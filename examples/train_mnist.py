@@ -1,0 +1,52 @@
+"""User-style training script: LeNet on MNIST via the public API."""
+import time
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.jit import TrainStep
+
+paddle.seed(0)
+print("device:", paddle.get_device())
+
+train_ds = MNIST(mode="train")
+loader = DataLoader(train_ds, batch_size=128, shuffle=True, drop_last=True)
+
+model = LeNet(num_classes=10)
+opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+ce = nn.CrossEntropyLoss()
+step = TrainStep(model, lambda m, x, y: ce(m(x), y), opt)
+
+t0 = time.time()
+first = last = None
+n = 0
+for epoch in range(3):
+    for x, y in loader:
+        loss = step(x, y)
+        n += 1
+        if first is None:
+            first = float(loss)
+            print(f"compile+first step: {time.time()-t0:.1f}s")
+        last = float(loss)
+print(f"steps={n} first_loss={first:.4f} last_loss={last:.4f}")
+assert last < first * 0.5, "loss did not decrease"
+
+# eval through eager path
+model.eval()
+xb, yb = next(iter(DataLoader(MNIST(mode="test"), batch_size=256)))
+with paddle.no_grad():
+    logits = model(xb)
+acc = float((logits.argmax(-1) == yb).astype("float32").mean())
+print(f"test acc: {acc:.3f}")
+assert acc > 0.9, "synthetic MNIST should be nearly separable"
+
+# checkpoint round-trip
+paddle.save(model.state_dict(), "/tmp/vdemo/lenet.pdparams")
+m2 = LeNet()
+m2.set_state_dict(paddle.load("/tmp/vdemo/lenet.pdparams"))
+d = float(abs(m2.fc[0].weight.numpy() - model.fc[0].weight.numpy()).max())
+print("save/load max param delta:", d)
+assert d == 0.0
+print("OK")

@@ -1,0 +1,54 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new design with the capabilities of the reference PaddlePaddle Fluid
+(v1.8 era, see SURVEY.md): eager (dygraph-parity) execution with tape
+autograd over jax ops, whole-step jit compilation for the fast path, SPMD
+parallelism over jax.sharding meshes, and paddle-flavored user APIs
+(Tensor / nn.Layer / optimizer / io / fleet).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    Tensor, to_tensor, is_tensor, no_grad, enable_grad, seed,
+    set_default_dtype, get_default_dtype, set_device, get_device,
+    device_count, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+    is_compiled_with_tpu, is_compiled_with_cuda, get_flags, set_flags,
+    rng_scope,
+)
+from .framework.dtype import (  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128,
+)
+from .framework import math_op_patch  # noqa: F401  (installs Tensor dunders)
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import autograd  # noqa: F401
+from .autograd import grad  # noqa: F401
+from . import vision  # noqa: F401
+from .framework.tape import no_grad as no_grad  # noqa: F401
+
+
+def save(obj, path, **kwargs):
+    from .io.serialization import save as _save
+
+    return _save(obj, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .io.serialization import load as _load
+
+    return _load(path, **kwargs)
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes)

@@ -1,0 +1,184 @@
+"""Automatic mixed precision.
+
+Parity with the reference AMP stack (/root/reference/python/paddle/fluid/
+dygraph/amp/auto_cast.py:90 amp_guard, loss_scaler.py:27 AmpScaler,
+contrib/mixed_precision/decorator.py, operators/amp/
+amp_check_finite_and_scale_op.cc). On TPU the low-precision type is
+bfloat16, which needs no loss scaling for convergence — GradScaler is kept
+for API parity and for float16 experiments; auto_cast switches the op
+white-list to bf16 inputs.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+_state = threading.local()
+
+# ops whose inputs are cast down under autocast (reference fp16_lists.py
+# white_list) — matmul/conv ride the MXU in bf16.
+WHITE_LIST = {"matmul", "conv1d", "conv2d", "conv3d", "linear", "bmm", "mv",
+              "einsum"}
+# numerically sensitive ops stay f32 (reference black_list)
+BLACK_LIST = {"softmax_with_cross_entropy", "softmax", "log_softmax",
+              "layer_norm", "reduce_mean", "reduce_sum", "exp", "log",
+              "norm", "p_norm", "logsumexp"}
+
+
+def amp_enabled():
+    return getattr(_state, "amp_level", "O0") != "O0"
+
+
+def amp_dtype():
+    return getattr(_state, "amp_dtype", jnp.bfloat16)
+
+
+class auto_cast:
+    """with amp.auto_cast(): matmul-family ops run in bf16."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        self.enable = enable
+        self.level = level if enable else "O0"
+        self.dtype = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") \
+            else jnp.float16
+        self.white = set(custom_white_list or ()) | WHITE_LIST
+        self.black = set(custom_black_list or ()) | BLACK_LIST
+
+    def __enter__(self):
+        self._prev = (getattr(_state, "amp_level", "O0"),
+                      getattr(_state, "amp_dtype", jnp.bfloat16),
+                      getattr(_state, "amp_white", None),
+                      getattr(_state, "amp_black", None))
+        _state.amp_level = self.level
+        _state.amp_dtype = self.dtype
+        _state.amp_white = self.white
+        _state.amp_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.amp_level, _state.amp_dtype, _state.amp_white,
+         _state.amp_black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name, arrays):
+    """Called by the op bridge under autocast (white-list policy)."""
+    level = getattr(_state, "amp_level", "O0")
+    if level == "O0":
+        return arrays
+    white = getattr(_state, "amp_white", WHITE_LIST)
+    black = getattr(_state, "amp_black", BLACK_LIST)
+    dt = amp_dtype()
+    if op_name in white or level == "O2" and op_name not in black:
+        return [a.astype(dt) if hasattr(a, "dtype") and
+                jnp.issubdtype(a.dtype, jnp.floating) else a for a in arrays]
+    if op_name in black:
+        return [a.astype(jnp.float32) if hasattr(a, "dtype") and
+                a.dtype in (jnp.bfloat16, jnp.float16) else a for a in arrays]
+    return arrays
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference loss_scaler.py AmpScaler)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        found = False
+        for p in optimizer._params():
+            if p.grad is not None:
+                g = p.grad.value / self._scale
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found = True
+                p.grad._value = g
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)  # no-op if the user already unscaled
+        if self._found_inf:
+            optimizer.clear_grad()
+        else:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def set_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good = state["good"]
+        self._bad = state["bad"]
+
+
+AmpScaler = GradScaler
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts layer params to bf16."""
+    if level == "O2" and models is not None:
+        targets = models if isinstance(models, (list, tuple)) else [models]
+        for m in targets:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

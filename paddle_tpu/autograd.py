@@ -1,0 +1,177 @@
+"""Autograd utilities: paddle.grad / PyLayer.
+
+Parity with the reference double-grad engine
+(/root/reference/paddle/fluid/imperative/partial_grad_engine.cc) and
+dygraph PyLayer. paddle.grad computes cotangents over the recorded tape
+without touching .grad accumulators.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework import tape as tape_mod
+from .framework.tensor import Tensor
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """Returns grads of outputs w.r.t. inputs (does not fill .grad)."""
+    if create_graph:
+        # The eager tape stores opaque vjp closures, which cannot be
+        # re-differentiated; higher-order grads go through the functional
+        # path (jax.grad composition in jit.TrainStep / paddle_tpu.jit).
+        from .framework.errors import UnimplementedError
+
+        raise UnimplementedError(
+            "grad(create_graph=True) is not supported on the eager tape; "
+            "compose jax.grad via paddle_tpu.jit for higher-order "
+            "derivatives")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
+        else [grad_outputs]
+
+    retain = True if retain_graph is None else retain_graph
+    cot = {}
+    alive = {}
+    nodes_seen = []
+    for out, g in zip(outputs, grad_outputs):
+        gv = jnp.ones(out.shape, out.dtype) if g is None else (
+            g.value if isinstance(g, Tensor) else jnp.asarray(g))
+        k = id(out)
+        cot[k] = cot.get(k, 0) + gv
+        alive[k] = out
+
+    # multi-root topological walk
+    roots = [o._node for o in outputs if o._node is not None]
+    order = _topo_multi(roots)
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    results = [None] * len(inputs)
+    for t in inputs:
+        if id(t) in cot:
+            results[input_ids[id(t)]] = Tensor(cot[id(t)])
+
+    for node in order:
+        outs = []
+        any_needed = False
+        for ref, aval in zip(node.out_refs, node.out_avals):
+            t = ref()
+            ct = cot.pop(id(t), None) if t is not None else None
+            if ct is None:
+                ct = jnp.zeros(aval.shape, aval.dtype)
+            else:
+                any_needed = True
+            outs.append(ct)
+        if not any_needed or node.vjp is None:
+            continue
+        in_cts = node.vjp(tuple(outs) if len(outs) > 1 else outs[0])
+        for t, ct in zip(node.inputs, in_cts):
+            if getattr(ct, "dtype", None) == jax.dtypes.float0:
+                continue
+            k = id(t)
+            if k in input_ids:
+                i = input_ids[k]
+                if results[i] is None:
+                    results[i] = Tensor(ct)
+                else:
+                    results[i]._value = results[i]._value + ct
+            if t._node is not None:
+                cot[k] = cot.get(k, 0) + ct
+        if not retain:
+            node.vjp = None
+
+    if not allow_unused:
+        for i, r in enumerate(results):
+            if r is None:
+                results[i] = Tensor(jnp.zeros(inputs[i].shape, inputs[i].dtype))
+    return results
+
+
+def _topo_multi(roots):
+    post = []
+    visited = set()
+    for root in roots:
+        if id(root) in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                post.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for t in node.inputs:
+                child = t._node
+                if child is not None and id(child) not in visited:
+                    stack.append((child, False))
+    post.reverse()
+    return post
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom op with user forward/backward (dygraph PyLayer parity)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with tape_mod.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        in_tensors = [a for a in args if isinstance(a, Tensor)
+                      and not a.stop_gradient]
+        if tape_mod.grad_enabled() and in_tensors:
+            def vjp(cts):
+                cts = cts if isinstance(cts, tuple) else (cts,)
+                ct_tensors = [Tensor(c) for c in cts]
+                with tape_mod.no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                return tuple(
+                    g.value if isinstance(g, Tensor) else g for g in gin)
+
+            node = tape_mod.TapeNode(vjp, in_tensors, cls.__name__)
+            wrapped = []
+            for o in outs:
+                t = Tensor(o.value if isinstance(o, Tensor) else o,
+                           stop_gradient=False)
+                t._node = node
+                node.add_output(t)
+                wrapped.append(t)
+            outs = wrapped
+        return outs[0] if single else tuple(outs)

@@ -1,0 +1,43 @@
+"""Distributed runtime + collectives + fleet (reference
+python/paddle/distributed + fluid collective ops — see SURVEY.md §2.6).
+
+TPU-native design: process-level multi-host via jax.distributed; data-plane
+collectives are XLA ops over ICI inside pjit/shard_map programs; the eager
+paddle.distributed.all_reduce facade maps to host-visible jax operations
+over the global mesh. The reference's NCCL ring bootstrap (c_gen_nccl_id,
+TCP exchange) is replaced by the jax.distributed coordination service.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, broadcast, reduce, scatter, reduce_scatter,
+    barrier, send, recv, ReduceOp,
+)
+from . import fleet  # noqa: F401
+from .parallel import init_parallel_env, DataParallel  # noqa: F401
+from .launch import spawn  # noqa: F401
+
+_initialized = [False]
+
+
+def get_world_size() -> int:
+    return jax.process_count() * max(1, jax.local_device_count()) \
+        if _initialized[0] else int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def get_rank() -> int:
+    return jax.process_index() if _initialized[0] else \
+        int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host bring-up (jax.distributed.initialize). Single-host no-op."""
+    if num_processes and num_processes > 1:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    _initialized[0] = True

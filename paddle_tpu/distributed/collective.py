@@ -1,0 +1,218 @@
+"""Collective communication facade.
+
+Parity with the reference collective ops
+(/root/reference/paddle/fluid/operators/collective/c_allreduce_op.h,
+c_broadcast_op.cc, c_allgather_op.cc, c_reducescatter_op.cc) and
+paddle.distributed.{all_reduce,...}. Inside SPMD regions (shard_map/pjit
+over a Mesh) these lower to XLA collectives on ICI; in single-process eager
+mode with one device they are identities, matching world_size=1 reference
+behavior. ring_id ≈ named mesh axis.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.op import primitive
+from ..framework.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _in_spmd(axis_name):
+    try:
+        jax.core.get_axis_size(axis_name)
+        return True
+    except BaseException:
+        return False
+
+
+def _axis(group):
+    if group is None:
+        return "data"
+    if isinstance(group, str):
+        return group
+    return getattr(group, "axis_name", "data")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis(group)
+    if not _in_spmd(axis):
+        return tensor  # world size 1
+
+    @primitive("c_allreduce")
+    def _ar(x, op, axis):
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(x, axis)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(x, axis)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(x, axis)
+        if op == ReduceOp.AVG:
+            return jax.lax.pmean(x, axis)
+        if op == ReduceOp.PROD:
+            return jnp.exp(jax.lax.psum(jnp.log(x), axis))
+        raise ValueError(op)
+
+    out = _ar(tensor, op=op, axis=axis)
+    if isinstance(tensor, Tensor):
+        tensor._value = out.value if isinstance(out, Tensor) else out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    if not _in_spmd(ax):
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+
+    @primitive("c_allgather")
+    def _ag(x, ax):
+        return jax.lax.all_gather(x, ax)
+
+    gathered = _ag(tensor, ax=ax)
+    if isinstance(tensor_list, list):
+        n = gathered.shape[0]
+        for i in range(n):
+            tensor_list.append(gathered[i])
+        return tensor_list
+    return gathered
+
+
+def reduce_scatter(output, input_list_or_tensor, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _axis(group)
+    if not _in_spmd(ax):
+        return input_list_or_tensor
+
+    @primitive("c_reducescatter")
+    def _rs(x, ax):
+        return jax.lax.psum_scatter(x, ax, tiled=True)
+
+    out = _rs(input_list_or_tensor, ax=ax)
+    if output is not None and isinstance(output, Tensor):
+        output._value = out.value if isinstance(out, Tensor) else out
+        return output
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_spmd(ax):
+        return tensor
+
+    @primitive("c_broadcast")
+    def _bc(x, src, ax):
+        # select src's value on every member of the axis
+        idx = jax.lax.axis_index(ax)
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        return jax.lax.psum(masked, ax)
+
+    out = _bc(tensor, src=src, ax=ax)
+    if isinstance(tensor, Tensor):
+        tensor._value = out.value if isinstance(out, Tensor) else out
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # XLA collectives are symmetric; reduce = allreduce (dst sees the result)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_spmd(ax):
+        return tensor
+
+    @primitive("c_scatter")
+    def _sc(stacked, src, ax):
+        full = jax.lax.psum(
+            jnp.where(jax.lax.axis_index(ax) == src, stacked,
+                      jnp.zeros_like(stacked)), ax)
+        idx = jax.lax.axis_index(ax)
+        return jax.lax.dynamic_index_in_dim(full, idx, keepdims=False)
+
+    from ..ops.manipulation import _stack
+
+    stacked = _stack([t for t in tensor_list], axis=0) if tensor_list else tensor
+    return _sc(stacked, src=src, ax=ax)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_spmd(ax):
+        return in_tensor_list
+
+    @primitive("c_alltoall")
+    def _a2a(x, ax):
+        return jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    from ..ops.manipulation import _concat
+
+    x = _concat(list(in_tensor_list), axis=0) \
+        if isinstance(in_tensor_list, (list, tuple)) else in_tensor_list
+    return _a2a(x, ax=ax)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point: realized as ppermute inside SPMD programs."""
+    ax = _axis(group)
+    if not _in_spmd(ax):
+        return tensor
+
+    @primitive("p_send")
+    def _p(x, dst, ax):
+        n = jax.lax.axis_size(ax)
+        perm = [(i, dst) for i in range(n)]
+        return jax.lax.ppermute(x, ax, perm)
+
+    return _p(tensor, dst=dst, ax=ax)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_spmd(ax):
+        return tensor
+
+    @primitive("p_recv")
+    def _p(x, src, ax):
+        n = jax.lax.axis_size(ax)
+        perm = [(src, i) for i in range(n)]
+        return jax.lax.ppermute(x, ax, perm)
+
+    out = _p(tensor, src=src, ax=ax)
+    if isinstance(tensor, Tensor):
+        tensor._value = out.value if isinstance(out, Tensor) else out
+    return tensor
+
+
+def barrier(group=None):
+    """Host-level sync: blocks until all live computations finish."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+class Group:
+    def __init__(self, rank, world_size, id=0, ranks=None, axis_name="data"):
+        self.rank = rank
+        self.nranks = world_size
+        self.id = id
+        self.ranks = ranks or list(range(world_size))
+        self.axis_name = axis_name
+
+
+def new_group(ranks=None, backend=None, axis_name="data"):
+    from . import get_rank, get_world_size
+
+    return Group(get_rank(), len(ranks) if ranks else get_world_size(),
+                 ranks=ranks, axis_name=axis_name)

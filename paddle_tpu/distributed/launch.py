@@ -1,0 +1,111 @@
+"""Process launcher.
+
+Parity with /root/reference/python/paddle/distributed/launch.py and
+fleet/launch_utils.py (Cluster :31, Pod :138, start_local_trainers :351,
+watch_local_trainers :418): spawns one worker process per host (TPU chips
+within a host are all driven by one process — unlike the reference's
+process-per-GPU), wires PADDLE_* env vars, supervises children, and kills
+the job when any worker dies.
+
+CLI: python -m paddle_tpu.distributed.launch --nproc_per_node=1 train.py
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _worker_env(rank, nranks, endpoints):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nranks),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "FLAGS_selected_tpus": str(rank),
+    })
+    return env
+
+
+def start_local_trainers(nranks, script_args, base_port=6170):
+    endpoints = [f"127.0.0.1:{base_port + i}" for i in range(nranks)]
+    procs = []
+    for rank in range(nranks):
+        cmd = [sys.executable] + script_args
+        procs.append(subprocess.Popen(
+            cmd, env=_worker_env(rank, nranks, endpoints)))
+    return procs
+
+
+def watch_local_trainers(procs, poll_interval=1.0):
+    """Abort-all-on-any-failure supervision (launch_utils.py:418)."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    raise RuntimeError(
+                        f"Trainer pid={p.pid} exited with code {ret}; "
+                        "job aborted")
+            if not alive:
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        raise
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity (multiprocessing-based)."""
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env_patch = {"PADDLE_TRAINER_ID": str(rank),
+                     "PADDLE_TRAINERS_NUM": str(nprocs)}
+
+        def target(rank=rank, env_patch=env_patch):
+            os.environ.update(env_patch)
+            func(*args)
+
+        p = ctx.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned process exited {p.exitcode}")
+    return procs
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--started_port", type=int, default=6170)
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    procs = start_local_trainers(
+        args.nproc_per_node,
+        [args.training_script] + args.training_script_args,
+        base_port=args.started_port)
+    sys.exit(watch_local_trainers(procs))
+
+
+if __name__ == "__main__":
+    main()

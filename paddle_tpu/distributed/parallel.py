@@ -1,0 +1,60 @@
+"""Eager data parallelism facade.
+
+Parity with the reference dygraph DataParallel
+(/root/reference/python/paddle/fluid/dygraph/parallel.py:225 DataParallel,
+scale_loss :289, apply_collective_grads :386). TPU-native execution model:
+one Python process drives all local TPU chips, so "multi-process DP with
+NCCL grad allreduce" becomes "shard the batch over the mesh's data axis and
+let XLA insert the gradient psum" — see paddle_tpu.parallel.parallelize and
+jit.TrainStep(mesh=...). This wrapper keeps the reference API and marks the
+model for data-parallel compilation.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+
+
+def init_parallel_env():
+    from . import init_distributed
+
+    init_distributed()
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    def __init__(self):
+        from . import get_rank, get_world_size
+
+        self.rank = get_rank()
+        self.world_size = get_world_size()
+        self.local_rank = self.rank
+        self.nranks = self.world_size
+        self.dev_id = 0
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.ddp_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # XLA's psum-of-mean makes explicit loss scaling unnecessary; kept
+        # for API parity with parallel.py:289.
+        return loss
+
+    def apply_collective_grads(self):
+        # grad sync happens inside the compiled step (psum over mesh axis);
+        # eager single-process grads need no sync.
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,27 @@
+"""Framework core: Tensor, autograd tape, dtypes, places, flags, RNG.
+
+TPU-native equivalent of the reference L0/L1 layers
+(/root/reference/paddle/fluid/platform + framework — see SURVEY.md §1).
+"""
+from . import dtype  # noqa: F401
+from .dtype import (  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, convert_dtype, set_default_dtype,
+    get_default_dtype,
+)
+from .errors import (  # noqa: F401
+    EnforceNotMet, InvalidArgumentError, NotFoundError, OutOfRangeError,
+    AlreadyExistsError, ResourceExhaustedError, PreconditionNotMetError,
+    PermissionDeniedError, ExecutionTimeoutError, UnimplementedError,
+    UnavailableError, FatalError, ExternalError, enforce,
+)
+from .flags import define_flag, get_flags, set_flags, get_flag  # noqa: F401
+from .place import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+    is_compiled_with_tpu, is_compiled_with_cuda, get_device, set_device,
+    device_count, get_default_place,
+)
+from .random import seed, default_generator, rng_scope, Generator  # noqa: F401
+from .tape import no_grad, enable_grad, grad_enabled  # noqa: F401
+from .tensor import Tensor, to_tensor, is_tensor  # noqa: F401
+from .op import primitive, OP_REGISTRY  # noqa: F401

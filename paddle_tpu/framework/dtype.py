@@ -1,0 +1,81 @@
+"""Dtype registry for paddle_tpu.
+
+TPU-native replacement for the reference dtype plumbing
+(/root/reference/paddle/fluid/framework/framework.proto:104 VarType and
+python/paddle/fluid/data_feeder.py convert_dtype): here dtypes are plain
+jax/numpy dtypes with paddle-style string aliases, bfloat16 first-class.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (exported at package top level as paddle_tpu.float32 ...)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def convert_dtype(dtype):
+    """Normalise a string / numpy / jax dtype to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return np.dtype(_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def set_default_dtype(dtype):
+    _DEFAULT_DTYPE[0] = convert_dtype(dtype)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)
+
+
+def is_inexact(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.inexact)

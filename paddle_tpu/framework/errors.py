@@ -1,0 +1,79 @@
+"""Typed error hierarchy + enforce helpers.
+
+TPU-native equivalent of the reference PADDLE_ENFORCE machinery
+(/root/reference/paddle/fluid/platform/enforce.h and error_codes.proto):
+the typed error-code taxonomy is kept, the C++ macro layer is replaced by
+plain Python exceptions raised at the framework boundary.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base framework error (reference: platform::EnforceNotMet)."""
+
+    code = "LEGACY"
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+class ExternalError(EnforceNotMet):
+    code = "EXTERNAL"
+
+
+def enforce(cond, msg="Enforce failed", exc=InvalidArgumentError):
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, msg=None, exc=InvalidArgumentError):
+    if a != b:
+        raise exc(msg or f"Expected {a!r} == {b!r}")
+
+
+def enforce_shape_match(shape_a, shape_b, msg=None):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(
+            msg or f"Shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}"
+        )

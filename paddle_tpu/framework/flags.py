@@ -1,0 +1,71 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference gflags layer
+(/root/reference/paddle/fluid/platform/flags.cc plus the
+pybind/global_value_getter_setter.cc export): a typed in-process registry,
+seeded from FLAGS_* environment variables, settable via set_flags()
+(parity with fluid.set_flags / fluid.get_flags).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_lock = threading.Lock()
+_registry: Dict[str, Any] = {}
+_docs: Dict[str, str] = {}
+
+
+def define_flag(name: str, default, doc: str = ""):
+    with _lock:
+        if name in _registry:
+            return
+        env = os.environ.get(f"FLAGS_{name}")
+        value = default
+        if env is not None:
+            if isinstance(default, bool):
+                value = env.lower() in ("1", "true", "yes", "on")
+            elif isinstance(default, int):
+                value = int(env)
+            elif isinstance(default, float):
+                value = float(env)
+            else:
+                value = env
+        _registry[name] = value
+        _docs[name] = doc
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: _registry[n] for n in names}
+
+
+def get_flag(name: str):
+    return _registry[name]
+
+
+def set_flags(flags: Dict[str, Any]):
+    with _lock:
+        for name, value in flags.items():
+            if name not in _registry:
+                raise KeyError(f"Flag {name!r} is not defined")
+            _registry[name] = value
+
+
+def all_flags():
+    return dict(_registry)
+
+
+# Core flags (subset of the reference's platform/flags.cc that is meaningful on TPU).
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf (reference flags.cc:44)")
+define_flag("benchmark", False, "Sync + time each op in eager mode")
+define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity; XLA manages buffers")
+define_flag("paddle_num_threads", 1, "Host threads for data pipeline")
+define_flag("use_pinned_memory", True, "Kept for API parity; jax manages transfers")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "API parity; XLA preallocation governs")
+define_flag("init_allocated_mem", False, "API parity")
+define_flag("cudnn_deterministic", False, "Maps to XLA deterministic ops")
+define_flag("max_inplace_grad_add", 0, "API parity")
+define_flag("tracer_profile_fname", "", "Eager tracer profile output path")

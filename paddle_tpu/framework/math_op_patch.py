@@ -1,0 +1,81 @@
+"""Arithmetic operator overloads on Tensor.
+
+Parity with the reference math_op_patch
+(/root/reference/python/paddle/fluid/layers/math_op_patch.py): dunders
+dispatch to the op library so they participate in autograd.
+"""
+from __future__ import annotations
+
+from .tensor import Tensor
+
+
+def _install():
+    from .. import ops
+
+    def binop(fn, swap=False):
+        def method(self, other):
+            if swap:
+                return fn(other, self)
+            return fn(self, other)
+
+        return method
+
+    patches = {
+        "__add__": binop(ops.add),
+        "__radd__": binop(ops.add, swap=True),
+        "__sub__": binop(ops.subtract),
+        "__rsub__": binop(ops.subtract, swap=True),
+        "__mul__": binop(ops.multiply),
+        "__rmul__": binop(ops.multiply, swap=True),
+        "__truediv__": binop(ops.divide),
+        "__rtruediv__": binop(ops.divide, swap=True),
+        "__floordiv__": binop(ops.floor_divide),
+        "__rfloordiv__": binop(ops.floor_divide, swap=True),
+        "__mod__": binop(ops.mod),
+        "__rmod__": binop(ops.mod, swap=True),
+        "__pow__": binop(ops.pow),
+        "__rpow__": binop(ops.pow, swap=True),
+        "__matmul__": binop(ops.matmul),
+        "__rmatmul__": binop(ops.matmul, swap=True),
+        "__neg__": lambda self: ops.neg(self),
+        "__abs__": lambda self: ops.abs(self),
+        "__invert__": lambda self: ops.logical_not(self),
+        "__eq__": binop(ops.equal),
+        "__ne__": binop(ops.not_equal),
+        "__lt__": binop(ops.less_than),
+        "__le__": binop(ops.less_equal),
+        "__gt__": binop(ops.greater_than),
+        "__ge__": binop(ops.greater_equal),
+        "__and__": binop(ops.logical_and),
+        "__or__": binop(ops.logical_or),
+        "__xor__": binop(ops.logical_xor),
+    }
+    for name, fn in patches.items():
+        setattr(Tensor, name, fn)
+
+    # tensor methods mirroring paddle.Tensor methods
+    methods = [
+        "add", "subtract", "multiply", "divide", "pow", "matmul", "mod",
+        "maximum", "minimum", "exp", "log", "log2", "log10", "sqrt", "rsqrt",
+        "abs", "ceil", "floor", "round", "cos", "sin", "tan", "tanh",
+        "sigmoid", "square", "sign", "reciprocal", "erf", "neg", "clip",
+        "sum", "mean", "max", "min", "prod", "any", "all", "std", "var",
+        "logsumexp", "cumsum", "cumprod", "argmax", "argmin", "argsort",
+        "sort", "topk", "reshape", "transpose", "flatten", "squeeze",
+        "unsqueeze", "split", "chunk", "tile", "expand", "expand_as",
+        "broadcast_to", "gather", "gather_nd", "scatter", "index_select",
+        "roll", "flip", "norm", "dist", "dot", "cross", "bmm", "mm",
+        "cholesky", "inverse", "isnan", "isinf", "isfinite", "equal",
+        "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal", "logical_and", "logical_or", "logical_not",
+        "allclose", "equal_all", "isclose", "where", "masked_fill",
+        "unbind", "kron", "trace", "diagonal", "flatten", "take_along_axis",
+        "put_along_axis", "scale", "stanh", "unique",
+    ]
+    for m in methods:
+        fn = getattr(ops, m, None)
+        if fn is not None and not hasattr(Tensor, m):
+            setattr(Tensor, m, (lambda f: lambda self, *a, **k: f(self, *a, **k))(fn))
+
+
+_install()

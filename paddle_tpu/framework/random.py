@@ -1,0 +1,95 @@
+"""RNG state management.
+
+TPU-native replacement for the reference per-device Generator/curand state
+(/root/reference/paddle/fluid/framework/generator.cc): JAX PRNG keys with a
+global stateful generator for eager mode, and an explicit functional
+rng_scope for traced (jit) code where stateful key splitting is not allowed.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+class Generator:
+    """Splittable counter-based generator over a jax PRNG key.
+
+    Key creation is lazy so importing the framework never touches a device
+    (backend bring-up happens on first op, like the reference's lazy
+    DeviceContextPool)."""
+
+    def __init__(self, seed: int = 0):
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._key = None
+        self._seed = seed
+        return self
+
+    def next_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+
+_default_generator = Generator(0)
+
+
+def seed(s: int):
+    """Parity with paddle.seed — reseeds the global eager generator."""
+    _default_generator.manual_seed(int(s))
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+class rng_scope:
+    """Provide an explicit PRNG key to stochastic ops inside traced code.
+
+    Inside `with rng_scope(key):`, ops that need randomness (dropout, ...)
+    fold into this key deterministically instead of consuming the global
+    generator, which keeps the computation jit-traceable and replayable.
+    """
+
+    def __init__(self, key_or_seed):
+        if isinstance(key_or_seed, int):
+            key_or_seed = jax.random.key(key_or_seed)
+        self.key = key_or_seed
+        self._count = 0
+
+    def __enter__(self):
+        stack = getattr(_state, "rng_stack", None)
+        if stack is None:
+            stack = _state.rng_stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.rng_stack.pop()
+        return False
+
+    def next_key(self):
+        self._count += 1
+        return jax.random.fold_in(self.key, self._count)
+
+
+def next_rng_key():
+    """Next key for a stochastic op: scope key if inside rng_scope else global."""
+    stack = getattr(_state, "rng_stack", None)
+    if stack:
+        return stack[-1].next_key()
+    return _default_generator.next_key()
+
+
+def in_rng_scope() -> bool:
+    stack = getattr(_state, "rng_stack", None)
+    return bool(stack)

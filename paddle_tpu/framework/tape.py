@@ -1,0 +1,163 @@
+"""Eager-mode autograd engine.
+
+TPU-native replacement for the reference dygraph tracer + BasicEngine
+(/root/reference/paddle/fluid/imperative/tracer.cc:46 TraceOp,
+basic_engine.cc:161 Execute): instead of recording OpBase grad-op nodes and
+re-dispatching CUDA kernels, every differentiable op is executed through
+jax.vjp at op granularity; the recorded VJP closures form the autograd DAG
+and Tensor.backward() walks it in reverse topological order. The fast path
+(jit) bypasses this entirely — whole-step jax.grad inside one XLA program.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_state = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _state.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+class TapeNode:
+    """One differentiable op application: vjp closure + graph edges."""
+
+    __slots__ = ("vjp", "inputs", "out_refs", "out_avals", "name", "__weakref__")
+
+    def __init__(self, vjp, inputs, name=""):
+        self.vjp = vjp  # cotangents-of-outputs (tuple) -> cotangents-of-inputs
+        self.inputs = inputs  # List[Tensor] (strong refs keep graph alive)
+        self.out_refs: List[Any] = []  # weakrefs to output Tensors
+        self.out_avals: List[Any] = []  # ShapeDtypeStruct per output
+        self.name = name
+
+    def add_output(self, tensor):
+        self.out_refs.append(weakref.ref(tensor))
+        self.out_avals.append(
+            jax.ShapeDtypeStruct(tensor.shape, tensor.dtype)
+        )
+
+
+def _topo_nodes(root: TapeNode) -> List[TapeNode]:
+    """Reverse-topological order (consumers before producers). Iterative DFS."""
+    post: List[TapeNode] = []
+    visited = set()
+    stack = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            post.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            child = t._node
+            if child is not None and id(child) not in visited:
+                stack.append((child, False))
+    post.reverse()  # root (consumer) first, producers after
+    return post
+
+
+def backward(tensor, grad=None, retain_graph: bool = False):
+    """Reverse-mode accumulation into leaf .grad (reference basic_engine.cc:161)."""
+    from .tensor import Tensor
+
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            g = jnp.ones(tensor.shape, tensor.dtype) if grad is None else _as_array(grad)
+            tensor._accumulate_grad(g)
+        return
+
+    if grad is None:
+        grad = jnp.ones(tensor.shape, tensor.dtype)
+    else:
+        grad = _as_array(grad)
+
+    # cotangent accumulator keyed by tensor id; keep tensors alive during walk
+    cotangents = {id(tensor): grad}
+    alive = {id(tensor): tensor}
+
+    for node in _topo_nodes(tensor._node):
+        outs = []
+        any_needed = False
+        for ref, aval in zip(node.out_refs, node.out_avals):
+            t = ref()
+            ct = cotangents.pop(id(t), None) if t is not None else None
+            if t is not None:
+                alive.pop(id(t), None)
+            if ct is None:
+                ct = jnp.zeros(aval.shape, aval.dtype)
+            else:
+                any_needed = True
+            outs.append(ct)
+        if not any_needed or node.vjp is None:
+            continue
+        in_cts = node.vjp(tuple(outs) if len(outs) > 1 else outs[0])
+        for t, ct in zip(node.inputs, in_cts):
+            if not isinstance(ct, jax.Array) and not isinstance(ct, np.ndarray):
+                continue  # float0 / symbolic zero for int inputs
+            if getattr(ct, "dtype", None) == jax.dtypes.float0:
+                continue
+            if t._node is None:
+                # leaf: accumulate straight into .grad
+                if not t.stop_gradient:
+                    t._accumulate_grad(ct)
+            else:
+                k = id(t)
+                if k in cotangents:
+                    cotangents[k] = cotangents[k] + ct
+                else:
+                    cotangents[k] = ct
+                    alive[k] = t
+        if not retain_graph:
+            node.vjp = None
+
+
+def _as_array(x):
+    from .tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x)

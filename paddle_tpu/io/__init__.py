@@ -1,0 +1,12 @@
+"""IO: datasets, loaders, serialization (reference fluid/reader.py,
+fluid/dataloader/, fluid/io.py)."""
+from .dataloader import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split, Sampler, SequenceSampler, RandomSampler,
+    WeightedRandomSampler, BatchSampler, DistributedBatchSampler, DataLoader,
+    default_collate_fn,
+)
+from .serialization import (  # noqa: F401
+    save, load, save_dygraph, load_dygraph, save_inference_model,
+    load_inference_model, save_persistables, load_persistables,
+)

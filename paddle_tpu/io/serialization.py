@@ -1,0 +1,88 @@
+"""Checkpoint save/load.
+
+Parity with /root/reference/python/paddle/fluid/io.py (save :1669 /
+load :1730 — single-file .pdparams/.pdopt pickles; save_inference_model
+:1164) and dygraph/checkpoint.py save_dygraph/load_dygraph. State dicts of
+numpy arrays are pickled; large sharded checkpoints can go through orbax
+(paddle_tpu.io.orbax_ckpt) instead.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def _to_numpy_state(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_numpy_state(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_state(v) for v in obj)
+    if hasattr(obj, "state_dict") and callable(obj.state_dict):
+        return _to_numpy_state(obj.state_dict())
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_state(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_dygraph(state_dict, model_path):
+    suffix = ".pdparams"
+    if any("moment" in k or k == "step" or "@" in k for k in state_dict):
+        suffix = ".pdopt"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path, **configs):
+    params = None
+    opt = None
+    if os.path.exists(model_path + ".pdparams"):
+        params = load(model_path + ".pdparams")
+    if os.path.exists(model_path + ".pdopt"):
+        opt = load(model_path + ".pdopt")
+    return params, opt
+
+
+def save_inference_model(path_prefix, layer, input_spec=None, **configs):
+    """Persist params + model class info for predictor reload
+    (reference io.py:1164 save_inference_model)."""
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    save(layer.state_dict(), path_prefix + ".pdiparams")
+    meta = {"class": type(layer).__name__}
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load_inference_model(path_prefix, **configs):
+    params = load(path_prefix + ".pdiparams")
+    return params
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, layer=None):
+    """Static-graph-style persistables save (reference io.py:598)."""
+    if layer is not None:
+        os.makedirs(dirname, exist_ok=True)
+        save(layer.state_dict(), os.path.join(dirname, filename or "params"))
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, layer=None):
+    if layer is not None:
+        state = load(os.path.join(dirname, filename or "params"))
+        layer.set_state_dict(state)

@@ -1,0 +1,75 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py:
+GradientClipByValue :117, GradientClipByNorm :186, GradientClipByGlobalNorm
+:254). Clips operate on (param, grad) lists — used by optimizers before the
+update rule, both eagerly and inside jitted train steps.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def apply_pytree(self, grads):
+        """Functional form over a pytree of raw arrays (for jitted steps)."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply_pytree(self, grads):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply_pytree(self, grads):
+        import jax
+
+        def clip_one(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            return g * scale
+
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def apply_pytree(self, grads):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+# reference-name aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def clip_grad_norm_(parameters, max_norm):
+    """Eager utility over Tensors (mutates .grad)."""
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return 0.0
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.value)) for g in grads))
+    scale = float(max_norm) / jnp.maximum(gnorm, float(max_norm))
+    for g in grads:
+        g._value = g._value * scale
+    return float(gnorm)

@@ -1,0 +1,1183 @@
+"""nn functional ops.
+
+Parity with the reference NN operator set (/root/reference/paddle/fluid/
+operators/: activation_op.cc, conv_op.cc, pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, softmax_op.cc, cross_entropy_op.cc, dropout_op.cc,
+lookup_table_v2_op.cc, interpolate_op.cc ...). Convs/matmuls lower to MXU
+via lax.conv_general_dilated / dot_general; everything else is fusable
+elementwise work for the VPU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.op import primitive
+from ..framework.random import next_rng_key
+from ..framework.tensor import Tensor, unwrap
+
+# ---------------------------------------------------------------------------
+# activations (activation_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@primitive("relu")
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+@primitive("relu6")
+def relu6(x, name=None):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+@primitive("leaky_relu")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@primitive("prelu_fn")
+def prelu(x, weight, data_format="NCHW", name=None):
+    if weight.size > 1:
+        shape = [1] * x.ndim
+        axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape[axis] = weight.size
+        weight = weight.reshape(shape)
+    return jnp.where(x >= 0, x, weight * x)
+
+
+@primitive("elu")
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha)
+
+
+@primitive("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@primitive("celu")
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha)
+
+
+@primitive("gelu")
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@primitive("silu")
+def silu(x, name=None):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@primitive("hardswish")
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@primitive("hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@primitive("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+@primitive("hardshrink")
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@primitive("softshrink")
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@primitive("tanhshrink")
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@primitive("softplus")
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jnp.log1p(jnp.exp(scaled)) / beta)
+
+
+@primitive("softsign")
+def softsign(x, name=None):
+    return x / (1.0 + jnp.abs(x))
+
+
+@primitive("mish")
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@primitive("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, name=None):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@primitive("maxout")
+def maxout(x, groups, axis=1, name=None):
+    c = x.shape[axis]
+    axis = axis % x.ndim
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@primitive("softmax")
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype_mod.convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@primitive("log_softmax")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype_mod.convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@primitive("gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = jax.random.gumbel(next_rng_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        hard_y = jnp.zeros_like(y).at[...].set(0.0)
+        hard_y = jnp.where(
+            jnp.arange(y.shape[axis]).reshape(
+                [-1 if i == axis % y.ndim else 1 for i in range(y.ndim)]) == idx,
+            1.0, 0.0)
+        # straight-through estimator
+        y = hard_y - jax.lax.stop_gradient(y) + y
+    return y
+
+
+@primitive("sigmoid_fn")
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding (mul_op.cc fc, lookup_table_v2_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@primitive("linear")
+def linear(x, weight, bias=None, name=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@primitive("embedding_fn")
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+@primitive("one_hot")
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype_mod.get_default_dtype())
+
+
+# ---------------------------------------------------------------------------
+# dropout family (dropout_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return _scale_only(x, factor=1.0 - p)
+        return x if isinstance(x, Tensor) else Tensor(x)
+    return _dropout(x, p=p, axis=axis, mode=mode, key=next_rng_key())
+
+
+@primitive("dropout")
+def _dropout(x, p, axis, mode, key):
+    if axis is None:
+        shape = x.shape
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0)
+    return jnp.where(keep, x, 0.0)
+
+
+@primitive("scale_only")
+def _scale_only(x, factor):
+    return x * factor
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    return _alpha_dropout(x, p=p, key=next_rng_key())
+
+
+@primitive("alpha_dropout")
+def _alpha_dropout(x, p, key):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / math.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))) if p < 1 else 0.0
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+# ---------------------------------------------------------------------------
+# convolutions (conv_op.cc / conv_transpose_op.cc) — MXU path
+# ---------------------------------------------------------------------------
+
+
+def _tuple_n(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _conv_padding(padding, n, strides=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(int(v) for v in p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"Bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+    stride = _tuple_n(stride, n)
+    dilation = _tuple_n(dilation, n)
+    pad = _conv_padding(padding, n)
+    if channel_last:
+        spatial = "DHW"[-n:]
+        lhs_spec = "N" + spatial + "C"
+    else:
+        spatial = "DHW"[-n:]
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (lhs_spec, rhs_spec, lhs_spec))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@primitive("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 channel_last=data_format == "NLC")
+
+
+@primitive("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 channel_last=data_format == "NHWC")
+
+
+@primitive("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 channel_last=data_format == "NDHWC")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last):
+    stride = _tuple_n(stride, n)
+    dilation = _tuple_n(dilation, n)
+    output_padding = _tuple_n(output_padding, n)
+    if isinstance(padding, str):
+        raise ValueError("string padding unsupported for conv_transpose")
+    pad = _conv_padding(padding, n)
+    if channel_last:
+        spatial = "DHW"[-n:]
+        lhs_spec = "N" + spatial + "C"
+    else:
+        spatial = "DHW"[-n:]
+        lhs_spec = "NC" + spatial
+    rhs_spec = "IO" + spatial  # paddle stores transpose weight as (Cin, Cout/g, K...)
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (lhs_spec, rhs_spec, lhs_spec))
+    # gradient-of-conv formulation: lhs_dilation=stride
+    k = [(weight.shape[2 + i] - 1) * dilation[i] for i in range(n)]
+    tpad = [(k[i] - pad[i][0], k[i] - pad[i][1] + output_padding[i])
+            for i in range(n)]
+    if groups > 1:
+        # weight (Cin, Cout/g, K) -> grouped transpose conv via reshape
+        cin = weight.shape[0]
+        w = weight.reshape(groups, cin // groups, *weight.shape[1:])
+        w = jnp.flip(w, axis=tuple(range(3, 3 + n)))
+        w = jnp.swapaxes(w, 1, 2)  # (g, Cout/g, Cin/g, K)
+        w = w.reshape(groups * w.shape[1], *w.shape[2:])  # (Cout, Cin/g, K)
+        dn2 = jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, (lhs_spec, "OI" + spatial, lhs_spec))
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,) * n, padding=tpad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn2,
+            feature_group_count=groups)
+    else:
+        w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+        w = jnp.swapaxes(w, 0, 1)  # (Cout, Cin, K)
+        dn2 = jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, (lhs_spec, "OI" + spatial, lhs_spec))
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,) * n, padding=tpad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn2)
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@primitive("conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC")
+
+
+@primitive("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC")
+
+
+@primitive("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC")
+
+
+# ---------------------------------------------------------------------------
+# pooling (pool_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, op, ceil_mode=False,
+          count_include_pad=True):
+    kernel = _tuple_n(kernel, n)
+    stride = _tuple_n(stride if stride is not None else kernel, n)
+    pad = _conv_padding(padding, n)
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)] if not isinstance(pad, str) else pad
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+    if isinstance(pads, str):
+        pads = jax.lax.padtype_to_pads(x.shape, window, strides, pads)
+    if ceil_mode:
+        pads = list(pads)
+        spatial_off = 1 if channel_last else 2
+        for i in range(n):
+            dim = spatial_off + i
+            size = x.shape[dim] + pads[dim][0] + pads[dim][1]
+            rem = (size - kernel[i]) % stride[i]
+            if rem != 0:
+                pads[dim] = (pads[dim][0], pads[dim][1] + stride[i] - rem)
+    if op == "max":
+        init = -jnp.inf if dtype_mod.is_floating(x.dtype) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+    ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if count_include_pad:
+        denom = float(np.prod(kernel))
+        return ssum / denom
+    ones = jnp.ones_like(x)
+    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+    return ssum / counts
+
+
+@primitive("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "max", ceil_mode)
+
+
+@primitive("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 "max", ceil_mode)
+
+
+@primitive("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "max", ceil_mode)
+
+
+@primitive("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "avg", ceil_mode, count_include_pad=not exclusive)
+
+
+@primitive("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 "avg", ceil_mode, count_include_pad=not exclusive)
+
+
+@primitive("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "avg", ceil_mode, count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, n, op, channel_last=False):
+    out_sizes = _tuple_n(output_size, n)
+    spatial_off = 1 if channel_last else 2
+    out = x
+    for i in range(n):
+        dim = spatial_off + i
+        in_size = out.shape[dim]
+        o = out_sizes[i] if out_sizes[i] is not None else in_size
+        if in_size % o == 0:
+            k = in_size // o
+            shape = out.shape[:dim] + (o, k) + out.shape[dim + 1:]
+            r = out.reshape(shape)
+            out = jnp.max(r, axis=dim + 1) if op == "max" else jnp.mean(r, axis=dim + 1)
+        else:
+            # general adaptive: gather variable windows
+            starts = (np.arange(o) * in_size) // o
+            ends = ((np.arange(o) + 1) * in_size + o - 1) // o
+            segs = []
+            for s, e in zip(starts, ends):
+                sl = jax.lax.slice_in_dim(out, int(s), int(e), axis=dim)
+                red = jnp.max(sl, axis=dim, keepdims=True) if op == "max" \
+                    else jnp.mean(sl, axis=dim, keepdims=True)
+                segs.append(red)
+            out = jnp.concatenate(segs, axis=dim)
+    return out
+
+
+@primitive("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+@primitive("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+@primitive("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+@primitive("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max")
+
+
+@primitive("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+@primitive("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max")
+
+
+# ---------------------------------------------------------------------------
+# normalization (batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc,
+# instance_norm_op.cc, norm_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@primitive("layer_norm")
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, (int, np.integer)):
+        normalized_shape = (int(normalized_shape),)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Stateful wrapper: updates running stats in training mode (eager)."""
+    axis = _bn_axis(unwrap(x).ndim, data_format)
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+    if use_stats:
+        return _batch_norm_infer(x, running_mean, running_var, weight, bias,
+                                 epsilon=epsilon, axis=axis)
+    out, mean, var = _batch_norm_train(x, weight, bias, epsilon=epsilon,
+                                       axis=axis)
+    if isinstance(running_mean, Tensor):
+        m = unwrap(mean)
+        v = unwrap(var)
+        running_mean._value = momentum * running_mean._value + (1 - momentum) * m
+        running_var._value = momentum * running_var._value + (1 - momentum) * v
+    return out
+
+
+def _bn_axis(ndim, data_format):
+    if data_format in ("NCHW", "NCL", "NCDHW", "NC"):
+        return 1
+    return ndim - 1
+
+
+@primitive("batch_norm_infer")
+def _batch_norm_infer(x, mean, var, weight, bias, epsilon, axis):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@primitive("batch_norm_train")
+def _batch_norm_train(x, weight, bias, epsilon, axis):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+@primitive("group_norm_fn")
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    if data_format != "NCHW" and x.ndim == 4:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    r = x.reshape(n, g, c // g, *x.shape[2:])
+    axes = tuple(range(2, r.ndim))
+    mean = jnp.mean(r, axis=axes, keepdims=True)
+    var = jnp.var(r, axis=axes, keepdims=True)
+    out = ((r - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format != "NCHW" and out.ndim == 4:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@primitive("instance_norm_fn")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+@primitive("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    chan_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[chan_axis] = (half, size - half - 1)
+    sq = jnp.pad(sq, pads)
+    window = [1] * x.ndim
+    window[chan_axis] = size
+    ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                 (1,) * x.ndim, [(0, 0)] * x.ndim)
+    return x / jnp.power(k + alpha * ssum, beta)
+
+
+@primitive("normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    nrm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+l2_normalize = normalize
+
+
+@primitive("rms_norm")
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    out = x * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses (cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, ...)
+# ---------------------------------------------------------------------------
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@primitive("softmax_with_cross_entropy")
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    if use_softmax:
+        logp = jax.nn.log_softmax(input, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(input, 1e-30))
+    n_classes = input.shape[axis]
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return _reduce_loss(loss, reduction)
+    lbl = label
+    if lbl.ndim == logp.ndim:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    if label_smoothing > 0.0:
+        onehot = jax.nn.one_hot(lbl, n_classes, dtype=logp.dtype, axis=axis)
+        soft = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(soft * logp, axis=axis)
+    else:
+        safe_lbl = jnp.where(lbl == ignore_index, 0, lbl)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_lbl, axis), axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis)
+    valid = lbl != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, jnp.where(valid, lbl, 0))
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ..framework.tensor import Tensor as _T
+
+    loss_nd = loss
+    if not soft_label:
+        lu = unwrap(label)
+        if lu.ndim < unwrap(logits).ndim:
+            from . import functional as F  # noqa
+
+            loss_nd = _unsqueeze_like(loss, axis=axis)
+    if return_softmax:
+        return loss_nd, softmax(logits, axis=axis)
+    return loss_nd
+
+
+@primitive("unsqueeze_like")
+def _unsqueeze_like(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@primitive("nll_loss")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    picked = jnp.take_along_axis(input, label[..., None], axis=-1)[..., 0]
+    loss = -picked
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, jnp.where(valid, label, 0))
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce_loss(loss, reduction)
+
+
+@primitive("binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+@primitive("sigmoid_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    neg_abs = -jnp.abs(logit)
+    base = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_weight = 1 + (pos_weight - 1) * label
+        base = jnp.maximum(logit, 0) - logit * label + \
+            log_weight * jnp.log1p(jnp.exp(neg_abs)) + \
+            (log_weight - 1) * jnp.maximum(-logit, 0)
+    if weight is not None:
+        base = base * weight
+    return _reduce_loss(base, reduction)
+
+
+@primitive("mse_loss")
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(jnp.square(input - label), reduction)
+
+
+@primitive("l1_loss")
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(jnp.abs(input - label), reduction)
+
+
+@primitive("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+@primitive("huber_loss")
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce_loss(loss, reduction)
+
+
+@primitive("kl_div")
+def kl_div(input, label, reduction="mean", name=None):
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+@primitive("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _reduce_loss(jnp.maximum(0.0, -label * (input - other) + margin),
+                        reduction)
+
+
+@primitive("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+    return _reduce_loss(loss, reduction)
+
+
+@primitive("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce_loss(loss, reduction)
+
+
+@primitive("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def pdist(a, b):
+        return jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+    dp = pdist(input, positive)
+    dn = pdist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, pdist(positive, negative))
+    return _reduce_loss(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+@primitive("square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@primitive("log_loss")
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return -label * jnp.log(input + epsilon) - \
+        (1 - label) * jnp.log(1 - input + epsilon)
+
+
+@primitive("ctc_loss_fn")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC forward (operators/warpctc_op.cc parity) as a lax.scan DP."""
+    # log_probs: (T, B, C) log-softmax scores; labels: (B, L)
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+
+    emit = jnp.take_along_axis(
+        jnp.transpose(log_probs, (1, 0, 2)),  # (B, T, C)
+        jnp.broadcast_to(ext[:, None, :], (B, T, S)), axis=2)  # (B,T,S)
+    emit = jnp.transpose(emit, (1, 0, 2))  # (T, B, S)
+
+    can_skip = jnp.concatenate(
+        [jnp.zeros((B, 2), bool),
+         (ext[:, 2:] != ext[:, :-2]) & (ext[:, 2:] != blank)], axis=1)
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(L > 0, emit[0, :, 1], neg_inf))
+
+    def step(alpha, e):
+        shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+        shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+        shift2 = jnp.where(can_skip, shift2, neg_inf)
+        new = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2) + e
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, emit[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, S)
+
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    final = alphas[t_idx, jnp.arange(B)]  # (B, S)
+    s_last = 2 * label_lengths  # blank after last label
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(final, s_last[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(final, jnp.maximum(s_last - 1, 0)[:, None], axis=1)[:, 0])
+    loss = -ll
+    if norm_by_times:
+        loss = loss / input_lengths.astype(loss.dtype)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1).astype(loss.dtype))
+    return _reduce_loss(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# attention — see ops/pallas/flash_attention.py for the fused TPU kernel
+# (reference fused op: operators/fused/multihead_matmul_op.cu)
+# ---------------------------------------------------------------------------
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """query/key/value: (B, L, H, D) paddle layout."""
+    use_dropout = dropout_p > 0.0 and training
+    return _sdpa(query, key, value, attn_mask,
+                 dropout_p=dropout_p if use_dropout else 0.0,
+                 is_causal=is_causal,
+                 key_rng=next_rng_key() if use_dropout else None)
+
+
+@primitive("sdpa")
+def _sdpa(q, k, v, mask, dropout_p, is_causal, key_rng):
+    from ..ops.pallas.flash_attention import flash_attention_or_fallback
+
+    return flash_attention_or_fallback(q, k, v, mask, dropout_p, is_causal,
+                                       key_rng)
+
+
+# ---------------------------------------------------------------------------
+# misc nn (interpolate_op.cc, pixel_shuffle_op.cc, pad ops, ...)
+# ---------------------------------------------------------------------------
+
+
+@primitive("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if not channel_last:
+        perm = (0,) + tuple(range(2, x.ndim)) + (1,)
+        xcl = jnp.transpose(x, perm)
+    else:
+        xcl = x
+    spatial = xcl.shape[1:-1]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = tuple(int(s) for s in size)
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if align_corners and jmode == "linear":
+        # jax.image.resize is half-pixel-center only; do per-dim linear
+        # interp with endpoint-preserving src = i*(in-1)/(out-1) sampling.
+        out = xcl
+        for d, o in enumerate(size):
+            dim = 1 + d
+            n = out.shape[dim]
+            if n == o:
+                continue
+            if o == 1 or n == 1:
+                src = jnp.zeros((o,))
+            else:
+                src = jnp.arange(o) * (n - 1) / (o - 1)
+            lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, n - 1)
+            hi = jnp.clip(lo + 1, 0, n - 1)
+            w = (src - lo).astype(out.dtype)
+            shape = [1] * out.ndim
+            shape[dim] = o
+            w = w.reshape(shape)
+            out = (jnp.take(out, lo, axis=dim) * (1 - w) +
+                   jnp.take(out, hi, axis=dim) * w)
+    else:
+        out = jax.image.resize(
+            xcl, (xcl.shape[0],) + size + (xcl.shape[-1],), method=jmode)
+    if not channel_last:
+        inv = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        out = jnp.transpose(out, inv)
+    return out
+
+
+upsample = interpolate
+
+
+@primitive("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, c // (r * r), r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h, w, r, r, c // (r * r))
+    out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+    return out.reshape(n, h * r, w * r, c // (r * r))
+
+
+@primitive("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+    return out.reshape(n, c * r * r, h // r, w // r)
+
+
+@primitive("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    n, c, h, w = x.shape
+    out = x.reshape(n, groups, c // groups, h, w)
+    out = jnp.swapaxes(out, 1, 2)
+    return out.reshape(n, c, h, w)
+
+
+@primitive("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    n, _, h, w = int(out_shape[0]), out_shape[1], int(out_shape[2]), int(out_shape[3])
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1
+        xs = (jnp.arange(w) * 2 + 1) / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    grid = jnp.matmul(jnp.tile(base, (theta.shape[0], 1, 1)),
+                      jnp.swapaxes(theta, 1, 2))
+    return grid.reshape(theta.shape[0], h, w, 2)
+
+
+@primitive("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    def gather(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        flat = x.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, 1, -1)
+        out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        out = out.reshape(n, c, *yy.shape[1:])
+        if padding_mode == "zeros":
+            out = out * valid[:, None].astype(out.dtype)
+        return out
+
+    wa = ((x1 - fx) * (y1 - fy))[:, None]
+    wb = ((fx - x0) * (y1 - fy))[:, None]
+    wc = ((x1 - fx) * (fy - y0))[:, None]
+    wd = ((fx - x0) * (fy - y0))[:, None]
+    if mode == "nearest":
+        return gather(jnp.round(fy), jnp.round(fx))
+    return (gather(y0, x0) * wa + gather(y0, x1) * wb +
+            gather(y1, x0) * wc + gather(y1, x1) * wd)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..ops.manipulation import pad as _pad_nd
+
+    return _pad_nd(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+@primitive("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    r = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([r[:, 1:, :fold], jnp.zeros_like(r[:, :1, :fold])], 1)
+    right = jnp.concatenate([jnp.zeros_like(r[:, :1, fold:2 * fold]),
+                             r[:, :-1, fold:2 * fold]], 1)
+    rest = r[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+@primitive("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@primitive("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = jnp.matmul(anchor, positive.T)
+    lbl = labels.reshape(-1, 1)
+    target = (lbl == lbl.T).astype(sim.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
+                    jnp.mean(jnp.sum(jnp.square(positive), 1))) * 0.25
+    return ce + reg
+
+
+@primitive("fused_bias_act")
+def fused_bias_act(x, bias=None, act="gelu"):
+    if bias is not None:
+        x = x + bias
+    if act == "gelu":
+        return jax.nn.gelu(x)
+    if act == "relu":
+        return jax.nn.relu(x)
+    return x
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    lengths_arr = unwrap(lengths)
+    if maxlen is None:
+        maxlen = int(np.asarray(lengths_arr).max())
+    return _sequence_mask(lengths, maxlen=int(maxlen),
+                          dtype=dtype_mod.convert_dtype(dtype))
+
+
+@primitive("sequence_mask")
+def _sequence_mask(lengths, maxlen, dtype):
+    steps = jnp.arange(maxlen)
+    return (steps[None, :] < lengths[..., None]).astype(dtype)
+
+
+@primitive("diag_embed")
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    n = input.shape[-1] + abs(offset)
+    out = jnp.zeros(input.shape[:-1] + (n, n), input.dtype)
+    idx = jnp.arange(input.shape[-1])
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    out = out.at[..., r, c].set(input)
+    return out

@@ -1,0 +1,358 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU.
+
+Parity with /root/reference/python/paddle/nn/layer/rnn.py (RNNCellBase :88,
+LSTMCell :258, GRUCell :399, RNN :522, SimpleRNN/LSTM/GRU :770+) and the
+fluid dynamic_rnn ops. The time loop is jax.lax.scan — a single compiled
+XLA while-loop (no per-step kernel launches like the reference CUDA path).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import primitive
+from ..framework.tensor import Tensor, unwrap
+from . import initializer as I
+from .layer import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ..ops.creation import full
+
+        b = unwrap(batch_ref).shape[batch_dim_idx]
+        shape = shape or (self.hidden_size,)
+        return full((b,) + tuple(shape), init_value,
+                    dtype=dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = _simple_rnn_cell(inputs, states, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh,
+                             act=self.activation)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+@primitive("simple_rnn_cell")
+def _simple_rnn_cell(x, h, w_ih, w_hh, b_ih, b_hh, act):
+    z = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        z = z + b_ih
+    if b_hh is not None:
+        z = z + b_hh
+    return jnp.tanh(z) if act == "tanh" else jax.nn.relu(z)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        h2, c2 = _lstm_cell(inputs, h, c, self.weight_ih, self.weight_hh,
+                            self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+@primitive("lstm_cell")
+def _lstm_cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    z = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        z = z + b_ih
+    if b_hh is not None:
+        z = z + b_hh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = _gru_cell(inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+@primitive("gru_cell")
+def _gru_cell(x, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x @ w_ih.T
+    gh = h @ w_hh.T
+    if b_ih is not None:
+        gi = gi + b_ih
+    if b_hh is not None:
+        gh = gh + b_hh
+    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    c = jnp.tanh(ic + r * hc)
+    return (1 - z) * c + z * h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference rnn.py:522)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs, states = _rnn_scan_layer(self.cell, inputs, initial_states,
+                                       sequence_length, self.is_reverse,
+                                       self.time_major)
+        return outs, states
+
+
+def _rnn_scan_layer(cell, inputs, initial_states, sequence_length, is_reverse,
+                    time_major):
+    """Run the cell over time with one traced scan (weights read from cell)."""
+    from ..framework import tape as tape_mod
+    from ..framework.op import primitive as _prim
+
+    is_lstm = isinstance(cell, LSTMCell)
+    x = inputs
+    if initial_states is None:
+        b = unwrap(x).shape[1 if time_major else 0]
+        hs = cell.hidden_size
+        from ..ops.creation import zeros
+
+        if is_lstm:
+            initial_states = (zeros([b, hs]), zeros([b, hs]))
+        else:
+            initial_states = zeros([b, hs])
+
+    w = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+
+    if is_lstm:
+        h0, c0 = initial_states
+
+        @_prim("lstm_scan")
+        def run(x, h0, c0, w_ih, w_hh, b_ih, b_hh, time_major, reverse, seq_len):
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)
+            if reverse:
+                xs = jnp.flip(xs, 0)
+
+            def step(carry, xt):
+                h, c = carry
+                h2, c2 = _lstm_cell.raw_fn(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+                return (h2, c2), h2
+
+            (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            if not time_major:
+                ys = jnp.swapaxes(ys, 0, 1)
+            return ys, hT, cT
+
+        ys, hT, cT = run(x, h0, c0, *w, time_major=time_major,
+                         reverse=is_reverse, seq_len=sequence_length)
+        return ys, (hT, cT)
+
+    h0 = initial_states
+    cell_fn = _gru_cell.raw_fn if isinstance(cell, GRUCell) else None
+    act = getattr(cell, "activation", "tanh")
+
+    @_prim("rnn_scan")
+    def run(x, h0, w_ih, w_hh, b_ih, b_hh, time_major, reverse, is_gru, act):
+        xs = x if time_major else jnp.swapaxes(x, 0, 1)
+        if reverse:
+            xs = jnp.flip(xs, 0)
+
+        def step(h, xt):
+            if is_gru:
+                h2 = _gru_cell.raw_fn(xt, h, w_ih, w_hh, b_ih, b_hh)
+            else:
+                h2 = _simple_rnn_cell.raw_fn(xt, h, w_ih, w_hh, b_ih, b_hh, act)
+            return h2, h2
+
+        hT, ys = jax.lax.scan(step, h0, xs)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        if not time_major:
+            ys = jnp.swapaxes(ys, 0, 1)
+        return ys, hT
+
+    ys, hT = run(x, h0, *w, time_major=time_major, reverse=is_reverse,
+                 is_gru=isinstance(cell, GRUCell), act=act)
+    return ys, hT
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import ops
+
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.bw(inputs, states_bw, sequence_length)
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        from .container import LayerList
+
+        kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        if activation is not None:
+            kw["activation"] = activation
+        self.rnns = LayerList()
+        for layer in range(num_layers):
+            isz = input_size if layer == 0 else hidden_size * num_dir
+            if self.bidirectional:
+                self.rnns.append(BiRNN(self.CELL(isz, hidden_size, **kw),
+                                       self.CELL(isz, hidden_size, **kw),
+                                       time_major=time_major))
+            else:
+                self.rnns.append(RNN(self.CELL(isz, hidden_size, **kw),
+                                     time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from . import functional as Fn
+
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.rnns):
+            out, st = rnn(out, None, sequence_length)
+            finals.append(st)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = Fn.dropout(out, p=self.dropout, training=self.training)
+        return out, _stack_states(finals, isinstance(self, LSTM),
+                                  self.bidirectional)
+
+
+def _stack_states(finals, is_lstm, bidirectional):
+    from .. import ops
+
+    if bidirectional:
+        flat = []
+        for st in finals:
+            flat.extend(st)
+        finals = flat
+    if is_lstm:
+        h = ops.stack([f[0] for f in finals], axis=0)
+        c = ops.stack([f[1] for f in finals], axis=0)
+        return (h, c)
+    return ops.stack(list(finals), axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

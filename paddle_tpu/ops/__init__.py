@@ -1,0 +1,10 @@
+"""Functional op library (TPU-native equivalent of the reference operator
+library, /root/reference/paddle/fluid/operators/ — see SURVEY.md §2.4)."""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, logic, linalg, search  # noqa: F401

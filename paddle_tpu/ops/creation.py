@@ -1,0 +1,219 @@
+"""Tensor creation ops.
+
+Parity with the reference creation ops (fill_constant, gaussian_random,
+uniform_random, range, eye, ... — /root/reference/paddle/fluid/operators/
+fill_constant_op.cc, gaussian_random_op.cc, uniform_random_op.cc) expressed
+as jnp builders; randomness draws from the framework PRNG (framework/random.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.op import primitive
+from ..framework.random import next_rng_key
+from ..framework.tensor import Tensor, unwrap
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return dtype_mod.get_default_dtype() if default_float else np.int64
+    return dtype_mod.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+fill_constant = full
+
+
+@primitive("zeros_like")
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype_mod.convert_dtype(dtype) if dtype else None)
+
+
+@primitive("ones_like")
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype_mod.convert_dtype(dtype) if dtype else None)
+
+
+@primitive("full_like")
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value,
+                         dtype=dtype_mod.convert_dtype(dtype) if dtype else None)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = dtype_mod.get_default_dtype()
+        else:
+            dtype = np.int64
+    return Tensor(jnp.arange(start, end, step, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+range_ = arange
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = unwrap(x)
+    if v.ndim == 1 and padding_value != 0:
+        n = v.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, v.dtype)
+        idx = jnp.arange(v.shape[0])
+        r = idx + max(0, -offset)
+        c = idx + max(0, offset)
+        return Tensor(out.at[r, c].set(v))
+    return Tensor(jnp.diag(v, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(unwrap(x), k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal=diagonal)
+
+
+@primitive("tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal=diagonal)
+
+
+@primitive("triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, name=None):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(g) for g in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+# -- random ----------------------------------------------------------------
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), min, max))
+
+
+uniform_random = uniform
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_rng_key(), _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        n = jax.random.normal(next_rng_key(), shp, dtype_mod.get_default_dtype())
+        return Tensor(m + s * n)
+    shp = _shape(shape if shape is not None else [1])
+    n = jax.random.normal(next_rng_key(), shp, dtype_mod.get_default_dtype())
+    return Tensor(mean + std * n)
+
+
+gaussian_random = normal
+gaussian = normal
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_rng_key(), _shape(shape), low, high,
+                                     dtype=_dt(dtype, default_float=False)))
+
+
+def randperm(n, dtype=None, name=None):
+    p = jax.random.permutation(next_rng_key(), n)
+    return Tensor(p.astype(_dt(dtype, default_float=False)))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def bernoulli(x, name=None):
+    p = unwrap(x)
+    return Tensor(jax.random.bernoulli(next_rng_key(), p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = unwrap(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_rng_key(), logits, axis=-1,
+                                     shape=(*p.shape[:-1], num_samples))
+    else:
+        key = next_rng_key()
+        z = jax.random.gumbel(key, p.shape)
+        _, out = jax.lax.top_k(logits + z, num_samples)
+    return Tensor(out.astype(np.int64))
+
+
+def assign(x, output=None):
+    v = _assign(x)
+    if output is not None:
+        output.set_value(v)
+        return output
+    return v
+
+
+@primitive("assign")
+def _assign(x):
+    return jnp.asarray(x) + 0  # copy
+
+
+def clone(x, name=None):
+    return assign(x)

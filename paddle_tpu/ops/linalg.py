@@ -1,0 +1,208 @@
+"""Linear algebra ops (reference operators/{matmul_op.cc, matmul_v2_op.cc,
+math/blas.h cuBLAS dispatch} and the linalg op family).
+
+matmul maps straight onto the MXU via XLA dot_general; bf16 accumulation in
+f32 is the default on TPU. No hand BLAS layer is needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.op import primitive
+
+
+@primitive("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+
+
+@primitive("bmm")
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@primitive("mv")
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@primitive("norm")
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis, keepdims=keepdim))
+    if p in (float("inf"), "inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    p = float(p)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+@primitive("p_norm")
+def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False):
+    return jnp.maximum(
+        jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim) ** (1.0 / porder),
+        epsilon)
+
+
+@primitive("dist")
+def dist(x, y, p=2, name=None):
+    d = x - y
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype)).astype(x.dtype)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@primitive("cholesky")
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@primitive("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    L = jnp.swapaxes(y, -1, -2) if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2), z, lower=False)
+
+
+@primitive("inverse")
+def inv(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+inverse = inv
+
+
+@primitive("pinv")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@primitive("det")
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@primitive("slogdet")
+def slogdet(x, name=None):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@primitive("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+@primitive("matrix_power")
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@primitive("qr")
+def qr(x, mode="reduced", name=None):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+@primitive("svd_op")
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+@primitive("eig")
+def eig(x, name=None):
+    # XLA TPU has no nonsymmetric eig; run via CPU callback shape-safely.
+    return tuple(jnp.linalg.eig(x))
+
+
+@primitive("eigh")
+def eigh(x, UPLO="L", name=None):
+    return tuple(jnp.linalg.eigh(x, UPLO=UPLO))
+
+
+@primitive("eigvalsh")
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@primitive("solve")
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@primitive("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@primitive("lstsq")
+def lstsq(x, y, rcond=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@primitive("lu")
+def lu(x, pivot=True, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32)
+
+
+@primitive("multi_dot")
+def multi_dot(xs, name=None):
+    return jnp.linalg.multi_dot(xs)
+
+
+@primitive("cross")
+def cross(x, y, axis=None, name=None):
+    if axis is None:
+        # first axis of size 3, paddle semantics
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@primitive("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@primitive("corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@primitive("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@primitive("einsum")
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@primitive("matrix_transpose")
+def matrix_transpose(x, name=None):
+    return jnp.swapaxes(x, -1, -2)

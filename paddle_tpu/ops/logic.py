@@ -1,0 +1,79 @@
+"""Comparison/logical ops (reference operators/controlflow/compare_op.cc,
+logical_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.op import primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+@primitive("equal")
+def equal(x, y, name=None):
+    return jnp.equal(x, y)
+
+
+@primitive("not_equal")
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, y)
+
+
+@primitive("less_than")
+def less_than(x, y, name=None):
+    return jnp.less(x, y)
+
+
+@primitive("less_equal")
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, y)
+
+
+@primitive("greater_than")
+def greater_than(x, y, name=None):
+    return jnp.greater(x, y)
+
+
+@primitive("greater_equal")
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, y)
+
+
+@primitive("logical_and")
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+@primitive("logical_or")
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+@primitive("logical_xor")
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+@primitive("logical_not")
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+@primitive("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    a, b = unwrap(x), unwrap(y)
+    if a.shape != b.shape:
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.all(a == b))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).size == 0))

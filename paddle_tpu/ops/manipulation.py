@@ -1,0 +1,450 @@
+"""Shape/layout manipulation ops.
+
+Parity with the reference reshape/transpose/concat/split/slice family
+(/root/reference/paddle/fluid/operators/{reshape_op,transpose_op,concat_op,
+split_op,slice_op,stack_op,squeeze_op,unsqueeze_op,...}.cc). All static
+shapes — dynamic-shape outputs (unique, nonzero, masked_select) return
+host-side results in eager mode and are excluded from jit paths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape(x, shape=_norm_shape(shape))
+
+
+@primitive("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape_(x, shape, name=None):
+    x._value = jnp.reshape(x._value, _norm_shape(shape))
+    return x
+
+
+@primitive("transpose")
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(x, axes=tuple(perm) if perm is not None else None)
+
+
+@primitive("flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, shape)
+
+
+@primitive("squeeze")
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@primitive("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    out = x
+    for a in sorted(int(v) for v in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def concat(x, axis=0, name=None):
+    return _concat(list(x), axis=int(unwrap(axis)))
+
+
+@primitive("concat")
+def _concat(tensors, axis=0):
+    return jnp.concatenate(tensors, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(list(x), axis=axis)
+
+
+@primitive("stack")
+def _stack(tensors, axis=0):
+    return jnp.stack(tensors, axis=axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else unwrap(x).shape[axis]
+    outs = _unstack(x, axis=axis, num=n)
+    return list(outs)
+
+
+@primitive("unstack")
+def _unstack(x, axis, num):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, num, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis))
+    if isinstance(num_or_sections, int):
+        return list(_split_even(x, num=num_or_sections, axis=axis))
+    sections = [int(unwrap(s)) for s in num_or_sections]
+    total = unwrap(x).shape[axis]
+    if any(s in (-1,) for s in sections):
+        known = sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return list(_split_sections(x, offsets=tuple(offsets), axis=axis))
+
+
+@primitive("split")
+def _split_even(x, num, axis):
+    return tuple(jnp.split(x, num, axis=axis))
+
+
+@primitive("split_sections")
+def _split_sections(x, offsets, axis):
+    return tuple(jnp.split(x, list(offsets), axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis=axis)
+
+
+@primitive("tile")
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+expand = None  # defined below
+
+
+@primitive("expand")
+def _expand(x, shape):
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s in (-1, None) and i >= len(shape) - x.ndim else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):  # noqa: F811
+    return _expand(x, shape=_norm_shape(shape))
+
+
+def expand_as(x, y, name=None):
+    return _expand(x, shape=unwrap(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return _expand(x, shape=_norm_shape(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = [unwrap(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrays])
+    return [_expand(t, shape=shape) for t in inputs]
+
+
+@primitive("slice_op")
+def slice(x, axes, starts, ends, name=None):
+    out = x
+    for ax, st, en in zip(axes, starts, ends):
+        n = out.shape[ax]
+        st = int(st)
+        en = int(en)
+        st = n + st if st < 0 else st
+        en = n + en if en < 0 else builtins_min(en, n)
+        out = jax.lax.slice_in_dim(out, st, en, axis=ax)
+    return out
+
+
+def builtins_min(a, b):
+    return a if a < b else b
+
+
+@primitive("strided_slice")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[int(st):int(en):int(sd)]
+    return x[tuple(idx)]
+
+
+@primitive("getitem")
+def getitem(x, idx):
+    if isinstance(idx, tuple):
+        idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    elif isinstance(idx, Tensor):
+        idx = idx._value
+    return x[idx]
+
+
+@primitive("gather")
+def gather(x, index, axis=0, name=None):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+
+@primitive("gather_nd")
+def gather_nd(x, index, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@primitive("take_along_axis")
+def take_along_axis(arr, indices, axis, name=None):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@primitive("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    dims = jnp.ogrid[tuple(jnp.s_[0:s] for s in indices.shape)]
+    dims = [jnp.asarray(d) for d in dims]
+    dims[axis] = indices
+    at = arr.at[tuple(dims)]
+    if reduce == "assign":
+        return at.set(values)
+    if reduce == "add":
+        return at.add(values)
+    if reduce == "multiply":
+        return at.multiply(values)
+    raise ValueError(f"Unknown reduce mode {reduce}")
+
+
+@primitive("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@primitive("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    base = zeros(shape, dtype=unwrap(updates).dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+@primitive("index_select")
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, index, axis=axis)
+
+
+@primitive("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@primitive("roll")
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@primitive("flip")
+def flip(x, axis, name=None):
+    return jnp.flip(x, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(x, k=k, axes=tuple(axes))
+
+
+@primitive("rot90")
+def _rot90(x, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@primitive("pad_nd")
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):
+    if len(pad) == 2 * x.ndim:
+        cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(x.ndim)]
+    else:
+        # paddle semantics: pad pairs apply last-spatial-dim-first
+        # (pad_left, pad_right, pad_top, pad_bottom, ...) — reference
+        # nn/functional/common.py pad; spatial dims depend on data_format.
+        n_spatial = len(pad) // 2
+        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1]))
+                 for i in range(n_spatial)]
+        channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+        cfg = [(0, 0)] * x.ndim
+        if channel_last:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        else:
+            spatial_dims = list(range(x.ndim - n_spatial, x.ndim))
+        for i, dim in enumerate(reversed(spatial_dims)):
+            cfg[dim] = pairs[i]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode=jmode, constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@primitive("shard_index")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """operators/shard_index_op.cc parity."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)
+
+
+@primitive("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@primitive("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@primitive("swapaxes")
+def swapaxes(x, axis1, axis2, name=None):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@primitive("as_real")
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@primitive("as_complex")
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@primitive("real")
+def real(x, name=None):
+    return jnp.real(x)
+
+
+@primitive("imag")
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+# -- dynamic-shape ops: host-side eager only -------------------------------
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype=np.int64, name=None):
+    arr = np.asarray(unwrap(x))
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    arr = np.asarray(unwrap(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(n.reshape(-1, 1)) for n in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    arr = np.asarray(unwrap(x))
+    m = np.asarray(unwrap(mask))
+    return Tensor(arr[m])
+
+
+@primitive("masked_fill")
+def masked_fill(x, mask, value, name=None):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@primitive("where")
+def where(condition, x=None, y=None, name=None):
+    return jnp.where(condition, x, y)
+
+
+@primitive("select_scatter")
+def select_scatter(x, values, axis, index, name=None):
+    idx = [jnp.s_[:]] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@primitive("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference operators/math/im2col.cc) as XLA patch extraction."""
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = [kernel_sizes, kernel_sizes]
+    if isinstance(strides, int):
+        strides = [strides, strides]
+    if isinstance(paddings, int):
+        paddings = [paddings] * 4
+    elif len(paddings) == 2:
+        paddings = [paddings[0], paddings[1], paddings[0], paddings[1]]
+    if isinstance(dilations, int):
+        dilations = [dilations, dilations]
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    x = jnp.pad(x, [(0, 0), (0, 0), (paddings[0], paddings[2]),
+                    (paddings[1], paddings[3])])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(strides), "VALID",
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: (N, C*kh*kw, OH, OW) -> (N, C*kh*kw, OH*OW)
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return _tensordot(x, y, axes=axes)
+
+
+@primitive("tensordot")
+def _tensordot(x, y, axes):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@primitive("crop")
+def crop(x, shape=None, offsets=None, name=None):
+    offsets = offsets or [0] * x.ndim
+    slices = tuple(jnp.s_[int(o):int(o) + int(s)]
+                   for o, s in zip(offsets, shape))
+    return x[slices]

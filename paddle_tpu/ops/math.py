@@ -1,0 +1,354 @@
+"""Elementwise + reduction math ops.
+
+Parity with the reference elementwise/, activation_op.cc, reduce_ops/ and
+the scalar math ops (/root/reference/paddle/fluid/operators/elementwise/*,
+activation_op.cc, reduce_ops/reduce_*.cc): each op is one jnp expression;
+XLA fuses chains of them into single kernels, so there is no fused-op zoo.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.op import primitive
+from ..framework.tensor import Tensor, unwrap
+
+_mod = sys.modules[__name__]
+
+# -- generated unary ops ---------------------------------------------------
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt, "abs": jnp.abs, "ceil": jnp.ceil,
+    "floor": jnp.floor, "round": jnp.round, "trunc": jnp.trunc,
+    "cos": jnp.cos, "sin": jnp.sin, "tan": jnp.tan, "acos": jnp.arccos,
+    "asin": jnp.arcsin, "atan": jnp.arctan, "cosh": jnp.cosh,
+    "sinh": jnp.sinh, "tanh": jnp.tanh, "acosh": jnp.arccosh,
+    "asinh": jnp.arcsinh, "atanh": jnp.arctanh, "reciprocal": jnp.reciprocal,
+    "square": jnp.square, "sign": jnp.sign, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv, "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma, "neg": jnp.negative,
+    "conj": jnp.conj, "angle": jnp.angle, "frac": lambda x: x - jnp.trunc(x),
+    "sigmoid": jax.nn.sigmoid, "i0": lambda x: jax.scipy.special.i0(x),
+}
+for _name, _fn in _UNARY.items():
+    setattr(_mod, _name, primitive(_name)(
+        (lambda f: (lambda x, name=None: f(x)))(_fn)))
+
+# -- generated binary (broadcasting) ops -----------------------------------
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.remainder, "pow": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum, "fmax": jnp.fmax,
+    "fmin": jnp.fmin, "atan2": jnp.arctan2, "hypot": jnp.hypot,
+    "logaddexp": jnp.logaddexp, "heaviside": jnp.heaviside,
+    "copysign": jnp.copysign, "nextafter": jnp.nextafter,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+}
+for _name, _fn in _BINARY.items():
+    setattr(_mod, _name, primitive(_name)(
+        (lambda f: (lambda x, y, name=None: f(x, y)))(_fn)))
+
+# paddle legacy aliases
+elementwise_add = _mod.add
+elementwise_sub = _mod.subtract
+elementwise_mul = _mod.multiply
+elementwise_div = _mod.divide
+elementwise_pow = _mod.pow
+elementwise_max = _mod.maximum
+elementwise_min = _mod.minimum
+elementwise_mod = _mod.mod
+floor_mod = _mod.mod
+
+
+@primitive("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """Reference scale_op.cc semantics."""
+    scale = jnp.asarray(scale, x.dtype) if not isinstance(scale, jax.Array) else scale
+    if bias_after_scale:
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return out
+
+
+@primitive("clip")
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+@primitive("lerp")
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+@primitive("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@primitive("logit")
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@primitive("log_sigmoid")
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@primitive("isnan")
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+@primitive("isinf")
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+@primitive("isfinite")
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+@primitive("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@primitive("cast")
+def cast(x, dtype):
+    return x.astype(dtype_mod.convert_dtype(dtype))
+
+
+# -- reductions (reference reduce_ops/) ------------------------------------
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@primitive("reduce_sum")
+def sum(x, axis=None, keepdim=False, dtype=None, name=None):
+    if dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dtype = np.int64
+    return jnp.sum(x, axis=_axis(axis), keepdims=keepdim,
+                   dtype=dtype_mod.convert_dtype(dtype) if dtype else None)
+
+
+@primitive("reduce_mean")
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("reduce_max")
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("reduce_min")
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("reduce_prod")
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim,
+                    dtype=dtype_mod.convert_dtype(dtype) if dtype else None)
+
+
+@primitive("reduce_any")
+def any(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("reduce_all")
+def all(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("logsumexp")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("nansum")
+def nansum(x, axis=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("nanmean")
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@primitive("var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@primitive("median")
+def median(x, axis=None, keepdim=False, name=None):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("quantile")
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis,
+                      dtype=dtype_mod.convert_dtype(dtype) if dtype else None)
+
+
+@primitive("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim,
+                       dtype=dtype_mod.convert_dtype(dtype) if dtype else None)
+
+
+@primitive("cummax")
+def _cummax_raw(x, axis):
+    return jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+
+
+def cummax(x, axis=None, name=None):
+    if axis is None:
+        from . import manipulation
+
+        x = manipulation.reshape(x, [-1])
+        axis = 0
+    return _cummax_raw(x, axis=axis)
+
+
+@primitive("cummin")
+def _cummin_raw(x, axis):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+def cummin(x, axis=None, name=None):
+    if axis is None:
+        from . import manipulation
+
+        x = manipulation.reshape(x, [-1])
+        axis = 0
+    return _cummin_raw(x, axis=axis)
+
+
+@primitive("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("amax")
+def amax(x, axis=None, keepdim=False, name=None):
+    return jnp.amax(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("amin")
+def amin(x, axis=None, keepdim=False, name=None):
+    return jnp.amin(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("diff")
+def diff(x, n=1, axis=-1, name=None):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@primitive("trace_op")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive("kron")
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@primitive("inner")
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@primitive("outer")
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+@primitive("dot_op")
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@primitive("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * (x @ y)
+
+
+# -- bitwise ---------------------------------------------------------------
+@primitive("bitwise_and")
+def bitwise_and(x, y, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+@primitive("bitwise_or")
+def bitwise_or(x, y, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+@primitive("bitwise_xor")
+def bitwise_xor(x, y, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+@primitive("bitwise_not")
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+@primitive("shift_left")
+def shift_left(x, y, name=None):
+    return jnp.left_shift(x, y)
+
+
+@primitive("shift_right")
+def shift_right(x, y, name=None):
+    return jnp.right_shift(x, y)
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._value + jnp.asarray(value, x.dtype)
+    return x
+
+
+def accuracy_op(pred, label, k=1):
+    """operators/metrics/accuracy_op.cc parity."""
+    p, l = unwrap(pred), unwrap(label)
+    topk = jnp.argsort(-p, axis=-1)[..., :k]
+    correct = jnp.any(topk == l.reshape(-1, 1), axis=-1)
+    return Tensor(jnp.mean(correct.astype(jnp.float32)))

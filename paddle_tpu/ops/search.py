@@ -1,0 +1,125 @@
+"""Search/sort ops (reference operators/{arg_min_max_op_base.h, top_k_op.cc,
+argsort_op.cc, index ops}).
+
+top_k uses jax.lax.top_k which XLA lowers to a TPU-native partial sort.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import primitive
+from ..framework.tensor import Tensor, unwrap
+
+
+@primitive("arg_max")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(np.dtype(dtype))
+
+
+@primitive("arg_min")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(np.dtype(dtype))
+
+
+@primitive("argsort")
+def argsort(x, axis=-1, descending=False, name=None):
+    out = jnp.argsort(-x if descending else x, axis=axis, stable=True)
+    return out.astype(np.int64)
+
+
+@primitive("sort")
+def sort(x, axis=-1, descending=False, name=None):
+    out = jnp.sort(x, axis=axis, stable=True)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+def top_k(x, k=1, axis=None, largest=True, sorted=True, name=None):
+    return topk(x, k=k, axis=axis, largest=largest, sorted=sorted)
+
+
+@primitive("top_k")
+def topk(x, k=1, axis=None, largest=True, sorted=True, name=None):
+    if axis is None:
+        axis = -1
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx.astype(np.int64), -1, axis))
+
+
+@primitive("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+        flat_val = values.reshape(-1, values.shape[-1])
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            flat_seq, flat_val).reshape(values.shape)
+    return out.astype(np.int32 if out_int32 else np.int64)
+
+
+@primitive("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    axis = axis % x.ndim
+    sorted_x = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis, stable=True)
+    vals = jnp.take(sorted_x, k - 1, axis=axis)
+    inds = jnp.take(idx, k - 1, axis=axis).astype(np.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        inds = jnp.expand_dims(inds, axis)
+    return vals, inds
+
+
+@primitive("mode")
+def mode(x, axis=-1, keepdim=False, name=None):
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    sorted_x = jnp.sort(moved, axis=-1)
+    n = sorted_x.shape[-1]
+    runs = jnp.concatenate(
+        [jnp.ones(sorted_x.shape[:-1] + (1,), bool),
+         sorted_x[..., 1:] != sorted_x[..., :-1]], axis=-1)
+    run_id = jnp.cumsum(runs, axis=-1)
+    counts = jax.vmap(
+        lambda rid: jnp.bincount(rid.reshape(-1), length=n + 1)
+    )(run_id.reshape(-1, n)).reshape(run_id.shape[:-1] + (n + 1,))
+    per_elem_count = jnp.take_along_axis(counts, run_id, axis=-1)
+    best = jnp.argmax(per_elem_count, axis=-1)
+    vals = jnp.take_along_axis(sorted_x, best[..., None], axis=-1)[..., 0]
+    idx_sorted = jnp.argsort(moved, axis=-1, stable=True)
+    pos = jnp.take_along_axis(idx_sorted, best[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals = jnp.expand_dims(vals, -1)
+        pos = jnp.expand_dims(pos, -1)
+        vals = jnp.moveaxis(vals, -1, axis)
+        pos = jnp.moveaxis(pos, -1, axis)
+    return vals, pos.astype(np.int64)
+
+
+@primitive("bincount")
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@primitive("histogram")
+def histogram(input, bins=100, min=0, max=0, name=None):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(input, bins=bins, range=(lo, hi))
+    return hist

@@ -1,0 +1,10 @@
+"""paddle_tpu.optimizer (reference python/paddle/fluid/optimizer.py +
+paddle/optimizer)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, DecayedAdagrad,
+    Adadelta, RMSProp, Ftrl, Lamb, LarsMomentum, Dpsgd,
+)
+from .meta import (  # noqa: F401
+    ModelAverage, EMA, LookAhead, GradientMergeOptimizer, RecomputeOptimizer,
+)

@@ -1,0 +1,175 @@
+"""Meta-optimizers: wrappers that change the update schedule.
+
+Parity with the reference optimizer.py meta family (ModelAverage :3102,
+EMA :3411, PipelineOptimizer :3661, RecomputeOptimizer :4513, Lookahead
+:4822, GradientMergeOptimizer :4988). Pipeline lives in
+paddle_tpu.parallel.pipeline; recompute maps onto jax.checkpoint.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .optimizer import Optimizer
+
+
+class GradientMergeOptimizer:
+    """Accumulate grads for k_steps micro-batches, then apply once
+    (reference optimizer.py:4988)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+        self._acc = {}
+        self._count = 0
+
+    def step(self):
+        params = self.inner._params()
+        self._count += 1
+        for p in params:
+            if p.grad is None:
+                continue
+            if id(p) in self._acc:
+                self._acc[id(p)] = self._acc[id(p)] + p.grad.value
+            else:
+                self._acc[id(p)] = p.grad.value
+        if self._count < self.k_steps:
+            for p in params:
+                p.clear_grad()
+            return False
+        for p in params:
+            if id(p) in self._acc:
+                g = self._acc[id(p)]
+                if self.avg:
+                    g = g / self.k_steps
+                p.grad = Tensor(g)
+        self.inner.step()
+        self._acc.clear()
+        self._count = 0
+        return True
+
+    def minimize(self, loss, **kw):
+        if loss._node is not None:
+            loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+class RecomputeOptimizer:
+    """API parity with reference optimizer.py:4513. On TPU the actual
+    rematerialisation is jax.checkpoint applied to forward segments (see
+    paddle_tpu.distributed.fleet recompute strategy); eagerly this wrapper
+    is a pass-through."""
+
+    def __init__(self, optimizer):
+        self.inner = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def step(self):
+        self.inner.step()
+
+    def minimize(self, loss, **kw):
+        return self.inner.minimize(loss, **kw)
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+class LookAhead(Optimizer):
+    """lookahead: slow/fast weights (reference optimizer.py:4822)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._n = 0
+
+    def _params(self):
+        return self.inner._params()
+
+    def step(self):
+        self.inner.step()
+        self._n += 1
+        if self._n % self.k == 0:
+            for p in self.inner._params():
+                if id(p) not in self._slow:
+                    self._slow[id(p)] = p.value
+                slow = self._slow[id(p)] + self.alpha * (p.value - self._slow[id(p)])
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def minimize(self, loss, **kw):
+        if loss._node is not None:
+            loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+
+class EMA:
+    """Exponential moving average of params (reference optimizer.py:3411)."""
+
+    def __init__(self, decay=0.999, thres_steps=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+        self._params = []
+
+    def register(self, parameters):
+        self._params = list(parameters)
+        for p in self._params:
+            self._ema[id(p)] = p.value
+
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            if id(p) not in self._ema:
+                self._ema[id(p)] = p.value
+            else:
+                self._ema[id(p)] = d * self._ema[id(p)] + (1 - d) * p.value
+
+    def apply(self, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p.value
+            p._value = self._ema[id(p)]
+
+    def restore(self):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+class ModelAverage(EMA):
+    """Running average of params (reference optimizer.py:3102) — on TPU the
+    same mechanism as EMA with uniform averaging."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000000):
+        super().__init__(decay=0.0)
+        self._sum = {}
+        self._count = 0
+
+    def update(self):
+        self._count += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum.get(id(p), 0) + p.value
+            self._ema[id(p)] = self._sum[id(p)] / self._count

@@ -1,0 +1,9 @@
+"""SPMD parallelism over TPU meshes (replaces the reference ParallelExecutor
++ transpiler + fleet meta-optimizer machinery — SURVEY.md §2.6)."""
+from .mesh import (  # noqa: F401
+    create_mesh, get_mesh, set_mesh, replicated, data_sharding, axis_size,
+    AXES,
+)
+from .sharding import (  # noqa: F401
+    shard_params, place_params, spec_for, TRANSFORMER_TP_RULES,
+)

@@ -1,0 +1,71 @@
+"""Device mesh management.
+
+TPU-native replacement for the reference's NCCL ring/communicator registry
+(/root/reference/paddle/fluid/platform/collective_helper.h:62
+NCCLCommContext keyed by ring_id, nccl_helper.h:234 InitFlatCtxs /
+:265 InitHierarchicalCtxs): instead of rings, a named jax.sharding.Mesh
+whose axes ('dp','pp','tp','sp','ep') are what collectives address.
+Hierarchical inter/intra-node rings become mesh factorizations with the
+DCN axis outermost.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_global_mesh: list = [None]
+
+AXES = ("dp", "pp", "tp", "sp", "ep")
+
+
+def create_mesh(mesh_shape: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """create_mesh({'dp': 2, 'tp': 4}) over local (or given) devices.
+
+    Axes with size 1 may be omitted; remaining devices fold into 'dp'.
+    DCN-reaching axes should be listed first (outermost) so XLA keeps
+    high-traffic collectives on ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    mesh_shape = dict(mesh_shape or {})
+    sized = {k: v for k, v in mesh_shape.items() if v and v > 1}
+    total = int(np.prod(list(sized.values()))) if sized else 1
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+    if total < len(devices):
+        if "dp" in sized:
+            sized["dp"] *= len(devices) // total
+        else:
+            sized = {"dp": len(devices) // total, **sized}
+    names = tuple(sized.keys())
+    shape = tuple(sized.values())
+    arr = np.asarray(devices[: int(np.prod(shape))]).reshape(shape)
+    mesh = Mesh(arr, names)
+    _global_mesh[0] = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _global_mesh[0]
+
+
+def set_mesh(mesh: Mesh):
+    _global_mesh[0] = mesh
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def data_sharding(mesh: Mesh, batch_ndim: int = 1) -> NamedSharding:
+    """Shard leading (batch) dim over every data-like axis present."""
+    axes = [a for a in ("dp",) if a in mesh.axis_names]
+    spec = [tuple(axes) if axes else None] + [None] * (batch_ndim - 1)
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.axis_names else 1

@@ -1,0 +1,63 @@
+"""Parameter sharding rules.
+
+TPU-native replacement for the reference multi-device graph builders
+(/root/reference/paddle/fluid/framework/ir/multi_devices_graph_pass/
+multi_devices_graph_pass.h AllReduce/Reduce/Dist builders): instead of
+cloning the graph per device and inserting comm op-handles, parameters get
+PartitionSpecs (regex rules over parameter names, t5x-style) and the XLA
+SPMD partitioner inserts the collectives.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Rules = List[Tuple[str, PartitionSpec]]
+
+# Default tensor-parallel rules for the transformer layer stack
+# (megatron-style: column-parallel qkv/ffn-in, row-parallel out/ffn-out).
+TRANSFORMER_TP_RULES: Rules = [
+    (r".*(q_proj|k_proj|v_proj)\.weight$", PartitionSpec(None, "tp")),
+    (r".*(q_proj|k_proj|v_proj)\.bias$", PartitionSpec("tp")),
+    (r".*out_proj\.weight$", PartitionSpec("tp", None)),
+    (r".*linear1\.weight$", PartitionSpec(None, "tp")),
+    (r".*linear1\.bias$", PartitionSpec("tp")),
+    (r".*linear2\.weight$", PartitionSpec("tp", None)),
+    (r".*(word_)?embedding.*\.weight$", PartitionSpec("tp", None)),
+]
+
+
+def spec_for(name: str, rules: Optional[Rules], mesh: Mesh) -> PartitionSpec:
+    if rules:
+        for pattern, spec in rules:
+            if re.match(pattern, name):
+                cleaned = tuple(
+                    ax if ax is not None and ax in mesh.axis_names else None
+                    for ax in spec)
+                return PartitionSpec(*cleaned)
+    return PartitionSpec()
+
+
+def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
+                 rules: Optional[Rules] = None) -> Dict[str, NamedSharding]:
+    """name->array dict to name->NamedSharding (replicated by default)."""
+    out = {}
+    for name, arr in params.items():
+        spec = spec_for(name, rules, mesh)
+        # drop specs that do not divide the dim evenly
+        fixed = []
+        for i, ax in enumerate(spec):
+            if ax is None or i >= arr.ndim:
+                fixed.append(None)
+                continue
+            size = mesh.shape[ax] if isinstance(ax, str) else 1
+            fixed.append(ax if arr.shape[i] % max(size, 1) == 0 else None)
+        out[name] = NamedSharding(mesh, PartitionSpec(*fixed[: arr.ndim]))
+    return out
+
+
+def place_params(params: Dict[str, jax.Array], shardings) -> Dict[str, jax.Array]:
+    return {n: jax.device_put(a, shardings[n]) for n, a in params.items()}

@@ -1,0 +1,5 @@
+"""Vision: transforms + synthetic/file datasets (reference
+python/paddle/incubate/hapi/datasets + vision ops)."""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401

@@ -1,0 +1,70 @@
+"""Datasets (reference incubate/hapi/datasets/mnist.py etc.).
+
+Zero-egress environment: MNIST/Cifar load from local files when present and
+otherwise fall back to a deterministic synthetic sample with the same
+shapes/labels, so model tests and benchmarks run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 synthetic_size=2048):
+        self.transform = transform
+        self.mode = mode
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8)
+        else:
+            n = synthetic_size if mode == "train" else synthetic_size // 4
+            # class base patterns are shared across train/test; only the
+            # noise and label draw differ per mode
+            base = np.random.RandomState(123).rand(10, 28, 28).astype(np.float32)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            noise = rng.rand(n, 28, 28).astype(np.float32) * 0.4
+            self.images = (base[self.labels] * 255 * 0.6 +
+                           noise * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FakeImageNet(Dataset):
+    """Synthetic ImageNet-shaped dataset for ResNet benchmarks."""
+
+    def __init__(self, size=1024, image_shape=(3, 224, 224), num_classes=1000,
+                 seed=0):
+        self.size = size
+        self.shape = image_shape
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.shape).astype(np.float32)
+        label = np.asarray(rng.randint(0, self.num_classes), np.int64)
+        return img, label
+
+    def __len__(self):
+        return self.size

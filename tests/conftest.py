@@ -1,0 +1,29 @@
+"""Test config: force the CPU backend with a virtual 8-device mesh
+(SURVEY.md §4 — multi-host logic tests via
+xla_force_host_platform_device_count). Must override, not setdefault:
+the environment pins JAX_PLATFORMS=axon (real TPU) by default."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The environment registers a remote-TPU PJRT plugin (axon) at interpreter
+# boot; when its tunnel is down, *any* backend init — including cpu —
+# blocks on it. Tests are CPU-only by design, so drop the factory before
+# the first backends() call.
+try:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    for _name in ("axon",):
+        _xb._backend_factories.pop(_name, None)
+    # pytest plugins (jaxtyping) import jax before this conftest runs, so
+    # the env var alone is too late — update the live config too.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+

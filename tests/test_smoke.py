@@ -1,0 +1,91 @@
+"""End-to-end smoke: imports, eager autograd, Linear regression learns."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == (2, 2)
+    assert t.dtype == np.float32
+    out = (t + 1) * 2
+    np.testing.assert_allclose(out.numpy(), [[4, 6], [8, 10]])
+    assert float(t.sum()) == 10.0
+
+
+def test_eager_autograd_chain():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x + x).sum()  # dy/dx = 2x + 1
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0], rtol=1e-6)
+
+
+def test_grad_api():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), 27.0, rtol=1e-6)
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_linear_learns():
+    paddle.seed(0)
+    w_true = np.array([[2.0], [-3.0]], np.float32)
+    xs = np.random.RandomState(0).randn(128, 2).astype(np.float32)
+    ys = xs @ w_true + 0.5
+
+    model = nn.Linear(2, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    loss_fn = nn.MSELoss()
+    losses = []
+    for i in range(60):
+        pred = model(paddle.to_tensor(xs))
+        loss = loss_fn(pred, paddle.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < 0.01
+    np.testing.assert_allclose(model.weight.numpy(), w_true, atol=0.05)
+    np.testing.assert_allclose(model.bias.numpy(), [0.5], atol=0.05)
+
+
+def test_train_step_jit_matches_eager():
+    paddle.seed(1)
+    xs = np.random.RandomState(1).randn(64, 4).astype(np.float32)
+    ys = (xs.sum(1, keepdims=True) > 0).astype(np.float32)
+
+    def build():
+        paddle.seed(42)
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        o = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+        return m, o
+
+    # eager path
+    m1, o1 = build()
+    bce = nn.BCEWithLogitsLoss()
+    for _ in range(5):
+        loss = bce(m1(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+    eager_w = m1[0].weight.numpy()
+
+    # jit path
+    from paddle_tpu.jit import TrainStep
+
+    m2, o2 = build()
+    step = TrainStep(m2, lambda model, x, y: bce(model(x), y), o2)
+    for _ in range(5):
+        step(paddle.to_tensor(xs), paddle.to_tensor(ys))
+    np.testing.assert_allclose(m2[0].weight.numpy(), eager_w, atol=1e-4)
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
