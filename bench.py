@@ -1,0 +1,78 @@
+"""Benchmark: BERT-base pretraining throughput on one chip (BASELINE.md
+config 3 — "BERT-base pretraining, tokens/sec/chip").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is 1.0 by convention — the reference publishes no numbers
+(BASELINE.md: "None"), so the recorded value IS the baseline going forward.
+
+Env knobs: BENCH_LAYERS/BENCH_BATCH/BENCH_SEQ/BENCH_STEPS for smoke runs
+(e.g. BENCH_SMOKE=1 runs a tiny config on CPU).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    layers = int(os.environ.get("BENCH_LAYERS", 2 if smoke else 12))
+    batch = int(os.environ.get("BENCH_BATCH", 2 if smoke else 16))
+    seq = int(os.environ.get("BENCH_SEQ", 64 if smoke else 128))
+    steps = int(os.environ.get("BENCH_STEPS", 3 if smoke else 20))
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    paddle.seed(0)
+    if smoke:
+        cfg = BertConfig.tiny()
+        cfg.num_hidden_layers = layers
+    else:
+        cfg = BertConfig.base()
+        cfg.num_hidden_layers = layers
+    model = BertForPretraining(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids, tt, mlm, nsp):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            return m.loss(ids, tt, mlm, nsp)
+
+    step = TrainStep(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    tt = paddle.to_tensor(np.zeros((batch, seq), np.int32))
+    mlm = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    nsp = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype(np.int32))
+
+    # warmup / compile
+    loss = step(ids, tt, mlm, nsp)
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _i in range(steps):
+        loss = step(ids, tt, mlm, nsp)
+    _ = float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

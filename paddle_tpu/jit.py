@@ -142,7 +142,8 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  seed: int = 0, donate: bool = True, mesh=None,
-                 in_shardings=None):
+                 param_rules=None, data_axes=("dp", "data"),
+                 data_spec=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -151,7 +152,52 @@ class TrainStep:
         self._seed = seed
         self._compiled = None
         self._mesh = mesh
-        self._in_shardings = in_shardings
+        self._param_rules = param_rules
+        self._data_axes = data_axes
+        self._data_spec = data_spec  # explicit PartitionSpec for batch leaves
+        self._placed = False
+
+    def _place_spmd(self, params, buffers, batch_arrays):
+        """First-call SPMD placement: params per TP rules (replicated over
+        dp), batch sharded on the data axes. XLA's partitioner then inserts
+        the gradient psum/collectives (replaces the reference's
+        multi_devices_graph_pass + allreduce op handles)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .parallel.sharding import shard_params
+
+        mesh = self._mesh
+        if not self._placed:
+            pshard = shard_params(params, mesh, self._param_rules)
+            for n in params:
+                params[n] = jax.device_put(params[n], pshard[n])
+            rep = NamedSharding(mesh, PartitionSpec())
+            for n in buffers:
+                buffers[n] = jax.device_put(buffers[n], rep)
+            if self._opt_state is not None:
+                slots = self._opt_state["slots"]
+                for n in slots:
+                    slots[n] = _tree.tree_map(
+                        lambda a, nn=n: jax.device_put(a, pshard[nn]), slots[n])
+            self._placed = True
+        axes = tuple(a for a in self._data_axes if a in mesh.axis_names)
+        if axes or self._data_spec is not None:
+            def shard_batch(a):
+                nd = getattr(a, "ndim", 0)
+                if nd < 1:
+                    return a
+                if self._data_spec is not None:
+                    cleaned = tuple(
+                        ax if ax is None or ax in mesh.axis_names else None
+                        for ax in self._data_spec[:nd])
+                    spec = PartitionSpec(*cleaned)
+                else:
+                    spec = PartitionSpec(axes if len(axes) > 1 else axes[0])
+                return jax.device_put(a, NamedSharding(mesh, spec))
+
+            batch_arrays = tuple(
+                _tree.tree_map(shard_batch, b) for b in batch_arrays)
+        return params, buffers, batch_arrays
 
     def _build(self):
         fmodel = self.fmodel
@@ -159,15 +205,12 @@ class TrainStep:
         optimizer = self.optimizer
         model = self.model
 
-        def pure_step(params, buffers, opt_state, lr, step_idx, batch):
+        def pure_step(params, buffers, opt_state, lr, batch):
+            step_idx = opt_state["step"]
+
             def loss_of(params):
                 key = jax.random.fold_in(jax.random.key(self._seed), step_idx)
-
-                def call_model(*args, **kwargs):
-                    # loss_fn sees the live layer with traced params
-                    return None
-
-                saved_p = {n: p._value for n, p in model.named_parameters()}
+                saved_p ={n: p._value for n, p in model.named_parameters()}
                 saved_b = {n: b._value for n, b in model.named_buffers()}
                 model.load_param_pytree(params)
                 model.load_buffer_pytree(buffers)
@@ -207,25 +250,23 @@ class TrainStep:
         if self._compiled is None:
             self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        step_idx = jnp.asarray(int(self._opt_state["step"]), jnp.int32) \
-            if not isinstance(self._opt_state["step"], jax.Array) \
-            else self._opt_state["step"]
         batch_arrays = tuple(
             _tree.tree_map(_unwrap_out, b,
                            is_leaf=lambda x: isinstance(x, Tensor))
             for b in batch)
+        if self._mesh is not None:
+            params, buffers, batch_arrays = self._place_spmd(
+                params, buffers, batch_arrays)
         loss, aux, new_params, new_buffers, new_opt_state = self._compiled(
-            params, buffers, self._opt_state, lr, step_idx, batch_arrays)
+            params, buffers, self._opt_state, lr, batch_arrays)
         for n, p in model.named_parameters():
             if n in new_params:
                 p._value = new_params[n]
         model.load_buffer_pytree(new_buffers)
         self._opt_state = new_opt_state
-        self.optimizer._step_count = int(new_opt_state["step"])
-        if isinstance(self.optimizer._learning_rate, object) and hasattr(
-                self.optimizer._learning_rate, "step") and callable(
-                getattr(self.optimizer._learning_rate, "step", None)):
-            pass  # user drives scheduler.step() explicitly, matching paddle
+        # host-side counter: no device sync per step (async dispatch stays
+        # ahead of the chip; the device-side step lives in opt_state)
+        self.optimizer._step_count += 1
         if aux:
             return (Tensor(loss),) + tuple(_tree.tree_map(_wrap_in, a) for a in aux)
         return Tensor(loss)

@@ -1,0 +1,9 @@
+"""Flagship model families (reference test-suite models: LeNet/ResNet in
+paddle_tpu.vision.models; BERT/ERNIE, Transformer NMT, DeepFM/Wide&Deep
+here — SURVEY.md §4 dist_transformer.py / dist_ctr.py parity)."""
+from .bert import BertConfig, BertModel, BertForPretraining  # noqa: F401
+from .transformer import TransformerNMT  # noqa: F401
+from .ctr import DeepFM, WideDeep  # noqa: F401
+from ..vision.models import (  # noqa: F401
+    LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+)

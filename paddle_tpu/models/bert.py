@@ -1,0 +1,121 @@
+"""BERT (the flagship benchmark model — BASELINE.md "BERT-base pretraining").
+
+Behavioral parity with the reference ERNIE/BERT stack built from
+fluid.layers (multi-head attention via stacked fc + matmul ops; reference
+fused path: /root/reference/paddle/fluid/operators/fused/
+multihead_matmul_op.cu). TPU-native design: bf16-friendly shapes
+(hidden/heads multiples of 128), attention through the Pallas flash kernel,
+whole-model jit, TP shardings from parallel.sharding.TRANSFORMER_TP_RULES.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .. import nn
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30592  # multiple of 128 for clean TP sharding (239*128)
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=128,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=256, max_position_embeddings=128)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from .. import ops
+
+        seq_len = input_ids.shape[1]
+        pos = ops.arange(0, seq_len, 1, dtype="int32")
+        emb = self.word_embeddings(input_ids)
+        emb = emb + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig = None):
+        super().__init__()
+        cfg = cfg or BertConfig()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            normalize_before=False)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pooler_act = nn.Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        emb = self.embeddings(input_ids, token_type_ids)
+        seq = self.encoder(emb, attention_mask)
+        pooled = self.pooler_act(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (matching the reference pretraining objective)."""
+
+    def __init__(self, cfg: BertConfig = None):
+        super().__init__()
+        cfg = cfg or BertConfig()
+        self.config = cfg
+        self.bert = BertModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.mlm_bias = self.create_parameter([cfg.vocab_size], is_bias=True)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        from .. import ops
+        from ..nn import functional as F
+
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        # tied decoder: share word embedding weights
+        logits = ops.matmul(h, self.bert.embeddings.word_embeddings.weight,
+                            transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return logits, nsp_logits
+
+    def loss(self, input_ids, token_type_ids, mlm_labels, nsp_labels,
+             attention_mask=None, ignore_index=-100):
+        from ..nn import functional as F
+
+        logits, nsp_logits = self(input_ids, token_type_ids, attention_mask)
+        mlm = F.cross_entropy(logits, mlm_labels, ignore_index=ignore_index)
+        nsp = F.cross_entropy(nsp_logits, nsp_labels)
+        return mlm + nsp

@@ -1,0 +1,101 @@
+"""CTR models: DeepFM and Wide&Deep (reference dist_ctr.py /
+dist_fleet_ctr.py test models; the sparse side of BASELINE config 5).
+
+TPU-native sparse design: fixed-slot dense gathers into embedding tables
+(no dynamic-shape SelectedRows) — every slot contributes exactly one id per
+example (MultiSlot padding upstream), so lookups are static-shape
+jnp.take that XLA vectorizes; the huge-vocab path goes through
+paddle_tpu.ps (host-RAM sharded tables).
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class DeepFM(nn.Layer):
+    def __init__(self, num_fields=26, vocab_sizes=None, embed_dim=16,
+                 dense_dim=13, hidden_units=(400, 400, 400)):
+        super().__init__()
+        vocab_sizes = vocab_sizes or [100000] * num_fields
+        self.num_fields = num_fields
+        self.embed_dim = embed_dim
+        # one embedding table per field (reference: per-slot lookup_table)
+        self.embeddings = nn.LayerList(
+            [nn.Embedding(v, embed_dim) for v in vocab_sizes])
+        self.linear_embeds = nn.LayerList(
+            [nn.Embedding(v, 1) for v in vocab_sizes])
+        self.dense_linear = nn.Linear(dense_dim, 1)
+        self.dense_embed = nn.Linear(dense_dim, embed_dim)
+        dnn_in = (num_fields + 1) * embed_dim
+        layers = []
+        prev = dnn_in
+        for h in hidden_units:
+            layers += [nn.Linear(prev, h), nn.ReLU()]
+            prev = h
+        layers.append(nn.Linear(prev, 1))
+        self.dnn = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_feats):
+        """sparse_ids: (B, num_fields) int; dense_feats: (B, dense_dim)."""
+        from .. import ops
+
+        # first-order
+        lin = self.dense_linear(dense_feats)
+        for i, emb in enumerate(self.linear_embeds):
+            lin = lin + emb(sparse_ids[:, i])
+        # second-order FM over field embeddings + dense projection
+        fields = [emb(sparse_ids[:, i])
+                  for i, emb in enumerate(self.embeddings)]
+        fields.append(self.dense_embed(dense_feats))
+        stacked = ops.stack(fields, axis=1)  # (B, F+1, D)
+        sum_sq = ops.square(ops.sum(stacked, axis=1))
+        sq_sum = ops.sum(ops.square(stacked), axis=1)
+        fm = 0.5 * ops.sum(sum_sq - sq_sum, axis=1, keepdim=True)
+        # deep part
+        flat = ops.reshape(stacked, [stacked.shape[0], -1])
+        deep = self.dnn(flat)
+        return lin + fm + deep
+
+    def loss(self, sparse_ids, dense_feats, labels):
+        from ..nn import functional as F
+
+        logits = self(sparse_ids, dense_feats)
+        return F.binary_cross_entropy_with_logits(
+            logits, labels.reshape(logits.shape).astype(logits.dtype))
+
+
+class WideDeep(nn.Layer):
+    def __init__(self, num_fields=26, vocab_sizes=None, embed_dim=16,
+                 dense_dim=13, hidden_units=(256, 128, 64)):
+        super().__init__()
+        vocab_sizes = vocab_sizes or [100000] * num_fields
+        self.wide_embeds = nn.LayerList(
+            [nn.Embedding(v, 1) for v in vocab_sizes])
+        self.wide_dense = nn.Linear(dense_dim, 1)
+        self.deep_embeds = nn.LayerList(
+            [nn.Embedding(v, embed_dim) for v in vocab_sizes])
+        prev = num_fields * embed_dim + dense_dim
+        layers = []
+        for h in hidden_units:
+            layers += [nn.Linear(prev, h), nn.ReLU()]
+            prev = h
+        layers.append(nn.Linear(prev, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_feats):
+        from .. import ops
+
+        wide = self.wide_dense(dense_feats)
+        for i, emb in enumerate(self.wide_embeds):
+            wide = wide + emb(sparse_ids[:, i])
+        deep_in = ops.concat(
+            [emb(sparse_ids[:, i]) for i, emb in enumerate(self.deep_embeds)]
+            + [dense_feats], axis=1)
+        return wide + self.deep(deep_in)
+
+    def loss(self, sparse_ids, dense_feats, labels):
+        from ..nn import functional as F
+
+        logits = self(sparse_ids, dense_feats)
+        return F.binary_cross_entropy_with_logits(
+            logits, labels.reshape(logits.shape).astype(logits.dtype))

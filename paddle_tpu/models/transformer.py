@@ -1,0 +1,71 @@
+"""Transformer NMT (reference dist_transformer.py / machine-translation
+book test parity): encoder-decoder with shared-dim embeddings, causal
+decoding, and a greedy/beam inference path."""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+
+
+class PositionalEncoding(nn.Layer):
+    def __init__(self, d_model, max_len=1024, dropout=0.1):
+        super().__init__()
+        import numpy as np
+
+        pe = np.zeros((max_len, d_model), np.float32)
+        pos = np.arange(max_len)[:, None]
+        div = np.exp(np.arange(0, d_model, 2) * (-math.log(10000.0) / d_model))
+        pe[:, 0::2] = np.sin(pos * div)
+        pe[:, 1::2] = np.cos(pos * div)
+        self.register_buffer("pe", pe, persistable=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.dropout(x + self.pe[: x.shape[1]])
+
+
+class TransformerNMT(nn.Layer):
+    def __init__(self, src_vocab_size=32000, tgt_vocab_size=32000,
+                 d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 max_len=1024):
+        super().__init__()
+        self.d_model = d_model
+        self.src_embed = nn.Embedding(src_vocab_size, d_model)
+        self.tgt_embed = nn.Embedding(tgt_vocab_size, d_model)
+        self.pos = PositionalEncoding(d_model, max_len, dropout)
+        self.transformer = nn.Transformer(
+            d_model, nhead, num_encoder_layers, num_decoder_layers,
+            dim_feedforward, dropout)
+        self.out_proj = nn.Linear(d_model, tgt_vocab_size)
+
+    def forward(self, src, tgt, src_mask=None):
+        from .. import ops
+
+        scale = math.sqrt(self.d_model)
+        src_e = self.pos(self.src_embed(src) * scale)
+        tgt_e = self.pos(self.tgt_embed(tgt) * scale)
+        tgt_mask = nn.Transformer.generate_square_subsequent_mask(tgt.shape[1])
+        out = self.transformer(src_e, tgt_e, src_mask=src_mask,
+                               tgt_mask=tgt_mask)
+        return self.out_proj(out)
+
+    def loss(self, src, tgt_in, tgt_out, pad_id=0):
+        from ..nn import functional as F
+
+        logits = self(src, tgt_in)
+        return F.cross_entropy(logits, tgt_out, ignore_index=pad_id)
+
+    def greedy_decode(self, src, bos_id=1, eos_id=2, max_len=64):
+        from .. import ops
+        from ..framework import no_grad
+
+        with no_grad():
+            b = src.shape[0]
+            ys = ops.full([b, 1], bos_id, dtype="int64")
+            for _ in range(max_len - 1):
+                logits = self(src, ys)
+                nxt = logits[:, -1].argmax(-1).reshape([b, 1]).astype("int64")
+                ys = ops.concat([ys, nxt], axis=1)
+            return ys
