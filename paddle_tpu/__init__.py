@@ -33,6 +33,9 @@ from . import jit  # noqa: F401
 from . import autograd  # noqa: F401
 from .autograd import grad  # noqa: F401
 from . import vision  # noqa: F401
+from . import utils  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401
 from .framework.tape import no_grad as no_grad  # noqa: F401
 
 

@@ -1,0 +1,19 @@
+"""hapi — high-level Keras-like training API.
+
+Capability parity with the reference high-level API
+(/root/reference/python/paddle/incubate/hapi/: model.py Model.fit/
+evaluate/predict, callbacks.py, distributed.py DistributedBatchSampler),
+re-designed TPU-first: train/eval batches run through one jit-compiled
+functional step instead of per-op dygraph dispatch.
+"""
+from .callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, LRSchedulerCallback,
+    ModelCheckpoint, ProgBarLogger,
+)
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401
+
+__all__ = [
+    "Model", "summary", "Callback", "CallbackList", "ProgBarLogger",
+    "ModelCheckpoint", "EarlyStopping", "LRSchedulerCallback",
+]

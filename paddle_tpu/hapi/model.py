@@ -1,0 +1,354 @@
+"""High-level Model API (reference incubate/hapi/model.py: Model.prepare/
+fit/evaluate/predict/save/load, train_batch/eval_batch/test_batch).
+
+TPU-first: train batches run through a single fused jit step
+(paddle_tpu.jit.TrainStep — forward+backward+update in one XLA program);
+eval/predict run through a jit-compiled functional forward. Distributed
+data parallelism comes from passing a mesh (params replicated, batch
+sharded over 'dp') instead of the reference's per-process NCCL DataParallel.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..io.dataloader import DataLoader, Dataset
+from ..jit import TrainStep, _FunctionalModel
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+class Input:
+    """Input spec (reference hapi.Input / static.InputSpec parity)."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"Input(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _np_scalar(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+class Model:
+    """Network wrapper with Keras-style train/eval/predict loops.
+
+    Usage:
+        model = hapi.Model(network)
+        model.prepare(optimizer, loss, metrics)
+        model.fit(train_dataset, eval_dataset, epochs=2, batch_size=64)
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_compiled = None
+        self._pred_compiled = None
+        self._mesh = None
+        self._param_rules = None
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, mesh=None, param_rules=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _to_list(metrics)
+        for m in ms:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle_tpu Metric")
+        self._metrics = ms
+        self._mesh = mesh
+        self._param_rules = param_rules
+        self._amp_configs = amp_configs
+        # a new optimizer/loss/mesh invalidates previously compiled steps
+        self._train_step = None
+        self._eval_compiled = None
+        self._pred_compiled = None
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    # ------------------------------------------------------- batch methods
+    def _split_batch(self, inputs, labels):
+        ins = _to_list(inputs)
+        labs = _to_list(labels)
+        if not labs and self._loss is not None and len(ins) > 1:
+            # convention: dataset yields (*inputs, label)
+            n_lab = max(1, len(self._labels)) if self._labels else 1
+            labs = ins[-n_lab:]
+            ins = ins[:-n_lab]
+        return ins, labs
+
+    def _compute_loss(self, preds, labels):
+        preds_l = preds if isinstance(preds, (list, tuple)) else [preds]
+        if self._loss is None:
+            return preds_l[0]
+        return self._loss(*preds_l, *labels)
+
+    def train_batch(self, inputs, labels=None):
+        """One fused forward+backward+update step. Returns
+        (loss_numpy, metric_results) like the reference when metrics are
+        set, else loss_numpy."""
+        if self._optimizer is None:
+            raise RuntimeError("call prepare(optimizer, loss) before fit")
+        self.network.train()
+        ins, labs = self._split_batch(inputs, labels)
+
+        if self._train_step is None:
+            n_in = len(ins)
+
+            def loss_fn(m, *batch):
+                xs, ys = batch[:n_in], batch[n_in:]
+                preds = m(*xs)
+                loss = self._compute_loss(preds, ys)
+                preds_t = preds if isinstance(preds, (tuple, list)) else (preds,)
+                return (loss,) + tuple(preds_t)
+
+            self._train_step = TrainStep(
+                self.network, loss_fn, self._optimizer, mesh=self._mesh,
+                param_rules=self._param_rules)
+
+        out = self._train_step(*(list(ins) + list(labs)))
+        if isinstance(out, tuple):
+            loss, preds = out[0], out[1:]
+        else:
+            loss, preds = out, ()
+        metrics = self._update_metrics(preds, labs)
+        loss_np = _np_scalar(loss)
+        return (loss_np, metrics) if self._metrics else loss_np
+
+    def _build_eval(self):
+        fmodel = _FunctionalModel(self.network)
+        compute_loss = self._compute_loss
+
+        def pure_eval(params, buffers, ins, labs):
+            preds, _ = fmodel(params, buffers, tuple(ins), {})
+            preds_t = preds if isinstance(preds, (tuple, list)) else (preds,)
+            labs_t = tuple(Tensor(l) if isinstance(l, jax.Array) else l
+                           for l in labs)
+            loss = compute_loss(
+                tuple(Tensor(p) if isinstance(p, jax.Array) else p
+                      for p in preds_t), labs_t)
+            loss = loss.value if isinstance(loss, Tensor) else loss
+            return loss, tuple(
+                p.value if isinstance(p, Tensor) else p for p in preds_t)
+
+        return jax.jit(pure_eval)
+
+    def _build_predict(self):
+        fmodel = _FunctionalModel(self.network)
+
+        def pure_pred(params, buffers, ins):
+            preds, _ = fmodel(params, buffers, tuple(ins), {})
+            preds_t = preds if isinstance(preds, (tuple, list)) else (preds,)
+            return tuple(p.value if isinstance(p, Tensor) else p
+                         for p in preds_t)
+
+        return jax.jit(pure_pred)
+
+    def _arrays(self, xs):
+        out = []
+        for x in xs:
+            if isinstance(x, Tensor):
+                out.append(x.value)
+            else:
+                out.append(np.asarray(x))
+        return tuple(out)
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins, labs = self._split_batch(inputs, labels)
+        if self._eval_compiled is None:
+            self._eval_compiled = self._build_eval()
+        params = self.network.param_pytree()
+        buffers = self.network.buffer_pytree()
+        loss, preds = self._eval_compiled(
+            params, buffers, self._arrays(ins), self._arrays(labs))
+        metrics = self._update_metrics(preds, labs)
+        loss_np = np.asarray(loss)
+        return (loss_np, metrics) if self._metrics else loss_np
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = _to_list(inputs)
+        if self._pred_compiled is None:
+            self._pred_compiled = self._build_predict()
+        params = self.network.param_pytree()
+        buffers = self.network.buffer_pytree()
+        preds = self._pred_compiled(params, buffers, self._arrays(ins))
+        out = [np.asarray(p) for p in preds]
+        return out if len(out) > 1 else out[0]
+
+    test_batch = predict_batch  # reference name
+
+    def _update_metrics(self, preds, labels):
+        results = []
+        preds = tuple(preds)
+        for m in self._metrics:
+            pred0 = preds[0] if preds else None
+            lab0 = labels[0] if labels else None
+            pv = Tensor(pred0) if isinstance(pred0, jax.Array) else pred0
+            lv = Tensor(np.asarray(lab0.numpy() if isinstance(lab0, Tensor)
+                                   else lab0)) if lab0 is not None else None
+            state = m.compute(pv, lv)
+            if isinstance(state, (tuple, list)):
+                m.update(*[_np_scalar(s) for s in state])
+            else:
+                m.update(_np_scalar(state))
+            results.append(m.accumulate())
+        return results
+
+    # --------------------------------------------------------------- loops
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        self._save_dir = save_dir
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=["loss"] + [m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, logs)
+                out = self.train_batch(batch)
+                logs = self._logs(out)
+                cbks.on_batch_end("train", step, logs)
+                if self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks,
+                              num_workers=num_workers)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        own_cbks = callbacks is None
+        cbks = callbacks if not own_cbks else config_callbacks(
+            None, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=["loss"] + [m.name() for m in self._metrics])
+        for m in self._metrics:
+            m.reset()
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks.on_begin("eval", {"steps": steps})
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_batch_begin("eval", step, logs)
+            out = self.eval_batch(batch)
+            logs = self._logs(out)
+            cbks.on_batch_end("eval", step, logs)
+        cbks.on_end("eval", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=0):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins = _to_list(batch)
+            if self._labels:
+                ins = ins[: len(ins) - len(self._labels)] or ins
+            preds = self.predict_batch(ins)
+            outputs.append(preds)
+        if stack_outputs and outputs:
+            if isinstance(outputs[0], list):
+                outputs = [np.concatenate([o[i] for o in outputs])
+                           for i in range(len(outputs[0]))]
+            else:
+                outputs = np.concatenate(outputs)
+        return outputs
+
+    def _logs(self, out):
+        if isinstance(out, tuple):
+            loss, metrics = out
+            logs = {"loss": np.asarray(loss).ravel().tolist()}
+            for m, r in zip(self._metrics, metrics):
+                logs[m.name()] = r
+            return logs
+        return {"loss": np.asarray(out).ravel().tolist()}
+
+    # ----------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..io.serialization import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..io.serialization import load as _load
+
+        state = _load(path + ".pdparams")
+        if skip_mismatch:
+            own = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in own and tuple(np.shape(v)) == tuple(own[k].shape)}
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        return self
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtype)

@@ -1,0 +1,73 @@
+"""paddle.summary — layer-by-layer parameter/output table
+(reference hapi/model_summary.py capability)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _num_params(layer, include_sublayers=False):
+    ps = layer.parameters(include_sublayers=include_sublayers)
+    return int(sum(int(np.prod(p.shape)) for p in ps))
+
+
+def summary(net: Layer, input_size=None, dtypes=None):
+    """Prints a per-layer table; returns {'total_params', 'trainable_params'}.
+
+    If input_size is given (tuple or list of tuples), runs a forward pass
+    with zeros to record per-layer output shapes via forward hooks.
+    """
+    rows = []
+    hooks = []
+
+    def mk_hook(name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            shape = tuple(out.shape) if hasattr(out, "shape") else None
+            rows.append((name, type(layer).__name__, shape,
+                         _num_params(layer, include_sublayers=False)))
+        return hook
+
+    shapes_known = input_size is not None
+    if shapes_known:
+        for name, sub in net.named_sublayers():
+            hooks.append(sub.register_forward_post_hook(mk_hook(name)))
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        args = []
+        for s, dt in zip(sizes, dts):
+            s = tuple(1 if d is None or d == -1 else d for d in s)
+            args.append(Tensor(np.zeros(s, dtype=np.dtype(dt or "float32"))))
+        was_training = net.training
+        net.eval()
+        try:
+            net(*args)
+        finally:
+            if was_training:
+                net.train()
+            for h in hooks:
+                h.remove()
+    else:
+        for name, sub in net.named_sublayers():
+            rows.append((name, type(sub).__name__, None,
+                         _num_params(sub, include_sublayers=False)))
+
+    header = f"{'Layer (type)':<40}{'Output Shape':<24}{'Param #':>12}"
+    line = "-" * len(header)
+    print(line)
+    print(header)
+    print(line)
+    for name, tname, shape, n in rows:
+        print(f"{name + ' (' + tname + ')':<40}"
+              f"{str(shape) if shape else '-':<24}{n:>12,}")
+    print(line)
+    total = _num_params(net, include_sublayers=True)
+    trainable = int(sum(int(np.prod(p.shape))
+                        for p in net.parameters() if p.trainable))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -310,10 +310,15 @@ class DataLoader:
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(sentinel)
-                except queue.Full:
-                    pass
+                # blocking put: a full queue must not swallow the sentinel
+                # (the consumer would hang on q.get() forever); stays
+                # abandonment-aware like the item puts above
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()

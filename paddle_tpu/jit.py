@@ -200,7 +200,6 @@ class TrainStep:
         return params, buffers, batch_arrays
 
     def _build(self):
-        fmodel = self.fmodel
         loss_fn = self.loss_fn
         optimizer = self.optimizer
         model = self.model
@@ -246,7 +245,9 @@ class TrainStep:
                   if p.trainable}
         buffers = model.buffer_pytree()
         if self._opt_state is None:
-            self._opt_state = self.optimizer.init_state(params)
+            self._opt_state = self.optimizer.init_state(
+                params, {n: p for n, p in model.named_parameters()
+                         if p.trainable})
         if self._compiled is None:
             self._build()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -262,6 +263,10 @@ class TrainStep:
         for n, p in model.named_parameters():
             if n in new_params:
                 p._value = new_params[n]
+            # mirror device-side slots into the optimizer's eager store so
+            # optimizer.state_dict() (Model.save) sees trained moments
+            if n in new_opt_state["slots"]:
+                self.optimizer._slots[id(p)] = new_opt_state["slots"][n]
         model.load_buffer_pytree(new_buffers)
         self._opt_state = new_opt_state
         # host-side counter: no device sync per step (async dispatch stays

@@ -58,14 +58,32 @@ class TransformerNMT(nn.Layer):
         return F.cross_entropy(logits, tgt_out, ignore_index=pad_id)
 
     def greedy_decode(self, src, bos_id=1, eos_id=2, max_len=64):
+        import numpy as np
+
         from .. import ops
         from ..framework import no_grad
+        from ..framework.tensor import to_tensor
 
-        with no_grad():
-            b = src.shape[0]
-            ys = ops.full([b, 1], bos_id, dtype="int64")
-            for _ in range(max_len - 1):
-                logits = self(src, ys)
-                nxt = logits[:, -1].argmax(-1).reshape([b, 1]).astype("int64")
-                ys = ops.concat([ys, nxt], axis=1)
-            return ys
+        was_training = self.training
+        self.eval()
+        try:
+            with no_grad():
+                b = src.shape[0]
+                ys = ops.full([b, 1], bos_id, dtype="int64")
+                finished = np.zeros(b, bool)
+                for _ in range(max_len - 1):
+                    logits = self(src, ys)
+                    nxt = logits[:, -1].argmax(-1).reshape([b, 1]).astype("int64")
+                    # freeze sequences that already emitted eos
+                    nxt_np = np.array(nxt.numpy()).reshape(b)
+                    nxt_np[finished] = eos_id
+                    finished |= nxt_np == eos_id
+                    ys = ops.concat(
+                        [ys, to_tensor(nxt_np.reshape(b, 1).astype("int64"))],
+                        axis=1)
+                    if finished.all():
+                        break
+                return ys
+        finally:
+            if was_training:
+                self.train()

@@ -45,10 +45,29 @@ class Optimizer:
         self._jit_update = None
 
     # -- functional API ------------------------------------------------------
-    def init_state(self, params):
-        """params: pytree of arrays -> state pytree (slots + step)."""
-        slots = _tmap(lambda p: self.init_slot(p), params)
-        return {"slots": slots, "step": jnp.zeros((), jnp.int32)}
+    def init_state(self, params, param_objs=None):
+        """params: pytree of arrays -> state pytree (slots + step).
+
+        If `param_objs` (name -> Parameter, matching the keys of a dict
+        `params`) is given, slots restored via set_state_dict seed the
+        state instead of zeros, so checkpoint-resume keeps optimizer
+        moments when training through jit.TrainStep."""
+        if param_objs and isinstance(params, dict):
+            slots = {}
+            for n, p in params.items():
+                base = self.init_slot(p)
+                restored = (self._slots.get(id(param_objs[n]))
+                            if n in param_objs else None)
+                if restored:
+                    for k, v in restored.items():
+                        if k in base:
+                            base[k] = jnp.asarray(
+                                v, getattr(base[k], "dtype", None))
+                slots[n] = base
+        else:
+            slots = _tmap(lambda p: self.init_slot(p), params)
+        return {"slots": slots,
+                "step": jnp.asarray(self._step_count, jnp.int32)}
 
     def apply_gradients_fn(self, grads, params, state, lr=None):
         """Pure update: returns (new_params, new_state). Used inside jit."""

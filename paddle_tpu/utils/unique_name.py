@@ -1,0 +1,33 @@
+"""Unique-name generation (reference python/paddle/fluid/unique_name.py:
+generate / guard / switch). Layer and Parameter auto-names come from this
+counter pool; `guard()` scopes the counters so models re-created inside a
+fresh guard get identical names — which is what makes optimizer state
+dicts (keyed by parameter name) portable across Model instances.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn import layer as _layer_mod
+
+
+def generate(key: str) -> str:
+    return _layer_mod._unique_name(key)
+
+
+def switch(new_counters=None):
+    """Replace the counter pool; returns the previous one."""
+    old = dict(_layer_mod._name_counters)
+    _layer_mod._name_counters.clear()
+    if new_counters:
+        _layer_mod._name_counters.update(new_counters)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch({})
+    try:
+        yield
+    finally:
+        switch(old)

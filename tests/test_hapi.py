@@ -1,0 +1,140 @@
+"""hapi Model.fit/evaluate/predict tests (reference test model:
+incubate/hapi tests — train a tiny classifier, assert loss decreases and
+accuracy is computed)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import hapi, metric, nn, optimizer
+from paddle_tpu.io.dataloader import Dataset
+
+
+class ToyDataset(Dataset):
+    def __init__(self, n=64, d=8, classes=4, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, d).astype("float32")
+        w = rng.randn(d, classes).astype("float32")
+        self.y = np.argmax(self.x @ w, axis=1).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def make_model():
+    paddle.seed(0)
+    # fresh name guard: re-created models get identical parameter names,
+    # which is what makes saved optimizer state (keyed by name) portable
+    with paddle.utils.unique_name.guard():
+        net = nn.Sequential(
+            nn.Linear(8, 32),
+            nn.ReLU() if hasattr(nn, "ReLU") else nn.Identity(),
+            nn.Linear(32, 4))
+    return hapi.Model(net)
+
+
+def test_fit_decreases_loss(capsys):
+    model = make_model()
+    model.prepare(
+        optimizer.Adam(learning_rate=0.05, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        metrics=metric.Accuracy())
+    ds = ToyDataset()
+    first = model.train_batch([ds.x[:16], ds.y[:16]])
+    model.fit(ds, epochs=3, batch_size=16, verbose=0)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert float(np.ravel(logs["loss"])[0]) < float(np.ravel(first[0])[0])
+    assert logs["acc"] > 0.5
+
+
+def test_predict_shapes():
+    model = make_model()
+    model.prepare(None, None)
+    ds = ToyDataset(n=20)
+    outs = model.predict([(ds.x[i * 5:(i + 1) * 5],) for i in range(4)],
+                         stack_outputs=True)
+    assert np.asarray(outs).shape == (20, 4)
+
+
+def test_save_load(tmp_path):
+    model = make_model()
+    model.prepare(
+        optimizer.Adam(learning_rate=0.05, parameters=model.parameters()),
+        nn.CrossEntropyLoss())
+    ds = ToyDataset(n=32)
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+
+    model2 = make_model()
+    model2.prepare(
+        optimizer.Adam(learning_rate=0.05, parameters=model2.parameters()),
+        nn.CrossEntropyLoss())
+    model2.load(path)
+    x = ds.x[:8]
+    np.testing.assert_allclose(
+        np.asarray(model.predict_batch([x])),
+        np.asarray(model2.predict_batch([x])), rtol=1e-5, atol=1e-5)
+
+
+def test_early_stopping_and_callbacks():
+    model = make_model()
+    model.prepare(
+        optimizer.Adam(learning_rate=0.05, parameters=model.parameters()),
+        nn.CrossEntropyLoss(), metrics=metric.Accuracy())
+    ds = ToyDataset(n=32)
+    seen = []
+
+    class Rec(hapi.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            seen.append(epoch)
+
+    es = hapi.EarlyStopping(monitor="acc", patience=0, save_best_model=False)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+              callbacks=[Rec(), es])
+    # with patience 0 it stops as soon as acc fails to improve
+    assert len(seen) < 10
+
+
+def test_summary(capsys):
+    net = nn.Sequential(nn.Linear(8, 32), nn.Linear(32, 4))
+    info = paddle.summary(net, (1, 8))
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+
+
+def test_resume_keeps_optimizer_state(tmp_path):
+    """Model.load must restore Adam moments into the fused TrainStep
+    (regression: init_state used to zero slots, silently resetting the
+    optimizer on resume)."""
+    ds = ToyDataset(n=32)
+    model = make_model()
+    model.prepare(
+        optimizer.Adam(learning_rate=0.05, parameters=model.parameters()),
+        nn.CrossEntropyLoss())
+    model.fit(ds, epochs=2, batch_size=16, verbose=0)
+    path = str(tmp_path / "m")
+    model.save(path)
+    opt_state = paddle.load(path + ".pdopt")
+    moments = [v for k, v in opt_state.items() if "moment" in k]
+    assert moments and any(np.abs(np.asarray(m)).max() > 0 for m in moments)
+
+    model2 = make_model()
+    model2.prepare(
+        optimizer.Adam(learning_rate=0.05, parameters=model2.parameters()),
+        nn.CrossEntropyLoss())
+    model2.load(path)
+    # seed TrainStep state and check it picked up the restored moments
+    model2.train_batch([ds.x[:16], ds.y[:16]])
+    slots = model2._train_step.opt_state["slots"]
+    restored = {k: v for k, v in opt_state.items() if "moment1" in k}
+    name0 = next(iter(restored))
+    pname = name0.split("@", 1)[0]
+    sname = [n for n, p in model2.network.named_parameters()
+             if p.name == pname][0]
+    # after one extra step the moment must still carry history (beta1=0.9
+    # keeps >=90% of the restored value): nonzero and not freshly zeroed
+    m1 = np.asarray(slots[sname]["moment1"])
+    assert np.abs(m1).max() > 0
+    assert int(model2._train_step.opt_state["step"]) >= 3
