@@ -10,7 +10,7 @@ from .callbacks import (  # noqa: F401
     Callback, CallbackList, EarlyStopping, LRSchedulerCallback,
     ModelCheckpoint, ProgBarLogger,
 )
-from .model import Model  # noqa: F401
+from .model import Input, Model  # noqa: F401
 from .summary import summary  # noqa: F401
 
 __all__ = [

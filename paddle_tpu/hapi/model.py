@@ -224,20 +224,22 @@ class Model:
         return results
 
     # --------------------------------------------------------------- loops
-    def _make_loader(self, data, batch_size, shuffle, num_workers):
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last=False):
         if data is None:
             return None
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              num_workers=num_workers)
+                              num_workers=num_workers, drop_last=drop_last)
         return data  # any iterable of batches
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
-        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,
                                         num_workers)
         self._save_dir = save_dir
@@ -251,6 +253,7 @@ class Model:
             save_dir=save_dir, metrics=["loss"] + [m.name() for m in self._metrics])
         self.stop_training = False
         cbks.on_begin("train")
+        logs = {}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -288,24 +291,51 @@ class Model:
             steps = None
         cbks.on_begin("eval", {"steps": steps})
         logs = {}
+        loss_sum, n_sum = 0.0, 0
         for step, batch in enumerate(loader):
             cbks.on_batch_begin("eval", step, logs)
             out = self.eval_batch(batch)
             logs = self._logs(out)
+            # sample-weighted mean loss across the whole set (the reference
+            # hapi averages before logging; the last batch may be ragged)
+            n = self._batch_len(batch)
+            loss_sum += float(np.mean(logs["loss"])) * n
+            n_sum += n
             cbks.on_batch_end("eval", step, logs)
+        if n_sum:
+            logs["loss"] = [loss_sum / n_sum]
         cbks.on_end("eval", logs)
         return logs
+
+    @staticmethod
+    def _batch_len(batch):
+        arrs = _to_list(batch)
+        try:
+            return int(np.shape(arrs[0])[0])
+        except (IndexError, TypeError):
+            return 1
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, callbacks=None, verbose=0):
         loader = self._make_loader(test_data, batch_size, False, num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = callbacks if callbacks is not None and hasattr(
+            callbacks, "on_begin") else config_callbacks(
+            callbacks, model=self, steps=steps, verbose=verbose, metrics=[])
+        cbks.on_begin("predict", {"steps": steps})
         outputs = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            cbks.on_batch_begin("predict", step, {})
             ins = _to_list(batch)
             if self._labels:
                 ins = ins[: len(ins) - len(self._labels)] or ins
             preds = self.predict_batch(ins)
             outputs.append(preds)
+            cbks.on_batch_end("predict", step, {})
+        cbks.on_end("predict", {})
         if stack_outputs and outputs:
             if isinstance(outputs[0], list):
                 outputs = [np.concatenate([o[i] for o in outputs])
@@ -346,6 +376,9 @@ class Model:
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + ".pdopt"):
             self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        # a live TrainStep caches params + opt state on device; drop it so
+        # the next train_batch rebuilds from the restored checkpoint
+        self._train_step = None
         return self
 
     def summary(self, input_size=None, dtype=None):

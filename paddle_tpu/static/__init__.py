@@ -1,0 +1,44 @@
+"""paddle_tpu.static — declarative (static-graph) mode.
+
+Feature parity with the reference's Fluid core (Program/Block/Op IR,
+Executor, append_backward, layers, save/load) re-designed for TPU: the
+Program is a thin serializable IR that lowers to ONE jit-compiled XLA
+program per (feed-signature, fetch-list); see ir.py / executor.py /
+backward.py docstrings for the design mapping.
+
+Typical use (reference book tests, e.g.
+/root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py):
+
+    import paddle_tpu.static as static
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 784])
+        label = static.data("label", [-1, 1], dtype="int64")
+        h = static.nn.fc(x, 128, act="relu")
+        logits = static.nn.fc(h, 10)
+        loss = static.mean(
+            static.softmax_with_cross_entropy(logits, label))
+        static.Adam(1e-3).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": ..., "label": ...},
+                   fetch_list=[loss])
+"""
+from . import initializer  # noqa: F401
+from .backward import append_backward, calc_gradient  # noqa: F401
+from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
+                       ExecutionStrategy)
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .io import (load_inference_model, load_params,  # noqa: F401
+                 load_persistables, save_inference_model, save_params,
+                 save_persistables)
+from .ir import (Block, OpDesc, Program, VarDesc, Variable,  # noqa: F401
+                 default_main_program, default_startup_program,
+                 program_guard)
+from .layers import *  # noqa: F401,F403
+from .layers import data  # noqa: F401
+from .optimizer import (SGD, Adam, AdamOptimizer, Lamb,  # noqa: F401
+                        LambOptimizer, Momentum, MomentumOptimizer,
+                        Optimizer, SGDOptimizer)
+
+from . import layers as nn  # noqa: F401  (static.nn.fc style access)

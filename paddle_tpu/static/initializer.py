@@ -1,0 +1,118 @@
+"""Initializer specs for static parameters.
+
+Reference: /root/reference/python/paddle/fluid/initializer.py — each
+initializer appends a startup-program op (fill_constant /
+gaussian_random / uniform_random / truncated_gaussian_random). Same
+design here: an initializer resolves to (op_type, attrs) appended to the
+startup program by LayerHelper.create_parameter.
+"""
+from __future__ import annotations
+
+import math
+
+
+class Initializer:
+    def resolve(self, shape, dtype, fan_hint):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def resolve(self, shape, dtype, fan_hint):
+        return "fill_constant", {"shape": list(shape), "dtype": dtype,
+                                 "value": float(self.value)}
+
+
+class Normal(Initializer):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc, self.scale = loc, scale
+
+    def resolve(self, shape, dtype, fan_hint):
+        return "gaussian_random", {"shape": list(shape), "dtype": dtype,
+                                   "mean": self.loc, "std": self.scale}
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc, self.scale = loc, scale
+
+    def resolve(self, shape, dtype, fan_hint):
+        return "truncated_gaussian_random", {
+            "shape": list(shape), "dtype": dtype, "mean": self.loc,
+            "std": self.scale}
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def resolve(self, shape, dtype, fan_hint):
+        return "uniform_random", {"shape": list(shape), "dtype": dtype,
+                                  "min": self.low, "max": self.high}
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Xavier(Initializer):
+    """Glorot (reference initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None):
+        self.uniform = uniform
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def resolve(self, shape, dtype, fan_hint):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return "uniform_random", {"shape": list(shape), "dtype": dtype,
+                                      "min": -limit, "max": limit}
+        std = math.sqrt(2.0 / (fi + fo))
+        return "gaussian_random", {"shape": list(shape), "dtype": dtype,
+                                   "mean": 0.0, "std": std}
+
+
+class MSRA(Initializer):
+    """Kaiming (reference initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None):
+        self.uniform = uniform
+        self.fan_in = fan_in
+
+    def resolve(self, shape, dtype, fan_hint):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return "uniform_random", {"shape": list(shape), "dtype": dtype,
+                                      "min": -limit, "max": limit}
+        std = math.sqrt(2.0 / fi)
+        return "gaussian_random", {"shape": list(shape), "dtype": dtype,
+                                   "mean": 0.0, "std": std}
+
+
+KaimingUniform = MSRA
+XavierInitializer = Xavier
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+
+
+def resolve_initializer(initializer, shape, dtype, fan_hint=None):
+    if initializer is None:
+        initializer = Xavier()
+    return initializer.resolve(shape, dtype, fan_hint)

@@ -1,0 +1,110 @@
+"""Static-graph checkpoint & inference-model IO.
+
+Reference: /root/reference/python/paddle/fluid/io.py — save_persistables
+:598 (runs save ops via an executor), save_inference_model :1164 (prunes
+program to feed/fetch targets + writes params), load_* counterparts.
+
+Here persistables live in a host-side Scope of jax arrays, so saving is a
+straight pickle of name->numpy (the reference's single-file `save :1669`
+.pdparams format shape), and the program is serialized as versioned JSON
+(ir.py). No executor round-trip needed.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .executor import Executor, Scope, global_scope
+from .ir import Program, Variable
+
+_PARAMS_SUFFIX = ".pdparams"
+_MODEL_FILENAME = "__model__"
+
+
+def _collect_persistables(program: Program, scope: Scope):
+    out = {}
+    for name, desc in program.global_block.vars.items():
+        if desc.persistable:
+            v = scope.find_var(name)
+            if v is not None:
+                out[name] = np.asarray(v)
+    return out
+
+
+def save_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None,
+                      filename: Optional[str] = None):
+    from .ir import default_main_program
+    program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    state = _collect_persistables(program, global_scope())
+    path = os.path.join(dirname, filename or "params" + _PARAMS_SUFFIX)
+    with open(path, "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    return path
+
+
+def load_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None,
+                      filename: Optional[str] = None):
+    import jax.numpy as jnp
+    path = os.path.join(dirname, filename or "params" + _PARAMS_SUFFIX)
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    scope = global_scope()
+    for k, v in state.items():
+        scope.set(k, jnp.asarray(v))
+
+
+save_params = save_persistables
+load_params = load_persistables
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable], executor: Executor,
+                         main_program: Optional[Program] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
+    """Prune to the inference subgraph and write model + params."""
+    from .ir import default_main_program
+    program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in target_vars]
+    pruned = program.clone(for_test=True).prune(feeded_var_names,
+                                                fetch_names)
+    meta = {"feed_names": list(feeded_var_names),
+            "fetch_names": fetch_names}
+    blob = {"program": pruned.to_dict(), "meta": meta}
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME),
+              "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+    state = _collect_persistables(pruned, global_scope())
+    with open(os.path.join(dirname,
+                           params_filename or "params" + _PARAMS_SUFFIX),
+              "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    return fetch_names
+
+
+def load_inference_model(dirname: str, executor: Executor,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
+    import jax.numpy as jnp
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME),
+              "rb") as f:
+        blob = pickle.load(f)
+    program = Program.from_dict(blob["program"])
+    meta = blob["meta"]
+    with open(os.path.join(dirname,
+                           params_filename or "params" + _PARAMS_SUFFIX),
+              "rb") as f:
+        state = pickle.load(f)
+    scope = global_scope()
+    for k, v in state.items():
+        scope.set(k, jnp.asarray(v))
+    fetch_vars = [program.global_block.var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars

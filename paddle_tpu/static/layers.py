@@ -1,0 +1,565 @@
+"""Graph-building layer functions for static programs.
+
+Reference: /root/reference/python/paddle/fluid/layers/nn.py (fc :211,
+conv2d, batch_norm, ...), layers/tensor.py, LayerHelper plumbing
+(layer_helper.py). Facades append OpDescs to the current program and
+return Variables.
+
+Shape inference is NOT hand-written per op (reference InferShape in every
+operator): each appended op's output shapes/dtypes come from
+`jax.eval_shape` over its kernel — the compiler's abstract interpretation
+is the single source of truth. Dynamic batch (-1) is threaded through with
+a sentinel dim.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..utils import unique_name
+from .ir import (Block, ParamDesc, Program, Variable, default_main_program,
+                 default_startup_program, _DYN_SENTINEL)
+from .kernels import KERNELS, ExecContext
+
+
+# ---------------------------------------------------------------------------
+# shape inference via abstract evaluation
+# ---------------------------------------------------------------------------
+def _infer_outputs(block: Block, op, out_slots: Dict[str, int]):
+    """Create output vars of `op` with shapes from jax.eval_shape."""
+    kernel = KERNELS[op.type]
+
+    concrete_ins = {}
+    for slot, names in op.inputs.items():
+        arrs = []
+        for n in names:
+            desc = block._find_var_recursive(n)
+            shape = tuple(_DYN_SENTINEL if (s is None or s == -1) else s
+                          for s in (desc.shape or ()))
+            arrs.append(jax.ShapeDtypeStruct(
+                shape, dtype_mod.convert_dtype(desc.dtype)))
+        concrete_ins[slot] = arrs
+
+    def absfn(ins):
+        ctx = ExecContext(rng_key=jax.random.PRNGKey(0))
+        return kernel(ins, op.attrs, ctx)
+
+    outs = jax.eval_shape(absfn, concrete_ins)
+    created = {}
+    for slot, names in op.outputs.items():
+        structs = outs.get(slot, [])
+        for name, st in zip(names, structs):
+            shape = tuple(-1 if s == _DYN_SENTINEL else s for s in st.shape)
+            if not block.has_var(name):
+                block.create_var(name=name, shape=shape,
+                                 dtype=dtype_mod.dtype_name(st.dtype))
+            created[name] = block.var(name)
+    return created
+
+
+class LayerHelper:
+    """Append-op helper (reference layer_helper.py / layer_helper_base.py)."""
+
+    def __init__(self, layer_type: str, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+        self.main_program = default_main_program()
+        self.startup_program = default_startup_program()
+
+    @property
+    def block(self) -> Block:
+        return self.main_program.current_block()
+
+    def create_tmp(self, dtype="float32") -> str:
+        return unique_name.generate(f"{self.layer_type}_tmp")
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
+        op = self.block.append_op(type=type, inputs=inputs, outputs=outputs,
+                                  attrs=attrs)
+        if infer_shape:
+            _infer_outputs(self.block, op, {})
+        return op
+
+    def create_parameter(self, shape, dtype="float32", name=None,
+                         initializer=None, trainable=True,
+                         attr=None):
+        """Create a ParamDesc in the main block AND its init op in the
+        startup program (reference LayerHelperBase.create_parameter)."""
+        from .initializer import resolve_initializer
+
+        if attr is not None and getattr(attr, "name", None):
+            name = attr.name
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            initializer = attr.initializer
+        if attr is not None and getattr(attr, "trainable", None) is not None:
+            trainable = attr.trainable
+        name = name or unique_name.generate(f"{self.layer_type}_w")
+        shape = tuple(int(s) for s in shape)
+        desc = ParamDesc(name, shape, dtype_mod.dtype_name(
+            dtype_mod.convert_dtype(dtype)), trainable=trainable)
+        self.main_program.global_block.vars[name] = desc
+
+        op_type, attrs = resolve_initializer(initializer, shape, desc.dtype,
+                                             fan_hint=shape)
+        desc.initializer_desc = [op_type, attrs]
+        sb = self.startup_program.global_block
+        sb.vars[name] = ParamDesc(name, shape, desc.dtype, trainable)
+        sb.append_op(type=op_type, inputs={}, outputs={"Out": [name]},
+                     attrs=attrs)
+        return Variable(self.main_program.global_block, desc)
+
+    def out_var(self, dtype="float32"):
+        name = self.create_tmp()
+        return name
+
+
+def _append_simple(op_type, inputs, attrs=None, out_slots=("Out",),
+                   helper=None):
+    helper = helper or LayerHelper(op_type)
+    outputs = {slot: [unique_name.generate(f"{op_type}.{slot.lower()}")]
+               for slot in out_slots}
+    op = helper.block.append_op(type=op_type, inputs=inputs,
+                                outputs=outputs, attrs=attrs or {})
+    created = _infer_outputs(helper.block, op, {})
+    outs = [helper.block.var(outputs[s][0]) for s in out_slots]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# data & constants
+# ---------------------------------------------------------------------------
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level=0, append_batch_size=False) -> Variable:
+    """Feed placeholder (reference fluid/data.py / layers/io.py data)."""
+    prog = default_main_program()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    v = prog.global_block.create_var(
+        name=name, shape=shape, dtype=dtype, is_data=True,
+        stop_gradient=True)
+    return v
+
+
+def fill_constant(shape, dtype, value, name=None):
+    helper = LayerHelper("fill_constant")
+    out_name = name or unique_name.generate("fill_constant.out")
+    op = helper.block.append_op(
+        type="fill_constant", inputs={},
+        outputs={"Out": [out_name]},
+        attrs={"shape": list(shape), "dtype": str(dtype), "value": value})
+    _infer_outputs(helper.block, op, {})
+    return helper.block.var(out_name)
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, (np.ndarray, list, tuple, float, int)):
+        arr = np.asarray(input)
+        out_name = output.name if output is not None else \
+            unique_name.generate("assign.out")
+        op = helper.block.append_op(
+            type="assign_value", inputs={}, outputs={"Out": [out_name]},
+            attrs={"shape": list(arr.shape), "dtype": str(arr.dtype),
+                   "values": arr.tolist()})
+        _infer_outputs(helper.block, op, {})
+        return helper.block.var(out_name)
+    if output is not None:
+        op = helper.block.append_op(type="assign", inputs={"X": [input]},
+                                    outputs={"Out": [output.name]})
+        _infer_outputs(helper.block, op, {})
+        return output
+    return _append_simple("assign", {"X": [input]})
+
+
+# ---------------------------------------------------------------------------
+# core NN layers
+# ---------------------------------------------------------------------------
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Reference layers/nn.py:211 fc: flatten -> mul -> add bias -> act."""
+    helper = LayerHelper("fc", name=name)
+    in_shape = input.shape
+    fan_in = 1
+    for s in in_shape[num_flatten_dims:]:
+        fan_in *= (s if s and s > 0 else 1)
+    w = helper.create_parameter((fan_in, size), input.dtype, attr=param_attr,
+                                initializer=None)
+    out = _append_simple("mul", {"X": [input], "Y": [w]},
+                         {"x_num_col_dims": num_flatten_dims,
+                          "y_num_col_dims": 1}, helper=helper)
+    if bias_attr is not False:
+        from .initializer import Constant
+        b = helper.create_parameter((size,), input.dtype, attr=bias_attr,
+                                    initializer=Constant(0.0))
+        out = _append_simple("elementwise_add", {"X": [out], "Y": [b]},
+                             {"axis": len(out.shape) - 1}, helper=helper)
+    if act:
+        out = _append_simple(act, {"X": [out]}, helper=helper)
+    return out
+
+
+def embedding(input, size, padding_idx=None, param_attr=None,
+              dtype="float32", is_sparse=False, name=None):
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(size, dtype, attr=param_attr)
+    return _append_simple(
+        "lookup_table_v2", {"W": [w], "Ids": [input]},
+        {"padding_idx": -1 if padding_idx is None else padding_idx},
+        helper=helper)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d", name=name)
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    c_in = input.shape[1]
+    w = helper.create_parameter(
+        (num_filters, c_in // groups) + tuple(filter_size), input.dtype,
+        attr=param_attr)
+    out = _append_simple(
+        "conv2d", {"Input": [input], "Filter": [w]},
+        {"strides": list(stride), "paddings": list(padding),
+         "dilations": list(dilation), "groups": groups},
+        out_slots=("Output",), helper=helper)
+    if bias_attr is not False:
+        from .initializer import Constant
+        b = helper.create_parameter((num_filters,), input.dtype,
+                                    attr=bias_attr,
+                                    initializer=Constant(0.0))
+        out = _append_simple("elementwise_add", {"X": [out], "Y": [b]},
+                             {"axis": 1}, helper=helper)
+    if act:
+        out = _append_simple(act, {"X": [out]}, helper=helper)
+    return out
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=None,
+           pool_padding=0, global_pooling=False, exclusive=True, name=None):
+    if isinstance(pool_size, int):
+        pool_size = (pool_size, pool_size)
+    pool_stride = pool_stride or pool_size
+    if isinstance(pool_stride, int):
+        pool_stride = (pool_stride, pool_stride)
+    if isinstance(pool_padding, int):
+        pool_padding = (pool_padding, pool_padding)
+    return _append_simple(
+        "pool2d", {"X": [input]},
+        {"ksize": list(pool_size), "pooling_type": pool_type,
+         "strides": list(pool_stride), "paddings": list(pool_padding),
+         "global_pooling": global_pooling, "exclusive": exclusive})
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, name=None):
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1]
+    from .initializer import Constant
+    scale = helper.create_parameter((c,), input.dtype, attr=param_attr,
+                                    initializer=Constant(1.0))
+    bias = helper.create_parameter((c,), input.dtype, attr=bias_attr,
+                                   initializer=Constant(0.0))
+    mean = helper.create_parameter((c,), input.dtype,
+                                   initializer=Constant(0.0),
+                                   trainable=False)
+    var = helper.create_parameter((c,), input.dtype,
+                                  initializer=Constant(1.0),
+                                  trainable=False)
+    outs = {s: [unique_name.generate(f"bn.{s.lower()}")]
+            for s in ("Y", "SavedMean", "SavedVariance")}
+    outs["MeanOut"] = [mean.name]
+    outs["VarianceOut"] = [var.name]
+    op = helper.block.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs=outs,
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test})
+    _infer_outputs(helper.block, op, {})
+    out = helper.block.var(outs["Y"][0])
+    if act:
+        out = _append_simple(act, {"X": [out]}, helper=helper)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+    helper = LayerHelper("layer_norm", name=name)
+    norm_shape = tuple(input.shape[begin_norm_axis:])
+    n = 1
+    for s in norm_shape:
+        n *= s
+    inputs = {"X": [input]}
+    from .initializer import Constant
+    if scale:
+        inputs["Scale"] = [helper.create_parameter(
+            (n,), input.dtype, attr=param_attr, initializer=Constant(1.0))]
+    if shift:
+        inputs["Bias"] = [helper.create_parameter(
+            (n,), input.dtype, attr=bias_attr, initializer=Constant(0.0))]
+    out, mean, var = _append_simple(
+        "layer_norm", inputs, {"epsilon": epsilon,
+                               "begin_norm_axis": begin_norm_axis},
+        out_slots=("Y", "Mean", "Variance"), helper=helper)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False,
+            dropout_implementation="upscale_in_train", name=None):
+    out, _ = _append_simple(
+        "dropout", {"X": [x]},
+        {"dropout_prob": dropout_prob, "is_test": is_test,
+         "dropout_implementation": dropout_implementation},
+        out_slots=("Out", "Mask"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# math / tensor ops
+# ---------------------------------------------------------------------------
+def _elementwise_binary(x, y, op_type, reverse=False):
+    block = x.block if isinstance(x, Variable) else y.block
+    if not isinstance(y, Variable):
+        y = fill_constant(shape=(1,), dtype=x.dtype, value=float(y))
+    if not isinstance(x, Variable):
+        x = fill_constant(shape=(1,), dtype=y.dtype, value=float(x))
+    if reverse:
+        x, y = y, x
+    return _append_simple(op_type, {"X": [x], "Y": [y]}, {"axis": -1})
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    return _append_simple("matmul", {"X": [x], "Y": [y]},
+                          {"transpose_X": transpose_x,
+                           "transpose_Y": transpose_y, "alpha": alpha})
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    return _append_simple("mul", {"X": [x], "Y": [y]},
+                          {"x_num_col_dims": x_num_col_dims,
+                           "y_num_col_dims": y_num_col_dims})
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    out = _append_simple("elementwise_add", {"X": [x], "Y": [y]},
+                         {"axis": axis})
+    return _append_simple(act, {"X": [out]}) if act else out
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _append_simple("elementwise_sub", {"X": [x], "Y": [y]},
+                          {"axis": axis})
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _append_simple("elementwise_mul", {"X": [x], "Y": [y]},
+                          {"axis": axis})
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _append_simple("elementwise_div", {"X": [x], "Y": [y]},
+                          {"axis": axis})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _append_simple("scale", {"X": [x]},
+                         {"scale": float(scale), "bias": float(bias),
+                          "bias_after_scale": bias_after_scale})
+    return _append_simple(act, {"X": [out]}) if act else out
+
+
+def cast(x, dtype):
+    return _append_simple("cast", {"X": [x]}, {"out_dtype": str(
+        dtype_mod.dtype_name(dtype_mod.convert_dtype(dtype)))})
+
+
+def clip(x, min, max, name=None):
+    return _append_simple("clip", {"X": [x]}, {"min": min, "max": max})
+
+
+def mean(x, name=None):
+    return _append_simple("mean", {"X": [x]})
+
+
+def sums(input, name=None):
+    return _append_simple("sum", {"X": list(input)})
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _append_simple("reduce_sum", {"X": [input]},
+                          {"dim": dim, "keep_dim": keep_dim,
+                           "reduce_all": dim is None})
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _append_simple("reduce_mean", {"X": [input]},
+                          {"dim": dim, "keep_dim": keep_dim,
+                           "reduce_all": dim is None})
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _append_simple("reduce_max", {"X": [input]},
+                          {"dim": dim, "keep_dim": keep_dim,
+                           "reduce_all": dim is None})
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _append_simple("reduce_min", {"X": [input]},
+                          {"dim": dim, "keep_dim": keep_dim,
+                           "reduce_all": dim is None})
+
+
+def reshape(x, shape, name=None):
+    return _append_simple("reshape2", {"X": [x]}, {"shape": list(shape)})
+
+
+def transpose(x, perm, name=None):
+    return _append_simple("transpose2", {"X": [x]}, {"axis": list(perm)})
+
+
+def concat(input, axis=0, name=None):
+    return _append_simple("concat", {"X": list(input)}, {"axis": axis})
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    ndim = len(input.shape)
+    axis = dim if dim >= 0 else dim + ndim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": axis}
+    else:
+        n = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": axis}
+    helper = LayerHelper("split")
+    names = [unique_name.generate("split.out") for _ in range(n)]
+    op = helper.block.append_op(type="split", inputs={"X": [input]},
+                                outputs={"Out": names}, attrs=attrs)
+    _infer_outputs(helper.block, op, {})
+    return [helper.block.var(n_) for n_ in names]
+
+
+def squeeze(input, axes, name=None):
+    return _append_simple("squeeze2", {"X": [input]}, {"axes": list(axes)})
+
+
+def unsqueeze(input, axes, name=None):
+    return _append_simple("unsqueeze2", {"X": [input]},
+                          {"axes": list(axes)})
+
+
+def stack(x, axis=0, name=None):
+    return _append_simple("stack", {"X": list(x)}, {"axis": axis},
+                          out_slots=("Y",))
+
+
+def slice(input, axes, starts, ends):
+    return _append_simple("slice", {"Input": [input], "X": [input]},
+                          {"axes": list(axes), "starts": list(starts),
+                           "ends": list(ends)})
+
+
+def flatten(x, axis=1, name=None):
+    return _append_simple("flatten2", {"X": [x]}, {"axis": axis})
+
+
+def one_hot(input, depth, name=None):
+    return _append_simple("one_hot_v2", {"X": [input]}, {"depth": depth})
+
+
+def gather(input, index, axis=0):
+    return _append_simple("gather", {"X": [input], "Index": [index]},
+                          {"axis": axis})
+
+
+def argmax(x, axis=-1):
+    return _append_simple("arg_max", {"X": [x]}, {"axis": axis})
+
+
+def topk(input, k, name=None):
+    return _append_simple("top_k_v2", {"X": [input]}, {"k": k},
+                          out_slots=("Out", "Indices"))
+
+
+# activations as layer fns
+def _act_layer(name):
+    def f(x, **kwargs):
+        return _append_simple(name, {"X": [x]})
+    f.__name__ = name
+    return f
+
+
+relu = _act_layer("relu")
+sigmoid = _act_layer("sigmoid")
+tanh = _act_layer("tanh")
+exp = _act_layer("exp")
+log = _act_layer("log")
+sqrt = _act_layer("sqrt")
+square = _act_layer("square")
+abs = _act_layer("abs")
+softmax_ = None
+
+
+def softmax(input, axis=-1, name=None):
+    return _append_simple("softmax", {"X": [input]}, {"axis": axis})
+
+
+def gelu(x, approximate=False):
+    return _append_simple("gelu", {"X": [x]}, {"approximate": approximate})
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _append_simple("leaky_relu", {"X": [x]}, {"alpha": alpha})
+
+
+# losses & metrics
+def cross_entropy(input, label, soft_label=False, name=None):
+    return _append_simple("cross_entropy",
+                          {"X": [input], "Label": [label]},
+                          {"soft_label": soft_label}, out_slots=("Y",))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               return_softmax=False, axis=-1):
+    sm, loss = _append_simple(
+        "softmax_with_cross_entropy",
+        {"Logits": [logits], "Label": [label]},
+        {"soft_label": soft_label}, out_slots=("Softmax", "Loss"))
+    return (loss, sm) if return_softmax else loss
+
+
+def accuracy(input, label, k=1, name=None):
+    acc, _, _ = _append_simple(
+        "accuracy", {"Out": [input], "Label": [label]}, {"k": k},
+        out_slots=("Accuracy", "Correct", "Total"))
+    return acc
+
+
+# comparison layers
+def equal(x, y):
+    return _append_simple("equal", {"X": [x], "Y": [y]}, {"axis": -1})
+
+
+def less_than(x, y):
+    return _append_simple("less_than", {"X": [x], "Y": [y]}, {"axis": -1})
+
+
+def greater_than(x, y):
+    return _append_simple("greater_than", {"X": [x], "Y": [y]}, {"axis": -1})
+
+
+def logical_and(x, y):
+    return _append_simple("logical_and", {"X": [x], "Y": [y]}, {"axis": -1})
+
+
+def logical_not(x):
+    return _append_simple("logical_not", {"X": [x]})

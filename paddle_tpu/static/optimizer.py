@@ -1,0 +1,156 @@
+"""Static-graph optimizers: append_backward + per-param update ops.
+
+Reference: /root/reference/python/paddle/fluid/optimizer.py:56 Optimizer
+(minimize -> append_backward -> _create_accumulators -> apply_gradients
+appending one update op per param). Same program-rewriting shape here;
+the update ops are jnp kernels (kernels.py) so the whole train step
+(fwd + vjp-backward + updates) compiles into one XLA program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..utils import unique_name
+from .backward import append_backward
+from .initializer import Constant
+from .ir import ParamDesc, Program, Variable, default_startup_program, \
+    grad_var_name
+from .layers import LayerHelper
+
+OPTIMIZER_OP_TYPES = {"sgd", "momentum", "adam", "lamb", "increment"}
+
+
+class Optimizer:
+    _update_op = None
+
+    def __init__(self, learning_rate=0.001, regularization=None,
+                 grad_clip=None, name=None):
+        self.learning_rate = learning_rate
+        self.regularization = regularization
+        self.grad_clip = grad_clip
+        self._name = name or type(self).__name__.lower()
+        self._lr_var = None
+
+    # -- helpers ----------------------------------------------------------
+    def _create_lr_var(self, helper: LayerHelper):
+        if self._lr_var is not None:
+            return self._lr_var
+        name = unique_name.generate(f"{self._name}_lr")
+        self._lr_var = self._create_persist(
+            helper, name, (1,), float(self.learning_rate))
+        return self._lr_var
+
+    @staticmethod
+    def _create_persist(helper, name, shape, value, dtype="float32"):
+        from .ir import VarDesc
+        desc = VarDesc(name, shape, dtype, persistable=True)
+        helper.main_program.global_block.vars[name] = desc
+        sb = helper.startup_program.global_block
+        sb.vars[name] = VarDesc(name, shape, dtype, persistable=True)
+        sb.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": [name]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "value": float(value)})
+        return Variable(helper.main_program.global_block, desc)
+
+    def _accumulator(self, helper, param, suffix, value=0.0, shape=None):
+        name = f"{param.name}_{self._name}_{suffix}"
+        return self._create_persist(
+            helper, name, shape or param.shape, value, param.dtype)
+
+    # -- public API (reference Optimizer.minimize) ------------------------
+    def minimize(self, loss: Variable, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        if self.grad_clip is not None:
+            params_grads = self.grad_clip(params_grads)
+        self.apply_gradients(params_grads)
+        return [], params_grads
+
+    def apply_gradients(self, params_grads):
+        helper = LayerHelper(self._name)
+        lr = self._create_lr_var(helper)
+        for p, g in params_grads:
+            self._append_update(helper, p, g, lr)
+        return []
+
+    def _append_update(self, helper, p, g, lr):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    def _append_update(self, helper, p, g, lr):
+        helper.block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g], "LearningRate": [lr]},
+            outputs={"ParamOut": [p.name]})
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _append_update(self, helper, p, g, lr):
+        vel = self._accumulator(helper, p, "velocity")
+        helper.block.append_op(
+            type="momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [vel],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [vel.name]},
+            attrs={"mu": self.momentum, "use_nesterov": self.use_nesterov})
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _append_update(self, helper, p, g, lr):
+        m1 = self._accumulator(helper, p, "moment1")
+        m2 = self._accumulator(helper, p, "moment2")
+        b1p = self._accumulator(helper, p, "beta1pow", 1.0, (1,))
+        b2p = self._accumulator(helper, p, "beta2pow", 1.0, (1,))
+        helper.block.append_op(
+            type="adam",
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [p.name], "Moment1Out": [m1.name],
+                     "Moment2Out": [m2.name], "Beta1PowOut": [b1p.name],
+                     "Beta2PowOut": [b2p.name]},
+            attrs={"beta1": self.beta1, "beta2": self.beta2,
+                   "epsilon": self.epsilon})
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.wd = lamb_weight_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _append_update(self, helper, p, g, lr):
+        m1 = self._accumulator(helper, p, "moment1")
+        m2 = self._accumulator(helper, p, "moment2")
+        b1p = self._accumulator(helper, p, "beta1pow", 1.0, (1,))
+        b2p = self._accumulator(helper, p, "beta2pow", 1.0, (1,))
+        helper.block.append_op(
+            type="lamb",
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [p.name], "Moment1Out": [m1.name],
+                     "Moment2Out": [m2.name], "Beta1PowOut": [b1p.name],
+                     "Beta2PowOut": [b2p.name]},
+            attrs={"beta1": self.beta1, "beta2": self.beta2,
+                   "epsilon": self.epsilon, "weight_decay": self.wd})
+
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdamOptimizer = Adam
+LambOptimizer = Lamb

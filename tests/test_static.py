@@ -1,0 +1,177 @@
+"""Static-graph subsystem tests (Program IR, Executor, backward, IO).
+
+Mirrors the reference's book tests
+(/root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py
+pattern: build program, train a few steps, assert loss decreases,
+save/load inference model)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+
+
+def _mlp_program(lr=0.1, optimizer="sgd"):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 8])
+        label = static.data("label", [-1, 1], dtype="int64")
+        h = static.nn.fc(x, 16, act="relu")
+        logits = static.nn.fc(h, 4)
+        loss = static.mean(
+            static.softmax_with_cross_entropy(logits, label))
+        opt = {"sgd": static.SGD, "adam": static.Adam,
+               "momentum": static.Momentum,
+               "lamb": static.Lamb}[optimizer](lr)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _batch(rng, n=32):
+    x = rng.randn(n, 8).astype("float32")
+    label = (x.sum(axis=1) > 0).astype("int64").reshape(n, 1) * 3
+    return x, label
+
+
+def test_program_build_and_repr():
+    main, startup, loss = _mlp_program()
+    assert len(main.global_block.ops) > 5
+    assert any(op.type == "backward" for op in main.global_block.ops)
+    assert any(op.type == "sgd" for op in main.global_block.ops)
+    params = main.all_parameters()
+    assert len(params) == 4  # 2 weights + 2 biases
+    # shape inference worked
+    assert loss.shape == ()or loss.shape == (1,) or loss.shape is not None
+
+
+def test_executor_trains_mlp():
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program(lr=0.5)
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    w_names = [p.name for p in main.all_parameters()]
+    assert all(scope.find_var(n) is not None for n in w_names)
+
+    losses = []
+    for _ in range(30):
+        x, label = _batch(rng)
+        out, = exe.run(main, feed={"x": x, "label": label},
+                       fetch_list=[loss])
+        losses.append(float(out))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+@pytest.mark.parametrize("opt", ["adam", "momentum", "lamb"])
+def test_optimizers_reduce_loss(opt):
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program(lr=0.01, optimizer=opt)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(25):
+        x, label = _batch(rng)
+        out, = exe.run(main, feed={"x": x, "label": label},
+                       fetch_list=[loss])
+        losses.append(float(out))
+    assert losses[-1] < losses[0], (opt, losses)
+
+
+def test_program_serialization_roundtrip():
+    main, _, _ = _mlp_program()
+    blob = main.serialize_to_string()
+    restored = static.Program.parse_from_string(blob)
+    assert len(restored.global_block.ops) == len(main.global_block.ops)
+    assert set(restored.global_block.vars) == set(main.global_block.vars)
+
+
+def test_clone_for_test_strips_backward_and_optim():
+    main, _, _ = _mlp_program()
+    test_prog = main.clone(for_test=True)
+    types = {op.type for op in test_prog.global_block.ops}
+    assert "backward" not in types and "sgd" not in types
+
+
+def test_save_load_inference_model(tmp_path):
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    x, label = _batch(rng)
+    exe.run(main, feed={"x": x, "label": label}, fetch_list=[loss])
+
+    # find the logits var (last fc output before softmax_with_ce)
+    logits_name = None
+    for op in main.global_block.ops:
+        if op.type == "softmax_with_cross_entropy":
+            logits_name = op.inputs["Logits"][0]
+    logits = main.global_block.var(logits_name)
+
+    d = str(tmp_path / "infer")
+    static.save_inference_model(d, ["x"], [logits], exe, main)
+
+    # fresh scope: load and run
+    with static.scope_guard(static.Scope()):
+        prog, feeds, fetches = static.load_inference_model(d, exe)
+        assert feeds == ["x"]
+        out, = exe.run(prog, feed={"x": x}, fetch_list=fetches)
+        assert out.shape == (32, 4)
+        assert np.isfinite(out).all()
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup, loss = _mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    p0 = main.all_parameters()[0].name
+    before = np.asarray(static.global_scope().find_var(p0))
+    static.save_persistables(exe, str(tmp_path), main)
+    static.global_scope().set(p0, before * 0)
+    static.load_persistables(exe, str(tmp_path), main)
+    after = np.asarray(static.global_scope().find_var(p0))
+    np.testing.assert_allclose(before, after)
+
+
+def test_compiled_program_data_parallel():
+    """DP via CompiledProgram: same convergence, sharded feeds
+    (reference compiler.py:160 with_data_parallel)."""
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program(lr=0.5)
+    exe = static.Executor()
+    exe.run(startup)
+    compiled = static.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    losses = []
+    for _ in range(20):
+        x, label = _batch(rng, n=32)  # divisible by 8 devices
+        out, = exe.run(compiled, feed={"x": x, "label": label},
+                       fetch_list=[loss])
+        losses.append(float(out))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_calc_gradient():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3])
+        y = static.reduce_sum(x * x)
+        (gx,) = static.calc_gradient(y, [x])
+    exe = static.Executor()
+    xv = np.arange(12, dtype="float32").reshape(4, 3)
+    out, = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(out, 2 * xv, rtol=1e-5)
+
+
+def test_conv_bn_pool_static():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        img = static.data("img", [-1, 3, 8, 8])
+        c = static.nn.conv2d(img, 4, 3, padding=1, act="relu")
+        b = static.nn.batch_norm(c)
+        p = static.nn.pool2d(b, 2, "max", 2)
+        out = static.nn.fc(p, 10)
+    exe = static.Executor()
+    exe.run(startup)
+    res, = exe.run(main, feed={"img": np.ones((2, 3, 8, 8), "float32")},
+                   fetch_list=[out])
+    assert res.shape == (2, 10)
+    assert np.isfinite(res).all()
