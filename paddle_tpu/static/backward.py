@@ -75,12 +75,28 @@ def run_backward_op(block: Block, idx: int, op, env: Dict, ctx):
     pset = set(params)
     base_env = {k: v for k, v in ctx.initial_env.items() if k not in pset}
 
+    # For a param produced by an op in [0, idx) (calc_gradient w.r.t. an
+    # intermediate var), injecting it at entry isn't enough — its producer
+    # would overwrite it and disconnect it from the loss. Inject AFTER its
+    # last producer runs instead, so all downstream consumers read the
+    # traced free input.
+    last_producer = {}
+    for j, o in enumerate(block.ops[:idx]):
+        for n in o.output_names():
+            if n in pset:
+                last_producer[n] = j
+
     def forward(pvals):
+        pmap = dict(zip(params, pvals))
         env2 = dict(base_env)
-        env2.update(zip(params, pvals))
+        env2.update({p: v for p, v in pmap.items()
+                     if p not in last_producer})
+        post = {}
+        for p, j in last_producer.items():
+            post.setdefault(j, {})[p] = pmap[p]
         ctx2 = ExecContext(rng_key=ctx.rng_key, is_test=ctx.is_test)
         ctx2.initial_env = env2  # nested backward unsupported but harmless
-        env2 = run_block(block, env2, ctx2, stop_at=idx)
+        env2 = run_block(block, env2, ctx2, stop_at=idx, post_writes=post)
         return env2[loss_name]
 
     fwd = forward
@@ -96,12 +112,29 @@ def run_backward_op(block: Block, idx: int, op, env: Dict, ctx):
 def calc_gradient(targets, inputs, target_gradients=None):
     """Reference backward.py:1665 calc_gradient parity: appends a backward
     op differentiating `targets` w.r.t. arbitrary `inputs` (not only
-    params)."""
+    params). Multiple targets / user cotangents are folded into one scalar
+    loss  sum_i <t_i, tg_i>  (tg_i defaults to ones) so a single vjp
+    yields the same gradients the reference accumulates per-op."""
+    from . import layers
+
     if not isinstance(targets, (list, tuple)):
         targets = [targets]
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    if not isinstance(target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    assert len(target_gradients) == len(targets), \
+        "target_gradients must match targets"
+
     block = targets[0].block
+    parts = []
+    for t, tg in zip(targets, target_gradients):
+        parts.append(layers.reduce_sum(t if tg is None
+                                       else layers.elementwise_mul(t, tg)))
+    total = parts[0] if len(parts) == 1 else layers.sums(parts)
+
     grad_names = []
     for v in inputs:
         gname = grad_var_name(v.name)
@@ -110,7 +143,7 @@ def calc_gradient(targets, inputs, target_gradients=None):
         grad_names.append(gname)
     block.append_op(
         type="backward",
-        inputs={"Loss": [targets[0].name],
+        inputs={"Loss": [total.name],
                 "Params": [v.name for v in inputs]},
         outputs={"Grads": grad_names},
         attrs={"use_checkpoint": False, "checkpoints": []},

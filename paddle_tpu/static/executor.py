@@ -84,10 +84,16 @@ def scope_guard(scope):
 # lowering: Block -> pure function(env) -> env
 # ---------------------------------------------------------------------------
 def run_block(block: Block, env: Dict[str, Any], ctx: ExecContext,
-              stop_at: Optional[int] = None) -> Dict[str, Any]:
+              stop_at: Optional[int] = None,
+              post_writes: Optional[Dict[int, Dict[str, Any]]] = None
+              ) -> Dict[str, Any]:
     """Interpret ops of a block over an env dict. Called under jit trace —
     this IS the compilation step, not the runtime (no per-op dispatch cost
-    after compile)."""
+    after compile).
+
+    post_writes: {op_index: {var_name: value}} — after op i runs, override
+    env entries (used by backward.py to treat an intermediate var as a free
+    input for gradient computation w.r.t. it)."""
     from .backward import run_backward_op  # local: avoids import cycle
 
     if not hasattr(ctx, "initial_env"):
@@ -115,6 +121,8 @@ def run_block(block: Block, env: Dict[str, Any], ctx: ExecContext,
                 continue
             for name, arr in zip(names, produced):
                 env[name] = arr
+        if post_writes and i in post_writes:
+            env.update(post_writes[i])
     return env
 
 
@@ -163,14 +171,19 @@ class Executor:
         persist_names = sorted(
             n for n, v in block.vars.items()
             if v.persistable and scope.find_var(n) is not None)
-        key = (id(program), program._version, _feed_signature(
-            {k: np.asarray(v) for k, v in feed.items()}),
-            tuple(fetch_names), tuple(persist_names), bool(sharding))
+        # shape/dtype only — never materialize device arrays for the key
+        sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in feed.items()))
+        key = (id(program), program._version, sig,
+               tuple(fetch_names), tuple(persist_names), bool(sharding))
 
         if not use_program_cache or key not in self._cache:
-            self._cache[key] = self._build(program, block, feed, fetch_names,
-                                           persist_names, sharding)
-        compiled = self._cache[key]
+            # hold a strong ref to the program: keyed by id(), a collected
+            # Program's id can be reused and alias a stale executable
+            self._cache[key] = (self._build(program, block, feed,
+                                            fetch_names, persist_names,
+                                            sharding), program)
+        compiled, _ = self._cache[key]
 
         state = [scope.find_var(n) for n in persist_names]
         seed = program.random_seed or random_mod.default_generator().initial_seed()

@@ -31,8 +31,11 @@ from ..framework import dtype as dtype_mod
 IR_VERSION = 1
 
 # Sentinel substituted for -1 (dynamic batch) during eval_shape-based
-# shape inference; any inferred dim equal to it maps back to -1.
-_DYN_SENTINEL = 97
+# shape inference; inferred dims divisible by it map back to -1 (covers
+# reshape-merged dims like batch*seq). A large prime keeps collisions with
+# real layer sizes out of practical range; eval_shape is abstract, so the
+# size costs nothing.
+_DYN_SENTINEL = 1000003
 
 
 class VarDesc:

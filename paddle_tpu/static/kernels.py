@@ -303,8 +303,11 @@ def _split(ins, attrs, ctx):
     num = attrs.get("num", 0)
     sections = attrs.get("sections")
     if sections:
-        idx = list(jnp.cumsum(jnp.array(sections[:-1])))
-        outs = jnp.split(x, [int(i) for i in idx], axis=axis)
+        idx, acc = [], 0
+        for s in sections[:-1]:
+            acc += int(s)
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
     else:
         outs = jnp.split(x, num, axis=axis)
     return _out(*outs)
@@ -328,7 +331,7 @@ def _unsqueeze(ins, attrs, ctx):
 
 @kernel("slice")
 def _slice(ins, attrs, ctx):
-    x = _x(ins)
+    x = ins.get("Input", ins.get("X"))[0]
     idx = [slice(None)] * x.ndim
     for ax, st, en in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
         idx[ax] = slice(st, en if en < 2 ** 31 - 1 else None)
@@ -378,8 +381,9 @@ def _one_hot(ins, attrs, ctx):
 
 @kernel("arg_max")
 def _arg_max(ins, attrs, ctx):
+    # reference arg_max outputs int64 (truncates to int32 without x64)
     return _out(jnp.argmax(_x(ins), axis=attrs.get("axis", -1))
-                .astype(jnp.int64 if False else jnp.int32))
+                .astype(jnp.int64))
 
 
 @kernel("top_k_v2")
@@ -466,10 +470,12 @@ def _softmax_ce(ins, attrs, ctx):
 @kernel("accuracy")
 def _accuracy(ins, attrs, ctx):
     pred, label = _x(ins, "Out"), ins["Label"][0]
-    top1 = jnp.argmax(pred, axis=-1)
-    lab = label.reshape(top1.shape).astype(top1.dtype)
-    correct = jnp.sum(top1 == lab)
-    total = top1.shape[0]
+    k = attrs.get("k", 1)
+    _, topk_idx = jax.lax.top_k(pred, k)
+    lab = label.reshape(pred.shape[0], 1).astype(topk_idx.dtype)
+    hit = jnp.any(topk_idx == lab, axis=-1)
+    correct = jnp.sum(hit)
+    total = pred.shape[0]
     acc = correct.astype(jnp.float32) / total
     return {"Accuracy": [acc], "Correct": [correct.astype(jnp.int32)],
             "Total": [jnp.asarray(total, jnp.int32)]}

@@ -53,7 +53,9 @@ def _infer_outputs(block: Block, op, out_slots: Dict[str, int]):
     for slot, names in op.outputs.items():
         structs = outs.get(slot, [])
         for name, st in zip(names, structs):
-            shape = tuple(-1 if s == _DYN_SENTINEL else s for s in st.shape)
+            shape = tuple(-1 if (s >= _DYN_SENTINEL and
+                                 s % _DYN_SENTINEL == 0) else s
+                          for s in st.shape)
             if not block.has_var(name):
                 block.create_var(name=name, shape=shape,
                                  dtype=dtype_mod.dtype_name(st.dtype))

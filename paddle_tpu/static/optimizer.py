@@ -33,7 +33,9 @@ class Optimizer:
 
     # -- helpers ----------------------------------------------------------
     def _create_lr_var(self, helper: LayerHelper):
-        if self._lr_var is not None:
+        # cached lr var is only valid within the program it was created in
+        if self._lr_var is not None and \
+                self._lr_var.block.program is helper.main_program:
             return self._lr_var
         name = unique_name.generate(f"{self._name}_lr")
         self._lr_var = self._create_persist(

@@ -1,0 +1,669 @@
+"""Op unit tests via the OpTest harness (reference test strategy §4.1:
+~600 of 862 unittests are op tests of this declarative shape, e.g.
+/root/reference/python/paddle/fluid/tests/unittests/test_elementwise_add_op.py,
+test_softmax_op.py, test_layer_norm_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTestCase
+
+RNG = np.random.RandomState(42)
+
+
+def _f32(*shape):
+    return RNG.uniform(-1, 1, shape).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# elementwise
+# ---------------------------------------------------------------------------
+class TestElementwiseAdd(OpTestCase):
+    op_type = "elementwise_add"
+
+    def test(self):
+        x, y = _f32(3, 4), _f32(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestElementwiseAddBcastAxis(OpTestCase):
+    op_type = "elementwise_add"
+
+    def test(self):
+        # reference axis semantics: y aligned at axis 1 of x
+        x, y = _f32(2, 3, 4), _f32(3)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+
+
+class TestElementwiseMul(OpTestCase):
+    op_type = "elementwise_mul"
+
+    def test(self):
+        x, y = _f32(5, 6), _f32(5, 6)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestElementwiseDiv(OpTestCase):
+    op_type = "elementwise_div"
+
+    def test(self):
+        x = _f32(4, 4)
+        y = _f32(4, 4) + 2.0
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+        self.check_output()
+        self.check_grad(["X"], max_relative_error=0.08)
+
+
+class TestElementwiseMax(OpTestCase):
+    op_type = "elementwise_max"
+
+    def test(self):
+        x, y = _f32(3, 4), _f32(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.maximum(x, y)}
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+class TestMatmul(OpTestCase):
+    op_type = "matmul"
+
+    def test(self):
+        x, y = _f32(4, 5), _f32(5, 3)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Y"])
+
+
+class TestMatmulTranspose(OpTestCase):
+    op_type = "matmul"
+
+    def test(self):
+        x, y = _f32(5, 4), _f32(3, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+        self.check_output(atol=1e-4)
+
+
+class TestMatmulBatched(OpTestCase):
+    op_type = "matmul"
+
+    def test(self):
+        x, y = _f32(2, 4, 5), _f32(2, 5, 3)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.check_output(atol=1e-4)
+
+
+class TestMul(OpTestCase):
+    op_type = "mul"
+
+    def test(self):
+        x, y = _f32(2, 3, 4), _f32(12, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(2, 12) @ y).reshape(2, 5)}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Y"])
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+class TestReduceSum(OpTestCase):
+    op_type = "reduce_sum"
+
+    def test(self):
+        x = _f32(3, 4, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X"])
+
+
+class TestReduceMeanAll(OpTestCase):
+    op_type = "reduce_mean"
+
+    def test(self):
+        x = _f32(4, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.mean())}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestReduceMaxKeepdim(OpTestCase):
+    op_type = "reduce_max"
+
+    def test(self):
+        x = _f32(3, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": True, "reduce_all": False}
+        self.outputs = {"Out": x.max(axis=1, keepdims=True)}
+        self.check_output()
+
+
+class TestSum(OpTestCase):
+    op_type = "sum"
+
+    def test(self):
+        xs = [_f32(3, 4), _f32(3, 4), _f32(3, 4)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+class TestRelu(OpTestCase):
+    op_type = "relu"
+
+    def test(self):
+        x = _f32(4, 5)
+        # keep every element away from the kink so FD is valid
+        x = np.where(np.abs(x) < 0.05, 0.1, x).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.maximum(x, 0)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestTanh(OpTestCase):
+    op_type = "tanh"
+
+    def test(self):
+        x = _f32(4, 5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.tanh(x)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestSigmoid(OpTestCase):
+    op_type = "sigmoid"
+
+    def test(self):
+        x = _f32(4, 5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestGelu(OpTestCase):
+    op_type = "gelu"
+
+    def test(self):
+        x = _f32(4, 5)
+        # exact gelu via math.erf (no scipy dependency)
+        import math
+        erf = np.vectorize(math.erf)
+        want = (x * 0.5 * (1.0 + erf(x / np.sqrt(2.0)))).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": want}
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestLeakyRelu(OpTestCase):
+    op_type = "leaky_relu"
+
+    def test(self):
+        x = _f32(4, 5) + 0.1
+        self.inputs = {"X": x}
+        self.attrs = {"alpha": 0.1}
+        self.outputs = {"Out": np.where(x > 0, x, 0.1 * x)}
+        self.check_output()
+
+
+class TestSqrt(OpTestCase):
+    op_type = "sqrt"
+
+    def test(self):
+        x = np.abs(_f32(3, 4)) + 0.5
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.sqrt(x)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+# ---------------------------------------------------------------------------
+# softmax / losses
+# ---------------------------------------------------------------------------
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestSoftmax(OpTestCase):
+    op_type = "softmax"
+
+    def test(self):
+        x = _f32(4, 7)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": _np_softmax(x)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestLogSoftmax(OpTestCase):
+    op_type = "log_softmax"
+
+    def test(self):
+        x = _f32(4, 7)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.log(_np_softmax(x))}
+        self.check_output()
+
+
+class TestCrossEntropy(OpTestCase):
+    op_type = "cross_entropy"
+
+    def test(self):
+        x = _np_softmax(_f32(5, 4)).astype("float32")
+        label = RNG.randint(0, 4, (5, 1)).astype("int64")
+        want = -np.log(x[np.arange(5), label[:, 0]] + 1e-12).reshape(5, 1)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": want}
+        self.check_output(atol=1e-4)
+
+
+class TestSoftmaxWithCrossEntropy(OpTestCase):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self):
+        logits = _f32(6, 5)
+        label = RNG.randint(0, 5, (6, 1)).astype("int64")
+        sm = _np_softmax(logits)
+        loss = -np.log(sm[np.arange(6), label[:, 0]]).reshape(6, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Logits"], output_slot="Loss")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+class TestLayerNorm(OpTestCase):
+    op_type = "layer_norm"
+
+    def test(self):
+        x = _f32(4, 10)
+        scale, bias = _f32(10) + 1.0, _f32(10)
+        mean = x.mean(axis=1, keepdims=True)
+        var = x.var(axis=1, keepdims=True)
+        want = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+        self.outputs = {"Y": want, "Mean": mean.squeeze(),
+                        "Variance": var.squeeze()}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Scale"], output_slot="Y",
+                        max_relative_error=0.08)
+
+
+class TestBatchNormInference(OpTestCase):
+    op_type = "batch_norm"
+
+    def test(self):
+        x = _f32(4, 3, 5, 5)
+        scale, bias = _f32(3) + 1.0, _f32(3)
+        mean, var = _f32(3) * 0.1, np.abs(_f32(3)) + 1.0
+        sh = (1, 3, 1, 1)
+        want = ((x - mean.reshape(sh)) / np.sqrt(var.reshape(sh) + 1e-5)
+                * scale.reshape(sh) + bias.reshape(sh))
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"is_test": True, "epsilon": 1e-5}
+        self.outputs = {"Y": want}
+        self.check_output(atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+class TestConv2d(OpTestCase):
+    op_type = "conv2d"
+
+    def test(self):
+        x = _f32(2, 3, 5, 5)
+        w = _f32(4, 3, 3, 3)
+        # numpy reference conv (stride 1, pad 1)
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        want = np.zeros((2, 4, 5, 5), np.float32)
+        for n in range(2):
+            for o in range(4):
+                for i in range(5):
+                    for j in range(5):
+                        want[n, o, i, j] = np.sum(
+                            xp[n, :, i:i + 3, j:j + 3] * w[o])
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": want}
+        self.check_output(atol=1e-3)
+        self.check_grad(["Input", "Filter"], output_slot="Output",
+                        max_relative_error=0.08)
+
+
+class TestPool2dMax(OpTestCase):
+    op_type = "pool2d"
+
+    def test(self):
+        x = _f32(2, 3, 4, 4)
+        want = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0], "pooling_type": "max"}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestPool2dAvg(OpTestCase):
+    op_type = "pool2d"
+
+    def test(self):
+        x = _f32(2, 3, 4, 4)
+        want = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0], "pooling_type": "avg"}
+        self.outputs = {"Out": want}
+        self.check_output(atol=1e-5)
+
+
+class TestPool2dGlobal(OpTestCase):
+    op_type = "pool2d"
+
+    def test(self):
+        x = _f32(2, 3, 4, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [1, 1], "global_pooling": True,
+                      "pooling_type": "avg"}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+        self.check_output(atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+class TestReshape(OpTestCase):
+    op_type = "reshape2"
+
+    def test(self):
+        x = _f32(2, 3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, 12]}  # 0 copies dim, paddle semantics
+        self.outputs = {"Out": x.reshape(2, 12)}
+        self.check_output()
+
+
+class TestTranspose(OpTestCase):
+    op_type = "transpose2"
+
+    def test(self):
+        x = _f32(2, 3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [2, 0, 1]}
+        self.outputs = {"Out": x.transpose(2, 0, 1)}
+        self.check_output()
+
+
+class TestConcat(OpTestCase):
+    op_type = "concat"
+
+    def test(self):
+        xs = [_f32(2, 3), _f32(2, 5)]
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate(xs, axis=1)}
+        self.check_output()
+
+
+class TestSplit(OpTestCase):
+    op_type = "split"
+
+    def test(self):
+        x = _f32(2, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"num": 3, "axis": 1}
+        self.outputs = {"Out": list(np.split(x, 3, axis=1))}
+        self.check_output()
+
+
+class TestSplitSections(OpTestCase):
+    op_type = "split"
+
+    def test(self):
+        x = _f32(2, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"sections": [1, 2, 3], "axis": 1}
+        self.outputs = {"Out": [x[:, :1], x[:, 1:3], x[:, 3:]]}
+        self.check_output()
+
+
+class TestSqueeze(OpTestCase):
+    op_type = "squeeze2"
+
+    def test(self):
+        x = _f32(2, 1, 3)
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [1]}
+        self.outputs = {"Out": x.squeeze(1)}
+        self.check_output()
+
+
+class TestUnsqueeze(OpTestCase):
+    op_type = "unsqueeze2"
+
+    def test(self):
+        x = _f32(2, 3)
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [0, 3]}
+        self.outputs = {"Out": x.reshape(1, 2, 3, 1)}
+        self.check_output()
+
+
+class TestStack(OpTestCase):
+    op_type = "stack"
+
+    def test(self):
+        xs = [_f32(2, 3), _f32(2, 3)]
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 0}
+        self.outputs = {"Y": np.stack(xs)}
+        self.check_output()
+
+
+class TestSlice(OpTestCase):
+    op_type = "slice"
+
+    def test(self):
+        x = _f32(4, 5, 6)
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [0, 2], "starts": [1, 2], "ends": [3, 5]}
+        self.outputs = {"Out": x[1:3, :, 2:5]}
+        self.check_output()
+
+
+class TestGather(OpTestCase):
+    op_type = "gather"
+
+    def test(self):
+        x = _f32(5, 3)
+        idx = np.array([0, 2, 4], dtype="int32")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+        self.check_output()
+
+
+class TestOneHot(OpTestCase):
+    op_type = "one_hot_v2"
+
+    def test(self):
+        x = np.array([0, 2, 1], dtype="int32")
+        want = np.eye(4, dtype="float32")[x]
+        self.inputs = {"X": x}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestLookupTable(OpTestCase):
+    op_type = "lookup_table_v2"
+
+    def test(self):
+        w = _f32(10, 4)
+        ids = np.array([[1, 3], [5, 0]], dtype="int32")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids]}
+        self.check_output()
+        self.check_grad(["W"])
+
+
+class TestTopK(OpTestCase):
+    op_type = "top_k_v2"
+
+    def test(self):
+        x = _f32(3, 6)
+        idx = np.argsort(-x, axis=1)[:, :2]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        self.outputs = {"Out": vals, "Indices": idx.astype("int32")}
+        self.check_output()
+
+
+class TestCast(OpTestCase):
+    op_type = "cast"
+
+    def test(self):
+        x = _f32(3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+        self.check_output()
+
+
+class TestScale(OpTestCase):
+    op_type = "scale"
+
+    def test(self):
+        x = _f32(3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0, "bias_after_scale": True}
+        self.outputs = {"Out": 2.5 * x + 1.0}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestClip(OpTestCase):
+    op_type = "clip"
+
+    def test(self):
+        x = _f32(3, 4) * 2
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.outputs = {"Out": np.clip(x, -0.5, 0.5)}
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops vs numpy (reference test_sgd_op.py / test_adam_op.py)
+# ---------------------------------------------------------------------------
+class TestSGDOp(OpTestCase):
+    op_type = "sgd"
+
+    def test(self):
+        p, g = _f32(5, 3), _f32(5, 3)
+        lr = np.array([0.1], dtype="float32")
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+        self.check_output()
+
+
+class TestMomentumOp(OpTestCase):
+    op_type = "momentum"
+
+    def test(self):
+        p, g, v = _f32(4, 3), _f32(4, 3), _f32(4, 3)
+        lr = np.array([0.01], dtype="float32")
+        v_new = 0.9 * v + g
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": 0.9}
+        self.outputs = {"ParamOut": p - 0.01 * v_new,
+                        "VelocityOut": v_new}
+        self.check_output()
+
+
+class TestAdamOp(OpTestCase):
+    op_type = "adam"
+
+    def test(self):
+        p, g = _f32(4, 3), _f32(4, 3)
+        m, v = _f32(4, 3) * 0.1, np.abs(_f32(4, 3)) * 0.1
+        b1p = np.array([0.9], dtype="float32")
+        b2p = np.array([0.999], dtype="float32")
+        lr = np.array([0.001], dtype="float32")
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+        p_new = p - lr_t * m_new / (np.sqrt(v_new) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m, "Moment2": v,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": p_new, "Moment1Out": m_new,
+                        "Moment2Out": v_new,
+                        "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+        self.check_output(atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# dropout determinism & test-mode
+# ---------------------------------------------------------------------------
+class TestDropoutTestMode(OpTestCase):
+    op_type = "dropout"
+
+    def test(self):
+        x = _f32(4, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.5, "is_test": True}
+        self.outputs = {"Out": x, "Mask": np.ones_like(x)}
+        self.check_output()
+
+
+def test_dropout_train_mode_stats():
+    """Train-mode dropout: ~p zeros, survivors upscaled by 1/(1-p)."""
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [1000, 100])
+        out = static.nn.dropout(x, dropout_prob=0.3)
+    exe = static.Executor()
+    xv = np.ones((1000, 100), dtype="float32")
+    res, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    zero_frac = float((res == 0).mean())
+    assert abs(zero_frac - 0.3) < 0.02, zero_frac
+    nz = res[res != 0]
+    np.testing.assert_allclose(nz, 1.0 / 0.7, rtol=1e-5)

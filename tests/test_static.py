@@ -161,6 +161,58 @@ def test_calc_gradient():
     np.testing.assert_allclose(out, 2 * xv, rtol=1e-5)
 
 
+def test_calc_gradient_wrt_intermediate():
+    """d loss/d h where h is produced by an op (not a feed var): the
+    injected free input must survive its producer re-running."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3])
+        h = static.scale(x, 3.0)          # h = 3x (h is op-produced)
+        loss = static.reduce_sum(h * h)   # d loss/d h = 2h
+        (gh,) = static.calc_gradient(loss, [h])
+    exe = static.Executor()
+    xv = np.arange(12, dtype="float32").reshape(4, 3)
+    out, = exe.run(main, feed={"x": xv}, fetch_list=[gh])
+    np.testing.assert_allclose(out, 2 * 3 * xv, rtol=1e-5)
+
+
+def test_calc_gradient_multi_targets_cotangents():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [3])
+        y1 = static.scale(x, 2.0)
+        y2 = x * x
+        tg = static.data("tg", [3])
+        (gx,) = static.calc_gradient([y1, y2], [x],
+                                     target_gradients=[None, tg])
+    exe = static.Executor()
+    xv = np.array([1., 2., 3.], "float32")
+    tgv = np.array([10., 20., 30.], "float32")
+    out, = exe.run(main, feed={"x": xv, "tg": tgv}, fetch_list=[gx])
+    # d(sum(2x) + sum(tg*x^2))/dx = 2 + 2*tg*x
+    np.testing.assert_allclose(out, 2 + 2 * tgv * xv, rtol=1e-5)
+
+
+def test_accuracy_topk():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        logits = static.data("logits", [4, 5])
+        label = static.data("label", [4, 1], dtype="int64")
+        acc1 = static.accuracy(logits, label, k=1)
+        acc2 = static.accuracy(logits, label, k=2)
+    exe = static.Executor()
+    lv = np.array([[0.1, 0.9, 0, 0, 0],     # top1=1, top2={1,0}
+                   [0.8, 0.5, 0, 0, 0],     # top1=0, top2={0,1}
+                   [0, 0, 0.3, 0.7, 0],     # top1=3, top2={3,2}
+                   [0, 0, 0, 0.2, 0.6]],    # top1=4, top2={4,3}
+                  dtype="float32")
+    lab = np.array([[1], [1], [2], [0]], dtype="int64")
+    a1, a2 = exe.run(main, feed={"logits": lv, "label": lab},
+                     fetch_list=[acc1, acc2])
+    assert abs(float(a1) - 0.25) < 1e-6   # only row 0 top-1 correct
+    assert abs(float(a2) - 0.75) < 1e-6   # rows 0,1,2 in top-2
+
+
 def test_conv_bn_pool_static():
     main, startup = static.Program(), static.Program()
     with static.program_guard(main, startup):
