@@ -1,0 +1,224 @@
+"""Dataset API: MultiSlot ingestion for CTR/PS-style training.
+
+Reference: /root/reference/python/paddle/fluid/dataset.py
+(DatasetFactory, InMemoryDataset with load_into_memory/local_shuffle/
+global_shuffle, QueueDataset) wrapping the C++ Dataset
+(framework/data_set.h:43). Here the heavy path — parsing, shuffling,
+batch assembly, prefetch queue — runs in the native library
+(paddle_tpu/native/src/datafeed.cc) and falls back to pure python when
+no toolchain exists. Batches come out as numpy: dense slots as
+(batch, dim) arrays, sparse slots as (values, lod-offsets) pairs ready
+for segment-sum embedding lookups.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SlotSpec:
+    def __init__(self, name: str, slot_type: str = "uint64",
+                 dense_dim: Optional[int] = None):
+        assert slot_type in ("float", "uint64"), slot_type
+        self.name = name
+        self.type = slot_type
+        # dense_dim set => fixed-length slot reshaped to (batch, dim)
+        self.dense_dim = dense_dim
+
+
+class DatasetBase:
+    def __init__(self):
+        self._slots: List[SlotSpec] = []
+        self._filelist: List[str] = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._drop_last = False
+
+    # -- reference-parity config setters ---------------------------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, slots):
+        """Accepts SlotSpec list (or (name, type[, dense_dim]) tuples)."""
+        specs = []
+        for s in slots:
+            if isinstance(s, SlotSpec):
+                specs.append(s)
+            else:
+                specs.append(SlotSpec(*s))
+        self._slots = specs
+
+    def slots(self):
+        return list(self._slots)
+
+
+class InMemoryDataset(DatasetBase):
+    """load_into_memory -> local_shuffle -> iterate batches.
+
+    Iteration yields {slot_name: array | (values, lod)} dicts.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._native = None
+        self._handle = None
+        self._py_records = None  # fallback storage
+
+    def _types_str(self):
+        return "".join("f" if s.type == "float" else "u"
+                       for s in self._slots)
+
+    # -- loading ----------------------------------------------------------
+    def load_into_memory(self):
+        from ..native import datafeed_lib
+
+        lib = datafeed_lib()
+        if lib is not None:
+            self._native = lib
+            if self._handle is None:
+                self._handle = ctypes.c_void_p(
+                    lib.pt_dataset_new(self._types_str().encode()))
+            for path in self._filelist:
+                n = lib.pt_dataset_load_file(self._handle, path.encode(),
+                                             self._thread_num)
+                if n < 0:
+                    raise IOError(f"failed to parse MultiSlot file {path}")
+        else:
+            self._py_records = []
+            for path in self._filelist:
+                self._py_records.extend(self._py_parse(path))
+
+    def _py_parse(self, path):
+        records = []
+        with open(path) as f:
+            for line in f:
+                toks = line.split()
+                if not toks:
+                    continue
+                i, rec = 0, []
+                for s in self._slots:
+                    cnt = int(toks[i]); i += 1
+                    vals = toks[i:i + cnt]; i += cnt
+                    if s.type == "float":
+                        rec.append(np.array(vals, dtype=np.float32))
+                    else:
+                        rec.append(np.array(vals, dtype=np.uint64))
+                records.append(rec)
+        return records
+
+    # -- shuffle ----------------------------------------------------------
+    def local_shuffle(self, seed=0):
+        if self._native is not None:
+            self._native.pt_dataset_shuffle(self._handle, seed)
+        elif self._py_records is not None:
+            np.random.RandomState(seed).shuffle(self._py_records)
+
+    def global_shuffle(self, fleet=None, seed=0):
+        """Single-host build: equivalent to local_shuffle. (The reference
+        redistributes records across trainers over RPC, data_set.h:111;
+        multi-host ingestion here shards files per host instead — see
+        distributed.launch.)"""
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self):
+        if self._native is not None:
+            return int(self._native.pt_dataset_size(self._handle))
+        return len(self._py_records or [])
+
+    def release_memory(self):
+        if self._native is not None:
+            self._native.pt_dataset_clear(self._handle)
+        self._py_records = None
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        if self._native is not None:
+            return self._iter_native()
+        return self._iter_py()
+
+    def _iter_native(self):
+        lib, h = self._native, self._handle
+        lib.pt_dataset_start(h, self._batch_size, int(self._drop_last))
+        while lib.pt_dataset_next(h):
+            rows = lib.pt_batch_rows(h)
+            out = {}
+            for i, s in enumerate(self._slots):
+                n = lib.pt_batch_slot_size(h, i)
+                lod = np.empty(rows + 1, dtype=np.int64)
+                lib.pt_batch_lod(h, i, lod.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)))
+                if s.type == "float":
+                    vals = np.empty(n, dtype=np.float32)
+                    if n:
+                        lib.pt_batch_slot_fvalues(h, i, vals.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_float)))
+                else:
+                    vals = np.empty(n, dtype=np.uint64)
+                    if n:
+                        lib.pt_batch_slot_uvalues(h, i, vals.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint64)))
+                out[s.name] = self._present(s, vals, lod, rows)
+            yield out
+
+    def _iter_py(self):
+        recs = self._py_records or []
+        bs = self._batch_size
+        for lo in range(0, len(recs), bs):
+            chunk = recs[lo:lo + bs]
+            if self._drop_last and len(chunk) < bs:
+                break
+            out = {}
+            for i, s in enumerate(self._slots):
+                vals = np.concatenate([r[i] for r in chunk]) if chunk \
+                    else np.empty(0)
+                lod = np.zeros(len(chunk) + 1, dtype=np.int64)
+                for j, r in enumerate(chunk):
+                    lod[j + 1] = lod[j] + len(r[i])
+                out[s.name] = self._present(s, vals, lod, len(chunk))
+            yield out
+
+    @staticmethod
+    def _present(spec: SlotSpec, vals, lod, rows):
+        if spec.dense_dim is not None:
+            return vals.reshape(rows, spec.dense_dim)
+        return vals, lod
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming flavor (reference QueueDataset): no global residence
+    required. This build loads per-file lazily at iteration time."""
+
+    def __iter__(self):
+        if not self._filelist:
+            return iter(())
+        return self._stream()
+
+    def _stream(self):
+        files = self._filelist
+        for path in files:
+            self._filelist = [path]
+            if self._native is not None and self._handle is not None:
+                self._native.pt_dataset_clear(self._handle)
+            self._py_records = None
+            self.load_into_memory()
+            yield from super().__iter__()
+        self._filelist = files
+
+
+class DatasetFactory:
+    """Reference dataset.py DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")

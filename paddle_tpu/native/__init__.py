@@ -1,0 +1,96 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference framework's runtime around the compute path is C++
+(/root/reference/paddle/fluid/framework/data_feed.cc, data_set.cc,
+operators/reader/lod_tensor_blocking_queue.h). This package holds the
+TPU build's native equivalents: sources in src/, compiled on first use
+with g++ into build/ (content-hash keyed, so rebuilds happen only when
+sources change). Python falls back to pure-python implementations when a
+toolchain is unavailable (e.g. wheels on a machine without g++) — same
+API, lower throughput.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_BUILD = os.path.join(_HERE, "build")
+
+_lock = threading.Lock()
+_libs = {}
+
+
+def _source_hash(src_path: str) -> str:
+    with open(src_path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def load_library(name: str):
+    """Compile (if needed) and dlopen src/<name>.cc. Returns None when no
+    toolchain is available; callers must degrade to their python path."""
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        src = os.path.join(_SRC, f"{name}.cc")
+        if not os.path.exists(src):
+            _libs[name] = None
+            return None
+        tag = _source_hash(src)
+        out = os.path.join(_BUILD, f"lib{name}-{tag}.so")
+        if not os.path.exists(out):
+            os.makedirs(_BUILD, exist_ok=True)
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", "-o", out + ".tmp", src]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=300)
+                os.replace(out + ".tmp", out)
+            except (subprocess.CalledProcessError, OSError,
+                    subprocess.TimeoutExpired) as e:
+                msg = getattr(e, "stderr", b"")
+                import warnings
+                warnings.warn(
+                    f"native build of {name} failed, using python fallback"
+                    f": {msg[:500] if msg else e}")
+                _libs[name] = None
+                return None
+        try:
+            _libs[name] = ctypes.CDLL(out)
+        except OSError:
+            _libs[name] = None
+        return _libs[name]
+
+
+def datafeed_lib():
+    lib = load_library("datafeed")
+    if lib is not None and not getattr(lib, "_pt_typed", False):
+        c = ctypes
+        lib.pt_dataset_new.restype = c.c_void_p
+        lib.pt_dataset_new.argtypes = [c.c_char_p]
+        lib.pt_dataset_free.argtypes = [c.c_void_p]
+        lib.pt_dataset_load_file.restype = c.c_int64
+        lib.pt_dataset_load_file.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        lib.pt_dataset_shuffle.argtypes = [c.c_void_p, c.c_uint64]
+        lib.pt_dataset_size.restype = c.c_int64
+        lib.pt_dataset_size.argtypes = [c.c_void_p]
+        lib.pt_dataset_clear.argtypes = [c.c_void_p]
+        lib.pt_dataset_start.argtypes = [c.c_void_p, c.c_int64, c.c_int]
+        lib.pt_dataset_next.restype = c.c_int
+        lib.pt_dataset_next.argtypes = [c.c_void_p]
+        lib.pt_batch_rows.restype = c.c_int64
+        lib.pt_batch_rows.argtypes = [c.c_void_p]
+        lib.pt_batch_slot_size.restype = c.c_int64
+        lib.pt_batch_slot_size.argtypes = [c.c_void_p, c.c_int]
+        lib.pt_batch_slot_fvalues.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.c_float)]
+        lib.pt_batch_slot_uvalues.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.c_uint64)]
+        lib.pt_batch_lod.argtypes = [c.c_void_p, c.c_int,
+                                     c.POINTER(c.c_int64)]
+        lib._pt_typed = True
+    return lib

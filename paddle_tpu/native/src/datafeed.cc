@@ -1,0 +1,335 @@
+// Native data-ingestion runtime: MultiSlot parser + in-memory dataset +
+// prefetching batch builder behind a bounded blocking queue.
+//
+// TPU-native counterpart of the reference C++ DataFeed/Dataset stack
+// (/root/reference/paddle/fluid/framework/data_feed.h:108 DataFeed,
+// :650 MultiSlotDataFeed, :668 MultiSlotInMemoryDataFeed;
+// data_set.h:43 Dataset with LoadIntoMemory/LocalShuffle;
+// operators/reader/lod_tensor_blocking_queue.h). Same responsibilities —
+// multi-threaded text parsing, record shuffle, background batch assembly —
+// redesigned around a flat C ABI consumed from Python via ctypes (the
+// reference uses pybind11), producing dense arrays + LoD offsets ready to
+// wrap as numpy/jax buffers.
+//
+// MultiSlot text format (reference data_feed.cc MultiSlotDataFeed::
+// ParseOneInstance): one example per line; for each slot in order:
+//   <count> <v_1> ... <v_count>
+// where values are floats for "float" slots and uint64 ids for "uint64"
+// slots.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotDef {
+  bool is_float;
+};
+
+// One parsed example: flattened values + per-slot length.
+struct Record {
+  std::vector<float> fvals;
+  std::vector<uint64_t> uvals;
+  std::vector<uint32_t> lens;  // per slot
+};
+
+struct Batch {
+  int64_t rows = 0;
+  // per slot: concatenated values + offsets (rows+1)
+  std::vector<std::vector<float>> fdata;
+  std::vector<std::vector<uint64_t>> udata;
+  std::vector<std::vector<int64_t>> lod;
+};
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t cap) : cap_(cap) {}
+
+  void Push(std::unique_ptr<Batch> b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return;
+    q_.push(std::move(b));
+    not_empty_.notify_one();
+  }
+
+  // returns nullptr when closed and drained
+  std::unique_ptr<Batch> Pop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty() || closed_; });
+    if (q_.empty()) return nullptr;
+    auto b = std::move(q_.front());
+    q_.pop();
+    not_full_.notify_one();
+    return b;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  void Reset() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+    while (!q_.empty()) q_.pop();
+  }
+
+ private:
+  size_t cap_;
+  bool closed_ = false;
+  std::queue<std::unique_ptr<Batch>> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+};
+
+class Dataset {
+ public:
+  explicit Dataset(const std::string& types) : queue_(4) {
+    for (char c : types) slots_.push_back({c == 'f'});
+  }
+
+  ~Dataset() { StopBuilder(); }
+
+  // multi-threaded load: split lines into shards, parse in parallel
+  // (reference data_set.cc DatasetImpl::LoadIntoMemory spawns
+  // load_thread_num_ threads over the filelist)
+  int64_t LoadFile(const std::string& path, int n_threads) {
+    std::ifstream in(path, std::ios::binary);
+    if (!in) return -1;
+    std::string content((std::istreambuf_iterator<char>(in)),
+                        std::istreambuf_iterator<char>());
+    std::vector<std::pair<const char*, const char*>> lines;
+    const char* p = content.data();
+    const char* end = p + content.size();
+    while (p < end) {
+      const char* nl = static_cast<const char*>(
+          memchr(p, '\n', static_cast<size_t>(end - p)));
+      const char* stop = nl ? nl : end;
+      if (stop > p) lines.emplace_back(p, stop);
+      p = nl ? nl + 1 : end;
+    }
+    if (n_threads < 1) n_threads = 1;
+    size_t n = lines.size();
+    std::vector<std::vector<Record>> shards(
+        static_cast<size_t>(n_threads));
+    std::vector<std::thread> workers;
+    std::atomic<bool> ok{true};
+    size_t per = (n + static_cast<size_t>(n_threads) - 1) /
+                 static_cast<size_t>(n_threads);
+    for (int t = 0; t < n_threads; ++t) {
+      workers.emplace_back([&, t] {
+        size_t lo = static_cast<size_t>(t) * per;
+        size_t hi = std::min(n, lo + per);
+        auto& out = shards[static_cast<size_t>(t)];
+        out.reserve(hi > lo ? hi - lo : 0);
+        for (size_t i = lo; i < hi && ok.load(); ++i) {
+          Record r;
+          if (!ParseLine(lines[i].first, lines[i].second, &r)) {
+            ok.store(false);
+            return;
+          }
+          out.push_back(std::move(r));
+        }
+      });
+    }
+    for (auto& w : workers) w.join();
+    if (!ok.load()) return -1;
+    int64_t added = 0;
+    for (auto& s : shards) {
+      added += static_cast<int64_t>(s.size());
+      for (auto& r : s) records_.push_back(std::move(r));
+    }
+    return added;
+  }
+
+  void Shuffle(uint64_t seed) {
+    std::mt19937_64 rng(seed);
+    std::shuffle(records_.begin(), records_.end(), rng);
+  }
+
+  int64_t Size() const { return static_cast<int64_t>(records_.size()); }
+
+  void Clear() { records_.clear(); }
+
+  // spawn the background batch builder (reference: DataFeed threads
+  // feeding LoDTensorBlockingQueue)
+  void Start(int64_t batch_size, bool drop_last) {
+    StopBuilder();
+    queue_.Reset();
+    builder_ = std::thread([this, batch_size, drop_last] {
+      size_t n = records_.size();
+      for (size_t lo = 0; lo < n; lo += static_cast<size_t>(batch_size)) {
+        size_t hi = std::min(n, lo + static_cast<size_t>(batch_size));
+        if (drop_last && hi - lo < static_cast<size_t>(batch_size)) break;
+        auto b = BuildBatch(lo, hi);
+        queue_.Push(std::move(b));
+      }
+      queue_.Close();
+    });
+  }
+
+  // blocks until a batch is ready; false = epoch done
+  bool Next() {
+    current_ = queue_.Pop();
+    return current_ != nullptr;
+  }
+
+  const Batch* current() const { return current_.get(); }
+  size_t n_slots() const { return slots_.size(); }
+  bool slot_is_float(int i) const {
+    return slots_[static_cast<size_t>(i)].is_float;
+  }
+
+ private:
+  void StopBuilder() {
+    queue_.Close();
+    if (builder_.joinable()) builder_.join();
+  }
+
+  bool ParseLine(const char* p, const char* end, Record* r) {
+    r->lens.resize(slots_.size());
+    char* next = nullptr;
+    for (size_t s = 0; s < slots_.size(); ++s) {
+      long cnt = strtol(p, &next, 10);
+      if (next == p || cnt < 0) return false;
+      p = next;
+      r->lens[s] = static_cast<uint32_t>(cnt);
+      for (long i = 0; i < cnt; ++i) {
+        if (slots_[s].is_float) {
+          float v = strtof(p, &next);
+          if (next == p) return false;
+          r->fvals.push_back(v);
+        } else {
+          uint64_t v = strtoull(p, &next, 10);
+          if (next == p) return false;
+          r->uvals.push_back(v);
+        }
+        p = next;
+      }
+      (void)end;
+    }
+    return true;
+  }
+
+  std::unique_ptr<Batch> BuildBatch(size_t lo, size_t hi) {
+    auto b = std::make_unique<Batch>();
+    size_t ns = slots_.size();
+    b->rows = static_cast<int64_t>(hi - lo);
+    b->fdata.resize(ns);
+    b->udata.resize(ns);
+    b->lod.assign(ns, std::vector<int64_t>(1, 0));
+    for (size_t i = lo; i < hi; ++i) {
+      const Record& r = records_[i];
+      size_t foff = 0, uoff = 0;
+      for (size_t s = 0; s < ns; ++s) {
+        uint32_t len = r.lens[s];
+        if (slots_[s].is_float) {
+          b->fdata[s].insert(b->fdata[s].end(), r.fvals.begin() +
+                             static_cast<long>(foff),
+                             r.fvals.begin() +
+                             static_cast<long>(foff + len));
+          foff += len;
+        } else {
+          b->udata[s].insert(b->udata[s].end(), r.uvals.begin() +
+                             static_cast<long>(uoff),
+                             r.uvals.begin() +
+                             static_cast<long>(uoff + len));
+          uoff += len;
+        }
+        b->lod[s].push_back(b->lod[s].back() + len);
+      }
+    }
+    return b;
+  }
+
+  std::vector<SlotDef> slots_;
+  std::vector<Record> records_;
+  BlockingQueue queue_;
+  std::thread builder_;
+  std::unique_ptr<Batch> current_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_dataset_new(const char* types) {
+  return new Dataset(types ? types : "");
+}
+
+void pt_dataset_free(void* h) { delete static_cast<Dataset*>(h); }
+
+int64_t pt_dataset_load_file(void* h, const char* path, int n_threads) {
+  return static_cast<Dataset*>(h)->LoadFile(path, n_threads);
+}
+
+void pt_dataset_shuffle(void* h, uint64_t seed) {
+  static_cast<Dataset*>(h)->Shuffle(seed);
+}
+
+int64_t pt_dataset_size(void* h) {
+  return static_cast<Dataset*>(h)->Size();
+}
+
+void pt_dataset_clear(void* h) { static_cast<Dataset*>(h)->Clear(); }
+
+void pt_dataset_start(void* h, int64_t batch_size, int drop_last) {
+  static_cast<Dataset*>(h)->Start(batch_size, drop_last != 0);
+}
+
+int pt_dataset_next(void* h) {
+  return static_cast<Dataset*>(h)->Next() ? 1 : 0;
+}
+
+int64_t pt_batch_rows(void* h) {
+  const Batch* b = static_cast<Dataset*>(h)->current();
+  return b ? b->rows : 0;
+}
+
+int64_t pt_batch_slot_size(void* h, int slot) {
+  const Batch* b = static_cast<Dataset*>(h)->current();
+  if (!b) return 0;
+  auto* d = static_cast<Dataset*>(h);
+  size_t s = static_cast<size_t>(slot);
+  return d->slot_is_float(slot)
+             ? static_cast<int64_t>(b->fdata[s].size())
+             : static_cast<int64_t>(b->udata[s].size());
+}
+
+void pt_batch_slot_fvalues(void* h, int slot, float* out) {
+  const Batch* b = static_cast<Dataset*>(h)->current();
+  if (!b) return;
+  const auto& v = b->fdata[static_cast<size_t>(slot)];
+  memcpy(out, v.data(), v.size() * sizeof(float));
+}
+
+void pt_batch_slot_uvalues(void* h, int slot, uint64_t* out) {
+  const Batch* b = static_cast<Dataset*>(h)->current();
+  if (!b) return;
+  const auto& v = b->udata[static_cast<size_t>(slot)];
+  memcpy(out, v.data(), v.size() * sizeof(uint64_t));
+}
+
+void pt_batch_lod(void* h, int slot, int64_t* out) {
+  const Batch* b = static_cast<Dataset*>(h)->current();
+  if (!b) return;
+  const auto& v = b->lod[static_cast<size_t>(slot)];
+  memcpy(out, v.data(), v.size() * sizeof(int64_t));
+}
+
+}  // extern "C"

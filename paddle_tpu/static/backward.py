@@ -129,11 +129,15 @@ def calc_gradient(targets, inputs, target_gradients=None):
         "target_gradients must match targets"
 
     block = targets[0].block
-    parts = []
-    for t, tg in zip(targets, target_gradients):
-        parts.append(layers.reduce_sum(t if tg is None
-                                       else layers.elementwise_mul(t, tg)))
-    total = parts[0] if len(parts) == 1 else layers.sums(parts)
+    # the folding ops must land in the targets' program even when called
+    # outside its program_guard
+    from .ir import program_guard
+    with program_guard(block.program):
+        parts = []
+        for t, tg in zip(targets, target_gradients):
+            parts.append(layers.reduce_sum(
+                t if tg is None else layers.elementwise_mul(t, tg)))
+        total = parts[0] if len(parts) == 1 else layers.sums(parts)
 
     grad_names = []
     for v in inputs:

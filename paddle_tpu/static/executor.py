@@ -135,8 +135,11 @@ class Executor:
     """exe = Executor(place); exe.run(program, feed=..., fetch_list=...)."""
 
     def __init__(self, place=None):
+        import weakref
         self.place = place if place is not None else CPUPlace()
-        self._cache: Dict[Any, Any] = {}
+        # per-program compiled cache: entries die with their Program (no
+        # id() aliasing, no pinning of dead programs)
+        self._cache = weakref.WeakKeyDictionary()
         self._step = 0
 
     def close(self):
@@ -172,18 +175,13 @@ class Executor:
             n for n, v in block.vars.items()
             if v.persistable and scope.find_var(n) is not None)
         # shape/dtype only — never materialize device arrays for the key
-        sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
-                           for k, v in feed.items()))
-        key = (id(program), program._version, sig,
+        key = (program._version, _feed_signature(feed),
                tuple(fetch_names), tuple(persist_names), bool(sharding))
-
-        if not use_program_cache or key not in self._cache:
-            # hold a strong ref to the program: keyed by id(), a collected
-            # Program's id can be reused and alias a stale executable
-            self._cache[key] = (self._build(program, block, feed,
-                                            fetch_names, persist_names,
-                                            sharding), program)
-        compiled, _ = self._cache[key]
+        per_prog = self._cache.setdefault(program, {})
+        if not use_program_cache or key not in per_prog:
+            per_prog[key] = self._build(program, block, feed, fetch_names,
+                                        persist_names, sharding)
+        compiled = per_prog[key]
 
         state = [scope.find_var(n) for n in persist_names]
         seed = program.random_seed or random_mod.default_generator().initial_seed()

@@ -81,7 +81,6 @@ class OpTestCase:
         inputs_to_check: feed var names, `slot` or `slot_i` style (the
         i-th array of a slot; bare slot means index 0).
         """
-        ins = self._norm(self.inputs)
         main, startup, feed, out_slots, expected = self._build()
         out_name = out_slots[output_slot][output_index]
         check_names = []
