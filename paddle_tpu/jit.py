@@ -143,7 +143,7 @@ class TrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  seed: int = 0, donate: bool = True, mesh=None,
                  param_rules=None, data_axes=("dp", "data"),
-                 data_spec=None):
+                 data_spec=None, sequence_parallel=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -155,6 +155,12 @@ class TrainStep:
         self._param_rules = param_rules
         self._data_axes = data_axes
         self._data_spec = data_spec  # explicit PartitionSpec for batch leaves
+        # "sp" / (axis, impl): bake ring/Ulysses context-parallel attention
+        # into the traced step (deterministic, unlike the dynamic
+        # sequence_parallel() scope — see parallel/ring.py module note)
+        if isinstance(sequence_parallel, str):
+            sequence_parallel = (sequence_parallel, "ring")
+        self._sequence_parallel = sequence_parallel
         self._placed = False
 
     def _place_spmd(self, params, buffers, batch_arrays):
@@ -213,8 +219,14 @@ class TrainStep:
                 saved_b = {n: b._value for n, b in model.named_buffers()}
                 model.load_param_pytree(params)
                 model.load_buffer_pytree(buffers)
+                from contextlib import nullcontext
+
+                from .parallel.ring import sequence_parallel as _sp_scope
+
+                sp_ctx = (_sp_scope(*self._sequence_parallel)
+                          if self._sequence_parallel else nullcontext())
                 try:
-                    with tape_mod.no_grad(), rng_scope(key):
+                    with tape_mod.no_grad(), rng_scope(key), sp_ctx:
                         out = loss_fn(model, *[_wrap_in(b) for b in batch])
                     loss = out[0] if isinstance(out, (tuple, list)) else out
                     aux = out[1:] if isinstance(out, (tuple, list)) else ()

@@ -133,6 +133,18 @@ def _pallas_ok(q, k, causal):
 
 def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
                                 is_causal=False, key_rng=None):
+    if mask is None and dropout_p == 0.0:
+        # context parallelism: shard the sequence axis over the mesh
+        # (ring / Ulysses attention) when a sequence_parallel() scope is on;
+        # ring_attention falls back to XLA attention for non-dividing shapes
+        from ...parallel.ring import active_sequence_parallel, ring_attention
+
+        sp = active_sequence_parallel()
+        if sp is not None:
+            axis, impl, batch_axis = sp
+            return ring_attention(q, k, v, seq_axis=axis,
+                                  batch_axis=batch_axis,
+                                  is_causal=is_causal, impl=impl)
     if mask is None and dropout_p == 0.0 and _pallas_ok(q, k, is_causal):
         try:
             return _flash_attention_pallas(q, k, v, causal=is_causal)

@@ -7,3 +7,7 @@ from .mesh import (  # noqa: F401
 from .sharding import (  # noqa: F401
     shard_params, place_params, spec_for, TRANSFORMER_TP_RULES,
 )
+from .ring import (  # noqa: F401
+    ring_attention, ulysses_attention, ring_attention_local,
+    ulysses_attention_local, sequence_parallel, active_sequence_parallel,
+)

@@ -1,0 +1,225 @@
+"""Sequence/context parallelism: ring attention and Ulysses (all-to-all).
+
+The reference framework predates sequence parallelism entirely (SURVEY §2.6:
+TP/SP/CP/ring attention absent; long sequences were handled only via
+recompute, /root/reference/python/paddle/fluid/backward.py:629, and pipeline
+micro-batching, /root/reference/paddle/fluid/framework/section_worker.cc).
+This module is the TPU-first design for that gap: the sequence axis of
+q/k/v is sharded over a named mesh axis, and
+
+- **ring attention**: every device keeps its local Q block resident and
+  streams K/V blocks around the ring with `lax.ppermute` (ICI
+  neighbour-exchange), combining partial results with a numerically stable
+  online softmax — flash attention across chips.
+- **Ulysses**: `lax.all_to_all` re-shards (seq-sharded, all heads) ->
+  (full seq, head-sharded), runs ordinary attention locally per head group,
+  and re-shards back. Cheaper for moderate sequence lengths when
+  num_heads % axis_size == 0.
+
+Both are plain collectives inside `shard_map`, so they compose with data /
+tensor parallel axes of the same mesh and with `jax.grad` (XLA
+differentiates ppermute/all_to_all natively).
+
+NOTE on tracing: the `sequence_parallel()` context is consulted at TRACE
+time. A function jitted outside the context keeps its non-ring executable
+in jax's cache even if later called inside the context (and vice versa).
+For the training hot path, prefer the explicit
+`jit.TrainStep(..., sequence_parallel="sp")` knob, which bakes the ring
+path into the compiled step deterministically.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from contextlib import contextmanager
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from .mesh import get_mesh
+
+_NEG_INF = -1e30
+
+
+def _axis_size(axis_name):
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# ring attention (inside shard_map; q/k/v local blocks (B, L_local, H, D))
+# ---------------------------------------------------------------------------
+
+
+def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
+                         axis_size: Optional[int] = None):
+    """Ring attention over `axis_name`; call inside shard_map.
+
+    q/k/v: (B, L_local, H, D) — this device's sequence shard. Returns the
+    attention output for the local Q block, (B, L_local, H, D). The KV ring
+    walk is a `fori_loop`, so HLO size stays O(1) in the axis size.
+    """
+    size = axis_size if axis_size is not None else _axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    orig_dtype = q.dtype
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # (b, h, lq, d)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    b, h, lq, d = qh.shape
+    lk = kh.shape[2]
+    scale = 1.0 / math.sqrt(d)
+
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def body(s, carry):
+        m, l, acc, kc, vc = carry
+        # after s rotations this device holds the block that originated on
+        # device (idx - s) mod size
+        origin = jnp.mod(idx - s, size)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kc) * scale
+        if is_causal:
+            q_pos = idx * lq + jnp.arange(lq)[:, None]
+            k_pos = origin * lk + jnp.arange(lk)[None, :]
+            valid = q_pos >= k_pos                     # (lq, lk)
+            scores = jnp.where(valid, scores, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        if is_causal:
+            # fully-masked rows have scores == m_new == _NEG_INF and would
+            # otherwise contribute exp(0) = 1
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return m_new, l, acc, kc, vc
+
+    # derive initial carries from the inputs (0*q) so they carry the same
+    # varying-manual-axes type as the loop outputs (shard_map vma check)
+    zero_q = 0.0 * qh[..., 0]                       # (b, h, lq)
+    m0 = zero_q + _NEG_INF
+    l0 = zero_q
+    acc0 = zero_q[..., None] * vh[..., :1, :]       # (b, h, lq, dv)
+    m, l, acc, _, _ = jax.lax.fori_loop(
+        0, size, body, (m0, l0, acc0, kh, vh))
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses attention (all-to-all head/sequence reshuffle)
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
+                            axis_size: Optional[int] = None):
+    """Ulysses sequence parallelism; call inside shard_map.
+
+    q/k/v: (B, L_local, H, D), H divisible by the axis size. all_to_all to
+    (B, L_full, H/size, D), local full attention, all_to_all back.
+    """
+    from ..ops.pallas.flash_attention import _xla_attention
+
+    def a2a_fwd(x):   # seq-sharded -> head-sharded
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def a2a_bwd(x):   # head-sharded -> seq-sharded
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qa, ka, va = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
+    out = _xla_attention(qa, ka, va, None, 0.0, is_causal, None)
+    return a2a_bwd(out)
+
+
+# ---------------------------------------------------------------------------
+# user-facing wrappers (shard_map over a mesh)
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
+                   seq_axis: str = "sp", batch_axis: str = "dp",
+                   head_axis: str = "tp",
+                   is_causal: bool = False, impl: str = "ring"):
+    """Context-parallel attention over `seq_axis` of `mesh`.
+
+    q/k/v: (B, L, H, D) global arrays (or sharded under pjit — specs
+    compose). impl: "ring" (ppermute KV rotation) or "ulysses"
+    (all-to-all head split). Shapes the sharded path cannot handle
+    (sequence/batch/heads not divisible by the relevant axis sizes) fall
+    back to plain XLA attention instead of erroring.
+    """
+    from ..ops.pallas.flash_attention import _xla_attention
+
+    mesh = mesh or get_mesh()
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if mesh is None or seq_axis not in mesh.axis_names:
+        return _xla_attention(q, k, v, None, 0.0, is_causal, None)
+    size = mesh.shape[seq_axis]
+    if size <= 1 or lq % size != 0 or lk % size != 0:
+        return _xla_attention(q, k, v, None, 0.0, is_causal, None)
+    ba = batch_axis if (batch_axis in mesh.axis_names
+                        and batch_axis != seq_axis
+                        and b % mesh.shape[batch_axis] == 0) else None
+    # keep the head axis sharded (e.g. over tp) so attention is not
+    # redundantly replicated across tensor-parallel devices
+    ha = head_axis if (head_axis in mesh.axis_names
+                       and head_axis not in (seq_axis, ba)
+                       and h % mesh.shape[head_axis] == 0) else None
+    h_local = h // (mesh.shape[ha] if ha else 1)
+    if impl == "ulysses" and h_local % size != 0:
+        impl = "ring"   # ulysses needs local heads divisible by the sp axis
+    spec = PartitionSpec(ba, seq_axis, ha, None)
+    local = ring_attention_local if impl == "ring" else ulysses_attention_local
+    fn = functools.partial(local, axis_name=seq_axis, is_causal=is_causal,
+                           axis_size=size)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+ulysses_attention = functools.partial(ring_attention, impl="ulysses")
+
+
+# ---------------------------------------------------------------------------
+# sequence-parallel context: routes nn.functional.scaled_dot_product_attention
+# through ring/ulysses attention when active (trace-time — see module note)
+# ---------------------------------------------------------------------------
+
+_SP_STATE = {"axis": None, "impl": "ring", "batch_axis": "dp"}
+
+
+@contextmanager
+def sequence_parallel(seq_axis: str = "sp", impl: str = "ring",
+                      batch_axis: str = "dp"):
+    """Within this context, scaled_dot_product_attention shards the sequence
+    axis over `seq_axis` using ring/Ulysses attention (mask-free paths).
+
+    Trace-time semantics: affects code being traced/compiled inside the
+    context. Already-compiled executables are not retraced — for jitted
+    training steps use `TrainStep(..., sequence_parallel=...)` instead.
+    """
+    prev = dict(_SP_STATE)
+    _SP_STATE.update(axis=seq_axis, impl=impl, batch_axis=batch_axis)
+    try:
+        yield
+    finally:
+        _SP_STATE.update(prev)
+
+
+def active_sequence_parallel():
+    """(axis, impl, batch_axis) if a usable sp context + mesh axis exist."""
+    axis = _SP_STATE["axis"]
+    if axis is None:
+        return None
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return None
+    return axis, _SP_STATE["impl"], _SP_STATE["batch_axis"]
