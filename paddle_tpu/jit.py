@@ -223,7 +223,8 @@ class TrainStep:
 
                 from .parallel.ring import sequence_parallel as _sp_scope
 
-                sp_ctx = (_sp_scope(*self._sequence_parallel)
+                sp_ctx = (_sp_scope(*self._sequence_parallel,
+                                    mesh=self._mesh)
                           if self._sequence_parallel else nullcontext())
                 try:
                     with tape_mod.no_grad(), rng_scope(key), sp_ctx:

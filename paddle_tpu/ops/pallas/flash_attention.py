@@ -141,8 +141,8 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
 
         sp = active_sequence_parallel()
         if sp is not None:
-            axis, impl, batch_axis = sp
-            return ring_attention(q, k, v, seq_axis=axis,
+            axis, impl, batch_axis, mesh = sp
+            return ring_attention(q, k, v, mesh=mesh, seq_axis=axis,
                                   batch_axis=batch_axis,
                                   is_causal=is_causal, impl=impl)
     if mask is None and dropout_p == 0.0 and _pallas_ok(q, k, is_causal):

@@ -7,6 +7,9 @@ from .mesh import (  # noqa: F401
 from .sharding import (  # noqa: F401
     shard_params, place_params, spec_for, TRANSFORMER_TP_RULES,
 )
+from .pipeline import (  # noqa: F401
+    pipeline_apply, stack_stage_params,
+)
 from .ring import (  # noqa: F401
     ring_attention, ulysses_attention, ring_attention_local,
     ulysses_attention_local, sequence_parallel, active_sequence_parallel,

@@ -1,0 +1,127 @@
+"""Pipeline parallelism: GPipe fill-drain microbatch schedule on a mesh axis.
+
+TPU-native redesign of the reference pipeline trainer
+(/root/reference/paddle/fluid/framework/pipeline_trainer.cc and
+section_worker.cc:82 TrainFiles — host threads per stage pushing
+micro-batch scopes through a queue; configured by
+python/paddle/fluid/optimizer.py:3661 PipelineOptimizer). On TPU there are
+no host threads in the loop: the whole fill-drain schedule is ONE compiled
+SPMD program — a `lax.scan` over schedule ticks inside `shard_map`, where
+each device holds one stage's parameters (stacked pytree sharded over the
+`pp` mesh axis) and activations hop stage->stage with `lax.ppermute` over
+ICI. Reverse-mode AD through the scan gives the backward pipeline for
+free, so a pjit-ed training step differentiates straight through
+`pipeline_apply`.
+
+Schedule: classic GPipe. With S stages and M microbatches there are
+S+M-1 ticks; at tick t, stage s computes microbatch (t-s) when
+0 <= t-s < M (everything else is masked compute — the SPMD trade for
+having no data-dependent control flow).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from .mesh import get_mesh
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, *,
+                   mesh: Optional[Mesh] = None, axis: str = "pp",
+                   num_microbatches: Optional[int] = None,
+                   batch_axis: str = "dp"):
+    """Run homogeneous pipeline stages over the `axis` mesh dimension.
+
+    stage_fn: (params_of_one_layer, h) -> h with h.shape preserved (the
+        transformer-block case; put embedding/head outside the pipeline).
+    stage_params: pytree whose leaves are stacked along a leading
+        num_layers axis (like the carry of a scan-over-layers).
+        num_layers must be a multiple of the pp axis size; each stage runs
+        its num_layers/num_stages consecutive layers with a local scan.
+    x: (batch, ...) activations entering stage 0.
+    num_microbatches: defaults to the number of stages (minimum bubble
+        fraction (S-1)/(S+M-1) wants M as large as the batch allows).
+
+    Returns stage-(S-1) outputs, (batch, ...), replicated over `axis`.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        # degenerate single-stage mesh: plain scan over stages
+        def one(h, p):
+            return stage_fn(p, h), None
+        out, _ = jax.lax.scan(one, x, stage_params)
+        return out
+
+    n_stages = mesh.shape[axis]
+    n_layers = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    if n_layers % n_stages != 0:
+        raise ValueError(
+            f"stacked layer count {n_layers} not divisible by pipeline "
+            f"stages {n_stages} (axis '{axis}')")
+    mb = num_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % mb != 0:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"num_microbatches {mb}")
+    xm = x.reshape(mb, batch // mb, *x.shape[1:])
+
+    # microbatch dim replicated over pp; per-microbatch batch dim may ride dp
+    ba = batch_axis if (batch_axis in mesh.axis_names and batch_axis != axis
+                        and (batch // mb) % mesh.shape[batch_axis] == 0) else None
+    x_spec = PartitionSpec(None, ba)
+    p_spec = PartitionSpec(axis)
+
+    send_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def local(params, xm):
+        s = jax.lax.axis_index(axis)
+        ticks = mb + n_stages - 1
+
+        def run_stage(params, h):
+            # this stage's num_layers/num_stages consecutive layers
+            def one(h, p):
+                return stage_fn(p, h), None
+            out, _ = jax.lax.scan(one, h, params)
+            return out
+
+        def tick(carry, t):
+            recv, outs = carry
+            xt = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, mb - 1), axis=0, keepdims=False)
+            inp = jnp.where(s == 0, xt, recv)
+            h = run_stage(params, inp)
+            # hop to the next stage (stage 0 receives zeros: masked anyway)
+            recv_next = jax.lax.ppermute(h, axis, send_perm)
+            # last stage records microbatch t-(S-1) once it is valid
+            widx = jnp.clip(t - (n_stages - 1), 0, mb - 1)
+            valid = (t >= n_stages - 1) & (t - (n_stages - 1) < mb)
+            cur = jax.lax.dynamic_index_in_dim(outs, widx, 0, keepdims=False)
+            new = jnp.where(valid & (s == n_stages - 1), h, cur)
+            outs = jax.lax.dynamic_update_index_in_dim(outs, new, widx, 0)
+            return (recv_next, outs), None
+
+        # 0*(x,params)-derived carries keep shard_map's varying-axes typing
+        # happy: outputs vary over both the data and stage axes
+        pzero = 0.0 * jax.tree_util.tree_leaves(params)[0].ravel()[0]
+        recv0 = 0.0 * xm[0] + pzero
+        outs0 = 0.0 * xm + pzero
+        (_, outs), _ = jax.lax.scan(tick, (recv0, outs0), jnp.arange(ticks))
+        # replicate the last stage's outputs to every pp rank
+        outs = jax.lax.psum(
+            jnp.where(s == n_stages - 1, outs, 0.0 * outs), axis)
+        return outs
+
+    outs = jax.shard_map(local, mesh=mesh, in_specs=(p_spec, x_spec),
+                         out_specs=x_spec)(stage_params, xm)
+    return outs.reshape(batch, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params):
+    """List of per-stage pytrees (same structure) -> stacked pytree with a
+    leading num_stages axis, ready for pipeline_apply."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_stage_params)

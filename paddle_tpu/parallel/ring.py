@@ -74,15 +74,17 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     scale = 1.0 / math.sqrt(d)
 
     perm = [(i, (i + 1) % size) for i in range(size)]
+    # causal alignment matches _xla_attention's bottom-right tril(k=kl-ql):
+    # the last lq*size query positions align with the end of the kv axis
+    causal_offset = (lk - lq) * size
 
-    def body(s, carry):
-        m, l, acc, kc, vc = carry
+    def block_update(s, m, l, acc, kc, vc):
         # after s rotations this device holds the block that originated on
         # device (idx - s) mod size
         origin = jnp.mod(idx - s, size)
         scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kc) * scale
         if is_causal:
-            q_pos = idx * lq + jnp.arange(lq)[:, None]
+            q_pos = idx * lq + jnp.arange(lq)[:, None] + causal_offset
             k_pos = origin * lk + jnp.arange(lk)[None, :]
             valid = q_pos >= k_pos                     # (lq, lk)
             scores = jnp.where(valid, scores, _NEG_INF)
@@ -95,9 +97,14 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(p, axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        return m_new, l, acc
+
+    def body(s, carry):
+        m, l, acc, kc, vc = carry
+        m, l, acc = block_update(s, m, l, acc, kc, vc)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return m_new, l, acc, kc, vc
+        return m, l, acc, kc, vc
 
     # derive initial carries from the inputs (0*q) so they carry the same
     # varying-manual-axes type as the loop outputs (shard_map vma check)
@@ -105,8 +112,11 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     m0 = zero_q + _NEG_INF
     l0 = zero_q
     acc0 = zero_q[..., None] * vh[..., :1, :]       # (b, h, lq, dv)
-    m, l, acc, _, _ = jax.lax.fori_loop(
-        0, size, body, (m0, l0, acc0, kh, vh))
+    # the last block needs no rotation afterwards: loop size-1 rotations,
+    # then fold in the final kv block outside the loop (saves one ICI hop)
+    m, l, acc, kc, vc = jax.lax.fori_loop(
+        0, size - 1, body, (m0, l0, acc0, kh, vh))
+    m, l, acc = block_update(size - 1, m, l, acc, kc, vc)
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(orig_dtype)
@@ -193,21 +203,24 @@ ulysses_attention = functools.partial(ring_attention, impl="ulysses")
 # through ring/ulysses attention when active (trace-time — see module note)
 # ---------------------------------------------------------------------------
 
-_SP_STATE = {"axis": None, "impl": "ring", "batch_axis": "dp"}
+_SP_STATE = {"axis": None, "impl": "ring", "batch_axis": "dp", "mesh": None}
 
 
 @contextmanager
 def sequence_parallel(seq_axis: str = "sp", impl: str = "ring",
-                      batch_axis: str = "dp"):
+                      batch_axis: str = "dp", mesh: Optional[Mesh] = None):
     """Within this context, scaled_dot_product_attention shards the sequence
     axis over `seq_axis` using ring/Ulysses attention (mask-free paths).
 
-    Trace-time semantics: affects code being traced/compiled inside the
-    context. Already-compiled executables are not retraced — for jitted
-    training steps use `TrainStep(..., sequence_parallel=...)` instead.
+    Pass `mesh` to pin the mesh (TrainStep does); otherwise the global
+    mesh at trace time is used. Trace-time semantics: affects code being
+    traced/compiled inside the context. Already-compiled executables are
+    not retraced — for jitted training steps use
+    `TrainStep(..., sequence_parallel=...)` instead.
     """
     prev = dict(_SP_STATE)
-    _SP_STATE.update(axis=seq_axis, impl=impl, batch_axis=batch_axis)
+    _SP_STATE.update(axis=seq_axis, impl=impl, batch_axis=batch_axis,
+                     mesh=mesh)
     try:
         yield
     finally:
@@ -215,11 +228,12 @@ def sequence_parallel(seq_axis: str = "sp", impl: str = "ring",
 
 
 def active_sequence_parallel():
-    """(axis, impl, batch_axis) if a usable sp context + mesh axis exist."""
+    """(axis, impl, batch_axis, mesh) if a usable sp context + mesh axis
+    exist; the scope's pinned mesh wins over the global one."""
     axis = _SP_STATE["axis"]
     if axis is None:
         return None
-    mesh = get_mesh()
+    mesh = _SP_STATE["mesh"] or get_mesh()
     if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
         return None
-    return axis, _SP_STATE["impl"], _SP_STATE["batch_axis"]
+    return axis, _SP_STATE["impl"], _SP_STATE["batch_axis"], mesh

@@ -82,6 +82,19 @@ def test_sequence_parallel_context_routes_sdpa(mesh_dp2_sp4):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_attention_causal_cross_alignment(mesh_dp2_sp4):
+    """Causal cross-attention (lq != lk) must match the fallback's
+    bottom-right alignment (tril k=kl-ql)."""
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 32, 4, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 32, 4, 8), jnp.float32)
+    ref = _xla_attention(q, k, v, None, 0.0, True, None)
+    out = ring_attention(q, k, v, mesh=mesh_dp2_sp4, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_attention_under_jit_and_grad(mesh_dp2_sp4):
     """ring attention composes with jit + value_and_grad (training path)."""
     q, k, v = _qkv(l=16)
