@@ -66,6 +66,8 @@ class ShardingConfig:
     mp_degree: int = 1
     dp_degree: int = 1
     sp_degree: int = 1
+    # ZeRO stage: 1/2 shard optimizer state over dp, 3 also shards params
+    stage: int = 1
 
 
 @dataclasses.dataclass
@@ -209,14 +211,35 @@ class Fleet:
         if strategy is not None:
             self._strategy = strategy
         s = self._strategy or DistributedStrategy()
-        from ..optimizer.meta import GradientMergeOptimizer, RecomputeOptimizer
+        from ..optimizer.meta import (DGCMomentum, GradientMergeOptimizer,
+                                      LocalSGDOptimizer, RecomputeOptimizer)
 
         opt = optimizer
+        if s.dgc and not isinstance(opt, DGCMomentum):
+            # reference dgc_optimizer.py swaps Momentum for DGCMomentum
+            from ..optimizer import Momentum
+
+            if isinstance(opt, Momentum):
+                c = s.dgc_configs
+                opt = DGCMomentum(
+                    learning_rate=opt._learning_rate,
+                    momentum=opt._momentum,
+                    rampup_begin_step=c.rampup_begin_step,
+                    rampup_step=c.rampup_step,
+                    sparsity=c.sparsity,
+                    parameters=opt._params(),
+                    use_nesterov=opt._nesterov,
+                    weight_decay=(opt._wd if opt._wd is not None
+                                  else (opt._l2_coeff or None)),
+                    grad_clip=opt._grad_clip)
         if s.gradient_merge and s.gradient_merge_configs.k_steps > 1:
             opt = GradientMergeOptimizer(opt, s.gradient_merge_configs.k_steps,
                                          s.gradient_merge_configs.avg)
         if s.recompute:
             opt = RecomputeOptimizer(opt)
+        if s.localsgd:
+            opt = LocalSGDOptimizer(opt, s.localsgd_configs.k_steps,
+                                    begin_step=s.localsgd_configs.begin_step)
         self._final_strategy = s
         return _FleetOptimizer(opt, s, self)
 
@@ -266,6 +289,10 @@ class _FleetOptimizer:
                 use_dynamic_loss_scaling=c.use_dynamic_loss_scaling)
         else:
             self._scaler = None
+        # ZeRO sharded-optimizer strategy: consumed by hapi/TrainStep when
+        # building the compiled step (slots sharded over the dp axis)
+        self._zero_stage = (strategy.sharding_configs.stage
+                            if strategy.sharding else 0)
 
     def step(self):
         if self._scaler is not None:

@@ -131,7 +131,9 @@ class Model:
 
             self._train_step = TrainStep(
                 self.network, loss_fn, self._optimizer, mesh=self._mesh,
-                param_rules=self._param_rules)
+                param_rules=self._param_rules,
+                # fleet sharding strategy (ZeRO): shard opt state over dp
+                zero_stage=getattr(self._optimizer, "_zero_stage", 0))
 
         out = self._train_step(*(list(ins) + list(labs)))
         if isinstance(out, tuple):

@@ -143,7 +143,8 @@ class TrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  seed: int = 0, donate: bool = True, mesh=None,
                  param_rules=None, data_axes=("dp", "data"),
-                 data_spec=None, sequence_parallel=None):
+                 data_spec=None, sequence_parallel=None, zero_stage=0,
+                 zero_axis="dp"):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -161,6 +162,10 @@ class TrainStep:
         if isinstance(sequence_parallel, str):
             sequence_parallel = (sequence_parallel, "ring")
         self._sequence_parallel = sequence_parallel
+        # ZeRO: 0 = off, 1/2 = shard optimizer slots over zero_axis,
+        # 3 = also shard the params themselves
+        self._zero_stage = zero_stage
+        self._zero_axis = zero_axis
         self._placed = False
 
     def _place_spmd(self, params, buffers, batch_arrays):
@@ -170,11 +175,19 @@ class TrainStep:
         multi_devices_graph_pass + allreduce op handles)."""
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from .parallel.sharding import shard_params
+        from .parallel.sharding import shard_params, zero_shardings
 
         mesh = self._mesh
         if not self._placed:
-            pshard = shard_params(params, mesh, self._param_rules)
+            if self._zero_stage:
+                pshard, slot_sharding = zero_shardings(
+                    params, mesh, axis=self._zero_axis,
+                    stage=self._zero_stage, rules=self._param_rules)
+            else:
+                pshard = shard_params(params, mesh, self._param_rules)
+
+                def slot_sharding(nn, arr):
+                    return pshard[nn]
             for n in params:
                 params[n] = jax.device_put(params[n], pshard[n])
             rep = NamedSharding(mesh, PartitionSpec())
@@ -184,7 +197,8 @@ class TrainStep:
                 slots = self._opt_state["slots"]
                 for n in slots:
                     slots[n] = _tree.tree_map(
-                        lambda a, nn=n: jax.device_put(a, pshard[nn]), slots[n])
+                        lambda a, nn=n: jax.device_put(
+                            a, slot_sharding(nn, a)), slots[n])
             self._placed = True
         axes = tuple(a for a in self._data_axes if a in mesh.axis_names)
         if axes or self._data_spec is not None:

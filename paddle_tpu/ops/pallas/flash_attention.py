@@ -131,6 +131,17 @@ def _pallas_ok(q, k, causal):
             (not causal or ql == kl))
 
 
+def _local_attention(q, k, v, is_causal):
+    """Best single-device mask-free attention: Pallas when eligible,
+    else XLA. Used directly and as ring_attention's fallback."""
+    if _pallas_ok(q, k, is_causal):
+        try:
+            return _flash_attention_pallas(q, k, v, causal=is_causal)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, None, 0.0, is_causal, None)
+
+
 def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
                                 is_causal=False, key_rng=None):
     if mask is None and dropout_p == 0.0:

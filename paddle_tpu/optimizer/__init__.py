@@ -7,4 +7,9 @@ from .optimizer import (  # noqa: F401
 )
 from .meta import (  # noqa: F401
     ModelAverage, EMA, LookAhead, GradientMergeOptimizer, RecomputeOptimizer,
+    LocalSGDOptimizer, DGCMomentum,
 )
+
+# reference-API aliases (fluid.optimizer.DGCMomentumOptimizer etc.)
+DGCMomentumOptimizer = DGCMomentum
+LookaheadOptimizer = LookAhead

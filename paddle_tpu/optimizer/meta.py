@@ -123,6 +123,142 @@ class LookAhead(Optimizer):
         self.inner.clear_grad()
 
 
+class LocalSGDOptimizer:
+    """LocalSGD (reference transpiler/collective.py:270 LocalSGD, fleet
+    meta_optimizers/localsgd_optimizer.py): each data-parallel worker takes
+    k_steps local optimizer steps, then parameters are averaged across the
+    replica group. On TPU the averaging is a pmean collective when running
+    under a multi-device group (no-op at world size 1)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, begin_step=1):
+        self._inner = inner_optimizer
+        self._k = max(1, int(k_steps))
+        self._begin = begin_step
+        self._step_cnt = 0
+
+    def step(self):
+        self._inner.step()
+        self._step_cnt += 1
+        if self._step_cnt >= self._begin and self._step_cnt % self._k == 0:
+            self._average_params()
+
+    def _average_params(self):
+        if jax.process_count() > 1:
+            # multi-process eager DP: average each replica's params across
+            # processes (the reference's c_allreduce over trainer ranks)
+            from jax.experimental import multihost_utils
+
+            for p in self._inner._params():
+                stacked = multihost_utils.process_allgather(p._value)
+                p._value = jnp.mean(stacked, axis=0)
+            return
+        # inside shard_map/pmap this lowers to pmean; world size 1: no-op
+        from ..distributed.collective import ReduceOp, all_reduce
+
+        for p in self._inner._params():
+            all_reduce(p, op=ReduceOp.AVG)
+
+    def minimize(self, loss, **kw):
+        if getattr(loss, "_node", None) is not None:
+            loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+class DGCMomentum(Optimizer):
+    """Deep gradient compression momentum (reference operators/dgc_op.cc +
+    fluid/optimizer.py:1176 DGCMomentumOptimizer): momentum-corrected
+    residual accumulation with top-k sparsification. Before
+    rampup_begin_step it is plain momentum; after, only the largest
+    (1-sparsity) fraction of accumulated-gradient entries update the
+    velocity each step, the rest stay in local residuals (u, v).
+
+    The rule is pure, so it runs inside the compiled TrainStep. Under
+    multi-process DP the sparsified tensor is what crosses the wire; in
+    the single-program SPMD world the same semantics apply to the already
+    psum-ed gradient."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 rampup_begin_step=0, rampup_step=1,
+                 sparsity=(0.999,), parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._rampup_begin = int(rampup_begin_step)
+        # warmup schedule: each entry of `sparsity` holds for
+        # rampup_step/len(sparsity) steps after rampup_begin_step
+        self._sparsities = (tuple(float(s) for s in sparsity)
+                            if isinstance(sparsity, (list, tuple))
+                            else (float(sparsity),))
+        self._rampup_step = max(1, int(rampup_step))
+        self._nesterov = use_nesterov
+
+    def init_slot(self, p):
+        return {"velocity": jnp.zeros_like(p),
+                "u": jnp.zeros_like(p),     # momentum-corrected accumulator
+                "v": jnp.zeros_like(p)}     # residual (unsent) gradient
+
+    def _dgc_update(self, g, p, slots, lr, sparsity):
+        m = self._momentum
+        u = m * slots["u"] + g
+        v = slots["v"] + u
+        flat = v.ravel()
+        n = flat.shape[0]
+        k = max(1, int(n * (1.0 - sparsity)))
+        topv, _ = jax.lax.top_k(jnp.abs(flat), k)
+        thr = topv[-1]
+        mask = jnp.abs(v) >= thr
+        sent = jnp.where(mask, v, 0.0)          # sparse allreduce payload
+        vel = m * slots["velocity"] + sent
+        if self._nesterov:
+            p2 = p - lr * (sent + m * vel)
+        else:
+            p2 = p - lr * vel
+        return p2, {"velocity": vel,
+                    "u": jnp.where(mask, 0.0, u),
+                    "v": jnp.where(mask, 0.0, v)}
+
+    def _momentum_update(self, g, p, slots, lr):
+        vel = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            p2 = p - lr * (g + self._momentum * vel)
+        else:
+            p2 = p - lr * vel
+        return p2, {"velocity": vel, "u": slots["u"], "v": slots["v"]}
+
+    def rule(self, g, p, slots, lr, t):
+        sparsities = self._sparsities
+        if len(sparsities) == 1:
+            def dgc_branch():
+                return self._dgc_update(g, p, slots, lr, sparsities[0])
+        else:
+            # top_k needs a static k, so each warmup sparsity is its own
+            # branch; the traced step picks one with lax.switch
+            steps_per = max(1, self._rampup_step // len(sparsities))
+            branches = [
+                (lambda s=s: self._dgc_update(g, p, slots, lr, s))
+                for s in sparsities
+            ]
+
+            def dgc_branch():
+                phase = jnp.clip((t - self._rampup_begin - 1) // steps_per,
+                                 0, len(sparsities) - 1).astype(jnp.int32)
+                return jax.lax.switch(phase, branches)
+
+        if self._rampup_begin <= 0:
+            return dgc_branch()
+        return jax.lax.cond(
+            t > self._rampup_begin,
+            dgc_branch,
+            lambda: self._momentum_update(g, p, slots, lr))
+
+
 class EMA:
     """Exponential moving average of params (reference optimizer.py:3411)."""
 

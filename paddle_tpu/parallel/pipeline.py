@@ -20,7 +20,6 @@ having no data-dependent control flow).
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax

@@ -166,16 +166,16 @@ def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
     (sequence/batch/heads not divisible by the relevant axis sizes) fall
     back to plain XLA attention instead of erroring.
     """
-    from ..ops.pallas.flash_attention import _xla_attention
+    from ..ops.pallas.flash_attention import _local_attention
 
     mesh = mesh or get_mesh()
     b, lq, h, d = q.shape
     lk = k.shape[1]
     if mesh is None or seq_axis not in mesh.axis_names:
-        return _xla_attention(q, k, v, None, 0.0, is_causal, None)
+        return _local_attention(q, k, v, is_causal)
     size = mesh.shape[seq_axis]
     if size <= 1 or lq % size != 0 or lk % size != 0:
-        return _xla_attention(q, k, v, None, 0.0, is_causal, None)
+        return _local_attention(q, k, v, is_causal)
     ba = batch_axis if (batch_axis in mesh.axis_names
                         and batch_axis != seq_axis
                         and b % mesh.shape[batch_axis] == 0) else None
