@@ -37,6 +37,9 @@ from . import utils  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework.tape import no_grad as no_grad  # noqa: F401
+from . import profiler  # noqa: F401
+from . import inference  # noqa: F401
+from . import incubate  # noqa: F401
 
 
 def save(obj, path, **kwargs):

@@ -86,6 +86,8 @@ def primitive(name=None, nondiff=()):
             if not diff_pos:
                 a, kw = jax.tree_util.tree_unflatten(treedef, arrays)
                 out = fn(*a, **kw)
+                if flags.get_flag("check_nan_inf"):
+                    _check_nan_inf(op_name, out)
                 return _wrap_outputs(out, stop_gradient=True)
 
             def pure(*diff_arrays):

@@ -1,0 +1,133 @@
+"""Auto-checkpoint for fault-tolerant training resume.
+
+Parity with the reference auto-checkpoint subsystem
+(/root/reference/python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:
+TrainEpochRange :265, train_epoch_range :598 — periodic snapshot keyed by
+job id, resume skips completed epochs; checkpoint_saver.py). TPU-native
+simplifications: snapshots are state-dict pickles through io.serialization
+(orbax for sharded arrays is available via io.orbax_ckpt) on a local or
+mounted path; the job id comes from PADDLE_JOB_ID like the reference's
+PaddleCloud wiring.
+
+Usage (mirrors the reference):
+
+    tr = TrainEpochRange(max_epochs, name="job0")
+    tr.register(model=model, optimizer=opt)
+    for epoch in tr.get():        # resumes after the last saved epoch
+        train_one_epoch(...)
+        # tr saves automatically at each epoch end (save_checkpoint_inter)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Optional
+
+_CKPT_ROOT_ENV = "PADDLE_AUTO_CHECKPOINT_PATH"
+_JOB_ID_ENV = "PADDLE_JOB_ID"
+
+
+def _default_root():
+    return os.environ.get(_CKPT_ROOT_ENV, "./auto_checkpoint")
+
+
+class TrainEpochRange:
+    """Epoch iterator with automatic snapshot/resume (reference :265)."""
+
+    def __init__(self, max_epoch_num: int, name: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
+                 save_checkpoint_inter: Optional[int] = None,
+                 checkpoint_inter: Optional[int] = None):
+        self._max = int(max_epoch_num)
+        self.name = name or os.environ.get(_JOB_ID_ENV, "default_job")
+        self._root = checkpoint_path or _default_root()
+        self._dir = os.path.join(self._root, self.name)
+        # seconds between saves; <=0 saves every epoch (tests use 0)
+        self._inter = (save_checkpoint_inter
+                       if save_checkpoint_inter is not None
+                       else checkpoint_inter)
+        if self._inter is None:
+            self._inter = 0
+        self._last_save = 0.0
+        self._model = None
+        self._optimizer = None
+        self._restored_epoch = -1
+        self._load_meta()
+
+    # -- registration --------------------------------------------------------
+    def register(self, model=None, optimizer=None):
+        self._model = model
+        self._optimizer = optimizer
+        self._maybe_restore_state()
+        return self
+
+    # -- persistence ---------------------------------------------------------
+    def _meta_path(self):
+        return os.path.join(self._dir, "meta.pkl")
+
+    def _state_path(self):
+        return os.path.join(self._dir, "state.pdparams")
+
+    def _load_meta(self):
+        try:
+            with open(self._meta_path(), "rb") as f:
+                meta = pickle.load(f)
+            self._restored_epoch = int(meta.get("epoch", -1))
+        except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+            self._restored_epoch = -1
+
+    def _maybe_restore_state(self):
+        if self._restored_epoch < 0 or not os.path.exists(self._state_path()):
+            return
+        with open(self._state_path(), "rb") as f:
+            state = pickle.load(f)
+        if self._model is not None and state.get("model") is not None:
+            self._model.set_state_dict(state["model"])
+        if self._optimizer is not None and state.get("opt") is not None:
+            set_state = getattr(self._optimizer, "set_state_dict", None)
+            if set_state:
+                set_state(state["opt"])
+
+    def save_checkpoint(self, epoch: int):
+        from ...io.serialization import _to_numpy_state
+
+        os.makedirs(self._dir, exist_ok=True)
+        state = {
+            "model": (_to_numpy_state(self._model.state_dict())
+                      if self._model is not None else None),
+            "opt": (_to_numpy_state(self._optimizer.state_dict())
+                    if self._optimizer is not None
+                    and hasattr(self._optimizer, "state_dict") else None),
+        }
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=4)
+        os.replace(tmp, self._state_path())
+        with open(self._meta_path() + ".tmp", "wb") as f:
+            pickle.dump({"epoch": epoch, "name": self.name}, f)
+        os.replace(self._meta_path() + ".tmp", self._meta_path())
+        self._last_save = time.time()
+
+    # -- iteration -----------------------------------------------------------
+    @property
+    def restored_epoch(self):
+        return self._restored_epoch
+
+    def get(self):
+        """Yield remaining epoch indices; snapshot after each one."""
+        start = self._restored_epoch + 1
+        for epoch in range(start, self._max):
+            yield epoch
+            now = time.time()
+            if self._inter <= 0 or now - self._last_save >= self._inter:
+                self.save_checkpoint(epoch)
+
+
+def train_epoch_range(max_epoch_num: int, save_checkpoint_inter=None,
+                      name=None, checkpoint_path=None):
+    """Generator parity with reference :598."""
+    tr = TrainEpochRange(max_epoch_num, name=name,
+                         checkpoint_path=checkpoint_path,
+                         save_checkpoint_inter=save_checkpoint_inter)
+    yield from tr.get()

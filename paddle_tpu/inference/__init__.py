@@ -1,0 +1,145 @@
+"""Inference API: Config / create_predictor / Predictor.
+
+Parity with the reference AnalysisPredictor C-API surface
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:82,
+paddle_api.h Config/PaddlePredictor, api/api_impl.cc NativePredictor).
+TPU-native execution: the "optimized inference program" is a StableHLO
+export produced by jit.save / io.save_inference_model (constants folded,
+XLA does the graph-pass pipeline the reference ran by hand), deserialized
+once and dispatched as a compiled XLA executable. Input/output handles
+keep the copy_from_cpu/copy_to_cpu protocol so reference predictor code
+ports unchanged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Config:
+    """Predictor configuration (reference paddle_api.h AnalysisConfig)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # prog_file may be "<prefix>.pdmodel" or a bare prefix
+        self._prefix = None
+        if prog_file:
+            self._prefix = (prog_file[:-len(".pdmodel")]
+                            if prog_file.endswith(".pdmodel") else prog_file)
+        self._ir_optim = True
+        self._memory_optim = True
+        self._device = None   # None = default jax backend
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._prefix = (prog_file[:-len(".pdmodel")]
+                        if prog_file.endswith(".pdmodel") else prog_file)
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    # knobs kept for parity; XLA handles fusion/memory planning
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_use_gpu(self, *a, **k):
+        pass   # device selection is the jax backend's business
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass
+
+
+class _IOHandle:
+    """Input/output tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._array: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._array = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = self._array.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def shape(self):
+        return None if self._array is None else list(self._array.shape)
+
+
+class Predictor:
+    """Compiled-executable predictor (reference analysis_predictor.h:82)."""
+
+    def __init__(self, config: Config):
+        from ..io.serialization import TranslatedLayer, load_inference_model
+
+        if config._prefix is None:
+            raise ValueError("Config has no model path; use set_model()")
+        loaded = load_inference_model(config._prefix)
+        if not isinstance(loaded, TranslatedLayer):
+            raise ValueError(
+                f"{config._prefix}.pdmodel holds no compiled graph; re-save "
+                "with jit.save(layer, path, input_spec=[...])")
+        self._layer = loaded
+        n_in = len(loaded.in_shapes or [])
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n) for n in self._input_names}
+        self._outputs: List[_IOHandle] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either pass arrays positionally or pre-fill the input
+        handles (copy_from_cpu protocol)."""
+        if inputs is not None:
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [self._inputs[n].copy_to_cpu()
+                      for n in self._input_names]
+        out = self._layer(*arrays)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        self._outputs = []
+        result = []
+        for i, o in enumerate(outs):
+            h = _IOHandle(f"out{i}")
+            h.copy_from_cpu(np.asarray(o.numpy() if hasattr(o, "numpy")
+                                       else o))
+            self._outputs.append(h)
+            result.append(h.copy_to_cpu())
+        return result
+
+    def get_output_names(self) -> List[str]:
+        return [h.name for h in self._outputs]
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+# NativePaddlePredictor-era aliases
+PaddlePredictor = Predictor
+AnalysisConfig = Config

@@ -59,17 +59,102 @@ def load_dygraph(model_path, **configs):
 
 
 def save_inference_model(path_prefix, layer, input_spec=None, **configs):
-    """Persist params + model class info for predictor reload
-    (reference io.py:1164 save_inference_model)."""
+    """Persist an inference artifact (reference io.py:1164
+    save_inference_model). The .pdmodel file holds a serialized StableHLO
+    export of forward (params baked in as constants — the TPU-native
+    analogue of the pruned inference ProgramDesc) when input_spec is
+    given; .pdiparams holds the state dict for set_state_dict flows."""
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     save(layer.state_dict(), path_prefix + ".pdiparams")
-    meta = {"class": type(layer).__name__}
+    meta = {"class": type(layer).__name__, "stablehlo": None,
+            "in_shapes": None}
+    if input_spec:
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jax_export
+
+        from ..jit import _FunctionalModel
+
+        was_training = getattr(layer, "training", False)
+        if hasattr(layer, "eval"):
+            layer.eval()
+        fmodel = _FunctionalModel(layer)
+        params = {n: p.value for n, p in layer.named_parameters()}
+        buffers = {n: b.value for n, b in layer.named_buffers()}
+
+        def fwd(*xs):
+            out, _ = fmodel(params, buffers, xs, {})
+            return out
+
+        structs = []
+        for i, spec in enumerate(input_spec):
+            dims = tuple(spec.shape or ())
+            if any(s is None or (isinstance(s, int) and s < 0)
+                   for s in dims):
+                # dynamic dims (None/-1) stay symbolic in the export
+                expr = ",".join(
+                    f"d{i}_{j}" if (s is None or s < 0) else str(s)
+                    for j, s in enumerate(dims))
+                shape = jax_export.symbolic_shape(expr)
+            else:
+                shape = dims
+            structs.append(jax.ShapeDtypeStruct(shape, jnp.dtype(spec.dtype)))
+        exported = jax_export.export(jax.jit(fwd))(*structs)
+        meta["stablehlo"] = bytes(exported.serialize())
+        # symbolic dims (_DimExpr) don't pickle; record them as None
+        meta["in_shapes"] = [
+            (tuple(d if isinstance(d, int) else None for d in s.shape),
+             str(s.dtype)) for s in structs]
+        if was_training and hasattr(layer, "train"):
+            layer.train()
     with open(path_prefix + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
 
 
+class TranslatedLayer:
+    """Loaded inference artifact: callable like the original layer's
+    forward (reference dygraph jit.load TranslatedLayer)."""
+
+    def __init__(self, exported, params, meta):
+        self._exported = exported
+        self._params = params
+        self._meta = meta
+
+    @property
+    def in_shapes(self):
+        return self._meta.get("in_shapes")
+
+    def state_dict(self):
+        return self._params
+
+    def eval(self):
+        return self
+
+    def __call__(self, *args):
+        import jax.numpy as jnp
+
+        arrays = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = self._exported.call(*arrays)
+        return (Tensor(out) if not isinstance(out, (tuple, list))
+                else type(out)(Tensor(o) for o in out))
+
+    forward = __call__
+
+
 def load_inference_model(path_prefix, **configs):
+    """Load an inference artifact. Returns a callable TranslatedLayer when
+    a StableHLO export is present, else the raw params state dict."""
     params = load(path_prefix + ".pdiparams")
+    meta_path = path_prefix + ".pdmodel"
+    if os.path.exists(meta_path):
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        if meta.get("stablehlo"):
+            from jax import export as jax_export
+
+            exported = jax_export.deserialize(bytearray(meta["stablehlo"]))
+            return TranslatedLayer(exported, params, meta)
     return params
 
 

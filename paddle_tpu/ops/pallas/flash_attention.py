@@ -156,9 +156,6 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
             return ring_attention(q, k, v, mesh=mesh, seq_axis=axis,
                                   batch_axis=batch_axis,
                                   is_causal=is_causal, impl=impl)
-    if mask is None and dropout_p == 0.0 and _pallas_ok(q, k, is_causal):
-        try:
-            return _flash_attention_pallas(q, k, v, causal=is_causal)
-        except Exception:
-            pass
+    if mask is None and dropout_p == 0.0:
+        return _local_attention(q, k, v, is_causal)
     return _xla_attention(q, k, v, mask, dropout_p, is_causal, key_rng)

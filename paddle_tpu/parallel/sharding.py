@@ -81,7 +81,10 @@ def zero_slot_spec(arr, mesh: Mesh, axis: str = "dp",
     sharding and additionally shard the largest free dim over `axis`."""
     spec = list(base_spec) if base_spec is not None else []
     spec = spec[: arr.ndim] + [None] * (arr.ndim - len(spec))
-    if axis in mesh.axis_names:
+    already_used = any(
+        axis == ax or (isinstance(ax, (tuple, list)) and axis in ax)
+        for ax in spec)
+    if axis in mesh.axis_names and not already_used:
         size = mesh.shape[axis]
         for i in sorted(range(arr.ndim), key=lambda i: -arr.shape[i]):
             if spec[i] is None and arr.shape[i] % max(size, 1) == 0:

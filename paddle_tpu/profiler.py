@@ -1,0 +1,143 @@
+"""Profiler: host event annotation + device tracing.
+
+Parity with the reference profiler stack
+(/root/reference/paddle/fluid/platform/profiler.h:126 RecordEvent, :208
+EnableProfiler, :211 DisableProfiler; python front
+python/paddle/fluid/profiler.py:131 start_profiler, :198 stop_profiler,
+:255 profiler context manager). TPU-native mapping: `RecordEvent` is an
+RAII scope that both feeds a host-side aggregation table (the reference's
+sorted summary) and emits a `jax.profiler.TraceAnnotation` so the scope
+shows up on the TensorBoard/XPlane device timeline; `start_profiler` with
+a trace dir runs `jax.profiler.start_trace` (the CUPTI DeviceTracer
+equivalent — XLA runtime events + TPU counters).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+_state = {
+    "enabled": False,
+    "trace_dir": None,
+    # name -> [calls, total_s, min_s, max_s]
+    "events": defaultdict(lambda: [0, 0.0, float("inf"), 0.0]),
+}
+
+
+class RecordEvent:
+    """RAII profiling scope (reference platform/profiler.h:126).
+
+    Usable as context manager or explicit begin()/end() pair. Always emits
+    a TraceAnnotation (cheap when no trace is active); host aggregation
+    only while the profiler is enabled.
+    """
+
+    def __init__(self, name: str, event_type: str = "PyUserDefined"):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        if _state["enabled"]:
+            self._t0 = time.perf_counter()
+        return self
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            rec = _state["events"][self.name]
+            rec[0] += 1
+            rec[1] += dt
+            rec[2] = min(rec[2], dt)
+            rec[3] = max(rec[3], dt)
+            self._t0 = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def record_event(name):
+    return RecordEvent(name)
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   trace_dir: Optional[str] = None):
+    """Enable host aggregation; with trace_dir, also start a device trace
+    (reference profiler.py:131; state kept for API parity)."""
+    _state["enabled"] = True
+    _state["events"].clear()
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        _state["trace_dir"] = trace_dir
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: Optional[str] = None):
+    """Disable profiling, write/print the aggregated event table
+    (reference profiler.py:198)."""
+    _state["enabled"] = False
+    if _state["trace_dir"]:
+        jax.profiler.stop_trace()
+        _state["trace_dir"] = None
+    table = summary(sorted_key)
+    if profile_path:
+        d = os.path.dirname(profile_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(profile_path, "w") as f:
+            f.write(table)
+    else:
+        print(table)
+    return table
+
+
+def summary(sorted_key: Optional[str] = "total") -> str:
+    rows = []
+    for name, (calls, total, mn, mx) in _state["events"].items():
+        rows.append((name, calls, total, total / max(calls, 1), mn, mx))
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key or "total", 2)
+    rows.sort(key=lambda r: -r[key_idx])
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"
+             f"{'Min(s)':>12}{'Max(s)':>12}"]
+    for name, calls, total, ave, mn, mx in rows:
+        lines.append(f"{name:<40}{calls:>8}{total:>12.6f}{ave:>12.6f}"
+                     f"{mn:>12.6f}{mx:>12.6f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """`with profiler.profiler():` parity (reference profiler.py:255)."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# convenience re-exports of the underlying device tracer
+start_trace = jax.profiler.start_trace
+stop_trace = jax.profiler.stop_trace
+
+
+def cuda_profiler(*a, **k):
+    """Reference fluid.profiler.cuda_profiler parity: no CUDA on TPU;
+    returns a null context so call sites keep working."""
+    return contextlib.nullcontext()

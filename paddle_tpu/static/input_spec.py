@@ -1,0 +1,18 @@
+"""InputSpec (reference python/paddle/static/input.py InputSpec): shape/
+dtype/name signature for jit.save / to_static input binding."""
+from __future__ import annotations
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
