@@ -11,6 +11,7 @@ jit so the same op library serves both execution engines.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Callable, Dict
 
 import jax
@@ -40,11 +41,16 @@ def primitive(name=None, nondiff=()):
     Tensors (including inside lists/tuples one level deep), plus untouched
     static kwargs, and must return an array or a (nested) tuple of arrays.
 
-    nondiff: names of keyword args never differentiated even if Tensors.
+    nondiff: names of args never differentiated even if Tensors (matched
+    against the function signature, so positional calls are covered too).
     """
 
     def deco(fn):
         op_name = name or fn.__name__
+        try:
+            _sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            _sig = None
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
@@ -72,15 +78,24 @@ def primitive(name=None, nondiff=()):
             diff_pos = (
                 [i for i in tensor_pos if _differentiable(flat[i])] if record else []
             )
-            # nondiff kwargs: drop their positions from diff set
+            # nondiff args: drop their positions from diff set (bind via
+            # the signature so positionally-passed args are covered)
             if diff_pos and nondiff:
+                sources = {k: kwargs[k] for k in nondiff if k in kwargs}
+                if _sig is not None and len(sources) < len(nondiff):
+                    try:
+                        bound = _sig.bind(*args, **kwargs)
+                        for k in nondiff:
+                            if k in bound.arguments:
+                                sources[k] = bound.arguments[k]
+                    except TypeError:
+                        pass
                 banned = set()
-                for k in nondiff:
-                    if k in kwargs:
-                        sub, _ = jax.tree_util.tree_flatten(
-                            kwargs[k], is_leaf=_is_tensor_leaf
-                        )
-                        banned.update(id(x) for x in sub if isinstance(x, Tensor))
+                for val in sources.values():
+                    sub, _ = jax.tree_util.tree_flatten(
+                        val, is_leaf=_is_tensor_leaf
+                    )
+                    banned.update(id(x) for x in sub if isinstance(x, Tensor))
                 diff_pos = [i for i in diff_pos if id(flat[i]) not in banned]
 
             if not diff_pos:

@@ -6,5 +6,7 @@ from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 
-from . import creation, math, manipulation, logic, linalg, search  # noqa: F401
+from . import (creation, math, manipulation, logic, linalg,  # noqa: F401
+               search, sequence)

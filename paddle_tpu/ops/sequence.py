@@ -1,0 +1,178 @@
+"""Sequence ops: the LoD family on dense shapes.
+
+TPU rewrite of the reference LoD sequence ops
+(/root/reference/paddle/fluid/operators/sequence_ops/ — sequence_pool_op,
+sequence_softmax_op, sequence_pad_op, sequence_unpad_op,
+sequence_reverse_op, sequence_expand_op, sequence_conv_op, …) which
+operate on ragged LoDTensors (lod_tensor.h offset vectors). XLA wants
+static shapes, so the ragged representation becomes
+**dense padded (batch, maxlen, ...) + lengths (batch,)**; each op masks by
+position < length (SURVEY §5/§7: the segment-ids rewrite). Ops whose
+output size is data-dependent (unpad/expand) return concrete arrays
+eagerly and are documented as not jit-traceable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import primitive
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_pad", "sequence_unpad", "sequence_expand",
+    "sequence_first_step", "sequence_last_step", "sequence_conv",
+]
+
+
+def _mask(lengths, maxlen):
+    # (b, maxlen) bool: position < length
+    return jnp.arange(maxlen)[None, :] < lengths[:, None]
+
+
+@primitive("sequence_pool", nondiff=("lengths",))
+def sequence_pool(x, lengths, pool_type="sum", pad_value=0.0, name=None):
+    """x: (b, maxlen, ...) padded; lengths: (b,) valid counts.
+    pool_type: sum/average/sqrt/max/last/first (sequence_pool_op.cc)."""
+    pool_type = pool_type.lower()
+    b, maxlen = x.shape[0], x.shape[1]
+    m = _mask(lengths, maxlen)
+    mx = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    lens = jnp.maximum(lengths, 1).astype(x.dtype)
+    lens = lens.reshape((b,) + (1,) * (x.ndim - 2))
+    if pool_type == "sum":
+        out = jnp.sum(jnp.where(mx, x, 0), axis=1)
+    elif pool_type in ("average", "mean"):
+        out = jnp.sum(jnp.where(mx, x, 0), axis=1) / lens
+    elif pool_type == "sqrt":
+        out = jnp.sum(jnp.where(mx, x, 0), axis=1) / jnp.sqrt(lens)
+    elif pool_type == "max":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min
+                          if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jnp.iinfo(x.dtype).min, x.dtype)
+        out = jnp.max(jnp.where(mx, x, neg), axis=1)
+    elif pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((b, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    elif pool_type == "first":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type}")
+    # empty sequences produce pad_value (reference pad_value attr)
+    empty = (lengths == 0).reshape((b,) + (1,) * (x.ndim - 2))
+    return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+
+def sequence_first_step(x, lengths=None, name=None):
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths=None, name=None):
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return sequence_pool(x, lengths, "last")
+
+
+@primitive("sequence_softmax", nondiff=("lengths",))
+def sequence_softmax(x, lengths, name=None):
+    """Softmax within each sequence, padding excluded
+    (sequence_softmax_op.cc). x: (b, maxlen) or (b, maxlen, 1)."""
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x[..., 0] if squeeze else x
+    m = _mask(lengths, v.shape[1])
+    s = jnp.where(m, v, -1e30)
+    out = jax.nn.softmax(s, axis=1)
+    out = jnp.where(m, out, 0.0)
+    return out[..., None] if squeeze else out
+
+
+@primitive("sequence_reverse", nondiff=("lengths",))
+def sequence_reverse(x, lengths, name=None):
+    """Reverse each sequence's valid prefix in place
+    (sequence_reverse_op.h). x: (b, maxlen, ...)."""
+    maxlen = x.shape[1]
+    pos = jnp.arange(maxlen)[None, :]                       # (1, maxlen)
+    rev = lengths[:, None] - 1 - pos                         # reversed idx
+    idx = jnp.where(pos < lengths[:, None], rev, pos)
+    idx = jnp.clip(idx, 0, maxlen - 1)
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, lengths=None, name=None):
+    """Flat (total, ...) + lengths -> (b, maxlen, ...) padded + lengths
+    (sequence_pad_op.cc). Eager only: output batch comes from lengths."""
+    lengths = np.asarray(lengths)
+    ends = np.cumsum(lengths)
+    starts = ends - lengths
+    ml = int(maxlen) if maxlen else int(lengths.max() if len(lengths) else 0)
+    xv = x.value if hasattr(x, "value") else jnp.asarray(x)
+    rows = []
+    for s, l in zip(starts, lengths):
+        seg = xv[int(s):int(s + min(l, ml))]
+        pad = [(0, ml - seg.shape[0])] + [(0, 0)] * (xv.ndim - 1)
+        rows.append(jnp.pad(seg, pad, constant_values=pad_value))
+    out = jnp.stack(rows) if rows else jnp.zeros((0, ml) + xv.shape[1:],
+                                                 xv.dtype)
+    from ..framework.tensor import Tensor
+
+    return Tensor(out), Tensor(jnp.asarray(np.minimum(lengths, ml),
+                                           jnp.int32))
+
+
+def sequence_unpad(x, lengths, name=None):
+    """(b, maxlen, ...) + lengths -> flat (total, ...)
+    (sequence_unpad_op.cc). Eager only: output size is data-dependent."""
+    xv = x.value if hasattr(x, "value") else jnp.asarray(x)
+    lens = np.asarray(lengths.numpy() if hasattr(lengths, "numpy")
+                      else lengths)
+    parts = [xv[i, :int(l)] for i, l in enumerate(lens)]
+    out = (jnp.concatenate(parts) if parts
+           else jnp.zeros((0,) + xv.shape[2:], xv.dtype))
+    from ..framework.tensor import Tensor
+
+    return Tensor(out)
+
+
+def sequence_expand(x, lengths, name=None):
+    """Repeat row i of x lengths[i] times (sequence_expand_op.cc with the
+    common ref_level=0 usage). Eager only."""
+    xv = x.value if hasattr(x, "value") else jnp.asarray(x)
+    lens = np.asarray(lengths.numpy() if hasattr(lengths, "numpy")
+                      else lengths).astype(np.int64)
+    idx = np.repeat(np.arange(len(lens)), lens)
+    from ..framework.tensor import Tensor
+
+    return Tensor(jnp.take(xv, jnp.asarray(idx), axis=0))
+
+
+@primitive("sequence_conv", nondiff=("lengths",))
+def sequence_conv(x, weight, lengths=None, context_length=3,
+                  context_start=None, bias=None, name=None):
+    """Context-window conv over the time axis (sequence_conv_op.cc):
+    each step sees [t+context_start, t+context_start+context_length);
+    positions outside the valid prefix contribute zeros.
+    x: (b, maxlen, d); weight: (context_length*d, out_d)."""
+    b, maxlen, d = x.shape
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    m = _mask(lengths, maxlen)[..., None] if lengths is not None else None
+    xm = jnp.where(m, x, 0.0) if m is not None else x
+    cols = []
+    for j in range(context_length):
+        off = context_start + j
+        shifted = jnp.roll(xm, -off, axis=1)
+        pos = jnp.arange(maxlen) + off
+        ok = (pos >= 0) & (pos < maxlen)
+        cols.append(jnp.where(ok[None, :, None], shifted, 0.0))
+    col = jnp.concatenate(cols, axis=-1)            # (b, maxlen, cl*d)
+    out = col @ weight
+    if bias is not None:
+        out = out + bias
+    if m is not None:
+        out = jnp.where(m, out, 0.0)
+    return out

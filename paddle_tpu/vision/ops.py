@@ -1,0 +1,286 @@
+"""Detection ops (paddle.vision.ops / reference operators/detection/).
+
+TPU-first redesigns of the CUDA detection kernels
+(/root/reference/paddle/fluid/operators/detection/: yolo_box_op.cc,
+prior_box_op.cc, box_coder_op.cc, roi_align_op.cc, multiclass_nms_op.cc).
+Everything is static-shape and mask-based so it compiles under jit:
+NMS runs a fixed-iteration greedy loop returning padded indices (keep
+count in a mask) instead of the reference's dynamic-length outputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import primitive
+
+__all__ = ["yolo_box", "prior_box", "box_coder", "roi_align", "nms",
+           "iou_matrix"]
+
+
+@primitive("yolo_box", nondiff=("img_size",))
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None):
+    """Decode YOLOv3 head output (yolo_box_op.cc).
+
+    x: (b, an*(5+class_num), h, w); img_size: (b, 2) [h, w].
+    Returns (boxes (b, an*h*w, 4) xyxy, scores (b, an*h*w, class_num)).
+    """
+    b, _, h, w = x.shape
+    an = len(anchors) // 2
+    anchors_a = jnp.asarray(anchors, jnp.float32).reshape(an, 2)
+    xv = x.reshape(b, an, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(xv[:, :, 0]) * alpha + beta + gx) / w
+    cy = (jax.nn.sigmoid(xv[:, :, 1]) * alpha + beta + gy) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(xv[:, :, 2]) * anchors_a[None, :, 0, None, None] / input_w
+    bh = jnp.exp(xv[:, :, 3]) * anchors_a[None, :, 1, None, None] / input_h
+
+    conf = jax.nn.sigmoid(xv[:, :, 4])
+    conf = jnp.where(conf < conf_thresh, 0.0, conf)
+    probs = jax.nn.sigmoid(xv[:, :, 5:]) * conf[:, :, None]
+
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x0 = (cx - bw / 2) * imw
+    y0 = (cy - bh / 2) * imh
+    x1 = (cx + bw / 2) * imw
+    y1 = (cy + bh / 2) * imh
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, imw - 1)
+        y0 = jnp.clip(y0, 0, imh - 1)
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(b, an * h * w, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(b, an * h * w, class_num)
+    # zero-confidence boxes are zeroed like the reference
+    valid = (conf > 0).reshape(b, an * h * w, 1)
+    return jnp.where(valid, boxes, 0.0), scores
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (prior_box_op.cc). input: (b, c, h, w) feature map,
+    image: (b, c, imh, imw). Returns (boxes (h, w, n, 4),
+    variances (h, w, n, 4))."""
+    h, w = input.shape[2], input.shape[3]
+    imh, imw = image.shape[2], image.shape[3]
+    step_h = steps[1] or imh / h
+    step_w = steps[0] or imw / w
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    wh = []
+    for i, ms in enumerate(min_sizes):
+        ms = float(ms)
+        mx = float(max_sizes[i]) if max_sizes else None
+        if min_max_aspect_ratios_order:
+            wh.append((ms, ms))
+            if mx is not None:
+                wh.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                wh.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                wh.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if mx is not None:
+                wh.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    n = len(wh)
+    wh_a = jnp.asarray(wh, jnp.float32)                     # (n, 2)
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                         # (h, w)
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    bw = wh_a[None, None, :, 0] / 2.0
+    bh = wh_a[None, None, :, 1] / 2.0
+    boxes = jnp.stack([(cxg - bw) / imw, (cyg - bh) / imh,
+                       (cxg + bw) / imw, (cyg + bh) / imh], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                                 (h, w, n, 4))
+    from ..framework.tensor import Tensor
+
+    return Tensor(boxes), Tensor(variances)
+
+
+@primitive("box_coder")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (box_coder_op.cc).
+    prior_box: (m, 4) xyxy; target_box: encode (n, 4) / decode (n, m, 4)."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw / 2
+    pcy = prior_box[:, 1] + ph / 2
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), jnp.float32)
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32).reshape(-1, 4)
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw / 2
+        tcy = target_box[:, 1] + th / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1) / var[None, :, :]
+        return out                                          # (n, m, 4)
+    # decode_center_size: target (n, m, 4); priors broadcast along the
+    # dim given by `axis` (0: priors pair with dim 1, 1: with dim 0)
+    t = target_box
+    if t.ndim == 2:
+        t = t[:, None, :] if axis == 0 else t[None, :, :]
+
+    def bc(a):   # broadcast a prior-indexed vector per axis
+        return a[None, :] if axis == 0 else a[:, None]
+
+    v = var[None, :, :] if axis == 0 else var[:, None, :]
+    tcx = v[..., 0] * t[..., 0] * bc(pw) + bc(pcx)
+    tcy = v[..., 1] * t[..., 1] * bc(ph) + bc(pcy)
+    tw = jnp.exp(v[..., 2] * t[..., 2]) * bc(pw)
+    th = jnp.exp(v[..., 3] * t[..., 3]) * bc(ph)
+    # widths carry the +norm of the un-normalized convention, so only the
+    # max corner gets the -norm correction (reference box_coder_op.h)
+    return jnp.stack([tcx - tw / 2, tcy - th / 2,
+                      tcx + tw / 2 - norm, tcy + th / 2 - norm],
+                     axis=-1)
+
+
+@primitive("roi_align", nondiff=("rois", "rois_num"))
+def roi_align(x, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=True, rois_num=None, name=None):
+    """RoIAlign (roi_align_op.cc): bilinear-sampled average pooling of
+    each region. x: (b, c, h, w); rois: (n, 4) xyxy in image coords, all
+    attributed to batch 0 unless rois_num gives per-image counts."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    b, c, h, w = x.shape
+    n = rois.shape[0]
+    off = 0.5 if aligned else 0.0
+    x0 = rois[:, 0] * spatial_scale - off
+    y0 = rois[:, 1] * spatial_scale - off
+    x1 = rois[:, 2] * spatial_scale - off
+    y1 = rois[:, 3] * spatial_scale - off
+    rw = x1 - x0
+    rh = y1 - y0
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: (n, ph*s) y coords, (n, pw*s) x coords
+    iy = (jnp.arange(ph * s) + 0.5) / s                     # in bin units
+    ix = (jnp.arange(pw * s) + 0.5) / s
+    ys = y0[:, None] + bin_h[:, None] * iy[None, :]          # (n, ph*s)
+    xs = x0[:, None] + bin_w[:, None] * ix[None, :]          # (n, pw*s)
+
+    if rois_num is not None:
+        counts = jnp.asarray(rois_num)
+        batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                               total_repeat_length=n)
+    else:
+        batch_idx = jnp.zeros((n,), jnp.int32)
+    feat = x[batch_idx]                                      # (n, c, h, w)
+
+    def bilinear(feat_n, ys_n, xs_n):
+        y = jnp.clip(ys_n, 0.0, h - 1.0)
+        xq = jnp.clip(xs_n, 0.0, w - 1.0)
+        y0i = jnp.floor(y).astype(jnp.int32)
+        x0i = jnp.floor(xq).astype(jnp.int32)
+        y1i = jnp.minimum(y0i + 1, h - 1)
+        x1i = jnp.minimum(x0i + 1, w - 1)
+        wy1 = y - y0i
+        wx1 = xq - x0i
+        wy0 = 1.0 - wy1
+        wx0 = 1.0 - wx1
+        g = feat_n[:, y0i][:, :, x0i] * (wy0[:, None] * wx0[None, :]) + \
+            feat_n[:, y1i][:, :, x0i] * (wy1[:, None] * wx0[None, :]) + \
+            feat_n[:, y0i][:, :, x1i] * (wy0[:, None] * wx1[None, :]) + \
+            feat_n[:, y1i][:, :, x1i] * (wy1[:, None] * wx1[None, :])
+        return g                                             # (c, phs, pws)
+
+    g = jax.vmap(bilinear)(feat, ys, xs)                     # (n, c, phs, pws)
+    g = g.reshape(n, c, ph, s, pw, s)
+    return jnp.mean(g, axis=(3, 5))
+
+
+def iou_matrix(boxes_a, boxes_b):
+    """Pairwise IoU of xyxy boxes: (n, 4) x (m, 4) -> (n, m)."""
+    ax0, ay0, ax1, ay1 = jnp.split(boxes_a, 4, axis=-1)
+    bx0, by0, bx1, by1 = [b[None, :, 0] for b in jnp.split(boxes_b, 4, -1)]
+    ix0 = jnp.maximum(ax0, bx0)
+    iy0 = jnp.maximum(ay0, by0)
+    ix1 = jnp.minimum(ax1, bx1)
+    iy1 = jnp.minimum(ay1, by1)
+    inter = jnp.clip(ix1 - ix0, 0) * jnp.clip(iy1 - iy0, 0)
+    area_a = (ax1 - ax0) * (ay1 - ay0)
+    area_b = (bx1 - bx0) * (by1 - by0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+def nms(boxes, scores, iou_threshold=0.3, score_threshold=None, top_k=None,
+        category_idxs=None, categories=None, name=None):
+    """Greedy NMS (multiclass_nms_op.cc kernel NMSFast) as a fixed-shape
+    compiled loop: boxes sorted by score, each kept box suppresses later
+    boxes with IoU > threshold. Returns kept indices sorted by score
+    (dynamic length — materialized eagerly like the reference's
+    LoD output)."""
+    bv = boxes.value if hasattr(boxes, "value") else jnp.asarray(boxes)
+    sv = scores.value if hasattr(scores, "value") else jnp.asarray(scores)
+    keep_mask, order = _nms_mask(bv, sv, float(iou_threshold),
+                                 float("-inf") if score_threshold is None
+                                 else float(score_threshold),
+                                 category_idxs if category_idxs is None
+                                 else jnp.asarray(category_idxs))
+    kept = np.asarray(order)[np.asarray(keep_mask)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    from ..framework.tensor import Tensor
+
+    return Tensor(jnp.asarray(kept, jnp.int32))
+
+
+@jax.jit
+def _nms_mask(boxes, scores, iou_threshold, score_threshold, category_idxs):
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s = scores[order]
+    iou = iou_matrix(b, b)
+    if category_idxs is not None:
+        cats = category_idxs[order]
+        same = cats[:, None] == cats[None, :]
+        iou = jnp.where(same, iou, 0.0)   # only same-class suppression
+
+    def body(i, keep):
+        # i suppresses j>i iff i itself is kept
+        sup = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep0 = s > score_threshold
+    keep = jax.lax.fori_loop(0, n, body, keep0)
+    return keep, order

@@ -1,0 +1,214 @@
+"""Sequence ops (dense+lengths LoD rewrite) and detection ops vs numpy
+references."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.vision import ops as vops
+
+
+# ---------------------------------------------------------------------------
+# sequence ops
+# ---------------------------------------------------------------------------
+
+
+def _seq_data(b=3, ml=5, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(b, ml, d).astype(np.float32)
+    lengths = np.array([5, 2, 3], np.int32)[:b]
+    return x, lengths
+
+
+@pytest.mark.parametrize("pool,ref_fn", [
+    ("sum", lambda seg: seg.sum(0)),
+    ("average", lambda seg: seg.mean(0)),
+    ("sqrt", lambda seg: seg.sum(0) / np.sqrt(len(seg))),
+    ("max", lambda seg: seg.max(0)),
+    ("last", lambda seg: seg[-1]),
+    ("first", lambda seg: seg[0]),
+])
+def test_sequence_pool(pool, ref_fn):
+    x, lengths = _seq_data()
+    out = ops.sequence_pool(paddle.to_tensor(x), jnp.asarray(lengths), pool)
+    ref = np.stack([ref_fn(x[i, :l]) for i, l in enumerate(lengths)])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_sequence_softmax_masks_padding():
+    x, lengths = _seq_data(d=1)
+    out = ops.sequence_softmax(paddle.to_tensor(x[..., 0]),
+                               jnp.asarray(lengths)).numpy()
+    for i, l in enumerate(lengths):
+        e = np.exp(x[i, :l, 0] - x[i, :l, 0].max())
+        np.testing.assert_allclose(out[i, :l], e / e.sum(), rtol=1e-5)
+        assert np.all(out[i, l:] == 0)
+
+
+def test_sequence_reverse():
+    x, lengths = _seq_data()
+    out = ops.sequence_reverse(paddle.to_tensor(x),
+                               jnp.asarray(lengths)).numpy()
+    for i, l in enumerate(lengths):
+        np.testing.assert_allclose(out[i, :l], x[i, :l][::-1])
+        np.testing.assert_allclose(out[i, l:], x[i, l:])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    rng = np.random.RandomState(1)
+    flat = rng.randn(10, 4).astype(np.float32)
+    lengths = [5, 2, 3]
+    padded, out_lens = ops.sequence_pad(paddle.to_tensor(flat),
+                                        lengths=lengths)
+    assert padded.shape == (3, 5, 4)
+    np.testing.assert_allclose(out_lens.numpy(), lengths)
+    back = ops.sequence_unpad(padded, jnp.asarray(lengths))
+    np.testing.assert_allclose(back.numpy(), flat)
+
+
+def test_sequence_expand():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+    out = ops.sequence_expand(x, np.array([2, 0, 3]))
+    ref = np.array([[0, 1], [0, 1], [4, 5], [4, 5], [4, 5]], np.float32)
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_sequence_conv_matches_manual():
+    x, lengths = _seq_data(b=2, ml=4, d=3)
+    rng = np.random.RandomState(2)
+    w = rng.randn(9, 5).astype(np.float32)   # context 3 * d 3 -> 5
+    out = ops.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                            lengths=jnp.asarray(lengths[:2]),
+                            context_length=3).numpy()
+    xm = x.copy()
+    for i, l in enumerate(lengths[:2]):
+        xm[i, l:] = 0
+    for i in range(2):
+        for t in range(4):
+            ctx = []
+            for off in (-1, 0, 1):
+                ctx.append(xm[i, t + off] if 0 <= t + off < 4
+                           else np.zeros(3, np.float32))
+            ref = np.concatenate(ctx) @ w
+            if t < lengths[i]:
+                np.testing.assert_allclose(out[i, t], ref, rtol=1e-5,
+                                           atol=1e-5)
+            else:
+                assert np.all(out[i, t] == 0)
+
+
+# ---------------------------------------------------------------------------
+# detection ops
+# ---------------------------------------------------------------------------
+
+
+def test_iou_matrix():
+    a = jnp.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], jnp.float32)
+    got = np.asarray(vops.iou_matrix(a, a))
+    np.testing.assert_allclose(np.diag(got), [1.0, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_nms_greedy_matches_numpy():
+    rng = np.random.RandomState(3)
+    n = 40
+    xy = rng.rand(n, 2) * 10
+    wh = rng.rand(n, 2) * 4 + 0.5
+    boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+    scores = rng.rand(n).astype(np.float32)
+
+    def np_nms(boxes, scores, thr):
+        order = np.argsort(-scores)
+        keep = []
+        while order.size:
+            i = order[0]
+            keep.append(i)
+            if order.size == 1:
+                break
+            rest = order[1:]
+            ious = np.asarray(vops.iou_matrix(
+                jnp.asarray(boxes[i][None]), jnp.asarray(boxes[rest])))[0]
+            order = rest[ious <= thr]
+        return np.array(keep)
+
+    got = vops.nms(jnp.asarray(boxes), jnp.asarray(scores),
+                   iou_threshold=0.4).numpy()
+    ref = np_nms(boxes, scores, 0.4)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_nms_categories_do_not_suppress_cross_class():
+    boxes = jnp.asarray([[0, 0, 2, 2], [0, 0, 2, 2]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8], jnp.float32)
+    got = vops.nms(boxes, scores, iou_threshold=0.5,
+                   category_idxs=np.array([0, 1]),
+                   categories=[0, 1]).numpy()
+    assert set(got.tolist()) == {0, 1}
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(4)
+    priors = np.abs(rng.rand(6, 4)).astype(np.float32)
+    priors[:, 2:] += priors[:, :2] + 0.5
+    targets = np.abs(rng.rand(3, 4)).astype(np.float32)
+    targets[:, 2:] += targets[:, :2] + 0.5
+    var = np.full((6, 4), 0.5, np.float32)
+    enc = vops.box_coder(jnp.asarray(priors), jnp.asarray(var),
+                         jnp.asarray(targets), "encode_center_size")
+    dec = vops.box_coder(jnp.asarray(priors), jnp.asarray(var),
+                         enc, "decode_center_size")
+    ref = np.broadcast_to(targets[:, None, :], (3, 6, 4))
+    np.testing.assert_allclose(np.asarray(dec.numpy()), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_box_coder_unnormalized_roundtrip():
+    rng = np.random.RandomState(6)
+    priors = (np.abs(rng.rand(4, 4)) * 10).astype(np.float32)
+    priors[:, 2:] += priors[:, :2] + 2.0
+    targets = (np.abs(rng.rand(3, 4)) * 10).astype(np.float32)
+    targets[:, 2:] += targets[:, :2] + 2.0
+    enc = vops.box_coder(jnp.asarray(priors), None, jnp.asarray(targets),
+                         "encode_center_size", box_normalized=False)
+    dec = vops.box_coder(jnp.asarray(priors), None, enc,
+                         "decode_center_size", box_normalized=False)
+    ref = np.broadcast_to(targets[:, None, :], (3, 4, 4))
+    np.testing.assert_allclose(np.asarray(dec.numpy()), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_roi_align_identity_bin():
+    """A RoI covering exactly one aligned pixel area returns that value."""
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+    rois = jnp.asarray([[0.0, 0.0, 4.0, 4.0]], jnp.float32)
+    out = vops.roi_align(x, rois, output_size=4, spatial_scale=1.0,
+                         sampling_ratio=1, aligned=True).numpy()
+    np.testing.assert_allclose(out[0, 0], np.arange(16).reshape(4, 4),
+                               rtol=1e-5)
+
+
+def test_yolo_box_shapes_and_range():
+    b, an, cls, h, w = 2, 3, 5, 4, 4
+    rng = np.random.RandomState(5)
+    x = rng.randn(b, an * (5 + cls), h, w).astype(np.float32)
+    img = np.array([[64, 64], [32, 48]], np.int32)
+    boxes, scores = vops.yolo_box(jnp.asarray(x), jnp.asarray(img),
+                                  anchors=[10, 13, 16, 30, 33, 23],
+                                  class_num=cls, conf_thresh=0.0,
+                                  downsample_ratio=8)
+    assert boxes.shape == (b, an * h * w, 4)
+    assert scores.shape == (b, an * h * w, cls)
+    bx = boxes.numpy()
+    assert bx[0].max() <= 64 and bx.min() >= 0
+
+
+def test_prior_box_counts():
+    feat = jnp.zeros((1, 8, 3, 3), jnp.float32)
+    img = jnp.zeros((1, 3, 30, 30), jnp.float32)
+    boxes, variances = vops.prior_box(feat, img, min_sizes=[4.0],
+                                      max_sizes=[8.0],
+                                      aspect_ratios=[2.0], flip=True)
+    # 1 (ar=1,min) + 2 (ar=2, 1/2) + 1 (max interp) = 4 per cell
+    assert boxes.shape == (3, 3, 4, 4)
+    assert variances.shape == (3, 3, 4, 4)
