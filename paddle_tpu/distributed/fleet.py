@@ -256,7 +256,17 @@ class Fleet:
         save_persistables(executor, dirname, main_program, layer=layer)
 
     def init_worker(self):
-        pass
+        """PS mode: connect to the pserver endpoints from the launcher env
+        (reference PaddleCloudRoleMaker env wiring)."""
+        if getattr(self, "_ps_client", None) is not None:
+            return self._ps_client
+        eps = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        if eps:
+            from ..ps import PSClient
+
+            self._ps_client = PSClient(eps)
+        return getattr(self, "_ps_client", None)
 
     def init_server(self, *args, **kwargs):
         pass
@@ -267,7 +277,10 @@ class Fleet:
         run_server()
 
     def stop_worker(self):
-        pass
+        client = getattr(self, "_ps_client", None)
+        if client is not None:
+            client.close()
+            self._ps_client = None
 
 
 class _FleetOptimizer:

@@ -1,0 +1,228 @@
+// Sparse parameter table: sharded hash KV with optimizer-on-push.
+//
+// Native equivalent of the reference's server-side sparse tables
+// (/root/reference/paddle/fluid/operators/distributed/large_scale_kv.h —
+// ValueBlock/SparseVariable: init-on-first-touch rows, pull/push with
+// entry-wise optimizers; and the pslib DownpourWorker pull/push cycle,
+// framework/fleet/fleet_wrapper.h:105-186). Redesigned for the TPU build:
+// the table lives in host RAM behind a C ABI (ctypes), rows are
+// hash-sharded across N internal shards each with its own mutex so pull
+// and push from the dataloader/trainer threads scale, and the optimizer
+// (SGD / AdaGrad) is applied at push time exactly like the reference's
+// server-side optimize blocks.
+//
+// C ABI (see paddle_tpu/ps/table.py):
+//   kv_create(dim, optimizer, init_range, seed) -> handle
+//   kv_pull(h, ids, n, out)            rows materialize on first touch
+//   kv_push(h, ids, n, grads, lr)      sequential accumulate on dup ids
+//   kv_rows(h), kv_dim(h)
+//   kv_save(h, path) / kv_load(h, path)
+//   kv_destroy(h)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 16;
+constexpr float kAdaEps = 1e-6f;
+
+enum Optimizer : int { kSGD = 0, kAdaGrad = 1 };
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;  // value [+ accum]
+};
+
+struct Table {
+  int64_t dim;
+  int optimizer;
+  float init_range;
+  uint64_t seed;
+  Shard shards[kShards];
+
+  size_t row_width() const {
+    return optimizer == kAdaGrad ? 2 * dim : dim;
+  }
+};
+
+inline int shard_of(int64_t id) {
+  uint64_t h = static_cast<uint64_t>(id) * 0x9e3779b97f4a7c15ull;
+  return static_cast<int>(h >> 60) & (kShards - 1);
+}
+
+// splitmix64: deterministic per-(seed, id, col) init, so every process
+// that first touches a row materializes identical values.
+inline uint64_t mix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+void init_row(const Table* t, int64_t id, float* out) {
+  for (int64_t j = 0; j < t->dim; ++j) {
+    uint64_t r = mix(t->seed ^ mix(static_cast<uint64_t>(id) * 1315423911ull +
+                                   static_cast<uint64_t>(j)));
+    float u = static_cast<float>(r >> 40) / static_cast<float>(1ull << 24);
+    out[j] = (2.0f * u - 1.0f) * t->init_range;
+  }
+}
+
+std::vector<float>& row_of(Table* t, Shard& s, int64_t id) {
+  auto it = s.rows.find(id);
+  if (it != s.rows.end()) return it->second;
+  std::vector<float> v(t->row_width(), 0.0f);
+  init_row(t, id, v.data());
+  return s.rows.emplace(id, std::move(v)).first->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int64_t dim, int optimizer, float init_range, uint64_t seed) {
+  Table* t = new Table();
+  t->dim = dim;
+  t->optimizer = optimizer;
+  t->init_range = init_range;
+  t->seed = seed;
+  return t;
+}
+
+void kv_destroy(void* h) { delete static_cast<Table*>(h); }
+
+int64_t kv_dim(void* h) { return static_cast<Table*>(h)->dim; }
+
+int64_t kv_rows(void* h) {
+  Table* t = static_cast<Table*>(h);
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    n += static_cast<int64_t>(s.rows.size());
+  }
+  return n;
+}
+
+void kv_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  Table* t = static_cast<Table*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shards[shard_of(ids[i])];
+    std::lock_guard<std::mutex> g(s.mu);
+    const std::vector<float>& row = row_of(t, s, ids[i]);
+    std::memcpy(out + i * t->dim, row.data(), t->dim * sizeof(float));
+  }
+}
+
+void kv_push(void* h, const int64_t* ids, int64_t n, const float* grads,
+             float lr) {
+  Table* t = static_cast<Table*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shards[shard_of(ids[i])];
+    std::lock_guard<std::mutex> g(s.mu);
+    std::vector<float>& row = row_of(t, s, ids[i]);
+    const float* gr = grads + i * t->dim;
+    if (t->optimizer == kAdaGrad) {
+      float* w = row.data();
+      float* g2 = row.data() + t->dim;
+      for (int64_t j = 0; j < t->dim; ++j) {
+        g2[j] += gr[j] * gr[j];
+        w[j] -= lr * gr[j] / std::sqrt(g2[j] + kAdaEps);
+      }
+    } else {
+      float* w = row.data();
+      for (int64_t j = 0; j < t->dim; ++j) w[j] -= lr * gr[j];
+    }
+  }
+}
+
+// overwrite rows (no optimizer) — used by geo-SGD delta merges and load
+void kv_assign(void* h, const int64_t* ids, int64_t n, const float* vals) {
+  Table* t = static_cast<Table*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shards[shard_of(ids[i])];
+    std::lock_guard<std::mutex> g(s.mu);
+    std::vector<float>& row = row_of(t, s, ids[i]);
+    std::memcpy(row.data(), vals + i * t->dim, t->dim * sizeof(float));
+  }
+}
+
+// add deltas to rows (geo merge: w += delta)
+void kv_merge_add(void* h, const int64_t* ids, int64_t n,
+                  const float* deltas) {
+  Table* t = static_cast<Table*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shards[shard_of(ids[i])];
+    std::lock_guard<std::mutex> g(s.mu);
+    std::vector<float>& row = row_of(t, s, ids[i]);
+    const float* d = deltas + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) row[j] += d[j];
+  }
+}
+
+int64_t kv_keys(void* h, int64_t* out, int64_t cap) {
+  Table* t = static_cast<Table*>(h);
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kvp : s.rows) {
+      if (n >= cap) return n;
+      out[n++] = kvp.first;
+    }
+  }
+  return n;
+}
+
+int kv_save(void* h, const char* path) {
+  Table* t = static_cast<Table*>(h);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int64_t dim = t->dim;
+  int64_t width = static_cast<int64_t>(t->row_width());
+  std::fwrite(&dim, sizeof(dim), 1, f);
+  std::fwrite(&width, sizeof(width), 1, f);
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> g(s.mu);
+    for (auto& kvp : s.rows) {
+      std::fwrite(&kvp.first, sizeof(int64_t), 1, f);
+      std::fwrite(kvp.second.data(), sizeof(float), kvp.second.size(), f);
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int kv_load(void* h, const char* path) {
+  Table* t = static_cast<Table*>(h);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t dim = 0, width = 0;
+  if (std::fread(&dim, sizeof(dim), 1, f) != 1 ||
+      std::fread(&width, sizeof(width), 1, f) != 1 ||
+      dim != t->dim || width != static_cast<int64_t>(t->row_width())) {
+    std::fclose(f);
+    return -2;
+  }
+  int64_t id;
+  std::vector<float> buf(width);
+  while (std::fread(&id, sizeof(id), 1, f) == 1) {
+    if (std::fread(buf.data(), sizeof(float), width, f) !=
+        static_cast<size_t>(width)) {
+      std::fclose(f);
+      return -3;
+    }
+    Shard& s = t->shards[shard_of(id)];
+    std::lock_guard<std::mutex> g(s.mu);
+    s.rows[id] = buf;
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,109 @@
+"""Communicator: background gradient send/param sync threads.
+
+Parity with the reference Communicator family
+(/root/reference/paddle/fluid/operators/distributed/communicator.h:180 —
+:253 AsyncCommunicator (queue + send thread), :326 HalfAsync (batched
+merge), :365 Sync, :396 GeoCommunicator (send param deltas every k
+steps)). The TPU build keeps the same modes but over the TCP PSClient;
+"merge before send" is a numpy groupby-add instead of SelectedRows
+merge."""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .service import PSClient
+
+
+def _merge_dups(ids, grads):
+    """Sum gradients of duplicate ids (communicator MergeVars parity)."""
+    uniq, inv = np.unique(ids, return_inverse=True)
+    merged = np.zeros((uniq.size, grads.shape[1]), grads.dtype)
+    np.add.at(merged, inv, grads)
+    return uniq, merged
+
+
+class AsyncCommunicator:
+    """Queue + background send thread (communicator.h:253). Trainer calls
+    push_sparse_grad and keeps going; the send thread batches
+    send_queue_size entries, merges duplicates, and pushes."""
+
+    def __init__(self, client: PSClient, dim: int, table_id: int = 0,
+                 lr: float = 0.01, send_queue_size: int = 16):
+        self._client = client
+        self._dim = dim
+        self._table = table_id
+        self._lr = lr
+        self._q: queue.Queue = queue.Queue(maxsize=max(send_queue_size, 1))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def push_sparse_grad(self, ids, grads, lr: Optional[float] = None):
+        self._q.put((np.asarray(ids, np.int64).ravel(),
+                     np.asarray(grads, np.float32),
+                     self._lr if lr is None else lr))
+
+    def _loop(self):
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                ids, grads, lr = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            ids, grads = _merge_dups(ids, grads.reshape(ids.size, self._dim))
+            self._client.push(self._table, ids, grads, self._dim, lr)
+            self._q.task_done()
+
+    def flush(self):
+        self._q.join()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+class GeoCommunicator:
+    """GEO-SGD (communicator.h:396 + geo_sgd_transpiler.py): the trainer
+    keeps a local SparseTable replica, trains on it for k steps, then
+    sends the param DELTAS (local - base) and pulls the merged params."""
+
+    def __init__(self, client: PSClient, local_table, table_id: int = 0,
+                 k_steps: int = 4):
+        self._client = client
+        self._local = local_table
+        self._table = table_id
+        self._k = max(1, k_steps)
+        self._step = 0
+        self._base = {}    # id -> row value at last sync
+
+    def snapshot(self, ids):
+        """Record base values for ids about to be trained."""
+        ids = np.asarray(ids, np.int64).ravel()
+        vals = self._local.pull(ids)
+        for i, v in zip(ids, vals):
+            self._base.setdefault(int(i), v.copy())
+
+    def step(self):
+        self._step += 1
+        if self._step % self._k == 0:
+            self.sync()
+
+    def sync(self):
+        if not self._base:
+            return
+        ids = np.fromiter(self._base.keys(), np.int64, len(self._base))
+        base = np.stack([self._base[int(i)] for i in ids])
+        cur = self._local.pull(ids)
+        delta = cur - base
+        self._client.merge_add(self._table, ids, delta, self._local.dim)
+        merged = self._client.pull(self._table, ids, self._local.dim)
+        self._local.assign(ids, merged)
+        self._base.clear()

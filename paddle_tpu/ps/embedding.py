@@ -1,0 +1,75 @@
+"""SparseEmbedding: embedding layer backed by a parameter-server table.
+
+The distributed_lookup_table path (reference
+operators/distributed_ops/distributed_lookup_table_op.cc + the pslib
+DownpourWorker cycle downpour_worker.cc:726: pull sparse before forward,
+push grads after backward). TPU-native shape: forward pulls the touched
+rows into a dense (n, dim) Tensor that joins the autodiff tape like any
+activation; after loss.backward(), push_gradients() reads the pulled
+tensor's grad and pushes it (optimizer applies server-side). The dense
+compute stays on-chip; only the touched rows cross host<->server."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer import Layer
+from .table import SparseTable
+
+
+class SparseEmbedding(Layer):
+    def __init__(self, embedding_dim: int, table: Optional[SparseTable] = None,
+                 client=None, table_id: int = 0, optimizer: str = "sgd",
+                 init_range: float = 0.01, seed: int = 0, name=None):
+        super().__init__()
+        self.embedding_dim = int(embedding_dim)
+        self._table = table
+        self._client = client          # PSClient for remote mode
+        self._table_id = table_id
+        if self._table is None and self._client is None:
+            self._table = SparseTable(embedding_dim, optimizer=optimizer,
+                                      init_range=init_range, seed=seed)
+        self._pending = []             # (ids, pulled Tensor) since last push
+
+    def _pull(self, ids: np.ndarray) -> np.ndarray:
+        if self._client is not None:
+            return self._client.pull(self._table_id, ids,
+                                     self.embedding_dim)
+        return self._table.pull(ids)
+
+    def _push(self, ids: np.ndarray, grads: np.ndarray, lr: float):
+        if self._client is not None:
+            self._client.push(self._table_id, ids, grads,
+                              self.embedding_dim, lr)
+        else:
+            self._table.push(ids, grads, lr)
+
+    def forward(self, ids):
+        """ids: int Tensor/array of any shape -> (*, dim) embeddings."""
+        ids_np = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids,
+                            np.int64)
+        flat = ids_np.ravel()
+        pulled = Tensor(self._pull(flat), stop_gradient=False)
+        if self.training:
+            self._pending.append((flat, pulled))
+        from .. import ops
+
+        out = ops.reshape(pulled, list(ids_np.shape) +
+                          [self.embedding_dim])
+        return out
+
+    def push_gradients(self, lr: float):
+        """Push grads of all pulls since the last call (DownpourWorker's
+        PushSparseVarsWithLabelAsync moment). Call after loss.backward()."""
+        for flat, pulled in self._pending:
+            g = pulled.grad
+            if g is None:
+                continue
+            self._push(flat, np.asarray(g.numpy()
+                                        if hasattr(g, "numpy") else g), lr)
+        self._pending.clear()
+
+    def clear_pending(self):
+        self._pending.clear()

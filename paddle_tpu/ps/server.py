@@ -1,0 +1,36 @@
+"""Server entrypoint: env-driven pserver bootstrap (fleet.run_server).
+
+Parity with the reference pserver startup
+(/root/reference/python/paddle/fluid/incubate/fleet/parameter_server and
+listen_and_serv_op.cc): endpoints/roles come from the PADDLE_* env the
+launcher sets (launch_utils.py), tables are declared via
+PADDLE_PS_TABLES ("id:dim:optimizer,..." — the TrainerDesc/table-config
+analogue)."""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+from .service import PSServer
+from .table import SparseTable
+
+
+def _tables_from_env() -> Dict[int, SparseTable]:
+    spec = os.environ.get("PADDLE_PS_TABLES", "0:8:sgd")
+    tables = {}
+    for part in spec.split(","):
+        tid, dim, opt = (part.split(":") + ["sgd"])[:3]
+        tables[int(tid)] = SparseTable(int(dim), optimizer=opt)
+    return tables
+
+
+def run_server(block: bool = True):
+    """Start serving on PADDLE_PORT (reference listen_and_serv main loop)."""
+    port = int(os.environ.get("PADDLE_PORT", "0"))
+    num_trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    server = PSServer(_tables_from_env(), port=port,
+                      num_trainers=num_trainers).start()
+    print(f"paddle_tpu pserver listening on {server.endpoint}")
+    if block:
+        server.join()
+    return server

@@ -1,0 +1,250 @@
+"""Parameter-server RPC: TCP pull/push service over SparseTables.
+
+TPU-native replacement for the reference PS data plane
+(/root/reference/paddle/fluid/operators/distributed/ — gRPC/BRPC
+send_recv.proto.in SendVariable/GetVariable,
+distributed_ops/listen_and_serv_op.cc server loop, parameter_send.cc /
+parameter_recv.cc sharded send/recv). Design notes: the wire protocol is
+a fixed little-endian header + raw float/int64 payloads (numpy buffers
+straight onto the socket — no proto marshalling on the hot path), ids are
+hash-sharded across server endpoints by the client exactly like the
+reference splits parameter blocks across pservers, and each connection
+gets a server thread (the listen_and_serv thread-per-handler model).
+
+Wire format: [op:u8][table:u32][n:u64][lr:f32] then op-dependent arrays.
+  PULL:  ids[n]i64            -> values[n*dim]f32
+  PUSH:  ids[n]i64 grads f32  -> ack u8
+  MERGE: ids[n]i64 deltas f32 -> ack u8   (geo delta add)
+  SAVE/LOAD: path bytes[n]    -> rc u8
+  ROWS:                       -> count u64
+  BARRIER/STOP:               -> ack u8
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .table import SparseTable
+
+OP_PULL, OP_PUSH, OP_MERGE, OP_SAVE, OP_LOAD, OP_ROWS, OP_BARRIER, \
+    OP_STOP = range(8)
+
+_HDR = struct.Struct("<BIQf")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+    return bytes(buf)
+
+
+class PSServer:
+    """One parameter-server process/thread (listen_and_serv_op parity)."""
+
+    def __init__(self, tables: Dict[int, SparseTable], host="127.0.0.1",
+                 port: int = 0, num_trainers: int = 1):
+        self.tables = tables
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._barrier = threading.Barrier(max(num_trainers, 1))
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self):
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                hdr = _recv_exact(conn, _HDR.size)
+                op, table_id, n, lr = _HDR.unpack(hdr)
+                if op == OP_STOP:
+                    conn.sendall(b"\x01")
+                    self._stop.set()
+                    return
+                if op == OP_BARRIER:
+                    try:
+                        self._barrier.wait(timeout=60)
+                    except threading.BrokenBarrierError:
+                        pass
+                    conn.sendall(b"\x01")
+                    continue
+                table = self.tables[table_id]
+                if op == OP_PULL:
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                    conn.sendall(table.pull(ids).tobytes())
+                elif op in (OP_PUSH, OP_MERGE):
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                    vals = np.frombuffer(
+                        _recv_exact(conn, 4 * n * table.dim), np.float32)
+                    if op == OP_PUSH:
+                        table.push(ids, vals, lr)
+                    else:
+                        table.merge_add(ids, vals)
+                    conn.sendall(b"\x01")
+                elif op in (OP_SAVE, OP_LOAD):
+                    path = _recv_exact(conn, n).decode()
+                    try:
+                        (table.save if op == OP_SAVE else table.load)(path)
+                        conn.sendall(b"\x01")
+                    except IOError:
+                        conn.sendall(b"\x00")
+                elif op == OP_ROWS:
+                    conn.sendall(struct.pack("<Q", table.rows()))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def join(self, timeout: Optional[float] = None):
+        self._stop.wait(timeout)
+
+
+class PSClient:
+    """Trainer-side client: shards ids across endpoints by hash
+    (parameter_send.cc splits param blocks the same way)."""
+
+    def __init__(self, endpoints: Sequence[str]):
+        self._eps = list(endpoints)
+        self._socks: List[Optional[socket.socket]] = [None] * len(self._eps)
+        self._locks = [threading.Lock() for _ in self._eps]
+
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            host, port = self._eps[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _shard(self, ids: np.ndarray):
+        srv = (ids * np.int64(0x9E3779B1) % np.int64(2**31)) % len(self._eps)
+        return [np.nonzero(srv == k)[0] for k in range(len(self._eps))]
+
+    def pull(self, table_id: int, ids, dim: int) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, dim), np.float32)
+        for k, sel in enumerate(self._shard(ids)):
+            if sel.size == 0:
+                continue
+            with self._locks[k]:
+                s = self._sock(k)
+                s.sendall(_HDR.pack(OP_PULL, table_id, sel.size, 0.0))
+                s.sendall(ids[sel].tobytes())
+                vals = np.frombuffer(
+                    _recv_exact(s, 4 * sel.size * dim),
+                    np.float32).reshape(sel.size, dim)
+            out[sel] = vals
+        return out
+
+    def _send_vals(self, op: int, table_id: int, ids, vals, dim: int,
+                   lr: float):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        vals = np.ascontiguousarray(vals, np.float32).reshape(ids.size, dim)
+        for k, sel in enumerate(self._shard(ids)):
+            if sel.size == 0:
+                continue
+            with self._locks[k]:
+                s = self._sock(k)
+                s.sendall(_HDR.pack(op, table_id, sel.size, lr))
+                s.sendall(ids[sel].tobytes())
+                s.sendall(vals[sel].tobytes())
+                _recv_exact(s, 1)
+
+    def push(self, table_id: int, ids, grads, dim: int, lr: float):
+        self._send_vals(OP_PUSH, table_id, ids, grads, dim, lr)
+
+    def merge_add(self, table_id: int, ids, deltas, dim: int):
+        self._send_vals(OP_MERGE, table_id, ids, deltas, dim, 0.0)
+
+    def rows(self, table_id: int) -> int:
+        total = 0
+        for k in range(len(self._eps)):
+            with self._locks[k]:
+                s = self._sock(k)
+                s.sendall(_HDR.pack(OP_ROWS, table_id, 0, 0.0))
+                total += struct.unpack("<Q", _recv_exact(s, 8))[0]
+        return total
+
+    def save(self, table_id: int, path_prefix: str):
+        for k in range(len(self._eps)):
+            p = f"{path_prefix}.shard{k}".encode()
+            with self._locks[k]:
+                s = self._sock(k)
+                s.sendall(_HDR.pack(OP_SAVE, table_id, len(p), 0.0))
+                s.sendall(p)
+                if _recv_exact(s, 1) != b"\x01":
+                    raise IOError(f"save failed on {self._eps[k]}")
+
+    def barrier(self):
+        def one(k):
+            with self._locks[k]:
+                s = self._sock(k)
+                s.sendall(_HDR.pack(OP_BARRIER, 0, 0, 0.0))
+                _recv_exact(s, 1)
+        threads = [threading.Thread(target=one, args=(k,))
+                   for k in range(len(self._eps))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def stop_servers(self):
+        for k in range(len(self._eps)):
+            try:
+                with self._locks[k]:
+                    s = self._sock(k)
+                    s.sendall(_HDR.pack(OP_STOP, 0, 0, 0.0))
+                    _recv_exact(s, 1)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._socks = [None] * len(self._eps)

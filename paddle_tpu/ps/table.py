@@ -1,0 +1,198 @@
+"""SparseTable: host-RAM sparse parameter table.
+
+Python face of native/src/sparse_kv.cc (reference large_scale_kv.h
+SparseVariable + pslib tables — see the .cc header for the mapping).
+Falls back to a pure-numpy dict implementation when no C++ toolchain is
+available; both paths share deterministic init so mixed deployments
+agree.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+SGD = 0
+ADAGRAD = 1
+
+_OPT_NAMES = {"sgd": SGD, "adagrad": ADAGRAD}
+
+
+def _kv_lib():
+    from ..native import load_library
+
+    lib = load_library("sparse_kv")
+    if lib is not None and not getattr(lib, "_pt_typed", False):
+        c = ctypes
+        lib.kv_create.restype = c.c_void_p
+        lib.kv_create.argtypes = [c.c_int64, c.c_int, c.c_float, c.c_uint64]
+        lib.kv_destroy.argtypes = [c.c_void_p]
+        lib.kv_dim.restype = c.c_int64
+        lib.kv_dim.argtypes = [c.c_void_p]
+        lib.kv_rows.restype = c.c_int64
+        lib.kv_rows.argtypes = [c.c_void_p]
+        ptr_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        ptr_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.kv_pull.argtypes = [c.c_void_p, ptr_i64, c.c_int64, ptr_f32]
+        lib.kv_push.argtypes = [c.c_void_p, ptr_i64, c.c_int64, ptr_f32,
+                                c.c_float]
+        lib.kv_assign.argtypes = [c.c_void_p, ptr_i64, c.c_int64, ptr_f32]
+        lib.kv_merge_add.argtypes = [c.c_void_p, ptr_i64, c.c_int64, ptr_f32]
+        lib.kv_keys.restype = c.c_int64
+        lib.kv_keys.argtypes = [c.c_void_p, ptr_i64, c.c_int64]
+        lib.kv_save.restype = c.c_int
+        lib.kv_save.argtypes = [c.c_void_p, c.c_char_p]
+        lib.kv_load.restype = c.c_int
+        lib.kv_load.argtypes = [c.c_void_p, c.c_char_p]
+        lib._pt_typed = True
+    return lib
+
+
+def _splitmix64(x):
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+class SparseTable:
+    """dim-wide rows keyed by int64 id; rows materialize on first pull with
+    deterministic uniform(-init_range, init_range) values; push applies the
+    entry optimizer (sgd / adagrad)."""
+
+    def __init__(self, dim: int, optimizer: str = "sgd",
+                 init_range: float = 0.01, seed: int = 0,
+                 force_python: bool = False):
+        self.dim = int(dim)
+        self.optimizer = _OPT_NAMES[optimizer.lower()]
+        self.init_range = float(init_range)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._lib = None if force_python else _kv_lib()
+        if self._lib is not None:
+            self._h = self._lib.kv_create(self.dim, self.optimizer,
+                                          self.init_range, self.seed)
+        else:
+            self._rows = {}   # id -> np row (value [+ adagrad accum])
+
+    # -- python fallback helpers --------------------------------------------
+    def _py_width(self):
+        return 2 * self.dim if self.optimizer == ADAGRAD else self.dim
+
+    def _py_row(self, i):
+        row = self._rows.get(i)
+        if row is None:
+            row = np.zeros(self._py_width(), np.float32)
+            for j in range(self.dim):
+                r = _splitmix64(self.seed ^ _splitmix64(
+                    (i * 1315423911 + j) & 0xFFFFFFFFFFFFFFFF))
+                u = float(r >> 40) / float(1 << 24)
+                row[j] = (2.0 * u - 1.0) * self.init_range
+            self._rows[i] = row
+        return row
+
+    # -- API -----------------------------------------------------------------
+    def pull(self, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, self.dim), np.float32)
+        if self._lib is not None:
+            self._lib.kv_pull(self._h, ids, ids.size, out)
+            return out
+        with self._lock:
+            for k, i in enumerate(ids):
+                out[k] = self._py_row(int(i))[: self.dim]
+        return out
+
+    def push(self, ids, grads, lr: float):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            ids.size, self.dim)
+        if self._lib is not None:
+            self._lib.kv_push(self._h, ids, ids.size, grads, float(lr))
+            return
+        with self._lock:
+            for k, i in enumerate(ids):
+                row = self._py_row(int(i))
+                g = grads[k]
+                if self.optimizer == ADAGRAD:
+                    row[self.dim:] += g * g
+                    row[: self.dim] -= (lr * g /
+                                        np.sqrt(row[self.dim:] + 1e-6))
+                else:
+                    row[: self.dim] -= lr * g
+
+    def assign(self, ids, values):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        values = np.ascontiguousarray(values, np.float32).reshape(
+            ids.size, self.dim)
+        if self._lib is not None:
+            self._lib.kv_assign(self._h, ids, ids.size, values)
+            return
+        with self._lock:
+            for k, i in enumerate(ids):
+                self._py_row(int(i))[: self.dim] = values[k]
+
+    def merge_add(self, ids, deltas):
+        """w[id] += delta — the geo-SGD server-side merge."""
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        deltas = np.ascontiguousarray(deltas, np.float32).reshape(
+            ids.size, self.dim)
+        if self._lib is not None:
+            self._lib.kv_merge_add(self._h, ids, ids.size, deltas)
+            return
+        with self._lock:
+            for k, i in enumerate(ids):
+                self._py_row(int(i))[: self.dim] += deltas[k]
+
+    def keys(self) -> np.ndarray:
+        if self._lib is not None:
+            n = self.rows()
+            out = np.empty(max(n, 1), np.int64)
+            got = self._lib.kv_keys(self._h, out, out.size)
+            return out[:got]
+        with self._lock:
+            return np.fromiter(self._rows.keys(), np.int64,
+                               len(self._rows))
+
+    def rows(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.kv_rows(self._h))
+        return len(self._rows)
+
+    def save(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if self._lib is not None:
+            rc = self._lib.kv_save(self._h, path.encode())
+            if rc != 0:
+                raise IOError(f"kv_save({path}) failed rc={rc}")
+            return
+        with self._lock, open(path, "wb") as f:
+            np.savez(f, dim=self.dim, width=self._py_width(),
+                     ids=np.fromiter(self._rows, np.int64,
+                                     len(self._rows)),
+                     vals=np.stack(list(self._rows.values()))
+                     if self._rows else np.zeros((0, self._py_width()),
+                                                 np.float32))
+
+    def load(self, path: str):
+        if self._lib is not None:
+            rc = self._lib.kv_load(self._h, path.encode())
+            if rc != 0:
+                raise IOError(f"kv_load({path}) failed rc={rc}")
+            return
+        with self._lock, open(path, "rb") as f:
+            data = np.load(f)
+            for i, v in zip(data["ids"], data["vals"]):
+                self._rows[int(i)] = v.astype(np.float32)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            try:
+                lib.kv_destroy(self._h)
+            except Exception:
+                pass
+            self._h = None

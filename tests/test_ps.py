@@ -1,0 +1,179 @@
+"""Parameter-server subsystem: native KV table, TCP service, communicator
+modes, sparse embedding training (reference test pattern: multi-"node" on
+localhost, SURVEY §4.3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ps import (
+    AsyncCommunicator, GeoCommunicator, PSClient, PSServer, SparseEmbedding,
+    SparseTable,
+)
+
+
+# ---------------------------------------------------------------------------
+# table (native + python fallback parity)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=[False, True], ids=["native", "python"])
+def force_python(request):
+    return request.param
+
+
+def test_table_pull_deterministic_init(force_python):
+    t1 = SparseTable(4, seed=7, force_python=force_python)
+    t2 = SparseTable(4, seed=7, force_python=force_python)
+    ids = np.array([3, 99, 3, 12345678901], np.int64)
+    np.testing.assert_allclose(t1.pull(ids), t2.pull(ids))
+    assert t1.rows() == 3
+    v = t1.pull(ids)
+    np.testing.assert_allclose(v[0], v[2])
+    assert np.all(np.abs(v) <= 0.01 + 1e-7)
+
+
+def test_table_native_python_same_init():
+    ids = np.array([5, 17, 23], np.int64)
+    a = SparseTable(8, seed=3, force_python=False).pull(ids)
+    b = SparseTable(8, seed=3, force_python=True).pull(ids)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_table_push_sgd_and_duplicates(force_python):
+    t = SparseTable(2, optimizer="sgd", init_range=0.0,
+                    force_python=force_python)
+    ids = np.array([1, 1, 2], np.int64)
+    grads = np.array([[1, 0], [1, 0], [0, 2]], np.float32)
+    t.push(ids, grads, lr=0.5)
+    out = t.pull(np.array([1, 2], np.int64))
+    # duplicate id 1 accumulates sequentially: two SGD steps of -0.5*1
+    np.testing.assert_allclose(out, [[-1.0, 0.0], [0.0, -1.0]])
+
+
+def test_table_adagrad(force_python):
+    t = SparseTable(1, optimizer="adagrad", init_range=0.0,
+                    force_python=force_python)
+    ids = np.array([7], np.int64)
+    t.push(ids, np.array([[2.0]], np.float32), lr=1.0)
+    # w -= lr * g / sqrt(g^2 + eps) = -2/sqrt(4) = -1
+    np.testing.assert_allclose(t.pull(ids), [[-1.0]], rtol=1e-4)
+
+
+def test_table_save_load_roundtrip(tmp_path, force_python):
+    t = SparseTable(3, init_range=0.1, force_python=force_python)
+    ids = np.array([1, 2, 3], np.int64)
+    t.push(ids, np.ones((3, 3), np.float32), lr=0.1)
+    ref = t.pull(ids)
+    p = str(tmp_path / "table.bin")
+    t.save(p)
+    t2 = SparseTable(3, init_range=0.1, force_python=force_python)
+    t2.load(p)
+    np.testing.assert_allclose(t2.pull(ids), ref)
+    assert t2.rows() == 3
+
+
+# ---------------------------------------------------------------------------
+# TCP service: 2 "pservers" on localhost (reference _run_cluster pattern)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def two_servers():
+    servers = [PSServer({0: SparseTable(4, init_range=0.0, seed=1)}).start()
+               for _ in range(2)]
+    client = PSClient([s.endpoint for s in servers])
+    yield client
+    client.stop_servers()
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+def test_ps_pull_push_sharded(two_servers):
+    client = two_servers
+    ids = np.arange(20, dtype=np.int64)
+    vals = client.pull(0, ids, 4)
+    np.testing.assert_allclose(vals, 0.0)
+    grads = np.ones((20, 4), np.float32)
+    client.push(0, ids, grads, 4, lr=0.25)
+    out = client.pull(0, ids, 4)
+    np.testing.assert_allclose(out, -0.25)
+    # rows spread over both shards, none lost
+    assert client.rows(0) == 20
+
+
+def test_ps_merge_add_and_save(two_servers, tmp_path):
+    client = two_servers
+    ids = np.array([1, 2, 3, 4], np.int64)
+    client.merge_add(0, ids, np.full((4, 4), 2.0, np.float32), 4)
+    np.testing.assert_allclose(client.pull(0, ids, 4), 2.0)
+    client.save(0, str(tmp_path / "ps"))
+    import glob
+
+    assert len(glob.glob(str(tmp_path / "ps.shard*"))) == 2
+
+
+# ---------------------------------------------------------------------------
+# communicators
+# ---------------------------------------------------------------------------
+
+
+def test_async_communicator_flush(two_servers):
+    client = two_servers
+    comm = AsyncCommunicator(client, dim=4, lr=0.5).start()
+    ids = np.array([5, 5, 6], np.int64)
+    grads = np.ones((3, 4), np.float32)
+    comm.push_sparse_grad(ids, grads)
+    comm.flush()
+    comm.stop()
+    out = client.pull(0, np.array([5, 6], np.int64), 4)
+    # dup id 5 merged (sum) then one SGD step: -0.5*2 and -0.5*1
+    np.testing.assert_allclose(out[0], -1.0)
+    np.testing.assert_allclose(out[1], -0.5)
+
+
+def test_geo_communicator_sync(two_servers):
+    client = two_servers
+    local = SparseTable(4, init_range=0.0, seed=1, force_python=True)
+    geo = GeoCommunicator(client, local, k_steps=2)
+    ids = np.array([9, 10], np.int64)
+    geo.snapshot(ids)
+    local.push(ids, np.ones((2, 4), np.float32), lr=1.0)  # local -1 delta
+    geo.step()          # step 1: no sync yet
+    assert client.pull(0, ids, 4).max() == 0.0
+    geo.step()          # step 2: delta sent, params merged back
+    np.testing.assert_allclose(client.pull(0, ids, 4), -1.0)
+    np.testing.assert_allclose(local.pull(ids), -1.0)
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding end-to-end (CTR-style: DownpourWorker cycle)
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_embedding_trains():
+    paddle.seed(0)
+    from paddle_tpu import nn
+
+    emb = SparseEmbedding(8, optimizer="sgd", init_range=0.01, seed=2)
+    fc = nn.Linear(8, 1)
+    rng = np.random.RandomState(0)
+    ids_all = rng.randint(0, 50, (200,)).astype(np.int64)
+    y_all = (ids_all % 2).astype(np.float32)   # parity of the id
+
+    losses = []
+    for step in range(30):
+        sel = rng.randint(0, 200, (32,))
+        ids = ids_all[sel]
+        y = paddle.to_tensor(y_all[sel].reshape(-1, 1))
+        e = emb(paddle.to_tensor(ids))
+        logit = fc(e)
+        loss = ((logit - y) ** 2).mean()
+        loss.backward()
+        emb.push_gradients(lr=0.5)
+        for p in fc.parameters():
+            p._value = p._value - 0.1 * p.grad.value
+            p.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+    assert emb._table.rows() <= 50
