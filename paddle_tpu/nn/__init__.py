@@ -40,3 +40,4 @@ from .rnn import (  # noqa: F401
     LSTM, GRU,
 )
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
+from .moe import MoELayer, moe_apply_ep, MOE_EP_RULES  # noqa: F401

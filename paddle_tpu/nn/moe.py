@@ -1,0 +1,185 @@
+"""Mixture-of-Experts with expert parallelism (the "ep" mesh axis).
+
+The reference framework predates MoE entirely (SURVEY §2.6: EP absent) —
+this is a TPU-first design, not a port. Tokens are routed top-2 by a
+learned gate with a GShard/Switch-style static capacity (overflow tokens
+drop to the residual path, keeping every shape static for XLA).
+
+How the expert parallelism actually works: gating and the dispatch/
+combine einsums are written on global arrays; the expert FFN runs inside
+`shard_map` with the expert-stacked weights and the (e, c, d) expert
+blocks sharded over "ep". The token exchange is therefore the resharding
+XLA inserts at the shard_map boundary (token-sharded -> expert-sharded
+and back) — collectives over ICI equivalent to the classic explicit
+all_to_all dispatch. A hand-written all_to_all dispatch that also
+parallelizes the dispatch/combine einsums is the known next optimization
+if the gate math ever dominates.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from ..framework.op import primitive
+from .layer import Layer
+
+__all__ = ["MoELayer", "moe_apply_ep", "MOE_EP_RULES", "top2_gating"]
+
+# parameter sharding rules: expert-stacked weights shard over "ep"
+MOE_EP_RULES = [
+    (r".*experts_w1$", PartitionSpec("ep", None, None)),
+    (r".*experts_b1$", PartitionSpec("ep", None)),
+    (r".*experts_w2$", PartitionSpec("ep", None, None)),
+    (r".*experts_b2$", PartitionSpec("ep", None)),
+]
+
+
+def top2_gating(logits, capacity: int):
+    """GShard top-2 gating with static capacity.
+
+    logits: (tokens, experts). Returns (dispatch (t, e, c) bool,
+    combine (t, e, c) float) — dispatch scatters tokens into expert
+    capacity slots, combine holds the normalized gate weights.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    g1_idx = jnp.argmax(probs, axis=-1)                     # (t,)
+    g1 = jnp.take_along_axis(probs, g1_idx[:, None], 1)[:, 0]
+    probs2 = probs.at[jnp.arange(t), g1_idx].set(0.0)
+    g2_idx = jnp.argmax(probs2, axis=-1)
+    g2 = jnp.take_along_axis(probs2, g2_idx[:, None], 1)[:, 0]
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    def slots_for(idx):
+        # position of each token within its expert's queue (running count)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)    # (t, e)
+        pos = jnp.cumsum(onehot, axis=0) - onehot           # tokens before
+        return jnp.sum(pos * onehot, axis=-1)               # (t,)
+
+    pos1 = slots_for(g1_idx)
+    # second choice queues behind all first choices of that expert
+    count1 = jnp.sum(jax.nn.one_hot(g1_idx, e, dtype=jnp.int32), axis=0)
+    pos2 = slots_for(g2_idx) + count1[g2_idx]
+
+    def scatter(idx, pos):
+        keep = pos < capacity
+        d = (jax.nn.one_hot(idx, e, dtype=jnp.float32)[:, :, None] *
+             jax.nn.one_hot(jnp.where(keep, pos, 0), capacity,
+                            dtype=jnp.float32)[:, None, :])
+        d = d * keep[:, None, None]
+        return d
+
+    d1 = scatter(g1_idx, pos1)
+    d2 = scatter(g2_idx, pos2)
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    dispatch = (d1 + d2) > 0
+    # load-balancing auxiliary loss (GShard eq.4): mean prob * mean assignment
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(g1_idx, e, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * e
+    return dispatch, combine, aux
+
+
+def _expert_ffn(w1, b1, w2, b2, x):
+    """One expert's FFN on its capacity block: x (c, d)."""
+    return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+
+def moe_apply_ep(params, x, *, mesh: Optional[Mesh] = None, axis: str = "ep",
+                 capacity_factor: float = 2.0):
+    """Expert-parallel MoE apply inside shard_map.
+
+    params: dict with gate_w (d, E), experts_w1 (E, d, h), experts_b1
+    (E, h), experts_w2 (E, h, d), experts_b2 (E, d). x: (tokens, d)
+    global. Experts shard over `axis`; tokens all_to_all to their
+    experts and back. Falls back to the dense einsum path when the mesh
+    axis is unusable.
+    """
+    e = params["experts_w1"].shape[0]
+    t, d = x.shape
+    capacity = max(1, int(capacity_factor * t / e))
+
+    logits = x @ params["gate_w"]
+    dispatch, combine, aux = top2_gating(logits, capacity)
+    # gather expert inputs: (e, c, d)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+
+    if mesh is None or axis not in mesh.axis_names or \
+            mesh.shape[axis] <= 1 or e % mesh.shape[axis] != 0:
+        out_e = jax.vmap(_expert_ffn)(
+            params["experts_w1"], params["experts_b1"],
+            params["experts_w2"], params["experts_b2"], expert_in)
+    else:
+        n = mesh.shape[axis]
+
+        def local(w1, b1, w2, b2, ein):
+            # ein arrives (e/n, c, d) after the spec split: this rank's
+            # experts' tokens. (XLA inserts the all_to_all when the
+            # upstream einsum output resharded from token- to expert-
+            # sharded layout.)
+            return jax.vmap(_expert_ffn)(w1, b1, w2, b2, ein)
+
+        spec_e = PartitionSpec(axis)
+        out_e = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(spec_e, spec_e, spec_e, spec_e, spec_e),
+            out_specs=spec_e,
+        )(params["experts_w1"], params["experts_b1"],
+          params["experts_w2"], params["experts_b2"], expert_in)
+    # combine back to tokens
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out_e)
+    return out, aux
+
+
+@primitive("moe")
+def _moe_prim(xf, gate_w, w1, b1, w2, b2, mesh=None, capacity_factor=2.0):
+    params = {"gate_w": gate_w, "experts_w1": w1, "experts_b1": b1,
+              "experts_w2": w2, "experts_b2": b2}
+    return moe_apply_ep(params, xf, mesh=mesh,
+                        capacity_factor=capacity_factor)
+
+
+class MoELayer(Layer):
+    """Transformer FFN replaced by num_experts expert FFNs + top-2 gate."""
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 capacity_factor: float = 2.0, name=None):
+        super().__init__()
+        from .initializer import XavierUniform
+
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        init = XavierUniform()
+        self.gate_w = self.create_parameter(
+            [d_model, num_experts], default_initializer=init)
+        self.experts_w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=init)
+        self.experts_b1 = self.create_parameter(
+            [num_experts, d_hidden], is_bias=True)
+        self.experts_w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model], default_initializer=init)
+        self.experts_b2 = self.create_parameter(
+            [num_experts, d_model], is_bias=True)
+        self._last_aux_loss = None
+
+    def forward(self, x):
+        from .. import ops
+        from ..parallel.mesh import get_mesh
+
+        shape = x.shape
+        xf = ops.reshape(x, [-1, shape[-1]])
+        out, aux = _moe_prim(xf, self.gate_w, self.experts_w1,
+                             self.experts_b1, self.experts_w2,
+                             self.experts_b2, mesh=get_mesh(),
+                             capacity_factor=self.capacity_factor)
+        self._last_aux_loss = aux
+        return ops.reshape(out, list(shape))
+
+    @property
+    def aux_loss(self):
+        return self._last_aux_loss
