@@ -102,6 +102,10 @@ def run_block(block: Block, env: Dict[str, Any], ctx: ExecContext,
         if stop_at is not None and i >= stop_at:
             break
         ctx.op_index = i
+        # control-flow kernels (cond/while) recurse into sub-blocks and
+        # need the program + a snapshot of the enclosing env
+        ctx.program = block.program
+        ctx.env = env
         if op.type == "backward":
             run_backward_op(block, i, op, env, ctx)
             continue

@@ -30,6 +30,9 @@ class ExecContext:
     rng_key: Any = None          # jax PRNGKey (traced)
     op_index: int = 0            # position in block, folds into the key
     is_test: bool = False
+    program: Any = None          # set by run_block: owning Program
+    env: Any = None              # set by run_block: live name->array env
+                                 # (control-flow kernels snapshot it)
 
     def key(self):
         return jax.random.fold_in(self.rng_key, self.op_index)
@@ -659,4 +662,82 @@ def _lamb(ins, attrs, ctx):
 
 @kernel("increment")
 def _increment(ins, attrs, ctx):
-    return _out(_x(ins) + attrs.get("step", 1.0))
+    x = _x(ins)
+    return _out(x + jnp.asarray(attrs.get("step", 1.0), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference operators/controlflow/conditional_block_op.cc and
+# while_op.cc: an inner Executor runs the sub-block; here the sub-block is
+# traced into lax.cond / lax.while_loop so the whole construct compiles)
+# ---------------------------------------------------------------------------
+
+
+def _sub_ctx(ctx, block_idx, extra=None):
+    """Context for a sub-block trace: distinct RNG stream per block (and
+    per loop iteration via `extra`), so random ops inside control flow
+    don't reuse the outer block's per-op keys."""
+    from dataclasses import replace
+
+    key = ctx.rng_key
+    if key is not None:
+        key = jax.random.fold_in(key, 7919 + block_idx)
+        if extra is not None:
+            key = jax.random.fold_in(key, extra)
+    return replace(ctx, rng_key=key)
+
+
+@kernel("cond")
+def _cond(ins, attrs, ctx):
+    from .executor import run_block
+
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    prog = ctx.program
+    outer_env = dict(ctx.env)
+
+    def make_branch(block_idx, out_names):
+        blk = prog.blocks[block_idx]
+
+        def branch(_):
+            env = dict(outer_env)
+            env = run_block(blk, env, _sub_ctx(ctx, block_idx))
+            return tuple(env[n] for n in out_names)
+
+        return branch
+
+    outs = jax.lax.cond(
+        pred,
+        make_branch(attrs["sub_block_t"], attrs["out_t"]),
+        make_branch(attrs["sub_block_f"], attrs["out_f"]),
+        None)
+    return {"Out": list(outs)}
+
+
+@kernel("while")
+def _while(ins, attrs, ctx):
+    from .executor import run_block
+
+    prog = ctx.program
+    blk = prog.blocks[attrs["sub_block"]]
+    loop_in = attrs["loop_in"]          # parent names body ops read
+    body_out = attrs["body_out"]        # names body ops write
+    cond_out = attrs["cond_out"]        # recomputed condition name
+    outer_env = dict(ctx.env)
+    init_vals = tuple(ins["X"])
+    init_cond = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+
+    def cond_fn(state):
+        return state[0]
+
+    def body_fn(state):
+        _, it, vals = state
+        env = dict(outer_env)
+        env.update(zip(loop_in, vals))
+        # fresh RNG stream per iteration (it rides the loop carry)
+        env = run_block(blk, env, _sub_ctx(ctx, attrs["sub_block"], it))
+        return (jnp.reshape(env[cond_out], ()).astype(bool), it + 1,
+                tuple(env[n] for n in body_out))
+
+    _, _, final = jax.lax.while_loop(
+        cond_fn, body_fn, (init_cond, jnp.asarray(0, jnp.int32), init_vals))
+    return {"Out": list(final)}

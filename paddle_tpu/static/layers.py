@@ -546,17 +546,42 @@ def accuracy(input, label, k=1, name=None):
     return acc
 
 
-# comparison layers
+def increment(x, value=1.0, in_place=True):
+    """x + value keeping dtype (reference layers/control_flow.py:increment,
+    operators/increment_op.cc). in_place=True (the reference default)
+    writes back to x's own variable, so later reads in the same block see
+    the updated value."""
+    helper = LayerHelper("increment")
+    if in_place:
+        helper.block.append_op(type="increment", inputs={"X": [x]},
+                               outputs={"Out": [x.name]},
+                               attrs={"step": value})
+        return helper.block.var(x.name)
+    return _append_simple("increment", {"X": [x]}, {"step": value})
+
+
+# comparison layers (python scalars wrap into fill_constant like the
+# reference's math_op_patch scalar promotion)
+def _cmp_operand(x, y):
+    if not hasattr(y, "name"):
+        y = fill_constant(shape=(1,), dtype=x.dtype, value=float(y))
+    return y
+
+
 def equal(x, y):
-    return _append_simple("equal", {"X": [x], "Y": [y]}, {"axis": -1})
+    return _append_simple("equal", {"X": [x], "Y": [_cmp_operand(x, y)]},
+                          {"axis": -1})
 
 
 def less_than(x, y):
-    return _append_simple("less_than", {"X": [x], "Y": [y]}, {"axis": -1})
+    return _append_simple("less_than", {"X": [x], "Y": [_cmp_operand(x, y)]},
+                          {"axis": -1})
 
 
 def greater_than(x, y):
-    return _append_simple("greater_than", {"X": [x], "Y": [y]}, {"axis": -1})
+    return _append_simple("greater_than",
+                          {"X": [x], "Y": [_cmp_operand(x, y)]},
+                          {"axis": -1})
 
 
 def logical_and(x, y):
@@ -565,3 +590,142 @@ def logical_and(x, y):
 
 def logical_not(x):
     return _append_simple("logical_not", {"X": [x]})
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference fluid/layers/control_flow.py: cond :2117,
+# While :1086, while_loop :1298, case/switch_case; executed by the
+# conditional_block_op.cc / while_op.cc sub-block pattern — here compiled
+# into lax.cond / lax.while_loop by the cond/while kernels)
+# ---------------------------------------------------------------------------
+
+
+def _as_var_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Static two-branch conditional. true_fn/false_fn build their ops in
+    fresh sub-blocks; both must return the same structure of Variables
+    with matching shapes/dtypes."""
+    from .ir import _BlockGuard
+
+    helper = LayerHelper("cond")
+    prog = helper.main_program
+    parent = prog.current_block()
+
+    tb = prog.create_block()
+    with _BlockGuard(prog, tb):
+        t_out = true_fn() if true_fn is not None else None
+    fb = prog.create_block()
+    with _BlockGuard(prog, fb):
+        f_out = false_fn() if false_fn is not None else None
+
+    t_list, f_list = _as_var_list(t_out), _as_var_list(f_out)
+    if len(t_list) != len(f_list):
+        raise ValueError(
+            f"cond branches must return the same number of outputs "
+            f"({len(t_list)} vs {len(f_list)})")
+    if not t_list:
+        raise NotImplementedError(
+            "cond branches returned no outputs; side-effect-only cond "
+            "(writes into parent-block vars) is not supported — return "
+            "the values you need and assign them after the cond")
+
+    out_names = [unique_name.generate("cond.out") for _ in t_list]
+    parent.append_op(
+        type="cond",
+        inputs={"Cond": [pred.name]},
+        outputs={"Out": out_names},
+        attrs={"sub_block_t": tb.idx, "sub_block_f": fb.idx,
+               "out_t": [v.name for v in t_list],
+               "out_f": [v.name for v in f_list]})
+    outs = []
+    for name_, tv in zip(out_names, t_list):
+        parent.create_var(name=name_, shape=tv.shape, dtype=tv.dtype)
+        outs.append(parent.var(name_))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """`while cond_fn(*vars): vars = body_fn(*vars)` compiled to
+    lax.while_loop (reference layers/control_flow.py:1298).
+
+    Constraints vs the reference while_op: loop-carried shapes/dtypes must
+    be invariant; ONLY the returned loop_vars are carried across
+    iterations — body writes to other parent-block variables are local to
+    one iteration and discarded (no scope write-back); is_test is accepted
+    for API parity but has no effect (no test-mode caching to skip)."""
+    from .ir import _BlockGuard
+
+    helper = LayerHelper("while_loop")
+    prog = helper.main_program
+    parent = prog.current_block()
+    loop_vars = list(loop_vars)
+
+    pre_cond = cond_fn(*loop_vars)           # evaluated in the parent block
+
+    sb = prog.create_block()
+    with _BlockGuard(prog, sb):
+        new_vars = body_fn(*loop_vars)
+        new_vars = (list(new_vars) if isinstance(new_vars, (list, tuple))
+                    else [new_vars])
+        if len(new_vars) != len(loop_vars):
+            raise ValueError("body_fn must return as many values as "
+                             "loop_vars")
+        new_cond = cond_fn(*new_vars)        # recomputed inside the block
+
+    out_names = [unique_name.generate("while.out") for _ in loop_vars]
+    parent.append_op(
+        type="while",
+        inputs={"X": [v.name for v in loop_vars],
+                "Cond": [pre_cond.name]},
+        outputs={"Out": out_names},
+        attrs={"sub_block": sb.idx,
+               "loop_in": [v.name for v in loop_vars],
+               "body_out": [v.name for v in new_vars],
+               "cond_out": new_cond.name})
+    outs = []
+    for name_, lv in zip(out_names, loop_vars):
+        parent.create_var(name=name_, shape=lv.shape, dtype=lv.dtype)
+        outs.append(parent.var(name_))
+    return outs
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First matching (pred, fn) wins (reference control_flow.py case)."""
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        if not pairs:
+            raise ValueError("case()/switch_case() needs at least one "
+                             "(pred, fn) pair or a default branch")
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+
+    def build(i):
+        if i >= len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index (reference control_flow.py
+    switch_case). branch_fns: dict index->fn or list of (index, fn)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = [(i, fn) if not isinstance(fn, tuple) else fn
+                 for i, fn in enumerate(branch_fns)]
+    pairs = []
+    for idx, fn in items:
+        pred = _append_simple(
+            "equal", {"X": [branch_index],
+                      "Y": [fill_constant(branch_index.shape or [1],
+                                          branch_index.dtype, idx)]})
+        pairs.append((pred, fn))
+    return case(pairs, default=default, name=name)

@@ -1,0 +1,77 @@
+"""Static-graph control flow: cond / while_loop / case / switch_case
+compiled through the executor (reference conditional_block_op / while_op
+semantics on lax.cond / lax.while_loop)."""
+import numpy as np
+
+from paddle_tpu import static
+from paddle_tpu.static import Executor, Program, program_guard
+from paddle_tpu.static import layers as L
+
+
+def _run(main, feed, fetch):
+    exe = Executor()
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_cond_selects_branch():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", shape=[1], dtype="float32")
+        pred = L.greater_than(x, 0.0)
+        out = L.cond(pred,
+                     lambda: L.scale(x, scale=2.0),
+                     lambda: L.scale(x, scale=-1.0))
+    for val, expect in [(3.0, 6.0), (-4.0, 4.0)]:
+        res = _run(main, {"x": np.array([val], np.float32)}, [out])
+        np.testing.assert_allclose(res[0], [expect], rtol=1e-6)
+
+
+def test_cond_multiple_outputs():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = L.data("x", shape=[2], dtype="float32")
+        pred = L.greater_than(L.reduce_sum(x), 0.0)
+        a, b = L.cond(
+            pred,
+            lambda: (L.scale(x, scale=1.0), L.scale(x, scale=2.0)),
+            lambda: (L.scale(x, scale=-1.0), L.scale(x, scale=-2.0)))
+    res = _run(main, {"x": np.array([1.0, 2.0], np.float32)}, [a, b])
+    np.testing.assert_allclose(res[0], [1.0, 2.0])
+    np.testing.assert_allclose(res[1], [2.0, 4.0])
+
+
+def test_while_loop_accumulates():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        i = L.fill_constant([1], "int64", 0)
+        s = L.fill_constant([1], "float32", 0.0)
+        limit = L.fill_constant([1], "int64", 10)
+
+        def cond_fn(i, s):
+            return L.less_than(i, limit)
+
+        def body_fn(i, s):
+            return [L.increment(i, value=1.0),
+                    L.elementwise_add(s, L.cast(i, "float32"))]
+
+        i_out, s_out = L.while_loop(cond_fn, body_fn, [i, s])
+    res = _run(main, {}, [i_out, s_out])
+    assert int(res[0][0]) == 10
+    # increment is in-place (reference semantics): the add reads the
+    # post-increment i, so s = 1+2+...+10 = 55
+    assert float(res[1][0]) == 55.0
+
+
+def test_case_and_switch_case():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        idx = L.data("idx", shape=[1], dtype="int64")
+        one = L.fill_constant([1], "float32", 1.0)
+        out = L.switch_case(
+            idx,
+            {0: lambda: L.scale(one, scale=10.0),
+             1: lambda: L.scale(one, scale=20.0)},
+            default=lambda: L.scale(one, scale=-1.0))
+    for v, expect in [(0, 10.0), (1, 20.0), (7, -1.0)]:
+        res = _run(main, {"idx": np.array([v], np.int64)}, [out])
+        np.testing.assert_allclose(res[0], [expect])
