@@ -40,6 +40,7 @@ from .framework.tape import no_grad as no_grad  # noqa: F401
 from . import profiler  # noqa: F401
 from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
+from . import quantization  # noqa: F401
 
 
 def save(obj, path, **kwargs):

@@ -1,0 +1,117 @@
+"""GPT-style decoder-only causal LM.
+
+The reference has no GPT (it predates the 2.0 model zoo's gpt); this is
+the TPU-native flagship decoder: pre-LN blocks built from the same
+MultiHeadAttention/Linear layers as the encoder stack, with
+is_causal=True attention so the mask-free path composes with ring
+attention (parallel/ring.py) for long-context training and with
+TRANSFORMER_TP_RULES for tensor parallelism (q_proj/out_proj/linear1/2
+naming preserved).
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..nn.transformer import MultiHeadAttention
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50257, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=None, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, max_position_embeddings=64,
+                   hidden_dropout_prob=0.0,
+                   attention_probs_dropout_prob=0.0)
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(cfg.hidden_size)
+        self.self_attn = MultiHeadAttention(
+            cfg.hidden_size, cfg.num_attention_heads,
+            dropout=cfg.attention_probs_dropout_prob, is_causal=True)
+        self.ln2 = LayerNorm(cfg.hidden_size)
+        self.linear1 = Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.linear2 = Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.self_attn(self.ln1(x)))
+        h = self.linear2(F.gelu(self.linear1(self.ln2(x))))
+        return x + self.dropout(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embedding = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.pos_embedding = Embedding(cfg.max_position_embeddings,
+                                       cfg.hidden_size)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        from ..nn.container import LayerList
+
+        self.layers = LayerList(
+            [GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids):
+        b, l = input_ids.shape
+        pos = ops.arange(0, l, dtype="int32")
+        x = self.word_embedding(input_ids) + self.pos_embedding(pos)
+        x = self.dropout(x)
+        for blk in self.layers:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        # weight tying with the input embedding (standard GPT)
+        self.cfg = cfg
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        w = self.gpt.word_embedding.weight          # (V, D)
+        return ops.matmul(h, ops.transpose(w, [1, 0]))
+
+    def loss(self, input_ids, labels=None):
+        """Next-token LM loss; labels default to input_ids shifted."""
+        logits = self(input_ids)
+        if labels is None:
+            labels = input_ids
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        v = shift_logits.shape[-1]
+        flat = ops.reshape(shift_logits, [-1, v])
+        return F.cross_entropy(flat, ops.reshape(shift_labels, [-1])).mean()
+
+    def generate(self, input_ids, max_new_tokens=16):
+        """Greedy decode (eager; compile-friendly decode cache comes with
+        the serving path)."""
+        ids = input_ids
+        for _ in range(max_new_tokens):
+            window = ids[:, -self.cfg.max_position_embeddings:]
+            logits = self(window)
+            nxt = ops.argmax(logits[:, -1, :], axis=-1)
+            ids = ops.concat([ids, ops.reshape(nxt, [-1, 1])], axis=1)
+        return ids

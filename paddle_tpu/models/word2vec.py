@@ -1,0 +1,65 @@
+"""Word2Vec skip-gram with negative sampling.
+
+Parity with the reference book example
+(/root/reference/python/paddle/fluid/tests/book/test_word2vec.py — there
+an N-gram MLP; plus the large-scale PS variants under
+tests/unittests/dist_word2vec.py). TPU-native: dense batched
+embedding lookups + sampled softmax via negative sampling — no
+dynamic-shape tables; the PS-backed variant swaps the Embedding for
+ps.SparseEmbedding unchanged.
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.common import Embedding
+from ..nn.layer import Layer
+
+
+class SkipGram(Layer):
+    def __init__(self, vocab_size: int, embedding_dim: int = 128):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.in_embed = Embedding(vocab_size, embedding_dim)
+        self.out_embed = Embedding(vocab_size, embedding_dim)
+
+    def forward(self, center, context, negatives):
+        """center: (b,), context: (b,), negatives: (b, k). Returns the
+        negative-sampling loss (Mikolov et al.)."""
+        v_c = self.in_embed(center)                      # (b, d)
+        u_o = self.out_embed(context)                    # (b, d)
+        u_n = self.out_embed(negatives)                  # (b, k, d)
+        pos = ops.sum(v_c * u_o, axis=-1)                # (b,)
+        neg = ops.matmul(u_n, ops.reshape(v_c, list(v_c.shape) + [1]))
+        neg = ops.reshape(neg, list(negatives.shape))    # (b, k)
+        loss = -(ops.log_sigmoid(pos).mean() +
+                 ops.log_sigmoid(-neg).sum(axis=-1).mean())
+        return loss
+
+    def embeddings(self):
+        return self.in_embed.weight
+
+
+class NGramLM(Layer):
+    """The book test's N-gram neural LM (test_word2vec.py: 4 context
+    words -> hidden -> softmax over vocab)."""
+
+    def __init__(self, vocab_size: int, embedding_dim: int = 32,
+                 context: int = 4, hidden: int = 256):
+        super().__init__()
+        from ..nn.common import Linear
+
+        self.embed = Embedding(vocab_size, embedding_dim)
+        self.fc1 = Linear(context * embedding_dim, hidden)
+        self.fc2 = Linear(hidden, vocab_size)
+        self.context = context
+
+    def forward(self, words):
+        """words: (b, context) int ids -> logits (b, vocab)."""
+        e = self.embed(words)                            # (b, c, d)
+        h = ops.reshape(e, [e.shape[0], -1])
+        h = ops.tanh(self.fc1(h))
+        return self.fc2(h)
+
+    def loss(self, words, target):
+        return F.cross_entropy(self(words), target).mean()

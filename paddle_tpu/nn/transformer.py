@@ -23,10 +23,12 @@ class MultiHeadAttention(Layer):
     """q/k/v projections + scaled dot-product attention (B, L, H, D)."""
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
-                 need_weights=False, weight_attr=None, bias_attr=None):
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 is_causal=False):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
+        self.is_causal = is_causal
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim
         self.dropout = dropout
@@ -55,8 +57,23 @@ class MultiHeadAttention(Layer):
             k = ops.concat([cache.k, k], axis=1)
             v = ops.concat([cache.v, v], axis=1)
             cache = type(cache)(k, v)
+        if self.is_causal and attn_mask is not None:
+            # fold the causal constraint into the user mask (bottom-right
+            # aligned, matching the mask-free is_causal path)
+            from .. import ops
+
+            lqk, lkk = q.shape[1], k.shape[1]
+            causal = ops.tril(
+                ops.ones([lqk, lkk], "bool"), diagonal=lkk - lqk)
+            if "bool" in str(attn_mask.dtype):
+                attn_mask = ops.logical_and(attn_mask, causal)
+            else:
+                attn_mask = attn_mask + ops.where(
+                    causal, ops.zeros([lqk, lkk], attn_mask.dtype),
+                    ops.full([lqk, lkk], -1e30, attn_mask.dtype))
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            is_causal=self.is_causal and attn_mask is None,
             training=self.training)
         out = ops.reshape(out, [b, lq, self.embed_dim])
         out = self.out_proj(out)

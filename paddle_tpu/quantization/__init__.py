@@ -1,0 +1,191 @@
+"""Quantization: QAT fake-quant training + PTQ calibration.
+
+Parity with the reference slim quantization stack
+(/root/reference/python/paddle/fluid/contrib/slim/quantization/ —
+QuantizationTransformPass inserting fake_quantize/fake_dequantize ops,
+quant_int8 inference conversion; imperative qat.py ImperativeQuantAware).
+TPU-native design: instead of graph passes over a ProgramDesc, layers are
+wrapped — QuantedLinear/QuantedConv2D fake-quantize weights and
+activations in forward with the straight-through estimator
+(x + stop_gradient(q(x) - x)), so the same Python model trains
+quant-aware under jit/pjit. PTQ runs calibration forwards that record
+moving-average abs-max ranges, then `convert` bakes int8 weights +
+scales for inference export.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.op import primitive
+from ..framework.tensor import Tensor
+from ..nn import conv as conv_mod
+from ..nn import common as common_mod
+from ..nn.layer import Layer
+
+__all__ = ["fake_quant", "QuantConfig", "QAT", "PTQ", "QuantedLinear",
+           "QuantedConv2D", "quant_aware", "convert"]
+
+
+@primitive("fake_quantize_dequantize", nondiff=("scale",))
+def fake_quant(x, scale, bit_length=8, name=None):
+    """Simulated symmetric quantization with STE gradient (reference
+    fake_quantize_op.cc fake_quantize_dequantize_moving_average_abs_max).
+    """
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax) * s / qmax
+    # straight-through: forward q, backward identity
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class QuantConfig:
+    """Subset of the reference quant config knobs that matter on TPU."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9,
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.quantizable_layer_type = tuple(quantizable_layer_type)
+
+
+class _QuantedBase(Layer):
+    """Wraps an inner layer: fake-quant weight (abs-max per tensor) and
+    input activation (moving-average abs-max observer buffer)."""
+
+    def __init__(self, inner: Layer, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self._cfg = config
+        # PTQ calibration records ranges without putting the model in
+        # train() (dropout/BN must stay in inference mode)
+        self._calibrating = False
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(0.0, jnp.float32)))
+
+    def _observe(self, x):
+        amax = jnp.max(jnp.abs(x.value if isinstance(x, Tensor) else x))
+        prev = self.act_scale.value
+        r = self._cfg.moving_rate
+        new = jnp.where(prev > 0, r * prev + (1 - r) * amax, amax)
+        if self.training or self._calibrating:
+            self.act_scale._value = new.astype(jnp.float32)
+            return new
+        return jnp.where(prev > 0, prev, amax)
+
+    def _q_act(self, x):
+        scale = self._observe(x)
+        return fake_quant(x, scale, self._cfg.activation_bits)
+
+    def _q_weight(self, w):
+        scale = jnp.max(jnp.abs(w.value if isinstance(w, Tensor) else w))
+        return fake_quant(w, scale, self._cfg.weight_bits)
+
+
+class QuantedLinear(_QuantedBase):
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        inner = self.inner
+        xq = self._q_act(x)
+        wq = self._q_weight(inner.weight)
+        return F.linear(xq, wq, inner.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        inner = self.inner
+        xq = self._q_act(x)
+        wq = self._q_weight(inner.weight)
+        return F.conv2d(xq, wq, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups,
+                        data_format=inner._data_format)
+
+
+_WRAPPERS = {
+    common_mod.Linear: QuantedLinear,
+    conv_mod.Conv2D: QuantedConv2D,
+}
+
+
+def _wrap_layers(model: Layer, config: QuantConfig) -> Layer:
+    for name, sub in list(model._sub_layers.items()):
+        cls = type(sub)
+        if cls in _WRAPPERS and cls.__name__ in \
+                config.quantizable_layer_type:
+            setattr(model, name, _WRAPPERS[cls](sub, config))
+        else:
+            _wrap_layers(sub, config)
+    return model
+
+
+class QAT:
+    """Imperative quant-aware training (reference imperative/qat.py
+    ImperativeQuantAware.quantize)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self._cfg = config or QuantConfig()
+
+    def quantize(self, model: Layer) -> Layer:
+        return _wrap_layers(model, self._cfg)
+
+
+def quant_aware(model: Layer, config: Optional[QuantConfig] = None) -> Layer:
+    return QAT(config).quantize(model)
+
+
+class PTQ:
+    """Post-training quantization: calibrate ranges with sample batches,
+    then convert (reference slim post_training_quantization.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self._cfg = config or QuantConfig()
+
+    def quantize(self, model: Layer) -> Layer:
+        m = _wrap_layers(model, self._cfg)
+        m.eval()   # dropout/BN stay in inference mode during calibration
+        for _, sub in m.named_sublayers():
+            if isinstance(sub, _QuantedBase):
+                sub._calibrating = True
+        return m
+
+    def convert(self, model: Layer) -> Layer:
+        model.eval()
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, _QuantedBase):
+                sub._calibrating = False
+        return model
+
+
+def convert(model: Layer) -> Dict[str, dict]:
+    """Bake int8 weights + scales for export: {layer_name: {weight_int8,
+    weight_scale, act_scale}} (reference quant_int8 conversion)."""
+    out = {}
+
+    def walk(layer: Layer, prefix: str):
+        for name, sub in layer._sub_layers.items():
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, _QuantedBase):
+                w = np.asarray(sub.inner.weight.numpy())
+                scale = float(np.max(np.abs(w)))
+                qmax = float(2 ** (sub._cfg.weight_bits - 1) - 1)
+                wq = np.clip(np.round(w / max(scale, 1e-8) * qmax),
+                             -qmax - 1, qmax).astype(np.int8)
+                out[full] = {
+                    "weight_int8": wq,
+                    "weight_scale": scale / qmax,
+                    "act_scale": float(np.asarray(sub.act_scale.numpy())),
+                }
+            else:
+                walk(sub, full)
+
+    walk(model, "")
+    return out

@@ -6,7 +6,7 @@ and the hapi vision models). NCHW layout; convs hit the MXU via XLA.
 """
 from __future__ import annotations
 
-from .. import nn
+from .. import nn, ops
 
 
 class LeNet(nn.Layer):
@@ -141,3 +141,114 @@ def resnet101(num_classes=1000, **kw):
 
 def resnet152(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes)
+
+
+class VGG(nn.Layer):
+    """VGG (paddle.vision.models.vgg / reference book
+    test_image_classification.py vgg16_bn pattern)."""
+
+    _cfgs = {
+        11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+        13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+             512, 512, "M"],
+        16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+             "M", 512, 512, 512, "M"],
+        19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+             512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+    }
+
+    def __init__(self, depth=16, num_classes=1000, batch_norm=True,
+                 in_channels=3):
+        super().__init__()
+        layers = []
+        c = in_channels
+        for v in self._cfgs[depth]:
+            if v == "M":
+                layers.append(nn.MaxPool2D(2, 2))
+            else:
+                layers.append(nn.Conv2D(c, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(nn.BatchNorm2D(v))
+                layers.append(nn.ReLU())
+                c = v
+        self.features = nn.Sequential(*layers)
+        self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.avgpool(x)
+        x = ops.flatten(x, 1)
+        return self.classifier(x)
+
+
+def vgg16(num_classes=1000, batch_norm=True, in_channels=3):
+    return VGG(16, num_classes, batch_norm, in_channels)
+
+
+def vgg19(num_classes=1000, batch_norm=True, in_channels=3):
+    return VGG(19, num_classes, batch_norm, in_channels)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hidden = int(round(cin * expand))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers += [nn.Conv2D(cin, hidden, 1, bias_attr=False),
+                       nn.BatchNorm2D(hidden), nn.ReLU6()]
+        layers += [
+            nn.Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                      groups=hidden, bias_attr=False),
+            nn.BatchNorm2D(hidden), nn.ReLU6(),
+            nn.Conv2D(hidden, cout, 1, bias_attr=False),
+            nn.BatchNorm2D(cout),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """MobileNetV2 (paddle.vision.models.MobileNetV2; depthwise convs map
+    to XLA grouped convolution)."""
+
+    def __init__(self, num_classes=1000, scale=1.0, in_channels=3):
+        super().__init__()
+        cfg = [   # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        c0 = int(32 * scale)
+        feats = [nn.Conv2D(in_channels, c0, 3, stride=2, padding=1,
+                           bias_attr=False),
+                 nn.BatchNorm2D(c0), nn.ReLU6()]
+        cin = c0
+        for t, c, n, s in cfg:
+            cout = int(c * scale)
+            for i in range(n):
+                feats.append(_InvertedResidual(cin, cout,
+                                               s if i == 0 else 1, t))
+                cin = cout
+        clast = int(1280 * max(scale, 1.0))
+        feats += [nn.Conv2D(cin, clast, 1, bias_attr=False),
+                  nn.BatchNorm2D(clast), nn.ReLU6()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Linear(clast, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.pool(x)
+        x = ops.flatten(x, 1)
+        return self.classifier(x)
+
+
+def mobilenet_v2(num_classes=1000, scale=1.0, in_channels=3):
+    return MobileNetV2(num_classes, scale, in_channels)

@@ -1,0 +1,112 @@
+"""Model zoo breadth: GPT causal LM, word2vec, VGG, MobileNetV2."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import (
+    GPTConfig, GPTForCausalLM, MobileNetV2, NGramLM, SkipGram, vgg16,
+)
+
+
+def test_gpt_causal_property():
+    """Future tokens must not affect past logits (causal attention)."""
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    logits_a = model(paddle.to_tensor(ids)).numpy()
+    ids_b = ids.copy()
+    ids_b[:, 10:] = rng.randint(0, cfg.vocab_size, (2, 6))
+    logits_b = model(paddle.to_tensor(ids_b)).numpy()
+    np.testing.assert_allclose(logits_a[:, :10], logits_b[:, :10],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_gpt_causal_with_padding_mask():
+    """is_causal must survive an additional boolean padding mask."""
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, cfg.vocab_size, (1, 12)).astype(np.int32)
+    blk = model.gpt.layers[0]
+    x = model.gpt.word_embedding(paddle.to_tensor(ids))
+    full_mask = paddle.to_tensor(np.ones((12, 12), bool))
+    a = blk.self_attn(blk.ln1(x), attn_mask=full_mask).numpy()
+    b = blk.self_attn(blk.ln1(x)).numpy()       # mask-free causal path
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_gpt_trains_and_generates():
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+
+    def loss_fn(m, ids):
+        return m.loss(ids)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(1)
+    # learnable sequence: cyclic pattern
+    base = np.arange(32) % 8
+    ids = paddle.to_tensor(np.stack([base] * 4).astype(np.int32))
+    losses = [float(step(ids).numpy()) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    model.eval()
+    out = model.generate(paddle.to_tensor(ids.numpy()[:1, :8]),
+                         max_new_tokens=4)
+    assert out.shape == (1, 12)
+
+
+def test_skipgram_trains():
+    paddle.seed(0)
+    model = SkipGram(50, 16)
+    opt = optimizer.Adam(learning_rate=0.05,
+                         parameters=model.parameters())
+    rng = np.random.RandomState(2)
+    center = paddle.to_tensor(rng.randint(0, 50, (64,)).astype(np.int64))
+    context = paddle.to_tensor(
+        ((center.numpy() + 1) % 50).astype(np.int64))   # learnable relation
+    negs = paddle.to_tensor(rng.randint(0, 50, (64, 5)).astype(np.int64))
+    losses = []
+    for _ in range(15):
+        loss = model(center, context, negs)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_ngram_lm_forward():
+    paddle.seed(0)
+    model = NGramLM(100, embedding_dim=8, context=4, hidden=32)
+    words = paddle.to_tensor(
+        np.random.RandomState(3).randint(0, 100, (8, 4)).astype(np.int64))
+    target = paddle.to_tensor(
+        np.random.RandomState(4).randint(0, 100, (8,)).astype(np.int64))
+    loss = model.loss(words, target)
+    assert np.isfinite(float(loss.numpy()))
+
+
+@pytest.mark.parametrize("factory,shape", [
+    (lambda: vgg16(num_classes=10), (2, 3, 32, 32)),
+    (lambda: MobileNetV2(num_classes=10, scale=0.35), (2, 3, 32, 32)),
+])
+def test_vision_models_forward(factory, shape):
+    paddle.seed(0)
+    model = factory()
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(5).randn(*shape).astype(np.float32))
+    out = model(x)
+    assert out.shape == (2, 10)
+    assert np.isfinite(out.numpy()).all()

@@ -1,0 +1,81 @@
+"""QAT fake-quant training + PTQ calibration + int8 conversion."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (
+    PTQ, QAT, QuantConfig, QuantedConv2D, QuantedLinear, convert, fake_quant,
+)
+
+
+def test_fake_quant_grid_and_ste():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.linspace(-1.0, 1.0, 11)
+    out = np.asarray(fake_quant(x, jnp.asarray(1.0), 8).numpy())
+    # values land on the int8 grid scale/127
+    grid = np.round(out * 127)
+    np.testing.assert_allclose(out, grid / 127, atol=1e-6)
+
+    # STE: gradient of sum(fake_quant(x)) wrt x is 1 everywhere in range
+    xt = paddle.to_tensor(np.array([0.3, -0.7], np.float32))
+    xt.stop_gradient = False
+    y = fake_quant(xt, jnp.asarray(1.0), 8)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(xt.grad.numpy()), 1.0)
+
+
+def _lenet_ish():
+    paddle.seed(0)
+    return nn.Sequential(
+        nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+        nn.Flatten(), nn.Linear(4 * 8 * 8, 10),
+    )
+
+
+def test_qat_wraps_and_trains():
+    model = _lenet_ish()
+    model = QAT(QuantConfig()).quantize(model)
+    kinds = [type(s).__name__ for _, s in model.named_sublayers()]
+    assert "QuantedConv2D" in kinds and "QuantedLinear" in kinds
+
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 8, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+    losses = []
+    for _ in range(8):
+        logits = model(x)
+        loss = nn.functional.cross_entropy(logits, y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_ptq_calibration_then_convert_close_to_fp():
+    model = _lenet_ish()
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(16, 1, 8, 8).astype(np.float32))
+    model.eval()
+    ref = model(x).numpy()
+
+    ptq = PTQ()
+    qmodel = ptq.quantize(model)
+    for _ in range(4):            # calibration passes
+        qmodel(x)
+    qmodel = ptq.convert(qmodel)
+    out = qmodel(x).numpy()
+    # int8 simulation stays close to fp32 output
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert err < 0.1, err
+
+    table = convert(qmodel)
+    assert len(table) == 2
+    for rec in table.values():
+        assert rec["weight_int8"].dtype == np.int8
+        assert rec["weight_scale"] > 0
+        assert rec["act_scale"] > 0
