@@ -74,6 +74,10 @@ class GPTModel(Layer):
 
     def forward(self, input_ids):
         b, l = input_ids.shape
+        if l > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {l} exceeds max_position_embeddings "
+                f"{self.cfg.max_position_embeddings}")
         pos = ops.arange(0, l, dtype="int32")
         x = self.word_embedding(input_ids) + self.pos_embedding(pos)
         x = self.dropout(x)

@@ -60,8 +60,6 @@ class MultiHeadAttention(Layer):
         if self.is_causal and attn_mask is not None:
             # fold the causal constraint into the user mask (bottom-right
             # aligned, matching the mask-free is_causal path)
-            from .. import ops
-
             lqk, lkk = q.shape[1], k.shape[1]
             causal = ops.tril(
                 ops.ones([lqk, lkk], "bool"), diagonal=lkk - lqk)

@@ -27,7 +27,7 @@ from ..nn import common as common_mod
 from ..nn.layer import Layer
 
 __all__ = ["fake_quant", "QuantConfig", "QAT", "PTQ", "QuantedLinear",
-           "QuantedConv2D", "quant_aware", "convert"]
+           "QuantedConv2D", "quant_aware", "export_int8"]
 
 
 @primitive("fake_quantize_dequantize", nondiff=("scale",))
@@ -165,9 +165,10 @@ class PTQ:
         return model
 
 
-def convert(model: Layer) -> Dict[str, dict]:
+def export_int8(model: Layer) -> Dict[str, dict]:
     """Bake int8 weights + scales for export: {layer_name: {weight_int8,
-    weight_scale, act_scale}} (reference quant_int8 conversion)."""
+    weight_scale, act_scale}} (reference quant_int8 conversion). Distinct
+    from PTQ.convert(), which ends calibration and returns the model."""
     out = {}
 
     def walk(layer: Layer, prefix: str):

@@ -1,0 +1,64 @@
+"""Flash-attention Pallas kernels in interpret mode (CPU-hermetic): the
+forward/backward math must match the XLA reference. On-chip speed is
+covered by bench.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def interpret_pallas(monkeypatch):
+    """Run pallas_call in interpret mode so kernels execute on CPU."""
+    from jax.experimental import pallas as pl
+    import functools
+
+    real = pl.pallas_call
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(real, interpret=True))
+    yield
+
+
+def _qkv(b=2, l=256, h=2, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, l, h, d), dtype)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_matches_xla(causal):
+    q, k, v = _qkv()
+    ref = fa._xla_attention(q, k, v, None, 0.0, causal, None)
+    out = fa._flash_attention_core(q, k, v, causal, 128, 128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_matches_xla(causal):
+    q, k, v = _qkv(l=256)
+
+    def loss_p(q, k, v):
+        return jnp.sum(fa._flash_attention_core(q, k, v, causal,
+                                                128, 128) ** 2)
+
+    def loss_x(q, k, v):
+        return jnp.sum(fa._xla_attention(q, k, v, None, 0.0, causal,
+                                         None) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_uneven_blocks():
+    """kv blocks smaller than q blocks and vice versa."""
+    q, k, v = _qkv(l=512)
+    ref = fa._xla_attention(q, k, v, None, 0.0, True, None)
+    out = fa._flash_attention_core(q, k, v, True, 256, 128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -5,7 +5,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.quantization import (
-    PTQ, QAT, QuantConfig, QuantedConv2D, QuantedLinear, convert, fake_quant,
+    PTQ, QAT, QuantConfig, QuantedConv2D, QuantedLinear, export_int8, fake_quant,
 )
 
 
@@ -73,7 +73,7 @@ def test_ptq_calibration_then_convert_close_to_fp():
     err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
     assert err < 0.1, err
 
-    table = convert(qmodel)
+    table = export_int8(qmodel)
     assert len(table) == 2
     for rec in table.values():
         assert rec["weight_int8"].dtype == np.int8
