@@ -57,6 +57,54 @@ class TransformerNMT(nn.Layer):
         logits = self(src, tgt_in)
         return F.cross_entropy(logits, tgt_out, ignore_index=pad_id)
 
+    def beam_search_decode(self, src, beam_size=4, bos_id=1, eos_id=2,
+                           max_len=64, length_penalty=0.6):
+        """Beam-search translation (reference layers/rnn.py
+        BeamSearchDecoder + dynamic_decode). Encodes once, tiles the
+        memory across beams, and recomputes the causal decoder on a
+        fixed-size token buffer each step — static shapes, so XLA
+        compiles the step once.
+
+        Returns (ids, scores): (batch, beam, max_len) int32, best beam
+        first, and length-normalised log-prob scores (batch, beam).
+        """
+        import jax.numpy as jnp
+
+        from .. import ops
+        from ..framework import no_grad
+        from ..framework.tensor import Tensor
+
+        was_training = self.training
+        self.eval()
+        try:
+            with no_grad():
+                b = src.shape[0]
+                scale = math.sqrt(self.d_model)
+                src_e = self.pos(self.src_embed(src) * scale)
+                memory = self.transformer.encoder(src_e)
+                mem = jnp.repeat(
+                    memory.value if isinstance(memory, Tensor)
+                    else memory, beam_size, axis=0)
+                tgt_mask = nn.Transformer.generate_square_subsequent_mask(
+                    max_len)
+
+                def logits_fn(ids_buf, t, _state):
+                    tgt_e = self.pos(
+                        self.tgt_embed(Tensor(ids_buf)) * scale)
+                    out = self.transformer.decoder(
+                        tgt_e, Tensor(mem), tgt_mask=tgt_mask)
+                    logits = self.out_proj(out)
+                    return logits.value[:, t]
+
+                ids, scores = ops.beam_search_decode(
+                    logits_fn, batch_size=b, beam_size=beam_size,
+                    max_len=max_len, bos_id=bos_id, eos_id=eos_id,
+                    length_penalty=length_penalty)
+                return Tensor(ids), Tensor(scores)
+        finally:
+            if was_training:
+                self.train()
+
     def greedy_decode(self, src, bos_id=1, eos_id=2, max_len=64):
         import numpy as np
 

@@ -1,0 +1,138 @@
+"""Beam search decoding.
+
+Parity with the reference's beam-search stack
+(/root/reference/paddle/fluid/operators/math/beam_search.cc BeamSearchFunctor,
+python/paddle/fluid/layers/rnn.py BeamSearchDecoder / dynamic_decode), built
+TPU-first: one fixed-shape step function over a (batch, beam) lattice —
+top-k over beam*vocab, EOS freezing via masked scores, parent back-gather —
+so XLA compiles a single kernel per step and the whole decode loop reuses it
+(static shapes, no host round-trips inside the step).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e9
+
+
+def beam_search_step(pre_scores, log_probs, finished, beam_size, end_id):
+    """One beam-search expansion (reference math/beam_search.cc semantics).
+
+    Args:
+      pre_scores: (batch, beam) cumulative log-prob of each live beam.
+      log_probs:  (batch, beam, vocab) next-token log-probs per beam.
+      finished:   (batch, beam) bool — beams that already emitted end_id.
+      beam_size:  beams to keep.
+      end_id:     EOS token id.
+
+    Returns (scores, token_ids, parent_idx, finished):
+      scores:     (batch, beam) new cumulative scores.
+      token_ids:  (batch, beam) int32 chosen tokens.
+      parent_idx: (batch, beam) int32 index of the source beam.
+      finished:   (batch, beam) updated finished mask.
+
+    A finished beam is frozen: its only continuation is `end_id` with zero
+    added score; every other token gets -inf so it can never fork.
+    """
+    batch, beam, vocab = log_probs.shape
+    # frozen continuation distribution for finished beams
+    eos_onehot = jnp.where(jnp.arange(vocab) == end_id, 0.0, NEG_INF)
+    log_probs = jnp.where(finished[:, :, None], eos_onehot[None, None, :],
+                          log_probs)
+    total = pre_scores[:, :, None] + log_probs          # (batch, beam, vocab)
+    flat = total.reshape(batch, beam * vocab)
+    scores, flat_idx = jax.lax.top_k(flat, beam_size)   # (batch, beam)
+    parent_idx = (flat_idx // vocab).astype(jnp.int32)
+    token_ids = (flat_idx % vocab).astype(jnp.int32)
+    was_finished = jnp.take_along_axis(finished, parent_idx, axis=1)
+    new_finished = was_finished | (token_ids == end_id)
+    return scores, token_ids, parent_idx, new_finished
+
+
+def _gather_beams(arr, parent_idx):
+    """Reorder a (batch, beam, ...) array by per-batch parent indices."""
+    return jnp.take_along_axis(
+        arr, parent_idx.reshape(parent_idx.shape + (1,) * (arr.ndim - 2)),
+        axis=1)
+
+
+def beam_search_decode(
+        logits_fn: Callable,
+        batch_size: int,
+        beam_size: int = 4,
+        max_len: int = 64,
+        bos_id: int = 1,
+        eos_id: int = 2,
+        length_penalty: float = 0.6,
+        state=None,
+        gather_state_fn=None,
+):
+    """Full beam-search decode loop.
+
+    Args:
+      logits_fn: (ids_buf, t, state) -> logits or (logits, new_state).
+        ids_buf is (batch*beam, max_len) int32, positions > t are padding
+        (a causal decoder must ignore them); returns next-token logits
+        (batch*beam, vocab) for position t.
+      state: optional pytree of per-beam decoder state, leaves with leading
+        dim batch*beam (e.g. KV caches); reordered via gather_state_fn.
+      gather_state_fn: (state, parent_flat) -> state, where parent_flat is
+        (batch*beam,) int32 source-row indices. Defaults to take() on dim 0.
+      length_penalty: GNMT alpha; final score = logp / ((5+len)/6)^alpha.
+
+    Returns (ids, scores): ids (batch, beam, max_len) int32 — best beam
+    first — and scores (batch, beam) length-normalised log-probs.
+    """
+    bk = batch_size * beam_size
+    ids_buf = jnp.full((bk, max_len), eos_id, jnp.int32)
+    ids_buf = ids_buf.at[:, 0].set(bos_id)
+    # only beam 0 of each batch entry is live at t=0 (all beams start
+    # identical; seeding others with -inf avoids beam_size duplicates)
+    pre_scores = jnp.tile(
+        jnp.asarray([0.0] + [NEG_INF] * (beam_size - 1), jnp.float32),
+        (batch_size, 1))
+    finished = jnp.zeros((batch_size, beam_size), bool)
+
+    if gather_state_fn is None:
+        def gather_state_fn(st, parent_flat):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.take(a, parent_flat, axis=0), st)
+
+    for t in range(max_len - 1):
+        out = logits_fn(ids_buf, t, state)
+        logits, state = out if isinstance(out, tuple) else (out, state)
+        log_probs = jax.nn.log_softmax(
+            jnp.asarray(logits, jnp.float32), axis=-1)
+        vocab = log_probs.shape[-1]
+        scores, tok, parent, finished = beam_search_step(
+            pre_scores, log_probs.reshape(batch_size, beam_size, vocab),
+            finished, beam_size, eos_id)
+        # reorder histories to follow the surviving beams
+        parent_flat = (parent + jnp.arange(batch_size)[:, None]
+                       * beam_size).reshape(bk)
+        ids_buf = jnp.take(ids_buf, parent_flat, axis=0)
+        ids_buf = ids_buf.at[:, t + 1].set(tok.reshape(bk))
+        if state is not None:
+            state = gather_state_fn(state, parent_flat)
+        pre_scores = scores
+        if bool(finished.all()):
+            break
+
+    # length-normalised final ranking (GNMT length penalty)
+    lengths = jnp.sum(
+        jnp.cumprod(
+            (ids_buf.reshape(batch_size, beam_size, max_len) != eos_id
+             ).astype(jnp.float32)[:, :, 1:], axis=-1), axis=-1) + 1.0
+    if length_penalty:
+        norm = ((5.0 + lengths) / 6.0) ** length_penalty
+    else:
+        norm = jnp.ones_like(lengths)
+    final = pre_scores / norm
+    order = jnp.argsort(-final, axis=1)
+    ids = _gather_beams(ids_buf.reshape(batch_size, beam_size, max_len),
+                        order)
+    return ids, jnp.take_along_axis(final, order, axis=1)

@@ -31,14 +31,22 @@ class Optimizer:
                  grad_clip=None, name=None, multi_precision=False):
         self._learning_rate = learning_rate
         self._parameter_list = list(parameters) if parameters is not None else None
-        if isinstance(weight_decay, (int, float)):
+        if weight_decay is None:
+            self._l2_coeff = 0.0
+            self._wd = None
+        elif isinstance(weight_decay, (int, float)):
             self._l2_coeff = float(weight_decay)
             self._wd = None
+        elif self.DECOUPLED_WD:
+            # AdamW-style: a regularizer object degrades to its coefficient,
+            # applied decoupled (reference AdamW semantics take a float)
+            self._l2_coeff = float(getattr(weight_decay, "coeff", 0.0))
+            self._wd = None
         else:
+            # coupled regularizer (L1Decay/L2Decay): folded into grads
             self._l2_coeff = 0.0
-            self._wd = weight_decay  # regularizer object or None
-            if weight_decay is not None and hasattr(weight_decay, "coeff"):
-                self._l2_coeff = float(weight_decay.coeff)
+            self._wd = weight_decay
+        self._regs_by_key = {}   # per-param override (ParamAttr.regularizer)
         self._grad_clip = grad_clip
         self._step_count = 0
         self._slots: Dict[int, dict] = {}
@@ -53,6 +61,8 @@ class Optimizer:
         state instead of zeros, so checkpoint-resume keeps optimizer
         moments when training through jit.TrainStep."""
         if param_objs and isinstance(params, dict):
+            self._set_regs({n: getattr(p, "regularizer", None)
+                            for n, p in param_objs.items()})
             slots = {}
             for n, p in params.items():
                 base = self.init_slot(p)
@@ -75,8 +85,7 @@ class Optimizer:
         step = state["step"] + 1
         if self._grad_clip is not None:
             grads = self._grad_clip.apply_pytree(grads)
-        if self._l2_coeff and not self.DECOUPLED_WD:
-            grads = _tmap(lambda g, p: g + self._l2_coeff * p, grads, params)
+        grads = self._append_regularization(grads, params)
 
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_p = treedef.flatten_up_to(params)
@@ -98,11 +107,40 @@ class Optimizer:
 
     DECOUPLED_WD = False
 
+    def _append_regularization(self, grads, params):
+        """Fold weight-decay gradient terms into `grads`. A per-parameter
+        regularizer (ParamAttr.regularizer, collected into _regs_by_key)
+        overrides the optimizer-level one — the reference's
+        append_regularization_ops precedence (fluid/regularizer.py:36)."""
+        from .. import regularizer as _reg
+
+        default = self._wd
+        if default is None and self._l2_coeff and not self.DECOUPLED_WD:
+            default = _reg.L2Decay(self._l2_coeff)
+        table = self._regs_by_key
+        if not table and default is None:
+            return grads
+
+        def f(path, g, p):
+            key = path[-1].key if path and hasattr(path[-1], "key") else None
+            reg = table.get(key, default)
+            return g if reg is None else g + reg.grad_term(p)
+
+        return jax.tree_util.tree_map_with_path(f, grads, params)
+
     def init_slot(self, p):
         return {}
 
     def rule(self, g, p, slots, lr, t):
         raise NotImplementedError
+
+    def _set_regs(self, table):
+        """Record per-param regularizers; the jitted update closes over the
+        table at trace time, so a change invalidates the cached trace."""
+        table = {k: v for k, v in table.items() if v is not None}
+        if table != self._regs_by_key:
+            self._regs_by_key = table
+            self._jit_update = None
 
     # -- eager API -----------------------------------------------------------
     def _params(self):
@@ -127,6 +165,8 @@ class Optimizer:
                 self._slots[id(p)] = self.init_slot(p.value)
             sdict[n] = self._slots[id(p)]
         state = {"slots": sdict, "step": jnp.asarray(self._step_count, jnp.int32)}
+        self._set_regs({n: getattr(p, "regularizer", None)
+                        for n, (_, p) in zip(names, updatable)})
         lr = self.get_lr()
         if self._jit_update is None:
             self._jit_update = jax.jit(

@@ -1,0 +1,52 @@
+"""Weight-decay regularizers.
+
+Parity with /root/reference/python/paddle/fluid/regularizer.py
+(L2DecayRegularizer :167, L1DecayRegularizer :232, and the
+append_regularization_ops precedence rule :36 — a per-parameter
+regularizer set through ParamAttr overrides the optimizer-level one).
+
+TPU-native design: instead of appending `sum`/`scale` ops onto a program,
+a regularizer is a pure gradient transform `g + grad_term(p)` folded into
+the optimizer's jitted update, so XLA fuses the decay term with the
+parameter update in one kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    """Base class: contributes an additive gradient term."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, p):
+        raise NotImplementedError
+
+    def __call__(self, grad, param):
+        return grad + self.grad_term(param)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: loss += coeff/2 * ||p||^2, i.e. grad += coeff * p
+    (reference regularizer.py:167 L2DecayRegularizer)."""
+
+    def grad_term(self, p):
+        return jnp.asarray(self.coeff, p.dtype) * p
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: loss += coeff * ||p||_1, i.e. grad += coeff * sign(p)
+    (reference regularizer.py:232 L1DecayRegularizer)."""
+
+    def grad_term(self, p):
+        return jnp.asarray(self.coeff, p.dtype) * jnp.sign(p)
+
+
+# fluid-style aliases (fluid.regularizer.L2DecayRegularizer)
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

@@ -169,6 +169,7 @@ _unary("floor", jnp.floor)
 _unary("ceil", jnp.ceil)
 _unary("round", jnp.round)
 _unary("reciprocal", jnp.reciprocal)
+_unary("sign", jnp.sign)
 _unary("softsign", jax.nn.soft_sign)
 _unary("softplus", jax.nn.softplus)
 

@@ -71,10 +71,31 @@ class Optimizer:
 
     def apply_gradients(self, params_grads):
         helper = LayerHelper(self._name)
+        params_grads = self._append_regularization_ops(params_grads)
         lr = self._create_lr_var(helper)
         for p, g in params_grads:
             self._append_update(helper, p, g, lr)
         return []
+
+    def _append_regularization_ops(self, params_grads):
+        """Append weight-decay ops onto the program (reference
+        fluid/regularizer.py:36 append_regularization_ops): L2 adds
+        scale(p)·coeff to the grad, L1 adds scale(sign(p))·coeff."""
+        if self.regularization is None:
+            return params_grads
+        from ..regularizer import L1Decay
+        from .layers import _append_simple
+
+        reg = self.regularization
+        out = []
+        for p, g in params_grads:
+            src = _append_simple("sign", {"X": [p]}) \
+                if isinstance(reg, L1Decay) else p
+            decay = _append_simple("scale", {"X": [src]},
+                                   {"scale": float(reg.coeff)})
+            g2 = _append_simple("elementwise_add", {"X": [g], "Y": [decay]})
+            out.append((p, g2))
+        return out
 
     def _append_update(self, helper, p, g, lr):
         raise NotImplementedError

@@ -1,0 +1,82 @@
+"""Program visualization.
+
+Parity with the reference's graph tooling: ir/graph_viz_pass.cc (Graph →
+Graphviz dot) and python/paddle/fluid/debugger.py draw_block_graphviz.
+TPU-native addition: dump the compiled view too — `hlo_text` lowers a
+jittable function and returns its StableHLO, which is the IR that actually
+runs (the equivalent of inspecting the post-pass ir::Graph).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def program_to_dot(program, block_idx: int = 0,
+                   max_var_label: int = 40) -> str:
+    """Render one block of a Program as a Graphviz dot string.
+
+    Ops are boxes, vars are ellipses (parameters shaded), edges follow
+    data flow — the layout of graph_viz_pass.cc's marked nodes.
+    """
+    block = program.blocks[block_idx]
+    lines = ["digraph G {", "  rankdir=TB;",
+             '  node [fontsize=10, fontname="helvetica"];']
+    var_nodes = {}
+
+    def var_node(name):
+        if name not in var_nodes:
+            vid = f"var_{len(var_nodes)}"
+            var_nodes[name] = vid
+            desc = block.vars.get(name)
+            label = name[:max_var_label]
+            shape_info = ""
+            style = ""
+            if desc is not None:
+                shape_info = f"\\n{getattr(desc, 'shape', ())}"
+                if getattr(desc, "persistable", False):
+                    style = ', style=filled, fillcolor="lightblue"'
+            lines.append(
+                f'  {vid} [label="{_esc(label)}{shape_info}", '
+                f'shape=ellipse{style}];')
+        return var_nodes[name]
+
+    for i, op in enumerate(block.ops):
+        oid = f"op_{i}"
+        lines.append(
+            f'  {oid} [label="{_esc(op.type)}", shape=box, '
+            'style=filled, fillcolor="seagreen1"];')
+        for name in op.input_names():
+            lines.append(f"  {var_node(name)} -> {oid};")
+        for name in op.output_names():
+            lines.append(f"  {oid} -> {var_node(name)};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def save_dot(program, path: str, block_idx: int = 0) -> str:
+    """Write the dot file (reference FLAGS_print_sub_graph_dir flavor);
+    render with `dot -Tpng` out-of-band if graphviz is installed."""
+    dot = program_to_dot(program, block_idx)
+    with open(path, "w") as f:
+        f.write(dot)
+    return path
+
+
+def hlo_text(fn, *example_args, static_argnums=(),
+             stage: str = "stablehlo") -> str:
+    """Lower a jittable callable and return its IR text.
+
+    stage: "stablehlo" (jaxpr→StableHLO, pre-XLA-fusion) or "optimized"
+    (post-compile HLO — what the TPU actually executes; the analogue of
+    the reference's post-pass ir::Graph dump).
+    """
+    import jax
+
+    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*example_args)
+    if stage == "optimized":
+        return lowered.compile().as_text()
+    return lowered.as_text()

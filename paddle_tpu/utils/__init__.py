@@ -1,1 +1,2 @@
 from . import unique_name  # noqa: F401
+from .env import summary_env  # noqa: F401

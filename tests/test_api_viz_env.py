@@ -1,0 +1,79 @@
+"""API-freeze, graph viz, and env-summary tests (reference
+tools/check_api_approvals.sh + API.spec, ir/graph_viz_pass.cc,
+tools/summary_env.py)."""
+import importlib.util
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_frozen():
+    """Public API must match the committed API.spec; intentional changes
+    regenerate it: python tools/print_signatures.py > API.spec"""
+    spec = importlib.util.spec_from_file_location(
+        "print_signatures", os.path.join(REPO, "tools", "print_signatures.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    current = mod.collect()
+    with open(os.path.join(REPO, "API.spec")) as f:
+        frozen = f.read().splitlines()
+    added = sorted(set(current) - set(frozen))
+    removed = sorted(set(frozen) - set(current))
+    assert not added and not removed, (
+        "Public API drifted from API.spec. If intentional, run\n"
+        "  python tools/print_signatures.py > API.spec\n"
+        f"added: {added[:10]}\nremoved: {removed[:10]}")
+
+
+def test_program_to_dot():
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4])
+        y = static.nn.fc(x, 3)
+        static.mean(y)
+    dot = static.program_to_dot(main)
+    assert dot.startswith("digraph G {") and dot.endswith("}")
+    assert "matmul" in dot or "mul" in dot
+    assert '"x' in dot
+    # parameters shaded
+    assert "lightblue" in dot
+
+
+def test_save_dot(tmp_path):
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4])
+        static.nn.fc(x, 3)
+    p = static.save_dot(main, str(tmp_path / "g.dot"))
+    assert os.path.exists(p)
+    assert "digraph" in open(p).read()
+
+
+def test_hlo_text():
+    import jax.numpy as jnp
+
+    import paddle_tpu.static as static
+
+    def f(a, b):
+        return (a @ b).sum()
+
+    a = jnp.ones((4, 4))
+    txt = static.hlo_text(f, a, a)
+    assert "stablehlo" in txt or "mhlo" in txt or "func" in txt
+    opt = static.hlo_text(f, a, a, stage="optimized")
+    assert "fusion" in opt or "dot" in opt or "HloModule" in opt
+
+
+def test_summary_env():
+    from paddle_tpu.utils import summary_env
+
+    info = summary_env()
+    assert info["paddle_tpu"] and info["python"]
+    assert "jax" in info
+    assert int(info.get("device_count", 1)) >= 1

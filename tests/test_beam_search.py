@@ -1,0 +1,103 @@
+"""Beam search tests (reference test_beam_search_op.py /
+test_beam_search_decode_op.py / rnn BeamSearchDecoder tests)."""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.ops.beam_search import (beam_search_decode, beam_search_step,
+                                        NEG_INF)
+
+
+def test_beam_search_step_selects_topk_across_beams():
+    # batch=1, beam=2, vocab=3
+    pre = jnp.asarray([[0.0, -1.0]])
+    lp = jnp.log(jnp.asarray([[[0.1, 0.6, 0.3],
+                               [0.8, 0.1, 0.1]]]))
+    fin = jnp.zeros((1, 2), bool)
+    scores, tok, parent, fin2 = beam_search_step(pre, lp, fin, 2, end_id=2)
+    # candidates: beam0: log .6=-.51(t1), log .3=-1.2(t2), log .1=-2.3
+    #             beam1: -1+log .8=-1.22(t0), ...
+    assert tok.tolist() == [[1, 2]]
+    assert parent.tolist() == [[0, 0]]
+    assert bool(fin2[0, 1]) and not bool(fin2[0, 0])
+    np.testing.assert_allclose(scores[0, 0], np.log(0.6), rtol=1e-5)
+
+
+def test_beam_search_step_freezes_finished_beams():
+    pre = jnp.asarray([[-0.5, -0.1]])
+    lp = jnp.zeros((1, 2, 4))  # uniform-ish; irrelevant for finished beam
+    fin = jnp.asarray([[False, True]])
+    scores, tok, parent, fin2 = beam_search_step(pre, lp, fin, 2, end_id=3)
+    # the finished beam (idx 1) survives with unchanged score via eos
+    row = list(zip(tok[0].tolist(), parent[0].tolist(), scores[0].tolist()))
+    frozen = [r for r in row if r[1] == 1]
+    assert frozen and frozen[0][0] == 3
+    np.testing.assert_allclose(frozen[0][2], -0.1, rtol=1e-5)
+
+
+def test_beam_search_beats_greedy_on_garden_path():
+    # vocab: 0=bos, 1=a, 2=b, 3=eos. From bos: p(a)=.6, p(b)=.4.
+    # After a: uniform over {a,b} (p .5) forever. After b: eos (p ~1).
+    # Greedy: bos->a->... total ~ .6*.5*.5; beam: bos->b->eos = .4.
+    table = np.full((4, 4), 1e-9, np.float32)
+    table[0] = [1e-9, 0.6, 0.4, 1e-9]
+    table[1] = [1e-9, 0.5, 0.5 - 1e-9, 1e-9]
+    table[2] = [1e-9, 1e-9, 1e-9, 1.0]
+    table[3] = [1e-9, 1e-9, 1e-9, 1.0]
+    log_table = jnp.log(jnp.asarray(table / table.sum(-1, keepdims=True)))
+
+    def logits_fn(ids_buf, t, state):
+        return jnp.take(log_table, ids_buf[:, t], axis=0)
+
+    ids, scores = beam_search_decode(
+        logits_fn, batch_size=1, beam_size=2, max_len=4,
+        bos_id=0, eos_id=3, length_penalty=0.0)
+    assert ids.shape == (1, 2, 4)
+    assert ids[0, 0].tolist() == [0, 2, 3, 3]
+    np.testing.assert_allclose(float(scores[0, 0]),
+                               np.log(0.4) + np.log(1.0), atol=1e-4)
+    # greedy path (beam 1) scores lower
+    assert float(scores[0, 0]) > float(scores[0, 1])
+
+
+def test_beam_search_decode_batched_and_state_gather():
+    # state carries a per-beam counter; ensure gather keeps it aligned
+    vocab = 5
+
+    def logits_fn(ids_buf, t, state):
+        lp = jnp.log(jnp.full((ids_buf.shape[0], vocab), 0.2))
+        return lp, state + 1
+
+    ids, scores = beam_search_decode(
+        logits_fn, batch_size=3, beam_size=2, max_len=5, bos_id=1,
+        eos_id=0, state=jnp.zeros((6,), jnp.int32))
+    assert ids.shape == (3, 2, 5)
+    assert np.all(np.asarray(ids[:, :, 0]) == 1)
+
+
+def test_transformer_nmt_beam_decode():
+    paddle.seed(0)
+    from paddle_tpu.models.transformer import TransformerNMT
+
+    model = TransformerNMT(src_vocab_size=50, tgt_vocab_size=50,
+                           d_model=32, nhead=4, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=64,
+                           dropout=0.0, max_len=32)
+    model.eval()
+    src = paddle.to_tensor(
+        np.random.RandomState(0).randint(3, 50, (2, 7)).astype("int64"))
+    ids, scores = model.beam_search_decode(src, beam_size=3, max_len=10,
+                                           length_penalty=0.0)
+    assert tuple(ids.shape) == (2, 3, 10)
+    assert np.all(ids.numpy()[:, :, 0] == 1)
+    s = scores.numpy()
+    assert np.all(s[:, 0] >= s[:, 1]) and np.all(s[:, 1] >= s[:, 2])
+
+    # beam_size=1 must follow the greedy path
+    ids1, _ = model.beam_search_decode(src, beam_size=1, max_len=10,
+                                       length_penalty=0.0)
+    greedy = model.greedy_decode(src, max_len=10).numpy()
+    b1 = ids1.numpy()[:, 0, :]
+    n = min(greedy.shape[1], b1.shape[1])
+    np.testing.assert_array_equal(b1[:, :n], greedy[:, :n])

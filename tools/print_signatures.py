@@ -1,0 +1,74 @@
+"""Public-API signature dump (reference tools/print_signatures.py, which
+feeds paddle/fluid/API.spec — the frozen public API that CI diffs so
+interface changes need explicit approval).
+
+Usage:
+    python tools/print_signatures.py > API.spec
+
+tests/test_api_spec.py regenerates the dump and compares it against the
+committed API.spec; an intentional API change must refresh the file.
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import sys
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.amp",
+    "paddle_tpu.autograd",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.hapi",
+    "paddle_tpu.io",
+    "paddle_tpu.jit",
+    "paddle_tpu.metric",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.initializer",
+    "paddle_tpu.ops",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr",
+    "paddle_tpu.profiler",
+    "paddle_tpu.quantization",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.static",
+    "paddle_tpu.utils",
+    "paddle_tpu.vision",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def collect():
+    lines = set()
+    for mod_name in MODULES:
+        mod = importlib.import_module(mod_name)
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            qual = f"{mod_name}.{name}"
+            if inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                if not obj.__module__.startswith("paddle_tpu"):
+                    continue
+                lines.add(f"{qual}.__init__ {_sig(obj.__init__)}")
+                continue
+            if callable(obj):
+                owner = getattr(obj, "__module__", "") or ""
+                if not owner.startswith("paddle_tpu"):
+                    continue
+                lines.add(f"{qual} {_sig(obj)}")
+    return sorted(lines)
+
+
+if __name__ == "__main__":
+    sys.stdout.write("\n".join(collect()) + "\n")
