@@ -15,7 +15,7 @@ from .framework import (  # noqa: F401
     set_default_dtype, get_default_dtype, set_device, get_device,
     device_count, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
     is_compiled_with_tpu, is_compiled_with_cuda, get_flags, set_flags,
-    rng_scope,
+    rng_scope, LoDTensor, create_lod_tensor, create_random_int_lodtensor,
 )
 from .framework.dtype import (  # noqa: F401
     bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,

@@ -25,3 +25,6 @@ from .random import seed, default_generator, rng_scope, Generator  # noqa: F401
 from .tape import no_grad, enable_grad, grad_enabled  # noqa: F401
 from .tensor import Tensor, to_tensor, is_tensor  # noqa: F401
 from .op import primitive, OP_REGISTRY  # noqa: F401
+from .lod import (  # noqa: F401
+    LoDTensor, create_lod_tensor, create_random_int_lodtensor,
+)

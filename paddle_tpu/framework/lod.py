@@ -1,0 +1,144 @@
+"""LoD (level-of-detail) ragged-tensor compatibility layer.
+
+Parity with /root/reference/paddle/fluid/framework/lod_tensor.{h,cc} and
+python/paddle/fluid/lod_tensor.py (create_lod_tensor :23,
+create_random_int_lodtensor :100).
+
+TPU-native design: XLA wants static shapes, so ragged data flows through
+the framework as **dense padded (batch, maxlen, ...) + lengths (batch,)**
+(see ops/sequence.py). This module keeps the reference's offset-based LoD
+container for API/io parity and provides lossless conversion to/from the
+dense+lengths form that actually runs on device.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _lengths_to_offsets(lengths: Sequence[int]) -> List[int]:
+    off = [0]
+    for n in lengths:
+        off.append(off[-1] + int(n))
+    return off
+
+
+def _offsets_to_lengths(offsets: Sequence[int]) -> List[int]:
+    return [int(offsets[i + 1] - offsets[i]) for i in range(len(offsets) - 1)]
+
+
+class LoDTensor:
+    """Dense rows + nested offset table (lod_tensor.h LoDTensor).
+
+    `lod()` returns offset-style levels ([[0, 2, 5], ...]);
+    `recursive_sequence_lengths()` the length-style view ([[2, 3], ...]).
+    """
+
+    def __init__(self, data=None, lod: Sequence[Sequence[int]] = ()):
+        self._data = None if data is None else np.asarray(data)
+        self._lod: List[List[int]] = [list(map(int, lv)) for lv in lod]
+
+    # -- reference API -------------------------------------------------------
+    def set(self, data, place=None):
+        self._data = np.asarray(data)
+
+    def lod(self) -> List[List[int]]:
+        return [list(lv) for lv in self._lod]
+
+    def set_lod(self, lod: Sequence[Sequence[int]]):
+        self._lod = [list(map(int, lv)) for lv in lod]
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [_offsets_to_lengths(lv) for lv in self._lod]
+
+    def set_recursive_sequence_lengths(self, lens: Sequence[Sequence[int]]):
+        self._lod = [_lengths_to_offsets(lv) for lv in lens]
+
+    def has_valid_recursive_sequence_lengths(self) -> bool:
+        if not self._lod:
+            return True
+        prev_count = None
+        for lv in self._lod:
+            if not lv or lv[0] != 0:
+                return False
+            if any(lv[i] > lv[i + 1] for i in range(len(lv) - 1)):
+                return False
+            if prev_count is not None and len(lv) - 1 != prev_count:
+                return False
+            prev_count = lv[-1]
+        return (self._data is None
+                or self._lod[-1][-1] == self._data.shape[0])
+
+    def shape(self):
+        return () if self._data is None else tuple(self._data.shape)
+
+    def __array__(self, dtype=None):
+        a = self._data
+        return a if dtype is None else a.astype(dtype)
+
+    def numpy(self):
+        return self._data
+
+    def __repr__(self):
+        return f"LoDTensor(shape={self.shape()}, lod={self._lod})"
+
+    # -- TPU-native conversion ----------------------------------------------
+    def to_dense_lengths(self, pad_value=0):
+        """Level-1 LoD -> (padded (batch, maxlen, ...), lengths (batch,)),
+        the static-shape form every sequence op consumes."""
+        if len(self._lod) != 1:
+            raise ValueError("to_dense_lengths requires exactly one LoD "
+                             f"level, got {len(self._lod)}")
+        off = self._lod[0]
+        lens = np.asarray(_offsets_to_lengths(off), np.int64)
+        batch = len(lens)
+        maxlen = int(lens.max()) if batch else 0
+        tail = self._data.shape[1:]
+        out = np.full((batch, maxlen) + tail, pad_value, self._data.dtype)
+        for i in range(batch):
+            out[i, :lens[i]] = self._data[off[i]:off[i + 1]]
+        return out, lens
+
+    @staticmethod
+    def from_dense_lengths(dense, lengths) -> "LoDTensor":
+        dense = np.asarray(dense)
+        lengths = [int(n) for n in np.asarray(lengths).ravel()]
+        rows = [dense[i, :n] for i, n in enumerate(lengths)]
+        flat = np.concatenate(rows, axis=0) if rows else \
+            dense.reshape((0,) + dense.shape[2:])
+        return LoDTensor(flat, [_lengths_to_offsets(lengths)])
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
+    """Build a LoDTensor from flat data + per-sequence lengths (reference
+    fluid/lod_tensor.py:23 create_lod_tensor)."""
+    if isinstance(data, LoDTensor):
+        t = LoDTensor(data.numpy())
+    elif isinstance(data, list):
+        # list of per-sequence lists: flatten, derive level-1 lengths
+        flat = [np.asarray(s).reshape(-1, 1) for s in data]
+        derived = [[len(s) for s in data]]
+        if recursive_seq_lens is not None and \
+                list(map(list, recursive_seq_lens)) != derived:
+            raise ValueError(
+                f"recursive_seq_lens {recursive_seq_lens} do not match "
+                f"the list data's lengths {derived}")
+        t = LoDTensor(np.concatenate(flat, axis=0))
+        t.set_recursive_sequence_lengths(derived)
+        return t
+    else:
+        t = LoDTensor(np.asarray(data))
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise ValueError("recursive_seq_lens do not match data rows")
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1) -> LoDTensor:
+    """Random-int LoDTensor (reference fluid/lod_tensor.py:100)."""
+    total = sum(recursive_seq_lens[-1])
+    shape = (total,) + tuple(base_shape)
+    data = np.random.randint(low, high + 1, shape).astype(np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)

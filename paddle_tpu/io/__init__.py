@@ -10,3 +10,7 @@ from .serialization import (  # noqa: F401
     save, load, save_dygraph, load_dygraph, save_inference_model,
     load_inference_model, save_persistables, load_persistables,
 )
+from . import fs  # noqa: F401
+from . import crypto  # noqa: F401
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+from .crypto import AESCipher, gen_key, gen_key_to_file  # noqa: F401

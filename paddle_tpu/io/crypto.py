@@ -1,0 +1,183 @@
+"""Encrypted model io.
+
+Parity with /root/reference/paddle/fluid/framework/io/crypto/ (cipher.cc:33
+default "AES_CTR_NoPadding", cipher_utils.cc GenKey/GenKeyToFile): AES-CTR
+over serialized checkpoints. The block cipher runs in native C++
+(native/src/aes.cc) via ctypes, with a pure-python fallback implementing
+the same FIPS-197 algorithm so files interoperate either way.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from ..native import load_library
+
+_SBOX = None
+
+
+def _sbox():
+    global _SBOX
+    if _SBOX is None:
+        # generate the AES S-box (multiplicative inverse in GF(2^8) +
+        # affine transform) instead of embedding the table again
+        inv = [0] * 256
+        p, q = 1, 1
+        while True:
+            p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+            q ^= q << 1
+            q ^= q << 2
+            q ^= q << 4
+            q &= 0xFF
+            if q & 0x80:
+                q ^= 0x09
+            inv[p] = q
+            if p == 1:
+                break
+        sbox = [0] * 256
+        sbox[0] = 0x63
+        for i in range(1, 256):
+            s = inv[i]
+            x = s
+            for _ in range(4):
+                x = ((x << 1) | (x >> 7)) & 0xFF
+                s ^= x
+            sbox[i] = s ^ 0x63
+        _SBOX = sbox
+    return _SBOX
+
+
+def _expand_key(key: bytes):
+    nk = len(key) // 4
+    nr = {4: 10, 6: 12, 8: 14}[nk]
+    sbox = _sbox()
+    w = [list(key[4 * i:4 * i + 4]) for i in range(nk)]
+    rcon = 1
+    for i in range(nk, 4 * (nr + 1)):
+        t = list(w[i - 1])
+        if i % nk == 0:
+            t = [sbox[t[1]] ^ rcon, sbox[t[2]], sbox[t[3]], sbox[t[0]]]
+            rcon = ((rcon << 1) ^ 0x11B) & 0xFF if rcon & 0x80 else rcon << 1
+        elif nk > 6 and i % nk == 4:
+            t = [sbox[b] for b in t]
+        w.append([a ^ b for a, b in zip(w[i - nk], t)])
+    return w, nr
+
+
+def _encrypt_block_py(w, nr, block: bytes) -> bytes:
+    sbox = _sbox()
+
+    def xt(x):
+        return ((x << 1) ^ 0x1B) & 0xFF if x & 0x80 else x << 1
+
+    s = [block[i] ^ w[i // 4][i % 4] for i in range(16)]
+    for rnd in range(1, nr + 1):
+        t = [0] * 16
+        for c in range(4):
+            for r in range(4):
+                t[4 * c + r] = sbox[s[4 * ((c + r) & 3) + r]]
+        if rnd < nr:
+            s = [0] * 16
+            for c in range(4):
+                a = t[4 * c:4 * c + 4]
+                x = a[0] ^ a[1] ^ a[2] ^ a[3]
+                for r in range(4):
+                    s[4 * c + r] = a[r] ^ x ^ xt(a[r] ^ a[(r + 1) & 3])
+        else:
+            s = t
+        rk = w[4 * rnd:4 * rnd + 4]
+        s = [s[i] ^ rk[i // 4][i % 4] for i in range(16)]
+    return bytes(s)
+
+
+def _ctr_py(key: bytes, iv: bytes, data: bytes) -> bytes:
+    w, nr = _expand_key(key)
+    out = bytearray(data)
+    counter = bytearray(iv)
+    for off in range(0, len(data), 16):
+        stream = _encrypt_block_py(w, nr, bytes(counter))
+        for i in range(min(16, len(data) - off)):
+            out[off + i] ^= stream[i]
+        for i in range(15, -1, -1):
+            counter[i] = (counter[i] + 1) & 0xFF
+            if counter[i]:
+                break
+    return bytes(out)
+
+
+class AESCipher:
+    """AES-CTR cipher (reference AESCipher, aes_cipher.cc). Key must be
+    16, 24, or 32 bytes. Output layout: 16-byte IV || ciphertext."""
+
+    def __init__(self, key: bytes):
+        if len(key) not in (16, 24, 32):
+            raise ValueError("AES key must be 16/24/32 bytes, got "
+                             f"{len(key)}")
+        self._key = bytes(key)
+        self._lib = load_library("aes")
+        if self._lib is not None and not getattr(self._lib, "_pt_typed",
+                                                 False):
+            self._lib.pt_aes_ctr_crypt.restype = ctypes.c_int
+            self._lib.pt_aes_ctr_crypt.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_int64]
+            self._lib.pt_aes_encrypt_block.restype = ctypes.c_int
+            self._lib.pt_aes_encrypt_block.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_char_p]
+            self._lib._pt_typed = True
+
+    def _ctr(self, iv: bytes, data: bytes) -> bytes:
+        if self._lib is not None:
+            buf = ctypes.create_string_buffer(data, len(data))
+            rc = self._lib.pt_aes_ctr_crypt(
+                self._key, len(self._key), iv, buf, len(data))
+            if rc != 0:
+                raise RuntimeError("native AES rejected the key")
+            return buf.raw
+        return _ctr_py(self._key, iv, data)
+
+    def encrypt(self, plaintext: bytes, iv: Optional[bytes] = None) -> bytes:
+        iv = iv if iv is not None else os.urandom(16)
+        if len(iv) != 16:
+            raise ValueError("IV must be 16 bytes")
+        return iv + self._ctr(iv, plaintext)
+
+    def decrypt(self, payload: bytes) -> bytes:
+        if len(payload) < 16:
+            raise ValueError("payload too short to contain an IV")
+        return self._ctr(bytes(payload[:16]), bytes(payload[16:]))
+
+    def encrypt_file(self, in_path: str, out_path: str) -> None:
+        with open(in_path, "rb") as f:
+            data = f.read()
+        with open(out_path, "wb") as f:
+            f.write(self.encrypt(data))
+
+    def decrypt_file(self, in_path: str, out_path: str) -> None:
+        with open(in_path, "rb") as f:
+            data = f.read()
+        with open(out_path, "wb") as f:
+            f.write(self.decrypt(data))
+
+
+def gen_key(length: int = 32) -> bytes:
+    """Random key (reference CipherUtils::GenKey)."""
+    return os.urandom(length)
+
+
+def gen_key_to_file(path: str, length: int = 32) -> bytes:
+    """Random key persisted to disk (reference CipherUtils::GenKeyToFile)."""
+    key = gen_key(length)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, key)
+    finally:
+        os.close(fd)
+    return key
+
+
+def read_key_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()

@@ -1,0 +1,255 @@
+"""Filesystem layer: local + HDFS shell-out.
+
+Parity with /root/reference/paddle/fluid/framework/io/{fs.cc,shell.cc} and
+python/paddle/fluid/incubate/fleet/utils/fs.py (FS/LocalFS/HDFSClient):
+checkpoints and datasets address local paths or `hdfs://` URIs through one
+interface. HDFS access shells out to `hadoop fs` exactly like the
+reference; when no hadoop binary exists the client raises a clear error
+at call time (construction stays cheap for config plumbing).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    """Abstract interface (reference fs.py:52)."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_file(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path) -> List[str]:
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem (reference fs.py:110 LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.isfile(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if test_exists:
+            if not self.is_exist(src_path):
+                raise FSFileNotExistsError(src_path)
+            if not overwrite and self.is_exist(dst_path):
+                raise FSFileExistsError(dst_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [d for d in sorted(os.listdir(fs_path))
+                if os.path.isdir(os.path.join(fs_path, d))]
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """HDFS via `hadoop fs` shell-out (reference fs.py HDFSClient /
+    framework/io/fs.cc hdfs_* — the reference also shells out).
+
+    configs: dict merged into the command as -D key=value (e.g.
+    fs.default.name, hadoop.job.ugi).
+    """
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000, _runner=None):
+        self._hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME", "")
+        self._configs = dict(configs or {})
+        self._time_out = time_out
+        self._sleep_inter = sleep_inter  # ms between retries
+        self._runner = _runner or self._run_cmd  # injectable for tests
+
+    # -- command plumbing ---------------------------------------------------
+    def _base_cmd(self) -> List[str]:
+        exe = os.path.join(self._hadoop_home, "bin", "hadoop") \
+            if self._hadoop_home else "hadoop"
+        cmd = [exe, "fs"]
+        for k, v in sorted(self._configs.items()):
+            cmd += ["-D", f"{k}={v}"]
+        return cmd
+
+    def _run_cmd(self, args: Sequence[str]) -> Tuple[int, List[str]]:
+        cmd = self._base_cmd() + list(args)
+        if not (self._hadoop_home and os.path.exists(self._base_cmd()[0])) \
+                and shutil.which("hadoop") is None:
+            raise ExecuteError(
+                "no hadoop binary found (set hadoop_home or HADOOP_HOME); "
+                f"would run: {' '.join(cmd)}")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._time_out / 1000.0)
+        except subprocess.TimeoutExpired as e:
+            raise ExecuteError(f"hadoop command timed out: {e}") from e
+        except OSError as e:  # e.g. hadoop_home/bin/hadoop missing
+            raise ExecuteError(f"failed to exec {cmd[0]}: {e}") from e
+        return proc.returncode, proc.stdout.splitlines()
+
+    # -- FS interface ---------------------------------------------------------
+    def ls_dir(self, fs_path):
+        rc, lines = self._runner(["-ls", fs_path])
+        if rc != 0:
+            return [], []
+        dirs, files = [], []
+        for ln in lines:
+            fields = ln.split()
+            if len(fields) < 8:
+                continue
+            name = fields[-1]
+            (dirs if fields[0].startswith("d") else files).append(
+                os.path.basename(name))
+        return dirs, files
+
+    def is_dir(self, fs_path):
+        rc, _ = self._runner(["-test", "-d", fs_path])
+        return rc == 0
+
+    def is_file(self, fs_path):
+        rc, _ = self._runner(["-test", "-f", fs_path])
+        return rc == 0
+
+    def is_exist(self, fs_path):
+        rc, _ = self._runner(["-test", "-e", fs_path])
+        return rc == 0
+
+    def upload(self, local_path, fs_path):
+        rc, out = self._runner(["-put", local_path, fs_path])
+        if rc != 0:
+            raise ExecuteError(f"hadoop -put failed: {out}")
+
+    def download(self, fs_path, local_path):
+        rc, out = self._runner(["-get", fs_path, local_path])
+        if rc != 0:
+            raise ExecuteError(f"hadoop -get failed: {out}")
+
+    def mkdirs(self, fs_path):
+        rc, out = self._runner(["-mkdir", "-p", fs_path])
+        if rc != 0:
+            raise ExecuteError(f"hadoop -mkdir failed: {out}")
+
+    def delete(self, fs_path):
+        rc, out = self._runner(["-rmr", fs_path])
+        if rc != 0:
+            raise ExecuteError(f"hadoop -rmr failed: {out}")
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        rc, out = self._runner(["-touchz", fs_path])
+        if rc != 0:
+            raise ExecuteError(f"hadoop -touchz failed: {out}")
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(fs_src_path)
+            if not overwrite and self.is_exist(fs_dst_path):
+                raise FSFileExistsError(fs_dst_path)
+        rc, out = self._runner(["-mv", fs_src_path, fs_dst_path])
+        if rc != 0:
+            raise ExecuteError(f"hadoop -mv failed: {out}")
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def need_upload_download(self):
+        return True

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from .framework import random as random_mod
 from .framework import tape as tape_mod
 from .framework.random import rng_scope
 from .framework.tensor import Tensor
@@ -228,7 +229,7 @@ class TrainStep:
             step_idx = opt_state["step"]
 
             def loss_of(params):
-                key = jax.random.fold_in(jax.random.key(self._seed), step_idx)
+                key = jax.random.fold_in(random_mod.make_key(self._seed), step_idx)
                 saved_p ={n: p._value for n, p in model.named_parameters()}
                 saved_b = {n: b._value for n, b in model.named_buffers()}
                 model.load_param_pytree(params)

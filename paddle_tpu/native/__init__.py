@@ -30,7 +30,7 @@ def _source_hash(src_path: str) -> str:
         return hashlib.sha256(f.read()).hexdigest()[:16]
 
 
-def load_library(name: str):
+def load_library(name: str, extra_flags=()):
     """Compile (if needed) and dlopen src/<name>.cc. Returns None when no
     toolchain is available; callers must degrade to their python path."""
     with _lock:
@@ -41,11 +41,14 @@ def load_library(name: str):
             _libs[name] = None
             return None
         tag = _source_hash(src)
+        if extra_flags:  # link env (e.g. libpython) is part of the identity
+            tag += "-" + hashlib.sha256(
+                " ".join(extra_flags).encode()).hexdigest()[:8]
         out = os.path.join(_BUILD, f"lib{name}-{tag}.so")
         if not os.path.exists(out):
             os.makedirs(_BUILD, exist_ok=True)
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", "-o", out + ".tmp", src]
+                   "-pthread", "-o", out + ".tmp", src] + list(extra_flags)
             try:
                 subprocess.run(cmd, check=True, capture_output=True,
                                timeout=300)
@@ -92,5 +95,52 @@ def datafeed_lib():
             c.c_void_p, c.c_int, c.POINTER(c.c_uint64)]
         lib.pt_batch_lod.argtypes = [c.c_void_p, c.c_int,
                                      c.POINTER(c.c_int64)]
+        lib._pt_typed = True
+    return lib
+
+
+def capi_build_flags():
+    """g++ flags to compile/link the embedded-CPython C API."""
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_python_version()
+    return [f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+            f"-lpython{ver}"]
+
+
+def capi_lib():
+    """Build + load the C inference API (native/src/capi.cc). Returns the
+    ctypes handle (typed), or None without a toolchain/libpython."""
+    lib = load_library("capi", extra_flags=capi_build_flags())
+    if lib is not None and not getattr(lib, "_pt_typed", False):
+        c = ctypes
+        lib.PD_Init.restype = c.c_int
+        lib.PD_Init.argtypes = [c.c_char_p]
+        lib.PD_GetLastError.restype = c.c_char_p
+        lib.PD_NewPredictor.restype = c.c_void_p
+        lib.PD_NewPredictor.argtypes = [c.c_char_p]
+        lib.PD_DeletePredictor.argtypes = [c.c_void_p]
+        lib.PD_GetInputNum.restype = c.c_int
+        lib.PD_GetInputNum.argtypes = [c.c_void_p]
+        lib.PD_GetInputName.restype = c.c_char_p
+        lib.PD_GetInputName.argtypes = [c.c_void_p, c.c_int]
+        lib.PD_SetInputFloat.restype = c.c_int
+        lib.PD_SetInputFloat.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_float),
+            c.POINTER(c.c_int64), c.c_int]
+        lib.PD_SetInputInt64.restype = c.c_int
+        lib.PD_SetInputInt64.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_int64),
+            c.POINTER(c.c_int64), c.c_int]
+        lib.PD_Run.restype = c.c_int
+        lib.PD_Run.argtypes = [c.c_void_p]
+        lib.PD_GetOutputNum.restype = c.c_int
+        lib.PD_GetOutputNum.argtypes = [c.c_void_p]
+        lib.PD_GetOutputFloat.restype = c.c_int
+        lib.PD_GetOutputFloat.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.POINTER(c.c_float)),
+            c.POINTER(c.POINTER(c.c_int64)), c.POINTER(c.c_int)]
         lib._pt_typed = True
     return lib

@@ -1,0 +1,51 @@
+/* C inference API for paddle_tpu (reference inference/capi/paddle_c_api.h).
+ *
+ * Link against libcapi-<hash>.so built from native/src/capi.cc (or build it:
+ *   g++ -O3 -shared -fPIC capi.cc $(python3-config --includes) \
+ *       -L$(python3-config --configdir)/../.. -lpython3.X
+ * ). The library embeds CPython and drives models exported with
+ * paddle_tpu.jit.save. Call PD_Init with the directory containing the
+ * paddle_tpu package if it is not already importable.
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+/* Extend sys.path before the first PD_NewPredictor; may be NULL. */
+int PD_Init(const char* extra_sys_path);
+
+const char* PD_GetLastError(void);
+
+/* model_prefix: path prefix of <prefix>.pdmodel / <prefix>.pdiparams. */
+PD_Predictor* PD_NewPredictor(const char* model_prefix);
+void PD_DeletePredictor(PD_Predictor* p);
+
+int PD_GetInputNum(const PD_Predictor* p);
+const char* PD_GetInputName(const PD_Predictor* p, int i);
+
+int PD_SetInputFloat(PD_Predictor* p, const char* name, const float* data,
+                     const int64_t* shape, int ndim);
+int PD_SetInputInt64(PD_Predictor* p, const char* name, const int64_t* data,
+                     const int64_t* shape, int ndim);
+int PD_SetInputInt32(PD_Predictor* p, const char* name, const int32_t* data,
+                     const int64_t* shape, int ndim);
+
+/* Outputs are float32; buffers stay valid until the next PD_Run or
+ * PD_DeletePredictor. Returns 0 on success, -1 on error. */
+int PD_Run(PD_Predictor* p);
+int PD_GetOutputNum(const PD_Predictor* p);
+int PD_GetOutputFloat(const PD_Predictor* p, int idx, const float** data,
+                      const int64_t** shape, int* ndim);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

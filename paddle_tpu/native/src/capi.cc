@@ -1,0 +1,276 @@
+// C inference API.
+//
+// Native equivalent of the reference's pure-C predictor wrapper
+// (/root/reference/paddle/fluid/inference/capi/pd_predictor.cc,
+// pd_config.cc, paddle_c_api.h): lets C/C++/Go applications run models
+// exported with jit.save without linking Python code themselves. The
+// reference wraps its C++ AnalysisPredictor; the TPU build's predictor is
+// the XLA-compiled TranslatedLayer behind paddle_tpu.inference, so this
+// library embeds CPython (libpython) and drives that predictor through a
+// small helper module. Build via paddle_tpu.native.load_library("capi",
+// python-config flags) or: g++ -shared -fPIC capi.cc $(python3-config
+// --includes --embed --libs).
+//
+// Threading: every entry point takes the GIL (PyGILState_Ensure), so the
+// API is safe to call from any single thread at a time.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+const char kHelperSrc[] = R"PY(
+import os
+
+import numpy as np
+
+def _new_predictor(prefix):
+    # honor JAX_PLATFORMS even when an installed PJRT plugin pins
+    # jax_platforms at import time (e.g. force cpu on a host without the
+    # accelerator tunnel)
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)
+    from paddle_tpu import inference
+    cfg = inference.Config(prefix)
+    return inference.Predictor(cfg)
+
+def _set_input(feeds, name, buf, shape, dtype):
+    feeds[name] = np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+
+def _run(pred, feeds):
+    names = pred.get_input_names()
+    arrays = [feeds[n] for n in names]
+    outs = pred.run(arrays)
+    res = []
+    for a in outs:
+        a = np.ascontiguousarray(np.asarray(a, dtype=np.float32))
+        res.append((a.tobytes(), list(a.shape)))
+    return res
+)PY";
+
+struct Output {
+  PyObject* bytes = nullptr;  // owned ref; data pointer stays valid
+  std::vector<int64_t> shape;
+};
+
+std::string g_last_error;
+PyObject* g_helper = nullptr;  // module dict
+bool g_we_initialized = false;
+
+void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      g_last_error = PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : "unknown";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+bool ensure_helper() {
+  if (g_helper != nullptr) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* r = PyRun_String(kHelperSrc, Py_file_input, globals, globals);
+  if (r == nullptr) {
+    set_error_from_python();
+    Py_DECREF(globals);
+    PyGILState_Release(gil);
+    return false;
+  }
+  Py_DECREF(r);
+  g_helper = globals;
+  PyGILState_Release(gil);
+  return true;
+}
+
+PyObject* helper_call(const char* fn, PyObject* args) {
+  PyObject* f = PyDict_GetItemString(g_helper, fn);  // borrowed
+  if (f == nullptr) {
+    g_last_error = std::string("helper missing: ") + fn;
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  if (out == nullptr) set_error_from_python();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct PD_Predictor {
+  PyObject* pred = nullptr;
+  PyObject* feeds = nullptr;  // dict name -> ndarray
+  std::vector<Output> outputs;
+  std::vector<std::string> input_names;
+};
+
+// Optional: extend sys.path (e.g. the repo root holding paddle_tpu)
+// before the first PD_NewPredictor. Safe to call multiple times.
+int PD_Init(const char* extra_sys_path) {
+  if (!ensure_helper()) return -1;
+  if (extra_sys_path == nullptr || extra_sys_path[0] == '\0') return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string code = "import sys\nsys.path.insert(0, r'''";
+  code += extra_sys_path;
+  code += "''')\n";
+  PyObject* r = PyRun_String(code.c_str(), Py_file_input, g_helper,
+                             g_helper);
+  int rc = 0;
+  if (r == nullptr) {
+    set_error_from_python();
+    rc = -1;
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+PD_Predictor* PD_NewPredictor(const char* model_prefix) {
+  if (!ensure_helper()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(s)", model_prefix);
+  PyObject* pred = helper_call("_new_predictor", args);
+  Py_DECREF(args);
+  if (pred == nullptr) {
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  PD_Predictor* p = new PD_Predictor();
+  p->pred = pred;
+  p->feeds = PyDict_New();
+  PyObject* names = PyObject_CallMethod(pred, "get_input_names", nullptr);
+  if (names != nullptr) {
+    for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+      p->input_names.emplace_back(
+          PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+    }
+    Py_DECREF(names);
+  }
+  PyGILState_Release(gil);
+  return p;
+}
+
+int PD_GetInputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->input_names.size());
+}
+
+const char* PD_GetInputName(const PD_Predictor* p, int i) {
+  if (i < 0 || i >= static_cast<int>(p->input_names.size())) return nullptr;
+  return p->input_names[i].c_str();
+}
+
+static int set_input(PD_Predictor* p, const char* name, const void* data,
+                     int64_t elem_size, const char* dtype,
+                     const int64_t* shape, int ndim) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t n = 1;
+  PyObject* shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyList_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* buf = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), n * elem_size);
+  PyObject* args = Py_BuildValue("(OsOOs)", p->feeds, name, buf, shp,
+                                 dtype);
+  PyObject* r = helper_call("_set_input", args);
+  Py_DECREF(args);
+  Py_DECREF(buf);
+  Py_DECREF(shp);
+  int rc = (r == nullptr) ? -1 : 0;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int PD_SetInputFloat(PD_Predictor* p, const char* name, const float* data,
+                     const int64_t* shape, int ndim) {
+  return set_input(p, name, data, 4, "float32", shape, ndim);
+}
+
+int PD_SetInputInt64(PD_Predictor* p, const char* name,
+                     const int64_t* data, const int64_t* shape, int ndim) {
+  return set_input(p, name, data, 8, "int64", shape, ndim);
+}
+
+int PD_SetInputInt32(PD_Predictor* p, const char* name,
+                     const int32_t* data, const int64_t* shape, int ndim) {
+  return set_input(p, name, data, 4, "int32", shape, ndim);
+}
+
+// Runs the model on the staged inputs. Output buffers stay valid until
+// the next PD_Run or PD_DeletePredictor.
+int PD_Run(PD_Predictor* p) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  for (Output& o : p->outputs) Py_XDECREF(o.bytes);
+  p->outputs.clear();
+  PyObject* args = Py_BuildValue("(OO)", p->pred, p->feeds);
+  PyObject* res = helper_call("_run", args);
+  Py_DECREF(args);
+  if (res == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    PyObject* item = PyList_GetItem(res, i);  // (bytes, shape)
+    Output o;
+    o.bytes = PyTuple_GetItem(item, 0);
+    Py_INCREF(o.bytes);
+    PyObject* shp = PyTuple_GetItem(item, 1);
+    for (Py_ssize_t j = 0; j < PyList_Size(shp); ++j)
+      o.shape.push_back(PyLong_AsLongLong(PyList_GetItem(shp, j)));
+    p->outputs.push_back(o);
+  }
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int PD_GetOutputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->outputs.size());
+}
+
+int PD_GetOutputFloat(const PD_Predictor* p, int idx, const float** data,
+                      const int64_t** shape, int* ndim) {
+  if (idx < 0 || idx >= static_cast<int>(p->outputs.size())) return -1;
+  const Output& o = p->outputs[idx];
+  *data = reinterpret_cast<const float*>(PyBytes_AsString(o.bytes));
+  *shape = o.shape.data();
+  *ndim = static_cast<int>(o.shape.size());
+  return 0;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (p == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  for (Output& o : p->outputs) Py_XDECREF(o.bytes);
+  Py_XDECREF(p->feeds);
+  Py_XDECREF(p->pred);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+}  // extern "C"

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
 from ..framework.op import primitive
 from ..framework.random import next_rng_key
 from ..framework.tensor import Tensor, unwrap
@@ -142,7 +143,7 @@ def meshgrid(*args, name=None):
 # -- random ----------------------------------------------------------------
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    key = jax.random.key(seed) if seed else next_rng_key()
+    key = random_mod.make_key(seed) if seed else next_rng_key()
     return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), min, max))
 
 

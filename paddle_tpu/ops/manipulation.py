@@ -448,3 +448,86 @@ def crop(x, shape=None, offsets=None, name=None):
     slices = tuple(jnp.s_[int(o):int(o) + int(s)]
                    for o, s in zip(offsets, shape))
     return x[slices]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype=np.int64, name=None):
+    """Deduplicate consecutive runs (reference unique_consecutive_op.cc).
+    Host-side like unique(): the output shape is data-dependent."""
+    arr = np.asarray(unwrap(x))
+    if axis is None:
+        flat = arr.reshape(-1)
+        if flat.size == 0:
+            keep = np.zeros(0, bool)
+        else:
+            keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        out = flat[keep]
+        outs = [Tensor(out)]
+        if return_inverse:
+            outs.append(Tensor((np.cumsum(keep) - 1).astype(dtype)))
+        if return_counts:
+            idx = np.nonzero(np.concatenate([keep, [True]]))[0] \
+                if flat.size else np.zeros(1, np.int64)
+            outs.append(Tensor((np.diff(idx) if flat.size
+                                else np.zeros(0)).astype(dtype)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    arr_m = np.moveaxis(arr, axis, 0)
+    if arr_m.shape[0] == 0:
+        keep = np.zeros(0, bool)
+    else:
+        flat2 = arr_m.reshape(arr_m.shape[0], -1)
+        keep = np.concatenate(
+            [[True], np.any(flat2[1:] != flat2[:-1], axis=1)])
+    out = np.moveaxis(arr_m[keep], 0, axis)
+    outs = [Tensor(out)]
+    if return_inverse:
+        outs.append(Tensor((np.cumsum(keep) - 1).astype(dtype)))
+    if return_counts:
+        idx = np.nonzero(np.concatenate([keep, [True]]))[0] \
+            if keep.size else np.zeros(1, np.int64)
+        outs.append(Tensor((np.diff(idx) if keep.size
+                            else np.zeros(0)).astype(dtype)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@primitive("as_strided", nondiff=("shape", "stride", "offset"))
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference as_strided / torch parity). JAX arrays have
+    no strides, so this materializes the gather: flat[offset + i·stride]."""
+    x = jnp.asarray(x).reshape(-1)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * st
+    return jnp.take(x, idx.reshape(-1), axis=0).reshape(shape)
+
+
+def view(x, shape_or_dtype, name=None):
+    """Zero-copy reshape or bitcast (paddle.view): with a dtype the last
+    dimension scales by the size ratio, e.g. float32 (2, 3) -> uint8
+    (2, 12). Under XLA both are layout rewrites the compiler folds away."""
+    from ..framework import dtype as dtype_mod
+
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    dt = np.dtype(dtype_mod.dtype_name(
+        dtype_mod.convert_dtype(shape_or_dtype)))
+    arr = unwrap(x)
+    src = np.dtype(str(arr.dtype))
+    if dt.itemsize == src.itemsize:
+        return Tensor(jax.lax.bitcast_convert_type(arr, dt))
+    if dt.itemsize < src.itemsize:  # narrowing: (..., n) -> (..., n*r)
+        out = jax.lax.bitcast_convert_type(arr, dt)  # (..., n, r)
+        return Tensor(out.reshape(out.shape[:-2] + (-1,)))
+    ratio = dt.itemsize // src.itemsize  # widening: (..., n) -> (..., n/r)
+    if arr.shape[-1] % ratio:
+        raise ValueError(
+            f"view: last dim {arr.shape[-1]} not divisible by the "
+            f"{src}->{dt} size ratio {ratio}")
+    grouped = arr.reshape(arr.shape[:-1] + (arr.shape[-1] // ratio, ratio))
+    return Tensor(jax.lax.bitcast_convert_type(grouped, dt))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, tuple(unwrap(other).shape))

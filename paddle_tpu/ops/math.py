@@ -352,3 +352,36 @@ def accuracy_op(pred, label, k=1):
     topk = jnp.argsort(-p, axis=-1)[..., :k]
     correct = jnp.any(topk == l.reshape(-1, 1), axis=-1)
     return Tensor(jnp.mean(correct.astype(jnp.float32)))
+
+
+@primitive("nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive("rad2deg")
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+@primitive("deg2rad")
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+@primitive("ldexp")
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, y)
+
+
+@primitive("polygamma", nondiff=("n",))
+def polygamma(x, n, name=None):
+    import jax.scipy.special as jsp
+
+    return jsp.polygamma(n, x)
+
+
+@primitive("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    return jnp.trapezoid(jnp.asarray(y), x=x,
+                         dx=1.0 if dx is None else dx, axis=axis)

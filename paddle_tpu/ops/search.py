@@ -123,3 +123,12 @@ def histogram(input, bins=100, min=0, max=0, name=None):
         lo, hi = min, max
     hist, _ = jnp.histogram(input, bins=bins, range=(lo, hi))
     return hist
+
+
+@primitive("bucketize", nondiff=("sorted_sequence",))
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Bucket index of each x in a 1-D sorted sequence (reference
+    searchsorted over buckets; operators/searchsorted_op.cc flavor)."""
+    idx = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(x),
+                           side="right" if right else "left")
+    return idx.astype(jnp.int32 if out_int32 else jnp.int64)

@@ -17,6 +17,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from ..framework import random as random_mod
 from ..framework.tensor import Tensor
 from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
@@ -466,7 +467,7 @@ class Dpsgd(Optimizer):
                  sigma=1.0, parameters=None, seed=0, **kw):
         super().__init__(learning_rate, parameters)
         self._clip, self._batch, self._sigma = clip, batch_size, sigma
-        self._key = jax.random.key(seed or 0)
+        self._key = random_mod.make_key(seed or 0)
 
     def rule(self, g, p, slots, lr, t):
         sub = jax.random.fold_in(self._key, t)

@@ -2,6 +2,7 @@
 sync/async/geo family, large_scale_kv, FleetWrapper pull/push). See each
 module's docstring for the reference mapping."""
 from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
+from .heartbeat import HeartBeatMonitor  # noqa: F401
 from .embedding import SparseEmbedding  # noqa: F401
 from .server import run_server  # noqa: F401
 from .service import PSClient, PSServer  # noqa: F401

@@ -1,0 +1,94 @@
+"""Trainer liveness tracking for the parameter server.
+
+Parity with /root/reference/paddle/fluid/operators/distributed/
+heart_beat_monitor.{h,cc}: every trainer beats periodically; a monitor
+thread on the pserver walks the table and flags trainers whose last beat
+is older than the timeout (the reference logs ERROR and, for the chief
+trainer 0, aborts the job). Here the policy is injectable via `on_dead`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+RUNNING = 0
+COMPLETED = 1
+
+
+class HeartBeatMonitor:
+    """Tracks last-beat timestamps per trainer (heart_beat_monitor.cc:60
+    Update / :80 LostWorkerMonitor loop)."""
+
+    def __init__(self, num_trainers: int, timeout_s: float = 120.0,
+                 check_interval_s: float = 1.0,
+                 on_dead: Optional[Callable[[int], None]] = None):
+        self._timeout = float(timeout_s)
+        self._interval = float(check_interval_s)
+        self._on_dead = on_dead
+        self._lock = threading.Lock()
+        self._beats: Dict[int, float] = {}
+        self._status: Dict[int, int] = {}
+        self._dead: set = set()
+        self._num_trainers = int(num_trainers)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- updates ------------------------------------------------------------
+    def update(self, trainer_id: int, status: int = RUNNING):
+        with self._lock:
+            self._beats[trainer_id] = time.monotonic()
+            self._status[trainer_id] = status
+            self._dead.discard(trainer_id)
+
+    # -- queries ------------------------------------------------------------
+    def alive(self, trainer_id: int) -> bool:
+        with self._lock:
+            if self._status.get(trainer_id) == COMPLETED:
+                return True
+            t = self._beats.get(trainer_id)
+            return t is not None and \
+                time.monotonic() - t <= self._timeout and \
+                trainer_id not in self._dead
+
+    def dead_trainers(self) -> List[int]:
+        with self._lock:
+            return sorted(self._dead)
+
+    def completed_trainers(self) -> List[int]:
+        with self._lock:
+            return sorted(t for t, s in self._status.items()
+                          if s == COMPLETED)
+
+    def all_completed(self) -> bool:
+        with self._lock:
+            done = sum(1 for s in self._status.values() if s == COMPLETED)
+            return done >= self._num_trainers
+
+    # -- monitor loop --------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            now = time.monotonic()
+            newly_dead = []
+            with self._lock:
+                for tid, t in self._beats.items():
+                    if (self._status.get(tid) != COMPLETED
+                            and tid not in self._dead
+                            and now - t > self._timeout):
+                        self._dead.add(tid)
+                        newly_dead.append(tid)
+            for tid in newly_dead:
+                if self._on_dead is not None:
+                    self._on_dead(tid)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

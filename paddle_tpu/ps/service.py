@@ -31,7 +31,7 @@ import numpy as np
 from .table import SparseTable
 
 OP_PULL, OP_PUSH, OP_MERGE, OP_SAVE, OP_LOAD, OP_ROWS, OP_BARRIER, \
-    OP_STOP = range(8)
+    OP_STOP, OP_HEARTBEAT = range(9)
 
 _HDR = struct.Struct("<BIQf")
 
@@ -52,8 +52,12 @@ class PSServer:
     """One parameter-server process/thread (listen_and_serv_op parity)."""
 
     def __init__(self, tables: Dict[int, SparseTable], host="127.0.0.1",
-                 port: int = 0, num_trainers: int = 1):
+                 port: int = 0, num_trainers: int = 1,
+                 heartbeat_timeout_s: float = 120.0):
+        from .heartbeat import HeartBeatMonitor
+
         self.tables = tables
+        self.monitor = HeartBeatMonitor(num_trainers, heartbeat_timeout_s)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -68,6 +72,7 @@ class PSServer:
         return f"{self.host}:{self.port}"
 
     def start(self):
+        self.monitor.start()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -96,6 +101,11 @@ class PSServer:
                     conn.sendall(b"\x01")
                     self._stop.set()
                     return
+                if op == OP_HEARTBEAT:
+                    # trainer_id rides the table field, status the count
+                    self.monitor.update(table_id, int(n))
+                    conn.sendall(b"\x01")
+                    continue
                 if op == OP_BARRIER:
                     try:
                         self._barrier.wait(timeout=60)
@@ -132,6 +142,7 @@ class PSServer:
 
     def stop(self):
         self._stop.set()
+        self.monitor.stop()
         try:
             self._srv.close()
         except OSError:
@@ -149,6 +160,8 @@ class PSClient:
         self._eps = list(endpoints)
         self._socks: List[Optional[socket.socket]] = [None] * len(self._eps)
         self._locks = [threading.Lock() for _ in self._eps]
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
@@ -229,6 +242,45 @@ class PSClient:
             t.start()
         for t in threads:
             t.join()
+
+    def heartbeat(self, trainer_id: int, status: int = 0):
+        """Beat every pserver (reference HeartbeatRPC; status 0=running,
+        1=completed — see ps/heartbeat.py)."""
+        for k in range(len(self._eps)):
+            with self._locks[k]:
+                s = self._sock(k)
+                s.sendall(_HDR.pack(OP_HEARTBEAT, trainer_id, status, 0.0))
+                _recv_exact(s, 1)
+
+    def start_heartbeat(self, trainer_id: int, interval_s: float = 10.0):
+        """Background beat thread (the reference Communicator's send
+        thread beats as a side effect; here it is explicit)."""
+        if self._hb_thread is not None:
+            return
+
+        def loop():
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    self.heartbeat(trainer_id)
+                except (ConnectionError, OSError):
+                    return
+
+        self.heartbeat(trainer_id)
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self, trainer_id: Optional[int] = None,
+                       completed: bool = True):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        self._hb_stop = threading.Event()
+        if trainer_id is not None and completed:
+            try:
+                self.heartbeat(trainer_id, status=1)
+            except (ConnectionError, OSError):
+                pass
 
     def stop_servers(self):
         for k in range(len(self._eps)):

@@ -28,6 +28,7 @@ from . import initializer  # noqa: F401
 from .backward import append_backward, calc_gradient  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 from .viz import hlo_text, program_to_dot, save_dot  # noqa: F401
+from .feeder import DataFeeder  # noqa: F401
 from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401

@@ -189,7 +189,7 @@ class Executor:
 
         state = [scope.find_var(n) for n in persist_names]
         seed = program.random_seed or random_mod.default_generator().initial_seed()
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        rng = jax.random.fold_in(random_mod.make_key(seed), self._step)
         self._step += 1
         feed_vals = [feed[k] for k in sorted(feed.keys())]
         fetches, new_state = compiled(feed_vals, state, rng)
@@ -352,7 +352,7 @@ class Executor:
         (Executor.run on a startup program delegates here.)"""
         scope = scope or global_scope()
         seed = program.random_seed or random_mod.default_generator().initial_seed()
-        ctx = ExecContext(rng_key=jax.random.PRNGKey(seed))
+        ctx = ExecContext(rng_key=random_mod.make_key(seed))
         env = {n: scope.find_var(n) for n in program.global_block.vars
                if scope.find_var(n) is not None}
         env = run_block(program.global_block, env, ctx)

@@ -1,0 +1,73 @@
+"""DataFeeder: minibatch samples -> executor feed dict.
+
+Parity with /root/reference/python/paddle/fluid/data_feeder.py
+(DataFeeder :229, feed :331): converts an iterable of per-sample tuples
+into the arrays the executor feeds, keyed by the data vars' names.
+
+TPU-native handling of ragged slots: a sample field that is a variable-
+length sequence becomes padded dense + a `<name>_lens` entry (the
+dense+lengths LoD rewrite used by ops/sequence.py and
+Executor.train_from_dataset) instead of a LoDTensor.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from ..framework.lod import LoDTensor
+from .ir import Variable
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.feed_names: List[str] = []
+        self.feed_dtypes: List[str] = []
+        for v in feed_list:
+            if isinstance(v, Variable):
+                self.feed_names.append(v.name)
+                self.feed_dtypes.append(getattr(v, "dtype", "float32"))
+            else:
+                self.feed_names.append(str(v))
+                self.feed_dtypes.append("float32")
+        self.place = place
+
+    def feed(self, iterable) -> Dict[str, Any]:
+        """iterable: list of samples, each a tuple aligned with feed_list."""
+        columns: List[List[Any]] = [[] for _ in self.feed_names]
+        for sample in iterable:
+            if len(sample) != len(self.feed_names):
+                raise ValueError(
+                    f"sample has {len(sample)} fields, feed_list expects "
+                    f"{len(self.feed_names)}")
+            for col, field in zip(columns, sample):
+                col.append(field)
+        out: Dict[str, Any] = {}
+        for name, dtype, col in zip(self.feed_names, self.feed_dtypes,
+                                    columns):
+            out.update(self._present(name, dtype, col))
+        return out
+
+    @staticmethod
+    def _pad_rows(name: str, dtype: str, rows: List[np.ndarray]):
+        lengths = np.asarray([r.shape[0] for r in rows], np.int64)
+        maxlen = int(lengths.max()) if len(rows) else 0
+        tail = rows[0].shape[1:] if rows else ()
+        padded = np.zeros((len(rows), maxlen) + tail, rows[0].dtype)
+        for i, r in enumerate(rows):
+            padded[i, :r.shape[0]] = r
+        return {name: padded.astype(dtype, copy=False),
+                f"{name}_lens": lengths}
+
+    @classmethod
+    def _present(cls, name: str, dtype: str, col: List[Any]
+                 ) -> Dict[str, Any]:
+        if col and isinstance(col[0], LoDTensor):
+            return cls._pad_rows(name, dtype,
+                                 [np.asarray(s.numpy()) for s in col])
+        arrs = [np.asarray(c) for c in col]
+        ragged = arrs and any(a.shape != arrs[0].shape for a in arrs)
+        if ragged:
+            return cls._pad_rows(name, dtype, arrs)
+        arr = np.stack(arrs) if arrs else np.zeros(0)
+        return {name: arr.astype(dtype, copy=False)}

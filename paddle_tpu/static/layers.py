@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
 from ..utils import unique_name
 from .ir import (Block, ParamDesc, Program, Variable, default_main_program,
                  default_startup_program, _DYN_SENTINEL)
@@ -45,7 +46,7 @@ def _infer_outputs(block: Block, op, out_slots: Dict[str, int]):
         concrete_ins[slot] = arrs
 
     def absfn(ins):
-        ctx = ExecContext(rng_key=jax.random.PRNGKey(0))
+        ctx = ExecContext(rng_key=random_mod.make_key(0))
         return kernel(ins, op.attrs, ctx)
 
     outs = jax.eval_shape(absfn, concrete_ins)

@@ -1,0 +1,121 @@
+"""C inference API tests (reference inference/capi/ +
+inference/capi_tester.cc pattern): exercise the embedded-CPython C API
+both in-process via ctypes and from a real compiled C client."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 3)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(0)
+    net = _Net()
+    net.eval()
+    prefix = str(tmp_path_factory.mktemp("capi") / "model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 4], "float32")])
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    expect = net(paddle.to_tensor(x)).numpy()
+    return prefix, x, expect
+
+
+def test_capi_in_process(saved_model):
+    from paddle_tpu.native import capi_lib
+
+    prefix, x, expect = saved_model
+    lib = capi_lib()
+    assert lib is not None, "capi must build (g++ + libpython baked in)"
+    p = lib.PD_NewPredictor(prefix.encode())
+    assert p, lib.PD_GetLastError()
+    try:
+        n_in = lib.PD_GetInputNum(p)
+        assert n_in == 1
+        name = lib.PD_GetInputName(p, 0)
+        assert name == b"x0"
+        shape = (ctypes.c_int64 * 2)(2, 4)
+        data = x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        assert lib.PD_SetInputFloat(p, name, data, shape, 2) == 0
+        assert lib.PD_Run(p) == 0, lib.PD_GetLastError()
+        assert lib.PD_GetOutputNum(p) == 1
+        out_data = ctypes.POINTER(ctypes.c_float)()
+        out_shape = ctypes.POINTER(ctypes.c_int64)()
+        out_ndim = ctypes.c_int()
+        assert lib.PD_GetOutputFloat(p, 0, ctypes.byref(out_data),
+                                     ctypes.byref(out_shape),
+                                     ctypes.byref(out_ndim)) == 0
+        dims = [out_shape[i] for i in range(out_ndim.value)]
+        assert dims == [2, 3]
+        got = np.ctypeslib.as_array(out_data, shape=(2, 3)).copy()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    finally:
+        lib.PD_DeletePredictor(p)
+
+
+C_CLIENT = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (PD_Init(argv[2]) != 0) {
+    fprintf(stderr, "init: %s\n", PD_GetLastError());
+    return 2;
+  }
+  PD_Predictor* p = PD_NewPredictor(argv[1]);
+  if (!p) { fprintf(stderr, "new: %s\n", PD_GetLastError()); return 3; }
+  float x[8]; int64_t shape[2] = {2, 4};
+  for (int i = 0; i < 8; ++i) x[i] = (float)i;
+  if (PD_SetInputFloat(p, PD_GetInputName(p, 0), x, shape, 2) != 0) return 4;
+  if (PD_Run(p) != 0) { fprintf(stderr, "run: %s\n", PD_GetLastError()); return 5; }
+  const float* out; const int64_t* oshape; int ondim;
+  if (PD_GetOutputFloat(p, 0, &out, &oshape, &ondim) != 0) return 6;
+  printf("ndim=%d shape=%lld,%lld\n", ondim,
+         (long long)oshape[0], (long long)oshape[1]);
+  for (int i = 0; i < 6; ++i) printf("%.6f ", out[i]);
+  printf("\n");
+  PD_DeletePredictor(p);
+  return 0;
+}
+"""
+
+
+def test_capi_from_c_client(saved_model, tmp_path):
+    from paddle_tpu.native import _BUILD, capi_build_flags, capi_lib
+
+    prefix, x, expect = saved_model
+    lib = capi_lib()
+    assert lib is not None
+    so = lib._name
+    src = tmp_path / "client.c"
+    src.write_text(C_CLIENT)
+    exe = tmp_path / "client"
+    inc = os.path.join(REPO, "paddle_tpu", "native", "include")
+    cmd = ["g++", "-o", str(exe), str(src), f"-I{inc}", so,
+           f"-Wl,-rpath,{_BUILD}"] + capi_build_flags()
+    subprocess.run(cmd, check=True, capture_output=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([str(exe), prefix, REPO], capture_output=True,
+                       text=True, timeout=240, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "ndim=2 shape=2,3"
+    got = np.array([float(v) for v in lines[1].split()]).reshape(2, 3)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,133 @@
+"""fs layer + encrypted-model-io tests (reference
+framework/io/fs.cc, io/crypto/aes_cipher_test.cc, incubate fs.py tests)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.crypto import (AESCipher, _ctr_py, gen_key,
+                                  gen_key_to_file)
+from paddle_tpu.io.fs import (ExecuteError, FSFileExistsError, HDFSClient,
+                              LocalFS)
+
+
+# FIPS-197 appendix C vectors
+VEC128 = (bytes(range(16)), bytes.fromhex("00112233445566778899aabbccddeeff"),
+          bytes.fromhex("69c4e0d86a7b0430d8cdb78070b4c55a"))
+VEC256 = (bytes(range(32)), bytes.fromhex("00112233445566778899aabbccddeeff"),
+          bytes.fromhex("8ea2b7ca516745bfeafc49904b496089"))
+# NIST SP800-38A F.5.1 CTR-AES128 first block
+CTR_KEY = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+CTR_IV = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+CTR_PT = bytes.fromhex("6bc1bee22e409f96e93d7e117393172a")
+CTR_CT = bytes.fromhex("874d6191b620e3261bef6864990db6ce")
+
+
+def test_native_block_matches_fips_vectors():
+    from paddle_tpu.native import load_library
+    import ctypes
+
+    lib = load_library("aes")
+    assert lib is not None, "native AES must build (g++ is baked in)"
+    for key, pt, expect in (VEC128, VEC256):
+        out = ctypes.create_string_buffer(16)
+        rc = lib.pt_aes_encrypt_block(key, len(key), pt, out)
+        assert rc == 0
+        assert out.raw == expect
+
+
+def test_python_ctr_matches_nist_vector():
+    assert _ctr_py(CTR_KEY, CTR_IV, CTR_PT) == CTR_CT
+
+
+def test_native_and_python_agree():
+    key = bytes(range(32))
+    iv = bytes(range(16))
+    data = bytes(os.urandom(1000))
+    c = AESCipher(key)
+    native = c._ctr(iv, data)
+    assert native == _ctr_py(key, iv, data)
+
+
+def test_cipher_roundtrip_and_file(tmp_path):
+    key = gen_key()
+    c = AESCipher(key)
+    msg = b"paddle_tpu encrypted checkpoint" * 100
+    ct = c.encrypt(msg)
+    assert ct[16:] != msg[:len(ct) - 16]
+    assert c.decrypt(ct) == msg
+    # wrong key fails to roundtrip
+    assert AESCipher(gen_key()).decrypt(ct) != msg
+
+    src = tmp_path / "model.pdparams"
+    src.write_bytes(msg)
+    enc = tmp_path / "model.enc"
+    dec = tmp_path / "model.dec"
+    c.encrypt_file(str(src), str(enc))
+    c.decrypt_file(str(enc), str(dec))
+    assert dec.read_bytes() == msg
+
+
+def test_gen_key_to_file(tmp_path):
+    p = tmp_path / "key.bin"
+    key = gen_key_to_file(str(p))
+    assert p.read_bytes() == key and len(key) == 32
+    assert (os.stat(p).st_mode & 0o777) == 0o600
+
+
+def test_bad_key_rejected():
+    with pytest.raises(ValueError):
+        AESCipher(b"short")
+
+
+def test_local_fs(tmp_path):
+    fs = LocalFS()
+    d = tmp_path / "ckpt"
+    fs.mkdirs(str(d))
+    assert fs.is_dir(str(d)) and fs.is_exist(str(d))
+    f = d / "a.txt"
+    fs.touch(str(f))
+    assert fs.is_file(str(f))
+    with pytest.raises(FSFileExistsError):
+        fs.touch(str(f), exist_ok=False)
+    dirs, files = fs.ls_dir(str(d))
+    assert files == ["a.txt"] and dirs == []
+    fs.mv(str(f), str(d / "b.txt"))
+    assert fs.is_file(str(d / "b.txt")) and not fs.is_exist(str(f))
+    (d / "sub").mkdir()
+    assert fs.list_dirs(str(d)) == ["sub"]
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    assert fs.need_upload_download() is False
+
+
+def test_hdfs_client_command_construction():
+    calls = []
+
+    def fake_runner(args):
+        calls.append(args)
+        if args[0] == "-ls":
+            return 0, [
+                "Found 2 items",
+                "drwxr-xr-x - u g 0 2026-01-01 00:00 hdfs://nn/a/dir1",
+                "-rw-r--r-- 3 u g 9 2026-01-01 00:00 hdfs://nn/a/f1",
+            ]
+        return 0, []
+
+    fs = HDFSClient(hadoop_home="/opt/hadoop",
+                    configs={"fs.default.name": "hdfs://nn:9000"},
+                    _runner=fake_runner)
+    dirs, files = fs.ls_dir("hdfs://nn/a")
+    assert dirs == ["dir1"] and files == ["f1"]
+    assert fs.need_upload_download() is True
+    fs.mkdirs("hdfs://nn/b")
+    assert ["-mkdir", "-p", "hdfs://nn/b"] in calls
+    base = fs._base_cmd()
+    assert base[0] == "/opt/hadoop/bin/hadoop"
+    assert "-D" in base and "fs.default.name=hdfs://nn:9000" in base
+
+
+def test_hdfs_client_without_binary_errors():
+    fs = HDFSClient(hadoop_home="/nonexistent")
+    with pytest.raises(ExecuteError, match="no hadoop binary"):
+        fs.is_exist("hdfs://nn/x")

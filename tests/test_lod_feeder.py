@@ -1,0 +1,105 @@
+"""LoDTensor compat layer + DataFeeder tests (reference
+test_lod_tensor.py / data_feeder tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def test_create_lod_tensor_from_lengths():
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    t = paddle.create_lod_tensor(data, [[2, 3]])
+    assert t.lod() == [[0, 2, 5]]
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.has_valid_recursive_sequence_lengths()
+    np.testing.assert_array_equal(t.numpy(), data)
+
+
+def test_create_lod_tensor_from_list():
+    t = paddle.create_lod_tensor([[1, 2, 3], [4, 5]], None)
+    assert t.recursive_sequence_lengths() == [[3, 2]]
+    np.testing.assert_array_equal(t.numpy().ravel(), [1, 2, 3, 4, 5])
+
+
+def test_invalid_lod_rejected():
+    data = np.zeros((4, 1), np.float32)
+    with pytest.raises(ValueError):
+        paddle.create_lod_tensor(data, [[2, 3]])  # 5 rows != 4
+
+
+def test_nested_lod_validity():
+    t = paddle.LoDTensor(np.zeros((5, 1)), lod=[[0, 2, 3], [0, 2, 4, 5]])
+    assert t.has_valid_recursive_sequence_lengths()
+    bad = paddle.LoDTensor(np.zeros((5, 1)), lod=[[0, 3, 2]])
+    assert not bad.has_valid_recursive_sequence_lengths()
+
+
+def test_dense_lengths_roundtrip():
+    data = np.arange(5, dtype=np.float32).reshape(5, 1)
+    t = paddle.create_lod_tensor(data, [[2, 3]])
+    dense, lens = t.to_dense_lengths()
+    assert dense.shape == (2, 3, 1)
+    np.testing.assert_array_equal(lens, [2, 3])
+    np.testing.assert_array_equal(dense[0, :2, 0], [0, 1])
+    np.testing.assert_array_equal(dense[0, 2], 0)  # padding
+    back = paddle.LoDTensor.from_dense_lengths(dense, lens)
+    np.testing.assert_array_equal(back.numpy(), data)
+    assert back.lod() == [[0, 2, 5]]
+
+
+def test_create_random_int_lodtensor():
+    t = paddle.create_random_int_lodtensor([[2, 3]], base_shape=[1],
+                                           low=0, high=9)
+    assert t.shape() == (5, 1)
+    assert t.numpy().max() <= 9 and t.numpy().min() >= 0
+
+
+def test_data_feeder_dense():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 3])
+        y = static.data("y", [-1, 1], dtype="int64")
+    feeder = static.DataFeeder(feed_list=[x, y])
+    batch = [(np.ones(3, np.float32), np.array([1])),
+             (np.zeros(3, np.float32), np.array([0]))]
+    feed = feeder.feed(batch)
+    assert feed["x"].shape == (2, 3) and feed["x"].dtype == np.float32
+    assert feed["y"].shape == (2, 1) and feed["y"].dtype == np.int64
+
+
+def test_data_feeder_ragged_pads_with_lengths():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = static.data("ids", [-1, -1], dtype="int64")
+    feeder = static.DataFeeder(feed_list=[ids])
+    feeder.feed_dtypes = ["int64"]
+    batch = [(np.array([1, 2, 3]),), (np.array([4]),)]
+    feed = feeder.feed(batch)
+    np.testing.assert_array_equal(feed["ids"],
+                                  [[1, 2, 3], [4, 0, 0]])
+    np.testing.assert_array_equal(feed["ids_lens"], [3, 1])
+
+
+def test_data_feeder_end_to_end():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 3])
+        label = static.data("label", [-1, 1], dtype="int64")
+        loss = static.mean(static.softmax_with_cross_entropy(
+            static.nn.fc(x, 2), label))
+    feeder = static.DataFeeder(feed_list=[x, label])
+    feeder.feed_dtypes = ["float32", "int64"]
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    batch = [(rng.randn(3).astype(np.float32), np.array([i % 2]))
+             for i in range(8)]
+    out, = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+    assert np.isfinite(out).all()
+
+
+def test_feeder_field_count_mismatch():
+    feeder = static.DataFeeder(feed_list=["a", "b"])
+    with pytest.raises(ValueError, match="fields"):
+        feeder.feed([(1,)])

@@ -1,0 +1,107 @@
+"""Tests for the long-tail tensor ops added for API completeness
+(reference operators: searchsorted_op, unique_consecutive_op, trapezoid,
+and the math jnp wrappers), plus the PRNG impl flag."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_nanmedian():
+    x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], np.float32)
+    np.testing.assert_allclose(paddle.nanmedian(t(x)).numpy(),
+                               np.nanmedian(x))
+
+
+def test_rad2deg_deg2rad_roundtrip():
+    x = np.linspace(-3, 3, 7).astype(np.float32)
+    got = paddle.deg2rad(paddle.rad2deg(t(x)))
+    np.testing.assert_allclose(got.numpy(), x, rtol=1e-6)
+
+
+def test_ldexp():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    e = np.array([1, 2, 3], np.int32)
+    np.testing.assert_allclose(paddle.ldexp(t(x), t(e)).numpy(),
+                               np.ldexp(x, e))
+
+
+def test_polygamma():
+    # polygamma(1, 1) = trigamma(1) = pi^2/6
+    got = paddle.polygamma(t(np.array([1.0], np.float32)), 1)
+    np.testing.assert_allclose(got.numpy(), np.pi ** 2 / 6, rtol=1e-5)
+
+
+def test_trapezoid():
+    y = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(paddle.trapezoid(t(y)).numpy(), 4.0)
+    x = np.array([0.0, 1.0, 3.0], np.float32)
+    np.testing.assert_allclose(paddle.trapezoid(t(y), x=t(x)).numpy(),
+                               np.trapezoid(y, x))
+    np.testing.assert_allclose(paddle.trapezoid(t(y), dx=0.5).numpy(),
+                               np.trapezoid(y, dx=0.5))
+
+
+def test_bucketize():
+    edges = np.array([1.0, 3.0, 5.0], np.float32)
+    x = np.array([0.5, 1.0, 2.0, 5.0, 9.0], np.float32)
+    got = paddle.bucketize(t(x), t(edges))
+    np.testing.assert_array_equal(got.numpy(),
+                                  np.searchsorted(edges, x, side="left"))
+    got_r = paddle.bucketize(t(x), t(edges), right=True, out_int32=True)
+    np.testing.assert_array_equal(got_r.numpy(),
+                                  np.searchsorted(edges, x, side="right"))
+    assert got_r.numpy().dtype == np.int32
+
+
+def test_unique_consecutive():
+    x = np.array([1, 1, 2, 2, 2, 3, 1, 1], np.int64)
+    out, inv, cnt = paddle.unique_consecutive(
+        t(x), return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3, 1])
+    np.testing.assert_array_equal(cnt.numpy(), [2, 3, 1, 2])
+    np.testing.assert_array_equal(out.numpy()[inv.numpy()], x)
+
+
+def test_unique_consecutive_axis():
+    x = np.array([[1, 2], [1, 2], [3, 4]], np.int64)
+    out = paddle.unique_consecutive(t(x), axis=0)
+    np.testing.assert_array_equal(out.numpy(), [[1, 2], [3, 4]])
+
+
+def test_as_strided():
+    x = np.arange(12, dtype=np.float32)
+    # sliding windows of 3, step 2 -> shape (5, 3), strides (2, 1)
+    got = paddle.as_strided(t(x), [5, 3], [2, 1]).numpy()
+    expect = np.lib.stride_tricks.as_strided(
+        x, shape=(5, 3), strides=(8, 4))
+    np.testing.assert_array_equal(got, expect)
+    # offset
+    got2 = paddle.as_strided(t(x), [2, 2], [4, 1], offset=1).numpy()
+    np.testing.assert_array_equal(got2, [[1, 2], [5, 6]])
+
+
+def test_view_reshape_and_bitcast():
+    x = np.arange(8, dtype=np.float32)
+    assert tuple(paddle.view(t(x), [2, 4]).shape) == (2, 4)
+    bits = paddle.view(t(x), "int32")
+    assert bits.numpy().dtype == np.int32
+    np.testing.assert_array_equal(bits.numpy(),
+                                  x.view(np.int32))
+
+
+def test_prng_impl_flag_resolution():
+    from paddle_tpu.framework import random as random_mod
+    from paddle_tpu.framework.flags import get_flag
+
+    assert get_flag("prng_impl") == "auto"
+    impl = random_mod.prng_impl()
+    # conftest forces the cpu backend -> threefry
+    assert impl == "threefry2x32"
+    key = random_mod.make_key(0)
+    import jax
+    assert str(jax.random.key_impl(key)) == impl

@@ -1,6 +1,8 @@
 """Parameter-server subsystem: native KV table, TCP service, communicator
 modes, sparse embedding training (reference test pattern: multi-"node" on
 localhost, SURVEY §4.3)."""
+import time
+
 import numpy as np
 import pytest
 
@@ -177,3 +179,75 @@ def test_sparse_embedding_trains():
         losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
     assert emb._table.rows() <= 50
+
+
+def test_heartbeat_monitor_marks_dead_and_completed():
+    from paddle_tpu.ps.heartbeat import COMPLETED, HeartBeatMonitor
+
+    dead = []
+    m = HeartBeatMonitor(num_trainers=2, timeout_s=0.2,
+                         check_interval_s=0.05, on_dead=dead.append)
+    m.start()
+    try:
+        m.update(0)
+        m.update(1)
+        assert m.alive(0) and m.alive(1)
+        # trainer 1 completes, trainer 0 goes silent
+        m.update(1, COMPLETED)
+        time.sleep(0.6)
+        assert m.dead_trainers() == [0]
+        assert dead == [0]
+        assert not m.alive(0)
+        assert m.alive(1)  # completed trainers are never "dead"
+        assert m.completed_trainers() == [1]
+        # a late beat revives the trainer (rejoin)
+        m.update(0)
+        assert m.alive(0) and m.dead_trainers() == []
+    finally:
+        m.stop()
+
+
+def test_heartbeat_over_ps_service():
+    from paddle_tpu.ps.heartbeat import COMPLETED
+    from paddle_tpu.ps.service import PSClient, PSServer
+    from paddle_tpu.ps.table import SparseTable
+
+    srv = PSServer({0: SparseTable(dim=4)}, num_trainers=2,
+                   heartbeat_timeout_s=0.3)
+    srv.monitor._interval = 0.05  # fast checks for the test
+    srv.start()
+    client = PSClient([srv.endpoint])
+    try:
+        client.heartbeat(trainer_id=0)
+        client.heartbeat(trainer_id=1)
+        assert srv.monitor.alive(0) and srv.monitor.alive(1)
+        client.heartbeat(trainer_id=1, status=COMPLETED)
+        time.sleep(0.8)
+        assert srv.monitor.dead_trainers() == [0]
+        assert srv.monitor.alive(1)
+        assert not srv.monitor.all_completed()
+        client.heartbeat(trainer_id=0, status=COMPLETED)
+        assert srv.monitor.all_completed()
+    finally:
+        client.stop_servers()
+        client.close()
+        srv.stop()
+
+
+def test_client_background_heartbeat():
+    from paddle_tpu.ps.service import PSClient, PSServer
+    from paddle_tpu.ps.table import SparseTable
+
+    srv = PSServer({0: SparseTable(dim=4)}, num_trainers=1,
+                   heartbeat_timeout_s=5.0).start()
+    client = PSClient([srv.endpoint])
+    try:
+        client.start_heartbeat(trainer_id=0, interval_s=0.05)
+        time.sleep(0.2)
+        assert srv.monitor.alive(0)
+        client.stop_heartbeat(trainer_id=0)
+        assert srv.monitor.completed_trainers() == [0]
+    finally:
+        client.stop_servers()
+        client.close()
+        srv.stop()
