@@ -6,6 +6,7 @@ from .transformer import TransformerNMT  # noqa: F401
 from .ctr import DeepFM, WideDeep  # noqa: F401
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
 from .word2vec import SkipGram, NGramLM  # noqa: F401
+from .sentiment import SentimentLSTM  # noqa: F401
 from ..vision.models import (  # noqa: F401
     LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
     VGG, vgg16, vgg19, MobileNetV2, mobilenet_v2,

@@ -52,10 +52,18 @@ class GPTBlock(Layer):
         self.linear2 = Linear(cfg.intermediate_size, cfg.hidden_size)
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = x + self.dropout(self.self_attn(self.ln1(x)))
+    def forward(self, x, cache=None):
+        if cache is not None:
+            attn, cache = self.self_attn(self.ln1(x), cache=cache)
+        else:
+            attn = self.self_attn(self.ln1(x))
+        x = x + self.dropout(attn)
         h = self.linear2(F.gelu(self.linear1(self.ln2(x))))
-        return x + self.dropout(h)
+        out = x + self.dropout(h)
+        return (out, cache) if cache is not None else out
+
+    def gen_cache(self, x):
+        return self.self_attn.gen_cache(x)
 
 
 class GPTModel(Layer):
@@ -72,18 +80,29 @@ class GPTModel(Layer):
             [GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos_offset=0):
         b, l = input_ids.shape
-        if l > self.cfg.max_position_embeddings:
+        if pos_offset + l > self.cfg.max_position_embeddings:
             raise ValueError(
-                f"sequence length {l} exceeds max_position_embeddings "
+                f"sequence length {pos_offset + l} exceeds "
+                f"max_position_embeddings "
                 f"{self.cfg.max_position_embeddings}")
-        pos = ops.arange(0, l, dtype="int32")
+        pos = ops.arange(pos_offset, pos_offset + l, dtype="int32")
         x = self.word_embedding(input_ids) + self.pos_embedding(pos)
         x = self.dropout(x)
-        for blk in self.layers:
-            x = blk(x)
-        return self.ln_f(x)
+        if caches is None:
+            for blk in self.layers:
+                x = blk(x)
+            return self.ln_f(x)
+        new_caches = []
+        for blk, c in zip(self.layers, caches):
+            x, c = blk(x, cache=c)
+            new_caches.append(c)
+        return self.ln_f(x), new_caches
+
+    def gen_caches(self, x):
+        """Empty per-layer KV caches (MultiHeadAttention.gen_cache)."""
+        return [blk.gen_cache(x) for blk in self.layers]
 
 
 class GPTForCausalLM(Layer):
@@ -93,10 +112,12 @@ class GPTForCausalLM(Layer):
         # weight tying with the input embedding (standard GPT)
         self.cfg = cfg
 
-    def forward(self, input_ids):
-        h = self.gpt(input_ids)
+    def forward(self, input_ids, caches=None, pos_offset=0):
+        out = self.gpt(input_ids, caches=caches, pos_offset=pos_offset)
+        h, caches = out if caches is not None else (out, None)
         w = self.gpt.word_embedding.weight          # (V, D)
-        return ops.matmul(h, ops.transpose(w, [1, 0]))
+        logits = ops.matmul(h, ops.transpose(w, [1, 0]))
+        return (logits, caches) if caches is not None else logits
 
     def loss(self, input_ids, labels=None):
         """Next-token LM loss; labels default to input_ids shifted."""
@@ -109,13 +130,84 @@ class GPTForCausalLM(Layer):
         flat = ops.reshape(shift_logits, [-1, v])
         return F.cross_entropy(flat, ops.reshape(shift_labels, [-1])).mean()
 
-    def generate(self, input_ids, max_new_tokens=16):
-        """Greedy decode (eager; compile-friendly decode cache comes with
-        the serving path)."""
-        ids = input_ids
-        for _ in range(max_new_tokens):
-            window = ids[:, -self.cfg.max_position_embeddings:]
-            logits = self(window)
-            nxt = ops.argmax(logits[:, -1, :], axis=-1)
-            ids = ops.concat([ids, ops.reshape(nxt, [-1, 1])], axis=1)
-        return ids
+    def generate(self, input_ids, max_new_tokens=16, use_cache=True,
+                 do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
+                 eos_token_id=None, seed=None):
+        """Autoregressive decode with KV cache: prefill once on the
+        prompt, then one single-token step per new token reusing the
+        per-layer caches (the serving path of the reference's fused
+        decoder, multihead_matmul_op + beam/topk sampling ops). Greedy by
+        default; do_sample enables temperature / top-k / nucleus top-p.
+        """
+        import numpy as np
+
+        from ..framework import no_grad
+        from ..framework.tensor import Tensor
+
+        rng = np.random.RandomState(seed)
+        max_pos = self.cfg.max_position_embeddings
+        with no_grad():
+            ids = input_ids
+            b = ids.shape[0]
+            finished = np.zeros(b, bool)
+            caches = None
+            prompt = ids[:, -max_pos:]  # sliding-window truncation
+            if use_cache and prompt.shape[1] < max_pos:
+                logits, caches = self(
+                    prompt, caches=self.gpt.gen_caches(prompt))
+            else:
+                logits = self(prompt)
+            for step in range(max_new_tokens):
+                last = logits[:, -1, :]
+                nxt = self._pick_token(last, do_sample, top_k, top_p,
+                                       temperature, rng)
+                if eos_token_id is not None:
+                    nxt = np.where(finished, eos_token_id, nxt)
+                    finished |= nxt == eos_token_id
+                nxt_t = Tensor(nxt.reshape(b, 1).astype("int32"))
+                ids = ops.concat([ids, nxt_t], axis=1)
+                if eos_token_id is not None and finished.all():
+                    break
+                if step == max_new_tokens - 1:
+                    break
+                if use_cache and caches is not None \
+                        and ids.shape[1] < max_pos:
+                    logits, caches = self(nxt_t, caches=caches,
+                                          pos_offset=ids.shape[1] - 1)
+                else:
+                    # context full (or cacheless): slide the window and
+                    # recompute; the absolute positions shift, so the old
+                    # cache no longer applies
+                    caches = None
+                    logits = self(ids[:, -max_pos:])
+            return ids
+
+    @staticmethod
+    def _pick_token(last_logits, do_sample, top_k, top_p, temperature, rng):
+        """Greedy / temperature / top-k / top-p selection on host (the
+        per-token control flow; the model step stays on device)."""
+        import numpy as np
+
+        logits = np.asarray(last_logits.numpy(), np.float32)
+        if not do_sample:
+            return logits.argmax(-1)
+        if temperature and temperature != 1.0:
+            logits = logits / float(temperature)
+        if top_k:
+            k = min(int(top_k), logits.shape[-1])
+            kth = np.partition(logits, -k, axis=-1)[:, -k]
+            logits = np.where(logits < kth[:, None], -np.inf, logits)
+        if top_p < 1.0:
+            order = np.argsort(-logits, axis=-1)
+            sorted_logits = np.take_along_axis(logits, order, axis=-1)
+            probs = np.exp(sorted_logits - sorted_logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            cum = np.cumsum(probs, axis=-1)
+            cut = cum - probs >= top_p   # tokens past the nucleus
+            sorted_logits[cut] = -np.inf
+            logits = np.full_like(logits, -np.inf)
+            np.put_along_axis(logits, order, sorted_logits, axis=-1)
+        z = logits - logits.max(-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(-1, keepdims=True)
+        return np.array([rng.choice(p.shape[-1], p=row) for row in p])

@@ -1,0 +1,50 @@
+"""Sentiment classification models (reference book test
+/root/reference/python/paddle/fluid/tests/book/test_understand_sentiment.py:
+conv + stacked-LSTM text classifiers over IMDB).
+
+TPU-native shape: dense padded ids + lengths (no LoD), masked pooling, the
+whole step jit-compiled.
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..nn.rnn import LSTM
+
+
+class SentimentLSTM(Layer):
+    """Embedding -> (bi)LSTM -> masked max-pool -> FC (the stacked_lstm_net
+    flavor of the book test)."""
+
+    def __init__(self, vocab_size=5000, embed_dim=128, hidden_dim=128,
+                 num_layers=1, num_classes=2, bidirectional=True,
+                 dropout=0.1, pad_id=0):
+        super().__init__()
+        self.pad_id = pad_id
+        self.embedding = Embedding(vocab_size, embed_dim)
+        self.lstm = LSTM(embed_dim, hidden_dim, num_layers=num_layers,
+                         direction="bidirectional" if bidirectional
+                         else "forward")
+        out_dim = hidden_dim * (2 if bidirectional else 1)
+        self.norm = LayerNorm(out_dim)
+        self.dropout = Dropout(dropout)
+        self.fc = Linear(out_dim, num_classes)
+
+    def forward(self, ids, lengths=None):
+        """ids: (batch, maxlen) int; lengths: (batch,) valid counts
+        (defaults to counting non-pad ids)."""
+        if lengths is None:
+            lengths = ops.sum((ids != self.pad_id).astype("int64"), axis=1)
+        emb = self.embedding(ids)
+        seq, _ = self.lstm(emb)
+        # masked max-pool over time (sequence_pool 'max' semantics)
+        pooled = ops.sequence_pool(seq, lengths, pool_type="max")
+        h = self.dropout(self.norm(pooled))
+        return self.fc(h)
+
+    def loss(self, ids, labels, lengths=None):
+        logits = self(ids, lengths)
+        return F.cross_entropy(logits, labels)

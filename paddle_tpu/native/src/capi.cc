@@ -83,24 +83,32 @@ void set_error_from_python() {
 
 bool ensure_helper() {
   if (g_helper != nullptr) return true;
+  bool initialized_here = false;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
     g_we_initialized = true;
+    initialized_here = true;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* globals = PyDict_New();
   PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
   PyObject* r = PyRun_String(kHelperSrc, Py_file_input, globals, globals);
-  if (r == nullptr) {
+  bool ok = r != nullptr;
+  if (!ok) {
     set_error_from_python();
     Py_DECREF(globals);
-    PyGILState_Release(gil);
-    return false;
+  } else {
+    Py_DECREF(r);
+    g_helper = globals;
   }
-  Py_DECREF(r);
-  g_helper = globals;
   PyGILState_Release(gil);
-  return true;
+  if (initialized_here) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // other threads' PyGILState_Ensure can proceed (the header promises
+    // any-single-thread-at-a-time safety).
+    PyEval_SaveThread();
+  }
+  return ok;
 }
 
 PyObject* helper_call(const char* fn, PyObject* args) {
@@ -158,17 +166,21 @@ PD_Predictor* PD_NewPredictor(const char* model_prefix) {
     PyGILState_Release(gil);
     return nullptr;
   }
+  PyObject* names = PyObject_CallMethod(pred, "get_input_names", nullptr);
+  if (names == nullptr) {
+    set_error_from_python();  // fetches + clears the error indicator
+    Py_DECREF(pred);
+    PyGILState_Release(gil);
+    return nullptr;
+  }
   PD_Predictor* p = new PD_Predictor();
   p->pred = pred;
   p->feeds = PyDict_New();
-  PyObject* names = PyObject_CallMethod(pred, "get_input_names", nullptr);
-  if (names != nullptr) {
-    for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
-      p->input_names.emplace_back(
-          PyUnicode_AsUTF8(PyList_GetItem(names, i)));
-    }
-    Py_DECREF(names);
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    p->input_names.emplace_back(
+        PyUnicode_AsUTF8(PyList_GetItem(names, i)));
   }
+  Py_DECREF(names);
   PyGILState_Release(gil);
   return p;
 }

@@ -119,3 +119,45 @@ def test_capi_from_c_client(saved_model, tmp_path):
     assert lines[0] == "ndim=2 shape=2,3"
     got = np.array([float(v) for v in lines[1].split()]).reshape(2, 3)
     np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_capi_run_from_worker_thread(saved_model):
+    """The GIL must be released after embedded init so a second thread can
+    drive the predictor (serving pattern)."""
+    import threading
+
+    from paddle_tpu.native import capi_lib
+
+    prefix, x, expect = saved_model
+    lib = capi_lib()
+    result = {}
+
+    def worker():
+        p = lib.PD_NewPredictor(prefix.encode())
+        if not p:
+            result["err"] = lib.PD_GetLastError()
+            return
+        try:
+            shape = (ctypes.c_int64 * 2)(2, 4)
+            data = x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            lib.PD_SetInputFloat(p, b"x0", data, shape, 2)
+            if lib.PD_Run(p) != 0:
+                result["err"] = lib.PD_GetLastError()
+                return
+            out_data = ctypes.POINTER(ctypes.c_float)()
+            out_shape = ctypes.POINTER(ctypes.c_int64)()
+            out_ndim = ctypes.c_int()
+            lib.PD_GetOutputFloat(p, 0, ctypes.byref(out_data),
+                                  ctypes.byref(out_shape),
+                                  ctypes.byref(out_ndim))
+            result["out"] = np.ctypeslib.as_array(
+                out_data, shape=(2, 3)).copy()
+        finally:
+            lib.PD_DeletePredictor(p)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=120)
+    assert not t.is_alive(), "worker thread deadlocked (GIL not released?)"
+    assert "err" not in result, result.get("err")
+    np.testing.assert_allclose(result["out"], expect, rtol=1e-5, atol=1e-6)
