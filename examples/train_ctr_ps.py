@@ -1,0 +1,66 @@
+"""Wide&Deep-style CTR with the sparse side on a local parameter server
+(the reference's dist_fleet_ctr flow: pserver + trainer pull/push over
+the TCP KV service; BASELINE config 5 shape)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.ps import SparseEmbedding
+from paddle_tpu.ps.service import PSClient, PSServer
+from paddle_tpu.ps.table import SparseTable
+
+paddle.seed(0)
+FIELDS, VOCAB, DIM, DENSE = 8, 10000, 16, 4
+
+# -- "cluster": one in-process pserver (the reference spawns subprocesses;
+# the wire protocol is identical either way)
+server = PSServer({0: SparseTable(dim=DIM)}, num_trainers=1).start()
+client = PSClient([server.endpoint])
+client.start_heartbeat(trainer_id=0, interval_s=5.0)
+
+
+class WideDeepPS(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.emb = SparseEmbedding(DIM, client=client, table_id=0)
+        self.deep = nn.Sequential(
+            nn.Linear(FIELDS * DIM + DENSE, 64), nn.ReLU(),
+            nn.Linear(64, 1))
+
+    def forward(self, ids, dense):
+        vecs = self.emb(ids)                       # (B, FIELDS, DIM)
+        flat = paddle.reshape(vecs, [ids.shape[0], FIELDS * DIM])
+        return self.deep(paddle.concat([flat, dense], axis=1))
+
+
+model = WideDeepPS()
+dense_params = [p for p in model.parameters()]
+opt = optimizer.Adam(learning_rate=1e-3, parameters=dense_params)
+bce = nn.BCEWithLogitsLoss()
+rng = np.random.RandomState(0)
+
+first = last = None
+for step_i in range(60):
+    ids = paddle.to_tensor(
+        rng.randint(0, VOCAB, (64, FIELDS)).astype("int64"))
+    dense_np = rng.randn(64, DENSE).astype("float32")
+    label = (dense_np.sum(1, keepdims=True) > 0).astype("float32")
+    logits = model(ids, paddle.to_tensor(dense_np))
+    loss = bce(logits, paddle.to_tensor(label))
+    loss.backward()
+    model.emb.push_gradients(lr=0.05)   # sparse grads -> pserver
+    opt.step()                          # dense params update locally
+    opt.clear_grad()
+    if first is None:
+        first = float(loss)
+    last = float(loss)
+    if step_i % 20 == 0:
+        print(f"step {step_i}: loss {last:.4f}")
+
+print(f"loss {first:.4f} -> {last:.4f}; server rows: {client.rows(0)}")
+assert last < first
+client.stop_heartbeat(trainer_id=0)
+client.stop_servers()
+client.close()
+server.stop()
+print("OK")

@@ -41,3 +41,9 @@ def init_distributed(coordinator_address=None, num_processes=None,
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
     _initialized[0] = True
+
+
+from .transpiler import (  # noqa: F401,E402
+    DistributeTranspiler, DistributeTranspilerConfig, GeoSgdTranspiler,
+    PServerPlan,
+)

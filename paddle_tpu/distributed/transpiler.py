@@ -1,0 +1,169 @@
+"""DistributeTranspiler compatibility facade.
+
+Parity with /root/reference/python/paddle/fluid/transpiler/
+distribute_transpiler.py (DistributeTranspiler :256, transpile :545,
+get_trainer_program, get_pserver_program, get_startup_program) and
+geo_sgd_transpiler.py.
+
+TPU-native mapping: the reference rewrites the Program — splitting dense
+params into blocks across pservers and inserting send/recv ops. Here the
+data plane is the ps package (TCP sparse KV service, ps/service.py), so
+"transpiling" produces role plans instead of rewritten op graphs:
+
+- trainer side: the program is returned unchanged — sparse lookups go
+  through ps.SparseEmbedding / PSClient pull-push, dense gradients ride
+  XLA collectives (which beat PS round-trips for dense state on ICI);
+- pserver side: get_pserver_program returns a PServerPlan whose tables
+  are derived from the program's lookup_table_v2 ops, and
+  get_startup_program/run() boots a PSServer on the endpoint.
+
+The reference's sync/async/half-async modes map to the communicator
+choices (ps/communicator.py Async/Geo).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class DistributeTranspilerConfig:
+    """Knobs kept for API parity (reference distribute_transpiler.py:161).
+    slice_var_up/min_block_size concern dense-param splitting, which the
+    TPU build does not do (dense state stays on trainers)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.enable_dc_asgd = False
+        self.mode = "pserver"
+        self.sync_mode = True
+        self.runtime_split_send_recv = False
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+        self.wait_port = True
+
+
+class PServerPlan:
+    """What get_pserver_program returns: enough to boot the KV service
+    (the reference returns a Program whose ops are listen_and_serv +
+    per-param optimize blocks)."""
+
+    def __init__(self, endpoint: str, tables: Dict[int, tuple],
+                 num_trainers: int):
+        self.endpoint = endpoint
+        self.tables = tables          # table_id -> (rows_hint, dim)
+        self.num_trainers = num_trainers
+        self._server = None
+
+    def run(self, block: bool = False):
+        """Start the PSServer for this plan (listen_and_serv main loop)."""
+        from ..ps.service import PSServer
+        from ..ps.table import SparseTable
+
+        host, port = self.endpoint.rsplit(":", 1)
+        tables = {tid: SparseTable(dim=dim)
+                  for tid, (_rows, dim) in self.tables.items()}
+        self._server = PSServer(tables, host=host, port=int(port),
+                                num_trainers=self.num_trainers).start()
+        if block:
+            self._server.join()
+        return self._server
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop()
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program = None
+        self._trainer_id = 0
+        self._trainers = 1
+        self._endpoints: List[str] = []
+        self._sync_mode = True
+        self._tables: Dict[int, tuple] = {}
+
+    def transpile(self, trainer_id: int, program=None, pservers: str = "",
+                  trainers: int = 1, sync_mode: bool = True,
+                  startup_program=None, current_endpoint: str = ""):
+        """Record the cluster layout and derive the sparse tables from
+        the program's lookup_table_v2 ops (reference transpile :545 —
+        which instead splits params and injects send/recv ops)."""
+        from ..static.ir import Program
+
+        if program is None:
+            from ..static.ir import default_main_program
+
+            program = default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError(f"program must be a static Program, got "
+                            f"{type(program)!r}")
+        self._program = program
+        self._trainer_id = int(trainer_id)
+        self._trainers = int(trainers)
+        self._endpoints = [e.strip() for e in pservers.split(",")
+                           if e.strip()]
+        if not self._endpoints:
+            raise ValueError("pservers must list at least one endpoint")
+        self._sync_mode = sync_mode
+        self._tables = self._collect_tables(program)
+        return self
+
+    @staticmethod
+    def _collect_tables(program) -> Dict[int, tuple]:
+        tables = {}
+        tid = 0
+        for op in program.global_block.ops:
+            if op.type != "lookup_table_v2":
+                continue
+            w = op.inputs.get("W", [None])[0]
+            desc = program.global_block.vars.get(w)
+            if desc is not None and len(desc.shape) == 2:
+                tables[tid] = (int(desc.shape[0]), int(desc.shape[1]))
+                tid += 1
+        return tables
+
+    # -- role programs -----------------------------------------------------
+    def get_trainer_program(self, wait_port: bool = True):
+        """Unchanged program: trainer-side pull/push happens in the ps
+        layer, not via injected send/recv ops."""
+        if self._program is None:
+            raise RuntimeError("call transpile() first")
+        return self._program
+
+    def get_pserver_program(self, endpoint: str) -> PServerPlan:
+        if self._program is None:
+            raise RuntimeError("call transpile() first")
+        if endpoint not in self._endpoints:
+            raise ValueError(f"{endpoint} not in pserver list "
+                             f"{self._endpoints}")
+        return PServerPlan(endpoint, self._tables, self._trainers)
+
+    def get_pserver_programs(self, endpoint: str):
+        plan = self.get_pserver_program(endpoint)
+        return plan, plan  # (main, startup) pair in the reference
+
+    def get_startup_program(self, endpoint: str, pserver_program=None):
+        return pserver_program or self.get_pserver_program(endpoint)
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    """GEO-SGD flavor (reference geo_sgd_transpiler.py): trainers train
+    locally and push parameter deltas every k steps; maps to
+    ps.GeoCommunicator."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        super().__init__(config)
+        self.config.geo_sgd_mode = True
+        self.config.sync_mode = False
+
+    def make_communicator(self, table_id: int, dim: int, push_nums=None):
+        from ..ps.communicator import GeoCommunicator
+        from ..ps.service import PSClient
+        from ..ps.table import SparseTable
+
+        client = PSClient(self._endpoints)
+        return GeoCommunicator(
+            client, SparseTable(dim=dim), table_id=table_id,
+            k_steps=push_nums or self.config.geo_sgd_need_push_nums)

@@ -189,9 +189,10 @@ class GPTForCausalLM(Layer):
         import numpy as np
 
         logits = np.asarray(last_logits.numpy(), np.float32)
-        if not do_sample:
+        if not do_sample or temperature is not None and temperature <= 1e-6:
+            # temperature ~ 0 conventionally means deterministic decode
             return logits.argmax(-1)
-        if temperature and temperature != 1.0:
+        if temperature != 1.0:
             logits = logits / float(temperature)
         if top_k:
             k = min(int(top_k), logits.shape[-1])

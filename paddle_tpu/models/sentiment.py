@@ -39,7 +39,9 @@ class SentimentLSTM(Layer):
         if lengths is None:
             lengths = ops.sum((ids != self.pad_id).astype("int64"), axis=1)
         emb = self.embedding(ids)
-        seq, _ = self.lstm(emb)
+        # lengths make the backward LSTM start at position len-1 instead
+        # of reading pad embeddings (and zero outputs past len)
+        seq, _ = self.lstm(emb, sequence_length=lengths)
         # masked max-pool over time (sequence_pool 'max' semantics)
         pooled = ops.sequence_pool(seq, lengths, pool_type="max")
         h = self.dropout(self.norm(pooled))

@@ -190,6 +190,23 @@ class RNN(Layer):
         return outs, states
 
 
+def _valid_mask(seq_len, T, reverse):
+    """(T, b) bool mask of valid steps in scan order, or None.
+
+    Forward: step t valid while t < len. Reverse (inputs pre-flipped):
+    the valid region sits at the tail of the flipped sequence, so the
+    carry stays frozen at the initial state until t >= T - len — the
+    backward pass then starts exactly at original position len-1 instead
+    of consuming pad embeddings (reference rnn.py mask_fn semantics)."""
+    if seq_len is None:
+        return None
+    lens = jnp.asarray(seq_len)
+    t = jnp.arange(T)[:, None]
+    if reverse:
+        return t >= (T - lens)[None, :]
+    return t < lens[None, :]
+
+
 def _rnn_scan_layer(cell, inputs, initial_states, sequence_length, is_reverse,
                     time_major):
     """Run the cell over time with one traced scan (weights read from cell)."""
@@ -213,18 +230,28 @@ def _rnn_scan_layer(cell, inputs, initial_states, sequence_length, is_reverse,
     if is_lstm:
         h0, c0 = initial_states
 
-        @_prim("lstm_scan")
+        @_prim("lstm_scan", nondiff=("seq_len",))
         def run(x, h0, c0, w_ih, w_hh, b_ih, b_hh, time_major, reverse, seq_len):
             xs = x if time_major else jnp.swapaxes(x, 0, 1)
             if reverse:
                 xs = jnp.flip(xs, 0)
+            T = xs.shape[0]
+            valid = _valid_mask(seq_len, T, reverse)  # (T, b) or None
 
-            def step(carry, xt):
+            def step(carry, inp):
                 h, c = carry
+                xt, m = inp
                 h2, c2 = _lstm_cell.raw_fn(xt, h, c, w_ih, w_hh, b_ih, b_hh)
-                return (h2, c2), h2
+                if m is not None:
+                    mk = m[:, None]
+                    h2 = jnp.where(mk, h2, h)
+                    c2 = jnp.where(mk, c2, c)
+                    y = jnp.where(mk, h2, 0)
+                else:
+                    y = h2
+                return (h2, c2), y
 
-            (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs)
+            (hT, cT), ys = jax.lax.scan(step, (h0, c0), (xs, valid))
             if reverse:
                 ys = jnp.flip(ys, 0)
             if not time_major:
@@ -239,20 +266,30 @@ def _rnn_scan_layer(cell, inputs, initial_states, sequence_length, is_reverse,
     cell_fn = _gru_cell.raw_fn if isinstance(cell, GRUCell) else None
     act = getattr(cell, "activation", "tanh")
 
-    @_prim("rnn_scan")
-    def run(x, h0, w_ih, w_hh, b_ih, b_hh, time_major, reverse, is_gru, act):
+    @_prim("rnn_scan", nondiff=("seq_len",))
+    def run(x, h0, w_ih, w_hh, b_ih, b_hh, time_major, reverse, is_gru, act,
+            seq_len):
         xs = x if time_major else jnp.swapaxes(x, 0, 1)
         if reverse:
             xs = jnp.flip(xs, 0)
+        T = xs.shape[0]
+        valid = _valid_mask(seq_len, T, reverse)
 
-        def step(h, xt):
+        def step(h, inp):
+            xt, m = inp
             if is_gru:
                 h2 = _gru_cell.raw_fn(xt, h, w_ih, w_hh, b_ih, b_hh)
             else:
                 h2 = _simple_rnn_cell.raw_fn(xt, h, w_ih, w_hh, b_ih, b_hh, act)
-            return h2, h2
+            if m is not None:
+                mk = m[:, None]
+                h2 = jnp.where(mk, h2, h)
+                y = jnp.where(mk, h2, 0)
+            else:
+                y = h2
+            return h2, y
 
-        hT, ys = jax.lax.scan(step, h0, xs)
+        hT, ys = jax.lax.scan(step, h0, (xs, valid))
         if reverse:
             ys = jnp.flip(ys, 0)
         if not time_major:
@@ -260,7 +297,8 @@ def _rnn_scan_layer(cell, inputs, initial_states, sequence_length, is_reverse,
         return ys, hT
 
     ys, hT = run(x, h0, *w, time_major=time_major, reverse=is_reverse,
-                 is_gru=isinstance(cell, GRUCell), act=act)
+                 is_gru=isinstance(cell, GRUCell), act=act,
+                 seq_len=sequence_length)
     return ys, hT
 
 

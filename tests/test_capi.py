@@ -71,14 +71,15 @@ def test_capi_in_process(saved_model):
 C_CLIENT = r"""
 #include <stdio.h>
 #include <stdlib.h>
+#include <thread>
 #include "paddle_tpu_capi.h"
 
-int main(int argc, char** argv) {
-  if (PD_Init(argv[2]) != 0) {
-    fprintf(stderr, "init: %s\n", PD_GetLastError());
-    return 2;
-  }
-  PD_Predictor* p = PD_NewPredictor(argv[1]);
+// The predictor work runs on a WORKER thread after PD_Init on main —
+// this is the real gate for the embedded-init GIL release: if
+// ensure_helper leaves the main thread holding the GIL, the worker
+// deadlocks in PyGILState_Ensure and the harness timeout kills us.
+static int worker(const char* prefix) {
+  PD_Predictor* p = PD_NewPredictor(prefix);
   if (!p) { fprintf(stderr, "new: %s\n", PD_GetLastError()); return 3; }
   float x[8]; int64_t shape[2] = {2, 4};
   for (int i = 0; i < 8; ++i) x[i] = (float)i;
@@ -92,6 +93,17 @@ int main(int argc, char** argv) {
   printf("\n");
   PD_DeletePredictor(p);
   return 0;
+}
+
+int main(int argc, char** argv) {
+  if (PD_Init(argv[2]) != 0) {
+    fprintf(stderr, "init: %s\n", PD_GetLastError());
+    return 2;
+  }
+  int rc = 7;
+  std::thread t([&] { rc = worker(argv[1]); });
+  t.join();
+  return rc;
 }
 """
 

@@ -251,3 +251,52 @@ def test_client_background_heartbeat():
         client.stop_servers()
         client.close()
         srv.stop()
+
+
+def test_distribute_transpiler_roles(tmp_path):
+    """DistributeTranspiler facade (reference distribute_transpiler.py:256):
+    transpile a program with an embedding, boot the pserver plan, pull
+    from a trainer-side client."""
+    import paddle_tpu.static as static
+    from paddle_tpu.distributed import (DistributeTranspiler,
+                                        DistributeTranspilerConfig)
+    from paddle_tpu.ps.service import PSClient
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = static.data("ids", [-1], dtype="int64")
+        emb = static.embedding(ids, size=[100, 8])
+        static.mean(emb)
+
+    t = DistributeTranspiler(DistributeTranspilerConfig())
+    t.transpile(trainer_id=0, program=main,
+                pservers="127.0.0.1:0", trainers=1)
+    assert t.get_trainer_program() is main
+    plan = t.get_pserver_program("127.0.0.1:0")
+    assert plan.tables == {0: (100, 8)}
+    srv = plan.run()
+    client = None
+    try:
+        client = PSClient([srv.endpoint])
+        vals = client.pull(0, np.array([1, 2, 3], np.int64), dim=8)
+        assert vals.shape == (3, 8)
+    finally:
+        if client is not None:
+            client.stop_servers()
+            client.close()
+        plan.stop()
+
+
+def test_transpiler_validates_inputs():
+    from paddle_tpu.distributed import DistributeTranspiler
+
+    t = DistributeTranspiler()
+    with pytest.raises(RuntimeError):
+        t.get_trainer_program()
+    import paddle_tpu.static as static
+    main = static.Program()
+    with pytest.raises(ValueError):
+        t.transpile(0, program=main, pservers="", trainers=1)
+    t.transpile(0, program=main, pservers="127.0.0.1:7164", trainers=2)
+    with pytest.raises(ValueError):
+        t.get_pserver_program("127.0.0.1:9999")

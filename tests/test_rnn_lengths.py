@@ -1,0 +1,94 @@
+"""RNN sequence_length semantics (reference rnn.py mask_fn / LoD-aware
+dynamic_rnn): outputs past a sequence's length are zero, the carry
+freezes at the last valid step, and the backward direction of a biLSTM
+starts at position len-1 — so logits are invariant to trailing padding."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _run(layer, x, lens):
+    out, (h, c) = layer(paddle.to_tensor(x),
+                        sequence_length=paddle.to_tensor(lens))
+    return out.numpy(), h.numpy(), c.numpy()
+
+
+def test_lstm_padding_invariance_bidirectional():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8, direction="bidirectional")
+    lstm.eval()
+    rng = np.random.RandomState(0)
+    base = rng.randn(2, 5, 4).astype("float32")
+    lens = np.array([5, 3], np.int64)
+
+    pad8 = np.zeros((2, 8, 4), np.float32)
+    pad8[:, :5] = base
+    out5, h5, c5 = _run(lstm, base, lens)
+    out8, h8, c8 = _run(lstm, pad8, lens)
+
+    # valid region identical regardless of padding amount
+    np.testing.assert_allclose(out8[0, :5], out5[0, :5], rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(out8[1, :3], out5[1, :3], rtol=1e-5,
+                               atol=1e-6)
+    # outputs past length are zeros
+    assert np.all(out8[1, 3:] == 0) and np.all(out8[0, 5:] == 0)
+    # final states identical
+    np.testing.assert_allclose(h8, h5, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(c8, c5, rtol=1e-5, atol=1e-6)
+
+
+def test_forward_lstm_final_state_at_length():
+    paddle.seed(0)
+    lstm = nn.LSTM(3, 6)
+    lstm.eval()
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 7, 3).astype("float32")
+    # run full 4 steps on the truncated sequence vs lengths=4 on padded
+    out_trunc, (h_t, _) = lstm(paddle.to_tensor(x[:, :4]))
+    out_len, h_l, _ = _run(lstm, x, np.array([4], np.int64))
+    np.testing.assert_allclose(h_l, h_t.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out_len[:, :4], out_trunc.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gru_and_simple_rnn_lengths():
+    paddle.seed(0)
+    for cls in (nn.GRU, nn.SimpleRNN):
+        layer = cls(3, 5, direction="bidirectional")
+        layer.eval()
+        rng = np.random.RandomState(2)
+        base = rng.randn(2, 4, 3).astype("float32")
+        lens = np.array([4, 2], np.int64)
+        pad = np.zeros((2, 6, 3), np.float32)
+        pad[:, :4] = base
+        out4, h4, *_ = _run_any(layer, base, lens)
+        out6, h6, *_ = _run_any(layer, pad, lens)
+        np.testing.assert_allclose(out6[1, :2], out4[1, :2], rtol=1e-5,
+                                   atol=1e-6)
+        assert np.all(out6[1, 2:] == 0)
+        np.testing.assert_allclose(h6, h4, rtol=1e-5, atol=1e-6)
+
+
+def _run_any(layer, x, lens):
+    out, st = layer(paddle.to_tensor(x),
+                    sequence_length=paddle.to_tensor(lens))
+    if isinstance(st, tuple):
+        return (out.numpy(),) + tuple(s.numpy() for s in st)
+    return out.numpy(), st.numpy()
+
+
+def test_sentiment_logits_padding_invariant():
+    from paddle_tpu.models.sentiment import SentimentLSTM
+
+    paddle.seed(0)
+    model = SentimentLSTM(vocab_size=30, embed_dim=8, hidden_dim=8,
+                          dropout=0.0)
+    model.eval()
+    ids5 = np.array([[3, 9, 4, 7, 1]], np.int64)
+    ids12 = np.zeros((1, 12), np.int64)
+    ids12[0, :5] = ids5
+    l5 = model(paddle.to_tensor(ids5)).numpy()
+    l12 = model(paddle.to_tensor(ids12)).numpy()
+    np.testing.assert_allclose(l12, l5, rtol=1e-5, atol=1e-6)
