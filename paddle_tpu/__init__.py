@@ -27,6 +27,7 @@ from . import ops  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import distribution  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import amp  # noqa: F401

@@ -1,0 +1,170 @@
+"""Probability distributions.
+
+Parity with /root/reference/python/paddle/fluid/layers/distributions.py
+(Uniform :34, Normal :154, Categorical :269, MultivariateNormalDiag :374):
+sample / log_prob / entropy / kl_divergence, built on jax.random so
+sampling works inside jit with explicit keys (rng_scope) and eagerly via
+the global generator.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import random as random_mod
+from .framework.random import next_rng_key
+from .framework.tensor import Tensor, unwrap
+
+
+def _arr(x, dtype=jnp.float32):
+    return jnp.asarray(unwrap(x), dtype)
+
+
+def _key(seed=0):
+    return random_mod.make_key(seed) if seed else next_rng_key()
+
+
+class Distribution:
+    def sample(self, shape=(), seed=0):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(unwrap(self.log_prob(value))))
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference distributions.py:34)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(_key(seed), shape)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError("KL not defined for Uniform in reference")
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) (reference distributions.py:154)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        z = jax.random.normal(_key(seed), shape)
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = jnp.square(self.scale)
+        return Tensor(-jnp.square(v - self.loc) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale))
+
+    def kl_divergence(self, other: "Normal"):
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference
+    distributions.py:269)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def _log_pmf(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        return Tensor(jax.random.categorical(
+            _key(seed), self.logits, shape=tuple(shape)
+            + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = jnp.asarray(unwrap(value), jnp.int32)
+        lp = self._log_pmf()
+        return Tensor(jnp.take_along_axis(lp, v[..., None],
+                                          axis=-1)[..., 0])
+
+    def entropy(self):
+        lp = self._log_pmf()
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=-1))
+
+    def kl_divergence(self, other: "Categorical"):
+        lp = self._log_pmf()
+        lq = other._log_pmf()
+        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance MVN (reference distributions.py:374)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        scale = _arr(scale)
+        # reference passes a diagonal matrix; accept vector or matrix
+        self.scale_diag = jnp.diagonal(scale, axis1=-2, axis2=-1) \
+            if scale.ndim >= 2 else scale
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.loc.shape
+        z = jax.random.normal(_key(seed), shape)
+        return Tensor(self.loc + z * self.scale_diag)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        k = self.loc.shape[-1]
+        quad = jnp.sum(jnp.square((v - self.loc) / self.scale_diag),
+                       axis=-1)
+        logdet = jnp.sum(jnp.log(self.scale_diag), axis=-1)
+        return Tensor(-0.5 * (quad + k * math.log(2 * math.pi))
+                      - logdet)
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        return Tensor(0.5 * k * (1 + math.log(2 * math.pi))
+                      + jnp.sum(jnp.log(self.scale_diag), axis=-1))
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        var_ratio = jnp.square(self.scale_diag / other.scale_diag)
+        t1 = jnp.square((self.loc - other.loc) / other.scale_diag)
+        return Tensor(0.5 * jnp.sum(
+            var_ratio + t1 - 1 - jnp.log(var_ratio), axis=-1))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return p.kl_divergence(q)

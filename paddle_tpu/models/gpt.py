@@ -192,7 +192,7 @@ class GPTForCausalLM(Layer):
         if not do_sample or temperature is not None and temperature <= 1e-6:
             # temperature ~ 0 conventionally means deterministic decode
             return logits.argmax(-1)
-        if temperature != 1.0:
+        if temperature is not None and temperature != 1.0:
             logits = logits / float(temperature)
         if top_k:
             k = min(int(top_k), logits.shape[-1])

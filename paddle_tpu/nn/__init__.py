@@ -41,3 +41,4 @@ from .rnn import (  # noqa: F401
 )
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
 from .moe import MoELayer, moe_apply_ep, MOE_EP_RULES  # noqa: F401
+from .crf import LinearChainCRF, crf_decoding, linear_chain_crf  # noqa: F401,E402

@@ -1,0 +1,126 @@
+"""Linear-chain CRF.
+
+Parity with /root/reference/paddle/fluid/operators/linear_chain_crf_op.cc
+and crf_decoding_op.cc (fluid.layers.linear_chain_crf / crf_decoding),
+used for sequence labeling (the label_semantic_roles book test).
+
+Transition layout matches the reference: (num_tags + 2, num_tags) —
+row 0 start weights, row 1 stop weights, rows 2: pairwise[from, to].
+TPU-native shape: dense (B, L, T) emissions + lengths, recursions as
+lax.scan in log space (one compiled kernel; the reference loops per
+sequence on CPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.op import primitive
+from ..framework.tensor import Tensor, unwrap
+from .layer import Layer
+
+
+@primitive("linear_chain_crf", nondiff=("label", "lengths"))
+def linear_chain_crf(emission, transition, label, lengths, name=None):
+    """Per-sequence log-likelihood log p(label | emission).
+
+    emission: (B, L, T) unary scores; transition: (T+2, T);
+    label: (B, L) int; lengths: (B,). Returns (B, 1) log-likelihoods
+    (negative numbers; the training loss is their negated sum).
+    """
+    start, stop, pair = transition[0], transition[1], transition[2:]
+    B, L, T = emission.shape
+    lens = jnp.asarray(lengths)
+    label = jnp.asarray(label)
+
+    # -- partition function: forward algorithm over time ------------------
+    alpha0 = start[None, :] + emission[:, 0, :]            # (B, T)
+
+    def fwd(alpha, t):
+        e_t = emission[:, t, :]
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + pair[None, :, :], axis=1) + e_t
+        keep = (t < lens)[:, None]
+        return jnp.where(keep, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, L)) \
+        if L > 1 else (alpha0, None)
+    log_z = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+
+    # -- gold path score ----------------------------------------------------
+    pos = jnp.arange(L)
+    unary = jnp.take_along_axis(emission, label[:, :, None],
+                                axis=2)[..., 0]            # (B, L)
+    unary = jnp.where(pos[None, :] < lens[:, None], unary, 0.0)
+    trans_score = pair[label[:, :-1], label[:, 1:]] if L > 1 else \
+        jnp.zeros((B, 0))
+    trans_score = jnp.where(pos[None, 1:] < lens[:, None],
+                            trans_score, 0.0)
+    last = jnp.clip(lens - 1, 0, L - 1)
+    last_tag = jnp.take_along_axis(label, last[:, None], axis=1)[:, 0]
+    score = (unary.sum(1) + trans_score.sum(1)
+             + start[label[:, 0]] + stop[last_tag])
+    return (score - log_z)[:, None]
+
+
+def crf_decoding(emission, transition, lengths, label=None, name=None):
+    """Viterbi decode (crf_decoding_op.cc). Returns the best tag path
+    (B, L) int64 — or, when `label` is given, a (B, L) 0/1 mask marking
+    positions where the argmax path agrees with the label (the
+    reference's evaluation mode)."""
+    em = jnp.asarray(unwrap(emission), jnp.float32)
+    tr = jnp.asarray(unwrap(transition), jnp.float32)
+    lens = jnp.asarray(unwrap(lengths))
+    start, stop, pair = tr[0], tr[1], tr[2:]
+    B, L, T = em.shape
+
+    delta0 = start[None, :] + em[:, 0, :]
+
+    def step(delta, t):
+        cand = delta[:, :, None] + pair[None, :, :]        # (B, from, to)
+        best = jnp.max(cand, axis=1) + em[:, t, :]
+        arg = jnp.argmax(cand, axis=1)                     # (B, T)
+        keep = (t < lens)[:, None]
+        return jnp.where(keep, best, delta), arg
+
+    if L > 1:
+        delta, args = jax.lax.scan(step, delta0, jnp.arange(1, L))
+    else:
+        delta, args = delta0, jnp.zeros((0, B, T), jnp.int32)
+
+    final = delta + stop[None, :]
+    last_tag = jnp.argmax(final, axis=1)                   # (B,)
+
+    path = [last_tag]
+    tag = last_tag
+    for t in range(L - 1, 0, -1):
+        prev = jnp.take_along_axis(args[t - 1], tag[:, None], axis=1)[:, 0]
+        tag = jnp.where(t < lens, prev, tag)
+        path.append(tag)
+    path = jnp.stack(path[::-1], axis=1)                   # (B, L)
+    # positions past length: pad with 0
+    pos = jnp.arange(L)[None, :]
+    path = jnp.where(pos < lens[:, None], path, 0)
+    if label is not None:
+        gold = jnp.asarray(unwrap(label))
+        return Tensor((path == gold).astype(jnp.int64)
+                      * (pos < lens[:, None]))
+    return Tensor(path.astype(jnp.int64))
+
+
+class LinearChainCRF(Layer):
+    """CRF layer owning the transition parameters (fluid exposes this via
+    param_attr on the linear_chain_crf layer)."""
+
+    def __init__(self, num_tags: int, param_attr=None, name=None):
+        super().__init__()
+        self.num_tags = num_tags
+        self.transition = self.create_parameter(
+            [num_tags + 2, num_tags], attr=param_attr)
+
+    def forward(self, emission, label, lengths):
+        ll = linear_chain_crf(emission, self.transition, label, lengths)
+        return -ll.mean()
+
+    def decode(self, emission, lengths):
+        return crf_decoding(emission, self.transition, lengths)

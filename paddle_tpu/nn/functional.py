@@ -1181,3 +1181,199 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
     c = idx + max(0, offset)
     out = out.at[..., r, c].set(input)
     return out
+
+
+# -- fluid.layers long-tail losses/activations ------------------------------
+@primitive("brelu")
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    """Bounded relu (activation_op.cc BRelu)."""
+    return jnp.clip(x, t_min, t_max)
+
+
+@primitive("soft_relu")
+def soft_relu(x, threshold=40.0, name=None):
+    """log(1+exp(clip(x))) (activation_op.cc SoftRelu)."""
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+@primitive("dice_loss")
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice coefficient loss for segmentation (layers/nn.py dice_loss):
+    input (N, ..., C) probabilities, label (N, ..., 1) int."""
+    label_oh = jax.nn.one_hot(jnp.squeeze(label, -1), input.shape[-1],
+                              dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * label_oh, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + \
+        jnp.sum(label_oh, axis=reduce_dims)
+    dice = (2 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1 - dice)
+
+
+@primitive("bpr_loss", nondiff=("label",))
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking loss (bpr_loss_op.cc): input
+    (N, C) raw scores, label (N, 1) the positive class."""
+    label = jnp.reshape(label, (-1,))
+    pos = jnp.take_along_axis(input, label[:, None], axis=1)
+    # -mean over negatives of log sigmoid(pos - neg)
+    diff = pos - input
+    logsig = jax.nn.log_sigmoid(diff)
+    n = input.shape[1]
+    mask = jax.nn.one_hot(label, n, dtype=input.dtype)
+    return jnp.mean(-jnp.sum(logsig * (1 - mask), axis=1) / (n - 1))
+
+
+@primitive("rank_loss")
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (rank_loss_op.cc)."""
+    diff = left - right
+    return jnp.mean(-label * diff + jnp.log1p(jnp.exp(diff)))
+
+
+@primitive("margin_rank_loss")
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """max(0, -label*(left-right)+margin) (margin_rank_loss_op.cc)."""
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+@primitive("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    """Distillation CTR loss (teacher_student_sigmoid_loss_op.cc):
+    label in [0,1] teacher or {0,1} click."""
+    x = jnp.clip(input, soft_max_lower_bound, soft_max_up_bound)
+    return jnp.mean(x - x * label + jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+
+@primitive("sigmoid_focal_loss", nondiff=("normalizer",))
+def sigmoid_focal_loss_fluid(input, label, fg_num=None, gamma=2.0,
+                             alpha=0.25, normalizer=None, name=None):
+    """RetinaNet focal loss (sigmoid_focal_loss_op.cc), summed form."""
+    p = jax.nn.sigmoid(input)
+    ce = -(label * jnp.log(jnp.maximum(p, 1e-12)) +
+           (1 - label) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+    pt = label * p + (1 - label) * (1 - p)
+    w = (label * alpha + (1 - label) * (1 - alpha)) * (1 - pt) ** gamma
+    loss = w * ce
+    denom = normalizer if normalizer is not None else fg_num
+    if denom is not None:
+        loss = loss / jnp.maximum(jnp.asarray(denom, loss.dtype), 1.0)
+    return loss
+
+
+@primitive("center_loss", nondiff=("label", "update_center", "alpha"))
+def center_loss(input, label, centers, alpha=0.1, update_center=False,
+                name=None):
+    """Distance to per-class centers (center_loss_op.cc). Functional:
+    returns the loss; center updates are the caller's optimizer's job
+    (pass centers as a Parameter and let autograd update it)."""
+    label = jnp.reshape(label, (-1,))
+    c = jnp.take(centers, label, axis=0)
+    return 0.5 * jnp.sum(jnp.square(input - c), axis=1, keepdims=True)
+
+
+@primitive("bilinear_tensor_product")
+def bilinear_tensor_product_fn(x, y, weight, bias=None, name=None):
+    """out[:, i] = x W_i y^T (bilinear_tensor_product_op.cc);
+    weight: (size, dx, dy)."""
+    out = jnp.einsum("bi,oij,bj->bo", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@primitive("affine_channel")
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    """Per-channel scale+bias (affine_channel_op.cc; folded-BN form)."""
+    if data_layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+@primitive("fsp_matrix")
+def fsp_matrix(x, y, name=None):
+    """Flow-of-solution-procedure matrix for distillation
+    (fsp_op.cc): (N,C1,H,W),(N,C2,H,W) -> (N,C1,C2)."""
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    return jnp.einsum("nchw,ndhw->ncd", x, y) / (h * w)
+
+
+@primitive("row_conv")
+def row_conv(input, weight, name=None):
+    """Lookahead row convolution (row_conv_op.cc): input (B, T, D),
+    weight (future_context, D)."""
+    k = weight.shape[0]
+    pads = ((0, 0), (0, k - 1), (0, 0))
+    xp = jnp.pad(input, pads)
+    out = jnp.zeros_like(input)
+    for i in range(k):
+        out = out + xp[:, i:i + input.shape[1], :] * weight[i][None, None, :]
+    return out
+
+
+@primitive("nce", nondiff=("label", "num_neg_samples", "seed"))
+def nce(input, label, weight, bias=None, num_neg_samples=5,
+        sampler="uniform", seed=None, name=None):
+    """Noise-contrastive estimation loss (nce_op.cc): input (B, D),
+    label (B, 1) positive class, weight (num_classes, D). Uniform
+    negative sampling; returns (B, 1) losses."""
+    num_classes = weight.shape[0]
+    b = input.shape[0]
+    from ..framework import random as random_mod
+    from ..framework.random import next_rng_key
+
+    # fresh negatives each step unless the caller pins a seed
+    key = random_mod.make_key(seed) if seed else next_rng_key()
+    neg = jax.random.randint(key, (b, num_neg_samples), 0, num_classes)
+    label = jnp.reshape(label, (-1, 1))
+
+    def score(cls):
+        w = jnp.take(weight, cls, axis=0)          # (B, K, D)
+        s = jnp.einsum("bd,bkd->bk", input, w)
+        if bias is not None:
+            s = s + jnp.take(bias, cls, axis=0)
+        return s
+
+    s_pos = score(label)                           # (B, 1)
+    s_neg = score(neg)                             # (B, K)
+    # log-odds vs uniform noise: q = K/num_classes
+    log_q = jnp.log(jnp.asarray(num_neg_samples / num_classes,
+                                input.dtype))
+    pos_loss = -jax.nn.log_sigmoid(s_pos - log_q)
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-(s_neg - log_q)), axis=1,
+                        keepdims=True)
+    return pos_loss + neg_loss
+
+
+@primitive("sampled_softmax_with_cross_entropy",
+           nondiff=("label", "num_samples", "seed"))
+def sampled_softmax_with_cross_entropy(logits_weight, input, label,
+                                       num_samples, seed=None, name=None):
+    """Sampled-softmax CE (sample_logits_op.cc + layers
+    sampled_softmax_with_cross_entropy): full softmax over
+    [true class, num_samples uniform negatives] only. logits_weight
+    (num_classes, D), input (B, D), label (B, 1)."""
+    from ..framework import random as random_mod
+
+    num_classes = logits_weight.shape[0]
+    b = input.shape[0]
+    from ..framework.random import next_rng_key
+
+    key = random_mod.make_key(seed) if seed else next_rng_key()
+    neg = jax.random.randint(key, (b, num_samples), 0, num_classes)
+    label = jnp.reshape(label, (-1, 1))
+    cls = jnp.concatenate([label, neg], axis=1)    # (B, 1+S)
+    w = jnp.take(logits_weight, cls, axis=0)       # (B, 1+S, D)
+    logits = jnp.einsum("bd,bkd->bk", input, w)
+    # subtract expected sampling correction log q (uniform)
+    logq = jnp.log(jnp.asarray(num_samples / num_classes, logits.dtype))
+    logits = logits - logq
+    # mask accidental hits of the true class among negatives
+    hit = cls[:, 1:] == label
+    logits = logits.at[:, 1:].set(
+        jnp.where(hit, -1e9, logits[:, 1:]))
+    return -jax.nn.log_softmax(logits, axis=1)[:, :1]

@@ -8,6 +8,7 @@ from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .beam_search import beam_search_decode, beam_search_step  # noqa: F401
 from .sequence import *  # noqa: F401,F403
+from .debug import Assert, Print, py_func  # noqa: F401
 
 from . import (creation, math, manipulation, logic, linalg,  # noqa: F401
-               search, sequence, beam_search)
+               search, sequence, beam_search, debug)

@@ -1,0 +1,106 @@
+"""Debug / host-callback ops.
+
+Parity with the reference Print (layers/control_flow.py Print,
+operators/print_op.cc), Assert (operators/assert_op.cc), and py_func
+(layers/nn.py py_func, operators/py_func_op.cc).
+
+TPU-native design: under jit these lower to XLA host callbacks
+(jax.debug.print / jax.pure_callback), so they work inside compiled
+training steps — the reference runs them as interpreter ops, which is
+free for it but impossible inside a fused XLA program without callbacks.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, unwrap
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both", name=None):
+    """Print a tensor's value when it is computed; returns the input
+    unchanged so it can be chained into the graph."""
+    arr = unwrap(input)
+    if message:
+        jax.debug.print("{m} {x}", m=message, x=arr)
+    else:
+        jax.debug.print("{x}", x=arr)
+    return input
+
+
+def Assert(cond, data: Optional[Sequence] = None, summarize=20, name=None):
+    """Abort if cond is False (assert_op.cc). Eager: python raise.
+    Traced: host callback that raises when the value arrives."""
+    arr = unwrap(cond)
+
+    def _check(c, *vals):
+        if not bool(np.all(c)):
+            parts = ", ".join(str(np.asarray(v)[:summarize]) for v in vals)
+            raise AssertionError(
+                f"paddle_tpu.Assert failed{(': ' + parts) if parts else ''}")
+
+    vals = tuple(unwrap(d) for d in (data or ()))
+    if isinstance(arr, jax.core.Tracer):
+        jax.debug.callback(_check, arr, *vals)
+    else:
+        _check(arr, *vals)
+    return cond
+
+
+def py_func(func: Callable, x, out, backward_func: Optional[Callable] = None,
+            skip_vars_in_backward_input=None, name=None):
+    """Run a host python function as an op (py_func_op.cc).
+
+    x: input Tensor or list of Tensors. out: template Tensor(s) (or
+    jax.ShapeDtypeStruct) giving the output shape/dtype. backward_func,
+    if given, computes input grads on host: backward_func(*inputs,
+    *output_grads) -> input grad(s).
+    """
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrs = [unwrap(v) for v in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(unwrap(o).shape),
+                                   unwrap(o).dtype)
+              if not isinstance(o, jax.ShapeDtypeStruct) else o
+              for o in outs]
+    single = not isinstance(out, (list, tuple))
+
+    def host_fwd(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                     for r, s in zip(res, shapes))
+
+    if backward_func is None:
+        res = jax.pure_callback(host_fwd, tuple(shapes), *arrs)
+    else:
+        @jax.custom_vjp
+        def call(*vals):
+            return jax.pure_callback(host_fwd, tuple(shapes), *vals)
+
+        def fwd(*vals):
+            return call(*vals), vals
+
+        def bwd(vals, gs):
+            in_shapes = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                              for v in vals)
+
+            def host_bwd(*args):
+                n = len(vals)
+                res = backward_func(*[np.asarray(a) for a in args])
+                res = res if isinstance(res, (list, tuple)) else [res]
+                return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                             for r, s in zip(res, in_shapes))
+
+            return jax.pure_callback(host_bwd, in_shapes, *vals, *gs)
+
+        call.defvjp(fwd, bwd)
+        res = call(*arrs)
+    res = tuple(Tensor(r) for r in res)
+    return res[0] if single else list(res)

@@ -531,3 +531,77 @@ def view(x, shape_or_dtype, name=None):
 
 def view_as(x, other, name=None):
     return reshape(x, tuple(unwrap(other).shape))
+
+
+# -- fluid.layers long-tail parity ------------------------------------------
+@primitive("reverse", nondiff=("axis",))
+def reverse(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def shape(x, name=None):
+    """Shape as an int32 tensor (layers/nn.py shape)."""
+    return Tensor(jnp.asarray(unwrap(x).shape, jnp.int32))
+
+
+def size(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).size, jnp.int64))
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).ndim, jnp.int32))
+
+
+@primitive("space_to_depth", nondiff=("blocksize",))
+def space_to_depth(x, blocksize, name=None):
+    """(N,C,H,W) -> (N,C*bs^2,H/bs,W/bs) (space_to_depth_op.cc)."""
+    n, c, h, w = x.shape
+    bs = int(blocksize)
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@primitive("shuffle_channel", nondiff=("group",))
+def shuffle_channel(x, group, name=None):
+    """ShuffleNet channel shuffle (shuffle_channel_op.cc)."""
+    n, c, h, w = x.shape
+    g = int(group)
+    return jnp.transpose(x.reshape(n, g, c // g, h, w),
+                         (0, 2, 1, 3, 4)).reshape(n, c, h, w)
+
+
+@primitive("pad_constant_like")
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with pad_value (pad_constant_like_op.cc)."""
+    pads = [(0, int(sx) - int(sy)) for sx, sy in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Crop a window (crop_tensor_op.cc); same kernel as crop()."""
+    return crop(x, shape if shape is not None else unwrap(x).shape,
+                offsets)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    """shape[output_dim_idx] copies input's batch dim
+    (fill_constant_batch_size_like_op.cc)."""
+    from ..framework import dtype as dtype_mod
+
+    shape = list(shape)
+    shape[output_dim_idx] = unwrap(input).shape[input_dim_idx]
+    return Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                           dtype_mod.convert_dtype(dtype)))
+
+
+def unique_with_counts(x, dtype=np.int64, name=None):
+    """(out, index, count) triple (unique_with_counts_op.cc)."""
+    arr = np.asarray(unwrap(x)).ravel()
+    out, inv, cnt = np.unique(arr, return_inverse=True, return_counts=True)
+    return (Tensor(out), Tensor(inv.astype(dtype)),
+            Tensor(cnt.astype(dtype)))

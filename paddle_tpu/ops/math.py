@@ -385,3 +385,77 @@ def polygamma(x, n, name=None):
 def trapezoid(y, x=None, dx=None, axis=-1, name=None):
     return jnp.trapezoid(jnp.asarray(y), x=x,
                          dx=1.0 if dx is None else dx, axis=axis)
+
+
+# -- fluid.layers long-tail parity (layers/nn.py, layers/tensor.py) ---------
+@primitive("multiplex", nondiff=("index",))
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (layers/nn.py multiplex):
+    out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(list(inputs), axis=0)     # (n, batch, ...)
+    idx = jnp.reshape(jnp.asarray(index), (-1,))
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def has_inf(x, name=None):
+    from ..framework.tensor import Tensor as _T
+
+    return _T(jnp.isinf(jnp.asarray(
+        x.value if hasattr(x, "value") else x)).any())
+
+
+def has_nan(x, name=None):
+    from ..framework.tensor import Tensor as _T
+
+    return _T(jnp.isnan(jnp.asarray(
+        x.value if hasattr(x, "value") else x)).any())
+
+
+@primitive("clip_by_norm")
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x so ||x||_2 <= max_norm (clip_by_norm_op.cc)."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return x * (jnp.asarray(max_norm, x.dtype)
+                / jnp.maximum(norm, max_norm))
+
+
+@primitive("cos_sim")
+def cos_sim(X, Y, name=None):
+    """Row-wise cosine similarity (cos_sim_op.cc)."""
+    xn = jnp.sqrt(jnp.sum(jnp.square(X), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(Y), axis=-1, keepdims=True))
+    dot = jnp.sum(X * Y, axis=-1, keepdims=True)
+    return dot / jnp.maximum(xn * yn, 1e-12)
+
+
+@primitive("hash_op", nondiff=("num_hash", "mod_by"))
+def hash_(x, num_hash=1, mod_by=2**31 - 1, name=None):
+    """Integer feature hashing into [0, mod_by) with num_hash seeds
+    (hash_op.cc, xxHash in the reference; a multiplicative mixer here —
+    any deterministic uniform mixer serves the embedding-bucket use)."""
+    x = jnp.asarray(x, jnp.uint32)
+    seeds = (jnp.arange(1, num_hash + 1, dtype=jnp.uint32)
+             * jnp.uint32(0x9E3779B1))
+    h = x[..., None] * seeds                       # broadcast mix
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    return (h % jnp.uint32(mod_by)).astype(jnp.int64)
+
+
+@primitive("add_position_encoding")
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """Sinusoidal position encoding added to (B, L, D) input
+    (add_position_encoding_op.cc)."""
+    b, l, d = input.shape
+    half = d // 2
+    pos = jnp.arange(l, dtype=jnp.float32)[:, None]
+    denom = half - 1 if half > 1 else 1  # builtins.max is shadowed here
+    div = jnp.exp(jnp.arange(half, dtype=jnp.float32)
+                  * -(jnp.log(10000.0) / denom))
+    enc = jnp.concatenate(
+        [jnp.sin(pos * div), jnp.cos(pos * div)], axis=1)
+    if enc.shape[1] < d:
+        enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[1])))
+    return alpha * input + beta * enc[None, :, :].astype(input.dtype)

@@ -132,3 +132,91 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
     idx = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(x),
                            side="right" if right else "left")
     return idx.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    """Sample one category id per row from probabilities
+    (sampling_id_op.cc)."""
+    import jax
+
+    from ..framework import random as random_mod
+    from ..framework.random import next_rng_key
+
+    probs = unwrap(x)
+    key = random_mod.make_key(seed) if seed else next_rng_key()
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-20)),
+                                 axis=-1)
+    return Tensor(ids.astype(jnp.int32 if dtype == "int32" else jnp.int64))
+
+
+def gather_tree(ids, parents, name=None):
+    """Back-trace full beam-search sequences from per-step ids+parents
+    (gather_tree_op.cc): inputs (max_time, batch, beam)."""
+    arr = np.asarray(unwrap(ids))
+    par = np.asarray(unwrap(parents))
+    T, b, k = arr.shape
+    out = np.empty_like(arr)
+    out[T - 1] = arr[T - 1]
+    beam_idx = np.tile(np.arange(k), (b, 1))
+    for t in range(T - 2, -1, -1):
+        rows = np.arange(b)[:, None]
+        beam_idx = par[t + 1][rows, beam_idx]
+        out[t] = arr[t][rows, beam_idx]
+    return Tensor(out)
+
+
+def edit_distance(input, label, input_length=None, label_length=None,
+                  normalized=True, ignored_tokens=None, name=None):
+    """Levenshtein distance per pair (edit_distance_op.cc). Inputs
+    (b, maxlen) int with lengths. Returns (dist (b,1), seq_num)."""
+    hyp = np.asarray(unwrap(input))
+    ref = np.asarray(unwrap(label))
+    b = hyp.shape[0]
+    hl = (np.asarray(unwrap(input_length)).ravel() if input_length is not None
+          else np.full(b, hyp.shape[1]))
+    rl = (np.asarray(unwrap(label_length)).ravel() if label_length is not None
+          else np.full(b, ref.shape[1]))
+    ignored = set(ignored_tokens or ())
+    out = np.zeros((b, 1), np.float32)
+    for i in range(b):
+        h = [t for t in hyp[i, :hl[i]].tolist() if t not in ignored]
+        r = [t for t in ref[i, :rl[i]].tolist() if t not in ignored]
+        m, n = len(h), len(r)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for x_i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = x_i
+            for y_i in range(1, n + 1):
+                cost = 0 if h[x_i - 1] == r[y_i - 1] else 1
+                dp[y_i] = min(prev[y_i] + 1, dp[y_i - 1] + 1,
+                              prev[y_i - 1] + cost)
+        d = float(dp[n])
+        if normalized:
+            d = d / max(n, 1)
+        out[i, 0] = d
+    return Tensor(out), Tensor(np.int64(b))
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Best-path CTC decoding (ctc_align_op.cc + layers
+    ctc_greedy_decoder): argmax per frame, merge repeats, drop blanks.
+    input: (b, T, num_classes+1) probs/logits. Returns (ids (b, maxlen),
+    lengths (b,))."""
+    probs = np.asarray(unwrap(input))
+    b, T = probs.shape[0], probs.shape[1]
+    lens = (np.asarray(unwrap(input_length)).ravel()
+            if input_length is not None else np.full(b, T))
+    seqs = []
+    for i in range(b):
+        path = probs[i, :lens[i]].argmax(-1)
+        merged = [int(t) for j, t in enumerate(path)
+                  if t != blank and (j == 0 or t != path[j - 1])]
+        seqs.append(merged)
+    maxlen = max((len(s) for s in seqs), default=0)
+    out = np.full((b, max(maxlen, 1)), padding_value, np.int64)
+    out_len = np.zeros(b, np.int64)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = s
+        out_len[i] = len(s)
+    return Tensor(out), Tensor(out_len)

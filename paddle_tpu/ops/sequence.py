@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.op import primitive
+from ..framework.tensor import Tensor, unwrap
 
 __all__ = [
     "sequence_pool", "sequence_softmax", "sequence_reverse",
@@ -176,3 +177,76 @@ def sequence_conv(x, weight, lengths=None, context_length=3,
     if m is not None:
         out = jnp.where(m, out, 0.0)
     return out
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concatenate sequences row-wise (sequence_concat_op.cc):
+    out row i = concat of each input's valid prefix. Returns
+    (padded, lengths)."""
+    mats = [np.asarray(unwrap(x)) for x in xs]
+    lens = [np.asarray(unwrap(l)).astype(np.int64) for l in lengths_list]
+    b = mats[0].shape[0]
+    out_len = np.sum(np.stack(lens), axis=0)
+    maxlen = int(out_len.max()) if b else 0
+    tail = mats[0].shape[2:]
+    out = np.zeros((b, maxlen) + tail, mats[0].dtype)
+    for i in range(b):
+        off = 0
+        for m, l in zip(mats, lens):
+            n = int(l[i])
+            out[i, off:off + n] = m[i, :n]
+            off += n
+    return Tensor(out), Tensor(out_len)
+
+
+def sequence_expand_as(x, lengths, name=None):
+    """Expand each row of x to its target length
+    (sequence_expand_as_op.cc): row i repeated lengths[i] times,
+    concatenated flat like sequence_expand — shape (sum(lengths), ...)."""
+    return sequence_expand(x, lengths, name=name)
+
+
+def sequence_slice(x, lengths, offset, length, name=None):
+    """Slice each sequence (sequence_slice_op.cc): take `length[i]`
+    steps starting at offset[i]. Returns (padded, new_lengths)."""
+    off = jnp.reshape(jnp.asarray(unwrap(offset)), (-1,))
+    ln = jnp.reshape(jnp.asarray(unwrap(length)), (-1,))
+    arr = unwrap(x)
+    b, maxlen = arr.shape[0], arr.shape[1]
+    pos = jnp.arange(maxlen)[None, :]
+    src = pos + off[:, None]
+    src = jnp.clip(src, 0, maxlen - 1)
+    gathered = jnp.take_along_axis(
+        arr, src.reshape(src.shape + (1,) * (arr.ndim - 2)).astype(jnp.int32),
+        axis=1)
+    mask = pos < ln[:, None]
+    out = jnp.where(mask.reshape(mask.shape + (1,) * (arr.ndim - 2)),
+                    gathered, 0)
+    return Tensor(out), Tensor(ln)
+
+
+def sequence_enumerate(x, lengths, win_size, pad_value=0, name=None):
+    """Sliding-window id enumeration (sequence_enumerate_op.cc):
+    (b, maxlen) int -> (b, maxlen, win_size)."""
+    arr = unwrap(x)
+    b, maxlen = arr.shape
+    lens = jnp.reshape(jnp.asarray(unwrap(lengths)), (-1,))
+    outs = []
+    for w in range(win_size):
+        shifted = jnp.concatenate(
+            [arr[:, w:], jnp.full((b, w), pad_value, arr.dtype)], axis=1)
+        # positions beyond len-w are pad
+        valid = jnp.arange(maxlen)[None, :] + w < lens[:, None]
+        outs.append(jnp.where(valid, shifted, pad_value))
+    return Tensor(jnp.stack(outs, axis=-1))
+
+
+def sequence_scatter(x, index, updates, lengths=None, name=None):
+    """Scatter updates into each sequence at per-row indices
+    (sequence_scatter_op.cc), dense form: x (b, n), index (b, k),
+    updates (b, k)."""
+    arr = unwrap(x)
+    idx = jnp.asarray(unwrap(index))
+    upd = jnp.asarray(unwrap(updates))
+    rows = jnp.arange(arr.shape[0])[:, None]
+    return Tensor(arr.at[rows, idx].add(upd.astype(arr.dtype)))

@@ -45,7 +45,10 @@ class VarDesc:
                  stop_gradient=True, is_data=False, lod_level=0):
         self.name = name
         self.shape = tuple(shape) if shape is not None else None
-        self.dtype = dtype_mod.dtype_name(dtype_mod.convert_dtype(dtype))
+        # tensor_array is a container type, not an element dtype
+        # (framework.proto:151 LOD_TENSOR_ARRAY)
+        self.dtype = dtype if dtype == "tensor_array" else \
+            dtype_mod.dtype_name(dtype_mod.convert_dtype(dtype))
         self.persistable = persistable
         self.stop_gradient = stop_gradient
         self.is_data = is_data

@@ -742,3 +742,66 @@ def _while(ins, attrs, ctx):
     _, _, final = jax.lax.while_loop(
         cond_fn, body_fn, (init_cond, jnp.asarray(0, jnp.int32), init_vals))
     return {"Out": list(final)}
+
+
+# -- tensor arrays (reference LoDTensorArray + lod_tensor_array ops:
+# operators/controlflow/while_op + array_write/read; here an array is a
+# python list flowing through the env, so structure is trace-static) -----
+@kernel("create_array")
+def _create_array(ins, attrs, ctx):
+    return {"Out": [[]]}
+
+
+@kernel("array_write")
+def _array_write(ins, attrs, ctx):
+    """Write at a concrete index (overwrite or append, fluid semantics).
+    A traced index falls back to append — the only pattern that cannot
+    restructure a trace-static list, and the ubiquitous one (loops write
+    at i == len)."""
+    arr = list(ins["Array"][0])
+    val = _x(ins)
+    try:
+        i = int(ins["I"][0])
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        arr.append(val)
+        return {"Out": [arr]}
+    if i < len(arr):
+        arr[i] = val
+    elif i == len(arr):
+        arr.append(val)
+    else:
+        raise IndexError(
+            f"array_write index {i} beyond array length {len(arr)}")
+    return {"Out": [arr]}
+
+
+@kernel("array_read")
+def _array_read(ins, attrs, ctx):
+    arr = ins["X"][0]
+    i = ins["I"][0]
+    try:
+        return {"Out": [arr[int(i)]]}
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        # traced index: stack equal-shaped elements, dynamic-index
+        stacked = jnp.stack(arr, axis=0)
+        return {"Out": [jax.lax.dynamic_index_in_dim(
+            stacked, jnp.reshape(i, ()).astype(jnp.int32), axis=0,
+            keepdims=False)]}
+
+
+@kernel("array_length")
+def _array_length(ins, attrs, ctx):
+    return {"Out": [jnp.asarray([len(ins["X"][0])], jnp.int32)]}
+
+
+@kernel("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ins, attrs, ctx):
+    arr = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    if attrs.get("use_stack", False):
+        out = jnp.stack(arr, axis=axis)
+        idx = jnp.asarray([1] * len(arr), jnp.int32)
+    else:
+        out = jnp.concatenate(arr, axis=axis)
+        idx = jnp.asarray([a.shape[axis] for a in arr], jnp.int32)
+    return {"Out": [out], "OutIndex": [idx]}

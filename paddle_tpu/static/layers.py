@@ -22,8 +22,9 @@ import numpy as np
 from ..framework import dtype as dtype_mod
 from ..framework import random as random_mod
 from ..utils import unique_name
-from .ir import (Block, ParamDesc, Program, Variable, default_main_program,
-                 default_startup_program, _DYN_SENTINEL)
+from .ir import (Block, ParamDesc, Program, VarDesc, Variable,
+                 default_main_program, default_startup_program,
+                 _DYN_SENTINEL)
 from .kernels import KERNELS, ExecContext
 
 
@@ -730,3 +731,133 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
                                           branch_index.dtype, idx)]})
         pairs.append((pred, fn))
     return case(pairs, default=default, name=name)
+
+
+# -- fluid.layers tensor/array sugar ----------------------------------------
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """Empty named var to assign into (layers/tensor.py create_tensor)."""
+    prog = default_main_program()
+    name = name or unique_name.generate("create_tensor")
+    return prog.global_block.create_var(name=name, shape=(), dtype=dtype,
+                                        persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Persistable filled var in startup (layers/tensor.py
+    create_global_var)."""
+    helper = LayerHelper("global_var")
+    name = name or unique_name.generate("global_var")
+    desc = VarDesc(name, tuple(int(s) for s in shape),
+                   dtype_mod.dtype_name(dtype_mod.convert_dtype(dtype)),
+                   persistable=persistable)
+    helper.main_program.global_block.vars[name] = desc
+    sb = helper.startup_program.global_block
+    sb.vars[name] = VarDesc(name, tuple(int(s) for s in shape),
+                            desc.dtype, persistable=persistable)
+    sb.append_op(type="fill_constant", inputs={},
+                 outputs={"Out": [name]},
+                 attrs={"shape": list(shape), "dtype": desc.dtype,
+                        "value": float(value)})
+    return Variable(helper.main_program.global_block, desc)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone parameter (layers/tensor.py create_parameter)."""
+    helper = LayerHelper("create_parameter")
+    return helper.create_parameter(shape, dtype, name=name,
+                                   initializer=default_initializer,
+                                   attr=attr)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Global step counter incremented every run (layers/nn.py
+    autoincreased_step_counter)."""
+    name = counter_name or "@STEP_COUNTER@"
+    prog = default_main_program()
+    if name not in prog.global_block.vars:
+        v = create_global_var([1], float(begin - step), "int64",
+                              persistable=True, name=name)
+    else:
+        v = Variable(prog.global_block, prog.global_block.vars[name])
+    helper = LayerHelper("step_counter")
+    helper.append_op(type="increment", inputs={"X": [v]},
+                     outputs={"Out": [v.name]},
+                     attrs={"step": float(step)})
+    return v
+
+
+# -- tensor arrays (LoDTensorArray parity; layers/control_flow.py).
+# Shape inference is bypassed: an array's value is a trace-static python
+# list, so element shape/dtype are tracked on its VarDesc instead.
+def create_array(dtype="float32", initialized_list=None):
+    helper = LayerHelper("array")
+    name = unique_name.generate("array")
+    desc = VarDesc(name, (), "tensor_array")
+    desc.elem_shape = None
+    desc.elem_dtype = str(dtype)
+    helper.block.vars[name] = desc
+    helper.block.append_op(type="create_array", inputs={},
+                           outputs={"Out": [name]},
+                           attrs={"dtype": str(dtype)})
+    return helper.block.var(name)
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array()
+    helper = LayerHelper("array_write")
+    helper.block.append_op(type="array_write",
+                           inputs={"X": [x], "I": [i],
+                                   "Array": [array]},
+                           outputs={"Out": [array.name]})
+    xdesc = helper.block._find_var_recursive(
+        x.name if hasattr(x, "name") else str(x))
+    adesc = helper.block._find_var_recursive(array.name)
+    if xdesc is not None:
+        adesc.elem_shape = tuple(xdesc.shape or ())
+        adesc.elem_dtype = xdesc.dtype
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = unique_name.generate("array_read.out")
+    adesc = helper.block._find_var_recursive(array.name)
+    helper.block.create_var(name=out,
+                            shape=tuple(adesc.elem_shape or ()),
+                            dtype=adesc.elem_dtype or "float32")
+    helper.block.append_op(type="array_read",
+                           inputs={"X": [array], "I": [i]},
+                           outputs={"Out": [out]})
+    return helper.block.var(out)
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = unique_name.generate("array_length.out")
+    helper.block.create_var(name=out, shape=(1,), dtype="int32")
+    helper.block.append_op(type="array_length", inputs={"X": [array]},
+                           outputs={"Out": [out]})
+    return helper.block.var(out)
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
+    helper = LayerHelper("array_to_tensor")
+    out = unique_name.generate("array_concat.out")
+    idx = unique_name.generate("array_concat.index")
+    adesc = helper.block._find_var_recursive(input.name)
+    elem = tuple(adesc.elem_shape or ())
+    if use_stack:
+        oshape = (-1,) + elem
+    else:
+        oshape = ((-1,) + elem[1:]) if elem else (-1,)
+    helper.block.create_var(name=out, shape=oshape,
+                            dtype=adesc.elem_dtype or "float32")
+    helper.block.create_var(name=idx, shape=(-1,), dtype="int32")
+    helper.block.append_op(
+        type="tensor_array_to_tensor", inputs={"X": [input]},
+        outputs={"Out": [out], "OutIndex": [idx]},
+        attrs={"axis": int(axis), "use_stack": bool(use_stack)})
+    return helper.block.var(out), helper.block.var(idx)

@@ -284,3 +284,124 @@ def _nms_mask(boxes, scores, iou_threshold, score_threshold, category_idxs):
     keep0 = s > score_threshold
     keep = jax.lax.fori_loop(0, n, body, keep0)
     return keep, order
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """Mean intersection-over-union metric (mean_iou_op.cc). Returns
+    (mean_iou, out_wrong, out_correct)."""
+    import numpy as np
+
+    from ..framework.tensor import Tensor, unwrap
+
+    pred = np.asarray(unwrap(input)).ravel()
+    gt = np.asarray(unwrap(label)).ravel()
+    ious = []
+    wrong = np.zeros(num_classes, np.int64)
+    correct = np.zeros(num_classes, np.int64)
+    for c in range(num_classes):
+        inter = int(((pred == c) & (gt == c)).sum())
+        union = int(((pred == c) | (gt == c)).sum())
+        correct[c] = inter
+        wrong[c] = int((gt == c).sum()) + int((pred == c).sum()) - 2 * inter
+        if union:
+            ious.append(inter / union)
+    miou = float(np.mean(ious)) if ious else 0.0
+    return (Tensor(np.float32(miou)), Tensor(wrong), Tensor(correct))
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU matrix (iou_similarity_op.cc)."""
+    from ..framework.tensor import Tensor, unwrap
+
+    return Tensor(iou_matrix(unwrap(x), unwrap(y)))
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image boundaries (box_clip_op.cc). im_info rows:
+    (height, width, scale)."""
+    import jax.numpy as jnp
+
+    from ..framework.tensor import Tensor, unwrap
+
+    boxes = unwrap(input)
+    info = unwrap(im_info)
+    h = info[..., 0] / info[..., 2] - 1
+    w = info[..., 1] / info[..., 2] - 1
+    if boxes.ndim == 2:
+        hh, ww = h, w
+    else:
+        hh, ww = h[:, None], w[:, None]
+    x1 = jnp.clip(boxes[..., 0], 0, ww)
+    y1 = jnp.clip(boxes[..., 1], 0, hh)
+    x2 = jnp.clip(boxes[..., 2], 0, ww)
+    y2 = jnp.clip(boxes[..., 3], 0, hh)
+    return Tensor(jnp.stack([x1, y1, x2, y2], axis=-1))
+
+
+def roi_pool(x, rois, output_size, spatial_scale=1.0, rois_num=None,
+             name=None):
+    """Max-pool RoI features (roi_pool_op.cc) — the quantized
+    predecessor of roi_align."""
+    import numpy as np
+
+    from ..framework.tensor import Tensor, unwrap
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    feat = np.asarray(unwrap(x))
+    boxes = np.asarray(unwrap(rois))
+    n_roi = boxes.shape[0]
+    c = feat.shape[1]
+    out = np.zeros((n_roi, c, ph, pw), feat.dtype)
+    H, W = feat.shape[2], feat.shape[3]
+    for i, box in enumerate(boxes):
+        bidx = 0 if boxes.shape[1] == 4 else int(box[0])
+        bx = box if boxes.shape[1] == 4 else box[1:]
+        # reference roi_pool uses inclusive box ends (+1)
+        x1 = int(round(float(bx[0]) * spatial_scale))
+        y1 = int(round(float(bx[1]) * spatial_scale))
+        x2 = max(int(round(float(bx[2]) * spatial_scale)) + 1, x1 + 1)
+        y2 = max(int(round(float(bx[3]) * spatial_scale)) + 1, y1 + 1)
+        x1, y1 = max(x1, 0), max(y1, 0)
+        x2, y2 = min(x2, W), min(y2, H)
+        for iy in range(ph):
+            ys = y1 + (y2 - y1) * iy // ph
+            ye = max(y1 + (y2 - y1) * (iy + 1) // ph, ys + 1)
+            for ix in range(pw):
+                xs = x1 + (x2 - x1) * ix // pw
+                xe = max(x1 + (x2 - x1) * (ix + 1) // pw, xs + 1)
+                out[i, :, iy, ix] = feat[bidx, :, ys:ye, xs:xe].max(
+                    axis=(1, 2))
+    return Tensor(out)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching of priors to ground truth
+    (bipartite_match_op.cc). Returns (match_indices, match_dist)."""
+    import numpy as np
+
+    from ..framework.tensor import Tensor, unwrap
+
+    dist = np.array(unwrap(dist_matrix), np.float32, copy=True)
+    rows, cols = dist.shape
+    match_idx = np.full(cols, -1, np.int64)
+    match_dist = np.zeros(cols, np.float32)
+    for _ in range(min(rows, cols)):
+        r, c = np.unravel_index(np.argmax(dist), dist.shape)
+        if dist[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = dist[r, c]
+        dist[r, :] = -1
+        dist[:, c] = -1
+    if match_type == "per_prediction":
+        orig = np.asarray(unwrap(dist_matrix))
+        for c in range(cols):
+            if match_idx[c] == -1:
+                r = int(orig[:, c].argmax())
+                if orig[r, c] >= dist_threshold:
+                    match_idx[c] = r
+                    match_dist[c] = orig[r, c]
+    return Tensor(match_idx[None, :]), Tensor(match_dist[None, :])

@@ -227,3 +227,53 @@ def test_conv_bn_pool_static():
                    fetch_list=[out])
     assert res.shape == (2, 10)
     assert np.isfinite(res).all()
+
+
+def test_tensor_array_and_global_var_sugar():
+    """fluid tensor-array + create_global_var/step-counter parity
+    (layers/control_flow.py array_write/read, layers/tensor.py)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 3])
+        arr = static.array_write(x, static.fill_constant([1], "int32", 0))
+        doubled = static.scale(x, scale=2.0)
+        static.array_write(doubled, static.fill_constant([1], "int32", 1),
+                           array=arr)
+        n = static.array_length(arr)
+        first = static.array_read(arr, static.fill_constant([1], "int32", 0))
+        stacked, idx = static.tensor_array_to_tensor(arr, use_stack=True)
+        gv = static.create_global_var([1], 7.0, "float32",
+                                      persistable=True)
+        ctr = static.autoincreased_step_counter()
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 3), np.float32)
+    n_v, first_v, st_v, gv_v, ctr_v = exe.run(
+        main, feed={"x": xv}, fetch_list=[n, first, stacked, gv, ctr])
+    assert int(n_v[0]) == 2
+    np.testing.assert_allclose(first_v, xv)
+    assert st_v.shape == (2, 2, 3)
+    np.testing.assert_allclose(st_v[1], 2 * xv)
+    assert float(gv_v[0]) == 7.0
+    assert int(ctr_v[0]) == 1
+    # step counter increments across runs
+    ctr_v2 = exe.run(main, feed={"x": xv}, fetch_list=[ctr])[0]
+    assert int(ctr_v2[0]) == 2
+
+
+def test_array_write_overwrites_at_existing_index():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 2])
+        arr = static.array_write(x, static.fill_constant([1], "int32", 0))
+        y = static.scale(x, scale=3.0)
+        static.array_write(y, static.fill_constant([1], "int32", 0),
+                           array=arr)  # overwrite, not append
+        n = static.array_length(arr)
+        got = static.array_read(arr, static.fill_constant([1], "int32", 0))
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 2), np.float32)
+    n_v, got_v = exe.run(main, feed={"x": xv}, fetch_list=[n, got])
+    assert int(n_v[0]) == 1
+    np.testing.assert_allclose(got_v, 3 * xv)
