@@ -760,11 +760,13 @@ def _array_write(ins, attrs, ctx):
     at i == len)."""
     arr = list(ins["Array"][0])
     val = _x(ins)
-    try:
-        i = int(ins["I"][0])
-    except (TypeError, jax.errors.ConcretizationTypeError):
-        arr.append(val)
-        return {"Out": [arr]}
+    i = attrs.get("static_index")
+    if i is None:
+        try:
+            i = int(ins["I"][0])
+        except (KeyError, TypeError, jax.errors.ConcretizationTypeError):
+            arr.append(val)
+            return {"Out": [arr]}
     if i < len(arr):
         arr[i] = val
     elif i == len(arr):
@@ -778,6 +780,8 @@ def _array_write(ins, attrs, ctx):
 @kernel("array_read")
 def _array_read(ins, attrs, ctx):
     arr = ins["X"][0]
+    if "static_index" in attrs:
+        return {"Out": [arr[int(attrs["static_index"])]]}
     i = ins["I"][0]
     try:
         return {"Out": [arr[int(i)]]}

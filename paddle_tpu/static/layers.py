@@ -804,14 +804,34 @@ def create_array(dtype="float32", initialized_list=None):
     return helper.block.var(name)
 
 
+def _literal_index(block, i):
+    """Resolve a graph-build-time constant index: a python int, or a var
+    produced by fill_constant (the executor traces the block, so runtime
+    values are tracers — write positions must be known when the trace is
+    built, exactly like the reference's compile-time LoDTensorArray
+    slots)."""
+    if isinstance(i, (int, np.integer)):
+        return int(i)
+    name = getattr(i, "name", None)
+    for op in block.ops:
+        if op.type == "fill_constant" and name in op.output_names():
+            return int(op.attrs.get("value", 0))
+    return None
+
+
 def array_write(x, i, array=None):
     if array is None:
         array = create_array()
     helper = LayerHelper("array_write")
-    helper.block.append_op(type="array_write",
-                           inputs={"X": [x], "I": [i],
-                                   "Array": [array]},
-                           outputs={"Out": [array.name]})
+    attrs = {}
+    lit = _literal_index(helper.block, i)
+    if lit is not None:
+        attrs["static_index"] = lit
+    inputs = {"X": [x], "Array": [array]}
+    if not isinstance(i, (int, np.integer)):
+        inputs["I"] = [i]
+    helper.block.append_op(type="array_write", inputs=inputs,
+                           outputs={"Out": [array.name]}, attrs=attrs)
     xdesc = helper.block._find_var_recursive(
         x.name if hasattr(x, "name") else str(x))
     adesc = helper.block._find_var_recursive(array.name)
@@ -828,9 +848,15 @@ def array_read(array, i):
     helper.block.create_var(name=out,
                             shape=tuple(adesc.elem_shape or ()),
                             dtype=adesc.elem_dtype or "float32")
-    helper.block.append_op(type="array_read",
-                           inputs={"X": [array], "I": [i]},
-                           outputs={"Out": [out]})
+    attrs = {}
+    lit = _literal_index(helper.block, i)
+    if lit is not None:
+        attrs["static_index"] = lit
+    inputs = {"X": [array]}
+    if not isinstance(i, (int, np.integer)):
+        inputs["I"] = [i]
+    helper.block.append_op(type="array_read", inputs=inputs,
+                           outputs={"Out": [out]}, attrs=attrs)
     return helper.block.var(out)
 
 
