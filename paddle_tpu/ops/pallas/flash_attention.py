@@ -62,10 +62,14 @@ def _dot(a, b, trans_b=False):
     return jax.lax.dot_general(a, b, dims, preferred_element_type=_F32)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_len,
-                      block_kv, sm_scale, causal, q_block):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, kv_len,
+                      block_kv, sm_scale, causal, q_block, masked=False):
     from jax.experimental import pallas as pl
 
+    if masked:
+        mask_ref, o_ref, lse_ref = rest
+    else:
+        (o_ref, lse_ref), mask_ref = rest, None
     q = q_ref[...].astype(_F32) * sm_scale       # (bq, d)
     bq = q.shape[0]
     qi = pl.program_id(1)
@@ -76,6 +80,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_len,
         k = k_ref[pl.dslice(j * block_kv, block_kv), :].astype(_F32)
         v = v_ref[pl.dslice(j * block_kv, block_kv), :].astype(_F32)
         s = _dot(q, k, trans_b=True)             # (bq, bkv)
+        if mask_ref is not None:
+            mb = mask_ref[0, pl.dslice(j * block_kv, block_kv)]
+            s = s + mb[None, :].astype(_F32)
         if causal:
             q_pos = qi * q_block + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_kv), 0)
@@ -109,10 +116,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, kv_len,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, kv_len, block_kv, sm_scale, causal,
-                         q_block):
+                         *rest, kv_len, block_kv, sm_scale, causal,
+                         q_block, masked=False):
     from jax.experimental import pallas as pl
 
+    if masked:
+        mask_ref, dq_ref = rest
+    else:
+        (dq_ref,), mask_ref = rest, None
     q = q_ref[...].astype(_F32) * sm_scale       # (bq, d)
     do = do_ref[...].astype(_F32)
     lse = lse_ref[0, :]                          # (bq,)
@@ -125,6 +136,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[pl.dslice(j * block_kv, block_kv), :].astype(_F32)
         v = v_ref[pl.dslice(j * block_kv, block_kv), :].astype(_F32)
         s = _dot(q, k, trans_b=True)
+        if mask_ref is not None:
+            mb = mask_ref[0, pl.dslice(j * block_kv, block_kv)]
+            s = s + mb[None, :].astype(_F32)
         if causal:
             q_pos = qi * q_block + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_kv), 0)
@@ -145,10 +159,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, q_len, block_q, sm_scale,
-                          causal, kv_block):
+                          *rest, q_len, block_q, sm_scale,
+                          causal, kv_block, masked=False):
     from jax.experimental import pallas as pl
 
+    if masked:
+        mask_ref, dk_ref, dv_ref = rest
+    else:
+        (dk_ref, dv_ref), mask_ref = rest, None
     k = k_ref[...].astype(_F32)                  # (bkv, d)
     v = v_ref[...].astype(_F32)
     bkv = k.shape[0]
@@ -162,6 +180,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, pl.dslice(i * block_q, block_q)]
         delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
         s = _dot(q, k, trans_b=True)             # (bq, bkv)
+        if mask_ref is not None:
+            mb = mask_ref[0, :]
+            s = s + mb[None, :].astype(_F32)
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bkv), 0)
@@ -202,21 +223,29 @@ def _splitheads(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, l, d), 1, 2)
 
 
-def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale):
+def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
+              mask_bias=None):
     from jax.experimental import pallas as pl
 
     bh, ql, d = qm.shape
     kl = km.shape[1]
     grid = (bh, ql // block_q)
+    masked = mask_bias is not None
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
+    ]
+    operands = [qm, km, vm]
+    if masked:
+        in_specs.append(pl.BlockSpec((None, 1, kl), lambda i, j: (i, 0, 0)))
+        operands.append(mask_bias)
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, kv_len=kl, block_kv=block_kv,
-                          sm_scale=sm_scale, causal=causal, q_block=block_q),
+                          sm_scale=sm_scale, causal=causal, q_block=block_q,
+                          masked=masked),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
@@ -225,7 +254,7 @@ def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale):
             jax.ShapeDtypeStruct((bh, ql, d), qm.dtype),
             jax.ShapeDtypeStruct((bh, 1, ql), _F32),
         ],
-    )(qm, km, vm)
+    )(*operands)
     return out, lse
 
 
@@ -243,47 +272,55 @@ def _flash_attention_core_fwd(q, k, v, causal, block_q, block_kv):
     return _splitheads(out_m, b, h), (qm, km, vm, out_m, lse, b, h)
 
 
-def _flash_attention_core_bwd(causal, block_q, block_kv, res, dout):
+def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
+              sm_scale, mask_bias=None):
     from jax.experimental import pallas as pl
 
-    qm, km, vm, out_m, lse, b, h = res
     bh, ql, d = qm.shape
     kl = km.shape[1]
-    sm_scale = 1.0 / math.sqrt(d)
-    dom = _mergeheads(dout)
-    delta = jnp.sum(dom.astype(_F32) * out_m.astype(_F32),
-                    axis=-1)[:, None, :]                     # (bh, 1, ql)
+    masked = mask_bias is not None
 
+    dq_specs = [
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+        pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+    ]
+    dq_ops = [qm, km, vm, dom, lse, delta]
+    if masked:
+        dq_specs.append(pl.BlockSpec((None, 1, kl), lambda i, j: (i, 0, 0)))
+        dq_ops.append(mask_bias)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, kv_len=kl,
                           block_kv=block_kv, sm_scale=sm_scale,
-                          causal=causal, q_block=block_q),
+                          causal=causal, q_block=block_q, masked=masked),
         grid=(bh, ql // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, kl, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, ql, d), qm.dtype),
-    )(qm, km, vm, dom, lse, delta)
+    )(*dq_ops)
 
+    dkv_specs = [
+        pl.BlockSpec((None, ql, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, block_kv, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, block_kv, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, ql, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, 1, ql), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, 1, ql), lambda i, j: (i, 0, 0)),
+    ]
+    dkv_ops = [qm, km, vm, dom, lse, delta]
+    if masked:
+        dkv_specs.append(
+            pl.BlockSpec((None, 1, block_kv), lambda i, j: (i, 0, j)))
+        dkv_ops.append(mask_bias)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, q_len=ql, block_q=block_q,
                           sm_scale=sm_scale, causal=causal,
-                          kv_block=block_kv),
+                          kv_block=block_kv, masked=masked),
         grid=(bh, kl // block_kv),
-        in_specs=[
-            pl.BlockSpec((None, ql, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, block_kv, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_kv, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, ql, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, 1, ql), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, 1, ql), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((None, block_kv, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, block_kv, d), lambda i, j: (i, j, 0)),
@@ -292,14 +329,72 @@ def _flash_attention_core_bwd(causal, block_q, block_kv, res, dout):
             jax.ShapeDtypeStruct((bh, kl, d), km.dtype),
             jax.ShapeDtypeStruct((bh, kl, d), vm.dtype),
         ],
-    )(qm, km, vm, dom, lse, delta)
+    )(*dkv_ops)
+    return dq, dk, dv
 
+
+def _flash_attention_core_bwd(causal, block_q, block_kv, res, dout):
+    qm, km, vm, out_m, lse, b, h = res
+    d = qm.shape[-1]
+    sm_scale = 1.0 / math.sqrt(d)
+    dom = _mergeheads(dout)
+    delta = jnp.sum(dom.astype(_F32) * out_m.astype(_F32),
+                    axis=-1)[:, None, :]                     # (bh, 1, ql)
+    dq, dk, dv = _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q,
+                           block_kv, sm_scale)
     return (_splitheads(dq, b, h), _splitheads(dk, b, h),
             _splitheads(dv, b, h))
 
 
 _flash_attention_core.defvjp(_flash_attention_core_fwd,
                              _flash_attention_core_bwd)
+
+
+# -- masked variant: additive (batch, kv_len) bias, e.g. key-padding -------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention_core_masked(q, k, v, mask_bias, causal, block_q,
+                                 block_kv):
+    out, _ = _flash_attention_core_masked_fwd(q, k, v, mask_bias, causal,
+                                              block_q, block_kv)
+    return out
+
+
+def _expand_mask(mask_bias, h):
+    """(b, kl) -> (b*h, 1, kl) to ride the merged batch-head grid."""
+    b, kl = mask_bias.shape
+    return jnp.broadcast_to(mask_bias[:, None, None, :],
+                            (b, h, 1, kl)).reshape(b * h, 1, kl)
+
+
+def _flash_attention_core_masked_fwd(q, k, v, mask_bias, causal, block_q,
+                                     block_kv):
+    b, ql, h, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    qm, km, vm = _mergeheads(q), _mergeheads(k), _mergeheads(v)
+    mm = _expand_mask(mask_bias.astype(_F32), h)
+    out_m, lse = _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
+                           mask_bias=mm)
+    return (_splitheads(out_m, b, h),
+            (qm, km, vm, out_m, lse, mm, mask_bias, b, h))
+
+
+def _flash_attention_core_masked_bwd(causal, block_q, block_kv, res, dout):
+    qm, km, vm, out_m, lse, mm, mask_bias, b, h = res
+    d = qm.shape[-1]
+    sm_scale = 1.0 / math.sqrt(d)
+    dom = _mergeheads(dout)
+    delta = jnp.sum(dom.astype(_F32) * out_m.astype(_F32),
+                    axis=-1)[:, None, :]
+    dq, dk, dv = _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q,
+                           block_kv, sm_scale, mask_bias=mm)
+    # mask_bias is boolean-derived (bool masks only reach this path), so
+    # its cotangent is structurally zero
+    return (_splitheads(dq, b, h), _splitheads(dk, b, h),
+            _splitheads(dv, b, h), jnp.zeros_like(mask_bias))
+
+
+_flash_attention_core_masked.defvjp(_flash_attention_core_masked_fwd,
+                                    _flash_attention_core_masked_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
@@ -309,6 +404,30 @@ def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
     ql, kl = q.shape[1], k.shape[1]
     return _flash_attention_core(q, k, v, causal, min(block_q, ql),
                                  min(block_kv, kl))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q",
+                                             "block_kv"))
+def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
+                                   block_q=256, block_kv=256):
+    ql, kl = q.shape[1], k.shape[1]
+    return _flash_attention_core_masked(q, k, v, mask_bias, causal,
+                                        min(block_q, ql), min(block_kv, kl))
+
+
+def _kv_mask_bias(mask, batch, kv_len):
+    """Normalise a BOOLEAN key-padding mask to an additive (batch, kv_len)
+    bias, or None when ineligible: non-bool masks (e.g. learnable float
+    biases, whose gradient this kernel does not produce) and per-query
+    masks keep the XLA path."""
+    m = mask
+    if m.dtype != jnp.bool_:
+        return None
+    while m.ndim > 2 and m.shape[1] == 1:
+        m = m[:, 0]
+    if m.ndim != 2 or m.shape != (batch, kv_len):
+        return None
+    return jnp.where(m, 0.0, _NEG_INF).astype(_F32)
 
 
 def _pallas_ok(q, k, causal):
@@ -353,4 +472,14 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
                                   batch_axis=batch_axis,
                                   is_causal=is_causal, impl=impl)
         return _local_attention(q, k, v, is_causal)
+    if mask is not None and dropout_p == 0.0 and _pallas_ok(q, k, is_causal):
+        # key-padding masks ride the Pallas kernel as an additive kv bias;
+        # per-query masks keep the XLA path
+        bias = _kv_mask_bias(jnp.asarray(mask), q.shape[0], k.shape[1])
+        if bias is not None:
+            try:
+                return _flash_attention_pallas_masked(q, k, v, bias,
+                                                      causal=is_causal)
+            except Exception:
+                pass
     return _xla_attention(q, k, v, mask, dropout_p, is_causal, key_rng)

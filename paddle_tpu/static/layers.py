@@ -813,10 +813,12 @@ def _literal_index(block, i):
     if isinstance(i, (int, np.integer)):
         return int(i)
     name = getattr(i, "name", None)
-    for op in block.ops:
-        if op.type == "fill_constant" and name in op.output_names():
-            return int(op.attrs.get("value", 0))
-    return None
+    lit = None
+    for op in block.ops:  # last writer wins: increment etc. invalidate
+        if name in op.output_names():
+            lit = (op.attrs.get("value", 0)
+                   if op.type == "fill_constant" else None)
+    return int(lit) if lit is not None else None
 
 
 def array_write(x, i, array=None):

@@ -277,3 +277,23 @@ def test_array_write_overwrites_at_existing_index():
     n_v, got_v = exe.run(main, feed={"x": xv}, fetch_list=[n, got])
     assert int(n_v[0]) == 1
     np.testing.assert_allclose(got_v, 3 * xv)
+
+
+def test_array_write_with_incremented_counter_appends():
+    """A fill_constant counter that is later incremented must NOT resolve
+    to a stale static index (last-writer-wins literal resolution)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 2])
+        i = static.fill_constant([1], "int32", 0)
+        arr = static.array_write(x, i)
+        i2 = static.increment(i, in_place=True)  # i now 1 at runtime
+        static.array_write(static.scale(x, scale=2.0), i2, array=arr)
+        n = static.array_length(arr)
+        last = static.array_read(arr, static.fill_constant([1], "int32", 1))
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 2), np.float32)
+    n_v, last_v = exe.run(main, feed={"x": xv}, fetch_list=[n, last])
+    assert int(n_v[0]) == 2
+    np.testing.assert_allclose(last_v, 2 * xv)
