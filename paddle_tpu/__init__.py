@@ -35,6 +35,7 @@ from . import jit  # noqa: F401
 from . import autograd  # noqa: F401
 from .autograd import grad  # noqa: F401
 from . import vision  # noqa: F401
+from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401

@@ -224,7 +224,7 @@ def _splitheads(x, b, h):
 
 
 def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
-              mask_bias=None):
+              mask_bias=None, heads=1):
     from jax.experimental import pallas as pl
 
     bh, ql, d = qm.shape
@@ -238,7 +238,10 @@ def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
     ]
     operands = [qm, km, vm]
     if masked:
-        in_specs.append(pl.BlockSpec((None, 1, kl), lambda i, j: (i, 0, 0)))
+        # bias stays (b, 1, kl) in HBM; the grid maps each merged
+        # batch-head row back to its batch entry (no h-fold copy)
+        in_specs.append(pl.BlockSpec((None, 1, kl),
+                                     lambda i, j: (i // heads, 0, 0)))
         operands.append(mask_bias)
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, kv_len=kl, block_kv=block_kv,
@@ -273,7 +276,7 @@ def _flash_attention_core_fwd(q, k, v, causal, block_q, block_kv):
 
 
 def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
-              sm_scale, mask_bias=None):
+              sm_scale, mask_bias=None, heads=1):
     from jax.experimental import pallas as pl
 
     bh, ql, d = qm.shape
@@ -290,7 +293,8 @@ def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
     ]
     dq_ops = [qm, km, vm, dom, lse, delta]
     if masked:
-        dq_specs.append(pl.BlockSpec((None, 1, kl), lambda i, j: (i, 0, 0)))
+        dq_specs.append(pl.BlockSpec((None, 1, kl),
+                                     lambda i, j: (i // heads, 0, 0)))
         dq_ops.append(mask_bias)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, kv_len=kl,
@@ -313,7 +317,8 @@ def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
     dkv_ops = [qm, km, vm, dom, lse, delta]
     if masked:
         dkv_specs.append(
-            pl.BlockSpec((None, 1, block_kv), lambda i, j: (i, 0, j)))
+            pl.BlockSpec((None, 1, block_kv),
+                         lambda i, j: (i // heads, 0, j)))
         dkv_ops.append(mask_bias)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, q_len=ql, block_q=block_q,
@@ -359,21 +364,14 @@ def _flash_attention_core_masked(q, k, v, mask_bias, causal, block_q,
     return out
 
 
-def _expand_mask(mask_bias, h):
-    """(b, kl) -> (b*h, 1, kl) to ride the merged batch-head grid."""
-    b, kl = mask_bias.shape
-    return jnp.broadcast_to(mask_bias[:, None, None, :],
-                            (b, h, 1, kl)).reshape(b * h, 1, kl)
-
-
 def _flash_attention_core_masked_fwd(q, k, v, mask_bias, causal, block_q,
                                      block_kv):
     b, ql, h, d = q.shape
     sm_scale = 1.0 / math.sqrt(d)
     qm, km, vm = _mergeheads(q), _mergeheads(k), _mergeheads(v)
-    mm = _expand_mask(mask_bias.astype(_F32), h)
+    mm = mask_bias.astype(_F32)[:, None, :]      # (b, 1, kl), no h copy
     out_m, lse = _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
-                           mask_bias=mm)
+                           mask_bias=mm, heads=h)
     return (_splitheads(out_m, b, h),
             (qm, km, vm, out_m, lse, mm, mask_bias, b, h))
 
@@ -386,7 +384,7 @@ def _flash_attention_core_masked_bwd(causal, block_q, block_kv, res, dout):
     delta = jnp.sum(dom.astype(_F32) * out_m.astype(_F32),
                     axis=-1)[:, None, :]
     dq, dk, dv = _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q,
-                           block_kv, sm_scale, mask_bias=mm)
+                           block_kv, sm_scale, mask_bias=mm, heads=h)
     # mask_bias is boolean-derived (bool masks only reach this path), so
     # its cotangent is structurally zero
     return (_splitheads(dq, b, h), _splitheads(dk, b, h),

@@ -1,0 +1,179 @@
+"""Text datasets (reference incubate/hapi/datasets/{imdb,imikolov,
+uci_housing,conll05,movielens}.py).
+
+Zero-egress design: each dataset loads from a local file when given a
+path, else generates a deterministic synthetic corpus with the same
+record schema — the pattern vision.datasets.MNIST established — so the
+data pipeline, models, and tests exercise the exact interfaces without
+downloads.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.dataloader import Dataset as _Dataset
+
+
+def _stable_hash(word: str, mod: int) -> int:
+    """Process-stable token hashing (python hash() is randomized per
+    process via PYTHONHASHSEED, which would scramble saved embeddings)."""
+    return zlib.crc32(word.encode("utf8")) % mod
+
+
+class Imdb(_Dataset):
+    """IMDB sentiment (imdb.py): records of (token_ids, label)."""
+
+    def __init__(self, data_path: Optional[str] = None, mode="train",
+                 cutoff=150, synthetic_size=512, vocab_size=5000,
+                 max_len=64, seed=0):
+        self.mode = mode
+        self.vocab_size = vocab_size
+        if data_path and os.path.exists(data_path):
+            self._load_archive(data_path, mode, cutoff)
+        else:
+            rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+            n = synthetic_size
+            self.docs: List[np.ndarray] = []
+            self.labels = np.zeros(n, np.int64)
+            # synthetic rule: positive docs over-sample the top quarter of
+            # the vocab, so the task is learnable
+            lo = min(8, max(1, max_len - 1))
+            for i in range(n):
+                label = int(rng.randint(0, 2))
+                length = int(rng.randint(lo, max_len + 1))
+                if label:
+                    ids = rng.randint(vocab_size // 4, vocab_size, length)
+                else:
+                    ids = rng.randint(1, (3 * vocab_size) // 4, length)
+                self.docs.append(ids.astype(np.int64))
+                self.labels[i] = label
+
+    def _load_archive(self, path, mode, cutoff):
+        pat = f"aclImdb/{mode}/"
+        self.docs, labels = [], []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not member.name.startswith(pat) or \
+                        not member.name.endswith(".txt"):
+                    continue
+                if "/pos/" in member.name:
+                    labels.append(1)
+                elif "/neg/" in member.name:
+                    labels.append(0)
+                else:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf8", "ignore").lower().split()
+                ids = np.asarray(
+                    [_stable_hash(w, self.vocab_size) for w in text],
+                    np.int64)
+                self.docs.append(ids[:cutoff])
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+
+class Imikolov(_Dataset):
+    """PTB-style n-gram LM dataset (imikolov.py): n-gram windows."""
+
+    def __init__(self, data_path: Optional[str] = None, data_type="NGRAM",
+                 window_size=5, mode="train", min_word_freq=50,
+                 synthetic_size=4096, vocab_size=2000, seed=0):
+        if data_type not in ("NGRAM", "SKIPGRAM"):
+            raise ValueError(f"unsupported data_type {data_type!r}")
+        self.window_size = window_size
+        self.data_type = data_type
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        if data_path and os.path.exists(data_path):
+            with open(data_path) as f:
+                words = f.read().split()
+            counts = {}
+            for w in words:
+                counts[w] = counts.get(w, 0) + 1
+            ids = np.asarray(
+                [_stable_hash(w, vocab_size) for w in words
+                 if counts[w] >= min_word_freq], np.int64)
+        else:
+            # Zipf-ish synthetic stream (imikolov's corpus statistics shape)
+            ranks = np.arange(1, vocab_size + 1)
+            p = (1.0 / ranks) / np.sum(1.0 / ranks)
+            ids = rng.choice(vocab_size, size=synthetic_size, p=p)
+        self.grams = np.lib.stride_tricks.sliding_window_view(
+            ids, window_size).astype(np.int64)
+
+    def __len__(self):
+        if self.data_type == "SKIPGRAM":
+            return len(self.grams) * (self.window_size - 1)
+        return len(self.grams)
+
+    def __getitem__(self, idx):
+        if self.data_type == "SKIPGRAM":
+            # (center, one context word) pairs; center = window middle
+            g = self.grams[idx // (self.window_size - 1)]
+            mid = self.window_size // 2
+            ctx = [g[i] for i in range(self.window_size) if i != mid]
+            return g[mid], ctx[idx % (self.window_size - 1)]
+        g = self.grams[idx]
+        return g[:-1], g[-1]
+
+
+class UCIHousing(_Dataset):
+    """Boston housing regression (uci_housing.py): 13 features, price."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_path: Optional[str] = None, mode="train",
+                 synthetic_size=404, seed=0):
+        if data_path and os.path.exists(data_path):
+            raw = np.loadtxt(data_path).astype(np.float32)
+            feats, target = raw[:, :-1], raw[:, -1:]
+        else:
+            # one shared ground-truth w across splits, disjoint samples
+            w = np.random.RandomState(seed).randn(
+                self.FEATURE_DIM, 1).astype(np.float32)
+            rng = np.random.RandomState(
+                seed + (1 if mode == "train" else 2))
+            n = synthetic_size if mode == "train" else synthetic_size // 4
+            feats = rng.randn(n, self.FEATURE_DIM).astype(np.float32)
+            target = feats @ w + 0.1 * rng.randn(n, 1).astype(np.float32)
+        mean, std = feats.mean(0), feats.std(0) + 1e-6
+        self.features = ((feats - mean) / std).astype(np.float32)
+        self.target = target.astype(np.float32)
+
+    def __len__(self):
+        return len(self.features)
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.target[idx]
+
+
+class Conll05st(_Dataset):
+    """Semantic role labeling records (conll05.py): token ids, predicate
+    position, BIO tag ids — the label_semantic_roles book-test schema."""
+
+    def __init__(self, data_path: Optional[str] = None, mode="train",
+                 vocab_size=3000, num_tags=9, max_len=30,
+                 synthetic_size=256, seed=0):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.records = []
+        for _ in range(synthetic_size):
+            n = int(rng.randint(5, max_len))
+            words = rng.randint(1, vocab_size, n).astype(np.int64)
+            pred_pos = int(rng.randint(0, n))
+            tags = rng.randint(0, num_tags, n).astype(np.int64)
+            self.records.append((words, np.int64(pred_pos), tags))
+
+    def __len__(self):
+        return len(self.records)
+
+    def __getitem__(self, idx):
+        return self.records[idx]

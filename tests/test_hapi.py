@@ -138,3 +138,55 @@ def test_resume_keeps_optimizer_state(tmp_path):
     m1 = np.asarray(slots[sname]["moment1"])
     assert np.abs(m1).max() > 0
     assert int(model2._train_step.opt_state["step"]) >= 3
+
+
+def test_text_datasets_schema_and_learnability():
+    """Text datasets (reference incubate/hapi/datasets): schema parity +
+    the synthetic Imdb task trains the SentimentLSTM end-to-end."""
+    import numpy as np
+
+    from paddle_tpu.text import Conll05st, Imdb, Imikolov, UCIHousing
+
+    imdb = Imdb(synthetic_size=64, vocab_size=100, max_len=16)
+    doc, label = imdb[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert len(imdb) == 64
+
+    ng = Imikolov(window_size=5, synthetic_size=128, vocab_size=50)
+    ctx, nxt = ng[0]
+    assert ctx.shape == (4,) and 0 <= int(nxt) < 50
+
+    uci = UCIHousing(synthetic_size=32)
+    f, y = uci[0]
+    assert f.shape == (13,) and y.shape == (1,)
+    assert abs(uci.features.mean()) < 0.2
+
+    srl = Conll05st(synthetic_size=8)
+    words, pred, tags = srl[0]
+    assert words.shape == tags.shape and 0 <= int(pred) < len(words)
+
+
+def test_imdb_trains_sentiment_model():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.sentiment import SentimentLSTM
+    from paddle_tpu.text import Imdb
+
+    paddle.seed(0)
+    ds = Imdb(synthetic_size=128, vocab_size=60, max_len=12)
+    maxlen = max(len(d) for d in ds.docs)
+    ids = np.zeros((len(ds), maxlen), np.int64)
+    for i, d in enumerate(ds.docs):
+        ids[i, :len(d)] = d
+    model = SentimentLSTM(vocab_size=60, embed_dim=16, hidden_dim=16,
+                          dropout=0.0)
+    opt = optimizer.Adam(learning_rate=0.01,
+                         parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: m.loss(x, y), opt)
+    losses = [float(step(paddle.to_tensor(ids),
+                         paddle.to_tensor(ds.labels)))
+              for _ in range(25)]
+    assert losses[-1] < losses[0] / 1.5, (losses[0], losses[-1])

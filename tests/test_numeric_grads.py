@@ -1,0 +1,132 @@
+"""Numeric gradient checks for the long-tail differentiable ops — the
+eager counterpart of the OpTest check_grad fixture (reference
+op_test.py:57 get_numeric_gradient, delta=0.005): analytic jax.grad vs
+central finite differences on the raw jnp implementations."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.crf import linear_chain_crf
+from paddle_tpu import ops
+
+DELTA = 5e-3
+RTOL, ATOL = 5e-2, 5e-3
+
+
+def _num_grad(f, args, idx, delta=DELTA):
+    a = np.asarray(args[idx], np.float32)
+    g = np.zeros_like(a)
+    flat = a.ravel()
+    for i in range(flat.size):
+        for sign in (+1, -1):
+            pert = flat.copy()
+            pert[i] += sign * delta
+            new = list(args)
+            new[idx] = pert.reshape(a.shape)
+            val = float(f(*new))
+            g.ravel()[i] += sign * val / (2 * delta)
+    return g
+
+
+def _check(f, args, wrt):
+    """f: scalar-valued fn of numpy arrays (first len(args) positional)."""
+    jf = lambda *xs: f(*xs)
+    for idx in wrt:
+        analytic = np.asarray(
+            jax.grad(jf, argnums=idx)(*[jnp.asarray(a) for a in args]))
+        numeric = _num_grad(lambda *xs: jf(*[jnp.asarray(x) for x in xs]),
+                            args, idx)
+        np.testing.assert_allclose(analytic, numeric, rtol=RTOL, atol=ATOL)
+
+
+RNG = np.random.RandomState(0)
+
+
+def test_dice_loss_grad():
+    x = jax.nn.softmax(jnp.asarray(RNG.randn(4, 3), jnp.float32))
+    label = RNG.randint(0, 3, (4, 1)).astype(np.int64)
+    _check(lambda p: jnp.sum(F.dice_loss.raw_fn(p, jnp.asarray(label))),
+           [np.asarray(x)], [0])
+
+
+def test_bpr_and_rank_losses_grad():
+    x = RNG.randn(4, 5).astype(np.float32)
+    lbl = RNG.randint(0, 5, (4, 1)).astype(np.int64)
+    _check(lambda a: jnp.sum(F.bpr_loss.raw_fn(a, jnp.asarray(lbl))),
+           [x], [0])
+    label = RNG.rand(3, 1).astype(np.float32)
+    left = RNG.randn(3, 1).astype(np.float32)
+    right = RNG.randn(3, 1).astype(np.float32)
+    _check(lambda l, r: jnp.sum(F.rank_loss.raw_fn(jnp.asarray(label),
+                                                   l, r)),
+           [left, right], [0, 1])
+    _check(lambda l, r: jnp.sum(F.margin_rank_loss.raw_fn(
+        jnp.asarray(label), l, r, margin=0.3)), [left, right], [0, 1])
+
+
+def test_center_loss_grad():
+    x = RNG.randn(4, 6).astype(np.float32)
+    centers = RNG.randn(3, 6).astype(np.float32)
+    lbl = RNG.randint(0, 3, (4,)).astype(np.int64)
+    _check(lambda a, c: jnp.sum(F.center_loss.raw_fn(
+        a, jnp.asarray(lbl), c)), [x, centers], [0, 1])
+
+
+def test_bilinear_tensor_product_grad():
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = RNG.randn(3, 5).astype(np.float32)
+    w = (RNG.randn(2, 4, 5) * 0.3).astype(np.float32)
+    _check(lambda a, b, ww: jnp.sum(jnp.square(
+        F.bilinear_tensor_product_fn.raw_fn(a, b, ww))),
+        [x, y, w], [0, 1, 2])
+
+
+def test_affine_channel_and_row_conv_grad():
+    x = RNG.randn(2, 3, 2, 2).astype(np.float32)
+    s = RNG.randn(3).astype(np.float32)
+    b = RNG.randn(3).astype(np.float32)
+    _check(lambda a, sc, bb: jnp.sum(jnp.square(
+        F.affine_channel.raw_fn(a, sc, bb))), [x, s, b], [0, 1, 2])
+    seq = RNG.randn(2, 5, 3).astype(np.float32)
+    w = RNG.randn(2, 3).astype(np.float32)
+    _check(lambda a, ww: jnp.sum(jnp.square(F.row_conv.raw_fn(a, ww))),
+           [seq, w], [0, 1])
+
+
+def test_cos_sim_and_clip_by_norm_grad():
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = RNG.randn(3, 4).astype(np.float32)
+    _check(lambda a, b: jnp.sum(ops.math.cos_sim.raw_fn(a, b)),
+           [x, y], [0, 1])
+    big = (RNG.randn(4) * 3).astype(np.float32)
+    _check(lambda a: jnp.sum(jnp.square(
+        ops.math.clip_by_norm.raw_fn(a, 1.5))), [big], [0])
+
+
+def test_soft_relu_brelu_grad():
+    x = RNG.randn(8).astype(np.float32)
+    _check(lambda a: jnp.sum(F.soft_relu.raw_fn(a)), [x], [0])
+    # brelu is piecewise-linear; keep clear of the kinks
+    x2 = np.array([-2.0, 1.0, 5.0, 30.0], np.float32)
+    _check(lambda a: jnp.sum(F.brelu.raw_fn(a, 0.5, 24.0)), [x2], [0])
+
+
+def test_linear_chain_crf_grad():
+    B, L, T = 2, 3, 3
+    em = RNG.randn(B, L, T).astype(np.float32)
+    tr = (RNG.randn(T + 2, T) * 0.5).astype(np.float32)
+    label = RNG.randint(0, T, (B, L)).astype(np.int64)
+    lens = np.array([3, 2], np.int64)
+    _check(lambda e, t: -jnp.sum(linear_chain_crf.raw_fn(
+        e, t, jnp.asarray(label), jnp.asarray(lens))),
+        [em, tr], [0, 1])
+
+
+def test_teacher_student_loss_grad():
+    x = RNG.randn(4, 1).astype(np.float32)
+    lbl = RNG.rand(4, 1).astype(np.float32)
+    _check(lambda a: jnp.sum(
+        F.teacher_student_sigmoid_loss.raw_fn(a, jnp.asarray(lbl))),
+        [x], [0])

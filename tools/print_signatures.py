@@ -34,6 +34,7 @@ MODULES = [
     "paddle_tpu.quantization",
     "paddle_tpu.regularizer",
     "paddle_tpu.static",
+    "paddle_tpu.text",
     "paddle_tpu.utils",
     "paddle_tpu.vision",
 ]
