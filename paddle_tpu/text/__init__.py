@@ -19,6 +19,18 @@ import numpy as np
 from ..io.dataloader import Dataset as _Dataset
 
 
+class _RecordsDataset(_Dataset):
+    """Shared list-of-records base for the tuple-schema datasets."""
+
+    records: list
+
+    def __len__(self):
+        return len(self.records)
+
+    def __getitem__(self, idx):
+        return self.records[idx]
+
+
 def _stable_hash(word: str, mod: int) -> int:
     """Process-stable token hashing (python hash() is randomized per
     process via PYTHONHASHSEED, which would scramble saved embeddings)."""
@@ -156,7 +168,7 @@ class UCIHousing(_Dataset):
         return self.features[idx], self.target[idx]
 
 
-class Conll05st(_Dataset):
+class Conll05st(_RecordsDataset):
     """Semantic role labeling records (conll05.py): token ids, predicate
     position, BIO tag ids — the label_semantic_roles book-test schema."""
 
@@ -172,8 +184,91 @@ class Conll05st(_Dataset):
             tags = rng.randint(0, num_tags, n).astype(np.int64)
             self.records.append((words, np.int64(pred_pos), tags))
 
-    def __len__(self):
-        return len(self.records)
 
-    def __getitem__(self, idx):
-        return self.records[idx]
+class Movielens(_RecordsDataset):
+    """MovieLens rating records (movielens.py): (user_id, gender, age,
+    job, movie_id, categories, rating). With data_path, parses ml-1m
+    style ratings.dat lines (UserID::MovieID::Rating::Timestamp);
+    gender/age/job/categories are synthesized when no user/movie
+    metadata accompanies the ratings file."""
+
+    def __init__(self, data_path: Optional[str] = None, mode="train",
+                 synthetic_size=1024, num_users=500, num_movies=800,
+                 num_categories=18, seed=0):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.records = []
+        if data_path and os.path.exists(data_path):
+            with open(data_path) as f:
+                for line in f:
+                    parts = line.strip().split("::")
+                    if len(parts) < 3:
+                        continue
+                    u, m, r = int(parts[0]), int(parts[1]), float(parts[2])
+                    self.records.append((
+                        np.int64(u), np.int64(rng.randint(0, 2)),
+                        np.int64(rng.randint(0, 7)),
+                        np.int64(rng.randint(0, 21)), np.int64(m),
+                        rng.randint(0, num_categories, 3).astype(np.int64),
+                        np.float32(r)))
+            return
+        # latent-factor synthetic ratings so recommenders can learn
+        u_f = rng.randn(num_users, 4)
+        m_f = rng.randn(num_movies, 4)
+        for _ in range(synthetic_size):
+            u = int(rng.randint(0, num_users))
+            m = int(rng.randint(0, num_movies))
+            rating = float(np.clip(2.5 + u_f[u] @ m_f[m], 1.0, 5.0))
+            self.records.append((
+                np.int64(u), np.int64(rng.randint(0, 2)),
+                np.int64(rng.randint(0, 7)), np.int64(rng.randint(0, 21)),
+                np.int64(m),
+                rng.randint(0, num_categories, 3).astype(np.int64),
+                np.float32(rating)))
+
+
+class WMT16(_RecordsDataset):
+    """Translation pairs (wmt16.py): (src_ids, trg_in, trg_out) with
+    BOS/EOS framing. With data_path, reads tab-separated parallel lines
+    ("source\ttarget", stable-hashed token ids). Synthetic mode emits an
+    invertible toy mapping (target = source reversed, remapped into the
+    non-reserved target id range) so seq2seq models can overfit it."""
+
+    BOS, EOS, PAD = 1, 2, 0
+
+    def __init__(self, data_path: Optional[str] = None, mode="train",
+                 src_vocab_size=1000, trg_vocab_size=1000, max_len=16,
+                 synthetic_size=512, seed=0):
+        if trg_vocab_size < 4 or src_vocab_size < 4:
+            raise ValueError("vocab sizes must be >= 4 (3 reserved ids)")
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.records = []
+
+        def frame(src, trg):
+            trg_in = np.concatenate([[self.BOS], trg]).astype(np.int64)
+            trg_out = np.concatenate([trg, [self.EOS]]).astype(np.int64)
+            self.records.append((src.astype(np.int64), trg_in, trg_out))
+
+        if data_path and os.path.exists(data_path):
+            with open(data_path, encoding="utf8", errors="ignore") as f:
+                for line in f:
+                    cols = line.rstrip("\n").split("\t")
+                    if len(cols) < 2:
+                        continue
+                    src = np.asarray(
+                        [3 + _stable_hash(w, src_vocab_size - 3)
+                         for w in cols[0].split()[:max_len]], np.int64)
+                    trg = np.asarray(
+                        [3 + _stable_hash(w, trg_vocab_size - 3)
+                         for w in cols[1].split()[:max_len]], np.int64)
+                    if len(src) and len(trg):
+                        frame(src, trg)
+            return
+        lo = min(3, max(1, max_len - 2))
+        hi = max(lo + 1, max_len - 1)
+        for _ in range(synthetic_size):
+            n = int(rng.randint(lo, hi))
+            src = rng.randint(3, src_vocab_size, n).astype(np.int64)
+            # reversed + remapped into [3, trg_vocab) so reserved
+            # PAD/BOS/EOS ids never appear mid-sequence
+            trg = 3 + (src[::-1] - 3) % (trg_vocab_size - 3)
+            frame(src, trg)

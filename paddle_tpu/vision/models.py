@@ -252,3 +252,110 @@ class MobileNetV2(nn.Layer):
 
 def mobilenet_v2(num_classes=1000, scale=1.0, in_channels=3):
     return MobileNetV2(num_classes, scale, in_channels)
+
+
+class SEBlock(nn.Layer):
+    """Squeeze-and-excitation channel gate (reference
+    dist_se_resnext.py squeeze_excitation)."""
+
+    def __init__(self, channels, reduction=16):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Linear(channels, max(channels // reduction, 1))
+        self.fc2 = nn.Linear(max(channels // reduction, 1), channels)
+
+    def forward(self, x):
+        from .. import ops
+
+        b, c = x.shape[0], x.shape[1]
+        s = ops.flatten(self.pool(x), 1)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.sigmoid(self.fc2(s))
+        return x * ops.reshape(s, [b, c, 1, 1])
+
+
+class SEBottleneckBlock(nn.Layer):
+    """ResNeXt bottleneck (grouped 3x3) + SE gate (reference
+    tests dist_se_resnext.py bottleneck_block)."""
+
+    expansion = 2
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 cardinality=32, reduction=16):
+        super().__init__()
+        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
+                               groups=cardinality, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(planes)
+        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1,
+                               bias_attr=False)
+        self.bn3 = nn.BatchNorm2D(planes * self.expansion)
+        self.se = SEBlock(planes * self.expansion, reduction)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.se(self.bn3(self.conv3(out)))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class SEResNeXt(nn.Layer):
+    """SE-ResNeXt-50 (32x4d flavor), the reference's flagship
+    distributed vision test model (dist_se_resnext.py)."""
+
+    def __init__(self, depth_cfg=(3, 4, 6, 3), cardinality=32,
+                 num_classes=1000, in_channels=3):
+        super().__init__()
+        self.cardinality = cardinality
+        self.inplanes = 64
+        self.conv1 = nn.Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(128, depth_cfg[0])
+        self.layer2 = self._make_layer(256, depth_cfg[1], stride=2)
+        self.layer3 = self._make_layer(512, depth_cfg[2], stride=2)
+        self.layer4 = self._make_layer(1024, depth_cfg[3], stride=2)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(1024 * SEBottleneckBlock.expansion, num_classes)
+
+    def _make_layer(self, planes, blocks, stride=1):
+        exp = SEBottleneckBlock.expansion
+        downsample = None
+        if stride != 1 or self.inplanes != planes * exp:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.inplanes, planes * exp, 1, stride=stride,
+                          bias_attr=False),
+                nn.BatchNorm2D(planes * exp),
+            )
+        layers = [SEBottleneckBlock(self.inplanes, planes, stride,
+                                    downsample, self.cardinality)]
+        self.inplanes = planes * exp
+        for _ in range(1, blocks):
+            layers.append(SEBottleneckBlock(self.inplanes, planes,
+                                            cardinality=self.cardinality))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        from .. import ops
+
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.layer3(x)
+        x = self.layer4(x)
+        x = self.avgpool(x)
+        x = ops.flatten(x, 1)
+        return self.fc(x)
+
+
+def se_resnext50_32x4d(num_classes=1000, **kw):
+    return SEResNeXt((3, 4, 6, 3), cardinality=32,
+                     num_classes=num_classes, **kw)

@@ -190,3 +190,52 @@ def test_imdb_trains_sentiment_model():
                          paddle.to_tensor(ds.labels)))
               for _ in range(25)]
     assert losses[-1] < losses[0] / 1.5, (losses[0], losses[-1])
+
+
+def test_movielens_and_wmt16_schemas():
+    import numpy as np
+
+    from paddle_tpu.text import WMT16, Movielens
+
+    ml = Movielens(synthetic_size=32)
+    u, g, a, j, m, cats, r = ml[0]
+    assert cats.shape == (3,) and 1.0 <= float(r) <= 5.0
+
+    wmt = WMT16(synthetic_size=16, max_len=10)
+    src, trg_in, trg_out = wmt[0]
+    assert trg_in[0] == WMT16.BOS and trg_out[-1] == WMT16.EOS
+    np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+    np.testing.assert_array_equal(trg_out[:-1], src[::-1])
+
+
+def test_movielens_wmt16_file_loading(tmp_path):
+    import numpy as np
+
+    from paddle_tpu.text import WMT16, Movielens
+
+    ml_file = tmp_path / "ratings.dat"
+    ml_file.write_text("1::10::4.0::978300760\n2::20::3.5::978300761\n")
+    ml = Movielens(data_path=str(ml_file))
+    assert len(ml) == 2
+    u, _, _, _, m, _, r = ml[0]
+    assert int(u) == 1 and int(m) == 10 and float(r) == 4.0
+
+    wmt_file = tmp_path / "pairs.tsv"
+    wmt_file.write_text("hello world\tbonjour monde\nhi\tsalut\n")
+    wmt = WMT16(data_path=str(wmt_file))
+    assert len(wmt) == 2
+    src, trg_in, trg_out = wmt[0]
+    assert len(src) == 2 and trg_in[0] == WMT16.BOS
+    assert (src >= 3).all() and (trg_out[:-1] >= 3).all()
+    # stable across constructions (crc32 hashing, not PYTHONHASHSEED)
+    np.testing.assert_array_equal(WMT16(data_path=str(wmt_file))[0][0], src)
+
+
+def test_wmt16_small_vocab_never_emits_reserved_ids():
+    from paddle_tpu.text import WMT16
+
+    wmt = WMT16(src_vocab_size=1000, trg_vocab_size=10, synthetic_size=64)
+    for src, trg_in, trg_out in wmt.records:
+        assert (trg_out[:-1] >= 3).all()
+    # tiny max_len doesn't crash
+    WMT16(max_len=4, synthetic_size=4)
