@@ -9,6 +9,6 @@ from .word2vec import SkipGram, NGramLM  # noqa: F401
 from .sentiment import SentimentLSTM  # noqa: F401
 from ..vision.models import (  # noqa: F401
     LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
-    VGG, vgg16, vgg19, MobileNetV2, mobilenet_v2, SEResNeXt,
-    se_resnext50_32x4d,
+    VGG, vgg16, vgg19, MobileNetV1, mobilenet_v1, MobileNetV2, mobilenet_v2,
+    SEResNeXt, se_resnext50_32x4d,
 )

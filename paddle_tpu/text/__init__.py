@@ -198,17 +198,24 @@ class Movielens(_RecordsDataset):
         rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
         self.records = []
         if data_path and os.path.exists(data_path):
+            # file ids are remapped into [0, num_users/num_movies) so an
+            # Embedding sized from the same constructor params never
+            # overflows; user/movie attributes are derived from the raw id
+            # (stable hash), so every record of a user agrees on them
             with open(data_path) as f:
                 for line in f:
                     parts = line.strip().split("::")
                     if len(parts) < 3:
                         continue
                     u, m, r = int(parts[0]), int(parts[1]), float(parts[2])
+                    uh = _stable_hash(f"user{u}", 1 << 30)
+                    mh = _stable_hash(f"movie{m}", 1 << 30)
                     self.records.append((
-                        np.int64(u), np.int64(rng.randint(0, 2)),
-                        np.int64(rng.randint(0, 7)),
-                        np.int64(rng.randint(0, 21)), np.int64(m),
-                        rng.randint(0, num_categories, 3).astype(np.int64),
+                        np.int64(u % num_users), np.int64(uh % 2),
+                        np.int64(uh // 2 % 7),
+                        np.int64(uh // 14 % 21), np.int64(m % num_movies),
+                        (np.array([mh, mh // 7, mh // 49])
+                         % num_categories).astype(np.int64),
                         np.float32(r)))
             return
         # latent-factor synthetic ratings so recommenders can learn
@@ -272,3 +279,44 @@ class WMT16(_RecordsDataset):
             # PAD/BOS/EOS ids never appear mid-sequence
             trg = 3 + (src[::-1] - 3) % (trg_vocab_size - 3)
             frame(src, trg)
+
+
+class WMT14(WMT16):
+    """WMT'14 en→fr translation pairs (reference hapi/datasets/wmt14.py:41).
+    Same (src_ids, trg_in, trg_out) triple schema as [WMT16]; the reference
+    differs only in corpus + a single shared dict_size for both vocabs
+    (wmt14.py:89 __init__(dict_size)), mirrored here."""
+
+    def __init__(self, data_path=None, mode="train", dict_size=1000,
+                 max_len=16, synthetic_size=512, seed=14):
+        super().__init__(data_path, mode, src_vocab_size=dict_size,
+                         trg_vocab_size=dict_size, max_len=max_len,
+                         synthetic_size=synthetic_size, seed=seed)
+
+
+class MovieReviews(_RecordsDataset):
+    """NLTK movie-review sentiment records (reference
+    hapi/datasets/movie_reviews.py:39): (token_ids, label) with label
+    0=negative 1=positive. File mode reads one `label<TAB>text` line per
+    document; synthetic mode reuses the learnable Imdb rule."""
+
+    def __init__(self, data_path: Optional[str] = None, mode="train",
+                 vocab_size=5000, max_len=64, synthetic_size=512, seed=3):
+        self.vocab_size = vocab_size
+        self.records = []
+        if data_path and os.path.exists(data_path):
+            with open(data_path, encoding="utf8", errors="ignore") as f:
+                for line in f:
+                    cols = line.rstrip("\n").split("\t", 1)
+                    if len(cols) != 2:
+                        continue
+                    ids = np.asarray(
+                        [1 + _stable_hash(w, vocab_size - 1)
+                         for w in cols[1].split()[:max_len]], np.int64)
+                    if len(ids):
+                        self.records.append((ids, np.int64(int(cols[0]))))
+            return
+        inner = Imdb(None, mode, synthetic_size=synthetic_size,
+                     vocab_size=vocab_size, max_len=max_len, seed=seed)
+        for i in range(len(inner)):
+            self.records.append(inner[i])

@@ -8,7 +8,9 @@ from __future__ import annotations
 
 import gzip
 import os
+import pickle
 import struct
+import tarfile
 
 import numpy as np
 
@@ -48,6 +50,275 @@ class MNIST(Dataset):
 
     def __len__(self):
         return len(self.labels)
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 (reference hapi/datasets/cifar.py:41 Cifar10). Loads the
+    cifar-10-python.tar.gz pickle batches when given a path; otherwise a
+    deterministic synthetic sample with the same (3072,) uint8 rows."""
+
+    _n_classes = 10
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, synthetic_size=1024):
+        assert mode in ("train", "test")
+        self.mode = mode
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self._load_archive(data_file, mode)
+        else:
+            n = synthetic_size if mode == "train" else synthetic_size // 4
+            base = np.random.RandomState(7).rand(
+                self._n_classes, 3072).astype(np.float32)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, self._n_classes, n).astype(np.int64)
+            noise = rng.rand(n, 3072).astype(np.float32) * 0.4
+            self.data = (base[self.labels % self._n_classes] * 255 * 0.6 +
+                         noise * 255).astype(np.uint8)
+
+    def _member_flag(self):
+        # cifar.py:33 MODE_FLAG_MAP: train10→data_batch, test10→test_batch
+        return "data_batch" if self.mode == "train" else "test_batch"
+
+    def _load_archive(self, path, mode):
+        flag = self._member_flag()
+        rows, labels = [], []
+        with tarfile.open(path) as tf:
+            for member in sorted(tf.getmembers(), key=lambda m: m.name):
+                if flag not in member.name:
+                    continue
+                batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                rows.append(np.asarray(batch[b"data"], np.uint8))
+                labels.extend(batch[self._label_key])
+        self.data = np.concatenate(rows, axis=0)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].reshape(3, 32, 32).astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 (cifar.py Cifar100): fine labels, train/test members."""
+
+    _n_classes = 100
+    _label_key = b"fine_labels"
+
+    def _member_flag(self):
+        return "train" if self.mode == "train" else "test"
+
+
+class Flowers(Dataset):
+    """Oxford Flowers-102 (hapi/datasets/flowers.py:42). File path loads the
+    102flowers jpg tar + .mat annotations when scipy/PIL are present;
+    synthetic fallback keeps the (image HWC uint8, [label] int64) schema."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 synthetic_size=256, image_size=(64, 64)):
+        assert mode in ("train", "valid", "test")
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self._load_anno(data_file, label_file, setid_file, mode)
+        else:
+            n = synthetic_size if mode == "train" else synthetic_size // 4
+            rng = np.random.RandomState({"train": 0, "valid": 1,
+                                         "test": 2}[mode])
+            h, w = image_size
+            self.labels = rng.randint(1, 103, n).astype(np.int64)
+            self.images = rng.randint(0, 256, (n, h, w, 3)).astype(np.uint8)
+
+    def _load_anno(self, data_file, label_file, setid_file, mode):
+        import io as _io
+
+        from PIL import Image
+        import scipy.io as scio
+        flag = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        labels = scio.loadmat(label_file)["labels"][0]
+        indexes = scio.loadmat(setid_file)[flag][0]
+        self.images, self.labels = [], []
+        with tarfile.open(data_file) as tar:
+            name2mem = {m.name: m for m in tar.getmembers()}
+            for index in indexes:
+                ele = name2mem["jpg/image_%05d.jpg" % index]
+                raw = tar.extractfile(ele).read()
+                self.images.append(np.array(Image.open(_io.BytesIO(raw))))
+                self.labels.append(int(labels[index - 1]))
+        self.labels = np.asarray(self.labels, np.int64)
+
+    def __getitem__(self, idx):
+        image = self.images[idx]
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, np.array([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (hapi/datasets/voc2012.py:40):
+    (image HWC, label mask HW). Synthetic fallback emits blob masks."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, synthetic_size=64, image_size=(64, 64)):
+        assert mode in ("train", "valid", "test")
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self._load_archive(data_file, mode)
+        else:
+            n = synthetic_size if mode == "train" else synthetic_size // 4
+            rng = np.random.RandomState({"train": 0, "valid": 1,
+                                         "test": 2}[mode])
+            h, w = image_size
+            self.images = rng.randint(0, 256, (n, h, w, 3)).astype(np.uint8)
+            # each mask: one rectangular object of a random class on bg 0
+            self.masks = np.zeros((n, h, w), np.uint8)
+            for i in range(n):
+                cls = rng.randint(1, 21)
+                y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+                self.masks[i, y0:y0 + h // 2, x0:x0 + w // 2] = cls
+
+    def _load_archive(self, path, mode):
+        import io as _io
+
+        from PIL import Image
+        flag = {"train": "train", "valid": "val", "test": "trainval"}[mode]
+        voc = "VOCdevkit/VOC2012"
+        self.images, self.masks = [], []
+        with tarfile.open(path) as tar:
+            name2mem = {m.name: m for m in tar.getmembers()}
+            sets = tar.extractfile(
+                name2mem[f"{voc}/ImageSets/Segmentation/{flag}.txt"])
+            for line in sets:
+                stem = line.strip().decode("utf-8")
+                img = tar.extractfile(
+                    name2mem[f"{voc}/JPEGImages/{stem}.jpg"]).read()
+                lab = tar.extractfile(
+                    name2mem[f"{voc}/SegmentationClass/{stem}.png"]).read()
+                self.images.append(np.array(Image.open(_io.BytesIO(img))))
+                self.masks.append(np.array(Image.open(_io.BytesIO(lab))))
+
+    def __getitem__(self, idx):
+        image, label = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def has_valid_extension(filename, extensions):
+    return filename.lower().endswith(tuple(extensions))
+
+
+def _default_loader(path):
+    if path.lower().endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with open(path, "rb") as f:
+        return np.array(Image.open(f).convert("RGB"))
+
+
+def make_dataset(directory, class_to_idx, extensions, is_valid_file=None):
+    """(path, class_idx) list from root/class_x/*.ext layout
+    (hapi/datasets/folder.py make_dataset)."""
+    samples = []
+    directory = os.path.expanduser(directory)
+    if extensions is not None:
+        def is_valid_file(x):
+            return has_valid_extension(x, extensions)
+    for target in sorted(class_to_idx):
+        d = os.path.join(directory, target)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[target]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Generic root/class_a/x.ext loader (folder.py:80 DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes, class_to_idx = self._find_classes(root)
+        samples = make_dataset(root, class_to_idx, extensions, is_valid_file)
+        if not samples:
+            raise RuntimeError(f"Found 0 files in subfolders of: {root}")
+        self.loader = loader or _default_loader
+        self.extensions = extensions
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+        self.transform = transform
+
+    @staticmethod
+    def _find_classes(directory):
+        classes = sorted(e.name for e in os.scandir(directory) if e.is_dir())
+        return classes, {name: i for i, name in enumerate(classes)}
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.asarray(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/unlabelled image folder (folder.py ImageFolder): samples are
+    images only, no targets."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if extensions is not None:
+            def is_valid_file(x):
+                return has_valid_extension(x, extensions)
+        samples = []
+        for root_dir, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root_dir, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(f"Found 0 files in: {root}")
+        self.loader = loader or _default_loader
+        self.samples = samples
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
 
 
 class FakeImageNet(Dataset):

@@ -254,6 +254,55 @@ def mobilenet_v2(num_classes=1000, scale=1.0, in_channels=3):
     return MobileNetV2(num_classes, scale, in_channels)
 
 
+class _DepthwiseSeparable(nn.Layer):
+    """Depthwise 3x3 + pointwise 1x1 pair (reference
+    hapi/vision/models/mobilenetv1.py:72 DepthwiseSeparable)."""
+
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.conv = nn.Sequential(
+            nn.Conv2D(cin, cin, 3, stride=stride, padding=1, groups=cin,
+                      bias_attr=False),
+            nn.BatchNorm2D(cin), nn.ReLU(),
+            nn.Conv2D(cin, cout, 1, bias_attr=False),
+            nn.BatchNorm2D(cout), nn.ReLU(),
+        )
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class MobileNetV1(nn.Layer):
+    """MobileNetV1 (reference hapi/vision/models/mobilenetv1.py:105)."""
+
+    def __init__(self, num_classes=1000, scale=1.0, in_channels=3):
+        super().__init__()
+        def c(ch):
+            return max(int(ch * scale), 8)
+        cfg = [  # cin, cout, stride
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + [
+            (512, 1024, 2), (1024, 1024, 1)]
+        feats = [nn.Conv2D(in_channels, c(32), 3, stride=2, padding=1,
+                           bias_attr=False),
+                 nn.BatchNorm2D(c(32)), nn.ReLU()]
+        for cin, cout, s in cfg:
+            feats.append(_DepthwiseSeparable(c(cin), c(cout), s))
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.pool(x)
+        x = ops.flatten(x, 1)
+        return self.classifier(x)
+
+
+def mobilenet_v1(num_classes=1000, scale=1.0, in_channels=3):
+    return MobileNetV1(num_classes, scale, in_channels)
+
+
 class SEBlock(nn.Layer):
     """Squeeze-and-excitation channel gate (reference
     dist_se_resnext.py squeeze_excitation)."""

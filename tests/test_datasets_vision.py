@@ -1,0 +1,137 @@
+"""Vision/text dataset additions: Cifar, Flowers, VOC2012, folder
+loaders, WMT14, MovieReviews (reference incubate/hapi/datasets/*), and
+MobileNetV1."""
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets as vdatasets
+
+
+def test_cifar10_archive_roundtrip(tmp_path):
+    """File mode parses the cifar-10-python pickle-batch tar layout
+    (reference cifar.py _load_data)."""
+    rng = np.random.RandomState(0)
+    root = tmp_path / "cifar-10-batches-py"
+    root.mkdir()
+    for name, n in [("data_batch_1", 6), ("data_batch_2", 4),
+                    ("test_batch", 3)]:
+        batch = {b"data": rng.randint(0, 256, (n, 3072)).astype(np.uint8),
+                 b"labels": rng.randint(0, 10, n).tolist()}
+        with open(root / name, "wb") as f:
+            pickle.dump(batch, f)
+    tar_path = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(root, arcname="cifar-10-batches-py")
+
+    train = vdatasets.Cifar10(str(tar_path), mode="train")
+    test = vdatasets.Cifar10(str(tar_path), mode="test")
+    assert len(train) == 10 and len(test) == 3
+    img, label = train[0]
+    assert img.shape == (3, 32, 32) and img.dtype == np.float32
+    assert 0 <= int(label) < 10
+
+
+def test_cifar_synthetic_schema():
+    c10 = vdatasets.Cifar10()
+    c100 = vdatasets.Cifar100(mode="test")
+    img, label = c10[0]
+    assert img.shape == (3, 32, 32)
+    assert img.min() >= 0.0 and img.max() <= 1.0
+    assert c100.labels.max() < 100
+    # deterministic across constructions
+    again, _ = vdatasets.Cifar10()[0]
+    np.testing.assert_array_equal(img, again)
+
+
+def test_flowers_and_voc_synthetic():
+    f = vdatasets.Flowers(mode="train", image_size=(32, 32))
+    img, label = f[3]
+    assert img.shape == (32, 32, 3) and label.shape == (1,)
+    assert 1 <= int(label[0]) <= 102
+    v = vdatasets.VOC2012(mode="valid", image_size=(32, 32))
+    img, mask = v[1]
+    assert img.shape == (32, 32, 3) and mask.shape == (32, 32)
+    assert mask.max() <= 20
+
+
+def _write_npy_tree(root, classes, per_class):
+    rng = np.random.RandomState(1)
+    for cls in classes:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            np.save(d / f"{i}.npy", rng.rand(4, 4, 3).astype(np.float32))
+
+
+def test_dataset_folder(tmp_path):
+    _write_npy_tree(tmp_path, ["cat", "dog"], 3)
+    ds = vdatasets.DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    sample, target = ds[0]
+    assert sample.shape == (4, 4, 3) and int(target) == 0
+    assert int(ds[5][1]) == 1
+    with pytest.raises(RuntimeError):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        vdatasets.DatasetFolder(str(empty))
+
+
+def test_image_folder(tmp_path):
+    _write_npy_tree(tmp_path, ["unlabelled"], 4)
+    ds = vdatasets.ImageFolder(str(tmp_path))
+    assert len(ds) == 4
+    (sample,) = ds[2]
+    assert sample.shape == (4, 4, 3)
+
+
+def test_wmt14_schema():
+    from paddle_tpu.text import WMT14
+    ds = WMT14(dict_size=200, synthetic_size=32)
+    src, trg_in, trg_out = ds[0]
+    assert trg_in[0] == ds.BOS and trg_out[-1] == ds.EOS
+    assert len(trg_in) == len(trg_out)
+    np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+    assert src.max() < 200 and trg_in.max() < 200
+    # deterministic across constructions
+    src2, _, _ = WMT14(dict_size=200, synthetic_size=32)[0]
+    np.testing.assert_array_equal(src, src2)
+
+
+def test_movie_reviews(tmp_path):
+    from paddle_tpu.text import MovieReviews
+    syn = MovieReviews(synthetic_size=16)
+    ids, label = syn[0]
+    assert ids.dtype == np.int64 and int(label) in (0, 1)
+    path = tmp_path / "reviews.tsv"
+    path.write_text("1\tgreat film truly great\n0\tawful boring mess\n")
+    ds = MovieReviews(str(path), vocab_size=100)
+    assert len(ds) == 2
+    assert int(ds[0][1]) == 1 and int(ds[1][1]) == 0
+    assert ds[0][0].max() < 100
+
+
+def test_mobilenet_v1_trains():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models import mobilenet_v1
+
+    paddle.seed(0)
+    net = mobilenet_v1(num_classes=4, scale=0.25)
+    opt = optimizer.Momentum(learning_rate=0.1,
+                             parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(4, 3, 32, 32).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (4,)).astype(np.int64))
+    losses = []
+    for _ in range(3):
+        logits = net(x)
+        loss = nn.functional.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
