@@ -40,7 +40,10 @@ def _infer_outputs(block: Block, op, out_slots: Dict[str, int]):
         arrs = []
         for n in names:
             desc = block._find_var_recursive(n)
-            shape = tuple(_DYN_SENTINEL if (s is None or s == -1) else s
+            # -k encodes "dynamic batch times static k" (see below), so a
+            # flatten/gather/reshape round-trip keeps its static factor
+            shape = tuple(_DYN_SENTINEL * (1 if s is None else -s)
+                          if (s is None or s < 0) else s
                           for s in (desc.shape or ()))
             arrs.append(jax.ShapeDtypeStruct(
                 shape, dtype_mod.convert_dtype(desc.dtype)))
@@ -55,9 +58,9 @@ def _infer_outputs(block: Block, op, out_slots: Dict[str, int]):
     for slot, names in op.outputs.items():
         structs = outs.get(slot, [])
         for name, st in zip(names, structs):
-            shape = tuple(-1 if (s >= _DYN_SENTINEL and
-                                 s % _DYN_SENTINEL == 0) else s
-                          for s in st.shape)
+            shape = tuple(-(s // _DYN_SENTINEL) if (s >= _DYN_SENTINEL and
+                                                    s % _DYN_SENTINEL == 0)
+                          else s for s in st.shape)
             if not block.has_var(name):
                 block.create_var(name=name, shape=shape,
                                  dtype=dtype_mod.dtype_name(st.dtype))
@@ -375,6 +378,16 @@ def elementwise_div(x, y, axis=-1, act=None, name=None):
                           {"axis": axis})
 
 
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _append_simple("elementwise_max", {"X": [x], "Y": [y]},
+                          {"axis": axis})
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _append_simple("elementwise_min", {"X": [x], "Y": [y]},
+                          {"axis": axis})
+
+
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     out = _append_simple("scale", {"X": [x]},
                          {"scale": float(scale), "bias": float(bias),
@@ -539,6 +552,36 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
         {"Logits": [logits], "Label": [label]},
         {"soft_label": soft_label}, out_slots=("Softmax", "Loss"))
     return (loss, sm) if return_softmax else loss
+
+
+def square_error_cost(input, label):
+    """(input - label)^2 per element (reference layers/loss.py
+    square_error_cost; operators/squared_l2_distance is the fused form —
+    composition keeps the kernel set minimal and XLA fuses it anyway)."""
+    diff = _append_simple("elementwise_sub", {"X": [input], "Y": [label]})
+    return _append_simple("square", {"X": [diff]})
+
+
+def cos_sim(X, Y):
+    """Row-wise cosine similarity, shape (N, 1) (reference layers/nn.py
+    cos_sim / operators/cos_sim_op.cc)."""
+    xy = _append_simple("reduce_sum",
+                        {"X": [_append_simple("elementwise_mul",
+                                              {"X": [X], "Y": [Y]})]},
+                        {"dim": [-1], "keep_dim": True})
+    xx = _append_simple("reduce_sum",
+                        {"X": [_append_simple("square", {"X": [X]})]},
+                        {"dim": [-1], "keep_dim": True})
+    yy = _append_simple("reduce_sum",
+                        {"X": [_append_simple("square", {"X": [Y]})]},
+                        {"dim": [-1], "keep_dim": True})
+    denom = _append_simple(
+        "elementwise_max",
+        {"X": [_append_simple("sqrt",
+                              {"X": [_append_simple("elementwise_mul",
+                                                    {"X": [xx], "Y": [yy]})]})],
+         "Y": [fill_constant([1], X.dtype, 1e-8)]})
+    return _append_simple("elementwise_div", {"X": [xy], "Y": [denom]})
 
 
 def accuracy(input, label, k=1, name=None):

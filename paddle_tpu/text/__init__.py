@@ -302,9 +302,13 @@ class MovieReviews(_RecordsDataset):
 
     def __init__(self, data_path: Optional[str] = None, mode="train",
                  vocab_size=5000, max_len=64, synthetic_size=512, seed=3):
+        assert mode in ("train", "test")
         self.vocab_size = vocab_size
         self.records = []
         if data_path and os.path.exists(data_path):
+            # deterministic 80/20 split by document index (the reference
+            # splits the nltk corpus per category movie_reviews.py:100)
+            docs = []
             with open(data_path, encoding="utf8", errors="ignore") as f:
                 for line in f:
                     cols = line.rstrip("\n").split("\t", 1)
@@ -314,7 +318,12 @@ class MovieReviews(_RecordsDataset):
                         [1 + _stable_hash(w, vocab_size - 1)
                          for w in cols[1].split()[:max_len]], np.int64)
                     if len(ids):
-                        self.records.append((ids, np.int64(int(cols[0]))))
+                        docs.append((ids, np.int64(int(cols[0]))))
+            if len(docs) < 5:       # too small to split meaningfully
+                self.records = docs
+            else:
+                self.records = [d for i, d in enumerate(docs)
+                                if (i % 5 == 4) == (mode == "test")]
             return
         inner = Imdb(None, mode, synthetic_size=synthetic_size,
                      vocab_size=vocab_size, max_len=max_len, seed=seed)

@@ -139,7 +139,14 @@ class Flowers(Dataset):
 
         from PIL import Image
         import scipy.io as scio
-        flag = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        if not (label_file and os.path.exists(label_file)) or \
+                not (setid_file and os.path.exists(setid_file)):
+            raise ValueError(
+                "Flowers file mode needs data_file, label_file "
+                "(imagelabels.mat) and setid_file (setid.mat) together")
+        # reference flowers.py:39: train uses the LARGER tstid split,
+        # test the 1020-image trnid split (deliberately swapped there)
+        flag = {"train": "tstid", "valid": "valid", "test": "trnid"}[mode]
         labels = scio.loadmat(label_file)["labels"][0]
         indexes = scio.loadmat(setid_file)[flag][0]
         self.images, self.labels = [], []
@@ -189,7 +196,8 @@ class VOC2012(Dataset):
         import io as _io
 
         from PIL import Image
-        flag = {"train": "train", "valid": "val", "test": "trainval"}[mode]
+        # reference voc2012.py:37 MODE_FLAG_MAP: train→trainval, test→train
+        flag = {"train": "trainval", "valid": "val", "test": "train"}[mode]
         voc = "VOCdevkit/VOC2012"
         self.images, self.masks = [], []
         with tarfile.open(path) as tar:
