@@ -1,0 +1,599 @@
+"""dygraph-to-static AST engine (reference fluid/dygraph/dygraph_to_static/:
+ast_transformer.py, ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py, convert_operators.py — 23 modules).
+
+TPU-native redesign: instead of rewriting to Program ops executed by a C++
+while/conditional_block interpreter, the transformer rewrites Python
+control flow into calls of runtime `convert_*` helpers that dispatch on
+tensor-ness:
+
+- concrete values (eager/tape mode, or plain Python conditions under
+  trace) keep exact Python semantics;
+- traced tensors (inside jax.jit / TrainStep) lower to lax.cond /
+  lax.while_loop, which XLA compiles and jax.grad differentiates.
+
+This mirrors the reference's convert_ifelse / convert_while_loop /
+convert_logical_* runtime dispatch (convert_operators.py) while letting
+XLA replace the sub-block executor.
+
+Supported rewrites: `if` (branches without return/break/continue),
+`while` (body without return/break/continue), `for ... in range(...)`
+(desugared to while), `and`/`or`/`not`. Anything else is left as plain
+Python — correct for concrete values, and a clear jax TracerBoolConversion
+error points at the unsupported tensor-dependent construct.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+
+__all__ = [
+    "ast_transform", "convert_ifelse", "convert_while",
+    "convert_logical_and", "convert_logical_or", "convert_logical_not",
+    "ProgramTranslator", "enable_ast", "ast_enabled", "UNDEF",
+    "max_loop_iters",
+]
+
+
+class _Undefined:
+    """Sentinel for 'name not bound on this path' (reference
+    variable_trans_func.py create_undefined_variable)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<paddle_tpu.dy2static.UNDEF>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is undefined on the branch/loop path that produced "
+            "it (dy2static UNDEF sentinel)")
+
+
+UNDEF = _Undefined()
+
+_AST_ENABLED = True
+_MAX_LOOP_ITERS = [None]
+
+
+def enable_ast(flag: bool = True):
+    """Globally toggle AST conversion (ProgramTranslator.enable parity)."""
+    global _AST_ENABLED
+    _AST_ENABLED = bool(flag)
+
+
+class max_loop_iters:
+    """Context manager: bound tensor-dependent `while` loops to n
+    iterations, lowering them to a masked lax.scan instead of
+    lax.while_loop. The scan form is REVERSE-DIFFERENTIABLE (jax's
+    while_loop is not) at the cost of always running n steps; loops whose
+    true trip count exceeds n are silently truncated at n."""
+
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def __enter__(self):
+        self._prev = _MAX_LOOP_ITERS[0]
+        _MAX_LOOP_ITERS[0] = self.n
+        return self
+
+    def __exit__(self, *exc):
+        _MAX_LOOP_ITERS[0] = self._prev
+        return False
+
+
+def ast_enabled() -> bool:
+    return _AST_ENABLED
+
+
+class ProgramTranslator:
+    """API-parity facade (reference program_translator.py:ProgramTranslator
+    singleton with .enable())."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, flag: bool):
+        enable_ast(flag)
+
+
+# ---------------------------------------------------------------------------
+# runtime converters
+# ---------------------------------------------------------------------------
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        _raw, tree, is_leaf=lambda x: isinstance(x, (Tensor, _Undefined)))
+
+
+def _rewrap_like(arrays, template):
+    """Wrap arrays back into Tensors where the template had Tensors."""
+    flat_t, treedef = jax.tree_util.tree_flatten(
+        template, is_leaf=lambda x: isinstance(x, (Tensor, _Undefined)))
+    flat_a = jax.tree_util.tree_leaves(
+        arrays, is_leaf=lambda x: isinstance(x, _Undefined))
+    out = [Tensor(a, stop_gradient=False) if isinstance(t, Tensor) else a
+           for a, t in zip(flat_a, flat_t)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _pred_array(pred):
+    p = _raw(pred)
+    p = jnp.asarray(p)
+    if p.ndim:
+        p = p.reshape(())
+    return p.astype(jnp.bool_)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   init_vals: tuple = ()):
+    """Runtime `if` dispatch (reference convert_operators.py
+    convert_ifelse). Branch fns take the names assigned in either branch
+    as positional args (reference get_args/set_args pattern — reads of
+    unassigned names come via closure) and return them as a tuple."""
+    if not isinstance(pred, Tensor) and not isinstance(pred, jax.Array):
+        return true_fn(*init_vals) if pred else false_fn(*init_vals)
+    if not _is_traced(pred):
+        return (true_fn(*init_vals) if bool(_pred_array(pred))
+                else false_fn(*init_vals))
+
+    t_out = true_fn(*init_vals)
+    f_out = false_fn(*init_vals)
+    t_flat = jax.tree_util.tree_leaves(
+        t_out, is_leaf=lambda x: isinstance(x, _Undefined))
+    f_flat = jax.tree_util.tree_leaves(
+        f_out, is_leaf=lambda x: isinstance(x, _Undefined))
+    if len(t_flat) != len(f_flat):
+        raise ValueError(
+            "dy2static: both branches of a tensor-dependent `if` must "
+            "produce the same set of variables")
+    for a, b in zip(t_flat, f_flat):
+        if isinstance(a, _Undefined) != isinstance(b, _Undefined):
+            raise ValueError(
+                "dy2static: a variable assigned in only one branch of a "
+                "tensor-dependent `if` was used; assign it in both "
+                "branches (or before the if)")
+    # UNDEF-on-both-paths entries stay out of the cond operands
+    sel = [i for i, a in enumerate(t_flat)
+           if not isinstance(a, _Undefined)]
+    picked = jax.lax.cond(
+        _pred_array(pred),
+        lambda: tuple(_raw(t_flat[i]) for i in sel),
+        lambda: tuple(_raw(f_flat[i]) for i in sel))
+    out_flat = list(t_flat)
+    for slot, i in enumerate(sel):
+        out_flat[i] = (Tensor(picked[slot], stop_gradient=False)
+                       if isinstance(t_flat[i], Tensor) else picked[slot])
+    treedef = jax.tree_util.tree_structure(
+        t_out, is_leaf=lambda x: isinstance(x, (Tensor, _Undefined)))
+    return jax.tree_util.tree_unflatten(treedef, out_flat)
+
+
+def convert_while(test_fn: Callable, body_fn: Callable,
+                  init_vals: tuple):
+    """Runtime `while` dispatch (reference convert_while_loop). test/body
+    take the loop vars positionally; body returns them. Vars that are
+    UNDEF at entry are treated as per-iteration temporaries (not carried
+    through lax.while_loop)."""
+    first = test_fn(*init_vals)
+    if not _is_traced(first):
+        # concrete test: plain Python loop. Under jit this UNROLLS at
+        # trace time (traced body values are fine) — which also keeps the
+        # loop reverse-differentiable, unlike lax.while_loop. Only a
+        # traced test (truly data-dependent trip count) lowers to
+        # lax.while_loop below.
+        vals = init_vals
+        cond = first
+        while bool(_pred_array(cond)) if isinstance(
+                cond, (Tensor, jax.Array)) else cond:
+            vals = tuple(body_fn(*vals))
+            cond = test_fn(*vals)
+        return vals
+
+    carried_idx = [i for i, v in enumerate(init_vals)
+                   if not isinstance(v, _Undefined)]
+
+    def merge(carry):
+        vals = [UNDEF] * len(init_vals)
+        for slot, i in enumerate(carried_idx):
+            vals[i] = carry[slot]
+        return vals
+
+    def cond_w(carry):
+        return _pred_array(test_fn(*_rewrap_like(
+            merge(carry), merge(tuple(init_vals[i] for i in carried_idx)))))
+
+    def body_w(carry):
+        template = merge(tuple(init_vals[i] for i in carried_idx))
+        outs = body_fn(*_rewrap_like(merge(carry), template))
+        for i in carried_idx:
+            if isinstance(outs[i], _Undefined):
+                raise ValueError(
+                    "dy2static: loop variable became undefined inside a "
+                    "tensor-dependent while body")
+        return tuple(_unwrap_tree(outs[i]) for i in carried_idx)
+
+    init_carry = tuple(_unwrap_tree(init_vals[i]) for i in carried_idx)
+    # dtypes/shapes must be loop-invariant: promote weak-typed python
+    # scalars through one body round so the carry structure is stable
+    proto = body_w(init_carry)
+    init_carry = tuple(
+        jnp.asarray(a, getattr(p, "dtype", None)) if hasattr(p, "dtype")
+        else a for a, p in zip(init_carry, proto))
+    if _MAX_LOOP_ITERS[0] is not None:
+        # bounded differentiable form: masked scan over n steps — inactive
+        # steps carry values through unchanged (select), so grads flow
+        def scan_step(carry, _):
+            vals = carry
+            active = cond_w(vals)
+            new_vals = body_w(vals)
+            vals = tuple(jnp.where(active, n, o)
+                         for n, o in zip(new_vals, vals))
+            return vals, None
+        final, _ = jax.lax.scan(scan_step, init_carry, None,
+                                length=_MAX_LOOP_ITERS[0])
+    else:
+        final = jax.lax.while_loop(cond_w, body_w, init_carry)
+    out = merge(final)
+    template = merge(tuple(init_vals[i] for i in carried_idx))
+    return tuple(_rewrap_like(out, template))
+
+
+def convert_logical_and(lhs_fn: Callable[[], Any], rhs_fn: Callable[[], Any]):
+    """`a and b` (reference convert_logical_and): Python operand-selection
+    semantics wherever a concrete truth value exists (incl. short-circuit
+    for plain-Python lhs); only a TRACED tensor operand collapses to a
+    boolean jnp.logical_and (both sides evaluated)."""
+    lhs = lhs_fn()
+    if not isinstance(lhs, (Tensor, jax.Array)):
+        return lhs and rhs_fn()
+    if not _is_traced(lhs):
+        # concrete tensor: python semantics — falsy selects lhs
+        return rhs_fn() if bool(_pred_array(lhs)) else lhs
+    rhs = rhs_fn()
+    out = jnp.logical_and(_pred_array(lhs),
+                          jnp.asarray(_pred_array(rhs))
+                          if isinstance(rhs, (Tensor, jax.Array))
+                          else bool(rhs))
+    return Tensor(out)
+
+
+def convert_logical_or(lhs_fn: Callable[[], Any], rhs_fn: Callable[[], Any]):
+    lhs = lhs_fn()
+    if not isinstance(lhs, (Tensor, jax.Array)):
+        return lhs or rhs_fn()
+    if not _is_traced(lhs):
+        return lhs if bool(_pred_array(lhs)) else rhs_fn()
+    rhs = rhs_fn()
+    out = jnp.logical_or(_pred_array(lhs),
+                         jnp.asarray(_pred_array(rhs))
+                         if isinstance(rhs, (Tensor, jax.Array))
+                         else bool(rhs))
+    return Tensor(out)
+
+
+def convert_logical_not(x):
+    if not isinstance(x, (Tensor, jax.Array)):
+        return not x
+    out = jnp.logical_not(_raw(x).astype(bool))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+# ---------------------------------------------------------------------------
+# AST analysis + rewriting
+# ---------------------------------------------------------------------------
+
+
+def _assigned_names(stmts: Sequence[ast.stmt]) -> List[str]:
+    """Names bound by a statement list (assignments, aug-assigns, for
+    targets, with-as) in first-seen order."""
+    seen, order = set(), []
+
+    def add(name):
+        if name not in seen:
+            seen.add(name)
+            order.append(name)
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                add(node.id)
+
+        def visit_FunctionDef(self, node):
+            add(node.name)      # binds the name; don't descend
+
+        def visit_AsyncFunctionDef(self, node):
+            add(node.name)
+
+        def visit_ClassDef(self, node):
+            add(node.name)
+
+        def visit_Lambda(self, node):
+            pass                # inner scope
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return order
+
+
+def _contains_escape(stmts: Sequence[ast.stmt]) -> bool:
+    """True if return/break/continue/yield occur at this loop/branch level
+    (not inside a nested function or nested loop for break/continue)."""
+
+    class F(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Yield(self, node):
+            self.found = True
+
+        def visit_YieldFrom(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    f = F()
+    for s in stmts:
+        f.visit(s)
+    return f.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=_name("_jst"), attr=fn_name, ctx=ast.Load())
+
+
+def _guarded_capture(names: List[str], prefix: str) -> List[ast.stmt]:
+    """try: _c0 = x\nexcept (NameError, UnboundLocalError): _c0 = UNDEF"""
+    out = []
+    for i, n in enumerate(names):
+        out.append(ast.Try(
+            body=[ast.Assign(targets=[_name(f"{prefix}{i}", ast.Store())],
+                             value=_name(n))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_name("NameError"),
+                                     _name("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[_name(f"{prefix}{i}", ast.Store())],
+                    value=_jst_attr("UNDEF"))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+def _tuple_of(names: List[str], ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+class _Dy2StaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.failures: List[str] = []
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # -- logical ops --------------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[-1]
+        for value in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=_jst_attr(fn),
+                args=[ast.Lambda(args=_no_args(), body=value),
+                      ast.Lambda(args=_no_args(), body=expr)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_jst_attr("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+    # -- if -----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains_escape(node.body) or _contains_escape(node.orelse):
+            # python `if` kept as-is: fine for concrete preds; a tensor
+            # pred will raise TracerBoolConversionError pointing here
+            return node
+        uid = self._uid()
+        out_names = sorted(set(_assigned_names(node.body)) |
+                           set(_assigned_names(node.orelse)))
+        tb_name, fb_name = f"_jst_true_{uid}", f"_jst_false_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in out_names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+
+        def branch(fn_name, stmts):
+            body = list(stmts) if stmts else [ast.Pass()]
+            body.append(ast.Return(value=_tuple_of(out_names)))
+            return ast.FunctionDef(
+                name=fn_name, args=args, body=body,
+                decorator_list=[], returns=None)
+
+        init = _guarded_capture(out_names, f"_jst_c{uid}_")
+        call = ast.Call(
+            func=_jst_attr("convert_ifelse"),
+            args=[node.test, _name(tb_name), _name(fb_name),
+                  ast.Tuple(elts=[_name(f"_jst_c{uid}_{i}")
+                                  for i in range(len(out_names))],
+                            ctx=ast.Load())],
+            keywords=[])
+        if out_names:
+            assign = ast.Assign(
+                targets=[_tuple_of(out_names, ast.Store())], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [branch(tb_name, node.body),
+                branch(fb_name, node.orelse)] + init + [assign]
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains_escape(node.body):
+            return node
+        uid = self._uid()
+        loop_vars = _assigned_names(node.body)
+        if not loop_vars:
+            return node
+        t_name, b_name = f"_jst_wtest_{uid}", f"_jst_wbody_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in loop_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        test_fn = ast.FunctionDef(
+            name=t_name, args=args,
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None)
+        body_fn = ast.FunctionDef(
+            name=b_name, args=args,
+            body=list(node.body) + [ast.Return(value=_tuple_of(loop_vars))],
+            decorator_list=[], returns=None)
+        init = _guarded_capture(loop_vars, f"_jst_i{uid}_")
+        call = ast.Call(
+            func=_jst_attr("convert_while"),
+            args=[_name(t_name), _name(b_name),
+                  ast.Tuple(elts=[_name(f"_jst_i{uid}_{i}")
+                                  for i in range(len(loop_vars))],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(targets=[_tuple_of(loop_vars, ast.Store())],
+                            value=call)
+        return [test_fn, body_fn] + init + [assign]
+
+    # -- for over range() ---------------------------------------------------
+    def visit_For(self, node):
+        if (node.orelse or _contains_escape(node.body) or
+                not isinstance(node.target, ast.Name) or
+                not (isinstance(node.iter, ast.Call) and
+                     isinstance(node.iter.func, ast.Name) and
+                     node.iter.func.id == "range" and
+                     1 <= len(node.iter.args) <= 3 and
+                     not node.iter.keywords)):
+            self.generic_visit(node)
+            return node
+        uid = self._uid()
+        i_var = node.target.id
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(value=0), rargs[0], \
+                ast.Constant(value=1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(value=1)
+        else:
+            start, stop, step = rargs
+        stop_n, step_n = f"_jst_stop_{uid}", f"_jst_step_{uid}"
+        # i = start; while i < stop: body; i += step   (step sign handled
+        # only for positive python/tensor steps, matching range here when
+        # step > 0; negative constant steps use >)
+        comp_op = ast.Lt()
+        if isinstance(step, ast.Constant) and isinstance(step.value, int) \
+                and step.value < 0:
+            comp_op = ast.Gt()
+        # stop/step evaluate BEFORE the target is (re)bound — `for n in
+        # range(n)` must read the old n for its bound
+        new = [
+            ast.Assign(targets=[_name(stop_n, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(step_n, ast.Store())], value=step),
+            ast.Assign(targets=[_name(i_var, ast.Store())], value=start),
+            ast.While(
+                test=ast.Compare(left=_name(i_var), ops=[comp_op],
+                                 comparators=[_name(stop_n)]),
+                body=list(node.body) + [ast.AugAssign(
+                    target=_name(i_var, ast.Store()), op=ast.Add(),
+                    value=_name(step_n))],
+                orelse=[]),
+        ]
+        out = []
+        for s in new:
+            r = self.visit(s) if isinstance(s, ast.While) else s
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Rewrite `fn`'s tensor-dependent control flow into convert_* calls.
+    Returns the transformed function, or raises on untransformable input
+    (caller decides whether to fall back to pure tracing)."""
+    if fn.__closure__:
+        raise ValueError("dy2static: closures are not supported; pass "
+                         "state explicitly or use trace mode")
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ValueError("dy2static: expected a function definition")
+    fdef.decorator_list = []
+    transformer = _Dy2StaticTransformer()
+    new_tree = transformer.visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    import paddle_tpu.dy2static as _jst_mod
+    glb = dict(fn.__globals__)
+    glb["_jst"] = _jst_mod
+    exec(code, glb)
+    out = glb[fdef.name]
+    out = functools.wraps(fn)(out)
+    out.__dy2static_transformed__ = True
+    return out

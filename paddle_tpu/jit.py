@@ -65,13 +65,47 @@ class _FunctionalModel:
         return out_arrays, new_buffers
 
 
+_ast_cache = {}
+
+
+def _maybe_ast(fn):
+    """AST-rewrite tensor-dependent Python control flow (dy2static) when
+    enabled; trace-only fallback otherwise. Mirrors the reference's
+    ProgramTranslator default-on behavior (program_translator.py).
+    Memoized per source function so repeated to_static(f) calls share one
+    transformed function (and so one _fn_compiled jit cache entry)."""
+    from . import dy2static
+
+    if not dy2static.ast_enabled():
+        return fn
+    if fn in _ast_cache:
+        return _ast_cache[fn]
+    try:
+        out = dy2static.ast_transform(fn)
+    except (OSError, TypeError, ValueError, SyntaxError) as e:
+        try:
+            fn.__dy2static_fallback_reason__ = str(e)
+        except (AttributeError, TypeError):
+            pass
+        out = fn
+    _ast_cache[fn] = out
+    return out
+
+
 def to_static(layer_or_fn=None, input_spec=None, **jit_kwargs):
-    """Compile a Layer's forward (or a function over Tensors) with jax.jit."""
+    """Compile a Layer's forward (or a function over Tensors) with jax.jit.
+    Python `if`/`while`/`for range()` over traced Tensors are first
+    AST-rewritten to lax control flow (see paddle_tpu.dy2static)."""
     if layer_or_fn is None:
         return functools.partial(to_static, input_spec=input_spec, **jit_kwargs)
     if isinstance(layer_or_fn, Layer):
-        return CompiledLayer(layer_or_fn, **jit_kwargs)
-    fn = layer_or_fn
+        layer = layer_or_fn
+        fwd = type(layer).forward
+        converted = _maybe_ast(fwd)
+        if converted is not fwd:
+            layer.forward = converted.__get__(layer)
+        return CompiledLayer(layer, **jit_kwargs)
+    fn = _maybe_ast(layer_or_fn)
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):

@@ -62,16 +62,35 @@ def _dot(a, b, trans_b=False):
     return jax.lax.dot_general(a, b, dims, preferred_element_type=_F32)
 
 
+def _keep_mask(seed, row, qi, j, shape, dropout_p):
+    """Regenerable per-tile dropout keep-mask from the TPU hardware PRNG.
+    Seeding with (seed, row, q_tile, kv_tile) makes the mask a pure
+    function of tile coordinates, so forward and both backward kernels
+    reproduce identical bits without any HBM mask tensor."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    # Mosaic takes at most 2 seed words: fold the tile coordinates into
+    # one (collision-free: row < 2^15 batch*head rows, <=2^8 tiles per
+    # axis — enforced by _pallas_ok's seq/shape ceilings)
+    pltpu.prng_seed(seed, (row << 16) + (qi << 8) + j)
+    bits = jax.lax.bitcast_convert_type(
+        pltpu.prng_random_bits(shape), jnp.uint32)
+    threshold = jnp.uint32(min(int(dropout_p * (1 << 32)), (1 << 32) - 1))
+    return bits >= threshold
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, kv_len,
-                      block_kv, sm_scale, causal, q_block, masked=False):
+                      block_kv, sm_scale, causal, q_block, masked=False,
+                      dropout_p=0.0):
     from jax.experimental import pallas as pl
 
-    if masked:
-        mask_ref, o_ref, lse_ref = rest
-    else:
-        (o_ref, lse_ref), mask_ref = rest, None
+    rest = list(rest)
+    mask_ref = rest.pop(0) if masked else None
+    seed_ref = rest.pop(0) if dropout_p > 0.0 else None
+    o_ref, lse_ref = rest
     q = q_ref[...].astype(_F32) * sm_scale       # (bq, d)
     bq = q.shape[0]
+    row = pl.program_id(0)
     qi = pl.program_id(1)
     num_kv = kv_len // block_kv
 
@@ -92,7 +111,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, kv_len,
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
+        # dropout hits only the value accumulation; the normalizer l uses
+        # the undropped p, so out = dropout(softmax(s)) @ v exactly
         l_new = alpha * l + jnp.sum(p, axis=1)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0, 0], row, qi, j,
+                              (bq, block_kv), dropout_p)
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         acc_new = acc * alpha[:, None] + _dot(p, v)
         return m_new, l_new, acc_new
 
@@ -117,18 +142,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, kv_len,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *rest, kv_len, block_kv, sm_scale, causal,
-                         q_block, masked=False):
+                         q_block, masked=False, dropout_p=0.0):
     from jax.experimental import pallas as pl
 
-    if masked:
-        mask_ref, dq_ref = rest
-    else:
-        (dq_ref,), mask_ref = rest, None
+    rest = list(rest)
+    mask_ref = rest.pop(0) if masked else None
+    seed_ref = rest.pop(0) if dropout_p > 0.0 else None
+    (dq_ref,) = rest
     q = q_ref[...].astype(_F32) * sm_scale       # (bq, d)
     do = do_ref[...].astype(_F32)
     lse = lse_ref[0, :]                          # (bq,)
     delta = delta_ref[0, :]                      # (bq,)
     bq = q.shape[0]
+    row = pl.program_id(0)
     qi = pl.program_id(1)
     num_kv = kv_len // block_kv
 
@@ -147,6 +173,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])            # (bq, bkv)
         dp = _dot(do, v, trans_b=True)           # (bq, bkv)
+        if dropout_p > 0.0:
+            # same tile coordinates as forward -> identical keep mask;
+            # delta = rowsum(do*out) already equals <dp_dropped, p>
+            keep = _keep_mask(seed_ref[0, 0], row, qi, j,
+                              (bq, block_kv), dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta[:, None])
         return dq + _dot(ds, k)                  # grad wrt scaled q
 
@@ -160,16 +192,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           *rest, q_len, block_q, sm_scale,
-                          causal, kv_block, masked=False):
+                          causal, kv_block, masked=False, dropout_p=0.0):
     from jax.experimental import pallas as pl
 
-    if masked:
-        mask_ref, dk_ref, dv_ref = rest
-    else:
-        (dk_ref, dv_ref), mask_ref = rest, None
+    rest = list(rest)
+    mask_ref = rest.pop(0) if masked else None
+    seed_ref = rest.pop(0) if dropout_p > 0.0 else None
+    dk_ref, dv_ref = rest
     k = k_ref[...].astype(_F32)                  # (bkv, d)
     v = v_ref[...].astype(_F32)
     bkv = k.shape[0]
+    row = pl.program_id(0)
     kj = pl.program_id(1)
     num_q = q_len // block_q
 
@@ -190,8 +223,16 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, bkv), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + _dot(p.T, do)
         dp = _dot(do, v, trans_b=True)
+        if dropout_p > 0.0:
+            # (row, q_tile=i, kv_tile=kj) matches the forward's seeding
+            keep = _keep_mask(seed_ref[0, 0], row, i, kj,
+                              (block_q, bkv), dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            dv = dv + _dot(jnp.where(keep, p * inv, 0.0).T, do)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            dv = dv + _dot(p.T, do)
         ds = p * (dp - delta[:, None])
         dk = dk + _dot(ds.T, q)                  # q already scaled
         return dk, dv
@@ -224,7 +265,7 @@ def _splitheads(x, b, h):
 
 
 def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
-              mask_bias=None, heads=1):
+              mask_bias=None, heads=1, dropout_p=0.0, seed=None):
     from jax.experimental import pallas as pl
 
     bh, ql, d = qm.shape
@@ -243,10 +284,13 @@ def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
         in_specs.append(pl.BlockSpec((None, 1, kl),
                                      lambda i, j: (i // heads, 0, 0)))
         operands.append(mask_bias)
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0)))
+        operands.append(seed)
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, kv_len=kl, block_kv=block_kv,
                           sm_scale=sm_scale, causal=causal, q_block=block_q,
-                          masked=masked),
+                          masked=masked, dropout_p=dropout_p),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -276,7 +320,7 @@ def _flash_attention_core_fwd(q, k, v, causal, block_q, block_kv):
 
 
 def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
-              sm_scale, mask_bias=None, heads=1):
+              sm_scale, mask_bias=None, heads=1, dropout_p=0.0, seed=None):
     from jax.experimental import pallas as pl
 
     bh, ql, d = qm.shape
@@ -296,10 +340,14 @@ def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
         dq_specs.append(pl.BlockSpec((None, 1, kl),
                                      lambda i, j: (i // heads, 0, 0)))
         dq_ops.append(mask_bias)
+    if dropout_p > 0.0:
+        dq_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0)))
+        dq_ops.append(seed)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, kv_len=kl,
                           block_kv=block_kv, sm_scale=sm_scale,
-                          causal=causal, q_block=block_q, masked=masked),
+                          causal=causal, q_block=block_q, masked=masked,
+                          dropout_p=dropout_p),
         grid=(bh, ql // block_q),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
@@ -320,10 +368,14 @@ def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
             pl.BlockSpec((None, 1, block_kv),
                          lambda i, j: (i // heads, 0, j)))
         dkv_ops.append(mask_bias)
+    if dropout_p > 0.0:
+        dkv_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0)))
+        dkv_ops.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, q_len=ql, block_q=block_q,
                           sm_scale=sm_scale, causal=causal,
-                          kv_block=block_kv, masked=masked),
+                          kv_block=block_kv, masked=masked,
+                          dropout_p=dropout_p),
         grid=(bh, kl // block_kv),
         in_specs=dkv_specs,
         out_specs=[
@@ -395,6 +447,53 @@ _flash_attention_core_masked.defvjp(_flash_attention_core_masked_fwd,
                                     _flash_attention_core_masked_bwd)
 
 
+# -- dropout variant: keep-mask generated in-kernel from the TPU PRNG ------
+# (replaces the XLA path's HBM-materialised (B, H, L, L) dropout mask; the
+# reference fuses attention+dropout similarly in bert_encoder_functor.cu)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_core_dropout(q, k, v, seed, causal, block_q, block_kv,
+                                  dropout_p):
+    out, _ = _flash_attention_core_dropout_fwd(q, k, v, seed, causal,
+                                               block_q, block_kv, dropout_p)
+    return out
+
+
+def _flash_attention_core_dropout_fwd(q, k, v, seed, causal, block_q,
+                                      block_kv, dropout_p):
+    b, ql, h, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    qm, km, vm = _mergeheads(q), _mergeheads(k), _mergeheads(v)
+    out_m, lse = _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
+                           dropout_p=dropout_p, seed=seed)
+    return _splitheads(out_m, b, h), (qm, km, vm, out_m, lse, seed, b, h)
+
+
+def _flash_attention_core_dropout_bwd(causal, block_q, block_kv, dropout_p,
+                                      res, dout):
+    import numpy as np
+
+    qm, km, vm, out_m, lse, seed, b, h = res
+    d = qm.shape[-1]
+    sm_scale = 1.0 / math.sqrt(d)
+    # barrier: a structurally-constant cotangent (e.g. grad of sum(out))
+    # otherwise constant-folds into the Mosaic kernel, which mis-lowers
+    # broadcast operands (observed on v5e: wrong dq/dk/dv for dout=ones)
+    dom = _mergeheads(jax.lax.optimization_barrier(dout))
+    delta = jnp.sum(dom.astype(_F32) * out_m.astype(_F32),
+                    axis=-1)[:, None, :]
+    dq, dk, dv = _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q,
+                           block_kv, sm_scale, dropout_p=dropout_p,
+                           seed=seed)
+    # integer seed: cotangent is the symbolic zero dtype float0
+    dseed = np.zeros(seed.shape, jax.dtypes.float0)
+    return (_splitheads(dq, b, h), _splitheads(dk, b, h),
+            _splitheads(dv, b, h), dseed)
+
+
+_flash_attention_core_dropout.defvjp(_flash_attention_core_dropout_fwd,
+                                     _flash_attention_core_dropout_bwd)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
                                              "block_kv"))
 def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
@@ -413,6 +512,19 @@ def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
                                         min(block_q, ql), min(block_kv, kl))
 
 
+@functools.partial(jax.jit, static_argnames=("causal", "dropout_p",
+                                             "block_q", "block_kv"))
+def _flash_attention_pallas_dropout(q, k, v, seed, dropout_p, causal=False,
+                                    block_q=256, block_kv=256):
+    ql, kl = q.shape[1], k.shape[1]
+    # blocks must DIVIDE the lengths (the grid floors otherwise, silently
+    # skipping tail tiles); this path admits seq % 128 == 0
+    bq = block_q if ql % block_q == 0 else 128
+    bkv = block_kv if kl % block_kv == 0 else 128
+    return _flash_attention_core_dropout(q, k, v, seed, causal, bq, bkv,
+                                         dropout_p)
+
+
 def _kv_mask_bias(mask, batch, kv_len):
     """Normalise a BOOLEAN key-padding mask to an additive (batch, kv_len)
     bias, or None when ineligible: non-bool masks (e.g. learnable float
@@ -428,7 +540,7 @@ def _kv_mask_bias(mask, batch, kv_len):
     return jnp.where(m, 0.0, _NEG_INF).astype(_F32)
 
 
-def _pallas_ok(q, k, causal):
+def _pallas_ok(q, k, causal, seq_floor=256):
     import os
 
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1":
@@ -439,9 +551,15 @@ def _pallas_ok(q, k, causal):
     kl = k.shape[1]
     # MXU-friendly tiles; seq floor where the kernel beats XLA (short
     # sequences fuse fine in XLA), ceiling so K/V stay VMEM-resident
-    return (ql % 256 == 0 and kl % 256 == 0 and d % 64 == 0 and
+    return (ql % seq_floor == 0 and kl % seq_floor == 0 and d % 64 == 0 and
             d <= 256 and kl <= 8192 and ql <= 8192 and
             (not causal or ql == kl))
+
+
+def _rng_seed_arr(key_rng):
+    """(1, 1) int32 seed operand for the in-kernel PRNG from a jax key."""
+    bits = jax.random.bits(key_rng, (1, 1), jnp.uint32)
+    return jax.lax.bitcast_convert_type(bits, jnp.int32)
 
 
 def _local_attention(q, k, v, is_causal):
@@ -470,6 +588,18 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
                                   batch_axis=batch_axis,
                                   is_causal=is_causal, impl=impl)
         return _local_attention(q, k, v, is_causal)
+    if (mask is None and dropout_p > 0.0 and key_rng is not None and
+            q.shape[0] * q.shape[2] < (1 << 15) and
+            _pallas_ok(q, k, is_causal, seq_floor=128)):
+        # dropout rides the kernel's hardware PRNG — no HBM mask tensor
+        # (the XLA path materialises (B, H, L, L) keep masks); 128 floor:
+        # XLA-with-dropout is the alternative and loses earlier
+        try:
+            return _flash_attention_pallas_dropout(
+                q, k, v, _rng_seed_arr(key_rng), dropout_p,
+                causal=is_causal)
+        except Exception:
+            pass
     if mask is not None and dropout_p == 0.0 and _pallas_ok(q, k, is_causal):
         # key-padding masks ride the Pallas kernel as an additive kv bias;
         # per-query masks keep the XLA path

@@ -1,0 +1,257 @@
+"""dygraph-to-static AST engine (paddle_tpu/dy2static.py — reference
+dygraph_to_static/ ifelse/loop/logical transformers + convert_operators):
+tensor-dependent Python control flow must compile under jit via
+lax.cond/lax.while_loop, while concrete values keep Python semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import dy2static, nn
+from paddle_tpu.jit import to_static
+
+
+def t(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def test_convert_ifelse_concrete_and_python():
+    out = dy2static.convert_ifelse(True, lambda: (1,), lambda: (2,))
+    assert out == (1,)
+    out = dy2static.convert_ifelse(t(0.0) > 1.0, lambda: (t(1.0),),
+                                   lambda: (t(2.0),))
+    assert float(out[0].numpy()) == 2.0
+
+
+def test_if_on_tensor_under_jit():
+    @to_static
+    def f(x):
+        if (x.sum() > 0.0):
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    pos = f(t([1.0, 2.0]))
+    neg = f(t([-1.0, -2.0]))
+    np.testing.assert_allclose(pos.numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(neg.numpy(), [-2.0, -3.0])
+
+
+def test_if_else_missing_branch_var_errors():
+    @to_static
+    def f(x):
+        if (x.sum() > 0.0):
+            y = x * 2.0
+        else:
+            z = x - 1.0          # y undefined on this path
+        return y
+
+    with pytest.raises(ValueError, match="both branches"):
+        f(t([1.0]))
+
+
+def test_while_on_tensor_under_jit():
+    @to_static
+    def f(x):
+        s = x * 0.0
+        i = t(0.0)
+        while (i < 5.0):
+            s = s + x
+            i = i + 1.0
+        return s
+
+    out = f(t([2.0, 3.0]))
+    np.testing.assert_allclose(out.numpy(), [10.0, 15.0])
+
+
+def test_while_data_dependent_trip_count():
+    """Test depends on the traced input -> lowers to lax.while_loop
+    (forward-only: jax while_loop is not reverse-differentiable)."""
+    @to_static
+    def f(x):
+        while (x.sum() < 100.0):
+            x = x * 2.0
+        return x
+
+    out = f(t([1.0, 2.0]))          # 3 -> 6 -> ... -> 192
+    np.testing.assert_allclose(out.numpy(), [64.0, 128.0])
+    out = f(t([200.0, 0.0]))        # never enters
+    np.testing.assert_allclose(out.numpy(), [200.0, 0.0])
+
+
+def test_while_with_temporary_local():
+    @to_static
+    def f(x):
+        i = t(0.0)
+        acc = x * 0.0
+        while (i < 3.0):
+            delta = x + i        # per-iteration temporary, UNDEF at entry
+            acc = acc + delta
+            i = i + 1.0
+        return acc
+
+    out = f(t([1.0]))
+    np.testing.assert_allclose(out.numpy(), [6.0])  # (1+0)+(1+1)+(1+2)
+
+
+def test_for_range_python_and_nested_if():
+    @to_static
+    def f(x):
+        acc = x * 0.0
+        for k in range(4):
+            if (x.sum() > 0.0):
+                acc = acc + x
+            else:
+                acc = acc - x
+        return acc
+
+    np.testing.assert_allclose(f(t([1.0])).numpy(), [4.0])
+    np.testing.assert_allclose(f(t([-1.0])).numpy(), [4.0])
+
+
+def test_logical_ops():
+    @to_static
+    def f(x, y):
+        both = (x.sum() > 0.0) and (y.sum() > 0.0)
+        either = (x.sum() > 0.0) or (y.sum() > 0.0)
+        neither = not either
+        if both:
+            out = x + y
+        else:
+            out = x - y
+        return out, either, neither
+
+    out, either, neither = f(t([1.0]), t([2.0]))
+    np.testing.assert_allclose(out.numpy(), [3.0])
+    assert bool(either.numpy()) and not bool(neither.numpy())
+    out, either, neither = f(t([-1.0]), t([-2.0]))
+    np.testing.assert_allclose(out.numpy(), [1.0])
+    assert not bool(either.numpy()) and bool(neither.numpy())
+
+
+def test_mixed_and_or_value_semantics():
+    """Python operand-selection semantics for concrete values: `x or 5.0`
+    returns x when truthy; `x and 7.0` returns 7.0 (review regression)."""
+    x = t(3.0)
+    assert dy2static.convert_logical_or(lambda: x, lambda: 5.0) is x
+    assert dy2static.convert_logical_and(lambda: x, lambda: 7.0) == 7.0
+    zero = t(0.0)
+    assert dy2static.convert_logical_or(lambda: zero, lambda: 5.0) == 5.0
+    assert dy2static.convert_logical_and(lambda: zero, lambda: 7.0) is zero
+    # the `scale = scale or default` idiom survives transformation
+    def f(x, scale):
+        scale = scale or 2.0
+        return x * scale
+
+    fc = dy2static.ast_transform(f)
+    np.testing.assert_allclose(fc(t([3.0]), None).numpy(), [6.0])
+
+
+def test_for_range_target_shadows_bound():
+    """`for n in range(n)` must read the OLD n for its bound (review
+    regression: desugar used to clobber the bound first)."""
+    def h(n):
+        tot = 0
+        for n in range(n):
+            tot = tot + n
+        return tot
+
+    hc = dy2static.ast_transform(h)
+    assert hc(4) == 6
+
+
+def test_to_static_transform_is_memoized():
+    def f(x):
+        if (x.sum() > 0.0):
+            y = x
+        else:
+            y = -x
+        return y
+
+    a = to_static(f)
+    b = to_static(f)
+    from paddle_tpu.jit import _ast_cache
+    assert f in _ast_cache
+    assert a(t([2.0])).numpy() == b(t([2.0])).numpy()
+
+
+def test_python_short_circuit_preserved():
+    calls = []
+
+    def right():
+        calls.append(1)
+        return True
+
+    assert dy2static.convert_logical_and(lambda: False, right) is False
+    assert calls == []   # rhs never evaluated for Python lhs
+
+
+def test_eager_path_keeps_tape_gradients():
+    """Outside jit the converters take the Python branch, so the eager
+    tape still sees every op."""
+    def f(x):
+        if (x.sum() > 0.0):
+            return (x * 3.0).sum()
+        return (x * 5.0).sum()
+
+    fc = dy2static.ast_transform(f)
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    loss = fc(x)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_gradient_through_cond_and_while():
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+
+    class Gated(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if (h.sum() > 0.0):
+                out = h * 2.0
+            else:
+                out = h * 0.5
+            i = t(0.0)
+            while (i < 2.0):
+                out = out + h
+                i = i + 1.0
+            return out
+
+    paddle.seed(0)
+    model = Gated()
+    model.forward = dy2static.ast_transform(
+        type(model).forward).__get__(model)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+    step = TrainStep(model, lambda m, x: (m(x) ** 2).mean(), opt)
+    x = t(np.random.RandomState(0).randn(2, 4))
+    # the while trip count is tensor-dependent under trace; the bounded
+    # scan form makes it reverse-differentiable
+    with dy2static.max_loop_iters(4):
+        l0 = float(step(x))
+        for _ in range(5):
+            l1 = float(step(x))
+    assert l1 < l0
+
+
+def test_program_translator_toggle():
+    dy2static.ProgramTranslator().enable(False)
+    try:
+        def f(x):
+            if (x.sum() > 0.0):
+                y = x
+            else:
+                y = -x
+            return y
+
+        g = to_static(f)
+        # trace-only mode: tensor-dependent if raises jax's tracer error
+        with pytest.raises(Exception):
+            g(t([1.0]))
+    finally:
+        dy2static.ProgramTranslator().enable(True)
+    assert dy2static.ast_enabled()
