@@ -344,11 +344,15 @@ def _assigned_names(stmts: Sequence[ast.stmt]) -> List[str]:
 
 
 def _contains_escape(stmts: Sequence[ast.stmt]) -> bool:
-    """True if return/break/continue/yield occur at this loop/branch level
-    (not inside a nested function or nested loop for break/continue)."""
+    """True if return/yield occur anywhere at this level (incl. inside
+    nested loops), or break/continue occur OUTSIDE any nested loop —
+    a break belonging to an inner for/while doesn't block converting the
+    enclosing construct."""
 
     class F(ast.NodeVisitor):
-        found = False
+        def __init__(self, loop_depth=0):
+            self.loop_depth = loop_depth
+            self.found = False
 
         def visit_Return(self, node):
             self.found = True
@@ -360,10 +364,20 @@ def _contains_escape(stmts: Sequence[ast.stmt]) -> bool:
             self.found = True
 
         def visit_Break(self, node):
-            self.found = True
+            if self.loop_depth == 0:
+                self.found = True
 
         def visit_Continue(self, node):
-            self.found = True
+            if self.loop_depth == 0:
+                self.found = True
+
+        def _nested_loop(self, node):
+            inner = F(self.loop_depth + 1)
+            for s in ast.iter_child_nodes(node):
+                inner.visit(s)
+            self.found = self.found or inner.found
+
+        visit_For = visit_While = visit_AsyncFor = _nested_loop
 
         def visit_FunctionDef(self, node):
             pass
@@ -378,6 +392,17 @@ def _contains_escape(stmts: Sequence[ast.stmt]) -> bool:
     for s in stmts:
         f.visit(s)
     return f.found
+
+
+_MACHINERY_PREFIXES = ("_jst_true_", "_jst_false_", "_jst_wtest_",
+                       "_jst_wbody_", "_jst_c", "_jst_v")
+
+
+def _is_machinery_name(n: str) -> bool:
+    """Synthetic helper-function / capture-temp names from inner
+    transforms: never user loop state. The for-range counter/bounds
+    (_jst_it_/_jst_stop_/_jst_step_) ARE state and are NOT excluded."""
+    return n.startswith(_MACHINERY_PREFIXES)
 
 
 def _name(id_, ctx=None):
@@ -450,8 +475,12 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             # pred will raise TracerBoolConversionError pointing here
             return node
         uid = self._uid()
-        out_names = sorted(set(_assigned_names(node.body)) |
-                           set(_assigned_names(node.orelse)))
+        # synthetic _jst_* helpers from already-transformed inner
+        # constructs are branch-local machinery, not user variables
+        out_names = sorted(
+            n for n in (set(_assigned_names(node.body)) |
+                        set(_assigned_names(node.orelse)))
+            if not _is_machinery_name(n))
         tb_name, fb_name = f"_jst_true_{uid}", f"_jst_false_{uid}"
         args = ast.arguments(
             posonlyargs=[], args=[ast.arg(arg=n) for n in out_names],
@@ -487,7 +516,8 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         if node.orelse or _contains_escape(node.body):
             return node
         uid = self._uid()
-        loop_vars = _assigned_names(node.body)
+        loop_vars = [n for n in _assigned_names(node.body)
+                     if not _is_machinery_name(n)]
         if not loop_vars:
             return node
         t_name, b_name = f"_jst_wtest_{uid}", f"_jst_wbody_{uid}"
@@ -503,11 +533,11 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             name=b_name, args=args,
             body=list(node.body) + [ast.Return(value=_tuple_of(loop_vars))],
             decorator_list=[], returns=None)
-        init = _guarded_capture(loop_vars, f"_jst_i{uid}_")
+        init = _guarded_capture(loop_vars, f"_jst_v{uid}_")
         call = ast.Call(
             func=_jst_attr("convert_while"),
             args=[_name(t_name), _name(b_name),
-                  ast.Tuple(elts=[_name(f"_jst_i{uid}_{i}")
+                  ast.Tuple(elts=[_name(f"_jst_v{uid}_{i}")
                                   for i in range(len(loop_vars))],
                             ctx=ast.Load())],
             keywords=[])
@@ -537,25 +567,29 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         else:
             start, stop, step = rargs
         stop_n, step_n = f"_jst_stop_{uid}", f"_jst_step_{uid}"
-        # i = start; while i < stop: body; i += step   (step sign handled
-        # only for positive python/tensor steps, matching range here when
-        # step > 0; negative constant steps use >)
+        it_n = f"_jst_it_{uid}"
+        # internal counter drives the while; the user target is assigned
+        # at the top of each iteration, so after the loop it holds the
+        # LAST YIELDED value (Python semantics), not stop (step sign
+        # handled for constant negative steps via >)
         comp_op = ast.Lt()
         if isinstance(step, ast.Constant) and isinstance(step.value, int) \
                 and step.value < 0:
             comp_op = ast.Gt()
-        # stop/step evaluate BEFORE the target is (re)bound — `for n in
-        # range(n)` must read the old n for its bound
+        # stop/step/start evaluate BEFORE the target is (re)bound — `for
+        # n in range(n)` must read the old n for its bound
         new = [
             ast.Assign(targets=[_name(stop_n, ast.Store())], value=stop),
             ast.Assign(targets=[_name(step_n, ast.Store())], value=step),
-            ast.Assign(targets=[_name(i_var, ast.Store())], value=start),
+            ast.Assign(targets=[_name(it_n, ast.Store())], value=start),
             ast.While(
-                test=ast.Compare(left=_name(i_var), ops=[comp_op],
+                test=ast.Compare(left=_name(it_n), ops=[comp_op],
                                  comparators=[_name(stop_n)]),
-                body=list(node.body) + [ast.AugAssign(
-                    target=_name(i_var, ast.Store()), op=ast.Add(),
-                    value=_name(step_n))],
+                body=[ast.Assign(targets=[_name(i_var, ast.Store())],
+                                 value=_name(it_n))] + list(node.body) +
+                     [ast.AugAssign(
+                         target=_name(it_n, ast.Store()), op=ast.Add(),
+                         value=_name(step_n))],
                 orelse=[]),
         ]
         out = []

@@ -145,3 +145,200 @@ def accuracy(input, label, k=1, correct=None, total=None):
     from ..ops.math import accuracy_op
 
     return accuracy_op(input, label, k=k)
+
+
+MetricBase = Metric        # reference fluid/metrics.py:46 name
+
+
+class CompositeMetric(Metric):
+    """Hold several metrics updated with the same inputs (reference
+    fluid/metrics.py:219 CompositeMetric)."""
+
+    def __init__(self, name="composite"):
+        self._name = name
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, Metric):
+            raise ValueError("add_metric expects a Metric instance")
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args):
+        for m in self._metrics:
+            m.update(*args)
+
+    def accumulate(self):
+        return [m.accumulate() for m in self._metrics]
+
+    # fluid-era alias
+    def eval(self):
+        return self.accumulate()
+
+
+class EditDistance(Metric):
+    """Average Levenshtein distance over sequence pairs (reference
+    fluid/metrics.py:650 EditDistance). update() takes per-batch distances
+    and a per-batch count of (reference-)empty label sequences."""
+
+    def __init__(self, name="edit_distance"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None, instance_error=None):
+        d = _np(distances).astype(np.float64).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num) if seq_num is not None else len(d)
+        if instance_error is not None:
+            self.instance_error += int(instance_error)
+        else:
+            self.instance_error += int((d > 0).sum())
+
+    def accumulate(self):
+        if self.seq_num == 0:
+            raise ValueError("no data was updated")
+        avg = self.total_distance / self.seq_num
+        error_rate = self.instance_error / self.seq_num
+        return avg, error_rate
+
+    def eval(self):
+        return self.accumulate()
+
+
+class ChunkEvaluator(Metric):
+    """Precision/recall/F1 over chunk counts (reference fluid/metrics.py
+    :555 ChunkEvaluator: update(num_infer_chunks, num_label_chunks,
+    num_correct_chunks))."""
+
+    def __init__(self, name="chunk"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(_np(num_infer_chunks))
+        self.num_label_chunks += int(_np(num_label_chunks))
+        self.num_correct_chunks += int(_np(num_correct_chunks))
+
+    def accumulate(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+    def eval(self):
+        return self.accumulate()
+
+
+class DetectionMAP(Metric):
+    """Mean average precision for detection (reference fluid/metrics.py
+    :752 DetectionMAP / operators/detection/detection_map_op). Pure-host
+    accumulation: update() takes per-image predictions
+    [[label, score, x1, y1, x2, y2], ...] and ground truths
+    [[label, x1, y1, x2, y2], ...]; accumulate() returns mAP using
+    11-point or integral AP."""
+
+    def __init__(self, overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral", class_num=None, name="mAP"):
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self._name = name
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._preds = {}     # label -> list of (score, matched)
+        self._gt_count = {}  # label -> count
+
+    @staticmethod
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(0.0, ix2 - ix1), max(0.0, iy2 - iy1)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, predictions, ground_truths):
+        """predictions: rows of [label, score, x1, y1, x2, y2]; ground
+        truths: [label, x1, y1, x2, y2] or [label, x1, y1, x2, y2,
+        difficult]. With evaluate_difficult=False, difficult boxes are
+        excluded from the recall denominator and predictions matched to
+        them are ignored (VOC convention, detection_map_op.cc)."""
+        parr = _np(predictions)
+        preds = ([list(map(float, p)) for p in parr.reshape(-1, 6)]
+                 if parr.size else [])
+        garr = _np(ground_truths)
+        gcols = 6 if garr.size and garr.reshape(garr.shape[0], -1).shape[-1] == 6 else 5
+        gts = ([list(map(float, g)) for g in garr.reshape(-1, gcols)]
+               if garr.size else [])
+        difficult = [bool(g[5]) if gcols == 6 else False for g in gts]
+        for g, diff in zip(gts, difficult):
+            if self.evaluate_difficult or not diff:
+                self._gt_count[int(g[0])] = \
+                    self._gt_count.get(int(g[0]), 0) + 1
+        used = [False] * len(gts)
+        for p in sorted(preds, key=lambda r: -r[1]):
+            label, score, box = int(p[0]), p[1], p[2:6]
+            best, best_j = 0.0, -1
+            for j, g in enumerate(gts):
+                if int(g[0]) != label or used[j]:
+                    continue
+                ov = self._iou(box, g[1:5])
+                if ov > best:
+                    best, best_j = ov, j
+            matched = best >= self.overlap_threshold and best_j >= 0
+            if matched:
+                used[best_j] = True
+                if not self.evaluate_difficult and difficult[best_j]:
+                    continue            # ignore, neither TP nor FP
+            self._preds.setdefault(label, []).append((score, matched))
+
+    def accumulate(self):
+        aps = []
+        for label, count in self._gt_count.items():
+            entries = sorted(self._preds.get(label, []), key=lambda e: -e[0])
+            tp, fp, rec, prec = 0, 0, [], []
+            for score, matched in entries:
+                tp += int(matched)
+                fp += int(not matched)
+                rec.append(tp / count)
+                prec.append(tp / (tp + fp))
+            if not rec:
+                aps.append(0.0)
+                continue
+            if self.ap_version == "11point":
+                ap = sum(max([p for r, p in zip(rec, prec) if r >= t],
+                             default=0.0) for t in np.arange(0, 1.01, 0.1))
+                aps.append(ap / 11.0)
+            else:
+                ap, prev_r = 0.0, 0.0
+                for r, p in zip(rec, prec):
+                    ap += (r - prev_r) * p
+                    prev_r = r
+                aps.append(ap)
+        if not aps:
+            raise ValueError("no ground truth was updated")
+        return float(np.mean(aps))
+
+    def eval(self):
+        return self.accumulate()

@@ -73,3 +73,71 @@ def clip_grad_norm_(parameters, max_norm):
     for g in grads:
         g._value = g._value * scale
     return float(gnorm)
+
+
+class ErrorClipByValue:
+    """Per-variable backward error clipping (reference fluid/clip.py:46
+    ErrorClipByValue, attached to a var's error_clip and applied to its
+    gradient ops)."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op(type="clip", inputs={"X": [grad_name]},
+                        outputs={"Out": [grad_name]},
+                        attrs={"min": self.min, "max": self.max})
+
+
+def _is_static_pairs(params_grads):
+    from ..static.ir import Variable
+    return bool(params_grads) and isinstance(params_grads[0][1], Variable)
+
+
+def _eager_pairs(self, params_grads):
+    from ..framework.tensor import Tensor
+    arrs = self.apply_pytree([g._value for _, g in params_grads])
+    return [(p, Tensor(a)) for (p, _), a in zip(params_grads, arrs)]
+
+
+def _by_value_call(self, params_grads):
+    """(param, grad) pair form used by static Optimizer.minimize
+    (reference GradientClipBase: _static_clip vs _dygraph_clip)."""
+    if _is_static_pairs(params_grads):
+        from ..static import layers as L
+        return [(p, L.clip(g, self.min, self.max)) for p, g in params_grads]
+    return _eager_pairs(self, params_grads)
+
+
+def _by_norm_call(self, params_grads):
+    if _is_static_pairs(params_grads):
+        from ..static import layers as L
+        out = []
+        for p, g in params_grads:
+            norm = L.sqrt(L.reduce_sum(L.square(g)))
+            limit = L.fill_constant([1], g.dtype, self.clip_norm)
+            scale = L.elementwise_div(limit, L.elementwise_max(norm, limit))
+            out.append((p, L.elementwise_mul(g, scale)))
+        return out
+    return _eager_pairs(self, params_grads)
+
+
+def _by_global_norm_call(self, params_grads):
+    if _is_static_pairs(params_grads):
+        from ..static import layers as L
+        total = None
+        for _, g in params_grads:
+            s = L.reduce_sum(L.square(g))
+            total = s if total is None else L.elementwise_add(total, s)
+        limit = L.fill_constant([1], params_grads[0][1].dtype,
+                                self.clip_norm)
+        scale = L.elementwise_div(
+            limit, L.elementwise_max(L.sqrt(total), limit))
+        return [(p, L.elementwise_mul(g, scale)) for p, g in params_grads]
+    return _eager_pairs(self, params_grads)
+
+
+ClipGradByValue.__call__ = _by_value_call
+ClipGradByNorm.__call__ = _by_norm_call
+ClipGradByGlobalNorm.__call__ = _by_global_norm_call

@@ -42,6 +42,9 @@ from .layers import *  # noqa: F401,F403
 from .layers import data  # noqa: F401
 from .optimizer import (SGD, Adam, AdamOptimizer, Lamb,  # noqa: F401
                         LambOptimizer, Momentum, MomentumOptimizer,
-                        Optimizer, SGDOptimizer)
+                        Optimizer, SGDOptimizer, set_gradient_clip)
+from ..nn.clip import (ErrorClipByValue, GradientClipByGlobalNorm,  # noqa: F401
+                       GradientClipByNorm, GradientClipByValue)
 
 from . import layers as nn  # noqa: F401  (static.nn.fc style access)
+from . import nets  # noqa: F401

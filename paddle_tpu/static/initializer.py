@@ -105,14 +105,67 @@ class MSRA(Initializer):
                                    "mean": 0.0, "std": std}
 
 
+class NumpyArrayInitializer(Initializer):
+    """Initialize from a literal array (reference initializer.py
+    NumpyArrayInitializer → assign_value op)."""
+
+    def __init__(self, value):
+        import numpy as np
+
+        self.value = np.asarray(value)
+
+    def resolve(self, shape, dtype, fan_hint):
+        if tuple(self.value.shape) != tuple(shape):
+            raise ValueError(
+                f"NumpyArrayInitializer value shape {self.value.shape} "
+                f"does not match parameter shape {tuple(shape)}")
+        return "assign_value", {"shape": list(shape), "dtype": dtype,
+                                "values": self.value.reshape(-1).tolist()}
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed convs (reference
+    initializer.py BilinearInitializer); weight shape (C_out, C_in, H, W)."""
+
+    def resolve(self, shape, dtype, fan_hint):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        h, w = shape[2], shape[3]
+        f_h, f_w = (h + 1) // 2, (w + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        y = np.arange(h)[:, None]
+        x = np.arange(w)[None, :]
+        filt = ((1 - np.abs(y / f_h - c_h)) *
+                (1 - np.abs(x / f_w - c_w))).astype(np.float64)
+        weight = np.zeros(shape)
+        for i in range(shape[0]):
+            weight[i, i % shape[1]] = filt
+        return "assign_value", {"shape": list(shape), "dtype": dtype,
+                                "values": weight.reshape(-1).tolist()}
+
+
 KaimingUniform = MSRA
 XavierInitializer = Xavier
 ConstantInitializer = Constant
 NormalInitializer = Normal
 UniformInitializer = Uniform
+BilinearInitializer = Bilinear
+
+_global_initializer = [None, None]   # [weight_init, bias_init]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializer for parameters that do not specify one
+    (reference initializer.py set_global_initializer). Pass None, None
+    to reset."""
+    _global_initializer[0] = weight_init
+    _global_initializer[1] = bias_init
 
 
 def resolve_initializer(initializer, shape, dtype, fan_hint=None):
     if initializer is None:
-        initializer = Xavier()
+        initializer = _global_initializer[0] or Xavier()
     return initializer.resolve(shape, dtype, fan_hint)

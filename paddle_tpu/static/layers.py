@@ -187,6 +187,14 @@ def assign(input, output=None):
 # ---------------------------------------------------------------------------
 # core NN layers
 # ---------------------------------------------------------------------------
+def _bias_default():
+    """Bias initializer default: the set_global_initializer bias slot if
+    set (reference initializer.py set_global_initializer), else zeros."""
+    from .initializer import Constant, _global_initializer
+
+    return _global_initializer[1] or Constant(0.0)
+
+
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
        act=None, name=None):
     """Reference layers/nn.py:211 fc: flatten -> mul -> add bias -> act."""
@@ -203,7 +211,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     if bias_attr is not False:
         from .initializer import Constant
         b = helper.create_parameter((size,), input.dtype, attr=bias_attr,
-                                    initializer=Constant(0.0))
+                                    initializer=_bias_default())
         out = _append_simple("elementwise_add", {"X": [out], "Y": [b]},
                              {"axis": len(out.shape) - 1}, helper=helper)
     if act:
@@ -243,7 +251,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         from .initializer import Constant
         b = helper.create_parameter((num_filters,), input.dtype,
                                     attr=bias_attr,
-                                    initializer=Constant(0.0))
+                                    initializer=_bias_default())
         out = _append_simple("elementwise_add", {"X": [out], "Y": [b]},
                              {"axis": 1}, helper=helper)
     if act:
@@ -275,9 +283,9 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     scale = helper.create_parameter((c,), input.dtype, attr=param_attr,
                                     initializer=Constant(1.0))
     bias = helper.create_parameter((c,), input.dtype, attr=bias_attr,
-                                   initializer=Constant(0.0))
+                                   initializer=_bias_default())
     mean = helper.create_parameter((c,), input.dtype,
-                                   initializer=Constant(0.0),
+                                   initializer=_bias_default(),
                                    trainable=False)
     var = helper.create_parameter((c,), input.dtype,
                                   initializer=Constant(1.0),
@@ -313,7 +321,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
             (n,), input.dtype, attr=param_attr, initializer=Constant(1.0))]
     if shift:
         inputs["Bias"] = [helper.create_parameter(
-            (n,), input.dtype, attr=bias_attr, initializer=Constant(0.0))]
+            (n,), input.dtype, attr=bias_attr, initializer=_bias_default())]
     out, mean, var = _append_simple(
         "layer_norm", inputs, {"epsilon": epsilon,
                                "begin_norm_axis": begin_norm_axis},

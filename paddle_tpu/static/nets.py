@@ -1,0 +1,151 @@
+"""Composed static-graph building blocks (reference
+python/paddle/fluid/nets.py: simple_img_conv_pool :29, img_conv_group
+:141, sequence_conv_pool :256, glu :328, scaled_dot_product_attention
+:372). Same compositions over this package's static layers; the
+LoD-sequence input of sequence_conv_pool becomes dense (N, L, D) plus an
+optional mask, per the framework-wide dense+lengths design.
+"""
+from __future__ import annotations
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    conv_out = layers.conv2d(input, num_filters, filter_size,
+                             stride=conv_stride, padding=conv_padding,
+                             dilation=conv_dilation, groups=conv_groups,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             act=act)
+    return layers.pool2d(conv_out, pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride,
+                         pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """Chain of conv(+bn)(+dropout) blocks followed by one pool — the VGG
+    block builder (nets.py:141)."""
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+
+    def per_conv(arg, default=None):
+        if isinstance(arg, (list, tuple)):
+            return list(arg)
+        return [arg] * len(conv_num_filter)
+
+    paddings = per_conv(conv_padding)
+    fsizes = per_conv(conv_filter_size)
+    acts = per_conv(conv_act)
+    attrs = per_conv(param_attr)
+    with_bn = per_conv(conv_with_batchnorm)
+    drops = per_conv(conv_batchnorm_drop_rate)
+
+    tmp = input
+    for i, nf in enumerate(conv_num_filter):
+        local_act = None if with_bn[i] else acts[i]
+        tmp = layers.conv2d(tmp, nf, fsizes[i], padding=paddings[i],
+                            param_attr=attrs[i], act=local_act)
+        if with_bn[i]:
+            tmp = layers.batch_norm(tmp, act=acts[i])
+            if drops[i] > 0.0:
+                tmp = layers.dropout(tmp, dropout_prob=drops[i])
+    return layers.pool2d(tmp, pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       mask=None):
+    """1-D sequence conv + temporal pool (nets.py:256). `input` is dense
+    (N, L, D); the conv is built from shifted slices + fc (small
+    filter_size), replacing the LoD sequence_conv kernel; `mask`
+    (N, L, 1) excludes padded steps from the pool."""
+    shape = input.shape
+    L, D = shape[1], shape[2]
+    if mask is not None:
+        # zero padded steps BEFORE windowing: the reference LoD conv never
+        # reads past a sequence's end (zero boundary padding)
+        input = layers.elementwise_mul(input, mask)
+    half = (filter_size - 1) // 2
+    # gather the filter_size-wide context at every step via shifted,
+    # zero-padded slices along time — XLA fuses these into one window op
+    ctx_parts = []
+    for off in range(-half, filter_size - half):
+        if off < 0:
+            pad = layers.fill_constant([1], input.dtype, 0.0)
+            body = layers.slice(input, axes=[1], starts=[0], ends=[L + off])
+            zero = layers.elementwise_mul(
+                layers.slice(input, axes=[1], starts=[0], ends=[-off]),
+                pad)
+            part = layers.concat([zero, body], axis=1)
+        elif off > 0:
+            pad = layers.fill_constant([1], input.dtype, 0.0)
+            body = layers.slice(input, axes=[1], starts=[off], ends=[L])
+            zero = layers.elementwise_mul(
+                layers.slice(input, axes=[1], starts=[0], ends=[off]), pad)
+            part = layers.concat([body, zero], axis=1)
+        else:
+            part = input
+        ctx_parts.append(part)
+    ctx = layers.concat(ctx_parts, axis=2)          # (N, L, fs*D)
+    conv = layers.fc(ctx, num_filters, num_flatten_dims=2,
+                     param_attr=param_attr, bias_attr=bias_attr, act=act)
+    if mask is not None:
+        if pool_type == "max":
+            neg = layers.scale(
+                layers.elementwise_sub(
+                    layers.fill_constant([1], conv.dtype, 1.0), mask),
+                scale=-1e9)
+            conv = layers.elementwise_add(
+                layers.elementwise_mul(conv, mask), neg)
+        else:
+            conv = layers.elementwise_mul(conv, mask)
+    if pool_type == "max":
+        return layers.reduce_max(conv, dim=[1])
+    pooled = layers.reduce_sum(conv, dim=[1])
+    if mask is not None:
+        count = layers.elementwise_max(
+            layers.reduce_sum(mask, dim=[1]),
+            layers.fill_constant([1], conv.dtype, 1.0))
+        pooled = layers.elementwise_div(pooled, count)
+        return pooled
+    return layers.scale(pooled, scale=1.0 / L)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in two along dim, a * sigmoid(b)
+    (nets.py:328)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention over static Variables
+    (nets.py:372): (N, L, D) q/k/v → (N, Lq, Dv)."""
+    dk = queries.shape[-1]
+
+    def split_heads(x):
+        n, l, d = x.shape
+        y = layers.reshape(x, [-1, l, num_heads, d // num_heads])
+        return layers.transpose(y, [0, 2, 1, 3])    # (N, H, L, d)
+
+    q, k, v = split_heads(queries), split_heads(keys), split_heads(values)
+    scores = layers.matmul(q, layers.transpose(k, [0, 1, 3, 2]))
+    scores = layers.scale(scores, scale=1.0 / (dk // num_heads) ** 0.5)
+    weights = layers.softmax(scores)
+    if dropout_rate > 0.0:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)                  # (N, H, Lq, dv)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    n, lq = ctx.shape[0], ctx.shape[1]
+    dv = values.shape[-1]
+    return layers.reshape(ctx, [-1, lq, dv])

@@ -19,6 +19,16 @@ from .layers import LayerHelper
 
 OPTIMIZER_OP_TYPES = {"sgd", "momentum", "adam", "lamb", "increment"}
 
+_global_grad_clip = [None]
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Program-level default gradient clip (reference fluid/clip.py:766
+    set_gradient_clip): applied by Optimizer.minimize when the optimizer
+    itself was not given a grad_clip. param_list/program accepted for API
+    parity; the clip applies to all minimized parameters."""
+    _global_grad_clip[0] = clip
+
 
 class Optimizer:
     _update_op = None
@@ -64,8 +74,9 @@ class Optimizer:
     def minimize(self, loss: Variable, startup_program=None,
                  parameter_list=None, no_grad_set=None):
         params_grads = append_backward(loss, parameter_list, no_grad_set)
-        if self.grad_clip is not None:
-            params_grads = self.grad_clip(params_grads)
+        clip = self.grad_clip or _global_grad_clip[0]
+        if clip is not None:
+            params_grads = clip(params_grads)
         self.apply_gradients(params_grads)
         return [], params_grads
 

@@ -255,3 +255,50 @@ def test_program_translator_toggle():
     finally:
         dy2static.ProgramTranslator().enable(True)
     assert dy2static.ast_enabled()
+
+
+def test_elif_chain_on_tensor():
+    """elif chains (nested ifs) must not leak synthetic helper names into
+    the outer branch variable set (review regression)."""
+    def h(x):
+        if (x.sum() > 0.0):
+            y = x * 2.0
+        elif (x.sum() < -10.0):
+            y = x * 3.0
+        else:
+            y = x - 1.0
+        return y
+
+    hc = to_static(h)
+    np.testing.assert_allclose(hc(t([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(hc(t([-20.0])).numpy(), [-60.0])
+    np.testing.assert_allclose(hc(t([-1.0])).numpy(), [-2.0])
+
+
+def test_for_range_last_value_semantics():
+    """After the loop the target holds the last YIELDED value, not the
+    bound (review regression)."""
+    def f():
+        tot = 0
+        for k in range(3):
+            tot = tot + k
+        return k, tot
+
+    fc = dy2static.ast_transform(f)
+    assert fc() == (2, 3)
+
+
+def test_inner_break_does_not_block_outer_while():
+    """A break inside an inner Python for must not stop the enclosing
+    tensor-dependent while from converting (review regression)."""
+    @to_static
+    def f(x):
+        while (x.sum() < 10.0):
+            for j in range(5):
+                if j == 2:
+                    break
+                x = x + 1.0
+        return x
+
+    out = f(t([0.0]))        # +2 per outer iteration until >= 10
+    assert float(out.numpy()[0]) >= 10.0

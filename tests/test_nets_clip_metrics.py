@@ -1,0 +1,229 @@
+"""fluid.nets compositions, static gradient clipping, extra initializers,
+and fluid.metrics classes (reference nets.py / clip.py / initializer.py /
+metrics.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu import metric
+
+
+def test_simple_img_conv_pool_and_glu():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        img = static.data("img", [-1, 1, 28, 28])
+        conv_pool = static.nets.simple_img_conv_pool(
+            img, num_filters=8, filter_size=5, pool_size=2, pool_stride=2,
+            act="relu")
+        flat = static.flatten(conv_pool, axis=1)
+        gated = static.nets.glu(flat, dim=-1)
+    exe = static.Executor()
+    exe.run(startup)
+    out, g = exe.run(main, feed={"img": np.random.RandomState(0).rand(
+        2, 1, 28, 28).astype(np.float32)}, fetch_list=[conv_pool, gated])
+    assert np.asarray(out).shape == (2, 8, 12, 12)
+    assert np.asarray(g).shape[-1] == np.asarray(out).reshape(2, -1).shape[-1] // 2
+
+
+def test_img_conv_group_vgg_block():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        img = static.data("img", [-1, 3, 16, 16])
+        out = static.nets.img_conv_group(
+            img, conv_num_filter=[8, 8], pool_size=2, conv_act="relu",
+            conv_with_batchnorm=True, conv_batchnorm_drop_rate=0.1,
+            pool_stride=2)
+    exe = static.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"img": np.random.RandomState(1).rand(
+        2, 3, 16, 16).astype(np.float32)}, fetch_list=[out])
+    assert np.asarray(o).shape == (2, 8, 8, 8)
+
+
+def test_sequence_conv_pool_masked():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        seq = static.data("seq", [-1, 6, 4])
+        mask = static.data("mask", [-1, 6, 1])
+        pooled = static.nets.sequence_conv_pool(
+            seq, num_filters=5, filter_size=3, act="relu",
+            pool_type="max", mask=mask)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    x = rng.rand(3, 6, 4).astype(np.float32)
+    m = np.ones((3, 6, 1), np.float32)
+    m[:, 4:] = 0.0
+    o, = exe.run(main, feed={"seq": x, "mask": m}, fetch_list=[pooled])
+    o = np.asarray(o)
+    assert o.shape == (3, 5)
+    # masked steps must not win the max-pool: recompute with zeroed tail
+    x2 = x.copy()
+    x2[:, 4:] = 100.0          # huge values on masked steps
+    o2, = exe.run(main, feed={"seq": x2, "mask": m}, fetch_list=[pooled])
+    np.testing.assert_allclose(np.asarray(o2), o, rtol=1e-5)
+
+
+def test_scaled_dot_product_attention_net():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        q = static.data("q", [-1, 4, 8])
+        k = static.data("k", [-1, 6, 8])
+        v = static.data("v", [-1, 6, 8])
+        ctx = static.nets.scaled_dot_product_attention(q, k, v, num_heads=2)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    o, = exe.run(main, feed={
+        "q": rng.rand(2, 4, 8).astype(np.float32),
+        "k": rng.rand(2, 6, 8).astype(np.float32),
+        "v": rng.rand(2, 6, 8).astype(np.float32)}, fetch_list=[ctx])
+    assert np.asarray(o).shape == (2, 4, 8)
+
+
+@pytest.mark.parametrize("clip", [
+    static.GradientClipByValue(0.01),
+    static.GradientClipByNorm(0.05),
+    static.GradientClipByGlobalNorm(0.05),
+])
+def test_static_gradient_clip(clip):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4])
+        y = static.data("y", [-1, 1])
+        pred = static.nn.fc(x, 1)
+        loss = static.mean(static.square_error_cost(pred, y))
+        static.SGD(learning_rate=1.0, grad_clip=clip).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # huge targets -> huge raw grads; the clip keeps params from exploding
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "y": (rng.rand(8, 1) * 1e4).astype(np.float32)}
+    scope = static.global_scope()
+    w_name = main.all_parameters()[0].name
+    w0 = np.asarray(scope.find_var(w_name))
+    exe.run(main, feed=feed, fetch_list=[loss])
+    w1 = np.asarray(static.global_scope().find_var(w_name))
+    assert np.abs(w1 - w0).max() < 1.0, np.abs(w1 - w0).max()
+
+
+def test_set_gradient_clip_default():
+    static.set_gradient_clip(static.GradientClipByValue(0.001))
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 4])
+            y = static.data("y", [-1, 1])
+            loss = static.mean(
+                static.square_error_cost(static.nn.fc(x, 1), y))
+            static.SGD(learning_rate=1.0).minimize(loss)
+        assert any(op.type == "clip" for op in main.global_block.ops)
+    finally:
+        static.set_gradient_clip(None)
+
+
+def test_numpy_array_and_bilinear_initializers():
+    from paddle_tpu.static.initializer import (Bilinear,
+                                               NumpyArrayInitializer)
+    val = np.arange(12, dtype=np.float32).reshape(3, 4)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        w = static.create_parameter(
+            [3, 4], "float32", name="w_np",
+            default_initializer=NumpyArrayInitializer(val))
+        up = static.create_parameter(
+            [2, 2, 4, 4], "float32", name="w_bl",
+            default_initializer=Bilinear())
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    np.testing.assert_allclose(np.asarray(scope.find_var("w_np")), val)
+    blw = np.asarray(scope.find_var("w_bl"))
+    assert blw.shape == (2, 2, 4, 4)
+    assert blw.max() <= 1.0 and blw[0, 0].sum() > 0
+
+
+def test_set_global_initializer():
+    from paddle_tpu.static import initializer as I
+    I.set_global_initializer(I.Constant(0.5))
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            static.nn.fc(static.data("x", [-1, 4]), 3, bias_attr=False)
+        exe = static.Executor()
+        exe.run(startup)
+        w = np.asarray(static.global_scope().find_var(
+            main.all_parameters()[0].name))
+        np.testing.assert_allclose(w, 0.5)
+    finally:
+        I.set_global_initializer(None)
+
+
+def test_composite_and_chunk_and_edit_distance_metrics():
+    comp = metric.CompositeMetric()
+    acc = metric.Accuracy()
+    comp.add_metric(acc)
+    comp.reset()
+
+    chunk = metric.ChunkEvaluator()
+    chunk.update(10, 8, 6)
+    p, r, f1 = chunk.accumulate()
+    assert abs(p - 0.6) < 1e-9 and abs(r - 0.75) < 1e-9
+    assert abs(f1 - 2 * p * r / (p + r)) < 1e-9
+
+    ed = metric.EditDistance()
+    ed.update(np.array([0.0, 2.0, 1.0]))
+    avg, err = ed.accumulate()
+    assert abs(avg - 1.0) < 1e-9 and abs(err - 2 / 3) < 1e-9
+
+
+def test_detection_map():
+    m = metric.DetectionMAP(overlap_threshold=0.5)
+    gts = np.array([[0, 0, 0, 10, 10], [1, 20, 20, 30, 30]], np.float32)
+    # one perfect match per class, one false positive
+    preds = np.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [1, 0.8, 20, 20, 30, 30],
+        [0, 0.7, 50, 50, 60, 60],
+    ], np.float32)
+    m.update(preds, gts)
+    assert abs(m.accumulate() - 1.0) < 1e-9   # FP after full recall
+    m2 = metric.DetectionMAP()
+    m2.update(np.array([[0, 0.9, 50, 50, 60, 60]], np.float32),
+              np.array([[0, 0, 0, 10, 10]], np.float32))
+    assert m2.accumulate() == 0.0
+
+
+def test_set_global_initializer_bias_slot():
+    """The bias_init argument must reach bias parameters (review
+    regression: it was stored but never read)."""
+    from paddle_tpu.static import initializer as I
+    I.set_global_initializer(I.Constant(0.25), I.Constant(1.5))
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            static.nn.fc(static.data("x", [-1, 4]), 3)
+        exe = static.Executor()
+        exe.run(startup)
+        scope = static.global_scope()
+        vals = sorted(
+            float(np.asarray(scope.find_var(p.name)).reshape(-1)[0])
+            for p in main.all_parameters())
+        assert vals == [0.25, 1.5], vals
+    finally:
+        I.set_global_initializer(None, None)
+
+
+def test_detection_map_difficult_boxes():
+    gts = np.array([[0, 0, 0, 10, 10, 0], [0, 20, 20, 30, 30, 1]],
+                   np.float32)          # second box difficult
+    preds = np.array([[0, 0.9, 0, 0, 10, 10],
+                      [0, 0.8, 20, 20, 30, 30]], np.float32)
+    m = metric.DetectionMAP(evaluate_difficult=False)
+    m.update(preds, gts)
+    # difficult GT excluded from denominator; its matched pred ignored
+    assert abs(m.accumulate() - 1.0) < 1e-9
+    m2 = metric.DetectionMAP(evaluate_difficult=True)
+    m2.update(preds, gts)
+    assert abs(m2.accumulate() - 1.0) < 1e-9
