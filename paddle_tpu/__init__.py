@@ -62,3 +62,5 @@ def summary(net, input_size=None, dtypes=None):
     from .hapi.summary import summary as _summary
 
     return _summary(net, input_size, dtypes)
+from . import reader  # noqa: F401
+from .reader import batch  # noqa: F401

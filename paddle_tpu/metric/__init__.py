@@ -328,7 +328,7 @@ class DetectionMAP(Metric):
                 continue
             if self.ap_version == "11point":
                 ap = sum(max([p for r, p in zip(rec, prec) if r >= t],
-                             default=0.0) for t in np.arange(0, 1.01, 0.1))
+                             default=0.0) for t in np.linspace(0, 1, 11))
                 aps.append(ap / 11.0)
             else:
                 ap, prev_r = 0.0, 0.0

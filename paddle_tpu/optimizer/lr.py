@@ -328,3 +328,26 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
 
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     return LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# fluid/dygraph/learning_rate_scheduler.py era names
+class CosineDecay(LRScheduler):
+    """fluid.dygraph.CosineDecay(learning_rate, step_each_epoch, epochs):
+    lr = 0.5 * lr0 * (cos(pi * epoch / epochs) + 1), with epoch =
+    step // step_each_epoch. NOT the same signature as
+    CosineAnnealingDecay (learning_rate, T_max, eta_min)."""
+
+    def __init__(self, learning_rate, step_each_epoch, epochs,
+                 last_epoch=-1, verbose=False):
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        cur_epoch = math.floor(self.last_epoch / self.step_each_epoch)
+        return self.base_lr * 0.5 * (
+            math.cos(cur_epoch * math.pi / self.epochs) + 1)
+
+
+LinearLrWarmup = LinearWarmup
+ReduceLROnPlateau = ReduceOnPlateau

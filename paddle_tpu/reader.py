@@ -1,0 +1,271 @@
+"""Old-style reader decorators + paddle.batch (reference
+python/paddle/reader/decorator.py and python/paddle/batch.py). A
+"reader" is a zero-arg callable returning a sample generator; decorators
+compose them. Kept for fluid-era training loops (`for batch in
+paddle.batch(paddle.reader.shuffle(train(), 500), 32)`); the 2.0 path is
+io.DataLoader."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+__all__ = [
+    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "ComposeNotAligned", "firstn", "xmap_readers", "multiprocess_reader",
+    "batch",
+]
+
+
+def cache(reader):
+    """Materialize once, replay from memory (decorator.py cache)."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        return iter(all_data)
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Zip readers, yield func(*samples) (decorator.py map_readers)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (decorator.py shuffle)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back (decorator.py chain)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined tuples, flattening tuple samples
+    (decorator.py compose). check_alignment=True raises ComposeNotAligned
+    when the readers run out at different lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+class _WorkerError:
+    """Exception captured in a worker thread, re-raised in the consumer
+    (reference decorator.py propagates worker failures the same way)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (decorator.py buffered)."""
+
+    class _End:
+        pass
+
+    def read_worker(r, q):
+        try:
+            for d in r:
+                q.put(d)
+            q.put(_End())
+        except Exception as exc:            # noqa: BLE001
+            q.put(_WorkerError(exc))
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+        t = Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while not isinstance(e, _End):
+            if isinstance(e, _WorkerError):
+                raise e.exc
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n samples (decorator.py firstn)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool map over a reader (decorator.py xmap_readers). order
+    preserves input order."""
+
+    end = object()
+
+    def data_reader():
+        in_q: Queue = Queue(buffer_size)
+        out_q: Queue = Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+                for _ in range(process_num):
+                    in_q.put(end)
+            except Exception as exc:        # noqa: BLE001
+                out_q.put(_WorkerError(exc))
+
+        results = {}
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except Exception as exc:    # noqa: BLE001
+                    out_q.put(_WorkerError(exc))
+                    return
+
+        feeder = Thread(target=feed)
+        feeder.daemon = True
+        feeder.start()
+        workers = []
+        for _ in range(process_num):
+            t = Thread(target=work)
+            t.daemon = True
+            t.start()
+            workers.append(t)
+
+        finished = 0
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                results[i] = mapped
+                while next_idx in results:
+                    yield results.pop(next_idx)
+                    next_idx += 1
+        if order:
+            while next_idx in results:
+                yield results.pop(next_idx)
+                next_idx += 1
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers via worker threads (decorator.py
+    multiprocess_reader; thread-backed here — the samples feed a
+    host-side pipeline, and threads avoid fork+jax issues)."""
+
+    end = object()
+
+    def data_reader():
+        q: Queue = Queue(queue_size)
+
+        def work(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+                q.put(end)
+            except Exception as exc:        # noqa: BLE001
+                q.put(_WorkerError(exc))
+
+        for r in readers:
+            t = Thread(target=work, args=(r,))
+            t.daemon = True
+            t.start()
+
+        finished = 0
+        while finished < len(readers):
+            sample = q.get()
+            if sample is end:
+                finished += 1
+            elif isinstance(sample, _WorkerError):
+                raise sample.exc
+            else:
+                yield sample
+
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (python/paddle/batch.py)."""
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

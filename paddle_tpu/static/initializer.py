@@ -140,9 +140,9 @@ class Bilinear(Initializer):
         x = np.arange(w)[None, :]
         filt = ((1 - np.abs(y / f_h - c_h)) *
                 (1 - np.abs(x / f_w - c_w))).astype(np.float64)
-        weight = np.zeros(shape)
-        for i in range(shape[0]):
-            weight[i, i % shape[1]] = filt
+        # reference BilinearInitializer writes the filter into EVERY
+        # (out, in) channel pair (initializer.py, np.tile over C_out*C_in)
+        weight = np.tile(filt, (shape[0], shape[1], 1, 1))
         return "assign_value", {"shape": list(shape), "dtype": dtype,
                                 "values": weight.reshape(-1).tolist()}
 

@@ -284,8 +284,10 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                                     initializer=Constant(1.0))
     bias = helper.create_parameter((c,), input.dtype, attr=bias_attr,
                                    initializer=_bias_default())
+    # running statistics, not biases: never subject to the global
+    # bias initializer (mean starts at 0, variance at 1)
     mean = helper.create_parameter((c,), input.dtype,
-                                   initializer=_bias_default(),
+                                   initializer=Constant(0.0),
                                    trainable=False)
     var = helper.create_parameter((c,), input.dtype,
                                   initializer=Constant(1.0),

@@ -70,6 +70,10 @@ def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
     (N, L, 1) excludes padded steps from the pool."""
     shape = input.shape
     L, D = shape[1], shape[2]
+    if L is None or L < 0:
+        raise ValueError(
+            "sequence_conv_pool needs a static time dimension; declare "
+            "the input as data(name, [-1, L, D]) with concrete L")
     if mask is not None:
         # zero padded steps BEFORE windowing: the reference LoD conv never
         # reads past a sequence's end (zero boundary padding)

@@ -109,3 +109,360 @@ class CenterCrop:
         sl[h_ax] = slice(i, i + th)
         sl[w_ax] = slice(j, j + tw)
         return arr[tuple(sl)]
+
+
+def _axes(arr):
+    """(h_axis, w_axis, c_axis|None) for HWC or CHW numpy images."""
+    if arr.ndim == 2:
+        return 0, 1, None
+    if arr.shape[0] in (1, 3):      # CHW
+        return 1, 2, 0
+    return 0, 1, 2                   # HWC
+
+
+# -- functional API (reference hapi/vision/transforms/functional.py) --------
+
+
+def flip(image, code):
+    """cv2-style flip code: 0 vertical, >0 horizontal, <0 both."""
+    arr = np.asarray(image)
+    h_ax, w_ax, _ = _axes(arr)
+    if code == 0:
+        return np.ascontiguousarray(np.flip(arr, axis=h_ax))
+    if code > 0:
+        return np.ascontiguousarray(np.flip(arr, axis=w_ax))
+    return np.ascontiguousarray(np.flip(np.flip(arr, axis=h_ax), axis=w_ax))
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """padding: int | (pad_lr, pad_tb) | (left, top, right, bottom)."""
+    arr = np.asarray(img)
+    h_ax, w_ax, _ = _axes(arr)
+    if isinstance(padding, int):
+        l = t = r = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    pads = [(0, 0)] * arr.ndim
+    pads[h_ax] = (t, b)
+    pads[w_ax] = (l, r)
+    if padding_mode == "constant":
+        return np.pad(arr, pads, mode="constant", constant_values=fill)
+    return np.pad(arr, pads, mode=padding_mode)
+
+
+def rotate(img, angle, resample=False, expand=False, center=None):
+    """Rotate counter-clockwise by `angle` degrees about the image center
+    (nearest-neighbor inverse mapping; reference uses cv2.warpAffine)."""
+    arr = np.asarray(img)
+    h_ax, w_ax, _ = _axes(arr)
+    h, w = arr.shape[h_ax], arr.shape[w_ax]
+    theta = np.deg2rad(angle)
+    cos, sin = np.cos(theta), np.sin(theta)
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ys, xs = np.mgrid[0:h, 0:w]
+    # inverse rotation: output pixel -> source pixel
+    src_x = cos * (xs - cx) + sin * (ys - cy) + cx
+    src_y = -sin * (xs - cx) + cos * (ys - cy) + cy
+    sx = np.clip(np.round(src_x), 0, w - 1).astype(np.int64)
+    sy = np.clip(np.round(src_y), 0, h - 1).astype(np.int64)
+    valid = (src_x >= -0.5) & (src_x <= w - 0.5) & \
+            (src_y >= -0.5) & (src_y <= h - 0.5)
+    take = [slice(None)] * arr.ndim
+    take[h_ax], take[w_ax] = sy, sx
+    out = arr[tuple(take)]
+    mask_shape = [1] * arr.ndim
+    mask_shape[h_ax], mask_shape[w_ax] = h, w
+    out = out * valid.reshape(mask_shape).astype(out.dtype)
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img, np.float32)
+    h_ax, w_ax, c_ax = _axes(arr)
+    if c_ax is None or arr.shape[c_ax] == 1:
+        gray = arr if c_ax is not None else arr[..., None]
+    else:
+        weights = np.array([0.299, 0.587, 0.114], np.float32)
+        shape = [1, 1, 1]
+        shape[c_ax] = 3
+        gray = (arr * weights.reshape(shape)).sum(axis=c_ax, keepdims=True)
+    reps = [1] * gray.ndim
+    reps[c_ax if c_ax is not None else 2] = num_output_channels
+    return np.tile(gray, reps).astype(np.asarray(img).dtype)
+
+
+# -- transform classes ------------------------------------------------------
+
+
+class BatchCompose:
+    """Compose applied per-sample inside a collate step (reference
+    transforms.py BatchCompose: callables over whole batches)."""
+
+    def __init__(self, transforms=None):
+        self.transforms = transforms or []
+
+    def __call__(self, data):
+        for f in self.transforms:
+            data = f(data)
+        return data
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop resized to `size` (transforms.py
+    RandomResizedCrop)."""
+
+    def __init__(self, output_size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3)):
+        self.size = (output_size, output_size) \
+            if isinstance(output_size, int) else tuple(output_size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h_ax, w_ax, _ = _axes(arr)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * aspect)))
+            ch = int(round(np.sqrt(target / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                sl = [slice(None)] * arr.ndim
+                sl[h_ax] = slice(i, i + ch)
+                sl[w_ax] = slice(j, j + cw)
+                return Resize(self.size)(arr[tuple(sl)])
+        return Resize(self.size)(CenterCrop(min(h, w))(arr))
+
+
+class CenterCropResize:
+    """Center crop by c = int(size*h/(size+pad)) then resize
+    (transforms.py CenterCropResize)."""
+
+    def __init__(self, size, crop_padding=32, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.crop_padding = crop_padding
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h_ax, w_ax, _ = _axes(arr)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        c = int(self.size[0] / (self.size[0] + self.crop_padding) *
+                min(h, w))
+        return Resize(self.size, self.interpolation)(CenterCrop(c)(arr))
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            h_ax, _, _ = _axes(arr)
+            return np.ascontiguousarray(np.flip(arr, axis=h_ax))
+        return img
+
+
+class Permute:
+    """HWC -> CHW (+ float conversion in 'float32' mode), matching
+    transforms.py Permute."""
+
+    def __init__(self, mode="CHW", to_rgb=True):
+        self.mode = mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.mode == "CHW" and arr.shape[-1] in (1, 3):
+            arr = np.transpose(arr, (2, 0, 1))
+        return np.ascontiguousarray(arr)
+
+
+class GaussianNoise:
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        return arr + np.random.normal(self.mean, self.std, arr.shape) \
+            .astype(np.float32)
+
+
+class BrightnessTransform:
+    """value=v: scale by uniform(1-v, 1+v) (transforms.py)."""
+
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("brightness value should be non-negative")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        alpha = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return np.clip(arr * alpha, 0, 255).astype(np.asarray(img).dtype)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        alpha = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        mean = to_grayscale(arr).mean()
+        return np.clip(arr * alpha + mean * (1 - alpha), 0, 255) \
+            .astype(np.asarray(img).dtype)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        if value < 0:
+            raise ValueError("saturation value should be non-negative")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        alpha = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        gray = to_grayscale(arr).astype(np.float32)
+        return np.clip(arr * alpha + gray * (1 - alpha), 0, 255) \
+            .astype(np.asarray(img).dtype)
+
+
+class HueTransform:
+    """Hue rotation in HSV space by uniform(-value, value) (value<=0.5)."""
+
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img)
+        h_ax, w_ax, c_ax = _axes(arr)
+        if c_ax is None or arr.shape[c_ax] != 3:
+            return img
+        hwc = np.moveaxis(arr, c_ax, -1).astype(np.float32)
+        scaled = hwc / 255.0 if hwc.max() > 1.5 else hwc
+        mx, mn = scaled.max(-1), scaled.min(-1)
+        diff = mx - mn + 1e-12
+        r, g, b = scaled[..., 0], scaled[..., 1], scaled[..., 2]
+        hch = np.where(mx == r, ((g - b) / diff) % 6,
+                       np.where(mx == g, (b - r) / diff + 2,
+                                (r - g) / diff + 4)) / 6.0
+        s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+        v = mx
+        hch = (hch + np.random.uniform(-self.value, self.value)) % 1.0
+        i = np.floor(hch * 6).astype(np.int64) % 6
+        f = hch * 6 - np.floor(hch * 6)
+        p, q, t_ = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+        choices = np.stack([
+            np.stack([v, t_, p], -1), np.stack([q, v, p], -1),
+            np.stack([p, v, t_], -1), np.stack([p, q, v], -1),
+            np.stack([t_, p, v], -1), np.stack([v, p, q], -1)], 0)
+        out = np.take_along_axis(
+            choices, i[None, ..., None].repeat(3, -1), axis=0)[0]
+        if hwc.max() > 1.5:
+            out = out * 255.0
+        return np.moveaxis(out, -1, c_ax).astype(arr.dtype)
+
+
+class ColorJitter:
+    """Random-order brightness/contrast/saturation/hue (transforms.py
+    ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for idx in order:
+            img = self.transforms[idx](img)
+        return img
+
+
+class RandomErasing:
+    """Zero (or noise-fill) a random rectangle (transforms.py
+    RandomErasing / RandomErasing paper)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.4), ratio=0.3, value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img).copy()
+        h_ax, w_ax, _ = _axes(arr)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            aspect = np.random.uniform(self.ratio, 1 / self.ratio)
+            eh = int(round(np.sqrt(target * aspect)))
+            ew = int(round(np.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                sl = [slice(None)] * arr.ndim
+                sl[h_ax] = slice(i, i + eh)
+                sl[w_ax] = slice(j, j + ew)
+                arr[tuple(sl)] = self.value
+                return arr
+        return arr
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def __call__(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class RandomRotate:
+    """Rotate by uniform(-degrees, degrees) (transforms.py RandomRotate)."""
+
+    def __init__(self, degrees):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle)
+
+
+class Grayscale:
+    def __init__(self, output_channels=1):
+        self.output_channels = output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.output_channels)

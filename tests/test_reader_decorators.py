@@ -1,0 +1,73 @@
+"""Old-style reader decorators (reference python/paddle/reader/decorator.py
++ batch.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader
+
+
+def r10():
+    def r():
+        for i in range(10):
+            yield i
+    return r
+
+
+def test_batch_and_firstn():
+    batches = list(paddle.batch(r10(), 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    batches = list(paddle.batch(r10(), 3, drop_last=True)())
+    assert batches[-1] == [6, 7, 8]
+    assert list(reader.firstn(r10(), 4)()) == [0, 1, 2, 3]
+
+
+def test_cache_map_chain_compose():
+    c = reader.cache(r10())
+    assert list(c()) == list(range(10)) == list(c())
+    m = reader.map_readers(lambda a, b: a + b, r10(), r10())
+    assert list(m()) == [2 * i for i in range(10)]
+    ch = reader.chain(r10(), r10())
+    assert len(list(ch())) == 20
+    comp = reader.compose(r10(), r10())
+    assert list(comp())[0] == (0, 0)
+
+    def r5():
+        def r():
+            for i in range(5):
+                yield i
+        return r
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(r10(), r5())())
+    ok = reader.compose(r10(), r5(), check_alignment=False)
+    assert len(list(ok())) == 5
+
+
+def test_shuffle_buffered_xmap_multiprocess():
+    np.random.seed(0)
+    s = sorted(reader.shuffle(r10(), 5)())
+    assert s == list(range(10))
+    assert sorted(reader.buffered(r10(), 2)()) == list(range(10))
+    x = reader.xmap_readers(lambda v: v * 2, r10(), 3, 4, order=True)
+    assert list(x()) == [2 * i for i in range(10)]
+    xo = reader.xmap_readers(lambda v: v * 2, r10(), 3, 4, order=False)
+    assert sorted(xo()) == [2 * i for i in range(10)]
+    mp = reader.multiprocess_reader([r10(), r10()])
+    assert sorted(mp()) == sorted(list(range(10)) * 2)
+
+
+def test_worker_errors_propagate():
+    """Failing readers/mappers raise in the consumer instead of hanging
+    (review regression)."""
+    def bad():
+        def r():
+            yield 1
+            raise RuntimeError("boom")
+        return r
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(reader.buffered(bad(), 2)())
+    with pytest.raises(ZeroDivisionError):
+        list(reader.xmap_readers(lambda v: 1 // (v - v), r10(), 2, 4)())
+    with pytest.raises(RuntimeError, match="boom"):
+        list(reader.multiprocess_reader([bad()])())
