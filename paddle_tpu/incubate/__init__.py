@@ -1,2 +1,3 @@
 """paddle_tpu.incubate (reference python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
+from . import layers  # noqa: F401

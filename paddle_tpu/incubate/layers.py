@@ -1,0 +1,124 @@
+"""Generic contrib layers (reference fluid/contrib/layers/nn.py — the
+portable subset; the Baidu-hardware ops tdm_*/search_pyramid_hash/
+_pull_box_extended_sparse stay out of scope with BoxPS/HeterPS).
+
+Built on the framework's tape-aware ops (paddle_tpu.ops / nn.functional),
+so gradients flow in eager mode and everything traces under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .. import ops
+from ..framework import random as random_mod
+from ..framework.tensor import Tensor, unwrap
+
+
+def shuffle_batch(x, seed=None):
+    """Shuffle rows (all dims but the last collapse to rows) — reference
+    contrib nn.py:783 shuffle_batch / shuffle_batch_op.cc. Differentiable
+    through the gather."""
+    shape = x.shape
+    rows = ops.reshape(x, [-1, shape[-1]])
+    key = random_mod.make_key(seed) if seed is not None else \
+        random_mod.next_rng_key()
+    perm = Tensor(jax.random.permutation(key, rows.shape[0]))
+    out = ops.gather(rows, perm)
+    return ops.reshape(out, list(shape))
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat a [start:start+length] column slice of each input
+    (contrib nn.py:847 partial_concat_op)."""
+    parts = []
+    for v in input:
+        end = v.shape[1] if length < 0 else start_index + length
+        parts.append(v[:, start_index:end])
+    return ops.concat(parts, axis=1)
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum the same column slice across inputs (contrib nn.py:910)."""
+    end = input[0].shape[1] if length < 0 else start_index + length
+    out = input[0][:, start_index:end]
+    for v in input[1:]:
+        out = out + v[:, start_index:end]
+    return out
+
+
+def batch_fc(input, param_size, param_attr=None, bias_size=None,
+             bias_attr=None, act=None, weight=None, bias=None):
+    """Per-slot batched fc: input (slot, N, D) @ w (slot, D, out) + b
+    (contrib nn.py:1379 batch_fc_op). Pass weight/bias Tensors to train
+    them; otherwise they are created here and returned alongside the
+    output as (out, w, b) for functional parameter management."""
+    slot, _, d = input.shape
+    ps = tuple(param_size)
+    if ps[0] != slot or ps[1] != d:
+        raise ValueError(f"param_size {param_size} does not match input "
+                         f"(slot, N, {d})")
+    if weight is None:
+        key = random_mod.next_rng_key()
+        weight = Tensor(jax.random.normal(key, ps) * (1.0 / d ** 0.5),
+                        stop_gradient=False)
+    if bias is None and bias_size is not None:
+        bias = Tensor(np.zeros(tuple(bias_size), np.float32),
+                      stop_gradient=False)
+    out = ops.matmul(input, weight)          # batched (slot, N, out)
+    if bias is not None:
+        out = out + (ops.unsqueeze(bias, [1]) if bias.ndim == 2 else bias)
+    if act is not None:
+        from .. import nn as nn_mod
+
+        out = getattr(nn_mod.functional, act)(out)
+    return out, weight, bias
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
+                             combiner="sum", param_attr=None,
+                             dtype="float32", weight=None, lengths=None):
+    """Embedding lookup + sequence pool in one step (contrib nn.py:471
+    fused_embedding_seq_pool_op). Dense form: input (N, L) ids (+optional
+    lengths for padding-aware pooling); returns (N, D). Gradients flow
+    into `weight`."""
+    from ..nn import functional as F
+
+    if weight is None:
+        key = random_mod.next_rng_key()
+        weight = Tensor(jax.random.normal(key, tuple(size)) * 0.01,
+                        stop_gradient=False)
+    emb = F.embedding(input, weight, padding_idx=padding_idx)  # (N, L, D)
+    L = input.shape[1]
+    if lengths is not None:
+        step = Tensor(np.arange(L, dtype=np.int64)[None, :])
+        keep = ops.cast(
+            ops.unsqueeze(step < ops.unsqueeze(lengths, [1]), [2]),
+            emb.dtype)
+        emb = emb * keep
+        denom = ops.cast(ops.unsqueeze(ops.maximum(
+            lengths, Tensor(np.int64(1))), [1]), emb.dtype)
+    else:
+        denom = float(L)
+    if combiner == "sum":
+        return ops.sum(emb, axis=1)
+    if combiner in ("mean", "avg"):
+        return ops.sum(emb, axis=1) / denom
+    raise ValueError(f"unsupported combiner {combiner}")
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """Large-scale sparse embedding facade (contrib nn.py:964) — routed
+    to the parameter-server SparseEmbedding, the TPU answer to
+    large_scale_kv (see paddle_tpu/ps)."""
+    from ..ps.embedding import SparseEmbedding
+
+    layer = SparseEmbedding(int(size[1]))
+    out = layer(input)
+    if padding_idx is not None:
+        mask = ops.cast(ops.unsqueeze(input != padding_idx, [-1]),
+                        out.dtype)
+        out = out * mask
+    return out

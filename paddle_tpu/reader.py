@@ -164,10 +164,12 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             try:
                 for i, sample in enumerate(reader()):
                     in_q.put((i, sample))
-                for _ in range(process_num):
-                    in_q.put(end)
             except Exception as exc:        # noqa: BLE001
                 out_q.put(_WorkerError(exc))
+            finally:
+                # sentinels always flow, so workers never park forever
+                for _ in range(process_num):
+                    in_q.put(end)
 
         results = {}
 
@@ -211,10 +213,6 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 while next_idx in results:
                     yield results.pop(next_idx)
                     next_idx += 1
-        if order:
-            while next_idx in results:
-                yield results.pop(next_idx)
-                next_idx += 1
 
     return data_reader
 

@@ -44,9 +44,19 @@ class Normalize:
         return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
 
 
+_RESIZE_METHODS = {"bilinear": "linear", "linear": "linear",
+                   "nearest": "nearest", "bicubic": "cubic",
+                   "cubic": "cubic", "lanczos": "lanczos3"}
+
+
 class Resize:
     def __init__(self, size, interpolation="bilinear"):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
+        if interpolation not in _RESIZE_METHODS:
+            raise ValueError(
+                f"unsupported interpolation {interpolation!r}; one of "
+                f"{sorted(_RESIZE_METHODS)}")
+        self.method = _RESIZE_METHODS[interpolation]
 
     def __call__(self, img):
         import jax
@@ -57,7 +67,8 @@ class Resize:
             out_shape = (arr.shape[0],) + self.size
         else:
             out_shape = self.size + ((arr.shape[-1],) if arr.ndim == 3 else ())
-        return np.asarray(jax.image.resize(arr, out_shape, method="linear"))
+        return np.asarray(jax.image.resize(arr, out_shape,
+                                           method=self.method))
 
 
 class RandomHorizontalFlip:
@@ -158,15 +169,30 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 
 def rotate(img, angle, resample=False, expand=False, center=None):
-    """Rotate counter-clockwise by `angle` degrees about the image center
-    (nearest-neighbor inverse mapping; reference uses cv2.warpAffine)."""
+    """Rotate counter-clockwise by `angle` degrees about `center`
+    (default image center); expand=True enlarges the canvas to contain
+    the whole rotated image. Nearest-neighbor inverse mapping (reference
+    uses cv2.warpAffine)."""
     arr = np.asarray(img)
     h_ax, w_ax, _ = _axes(arr)
     h, w = arr.shape[h_ax], arr.shape[w_ax]
     theta = np.deg2rad(angle)
     cos, sin = np.cos(theta), np.sin(theta)
-    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
-    ys, xs = np.mgrid[0:h, 0:w]
+    if center is None:
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    else:
+        cx, cy = center
+    if expand:
+        out_h = int(np.ceil(abs(h * cos) + abs(w * sin)))
+        out_w = int(np.ceil(abs(w * cos) + abs(h * sin)))
+    else:
+        out_h, out_w = h, w
+    ys, xs = np.mgrid[0:out_h, 0:out_w]
+    if expand:
+        # recenter the enlarged canvas on the rotation center so the
+        # whole rotated image lands inside it
+        xs = xs - (out_w - 1) / 2.0 + cx
+        ys = ys - (out_h - 1) / 2.0 + cy
     # inverse rotation: output pixel -> source pixel
     src_x = cos * (xs - cx) + sin * (ys - cy) + cx
     src_y = -sin * (xs - cx) + cos * (ys - cy) + cy
@@ -178,7 +204,7 @@ def rotate(img, angle, resample=False, expand=False, center=None):
     take[h_ax], take[w_ax] = sy, sx
     out = arr[tuple(take)]
     mask_shape = [1] * arr.ndim
-    mask_shape[h_ax], mask_shape[w_ax] = h, w
+    mask_shape[h_ax], mask_shape[w_ax] = out_h, out_w
     out = out * valid.reshape(mask_shape).astype(out.dtype)
     return out
 
@@ -276,16 +302,19 @@ class RandomVerticalFlip:
 
 
 class Permute:
-    """HWC -> CHW (+ float conversion in 'float32' mode), matching
-    transforms.py Permute."""
+    """HWC -> CHW, with BGR->RGB channel reversal when to_rgb=True
+    (transforms.py Permute: cv2-loaded images are BGR)."""
 
     def __init__(self, mode="CHW", to_rgb=True):
         self.mode = mode
+        self.to_rgb = to_rgb
 
     def __call__(self, img):
         arr = np.asarray(img)
         if arr.ndim == 2:
             arr = arr[:, :, None]
+        if self.to_rgb and arr.shape[-1] == 3:
+            arr = arr[..., ::-1]
         if self.mode == "CHW" and arr.shape[-1] in (1, 3):
             arr = np.transpose(arr, (2, 0, 1))
         return np.ascontiguousarray(arr)

@@ -1,0 +1,60 @@
+"""Generic contrib layers (reference fluid/contrib/layers/nn.py subset)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import layers as L
+
+
+def test_shuffle_batch():
+    paddle.seed(0)
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    out = L.shuffle_batch(x, seed=3).numpy()
+    assert sorted(map(tuple, out.tolist())) == sorted(
+        map(tuple, x.numpy().tolist()))
+    # last dim rows stay intact
+    assert all(tuple(r) in {(0., 1.), (2., 3.), (4., 5.), (6., 7.)}
+               for r in out)
+
+
+def test_partial_concat_and_sum():
+    a = paddle.to_tensor(np.array([[1., 2., 3.], [4., 5., 6.]], np.float32))
+    b = paddle.to_tensor(np.array([[10., 20., 30.], [40., 50., 60.]],
+                                  np.float32))
+    cat = L.partial_concat([a, b], start_index=1, length=2).numpy()
+    np.testing.assert_allclose(cat, [[2, 3, 20, 30], [5, 6, 50, 60]])
+    s = L.partial_sum([a, b], start_index=0, length=2).numpy()
+    np.testing.assert_allclose(s, [[11, 22], [44, 55]])
+
+
+def test_batch_fc():
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(3, 4, 5)
+                         .astype(np.float32))
+    out, w, b = L.batch_fc(x, param_size=(3, 5, 6), bias_size=(3, 6),
+                           act="relu")
+    assert out.shape == (3, 4, 6)
+    assert w.shape == (3, 5, 6) and b.shape == (3, 6)
+    assert (out.numpy() >= 0).all()
+
+
+def test_fused_embedding_seq_pool():
+    paddle.seed(0)
+    ids = paddle.to_tensor(np.array([[1, 2, 0], [3, 0, 0]], np.int64))
+    w = paddle.to_tensor(np.arange(40, dtype=np.float32).reshape(10, 4))
+    lengths = paddle.to_tensor(np.array([2, 1], np.int64))
+    out = L.fused_embedding_seq_pool(ids, (10, 4), weight=w,
+                                     lengths=lengths).numpy()
+    np.testing.assert_allclose(out[0], w.numpy()[1] + w.numpy()[2])
+    np.testing.assert_allclose(out[1], w.numpy()[3])
+    mean = L.fused_embedding_seq_pool(ids, (10, 4), weight=w,
+                                      lengths=lengths,
+                                      combiner="mean").numpy()
+    np.testing.assert_allclose(mean[1], w.numpy()[3])
+
+
+def test_sparse_embedding_facade():
+    paddle.seed(0)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+    out = L.sparse_embedding(ids, size=(100, 8), padding_idx=0)
+    assert out.shape == (2, 2, 8)
+    np.testing.assert_allclose(out.numpy()[1, 1], np.zeros(8))

@@ -71,3 +71,23 @@ def test_worker_errors_propagate():
         list(reader.xmap_readers(lambda v: 1 // (v - v), r10(), 2, 4)())
     with pytest.raises(RuntimeError, match="boom"):
         list(reader.multiprocess_reader([bad()])())
+
+
+def test_xmap_source_error_releases_workers():
+    """Failing SOURCE reader must still send worker end-sentinels so no
+    threads park forever (review regression)."""
+    import threading
+    before = threading.active_count()
+
+    def bad():
+        def r():
+            raise IOError("nope")
+            yield 1
+        return r
+
+    for _ in range(3):
+        with pytest.raises(IOError):
+            list(reader.xmap_readers(lambda v: v, bad(), 2, 4)())
+    import time
+    time.sleep(0.3)
+    assert threading.active_count() <= before + 2

@@ -111,3 +111,29 @@ def test_cosine_decay_fluid_signature():
         sched.step()
     expected = 0.05 * (math.cos(math.pi / 4) + 1)
     assert abs(sched.get_lr() - expected) < 1e-9
+
+
+def test_rotate_expand_and_center():
+    img = np.ones((10, 20, 3), np.float32)
+    out = T.rotate(img, 90, expand=True)
+    assert out.shape[0] >= 19 and out.shape[1] >= 9   # canvas grew
+    same = T.rotate(img, 0, center=(5, 5))
+    np.testing.assert_array_equal(same, img)
+
+
+def test_permute_bgr_to_rgb():
+    img = np.zeros((2, 2, 3), np.uint8)
+    img[..., 0] = 10   # B
+    img[..., 2] = 30   # R
+    chw = T.Permute(to_rgb=True)(img)
+    assert chw[0, 0, 0] == 30 and chw[2, 0, 0] == 10
+    chw2 = T.Permute(to_rgb=False)(img)
+    assert chw2[0, 0, 0] == 10
+
+
+def test_resize_interpolation_modes():
+    mask = np.array([[0, 0], [3, 3]], np.float32)
+    out = T.resize(mask, (4, 4), interpolation="nearest")
+    assert set(np.unique(out)) <= {0.0, 3.0}           # no blended labels
+    with pytest.raises(ValueError):
+        T.Resize(4, interpolation="area")
