@@ -29,22 +29,30 @@ def shuffle_batch(x, seed=None):
     return ops.reshape(out, list(shape))
 
 
+def _norm_start(start_index, width):
+    """Negative start counts from the end (reference partial_concat_op.h
+    ComputeStartIndex)."""
+    return start_index + width if start_index < 0 else start_index
+
+
 def partial_concat(input, start_index=0, length=-1):
     """Concat a [start:start+length] column slice of each input
     (contrib nn.py:847 partial_concat_op)."""
     parts = []
     for v in input:
-        end = v.shape[1] if length < 0 else start_index + length
-        parts.append(v[:, start_index:end])
+        s = _norm_start(start_index, v.shape[1])
+        end = v.shape[1] if length < 0 else s + length
+        parts.append(v[:, s:end])
     return ops.concat(parts, axis=1)
 
 
 def partial_sum(input, start_index=0, length=-1):
     """Sum the same column slice across inputs (contrib nn.py:910)."""
-    end = input[0].shape[1] if length < 0 else start_index + length
-    out = input[0][:, start_index:end]
+    s = _norm_start(start_index, input[0].shape[1])
+    end = input[0].shape[1] if length < 0 else s + length
+    out = input[0][:, s:end]
     for v in input[1:]:
-        out = out + v[:, start_index:end]
+        out = out + v[:, s:end]
     return out
 
 
@@ -81,11 +89,14 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
                              dtype="float32", weight=None, lengths=None):
     """Embedding lookup + sequence pool in one step (contrib nn.py:471
     fused_embedding_seq_pool_op). Dense form: input (N, L) ids (+optional
-    lengths for padding-aware pooling); returns (N, D). Gradients flow
-    into `weight`."""
+    lengths for padding-aware pooling); returns (N, D), and gradients
+    flow into `weight`. When `weight` is omitted a fresh table is created
+    and the return becomes the pair (pooled, weight) so the caller can
+    train and reuse it."""
     from ..nn import functional as F
 
-    if weight is None:
+    created = weight is None
+    if created:
         key = random_mod.next_rng_key()
         weight = Tensor(jax.random.normal(key, tuple(size)) * 0.01,
                         stop_gradient=False)
@@ -102,20 +113,32 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
     else:
         denom = float(L)
     if combiner == "sum":
-        return ops.sum(emb, axis=1)
-    if combiner in ("mean", "avg"):
-        return ops.sum(emb, axis=1) / denom
-    raise ValueError(f"unsupported combiner {combiner}")
+        out = ops.sum(emb, axis=1)
+    elif combiner in ("mean", "avg"):
+        out = ops.sum(emb, axis=1) / denom
+    else:
+        raise ValueError(f"unsupported combiner {combiner}")
+    return (out, weight) if created else out
+
+
+_sparse_tables = {}
 
 
 def sparse_embedding(input, size, padding_idx=None, is_test=False,
-                     entry=None, param_attr=None, dtype="float32"):
+                     entry=None, param_attr=None, dtype="float32",
+                     name=None):
     """Large-scale sparse embedding facade (contrib nn.py:964) — routed
     to the parameter-server SparseEmbedding, the TPU answer to
-    large_scale_kv (see paddle_tpu/ps)."""
+    large_scale_kv (see paddle_tpu/ps). The backing layer is cached per
+    (name, dim), so repeated calls share ONE table (pulls stay
+    consistent and pushed gradients reach it); use the
+    ps.embedding.SparseEmbedding Layer directly for full control."""
     from ..ps.embedding import SparseEmbedding
 
-    layer = SparseEmbedding(int(size[1]))
+    key = (name or f"sparse_emb_{size[1]}", int(size[1]))
+    layer = _sparse_tables.get(key)
+    if layer is None:
+        layer = _sparse_tables[key] = SparseEmbedding(int(size[1]))
     out = layer(input)
     if padding_idx is not None:
         mask = ops.cast(ops.unsqueeze(input != padding_idx, [-1]),

@@ -142,5 +142,25 @@ def capi_lib():
         lib.PD_GetOutputFloat.argtypes = [
             c.c_void_p, c.c_int, c.POINTER(c.POINTER(c.c_float)),
             c.POINTER(c.POINTER(c.c_int64)), c.POINTER(c.c_int)]
+        lib.PD_NewTrainer.restype = c.c_void_p
+        lib.PD_NewTrainer.argtypes = [c.c_char_p]
+        lib.PD_DeleteTrainer.argtypes = [c.c_void_p]
+        lib.PD_TrainerSetInputFloat.restype = c.c_int
+        lib.PD_TrainerSetInputFloat.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_float),
+            c.POINTER(c.c_int64), c.c_int]
+        lib.PD_TrainerSetInputInt64.restype = c.c_int
+        lib.PD_TrainerSetInputInt64.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_int64),
+            c.POINTER(c.c_int64), c.c_int]
+        lib.PD_TrainerRun.restype = c.c_int
+        lib.PD_TrainerRun.argtypes = [
+            c.c_void_p, c.POINTER(c.c_char_p), c.c_int]
+        lib.PD_TrainerGetFetchFloat.restype = c.c_int
+        lib.PD_TrainerGetFetchFloat.argtypes = [
+            c.c_void_p, c.c_int, c.POINTER(c.POINTER(c.c_float)),
+            c.POINTER(c.POINTER(c.c_int64)), c.POINTER(c.c_int)]
+        lib.PD_TrainerSave.restype = c.c_int
+        lib.PD_TrainerSave.argtypes = [c.c_void_p, c.c_char_p]
         lib._pt_typed = True
     return lib

@@ -44,6 +44,27 @@ int PD_GetOutputNum(const PD_Predictor* p);
 int PD_GetOutputFloat(const PD_Predictor* p, int idx, const float** data,
                       const int64_t** shape, int* ndim);
 
+/* Trainer: run a saved (main, startup) training-program pair from C —
+ * the reference C++ train demo (fluid/train/demo/demo_trainer.cc).
+ * Save the pair from Python with static.save_train_program(dir, main,
+ * startup); fetch buffers are float32 and stay valid until the next
+ * PD_TrainerRun or PD_DeleteTrainer. */
+typedef struct PD_Trainer PD_Trainer;
+
+PD_Trainer* PD_NewTrainer(const char* program_dir);
+void PD_DeleteTrainer(PD_Trainer* t);
+int PD_TrainerSetInputFloat(PD_Trainer* t, const char* name,
+                            const float* data, const int64_t* shape,
+                            int ndim);
+int PD_TrainerSetInputInt64(PD_Trainer* t, const char* name,
+                            const int64_t* data, const int64_t* shape,
+                            int ndim);
+int PD_TrainerRun(PD_Trainer* t, const char** fetch_names, int num_fetch);
+int PD_TrainerGetFetchFloat(const PD_Trainer* t, int idx,
+                            const float** data, const int64_t** shape,
+                            int* ndim);
+int PD_TrainerSave(PD_Trainer* t, const char* dirname);
+
 #ifdef __cplusplus
 }
 #endif

@@ -52,6 +52,34 @@ def _run(pred, feeds):
         a = np.ascontiguousarray(np.asarray(a, dtype=np.float32))
         res.append((a.tobytes(), list(a.shape)))
     return res
+
+def _new_trainer(dirpath):
+    # C++ train-demo parity (reference fluid/train/demo/demo_trainer.cc):
+    # load the (main, startup) program pair, run startup once
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)
+    import paddle_tpu.static as static
+    main = static.load_program(os.path.join(dirpath, "main_program"))
+    startup = static.load_program(os.path.join(dirpath, "startup_program"))
+    exe = static.Executor()
+    exe.run(startup)       # initializes params in the global scope
+    return (exe, main)
+
+def _train_run(tr, feeds, fetch_names):
+    exe, main = tr
+    outs = exe.run(main, feed=feeds, fetch_list=list(fetch_names))
+    res = []
+    for a in outs:
+        a = np.ascontiguousarray(np.asarray(a, dtype=np.float32))
+        res.append((a.tobytes(), list(a.shape)))
+    return res
+
+def _train_save(tr, dirname):
+    exe, main = tr
+    import paddle_tpu.static as static
+    static.save_persistables(exe, dirname, main)
 )PY";
 
 struct Output {
@@ -120,6 +148,46 @@ PyObject* helper_call(const char* fn, PyObject* args) {
   PyObject* out = PyObject_CallObject(f, args);
   if (out == nullptr) set_error_from_python();
   return out;
+}
+
+// Shared feed staging (predictor + trainer): copy a raw buffer into the
+// feeds dict as an ndarray. GIL taken by the caller-facing wrappers.
+int stage_input(PyObject* feeds, const char* name, const void* data,
+                int64_t elem_size, const char* dtype, const int64_t* shape,
+                int ndim) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t n = 1;
+  PyObject* shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyList_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* buf = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), n * elem_size);
+  PyObject* args = Py_BuildValue("(OsOOs)", feeds, name, buf, shp, dtype);
+  PyObject* r = helper_call("_set_input", args);
+  Py_DECREF(args);
+  Py_DECREF(buf);
+  Py_DECREF(shp);
+  int rc = (r == nullptr) ? -1 : 0;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Shared fetch unpacking: [(bytes, shape), ...] -> outputs. Caller holds
+// the GIL and has cleared the previous outputs.
+void collect_outputs(PyObject* res, std::vector<Output>* outputs) {
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    PyObject* item = PyList_GetItem(res, i);  // (bytes, shape)
+    Output o;
+    o.bytes = PyTuple_GetItem(item, 0);
+    Py_INCREF(o.bytes);
+    PyObject* shp = PyTuple_GetItem(item, 1);
+    for (Py_ssize_t j = 0; j < PyList_Size(shp); ++j)
+      o.shape.push_back(PyLong_AsLongLong(PyList_GetItem(shp, j)));
+    outputs->push_back(o);
+  }
 }
 
 }  // namespace
@@ -197,25 +265,7 @@ const char* PD_GetInputName(const PD_Predictor* p, int i) {
 static int set_input(PD_Predictor* p, const char* name, const void* data,
                      int64_t elem_size, const char* dtype,
                      const int64_t* shape, int ndim) {
-  PyGILState_STATE gil = PyGILState_Ensure();
-  int64_t n = 1;
-  PyObject* shp = PyList_New(ndim);
-  for (int i = 0; i < ndim; ++i) {
-    n *= shape[i];
-    PyList_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
-  }
-  PyObject* buf = PyBytes_FromStringAndSize(
-      static_cast<const char*>(data), n * elem_size);
-  PyObject* args = Py_BuildValue("(OsOOs)", p->feeds, name, buf, shp,
-                                 dtype);
-  PyObject* r = helper_call("_set_input", args);
-  Py_DECREF(args);
-  Py_DECREF(buf);
-  Py_DECREF(shp);
-  int rc = (r == nullptr) ? -1 : 0;
-  Py_XDECREF(r);
-  PyGILState_Release(gil);
-  return rc;
+  return stage_input(p->feeds, name, data, elem_size, dtype, shape, ndim);
 }
 
 int PD_SetInputFloat(PD_Predictor* p, const char* name, const float* data,
@@ -246,16 +296,7 @@ int PD_Run(PD_Predictor* p) {
     PyGILState_Release(gil);
     return -1;
   }
-  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
-    PyObject* item = PyList_GetItem(res, i);  // (bytes, shape)
-    Output o;
-    o.bytes = PyTuple_GetItem(item, 0);
-    Py_INCREF(o.bytes);
-    PyObject* shp = PyTuple_GetItem(item, 1);
-    for (Py_ssize_t j = 0; j < PyList_Size(shp); ++j)
-      o.shape.push_back(PyLong_AsLongLong(PyList_GetItem(shp, j)));
-    p->outputs.push_back(o);
-  }
+  collect_outputs(res, &p->outputs);
   Py_DECREF(res);
   PyGILState_Release(gil);
   return 0;
@@ -273,6 +314,107 @@ int PD_GetOutputFloat(const PD_Predictor* p, int idx, const float** data,
   *shape = o.shape.data();
   *ndim = static_cast<int>(o.shape.size());
   return 0;
+}
+
+// -- trainer: C++ train-demo parity (demo_trainer.cc) ----------------------
+
+struct PD_Trainer {
+  PyObject* tr = nullptr;     // (executor, main_program) tuple
+  PyObject* feeds = nullptr;  // dict name -> ndarray
+  std::vector<Output> outputs;
+};
+
+PD_Trainer* PD_NewTrainer(const char* program_dir) {
+  if (!ensure_helper()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(s)", program_dir);
+  PyObject* tr = helper_call("_new_trainer", args);
+  Py_DECREF(args);
+  if (tr == nullptr) {
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  PD_Trainer* t = new PD_Trainer();
+  t->tr = tr;
+  t->feeds = PyDict_New();
+  PyGILState_Release(gil);
+  return t;
+}
+
+static int trainer_set_input(PD_Trainer* t, const char* name,
+                             const void* data, int64_t elem_size,
+                             const char* dtype, const int64_t* shape,
+                             int ndim) {
+  return stage_input(t->feeds, name, data, elem_size, dtype, shape, ndim);
+}
+
+int PD_TrainerSetInputFloat(PD_Trainer* t, const char* name,
+                            const float* data, const int64_t* shape,
+                            int ndim) {
+  return trainer_set_input(t, name, data, 4, "float32", shape, ndim);
+}
+
+int PD_TrainerSetInputInt64(PD_Trainer* t, const char* name,
+                            const int64_t* data, const int64_t* shape,
+                            int ndim) {
+  return trainer_set_input(t, name, data, 8, "int64", shape, ndim);
+}
+
+// One optimizer step over the staged feed; fetches `fetch_names`
+// (e.g. the loss) as float32. Buffers valid until next call/delete.
+int PD_TrainerRun(PD_Trainer* t, const char** fetch_names,
+                  int num_fetch) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  for (Output& o : t->outputs) Py_XDECREF(o.bytes);
+  t->outputs.clear();
+  PyObject* names = PyList_New(num_fetch);
+  for (int i = 0; i < num_fetch; ++i)
+    PyList_SetItem(names, i, PyUnicode_FromString(fetch_names[i]));
+  PyObject* args = Py_BuildValue("(OOO)", t->tr, t->feeds, names);
+  PyObject* res = helper_call("_train_run", args);
+  Py_DECREF(args);
+  Py_DECREF(names);
+  if (res == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  collect_outputs(res, &t->outputs);
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int PD_TrainerGetFetchFloat(const PD_Trainer* t, int idx,
+                            const float** data, const int64_t** shape,
+                            int* ndim) {
+  if (idx < 0 || idx >= static_cast<int>(t->outputs.size())) return -1;
+  const Output& o = t->outputs[idx];
+  *data = reinterpret_cast<const float*>(PyBytes_AsString(o.bytes));
+  *shape = o.shape.data();
+  *ndim = static_cast<int>(o.shape.size());
+  return 0;
+}
+
+// Save the trained persistables (params + optimizer slots) to dirname.
+int PD_TrainerSave(PD_Trainer* t, const char* dirname) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(Os)", t->tr, dirname);
+  PyObject* r = helper_call("_train_save", args);
+  Py_DECREF(args);
+  int rc = (r == nullptr) ? -1 : 0;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void PD_DeleteTrainer(PD_Trainer* t) {
+  if (t == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  for (Output& o : t->outputs) Py_XDECREF(o.bytes);
+  Py_XDECREF(t->feeds);
+  Py_XDECREF(t->tr);
+  PyGILState_Release(gil);
+  delete t;
 }
 
 void PD_DeletePredictor(PD_Predictor* p) {

@@ -26,6 +26,8 @@ _state = {
     "trace_dir": None,
     # name -> [calls, total_s, min_s, max_s]
     "events": defaultdict(lambda: [0, 0.0, float("inf"), 0.0]),
+    # (name, start_us, dur_us, tid) spans for chrome-trace export
+    "spans": [],
 }
 
 
@@ -54,12 +56,18 @@ class RecordEvent:
             self._ann.__exit__(None, None, None)
             self._ann = None
         if self._t0 is not None:
-            dt = time.perf_counter() - self._t0
+            t1 = time.perf_counter()
+            dt = t1 - self._t0
             rec = _state["events"][self.name]
             rec[0] += 1
             rec[1] += dt
             rec[2] = min(rec[2], dt)
             rec[3] = max(rec[3], dt)
+            import threading
+
+            _state["spans"].append(
+                (self.name, self._t0 * 1e6, dt * 1e6,
+                 threading.get_ident() & 0xFFFF))
             self._t0 = None
 
     __enter__ = begin
@@ -79,6 +87,7 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
     (reference profiler.py:131; state kept for API parity)."""
     _state["enabled"] = True
     _state["events"].clear()
+    _state["spans"].clear()
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
         jax.profiler.start_trace(trace_dir)
@@ -130,6 +139,26 @@ def profiler(state: str = "All", sorted_key: str = "total",
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+def export_chrome_tracing(path: str, process_name: str = "paddle_tpu"):
+    """Write recorded host spans as a chrome://tracing JSON file — the
+    reference's timeline output (platform/profiler.proto + tools
+    timeline.py). Device-side traces live in the XPlane dir from
+    start_profiler(trace_dir=...)."""
+    import json
+
+    events = [{"name": "process_name", "ph": "M", "pid": 0,
+               "args": {"name": process_name}}]
+    for name, start_us, dur_us, tid in _state["spans"]:
+        events.append({"name": name, "ph": "X", "pid": 0, "tid": tid,
+                       "ts": start_us, "dur": dur_us, "cat": "host"})
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
 
 
 # convenience re-exports of the underlying device tracer

@@ -33,8 +33,9 @@ from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .io import (load_inference_model, load_params,  # noqa: F401
-                 load_persistables, save_inference_model, save_params,
-                 save_persistables)
+                 load_persistables, load_program, save_inference_model,
+                 save_params, save_persistables, save_program,
+                 save_train_program)
 from .ir import (Block, OpDesc, Program, VarDesc, Variable,  # noqa: F401
                  default_main_program, default_startup_program,
                  program_guard)

@@ -108,3 +108,27 @@ def load_inference_model(dirname: str, executor: Executor,
         scope.set(k, jnp.asarray(v))
     fetch_vars = [program.global_block.var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars
+
+
+def save_program(program: Program, path: str):
+    """Serialize one program to a file (the reference C++ train demo's
+    main_program/startup_program files — train/demo/demo_trainer.cc:41
+    Load reads exactly such a pair)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(program.serialize_to_string())
+
+
+def load_program(path: str) -> Program:
+    with open(path, "rb") as f:
+        return Program.parse_from_string(f.read())
+
+
+def save_train_program(dirname: str, main: Program, startup: Program):
+    """Save the (main, startup) pair the C trainer API consumes
+    (native/src/capi.cc PD_NewTrainer)."""
+    os.makedirs(dirname, exist_ok=True)
+    save_program(main, os.path.join(dirname, "main_program"))
+    save_program(startup, os.path.join(dirname, "startup_program"))

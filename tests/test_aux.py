@@ -151,3 +151,23 @@ def test_check_nan_inf_flag():
             (x * 1.0).numpy()
     finally:
         flags.set_flags({"check_nan_inf": False})
+
+
+def test_profiler_chrome_trace_export(tmp_path):
+    """Host spans export as chrome://tracing JSON (reference timeline.py
+    output format)."""
+    import json
+
+    from paddle_tpu import profiler
+
+    profiler.start_profiler()
+    with profiler.RecordEvent("step"):
+        with profiler.RecordEvent("forward"):
+            pass
+    profiler.stop_profiler(profile_path=str(tmp_path / "table.txt"))
+    out = profiler.export_chrome_tracing(str(tmp_path / "trace.json"))
+    data = json.load(open(out))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert {"step", "forward"} <= names
+    xs = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 and "ts" in e for e in xs)

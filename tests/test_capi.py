@@ -173,3 +173,58 @@ def test_capi_run_from_worker_thread(saved_model):
     assert not t.is_alive(), "worker thread deadlocked (GIL not released?)"
     assert "err" not in result, result.get("err")
     np.testing.assert_allclose(result["out"], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_capi_trainer(tmp_path):
+    """C trainer API: load a saved (main, startup) pair, train steps from
+    C, loss decreases, save persistables (reference
+    fluid/train/demo/demo_trainer.cc flow)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.native import capi_lib
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4])
+        y = static.data("y", [-1, 1])
+        pred = static.nn.fc(x, 1)
+        loss = static.mean(static.square_error_cost(pred, y))
+        static.SGD(learning_rate=0.05).minimize(loss)
+    prog_dir = str(tmp_path / "train_prog")
+    static.save_train_program(prog_dir, main, startup)
+    loss_name = loss.name
+
+    lib = capi_lib()
+    assert lib is not None
+    t = lib.PD_NewTrainer(prog_dir.encode())
+    assert t, lib.PD_GetLastError()
+    try:
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(4, 1).astype(np.float32)
+        losses = []
+        shape_x = (ctypes.c_int64 * 2)(16, 4)
+        shape_y = (ctypes.c_int64 * 2)(16, 1)
+        fetches = (ctypes.c_char_p * 1)(loss_name.encode())
+        for step in range(30):
+            xb = rng.randn(16, 4).astype(np.float32)
+            yb = (xb @ w_true).astype(np.float32)
+            assert lib.PD_TrainerSetInputFloat(
+                t, b"x", xb.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                shape_x, 2) == 0
+            assert lib.PD_TrainerSetInputFloat(
+                t, b"y", yb.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                shape_y, 2) == 0
+            assert lib.PD_TrainerRun(t, fetches, 1) == 0, \
+                lib.PD_GetLastError()
+            out = ctypes.POINTER(ctypes.c_float)()
+            shp = ctypes.POINTER(ctypes.c_int64)()
+            nd = ctypes.c_int()
+            assert lib.PD_TrainerGetFetchFloat(
+                t, 0, ctypes.byref(out), ctypes.byref(shp),
+                ctypes.byref(nd)) == 0
+            losses.append(float(out[0]))
+        assert losses[-1] < losses[0] * 0.2, losses[:3] + losses[-3:]
+        save_dir = str(tmp_path / "saved")
+        assert lib.PD_TrainerSave(t, save_dir.encode()) == 0
+        assert os.listdir(save_dir)
+    finally:
+        lib.PD_DeleteTrainer(t)

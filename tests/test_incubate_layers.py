@@ -58,3 +58,30 @@ def test_sparse_embedding_facade():
     out = L.sparse_embedding(ids, size=(100, 8), padding_idx=0)
     assert out.shape == (2, 2, 8)
     np.testing.assert_allclose(out.numpy()[1, 1], np.zeros(8))
+
+
+def test_partial_negative_start_and_created_weight():
+    """Review regressions: negative start_index counts from the end
+    (reference ComputeStartIndex); omitted weight is returned for
+    training."""
+    a = paddle.to_tensor(np.array([[1., 2., 3.]], np.float32))
+    out = L.partial_concat([a], start_index=-2, length=2).numpy()
+    np.testing.assert_allclose(out, [[2., 3.]])
+    out = L.partial_sum([a, a], start_index=-1, length=1).numpy()
+    np.testing.assert_allclose(out, [[6.]])
+
+    paddle.seed(0)
+    ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+    pooled, w = L.fused_embedding_seq_pool(ids, (10, 4))
+    assert w.shape == (10, 4) and pooled.shape == (1, 4)
+    np.testing.assert_allclose(pooled.numpy()[0],
+                               w.numpy()[1] + w.numpy()[2], rtol=1e-6)
+
+
+def test_sparse_embedding_cached_table():
+    """Repeated calls share one table (review regression: a fresh table
+    per call made the embedding pure noise)."""
+    ids = paddle.to_tensor(np.array([[5, 9]], np.int64))
+    a = L.sparse_embedding(ids, size=(100, 8), name="shared").numpy()
+    b = L.sparse_embedding(ids, size=(100, 8), name="shared").numpy()
+    np.testing.assert_allclose(a, b)

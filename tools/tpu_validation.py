@@ -1,0 +1,76 @@
+"""One-shot TPU validation batch (run when the axon tunnel is alive):
+1. flash-attention dropout kernel tests (tests/test_flash_dropout_tpu.py)
+2. attention micro-bench: XLA+dropout vs Pallas in-kernel dropout
+3. bench.py (BERT-base tokens/s; the driver-contract metric)
+Usage: PYTHONPATH=/root/repo python tools/tpu_validation.py
+"""
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_kernel_tests():
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_flash_dropout_tpu.py",
+         "-q", "-p", "no:cacheprovider"],
+        env={**os.environ, "PYTHONPATH": "/root/repo"},
+        capture_output=True, text=True, timeout=2400)
+    tail = "\n".join((r.stdout + r.stderr).splitlines()[-6:])
+    print("== kernel tests ==\n" + tail)
+    return r.returncode == 0
+
+
+def attention_microbench():
+    import numpy as np
+    import jax, jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _flash_attention_pallas_dropout, _xla_attention)
+
+    rng = np.random.RandomState(0)
+    B, L, H, D = 128, 128, 12, 64
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, L, H, D), jnp.bfloat16)
+    seed = jnp.asarray([[7]], jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    def timeit(fn, n=30):
+        fn()  # compile
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    xla = jax.jit(lambda: _xla_attention(q, k, v, None, 0.1, False, key))
+    pallas = lambda: _flash_attention_pallas_dropout(q, k, v, seed, 0.1)
+    print(f"== attention fwd (B{B} L{L} H{H} D{D} bf16, dropout 0.1) ==")
+    print(f"xla+dropout:    {timeit(xla):.3f} ms")
+    print(f"pallas dropout: {timeit(pallas):.3f} ms")
+
+    def grad_of(fn):
+        g = jax.jit(jax.grad(lambda qq: jnp.sum(fn(qq).astype(jnp.float32))))
+        return lambda: g(q)
+
+    print(f"xla+dropout grad:    "
+          f"{timeit(grad_of(lambda qq: _xla_attention(qq, k, v, None, 0.1, False, key))):.3f} ms")
+    print(f"pallas dropout grad: "
+          f"{timeit(grad_of(lambda qq: _flash_attention_pallas_dropout(qq, k, v, seed, 0.1))):.3f} ms")
+
+
+def run_bench():
+    r = subprocess.run([sys.executable, "bench.py"],
+                       env={**os.environ, "PYTHONPATH": "/root/repo"},
+                       capture_output=True, text=True, timeout=2400)
+    print("== bench ==\n" + "\n".join(
+        line for line in r.stdout.splitlines() if line.startswith("{")))
+
+
+if __name__ == "__main__":
+    ok = run_kernel_tests()
+    attention_microbench()
+    run_bench()
+    sys.exit(0 if ok else 1)
