@@ -1,0 +1,66 @@
+"""Static-graph image classification (reference book/
+test_image_classification.py shape): small ResNet on CIFAR-sized data via
+Program/Executor, with save_inference_model at the end.
+
+Run: PYTHONPATH=. python examples/train_resnet_static.py  (add
+JAX_PLATFORMS=cpu off-TPU)
+"""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.vision.datasets import Cifar10
+
+
+def conv_bn(x, ch, stride=1, act="relu"):
+    h = static.nn.conv2d(x, ch, 3, stride=stride, padding=1,
+                         bias_attr=False)
+    return static.nn.batch_norm(h, act=act)
+
+
+def basic_block(x, ch, stride=1):
+    h = conv_bn(x, ch, stride)
+    h = conv_bn(h, ch, act=None)
+    short = x if stride == 1 and x.shape[1] == ch else \
+        static.nn.conv2d(x, ch, 1, stride=stride, bias_attr=False)
+    return static.relu(static.elementwise_add(h, short))
+
+
+def main():
+    ds = Cifar10(mode="train", synthetic_size=1024)
+    imgs = np.stack([ds[i][0] for i in range(512)]).astype(np.float32)
+    labels = np.stack([ds[i][1] for i in range(512)]).reshape(-1, 1)
+
+    main_prog, startup = static.Program(), static.Program()
+    with static.program_guard(main_prog, startup):
+        img = static.data("img", [-1, 3, 32, 32])
+        label = static.data("label", [-1, 1], dtype="int64")
+        h = conv_bn(img, 16)
+        h = basic_block(h, 16)
+        h = basic_block(h, 32, stride=2)
+        h = basic_block(h, 64, stride=2)
+        h = static.nn.pool2d(h, 8, pool_type="avg")
+        logits = static.nn.fc(h, 10)
+        loss = static.mean(
+            static.softmax_with_cross_entropy(logits, label))
+        acc = static.accuracy(static.softmax(logits), label)
+        static.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    for epoch in range(3):
+        perm = np.random.RandomState(epoch).permutation(len(imgs))
+        for i in range(0, len(imgs) - 64, 64):
+            sl = perm[i:i + 64]
+            lo, ac = exe.run(main_prog,
+                             feed={"img": imgs[sl], "label": labels[sl]},
+                             fetch_list=[loss, acc])
+        print(f"epoch {epoch}: loss={float(np.asarray(lo)):.4f} "
+              f"acc={float(np.asarray(ac)):.3f}")
+
+    static.save_inference_model("/tmp/resnet_static", ["img"], [logits],
+                                exe, main_prog)
+    print("saved inference model to /tmp/resnet_static")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,225 @@
+// Package paddle is the Go wrapper over the paddle_tpu C API
+// (reference go/paddle/{predictor,config,tensor,common}.go wrapping
+// paddle_c_api.h; here it wraps native/include/paddle_tpu_capi.h).
+//
+// Build: the capi shared library must be built first —
+//   python -c "from paddle_tpu.native import capi_lib; print(capi_lib()._name)"
+// then:
+//   CGO_CFLAGS="-I$REPO/paddle_tpu/native/include" \
+//   CGO_LDFLAGS="$CAPI_SO -Wl,-rpath,$(dirname $CAPI_SO)" go build ./...
+//
+// NOTE: the build image ships no Go toolchain, so this package is
+// provided as source parity with the reference Go API and exercised via
+// the identical C calls in tests/test_capi.py.
+package paddle
+
+// #include <stdint.h>
+// #include <stdlib.h>
+// #include "paddle_tpu_capi.h"
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+func lastError() error {
+	return errors.New(C.GoString(C.PD_GetLastError()))
+}
+
+// Init extends sys.path of the embedded interpreter (e.g. with the
+// directory containing the paddle_tpu package). Call once before use.
+func Init(extraSysPath string) error {
+	cs := C.CString(extraSysPath)
+	defer C.free(unsafe.Pointer(cs))
+	if C.PD_Init(cs) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Predictor runs models exported with paddle_tpu.jit.save.
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+func NewPredictor(modelPrefix string) (*Predictor, error) {
+	cs := C.CString(modelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	p := C.PD_NewPredictor(cs)
+	if p == nil {
+		return nil, lastError()
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, (*Predictor).finalize)
+	return pred, nil
+}
+
+func (p *Predictor) finalize() { C.PD_DeletePredictor(p.c) }
+
+func (p *Predictor) InputNum() int { return int(C.PD_GetInputNum(p.c)) }
+
+func (p *Predictor) InputName(i int) string {
+	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) SetInputFloat(name string, data []float32,
+	shape []int64) error {
+	cs := C.CString(name)
+	defer C.free(unsafe.Pointer(cs))
+	rc := C.PD_SetInputFloat(p.c, cs, (*C.float)(&data[0]),
+		(*C.int64_t)(&shape[0]), C.int(len(shape)))
+	runtime.KeepAlive(p)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (p *Predictor) SetInputInt64(name string, data []int64,
+	shape []int64) error {
+	cs := C.CString(name)
+	defer C.free(unsafe.Pointer(cs))
+	rc := C.PD_SetInputInt64(p.c, cs, (*C.int64_t)(&data[0]),
+		(*C.int64_t)(&shape[0]), C.int(len(shape)))
+	runtime.KeepAlive(p)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (p *Predictor) Run() error {
+	rc := C.PD_Run(p.c)
+	runtime.KeepAlive(p)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (p *Predictor) OutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+
+// OutputFloat copies output idx into a fresh slice plus its shape.
+func (p *Predictor) OutputFloat(idx int) ([]float32, []int64, error) {
+	var data *C.float
+	var shape *C.int64_t
+	var ndim C.int
+	rc := C.PD_GetOutputFloat(p.c, C.int(idx), &data, &shape, &ndim)
+	if rc != 0 {
+		return nil, nil, lastError()
+	}
+	shp := make([]int64, int(ndim))
+	n := int64(1)
+	cshape := unsafe.Slice((*int64)(unsafe.Pointer(shape)), int(ndim))
+	for i, d := range cshape {
+		shp[i] = d
+		n *= d
+	}
+	out := make([]float32, n)
+	copy(out, unsafe.Slice((*float32)(unsafe.Pointer(data)), int(n)))
+	runtime.KeepAlive(p)
+	return out, shp, nil
+}
+
+// Trainer runs a saved (main, startup) training-program pair
+// (reference fluid/train/demo/demo_trainer.cc; save the pair with
+// paddle_tpu.static.save_train_program).
+type Trainer struct {
+	c *C.PD_Trainer
+}
+
+func NewTrainer(programDir string) (*Trainer, error) {
+	cs := C.CString(programDir)
+	defer C.free(unsafe.Pointer(cs))
+	t := C.PD_NewTrainer(cs)
+	if t == nil {
+		return nil, lastError()
+	}
+	tr := &Trainer{c: t}
+	runtime.SetFinalizer(tr, (*Trainer).finalize)
+	return tr, nil
+}
+
+func (t *Trainer) finalize() { C.PD_DeleteTrainer(t.c) }
+
+func (t *Trainer) SetInputFloat(name string, data []float32,
+	shape []int64) error {
+	cs := C.CString(name)
+	defer C.free(unsafe.Pointer(cs))
+	rc := C.PD_TrainerSetInputFloat(t.c, cs, (*C.float)(&data[0]),
+		(*C.int64_t)(&shape[0]), C.int(len(shape)))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (t *Trainer) SetInputInt64(name string, data []int64,
+	shape []int64) error {
+	cs := C.CString(name)
+	defer C.free(unsafe.Pointer(cs))
+	rc := C.PD_TrainerSetInputInt64(t.c, cs, (*C.int64_t)(&data[0]),
+		(*C.int64_t)(&shape[0]), C.int(len(shape)))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Run performs one optimizer step and fetches fetchNames as float32.
+// At least one fetch name is required (e.g. the loss variable).
+func (t *Trainer) Run(fetchNames []string) error {
+	if len(fetchNames) == 0 {
+		return errors.New("Trainer.Run needs at least one fetch name")
+	}
+	cnames := make([]*C.char, len(fetchNames))
+	for i, n := range fetchNames {
+		cnames[i] = C.CString(n)
+		defer C.free(unsafe.Pointer(cnames[i]))
+	}
+	rc := C.PD_TrainerRun(t.c, (**C.char)(&cnames[0]),
+		C.int(len(cnames)))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (t *Trainer) FetchFloat(idx int) ([]float32, []int64, error) {
+	var data *C.float
+	var shape *C.int64_t
+	var ndim C.int
+	rc := C.PD_TrainerGetFetchFloat(t.c, C.int(idx), &data, &shape,
+		&ndim)
+	if rc != 0 {
+		return nil, nil, lastError()
+	}
+	shp := make([]int64, int(ndim))
+	n := int64(1)
+	cshape := unsafe.Slice((*int64)(unsafe.Pointer(shape)), int(ndim))
+	for i, d := range cshape {
+		shp[i] = d
+		n *= d
+	}
+	out := make([]float32, n)
+	copy(out, unsafe.Slice((*float32)(unsafe.Pointer(data)), int(n)))
+	runtime.KeepAlive(t)
+	return out, shp, nil
+}
+
+// Save writes trained persistables (params + optimizer state).
+func (t *Trainer) Save(dirname string) error {
+	cs := C.CString(dirname)
+	defer C.free(unsafe.Pointer(cs))
+	rc := C.PD_TrainerSave(t.c, cs)
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}

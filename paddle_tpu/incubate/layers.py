@@ -124,18 +124,33 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
 _sparse_tables = {}
 
 
+def reset_sparse_tables():
+    """Drop all cached sparse_embedding tables (tests / fresh models)."""
+    _sparse_tables.clear()
+
+
 def sparse_embedding(input, size, padding_idx=None, is_test=False,
                      entry=None, param_attr=None, dtype="float32",
                      name=None):
     """Large-scale sparse embedding facade (contrib nn.py:964) — routed
     to the parameter-server SparseEmbedding, the TPU answer to
     large_scale_kv (see paddle_tpu/ps). The backing layer is cached per
-    (name, dim), so repeated calls share ONE table (pulls stay
-    consistent and pushed gradients reach it); use the
-    ps.embedding.SparseEmbedding Layer directly for full control."""
+    (name, size), so repeated calls with the same name share ONE table
+    (pulls stay consistent and pushed gradients reach it). A name is
+    REQUIRED (via name= or param_attr.name) — it is what distinguishes
+    two sparse features, exactly like the reference's parameter name.
+    Use the ps.embedding.SparseEmbedding Layer directly for full
+    control."""
     from ..ps.embedding import SparseEmbedding
 
-    key = (name or f"sparse_emb_{size[1]}", int(size[1]))
+    if name is None:
+        name = getattr(param_attr, "name", None)
+    if not name:
+        raise ValueError(
+            "sparse_embedding needs a stable table name: pass name=... "
+            "(or param_attr with a name); it identifies the shared table "
+            "across calls, like the reference's parameter name")
+    key = (name, int(size[0]), int(size[1]))
     layer = _sparse_tables.get(key)
     if layer is None:
         layer = _sparse_tables[key] = SparseEmbedding(int(size[1]))

@@ -55,7 +55,9 @@ def _run(pred, feeds):
 
 def _new_trainer(dirpath):
     # C++ train-demo parity (reference fluid/train/demo/demo_trainer.cc):
-    # load the (main, startup) program pair, run startup once
+    # load the (main, startup) program pair, run startup once. Each
+    # trainer owns a private Scope, so two trainers never clobber each
+    # other's parameters.
     want = os.environ.get("JAX_PLATFORMS")
     if want:
         import jax
@@ -64,12 +66,16 @@ def _new_trainer(dirpath):
     main = static.load_program(os.path.join(dirpath, "main_program"))
     startup = static.load_program(os.path.join(dirpath, "startup_program"))
     exe = static.Executor()
-    exe.run(startup)       # initializes params in the global scope
-    return (exe, main)
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+    return (exe, main, scope)
 
 def _train_run(tr, feeds, fetch_names):
-    exe, main = tr
-    outs = exe.run(main, feed=feeds, fetch_list=list(fetch_names))
+    import paddle_tpu.static as static
+    exe, main, scope = tr
+    with static.scope_guard(scope):
+        outs = exe.run(main, feed=feeds, fetch_list=list(fetch_names))
     res = []
     for a in outs:
         a = np.ascontiguousarray(np.asarray(a, dtype=np.float32))
@@ -77,9 +83,10 @@ def _train_run(tr, feeds, fetch_names):
     return res
 
 def _train_save(tr, dirname):
-    exe, main = tr
+    exe, main, scope = tr
     import paddle_tpu.static as static
-    static.save_persistables(exe, dirname, main)
+    with static.scope_guard(scope):
+        static.save_persistables(exe, dirname, main)
 )PY";
 
 struct Output {

@@ -28,6 +28,8 @@ _state = {
     "events": defaultdict(lambda: [0, 0.0, float("inf"), 0.0]),
     # (name, start_us, dur_us, tid) spans for chrome-trace export
     "spans": [],
+    # thread ident -> small sequential tid (stable chrome-trace rows)
+    "tids": {},
 }
 
 
@@ -65,9 +67,10 @@ class RecordEvent:
             rec[3] = max(rec[3], dt)
             import threading
 
+            ident = threading.get_ident()
+            tid = _state["tids"].setdefault(ident, len(_state["tids"]))
             _state["spans"].append(
-                (self.name, self._t0 * 1e6, dt * 1e6,
-                 threading.get_ident() & 0xFFFF))
+                (self.name, self._t0 * 1e6, dt * 1e6, tid))
             self._t0 = None
 
     __enter__ = begin

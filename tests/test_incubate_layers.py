@@ -55,7 +55,8 @@ def test_fused_embedding_seq_pool():
 def test_sparse_embedding_facade():
     paddle.seed(0)
     ids = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
-    out = L.sparse_embedding(ids, size=(100, 8), padding_idx=0)
+    out = L.sparse_embedding(ids, size=(100, 8), padding_idx=0,
+                             name="facade_t")
     assert out.shape == (2, 2, 8)
     np.testing.assert_allclose(out.numpy()[1, 1], np.zeros(8))
 
@@ -76,6 +77,13 @@ def test_partial_negative_start_and_created_weight():
     assert w.shape == (10, 4) and pooled.shape == (1, 4)
     np.testing.assert_allclose(pooled.numpy()[0],
                                w.numpy()[1] + w.numpy()[2], rtol=1e-6)
+
+
+def test_sparse_embedding_requires_name():
+    ids = paddle.to_tensor(np.array([[1]], np.int64))
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="name"):
+        L.sparse_embedding(ids, size=(10, 4))
 
 
 def test_sparse_embedding_cached_table():

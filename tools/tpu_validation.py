@@ -65,12 +65,18 @@ def run_bench():
     r = subprocess.run([sys.executable, "bench.py"],
                        env={**os.environ, "PYTHONPATH": "/root/repo"},
                        capture_output=True, text=True, timeout=2400)
-    print("== bench ==\n" + "\n".join(
-        line for line in r.stdout.splitlines() if line.startswith("{")))
+    metric_lines = [line for line in r.stdout.splitlines()
+                    if line.startswith("{")]
+    print("== bench ==\n" + "\n".join(metric_lines))
+    if r.returncode != 0 or not metric_lines:
+        print("bench FAILED (rc=%d):\n%s" % (
+            r.returncode, "\n".join(r.stderr.splitlines()[-8:])))
+        return False
+    return True
 
 
 if __name__ == "__main__":
     ok = run_kernel_tests()
     attention_microbench()
-    run_bench()
+    ok = run_bench() and ok
     sys.exit(0 if ok else 1)
