@@ -49,7 +49,7 @@ def main():
     exe.run(startup)
     for epoch in range(3):
         perm = np.random.RandomState(epoch).permutation(len(imgs))
-        for i in range(0, len(imgs) - 64, 64):
+        for i in range(0, len(imgs) - 63, 64):
             sl = perm[i:i + 64]
             lo, ac = exe.run(main_prog,
                              feed={"img": imgs[sl], "label": labels[sl]},

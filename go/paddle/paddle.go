@@ -58,14 +58,23 @@ func NewPredictor(modelPrefix string) (*Predictor, error) {
 
 func (p *Predictor) finalize() { C.PD_DeletePredictor(p.c) }
 
-func (p *Predictor) InputNum() int { return int(C.PD_GetInputNum(p.c)) }
+func (p *Predictor) InputNum() int {
+	n := int(C.PD_GetInputNum(p.c))
+	runtime.KeepAlive(p)
+	return n
+}
 
 func (p *Predictor) InputName(i int) string {
-	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+	s := C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+	runtime.KeepAlive(p)
+	return s
 }
 
 func (p *Predictor) SetInputFloat(name string, data []float32,
 	shape []int64) error {
+	if len(data) == 0 || len(shape) == 0 {
+		return errors.New("empty data or shape")
+	}
 	cs := C.CString(name)
 	defer C.free(unsafe.Pointer(cs))
 	rc := C.PD_SetInputFloat(p.c, cs, (*C.float)(&data[0]),
@@ -79,6 +88,9 @@ func (p *Predictor) SetInputFloat(name string, data []float32,
 
 func (p *Predictor) SetInputInt64(name string, data []int64,
 	shape []int64) error {
+	if len(data) == 0 || len(shape) == 0 {
+		return errors.New("empty data or shape")
+	}
 	cs := C.CString(name)
 	defer C.free(unsafe.Pointer(cs))
 	rc := C.PD_SetInputInt64(p.c, cs, (*C.int64_t)(&data[0]),
@@ -99,7 +111,11 @@ func (p *Predictor) Run() error {
 	return nil
 }
 
-func (p *Predictor) OutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+func (p *Predictor) OutputNum() int {
+	n := int(C.PD_GetOutputNum(p.c))
+	runtime.KeepAlive(p)
+	return n
+}
 
 // OutputFloat copies output idx into a fresh slice plus its shape.
 func (p *Predictor) OutputFloat(idx int) ([]float32, []int64, error) {
@@ -146,6 +162,9 @@ func (t *Trainer) finalize() { C.PD_DeleteTrainer(t.c) }
 
 func (t *Trainer) SetInputFloat(name string, data []float32,
 	shape []int64) error {
+	if len(data) == 0 || len(shape) == 0 {
+		return errors.New("empty data or shape")
+	}
 	cs := C.CString(name)
 	defer C.free(unsafe.Pointer(cs))
 	rc := C.PD_TrainerSetInputFloat(t.c, cs, (*C.float)(&data[0]),
@@ -159,6 +178,9 @@ func (t *Trainer) SetInputFloat(name string, data []float32,
 
 func (t *Trainer) SetInputInt64(name string, data []int64,
 	shape []int64) error {
+	if len(data) == 0 || len(shape) == 0 {
+		return errors.New("empty data or shape")
+	}
 	cs := C.CString(name)
 	defer C.free(unsafe.Pointer(cs))
 	rc := C.PD_TrainerSetInputInt64(t.c, cs, (*C.int64_t)(&data[0]),

@@ -150,11 +150,18 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
             "sparse_embedding needs a stable table name: pass name=... "
             "(or param_attr with a name); it identifies the shared table "
             "across calls, like the reference's parameter name")
-    key = (name, int(size[0]), int(size[1]))
-    layer = _sparse_tables.get(key)
-    if layer is None:
-        layer = _sparse_tables[key] = SparseEmbedding(int(size[1]))
-    out = layer(input)
+    key = name
+    cached = _sparse_tables.get(key)
+    if cached is not None and cached[0] != (int(size[0]), int(size[1])):
+        raise ValueError(
+            f"sparse_embedding table {name!r} already exists with size "
+            f"{cached[0]}, got {tuple(int(s) for s in size)} — a shared "
+            "name must keep one size (like reusing a parameter name with "
+            "a different shape in the reference)")
+    if cached is None:
+        cached = _sparse_tables[key] = (
+            (int(size[0]), int(size[1])), SparseEmbedding(int(size[1])))
+    out = cached[1](input)
     if padding_idx is not None:
         mask = ops.cast(ops.unsqueeze(input != padding_idx, [-1]),
                         out.dtype)

@@ -91,6 +91,7 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
     _state["enabled"] = True
     _state["events"].clear()
     _state["spans"].clear()
+    _state["tids"].clear()
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
         jax.profiler.start_trace(trace_dir)

@@ -339,3 +339,75 @@ def test_book_rnn_encoder_decoder():
             "tmask": tmask[sl]}, fetch_list=[loss])
         losses.append(float(np.asarray(out)))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_book_label_semantic_roles():
+    """book/test_label_semantic_roles.py: SRL tagger — word+predicate
+    embeddings → BiGRU encoder → CRF loss on Conll05st, CRF viterbi
+    decode improves with training (eager path; CRF is the load-bearing
+    piece the reference test exercises)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.text import Conll05st
+
+    paddle.seed(0)
+    V, T, L = 200, 5, 12
+    ds = Conll05st(vocab_size=V, num_tags=T, max_len=L,
+                   synthetic_size=128)
+    words = np.zeros((len(ds), L), np.int64)
+    tags = np.zeros((len(ds), L), np.int64)
+    lengths = np.zeros((len(ds),), np.int64)
+    pred_pos = np.zeros((len(ds),), np.int64)
+    for i in range(len(ds)):
+        w, p, t = ds[i]
+        n = min(len(w), L)
+        words[i, :n] = w[:n]
+        tags[i, :n] = t[:n]
+        lengths[i] = n
+        pred_pos[i] = p
+
+    class SRL(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.word_emb = nn.Embedding(V, 16)
+            self.mark_emb = nn.Embedding(2, 4)
+            self.gru = nn.GRU(20, 16, direction="bidirect")
+            self.proj = nn.Linear(32, T)
+            self.crf = nn.LinearChainCRF(T)
+
+        def emissions(self, w, mark, lens):
+            x = paddle.concat([self.word_emb(w), self.mark_emb(mark)],
+                              axis=-1)
+            h, _ = self.gru(x, sequence_length=lens)
+            return self.proj(h)
+
+        def loss(self, w, mark, lens, y):
+            return self.crf(self.emissions(w, mark, lens), y, lens)
+
+    model = SRL()
+    opt = optimizer.Adam(learning_rate=1e-2,
+                         parameters=model.parameters())
+    mark = (np.arange(L)[None, :] == pred_pos[:, None]).astype(np.int64)
+
+    def batch(i):
+        sl = slice((i * 32) % 96, (i * 32) % 96 + 32)
+        return (paddle.to_tensor(words[sl]), paddle.to_tensor(mark[sl]),
+                paddle.to_tensor(lengths[sl]), paddle.to_tensor(tags[sl]))
+
+    losses = []
+    for i in range(50):
+        w, m, lens, y = batch(i)
+        loss = model.loss(w, m, lens, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.75, losses[::10]
+
+    # viterbi decode shape + accuracy beats random tagging
+    w, m, lens, y = batch(0)
+    decoded = model.crf.decode(model.emissions(w, m, lens), lens).numpy()
+    mask = (np.arange(L)[None, :] < lens.numpy()[:, None])
+    acc = (decoded == y.numpy())[mask].mean()
+    assert decoded.shape == (32, L)
+    assert acc > 1.5 / T, acc
