@@ -16,11 +16,12 @@ This mirrors the reference's convert_ifelse / convert_while_loop /
 convert_logical_* runtime dispatch (convert_operators.py) while letting
 XLA replace the sub-block executor.
 
-Supported rewrites: `if` (branches without return/break/continue),
-`while` (body without return/break/continue), `for ... in range(...)`
-(desugared to while), `and`/`or`/`not`. Anything else is left as plain
-Python — correct for concrete values, and a clear jax TracerBoolConversion
-error points at the unsupported tensor-dependent construct.
+Supported rewrites: `if` (incl. tail `return`s in branches, lifted by
+the return normalizer like the reference return_transformer), `while`
+(body without return/break/continue), `for ... in range(...)` (desugared
+to while), `and`/`or`/`not`. Anything else is left as plain Python —
+correct for concrete values, and a clear jax TracerBoolConversion error
+points at the unsupported tensor-dependent construct.
 """
 from __future__ import annotations
 
@@ -45,7 +46,9 @@ __all__ = [
 
 class _Undefined:
     """Sentinel for 'name not bound on this path' (reference
-    variable_trans_func.py create_undefined_variable)."""
+    variable_trans_func.py create_undefined_variable). Every use raises
+    the explanatory NameError, so 'assigned in only one branch of a
+    tensor-dependent if' surfaces clearly at the point of use."""
 
     _instance = None
 
@@ -57,10 +60,21 @@ class _Undefined:
     def __repr__(self):
         return "<paddle_tpu.dy2static.UNDEF>"
 
-    def __bool__(self):
+    @staticmethod
+    def _fail(*a, **k):
         raise NameError(
             "variable is undefined on the branch/loop path that produced "
-            "it (dy2static UNDEF sentinel)")
+            "it — assign it on every branch of the tensor-dependent "
+            "if/while (dy2static UNDEF sentinel)")
+
+
+for _dunder in ("__bool__", "__add__", "__radd__", "__sub__", "__rsub__",
+                "__mul__", "__rmul__", "__truediv__", "__rtruediv__",
+                "__neg__", "__getitem__", "__call__", "__float__",
+                "__int__", "__array__", "__iter__", "__len__",
+                "__lt__", "__le__", "__gt__", "__ge__", "__matmul__",
+                "__pow__", "__mod__"):
+    setattr(_Undefined, _dunder, _Undefined._fail)
 
 
 UNDEF = _Undefined()
@@ -173,20 +187,20 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
         raise ValueError(
             "dy2static: both branches of a tensor-dependent `if` must "
             "produce the same set of variables")
-    for a, b in zip(t_flat, f_flat):
-        if isinstance(a, _Undefined) != isinstance(b, _Undefined):
-            raise ValueError(
-                "dy2static: a variable assigned in only one branch of a "
-                "tensor-dependent `if` was used; assign it in both "
-                "branches (or before the if)")
-    # UNDEF-on-both-paths entries stay out of the cond operands
-    sel = [i for i, a in enumerate(t_flat)
-           if not isinstance(a, _Undefined)]
+    # names defined on only ONE path become UNDEF (reference
+    # undefined-var semantics: the error surfaces at USE, not here —
+    # branch-local temporaries then never get in the way); only
+    # both-sides-defined entries ride the cond
+    sel = [i for i, (a, b) in enumerate(zip(t_flat, f_flat))
+           if not isinstance(a, _Undefined) and
+           not isinstance(b, _Undefined)]
     picked = jax.lax.cond(
         _pred_array(pred),
         lambda: tuple(_raw(t_flat[i]) for i in sel),
         lambda: tuple(_raw(f_flat[i]) for i in sel))
-    out_flat = list(t_flat)
+    sel_set = set(sel)
+    out_flat = [t if i in sel_set else UNDEF
+                for i, t in enumerate(t_flat)]
     for slot, i in enumerate(sel):
         out_flat[i] = (Tensor(picked[slot], stop_gradient=False)
                        if isinstance(t_flat[i], Tensor) else picked[slot])
@@ -605,6 +619,73 @@ def _no_args():
                          defaults=[])
 
 
+# ---------------------------------------------------------------------------
+# return lifting (reference return_transformer.py): early `return` inside
+# an `if` becomes an assignment to a result variable, so the ifelse
+# transformer — and therefore tensor predicates — can handle the branch
+# ---------------------------------------------------------------------------
+
+
+def _tail_returns(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+def _lift_returns(stmts: List[ast.stmt], counter: List[int]
+                  ) -> List[ast.stmt]:
+    """Normalize tail returns: for an If whose body ends in Return,
+    statements after the If fold into its orelse (implicit else), each
+    branch's trailing Return becomes `_jst_ret_k = <value>`, and a single
+    `return _jst_ret_k` follows the If. Applied bottom-up; returns inside
+    loops or mid-branch stay untouched (those Ifs keep Python semantics
+    via the escape check in visit_If)."""
+    out = list(stmts)
+    for idx, st in enumerate(out):
+        if isinstance(st, ast.If):
+            st.body = _lift_returns(list(st.body), counter)
+            st.orelse = _lift_returns(list(st.orelse), counter)
+    for idx, st in enumerate(out):
+        if not isinstance(st, ast.If):
+            continue
+        body_ret = _tail_returns(st.body)
+        else_ret = _tail_returns(st.orelse)
+        rest = out[idx + 1:]
+        if rest and (body_ret or else_ret):
+            if body_ret and else_ret:
+                out = out[:idx + 1]      # rest is unreachable
+            elif body_ret:
+                # continuation belongs to the (implicit) else branch
+                st.orelse = _lift_returns(list(st.orelse) + rest, counter)
+                out = out[:idx + 1]
+            else:
+                # mirror: else returns, so the continuation is the body's
+                st.body = _lift_returns(list(st.body) + rest, counter)
+                out = out[:idx + 1]
+        elif not rest:
+            if body_ret and not st.orelse:
+                # `if c: return A` at function end — implicit return None
+                st.orelse = [ast.Return(value=ast.Constant(None))]
+            elif else_ret and not body_ret:
+                # `else: return X` at function end — body falls through
+                st.body = list(st.body) + [
+                    ast.Return(value=ast.Constant(None))]
+        if not (_tail_returns(st.body) and _tail_returns(st.orelse)):
+            continue
+        counter[0] += 1
+        ret_name = f"_jst_r{counter[0]}"
+
+        def to_assign(branch):
+            r = branch[-1]
+            val = r.value if r.value is not None else ast.Constant(None)
+            return branch[:-1] + [ast.Assign(
+                targets=[_name(ret_name, ast.Store())], value=val)]
+
+        st.body = to_assign(st.body)
+        st.orelse = to_assign(st.orelse)
+        out = out[:idx] + [st, ast.Return(value=_name(ret_name))]
+        break
+    return out
+
+
 def ast_transform(fn: Callable) -> Callable:
     """Rewrite `fn`'s tensor-dependent control flow into convert_* calls.
     Returns the transformed function, or raises on untransformable input
@@ -618,6 +699,7 @@ def ast_transform(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ValueError("dy2static: expected a function definition")
     fdef.decorator_list = []
+    fdef.body = _lift_returns(list(fdef.body), [0])
     transformer = _Dy2StaticTransformer()
     new_tree = transformer.visit(tree)
     ast.fix_missing_locations(new_tree)

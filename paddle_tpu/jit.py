@@ -121,6 +121,12 @@ def _fn_compiled(fn):
         kwargs = _tree.tree_map(_wrap_in, kw_arrays)
         with tape_mod.no_grad():
             out = fn(*args, **kwargs)
+        from .dy2static import _Undefined
+
+        for leaf in _tree.tree_leaves(
+                out, is_leaf=lambda x: isinstance(x, (Tensor, _Undefined))):
+            if isinstance(leaf, _Undefined):
+                _Undefined._fail()
         return _tree.tree_map(_unwrap_out, out,
                               is_leaf=lambda x: isinstance(x, Tensor))
 

@@ -46,7 +46,8 @@ def test_if_else_missing_branch_var_errors():
             z = x - 1.0          # y undefined on this path
         return y
 
-    with pytest.raises(ValueError, match="both branches"):
+    # one-sided names become UNDEF; the clear error surfaces at USE
+    with pytest.raises(NameError, match="undefined on the branch"):
         f(t([1.0]))
 
 
@@ -302,3 +303,74 @@ def test_inner_break_does_not_block_outer_while():
 
     out = f(t([0.0]))        # +2 per outer iteration until >= 10
     assert float(out.numpy()[0]) >= 10.0
+
+
+def test_early_return_in_tensor_if():
+    """Tail returns inside if branches are lifted to assignments
+    (reference return_transformer.py), so tensor predicates work with
+    early-return style."""
+    @to_static
+    def f(x):
+        if (x.sum() > 0.0):
+            return x * 2.0
+        return x - 1.0
+
+    np.testing.assert_allclose(f(t([3.0])).numpy(), [6.0])
+    np.testing.assert_allclose(f(t([-3.0])).numpy(), [-4.0])
+
+
+def test_early_return_chain_and_trailing_code():
+    @to_static
+    def f(x):
+        if (x.sum() > 10.0):
+            return x * 10.0
+        y = x + 1.0
+        if (y.sum() > 0.0):
+            return y
+        return -y
+
+    np.testing.assert_allclose(f(t([20.0])).numpy(), [200.0])
+    np.testing.assert_allclose(f(t([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(f(t([-5.0])).numpy(), [4.0])
+
+
+def test_early_return_implicit_none():
+    def g(x):
+        if x > 10:
+            return "big"
+
+    gc = dy2static.ast_transform(g)
+    assert gc(20) == "big" and gc(1) is None
+
+
+def test_else_only_tail_return():
+    """else-branch tail returns are lifted too (review regression)."""
+    @to_static
+    def f(x):
+        if (x.sum() > 0.0):
+            y = x * 2.0
+        else:
+            return x - 1.0
+        return y + 1.0
+
+    np.testing.assert_allclose(f(t([3.0])).numpy(), [7.0])
+    np.testing.assert_allclose(f(t([-3.0])).numpy(), [-4.0])
+
+    def g(n):
+        if n > 0:
+            y = n
+        else:
+            return -1
+        return y * 10
+
+    gc = dy2static.ast_transform(g)
+    assert gc(3) == 30 and gc(-3) == -1
+
+    def h(n):              # else-return at function end, body falls off
+        if n > 0:
+            y = n
+        else:
+            return -1
+
+    hc = dy2static.ast_transform(h)
+    assert hc(3) is None and hc(-3) == -1
