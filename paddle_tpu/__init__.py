@@ -64,3 +64,4 @@ def summary(net, input_size=None, dtypes=None):
     return _summary(net, input_size, dtypes)
 from . import reader  # noqa: F401
 from .reader import batch  # noqa: F401
+from . import install_check  # noqa: F401

@@ -73,7 +73,8 @@ for _dunder in ("__bool__", "__add__", "__radd__", "__sub__", "__rsub__",
                 "__neg__", "__getitem__", "__call__", "__float__",
                 "__int__", "__array__", "__iter__", "__len__",
                 "__lt__", "__le__", "__gt__", "__ge__", "__matmul__",
-                "__pow__", "__mod__"):
+                "__pow__", "__mod__", "__eq__", "__ne__", "__contains__",
+                "__getattr__"):
     setattr(_Undefined, _dunder, _Undefined._fail)
 
 
@@ -179,33 +180,45 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
 
     t_out = true_fn(*init_vals)
     f_out = false_fn(*init_vals)
-    t_flat = jax.tree_util.tree_leaves(
-        t_out, is_leaf=lambda x: isinstance(x, _Undefined))
-    f_flat = jax.tree_util.tree_leaves(
-        f_out, is_leaf=lambda x: isinstance(x, _Undefined))
+
+    def leaves(tree):
+        return jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: x is None or isinstance(x, _Undefined))
+
+    t_flat, f_flat = leaves(t_out), leaves(f_out)
     if len(t_flat) != len(f_flat):
         raise ValueError(
-            "dy2static: both branches of a tensor-dependent `if` must "
-            "produce the same set of variables")
+            "dy2static: both paths of a tensor-dependent `if` must "
+            "produce the same structure — this includes returning a "
+            "value on one path while falling through (returning None) "
+            "on the other")
+    for a, b in zip(t_flat, f_flat):
+        if (a is None) != (b is None):
+            raise ValueError(
+                "dy2static: a tensor-dependent `if` returns a value on "
+                "one path and None (fall-through) on the other; return "
+                "the same structure on both paths")
     # names defined on only ONE path become UNDEF (reference
     # undefined-var semantics: the error surfaces at USE, not here —
     # branch-local temporaries then never get in the way); only
-    # both-sides-defined entries ride the cond
+    # both-sides-defined entries ride the cond; None-on-both-paths
+    # passes through as None
     sel = [i for i, (a, b) in enumerate(zip(t_flat, f_flat))
            if not isinstance(a, _Undefined) and
-           not isinstance(b, _Undefined)]
+           not isinstance(b, _Undefined) and a is not None]
     picked = jax.lax.cond(
         _pred_array(pred),
         lambda: tuple(_raw(t_flat[i]) for i in sel),
         lambda: tuple(_raw(f_flat[i]) for i in sel))
     sel_set = set(sel)
-    out_flat = [t if i in sel_set else UNDEF
+    out_flat = [t if i in sel_set or t is None else UNDEF
                 for i, t in enumerate(t_flat)]
     for slot, i in enumerate(sel):
         out_flat[i] = (Tensor(picked[slot], stop_gradient=False)
                        if isinstance(t_flat[i], Tensor) else picked[slot])
     treedef = jax.tree_util.tree_structure(
-        t_out, is_leaf=lambda x: isinstance(x, (Tensor, _Undefined)))
+        t_out,
+        is_leaf=lambda x: x is None or isinstance(x, (Tensor, _Undefined)))
     return jax.tree_util.tree_unflatten(treedef, out_flat)
 
 
@@ -630,19 +643,28 @@ def _tail_returns(stmts: List[ast.stmt]) -> bool:
     return bool(stmts) and isinstance(stmts[-1], ast.Return)
 
 
-def _lift_returns(stmts: List[ast.stmt], counter: List[int]
-                  ) -> List[ast.stmt]:
+def _lift_returns(stmts: List[ast.stmt], counter: List[int],
+                  at_function_end: bool = True) -> List[ast.stmt]:
     """Normalize tail returns: for an If whose body ends in Return,
     statements after the If fold into its orelse (implicit else), each
     branch's trailing Return becomes `_jst_ret_k = <value>`, and a single
     `return _jst_ret_k` follows the If. Applied bottom-up; returns inside
     loops or mid-branch stay untouched (those Ifs keep Python semantics
-    via the escape check in visit_If)."""
+    via the escape check in visit_If).
+
+    at_function_end: only a statement list whose end IS the function's
+    end may complete a non-returning path with `return None`; the end of
+    a nested branch falls through to the ENCLOSING continuation instead
+    (review regression: nested ifs / elif chains must not return None
+    early)."""
     out = list(stmts)
     for idx, st in enumerate(out):
         if isinstance(st, ast.If):
-            st.body = _lift_returns(list(st.body), counter)
-            st.orelse = _lift_returns(list(st.orelse), counter)
+            last = idx == len(out) - 1
+            st.body = _lift_returns(list(st.body), counter,
+                                    at_function_end and last)
+            st.orelse = _lift_returns(list(st.orelse), counter,
+                                      at_function_end and last)
     for idx, st in enumerate(out):
         if not isinstance(st, ast.If):
             continue
@@ -654,13 +676,15 @@ def _lift_returns(stmts: List[ast.stmt], counter: List[int]
                 out = out[:idx + 1]      # rest is unreachable
             elif body_ret:
                 # continuation belongs to the (implicit) else branch
-                st.orelse = _lift_returns(list(st.orelse) + rest, counter)
+                st.orelse = _lift_returns(list(st.orelse) + rest, counter,
+                                          at_function_end)
                 out = out[:idx + 1]
             else:
                 # mirror: else returns, so the continuation is the body's
-                st.body = _lift_returns(list(st.body) + rest, counter)
+                st.body = _lift_returns(list(st.body) + rest, counter,
+                                        at_function_end)
                 out = out[:idx + 1]
-        elif not rest:
+        elif not rest and at_function_end:
             if body_ret and not st.orelse:
                 # `if c: return A` at function end — implicit return None
                 st.orelse = [ast.Return(value=ast.Constant(None))]

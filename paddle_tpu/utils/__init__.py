@@ -1,2 +1,3 @@
 from . import unique_name  # noqa: F401
 from .env import summary_env  # noqa: F401
+from ..install_check import run_check  # noqa: F401

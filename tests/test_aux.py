@@ -171,3 +171,14 @@ def test_profiler_chrome_trace_export(tmp_path):
     assert {"step", "forward"} <= names
     xs = [e for e in data["traceEvents"] if e["ph"] == "X"]
     assert all(e["dur"] >= 0 and "ts" in e for e in xs)
+
+
+def test_install_check_run_check(capsys):
+    """fluid.install_check.run_check parity: single + multi-device tiny
+    train steps, success report."""
+    import paddle_tpu as paddle
+
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "SINGLE device" in out
+    assert "installed successfully" in out

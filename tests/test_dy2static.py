@@ -374,3 +374,68 @@ def test_else_only_tail_return():
 
     hc = dy2static.ast_transform(h)
     assert hc(3) is None and hc(-3) == -1
+
+
+def test_for_over_tensor_rows():
+    """`for row in tensor` iterates the leading dim (Tensor.__iter__):
+    unrolls at trace time; per-row tensor-dependent ifs convert to
+    lax.cond inside the unrolled body."""
+    @to_static
+    def f(x):
+        acc = x[0] * 0.0
+        for row in x:
+            if (row.sum() > 100.0):
+                acc = acc - row      # outlier rows are subtracted
+            else:
+                acc = acc + row
+        return acc
+
+    out = f(t([[1.0, 2.0], [3.0, 4.0], [1000.0, 0.0], [5.0, 6.0]]))
+    np.testing.assert_allclose(out.numpy(), [-991.0, 12.0])
+
+
+def test_nested_and_elif_return_python_semantics():
+    """Review regressions: end-of-branch is NOT end-of-function — nested
+    ifs and elif chains with trailing code keep Python semantics."""
+    def f(a, b):
+        if a:
+            if b:
+                return 1
+        return 2
+
+    fc = dy2static.ast_transform(f)
+    assert fc(True, True) == 1
+    assert fc(True, False) == 2
+    assert fc(False, False) == 2
+
+    def g(a, b):
+        if a:
+            return 1
+        elif b:
+            return 2
+        return 3
+
+    gc = dy2static.ast_transform(g)
+    assert gc(True, False) == 1
+    assert gc(False, True) == 2
+    assert gc(False, False) == 3
+
+
+def test_undef_equality_raises():
+    with pytest.raises(NameError, match="undefined"):
+        dy2static.UNDEF == 1
+    with pytest.raises(NameError, match="undefined"):
+        dy2static.UNDEF != 1
+    with pytest.raises(NameError, match="undefined"):
+        dy2static.UNDEF.shape
+
+
+def test_tensor_if_return_vs_fallthrough_clear_error():
+    @to_static
+    def f(x):
+        if (x.sum() > 0.0):
+            return x * 2.0
+        # falls through -> returns None
+
+    with pytest.raises(ValueError, match="fall"):
+        f(t([1.0]))
