@@ -44,14 +44,17 @@ def main():
     else:
         cfg = BertConfig.base()
         cfg.num_hidden_layers = layers
-    model = BertForPretraining(cfg)
-    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
-
     def loss_fn(m, ids, tt, mlm, nsp):
         with amp.auto_cast(level="O1", dtype="bfloat16"):
             return m.loss(ids, tt, mlm, nsp)
 
-    step = TrainStep(model, loss_fn, opt)
+    def build():
+        paddle.seed(0)
+        m = BertForPretraining(cfg)
+        o = optimizer.AdamW(learning_rate=1e-4, parameters=m.parameters())
+        return TrainStep(m, loss_fn, o)
+
+    step = build()
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
@@ -61,9 +64,23 @@ def main():
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     nsp = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype(np.int32))
 
-    # warmup / compile
-    loss = step(ids, tt, mlm, nsp)
-    _ = float(loss)
+    # warmup / compile; if a custom Pallas kernel fails to compile on
+    # this backend, fall back to the pure-XLA paths and keep benching
+    import jax
+    pallas_eligible = (jax.default_backend() == "tpu" and
+                       os.environ.get("PADDLE_TPU_DISABLE_PALLAS") != "1")
+    try:
+        loss = step(ids, tt, mlm, nsp)
+        _ = float(loss)
+    except Exception as e:
+        if not pallas_eligible:
+            raise
+        sys.stderr.write(f"pallas path failed ({type(e).__name__}: {e}); "
+                         "retrying with PADDLE_TPU_DISABLE_PALLAS=1\n")
+        os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+        step = build()
+        loss = step(ids, tt, mlm, nsp)
+        _ = float(loss)
     t0 = time.perf_counter()
     for _i in range(steps):
         loss = step(ids, tt, mlm, nsp)

@@ -39,9 +39,24 @@ from .framework.tensor import Tensor
 __all__ = [
     "ast_transform", "convert_ifelse", "convert_while",
     "convert_logical_and", "convert_logical_or", "convert_logical_not",
-    "ProgramTranslator", "enable_ast", "ast_enabled", "UNDEF",
+    "ProgramTranslator", "enable_ast", "ast_enabled", "UNDEF", "UndefinedVarError", "UndefinedVarAttributeError",
     "max_loop_iters",
 ]
+
+
+_UNDEF_MSG = ("variable is undefined on the branch/loop path that "
+              "produced it — assign it on every branch of the "
+              "tensor-dependent if/while (dy2static UNDEF sentinel)")
+
+
+class UndefinedVarError(NameError):
+    """Raised on any VALUE use of UNDEF (arithmetic, bool, return...)."""
+
+
+class UndefinedVarAttributeError(AttributeError):
+    """Raised for attribute access on UNDEF. An AttributeError subclass
+    so hasattr/getattr-with-default/deepcopy probes keep their
+    protocol."""
 
 
 class _Undefined:
@@ -62,10 +77,10 @@ class _Undefined:
 
     @staticmethod
     def _fail(*a, **k):
-        raise NameError(
-            "variable is undefined on the branch/loop path that produced "
-            "it — assign it on every branch of the tensor-dependent "
-            "if/while (dy2static UNDEF sentinel)")
+        raise UndefinedVarError(_UNDEF_MSG)
+
+    def __getattr__(self, name):
+        raise UndefinedVarAttributeError(_UNDEF_MSG)
 
 
 for _dunder in ("__bool__", "__add__", "__radd__", "__sub__", "__rsub__",
@@ -73,8 +88,7 @@ for _dunder in ("__bool__", "__add__", "__radd__", "__sub__", "__rsub__",
                 "__neg__", "__getitem__", "__call__", "__float__",
                 "__int__", "__array__", "__iter__", "__len__",
                 "__lt__", "__le__", "__gt__", "__ge__", "__matmul__",
-                "__pow__", "__mod__", "__eq__", "__ne__", "__contains__",
-                "__getattr__"):
+                "__pow__", "__mod__", "__eq__", "__ne__", "__contains__"):
     setattr(_Undefined, _dunder, _Undefined._fail)
 
 
@@ -192,27 +206,22 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
             "produce the same structure — this includes returning a "
             "value on one path while falling through (returning None) "
             "on the other")
-    for a, b in zip(t_flat, f_flat):
-        if (a is None) != (b is None):
-            raise ValueError(
-                "dy2static: a tensor-dependent `if` returns a value on "
-                "one path and None (fall-through) on the other; return "
-                "the same structure on both paths")
-    # names defined on only ONE path become UNDEF (reference
-    # undefined-var semantics: the error surfaces at USE, not here —
-    # branch-local temporaries then never get in the way); only
-    # both-sides-defined entries ride the cond; None-on-both-paths
-    # passes through as None
+    # names defined on only ONE path — including one-sided None bindings
+    # and return-vs-fallthrough — become UNDEF (reference undefined-var
+    # semantics: the error surfaces at USE); only both-sides-defined
+    # entries ride the cond, None-on-both-paths passes through
     sel = [i for i, (a, b) in enumerate(zip(t_flat, f_flat))
            if not isinstance(a, _Undefined) and
-           not isinstance(b, _Undefined) and a is not None]
+           not isinstance(b, _Undefined) and
+           a is not None and b is not None]
     picked = jax.lax.cond(
         _pred_array(pred),
         lambda: tuple(_raw(t_flat[i]) for i in sel),
         lambda: tuple(_raw(f_flat[i]) for i in sel))
     sel_set = set(sel)
-    out_flat = [t if i in sel_set or t is None else UNDEF
-                for i, t in enumerate(t_flat)]
+    out_flat = [
+        t if i in sel_set or (t is None and f_flat[i] is None) else UNDEF
+        for i, t in enumerate(t_flat)]
     for slot, i in enumerate(sel):
         out_flat[i] = (Tensor(picked[slot], stop_gradient=False)
                        if isinstance(t_flat[i], Tensor) else picked[slot])

@@ -121,12 +121,16 @@ def _fn_compiled(fn):
         kwargs = _tree.tree_map(_wrap_in, kw_arrays)
         with tape_mod.no_grad():
             out = fn(*args, **kwargs)
-        from .dy2static import _Undefined
+        from .dy2static import UndefinedVarError, _Undefined
 
         for leaf in _tree.tree_leaves(
                 out, is_leaf=lambda x: isinstance(x, (Tensor, _Undefined))):
             if isinstance(leaf, _Undefined):
-                _Undefined._fail()
+                raise UndefinedVarError(
+                    "the returned value is undefined on some branch path "
+                    "— either a tensor-dependent `if` returns on one path "
+                    "and falls through on the other, or a returned "
+                    "variable was assigned on only one branch")
         return _tree.tree_map(_unwrap_out, out,
                               is_leaf=lambda x: isinstance(x, Tensor))
 

@@ -47,7 +47,7 @@ def test_if_else_missing_branch_var_errors():
         return y
 
     # one-sided names become UNDEF; the clear error surfaces at USE
-    with pytest.raises(NameError, match="undefined on the branch"):
+    with pytest.raises(NameError, match="branch"):
         f(t([1.0]))
 
 
@@ -426,7 +426,7 @@ def test_undef_equality_raises():
         dy2static.UNDEF == 1
     with pytest.raises(NameError, match="undefined"):
         dy2static.UNDEF != 1
-    with pytest.raises(NameError, match="undefined"):
+    with pytest.raises(AttributeError, match="undefined"):
         dy2static.UNDEF.shape
 
 
@@ -437,5 +437,25 @@ def test_tensor_if_return_vs_fallthrough_clear_error():
             return x * 2.0
         # falls through -> returns None
 
-    with pytest.raises(ValueError, match="fall"):
+    with pytest.raises(NameError, match="branch"):
         f(t([1.0]))
+
+
+def test_one_sided_none_assignment_is_undef_not_error():
+    """Assigning None on one branch is a branch-local binding, not a
+    return mismatch (review regression)."""
+    @to_static
+    def f(x):
+        if (x.sum() > 0.0):
+            y = None            # never used afterwards
+        out = x * 3.0
+        return out
+
+    np.testing.assert_allclose(f(t([2.0])).numpy(), [6.0])
+
+
+def test_undef_attribute_protocol():
+    import copy
+    assert not hasattr(dy2static.UNDEF, "shape")
+    assert getattr(dy2static.UNDEF, "numpy", None) is None
+    copy.deepcopy({"a": dy2static.UNDEF})   # must not raise
