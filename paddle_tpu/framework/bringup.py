@@ -1,0 +1,131 @@
+"""Backend bring-up hardening.
+
+Reference posture (/root/reference/paddle/fluid/platform/init.cc
+InitDevices, platform/dynload/dynamic_loader.cc): platform probing never
+takes down the process — a missing driver degrades to CPU. JAX's default
+posture is the opposite: a broken PJRT plugin (e.g. a remote-TPU tunnel
+that is down) makes *every* backend init raise or, worse, hang — including
+the cpu backend, because jax initializes all registered factories on the
+first ``backends()`` call. These helpers contain that:
+
+- :func:`probe_backend` asks a *subprocess* (with a hard timeout) what the
+  default backend is, so a hung plugin can never hang this process.
+- :func:`force_cpu` drops non-CPU PJRT factories and pins the cpu
+  platform, mirroring the guard in ``tests/conftest.py``.
+- :func:`ensure_backend` probes once and falls back to cpu when the
+  default backend is unusable. Idempotent; cheap after the first call.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+_lock = threading.Lock()
+_resolved: str | None = None
+
+_PROBE_SRC = "import jax; print(jax.default_backend())"
+
+#: Platform names that mean "a real TPU is on the other end". The axon
+#: remote plugin registers under its own name but fronts a TPU chip.
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def backends_initialized() -> bool:
+    """True once jax has committed to a set of live backends."""
+    try:
+        from jax._src import xla_bridge as xb
+
+        return bool(getattr(xb, "_backends", None))
+    except Exception:
+        return False
+
+
+def probe_backend(timeout: float = 75.0) -> str | None:
+    """Default-backend platform name, resolved in a subprocess.
+
+    Returns None when backend init raises or exceeds ``timeout`` —
+    never raises, never blocks this process past the timeout."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ))
+    except Exception:
+        return None
+    if out.returncode != 0:
+        return None
+    lines = out.stdout.strip().splitlines()
+    return lines[-1].strip() if lines else None
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin the cpu platform, dropping every other PJRT factory.
+
+    ``n_devices`` requests that many virtual host devices
+    (``--xla_force_host_platform_device_count``); it only takes effect
+    when backends have not initialized yet. Safe to call at any point —
+    after a *failed* init the factories are simply popped again."""
+    if n_devices is not None and not backends_initialized():
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge as xb
+
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def ensure_backend(timeout: float = 75.0) -> str:
+    """Resolve a usable default backend, degrading to cpu.
+
+    Call this before the first in-process device touch (model build,
+    ``jax.devices()``, ...). Returns the platform name that subsequent
+    in-process init will produce."""
+    global _resolved
+    with _lock:
+        if _resolved is not None:
+            return _resolved
+        if backends_initialized():
+            import jax
+
+            _resolved = jax.default_backend()
+            return _resolved
+        plat = probe_backend(timeout)
+        if plat is None:
+            sys.stderr.write(
+                "paddle_tpu: default backend init failed or hung; "
+                "falling back to cpu\n")
+            force_cpu()
+            plat = "cpu"
+        _resolved = plat
+        return plat
+
+
+def default_platform() -> str:
+    """Platform name without forcing init: live backend if initialized,
+    else the probed/forced result, else a best-effort guess from config —
+    never raises, never hangs."""
+    try:
+        import jax
+
+        if backends_initialized():
+            return jax.default_backend()
+        if _resolved is not None:
+            return _resolved
+        plats = os.environ.get("JAX_PLATFORMS", "") or str(
+            jax.config.jax_platforms or "")
+        return plats.split(",")[0].strip() if plats.strip() else "unknown"
+    except Exception:
+        return "unknown"

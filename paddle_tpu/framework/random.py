@@ -25,8 +25,19 @@ def prng_impl() -> str:
 
     impl = get_flag("prng_impl")
     if impl == "auto":
-        # default_backend() is cached by jax after first backend init
-        impl = "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
+        from .bringup import TPU_PLATFORMS, backends_initialized, default_platform
+
+        if backends_initialized():
+            try:
+                platform = jax.default_backend()
+            except Exception:  # broken plugin: survivable (init.cc posture)
+                platform = "unknown"
+        else:
+            # Never let RNG-impl selection be the call that triggers (and
+            # possibly dies on) backend bring-up — guess from config; the
+            # key creation that follows does the real init.
+            platform = default_platform()
+        impl = "rbg" if platform in TPU_PLATFORMS else "threefry2x32"
     return impl
 
 

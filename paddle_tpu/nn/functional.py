@@ -297,13 +297,14 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
     rhs_spec = "OI" + spatial
     dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
                                         (lhs_spec, rhs_spec, lhs_spec))
+    # no preferred_element_type here: the TPU MXU accumulates bf16 convs
+    # in f32 natively, and requesting an f32 output makes the conv
+    # transpose rule see an f32 cotangent against bf16 operands (dtype
+    # mismatch at trace time under value_and_grad)
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        feature_group_count=groups)
     if bias is not None:
         bshape = [1] * out.ndim
         bshape[-1 if channel_last else 1] = bias.shape[0]

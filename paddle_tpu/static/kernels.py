@@ -512,13 +512,12 @@ def _conv2d(ins, attrs, ctx):
         pad = [(pad[0], pad[0]), (pad[1], pad[1])]
     else:
         pad = [(pad[0], pad[1]), (pad[2], pad[3])]
+    # no preferred_element_type: MXU accumulates bf16 convs in f32
+    # natively, and an f32 output breaks the conv transpose rule under
+    # append_backward (f32 cotangent vs bf16 operands)
     out = jax.lax.conv_general_dilated(
         x, w, stride, pad, rhs_dilation=dil, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-        else None)
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return _out(out, slot="Output")
 
 

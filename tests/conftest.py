@@ -1,29 +1,21 @@
 """Test config: force the CPU backend with a virtual 8-device mesh
 (SURVEY.md §4 — multi-host logic tests via
-xla_force_host_platform_device_count). Must override, not setdefault:
-the environment pins JAX_PLATFORMS=axon (real TPU) by default."""
+xla_force_host_platform_device_count).
+
+The guard itself lives in paddle_tpu.framework.bringup.force_cpu: the
+environment registers a remote-TPU PJRT plugin (axon) at interpreter
+boot, and when its tunnel is down *any* backend init — including cpu —
+blocks on it; force_cpu drops the factory and pins the cpu platform.
+Must override JAX_PLATFORMS, not setdefault: the environment pins
+JAX_PLATFORMS=axon (real TPU) by default. pytest plugins (jaxtyping)
+import jax before this conftest runs, so env vars alone are too late —
+force_cpu also updates the live jax config."""
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The environment registers a remote-TPU PJRT plugin (axon) at interpreter
-# boot; when its tunnel is down, *any* backend init — including cpu —
-# blocks on it. Tests are CPU-only by design, so drop the factory before
-# the first backends() call.
-try:
-    import jax
-    from jax._src import xla_bridge as _xb
+from paddle_tpu.framework.bringup import force_cpu  # noqa: E402
 
-    for _name in ("axon",):
-        _xb._backend_factories.pop(_name, None)
-    # pytest plugins (jaxtyping) import jax before this conftest runs, so
-    # the env var alone is too late — update the live config too.
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
-
+force_cpu(n_devices=8)
