@@ -31,6 +31,9 @@ _PROBE_SRC = "import jax; print(jax.default_backend())"
 #: remote plugin registers under its own name but fronts a TPU chip.
 TPU_PLATFORMS = ("tpu", "axon")
 
+#: jax's own platform factories; external plugins register other names
+_BUILTIN_PLATFORMS = ("cpu", "tpu", "cuda", "rocm", "gpu", "metal")
+
 
 def backends_initialized() -> bool:
     """True once jax has committed to a set of live backends."""
@@ -79,8 +82,12 @@ def force_cpu(n_devices: int | None = None) -> None:
         import jax
         from jax._src import xla_bridge as xb
 
+        # Drop only EXTERNAL plugin factories (the hang lives in remote
+        # plugins like axon). Built-in platform factories must stay
+        # registered — e.g. "tpu" being a *known* platform is what lets
+        # Pallas register its TPU lowering rules even on a cpu backend.
         for name in list(getattr(xb, "_backend_factories", {})):
-            if name != "cpu":
+            if name not in _BUILTIN_PLATFORMS:
                 xb._backend_factories.pop(name, None)
         jax.config.update("jax_platforms", "cpu")
     except Exception:

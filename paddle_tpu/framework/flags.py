@@ -73,3 +73,6 @@ define_flag("init_allocated_mem", False, "API parity")
 define_flag("cudnn_deterministic", False, "Maps to XLA deterministic ops")
 define_flag("max_inplace_grad_add", 0, "API parity")
 define_flag("tracer_profile_fname", "", "Eager tracer profile output path")
+define_flag("sp_fallback_warn", True,
+            "Warn when sequence-parallel (ring/Ulysses) attention falls "
+            "back to the replicated local path — a silent perf cliff")

@@ -4,7 +4,7 @@ from .dataloader import (  # noqa: F401
     Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
     Subset, random_split, Sampler, SequenceSampler, RandomSampler,
     WeightedRandomSampler, BatchSampler, DistributedBatchSampler, DataLoader,
-    default_collate_fn,
+    default_collate_fn, get_worker_info, WorkerInfo,
 )
 from .serialization import (  # noqa: F401
     save, load, save_dygraph, load_dygraph, save_inference_model,

@@ -10,8 +10,12 @@ jax.device_put; for peak input rates see paddle_tpu.io.native (C++ feeder).
 from __future__ import annotations
 
 import itertools
+import multiprocessing
 import queue
+import sys
 import threading
+import time
+import traceback
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -239,20 +243,384 @@ def default_collate_fn(batch):
     return Tensor(np.asarray(batch))
 
 
+class WorkerInfo:
+    """Per-worker metadata inside a DataLoader worker process
+    (reference fluid/dataloader/dataloader_iter.py get_worker_info)."""
+
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """Inside a worker process: this worker's (id, num_workers, seed,
+    dataset); None in the main process. IterableDataset implementations
+    use it to shard their stream across workers."""
+    return _worker_info
+
+
+def _np_collate(batch):
+    """Numpy-only collate used inside worker processes. Workers must not
+    touch jax: the TPU plugin must never be initialized host-side in a
+    data worker, and XLA client thread pools do not survive fork."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(_np_collate([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([b.numpy() for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    return np.asarray(batch)
+
+
+def _tree_to_np(tree):
+    """Demote Tensor leaves (from a user collate_fn) to numpy for IPC."""
+    if isinstance(tree, (tuple, list)):
+        return tuple(_tree_to_np(x) for x in tree)
+    if isinstance(tree, dict):
+        return {k: _tree_to_np(v) for k, v in tree.items()}
+    if isinstance(tree, Tensor):
+        return tree.numpy()
+    return tree
+
+
+def _contains_tensor(tree) -> bool:
+    if isinstance(tree, (tuple, list)):
+        return any(_contains_tensor(x) for x in tree)
+    if isinstance(tree, dict):
+        return any(_contains_tensor(v) for v in tree.values())
+    return isinstance(tree, Tensor)
+
+
+def _tree_to_tensor(tree):
+    """Promote ndarray leaves back to Tensors in the main process."""
+    if isinstance(tree, (tuple, list)):
+        return tuple(_tree_to_tensor(x) for x in tree)
+    if isinstance(tree, dict):
+        return {k: _tree_to_tensor(v) for k, v in tree.items()}
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    return tree
+
+
+_SHM_MIN_BYTES = 1 << 15  # below this, pipe pickling beats a shm segment
+
+
+def _shm_pack(tree):
+    """Move large ndarray leaves into shared-memory segments so batches
+    cross the worker->main pipe as (name, shape, dtype) descriptors
+    instead of pickled buffers (reference memory/allocation/
+    mmap_allocator.cc shared-memory path)."""
+    from multiprocessing import shared_memory
+
+    if isinstance(tree, (tuple, list)):
+        return tuple(_shm_pack(x) for x in tree)
+    if isinstance(tree, dict):
+        return {k: _shm_pack(v) for k, v in tree.items()}
+    if isinstance(tree, np.ndarray) and tree.nbytes >= _SHM_MIN_BYTES:
+        try:
+            seg = shared_memory.SharedMemory(create=True, size=tree.nbytes)
+        except OSError:  # no /dev/shm: fall back to pipe transport
+            return tree
+        # count=: the OS may round the mapping up to a page multiple
+        np.frombuffer(seg.buf, dtype=tree.dtype,
+                      count=tree.size)[:] = tree.reshape(-1)
+        desc = ("__shm__", seg.name, tree.shape, str(tree.dtype))
+        seg.close()
+        # ownership transfers to the consumer (which unlinks after copy);
+        # keep this process's resource_tracker from double-unlinking at exit
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:
+            pass
+        return desc
+    return tree
+
+
+def _shm_unpack(tree):
+    from multiprocessing import shared_memory
+
+    if isinstance(tree, tuple) and len(tree) == 4 and tree[0] == "__shm__":
+        _, name, shape, dtype = tree
+        seg = shared_memory.SharedMemory(name=name)
+        try:
+            count = int(np.prod(shape)) if shape else 1
+            arr = np.frombuffer(seg.buf, dtype=np.dtype(dtype),
+                                count=count).reshape(shape).copy()
+        finally:
+            seg.close()
+            seg.unlink()
+        return arr
+    if isinstance(tree, (tuple, list)):
+        return tuple(_shm_unpack(x) for x in tree)
+    if isinstance(tree, dict):
+        return {k: _shm_unpack(v) for k, v in tree.items()}
+    return tree
+
+
+def _worker_loop(dataset, is_iterable, batch_size, drop_last, collate_fn,
+                 task_q, data_q, stop_event, wid, num_workers, seed,
+                 worker_init_fn, use_shm, is_spawn):
+    """Body of one DataLoader worker process (reference
+    fluid/dataloader/dataloader_iter.py:335 _worker_loop)."""
+    if is_spawn:
+        # a spawned worker has a fresh interpreter: if sample code touches
+        # jax (Tensor datasets), backend bring-up must pin cpu — never the
+        # (possibly broken, possibly remote) accelerator plugin
+        from ..framework.bringup import force_cpu
+
+        force_cpu()
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, seed + wid, dataset)
+    np.random.seed((seed + wid) % (1 << 31))
+    collate = (_np_collate if collate_fn is None
+               else (lambda b: _tree_to_np(collate_fn(b))))
+    pack = _shm_pack if use_shm else (lambda t: t)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        if is_iterable:
+            it = iter(dataset)
+            while not stop_event.is_set():
+                chunk = list(itertools.islice(it, batch_size))
+                if not chunk or (len(chunk) < batch_size and drop_last):
+                    break
+                data_q.put(("data", None, pack(collate(chunk))))
+            data_q.put(("done", wid, None))
+        else:
+            while not stop_event.is_set():
+                task = task_q.get()
+                if task is None:
+                    break
+                bid, indices = task
+                batch = pack(collate([dataset[i] for i in indices]))
+                data_q.put(("data", bid, batch))
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        try:
+            data_q.put(("error", wid, traceback.format_exc()))
+        except Exception:
+            pass
+    finally:
+        # let the queue feeder flush before the process exits
+        data_q.close()
+        data_q.join_thread()
+
+
+class _MultiprocessIter:
+    """Multi-worker iteration: a shared task queue feeds worker processes,
+    an out-of-order data queue comes back, and the main process reorders
+    completed batches so the sampler's order is preserved (reference
+    _DataLoaderIterMultiProcess: indices queues + reorder buffer +
+    SIGCHLD watchdog; the watchdog here is an is_alive poll)."""
+
+    _POLL_SEC = 1.0
+
+    def __init__(self, loader, epoch: int = 0):
+        self.loader = loader
+        self.is_iterable = loader.batch_sampler is None
+        ctx_name = loader.mp_context
+        if ctx_name == "fork" and not self.is_iterable:
+            # fork is only safe while workers never touch jax; a dataset
+            # yielding Tensors (jax-backed) forces a clean interpreter
+            try:
+                if _contains_tensor(loader.dataset[0]):
+                    ctx_name = "spawn"
+            except Exception:
+                pass
+        self.ctx = multiprocessing.get_context(ctx_name)
+        self.task_q = self.ctx.Queue()
+        self.data_q = self.ctx.Queue()
+        self.stop_event = self.ctx.Event()
+        self.timeout = loader.timeout
+        n = loader.num_workers
+        # fresh per-epoch base seed: epoch-invariant seeds would replay
+        # the same augmentation stream every epoch (reference
+        # dataloader_iter.py draws a new base_seed per iterator)
+        seed = default_generator().initial_seed() + 1000003 * epoch
+        # user collate runs worker-side (numpy in/out); the default stays
+        # None so workers use the jax-free _np_collate
+        collate = (None if loader.collate_fn is default_collate_fn
+                   else loader.collate_fn)
+        self.workers = []
+        for wid in range(n):
+            w = self.ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.is_iterable, loader.batch_size
+                      if self.is_iterable else 0, loader.drop_last
+                      if self.is_iterable else False, collate, self.task_q,
+                      self.data_q, self.stop_event, wid, n, seed,
+                      loader.worker_init_fn, loader.use_shared_memory,
+                      ctx_name == "spawn"),
+                daemon=True)
+            w.start()
+            self.workers.append(w)
+
+    def _check_workers(self):
+        for w in self.workers:
+            if not w.is_alive() and w.exitcode not in (0, None):
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker (pid {w.pid}) exited unexpectedly "
+                    f"with exitcode {w.exitcode}. This usually means the "
+                    "worker was killed (OOM?) or called os._exit; rerun "
+                    "with num_workers=0 to debug in-process.")
+
+    def _get(self):
+        deadline = time.time() + self.timeout if self.timeout else None
+        while True:
+            try:
+                return self.data_q.get(timeout=self._POLL_SEC)
+            except queue.Empty:
+                self._check_workers()
+                if deadline is not None and time.time() > deadline:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout}s "
+                        "waiting for a worker batch")
+
+    def _handle(self, msg):
+        tag, key, payload = msg
+        if tag == "error":
+            self._shutdown()
+            raise RuntimeError(
+                f"DataLoader worker {key} raised:\n{payload}")
+        return tag, key, payload
+
+    def __iter__(self):
+        try:
+            if self.is_iterable:
+                yield from self._iter_iterable()
+            else:
+                yield from self._iter_map()
+        finally:
+            self._shutdown()
+
+    def _iter_iterable(self):
+        done = 0
+        while done < len(self.workers):
+            tag, _key, payload = self._handle(self._get())
+            if tag == "done":
+                done += 1
+                continue
+            yield _tree_to_tensor(_shm_unpack(payload))
+
+    def _iter_map(self):
+        batches = list(self.loader.batch_sampler)
+        total = len(batches)
+        inflight_cap = max(2, self.loader.prefetch) * len(self.workers)
+        sent = 0
+        while sent < min(inflight_cap, total):
+            self.task_q.put((sent, batches[sent]))
+            sent += 1
+        buffered = {}
+        next_bid = 0
+        while next_bid < total:
+            while next_bid in buffered:
+                payload = buffered.pop(next_bid)
+                next_bid += 1
+                if sent < total:
+                    self.task_q.put((sent, batches[sent]))
+                    sent += 1
+                yield _tree_to_tensor(_shm_unpack(payload))
+            if next_bid >= total:
+                break
+            tag, bid, payload = self._handle(self._get())
+            if tag == "data":
+                buffered[bid] = payload
+
+    def _drain_once(self):
+        """Unpack (and so unlink) any shm-backed batches sitting in the
+        data queue — the workers unregistered the segments, so an
+        undrained queue would leak /dev/shm until reboot."""
+        try:
+            while True:
+                msg = self.data_q.get_nowait()
+                if msg[0] == "data":
+                    _shm_unpack(msg[2])
+        except Exception:
+            pass
+
+    def _shutdown(self):
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        self.stop_event.set()   # iterable workers have no task sentinel
+        for _w in self.workers:
+            try:
+                self.task_q.put(None)
+            except Exception:
+                pass
+        # keep draining while workers wind down: a worker mid-batch will
+        # still put one more message after the stop signal
+        deadline = time.time() + 5.0
+        while time.time() < deadline and any(
+                w.is_alive() for w in self.workers):
+            self._drain_once()
+            time.sleep(0.05)
+        for w in self.workers:
+            w.join(timeout=max(0.1, deadline - time.time()))
+            if w.is_alive():
+                w.terminate()
+        self._drain_once()
+        for q in (self.task_q, self.data_q):
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     """Iterates a Dataset into device-ready Tensor batches with background
-    prefetch (replaces reference GeneratorLoader + buffered_reader)."""
+    prefetch (replaces reference GeneratorLoader + buffered_reader).
+
+    num_workers>0 preprocesses batches in that many OS processes
+    (reference imperative/data_loader.cc + dataloader_iter.py
+    _DataLoaderIterMultiProcess): a shared task queue, shared-memory
+    batch transport (use_shared_memory), sampler-order-preserving
+    reordering, and a watchdog that surfaces dead workers instead of
+    hanging. Worker-side code must stay numpy-only — jax is deliberately
+    never touched in workers (host preprocessing feeds the TPU; the
+    device path belongs to the main process)."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 mp_context=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
-        self.num_workers = num_workers
+        self.num_workers = int(num_workers)
         self.prefetch = max(2, prefetch_factor) if use_buffer_reader else 0
         self.return_list = return_list
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        # fork keeps worker startup cheap; jax is never touched worker-side
+        # so fork-after-XLA-init hazards don't apply. spawn available for
+        # datasets that need a clean interpreter.
+        self.mp_context = mp_context or (
+            "fork" if sys.platform.startswith("linux") else "spawn")
+        self._epoch = 0
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -285,6 +653,11 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        if self.num_workers > 0:
+            epoch = self._epoch
+            self._epoch += 1
+            yield from _MultiprocessIter(self, epoch=epoch)
+            return
         if self.prefetch <= 0:
             yield from self._raw_iter()
             return

@@ -573,21 +573,51 @@ def _local_attention(q, k, v, is_causal):
     return _xla_attention(q, k, v, None, 0.0, is_causal, None)
 
 
+def _as_kv_padding_mask(mask, b, lk):
+    """(B, Lk) bool view of a key-padding mask, or None if the mask
+    depends on the query position ((B, Lq, Lk), full (B, H, Lq, Lk), ...)
+    and cannot ride the ring as a per-key mask. Bool masks only: a
+    non-bool mask is an ADDITIVE bias (0 = attend, -1e9 = masked) —
+    casting it to bool would invert its meaning (cf. _kv_mask_bias)."""
+    if mask is None:
+        return None
+    m = jnp.asarray(mask)
+    if m.dtype != jnp.bool_:
+        return None
+    if m.ndim == 2 and m.shape == (b, lk):
+        return m
+    if m.ndim == 4 and m.shape[1] == 1 and m.shape[2] == 1 \
+            and m.shape[0] == b and m.shape[3] == lk:
+        return m[:, 0, 0, :]
+    if m.ndim == 3 and m.shape == (b, 1, lk):
+        return m[:, 0, :]
+    return None
+
+
 def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
                                 is_causal=False, key_rng=None):
-    if mask is None and dropout_p == 0.0:
+    if dropout_p == 0.0:
         # context parallelism: shard the sequence axis over the mesh
-        # (ring / Ulysses attention) when a sequence_parallel() scope is on;
-        # ring_attention falls back to XLA attention for non-dividing shapes
-        from ...parallel.ring import active_sequence_parallel, ring_attention
+        # (ring / Ulysses attention) when a sequence_parallel() scope is
+        # on. Key-padding masks ride the ring at block granularity;
+        # query-dependent masks fall back (logged via
+        # FLAGS_sp_fallback_warn).
+        from ...parallel.ring import (_log_sp_fallback,
+                                      active_sequence_parallel,
+                                      ring_attention)
 
         sp = active_sequence_parallel()
         if sp is not None:
             axis, impl, batch_axis, mesh = sp
-            return ring_attention(q, k, v, mesh=mesh, seq_axis=axis,
-                                  batch_axis=batch_axis,
-                                  is_causal=is_causal, impl=impl)
-        return _local_attention(q, k, v, is_causal)
+            kv_mask = _as_kv_padding_mask(mask, q.shape[0], k.shape[1])
+            if mask is None or kv_mask is not None:
+                return ring_attention(q, k, v, mesh=mesh, seq_axis=axis,
+                                      batch_axis=batch_axis,
+                                      is_causal=is_causal, impl=impl,
+                                      kv_mask=kv_mask)
+            _log_sp_fallback("query-dependent attention mask")
+        elif mask is None:
+            return _local_attention(q, k, v, is_causal)
     if (mask is None and dropout_p > 0.0 and key_rng is not None and
             q.shape[0] * q.shape[2] < (1 << 15) and
             _pallas_ok(q, k, is_causal, seq_floor=128)):

@@ -55,12 +55,17 @@ def _axis_size(axis_name):
 
 
 def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
-                         axis_size: Optional[int] = None):
+                         axis_size: Optional[int] = None, kv_mask=None):
     """Ring attention over `axis_name`; call inside shard_map.
 
     q/k/v: (B, L_local, H, D) — this device's sequence shard. Returns the
     attention output for the local Q block, (B, L_local, H, D). The KV ring
     walk is a `fori_loop`, so HLO size stays O(1) in the axis size.
+
+    kv_mask: optional (B, L_local) bool — this device's key-padding shard
+    (True = attend). It rides the ring with its K/V block, so padded keys
+    are masked at block granularity without materialising a global
+    (B, L, L) mask. Rows whose every key is padded produce zeros.
     """
     size = axis_size if axis_size is not None else _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -72,25 +77,32 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     b, h, lq, d = qh.shape
     lk = kh.shape[2]
     scale = 1.0 / math.sqrt(d)
+    has_mask = kv_mask is not None
+    mh = kv_mask.astype(jnp.bool_) if has_mask else None  # (b, lk)
 
     perm = [(i, (i + 1) % size) for i in range(size)]
     # causal alignment matches _xla_attention's bottom-right tril(k=kl-ql):
     # the last lq*size query positions align with the end of the kv axis
     causal_offset = (lk - lq) * size
 
-    def block_update(s, m, l, acc, kc, vc):
+    def block_update(s, m, l, acc, kc, vc, mc):
         # after s rotations this device holds the block that originated on
         # device (idx - s) mod size
         origin = jnp.mod(idx - s, size)
         scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kc) * scale
+        valid = None
         if is_causal:
             q_pos = idx * lq + jnp.arange(lq)[:, None] + causal_offset
             k_pos = origin * lk + jnp.arange(lk)[None, :]
-            valid = q_pos >= k_pos                     # (lq, lk)
+            valid = jnp.broadcast_to(q_pos >= k_pos, (1, 1, lq, lk))
+        if has_mask:
+            kvalid = mc[:, None, None, :]              # (b, 1, 1, lk)
+            valid = kvalid if valid is None else (valid & kvalid)
+        if valid is not None:
             scores = jnp.where(valid, scores, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
         p = jnp.exp(scores - m_new[..., None])
-        if is_causal:
+        if valid is not None:
             # fully-masked rows have scores == m_new == _NEG_INF and would
             # otherwise contribute exp(0) = 1
             p = jnp.where(valid, p, 0.0)
@@ -100,11 +112,13 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
         return m_new, l, acc
 
     def body(s, carry):
-        m, l, acc, kc, vc = carry
-        m, l, acc = block_update(s, m, l, acc, kc, vc)
+        m, l, acc, kc, vc, mc = carry
+        m, l, acc = block_update(s, m, l, acc, kc, vc, mc)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return m, l, acc, kc, vc
+        if has_mask:
+            mc = jax.lax.ppermute(mc, axis_name, perm)
+        return m, l, acc, kc, vc, mc
 
     # derive initial carries from the inputs (0*q) so they carry the same
     # varying-manual-axes type as the loop outputs (shard_map vma check)
@@ -112,12 +126,15 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     m0 = zero_q + _NEG_INF
     l0 = zero_q
     acc0 = zero_q[..., None] * vh[..., :1, :]       # (b, h, lq, dv)
+    # a dummy all-True mask keeps the carry structure static when unmasked
+    mc0 = mh if has_mask else jnp.zeros((), jnp.bool_)
     # the last block needs no rotation afterwards: loop size-1 rotations,
     # then fold in the final kv block outside the loop (saves one ICI hop)
-    m, l, acc, kc, vc = jax.lax.fori_loop(
-        0, size - 1, body, (m0, l0, acc0, kh, vh))
-    m, l, acc = block_update(size - 1, m, l, acc, kc, vc)
+    m, l, acc, kc, vc, mc = jax.lax.fori_loop(
+        0, size - 1, body, (m0, l0, acc0, kh, vh, mc0))
+    m, l, acc = block_update(size - 1, m, l, acc, kc, vc, mc)
 
+    # fully-masked rows: l == 0 -> output 0 (not NaN)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(orig_dtype)
 
@@ -128,11 +145,13 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
 
 
 def ulysses_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
-                            axis_size: Optional[int] = None):
+                            axis_size: Optional[int] = None, kv_mask=None):
     """Ulysses sequence parallelism; call inside shard_map.
 
     q/k/v: (B, L_local, H, D), H divisible by the axis size. all_to_all to
     (B, L_full, H/size, D), local full attention, all_to_all back.
+    kv_mask: optional (B, L_full) bool key-padding mask, replicated over
+    the axis (after the all-to-all every device sees the full kv axis).
     """
     from ..ops.pallas.flash_attention import _xla_attention
 
@@ -145,7 +164,9 @@ def ulysses_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
                                   tiled=True)
 
     qa, ka, va = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
-    out = _xla_attention(qa, ka, va, None, 0.0, is_causal, None)
+    mask = (kv_mask[:, None, None, :].astype(jnp.bool_)
+            if kv_mask is not None else None)
+    out = _xla_attention(qa, ka, va, mask, 0.0, is_causal, None)
     return a2a_bwd(out)
 
 
@@ -154,28 +175,61 @@ def ulysses_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
 # ---------------------------------------------------------------------------
 
 
+def _log_sp_fallback(reason: str):
+    """Sequence-parallel fallbacks are a silent perf cliff (the full
+    attention runs replicated); surface them (FLAGS_sp_fallback_warn)."""
+    from ..framework.flags import get_flag
+
+    try:
+        warn = get_flag("sp_fallback_warn")
+    except KeyError:
+        warn = True
+    if warn:
+        import warnings
+
+        warnings.warn(
+            f"sequence-parallel attention fell back to the local/XLA "
+            f"path: {reason}", RuntimeWarning, stacklevel=3)
+
+
 def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
                    seq_axis: str = "sp", batch_axis: str = "dp",
                    head_axis: str = "tp",
-                   is_causal: bool = False, impl: str = "ring"):
+                   is_causal: bool = False, impl: str = "ring",
+                   kv_mask=None):
     """Context-parallel attention over `seq_axis` of `mesh`.
 
     q/k/v: (B, L, H, D) global arrays (or sharded under pjit — specs
     compose). impl: "ring" (ppermute KV rotation) or "ulysses"
-    (all-to-all head split). Shapes the sharded path cannot handle
-    (sequence/batch/heads not divisible by the relevant axis sizes) fall
-    back to plain XLA attention instead of erroring.
+    (all-to-all head split). kv_mask: optional (B, L) bool key-padding
+    mask (True = attend) — sharded over the sequence axis and streamed
+    around the ring with its K/V block. Shapes the sharded path cannot
+    handle (sequence/batch/heads not divisible by the relevant axis
+    sizes) fall back to plain XLA attention, logged via
+    FLAGS_sp_fallback_warn.
     """
-    from ..ops.pallas.flash_attention import _local_attention
+    from ..ops.pallas.flash_attention import _local_attention, _xla_attention
+
+    def fallback(reason):
+        _log_sp_fallback(reason)
+        if kv_mask is None:
+            return _local_attention(q, k, v, is_causal)
+        return _xla_attention(q, k, v,
+                              kv_mask[:, None, None, :].astype(jnp.bool_),
+                              0.0, is_causal, None)
 
     mesh = mesh or get_mesh()
     b, lq, h, d = q.shape
     lk = k.shape[1]
     if mesh is None or seq_axis not in mesh.axis_names:
-        return _local_attention(q, k, v, is_causal)
+        return fallback(f"no mesh axis {seq_axis!r}")
     size = mesh.shape[seq_axis]
-    if size <= 1 or lq % size != 0 or lk % size != 0:
-        return _local_attention(q, k, v, is_causal)
+    if size <= 1:
+        return fallback(f"axis {seq_axis!r} has size 1")
+    if lq % size != 0 or lk % size != 0:
+        return fallback(
+            f"sequence lengths ({lq}, {lk}) not divisible by "
+            f"{seq_axis}={size}")
     ba = batch_axis if (batch_axis in mesh.axis_names
                         and batch_axis != seq_axis
                         and b % mesh.shape[batch_axis] == 0) else None
@@ -191,8 +245,18 @@ def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
     local = ring_attention_local if impl == "ring" else ulysses_attention_local
     fn = functools.partial(local, axis_name=seq_axis, is_causal=is_causal,
                            axis_size=size)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    if kv_mask is None:
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)
+    kv_mask = jnp.asarray(kv_mask)
+    # ring: the mask shard travels with its kv block; ulysses: every
+    # device needs the full kv axis after the all-to-all -> replicated
+    mspec = (PartitionSpec(ba, seq_axis) if impl == "ring"
+             else PartitionSpec(ba, None))
+    wrapped = lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_)  # noqa: E731
+    return jax.shard_map(wrapped, mesh=mesh,
+                         in_specs=(spec, spec, spec, mspec),
+                         out_specs=spec)(q, k, v, kv_mask)
 
 
 ulysses_attention = functools.partial(ring_attention, impl="ulysses")

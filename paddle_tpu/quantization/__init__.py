@@ -27,7 +27,8 @@ from ..nn import common as common_mod
 from ..nn.layer import Layer
 
 __all__ = ["fake_quant", "QuantConfig", "QAT", "PTQ", "QuantedLinear",
-           "QuantedConv2D", "quant_aware", "export_int8"]
+           "QuantedConv2D", "quant_aware", "export_int8",
+           "convert_to_inference", "save_quantized"]
 
 
 @primitive("fake_quantize_dequantize", nondiff=("scale",))
@@ -47,11 +48,16 @@ class QuantConfig:
 
     def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
                  moving_rate: float = 0.9,
-                 quantizable_layer_type=("Linear", "Conv2D")):
+                 quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_quantize_type: str = "abs_max"):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unsupported weight_quantize_type {weight_quantize_type!r}")
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.moving_rate = moving_rate
         self.quantizable_layer_type = tuple(quantizable_layer_type)
+        self.weight_quantize_type = weight_quantize_type
 
 
 class _QuantedBase(Layer):
@@ -82,12 +88,27 @@ class _QuantedBase(Layer):
         scale = self._observe(x)
         return fake_quant(x, scale, self._cfg.activation_bits)
 
+    #: reduction axes for channel-wise weight scales; subclasses override.
+    #: Linear weight (in, out) -> per-out-channel over axis 0;
+    #: Conv2D weight (out, in, kh, kw) -> per-out-channel over (1, 2, 3)
+    #: (reference quantization_pass.py channel_wise_abs_max, quant_axis)
+    _channel_reduce_axes: tuple = ()
+
+    def _weight_scale(self, w):
+        """Broadcast-shaped abs-max scale per the configured quant type."""
+        if self._cfg.weight_quantize_type == "channel_wise_abs_max" and \
+                self._channel_reduce_axes:
+            return jnp.max(jnp.abs(w), axis=self._channel_reduce_axes,
+                           keepdims=True)
+        return jnp.max(jnp.abs(w))
+
     def _q_weight(self, w):
-        scale = jnp.max(jnp.abs(w.value if isinstance(w, Tensor) else w))
-        return fake_quant(w, scale, self._cfg.weight_bits)
+        arr = w.value if isinstance(w, Tensor) else w
+        return fake_quant(w, self._weight_scale(arr), self._cfg.weight_bits)
 
 
 class QuantedLinear(_QuantedBase):
+    _channel_reduce_axes = (0,)
     def forward(self, x):
         import paddle_tpu.nn.functional as F
 
@@ -98,6 +119,8 @@ class QuantedLinear(_QuantedBase):
 
 
 class QuantedConv2D(_QuantedBase):
+    _channel_reduce_axes = (1, 2, 3)
+
     def forward(self, x):
         import paddle_tpu.nn.functional as F
 
@@ -165,24 +188,37 @@ class PTQ:
         return model
 
 
+def _bake_int8(qb: _QuantedBase):
+    """(weight_int8, dequant_multiplier) for a quantized layer; the
+    multiplier is scalar for abs_max, broadcast-shaped per-out-channel for
+    channel_wise_abs_max."""
+    w = np.asarray(qb.inner.weight.numpy())
+    scale = np.asarray(qb._weight_scale(jnp.asarray(w)))
+    qmax = float(2 ** (qb._cfg.weight_bits - 1) - 1)
+    wq = np.clip(np.round(w / np.maximum(scale, 1e-8) * qmax),
+                 -qmax - 1, qmax).astype(np.int8)
+    return wq, (scale / qmax).astype(np.float32)
+
+
 def export_int8(model: Layer) -> Dict[str, dict]:
     """Bake int8 weights + scales for export: {layer_name: {weight_int8,
-    weight_scale, act_scale}} (reference quant_int8 conversion). Distinct
-    from PTQ.convert(), which ends calibration and returns the model."""
+    weight_scale, act_scale}} (reference quant_int8 conversion).
+    weight_scale is a python float for abs_max, a per-out-channel ndarray
+    for channel_wise_abs_max. Distinct from PTQ.convert(), which ends
+    calibration and returns the model; for a loadable artifact see
+    save_quantized()."""
     out = {}
 
     def walk(layer: Layer, prefix: str):
         for name, sub in layer._sub_layers.items():
             full = f"{prefix}.{name}" if prefix else name
             if isinstance(sub, _QuantedBase):
-                w = np.asarray(sub.inner.weight.numpy())
-                scale = float(np.max(np.abs(w)))
-                qmax = float(2 ** (sub._cfg.weight_bits - 1) - 1)
-                wq = np.clip(np.round(w / max(scale, 1e-8) * qmax),
-                             -qmax - 1, qmax).astype(np.int8)
+                wq, mult = _bake_int8(sub)
                 out[full] = {
                     "weight_int8": wq,
-                    "weight_scale": scale / qmax,
+                    "weight_scale": (float(mult) if mult.size == 1
+                                     else np.squeeze(mult)),
+                    "quant_type": sub._cfg.weight_quantize_type,
                     "act_scale": float(np.asarray(sub.act_scale.numpy())),
                 }
             else:
@@ -190,3 +226,104 @@ def export_int8(model: Layer) -> Dict[str, dict]:
 
     walk(model, "")
     return out
+
+
+class _Int8InferenceBase(Layer):
+    """Inference-mode int8 layer: holds the actual int8 weight plus
+    dequant multiplier and the frozen activation scale. Forward
+    statically quantizes the activation and computes with the dequantized
+    weight — the TPU-native analogue of the reference's saved quant_int8
+    inference program (weights live as int8 constants in the exported
+    StableHLO; XLA folds the dequant into the matmul/conv)."""
+
+    def __init__(self, qb: _QuantedBase):
+        super().__init__()
+        wq, mult = _bake_int8(qb)
+        self._abits = qb._cfg.activation_bits
+        self.register_buffer("weight_q", Tensor(jnp.asarray(wq)))
+        self.register_buffer("weight_mult", Tensor(jnp.asarray(mult)))
+        self.register_buffer("act_scale", Tensor(
+            jnp.maximum(qb.act_scale.value.astype(jnp.float32), 1e-8)))
+        bias = qb.inner.bias
+        self._has_bias = bias is not None
+        if self._has_bias:
+            self.register_buffer("bias", Tensor(bias.value))
+
+    def _weight(self):
+        return self.weight_q.value.astype(jnp.float32) * \
+            self.weight_mult.value
+
+    def _q_act(self, x):
+        return fake_quant(x, self.act_scale.value, self._abits)
+
+
+class Int8Linear(_Int8InferenceBase):
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.linear(self._q_act(x), self._weight(),
+                        self.bias if self._has_bias else None)
+
+
+class Int8Conv2D(_Int8InferenceBase):
+    def __init__(self, qb: _QuantedBase):
+        super().__init__(qb)
+        inner = qb.inner
+        self._stride = inner._stride
+        self._padding = inner._padding
+        self._dilation = inner._dilation
+        self._groups = inner._groups
+        self._data_format = inner._data_format
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return F.conv2d(self._q_act(x), self._weight(),
+                        self.bias if self._has_bias else None,
+                        stride=self._stride, padding=self._padding,
+                        dilation=self._dilation, groups=self._groups,
+                        data_format=self._data_format)
+
+
+_INT8_WRAPPERS = {QuantedLinear: Int8Linear, QuantedConv2D: Int8Conv2D}
+
+
+def convert_to_inference(model: Layer) -> Layer:
+    """Swap Quanted* layers for Int8* inference layers holding real int8
+    weights (reference slim convert / QuantizationFreezePass). The
+    returned model is eval-mode and export-ready."""
+    def wrapper_for(sub):
+        # isinstance, not exact type: subclasses of the Quanted layers
+        # must not silently survive conversion as fp32 fake-quant
+        for qcls, icls in _INT8_WRAPPERS.items():
+            if isinstance(sub, qcls):
+                return icls
+        if isinstance(sub, _QuantedBase):
+            raise TypeError(
+                f"no int8 inference conversion registered for "
+                f"{type(sub).__name__}")
+        return None
+
+    def walk(layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            wrapper = wrapper_for(sub)
+            if wrapper is not None:
+                setattr(layer, name, wrapper(sub))
+            else:
+                walk(sub)
+
+    walk(model)
+    model.eval()
+    return model
+
+
+def save_quantized(model: Layer, path_prefix: str, input_spec) -> Layer:
+    """Quantized-model → inference-artifact round trip: convert to int8
+    inference layers and save a StableHLO export that
+    inference.create_predictor loads and runs (closes the reference's
+    train→slim-convert→save→AnalysisPredictor loop)."""
+    from ..io.serialization import save_inference_model
+
+    m = convert_to_inference(model)
+    save_inference_model(path_prefix, m, input_spec=input_spec)
+    return m

@@ -1,0 +1,73 @@
+"""Worker process for test_multiprocess_collective.py (reference
+unittests/test_collective_base.py runtime_main shape): init the
+jax.distributed coordination service, prove cross-process visibility,
+run an eager allgather and a jitted DP train step whose mean-loss
+collective XLA inserts across processes, and print LOSS lines the
+parent asserts on."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.framework.bringup import force_cpu  # noqa: E402
+
+force_cpu()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
+    coord = os.environ["PADDLE_COORDINATOR"]
+
+    from paddle_tpu.distributed import (get_rank, get_world_size,
+                                        init_distributed)
+
+    init_distributed(coord, nproc, rank)
+    assert get_rank() == rank, (get_rank(), rank)
+    assert get_world_size() == nproc, (get_world_size(), nproc)
+    assert jax.device_count() == nproc, jax.device_count()
+
+    # eager cross-process allgather through the coordination backend
+    from jax.experimental import multihost_utils
+
+    g = multihost_utils.process_allgather(
+        np.array([float(rank + 1)], np.float32))
+    np.testing.assert_allclose(np.sort(np.ravel(g)),
+                               np.arange(1, nproc + 1, dtype=np.float32))
+    print(f"ALLGATHER {rank} OK", flush=True)
+
+    # DP train step: per-process batch shard, global mean loss — XLA
+    # inserts the cross-process all-reduce inside jit
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(nproc), ("dp",))
+    rng = np.random.RandomState(0)
+    per = 4
+    X = rng.randn(per * nproc, 4).astype(np.float32)
+    Y = rng.randn(per * nproc, 1).astype(np.float32)
+    W = jnp.asarray(rng.randn(4, 1).astype(np.float32))
+
+    shard = NamedSharding(mesh, P("dp"))
+    gx = jax.make_array_from_process_local_data(
+        shard, X[rank * per:(rank + 1) * per])
+    gy = jax.make_array_from_process_local_data(
+        shard, Y[rank * per:(rank + 1) * per])
+
+    @jax.jit
+    def step(W, x, y):
+        loss, grad = jax.value_and_grad(
+            lambda w: jnp.mean((x @ w - y) ** 2))(W)
+        return loss, W - 0.1 * grad
+
+    for i in range(3):
+        loss, W = step(W, gx, gy)
+        print(f"LOSS {rank} {i} {float(loss):.8f}", flush=True)
+    print(f"DONE {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,10 @@ import numpy as np
 import paddle_tpu.static as static
 from paddle_tpu.vision.datasets import MNIST, Cifar10
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def _train(main, startup, loss, feeds, steps=20, fetch=None):
     exe = static.Executor()

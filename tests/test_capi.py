@@ -12,6 +12,8 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.static import InputSpec
 
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

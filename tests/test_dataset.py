@@ -7,6 +7,8 @@ import pytest
 from paddle_tpu.io.dataset import (DatasetFactory, InMemoryDataset,
                                    SlotSpec)
 
+pytestmark = pytest.mark.slow
+
 
 def _write_multislot(path, n=100, seed=0):
     """3 slots: sparse uint64 ids (varlen), dense float x2, label uint64."""

@@ -17,6 +17,8 @@ from paddle_tpu.parallel import create_mesh
 from jax.sharding import PartitionSpec
 
 
+pytestmark = pytest.mark.slow
+
 class _MLP(nn.Layer):
     def __init__(self):
         super().__init__()

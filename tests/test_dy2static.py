@@ -10,6 +10,8 @@ from paddle_tpu import dy2static, nn
 from paddle_tpu.jit import to_static
 
 
+pytestmark = pytest.mark.slow
+
 def t(x, dtype=np.float32):
     return paddle.to_tensor(np.asarray(x, dtype))
 

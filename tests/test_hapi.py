@@ -9,6 +9,8 @@ from paddle_tpu import hapi, metric, nn, optimizer
 from paddle_tpu.io.dataloader import Dataset
 
 
+pytestmark = pytest.mark.slow
+
 class ToyDataset(Dataset):
     def __init__(self, n=64, d=8, classes=4, seed=0):
         rng = np.random.RandomState(seed)

@@ -7,6 +7,8 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
 
+pytestmark = pytest.mark.slow
+
 
 def t(a):
     return paddle.to_tensor(np.asarray(a))

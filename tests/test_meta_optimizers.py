@@ -7,6 +7,8 @@ import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 
 
+pytestmark = pytest.mark.slow
+
 def _model():
     paddle.seed(0)
     return nn.Linear(8, 4)

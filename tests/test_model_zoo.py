@@ -9,6 +9,8 @@ from paddle_tpu.models import (
     GPTConfig, GPTForCausalLM, MobileNetV2, NGramLM, SkipGram, vgg16,
 )
 
+pytestmark = pytest.mark.slow
+
 
 def test_gpt_causal_property():
     """Future tokens must not affect past logits (causal attention)."""

@@ -6,6 +6,10 @@ import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.jit import TrainStep
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def _train_decreases(model, loss_fn, batches, lr=1e-3, steps=8):
     opt = optimizer.Adam(learning_rate=lr, parameters=model.parameters())

@@ -11,6 +11,8 @@ from paddle_tpu.parallel import create_mesh, set_mesh
 from paddle_tpu.parallel.mesh import _global_mesh
 
 
+pytestmark = pytest.mark.slow
+
 @pytest.fixture
 def mesh_ep4_dp2():
     mesh = create_mesh({"ep": 4, "dp": 2})

@@ -1,0 +1,76 @@
+"""Two-process collective execution (reference
+unittests/test_collective_base.py:144-189 check_with_place: Popen two
+ranks with env wiring, compare outputs). Proves the jax.distributed
+coordination path end-to-end on CPU: init, cross-process allgather, and
+a jitted DP step whose global-mean loss matches a single-process
+full-batch run exactly."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_collective_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _reference_losses(nproc=2, per=4, steps=3):
+    rng = np.random.RandomState(0)
+    X = rng.randn(per * nproc, 4).astype(np.float32)
+    Y = rng.randn(per * nproc, 1).astype(np.float32)
+    W = rng.randn(4, 1).astype(np.float32)
+    out = []
+    for _ in range(steps):
+        pred = X @ W
+        out.append(float(np.mean((pred - Y) ** 2)))
+        grad = 2.0 * X.T @ (pred - Y) / len(X)
+        W = W - 0.1 * grad
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_allreduce_and_dp_step():
+    nproc = 2
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if not k.startswith(("JAX_", "XLA_"))}
+    procs = []
+    for rank in range(nproc):
+        env = dict(env_base,
+                   PADDLE_TRAINER_ID=str(rank),
+                   PADDLE_TRAINERS_NUM=str(nproc),
+                   PADDLE_COORDINATOR=f"127.0.0.1:{port}")
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"ALLGATHER {rank} OK" in out, out
+        assert f"DONE {rank}" in out, out
+
+    # loss parity: every rank's global-mean loss at every step must equal
+    # the single-process full-batch value (the reference's check_with_place
+    # loss-delta criterion, exact here because the math is identical)
+    ref = _reference_losses(nproc)
+    for rank, out in enumerate(outs):
+        losses = [float(line.split()[3]) for line in out.splitlines()
+                  if line.startswith(f"LOSS {rank} ")]
+        assert len(losses) == len(ref), out
+        np.testing.assert_allclose(losses, ref, rtol=1e-5)

@@ -11,6 +11,8 @@ from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.crf import linear_chain_crf
 from paddle_tpu import ops
 
+pytestmark = pytest.mark.slow
+
 DELTA = 5e-3
 RTOL, ATOL = 5e-2, 5e-3
 

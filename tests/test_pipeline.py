@@ -12,6 +12,8 @@ from paddle_tpu.parallel import create_mesh, pipeline_apply, set_mesh
 from paddle_tpu.parallel.mesh import _global_mesh
 
 
+pytestmark = pytest.mark.slow
+
 @pytest.fixture
 def mesh_pp4_dp2():
     mesh = create_mesh({"pp": 4, "dp": 2})

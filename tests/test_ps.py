@@ -12,6 +12,8 @@ from paddle_tpu.ps import (
     SparseTable,
 )
 
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------------------
 # table (native + python fallback parity)

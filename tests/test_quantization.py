@@ -1,12 +1,17 @@
-"""QAT fake-quant training + PTQ calibration + int8 conversion."""
+"""QAT fake-quant training + PTQ calibration + int8 conversion +
+inference round trip (reference slim quantization_pass.py +
+test_quantization_pass.py freeze/save coverage)."""
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.quantization import (
-    PTQ, QAT, QuantConfig, QuantedConv2D, QuantedLinear, export_int8, fake_quant,
+    PTQ, QAT, QuantConfig, QuantedConv2D, QuantedLinear,
+    convert_to_inference, export_int8, fake_quant, save_quantized,
 )
+
+pytestmark = pytest.mark.slow
 
 
 def test_fake_quant_grid_and_ste():
@@ -79,3 +84,68 @@ def test_ptq_calibration_then_convert_close_to_fp():
         assert rec["weight_int8"].dtype == np.int8
         assert rec["weight_scale"] > 0
         assert rec["act_scale"] > 0
+
+
+def test_channel_wise_scales_beat_per_tensor():
+    """Per-out-channel scales must quantize a weight whose channels have
+    wildly different magnitudes with far less error than one global scale
+    (reference quantization_pass.py channel_wise_abs_max motivation)."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 8).astype(np.float32)
+    w[:, 0] *= 100.0  # one huge channel wrecks a per-tensor scale
+    lin = nn.Linear(16, 8)
+    lin.weight.set_value(w)
+
+    def quant_err(qtype):
+        q = QAT(QuantConfig(weight_quantize_type=qtype)).quantize(
+            nn.Sequential(lin))
+        layer = q[0]
+        wq = layer._q_weight(layer.inner.weight).numpy()
+        small = w[:, 1:]
+        return np.abs(wq[:, 1:] - small).max() / np.abs(small).max()
+
+    per_tensor = quant_err("abs_max")
+    per_channel = quant_err("channel_wise_abs_max")
+    assert per_channel < per_tensor / 10, (per_tensor, per_channel)
+
+    table = export_int8(QAT(QuantConfig(
+        weight_quantize_type="channel_wise_abs_max")).quantize(
+            nn.Sequential(nn.Linear(4, 6))))
+    (rec,) = table.values()
+    assert rec["weight_scale"].shape == (6,)
+    assert rec["quant_type"] == "channel_wise_abs_max"
+
+
+def test_quantized_inference_round_trip(tmp_path):
+    """train -> quantize -> save -> create_predictor -> run parity
+    (VERDICT r1 item 6; reference freeze-pass + AnalysisPredictor loop)."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    model = _lenet_ish()
+    qmodel = QAT(QuantConfig(
+        weight_quantize_type="channel_wise_abs_max")).quantize(model)
+    opt = optimizer.Adam(learning_rate=1e-2,
+                         parameters=qmodel.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 8, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+    for _ in range(4):
+        loss = nn.functional.cross_entropy(qmodel(x), y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    qmodel.eval()
+    ref = qmodel(x).numpy()
+
+    prefix = str(tmp_path / "quant_lenet")
+    save_quantized(qmodel, prefix,
+                   input_spec=[InputSpec([8, 1, 8, 8], "float32")])
+
+    pred = create_predictor(Config(prefix + ".pdmodel"))
+    (out,) = pred.run([x.numpy()])
+    # int8 inference layers reproduce the fake-quant eval forward closely
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert err < 0.05, err
+    assert np.array_equal(np.argmax(out, -1), np.argmax(ref, -1))

@@ -7,6 +7,10 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import nn
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def _run(layer, x, lens):
     out, (h, c) = layer(paddle.to_tensor(x),

@@ -14,6 +14,8 @@ from paddle_tpu.parallel import create_mesh, set_mesh
 from paddle_tpu.parallel.mesh import _global_mesh
 
 
+pytestmark = pytest.mark.slow
+
 @pytest.fixture
 def mesh_dp8():
     mesh = create_mesh({"dp": 8})

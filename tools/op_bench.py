@@ -1,0 +1,190 @@
+"""Op-level micro-benchmark harness (reference
+operators/benchmark/op_tester.cc + operators/jit/benchmark.cc): times the
+hot kernels — matmul, attention (XLA and Pallas flash), layernorm,
+embedding lookup, conv — on the current backend and appends one JSON
+line per op to a per-round history file so a single-kernel regression
+between rounds is visible without running a full model.
+
+Usage:
+    python tools/op_bench.py                 # bench all ops, print rows
+    python tools/op_bench.py --ops matmul,attention
+    python tools/op_bench.py --append bench_ops.jsonl  # history file
+
+Each row: {"op", "shape", "ms", "gflops" (if meaningful), "backend",
+"device_kind", "round": $BENCH_ROUND}. Smoke shapes via BENCH_SMOKE=1.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def bench_matmul(smoke):
+    import jax.numpy as jnp
+
+    n = 512 if smoke else 4096
+    key = jax.random.key(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(key, (n, n), jnp.bfloat16)
+    f = jax.jit(lambda a, b: a @ b)
+    ms = _timeit(f, a, b)
+    return {"op": "matmul_bf16", "shape": f"{n}x{n}x{n}", "ms": ms,
+            "gflops": 2 * n ** 3 / (ms / 1e3) / 1e9}
+
+
+def bench_attention(smoke):
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import functional as F
+
+    # (B, L, H, D) paddle layout; sdpa dispatches Pallas flash on TPU
+    b, h, s, d = (2, 4, 256, 64) if smoke else (8, 12, 512, 64)
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (b, s, h, d), jnp.float32)
+    f = jax.jit(lambda q: F.scaled_dot_product_attention(
+        q, q, q, is_causal=True, training=False).value)
+    ms = _timeit(f, q)
+    flops = 4 * b * h * s * s * d
+    return {"op": "attention_causal", "shape": f"b{b}h{h}s{s}d{d}",
+            "ms": ms, "gflops": flops / (ms / 1e3) / 1e9}
+
+
+def bench_flash_attention(smoke):
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.bringup import TPU_PLATFORMS
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _local_attention, _xla_attention)
+
+    if jax.default_backend() not in TPU_PLATFORMS:
+        return {"op": "flash_vs_xla", "skipped": "tpu-only"}
+    b, h, s, d = (2, 4, 256, 64) if smoke else (8, 12, 512, 64)
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (b, s, h, d), jnp.float32)
+    flash = jax.jit(lambda q: _local_attention(q, q, q, True))
+    xla = jax.jit(lambda q: _xla_attention(q, q, q, None, 0.0, True, None))
+    ms_flash = _timeit(flash, q)
+    ms_xla = _timeit(xla, q)
+    return {"op": "flash_vs_xla", "shape": f"b{b}h{h}s{s}d{d}",
+            "ms": ms_flash, "ms_xla": round(ms_xla, 4),
+            "speedup": round(ms_xla / ms_flash, 3)}
+
+
+def bench_layernorm(smoke):
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import functional as F
+
+    rows, dim = (1 << 12, 256) if smoke else (1 << 16, 1024)
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (rows, dim), jnp.float32)
+    w = jnp.ones((dim,), jnp.float32)
+    bvec = jnp.zeros((dim,), jnp.float32)
+    f = jax.jit(lambda x: F.layer_norm(x, (dim,), w, bvec).value)
+    ms = _timeit(f, x)
+    gbps = x.nbytes * 2 / (ms / 1e3) / 1e9
+    return {"op": "layernorm", "shape": f"{rows}x{dim}", "ms": ms,
+            "gbps": gbps}
+
+
+def bench_embedding(smoke):
+    import jax.numpy as jnp
+
+    vocab, dim = (10000, 128) if smoke else (100000, 768)
+    tokens = 1 << 12 if smoke else 1 << 15
+    key = jax.random.key(0)
+    table = jax.random.normal(key, (vocab, dim), jnp.float32)
+    ids = jax.random.randint(key, (tokens,), 0, vocab)
+    f = jax.jit(lambda t, i: t[i])
+    ms = _timeit(f, table, ids)
+    gbps = tokens * dim * 4 / (ms / 1e3) / 1e9
+    return {"op": "embedding", "shape": f"{vocab}x{dim}@{tokens}",
+            "ms": ms, "gbps": gbps}
+
+
+def bench_conv(smoke):
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import functional as F
+
+    b, c, hw, k = (4, 32, 32, 64) if smoke else (64, 128, 56, 128)
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (b, c, hw, hw), jnp.bfloat16)
+    w = jax.random.normal(key, (k, c, 3, 3), jnp.bfloat16)
+    f = jax.jit(lambda x, w: F.conv2d(x, w, padding=1).value)
+    ms = _timeit(f, x, w)
+    flops = 2 * b * k * c * 9 * hw * hw
+    return {"op": "conv2d_bf16", "shape": f"b{b}c{c}x{hw}->k{k}",
+            "ms": ms, "gflops": flops / (ms / 1e3) / 1e9}
+
+
+BENCHES = {
+    "matmul": bench_matmul,
+    "attention": bench_attention,
+    "flash_attention": bench_flash_attention,
+    "layernorm": bench_layernorm,
+    "embedding": bench_embedding,
+    "conv": bench_conv,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=",".join(BENCHES))
+    ap.add_argument("--append", default=None,
+                    help="JSONL history file to append rows to")
+    args = ap.parse_args()
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+
+    from paddle_tpu.framework.bringup import ensure_backend
+
+    backend = ensure_backend()
+    global jax
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    rows = []
+    for name in args.ops.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            row = BENCHES[name](smoke)
+        except Exception as e:
+            row = {"op": name, "error": f"{type(e).__name__}: {e}"}
+        row.update({"backend": backend, "device_kind": kind,
+                    "round": os.environ.get("BENCH_ROUND", "")})
+        if "ms" in row:
+            row["ms"] = round(row["ms"], 4)
+        for k in ("gflops", "gbps"):
+            if k in row:
+                row[k] = round(row[k], 2)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.append:
+        with open(args.append, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
+
+jax = None  # set in main() after backend resolution
+
+if __name__ == "__main__":
+    main()
