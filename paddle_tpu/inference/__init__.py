@@ -39,12 +39,27 @@ class Config:
     def model_dir(self):
         return os.path.dirname(self._prefix or "")
 
-    # knobs kept for parity; XLA handles fusion/memory planning
+    # knobs kept for parity; XLA handles fusion/memory planning. Turning
+    # them OFF cannot be honored (there is no non-optimized execution
+    # path) — say so instead of silently ignoring the request.
     def switch_ir_optim(self, flag: bool = True):
         self._ir_optim = flag
+        if not flag:
+            import warnings
+
+            warnings.warn(
+                "switch_ir_optim(False) has no effect: graph optimization "
+                "is XLA's compilation pipeline here, not a removable pass "
+                "stage", stacklevel=2)
 
     def enable_memory_optim(self, flag: bool = True):
         self._memory_optim = flag
+        if not flag:
+            import warnings
+
+            warnings.warn(
+                "enable_memory_optim(False) has no effect: buffer reuse is "
+                "XLA's memory planner here", stacklevel=2)
 
     def disable_glog_info(self):
         pass

@@ -431,14 +431,19 @@ class _MultiprocessIter:
         self.loader = loader
         self.is_iterable = loader.batch_sampler is None
         ctx_name = loader.mp_context
-        if ctx_name == "fork" and not self.is_iterable:
+        if ctx_name == "fork" and loader._needs_spawn is None:
             # fork is only safe while workers never touch jax; a dataset
-            # yielding Tensors (jax-backed) forces a clean interpreter
+            # yielding Tensors (jax-backed) forces a clean interpreter.
+            # Probed once per loader (dataset __getitem__/__iter__ may be
+            # expensive), cached for later epochs.
             try:
-                if _contains_tensor(loader.dataset[0]):
-                    ctx_name = "spawn"
+                sample = (next(iter(loader.dataset)) if self.is_iterable
+                          else loader.dataset[0])
+                loader._needs_spawn = _contains_tensor(sample)
             except Exception:
-                pass
+                loader._needs_spawn = False
+        if ctx_name == "fork" and loader._needs_spawn:
+            ctx_name = "spawn"
         self.ctx = multiprocessing.get_context(ctx_name)
         self.task_q = self.ctx.Queue()
         self.data_q = self.ctx.Queue()
@@ -621,6 +626,7 @@ class DataLoader:
         self.mp_context = mp_context or (
             "fork" if sys.platform.startswith("linux") else "spawn")
         self._epoch = 0
+        self._needs_spawn = None   # lazily probed once per loader
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
             self.batch_size = batch_size

@@ -16,7 +16,8 @@ import numpy as np
 from ..framework.op import primitive
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "roi_align", "nms",
-           "iou_matrix"]
+           "iou_matrix", "multiclass_nms", "matrix_nms",
+           "density_prior_box", "ssd_loss"]
 
 
 @primitive("yolo_box", nondiff=("img_size",))
@@ -405,3 +406,285 @@ def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
                     match_idx[c] = r
                     match_dist[c] = orig[r, c]
     return Tensor(match_idx[None, :]), Tensor(match_dist[None, :])
+
+
+# ---------------------------------------------------------------------------
+# SSD family long tail: multiclass/matrix NMS, density prior boxes, ssd loss
+# ---------------------------------------------------------------------------
+
+
+def _per_class_nms_masks(boxes, scores, iou_threshold, score_threshold,
+                         nms_top_k):
+    """vmapped greedy NMS over classes. boxes (M, 4), scores (C, M) ->
+    keep (C, M) over score-sorted order, order (C, M)."""
+    def one(s):
+        keep, order = _nms_mask(boxes, s, iou_threshold, score_threshold,
+                                None)
+        if nms_top_k > 0:
+            keep = keep & (jnp.arange(s.shape[0]) < nms_top_k)
+        return keep, order
+
+    return jax.vmap(one)(scores)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=-1,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   rois_num=None, name=None):
+    """Per-class NMS + cross-class top-k (multiclass_nms_op.cc).
+
+    bboxes: (N, M, 4) xyxy; scores: (N, C, M). Returns (out, nms_rois_num)
+    — out (sum_k, 6) rows [label, score, x0, y0, x1, y1] sorted by score
+    per image, nms_rois_num (N,) int32 — the dense+lengths rewrite of the
+    reference's LoD output (same pattern as ops/sequence.py). The compute
+    is a fixed-shape jit (vmap over batch and class); only the final trim
+    to per-image counts materializes eagerly."""
+    from ..framework.tensor import Tensor, unwrap
+
+    bv = jnp.asarray(unwrap(bboxes), jnp.float32)
+    sv = jnp.asarray(unwrap(scores), jnp.float32)
+    n, m = bv.shape[0], bv.shape[1]
+    c = sv.shape[1]
+    keep_k = keep_top_k if keep_top_k > 0 else c * m
+    keep_k = min(keep_k, c * m)
+
+    @jax.jit
+    def single(boxes, sc):
+        if background_label >= 0:
+            sc = sc.at[background_label].set(-jnp.inf)
+        keep, order = _per_class_nms_masks(
+            boxes, sc, float(nms_threshold), float(score_threshold),
+            int(nms_top_k))
+        s_sorted = jnp.take_along_axis(sc, order, axis=1)     # (C, M)
+        flat = jnp.where(keep, s_sorted, -jnp.inf).ravel()    # (C*M,)
+        vals, idx = jax.lax.top_k(flat, keep_k)
+        cls = idx // m
+        box_i = order[cls, idx % m]
+        rows = jnp.concatenate(
+            [cls[:, None].astype(jnp.float32), vals[:, None],
+             boxes[box_i]], axis=1)                            # (K, 6)
+        valid = jnp.isfinite(vals)
+        count = jnp.sum(valid.astype(jnp.int32))
+        return rows, box_i, count
+
+    rows, idxs, counts = jax.vmap(single)(bv, sv)
+    counts_np = np.asarray(counts)
+    out = np.concatenate([np.asarray(rows[i][:counts_np[i]])
+                          for i in range(n)], axis=0) if n else \
+        np.zeros((0, 6), np.float32)
+    if return_index:
+        index = np.concatenate([np.asarray(idxs[i][:counts_np[i]])
+                                for i in range(n)], axis=0) if n else \
+            np.zeros((0,), np.int32)
+        return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(index)),
+                Tensor(jnp.asarray(counts_np, jnp.int32)))
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(counts_np, jnp.int32)))
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, name=None):
+    """Matrix NMS (matrix_nms_op.cc; SOLOv2): soft decay of each box's
+    score by its IoU with every higher-scored same-class box — no
+    sequential suppression loop, one (K, K) IoU matrix per image, which
+    is the TPU-shaped formulation (pure matmul/reduce, no data-dependent
+    control flow)."""
+    from ..framework.tensor import Tensor, unwrap
+
+    bv = jnp.asarray(unwrap(bboxes), jnp.float32)
+    sv = jnp.asarray(unwrap(scores), jnp.float32)
+    n, m = bv.shape[0], bv.shape[1]
+    c = sv.shape[1]
+    topk = min(nms_top_k if nms_top_k > 0 else c * m, c * m)
+    keep_k = min(keep_top_k if keep_top_k > 0 else topk, topk)
+
+    @jax.jit
+    def single(boxes, sc):
+        if background_label >= 0:
+            sc = sc.at[background_label].set(-jnp.inf)
+        flat = jnp.where(sc > score_threshold, sc, -jnp.inf).ravel()
+        vals, idx = jax.lax.top_k(flat, topk)       # global score order
+        cls = idx // m
+        bx = boxes[idx % m]                          # (K, 4)
+        iou = iou_matrix(bx, bx)                     # (K, K)
+        same = (cls[:, None] == cls[None, :])
+        # suppressors are higher-scored (earlier) same-class boxes only
+        earlier = jnp.tril(jnp.ones((topk, topk), bool), k=-1)  # j < i
+        applicable = same & earlier                  # [i, j]: j suppresses i
+        ious = jnp.where(applicable, iou, 0.0)
+        # compensate IoU (matrix_nms_op.cc): a suppressor j that is itself
+        # overlapped (comp_j = max_k<j iou_jk) suppresses less
+        comp = jnp.max(ious, axis=1)                 # (K,) per box as i
+        comp_j = comp[None, :]                       # broadcast as suppressor
+        if use_gaussian:
+            d = jnp.exp(-(iou ** 2 - comp_j ** 2) / gaussian_sigma)
+        else:
+            d = (1.0 - iou) / jnp.maximum(1.0 - comp_j, 1e-10)
+        decay = jnp.min(jnp.where(applicable, d, 1.0), axis=1)
+        new_scores = jnp.where(jnp.isfinite(vals), vals * decay, -jnp.inf)
+        if post_threshold > 0:
+            new_scores = jnp.where(new_scores > post_threshold, new_scores,
+                                   -jnp.inf)
+        v2, i2 = jax.lax.top_k(new_scores, keep_k)
+        rows = jnp.concatenate(
+            [cls[i2][:, None].astype(jnp.float32), v2[:, None], bx[i2]],
+            axis=1)
+        count = jnp.sum(jnp.isfinite(v2).astype(jnp.int32))
+        return rows, (idx % m)[i2], count
+
+    rows, idxs, counts = jax.vmap(single)(bv, sv)
+    counts_np = np.asarray(counts)
+    out = np.concatenate([np.asarray(rows[i][:counts_np[i]])
+                          for i in range(n)], axis=0) if n else \
+        np.zeros((0, 6), np.float32)
+    if return_index:
+        index = np.concatenate([np.asarray(idxs[i][:counts_np[i]])
+                                for i in range(n)], axis=0) if n else \
+            np.zeros((0,), np.int32)
+        return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(index)),
+                Tensor(jnp.asarray(counts_np, jnp.int32)))
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(counts_np, jnp.int32)))
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Density prior boxes (density_prior_box_op.cc): for each fixed size
+    with density D, a DxD grid of shifted centers inside the step cell,
+    one box per fixed ratio. Returns (boxes (h, w, n, 4), variances) or
+    (h*w*n, 4) with flatten_to_2d."""
+    from ..framework.tensor import Tensor
+
+    h, w = input.shape[2], input.shape[3]
+    imh, imw = image.shape[2], image.shape[3]
+    step_h = steps[1] or imh / h
+    step_w = steps[0] or imw / w
+
+    # static per-cell (dx, dy, bw, bh) table, like prior_box's wh table
+    cells = []
+    for fs, dens in zip(fixed_sizes, densities):
+        fs = float(fs)
+        dens = int(dens)
+        shift_w = step_w / dens
+        shift_h = step_h / dens
+        for ratio in fixed_ratios:
+            bw = fs * np.sqrt(ratio) / 2.0
+            bh = fs / np.sqrt(ratio) / 2.0
+            for di in range(dens):
+                for dj in range(dens):
+                    dx = -step_w / 2.0 + (dj + 0.5) * shift_w
+                    dy = -step_h / 2.0 + (di + 0.5) * shift_h
+                    cells.append((dx, dy, bw, bh))
+    tab = jnp.asarray(cells, jnp.float32)          # (n, 4)
+    n = tab.shape[0]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg = cxg[..., None] + tab[None, None, :, 0]   # (h, w, n)
+    cyg = cyg[..., None] + tab[None, None, :, 1]
+    bw = tab[None, None, :, 2]
+    bh = tab[None, None, :, 3]
+    boxes = jnp.stack([(cxg - bw) / imw, (cyg - bh) / imh,
+                       (cxg + bw) / imw, (cyg + bh) / imh], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                                 (h, w, n, 4))
+    if flatten_to_2d:
+        return (Tensor(boxes.reshape(-1, 4)),
+                Tensor(variances.reshape(-1, 4)))
+    return Tensor(boxes), Tensor(variances)
+
+
+@primitive("ssd_loss", nondiff=("gt_box", "gt_label", "prior_box_arr",
+                                "prior_box_var"))
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box_arr,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, normalize=True, name=None):
+    """SSD multibox loss (reference fluid/layers/detection.py ssd_loss:
+    match + encode + smooth-L1 loc loss + softmax conf loss + hard
+    negative mining). Dense+lengths rewrite of the LoD inputs: gt_box
+    (N, G, 4) xyxy padded, gt_label (N, G) int padded with -1. location
+    (N, P, 4) encoded offsets, confidence (N, P, C), prior_box_arr (P, 4).
+    Returns per-image loss (N, 1); fully static-shape (jit/pjit-safe) —
+    matching is argmax-based per_prediction with the bipartite guarantee
+    folded in via a per-gt best-prior override."""
+    eps = 1e-10
+    var = (jnp.asarray(prior_box_var, jnp.float32)
+           if prior_box_var is not None
+           else jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32))
+    pb = jnp.asarray(prior_box_arr, jnp.float32)          # (P, 4)
+    pcx = (pb[:, 0] + pb[:, 2]) / 2
+    pcy = (pb[:, 1] + pb[:, 3]) / 2
+    pw = jnp.maximum(pb[:, 2] - pb[:, 0], eps)
+    ph = jnp.maximum(pb[:, 3] - pb[:, 1], eps)
+
+    def encode(g):                                        # (G, 4) -> (G, P, 4)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], eps)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], eps)
+        tx = (gcx[:, None] - pcx[None, :]) / pw[None, :] / var[0]
+        ty = (gcy[:, None] - pcy[None, :]) / ph[None, :] / var[1]
+        tw = jnp.log(gw[:, None] / pw[None, :]) / var[2]
+        th = jnp.log(gh[:, None] / ph[None, :]) / var[3]
+        return jnp.stack([tx, ty, tw, th], axis=-1)
+
+    def per_image(loc, conf, g, gl):
+        valid_g = gl >= 0                                  # (G,)
+        iou = iou_matrix(g, pb)                            # (G, P)
+        iou = jnp.where(valid_g[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=0)                  # (P,)
+        best_iou = jnp.max(iou, axis=0)
+        matched = best_iou >= overlap_threshold
+        # bipartite guarantee: each valid gt claims its own best prior.
+        # Invalid (padded) gts are routed out of bounds and dropped — a
+        # duplicate-index scatter mixing valid True and padded False
+        # writes would be nondeterministic.
+        best_prior = jnp.argmax(iou, axis=1)               # (G,)
+        g_idx = jnp.arange(g.shape[0])
+        oob = jnp.asarray(pb.shape[0], best_prior.dtype)
+        claim = jnp.where(valid_g, best_prior, oob)
+        best_gt = best_gt.at[claim].set(g_idx, mode="drop")
+        matched = matched.at[claim].set(True, mode="drop")
+
+        num_pos = jnp.sum(matched.astype(jnp.float32))
+        # conf target: matched -> gt label, else background
+        tgt_label = jnp.where(matched, gt_label_of(gl, best_gt),
+                              background_label)
+        logp = jax.nn.log_softmax(conf, axis=-1)           # (P, C)
+        ce = -jnp.take_along_axis(logp, tgt_label[:, None], axis=1)[:, 0]
+        # hard negative mining: top (neg_pos_ratio * num_pos) negs by loss
+        is_neg = (~matched) & (best_iou < neg_overlap)
+        neg_loss = jnp.where(is_neg, ce, -jnp.inf)
+        rank = jnp.argsort(jnp.argsort(-neg_loss))         # 0 = hardest
+        n_neg = jnp.minimum(neg_pos_ratio * num_pos,
+                            jnp.sum(is_neg.astype(jnp.float32)))
+        sel_neg = is_neg & (rank < n_neg)
+        conf_loss = jnp.sum(jnp.where(matched | sel_neg, ce, 0.0))
+        # loc loss: smooth L1 on matched priors against encoded targets
+        tgt_all = encode(g)                                # (G, P, 4)
+        tgt = jnp.take_along_axis(
+            tgt_all, best_gt[None, :, None], axis=0)[0]    # (P, 4)
+        diff = jnp.abs(loc - tgt)
+        sl1 = jnp.sum(jnp.where(diff < 1.0, 0.5 * diff * diff,
+                                diff - 0.5), axis=-1)
+        loc_loss = jnp.sum(jnp.where(matched, sl1, 0.0))
+        total = conf_loss_weight * conf_loss + loc_loss_weight * loc_loss
+        if normalize:
+            total = total / jnp.maximum(num_pos, 1.0)
+        return total
+
+    def gt_label_of(gl, best_gt):
+        return jnp.maximum(gl, 0)[best_gt]
+
+    loss = jax.vmap(per_image)(location, confidence,
+                               jnp.asarray(gt_box, jnp.float32),
+                               jnp.asarray(gt_label, jnp.int32))
+    return loss[:, None]
