@@ -434,14 +434,18 @@ class _MultiprocessIter:
         if ctx_name == "fork" and loader._needs_spawn is None:
             # fork is only safe while workers never touch jax; a dataset
             # yielding Tensors (jax-backed) forces a clean interpreter.
-            # Probed once per loader (dataset __getitem__/__iter__ may be
-            # expensive), cached for later epochs.
-            try:
-                sample = (next(iter(loader.dataset)) if self.is_iterable
-                          else loader.dataset[0])
-                loader._needs_spawn = _contains_tensor(sample)
-            except Exception:
+            # Probed once per loader and cached. IterableDatasets are NOT
+            # probed (next(iter(ds)) would consume a sample / run __iter__
+            # side effects in the parent): pass mp_context="spawn"
+            # explicitly for Tensor-yielding iterable datasets.
+            if self.is_iterable:
                 loader._needs_spawn = False
+            else:
+                try:
+                    loader._needs_spawn = _contains_tensor(
+                        loader.dataset[0])
+                except Exception:
+                    loader._needs_spawn = False
         if ctx_name == "fork" and loader._needs_spawn:
             ctx_name = "spawn"
         self.ctx = multiprocessing.get_context(ctx_name)

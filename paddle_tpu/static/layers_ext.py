@@ -1,0 +1,1255 @@
+"""Static-graph layer long tail (reference fluid/layers/nn.py breadth).
+
+The reference hand-writes an OpDesc builder + C++ InferShape + CPU/CUDA
+kernels per function; here each static op delegates to the SAME jnp
+implementation the eager API uses (paddle_tpu.nn.functional /
+paddle_tpu.ops), registered as a static kernel. Shape inference is
+jax.eval_shape over that kernel (static/layers.py) and gradients come
+from the traced-vjp append_backward — so one implementation serves
+eager, jit, and static modes (the reference needed three).
+
+Facades keep the reference fluid.layers signatures
+(/root/reference/python/paddle/fluid/layers/nn.py) so static model code
+ports unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .initializer import Constant as _const
+from .kernels import KERNELS, _out, _x, kernel
+from .layers import LayerHelper, _append_simple
+
+
+def _apply_act(out, act):
+    if act:
+        out = _append_simple(act, {"X": [out.name]})
+    return out
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _unwrap_tree(out):
+    if isinstance(out, (tuple, list)):
+        return [_unwrap(o) for o in out]
+    return [_unwrap(out)]
+
+
+def _register_delegate(op_type, fn, in_slots=("X",), out_slots=("Out",),
+                       list_slot=None, needs_rng=False):
+    """Register a static kernel that calls an eager jnp implementation.
+
+    in_slots: input slot order passed positionally (missing slots are
+    skipped). list_slot: this slot's full array LIST is the (single)
+    positional argument. attrs become keyword arguments verbatim.
+    """
+    if op_type in KERNELS:
+        return
+
+    @kernel(op_type)
+    def k(ins, attrs, ctx, _fn=fn):
+        if list_slot is not None:
+            args = [list(ins[list_slot])]
+        else:
+            args = [ins[s][0] for s in in_slots if s in ins and ins[s]]
+        kw = dict(attrs)
+        if needs_rng:
+            kw["_rng_key"] = ctx.rng_key
+        out = _fn(*args, **kw)
+        outs = _unwrap_tree(out)
+        if len(out_slots) == 1:
+            return {out_slots[0]: outs}
+        return {s: [o] for s, o in zip(out_slots, outs)}
+
+
+def _delegate(op_type, fn, n_in=1, in_slots=None, out_slots=("Out",),
+              list_slot=None, needs_rng=False):
+    """One-stop: register kernel + return a facade builder."""
+    slots = in_slots or ("X", "Y", "Z")[:n_in]
+    _register_delegate(op_type, fn, in_slots=slots, out_slots=out_slots,
+                       list_slot=list_slot, needs_rng=needs_rng)
+
+    def build(*xs, **attrs):
+        if list_slot is not None:
+            inputs = {list_slot: [v.name for v in xs[0]]}
+        else:
+            inputs = {s: [v.name] for s, v in zip(slots, xs)}
+        return _append_simple(op_type, inputs, attrs, out_slots=out_slots)
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# activations (reference nn.py elu:9212.., ops.py generated activations)
+# ---------------------------------------------------------------------------
+from ..nn import functional as F  # noqa: E402
+from .. import ops as O  # noqa: E402
+
+
+def _act(op_type, fn, n_in=1):
+    return _delegate(op_type, fn, n_in=n_in)
+
+
+_elu = _act("elu_s", lambda x, alpha=1.0: F.elu(x, alpha))
+_relu6 = _act("relu6_s", lambda x, threshold=6.0: jnp.clip(x, 0, threshold))
+_pow = _act("pow_s", lambda x, factor=1.0: jnp.power(x, factor))
+_stanh = _act("stanh_s",
+              lambda x, scale_a=0.67, scale_b=1.7159:
+              scale_b * jnp.tanh(scale_a * x))
+_hard_sigmoid = _act("hard_sigmoid_s",
+                     lambda x, slope=0.2, offset=0.5:
+                     jnp.clip(slope * x + offset, 0.0, 1.0))
+_swish = _act("swish_s", lambda x, beta=1.0: x * jax.nn.sigmoid(beta * x))
+_brelu = _act("brelu_s",
+              lambda x, t_min=0.0, t_max=24.0: jnp.clip(x, t_min, t_max))
+_soft_relu = _act("soft_relu_s",
+                  lambda x, threshold=40.0:
+                  jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold))))
+_hard_swish = _act("hard_swish_s",
+                   lambda x, threshold=6.0, scale=6.0, offset=3.0:
+                   x * jnp.clip(x + offset, 0, threshold) / scale)
+_mish = _act("mish_s",
+             lambda x, threshold=20.0: x * jnp.tanh(jax.nn.softplus(x)))
+_selu = _act("selu_s",
+             lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+             scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1)))
+_sign = _act("sign_s", jnp.sign)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(x, alpha=alpha)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _relu6(x, threshold=threshold)
+
+
+def pow(x, factor=1.0, name=None):
+    return _pow(x, factor=factor)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(x, scale_a=scale_a, scale_b=scale_b)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _hard_sigmoid(x, slope=slope, offset=offset)
+
+
+def swish(x, beta=1.0, name=None):
+    return _swish(x, beta=beta)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _brelu(x, t_min=t_min, t_max=t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _soft_relu(x, threshold=threshold)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return _hard_swish(x, threshold=threshold, scale=scale, offset=offset)
+
+
+def mish(x, threshold=20.0, name=None):
+    return _mish(x, threshold=threshold)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(x, scale=scale, alpha=alpha)
+
+
+def sign(x, name=None):
+    return _sign(x)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """PReLU with a learnable alpha parameter (nn.py prelu)."""
+    helper = LayerHelper("prelu_s")
+    # alpha shape by mode: all -> 1, channel -> C, element -> x.shape[1:]
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [int(x.shape[1])]
+    else:
+        shape = [int(s) for s in x.shape[1:]]
+    alpha = helper.create_parameter(
+        shape=shape, dtype="float32", attr=param_attr,
+        initializer=_const(0.25))
+    _register_delegate("prelu_s", _prelu_fn, in_slots=("X", "Alpha"))
+    return _append_simple("prelu_s",
+                          {"X": [x.name], "Alpha": [alpha.name]},
+                          {"mode": mode})
+
+
+def _prelu_fn(x, alpha, mode="all"):
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    return jnp.where(x > 0, x, alpha * x)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / logical / reduce long tail
+# ---------------------------------------------------------------------------
+from .layers import _elementwise_binary  # noqa: E402
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary(x, y, "elementwise_pow")
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary(x, y, "elementwise_mod")
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary(x, y, "elementwise_floordiv")
+
+
+_logical_or = _delegate("logical_or_s", jnp.logical_or, n_in=2)
+_logical_xor = _delegate("logical_xor_s", jnp.logical_xor, n_in=2)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical_or(x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical_xor(x, y)
+
+
+def _reduce(op_type, jfn):
+    build = _delegate(op_type, lambda x, dim=None, keep_dim=False:
+                      jfn(x, axis=None if dim is None else tuple(dim),
+                          keepdims=keep_dim))
+
+    def f(input, dim=None, keep_dim=False, name=None):
+        if dim is not None and not isinstance(dim, (list, tuple)):
+            dim = [dim]
+        return build(input, dim=dim, keep_dim=keep_dim)
+
+    return f
+
+
+reduce_prod = _reduce("reduce_prod_s", jnp.prod)
+reduce_all = _reduce("reduce_all_s", jnp.all)
+reduce_any = _reduce("reduce_any_s", jnp.any)
+
+_where_idx = _delegate("where_index_s",
+                       lambda c: jnp.stack(
+                           jnp.nonzero(c, size=int(np.prod(c.shape)),
+                                       fill_value=-1), axis=1))
+
+
+def where(condition, name=None):
+    """Indices of true elements, padded with -1 rows to the static size
+    (nonzero is dynamic in the reference; TPU needs fixed shapes)."""
+    return _where_idx(condition)
+
+
+import functools as _functools  # noqa: E402
+
+# NB: builtins `sum`/`pow` are shadowed by the facades below — the kernel
+# must not reference them
+_sum_n = _delegate("sum_n_s",
+                   lambda xs: _functools.reduce(jnp.add, xs),
+                   list_slot="X")
+
+
+def sum(x, name=None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return _sum_n(xs)
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing / manipulation
+# ---------------------------------------------------------------------------
+_shape = _delegate("shape_s",
+                   lambda x: jnp.asarray(x.shape, jnp.int32))
+_rank = _delegate("rank_s", lambda x: jnp.asarray(x.ndim, jnp.int32))
+_size = _delegate("size_s",
+                  lambda x: jnp.asarray(int(np.prod(x.shape)), jnp.int64))
+
+
+def shape(input):
+    return _shape(input)
+
+
+def rank(input):
+    return _rank(input)
+
+
+def size(input):
+    return _size(input)
+
+
+_unstack = None
+
+
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else int(x.shape[axis])
+    op_type = f"unstack_{n}_s"
+    _register_delegate(
+        op_type,
+        lambda a, axis=0, num=1: [jnp.squeeze(s, axis)
+                                  for s in jnp.split(a, num, axis)],
+        out_slots=tuple(f"Y{i}" for i in range(n)))
+    outs = _append_simple(op_type, {"X": [x.name]},
+                          {"axis": axis, "num": n},
+                          out_slots=tuple(f"Y{i}" for i in range(n)))
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+unbind = unstack
+
+
+_expand = _delegate("expand_s",
+                    lambda x, expand_times=(): jnp.tile(x, expand_times))
+
+
+def expand(x, expand_times, name=None):
+    return _expand(x, expand_times=tuple(int(t) for t in expand_times))
+
+
+def expand_as(x, target_tensor, name=None):
+    times = tuple(int(t) // int(s) for t, s in
+                  zip(target_tensor.shape, x.shape))
+    return _expand(x, expand_times=times)
+
+
+_strided_slice = _delegate(
+    "strided_slice_s",
+    lambda x, axes=(), starts=(), ends=(), strides=():
+    x[tuple(np.s_[s:e:st] if i in axes else np.s_[:]
+            for i, (s, e, st) in enumerate(
+                _expand_slice_args(x.ndim, axes, starts, ends, strides)))])
+
+
+def _expand_slice_args(ndim, axes, starts, ends, strides):
+    full = [(0, None, 1)] * ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        full[ax] = (s, e, st)
+    return full
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    return _strided_slice(input, axes=tuple(axes), starts=tuple(starts),
+                          ends=tuple(ends), strides=tuple(strides))
+
+
+_gather_nd = _delegate("gather_nd_s",
+                       lambda x, index: O.gather_nd(x, index),
+                       in_slots=("X", "Index"))
+
+
+def gather_nd(input, index, name=None):
+    return _gather_nd(input, index)
+
+
+_scatter = _delegate("scatter_s",
+                     lambda x, ids, updates, overwrite=True:
+                     O.scatter(x, ids, updates, overwrite=overwrite),
+                     in_slots=("X", "Ids", "Updates"))
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    return _scatter(input, index, updates, overwrite=overwrite)
+
+
+_scatter_nd_add = _delegate("scatter_nd_add_s",
+                            lambda x, index, updates:
+                            O.scatter_nd_add(x, index, updates),
+                            in_slots=("X", "Index", "Updates"))
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _scatter_nd_add(ref, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    helper = LayerHelper("scatter_nd_s")
+    _register_delegate("scatter_nd_s",
+                       lambda index, updates, shape=():
+                       O.scatter_nd(index, updates, shape),
+                       in_slots=("Index", "Updates"))
+    return _append_simple("scatter_nd_s",
+                          {"Index": [index.name], "Updates": [updates.name]},
+                          {"shape": tuple(int(s) for s in shape)})
+
+
+_gather_tree = _delegate("gather_tree_s",
+                         lambda ids, parents: O.gather_tree(ids, parents),
+                         in_slots=("Ids", "Parents"))
+
+
+def gather_tree(ids, parents):
+    return _gather_tree(ids, parents)
+
+
+_shard_index = _delegate(
+    "shard_index_s",
+    lambda x, index_num=0, nshards=1, shard_id=0, ignore_value=-1:
+    O.shard_index(x, index_num, nshards, shard_id, ignore_value))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _shard_index(input, index_num=index_num, nshards=nshards,
+                        shard_id=shard_id, ignore_value=ignore_value)
+
+
+# ---------------------------------------------------------------------------
+# padding / cropping
+# ---------------------------------------------------------------------------
+_pad = _delegate("pad_s",
+                 lambda x, paddings=(), pad_value=0.0:
+                 jnp.pad(x, [(paddings[2 * i], paddings[2 * i + 1])
+                             for i in range(x.ndim)],
+                         constant_values=pad_value))
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _pad(x, paddings=tuple(int(p) for p in paddings),
+                pad_value=float(pad_value))
+
+
+_pad2d = _delegate(
+    "pad2d_s",
+    # fluid pad2d order is (top, bottom, left, right); F.pad wants
+    # (left, right, top, bottom)
+    lambda x, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+    data_format="NCHW": F.pad(
+        x, [paddings[2], paddings[3], paddings[0], paddings[1]],
+        mode={"constant": "constant", "reflect": "reflect",
+              "edge": "replicate"}[mode],
+        value=pad_value, data_format=data_format))
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _pad2d(input, paddings=tuple(int(p) for p in paddings),
+                  mode=mode, pad_value=float(pad_value),
+                  data_format=data_format)
+
+
+_pad_constant_like = _delegate(
+    "pad_constant_like_s",
+    lambda x, y, pad_value=0.0: O.pad_constant_like(x, y, pad_value),
+    in_slots=("X", "Y"))
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _pad_constant_like(x, y, pad_value=float(pad_value))
+
+
+_crop_tensor = _delegate(
+    "crop_tensor_s",
+    lambda x, shape=(), offsets=():
+    jax.lax.dynamic_slice(x, offsets, shape))
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    if offsets is None:
+        offsets = [0] * len(x.shape)
+    return _crop_tensor(x, shape=tuple(int(s) for s in shape),
+                        offsets=tuple(int(o) for o in offsets))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return crop_tensor(x, shape=shape, offsets=offsets, name=name)
+
+
+# ---------------------------------------------------------------------------
+# normalization / feature ops
+# ---------------------------------------------------------------------------
+_l2_normalize = _delegate(
+    "l2_normalize_s",
+    lambda x, axis=-1, epsilon=1e-12:
+    x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=axis, keepdims=True),
+                             epsilon)))
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _l2_normalize(x, axis=axis, epsilon=epsilon)
+
+
+_label_smooth = _delegate(
+    "label_smooth_s",
+    lambda label, prior_dist=None, epsilon=0.1:
+    F.label_smooth(label, prior_dist, epsilon),
+    in_slots=("X", "PriorDist"))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    if prior_dist is not None:
+        return _label_smooth(label, prior_dist, epsilon=float(epsilon))
+    return _label_smooth(label, epsilon=float(epsilon))
+
+
+_clip_by_norm = _delegate(
+    "clip_by_norm_s",
+    lambda x, max_norm=1.0:
+    x * jnp.minimum(1.0, max_norm /
+                    jnp.maximum(jnp.sqrt(jnp.sum(x * x)), 1e-12)))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _clip_by_norm(x, max_norm=float(max_norm))
+
+
+_maxout = _delegate("maxout_s",
+                    lambda x, groups=1, axis=1: F.maxout(x, groups, axis))
+
+
+def maxout(x, groups, name=None, axis=1):
+    return _maxout(x, groups=groups, axis=axis)
+
+
+_space_to_depth = _delegate(
+    "space_to_depth_s",
+    lambda x, blocksize=2: O.space_to_depth(x, blocksize))
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _space_to_depth(x, blocksize=blocksize)
+
+
+_pixel_shuffle = _delegate(
+    "pixel_shuffle_s",
+    lambda x, upscale_factor=1: F.pixel_shuffle(x, upscale_factor))
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _pixel_shuffle(x, upscale_factor=upscale_factor)
+
+
+_shuffle_channel = _delegate(
+    "shuffle_channel_s",
+    lambda x, group=1: O.shuffle_channel(x, group))
+
+
+def shuffle_channel(x, group, name=None):
+    return _shuffle_channel(x, group=group)
+
+
+_temporal_shift = _delegate(
+    "temporal_shift_s",
+    lambda x, seg_num=1, shift_ratio=0.25:
+    F.temporal_shift(x, seg_num, shift_ratio))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _temporal_shift(x, seg_num=seg_num, shift_ratio=shift_ratio)
+
+
+_affine_channel = _delegate(
+    "affine_channel_s",
+    lambda x, scale, bias, data_layout="NCHW":
+    F.affine_channel(x, scale, bias, data_layout),
+    in_slots=("X", "Scale", "Bias"))
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    out = _affine_channel(x, scale, bias, data_layout=data_layout)
+    return _apply_act(out, act)
+
+
+_row_conv = _delegate("row_conv_s",
+                      lambda x, w: F.row_conv(x, w),
+                      in_slots=("X", "Filter"))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    helper = LayerHelper("row_conv_s")
+    w = helper.create_parameter(
+        shape=[future_context_size + 1, int(input.shape[-1])],
+        dtype="float32", attr=param_attr)
+    out = _append_simple("row_conv_s",
+                         {"X": [input.name], "Filter": [w.name]})
+    return _apply_act(out, act)
+
+
+def multiplex(inputs, index, name=None):
+    op_type = f"multiplex_{len(inputs)}_s"
+    _register_delegate(
+        op_type,
+        lambda index, *xs: O.multiplex(list(xs), index),
+        in_slots=("Ids",) + tuple(f"X{i}" for i in range(len(inputs))))
+    ins = {"Ids": [index.name]}
+    for i, v in enumerate(inputs):
+        ins[f"X{i}"] = [v.name]
+    return _append_simple(op_type, ins, {})
+
+
+# ---------------------------------------------------------------------------
+# losses / misc math
+# ---------------------------------------------------------------------------
+_smooth_l1 = _delegate(
+    "smooth_l1_s",
+    lambda x, y, sigma=1.0: _smooth_l1_fn(x, y, sigma),
+    in_slots=("X", "Y"))
+
+
+def _smooth_l1_fn(x, y, sigma):
+    s2 = sigma * sigma
+    diff = jnp.abs(x - y)
+    loss = jnp.where(diff < 1.0 / s2, 0.5 * s2 * diff * diff,
+                     diff - 0.5 / s2)
+    return jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    return _smooth_l1(x, y, sigma=float(sigma))
+
+
+_dice_loss = _delegate("dice_loss_s",
+                       lambda input, label, epsilon=1e-5:
+                       F.dice_loss(input, label, epsilon),
+                       in_slots=("X", "Label"))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return _dice_loss(input, label, epsilon=epsilon)
+
+
+_log_loss = _delegate("log_loss_s",
+                      lambda input, label, epsilon=1e-4:
+                      F.log_loss(input, label, epsilon),
+                      in_slots=("Predicted", "Labels"))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss(input, label, epsilon=epsilon)
+
+
+_add_position_encoding = _delegate(
+    "add_position_encoding_s",
+    lambda x, alpha=1.0, beta=1.0: O.add_position_encoding(x, alpha, beta))
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    return _add_position_encoding(input, alpha=float(alpha),
+                                  beta=float(beta))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """x^T W y bilinear form with learnable W (nn.py
+    bilinear_tensor_product)."""
+    helper = LayerHelper("bilinear_tp_s")
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    w = helper.create_parameter(shape=[size, dx, dy], dtype="float32",
+                                attr=param_attr)
+    b = helper.create_parameter(shape=[size], dtype="float32", attr=bias_attr,
+                                initializer=_const(0.0))
+    _register_delegate(
+        "bilinear_tp_s",
+        lambda x, y, w, b: jnp.einsum("bi,kij,bj->bk", x, w, y) + b,
+        in_slots=("X", "Y", "Weight", "Bias"))
+    out = _append_simple("bilinear_tp_s",
+                         {"X": [x.name], "Y": [y.name],
+                          "Weight": [w.name], "Bias": [b.name]})
+    return _apply_act(out, act)
+
+
+_fsp = _delegate("fsp_s", lambda x, y: F.fsp_matrix(x, y),
+                 in_slots=("X", "Y"))
+
+
+def fsp_matrix(x, y):
+    return _fsp(x, y)
+
+
+def _mean_iou_fn(pred, label, num_classes=2):
+    # traceable mean-IoU (the eager vision.ops.mean_iou materializes on
+    # host); confusion counts via scatter-add
+    pred = pred.ravel()
+    label = label.ravel()
+    hit = (pred == label).astype(jnp.float32)
+    inter = jnp.zeros((num_classes,)).at[label].add(hit, mode="drop")
+    pc = jnp.zeros((num_classes,)).at[pred].add(1.0, mode="drop")
+    lc = jnp.zeros((num_classes,)).at[label].add(1.0, mode="drop")
+    union = pc + lc - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                      1.0)
+    return (mean.astype(jnp.float32),
+            (union - inter).astype(jnp.int32), inter.astype(jnp.int32))
+
+
+_mean_iou = _delegate(
+    "mean_iou_s", _mean_iou_fn,
+    in_slots=("Predictions", "Labels"),
+    out_slots=("OutMeanIou", "OutWrong", "OutCorrect"))
+
+
+def mean_iou(input, label, num_classes, name=None):
+    return _mean_iou(input, label, num_classes=num_classes)
+
+
+_lrn = _delegate(
+    "lrn_s",
+    lambda x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW":
+    F.local_response_norm(x, n, alpha=alpha, beta=beta, k=k,
+                          data_format=data_format))
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return _lrn(input, n=n, k=float(k), alpha=float(alpha),
+                beta=float(beta), data_format=data_format)
+
+
+_grid_sampler = _delegate("grid_sampler_s",
+                          lambda x, grid: F.grid_sample(x, grid),
+                          in_slots=("X", "Grid"))
+
+
+def grid_sampler(x, grid, name=None):
+    return _grid_sampler(x, grid)
+
+
+_affine_grid = _delegate(
+    "affine_grid_s",
+    lambda theta, out_shape=(): F.affine_grid(theta, list(out_shape)),
+    in_slots=("Theta",))
+
+
+def affine_grid(theta, out_shape, name=None):
+    return _affine_grid(theta, out_shape=tuple(int(s) for s in out_shape))
+
+
+_unfold = _delegate(
+    "unfold_s",
+    lambda x, kernel_sizes=(3, 3), strides=1, paddings=0, dilations=1:
+    O.unfold(x, list(kernel_sizes), strides, paddings, dilations))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    if not isinstance(kernel_sizes, (list, tuple)):
+        kernel_sizes = [kernel_sizes, kernel_sizes]
+    return _unfold(x, kernel_sizes=tuple(kernel_sizes), strides=strides,
+                   paddings=paddings, dilations=dilations)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """Sliding-window patches flattened to a sequence (im2sequence_op.cc):
+    unfold + transpose so each output row is one patch."""
+    if not isinstance(filter_size, (list, tuple)):
+        filter_size = [filter_size, filter_size]
+    cols = unfold(input, list(filter_size), stride, padding)
+    from .layers import reshape, transpose
+
+    t = transpose(cols, [0, 2, 1])   # (b, L, C*kh*kw)
+    return reshape(t, [-1, int(t.shape[-1])])
+
+
+# ---------------------------------------------------------------------------
+# resize family (interpolate_op.cc)
+# ---------------------------------------------------------------------------
+_interp = _delegate(
+    "interpolate_s",
+    lambda x, size=None, scale=None, mode="nearest", align_corners=False,
+    align_mode=0, data_format="NCHW":
+    F.interpolate(x, size=list(size) if size else None, scale_factor=scale,
+                  mode=mode, align_corners=align_corners,
+                  align_mode=align_mode, data_format=data_format))
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    mode = resample.lower()
+    return _interp(input, size=tuple(int(s) for s in out_shape)
+                   if out_shape else None,
+                   scale=scale, mode=mode, align_corners=align_corners,
+                   align_mode=align_mode, data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners, 0, data_format)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format="NCW"):
+    return image_resize(input, out_shape, scale, name, "LINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return image_resize(input, out_shape, scale, name, "TRILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    out = (int(h * out_short_len / short), int(w * out_short_len / short))
+    return image_resize(input, out_shape=out, resample=resample)
+
+
+# ---------------------------------------------------------------------------
+# norm layers with parameters
+# ---------------------------------------------------------------------------
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm_s")
+    c = int(input.shape[1])
+    scale = helper.create_parameter(shape=[c], dtype="float32",
+                                    attr=param_attr,
+                                    initializer=_const(1.0))
+    bias = helper.create_parameter(shape=[c], dtype="float32", attr=bias_attr,
+                                initializer=_const(0.0))
+    _register_delegate(
+        "instance_norm_s",
+        lambda x, s, b, epsilon=1e-5: F.instance_norm(
+            x, None, None, s, b, eps=epsilon),
+        in_slots=("X", "Scale", "Bias"))
+    return _append_simple("instance_norm_s",
+                          {"X": [input.name], "Scale": [scale.name],
+                           "Bias": [bias.name]},
+                          {"epsilon": float(epsilon)})
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm_s")
+    c = int(input.shape[1])
+    scale = helper.create_parameter(shape=[c], dtype="float32",
+                                    attr=param_attr,
+                                    initializer=_const(1.0))
+    bias = helper.create_parameter(shape=[c], dtype="float32", attr=bias_attr,
+                                initializer=_const(0.0))
+    _register_delegate(
+        "group_norm_s",
+        lambda x, s, b, groups=1, epsilon=1e-5, data_layout="NCHW":
+        F.group_norm(x, groups, s, b, epsilon, data_layout),
+        in_slots=("X", "Scale", "Bias"))
+    out = _append_simple("group_norm_s",
+                         {"X": [input.name], "Scale": [scale.name],
+                          "Bias": [bias.name]},
+                         {"groups": groups, "epsilon": float(epsilon),
+                          "data_layout": data_layout})
+    return _apply_act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization via power iteration (spectral_norm_op.cc).
+    The u/v vectors are non-trainable state approximated per call (the
+    reference updates them in-place; one-shot iteration from a fixed
+    start is deterministic under jit)."""
+    _register_delegate(
+        "spectral_norm_s",
+        lambda w, dim=0, power_iters=1, eps=1e-12:
+        _spectral_norm_fn(w, dim, power_iters, eps))
+    return _append_simple("spectral_norm_s", {"X": [weight.name]},
+                          {"dim": dim, "power_iters": power_iters,
+                           "eps": float(eps)})
+
+
+def _spectral_norm_fn(w, dim, power_iters, eps):
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u = jnp.ones((mat.shape[0],), w.dtype) / np.sqrt(mat.shape[0])
+    v = None
+    for _ in range(max(1, power_iters)):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = mat @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ (mat @ v)
+    return w / jnp.maximum(sigma, eps)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None,
+              **kwargs):
+    """Per-feature normalization from accumulated batch statistics
+    (data_norm_op.cc). Statistics are learnable accumulators."""
+    helper = LayerHelper("data_norm_s")
+    c = int(input.shape[-1])
+    size = helper.create_parameter(shape=[c], dtype="float32",
+                                   name=None, initializer=_const(1.0))
+    ssum = helper.create_parameter(shape=[c], dtype="float32",
+                                   initializer=_const(0.0))
+    sqsum = helper.create_parameter(shape=[c], dtype="float32",
+                                    initializer=_const(1.0))
+    _register_delegate(
+        "data_norm_s",
+        lambda x, n, s, sq, epsilon=1e-5: _data_norm_fn(x, n, s, sq,
+                                                        epsilon),
+        in_slots=("X", "BatchSize", "BatchSum", "BatchSquareSum"))
+    out = _append_simple(
+        "data_norm_s",
+        {"X": [input.name], "BatchSize": [size.name],
+         "BatchSum": [ssum.name], "BatchSquareSum": [sqsum.name]},
+        {"epsilon": float(epsilon)})
+    return _apply_act(out, act)
+
+
+def _data_norm_fn(x, n, s, sq, epsilon):
+    mean = s / jnp.maximum(n, 1e-4)
+    var = sq / jnp.maximum(n, 1e-4) - mean * mean
+    return (x - mean) / jnp.sqrt(jnp.maximum(var, epsilon))
+
+
+# ---------------------------------------------------------------------------
+# conv/pool long tail
+# ---------------------------------------------------------------------------
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d_transpose_s")
+    cin = int(input.shape[1])
+    if filter_size is None:
+        raise ValueError("filter_size required (output_size-only inference "
+                         "not supported)")
+    k = (filter_size if isinstance(filter_size, (list, tuple))
+         else [filter_size, filter_size])
+    # output_size fixes the ambiguous stride>1 transpose shape via
+    # output_padding (reference nn.py conv2d_transpose output_size attr)
+    output_padding = 0
+    if output_size is not None:
+        os_ = (output_size if isinstance(output_size, (list, tuple))
+               else [output_size, output_size])
+        st = (stride if isinstance(stride, (list, tuple))
+              else [stride, stride])
+        pd = (padding if isinstance(padding, (list, tuple))
+              else [padding, padding])
+        dl = (dilation if isinstance(dilation, (list, tuple))
+              else [dilation, dilation])
+        output_padding = tuple(
+            int(os_[i]) - ((int(input.shape[2 + i]) - 1) * st[i]
+                           - 2 * pd[i] + dl[i] * (int(k[i]) - 1) + 1)
+            for i in range(2))
+        if any(p < 0 for p in output_padding):
+            raise ValueError(
+                f"output_size {os_} unreachable: needs output_padding "
+                f"{output_padding}")
+    w = helper.create_parameter(
+        shape=[cin, num_filters // groups, int(k[0]), int(k[1])],
+        dtype="float32", attr=param_attr)
+    ins = {"Input": [input.name], "Filter": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(shape=[num_filters], dtype="float32", attr=bias_attr,
+                                initializer=_const(0.0))
+        ins["Bias"] = [b.name]
+    _register_delegate(
+        "conv2d_transpose_s",
+        lambda x, w, b=None, stride=1, padding=0, dilation=1, groups=1,
+        output_padding=0:
+        F.conv2d_transpose(x, w, b, stride=stride, padding=padding,
+                           output_padding=output_padding,
+                           dilation=dilation, groups=groups),
+        in_slots=("Input", "Filter", "Bias"))
+    out = _append_simple("conv2d_transpose_s", ins,
+                         {"stride": stride, "padding": padding,
+                          "dilation": dilation, "groups": groups,
+                          "output_padding": output_padding})
+    return _apply_act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    helper = LayerHelper("conv3d_s")
+    cin = int(input.shape[1])
+    k = (filter_size if isinstance(filter_size, (list, tuple))
+         else [filter_size] * 3)
+    w = helper.create_parameter(
+        shape=[num_filters, cin // groups] + [int(s) for s in k],
+        dtype="float32", attr=param_attr)
+    ins = {"Input": [input.name], "Filter": [w.name]}
+    if bias_attr is not False:
+        b = helper.create_parameter(shape=[num_filters], dtype="float32", attr=bias_attr,
+                                initializer=_const(0.0))
+        ins["Bias"] = [b.name]
+    _register_delegate(
+        "conv3d_s",
+        lambda x, w, b=None, stride=1, padding=0, dilation=1, groups=1:
+        F.conv3d(x, w, b, stride=stride, padding=padding, dilation=dilation,
+                 groups=groups),
+        in_slots=("Input", "Filter", "Bias"))
+    out = _append_simple("conv3d_s", ins,
+                         {"stride": stride, "padding": padding,
+                          "dilation": dilation, "groups": groups})
+    return _apply_act(out, act)
+
+
+_pool3d = _delegate(
+    "pool3d_s",
+    lambda x, pool_size=2, pool_type="max", pool_stride=None,
+    pool_padding=0: (F.max_pool3d(x, pool_size, pool_stride, pool_padding)
+                     if pool_type == "max"
+                     else F.avg_pool3d(x, pool_size, pool_stride,
+                                       pool_padding)))
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=None,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCDHW"):
+    if global_pooling:
+        pool_size = [int(s) for s in input.shape[2:]]
+    return _pool3d(input, pool_size=pool_size, pool_type=pool_type,
+                   pool_stride=pool_stride or pool_size,
+                   pool_padding=pool_padding)
+
+
+def _adaptive_pool_fn(nd):
+    maxp = F.adaptive_max_pool2d if nd == 2 else F.adaptive_max_pool3d
+    avgp = F.adaptive_avg_pool2d if nd == 2 else F.adaptive_avg_pool3d
+
+    def fn(x, pool_size=1, pool_type="max", require_index=False):
+        if pool_type == "max":
+            out = maxp(x, pool_size, return_mask=require_index)
+            return out if require_index else out
+        return avgp(x, pool_size)
+
+    return fn
+
+
+_adaptive_pool2d = _delegate("adaptive_pool2d_s", _adaptive_pool_fn(2))
+_adaptive_pool2d_idx = _delegate("adaptive_pool2d_idx_s",
+                                 _adaptive_pool_fn(2),
+                                 out_slots=("Out", "Mask"))
+_adaptive_pool3d = _delegate("adaptive_pool3d_s", _adaptive_pool_fn(3))
+_adaptive_pool3d_idx = _delegate("adaptive_pool3d_idx_s",
+                                 _adaptive_pool_fn(3),
+                                 out_slots=("Out", "Mask"))
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    ps = (tuple(pool_size) if isinstance(pool_size, (list, tuple))
+          else pool_size)
+    build = _adaptive_pool2d_idx if require_index else _adaptive_pool2d
+    return build(input, pool_size=ps, pool_type=pool_type,
+                 require_index=require_index)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    ps = (tuple(pool_size) if isinstance(pool_size, (list, tuple))
+          else pool_size)
+    build = _adaptive_pool3d_idx if require_index else _adaptive_pool3d
+    return build(input, pool_size=ps, pool_type=pool_type,
+                 require_index=require_index)
+
+
+_roi_align_s = _delegate(
+    "roi_align_s2",
+    lambda x, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+    sampling_ratio=-1:
+    __import__("paddle_tpu.vision.ops", fromlist=["roi_align"]).roi_align(
+        x, rois, (pooled_height, pooled_width), spatial_scale,
+        sampling_ratio),
+    in_slots=("X", "ROIs"))
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_num=None):
+    return _roi_align_s(input, rois, pooled_height=pooled_height,
+                        pooled_width=pooled_width,
+                        spatial_scale=float(spatial_scale),
+                        sampling_ratio=sampling_ratio)
+
+
+_roi_pool_s = _delegate(
+    "roi_pool_s2",
+    lambda x, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0:
+    __import__("paddle_tpu.vision.ops", fromlist=["roi_pool"]).roi_pool(
+        x, rois, (pooled_height, pooled_width), spatial_scale),
+    in_slots=("X", "ROIs"))
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    return _roi_pool_s(input, rois, pooled_height=pooled_height,
+                       pooled_width=pooled_width,
+                       spatial_scale=float(spatial_scale))
+
+
+# ---------------------------------------------------------------------------
+# random ops
+# ---------------------------------------------------------------------------
+def _rng_delegate(op_type, fn):
+    """Delegate whose kernel consumes the executor's per-run rng key."""
+    if op_type not in KERNELS:
+        @kernel(op_type)
+        def k(ins, attrs, ctx, _fn=fn):
+            arrs = [ins[s][0] for s in ("X",) if s in ins and ins[s]]
+            return _out(_fn(ctx.rng_key, *arrs, **attrs))
+
+    def build(*xs, **attrs):
+        ins = {"X": [xs[0].name]} if xs else {}
+        return _append_simple(op_type, ins, attrs)
+
+    return build
+
+
+_uniform_random = _rng_delegate(
+    "uniform_random_s2",
+    lambda key, shape=(), min=-1.0, max=1.0:
+    jax.random.uniform(key, shape, jnp.float32, min, max))
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    return _uniform_random(shape=tuple(int(s) for s in shape),
+                           min=float(min), max=float(max))
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    build = _rng_delegate(
+        "gaussian_random_s2",
+        lambda key, shape=(), mean=0.0, std=1.0:
+        mean + std * jax.random.normal(key, shape, jnp.float32))
+    return build(shape=tuple(int(s) for s in shape), mean=float(mean),
+                 std=float(std))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    build = _rng_delegate(
+        "sampling_id_s",
+        lambda key, probs: jax.random.categorical(
+            key, jnp.log(jnp.maximum(probs, 1e-20)), axis=-1))
+    return build(x)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return uniform_random(shape, dtype, min, max, seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return gaussian_random(shape, mean, std, seed, dtype)
+
+
+def random_crop(x, shape, seed=None):
+    build = _rng_delegate(
+        "random_crop_s",
+        lambda key, x, shape=(): _random_crop_fn(key, x, shape))
+    return build(x, shape=tuple(int(s) for s in shape))
+
+
+def _random_crop_fn(key, x, shape):
+    # crop the trailing len(shape) dims at a random offset (batch kept)
+    lead = x.ndim - len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s + 1
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, limit))
+    full_start = [jnp.asarray(0)] * lead + starts
+    full_size = list(x.shape[:lead]) + list(shape)
+    return jax.lax.dynamic_slice(x, full_start, full_size)
+
+
+# ---------------------------------------------------------------------------
+# CRF / sequence decode (delegating to the eager nn.crf implementations)
+# ---------------------------------------------------------------------------
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF negative log-likelihood (linear_chain_crf_op.cc).
+    Static wrapper over nn.crf.linear_chain_crf; transition is the
+    learnable parameter (size (num_tags + 2, num_tags))."""
+    from ..nn import crf as crf_mod
+
+    helper = LayerHelper("linear_chain_crf_s")
+    num_tags = int(input.shape[-1])
+    trans = helper.create_parameter(shape=[num_tags + 2, num_tags],
+                                    dtype="float32", attr=param_attr)
+    _register_delegate(
+        "linear_chain_crf_s",
+        lambda emission, transition, label, length=None:
+        crf_mod.linear_chain_crf(
+            emission, transition, label,
+            length if length is not None else
+            jnp.full((emission.shape[0],), emission.shape[1], jnp.int32)),
+        in_slots=("Emission", "Transition", "Label", "Length"))
+    ins = {"Emission": [input.name], "Transition": [trans.name],
+           "Label": [label.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    return _append_simple("linear_chain_crf_s", ins, {})
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    from ..nn import crf as crf_mod
+
+    _register_delegate(
+        "crf_decoding_s",
+        lambda emission, transition, length=None:
+        crf_mod.crf_decoding(
+            emission, transition,
+            length if length is not None else
+            jnp.full((emission.shape[0],), emission.shape[1], jnp.int32)),
+        in_slots=("Emission", "Transition", "Length"))
+    # param_attr here is the trained transition parameter Variable
+    ins = {"Emission": [input.name], "Transition": [param_attr.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    return _append_simple("crf_decoding_s", ins, {})
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    _register_delegate(
+        "ctc_greedy_decoder_s",
+        lambda probs, blank=0, padding_value=0:
+        O.ctc_greedy_decoder(probs, blank, padding_value=padding_value),
+        in_slots=("Input",))
+    return _append_simple("ctc_greedy_decoder_s", {"Input": [input.name]},
+                          {"blank": blank, "padding_value": padding_value})
+
+
+# ---------------------------------------------------------------------------
+# export: public functions defined here join fluid.layers / static.nn
+# ---------------------------------------------------------------------------
+__all__ = [n for n, v in list(globals().items())
+           if not n.startswith("_") and callable(v)
+           and getattr(v, "__module__", "") == __name__]
+
+
+def _export_into_layers():
+    from . import layers as _layers
+
+    for _n in __all__:
+        if not hasattr(_layers, _n):
+            setattr(_layers, _n, globals()[_n])
+
+
+_export_into_layers()

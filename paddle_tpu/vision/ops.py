@@ -497,17 +497,22 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
     sv = jnp.asarray(unwrap(scores), jnp.float32)
     n, m = bv.shape[0], bv.shape[1]
     c = sv.shape[1]
-    topk = min(nms_top_k if nms_top_k > 0 else c * m, c * m)
+    # nms_top_k caps candidates PER CLASS (matrix_nms_op.cc NMSMatrix);
+    # a global cap would let one dominant class evict every other class
+    per_class = min(nms_top_k if nms_top_k > 0 else m, m)
+    topk = c * per_class
     keep_k = min(keep_top_k if keep_top_k > 0 else topk, topk)
 
     @jax.jit
     def single(boxes, sc):
         if background_label >= 0:
             sc = sc.at[background_label].set(-jnp.inf)
-        flat = jnp.where(sc > score_threshold, sc, -jnp.inf).ravel()
-        vals, idx = jax.lax.top_k(flat, topk)       # global score order
-        cls = idx // m
-        bx = boxes[idx % m]                          # (K, 4)
+        masked = jnp.where(sc > score_threshold, sc, -jnp.inf)  # (C, M)
+        vals_c, idx_c = jax.lax.top_k(masked, per_class)        # per class
+        vals = vals_c.ravel()                        # class-major order:
+        cls = jnp.repeat(jnp.arange(c), per_class)   # within-class sorted
+        bx = boxes[idx_c.ravel()]                    # (K, 4)
+        idx = cls * m + idx_c.ravel()                # for return_index
         iou = iou_matrix(bx, bx)                     # (K, K)
         same = (cls[:, None] == cls[None, :])
         # suppressors are higher-scored (earlier) same-class boxes only

@@ -1,0 +1,288 @@
+"""fluid.layers long-tail static ops (static/layers_ext.py) executed
+through Program/Executor — values vs numpy/eager ground truth, parameter
+layers trained via append_backward to prove the traced-vjp path works
+through delegate kernels (reference fluid/tests/unittests/test_layers.py
+breadth pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+
+
+def _run(build, feeds=None, n_out=1):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+    exe = static.Executor()
+    exe.run(startup)
+    res = exe.run(main, feed=feeds or {}, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+def test_activation_family_values():
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+
+    def build():
+        v = static.data("x", [13])
+        return [static.elu(v, 1.5), static.swish(v), static.mish(v),
+                static.selu(v), static.hard_sigmoid(v), static.relu6(v),
+                static.brelu(v, 1.0, 2.0), static.stanh(v),
+                static.hard_swish(v), static.soft_relu(v),
+                static.sign(v), static.pow(v, 2.0)]
+
+    outs = _run(build, {"x": x})
+    np.testing.assert_allclose(
+        outs[0], np.where(x > 0, x, 1.5 * (np.exp(x) - 1)), atol=1e-5)
+    np.testing.assert_allclose(outs[1], x / (1 + np.exp(-x)), atol=1e-5)
+    np.testing.assert_allclose(outs[5], np.clip(x, 0, 6), atol=1e-6)
+    np.testing.assert_allclose(outs[6], np.clip(x, 1, 2), atol=1e-6)
+    np.testing.assert_allclose(outs[10], np.sign(x), atol=0)
+    np.testing.assert_allclose(outs[11], x * x, atol=1e-4)
+
+
+def test_elementwise_logical_reduce():
+    a = np.array([[2.0, 3.0], [4.0, 5.0]], np.float32)
+    b = np.array([[2.0, 2.0], [3.0, 2.0]], np.float32)
+
+    def build():
+        x = static.data("a", [2, 2])
+        y = static.data("b", [2, 2])
+        t = static.equal(x, y)
+        f = static.less_than(x, y)
+        return [static.elementwise_pow(x, y), static.elementwise_mod(x, y),
+                static.elementwise_floordiv(x, y),
+                static.logical_or(t, f), static.logical_xor(t, t),
+                static.reduce_prod(x, dim=1),
+                static.reduce_all(t), static.reduce_any(t)]
+
+    outs = _run(build, {"a": a, "b": b})
+    np.testing.assert_allclose(outs[0], a ** b)
+    np.testing.assert_allclose(outs[1], np.mod(a, b))
+    np.testing.assert_allclose(outs[2], np.floor_divide(a, b))
+    np.testing.assert_allclose(outs[5], [6.0, 20.0])
+    assert outs[6] == np.all(a == b)
+    assert outs[7] == np.any(a == b)
+
+
+def test_shape_introspection_and_sum():
+    x = np.ones((3, 4), np.float32)
+
+    def build():
+        v = static.data("x", [3, 4])
+        return [static.shape(v), static.rank(v), static.size(v),
+                static.sum([v, v, v])]
+
+    s, r, n, total = _run(build, {"x": x})
+    assert list(s) == [3, 4] and r == 2 and n == 12
+    np.testing.assert_allclose(total, 3 * x)
+
+
+def test_manipulation_group():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+
+    def build():
+        v = static.data("x", [3, 4])
+        idx = static.data("idx", [2, 2], dtype="int64")
+        return [static.expand(v, [2, 1]),
+                static.strided_slice(v, axes=[1], starts=[0], ends=[4],
+                                     strides=[2]),
+                static.gather_nd(v, idx),
+                static.pad(v, [1, 1, 0, 0], pad_value=9.0),
+                static.crop_tensor(v, shape=[2, 2], offsets=[1, 1]),
+                static.unstack(v, axis=0)[1]]
+
+    idx = np.array([[0, 1], [2, 3]], np.int64)
+    outs = _run(build, {"x": x, "idx": idx})
+    np.testing.assert_allclose(outs[0], np.tile(x, (2, 1)))
+    np.testing.assert_allclose(outs[1], x[:, ::2])
+    np.testing.assert_allclose(outs[2], [1.0, 11.0])
+    assert outs[3].shape == (5, 4) and outs[3][0, 0] == 9.0
+    np.testing.assert_allclose(outs[4], x[1:3, 1:3])
+    np.testing.assert_allclose(outs[5], x[1])
+
+
+def test_norm_and_feature_ops():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+
+    def build():
+        v = static.data("x", [2, 4, 8, 8])
+        return [static.instance_norm(v), static.group_norm(v, groups=2),
+                static.l2_normalize(v, axis=1), static.lrn(v),
+                static.space_to_depth(v, 2), static.pixel_shuffle(v, 2),
+                static.shuffle_channel(v, 2),
+                static.adaptive_pool2d(v, [2, 2], "avg")]
+
+    outs = _run(build, {"x": x})
+    inorm = outs[0]
+    np.testing.assert_allclose(inorm.mean(axis=(2, 3)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(inorm.std(axis=(2, 3)), 1.0, atol=1e-2)
+    assert outs[4].shape == (2, 16, 4, 4)
+    assert outs[5].shape == (2, 1, 16, 16)
+    assert outs[7].shape == (2, 4, 2, 2)
+    np.testing.assert_allclose(
+        outs[7][0, 0], x[0, 0].reshape(2, 4, 2, 4).mean(axis=(1, 3)),
+        atol=1e-5)
+
+
+def test_resize_and_grid_ops():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def build():
+        v = static.data("x", [1, 1, 4, 4])
+        theta = static.data("theta", [1, 2, 3])
+        grid = static.affine_grid(theta, [1, 1, 4, 4])
+        return [static.resize_nearest(v, out_shape=[8, 8],
+                                      align_corners=False),
+                static.resize_bilinear(v, out_shape=[2, 2],
+                                       align_corners=True),
+                static.grid_sampler(v, grid)]
+
+    theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+    outs = _run(build, {"x": x, "theta": theta})
+    assert outs[0].shape == (1, 1, 8, 8)
+    np.testing.assert_allclose(outs[0][0, 0, ::2, ::2], x[0, 0])
+    assert outs[1].shape == (1, 1, 2, 2)
+    # identity affine grid reproduces the input
+    np.testing.assert_allclose(outs[2], x, atol=1e-4)
+
+
+def test_conv_pool_long_tail_shapes():
+    rng = np.random.RandomState(0)
+    x4 = rng.randn(2, 3, 8, 8).astype(np.float32)
+    x5 = rng.randn(2, 3, 4, 8, 8).astype(np.float32)
+
+    def build():
+        v4 = static.data("x4", [2, 3, 8, 8])
+        v5 = static.data("x5", [2, 3, 4, 8, 8])
+        return [static.conv2d_transpose(v4, 6, filter_size=2, stride=2),
+                static.conv3d(v5, 4, filter_size=3, padding=1),
+                static.pool3d(v5, 2, "max", 2),
+                static.adaptive_pool3d(v5, [2, 2, 2], "avg")]
+
+    outs = _run(build, {"x4": x4, "x5": x5})
+    assert outs[0].shape == (2, 6, 16, 16)
+    assert outs[1].shape == (2, 4, 4, 8, 8)
+    assert outs[2].shape == (2, 3, 2, 4, 4)
+    assert outs[3].shape == (2, 3, 2, 2, 2)
+
+
+def test_losses_and_misc():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 5).astype(np.float32)
+    y = rng.rand(4, 5).astype(np.float32)
+
+    f1 = rng.randn(2, 3, 4, 4).astype(np.float32)
+    f2 = rng.randn(2, 5, 4, 4).astype(np.float32)
+
+    def build():
+        a = static.data("x", [4, 5])
+        b = static.data("y", [4, 5])
+        lbl = static.data("lbl", [4, 5])
+        fa = static.data("f1", [2, 3, 4, 4])
+        fb = static.data("f2", [2, 5, 4, 4])
+        return [static.smooth_l1(a, b), static.log_loss(a, b),
+                static.label_smooth(lbl, epsilon=0.1),
+                static.clip_by_norm(a, 1.0),
+                static.fsp_matrix(fa, fb)]
+
+    outs = _run(build, {"x": x, "y": y, "lbl": y, "f1": f1, "f2": f2})
+    assert outs[0].shape == (4, 1)
+    np.testing.assert_allclose(outs[2], 0.9 * y + 0.1 / 5, atol=1e-6)
+    assert np.linalg.norm(outs[3]) <= 1.0 + 1e-5
+    assert outs[4].shape == (2, 3, 5)
+
+
+def test_random_ops_shapes_and_ranges():
+    def build():
+        probs = static.data("p", [4, 6])
+        return [static.uniform_random([3, 4], min=-2.0, max=2.0),
+                static.gaussian_random([64], std=2.0),
+                static.sampling_id(probs),
+                static.random_crop(probs, shape=[3])]
+
+    p = np.full((4, 6), 1.0 / 6, np.float32)
+    outs = _run(build, {"p": p})
+    assert outs[0].shape == (3, 4) and (np.abs(outs[0]) <= 2).all()
+    assert outs[1].shape == (64,)
+    assert outs[2].shape == (4,) and (outs[2] < 6).all()
+    assert outs[3].shape == (4, 3)
+
+
+def test_crf_static_matches_eager():
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import crf as crf_mod
+
+    rng = np.random.RandomState(0)
+    B, L, T = 2, 5, 3
+    em = rng.randn(B, L, T).astype(np.float32)
+    lbl = rng.randint(0, T, (B, L)).astype(np.int64)
+    lens = np.array([5, 3], np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        e = static.data("em", [B, L, T])
+        la = static.data("lbl", [B, L], dtype="int64")
+        ln = static.data("lens", [B], dtype="int64")
+        ll = static.linear_chain_crf(e, la, length=ln)
+    exe = static.Executor()
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"em": em, "lbl": lbl, "lens": lens},
+                     fetch_list=[ll])
+    # same transition init as the static parameter (xavier) is unknown;
+    # instead check consistency: rerun eager with the trained param
+    trans_name = [n for n, v in main.global_block.vars.items()
+                  if "linear_chain_crf" in n][0]
+    from paddle_tpu.static.executor import global_scope
+    trans = np.asarray(global_scope().find_var(trans_name))
+    want = crf_mod.linear_chain_crf(jnp.asarray(em), jnp.asarray(trans),
+                                    jnp.asarray(lbl), jnp.asarray(lens))
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(want.numpy()), atol=1e-4)
+
+
+def test_param_layers_train_via_append_backward():
+    """prelu + bilinear_tensor_product parameters update and reduce the
+    loss — proving delegate kernels differentiate through traced-vjp."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 3).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        a = static.data("x", [8, 4])
+        t = static.data("y", [8, 3])
+        h = static.prelu(a, mode="all")
+        out = static.bilinear_tensor_product(h, h, 3)
+        loss = static.reduce_mean(static.square_error_cost(out, t))
+        static.SGD(learning_rate=0.05).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = [float(np.asarray(exe.run(main, feed={"x": x, "y": y},
+                                       fetch_list=[loss])[0]))
+              for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_multiplex_and_mean_iou():
+    a = np.zeros((3, 2), np.float32)
+    b = np.ones((3, 2), np.float32)
+    idx = np.array([[0], [1], [0]], np.int32)
+
+    def build():
+        va = static.data("a", [3, 2])
+        vb = static.data("b", [3, 2])
+        vi = static.data("i", [3, 1], dtype="int32")
+        pred = static.data("pred", [6], dtype="int64")
+        lbl = static.data("lbl", [6], dtype="int64")
+        m = static.mean_iou(pred, lbl, 2)
+        return [static.multiplex([va, vb], vi), m[0]]
+
+    pred = np.array([0, 0, 1, 1, 0, 1], np.int64)
+    lbl = np.array([0, 1, 1, 1, 0, 0], np.int64)
+    outs = _run(build, {"a": a, "b": b, "i": idx, "pred": pred, "lbl": lbl})
+    np.testing.assert_allclose(outs[0], [[0, 0], [1, 1], [0, 0]])
+    assert 0.0 < float(outs[1]) < 1.0
