@@ -93,26 +93,38 @@ class InMemoryDataset(DatasetBase):
                     raise IOError(f"failed to parse MultiSlot file {path}")
         else:
             self._py_records = []
-            for path in self._filelist:
-                self._py_records.extend(self._py_parse(path))
+            if self._thread_num > 1 and len(self._filelist) > 1:
+                # parse files in parallel processes (pure-Python parsing
+                # is GIL-bound; the native path threads in C++ instead)
+                import concurrent.futures as cf
+                import multiprocessing as mp
+
+                specs = [(s.name, s.type, s.dense_dim)
+                         for s in self._slots]
+                # spawn: fork after jax/XLA init can copy locked mutexes
+                with cf.ProcessPoolExecutor(
+                        max_workers=min(self._thread_num,
+                                        len(self._filelist)),
+                        mp_context=mp.get_context("spawn")) as ex:
+                    for recs in ex.map(_parse_multislot_file,
+                                       self._filelist,
+                                       [specs] * len(self._filelist)):
+                        self._py_records.extend(recs)
+            else:
+                for path in self._filelist:
+                    self._py_records.extend(self._py_parse(path))
+
+    def ingest_shards(self, n: int):
+        """Split this dataset into independent per-file ingestion shards
+        for multi-threaded train_from_dataset producers (the TPU-side
+        translation of the reference's thread-per-DeviceWorker DataFeed
+        channels, data_feed.cc). Only meaningful for streaming datasets
+        with several files; in-memory datasets iterate as one shard."""
+        return [self]
 
     def _py_parse(self, path):
-        records = []
-        with open(path) as f:
-            for line in f:
-                toks = line.split()
-                if not toks:
-                    continue
-                i, rec = 0, []
-                for s in self._slots:
-                    cnt = int(toks[i]); i += 1
-                    vals = toks[i:i + cnt]; i += cnt
-                    if s.type == "float":
-                        rec.append(np.array(vals, dtype=np.float32))
-                    else:
-                        rec.append(np.array(vals, dtype=np.uint64))
-                records.append(rec)
-        return records
+        return _parse_multislot_file(
+            path, [(s.name, s.type, s.dense_dim) for s in self._slots])
 
     # -- shuffle ----------------------------------------------------------
     def local_shuffle(self, seed=0):
@@ -137,6 +149,20 @@ class InMemoryDataset(DatasetBase):
         if self._native is not None:
             self._native.pt_dataset_clear(self._handle)
         self._py_records = None
+
+    def _free_native(self):
+        if self._native is not None and self._handle is not None:
+            try:
+                self._native.pt_dataset_free(self._handle)
+            except Exception:
+                pass
+            self._handle = None
+            self._native = None
+
+    def __del__(self):
+        # ephemeral ingestion shards (ingest_shards) allocate their own
+        # C++ Dataset handles; without this they leak per epoch
+        self._free_native()
 
     # -- iteration ---------------------------------------------------------
     def __iter__(self):
@@ -192,6 +218,24 @@ class InMemoryDataset(DatasetBase):
         return vals, lod
 
 
+def _parse_multislot_file(path, specs):
+    """Picklable MultiSlot parser for ProcessPoolExecutor workers."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            i, rec = 0, []
+            for _name, typ, _dd in specs:
+                cnt = int(toks[i]); i += 1
+                vals = toks[i:i + cnt]; i += cnt
+                rec.append(np.array(vals, dtype=np.float32 if typ == "float"
+                                    else np.uint64))
+            records.append(rec)
+    return records
+
+
 class QueueDataset(InMemoryDataset):
     """Streaming flavor (reference QueueDataset): no global residence
     required. This build loads per-file lazily at iteration time."""
@@ -211,6 +255,25 @@ class QueueDataset(InMemoryDataset):
             self.load_into_memory()
             yield from super().__iter__()
         self._filelist = files
+
+    def ingest_shards(self, n: int):
+        if n <= 1 or len(self._filelist) < 2:
+            return [self]
+        import copy
+
+        shards = []
+        n = min(n, len(self._filelist))
+        for i in range(n):
+            # copy keeps every config attribute; only the native handle
+            # and the file shard are per-clone
+            clone = copy.copy(self)
+            clone._native = None
+            clone._handle = None
+            clone._py_records = None
+            clone._thread_num = 1
+            clone._filelist = self._filelist[i::n]
+            shards.append(clone)
+        return shards
 
 
 class DatasetFactory:

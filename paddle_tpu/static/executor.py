@@ -258,23 +258,39 @@ class Executor:
         _END = object()
         producer_error = []
 
-        def producer():
+        # multi-worker ingestion: `thread` producers over per-file dataset
+        # shards (reference thread-per-DeviceWorker DataFeed channels);
+        # batch->feed padding runs in the producer threads so the device
+        # never waits on host-side parse/pad
+        shards = (dataset.ingest_shards(int(thread))
+                  if hasattr(dataset, "ingest_shards") and int(thread) > 1
+                  else [dataset])
+
+        def producer(shard):
             try:
-                for batch in dataset:
+                for batch in shard:
                     q.put(self._dataset_batch_to_feed(batch, block))
             except BaseException as e:  # surfaced in the consumer
                 producer_error.append(e)
             finally:
                 q.put(_END)
 
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
+        producers = [threading.Thread(target=producer, args=(s,),
+                                      daemon=True)
+                     for s in shards]
+        for t in producers:
+            t.start()
         step = 0
         last_fetch = None
         pending = None  # one-batch lookahead so the final step is known
+        ended = 0
         try:
             while True:
                 feed = q.get()
+                if feed is _END:
+                    ended += 1
+                    if ended < len(producers):
+                        continue   # other shards still producing
                 at_end = feed is _END
                 feed, pending = pending, (None if at_end else feed)
                 if feed is None or not feed:
@@ -297,14 +313,15 @@ class Executor:
                 if at_end:
                     break
         finally:
-            # unblock the producer (bounded queue) before joining, even
+            # unblock the producers (bounded queue) before joining, even
             # when a step raised mid-epoch
-            while t.is_alive():
+            while any(t.is_alive() for t in producers):
                 try:
                     q.get(timeout=0.1)
                 except queue_mod.Empty:
                     pass
-            t.join()
+            for t in producers:
+                t.join()
         if producer_error:
             raise producer_error[0]
         return last_fetch
