@@ -35,6 +35,20 @@ TPU_PLATFORMS = ("tpu", "axon")
 _BUILTIN_PLATFORMS = ("cpu", "tpu", "cuda", "rocm", "gpu", "metal")
 
 
+def pallas_enabled() -> bool:
+    """Common gate for custom Pallas kernels: not disabled by env, and the
+    live backend fronts a TPU. Kernel-specific shape ceilings stack on
+    top of this (flash_attention._pallas_ok, fused_embedding._eligible)."""
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1":
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() in TPU_PLATFORMS
+    except Exception:
+        return False
+
+
 def backends_initialized() -> bool:
     """True once jax has committed to a set of live backends."""
     try:

@@ -100,6 +100,12 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
         key = random_mod.next_rng_key()
         weight = Tensor(jax.random.normal(key, tuple(size)) * 0.01,
                         stop_gradient=False)
+    if lengths is None and combiner == "sum":
+        # fused path: the (N, L, D) gathered tensor never materializes
+        # (Pallas scalar-prefetch kernel on TPU, ops/pallas/fused_embedding)
+        out = F.fused_embedding_seq_pool(weight, input, combiner="sum",
+                                         padding_idx=padding_idx)
+        return (out, weight) if created else out
     emb = F.embedding(input, weight, padding_idx=padding_idx)  # (N, L, D)
     L = input.shape[1]
     if lengths is not None:

@@ -1378,3 +1378,16 @@ def sampled_softmax_with_cross_entropy(logits_weight, input, label,
     logits = logits.at[:, 1:].set(
         jnp.where(hit, -1e9, logits[:, 1:]))
     return -jax.nn.log_softmax(logits, axis=1)[:, :1]
+
+
+@primitive("fused_embedding_seq_pool", nondiff=("ids",))
+def fused_embedding_seq_pool(table, ids, combiner="sum", padding_idx=None,
+                             name=None):
+    """Fused lookup_table + sequence_pool — the (B, S, D) gathered
+    intermediate never reaches HBM (reference fused/
+    fused_embedding_seq_pool_op.cc; Pallas scalar-prefetch kernel on TPU,
+    XLA fallback elsewhere). table (V, D); ids (B, S) with padding_idx /
+    negative entries ignored; combiner sum|mean|sqrtn. Returns (B, D)."""
+    from ..ops.pallas.fused_embedding import fused_embedding_seq_pool as fe
+
+    return fe(table, ids, combiner=combiner, padding_idx=padding_idx)

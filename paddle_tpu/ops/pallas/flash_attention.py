@@ -543,9 +543,9 @@ def _kv_mask_bias(mask, batch, kv_len):
 def _pallas_ok(q, k, causal, seq_floor=256):
     import os
 
-    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") == "1":
-        return False
-    if jax.default_backend() not in ("tpu",):
+    from ...framework.bringup import pallas_enabled
+
+    if not pallas_enabled():
         return False
     b, ql, h, d = q.shape
     kl = k.shape[1]
