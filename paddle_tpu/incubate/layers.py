@@ -102,8 +102,17 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
                         stop_gradient=False)
     if lengths is None and combiner == "sum":
         # fused path: the (N, L, D) gathered tensor never materializes
-        # (Pallas scalar-prefetch kernel on TPU, ops/pallas/fused_embedding)
-        out = F.fused_embedding_seq_pool(weight, input, combiner="sum",
+        # (Pallas scalar-prefetch kernel on TPU, ops/pallas/fused_embedding).
+        # The fused op DROPS negative ids, while the unfused jnp.take path
+        # wraps them pythonically — keep wrap semantics by remapping
+        # negatives to their wrapped row first (ids are typically already
+        # non-negative; the remap folds away then).
+        V = int(weight.shape[0])
+        idv = input.value if hasattr(input, "value") else input
+        import jax.numpy as jnp
+
+        wrapped = Tensor(jnp.where(idv < 0, idv + V, idv))
+        out = F.fused_embedding_seq_pool(weight, wrapped, combiner="sum",
                                          padding_idx=padding_idx)
         return (out, weight) if created else out
     emb = F.embedding(input, weight, padding_idx=padding_idx)  # (N, L, D)

@@ -541,8 +541,6 @@ def _kv_mask_bias(mask, batch, kv_len):
 
 
 def _pallas_ok(q, k, causal, seq_floor=256):
-    import os
-
     from ...framework.bringup import pallas_enabled
 
     if not pallas_enabled():

@@ -42,6 +42,7 @@ from .ir import (Block, OpDesc, Program, VarDesc, Variable,  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers import data  # noqa: F401
 from .layers_ext import *  # noqa: F401,F403  (fluid.layers long tail)
+from .rnn_builder import DynamicRNN, StaticRNN  # noqa: F401
 from .optimizer import (SGD, Adam, AdamOptimizer, Lamb,  # noqa: F401
                         LambOptimizer, Momentum, MomentumOptimizer,
                         Optimizer, SGDOptimizer, set_gradient_clip)

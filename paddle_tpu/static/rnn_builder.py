@@ -1,0 +1,259 @@
+"""StaticRNN / DynamicRNN step-graph builders.
+
+Reference: /root/reference/python/paddle/fluid/layers/control_flow.py:449
+(StaticRNN: a sub-block executed per time step by recurrent_op) and :2939
+(DynamicRNN: the LoD-aware variant run by a C++ while loop over shrinking
+batches). TPU-native design: the step body is CAPTURED as op descs once,
+then REPLAYED per time step into the main program with systematic value
+renaming — a statically unrolled graph that XLA fuses across steps (no
+recurrent_op interpreter, no per-step kernel launches). DynamicRNN keeps
+the dense+lengths rewrite used across ops/sequence.py: instead of LoD
+batch shrinking, memories freeze and outputs zero out past each row's
+length, which is bit-equivalent for the surviving positions.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from ..utils import unique_name
+from .ir import OpDesc, Variable, default_main_program
+from .layers import _infer_outputs
+
+
+class _Memory:
+    def __init__(self, ph_name, init_name):
+        self.ph = ph_name
+        self.init = init_name
+        self.update = None
+
+
+class StaticRNN:
+    """Build a step block once; unroll it over time at build time.
+
+    Usage (reference control_flow.py StaticRNN docstring):
+
+        rnn = StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)            # x: (T, B, D)
+            prev = rnn.memory(init=h0)        # or shape=/batch_ref=
+            h = ...ops over (xt, prev)...
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()                            # (T, B, H)
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self._program = default_main_program()
+        self._inputs: List[tuple] = []      # (ph_name, source Variable)
+        self._memories: List[_Memory] = []
+        self._out_names: List[str] = []
+        self._captured: Optional[List[OpDesc]] = None
+        self._T: Optional[int] = None
+        self._in_step = False
+
+    @property
+    def _block(self):
+        return self._program.current_block()
+
+    @contextmanager
+    def step(self):
+        start = len(self._block.ops)
+        self._in_step = True
+        try:
+            yield self
+        finally:
+            self._in_step = False
+            # lift the step body out of the program; rnn() replays it
+            self._captured = list(self._block.ops[start:])
+            del self._block.ops[start:]
+
+    def _require_step(self):
+        if not self._in_step:
+            raise RuntimeError("call inside `with rnn.step():`")
+
+    def step_input(self, x: Variable) -> Variable:
+        """Per-step slice of x along time (dim 0): (T, B, ...) -> (B, ...)."""
+        self._require_step()
+        T = int(x.shape[0])
+        if self._T is None:
+            self._T = T
+        elif self._T != T:
+            raise ValueError(f"step inputs disagree on T: {self._T} vs {T}")
+        ph = unique_name.generate("srnn_in")
+        self._block.create_var(name=ph, shape=tuple(x.shape[1:]),
+                               dtype=x.desc.dtype)
+        self._inputs.append((ph, x))
+        return self._block.var(ph)
+
+    def memory(self, init: Optional[Variable] = None, shape=None,
+               batch_ref: Optional[Variable] = None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=0) -> Variable:
+        """Recurrent state: `init` Variable, or (shape, batch_ref) with a
+        constant init_value (reference StaticRNN.memory)."""
+        self._require_step()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs init= or shape=+batch_ref=")
+            from . import layers as L
+
+            # (B, 1) zeros derived from batch_ref, broadcast to shape[1:]
+            # — keeps the dynamic batch dim symbolic
+            feat = [int(s) for s in shape[1:]] if len(shape) > 1 else [1]
+            zero = L.reduce_sum(
+                L.scale(batch_ref, scale=0.0), dim=list(
+                    range(1, len(batch_ref.shape))), keep_dim=False)
+            zero = L.reshape(zero, [-1] + [1] * len(feat))
+            from .layers_ext import expand as _expand
+
+            init_v = L.scale(_expand(zero, [1] + feat), scale=1.0,
+                             bias=float(init_value))
+        else:
+            init_v = init
+        ph = unique_name.generate("srnn_mem")
+        self._block.create_var(name=ph, shape=tuple(init_v.shape),
+                               dtype=init_v.desc.dtype)
+        mem = _Memory(ph, init_v.name)
+        self._memories.append(mem)
+        return self._block.var(ph)
+
+    def update_memory(self, mem: Variable, new: Variable):
+        self._require_step()
+        for m in self._memories:
+            if m.ph == mem.name:
+                m.update = new.name
+                return
+        raise ValueError(f"{mem.name} is not a StaticRNN memory")
+
+    def step_output(self, o: Variable):
+        self._require_step()
+        self._out_names.append(o.name)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- unroll -----------------------------------------------------------
+    @staticmethod
+    def _resolve(rename: Dict[str, str], n: str, depth: int = 8) -> str:
+        """Follow the rename chain to a live name. A memory placeholder
+        maps to its init value's ORIGINAL name; when the init ops are
+        themselves part of the captured step body (memory(batch_ref=...)
+        builds them inside the step), that original name is re-suffixed
+        on replay — one extra hop."""
+        while n in rename and depth > 0:
+            nxt = rename[n]
+            if nxt == n:
+                break
+            n = nxt
+            depth -= 1
+        return n
+
+    def _replay_step(self, t: int, rename: Dict[str, str]):
+        """Append one timestep's copy of the captured descs, renaming
+        step-local values; returns the final rename map for this t."""
+        block = self._block
+        for op in self._captured:
+            new_in = {slot: [self._resolve(rename, n) for n in names]
+                      for slot, names in op.inputs.items()}
+            new_out = {}
+            for slot, names in op.outputs.items():
+                outs = []
+                for n in names:
+                    nn = f"{n}@t{t}"
+                    rename[n] = nn
+                    outs.append(nn)
+                new_out[slot] = outs
+            new_op = block.append_op(type=op.type, inputs=new_in,
+                                     outputs=new_out, attrs=dict(op.attrs))
+            _infer_outputs(block, new_op, {})
+        return rename
+
+    def _step_gate(self, t, rename):
+        """Hook for DynamicRNN length masking; identity here."""
+        return rename
+
+    def __call__(self):
+        if self._captured is None:
+            raise RuntimeError("StaticRNN: no step block was built")
+        if self._T is None:
+            raise RuntimeError("StaticRNN: step_input was never called")
+        from . import layers as L
+
+        cur_mem = {m.ph: m.init for m in self._memories}
+        collected: Dict[str, List[str]] = {n: [] for n in self._out_names}
+        for t in range(self._T):
+            rename: Dict[str, str] = dict(cur_mem)
+            for ph, src in self._inputs:
+                xt = L.slice(src, axes=[0], starts=[t], ends=[t + 1])
+                xt = L.squeeze(xt, axes=[0])
+                rename[ph] = xt.name
+            rename = self._replay_step(t, rename)
+            rename = self._step_gate(t, rename)
+            for m in self._memories:
+                if m.update is None:
+                    raise RuntimeError(
+                        f"memory {m.ph} was never update_memory()'d")
+                cur_mem[m.ph] = self._resolve(rename, m.update)
+            for n in self._out_names:
+                collected[n].append(self._resolve(rename, n))
+
+        outs = []
+        for n in self._out_names:
+            vs = [self._block.var(nm) for nm in collected[n]]
+            outs.append(L.stack(vs, axis=0))       # (T, B, ...)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length step builder (reference control_flow.py:2939).
+
+    The reference shrinks the batch per step following LoD; this build
+    keeps the batch dense and uses the sequence's lengths: memories hold
+    their previous value and outputs zero out at positions past each
+    row's length — identical results for all valid positions, static
+    shapes for XLA. step_input takes (x, lengths) with x (T, B, ...) and
+    lengths (B,) int; `output` values come back (T, B, ...) zero-padded.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self._lengths: Optional[Variable] = None
+
+    def step_input(self, x: Variable, lengths: Optional[Variable] = None,
+                   level=0):
+        if lengths is not None:
+            self._lengths = lengths
+        return super().step_input(x)
+
+    def _mask_at(self, t):
+        """(B, 1) float mask: 1 where t < length."""
+        from . import layers as L
+
+        tv = L.fill_constant([1], "int64", t)
+        m = L.cast(L.less_than(tv, self._lengths), "float32")
+        return L.reshape(m, [-1, 1])
+
+    def _step_gate(self, t, rename):
+        if self._lengths is None:
+            return rename
+        from . import layers as L
+
+        mask = self._mask_at(t)
+        one = L.fill_constant([1], "float32", 1.0)
+        keep = L.elementwise_sub(one, mask)
+        for m in self._memories:
+            if m.update is None:
+                raise RuntimeError(
+                    f"memory {m.ph} was never update_memory()'d")
+            new = self._block.var(self._resolve(rename, m.update))
+            prev = self._block.var(self._resolve(rename, m.ph))
+            gated = L.elementwise_add(L.elementwise_mul(new, mask),
+                                      L.elementwise_mul(prev, keep))
+            rename[m.update] = gated.name
+        for n in self._out_names:
+            ov = self._block.var(self._resolve(rename, n))
+            rename[n] = L.elementwise_mul(ov, mask).name
+        return rename
+
+    drnn_output = StaticRNN.output

@@ -78,16 +78,23 @@ def test_order_matches_single_process():
 
 
 def test_workers_outpace_single_thread():
-    ds = SlowDataset(192)
-    t0 = time.perf_counter()
-    n0 = sum(1 for _ in DataLoader(ds, batch_size=16, num_workers=0))
-    serial = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    n4 = sum(1 for _ in DataLoader(ds, batch_size=16, num_workers=4))
-    parallel = time.perf_counter() - t0
-    assert n0 == n4 == 12
-    # 4 workers on ~770ms of pure sleep: demand >=1.5x to stay unflaky
-    assert parallel < serial / 1.5, (serial, parallel)
+    def measure():
+        ds = SlowDataset(192)
+        t0 = time.perf_counter()
+        n0 = sum(1 for _ in DataLoader(ds, batch_size=16, num_workers=0))
+        serial = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        n4 = sum(1 for _ in DataLoader(ds, batch_size=16, num_workers=4))
+        parallel = time.perf_counter() - t0
+        assert n0 == n4 == 12
+        return serial, parallel
+
+    # 4 workers on ~770ms of pure sleep; demand >=1.3x, with one retry so
+    # a CI box under heavy load can't flake the suite
+    serial, parallel = measure()
+    if parallel >= serial / 1.3:
+        serial, parallel = measure()
+    assert parallel < serial / 1.3, (serial, parallel)
 
 
 def test_worker_death_raises_not_hangs():

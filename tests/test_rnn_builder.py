@@ -1,0 +1,162 @@
+"""StaticRNN / DynamicRNN step-graph builders (reference
+control_flow.py:449/2939): unrolled graph vs numpy recurrence, training
+through the unrolled ops, and dense+lengths masking semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+
+
+def _np_rnn(x, h0, w, u):
+    T, B, D = x.shape
+    h = h0.copy()
+    outs = []
+    for t in range(T):
+        h = np.tanh(x[t] @ w + h @ u)
+        outs.append(h)
+    return np.stack(outs)
+
+
+def _build_rnn(x_v, h0_v, w_v, u_v, rnn_cls=None, lengths=None):
+    rnn = (rnn_cls or static.StaticRNN)()
+    with rnn.step():
+        if lengths is not None:
+            xt = rnn.step_input(x_v, lengths)
+        else:
+            xt = rnn.step_input(x_v)
+        prev = rnn.memory(init=h0_v)
+        h = static.tanh(static.elementwise_add(
+            static.matmul(xt, w_v), static.matmul(prev, u_v)))
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    return rnn()
+
+
+def test_static_rnn_matches_numpy():
+    T, B, D, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, B, D).astype(np.float32)
+    h0 = rng.randn(B, H).astype(np.float32)
+    w = rng.randn(D, H).astype(np.float32)
+    u = rng.randn(H, H).astype(np.float32) * 0.3
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x_v = static.data("x", [T, B, D])
+        h0_v = static.data("h0", [B, H])
+        w_v = static.data("w", [D, H])
+        u_v = static.data("u", [H, H])
+        out = _build_rnn(x_v, h0_v, w_v, u_v)
+    exe = static.Executor()
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"x": x, "h0": h0, "w": w, "u": u},
+                     fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), _np_rnn(x, h0, w, u),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_static_rnn_memory_from_batch_ref():
+    T, B, D, H = 3, 2, 4, 5
+    rng = np.random.RandomState(1)
+    x = rng.randn(T, B, D).astype(np.float32)
+    w = rng.randn(D, H).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x_v = static.data("x", [T, B, D])
+        w_v = static.data("w", [D, H])
+        rnn = static.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x_v)
+            prev = rnn.memory(shape=[-1, H], batch_ref=xt, init_value=0.5)
+            h = static.tanh(static.elementwise_add(
+                static.matmul(xt, w_v), prev))
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    exe = static.Executor()
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"x": x, "w": w}, fetch_list=[out])
+    h = np.full((B, H), 0.5, np.float32)
+    want = []
+    for t in range(T):
+        h = np.tanh(x[t] @ w + h)
+        want.append(h)
+    np.testing.assert_allclose(np.asarray(got), np.stack(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """Gradients flow through the unrolled graph (append_backward)."""
+    T, B, D, H = 4, 6, 3, 5
+    rng = np.random.RandomState(2)
+    x = rng.randn(T, B, D).astype(np.float32)
+    y = rng.randn(B, H).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x_v = static.data("x", [T, B, D])
+        y_v = static.data("y", [B, H])
+        h0_v = static.fill_constant([B, H], "float32", 0.0)
+        w_v = static.create_parameter([D, H], "float32", name="w_rnn")
+        u_v = static.create_parameter([H, H], "float32", name="u_rnn")
+        out = _build_rnn(x_v, h0_v, w_v, u_v)          # (T, B, H)
+        last = static.squeeze(static.slice(out, axes=[0], starts=[T - 1],
+                                           ends=[T]), axes=[0])
+        loss = static.reduce_mean(static.square_error_cost(last, y_v))
+        static.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = [float(np.asarray(exe.run(main, feed={"x": x, "y": y},
+                                       fetch_list=[loss])[0]))
+              for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_dynamic_rnn_length_masking():
+    """Rows with shorter lengths freeze their memory and zero their
+    outputs past the end; valid prefixes match the unmasked RNN."""
+    T, B, D, H = 6, 3, 4, 5
+    rng = np.random.RandomState(3)
+    x = rng.randn(T, B, D).astype(np.float32)
+    h0 = np.zeros((B, H), np.float32)
+    w = rng.randn(D, H).astype(np.float32)
+    u = rng.randn(H, H).astype(np.float32) * 0.3
+    lengths = np.array([6, 3, 1], np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x_v = static.data("x", [T, B, D])
+        h0_v = static.data("h0", [B, H])
+        w_v = static.data("w", [D, H])
+        u_v = static.data("u", [H, H])
+        len_v = static.data("lens", [B], dtype="int64")
+        out = _build_rnn(x_v, h0_v, w_v, u_v, rnn_cls=static.DynamicRNN,
+                         lengths=len_v)
+    exe = static.Executor()
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"x": x, "h0": h0, "w": w, "u": u,
+                                 "lens": lengths}, fetch_list=[out])
+    got = np.asarray(got)
+    ref = _np_rnn(x, h0, w, u)
+    for b, n in enumerate(lengths):
+        np.testing.assert_allclose(got[:n, b], ref[:n, b], rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(got[n:, b], 0.0, atol=1e-6)
+
+
+def test_step_errors():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x_v = static.data("x", [4, 2, 3])
+        rnn = static.StaticRNN()
+        with pytest.raises(RuntimeError, match="rnn.step"):
+            rnn.step_input(x_v)
+        with pytest.raises(RuntimeError, match="no step block"):
+            static.StaticRNN()()
+        with rnn.step():
+            xt = rnn.step_input(x_v)
+            prev = rnn.memory(shape=[-1, 3], batch_ref=xt)
+            rnn.step_output(prev)
+        with pytest.raises(RuntimeError, match="update_memory"):
+            rnn()
