@@ -111,9 +111,14 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
         idv = input.value if hasattr(input, "value") else input
         import jax.numpy as jnp
 
-        wrapped = Tensor(jnp.where(idv < 0, idv + V, idv))
+        if padding_idx is not None:
+            # mark padding FIRST (a negative padding_idx must stay
+            # dropped, not wrap to a live row), then wrap the remaining
+            # pythonic negatives like jnp.take would
+            idv = jnp.where(idv == padding_idx, -V - 1, idv)
+        wrapped = Tensor(jnp.where((idv < 0) & (idv >= -V), idv + V, idv))
         out = F.fused_embedding_seq_pool(weight, wrapped, combiner="sum",
-                                         padding_idx=padding_idx)
+                                         padding_idx=None)
         return (out, weight) if created else out
     emb = F.embedding(input, weight, padding_idx=padding_idx)  # (N, L, D)
     L = input.shape[1]

@@ -1,0 +1,130 @@
+"""Program / op-desc validation.
+
+Reference: /root/reference/tools/check_op_desc.py + the per-op
+OpDesc::CheckAttrs / InferShape validation the C++ operator registry ran
+at build time. Here descs are JSON + eval_shape-inferred, so the checker
+validates the graph-level invariants the reference enforced in C++:
+
+- every op type has a registered kernel
+- every op input names a var that exists (in scope) and was produced
+  before use (feed/parameter/fetch-order discipline)
+- no two ops write the same var name (single-assignment, which the
+  executor env relies on)
+- dangling fetch targets / unreachable outputs are reported
+
+`validate_program` raises ProgramValidationError with ALL findings (the
+reference printed a batch report, not first-failure).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..framework.errors import EnforceNotMet
+from .ir import Program
+from .kernels import KERNELS
+
+
+class ProgramValidationError(EnforceNotMet):
+    def __init__(self, findings: List[str]):
+        self.findings = findings
+        super().__init__(
+            "program validation failed:\n  - " + "\n  - ".join(findings))
+
+
+def validate_program(program: Program, check_order: bool = True,
+                     extra_defined: Optional[set] = None) -> List[str]:
+    """Return the list of findings (empty = valid); see module doc.
+
+    check_order=False skips the produced-before-use pass (startup
+    programs legitimately read nothing, and some callers append ops out
+    of order before a final reorder).
+    extra_defined: var names provided externally (e.g. by a paired
+    startup program or feed dict).
+    """
+    findings: List[str] = []
+    block_final_produced = {}
+    for block in program.blocks:
+        produced = set(extra_defined or ())
+        # a sub-block sees everything its ancestors produced
+        parent = getattr(block, "parent_idx", -1)
+        while parent not in (-1, None):
+            produced |= block_final_produced.get(parent, set())
+            parent = getattr(program.blocks[parent], "parent_idx", -1)
+        # parameters + feed targets are live before any op runs
+        for name, desc in block.vars.items():
+            if getattr(desc, "initializer_desc", None) is not None \
+                    or getattr(desc, "is_data", False) \
+                    or getattr(desc, "persistable", False):
+                produced.add(name)
+        written = {}
+        for i, op in enumerate(block.ops):
+            # executor-native pseudo-ops with no kernel entry
+            # (static/executor.py run_block): the backward region marker
+            # and feed/fetch bookkeeping
+            if op.type in ("backward", "feed", "fetch"):
+                for slot, names in op.outputs.items():
+                    produced.update(names)
+                continue
+            if op.type not in KERNELS:
+                findings.append(
+                    f"block {block.idx} op #{i}: no kernel registered "
+                    f"for type {op.type!r}")
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if not block.has_var(n):
+                        findings.append(
+                            f"block {block.idx} op #{i} ({op.type}) input "
+                            f"{slot}: var {n!r} does not exist")
+                    elif check_order and n not in produced and \
+                            n not in written:
+                        findings.append(
+                            f"block {block.idx} op #{i} ({op.type}) input "
+                            f"{slot}: var {n!r} used before it is "
+                            "produced (feed it, make it persistable, or "
+                            "reorder ops)")
+            for slot, names in op.outputs.items():
+                for n in names:
+                    if n in written and op.type not in (
+                            "assign", "increment", "fill_constant"):
+                        findings.append(
+                            f"block {block.idx} op #{i} ({op.type}) "
+                            f"output {slot}: var {n!r} already written by "
+                            f"op #{written[n]} (single-assignment)")
+                    written[n] = i
+                    produced.add(n)
+                    if not block.has_var(n):
+                        findings.append(
+                            f"block {block.idx} op #{i} ({op.type}) "
+                            f"output {slot}: var {n!r} has no VarDesc")
+        block_final_produced[block.idx] = produced
+    return findings
+
+
+def check_program(program: Program, **kw) -> None:
+    """Raise ProgramValidationError when validate_program finds issues."""
+    findings = validate_program(program, **kw)
+    if findings:
+        raise ProgramValidationError(findings)
+
+
+def compare_op_signatures(old_spec_path: str, new_spec_path: str):
+    """Diff two API.spec dumps (reference check_op_desc.py printed an
+    added/deleted/changed report for op protos across versions)."""
+    def load(p):
+        out = {}
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line or " " not in line:
+                    continue
+                name, sig = line.split(" ", 1)
+                out[name] = sig
+        return out
+
+    old, new = load(old_spec_path), load(new_spec_path)
+    return {
+        "added": sorted(set(new) - set(old)),
+        "deleted": sorted(set(old) - set(new)),
+        "changed": sorted(n for n in set(old) & set(new)
+                          if old[n] != new[n]),
+    }

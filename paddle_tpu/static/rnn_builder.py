@@ -51,6 +51,7 @@ class StaticRNN:
         self._captured: Optional[List[OpDesc]] = None
         self._T: Optional[int] = None
         self._in_step = False
+        self._init_ops: List[OpDesc] = []   # run once, before the unroll
 
     @property
     def _block(self):
@@ -64,9 +65,16 @@ class StaticRNN:
             yield self
         finally:
             self._in_step = False
-            # lift the step body out of the program; rnn() replays it
-            self._captured = list(self._block.ops[start:])
+            # lift the step body out of the program; rnn() replays it.
+            # Memory-init chains (built by memory(batch_ref=...)) run
+            # ONCE: splice them back in ahead of the unroll instead of
+            # replaying dead copies every timestep.
+            body = list(self._block.ops[start:])
+            init_set = {id(op) for op in self._init_ops}
+            self._captured = [op for op in body if id(op) not in init_set]
             del self._block.ops[start:]
+            self._block.ops.extend(
+                op for op in body if id(op) in init_set)
 
     def _require_step(self):
         if not self._in_step:
@@ -97,17 +105,28 @@ class StaticRNN:
                 raise ValueError("memory() needs init= or shape=+batch_ref=")
             from . import layers as L
 
-            # (B, 1) zeros derived from batch_ref, broadcast to shape[1:]
+            mark = len(self._block.ops)
+            # a step-input placeholder has no pre-loop value: derive the
+            # batch dim from its SOURCE's t=0 slice so the init chain can
+            # run once before the unroll
+            ref = batch_ref
+            for ph, src_v in self._inputs:
+                if ph == batch_ref.name:
+                    ref = L.squeeze(L.slice(src_v, axes=[0], starts=[0],
+                                            ends=[1]), axes=[0])
+                    break
+            # (B, 1) zeros derived from the ref, broadcast to shape[1:]
             # — keeps the dynamic batch dim symbolic
             feat = [int(s) for s in shape[1:]] if len(shape) > 1 else [1]
             zero = L.reduce_sum(
-                L.scale(batch_ref, scale=0.0), dim=list(
-                    range(1, len(batch_ref.shape))), keep_dim=False)
+                L.scale(ref, scale=0.0), dim=list(
+                    range(1, len(ref.shape))), keep_dim=False)
             zero = L.reshape(zero, [-1] + [1] * len(feat))
             from .layers_ext import expand as _expand
 
             init_v = L.scale(_expand(zero, [1] + feat), scale=1.0,
                              bias=float(init_value))
+            self._init_ops.extend(self._block.ops[mark:])
         else:
             init_v = init
         ph = unique_name.generate("srnn_mem")
@@ -242,18 +261,28 @@ class DynamicRNN(StaticRNN):
         mask = self._mask_at(t)
         one = L.fill_constant([1], "float32", 1.0)
         keep = L.elementwise_sub(one, mask)
+
+        def fit(m2, value):
+            # broadcast the (B, 1) mask against any-rank (B, ...) value
+            rank = len(value.shape)
+            if rank <= 2:
+                return m2
+            return L.reshape(m2, [-1] + [1] * (rank - 1))
+
         for m in self._memories:
             if m.update is None:
                 raise RuntimeError(
                     f"memory {m.ph} was never update_memory()'d")
             new = self._block.var(self._resolve(rename, m.update))
             prev = self._block.var(self._resolve(rename, m.ph))
-            gated = L.elementwise_add(L.elementwise_mul(new, mask),
-                                      L.elementwise_mul(prev, keep))
+            mk = fit(mask, new)
+            gated = L.elementwise_add(
+                L.elementwise_mul(new, mk),
+                L.elementwise_mul(prev, fit(keep, prev)))
             rename[m.update] = gated.name
         for n in self._out_names:
             ov = self._block.var(self._resolve(rename, n))
-            rename[n] = L.elementwise_mul(ov, mask).name
+            rename[n] = L.elementwise_mul(ov, fit(mask, ov)).name
         return rename
 
     drnn_output = StaticRNN.output

@@ -297,3 +297,62 @@ def test_array_write_with_incremented_counter_appends():
     n_v, last_v = exe.run(main, feed={"x": xv}, fetch_list=[n, last])
     assert int(n_v[0]) == 2
     np.testing.assert_allclose(last_v, 2 * xv)
+
+
+def test_program_validation():
+    """check_program catches missing vars, unregistered ops, and
+    use-before-produce (reference tools/check_op_desc.py class of CI
+    checks, graph-level)."""
+    import pytest
+
+    from paddle_tpu.static import (ProgramValidationError, check_program,
+                                   validate_program)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3])
+        h = static.nn.fc(x, 5)
+        loss = static.mean(h)
+    assert validate_program(main) == []
+    check_program(startup, check_order=False)
+
+    # break it: input referencing a nonexistent var
+    main.global_block.ops[0].inputs["X"] = ["ghost_var"]
+    findings = validate_program(main)
+    assert any("ghost_var" in f and "does not exist" in f
+               for f in findings)
+    with pytest.raises(ProgramValidationError, match="ghost_var"):
+        check_program(main)
+
+    # break it: unregistered op type
+    main2, startup2 = static.Program(), static.Program()
+    with static.program_guard(main2, startup2):
+        x = static.data("x", [2])
+        main2.global_block.append_op(
+            type="not_a_real_op", inputs={"X": [x.name]},
+            outputs={"Out": ["y"]})
+    findings = validate_program(main2)
+    assert any("no kernel registered" in f for f in findings)
+
+
+def test_program_validation_control_flow_subblocks():
+    """A while_loop body reading a parent-block var must validate clean
+    (sub-blocks see ancestor-produced names)."""
+    from paddle_tpu.static import validate_program
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4])
+        y = static.scale(x, scale=2.0)         # parent-block computed
+        i = static.fill_constant([1], "int64", 0)
+        n = static.fill_constant([1], "int64", 3)
+
+        def cond(i, acc):
+            return static.less_than(i, n)
+
+        def body(i, acc):
+            return static.increment(i, 1.0, in_place=False), \
+                static.elementwise_add(acc, y)  # reads parent var
+
+        _i, acc = static.while_loop(cond, body, [i, y])
+    assert validate_program(main) == [], validate_program(main)
