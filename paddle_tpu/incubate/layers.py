@@ -100,6 +100,10 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
         key = random_mod.next_rng_key()
         weight = Tensor(jax.random.normal(key, tuple(size)) * 0.01,
                         stop_gradient=False)
+    if padding_idx is not None and padding_idx < 0:
+        # fluid normalizes a negative padding_idx to size[0]+padding_idx
+        # before comparing (contrib nn.py fused_embedding_seq_pool)
+        padding_idx = int(weight.shape[0]) + int(padding_idx)
     if lengths is None and combiner == "sum":
         # fused path: the (N, L, D) gathered tensor never materializes
         # (Pallas scalar-prefetch kernel on TPU, ops/pallas/fused_embedding).
@@ -112,8 +116,8 @@ def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
         import jax.numpy as jnp
 
         if padding_idx is not None:
-            # mark padding FIRST (a negative padding_idx must stay
-            # dropped, not wrap to a live row), then wrap the remaining
+            # mark padding FIRST (padding_idx is non-negative after the
+            # fluid normalization above), then wrap the remaining
             # pythonic negatives like jnp.take would
             idv = jnp.where(idv == padding_idx, -V - 1, idv)
         wrapped = Tensor(jnp.where((idv < 0) & (idv >= -V), idv + V, idv))

@@ -180,6 +180,13 @@ def sigmoid(x, name=None):
 
 @primitive("linear")
 def linear(x, weight, bias=None, name=None):
+    if x.ndim < 1 or weight.ndim != 2 or x.shape[-1] != weight.shape[0]:
+        from ..framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"linear: input features {tuple(x.shape)}[-1] must match "
+            f"weight rows {tuple(weight.shape)} — W is (in_features, "
+            "out_features) in this framework (reference fc/mul op)")
     out = jnp.matmul(x, weight)
     if bias is not None:
         out = out + bias
@@ -188,6 +195,13 @@ def linear(x, weight, bias=None, name=None):
 
 @primitive("embedding_fn")
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    if not jnp.issubdtype(x.dtype, jnp.integer):
+        from ..framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"embedding: ids must be an integer tensor, got {x.dtype} "
+            f"shape {tuple(x.shape)} (cast labels/ids with "
+            ".astype('int64'))")
     out = jnp.take(weight, x, axis=0)
     if padding_idx is not None:
         mask = (x == padding_idx)[..., None]
@@ -285,6 +299,21 @@ def _conv_padding(padding, n, strides=None):
 
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+    from ..framework.errors import InvalidArgumentError
+
+    if x.ndim != n + 2 or weight.ndim != n + 2:
+        raise InvalidArgumentError(
+            f"conv{n}d: expected rank-{n + 2} input and weight, got "
+            f"input {tuple(x.shape)} and weight {tuple(weight.shape)}")
+    cin = x.shape[-1] if channel_last else x.shape[1]
+    if cin != weight.shape[1] * groups:
+        raise InvalidArgumentError(
+            f"conv{n}d: input {tuple(x.shape)} "
+            f"({'channel-last' if channel_last else 'channel-first'}, "
+            f"C_in={cin}) is incompatible with weight "
+            f"{tuple(weight.shape)} — weight layout is (C_out, "
+            f"C_in/groups, *kernel) and needs C_in == "
+            f"{weight.shape[1]} * groups({groups})")
     stride = _tuple_n(stride, n)
     dilation = _tuple_n(dilation, n)
     pad = _conv_padding(padding, n)
@@ -722,8 +751,22 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             return jnp.mean(loss)
         return _reduce_loss(loss, reduction)
     lbl = label
+    if not jnp.issubdtype(lbl.dtype, jnp.integer):
+        from ..framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"cross_entropy: hard labels must be integer class ids, got "
+            f"{lbl.dtype} {tuple(lbl.shape)}; pass soft_label=True for "
+            "probability targets")
     if lbl.ndim == logp.ndim:
         lbl = jnp.squeeze(lbl, axis=axis)
+    elif lbl.ndim != logp.ndim - 1:
+        from ..framework.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"cross_entropy: label shape {tuple(label.shape)} must be "
+            f"logits shape {tuple(input.shape)} without the class axis "
+            f"(or with a trailing 1)")
     if label_smoothing > 0.0:
         onehot = jax.nn.one_hot(lbl, n_classes, dtype=logp.dtype, axis=axis)
         soft = onehot * (1 - label_smoothing) + label_smoothing / n_classes

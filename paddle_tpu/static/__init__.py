@@ -43,8 +43,8 @@ from .layers import *  # noqa: F401,F403
 from .layers import data  # noqa: F401
 from .layers_ext import *  # noqa: F401,F403  (fluid.layers long tail)
 from .rnn_builder import DynamicRNN, StaticRNN  # noqa: F401
-from .checker import (check_program, validate_program,  # noqa: F401
-                      ProgramValidationError)
+from .checker import (check_program, compare_op_signatures,  # noqa: F401
+                      validate_program, ProgramValidationError)
 from .optimizer import (SGD, Adam, AdamOptimizer, Lamb,  # noqa: F401
                         LambOptimizer, Momentum, MomentumOptimizer,
                         Optimizer, SGDOptimizer, set_gradient_clip)

@@ -10,7 +10,8 @@ validates the graph-level invariants the reference enforced in C++:
   before use (feed/parameter/fetch-order discipline)
 - no two ops write the same var name (single-assignment, which the
   executor env relies on)
-- dangling fetch targets / unreachable outputs are reported
+- fetch targets name vars some op actually produces (pass
+  fetch_names=)
 
 `validate_program` raises ProgramValidationError with ALL findings (the
 reference printed a batch report, not first-failure).
@@ -32,7 +33,8 @@ class ProgramValidationError(EnforceNotMet):
 
 
 def validate_program(program: Program, check_order: bool = True,
-                     extra_defined: Optional[set] = None) -> List[str]:
+                     extra_defined: Optional[set] = None,
+                     fetch_names: Optional[List[str]] = None) -> List[str]:
     """Return the list of findings (empty = valid); see module doc.
 
     check_order=False skips the produced-before-use pass (startup
@@ -97,6 +99,15 @@ def validate_program(program: Program, check_order: bool = True,
                             f"block {block.idx} op #{i} ({op.type}) "
                             f"output {slot}: var {n!r} has no VarDesc")
         block_final_produced[block.idx] = produced
+    if fetch_names:
+        all_produced = set()
+        for s in block_final_produced.values():
+            all_produced |= s
+        for n in fetch_names:
+            name = getattr(n, "name", n)
+            if name not in all_produced:
+                findings.append(
+                    f"fetch target {name!r} is never produced by any op")
     return findings
 
 

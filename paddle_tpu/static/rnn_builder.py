@@ -60,6 +60,7 @@ class StaticRNN:
     @contextmanager
     def step(self):
         start = len(self._block.ops)
+        self._step_start = start
         self._in_step = True
         try:
             yield self
@@ -110,11 +111,23 @@ class StaticRNN:
             # batch dim from its SOURCE's t=0 slice so the init chain can
             # run once before the unroll
             ref = batch_ref
+            hoistable = True
+            matched = False
             for ph, src_v in self._inputs:
                 if ph == batch_ref.name:
                     ref = L.squeeze(L.slice(src_v, axes=[0], starts=[0],
                                             ends=[1]), axes=[0])
+                    matched = True
                     break
+            if not matched:
+                # batch_ref produced INSIDE the step body? Then the init
+                # chain must stay in the body (replayed per step and
+                # resolved through the rename map) — it cannot run before
+                # the unroll
+                step_outputs = {
+                    n for op in self._block.ops[self._step_start:mark]
+                    for names in op.outputs.values() for n in names}
+                hoistable = batch_ref.name not in step_outputs
             # (B, 1) zeros derived from the ref, broadcast to shape[1:]
             # — keeps the dynamic batch dim symbolic
             feat = [int(s) for s in shape[1:]] if len(shape) > 1 else [1]
@@ -126,7 +139,8 @@ class StaticRNN:
 
             init_v = L.scale(_expand(zero, [1] + feat), scale=1.0,
                              bias=float(init_value))
-            self._init_ops.extend(self._block.ops[mark:])
+            if hoistable:
+                self._init_ops.extend(self._block.ops[mark:])
         else:
             init_v = init
         ph = unique_name.generate("srnn_mem")
@@ -265,8 +279,10 @@ class DynamicRNN(StaticRNN):
         def fit(m2, value):
             # broadcast the (B, 1) mask against any-rank (B, ...) value
             rank = len(value.shape)
-            if rank <= 2:
+            if rank == 2:
                 return m2
+            if rank == 1:
+                return L.reshape(m2, [-1])
             return L.reshape(m2, [-1] + [1] * (rank - 1))
 
         for m in self._memories:

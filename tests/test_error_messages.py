@@ -1,0 +1,59 @@
+"""Framework-boundary error quality (reference PADDLE_ENFORCE messages,
+platform/enforce.h): common user mistakes must raise typed errors with
+actionable text, not raw XLA shape dumps."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.nn import functional as F
+
+
+def test_linear_feature_mismatch():
+    lin = nn.Linear(8, 4)
+    x = paddle.to_tensor(np.zeros((2, 5), np.float32))
+    with pytest.raises(InvalidArgumentError, match="in_features"):
+        lin(x)
+
+
+def test_conv_channel_mismatch():
+    conv = nn.Conv2D(3, 8, 3)
+    x = paddle.to_tensor(np.zeros((2, 4, 8, 8), np.float32))
+    with pytest.raises(InvalidArgumentError, match="C_in"):
+        conv(x)
+
+
+def test_conv_groups_mismatch():
+    conv = nn.Conv2D(8, 8, 3, groups=4)
+    x = paddle.to_tensor(np.zeros((2, 6, 8, 8), np.float32))
+    with pytest.raises(InvalidArgumentError, match="groups"):
+        conv(x)
+
+
+def test_embedding_float_ids():
+    emb = nn.Embedding(10, 4)
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    with pytest.raises(InvalidArgumentError, match="integer"):
+        emb(x)
+
+
+def test_cross_entropy_label_shape_and_dtype():
+    logits = paddle.to_tensor(np.zeros((4, 3), np.float32))
+    bad_dtype = paddle.to_tensor(np.zeros((4,), np.float32))
+    with pytest.raises(InvalidArgumentError, match="soft_label"):
+        F.cross_entropy(logits, bad_dtype)
+    bad_shape = paddle.to_tensor(np.zeros((4, 2, 2), np.int64))
+    with pytest.raises(InvalidArgumentError, match="class axis"):
+        F.cross_entropy(logits, bad_shape)
+
+
+def test_valid_calls_still_work():
+    lin = nn.Linear(8, 4)
+    out = lin(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    assert out.shape == (2, 4)
+    logits = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32))
+    lbl = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    assert float(F.cross_entropy(logits, lbl).numpy()) > 0
+    lbl2 = paddle.to_tensor(np.array([[0], [1], [2], [0]]))
+    assert float(F.cross_entropy(logits, lbl2).numpy()) > 0
