@@ -295,15 +295,36 @@ def noam_decay(d_model, warmup_steps, learning_rate=1.0):
     return NoamDecay(d_model, warmup_steps, learning_rate)
 
 
-def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
-    class _Exp(LRScheduler):
+def _staircase_decay(learning_rate, decay_steps, staircase, fn):
+    """Shared scaffold for the step/decay_steps (+optional floor) decays
+    (reference learning_rate_scheduler.py exponential/natural_exp/
+    inverse_time family)."""
+    class _Decay(LRScheduler):
         def get_lr(self):
-            exp = self.last_epoch / decay_steps
+            t = self.last_epoch / decay_steps
             if staircase:
-                exp = math.floor(exp)
-            return self.base_lr * decay_rate ** exp
+                t = math.floor(t)
+            return fn(self.base_lr, t)
 
-    return _Exp(learning_rate)
+    return _Decay(learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    return _staircase_decay(learning_rate, decay_steps, staircase,
+                            lambda lr, t: lr * decay_rate ** t)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    return _staircase_decay(learning_rate, decay_steps, staircase,
+                            lambda lr, t: lr * math.exp(-decay_rate * t))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    return _staircase_decay(learning_rate, decay_steps, staircase,
+                            lambda lr, t: lr / (1.0 + decay_rate * t))
 
 
 def piecewise_decay(boundaries, values):

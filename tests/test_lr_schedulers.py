@@ -1,0 +1,32 @@
+"""LR schedule closed forms (reference layers/learning_rate_scheduler.py
+decay family)."""
+def test_natural_exp_and_inverse_time_decay():
+    """learning_rate_scheduler.py natural_exp_decay / inverse_time_decay
+    closed forms (reference layers/learning_rate_scheduler.py)."""
+    import math
+
+    from paddle_tpu.optimizer import lr
+
+    s = lr.natural_exp_decay(0.1, 10, 0.5)
+    for _ in range(20):
+        s.step()
+    assert abs(s() - 0.1 * math.exp(-0.5 * 2.0)) < 1e-9
+
+    s = lr.natural_exp_decay(0.1, 10, 0.5, staircase=True)
+    for _ in range(15):
+        s.step()
+    assert abs(s() - 0.1 * math.exp(-0.5 * 1.0)) < 1e-9
+
+    s = lr.inverse_time_decay(0.1, 10, 0.5)
+    for _ in range(20):
+        s.step()
+    assert abs(s() - 0.1 / (1 + 0.5 * 2.0)) < 1e-9
+
+
+def test_exponential_decay_staircase():
+    from paddle_tpu.optimizer import lr
+
+    s = lr.exponential_decay(0.2, 10, 0.5, staircase=True)
+    for _ in range(25):
+        s.step()
+    assert abs(s() - 0.2 * 0.5 ** 2) < 1e-9

@@ -137,3 +137,4 @@ def test_resize_interpolation_modes():
     assert set(np.unique(out)) <= {0.0, 3.0}           # no blended labels
     with pytest.raises(ValueError):
         T.Resize(4, interpolation="area")
+
