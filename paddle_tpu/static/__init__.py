@@ -42,6 +42,7 @@ from .ir import (Block, OpDesc, Program, VarDesc, Variable,  # noqa: F401
 from .layers import *  # noqa: F401,F403
 from .layers import data  # noqa: F401
 from .layers_ext import *  # noqa: F401,F403  (fluid.layers long tail)
+from .layers_compat import *  # noqa: F401,F403  (fluid.layers bridge)
 from .rnn_builder import DynamicRNN, StaticRNN  # noqa: F401
 from .checker import (check_program, compare_op_signatures,  # noqa: F401
                       validate_program, ProgramValidationError)

@@ -44,9 +44,12 @@ def _register_delegate(op_type, fn, in_slots=("X",), out_slots=("Out",),
                        list_slot=None, needs_rng=False):
     """Register a static kernel that calls an eager jnp implementation.
 
-    in_slots: input slot order passed positionally (missing slots are
-    skipped). list_slot: this slot's full array LIST is the (single)
-    positional argument. attrs become keyword arguments verbatim.
+    in_slots: input slot order passed positionally. A missing optional
+    slot binds None at ITS OWN position (trailing Nones are trimmed) —
+    skipping it would shift every later slot one position left and
+    silently bind the wrong arrays. list_slot: this slot's full array
+    LIST is the (single) positional argument. attrs become keyword
+    arguments verbatim.
     """
     if op_type in KERNELS:
         return
@@ -56,7 +59,10 @@ def _register_delegate(op_type, fn, in_slots=("X",), out_slots=("Out",),
         if list_slot is not None:
             args = [list(ins[list_slot])]
         else:
-            args = [ins[s][0] for s in in_slots if s in ins and ins[s]]
+            args = [ins[s][0] if (s in ins and ins[s]) else None
+                    for s in in_slots]
+            while args and args[-1] is None:
+                args.pop()
         kw = dict(attrs)
         if needs_rng:
             kw["_rng_key"] = ctx.rng_key

@@ -43,6 +43,13 @@ class Optimizer:
 
     # -- helpers ----------------------------------------------------------
     def _create_lr_var(self, helper: LayerHelper):
+        from .ir import Variable
+
+        # a graph-built schedule (layers_compat exponential_decay & co.)
+        # IS the lr var — the decay recomputes from the step counter
+        # inside the program, like the reference's lr_scheduler ops
+        if isinstance(self.learning_rate, Variable):
+            return self.learning_rate
         # cached lr var is only valid within the program it was created in
         if self._lr_var is not None and \
                 self._lr_var.block.program is helper.main_program:

@@ -1,0 +1,396 @@
+"""fluid.layers compatibility bridge (static/layers_compat.py): graph-
+built LR schedules, loss/sequence/detection delegates, RNN sweep ops,
+hsigmoid/warpctc/hash/auc — executed through Program/Executor."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+
+pytestmark = pytest.mark.slow
+
+
+def _run(build, feeds=None):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+    exe = static.Executor()
+    exe.run(startup)
+    return [np.asarray(r) for r in
+            exe.run(main, feed=feeds or {}, fetch_list=list(outs))], \
+        (exe, main)
+
+
+def test_graph_built_lr_schedule_drives_optimizer():
+    """exponential_decay builds a Variable from the step counter; the
+    optimizer consumes it and the fetched lr follows the closed form
+    across exe.run calls (reference learning_rate_scheduler.py)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 1).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = static.data("x", [8, 4])
+        yv = static.data("y", [8, 1])
+        lr = static.exponential_decay(0.1, decay_steps=2, decay_rate=0.5)
+        loss = static.reduce_mean(
+            static.square_error_cost(static.nn.fc(xv, 1), yv))
+        static.SGD(learning_rate=lr).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    lrs = []
+    for _ in range(5):
+        out = exe.run(main, feed={"x": x, "y": y}, fetch_list=[lr, loss])
+        lrs.append(float(np.asarray(out[0]).ravel()[0]))
+    # step counter starts at 1 on the first run
+    want = [0.1 * 0.5 ** ((i + 1) / 2) for i in range(5)]
+    np.testing.assert_allclose(lrs, want, rtol=1e-5)
+
+
+def test_more_lr_schedules_build_and_run():
+    def build():
+        return [static.noam_decay(64, 10),
+                static.natural_exp_decay(0.1, 5, 0.5),
+                static.inverse_time_decay(0.1, 5, 0.5),
+                static.polynomial_decay(0.1, 10),
+                static.piecewise_decay([2, 5], [0.1, 0.05, 0.01]),
+                static.cosine_decay(0.1, 2, 10),
+                static.linear_lr_warmup(0.1, 5, 0.0, 0.1)]
+
+    outs, _ = _run(build)
+    step = 1.0  # first run
+    assert abs(float(outs[1]) - 0.1 * math.exp(-0.5 * step / 5)) < 1e-6
+    assert abs(float(outs[2]) - 0.1 / (1 + 0.5 * step / 5)) < 1e-6
+    assert abs(float(outs[4]) - 0.1) < 1e-7          # step 1 < boundary 2
+    assert abs(float(outs[6]) - (0.1 / 5)) < 1e-6    # warmup step 1
+
+
+def test_loss_delegates_values():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 3).astype(np.float32)
+    y = rng.rand(4, 3).astype(np.float32)
+    logits = rng.randn(4, 3).astype(np.float32)
+    bin_lbl = rng.randint(0, 2, (4, 3)).astype(np.float32)
+
+    def build():
+        a = static.data("x", [4, 3])
+        b = static.data("y", [4, 3])
+        lg = static.data("lg", [4, 3])
+        bl = static.data("bl", [4, 3])
+        return [static.mse_loss(a, b), static.huber_loss(a, b, 0.5),
+                static.sigmoid_cross_entropy_with_logits(lg, bl),
+                static.kldiv_loss(a, b)]
+
+    outs, _ = _run(build, {"x": x, "y": y, "lg": logits, "bl": bin_lbl})
+    np.testing.assert_allclose(outs[0], np.mean((x - y) ** 2), rtol=1e-5)
+    want_ce = np.maximum(logits, 0) - logits * bin_lbl + \
+        np.log1p(np.exp(-np.abs(logits)))
+    np.testing.assert_allclose(outs[2], want_ce, rtol=1e-5, atol=1e-6)
+
+
+def test_sigmoid_focal_loss_down_weights_easy():
+    x = np.array([[5.0, -5.0], [-5.0, 5.0]], np.float32)   # confident
+    lbl = np.array([[1], [2]], np.int64)                   # correct
+
+    def build():
+        xv = static.data("x", [2, 2])
+        lv = static.data("l", [2, 1], dtype="int64")
+        return static.sigmoid_focal_loss(xv, lv)
+
+    outs, _ = _run(build, {"x": x, "l": lbl})
+    assert np.all(outs[0] < 0.01)      # easy correct -> tiny loss
+
+
+def test_detection_delegates():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+
+    def build():
+        f = static.data("f", [1, 8, 4, 4])
+        im = static.data("im", [1, 3, 64, 64])
+        boxes, var = static.prior_box(f, im, min_sizes=[16.0],
+                                      aspect_ratios=[1.0])
+        anchors, avar = static.anchor_generator(
+            f, anchor_sizes=[32.0], aspect_ratios=[1.0, 2.0])
+        a = static.data("ba", [3, 4])
+        b = static.data("bb", [2, 4])
+        iou = static.iou_similarity(a, b)
+        return [boxes, anchors, iou]
+
+    ba = np.array([[0, 0, 1, 1], [0, 0, 2, 2], [5, 5, 6, 6]], np.float32)
+    bb = np.array([[0, 0, 1, 1], [1, 1, 2, 2]], np.float32)
+    outs, _ = _run(build, {"f": feat, "im": img, "ba": ba, "bb": bb})
+    assert outs[0].shape[-1] == 4
+    assert outs[1].shape == (4, 4, 2, 4)
+    assert abs(outs[2][0, 0] - 1.0) < 1e-6     # identical boxes IoU=1
+
+
+def test_hash_range_auc():
+    ids = np.array([[1, 2], [3, 1]], np.int64)
+
+    def build():
+        iv = static.data("ids", [2, 2], dtype="int64")
+        h = static.hash(iv, hash_size=100, num_hash=2)
+        r = static.range(0, 10, 2, "int64")
+        p = static.data("p", [6, 2])
+        lbl = static.data("lbl", [6, 1], dtype="int64")
+        a = static.auc(p, lbl)
+        return [h, r, a]
+
+    p = np.stack([1 - np.array([.9, .8, .7, .3, .2, .1]),
+                  np.array([.9, .8, .7, .3, .2, .1])], 1).astype(np.float32)
+    lbl = np.array([[1], [1], [0], [1], [0], [0]], np.int64)
+    outs, _ = _run(build, {"ids": ids, "p": p, "lbl": lbl})
+    assert outs[0].shape == (2, 2, 2)
+    assert (outs[0] >= 0).all() and (outs[0] < 100).all()
+    # determinism: same id -> same hash
+    assert outs[0][0, 0, 0] == outs[0][1, 1, 0]
+    np.testing.assert_array_equal(outs[1], np.arange(0, 10, 2))
+    # manual AUC: pos ranks {6,5,2} of 6 -> (13 - 6)/ (3*3)
+    assert abs(float(outs[2]) - 8.0 / 9.0) < 1e-5
+
+
+def test_warpctc_loss_and_grads():
+    rng = np.random.RandomState(0)
+    B, T, C, L = 2, 8, 5, 3
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = np.array([[1, 2, 3], [2, 4, 0]], np.int64)
+    llen = np.array([3, 2], np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        lg = static.data("lg", [B, T, C])
+        lb = static.data("lb", [B, L], dtype="int64")
+        ll = static.data("ll", [B], dtype="int64")
+        loss = static.warpctc(lg, lb, blank=0, label_length=ll)
+        total = static.reduce_mean(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"lg": logits, "lb": labels, "ll": llen},
+                  fetch_list=[loss, total])
+    losses = np.asarray(out[0])
+    assert losses.shape == (B, 1) and (losses > 0).all()
+
+    import optax
+    import jax.numpy as jnp
+
+    tpos = np.arange(T)[None, :].repeat(B, 0)
+    want = optax.ctc_loss(jnp.asarray(logits),
+                          jnp.zeros((B, T), jnp.float32),
+                          jnp.asarray(labels),
+                          jnp.asarray((np.arange(L)[None, :] >=
+                                       llen[:, None]).astype(np.float32)),
+                          blank_id=0)
+    np.testing.assert_allclose(losses.ravel(), np.asarray(want), rtol=1e-4)
+
+
+def test_hsigmoid_trains():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 6, (16, 1)).astype(np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = static.data("x", [16, 8])
+        yv = static.data("y", [16, 1], dtype="int64")
+        loss = static.reduce_mean(static.hsigmoid(xv, yv, 6))
+        static.SGD(0.5).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    vals = [float(np.asarray(exe.run(main, feed={"x": x, "y": y},
+                                     fetch_list=[loss])[0]))
+            for _ in range(20)]
+    assert vals[-1] < vals[0] * 0.7, vals
+
+
+def test_dynamic_lstm_gru_match_numpy():
+    rng = np.random.RandomState(0)
+    B, T, H = 2, 5, 4
+    xl = rng.randn(B, T, 4 * H).astype(np.float32) * 0.5
+    xg = rng.randn(B, T, 3 * H).astype(np.float32) * 0.5
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xlv = static.data("xl", [B, T, 4 * H])
+        xgv = static.data("xg", [B, T, 3 * H])
+        hid, cell = static.dynamic_lstm(xlv, 4 * H)
+        gh = static.dynamic_gru(xgv, H)
+    exe = static.Executor()
+    exe.run(startup)
+    from paddle_tpu.static.executor import global_scope
+
+    hidv, cellv, ghv = [np.asarray(v) for v in exe.run(
+        main, feed={"xl": xl, "xg": xg}, fetch_list=[hid, cell, gh])]
+    # numpy LSTM reference with the trained-in (initialized) weights
+    wname = [n for n in main.global_block.vars
+             if n.startswith("dynamic_lstm_s_w")][0]
+    w = np.asarray(global_scope().find_var(wname))
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    for t in range(T):
+        g = xl[:, t] + h @ w
+        i, f, cand, o = (1 / (1 + np.exp(-g[:, :H])),
+                         1 / (1 + np.exp(-g[:, H:2 * H])),
+                         np.tanh(g[:, 2 * H:3 * H]),
+                         1 / (1 + np.exp(-g[:, 3 * H:])))
+        c = f * c + i * cand
+        h = o * np.tanh(c)
+    np.testing.assert_allclose(hidv[:, -1], h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cellv[:, -1], c, rtol=1e-4, atol=1e-5)
+    assert ghv.shape == (B, T, H)
+
+
+def test_dynamic_lstm_lengths_freeze():
+    B, T, H = 2, 6, 3
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, T, 4 * H).astype(np.float32)
+    lens = np.array([6, 2], np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = static.data("x", [B, T, 4 * H])
+        lv = static.data("lens", [B], dtype="int64")
+        hid, _ = static.dynamic_lstm(xv, 4 * H, lengths=lv)
+    exe = static.Executor()
+    exe.run(startup)
+    out = np.asarray(exe.run(main, feed={"x": x, "lens": lens},
+                             fetch_list=[hid])[0])
+    # row 1 freezes after t=2: all later steps equal h at t=1
+    np.testing.assert_allclose(out[1, 2:], np.broadcast_to(
+        out[1, 1], out[1, 2:].shape), atol=1e-6)
+
+
+def test_lstm_multilayer_and_units():
+    B, T, D, H = 2, 4, 6, 5
+    rng = np.random.RandomState(2)
+    x = rng.randn(B, T, D).astype(np.float32)
+    h0 = np.zeros((2, B, H), np.float32)
+    c0 = np.zeros((2, B, H), np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = static.data("x", [B, T, D])
+        hv = static.data("h0", [2, B, H])
+        cv = static.data("c0", [2, B, H])
+        out, lh, lc = static.lstm(xv, hv, cv, T, H, num_layers=2)
+        # single-step units
+        xu = static.data("xu", [B, 3 * H])
+        hu = static.data("hu", [B, H])
+        gh, _, _ = static.gru_unit(xu, hu, 3 * H)
+        xr = static.data("xr", [B, D])
+        cu = static.data("cu", [B, H])
+        uh, uc = static.lstm_unit(xr, hu, cu)
+    exe = static.Executor()
+    exe.run(startup)
+    outs = exe.run(main, feed={
+        "x": x, "h0": h0, "c0": c0,
+        "xu": rng.randn(B, 3 * H).astype(np.float32),
+        "hu": np.zeros((B, H), np.float32),
+        "xr": rng.randn(B, D).astype(np.float32),
+        "cu": np.zeros((B, H), np.float32)},
+        fetch_list=[out, gh, uh, uc])
+    assert np.asarray(outs[0]).shape == (B, T, H)
+    assert np.asarray(outs[1]).shape == (B, H)
+    assert np.asarray(outs[2]).shape == (B, H)
+
+
+def test_chunk_eval_iob():
+    from paddle_tpu.static import chunk_eval
+
+    # IOB, 2 types: tags B0=0 I0=1 B1=2 I1=3 O=4
+    label = np.array([[0, 1, 4, 2, 3, 4]])
+    pred = np.array([[0, 1, 4, 2, 4, 4]])   # second chunk truncated
+    p, r, f1, ni, nl, nc = chunk_eval(pred, label, "IOB", 2)
+    assert (ni, nl, nc) == (2, 2, 1)
+    assert abs(f1 - 0.5) < 1e-9
+
+
+def test_multi_box_head_shapes():
+    def build():
+        f1 = static.data("f1", [1, 8, 4, 4])
+        f2 = static.data("f2", [1, 8, 2, 2])
+        img = static.data("img", [1, 3, 64, 64])
+        locs, confs, boxes, vars_ = static.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=3,
+            aspect_ratios=[[1.0], [1.0, 2.0]], min_ratio=20, max_ratio=90)
+        return [locs, confs, boxes, vars_]
+
+    outs, _ = _run(build, {"f1": np.zeros((1, 8, 4, 4), np.float32),
+                           "f2": np.zeros((1, 8, 2, 2), np.float32),
+                           "img": np.zeros((1, 3, 64, 64), np.float32)})
+    P = outs[2].shape[0]
+    assert outs[0].shape == (1, P, 4)
+    assert outs[1].shape == (1, P, 3)
+    assert outs[3].shape == (P, 4)
+
+
+def test_yolov3_loss_trains():
+    rng = np.random.RandomState(0)
+    B, an, C, HW = 1, 2, 3, 4
+    anchors = [10, 14, 23, 27]
+    x = rng.randn(B, an * (5 + C), HW, HW).astype(np.float32) * 0.1
+    gt_box = np.array([[[0.4, 0.4, 0.2, 0.3]]], np.float32)
+    gt_label = np.array([[1]], np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = static.data("x", [B, an * (5 + C), HW, HW])
+        xv.desc.stop_gradient = False
+        gb = static.data("gb", [B, 1, 4])
+        gl = static.data("gl", [B, 1], dtype="int64")
+        h = static.nn.conv2d(xv, an * (5 + C), 1)
+        loss = static.reduce_mean(static.yolov3_loss(
+            h, gb, gl, anchors, [0, 1], C, 0.7, 8))
+        static.Adam(0.01).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    vals = [float(np.asarray(exe.run(
+        main, feed={"x": x, "gb": gt_box, "gl": gt_label},
+        fetch_list=[loss])[0])) for _ in range(40)]
+    assert vals[-1] < vals[0] * 0.7, vals
+    assert all(b <= a + 1e-4 for a, b in zip(vals, vals[1:])), vals
+
+
+def test_sequence_compat_ops():
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    lens = np.array([6, 3], np.int64)
+
+    def build():
+        xv = static.data("x", [2, 6])
+        lv = static.data("lens", [2], dtype="int64")
+        m = static.sequence_mask(lv, maxlen=6)
+        r = static.sequence_reshape(xv, 3)
+        return [m, r]
+
+    outs, _ = _run(build, {"x": x, "lens": lens})
+    np.testing.assert_array_equal(
+        outs[0], (np.arange(6)[None, :] < lens[:, None]).astype(np.int64))
+    assert outs[1].shape == (2, 2, 3)
+
+
+def test_nce_and_sampled_softmax_train():
+    rng = np.random.RandomState(0)
+    B, D, C = 16, 8, 20
+    x = rng.randn(B, D).astype(np.float32)
+    y = rng.randint(0, C, (B, 1)).astype(np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = static.data("x", [B, D])
+        yv = static.data("y", [B, 1], dtype="int64")
+        nce_loss = static.reduce_mean(static.nce(xv, yv, C))
+        logits = static.nn.fc(xv, C)
+        sce = static.reduce_mean(
+            static.sampled_softmax_with_cross_entropy(logits, yv, 5))
+        loss = static.elementwise_add(nce_loss, sce)
+        static.Adam(0.05).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    vals = [float(np.asarray(exe.run(main, feed={"x": x, "y": y},
+                                     fetch_list=[loss])[0]))
+            for _ in range(15)]
+    assert vals[-1] < vals[0], vals
