@@ -942,3 +942,42 @@ def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
         outputs={"Out": [out], "OutIndex": [idx]},
         attrs={"axis": int(axis), "use_stack": bool(use_stack)})
     return helper.block.var(out), helper.block.var(idx)
+
+
+# ---------------------------------------------------------------------------
+# extension-op registry (PEP 562 module __getattr__)
+#
+# layers_ext / layers_compat contribute the fluid.layers long tail. They
+# must NOT setattr into this module: several fluid ops share a name with a
+# Python builtin (`range`, `sum`, `pow`, `hash`, ...), and a module global
+# shadows the builtin for every bare use *inside this file* (globals are
+# consulted before builtins during name resolution). Attribute access from
+# outside (`layers.range`, `static.nn.sum`) instead resolves through
+# __getattr__, which only fires when normal lookup fails — so registered
+# names are visible to callers but can never leak into this module's
+# namespace.
+# ---------------------------------------------------------------------------
+_EXTRA_EXPORTS: Dict[str, Any] = {}
+
+
+def _register_exports(mapping: Dict[str, Any]) -> None:
+    """Expose extension ops as attributes of this module.
+
+    First registration wins; names already defined in this module are
+    never overridden."""
+    g = globals()
+    for name, value in mapping.items():
+        if name not in g and name not in _EXTRA_EXPORTS:
+            _EXTRA_EXPORTS[name] = value
+
+
+def __getattr__(name: str):
+    try:
+        return _EXTRA_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXTRA_EXPORTS))

@@ -1068,11 +1068,11 @@ __all__ = [n for n, v in list(globals().items())
 
 
 def _export_into_layers():
+    # registry, NOT setattr: a module global named `range`/`sum`/... would
+    # shadow the builtin for code inside layers.py (round-2 bug)
     from . import layers as _layers
 
-    for _n in __all__:
-        if not hasattr(_layers, _n):
-            setattr(_layers, _n, globals()[_n])
+    _layers._register_exports({_n: globals()[_n] for _n in __all__})
 
 
 _export_into_layers()

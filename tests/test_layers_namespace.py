@@ -1,0 +1,70 @@
+"""Namespace-hygiene regression tests for the fluid.layers surface.
+
+Round-2 shipped bug: layers_compat setattr'd its `range` op into the
+layers module, shadowing the Python builtin for every bare use inside
+static/layers.py and breaking `split(num_or_sections=int)`
+(layers.py `for _ in range(n)`). The fix routes extension exports
+through a PEP 562 module-__getattr__ registry (layers._EXTRA_EXPORTS),
+which is structurally unable to shadow builtins for code inside the
+module. These tests pin that contract.
+"""
+import ast
+import builtins
+import inspect
+
+from paddle_tpu.static import layers as L
+
+
+def test_registry_populated_and_not_module_globals():
+    assert L._EXTRA_EXPORTS, "extension registry should be non-empty"
+    mod_globals = vars(L)
+    for name in L._EXTRA_EXPORTS:
+        assert name not in mod_globals, (
+            f"extension op {name!r} leaked into layers module globals; "
+            "it must live only in _EXTRA_EXPORTS")
+
+
+def test_builtin_named_ops_accessible_but_not_globals():
+    for name in ("range", "sum", "pow", "hash"):
+        assert callable(getattr(L, name)), name
+        assert name in dir(L), name
+        assert name not in vars(L), (
+            f"{name!r} is a module global of layers.py — it shadows the "
+            "builtin for code inside that file")
+
+
+def test_first_registration_wins():
+    # ops defined in layers.py itself are never overridden by ext/compat
+    assert L.split is vars(L)["split"]
+    assert "split" not in L._EXTRA_EXPORTS
+
+
+def test_split_int_sections_uses_builtin_range():
+    # the concrete round-2 breakage: split with an int section count
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 6])
+        a, b, c = L.split(x, 3, dim=1)
+    assert tuple(a.shape) == (4, 2)
+
+
+def test_no_bare_use_of_builtin_named_module_globals():
+    """layers.py may define ops named like builtins (`abs`, `slice`) —
+    but then no code inside the file may reference those names bare,
+    because the module global wins over the builtin. Use ``builtins.X``
+    or jnp equivalents explicitly instead."""
+    shadowed = {n for n in vars(L)
+                if not n.startswith("_") and hasattr(builtins, n)
+                and callable(vars(L)[n])}
+    tree = ast.parse(inspect.getsource(L))
+    offenders = [
+        (node.id, node.lineno) for node in ast.walk(tree)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+        and node.id in shadowed
+    ]
+    assert not offenders, (
+        f"bare use of builtin-named module globals in layers.py: "
+        f"{offenders}; reference the builtin explicitly "
+        "(import builtins) or rename")
