@@ -17,15 +17,93 @@ first ``backends()`` call. These helpers contain that:
 """
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
+import tempfile
 import threading
+import time
 
 _lock = threading.Lock()
 _resolved: str | None = None
 
 _PROBE_SRC = "import jax; print(jax.default_backend())"
+
+#: default subprocess-probe timeout (seconds); a dead remote-TPU tunnel
+#: costs exactly this much once per cache TTL, not per invocation. 30 s
+#: covers remote-tunnel cold starts while staying far inside any driver
+#: budget (the old 75 s default ate most of it).
+PROBE_TIMEOUT = float(os.environ.get("PADDLE_TPU_PROBE_TIMEOUT", "30"))
+
+#: probe FAILURE verdicts are cached on disk for this long, so repeated
+#: CLI invocations against a dead tunnel don't each re-pay the timeout
+PROBE_CACHE_TTL = float(os.environ.get("PADDLE_TPU_PROBE_CACHE_TTL", "300"))
+
+#: SUCCESS verdicts are cached much shorter: acting on a stale "tpu is
+#: up" verdict skips the probe and lets the first in-process device touch
+#: hang on a tunnel that died in the meantime. A live tunnel re-probes
+#: cheaply; a dead one must be re-detected fast.
+PROBE_SUCCESS_TTL = float(
+    os.environ.get("PADDLE_TPU_PROBE_SUCCESS_TTL", "60"))
+
+
+def _probe_cache_path() -> str:
+    p = os.environ.get("PADDLE_TPU_PROBE_CACHE")
+    if p:
+        return p
+    return os.path.join(tempfile.gettempdir(),
+                        f"paddle_tpu_probe_{os.getuid()}.json")
+
+
+def _cache_relevant_env() -> dict:
+    """Identity of the probe: env vars that change the outcome plus the
+    interpreter (different venvs carry different PJRT plugins) — a cache
+    entry is only valid when all match."""
+    ident = {k: os.environ.get(k, "") for k in
+             ("JAX_PLATFORMS", "PJRT_DEVICE", "XLA_FLAGS", "TPU_NAME")}
+    ident["_executable"] = sys.executable
+    try:
+        import jax
+
+        ident["_jax"] = jax.__version__
+    except Exception:
+        ident["_jax"] = "?"
+    return ident
+
+
+def _read_probe_cache() -> str | None:
+    try:
+        with open(_probe_cache_path()) as f:
+            ent = json.load(f)
+        if ent.get("env") != _cache_relevant_env():
+            return None
+        plat = ent.get("platform")
+        if not isinstance(plat, str):
+            return None
+        ttl = PROBE_CACHE_TTL if plat == "" else min(
+            PROBE_CACHE_TTL, PROBE_SUCCESS_TTL)
+        age = time.time() - float(ent.get("time", 0))
+        if age < 0 or age > ttl:
+            return None
+        return plat
+    except Exception:
+        return None
+
+
+def _write_probe_cache(platform: str | None) -> None:
+    # "" encodes a failed probe: also cached, so a dead tunnel costs one
+    # timeout per TTL window instead of one per process
+    try:
+        path = _probe_cache_path()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"platform": platform if platform else "",
+                       "time": time.time(),
+                       "env": _cache_relevant_env()}, f)
+        os.replace(tmp, path)
+    except Exception:
+        pass
 
 #: Platform names that mean "a real TPU is on the other end". The axon
 #: remote plugin registers under its own name but fronts a TPU chip.
@@ -59,22 +137,36 @@ def backends_initialized() -> bool:
         return False
 
 
-def probe_backend(timeout: float = 75.0) -> str | None:
+def probe_backend(timeout: float | None = None,
+                  use_cache: bool = True) -> str | None:
     """Default-backend platform name, resolved in a subprocess.
 
-    Returns None when backend init raises or exceeds ``timeout`` —
-    never raises, never blocks this process past the timeout."""
+    Returns None when backend init raises or exceeds ``timeout``
+    (default :data:`PROBE_TIMEOUT`) — never raises, never blocks this
+    process past the timeout. Verdicts (including failures) are cached
+    on disk for :data:`PROBE_CACHE_TTL` seconds keyed on the
+    backend-relevant env vars, so repeat invocations skip the probe."""
+    if timeout is None:
+        timeout = PROBE_TIMEOUT
+    if use_cache:
+        cached = _read_probe_cache()
+        if cached is not None:
+            return cached or None  # "" = cached failure
     try:
         out = subprocess.run(
             [sys.executable, "-c", _PROBE_SRC],
             capture_output=True, text=True, timeout=timeout,
             env=dict(os.environ))
     except Exception:
+        _write_probe_cache(None)
         return None
     if out.returncode != 0:
+        _write_probe_cache(None)
         return None
     lines = out.stdout.strip().splitlines()
-    return lines[-1].strip() if lines else None
+    plat = lines[-1].strip() if lines else None
+    _write_probe_cache(plat)
+    return plat
 
 
 def force_cpu(n_devices: int | None = None) -> None:
@@ -108,7 +200,7 @@ def force_cpu(n_devices: int | None = None) -> None:
         pass
 
 
-def ensure_backend(timeout: float = 75.0) -> str:
+def ensure_backend(timeout: float | None = None) -> str:
     """Resolve a usable default backend, degrading to cpu.
 
     Call this before the first in-process device touch (model build,
@@ -123,6 +215,10 @@ def ensure_backend(timeout: float = 75.0) -> str:
 
             _resolved = jax.default_backend()
             return _resolved
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # pinned to cpu (tests, dryrun): no probe needed
+            _resolved = "cpu"
+            return _resolved
         plat = probe_backend(timeout)
         if plat is None:
             sys.stderr.write(
@@ -132,6 +228,24 @@ def ensure_backend(timeout: float = 75.0) -> str:
             plat = "cpu"
         _resolved = plat
         return plat
+
+
+def guard_first_touch() -> None:
+    """Inline guard for the library's own first device touch
+    (``to_tensor``, ``Place.jax_device``, mesh construction, ...): resolve
+    a usable backend before jax initializes one, so a broken plugin
+    degrades to cpu instead of hanging the calling thread. No-op (one
+    global read) after the first resolution."""
+    if _resolved is None:
+        ensure_backend()
+
+
+def safe_devices(platform: str | None = None):
+    """``jax.devices()`` behind the bring-up guard."""
+    guard_first_touch()
+    import jax
+
+    return jax.devices(platform) if platform else jax.devices()
 
 
 def default_platform() -> str:

@@ -8,7 +8,7 @@ pool degenerates to a device lookup.
 """
 from __future__ import annotations
 
-import jax
+from .bringup import safe_devices
 
 
 class Place:
@@ -33,10 +33,10 @@ class Place:
         return f"{type(self).__name__}({self.device_id})"
 
     def jax_device(self):
-        devs = [d for d in jax.devices() if _matches(d, self.device_type)]
+        devs = [d for d in safe_devices() if _matches(d, self.device_type)]
         if not devs:
             # CPU is always present as a fallback backend.
-            devs = jax.devices("cpu")
+            devs = safe_devices("cpu")
         return devs[self.device_id % len(devs)]
 
 
@@ -70,7 +70,7 @@ class XPUPlace(TPUPlace):
 
 def is_compiled_with_tpu() -> bool:
     try:
-        return any(_matches(d, "tpu") for d in jax.devices())
+        return any(_matches(d, "tpu") for d in safe_devices())
     except RuntimeError:
         return False
 
@@ -80,7 +80,7 @@ def is_compiled_with_cuda() -> bool:
 
 
 def get_device() -> str:
-    d = jax.devices()[0]
+    d = safe_devices()[0]
     return f"{d.platform}:{d.id}"
 
 
@@ -103,7 +103,7 @@ def set_device(device: str) -> Place:
 
 
 def device_count(device_type: str = "tpu") -> int:
-    return len([d for d in jax.devices() if _matches(d, device_type)]) or 1
+    return len([d for d in safe_devices() if _matches(d, device_type)]) or 1
 
 
 _default_place = [None]

@@ -42,7 +42,15 @@ def prng_impl() -> str:
 
 
 def make_key(seed: int):
-    """Create a PRNG key with the configured implementation."""
+    """Create a PRNG key with the configured implementation.
+
+    Key creation is the library's earliest device touch (parameter
+    initializers run before any user Tensor exists), so it goes through
+    the bring-up guard: a broken PJRT plugin degrades to cpu here
+    instead of hanging model construction."""
+    from .bringup import guard_first_touch
+
+    guard_first_touch()
     return jax.random.key(seed, impl=prng_impl())
 
 

@@ -14,6 +14,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import bringup
 from . import dtype as dtype_mod
 from . import tape as tape_mod
 
@@ -28,6 +29,7 @@ class Tensor:
                  name=None, persistable=False):
         if isinstance(value, Tensor):
             value = value._value
+        bringup.guard_first_touch()
         if not isinstance(value, jax.Array) or dtype is not None:
             np_dtype = dtype_mod.convert_dtype(dtype) if dtype is not None else None
             if np_dtype is None and not hasattr(value, "dtype"):

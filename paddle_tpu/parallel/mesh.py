@@ -16,6 +16,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..framework.bringup import safe_devices as _safe_devices
+
 _global_mesh: list = [None]
 
 AXES = ("dp", "pp", "tp", "sp", "ep")
@@ -29,7 +31,7 @@ def create_mesh(mesh_shape: Optional[Dict[str, int]] = None,
     DCN-reaching axes should be listed first (outermost) so XLA keeps
     high-traffic collectives on ICI.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else _safe_devices())
     mesh_shape = dict(mesh_shape or {})
     sized = {k: v for k, v in mesh_shape.items() if v and v > 1}
     total = int(np.prod(list(sized.values()))) if sized else 1

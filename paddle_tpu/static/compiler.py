@@ -18,6 +18,7 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..framework.bringup import safe_devices as _safe_devices
 from .ir import Program
 
 
@@ -63,7 +64,7 @@ class CompiledProgram:
         from ..parallel.mesh import create_mesh, get_mesh
         self._mesh = get_mesh()
         if self._mesh is None or "data" not in self._mesh.axis_names:
-            n = len(places) if places else len(jax.devices())
+            n = len(places) if places else len(_safe_devices())
             self._mesh = create_mesh({"data": n})
         return self
 

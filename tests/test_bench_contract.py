@@ -1,0 +1,219 @@
+"""Driver-contract regression tests for bench.py and backend bring-up.
+
+Rounds 1 and 2 both lost their perf evidence to the same failure mode: a
+dead remote-TPU tunnel (a PJRT plugin whose factory hangs) made
+`jax.devices()` block, the old 75 s probe burned most of the budget, and
+the degraded path then benched full-size BERT on CPU until the driver's
+`timeout` killed it (rc=124, nothing parseable). These tests simulate the
+dead tunnel with a sitecustomize-registered hanging PJRT factory and pin
+the contract: `python bench.py` must print a parseable JSON row quickly
+and exit 0 under ANY tunnel state.
+
+Reference posture being matched:
+/root/reference/paddle/fluid/platform/init.cc (InitDevices never fails
+the process), platform/dynload/dynamic_loader.cc (degrade on missing
+driver).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+# Registers a PJRT backend factory that blocks forever — the exact shape
+# of the dead-axon-tunnel hang. fail_quietly only covers *raising*
+# factories, so jax's first backends() call blocks on this one.
+SITECUSTOMIZE = """\
+import time
+
+
+def _install():
+    try:
+        from jax._src import xla_bridge as xb
+    except Exception:
+        return
+
+    def factory():
+        time.sleep(3600)
+
+    try:
+        xb.register_backend_factory("faketunnel", factory, priority=400)
+    except Exception:
+        pass
+
+
+_install()
+"""
+
+
+def _dead_tunnel_env(tmp_path, **extra):
+    site_dir = tmp_path / "site"
+    site_dir.mkdir(exist_ok=True)
+    (site_dir / "sitecustomize.py").write_text(SITECUSTOMIZE)
+    env = dict(os.environ)
+    # the hang must be reachable: drop the test suite's cpu pin
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = f"{site_dir}{os.pathsep}{env.get('PYTHONPATH', '')}"
+    env["PADDLE_TPU_PROBE_TIMEOUT"] = "5"
+    env["PADDLE_TPU_PROBE_CACHE"] = str(tmp_path / "probe_cache.json")
+    env.update(extra)
+    return env
+
+
+def _run_streaming(cmd, env, first_row_deadline, total_deadline):
+    """Run cmd; return (rc, lines, seconds_to_first_json_line)."""
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    lines, first_at = [], [None]
+    t0 = time.monotonic()
+
+    def reader():
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("{") and first_at[0] is None:
+                first_at[0] = time.monotonic() - t0
+            if line:
+                lines.append(line)
+
+    th = threading.Thread(target=reader, daemon=True)
+    th.start()
+    try:
+        rc = proc.wait(timeout=total_deadline)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        pytest.fail(f"bench.py exceeded {total_deadline}s; "
+                    f"captured lines: {lines}")
+    th.join(timeout=10)
+    assert first_at[0] is not None, f"no JSON line in output: {lines}"
+    assert first_at[0] < first_row_deadline, (
+        f"first JSON row took {first_at[0]:.1f}s "
+        f"(limit {first_row_deadline}s)")
+    return rc, lines, first_at[0]
+
+
+def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
+    """Dead tunnel + tiny overrides: a parseable row in <60 s, rc 0."""
+    env = _dead_tunnel_env(tmp_path, BENCH_LAYERS="1", BENCH_BATCH="2",
+                           BENCH_SEQ="16", BENCH_STEPS="1")
+    rc, lines, _ = _run_streaming(
+        [sys.executable, BENCH], env,
+        first_row_deadline=60, total_deadline=180)
+    assert rc == 0
+    rows = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    assert rows, lines
+    # the placeholder precedes the measurement; the LAST row is the one
+    # the driver parses and it must carry the headline metric
+    last = rows[-1]
+    assert last["metric"] == "bert_base_pretrain_tokens_per_sec_per_chip"
+    assert last["backend"] == "cpu"
+    assert last.get("comparable") is False
+    assert rows[0].get("placeholder") is True
+    # provenance: no driver-captured baseline exists yet, so no ratio
+    assert last.get("baseline_provenance") in ("none", None)
+
+
+@pytest.mark.slow
+def test_bench_default_invocation_with_dead_tunnel(tmp_path):
+    """The exact driver invocation (no env overrides): placeholder row in
+    <60 s, smoke-measured headline row last, rc 0 — un-timeout-able."""
+    env = _dead_tunnel_env(tmp_path)
+    rc, lines, first = _run_streaming(
+        [sys.executable, BENCH], env,
+        first_row_deadline=60, total_deadline=420)
+    assert rc == 0
+    rows = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    assert rows[0].get("placeholder") is True
+    last = rows[-1]
+    assert last["metric"] == "bert_base_pretrain_tokens_per_sec_per_chip"
+    assert last.get("placeholder") is None  # real smoke measurement
+    assert last["value"] > 0, last
+    assert last.get("degraded") is True
+
+
+@pytest.mark.parametrize("delay", [3, 15])
+def test_bench_sigterm_still_emits_row(tmp_path, delay):
+    """An external `timeout`-style SIGTERM still yields a parseable
+    final row and rc 0 (the rc=124 class is closed) — both during the
+    probe window (delay 3 < probe timeout 5) and mid-measurement."""
+    env = _dead_tunnel_env(tmp_path)
+    proc = subprocess.Popen([sys.executable, BENCH], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    time.sleep(delay)
+    proc.terminate()
+    try:
+        out, _ = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        pytest.fail("bench.py did not exit after SIGTERM")
+    assert proc.returncode == 0
+    rows = [json.loads(ln) for ln in out.strip().splitlines()
+            if ln.startswith("{")]
+    assert rows, out
+    assert all("metric" in r for r in rows)
+
+
+def test_probe_cache_skips_repeat_timeout(tmp_path):
+    """Second probe against a dead tunnel reads the cached failure
+    verdict instead of re-paying the subprocess timeout."""
+    env = _dead_tunnel_env(tmp_path, PADDLE_TPU_PROBE_TIMEOUT="4")
+    src = ("import time, paddle_tpu.framework.bringup as b;"
+           "t0=time.monotonic();"
+           "p=b.probe_backend();"
+           "print('P1', p, round(time.monotonic()-t0, 2))")
+    t0 = time.monotonic()
+    out1 = subprocess.run([sys.executable, "-c", src], env=env,
+                          capture_output=True, text=True, timeout=120)
+    dt1 = time.monotonic() - t0
+    assert "P1 None" in out1.stdout, (out1.stdout, out1.stderr)
+    assert dt1 > 3, "first probe should pay the timeout"
+    t0 = time.monotonic()
+    out2 = subprocess.run([sys.executable, "-c", src], env=env,
+                          capture_output=True, text=True, timeout=120)
+    dt2 = time.monotonic() - t0
+    assert "P1 None" in out2.stdout
+    assert dt2 < dt1, (dt1, dt2)
+    assert dt2 < 4, f"cached probe verdict should be instant, took {dt2}"
+
+
+def test_library_first_touch_degrades_not_hangs(tmp_path):
+    """VERDICT r2 weak #4: plain `import paddle_tpu; to_tensor(...)` with
+    a dead tunnel must fall back to cpu, not block forever."""
+    env = _dead_tunnel_env(tmp_path)
+    src = (
+        "import numpy as np, paddle_tpu as paddle\n"
+        "t = paddle.to_tensor(np.ones((2, 2), np.float32))\n"
+        "print('PLATFORM', t.value.devices().pop().platform)\n")
+    out = subprocess.run([sys.executable, "-c", src], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "PLATFORM cpu" in out.stdout
+
+
+@pytest.mark.slow
+def test_eager_train_step_degrades_not_hangs(tmp_path):
+    """Eager LeNet step end-to-end on the degraded backend."""
+    env = _dead_tunnel_env(tmp_path)
+    src = (
+        "import numpy as np, paddle_tpu as paddle\n"
+        "from paddle_tpu import nn, optimizer\n"
+        "from paddle_tpu.vision.models import LeNet\n"
+        "m = LeNet(num_classes=10)\n"
+        "opt = optimizer.Adam(learning_rate=1e-3,"
+        " parameters=m.parameters())\n"
+        "ce = nn.CrossEntropyLoss()\n"
+        "x = paddle.to_tensor(np.zeros((2, 1, 28, 28), np.float32))\n"
+        "y = paddle.to_tensor(np.zeros((2,), np.int64))\n"
+        "loss = ce(m(x), y); loss.backward(); opt.step()\n"
+        "print('LOSS', float(loss))\n")
+    out = subprocess.run([sys.executable, "-c", src], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "LOSS" in out.stdout
