@@ -52,8 +52,18 @@ def _probe_cache_path() -> str:
     p = os.environ.get("PADDLE_TPU_PROBE_CACHE")
     if p:
         return p
-    return os.path.join(tempfile.gettempdir(),
-                        f"paddle_tpu_probe_{os.getuid()}.json")
+    # a per-user 0700 cache dir, NOT a predictable world-writable /tmp
+    # name: the verdict steers backend selection, so another local user
+    # must not be able to plant one
+    try:
+        cache_root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".cache")
+        d = os.path.join(cache_root, "paddle_tpu")
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        return os.path.join(d, "probe.json")
+    except Exception:
+        return os.path.join(tempfile.gettempdir(),
+                            f"paddle_tpu_probe_{os.getuid()}.json")
 
 
 def _cache_relevant_env() -> dict:
@@ -74,7 +84,11 @@ def _cache_relevant_env() -> dict:
 
 def _read_probe_cache() -> str | None:
     try:
-        with open(_probe_cache_path()) as f:
+        path = _probe_cache_path()
+        st = os.stat(path, follow_symlinks=False)
+        if hasattr(os, "getuid") and st.st_uid != os.getuid():
+            return None  # not ours: don't trust it
+        with open(path) as f:
             ent = json.load(f)
         if ent.get("env") != _cache_relevant_env():
             return None

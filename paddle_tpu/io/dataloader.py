@@ -11,11 +11,13 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing
+import os
 import queue
 import sys
 import threading
 import time
 import traceback
+import uuid
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -313,6 +315,72 @@ def _tree_to_tensor(tree):
 
 _SHM_MIN_BYTES = 1 << 15  # below this, pipe pickling beats a shm segment
 
+#: every loader segment carries this prefix AND the pid of the CONSUMER
+#: (the process that will unpack and unlink it) so orphans are
+#: reclaimable: workers unregister segments from their resource_tracker
+#: (ownership transfers to the consumer), so a consumer SIGKILLed before
+#: unpacking leaves segments nothing owns (ADVICE r2) — the sweep below
+#: reclaims exactly the segments whose consumer is dead. Age alone is
+#: not a safe criterion: a prefetched batch can legitimately sit queued
+#: for many minutes under slow training steps.
+_SHM_PREFIX = f"ptu_shm_{os.getuid() if hasattr(os, 'getuid') else 0}_"
+_SHM_ORPHAN_AGE_SEC = 600.0
+
+
+def _shm_new_segment(nbytes: int):
+    from multiprocessing import shared_memory
+
+    # workers are children of the consumer, so getppid names it; in the
+    # (single-process shm) edge case the creator is the consumer itself
+    consumer = os.getppid() if get_worker_info() is not None else \
+        os.getpid()
+    for _ in range(8):
+        name = f"{_SHM_PREFIX}{consumer}_{uuid.uuid4().hex[:8]}"
+        try:
+            return shared_memory.SharedMemory(name=name, create=True,
+                                              size=nbytes)
+        except FileExistsError:
+            continue
+    return shared_memory.SharedMemory(create=True, size=nbytes)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # EPERM: exists but not ours — treat as alive
+
+
+def _sweep_orphan_segments(max_age: float = _SHM_ORPHAN_AGE_SEC) -> int:
+    """Unlink prefix-named segments whose consumer pid is dead.
+    Live consumers are never touched (their prefetched batches may be
+    arbitrarily old); unparseable names fall back to the age gate.
+    Returns the number reclaimed."""
+    shm_dir = "/dev/shm"
+    if not os.path.isdir(shm_dir):
+        return 0
+    reclaimed = 0
+    now = time.time()
+    for fn in os.listdir(shm_dir):
+        if not fn.startswith(_SHM_PREFIX):
+            continue
+        path = os.path.join(shm_dir, fn)
+        try:
+            pid_part = fn[len(_SHM_PREFIX):].split("_", 1)[0]
+            if pid_part.isdigit():
+                dead = not _pid_alive(int(pid_part))
+            else:
+                dead = now - os.stat(path).st_mtime > max_age
+            if dead:
+                os.unlink(path)
+                reclaimed += 1
+        except OSError:
+            pass
+    return reclaimed
+
 
 def _shm_pack(tree):
     """Move large ndarray leaves into shared-memory segments so batches
@@ -327,7 +395,7 @@ def _shm_pack(tree):
         return {k: _shm_pack(v) for k, v in tree.items()}
     if isinstance(tree, np.ndarray) and tree.nbytes >= _SHM_MIN_BYTES:
         try:
-            seg = shared_memory.SharedMemory(create=True, size=tree.nbytes)
+            seg = _shm_new_segment(tree.nbytes)
         except OSError:  # no /dev/shm: fall back to pipe transport
             return tree
         # count=: the OS may round the mapping up to a page multiple
@@ -441,9 +509,27 @@ class _MultiprocessIter:
             if self.is_iterable:
                 loader._needs_spawn = False
             else:
+                from ..framework.bringup import backends_initialized
+
                 try:
-                    loader._needs_spawn = _contains_tensor(
-                        loader.dataset[0])
+                    jax_live_before = backends_initialized()
+                    sample = loader.dataset[0]
+                    probe = sample
+                    if loader.collate_fn is not default_collate_fn:
+                        # a user collate_fn runs worker-side and may
+                        # build jax-backed Tensors the raw sample can't
+                        # show (ADVICE r2): probe its output too. A
+                        # blanket spawn would break local-closure
+                        # collate fns (spawn pickles Process args).
+                        probe = (sample, loader.collate_fn([sample]))
+                    needs = _contains_tensor(probe)
+                    if not needs and not jax_live_before and \
+                            backends_initialized():
+                        # the probe itself initialized jax in the parent
+                        # (e.g. collate uses jnp but returns numpy):
+                        # forking now IS the hazard — spawn
+                        needs = True
+                    loader._needs_spawn = needs
                 except Exception:
                     loader._needs_spawn = False
         if ctx_name == "fork" and loader._needs_spawn:
@@ -462,6 +548,8 @@ class _MultiprocessIter:
         # None so workers use the jax-free _np_collate
         collate = (None if loader.collate_fn is default_collate_fn
                    else loader.collate_fn)
+        if loader.use_shared_memory:
+            _sweep_orphan_segments()  # reclaim segments from dead runs
         self.workers = []
         for wid in range(n):
             w = self.ctx.Process(

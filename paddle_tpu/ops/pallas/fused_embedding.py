@@ -48,8 +48,10 @@ def _bag_kernel(ids_ref, table_row_ref, out_ref, cnt_ref, *, seq, combiner):
         cnt_ref[0] = 0.0
 
     idx = ids_ref[b * seq + s]
-    valid = (idx >= 0).astype(out_ref.dtype)
-    out_ref[...] += valid * table_row_ref[...]
+    valid = (idx >= 0).astype(jnp.float32)
+    # accumulate in f32 regardless of table dtype: bf16 += over long
+    # bags loses low bits and diverges from the XLA fallback (ADVICE r2)
+    out_ref[...] += valid * table_row_ref[...].astype(jnp.float32)
     cnt_ref[0] += valid
 
     if combiner in ("mean", "sqrtn"):
@@ -84,11 +86,13 @@ def _bag_pallas(table, ids, combiner):
         scratch_shapes=[pltpu.SMEM((1,), jnp.float32)],
     )
     kernel = functools.partial(_bag_kernel, seq=s, combiner=combiner)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, d), table.dtype),
+        # f32 accumulator output; cast back to the table dtype at the end
+        out_shape=jax.ShapeDtypeStruct((b, d), jnp.float32),
     )(ids.reshape(-1).astype(jnp.int32), table)
+    return out.astype(table.dtype)
 
 
 def _eligible(table, ids):

@@ -266,7 +266,14 @@ def nms(boxes, scores, iou_threshold=0.3, score_threshold=None, top_k=None,
 
 
 @jax.jit
-def _nms_mask(boxes, scores, iou_threshold, score_threshold, category_idxs):
+def _nms_mask(boxes, scores, iou_threshold, score_threshold, category_idxs,
+              nms_eta=1.0):
+    """Greedy NMS as a keep-mask over score-sorted order.
+
+    Visits boxes best-first; box j survives iff no already-kept earlier
+    box overlaps it above the threshold. `nms_eta < 1` adaptively lowers
+    the threshold after each kept box while it stays above 0.5
+    (multiclass_nms_op.cc NMSFast adaptive_threshold loop)."""
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
     b = boxes[order]
@@ -277,13 +284,20 @@ def _nms_mask(boxes, scores, iou_threshold, score_threshold, category_idxs):
         same = cats[:, None] == cats[None, :]
         iou = jnp.where(same, iou, 0.0)   # only same-class suppression
 
-    def body(i, keep):
-        # i suppresses j>i iff i itself is kept
-        sup = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
-        return keep & ~sup
+    idx = jnp.arange(n)
+    eta = jnp.asarray(nms_eta, jnp.float32)
+
+    def body(j, state):
+        keep, thr = state
+        sup = jnp.any((iou[:, j] > thr) & (idx < j) & keep)
+        kj = keep[j] & ~sup
+        keep = keep.at[j].set(kj)
+        thr = jnp.where(kj & (eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return keep, thr
 
     keep0 = s > score_threshold
-    keep = jax.lax.fori_loop(0, n, body, keep0)
+    keep, _ = jax.lax.fori_loop(
+        0, n, body, (keep0, jnp.asarray(iou_threshold, jnp.float32)))
     return keep, order
 
 
@@ -414,12 +428,12 @@ def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
 
 
 def _per_class_nms_masks(boxes, scores, iou_threshold, score_threshold,
-                         nms_top_k):
+                         nms_top_k, nms_eta=1.0):
     """vmapped greedy NMS over classes. boxes (M, 4), scores (C, M) ->
     keep (C, M) over score-sorted order, order (C, M)."""
     def one(s):
         keep, order = _nms_mask(boxes, s, iou_threshold, score_threshold,
-                                None)
+                                None, nms_eta)
         if nms_top_k > 0:
             keep = keep & (jnp.arange(s.shape[0]) < nms_top_k)
         return keep, order
@@ -454,7 +468,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=-1,
             sc = sc.at[background_label].set(-jnp.inf)
         keep, order = _per_class_nms_masks(
             boxes, sc, float(nms_threshold), float(score_threshold),
-            int(nms_top_k))
+            int(nms_top_k), float(nms_eta))
         s_sorted = jnp.take_along_axis(sc, order, axis=1)     # (C, M)
         flat = jnp.where(keep, s_sorted, -jnp.inf).ravel()    # (C*M,)
         vals, idx = jax.lax.top_k(flat, keep_k)
@@ -524,7 +538,8 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
         comp = jnp.max(ious, axis=1)                 # (K,) per box as i
         comp_j = comp[None, :]                       # broadcast as suppressor
         if use_gaussian:
-            d = jnp.exp(-(iou ** 2 - comp_j ** 2) / gaussian_sigma)
+            # matrix_nms_op.cc decay_score<T,true>: sigma multiplies
+            d = jnp.exp((comp_j ** 2 - iou ** 2) * gaussian_sigma)
         else:
             d = (1.0 - iou) / jnp.maximum(1.0 - comp_j, 1e-10)
         decay = jnp.min(jnp.where(applicable, d, 1.0), axis=1)

@@ -382,3 +382,68 @@ def test_ssd_forward_flow_trains():
         opt.clear_grad()
         losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_matrix_nms_gaussian_matches_reference_formula():
+    """Gaussian decay must follow matrix_nms_op.cc decay_score<T,true>:
+    exp((max_iou^2 - iou^2) * sigma) — sigma MULTIPLIES (ADVICE r2)."""
+    rng = np.random.RandomState(0)
+    base = rng.rand(6, 2) * 40
+    boxes = np.concatenate([base, base + 8 + rng.rand(6, 2) * 8],
+                           axis=1).astype(np.float32)
+    scores = rng.rand(1, 2, 6).astype(np.float32)
+    scores[0, 0] = 0  # background
+    sigma = 2.0
+    out, counts = vops.matrix_nms(boxes[None], scores, score_threshold=0.0,
+                                  post_threshold=0.0, use_gaussian=True,
+                                  gaussian_sigma=sigma, keep_top_k=6,
+                                  nms_top_k=6)
+    out = np.asarray(out.numpy())
+
+    # numpy transliteration of NMSMatrix<T, true>
+    def iou(a, b):
+        x0 = max(a[0], b[0]); y0 = max(a[1], b[1])
+        x1 = min(a[2], b[2]); y1 = min(a[3], b[3])
+        inter = max(0.0, x1 - x0) * max(0.0, y1 - y0)
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    s = scores[0, 1]
+    perm = np.argsort(-s)
+    expect = {}
+    ious = np.zeros((6, 6))
+    for i in range(6):
+        for j in range(6):
+            ious[i, j] = iou(boxes[perm[i]], boxes[perm[j]])
+    iou_max = [0.0]
+    expect[perm[0]] = s[perm[0]]
+    for i in range(1, 6):
+        iou_max.append(max(ious[i, j] for j in range(i)))
+        decay = min(np.exp((iou_max[j] ** 2 - ious[i, j] ** 2) * sigma)
+                    for j in range(i))
+        expect[perm[i]] = s[perm[i]] * decay
+
+    got = sorted(round(float(r[1]), 5) for r in out)
+    want = sorted(round(float(v), 5) for v in expect.values())
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_multiclass_nms_eta_adapts_threshold():
+    """nms_eta < 1 lowers the IoU threshold after each kept box
+    (multiclass_nms_op.cc NMSFast): a pair that survives at eta=1 is
+    suppressed once the threshold decays below its overlap."""
+    # IoU(A, B) ~ 0.6; threshold 0.9 keeps both at eta=1
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 16],
+                      [40, 40, 50, 50]], np.float32)[None]
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out1, c1 = vops.multiclass_nms(boxes, scores, score_threshold=0.1,
+                                   nms_threshold=0.9, nms_eta=1.0)
+    assert int(c1.numpy()[0]) == 3
+    # eta=0.5: after keeping A the threshold drops 0.9 -> 0.45 < 0.6
+    out2, c2 = vops.multiclass_nms(boxes, scores, score_threshold=0.1,
+                                   nms_threshold=0.9, nms_eta=0.5)
+    assert int(c2.numpy()[0]) == 2
+    kept_scores = sorted(np.asarray(out2.numpy())[:, 1])
+    np.testing.assert_allclose(kept_scores, [0.7, 0.9], atol=1e-6)
