@@ -76,3 +76,8 @@ define_flag("tracer_profile_fname", "", "Eager tracer profile output path")
 define_flag("sp_fallback_warn", True,
             "Warn when sequence-parallel (ring/Ulysses) attention falls "
             "back to the replicated local path — a silent perf cliff")
+define_flag("sp_mask_fallback", False,
+            "Allow query-dependent attention masks the ring cannot "
+            "decompose to fall back to replicated XLA attention instead "
+            "of raising (causal + key-padding masks never need this: "
+            "they ride the ring natively)")

@@ -592,14 +592,59 @@ def _as_kv_padding_mask(mask, b, lk):
     return None
 
 
+#: decomposition inspects the whole mask host-side; above this many
+#: elements the transfer+compare costs more than it saves — tell the
+#: user to pass the decomposed form instead
+_DECOMPOSE_MAX_ELEMS = 1 << 26
+
+
+def _decompose_concrete_mask(mask, b, lq, lk):
+    """Factor a CONCRETE (non-traced) boolean query-dependent mask into
+    ring-ridable parts: returns ``(kv_mask, add_causal)`` when
+    ``mask == bottom-right-tril & key_padding`` (the standard causal +
+    padding training mask) or ``mask`` is constant over the query axis
+    (pure padding in query-dependent clothing); None otherwise.
+
+    Eager-path only, by construction: a traced mask (any mask passed as
+    an argument through jit, e.g. via TrainStep) has no inspectable
+    values. Jitted training code should pass ``is_causal=True`` plus a
+    (B, Lk) padding mask — that form rides the ring natively under jit,
+    no decomposition needed. Very large masks are also skipped: the
+    host-side verify is linear in the mask but the transfer alone
+    defeats the purpose at ring-attention scale."""
+    import numpy as np
+
+    if mask is None or isinstance(mask, jax.core.Tracer):
+        return None
+    size = getattr(mask, "size", None)
+    if isinstance(size, int) and size > _DECOMPOSE_MAX_ELEMS:
+        return None
+    m = np.asarray(mask)
+    if m.dtype != np.bool_:
+        return None
+    if m.ndim == 4 and m.shape[:2] == (b, 1):
+        m = m[:, 0]
+    if m.shape != (b, lq, lk):
+        return None
+    pad = m.any(axis=1)                                   # (b, lk)
+    if (m == pad[:, None, :]).all():
+        return jnp.asarray(pad), False
+    tril = np.tril(np.ones((lq, lk), np.bool_), k=lk - lq)
+    if (m == (tril[None] & pad[:, None, :])).all():
+        return jnp.asarray(pad), True
+    return None
+
+
 def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
                                 is_causal=False, key_rng=None):
     if dropout_p == 0.0:
         # context parallelism: shard the sequence axis over the mesh
         # (ring / Ulysses attention) when a sequence_parallel() scope is
-        # on. Key-padding masks ride the ring at block granularity;
-        # query-dependent masks fall back (logged via
-        # FLAGS_sp_fallback_warn).
+        # on. Key-padding masks ride the ring at block granularity, and
+        # concrete causal+padding masks are decomposed onto the native
+        # ring path (eager only — traced masks have no values); masks
+        # the ring cannot carry raise unless FLAGS_sp_mask_fallback
+        # opts into replicated attention.
         from ...parallel.ring import (_log_sp_fallback,
                                       active_sequence_parallel,
                                       ring_attention)
@@ -608,12 +653,34 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
         if sp is not None:
             axis, impl, batch_axis, mesh = sp
             kv_mask = _as_kv_padding_mask(mask, q.shape[0], k.shape[1])
+            ride_causal = is_causal
+            if mask is not None and kv_mask is None:
+                dec = _decompose_concrete_mask(
+                    mask, q.shape[0], q.shape[1], k.shape[1])
+                if dec is not None:
+                    kv_mask, add_causal = dec
+                    ride_causal = is_causal or add_causal
             if mask is None or kv_mask is not None:
                 return ring_attention(q, k, v, mesh=mesh, seq_axis=axis,
                                       batch_axis=batch_axis,
-                                      is_causal=is_causal, impl=impl,
+                                      is_causal=ride_causal, impl=impl,
                                       kv_mask=kv_mask)
-            _log_sp_fallback("query-dependent attention mask")
+            from ...framework.flags import get_flag
+
+            if not get_flag("sp_mask_fallback"):
+                raise ValueError(
+                    "sequence_parallel attention received a "
+                    "query-dependent mask it cannot ride the ring with. "
+                    "Pass is_causal=True plus a (B, L) key-padding mask "
+                    "instead (that form runs natively, including "
+                    "combined, and works under jit — full (B, 1, Lq, "
+                    "Lk) masks can only be decomposed eagerly, never "
+                    "inside jit where values are traced). Or set "
+                    "FLAGS_sp_mask_fallback=True to accept replicated "
+                    "XLA attention for this mask (a per-device memory "
+                    "and compute cliff).")
+            _log_sp_fallback("query-dependent attention mask "
+                             "(FLAGS_sp_mask_fallback=True)")
         elif mask is None:
             return _local_attention(q, k, v, is_causal)
     if (mask is None and dropout_p > 0.0 and key_rng is not None and

@@ -8,7 +8,7 @@ from .sharding import (  # noqa: F401
     shard_params, place_params, spec_for, TRANSFORMER_TP_RULES,
 )
 from .pipeline import (  # noqa: F401
-    pipeline_apply, stack_stage_params,
+    pipeline_apply, pipeline_1f1b_value_and_grad, stack_stage_params,
 )
 from .ring import (  # noqa: F401
     ring_attention, ulysses_attention, ring_attention_local,

@@ -13,10 +13,17 @@ ICI. Reverse-mode AD through the scan gives the backward pipeline for
 free, so a pjit-ed training step differentiates straight through
 `pipeline_apply`.
 
-Schedule: classic GPipe. With S stages and M microbatches there are
-S+M-1 ticks; at tick t, stage s computes microbatch (t-s) when
-0 <= t-s < M (everything else is masked compute — the SPMD trade for
-having no data-dependent control flow).
+Two schedules:
+
+- :func:`pipeline_apply` — classic GPipe forward; AD through the scan
+  gives the backward. With S stages and M microbatches there are S+M-1
+  ticks; at tick t, stage s computes microbatch (t-s) when
+  0 <= t-s < M (everything else is masked compute — the SPMD trade for
+  having no data-dependent control flow).
+- :func:`pipeline_1f1b_value_and_grad` — 1F1B (PipeDream-flush) with
+  per-stage activation recomputation and embedding/head *inside* the
+  pipeline; backward for a microbatch starts as soon as its cotangent
+  can arrive, bounding the activation stash at 2S-1 instead of M.
 """
 from __future__ import annotations
 
@@ -124,3 +131,247 @@ def stack_stage_params(per_stage_params):
     leading num_stages axis, ready for pipeline_apply."""
     return jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (PipeDream-flush) with activation recomputation
+# ---------------------------------------------------------------------------
+
+
+def pipeline_1f1b_value_and_grad(stage_fn: Callable, first_fn: Callable,
+                                 last_fn: Callable, params, x, y, *,
+                                 mesh: Optional[Mesh] = None,
+                                 axis: str = "pp",
+                                 num_microbatches: Optional[int] = None,
+                                 batch_axis: str = "dp"):
+    """One pipeline-parallel training step on the 1F1B schedule.
+
+    Differences from :func:`pipeline_apply` + AD (the GPipe path):
+
+    - **embedding and head live INSIDE the pipeline**: ``first_fn``
+      (params_first, x_mb) -> h runs on stage 0 per microbatch and
+      ``last_fn`` (params_last, h, y_mb) -> scalar mean loss on the last
+      stage per microbatch, each behind a ``lax.cond`` so only the owning
+      stage pays their FLOPs. The GPipe path needs them outside, applied
+      to the full batch (pipeline.py:37-44 in round 2).
+    - **1F1B ordering with activation recomputation**: each schedule tick
+      carries one forward slot and one backward slot. Stage ``s`` runs
+      backward for microbatch ``m`` at tick ``2(S-1)-s+m`` — as early as
+      its cotangent can arrive — so at most ``2(S-1)+1`` stashed
+      activations exist per stage regardless of M (GPipe-through-AD
+      stashes all M). The stash holds only each stage's *input* block;
+      the stage forward is recomputed inside the backward slot
+      (Megatron-style remat — SURVEY's trade-FLOPs-for-HBM rule), which
+      is what lets M grow to amortise the bubble without OOM.
+
+    The schedule is still ONE compiled SPMD program: a ``lax.scan`` over
+    ``M + 2(S-1)`` ticks inside ``shard_map``; activations hop forward
+    and cotangents hop backward with ``lax.ppermute`` each tick.
+
+    stage_fn: (one layer's params, h) -> h; ``params["blocks"]`` is a
+    pytree stacked over a leading num_layers axis (num_layers % S == 0).
+    params: dict(first=..., blocks=..., last=...). Returns
+    ``(loss, grads)`` with grads matching ``params``' structure; loss is
+    the mean over microbatches of ``last_fn``'s per-microbatch mean.
+
+    Reference semantics matched: section_worker.cc:111-172 micro-batch
+    loop (fill-drain pipeline with per-microbatch backward); schedule
+    upgraded from its round-2 GPipe form per VERDICT r2 item 4.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return _sequential_value_and_grad(stage_fn, first_fn, last_fn,
+                                          params, x, y,
+                                          num_microbatches or 1)
+
+    n_stages = mesh.shape[axis]
+    n_layers = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
+    if n_layers % n_stages != 0:
+        raise ValueError(
+            f"stacked layer count {n_layers} not divisible by pipeline "
+            f"stages {n_stages} (axis '{axis}')")
+    mb = num_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % mb != 0:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"num_microbatches {mb}")
+    xm = x.reshape(mb, batch // mb, *x.shape[1:])
+    ym = y.reshape(mb, batch // mb, *y.shape[1:])
+
+    ba = batch_axis if (batch_axis in mesh.axis_names and batch_axis != axis
+                        and (batch // mb) % mesh.shape[batch_axis] == 0) \
+        else None
+    data_spec = PartitionSpec(None, ba)
+    blocks_spec = PartitionSpec(axis)
+    repl_spec = PartitionSpec()
+
+    send_perm = [(i, i + 1) for i in range(n_stages - 1)]
+    back_perm = [(i + 1, i) for i in range(n_stages - 1)]
+
+    def local(p_first, p_blocks, p_last, xm, ym):
+        s = jax.lax.axis_index(axis)
+        S, M = n_stages, mb
+        ticks = M + 2 * (S - 1)
+        depth = 2 * (S - 1) + 1     # max stash lifetime + 1
+
+        def run_blocks(pb, h):
+            def one(h, p):
+                return stage_fn(p, h), None
+            out, _ = jax.lax.scan(one, h, pb)
+            return out
+
+        # probe the hidden shape via eval_shape (first_fn decides it)
+        h_struct = jax.eval_shape(first_fn, p_first, xm[0])
+
+        want_axes = (axis,) + ((ba,) if ba else ())
+
+        def vary(t):
+            """Mark a tree as varying over the pp (and dp, when the data
+            rides it) axes: cond branches and scan carries must agree on
+            shard_map's varying-axes type, and stage-local values
+            genuinely differ per rank. Already-varying axes pass through
+            (pcast rejects re-casting them)."""
+            def one(a):
+                have = set(getattr(jax.typeof(a), "vma", ()))
+                need = tuple(ax for ax in want_axes if ax not in have)
+                return jax.lax.pcast(a, need, to="varying") if need else a
+            return jax.tree_util.tree_map(one, t)
+
+        zero_h = vary(jnp.zeros(h_struct.shape, h_struct.dtype))
+        # losses and their cotangent seeds stay f32: under bf16
+        # activations an M-term bf16 accumulation (and a rounded 1/M
+        # seed) would scale every gradient away from the sequential
+        # reference; only the h traffic needs the hidden dtype
+        zero_s = vary(jnp.zeros((), jnp.float32))
+
+        # CRITICAL: all of local_fwd's inputs are re-typed varying HERE,
+        # outside every lax.cond. pcast's transpose is a psum, and
+        # local_fwd is vjp'd inside a cond whose predicate differs per
+        # stage — a collective materialised inside those branches
+        # deadlocks the SPMD program (devices rendezvous at different
+        # collectives). With fully-varying inputs the vjp is purely
+        # device-local; the only collectives are the per-tick ppermutes
+        # and the final psums, all unconditional.
+        p_first_v, p_blocks_v, p_last_v, xm_v, ym_v = vary(
+            (p_first, p_blocks, p_last, xm, ym))
+
+        def local_fwd(p_first, p_blocks, p_last, h_in, m_idx):
+            """Uniform per-stage forward: (h_out, mb mean loss).
+            Stage roles are lax.cond'ed so only stage 0 pays first_fn
+            and only stage S-1 pays last_fn."""
+            x_m = jax.lax.dynamic_index_in_dim(xm_v, m_idx, 0, False)
+            y_m = jax.lax.dynamic_index_in_dim(ym_v, m_idx, 0, False)
+            inp = jax.lax.cond(
+                s == 0,
+                lambda: first_fn(p_first, x_m).astype(h_struct.dtype),
+                lambda: h_in)
+            mid = run_blocks(p_blocks, inp)
+            loss = jax.lax.cond(
+                s == S - 1,
+                lambda: last_fn(p_last, mid, y_m).astype(jnp.float32),
+                lambda: zero_s)
+            return mid, loss
+
+        gz = vary(jax.tree_util.tree_map(
+            jnp.zeros_like, (p_first, p_blocks, p_last)))
+
+        def tick(carry, t):
+            recv_h, recv_ct, stash, g_acc, loss_acc = carry
+
+            # ---- forward slot: stage s runs microbatch t - s
+            fm = t - s
+            f_on = (fm >= 0) & (fm < M)
+            fm_c = jnp.clip(fm, 0, M - 1)
+            h_out, f_loss = jax.lax.cond(
+                f_on,
+                lambda: local_fwd(p_first_v, p_blocks_v, p_last_v, recv_h,
+                                  fm_c),
+                lambda: (zero_h, zero_s))
+            # stash this stage's INPUT for the remat backward
+            slot_f = jnp.mod(fm_c, depth)
+            stash = jnp.where(
+                f_on,
+                jax.lax.dynamic_update_index_in_dim(stash, recv_h, slot_f,
+                                                    0),
+                stash)
+            loss_acc = loss_acc + jnp.where(f_on & (s == S - 1),
+                                            f_loss / M, 0.0)
+
+            # ---- backward slot: stage s runs microbatch t - (2(S-1)-s)
+            bm = t - (2 * (S - 1) - s)
+            b_on = (bm >= 0) & (bm < M)
+            bm_c = jnp.clip(bm, 0, M - 1)
+            h_saved = jax.lax.dynamic_index_in_dim(
+                stash, jnp.mod(bm_c, depth), 0, False)
+
+            def bwd():
+                _, vjp_fn = jax.vjp(
+                    lambda a, b, c, h: local_fwd(a, b, c, h, bm_c),
+                    p_first_v, p_blocks_v, p_last_v, h_saved)
+                # the last stage seeds the loss cotangent (1/M for the
+                # microbatch mean); everyone else seeds the arriving h ct
+                loss_seed = vary(jnp.where(s == S - 1, 1.0 / M, 0.0)
+                                 .astype(jnp.float32))
+                gf, gb, gl, ct_h = vjp_fn((recv_ct, loss_seed))
+                return (gf, gb, gl), ct_h
+
+            grads_t, ct_out = jax.lax.cond(
+                b_on, bwd, lambda: (gz, zero_h))
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads_t)
+
+            # ---- hops (unconditional: collectives stay outside cond)
+            recv_h = jax.lax.ppermute(h_out, axis, send_perm)
+            recv_ct = jax.lax.ppermute(ct_out, axis, back_perm)
+            return (recv_h, recv_ct, stash, g_acc, loss_acc), None
+
+        # vary()-typed carries: scan carry types must match the varying
+        # outputs of the tick body
+        stash0 = vary(jnp.zeros((depth,) + zero_h.shape, zero_h.dtype))
+        (_, _, _, g_acc, loss_acc), _ = jax.lax.scan(
+            tick, (zero_h, zero_h, stash0, gz, zero_s), jnp.arange(ticks))
+
+        gf, gb, gl = g_acc
+        # first/last grads + loss live on one stage each: psum replicates
+        loss = jax.lax.psum(loss_acc, axis)
+        gf = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, axis), gf)
+        gl = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, axis), gl)
+        if ba is not None:
+            loss = jax.lax.pmean(loss, ba)
+            gf, gb, gl = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, ba), (gf, gb, gl))
+        return loss, gf, gb, gl
+
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(repl_spec, blocks_spec, repl_spec, data_spec, data_spec),
+        out_specs=(repl_spec, repl_spec, blocks_spec, repl_spec))
+    # always run compiled: the schedule only makes sense as one SPMD
+    # program (jax's eager shard_map interpreter executes tick by tick);
+    # inside an outer jit this inlines, outside it compiles once per
+    # shape thanks to jit's global trace cache
+    loss, gf, gb, gl = jax.jit(sharded)(
+        params["first"], params["blocks"], params["last"], xm, ym)
+    return loss, {"first": gf, "blocks": gb, "last": gl}
+
+
+def _sequential_value_and_grad(stage_fn, first_fn, last_fn, params, x, y,
+                               mb):
+    """Single-device reference semantics for the 1F1B step (also the
+    degenerate no-pp-axis path): microbatched loss mean + plain AD."""
+    def loss_fn(params):
+        xm = x.reshape(mb, x.shape[0] // mb, *x.shape[1:])
+        ym = y.reshape(mb, y.shape[0] // mb, *y.shape[1:])
+
+        def one(acc, xy):
+            x_m, y_m = xy
+            h = first_fn(params["first"], x_m)
+
+            def layer(h, p):
+                return stage_fn(p, h), None
+            h, _ = jax.lax.scan(layer, h, params["blocks"])
+            return acc + last_fn(params["last"], h, y_m) / mb, None
+
+        total, _ = jax.lax.scan(one, jnp.zeros(()), (xm, ym))
+        return total
+
+    return jax.value_and_grad(loss_fn)(params)

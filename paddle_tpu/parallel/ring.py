@@ -111,9 +111,32 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
         acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
         return m_new, l, acc
 
+    def guarded_update(s, m, l, acc, kc, vc, mc):
+        """block_update behind a lax.cond that skips whole KV blocks:
+        causal blocks entirely above the diagonal (the ~2x win at long
+        sequence — the classic ring walk computes then discards them)
+        and fully-padded blocks. The ppermute always runs; only the
+        einsum pair is skipped."""
+        needed = None
+        if is_causal:
+            origin = jnp.mod(idx - s, size)
+            # intersects the causal triangle iff the local Q block's last
+            # position can see the arriving KV block's first position
+            q_last = idx * lq + (lq - 1) + causal_offset
+            needed = q_last >= origin * lk
+        if has_mask:
+            any_valid = jnp.any(mc)
+            needed = any_valid if needed is None else (needed & any_valid)
+        if needed is None:
+            return block_update(s, m, l, acc, kc, vc, mc)
+        return jax.lax.cond(
+            needed,
+            lambda: block_update(s, m, l, acc, kc, vc, mc),
+            lambda: (m, l, acc))
+
     def body(s, carry):
         m, l, acc, kc, vc, mc = carry
-        m, l, acc = block_update(s, m, l, acc, kc, vc, mc)
+        m, l, acc = guarded_update(s, m, l, acc, kc, vc, mc)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         if has_mask:
@@ -132,7 +155,7 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     # then fold in the final kv block outside the loop (saves one ICI hop)
     m, l, acc, kc, vc, mc = jax.lax.fori_loop(
         0, size - 1, body, (m0, l0, acc0, kh, vh, mc0))
-    m, l, acc = block_update(size - 1, m, l, acc, kc, vc, mc)
+    m, l, acc = guarded_update(size - 1, m, l, acc, kc, vc, mc)
 
     # fully-masked rows: l == 0 -> output 0 (not NaN)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
