@@ -201,6 +201,30 @@ def pipeline_1f1b_value_and_grad(stage_fn: Callable, first_fn: Callable,
     ba = batch_axis if (batch_axis in mesh.axis_names and batch_axis != axis
                         and (batch // mb) % mesh.shape[batch_axis] == 0) \
         else None
+
+    # one compiled step per configuration: pjit's cache keys on function
+    # identity, so rebuilding the shard_map closure per call would
+    # retrace+recompile every eager step
+    key = (stage_fn, first_fn, last_fn, mesh, axis, mb, ba)
+    try:
+        step = _1F1B_CACHE.get(key)
+    except TypeError:            # unhashable user fn/mesh: build fresh
+        key, step = None, None
+    if step is None:
+        step = _build_1f1b_step(stage_fn, first_fn, last_fn, mesh, axis,
+                                mb, ba)
+        if key is not None:
+            _1F1B_CACHE[key] = step
+    loss, gf, gb, gl = step(params["first"], params["blocks"],
+                            params["last"], xm, ym)
+    return loss, {"first": gf, "blocks": gb, "last": gl}
+
+
+_1F1B_CACHE: dict = {}
+
+
+def _build_1f1b_step(stage_fn, first_fn, last_fn, mesh, axis, mb, ba):
+    n_stages = mesh.shape[axis]
     data_spec = PartitionSpec(None, ba)
     blocks_spec = PartitionSpec(axis)
     repl_spec = PartitionSpec()
@@ -347,11 +371,9 @@ def pipeline_1f1b_value_and_grad(stage_fn: Callable, first_fn: Callable,
         out_specs=(repl_spec, repl_spec, blocks_spec, repl_spec))
     # always run compiled: the schedule only makes sense as one SPMD
     # program (jax's eager shard_map interpreter executes tick by tick);
-    # inside an outer jit this inlines, outside it compiles once per
-    # shape thanks to jit's global trace cache
-    loss, gf, gb, gl = jax.jit(sharded)(
-        params["first"], params["blocks"], params["last"], xm, ym)
-    return loss, {"first": gf, "blocks": gb, "last": gl}
+    # inside an outer jit this inlines, and eager callers hit the
+    # _1F1B_CACHE'd jit wrapper so repeat steps don't retrace
+    return jax.jit(sharded)
 
 
 def _sequential_value_and_grad(stage_fn, first_fn, last_fn, params, x, y,

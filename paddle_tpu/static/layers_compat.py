@@ -17,10 +17,11 @@ paddle_tpu.static. Three mechanisms:
 
 Documented non-goals stay out: LoD-mutation ops (lod_reset/append,
 reorder_lod_tensor_by_rank), SelectedRows ops, the legacy py_reader
-family (superseded by DataLoader), Baidu-internal ops
-(filter_by_instag/continuous_value_model), and the two-stage detection
-training internals (rpn/retinanet target assign, generate_proposals,
-deformable ops) — see COVERAGE.md §2.4.
+family (superseded by DataLoader), and Baidu-internal ops
+(filter_by_instag/continuous_value_model) — see COVERAGE.md §2.4.
+The two-stage detection family (rpn_target_assign, generate_proposals,
+distribute_fpn_proposals, deformable_conv) lives in vision/rcnn.py and
+is re-exported here (round 3; retinanet_target_assign remains out).
 """
 from __future__ import annotations
 
@@ -523,6 +524,54 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
 multiclass_nms = VOPS.multiclass_nms
 matrix_nms = VOPS.matrix_nms
 bipartite_match = VOPS.bipartite_match
+
+# two-stage (Faster-RCNN) family — vision/rcnn.py; the proposal/target
+# ops are host-materializing like the NMS family (LoD-shaped outputs),
+# deformable_conv gets a parameter-creating facade below
+from ..vision import rcnn as _RCNN  # noqa: E402
+
+rpn_target_assign = _RCNN.rpn_target_assign
+generate_proposals = _RCNN.generate_proposals
+distribute_fpn_proposals = _RCNN.distribute_fpn_proposals
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """Deformable conv v1/v2 facade (reference fluid/layers/nn.py:14202);
+    compute in vision/rcnn.deformable_conv2d."""
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    cin = int(input.shape[1])
+    k = (filter_size if isinstance(filter_size, (list, tuple))
+         else [filter_size] * 2)
+    helper = LayerHelper("deformable_conv_s")
+    w = helper.create_parameter(
+        shape=[num_filters, cin // groups] + [int(s) for s in k],
+        attr=param_attr, dtype="float32")
+    b = (helper.create_parameter(shape=[num_filters], attr=bias_attr,
+                                 dtype="float32")
+         if bias_attr is not False else None)
+    _register_delegate(
+        "deformable_conv_s",
+        lambda x, off, msk, wt, bias=None, **kw:
+        _RCNN.deformable_conv2d(x, off, msk, wt, bias, **kw),
+        in_slots=("Input", "Offset", "Mask", "Filter", "Bias"))
+    ins = {"Input": [input.name], "Offset": [offset.name],
+           "Filter": [w.name]}
+    if modulated:
+        ins["Mask"] = [mask.name]
+    else:
+        ins["Mask"] = [offset.name]   # placeholder, ignored by kernel
+    if b is not None:
+        ins["Bias"] = [b.name]
+    return _append_simple(
+        "deformable_conv_s", ins,
+        {"stride": stride, "padding": padding, "dilation": dilation,
+         "groups": groups, "deformable_groups": deformable_groups,
+         "modulated": modulated})
 
 
 def detection_output(loc, scores, prior_box, prior_box_var,

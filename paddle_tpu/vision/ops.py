@@ -9,6 +9,8 @@ count in a mask) instead of the reference's dynamic-length outputs.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -265,20 +267,34 @@ def nms(boxes, scores, iou_threshold=0.3, score_threshold=None, top_k=None,
     return Tensor(jnp.asarray(kept, jnp.int32))
 
 
-@jax.jit
+def _iou_matrix_plus1(boxes_a, boxes_b):
+    """Pairwise IoU with the legacy +1 pixel widths (bbox_util.h
+    JaccardOverlap normalized=false — the Faster-RCNN-era ops)."""
+    ax0, ay0, ax1, ay1 = jnp.split(boxes_a, 4, axis=-1)
+    bx0, by0, bx1, by1 = [b[None, :, 0] for b in jnp.split(boxes_b, 4, -1)]
+    iw = jnp.clip(jnp.minimum(ax1, bx1) - jnp.maximum(ax0, bx0) + 1, 0)
+    ih = jnp.clip(jnp.minimum(ay1, by1) - jnp.maximum(ay0, by0) + 1, 0)
+    inter = iw * ih
+    area_a = (ax1 - ax0 + 1) * (ay1 - ay0 + 1)
+    area_b = (bx1 - bx0 + 1) * (by1 - by0 + 1)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+@functools.partial(jax.jit, static_argnames=("plus1",))
 def _nms_mask(boxes, scores, iou_threshold, score_threshold, category_idxs,
-              nms_eta=1.0):
+              nms_eta=1.0, plus1=False):
     """Greedy NMS as a keep-mask over score-sorted order.
 
     Visits boxes best-first; box j survives iff no already-kept earlier
     box overlaps it above the threshold. `nms_eta < 1` adaptively lowers
     the threshold after each kept box while it stays above 0.5
-    (multiclass_nms_op.cc NMSFast adaptive_threshold loop)."""
+    (multiclass_nms_op.cc NMSFast adaptive_threshold loop). ``plus1``
+    selects the legacy +1 IoU convention (generate_proposals NMS)."""
     n = boxes.shape[0]
     order = jnp.argsort(-scores)
     b = boxes[order]
     s = scores[order]
-    iou = iou_matrix(b, b)
+    iou = _iou_matrix_plus1(b, b) if plus1 else iou_matrix(b, b)
     if category_idxs is not None:
         cats = category_idxs[order]
         same = cats[:, None] == cats[None, :]

@@ -1,0 +1,375 @@
+"""Two-stage detection ops (VERDICT r2 item 7) vs numpy transliterations
+of the reference kernels (generate_proposals_op.cc,
+rpn_target_assign_op.cc, distribute_fpn_proposals_op.cc,
+deformable_conv_op / modulated_deformable_im2col)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import rcnn
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals
+# ---------------------------------------------------------------------------
+
+
+def _np_decode(anchors, deltas, variances):
+    clip = math.log(1000.0 / 16.0)
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    cx = variances[:, 0] * deltas[:, 0] * aw + acx
+    cy = variances[:, 1] * deltas[:, 1] * ah + acy
+    w = np.exp(np.minimum(variances[:, 2] * deltas[:, 2], clip)) * aw
+    h = np.exp(np.minimum(variances[:, 3] * deltas[:, 3], clip)) * ah
+    return np.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - 1, cy + h / 2 - 1], 1)
+
+
+def _np_generate_proposals_one(scores, deltas, info, anchors, variances,
+                               pre_n, post_n, thresh, min_size, eta):
+    """Literal ProposalForOneImage (generate_proposals_op.cc:389)."""
+    imh, imw, scale = info
+    order = np.argsort(-scores, kind="stable")[:pre_n]
+    props = _np_decode(anchors[order], deltas[order], variances[order])
+    props[:, 0] = np.clip(props[:, 0], 0, imw - 1)
+    props[:, 1] = np.clip(props[:, 1], 0, imh - 1)
+    props[:, 2] = np.clip(props[:, 2], 0, imw - 1)
+    props[:, 3] = np.clip(props[:, 3], 0, imh - 1)
+    sc = scores[order]
+    ms = max(min_size, 1.0)
+    ws = props[:, 2] - props[:, 0] + 1
+    hs = props[:, 3] - props[:, 1] + 1
+    ws_o = (props[:, 2] - props[:, 0]) / scale + 1
+    hs_o = (props[:, 3] - props[:, 1]) / scale + 1
+    keep = ((ws_o >= ms) & (hs_o >= ms) &
+            (props[:, 0] + ws / 2 <= imw) & (props[:, 1] + hs / 2 <= imh))
+    props, sc = props[keep], sc[keep]
+
+    def iou(a, b):
+        x0 = max(a[0], b[0]); y0 = max(a[1], b[1])          # noqa: E702
+        x1 = min(a[2], b[2]); y1 = min(a[3], b[3])          # noqa: E702
+        # JaccardOverlap(..., normalized=false): legacy +1 convention
+        iw = max(0.0, x1 - x0 + 1)
+        ih = max(0.0, y1 - y0 + 1)
+        inter = iw * ih
+        ua = ((a[2] - a[0] + 1) * (a[3] - a[1] + 1) +
+              (b[2] - b[0] + 1) * (b[3] - b[1] + 1) - inter)
+        return inter / ua
+
+    sel, adaptive = [], thresh
+    for i in range(props.shape[0]):
+        ok = all(iou(props[i], props[j]) <= adaptive for j in sel)
+        if ok:
+            sel.append(i)
+            if eta < 1 and adaptive > 0.5:
+                adaptive *= eta
+    sel = sel[:post_n]
+    return props[sel], sc[sel]
+
+
+def test_generate_proposals_matches_reference_flow():
+    rng = np.random.RandomState(0)
+    n, a, h, w = 2, 3, 4, 4
+    scores = rng.rand(n, a, h, w).astype(np.float32)
+    deltas = (rng.randn(n, 4 * a, h, w) * 0.3).astype(np.float32)
+    info = np.array([[40.0, 40.0, 1.0], [32.0, 40.0, 1.0]], np.float32)
+    base = rng.rand(h, w, a, 4).astype(np.float32)
+    anchors = np.stack([base[..., 0] * 30, base[..., 1] * 30,
+                        base[..., 0] * 30 + 8 + base[..., 2] * 12,
+                        base[..., 1] * 30 + 8 + base[..., 3] * 12], -1)
+    variances = np.full((h, w, a, 4), 0.5, np.float32)
+
+    rois, probs, rois_num = rcnn.generate_proposals(
+        scores, deltas, info, anchors, variances, pre_nms_top_n=30,
+        post_nms_top_n=10, nms_thresh=0.6, min_size=2.0,
+        return_rois_num=True)
+    rois = np.asarray(rois.numpy())
+    probs = np.asarray(probs.numpy())
+    counts = list(np.asarray(rois_num.numpy()))
+
+    flat_anchors = anchors.reshape(-1, 4)
+    flat_vars = variances.reshape(-1, 4)
+    start = 0
+    for i in range(n):
+        s_flat = scores[i].transpose(1, 2, 0).reshape(-1)
+        d_flat = deltas[i].transpose(1, 2, 0).reshape(-1, 4)
+        ref_r, ref_s = _np_generate_proposals_one(
+            s_flat, d_flat, info[i], flat_anchors, flat_vars,
+            30, 10, 0.6, 2.0, 1.0)
+        assert counts[i] == ref_r.shape[0]
+        got_r = rois[start:start + counts[i]]
+        got_s = probs[start:start + counts[i], 0]
+        np.testing.assert_allclose(got_r, ref_r, atol=1e-4)
+        np.testing.assert_allclose(got_s, ref_s, atol=1e-6)
+        start += counts[i]
+
+
+def test_generate_proposals_min_size_filters():
+    """All boxes tiny -> zero proposals, empty outputs, no crash."""
+    n, a, h, w = 1, 2, 2, 2
+    scores = np.random.RandomState(1).rand(n, a, h, w).astype(np.float32)
+    deltas = np.zeros((n, 4 * a, h, w), np.float32)
+    info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    anchors = np.tile(np.array([5, 5, 6, 6], np.float32),
+                      (h, w, a, 1))       # 2x2 boxes < min_size 8
+    variances = np.ones((h, w, a, 4), np.float32)
+    rois, probs, num = rcnn.generate_proposals(
+        scores, deltas, info, anchors, variances, min_size=8.0,
+        return_rois_num=True)
+    assert rois.numpy().shape == (0, 4)
+    assert list(np.asarray(num.numpy())) == [0]
+
+
+# ---------------------------------------------------------------------------
+# distribute_fpn_proposals
+# ---------------------------------------------------------------------------
+
+
+def test_distribute_fpn_proposals_levels_and_restore():
+    # areas chosen to land on distinct levels for refer 224@4
+    sizes = [28.0, 56.0, 112.0, 224.0, 448.0, 70.0]
+    rois = np.array([[0, 0, s, s] for s in sizes], np.float32)
+    multi, restore = rcnn.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    per_level = [np.asarray(m.numpy()) for m in multi]
+    # numpy reference: BBoxArea(normalized=false) -> (w+1)*(h+1)
+    scale = np.asarray(sizes) + 1.0
+    lvl = np.clip(np.floor(np.log2(scale / 224.0 + 1e-6)) + 4,
+                  2, 5).astype(int)
+    for li, lev in enumerate(range(2, 6)):
+        expect = rois[lvl == lev]
+        np.testing.assert_allclose(per_level[li], expect, atol=0)
+    # restore_ind maps concat(multi) back to input order
+    concat = np.concatenate(per_level, axis=0)
+    rest = np.asarray(restore.numpy())[:, 0]
+    np.testing.assert_allclose(concat[rest], rois, atol=0)
+
+
+def test_distribute_fpn_proposals_rois_num():
+    rois = np.array([[0, 0, 30, 30], [0, 0, 500, 500],
+                     [0, 0, 32, 32]], np.float32)
+    multi, restore, nums = rcnn.distribute_fpn_proposals(
+        rois, 2, 5, 4, 224, rois_num=np.array([2, 1]))
+    total_per_img = np.zeros(2, int)
+    for lv in nums:
+        total_per_img += np.asarray(lv.numpy())
+    assert list(total_per_img) == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# rpn_target_assign
+# ---------------------------------------------------------------------------
+
+
+def _grid_anchors():
+    xs, ys = np.meshgrid(np.arange(0, 48, 8), np.arange(0, 48, 8))
+    out = []
+    for size in (8.0, 16.0):
+        out.append(np.stack([xs.ravel(), ys.ravel(),
+                             xs.ravel() + size, ys.ravel() + size], 1))
+    return np.concatenate(out).astype(np.float32)
+
+
+def test_rpn_target_assign_deterministic_labels():
+    anchors = _grid_anchors()
+    m = anchors.shape[0]
+    rng = np.random.RandomState(0)
+    preds = rng.randn(1, m, 4).astype(np.float32)
+    logits = rng.randn(1, m, 1).astype(np.float32)
+    gt = np.array([[[8, 8, 24, 24], [30, 30, 40, 40]]], np.float32)
+    crowd = np.zeros((1, 2), np.int32)
+    info = np.array([[48.0, 48.0, 1.0]], np.float32)
+
+    scores, locs, labels, tgt, w = rcnn.rpn_target_assign(
+        preds, logits, anchors, np.ones_like(anchors), gt, crowd, info,
+        rpn_batch_size_per_im=32, rpn_fg_fraction=0.5,
+        rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+        use_random=False)
+    labels = np.asarray(labels.numpy())[:, 0]
+    fg = int((labels == 1).sum())
+    bg = int((labels == 0).sum())
+    assert fg >= 1                      # each gt's best anchor is fg
+    assert fg + bg <= 32                # batch size respected
+    assert locs.numpy().shape[0] == w.numpy().shape[0]
+    assert scores.numpy().shape[0] == labels.shape[0]
+
+    # foreground targets encode the matched gt (BoxToDelta round trip):
+    # decoding the target deltas from the matched anchors must land on a
+    # ground-truth box
+    tgt = np.asarray(tgt.numpy())
+    wv = np.asarray(w.numpy())
+    real = wv[:, 0] > 0
+    assert real.any()
+    # recover fg anchors via the iou argmax like the kernel does
+    from paddle_tpu.vision.rcnn import _box_to_delta, _iou_plus1
+    iou = np.asarray(_iou_plus1(jnp.asarray(anchors), jnp.asarray(gt[0])))
+    amax = iou.argmax(1)
+    expect_sets = []
+    for g in gt[0]:
+        expect_sets.append(g)
+    for row_t, is_real in zip(tgt, real):
+        if not is_real:
+            continue
+        # the delta decodes back onto one of the gts for some anchor
+        ok = False
+        for ai in range(m):
+            d = _box_to_delta(anchors[ai:ai + 1], gt[0][amax[ai]:amax[ai] + 1])
+            if np.allclose(d[0], row_t, atol=1e-5):
+                ok = True
+                break
+        assert ok, row_t
+
+
+def test_rpn_target_assign_crowd_and_straddle_excluded():
+    anchors = np.array([[0, 0, 8, 8], [-20, -20, -4, -4],
+                        [40, 40, 47, 47]], np.float32)
+    preds = np.zeros((1, 3, 4), np.float32)
+    logits = np.zeros((1, 3, 1), np.float32)
+    gt = np.array([[[0, 0, 8, 8], [40, 40, 47, 47]]], np.float32)
+    crowd = np.array([[0, 1]], np.int32)   # second gt is crowd
+    info = np.array([[48.0, 48.0, 1.0]], np.float32)
+    scores, locs, labels, tgt, w = rcnn.rpn_target_assign(
+        preds, logits, anchors, np.ones_like(anchors), gt, crowd, info,
+        rpn_straddle_thresh=0.0, use_random=False)
+    # anchor 1 straddles the image -> excluded entirely; crowd gt is not
+    # a positive target, so anchor 2 (overlapping only the crowd gt)
+    # becomes background
+    labels = np.asarray(labels.numpy())[:, 0]
+    assert (labels == 1).sum() == 1
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+
+
+def _np_deform_conv(x, offset, mask, weight, stride, padding, dilation,
+                    dg, modulated):
+    """Scalar transliteration of modulated_deformable_im2col."""
+    n, cin, hin, win = x.shape
+    cout, cpg, kh, kw = weight.shape
+    ho = (hin + 2 * padding - (dilation * (kh - 1) + 1)) // stride + 1
+    wo = (win + 2 * padding - (dilation * (kw - 1) + 1)) // stride + 1
+    cpdg = cin // dg
+    out = np.zeros((n, cout, ho, wo), np.float32)
+
+    def sample(img, ph, pw):
+        if ph <= -1 or ph >= hin or pw <= -1 or pw >= win:
+            return 0.0
+        h0, w0 = int(np.floor(ph)), int(np.floor(pw))
+        dh, dw = ph - h0, pw - w0
+        val = 0.0
+        for (hh, wt_h) in ((h0, 1 - dh), (h0 + 1, dh)):
+            for (ww, wt_w) in ((w0, 1 - dw), (w0 + 1, dw)):
+                if 0 <= hh < hin and 0 <= ww < win:
+                    val += wt_h * wt_w * img[hh, ww]
+        return val
+
+    off = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+    msk = mask.reshape(n, dg, kh * kw, ho, wo)
+    for b in range(n):
+        for oc in range(cout):
+            for oh in range(ho):
+                for ow in range(wo):
+                    acc = 0.0
+                    for ic in range(cin):
+                        g = ic // cpdg
+                        for i in range(kh):
+                            for j in range(kw):
+                                kk = i * kw + j
+                                ph = (oh * stride - padding + i * dilation
+                                      + off[b, g, kk, 0, oh, ow])
+                                pw = (ow * stride - padding + j * dilation
+                                      + off[b, g, kk, 1, oh, ow])
+                                v = sample(x[b, ic], ph, pw)
+                                if modulated:
+                                    v *= msk[b, g, kk, oh, ow]
+                                acc += v * weight[oc, ic, i, j]
+                    out[b, oc, oh, ow] = acc
+    return out
+
+
+@pytest.mark.parametrize("modulated", [True, False])
+def test_deformable_conv_matches_numpy(modulated):
+    rng = np.random.RandomState(3)
+    n, cin, hin, win = 1, 4, 5, 5
+    cout, kh = 3, 3
+    dg = 2
+    x = rng.randn(n, cin, hin, win).astype(np.float32)
+    w = (rng.randn(cout, cin, kh, kh) * 0.3).astype(np.float32)
+    off = (rng.randn(n, 2 * dg * kh * kh, 3, 3) * 0.7).astype(np.float32)
+    mask = rng.rand(n, dg * kh * kh, 3, 3).astype(np.float32)
+    got = rcnn.deformable_conv2d(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(mask),
+        jnp.asarray(w), stride=2, padding=1, dilation=1,
+        deformable_groups=dg, modulated=modulated)
+    got = np.asarray(got.numpy() if hasattr(got, "numpy") else got)
+    ref = _np_deform_conv(x, off, mask, w, 2, 1, 1, dg, modulated)
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 9, 9), jnp.float32)
+    w = jnp.asarray(rng.randn(6, 4, 3, 3) * 0.2, jnp.float32)
+    off = jnp.zeros((2, 2 * 9, 9, 9), jnp.float32)
+    mask = jnp.ones((2, 9, 9, 9), jnp.float32)
+    out = rcnn.deformable_conv2d(x, off, mask, w, stride=1, padding=1)
+    ref = jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ov = out.value if hasattr(out, "value") else out
+    np.testing.assert_allclose(np.asarray(ov), np.asarray(ref), atol=1e-5)
+
+
+def test_deformable_conv_gradients_flow():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(1, 2, 6, 6), jnp.float32)
+    w = jnp.asarray(rng.randn(2, 2, 3, 3) * 0.3, jnp.float32)
+    off = jnp.asarray(rng.randn(1, 2 * 9, 6, 6) * 0.3, jnp.float32)
+    mask = jnp.asarray(rng.rand(1, 9, 6, 6), jnp.float32)
+
+    def loss(x, off, mask, w):
+        out = rcnn.deformable_conv2d(x, off, mask, w, padding=1)
+        ov = out.value if hasattr(out, "value") else out
+        return jnp.sum(ov ** 2)
+
+    gx, go, gm, gw = jax.grad(loss, argnums=(0, 1, 2, 3))(x, off, mask, w)
+    for g in (gx, go, gm, gw):
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).max()) > 0
+
+
+def test_fluid_layers_exports_and_static_deformable_conv():
+    """The four ops are reachable as fluid.layers names; deformable_conv
+    builds and runs inside a static program (param-creating facade)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers as L
+
+    for name in ("rpn_target_assign", "generate_proposals",
+                 "distribute_fpn_proposals", "deformable_conv"):
+        assert callable(getattr(L, name)), name
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 4, 8, 8])
+        off = static.data("off", [2, 18, 8, 8])
+        msk = static.data("msk", [2, 9, 8, 8])
+        out = L.deformable_conv(x, off, msk, num_filters=6, filter_size=3,
+                                padding=1, modulated=True)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    res, = exe.run(main, feed={
+        "x": rng.randn(2, 4, 8, 8).astype(np.float32),
+        "off": np.zeros((2, 18, 8, 8), np.float32),
+        "msk": np.ones((2, 9, 8, 8), np.float32)},
+        fetch_list=[out])
+    assert res.shape == (2, 6, 8, 8)
+    assert np.isfinite(res).all()
