@@ -230,7 +230,12 @@ def ensure_backend(timeout: float | None = None) -> str:
             _resolved = jax.default_backend()
             return _resolved
         if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-            # pinned to cpu (tests, dryrun): no probe needed
+            # pinned to cpu (tests, dryrun): no probe needed — but a
+            # registered external plugin must still be dropped, because
+            # jax initializes every factory on the first backends()
+            # call even under a cpu pin (measured: a dead remote-TPU
+            # plugin hangs `JAX_PLATFORMS=cpu jax.devices()`)
+            force_cpu()
             _resolved = "cpu"
             return _resolved
         plat = probe_backend(timeout)

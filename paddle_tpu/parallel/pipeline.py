@@ -214,6 +214,10 @@ def pipeline_1f1b_value_and_grad(stage_fn: Callable, first_fn: Callable,
         step = _build_1f1b_step(stage_fn, first_fn, last_fn, mesh, axis,
                                 mb, ba)
         if key is not None:
+            # bounded FIFO: per-step-constructed fns (fresh lambdas)
+            # would otherwise pin compiled executables forever
+            if len(_1F1B_CACHE) >= _1F1B_CACHE_MAX:
+                _1F1B_CACHE.pop(next(iter(_1F1B_CACHE)))
             _1F1B_CACHE[key] = step
     loss, gf, gb, gl = step(params["first"], params["blocks"],
                             params["last"], xm, ym)
@@ -221,6 +225,7 @@ def pipeline_1f1b_value_and_grad(stage_fn: Callable, first_fn: Callable,
 
 
 _1F1B_CACHE: dict = {}
+_1F1B_CACHE_MAX = 32
 
 
 def _build_1f1b_step(stage_fn, first_fn, last_fn, mesh, axis, mb, ba):

@@ -89,7 +89,11 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     d_flat = jnp.transpose(dv, (0, 2, 3, 1)).reshape(n, total, 4)
 
     k1 = total if pre_nms_top_n <= 0 else min(int(pre_nms_top_n), total)
-    k2 = k1 if post_nms_top_n <= 0 else min(int(post_nms_top_n), k1)
+    # post_nms_top_n only trims NMS output; with NMS disabled the
+    # reference returns every min-size survivor (ProposalForOneImage
+    # early return at generate_proposals_op.cc:444)
+    k2 = k1 if (post_nms_top_n <= 0 or nms_thresh <= 0) \
+        else min(int(post_nms_top_n), k1)
     min_sz = max(float(min_size), 1.0)
 
     @jax.jit
@@ -134,10 +138,12 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
 
     rois_p, probs_p, counts = jax.vmap(one)(s_flat, d_flat, info)
     counts_np = np.asarray(counts)
-    rois = np.concatenate([np.asarray(rois_p[i][:counts_np[i]])
+    rois_np = np.asarray(rois_p)       # ONE device->host transfer each
+    probs_np = np.asarray(probs_p)
+    rois = np.concatenate([rois_np[i][:counts_np[i]]
                            for i in range(n)], axis=0) if n else \
         np.zeros((0, 4), np.float32)
-    probs = np.concatenate([np.asarray(probs_p[i][:counts_np[i]])
+    probs = np.concatenate([probs_np[i][:counts_np[i]]
                             for i in range(n)], axis=0)[:, None] if n else \
         np.zeros((0, 1), np.float32)
     out = (Tensor(jnp.asarray(rois)), Tensor(jnp.asarray(probs)))
@@ -204,17 +210,10 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 
 def _iou_plus1(a, b):
     """(A, 4) x (G, 4) -> (A, G) IoU with the legacy +1 box widths
-    (bbox_util.h BboxOverlaps)."""
-    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
-    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
-    x0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
-    y0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
-    x1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
-    y1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
-    iw = jnp.maximum(x1 - x0 + 1, 0.0)
-    ih = jnp.maximum(y1 - y0 + 1, 0.0)
-    inter = iw * ih
-    return inter / (area_a[:, None] + area_b[None, :] - inter)
+    (bbox_util.h BboxOverlaps) — shared with the NMS path."""
+    from .ops import _iou_matrix_plus1
+
+    return _iou_matrix_plus1(a, b)
 
 
 def _box_to_delta(anchors, gts):

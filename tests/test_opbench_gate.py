@@ -1,0 +1,122 @@
+"""Round-over-round op-level perf regression gate (VERDICT r2 item 6).
+
+Compares a fresh `tools/op_bench.py` smoke run against the newest
+committed `OPBENCH_r*.jsonl` baseline (same backend, same shapes) and
+fails on a >20% per-op slowdown. Timing noise is handled by taking the
+min over retries before declaring a regression — a real kernel
+regression reproduces on every retry, scheduler hiccups don't.
+
+The baseline files are part of the round ritual: regenerate at the end
+of each round with
+    BENCH_SMOKE=1 BENCH_ROUND=rNN python tools/op_bench.py \
+        --append OPBENCH_rNN.jsonl
+(median-of-3 per op; see OPBENCH_r03.jsonl provenance).
+
+Reference culture being matched: operators/benchmark/op_tester.cc.
+"""
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MARGIN = float(os.environ.get("PADDLE_TPU_OPBENCH_MARGIN", "0.20"))
+# sub-millisecond ops live in scheduler-noise territory: a relative
+# margin alone flags phantom regressions, so an absolute slack stacks
+ABS_SLACK_MS = float(os.environ.get("PADDLE_TPU_OPBENCH_ABS_MS", "0.25"))
+RETRIES = 2
+
+
+def _latest_baseline():
+    files = sorted(glob.glob(os.path.join(REPO, "OPBENCH_r*.jsonl")),
+                   key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+    return files[-1] if files else None
+
+
+def _run_ops(ops):
+    """One subprocess smoke run of the named ops (the exact environment
+    the committed baselines were measured in: cpu pin, no virtual
+    device forcing)."""
+    env = dict(os.environ)
+    # REPLACE PYTHONPATH: the inherited one carries the remote-TPU
+    # plugin, whose factory can hang backend init even under a cpu pin
+    env.update(JAX_PLATFORMS="cpu", BENCH_SMOKE="1", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_bench.py"),
+         "--ops", ",".join(ops)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return {r["op"]: r for r in
+            (json.loads(ln) for ln in out.stdout.strip().splitlines())
+            if "ms" in r}
+
+
+def test_opbench_no_regression_vs_committed_baseline():
+    baseline_path = _latest_baseline()
+    if baseline_path is None:
+        pytest.skip("no committed OPBENCH baseline yet")
+    baseline = {}
+    with open(baseline_path) as f:
+        for ln in f:
+            r = json.loads(ln)
+            if "ms" in r:
+                baseline[r["op"]] = r
+
+    # map op names back to BENCHES keys for re-runs
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import op_bench as ob
+
+    name_by_op = {}
+    for key in ob.BENCHES:
+        name_by_op[key] = key
+    op_to_bench = {
+        "matmul_bf16": "matmul", "attention_causal": "attention",
+        "flash_vs_xla": "flash_attention", "layernorm": "layernorm",
+        "embedding": "embedding", "fused_embedding_bag": "fused_embedding",
+        "conv2d_bf16": "conv", "softmax_xent": "softmax_xent",
+        "adamw_update": "optimizer_update", "transpose_add": "transpose",
+    }
+
+    current = _run_ops([op_to_bench[op] for op in baseline
+                        if op in op_to_bench])
+
+    def comparable(op):
+        b, c = baseline[op], current.get(op)
+        return (c is not None and b.get("shape") == c.get("shape")
+                and b.get("backend") == c.get("backend"))
+
+    suspects = {}
+    compared = 0
+    for op in baseline:
+        if not comparable(op):
+            continue
+        compared += 1
+        limit = baseline[op]["ms"] * (1 + MARGIN) + ABS_SLACK_MS
+        if current[op]["ms"] > limit:
+            suspects[op] = current[op]["ms"]
+    assert compared, (
+        "gate compared zero ops — baseline backend/shapes no longer "
+        f"match this environment; regenerate {baseline_path}")
+
+    # retry suspects: keep the MIN across reruns before failing
+    for _ in range(RETRIES):
+        if not suspects:
+            break
+        rerun = _run_ops([op_to_bench[op] for op in suspects])
+        for op in list(suspects):
+            if op in rerun:
+                suspects[op] = min(suspects[op], rerun[op]["ms"])
+            if suspects[op] <= (baseline[op]["ms"] * (1 + MARGIN)
+                                + ABS_SLACK_MS):
+                del suspects[op]
+
+    assert not suspects, (
+        f"op-level perf regression vs {os.path.basename(baseline_path)} "
+        f"(margin {MARGIN:.0%}): " + ", ".join(
+            f"{op}: {ms:.3f}ms vs baseline {baseline[op]['ms']:.3f}ms"
+            for op, ms in suspects.items()))

@@ -135,23 +135,99 @@ def bench_conv(smoke):
             "ms": ms, "gflops": flops / (ms / 1e3) / 1e9}
 
 
+def bench_fused_embedding(smoke):
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.fused_embedding import \
+        fused_embedding_seq_pool
+
+    vocab, dim = (5000, 128) if smoke else (100000, 256)
+    b, s = (256, 16) if smoke else (4096, 64)
+    key = jax.random.key(0)
+    table = jax.random.normal(key, (vocab, dim), jnp.float32)
+    ids = jax.random.randint(key, (b, s), 0, vocab)
+    f = jax.jit(lambda t, i: fused_embedding_seq_pool(
+        t, i, combiner="sum"))
+    ms = _timeit(f, table, ids)
+    gbps = b * s * dim * 4 / (ms / 1e3) / 1e9
+    return {"op": "fused_embedding_bag", "shape": f"{vocab}x{dim}@{b}x{s}",
+            "ms": ms, "gbps": gbps}
+
+
+def bench_softmax_xent(smoke):
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import functional as F
+
+    rows, classes = (1 << 10, 1000) if smoke else (1 << 14, 32000)
+    key = jax.random.key(0)
+    logits = jax.random.normal(key, (rows, classes), jnp.float32)
+    labels = jax.random.randint(key, (rows,), 0, classes)
+
+    def step(lg, lb):
+        return F.cross_entropy(lg, lb).value
+
+    f = jax.jit(step)
+    ms = _timeit(f, logits, labels)
+    return {"op": "softmax_xent", "shape": f"{rows}x{classes}", "ms": ms,
+            "gbps": logits.nbytes / (ms / 1e3) / 1e9}
+
+
+def bench_optimizer_update(smoke):
+    """AdamW slot update over a flat param bundle (optimizer hot loop)."""
+    import jax.numpy as jnp
+    import optax
+
+    n = (1 << 20) if smoke else (1 << 24)
+    key = jax.random.key(0)
+    p = jax.random.normal(key, (n,), jnp.float32)
+    g = jax.random.normal(key, (n,), jnp.float32)
+    opt = optax.adamw(1e-3)
+    state = opt.init(p)
+
+    @jax.jit
+    def step(p, g, state):
+        up, state = opt.update(g, state, p)
+        return optax.apply_updates(p, up), state
+
+    ms = _timeit(step, p, g, state, iters=10)
+    return {"op": "adamw_update", "shape": f"{n}", "ms": ms,
+            "gbps": p.nbytes * 5 / (ms / 1e3) / 1e9}
+
+
+def bench_transpose(smoke):
+    """HBM bandwidth probe: non-fusible major-axis transpose copy."""
+    import jax.numpy as jnp
+
+    n = 1024 if smoke else 8192
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (n, n), jnp.float32)
+    f = jax.jit(lambda x: jnp.swapaxes(x, 0, 1) + 1.0)
+    ms = _timeit(f, x)
+    return {"op": "transpose_add", "shape": f"{n}x{n}", "ms": ms,
+            "gbps": x.nbytes * 2 / (ms / 1e3) / 1e9}
+
+
 BENCHES = {
     "matmul": bench_matmul,
     "attention": bench_attention,
     "flash_attention": bench_flash_attention,
     "layernorm": bench_layernorm,
     "embedding": bench_embedding,
+    "fused_embedding": bench_fused_embedding,
     "conv": bench_conv,
+    "softmax_xent": bench_softmax_xent,
+    "optimizer_update": bench_optimizer_update,
+    "transpose": bench_transpose,
 }
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--ops", default=",".join(BENCHES))
-    ap.add_argument("--append", default=None,
-                    help="JSONL history file to append rows to")
-    args = ap.parse_args()
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
+def run_benches(ops=None, smoke=None):
+    """Resolve the backend, run the named benches (default: all), return
+    the row dicts. Importable so the regression-gate test shares the
+    exact measurement path with the CLI."""
+    if smoke is None:
+        smoke = os.environ.get("BENCH_SMOKE") == "1"
 
     from paddle_tpu.framework.bringup import ensure_backend
 
@@ -161,7 +237,7 @@ def main():
 
     kind = jax.devices()[0].device_kind
     rows = []
-    for name in args.ops.split(","):
+    for name in (ops or list(BENCHES)):
         name = name.strip()
         if not name:
             continue
@@ -169,7 +245,7 @@ def main():
             row = BENCHES[name](smoke)
         except Exception as e:
             row = {"op": name, "error": f"{type(e).__name__}: {e}"}
-        row.update({"backend": backend, "device_kind": kind,
+        row.update({"backend": backend, "device_kind": kind, "smoke": smoke,
                     "round": os.environ.get("BENCH_ROUND", "")})
         if "ms" in row:
             row["ms"] = round(row["ms"], 4)
@@ -177,6 +253,17 @@ def main():
             if k in row:
                 row[k] = round(row[k], 2)
         rows.append(row)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=",".join(BENCHES))
+    ap.add_argument("--append", default=None,
+                    help="JSONL history file to append rows to")
+    args = ap.parse_args()
+    rows = run_benches(args.ops.split(","))
+    for row in rows:
         print(json.dumps(row), flush=True)
     if args.append:
         with open(args.append, "a") as f:
