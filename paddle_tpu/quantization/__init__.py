@@ -25,10 +25,16 @@ from ..framework.tensor import Tensor
 from ..nn import conv as conv_mod
 from ..nn import common as common_mod
 from ..nn.layer import Layer
+from .observers import (OBSERVERS, AbsMaxObserver,  # noqa: F401
+                        MovingAverageAbsMaxObserver, MSEObserver,
+                        Observer, PercentileObserver, make_observer)
 
 __all__ = ["fake_quant", "QuantConfig", "QAT", "PTQ", "QuantedLinear",
-           "QuantedConv2D", "quant_aware", "export_int8",
-           "convert_to_inference", "save_quantized"]
+           "QuantedConv2D", "QuantedEmbedding", "quant_aware",
+           "export_int8", "convert_to_inference", "save_quantized",
+           "int8_matmul", "post_training_quantization", "Observer",
+           "AbsMaxObserver", "MovingAverageAbsMaxObserver",
+           "PercentileObserver", "MSEObserver"]
 
 
 @primitive("fake_quantize_dequantize", nondiff=("scale",))
@@ -44,20 +50,37 @@ def fake_quant(x, scale, bit_length=8, name=None):
 
 
 class QuantConfig:
-    """Subset of the reference quant config knobs that matter on TPU."""
+    """Subset of the reference quant config knobs that matter on TPU.
+
+    ``algo`` picks the activation-range observer (the reference
+    PostTrainingQuantization algo families): abs_max,
+    moving_average_abs_max/avg, percentile/hist, mse — see observers.py.
+    """
 
     def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
                  moving_rate: float = 0.9,
-                 quantizable_layer_type=("Linear", "Conv2D"),
-                 weight_quantize_type: str = "abs_max"):
+                 quantizable_layer_type=("Linear", "Conv2D", "Embedding"),
+                 weight_quantize_type: str = "abs_max",
+                 algo: str = "moving_average_abs_max",
+                 percentile: float = 99.99):
         if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
             raise ValueError(
                 f"unsupported weight_quantize_type {weight_quantize_type!r}")
+        if algo not in OBSERVERS:
+            raise ValueError(
+                f"unknown algo {algo!r}; one of {sorted(OBSERVERS)}")
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.moving_rate = moving_rate
         self.quantizable_layer_type = tuple(quantizable_layer_type)
         self.weight_quantize_type = weight_quantize_type
+        self.algo = algo
+        self.percentile = percentile
+
+    def make_observer(self) -> Observer:
+        return make_observer(
+            self.algo, moving_rate=self.moving_rate,
+            percentile=self.percentile, bit_length=self.activation_bits)
 
 
 class _QuantedBase(Layer):
@@ -74,8 +97,25 @@ class _QuantedBase(Layer):
         self.register_buffer("act_scale",
                              Tensor(jnp.asarray(0.0, jnp.float32)))
 
+    #: PTQ calibration observer (observers.py); created by PTQ.quantize
+    _observer = None
+
     def _observe(self, x):
-        amax = jnp.max(jnp.abs(x.value if isinstance(x, Tensor) else x))
+        arr = x.value if isinstance(x, Tensor) else x
+        if self._calibrating and self._observer is not None:
+            # host-side observer (abs_max / percentile / mse ...):
+            # calibration forwards are eager by design — the compiled
+            # serving graph only ever sees the frozen scale
+            if isinstance(arr, jax.core.Tracer):
+                raise RuntimeError(
+                    "PTQ calibration must run eagerly (observers "
+                    "accumulate host-side); call the model outside jit "
+                    "during calibration")
+            self._observer.observe(np.asarray(arr))
+            s = jnp.asarray(self._observer.scale(), jnp.float32)
+            self.act_scale._value = s
+            return jnp.maximum(s, 1e-8)
+        amax = jnp.max(jnp.abs(arr))
         prev = self.act_scale.value
         r = self._cfg.moving_rate
         new = jnp.where(prev > 0, r * prev + (1 - r) * amax, amax)
@@ -106,6 +146,17 @@ class _QuantedBase(Layer):
         arr = w.value if isinstance(w, Tensor) else w
         return fake_quant(w, self._weight_scale(arr), self._cfg.weight_bits)
 
+    # wrapped layers stay attribute-transparent for the inner params:
+    # weight-tying reads like BERT's `embeddings.word_embeddings.weight`
+    # must keep resolving after quantization
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    @property
+    def bias(self):
+        return getattr(self.inner, "bias", None)
+
 
 class QuantedLinear(_QuantedBase):
     _channel_reduce_axes = (0,)
@@ -133,18 +184,40 @@ class QuantedConv2D(_QuantedBase):
                         data_format=inner._data_format)
 
 
+class QuantedEmbedding(_QuantedBase):
+    """Weight-only quantization: ids have no range to observe, so only
+    the table is fake-quantized (per-tensor abs_max — rows share one
+    scale like the reference lookup_table int8 path)."""
+
+    def forward(self, x):
+        inner = self.inner
+        wq = self._q_weight(inner.weight)
+        wv = wq.value if isinstance(wq, Tensor) else wq
+        ids = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        out = jnp.take(wv, ids, axis=0)
+        if inner._padding_idx is not None:
+            out = jnp.where((ids == inner._padding_idx)[..., None], 0.0,
+                            out)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+
 _WRAPPERS = {
     common_mod.Linear: QuantedLinear,
     conv_mod.Conv2D: QuantedConv2D,
+    common_mod.Embedding: QuantedEmbedding,
 }
 
 
 def _wrap_layers(model: Layer, config: QuantConfig) -> Layer:
+    # a bare quantizable layer as the root gets wrapped directly
+    cls = type(model)
+    if cls in _WRAPPERS and cls.__name__ in config.quantizable_layer_type:
+        return _WRAPPERS[cls](model, config)
     for name, sub in list(model._sub_layers.items()):
-        cls = type(sub)
-        if cls in _WRAPPERS and cls.__name__ in \
+        sub_cls = type(sub)
+        if sub_cls in _WRAPPERS and sub_cls.__name__ in \
                 config.quantizable_layer_type:
-            setattr(model, name, _WRAPPERS[cls](sub, config))
+            setattr(model, name, _WRAPPERS[sub_cls](sub, config))
         else:
             _wrap_layers(sub, config)
     return model
@@ -167,7 +240,8 @@ def quant_aware(model: Layer, config: Optional[QuantConfig] = None) -> Layer:
 
 class PTQ:
     """Post-training quantization: calibrate ranges with sample batches,
-    then convert (reference slim post_training_quantization.py)."""
+    then convert (reference slim post_training_quantization.py). The
+    observer family is picked by QuantConfig.algo."""
 
     def __init__(self, config: Optional[QuantConfig] = None):
         self._cfg = config or QuantConfig()
@@ -175,17 +249,50 @@ class PTQ:
     def quantize(self, model: Layer) -> Layer:
         m = _wrap_layers(model, self._cfg)
         m.eval()   # dropout/BN stay in inference mode during calibration
-        for _, sub in m.named_sublayers():
+        for _, sub in m.named_sublayers(include_self=True):
             if isinstance(sub, _QuantedBase):
                 sub._calibrating = True
+                sub._observer = self._cfg.make_observer()
         return m
 
     def convert(self, model: Layer) -> Layer:
         model.eval()
-        for _, sub in model.named_sublayers():
+        for _, sub in model.named_sublayers(include_self=True):
             if isinstance(sub, _QuantedBase):
+                if sub._observer is not None:
+                    frozen = sub._observer.scale()
+                    # a weight-only layer (QuantedEmbedding) never feeds
+                    # its observer: keep whatever scale it already holds
+                    if frozen > 0:
+                        sub.act_scale._value = jnp.asarray(
+                            frozen, jnp.float32)
+                    sub._observer = None
                 sub._calibrating = False
         return model
+
+
+def post_training_quantization(model: Layer, sample_batches,
+                               config: Optional[QuantConfig] = None,
+                               forward=None) -> Layer:
+    """One-call PTQ over a calibration dataset (the reference
+    PostTrainingQuantization.quantize() loop: feed sample batches, let
+    per-op observers accumulate, freeze scales, convert).
+
+    sample_batches: iterable of model inputs — a tuple/list is splatted
+    as positional args, anything else passed as the single argument.
+    forward: optional callable (model, batch) -> Any overriding how a
+    batch is fed (models whose calibration entry point is not
+    ``model(*batch)``)."""
+    ptq = PTQ(config)
+    m = ptq.quantize(model)
+    for batch in sample_batches:
+        if forward is not None:
+            forward(m, batch)
+        elif isinstance(batch, (tuple, list)):
+            m(*batch)
+        else:
+            m(batch)
+    return ptq.convert(m)
 
 
 def _bake_int8(qb: _QuantedBase):
@@ -209,23 +316,49 @@ def export_int8(model: Layer) -> Dict[str, dict]:
     save_quantized()."""
     out = {}
 
+    def emit(full, sub):
+        wq, mult = _bake_int8(sub)
+        out[full] = {
+            "weight_int8": wq,
+            "weight_scale": (float(mult) if mult.size == 1
+                             else np.squeeze(mult)),
+            "quant_type": sub._cfg.weight_quantize_type,
+            "act_scale": float(np.asarray(sub.act_scale.numpy())),
+        }
+
     def walk(layer: Layer, prefix: str):
         for name, sub in layer._sub_layers.items():
             full = f"{prefix}.{name}" if prefix else name
             if isinstance(sub, _QuantedBase):
-                wq, mult = _bake_int8(sub)
-                out[full] = {
-                    "weight_int8": wq,
-                    "weight_scale": (float(mult) if mult.size == 1
-                                     else np.squeeze(mult)),
-                    "quant_type": sub._cfg.weight_quantize_type,
-                    "act_scale": float(np.asarray(sub.act_scale.numpy())),
-                }
+                emit(full, sub)
             else:
                 walk(sub, full)
 
-    walk(model, "")
+    if isinstance(model, _QuantedBase):   # bare root-wrapped layer
+        emit("", model)
+    else:
+        walk(model, "")
     return out
+
+
+def int8_matmul(x, w_q, x_scale, w_mult, activation_bits=8):
+    """True int8 matmul: quantize the activation, contract int8 x int8
+    on the MXU with an int32 accumulator (preferred_element_type), and
+    dequantize once at the end — the TPU-native analogue of the
+    reference's quant_int8 matmul kernels, and exactly equal to
+    quantize-dequantize-then-f32-matmul because the integer product is
+    exact where f32 accumulation rounds.
+
+    x (..., K) float; w_q (K, N) int8; x_scale scalar; w_mult dequant
+    multiplier (scalar or (1, N) per-out-channel)."""
+    qmax = float(2 ** (activation_bits - 1) - 1)
+    s = jnp.maximum(x_scale, 1e-8)
+    x_q = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax) \
+        .astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (s / qmax) * w_mult
 
 
 class _Int8InferenceBase(Layer):
@@ -244,7 +377,7 @@ class _Int8InferenceBase(Layer):
         self.register_buffer("weight_mult", Tensor(jnp.asarray(mult)))
         self.register_buffer("act_scale", Tensor(
             jnp.maximum(qb.act_scale.value.astype(jnp.float32), 1e-8)))
-        bias = qb.inner.bias
+        bias = getattr(qb.inner, "bias", None)
         self._has_bias = bias is not None
         if self._has_bias:
             self.register_buffer("bias", Tensor(bias.value))
@@ -259,10 +392,16 @@ class _Int8InferenceBase(Layer):
 
 class Int8Linear(_Int8InferenceBase):
     def forward(self, x):
-        import paddle_tpu.nn.functional as F
-
-        return F.linear(self._q_act(x), self._weight(),
-                        self.bias if self._has_bias else None)
+        xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        out = int8_matmul(xv, self.weight_q.value,
+                          self.act_scale.value,
+                          self.weight_mult.value.reshape(1, -1)
+                          if self.weight_mult.value.ndim > 0
+                          else self.weight_mult.value,
+                          activation_bits=self._abits)
+        if self._has_bias:
+            out = out + self.bias.value
+        return Tensor(out) if isinstance(x, Tensor) else out
 
 
 class Int8Conv2D(_Int8InferenceBase):
@@ -285,7 +424,33 @@ class Int8Conv2D(_Int8InferenceBase):
                         data_format=self._data_format)
 
 
-_INT8_WRAPPERS = {QuantedLinear: Int8Linear, QuantedConv2D: Int8Conv2D}
+class Int8Embedding(_Int8InferenceBase):
+    """int8 table resident in HBM (4x smaller); rows dequantize after
+    the gather, so lookup bandwidth drops with the table size."""
+
+    def __init__(self, qb: _QuantedBase):
+        super().__init__(qb)
+        self._padding_idx = qb.inner._padding_idx
+
+    def forward(self, x):
+        ids = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        rows = jnp.take(self.weight_q.value, ids, axis=0)
+        out = rows.astype(jnp.float32) * self.weight_mult.value
+        if self._padding_idx is not None:
+            out = jnp.where((ids == self._padding_idx)[..., None], 0.0,
+                            out)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+    @property
+    def weight(self):
+        """Dequantized table view: weight-tied heads (BERT MLM decoder)
+        keep working on the int8 model."""
+        return Tensor(self.weight_q.value.astype(jnp.float32) *
+                      self.weight_mult.value)
+
+
+_INT8_WRAPPERS = {QuantedLinear: Int8Linear, QuantedConv2D: Int8Conv2D,
+                  QuantedEmbedding: Int8Embedding}
 
 
 def convert_to_inference(model: Layer) -> Layer:
@@ -312,7 +477,11 @@ def convert_to_inference(model: Layer) -> Layer:
             else:
                 walk(sub)
 
-    walk(model)
+    root_wrapper = wrapper_for(model)
+    if root_wrapper is not None:
+        model = root_wrapper(model)
+    else:
+        walk(model)
     model.eval()
     return model
 

@@ -1,0 +1,160 @@
+"""Activation-range observers for PTQ/QAT.
+
+Parity with the reference PostTrainingQuantization's `algo` families
+(/root/reference/python/paddle/fluid/contrib/slim/quantization/
+post_training_quantization.py: abs_max, avg/moving-average, hist →
+percentile, mse) re-shaped for the imperative TPU design: observers are
+small host-side accumulators fed by eager calibration forwards — the
+compiled inference graph only ever sees the final frozen scale, so
+observer choice costs nothing at serving time.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Observer", "AbsMaxObserver", "MovingAverageAbsMaxObserver",
+           "PercentileObserver", "MSEObserver", "OBSERVERS",
+           "make_observer"]
+
+
+class Observer:
+    """Accumulates statistics of |x| over calibration batches and yields
+    one symmetric-quant scale."""
+
+    def observe(self, x: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        raise NotImplementedError
+
+
+class AbsMaxObserver(Observer):
+    """Global max of |x| over every observed batch (algo='abs_max')."""
+
+    def __init__(self):
+        self._max = 0.0
+
+    def observe(self, x):
+        self._max = max(self._max, float(np.max(np.abs(x), initial=0.0)))
+
+    def scale(self):
+        return self._max
+
+
+class MovingAverageAbsMaxObserver(Observer):
+    """EMA of per-batch abs-max (fake_quantize_moving_average_abs_max /
+    algo='avg')."""
+
+    def __init__(self, moving_rate: float = 0.9):
+        self._rate = moving_rate
+        self._val = 0.0
+        self._seen = False
+
+    def observe(self, x):
+        amax = float(np.max(np.abs(x), initial=0.0))
+        if not self._seen:
+            self._val, self._seen = amax, True
+        else:
+            self._val = self._rate * self._val + (1 - self._rate) * amax
+
+    def scale(self):
+        return self._val
+
+
+class PercentileObserver(Observer):
+    """Histogram of |x|; scale = the `percentile` quantile (algo='hist',
+    hist_percent). Outliers above the current range re-bin the histogram
+    instead of being clipped, so the quantile stays exact to bin width.
+    """
+
+    def __init__(self, percentile: float = 99.99, bins: int = 2048):
+        self._q = percentile / 100.0
+        self._bins = bins
+        self._hist = np.zeros(bins, np.int64)
+        self._width = None
+
+    def observe(self, x):
+        a = np.abs(np.asarray(x, np.float32)).ravel()
+        amax = float(a.max(initial=0.0))
+        if amax == 0.0:
+            return
+        if self._width is None:
+            self._width = amax / self._bins
+        if amax > self._width * self._bins:
+            # grow the range: re-bin existing counts into wider bins
+            factor = int(np.ceil(amax / (self._width * self._bins)))
+            new_width = self._width * factor
+            idx = (np.arange(self._bins) * self._width / new_width)
+            new_hist = np.zeros(self._bins, np.int64)
+            np.add.at(new_hist, idx.astype(int), self._hist)
+            self._hist, self._width = new_hist, new_width
+        bin_idx = np.minimum((a / self._width).astype(int), self._bins - 1)
+        np.add.at(self._hist, bin_idx, 1)
+
+    def scale(self):
+        if self._width is None:
+            return 0.0
+        total = self._hist.sum()
+        if total == 0:
+            return 0.0
+        cdf = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cdf, self._q))
+        return (idx + 1) * self._width
+
+
+class MSEObserver(Observer):
+    """Scale minimizing the quantization MSE over the observed
+    distribution (algo='mse'): keeps a histogram, then searches scale
+    candidates s = f * absmax for f in (0.05..1.0] and picks the one
+    with least sum(hist * (x - dequant(quant(x)))^2), using each bin's
+    center as its representative value."""
+
+    def __init__(self, bit_length: int = 8, bins: int = 2048,
+                 steps: int = 64):
+        self._inner = PercentileObserver(100.0, bins)
+        self._qmax = float(2 ** (bit_length - 1) - 1)
+        self._steps = steps
+
+    def observe(self, x):
+        self._inner.observe(x)
+
+    def scale(self):
+        h = self._inner._hist
+        w = self._inner._width
+        if w is None or h.sum() == 0:
+            return 0.0
+        centers = (np.arange(h.shape[0]) + 0.5) * w
+        absmax = self._inner.scale()   # 100th percentile = max
+        best_s, best_err = absmax, np.inf
+        for f in np.linspace(0.05, 1.0, self._steps):
+            s = f * absmax
+            if s <= 0:
+                continue
+            q = np.clip(np.round(centers / s * self._qmax),
+                        -self._qmax - 1, self._qmax) * s / self._qmax
+            err = float(np.sum(h * (centers - q) ** 2))
+            if err < best_err:
+                best_err, best_s = err, s
+        return best_s
+
+
+OBSERVERS = {
+    "abs_max": AbsMaxObserver,
+    "moving_average_abs_max": MovingAverageAbsMaxObserver,
+    "avg": MovingAverageAbsMaxObserver,
+    "percentile": PercentileObserver,
+    "hist": PercentileObserver,
+    "mse": MSEObserver,
+}
+
+
+def make_observer(algo: str, **kwargs) -> Observer:
+    try:
+        cls = OBSERVERS[algo]
+    except KeyError:
+        raise ValueError(
+            f"unknown observer algo {algo!r}; one of {sorted(OBSERVERS)}")
+    import inspect
+
+    accepted = set(inspect.signature(cls.__init__).parameters)
+    return cls(**{k: v for k, v in kwargs.items() if k in accepted})

@@ -67,13 +67,7 @@ def test_opbench_no_regression_vs_committed_baseline():
             if "ms" in r:
                 baseline[r["op"]] = r
 
-    # map op names back to BENCHES keys for re-runs
-    sys.path.insert(0, os.path.join(REPO, "tools"))
-    import op_bench as ob
-
-    name_by_op = {}
-    for key in ob.BENCHES:
-        name_by_op[key] = key
+    # map baseline op names back to BENCHES keys for re-runs
     op_to_bench = {
         "matmul_bf16": "matmul", "attention_causal": "attention",
         "flash_vs_xla": "flash_attention", "layernorm": "layernorm",

@@ -149,3 +149,177 @@ def test_quantized_inference_round_trip(tmp_path):
     err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
     assert err < 0.05, err
     assert np.array_equal(np.argmax(out, -1), np.argmax(ref, -1))
+
+
+# ---------------------------------------------------------------------------
+# round 3 (VERDICT r2 item 9): observers, embedding/matmul int8, dataset PTQ
+# ---------------------------------------------------------------------------
+
+
+def test_observers_match_numpy_references():
+    import numpy as np
+
+    from paddle_tpu.quantization import (AbsMaxObserver, MSEObserver,
+                                         MovingAverageAbsMaxObserver,
+                                         PercentileObserver)
+
+    rng = np.random.RandomState(0)
+    batches = [rng.randn(1000).astype(np.float32) * (i + 1)
+               for i in range(4)]
+
+    ob = AbsMaxObserver()
+    for b in batches:
+        ob.observe(b)
+    assert np.isclose(ob.scale(),
+                      max(float(np.abs(b).max()) for b in batches))
+
+    ob = MovingAverageAbsMaxObserver(0.9)
+    ref = None
+    for b in batches:
+        amax = float(np.abs(b).max())
+        ref = amax if ref is None else 0.9 * ref + 0.1 * amax
+    for b in batches:
+        ob.observe(b)
+    assert np.isclose(ob.scale(), ref)
+
+    ob = PercentileObserver(percentile=99.0, bins=4096)
+    allv = np.abs(np.concatenate(batches))
+    for b in batches:
+        ob.observe(b)
+    ref = float(np.percentile(allv, 99.0))
+    assert abs(ob.scale() - ref) / ref < 0.02   # bin-width tolerance
+
+    ob = MSEObserver(bit_length=8, bins=4096)
+    for b in batches:
+        ob.observe(b)
+    s = ob.scale()
+    # MSE-optimal scale for a heavy-tailed mix clips some outliers:
+    # strictly below absmax, above the median
+    assert 0 < s <= float(allv.max())
+    assert s > float(np.median(allv))
+
+
+def test_int8_matmul_matches_fake_quant_path():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.quantization import int8_matmul
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+    w = rng.randn(16, 8).astype(np.float32)
+    w_scale = np.abs(w).max()
+    w_q = np.clip(np.round(w / w_scale * 127), -128, 127).astype(np.int8)
+    w_mult = np.float32(w_scale / 127)
+    x_scale = jnp.asarray(float(jnp.abs(x).max()), jnp.float32)
+
+    got = int8_matmul(x, jnp.asarray(w_q), x_scale, w_mult)
+    # reference: dequantize both and matmul in f64 (exact for int8 mags)
+    x_q = np.clip(np.round(np.asarray(x) / float(x_scale) * 127),
+                  -128, 127)
+    ref = (x_q * float(x_scale) / 127) @ (w_q.astype(np.float64) * w_mult)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+
+
+def test_embedding_int8_roundtrip():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import (PTQ, QuantConfig,
+                                         convert_to_inference)
+
+    paddle.seed(0)
+    emb = nn.Embedding(50, 16, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 2, 0, 7]], np.int64))
+    ref = emb(ids).numpy()
+
+    ptq = PTQ(QuantConfig(algo="abs_max"))
+    m = ptq.quantize(emb)
+    m(ids)
+    m = ptq.convert(m)
+    m = convert_to_inference(m)
+    from paddle_tpu.quantization import Int8Embedding
+
+    assert isinstance(m, Int8Embedding) or any(
+        isinstance(s, Int8Embedding) for _, s in m.named_sublayers())
+    got = m(ids).numpy()
+    # int8 table: rows match within quantization step of the table scale
+    step = float(np.abs(emb.weight.numpy()).max()) / 127
+    assert np.abs(got - ref).max() <= step
+    # padding row stays exactly zero
+    np.testing.assert_allclose(got[0, 2], 0.0, atol=0)
+
+
+def test_ptq_bert_encoder_accuracy_delta():
+    """PTQ of the bench BERT encoder (VERDICT r2 item 9 'Done' bar):
+    calibrate on sample batches with the percentile observer, convert
+    to int8 inference layers, and assert the masked-LM loss moves by
+    <2% relative to fp32."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.quantization import (QuantConfig,
+                                         convert_to_inference,
+                                         post_training_quantization)
+
+    paddle.seed(0)
+    cfg = BertConfig.tiny()
+    cfg.num_hidden_layers = 2
+    model = BertForPretraining(cfg)
+    model.eval()
+
+    rng = np.random.RandomState(0)
+
+    def batch(seed):
+        r = np.random.RandomState(seed)
+        ids = paddle.to_tensor(
+            r.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32))
+        tt = paddle.to_tensor(np.zeros((2, 16), np.int32))
+        mlm = paddle.to_tensor(
+            r.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32))
+        nsp = paddle.to_tensor(r.randint(0, 2, (2,)).astype(np.int32))
+        return ids, tt, mlm, nsp
+
+    eval_b = batch(99)
+    fp32_loss = float(model.loss(*eval_b).numpy())
+
+    qmodel = post_training_quantization(
+        model, [batch(i)[:2] for i in range(6)],
+        QuantConfig(algo="percentile", percentile=99.99,
+                    weight_quantize_type="channel_wise_abs_max"),
+        forward=lambda m, b: m(*b))
+    qmodel = convert_to_inference(qmodel)
+    int8_loss = float(qmodel.loss(*eval_b).numpy())
+    delta = abs(int8_loss - fp32_loss) / max(abs(fp32_loss), 1e-6)
+    assert np.isfinite(int8_loss)
+    assert delta < 0.02, (fp32_loss, int8_loss, delta)
+
+
+def test_bare_root_linear_ptq_roundtrip():
+    """A quantizable layer AS the model root must calibrate, convert,
+    and export like a nested one (review regression: the root was
+    skipped by named_sublayers, leaving act_scale at 0)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import (PTQ, QuantConfig,
+                                         convert_to_inference,
+                                         export_int8)
+
+    paddle.seed(0)
+    lin = nn.Linear(8, 4)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    ref = lin(x).numpy()
+    ptq = PTQ(QuantConfig(algo="abs_max"))
+    m = ptq.quantize(lin)
+    m(x)
+    m = ptq.convert(m)
+    art = export_int8(m)
+    assert "" in art and art[""]["act_scale"] > 0
+    m = convert_to_inference(m)
+    got = m(x).numpy()
+    assert np.abs(got - ref).max() < 0.2
