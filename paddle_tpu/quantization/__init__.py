@@ -190,15 +190,11 @@ class QuantedEmbedding(_QuantedBase):
     scale like the reference lookup_table int8 path)."""
 
     def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
         inner = self.inner
         wq = self._q_weight(inner.weight)
-        wv = wq.value if isinstance(wq, Tensor) else wq
-        ids = x.value if isinstance(x, Tensor) else jnp.asarray(x)
-        out = jnp.take(wv, ids, axis=0)
-        if inner._padding_idx is not None:
-            out = jnp.where((ids == inner._padding_idx)[..., None], 0.0,
-                            out)
-        return Tensor(out) if isinstance(x, Tensor) else out
+        return F.embedding(x, wq, padding_idx=inner._padding_idx)
 
 
 _WRAPPERS = {
@@ -350,8 +346,18 @@ def int8_matmul(x, w_q, x_scale, w_mult, activation_bits=8):
     exact where f32 accumulation rounds.
 
     x (..., K) float; w_q (K, N) int8; x_scale scalar; w_mult dequant
-    multiplier (scalar or (1, N) per-out-channel)."""
+    multiplier (scalar or (1, N) per-out-channel).
+
+    The int32 accumulator is exact only while K * 2^(2*(bits-1)) fits
+    in int32 — K <= 131071 at 8 bits; larger contractions fall back to
+    the f32 dequantized matmul rather than silently wrapping."""
     qmax = float(2 ** (activation_bits - 1) - 1)
+    k = x.shape[-1]
+    if k * (qmax + 1) ** 2 >= 2 ** 31:
+        s = jnp.maximum(x_scale, 1e-8)
+        x_dq = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax) \
+            * (s / qmax)
+        return x_dq @ (w_q.astype(jnp.float32) * w_mult)
     s = jnp.maximum(x_scale, 1e-8)
     x_q = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax) \
         .astype(jnp.int8)
@@ -394,10 +400,7 @@ class Int8Linear(_Int8InferenceBase):
     def forward(self, x):
         xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
         out = int8_matmul(xv, self.weight_q.value,
-                          self.act_scale.value,
-                          self.weight_mult.value.reshape(1, -1)
-                          if self.weight_mult.value.ndim > 0
-                          else self.weight_mult.value,
+                          self.act_scale.value, self.weight_mult.value,
                           activation_bits=self._abits)
         if self._has_bias:
             out = out + self.bias.value
@@ -433,12 +436,14 @@ class Int8Embedding(_Int8InferenceBase):
         self._padding_idx = qb.inner._padding_idx
 
     def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        # gather the int8 rows first, dequantize only what was fetched
         ids = x.value if isinstance(x, Tensor) else jnp.asarray(x)
-        rows = jnp.take(self.weight_q.value, ids, axis=0)
-        out = rows.astype(jnp.float32) * self.weight_mult.value
-        if self._padding_idx is not None:
-            out = jnp.where((ids == self._padding_idx)[..., None], 0.0,
-                            out)
+        rows = F.embedding(ids, self.weight_q.value,
+                           padding_idx=self._padding_idx)
+        rv = rows.value if isinstance(rows, Tensor) else rows
+        out = rv.astype(jnp.float32) * self.weight_mult.value
         return Tensor(out) if isinstance(x, Tensor) else out
 
     @property

@@ -1,0 +1,88 @@
+"""Worker for the 4-process 2x2 (dp x tp) mesh test — launched through
+paddle_tpu.distributed.launch's start_local_trainers (reference
+fleet/launch_utils.py:351), NOT hand-spawned. Reads the standard
+PADDLE_* env the launcher wires, uses endpoint 0 as the jax.distributed
+coordinator, builds a dp2 x tp2 mesh over the 4 single-device
+processes, and runs a jitted train step where X rides dp and the MLP's
+hidden dimension rides tp — XLA inserts the cross-process collectives.
+Writes per-step losses to $PADDLE_TEST_OUT/losses_rank{r}.json.
+"""
+import json
+import os
+import sys
+
+# scrub the parent test-process env BEFORE jax import: the pytest
+# conftest forces 8 virtual devices per process, which would give this
+# 4-process job 32 global devices instead of 4
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.framework.bringup import force_cpu  # noqa: E402
+
+force_cpu()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
+    endpoints = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert nproc == 4, nproc
+
+    from paddle_tpu.distributed import get_rank, init_distributed
+
+    init_distributed(endpoints[0], nproc, rank)
+    assert get_rank() == rank
+    assert jax.device_count() == nproc, jax.device_count()
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 2), ("dp", "tp"))
+
+    rng = np.random.RandomState(0)
+    per = 4                       # batch shard per dp group
+    dp = 2
+    X = rng.randn(per * dp, 4).astype(np.float32)
+    Y = rng.randn(per * dp, 1).astype(np.float32)
+    W1 = rng.randn(4, 8).astype(np.float32) * 0.5
+    W2 = rng.randn(8, 1).astype(np.float32) * 0.5
+
+    x_shard = NamedSharding(mesh, P("dp", None))
+    w1_shard = NamedSharding(mesh, P(None, "tp"))   # hidden dim on tp
+    w2_shard = NamedSharding(mesh, P("tp", None))
+
+    dp_group = rank // 2          # devices laid out (dp, tp) row-major
+    gx = jax.make_array_from_process_local_data(
+        x_shard, X[dp_group * per:(dp_group + 1) * per])
+    gy = jax.make_array_from_process_local_data(
+        x_shard, Y[dp_group * per:(dp_group + 1) * per])
+    gw1 = jax.device_put(W1, w1_shard)
+    gw2 = jax.device_put(W2, w2_shard)
+
+    @jax.jit
+    def step(w1, w2, x, y):
+        def loss_fn(params):
+            w1, w2 = params
+            h = jax.nn.relu(x @ w1)
+            return jnp.mean((h @ w2 - y) ** 2)
+
+        loss, (g1, g2) = jax.value_and_grad(loss_fn)((w1, w2))
+        return loss, w1 - 0.1 * g1, w2 - 0.1 * g2
+
+    losses = []
+    for _ in range(3):
+        loss, gw1, gw2 = step(gw1, gw2, gx, gy)
+        losses.append(float(loss))
+
+    out_dir = os.environ["PADDLE_TEST_OUT"]
+    with open(os.path.join(out_dir, f"losses_rank{rank}.json"), "w") as f:
+        json.dump(losses, f)
+    print(f"DONE {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

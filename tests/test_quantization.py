@@ -323,3 +323,20 @@ def test_bare_root_linear_ptq_roundtrip():
     m = convert_to_inference(m)
     got = m(x).numpy()
     assert np.abs(got - ref).max() < 0.2
+
+
+def test_int8_matmul_overflow_guard_falls_back():
+    """K large enough to overflow the int32 accumulator routes to the
+    f32 dequantized matmul (sign-correct), not silent wraparound."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.quantization import int8_matmul
+
+    k = (1 << 17) + 128          # beyond the 131071 exactness bound
+    x = jnp.ones((1, k), jnp.float32)
+    w_q = np.full((k, 2), 127, np.int8)
+    got = int8_matmul(x, jnp.asarray(w_q), jnp.asarray(1.0), 1.0 / 127)
+    ref = float(k)               # all-ones x at full scale, w = 1.0
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-3)
+    assert (np.asarray(got) > 0).all()   # wraparound would flip sign
