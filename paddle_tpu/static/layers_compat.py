@@ -21,7 +21,7 @@ family (superseded by DataLoader), and Baidu-internal ops
 (filter_by_instag/continuous_value_model) — see COVERAGE.md §2.4.
 The two-stage detection family (rpn_target_assign, generate_proposals,
 distribute_fpn_proposals, deformable_conv) lives in vision/rcnn.py and
-is re-exported here (round 3; retinanet_target_assign remains out).
+is re-exported here (round 3), retinanet_target_assign included.
 """
 from __future__ import annotations
 
@@ -531,6 +531,7 @@ bipartite_match = VOPS.bipartite_match
 from ..vision import rcnn as _RCNN  # noqa: E402
 
 rpn_target_assign = _RCNN.rpn_target_assign
+retinanet_target_assign = _RCNN.retinanet_target_assign
 generate_proposals = _RCNN.generate_proposals
 distribute_fpn_proposals = _RCNN.distribute_fpn_proposals
 

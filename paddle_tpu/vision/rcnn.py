@@ -36,7 +36,8 @@ import numpy as np
 from ..framework.op import primitive
 
 __all__ = ["generate_proposals", "distribute_fpn_proposals",
-           "rpn_target_assign", "deformable_conv2d"]
+           "rpn_target_assign", "retinanet_target_assign",
+           "deformable_conv2d"]
 
 #: generate_proposals_op.cc kBBoxClipDefault: exp() argument ceiling
 _BBOX_CLIP = math.log(1000.0 / 16.0)
@@ -341,6 +342,106 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
             Tensor(jnp.asarray(cat(out_lbls, 1).astype(np.int32))),
             Tensor(jnp.asarray(cat(out_tgts, 4))),
             Tensor(jnp.asarray(cat(out_w, 4))))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """Assign RetinaNet training targets (rpn_target_assign_op.cc
+    RetinanetTargetAssignKernel / GetAllFgBgGt): the RPN assignment with
+    sampling DISABLED (every anchor above/below the thresholds
+    participates — focal loss replaces subsampling), class labels taken
+    from the matched gt, and the per-image foreground count returned as
+    the focal-loss normalizer (fg_fake_num + 1).
+
+    Dense+lengths inputs like :func:`rpn_target_assign`, plus
+    gt_labels (N, G) int (class ids, 1-based). cls_logits (N, M, C);
+    ``num_classes`` exists for API parity and is validated against C.
+    Returns (predicted_scores (F+B, C), predicted_location (F', 4),
+    target_label (F+B, 1) int32, target_bbox (F', 4),
+    bbox_inside_weight (F', 4), fg_num (N, 1) int32)."""
+    from ..framework.tensor import Tensor, unwrap
+
+    preds = np.asarray(unwrap(bbox_pred), np.float32)
+    logits = np.asarray(unwrap(cls_logits), np.float32)
+    anchors = np.asarray(unwrap(anchor_box), np.float32)
+    gts_all = np.asarray(unwrap(gt_boxes), np.float32)
+    lbl_all = np.asarray(unwrap(gt_labels))
+    crowd_all = np.asarray(unwrap(is_crowd))
+    infos = np.asarray(unwrap(im_info), np.float32)
+    n = preds.shape[0]
+    c = logits.shape[2]
+    if int(num_classes) != c:
+        raise ValueError(
+            f"num_classes={num_classes} but cls_logits carries "
+            f"{c} classes (shape {logits.shape})")
+
+    out_scores, out_locs, out_lbls, out_tgts, out_w, out_fg = \
+        [], [], [], [], [], []
+    for i in range(n):
+        scale = infos[i][2]
+        valid = (crowd_all[i] == 0)
+        gts = gts_all[i][valid] * scale
+        glbl = lbl_all[i][valid]
+        a_num, g_num = anchors.shape[0], gts.shape[0]
+        if g_num > 0:
+            iou = np.asarray(_iou_plus1(jnp.asarray(anchors),
+                                        jnp.asarray(gts)))
+            anchor_max = iou.max(axis=1)
+            anchor_arg = iou.argmax(axis=1)
+            gt_max = iou.max(axis=0)
+            is_gt_best = (np.abs(iou - gt_max[None, :]) < 1e-5).any(axis=1)
+        else:
+            anchor_max = np.zeros((a_num,), np.float32)
+            anchor_arg = np.zeros((a_num,), np.int64)
+            is_gt_best = np.zeros((a_num,), bool)
+
+        # ScoreAssign with batch_size=-1, fg_fraction=-1: no sampling
+        target = np.full((a_num,), -1, np.int64)
+        fg_cand = np.nonzero(is_gt_best |
+                             (anchor_max >= positive_overlap))[0]
+        fg_fake_num = len(fg_cand)
+        target[fg_cand] = 1
+        bg_cand = np.nonzero(anchor_max < negative_overlap)[0]
+        # vectorized fake-fg bookkeeping: with sampling disabled
+        # bg_cand covers most of ~100k anchors, a Python loop would
+        # dominate the step
+        fake_num = int((target[bg_cand] == 1).sum())
+        inside_w = [0.0] * (4 * fake_num) + \
+            [1.0] * (4 * (fg_fake_num - fake_num))
+        fg_fake = [fg_cand[0]] * fake_num
+        target[bg_cand] = 0
+
+        fg_inds = np.nonzero(target == 1)[0]
+        bg_inds = np.nonzero(target == 0)[0]
+        fg_fake = np.asarray(fg_fake + list(fg_inds), np.int64)
+        # class labels: matched gt's class for fg, 0 for bg
+        labels = np.concatenate([
+            (glbl[anchor_arg[fg_inds]].astype(np.int32).reshape(-1)
+             if len(fg_inds) else np.zeros((0,), np.int32)),
+            np.zeros(len(bg_inds), np.int32)])
+        score_index = np.concatenate([fg_inds, bg_inds]).astype(np.int64)
+
+        if fg_fake.size and g_num > 0:
+            tgt = _box_to_delta(anchors[fg_fake], gts[anchor_arg[fg_fake]])
+        else:
+            tgt = np.zeros((0, 4), np.float32)
+        out_scores.append(logits[i].reshape(-1, c)[score_index])
+        out_locs.append(preds[i].reshape(-1, 4)[fg_fake])
+        out_lbls.append(labels[:, None])
+        out_tgts.append(tgt)
+        out_w.append(np.asarray(inside_w, np.float32).reshape(-1, 4))
+        out_fg.append([len(fg_fake) + 1])
+
+    cat = lambda xs, d: (np.concatenate(xs, axis=0) if xs else  # noqa: E731
+                         np.zeros((0, d), np.float32))
+    return (Tensor(jnp.asarray(cat(out_scores, c))),
+            Tensor(jnp.asarray(cat(out_locs, 4))),
+            Tensor(jnp.asarray(cat(out_lbls, 1).astype(np.int32))),
+            Tensor(jnp.asarray(cat(out_tgts, 4))),
+            Tensor(jnp.asarray(cat(out_w, 4))),
+            Tensor(jnp.asarray(np.asarray(out_fg, np.int32))))
 
 
 def _sample(cand, num, rng):

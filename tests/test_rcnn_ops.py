@@ -373,3 +373,34 @@ def test_fluid_layers_exports_and_static_deformable_conv():
         fetch_list=[out])
     assert res.shape == (2, 6, 8, 8)
     assert np.isfinite(res).all()
+
+
+def test_retinanet_target_assign_class_labels_and_fg_num():
+    """No subsampling (focal loss), class labels from the matched gt,
+    fg_num = fg_fake_num + 1 (rpn_target_assign_op.cc GetAllFgBgGt)."""
+    anchors = _grid_anchors()
+    m = anchors.shape[0]
+    rng = np.random.RandomState(0)
+    C = 3
+    preds = rng.randn(1, m, 4).astype(np.float32)
+    logits = rng.randn(1, m, C).astype(np.float32)
+    gt = np.array([[[8, 8, 24, 24], [30, 30, 40, 40]]], np.float32)
+    glbl = np.array([[2, 3]], np.int32)
+    crowd = np.zeros((1, 2), np.int32)
+    info = np.array([[48.0, 48.0, 1.0]], np.float32)
+
+    scores, locs, labels, tgt, w, fg_num = rcnn.retinanet_target_assign(
+        preds, logits, anchors, np.ones_like(anchors), gt, glbl, crowd,
+        info, num_classes=C, positive_overlap=0.5, negative_overlap=0.4)
+    labels = np.asarray(labels.numpy())[:, 0]
+    fg_labels = labels[labels > 0]
+    assert set(fg_labels.tolist()) <= {2, 3}
+    assert len(fg_labels) >= 2              # each gt's best anchor is fg
+    assert scores.numpy().shape[1] == C
+    assert int(fg_num.numpy()[0, 0]) == locs.numpy().shape[0] + 1
+    # no sampling: every anchor below 0.4 max-IoU is background
+    from paddle_tpu.vision.rcnn import _iou_plus1
+    import jax.numpy as jnp
+    iou = np.asarray(_iou_plus1(jnp.asarray(anchors), jnp.asarray(gt[0])))
+    n_bg_expected = int((iou.max(1) < 0.4).sum())
+    assert int((labels == 0).sum()) == n_bg_expected
