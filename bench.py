@@ -34,7 +34,9 @@ report vs_baseline 1.0.
 Env knobs: BENCH_SMOKE=1 forces tiny CPU-friendly shapes (0 forces full
 shapes even off-TPU), BENCH_LAYERS / BENCH_BATCH / BENCH_SEQ /
 BENCH_STEPS overrides, BENCH_BUDGET_S internal wall-clock budget
-(default 480; 0 disables), PADDLE_TPU_PROBE_TIMEOUT probe seconds.
+(default 480; 0 disables), BENCH_TPU_BUDGET_S per-config budget on a
+healthy TPU (default 540; 0 disables), PADDLE_TPU_PROBE_TIMEOUT probe
+seconds.
 """
 from __future__ import annotations
 
@@ -385,11 +387,14 @@ def _base_row(name: str, backend: str) -> dict:
             "device_kind": "unknown", "mfu": None, "config": name}
 
 
-def _placeholder_row(name: str, backend: str, note: str) -> dict:
-    """Parseable row emitted BEFORE measurement on a degraded backend,
-    so a later hang can never leave the driver with nothing to parse."""
+def _placeholder_row(name: str, backend: str, note: str,
+                     degraded: bool = True) -> dict:
+    """Parseable row emitted BEFORE measurement, so a later hang can
+    never leave the driver with nothing to parse. ``degraded=False``
+    marks the healthy-TPU pre-measurement row — everywhere else
+    (cpu fallback, signal exit) the run really is degraded."""
     row = _base_row(name, backend)
-    row.update({"comparable": False, "degraded": True,
+    row.update({"comparable": False, "degraded": degraded,
                 "placeholder": True, "note": note})
     return row
 
@@ -445,12 +450,22 @@ def main():
     backend = ensure_backend()
     state["backend"] = backend
     on_tpu = backend in TPU_PLATFORMS
+    tpu_budget = 0.0
     if on_tpu and "BENCH_BUDGET_S" not in os.environ and \
             hasattr(signal, "alarm"):
-        # a healthy TPU running full shapes must not be killed by the
-        # degraded-path budget (seq-512 compile + 20 steps can pass
-        # 480s over a remote tunnel); SIGTERM coverage stays armed
-        signal.alarm(0)
+        # a healthy TPU running full shapes needs more than the
+        # degraded-path budget (seq-512 compile + 20 steps over a remote
+        # tunnel), but the alarm must stay ARMED: the remote tunnel can
+        # die between the probe and the measurement (observed mid-round),
+        # and an unarmed bench then hangs into the driver's rc=124. The
+        # budget is PER CONFIG (re-armed before each measurement below);
+        # a healthy config measures well under 540 s cold. 0 disables,
+        # like BENCH_BUDGET_S.
+        try:
+            tpu_budget = float(os.environ.get("BENCH_TPU_BUDGET_S", "540"))
+        except ValueError:
+            tpu_budget = 540.0
+        signal.alarm(max(1, int(tpu_budget)) if tpu_budget > 0 else 0)
     smoke_env = os.environ.get("BENCH_SMOKE")
     # full shapes only run on a real TPU (or under explicit BENCH_SMOKE=0)
     smoke = smoke_env == "1" or (smoke_env != "0" and not on_tpu)
@@ -458,12 +473,15 @@ def main():
     # full-shape CPU number must not become a vs_baseline denominator
     degraded = not on_tpu
 
-    if not on_tpu:
-        # a parseable row exists from this point on, whatever happens next
-        print(json.dumps(_placeholder_row(
-            args.config, backend,
+    # a parseable row exists from this point on, whatever happens next —
+    # on TPU too: a tunnel that dies mid-measurement must still leave the
+    # driver a row to parse (the alarm/SIGTERM handler covers the exit)
+    note = (f"backend is {backend!r}; full-shape TPU measurement follows"
+            if on_tpu else
             f"backend is {backend!r} (TPU unreachable); smoke-shape "
-            "measurement follows")), flush=True)
+            "measurement follows")
+    print(json.dumps(_placeholder_row(args.config, backend, note,
+                                      degraded=degraded)), flush=True)
 
     names = ([n for n in CONFIGS if n != args.config] + [args.config]
              if args.all else [args.config])
@@ -474,6 +492,10 @@ def main():
         # headline. Headline stays the LAST line for the driver parser.
         names = ["bert512"] + names
     for name in names:
+        if on_tpu and tpu_budget > 0 and hasattr(signal, "alarm"):
+            # fresh per-config budget: bert512 must not eat the headline
+            # config's alarm window
+            signal.alarm(max(1, int(tpu_budget)))
         row = run_config(name, smoke, backend, degraded=degraded)
         print(json.dumps(row), flush=True)
         if name == args.config:

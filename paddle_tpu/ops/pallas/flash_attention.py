@@ -499,8 +499,11 @@ _flash_attention_core_dropout.defvjp(_flash_attention_core_dropout_fwd,
 def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
                             block_kv=256):
     ql, kl = q.shape[1], k.shape[1]
-    return _flash_attention_core(q, k, v, causal, min(block_q, ql),
-                                 min(block_kv, kl))
+    # blocks must DIVIDE the lengths (the grid floors otherwise, silently
+    # skipping tail tiles); _pallas_ok admits seq % 128 == 0
+    bq = block_q if ql % block_q == 0 else 128
+    bkv = block_kv if kl % block_kv == 0 else 128
+    return _flash_attention_core(q, k, v, causal, bq, bkv)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
@@ -508,8 +511,9 @@ def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
 def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
                                    block_q=256, block_kv=256):
     ql, kl = q.shape[1], k.shape[1]
-    return _flash_attention_core_masked(q, k, v, mask_bias, causal,
-                                        min(block_q, ql), min(block_kv, kl))
+    bq = block_q if ql % block_q == 0 else 128
+    bkv = block_kv if kl % block_kv == 0 else 128
+    return _flash_attention_core_masked(q, k, v, mask_bias, causal, bq, bkv)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "dropout_p",
@@ -547,9 +551,12 @@ def _pallas_ok(q, k, causal, seq_floor=256):
         return False
     b, ql, h, d = q.shape
     kl = k.shape[1]
-    # MXU-friendly tiles; seq floor where the kernel beats XLA (short
-    # sequences fuse fine in XLA), ceiling so K/V stay VMEM-resident
-    return (ql % seq_floor == 0 and kl % seq_floor == 0 and d % 64 == 0 and
+    # 128 is the hard tile modulus (the wrappers fall back to 128-wide
+    # blocks when 256 doesn't divide); seq_floor is a pure perf floor —
+    # where the kernel beats XLA (short sequences fuse fine in XLA).
+    # Ceiling keeps K/V VMEM-resident.
+    return (ql >= seq_floor and kl >= seq_floor and
+            ql % 128 == 0 and kl % 128 == 0 and d % 64 == 0 and
             d <= 256 and kl <= 8192 and ql <= 8192 and
             (not causal or ql == kl))
 
@@ -685,10 +692,13 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
             return _local_attention(q, k, v, is_causal)
     if (mask is None and dropout_p > 0.0 and key_rng is not None and
             q.shape[0] * q.shape[2] < (1 << 15) and
-            _pallas_ok(q, k, is_causal, seq_floor=128)):
+            _pallas_ok(q, k, is_causal)):
         # dropout rides the kernel's hardware PRNG — no HBM mask tensor
-        # (the XLA path materialises (B, H, L, L) keep masks); 128 floor:
-        # XLA-with-dropout is the alternative and loses earlier
+        # (the XLA path materialises (B, H, L, L) keep masks). Floor is
+        # the shared 256: with rbg keys XLA-with-dropout wins at seq 128
+        # (122.8K vs 107.7K tok/s, BERT-base b128 v5e) and loses from
+        # 256 up (105.8K vs 111.8K at b64/s256; 77.0K vs 98.9K at
+        # b32/s512)
         try:
             return _flash_attention_pallas_dropout(
                 q, k, v, _rng_seed_arr(key_rng), dropout_p,
