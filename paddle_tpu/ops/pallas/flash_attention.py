@@ -494,15 +494,26 @@ _flash_attention_core_dropout.defvjp(_flash_attention_core_dropout_fwd,
                                      _flash_attention_core_dropout_bwd)
 
 
+def _pick_blocks(ql, kl, block_q, block_kv):
+    """Block sizes that DIVIDE the lengths (the grid floors otherwise,
+    silently skipping tail tiles): the requested size when it divides,
+    else the 128 tile modulus `_pallas_ok` admits. Lengths outside that
+    contract fail loudly instead of corrupting the output."""
+    bq = block_q if ql % block_q == 0 else 128
+    bkv = block_kv if kl % block_kv == 0 else 128
+    if ql % bq != 0 or kl % bkv != 0:
+        raise ValueError(
+            f"flash attention needs seq lengths divisible by 128 "
+            f"(q {ql}, kv {kl}); route other shapes through "
+            f"flash_attention_or_fallback")
+    return bq, bkv
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
                                              "block_kv"))
 def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
                             block_kv=256):
-    ql, kl = q.shape[1], k.shape[1]
-    # blocks must DIVIDE the lengths (the grid floors otherwise, silently
-    # skipping tail tiles); _pallas_ok admits seq % 128 == 0
-    bq = block_q if ql % block_q == 0 else 128
-    bkv = block_kv if kl % block_kv == 0 else 128
+    bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core(q, k, v, causal, bq, bkv)
 
 
@@ -510,9 +521,7 @@ def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
                                              "block_kv"))
 def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
                                    block_q=256, block_kv=256):
-    ql, kl = q.shape[1], k.shape[1]
-    bq = block_q if ql % block_q == 0 else 128
-    bkv = block_kv if kl % block_kv == 0 else 128
+    bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core_masked(q, k, v, mask_bias, causal, bq, bkv)
 
 
@@ -520,11 +529,7 @@ def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
                                              "block_q", "block_kv"))
 def _flash_attention_pallas_dropout(q, k, v, seed, dropout_p, causal=False,
                                     block_q=256, block_kv=256):
-    ql, kl = q.shape[1], k.shape[1]
-    # blocks must DIVIDE the lengths (the grid floors otherwise, silently
-    # skipping tail tiles); this path admits seq % 128 == 0
-    bq = block_q if ql % block_q == 0 else 128
-    bkv = block_kv if kl % block_kv == 0 else 128
+    bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core_dropout(q, k, v, seed, causal, bq, bkv,
                                          dropout_p)
 

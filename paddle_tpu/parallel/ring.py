@@ -71,9 +71,12 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     idx = jax.lax.axis_index(axis_name)
 
     orig_dtype = q.dtype
-    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # (b, h, lq, d)
-    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    # MXU einsums run in the INPUT dtype (bf16 under AMP = 2x throughput);
+    # softmax statistics and the accumulator stay f32 (flash-standard
+    # mixed precision: scores/acc accumulate via preferred_element_type)
+    qh = jnp.swapaxes(q, 1, 2)                       # (b, h, lq, d)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
     b, h, lq, d = qh.shape
     lk = kh.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -89,7 +92,8 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
         # after s rotations this device holds the block that originated on
         # device (idx - s) mod size
         origin = jnp.mod(idx - s, size)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kc) * scale
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kc,
+                            preferred_element_type=jnp.float32) * scale
         valid = None
         if is_causal:
             q_pos = idx * lq + jnp.arange(lq)[:, None] + causal_offset
@@ -108,7 +112,9 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
             p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(p, axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vc.dtype), vc,
+            preferred_element_type=jnp.float32)
         return m_new, l, acc
 
     def guarded_update(s, m, l, acc, kc, vc, mc):
@@ -144,11 +150,12 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
         return m, l, acc, kc, vc, mc
 
     # derive initial carries from the inputs (0*q) so they carry the same
-    # varying-manual-axes type as the loop outputs (shard_map vma check)
-    zero_q = 0.0 * qh[..., 0]                       # (b, h, lq)
+    # varying-manual-axes type as the loop outputs (shard_map vma check);
+    # f32 regardless of input dtype — they are the softmax statistics
+    zero_q = (0.0 * qh[..., 0]).astype(jnp.float32)  # (b, h, lq)
     m0 = zero_q + _NEG_INF
     l0 = zero_q
-    acc0 = zero_q[..., None] * vh[..., :1, :]       # (b, h, lq, dv)
+    acc0 = zero_q[..., None] * vh[..., :1, :].astype(jnp.float32)
     # a dummy all-True mask keeps the carry structure static when unmasked
     mc0 = mh if has_mask else jnp.zeros((), jnp.bool_)
     # the last block needs no rotation afterwards: loop size-1 rotations,

@@ -145,7 +145,22 @@ def test_bench_sigterm_still_emits_row(tmp_path, delay):
     env = _dead_tunnel_env(tmp_path)
     proc = subprocess.Popen([sys.executable, BENCH], env=env,
                             stdout=subprocess.PIPE,
-                            stderr=subprocess.DEVNULL, text=True)
+                            stderr=subprocess.PIPE, text=True)
+    # the delay clock starts when the handler is armed, not at exec: on a
+    # loaded machine interpreter startup (sitecustomize imports jax) can
+    # eat seconds, and a TERM before the handler gets default disposition.
+    # A reader thread keeps the wait bounded even if stderr goes silent.
+    armed = threading.Event()
+
+    def _wait_armed():
+        for line in proc.stderr:
+            if "signal net armed" in line:
+                armed.set()
+                return
+
+    th = threading.Thread(target=_wait_armed, daemon=True)
+    th.start()
+    armed.wait(timeout=60)
     time.sleep(delay)
     proc.terminate()
     try:

@@ -120,3 +120,39 @@ def test_kv_mask_bias_shapes():
     # float additive masks stay on XLA (their gradient is real there)
     add = jnp.zeros((2, 256), jnp.float32)
     assert fa._kv_mask_bias(add, 2, 256) is None
+
+
+def test_pallas_ok_floor_vs_modulus(monkeypatch):
+    """seq_floor is a perf floor; 128 is the hard tile modulus. Lengths
+    >= floor but not multiples of 256 (384, 640) must stay eligible —
+    the wrappers fall back to 128-wide blocks for them."""
+    monkeypatch.setattr(
+        "paddle_tpu.framework.bringup.pallas_enabled", lambda: True)
+
+    def ok(l):
+        q = jnp.zeros((1, l, 2, 64), jnp.float32)
+        return fa._pallas_ok(q, q, False)
+
+    assert not ok(128)       # below floor: XLA wins there (measured)
+    assert ok(256) and ok(384) and ok(512) and ok(640)
+    assert not ok(192)       # not a multiple of the 128 tile
+    assert not ok(8192 + 128)  # above the VMEM ceiling
+
+
+def test_flash_wrappers_128_block_fallback_at_384():
+    """Non-multiple-of-256 lengths must produce correct output (the
+    grid would silently drop tail tiles if 256 blocks were kept)."""
+    q, k, v = _qkv(l=384)
+    ref = fa._xla_attention(q, k, v, None, 0.0, False, None)
+    out = fa._flash_attention_pallas(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    mask = _padding_mask(2, 384, [300, 384])
+    bias = fa._kv_mask_bias(mask, 2, 384)
+    ref_m = fa._xla_attention(q, k, v, mask[:, None, None, :], 0.0,
+                              False, None)
+    out_m = fa._flash_attention_pallas_masked(q, k, v, bias)
+    valid = np.asarray(mask)
+    np.testing.assert_allclose(np.asarray(out_m)[valid],
+                               np.asarray(ref_m)[valid], rtol=2e-5,
+                               atol=2e-5)

@@ -287,3 +287,17 @@ def test_ring_causal_block_skip_long_seq_parity(mesh_dp2_sp4):
     for a, bb in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_bf16_inputs(mesh_dp2_sp4, causal):
+    """bf16 q/k/v keep the MXU einsums in bf16 (2x throughput under AMP)
+    while softmax stats/accumulator stay f32 — output must track the f32
+    reference within bf16 tolerance and come back as bf16."""
+    q, k, v = _qkv(l=32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = _xla_attention(q, k, v, None, 0.0, causal, None)
+    out = ring_attention(qb, kb, vb, mesh=mesh_dp2_sp4, is_causal=causal)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
