@@ -94,12 +94,17 @@ def test_workers_outpace_single_thread():
 
     # 4 workers on ~5.1s of pure sleep: big enough that the promoted
     # forkserver context's per-iterator worker startup (~1.4s — fresh
-    # workers re-run main-module fixup) amortizes; demand >=1.3x, with
-    # one retry so a CI box under heavy load can't flake the suite
-    serial, parallel = measure()
-    if parallel >= serial / 1.3:
+    # workers re-run main-module fixup) amortizes; demand >=1.3x on the
+    # best of 3 attempts — a box under heavy external load (parallel CI
+    # shards) can starve the workers on any single attempt
+    best, best_ratio = None, float("inf")
+    for _attempt in range(3):
         serial, parallel = measure()
-    assert parallel < serial / 1.3, (serial, parallel)
+        if parallel < serial / 1.3:
+            return
+        if parallel / serial < best_ratio:
+            best, best_ratio = (serial, parallel), parallel / serial
+    raise AssertionError(f"workers never outpaced serial: best {best}")
 
 
 def test_worker_death_raises_not_hangs():
