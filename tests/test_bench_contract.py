@@ -183,19 +183,24 @@ def test_probe_cache_skips_repeat_timeout(tmp_path):
            "t0=time.monotonic();"
            "p=b.probe_backend();"
            "print('P1', p, round(time.monotonic()-t0, 2))")
-    t0 = time.monotonic()
+    def _probe_secs(out):
+        # The subprocess prints its own in-process elapsed ("P1 None 4.0"),
+        # which excludes interpreter startup — wall-clock around the
+        # subprocess is load-sensitive (importing jax under a saturated
+        # machine can alone exceed the probe timeout).
+        return float(out.stdout.split()[-1])
+
     out1 = subprocess.run([sys.executable, "-c", src], env=env,
-                          capture_output=True, text=True, timeout=120)
-    dt1 = time.monotonic() - t0
+                          capture_output=True, text=True, timeout=180)
     assert "P1 None" in out1.stdout, (out1.stdout, out1.stderr)
+    dt1 = _probe_secs(out1)
     assert dt1 > 3, "first probe should pay the timeout"
-    t0 = time.monotonic()
     out2 = subprocess.run([sys.executable, "-c", src], env=env,
-                          capture_output=True, text=True, timeout=120)
-    dt2 = time.monotonic() - t0
+                          capture_output=True, text=True, timeout=180)
     assert "P1 None" in out2.stdout
+    dt2 = _probe_secs(out2)
     assert dt2 < dt1, (dt1, dt2)
-    assert dt2 < 4, f"cached probe verdict should be instant, took {dt2}"
+    assert dt2 < 2, f"cached probe verdict should be instant, took {dt2}"
 
 
 def test_library_first_touch_degrades_not_hangs(tmp_path):
