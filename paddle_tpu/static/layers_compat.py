@@ -534,6 +534,21 @@ rpn_target_assign = _RCNN.rpn_target_assign
 retinanet_target_assign = _RCNN.retinanet_target_assign
 generate_proposals = _RCNN.generate_proposals
 distribute_fpn_proposals = _RCNN.distribute_fpn_proposals
+collect_fpn_proposals = _RCNN.collect_fpn_proposals
+generate_proposal_labels = _RCNN.generate_proposal_labels
+generate_mask_labels = _RCNN.generate_mask_labels
+
+# single-stage / OCR / metric long tail (round 3) — vision/ops.py;
+# target_assign & polygon_box_transform & box_decoder_and_assign &
+# roi_perspective_transform jit onto TPU, the NMS-family ones are
+# host-materializing like multiclass_nms above
+target_assign = VOPS.target_assign
+polygon_box_transform = VOPS.polygon_box_transform
+box_decoder_and_assign = VOPS.box_decoder_and_assign
+roi_perspective_transform = VOPS.roi_perspective_transform
+locality_aware_nms = VOPS.locality_aware_nms
+retinanet_detection_output = VOPS.retinanet_detection_output
+detection_map = VOPS.detection_map
 
 
 def deformable_conv(input, offset, mask, num_filters, filter_size,

@@ -10,6 +10,7 @@ count in a mask) instead of the reference's dynamic-length outputs.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -19,7 +20,10 @@ from ..framework.op import primitive
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "roi_align", "nms",
            "iou_matrix", "multiclass_nms", "matrix_nms",
-           "density_prior_box", "ssd_loss"]
+           "density_prior_box", "ssd_loss", "target_assign",
+           "polygon_box_transform", "box_decoder_and_assign",
+           "roi_perspective_transform", "locality_aware_nms",
+           "retinanet_detection_output", "detection_map"]
 
 
 @primitive("yolo_box", nondiff=("img_size",))
@@ -724,3 +728,578 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box_arr,
                                jnp.asarray(gt_box, jnp.float32),
                                jnp.asarray(gt_label, jnp.int32))
     return loss[:, None]
+
+
+# ---------------------------------------------------------------------------
+# single-stage / OCR long tail (round 3)
+# ---------------------------------------------------------------------------
+
+
+@primitive("target_assign", nondiff=("match_indices", "lengths",
+                                     "neg_indices", "neg_lengths"))
+def target_assign(x, match_indices, lengths=None, neg_indices=None,
+                  neg_lengths=None, mismatch_value=0, name=None):
+    """Gather per-prediction targets by match index (target_assign_op.h).
+
+    x: (total_entities, P, K) flat per-image entity rows with
+    ``lengths`` (N,) per-image counts (the dense+lengths rewrite of the
+    reference's 1-level LoD input); match_indices: (N, M) int, -1 =
+    unmatched. out[i, j] = x[offset[i] + match[i, j], j % P]; matched
+    weight 1, unmatched rows filled with ``mismatch_value``, weight 0.
+    neg_indices (+ neg_lengths): per-image prediction columns forced to
+    ``mismatch_value`` with weight 1 (SSD negative mining).
+
+    Static shapes throughout — the gather indices are data, the shapes
+    are not, so the whole op jit-compiles onto TPU.
+    """
+    x = jnp.asarray(x)
+    mi = jnp.asarray(match_indices, jnp.int32)
+    n, m = mi.shape
+    if x.ndim == 2:
+        x = x[:, None, :]
+    p, k = x.shape[1], x.shape[2]
+    if lengths is None:
+        off = jnp.zeros((n,), jnp.int32)
+    else:
+        lv = jnp.asarray(lengths, jnp.int32)
+        off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(lv)[:-1]])
+    cols = jnp.arange(m, dtype=jnp.int32) % p                    # (M,)
+    rows = off[:, None] + jnp.maximum(mi, 0)                     # (N, M)
+    gathered = x[rows, cols[None, :], :]                         # (N, M, K)
+    matched = mi > -1
+    out = jnp.where(matched[..., None], gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    wt = matched.astype(jnp.float32)[..., None]                  # (N, M, 1)
+    if neg_indices is not None:
+        ni = jnp.asarray(neg_indices, jnp.int32).reshape(-1)
+        if neg_lengths is None:
+            img = jnp.zeros(ni.shape, jnp.int32)
+        else:
+            nl = jnp.asarray(neg_lengths, jnp.int32)
+            img = jnp.repeat(jnp.arange(n, dtype=jnp.int32), nl,
+                             total_repeat_length=ni.shape[0])
+        out = out.at[img, ni, :].set(jnp.asarray(mismatch_value, x.dtype))
+        wt = wt.at[img, ni, 0].set(1.0)
+    return out, wt
+
+
+@primitive("polygon_box_transform")
+def polygon_box_transform(input, name=None):
+    """EAST OCR geometry-map offsets -> absolute vertex coordinates
+    (polygon_box_transform_op.cc). input (N, 2m, H, W): even channels
+    hold x-offsets, odd channels y-offsets, on a 4-pixel grid:
+    out_even = 4*w - v, out_odd = 4*h - v."""
+    x = jnp.asarray(input)
+    n, c, h, w = x.shape
+    xs = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
+    ys = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
+    even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return jnp.where(even, xs - x, ys - x)
+
+
+@primitive("box_decoder_and_assign", nondiff=("box_score",))
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    """Per-class box decode + argmax-class assignment
+    (box_decoder_and_assign_op.h). prior_box (R, 4) [x1 y1 x2 y2, +1
+    legacy widths]; prior_box_var (4,); target_box (R, 4C) per-class
+    deltas; box_score (R, C). Returns (decode_box (R, 4C), assign_box
+    (R, 4)) where assign_box picks the decoded box of the best-scoring
+    non-background class (falling back to the prior)."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    pv = jnp.asarray(prior_box_var, jnp.float32).reshape(4)
+    tb = jnp.asarray(target_box, jnp.float32)
+    sc = jnp.asarray(box_score, jnp.float32)
+    r = pb.shape[0]
+    c = sc.shape[1]
+    d = tb.reshape(r, c, 4)
+    w = pb[:, 2] - pb[:, 0] + 1.0
+    h = pb[:, 3] - pb[:, 1] + 1.0
+    cx = pb[:, 0] + w / 2
+    cy = pb[:, 1] + h / 2
+    dw = jnp.minimum(pv[2] * d[:, :, 2], box_clip)
+    dh = jnp.minimum(pv[3] * d[:, :, 3], box_clip)
+    ncx = pv[0] * d[:, :, 0] * w[:, None] + cx[:, None]
+    ncy = pv[1] * d[:, :, 1] * h[:, None] + cy[:, None]
+    nw = jnp.exp(dw) * w[:, None]
+    nh = jnp.exp(dh) * h[:, None]
+    dec = jnp.stack([ncx - nw / 2, ncy - nh / 2,
+                     ncx + nw / 2 - 1, ncy + nh / 2 - 1], axis=-1)
+    # best non-background class, strictly-greater scan from class 1 up
+    fg = sc.at[:, 0].set(-jnp.inf) if c > 1 else sc
+    max_j = jnp.argmax(fg, axis=1) if c > 1 else jnp.zeros((r,), jnp.int32)
+    assigned = jnp.where((max_j > 0)[:, None],
+                         dec[jnp.arange(r), max_j], pb)
+    return dec.reshape(r, c * 4), assigned
+
+
+def _quad_transform_matrix(rx, ry, tw, th):
+    """Homography mapping output-grid coords onto the source quad
+    (roi_perspective_transform_op.cc get_transform_matrix), incl. the
+    reference's estimated-size renormalisation of the output width."""
+    x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+    y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+    len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+    len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+    len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+    len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+    est_h = (len2 + len4) / 2.0
+    est_w = (len1 + len3) / 2.0
+    nh = max(2, th)
+    nw = jnp.round(est_w * (nh - 1) / est_h) + 1
+    nw = jnp.clip(nw, 2, tw)
+    dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+    dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+    den = dx1 * dy2 - dx2 * dy1 + 1e-5
+    a31 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    a32 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    a11 = (x1 - x0 + a31 * (nw - 1) * x1) / (nw - 1)
+    a12 = (x3 - x0 + a32 * (nh - 1) * x3) / (nh - 1)
+    a21 = (y1 - y0 + a31 * (nw - 1) * y1) / (nw - 1)
+    a22 = (y3 - y0 + a32 * (nh - 1) * y3) / (nh - 1)
+    return jnp.stack([a11, a12, x0, a21, a22, y0, a31, a32,
+                      jnp.ones_like(a11)])
+
+
+def _in_quad(px, py, rx, ry, eps=1e-4):
+    """Even-odd (crossing-number) point-in-quad test, vectorised over a
+    grid of points. Edges within ``eps`` count as inside (the reference
+    uses the same tolerance via its GT_E comparisons)."""
+    inside = jnp.zeros(px.shape, bool)
+    on_edge = jnp.zeros(px.shape, bool)
+    for i in range(4):
+        j = (i + 1) % 4
+        x1, y1, x2, y2 = rx[i], ry[i], rx[j], ry[j]
+        # point-on-segment (cross product ~ 0 and within bbox)
+        cross = (x2 - x1) * (py - y1) - (y2 - y1) * (px - x1)
+        seg_len = jnp.sqrt((x2 - x1) ** 2 + (y2 - y1) ** 2) + 1e-12
+        near = (jnp.abs(cross) / seg_len <= eps) & \
+            (px >= jnp.minimum(x1, x2) - eps) & \
+            (px <= jnp.maximum(x1, x2) + eps) & \
+            (py >= jnp.minimum(y1, y2) - eps) & \
+            (py <= jnp.maximum(y1, y2) + eps)
+        on_edge = on_edge | near
+        crosses = ((y1 > py) != (y2 > py)) & \
+            (px < (x2 - x1) * (py - y1) / (y2 - y1 + 1e-12) + x1)
+        inside = inside ^ crosses
+    return inside | on_edge
+
+
+def roi_perspective_transform(x, rois, lengths=None, transformed_height=8,
+                              transformed_width=8, spatial_scale=1.0,
+                              name=None):
+    """Warp quadrilateral RoIs to a fixed-size grid via perspective
+    transform + bilinear sampling (roi_perspective_transform_op.cc, the
+    OCR/EAST text-rectification op).
+
+    x: (N, C, H, W); rois: (R, 8) quads [x0 y0 ... x3 y3] with
+    ``lengths`` (N,) rois-per-image. Returns (out (R, C, th, tw),
+    mask (R, 1, th, tw) int32, transform_matrix (R, 9)). One jit,
+    vmapped over RoIs: the per-pixel homography/bilinear math is dense
+    fixed-shape arithmetic — no reference-style scalar loops."""
+    from ..framework.tensor import Tensor, unwrap
+
+    xv = jnp.asarray(unwrap(x), jnp.float32)
+    rv = jnp.asarray(unwrap(rois), jnp.float32).reshape(-1, 8)
+    n, ch, hh, ww = xv.shape
+    r = rv.shape[0]
+    th, tw = int(transformed_height), int(transformed_width)
+    if lengths is None:
+        roi2img = jnp.zeros((r,), jnp.int32)
+    else:
+        lv = np.asarray(unwrap(lengths)).astype(np.int64).reshape(-1)
+        roi2img = jnp.asarray(np.repeat(np.arange(n), lv), jnp.int32)
+
+    @jax.jit
+    def run(xv, rv, roi2img):
+        def one(roi, img_id):
+            rx = roi[0::2] * spatial_scale
+            ry = roi[1::2] * spatial_scale
+            mat = _quad_transform_matrix(rx, ry, tw, th)
+            ow = jnp.arange(tw, dtype=jnp.float32)[None, :]
+            oh = jnp.arange(th, dtype=jnp.float32)[:, None]
+            u = mat[0] * ow + mat[1] * oh + mat[2]
+            v = mat[3] * ow + mat[4] * oh + mat[5]
+            wdiv = mat[6] * ow + mat[7] * oh + mat[8]
+            in_w = u / wdiv
+            in_h = v / wdiv
+            ok_quad = _in_quad(in_w, in_h, rx, ry)
+            inb = (in_w > -0.5) & (in_w < ww - 0.5) & \
+                (in_h > -0.5) & (in_h < hh - 0.5)
+            valid = ok_quad & inb
+            cw = jnp.clip(in_w, 0.0, ww - 1.0)
+            chh = jnp.clip(in_h, 0.0, hh - 1.0)
+            w0 = jnp.floor(cw).astype(jnp.int32)
+            h0 = jnp.floor(chh).astype(jnp.int32)
+            w0 = jnp.minimum(w0, ww - 1)
+            h0 = jnp.minimum(h0, hh - 1)
+            w1 = jnp.minimum(w0 + 1, ww - 1)
+            h1 = jnp.minimum(h0 + 1, hh - 1)
+            fw = cw - w0
+            fh = chh - h0
+            img = xv[img_id]                                 # (C, H, W)
+            v1 = img[:, h0, w0]
+            v2 = img[:, h1, w0]
+            v3 = img[:, h1, w1]
+            v4 = img[:, h0, w1]
+            val = ((1 - fw) * (1 - fh) * v1 + (1 - fw) * fh * v2 +
+                   fw * fh * v3 + fw * (1 - fh) * v4)
+            out = jnp.where(valid[None], val, 0.0)
+            return out, valid.astype(jnp.int32)[None], mat
+
+        return jax.vmap(one)(rv, roi2img)
+
+    out, mask, mats = run(xv, rv, roi2img)
+    return Tensor(out), Tensor(mask), Tensor(mats)
+
+
+def _np_jaccard(a, b, normalized):
+    """Host IoU of two xyxy boxes (nms_util.h JaccardOverlap)."""
+    off = 0.0 if normalized else 1.0
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    iw, ih = max(0.0, ix2 - ix1 + off), max(0.0, iy2 - iy1 + off)
+    inter = iw * ih
+    aa = (a[2] - a[0] + off) * (a[3] - a[1] + off)
+    ab = (b[2] - b[0] + off) * (b[3] - b[1] + off)
+    return inter / (aa + ab - inter) if aa + ab - inter > 0 else 0.0
+
+
+def _np_poly_iou(a, b):
+    """Convex-polygon IoU via Sutherland-Hodgman clipping (host).
+
+    The reference (poly_util.cc) links the GPC general clipper; OCR
+    quads are convex in practice, for which half-plane clipping is
+    exact. Points are [x0 y0 x1 y1 ...]."""
+    def area(p):
+        x, y = p[:, 0], p[:, 1]
+        return 0.5 * abs(np.dot(x, np.roll(y, -1)) - np.dot(y, np.roll(x, -1)))
+
+    def clip(subject, p1, p2):
+        out = []
+        n = len(subject)
+        for i in range(n):
+            cur, nxt = subject[i], subject[(i + 1) % n]
+            side_c = ((p2[0] - p1[0]) * (cur[1] - p1[1]) -
+                      (p2[1] - p1[1]) * (cur[0] - p1[0]))
+            side_n = ((p2[0] - p1[0]) * (nxt[1] - p1[1]) -
+                      (p2[1] - p1[1]) * (nxt[0] - p1[0]))
+            if side_c >= 0:
+                out.append(cur)
+            if side_c * side_n < 0:
+                t = side_c / (side_c - side_n)
+                out.append(cur + t * (nxt - cur))
+        return out
+
+    pa = np.asarray(a, np.float64).reshape(-1, 2)
+    pb = np.asarray(b, np.float64).reshape(-1, 2)
+    # orient counter-clockwise (positive signed area)
+    def ccw(p):
+        s = np.dot(p[:, 0], np.roll(p[:, 1], -1)) - \
+            np.dot(p[:, 1], np.roll(p[:, 0], -1))
+        return p if s >= 0 else p[::-1]
+    pa, pb = ccw(pa), ccw(pb)
+    poly = [pa[i] for i in range(len(pa))]
+    for i in range(len(pb)):
+        if not poly:
+            break
+        poly = clip(poly, pb[i], pb[(i + 1) % len(pb)])
+    inter = area(np.asarray(poly)) if len(poly) >= 3 else 0.0
+    ua = area(pa) + area(pb) - inter
+    return inter / ua if ua > 1e-12 else 0.0
+
+
+def _box_overlap(a, b, normalized):
+    if len(a) == 4:
+        return _np_jaccard(a, b, normalized)
+    return _np_poly_iou(a, b)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                       keep_top_k=-1, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS for scene-text detection
+    (locality_aware_nms_op.cc, EAST pipeline).
+
+    bboxes (N, M, B) with B in {4, 8, 16, 24, 32} (xyxy or polygon
+    vertices); scores (N, C, M). A first pass walks the boxes in input
+    order score-weight-merging consecutive overlapping boxes (the
+    "locality" trick: EAST emits geo-sorted quads, so neighbours on the
+    text line merge in O(M)); survivors then go through standard greedy
+    NMS with eta-adaptive threshold and cross-class keep_top_k. Output
+    is host-materializing like :func:`multiclass_nms`: rows
+    [label, merged_score, box...] + per-image counts.
+
+    Host-side by design (the reference registers CPU only): the merge
+    is a sequential data-dependent recurrence over ragged survivors —
+    compiled fixed-shape NMS lives in :func:`multiclass_nms`."""
+    from ..framework.tensor import Tensor, unwrap
+
+    bv = np.array(unwrap(bboxes), np.float32, copy=True)
+    sv = np.array(unwrap(scores), np.float32, copy=True)
+    n, m, box_size = bv.shape
+    c = sv.shape[1]
+    all_rows, counts = [], []
+    for i in range(n):
+        indices = {}          # class -> kept indices (into merged arrays)
+        boxes_i = bv[i]
+        scores_i = sv[i]
+        num_det = 0
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = scores_i[cls]               # mutated in place by merge
+            b = boxes_i                     # shared across classes (ref.)
+            # pass 1: locality-aware merge in input order
+            skip = np.ones(m, bool)
+            idx = -1
+            for j in range(m):
+                if idx > -1:
+                    ov = _box_overlap(b[j], b[idx], normalized)
+                    if ov > nms_threshold:
+                        tot = s[j] + s[idx]
+                        b[idx] = (b[j] * s[j] + b[idx] * s[idx]) / tot
+                        s[idx] = tot
+                    else:
+                        skip[idx] = False
+                        idx = j
+                else:
+                    idx = j
+            if idx > -1:
+                skip[idx] = False
+            cand = [(s[j], j) for j in range(m)
+                    if s[j] > score_threshold and not skip[j]]
+            cand.sort(key=lambda p: -p[0])
+            if nms_top_k > -1:
+                cand = cand[:nms_top_k]
+            # pass 2: greedy NMS with adaptive threshold
+            kept = []
+            adaptive = nms_threshold
+            for score, j in cand:
+                keep = all(_box_overlap(b[j], b[k], normalized) <= adaptive
+                           for k in kept)
+                if keep:
+                    kept.append(j)
+                    if nms_eta < 1 and adaptive > 0.5:
+                        adaptive *= nms_eta
+            indices[cls] = kept
+            num_det += len(kept)
+        if keep_top_k > -1 and num_det > keep_top_k:
+            pairs = [(scores_i[cls][j], cls, j)
+                     for cls, kept in indices.items() for j in kept]
+            pairs.sort(key=lambda p: -p[0])
+            pairs = pairs[:keep_top_k]
+            indices = {}
+            for score, cls, j in pairs:
+                indices.setdefault(cls, []).append(j)
+            num_det = keep_top_k
+        rows = []
+        for cls in sorted(indices):
+            for j in indices[cls]:
+                rows.append(np.concatenate(
+                    [[float(cls), scores_i[cls][j]], boxes_i[j]]))
+        counts.append(len(rows))
+        if rows:
+            all_rows.append(np.stack(rows))
+    out = (np.concatenate(all_rows, axis=0) if all_rows
+           else np.zeros((0, box_size + 2), np.float32))
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0, name=None):
+    """RetinaNet multi-level post-processing
+    (retinanet_detection_output_op.cc).
+
+    bboxes: list of (N, Ai, 4) per-FPN-level deltas; scores: list of
+    (N, Ai, C) sigmoid class probabilities; anchors: list of (Ai, 4);
+    im_info (N, 3) [h, w, scale]. Per image: per-level top-k over the
+    flattened (anchor, class) scores (threshold 0 on the coarsest
+    level), anchor decode without variances, /scale + clip to the
+    original image, then per-class greedy NMS and cross-class
+    keep_top_k. Rows [label+1, score, x0, y0, x1, y1] sorted by score,
+    plus per-image counts (dense+lengths)."""
+    from ..framework.tensor import Tensor, unwrap
+
+    blist = [np.asarray(unwrap(b), np.float32) for b in bboxes]
+    slist = [np.asarray(unwrap(s), np.float32) for s in scores]
+    alist = [np.asarray(unwrap(a), np.float32).reshape(-1, 4)
+             for a in anchors]
+    info = np.asarray(unwrap(im_info), np.float32).reshape(-1, 3)
+    n = slist[0].shape[0]
+    c = slist[0].shape[2]
+    nlv = len(slist)
+    all_rows, counts = [], []
+    for i in range(n):
+        im_h, im_w, im_scale = info[i]
+        oh = round(float(im_h) / im_scale)
+        ow = round(float(im_w) / im_scale)
+        preds = {}                       # class -> [ [x1,y1,x2,y2,score] ]
+        for lv in range(nlv):
+            sc = slist[lv][i].reshape(-1)               # (Ai*C,)
+            thr = score_threshold if lv < nlv - 1 else 0.0
+            sel = np.nonzero(sc > thr)[0]
+            order = sel[np.argsort(-sc[sel], kind="stable")]
+            if nms_top_k > -1:
+                order = order[:nms_top_k]
+            deltas = blist[lv][i]
+            anc = alist[lv]
+            for idx in order:
+                a_i, cls = idx // c, idx % c
+                ax1, ay1, ax2, ay2 = anc[a_i]
+                aw, ah = ax2 - ax1 + 1, ay2 - ay1 + 1
+                acx, acy = ax1 + aw / 2, ay1 + ah / 2
+                dx, dy, dw, dh = deltas[a_i]
+                pcx, pcy = dx * aw + acx, dy * ah + acy
+                pw, ph = math.exp(dw) * aw, math.exp(dh) * ah
+                x1 = (pcx - pw / 2) / im_scale
+                y1 = (pcy - ph / 2) / im_scale
+                x2 = (pcx + pw / 2 - 1) / im_scale
+                y2 = (pcy + ph / 2 - 1) / im_scale
+                x1 = min(max(x1, 0.0), ow - 1)
+                y1 = min(max(y1, 0.0), oh - 1)
+                x2 = min(max(x2, 0.0), ow - 1)
+                y2 = min(max(y2, 0.0), oh - 1)
+                preds.setdefault(int(cls), []).append(
+                    [x1, y1, x2, y2, float(sc[idx])])
+        # per-class greedy NMS
+        pairs = []                       # (score, cls, det-row)
+        for cls, dets in preds.items():
+            dets.sort(key=lambda d: -d[4])
+            kept = []
+            adaptive = nms_threshold
+            for d in dets:
+                keep = all(_np_jaccard(d[:4], k[:4], False) <= adaptive
+                           for k in kept)
+                if keep:
+                    kept.append(d)
+                    if nms_eta < 1 and adaptive > 0.5:
+                        adaptive *= nms_eta
+            pairs.extend((d[4], cls, d) for d in kept)
+        pairs.sort(key=lambda p: -p[0])
+        if keep_top_k > -1 and len(pairs) > keep_top_k:
+            pairs = pairs[:keep_top_k]
+        rows = [np.asarray([cls + 1, d[4], d[0], d[1], d[2], d[3]],
+                           np.float32) for _, cls, d in pairs]
+        counts.append(len(rows))
+        if rows:
+            all_rows.append(np.stack(rows))
+    out = (np.concatenate(all_rows, axis=0) if all_rows
+           else np.zeros((0, 6), np.float32))
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+def detection_map(detect_res, label, class_num, det_lengths=None,
+                  label_lengths=None, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", state=None, name=None):
+    """Detection mean-average-precision (detection_map_op.h).
+
+    detect_res (M, 6) rows [label, score, x1, y1, x2, y2] with
+    det_lengths (N,) per-image counts; label (G, 6) rows
+    [label, difficult, x1, y1, x2, y2] (or (G, 5) without the
+    difficult flag) with label_lengths. ``state`` threads the
+    accumulators the reference keeps in PosCount/TruePos/FalsePos
+    LoDTensors: pass the returned state back in to accumulate across
+    batches (HasState=1 semantics). Returns (mAP, state)."""
+    from ..framework.tensor import unwrap
+
+    det = np.asarray(unwrap(detect_res), np.float32).reshape(-1, 6)
+    lab = np.asarray(unwrap(label), np.float32)
+    lab = lab.reshape(-1, lab.shape[-1])
+    has_difficult = lab.shape[1] == 6
+    dl = (np.asarray(unwrap(det_lengths), np.int64).reshape(-1)
+          if det_lengths is not None else np.asarray([det.shape[0]]))
+    ll = (np.asarray(unwrap(label_lengths), np.int64).reshape(-1)
+          if label_lengths is not None else np.asarray([lab.shape[0]]))
+    n = len(dl)
+    if state is None:
+        pos_count, true_pos, false_pos = {}, {}, {}
+    else:
+        pos_count = dict(state[0])
+        true_pos = {k: list(v) for k, v in state[1].items()}
+        false_pos = {k: list(v) for k, v in state[2].items()}
+
+    doff = np.concatenate([[0], np.cumsum(dl)])
+    loff = np.concatenate([[0], np.cumsum(ll)])
+    for i in range(n):
+        gts = {}            # cls -> [(box, difficult)]
+        for row in lab[loff[i]:loff[i + 1]]:
+            cls = int(row[0])
+            if has_difficult:
+                gts.setdefault(cls, []).append((row[2:6], bool(row[1])))
+            else:
+                gts.setdefault(cls, []).append((row[1:5], False))
+        for cls, boxes in gts.items():
+            cnt = (len(boxes) if evaluate_difficult
+                   else sum(1 for _, d in boxes if not d))
+            if cnt:
+                pos_count[cls] = pos_count.get(cls, 0) + cnt
+        dets = {}
+        for row in det[doff[i]:doff[i + 1]]:
+            dets.setdefault(int(row[0]), []).append((float(row[1]), row[2:6]))
+        for cls, preds in dets.items():
+            if cls not in gts:
+                for score, _ in preds:
+                    true_pos.setdefault(cls, []).append((score, 0))
+                    false_pos.setdefault(cls, []).append((score, 1))
+                continue
+            boxes = gts[cls]
+            visited = [False] * len(boxes)
+            preds = sorted(preds, key=lambda p: -p[0])
+            for score, pbox in preds:
+                pb = np.clip(pbox, 0.0, 1.0)
+                ious = [_np_jaccard(pb, g, True) for g, _ in boxes]
+                j = int(np.argmax(ious)) if ious else 0
+                if ious and ious[j] > overlap_threshold:
+                    if evaluate_difficult or not boxes[j][1]:
+                        tp = 0 if visited[j] else 1
+                        visited[j] = visited[j] or bool(tp)
+                        true_pos.setdefault(cls, []).append((score, tp))
+                        false_pos.setdefault(cls, []).append((score, 1 - tp))
+                else:
+                    true_pos.setdefault(cls, []).append((score, 0))
+                    false_pos.setdefault(cls, []).append((score, 1))
+
+    m_ap, count = 0.0, 0
+    for cls, npos in pos_count.items():
+        if npos == background_label:
+            continue
+        if cls not in true_pos:
+            count += 1
+            continue
+        tps = sorted(true_pos[cls], key=lambda p: -p[0])
+        fps = sorted(false_pos[cls], key=lambda p: -p[0])
+        tp_sum = np.cumsum([t for _, t in tps])
+        fp_sum = np.cumsum([f for _, f in fps])
+        prec = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+        rec = tp_sum / npos
+        if ap_version == "11point":
+            maxp = np.zeros(11)
+            start = len(rec) - 1
+            for j in range(10, -1, -1):
+                for i2 in range(start, -1, -1):
+                    if rec[i2] < j / 10.0:
+                        start = i2
+                        if j > 0:
+                            maxp[j - 1] = maxp[j]
+                        break
+                    maxp[j] = max(maxp[j], prec[i2])
+            m_ap += float(np.sum(maxp) / 11)
+        else:
+            prev_r = 0.0
+            ap = 0.0
+            for p_, r_ in zip(prec, rec):
+                if abs(r_ - prev_r) > 1e-6:
+                    ap += p_ * abs(r_ - prev_r)
+                prev_r = r_
+            m_ap += ap
+        count += 1
+    if count:
+        m_ap /= count
+    return float(m_ap), (pos_count, true_pos, false_pos)

@@ -1,0 +1,482 @@
+"""Round-3 detection long-tail ops vs numpy transliterations of the
+reference kernels (operators/detection/: target_assign_op.h,
+polygon_box_transform_op.cc, box_decoder_and_assign_op.h,
+locality_aware_nms_op.cc, retinanet_detection_output_op.cc,
+collect_fpn_proposals_op.h, generate_proposal_labels_op.cc,
+generate_mask_labels_op.cc, roi_perspective_transform_op.cc,
+detection_map_op.h)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (backend bring-up guard)
+from paddle_tpu.vision import ops as vops
+from paddle_tpu.vision import rcnn
+
+
+def _np(x):
+    import jax
+    if hasattr(x, "value"):
+        x = x.value
+    if isinstance(x, jax.Array):
+        return np.asarray(x)
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# target_assign
+# ---------------------------------------------------------------------------
+
+
+def test_target_assign_matches_reference_loop():
+    rng = np.random.RandomState(0)
+    n, m, p, k = 3, 5, 1, 4
+    lengths = np.asarray([2, 3, 1])
+    x = rng.randn(int(lengths.sum()), p, k).astype(np.float32)
+    match = np.full((n, m), -1, np.int32)
+    match[0, 0] = 1
+    match[0, 3] = 0
+    match[1, 2] = 2
+    match[2, 4] = 0
+    neg = np.asarray([1, 0, 2], np.int32)   # flat negative columns
+    neg_len = np.asarray([1, 1, 1])
+
+    out, wt = vops.target_assign(x, match, lengths=lengths,
+                                 neg_indices=neg, neg_lengths=neg_len,
+                                 mismatch_value=0)
+    out, wt = _np(out), _np(wt)
+
+    off = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+    exp = np.zeros((n, m, k), np.float32)
+    exp_w = np.zeros((n, m, 1), np.float32)
+    for i in range(n):
+        for j in range(m):
+            idx = match[i, j]
+            if idx > -1:
+                exp[i, j] = x[off[i] + idx, j % p]
+                exp_w[i, j] = 1.0
+    pos = 0
+    for i in range(n):
+        for _ in range(neg_len[i]):
+            exp[i, neg[pos]] = 0.0
+            exp_w[i, neg[pos]] = 1.0
+            pos += 1
+    np.testing.assert_allclose(out, exp, rtol=1e-6)
+    np.testing.assert_allclose(wt, exp_w)
+
+
+# ---------------------------------------------------------------------------
+# polygon_box_transform
+# ---------------------------------------------------------------------------
+
+
+def test_polygon_box_transform_formula():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 3, 5).astype(np.float32)
+    out = _np(vops.polygon_box_transform(x))
+    exp = np.empty_like(x)
+    for nn in range(2):
+        for c in range(4):
+            for h in range(3):
+                for w in range(5):
+                    if c % 2 == 0:
+                        exp[nn, c, h, w] = w * 4 - x[nn, c, h, w]
+                    else:
+                        exp[nn, c, h, w] = h * 4 - x[nn, c, h, w]
+    np.testing.assert_allclose(out, exp, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# box_decoder_and_assign
+# ---------------------------------------------------------------------------
+
+
+def test_box_decoder_and_assign_vs_loop():
+    rng = np.random.RandomState(2)
+    r, c = 6, 4
+    prior = np.abs(rng.randn(r, 4)).astype(np.float32) * 10
+    prior[:, 2:] += prior[:, :2] + 5
+    var = np.asarray([0.1, 0.1, 0.2, 0.2], np.float32)
+    tb = rng.randn(r, 4 * c).astype(np.float32) * 0.3
+    sc = rng.rand(r, c).astype(np.float32)
+    clip = 4.135
+    dec, asg = vops.box_decoder_and_assign(prior, var, tb, sc, clip)
+    dec, asg = _np(dec), _np(asg)
+
+    exp = np.zeros((r, c * 4), np.float32)
+    exp_a = np.zeros((r, 4), np.float32)
+    for i in range(r):
+        pw = prior[i, 2] - prior[i, 0] + 1
+        ph = prior[i, 3] - prior[i, 1] + 1
+        pcx = prior[i, 0] + pw / 2
+        pcy = prior[i, 1] + ph / 2
+        for j in range(c):
+            o = j * 4
+            dw = min(var[2] * tb[i, o + 2], clip)
+            dh = min(var[3] * tb[i, o + 3], clip)
+            cx = var[0] * tb[i, o] * pw + pcx
+            cy = var[1] * tb[i, o + 1] * ph + pcy
+            w = math.exp(dw) * pw
+            h = math.exp(dh) * ph
+            exp[i, o:o + 4] = [cx - w / 2, cy - h / 2,
+                               cx + w / 2 - 1, cy + h / 2 - 1]
+        best, best_j = -1.0, -1
+        for j in range(c):
+            if sc[i, j] > best and j > 0:
+                best, best_j = sc[i, j], j
+        if best_j > 0:
+            exp_a[i] = exp[i, best_j * 4:best_j * 4 + 4]
+        else:
+            exp_a[i] = prior[i]
+    np.testing.assert_allclose(dec, exp, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(asg, exp_a, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# locality_aware_nms
+# ---------------------------------------------------------------------------
+
+
+def test_locality_aware_nms_merges_neighbours():
+    # three overlapping axis-aligned boxes in input order; the first two
+    # merge (score-weighted), the third is disjoint
+    boxes = np.asarray([[[0, 0, 10, 10],
+                         [1, 1, 11, 11],
+                         [50, 50, 60, 60]]], np.float32)
+    scores = np.asarray([[[0.6, 0.4, 0.9]]], np.float32)
+    out, counts = vops.locality_aware_nms(
+        boxes, scores, score_threshold=0.01, nms_threshold=0.3,
+        normalized=False, background_label=-1)
+    out, counts = _np(out), _np(counts)
+    assert counts.tolist() == [2]
+    # merged box: (b0*0.6 + b1*0.4) / 1.0, merged score 1.0
+    merged = (boxes[0, 0] * 0.6 + boxes[0, 1] * 0.4) / 1.0
+    by_score = out[np.argsort(-out[:, 1])]
+    np.testing.assert_allclose(by_score[0, 1], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(by_score[0, 2:], merged, rtol=1e-5)
+    np.testing.assert_allclose(by_score[1, 1], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(by_score[1, 2:], boxes[0, 2], rtol=1e-6)
+
+
+def test_locality_aware_nms_quads_poly_iou():
+    # two identical quads merge; poly IoU path (box_size=8)
+    q = [0, 0, 10, 0, 10, 10, 0, 10]
+    q2 = [1, 0, 11, 0, 11, 10, 1, 10]
+    far = [100, 100, 110, 100, 110, 110, 100, 110]
+    boxes = np.asarray([[q, q2, far]], np.float32)
+    scores = np.asarray([[[0.5, 0.5, 0.8]]], np.float32)
+    out, counts = vops.locality_aware_nms(
+        boxes, scores, score_threshold=0.01, nms_threshold=0.3,
+        normalized=True, background_label=-1)
+    out, counts = _np(out), _np(counts)
+    assert counts.tolist() == [2]
+    scores_out = sorted(out[:, 1].tolist(), reverse=True)
+    np.testing.assert_allclose(scores_out[0], 1.0, rtol=1e-6)
+
+
+def test_poly_iou_identical_and_disjoint():
+    sq = [0, 0, 4, 0, 4, 4, 0, 4]
+    assert vops._np_poly_iou(sq, sq) == pytest.approx(1.0)
+    sq2 = [10, 10, 14, 10, 14, 14, 10, 14]
+    assert vops._np_poly_iou(sq, sq2) == pytest.approx(0.0)
+    half = [2, 0, 6, 0, 6, 4, 2, 4]   # overlaps half of sq
+    assert vops._np_poly_iou(sq, half) == pytest.approx(8.0 / 24.0)
+
+
+# ---------------------------------------------------------------------------
+# retinanet_detection_output
+# ---------------------------------------------------------------------------
+
+
+def test_retinanet_detection_output_decode_and_nms():
+    # one level, 2 anchors, 2 classes, 1 image; zero deltas = anchors
+    anchors = np.asarray([[0, 0, 9, 9], [20, 20, 29, 29]], np.float32)
+    deltas = np.zeros((1, 2, 4), np.float32)
+    scores = np.asarray([[[0.9, 0.1], [0.2, 0.7]]], np.float32)
+    im_info = np.asarray([[100, 100, 1.0]], np.float32)
+    out, counts = vops.retinanet_detection_output(
+        [deltas], [scores], [anchors], im_info,
+        score_threshold=0.05, nms_top_k=10, keep_top_k=10,
+        nms_threshold=0.3)
+    out, counts = _np(out), _np(counts)
+    # single level = last level -> threshold 0, all 4 (anchor, class)
+    # pairs survive (disjoint anchors, so per-class NMS keeps both)
+    assert counts.tolist() == [4]
+    # rows sorted by score desc: anchor0/class0 (0.9), anchor1/class1
+    assert out[0, 0] == 1.0 and out[0, 1] == pytest.approx(0.9)
+    np.testing.assert_allclose(out[0, 2:], [0, 0, 9, 9], atol=1e-5)
+    assert out[1, 0] == 2.0 and out[1, 1] == pytest.approx(0.7)
+    np.testing.assert_allclose(out[1, 2:], [20, 20, 29, 29], atol=1e-5)
+    assert out[2, 1] == pytest.approx(0.2)
+    assert out[3, 1] == pytest.approx(0.1)
+
+
+def test_retinanet_keep_top_k_minus_one_keeps_all():
+    anchors = np.asarray([[0, 0, 9, 9], [20, 20, 29, 29],
+                          [40, 40, 49, 49]], np.float32)
+    deltas = np.zeros((1, 3, 4), np.float32)
+    scores = np.full((1, 3, 1), 0.9, np.float32)
+    im_info = np.asarray([[100, 100, 1.0]], np.float32)
+    _, counts = vops.retinanet_detection_output(
+        [deltas], [scores], [anchors], im_info, keep_top_k=-1)
+    assert _np(counts).tolist() == [3]
+
+
+def test_retinanet_last_level_keeps_all_scores():
+    # single (= last) level ignores score_threshold (threshold 0)
+    anchors = np.asarray([[0, 0, 9, 9]], np.float32)
+    deltas = np.zeros((1, 1, 4), np.float32)
+    scores = np.asarray([[[0.01]]], np.float32)  # below threshold
+    im_info = np.asarray([[50, 50, 1.0]], np.float32)
+    out, counts = vops.retinanet_detection_output(
+        [deltas], [scores], [anchors], im_info, score_threshold=0.05)
+    assert _np(counts).tolist() == [1]
+
+
+# ---------------------------------------------------------------------------
+# roi_perspective_transform
+# ---------------------------------------------------------------------------
+
+
+def test_roi_perspective_transform_identity_quad():
+    # an axis-aligned quad over a linear-ramp image: output approximates
+    # a resampled crop; corners must match the source corners
+    h = w = 16
+    img = np.arange(h * w, dtype=np.float32).reshape(1, 1, h, w)
+    # quad = full image corners in (x, y) order, clockwise from top-left
+    rois = np.asarray([[0, 0, w - 1.0, 0, w - 1.0, h - 1.0, 0, h - 1.0]],
+                      np.float32)
+    out, mask, mats = vops.roi_perspective_transform(
+        img, rois, lengths=np.asarray([1]), transformed_height=8,
+        transformed_width=8, spatial_scale=1.0)
+    out, mask = _np(out), _np(mask)
+    assert out.shape == (1, 1, 8, 8)
+    assert mask.shape == (1, 1, 8, 8)
+    assert mask.min() == 1  # whole quad covers the image
+    # top-left pixel samples source (0,0); the transform maps output
+    # (0,0) -> quad corner 0
+    assert out[0, 0, 0, 0] == pytest.approx(img[0, 0, 0, 0], abs=1e-3)
+
+
+def test_roi_perspective_transform_outside_is_masked():
+    img = np.ones((1, 1, 8, 8), np.float32)
+    # degenerate-ish quad in the corner; far output columns fall outside
+    rois = np.asarray([[0, 0, 3, 0, 3, 3, 0, 3]], np.float32)
+    out, mask, _ = vops.roi_perspective_transform(
+        img, rois, transformed_height=4, transformed_width=8)
+    out, mask = _np(out), _np(mask)
+    # wherever mask == 0 the output must be 0
+    assert np.all(out[_np(mask) == 0] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# collect_fpn_proposals
+# ---------------------------------------------------------------------------
+
+
+def test_collect_fpn_proposals_topk_and_regroup():
+    # 2 images, 2 levels
+    rois_l0 = np.asarray([[0, 0, 1, 1], [2, 2, 3, 3],     # img0
+                          [4, 4, 5, 5]], np.float32)       # img1
+    rois_l1 = np.asarray([[6, 6, 7, 7],                    # img0
+                          [8, 8, 9, 9]], np.float32)       # img1
+    sc_l0 = np.asarray([0.9, 0.2, 0.8], np.float32)[:, None]
+    sc_l1 = np.asarray([0.5, 0.95], np.float32)[:, None]
+    lens = [np.asarray([2, 1]), np.asarray([1, 1])]
+    rois, counts = rcnn.collect_fpn_proposals(
+        [rois_l0, rois_l1], [sc_l0, sc_l1], 2, 3, post_nms_top_n=3,
+        lengths=lens)
+    rois, counts = _np(rois), _np(counts)
+    # top-3 scores: 0.95 (img1), 0.9 (img0), 0.8 (img1) -> regrouped by
+    # image: img0 first (0.9), then img1 (0.95, 0.8 in score order)
+    assert counts.tolist() == [1, 2]
+    np.testing.assert_allclose(rois[0], [0, 0, 1, 1])
+    np.testing.assert_allclose(rois[1], [8, 8, 9, 9])
+    np.testing.assert_allclose(rois[2], [4, 4, 5, 5])
+
+
+# ---------------------------------------------------------------------------
+# generate_proposal_labels
+# ---------------------------------------------------------------------------
+
+
+def test_generate_proposal_labels_fg_bg_split_and_targets():
+    # one image, deterministic (use_random=False)
+    gt_boxes = np.asarray([[0, 0, 10, 10]], np.float32)
+    gt_classes = np.asarray([3], np.int32)
+    is_crowd = np.asarray([0], np.int32)
+    rois = np.asarray([[0, 0, 9, 9],        # IoU ~0.83 -> fg
+                       [0, 0, 30, 30],      # IoU ~0.12 -> bg
+                       [50, 50, 60, 60]],   # IoU 0 -> bg (lo=0 incl.)
+                      np.float32)
+    im_info = np.asarray([[100, 100, 1.0]], np.float32)
+    cls_n = 5
+    out = rcnn.generate_proposal_labels(
+        rois, gt_classes, is_crowd, gt_boxes, im_info,
+        rois_lengths=np.asarray([3]), gt_lengths=np.asarray([1]),
+        batch_size_per_im=4, fg_fraction=0.5, fg_thresh=0.5,
+        bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+        bbox_reg_weights=(0.1, 0.1, 0.2, 0.2), class_nums=cls_n,
+        use_random=False)
+    srois, labels, tgt, inw, outw, num = [_np(o) for o in out]
+    assert num.tolist() == [4]
+    labels = labels.reshape(-1)
+    # candidates = [gt] + rois: gt (IoU 1) and roi0 are fg, rest bg
+    assert (labels > 0).sum() == 2
+    assert set(labels[labels > 0].tolist()) == {3}
+    # fg targets live in the class-3 slot, with unit weights
+    fg_rows = np.nonzero(labels > 0)[0]
+    for r in fg_rows:
+        assert inw[r, 12:16].tolist() == [1, 1, 1, 1]
+        assert outw[r, 12:16].tolist() == [1, 1, 1, 1]
+        assert np.all(inw[r, :12] == 0) and np.all(inw[r, 16:] == 0)
+    # the gt-as-roi row encodes against itself -> zero deltas
+    gt_row = fg_rows[np.all(np.isclose(srois[fg_rows], [0, 0, 10, 10]),
+                            axis=1)][0]
+    np.testing.assert_allclose(tgt[gt_row, 12:16], 0.0, atol=1e-5)
+
+
+def test_generate_proposal_labels_crowd_gt_excluded():
+    gt_boxes = np.asarray([[0, 0, 10, 10]], np.float32)
+    gt_classes = np.asarray([2], np.int32)
+    is_crowd = np.asarray([1], np.int32)   # crowd: candidate gt row
+    rois = np.asarray([[40, 40, 49, 49]], np.float32)
+    im_info = np.asarray([[100, 100, 1.0]], np.float32)
+    out = rcnn.generate_proposal_labels(
+        rois, gt_classes, is_crowd, gt_boxes, im_info,
+        batch_size_per_im=4, fg_thresh=0.5, bg_thresh_hi=0.5,
+        bg_thresh_lo=0.0, class_nums=3, use_random=False)
+    labels = _np(out[1]).reshape(-1)
+    # the crowd gt row has max_overlap forced to -1 -> not fg, not bg
+    # (below bg_thresh_lo=0.0? -1 < 0 -> excluded entirely)
+    assert np.all(labels == 0)
+    # crowd row must not appear as fg
+    assert (labels > 0).sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# generate_mask_labels
+# ---------------------------------------------------------------------------
+
+
+def test_rasterize_square_polygon():
+    m = 8
+    box = np.asarray([0.0, 0.0, 8.0, 8.0])
+    poly = [np.asarray([[0, 0], [8, 0], [8, 8], [0, 8]], np.float32)]
+    mask = rcnn._rasterize_polys(poly, box, m)
+    assert mask.shape == (m, m)
+    assert mask.sum() == m * m          # full coverage
+    half = [np.asarray([[0, 0], [4, 0], [4, 8], [0, 8]], np.float32)]
+    mask2 = rcnn._rasterize_polys(half, box, m)
+    assert mask2[:, :4].sum() == m * 4  # left half set
+    assert mask2[:, 4:].sum() == 0
+
+
+def test_generate_mask_labels_layout():
+    num_classes, res = 4, 8
+    im_info = np.asarray([[32, 32, 1.0]], np.float32)
+    gt_classes = np.asarray([2], np.int32)
+    is_crowd = np.asarray([0], np.int32)
+    # one gt with one square polygon
+    pts = np.asarray([[4, 4], [20, 4], [20, 20], [4, 20]], np.float32)
+    rois = np.asarray([[4, 4, 20, 20],    # fg roi == poly box
+                       [0, 0, 31, 31]], np.float32)
+    labels = np.asarray([2, 0], np.int32)
+    mask_rois, has_mask, masks, counts = rcnn.generate_mask_labels(
+        im_info, gt_classes, is_crowd, pts, rois, labels,
+        num_classes=num_classes, resolution=res,
+        gt_lengths=np.asarray([1]), rois_lengths=np.asarray([2]),
+        polys_per_gt=np.asarray([1]), points_per_poly=np.asarray([4]))
+    mask_rois, has_mask, masks, counts = [
+        _np(o) for o in (mask_rois, has_mask, masks, counts)]
+    assert counts.tolist() == [1]
+    assert has_mask.reshape(-1).tolist() == [0]
+    m2 = res * res
+    # class-2 slot holds the rasterized square (full coverage in the
+    # roi frame), everything else is ignore (-1)
+    assert np.all(masks[0, :2 * m2] == -1)
+    assert np.all(masks[0, 3 * m2:] == -1)
+    cls_slot = masks[0, 2 * m2:3 * m2]
+    assert cls_slot.min() >= 0 and cls_slot.sum() == m2
+
+
+def test_generate_mask_labels_no_fg_emits_bg_guard():
+    num_classes, res = 3, 4
+    im_info = np.asarray([[32, 32, 1.0]], np.float32)
+    gt_classes = np.asarray([1], np.int32)
+    is_crowd = np.asarray([1], np.int32)   # crowd -> no usable gt
+    pts = np.asarray([[0, 0], [8, 0], [8, 8], [0, 8]], np.float32)
+    rois = np.asarray([[0, 0, 8, 8]], np.float32)
+    labels = np.asarray([0], np.int32)
+    _, has_mask, masks, counts = rcnn.generate_mask_labels(
+        im_info, gt_classes, is_crowd, pts, rois, labels,
+        num_classes=num_classes, resolution=res,
+        gt_lengths=np.asarray([1]), rois_lengths=np.asarray([1]),
+        polys_per_gt=np.asarray([1]), points_per_poly=np.asarray([4]))
+    assert _np(counts).tolist() == [1]
+    assert np.all(_np(masks) == -1)
+
+
+# ---------------------------------------------------------------------------
+# detection_map
+# ---------------------------------------------------------------------------
+
+
+def test_detection_map_perfect_predictions():
+    det = np.asarray([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                      [2, 0.8, 0.5, 0.5, 0.9, 0.9]], np.float32)
+    lab = np.asarray([[1, 0, 0.1, 0.1, 0.4, 0.4],
+                      [2, 0, 0.5, 0.5, 0.9, 0.9]], np.float32)
+    m_ap, state = vops.detection_map(det, lab, class_num=3,
+                                     det_lengths=np.asarray([2]),
+                                     label_lengths=np.asarray([2]))
+    assert m_ap == pytest.approx(1.0)
+
+
+def test_detection_map_false_positive_and_accumulate():
+    det = np.asarray([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                      [1, 0.8, 0.6, 0.6, 0.9, 0.9]], np.float32)  # FP
+    lab = np.asarray([[1, 0, 0.1, 0.1, 0.4, 0.4]], np.float32)
+    m_ap, state = vops.detection_map(det, lab, class_num=2,
+                                     det_lengths=np.asarray([2]),
+                                     label_lengths=np.asarray([1]))
+    assert m_ap == pytest.approx(1.0)   # TP ranked above FP: AP = 1
+    # accumulate a second batch where the same class gets a miss
+    det2 = np.asarray([[1, 0.7, 0.0, 0.0, 0.05, 0.05]], np.float32)
+    lab2 = np.asarray([[1, 0, 0.5, 0.5, 0.9, 0.9]], np.float32)
+    m_ap2, _ = vops.detection_map(det2, lab2, class_num=2,
+                                  det_lengths=np.asarray([1]),
+                                  label_lengths=np.asarray([1]),
+                                  state=state)
+    assert m_ap2 < 1.0                   # recall can no longer reach 1
+    # 11-point flavour also runs
+    m_ap3, _ = vops.detection_map(det, lab, class_num=2,
+                                  det_lengths=np.asarray([2]),
+                                  label_lengths=np.asarray([1]),
+                                  ap_version="11point")
+    assert 0.99 <= m_ap3 <= 1.01
+
+
+def test_detection_map_difficult_excluded():
+    det = np.asarray([[1, 0.9, 0.1, 0.1, 0.4, 0.4]], np.float32)
+    lab = np.asarray([[1, 1, 0.1, 0.1, 0.4, 0.4]], np.float32)  # difficult
+    m_ap, state = vops.detection_map(det, lab, class_num=2,
+                                     det_lengths=np.asarray([1]),
+                                     label_lengths=np.asarray([1]),
+                                     evaluate_difficult=False)
+    # difficult-only gt: pos_count empty for the class -> mAP 0, and the
+    # matched-difficult detection is neither TP nor FP
+    pos_count, true_pos, _ = state
+    assert pos_count.get(1, 0) == 0 or 1 not in pos_count
+    assert not true_pos.get(1)
+
+
+def test_fluid_layers_facades_exist():
+    from paddle_tpu.static import layers as L
+    for n in ("target_assign", "polygon_box_transform",
+              "box_decoder_and_assign", "roi_perspective_transform",
+              "locality_aware_nms", "retinanet_detection_output",
+              "detection_map", "collect_fpn_proposals",
+              "generate_proposal_labels", "generate_mask_labels"):
+        assert callable(getattr(L, n)), n
