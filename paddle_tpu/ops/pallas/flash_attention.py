@@ -496,11 +496,20 @@ _flash_attention_core_dropout.defvjp(_flash_attention_core_dropout_fwd,
 
 def _pick_blocks(ql, kl, block_q, block_kv):
     """Block sizes that DIVIDE the lengths (the grid floors otherwise,
-    silently skipping tail tiles): the requested size when it divides,
-    else the 128 tile modulus `_pallas_ok` admits. Lengths outside that
-    contract fail loudly instead of corrupting the output."""
-    bq = block_q if ql % block_q == 0 else 128
-    bkv = block_kv if kl % block_kv == 0 else 128
+    silently skipping tail tiles): the largest of {requested, halves,
+    ..., 128} that divides — so a 512-default degrades to 256 at seq
+    256, not straight to the 128 tile modulus. Lengths outside the
+    128-modulus contract fail loudly instead of corrupting the
+    output."""
+    def fit(req, length):
+        b = req
+        while b > 128 and length % b != 0:
+            b //= 2
+        # a non-power-of-two request can halve past the tile modulus
+        # without ever trying it — 128 is always the final fallback
+        return b if b >= 128 and length % b == 0 else 128
+
+    bq, bkv = fit(block_q, ql), fit(block_kv, kl)
     if ql % bq != 0 or kl % bkv != 0:
         raise ValueError(
             f"flash attention needs seq lengths divisible by 128 "
@@ -512,7 +521,7 @@ def _pick_blocks(ql, kl, block_q, block_kv):
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
                                              "block_kv"))
 def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
-                            block_kv=256):
+                            block_kv=512):
     bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core(q, k, v, causal, bq, bkv)
 
@@ -520,7 +529,7 @@ def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
                                              "block_kv"))
 def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
-                                   block_q=256, block_kv=256):
+                                   block_q=256, block_kv=512):
     bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core_masked(q, k, v, mask_bias, causal, bq, bkv)
 
@@ -528,7 +537,7 @@ def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
 @functools.partial(jax.jit, static_argnames=("causal", "dropout_p",
                                              "block_q", "block_kv"))
 def _flash_attention_pallas_dropout(q, k, v, seed, dropout_p, causal=False,
-                                    block_q=256, block_kv=256):
+                                    block_q=256, block_kv=512):
     bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core_dropout(q, k, v, seed, causal, bq, bkv,
                                          dropout_p)

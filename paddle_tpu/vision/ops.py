@@ -1146,26 +1146,28 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
             order = sel[np.argsort(-sc[sel], kind="stable")]
             if nms_top_k > -1:
                 order = order[:nms_top_k]
-            deltas = blist[lv][i]
-            anc = alist[lv]
-            for idx in order:
-                a_i, cls = idx // c, idx % c
-                ax1, ay1, ax2, ay2 = anc[a_i]
-                aw, ah = ax2 - ax1 + 1, ay2 - ay1 + 1
-                acx, acy = ax1 + aw / 2, ay1 + ah / 2
-                dx, dy, dw, dh = deltas[a_i]
-                pcx, pcy = dx * aw + acx, dy * ah + acy
-                pw, ph = math.exp(dw) * aw, math.exp(dh) * ah
-                x1 = (pcx - pw / 2) / im_scale
-                y1 = (pcy - ph / 2) / im_scale
-                x2 = (pcx + pw / 2 - 1) / im_scale
-                y2 = (pcy + ph / 2 - 1) / im_scale
-                x1 = min(max(x1, 0.0), ow - 1)
-                y1 = min(max(y1, 0.0), oh - 1)
-                x2 = min(max(x2, 0.0), ow - 1)
-                y2 = min(max(y2, 0.0), oh - 1)
-                preds.setdefault(int(cls), []).append(
-                    [x1, y1, x2, y2, float(sc[idx])])
+            if not len(order):
+                continue
+            # vectorized anchor decode of all surviving candidates
+            a_i, cls_i = order // c, order % c
+            anc = alist[lv][a_i]                         # (K, 4)
+            d = blist[lv][i][a_i]                        # (K, 4)
+            aw = anc[:, 2] - anc[:, 0] + 1
+            ah = anc[:, 3] - anc[:, 1] + 1
+            pcx = d[:, 0] * aw + anc[:, 0] + aw / 2
+            pcy = d[:, 1] * ah + anc[:, 1] + ah / 2
+            pw = np.exp(d[:, 2]) * aw
+            ph = np.exp(d[:, 3]) * ah
+            box = np.stack([(pcx - pw / 2) / im_scale,
+                            (pcy - ph / 2) / im_scale,
+                            (pcx + pw / 2 - 1) / im_scale,
+                            (pcy + ph / 2 - 1) / im_scale], axis=1)
+            box[:, 0::2] = np.clip(box[:, 0::2], 0.0, ow - 1)
+            box[:, 1::2] = np.clip(box[:, 1::2], 0.0, oh - 1)
+            for k, idx in enumerate(order):
+                preds.setdefault(int(cls_i[k]), []).append(
+                    [box[k, 0], box[k, 1], box[k, 2], box[k, 3],
+                     float(sc[idx])])
         # per-class greedy NMS
         pairs = []                       # (score, cls, det-row)
         for cls, dets in preds.items():
@@ -1268,6 +1270,10 @@ def detection_map(detect_res, label, class_num, det_lengths=None,
 
     m_ap, count = 0.0, 0
     for cls, npos in pos_count.items():
+        # reference parity quirk: detection_map_op.h:422 compares the
+        # positive COUNT (label_num_pos) to background_label, not the
+        # class id — kept verbatim (moot in practice: detector outputs
+        # and gt labels exclude the background class)
         if npos == background_label:
             continue
         if cls not in true_pos:

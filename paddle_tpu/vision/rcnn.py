@@ -665,6 +665,11 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         max_ov = iou.max(axis=1) if iou.shape[1] else \
             np.zeros(len(boxes), np.float32)
         gt_num = len(gts)
+        # rows 0..gt_num-1 of the candidate set are gt boxes: appended
+        # above in the standard path, prepended by the CALLER in cascade
+        # mode (the cascade convention the unscaled-first-rows handling
+        # above also relies on) — so indexing crowd flags by row is
+        # correct in both modes (reference SampleFgBgGt does the same)
         for j in range(min(gt_num, len(boxes))):
             if crowd[j]:
                 max_ov[j] = -1.0
@@ -861,7 +866,7 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
             out_has.append(np.asarray(fg, np.int32) - r0)
             out_masks.append(masks)
             counts.append(len(fg))
-        else:
+        elif r1 > r0:
             # empty-blob guard: one bg roi, all-ignore mask, class 0
             bgs = [r for r in range(r0, r1) if lbl[r] == 0]
             pick = bgs[0] if bgs else r0
@@ -869,8 +874,18 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
             out_has.append(np.asarray([pick - r0], np.int32))
             out_masks.append(np.full((1, num_classes * M), -1, np.int32))
             counts.append(1)
+        else:
+            # no rois at all for this image: nothing to guard — emit an
+            # empty segment so the four outputs stay in sync
+            counts.append(0)
 
-    return (Tensor(jnp.asarray(np.concatenate(out_rois, axis=0))),
-            Tensor(jnp.asarray(np.concatenate(out_has))[:, None]),
-            Tensor(jnp.asarray(np.concatenate(out_masks, axis=0))),
+    def _cat(parts, width, dtype):
+        return (np.concatenate(parts, axis=0) if parts
+                else np.zeros((0, width), dtype))
+
+    return (Tensor(jnp.asarray(_cat(out_rois, 4, np.float32))),
+            Tensor(jnp.asarray(
+                np.concatenate(out_has) if out_has
+                else np.zeros(0, np.int32))[:, None]),
+            Tensor(jnp.asarray(_cat(out_masks, num_classes * M, np.int32))),
             Tensor(jnp.asarray(np.asarray(counts, np.int32))))

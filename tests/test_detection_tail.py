@@ -401,6 +401,27 @@ def test_generate_mask_labels_layout():
     assert cls_slot.min() >= 0 and cls_slot.sum() == m2
 
 
+def test_generate_mask_labels_zero_roi_image_stays_in_sync():
+    # image 0 has rois, image 1 has none: outputs must stay aligned
+    num_classes, res = 3, 4
+    im_info = np.asarray([[32, 32, 1.0], [32, 32, 1.0]], np.float32)
+    gt_classes = np.asarray([1, 1], np.int32)
+    is_crowd = np.asarray([0, 0], np.int32)
+    pts = np.asarray([[0, 0], [8, 0], [8, 8], [0, 8]] * 2, np.float32)
+    rois = np.asarray([[0, 0, 8, 8]], np.float32)
+    labels = np.asarray([1], np.int32)
+    mask_rois, has_mask, masks, counts = rcnn.generate_mask_labels(
+        im_info, gt_classes, is_crowd, pts, rois, labels,
+        num_classes=num_classes, resolution=res,
+        gt_lengths=np.asarray([1, 1]), rois_lengths=np.asarray([1, 0]),
+        polys_per_gt=np.asarray([1, 1]),
+        points_per_poly=np.asarray([4, 4]))
+    counts = _np(counts)
+    assert counts.tolist() == [1, 0]
+    assert _np(mask_rois).shape[0] == counts.sum()
+    assert _np(masks).shape[0] == counts.sum()
+
+
 def test_generate_mask_labels_no_fg_emits_bg_guard():
     num_classes, res = 3, 4
     im_info = np.asarray([[32, 32, 1.0]], np.float32)
