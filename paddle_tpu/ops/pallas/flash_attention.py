@@ -520,7 +520,7 @@ def _pick_blocks(ql, kl, block_q, block_kv):
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
                                              "block_kv"))
-def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
+def _flash_attention_pallas(q, k, v, causal=False, block_q=512,
                             block_kv=512):
     bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core(q, k, v, causal, bq, bkv)
@@ -529,7 +529,7 @@ def _flash_attention_pallas(q, k, v, causal=False, block_q=256,
 @functools.partial(jax.jit, static_argnames=("causal", "block_q",
                                              "block_kv"))
 def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
-                                   block_q=256, block_kv=512):
+                                   block_q=512, block_kv=512):
     bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core_masked(q, k, v, mask_bias, causal, bq, bkv)
 
@@ -537,7 +537,7 @@ def _flash_attention_pallas_masked(q, k, v, mask_bias, causal=False,
 @functools.partial(jax.jit, static_argnames=("causal", "dropout_p",
                                              "block_q", "block_kv"))
 def _flash_attention_pallas_dropout(q, k, v, seed, dropout_p, causal=False,
-                                    block_q=256, block_kv=512):
+                                    block_q=512, block_kv=512):
     bq, bkv = _pick_blocks(q.shape[1], k.shape[1], block_q, block_kv)
     return _flash_attention_core_dropout(q, k, v, seed, causal, bq, bkv,
                                          dropout_p)

@@ -42,6 +42,12 @@ def main():
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--dropout", type=float, default=0.1)
+    ap.add_argument("--grad", action="store_true",
+                    help="time fwd+bwd (the training path) instead of "
+                         "forward only — bwd is ~2/3 of attention time "
+                         "and prefers LARGER q blocks (measured: "
+                         "bq=512,bkv=512 beats 256,512 by 7% combined "
+                         "at seq 512 though it loses the fwd-only race)")
     ns = ap.parse_args()
 
     backend = ensure_backend()
@@ -59,21 +65,31 @@ def main():
     seed = jnp.zeros((1, 1), jnp.int32)
 
     base = {"seq": ns.seq, "batch": ns.batch, "heads": ns.heads,
-            "dim": ns.dim}
-    ms = _time(jax.jit(functools.partial(
+            "dim": ns.dim, "mode": "fwd+bwd" if ns.grad else "fwd"}
+
+    def wrap(fn):
+        if not ns.grad:
+            return fn
+        return jax.jit(jax.grad(
+            lambda *a: fn(*a).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+
+    ms = _time(wrap(jax.jit(functools.partial(
         fa._xla_attention, mask=None, dropout_p=ns.dropout,
-        is_causal=False, key_rng=jax.random.key(0))), (q, k, v), ns.steps)
+        is_causal=False, key_rng=jax.random.key(0)))), (q, k, v), ns.steps)
     print(json.dumps({**base, "kernel": "xla_dropout",
                       "ms": round(ms, 4)}), flush=True)
     cands = [(bq, bkv) for bq in (128, 256, 512) for bkv in (128, 256, 512)
              if ns.seq % bq == 0 and ns.seq % bkv == 0]
     for bq, bkv in cands:
         try:
-            ms = _time(
-                functools.partial(fa._flash_attention_pallas_dropout,
-                                  dropout_p=ns.dropout, block_q=bq,
-                                  block_kv=bkv),
-                (q, k, v, seed), ns.steps)
+            pallas = functools.partial(
+                fa._flash_attention_pallas_dropout,
+                dropout_p=ns.dropout, block_q=bq, block_kv=bkv)
+            if ns.grad:
+                ms = _time(wrap(lambda q, k, v: pallas(q, k, v, seed)),
+                           (q, k, v), ns.steps)
+            else:
+                ms = _time(pallas, (q, k, v, seed), ns.steps)
         except Exception as e:
             print(json.dumps({**base, "kernel": "pallas_dropout",
                               "bq": bq, "bkv": bkv,
