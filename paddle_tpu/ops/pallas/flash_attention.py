@@ -9,8 +9,13 @@ logsumexp), wired together with jax.custom_vjp so the kernel is used in
 training too. An XLA fallback covers shapes/backends the kernel does not
 (masks, dropout, unaligned lengths, CPU tests).
 
-Layout convention is paddle's (batch, seq, heads, head_dim). Measured on
-v5e: ~2.5x over XLA attention forward at seq 512, d 64, causal.
+Layout convention is paddle's (batch, seq, heads, head_dim). Measured
+end-to-end on v5e (bench.py bert512, the trustworthy loss-fetch timing):
++28% tokens/s over the XLA path at seq 512 with the r3-tuned (512, 512)
+blocks; the seq<256 dispatch floor routes short sequences to XLA where
+it wins. (An earlier "~2.5x forward" per-op figure predates the
+remote-tunnel timing fix in tools/op_bench.py — treat per-op numbers
+captured before that fix as unverified.)
 """
 from __future__ import annotations
 

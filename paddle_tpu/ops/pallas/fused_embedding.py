@@ -38,28 +38,40 @@ def _xla_bag(table, ids, combiner):
     raise ValueError(f"unknown combiner {combiner!r}")
 
 
-def _bag_kernel(ids_ref, table_row_ref, out_ref, cnt_ref, *, seq, combiner):
-    b = pl.program_id(0)
+def _bag_kernel(ids_ref, table_blk_ref, out_ref, cnt_ref, *, seq, combiner):
+    """Blocks are 8 rows tall — the TPU sublane tile modulus; (1, d)
+    row blocks do not lower on real hardware (Mosaic requires the
+    second-to-last block dim % 8). The streamed table block is the
+    8-row group containing the wanted row; the output block holds 8
+    bags, revisited across the 8*seq grid steps that share it."""
+    bi = pl.program_id(0)
     s = pl.program_id(1)
+    off = bi % 8
+
+    @pl.when(jnp.logical_and(s == 0, off == 0))
+    def _init_out():
+        out_ref[...] = jnp.zeros_like(out_ref)
 
     @pl.when(s == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-        cnt_ref[0] = 0.0
+    def _init_cnt():
+        cnt_ref[off] = 0.0
 
-    idx = ids_ref[b * seq + s]
+    idx = ids_ref[bi * seq + s]
     valid = (idx >= 0).astype(jnp.float32)
     # accumulate in f32 regardless of table dtype: bf16 += over long
     # bags loses low bits and diverges from the XLA fallback (ADVICE r2)
-    out_ref[...] += valid * table_row_ref[...].astype(jnp.float32)
-    cnt_ref[0] += valid
+    row = table_blk_ref[pl.dslice(jnp.maximum(idx, 0) % 8, 1),
+                        :].astype(jnp.float32)
+    out_ref[pl.dslice(off, 1), :] += valid * row
+    cnt_ref[off] += valid
 
     if combiner in ("mean", "sqrtn"):
         @pl.when(s == seq - 1)
         def _normalize():
-            c = jnp.maximum(cnt_ref[0], 1.0)
+            c = jnp.maximum(cnt_ref[off], 1.0)
             denom = c if combiner == "mean" else jnp.sqrt(c)
-            out_ref[...] = out_ref[...] / denom
+            out_ref[pl.dslice(off, 1), :] = \
+                out_ref[pl.dslice(off, 1), :] / denom
 
 
 try:  # pallas imports kept lazy-tolerant (cpu wheels without pallas tpu)
@@ -78,12 +90,14 @@ def _bag_pallas(table, ids, combiner):
         num_scalar_prefetch=1,
         grid=(b, s),
         in_specs=[
+            # the 8-row table group containing the wanted row
             pl.BlockSpec(
-                (1, d), lambda bi, si, idv: (jnp.maximum(
-                    idv[bi * s + si], 0), 0)),
+                (8, d), lambda bi, si, idv: (jnp.maximum(
+                    idv[bi * s + si], 0) // 8, 0)),
         ],
-        out_specs=pl.BlockSpec((1, d), lambda bi, si, idv: (bi, 0)),
-        scratch_shapes=[pltpu.SMEM((1,), jnp.float32)],
+        # 8 bags per output block, shared by 8 consecutive bi
+        out_specs=pl.BlockSpec((8, d), lambda bi, si, idv: (bi // 8, 0)),
+        scratch_shapes=[pltpu.SMEM((8,), jnp.float32)],
     )
     kernel = functools.partial(_bag_kernel, seq=s, combiner=combiner)
     out = pl.pallas_call(
@@ -100,9 +114,12 @@ def _eligible(table, ids):
 
     if not _PALLAS or not pallas_enabled():
         return False
-    d = table.shape[1]
-    # lane-aligned embedding dim; tiny bags fuse fine in XLA
-    return d % 128 == 0 and ids.shape[1] >= 8
+    v, d = table.shape
+    b = ids.shape[0]
+    # lane-aligned embedding dim; tiny bags fuse fine in XLA; the 8-row
+    # block layout needs vocab and batch on the sublane modulus
+    return (d % 128 == 0 and ids.shape[1] >= 8
+            and v % 8 == 0 and b % 8 == 0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

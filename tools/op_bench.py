@@ -19,21 +19,16 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _timeit(fn, *args, iters=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3  # ms
+def _timeit(fn, *args, iters=20, vary=-1):
+    from tools._timing import timeit
+
+    return timeit(fn, *args, iters=iters, vary_arg=vary)
 
 
 def bench_matmul(smoke):
@@ -190,7 +185,7 @@ def bench_optimizer_update(smoke):
         up, state = opt.update(g, state, p)
         return optax.apply_updates(p, up), state
 
-    ms = _timeit(step, p, g, state, iters=10)
+    ms = _timeit(step, p, g, state, iters=10, vary=1)  # vary the grads
     return {"op": "adamw_update", "shape": f"{n}", "ms": ms,
             "gbps": p.nbytes * 5 / (ms / 1e3) / 1e9}
 
