@@ -26,6 +26,11 @@ class OutOfRangeError(EnforceNotMet, IndexError):
     code = "OUT_OF_RANGE"
 
 
+class EOFException(OutOfRangeError):
+    """Reader exhausted (reference fluid.core.EOFException — raised by
+    read_op on an empty closed queue; here by PyReader._next_feed)."""
+
+
 class AlreadyExistsError(EnforceNotMet):
     code = "ALREADY_EXISTS"
 

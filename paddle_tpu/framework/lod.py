@@ -80,6 +80,10 @@ class LoDTensor:
     def numpy(self):
         return self._data
 
+    @property
+    def data(self):
+        return self._data
+
     def __repr__(self):
         return f"LoDTensor(shape={self.shape()}, lod={self._lod})"
 

@@ -39,6 +39,11 @@ from .rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,
 )
+from .decode import (  # noqa: F401
+    Decoder, BeamSearchDecoder, dynamic_decode, DecodeHelper,
+    TrainingHelper, GreedyEmbeddingHelper, SampleEmbeddingHelper,
+    BasicDecoder,
+)
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
 from .moe import MoELayer, moe_apply_ep, MOE_EP_RULES  # noqa: F401
 from .crf import LinearChainCRF, crf_decoding, linear_chain_crf  # noqa: F401,E402

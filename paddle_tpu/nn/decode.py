@@ -1,0 +1,377 @@
+"""Seq2seq decoding API (reference fluid/layers/rnn.py:585-1900 —
+Decoder, BeamSearchDecoder, dynamic_decode, DecodeHelper family,
+BasicDecoder).
+
+TPU-native shape: decoding state is a pytree of (batch, beam, ...)
+arrays; every step is dense jnp (top-k over the flattened beam*vocab
+axis, take_along_axis beam gathers) so a single step jit-compiles
+cleanly. The outer time loop is an eager Python loop with early exit
+when every beam finishes — decoding is inference-time and
+data-dependent-length; the per-step compute is where the FLOPs are.
+Outputs are stacked to the reference's [time, batch, beam] layout and
+backtraced with ops.gather_tree.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode",
+           "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
+           "SampleEmbeddingHelper", "BasicDecoder"]
+
+_KINF = 1e9
+
+
+def _unwrap(x):
+    from ..framework.tensor import Tensor
+
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(x):
+    from ..framework.tensor import Tensor
+
+    return Tensor(x)
+
+
+def _map(fn, tree):
+    return jax.tree_util.tree_map(fn, tree)
+
+
+class Decoder:
+    """Abstract decoder protocol (reference rnn.py:585): initialize /
+    step / finalize over a (possibly nested) state structure."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN (or any) cell (reference rnn.py:698).
+
+    cell: callable (inputs, states) -> (outputs, next_states) over
+    MERGED (batch*beam, ...) tensors; start_token/end_token: int ids;
+    beam_size: int; embedding_fn: optional id -> embedding callable
+    applied to sampled ids; output_fn: optional projection from cell
+    output to vocab logits.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam/batch reshape helpers (reference rnn.py:776-945) --------
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(batch, ...) -> (batch*beam, ...) by tiling each row beam
+        times (for encoder outputs consumed inside the cell)."""
+        v = _unwrap(x)
+        tiled = jnp.repeat(v[:, None], beam_size, axis=1)
+        return _wrap(tiled.reshape((-1,) + v.shape[1:]))
+
+    def _expand_to_beam_size(self, x):
+        v = _unwrap(x)
+        return jnp.repeat(v[:, None], self.beam_size, axis=1)
+
+    def _merge_batch_beams(self, x):
+        v = jnp.asarray(x)
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split_batch_beams(self, x):
+        v = jnp.asarray(x)
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def _mask_probs(self, probs, finished):
+        """Finished beams may only grow through end_token with score 0
+        (so their total log prob freezes)."""
+        vocab = probs.shape[-1]
+        noend = jnp.full((vocab,), -_KINF, probs.dtype)
+        noend = noend.at[self.end_token].set(0.0)
+        return jnp.where(finished[..., None], noend, probs)
+
+    @staticmethod
+    def _gather(x, indices, *_):
+        """Reorder the beam axis: x (batch, beam, ...), indices
+        (batch, beam) int."""
+        idx = indices
+        while idx.ndim < x.ndim:
+            idx = idx[..., None]
+        return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)
+
+    # -- decoder protocol ---------------------------------------------
+
+    def initialize(self, initial_cell_states):
+        cell_states = _map(lambda s: self._expand_to_beam_size(s),
+                           initial_cell_states)
+        batch = jax.tree_util.tree_leaves(cell_states)[0].shape[0]
+        init_inputs = jnp.full((batch, self.beam_size), self.start_token,
+                               jnp.int64)
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-_KINF] * (self.beam_size - 1)],
+                        jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int64)
+        inputs = (self.embedding_fn(_wrap(init_inputs))
+                  if self.embedding_fn else _wrap(init_inputs))
+        return inputs, self.StateWrapper(cell_states, log_probs, finished,
+                                         lengths), _wrap(finished)
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        vocab = logits.shape[-1]
+        step_log_probs = jax.nn.log_softmax(logits)
+        step_log_probs = self._mask_probs(step_log_probs,
+                                          beam_state.finished)
+        log_probs = step_log_probs + beam_state.log_probs[..., None]
+        scores = log_probs.reshape(-1, self.beam_size * vocab)
+        topk_scores, topk_indices = jax.lax.top_k(scores, self.beam_size)
+        beam_indices = topk_indices // vocab
+        token_indices = (topk_indices % vocab).astype(jnp.int64)
+        next_log_probs = jnp.take_along_axis(scores, topk_indices, axis=1)
+        next_cell_states = _map(
+            lambda x: self._gather(x, beam_indices), next_cell_states)
+        next_finished = self._gather(beam_state.finished, beam_indices)
+        next_lengths = self._gather(beam_state.lengths, beam_indices)
+        next_lengths = next_lengths + (~next_finished).astype(jnp.int64)
+        next_finished = next_finished | (token_indices == self.end_token)
+        out = self.OutputWrapper(topk_scores, token_indices,
+                                 beam_indices.astype(jnp.int64))
+        state = self.StateWrapper(next_cell_states, next_log_probs,
+                                  next_finished, next_lengths)
+        return out, state
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_in = _map(lambda x: self._merge_batch_beams(_unwrap(x)),
+                         inputs)
+        merged_states = _map(self._merge_batch_beams, states.cell_states)
+        cell_out, next_cell_states = self.cell(
+            _map(_wrap, merged_in), _map(_wrap, merged_states), **kwargs)
+        cell_out = _map(lambda x: self._split_batch_beams(_unwrap(x)),
+                        cell_out)
+        next_cell_states = _map(lambda x: self._split_batch_beams(_unwrap(x)),
+                                next_cell_states)
+        if self.output_fn is not None:
+            cell_out = _unwrap(self.output_fn(_wrap(cell_out)))
+        out, state = self._beam_search_step(time, jnp.asarray(cell_out),
+                                            next_cell_states, states)
+        next_inputs = (self.embedding_fn(_wrap(out.predicted_ids))
+                       if self.embedding_fn else _wrap(out.predicted_ids))
+        return out, state, next_inputs, _wrap(state.finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from ..ops.search import gather_tree
+
+        predicted_ids = gather_tree(_wrap(outputs.predicted_ids),
+                                    _wrap(outputs.parent_ids))
+        return predicted_ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run a decoder until every sequence finishes or ``max_step_num``
+    steps (reference rnn.py:1169).
+
+    The step compute is dense jnp (jit-friendly); the loop is an eager
+    Python loop with early exit — the TPU translation of the
+    reference's while_op + TensorArray machinery. When
+    ``max_step_num`` is None a 256-step safety cap applies (outputs
+    must have a bounded time axis). Returns
+    ``(outputs, final_states[, sequence_lengths])`` with the time axis
+    first iff ``output_time_major``.
+    """
+    cap = 256 if max_step_num is None else int(max_step_num)
+    inputs, states, finished = decoder.initialize(inits)
+    finished_v = _unwrap(finished)
+    seq_len = jnp.zeros(finished_v.shape, jnp.int64)
+    step_outputs = []
+    final_outputs = None
+    step = 0
+    while step <= cap and not bool(jnp.all(finished_v)):
+        out, next_states, next_inputs, next_finished = decoder.step(
+            jnp.asarray(step, jnp.int64), inputs, states, **kwargs)
+        next_finished_v = _unwrap(next_finished)
+        if not decoder.tracks_own_finished:
+            next_finished_v = next_finished_v | finished_v
+            next_seq_len = seq_len + (~finished_v).astype(jnp.int64)
+            if impute_finished:
+                next_states = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(
+                        _bcast(finished_v, jnp.asarray(new)),
+                        jnp.asarray(old), jnp.asarray(new)),
+                    next_states, states)
+        else:
+            next_seq_len = getattr(next_states, "lengths", seq_len)
+        step_outputs.append(_map(_unwrap, out))
+        inputs, states = next_inputs, next_states
+        finished_v, seq_len = next_finished_v, next_seq_len
+        step += 1
+
+    if not step_outputs:
+        raise ValueError("dynamic_decode: decoder finished before the "
+                         "first step — check initialize()")
+    # stack along time, keeping the output namedtuple structure
+    outputs = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *step_outputs)
+    try:
+        outputs, final_states = decoder.finalize(outputs, states, seq_len)
+        final_outputs = outputs
+    except NotImplementedError:
+        final_outputs, final_states = outputs, states
+
+    if not output_time_major:
+        final_outputs = _map(
+            lambda x: jnp.swapaxes(jnp.asarray(_unwrap(x)), 0, 1),
+            final_outputs)
+    final_outputs = _map(lambda x: _wrap(jnp.asarray(x)), final_outputs)
+    if return_length:
+        return final_outputs, final_states, _wrap(seq_len)
+    return final_outputs, final_states
+
+
+def _bcast(mask, ref):
+    m = mask
+    while m.ndim < ref.ndim:
+        m = m[..., None]
+    return m
+
+
+class DecodeHelper:
+    """Sampling protocol for BasicDecoder (reference rnn.py:1399)."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: feed ground-truth inputs step by step
+    (reference rnn.py:1468). inputs: (batch, time, ...) (or time-major);
+    sequence_length: (batch,)."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.sequence_length = _unwrap(sequence_length)
+        self.time_major = time_major
+        # transpose to time-major ONCE — next_inputs slices a step per
+        # call and must not move the whole tensor every step
+        t = (lambda x: _unwrap(x)) if time_major else \
+            (lambda x: jnp.swapaxes(_unwrap(x), 0, 1))
+        self._tm_inputs = _map(t, inputs)
+
+    def initialize(self):
+        first = _map(lambda x: x[0], self._tm_inputs)
+        finished = self.sequence_length <= 0
+        return _map(_wrap, first), _wrap(finished)
+
+    def sample(self, time, outputs, states):
+        return _wrap(jnp.argmax(_unwrap(outputs), axis=-1))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        t = int(time) + 1
+        length = jax.tree_util.tree_leaves(self._tm_inputs)[0].shape[0]
+        nxt = _map(lambda x: x[min(t, length - 1)], self._tm_inputs)
+        finished = self.sequence_length <= t
+        return _wrap(finished), _map(_wrap, nxt), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Greedy argmax sampling fed back through an embedding
+    (reference rnn.py:1599)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = _unwrap(start_tokens).astype(jnp.int64)
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        finished = jnp.zeros(self.start_tokens.shape, bool)
+        return self.embedding_fn(_wrap(self.start_tokens)), _wrap(finished)
+
+    def sample(self, time, outputs, states):
+        return _wrap(jnp.argmax(_unwrap(outputs), axis=-1))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        ids = _unwrap(sample_ids)
+        finished = ids == self.end_token
+        return _wrap(finished), self.embedding_fn(_wrap(ids)), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Categorical sampling from the softmax (reference rnn.py:1700)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self._key = jax.random.key(0 if seed is None else seed)
+
+    def sample(self, time, outputs, states):
+        logits = _unwrap(outputs)
+        if self.temperature is not None:
+            logits = logits / self.temperature
+        self._key, sub = jax.random.split(self._key)
+        return _wrap(jax.random.categorical(sub, logits, axis=-1))
+
+
+class BasicDecoder(Decoder):
+    """cell + helper decoder (reference rnn.py:1770): each step runs
+    the cell, samples via the helper, and emits
+    (cell_outputs, sample_ids)."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("cell_outputs", "sample_ids"))
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        initial_inputs, initial_finished = self.helper.initialize()
+        return initial_inputs, initial_cell_states, initial_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        out = self.OutputWrapper(_unwrap(cell_outputs), _unwrap(sample_ids))
+        return out, next_states, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError

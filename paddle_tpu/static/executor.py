@@ -171,6 +171,12 @@ class Executor:
             return self.run_startup(program, scope)
         feed = {k: np.asarray(v) if not isinstance(v, jax.Array) else v
                 for k, v in (feed or {}).items()}
+        # started py_readers feed their data vars (read_op parity —
+        # static/py_reader.py; raises EOFException when exhausted)
+        for _rdr in getattr(program, "_py_readers", []):
+            if _rdr._started:
+                for k, v in _rdr._next_feed().items():
+                    feed.setdefault(k, v)
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in (fetch_list or [])]
 

@@ -407,6 +407,17 @@ def _where(ins, attrs, ctx):
     return _out(jnp.where(ins["Condition"][0], _x(ins), ins["Y"][0]))
 
 
+@kernel("masked_select_rows")
+def _masked_select_rows(ins, attrs, ctx):
+    """Row-wise merge for the IfElse construct (legacy_flow.py): rows
+    where the (batch, 1) mask is true come from X, else from Y."""
+    m = ins["Mask"][0].astype(bool).reshape(-1)
+    x = _x(ins)
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    return _out(jnp.where(m, x, ins["Y"][0]))
+
+
 @kernel("fill_zeros_like")
 def _fill_zeros_like(ins, attrs, ctx):
     return _out(jnp.zeros_like(_x(ins)))

@@ -628,9 +628,16 @@ def equal(x, y):
                           {"axis": -1})
 
 
-def less_than(x, y):
-    return _append_simple("less_than", {"X": [x], "Y": [_cmp_operand(x, y)]},
-                          {"axis": -1})
+def less_than(x, y, cond=None):
+    """x < y. ``cond`` (reference layers/control_flow.py:less_than):
+    write the result into an existing variable — the fluid While
+    pattern's condition refresh."""
+    out = _append_simple("less_than",
+                         {"X": [x], "Y": [_cmp_operand(x, y)]},
+                         {"axis": -1})
+    if cond is not None:
+        return assign(out, output=cond)
+    return out
 
 
 def greater_than(x, y):

@@ -1,0 +1,309 @@
+"""Fluid 1.x block-builder control flow: While / Switch / IfElse
+(reference fluid/layers/control_flow.py: While :1086, Switch :2771,
+IfElse :2547).
+
+These are the mutation-style forms (the block writes back into existing
+variables via assign(output=)/increment(in_place)); the functional forms
+in static/layers.py (while_loop/cond/case/switch_case) are the preferred
+TPU-native API and this module lowers onto their kernels:
+
+- ``While``: the captured sub-block's writes to pre-existing variables
+  become the loop carry of the same ``while`` op while_loop uses — the
+  fluid contract (block must refresh the cond variable, e.g.
+  ``layers.less_than(i, n, cond=cond)``) maps 1:1 onto its
+  (loop_in, body_out, cond_out) attrs.
+- ``Switch``: each case body is captured in a sub-block; cases chain
+  into nested ``cond`` ops, else-branches re-emitting the previous
+  value (the reference executes at most one case body — here exactly
+  one branch of each lax.cond runs, same observable result).
+- ``IfElse``: the reference splits the batch rows by the mask, runs
+  each block on its slice, and merges; the TPU translation evaluates
+  both blocks DENSE on the full batch and row-merges with where —
+  identical results for row-wise computation (the reference's own
+  documented use), divergent for cross-row reductions inside a branch
+  (rejected: ``input()`` marks values; reductions over them inside a
+  branch see all rows — documented contract).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..utils import unique_name
+from .ir import _BlockGuard
+from .layers import LayerHelper, assign, default_main_program
+
+__all__ = ["While", "Switch", "IfElse"]
+
+
+def _parent_visible_names(block):
+    """All names resolvable from `block` BEFORE entering a child."""
+    names = set()
+    blk = block
+    prog = block.program
+    while blk is not None:
+        names.update(blk.vars.keys())
+        blk = (prog.blocks[blk.parent_idx]
+               if blk.parent_idx >= 0 else None)
+    return names
+
+
+def _written_parent_names(sub_block, pre_names):
+    """Names a sub-block writes that already existed outside it, in
+    first-write order (the loop-carry / merge set)."""
+    seen, out = set(), []
+    for op in sub_block.ops:
+        for ns in op.outputs.values():
+            for n in ns:
+                if n in pre_names and n not in seen:
+                    seen.add(n)
+                    out.append(n)
+    return out
+
+
+class While:
+    """``while cond:`` block builder (reference control_flow.py:1086).
+
+    Usage (fluid 1.x pattern)::
+
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)   # refresh the condition
+
+    Every write into a pre-existing variable is loop-carried; the block
+    MUST refresh the cond variable or the loop would never terminate
+    (raised at build time).
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper(name or "while")
+
+    @contextlib.contextmanager
+    def block(self):
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        pre_names = _parent_visible_names(parent)
+        sb = prog.create_block()
+        with _BlockGuard(prog, sb):
+            yield
+        carried = _written_parent_names(sb, pre_names)
+        if self.cond_var.name not in carried:
+            raise ValueError(
+                "While block never updates its condition variable "
+                f"{self.cond_var.name!r} — the loop would not terminate. "
+                "Refresh it inside the block, e.g. "
+                "layers.less_than(i, n, cond=cond).")
+        parent.append_op(
+            type="while",
+            inputs={"X": list(carried), "Cond": [self.cond_var.name]},
+            outputs={"Out": list(carried)},
+            attrs={"sub_block": sb.idx, "loop_in": list(carried),
+                   "body_out": list(carried),
+                   "cond_out": self.cond_var.name})
+
+
+class Switch:
+    """At-most-one-case dispatch (reference control_flow.py:2771), the
+    fluid learning-rate-schedule staple::
+
+        lr = layers.create_global_var([1], 0.0, "float32")
+        with layers.Switch() as switch:
+            with switch.case(step < warmup):
+                layers.assign(warm_lr, lr)
+            with switch.default():
+                layers.assign(base_lr, lr)
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper(name or "switch")
+        self._cases = []          # (pred_var_or_None, block, written)
+        self._inside = False
+
+    def __enter__(self):
+        self._inside = True
+        return self
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        if not self._inside:
+            raise RuntimeError("Switch.case used outside 'with Switch()'")
+        yield from self._capture(condition)
+
+    @contextlib.contextmanager
+    def default(self):
+        if not self._inside:
+            raise RuntimeError("Switch.default used outside "
+                               "'with Switch()'")
+        yield from self._capture(None)
+
+    def _capture(self, condition):
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        pre = _parent_visible_names(parent)
+        sb = prog.create_block()
+        with _BlockGuard(prog, sb):
+            yield
+        self._cases.append((condition, sb, _written_parent_names(sb, pre)))
+
+    def __exit__(self, exc_type, exc, tb):
+        self._inside = False
+        if exc_type is not None:
+            return False
+        prog = self.helper.main_program
+        parent = prog.current_block()
+        written = []
+        for _, _, w in self._cases:
+            for n in w:
+                if n not in written:
+                    written.append(n)
+        if not written:
+            return False
+        defaults = [(sb, w) for c, sb, w in self._cases if c is None]
+        cases = [(c, sb, w) for c, sb, w in self._cases if c is not None]
+        if len(defaults) > 1:
+            raise ValueError("Switch allows at most one default() block")
+        if not cases:
+            raise ValueError("Switch with only a default() block — use "
+                             "plain assigns instead")
+        # ONE cond per case over the union of written names (not one
+        # per (name, case) pair — a case sub-block must execute once):
+        # chain back to front; each else-branch re-emits whatever the
+        # chain below produced, the base being the default block's
+        # values (falling through to the originals for names it does
+        # not write)
+        current = {}                    # name -> source
+        for name in written:
+            if defaults and name in defaults[0][1]:
+                current[name] = ("block", defaults[0][0].idx)
+            else:
+                current[name] = name
+        for condition, sb, w in reversed(cases):
+            # true branch: the case sub-block; names it does not write
+            # are re-emitted inside it from the chain's current source
+            t_names = {}
+            with _BlockGuard(prog, sb):
+                for name in written:
+                    if name in w:
+                        t_names[name] = name
+                    else:
+                        t_names[name] = _source_value(
+                            prog, parent, current[name], name).name
+            fb = prog.create_block()
+            f_names = {}
+            with _BlockGuard(prog, fb):
+                for name in written:
+                    f_names[name] = _source_value(
+                        prog, parent, current[name], name).name
+            out_names = [unique_name.generate("switch.out")
+                         for _ in written]
+            parent.append_op(
+                type="cond",
+                inputs={"Cond": [condition.name]},
+                outputs={"Out": out_names},
+                attrs={"sub_block_t": sb.idx, "sub_block_f": fb.idx,
+                       "out_t": [t_names[n] for n in written],
+                       "out_f": [f_names[n] for n in written]})
+            for name, out_name in zip(written, out_names):
+                v = parent.var(name)
+                parent.create_var(name=out_name, shape=v.shape,
+                                  dtype=v.dtype)
+                current[name] = out_name
+        for name in written:
+            if current[name] != name:
+                assign(parent.var(current[name]),
+                       output=parent.var(name))
+        return False
+
+
+def _reemit_block(prog, src_block_idx, src_name):
+    """Inside the current (false-)block, re-run the ops of a previously
+    captured default block so its value for src_name materializes here."""
+    src = prog.blocks[src_block_idx]
+    cur = prog.current_block()
+    for op in src.ops:
+        cur.append_op(type=op.type, inputs=dict(op.inputs),
+                      outputs=dict(op.outputs), attrs=dict(op.attrs))
+        for ns in op.outputs.values():
+            for n in ns:
+                if n not in cur.vars:
+                    sv = src.var(n)
+                    cur.create_var(name=n, shape=sv.shape, dtype=sv.dtype)
+    return cur.var(src_name)
+
+
+def _source_value(prog, parent, source, name):
+    """Materialize a chain source inside the current block: either
+    re-emit the default block's ops (("block", idx) source) or assign
+    from a parent-visible name."""
+    if isinstance(source, tuple):
+        return _reemit_block(prog, source[1], name)
+    return assign(parent.var(source))
+
+
+class IfElse:
+    """Row-masked two-branch construct (reference control_flow.py:2547).
+
+    cond: (batch, 1) bool. ``input(x)`` marks a value used inside a
+    branch; ``output(*outs)`` registers branch results. Calling the
+    instance merges both branches' outputs row-wise by the mask. Both
+    branches run DENSE on the full batch (see module docstring).
+    """
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.helper = LayerHelper(name or "ifelse")
+        self._outs = {True: None, False: None}
+        self._in_branch = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._in_branch = True
+        yield
+        self._in_branch = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_branch = False
+        yield
+        self._in_branch = None
+
+    def input(self, x):
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.input outside a branch block")
+        return x
+
+    def output(self, *outs):
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.output outside a branch block")
+        self._outs[self._in_branch] = list(outs)
+
+    def __call__(self):
+        t, f = self._outs[True], self._outs[False]
+        if t is None or f is None:
+            raise ValueError("IfElse needs output() in both branches")
+        if len(t) != len(f):
+            raise ValueError("IfElse branches registered different "
+                             "output arities")
+        from .layers import _append_simple
+
+        merged = []
+        for tv, fv in zip(t, f):
+            merged.append(_append_simple(
+                "masked_select_rows",
+                {"Mask": [self.cond.name], "X": [tv.name],
+                 "Y": [fv.name]}, {}))
+        return merged
+
+
+def _register():
+    from . import layers as _layers
+
+    _layers._register_exports(
+        {"While": While, "Switch": Switch, "IfElse": IfElse})
+
+
+_register()

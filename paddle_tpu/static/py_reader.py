@@ -1,0 +1,144 @@
+"""Static-graph reader facade: py_reader / create_py_reader_by_data /
+read_file / double_buffer (reference fluid/layers/io.py py_reader :558,
+operators/reader/create_py_reader_op.cc + buffered_reader.cc).
+
+The reference feeds a C++ LoDTensorBlockingQueue that `read_op` pops
+inside the executor. The TPU translation: the reader owns the static
+``data`` variables and a Python batch source; ``Executor.run`` pulls the
+next batch from every started reader of the program into the feed dict
+(the dense equivalent of read_op), raising ``EOFException`` when the
+source is exhausted — the reference's catch-EOF-then-reset() training
+loop works verbatim. Device prefetch/double buffering is subsumed by
+jit dispatch pipelining (the next batch's host->device copy overlaps
+the current step), so ``double_buffer`` is the identity with its
+contract documented.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.errors import EOFException
+from ..utils import unique_name
+
+__all__ = ["py_reader", "create_py_reader_by_data", "read_file",
+           "double_buffer", "PyReader"]
+
+
+class PyReader:
+    """Program-attached batch source feeding a fixed list of data vars."""
+
+    def __init__(self, program, feed_vars, capacity=64,
+                 use_double_buffer=True):
+        self.program = program
+        self.feed_vars = list(feed_vars)
+        self.capacity = capacity
+        self._gen_fn = None
+        self._it = None
+        self._started = False
+        readers = getattr(program, "_py_readers", None)
+        if readers is None:
+            readers = []
+            program._py_readers = readers
+        readers.append(self)
+
+    # -- decoration (reference reader.py GeneratorLoader surface) -----
+
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader() yields per-SAMPLE tuples; batching left to the
+        decorated reader (paddle.batch), matching the reference."""
+        self._gen_fn = reader
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._gen_fn = reader
+        return self
+
+    decorate_tensor_provider = decorate_batch_generator
+
+    # -- run-time protocol --------------------------------------------
+
+    def start(self):
+        if self._gen_fn is None:
+            raise RuntimeError("py_reader.start() before decorate_*()")
+        self._it = iter(self._gen_fn())
+        self._started = True
+
+    def reset(self):
+        self._it = None
+        self._started = False
+
+    def _next_feed(self):
+        if not self._started:
+            return {}
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self._started = False
+            raise EOFException(
+                "py_reader source exhausted — catch this and call "
+                "reader.reset() (reference fluid.core.EOFException "
+                "loop)") from None
+        if not isinstance(batch, (list, tuple)):
+            batch = (batch,)
+        if len(batch) != len(self.feed_vars):
+            raise ValueError(
+                f"py_reader batch arity {len(batch)} != declared "
+                f"{len(self.feed_vars)} slots")
+        return {v.name: np.asarray(b) for v, b in
+                zip(self.feed_vars, batch)}
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Create a reader plus its data variables (fluid/layers/io.py:558).
+    shapes use -1 for the batch axis."""
+    from .ir import default_main_program
+    from .layers import data as data_layer
+
+    prog = default_main_program()
+    feed_vars = []
+    base = name or unique_name.generate("py_reader")
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        feed_vars.append(data_layer(
+            name=f"{base}.slot{i}", shape=list(shape), dtype=dtype))
+    return PyReader(prog, feed_vars, capacity, use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """Reader over EXISTING data vars (fluid/layers/io.py:755)."""
+    from .ir import default_main_program
+
+    return PyReader(default_main_program(), feed_list, capacity,
+                    use_double_buffer)
+
+
+def read_file(reader):
+    """The data variables a reader feeds (read_op parity: in the
+    reference this pops the queue; here the pull happens in
+    Executor.run, so this just hands back the graph inputs)."""
+    vars_ = reader.feed_vars
+    return vars_[0] if len(vars_) == 1 else vars_
+
+
+def double_buffer(reader, place=None, name=None):
+    """Identity by design: host->device copy of the next feed overlaps
+    the current jitted step (XLA async dispatch), which is what
+    buffered_reader.cc's second buffer bought."""
+    return reader
+
+
+def _register():
+    from . import layers as _layers
+
+    _layers._register_exports(
+        {"py_reader": py_reader,
+         "create_py_reader_by_data": create_py_reader_by_data,
+         "read_file": read_file, "double_buffer": double_buffer})
+
+
+_register()

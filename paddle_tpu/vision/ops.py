@@ -1309,3 +1309,234 @@ def detection_map(detect_res, label, class_num, det_lengths=None,
     if count:
         m_ap /= count
     return float(m_ap), (pos_count, true_pos, false_pos)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling variants (round 3): psroi / prroi / deformable
+# ---------------------------------------------------------------------------
+
+
+def _roi_batch_ids(lengths, r, n):
+    if lengths is None:
+        return jnp.zeros((r,), jnp.int32)
+    lv = np.asarray(lengths).astype(np.int64).reshape(-1)
+    return jnp.asarray(np.repeat(np.arange(n), lv), jnp.int32)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_lengths=None, name=None):
+    """Position-sensitive RoI average pooling (psroi_pool_op.h, R-FCN).
+
+    input (N, OC*PH*PW, H, W); rois (R, 4) xyxy with rois_lengths (N,).
+    Output channel c at bin (ph, pw) averages input channel
+    (c*PH + ph)*PW + pw over the (rounded, +1-ended) bin window. One
+    jit, vmapped over RoIs: each bin is an indicator-weighted einsum —
+    no scalar loops."""
+    from ..framework.tensor import Tensor, unwrap
+
+    x = jnp.asarray(unwrap(input), jnp.float32)
+    rv = jnp.asarray(unwrap(rois), jnp.float32).reshape(-1, 4)
+    n, cin, h, w = x.shape
+    oc, ph_n, pw_n = output_channels, pooled_height, pooled_width
+    if cin != oc * ph_n * pw_n:
+        raise ValueError(
+            f"psroi_pool: input channels {cin} != output_channels*PH*PW "
+            f"({oc}*{ph_n}*{pw_n})")
+    batch_of = _roi_batch_ids(rois_lengths, rv.shape[0], n)
+
+    @jax.jit
+    def run(x, rv, batch_of):
+        x5 = x.reshape(n, oc, ph_n, pw_n, h, w)
+
+        def one(roi, bi):
+            sw = jnp.round(roi[0]) * spatial_scale
+            sh = jnp.round(roi[1]) * spatial_scale
+            ew = (jnp.round(roi[2]) + 1.0) * spatial_scale
+            eh = (jnp.round(roi[3]) + 1.0) * spatial_scale
+            rh = jnp.maximum(eh - sh, 0.1)
+            rw = jnp.maximum(ew - sw, 0.1)
+            bh, bw = rh / ph_n, rw / pw_n
+            phs = jnp.arange(ph_n, dtype=jnp.float32)
+            pws = jnp.arange(pw_n, dtype=jnp.float32)
+            h0 = jnp.clip(jnp.floor(phs * bh + sh), 0, h)
+            h1 = jnp.clip(jnp.ceil((phs + 1) * bh + sh), 0, h)
+            w0 = jnp.clip(jnp.floor(pws * bw + sw), 0, w)
+            w1 = jnp.clip(jnp.ceil((pws + 1) * bw + sw), 0, w)
+            hg = jnp.arange(h, dtype=jnp.float32)
+            wg = jnp.arange(w, dtype=jnp.float32)
+            rmask = ((hg[None, :] >= h0[:, None]) &
+                     (hg[None, :] < h1[:, None])).astype(jnp.float32)
+            cmask = ((wg[None, :] >= w0[:, None]) &
+                     (wg[None, :] < w1[:, None])).astype(jnp.float32)
+            img = x5[bi]                                  # (OC,PH,PW,H,W)
+            tot = jnp.einsum("ph,qw,cpqhw->cpq", rmask, cmask, img)
+            area = ((h1 - h0)[:, None] * (w1 - w0)[None, :])
+            return jnp.where(area > 0, tot / jnp.maximum(area, 1.0), 0.0)
+
+        return jax.vmap(one)(rv, batch_of)
+
+    return Tensor(run(x, rv, batch_of))
+
+
+def _tri_integral(a, b, grid):
+    """∫_a^b max(0, 1-|x-c|) dx for every node c in ``grid`` — the row
+    of exact bilinear-surface integration weights PrRoI pooling is
+    built on (prroi_pool_op.h PrRoIPoolingMatCalculation, refactored
+    as a dense weight vector instead of per-cell scalar math)."""
+    def F(t):  # antiderivative of the triangle kernel from -inf
+        return jnp.where(
+            t <= -1.0, 0.0,
+            jnp.where(t <= 0.0, 0.5 * (t + 1.0) ** 2,
+                      jnp.where(t < 1.0, 1.0 - 0.5 * (1.0 - t) ** 2, 1.0)))
+
+    return F(b - grid) - F(a - grid)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise RoI pooling (prroi_pool_op.h, arXiv:1807.11590): the
+    EXACT integral of the bilinearly-interpolated feature surface over
+    each continuous bin, divided by the bin area — fully differentiable
+    in the roi coordinates too (AD through the closed-form triangle
+    integrals gives the paper's coordinate gradient).
+
+    input (N, C, H, W); rois (R, 4); batch_roi_nums (N,). The per-bin
+    integral is two 1-D triangle-integral weight vectors contracted
+    against the feature map (einsum -> MXU), vmapped over RoIs."""
+    from ..framework.tensor import Tensor, unwrap
+
+    x = jnp.asarray(unwrap(input), jnp.float32)
+    rv = jnp.asarray(unwrap(rois), jnp.float32).reshape(-1, 4)
+    n, c, h, w = x.shape
+    ph_n, pw_n = pooled_height, pooled_width
+    batch_of = _roi_batch_ids(batch_roi_nums, rv.shape[0], n)
+
+    @jax.jit
+    def run(x, rv, batch_of):
+        hg = jnp.arange(h, dtype=jnp.float32)
+        wg = jnp.arange(w, dtype=jnp.float32)
+
+        def one(roi, bi):
+            sw, sh = roi[0] * spatial_scale, roi[1] * spatial_scale
+            ew, eh = roi[2] * spatial_scale, roi[3] * spatial_scale
+            rw = jnp.maximum(ew - sw, 0.0)
+            rh = jnp.maximum(eh - sh, 0.0)
+            bh, bw = rh / ph_n, rw / pw_n
+            win = jnp.maximum(bh * bw, 0.0)
+            phs = jnp.arange(ph_n, dtype=jnp.float32)
+            pws = jnp.arange(pw_n, dtype=jnp.float32)
+            # (PH, H) and (PW, W) exact integration weights
+            wh = _tri_integral(sh + phs[:, None] * bh,
+                               sh + (phs[:, None] + 1) * bh, hg[None, :])
+            ww = _tri_integral(sw + pws[:, None] * bw,
+                               sw + (pws[:, None] + 1) * bw, wg[None, :])
+            tot = jnp.einsum("ph,qw,chw->cpq", wh, ww, x[bi])
+            return jnp.where(win > 0, tot / jnp.maximum(win, 1e-12), 0.0)
+
+        return jax.vmap(one)(rv, batch_of)
+
+    return Tensor(run(x, rv, batch_of))
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, rois_lengths=None,
+                           name=None):
+    """Deformable (PS-)RoI pooling (deformable_psroi_pooling_op.h):
+    each bin's sampling window is shifted by a learned offset from
+    ``trans``, then averaged over sample_per_part^2 bilinear samples.
+    position_sensitive maps output channel c at group cell (gh, gw) to
+    input channel (c*GH + gh)*GW + gw (R-FCN layout).
+
+    input (N, C, H, W); rois (R, 4); trans (R, 2, PART_H, PART_W).
+    Returns (out (R, OC, PH, PW)); fully jit (vmapped over RoIs,
+    fixed sample grid)."""
+    from ..framework.tensor import Tensor, unwrap
+
+    x = jnp.asarray(unwrap(input), jnp.float32)
+    rv = jnp.asarray(unwrap(rois), jnp.float32).reshape(-1, 4)
+    tv = jnp.asarray(unwrap(trans), jnp.float32)
+    n, cin, h, w = x.shape
+    gh_n, gw_n = group_size
+    ph_n, pw_n = pooled_height, pooled_width
+    if part_size is None:
+        part_h, part_w = ph_n, pw_n
+    else:
+        part_h, part_w = part_size
+    oc = cin // (gh_n * gw_n) if position_sensitive else cin
+    batch_of = _roi_batch_ids(rois_lengths, rv.shape[0], n)
+    spp = int(sample_per_part)
+
+    @jax.jit
+    def run(x, rv, tv, batch_of):
+        def one(roi, tr, bi):
+            sw = jnp.round(roi[0]) * spatial_scale - 0.5
+            sh = jnp.round(roi[1]) * spatial_scale - 0.5
+            ew = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+            eh = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+            rw = jnp.maximum(ew - sw, 0.1)
+            rh = jnp.maximum(eh - sh, 0.1)
+            bh, bw = rh / ph_n, rw / pw_n
+            sbh, sbw = bh / spp, bw / spp
+            phs = jnp.arange(ph_n)
+            pws = jnp.arange(pw_n)
+            prt_h = jnp.floor(phs.astype(jnp.float32) / ph_n * part_h
+                              ).astype(jnp.int32)
+            prt_w = jnp.floor(pws.astype(jnp.float32) / pw_n * part_w
+                              ).astype(jnp.int32)
+            if no_trans:
+                tx = jnp.zeros((ph_n, pw_n))
+                ty = jnp.zeros((ph_n, pw_n))
+            else:
+                tx = tr[0][prt_h[:, None], prt_w[None, :]] * trans_std
+                ty = tr[1][prt_h[:, None], prt_w[None, :]] * trans_std
+            wstart = pws[None, :] * bw + sw + tx * rw       # (PH, PW)
+            hstart = phs[:, None] * bh + sh + ty * rh
+            # sample grid (PH, PW, S, S)
+            iw = jnp.arange(spp, dtype=jnp.float32)
+            ws = wstart[..., None, None] + iw[None, None, None, :] * sbw
+            hs = hstart[..., None, None] + iw[None, None, :, None] * sbh
+            inb = ((ws >= -0.5) & (ws <= w - 0.5) &
+                   (hs >= -0.5) & (hs <= h - 0.5))
+            wc = jnp.clip(ws, 0.0, w - 1.0)
+            hc = jnp.clip(hs, 0.0, h - 1.0)
+            # position-sensitive channel map per bin
+            gw_i = jnp.clip((pws * gw_n) // pw_n, 0, gw_n - 1)
+            gh_i = jnp.clip((phs * gh_n) // ph_n, 0, gh_n - 1)
+            img = x[bi]                                     # (C, H, W)
+
+            h0 = jnp.floor(hc).astype(jnp.int32)
+            w0 = jnp.floor(wc).astype(jnp.int32)
+            h1 = jnp.minimum(h0 + 1, h - 1)
+            w1 = jnp.minimum(w0 + 1, w - 1)
+            fh = hc - h0
+            fw = wc - w0
+
+            # channel map for ALL output channels at once: (OC, PH, PW)
+            cs = jnp.arange(oc, dtype=jnp.int32)
+            if position_sensitive:
+                cmap = ((cs[:, None, None] * gh_n + gh_i[None, :, None])
+                        * gw_n + gw_i[None, None, :])
+            else:
+                cmap = jnp.broadcast_to(cs[:, None, None],
+                                        (oc, ph_n, pw_n))
+            cm = cmap[..., None, None]                # (OC, PH, PW, 1, 1)
+            v00 = img[cm, h0[None], w0[None]]
+            v01 = img[cm, h0[None], w1[None]]
+            v10 = img[cm, h1[None], w0[None]]
+            v11 = img[cm, h1[None], w1[None]]
+            vals = ((1 - fh)[None] * (1 - fw)[None] * v00 +
+                    (1 - fh)[None] * fw[None] * v01 +
+                    fh[None] * (1 - fw)[None] * v10 +
+                    fh[None] * fw[None] * v11)        # (OC, PH, PW, S, S)
+            vals = jnp.where(inb[None], vals, 0.0)
+            cnt = jnp.sum(inb, axis=(-2, -1))         # (PH, PW)
+            return jnp.where(cnt[None] > 0,
+                             jnp.sum(vals, axis=(-2, -1))
+                             / jnp.maximum(cnt[None], 1), 0.0)
+
+        return jax.vmap(one)(rv, tv, batch_of)
+
+    return Tensor(run(x, rv, tv, batch_of))

@@ -1,0 +1,156 @@
+"""Seq2seq decoding API (nn/decode.py vs reference fluid/layers/rnn.py:
+BeamSearchDecoder semantics, dynamic_decode loop, helper family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.decode import (BasicDecoder, BeamSearchDecoder,
+                                  GreedyEmbeddingHelper,
+                                  SampleEmbeddingHelper, TrainingHelper,
+                                  dynamic_decode)
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+class RiggedCell:
+    """A 'cell' whose logits follow a fixed per-step script, so the
+    best decode path is known in closed form. States: a (batch, 1)
+    step counter."""
+
+    def __init__(self, script, vocab):
+        # script: list of token ids, the forced argmax at each step
+        self.script = script
+        self.vocab = vocab
+
+    def __call__(self, inputs, states, **kw):
+        import jax.numpy as jnp
+        step_arr = _np(states)
+        t = int(step_arr.reshape(-1)[0])
+        tok = self.script[min(t, len(self.script) - 1)]
+        logits = np.full((step_arr.shape[0], self.vocab), -5.0, np.float32)
+        logits[:, tok] = 5.0
+        from paddle_tpu.framework.tensor import Tensor
+        return (Tensor(jnp.asarray(logits)),
+                Tensor(jnp.asarray(step_arr + 1)))
+
+
+def test_beam_search_decoder_follows_rigged_script():
+    vocab, beam, batch = 7, 3, 2
+    end = 0
+    script = [4, 2, 5, end]
+    dec = BeamSearchDecoder(RiggedCell(script, vocab), start_token=1,
+                            end_token=end, beam_size=beam)
+    import jax.numpy as jnp
+    init_states = jnp.zeros((batch, 1), jnp.int64)
+    outputs, final_states, seq_len = dynamic_decode(
+        dec, inits=init_states, max_step_num=10, return_length=True)
+    ids = _np(outputs)                      # (batch, time, beam)
+    assert ids.shape[0] == batch
+    # the top beam must follow the scripted path then the end token
+    top = ids[0, :, 0].tolist()
+    assert top[:4] == script
+    # all beams finished at the end token -> loop exited early (<=10)
+    assert ids.shape[1] <= 6
+    lengths = _np(seq_len)
+    assert lengths.shape == (batch, beam)
+    assert int(lengths[0, 0]) == 4          # 4 real tokens incl. end
+
+
+def test_beam_search_decoder_with_lstm_and_embedding():
+    vocab, hidden, beam, batch = 11, 16, 4, 3
+    np.random.seed(0)
+    emb = nn.Embedding(vocab, hidden)
+    cell = nn.LSTMCell(hidden, hidden)
+    proj = nn.Linear(hidden, vocab)
+    dec = BeamSearchDecoder(cell, start_token=1, end_token=2,
+                            beam_size=beam, embedding_fn=emb,
+                            output_fn=proj)
+    import jax.numpy as jnp
+    h0 = jnp.zeros((batch, hidden), jnp.float32)
+    c0 = jnp.zeros((batch, hidden), jnp.float32)
+    outputs, final_states = dynamic_decode(dec, inits=(h0, c0),
+                                           max_step_num=5)
+    ids = _np(outputs)
+    assert ids.shape[0] == batch and ids.shape[2] == beam
+    assert ids.shape[1] <= 6
+    assert ids.dtype in (np.int64, np.int32)
+    # log probs are finite and sorted descending across beams at exit
+    lp = np.asarray(final_states.log_probs)
+    assert np.isfinite(lp[:, 0]).all()
+    assert (np.diff(lp, axis=1) <= 1e-5).all()
+
+
+def test_tile_beam_merge_with_batch():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t = _np(BeamSearchDecoder.tile_beam_merge_with_batch(x, 2))
+    assert t.shape == (4, 3)
+    np.testing.assert_allclose(t[0], t[1])
+    np.testing.assert_allclose(t[2], t[3])
+
+
+def test_basic_decoder_greedy_helper():
+    vocab, hidden, batch = 9, 8, 2
+    emb = nn.Embedding(vocab, hidden)
+    cell = nn.GRUCell(hidden, hidden)
+    proj = nn.Linear(hidden, vocab)
+    import jax.numpy as jnp
+    helper = GreedyEmbeddingHelper(emb, jnp.ones((batch,), jnp.int64), 0)
+    dec = BasicDecoder(cell, helper, output_fn=proj)
+    h0 = jnp.zeros((batch, hidden), jnp.float32)
+    outputs, _ = dynamic_decode(dec, inits=h0, max_step_num=4)
+    logits = _np(outputs.cell_outputs)      # (batch, time, vocab)
+    ids = _np(outputs.sample_ids)           # (batch, time)
+    assert logits.shape[0] == batch and logits.shape[2] == vocab
+    # sample_ids ARE the argmax of the emitted logits (greedy contract)
+    np.testing.assert_array_equal(ids, logits.argmax(-1))
+
+
+def test_basic_decoder_training_helper_teacher_forcing():
+    vocab, hidden, batch, T = 6, 8, 2, 5
+    np.random.seed(1)
+    cell = nn.SimpleRNNCell(hidden, hidden)
+    proj = nn.Linear(hidden, vocab)
+    import jax.numpy as jnp
+    gt = jnp.asarray(np.random.randn(batch, T, hidden), jnp.float32)
+    seq_len = jnp.asarray([T, 3])
+    helper = TrainingHelper(gt, seq_len)
+    dec = BasicDecoder(cell, helper, output_fn=proj)
+    h0 = jnp.zeros((batch, hidden), jnp.float32)
+    outputs, _, lengths = dynamic_decode(dec, inits=h0, max_step_num=T,
+                                         return_length=True)
+    ids = _np(outputs.sample_ids)
+    assert ids.shape == (batch, T)          # runs to the longest length
+    ln = _np(lengths)
+    assert ln[0] == T and ln[1] == 3
+
+
+def test_sample_embedding_helper_respects_temperature():
+    vocab, hidden, batch = 8, 8, 4
+    emb = nn.Embedding(vocab, hidden)
+    cell = nn.GRUCell(hidden, hidden)
+    proj = nn.Linear(hidden, vocab)
+    import jax.numpy as jnp
+    helper = SampleEmbeddingHelper(emb, jnp.ones((batch,), jnp.int64), 0,
+                                   softmax_temperature=0.5, seed=3)
+    dec = BasicDecoder(cell, helper, output_fn=proj)
+    h0 = jnp.zeros((batch, hidden), jnp.float32)
+    outputs, _ = dynamic_decode(dec, inits=h0, max_step_num=3)
+    ids = _np(outputs.sample_ids)
+    assert ids.min() >= 0 and ids.max() < vocab
+
+
+def test_layers_facades_and_rnn():
+    from paddle_tpu.static import layers as L
+    for n in ("Decoder", "BeamSearchDecoder", "BasicDecoder",
+              "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
+              "SampleEmbeddingHelper", "dynamic_decode", "rnn"):
+        assert hasattr(L, n), n
+    # layers.rnn scans a cell over time
+    import jax.numpy as jnp
+    cell = nn.GRUCell(4, 4)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 4), np.float32)
+    outs, final = L.rnn(cell, x)
+    assert _np(outs).shape == (2, 5, 4)

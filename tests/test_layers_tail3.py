@@ -1,0 +1,227 @@
+"""Round-3 third export sweep: roi-pool variants, CTR/focus ops,
+LoD/SelectedRows bridge ops, py_reader family (vs numpy
+transliterations of psroi_pool_op.h, prroi_pool_op.h,
+deformable_psroi_pooling_op.h, cvm_op.h, filter_by_instag_op.h,
+similarity_focus_op.cc, lod_reset/lod_append, merge_selected_rows,
+create_py_reader_op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.framework.errors import EOFException
+from paddle_tpu.framework.lod import LoDTensor
+from paddle_tpu.vision import ops as vops
+
+L = static.layers
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+# ---------------------------------------------------------------------------
+# psroi / prroi / deformable roi pooling
+# ---------------------------------------------------------------------------
+
+
+def test_psroi_pool_vs_loop():
+    rng = np.random.RandomState(0)
+    oc, ph, pw = 2, 2, 2
+    x = rng.randn(1, oc * ph * pw, 8, 8).astype(np.float32)
+    rois = np.asarray([[0, 0, 3, 3], [2, 2, 7, 7]], np.float32)
+    out = _np(vops.psroi_pool(x, rois, oc, 1.0, ph, pw,
+                              rois_lengths=np.asarray([2])))
+    assert out.shape == (2, oc, ph, pw)
+    # numpy reference for roi 0, channel 0, bin (0, 0)
+    sw, sh = round(0) * 1.0, round(0) * 1.0
+    ew, eh = (round(3) + 1.0), (round(3) + 1.0)
+    bh, bw = max(eh - sh, 0.1) / ph, max(ew - sw, 0.1) / pw
+    hs, he = int(np.floor(0 * bh + sh)), int(np.ceil(1 * bh + sh))
+    ws, we = int(np.floor(0 * bw + sw)), int(np.ceil(1 * bw + sw))
+    ch = (0 * ph + 0) * pw + 0
+    expect = x[0, ch, hs:he, ws:we].sum() / ((he - hs) * (we - ws))
+    np.testing.assert_allclose(out[0, 0, 0, 0], expect, rtol=1e-5)
+
+
+def test_psroi_pool_channel_mismatch_raises():
+    with pytest.raises(ValueError):
+        vops.psroi_pool(np.zeros((1, 7, 4, 4), np.float32),
+                        np.zeros((1, 4), np.float32), 2, 1.0, 2, 2)
+
+
+def test_prroi_pool_constant_field_is_exact():
+    # over a constant feature map the precise integral equals the
+    # constant regardless of roi alignment — the op's defining property
+    x = np.full((1, 3, 10, 10), 2.5, np.float32)
+    rois = np.asarray([[1.3, 2.7, 6.1, 8.9]], np.float32)
+    out = _np(vops.prroi_pool(x, rois, 1.0, 2, 2,
+                              batch_roi_nums=np.asarray([1])))
+    assert out.shape == (1, 3, 2, 2)
+    # interior bins fully covered by the constant field
+    np.testing.assert_allclose(out, 2.5, rtol=1e-4)
+
+
+def test_prroi_pool_matches_triangle_integral_1d():
+    # ramp image: integral of bilinear surface over bin == analytic mean
+    h = w = 8
+    x = np.broadcast_to(np.arange(w, dtype=np.float32), (h, w)).copy()
+    x = x[None, None]
+    rois = np.asarray([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = _np(vops.prroi_pool(x, rois, 1.0, 1, 1))
+    # over [1, 5]^2 the ramp f(x)=x has mean 3.0
+    np.testing.assert_allclose(out.reshape(()), 3.0, rtol=1e-5)
+
+
+def test_deformable_roi_pooling_no_trans_matches_avg():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.asarray([[0, 0, 7, 7]], np.float32)
+    trans = np.zeros((1, 2, 1, 1), np.float32)
+    out = _np(vops.deformable_roi_pooling(
+        x, rois, trans, no_trans=True, spatial_scale=1.0,
+        group_size=(1, 1), pooled_height=2, pooled_width=2,
+        sample_per_part=4))
+    assert out.shape == (1, 2, 2, 2)
+    assert np.isfinite(out).all()
+    # zero offsets + dense sampling ~ bin average of the bilinear field
+    approx = x[0, 0, 0:4, 0:4].mean()
+    assert abs(out[0, 0, 0, 0] - approx) < 0.5
+
+
+def test_deformable_roi_pooling_offsets_shift_window():
+    # constant-gradient image: a positive x-offset increases the pooled
+    # value by offset * gradient
+    h = w = 16
+    img = np.broadcast_to(np.arange(w, dtype=np.float32), (h, w)).copy()
+    x = img[None, None]
+    rois = np.asarray([[2, 2, 9, 9]], np.float32)
+    z = np.zeros((1, 2, 1, 1), np.float32)
+    t = np.zeros((1, 2, 1, 1), np.float32)
+    t[0, 0] = 1.0   # x-offset, scaled by trans_std * roi_width
+    base = _np(vops.deformable_roi_pooling(
+        x, rois, z, pooled_height=1, pooled_width=1, sample_per_part=4,
+        trans_std=0.1))
+    shifted = _np(vops.deformable_roi_pooling(
+        x, rois, t, pooled_height=1, pooled_width=1, sample_per_part=4,
+        trans_std=0.1))
+    assert shifted[0, 0, 0, 0] > base[0, 0, 0, 0]
+
+
+# ---------------------------------------------------------------------------
+# cvm / filter_by_instag / similarity_focus
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_value_model():
+    x = np.asarray([[3.0, 1.0, 7.0, 8.0]], np.float32)
+    out = _np(L.continuous_value_model(x, None, use_cvm=True))
+    np.testing.assert_allclose(
+        out[0, :2], [np.log(4.0), np.log(2.0) - np.log(4.0)], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2:], [7.0, 8.0])
+    out2 = _np(L.continuous_value_model(x, None, use_cvm=False))
+    np.testing.assert_allclose(out2, [[7.0, 8.0]])
+
+
+def test_filter_by_instag():
+    ins = np.arange(12, dtype=np.float32).reshape(4, 3)
+    tags = np.asarray([[1, -1], [2, 3], [4, -1], [3, 5]], np.int64)
+    out, lw, imap = L.filter_by_instag(ins, tags, np.asarray([3]))
+    np.testing.assert_allclose(_np(out), ins[[1, 3]])
+    np.testing.assert_allclose(_np(lw), [[1.0], [1.0]])
+    np.testing.assert_array_equal(_np(imap)[:, 1], [1, 3])
+    # empty match -> guard row
+    out2, lw2, _ = L.filter_by_instag(ins, tags, np.asarray([99]),
+                                      out_val_if_empty=7)
+    assert _np(out2).shape == (1, 3)
+    assert (_np(out2) == 7).all() and float(_np(lw2)[0, 0]) == 0.0
+
+
+def test_similarity_focus_unique_rows_cols():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    out = _np(L.similarity_focus(x, axis=1, indexes=[0, 2]))
+    assert out.shape == x.shape
+    # mask broadcast identically over the axis
+    np.testing.assert_allclose(out[:, 0], out[:, 1])
+    # per batch: the merged mask of one index has min(B,C)=4 picks with
+    # unique rows/cols; union of 2 indexes is between 4 and 8
+    per_image = out[:, 0].reshape(2, -1).sum(1)
+    assert ((per_image >= 4) & (per_image <= 8)).all()
+
+
+# ---------------------------------------------------------------------------
+# LoD / SelectedRows bridges
+# ---------------------------------------------------------------------------
+
+
+def test_lod_reset_append_and_rank_reorder():
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    t = LoDTensor(data, [[0, 2, 5]])
+    t2 = L.lod_reset(t, target_lod=[0, 1, 5])
+    assert t2.lod()[0] == [0, 1, 5]
+    t3 = L.lod_append(t2, [0, 1, 2, 3, 4, 5])
+    assert len(t3.lod()) == 2
+    # rank by length desc: seq1 (len 4) before seq0 (len 1)
+    table = L.lod_rank_table(t2)
+    assert [i for i, _ in table.items] == [1, 0]
+    r = L.reorder_lod_tensor_by_rank(t2, table)
+    np.testing.assert_allclose(np.asarray(r.data)[:4], data[1:5])
+    assert r.recursive_sequence_lengths()[0] == [4, 1]
+
+
+def test_selected_rows_merge_and_densify():
+    sr = L.SelectedRows([3, 1, 3], np.asarray(
+        [[1.0, 1.0], [2.0, 2.0], [10.0, 10.0]], np.float32), height=5)
+    m = L.merge_selected_rows(sr)
+    np.testing.assert_array_equal(m.rows, [1, 3])
+    np.testing.assert_allclose(m.value, [[2, 2], [11, 11]])
+    dense = _np(L.get_tensor_from_selected_rows(m))
+    assert dense.shape == (5, 2)
+    np.testing.assert_allclose(dense[3], [11, 11])
+    np.testing.assert_allclose(dense[0], [0, 0])
+
+
+# ---------------------------------------------------------------------------
+# py_reader family
+# ---------------------------------------------------------------------------
+
+
+def test_py_reader_feeds_executor_until_eof():
+    prog = static.Program()
+    with static.program_guard(prog):
+        reader = L.py_reader(capacity=4, shapes=[[-1, 3], [-1, 1]],
+                             dtypes=["float32", "int64"])
+        x, y = L.read_file(reader)
+        out = L.elementwise_add(x, L.cast(y, "float32"))
+
+    batches = [(np.ones((2, 3), np.float32) * i,
+                np.full((2, 1), i, np.int64)) for i in range(3)]
+    reader.decorate_batch_generator(lambda: iter(batches))
+    reader.start()
+    exe = static.Executor()
+    seen = 0
+    while True:
+        try:
+            (o,) = exe.run(prog, fetch_list=[out])
+        except EOFException:
+            reader.reset()
+            break
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.full((2, 3), 2 * seen, np.float32))
+        seen += 1
+    assert seen == 3
+    # restartable after reset
+    reader.start()
+    (o,) = exe.run(prog, fetch_list=[out])
+    assert np.asarray(o).shape == (2, 3)
+    reader.reset()
+
+
+def test_double_buffer_identity_and_by_data():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = L.data(name="pr_x", shape=[2, 2], dtype="float32")
+        reader = L.create_py_reader_by_data(4, [x])
+        assert L.double_buffer(reader) is reader
+        assert L.read_file(reader) is x

@@ -1,0 +1,133 @@
+"""Fluid 1.x block-builder control flow (static/legacy_flow.py While /
+Switch / IfElse vs reference control_flow.py semantics)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+L = static.layers
+
+
+def _run(prog, fetch, feed=None):
+    exe = static.Executor()
+    return exe.run(prog, feed=feed or {}, fetch_list=fetch)
+
+
+def test_while_counts_to_ten():
+    prog = static.Program()
+    with static.program_guard(prog):
+        i = L.fill_constant([1], "int64", 0)
+        n = L.fill_constant([1], "int64", 10)
+        s = L.fill_constant([1], "int64", 0)
+        cond = L.less_than(i, n)
+        w = L.While(cond)
+        with w.block():
+            L.assign(L.elementwise_add(s, i), output=s)
+            L.increment(i, value=1, in_place=True)
+            L.less_than(i, n, cond=cond)
+        out_i, out_s = _run(prog, [i, s])
+    assert int(np.asarray(out_i).reshape(())) == 10
+    assert int(np.asarray(out_s).reshape(())) == sum(range(10))
+
+
+def test_while_requires_cond_update():
+    prog = static.Program()
+    with static.program_guard(prog):
+        i = L.fill_constant([1], "int64", 0)
+        n = L.fill_constant([1], "int64", 3)
+        cond = L.less_than(i, n)
+        w = L.While(cond)
+        try:
+            with w.block():
+                L.increment(i, value=1, in_place=True)
+        except ValueError as e:
+            assert "condition" in str(e)
+        else:
+            raise AssertionError("missing cond refresh not caught")
+
+
+def test_switch_lr_schedule():
+    # the classic warmup LR pattern the reference documents for Switch
+    for step_val, expect in [(2.0, 0.1), (7.0, 0.01)]:
+        prog = static.Program()
+        with static.program_guard(prog):
+            step = L.fill_constant([1], "float32", step_val)
+            lr = L.fill_constant([1], "float32", 0.0)
+            warm = L.fill_constant([1], "float32", 0.1)
+            base = L.fill_constant([1], "float32", 0.01)
+            boundary = L.fill_constant([1], "float32", 5.0)
+            with L.Switch() as sw:
+                with sw.case(L.less_than(step, boundary)):
+                    L.assign(warm, output=lr)
+                with sw.default():
+                    L.assign(base, output=lr)
+            (out,) = _run(prog, [lr])
+        assert float(np.asarray(out).reshape(())) == np.float32(expect), (step_val, out)
+
+
+def test_switch_multiple_cases_first_match_wins():
+    for x_val, expect in [(1.0, 10.0), (5.0, 20.0), (9.0, 30.0)]:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = L.fill_constant([1], "float32", x_val)
+            out = L.fill_constant([1], "float32", 0.0)
+            three = L.fill_constant([1], "float32", 3.0)
+            seven = L.fill_constant([1], "float32", 7.0)
+            with L.Switch() as sw:
+                with sw.case(L.less_than(x, three)):
+                    L.assign(L.fill_constant([1], "float32", 10.0),
+                             output=out)
+                with sw.case(L.less_than(x, seven)):
+                    L.assign(L.fill_constant([1], "float32", 20.0),
+                             output=out)
+                with sw.default():
+                    L.assign(L.fill_constant([1], "float32", 30.0),
+                             output=out)
+            (o,) = _run(prog, [out])
+        assert float(np.asarray(o).reshape(())) == expect, (x_val, o)
+
+
+def test_switch_case_writing_two_vars():
+    # one cond per case even when the body writes several vars — both
+    # land, and the case body's ops run once in program structure
+    for x_val, (e_lr, e_mom) in [(1.0, (0.5, 0.8)), (9.0, (0.1, 0.9))]:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = L.fill_constant([1], "float32", x_val)
+            lr = L.fill_constant([1], "float32", 0.0)
+            mom = L.fill_constant([1], "float32", 0.0)
+            five = L.fill_constant([1], "float32", 5.0)
+            with L.Switch() as sw:
+                with sw.case(L.less_than(x, five)):
+                    L.assign(L.fill_constant([1], "float32", 0.5),
+                             output=lr)
+                    L.assign(L.fill_constant([1], "float32", 0.8),
+                             output=mom)
+                with sw.default():
+                    L.assign(L.fill_constant([1], "float32", 0.1),
+                             output=lr)
+                    L.assign(L.fill_constant([1], "float32", 0.9),
+                             output=mom)
+            o_lr, o_mom = _run(prog, [lr, mom])
+        assert float(np.asarray(o_lr).reshape(())) == np.float32(e_lr)
+        assert float(np.asarray(o_mom).reshape(())) == np.float32(e_mom)
+
+
+def test_ifelse_row_merge():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = L.data(name="x", shape=[4, 1], dtype="float32")
+        zero = L.fill_constant([4, 1], "float32", 0.0)
+        mask = L.greater_than(x, zero)
+        ie = L.IfElse(mask)
+        with ie.true_block():
+            ie.output(L.elementwise_mul(
+                ie.input(x), L.fill_constant([4, 1], "float32", 2.0)))
+        with ie.false_block():
+            ie.output(L.elementwise_mul(
+                ie.input(x), L.fill_constant([4, 1], "float32", -1.0)))
+        (merged,) = ie()
+        xv = np.asarray([[1.0], [-2.0], [3.0], [-4.0]], np.float32)
+        (out,) = _run(prog, [merged], feed={"x": xv})
+    np.testing.assert_allclose(np.asarray(out),
+                               [[2.0], [2.0], [6.0], [4.0]])
