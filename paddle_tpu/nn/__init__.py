@@ -47,3 +47,8 @@ from .decode import (  # noqa: F401
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
 from .moe import MoELayer, moe_apply_ep, MOE_EP_RULES  # noqa: F401
 from .crf import LinearChainCRF, crf_decoding, linear_chain_crf  # noqa: F401,E402
+
+# 2.0-alpha surface parity: pre-rename spellings + functional re-exports
+# + the layers that only lived there (must import LAST — it fills gaps
+# without overriding anything above)
+from . import compat20  # noqa: F401,E402

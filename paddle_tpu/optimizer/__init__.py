@@ -13,3 +13,33 @@ from .meta import (  # noqa: F401
 # reference-API aliases (fluid.optimizer.DGCMomentumOptimizer etc.)
 DGCMomentumOptimizer = DGCMomentum
 LookaheadOptimizer = LookAhead
+
+# -- v1.8 2.0-alpha spellings (reference python/paddle/optimizer at the
+# pre-rename point: *Optimizer class aliases, *LR scheduler names) -----
+AdadeltaOptimizer = Adadelta
+AdagradOptimizer = Adagrad
+DecayedAdagradOptimizer = DecayedAdagrad
+DpsgdOptimizer = Dpsgd
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum
+MomentumOptimizer = Momentum
+SGDOptimizer = SGD
+ExponentialMovingAverage = EMA
+
+from .lr import (  # noqa: E402,F401
+    LRScheduler as _LRScheduler,
+    CosineAnnealingDecay as CosineAnnealingLR,
+    ExponentialDecay as ExponentialLR,
+    InverseTimeDecay as InverseTimeLR,
+    LambdaDecay as LambdaLR,
+    LinearLrWarmup,
+    MultiStepDecay as MultiStepLR,
+    NaturalExpDecay as NaturalExpLR,
+    NoamDecay as NoamLR,
+    PiecewiseDecay as PiecewiseLR,
+    PolynomialDecay as PolynomialLR,
+    ReduceLROnPlateau,
+    StepDecay as StepLR,
+)
+from .meta import PipelineOptimizer  # noqa: E402,F401

@@ -309,3 +309,57 @@ class ModelAverage(EMA):
         for p in self._params:
             self._sum[id(p)] = self._sum.get(id(p), 0) + p.value
             self._ema[id(p)] = self._sum[id(p)] / self._count
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel training facade (reference fluid/optimizer.py
+    :3661 PipelineOptimizer — splits a program into SectionWorker
+    stages). The TPU pipeline is a compiled schedule, not a program
+    rewrite: this class pairs an inner optimizer with the
+    parallel.pipeline machinery and runs GPipe or 1F1B over a staged
+    model.
+
+    Usage::
+
+        opt = PipelineOptimizer(paddle.optimizer.Adam(...),
+                                num_microbatches=8)
+        # GPipe forward over stacked stages:
+        y = opt.pipeline_apply(stage_fn, stage_params, x,
+                               mesh=mesh, axis="pp")
+        # 1F1B training step (embedding/head inside the pipeline):
+        loss, grads = opt.pipeline_value_and_grad(
+            stage_fn, first_fn, last_fn, params, batch,
+            mesh=mesh, axis="pp")
+
+    or hand `strategy.pipeline = True` to fleet.distributed_optimizer,
+    which routes through the same schedule (distributed/fleet.py).
+    """
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self.inner_opt = optimizer
+        self.num_microbatches = num_microbatches
+
+    def pipeline_apply(self, stage_fn, stage_params, x, *, mesh, axis,
+                       **kw):
+        from ..parallel import pipeline as pp
+
+        return pp.pipeline_apply(stage_fn, stage_params, x, mesh=mesh,
+                                 axis=axis,
+                                 num_microbatches=self.num_microbatches,
+                                 **kw)
+
+    def pipeline_value_and_grad(self, stage_fn, first_fn, last_fn, *args,
+                                **kw):
+        from ..parallel import pipeline as pp
+
+        kw.setdefault("num_microbatches", self.num_microbatches)
+        return pp.pipeline_1f1b_value_and_grad(stage_fn, first_fn,
+                                               last_fn, *args, **kw)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)

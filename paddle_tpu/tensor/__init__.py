@@ -1,0 +1,139 @@
+"""paddle.tensor namespace (reference python/paddle/tensor — the 2.0
+tensor-operation namespace; every name is also reachable at the paddle
+top level). The implementations live in ops/; this module re-exports
+them and fills the handful of v1.8-era spellings that only existed
+here (reduce_*, elementwise_floordiv/sum, mul, numel, t, sums,
+standard_normal, shuffle, addcmul).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops as _ops
+from ..framework.tensor import Tensor
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    """input + value * tensor1 * tensor2 (reference tensor/math.py)."""
+    return Tensor(_unwrap(input) +
+                  value * _unwrap(tensor1) * _unwrap(tensor2))
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return Tensor(jnp.floor_divide(_unwrap(x), _unwrap(y)))
+
+
+def elementwise_sum(inputs, name=None):
+    out = _unwrap(inputs[0])
+    for t in inputs[1:]:
+        out = out + _unwrap(t)
+    return Tensor(out)
+
+
+sums = elementwise_sum
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """mul_op.cc: flatten x to 2-D at x_num_col_dims, y likewise,
+    matmul, then restore the reference output shape
+    x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:]."""
+    xv, yv = _unwrap(x), _unwrap(y)
+    xs = xv.reshape((int(np.prod(xv.shape[:x_num_col_dims])), -1))
+    ys = yv.reshape((int(np.prod(yv.shape[:y_num_col_dims])), -1))
+    out = xs @ ys
+    return Tensor(out.reshape(
+        tuple(xv.shape[:x_num_col_dims]) +
+        tuple(yv.shape[y_num_col_dims:])))
+
+
+def numel(x, name=None):
+    # default int dtype: requesting int64 under x64-off truncates to
+    # int32 anyway and warns on every call
+    return Tensor(jnp.asarray(int(np.prod(_unwrap(x).shape))))
+
+
+def reduce_sum(x, dim=None, keep_dim=False, name=None):
+    return Tensor(jnp.sum(_unwrap(x), axis=_ax(dim), keepdims=keep_dim))
+
+
+def reduce_mean(x, dim=None, keep_dim=False, name=None):
+    return Tensor(jnp.mean(_unwrap(x), axis=_ax(dim), keepdims=keep_dim))
+
+
+def reduce_max(x, dim=None, keep_dim=False, name=None):
+    return Tensor(jnp.max(_unwrap(x), axis=_ax(dim), keepdims=keep_dim))
+
+
+def reduce_min(x, dim=None, keep_dim=False, name=None):
+    return Tensor(jnp.min(_unwrap(x), axis=_ax(dim), keepdims=keep_dim))
+
+
+def reduce_prod(x, dim=None, keep_dim=False, name=None):
+    return Tensor(jnp.prod(_unwrap(x), axis=_ax(dim), keepdims=keep_dim))
+
+
+def reduce_all(x, dim=None, keep_dim=False, name=None):
+    return Tensor(jnp.all(_unwrap(x), axis=_ax(dim), keepdims=keep_dim))
+
+
+def reduce_any(x, dim=None, keep_dim=False, name=None):
+    return Tensor(jnp.any(_unwrap(x), axis=_ax(dim), keepdims=keep_dim))
+
+
+def _ax(dim):
+    if dim is None:
+        return None
+    return tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+
+def t(input, name=None):
+    """<=2-D transpose (reference tensor/linalg.py t)."""
+    v = _unwrap(input)
+    if v.ndim > 2:
+        raise ValueError("t() expects a tensor of rank <= 2")
+    return Tensor(v.T)
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    from ..ops.creation import randn
+
+    return randn(shape, dtype=dtype)
+
+
+def shuffle(x, name=None):
+    """Random row permutation (reference tensor/random.py shuffle)."""
+    from ..framework import flags as _flags  # noqa: F401  (seed plumbing)
+    import jax
+
+    v = _unwrap(x)
+    key = jax.random.key(np.random.randint(0, 2 ** 31 - 1))
+    return Tensor(jax.random.permutation(key, v, axis=0))
+
+
+# 'chunksqueeze' appears verbatim in the reference __all__ (a list-merge
+# typo for 'chunk'); alias it so the audit closes without inventing API
+chunksqueeze = _ops.chunk
+
+
+def _register():
+    import sys
+
+    mod = sys.modules[__name__]
+    # re-export the ops surface
+    for n in dir(_ops):
+        if not n.startswith("_") and not hasattr(mod, n):
+            setattr(mod, n, getattr(_ops, n))
+    # serialization + construction live at the paddle top level
+    import paddle_tpu as _p
+
+    for n in ("save", "load", "to_tensor"):
+        if not hasattr(mod, n) and hasattr(_p, n):
+            setattr(mod, n, getattr(_p, n))
+
+
+_register()

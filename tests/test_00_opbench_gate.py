@@ -1,5 +1,12 @@
 """Round-over-round op-level perf regression gate (VERDICT r2 item 6).
 
+Named test_00_* so pytest collects it FIRST: perf measurement wants the
+machine in its cleanest state. Late in a full-suite run the accumulated
+memory pressure slows big-footprint rows (adamw's 64 MB arrays) MORE
+than the small anchor ops, which load normalization cannot distinguish
+from a real regression — measuring before the churn removes the
+confound instead of papering over it with wider margins.
+
 Compares a fresh `tools/op_bench.py` smoke run against the newest
 committed `OPBENCH_r*.jsonl` baseline (same backend, same shapes) and
 fails on a >20% per-op slowdown. Timing noise is handled by taking the
