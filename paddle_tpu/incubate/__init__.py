@@ -1,3 +1,169 @@
 """paddle_tpu.incubate (reference python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
 from . import layers  # noqa: F401
+
+# hapi surface parity (reference python/paddle/incubate/hapi): text
+# building blocks, vision transforms/datasets/models, callbacks —
+# resolved from the package's own implementations, never overriding
+from . import text_models  # noqa: F401
+from .text_models import (  # noqa: F401
+    RNNCell, BasicLSTMCell, BasicGRUCell, StackedRNNCell,
+    StackedLSTMCell, StackedGRUCell, BidirectionalRNN, BidirectionalLSTM,
+    BidirectionalGRU, DynamicDecode, Conv1dPoolLayer, CNNEncoder, FFN,
+    TransformerCell, TransformerBeamSearchDecoder, CRFDecoding,
+    SequenceTagging,
+)
+
+
+class ProgressBar:
+    """hapi/progressbar.py: terminal progress meter Model.fit uses."""
+
+    def __init__(self, num=None, width=30, verbose=1, file=None):
+        import sys
+
+        self.num = num
+        self.width = width
+        self.verbose = verbose
+        self.file = file or sys.stdout
+        self._seen = 0
+
+    def start(self):
+        self._seen = 0
+
+    def update(self, current_num, values=None):
+        self._seen = current_num
+        if self.verbose == 0:
+            return
+        msg = ""
+        if self.num:
+            done = int(self.width * current_num / max(self.num, 1))
+            bar = "=" * done + "." * (self.width - done)
+            msg = f"\r{current_num}/{self.num} [{bar}]"
+        else:
+            msg = f"\rstep {current_num}"
+        for k, v in (values or []):
+            try:
+                msg += f" - {k}: {float(v):.4f}"
+            except (TypeError, ValueError):
+                msg += f" - {k}: {v}"
+        self.file.write(msg)
+        if self.num and current_num >= self.num:
+            self.file.write("\n")
+        self.file.flush()
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """hapi/download.py: resolve a pretrained-weights URL to a local
+    cache path, downloading on a cache miss."""
+    import hashlib
+    import os
+    import urllib.request
+
+    def _md5(p):
+        h = hashlib.md5()
+        with open(p, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    cache_dir = os.path.join(os.path.expanduser("~"), ".cache",
+                             "paddle_tpu", "weights")
+    os.makedirs(cache_dir, exist_ok=True)
+    fname = os.path.basename(url.split("?")[0]) or \
+        hashlib.md5(url.encode()).hexdigest()
+    path = os.path.join(cache_dir, fname)
+    if os.path.exists(path) and (md5sum is None or _md5(path) == md5sum):
+        return path
+    # download to a temp name and rename so an interrupted transfer can
+    # never be mistaken for a cached file
+    tmp = path + ".part"
+    try:
+        urllib.request.urlretrieve(url, tmp)
+    except OSError as e:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise RuntimeError(
+            f"could not download {url} (offline environment?) — place "
+            f"the file at {path} manually") from e
+    if md5sum is not None and _md5(tmp) != md5sum:
+        os.remove(tmp)
+        raise RuntimeError(f"md5 mismatch downloading {url}")
+    os.replace(tmp, path)
+    return path
+
+
+def uncombined_weight_to_state_dict(weight_dir):
+    """hapi/model.py helper: fold a directory of per-variable files
+    (the save_persistables one-file-per-var layout) into one state
+    dict."""
+    import os
+    import pickle
+
+    import numpy as np
+
+    state = {}
+    skipped = []
+    for fname in sorted(os.listdir(weight_dir)):
+        fpath = os.path.join(weight_dir, fname)
+        if not os.path.isfile(fpath):
+            continue
+        try:
+            state[fname] = np.load(fpath, allow_pickle=False)
+            continue
+        except (ValueError, OSError):
+            pass
+        try:
+            with open(fpath, "rb") as f:
+                state[fname] = np.asarray(pickle.load(f))
+        except Exception:           # unreadable format: report, not abort
+            skipped.append(fname)
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            f"uncombined_weight_to_state_dict: skipped unreadable "
+            f"files {skipped} (neither .npy nor pickle)")
+    return state
+
+
+def _register_hapi_surface():
+    """Resolve the remaining reference incubate/hapi __all__ names from
+    the package's vision/text/hapi modules."""
+    import sys
+
+    from .. import hapi as _hapi
+    from .. import text as _text
+    from ..io import dataloader as _dl  # noqa: F401
+    from ..vision import datasets as _vd
+    from ..vision import models as _vm
+    from ..vision import transforms as _vt
+
+    import types
+
+    mod = sys.modules[__name__]
+    for src in (_vt, _vd, _vm, _hapi, _text):
+        names = getattr(src, "__all__", None) or [
+            n for n in dir(src) if not n.startswith("_")]
+        for n in names:
+            v = getattr(src, n, None)
+            # only surface things DEFINED in this package — transitive
+            # imports (np, os, submodules) are not API
+            if v is None or isinstance(v, types.ModuleType):
+                continue
+            if not str(getattr(v, "__module__", "")).startswith(
+                    "paddle_tpu"):
+                continue
+            if not hasattr(mod, n):
+                setattr(mod, n, v)
+
+
+_register_hapi_surface()
+
+# nn-resident names the hapi surface also publishes
+from ..nn import (  # noqa: F401,E402
+    GRU, LSTM, RNN, BeamSearchDecoder, LinearChainCRF, MultiHeadAttention,
+    TransformerDecoder, TransformerDecoderLayer, TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from ..io.dataloader import DistributedBatchSampler  # noqa: F401,E402
+from ..hapi import Input, Model  # noqa: F401,E402

@@ -1,0 +1,365 @@
+"""hapi text-model building blocks (reference
+python/paddle/incubate/hapi/text/text.py): cell adapters, stacked and
+bidirectional RNN wrappers, the DynamicDecode layer, CNN text encoder,
+transformer decode cell + beam-search decoder, and the SequenceTagging
+(BiGRU-CRF) model.
+
+These compose the framework's primitives (nn cells + lax.scan RNN
+runner, nn/decode.py decoding stack, nn/crf.py) rather than
+re-implementing them — the reference file re-implements fluid layers
+for dygraph; here the layers are already define-by-run.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn.decode import BeamSearchDecoder, dynamic_decode
+
+__all__ = ["RNNCell", "BasicLSTMCell", "BasicGRUCell", "StackedRNNCell",
+           "StackedLSTMCell", "StackedGRUCell", "BidirectionalRNN",
+           "BidirectionalLSTM", "BidirectionalGRU", "DynamicDecode",
+           "Conv1dPoolLayer", "CNNEncoder", "FFN", "TransformerCell",
+           "TransformerBeamSearchDecoder", "CRFDecoding",
+           "SequenceTagging"]
+
+#: reference text.py:67 RNNCell — the framework's cell protocol
+RNNCell = nn.RNNCellBase
+
+
+class BasicLSTMCell(nn.LSTMCell):
+    """text.py:186 BasicLSTMCell: an LSTM cell with a forget-gate bias
+    offset (the only behavioural difference from the standard cell).
+    The offset is folded into the forget-gate slice of bias_ih at init
+    (gate order i|f|g|o, nn/rnn.py _lstm_cell)."""
+
+    def __init__(self, input_size, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__(input_size, hidden_size,
+                         weight_ih_attr=param_attr, bias_ih_attr=bias_attr)
+        self.forget_bias = forget_bias
+        if forget_bias:
+            b = np.array(
+                self.bias_ih.value if hasattr(self.bias_ih, "value")
+                else self.bias_ih, copy=True)
+            b[hidden_size:2 * hidden_size] += forget_bias
+            self.bias_ih.set_value(b)
+
+
+class BasicGRUCell(nn.GRUCell):
+    """text.py:321 BasicGRUCell — the standard GRU recurrence."""
+
+    def __init__(self, input_size, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        super().__init__(input_size, hidden_size,
+                         weight_ih_attr=param_attr, bias_ih_attr=bias_attr)
+
+
+class StackedRNNCell(nn.RNNCellBase):
+    """text.py:639: run a list of cells as one, threading the hidden
+    output of each into the next (vertical stacking)."""
+
+    def __init__(self, cells):
+        super().__init__()
+        self.cells = nn.LayerList(cells)
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else [None] * len(self.cells)
+        new_states = []
+        out = inputs
+        for cell, st in zip(self.cells, states):
+            out, ns = cell(out, st)
+            new_states.append(ns)
+        return out, new_states
+
+    @staticmethod
+    def stack_param_attr(param_attr, n):
+        return [param_attr] * n
+
+
+class StackedLSTMCell(StackedRNNCell):
+    """text.py:734: num_layers LSTM cells stacked (dropout between
+    layers applies at training time)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, dropout=0.0,
+                 param_attr=None, bias_attr=None, dtype="float32"):
+        cells = [nn.LSTMCell(input_size if i == 0 else hidden_size,
+                             hidden_size) for i in range(num_layers)]
+        super().__init__(cells)
+        self.dropout = dropout
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else [None] * len(self.cells)
+        new_states = []
+        out = inputs
+        for i, (cell, st) in enumerate(zip(self.cells, states)):
+            out, ns = cell(out, st)
+            if self.dropout and i < len(self.cells) - 1 and self.training:
+                out = F.dropout(out, p=self.dropout, training=True)
+            new_states.append(ns)
+        return out, new_states
+
+
+class StackedGRUCell(StackedLSTMCell):
+    """text.py:1337 — GRU flavour of the stack."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, dropout=0.0,
+                 param_attr=None, bias_attr=None, dtype="float32"):
+        cells = [nn.GRUCell(input_size if i == 0 else hidden_size,
+                            hidden_size) for i in range(num_layers)]
+        StackedRNNCell.__init__(self, cells)
+        self.dropout = dropout
+
+
+class BidirectionalRNN(nn.Layer):
+    """text.py:1006: forward + backward cells over the time axis, with
+    concat (default) merge. The scan runner compiles one direction per
+    basic cell, so stacking happens at the LAYER level (fwd+bwd per
+    depth, concat, feed the next depth) — the standard bi-RNN stacking,
+    and the one that maps onto lax.scan without a bespoke multi-state
+    carry."""
+
+    def __init__(self, cell_fw, cell_bw, merge_mode="concat"):
+        super().__init__()
+        self.rnn_fw = nn.RNN(cell_fw, is_reverse=False)
+        self.rnn_bw = nn.RNN(cell_bw, is_reverse=True)
+        if merge_mode != "concat":
+            raise NotImplementedError("merge_mode other than 'concat'")
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw, _ = self.rnn_fw(inputs, sequence_length=sequence_length)
+        bw, _ = self.rnn_bw(inputs, sequence_length=sequence_length)
+        from .. import ops
+
+        return ops.concat([fw, bw], axis=-1)
+
+
+class _StackedBiRNN(nn.Layer):
+    def __init__(self, cell_type, input_size, hidden_size, num_layers,
+                 dropout, merge_mode):
+        super().__init__()
+        self.layers = nn.LayerList([
+            BidirectionalRNN(
+                cell_type(input_size if i == 0 else 2 * hidden_size,
+                          hidden_size),
+                cell_type(input_size if i == 0 else 2 * hidden_size,
+                          hidden_size), merge_mode)
+            for i in range(num_layers)])
+        self.dropout = dropout
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        h = inputs
+        for i, bi in enumerate(self.layers):
+            h = bi(h, sequence_length=sequence_length)
+            if self.dropout and i < len(self.layers) - 1 and self.training:
+                h = F.dropout(h, p=self.dropout, training=True)
+        return h
+
+
+class BidirectionalLSTM(_StackedBiRNN):
+    """text.py:1144."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, dropout=0.0,
+                 merge_mode="concat", **kw):
+        super().__init__(nn.LSTMCell, input_size, hidden_size, num_layers,
+                         dropout, merge_mode)
+
+
+class BidirectionalGRU(_StackedBiRNN):
+    """text.py:1581."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, dropout=0.0,
+                 merge_mode="concat", **kw):
+        super().__init__(nn.GRUCell, input_size, hidden_size, num_layers,
+                         dropout, merge_mode)
+
+
+class DynamicDecode(nn.Layer):
+    """text.py:1762: Layer wrapper over nn.decode.dynamic_decode."""
+
+    def __init__(self, decoder, max_step_num=None, output_time_major=False,
+                 impute_finished=False, is_test=False, return_length=False):
+        super().__init__()
+        self.decoder = decoder
+        self.kw = dict(max_step_num=max_step_num,
+                       output_time_major=output_time_major,
+                       impute_finished=impute_finished, is_test=is_test,
+                       return_length=return_length)
+
+    def forward(self, inits=None, **kwargs):
+        return dynamic_decode(self.decoder, inits=inits, **self.kw,
+                              **kwargs)
+
+
+class Conv1dPoolLayer(nn.Layer):
+    """text.py:1980: conv over the time axis + max pool (TextCNN
+    branch)."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size=None, pool_stride=1, global_pooling=False,
+                 act=None, **kw):
+        super().__init__()
+        self.conv = nn.Conv1D(num_channels, num_filters, filter_size)
+        self.pool_size = pool_size
+        self.pool_stride = pool_stride
+        # TextCNN default: no explicit pool size -> max over the whole
+        # time axis (what makes different filter widths concatenable)
+        self.global_pooling = global_pooling or pool_size is None
+        self.act = act
+
+    def forward(self, x):
+        h = self.conv(x)
+        if self.act == "tanh":
+            from .. import ops
+
+            h = ops.tanh(h)
+        elif self.act == "relu":
+            h = F.relu(h)
+        if self.global_pooling:
+            h = F.max_pool1d(h, kernel_size=h.shape[-1])
+        elif self.pool_size:
+            h = F.max_pool1d(h, kernel_size=self.pool_size,
+                             stride=self.pool_stride)
+        return h
+
+
+class CNNEncoder(nn.Layer):
+    """text.py:2109: parallel Conv1dPoolLayers concatenated on the
+    channel axis (TextCNN encoder)."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size=None, pool_stride=1, act=None, **kw):
+        super().__init__()
+        n = len(filter_size) if isinstance(filter_size, (list, tuple)) \
+            else 1
+        sizes = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size]
+        chans = num_channels if isinstance(num_channels, (list, tuple)) \
+            else [num_channels] * n
+        filts = num_filters if isinstance(num_filters, (list, tuple)) \
+            else [num_filters] * n
+        self.branches = nn.LayerList([
+            Conv1dPoolLayer(c, f, k, pool_size=pool_size,
+                            pool_stride=pool_stride, act=act)
+            for c, f, k in zip(chans, filts, sizes)])
+
+    def forward(self, x):
+        from .. import ops
+
+        return ops.concat([b(x) for b in self.branches], axis=1)
+
+
+class FFN(nn.Layer):
+    """text.py:2900: transformer position-wise feed-forward."""
+
+    def __init__(self, d_inner_hid, d_model, dropout_rate=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(d_model, d_inner_hid)
+        self.fc2 = nn.Linear(d_inner_hid, d_model)
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x):
+        h = F.relu(self.fc1(x))
+        if self.dropout_rate and self.training:
+            h = F.dropout(h, p=self.dropout_rate, training=True)
+        return self.fc2(h)
+
+
+class TransformerCell(nn.Layer):
+    """text.py:2252: wraps a TransformerDecoder so one decoding step
+    looks like an RNN cell — states are the per-layer (k, v) caches."""
+
+    def __init__(self, decoder, embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.decoder = decoder
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def forward(self, inputs, states=None, enc_output=None,
+                trg_slf_attn_bias=None, trg_src_attn_bias=None,
+                memory=None):
+        mem = enc_output if enc_output is not None else memory
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        # grow the sequence one token at a time: states carry the
+        # decoded prefix (the dense+lengths translation of the
+        # reference's per-layer k/v caches — prefix re-encoding keeps
+        # the compiled shapes static per step)
+        from .. import ops
+
+        x = inputs if inputs.ndim == 3 else ops.unsqueeze(inputs, 1)
+        prefix = x if states is None else ops.concat([states, x], axis=1)
+        out = self.decoder(prefix, mem)
+        last = out[:, -1]
+        if self.output_fn is not None:
+            last = self.output_fn(last)
+        return last, prefix
+
+
+class TransformerBeamSearchDecoder(BeamSearchDecoder):
+    """text.py:2421: BeamSearchDecoder over a TransformerCell whose
+    state is the growing decoded prefix. Initialize with an EMPTY
+    prefix of shape (batch, 0, d_model) — the base class's
+    expand/merge/split then carry the extra (variable) time axis
+    through the beam reshape unchanged; the prefix grows by one step
+    per decode step inside the cell."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 var_dim_in_state=2):
+        super().__init__(cell, start_token, end_token, beam_size)
+        self.var_dim_in_state = var_dim_in_state
+
+    @staticmethod
+    def empty_prefix(batch, d_model, dtype=None):
+        """The (batch, 0, d_model) initial cell state."""
+        return jnp.zeros((batch, 0, d_model),
+                         dtype or jnp.float32)
+
+
+class CRFDecoding(nn.Layer):
+    """text.py:3655: viterbi decode layer over LinearChainCRF params."""
+
+    def __init__(self, param_attr, size=None, is_test=False, dtype="float32",
+                 crf=None):
+        super().__init__()
+        self.crf = crf
+
+    def forward(self, emissions, lengths=None):
+        if self.crf is None:
+            raise ValueError("CRFDecoding needs the trained "
+                             "LinearChainCRF layer (crf=...)")
+        return self.crf.decode(emissions, lengths)
+
+
+class SequenceTagging(nn.Layer):
+    """text.py:3832: the lexical-analysis BiGRU-CRF tagger (embedding
+    -> stacked BiGRU -> emission fc -> CRF loss / viterbi decode)."""
+
+    def __init__(self, vocab_size, num_labels, word_emb_dim=128,
+                 grnn_hidden_dim=128, emb_learning_rate=0.1,
+                 crf_learning_rate=0.1, bigru_num=2, init_bound=0.1):
+        super().__init__()
+        self.word_embedding = nn.Embedding(vocab_size, word_emb_dim)
+        self.bigrus = nn.LayerList([
+            BidirectionalGRU(word_emb_dim if i == 0 else
+                             2 * grnn_hidden_dim, grnn_hidden_dim)
+            for i in range(bigru_num)])
+        self.fc = nn.Linear(2 * grnn_hidden_dim, num_labels)
+        self.crf = nn.LinearChainCRF(num_labels)
+
+    def emissions(self, word, lengths=None):
+        h = self.word_embedding(word)
+        for bigru in self.bigrus:
+            h = bigru(h, sequence_length=lengths)
+        return self.fc(h)
+
+    def forward(self, word, target=None, lengths=None):
+        em = self.emissions(word, lengths)
+        if target is not None:
+            return self.crf(em, target, lengths)      # training loss
+        return self.crf.decode(em, lengths)           # viterbi path

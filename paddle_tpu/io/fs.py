@@ -23,6 +23,16 @@ class FSFileExistsError(Exception):
     pass
 
 
+class FSTimeOut(Exception):
+    """Shell filesystem command exceeded its deadline (reference
+    fleet/utils/fs.py FSTimeOut)."""
+
+
+class FSShellCmdAborted(ExecuteError):
+    """Shell filesystem command aborted (reference fleet/utils/fs.py
+    FSShellCmdAborted)."""
+
+
 class FSFileNotExistsError(Exception):
     pass
 

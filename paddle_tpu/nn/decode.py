@@ -100,11 +100,14 @@ class BeamSearchDecoder(Decoder):
 
     def _merge_batch_beams(self, x):
         v = jnp.asarray(x)
-        return v.reshape((-1,) + v.shape[2:])
+        # explicit sizes: -1 cannot be inferred when a later axis is 0
+        # (e.g. a transformer decoder's empty initial prefix)
+        return v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:])
 
     def _split_batch_beams(self, x):
         v = jnp.asarray(x)
-        return v.reshape((-1, self.beam_size) + v.shape[1:])
+        return v.reshape((v.shape[0] // self.beam_size, self.beam_size)
+                         + v.shape[1:])
 
     def _mask_probs(self, probs, finished):
         """Finished beams may only grow through end_token with score 0
@@ -217,7 +220,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     step = 0
     while step <= cap and not bool(jnp.all(finished_v)):
         out, next_states, next_inputs, next_finished = decoder.step(
-            jnp.asarray(step, jnp.int64), inputs, states, **kwargs)
+            jnp.asarray(step), inputs, states, **kwargs)
         next_finished_v = _unwrap(next_finished)
         if not decoder.tracks_own_finished:
             next_finished_v = next_finished_v | finished_v

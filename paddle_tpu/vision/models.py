@@ -185,6 +185,14 @@ class VGG(nn.Layer):
         return self.classifier(x)
 
 
+def vgg11(num_classes=1000, batch_norm=True, in_channels=3):
+    return VGG(11, num_classes, batch_norm, in_channels)
+
+
+def vgg13(num_classes=1000, batch_norm=True, in_channels=3):
+    return VGG(13, num_classes, batch_norm, in_channels)
+
+
 def vgg16(num_classes=1000, batch_norm=True, in_channels=3):
     return VGG(16, num_classes, batch_norm, in_channels)
 

@@ -59,6 +59,7 @@ def test_beam_search_decoder_follows_rigged_script():
     assert int(lengths[0, 0]) == 4          # 4 real tokens incl. end
 
 
+@pytest.mark.slow
 def test_beam_search_decoder_with_lstm_and_embedding():
     vocab, hidden, beam, batch = 11, 16, 4, 3
     np.random.seed(0)

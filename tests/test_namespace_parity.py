@@ -1,0 +1,186 @@
+"""distributed / incubate namespace parity (reference
+python/paddle/{distributed,incubate} __all__) + behaviour of the new
+fleet meta-optimizer classes and hapi text building blocks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu import incubate
+from paddle_tpu import nn
+
+
+def _np(x):
+    return np.asarray(x.value if hasattr(x, "value") else x)
+
+
+def test_distributed_surface():
+    for n in ("Fleet", "DistributedStrategy", "PaddleCloudRoleMaker",
+              "RoleMakerBase", "MetaOptimizerBase", "MetaOptimizerFactory",
+              "AMPOptimizer", "DGCOptimizer", "LambOptimizer",
+              "LarsOptimizer", "GraphExecutionOptimizer",
+              "AsyncMetaOptimizer", "AsyncGraphExecutionOptimizer",
+              "CollectiveRuntime", "ParameterServerRuntime", "UtilBase",
+              "LocalFS", "HDFSClient", "FSTimeOut", "FSShellCmdAborted",
+              "InMemoryDataset", "QueueDataset", "PipelineOptimizer",
+              "RecomputeOptimizer"):
+        assert hasattr(dist, n), n
+
+
+def test_meta_optimizer_factory_filters_by_strategy():
+    s = dist.DistributedStrategy()
+    s.dgc = True
+    lin = nn.Linear(2, 2)
+    base = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                     parameters=list(lin.parameters()))
+    valid = dist.MetaOptimizerFactory()._get_valid_meta_optimizers(base, s)
+    names = [type(m).__name__ for m in valid]
+    assert "DGCOptimizer" in names
+    assert "AMPOptimizer" not in names        # amp flag off
+    # DGC apply swaps Momentum for DGCMomentum
+    from paddle_tpu.optimizer.meta import DGCMomentum
+    dgc = next(m for m in valid if type(m).__name__ == "DGCOptimizer")
+    assert isinstance(dgc.apply(base), DGCMomentum)
+
+
+def test_lars_meta_optimizer_swaps():
+    s = dist.DistributedStrategy()
+    s.lars = True
+    lin = nn.Linear(2, 2)
+    base = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                     parameters=list(lin.parameters()))
+    m = dist.LarsOptimizer(base)
+    m.user_defined_strategy = s
+    assert m._can_apply()
+    from paddle_tpu.optimizer import LarsMomentum
+    assert isinstance(m.apply(base), LarsMomentum)
+
+
+def test_util_base_file_shard(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    files = [f"part-{i}" for i in range(5)]
+    assert dist.UtilBase().get_file_shard(files) == ["part-1", "part-3"]
+
+
+def test_incubate_stacked_and_bidirectional_cells():
+    cell = incubate.StackedLSTMCell(8, 16, num_layers=2)
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    out, states = cell(x)
+    assert tuple(out.shape) == (4, 16)
+    assert len(states) == 2
+    bi = incubate.BidirectionalGRU(8, 16)
+    seq = paddle.to_tensor(np.random.randn(4, 5, 8).astype(np.float32))
+    y = bi(seq)
+    assert tuple(y.shape) == (4, 5, 32)
+
+
+def test_incubate_cnn_encoder():
+    enc = incubate.CNNEncoder(num_channels=16, num_filters=8,
+                              filter_size=[2, 3], act="relu")
+    x = paddle.to_tensor(np.random.randn(2, 16, 12).astype(np.float32))
+    y = enc(x)
+    # two branches of 8 filters, globally max-pooled over time
+    assert _np(y).shape == (2, 16, 1)
+
+
+@pytest.mark.slow
+def test_incubate_sequence_tagging_trains():
+    rng = np.random.RandomState(0)
+    model = incubate.SequenceTagging(vocab_size=20, num_labels=4,
+                                     word_emb_dim=16, grnn_hidden_dim=16,
+                                     bigru_num=1)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=list(model.parameters()))
+    words = paddle.to_tensor(rng.randint(0, 20, (4, 6)))
+    tags = paddle.to_tensor(rng.randint(0, 4, (4, 6)))
+    lengths = paddle.to_tensor(np.asarray([6, 6, 4, 5]))
+    first = None
+    for _ in range(6):
+        loss = model(words, tags, lengths).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.value)
+    assert float(loss.value) < first
+    path = model(words, lengths=lengths)
+    assert _np(path).shape == (4, 6)
+
+
+@pytest.mark.slow
+def test_incubate_transformer_cell_greedy_decode():
+    d, heads, vocab = 16, 2, 7
+    emb = nn.Embedding(vocab, d)
+    dec_layer = nn.TransformerDecoderLayer(d, heads, 64)
+    decoder = nn.TransformerDecoder(dec_layer, 1)
+    proj = nn.Linear(d, vocab)
+    # the helper embeds sampled ids, so the cell must not re-embed
+    cell = incubate.TransformerCell(decoder, output_fn=proj)
+    memory = paddle.to_tensor(np.random.randn(2, 4, d).astype(np.float32))
+    helper = incubate.DynamicDecode(
+        nn.BasicDecoder(lambda i, s, **kw: cell(i, s, memory=memory),
+                        nn.GreedyEmbeddingHelper(
+                            emb,
+                            np.ones((2,), np.int64), 0)),
+        max_step_num=2)
+    outputs, _ = helper(inits=None)
+    ids = _np(outputs.sample_ids)
+    assert ids.shape[0] == 2 and ids.shape[1] <= 3
+
+
+@pytest.mark.slow
+def test_transformer_beam_search_decoder_runs():
+    from paddle_tpu.nn.decode import dynamic_decode
+
+    d, heads, vocab, batch, beam = 16, 2, 7, 2, 3
+    emb = nn.Embedding(vocab, d)
+    decoder = nn.TransformerDecoder(
+        nn.TransformerDecoderLayer(d, heads, 32), 1)
+    proj = nn.Linear(d, vocab)
+    memory = paddle.to_tensor(
+        np.random.randn(batch * beam, 4, d).astype(np.float32))
+    cell = incubate.TransformerCell(decoder, embedding_fn=emb)
+    bsd = incubate.TransformerBeamSearchDecoder(
+        lambda i, s, **kw: cell(i, s, memory=memory),
+        start_token=1, end_token=0, beam_size=beam)
+    bsd.output_fn = proj
+    prefix0 = incubate.TransformerBeamSearchDecoder.empty_prefix(batch, d)
+    outputs, _ = dynamic_decode(bsd, inits=prefix0, max_step_num=2)
+    ids = _np(outputs)
+    assert ids.shape[0] == batch and ids.shape[2] == beam
+
+
+def test_basic_lstm_cell_forget_bias_applied():
+    cell = incubate.BasicLSTMCell(4, 8, forget_bias=3.0)
+    plain = nn.LSTMCell(4, 8)
+    b = _np(cell.bias_ih)
+    # the forget-gate quarter got the offset; magnitude check vs the
+    # plain cell's init scale
+    assert b[8:16].mean() > _np(plain.bias_ih)[8:16].mean() + 2.0
+
+
+def test_progress_bar_and_weights_utils(tmp_path, capsys):
+    bar = incubate.ProgressBar(num=4)
+    bar.start()
+    bar.update(2, values=[("loss", 0.5)])
+    bar.update(4, values=[("loss", 0.25)])
+    out = capsys.readouterr().out
+    assert "4/4" in out and "loss" in out
+    # uncombined weights -> state dict
+    np.save(tmp_path / "w0.npy", np.ones(3))
+    state = incubate.uncombined_weight_to_state_dict(str(tmp_path))
+    assert "w0.npy" in state
+    # offline download raises with the cache path in the message
+    with pytest.raises(RuntimeError, match="place"):
+        incubate.get_weights_path_from_url(
+            "http://127.0.0.1:9/definitely-not-served/w.pdparams")
+
+
+@pytest.mark.slow
+def test_vgg_variants():
+    from paddle_tpu.vision.models import vgg11, vgg13
+
+    m = vgg11(num_classes=10)
+    x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    assert tuple(m(x).shape) == (1, 10)
+    assert callable(vgg13)
