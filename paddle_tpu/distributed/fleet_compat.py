@@ -70,11 +70,14 @@ class AMPOptimizer(MetaOptimizerBase):
         # strategy.amp: a GradScaler-managed optimizer (fleet.py
         # _FleetOptimizer), so the class surface and the strategy
         # surface behave identically
+        import copy
+
         from .fleet import DistributedStrategy, _FleetOptimizer
 
-        s = self.user_defined_strategy or DistributedStrategy()
-        if not s.amp:
-            s.amp = True
+        s = copy.deepcopy(self.user_defined_strategy) \
+            if self.user_defined_strategy is not None \
+            else DistributedStrategy()
+        s.amp = True                  # never mutate the caller's strategy
         return _FleetOptimizer(optimizer, s, None)
 
 

@@ -133,7 +133,6 @@ def _register_hapi_surface():
 
     from .. import hapi as _hapi
     from .. import text as _text
-    from ..io import dataloader as _dl  # noqa: F401
     from ..vision import datasets as _vd
     from ..vision import models as _vm
     from ..vision import transforms as _vt

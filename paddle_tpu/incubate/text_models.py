@@ -132,8 +132,13 @@ class BidirectionalRNN(nn.Layer):
             raise NotImplementedError("merge_mode other than 'concat'")
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
-        fw, _ = self.rnn_fw(inputs, sequence_length=sequence_length)
-        bw, _ = self.rnn_bw(inputs, sequence_length=sequence_length)
+        init_fw = init_bw = None
+        if initial_states is not None:
+            init_fw, init_bw = initial_states
+        fw, _ = self.rnn_fw(inputs, initial_states=init_fw,
+                            sequence_length=sequence_length)
+        bw, _ = self.rnn_bw(inputs, initial_states=init_bw,
+                            sequence_length=sequence_length)
         from .. import ops
 
         return ops.concat([fw, bw], axis=-1)

@@ -14,3 +14,77 @@ from . import fs  # noqa: F401
 from . import crypto  # noqa: F401
 from .fs import FS, LocalFS, HDFSClient  # noqa: F401
 from .crypto import AESCipher, gen_key, gen_key_to_file  # noqa: F401
+
+# reader decorators the reference publishes under paddle.io/paddle.reader
+from ..reader import (  # noqa: F401,E402
+    buffered, cache, chain, compose, firstn, map_readers, shuffle,
+    xmap_readers,
+)
+
+
+def load_program_state(model_path, var_list=None):
+    """reference paddle/io io.py load_program_state: read a saved
+    persistables snapshot into a plain {name: ndarray} dict without
+    touching any scope."""
+    import os
+    import pickle
+
+    import numpy as np
+
+    state = {}
+    if os.path.isdir(model_path):
+        for fname in sorted(os.listdir(model_path)):
+            p = os.path.join(model_path, fname)
+            if not os.path.isfile(p):
+                continue
+            try:
+                state[fname] = np.load(p, allow_pickle=False)
+                continue
+            except (ValueError, OSError):
+                pass
+            try:
+                with open(p, "rb") as f:
+                    blob = pickle.load(f)
+            except Exception:
+                continue
+            if isinstance(blob, dict):
+                # combined snapshot (save_persistables params.pdparams)
+                state.update({k: np.asarray(v) for k, v in blob.items()})
+            else:
+                state[fname] = np.asarray(blob)
+    else:
+        with open(model_path, "rb") as f:
+            blob = pickle.load(f)
+        if isinstance(blob, dict):
+            state = {k: np.asarray(v) for k, v in blob.items()}
+        else:
+            import os as _os
+
+            state = {_os.path.basename(model_path): np.asarray(blob)}
+    if var_list is not None:
+        keep = {getattr(v, "name", str(v)) for v in var_list}
+        state = {k: v for k, v in state.items() if k in keep}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """reference set_program_state: write a {name: ndarray} dict into
+    the program's persistable variables in the global scope."""
+    import numpy as np
+
+    from ..static.executor import global_scope
+
+    scope = global_scope()
+    prog_names = set(program.global_block.vars) if program is not None \
+        else None
+    missing = []
+    for name, value in state_dict.items():
+        if prog_names is not None and name not in prog_names:
+            missing.append(name)
+            continue
+        scope.set(name, np.asarray(value))
+    if missing:
+        import warnings
+
+        warnings.warn(f"set_program_state: variables not in scope: "
+                      f"{missing}")

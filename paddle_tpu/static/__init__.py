@@ -47,6 +47,8 @@ from .rnn_builder import DynamicRNN, StaticRNN  # noqa: F401
 from .legacy_flow import IfElse, Switch, While  # noqa: F401
 from .py_reader import (PyReader, create_py_reader_by_data,  # noqa: F401
                         double_buffer, py_reader, read_file)
+from .layers import (ParallelExecutor, WeightNormParamAttr,  # noqa: F401
+                     gradients, name_scope)
 from .checker import (check_program, compare_op_signatures,  # noqa: F401
                       validate_program, ProgramValidationError)
 from .optimizer import (SGD, Adam, AdamOptimizer, Lamb,  # noqa: F401

@@ -1883,3 +1883,107 @@ _layers_mod._register_exports({
     "LoDRankTable": LoDRankTable, "lod_rank_table": lod_rank_table,
     "reorder_lod_tensor_by_rank": reorder_lod_tensor_by_rank,
 })
+
+
+# ---------------------------------------------------------------------------
+# paddle.static stragglers: gradients, name_scope, ParallelExecutor,
+# WeightNormParamAttr
+# ---------------------------------------------------------------------------
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients (reference static/__init__ ->
+    backward.gradients): d targets / d inputs inside the current
+    program — the calc_gradient surface under its 2.0 name.
+    Inputs named in no_grad_set get None in the result (the
+    reference's stop-gradient contract), the rest flow through
+    calc_gradient."""
+    from .backward import calc_gradient
+
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    ng = {getattr(v, "name", str(v)) for v in (no_grad_set or ())}
+    live = [v for v in inputs if v.name not in ng]
+    grads = calc_gradient(targets, live, target_gradients)
+    if not isinstance(grads, (list, tuple)):
+        grads = [grads]
+    it = iter(grads)
+    return [None if v.name in ng else next(it) for v in inputs]
+
+
+class _NameScope:
+    def __init__(self, prefix):
+        self.prefix = prefix
+
+    def __enter__(self):
+        from ..utils import unique_name
+
+        unique_name._prefix_stack.append(self.prefix + "/")
+        return self
+
+    def __exit__(self, *exc):
+        from ..utils import unique_name
+
+        unique_name._prefix_stack.pop()
+        return False
+
+
+def name_scope(prefix=None):
+    """paddle.static.name_scope: nest generated op/var names under a
+    prefix (reference framework.py name_scope)."""
+    return _NameScope(prefix or "scope")
+
+
+class ParallelExecutor:
+    """fluid.ParallelExecutor facade (reference parallel_executor.py):
+    the multi-device engine behind CompiledProgram.with_data_parallel —
+    here one sharded jit (static/compiler.py), so this class pairs a
+    CompiledProgram with an Executor and keeps the old run() shape."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .compiler import CompiledProgram
+        from .executor import Executor, global_scope
+        from .ir import default_main_program
+
+        prog = main_program or default_main_program()
+        self._compiled = CompiledProgram(prog).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy)
+        self._exe = Executor()
+        self._scope = scope or global_scope()
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._compiled, feed=feed or feed_dict,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+
+from ..nn.layer import ParamAttr as _ParamAttr  # noqa: E402
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """fluid.WeightNormParamAttr (reference param_attr.py:197): marks a
+    parameter for g * v/||v|| reparametrization along ``dim``. Dygraph
+    layers apply it through nn.weight_norm; static fc consumers read
+    the ``dim`` attribute."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         do_model_average=do_model_average,
+                         need_clip=need_clip)
+        self.dim = dim
+
+
+_layers_mod._register_exports({
+    "gradients": gradients, "name_scope": name_scope,
+    "ParallelExecutor": ParallelExecutor,
+    "WeightNormParamAttr": WeightNormParamAttr,
+})

@@ -1,3 +1,151 @@
 from . import unique_name  # noqa: F401
 from .env import summary_env  # noqa: F401
 from ..install_check import run_check  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason=""):
+    """paddle.utils.deprecated decorator (reference utils/deprecated.py):
+    warn once per call site, keep the docstring annotated."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            hint = f" Use {update_to} instead." if update_to else ""
+            warnings.warn(
+                f"API {fn.__module__}.{fn.__name__} is deprecated since "
+                f"{since or 'this release'}: {reason}.{hint}",
+                DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__doc__ = ((fn.__doc__ or "") +
+                           f"\n\n    .. deprecated:: {since or ''}\n")
+        return wrapper
+
+    return deco
+
+
+class ProfilerOptions:
+    """reference utils/profiler.py ProfilerOptions: option bag for the
+    profiler facade."""
+
+    def __init__(self, options=None):
+        self.options = {
+            "state": "All", "sorted_key": "total",
+            "tracer_level": "Default", "batch_range": [0, 10],
+            "output_thread_detail": False, "profile_path": "",
+            "timeline_path": "", "op_summary_path": "",
+        }
+        if options is not None:
+            self.options.update(options)
+
+    def __getitem__(self, name):
+        return self.options[name]
+
+
+class Profiler:
+    """reference utils/profiler.py Profiler: start/stop facade over the
+    framework profiler (profiler.py RecordEvent/jax traces)."""
+
+    def __init__(self, enabled=True, options=None):
+        self.enabled = enabled
+        self.profiler_options = ProfilerOptions(options)
+        self._running = False
+
+    def start(self):
+        if self.enabled and not self._running:
+            from ..profiler import start_profiler
+
+            start_profiler(self.profiler_options["state"])
+            self._running = True
+
+    def stop(self):
+        if self._running:
+            from ..profiler import stop_profiler
+
+            stop_profiler(self.profiler_options["sorted_key"])
+            self._running = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def record_step(self, change_profiler_status=True):
+        pass
+
+
+_profiler_singleton = None
+
+
+def get_profiler(options=None):
+    """reference utils/profiler.py get_profiler: process-wide singleton."""
+    global _profiler_singleton
+    if _profiler_singleton is None:
+        _profiler_singleton = Profiler(options=options)
+    return _profiler_singleton
+
+
+def dump_config(config=None, path=None):
+    """Dump the active FLAGS / config tiers to text (reference
+    utils/dump_config semantics: make the run's knobs inspectable)."""
+    from ..framework import flags as _flags
+
+    lines = [f"{k} = {v}" for k, v in sorted(_flags._registry.items())]
+    if config is not None:
+        lines += [f"{k} = {v}" for k, v in sorted(
+            getattr(config, "__dict__", {}).items())]
+    text = "\n".join(lines) + "\n"
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+class Ploter:
+    """reference utils/plot.py Ploter: records (step, value) series for
+    training curves; renders with matplotlib when available, always
+    dumps CSV."""
+
+    def __init__(self, *titles):
+        self.titles = list(titles)
+        self.data = {t: ([], []) for t in titles}
+
+    def append(self, title, step, value):
+        xs, ys = self.data[title]
+        xs.append(step)
+        ys.append(float(value))
+
+    def plot(self, path=None):
+        if path and path.endswith(".csv") or path is None:
+            out = []
+            for t in self.titles:
+                xs, ys = self.data[t]
+                out += [f"{t},{x},{y}" for x, y in zip(xs, ys)]
+            text = "\n".join(out) + "\n"
+            if path:
+                with open(path, "w") as f:
+                    f.write(text)
+            return text
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            for t in self.titles:
+                xs, ys = self.data[t]
+                plt.plot(xs, ys, label=t)
+            plt.legend()
+            plt.savefig(path)
+            plt.close()
+        except ImportError:
+            self.plot(path=(path or "plot") + ".csv")
+
+    def reset(self):
+        for t in self.titles:
+            self.data[t] = ([], [])

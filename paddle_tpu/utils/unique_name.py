@@ -11,8 +11,14 @@ import contextlib
 from ..nn import layer as _layer_mod
 
 
+_prefix_stack: list = []
+
+
 def generate(key: str) -> str:
-    return _layer_mod._unique_name(key)
+    name = _layer_mod._unique_name(key)
+    if _prefix_stack:
+        return "".join(_prefix_stack) + name
+    return name
 
 
 def switch(new_counters=None):

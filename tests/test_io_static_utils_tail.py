@@ -1,0 +1,121 @@
+"""Round-3 final namespace stragglers: paddle.io reader decorators +
+program-state utils, paddle.static gradients/name_scope/
+ParallelExecutor/WeightNormParamAttr, paddle.utils
+Ploter/Profiler/deprecated/dump_config."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.io as pio
+import paddle_tpu.static as static
+import paddle_tpu.utils as putils
+
+L = static.layers
+
+
+def test_io_reader_decorators_exposed():
+    for n in ("buffered", "cache", "chain", "compose", "firstn",
+              "map_readers", "shuffle", "xmap_readers"):
+        assert callable(getattr(pio, n)), n
+    r = pio.firstn(lambda: iter(range(10)), 3)
+    assert list(r()) == [0, 1, 2]
+
+
+def test_program_state_round_trip(tmp_path):
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        x = L.data(name="ps_x", shape=[2, 4], dtype="float32")
+        L.fc(x, size=3)
+    exe = static.Executor()
+    exe.run(startup)
+    static.save_persistables(exe, str(tmp_path), prog)
+    state = pio.load_program_state(str(tmp_path))
+    assert state and all(isinstance(v, np.ndarray) for v in state.values())
+    k = next(iter(state))
+    state[k] = np.zeros_like(state[k])
+    pio.set_program_state(prog, state)
+    from paddle_tpu.static.executor import global_scope
+
+    np.testing.assert_allclose(np.asarray(global_scope().find_var(k)), 0.0)
+
+
+def test_static_gradients():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = L.data(name="g_x", shape=[2, 3], dtype="float32")
+        y = L.reduce_sum(L.elementwise_mul(x, x))
+        (dx,) = static.gradients([y], [x])
+        exe = static.Executor()
+        xv = np.ones((2, 3), np.float32) * 2
+        (out,) = exe.run(prog, feed={"g_x": xv}, fetch_list=[dx])
+    np.testing.assert_allclose(np.asarray(out), 2 * xv)
+
+
+def test_static_name_scope_nested():
+    prog = static.Program()
+    with static.program_guard(prog):
+        with static.name_scope("enc"):
+            a = L.fill_constant([1], "float32", 1.0)
+            with static.name_scope("attn"):
+                b = L.fill_constant([1], "float32", 1.0)
+        c = L.fill_constant([1], "float32", 1.0)
+    assert a.name.startswith("enc/")
+    assert b.name.startswith("enc/attn/")
+    assert not c.name.startswith("enc")
+
+
+def test_parallel_executor_facade():
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        x = L.data(name="pe_x", shape=[8, 3], dtype="float32")
+        loss = L.reduce_mean(L.fc(x, size=2))
+    exe = static.Executor()
+    exe.run(startup)
+    pe = static.ParallelExecutor(loss_name=loss.name, main_program=prog)
+    (out,) = pe.run(fetch_list=[loss],
+                    feed={"pe_x": np.ones((8, 3), np.float32)})
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_weight_norm_param_attr_fields():
+    a = static.WeightNormParamAttr(dim=0, name="w")
+    assert a.dim == 0 and a.name == "w" and a.trainable
+
+
+def test_utils_deprecated_warns_once_per_call():
+    @putils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old_api(v):
+        return v + 1
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert old_api(1) == 2
+    assert any("deprecated" in str(w.message) for w in rec)
+    assert "deprecated" in (old_api.__doc__ or "")
+
+
+def test_utils_profiler_and_dump_config(tmp_path):
+    p = putils.get_profiler()
+    assert p is putils.get_profiler()          # singleton
+    with putils.Profiler(enabled=False):
+        pass
+    text = putils.dump_config()
+    assert "=" in text
+    out = tmp_path / "cfg.txt"
+    putils.dump_config(path=str(out))
+    assert out.read_text()
+
+
+def test_utils_ploter(tmp_path):
+    pl = putils.Ploter("train", "test")
+    pl.append("train", 0, 1.0)
+    pl.append("train", 1, 0.5)
+    pl.append("test", 0, 1.2)
+    csv = pl.plot()
+    assert "train,0,1.0" in csv and "test,0,1.2" in csv
+    pl.reset()
+    assert pl.plot().strip() == ""
