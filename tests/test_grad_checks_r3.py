@@ -1,0 +1,163 @@
+"""Numeric gradient checks (the reference OpTest check_grad fixture,
+SURVEY §4.1) for the round-3 differentiable ops: prroi_pool (exact
+coordinate gradients are the op's defining property —
+arXiv:1807.11590), deformable_roi_pooling (offset gradients),
+bilinear_tensor_product, hsigmoid, row_conv, roi_perspective_transform.
+Central differences vs jax.grad in f64-safe f32 with loose-but-real
+tolerances."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+
+# full-tensor central differences are deliberate and slow — slow tier
+pytestmark = pytest.mark.slow
+
+
+def _numeric_grad(f, x, delta=1e-3):
+    x = np.asarray(x, np.float32)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = float(f(jnp.asarray(x)))
+        flat[i] = orig - delta
+        fm = float(f(jnp.asarray(x)))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * delta)
+    return g
+
+
+def _check(f, x, rtol=0.05, atol=5e-3, delta=1e-3):
+    analytic = np.asarray(jax.grad(lambda v: f(v))(jnp.asarray(
+        np.asarray(x, np.float32))))
+    numeric = _numeric_grad(f, x, delta)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+def test_prroi_pool_grad_wrt_input_and_rois():
+    from paddle_tpu.vision.ops import prroi_pool
+
+    rng = np.random.RandomState(0)
+    img = rng.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.asarray([[0.7, 1.2, 4.3, 4.9]], np.float32)
+
+    def loss_img(x):
+        out = prroi_pool(x, rois, 1.0, 2, 2)
+        return (out.value ** 2).sum()
+
+    _check(loss_img, img)
+
+    # the PrRoI selling point: exact gradients wrt the roi COORDINATES
+    def loss_rois(r):
+        out = prroi_pool(img, r, 1.0, 2, 2)
+        return (out.value ** 2).sum()
+
+    _check(loss_rois, rois, rtol=0.08, atol=2e-2, delta=5e-3)
+
+
+def test_deformable_roi_pooling_grad_wrt_offsets():
+    from paddle_tpu.vision.ops import deformable_roi_pooling
+
+    rng = np.random.RandomState(1)
+    img = rng.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.asarray([[1, 1, 6, 6]], np.float32)
+    trans = rng.randn(1, 2, 2, 2).astype(np.float32) * 0.1
+
+    def loss(t):
+        out = deformable_roi_pooling(
+            img, rois, t, pooled_height=2, pooled_width=2,
+            sample_per_part=2, trans_std=0.1)
+        return (out.value ** 2).sum()
+
+    # bilinear sampling is piecewise-smooth; keep the step small and
+    # tolerate kinks at cell boundaries via atol
+    _check(loss, trans, rtol=0.08, atol=3e-2, delta=2e-3)
+
+
+def test_bilinear_tensor_product_grad():
+    from paddle_tpu.nn.compat20 import bilinear
+
+    rng = np.random.RandomState(2)
+    x1 = rng.randn(3, 4).astype(np.float32)
+    x2 = rng.randn(3, 5).astype(np.float32)
+    w = rng.randn(2, 4, 5).astype(np.float32)
+
+    def loss(wv):
+        return (bilinear.raw_fn(jnp.asarray(x1), jnp.asarray(x2),
+                                wv, None) ** 2).sum()
+
+    _check(loss, w)
+
+
+def test_hsigmoid_grad():
+    from paddle_tpu.nn.compat20 import hsigmoid
+
+    rng = np.random.RandomState(3)
+    num_classes, dim, b = 6, 8, 4
+    x = rng.randn(b, dim).astype(np.float32)
+    w = rng.randn(num_classes - 1, dim).astype(np.float32)
+    bias = rng.randn(num_classes - 1).astype(np.float32)
+    label = rng.randint(0, num_classes, b)
+
+    def loss_x(xv):
+        return hsigmoid.raw_fn(xv, jnp.asarray(w), jnp.asarray(bias),
+                               label, num_classes).sum()
+
+    _check(loss_x, x)
+
+    def loss_w(wv):
+        return hsigmoid.raw_fn(jnp.asarray(x), wv, jnp.asarray(bias),
+                               label, num_classes).sum()
+
+    _check(loss_w, w)
+
+
+def test_row_conv_grad():
+    from paddle_tpu.nn.compat20 import _row_conv_fn
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(3, 3).astype(np.float32)
+
+    def loss(wv):
+        return (_row_conv_fn.raw_fn(jnp.asarray(x), wv) ** 2).sum()
+
+    _check(loss, w)
+
+
+def test_roi_perspective_transform_grad_wrt_input():
+    from paddle_tpu.vision.ops import roi_perspective_transform
+
+    rng = np.random.RandomState(5)
+    img = rng.randn(1, 1, 8, 8).astype(np.float32)
+    rois = np.asarray([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32)
+
+    def loss(x):
+        out, _, _ = roi_perspective_transform(
+            x, rois, transformed_height=4, transformed_width=4)
+        return (out.value ** 2).sum()
+
+    _check(loss, img, rtol=0.08, atol=2e-2)
+
+
+def test_fused_embedding_bag_grad_matches_xla_path():
+    from paddle_tpu.ops.pallas.fused_embedding import _bag_core, _xla_bag
+
+    rng = np.random.RandomState(6)
+    table = rng.randn(64, 128).astype(np.float32)
+    ids = rng.randint(-1, 64, (8, 12)).astype(np.int32)
+
+    def loss_custom(t):
+        return (_bag_core(t, jnp.asarray(ids), "mean") ** 2).sum()
+
+    def loss_ref(t):
+        return (_xla_bag(t, jnp.asarray(ids), "mean") ** 2).sum()
+
+    g1 = np.asarray(jax.grad(loss_custom)(jnp.asarray(table)))
+    g2 = np.asarray(jax.grad(loss_ref)(jnp.asarray(table)))
+    np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-5)
