@@ -95,35 +95,11 @@ def get_weights_path_from_url(url, md5sum=None):
 def uncombined_weight_to_state_dict(weight_dir):
     """hapi/model.py helper: fold a directory of per-variable files
     (the save_persistables one-file-per-var layout) into one state
-    dict."""
-    import os
-    import pickle
+    dict. Delegates to io.load_program_state — one snapshot-reading
+    implementation to keep in sync."""
+    from ..io import load_program_state
 
-    import numpy as np
-
-    state = {}
-    skipped = []
-    for fname in sorted(os.listdir(weight_dir)):
-        fpath = os.path.join(weight_dir, fname)
-        if not os.path.isfile(fpath):
-            continue
-        try:
-            state[fname] = np.load(fpath, allow_pickle=False)
-            continue
-        except (ValueError, OSError):
-            pass
-        try:
-            with open(fpath, "rb") as f:
-                state[fname] = np.asarray(pickle.load(f))
-        except Exception:           # unreadable format: report, not abort
-            skipped.append(fname)
-    if skipped:
-        import warnings
-
-        warnings.warn(
-            f"uncombined_weight_to_state_dict: skipped unreadable "
-            f"files {skipped} (neither .npy nor pickle)")
-    return state
+    return load_program_state(weight_dir)
 
 
 def _register_hapi_surface():

@@ -186,18 +186,14 @@ class Switch:
             # are re-emitted inside it from the chain's current source
             t_names = {}
             with _BlockGuard(prog, sb):
-                for name in written:
-                    if name in w:
-                        t_names[name] = name
-                    else:
-                        t_names[name] = _source_value(
-                            prog, parent, current[name], name).name
+                t_names = _materialize_sources(
+                    prog, parent, current,
+                    [n for n in written if n not in w])
+                t_names.update({n: n for n in written if n in w})
             fb = prog.create_block()
-            f_names = {}
             with _BlockGuard(prog, fb):
-                for name in written:
-                    f_names[name] = _source_value(
-                        prog, parent, current[name], name).name
+                f_names = _materialize_sources(prog, parent, current,
+                                               written)
             out_names = [unique_name.generate("switch.out")
                          for _ in written]
             parent.append_op(
@@ -242,6 +238,25 @@ def _source_value(prog, parent, source, name):
     if isinstance(source, tuple):
         return _reemit_block(prog, source[1], name)
     return assign(parent.var(source))
+
+
+def _materialize_sources(prog, parent, current, names):
+    """Materialize several chain sources inside the current block,
+    re-emitting each distinct source BLOCK only once (a default block
+    writing K variables must not have its op list duplicated K times)."""
+    out = {}
+    emitted_blocks = set()
+    for name in names:
+        src = current[name]
+        if isinstance(src, tuple):
+            if src[1] in emitted_blocks:
+                out[name] = prog.current_block().var(name).name
+            else:
+                out[name] = _reemit_block(prog, src[1], name).name
+                emitted_blocks.add(src[1])
+        else:
+            out[name] = assign(parent.var(src)).name
+    return out
 
 
 class IfElse:

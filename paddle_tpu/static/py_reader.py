@@ -45,15 +45,24 @@ class PyReader:
 
     # -- decoration (reference reader.py GeneratorLoader surface) -----
 
-    def decorate_paddle_reader(self, reader, places=None):
-        """reader() yields per-SAMPLE tuples; batching left to the
-        decorated reader (paddle.batch), matching the reference."""
-        self._gen_fn = reader
+    def decorate_sample_list_generator(self, reader, places=None):
+        """reader() yields a LIST of per-sample tuples per batch (the
+        paddle.batch output format); each batch is stacked into one
+        array per declared slot (reference GeneratorLoader
+        set_sample_list_generator)."""
+        def stacked():
+            for sample_list in reader():
+                yield tuple(np.stack([np.asarray(s[i]) for s in
+                                      sample_list])
+                            for i in range(len(sample_list[0])))
+
+        self._gen_fn = stacked
         return self
 
-    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_paddle_reader = decorate_sample_list_generator
 
     def decorate_batch_generator(self, reader, places=None):
+        """reader() yields pre-batched per-slot arrays."""
         self._gen_fn = reader
         return self
 

@@ -225,3 +225,26 @@ def test_double_buffer_identity_and_by_data():
         reader = L.create_py_reader_by_data(4, [x])
         assert L.double_buffer(reader) is reader
         assert L.read_file(reader) is x
+
+
+def test_py_reader_sample_list_generator():
+    """paddle.batch format: a LIST of per-sample tuples per batch gets
+    stacked into per-slot arrays (decorate_sample_list_generator)."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        reader = L.py_reader(capacity=2, shapes=[[-1, 2], [-1, 1]],
+                             dtypes=["float32", "int64"])
+        x, y = L.read_file(reader)
+        out = L.elementwise_add(x, L.cast(y, "float32"))
+
+    def batches():
+        yield [(np.ones(2, np.float32), np.asarray([1]))
+               for _ in range(4)]
+
+    reader.decorate_sample_list_generator(batches)
+    reader.start()
+    exe = static.Executor()
+    (o,) = exe.run(prog, fetch_list=[out])
+    assert np.asarray(o).shape == (4, 2)
+    np.testing.assert_allclose(np.asarray(o), 2.0)
+    reader.reset()

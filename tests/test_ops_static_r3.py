@@ -1,0 +1,281 @@
+"""Round-3 OpTest batch: declarative output + numeric-grad checks for
+static kernels that previously only had layer-level tests (losses,
+activations, misc vision math). Reference fixture: unittests/op_test.py
+— numpy forward reference + finite-difference grad parity."""
+import numpy as np
+import pytest
+
+from op_test import OpTestCase
+
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+class TestHuberLoss(OpTestCase):
+    op_type = "huber_loss_s"
+    x = rng.randn(6, 3).astype(np.float32)
+    y = rng.randn(6, 3).astype(np.float32)
+    inputs = {"X": x, "Label": y}
+    attrs = {"delta": 1.0}
+    d = x - y
+    outputs = {"Out": np.where(np.abs(d) <= 1.0, 0.5 * d * d,
+                               np.abs(d) - 0.5).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestMseLoss(OpTestCase):
+    op_type = "mse_loss_s"
+    x = rng.randn(5, 4).astype(np.float32)
+    y = rng.randn(5, 4).astype(np.float32)
+    inputs = {"X": x, "Label": y}
+    outputs = {"Out": np.asarray(((x - y) ** 2).mean(), np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestLogLoss(OpTestCase):
+    op_type = "log_loss_s"
+    p = rng.uniform(0.1, 0.9, (8, 1)).astype(np.float32)
+    label = rng.randint(0, 2, (8, 1)).astype(np.float32)
+    inputs = {"Predicted": p, "Labels": label}
+    attrs = {"epsilon": 1e-4}
+    outputs = {"Out": (-label * np.log(p + 1e-4) -
+                       (1 - label) * np.log(1 - p + 1e-4)
+                       ).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["Predicted"])
+
+
+class TestMarginRankLoss(OpTestCase):
+    op_type = "margin_rank_loss_s"
+    x1 = rng.randn(7, 1).astype(np.float32)
+    x2 = rng.randn(7, 1).astype(np.float32)
+    label = np.where(rng.rand(7, 1) > 0.5, 1.0, -1.0).astype(np.float32)
+    inputs = {"Label": label, "Left": x1, "Right": x2}
+    attrs = {"margin": 0.1}
+    outputs = {"Out": np.maximum(0, -label * (x1 - x2) + 0.1
+                                 ).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+class TestLabelSmooth(OpTestCase):
+    op_type = "label_smooth_s"
+    onehot = np.eye(5, dtype=np.float32)[rng.randint(0, 5, 6)]
+    inputs = {"X": onehot}
+    attrs = {"epsilon": 0.1}
+    outputs = {"Out": ((1 - 0.1) * onehot + 0.1 / 5).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-6)
+
+
+class TestDiceLoss(OpTestCase):
+    op_type = "dice_loss_s"
+    # input (N, C) probabilities; label (N, 1) int class id
+    p = rng.uniform(0.1, 0.9, (10, 3)).astype(np.float32)
+    p = p / p.sum(1, keepdims=True)
+    label = rng.randint(0, 3, (10, 1)).astype(np.int64)
+
+    inputs = {"X": p, "Label": label}
+    attrs = {"epsilon": 1e-5}
+    _oh = np.eye(3, dtype=np.float32)[label[:, 0]]
+    inter = (p * _oh).sum(1)
+    union = p.sum(1) + _oh.sum(1)
+    dice = (2 * inter + 1e-5) / (union + 1e-5)
+    outputs = {"Out": np.asarray((1 - dice).mean(), np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+class TestElu(OpTestCase):
+    op_type = "elu_s"
+    x = rng.randn(4, 6).astype(np.float32)
+    inputs = {"X": x}
+    attrs = {"alpha": 1.2}
+    outputs = {"Out": np.where(x > 0, x, 1.2 * (np.exp(x) - 1)
+                               ).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestBRelu(OpTestCase):
+    op_type = "brelu_s"
+    x = (rng.randn(4, 6) * 10).astype(np.float32)
+    inputs = {"X": x}
+    attrs = {"t_min": 1.0, "t_max": 8.0}
+    outputs = {"Out": np.clip(x, 1.0, 8.0)}
+
+    def test(self):
+        self.check_output(atol=1e-6)
+
+
+class TestHardSigmoid(OpTestCase):
+    op_type = "hard_sigmoid_s"
+    x = (rng.randn(5, 5) * 3).astype(np.float32)
+    inputs = {"X": x}
+    attrs = {"slope": 0.2, "offset": 0.5}
+    outputs = {"Out": np.clip(0.2 * x + 0.5, 0, 1).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-6)
+
+
+class TestMish(OpTestCase):
+    op_type = "mish_s"
+    x = rng.randn(4, 4).astype(np.float32)
+    inputs = {"X": x}
+    outputs = {"Out": (x * np.tanh(np.log1p(np.exp(x)))
+                       ).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestMaxout(OpTestCase):
+    op_type = "maxout_s"
+    x = rng.randn(2, 6, 3, 3).astype(np.float32)   # C=6, groups=2 -> 3
+    inputs = {"X": x}
+    attrs = {"groups": 2}
+    outputs = {"Out": x.reshape(2, 3, 2, 3, 3).max(axis=2)}
+
+    def test(self):
+        self.check_output(atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# misc math / vision
+# ---------------------------------------------------------------------------
+
+
+class TestAffineChannel(OpTestCase):
+    op_type = "affine_channel_s"
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    scale = rng.randn(3).astype(np.float32)
+    bias = rng.randn(3).astype(np.float32)
+    inputs = {"X": x, "Scale": scale, "Bias": bias}
+    outputs = {"Out": x * scale[None, :, None, None] +
+               bias[None, :, None, None]}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestL2Normalize(OpTestCase):
+    op_type = "l2_normalize_s"
+    x = rng.randn(4, 8).astype(np.float32)
+    inputs = {"X": x}
+    attrs = {"axis": 1, "epsilon": 1e-12}
+    outputs = {"Out": x / np.sqrt((x * x).sum(1, keepdims=True) + 1e-12)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestIouSimilarity(OpTestCase):
+    op_type = "iou_similarity_s"
+    a = np.asarray([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    b = np.asarray([[0, 0, 10, 10], [100, 100, 110, 110]], np.float32)
+    inputs = {"X": a, "Y": b}
+
+    @staticmethod
+    def _iou(a, b):
+        out = np.zeros((len(a), len(b)), np.float32)
+        for i, p in enumerate(a):
+            for j, q in enumerate(b):
+                ix1, iy1 = max(p[0], q[0]), max(p[1], q[1])
+                ix2, iy2 = min(p[2], q[2]), min(p[3], q[3])
+                iw, ih = max(0, ix2 - ix1), max(0, iy2 - iy1)
+                inter = iw * ih
+                ua = ((p[2] - p[0]) * (p[3] - p[1]) +
+                      (q[2] - q[0]) * (q[3] - q[1]) - inter)
+                out[i, j] = inter / ua if ua > 0 else 0
+        return out
+
+    outputs = {"Out": _iou.__func__(a, b)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFsp(OpTestCase):
+    op_type = "fsp_s"
+    a = rng.randn(2, 3, 4, 4).astype(np.float32)
+    b = rng.randn(2, 5, 4, 4).astype(np.float32)
+    inputs = {"X": a, "Y": b}
+    outputs = {"Out": np.einsum("nchw,ndhw->ncd", a, b) / 16.0}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"], max_relative_error=0.06)
+
+
+class TestBoxClip(OpTestCase):
+    op_type = "box_clip_s"
+    boxes = np.asarray([[[-5, -5, 20, 20], [2, 2, 8, 8]]], np.float32)
+    im_info = np.asarray([[10, 12, 1.0]], np.float32)
+    inputs = {"Input": boxes, "ImInfo": im_info}
+    # clip to [0, w-1] x [0, h-1]
+    outputs = {"Out": np.asarray([[[0, 0, 11, 9], [2, 2, 8, 8]]],
+                                 np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+class TestClipByNorm(OpTestCase):
+    op_type = "clip_by_norm_s"
+    x = (rng.randn(6) * 5).astype(np.float32)
+    inputs = {"X": x}
+    attrs = {"max_norm": 2.0}
+    n = np.sqrt((x * x).sum())
+    outputs = {"Out": (x * 2.0 / n if n > 2.0 else x).astype(np.float32)}
+
+    def test(self):
+        self.check_output(atol=1e-5)
+
+
+class TestAddPositionEncoding(OpTestCase):
+    op_type = "add_position_encoding_s"
+    x = rng.randn(2, 4, 6).astype(np.float32)
+    inputs = {"X": x}
+    attrs = {"alpha": 1.0, "beta": 1.0}
+
+    @staticmethod
+    def _pe(x):
+        b, t, d = x.shape
+        half = d // 2
+        pos = np.arange(t, dtype=np.float32)[:, None]
+        denom = half - 1 if half > 1 else 1
+        div = np.exp(np.arange(half, dtype=np.float32) *
+                     -(np.log(10000.0) / denom))
+        enc = np.concatenate([np.sin(pos * div), np.cos(pos * div)], 1)
+        return x + enc[None]
+
+    outputs = {"Out": _pe.__func__(x)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
