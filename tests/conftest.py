@@ -14,6 +14,10 @@ import os
 import sys
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# test-suite bench invocations must not pollute the committed capture
+# log (tests that exercise persistence override with BENCH_CAPTURES_PATH
+# and re-enable)
+os.environ.setdefault("BENCH_NO_PERSIST", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from paddle_tpu.framework.bringup import force_cpu  # noqa: E402

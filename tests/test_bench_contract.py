@@ -100,14 +100,27 @@ def _run_streaming(cmd, env, first_row_deadline, total_deadline):
 
 def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     """Dead tunnel + tiny overrides: a parseable row in <60 s, rc 0."""
+    captures = tmp_path / "captures.jsonl"
     env = _dead_tunnel_env(tmp_path, BENCH_LAYERS="1", BENCH_BATCH="2",
-                           BENCH_SEQ="16", BENCH_STEPS="1")
+                           BENCH_SEQ="16", BENCH_STEPS="1",
+                           BENCH_NO_PERSIST="0",
+                           BENCH_CAPTURES_PATH=str(captures))
     rc, lines, _ = _run_streaming(
         [sys.executable, BENCH], env,
         first_row_deadline=60, total_deadline=180)
     assert rc == 0
     rows = [json.loads(ln) for ln in lines if ln.startswith("{")]
     assert rows, lines
+    # VERDICT r3 weak #1: every measured row must leave a durable capture
+    # (ts + git sha + backend), so live-TPU numbers survive as artifacts
+    caps = [json.loads(ln) for ln in
+            captures.read_text().strip().splitlines()]
+    assert caps, "measured row was not persisted to BENCH_CAPTURES"
+    assert all(c.get("placeholder") is None for c in caps), caps
+    cap = caps[-1]
+    assert cap["kind"] == "bench" and cap["ts"] and cap["git_sha"]
+    assert cap["backend"] == "cpu" and cap["config"] == "bert"
+    assert cap["value"] == rows[-1]["value"]
     # the placeholder precedes the measurement; the LAST row is the one
     # the driver parses and it must carry the headline metric
     last = rows[-1]

@@ -258,8 +258,11 @@ def main():
                     help="JSONL history file to append rows to")
     args = ap.parse_args()
     rows = run_benches(args.ops.split(","))
+    from tools._captures import persist_row
+
     for row in rows:
         print(json.dumps(row), flush=True)
+        persist_row(row, kind="opbench")
     if args.append:
         with open(args.append, "a") as f:
             for row in rows:
