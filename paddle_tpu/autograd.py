@@ -17,17 +17,14 @@ from .framework.tensor import Tensor
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
-    """Returns grads of outputs w.r.t. inputs (does not fill .grad)."""
-    if create_graph:
-        # The eager tape stores opaque vjp closures, which cannot be
-        # re-differentiated; higher-order grads go through the functional
-        # path (jax.grad composition in jit.TrainStep / paddle_tpu.jit).
-        from .framework.errors import UnimplementedError
+    """Returns grads of outputs w.r.t. inputs (does not fill .grad).
 
-        raise UnimplementedError(
-            "grad(create_graph=True) is not supported on the eager tape; "
-            "compose jax.grad via paddle_tpu.jit for higher-order "
-            "derivatives")
+    With ``create_graph=True`` the backward pass itself is recorded on
+    the tape — each node's vjp is replayed as ``jax.vjp(pure_fn,
+    *primals)`` through the @primitive recorder — so the returned
+    gradients are differentiable again to any order (reference eager
+    double-grad: imperative/partial_grad_engine.cc).
+    """
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -36,6 +33,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else [grad_outputs]
 
     retain = True if retain_graph is None else retain_graph
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs, retain,
+                                  allow_unused)
     cot = {}
     alive = {}
     nodes_seen = []
@@ -82,12 +82,111 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             if t._node is not None:
                 cot[k] = cot.get(k, 0) + ct
         if not retain:
-            node.vjp = None
+            node.release()
 
     if not allow_unused:
         for i, r in enumerate(results):
             if r is None:
                 results[i] = Tensor(jnp.zeros(inputs[i].shape, inputs[i].dtype))
+    return results
+
+
+def _replay_vjp(cts, primals, pure_fn=None, multi=False):
+    """Backward of one tape node as a *recorded* op: cotangents of
+    pure_fn's outputs + its primals -> cotangents of its primals.
+
+    Registered through @primitive (lazily, to dodge a circular import at
+    module load), so the returned gradients carry TapeNodes themselves —
+    including pure_fn/primals, which makes third- and higher-order
+    grads work by recursion. Cotangents are cast to pure_fn's actual
+    output dtypes first (an AMP-cast forward records bf16 out_avals
+    while the replay here runs the uncast primal values).
+    """
+    global _replay_prim
+    if _replay_prim is None:
+        from .framework.op import primitive
+
+        @primitive(name="grad_replay")
+        def _replay(cts, primals, pure_fn=None, multi=False):
+            out_shapes = jax.tree_util.tree_leaves(
+                jax.eval_shape(pure_fn, *primals))
+            cts = [jnp.asarray(c, s.dtype)
+                   for c, s in zip(cts, out_shapes)]
+            _, vjp = jax.vjp(pure_fn, *primals)
+            res = vjp(tuple(cts) if multi else cts[0])
+            # the tape's vjp convention is bare-leaf for single outputs
+            # (backward() passes outs[0], not (outs[0],)) — a 1-tuple
+            # here would break the replay node's own backward
+            return res[0] if len(res) == 1 else res
+
+        _replay_prim = _replay
+    return _replay_prim(cts, primals, pure_fn=pure_fn, multi=multi)
+
+
+_replay_prim = None
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, retain, allow_unused):
+    """Tape walk where every vjp application is itself tape-recorded."""
+    from .framework.errors import UnimplementedError
+
+    cot = {}    # id(tensor) -> cotangent Tensor (tape-connected)
+    alive = {}  # keep tensors with pending cotangents alive for id()
+    for out, g in zip(outputs, grad_outputs):
+        if g is None:
+            gt = Tensor(jnp.ones(out.shape, out.dtype))
+        else:
+            gt = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        k = id(out)
+        cot[k] = gt if k not in cot else cot[k] + gt
+        alive[k] = out
+
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    results = [None] * len(inputs)
+    for t in inputs:
+        if id(t) in cot:
+            results[input_ids[id(t)]] = cot[id(t)]
+
+    roots = [o._node for o in outputs if o._node is not None]
+    for node in _topo_multi(roots):
+        cts = []
+        any_needed = False
+        for ref, aval in zip(node.out_refs, node.out_avals):
+            t = ref()
+            ct = cot.pop(id(t), None) if t is not None else None
+            if t is not None:
+                alive.pop(id(t), None)
+            if ct is None:
+                ct = Tensor(jnp.zeros(aval.shape, aval.dtype))
+            else:
+                any_needed = True
+            cts.append(ct)
+        if not any_needed or node.vjp is None:
+            continue
+        if node.pure_fn is None:
+            raise UnimplementedError(
+                f"grad(create_graph=True) through op '{node.name}' is not "
+                "supported: the node has no re-differentiable replay "
+                "(custom PyLayer backward)")
+        in_cts = _replay_vjp(cts, list(node.inputs), pure_fn=node.pure_fn,
+                             multi=len(cts) > 1)
+        in_cts = in_cts if isinstance(in_cts, (tuple, list)) else (in_cts,)
+        for t, ct in zip(node.inputs, in_cts):
+            k = id(t)
+            if k in input_ids:
+                i = input_ids[k]
+                results[i] = ct if results[i] is None else results[i] + ct
+            if t._node is not None:
+                cot[k] = ct if k not in cot else cot[k] + ct
+                alive[k] = t
+        if not retain:
+            node.release()
+
+    if not allow_unused:
+        for i, r in enumerate(results):
+            if r is None:
+                results[i] = Tensor(
+                    jnp.zeros(inputs[i].shape, inputs[i].dtype))
     return results
 
 

@@ -114,7 +114,8 @@ def primitive(name=None, nondiff=()):
 
             primals = [arrays[p] for p in diff_pos]
             out, vjp = jax.vjp(pure, *primals)
-            node = tape_mod.TapeNode(vjp, [flat[p] for p in diff_pos], op_name)
+            node = tape_mod.TapeNode(vjp, [flat[p] for p in diff_pos],
+                                     op_name, pure_fn=pure, primals=primals)
             result = _wrap_outputs(out, stop_gradient=False, node=node)
             if flags.get_flag("check_nan_inf"):
                 _check_nan_inf(op_name, out)

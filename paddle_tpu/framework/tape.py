@@ -60,22 +60,43 @@ class enable_grad:
 
 
 class TapeNode:
-    """One differentiable op application: vjp closure + graph edges."""
+    """One differentiable op application: vjp closure + graph edges.
 
-    __slots__ = ("vjp", "inputs", "out_refs", "out_avals", "name", "__weakref__")
+    ``pure_fn``/``primals`` (set by the @primitive recorder) are the
+    re-differentiable description of the op — a pure function of the
+    differentiable primal arrays. grad(create_graph=True) replays the
+    backward as ``jax.vjp(pure_fn, *primals)`` executed *through* the
+    tape recorder, which is how higher-order eager gradients work
+    (reference: imperative/partial_grad_engine.cc re-dispatches grad
+    ops through the tracer for the same reason). Nodes recorded outside
+    @primitive (PyLayer custom backward) leave them None.
+    """
 
-    def __init__(self, vjp, inputs, name=""):
+    __slots__ = ("vjp", "inputs", "out_refs", "out_avals", "name",
+                 "pure_fn", "primals", "__weakref__")
+
+    def __init__(self, vjp, inputs, name="", pure_fn=None, primals=None):
         self.vjp = vjp  # cotangents-of-outputs (tuple) -> cotangents-of-inputs
         self.inputs = inputs  # List[Tensor] (strong refs keep graph alive)
         self.out_refs: List[Any] = []  # weakrefs to output Tensors
         self.out_avals: List[Any] = []  # ShapeDtypeStruct per output
         self.name = name
+        self.pure_fn = pure_fn
+        self.primals = primals
 
     def add_output(self, tensor):
         self.out_refs.append(weakref.ref(tensor))
         self.out_avals.append(
             jax.ShapeDtypeStruct(tensor.shape, tensor.dtype)
         )
+
+    def release(self):
+        """Drop everything that pins device memory (vjp residuals, the
+        pure_fn closure over all input arrays, the primal arrays). Called
+        by the non-retain backward walks."""
+        self.vjp = None
+        self.pure_fn = None
+        self.primals = None
 
 
 def _topo_nodes(root: TapeNode) -> List[TapeNode]:
@@ -152,7 +173,7 @@ def backward(tensor, grad=None, retain_graph: bool = False):
                     cotangents[k] = ct
                     alive[k] = t
         if not retain_graph:
-            node.vjp = None
+            node.release()
 
 
 def _as_array(x):
