@@ -12,8 +12,25 @@ from .callbacks import (  # noqa: F401
 )
 from .model import Input, Model  # noqa: F401
 from .summary import summary  # noqa: F401
+from . import callbacks, distributed, download, utils  # noqa: F401
+from ..framework.place import set_device  # noqa: F401
+from .. import text, vision  # noqa: F401
 
 __all__ = [
     "Model", "summary", "Callback", "CallbackList", "ProgBarLogger",
     "ModelCheckpoint", "EarlyStopping", "LRSchedulerCallback",
+    "callbacks", "datasets", "distributed", "download", "vision", "text",
+    "utils", "set_device",
 ]
+
+
+def __getattr__(name):
+    # hapi.datasets re-exports the vision+text dataset families; lazy so
+    # importing hapi doesn't pay for the dataset modules
+    if name == "datasets":
+        # importlib, not `from . import`: the from-import form getattrs
+        # the package first, which re-enters this __getattr__ forever
+        import importlib
+
+        return importlib.import_module(".datasets", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,12 @@
+"""hapi datasets namespace (reference incubate/hapi/datasets/): the
+vision and text dataset families under one roof. Implementations live
+in paddle_tpu.vision.datasets and paddle_tpu.text (zero-egress
+synthetic-fallback design)."""
+from ..text import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16)
+from ..vision.datasets import (  # noqa: F401
+    MNIST, Cifar10, Cifar100, FashionMNIST, Flowers, VOC2012)
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "Conll05st", "Imdb", "Imikolov", "Movielens",
+           "UCIHousing", "WMT14", "WMT16"]

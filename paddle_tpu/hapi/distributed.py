@@ -1,0 +1,19 @@
+"""hapi distributed helpers (reference incubate/hapi/distributed.py):
+DistributedBatchSampler plus the env-derived rank/size getters. The
+sampler implementation lives with the rest of the data pipeline in
+paddle_tpu.io; this module is the hapi-surface re-export."""
+from __future__ import annotations
+
+import os
+
+from ..io import DistributedBatchSampler  # noqa: F401
+
+__all__ = ["DistributedBatchSampler", "get_nranks", "get_local_rank"]
+
+
+def get_nranks() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))

@@ -1,0 +1,42 @@
+"""Weight/archive path resolution (reference incubate/hapi/download.py:
+get_weights_path_from_url / get_path_from_url).
+
+Zero-egress design: this environment has no network, so URLs resolve
+strictly against the local cache directory (~/.cache/paddle_tpu/weights
+or PADDLE_TPU_WEIGHTS_HOME). A file someone pre-seeded resolves exactly
+like a downloaded one; a missing file raises a clear error instead of
+attempting a fetch.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_TPU_WEIGHTS_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "weights"))
+
+
+def _cache_path(url: str, root_dir: str) -> str:
+    return os.path.join(root_dir, os.path.basename(url.split("?")[0]))
+
+
+def get_path_from_url(url: str, root_dir: str = WEIGHTS_HOME,
+                      md5sum=None, check_exist: bool = True) -> str:
+    """Resolve ``url`` to its local cache path. Local paths pass
+    through; cached files resolve; anything else raises (no egress)."""
+    if os.path.exists(url):
+        return url
+    path = _cache_path(url, root_dir)
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        f"{url!r} is not cached at {path!r} and this build performs no "
+        "network downloads; pre-seed the file into "
+        f"{root_dir!r} (or set PADDLE_TPU_WEIGHTS_HOME)")
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,46 @@
+"""hapi misc helpers (reference incubate/hapi/utils.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["to_list", "to_numpy", "flatten_list", "restore_flatten_list"]
+
+
+def to_list(value):
+    if value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    return [value]
+
+
+def to_numpy(var):
+    if hasattr(var, "numpy"):
+        return var.numpy()
+    return np.asarray(var)
+
+
+def flatten_list(nested):
+    """[[a, b], [c]] -> ([a, b, c], [2, 1]) — layout for restore."""
+    assert isinstance(nested, list), "input must be a list"
+    flat, structure = [], []
+    for sub in nested:
+        if isinstance(sub, list):
+            flat.extend(sub)
+            structure.append(len(sub))
+        else:
+            flat.append(sub)
+            structure.append(0)
+    return flat, structure
+
+
+def restore_flatten_list(flat, structure):
+    out, i = [], 0
+    for n in structure:
+        if n == 0:
+            out.append(flat[i])
+            i += 1
+        else:
+            out.append(list(flat[i:i + n]))
+            i += n
+    return out

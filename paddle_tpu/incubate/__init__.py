@@ -142,3 +142,20 @@ from ..nn import (  # noqa: F401,E402
 )
 from ..io.dataloader import DistributedBatchSampler  # noqa: F401,E402
 from ..hapi import Input, Model  # noqa: F401,E402
+
+
+def __getattr__(name):
+    # incubate re-exports the hapi sub-namespaces (reference
+    # python/paddle/incubate/__init__.py: __all__ += hapi.__all__ +
+    # ["reader"]) — lazy to keep incubate import light
+    if name in ("callbacks", "datasets", "distributed", "download",
+                "vision", "text", "utils", "set_device", "Model",
+                "summary"):
+        from .. import hapi as _hapi
+
+        return getattr(_hapi, name)
+    if name == "reader":
+        from .. import reader as _reader
+
+        return _reader
+    raise AttributeError(name)

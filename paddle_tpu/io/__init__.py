@@ -88,3 +88,14 @@ def set_program_state(program, state_dict):
 
         warnings.warn(f"set_program_state: variables not in scope: "
                       f"{missing}")
+
+
+def __getattr__(name):
+    # paddle.io.batch (reference python/paddle/io/__init__.py re-exports
+    # the batching reader decorator) — lazy to keep reader import cost
+    # out of package load
+    if name == "batch":
+        from ..reader import batch as _batch
+
+        return _batch
+    raise AttributeError(name)

@@ -342,3 +342,15 @@ class DetectionMAP(Metric):
 
     def eval(self):
         return self.accumulate()
+
+
+def __getattr__(name):
+    # functional metric ops of the 2.0 namespace (reference
+    # python/paddle/metric/__init__.py __all__: auc/chunk_eval/cos_sim/
+    # mean_iou ride the op library) — lazy to avoid importing the static
+    # layer surface at package load
+    if name in ("auc", "chunk_eval", "cos_sim", "mean_iou"):
+        from ..static import layers as _L
+
+        return getattr(_L, name)
+    raise AttributeError(name)

@@ -141,3 +141,12 @@ def _by_global_norm_call(self, params_grads):
 ClipGradByValue.__call__ = _by_value_call
 ClipGradByNorm.__call__ = _by_norm_call
 ClipGradByGlobalNorm.__call__ = _by_global_norm_call
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """fluid.clip.set_gradient_clip parity — delegates to the static
+    optimizer-side registration (static/optimizer.py applies it at
+    minimize time). Lazy import: static imports this module at load."""
+    from ..static.optimizer import set_gradient_clip as _impl
+
+    return _impl(clip, param_list=param_list, program=program)

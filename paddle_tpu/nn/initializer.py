@@ -118,13 +118,18 @@ class KaimingNormal(Initializer):
         return std * jax.random.normal(next_rng_key(), shape, dtype)
 
 
-# reference-name aliases (fluid.initializer)
-MSRAInitializer = KaimingNormal
+# reference-name aliases (fluid.initializer). MSRAInitializer defaults
+# to uniform=True in the reference (initializer.py:573), i.e. the
+# Kaiming-UNIFORM draw.
+MSRAInitializer = KaimingUniform
 XavierInitializer = XavierUniform
 NormalInitializer = Normal
 UniformInitializer = Uniform
 ConstantInitializer = Constant
 TruncatedNormalInitializer = TruncatedNormal
+# short spellings (fluid.initializer.Xavier/MSRA — initializer.py:484/:613)
+Xavier = XavierUniform
+MSRA = KaimingUniform
 
 
 class Assign(Initializer):
@@ -173,6 +178,30 @@ class Bilinear(Initializer):
             y = (i // shape[-1]) % shape[-2]
             weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
         return jnp.asarray(weight.reshape(shape), dtype)
+
+
+NumpyArrayInitializer = Assign
+BilinearInitializer = Bilinear
+
+# set_global_initializer (reference fluid/initializer.py:974): process-wide
+# default weight/bias initializers consulted when a parameter has neither
+# an explicit initializer nor a caller-supplied default override.
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    if weight_init is not None and not isinstance(weight_init, Initializer):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not isinstance(bias_init, Initializer):
+        raise TypeError("bias_init must be an Initializer or None")
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def global_initializer(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init
 
 
 def _resolve(init, default):

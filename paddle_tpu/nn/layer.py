@@ -90,7 +90,8 @@ class Layer:
             return None
         dtype = dtype_mod.convert_dtype(dtype) if dtype else self._dtype
         if default_initializer is None:
-            default_initializer = I.Constant(0.0) if is_bias else I.XavierUniform()
+            default_initializer = I.global_initializer(is_bias) or (
+                I.Constant(0.0) if is_bias else I.XavierUniform())
         init = I._resolve(attr.initializer, default_initializer)
         value = init(tuple(int(s) for s in shape), dtype)
         return Parameter(value, trainable=attr.trainable,

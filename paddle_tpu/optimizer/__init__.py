@@ -25,6 +25,9 @@ LambOptimizer = Lamb
 LarsMomentumOptimizer = LarsMomentum
 MomentumOptimizer = Momentum
 SGDOptimizer = SGD
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+RMSPropOptimizer = RMSProp
 ExponentialMovingAverage = EMA
 
 from .lr import (  # noqa: E402,F401

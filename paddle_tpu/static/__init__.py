@@ -32,6 +32,7 @@ from .feeder import DataFeeder  # noqa: F401
 from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .io import load, save  # noqa: F401
 from .io import (load_inference_model, load_params,  # noqa: F401
                  load_persistables, load_program, save_inference_model,
                  save_params, save_persistables, save_program,
@@ -47,8 +48,8 @@ from .rnn_builder import DynamicRNN, StaticRNN  # noqa: F401
 from .legacy_flow import IfElse, Switch, While  # noqa: F401
 from .py_reader import (PyReader, create_py_reader_by_data,  # noqa: F401
                         double_buffer, py_reader, read_file)
-from .layers import (ParallelExecutor, WeightNormParamAttr,  # noqa: F401
-                     gradients, name_scope)
+from .layers import (ParallelExecutor, Print, WeightNormParamAttr,  # noqa: F401
+                     gradients, name_scope, py_func)
 from .checker import (check_program, compare_op_signatures,  # noqa: F401
                       validate_program, ProgramValidationError)
 from .optimizer import (SGD, Adam, AdamOptimizer, Lamb,  # noqa: F401

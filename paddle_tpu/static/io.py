@@ -63,6 +63,48 @@ save_params = save_persistables
 load_params = load_persistables
 
 
+def save(program: Program, model_path: str):
+    """paddle.static.save parity (reference fluid/io.py:1669): split the
+    program's persistables into parameters -> {model_path}.pdparams and
+    the remaining persistable (optimizer) state -> {model_path}.pdopt,
+    plus the serialized program -> {model_path}.pdmodel."""
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    scope = global_scope()
+    state = _collect_persistables(program, scope)
+    param_names = {p.name for p in program.all_parameters()}
+    params = {k: v for k, v in state.items() if k in param_names}
+    opt = {k: v for k, v in state.items() if k not in param_names}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=4)
+    if opt:
+        with open(model_path + ".pdopt", "wb") as f:
+            pickle.dump(opt, f, protocol=4)
+    save_program(program, model_path + ".pdmodel")
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    """paddle.static.load parity (reference fluid/io.py:1730): restore
+    .pdparams (+ .pdopt when present) into the global scope. ``var_list``
+    restricts the restore to those variables' names."""
+    import jax.numpy as jnp
+
+    state = {}
+    with open(model_path + ".pdparams", "rb") as f:
+        state.update(pickle.load(f))
+    if os.path.exists(model_path + ".pdopt"):
+        with open(model_path + ".pdopt", "rb") as f:
+            state.update(pickle.load(f))
+    wanted = None
+    if var_list is not None:
+        wanted = {v.name if hasattr(v, "name") else v for v in var_list}
+    scope = global_scope()
+    for k, v in state.items():
+        if wanted is None or k in wanted:
+            scope.set(k, jnp.asarray(v))
+
+
 def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                          target_vars: Sequence[Variable], executor: Executor,
                          main_program: Optional[Program] = None,

@@ -172,6 +172,53 @@ _unary("reciprocal", jnp.reciprocal)
 _unary("sign", jnp.sign)
 _unary("softsign", jax.nn.soft_sign)
 _unary("softplus", jax.nn.softplus)
+_unary("cos", jnp.cos)
+_unary("sin", jnp.sin)
+_unary("acos", jnp.arccos)
+_unary("asin", jnp.arcsin)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("erf", jax.scipy.special.erf)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+_unary("tanh_shrink", lambda x: x - jnp.tanh(x))
+
+
+@kernel("cumsum")
+def _cumsum(ins, attrs, ctx):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten"):
+        x, axis = x.reshape(-1), 0
+    if attrs.get("reverse"):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive"):
+        out = out - x
+    return _out(out)
+
+
+@kernel("softshrink")
+def _softshrink(ins, attrs, ctx):
+    x = _x(ins)
+    lam = attrs.get("lambda", 0.5)
+    return _out(jnp.where(x > lam, x - lam,
+                          jnp.where(x < -lam, x + lam, 0.0)).astype(x.dtype))
+
+
+@kernel("hard_shrink")
+def _hard_shrink(ins, attrs, ctx):
+    x = _x(ins)
+    t = attrs.get("threshold", 0.5)
+    return _out(jnp.where(jnp.abs(x) > t, x, 0.0).astype(x.dtype))
+
+
+@kernel("thresholded_relu")
+def _thresholded_relu(ins, attrs, ctx):
+    x = _x(ins)
+    t = attrs.get("threshold", 1.0)
+    return _out(jnp.where(x > t, x, 0.0).astype(x.dtype))
 
 
 @kernel("gelu")

@@ -1987,3 +1987,71 @@ _layers_mod._register_exports({
     "ParallelExecutor": ParallelExecutor,
     "WeightNormParamAttr": WeightNormParamAttr,
 })
+
+
+# ---------------------------------------------------------------------------
+# fluid.layers.ops activation tail (reference fluid/layers/ops.py __all__:
+# __activations_noattr__ + __unary_func__ + the parameterized shrink/relu
+# family). These complete the frozen fluid.layers surface audited by
+# tests/test_namespace_freeze.py.
+# ---------------------------------------------------------------------------
+
+def _unary_layer(op_type):
+    def f(x, name=None):
+        return _append_simple(op_type, {"X": [x]})
+    f.__name__ = op_type
+    return f
+
+
+logsigmoid = _unary_layer("logsigmoid")
+tanh_shrink = _unary_layer("tanh_shrink")
+atan = _unary_layer("atan")
+acos = _unary_layer("acos")
+asin = _unary_layer("asin")
+sinh = _unary_layer("sinh")
+cosh = _unary_layer("cosh")
+erf = _unary_layer("erf")
+softplus = _unary_layer("softplus")
+softsign = _unary_layer("softsign")
+rsqrt = _unary_layer("rsqrt")
+reciprocal = _unary_layer("reciprocal")
+_cos_layer = _unary_layer("cos")
+_sin_layer = _unary_layer("sin")
+_ceil_layer = _unary_layer("ceil")
+_floor_layer = _unary_layer("floor")
+_round_layer = _unary_layer("round")
+
+
+def softshrink(x, alpha=0.5, name=None):
+    return _append_simple("softshrink", {"X": [x]}, {"lambda": alpha})
+
+
+def hard_shrink(x, threshold=0.5):
+    return _append_simple("hard_shrink", {"X": [x]},
+                          {"threshold": threshold})
+
+
+def thresholded_relu(x, threshold=1.0):
+    return _append_simple("thresholded_relu", {"X": [x]},
+                          {"threshold": threshold})
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None):
+    attrs = {"axis": -1 if axis is None else axis,
+             "flatten": axis is None,
+             "exclusive": bool(exclusive), "reverse": bool(reverse)}
+    return _append_simple("cumsum", {"X": [x]}, attrs)
+
+
+_layers_mod._register_exports({
+    "logsigmoid": logsigmoid, "tanh_shrink": tanh_shrink, "atan": atan,
+    "acos": acos, "asin": asin, "sinh": sinh, "cosh": cosh, "erf": erf,
+    "softplus": softplus, "softsign": softsign, "rsqrt": rsqrt,
+    "reciprocal": reciprocal, "softshrink": softshrink,
+    "hard_shrink": hard_shrink, "thresholded_relu": thresholded_relu,
+    "cumsum": cumsum,
+    # builtin-named / math ops must ride the PEP 562 registry so they
+    # never shadow builtins inside layers.py
+    "cos": _cos_layer, "sin": _sin_layer, "ceil": _ceil_layer,
+    "floor": _floor_layer, "round": _round_layer,
+})

@@ -52,6 +52,27 @@ class MNIST(Dataset):
         return len(self.labels)
 
 
+class FashionMNIST(MNIST):
+    """Same IDX wire format and synthetic-fallback scheme as MNIST
+    (reference incubate/hapi/datasets/mnist.py subclass pattern); only
+    the base-pattern seed differs so the two synthetic sets are
+    distinguishable."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 synthetic_size=2048):
+        super().__init__(image_path, label_path, mode, transform,
+                         download, backend, synthetic_size)
+        if not (image_path and os.path.exists(image_path)):
+            n = len(self.labels)
+            base = np.random.RandomState(321).rand(10, 28, 28).astype(
+                np.float32)
+            rng = np.random.RandomState(2 if mode == "train" else 3)
+            noise = rng.rand(n, 28, 28).astype(np.float32) * 0.4
+            self.images = (base[self.labels] * 255 * 0.6 +
+                           noise * 255).astype(np.uint8)
+
+
 class Cifar10(Dataset):
     """CIFAR-10 (reference hapi/datasets/cifar.py:41 Cifar10). Loads the
     cifar-10-python.tar.gz pickle batches when given a path; otherwise a

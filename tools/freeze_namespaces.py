@@ -1,0 +1,200 @@
+"""Vendor the reference public-API name lists into a committed data file.
+
+Statically (ast, no imports) resolves each reference namespace's
+``__all__`` — including the aggregation idiom ``__all__ += sub.__all__``
+and literal helper lists like ``__activations_noattr__`` — and writes
+``tests/data/reference_api_freeze.json``. The committed JSON is what
+tests/test_namespace_freeze.py audits against, making the parity claims
+executable instead of prose (reference posture:
+tools/check_api_approvals.sh + paddle/fluid/API.spec freeze).
+
+Run only when regenerating the freeze:
+    python tools/freeze_namespaces.py
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+REF = "/root/reference/python/paddle"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "data", "reference_api_freeze.json")
+
+# namespace -> reference module path (relative to python/paddle)
+NAMESPACES = {
+    "fluid.layers": "fluid/layers/__init__.py",
+    "nn": "nn/__init__.py",
+    "nn.functional": "nn/functional/__init__.py",
+    "optimizer": "optimizer/__init__.py",
+    "metric": "metric/__init__.py",
+    "distribution": "distribution.py",
+    "distributed.fleet": "distributed/fleet/__init__.py",
+    "distributed.fleet.meta_optimizers":
+        "distributed/fleet/meta_optimizers/__init__.py",
+    "incubate": "incubate/__init__.py",
+    "incubate.hapi": "incubate/hapi/__init__.py",
+    "io": "io/__init__.py",
+    "static": "static/__init__.py",
+    "utils": "utils/__init__.py",
+    "fluid.metrics": "fluid/metrics.py",
+    "fluid.initializer": "fluid/initializer.py",
+    "fluid.regularizer": "fluid/regularizer.py",
+    "fluid.clip": "fluid/clip.py",
+    "fluid.optimizer": "fluid/optimizer.py",
+}
+
+_memo: dict = {}
+
+
+def _module_file(base_dir: str, dotted: str):
+    """Resolve a (possibly dotted) module name relative to base_dir."""
+    parts = dotted.split(".")
+    cand = os.path.join(base_dir, *parts)
+    for p in (cand + ".py", os.path.join(cand, "__init__.py")):
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def extract_all(path: str):
+    """Names in this module's __all__, following literal lists, helper
+    list variables, and sub-module `x.__all__` aggregation."""
+    path = os.path.abspath(path)
+    if path in _memo:
+        return list(_memo[path])
+    _memo[path] = []  # cycle guard
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    base_dir = os.path.dirname(path)
+
+    # import map: local name -> module file (from-import of submodules)
+    imports: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            # from .layer import norm / from . import nn / from ..x import y
+            prefix_dir = base_dir
+            for _ in range(max(node.level - 1, 0)):
+                prefix_dir = os.path.dirname(prefix_dir)
+            mod = node.module or ""
+            for alias in node.names:
+                dotted = f"{mod}.{alias.name}" if mod else alias.name
+                f_ = _module_file(prefix_dir, dotted)
+                if f_ is None and mod:
+                    # "from .common import *"-style: the module itself
+                    f_ = _module_file(prefix_dir, mod)
+                if f_:
+                    imports[alias.asname or alias.name] = f_
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                f_ = _module_file(base_dir, alias.name)
+                if f_:
+                    imports[alias.asname or alias.name] = f_
+
+    env: dict = {}  # helper literal list variables
+    names: list = []
+
+    def resolve(value) -> list:
+        if isinstance(value, (ast.List, ast.Tuple)):
+            out = []
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out.append(elt.value)
+            return out
+        if isinstance(value, ast.Name):
+            return list(env.get(value.id, []))
+        if isinstance(value, ast.Attribute) and value.attr == "__all__":
+            if isinstance(value.value, ast.Name):
+                f_ = imports.get(value.value.id)
+                if f_:
+                    return extract_all(f_)
+            if isinstance(value.value, ast.Attribute):
+                # e.g. fluid.layers.__all__ — resolve the dotted chain
+                chain = []
+                cur = value.value
+                while isinstance(cur, ast.Attribute):
+                    chain.append(cur.attr)
+                    cur = cur.value
+                if isinstance(cur, ast.Name):
+                    chain.append(cur.id)
+                    chain.reverse()
+                    f_ = imports.get(chain[0])
+                    if f_ is None:
+                        f_ = _module_file(os.path.dirname(REF),
+                                          ".".join(chain))
+                    else:
+                        sub = _module_file(os.path.dirname(f_), ".".join(
+                            [os.path.splitext(os.path.basename(f_))[0]]
+                            + chain[1:])) if len(chain) > 1 else f_
+                        f_ = sub or f_
+                    if f_:
+                        return extract_all(f_)
+            return []
+        if isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add):
+            return resolve(value.left) + resolve(value.right)
+        return []
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            if tgt == "__all__":
+                names = resolve(node.value)
+            else:
+                vals = resolve(node.value)
+                if vals or isinstance(node.value, (ast.List, ast.Tuple)):
+                    env[tgt] = vals
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name) and isinstance(
+                    node.op, ast.Add):
+            if node.target.id == "__all__":
+                names += resolve(node.value)
+            elif node.target.id in env:
+                env[node.target.id] = env[node.target.id] + resolve(
+                    node.value)
+
+    # de-dup, preserve order
+    seen, out = set(), []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    _memo[path] = out
+    return list(out)
+
+
+# namespaces whose surface is the union of per-submodule __all__s (the
+# package __init__ has no __all__ of its own in the reference)
+AGGREGATE_DIRS = {
+    "tensor": "tensor",
+}
+
+
+def main():
+    freeze = {}
+    for ns, rel in NAMESPACES.items():
+        path = os.path.join(REF, rel)
+        names = extract_all(path)
+        freeze[ns] = names
+        print(f"{ns}: {len(names)} names")
+    for ns, rel in AGGREGATE_DIRS.items():
+        agg, seen = [], set()
+        d = os.path.join(REF, rel)
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py") or fname == "__init__.py":
+                continue
+            for n in extract_all(os.path.join(d, fname)):
+                if n not in seen:
+                    seen.add(n)
+                    agg.append(n)
+        freeze[ns] = agg
+        print(f"{ns}: {len(agg)} names (dir aggregate)")
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(freeze, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
