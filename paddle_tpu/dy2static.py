@@ -237,20 +237,22 @@ def convert_while(test_fn: Callable, body_fn: Callable,
     take the loop vars positionally; body returns them. Vars that are
     UNDEF at entry are treated as per-iteration temporaries (not carried
     through lax.while_loop)."""
-    first = test_fn(*init_vals)
-    if not _is_traced(first):
-        # concrete test: plain Python loop. Under jit this UNROLLS at
-        # trace time (traced body values are fine) — which also keeps the
-        # loop reverse-differentiable, unlike lax.while_loop. Only a
-        # traced test (truly data-dependent trip count) lowers to
-        # lax.while_loop below.
-        vals = init_vals
-        cond = first
-        while bool(_pred_array(cond)) if isinstance(
-                cond, (Tensor, jax.Array)) else cond:
-            vals = tuple(body_fn(*vals))
-            cond = test_fn(*vals)
-        return vals
+    # concrete test: plain Python loop. Under jit this UNROLLS at trace
+    # time (traced body values are fine) — which also keeps the loop
+    # reverse-differentiable, unlike lax.while_loop. The test can BECOME
+    # traced mid-loop (a break-flag set under a tensor `if` joins the
+    # carry — the escape lowering), so the dispatch re-checks every
+    # iteration and hands the current vals to the traced path the moment
+    # it does.
+    vals = init_vals
+    cond = test_fn(*vals)
+    while not _is_traced(cond):
+        if not (bool(_pred_array(cond)) if isinstance(
+                cond, (Tensor, jax.Array)) else cond):
+            return vals
+        vals = tuple(body_fn(*vals))
+        cond = test_fn(*vals)
+    init_vals = vals
 
     carried_idx = [i for i, v in enumerate(init_vals)
                    if not isinstance(v, _Undefined)]
@@ -434,6 +436,103 @@ _MACHINERY_PREFIXES = ("_jst_true_", "_jst_false_", "_jst_wtest_",
                        "_jst_wbody_", "_jst_c", "_jst_v")
 
 
+# ---------------------------------------------------------------------------
+# break/continue -> bool-flag dataflow (reference
+# dygraph_to_static/break_continue_transformer.py): a loop whose only
+# escapes are break/continue at its own level (possibly nested in ifs)
+# is rewritten so the escapes become flag assignments —
+#   break     ->  _jst_brk_k = True        (loop test gains `and not brk`)
+#   continue  ->  _jst_skip_k = True       (reset at each body start)
+# and every statement that could follow a flag-set is guarded by
+# `if not (brk or skip):`. The rewritten loop contains no escape
+# statements, so the normal While conversion compiles it to
+# lax.while_loop instead of falling back to eager tracing.
+# NOTE: flag names must NOT match _MACHINERY_PREFIXES — they are real
+# loop state and must be carried by convert_while.
+# ---------------------------------------------------------------------------
+
+
+def _loop_level_escapes(stmts):
+    """Escapes belonging to THIS loop: (has_break, has_continue,
+    has_other, supported). has_other covers return/yield at this level;
+    supported=False when an escape sits under try/with (control flow we
+    don't model as dataflow)."""
+    state = {"brk": False, "cont": False, "other": False, "ok": True}
+
+    def walk(s, in_guard):
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor,
+                          ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return  # inner scope: its escapes are its own
+        if isinstance(s, ast.Break):
+            state["brk"] = True
+            state["ok"] = state["ok"] and not in_guard
+        elif isinstance(s, ast.Continue):
+            state["cont"] = True
+            state["ok"] = state["ok"] and not in_guard
+        elif isinstance(s, (ast.Return, ast.Yield, ast.YieldFrom)):
+            state["other"] = True
+        # Try/With: escape-as-dataflow can't model unwinding; Match:
+        # _rewrite_escape_block only rewrites If subtrees, so a Break
+        # under a case body would survive and re-lower forever
+        guard = in_guard or isinstance(
+            s, (ast.Try, ast.With, ast.AsyncWith, ast.Match))
+        for child in ast.iter_child_nodes(s):
+            walk(child, guard)
+
+    for s in stmts:
+        walk(s, False)
+    return state["brk"], state["cont"], state["other"], state["ok"]
+
+
+def _subtree_sets_flags(stmt) -> bool:
+    """Does this (non-loop) statement contain a Break/Continue at the
+    current loop level?"""
+    brk, cont, _, _ = _loop_level_escapes([stmt])
+    return brk or cont
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _flags_clear_test(brk, skip):
+    """`not brk`, `not skip`, or `not (brk or skip)` as an AST expr."""
+    if brk and skip:
+        inner = ast.BoolOp(op=ast.Or(),
+                           values=[_name(brk), _name(skip)])
+    else:
+        inner = _name(brk or skip)
+    return ast.UnaryOp(op=ast.Not(), operand=inner)
+
+
+def _rewrite_escape_block(stmts, brk, skip):
+    """Rewrite one statement list: flag-sets replace escapes, and the
+    continuation after any statement that may set a flag is guarded."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_assign_const(brk, True))
+            return out  # rest of the block is unreachable
+        if isinstance(s, ast.Continue):
+            out.append(_assign_const(skip, True))
+            return out
+        if isinstance(s, ast.If) and _subtree_sets_flags(s):
+            out.append(ast.If(
+                test=s.test,
+                body=_rewrite_escape_block(s.body, brk, skip) or
+                [ast.Pass()],
+                orelse=_rewrite_escape_block(s.orelse, brk, skip)))
+            rest = _rewrite_escape_block(list(stmts[i + 1:]), brk, skip)
+            if rest:
+                out.append(ast.If(test=_flags_clear_test(brk, skip),
+                                  body=rest, orelse=[]))
+            return out
+        out.append(s)
+    return out
+
+
 def _is_machinery_name(n: str) -> bool:
     """Synthetic helper-function / capture-temp names from inner
     transforms: never user loop state. The for-range counter/bounds
@@ -546,8 +645,40 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         return [branch(tb_name, node.body),
                 branch(fb_name, node.orelse)] + init + [assign]
 
+    # -- break/continue lowering -------------------------------------------
+    def _maybe_lower_escapes(self, node):
+        """For a While/For whose body breaks/continues (and nothing
+        worse), return the flag names + rewritten body; else None."""
+        has_brk, has_cont, has_other, ok = _loop_level_escapes(node.body)
+        if not (has_brk or has_cont) or has_other or not ok or node.orelse:
+            return None
+        uid = self._uid()
+        brk = f"_jst_brk_{uid}" if has_brk else None
+        skip = f"_jst_skip_{uid}" if has_cont else None
+        body = _rewrite_escape_block(list(node.body), brk, skip)
+        if skip:
+            body = [_assign_const(skip, False)] + body
+        return brk, skip, body
+
     # -- while --------------------------------------------------------------
     def visit_While(self, node):
+        lowered = self._maybe_lower_escapes(node)
+        if lowered is not None:
+            brk, skip, body = lowered
+            test = node.test
+            if brk:
+                # flag FIRST: after break fires the original test must
+                # not be re-evaluated (it may be side-effecting or
+                # out-of-range — Python never re-tests after break)
+                test = ast.BoolOp(op=ast.And(), values=[
+                    ast.UnaryOp(op=ast.Not(), operand=_name(brk)), test])
+            out = []
+            if brk:
+                out.append(_assign_const(brk, False))
+            new_loop = ast.While(test=test, body=body, orelse=[])
+            r = self.visit(new_loop)
+            out.extend(r if isinstance(r, list) else [r])
+            return out
         self.generic_visit(node)
         if node.orelse or _contains_escape(node.body):
             return node
@@ -583,13 +714,25 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
 
     # -- for over range() ---------------------------------------------------
     def visit_For(self, node):
+        is_range_for = (
+            isinstance(node.target, ast.Name) and
+            isinstance(node.iter, ast.Call) and
+            isinstance(node.iter.func, ast.Name) and
+            node.iter.func.id == "range" and
+            1 <= len(node.iter.args) <= 3 and not node.iter.keywords)
+        brk = None
+        if is_range_for and not node.orelse:
+            lowered = self._maybe_lower_escapes(node)
+            if lowered is not None:
+                # continue suppresses only the USER body; the counter
+                # increment appended by the desugar below stays
+                # unguarded, so the loop still advances (real `for`
+                # semantics). break additionally gates the while test.
+                brk, _skip, body = lowered
+                node = ast.For(target=node.target, iter=node.iter,
+                               body=body, orelse=[])
         if (node.orelse or _contains_escape(node.body) or
-                not isinstance(node.target, ast.Name) or
-                not (isinstance(node.iter, ast.Call) and
-                     isinstance(node.iter.func, ast.Name) and
-                     node.iter.func.id == "range" and
-                     1 <= len(node.iter.args) <= 3 and
-                     not node.iter.keywords)):
+                not is_range_for):
             self.generic_visit(node)
             return node
         uid = self._uid()
@@ -614,20 +757,30 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             comp_op = ast.Gt()
         # stop/step/start evaluate BEFORE the target is (re)bound — `for
         # n in range(n)` must read the old n for its bound
+        while_test = ast.Compare(left=_name(it_n), ops=[comp_op],
+                                 comparators=[_name(stop_n)])
+        if brk:
+            # flag first — see visit_While: no re-test after break
+            while_test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                while_test])
         new = [
             ast.Assign(targets=[_name(stop_n, ast.Store())], value=stop),
             ast.Assign(targets=[_name(step_n, ast.Store())], value=step),
             ast.Assign(targets=[_name(it_n, ast.Store())], value=start),
+        ]
+        if brk:
+            new.append(_assign_const(brk, False))
+        new.append(
             ast.While(
-                test=ast.Compare(left=_name(it_n), ops=[comp_op],
-                                 comparators=[_name(stop_n)]),
+                test=while_test,
                 body=[ast.Assign(targets=[_name(i_var, ast.Store())],
                                  value=_name(it_n))] + list(node.body) +
                      [ast.AugAssign(
                          target=_name(it_n, ast.Store()), op=ast.Add(),
                          value=_name(step_n))],
                 orelse=[]),
-        ]
+        )
         out = []
         for s in new:
             r = self.visit(s) if isinstance(s, ast.While) else s
