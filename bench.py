@@ -148,6 +148,9 @@ def bench_bert(seq=128, smoke=False):
         jax.default_backend() in TPU_PLATFORMS and
         os.environ.get("PADDLE_TPU_DISABLE_PALLAS") != "1")
     pallas_fallback = False
+    from paddle_tpu.ops.pallas.counters import delta, snapshot
+
+    counters_before = snapshot()
     step = build()
     try:
         dt = _time_steps(step, fargs, steps)
@@ -177,12 +180,20 @@ def bench_bert(seq=128, smoke=False):
     fwd_per_token = L * (8 * H * H + 4 * H * I + 4 * seq * H) \
         + 2 * H * H + 2 * H * V
     flops_per_step = 3 * fwd_per_token * batch * seq
+    # dispatch truth (VERDICT r3 weak #8): pallas_fallback reflects the
+    # real kernel-dispatch counters, not just compile exceptions — on an
+    # eligible backend, zero Pallas engagements = fallback, whatever the
+    # reason (perf floor, shape guard, or kernel error)
+    counts = delta(counters_before)
+    if pallas_eligible and not pallas_fallback:
+        pallas_fallback = counts.get("flash_attention.pallas", 0) == 0
     return {
         "value": tokens / dt, "unit": "tokens/s",
         "flops_per_step": flops_per_step,
         "steps_per_sec": steps / dt, "dt": dt, "steps": steps,
         "batch": batch, "seq": seq, "layers": L,
         "pallas_fallback": pallas_fallback,
+        "pallas_counters": counts,
     }
 
 

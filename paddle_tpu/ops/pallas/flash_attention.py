@@ -589,11 +589,20 @@ def _rng_seed_arr(key_rng):
 def _local_attention(q, k, v, is_causal):
     """Best single-device mask-free attention: Pallas when eligible,
     else XLA. Used directly and as ring_attention's fallback."""
+    from .counters import bump
+
     if _pallas_ok(q, k, is_causal):
         try:
-            return _flash_attention_pallas(q, k, v, causal=is_causal)
-        except Exception:
-            pass
+            out = _flash_attention_pallas(q, k, v, causal=is_causal)
+            bump("flash_attention", "pallas")
+            return out
+        except Exception as e:
+            bump("flash_attention", "xla",
+                 f"kernel error {type(e).__name__}: {e}")
+    else:
+        bump("flash_attention", "xla",
+             f"dispatch ineligible (q {tuple(q.shape)}, causal="
+             f"{is_causal}; floor/modulus in _pallas_ok)")
     return _xla_attention(q, k, v, None, 0.0, is_causal, None)
 
 
@@ -709,6 +718,10 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
                              "(FLAGS_sp_mask_fallback=True)")
         elif mask is None:
             return _local_attention(q, k, v, is_causal)
+    from .counters import bump
+
+    reason = "dropout/mask dispatch ineligible (floor/modulus in " \
+        "_pallas_ok or per-query mask)"
     if (mask is None and dropout_p > 0.0 and key_rng is not None and
             q.shape[0] * q.shape[2] < (1 << 15) and
             _pallas_ok(q, k, is_causal)):
@@ -719,19 +732,24 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
         # 256 up (105.8K vs 111.8K at b64/s256; 77.0K vs 98.9K at
         # b32/s512)
         try:
-            return _flash_attention_pallas_dropout(
+            out = _flash_attention_pallas_dropout(
                 q, k, v, _rng_seed_arr(key_rng), dropout_p,
                 causal=is_causal)
-        except Exception:
-            pass
+            bump("flash_attention", "pallas")
+            return out
+        except Exception as e:
+            reason = f"dropout kernel error {type(e).__name__}: {e}"
     if mask is not None and dropout_p == 0.0 and _pallas_ok(q, k, is_causal):
         # key-padding masks ride the Pallas kernel as an additive kv bias;
         # per-query masks keep the XLA path
         bias = _kv_mask_bias(jnp.asarray(mask), q.shape[0], k.shape[1])
         if bias is not None:
             try:
-                return _flash_attention_pallas_masked(q, k, v, bias,
-                                                      causal=is_causal)
-            except Exception:
-                pass
+                out = _flash_attention_pallas_masked(q, k, v, bias,
+                                                     causal=is_causal)
+                bump("flash_attention", "pallas")
+                return out
+            except Exception as e:
+                reason = f"masked kernel error {type(e).__name__}: {e}"
+    bump("flash_attention", "xla", reason)
     return _xla_attention(q, k, v, mask, dropout_p, is_causal, key_rng)

@@ -124,11 +124,23 @@ def _eligible(table, ids):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _bag_core(table, ids, combiner):
+    from .counters import bump
+
     if _eligible(table, ids):
         try:
-            return _bag_pallas(table, ids, combiner)
-        except Exception:
-            pass
+            out = _bag_pallas(table, ids, combiner)
+            bump("fused_embedding", "pallas")
+            return out
+        except Exception as e:
+            # counted + optionally logged: this exact silent except hid
+            # a Mosaic tile-rule bug for a full round
+            bump("fused_embedding", "xla",
+                 f"kernel error {type(e).__name__}: {e}")
+    else:
+        bump("fused_embedding", "xla",
+             f"ineligible (table {tuple(table.shape)}, ids "
+             f"{tuple(ids.shape)}: need d%128==0, seq>=8, vocab%8==0, "
+             "batch%8==0, pallas enabled)")
     return _xla_bag(table, ids, combiner)
 
 
