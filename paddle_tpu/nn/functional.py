@@ -1444,6 +1444,9 @@ def fused_embedding_seq_pool(table, ids, combiner="sum", padding_idx=None,
 # Audited by tests/test_namespace_freeze.py.
 # ---------------------------------------------------------------------------
 
+# fluid-surface names keep their fluid semantics/signatures (e.g.
+# hard_sigmoid slope=0.2, not Hardsigmoid's 1/6 — the v1.8 functional
+# namespace aliases the fluid ops)
 _LAYER_ALIASES = (
     "add_position_encoding", "continuous_value_model", "filter_by_instag",
     "multiclass_nms", "polygon_box_transform", "random_crop",
@@ -1452,14 +1455,13 @@ _LAYER_ALIASES = (
     "adaptive_pool2d", "adaptive_pool3d", "edit_distance",
     "iou_similarity", "sigmoid_cross_entropy_with_logits",
     "sigmoid_focal_loss", "smooth_l1", "ssd_loss", "hsigmoid",
+    "hard_sigmoid", "hard_swish", "tanh",
 )
 
 _LOCAL_ALIASES = {
     "conv_transpose1d": "conv1d_transpose",
     "conv_transpose2d": "conv2d_transpose",
     "conv_transpose3d": "conv3d_transpose",
-    "hard_sigmoid": "hardsigmoid",
-    "hard_swish": "hardswish",
 }
 
 
@@ -1469,7 +1471,7 @@ def __getattr__(name):
     mod = sys.modules[__name__]
     if name in _LOCAL_ALIASES:
         return getattr(mod, _LOCAL_ALIASES[name])
-    if name in ("erf", "tanh", "logsigmoid"):
+    if name in ("erf", "logsigmoid"):
         from .. import ops as _ops
 
         return getattr(_ops, {"logsigmoid": "log_sigmoid"}.get(name, name))

@@ -127,9 +127,6 @@ NormalInitializer = Normal
 UniformInitializer = Uniform
 ConstantInitializer = Constant
 TruncatedNormalInitializer = TruncatedNormal
-# short spellings (fluid.initializer.Xavier/MSRA — initializer.py:484/:613)
-Xavier = XavierUniform
-MSRA = KaimingUniform
 
 
 class Assign(Initializer):
@@ -225,3 +222,14 @@ def calculate_gain(nonlinearity, param=None):
         "selu": 3.0 / 4.0,
     }
     return gains[nonlinearity]
+
+def __getattr__(name):
+    # fluid.initializer short names Xavier/MSRA resolve to the faithful
+    # fluid classes (uniform=True default — static/initializer.py), not
+    # the 2.0 XavierUniform/KaimingUniform spellings above. Lazy: the
+    # static package imports this module at load.
+    if name in ("Xavier", "MSRA"):
+        from ..static import initializer as _SI
+
+        return getattr(_SI, name)
+    raise AttributeError(name)

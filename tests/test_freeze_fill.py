@@ -109,7 +109,9 @@ def test_functional_bilinear_and_cosine_similarity_grads():
 def test_conv_transpose_aliases():
     assert F.conv_transpose2d is F.conv2d_transpose
     assert F.conv_transpose3d is F.conv3d_transpose
-    assert F.hard_sigmoid is F.hardsigmoid
+    # fluid-surface name keeps fluid defaults (slope=0.2), distinct from
+    # the 2.0 Hardsigmoid functional (slope 1/6)
+    assert callable(F.hard_sigmoid) and F.hard_sigmoid is not F.hardsigmoid
 
 
 def test_set_global_initializer():
