@@ -507,6 +507,124 @@ def _flags_clear_test(brk, skip):
     return ast.UnaryOp(op=ast.Not(), operand=inner)
 
 
+def _lower_loop_returns(stmts, counter, in_loop=False):
+    """Pre-pass (before _lift_returns): a loop whose body returns at its
+    own level is rewritten so the return becomes flag dataflow —
+        return <v>   ->   _jst_retv_k = <v>; _jst_retf_k = True; break
+    with ``if _jst_retf_k: return _jst_retv_k`` appended after the loop.
+    The leftover break is then compiled by the normal escape lowering,
+    and the trailing tensor-pred return-if is handled by _lift_returns
+    (which runs right after this pass). Returns in loops nested inside
+    other loops keep Python semantics (eager fallback) — the flag would
+    only exit the inner loop.
+    """
+
+    def rewrite_returns(body, retv, retf):
+        out = []
+        for i, s in enumerate(body):
+            if isinstance(s, ast.Return):
+                val = s.value if s.value is not None else \
+                    ast.Constant(value=None)
+                out.append(ast.Assign(
+                    targets=[_name(retv, ast.Store())], value=val))
+                out.append(_assign_const(retf, True))
+                out.append(ast.Break())
+                return out  # rest unreachable
+            if isinstance(s, ast.If):
+                s = ast.If(test=s.test,
+                           body=rewrite_returns(s.body, retv, retf) or
+                           [ast.Pass()],
+                           orelse=rewrite_returns(s.orelse, retv, retf))
+            out.append(s)
+        return out
+
+    out = []
+    for s in stmts:
+        if isinstance(s, (ast.While, ast.For)) and not s.orelse \
+                and not in_loop:
+            has_brk, has_cont, has_ret, ok = _loop_level_escapes(s.body)
+            # only Return needs this pass; the flag break must reach the
+            # function tail directly, so the loop must not be nested
+            if has_ret and ok and not _contains_yield(s.body):
+                counter[0] += 1
+                retf = f"_jst_retf_{counter[0]}"
+                retv = f"_jst_retv_{counter[0]}"
+                first_expr = _first_return_expr(s.body)
+                new_body = rewrite_returns(list(s.body), retv, retf)
+                loop = (ast.While(test=s.test, body=new_body, orelse=[])
+                        if isinstance(s, ast.While) else
+                        ast.For(target=s.target, iter=s.iter,
+                                body=new_body, orelse=[]))
+                out.append(_assign_const(retf, False))
+                # seed retv with the return expression probed at entry
+                # state (guarded; a for-target is lambda-scoped to the
+                # range start) so it is a CARRIED loop var with the right
+                # shape/dtype under lax.while_loop — the retf flag means
+                # the seed value itself can never be returned
+                out.append(_seed_return_value(s, retv, first_expr))
+                out.append(loop)
+                out.append(ast.If(test=_name(retf),
+                                  body=[ast.Return(value=_name(retv))],
+                                  orelse=[]))
+                continue
+            out.append(s)  # unsupported shape: keeps Python semantics
+        elif isinstance(s, ast.If):
+            out.append(ast.If(
+                test=s.test,
+                body=_lower_loop_returns(s.body, counter, in_loop),
+                orelse=_lower_loop_returns(s.orelse, counter, in_loop)))
+        else:
+            out.append(s)
+    return out
+
+
+def _first_return_expr(stmts):
+    """The first loop-level return's value expression (ifs descended,
+    nested loops/functions skipped)."""
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            return s.value if s.value is not None else ast.Constant(None)
+        if isinstance(s, ast.If):
+            for branch in (s.body, s.orelse):
+                e = _first_return_expr(branch)
+                if e is not None:
+                    return e
+    return None
+
+
+def _seed_return_value(loop, retv, expr):
+    """try: retv = (lambda [target=start]: <expr-copy>)()
+    except Exception: retv = UNDEF"""
+    import copy
+
+    expr = copy.deepcopy(expr) if expr is not None else ast.Constant(None)
+    lam_args = _no_args()
+    if isinstance(loop, ast.For) and isinstance(loop.target, ast.Name):
+        rargs = loop.iter.args if isinstance(loop.iter, ast.Call) else []
+        start = (rargs[0] if len(rargs) >= 2 else ast.Constant(0))
+        lam_args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=loop.target.id)],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[copy.deepcopy(start)])
+    probe = ast.Call(func=ast.Lambda(args=lam_args, body=expr),
+                     args=[], keywords=[])
+    return ast.Try(
+        body=[ast.Assign(targets=[_name(retv, ast.Store())], value=probe)],
+        handlers=[ast.ExceptHandler(
+            type=_name("Exception"), name=None,
+            body=[ast.Assign(targets=[_name(retv, ast.Store())],
+                             value=_jst_attr("UNDEF"))])],
+        orelse=[], finalbody=[])
+
+
+def _contains_yield(stmts) -> bool:
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
 def _rewrite_escape_block(stmts, brk, skip):
     """Rewrite one statement list: flag-sets replace escapes, and the
     continuation after any statement that may set a flag is guarded."""
@@ -885,6 +1003,10 @@ def ast_transform(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ValueError("dy2static: expected a function definition")
     fdef.decorator_list = []
+    # return-in-loop -> flag dataflow FIRST (emits trailing `if retf:
+    # return retv` ifs), so _lift_returns can fold the function
+    # continuation into their else-branches
+    fdef.body = _lower_loop_returns(list(fdef.body), [0])
     fdef.body = _lift_returns(list(fdef.body), [0])
     transformer = _Dy2StaticTransformer()
     new_tree = transformer.visit(tree)

@@ -1,6 +1,7 @@
 """Beam search tests (reference test_beam_search_op.py /
 test_beam_search_decode_op.py / rnn BeamSearchDecoder tests)."""
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -76,6 +77,7 @@ def test_beam_search_decode_batched_and_state_gather():
     assert np.all(np.asarray(ids[:, :, 0]) == 1)
 
 
+@pytest.mark.slow
 def test_transformer_nmt_beam_decode():
     paddle.seed(0)
     from paddle_tpu.models.transformer import TransformerNMT

@@ -150,7 +150,8 @@ def test_bench_default_invocation_with_dead_tunnel(tmp_path):
     assert last.get("degraded") is True
 
 
-@pytest.mark.parametrize("delay", [3, 15])
+@pytest.mark.parametrize(
+    "delay", [3, pytest.param(15, marks=pytest.mark.slow)])
 def test_bench_sigterm_still_emits_row(tmp_path, delay):
     """An external `timeout`-style SIGTERM still yields a parseable
     final row and rc 0 (the rc=124 class is closed) — both during the

@@ -80,6 +80,7 @@ def test_order_matches_single_process():
         np.testing.assert_array_equal(ry, gy)
 
 
+@pytest.mark.slow
 def test_workers_outpace_single_thread():
     def measure():
         ds = SlowDataset(512)

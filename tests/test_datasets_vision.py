@@ -115,6 +115,7 @@ def test_movie_reviews(tmp_path):
     assert ds[0][0].max() < 100
 
 
+@pytest.mark.slow
 def test_mobilenet_v1_trains():
     from paddle_tpu import nn, optimizer
     from paddle_tpu.models import mobilenet_v1

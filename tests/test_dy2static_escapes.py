@@ -209,9 +209,9 @@ def test_break_inside_match_falls_back_not_recurses():
     np.testing.assert_allclose(g(t([0.0])).numpy(), [2.0])
 
 
-def test_return_in_loop_still_falls_back():
-    """return-in-loop is not modeled as dataflow; the loop must keep
-    Python semantics (correct eagerly) rather than mis-compile."""
+def test_return_in_loop_concrete_pred():
+    """return-in-loop with a concrete predicate: the flag rewrite must
+    preserve plain Python semantics (loop unrolls at trace)."""
     def f(x):
         s = x * 0.0
         for i in range(5):
@@ -222,3 +222,68 @@ def test_return_in_loop_still_falls_back():
 
     g = ast_transform(f)
     np.testing.assert_allclose(g(t([1.0])).numpy(), [3.0])
+
+
+def test_return_in_while_compiles():
+    """return-in-loop -> retv/retf flags + break; the whole construct
+    lowers (search-loop pattern, reference return_transformer.py)."""
+    @to_static
+    def f(x):
+        i = t(0.0)
+        while (i < 100.0):
+            if ((x + i).sum() > 10.0):
+                return x + i
+            i = i + 1.0
+        return x * 0.0
+
+    # 4 + i > 10 first at i = 7
+    np.testing.assert_allclose(f(t([4.0])).numpy(), [11.0])
+    # never triggers -> falls through to the final return
+    np.testing.assert_allclose(f(t([-200.0])).numpy(), [0.0])
+    g = ast_transform(f.__wrapped__)
+    assert _jaxpr_has_while(g, t([4.0]))
+
+
+def test_return_in_for_range_compiles():
+    @to_static
+    def f(x):
+        for i in range(8):
+            if ((x * i).sum() > 6.0):
+                return x * i
+        return x * 0.0
+
+    np.testing.assert_allclose(f(t([2.0])).numpy(), [8.0])  # i=4: 8>6
+    np.testing.assert_allclose(f(t([0.0])).numpy(), [0.0])
+
+
+def test_return_in_loop_with_continue():
+    @to_static
+    def f(x):
+        i = t(0.0)
+        while (i < 10.0):
+            i = i + 1.0
+            if (i % 2.0 < 0.5):
+                continue
+            if (i > 5.0):
+                return x + i
+        return x
+
+    # first odd i > 5 is 7
+    np.testing.assert_allclose(f(t([0.5])).numpy(), [7.5])
+
+
+def test_return_in_nested_loop_falls_back():
+    def f(x):
+        s = x * 0.0
+        for i in range(3):
+            for j in range(3):
+                if i + j == 3:
+                    return s
+                s = s + 1.0
+        return s
+
+    g = ast_transform(f)
+    # i=0: j 0,1,2 (+3); i=1: j=0,1 (+2), then i+j==3 at j=2 -> return 5
+    np.testing.assert_allclose(g(t([0.0])).numpy(), [5.0])
+    # plain python agrees (the construct kept eager semantics)
+    np.testing.assert_allclose(f(t([0.0])).numpy(), [5.0])

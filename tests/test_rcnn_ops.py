@@ -328,6 +328,7 @@ def test_deformable_conv_zero_offset_equals_conv():
     np.testing.assert_allclose(np.asarray(ov), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_deformable_conv_gradients_flow():
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(1, 2, 6, 6), jnp.float32)

@@ -110,6 +110,7 @@ def test_iou_matrix():
     np.testing.assert_allclose(got[0, 1], 1.0 / 7.0, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_nms_greedy_matches_numpy():
     rng = np.random.RandomState(3)
     n = 40
@@ -295,6 +296,7 @@ def test_density_prior_box_shapes_and_centers():
     assert flat.numpy().shape == (4 * 4 * n, 4)
 
 
+@pytest.mark.slow
 def test_ssd_loss_matching_and_training_signal():
     """Perfect predictions on matched priors -> near-zero loc loss and
     low conf loss; random predictions lose. Gradients flow to preds."""
@@ -336,6 +338,7 @@ def test_ssd_loss_matching_and_training_signal():
     assert np.abs(np.asarray(conf_t.grad.numpy())).sum() > 0
 
 
+@pytest.mark.slow
 def test_ssd_forward_flow_trains():
     """Book-style SSD head: conv features -> loc/conf heads ->
     prior_box + ssd_loss; a few Adam steps reduce the loss
