@@ -1,0 +1,96 @@
+"""One-command live-TPU capture session.
+
+The axon tunnel is up for short unpredictable windows (observed ~1-2 h
+per day); this script packs everything the perf contract needs into one
+invocation so a single window produces committed evidence:
+
+  1. full bench matrix (headline + bert512/resnet/nmt/ctr/mnist) —
+     every measured row appends to BENCH_CAPTURES.jsonl via bench.py
+  2. op-level micro-bench -> OPBENCH_r04.jsonl (device_kind=TPU rows,
+     host-fetch timing methodology) + capture log
+  3. flash-attention block/crossover sweep at seq 128/256/512
+     (fwd-only and fwd+bwd) for the dispatch-floor decision
+
+Usage (default env — PYTHONPATH must keep /root/.axon_site):
+    python tools/live_tpu_session.py [--skip-sweep]
+Then commit BENCH_CAPTURES.jsonl + OPBENCH_r04.jsonl.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _run(cmd, timeout, env=None):
+    print(f"\n=== {' '.join(cmd)} (timeout {timeout}s)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, timeout=timeout, env=env)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        rc = "timeout"
+    print(f"=== rc={rc} in {time.time() - t0:.0f}s", flush=True)
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-sweep", action="store_true")
+    ap.add_argument("--skip-bench", action="store_true")
+    args = ap.parse_args()
+
+    from paddle_tpu.framework.bringup import TPU_PLATFORMS, ensure_backend
+
+    backend = ensure_backend()
+    if backend not in TPU_PLATFORMS:
+        print(f"backend is {backend!r} — tunnel down, nothing to capture")
+        return 1
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    print(f"LIVE TPU: backend={backend} device_kind={kind}")
+
+    env = dict(os.environ)
+    env.setdefault("BENCH_ROUND", "r04")
+
+    if not args.skip_bench:
+        # the default driver invocation: headline + extras, rows persist
+        _run([sys.executable, "bench.py"], timeout=3600, env=env)
+
+    # op-bench: TPU baseline rows (the gate's committed reference)
+    _run([sys.executable, "tools/op_bench.py",
+          "--append", "OPBENCH_r04.jsonl"], timeout=1200, env=env)
+
+    if not args.skip_sweep:
+        for extra in ([], ["--grad"]):
+            _run([sys.executable, "tools/tune_flash.py"] + extra,
+                 timeout=1800, env=env)
+
+    # summary of what landed in the capture log this session
+    try:
+        with open(os.path.join(REPO, "BENCH_CAPTURES.jsonl")) as f:
+            rows = [json.loads(ln) for ln in f if ln.strip()]
+        tpu_rows = [r for r in rows if r.get("backend") in ("axon", "tpu")
+                    or "tpu" in str(r.get("device_kind", "")).lower()
+                    or "v5" in str(r.get("device_kind", "")).lower()]
+        print(f"\nBENCH_CAPTURES.jsonl: {len(rows)} rows total, "
+              f"{len(tpu_rows)} TPU rows")
+        for r in tpu_rows[-12:]:
+            print(" ", {k: r.get(k) for k in
+                        ("ts", "config", "op", "value", "ms", "mfu",
+                         "device_kind", "git_sha")})
+    except OSError:
+        pass
+    print("\nNow: git add BENCH_CAPTURES.jsonl OPBENCH_r04.jsonl && commit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
