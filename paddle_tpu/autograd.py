@@ -33,9 +33,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else [grad_outputs]
 
     retain = True if retain_graph is None else retain_graph
+    # no_grad_vars: tensors the walk must treat as stop points — no
+    # cotangent flows into or through them (reference
+    # partial_grad_engine.cc no_grad_vars semantics)
+    ng = {id(t) for t in (no_grad_vars or [])}
     if create_graph:
         return _grad_create_graph(outputs, inputs, grad_outputs, retain,
-                                  allow_unused)
+                                  allow_unused, ng)
     cot = {}
     alive = {}
     nodes_seen = []
@@ -73,6 +77,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             if getattr(ct, "dtype", None) == jax.dtypes.float0:
                 continue
             k = id(t)
+            if k in ng:
+                continue
             if k in input_ids:
                 i = input_ids[k]
                 if results[i] is None:
@@ -126,7 +132,8 @@ def _replay_vjp(cts, primals, pure_fn=None, multi=False):
 _replay_prim = None
 
 
-def _grad_create_graph(outputs, inputs, grad_outputs, retain, allow_unused):
+def _grad_create_graph(outputs, inputs, grad_outputs, retain, allow_unused,
+                       ng=frozenset()):
     """Tape walk where every vjp application is itself tape-recorded."""
     from .framework.errors import UnimplementedError
 
@@ -163,16 +170,26 @@ def _grad_create_graph(outputs, inputs, grad_outputs, retain, allow_unused):
             cts.append(ct)
         if not any_needed or node.vjp is None:
             continue
-        if node.pure_fn is None:
+        if node.pure_fn is not None:
+            in_cts = _replay_vjp(cts, list(node.inputs),
+                                 pure_fn=node.pure_fn,
+                                 multi=len(cts) > 1)
+            in_cts = in_cts if isinstance(in_cts, (tuple, list)) \
+                else (in_cts,)
+        elif node.tensor_vjp is not None:
+            # PyLayer: the user backward runs under recording; whatever
+            # differentiable ops it uses become the higher-order graph
+            in_cts = node.tensor_vjp(cts)
+        else:
             raise UnimplementedError(
-                f"grad(create_graph=True) through op '{node.name}' is not "
-                "supported: the node has no re-differentiable replay "
-                "(custom PyLayer backward)")
-        in_cts = _replay_vjp(cts, list(node.inputs), pure_fn=node.pure_fn,
-                             multi=len(cts) > 1)
-        in_cts = in_cts if isinstance(in_cts, (tuple, list)) else (in_cts,)
+                f"grad(create_graph=True) through op '{node.name}' is "
+                "not supported: the node has no re-differentiable replay")
         for t, ct in zip(node.inputs, in_cts):
+            if ct is None:
+                continue
             k = id(t)
+            if k in ng:
+                continue
             if k in input_ids:
                 i = input_ids[k]
                 results[i] = ct if results[i] is None else results[i] + ct
@@ -264,7 +281,15 @@ class PyLayer:
                 return tuple(
                     g.value if isinstance(g, Tensor) else g for g in gin)
 
-            node = tape_mod.TapeNode(vjp, in_tensors, cls.__name__)
+            def tensor_vjp(ct_tensors):
+                # create_graph path: user backward runs WITH recording,
+                # so its ops form the second-order graph (reference
+                # PyLayer double-grad: the grad ops re-enter the tracer)
+                gin = cls.backward(ctx, *ct_tensors)
+                return gin if isinstance(gin, (tuple, list)) else (gin,)
+
+            node = tape_mod.TapeNode(vjp, in_tensors, cls.__name__,
+                                     tensor_vjp=tensor_vjp)
             wrapped = []
             for o in outs:
                 t = Tensor(o.value if isinstance(o, Tensor) else o,

@@ -73,9 +73,10 @@ class TapeNode:
     """
 
     __slots__ = ("vjp", "inputs", "out_refs", "out_avals", "name",
-                 "pure_fn", "primals", "__weakref__")
+                 "pure_fn", "primals", "tensor_vjp", "__weakref__")
 
-    def __init__(self, vjp, inputs, name="", pure_fn=None, primals=None):
+    def __init__(self, vjp, inputs, name="", pure_fn=None, primals=None,
+                 tensor_vjp=None):
         self.vjp = vjp  # cotangents-of-outputs (tuple) -> cotangents-of-inputs
         self.inputs = inputs  # List[Tensor] (strong refs keep graph alive)
         self.out_refs: List[Any] = []  # weakrefs to output Tensors
@@ -83,6 +84,10 @@ class TapeNode:
         self.name = name
         self.pure_fn = pure_fn
         self.primals = primals
+        # Tensor-level backward (PyLayer): called with cotangent Tensors
+        # UNDER tape recording for create_graph — the user backward's own
+        # ops form the higher-order graph
+        self.tensor_vjp = tensor_vjp
 
     def add_output(self, tensor):
         self.out_refs.append(weakref.ref(tensor))
@@ -97,6 +102,7 @@ class TapeNode:
         self.vjp = None
         self.pure_fn = None
         self.primals = None
+        self.tensor_vjp = None
 
 
 def _topo_nodes(root: TapeNode) -> List[TapeNode]:

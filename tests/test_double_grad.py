@@ -118,9 +118,9 @@ def test_first_order_unaffected():
     assert dx._node is None
 
 
-def test_pylayer_create_graph_raises():
-    from paddle_tpu.framework.errors import UnimplementedError
-
+def test_pylayer_double_grad():
+    """create_graph through a PyLayer: the user backward runs under
+    recording, so its ops form the second-order graph."""
     class Square(autograd.PyLayer):
         @staticmethod
         def forward(ctx, x):
@@ -134,7 +134,27 @@ def test_pylayer_create_graph_raises():
 
         apply = classmethod(autograd.PyLayer.apply.__func__)
 
-    x = _t([3.0])
+    x = _t([3.0, -2.0])
     y = Square.apply(x)
-    with pytest.raises(UnimplementedError):
-        autograd.grad(y.sum(), [x], create_graph=True)
+    (dx,) = autograd.grad(y.sum(), [x], create_graph=True)
+    np.testing.assert_allclose(dx.numpy(), [6.0, -4.0])
+    dx.sum().backward()
+    # d2(x^2)/dx2 = 2
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_no_grad_vars_blocks_flow():
+    # z = (x * y).sum(); with y in no_grad_vars only x gets a grad
+    x = _t([1.0, 2.0])
+    y = _t([3.0, 4.0])
+    z = ((x * y) ** 2).sum()
+    gx, gy = autograd.grad(z, [x, y], no_grad_vars=[y],
+                           allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), 2 * np.array([3., 8.])
+                               * np.array([3., 4.]), rtol=1e-6)
+    assert gy is None
+    # create_graph path honors it too
+    gx2, gy2 = autograd.grad(z, [x, y], no_grad_vars=[y],
+                             allow_unused=True, create_graph=True)
+    np.testing.assert_allclose(gx2.numpy(), gx.numpy(), rtol=1e-6)
+    assert gy2 is None
