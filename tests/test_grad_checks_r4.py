@@ -1,0 +1,94 @@
+"""Numeric gradient checks (reference OpTest check_grad, SURVEY §4.1)
+for the round-4 kernels: the fluid.layers activation tail
+(softshrink/hard_shrink/thresholded_relu/tanh_shrink/logsigmoid/erf,
+cumsum variants) and the new functional bilinear/cosine_similarity.
+Central differences vs jax.grad; inputs avoid the kink points of the
+piecewise ops so the finite-difference is well-defined."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.static.kernels import KERNELS
+
+pytestmark = pytest.mark.slow
+
+
+def _numeric_grad(f, x, delta=1e-3):
+    x = np.asarray(x, np.float32)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = float(f(jnp.asarray(x)))
+        flat[i] = orig - delta
+        fm = float(f(jnp.asarray(x)))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * delta)
+    return g
+
+
+def _check(f, x, rtol=0.05, atol=5e-3, delta=1e-3):
+    analytic = np.asarray(jax.grad(lambda v: f(v))(jnp.asarray(
+        np.asarray(x, np.float32))))
+    numeric = _numeric_grad(f, x, delta)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+def _k(op, x, **attrs):
+    out = KERNELS[op]({"X": [x]}, attrs, None)
+    return out["Out"][0] if isinstance(out, dict) else out[0]
+
+
+# kink-free inputs per op: piecewise ops get values away from their
+# thresholds (|x| near 0.5 / 1.0 would break central differences)
+CASES = [
+    ("softshrink", np.array([-2.0, -1.2, 0.1, 0.2, 1.4, 2.5]),
+     {"lambda": 0.5}),
+    ("hard_shrink", np.array([-2.0, -1.2, 0.1, 0.2, 1.4, 2.5]),
+     {"threshold": 0.5}),
+    ("thresholded_relu", np.array([-2.0, 0.3, 0.7, 1.6, 2.5]),
+     {"threshold": 1.0}),
+    ("tanh_shrink", np.array([-1.5, -0.3, 0.2, 0.8, 2.0]), {}),
+    ("logsigmoid", np.array([-2.0, -0.5, 0.0, 1.0, 3.0]), {}),
+    ("erf", np.array([-1.5, -0.5, 0.0, 0.7, 1.8]), {}),
+    ("cumsum", np.array([0.5, -1.0, 2.0, 0.3]), {"axis": 0}),
+    ("cumsum", np.array([0.5, -1.0, 2.0, 0.3]),
+     {"axis": 0, "reverse": True}),
+    ("cumsum", np.array([0.5, -1.0, 2.0, 0.3]),
+     {"axis": 0, "exclusive": True}),
+]
+
+
+@pytest.mark.parametrize("op,x,attrs", CASES,
+                         ids=[f"{c[0]}-{i}" for i, c in enumerate(CASES)])
+def test_kernel_gradient(op, x, attrs):
+    _check(lambda v: jnp.sum(jnp.sin(_k(op, v, **attrs))), x)
+
+
+def test_bilinear_gradient():
+    from paddle_tpu.nn.functional import bilinear
+
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(3, 2, 4).astype(np.float32))
+    x2 = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+
+    def f(x1):
+        return jnp.sum(bilinear.raw_fn(x1, x2, w))
+
+    _check(f, rng.randn(2, 2).astype(np.float32))
+
+
+def test_cosine_similarity_gradient():
+    from paddle_tpu.nn.functional import cosine_similarity
+
+    rng = np.random.RandomState(1)
+    b = jnp.asarray(rng.randn(3, 5).astype(np.float32))
+
+    def f(a):
+        return jnp.sum(cosine_similarity.raw_fn(a, b, axis=1))
+
+    _check(f, rng.randn(3, 5).astype(np.float32))
