@@ -499,6 +499,183 @@ _flash_attention_core_dropout.defvjp(_flash_attention_core_dropout_fwd,
                                      _flash_attention_core_dropout_bwd)
 
 
+# ---------------------------------------------------------------------------
+# short-sequence single-block kernels (seq <= _SHORT_SEQ_MAX): the whole
+# (L, L) score tile lives in VMEM, so softmax is computed directly (no
+# online-softmax carry/rescale machinery) and the ENTIRE backward — dq,
+# dk and dv — is one kernel launch recomputing the scores once, versus
+# the streaming path's two launches recomputing them twice. This is the
+# candidate for beating XLA below the seq-256 dispatch floor
+# (VERDICT r3 weak #3); FLAGS_flash_short_seq gates dispatch until a
+# live A/B (tools/live_tpu_session.py) proves it on hardware.
+# ---------------------------------------------------------------------------
+
+_SHORT_SEQ_MAX = 256
+
+
+def _short_scores(q, k, sm_scale, causal):
+    s = _dot(q * sm_scale, k, trans_b=True)          # (L, L) f32
+    if causal:
+        L, Lk = s.shape
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (L, Lk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (L, Lk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return s
+
+
+def _short_fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal,
+                      dropout_p=0.0):
+    from jax.experimental import pallas as pl
+
+    rest = list(rest)
+    seed_ref = rest.pop(0) if dropout_p > 0.0 else None
+    o_ref, lse_ref = rest
+    q = q_ref[...].astype(_F32)
+    k = k_ref[...].astype(_F32)
+    v = v_ref[...].astype(_F32)
+    s = _short_scores(q, k, sm_scale, causal)
+    m = jnp.max(s, axis=1)
+    p = jnp.exp(s - m[:, None])
+    l = jnp.sum(p, axis=1)
+    p = p / l[:, None]
+    if dropout_p > 0.0:
+        keep = _keep_mask(seed_ref[0, 0], pl.program_id(0), 0, 0,
+                          p.shape, dropout_p)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    o_ref[...] = _dot(p, v).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(jnp.maximum(l, 1e-30)))[None, :]
+
+
+def _short_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      *rest, sm_scale, causal, dropout_p=0.0):
+    from jax.experimental import pallas as pl
+
+    rest = list(rest)
+    seed_ref = rest.pop(0) if dropout_p > 0.0 else None
+    dq_ref, dk_ref, dv_ref = rest
+    q = q_ref[...].astype(_F32) * sm_scale
+    k = k_ref[...].astype(_F32)
+    v = v_ref[...].astype(_F32)
+    do = do_ref[...].astype(_F32)
+    lse = lse_ref[0, :]
+    delta = delta_ref[0, :]
+    s = _short_scores(q, k, 1.0, causal)             # q pre-scaled
+    p = jnp.exp(s - lse[:, None])                    # (L, L)
+    dp = _dot(do, v, trans_b=True)
+    if dropout_p > 0.0:
+        keep = _keep_mask(seed_ref[0, 0], pl.program_id(0), 0, 0,
+                          p.shape, dropout_p)
+        inv = 1.0 / (1.0 - dropout_p)
+        dv_ref[...] = _dot(jnp.where(keep, p * inv, 0.0).T,
+                           do).astype(dv_ref.dtype)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    else:
+        dv_ref[...] = _dot(p.T, do).astype(dv_ref.dtype)
+    ds = p * (dp - delta[:, None])
+    dq_ref[...] = (_dot(ds, k) * sm_scale).astype(dq_ref.dtype)
+    dk_ref[...] = _dot(ds.T, q).astype(dk_ref.dtype)
+
+
+def _short_call_specs(bh, L, d, dropout):
+    from jax.experimental import pallas as pl
+
+    specs = [pl.BlockSpec((None, L, d), lambda i: (i, 0, 0))] * 3
+    if dropout:
+        specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0)))
+    return specs
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_attention_core_short(q, k, v, seed, causal, dropout_p):
+    out, _ = _flash_attention_core_short_fwd(q, k, v, seed, causal,
+                                             dropout_p)
+    return out
+
+
+def _flash_attention_core_short_fwd(q, k, v, seed, causal, dropout_p):
+    from jax.experimental import pallas as pl
+
+    b, L, h, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    qm, km, vm = _mergeheads(q), _mergeheads(k), _mergeheads(v)
+    bh = qm.shape[0]
+    ops = [qm, km, vm]
+    if dropout_p > 0.0:
+        ops.append(seed)
+    out_m, lse = pl.pallas_call(
+        functools.partial(_short_fwd_kernel, sm_scale=sm_scale,
+                          causal=causal, dropout_p=dropout_p),
+        grid=(bh,),
+        in_specs=_short_call_specs(bh, L, d, dropout_p > 0.0),
+        out_specs=[
+            pl.BlockSpec((None, L, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((None, 1, L), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, L, d), qm.dtype),
+            jax.ShapeDtypeStruct((bh, 1, L), _F32),
+        ],
+    )(*ops)
+    return _splitheads(out_m, b, h), (qm, km, vm, out_m, lse, seed, b, h)
+
+
+def _flash_attention_core_short_bwd(causal, dropout_p, res, dout):
+    import numpy as np
+
+    from jax.experimental import pallas as pl
+
+    qm, km, vm, out_m, lse, seed, b, h = res
+    bh, L, d = qm.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    # same constant-cotangent Mosaic guard as the streaming dropout bwd
+    dom = _mergeheads(jax.lax.optimization_barrier(dout))
+    delta = jnp.sum(dom.astype(_F32) * out_m.astype(_F32),
+                    axis=-1)[:, None, :]
+    specs = [pl.BlockSpec((None, L, d), lambda i: (i, 0, 0))] * 4 + [
+        pl.BlockSpec((None, 1, L), lambda i: (i, 0, 0)),
+        pl.BlockSpec((None, 1, L), lambda i: (i, 0, 0)),
+    ]
+    ops = [qm, km, vm, dom, lse, delta]
+    if dropout_p > 0.0:
+        specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0)))
+        ops.append(seed)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_short_bwd_kernel, sm_scale=sm_scale,
+                          causal=causal, dropout_p=dropout_p),
+        grid=(bh,),
+        in_specs=specs,
+        out_specs=[pl.BlockSpec((None, L, d), lambda i: (i, 0, 0))] * 3,
+        out_shape=[jax.ShapeDtypeStruct((bh, L, d), qm.dtype)] * 3,
+    )(*ops)
+    dseed = None if seed is None else np.zeros(seed.shape,
+                                               jax.dtypes.float0)
+    return (_splitheads(dq, b, h), _splitheads(dk, b, h),
+            _splitheads(dv, b, h), dseed)
+
+
+_flash_attention_core_short.defvjp(_flash_attention_core_short_fwd,
+                                   _flash_attention_core_short_bwd)
+
+
+def _short_ok(q, k, causal):
+    from ...framework.bringup import pallas_enabled
+
+    if not pallas_enabled():
+        return False
+    b, ql, h, d = q.shape
+    kl = k.shape[1]
+    # b*h < 2^15: _keep_mask folds (row << 16) + tile coords into one
+    # int32 seed word — beyond that rows would share dropout masks
+    return (ql == kl and 128 <= ql <= _SHORT_SEQ_MAX and ql % 128 == 0 and
+            d % 64 == 0 and d <= 256 and b * h < (1 << 15))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "dropout_p"))
+def _flash_attention_pallas_short(q, k, v, seed=None, causal=False,
+                                  dropout_p=0.0):
+    return _flash_attention_core_short(q, k, v, seed, causal, dropout_p)
+
+
 def _pick_blocks(ql, kl, block_q, block_kv):
     """Block sizes that DIVIDE the lengths (the grid floors otherwise,
     silently skipping tail tiles): the largest of {requested, halves,
@@ -580,6 +757,12 @@ def _pallas_ok(q, k, causal, seq_floor=256):
             (not causal or ql == kl))
 
 
+def _get_flag_short():
+    from ...framework.flags import get_flag
+
+    return get_flag("flash_short_seq")
+
+
 def _rng_seed_arr(key_rng):
     """(1, 1) int32 seed operand for the in-kernel PRNG from a jax key."""
     bits = jax.random.bits(key_rng, (1, 1), jnp.uint32)
@@ -591,6 +774,15 @@ def _local_attention(q, k, v, is_causal):
     else XLA. Used directly and as ring_attention's fallback."""
     from .counters import bump
 
+    if _get_flag_short() and _short_ok(q, k, is_causal):
+        try:
+            out = _flash_attention_pallas_short(q, k, v, causal=is_causal)
+            bump("flash_attention", "pallas")
+            return out
+        except Exception:
+            # fall through: the streaming kernel may still be eligible
+            # (seq 256 overlaps both dispatch windows)
+            pass
     if _pallas_ok(q, k, is_causal):
         try:
             out = _flash_attention_pallas(q, k, v, causal=is_causal)
@@ -722,6 +914,16 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
 
     reason = "dropout/mask dispatch ineligible (floor/modulus in " \
         "_pallas_ok or per-query mask)"
+    if (mask is None and dropout_p > 0.0 and key_rng is not None and
+            _get_flag_short() and _short_ok(q, k, is_causal)):
+        try:
+            out = _flash_attention_pallas_short(
+                q, k, v, seed=_rng_seed_arr(key_rng), causal=is_causal,
+                dropout_p=dropout_p)
+            bump("flash_attention", "pallas")
+            return out
+        except Exception as e:
+            reason = f"short dropout kernel error {type(e).__name__}: {e}"
     if (mask is None and dropout_p > 0.0 and key_rng is not None and
             q.shape[0] * q.shape[2] < (1 << 15) and
             _pallas_ok(q, k, is_causal)):

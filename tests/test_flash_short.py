@@ -1,0 +1,108 @@
+"""Short-sequence single-block flash kernels in interpret mode
+(CPU-hermetic): fwd and the fused one-launch bwd must match the XLA
+reference. On-chip speed (the seq-128/256 dispatch-floor A/B) is
+covered by tools/live_tpu_session.py."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def interpret_pallas(monkeypatch):
+    from jax.experimental import pallas as pl
+
+    real = pl.pallas_call
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(real, interpret=True))
+    yield
+
+
+def _qkv(b=2, l=128, h=2, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, l, h, d), dtype)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("l", [128, 256])
+def test_short_fwd_matches_xla(causal, l):
+    q, k, v = _qkv(l=l)
+    ref = fa._xla_attention(q, k, v, None, 0.0, causal, None)
+    out = fa._flash_attention_core_short(q, k, v, None, causal, 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_short_fused_bwd_matches_xla(causal):
+    q, k, v = _qkv(l=128)
+
+    def loss_s(q, k, v):
+        return jnp.sum(fa._flash_attention_core_short(
+            q, k, v, None, causal, 0.0) ** 2)
+
+    def loss_x(q, k, v):
+        return jnp.sum(fa._xla_attention(q, k, v, None, 0.0, causal,
+                                         None) ** 2)
+
+    gs = jax.grad(loss_s, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_short_matches_streaming_kernel():
+    """Same math as the streaming online-softmax kernel (including the
+    lse side output used by the bwd)."""
+    q, k, v = _qkv(l=256)
+    out_s, res_s = fa._flash_attention_core_short_fwd(
+        q, k, v, None, False, 0.0)
+    out_f, res_f = fa._flash_attention_core_fwd(q, k, v, False, 128, 128)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_f),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(res_s[4]), np.asarray(res_f[4]),
+                               rtol=2e-5, atol=2e-5)  # lse
+
+
+def test_short_ok_eligibility():
+    q, k, _ = _qkv(l=128)
+    import paddle_tpu.framework.bringup as bringup
+    orig = bringup.pallas_enabled
+    bringup.pallas_enabled = lambda: True
+    try:
+        assert fa._short_ok(q, k, False)
+        q2, k2, _ = _qkv(l=512)
+        assert not fa._short_ok(q2, k2, False), "beyond short max"
+        assert not fa._short_ok(q, k2, False), "cross attention"
+    finally:
+        bringup.pallas_enabled = orig
+
+
+def test_short_dispatch_flag_gates(monkeypatch):
+    """flash_short_seq off (default): the short kernel is NOT entered
+    at seq 128; on: it is (counter shows pallas engagement)."""
+    import paddle_tpu.framework.bringup as bringup
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops.pallas import counters
+
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    q, k, v = _qkv(l=128)
+    counters.reset()
+    fa._local_attention(q, k, v, False)
+    assert counters.snapshot().get("flash_attention.pallas", 0) == 0
+    set_flags({"flash_short_seq": True})
+    try:
+        counters.reset()
+        out = fa._local_attention(q, k, v, False)
+        assert counters.snapshot().get("flash_attention.pallas", 0) == 1
+        ref = fa._xla_attention(q, k, v, None, 0.0, False, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        set_flags({"flash_short_seq": False})

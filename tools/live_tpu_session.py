@@ -63,6 +63,13 @@ def main():
     if not args.skip_bench:
         # the default driver invocation: headline + extras, rows persist
         _run([sys.executable, "bench.py"], timeout=3600, env=env)
+        # A/B for the seq-128 dispatch floor: short single-block kernel
+        # vs the XLA floor (VERDICT r3 weak #3). Rows land in the
+        # capture log; pallas_fallback distinguishes the two arms.
+        ab = dict(env)
+        ab["FLAGS_flash_short_seq"] = "1"
+        _run([sys.executable, "bench.py", "--config", "bert"],
+             timeout=1200, env=ab)
 
     # op-bench: TPU baseline rows (the gate's committed reference)
     _run([sys.executable, "tools/op_bench.py",
