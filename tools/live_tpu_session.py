@@ -60,6 +60,13 @@ def main():
     env = dict(os.environ)
     env.setdefault("BENCH_ROUND", "r04")
 
+    # hardware-only kernel validation first (interpret mode can't vouch
+    # for Mosaic lowering — the r3 fused-embedding lesson)
+    _run([sys.executable, "-m", "pytest", "-q",
+          "tests/test_flash_short_tpu.py", "tests/test_flash_dropout_tpu.py",
+          "-p", "no:cacheprovider", "--noconftest"],
+         timeout=900, env=dict(os.environ))
+
     if not args.skip_bench:
         # the default driver invocation: headline + extras, rows persist
         _run([sys.executable, "bench.py"], timeout=3600, env=env)
