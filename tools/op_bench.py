@@ -82,6 +82,36 @@ def bench_flash_attention(smoke):
             "speedup": round(ms_xla / ms_flash, 3)}
 
 
+def bench_flash_short(smoke):
+    """Seq-128 dispatch-floor A/B: single-block short kernel vs the
+    streaming kernel vs XLA (VERDICT r3 weak #3)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.bringup import TPU_PLATFORMS
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _flash_attention_pallas, _flash_attention_pallas_short,
+        _xla_attention)
+
+    if jax.default_backend() not in TPU_PLATFORMS:
+        return {"op": "flash_short_vs_xla", "skipped": "tpu-only"}
+    b, h, s, d = (2, 4, 128, 64) if smoke else (128, 12, 128, 64)
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (b, s, h, d), jnp.float32)
+    short = jax.jit(lambda q: _flash_attention_pallas_short(
+        q, q, q, causal=False))
+    stream = jax.jit(lambda q: _flash_attention_pallas(
+        q, q, q, causal=False, block_q=128, block_kv=128))
+    xla = jax.jit(lambda q: _xla_attention(q, q, q, None, 0.0, False,
+                                           None))
+    ms_short = _timeit(short, q)
+    ms_stream = _timeit(stream, q)
+    ms_xla = _timeit(xla, q)
+    return {"op": "flash_short_vs_xla", "shape": f"b{b}h{h}s{s}d{d}",
+            "ms": ms_short, "ms_stream": round(ms_stream, 4),
+            "ms_xla": round(ms_xla, 4),
+            "speedup_vs_xla": round(ms_xla / ms_short, 3)}
+
+
 def bench_layernorm(smoke):
     import jax.numpy as jnp
 
@@ -207,6 +237,7 @@ BENCHES = {
     "matmul": bench_matmul,
     "attention": bench_attention,
     "flash_attention": bench_flash_attention,
+    "flash_short": bench_flash_short,
     "layernorm": bench_layernorm,
     "embedding": bench_embedding,
     "fused_embedding": bench_fused_embedding,
