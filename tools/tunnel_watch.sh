@@ -1,0 +1,18 @@
+#!/bin/bash
+# Unattended tunnel watcher: probe every 10 min; when the axon tunnel is
+# up, immediately run the full live-TPU capture session (hardware kernel
+# tests + bench matrix + A/B + op-bench + sweeps), then back off 2 h so
+# repeated windows don't re-burn the same captures. Log: /tmp/tunnel_watch.log
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  rm -f ~/.cache/paddle_tpu/probe.json
+  if timeout 90 python -c "import jax; assert jax.devices()" 2>/dev/null; then
+    echo "=== tunnel UP at $(date -u) — running live session" >> /tmp/tunnel_watch.log
+    python tools/live_tpu_session.py >> /tmp/tunnel_watch.log 2>&1
+    echo "=== session done at $(date -u) rc=$?" >> /tmp/tunnel_watch.log
+    sleep 7200
+  else
+    echo "down $(date -u)" >> /tmp/tunnel_watch.log
+    sleep 600
+  fi
+done
