@@ -33,6 +33,20 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else [grad_outputs]
 
     retain = True if retain_graph is None else retain_graph
+    # Inside a jit trace the tape is off (ops don't record), so a walk
+    # would silently return zeros — fail loudly with the functional
+    # recipe instead.
+    for out in outputs:
+        if out._node is None and isinstance(
+                getattr(out, "_value", None), jax.core.Tracer):
+            from .framework.errors import UnimplementedError
+
+            raise UnimplementedError(
+                "paddle.grad was called on a traced tensor with no tape "
+                "(inside jit/TrainStep the eager tape is disabled). "
+                "Compute inner gradients functionally there: "
+                "jax.grad(lambda x: f(x).value)(x.value), or move the "
+                "grad() call outside the compiled step")
     # no_grad_vars: tensors the walk must treat as stop points — no
     # cotangent flows into or through them (reference
     # partial_grad_engine.cc no_grad_vars semantics)

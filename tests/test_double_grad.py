@@ -158,3 +158,20 @@ def test_no_grad_vars_blocks_flow():
                              allow_unused=True, create_graph=True)
     np.testing.assert_allclose(gx2.numpy(), gx.numpy(), rtol=1e-6)
     assert gy2 is None
+
+
+def test_grad_inside_jit_raises_clearly():
+    """Inside a compiled step the tape is off; grad() must fail loudly
+    (it used to silently return zeros) with the functional recipe."""
+    import jax
+
+    from paddle_tpu.framework.errors import UnimplementedError
+
+    def traced(xv):
+        x = paddle.Tensor(xv)
+        y = (x * x).sum()
+        with pytest.raises(UnimplementedError, match="functional"):
+            autograd.grad(y, [x])
+        return xv
+
+    jax.jit(traced)(np.ones((2,), np.float32))
