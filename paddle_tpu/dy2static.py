@@ -18,10 +18,14 @@ XLA replace the sub-block executor.
 
 Supported rewrites: `if` (incl. tail `return`s in branches, lifted by
 the return normalizer like the reference return_transformer), `while`
-(body without return/break/continue), `for ... in range(...)` (desugared
-to while), `and`/`or`/`not`. Anything else is left as plain Python —
-correct for concrete values, and a clear jax TracerBoolConversion error
-points at the unsupported tensor-dependent construct.
+and `for ... in range(...)` (desugared to while) — including
+`break`/`continue` (lowered to bool-flag dataflow,
+break_continue_transformer parity) and `return` in a non-nested loop
+(retv/retf flags + break) — plus `and`/`or`/`not`. Escapes under
+`match`/`try`/`with`, returns in nested loops, and anything else keep
+plain Python semantics — correct for concrete values, and a clear jax
+TracerBoolConversion error points at the unsupported tensor-dependent
+construct.
 """
 from __future__ import annotations
 
