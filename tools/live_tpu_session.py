@@ -86,6 +86,10 @@ def main():
         for extra in ([], ["--grad"]):
             _run([sys.executable, "tools/tune_flash.py"] + extra,
                  timeout=1800, env=env)
+        # resnet bottleneck diagnosis (~20% MFU): XPlane trace for
+        # offline analysis
+        _run([sys.executable, "tools/profile_step.py",
+              "--config", "resnet"], timeout=900, env=env)
 
     # summary of what landed in the capture log this session
     try:
