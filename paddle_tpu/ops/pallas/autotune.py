@@ -1,0 +1,141 @@
+"""On-device attention dispatch autotune.
+
+Reference parity: FLAGS_cudnn_exhaustive_search (platform/flags.cc) —
+the reference times every cuDNN conv algorithm on the real device and
+caches the winner per shape. Here the uncertain window is short-seq
+attention (128 <= seq <= 256), where the single-block short kernel, the
+streaming flash kernel, and fused XLA attention trade places depending
+on batch/heads/dropout: instead of a hard-coded dispatch floor, time
+the eligible candidates once per (shape, dtype, causal, dropout) on
+the REAL chip — forward + backward, since training is the headline —
+and cache the winner for the process.
+
+Runs only on a TPU backend. Dispatch decisions under jit happen at
+Python trace time, so the tuner can execute the candidates on concrete
+random inputs on the side; timing uses paddle_tpu.utils.timing (host
+fetch sync + per-iteration varied inputs — the two axon-tunnel
+lessons). Any failure falls back to the static dispatch.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+from ...framework.flags import define_flag, get_flag
+
+define_flag("flash_autotune", True,
+            "Time short/streaming/XLA attention on-device once per "
+            "shape in the 128-256 seq window and dispatch the winner "
+            "(cudnn_exhaustive_search parity). TPU only; "
+            "FLAGS_flash_short_seq=True overrides to always-short")
+
+_cache: Dict[tuple, str] = {}
+_ITERS = 8
+
+
+def cached_choices() -> Dict[tuple, str]:
+    return dict(_cache)
+
+
+def reset() -> None:
+    _cache.clear()
+
+
+def best_short_window_impl(b, l, h, d, dtype, causal,
+                           dropout_p) -> str | None:
+    """'short' | 'stream' | 'xla' for this shape, timed fwd+bwd on the
+    device (memoized), or None when no candidate could be timed. Must
+    only be called with _short_ok shapes on a TPU backend."""
+    key = (b, l, h, d, str(dtype), bool(causal), round(float(dropout_p), 4))
+    if key in _cache:
+        return _cache[key]
+
+    import jax
+    import jax.numpy as jnp
+
+    from ...utils.timing import timeit
+    from . import flash_attention as fa
+
+    kq = jax.random.key(0)
+    q = jax.random.normal(kq, (b, l, h, d), jnp.float32).astype(dtype)
+    seed = jnp.asarray([[17]], jnp.int32)
+
+    def train_like(impl):
+        # fwd+bwd through the impl's custom vjp: training is what the
+        # headline measures, and fwd-only and train prefer different
+        # kernels (the r3 block sweeps showed exactly that)
+        def loss(a):
+            return jnp.sum(impl(a))
+
+        return jax.jit(jax.grad(loss))
+
+    candidates = {}
+    if dropout_p > 0.0:
+        candidates["short"] = train_like(
+            lambda a: fa._flash_attention_core_short(
+                a, a, a, seed, causal, dropout_p))
+        if fa._pallas_ok(q, q, causal):
+            candidates["stream"] = train_like(
+                lambda a: fa._flash_attention_core_dropout(
+                    a, a, a, seed, causal, *fa._pick_blocks(
+                        l, l, 512, 512), dropout_p))
+        candidates["xla"] = train_like(
+            lambda a: fa._xla_attention(a, a, a, None, dropout_p, causal,
+                                        jax.random.key(3)))
+    else:
+        candidates["short"] = train_like(
+            lambda a: fa._flash_attention_core_short(
+                a, a, a, None, causal, 0.0))
+        if fa._pallas_ok(q, q, causal):
+            candidates["stream"] = train_like(
+                lambda a: fa._flash_attention_core(
+                    a, a, a, causal, *fa._pick_blocks(l, l, 512, 512)))
+        candidates["xla"] = train_like(
+            lambda a: fa._xla_attention(a, a, a, None, 0.0, causal, None))
+
+    times = {}
+    for name, fn in candidates.items():
+        try:
+            times[name] = timeit(fn, q, iters=_ITERS)
+        except Exception as e:  # candidate fails to compile/run: skip it
+            sys.stderr.write(f"flash autotune: {name} failed "
+                             f"({type(e).__name__}: {e})\n")
+    if not times:
+        # a transient blip (the tunnel flaps) must not pin a verdict for
+        # the whole process: leave uncached so static dispatch decides
+        # now and tuning retries on the next fresh dispatch
+        sys.stderr.write("flash autotune: all candidates failed; "
+                         "keeping static dispatch\n")
+        return None
+    winner = min(times, key=times.get)
+    sys.stderr.write(
+        "flash autotune "
+        f"(b={b} l={l} h={h} d={d} causal={causal} p={dropout_p}): "
+        + " ".join(f"{n}={t:.3f}ms" for n, t in sorted(times.items()))
+        + f" -> {winner}\n")
+    _cache[key] = winner
+    return winner
+
+
+def short_window_choice(q, k, causal, dropout_p) -> str | None:
+    """The dispatch entry: returns the tuned impl name, or None when
+    autotuning does not apply (not TPU / flag off / outside window)."""
+    from ...framework.bringup import TPU_PLATFORMS
+    from . import flash_attention as fa
+
+    if not get_flag("flash_autotune"):
+        return None
+    if not fa._short_ok(q, k, causal):
+        return None
+    import jax
+
+    if jax.default_backend() not in TPU_PLATFORMS:
+        return None
+    b, l, h, d = q.shape
+    try:
+        return best_short_window_impl(b, l, h, d, q.dtype, causal,
+                                      dropout_p)
+    except Exception as e:
+        sys.stderr.write(f"flash autotune failed, static dispatch keeps "
+                         f"({type(e).__name__}: {e})\n")
+        return None

@@ -763,6 +763,18 @@ def _get_flag_short():
     return get_flag("flash_short_seq")
 
 
+def _short_choice(q, k, causal, dropout_p):
+    """Dispatch verdict for the short-seq window: the manual
+    FLAGS_flash_short_seq override wins, else the on-device autotune
+    (None = keep static dispatch). The single source for both the
+    mask-free and the dropout dispatch sites."""
+    if _get_flag_short() and _short_ok(q, k, causal):
+        return "short"
+    from .autotune import short_window_choice
+
+    return short_window_choice(q, k, causal, dropout_p)
+
+
 def _rng_seed_arr(key_rng):
     """(1, 1) int32 seed operand for the in-kernel PRNG from a jax key."""
     bits = jax.random.bits(key_rng, (1, 1), jnp.uint32)
@@ -774,7 +786,8 @@ def _local_attention(q, k, v, is_causal):
     else XLA. Used directly and as ring_attention's fallback."""
     from .counters import bump
 
-    if _get_flag_short() and _short_ok(q, k, is_causal):
+    choice = _short_choice(q, k, is_causal, 0.0)
+    if choice == "short":
         try:
             out = _flash_attention_pallas_short(q, k, v, causal=is_causal)
             bump("flash_attention", "pallas")
@@ -783,6 +796,10 @@ def _local_attention(q, k, v, is_causal):
             # fall through: the streaming kernel may still be eligible
             # (seq 256 overlaps both dispatch windows)
             pass
+    elif choice == "xla":
+        bump("flash_attention", "xla", "autotuned: xla wins this shape")
+        return _xla_attention(q, k, v, None, 0.0, is_causal, None)
+    # choice == "stream" or no autotune verdict: static streaming path
     if _pallas_ok(q, k, is_causal):
         try:
             out = _flash_attention_pallas(q, k, v, causal=is_causal)
@@ -914,16 +931,24 @@ def flash_attention_or_fallback(q, k, v, mask=None, dropout_p=0.0,
 
     reason = "dropout/mask dispatch ineligible (floor/modulus in " \
         "_pallas_ok or per-query mask)"
-    if (mask is None and dropout_p > 0.0 and key_rng is not None and
-            _get_flag_short() and _short_ok(q, k, is_causal)):
-        try:
-            out = _flash_attention_pallas_short(
-                q, k, v, seed=_rng_seed_arr(key_rng), causal=is_causal,
-                dropout_p=dropout_p)
-            bump("flash_attention", "pallas")
-            return out
-        except Exception as e:
-            reason = f"short dropout kernel error {type(e).__name__}: {e}"
+    if mask is None and dropout_p > 0.0 and key_rng is not None:
+        choice = _short_choice(q, k, is_causal, dropout_p)
+        if choice == "short":
+            try:
+                out = _flash_attention_pallas_short(
+                    q, k, v, seed=_rng_seed_arr(key_rng),
+                    causal=is_causal, dropout_p=dropout_p)
+                bump("flash_attention", "pallas")
+                return out
+            except Exception as e:
+                reason = (f"short dropout kernel error "
+                          f"{type(e).__name__}: {e}")
+        elif choice == "xla":
+            bump("flash_attention", "xla",
+                 "autotuned: xla wins this shape")
+            return _xla_attention(q, k, v, mask, dropout_p, is_causal,
+                                  key_rng)
+        # choice == "stream"/None: static streaming dispatch below
     if (mask is None and dropout_p > 0.0 and key_rng is not None and
             q.shape[0] * q.shape[2] < (1 << 15) and
             _pallas_ok(q, k, is_causal)):

@@ -1,0 +1,78 @@
+"""Shared micro-benchmark timing for tools/op_bench.py and
+tools/tune_flash.py.
+
+Two hardware facts (measured on the axon remote-TPU plugin, round 3)
+drive the design — both discovered when per-op numbers came out 17-20x
+over the chip's bf16 peak:
+
+1. ``jax.block_until_ready`` returns early under the remote plugin.
+   The only truthful completion barrier is a HOST FETCH of a scalar
+   that data-depends on the work (``float(...)``).
+2. Value-identical repeat dispatches can be served from cache rather
+   than executed, so every timed iteration must be a genuinely new
+   computation. The perturbation must survive the array dtype: a
+   ``* (1 + 1e-6)`` factor rounds to exactly 1.0 in bf16 (eps ~7.8e-3)
+   and hands back bitwise-identical copies.
+
+On the CPU backend neither failure mode exists, and the countermeasures
+actively hurt (distinct buffers defeat cache-hot reuse; per-iteration
+scalar dispatches add ~0.1 ms each against millisecond rows), so CPU
+keeps the classic reuse-args + block_until_ready loop — matching the
+committed OPBENCH baselines.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def vary(arg, i):
+    """A value-distinct copy of ``arg`` for iteration ``i``, scaled by
+    one ulp-multiple so the change survives the dtype (bf16 included)."""
+    if jnp.issubdtype(arg.dtype, jnp.floating):
+        eps = float(jnp.finfo(arg.dtype).eps)
+        return arg * (1.0 + (i + 1) * 2 * eps)
+    return jnp.roll(arg, i + 1)
+
+
+def scalar_of(o):
+    """A cheap scalar data-depending on output ``o`` (first leaf)."""
+    while isinstance(o, (tuple, list)):
+        o = o[0]
+    return jnp.ravel(o)[0].astype(jnp.float32)
+
+
+def timeit(fn, *args, iters=20, vary_arg=-1):
+    """ms/iteration of ``fn(*args)`` with backend-appropriate sync (see
+    module docstring). ``vary_arg`` indexes the argument perturbed per
+    iteration on non-CPU backends."""
+    args = list(args)
+    cpu = jax.default_backend() == "cpu"
+    varied = ([args[vary_arg]] * iters if cpu else
+              [vary(args[vary_arg], i) for i in range(iters)])
+    # force the perturbation work itself to finish before the clock
+    # starts — block_until_ready alone is not a barrier on remote
+    _ = float(sum(scalar_of(v) for v in varied)) if not cpu else None
+    out = fn(*args)
+    jax.block_until_ready(out)
+    _ = float(scalar_of(out))     # sync before the clock starts
+
+    if cpu:
+        # reuse-args loop: rebinding `out` frees the previous buffer so
+        # the allocator reuses it hot in cache; holding all outputs
+        # measured 2.3x slower on bandwidth-bound rows
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    deps = []
+    t0 = time.perf_counter()
+    for i in range(iters):
+        args[vary_arg] = varied[i]
+        deps.append(scalar_of(fn(*args)))
+    _ = float(sum(deps))          # one fetch, depends on all iterations
+    return (time.perf_counter() - t0) / iters * 1e3

@@ -1,0 +1,140 @@
+"""Attention dispatch autotune (FLAGS_cudnn_exhaustive_search parity):
+selection, caching, fallback, and dispatch wiring. Real on-device
+timing is exercised by tools/live_tpu_session.py; here the timer is
+stubbed and kernels run in interpret mode."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.framework.bringup as bringup
+from paddle_tpu.ops.pallas import autotune, counters
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    autotune.reset()
+    counters.reset()
+    yield
+    autotune.reset()
+    counters.reset()
+
+
+@pytest.fixture
+def interpret_pallas(monkeypatch):
+    from jax.experimental import pallas as pl
+
+    real = pl.pallas_call
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(real, interpret=True))
+    yield
+
+
+def _q(l=128, b=2, h=2, d=64):
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randn(b, l, h, d), jnp.float32)
+
+
+def test_choice_none_off_tpu():
+    q = _q()
+    assert autotune.short_window_choice(q, q, False, 0.0) is None
+
+
+def test_selection_picks_min_and_caches(monkeypatch, interpret_pallas):
+    import paddle_tpu.utils.timing as timing
+
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(bringup, "TPU_PLATFORMS", ("cpu", "tpu"))
+    calls = []
+    # candidate order at seq 128 (stream ineligible below its floor):
+    # short, xla
+    times = iter([3.0, 1.0])
+
+    def fake_timeit(fn, *args, iters=0, vary_arg=-1):
+        calls.append(fn)
+        return next(times)
+
+    monkeypatch.setattr(timing, "timeit", fake_timeit)
+    q = _q(l=128)
+    choice = autotune.short_window_choice(q, q, False, 0.0)
+    assert choice == "xla"
+    assert len(calls) == 2
+    # memoized: no more timing on the same shape
+    assert autotune.short_window_choice(q, q, False, 0.0) == "xla"
+    assert len(calls) == 2
+    # different shape -> fresh tuning
+    times2 = iter([1.0, 9.0, 9.0])
+    monkeypatch.setattr(timing, "timeit",
+                        lambda fn, *a, **k: next(times2))
+    q2 = _q(l=256)
+    assert autotune.short_window_choice(q2, q2, False, 0.0) == "short"
+
+
+def test_failed_candidates_are_skipped(monkeypatch, interpret_pallas):
+    import paddle_tpu.utils.timing as timing
+
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(bringup, "TPU_PLATFORMS", ("cpu", "tpu"))
+
+    def exploding_timeit(fn, *args, iters=0, vary_arg=-1):
+        if exploding_timeit.n == 0:
+            exploding_timeit.n += 1
+            raise RuntimeError("mosaic says no")
+        return 1.0
+
+    exploding_timeit.n = 0
+    monkeypatch.setattr(timing, "timeit", exploding_timeit)
+    q = _q(l=128)
+    assert autotune.short_window_choice(q, q, False, 0.0) == "xla"
+
+
+def test_dispatch_routes_on_choice(monkeypatch, interpret_pallas):
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    q = _q(l=128)
+
+    monkeypatch.setattr(autotune, "short_window_choice",
+                        lambda *a: "short")
+    out = fa._local_attention(q, q, q, False)
+    assert counters.snapshot().get("flash_attention.pallas", 0) == 1
+    ref = fa._xla_attention(q, q, q, None, 0.0, False, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    counters.reset()
+    monkeypatch.setattr(autotune, "short_window_choice",
+                        lambda *a: "xla")
+    out2 = fa._local_attention(q, q, q, False)
+    snap = counters.snapshot()
+    assert snap.get("flash_attention.pallas", 0) == 0
+    assert snap.get("flash_attention.xla", 0) == 1
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=1e-6)
+
+
+def test_autotune_error_keeps_static_dispatch(monkeypatch):
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(bringup, "TPU_PLATFORMS", ("cpu", "tpu"))
+    monkeypatch.setattr(
+        autotune, "best_short_window_impl",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    q = _q(l=128)
+    assert autotune.short_window_choice(q, q, False, 0.0) is None
+
+
+def test_all_failed_leaves_cache_empty(monkeypatch, interpret_pallas):
+    import paddle_tpu.utils.timing as timing
+
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(bringup, "TPU_PLATFORMS", ("cpu", "tpu"))
+
+    def always_fail(fn, *args, **kw):
+        raise RuntimeError("tunnel blip")
+
+    monkeypatch.setattr(timing, "timeit", always_fail)
+    q = _q(l=128)
+    assert autotune.short_window_choice(q, q, False, 0.0) is None
+    assert autotune.cached_choices() == {}, (
+        "a transient failure must not pin a process-wide verdict")
