@@ -508,9 +508,13 @@ _flash_attention_core_dropout.defvjp(_flash_attention_core_dropout_fwd,
 # candidate for beating XLA below the seq-256 dispatch floor
 # (VERDICT r3 weak #3); FLAGS_flash_short_seq gates dispatch until a
 # live A/B (tools/live_tpu_session.py) proves it on hardware.
+# The 512 ceiling includes the bert512 shape on purpose: per program the
+# fused bwd holds ~4x(512,512) f32 intermediates (~5 MB) — inside v5e
+# VMEM on paper, and if Mosaic disagrees the autotune candidate just
+# fails and is skipped.
 # ---------------------------------------------------------------------------
 
-_SHORT_SEQ_MAX = 256
+_SHORT_SEQ_MAX = 512
 
 
 def _short_scores(q, k, sm_scale, causal):

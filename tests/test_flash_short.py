@@ -29,7 +29,7 @@ def _qkv(b=2, l=128, h=2, d=64, seed=0, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("l", [128, 256])
+@pytest.mark.parametrize("l", [128, 256, 512])
 def test_short_fwd_matches_xla(causal, l):
     q, k, v = _qkv(l=l)
     ref = fa._xla_attention(q, k, v, None, 0.0, causal, None)
@@ -77,7 +77,7 @@ def test_short_ok_eligibility():
     bringup.pallas_enabled = lambda: True
     try:
         assert fa._short_ok(q, k, False)
-        q2, k2, _ = _qkv(l=512)
+        q2, k2, _ = _qkv(l=1024)
         assert not fa._short_ok(q2, k2, False), "beyond short max"
         assert not fa._short_ok(q, k2, False), "cross attention"
     finally:
