@@ -142,6 +142,14 @@ def calc_gradient(targets, inputs, target_gradients=None):
     grad_names = []
     for v in inputs:
         gname = grad_var_name(v.name)
+        # repeated differentiation w.r.t. the same var (double grad:
+        # calc_gradient of a calc_gradient output) must not clobber the
+        # earlier gradient — uniquify like the reference's _rename_grad_
+        if gname in block.vars:
+            k = 1
+            while f"{gname}@{k}" in block.vars:
+                k += 1
+            gname = f"{gname}@{k}"
         block.create_var(name=gname, shape=v.shape, dtype=v.dtype,
                          stop_gradient=True)
         grad_names.append(gname)
