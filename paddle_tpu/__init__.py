@@ -65,3 +65,55 @@ def summary(net, input_size=None, dtypes=None):
 from . import reader  # noqa: F401
 from .reader import batch  # noqa: F401
 from . import install_check  # noqa: F401
+
+
+def __getattr__(name):
+    """Top-level 2.0-alpha aliases (reference python/paddle/__init__.py
+    DEFINE_ALIAS rows), resolved lazily so package import stays light.
+    Audited by tests/test_namespace_freeze.py ("paddle")."""
+    _tensor_names = {
+        "t", "reduce_all", "reduce_any", "reduce_max", "reduce_min",
+        "reduce_prod", "reduce_sum", "reduce_mean", "sums",
+        "elementwise_sum", "elementwise_floordiv", "addcmul",
+        "standard_normal", "shuffle", "numel",
+    }
+    if name in _tensor_names:
+        from . import tensor as _T
+
+        return getattr(_T, name)
+    if name == "manual_seed":
+        return seed
+    if name == "to_variable":
+        from .dygraph import to_variable as _tv
+
+        return _tv
+    if name in ("enable_static", "disable_static", "in_dynamic_mode",
+                "in_dygraph_mode", "enable_imperative",
+                "disable_imperative"):
+        from .framework import mode as _mode
+
+        return getattr(_mode, name)
+    if name in ("Variable", "data"):
+        from . import static as _S
+
+        return getattr(_S, name)
+    if name in ("create_parameter", "create_global_var"):
+        from .static import layers as _L
+
+        return getattr(_L, name)
+    if name == "ParamAttr":
+        from .nn.layer import ParamAttr as _PA
+
+        return _PA
+    if name in ("BackwardStrategy", "prepare_context", "ParallelEnv",
+                "DataParallel", "NoamDecay", "PiecewiseDecay",
+                "NaturalExpDecay", "ExponentialDecay",
+                "InverseTimeDecay", "PolynomialDecay", "CosineDecay"):
+        from . import dygraph as _dg
+
+        return getattr(_dg, name)
+    if name == "get_cudnn_version":
+        # no cuDNN on this stack — the reference returns None when not
+        # compiled with it
+        return lambda: None
+    raise AttributeError(name)

@@ -18,7 +18,8 @@ from .collective import (  # noqa: F401
     barrier, send, recv, ReduceOp,
 )
 from . import fleet  # noqa: F401
-from .parallel import init_parallel_env, DataParallel  # noqa: F401
+from .parallel import (DataParallel, ParallelEnv,  # noqa: F401
+                       init_parallel_env, prepare_context)
 from .launch import spawn  # noqa: F401
 
 _initialized = [False]

@@ -58,3 +58,18 @@ class DataParallel(Layer):
 
     def set_state_dict(self, *a, **k):
         return self._layers.set_state_dict(*a, **k)
+
+
+def prepare_context(strategy=None):
+    """fluid.dygraph.prepare_context parity: bring up the parallel env
+    (jax.distributed coordination replaces the NCCL-id TCP bootstrap of
+    imperative/nccl_context.cc) and return the effective strategy."""
+    env = init_parallel_env()
+
+    class ParallelStrategy:
+        pass
+
+    s = strategy or ParallelStrategy()
+    s.nranks = env.nranks
+    s.local_rank = env.local_rank
+    return s

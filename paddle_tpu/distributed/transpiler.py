@@ -167,3 +167,48 @@ class GeoSgdTranspiler(DistributeTranspiler):
         return GeoCommunicator(
             client, SparseTable(dim=dim), table_id=table_id,
             k_steps=push_nums or self.config.geo_sgd_need_push_nums)
+
+
+class PSDispatcher:
+    """Parameter-block -> pserver endpoint assignment base (reference
+    transpiler/ps_dispatcher.py)."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    def reset(self):
+        self._step = 0
+
+    @property
+    def eps(self):
+        return list(self._eps)
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    """Cycle endpoints in order (ps_dispatcher.py:60)."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _v in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    """Stable name-hash assignment (ps_dispatcher.py:41): the same var
+    always lands on the same pserver across runs."""
+
+    @staticmethod
+    def _hash(name: str) -> int:
+        import zlib
+
+        return zlib.crc32(name.encode())
+
+    def dispatch(self, varlist):
+        return [self._eps[self._hash(getattr(v, "name", str(v)))
+                          % len(self._eps)] for v in varlist]

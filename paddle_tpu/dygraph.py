@@ -1,0 +1,336 @@
+"""fluid.dygraph namespace shim (reference
+python/paddle/fluid/dygraph/__init__.py __all__): the eager-mode
+surface under its fluid-era names. Implementations live with their
+subsystems — Layer/containers in nn, LR schedules in optimizer.lr,
+DataParallel in distributed, @to_static machinery in jit/dy2static,
+AMP in amp — this module is the compatibility address plus the handful
+of genuinely fluid-only classes (GRUUnit, NCE, PRelu, TreeConv,
+TracedLayer, save/load_dygraph)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from jax.nn import sigmoid as jax_sigmoid
+
+from . import amp as _amp
+from . import nn
+from .amp import AmpScaler, amp_guard  # noqa: F401
+from .dy2static import ProgramTranslator  # noqa: F401
+from .framework.mode import (  # noqa: F401
+    disable_dygraph, enable_dygraph, in_dygraph_mode)
+from .framework.tensor import Tensor, to_tensor
+from .io.serialization import TranslatedLayer  # noqa: F401
+from .jit import to_static
+from .nn import (  # noqa: F401
+    BatchNorm, BilinearTensorProduct, Conv2D, Conv2DTranspose, Conv3D,
+    Conv3DTranspose, Dropout, Embedding, Flatten, GroupNorm, GRUCell,
+    InstanceNorm, Layer, LayerList, LayerNorm, Linear, LSTMCell,
+    ParameterList, Pool2D, Sequential, SpectralNorm)
+from .optimizer.lr import (  # noqa: F401
+    CosineAnnealingDecay as CosineDecay,
+    ExponentialDecay, InverseTimeDecay, LambdaDecay, LinearLrWarmup,
+    MultiStepDecay, NaturalExpDecay, NoamDecay, PiecewiseDecay,
+    PolynomialDecay, ReduceLROnPlateau, StepDecay)
+
+__all__ = [
+    "guard", "enabled", "to_variable", "no_grad", "grad",
+    "save_dygraph", "load_dygraph", "prepare_context", "ParallelEnv",
+    "DataParallel", "BackwardStrategy", "TracedLayer", "declarative",
+    "dygraph_to_static_func", "Layer", "Sequential", "LayerList",
+    "ParameterList", "GRUUnit", "NCE", "PRelu", "TreeConv",
+]
+
+
+def enabled() -> bool:
+    return in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard: eager is this framework's default mode, so
+    the guard simply scopes the mode flag (and accepts a place for API
+    parity — device selection is global here)."""
+    from .framework import mode
+
+    prev = mode._static_mode
+    mode.disable_static()
+    try:
+        yield
+    finally:
+        mode._static_mode = prev
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    t = to_tensor(np.asarray(value) if not isinstance(
+        value, (Tensor, jnp.ndarray)) else value, dtype=dtype)
+    if name:
+        t.name = name
+    return t
+
+
+def save_dygraph(state_dict, model_path: str):
+    """reference dygraph/checkpoint.py save_dygraph: params ->
+    {path}.pdparams, optimizer state -> {path}.pdopt (detected by the
+    LR/accumulator keys optimizers put in their state dicts)."""
+    from .io.serialization import save
+
+    # optimizer state: accumulator keys use the name@slot convention, or
+    # carry non-tensor entries (LR scheduler state, step counters)
+    is_opt = any(
+        "@" in str(k) or k in ("LR_Scheduler", "global_step")
+        or not isinstance(v, (Tensor, jnp.ndarray, np.ndarray))
+        for k, v in state_dict.items())
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path: str):
+    """Returns (param_dict, opt_dict); a suffixed path
+    ({prefix}.pdparams / .pdopt) is accepted like the reference.
+    Raises when neither file exists (a typo'd path must not come back
+    as a silent (None, None))."""
+    import os
+
+    from .io.serialization import load
+
+    for suffix in (".pdparams", ".pdopt"):
+        if model_path.endswith(suffix):
+            model_path = model_path[:-len(suffix)]
+    params = opt = None
+    if os.path.exists(model_path + ".pdparams"):
+        params = load(model_path + ".pdparams")
+    if os.path.exists(model_path + ".pdopt"):
+        opt = load(model_path + ".pdopt")
+    if params is None and opt is None:
+        raise ValueError(
+            f"load_dygraph: neither {model_path}.pdparams nor "
+            f"{model_path}.pdopt exists")
+    return params, opt
+
+
+class BackwardStrategy:
+    """reference imperative BackwardStrategy: the single public knob is
+    sort_sum_gradient (deterministic gradient accumulation order). The
+    tape here accumulates in recorded order already — deterministic by
+    construction — so the flag is accepted and recorded."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
+def declarative(fn=None, **kwargs):
+    """@declarative / @dygraph_to_static_func: the fluid-era spellings
+    of @to_static."""
+    return to_static(fn, **kwargs) if fn is not None else to_static(**kwargs)
+
+
+dygraph_to_static_func = declarative
+
+
+class TracedLayer:
+    """reference jit/TracedLayer: capture a layer's forward with example
+    inputs into a compiled callable that can be saved as an inference
+    model. jit-traces the forward once (the XLA answer to
+    ProgramDescTracer)."""
+
+    def __init__(self, layer, compiled):
+        self._layer = layer
+        self._compiled = compiled
+
+    @staticmethod
+    def trace(layer, inputs):
+        from .jit import CompiledLayer
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        compiled = CompiledLayer(layer)
+        out = compiled(*inputs)
+        return out, TracedLayer(layer, compiled)
+
+    def __call__(self, *inputs):
+        return self._compiled(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None,
+                             input_spec=None):
+        from .jit import save as jit_save
+
+        example = getattr(self._compiled, "_example_inputs", None)
+        jit_save(self._layer, path, input_spec=input_spec or example)
+
+
+# -- fluid-only layers ------------------------------------------------------
+# forwards are @primitive-wrapped pure functions so they record on the
+# eager tape (plain jnp math would silently detach gradients)
+
+from .framework.op import primitive as _primitive  # noqa: E402
+
+
+@_primitive(name="gru_unit")
+def _gru_unit_fn(x, h_prev, w, b, hsz=0, origin_mode=False):
+    xu, xr, xc = (x[:, :hsz], x[:, hsz:2 * hsz], x[:, 2 * hsz:])
+    wu, wr, wc = (w[:, :hsz], w[:, hsz:2 * hsz], w[:, 2 * hsz:])
+    bu, br, bc = (b[0, :hsz], b[0, hsz:2 * hsz], b[0, 2 * hsz:])
+    update = jax_sigmoid(xu + h_prev @ wu + bu)
+    reset = jax_sigmoid(xr + h_prev @ wr + br)
+    reset_hidden = reset * h_prev
+    cand = jnp.tanh(xc + reset_hidden @ wc + bc)
+    if origin_mode:
+        new_h = update * h_prev + (1.0 - update) * cand
+    else:
+        new_h = (1.0 - update) * h_prev + update * cand
+    gate = jnp.concatenate([update, reset, cand], axis=1)
+    return new_h, reset_hidden, gate
+
+
+@_primitive(name="prelu_fluid")
+def _prelu_fn(x, a):
+    return jnp.where(x >= 0, x, a * x)
+
+
+@_primitive(name="tree_conv", nondiff=("edges",))
+def _tree_conv_fn(x, edges, w, b, output_size=0, num_filters=1,
+                  act="tanh"):
+    n = x.shape[1]
+    parent = edges[..., 0]
+    child = edges[..., 1]
+    valid = (parent >= 0) & (child >= 0)
+
+    def node_out(i):
+        is_mine = valid & (parent == i)              # (B, E)
+        cnt = jnp.maximum(jnp.sum(is_mine, axis=1), 1)
+        # eta_t=1 for the node itself; children mix left/right by
+        # position among siblings (continuous binary tree)
+        pos = jnp.cumsum(is_mine, axis=1) - 1
+        eta_r = jnp.where(cnt[:, None] > 1,
+                          pos / jnp.maximum(cnt[:, None] - 1, 1), 0.5)
+        eta_l = 1.0 - eta_r
+        cv = jnp.take_along_axis(
+            x, jnp.maximum(child, 0)[..., None], axis=1)  # (B, E, F)
+        mixed = (eta_l[..., None] * (cv @ w[1]) +
+                 eta_r[..., None] * (cv @ w[2]))
+        mixed = mixed * is_mine[..., None]
+        return x[:, i] @ w[0] + jnp.sum(mixed, axis=1) + b
+
+    out = jnp.stack([node_out(i) for i in range(n)], axis=1)
+    if act == "tanh":
+        out = jnp.tanh(out)
+    elif act == "relu":
+        out = jnp.maximum(out, 0)
+    return out.reshape(out.shape[0], n, output_size, num_filters)
+
+
+class GRUUnit(Layer):
+    """One GRU step as a layer (reference dygraph/nn.py GRUUnit over the
+    gru_unit op): (input (N, 3*H) projected x, hidden (N, H)) ->
+    (hidden', reset_hidden, gate)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        self.hidden_size = size // 3
+        h = self.hidden_size
+        self.weight = self.create_parameter([h, 3 * h], attr=param_attr,
+                                            dtype=dtype)
+        self.bias = self.create_parameter([1, 3 * h], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self.origin_mode = origin_mode
+
+    def forward(self, input, hidden):
+        return _gru_unit_fn(input, hidden, self.weight, self.bias,
+                            hsz=self.hidden_size,
+                            origin_mode=self.origin_mode)
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation loss layer (reference dygraph
+    nn.NCE over the nce op): delegates to the fluid functional nce."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__()
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.sampler = sampler
+        self.custom_dist = custom_dist
+        self.seed = seed
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter(
+            [num_total_classes], attr=bias_attr, dtype=dtype,
+            is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):
+        from .nn.functional import nce as _nce
+
+        return _nce(input, label, self.weight, bias=self.bias,
+                    num_neg_samples=self.num_neg_samples,
+                    sampler=self.sampler, seed=self.seed or None)
+
+
+class PRelu(Layer):
+    """fluid dygraph PRelu (mode all|channel|element) — wraps the
+    shared-weight prelu activation."""
+
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        self.mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            if channel is None:
+                raise ValueError("PRelu(mode='channel') needs channel=")
+            shape = [1, channel, 1, 1]
+        elif mode == "element":
+            if input_shape is None:
+                raise ValueError("PRelu(mode='element') needs input_shape=")
+            shape = [1] + list(input_shape)[1:]
+        else:
+            raise ValueError(f"unknown PRelu mode {mode!r}")
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, dtype=dtype,
+            default_initializer=nn.initializer.Constant(0.25))
+
+    def forward(self, x):
+        return _prelu_fn(x, self.weight)
+
+
+class TreeConv(Layer):
+    """Tree-based convolution (reference dygraph nn.TreeConv over the
+    tree_conv op; Mou et al., continuous binary tree kernels): patches
+    are (node, its direct children); three weight bases W_t/W_l/W_r are
+    mixed by the child's position eta, then max-pooled over the patch."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self.output_size = output_size
+        self.num_filters = num_filters
+        self.max_depth = max_depth
+        self.act = act
+        # (3 bases, F, output_size * num_filters)
+        self.weight = self.create_parameter(
+            [3, feature_size, output_size * num_filters], attr=param_attr,
+            dtype=dtype)
+        self.bias = self.create_parameter(
+            [1, output_size * num_filters], attr=bias_attr, dtype=dtype,
+            is_bias=True)
+
+    def forward(self, nodes_vector, edge_set):
+        return _tree_conv_fn(nodes_vector, edge_set, self.weight,
+                             self.bias, output_size=self.output_size,
+                             num_filters=self.num_filters, act=self.act)
+
+
+# distributed pieces re-exported from their real homes
+from .distributed import DataParallel  # noqa: F401,E402
+from .distributed.parallel import (  # noqa: F401,E402
+    ParallelEnv, prepare_context)
+from .framework.tape import no_grad  # noqa: F401,E402
+from .autograd import grad  # noqa: F401,E402

@@ -146,3 +146,17 @@ def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
     shape = (total,) + tuple(base_shape)
     data = np.random.randint(low, high + 1, shape).astype(np.int64)
     return create_lod_tensor(data, recursive_seq_lens, place)
+
+
+class LoDTensorArray(list):
+    """Tensor array (reference VarType.LOD_TENSOR_ARRAY + pybind
+    LoDTensorArray): a python-visible list of LoDTensors with the
+    reference's append semantics; static tensor_array ops operate on
+    the same structure inside the executor."""
+
+    def append(self, tensor):
+        if not isinstance(tensor, (LoDTensor, np.ndarray)) and not \
+                hasattr(tensor, "shape"):
+            raise TypeError(
+                f"LoDTensorArray holds tensors, got {type(tensor)!r}")
+        super().append(tensor)

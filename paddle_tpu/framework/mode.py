@@ -1,0 +1,39 @@
+"""Execution-mode flag (reference framework.py in_dygraph_mode /
+paddle.enable_static): eager ("dygraph") is the default; enable_static
+flips the advisory mode flag that in_dynamic_mode()/in_dygraph_mode()
+report. Static graph building itself is explicit here
+(static.program_guard), so the flag's job is API parity for the
+`paddle.enable_static()` header line and mode introspection."""
+from __future__ import annotations
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+# fluid spellings (enable_dygraph == disable_static)
+def enable_dygraph(place=None):
+    disable_static()
+
+
+def disable_dygraph():
+    enable_static()
+
+
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+in_dygraph_mode = in_dynamic_mode

@@ -60,3 +60,50 @@ from ..nn.clip import (ErrorClipByValue, GradientClipByGlobalNorm,  # noqa: F401
 
 from . import layers as nn  # noqa: F401  (static.nn.fc style access)
 from . import nets  # noqa: F401
+
+# fluid top-level long tail (audited by test_namespace_freeze "fluid")
+from ..framework.lod import LoDTensorArray  # noqa: F401,E402
+from ..framework.mode import (  # noqa: F401,E402
+    disable_dygraph, disable_imperative, enable_dygraph,
+    enable_imperative, in_dygraph_mode)
+from ..framework.tensor import Tensor as VarBase  # noqa: F401,E402
+from ..nn.layer import ParamAttr  # noqa: F401,E402
+from .fluid_compat import (  # noqa: F401,E402
+    DataFeedDesc, DistMultiTrainer, Generator, MultiTrainer,
+    PipelineTrainer, TrainerDesc, cpu_places, cuda_pinned_places,
+    cuda_places, device_guard, is_compiled_with_xpu, load_op_library,
+    memory_optimize, release_memory, require_version, xpu_places)
+from ..distributed.transpiler import HashName, RoundRobin  # noqa: F401,E402
+
+
+def __getattr__(name):
+    # fluid submodule addresses, resolved lazily: fluid.dygraph -> the
+    # eager shim, fluid.contrib -> {mixed_precision: amp, slim:
+    # quantization}, fluid.learning_rate_decay -> the schedule fns
+    import importlib
+    import sys
+    import types
+
+    if name == "dygraph":
+        return importlib.import_module("paddle_tpu.dygraph")
+    if name == "contrib":
+        mod = types.ModuleType("paddle_tpu.static.contrib")
+        mod.mixed_precision = importlib.import_module("paddle_tpu.amp")
+        mod.slim = importlib.import_module("paddle_tpu.quantization")
+        sys.modules[mod.__name__] = mod
+        setattr(sys.modules[__name__], "contrib", mod)
+        return mod
+    if name == "learning_rate_decay":
+        mod = types.ModuleType("paddle_tpu.static.learning_rate_decay")
+        from . import layers as _L
+
+        for n in ("exponential_decay", "natural_exp_decay",
+                  "inverse_time_decay", "polynomial_decay",
+                  "piecewise_decay", "noam_decay", "cosine_decay",
+                  "linear_lr_warmup"):
+            if hasattr(_L, n):
+                setattr(mod, n, getattr(_L, n))
+        sys.modules[mod.__name__] = mod
+        setattr(sys.modules[__name__], "learning_rate_decay", mod)
+        return mod
+    raise AttributeError(name)

@@ -1,0 +1,246 @@
+"""fluid top-level long tail (reference python/paddle/fluid/__init__.py
+__all__): place helpers, device_guard, the deprecated memory passes,
+Generator, DataFeedDesc, trainer-desc facades, and version checks. Each
+is the real capability under its fluid name — not a stub — wired to the
+TPU-native subsystems (framework.place, framework.random, io.dataset,
+executor.train_from_dataset)."""
+from __future__ import annotations
+
+import contextlib
+import warnings
+from typing import List, Optional
+
+from ..framework.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,
+                               TPUPlace)
+
+__all__ = [
+    "cpu_places", "cuda_places", "cuda_pinned_places", "xpu_places",
+    "device_guard", "memory_optimize", "release_memory", "Generator",
+    "DataFeedDesc", "TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+    "PipelineTrainer", "require_version", "load_op_library",
+    "is_compiled_with_xpu",
+]
+
+
+def cpu_places(device_count: Optional[int] = None) -> List[CPUPlace]:
+    if device_count is None:
+        import os
+
+        device_count = int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace()] * device_count
+
+
+def cuda_places(device_ids=None) -> List[CUDAPlace]:
+    """On this framework an accelerator place IS the TPU chip
+    (CUDAPlace subclasses TPUPlace for fluid-API parity)."""
+    import jax
+
+    if device_ids is None:
+        try:
+            device_ids = range(len(jax.devices()))
+        except Exception:
+            device_ids = [0]
+    return [CUDAPlace(int(i)) for i in device_ids]
+
+
+def cuda_pinned_places(device_count: Optional[int] = None):
+    return [CUDAPinnedPlace()] * (device_count or 1)
+
+
+def xpu_places(device_ids=None):
+    return [TPUPlace(int(i)) for i in (device_ids or [0])]
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """reference framework.py device_guard: ops appended inside the
+    scope carry an `op_device` attr (the pipeline transpiler's stage
+    assignment mechanism). The attr is recorded on the OpDesc; on a
+    single chip execution ignores it, and the pipeline builder reads it
+    back for stage splits."""
+    from . import ir
+
+    prev = getattr(ir, "_current_op_device", None)
+    ir._current_op_device = device
+    try:
+        yield
+    finally:
+        ir._current_op_device = prev
+
+
+def memory_optimize(input_program=None, skip_opt_set=None,
+                    print_log=False, level=0, skip_grads=True):
+    """Deprecated no-op, matching the reference v1.8 exactly
+    (fluid/transpiler/memory_optimization_transpiler.py warns and
+    returns): XLA buffer liveness analysis performs this role."""
+    warnings.warn(
+        "memory_optimize is deprecated and performs nothing; buffer "
+        "reuse is handled by the XLA compiler", DeprecationWarning)
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    warnings.warn(
+        "release_memory is deprecated and performs nothing",
+        DeprecationWarning)
+
+
+class Generator:
+    """RNG generator handle (reference framework/generator.cc): seeds
+    the framework PRNG stream."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._seed = 0
+
+    def manual_seed(self, seed: int):
+        from ..framework import random as random_mod
+
+        self._seed = int(seed)
+        random_mod.seed(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    seed = manual_seed
+
+
+class DataFeedDesc:
+    """reference fluid/data_feed_desc.py: wraps the protobuf-text slot
+    description consumed by the C++ DataFeed. Parses the proto text
+    into the SlotSpec list io.dataset uses, so a fluid-era desc file
+    drives the same native MultiSlot parser."""
+
+    def __init__(self, proto_file: str):
+        self.proto_desc = open(proto_file).read() if proto_file else ""
+        self._slots = self._parse(self.proto_desc)
+        self._batch = 32
+        self._pipe_command = ""
+
+    @staticmethod
+    def _parse(text: str):
+        from ..io.dataset import SlotSpec
+
+        slots, cur = [], {}
+        for raw in text.splitlines():
+            line = raw.strip()
+            if line.startswith("slots {") or line.startswith("slot {"):
+                cur = {}
+            elif line.startswith("name:"):
+                cur["name"] = line.split(":", 1)[1].strip().strip('"')
+            elif line.startswith("type:"):
+                cur["type"] = line.split(":", 1)[1].strip().strip('"')
+            elif line.startswith("is_dense:"):
+                cur["dense"] = "true" in line.split(":", 1)[1].lower()
+            elif line.startswith("shape:"):
+                cur.setdefault("shape", []).append(
+                    int(line.split(":", 1)[1]))
+            elif line.startswith("}") and cur.get("name"):
+                t = cur.get("type", "uint64")
+                dense_dim = (cur.get("shape") or [1])[0] \
+                    if cur.get("dense") else None
+                slots.append(SlotSpec(
+                    cur["name"],
+                    slot_type="float" if "float" in t else "uint64",
+                    dense_dim=dense_dim))
+                cur = {}
+        return slots
+
+    def slots(self):
+        use = getattr(self, "_use", None)
+        if use is not None:
+            return [s for s in self._slots if s.name in use]
+        return list(self._slots)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch = batch_size
+
+    def set_pipe_command(self, cmd: str):
+        self._pipe_command = cmd
+
+    def set_dense_slots(self, names):
+        for s in self._slots:
+            if s.name in names and s.dense_dim is None:
+                s.dense_dim = 1
+
+    def set_use_slots(self, names):
+        self._use = list(names)
+
+    def desc(self) -> str:
+        return self.proto_desc
+
+
+class TrainerDesc:
+    """Trainer configuration facade (reference trainer_desc.py): the
+    thread/device knobs executor.train_from_dataset consumes. The C++
+    thread-per-DeviceWorker machinery is subsumed by the compiled step
+    + ingestion producers (COVERAGE §2.1), so the desc carries the
+    run configuration rather than an op-loop program."""
+
+    _kind = "MultiTrainer"
+
+    def __init__(self):
+        self.thread_num = 1
+        self.device_worker = "Hogwild"
+        self.fleet_desc = None
+
+    def set_thread(self, n: int):
+        self.thread_num = int(n)
+
+    def set_device_worker(self, name: str):
+        self.device_worker = name
+
+    def set_fleet_desc(self, desc):
+        self.fleet_desc = desc
+
+
+class MultiTrainer(TrainerDesc):
+    _kind = "MultiTrainer"
+
+
+class DistMultiTrainer(TrainerDesc):
+    _kind = "DistMultiTrainer"
+
+
+class PipelineTrainer(TrainerDesc):
+    _kind = "PipelineTrainer"
+
+
+def require_version(min_version: str, max_version: Optional[str] = None):
+    """reference fluid/framework.py require_version: compare against
+    the installed version, raising on mismatch."""
+    import paddle_tpu
+
+    def parse(v):
+        import re
+
+        out = []
+        for p in str(v).split(".")[:3]:
+            m = re.match(r"\d+", p)   # '1rc0' / '1-dev' -> 1
+            if m:
+                out.append(int(m.group()))
+        return tuple(out)
+
+    cur = parse(getattr(paddle_tpu, "__version__", "0.0.0"))
+    if parse(min_version) > cur:
+        raise RuntimeError(
+            f"paddle_tpu {cur} does not satisfy minimum required "
+            f"version {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise RuntimeError(
+            f"paddle_tpu {cur} exceeds maximum supported version "
+            f"{max_version}")
+
+
+def load_op_library(lib_path: str):
+    """reference fluid/framework.py load_op_library (custom C++ op .so).
+    Custom native code plugs in through the C extension path here: the
+    library is dlopened for its side effects; kernels it registers via
+    the CPython API become visible to the op registry."""
+    import ctypes
+
+    return ctypes.CDLL(lib_path)

@@ -184,6 +184,12 @@ class Block:
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None):
         op = OpDesc(type, _normalize_slots(inputs), _normalize_slots(outputs),
                     attrs)
+        # fluid device_guard scope: record the stage/device assignment on
+        # the desc (reference framework.py op_device attr — the pipeline
+        # stage-split mechanism); single-chip execution ignores it
+        dev = globals().get("_current_op_device")
+        if dev is not None:
+            op.attrs["op_device"] = dev
         self.ops.append(op)
         self.program._version += 1
         return op

@@ -1,0 +1,230 @@
+"""Behavior checks for the fluid / fluid.dygraph / top-level surface
+fill (the names the extended namespace freeze exposed): these must
+compute, not just resolve."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.dygraph as dg
+import paddle_tpu.static as static
+
+
+def test_mode_flags_roundtrip():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+        assert not static.in_dygraph_mode()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    with dg.guard():
+        assert dg.enabled()
+
+
+def test_to_variable_and_manual_seed():
+    v = paddle.to_variable(np.ones((2, 2), np.float32), name="v0")
+    assert v.name == "v0" and tuple(v.shape) == (2, 2)
+    paddle.manual_seed(7)
+    a = paddle.randn([3])
+    paddle.manual_seed(7)
+    b = paddle.randn([3])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_gru_unit_matches_reference_formula():
+    paddle.seed(0)
+    unit = dg.GRUUnit(size=12)  # hidden 4
+    h = 4
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3 * h).astype(np.float32))
+    hp = paddle.to_tensor(rng.randn(2, h).astype(np.float32))
+    new_h, reset_h, gate = unit(x, hp)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    w = unit.weight.numpy()
+    b = unit.bias.numpy()
+    xv, hv = x.numpy(), hp.numpy()
+    u = sig(xv[:, :h] + hv @ w[:, :h] + b[0, :h])
+    r = sig(xv[:, h:2 * h] + hv @ w[:, h:2 * h] + b[0, h:2 * h])
+    c = np.tanh(xv[:, 2 * h:] + (r * hv) @ w[:, 2 * h:] + b[0, 2 * h:])
+    exp = (1 - u) * hv + u * c
+    np.testing.assert_allclose(new_h.numpy(), exp, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(reset_h.numpy(), r * hv, rtol=1e-5)
+
+
+def test_nce_layer_trains():
+    paddle.seed(0)
+    layer = dg.NCE(num_total_classes=50, dim=8, num_neg_samples=5, seed=3)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 50, (4, 1)).astype(np.int64))
+    loss = layer(x, y).sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_prelu_modes():
+    paddle.seed(0)
+    p = dg.PRelu(mode="all")
+    x = paddle.to_tensor(np.array([[-2.0, 3.0]], np.float32))
+    out = p(x)
+    np.testing.assert_allclose(out.numpy(), [[-0.5, 3.0]], rtol=1e-6)
+    pc = dg.PRelu(mode="channel", channel=2)
+    xc = paddle.to_tensor(np.ones((1, 2, 2, 2), np.float32) * -1)
+    np.testing.assert_allclose(pc(xc).numpy(), -0.25)
+    with pytest.raises(ValueError):
+        dg.PRelu(mode="element")
+
+
+def test_tree_conv_shapes_and_grad():
+    paddle.seed(0)
+    tc = dg.TreeConv(feature_size=6, output_size=5, num_filters=2)
+    rng = np.random.RandomState(0)
+    nodes = paddle.to_tensor(rng.randn(2, 4, 6).astype(np.float32))
+    # node 0 has children 1,2; node 1 has child 3
+    edges = paddle.to_tensor(np.asarray(
+        [[[0, 1], [0, 2], [1, 3], [-1, -1]]] * 2, np.int64))
+    out = tc(nodes, edges)
+    assert tuple(out.shape) == (2, 4, 5, 2)
+    out.sum().backward()
+    assert tc.weight.grad is not None
+    assert np.isfinite(tc.weight.grad.numpy()).all()
+
+
+def test_save_load_dygraph_roundtrip(tmp_path):
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    lin = nn.Linear(3, 2)
+    path = str(tmp_path / "ckpt")
+    dg.save_dygraph(lin.state_dict(), path)
+    assert os.path.exists(path + ".pdparams")
+    params, opt = dg.load_dygraph(path)
+    assert opt is None
+    np.testing.assert_array_equal(np.asarray(params["weight"]),
+                                  lin.weight.numpy())
+
+
+def test_traced_layer_runs_and_saves(tmp_path):
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    out, traced = dg.TracedLayer.trace(lin, [x])
+    out2 = traced(x)
+    np.testing.assert_allclose(np.asarray(out2.numpy()),
+                               np.asarray(out.numpy()), rtol=1e-6)
+
+
+def test_device_guard_records_op_device():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 2])
+        with static.device_guard("gpu:1"):
+            y = static.nn.fc(x, size=2)
+    devices = [op.attrs.get("op_device") for op in main.global_block.ops]
+    assert "gpu:1" in devices
+
+
+def test_datafeed_desc_parses_proto_text(tmp_path):
+    proto = tmp_path / "feed.prototxt"
+    proto.write_text("""
+name: "MultiSlotDataFeed"
+batch_size: 2
+multi_slot_desc {
+  slots {
+    name: "words"
+    type: "uint64"
+    is_dense: false
+    is_used: true
+  }
+  slots {
+    name: "label"
+    type: "float"
+    is_dense: true
+    shape: 1
+    is_used: true
+  }
+}
+""")
+    desc = static.DataFeedDesc(str(proto))
+    slots = desc.slots()
+    assert [s.name for s in slots] == ["words", "label"]
+    assert slots[0].type == "uint64" and slots[0].dense_dim is None
+    assert slots[1].type == "float" and slots[1].dense_dim == 1
+
+
+def test_trainer_descs_and_dispatchers():
+    td = static.DistMultiTrainer()
+    td.set_thread(4)
+    assert td.thread_num == 4 and td._kind == "DistMultiTrainer"
+    rr = static.RoundRobin(["a:1", "b:2"])
+    assert rr.dispatch(["v1", "v2", "v3"]) == ["a:1", "b:2", "a:1"]
+    hn = static.HashName(["a:1", "b:2"])
+    one = hn.dispatch(["w"] )
+    assert hn.dispatch(["w"]) == one  # stable
+
+
+def test_memory_passes_warn_noop():
+    with pytest.warns(DeprecationWarning):
+        static.memory_optimize(None)
+    with pytest.warns(DeprecationWarning):
+        static.release_memory(None)
+
+
+def test_generator_and_require_version():
+    g = static.Generator().manual_seed(5)
+    assert g.initial_seed() == 5
+    static.require_version("0.0.1")
+    with pytest.raises(RuntimeError):
+        static.require_version("999.0.0")
+
+
+def test_lod_tensor_array():
+    arr = static.LoDTensorArray()
+    arr.append(np.ones((2, 2)))
+    assert len(arr) == 1
+    with pytest.raises(TypeError):
+        arr.append("nope")
+
+
+def test_save_dygraph_optimizer_state_suffix(tmp_path):
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "opt")
+    # all-tensor accumulator dict: the @slot key convention must still
+    # route to .pdopt (review finding)
+    dg.save_dygraph({"linear_0.w_0@velocity_0": jnp.ones(3)}, path)
+    assert os.path.exists(path + ".pdopt")
+    params, opt = dg.load_dygraph(path)
+    assert params is None and opt is not None
+
+
+def test_load_dygraph_suffixed_path_and_missing(tmp_path):
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    lin = nn.Linear(2, 2)
+    path = str(tmp_path / "m")
+    dg.save_dygraph(lin.state_dict(), path)
+    params, _ = dg.load_dygraph(path + ".pdparams")  # suffixed accepted
+    assert params is not None
+    with pytest.raises(ValueError, match="neither"):
+        dg.load_dygraph(str(tmp_path / "nope"))
+
+
+def test_datafeed_desc_use_slots_filters(tmp_path):
+    proto = tmp_path / "f.prototxt"
+    proto.write_text('slots {\n name: "a"\n type: "uint64"\n}\n'
+                     'slots {\n name: "b"\n type: "float"\n is_dense: '
+                     'true\n shape: 1\n}\n')
+    desc = static.DataFeedDesc(str(proto))
+    desc.set_use_slots(["b"])
+    assert [s.name for s in desc.slots()] == ["b"]

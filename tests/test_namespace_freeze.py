@@ -19,8 +19,12 @@ _DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data",
 with open(_DATA) as f:
     FREEZE = json.load(f)
 
-# reference namespace -> our module that carries that surface
+# reference namespace -> our module(s) that carry that surface (tuples
+# are unions: the name must resolve on at least one)
 TARGETS = {
+    "paddle": "paddle_tpu",
+    "fluid": ("paddle_tpu.static", "paddle_tpu", "paddle_tpu.distributed"),
+    "fluid.dygraph": ("paddle_tpu.dygraph",),
     "fluid.layers": "paddle_tpu.static.layers",
     "nn": "paddle_tpu.nn",
     "nn.functional": "paddle_tpu.nn.functional",
@@ -44,21 +48,39 @@ TARGETS = {
 
 # Documented exclusions: names that are deliberate non-goals, each with
 # the reason. Keep this list SHORT — anything here is a visible gap.
-EXCLUDED: dict = {}
+EXCLUDED: dict = {
+    "paddle": {
+        "check_import_scipy": "reference-internal import workaround for "
+                              "a Windows scipy DLL issue",
+        "monkey_patch_variable": "reference-internal bootstrap hook "
+                                 "(math ops are patched at import here)",
+        "monkey_patch_math_varbase": "reference-internal bootstrap hook",
+        "ComplexTensor": "complex dtypes ride Tensor natively (jax "
+                         "complex64/128); no separate wrapper type",
+    },
+    "fluid": {
+        "ComplexVariable": "complex dtypes ride Tensor natively",
+        "HeterXpuTrainer": "heterogeneous CPU/XPU PS is a documented "
+                           "non-goal (Baidu-internal hardware split)",
+    },
+}
 
 
 @pytest.mark.parametrize("ns", sorted(FREEZE))
 def test_namespace_surface_complete(ns):
     names = FREEZE[ns]
     assert names, f"freeze data for {ns} is empty — regenerate"
-    target = TARGETS[ns]
-    mod = importlib.import_module(target)
+    targets = TARGETS[ns]
+    if isinstance(targets, str):
+        targets = (targets,)
+    mods = [importlib.import_module(t) for t in targets]
     excluded = EXCLUDED.get(ns, {})
     missing = [n for n in names
-               if n not in excluded and not hasattr(mod, n)]
+               if n not in excluded
+               and not any(hasattr(m, n) for m in mods)]
     assert not missing, (
         f"{len(missing)}/{len(names)} reference {ns} names missing on "
-        f"{target}: {missing}")
+        f"{targets}: {missing}")
 
 
 def test_freeze_counts_pinned():
@@ -71,6 +93,7 @@ def test_freeze_counts_pinned():
         "incubate": 11, "incubate.hapi": 10, "io": 23, "static": 21,
         "utils": 3, "fluid.metrics": 9, "fluid.initializer": 16,
         "fluid.regularizer": 4, "fluid.clip": 5, "fluid.optimizer": 27,
+        "paddle": 202, "fluid": 76, "fluid.dygraph": 57,
     }
     for ns, n in expected_min.items():
         assert len(FREEZE[ns]) >= n, (ns, len(FREEZE[ns]), n)

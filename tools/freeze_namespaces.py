@@ -169,6 +169,31 @@ AGGREGATE_DIRS = {
     "tensor": "tensor",
 }
 
+# namespaces with aggregated __all__ handled by extract_all directly
+EXTRA = {
+    "fluid": "fluid/__init__.py",
+    "fluid.dygraph": "fluid/dygraph/__init__.py",
+}
+
+
+def extract_toplevel_imports(path: str):
+    """The top-level `paddle` surface: python/paddle/__init__.py has no
+    __all__ — its public names are the from-import aliases (198
+    #DEFINE_ALIAS rows plus framework/device/hapi imports)."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    seen, names = set(), []
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                n = alias.asname or alias.name
+                if n.startswith("_") or n == "*":
+                    continue
+                if n not in seen:
+                    seen.add(n)
+                    names.append(n)
+    return names
+
 
 def main():
     freeze = {}
@@ -189,6 +214,12 @@ def main():
                     agg.append(n)
         freeze[ns] = agg
         print(f"{ns}: {len(agg)} names (dir aggregate)")
+    for ns, rel in EXTRA.items():
+        freeze[ns] = extract_all(os.path.join(REF, rel))
+        print(f"{ns}: {len(freeze[ns])} names")
+    freeze["paddle"] = extract_toplevel_imports(
+        os.path.join(REF, "__init__.py"))
+    print(f"paddle (top-level): {len(freeze['paddle'])} names")
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
         json.dump(freeze, f, indent=1, sort_keys=True)
