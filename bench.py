@@ -187,6 +187,10 @@ def bench_bert(seq=128, smoke=False):
     counts = delta(counters_before)
     if pallas_eligible and not pallas_fallback:
         pallas_fallback = counts.get("flash_attention.pallas", 0) == 0
+    from paddle_tpu.ops.pallas.autotune import cached_choices
+
+    autotuned = {"x".join(map(str, k[:4])) + f"/causal={k[5]}/p={k[6]}": v
+                 for k, v in cached_choices().items()}
     return {
         "value": tokens / dt, "unit": "tokens/s",
         "flops_per_step": flops_per_step,
@@ -194,6 +198,7 @@ def bench_bert(seq=128, smoke=False):
         "batch": batch, "seq": seq, "layers": L,
         "pallas_fallback": pallas_fallback,
         "pallas_counters": counts,
+        "flash_autotune": autotuned,
     }
 
 
