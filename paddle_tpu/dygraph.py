@@ -136,9 +136,10 @@ class TracedLayer:
     model. jit-traces the forward once (the XLA answer to
     ProgramDescTracer)."""
 
-    def __init__(self, layer, compiled):
+    def __init__(self, layer, compiled, example_inputs):
         self._layer = layer
         self._compiled = compiled
+        self._example_inputs = example_inputs
 
     @staticmethod
     def trace(layer, inputs):
@@ -147,7 +148,7 @@ class TracedLayer:
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         compiled = CompiledLayer(layer)
         out = compiled(*inputs)
-        return out, TracedLayer(layer, compiled)
+        return out, TracedLayer(layer, compiled, list(inputs))
 
     def __call__(self, *inputs):
         return self._compiled(*inputs)
@@ -156,8 +157,8 @@ class TracedLayer:
                              input_spec=None):
         from .jit import save as jit_save
 
-        example = getattr(self._compiled, "_example_inputs", None)
-        jit_save(self._layer, path, input_spec=input_spec or example)
+        jit_save(self._layer, path,
+                 input_spec=input_spec or self._example_inputs)
 
 
 # -- fluid-only layers ------------------------------------------------------

@@ -121,6 +121,12 @@ def test_traced_layer_runs_and_saves(tmp_path):
     out2 = traced(x)
     np.testing.assert_allclose(np.asarray(out2.numpy()),
                                np.asarray(out.numpy()), rtol=1e-6)
+    # the StableHLO export round-trips through jit.load
+    path = str(tmp_path / "traced")
+    traced.save_inference_model(path)
+    loaded = paddle.jit.load(path)
+    y = loaded(x.numpy())
+    np.testing.assert_allclose(np.asarray(y), out.numpy(), rtol=1e-5)
 
 
 def test_device_guard_records_op_device():
