@@ -48,22 +48,30 @@ PROBE_SUCCESS_TTL = float(
     os.environ.get("PADDLE_TPU_PROBE_SUCCESS_TTL", "60"))
 
 
-def _probe_cache_path() -> str:
-    p = os.environ.get("PADDLE_TPU_PROBE_CACHE")
-    if p:
-        return p
-    # a per-user 0700 cache dir, NOT a predictable world-writable /tmp
-    # name: the verdict steers backend selection, so another local user
-    # must not be able to plant one
+def cache_dir() -> str:
+    """The per-user 0700 paddle_tpu cache dir (probe verdicts, autotune
+    winners), NOT a predictable world-writable /tmp name: the contents
+    steer backend selection and kernel dispatch, so another local user
+    must not be able to plant them. Falls back to tempdir when the home
+    cache is unwritable."""
     try:
         cache_root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
             os.path.expanduser("~"), ".cache")
         d = os.path.join(cache_root, "paddle_tpu")
         os.makedirs(d, mode=0o700, exist_ok=True)
-        return os.path.join(d, "probe.json")
+        return d
     except Exception:
-        return os.path.join(tempfile.gettempdir(),
-                            f"paddle_tpu_probe_{os.getuid()}.json")
+        return tempfile.gettempdir()
+
+
+def _probe_cache_path() -> str:
+    p = os.environ.get("PADDLE_TPU_PROBE_CACHE")
+    if p:
+        return p
+    d = cache_dir()
+    if d == tempfile.gettempdir():
+        return os.path.join(d, f"paddle_tpu_probe_{os.getuid()}.json")
+    return os.path.join(d, "probe.json")
 
 
 def _cache_relevant_env() -> dict:

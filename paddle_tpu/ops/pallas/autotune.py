@@ -18,7 +18,10 @@ lessons). Any failure falls back to the static dispatch.
 """
 from __future__ import annotations
 
+import json
+import os
 import sys
+import tempfile
 from typing import Dict
 
 from ...framework.flags import define_flag, get_flag
@@ -32,13 +35,88 @@ define_flag("flash_autotune", True,
 _cache: Dict[tuple, str] = {}
 _ITERS = 8
 
+# Verdicts persist across processes (the reference's cudnn algo cache is
+# process-local, but here every re-probe burns scarce tunnel minutes —
+# VERDICT r4 weak #5). One JSON file per device kind beside the backend
+# probe cache; write-through on every new verdict.
+_disk: Dict[str, str] | None = None
+_stats = {"mem_hits": 0, "disk_hits": 0, "timed": 0}
+
+
+def _cache_dir() -> str:
+    p = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE_DIR")
+    if p:
+        return p
+    from ...framework.bringup import cache_dir
+
+    return cache_dir()
+
+
+def _disk_path() -> str:
+    import jax
+
+    kind = jax.devices()[0].device_kind.replace(" ", "_").replace("/", "_")
+    return os.path.join(_cache_dir(), f"autotune_{kind}.json")
+
+
+def _disk_key(key: tuple) -> str:
+    return "|".join(str(p) for p in key)
+
+
+def _load_disk() -> Dict[str, str]:
+    global _disk
+    if _disk is None:
+        try:
+            with open(_disk_path()) as f:
+                _disk = {str(k): str(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            _disk = {}
+    return _disk
+
+
+def _save_disk() -> None:
+    # merge-then-replace: re-read the file so a concurrent process's
+    # fresh verdicts survive (lost-update), and os.replace keeps the
+    # file itself atomic (torn-write)
+    global _disk
+    try:
+        path = _disk_path()
+        try:
+            with open(path) as f:
+                on_disk = {str(k): str(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            on_disk = {}
+        merged = {**on_disk, **(_disk or {})}
+        _disk = merged
+        os.makedirs(_cache_dir(), mode=0o700, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=_cache_dir(), suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(merged, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        sys.stderr.write(f"flash autotune: cache persist failed ({e})\n")
+
 
 def cached_choices() -> Dict[tuple, str]:
     return dict(_cache)
 
 
-def reset() -> None:
+def stats() -> Dict[str, int]:
+    """Hit/miss counters for bench rows: 'timed' is the number of
+    on-chip timing rounds this process actually paid for."""
+    return dict(_stats)
+
+
+def reset(disk: bool = False) -> None:
+    global _disk
     _cache.clear()
+    _stats.update(mem_hits=0, disk_hits=0, timed=0)
+    _disk = None
+    if disk:
+        try:
+            os.remove(_disk_path())
+        except OSError:
+            pass
 
 
 def best_short_window_impl(b, l, h, d, dtype, causal,
@@ -48,10 +126,18 @@ def best_short_window_impl(b, l, h, d, dtype, causal,
     only be called with _short_ok shapes on a TPU backend."""
     key = (b, l, h, d, str(dtype), bool(causal), round(float(dropout_p), 4))
     if key in _cache:
+        _stats["mem_hits"] += 1
         return _cache[key]
 
     import jax
     import jax.numpy as jnp
+
+    disk = _load_disk()
+    hit = disk.get(_disk_key(key))
+    if hit in ("short", "stream", "xla"):
+        _stats["disk_hits"] += 1
+        _cache[key] = hit
+        return hit
 
     from ...utils.timing import timeit
     from . import flash_attention as fa
@@ -113,7 +199,10 @@ def best_short_window_impl(b, l, h, d, dtype, causal,
         f"(b={b} l={l} h={h} d={d} causal={causal} p={dropout_p}): "
         + " ".join(f"{n}={t:.3f}ms" for n, t in sorted(times.items()))
         + f" -> {winner}\n")
+    _stats["timed"] += 1
     _cache[key] = winner
+    disk[_disk_key(key)] = winner
+    _save_disk()
     return winner
 
 

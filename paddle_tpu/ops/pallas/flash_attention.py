@@ -67,6 +67,16 @@ def _dot(a, b, trans_b=False):
     return jax.lax.dot_general(a, b, dims, preferred_element_type=_F32)
 
 
+def _sds(shape, dtype, ref):
+    """ShapeDtypeStruct for pallas_call out_shape that inherits `ref`'s
+    varying-manual-axes type: under shard_map (the flash-ring path)
+    check_vma requires outputs to declare how they vary over the mesh."""
+    vma = getattr(jax.typeof(ref), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _keep_mask(seed, row, qi, j, shape, dropout_p):
     """Regenerable per-tile dropout keep-mask from the TPU hardware PRNG.
     Seeding with (seed, row, q_tile, kv_tile) makes the mask a pure
@@ -303,8 +313,8 @@ def _fwd_call(qm, km, vm, causal, block_q, block_kv, sm_scale,
             pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, ql, d), qm.dtype),
-            jax.ShapeDtypeStruct((bh, 1, ql), _F32),
+            _sds((bh, ql, d), qm.dtype, qm),
+            _sds((bh, 1, ql), _F32, qm),
         ],
     )(*operands)
     return out, lse
@@ -356,7 +366,7 @@ def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
         grid=(bh, ql // block_q),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, ql, d), qm.dtype),
+        out_shape=_sds((bh, ql, d), qm.dtype, qm),
     )(*dq_ops)
 
     dkv_specs = [
@@ -388,8 +398,8 @@ def _bwd_call(qm, km, vm, dom, lse, delta, causal, block_q, block_kv,
             pl.BlockSpec((None, block_kv, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, kl, d), km.dtype),
-            jax.ShapeDtypeStruct((bh, kl, d), vm.dtype),
+            _sds((bh, kl, d), km.dtype, qm),
+            _sds((bh, kl, d), vm.dtype, qm),
         ],
     )(*dkv_ops)
     return dq, dk, dv

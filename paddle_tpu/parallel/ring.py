@@ -38,15 +38,214 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+from ..framework.flags import define_flag, get_flag
 from .mesh import get_mesh
 
 _NEG_INF = -1e30
+
+define_flag("ring_flash", True,
+            "Route each ring-attention step's local block compute through "
+            "the Pallas flash kernel (SURVEY hard part f). Eligible shapes "
+            "only; False keeps the einsum online-softmax walk everywhere "
+            "(the A/B arm for tools/live_tpu_session.py)")
 
 
 def _axis_size(axis_name):
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+# Tests flip this to run interpret-mode Pallas under shard_map: the hlo
+# interpreter evaluates kernel bodies as jax ops, where kernel-internal
+# constants carry empty vma and trip check_vma (jax 0.9 rough edge).
+# Real Mosaic lowering never vma-types kernel internals.
+_SHARD_MAP_CHECK_VMA = [True]
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    kw = {} if _SHARD_MAP_CHECK_VMA[0] else {"check_vma": False}
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kw)
+
+
+# ---------------------------------------------------------------------------
+# flash-ring: the ring walk's local block compute routed through the Pallas
+# flash kernels (SURVEY.md hard part f: "ring attention as a Pallas
+# flash-attention kernel with ppermute KV rotation"). Forward runs the
+# streaming flash FORWARD kernel on each arriving KV block and merges the
+# normalized block outputs by their logsumexp; backward re-walks the ring
+# calling the flash dq/dkv kernels with the GLOBAL lse (the standard flash
+# decomposition: p = exp(s - lse_global) is the true probability, so each
+# block's dq/dk/dv contribution is exact), rotating each block's dk/dv
+# accumulators around the ring WITH the block so they arrive home after a
+# full circle.
+# ---------------------------------------------------------------------------
+
+
+def _ring_flash_eligible(q, k, is_causal):
+    """Static-shape gate for the flash-ring path (per-device shards)."""
+    from ..framework.bringup import pallas_enabled
+
+    try:
+        if not get_flag("ring_flash"):
+            return False
+    except KeyError:
+        pass
+    if not pallas_enabled():
+        return False
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    # kernel tile modulus 128, head_dim lane modulus 64; causal block
+    # classification below (before/diagonal/after) assumes equal shards
+    return (lq % 128 == 0 and lk % 128 == 0 and lq >= 128 and lk >= 128
+            and d % 64 == 0 and d <= 256 and (not is_causal or lq == lk))
+
+
+def _ring_branch(origin, idx, is_causal, bias, masked):
+    """0 = skip, 1 = full block, 2 = diagonal (in-block causal mask).
+
+    With equal shards, block `origin` is entirely before the local Q
+    block iff origin < idx (full), entirely after iff origin > idx
+    (skip under causal). Mask-empty blocks are skipped outright."""
+    if is_causal:
+        branch = jnp.where(origin > idx, 0,
+                           jnp.where(origin == idx, 2, 1))
+    else:
+        branch = jnp.ones((), jnp.int32)
+    if masked:
+        branch = jnp.where(jnp.any(bias > -1e29), branch, 0)
+    return branch
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_flash(q, k, v, kv_bias, axis_name, axis_size, is_causal, masked):
+    out, _ = _ring_flash_fwd(q, k, v, kv_bias, axis_name, axis_size,
+                             is_causal, masked)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, kv_bias, axis_name, axis_size, is_causal,
+                    masked):
+    from ..ops.pallas.flash_attention import (_fwd_call, _mergeheads,
+                                              _pick_blocks, _splitheads)
+
+    size = axis_size
+    idx = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    sm_scale = 1.0 / math.sqrt(d)
+    bq, bkv = _pick_blocks(lq, lk, 512, 512)
+    qm, km, vm = _mergeheads(q), _mergeheads(k), _mergeheads(v)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def merge(acc, lse, out_b, lse_b):
+        # both partials are normalized over disjoint key sets: combine
+        # with logsumexp weights (numerically the online-softmax rescale)
+        new = jnp.logaddexp(lse, lse_b)                  # (bh, 1, lq)
+        w_old = jnp.exp(lse - new)[:, 0, :, None]        # (bh, lq, 1)
+        w_new = jnp.exp(lse_b - new)[:, 0, :, None]
+        return acc * w_old + out_b.astype(jnp.float32) * w_new, new
+
+    def step_update(s, acc, lse, kc, vc, bc):
+        origin = jnp.mod(idx - s, size)
+
+        def compute(causal):
+            mb = bc[:, None, :] if masked else None
+            out_b, lse_b = _fwd_call(qm, kc, vc, causal, bq, bkv,
+                                     sm_scale, mask_bias=mb, heads=h)
+            return merge(acc, lse, out_b, lse_b)
+
+        branch = _ring_branch(origin, idx, is_causal, bc, masked)
+        return jax.lax.switch(branch, (lambda: (acc, lse),
+                                       lambda: compute(False),
+                                       lambda: compute(True)))
+
+    def body(s, carry):
+        acc, lse, kc, vc, bc = carry
+        acc, lse = step_update(s, acc, lse, kc, vc, bc)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        if masked:
+            bc = jax.lax.ppermute(bc, axis_name, perm)
+        return acc, lse, kc, vc, bc
+
+    # carries derive from inputs (0*x) for shard_map's vma typing; lse in
+    # f32 at the kernels' -1e30 floor (finite: logaddexp/exp stay NaN-free
+    # even for fully-masked rows)
+    acc0 = (0.0 * qm).astype(jnp.float32)
+    lse0 = (0.0 * qm[..., 0]).astype(jnp.float32)[:, None, :] + _NEG_INF
+    bc0 = kv_bias if masked else jnp.zeros((), jnp.float32)
+    # last block needs no rotation afterwards: size-1 rotations, final
+    # fold outside the loop (saves one ICI hop)
+    acc, lse, kc, vc, bc = jax.lax.fori_loop(
+        0, size - 1, body, (acc0, lse0, km, vm, bc0))
+    acc, lse = step_update(size - 1, acc, lse, kc, vc, bc)
+    out_m = acc.astype(q.dtype)
+    return (_splitheads(out_m, b, h),
+            (qm, km, vm, out_m, lse, kv_bias, b, h))
+
+
+def _ring_flash_bwd(axis_name, axis_size, is_causal, masked, res, dout):
+    from ..ops.pallas.flash_attention import (_bwd_call, _mergeheads,
+                                              _pick_blocks, _splitheads)
+
+    qm, km, vm, out_m, lse, kv_bias, b, h = res
+    size = axis_size
+    idx = jax.lax.axis_index(axis_name)
+    bh, lq, d = qm.shape
+    lk = km.shape[1]
+    sm_scale = 1.0 / math.sqrt(d)
+    bq, bkv = _pick_blocks(lq, lk, 512, 512)
+    # constant-cotangent Mosaic guard, as in the single-device bwd paths
+    dom = _mergeheads(jax.lax.optimization_barrier(dout))
+    delta = jnp.sum(dom.astype(jnp.float32) * out_m.astype(jnp.float32),
+                    axis=-1)[:, None, :]                 # (bh, 1, lq)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def step(s, dq, dkc, dvc, kc, vc, bc):
+        origin = jnp.mod(idx - s, size)
+
+        def compute(causal):
+            mb = bc[:, None, :] if masked else None
+            dqb, dkb, dvb = _bwd_call(qm, kc, vc, dom, lse, delta, causal,
+                                      bq, bkv, sm_scale, mask_bias=mb,
+                                      heads=h)
+            return (dq + dqb.astype(jnp.float32),
+                    dkc + dkb.astype(jnp.float32),
+                    dvc + dvb.astype(jnp.float32))
+
+        branch = _ring_branch(origin, idx, is_causal, bc, masked)
+        return jax.lax.switch(branch, (lambda: (dq, dkc, dvc),
+                                       lambda: compute(False),
+                                       lambda: compute(True)))
+
+    def body(s, carry):
+        dq, dkc, dvc, kc, vc, bc = carry
+        dq, dkc, dvc = step(s, dq, dkc, dvc, kc, vc, bc)
+        # each block's grad accumulators travel WITH the block: after a
+        # full circle (size process+rotate iterations) dk/dv are home
+        dkc = jax.lax.ppermute(dkc, axis_name, perm)
+        dvc = jax.lax.ppermute(dvc, axis_name, perm)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        if masked:
+            bc = jax.lax.ppermute(bc, axis_name, perm)
+        return dq, dkc, dvc, kc, vc, bc
+
+    dq0 = (0.0 * qm).astype(jnp.float32)
+    dk0 = (0.0 * km).astype(jnp.float32)
+    dv0 = (0.0 * vm).astype(jnp.float32)
+    bc0 = kv_bias if masked else jnp.zeros((), jnp.float32)
+    dq, dk, dv, _, _, _ = jax.lax.fori_loop(
+        0, size, body, (dq0, dk0, dv0, km, vm, bc0))
+    return (_splitheads(dq.astype(qm.dtype), b, h),
+            _splitheads(dk.astype(km.dtype), b, h),
+            _splitheads(dv.astype(vm.dtype), b, h),
+            jnp.zeros_like(kv_bias))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -66,8 +265,32 @@ def ring_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     (True = attend). It rides the ring with its K/V block, so padded keys
     are masked at block granularity without materialising a global
     (B, L, L) mask. Rows whose every key is padded produce zeros.
+
+    Eligible shapes route each block's compute through the Pallas flash
+    kernels (_ring_flash, FLAGS_ring_flash); the einsum online-softmax
+    walk below is the exact fallback for everything else.
     """
     size = axis_size if axis_size is not None else _axis_size(axis_name)
+    if _ring_flash_eligible(q, k, is_causal):
+        from ..ops.pallas.counters import bump
+
+        try:
+            bias = (jnp.where(kv_mask.astype(jnp.bool_), 0.0,
+                              _NEG_INF).astype(jnp.float32)
+                    if kv_mask is not None else jnp.zeros((), jnp.float32))
+            out = _ring_flash(q, k, v, bias, axis_name, size, is_causal,
+                              kv_mask is not None)
+            bump("ring_attention", "pallas")
+            return out
+        except Exception as e:  # trace/lowering failure: exact fallback
+            bump("ring_attention", "xla",
+                 f"flash-ring error {type(e).__name__}: {e}")
+    else:
+        from ..ops.pallas.counters import bump
+
+        bump("ring_attention", "xla",
+             f"dispatch ineligible (q {tuple(q.shape)}, causal="
+             f"{is_causal}; modulus/shape gate in _ring_flash_eligible)")
     idx = jax.lax.axis_index(axis_name)
 
     orig_dtype = q.dtype
@@ -276,17 +499,15 @@ def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
     fn = functools.partial(local, axis_name=seq_axis, is_causal=is_causal,
                            axis_size=size)
     if kv_mask is None:
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec)(q, k, v)
+        return _shard_map(fn, mesh, (spec, spec, spec), spec)(q, k, v)
     kv_mask = jnp.asarray(kv_mask)
     # ring: the mask shard travels with its kv block; ulysses: every
     # device needs the full kv axis after the all-to-all -> replicated
     mspec = (PartitionSpec(ba, seq_axis) if impl == "ring"
              else PartitionSpec(ba, None))
     wrapped = lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_)  # noqa: E731
-    return jax.shard_map(wrapped, mesh=mesh,
-                         in_specs=(spec, spec, spec, mspec),
-                         out_specs=spec)(q, k, v, kv_mask)
+    return _shard_map(wrapped, mesh,
+                      (spec, spec, spec, mspec), spec)(q, k, v, kv_mask)
 
 
 ulysses_attention = functools.partial(ring_attention, impl="ulysses")

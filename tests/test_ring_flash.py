@@ -1,0 +1,191 @@
+"""Flash-ring attention: the ring walk's local block compute routed
+through the Pallas flash kernels (VERDICT r4 #3; SURVEY hard part f).
+
+Kernels run in interpret mode on the virtual 8-device CPU mesh; ground
+truth is the single-device XLA attention AND the einsum online-softmax
+ring path (the exact A/B the live TPU session times). Counters assert
+dispatch truth — a test that silently fell back to the einsum walk
+would prove nothing.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.framework.bringup as bringup
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.ops.pallas import counters
+from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+from paddle_tpu.parallel import create_mesh, ring_attention, set_mesh
+from paddle_tpu.parallel.mesh import _global_mesh
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture
+def flash_ring(monkeypatch):
+    """Interpret-mode Pallas + forced eligibility (CPU backend)."""
+    from jax.experimental import pallas as pl
+
+    import paddle_tpu.parallel.ring as ring_mod
+
+    real = pl.pallas_call
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(real, interpret=True))
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    # the hlo interpreter can't vma-type kernel internals (see
+    # ring._SHARD_MAP_CHECK_VMA); real Mosaic lowering keeps the check
+    monkeypatch.setattr(ring_mod, "_SHARD_MAP_CHECK_VMA", [False])
+    counters.reset()
+    yield
+    counters.reset()
+
+
+@pytest.fixture
+def mesh_sp4():
+    mesh = create_mesh({"sp": 4})
+    prev = _global_mesh[0]
+    set_mesh(mesh)
+    yield mesh
+    _global_mesh[0] = prev
+
+
+def _qkv(b=1, l=512, h=2, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, l, h, d) * 0.5, jnp.float32)
+                 for _ in range(3))
+
+
+def _assert_pallas_engaged():
+    snap = counters.snapshot()
+    assert snap.get("ring_attention.pallas", 0) >= 1, (
+        f"flash-ring did not engage: {snap}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_matches_reference(flash_ring, mesh_sp4, causal):
+    q, k, v = _qkv()
+    ref = _xla_attention(q, k, v, None, 0.0, causal, None)
+    out = ring_attention(q, k, v, mesh=mesh_sp4, is_causal=causal)
+    _assert_pallas_engaged()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_matches_einsum_ring(flash_ring, mesh_sp4, causal):
+    """The exact A/B tools/live_tpu_session.py times on hardware:
+    FLAGS_ring_flash on/off must agree numerically."""
+    q, k, v = _qkv(seed=3)
+    out_flash = ring_attention(q, k, v, mesh=mesh_sp4, is_causal=causal)
+    _assert_pallas_engaged()
+    set_flags({"ring_flash": False})
+    try:
+        out_einsum = ring_attention(q, k, v, mesh=mesh_sp4,
+                                    is_causal=causal)
+    finally:
+        set_flags({"ring_flash": True})
+    np.testing.assert_allclose(np.asarray(out_flash),
+                               np.asarray(out_einsum),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_ring_grads_match(flash_ring, mesh_sp4):
+    q, k, v = _qkv(seed=5)
+    # non-constant cotangent exercises the real bwd data path
+    w = jnp.asarray(np.random.RandomState(7).randn(*q.shape), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(w * ring_attention(q, k, v, mesh=mesh_sp4,
+                                          is_causal=True))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(w * _xla_attention(q, k, v, None, 0.0, True, None))
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    _assert_pallas_engaged()
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_ring_masked_matches_reference(flash_ring, mesh_sp4):
+    q, k, v = _qkv(seed=9)
+    b, l = q.shape[0], q.shape[1]
+    rng = np.random.RandomState(11)
+    mask = rng.rand(b, l) > 0.25
+    mask[:, :128] = True          # keep every query row attendable
+    kv_mask = jnp.asarray(mask)
+    ref = _xla_attention(q, k, v, kv_mask[:, None, None, :], 0.0, False,
+                         None)
+    out = ring_attention(q, k, v, mesh=mesh_sp4, kv_mask=kv_mask)
+    _assert_pallas_engaged()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_ring_masked_grads_match(flash_ring, mesh_sp4):
+    q, k, v = _qkv(seed=13)
+    b, l = q.shape[0], q.shape[1]
+    rng = np.random.RandomState(17)
+    kv_mask = jnp.asarray(rng.rand(b, l) > 0.25).at[:, :128].set(True)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh_sp4,
+                                      kv_mask=kv_mask))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(
+            q, k, v, kv_mask[:, None, None, :], 0.0, False, None))
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    _assert_pallas_engaged()
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_ring_fully_masked_rows_zero(flash_ring, mesh_sp4):
+    q, k, v = _qkv(seed=19)
+    kv_mask = jnp.zeros((q.shape[0], q.shape[1]), bool)
+    out = np.asarray(ring_attention(q, k, v, mesh=mesh_sp4,
+                                    kv_mask=kv_mask))
+    _assert_pallas_engaged()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_flash_ring_under_jit(flash_ring, mesh_sp4):
+    """Composes with jit + value_and_grad (the TrainStep path)."""
+    q, k, v = _qkv(seed=23)
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q):
+            return jnp.sum(ring_attention(q, k, v, mesh=mesh_sp4,
+                                          is_causal=True))
+
+        return jax.value_and_grad(loss)(q)
+
+    val, g = step(q, k, v)
+    _assert_pallas_engaged()
+    ref = jnp.sum(_xla_attention(q, k, v, None, 0.0, True, None))
+    np.testing.assert_allclose(float(val), float(ref), rtol=2e-5)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_ineligible_shape_keeps_einsum_path(flash_ring, mesh_sp4):
+    """Sub-modulus shards (l_local 8 < 128) fall back to the einsum walk
+    — counted as xla dispatch, numerically identical to reference."""
+    q, k, v = _qkv(l=32, d=8)
+    ref = _xla_attention(q, k, v, None, 0.0, True, None)
+    out = ring_attention(q, k, v, mesh=mesh_sp4, is_causal=True)
+    snap = counters.snapshot()
+    assert snap.get("ring_attention.pallas", 0) == 0
+    assert snap.get("ring_attention.xla", 0) >= 1
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -6,7 +6,7 @@ invocation so a single window produces committed evidence:
 
   1. full bench matrix (headline + bert512/resnet/nmt/ctr/mnist) —
      every measured row appends to BENCH_CAPTURES.jsonl via bench.py
-  2. op-level micro-bench -> OPBENCH_r04.jsonl (device_kind=TPU rows,
+  2. op-level micro-bench -> OPBENCH_r05.jsonl (device_kind=TPU rows,
      host-fetch timing methodology) + capture log
   3. flash-attention block/crossover sweep at seq 128/256/512
      (fwd-only and fwd+bwd) for the dispatch-floor decision
@@ -58,12 +58,13 @@ def main():
     print(f"LIVE TPU: backend={backend} device_kind={kind}")
 
     env = dict(os.environ)
-    env.setdefault("BENCH_ROUND", "r04")
+    env.setdefault("BENCH_ROUND", "r05")
 
     # hardware-only kernel validation first (interpret mode can't vouch
     # for Mosaic lowering — the r3 fused-embedding lesson)
     _run([sys.executable, "-m", "pytest", "-q",
           "tests/test_flash_short_tpu.py", "tests/test_flash_dropout_tpu.py",
+          "tests/test_ring_flash_tpu.py",
           "-p", "no:cacheprovider", "--noconftest"],
          timeout=900, env=dict(os.environ))
 
@@ -80,7 +81,7 @@ def main():
 
     # op-bench: TPU baseline rows (the gate's committed reference)
     _run([sys.executable, "tools/op_bench.py",
-          "--append", "OPBENCH_r04.jsonl"], timeout=1200, env=env)
+          "--append", "OPBENCH_r05.jsonl"], timeout=1200, env=env)
 
     if not args.skip_sweep:
         for extra in ([], ["--grad"]):

@@ -1,18 +1,55 @@
 #!/bin/bash
 # Unattended tunnel watcher: probe every 10 min; when the axon tunnel is
 # up, immediately run the full live-TPU capture session (hardware kernel
-# tests + bench matrix + A/B + op-bench + sweeps), then back off 2 h so
-# repeated windows don't re-burn the same captures. Log: /tmp/tunnel_watch.log
+# tests + bench matrix + A/B + op-bench + sweeps), auto-commit whatever
+# landed, then back off — but ONLY if captures actually landed; a probe
+# that flapped mid-session retries on the short cadence so a second
+# window isn't wasted.
+#
+# Arm it (documented in README):
+#   nohup bash tools/tunnel_watch.sh >/dev/null 2>&1 &
+# Log: /tmp/tunnel_watch.log (rotated at ~1 MB).
 cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/tunnel_watch.log
+
+tpu_rows() {
+  # count durable TPU evidence rows in the capture log (grep -c prints 0
+  # itself on no-match; only a missing file leaves $n empty)
+  local n
+  n=$(grep -ciE '"device_kind": "[^"]*(tpu|v5)' BENCH_CAPTURES.jsonl 2>/dev/null)
+  echo "${n:-0}"
+}
+
 while true; do
+  # rotate the log so a multi-day run can't fill /tmp
+  if [ -f "$LOG" ] && [ "$(stat -c%s "$LOG" 2>/dev/null || echo 0)" -gt 1000000 ]; then
+    tail -c 200000 "$LOG" > "$LOG.1" && mv "$LOG.1" "$LOG"
+  fi
   rm -f ~/.cache/paddle_tpu/probe.json
   if timeout 90 python -c "import jax; assert jax.devices()" 2>/dev/null; then
-    echo "=== tunnel UP at $(date -u) — running live session" >> /tmp/tunnel_watch.log
-    python tools/live_tpu_session.py >> /tmp/tunnel_watch.log 2>&1
-    echo "=== session done at $(date -u) rc=$?" >> /tmp/tunnel_watch.log
-    sleep 7200
+    before=$(tpu_rows)
+    echo "=== tunnel UP at $(date -u) — running live session (tpu_rows=$before)" >> "$LOG"
+    timeout 7200 python tools/live_tpu_session.py >> "$LOG" 2>&1
+    rc=$?
+    after=$(tpu_rows)
+    echo "=== session done at $(date -u) rc=$rc tpu_rows $before -> $after" >> "$LOG"
+    # durability: commit whatever the session captured so a container
+    # restart can't lose the evidence
+    if [ "$after" -gt "$before" ] || ! git diff --quiet -- BENCH_CAPTURES.jsonl OPBENCH_r*.jsonl 2>/dev/null; then
+      # add per file: one missing pathspec must not abort the whole add
+      for f in BENCH_CAPTURES.jsonl OPBENCH_r*.jsonl XPLANE_SUMMARY.md; do
+        [ -f "$f" ] && git add "$f" >> "$LOG" 2>&1
+      done
+      git commit -m "Live TPU capture session: bench + op-bench rows" \
+        >> "$LOG" 2>&1 || true
+    fi
+    if [ "$after" -gt "$before" ]; then
+      sleep 7200   # real captures landed — no need to re-burn the window
+    else
+      sleep 600    # session ran but nothing landed (flap?) — keep probing
+    fi
   else
-    echo "down $(date -u)" >> /tmp/tunnel_watch.log
+    echo "down $(date -u)" >> "$LOG"
     sleep 600
   fi
 done
