@@ -44,6 +44,7 @@ from . import profiler  # noqa: F401
 from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
+from . import contrib  # noqa: F401  (fluid.contrib parity surface)
 
 
 def save(obj, path, **kwargs):

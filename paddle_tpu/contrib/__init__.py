@@ -1,0 +1,41 @@
+"""fluid.contrib parity surface (reference
+python/paddle/fluid/contrib/__init__.py): the aggregated contrib
+namespace — layers (dense+lengths rewrites of the LoD ops), the
+old-style decoder stack, extend_optimizer, reader/utils helpers,
+memory/op statistics, mixed_precision and quantize re-exports.
+
+Baidu-internal hardware ops are documented non-goals
+(search_pyramid_hash: pyramid-hash ANN serving; _pull_box_extended_
+sparse: BoxPS ads hardware) — everything else resolves here.
+"""
+from . import decoder  # noqa: F401
+from .decoder import *  # noqa: F401,F403
+from . import memory_usage_calc  # noqa: F401
+from .memory_usage_calc import *  # noqa: F401,F403
+from . import op_frequence  # noqa: F401
+from .op_frequence import *  # noqa: F401,F403
+from . import quantize  # noqa: F401
+from .quantize import *  # noqa: F401,F403
+from . import reader  # noqa: F401
+from .reader import *  # noqa: F401,F403
+from . import utils  # noqa: F401
+from .utils import *  # noqa: F401,F403
+from . import extend_optimizer  # noqa: F401
+from .extend_optimizer import *  # noqa: F401,F403
+from . import model_stat  # noqa: F401
+from .model_stat import *  # noqa: F401,F403
+from . import mixed_precision  # noqa: F401
+from .mixed_precision import *  # noqa: F401,F403
+from . import layers  # noqa: F401
+from .layers import *  # noqa: F401,F403
+
+__all__ = []
+__all__ += decoder.__all__
+__all__ += memory_usage_calc.__all__
+__all__ += op_frequence.__all__
+__all__ += quantize.__all__
+__all__ += reader.__all__
+__all__ += utils.__all__
+__all__ += extend_optimizer.__all__
+__all__ += ["mixed_precision"]
+__all__ += layers.__all__

@@ -1,0 +1,280 @@
+"""fluid.contrib.decoder (reference contrib/decoder/
+beam_search_decoder.py): the old-style InitState/StateCell decoding
+stack.
+
+The reference classes BUILD static sub-blocks inside fluid's
+DynamicRNN; `with decoder.block():` appends ops to a program executed
+per step by the DynamicRNN machinery. An eager/jit framework has no
+op-appending block to enter, so the per-step computation is registered
+as a callable instead (the same move dy2static makes for control
+flow, and the same posture as autograd.py's loud in-jit recipe):
+
+    decoder = TrainingDecoder(state_cell)
+
+    @decoder.step
+    def _(dec, current_word):
+        dec.state_cell.compute_state(inputs={'x': current_word})
+        score = proj(dec.state_cell.get_state('h'))
+        dec.state_cell.update_states()
+        dec.output(score)
+
+    scores = decoder(trg_embedding)     # loops over time
+
+`with decoder.block():` raises with exactly this recipe. StateCell
+itself (state_updater registration, compute_state/get_state/set_state/
+update_states) is API-faithful — the updater was always a registered
+function in the reference too (beam_search_decoder.py:314).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework.tensor import Tensor
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """Initial decoding state (beam_search_decoder.py:43): either a
+    concrete `init` tensor or a zero-filled (batch_ref-derived) shape."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is None and init_boot is None:
+            raise ValueError(
+                "InitState needs `init` (a tensor) or `init_boot` (a "
+                "batch reference to derive a filled state from)")
+        self._init = init
+        self._shape = shape
+        self._value = value
+        self._boot = init_boot
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        if self._init is not None:
+            return self._init
+        b = self._boot.shape[0]
+        shape = tuple(s for s in (self._shape or ()) if s != -1)
+        return Tensor(np.full((b,) + shape, self._value,
+                              np.dtype(self._dtype)))
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """Holds decoding states and the registered per-step updater
+    (beam_search_decoder.py:159)."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        if out_state not in states:
+            raise ValueError(f"out_state {out_state!r} not in states")
+        self._init_states = dict(states)
+        self._out_state = out_state
+        self._inputs = dict(inputs or {})
+        self._cur_states = {}
+        self._updater = None
+        self.reset()
+
+    def reset(self):
+        self._cur_states = {
+            k: (v.value if isinstance(v, InitState) else v)
+            for k, v in self._init_states.items()}
+        self._next_states = None
+
+    def state_updater(self, updater):
+        """Decorator registering the per-step state transition."""
+        self._updater = updater
+        return updater
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs or \
+                self._inputs[input_name] is None:
+            raise ValueError(f"input {input_name!r} not staged")
+        return self._inputs[input_name]
+
+    def get_state(self, state_name):
+        if state_name not in self._cur_states:
+            raise ValueError(f"unknown state {state_name!r}")
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name, state_value):
+        # the pending write becomes current at update_states() — the
+        # reference's deferred-write semantics
+        if self._next_states is None:
+            self._next_states = dict(self._cur_states)
+        self._next_states[state_name] = state_value
+
+    def compute_state(self, inputs):
+        if self._updater is None:
+            raise ValueError("no state_updater registered — decorate the "
+                             "transition with @state_cell.state_updater")
+        self._inputs.update(inputs)
+        self._updater(self)
+
+    def update_states(self):
+        if self._next_states is not None:
+            self._cur_states = self._next_states
+            self._next_states = None
+
+    def set_states(self, states):
+        self._cur_states = dict(states)
+        self._next_states = None
+
+    def snapshot(self):
+        return dict(self._cur_states)
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class _StepRegistry:
+    def __init__(self):
+        self._fn = None
+
+    def step(self, fn):
+        self._fn = fn
+        return fn
+
+    def block(self):
+        raise NotImplementedError(
+            "this framework is eager/jit, not block-building: register "
+            "the per-step computation with @decoder.step instead of "
+            "`with decoder.block():` — see paddle_tpu.contrib.decoder's "
+            "module docstring for the exact recipe")
+
+
+class TrainingDecoder(_StepRegistry):
+    """Teacher-forced decode loop (beam_search_decoder.py:384): runs
+    the registered step over the target sequence, collecting
+    decoder.output(...) values into (B, T, ...) tensors."""
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        super().__init__()
+        self.state_cell = state_cell
+        self._outputs_t = None
+
+    def output(self, *outputs):
+        self._outputs_t = outputs if len(outputs) > 1 else outputs[0]
+
+    def __call__(self, step_inputs):
+        """step_inputs: (B, T, ...) teacher sequence (batch-major)."""
+        if self._fn is None:
+            self.block()  # raises with the recipe
+        self.state_cell.reset()
+        T = step_inputs.shape[1]
+        collected = []
+        for t in range(T):
+            self._outputs_t = None
+            self._fn(self, step_inputs[:, t])
+            if self._outputs_t is None:
+                raise ValueError("the step function must call "
+                                 "decoder.output(...)")
+            collected.append(self._outputs_t)
+        if isinstance(collected[0], tuple):
+            return tuple(ops.stack(list(c), axis=1)
+                         for c in zip(*collected))
+        return ops.stack(collected, axis=1)
+
+
+class BeamSearchDecoder(_StepRegistry):
+    """Beam-search decode loop (beam_search_decoder.py:525). The step
+    function maps (decoder, prev_ids (B*beam,)) -> (B*beam, V) log
+    probs via the shared StateCell; the decoder expands/prunes beams,
+    tracks back pointers and returns (translation_ids, scores) as
+    dense (B, beam, T') arrays with end_id padding."""
+
+    def __init__(self, state_cell, init_ids, init_scores,
+                 target_dict_dim=None, word_dim=None,
+                 input_var_dict=None, topk_size=50, sparse_emb=True,
+                 max_len=100, beam_size=4, end_id=1, name=None):
+        super().__init__()
+        self.state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._beam = int(beam_size)
+        self._end_id = int(end_id)
+        self._max_len = int(max_len)
+        self._V = target_dict_dim
+
+    def decode(self):
+        raise NotImplementedError(
+            "register the scoring step with @decoder.step, then call "
+            "decoder() — the block-building decode() idiom does not "
+            "exist in an eager framework (module docstring has the "
+            "recipe)")
+
+    def __call__(self):
+        if self._fn is None:
+            self.block()
+        ids0 = np.asarray(
+            self._init_ids.numpy() if hasattr(self._init_ids, "numpy")
+            else self._init_ids).reshape(-1)
+        B = ids0.shape[0]
+        K, E = self._beam, self._end_id
+        self.state_cell.reset()
+        # tile every state over the beam axis: (B, ...) -> (B*K, ...)
+        tiled = {}
+        for k, v in self.state_cell.snapshot().items():
+            arr = v.value if hasattr(v, "value") else jnp.asarray(v)
+            tiled[k] = Tensor(jnp.repeat(arr, K, axis=0))
+        self.state_cell.set_states(tiled)
+        ids = jnp.repeat(jnp.asarray(ids0), K)           # (B*K,)
+        s0 = np.asarray(
+            self._init_scores.numpy() if hasattr(self._init_scores,
+                                                 "numpy")
+            else self._init_scores).reshape(B)
+        # beam 0 starts at the caller's initial score; other beams are
+        # dead until the first expansion
+        scores = jnp.where(jnp.arange(B * K) % K == 0,
+                           jnp.repeat(jnp.asarray(s0, jnp.float32), K),
+                           -1e9)
+        alive = jnp.ones((B * K,), bool)
+        steps_ids, steps_parent = [], []
+        for _t in range(self._max_len):
+            logp = self._fn(self, Tensor(ids))
+            logp = logp.value if hasattr(logp, "value") else jnp.asarray(logp)
+            V = logp.shape[-1]
+            # finished beams only propose end_id at zero added cost
+            fin_row = jnp.full((V,), -1e9).at[E].set(0.0)
+            logp = jnp.where(alive[:, None], logp, fin_row[None, :])
+            total = scores[:, None] + logp               # (B*K, V)
+            flat = total.reshape(B, K * V)
+            top_s, top_i = jax.lax.top_k(flat, K)
+            parent = top_i // V                          # (B, K) in-beam
+            word = top_i % V
+            gparent = (parent + jnp.arange(B)[:, None] * K).reshape(-1)
+            ids = word.reshape(-1)
+            scores = top_s.reshape(-1)
+            alive = alive[gparent] & (ids != E)
+            # reorder states by the selected parents
+            snap = self.state_cell.snapshot()
+            self.state_cell.set_states({
+                k: Tensor(jnp.asarray(
+                    v.value if hasattr(v, "value") else v)[gparent])
+                for k, v in snap.items()})
+            steps_ids.append(np.asarray(ids).reshape(B, K))
+            steps_parent.append(np.asarray(parent))
+            if not bool(alive.any()):
+                break
+        # backtrack pointers into dense (B, K, T) with end_id padding
+        T = len(steps_ids)
+        out = np.full((B, K, T), E, np.int64)
+        ptr = np.tile(np.arange(K), (B, 1))
+        for t in range(T - 1, -1, -1):
+            out[:, :, t] = np.take_along_axis(steps_ids[t], ptr, axis=1)
+            ptr = np.take_along_axis(steps_parent[t], ptr, axis=1)
+        final_scores = np.asarray(scores).reshape(B, K)
+        return Tensor(out), Tensor(final_scores)

@@ -1,0 +1,44 @@
+"""fluid.contrib.extend_optimizer (reference extend_optimizer_with_
+weight_decay.py): graft DECOUPLED weight decay onto any optimizer
+class — decay applied directly to parameters after the base rule, not
+folded into the gradient (the AdamW recipe generalized to any base).
+The framework Optimizer base already carries the decoupled path
+(DECOUPLED_WD + _l2_coeff, optimizer.py apply_gradients_fn), so the
+extension is a subclass flipping that switch."""
+from __future__ import annotations
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Return a subclass of `base_optimizer` whose constructor takes a
+    leading `weight_decay` coefficient applied decoupled:
+    p <- p - lr * wd * p alongside the base rule (reference
+    extend_with_decoupled_weight_decay / DecoupledWeightDecay mixin).
+
+        AdamW_like = extend_with_decoupled_weight_decay(optimizer.Adam)
+        opt = AdamW_like(0.01, learning_rate=1e-3, parameters=params)
+    """
+    from ..optimizer.optimizer import Optimizer
+
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError(
+            f"input {base_optimizer!r} must be an Optimizer subclass")
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        DECOUPLED_WD = True
+
+        def __init__(self, weight_decay, *args, **kwargs):
+            coeff = float(getattr(weight_decay, "coeff", weight_decay)
+                          if weight_decay is not None else 0.0)
+            kwargs.pop("weight_decay", None)
+            super().__init__(*args, **kwargs)
+            # the base may have interpreted its own weight_decay kwarg;
+            # pin the decoupled coefficient explicitly
+            self._l2_coeff = coeff
+            self._wd = None
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        f"Decoupled{base_optimizer.__name__}")
+    return OptimizerWithDecoupledWeightDecay

@@ -1,0 +1,13 @@
+"""fluid.contrib.layers (reference contrib/layers/__init__.py):
+nn ops + basic-operator RNNs + ctr metric bundle."""
+from . import nn  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from . import rnn_impl  # noqa: F401
+from .rnn_impl import *  # noqa: F401,F403
+from . import metric_op  # noqa: F401
+from .metric_op import *  # noqa: F401,F403
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += rnn_impl.__all__
+__all__ += metric_op.__all__

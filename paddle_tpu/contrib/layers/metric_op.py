@@ -1,0 +1,30 @@
+"""fluid.contrib.layers.metric_op (reference contrib/layers/
+metric_op.py): the CTR metric bundle — local accumulators the caller
+divides by (all-reduced) instance counts."""
+from __future__ import annotations
+
+from ...framework.op import primitive
+
+__all__ = ["ctr_metric_bundle"]
+
+
+@primitive("ctr_metric_bundle")
+def ctr_metric_bundle(input, label):
+    """Local CTR metrics (metric_op.py:30): returns (local_sqrerr,
+    local_abserr, local_prob, local_q, local_pos_num, local_ins_num).
+    MAE = abserr/ins, RMSE = sqrt(sqrerr/ins), predicted_ctr = prob/ins,
+    q = q/ins after the caller's all-reduce. input: (N, 1) predicted
+    probabilities; label: (N, 1) 0/1."""
+    import jax.numpy as jnp
+
+    p = input.reshape(-1).astype(jnp.float32)
+    y = label.reshape(-1).astype(jnp.float32)
+    err = p - y
+    local_sqrerr = jnp.sum(err * err)
+    local_abserr = jnp.sum(jnp.abs(err))
+    local_prob = jnp.sum(p)
+    local_q = jnp.sum(y * p)
+    local_pos_num = jnp.sum(y)
+    local_ins_num = jnp.asarray(float(p.shape[0]), jnp.float32)
+    return (local_sqrerr, local_abserr, local_prob, local_q,
+            local_pos_num, local_ins_num)

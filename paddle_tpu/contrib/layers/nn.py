@@ -1,0 +1,509 @@
+"""fluid.contrib.layers nn ops, TPU-native.
+
+Reference: python/paddle/fluid/contrib/layers/nn.py (__all__ at :54).
+The portable subset (shuffle_batch, partial_concat/sum, batch_fc,
+fused_embedding_seq_pool, sparse_embedding) lives in
+paddle_tpu.incubate.layers and is re-exported; this module adds the
+rest as dense+lengths rewrites of the reference's LoD kernels — static
+shapes + masks instead of ragged rows, so everything jits and the
+matmuls land on the MXU:
+
+- var_conv_2d (var_conv_2d_op.cc): variable-size images ride one
+  padded batched lax.conv with boundary masks.
+- match_matrix_tensor (match_matrix_tensor_op.cc): A·W·Bᵀ as one
+  einsum over the padded batch.
+- sequence_topk_avg_pooling (sequence_topk_avg_pooling_op.h): masked
+  sort + prefix sums.
+- tree_conv (math/tree2col.cc): host-built eta patch tensor (tree
+  structure is data; concrete in eager — document jit limits) and one
+  einsum against the (f, 3, out, filters) filter bank.
+- tdm_child / tdm_sampler (tdm_child_op.h, tdm_sampler_op.h): tree
+  gathers + layerwise negative sampling.
+- rank_attention (rank_attention.cu.h): the expand-input/expand-param
+  gathers vectorized, then one batched matmul.
+- bilateral_slice (bilateral_slice_op.cu): trilinear tent-weight grid
+  sampling in pure jnp (differentiable end to end).
+- fused_elemwise_activation (fused_elemwise_activation_op.cc): XLA
+  fuses the pair; the API keeps the functor_list contract.
+
+Baidu-hardware non-goals: search_pyramid_hash (pyramid-hash ANN
+serving), _pull_box_extended_sparse (BoxPS).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ... import ops
+from ...framework import random as random_mod
+from ...framework.op import primitive
+from ...framework.tensor import Tensor
+from ...incubate.layers import (  # noqa: F401  (re-exported surface)
+    batch_fc, fused_embedding_seq_pool, partial_concat, partial_sum,
+    shuffle_batch, sparse_embedding,
+)
+
+__all__ = [
+    'fused_elemwise_activation', 'sequence_topk_avg_pooling', 'var_conv_2d',
+    'match_matrix_tensor', 'tree_conv', 'fused_embedding_seq_pool',
+    'multiclass_nms2', 'shuffle_batch', 'partial_concat',
+    'sparse_embedding', 'partial_sum', 'tdm_child', 'rank_attention',
+    'tdm_sampler', 'batch_fc', 'bilateral_slice',
+]
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "scale": None,  # resolved with the scale attr
+}
+_BINARY = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply}
+
+
+def _axis_broadcast(x, y, axis):
+    """fluid elementwise axis semantics: y matches x's dims starting at
+    `axis` (default -1 = trailing alignment, plain numpy rules)."""
+    if axis == -1 or y.ndim == x.ndim:
+        return y
+    axis = int(axis)
+    return y.reshape((1,) * axis + y.shape
+                     + (1,) * (x.ndim - axis - y.ndim))
+
+
+@primitive("fused_elemwise_activation")
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """out = Unary(Binary(x, y)) or Binary(x, Unary(y)) — reference
+    contrib nn.py:63; the fusion itself is XLA's job on TPU."""
+    if isinstance(functor_list, str):
+        functor_list = functor_list.split(",")
+    if not isinstance(functor_list, (list, tuple)) or len(functor_list) != 2:
+        raise ValueError("functor_list should be a list of 2 strs")
+    a, b = (f.strip() for f in functor_list)
+
+    def unary(name, v):
+        if name == "scale":
+            return v * scale
+        return _UNARY[name](v)
+
+    if a in _BINARY:       # out = Binary(x, Unary(y))
+        return _BINARY[a](x, _axis_broadcast(x, unary(b, y), axis))
+    if b in _BINARY:       # out = Unary(Binary(x, y))
+        return unary(a, _BINARY[b](x, _axis_broadcast(x, y, axis)))
+    raise ValueError(f"functor_list {functor_list!r}: exactly one of the "
+                     "two must be elementwise_add/elementwise_mul")
+
+
+@primitive("var_conv_2d", nondiff=("row", "col"))
+def _var_conv_2d_core(input, row, col, weight, stride, ksize):
+    n, cin, hmax, wmax = input.shape
+    cout = weight.shape[0]
+    kh, kw = ksize
+    sh, sw = stride
+    hm = jnp.arange(hmax)[None, :] < row[:, None]          # (n, hmax)
+    wm = jnp.arange(wmax)[None, :] < col[:, None]
+    mask = (hm[:, None, :, None] & wm[:, None, None, :])
+    x = jnp.where(mask, input, 0.0)
+    w = weight.reshape(cout, cin, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(sh, sw), padding="SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh = (jnp.maximum(row, 1) - 1) // sh + 1
+    ow = (jnp.maximum(col, 1) - 1) // sw + 1
+    ohmax, owmax = out.shape[2], out.shape[3]
+    om = ((jnp.arange(ohmax)[None, :] < oh[:, None])[:, None, :, None]
+          & (jnp.arange(owmax)[None, :] < ow[:, None])[:, None, None, :])
+    return jnp.where(om, out, 0.0), oh, ow
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel,
+                filter_size, stride=1, param_attr=None, act=None,
+                dtype="float32", name=None, weight=None):
+    """Variable-size 2D conv (reference contrib nn.py:127
+    var_conv_2d_op.cc). Dense+lengths form: ``input`` (N, C, Hmax,
+    Wmax) padded images, ``row``/``col`` (N,) valid heights/widths.
+    SAME padding at each image's true boundary (invalid regions are
+    zeroed before and after the conv, like the reference's per-image
+    ragged conv). Returns (out (N, out_c, H', W'), out_rows, out_cols);
+    created weight (out_c, in_c*kh*kw) is appended when not passed."""
+    ksize = ((filter_size, filter_size) if isinstance(filter_size, int)
+             else tuple(filter_size))
+    strides = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    created = weight is None
+    if created:
+        fan = input_channel * ksize[0] * ksize[1]
+        key = random_mod.next_rng_key()
+        weight = Tensor(
+            jax.random.normal(key, (output_channel, fan)) * (2.0 / fan) ** 0.5,
+            stop_gradient=False)
+    out, oh, ow = _var_conv_2d_core(input, row, col, weight,
+                                    stride=strides, ksize=ksize)
+    if act is not None:
+        from ... import nn as nn_mod
+
+        out = getattr(nn_mod.functional, act)(out)
+    return (out, oh, ow, weight) if created else (out, oh, ow)
+
+
+@primitive("match_matrix_tensor", nondiff=("x_lengths", "y_lengths"))
+def _match_matrix_core(x, y, w, x_lengths, y_lengths):
+    # x (b, n, h) @ w (h, c, h) @ y (b, m, h)^T -> (b, c, n, m)
+    tmp = jnp.einsum("bnh,hco->bnco", x, w)
+    out = jnp.einsum("bnco,bmo->bcnm", tmp, y)
+    nm = jnp.arange(x.shape[1])[None, :] < x_lengths[:, None]
+    mm = jnp.arange(y.shape[1])[None, :] < y_lengths[:, None]
+    out = jnp.where(nm[:, None, :, None] & mm[:, None, None, :], out, 0.0)
+    return out, tmp
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None, x_lengths=None,
+                        y_lengths=None, weight=None):
+    """Semantic match matrix A·W·Bᵀ (reference contrib nn.py:245,
+    match_matrix_tensor_op.cc). Dense+lengths form: x (B, n_max, h) +
+    x_lengths, y (B, m_max, h) + y_lengths; W (h, channel_num, h).
+    Returns ((B, channel_num, n_max, m_max) masked, tmp=x·W); created
+    weight appended when not passed."""
+    h = x.shape[-1]
+    if y.shape[-1] != h:
+        raise ValueError(f"hidden sizes differ: {x.shape} vs {y.shape}")
+    b = x.shape[0]
+    if x_lengths is None:
+        x_lengths = Tensor(np.full((b,), x.shape[1], np.int32))
+    if y_lengths is None:
+        y_lengths = Tensor(np.full((b,), y.shape[1], np.int32))
+    created = weight is None
+    if created:
+        key = random_mod.next_rng_key()
+        weight = Tensor(
+            jax.random.normal(key, (h, channel_num, h)) * (1.0 / h) ** 0.5,
+            stop_gradient=False)
+    out, tmp = _match_matrix_core(x, y, weight, x_lengths, y_lengths)
+    if act is not None:
+        from ... import nn as nn_mod
+
+        out = getattr(nn_mod.functional, act)(out)
+    return (out, tmp, weight) if created else (out, tmp)
+
+
+@primitive("sequence_topk_avg_pooling", nondiff=("row", "col"))
+def _topk_avg_pool_core(input, row, col, topks):
+    # input (b, c, hmax, wmax); per (b, c, r): top-k averages over the
+    # valid w prefix; missing values contribute 0 (op.h:164 divides by
+    # the full k). Feature layout is channel-major: j * k_num + k.
+    b, c, hmax, wmax = input.shape
+    wm = jnp.arange(wmax)[None, :] < col[:, None]            # (b, wmax)
+    neg = jnp.asarray(-jnp.inf, input.dtype)
+    vals = jnp.where(wm[:, None, None, :], input, neg)
+    svals = -jnp.sort(-vals, axis=-1)                        # desc
+    svals = jnp.where(jnp.isfinite(svals), svals, 0.0)       # pad -> 0
+    csum = jnp.cumsum(svals, axis=-1)                        # (b,c,h,w)
+    feats = []
+    for k in topks:
+        idx = min(int(k), wmax) - 1
+        feats.append(csum[..., idx] / float(k))              # (b, c, h)
+    out = jnp.stack(feats, axis=-1)                          # (b,c,h,K)
+    hm = jnp.arange(hmax)[None, :] < row[:, None]            # (b, hmax)
+    out = jnp.where(hm[:, None, :, None], out, 0.0)
+    # (b, h, c*K) channel-major
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, hmax, -1)
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """Top-k average pooling per matrix row (reference contrib
+    nn.py:332, sequence_topk_avg_pooling_op.h). Dense+lengths form:
+    input (B, channel_num, Hmax, Wmax), row/col (B,) valid sizes.
+    Returns (B, Hmax, channel_num*len(topks)), channel-major features,
+    rows beyond `row` zeroed."""
+    if input.shape[1] != channel_num:
+        raise ValueError(f"input channel dim {input.shape[1]} != "
+                         f"channel_num {channel_num}")
+    if list(topks) != sorted(int(k) for k in topks) or int(topks[0]) < 1:
+        raise ValueError(f"topks must be increasing positives: {topks}")
+    return _topk_avg_pool_core(input, row, col, tuple(int(k) for k in topks))
+
+
+def _tree_patches(edges, n_nodes, max_depth):
+    """Host-side eta coefficient tensor (n_nodes, n_nodes, 3) from one
+    tree's edge list (math/tree2col.cc construct_patch: stack-BFS to
+    max_depth; eta_t = (d-depth)/d, eta_l = (1-eta_t) * (idx-1)/(len-1)
+    (0.5 for single child), eta_r = (1-eta_t)(1-eta_l))."""
+    tr = [[] for _ in range(n_nodes + 1)]
+    for u, v in edges:
+        if u != 0 and v != 0:
+            tr[int(u)].append(int(v))
+        else:
+            break
+    eta = np.zeros((n_nodes, n_nodes, 3), np.float32)
+
+    def visit(root):
+        # (node, 1-based child index, sibling count, depth starting 1)
+        stack = [(root, 1, 1, 1)]
+        seen = {root}
+        while stack:
+            node, idx, pclen, depth = stack.pop()
+            et = (max_depth - depth) / max_depth
+            el = (1.0 - et) * (0.5 if pclen == 1
+                               else (idx - 1.0) / (pclen - 1.0))
+            er = (1.0 - et) * (1.0 - el)
+            eta[root - 1, node - 1, 0] += el
+            eta[root - 1, node - 1, 1] += er
+            eta[root - 1, node - 1, 2] += et
+            if depth + 1 <= max_depth:
+                sz = len(tr[node])
+                for i, v in enumerate(tr[node]):
+                    if v not in seen:
+                        seen.add(v)
+                        stack.append((v, i + 1, sz, depth + 1))
+
+    for u in range(1, n_nodes + 1):
+        visit(u)
+    return eta
+
+
+@primitive("tree_conv", nondiff=("eta",))
+def _tree_conv_core(nodes_vector, eta, weight):
+    # patch (b, n, 3, f) = eta (b, n, n, 3) x features (b, n, f);
+    # out (b, n, out, filters) = patch x W (f, 3, out, filters)
+    patch = jnp.einsum("bvnt,bnf->bvtf", eta, nodes_vector)
+    return jnp.einsum("bvtf,ftoa->bvoa", patch, weight)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None, weight=None, bias=None):
+    """Tree-based convolution (TBCNN; reference contrib nn.py:400 over
+    math/tree2col.cc). nodes_vector (B, n, f); edge_set (B, m, 2)
+    1-based directed edges, 0-padded. The tree structure is DATA, so
+    patches are built host-side from concrete edge values (eager; under
+    jit pass precomputed `eta`-style structure via functional use).
+    Returns (B, n, output_size, num_filters); created weight
+    (f, 3, output_size, num_filters) / bias appended when created."""
+    ev = np.asarray(edge_set.numpy() if hasattr(edge_set, "numpy")
+                    else edge_set)
+    if ev.ndim == 2:
+        ev = ev[None]
+    b, n, f = nodes_vector.shape
+    eta = np.stack([_tree_patches(ev[i], n, max_depth) for i in range(b)])
+    created = weight is None
+    if created:
+        key = random_mod.next_rng_key()
+        weight = Tensor(
+            jax.random.normal(key, (f, 3, output_size, num_filters))
+            * (1.0 / f) ** 0.5, stop_gradient=False)
+        if bias_attr is not False and bias is None:
+            bias = Tensor(np.zeros((output_size, num_filters), np.float32),
+                          stop_gradient=False)
+    out = _tree_conv_core(nodes_vector, Tensor(eta), weight)
+    if bias is not None:
+        out = out + bias
+    if act is not None:
+        from ... import nn as nn_mod
+
+        out = getattr(nn_mod.functional, act)(out)
+    return (out, weight, bias) if created else out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """multiclass_nms that can also return the kept indices (reference
+    contrib nn.py:538 multiclass_nms2 — same kernel as
+    multiclass_nms_op.cc with the extra Index output)."""
+    from ...vision.ops import multiclass_nms
+
+    out = multiclass_nms(
+        bboxes, scores, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, normalized=normalized,
+        nms_eta=nms_eta, background_label=background_label,
+        return_index=return_index)
+    return out
+
+
+@primitive("tdm_child", nondiff=("x", "tree_info"))
+def _tdm_child_core(x, tree_info, child_nums):
+    ids = x.reshape(-1)                                    # (n,)
+    rows = tree_info[ids]                                  # (n, 3+c)
+    child = rows[:, 3:3 + child_nums]                      # (n, c)
+    has_child = ((ids != 0) & (rows[:, 3] != 0))[:, None]
+    child = jnp.where(has_child, child, 0)
+    item_id = tree_info[child.reshape(-1), 0].reshape(child.shape)
+    mask = jnp.where(has_child & (item_id != 0), 1, 0)
+    return (child.reshape(x.shape[:-1] + (child_nums,)),
+            mask.reshape(x.shape[:-1] + (child_nums,)))
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32",
+              tree_info=None):
+    """Child lookup on a TDM tree (reference contrib nn.py:1017,
+    tdm_child_op.h: TreeInfo row = [item_id, layer_id, parent_id,
+    child_ids...]; leaf_mask = child's item_id != 0). Pass the
+    (node_nums, 3+child_nums) `tree_info` table (the reference's
+    NumpyArrayInitializer param)."""
+    if tree_info is None:
+        raise ValueError("tdm_child needs the tree_info table (reference "
+                         "passes it via param_attr initializer)")
+    ti = tree_info if isinstance(tree_info, Tensor) else Tensor(
+        np.asarray(tree_info, np.int64))
+    child, mask = _tdm_child_core(x, ti, child_nums=int(child_nums))
+    return ops.cast(child, dtype), ops.cast(mask, dtype)
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int32", dtype="int32",
+                travel_array=None, layer_array=None):
+    """Layerwise negative sampling on a TDM tree (reference contrib
+    nn.py:1102, tdm_sampler_op.h). travel_array (leaf_node_num,
+    n_layers) gives each leaf's path (0 = padding on unbalanced trees);
+    layer_array flat (node_nums,) lists nodes per layer in order.
+    Negatives are drawn uniformly per layer, resampled away from the
+    positive. Returns (samples, labels, mask), each (B, 1+neg) per
+    layer — concatenated, or a per-layer list when output_list."""
+    if travel_array is None or layer_array is None:
+        raise ValueError("tdm_sampler needs travel_array and layer_array "
+                         "(the reference's NumpyArrayInitializer params)")
+    travel = np.asarray(travel_array)
+    layer_flat = np.asarray(layer_array).reshape(-1)
+    n_layers = len(layer_node_num_list)
+    if len(neg_samples_num_list) != n_layers:
+        raise ValueError("neg_samples_num_list and layer_node_num_list "
+                         "must have the same length")
+    offsets = np.concatenate([[0], np.cumsum(layer_node_num_list)])
+    ids = np.asarray(x.numpy() if hasattr(x, "numpy") else x).reshape(-1)
+    key = random_mod.make_key(seed if seed else None) if seed else \
+        random_mod.next_rng_key()
+    samples, labels, masks = [], [], []
+    for li in range(n_layers):
+        layer_nodes = jnp.asarray(
+            layer_flat[offsets[li]:offsets[li + 1]], jnp.int32)
+        n_nodes = int(layer_node_num_list[li])
+        neg = int(neg_samples_num_list[li])
+        if neg >= n_nodes:
+            raise ValueError(
+                f"layer {li}: neg_samples {neg} must be < layer node "
+                f"count {n_nodes} (tdm_sampler contract)")
+        pos = jnp.asarray(travel[ids, li], jnp.int32)        # (B,)
+        pmask = (pos != 0).astype(jnp.int64)
+        key, sub = jax.random.split(key)
+        # uniform over n_nodes-1 then shift past the positive: exact
+        # sampling-without-the-positive in one draw
+        draws = jax.random.randint(
+            sub, (ids.shape[0], neg), 0, max(n_nodes - 1, 1))
+        pos_idx = jnp.argmax(
+            layer_nodes[None, :] == pos[:, None], axis=1)[:, None]
+        draws = jnp.where(draws >= pos_idx, draws + 1, draws)
+        negs = layer_nodes[draws] * pmask[:, None]           # (B, neg)
+        if output_positive:
+            smp = jnp.concatenate([pos[:, None], negs], axis=1)
+            lab = jnp.concatenate(
+                [pmask[:, None],
+                 jnp.zeros_like(negs)], axis=1).astype(jnp.int32)
+            msk = jnp.repeat(pmask[:, None], 1 + neg, axis=1)
+        else:
+            smp, lab = negs, jnp.zeros_like(negs)
+            msk = jnp.repeat(pmask[:, None], neg, axis=1)
+        samples.append(Tensor(smp))
+        labels.append(Tensor(lab))
+        masks.append(Tensor(msk))
+    if output_list:
+        return samples, labels, masks
+    cat = lambda ts: ops.concat(ts, axis=1)  # noqa: E731
+    return cat(samples), cat(labels), cat(masks)
+
+
+@primitive("rank_attention", nondiff=("rank_offset",))
+def _rank_attention_core(input, rank_offset, rank_param, max_rank):
+    ins, d = input.shape
+    pcol = rank_param.shape[1]
+    own = rank_offset[:, 0] - 1                              # (ins,)
+    ks = jnp.arange(max_rank)
+    faster = rank_offset[:, 2 * ks + 1] - 1                  # (ins, mr)
+    index = rank_offset[:, 2 * ks + 2]                       # (ins, mr)
+    valid = (own[:, None] >= 0) & (faster >= 0)
+    # expand input: (ins, mr, d) rows gathered by index, zero if invalid
+    x_e = jnp.where(valid[:, :, None], input[index], 0.0)
+    # expand param: block (own*mr + faster) of shape (d, pcol) per slot
+    start = own[:, None] * max_rank + faster                 # (ins, mr)
+    start = jnp.where(valid, start, 0)
+    blocks = rank_param.reshape(-1, d, pcol)[start]          # (ins,mr,d,p)
+    blocks = jnp.where(valid[:, :, None, None], blocks, 0.0)
+    # out[i] = sum_k x_e[i,k] @ blocks[i,k]
+    return jnp.einsum("ikd,ikdp->ip", x_e, blocks)
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr=None,
+                   max_rank=3, max_size=0, rank_param=None):
+    """Rank attention (reference contrib nn.py:1311 over
+    rank_attention.cu.h): rank_offset row = [own_rank, (rank_k,
+    index_k) x max_rank] (1-based ranks, 0 = invalid); the parameter
+    holds max_rank*max_rank (d, param_col) blocks selected by
+    (own_rank, rank_k) and applied to the gathered instances. Created
+    rank_param is appended when not passed."""
+    d = input.shape[1]
+    if rank_param_shape[0] != d * max_rank * max_rank:
+        raise ValueError(
+            f"rank_param_shape[0] must be input_dim*max_rank^2 "
+            f"= {d * max_rank * max_rank}, got {rank_param_shape[0]}")
+    created = rank_param is None
+    if created:
+        key = random_mod.next_rng_key()
+        rank_param = Tensor(
+            jax.random.normal(key, tuple(rank_param_shape))
+            * (1.0 / d) ** 0.5, stop_gradient=False)
+    out = _rank_attention_core(input, rank_offset, rank_param,
+                               max_rank=int(max_rank))
+    return (out, rank_param) if created else out
+
+
+@primitive("bilateral_slice")
+def _bilateral_slice_core(x, guide, grid, has_offset):
+    n, cin, h, w = x.shape
+    gn, gc, gd, gh, gw = grid.shape
+    stride = cin + 1 if has_offset else cin
+    cout = gc // stride
+    gx = (jnp.arange(w) + 0.5) * gw / w                      # (w,)
+    gy = (jnp.arange(h) + 0.5) * gh / h                      # (h,)
+    gz = guide * gd                                          # (n, h, w)
+    fx = jnp.floor(gx - 0.5).astype(jnp.int32)
+    fy = jnp.floor(gy - 0.5).astype(jnp.int32)
+    fz = jnp.floor(gz - 0.5).astype(jnp.int32)
+
+    coeff = jnp.zeros((n, gc, h, w), x.dtype)
+    for dx in (0, 1):
+        xx = fx + dx
+        x_ = jnp.clip(xx, 0, gw - 1)
+        wx = jnp.maximum(1.0 - jnp.abs(xx + 0.5 - gx), 0.0)  # (w,)
+        for dy in (0, 1):
+            yy = fy + dy
+            y_ = jnp.clip(yy, 0, gh - 1)
+            wy = jnp.maximum(1.0 - jnp.abs(yy + 0.5 - gy), 0.0)
+            for dz in (0, 1):
+                zz = fz + dz
+                z_ = jnp.clip(zz, 0, gd - 1)
+                wz = jnp.maximum(1.0 - jnp.abs(zz + 0.5 - gz), 0.0)
+                # gather grid[b, :, z_(b,h,w), y_(h), x_(w)]
+                g = grid[:, :, :, y_, :][:, :, :, :, x_]     # (n,gc,gd,h,w)
+                g = jnp.take_along_axis(
+                    g, z_[:, None, None, :, :], axis=2)[:, :, 0]
+                coeff = coeff + g * (wx[None, None, None, :]
+                                     * wy[None, None, :, None]
+                                     * wz[:, None, :, :])
+    coeff = coeff.reshape(n, cout, stride, h, w)
+    out = jnp.einsum("ncshw,nshw->nchw", coeff[:, :, :cin], x)
+    if has_offset:
+        out = out + coeff[:, :, cin]
+    return out
+
+
+def bilateral_slice(x, guide, grid, has_offset, name=None):
+    """HDRNet bilateral-grid slicing (reference contrib nn.py:1489 over
+    bilateral_slice_op.cu): per pixel, trilinearly sample affine
+    coefficients from the (N, C_grid, D, Gh, Gw) grid at (x, y,
+    guide(x,y)) with tent weights and apply them to the input channels
+    (+1 offset channel when has_offset). Pure jnp — differentiable
+    through x, guide and grid."""
+    return _bilateral_slice_core(x, guide, grid, bool(has_offset))

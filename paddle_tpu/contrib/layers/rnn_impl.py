@@ -1,0 +1,196 @@
+"""fluid.contrib.layers.rnn_impl — basic-operator RNNs (reference
+contrib/layers/rnn_impl.py): BasicGRUUnit/BasicLSTMUnit single-step
+cells and basic_gru/basic_lstm full-sequence runners with multi-layer,
+bidirectional, sequence_length masking and inter-layer dropout. Built
+on the framework cells (nn.GRUCell/LSTMCell with the contrib
+forget-bias offset) and the nn.RNN scan runner, so the recurrence
+compiles to one lax.scan instead of per-step ops."""
+from __future__ import annotations
+
+from ... import nn
+from ...incubate.text_models import BasicGRUCell, BasicLSTMCell
+
+__all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm"]
+
+
+class BasicGRUUnit(nn.Layer):
+    """One GRU step from basic ops (rnn_impl.py:25). The reference
+    builds weights lazily from the first input; here the unit wraps
+    BasicGRUCell and does the same."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        super().__init__()
+        if hidden_size is None and isinstance(name_scope, int):
+            # tolerate the positional (hidden_size,) spelling
+            name_scope, hidden_size = None, name_scope
+        self.hidden_size = hidden_size
+        self._attrs = (param_attr, bias_attr)
+
+    def _build(self, input_size):
+        # lazy like the reference; never pre-assign None — a plain
+        # attribute would shadow the Layer sublayer registry
+        if getattr(self, "cell", None) is None:
+            self.cell = BasicGRUCell(input_size, self.hidden_size,
+                                     param_attr=self._attrs[0],
+                                     bias_attr=self._attrs[1])
+
+    def forward(self, input, pre_hidden):
+        self._build(input.shape[-1])
+        _, h = self.cell(input, pre_hidden)
+        return h
+
+
+class BasicLSTMUnit(nn.Layer):
+    """One LSTM step from basic ops (rnn_impl.py:580) with the
+    forget_bias offset. forward returns (hidden, cell)."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__()
+        if hidden_size is None and isinstance(name_scope, int):
+            name_scope, hidden_size = None, name_scope
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+        self._attrs = (param_attr, bias_attr)
+
+    def _build(self, input_size):
+        if getattr(self, "cell", None) is None:
+            self.cell = BasicLSTMCell(input_size, self.hidden_size,
+                                      param_attr=self._attrs[0],
+                                      bias_attr=self._attrs[1],
+                                      forget_bias=self.forget_bias)
+
+    def forward(self, input, pre_hidden, pre_cell):
+        self._build(input.shape[-1])
+        _, (h, c) = self.cell(input, (pre_hidden, pre_cell))
+        return h, c
+
+
+def _run_layers(input, cells_fw, cells_bw, init_states, sequence_length,
+                dropout_prob, batch_first):
+    """Shared multi-layer (optionally bidirectional) runner. Returns
+    (output, per-layer last states list)."""
+    from ... import nn as nn_mod
+    from ... import ops as ops_mod
+
+    out = input if batch_first else ops_mod.transpose(input, [1, 0, 2])
+    lasts = []
+    n_layers = len(cells_fw)
+    for li in range(n_layers):
+        init = None if init_states is None else init_states[li]
+        if cells_bw is not None:
+            rnn = nn_mod.BiRNN(cells_fw[li], cells_bw[li])
+            out, (st_f, st_b) = rnn(out, initial_states=init,
+                                    sequence_length=sequence_length)
+            lasts.append((st_f, st_b))
+        else:
+            rnn = nn_mod.RNN(cells_fw[li])
+            out, st = rnn(out, initial_states=init,
+                          sequence_length=sequence_length)
+            lasts.append(st)
+        if dropout_prob and li < n_layers - 1:
+            out = nn_mod.functional.dropout(out, p=dropout_prob)
+    if not batch_first:
+        from ... import ops as ops_mod
+
+        out = ops_mod.transpose(out, [1, 0, 2])
+    return out, lasts
+
+
+def _split_init(init, num_layers, directions, pairs=1):
+    """(num_layers*directions, B, H) -> per-layer initial states."""
+    if init is None:
+        return None
+    per = []
+    for li in range(num_layers):
+        if directions == 2:
+            f = init[li * 2]
+            b = init[li * 2 + 1]
+            per.append((f, b))
+        else:
+            per.append(init[li])
+    return per
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Multi-layer (bi)GRU over a sequence (rnn_impl.py:164). Returns
+    (rnn_out, last_hidden): rnn_out (B, T, H*D) [or time-major], last
+    hidden (num_layers*D, B, H)."""
+    from ... import ops as ops_mod
+
+    d = 2 if bidirectional else 1
+    in_sz = input.shape[-1]
+    cells_fw, cells_bw = [], ([] if bidirectional else None)
+    for li in range(num_layers):
+        sz = in_sz if li == 0 else hidden_size * d
+        cells_fw.append(BasicGRUCell(sz, hidden_size, param_attr=param_attr,
+                                     bias_attr=bias_attr))
+        if bidirectional:
+            cells_bw.append(BasicGRUCell(sz, hidden_size,
+                                         param_attr=param_attr,
+                                         bias_attr=bias_attr))
+    init = _split_init(init_hidden, num_layers, d)
+    out, lasts = _run_layers(input, cells_fw, cells_bw, init,
+                             sequence_length, dropout_prob, batch_first)
+    flat = []
+    for st in lasts:
+        if bidirectional:
+            flat += [st[0], st[1]]
+        else:
+            flat.append(st)
+    last_hidden = ops_mod.stack(flat, axis=0)
+    return out, last_hidden
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """Multi-layer (bi)LSTM over a sequence (rnn_impl.py:405). Returns
+    (rnn_out, last_hidden, last_cell)."""
+    from ... import ops as ops_mod
+
+    d = 2 if bidirectional else 1
+    in_sz = input.shape[-1]
+    cells_fw, cells_bw = [], ([] if bidirectional else None)
+    for li in range(num_layers):
+        sz = in_sz if li == 0 else hidden_size * d
+        cells_fw.append(BasicLSTMCell(sz, hidden_size, param_attr=param_attr,
+                                      bias_attr=bias_attr,
+                                      forget_bias=forget_bias))
+        if bidirectional:
+            cells_bw.append(BasicLSTMCell(sz, hidden_size,
+                                          param_attr=param_attr,
+                                          bias_attr=bias_attr,
+                                          forget_bias=forget_bias))
+    init = None
+    if init_hidden is not None and init_cell is not None:
+        init = []
+        for li in range(num_layers):
+            if bidirectional:
+                init.append(((init_hidden[2 * li], init_cell[2 * li]),
+                             (init_hidden[2 * li + 1],
+                              init_cell[2 * li + 1])))
+            else:
+                init.append((init_hidden[li], init_cell[li]))
+    out, lasts = _run_layers(input, cells_fw, cells_bw, init,
+                             sequence_length, dropout_prob, batch_first)
+    hs, cs = [], []
+    for st in lasts:
+        if bidirectional:
+            (hf, cf), (hb, cb) = st
+            hs += [hf, hb]
+            cs += [cf, cb]
+        else:
+            h, c = st
+            hs.append(h)
+            cs.append(c)
+    return out, ops_mod.stack(hs, axis=0), ops_mod.stack(cs, axis=0)

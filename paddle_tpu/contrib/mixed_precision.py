@@ -1,0 +1,39 @@
+"""fluid.contrib.mixed_precision (reference contrib/mixed_precision):
+the static-era AMP surface — `decorate` wrapping an optimizer and the
+op white/black lists. The live implementation is paddle_tpu.amp
+(auto_cast + GradScaler over the WHITE_LIST/BLACK_LIST in
+amp/auto_cast.py); this module re-exports it under the contrib names
+and carries the AutoMixedPrecisionLists container."""
+from __future__ import annotations
+
+from ..amp import BLACK_LIST, WHITE_LIST  # noqa: F401
+from ..amp import GradScaler, auto_cast, decorate  # noqa: F401
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists"]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op lists for AMP (reference fp16_lists.py:17):
+    custom entries extend/override the framework defaults; a name in
+    custom_black_list wins over white (same precedence as the
+    reference's _update_list)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        cw = set(custom_white_list or ())
+        cb = set(custom_black_list or ())
+        overlap = cw & cb
+        if overlap:
+            raise ValueError(
+                f"custom_white_list and custom_black_list overlap: "
+                f"{sorted(overlap)}")
+        self.white_list = (set(WHITE_LIST) | cw) - cb
+        self.black_list = (set(BLACK_LIST) | cb) - cw
+        self.gray_list = set()
+
+    def __repr__(self):
+        return (f"AutoMixedPrecisionLists(white={sorted(self.white_list)},"
+                f" black={sorted(self.black_list)})")
+
+
+#: reference fp16_lists exposes CustomOpLists as an alias
+CustomOpLists = AutoMixedPrecisionLists

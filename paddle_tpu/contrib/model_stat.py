@@ -1,0 +1,51 @@
+"""fluid.contrib.model_stat (reference model_stat.py): per-op
+parameter/FLOPs summary table over a static Program."""
+from __future__ import annotations
+
+__all__ = []  # reference model_stat.py exports nothing via __all__
+
+
+def summary(main_prog):
+    """Print and return (total_params, total_flops) for `main_prog`
+    (reference model_stat.summary: counts conv/fc weights and their
+    MACs from the program's var shapes)."""
+    total_params = 0
+    total_flops = 0
+    rows = []
+    for block in main_prog.blocks:
+        for op in block.ops:
+            p = wnumel = 0
+            for names in op.inputs.values():
+                for name in names:
+                    var = block.vars.get(name)
+                    if var is None or not var.persistable or not var.shape:
+                        continue
+                    n = 1
+                    for s in var.shape:
+                        n *= max(int(s), 1)
+                    p += n
+                    if len(var.shape) >= 2:   # weights, not bias vectors
+                        wnumel += n
+            f = 0
+            if op.type in ("mul", "matmul") and wnumel:
+                f = 2 * wnumel
+            elif op.type in ("conv2d", "depthwise_conv2d") and wnumel:
+                # each weight element fires once per output position
+                spatial = 1
+                for names in op.outputs.values():
+                    for name in names:
+                        ov = block.vars.get(name)
+                        if ov is not None and ov.shape and \
+                                len(ov.shape) >= 4:
+                            for s in ov.shape[2:]:
+                                spatial *= max(int(s), 1)
+                f = 2 * wnumel * spatial
+            total_params += p
+            total_flops += f
+            if p:
+                rows.append((op.type, p, f))
+    print(f"{'op':<24}{'params':>12}{'flops':>14}")
+    for t, p, f in rows:
+        print(f"{t:<24}{p:>12}{f:>14}")
+    print(f"{'TOTAL':<24}{total_params:>12}{total_flops:>14}")
+    return total_params, total_flops

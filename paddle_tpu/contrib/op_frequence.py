@@ -1,0 +1,29 @@
+"""fluid.contrib.op_frequence (reference op_frequence.py): op-type
+frequency statistics over a Program — single ops and adjacent pairs."""
+from __future__ import annotations
+
+from collections import Counter, OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): OrderedDicts of op-type
+    and adjacent-pair counts, most frequent first (reference
+    op_freq_statistic)."""
+    from ..static.ir import Program
+
+    if not isinstance(program, Program):
+        raise TypeError(f"op_freq_statistic expects a Program, got "
+                        f"{type(program).__name__}")
+    uni: Counter = Counter()
+    adj: Counter = Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] += 1
+            if prev is not None:
+                adj[f"{prev}->{op.type}"] += 1
+            prev = op.type
+    return (OrderedDict(uni.most_common()),
+            OrderedDict(adj.most_common()))

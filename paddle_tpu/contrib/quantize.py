@@ -1,0 +1,148 @@
+"""fluid.contrib.quantize (reference contrib/quantize/
+quantize_transpiler.py): the static-graph quantization transpiler.
+training_transpile inserts fake_quantize_dequantize ops in front of
+the matmul/conv compute (simulated-quantization training with
+straight-through gradients — the kernel lives in static/kernels.py);
+freeze_program pins weight scales for inference; convert_to_int8
+stores the weights as int8 + scale in the scope. The dygraph-side
+counterpart is paddle_tpu.quantization (QAT/PTQ observers + int8 MXU
+matmul)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE = ("mul", "matmul", "conv2d", "depthwise_conv2d")
+_QUANT_TYPES = ("abs_max", "range_abs_max", "moving_average_abs_max")
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9):
+        if weight_quantize_type not in _QUANT_TYPES:
+            raise ValueError(
+                f"Unknown weight_quantize_type {weight_quantize_type!r}")
+        if activation_quantize_type not in _QUANT_TYPES:
+            raise ValueError(
+                f"Unknown activation_quantize_type "
+                f"{activation_quantize_type!r}")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.window_size = window_size
+        self.moving_rate = moving_rate
+
+    # -- training ----------------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Rewrite `program` in place: every input of a quantizable op
+        goes through a fake_quantize_dequantize_abs_max op (reference
+        training_transpile; abs-max scales are computed dynamically, so
+        the one kernel covers all three reference quant types during
+        training)."""
+        from ..static import default_main_program
+
+        program = program or default_main_program()
+        for block in program.blocks:
+            new_ops = []
+            quantized = {}
+            for op in block.ops:
+                if op.type in _QUANTIZABLE:
+                    for slot, names in op.inputs.items():
+                        rewired = []
+                        for name in names:
+                            var = block.vars.get(name)
+                            bits = (self.weight_bits
+                                    if var is not None and var.persistable
+                                    else self.activation_bits)
+                            qname = quantized.get((name, bits))
+                            if qname is None:
+                                qname = f"{name}.quantized"
+                                sname = f"{name}.scale"
+                                if var is not None:
+                                    block.create_var(
+                                        qname, shape=var.shape,
+                                        dtype=var.dtype)
+                                else:
+                                    block.create_var(qname)
+                                block.create_var(sname, shape=[])
+                                from ..static.ir import OpDesc
+
+                                new_ops.append(OpDesc(
+                                    "fake_quantize_dequantize_abs_max",
+                                    {"X": [name]},
+                                    {"Out": [qname], "OutScale": [sname]},
+                                    {"bit_length": bits}))
+                                quantized[(name, bits)] = qname
+                            rewired.append(qname)
+                        op.inputs[slot] = rewired
+                new_ops.append(op)
+            block.ops = new_ops
+        # direct block surgery bypasses append_op's version bump; the
+        # Executor's compiled-program cache keys on _version
+        program._version += 1
+        return program
+
+    # -- inference ---------------------------------------------------------
+    def freeze_program(self, program, place=None, scope=None):
+        """Pin weight scales from the trained weights and mark the fake
+        quant ops is_test (reference freeze_program): inference uses a
+        fixed scale instead of the per-batch abs-max."""
+        from ..static.executor import global_scope
+
+        scope = scope or global_scope()
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type != "fake_quantize_dequantize_abs_max":
+                    continue
+                name = op.inputs["X"][0]
+                w = scope.find_var(name)
+                op.attrs["is_test"] = True
+                if w is not None:
+                    arr = np.asarray(w)
+                    scale = max(float(np.max(np.abs(arr))), 1e-8)
+                    sname = op.outputs["OutScale"][0]
+                    in_name = f"{sname}.frozen"
+                    # persistable: the Executor feeds persistable scope
+                    # vars into the lowered env — a temp var would
+                    # silently drop the frozen scale
+                    block.create_var(in_name, shape=[], persistable=True)
+                    scope.set(in_name, np.asarray(scale, np.float32))
+                    op.inputs["InScale"] = [in_name]
+        program._version += 1
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """Store every quantized persistable weight as int8 alongside
+        its scale (reference convert_to_int8): scope[name.int8] holds
+        the int8 rows, scope[name.int8.scale] the dequant scale."""
+        from ..static.executor import global_scope
+
+        scope = scope or global_scope()
+        qmax = float(2 ** (self.weight_bits - 1) - 1)
+        converted = []
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type not in _QUANTIZABLE:
+                    continue
+                for names in op.inputs.values():
+                    for name in names:
+                        base = name.replace(".quantized", "")
+                        var = block.vars.get(base)
+                        if var is None or not var.persistable:
+                            continue
+                        w = scope.find_var(base)
+                        if w is None:
+                            continue
+                        arr = np.asarray(w)
+                        scale = max(float(np.max(np.abs(arr))), 1e-8)
+                        q = np.clip(np.round(arr / scale * qmax),
+                                    -qmax - 1, qmax).astype(np.int8)
+                        scope.set(f"{base}.int8", q)
+                        scope.set(f"{base}.int8.scale",
+                                      np.asarray(scale, np.float32))
+                        converted.append(base)
+        return converted

@@ -1,0 +1,28 @@
+"""fluid.contrib.reader (reference contrib/reader/
+distributed_reader.py): shard a batch reader across trainers by
+round-robin on batch index, driven by the launch env
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM — the same variables
+distributed/launch.py exports)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Each trainer sees every PADDLE_TRAINERS_NUM-th batch starting at
+    its PADDLE_TRAINER_ID (reference distributed_batch_reader)."""
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if trainer_id >= trainers:
+        raise ValueError(
+            f"PADDLE_TRAINER_ID {trainer_id} must be < "
+            f"PADDLE_TRAINERS_NUM {trainers}")
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+
+    return decorated

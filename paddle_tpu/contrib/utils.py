@@ -1,0 +1,124 @@
+"""fluid.contrib.utils (reference contrib/utils): HDFS helpers and the
+distributed lookup-table persistence utilities.
+
+- HDFSClient / multi_download / multi_upload (hdfs_utils.py:29): the
+  client itself lives in io/fs (hadoop-shell HDFSClient); the multi_*
+  helpers shard a directory's files across trainers and fan the
+  transfers out over a thread pool.
+- lookup_table_utils (lookup_table_utils.py:28): in this framework the
+  distributed lookup table is the parameter-server sparse KV store
+  (paddle_tpu.ps), so the conversion marks lookup ops distributed and
+  the loaders restore dense persistables + sparse table rows.
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+__all__ = [
+    "load_persistables_for_increment", "load_persistables_for_inference",
+    "convert_dist_to_sparse_program",
+    "HDFSClient", "multi_download", "multi_upload",
+]
+
+from ..io.fs import HDFSClient  # noqa: F401
+
+
+def _shard(files, trainer_id, trainers):
+    return [f for i, f in enumerate(sorted(files))
+            if i % max(trainers, 1) == trainer_id]
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Download this trainer's shard of `hdfs_path`'s files with a
+    thread pool (reference hdfs_utils.multi_download; threads instead
+    of processes — the hadoop shell-out releases the GIL)."""
+    files = client.ls_dir(hdfs_path)[1] if hasattr(client, "ls_dir") \
+        else client.ls(hdfs_path)
+    mine = _shard(files, trainer_id, trainers)
+    os.makedirs(local_path, exist_ok=True)
+    downloaded = []
+
+    def pull(f):
+        src = f if str(f).startswith(hdfs_path) else f"{hdfs_path}/{f}"
+        dst = os.path.join(local_path, os.path.basename(str(f)))
+        client.download(src, dst)
+        return dst
+
+    with ThreadPoolExecutor(max_workers=max(int(multi_processes), 1)) as ex:
+        downloaded = list(ex.map(pull, mine))
+    return downloaded
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """Upload every file under `local_path` with a thread pool
+    (reference hdfs_utils.multi_upload)."""
+    todo = []
+    for root, _dirs, files in os.walk(local_path):
+        for f in files:
+            todo.append(os.path.join(root, f))
+
+    def push(f):
+        rel = os.path.relpath(f, local_path)
+        client.upload(f, f"{hdfs_path}/{rel}")
+        return rel
+
+    with ThreadPoolExecutor(max_workers=max(int(multi_processes), 1)) as ex:
+        return list(ex.map(push, todo))
+
+
+def convert_dist_to_sparse_program(program):
+    """Mark every lookup_table op in `program` distributed+sparse
+    (reference lookup_table_utils.convert_dist_to_sparse_program:
+    rewrites the table to SelectedRows slices; here the sparse side IS
+    the ps/ KV store, so the program-side change is the op attrs that
+    route the lookup through it)."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in ("lookup_table", "lookup_table_v2"):
+                op.attrs["is_distributed"] = True
+                op.attrs["is_sparse"] = True
+                op.attrs["remote_prefetch"] = True
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """Load a dist-train checkpoint to continue training (reference
+    lookup_table_utils.load_persistables_for_increment): dense
+    persistables from `dirname` into the scope; the lookup table's rows
+    from `lookup_table_var_path` into the named scope var."""
+    from ..static import io as static_io
+
+    static_io.load_persistables(executor, dirname, main_program=program)
+    if lookup_table_var and lookup_table_var_path:
+        import numpy as np
+
+        from ..static.executor import global_scope
+
+        rows = np.load(lookup_table_var_path, allow_pickle=False)
+        global_scope().set(str(lookup_table_var), rows)
+    return program
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """Load persistables (including a saved lookup table, if a file
+    named after it exists in `dirname`) for inference (reference
+    lookup_table_utils.load_persistables_for_inference)."""
+    from ..static import io as static_io
+
+    static_io.load_persistables(executor, dirname, main_program=program)
+    if lookup_table_var_name:
+        import numpy as np
+
+        from ..static.executor import global_scope
+
+        path = os.path.join(dirname, f"{lookup_table_var_name}.npy")
+        if os.path.exists(path):
+            global_scope().set(str(lookup_table_var_name),
+                               np.load(path, allow_pickle=False))
+    return program

@@ -248,6 +248,26 @@ def _pow(ins, attrs, ctx):
     return _out(jnp.power(_x(ins), attrs.get("factor", 1.0)))
 
 
+@kernel("fake_quantize_dequantize_abs_max")
+def _fake_quantize_dequantize_abs_max(ins, attrs, ctx):
+    """Simulated quantization (reference fake_quantize_op.cc
+    FakeQuantizeDequantizeAbsMax): quantize to bit_length ints at the
+    dynamic abs-max scale, dequantize back, straight-through gradient
+    (the jax.vjp over this forward sees identity). Used by
+    contrib.QuantizeTranspiler.training_transpile."""
+    x = _x(ins)
+    bits = int(attrs.get("bit_length", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    if attrs.get("is_test", False) and "InScale" in ins:
+        scale = ins["InScale"][0]
+    # clip BEFORE rounding: values beyond the (frozen) scale must
+    # saturate exactly like the deployed int8 model would
+    q = jnp.round(jnp.clip(x / scale, -1.0, 1.0) * qmax) / qmax * scale
+    out = x + jax.lax.stop_gradient(q - x)
+    return {"Out": [out], "OutScale": [scale]}
+
+
 # ---------------------------------------------------------------------------
 # matmul / fc (reference operators/matmul_op.cc, mul_op.cc, math/fc.cc)
 # ---------------------------------------------------------------------------

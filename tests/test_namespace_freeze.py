@@ -39,6 +39,8 @@ TARGETS = {
     "io": "paddle_tpu.io",
     "static": "paddle_tpu.static",
     "utils": "paddle_tpu.utils",
+    "fluid.contrib": "paddle_tpu.contrib",
+    "fluid.contrib.layers": "paddle_tpu.contrib.layers",
     "fluid.metrics": "paddle_tpu.metric",
     "fluid.initializer": "paddle_tpu.nn.initializer",
     "fluid.regularizer": "paddle_tpu.regularizer",
@@ -62,6 +64,18 @@ EXCLUDED: dict = {
         "ComplexVariable": "complex dtypes ride Tensor natively",
         "HeterXpuTrainer": "heterogeneous CPU/XPU PS is a documented "
                            "non-goal (Baidu-internal hardware split)",
+    },
+    "fluid.contrib": {
+        "search_pyramid_hash": "Baidu pyramid-hash ANN serving op "
+                               "(pyramid_hash_op.cc ties to internal "
+                               "bloom-filter serving infra)",
+        "_pull_box_extended_sparse": "BoxPS ads-hardware lookup "
+                                     "(documented non-goal with "
+                                     "BoxWrapper)",
+    },
+    "fluid.contrib.layers": {
+        "search_pyramid_hash": "Baidu pyramid-hash ANN serving op",
+        "_pull_box_extended_sparse": "BoxPS ads-hardware lookup",
     },
 }
 
@@ -94,6 +108,7 @@ def test_freeze_counts_pinned():
         "utils": 3, "fluid.metrics": 9, "fluid.initializer": 16,
         "fluid.regularizer": 4, "fluid.clip": 5, "fluid.optimizer": 27,
         "paddle": 202, "fluid": 76, "fluid.dygraph": 57,
+        "fluid.contrib": 34, "fluid.contrib.layers": 19,
     }
     for ns, n in expected_min.items():
         assert len(FREEZE[ns]) >= n, (ns, len(FREEZE[ns]), n)

@@ -37,6 +37,8 @@ NAMESPACES = {
     "io": "io/__init__.py",
     "static": "static/__init__.py",
     "utils": "utils/__init__.py",
+    "fluid.contrib": "fluid/contrib/__init__.py",
+    "fluid.contrib.layers": "fluid/contrib/layers/__init__.py",
     "fluid.metrics": "fluid/metrics.py",
     "fluid.initializer": "fluid/initializer.py",
     "fluid.regularizer": "fluid/regularizer.py",
