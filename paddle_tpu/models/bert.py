@@ -99,12 +99,19 @@ class BertForPretraining(nn.Layer):
         self.mlm_bias = self.create_parameter([cfg.vocab_size], is_bias=True)
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        from .. import ops
+    def _mlm_hidden(self, seq):
+        """The MLM head pipeline up to (but not including) the tied
+        vocab projection — shared by forward() and the fused loss path
+        so the FLAGS_fused_vocab_xent A/B can never drift."""
         from ..nn import functional as F
 
+        return self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        from .. import ops
+
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
-        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        h = self._mlm_hidden(seq)
         # tied decoder: share word embedding weights
         logits = ops.matmul(h, self.bert.embeddings.word_embeddings.weight,
                             transpose_y=True) + self.mlm_bias
@@ -113,8 +120,22 @@ class BertForPretraining(nn.Layer):
 
     def loss(self, input_ids, token_type_ids, mlm_labels, nsp_labels,
              attention_mask=None, ignore_index=-100):
+        from ..framework.flags import get_flag
         from ..nn import functional as F
+        from ..ops.pallas import fused_xent  # noqa: F401 (defines flag)
 
+        if get_flag("fused_vocab_xent"):
+            # fused path: the (B*S, vocab) logits never land in HBM
+            # (ops/pallas/fused_xent.py; FLAGS_fused_vocab_xent=False
+            # restores the materialised-logits path for A/B timing)
+            seq, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask)
+            h = self._mlm_hidden(seq)
+            mlm = F.fused_linear_cross_entropy(
+                h, self.bert.embeddings.word_embeddings.weight,
+                self.mlm_bias, mlm_labels, ignore_index=ignore_index)
+            nsp = F.cross_entropy(self.nsp(pooled), nsp_labels)
+            return mlm + nsp
         logits, nsp_logits = self(input_ids, token_type_ids, attention_mask)
         mlm = F.cross_entropy(logits, mlm_labels, ignore_index=ignore_index)
         nsp = F.cross_entropy(nsp_logits, nsp_labels)

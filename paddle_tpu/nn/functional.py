@@ -736,6 +736,20 @@ def _reduce_loss(loss, reduction):
     return loss
 
 
+@primitive("fused_linear_cross_entropy", nondiff=("label",))
+def fused_linear_cross_entropy(h, weight, bias, label, ignore_index=-100,
+                               name=None):
+    """mean softmax-xent of (h @ weight^T + bias) without materialising
+    the (rows, vocab) logits in HBM: the Pallas kernel streams vocab
+    tiles with an online logsumexp (ops/pallas/fused_xent.py — the MLM
+    head's ~1 GB logits round-trips were the top non-MXU cost at
+    bert512). weight: (V, H) (embedding layout, tied-decoder ready);
+    falls back to the equivalent XLA computation off-TPU."""
+    from ..ops.pallas.fused_xent import fused_linear_cross_entropy as core
+
+    return core(h, weight, bias, label, ignore_index=ignore_index)
+
+
 @primitive("softmax_with_cross_entropy")
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,

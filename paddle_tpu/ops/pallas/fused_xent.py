@@ -1,0 +1,304 @@
+"""Fused linear + softmax cross-entropy for large vocabularies.
+
+The BERT MLM head computes logits = h @ W^T + b with W the tied
+(vocab, hidden) embedding table, then softmax-xent over vocab. At
+bert512 bench shapes the logits tensor is (32*512, 30592) — ~1 GB in
+bf16 — written to HBM by the matmul, read back by the softmax, and the
+same again for dlogits in the backward. That HBM traffic is pure
+overhead: these Pallas kernels stream W in vocab tiles over a 2D grid
+(rows-block outer, vocab-block inner — the inner axis revisits the
+same output block, the canonical Pallas reduction idiom), carrying an
+online max/sumexp + label-logit forward and recomputing the logit
+blocks in the backward for dh and dW/db (the flash trick: p =
+exp(s - lse) needs only the saved lse). Logits never land in HBM in
+either direction.
+
+Reference analog: softmax_with_cross_entropy_op.cu fuses softmax+xent
+(but not the matmul); the matmul fusion is the TPU-native extension
+the MFU push needs (VERDICT r4 #2). XLA fallback covers ineligible
+shapes/backends; dispatch truth via ops.pallas.counters("fused_xent").
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.flags import define_flag
+from .flash_attention import _dot, _sds
+
+define_flag("fused_vocab_xent", True,
+            "Route large-vocab linear+cross-entropy heads (BERT MLM) "
+            "through the streamed Pallas kernel; False materialises "
+            "logits via XLA (the A/B arm for the live session)")
+
+_F32 = jnp.float32
+_NEG = -1e30
+
+_BLOCK_N = 256
+_BLOCK_V = 512
+
+
+def _pick_bv(v):
+    """Largest vocab block dividing v (lane modulus 128): BERT's 30592
+    = 128 * 239 only admits 128-wide blocks; round vocabs get 512."""
+    for bv in (512, 384, 256, 128):
+        if v % bv == 0:
+            return bv
+    return None
+
+
+# ---------------------------------------------------------------------------
+# forward: grid (rows/bn, vocab/bv); m/l/ll accumulators live in output
+# refs indexed by the row block only (inner vocab steps revisit them)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(h_ref, w_ref, b_ref, lab_ref, lse_ref, ll_ref, m_ref,
+                l_ref, *, num_v, block_v):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        ll_ref[...] = jnp.zeros_like(ll_ref)
+
+    h = h_ref[...].astype(_F32)                    # (bn, H)
+    labels = lab_ref[0, :]                         # (bn,)
+    bn = h.shape[0]
+    s = _dot(h, w_ref[...].astype(_F32), trans_b=True)   # (bn, bv)
+    s = s + b_ref[0, :][None, :]
+    m = m_ref[0, :]
+    l = l_ref[0, :]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1))
+    l_new = l * jnp.exp(m - m_new) + jnp.sum(
+        jnp.exp(s - m_new[:, None]), axis=1)
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (bn, block_v),
+                                                 1)
+    hit = col == labels[:, None]
+    ll_ref[...] = ll_ref[...] + jnp.sum(
+        jnp.where(hit, s, 0.0), axis=1)[None, :]
+    m_ref[...] = m_new[None, :]
+    l_ref[...] = l_new[None, :]
+
+    @pl.when(j == num_v - 1)
+    def _finalize():
+        lse_ref[...] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+
+
+# ---------------------------------------------------------------------------
+# backward: dh over (rows, vocab) grid; dW/db over (vocab, rows) grid
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dh_kernel(h_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref, dh_ref, *,
+                   block_v):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dh_ref[...] = jnp.zeros_like(dh_ref)
+
+    h = h_ref[...].astype(_F32)
+    w = w_ref[...].astype(_F32)
+    labels = lab_ref[0, :]
+    lse = lse_ref[0, :]
+    g = g_ref[0, :]
+    bn = h.shape[0]
+    s = _dot(h, w, trans_b=True) + b_ref[0, :][None, :]
+    p = jnp.exp(s - lse[:, None])
+    col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (bn, block_v),
+                                                 1)
+    p = p - (col == labels[:, None]).astype(_F32)
+    # dh_ref is f32 regardless of input dtype: accumulating across the
+    # vocab grid steps in bf16 would compound rounding per step
+    dh_ref[...] = dh_ref[...] + _dot(p * g[:, None], w)
+
+
+def _bwd_dw_kernel(h_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
+                   dw_ref, db_ref, *, block_n, block_v):
+    from jax.experimental import pallas as pl
+
+    vj = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    w = w_ref[...].astype(_F32)                     # (bv, H)
+    bv = w.shape[0]
+    h = h_ref[...].astype(_F32)                     # (bn, H)
+    labels = lab_ref[0, :]
+    lse = lse_ref[0, :]
+    g = g_ref[0, :]
+    s = _dot(h, w, trans_b=True) + b_ref[0, :][None, :]
+    p = jnp.exp(s - lse[:, None])
+    col = vj * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (block_n, bv), 1)
+    p = (p - (col == labels[:, None]).astype(_F32)) * g[:, None]
+    # f32 accumulator refs (cast to the param dtype happens outside)
+    dw_ref[...] = dw_ref[...] + _dot(p.T, h)
+    db_ref[...] = db_ref[...] + jnp.sum(p, axis=0)[None, :]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing + custom_vjp
+# ---------------------------------------------------------------------------
+
+
+def _fwd_call(h, w, bias, labels, block_n, block_v):
+    from jax.experimental import pallas as pl
+
+    n, hd = h.shape
+    v = w.shape[0]
+    num_v = v // block_v
+    lse, ll, _m, _l = pl.pallas_call(
+        functools.partial(_fwd_kernel, num_v=num_v, block_v=block_v),
+        grid=(n // block_n, num_v),
+        in_specs=[
+            pl.BlockSpec((block_n, hd), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_v, hd), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            _sds((1, n), _F32, h),     # lse
+            _sds((1, n), _F32, h),     # label logit
+            _sds((1, n), _F32, h),     # running max (scratch-as-output)
+            _sds((1, n), _F32, h),     # running sumexp
+        ],
+    )(h, w, bias[None, :], labels[None, :])
+    return lse[0], ll[0]
+
+
+def _bwd_call(h, w, bias, labels, lse, g, block_n, block_v):
+    from jax.experimental import pallas as pl
+
+    n, hd = h.shape
+    v = w.shape[0]
+    dh = pl.pallas_call(
+        functools.partial(_bwd_dh_kernel, block_v=block_v),
+        grid=(n // block_n, v // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, hd), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_v, hd), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((block_n, hd), lambda i, j: (i, 0)),
+        out_shape=_sds((n, hd), _F32, h),
+    )(h, w, bias[None, :], labels[None, :], lse[None, :], g[None, :])
+    dw, db = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, block_n=block_n,
+                          block_v=block_v),
+        grid=(v // block_v, n // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, hd), lambda vj, i: (i, 0)),
+            pl.BlockSpec((block_v, hd), lambda vj, i: (vj, 0)),
+            pl.BlockSpec((1, block_v), lambda vj, i: (0, vj)),
+            pl.BlockSpec((1, block_n), lambda vj, i: (0, i)),
+            pl.BlockSpec((1, block_n), lambda vj, i: (0, i)),
+            pl.BlockSpec((1, block_n), lambda vj, i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_v, hd), lambda vj, i: (vj, 0)),
+            pl.BlockSpec((1, block_v), lambda vj, i: (0, vj)),
+        ],
+        out_shape=[
+            _sds((v, hd), _F32, h),
+            _sds((1, v), _F32, h),
+        ],
+    )(h, w, bias[None, :], labels[None, :], lse[None, :], g[None, :])
+    return dh.astype(h.dtype), dw.astype(w.dtype), db[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_xent_core(h, w, bias, labels, ignore_index):
+    loss, _ = _fused_xent_fwd(h, w, bias, labels, ignore_index)
+    return loss
+
+
+def _fused_xent_fwd(h, w, bias, labels, ignore_index):
+    valid = labels != ignore_index
+    # rows with ignored labels still flow through the kernel; clamp the
+    # label so the in-kernel hit-test never matches, zero the loss after
+    safe = jnp.where(valid, labels, -1).astype(jnp.int32)
+    lse, ll = _fwd_call(h, w, bias, safe, _BLOCK_N, _pick_bv(w.shape[0]))
+    count = jnp.maximum(jnp.sum(valid.astype(_F32)), 1.0)
+    loss = jnp.sum(jnp.where(valid, lse - ll, 0.0)) / count
+    return loss, (h, w, bias, safe, valid, lse, count)
+
+
+def _fused_xent_bwd(ignore_index, res, dloss):
+    h, w, bias, safe, valid, lse, count = res
+    g = jnp.where(valid, dloss / count, 0.0).astype(_F32)
+    dh, dw, db = _bwd_call(h, w, bias, safe, lse, g, _BLOCK_N,
+                           _pick_bv(w.shape[0]))
+    return dh, dw, db.astype(bias.dtype), None
+
+
+_fused_xent_core.defvjp(_fused_xent_fwd, _fused_xent_bwd)
+
+
+def _eligible(n, hd, v):
+    from ...framework.bringup import pallas_enabled
+
+    if not pallas_enabled():
+        return False
+    return (n % _BLOCK_N == 0 and _pick_bv(v) is not None and
+            hd % 128 == 0 and hd <= 2048)
+
+
+def fused_linear_cross_entropy(h, w, bias, labels, ignore_index=-100):
+    """mean softmax-xent of (h @ w^T + bias) against labels, streaming
+    the vocab axis so the logits never land in HBM. h: (..., H); w:
+    (V, H); bias: (V,); labels: (...,) int. Falls back to the XLA
+    logits path off-TPU / for ineligible shapes (counters record
+    which)."""
+    from .counters import bump
+
+    hd = h.shape[-1]
+    h2 = h.reshape(-1, hd)
+    lab = labels.reshape(-1)
+    n = h2.shape[0]
+    pad = (-n) % _BLOCK_N
+    if _eligible(n + pad, hd, w.shape[0]):
+        try:
+            if pad:
+                h2 = jnp.concatenate(
+                    [h2, jnp.zeros((pad, hd), h2.dtype)], 0)
+                lab = jnp.concatenate(
+                    [lab, jnp.full((pad,), ignore_index, lab.dtype)], 0)
+            out = _fused_xent_core(h2, w, bias, lab, int(ignore_index))
+            bump("fused_xent", "pallas")
+            return out
+        except Exception as e:
+            bump("fused_xent", "xla",
+                 f"kernel error {type(e).__name__}: {e}")
+    else:
+        bump("fused_xent", "xla",
+             f"dispatch ineligible (n={n}, w={tuple(w.shape)})")
+    logits = (h2 @ w.T).astype(_F32) + bias.astype(_F32)
+    valid = lab != ignore_index
+    safe = jnp.where(valid, lab, 0)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(
+        logits, safe[:, None].astype(jnp.int32), axis=1)[:, 0]
+    count = jnp.maximum(jnp.sum(valid.astype(_F32)), 1.0)
+    return jnp.sum(jnp.where(valid, lse - ll, 0.0)) / count

@@ -1,0 +1,52 @@
+"""Worker for the fault-injection resume test (launched by
+tests/test_fault_resume.py through distributed.launch): trains a tiny
+regression with TrainEpochRange auto-checkpointing; crashes mid-epoch
+at KILL_AT_EPOCH to simulate a trainer failure."""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    kill_at = int(os.environ.get("KILL_AT_EPOCH", "-1"))
+    log_path = os.environ["FAULT_LOG"]
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+        TrainEpochRange,
+    )
+
+    paddle.seed(0)
+    model = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 4).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5], [2.0]], np.float32)
+    x = paddle.to_tensor(xv)
+    y = paddle.to_tensor(xv @ w_true)
+
+    tr = TrainEpochRange(6, name="fault_job")
+    tr.register(model=model, optimizer=opt)
+    for epoch in tr.get():
+        for _ in range(5):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        if epoch == kill_at:
+            # crash MID-epoch: this epoch must not be checkpointed
+            os._exit(17)
+        with open(log_path, "a") as f:
+            f.write(json.dumps({
+                "epoch": epoch, "loss": float(loss.numpy()),
+                "restored": tr.restored_epoch,
+                "trainer_id": os.environ.get("PADDLE_TRAINER_ID"),
+            }) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

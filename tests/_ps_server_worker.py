@@ -1,0 +1,26 @@
+"""PSServer subprocess for the at-scale PS bench: one server process
+hosting one SparseTable shard; prints its endpoint and serves until
+killed."""
+import os
+import sys
+import time
+
+
+def main():
+    from paddle_tpu.ps.service import PSServer
+    from paddle_tpu.ps.table import SparseTable
+
+    dim = int(os.environ.get("PS_DIM", "16"))
+    srv = PSServer({0: SparseTable(dim=dim, init_range=0.01, seed=1)})
+    srv.start()
+    print(f"ENDPOINT {srv.endpoint}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

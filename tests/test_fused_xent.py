@@ -1,0 +1,179 @@
+"""Fused linear+cross-entropy kernel (ops/pallas/fused_xent.py, the
+bert512 MFU item — VERDICT r4 #2): interpret-mode numerics vs the
+materialised-logits reference, gradients through the custom_vjp, the
+ignore_index/padding contract, dispatch truth, and the BERT loss A/B.
+Real Mosaic lowering is exercised by tests/test_fused_xent_tpu.py in
+the live session."""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.framework.bringup as bringup
+from paddle_tpu.ops.pallas import counters
+from paddle_tpu.ops.pallas import fused_xent as fx
+
+N, H, V = 512, 128, 1024
+
+
+@pytest.fixture
+def interp(monkeypatch):
+    from jax.experimental import pallas as pl
+
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(pl.pallas_call, interpret=True))
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    counters.reset()
+    yield
+    counters.reset()
+
+
+def _data(n=N, h=H, v=V, seed=0, ignore_frac=0.3):
+    rng = np.random.RandomState(seed)
+    hmat = jnp.asarray(rng.randn(n, h) * 0.2, jnp.float32)
+    w = jnp.asarray(rng.randn(v, h) * 0.2, jnp.float32)
+    b = jnp.asarray(rng.randn(v) * 0.1, jnp.float32)
+    lab = rng.randint(0, v, n)
+    lab[rng.rand(n) < ignore_frac] = -100
+    return hmat, w, b, jnp.asarray(lab, jnp.int32)
+
+
+def _ref_loss(h, w, b, lab, ignore_index=-100):
+    logits = h @ w.T + b
+    valid = lab != ignore_index
+    safe = jnp.where(valid, lab, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+    cnt = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return jnp.sum(jnp.where(valid, -ll, 0.0)) / cnt
+
+
+def test_forward_matches_reference(interp):
+    h, w, b, lab = _data()
+    out = fx.fused_linear_cross_entropy(h, w, b, lab)
+    assert counters.snapshot().get("fused_xent.pallas", 0) == 1
+    ref = _ref_loss(h, w, b, lab)
+    np.testing.assert_allclose(float(out), float(ref), rtol=2e-5)
+
+
+def test_all_ignored_is_finite(interp):
+    h, w, b, _ = _data()
+    lab = jnp.full((N,), -100, jnp.int32)
+    out = fx.fused_linear_cross_entropy(h, w, b, lab)
+    assert float(out) == 0.0
+
+
+def test_grads_match_reference(interp):
+    h, w, b, lab = _data(seed=1)
+
+    g_f = jax.grad(
+        lambda *a: fx.fused_linear_cross_entropy(*a, lab) * 3.0,
+        argnums=(0, 1, 2))(h, w, b)
+    assert counters.snapshot().get("fused_xent.pallas", 0) >= 1
+    g_r = jax.grad(lambda *a: _ref_loss(*a, lab) * 3.0,
+                   argnums=(0, 1, 2))(h, w, b)
+    for a, r, tol in zip(g_f, g_r, (2e-5, 2e-5, 2e-5)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=tol)
+
+
+def test_row_padding_path(interp):
+    """Row counts off the block modulus are padded with ignored labels
+    — same loss, same grads for the real rows."""
+    n = 300   # not a multiple of 256
+    h, w, b, lab = _data(n=n, seed=2)
+    out = fx.fused_linear_cross_entropy(h, w, b, lab)
+    assert counters.snapshot().get("fused_xent.pallas", 0) == 1
+    ref = _ref_loss(h, w, b, lab)
+    np.testing.assert_allclose(float(out), float(ref), rtol=2e-5)
+    gh = jax.grad(lambda x: fx.fused_linear_cross_entropy(
+        x, w, b, lab))(h)
+    gr = jax.grad(lambda x: _ref_loss(x, w, b, lab))(h)
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(gr),
+                               rtol=1e-4, atol=2e-5)
+
+
+def test_vocab_128_modulus_dispatches(interp):
+    """BERT's real vocab (30592 = 128*239) only admits 128-wide blocks
+    — the divisor-pick must keep such vocabs on the kernel (the r5
+    review caught a %512 gate silently rejecting the target workload)."""
+    h, w, b, lab = _data(v=640, seed=7)    # 640 = 128*5, not %512/%256
+    out = fx.fused_linear_cross_entropy(h, w, b, lab)
+    assert counters.snapshot().get("fused_xent.pallas", 0) == 1
+    np.testing.assert_allclose(float(out), float(_ref_loss(h, w, b, lab)),
+                               rtol=2e-5)
+    gh, gw, gb = jax.grad(
+        lambda *a: fx.fused_linear_cross_entropy(*a, lab),
+        argnums=(0, 1, 2))(h, w, b)
+    gr = jax.grad(lambda *a: _ref_loss(*a, lab), argnums=(0, 1, 2))(h, w,
+                                                                    b)
+    for a, r in zip((gh, gw, gb), gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=2e-5)
+
+
+def test_bf16_grads_accumulate_in_f32(interp):
+    """bf16 inputs must not accumulate partial grads in bf16 across
+    grid steps (f32 accumulator refs, single cast at the end)."""
+    h, w, b, lab = _data(seed=8)
+    h16, w16 = h.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    gh, gw, _ = jax.grad(
+        lambda *a: fx.fused_linear_cross_entropy(*a, lab),
+        argnums=(0, 1, 2))(h16, w16, b)
+    assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+    gr = jax.grad(
+        lambda hh, ww: _ref_loss(hh.astype(jnp.float32),
+                                 ww.astype(jnp.float32), b, lab),
+        argnums=(0, 1))(h16, w16)
+    np.testing.assert_allclose(np.asarray(gh, jnp.float32),
+                               np.asarray(gr[0], jnp.float32),
+                               rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gw, jnp.float32),
+                               np.asarray(gr[1], jnp.float32),
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_ineligible_vocab_falls_back(interp):
+    h, w, b, lab = _data(v=100, seed=3)   # 100 % 512 != 0
+    out = fx.fused_linear_cross_entropy(h, w, b, lab)
+    snap = counters.snapshot()
+    assert snap.get("fused_xent.pallas", 0) == 0
+    assert snap.get("fused_xent.xla", 0) == 1
+    np.testing.assert_allclose(float(out), float(_ref_loss(h, w, b, lab)),
+                               rtol=2e-5)
+
+
+def test_bert_loss_flag_ab(interp):
+    """FLAGS_fused_vocab_xent on/off agree on the BERT pretraining loss
+    — the exact A/B the live session times."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    cfg = BertConfig.tiny()          # vocab 1024 (512-modulus ok)
+    cfg.num_hidden_layers = 2
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    paddle.seed(0)
+    m = BertForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (2, 64)).astype(np.int32))
+    tt = paddle.to_tensor(np.zeros((2, 64), np.int32))
+    mlm = rng.randint(0, cfg.vocab_size, (2, 64))
+    mlm[rng.rand(2, 64) < 0.8] = -100     # MLM masks ~20% of positions
+    mlm_t = paddle.to_tensor(mlm.astype(np.int32))
+    nsp = paddle.to_tensor(rng.randint(0, 2, (2,)).astype(np.int32))
+
+    counters.reset()
+    fused = float(m.loss(ids, tt, mlm_t, nsp).numpy())
+    assert counters.snapshot().get("fused_xent.pallas", 0) == 1
+    set_flags({"fused_vocab_xent": False})
+    try:
+        unfused = float(m.loss(ids, tt, mlm_t, nsp).numpy())
+    finally:
+        set_flags({"fused_vocab_xent": True})
+    np.testing.assert_allclose(fused, unfused, rtol=5e-5)

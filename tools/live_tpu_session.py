@@ -64,7 +64,7 @@ def main():
     # for Mosaic lowering — the r3 fused-embedding lesson)
     _run([sys.executable, "-m", "pytest", "-q",
           "tests/test_flash_short_tpu.py", "tests/test_flash_dropout_tpu.py",
-          "tests/test_ring_flash_tpu.py",
+          "tests/test_ring_flash_tpu.py", "tests/test_fused_xent_tpu.py",
           "-p", "no:cacheprovider", "--noconftest"],
          timeout=900, env=dict(os.environ))
 
@@ -78,6 +78,13 @@ def main():
         ab["FLAGS_flash_short_seq"] = "1"
         _run([sys.executable, "bench.py", "--config", "bert"],
              timeout=1200, env=ab)
+        # fused-vocab-xent A/B at seq 512 (the MFU push, VERDICT r4 #2):
+        # the default run above measures the fused path; this arm
+        # re-measures bert512 with logits materialised via XLA
+        ab2 = dict(env)
+        ab2["FLAGS_fused_vocab_xent"] = "0"
+        _run([sys.executable, "bench.py", "--config", "bert512"],
+             timeout=1200, env=ab2)
 
     # op-bench: TPU baseline rows (the gate's committed reference)
     _run([sys.executable, "tools/op_bench.py",
