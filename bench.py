@@ -103,6 +103,20 @@ def _peak_flops(kind: str):
     return None
 
 
+def attach_mfu(row: dict) -> dict:
+    """Fill row['device_kind']/row['mfu'] from its flops_per_step/dt/
+    steps — the ONE place the MFU formula lives (run_config and
+    tools/profile_step.py both use it)."""
+    kind = _device_kind()
+    peak = _peak_flops(kind)
+    fps = row.get("flops_per_step")
+    mfu = None
+    if fps and peak and row.get("dt") and row.get("steps"):
+        mfu = round(fps * row["steps"] / row["dt"] / peak, 4)
+    row.update(device_kind=kind, mfu=mfu)
+    return row
+
+
 def _time_steps(step, args, steps):
     """Run `steps` timed iterations after one compile/warmup call.
     Returns wall-clock seconds; the final loss is synced on device."""
@@ -392,12 +406,10 @@ def run_config(name: str, smoke: bool, backend: str,
     try:
         res = (bench_bert(seq=128, trend=True)
                if trend and name == "bert" else CONFIGS[name](smoke))
-        kind = _device_kind()
-        peak = _peak_flops(kind)
+        attach_mfu(res)
+        kind = res["device_kind"]
+        mfu = res.pop("mfu")
         fps = res.pop("flops_per_step", None)
-        mfu = None
-        if fps and peak and res.get("dt") and res.get("steps"):
-            mfu = round(fps * res["steps"] / res["dt"] / peak, 4)
         comparable = _comparable(smoke) and not degraded
         base = DRIVER_CAPTURED_BASELINES.get(name) if comparable else None
         row.update(res)

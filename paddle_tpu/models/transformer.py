@@ -40,20 +40,33 @@ class TransformerNMT(nn.Layer):
             dim_feedforward, dropout)
         self.out_proj = nn.Linear(d_model, tgt_vocab_size)
 
-    def forward(self, src, tgt, src_mask=None):
-        from .. import ops
-
+    def _decode_hidden(self, src, tgt, src_mask=None):
+        """Everything up to (not including) the vocab projection —
+        shared by forward() and the fused-xent loss path."""
         scale = math.sqrt(self.d_model)
         src_e = self.pos(self.src_embed(src) * scale)
         tgt_e = self.pos(self.tgt_embed(tgt) * scale)
         tgt_mask = nn.Transformer.generate_square_subsequent_mask(tgt.shape[1])
-        out = self.transformer(src_e, tgt_e, src_mask=src_mask,
-                               tgt_mask=tgt_mask)
-        return self.out_proj(out)
+        return self.transformer(src_e, tgt_e, src_mask=src_mask,
+                                tgt_mask=tgt_mask)
+
+    def forward(self, src, tgt, src_mask=None):
+        return self.out_proj(self._decode_hidden(src, tgt, src_mask))
 
     def loss(self, src, tgt_in, tgt_out, pad_id=0):
+        from .. import ops
+        from ..framework.flags import get_flag
         from ..nn import functional as F
+        from ..ops.pallas import fused_xent  # noqa: F401 (defines flag)
 
+        if get_flag("fused_vocab_xent"):
+            # streamed vocab xent: the (B*T, 32000) logits never land
+            # in HBM (fused kernel wants (V, H) — one 65 MB weight
+            # transpose buys back ~1 GB of logits traffic per step)
+            h = self._decode_hidden(src, tgt_in)
+            w_t = ops.transpose(self.out_proj.weight, [1, 0])
+            return F.fused_linear_cross_entropy(
+                h, w_t, self.out_proj.bias, tgt_out, ignore_index=pad_id)
         logits = self(src, tgt_in)
         return F.cross_entropy(logits, tgt_out, ignore_index=pad_id)
 

@@ -55,3 +55,58 @@ def test_default_captures_path_is_repo_root():
     finally:
         if old is not None:
             os.environ["BENCH_CAPTURES_PATH"] = old
+
+
+def test_profile_trace_summarizer(tmp_path):
+    """tools/profile_step.summarize_trace turns a chrome trace into the
+    committed device-time-by-op table (synthetic trace; the real one
+    needs the live chip)."""
+    import gzip
+    import importlib.util
+    import json
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "profile_step", os.path.join(repo, "tools", "profile_step.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    trace = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "python"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 7, "tid": 3,
+         "args": {"name": "XLA Modules"}},
+        {"ph": "X", "pid": 7, "tid": 2, "name": "fusion.12",
+         "dur": 3000.0},
+        {"ph": "X", "pid": 7, "tid": 2, "name": "fusion.13",
+         "dur": 1000.0},
+        {"ph": "X", "pid": 7, "tid": 2, "name": "copy-start.1",
+         "dur": 500.0},
+        # module span == sum of the ops under it: counting it would
+        # double the total (the r5 review catch)
+        {"ph": "X", "pid": 7, "tid": 3, "name": "jit_train_step",
+         "dur": 4500.0},
+        {"ph": "X", "pid": 1, "tid": 9, "name": "host-stuff",
+         "dur": 9999.0},
+    ]}
+    d = tmp_path / "plugins"
+    d.mkdir()
+    with gzip.open(d / "t.trace.json.gz", "wt") as f:
+        json.dump(trace, f)
+    out = tmp_path / "XPLANE_SUMMARY.md"
+    ok = mod.summarize_trace(str(tmp_path), "bert512",
+                             {"value": 1.0, "unit": "tok/s",
+                              "device_kind": "fake-v5e", "mfu": 0.5},
+                             str(out))
+    assert ok
+    text = out.read_text()
+    assert "| fusion | 4.00 |" in text          # instances folded
+    assert "88.9%" in text                      # 4000/4500 device time
+    assert "host-stuff" not in text             # host track excluded
+    assert "jit_train_step" not in text         # module line excluded
+    assert "| TOTAL (all ops) | 4.50 |" in text  # no double count
+    assert "bert512 @ fake-v5e" in text

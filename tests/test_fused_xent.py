@@ -146,6 +146,34 @@ def test_ineligible_vocab_falls_back(interp):
                                rtol=2e-5)
 
 
+def test_nmt_loss_flag_ab(interp):
+    """The Transformer NMT head (Linear (H, V)) routes through the
+    fused kernel too — flag on/off must agree."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.models.transformer import TransformerNMT
+
+    paddle.seed(0)
+    m = TransformerNMT(src_vocab_size=512, tgt_vocab_size=512,
+                       d_model=128, nhead=4, num_encoder_layers=1,
+                       num_decoder_layers=1, dim_feedforward=128,
+                       dropout=0.0)
+    rng = np.random.RandomState(0)
+    src = paddle.to_tensor(rng.randint(1, 512, (2, 16)).astype(np.int64))
+    tin = paddle.to_tensor(rng.randint(1, 512, (2, 16)).astype(np.int64))
+    tout = paddle.to_tensor(rng.randint(0, 512, (2, 16)).astype(np.int64))
+
+    counters.reset()
+    fused = float(m.loss(src, tin, tout).numpy())
+    assert counters.snapshot().get("fused_xent.pallas", 0) == 1
+    set_flags({"fused_vocab_xent": False})
+    try:
+        unfused = float(m.loss(src, tin, tout).numpy())
+    finally:
+        set_flags({"fused_vocab_xent": True})
+    np.testing.assert_allclose(fused, unfused, rtol=5e-5)
+
+
 def test_bert_loss_flag_ab(interp):
     """FLAGS_fused_vocab_xent on/off agree on the BERT pretraining loss
     — the exact A/B the live session times."""

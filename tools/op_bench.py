@@ -233,11 +233,48 @@ def bench_transpose(smoke):
             "gbps": x.nbytes * 2 / (ms / 1e3) / 1e9}
 
 
+def bench_fused_xent(smoke):
+    """MLM-head A/B (VERDICT r4 #2): fused streamed linear+xent kernel
+    vs the materialised-logits XLA path, fwd+bwd at BERT shapes."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.bringup import TPU_PLATFORMS
+    from paddle_tpu.ops.pallas.fused_xent import (
+        _fused_xent_core, fused_linear_cross_entropy)
+
+    if jax.default_backend() not in TPU_PLATFORMS:
+        return {"op": "fused_xent_vs_xla", "skipped": "tpu-only"}
+    n, hd, v = (512, 128, 1024) if smoke else (4096, 768, 30592)
+    key = jax.random.key(0)
+    h = jax.random.normal(key, (n, hd), jnp.bfloat16) * 0.2
+    w = jax.random.normal(jax.random.key(1), (v, hd), jnp.bfloat16) * 0.2
+    b = jnp.zeros((v,), jnp.float32)
+    lab = jax.random.randint(jax.random.key(2), (n,), 0, v, jnp.int32)
+
+    fused = jax.jit(jax.grad(
+        lambda h_, w_: _fused_xent_core(h_, w_, b, lab, -100),
+        argnums=(0, 1)))
+
+    def xla_loss(h_, w_):
+        logits = (h_ @ w_.T).astype(jnp.float32) + b
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, lab[:, None], axis=1))
+
+    xla = jax.jit(jax.grad(xla_loss, argnums=(0, 1)))
+    ms_fused = _timeit(fused, h, w)
+    ms_xla = _timeit(xla, h, w)
+    return {"op": "fused_xent_vs_xla", "shape": f"{n}x{hd}x{v}",
+            "ms": ms_fused, "ms_xla": round(ms_xla, 4),
+            "speedup": round(ms_xla / ms_fused, 3)}
+
+
 BENCHES = {
     "matmul": bench_matmul,
     "attention": bench_attention,
     "flash_attention": bench_flash_attention,
     "flash_short": bench_flash_short,
+    "fused_xent": bench_fused_xent,
     "layernorm": bench_layernorm,
     "embedding": bench_embedding,
     "fused_embedding": bench_fused_embedding,
