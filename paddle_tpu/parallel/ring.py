@@ -405,8 +405,15 @@ def ulysses_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
     (B, L_full, H/size, D), local full attention, all_to_all back.
     kv_mask: optional (B, L_full) bool key-padding mask, replicated over
     the axis (after the all-to-all every device sees the full kv axis).
+
+    The post-all-to-all local attention sees the FULL sequence with a
+    head subset — exactly the flash kernel's sweet spot at the long
+    lengths Ulysses exists for — so the mask-free path dispatches
+    through _local_attention (Pallas when eligible; NOT
+    flash_attention_or_fallback, which would re-enter the active
+    sequence_parallel context and recurse).
     """
-    from ..ops.pallas.flash_attention import _xla_attention
+    from ..ops.pallas.flash_attention import _local_attention, _xla_attention
 
     def a2a_fwd(x):   # seq-sharded -> head-sharded
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -417,9 +424,12 @@ def ulysses_attention_local(q, k, v, axis_name: str, is_causal: bool = False,
                                   tiled=True)
 
     qa, ka, va = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
-    mask = (kv_mask[:, None, None, :].astype(jnp.bool_)
-            if kv_mask is not None else None)
-    out = _xla_attention(qa, ka, va, mask, 0.0, is_causal, None)
+    if kv_mask is None:
+        out = _local_attention(qa, ka, va, is_causal)
+    else:
+        out = _xla_attention(qa, ka, va,
+                             kv_mask[:, None, None, :].astype(jnp.bool_),
+                             0.0, is_causal, None)
     return a2a_bwd(out)
 
 
