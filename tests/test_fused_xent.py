@@ -66,6 +66,7 @@ def test_all_ignored_is_finite(interp):
     assert float(out) == 0.0
 
 
+@pytest.mark.slow
 def test_grads_match_reference(interp):
     h, w, b, lab = _data(seed=1)
 
@@ -80,6 +81,7 @@ def test_grads_match_reference(interp):
                                    rtol=1e-4, atol=tol)
 
 
+@pytest.mark.slow
 def test_row_padding_path(interp):
     """Row counts off the block modulus are padded with ignored labels
     — same loss, same grads for the real rows."""
@@ -96,6 +98,7 @@ def test_row_padding_path(interp):
                                rtol=1e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_vocab_128_modulus_dispatches(interp):
     """BERT's real vocab (30592 = 128*239) only admits 128-wide blocks
     — the divisor-pick must keep such vocabs on the kernel (the r5
@@ -115,6 +118,7 @@ def test_vocab_128_modulus_dispatches(interp):
                                    rtol=1e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bf16_grads_accumulate_in_f32(interp):
     """bf16 inputs must not accumulate partial grads in bf16 across
     grid steps (f32 accumulator refs, single cast at the end)."""
@@ -146,6 +150,7 @@ def test_ineligible_vocab_falls_back(interp):
                                rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_nmt_loss_flag_ab(interp):
     """The Transformer NMT head (Linear (H, V)) routes through the
     fused kernel too — flag on/off must agree."""
@@ -174,6 +179,7 @@ def test_nmt_loss_flag_ab(interp):
     np.testing.assert_allclose(fused, unfused, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_bert_loss_flag_ab(interp):
     """FLAGS_fused_vocab_xent on/off agree on the BERT pretraining loss
     — the exact A/B the live session times."""
