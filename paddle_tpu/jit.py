@@ -280,13 +280,18 @@ class TrainStep:
                 model.load_buffer_pytree(buffers)
                 from contextlib import nullcontext
 
+                from .parallel.mesh import trace_mesh as _trace_mesh_scope
                 from .parallel.ring import sequence_parallel as _sp_scope
 
                 sp_ctx = (_sp_scope(*self._sequence_parallel,
                                     mesh=self._mesh)
                           if self._sequence_parallel else nullcontext())
+                # mark the mesh governing this trace so non-shard_map
+                # pallas kernels (fused_xent) can self-gate on >1 devices
+                mesh_ctx = _trace_mesh_scope(self._mesh)
                 try:
-                    with tape_mod.no_grad(), rng_scope(key), sp_ctx:
+                    with tape_mod.no_grad(), rng_scope(key), sp_ctx, \
+                            mesh_ctx:
                         out = loss_fn(model, *[_wrap_in(b) for b in batch])
                     loss = out[0] if isinstance(out, (tuple, list)) else out
                     aux = out[1:] if isinstance(out, (tuple, list)) else ()

@@ -256,6 +256,21 @@ def _fused_xent_bwd(ignore_index, res, dloss):
 _fused_xent_core.defvjp(_fused_xent_fwd, _fused_xent_bwd)
 
 
+def _multi_device_trace():
+    """True while TrainStep traces over a >1-device mesh: the loss runs
+    inside pjit WITHOUT a shard_map wrapper (unlike the ring kernels),
+    and XLA cannot SPMD-partition an opaque pallas custom call — the
+    XLA fallback is partitionable and value-identical, so multi-chip
+    training stays correct while single-chip keeps the fused win. The
+    trace-time marker (parallel.mesh.trace_mesh, set by TrainStep) is
+    authoritative — NOT the ambient global mesh, which leaks across
+    callers and may differ from the mesh governing this trace."""
+    from ...parallel.mesh import active_trace_mesh
+
+    mesh = active_trace_mesh()
+    return mesh is not None and mesh.size > 1
+
+
 def _eligible(n, hd, v):
     from ...framework.bringup import pallas_enabled
 
@@ -278,7 +293,12 @@ def fused_linear_cross_entropy(h, w, bias, labels, ignore_index=-100):
     lab = labels.reshape(-1)
     n = h2.shape[0]
     pad = (-n) % _BLOCK_N
-    if _eligible(n + pad, hd, w.shape[0]):
+    if _multi_device_trace():
+        bump("fused_xent", "xla",
+             "gated off under a multi-device TrainStep trace (pjit "
+             "cannot partition the opaque pallas call; XLA path is "
+             "value-identical and partitionable)")
+    elif _eligible(n + pad, hd, w.shape[0]):
         try:
             if pad:
                 h2 = jnp.concatenate(

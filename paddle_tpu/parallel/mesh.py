@@ -10,6 +10,7 @@ DCN axis outermost.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -56,6 +57,32 @@ def get_mesh() -> Optional[Mesh]:
 
 def set_mesh(mesh: Mesh):
     _global_mesh[0] = mesh
+
+
+# -- trace-time mesh marker -------------------------------------------------
+# TrainStep sets this while TRACING its pjit'd step (same trace-time
+# pattern as ring.sequence_parallel): kernels whose pallas custom calls
+# XLA cannot SPMD-partition (fused_xent — not wrapped in shard_map)
+# consult it to self-gate under multi-device traces. The ambient
+# _global_mesh is NOT used for that decision: it leaks across tests and
+# may differ from the mesh actually governing the trace.
+
+_trace_mesh: list = [None]
+
+
+@contextmanager
+def trace_mesh(mesh: Optional[Mesh]):
+    prev = _trace_mesh[0]
+    _trace_mesh[0] = mesh
+    try:
+        yield
+    finally:
+        _trace_mesh[0] = prev
+
+
+def active_trace_mesh() -> Optional[Mesh]:
+    """The mesh of the TrainStep trace currently being built, if any."""
+    return _trace_mesh[0]
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -211,3 +211,54 @@ def test_bert_loss_flag_ab(interp):
     finally:
         set_flags({"fused_vocab_xent": True})
     np.testing.assert_allclose(fused, unfused, rtol=5e-5)
+
+
+@pytest.mark.slow
+def test_multi_device_trainstep_gates_fused_path(interp):
+    """Under a >1-device TrainStep trace the fused kernel self-gates
+    (pjit cannot partition the opaque pallas call); the XLA path keeps
+    the training step correct — and a mesh-free step keeps the kernel."""
+    import paddle_tpu as paddle
+    from jax.sharding import PartitionSpec
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.parallel import create_mesh
+
+    cfg = BertConfig.tiny()
+    cfg.num_hidden_layers = 1
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32))
+    tt = paddle.to_tensor(np.zeros((8, 32), np.int32))
+    mlm = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32))
+    nsp = paddle.to_tensor(rng.randint(0, 2, (8,)).astype(np.int32))
+
+    def loss_fn(m, *b):
+        return m.loss(*b)
+
+    def build(mesh):
+        paddle.seed(0)
+        m = BertForPretraining(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=m.parameters())
+        if mesh is None:
+            return TrainStep(m, loss_fn, opt)
+        return TrainStep(m, loss_fn, opt, mesh=mesh,
+                         data_spec=PartitionSpec("dp"))
+
+    counters.reset()
+    mesh = create_mesh({"dp": 8})
+    loss_dp = float(build(mesh)(ids, tt, mlm, nsp).numpy())
+    snap = counters.snapshot()
+    assert snap.get("fused_xent.pallas", 0) == 0, snap
+    assert snap.get("fused_xent.xla", 0) >= 1, snap
+
+    counters.reset()
+    loss_single = float(build(None)(ids, tt, mlm, nsp).numpy())
+    assert counters.snapshot().get("fused_xent.pallas", 0) >= 1
+    np.testing.assert_allclose(loss_dp, loss_single, rtol=1e-4)
