@@ -36,8 +36,6 @@ define_flag("fused_vocab_xent", True,
 _F32 = jnp.float32
 _NEG = -1e30
 
-_BLOCK_N = 256
-_BLOCK_V = 512
 
 
 def _pick_bv(v):
@@ -46,6 +44,32 @@ def _pick_bv(v):
     for bv in (512, 384, 256, 128):
         if v % bv == 0:
             return bv
+    return None
+
+
+_BN_CANDIDATES = (1024, 512, 256)
+#: pad modulus = the smallest row block we can always fall back to
+_BN_MIN = _BN_CANDIDATES[-1]
+#: per-kernel VMEM budget (bytes) for the block-resident f32 tensors;
+#: v5e has ~16 MB/core — leave headroom for Mosaic's own buffers
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _pick_bn(n, hd, bv):
+    """Largest row block that divides n AND fits VMEM: every grid
+    row-block streams the ENTIRE weight table once (47 MB for BERT),
+    so fewer, larger row blocks cut that HBM traffic linearly — at
+    bert512 (n=16384, hd=768) 1024-row blocks read W 16x (~0.75 GB)
+    vs 64x (~3 GB) at 256. The budget check covers the dh backward's
+    worst case (h + f32 dh accumulator + w tile + s/p pair), which at
+    hd=2048/bn=1024 would need ~24 MB and fail Mosaic at COMPILE time
+    — outside the dispatch try/except, so it must never be picked."""
+    for bn in _BN_CANDIDATES:
+        if n % bn != 0:
+            continue
+        vmem = 4 * (2 * bn * hd + bv * hd + 2 * bn * bv)
+        if vmem <= _VMEM_BUDGET:
+            return bn
     return None
 
 
@@ -239,7 +263,9 @@ def _fused_xent_fwd(h, w, bias, labels, ignore_index):
     # rows with ignored labels still flow through the kernel; clamp the
     # label so the in-kernel hit-test never matches, zero the loss after
     safe = jnp.where(valid, labels, -1).astype(jnp.int32)
-    lse, ll = _fwd_call(h, w, bias, safe, _BLOCK_N, _pick_bv(w.shape[0]))
+    bv = _pick_bv(w.shape[0])
+    lse, ll = _fwd_call(h, w, bias, safe,
+                        _pick_bn(h.shape[0], h.shape[1], bv), bv)
     count = jnp.maximum(jnp.sum(valid.astype(_F32)), 1.0)
     loss = jnp.sum(jnp.where(valid, lse - ll, 0.0)) / count
     return loss, (h, w, bias, safe, valid, lse, count)
@@ -248,8 +274,9 @@ def _fused_xent_fwd(h, w, bias, labels, ignore_index):
 def _fused_xent_bwd(ignore_index, res, dloss):
     h, w, bias, safe, valid, lse, count = res
     g = jnp.where(valid, dloss / count, 0.0).astype(_F32)
-    dh, dw, db = _bwd_call(h, w, bias, safe, lse, g, _BLOCK_N,
-                           _pick_bv(w.shape[0]))
+    bv = _pick_bv(w.shape[0])
+    dh, dw, db = _bwd_call(h, w, bias, safe, lse, g,
+                           _pick_bn(h.shape[0], h.shape[1], bv), bv)
     return dh, dw, db.astype(bias.dtype), None
 
 
@@ -276,7 +303,8 @@ def _eligible(n, hd, v):
 
     if not pallas_enabled():
         return False
-    return (n % _BLOCK_N == 0 and _pick_bv(v) is not None and
+    bv = _pick_bv(v)
+    return (bv is not None and _pick_bn(n, hd, bv) is not None and
             hd % 128 == 0 and hd <= 2048)
 
 
@@ -292,7 +320,7 @@ def fused_linear_cross_entropy(h, w, bias, labels, ignore_index=-100):
     h2 = h.reshape(-1, hd)
     lab = labels.reshape(-1)
     n = h2.shape[0]
-    pad = (-n) % _BLOCK_N
+    pad = (-n) % _BN_MIN
     if _multi_device_trace():
         bump("fused_xent", "xla",
              "gated off under a multi-device TrainStep trace (pjit "
