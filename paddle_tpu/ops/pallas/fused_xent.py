@@ -38,16 +38,8 @@ _NEG = -1e30
 
 
 
-def _pick_bv(v):
-    """Largest vocab block dividing v (lane modulus 128): BERT's 30592
-    = 128 * 239 only admits 128-wide blocks; round vocabs get 512."""
-    for bv in (512, 384, 256, 128):
-        if v % bv == 0:
-            return bv
-    return None
-
-
 _BN_CANDIDATES = (1024, 512, 256)
+_BV_CANDIDATES = (512, 384, 256, 128)
 #: pad modulus = the smallest row block we can always fall back to
 _BN_MIN = _BN_CANDIDATES[-1]
 #: per-kernel VMEM budget (bytes) for the block-resident f32 tensors;
@@ -55,21 +47,33 @@ _BN_MIN = _BN_CANDIDATES[-1]
 _VMEM_BUDGET = 10 * 1024 * 1024
 
 
-def _pick_bn(n, hd, bv):
-    """Largest row block that divides n AND fits VMEM: every grid
+def _fits(bn, bv, hd):
+    """Both backward kernels' block-resident f32 footprints must fit:
+    dh holds h + f32 dh accumulator + w tile + s/p pair; dW holds
+    h + w + f32 dW accumulator + s/p pair. Overflow would fail Mosaic
+    at COMPILE time — outside the dispatch try/except — so no
+    over-budget pair may ever be picked."""
+    dh_kernel = 4 * (2 * bn * hd + bv * hd + 2 * bn * bv)
+    dw_kernel = 4 * (bn * hd + 2 * bv * hd + 2 * bn * bv)
+    return max(dh_kernel, dw_kernel) <= _VMEM_BUDGET
+
+
+def _pick_blocks(n, hd, v):
+    """Joint (block_n, block_v) choice, LARGEST bn first: every grid
     row-block streams the ENTIRE weight table once (47 MB for BERT),
-    so fewer, larger row blocks cut that HBM traffic linearly — at
-    bert512 (n=16384, hd=768) 1024-row blocks read W 16x (~0.75 GB)
-    vs 64x (~3 GB) at 256. The budget check covers the dh backward's
-    worst case (h + f32 dh accumulator + w tile + s/p pair), which at
-    hd=2048/bn=1024 would need ~24 MB and fail Mosaic at COMPILE time
-    — outside the dispatch try/except, so it must never be picked."""
+    so bn — not bv — sets the dominant HBM traffic; at bert512
+    (n=16384, hd=768) 1024-row blocks read W 16x (~0.75 GB) vs 64x
+    (~3 GB) at 256. A greedy-large bv that forced a smaller bn under
+    the VMEM cap would double exactly that traffic, so bv concedes
+    first. Returns None when nothing divides + fits (dispatch falls
+    back to XLA via _eligible). Vocab lane modulus 128: BERT's 30592
+    = 128 * 239 only admits 128-wide vocab blocks anyway."""
     for bn in _BN_CANDIDATES:
         if n % bn != 0:
             continue
-        vmem = 4 * (2 * bn * hd + bv * hd + 2 * bn * bv)
-        if vmem <= _VMEM_BUDGET:
-            return bn
+        for bv in _BV_CANDIDATES:
+            if v % bv == 0 and _fits(bn, bv, hd):
+                return bn, bv
     return None
 
 
@@ -263,9 +267,8 @@ def _fused_xent_fwd(h, w, bias, labels, ignore_index):
     # rows with ignored labels still flow through the kernel; clamp the
     # label so the in-kernel hit-test never matches, zero the loss after
     safe = jnp.where(valid, labels, -1).astype(jnp.int32)
-    bv = _pick_bv(w.shape[0])
-    lse, ll = _fwd_call(h, w, bias, safe,
-                        _pick_bn(h.shape[0], h.shape[1], bv), bv)
+    bn, bv = _pick_blocks(h.shape[0], h.shape[1], w.shape[0])
+    lse, ll = _fwd_call(h, w, bias, safe, bn, bv)
     count = jnp.maximum(jnp.sum(valid.astype(_F32)), 1.0)
     loss = jnp.sum(jnp.where(valid, lse - ll, 0.0)) / count
     return loss, (h, w, bias, safe, valid, lse, count)
@@ -274,9 +277,8 @@ def _fused_xent_fwd(h, w, bias, labels, ignore_index):
 def _fused_xent_bwd(ignore_index, res, dloss):
     h, w, bias, safe, valid, lse, count = res
     g = jnp.where(valid, dloss / count, 0.0).astype(_F32)
-    bv = _pick_bv(w.shape[0])
-    dh, dw, db = _bwd_call(h, w, bias, safe, lse, g,
-                           _pick_bn(h.shape[0], h.shape[1], bv), bv)
+    bn, bv = _pick_blocks(h.shape[0], h.shape[1], w.shape[0])
+    dh, dw, db = _bwd_call(h, w, bias, safe, lse, g, bn, bv)
     return dh, dw, db.astype(bias.dtype), None
 
 
@@ -303,8 +305,7 @@ def _eligible(n, hd, v):
 
     if not pallas_enabled():
         return False
-    bv = _pick_bv(v)
-    return (bv is not None and _pick_bn(n, hd, bv) is not None and
+    return (_pick_blocks(n, hd, v) is not None and
             hd % 128 == 0 and hd <= 2048)
 
 

@@ -29,7 +29,10 @@ while true; do
   if timeout 90 python -c "import jax; assert jax.devices()" 2>/dev/null; then
     before=$(tpu_rows)
     echo "=== tunnel UP at $(date -u) — running live session (tpu_rows=$before)" >> "$LOG"
-    timeout 7200 python tools/live_tpu_session.py >> "$LOG" 2>&1
+    # 3 h ceiling: the session's per-step timeouts sum past 2 h in the
+    # worst case, and its steps are ordered most-important-first, so a
+    # kill only ever costs the tail (sweeps/profiles)
+    timeout 10800 python tools/live_tpu_session.py >> "$LOG" 2>&1
     rc=$?
     after=$(tpu_rows)
     echo "=== session done at $(date -u) rc=$rc tpu_rows $before -> $after" >> "$LOG"
