@@ -130,3 +130,56 @@ class OpTestCase:
                 f"{self.op_type} grad wrt {name}: max rel err "
                 f"{rel.max():.4f} > {max_relative_error}\n"
                 f"analytic={a.ravel()[:5]} numeric={num.ravel()[:5]}")
+
+
+# -- shared finite-difference harness (used by test_grad_checks_r3/4/5) ----
+
+def numeric_grad(f, x, delta=1e-3):
+    """Central-difference gradient of scalar f at x (full tensor)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = float(f(jnp.asarray(x)))
+        flat[i] = orig - delta
+        fm = float(f(jnp.asarray(x)))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * delta)
+    return g
+
+
+def check_grad(f, x, rtol=0.05, atol=5e-3, delta=1e-3):
+    """jax.grad vs full-tensor central differences."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    analytic = np.asarray(jax.grad(f)(jnp.asarray(
+        np.asarray(x, np.float32))))
+    numeric = numeric_grad(f, x, delta)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+def probe_check_grad(loss, x0, probes, rtol=0.08, atol=5e-3, delta=1e-2):
+    """Central differences at selected probe indices — for kernels whose
+    interpret-mode forwards make a full-tensor sweep impractical."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    analytic = np.asarray(jax.grad(loss)(jnp.asarray(x0)))
+    for idx in probes:
+        xp = x0.copy()
+        xp[idx] += delta
+        fp = float(loss(jnp.asarray(xp)))
+        xp[idx] -= 2 * delta
+        fm = float(loss(jnp.asarray(xp)))
+        num = (fp - fm) / (2 * delta)
+        np.testing.assert_allclose(analytic[idx], num, rtol=rtol,
+                                   atol=atol, err_msg=str(idx))
