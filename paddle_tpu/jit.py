@@ -213,6 +213,22 @@ class TrainStep:
         self._zero_axis = zero_axis
         self._placed = False
 
+    def _batch_row_axes(self) -> tuple:
+        """Mesh axes the batch's leading (row) dims shard over, from
+        data_spec (axis names or tuples per dim) or data_axes."""
+        if self._mesh is None:
+            return ()
+        axes = []
+        if self._data_spec is not None:
+            for entry in self._data_spec:
+                if entry is None:
+                    continue
+                axes += (list(entry) if isinstance(entry, (tuple, list))
+                         else [entry])
+        elif self._data_axes:
+            axes = list(self._data_axes)
+        return tuple(a for a in axes if a in self._mesh.axis_names)
+
     def _place_spmd(self, params, buffers, batch_arrays):
         """First-call SPMD placement: params per TP rules (replicated over
         dp), batch sharded on the data axes. XLA's partitioner then inserts
@@ -286,9 +302,11 @@ class TrainStep:
                 sp_ctx = (_sp_scope(*self._sequence_parallel,
                                     mesh=self._mesh)
                           if self._sequence_parallel else nullcontext())
-                # mark the mesh governing this trace so non-shard_map
-                # pallas kernels (fused_xent) can self-gate on >1 devices
-                mesh_ctx = _trace_mesh_scope(self._mesh)
+                # mark the mesh governing this trace (+ the axes batch
+                # rows shard over) so non-shard_map pallas kernels
+                # (fused_xent) can shard_map themselves or self-gate
+                mesh_ctx = _trace_mesh_scope(self._mesh,
+                                             self._batch_row_axes())
                 try:
                     with tape_mod.no_grad(), rng_scope(key), sp_ctx, \
                             mesh_ctx:

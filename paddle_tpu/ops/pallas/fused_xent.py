@@ -256,48 +256,96 @@ def _bwd_call(h, w, bias, labels, lse, g, block_n, block_v):
     return dh.astype(h.dtype), dw.astype(w.dtype), db[0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _fused_xent_core(h, w, bias, labels, ignore_index):
-    loss, _ = _fused_xent_fwd(h, w, bias, labels, ignore_index)
-    return loss
+    """mean loss = sum / clamp(count): derived from the ONE sum-form
+    custom_vjp below (autodiff of the division supplies the 1/count
+    the hand-written mean backward used to hard-code — r5 review
+    dedup)."""
+    s, c = _fused_xent_sums(h, w, bias, labels, ignore_index)
+    return s / jnp.maximum(c, 1.0)
 
 
-def _fused_xent_fwd(h, w, bias, labels, ignore_index):
+# -- the single custom_vjp: per-shard (loss_sum, valid_count), so the
+# shard_map'd multi-device path can psum BEFORE the mean --------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_xent_sums(h, w, bias, labels, ignore_index):
+    (s, c), _ = _fused_xent_sums_fwd(h, w, bias, labels, ignore_index)
+    return s, c
+
+
+def _fused_xent_sums_fwd(h, w, bias, labels, ignore_index):
     valid = labels != ignore_index
     # rows with ignored labels still flow through the kernel; clamp the
     # label so the in-kernel hit-test never matches, zero the loss after
     safe = jnp.where(valid, labels, -1).astype(jnp.int32)
-    bn, bv = _pick_blocks(h.shape[0], h.shape[1], w.shape[0])
+    blocks = _pick_blocks(h.shape[0], h.shape[1], w.shape[0])
+    if blocks is None:
+        raise ValueError(
+            f"fused_xent: no (block_n, block_v) divides+fits h "
+            f"{h.shape} x w {w.shape} — dispatch should have taken the "
+            "XLA path (_eligible)")
+    bn, bv = blocks
     lse, ll = _fwd_call(h, w, bias, safe, bn, bv)
-    count = jnp.maximum(jnp.sum(valid.astype(_F32)), 1.0)
-    loss = jnp.sum(jnp.where(valid, lse - ll, 0.0)) / count
-    return loss, (h, w, bias, safe, valid, lse, count)
+    s = jnp.sum(jnp.where(valid, lse - ll, 0.0))
+    c = jnp.sum(valid.astype(_F32))
+    return (s, c), (h, w, bias, safe, valid, lse)
 
 
-def _fused_xent_bwd(ignore_index, res, dloss):
-    h, w, bias, safe, valid, lse, count = res
-    g = jnp.where(valid, dloss / count, 0.0).astype(_F32)
-    bn, bv = _pick_blocks(h.shape[0], h.shape[1], w.shape[0])
+def _fused_xent_sums_bwd(ignore_index, res, ct):
+    ds, _dc = ct   # count is a step function of int labels: no grad path
+    h, w, bias, safe, valid, lse = res
+    g = jnp.where(valid, ds, 0.0).astype(_F32)
+    bn, bv = _pick_blocks(h.shape[0], h.shape[1], w.shape[0])  # fwd validated
     dh, dw, db = _bwd_call(h, w, bias, safe, lse, g, bn, bv)
     return dh, dw, db.astype(bias.dtype), None
 
 
-_fused_xent_core.defvjp(_fused_xent_fwd, _fused_xent_bwd)
+_fused_xent_sums.defvjp(_fused_xent_sums_fwd, _fused_xent_sums_bwd)
 
 
-def _multi_device_trace():
-    """True while TrainStep traces over a >1-device mesh: the loss runs
-    inside pjit WITHOUT a shard_map wrapper (unlike the ring kernels),
-    and XLA cannot SPMD-partition an opaque pallas custom call — the
-    XLA fallback is partitionable and value-identical, so multi-chip
-    training stays correct while single-chip keeps the fused win. The
-    trace-time marker (parallel.mesh.trace_mesh, set by TrainStep) is
-    authoritative — NOT the ambient global mesh, which leaks across
-    callers and may differ from the mesh governing this trace."""
-    from ...parallel.mesh import active_trace_mesh
+def _sharded_fused(h2, w, bias, lab, mesh, row_axes, ignore_index):
+    """Row-parallel fused xent under a multi-device TrainStep trace:
+    shard_map over the batch-row axes (each shard streams the full W —
+    replicated spec; pjit inserts the gather if TP shards it), psum the
+    per-shard sums, divide once. This is how the opaque pallas call
+    becomes SPMD-partitionable — the manual axes make the partitioning
+    explicit instead of asking XLA to infer it."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel.ring import _shard_map
+
+    def local(hs, ws, bs, ls):
+        s, c = _fused_xent_sums(hs, ws, bs, ls, ignore_index)
+        s = jax.lax.psum(s, row_axes)
+        c = jax.lax.psum(c, row_axes)
+        return s / jnp.maximum(c, 1.0)
+
+    return _shard_map(local, mesh,
+                      (P(row_axes, None), P(None, None), P(None),
+                       P(row_axes)), P())(h2, w, bias, lab)
+
+
+def _trace_shard_plan(n, hd, v):
+    """(mesh, row_axes) when the current TrainStep trace is multi-device
+    AND the rows divide into kernel-eligible shards; 'gate' when it is
+    multi-device but unshardable (XLA fallback keeps correctness);
+    None for single-device/no-trace."""
+    from ...parallel.mesh import active_trace_mesh, active_trace_row_axes
 
     mesh = active_trace_mesh()
-    return mesh is not None and mesh.size > 1
+    if mesh is None or mesh.size <= 1:
+        return None
+    row_axes = tuple(active_trace_row_axes())
+    if row_axes:
+        import numpy as _np
+
+        shards = int(_np.prod([mesh.shape[a] for a in row_axes]))
+        if (shards > 0 and n % shards == 0
+                and _eligible(n // shards, hd, v)):
+            return mesh, row_axes
+    return "gate"
 
 
 def _eligible(n, hd, v):
@@ -322,11 +370,22 @@ def fused_linear_cross_entropy(h, w, bias, labels, ignore_index=-100):
     lab = labels.reshape(-1)
     n = h2.shape[0]
     pad = (-n) % _BN_MIN
-    if _multi_device_trace():
+    plan = _trace_shard_plan(n, hd, w.shape[0])
+    if plan == "gate":
         bump("fused_xent", "xla",
-             "gated off under a multi-device TrainStep trace (pjit "
-             "cannot partition the opaque pallas call; XLA path is "
+             "multi-device trace without shard-divisible rows/row axes "
+             "(opaque pallas call is unpartitionable; XLA path is "
              "value-identical and partitionable)")
+    elif plan is not None:
+        mesh, row_axes = plan
+        try:
+            out = _sharded_fused(h2, w, bias, lab, mesh, row_axes,
+                                 int(ignore_index))
+            bump("fused_xent", "pallas_sharded")
+            return out
+        except Exception as e:
+            bump("fused_xent", "xla",
+                 f"sharded kernel error {type(e).__name__}: {e}")
     elif _eligible(n + pad, hd, w.shape[0]):
         try:
             if pad:

@@ -67,13 +67,16 @@ def set_mesh(mesh: Mesh):
 # _global_mesh is NOT used for that decision: it leaks across tests and
 # may differ from the mesh actually governing the trace.
 
-_trace_mesh: list = [None]
+_trace_mesh: list = [(None, ())]
 
 
 @contextmanager
-def trace_mesh(mesh: Optional[Mesh]):
+def trace_mesh(mesh: Optional[Mesh], row_axes: Sequence[str] = ()):
+    """row_axes: the mesh axes the BATCH rows are sharded over (from
+    TrainStep's data_spec/data_axes) — what a row-parallel kernel needs
+    to shard_map itself and psum its reductions."""
     prev = _trace_mesh[0]
-    _trace_mesh[0] = mesh
+    _trace_mesh[0] = (mesh, tuple(row_axes))
     try:
         yield
     finally:
@@ -82,7 +85,12 @@ def trace_mesh(mesh: Optional[Mesh]):
 
 def active_trace_mesh() -> Optional[Mesh]:
     """The mesh of the TrainStep trace currently being built, if any."""
-    return _trace_mesh[0]
+    return _trace_mesh[0][0]
+
+
+def active_trace_row_axes() -> tuple:
+    """The batch-row sharding axes of the current TrainStep trace."""
+    return _trace_mesh[0][1]
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

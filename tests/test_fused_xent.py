@@ -262,3 +262,61 @@ def test_multi_device_trainstep_gates_fused_path(interp):
     loss_single = float(build(None)(ids, tt, mlm, nsp).numpy())
     assert counters.snapshot().get("fused_xent.pallas", 0) >= 1
     np.testing.assert_allclose(loss_dp, loss_single, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_multi_device_trainstep_shards_fused_path(interp, monkeypatch):
+    """When the batch rows DO divide into kernel-eligible shards, the
+    multi-device TrainStep keeps the fused kernel via shard_map + psum
+    (fused_xent.pallas_sharded) and matches the single-device loss."""
+    import paddle_tpu as paddle
+    import paddle_tpu.parallel.ring as ring_mod
+    from jax.sharding import PartitionSpec
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.parallel import create_mesh
+    from paddle_tpu.parallel.mesh import _global_mesh
+
+    monkeypatch.setattr(ring_mod, "_SHARD_MAP_CHECK_VMA", [False])
+    cfg = BertConfig.tiny()
+    cfg.num_hidden_layers = 1
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    rng = np.random.RandomState(0)
+    B, S = 8, 128                     # n=1024; dp2 -> 512 local rows
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    tt = paddle.to_tensor(np.zeros((B, S), np.int32))
+    mlm = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    nsp = paddle.to_tensor(rng.randint(0, 2, (B,)).astype(np.int32))
+
+    def loss_fn(m, *b):
+        return m.loss(*b)
+
+    def build(mesh):
+        paddle.seed(0)
+        m = BertForPretraining(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=m.parameters())
+        if mesh is None:
+            return TrainStep(m, loss_fn, opt)
+        return TrainStep(m, loss_fn, opt, mesh=mesh,
+                         data_spec=PartitionSpec("dp"))
+
+    prev = _global_mesh[0]
+    try:
+        counters.reset()
+        mesh = create_mesh({"dp": 2}, devices=jax.devices()[:2])
+        loss_dp = float(build(mesh)(ids, tt, mlm, nsp).numpy())
+        snap = counters.snapshot()
+        assert snap.get("fused_xent.pallas_sharded", 0) >= 1, snap
+    finally:
+        _global_mesh[0] = prev
+
+    counters.reset()
+    loss_single = float(build(None)(ids, tt, mlm, nsp).numpy())
+    assert counters.snapshot().get("fused_xent.pallas", 0) >= 1
+    np.testing.assert_allclose(loss_dp, loss_single, rtol=1e-4)

@@ -164,3 +164,39 @@ def test_contrib_ops_numeric_grads():
         return jnp.sum(jnp.asarray(out.value) ** 2)
 
     _check(topk_loss, ti0)
+
+
+def test_sharded_fused_xent_numeric_grads(interp, monkeypatch):
+    """The shard_map'd multi-device fused-xent path (sum-form vjp +
+    psum transpose): gradients at probe points vs central differences."""
+    import paddle_tpu.parallel.ring as ring_mod
+    from paddle_tpu.ops.pallas.fused_xent import _sharded_fused
+    from paddle_tpu.parallel import create_mesh
+    from paddle_tpu.parallel.mesh import _global_mesh
+
+    monkeypatch.setattr(ring_mod, "_SHARD_MAP_CHECK_VMA", [False])
+    prev = _global_mesh[0]
+    import jax as _jax
+    mesh = create_mesh({"dp": 2}, devices=_jax.devices()[:2])
+    try:
+        rng = np.random.RandomState(3)
+        h0 = rng.randn(512, 128).astype(np.float32) * 0.3   # 256/shard
+        w = jnp.asarray(rng.randn(128, 128) * 0.3)
+        b = jnp.asarray(rng.randn(128) * 0.1)
+        lab = jnp.asarray(rng.randint(0, 128, 512), jnp.int32)
+
+        def loss_h(h):
+            return _sharded_fused(h, w, b, lab, mesh, ("dp",), -100)
+
+        _probe_check(loss_h, h0, probes=[(0, 0), (255, 64), (256, 1),
+                                         (511, 127)])
+
+        def loss_w(wm):
+            return _sharded_fused(jnp.asarray(h0), wm, b, lab, mesh,
+                                  ("dp",), -100)
+
+        # W is replicated across shards: its cotangent is the psum of
+        # per-shard contributions — the transpose this check pins
+        _probe_check(loss_w, np.asarray(w), probes=[(7, 0), (100, 64)])
+    finally:
+        _global_mesh[0] = prev
