@@ -4,7 +4,6 @@ for the round-4 kernels: the fluid.layers activation tail
 cumsum variants) and the new functional bilinear/cosine_similarity.
 Central differences vs jax.grad; inputs avoid the kink points of the
 piecewise ops so the finite-difference is well-defined."""
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -15,27 +14,7 @@ from paddle_tpu.static.kernels import KERNELS
 pytestmark = pytest.mark.slow
 
 
-def _numeric_grad(f, x, delta=1e-3):
-    x = np.asarray(x, np.float32)
-    g = np.zeros_like(x)
-    flat = x.reshape(-1)
-    gf = g.reshape(-1)
-    for i in range(flat.size):
-        orig = flat[i]
-        flat[i] = orig + delta
-        fp = float(f(jnp.asarray(x)))
-        flat[i] = orig - delta
-        fm = float(f(jnp.asarray(x)))
-        flat[i] = orig
-        gf[i] = (fp - fm) / (2 * delta)
-    return g
-
-
-def _check(f, x, rtol=0.05, atol=5e-3, delta=1e-3):
-    analytic = np.asarray(jax.grad(lambda v: f(v))(jnp.asarray(
-        np.asarray(x, np.float32))))
-    numeric = _numeric_grad(f, x, delta)
-    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+from tests.op_test import check_grad as _check  # shared harness
 
 
 def _k(op, x, **attrs):
