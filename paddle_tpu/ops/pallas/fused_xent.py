@@ -339,9 +339,9 @@ def _trace_shard_plan(n, hd, v):
         return None
     row_axes = tuple(active_trace_row_axes())
     if row_axes:
-        import numpy as _np
+        import math
 
-        shards = int(_np.prod([mesh.shape[a] for a in row_axes]))
+        shards = math.prod(mesh.shape[a] for a in row_axes)
         if (shards > 0 and n % shards == 0
                 and _eligible(n // shards, hd, v)):
             return mesh, row_axes

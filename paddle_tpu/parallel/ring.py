@@ -87,12 +87,9 @@ def _ring_flash_eligible(q, k, is_causal):
     """Static-shape gate for the flash-ring path (per-device shards)."""
     from ..framework.bringup import pallas_enabled
 
-    try:
-        if not get_flag("ring_flash"):
-            return False
-    except KeyError:
-        pass
-    if not pallas_enabled():
+    # FLAGS_ring_flash is defined at this module's import, so a plain
+    # lookup is safe
+    if not get_flag("ring_flash") or not pallas_enabled():
         return False
     b, lq, h, d = q.shape
     lk = k.shape[1]
