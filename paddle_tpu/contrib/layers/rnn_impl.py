@@ -94,13 +94,11 @@ def _run_layers(input, cells_fw, cells_bw, init_states, sequence_length,
         if dropout_prob and li < n_layers - 1:
             out = nn_mod.functional.dropout(out, p=dropout_prob)
     if not batch_first:
-        from ... import ops as ops_mod
-
         out = ops_mod.transpose(out, [1, 0, 2])
     return out, lasts
 
 
-def _split_init(init, num_layers, directions, pairs=1):
+def _split_init(init, num_layers, directions):
     """(num_layers*directions, B, H) -> per-layer initial states."""
     if init is None:
         return None
@@ -119,23 +117,33 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
               sequence_length=None, dropout_prob=0.0, bidirectional=False,
               batch_first=True, param_attr=None, bias_attr=None,
               gate_activation=None, activation=None, dtype="float32",
-              name="basic_gru"):
+              name="basic_gru", cells=None):
     """Multi-layer (bi)GRU over a sequence (rnn_impl.py:164). Returns
     (rnn_out, last_hidden): rnn_out (B, T, H*D) [or time-major], last
-    hidden (num_layers*D, B, H)."""
+    hidden (num_layers*D, B, H).
+
+    Like every parameter-creating contrib function here, the created
+    weights come back for reuse: when `cells` is None the return gains
+    a trailing `cells` handle — pass it to later calls, or training
+    updates parameters that the next call re-randomizes."""
     from ... import ops as ops_mod
 
     d = 2 if bidirectional else 1
     in_sz = input.shape[-1]
-    cells_fw, cells_bw = [], ([] if bidirectional else None)
-    for li in range(num_layers):
-        sz = in_sz if li == 0 else hidden_size * d
-        cells_fw.append(BasicGRUCell(sz, hidden_size, param_attr=param_attr,
-                                     bias_attr=bias_attr))
-        if bidirectional:
-            cells_bw.append(BasicGRUCell(sz, hidden_size,
+    created = cells is None
+    if created:
+        cells_fw, cells_bw = [], ([] if bidirectional else None)
+        for li in range(num_layers):
+            sz = in_sz if li == 0 else hidden_size * d
+            cells_fw.append(BasicGRUCell(sz, hidden_size,
                                          param_attr=param_attr,
                                          bias_attr=bias_attr))
+            if bidirectional:
+                cells_bw.append(BasicGRUCell(sz, hidden_size,
+                                             param_attr=param_attr,
+                                             bias_attr=bias_attr))
+        cells = (cells_fw, cells_bw)
+    cells_fw, cells_bw = cells
     init = _split_init(init_hidden, num_layers, d)
     out, lasts = _run_layers(input, cells_fw, cells_bw, init,
                              sequence_length, dropout_prob, batch_first)
@@ -146,31 +154,37 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
         else:
             flat.append(st)
     last_hidden = ops_mod.stack(flat, axis=0)
-    return out, last_hidden
+    return (out, last_hidden, cells) if created else (out, last_hidden)
 
 
 def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
                sequence_length=None, dropout_prob=0.0, bidirectional=False,
                batch_first=True, param_attr=None, bias_attr=None,
                gate_activation=None, activation=None, forget_bias=1.0,
-               dtype="float32", name="basic_lstm"):
+               dtype="float32", name="basic_lstm", cells=None):
     """Multi-layer (bi)LSTM over a sequence (rnn_impl.py:405). Returns
-    (rnn_out, last_hidden, last_cell)."""
+    (rnn_out, last_hidden, last_cell) — plus a trailing `cells` handle
+    when created here (pass it back in to train; see basic_gru)."""
     from ... import ops as ops_mod
 
     d = 2 if bidirectional else 1
     in_sz = input.shape[-1]
-    cells_fw, cells_bw = [], ([] if bidirectional else None)
-    for li in range(num_layers):
-        sz = in_sz if li == 0 else hidden_size * d
-        cells_fw.append(BasicLSTMCell(sz, hidden_size, param_attr=param_attr,
-                                      bias_attr=bias_attr,
-                                      forget_bias=forget_bias))
-        if bidirectional:
-            cells_bw.append(BasicLSTMCell(sz, hidden_size,
+    created = cells is None
+    if created:
+        cells_fw, cells_bw = [], ([] if bidirectional else None)
+        for li in range(num_layers):
+            sz = in_sz if li == 0 else hidden_size * d
+            cells_fw.append(BasicLSTMCell(sz, hidden_size,
                                           param_attr=param_attr,
                                           bias_attr=bias_attr,
                                           forget_bias=forget_bias))
+            if bidirectional:
+                cells_bw.append(BasicLSTMCell(sz, hidden_size,
+                                              param_attr=param_attr,
+                                              bias_attr=bias_attr,
+                                              forget_bias=forget_bias))
+        cells = (cells_fw, cells_bw)
+    cells_fw, cells_bw = cells
     init = None
     if init_hidden is not None and init_cell is not None:
         init = []
@@ -193,4 +207,5 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
             h, c = st
             hs.append(h)
             cs.append(c)
-    return out, ops_mod.stack(hs, axis=0), ops_mod.stack(cs, axis=0)
+    h_out, c_out = ops_mod.stack(hs, axis=0), ops_mod.stack(cs, axis=0)
+    return (out, h_out, c_out, cells) if created else (out, h_out, c_out)

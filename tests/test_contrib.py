@@ -297,10 +297,17 @@ def test_bilateral_slice_differentiable():
 def test_basic_gru_and_units():
     rng = np.random.RandomState(7)
     x = _t(rng.randn(2, 5, 3).astype(np.float32))
-    out, last_h = contrib.basic_gru(x, None, hidden_size=4, num_layers=2)
+    out, last_h, cells = contrib.basic_gru(x, None, hidden_size=4,
+                                           num_layers=2)
     assert out.shape == (2, 5, 4) and last_h.shape == (2, 2, 4)
-    out_bi, last_bi = contrib.basic_gru(x, None, hidden_size=4,
-                                        bidirectional=True)
+    # the created-cells handle makes repeated calls REUSE weights (the
+    # r5 high-effort review: without it, eager training updated params
+    # a fresh call silently re-randomized)
+    out2, _ = contrib.basic_gru(x, None, hidden_size=4, num_layers=2,
+                                cells=cells)
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6)
+    out_bi, last_bi, _ = contrib.basic_gru(x, None, hidden_size=4,
+                                           bidirectional=True)
     assert out_bi.shape == (2, 5, 8) and last_bi.shape == (2, 2, 4)
     unit = contrib.BasicGRUUnit(hidden_size=4)
     h = unit(_t(rng.randn(2, 3).astype(np.float32)),
@@ -308,12 +315,34 @@ def test_basic_gru_and_units():
     assert h.shape == (2, 4)
 
 
+def test_basic_gru_trains_through_cells_handle():
+    """Gradients reach the reused cells and an SGD step changes the
+    next call's output — the eager training loop actually trains."""
+    from paddle_tpu import optimizer
+
+    rng = np.random.RandomState(9)
+    x = _t(rng.randn(2, 5, 3).astype(np.float32))
+    out, _, cells = contrib.basic_gru(x, None, hidden_size=4)
+    params = [p for c in cells[0] for p in c.parameters()]
+    opt = optimizer.SGD(learning_rate=0.5, parameters=params)
+    loss = (out ** 2).mean()
+    loss.backward()
+    assert any(p.grad is not None for p in params)
+    opt.step()
+    opt.clear_grad()
+    out2, _ = contrib.basic_gru(x, None, hidden_size=4, cells=cells)
+    assert float(np.abs(out.numpy() - out2.numpy()).max()) > 1e-6
+
+
 def test_basic_lstm_and_units():
     rng = np.random.RandomState(8)
     x = _t(rng.randn(2, 4, 3).astype(np.float32))
-    out, h, c = contrib.basic_lstm(x, None, None, hidden_size=5)
+    out, h, c, cells = contrib.basic_lstm(x, None, None, hidden_size=5)
     assert out.shape == (2, 4, 5)
     assert h.shape == (1, 2, 5) and c.shape == (1, 2, 5)
+    out2, _, _ = contrib.basic_lstm(x, None, None, hidden_size=5,
+                                    cells=cells)
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-6)
     unit = contrib.BasicLSTMUnit(hidden_size=5, forget_bias=1.0)
     hh, cc = unit(_t(rng.randn(2, 3).astype(np.float32)),
                   _t(np.zeros((2, 5), np.float32)),

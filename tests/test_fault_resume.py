@@ -13,6 +13,10 @@ from paddle_tpu.distributed import launch
 
 pytestmark = pytest.mark.slow
 
+# portable repo root (the subprocess env REPLACES PYTHONPATH to drop
+# the axon plugin; it must still find paddle_tpu from any checkout)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "_fault_resume_worker.py")
 
@@ -24,7 +28,7 @@ def _read(log):
 
 def test_kill_detect_resume_cycle(tmp_path, monkeypatch):
     # subprocess env: CPU backend, axon plugin OFF (replaced PYTHONPATH)
-    monkeypatch.setenv("PYTHONPATH", "/root/repo")
+    monkeypatch.setenv("PYTHONPATH", _REPO)
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.setenv("PADDLE_AUTO_CHECKPOINT_PATH",
                        str(tmp_path / "ckpt"))

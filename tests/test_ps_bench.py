@@ -15,6 +15,10 @@ import pytest
 
 pytestmark = pytest.mark.slow
 
+# portable repo root (the subprocess env REPLACES PYTHONPATH to drop
+# the axon plugin; it must still find paddle_tpu from any checkout)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "_ps_server_worker.py")
 
@@ -26,7 +30,7 @@ BATCH = 100_000
 @pytest.fixture
 def two_server_procs():
     env = dict(os.environ)
-    env.update(PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu",
+    env.update(PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
                PS_DIM=str(DIM))
     procs, endpoints = [], []
     for _ in range(2):

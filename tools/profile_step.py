@@ -45,8 +45,11 @@ def summarize_trace(out_dir: str, config: str, row: dict,
     tid_names = {(e.get("pid"), e.get("tid")):
                  str(e.get("args", {}).get("name", ""))
                  for e in events if e.get("name") == "thread_name"}
+    # explicit op-line match: a substring like "op" also hits
+    # "TensorFlow Name Scope" (sc-op-e), whose hierarchical spans
+    # already contain every op under them — double counting
     op_tids = {k for k, n in tid_names.items()
-               if k[0] in device_pids and "op" in n.lower()}
+               if k[0] in device_pids and "xla ops" in n.lower()}
 
     per_tid = defaultdict(lambda: defaultdict(float))
     counts = defaultdict(int)

@@ -39,12 +39,17 @@ while true; do
     # durability: commit whatever the session captured so a container
     # restart can't lose the evidence
     if [ "$after" -gt "$before" ] || ! git diff --quiet -- BENCH_CAPTURES.jsonl OPBENCH_r*.jsonl 2>/dev/null; then
-      # add per file: one missing pathspec must not abort the whole add
+      # add per file AND commit with an explicit pathspec: the
+      # unattended commit must never sweep up unrelated staged work
+      capture_files=""
       for f in BENCH_CAPTURES.jsonl OPBENCH_r*.jsonl XPLANE_SUMMARY.md; do
-        [ -f "$f" ] && git add "$f" >> "$LOG" 2>&1
+        [ -f "$f" ] && { git add "$f" >> "$LOG" 2>&1; capture_files="$capture_files $f"; }
       done
-      git commit -m "Live TPU capture session: bench + op-bench rows" \
-        >> "$LOG" 2>&1 || true
+      if [ -n "$capture_files" ]; then
+        # shellcheck disable=SC2086
+        git commit -m "Live TPU capture session: bench + op-bench rows" \
+          -- $capture_files >> "$LOG" 2>&1 || true
+      fi
     fi
     if [ "$after" -gt "$before" ]; then
       sleep 7200   # real captures landed — no need to re-burn the window
