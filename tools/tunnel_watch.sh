@@ -38,7 +38,13 @@ while true; do
     echo "=== session done at $(date -u) rc=$rc tpu_rows $before -> $after" >> "$LOG"
     # durability: commit whatever the session captured so a container
     # restart can't lose the evidence
-    if [ "$after" -gt "$before" ] || ! git diff --quiet -- BENCH_CAPTURES.jsonl OPBENCH_r*.jsonl 2>/dev/null; then
+    # commit when TPU rows landed, tracked capture files changed, or a
+    # fresh (untracked) artifact like XPLANE_SUMMARY.md appeared
+    new_untracked=$(git ls-files --others --exclude-standard -- \
+      XPLANE_SUMMARY.md OPBENCH_r*.jsonl 2>/dev/null | head -1)
+    if [ "$after" -gt "$before" ] \
+        || ! git diff --quiet -- BENCH_CAPTURES.jsonl OPBENCH_r*.jsonl 2>/dev/null \
+        || [ -n "$new_untracked" ]; then
       # add per file AND commit with an explicit pathspec: the
       # unattended commit must never sweep up unrelated staged work
       capture_files=""
