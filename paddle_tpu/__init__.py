@@ -45,6 +45,7 @@ from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
 from . import contrib  # noqa: F401  (fluid.contrib parity surface)
+from . import dataset  # noqa: F401  (legacy paddle.dataset readers)
 
 
 def save(obj, path, **kwargs):

@@ -1,0 +1,16 @@
+"""paddle.dataset parity (reference python/paddle/dataset/__init__.py
+__all__ at :33): the legacy reader-creator modules. Each module wraps
+this framework's Dataset classes (text/, vision/datasets.py) in the
+1.x `train()/test()` reader-creator API; data is the same
+synthetic-gated source those classes use (zero-egress image — pass
+data_path where the classes accept one for real files)."""
+from . import (  # noqa: F401
+    cifar, conll05, flowers, image, imdb, imikolov, mnist, movielens,
+    mq2007, sentiment, uci_housing, voc2012, wmt14, wmt16,
+)
+
+__all__ = [
+    'mnist', 'imikolov', 'imdb', 'cifar', 'movielens', 'conll05',
+    'sentiment', 'uci_housing', 'wmt14', 'wmt16', 'mq2007', 'flowers',
+    'voc2012', 'image',
+]

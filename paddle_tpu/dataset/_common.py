@@ -1,0 +1,37 @@
+"""Shared reader-creator plumbing for the legacy paddle.dataset API."""
+from __future__ import annotations
+
+
+def reader_from(ds_factory, item_fn=None):
+    """1.x reader creator over a Dataset class: calling the returned
+    creator yields items (optionally mapped by item_fn)."""
+
+    def creator():
+        ds = ds_factory()
+        for i in range(len(ds)):
+            item = ds[i]
+            yield item_fn(item) if item_fn is not None else item
+
+    return creator
+
+
+def flat_image_item(sample):
+    """(image, label) -> (flattened float32 image, int label)."""
+    import numpy as np
+
+    img, label = sample
+    return (np.asarray(img, np.float32).reshape(-1),
+            int(np.asarray(label).reshape(-1)[0]))
+
+
+def ids_label_item(sample):
+    """(token ids, label) -> (list[int], int)."""
+    ids, label = sample
+    return [int(t) for t in ids], int(label)
+
+
+def triple_ids_item(sample):
+    """(src, trg_in, trg_out) -> three list[int]."""
+    a, b, c = sample
+    return ([int(t) for t in a], [int(t) for t in b],
+            [int(t) for t in c])

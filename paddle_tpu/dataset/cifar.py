@@ -1,0 +1,32 @@
+"""paddle.dataset.cifar parity (reference dataset/cifar.py): readers
+yield (3072-float32 image in [0, 1], int label)."""
+from __future__ import annotations
+
+from ._common import flat_image_item as _item
+from ._common import reader_from
+
+__all__ = ['train100', 'test100', 'train10', 'test10']
+
+
+def train10():
+    from ..vision.datasets import Cifar10
+
+    return reader_from(lambda: Cifar10(mode="train"), _item)
+
+
+def test10():
+    from ..vision.datasets import Cifar10
+
+    return reader_from(lambda: Cifar10(mode="test"), _item)
+
+
+def train100():
+    from ..vision.datasets import Cifar100
+
+    return reader_from(lambda: Cifar100(mode="train"), _item)
+
+
+def test100():
+    from ..vision.datasets import Cifar100
+
+    return reader_from(lambda: Cifar100(mode="test"), _item)

@@ -1,0 +1,40 @@
+"""paddle.dataset.conll05 parity (reference dataset/conll05.py): SRL
+test reader + dictionaries + embedding table."""
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import reader_from
+
+__all__ = ['test', 'get_dict', 'get_embedding']
+
+_VOCAB, _TAGS, _VERBS, _EMB = 3000, 9, 200, 32
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) — reference get_dict."""
+    word_dict = {f"w{i}": i for i in range(_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(_VERBS)}
+    label_dict = {f"tag{i}": i for i in range(_TAGS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Deterministic (vocab, 32) embedding (reference ships trained
+    emb_dict; synthetic-gated here like the datasets)."""
+    rng = np.random.RandomState(0)
+    return rng.randn(_VOCAB, _EMB).astype(np.float32) * 0.1
+
+
+def _item(sample):
+    words, pred_pos, tags = sample
+    return ([int(w) for w in words], int(pred_pos),
+            [int(t) for t in tags])
+
+
+def test():
+    from ..text import Conll05st
+
+    return reader_from(
+        lambda: Conll05st(mode="test", vocab_size=_VOCAB,
+                          num_tags=_TAGS), _item)

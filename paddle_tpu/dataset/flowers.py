@@ -1,0 +1,32 @@
+"""paddle.dataset.flowers parity (reference dataset/flowers.py):
+readers yield (CHW float32 image, int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import reader_from
+
+__all__ = ['train', 'test', 'valid']
+
+
+def _item(sample):
+    img, label = sample
+    return np.asarray(img, np.float32), int(np.asarray(label).reshape(-1)[0])
+
+
+def _make(mode):
+    from ..vision.datasets import Flowers
+
+    return reader_from(lambda: Flowers(mode=mode), _item)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _make("train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _make("test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _make("valid")

@@ -1,0 +1,131 @@
+"""paddle.dataset.image parity (reference dataset/image.py): numpy/PIL
+image utilities. The reference shells into cv2; PIL (shipped with the
+torch-cpu install) + numpy cover the same surface here. Arrays are HWC
+uint8/float unless noted; to_chw does the final transpose like the
+reference."""
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "paddle_tpu.dataset.image needs Pillow for decode/resize "
+            "(the reference uses cv2, not shipped here)") from e
+    return Image
+
+
+def load_image_bytes(bytes_, is_color=True):
+    img = _pil().open(io.BytesIO(bytes_))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    img = _pil().open(file)
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals `size` (reference resize_short)."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    pim = _pil().fromarray(np.asarray(im).astype(np.uint8))
+    return np.asarray(pim.resize((new_w, new_h)))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1, :] if (len(im.shape) == 3 and is_color) \
+        else im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     is_color=True, mean=None):
+    """resize_short -> crop (+flip when training) -> CHW -> f32 -> -mean
+    (reference simple_transform pipeline)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and len(im.shape) == 3:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Read images from a tar, batch into pickled files (reference
+    batch_images_from_tar); returns the meta-file path."""
+    import os
+    import pickle
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id = [], [], 0
+    with tarfile.open(data_file, mode="r") as f:
+        for mem in f.getmembers():
+            if mem.name not in img2label:
+                continue
+            data.append(f.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                with open(f"{out_path}/batch_{file_id}", "wb") as bf:
+                    pickle.dump({"data": data, "label": labels}, bf, 2)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        with open(f"{out_path}/batch_{file_id}", "wb") as bf:
+            pickle.dump({"data": data, "label": labels}, bf, 2)
+    with open(f"{out_path}/meta", "w") as mf:
+        mf.write(f"{file_id + (1 if data else 0)}\n")
+    return f"{out_path}/meta"

@@ -1,0 +1,31 @@
+"""paddle.dataset.imdb parity (reference dataset/imdb.py): readers
+yield (token-id list, 0/1 label); build_dict returns word -> id."""
+from __future__ import annotations
+
+from ._common import ids_label_item as _item
+from ._common import reader_from
+
+__all__ = ['build_dict', 'train', 'test']
+
+_VOCAB = 5000
+
+
+def build_dict(pattern=None, cutoff=150):
+    """Synthetic-stable vocabulary (the Dataset class hashes real words
+    into the same id space when given an archive)."""
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _make(mode, word_idx):
+    from ..text import Imdb
+
+    vocab = len(word_idx) if word_idx else _VOCAB
+    return reader_from(lambda: Imdb(mode=mode, vocab_size=vocab), _item)
+
+
+def train(word_idx=None):
+    return _make("train", word_idx)
+
+
+def test(word_idx=None):
+    return _make("test", word_idx)

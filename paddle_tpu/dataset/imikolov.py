@@ -1,0 +1,39 @@
+"""paddle.dataset.imikolov parity (reference dataset/imikolov.py):
+n-gram readers over the PTB-style stream; NGRAM items are n-tuples of
+ids, SKIPGRAM items are (center, context) pairs."""
+from __future__ import annotations
+
+from ._common import reader_from
+
+__all__ = ['train', 'test', 'build_dict']
+
+_VOCAB = 2000
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _item(sample):
+    ctx, tgt = sample
+    try:
+        return tuple(int(t) for t in ctx) + (int(tgt),)
+    except TypeError:           # SKIPGRAM: (center, context) scalars
+        return int(ctx), int(tgt)
+
+
+def _make(mode, word_idx, n, data_type):
+    from ..text import Imikolov
+
+    vocab = len(word_idx) if word_idx else _VOCAB
+    return reader_from(
+        lambda: Imikolov(mode=mode, window_size=n, data_type=data_type,
+                         vocab_size=vocab), _item)
+
+
+def train(word_idx=None, n=5, data_type="NGRAM"):
+    return _make("train", word_idx, n, data_type)
+
+
+def test(word_idx=None, n=5, data_type="NGRAM"):
+    return _make("test", word_idx, n, data_type)

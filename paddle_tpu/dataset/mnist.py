@@ -1,0 +1,20 @@
+"""paddle.dataset.mnist parity (reference dataset/mnist.py): readers
+yield (784-float32 image in [-1, 1], int label)."""
+from __future__ import annotations
+
+from ._common import flat_image_item as _item
+from ._common import reader_from
+
+__all__ = ['train', 'test']
+
+
+def train():
+    from ..vision.datasets import MNIST
+
+    return reader_from(lambda: MNIST(mode="train"), _item)
+
+
+def test():
+    from ..vision.datasets import MNIST
+
+    return reader_from(lambda: MNIST(mode="test"), _item)

@@ -1,0 +1,133 @@
+"""paddle.dataset.movielens parity (reference dataset/movielens.py):
+rating readers plus the movie/user metadata helpers. Metadata mirrors
+the synthetic tables the text.Movielens class draws from, so readers
+and helpers agree on id ranges."""
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import reader_from
+
+__all__ = [
+    'train', 'test', 'get_movie_title_dict', 'max_movie_id',
+    'max_user_id', 'age_table', 'movie_categories', 'max_job_id',
+    'user_info', 'movie_info',
+]
+
+_NUM_USERS = 500
+_NUM_MOVIES = 800
+_NUM_CATEGORIES = 18
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CATEGORIES = [
+    'Action', 'Adventure', 'Animation', "Children's", 'Comedy', 'Crime',
+    'Documentary', 'Drama', 'Fantasy', 'Film-Noir', 'Horror', 'Musical',
+    'Mystery', 'Romance', 'Sci-Fi', 'Thriller', 'War', 'Western',
+]
+
+
+def _title_id(word):
+    """Deterministic title-word id consistent with
+    get_movie_title_dict() (hash() is process-salted — it broke
+    reproducibility across workers)."""
+    import zlib
+
+    d = get_movie_title_dict()
+    return d.get(word, zlib.crc32(word.encode()) % 5000)
+
+
+class MovieInfo:
+    """reference movielens.py MovieInfo."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [_CATEGORIES.index(c) for c in self.categories],
+                [_title_id(w) for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), "
+                f"title({self.title}), categories({self.categories})>")
+
+
+class UserInfo:
+    """reference movielens.py UserInfo."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), "
+                f"gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+
+def _item(sample):
+    u, gender, age, job, m, cats, rating = sample
+    return [int(u), int(gender), int(age), int(job), int(m),
+            [int(c) for c in cats], float(rating)]
+
+
+def train():
+    from ..text import Movielens
+
+    return reader_from(
+        lambda: Movielens(mode="train", num_users=_NUM_USERS,
+                          num_movies=_NUM_MOVIES,
+                          num_categories=_NUM_CATEGORIES), _item)
+
+
+def test():
+    from ..text import Movielens
+
+    return reader_from(
+        lambda: Movielens(mode="test", num_users=_NUM_USERS,
+                          num_movies=_NUM_MOVIES,
+                          num_categories=_NUM_CATEGORIES), _item)
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"title{i}": i for i in range(5000)}
+
+
+def max_movie_id():
+    return _NUM_MOVIES - 1
+
+
+def max_user_id():
+    return _NUM_USERS - 1
+
+
+def max_job_id():
+    return 20
+
+
+def movie_info():
+    rng = np.random.RandomState(0)
+    return {i: MovieInfo(
+        i, [_CATEGORIES[int(c)] for c in rng.choice(
+            _NUM_CATEGORIES, 2, replace=False)], f"title{i}")
+        for i in range(_NUM_MOVIES)}
+
+
+def user_info():
+    rng = np.random.RandomState(1)
+    return {i: UserInfo(
+        i, 'M' if rng.randint(0, 2) else 'F',
+        age_table[int(rng.randint(0, len(age_table)))],
+        int(rng.randint(0, 21))) for i in range(_NUM_USERS)}

@@ -1,0 +1,56 @@
+"""paddle.dataset.mq2007 parity (reference dataset/mq2007.py): LETOR
+learning-to-rank readers. Query groups carry 46-dim feature vectors
+with graded relevance; formats follow the reference:
+  pointwise -> (label, feature)
+  pairwise  -> (feature_pos, feature_neg)
+  listwise  -> (label_list, feature_list) per query
+Synthetic-gated: relevance is a noisy linear function of the features
+so rankers can actually learn."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['train', 'test']
+
+_FDIM = 46
+_QUERIES = {"train": 120, "test": 40}
+_DOCS_PER_QUERY = 8
+
+
+def _groups(mode, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(_FDIM)
+    for _q in range(_QUERIES[mode]):
+        feats = rng.randn(_DOCS_PER_QUERY, _FDIM).astype(np.float32)
+        scores = feats @ w + rng.randn(_DOCS_PER_QUERY) * 0.5
+        labels = np.digitize(
+            scores, np.percentile(scores, [50, 80])).astype(np.int64)
+        yield labels, feats
+
+
+def _reader(mode, format, seed):
+    if format not in ("pointwise", "pairwise", "listwise"):
+        raise ValueError(f"unknown mq2007 format {format!r}")
+
+    def creator():
+        for labels, feats in _groups(mode, seed):
+            if format == "pointwise":
+                for lab, f in zip(labels, feats):
+                    yield int(lab), f
+            elif format == "listwise":
+                yield [int(x) for x in labels], feats
+            else:
+                for i in range(len(labels)):
+                    for j in range(len(labels)):
+                        if labels[i] > labels[j]:
+                            yield feats[i], feats[j]
+
+    return creator
+
+
+def train(format="pairwise"):
+    return _reader("train", format, seed=7)
+
+
+def test(format="pairwise"):
+    return _reader("test", format, seed=8)

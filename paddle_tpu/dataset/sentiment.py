@@ -1,0 +1,29 @@
+"""paddle.dataset.sentiment parity (reference dataset/sentiment.py):
+NLTK movie-reviews readers yielding (token ids, 0/1 label)."""
+from __future__ import annotations
+
+from ._common import ids_label_item as _item
+from ._common import reader_from
+
+__all__ = ['train', 'test', 'get_word_dict']
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+_VOCAB = 5000
+
+
+def get_word_dict():
+    return [(f"w{i}", i) for i in range(_VOCAB)]
+
+
+def train():
+    from ..text import MovieReviews
+
+    return reader_from(lambda: MovieReviews(mode="train"), _item)
+
+
+def test():
+    from ..text import MovieReviews
+
+    return reader_from(lambda: MovieReviews(mode="test"), _item)

@@ -1,0 +1,33 @@
+"""paddle.dataset.uci_housing parity (reference dataset/
+uci_housing.py): readers yield (13-float32 features, 1-float32 price).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import reader_from
+
+__all__ = ['train', 'test']
+
+feature_names = [
+    'CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS', 'RAD',
+    'TAX', 'PTRATIO', 'B', 'LSTAT',
+]
+
+
+def _item(sample):
+    x, y = sample
+    return (np.asarray(x, np.float32),
+            np.asarray(y, np.float32).reshape(-1))
+
+
+def train():
+    from ..text import UCIHousing
+
+    return reader_from(lambda: UCIHousing(mode="train"), _item)
+
+
+def test():
+    from ..text import UCIHousing
+
+    return reader_from(lambda: UCIHousing(mode="test"), _item)

@@ -1,0 +1,32 @@
+"""paddle.dataset.voc2012 parity (reference dataset/voc2012.py):
+segmentation readers yielding (image, mask)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import reader_from
+
+__all__ = ['train', 'test', 'val']
+
+
+def _item(sample):
+    img, mask = sample
+    return np.asarray(img, np.float32), np.asarray(mask, np.int64)
+
+
+def _make(mode):
+    from ..vision.datasets import VOC2012
+
+    return reader_from(lambda: VOC2012(mode=mode), _item)
+
+
+def train():
+    return _make("train")
+
+
+def test():
+    return _make("test")
+
+
+def val():
+    return _make("valid")

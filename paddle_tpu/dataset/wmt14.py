@@ -1,0 +1,32 @@
+"""paddle.dataset.wmt14 parity (reference dataset/wmt14.py): readers
+yield (src_ids, trg_in, trg_out) with BOS/EOS framing."""
+from __future__ import annotations
+
+from ._common import reader_from
+
+from ._common import triple_ids_item as _item
+
+__all__ = ['train', 'test', 'get_dict']
+
+
+def train(dict_size=1000):
+    from ..text import WMT14
+
+    return reader_from(lambda: WMT14(mode="train", dict_size=dict_size),
+                       _item)
+
+
+def test(dict_size=1000):
+    from ..text import WMT14
+
+    return reader_from(lambda: WMT14(mode="test", dict_size=dict_size),
+                       _item)
+
+
+def get_dict(dict_size=1000, reverse=False):
+    """(src_dict, trg_dict); reverse flips to id -> word (reference
+    wmt14.get_dict)."""
+    d = {f"w{i}": i for i in range(dict_size)}
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d, dict(d)

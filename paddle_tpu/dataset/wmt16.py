@@ -1,0 +1,42 @@
+"""paddle.dataset.wmt16 parity (reference dataset/wmt16.py): readers
+yield (src_ids, trg_in, trg_out); validation is a distinct split;
+fetch pre-materialises (a no-op for the synthetic-gated source)."""
+from __future__ import annotations
+
+from ._common import reader_from
+
+from ._common import triple_ids_item as _item
+
+__all__ = ['train', 'test', 'validation', 'fetch', 'get_dict']
+
+
+def _make(mode, src_dict_size, trg_dict_size, seed):
+    from ..text import WMT16
+
+    return reader_from(
+        lambda: WMT16(mode=mode, src_vocab_size=src_dict_size,
+                      trg_vocab_size=trg_dict_size, seed=seed), _item)
+
+
+def train(src_dict_size=1000, trg_dict_size=1000, src_lang="en"):
+    return _make("train", src_dict_size, trg_dict_size, seed=0)
+
+
+def test(src_dict_size=1000, trg_dict_size=1000, src_lang="en"):
+    return _make("test", src_dict_size, trg_dict_size, seed=0)
+
+
+def validation(src_dict_size=1000, trg_dict_size=1000, src_lang="en"):
+    # a third split: distinct seed, test-style sampling
+    return _make("test", src_dict_size, trg_dict_size, seed=16)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {f"{lang}{i}": i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def fetch():
+    """Reference fetch() downloads the archive; the synthetic-gated
+    source needs nothing."""
+    return None

@@ -46,8 +46,9 @@ def init_distributed(coordinator_address=None, num_processes=None,
 
 from .transpiler import (  # noqa: F401,E402
     DistributeTranspiler, DistributeTranspilerConfig, GeoSgdTranspiler,
-    PServerPlan,
+    HashName, PServerPlan, RoundRobin, memory_optimize, release_memory,
 )
+from .http_kv import KVHandler, KVHTTPServer, KVServer  # noqa: F401,E402
 
 # fleet class surface (reference python/paddle/distributed __all__):
 # strategy/rolemaker/meta-optimizer classes + dataset/fs re-exports

@@ -1,0 +1,93 @@
+"""Fleet HTTP KV coordination server (reference
+distributed/fleet/utils/http_server.py: KVHandler :46, KVHTTPServer
+:134, KVServer :157): a tiny GET/PUT/DELETE key-value HTTP service the
+reference uses for cross-node barrier/metadata exchange during fleet
+bring-up. Paths are "scope/key"; values are raw bytes."""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["KVHandler", "KVHTTPServer", "KVServer"]
+
+
+class KVHandler(BaseHTTPRequestHandler):
+    """GET returns the stored bytes (404 when absent), PUT stores the
+    body, DELETE removes the key and counts toward the scope's
+    deleted-size barrier."""
+
+    def do_GET(self):
+        with self.server.kv_lock:
+            value = self.server.kv.get(self.path.strip("/"))
+        if value is None:
+            self.send_status_code(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n) if n else b""
+        with self.server.kv_lock:
+            self.server.kv[self.path.strip("/")] = body
+        self.send_status_code(200)
+
+    def do_DELETE(self):
+        key = self.path.strip("/")
+        with self.server.kv_lock:
+            self.server.kv.pop(key, None)
+            scope = key.split("/")[0]
+            self.server.delete_kv[scope] = \
+                self.server.delete_kv.get(scope, 0) + 1
+        self.send_status_code(200)
+
+    def log_message(self, format, *args):  # noqa: A002 (reference name)
+        pass
+
+    def send_status_code(self, code):
+        self.send_response(code)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class KVHTTPServer(ThreadingHTTPServer):
+    """The listener: shared dict + per-scope delete counters."""
+
+    def __init__(self, port, handler):
+        super().__init__(("", int(port)), handler)
+        self.delete_kv = {}
+        self.kv_lock = threading.Lock()
+        self.kv = {}
+
+    def get_deleted_size(self, key):
+        with self.kv_lock:
+            return self.delete_kv.get(key, 0)
+
+
+class KVServer:
+    """Start/stop wrapper (reference KVServer): `size` maps scope ->
+    expected delete count for wait_server_ready-style barriers."""
+
+    def __init__(self, port, size=None):
+        self.http_server = KVHTTPServer(port, KVHandler)
+        self.listen_thread = None
+        self.size = dict(size or {})
+
+    def start(self):
+        self.listen_thread = threading.Thread(
+            target=self.http_server.serve_forever, daemon=True)
+        self.listen_thread.start()
+
+    def stop(self):
+        self.http_server.shutdown()
+        if self.listen_thread is not None:
+            self.listen_thread.join()
+        self.http_server.server_close()
+
+    def should_stop(self):
+        for key, expected in self.size.items():
+            if self.http_server.get_deleted_size(key) < expected:
+                return False
+        return True

@@ -267,3 +267,21 @@ class HashName(PSDispatcher):
     def dispatch(self, varlist):
         return [self._eps[self._hash(getattr(v, "name", str(v)))
                           % len(self._eps)] for v in varlist]
+
+
+def memory_optimize(input_program=None, skip_opt_set=None,
+                    print_log=False, level=0, skip_grads=True):
+    """Deprecated no-op, matching the reference (transpiler/
+    memory_optimization_transpiler.py: the 1.8 implementation logs an
+    error and does nothing — XLA buffer liveness subsumes it here)."""
+    import logging
+
+    logging.getLogger(__name__).error(
+        "paddle.fluid.memory_optimize is deprecated and retained as a "
+        "no-op (XLA's buffer-liveness scheduling replaces it)")
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Deprecated no-op (reference release_memory — same posture)."""
+    return None

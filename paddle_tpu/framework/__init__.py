@@ -28,3 +28,15 @@ from .op import primitive, OP_REGISTRY  # noqa: F401
 from .lod import (  # noqa: F401
     LoDTensor, create_lod_tensor, create_random_int_lodtensor,
 )
+
+
+def __getattr__(name):
+    # paddle.framework re-exports LayerList (reference framework/__init__
+    # __all__); importing nn at module top would cycle (nn imports
+    # framework), so resolve lazily
+    if name == "LayerList":
+        from ..nn import LayerList
+
+        return LayerList
+    raise AttributeError(
+        f"module 'paddle_tpu.framework' has no attribute {name!r}")

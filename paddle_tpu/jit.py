@@ -376,14 +376,114 @@ class TrainStep:
         return self._opt_state
 
 
+def _check_save_load_config(config):
+    """SaveLoadConfig knobs the StableHLO export does not implement
+    must fail LOUDLY, not round-trip into the void (r5 review): the
+    export always carries all forward outputs under the default
+    .pdmodel/.pdiparams names."""
+    cfg = config.pop("config", None)
+    if config:
+        raise TypeError(f"unknown jit.save/load options {sorted(config)}")
+    if cfg is None:
+        return
+    unsupported = []
+    if getattr(cfg, "output_spec", None):
+        unsupported.append("output_spec (all outputs are exported; "
+                           "select at call time)")
+    for knob in ("model_filename", "params_filename"):
+        if getattr(cfg, knob, None):
+            unsupported.append(f"{knob} (fixed .pdmodel/.pdiparams "
+                               "naming)")
+    if unsupported:
+        raise NotImplementedError(
+            "SaveLoadConfig knobs not supported by the StableHLO "
+            "export: " + "; ".join(unsupported))
+
+
 def save(layer, path, input_spec=None, **config):
     """jit.save parity: persist params + a StableHLO export of forward."""
     from .io.serialization import save_inference_model
 
+    _check_save_load_config(config)
     save_inference_model(path, layer, input_spec)
 
 
 def load(path, **config):
     from .io.serialization import load_inference_model
 
+    _check_save_load_config(config)
     return load_inference_model(path)
+
+
+class SaveLoadConfig:
+    """jit.SaveLoadConfig parity (reference fluid/dygraph/jit.py:270):
+    knob container for jit.save/load. output_spec selects forward
+    outputs to keep; model/params filenames name the export files;
+    separate_params/keep_name_table are storage-layout knobs the
+    StableHLO export does not need but keeps for API compatibility."""
+
+    def __init__(self):
+        self._output_spec = None
+        self._model_filename = None
+        self._params_filename = None
+        self._separate_params = False
+        self._keep_name_table = False
+
+    @property
+    def output_spec(self):
+        return self._output_spec
+
+    @output_spec.setter
+    def output_spec(self, spec):
+        self._output_spec = spec
+
+    @property
+    def model_filename(self):
+        return self._model_filename
+
+    @model_filename.setter
+    def model_filename(self, filename):
+        self._model_filename = filename
+
+    @property
+    def params_filename(self):
+        return self._params_filename
+
+    @params_filename.setter
+    def params_filename(self, filename):
+        self._params_filename = filename
+
+    @property
+    def separate_params(self):
+        return self._separate_params
+
+    @separate_params.setter
+    def separate_params(self, value):
+        self._separate_params = bool(value)
+
+    @property
+    def keep_name_table(self):
+        return self._keep_name_table
+
+    @keep_name_table.setter
+    def keep_name_table(self, value):
+        self._keep_name_table = bool(value)
+
+
+def __getattr__(name):
+    """Lazy paddle.jit surface re-exports (import-cycle-free):
+    TracedLayer lives in dygraph.py, ProgramTranslator in dy2static,
+    TranslatedLayer in io.serialization."""
+    if name == "TracedLayer":
+        from .dygraph import TracedLayer
+
+        return TracedLayer
+    if name == "ProgramTranslator":
+        from .dy2static import ProgramTranslator
+
+        return ProgramTranslator
+    if name == "TranslatedLayer":
+        from .io.serialization import TranslatedLayer
+
+        return TranslatedLayer
+    raise AttributeError(f"module 'paddle_tpu.jit' has no attribute {name!r}")

@@ -1,0 +1,187 @@
+"""Legacy paddle.dataset reader-creator surface + the fleet HTTP KV
+server + the MultiSlot data generators (round-5 namespace-closure
+sweep; references: dataset/__init__.py:33, fleet/utils/http_server.py,
+fluid/incubate/data_generator/__init__.py)."""
+import numpy as np
+import pytest
+
+from paddle_tpu import dataset
+
+pytestmark = pytest.mark.slow
+
+
+def _first(creator, n=3):
+    out = []
+    for item in creator():
+        out.append(item)
+        if len(out) == n:
+            break
+    return out
+
+
+def test_mnist_cifar_uci_readers():
+    img, label = _first(dataset.mnist.train())[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert isinstance(label, int)
+    img, label = _first(dataset.cifar.train10())[0]
+    assert img.shape == (3072,)
+    img, _ = _first(dataset.cifar.test100())[0]
+    assert img.shape == (3072,)
+    x, y = _first(dataset.uci_housing.test())[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert dataset.uci_housing.feature_names[0] == 'CRIM'
+
+
+def test_text_readers():
+    ids, label = _first(dataset.imdb.train(dataset.imdb.build_dict()))[0]
+    assert isinstance(ids, list) and label in (0, 1)
+    gram = _first(dataset.imikolov.train(n=5))[0]
+    assert len(gram) == 5 and all(isinstance(t, int) for t in gram)
+    pair = _first(dataset.imikolov.train(n=5, data_type="SKIPGRAM"))[0]
+    assert len(pair) == 2
+    ids, label = _first(dataset.sentiment.test())[0]
+    assert label in (0, 1)
+    assert dataset.sentiment.NUM_TOTAL_INSTANCES == 2000
+
+
+def test_translation_readers():
+    src, tin, tout = _first(dataset.wmt14.train(dict_size=64))[0]
+    assert tin[0] != tout[0] or len(tin) == len(tout)
+    sd, td = dataset.wmt14.get_dict(dict_size=16)
+    assert len(sd) == 16
+    src, tin, tout = _first(dataset.wmt16.validation(
+        src_dict_size=64, trg_dict_size=64))[0]
+    assert isinstance(src, list)
+    assert dataset.wmt16.fetch() is None
+    d = dataset.wmt16.get_dict("en", 8, reverse=True)
+    assert d[0] == "en0"
+
+
+def test_movielens_metadata_and_readers():
+    row = _first(dataset.movielens.train())[0]
+    assert len(row) == 7 and isinstance(row[5], list)
+    assert dataset.movielens.max_user_id() == 499
+    assert dataset.movielens.max_movie_id() == 799
+    assert dataset.movielens.max_job_id() == 20
+    cats = dataset.movielens.movie_categories()
+    assert cats['Action'] == 0 and len(cats) == 18
+    minfo = dataset.movielens.movie_info()
+    assert len(minfo) == 800 and minfo[3].index == 3
+    uinfo = dataset.movielens.user_info()
+    v = uinfo[7].value()
+    assert len(v) == 4
+    assert "MovieInfo" in repr(minfo[1])
+
+
+def test_conll_mq2007_flowers_voc():
+    words, pred, tags = _first(dataset.conll05.test())[0]
+    assert len(words) == len(tags) and isinstance(pred, int)
+    wd, vd, ld = dataset.conll05.get_dict()
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape == (len(wd), 32)
+    lab, feat = _first(dataset.mq2007.train(format="pointwise"))[0]
+    assert feat.shape == (46,) and 0 <= lab <= 2
+    pos, neg = _first(dataset.mq2007.train(format="pairwise"))[0]
+    assert pos.shape == neg.shape == (46,)
+    labs, feats = _first(dataset.mq2007.test(format="listwise"))[0]
+    assert feats.shape == (len(labs), 46)
+    img, label = _first(dataset.flowers.train())[0]
+    assert img.ndim == 3 and isinstance(label, int)
+    img, mask = _first(dataset.voc2012.val())[0]
+    assert img.ndim == 3 and mask.ndim == 2
+
+
+def test_image_utils(tmp_path):
+    rng = np.random.RandomState(0)
+    im = (rng.rand(40, 60, 3) * 255).astype(np.uint8)
+    r = dataset.image.resize_short(im, 32)
+    assert min(r.shape[:2]) == 32
+    c = dataset.image.center_crop(r, 24)
+    assert c.shape[:2] == (24, 24)
+    f = dataset.image.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    chw = dataset.image.to_chw(c)
+    assert chw.shape == (3, 24, 24)
+    t = dataset.image.simple_transform(im, 36, 24, is_train=True,
+                                       mean=[1.0, 2.0, 3.0])
+    assert t.shape == (3, 24, 24) and t.dtype == np.float32
+    # bytes round-trip through PIL
+    from PIL import Image
+    import io as _io
+
+    buf = _io.BytesIO()
+    Image.fromarray(im).save(buf, format="PNG")
+    back = dataset.image.load_image_bytes(buf.getvalue())
+    assert back.shape == im.shape
+
+
+def test_kv_server_roundtrip():
+    import urllib.request
+
+    from paddle_tpu.distributed import KVServer
+
+    srv = KVServer(0, size={"job": 1})
+    srv.start()
+    port = srv.http_server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        req = urllib.request.Request(f"{base}/job/rank0", data=b"ep:1234",
+                                     method="PUT")
+        assert urllib.request.urlopen(req).status == 200
+        got = urllib.request.urlopen(f"{base}/job/rank0").read()
+        assert got == b"ep:1234"
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"{base}/job/missing")
+        assert not srv.should_stop()
+        req = urllib.request.Request(f"{base}/job/rank0", method="DELETE")
+        urllib.request.urlopen(req)
+        assert srv.should_stop()
+    finally:
+        srv.stop()
+
+
+def test_multislot_data_generators():
+    from paddle_tpu.incubate.data_generator import (
+        MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+    )
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                yield [("words", [1926, 8, 17]), ("label", [1])]
+                yield [("words", [4, 5]), ("label", [0])]
+
+            return local_iter
+
+    g = G()
+    g.set_batch(2)
+    lines = g.run_from_memory()
+    assert lines == ["3 1926 8 17 1 1\n", "2 4 5 1 0\n"]
+
+    class S(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                yield [("q", ["a", "b"]), ("label", ["1"])]
+
+            return local_iter
+
+    assert S().run_from_memory() == ["2 a b 1 1\n"]
+    with pytest.raises(ValueError):
+        MultiSlotDataGenerator()._gen_str("not-a-list")
+
+
+def test_transpiler_deprecated_noops_and_jit_surface():
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.jit as jit
+
+    assert dist.memory_optimize(None) is None
+    assert dist.release_memory(None) is None
+    assert dist.HashName(["a:1", "b:2"]).dispatch([type(
+        "V", (), {"name": "w"})()])[0] in ("a:1", "b:2")
+    cfg = jit.SaveLoadConfig()
+    cfg.model_filename = "m.pdmodel"
+    cfg.output_spec = [1]
+    cfg.separate_params = True
+    assert cfg.model_filename == "m.pdmodel" and cfg.separate_params
+    assert jit.TracedLayer is not None
+    assert jit.TranslatedLayer is not None

@@ -41,6 +41,23 @@ TARGETS = {
     "utils": "paddle_tpu.utils",
     "fluid.contrib": "paddle_tpu.contrib",
     "fluid.contrib.layers": "paddle_tpu.contrib.layers",
+    "jit": "paddle_tpu.jit",
+    "framework": ("paddle_tpu.framework", "paddle_tpu"),
+    "nn.initializer": "paddle_tpu.nn.initializer",
+    "dataset": "paddle_tpu.dataset",
+    "distributed.fleet.utils": ("paddle_tpu.distributed",
+                                "paddle_tpu.io"),
+    "fluid.dataloader": "paddle_tpu.io",
+    "fluid.dygraph.amp": "paddle_tpu.amp",
+    "fluid.transpiler": "paddle_tpu.distributed",
+    "fluid.incubate.data_generator": "paddle_tpu.incubate.data_generator",
+    "incubate.hapi.datasets": ("paddle_tpu.text",
+                               "paddle_tpu.vision.datasets"),
+    "incubate.hapi.text": ("paddle_tpu.incubate.text_models",
+                           "paddle_tpu.incubate"),
+    "incubate.hapi.vision": ("paddle_tpu.vision",
+                             "paddle_tpu.vision.models",
+                             "paddle_tpu.vision.transforms"),
     "fluid.metrics": "paddle_tpu.metric",
     "fluid.initializer": "paddle_tpu.nn.initializer",
     "fluid.regularizer": "paddle_tpu.regularizer",
@@ -109,6 +126,11 @@ def test_freeze_counts_pinned():
         "fluid.regularizer": 4, "fluid.clip": 5, "fluid.optimizer": 27,
         "paddle": 202, "fluid": 76, "fluid.dygraph": 57,
         "fluid.contrib": 34, "fluid.contrib.layers": 19,
+        "jit": 7, "framework": 26, "nn.initializer": 7, "dataset": 14,
+        "distributed.fleet.utils": 3, "fluid.dataloader": 7,
+        "fluid.dygraph.amp": 2, "fluid.transpiler": 6,
+        "fluid.incubate.data_generator": 2, "incubate.hapi.datasets": 15,
+        "incubate.hapi.text": 27, "incubate.hapi.vision": 42,
     }
     for ns, n in expected_min.items():
         assert len(FREEZE[ns]) >= n, (ns, len(FREEZE[ns]), n)

@@ -39,6 +39,19 @@ NAMESPACES = {
     "utils": "utils/__init__.py",
     "fluid.contrib": "fluid/contrib/__init__.py",
     "fluid.contrib.layers": "fluid/contrib/layers/__init__.py",
+    "jit": "jit/__init__.py",
+    "framework": "framework/__init__.py",
+    "nn.initializer": "nn/initializer/__init__.py",
+    "dataset": "dataset/__init__.py",
+    "distributed.fleet.utils": "distributed/fleet/utils/__init__.py",
+    "fluid.dataloader": "fluid/dataloader/__init__.py",
+    "fluid.dygraph.amp": "fluid/dygraph/amp/__init__.py",
+    "fluid.transpiler": "fluid/transpiler/__init__.py",
+    "fluid.incubate.data_generator":
+        "fluid/incubate/data_generator/__init__.py",
+    "incubate.hapi.datasets": "incubate/hapi/datasets/__init__.py",
+    "incubate.hapi.text": "incubate/hapi/text/__init__.py",
+    "incubate.hapi.vision": "incubate/hapi/vision/__init__.py",
     "fluid.metrics": "fluid/metrics.py",
     "fluid.initializer": "fluid/initializer.py",
     "fluid.regularizer": "fluid/regularizer.py",
