@@ -403,10 +403,28 @@ def run_config(name: str, smoke: bool, backend: str,
                degraded: bool = False, trend: bool = False) -> dict:
     row = _base_row(name, backend)
     row["vs_baseline"] = 0.0
+    # executor hot-path counters (paddle_tpu.profiler): delta over this
+    # config's build+warmup+measurement. cache_hits/misses = compiled-step
+    # lookups, h2d_bytes = host->device payload traffic, donated = bytes
+    # of param/optimizer buffers offered to XLA for in-place reuse.
+    from paddle_tpu import profiler as _profiler
+
+    counters_before = _profiler.counters_snapshot()
     try:
         res = (bench_bert(seq=128, trend=True)
                if trend and name == "bert" else CONFIGS[name](smoke))
         attach_mfu(res)
+        ec = _profiler.counters_delta(counters_before)
+        res.update({
+            "cache_hits": ec.get("compile_cache_hits", 0),
+            "cache_misses": ec.get("compile_cache_misses", 0),
+            "h2d_bytes": ec.get("h2d_bytes", 0),
+            "donated": ec.get("donated_bytes", 0),
+            "exec_counters": ec,
+        })
+        if res.get("dt") and res.get("steps") and \
+                "steps_per_sec" not in res:
+            res["steps_per_sec"] = round(res["steps"] / res["dt"], 4)
         kind = res["device_kind"]
         mfu = res.pop("mfu")
         fps = res.pop("flops_per_step", None)

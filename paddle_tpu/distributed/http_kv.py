@@ -53,10 +53,14 @@ class KVHandler(BaseHTTPRequestHandler):
 
 
 class KVHTTPServer(ThreadingHTTPServer):
-    """The listener: shared dict + per-scope delete counters."""
+    """The listener: shared dict + per-scope delete counters.
 
-    def __init__(self, port, handler):
-        super().__init__(("", int(port)), handler)
+    Binds loopback by default — the unauthenticated KV store must not be
+    reachable from the network unless a real multi-node bring-up opts in
+    (host="" or the node's address)."""
+
+    def __init__(self, port, handler, host="127.0.0.1"):
+        super().__init__((host, int(port)), handler)
         self.delete_kv = {}
         self.kv_lock = threading.Lock()
         self.kv = {}
@@ -70,8 +74,8 @@ class KVServer:
     """Start/stop wrapper (reference KVServer): `size` maps scope ->
     expected delete count for wait_server_ready-style barriers."""
 
-    def __init__(self, port, size=None):
-        self.http_server = KVHTTPServer(port, KVHandler)
+    def __init__(self, port, size=None, host="127.0.0.1"):
+        self.http_server = KVHTTPServer(port, KVHandler, host=host)
         self.listen_thread = None
         self.size = dict(size or {})
 

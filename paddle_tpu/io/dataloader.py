@@ -14,11 +14,10 @@ import multiprocessing
 import os
 import queue
 import sys
-import threading
 import time
 import traceback
 import uuid
-from typing import Iterable, List, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -303,12 +302,17 @@ def _contains_tensor(tree) -> bool:
 
 
 def _tree_to_tensor(tree):
-    """Promote ndarray leaves back to Tensors in the main process."""
+    """Promote ndarray leaves back to Tensors in the consumer process —
+    Tensor.__init__'s jnp.asarray IS the h2d transfer, so count it (this
+    runs on the DataLoader prefetch thread when buffering is on)."""
     if isinstance(tree, (tuple, list)):
         return tuple(_tree_to_tensor(x) for x in tree)
     if isinstance(tree, dict):
         return {k: _tree_to_tensor(v) for k, v in tree.items()}
     if isinstance(tree, np.ndarray):
+        from .. import profiler
+
+        profiler.bump_counter("h2d_bytes", tree.nbytes)
         return Tensor(tree)
     return tree
 
@@ -803,60 +807,38 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        from ..static.prefetch import FeedPrefetcher
+
         if self.num_workers > 0:
             epoch = self._epoch
             self._epoch += 1
-            yield from _MultiprocessIter(self, epoch=epoch)
+            if self.prefetch > 0:
+                # the prefetch thread DRIVES the worker iterator, and
+                # _iter_map/_iter_iterable promote numpy payloads to
+                # Tensors (jnp.asarray = the h2d transfer) as they yield
+                # — so batches arrive device-resident and the training
+                # thread never pays the copy; no extra staging needed
+                pf = FeedPrefetcher(iter(_MultiprocessIter(self,
+                                                           epoch=epoch)),
+                                    depth=self.prefetch,
+                                    stage=lambda batch: batch)
+                try:
+                    yield from pf
+                finally:
+                    pf.close()
+            else:
+                yield from _MultiprocessIter(self, epoch=epoch)
             return
         if self.prefetch <= 0:
             yield from self._raw_iter()
             return
-        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
-        sentinel = object()
-        err: List[BaseException] = []
-        stop = threading.Event()
-
-        def worker():
-            try:
-                for item in self._raw_iter():
-                    # bounded put that notices consumer abandonment, so an
-                    # early `break` in the training loop can't leak the
-                    # thread blocked on a full queue
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.5)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:  # propagate to consumer
-                err.append(e)
-            finally:
-                # blocking put: a full queue must not swallow the sentinel
-                # (the consumer would hang on q.get() forever); stays
-                # abandonment-aware like the item puts above
-                while not stop.is_set():
-                    try:
-                        q.put(sentinel, timeout=0.5)
-                        break
-                    except queue.Full:
-                        continue
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
+        # single-process prefetch: same bounded-queue/sentinel/abandonment
+        # protocol, one implementation (paddle_tpu.static.prefetch).
+        # _raw_iter collates on the prefetch thread, so Tensor promotion
+        # (= the h2d transfer) also overlaps the consumer's step.
+        pf = FeedPrefetcher(self._raw_iter(), depth=self.prefetch,
+                            stage=lambda batch: batch)
         try:
-            while True:
-                item = q.get()
-                if item is sentinel:
-                    break
-                yield item
+            yield from pf
         finally:
-            stop.set()
-            while not q.empty():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-        if err:
-            raise err[0]
+            pf.close()

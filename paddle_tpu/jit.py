@@ -197,6 +197,10 @@ class TrainStep:
         self._opt_state = None
         self._seed = seed
         self._compiled = None
+        self._donate = bool(donate)
+        self._seen_sigs = set()   # batch signatures already compiled
+        self._donated_nbytes = None  # cached donated-set size
+        self._lr_cache = None     # (float value, device scalar)
         self._mesh = mesh
         self._param_rules = param_rules
         self._data_axes = data_axes
@@ -331,7 +335,10 @@ class TrainStep:
                 grads, params, opt_state, lr)
             return loss, aux, new_params, new_buffers, new_opt_state
 
-        jit_kwargs = {"donate_argnums": (0, 2)}
+        # params + optimizer state are donated: XLA updates the (large)
+        # parameter/moment buffers in place instead of allocating a fresh
+        # set per step. donate=False keeps every input buffer readable.
+        jit_kwargs = {"donate_argnums": (0, 2)} if self._donate else {}
         self._compiled = jax.jit(pure_step, **jit_kwargs)
 
     def __call__(self, *batch):
@@ -345,14 +352,40 @@ class TrainStep:
                          if p.trainable})
         if self._compiled is None:
             self._build()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        from . import profiler
+
+        # device lr scalar is cached on its float value: an unchanged lr
+        # costs zero per-step h2d transfers (schedulers invalidate it)
+        lr_val = float(self.optimizer.get_lr())
+        if self._lr_cache is None or self._lr_cache[0] != lr_val:
+            self._lr_cache = (lr_val, jnp.asarray(lr_val, jnp.float32))
+            profiler.bump_counter("h2d_bytes", 4)
+        lr = self._lr_cache[1]
         batch_arrays = tuple(
             _tree.tree_map(_unwrap_out, b,
                            is_leaf=lambda x: isinstance(x, Tensor))
             for b in batch)
+        sig = tuple(
+            (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", "")))
+            for a in _tree.tree_leaves(batch_arrays))
+        new_sig = sig not in self._seen_sigs
+        if new_sig:
+            self._seen_sigs.add(sig)
+        profiler.bump_counter(
+            "compile_cache_misses" if new_sig else "compile_cache_hits")
+        profiler.bump_counter("executor_steps")
         if self._mesh is not None:
             params, buffers, batch_arrays = self._place_spmd(
                 params, buffers, batch_arrays)
+        if self._donate:
+            if new_sig or self._donated_nbytes is None:
+                # O(param leaves) walk only on a fresh signature — the
+                # donated set is invariant across steady-state steps
+                self._donated_nbytes = sum(
+                    int(getattr(a, "nbytes", 0) or 0)
+                    for tree in (params, self._opt_state)
+                    for a in _tree.tree_leaves(tree))
+            profiler.bump_counter("donated_bytes", self._donated_nbytes)
         loss, aux, new_params, new_buffers, new_opt_state = self._compiled(
             params, buffers, self._opt_state, lr, batch_arrays)
         for n, p in model.named_parameters():
@@ -400,18 +433,32 @@ def _check_save_load_config(config):
             "export: " + "; ".join(unsupported))
 
 
-def save(layer, path, input_spec=None, **config):
+def _merge_configs_alias(config, configs):
+    """Reference signature parity: jit.save/load take the knob container
+    as ``configs=`` (fluid/dygraph/jit.py); ``config=`` is the historical
+    keyword this port accepted. Either spelling lands in the same checked
+    slot; passing both is ambiguous and refused."""
+    if configs is not None:
+        if config.get("config") is not None:
+            raise TypeError(
+                "pass the SaveLoadConfig as either config= or configs=, "
+                "not both")
+        config["config"] = configs
+    return config
+
+
+def save(layer, path, input_spec=None, configs=None, **config):
     """jit.save parity: persist params + a StableHLO export of forward."""
     from .io.serialization import save_inference_model
 
-    _check_save_load_config(config)
+    _check_save_load_config(_merge_configs_alias(config, configs))
     save_inference_model(path, layer, input_spec)
 
 
-def load(path, **config):
+def load(path, configs=None, **config):
     from .io.serialization import load_inference_model
 
-    _check_save_load_config(config)
+    _check_save_load_config(_merge_configs_alias(config, configs))
     return load_inference_model(path)
 
 

@@ -13,6 +13,7 @@ import re
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 Rules = List[Tuple[str, PartitionSpec]]
@@ -59,8 +60,30 @@ def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
     return out
 
 
+def device_put_counted(arr, sharding=None):
+    """jax.device_put that bumps the profiler's h2d byte counter when the
+    source is host-resident (numpy/python scalars). Device-to-device
+    reshards of an already-resident array count nothing — re-placing
+    state every step is exactly the traffic the executor hot path is
+    built to avoid, so only true uploads show up in ``h2d_bytes``."""
+    host_resident = not isinstance(arr, jax.Array)
+    out = jax.device_put(arr, sharding) if sharding is not None \
+        else jax.device_put(arr)
+    if host_resident:
+        try:
+            nb = int(np.asarray(arr).nbytes)
+        except Exception:
+            nb = 0
+        if nb:
+            from .. import profiler
+
+            profiler.bump_counter("h2d_bytes", nb)
+    return out
+
+
 def place_params(params: Dict[str, jax.Array], shardings) -> Dict[str, jax.Array]:
-    return {n: jax.device_put(a, shardings[n]) for n, a in params.items()}
+    return {n: device_put_counted(a, shardings[n])
+            for n, a in params.items()}
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,58 @@ _state = {
 }
 
 
+# ---------------------------------------------------------------------------
+# executor hot-path counters.
+#
+# The reference profiler only times host events; the quantities that decide
+# TPU step-loop health — did the step recompile, did state bounce through
+# host memory, were parameter buffers donated — are invisible to a timer.
+# Every executor (static Executor, jit.TrainStep) bumps these; bench.py
+# snapshots before/after a config and reports the delta in its rows.
+#
+# Names in use:
+#   compile_cache_hits / compile_cache_misses  per-step executable lookup
+#   h2d_bytes          all host->device payload bytes (feeds + uploads)
+#   state_h2d_bytes    the persistable-state slice of h2d_bytes only —
+#                      zero after the first step when state stays resident
+#   donated_bytes      bytes of buffers offered to XLA for in-place reuse
+#   donation_fallback_copies  aliased/exposed state arrays copied so a
+#                      caller-held reference survives donation
+#   executor_steps     compiled steps dispatched
+# ---------------------------------------------------------------------------
+import threading as _threading
+from collections import Counter as _Counter
+
+_counters: _Counter = _Counter()
+# prefetch threads bump h2d_bytes concurrently with the training
+# thread's bumps; Counter's += is a read-modify-write
+_counters_lock = _threading.Lock()
+
+
+def bump_counter(name: str, n: int = 1) -> None:
+    """Add ``n`` to the global executor counter ``name`` (thread-safe)."""
+    with _counters_lock:
+        _counters[name] += n
+
+
+def counters_snapshot() -> dict:
+    """Copy of the global executor counters (pair with counters_delta)."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def counters_delta(before: dict) -> dict:
+    """Non-zero counter movement since ``before`` (a counters_snapshot)."""
+    with _counters_lock:
+        return {k: v - before.get(k, 0) for k, v in _counters.items()
+                if v - before.get(k, 0)}
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
+
+
 class RecordEvent:
     """RAII profiling scope (reference platform/profiler.h:126).
 
@@ -130,6 +182,12 @@ def summary(sorted_key: Optional[str] = "total") -> str:
     for name, calls, total, ave, mn, mx in rows:
         lines.append(f"{name:<40}{calls:>8}{total:>12.6f}{ave:>12.6f}"
                      f"{mn:>12.6f}{mx:>12.6f}")
+    counters = counters_snapshot()   # locked copy: prefetch threads bump
+    if counters:
+        lines.append("")
+        lines.append(f"{'Executor counter':<40}{'Value':>12}")
+        for name in sorted(counters):
+            lines.append(f"{name:<40}{counters[name]:>12}")
     return "\n".join(lines)
 
 

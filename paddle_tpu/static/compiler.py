@@ -52,10 +52,12 @@ class CompiledProgram:
         self._data_parallel = False
         self._mesh: Optional[Mesh] = None
         self._loss_name = None
+        self._sharding_cache = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
         self._data_parallel = True
+        self._sharding_cache = None
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
@@ -70,12 +72,21 @@ class CompiledProgram:
 
     def _data_sharding(self):
         """Sharding map consumed by Executor._build: feed names -> sharding
-        (batch split over "data"), "__param__" -> replicated."""
+        (batch split over "data"), "__param__" -> replicated. Built once
+        and cached — the executor applies it when state is first uploaded
+        (and via in/out_shardings on the compiled step), so chained steps
+        never re-partition resident state."""
         if not self._data_parallel or self._mesh is None:
             return None
-        shard = NamedSharding(self._mesh, PartitionSpec("data"))
-        rep = NamedSharding(self._mesh, PartitionSpec())
-        feeds = {v.name: shard for v in self._program.list_vars()
-                 if v.desc.is_data}
-        feeds["__param__"] = rep
-        return feeds
+        # keyed on the program version: data vars added after the first
+        # run (another py_reader, a late feed) still get batch-split
+        version = getattr(self._program, "_version", 0)
+        if self._sharding_cache is None or \
+                self._sharding_cache[0] != version:
+            shard = NamedSharding(self._mesh, PartitionSpec("data"))
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            feeds = {v.name: shard for v in self._program.list_vars()
+                     if v.desc.is_data}
+            feeds["__param__"] = rep
+            self._sharding_cache = (version, feeds)
+        return self._sharding_cache[1]

@@ -33,28 +33,55 @@ from .kernels import KERNELS, ExecContext
 
 class Scope:
     """name -> jax.Array store (reference framework/scope.cc, but flat &
-    functional: executors read a snapshot and write back results)."""
+    functional: executors read a snapshot and write back results).
+
+    Arrays handed out through the public accessors are marked *exposed*:
+    the caller may hold a reference, so a donating executor must not let
+    XLA invalidate that buffer in place — it copies exposed entries
+    before donation (the copy is what gets donated; the caller's alias
+    stays readable). The executor's own reads/writes go through the
+    underscore accessors, which don't mark — and a write-back clears the
+    mark, because the freshly produced array has no external aliases."""
 
     def __init__(self):
         self._vars: Dict[str, Any] = {}
+        self._exposed: set = set()
 
     def find_var(self, name):
-        return self._vars.get(name)
+        v = self._vars.get(name)
+        if v is not None:
+            self._exposed.add(name)
+        return v
 
     def var(self, name):
-        return self._vars.setdefault(name, None)
+        v = self._vars.setdefault(name, None)
+        if v is not None:
+            self._exposed.add(name)
+        return v
 
     def set(self, name, value):
+        # the caller necessarily holds a reference to what it just set
         self._vars[name] = value
+        self._exposed.add(name)
 
     def keys(self):
         return self._vars.keys()
 
     def items(self):
+        self._exposed.update(self._vars.keys())
         return self._vars.items()
 
     def drop(self, name):
         self._vars.pop(name, None)
+        self._exposed.discard(name)
+
+    # -- executor-internal access (no exposure bookkeeping) ---------------
+    def _peek(self, name):
+        return self._vars.get(name)
+
+    def _write_back(self, name, value):
+        self._vars[name] = value
+        self._exposed.discard(name)
 
 
 _global_scope = Scope()
@@ -135,16 +162,50 @@ def _feed_signature(feed: Dict[str, np.ndarray]):
                         for k, v in feed.items()))
 
 
-class Executor:
-    """exe = Executor(place); exe.run(program, feed=..., fetch_list=...)."""
+def _nbytes(arr) -> int:
+    """Array payload bytes; 0 for extended dtypes (typed PRNG keys raise
+    on .nbytes) and non-arrays."""
+    try:
+        return int(arr.nbytes)
+    except Exception:
+        return 0
 
-    def __init__(self, place=None):
+
+class Executor:
+    """exe = Executor(place); exe.run(program, feed=..., fetch_list=...).
+
+    The step loop is allocation- and transfer-minimal: persistable state
+    lives on device across steps (uploaded + sharded once, never bounced
+    through host numpy), and the state/rng arguments are DONATED to XLA
+    so parameter/optimizer buffers are updated in place. Arrays a caller
+    obtained through the Scope's public API are copied before donation
+    (see Scope) so stale references stay readable. ``donate_state=False``
+    opts out entirely."""
+
+    def __init__(self, place=None, donate_state: bool = True):
         import weakref
         self.place = place if place is not None else CPUPlace()
         # per-program compiled cache: entries die with their Program (no
         # id() aliasing, no pinning of dead programs)
         self._cache = weakref.WeakKeyDictionary()
         self._step = 0
+        self._donate = bool(donate_state)
+        # per-executor view of the hot-path counters; the module-global
+        # aggregate lives in profiler._counters (bench reads that one)
+        import collections
+        self._counters = collections.Counter()
+
+    def _bump(self, name: str, n: int = 1):
+        from .. import profiler
+
+        self._counters[name] += n
+        profiler.bump_counter(name, n)
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        """This executor's hot-path counters (cache hits/misses, h2d
+        bytes, donated bytes, steps) — cumulative since construction."""
+        return dict(self._counters)
 
     def close(self):
         self._cache.clear()
@@ -165,6 +226,10 @@ class Executor:
             program = program._program
         if program is None:
             program = default_main_program()
+        # let the program's py_readers stage batches directly into the
+        # feed layout on their prefetch thread; set unconditionally so a
+        # later raw-Program run clears a stale data-parallel stash
+        program._feed_sharding = sharding
         scope = scope or global_scope()
         if not feed and not fetch_list:
             # startup-program shape: run initializers eagerly into the scope
@@ -181,9 +246,10 @@ class Executor:
                        for v in (fetch_list or [])]
 
         block = program.global_block
+        peek = getattr(scope, "_peek", scope.find_var)
         persist_names = sorted(
             n for n, v in block.vars.items()
-            if v.persistable and scope.find_var(n) is not None)
+            if v.persistable and peek(n) is not None)
         # shape/dtype only — never materialize device arrays for the key
         key = (program._version, _feed_signature(feed),
                tuple(fetch_names), tuple(persist_names), bool(sharding))
@@ -191,19 +257,77 @@ class Executor:
         if not use_program_cache or key not in per_prog:
             per_prog[key] = self._build(program, block, feed, fetch_names,
                                         persist_names, sharding)
+            self._bump("compile_cache_misses")
+        else:
+            self._bump("compile_cache_hits")
         compiled = per_prog[key]
 
-        state = [scope.find_var(n) for n in persist_names]
+        feed_vals = [feed[k] for k in sorted(feed.keys())]
+        state = self._gather_state(scope, persist_names, feed_vals,
+                                   sharding)
         seed = program.random_seed or random_mod.default_generator().initial_seed()
         rng = jax.random.fold_in(random_mod.make_key(seed), self._step)
         self._step += 1
-        feed_vals = [feed[k] for k in sorted(feed.keys())]
+        self._bump("executor_steps")
+        feed_h2d = sum(_nbytes(v) for v in feed_vals
+                       if not isinstance(v, jax.Array))
+        if feed_h2d:
+            self._bump("h2d_bytes", feed_h2d)
+        if self._donate:
+            self._bump("donated_bytes",
+                       sum(_nbytes(a) for a in state) + _nbytes(rng))
         fetches, new_state = compiled(feed_vals, state, rng)
+        write_back = getattr(scope, "_write_back", scope.set)
         for n, v in zip(persist_names, new_state):
-            scope.set(n, v)
+            write_back(n, v)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
+        # a fetched persistable may share its buffer with the state just
+        # written back (same traced value — XLA may alias the outputs);
+        # mark it exposed so the next donating step copies first
+        if self._donate and hasattr(scope, "_exposed"):
+            persist_set = set(persist_names)
+            scope._exposed.update(n for n in fetch_names
+                                  if n in persist_set)
         return list(fetches)
+
+    def _gather_state(self, scope, persist_names, feed_vals, sharding):
+        """Read persistable state for one step, keeping it device-resident:
+        host entries (numpy — e.g. fresh from static.io.load) are uploaded
+        ONCE, already laid out with the program's parameter sharding, and
+        written back so every later step passes resident jax.Arrays —
+        zero per-step host->device traffic for state. Under donation,
+        caller-visible aliases are copied so donation can't invalidate a
+        buffer the caller still holds (or hand XLA one buffer twice)."""
+        peek = getattr(scope, "_peek", scope.find_var)
+        write_back = getattr(scope, "_write_back", scope.set)
+        exposed = getattr(scope, "_exposed", set())
+        param_shard = sharding.get("__param__") if sharding else None
+        state = []
+        # a feed array doubling as state must not be donated out from
+        # under the feed argument
+        seen = {id(v) for v in feed_vals if isinstance(v, jax.Array)}
+        from ..parallel.sharding import device_put_counted
+
+        for n in persist_names:
+            arr = peek(n)
+            if not isinstance(arr, jax.Array):
+                host = np.asarray(arr)
+                # device_put_counted bumps the global h2d_bytes; the
+                # state-specific slice (and this executor's view) are
+                # tracked here
+                arr = device_put_counted(host, param_shard)
+                self._counters["h2d_bytes"] += host.nbytes
+                self._bump("state_h2d_bytes", host.nbytes)
+                write_back(n, arr)
+            if self._donate:
+                aliased = id(arr) in seen
+                seen.add(id(arr))
+                if aliased or n in exposed:
+                    arr = jnp.array(arr)   # the copy is what gets donated
+                    self._bump("donation_fallback_copies")
+            state.append(arr)
+        return state
 
     def _build(self, program, block, feed, fetch_names, persist_names,
                sharding):
@@ -220,12 +344,22 @@ class Executor:
             return fetches, new_state
 
         jit_kwargs = {}
+        if self._donate:
+            # state + rng buffers are reused in place by XLA; feeds are
+            # fresh per step and stay un-donated
+            jit_kwargs["donate_argnums"] = (1, 2)
         if sharding is not None:
+            param_shard = sharding.get("__param__")
             in_shardings = (
                 [sharding.get(k) for k in feed_keys],
-                [sharding.get("__param__")] * len(persist_names),
+                [param_shard] * len(persist_names),
                 None)
             jit_kwargs["in_shardings"] = in_shardings
+            # pin state OUTPUTS to the same layout: chained steps feed
+            # new_state straight back in without re-partitioning
+            jit_kwargs["out_shardings"] = (
+                [None] * len(fetch_names),
+                [param_shard] * len(persist_names))
         return jax.jit(step, **jit_kwargs)
 
     # -- dataset-driven training (reference executor.py:1593) -------------
@@ -249,11 +383,22 @@ class Executor:
         import queue as queue_mod
         import threading
 
+        from .compiler import CompiledProgram
         from .ir import default_main_program
 
         if dataset is None:
             raise ValueError("train_from_dataset requires a dataset")
-        program = program or default_main_program()
+        run_target = program if program is not None else \
+            default_main_program()
+        # a CompiledProgram trains data-parallel: steps run through
+        # self.run (which applies its sharding to the compiled step) and
+        # the prefetcher stages each batch DIRECTLY into the feed's
+        # sharded layout — no per-step re-partition
+        sharding = None
+        program = run_target
+        if isinstance(program, CompiledProgram):
+            sharding = program._data_sharding()
+            program = program._program
         scope = scope or global_scope()
         block = program.global_block
         fetch_list = fetch_list or []
@@ -286,27 +431,37 @@ class Executor:
                      for s in shards]
         for t in producers:
             t.start()
+
+        from .prefetch import FeedPrefetcher
+
+        def host_feeds():
+            ended = 0
+            while ended < len(producers):
+                item = q.get()
+                if item is _END:
+                    ended += 1
+                elif item:          # skip empty feed dicts
+                    yield item
+
+        # second pipeline stage: while the device executes step N, the
+        # prefetch thread device_puts batch N+1 (the producers above
+        # keep parsing/padding N+2...). Depth scales with ingestion
+        # parallelism but stays bounded — each slot pins device memory.
+        prefetcher = FeedPrefetcher(host_feeds(), depth=max(2, int(thread)),
+                                    sharding=sharding)
         step = 0
         last_fetch = None
-        pending = None  # one-batch lookahead so the final step is known
-        ended = 0
         try:
-            while True:
-                feed = q.get()
-                if feed is _END:
-                    ended += 1
-                    if ended < len(producers):
-                        continue   # other shards still producing
-                at_end = feed is _END
-                feed, pending = pending, (None if at_end else feed)
-                if feed is None or not feed:
-                    if at_end:
-                        break
-                    continue
-                final_step = at_end
+            # one-batch lookahead so the final step is known (it always
+            # fetches, like the reference's end-of-epoch metric read)
+            pending = next(prefetcher, None)
+            while pending is not None:
+                feed = pending
+                pending = next(prefetcher, None)
+                final_step = pending is None
                 want_fetch = fetch_list and (
                     debug or final_step or step % print_period == 0)
-                out = self.run(program, feed=feed,
+                out = self.run(run_target, feed=feed,
                                fetch_list=fetch_list if want_fetch else None,
                                scope=scope)
                 if want_fetch:
@@ -316,11 +471,14 @@ class Executor:
                                         for n, v in zip(fetch_info, out))
                         print(f"[train_from_dataset] step {step}: {msg}")
                 step += 1
-                if at_end:
-                    break
         finally:
-            # unblock the producers (bounded queue) before joining, even
-            # when a step raised mid-epoch
+            # teardown order matters: signal the prefetch thread FIRST
+            # (no join yet — it may be blocked on q.get while producers
+            # are still filling q), then unblock/join the producers, then
+            # re-seed the _END sentinels the drain may have eaten so
+            # host_feeds() always reaches its exit count, and only then
+            # join the prefetch thread.
+            prefetcher.stop()
             while any(t.is_alive() for t in producers):
                 try:
                     q.get(timeout=0.1)
@@ -328,6 +486,14 @@ class Executor:
                     pass
             for t in producers:
                 t.join()
+            for _ in producers:
+                try:
+                    q.put_nowait(_END)
+                except queue_mod.Full:
+                    # q full ⇒ the worker is past q.get (it consumed a
+                    # batch) and will see the stop flag, not block again
+                    break
+            prefetcher.close()
         if producer_error:
             raise producer_error[0]
         return last_fetch
@@ -376,10 +542,12 @@ class Executor:
         scope = scope or global_scope()
         seed = program.random_seed or random_mod.default_generator().initial_seed()
         ctx = ExecContext(rng_key=random_mod.make_key(seed))
-        env = {n: scope.find_var(n) for n in program.global_block.vars
-               if scope.find_var(n) is not None}
+        peek = getattr(scope, "_peek", scope.find_var)
+        write_back = getattr(scope, "_write_back", scope.set)
+        env = {n: peek(n) for n in program.global_block.vars
+               if peek(n) is not None}
         env = run_block(program.global_block, env, ctx)
         for name, desc in program.global_block.vars.items():
             if desc.persistable and name in env and env[name] is not None:
-                scope.set(name, env[name])
+                write_back(name, env[name])
         return []

@@ -1,0 +1,142 @@
+"""Async host->device feed pipeline.
+
+The reference overlaps input with compute through C++ double buffering
+(operators/reader/buffered_reader.cc: a background thread copies the
+next LoDTensor batch to the device while the op loop consumes the
+current one). The TPU-native equivalent: a ``FeedPrefetcher`` drives any
+batch iterator from a daemon thread, STAGES each batch host->device
+(``jax.device_put``, honoring the feed's sharding) into a bounded queue
+of configurable depth, and the training loop pops device-resident
+batches — the h2d copy of batch N+1 runs while XLA executes step N.
+
+EOF and failure semantics match the queue protocol the reference's
+BlockingQueue gives readers: exhaustion surfaces as ``StopIteration``
+(py_reader translates it to ``EOFException``), a worker exception is
+re-raised in the consumer with the original traceback, and ``close()``
+is always safe — it stops the thread, drains the queue, and closes the
+source iterator so upstream resources (e.g. DataLoader worker
+processes) wind down.
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["FeedPrefetcher", "stage_feed"]
+
+
+def stage_feed(feed: Dict[str, Any],
+               sharding: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Device-put every host array of a feed dict (per-name sharding when
+    given), counting the transferred bytes. Arrays already on device pass
+    through untouched."""
+    from ..parallel.sharding import device_put_counted
+
+    staged = {}
+    for name, val in feed.items():
+        if isinstance(val, jax.Array):
+            staged[name] = val
+            continue
+        staged[name] = device_put_counted(
+            np.asarray(val), sharding.get(name) if sharding else None)
+    return staged
+
+
+class FeedPrefetcher:
+    """Iterator adapter: pulls from ``source`` on a daemon thread,
+    applies ``stage`` (default :func:`stage_feed`) to each item, and
+    buffers up to ``depth`` staged items.
+
+    ``depth`` bounds device memory held by in-flight batches; 1 already
+    buys full overlap of one step's h2d with compute, larger depths ride
+    out jittery sources. Iteration raises the worker's exception at the
+    point of failure and ends cleanly at source exhaustion."""
+
+    _END = object()
+
+    def __init__(self, source: Iterable, depth: int = 2,
+                 stage: Optional[Callable] = None,
+                 sharding: Optional[Dict[str, Any]] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = iter(source)
+        self._stage = stage if stage is not None else (
+            lambda item: stage_feed(item, sharding))
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="feed-prefetch")
+        self._thread.start()
+
+    # -- worker -----------------------------------------------------------
+    def _put(self, item) -> bool:
+        """Bounded put that notices consumer abandonment (close() while
+        the queue is full must not wedge the thread)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            for item in self._source:
+                if not self._put(self._stage(item)):
+                    return
+        except BaseException as e:  # re-raised in the consumer
+            self._err = e
+        finally:
+            self._put(self._END)
+            # hand upstream resources back promptly (generator finally
+            # blocks, DataLoader worker shutdown) instead of waiting for GC
+            close = getattr(self._source, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    # -- consumer ---------------------------------------------------------
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._END:
+            self._stop.set()
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        return item
+
+    def stop(self):
+        """Signal the worker and drop buffered batches WITHOUT joining —
+        for teardown paths that must first unblock whatever the worker's
+        source is reading (see Executor.train_from_dataset). Idempotent."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue_mod.Empty:
+                break
+
+    def close(self):
+        """Stop the worker and drop buffered batches. Idempotent."""
+        self.stop()
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

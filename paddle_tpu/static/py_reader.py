@@ -8,10 +8,13 @@ inside the executor. The TPU translation: the reader owns the static
 next batch from every started reader of the program into the feed dict
 (the dense equivalent of read_op), raising ``EOFException`` when the
 source is exhausted — the reference's catch-EOF-then-reset() training
-loop works verbatim. Device prefetch/double buffering is subsumed by
-jit dispatch pipelining (the next batch's host->device copy overlaps
-the current step), so ``double_buffer`` is the identity with its
-contract documented.
+loop works verbatim. With ``use_double_buffer`` (the default) a started
+reader runs a :class:`~paddle_tpu.static.prefetch.FeedPrefetcher`: the
+next batch is pulled from the user generator AND device_put on a
+background thread while the current step executes — the real
+double-buffer semantics of buffered_reader.cc, not just jit dispatch
+pipelining. ``double_buffer`` stays the identity (the reader itself
+already buffers).
 """
 from __future__ import annotations
 
@@ -21,6 +24,7 @@ import numpy as np
 
 from ..framework.errors import EOFException
 from ..utils import unique_name
+from .prefetch import FeedPrefetcher, stage_feed
 
 __all__ = ["py_reader", "create_py_reader_by_data", "read_file",
            "double_buffer", "PyReader"]
@@ -34,6 +38,7 @@ class PyReader:
         self.program = program
         self.feed_vars = list(feed_vars)
         self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
         self._gen_fn = None
         self._it = None
         self._started = False
@@ -73,24 +78,38 @@ class PyReader:
     def start(self):
         if self._gen_fn is None:
             raise RuntimeError("py_reader.start() before decorate_*()")
-        self._it = iter(self._gen_fn())
+        if isinstance(self._it, FeedPrefetcher):
+            self._it.close()   # re-start without reset(): don't orphan
+                               # the old thread + its staged batches
+
+        def feeds():
+            for batch in self._gen_fn():
+                yield self._to_feed(batch)
+
+        if self.use_double_buffer:
+            # depth beyond a couple of batches only holds extra device
+            # memory; capacity still caps tiny-queue configs
+            depth = max(1, min(int(self.capacity) or 1, 2))
+            # a CompiledProgram run stashes its feed sharding on the
+            # program (Executor.run): batches stage straight into the
+            # sharded layout instead of resharding every step. Resolved
+            # per batch — the stash only appears at the first run, after
+            # start() has already been called.
+            self._it = FeedPrefetcher(
+                feeds(), depth=depth,
+                stage=lambda feed: stage_feed(
+                    feed, getattr(self.program, "_feed_sharding", None)))
+        else:
+            self._it = feeds()
         self._started = True
 
     def reset(self):
+        if isinstance(self._it, FeedPrefetcher):
+            self._it.close()
         self._it = None
         self._started = False
 
-    def _next_feed(self):
-        if not self._started:
-            return {}
-        try:
-            batch = next(self._it)
-        except StopIteration:
-            self._started = False
-            raise EOFException(
-                "py_reader source exhausted — catch this and call "
-                "reader.reset() (reference fluid.core.EOFException "
-                "loop)") from None
+    def _to_feed(self, batch):
         if not isinstance(batch, (list, tuple)):
             batch = (batch,)
         if len(batch) != len(self.feed_vars):
@@ -99,6 +118,22 @@ class PyReader:
                 f"{len(self.feed_vars)} slots")
         return {v.name: np.asarray(b) for v, b in
                 zip(self.feed_vars, batch)}
+
+    def _next_feed(self):
+        if not self._started:
+            return {}
+        try:
+            # prefetched batches arrive device-resident (staged on the
+            # reader thread); generator-path batches stay host arrays and
+            # transfer inside the jit call — either way Executor.run
+            # feeds them through unchanged
+            return next(self._it)
+        except StopIteration:
+            self.reset()
+            raise EOFException(
+                "py_reader source exhausted — catch this and call "
+                "reader.reset() (reference fluid.core.EOFException "
+                "loop)") from None
 
 
 def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
@@ -135,9 +170,9 @@ def read_file(reader):
 
 
 def double_buffer(reader, place=None, name=None):
-    """Identity by design: host->device copy of the next feed overlaps
-    the current jitted step (XLA async dispatch), which is what
-    buffered_reader.cc's second buffer bought."""
+    """Identity by design: a started PyReader already stages the next
+    batch host->device on its prefetch thread (see module note), which
+    is what buffered_reader.cc's second buffer bought."""
     return reader
 
 

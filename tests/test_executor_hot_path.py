@@ -1,0 +1,335 @@
+"""Zero-copy executor hot path: buffer donation, device-resident state,
+compile-cache counters, and the async feed prefetcher.
+
+These are the tier-1 guards for the transfer-minimal step loop: state
+must stay on device across steps (no per-step h2d of persistables),
+each (program, feed-signature) must compile exactly once, donation must
+never invalidate an array the caller can still see, and the prefetcher
+must propagate EOF/exceptions cleanly.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu import profiler
+from paddle_tpu.static.prefetch import FeedPrefetcher, stage_feed
+
+
+def _mlp_program(lr=0.1):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 8])
+        label = static.data("label", [-1, 1], dtype="int64")
+        h = static.nn.fc(x, 16, act="relu")
+        logits = static.nn.fc(h, 4)
+        loss = static.mean(
+            static.softmax_with_cross_entropy(logits, label))
+        static.SGD(lr).minimize(loss)
+    return main, startup, loss
+
+
+def _batch(rng, n=8):
+    x = rng.randn(n, 8).astype("float32")
+    label = (x.sum(axis=1) > 0).astype("int64").reshape(n, 1) * 3
+    return {"x": x, "label": label}
+
+
+@pytest.fixture
+def fresh_scope():
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        yield scope
+
+
+# ---------------------------------------------------------------------------
+# compile-once gate (the tier-1 cache-regression tripwire)
+# ---------------------------------------------------------------------------
+def test_compile_once_across_identical_steps(fresh_scope):
+    """3 identical steps = exactly 1 compile + 2 cache hits. A cache
+    regression (key churn, version bump per run) fails here fast."""
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    feed = _batch(rng)
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    assert exe.counters["compile_cache_misses"] == 1
+    assert exe.counters["compile_cache_hits"] == 2
+
+
+def test_cache_counters_across_feed_shape_change(fresh_scope):
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    exe.run(main, feed=_batch(rng, n=8), fetch_list=[loss])
+    exe.run(main, feed=_batch(rng, n=8), fetch_list=[loss])
+    assert exe.counters["compile_cache_misses"] == 1
+    # a new batch size is a new feed signature: one more compile, and
+    # returning to the old shape hits the cache again
+    exe.run(main, feed=_batch(rng, n=16), fetch_list=[loss])
+    assert exe.counters["compile_cache_misses"] == 2
+    exe.run(main, feed=_batch(rng, n=8), fetch_list=[loss])
+    assert exe.counters["compile_cache_hits"] == 2
+
+
+# ---------------------------------------------------------------------------
+# device-resident state: zero per-step h2d of persistables
+# ---------------------------------------------------------------------------
+def test_zero_per_step_state_h2d(fresh_scope):
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    after_first = exe.counters.get("state_h2d_bytes", 0)
+    for _ in range(4):
+        exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    # initializers wrote device arrays, steps keep them resident: no
+    # persistable bytes ever cross host->device after the first step
+    assert exe.counters.get("state_h2d_bytes", 0) == after_first
+    assert exe.counters["executor_steps"] == 5
+
+
+def test_host_state_uploaded_once(fresh_scope):
+    """A numpy persistable (the static.load path) is uploaded exactly
+    once, then stays device-resident."""
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    # demote one param to a host array, as load_persistables would
+    name = main.all_parameters()[0].name
+    host = np.asarray(fresh_scope.find_var(name))
+    fresh_scope.set(name, host)
+    exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    assert exe.counters.get("state_h2d_bytes", 0) == host.nbytes
+    exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    assert exe.counters.get("state_h2d_bytes", 0) == host.nbytes
+
+
+# ---------------------------------------------------------------------------
+# donation semantics
+# ---------------------------------------------------------------------------
+def test_donation_keeps_stale_caller_reference_readable(fresh_scope):
+    """A caller that grabbed a state array via find_var and re-reads it
+    after more steps must see valid (pre-donation) data."""
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program(lr=0.5)
+    exe = static.Executor()
+    exe.run(startup)
+    name = main.all_parameters()[0].name
+    exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    stale = fresh_scope.find_var(name)   # caller now aliases state
+    stale_copy = np.asarray(stale)
+    for _ in range(3):
+        exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    # the alias was copy-protected from donation: still readable, still
+    # the old values — while the scope's array moved on
+    np.testing.assert_array_equal(np.asarray(stale), stale_copy)
+    assert not np.array_equal(
+        np.asarray(fresh_scope._peek(name)), stale_copy)
+    assert exe.counters.get("donation_fallback_copies", 0) >= 1
+    assert exe.counters.get("donated_bytes", 0) > 0
+
+
+def test_donation_handles_aliased_state_names(fresh_scope):
+    """The same array under two persistable names must not be donated
+    twice (XLA rejects duplicate donation)."""
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program()
+    exe = static.Executor()
+    exe.run(startup)
+    params = main.all_parameters()
+    # alias: point one param's scope entry at another's array
+    a, b = params[1].name, params[3].name
+    arr = fresh_scope._peek(a)
+    if np.asarray(arr).shape == np.asarray(fresh_scope._peek(b)).shape:
+        fresh_scope._write_back(b, arr)
+    else:  # shapes differ for fc biases of different widths: self-alias
+        b = a
+    feed = _batch(rng)
+    out1, = exe.run(main, feed=feed, fetch_list=[loss])
+    out2, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(out1) and np.isfinite(out2)
+
+
+def test_fetched_persistable_survives_next_step(fresh_scope):
+    """fetch_list with return_numpy=False may hand back an array that
+    shares a buffer with written-back state; the next donating step must
+    not invalidate it."""
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program(lr=0.5)
+    exe = static.Executor()
+    exe.run(startup)
+    name = main.all_parameters()[0].name
+    feed = _batch(rng)
+    fetched = exe.run(main, feed=feed, fetch_list=[name],
+                      return_numpy=False)[0]
+    snap = np.asarray(fetched)
+    exe.run(main, feed=feed, fetch_list=[loss])
+    np.testing.assert_array_equal(np.asarray(fetched), snap)
+
+
+def test_donate_state_false_opts_out(fresh_scope):
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program()
+    exe = static.Executor(donate_state=False)
+    exe.run(startup)
+    name = main.all_parameters()[0].name
+    exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    held = fresh_scope.find_var(name)
+    exe.run(main, feed=_batch(rng), fetch_list=[loss])
+    np.asarray(held)   # never donated, always readable
+    assert exe.counters.get("donated_bytes", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# device-resident scope round-trips through save/load
+# ---------------------------------------------------------------------------
+def test_scope_save_load_roundtrip(fresh_scope, tmp_path):
+    rng = np.random.RandomState(0)
+    main, startup, loss = _mlp_program(lr=0.5)
+    exe = static.Executor()
+    exe.run(startup)
+    feed = _batch(rng)
+    for _ in range(2):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    names = [p.name for p in main.all_parameters()]
+    trained = {n: np.asarray(fresh_scope.find_var(n)) for n in names}
+    static.save_persistables(exe, str(tmp_path), main_program=main)
+
+    scope2 = static.Scope()
+    with static.scope_guard(scope2):
+        exe2 = static.Executor()
+        exe2.run(startup)   # different init values
+        static.load_persistables(exe2, str(tmp_path), main_program=main)
+        for n in names:
+            np.testing.assert_allclose(
+                np.asarray(scope2.find_var(n)), trained[n], rtol=1e-6)
+        # loaded (host-uploaded) state trains on, donation and all
+        out, = exe2.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(out)
+
+
+# ---------------------------------------------------------------------------
+# prefetcher protocol
+# ---------------------------------------------------------------------------
+def test_prefetcher_yields_all_then_eof():
+    feeds = [{"x": np.full((2, 2), i, np.float32)} for i in range(7)]
+    pf = FeedPrefetcher(iter(feeds), depth=2)
+    got = [float(f["x"][0, 0]) for f in pf]
+    assert got == [float(i) for i in range(7)]
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()   # idempotent after EOF
+
+
+def test_prefetcher_propagates_worker_exception():
+    def source():
+        yield {"x": np.zeros((2,), np.float32)}
+        yield {"x": np.ones((2,), np.float32)}
+        raise ValueError("bad batch 2")
+
+    pf = FeedPrefetcher(source(), depth=2)
+    assert float(next(pf)["x"][0]) == 0.0
+    assert float(next(pf)["x"][0]) == 1.0
+    with pytest.raises(ValueError, match="bad batch 2"):
+        next(pf)
+
+
+def test_prefetcher_close_unblocks_and_closes_source():
+    closed = threading.Event()
+
+    def source():
+        try:
+            for i in range(1000):
+                yield {"x": np.full((4,), i, np.float32)}
+        finally:
+            closed.set()
+
+    pf = FeedPrefetcher(source(), depth=1)
+    next(pf)
+    pf.close()
+    assert closed.wait(timeout=5.0), "source generator was not closed"
+
+
+def test_prefetcher_stages_to_device():
+    import jax
+
+    feeds = [{"x": np.ones((2, 2), np.float32)}]
+    before = profiler.counters_snapshot()
+    pf = FeedPrefetcher(iter(feeds), depth=1)
+    out = next(pf)
+    assert isinstance(out["x"], jax.Array)
+    assert profiler.counters_delta(before).get("h2d_bytes", 0) >= 16
+
+
+def test_stage_feed_passthrough_for_device_arrays():
+    import jax.numpy as jnp
+
+    dev = jnp.ones((3,))
+    before = profiler.counters_snapshot()
+    staged = stage_feed({"a": dev, "b": np.zeros((2,), np.float32)})
+    assert staged["a"] is dev
+    assert profiler.counters_delta(before).get("h2d_bytes", 0) == 8
+
+
+# ---------------------------------------------------------------------------
+# py_reader prefetch path keeps the reference EOF loop working
+# ---------------------------------------------------------------------------
+def test_py_reader_prefetch_eof_and_restart(fresh_scope):
+    from paddle_tpu.framework.errors import EOFException
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        reader = static.layers.py_reader(
+            capacity=8, shapes=[(-1, 4)], dtypes=["float32"])
+        x = static.layers.read_file(reader)
+        loss = static.mean(x * x)
+
+    def gen():
+        for i in range(3):
+            yield (np.full((2, 4), i, np.float32),)
+
+    reader.decorate_batch_generator(gen)
+    exe = static.Executor()
+    exe.run(startup)
+    for _epoch in range(2):   # reset() must allow a clean restart
+        reader.start()
+        seen = 0
+        while True:
+            try:
+                exe.run(main, fetch_list=[loss])
+                seen += 1
+            except EOFException:
+                reader.reset()
+                break
+        assert seen == 3
+
+
+def test_py_reader_worker_exception_propagates(fresh_scope):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        reader = static.layers.py_reader(
+            capacity=4, shapes=[(-1, 4)], dtypes=["float32"])
+        x = static.layers.read_file(reader)
+        loss = static.mean(x)
+
+    def gen():
+        yield (np.zeros((2, 4), np.float32),)
+        raise RuntimeError("reader source died")
+
+    reader.decorate_batch_generator(gen)
+    exe = static.Executor()
+    exe.run(startup)
+    reader.start()
+    exe.run(main, fetch_list=[loss])
+    with pytest.raises(RuntimeError, match="reader source died"):
+        for _ in range(3):
+            exe.run(main, fetch_list=[loss])
+    reader.reset()
