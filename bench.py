@@ -420,6 +420,11 @@ def run_config(name: str, smoke: bool, backend: str,
             "cache_misses": ec.get("compile_cache_misses", 0),
             "h2d_bytes": ec.get("h2d_bytes", 0),
             "donated": ec.get("donated_bytes", 0),
+            # fault-tolerance movement during the run: retries says the
+            # config survived transient failures, ckpt_commits that its
+            # snapshot path actually committed (both 0 on a clean box)
+            "retries": ec.get("retry_attempts", 0),
+            "ckpt_commits": ec.get("ckpt_commits", 0),
             "exec_counters": ec,
         })
         if res.get("dt") and res.get("steps") and \

@@ -2,13 +2,21 @@
 distributed/fleet/utils/http_server.py: KVHandler :46, KVHTTPServer
 :134, KVServer :157): a tiny GET/PUT/DELETE key-value HTTP service the
 reference uses for cross-node barrier/metadata exchange during fleet
-bring-up. Paths are "scope/key"; values are raw bytes."""
+bring-up. Paths are "scope/key"; values are raw bytes.
+
+``KVClient`` is the matching consumer: every round-trip retries
+transient socket failures through paddle_tpu.fault (the reference's
+bring-up loops assume a perfect network and hang on a flaky one), and
+``wait``/``barrier`` give the blocking rendezvous a hard timeout so a
+dead peer surfaces as TimeoutError instead of an infinite poll."""
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
-__all__ = ["KVHandler", "KVHTTPServer", "KVServer"]
+__all__ = ["KVHandler", "KVHTTPServer", "KVServer", "KVClient"]
 
 
 class KVHandler(BaseHTTPRequestHandler):
@@ -95,3 +103,113 @@ class KVServer:
             if self.http_server.get_deleted_size(key) < expected:
                 return False
         return True
+
+
+class KVClient:
+    """HTTP client for KVServer with transient-failure retry and
+    barrier timeouts.
+
+    ``endpoint`` is "host:port". Each request passes the
+    "http_kv.request" fault point and retries connection-level OSErrors
+    with exponential backoff; HTTP-level responses (404 = absent key)
+    are semantic, not retried.
+    """
+
+    def __init__(self, endpoint: str, timeout: float = 5.0,
+                 retrier=None, sleep=time.sleep):
+        from ..fault.retry import Retrier, env_backoff, env_max_attempts
+
+        endpoint = endpoint.replace("http://", "")
+        host, _, port = endpoint.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.timeout = float(timeout)
+        import http.client
+
+        # BadStatusLine and friends (HTTPException) mean the server
+        # died mid-response — as transient as a refused connection
+        self._transient = (OSError, http.client.HTTPException)
+        self._retry = retrier or Retrier(
+            max_attempts=env_max_attempts(4), retry_on=self._transient,
+            backoff=env_backoff(0.05, 1.0), sleep=sleep,
+            name="http_kv")
+        self._sleep = sleep
+
+    def _request_once(self, method: str, key: str,
+                      body: Optional[bytes] = None):
+        import http.client
+
+        from ..fault import injector as _fault
+
+        _fault.point("http_kv.request")
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, "/" + key.strip("/"), body=body)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _request(self, method: str, key: str, body: Optional[bytes] = None):
+        return self._retry.call(self._request_once, method, key, body)
+
+    def get(self, key: str) -> Optional[bytes]:
+        """Stored bytes, or None while the key is absent."""
+        status, data = self._request("GET", key)
+        if status == 404:
+            return None
+        if status != 200:
+            raise RuntimeError(f"KV GET {key!r} failed: HTTP {status}")
+        return data
+
+    def put(self, key: str, value) -> None:
+        body = value.encode() if isinstance(value, str) else bytes(value)
+        status, _ = self._request("PUT", key, body=body)
+        if status != 200:
+            raise RuntimeError(f"KV PUT {key!r} failed: HTTP {status}")
+
+    def delete(self, key: str) -> None:
+        # single attempt, never retried: the server counts every DELETE
+        # toward the scope's rendezvous barrier, so a retry after a
+        # lost response would double-count and release the barrier with
+        # a trainer still missing
+        status, _ = self._request_once("DELETE", key)
+        if status != 200:
+            raise RuntimeError(f"KV DELETE {key!r} failed: HTTP {status}")
+
+    def wait(self, key: str, timeout: float = 60.0,
+             poll: float = 0.1) -> bytes:
+        """Block until ``key`` exists; TimeoutError past ``timeout`` —
+        the barrier form of the reference's unbounded wait loops.
+
+        Each poll is a SINGLE request attempt (the poll loop *is* the
+        retry — an inner 4-attempt Retrier per poll would let a dead
+        server overshoot the deadline by minutes); a connection error
+        counts as "not there yet"."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                status, data = self._request_once("GET", key)
+                if status == 200:
+                    return data
+            except self._transient:
+                pass  # server not up yet / transient: poll again
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"KV barrier timed out after {timeout}s waiting "
+                    f"for {key!r} at {self.host}:{self.port}")
+            self._sleep(min(poll, max(0.0, deadline - time.monotonic())))
+
+    def barrier(self, scope: str, rank: int, world_size: int,
+                timeout: float = 60.0, poll: float = 0.1) -> None:
+        """All-ranks rendezvous on ``scope``: announce this rank, then
+        wait (bounded) for every other rank's announcement."""
+        self.put(f"{scope}/{rank}", b"1")
+        deadline = time.monotonic() + timeout
+        for r in range(int(world_size)):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"KV barrier {scope!r} timed out after {timeout}s "
+                    f"(rank {r} never arrived)")
+            self.wait(f"{scope}/{r}", timeout=remaining, poll=poll)

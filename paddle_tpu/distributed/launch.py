@@ -7,7 +7,16 @@ within a host are all driven by one process — unlike the reference's
 process-per-GPU), wires PADDLE_* env vars, supervises children, and kills
 the job when any worker dies.
 
+Beyond the reference's abort-on-any-failure policy, ``supervise(...)`` /
+``Supervisor`` adds a relaunch loop: a dead trainer is re-exec'd (after
+exponential backoff with jitter) while a restart budget lasts, composing
+with auto-checkpoint resume so a preempted trainer rejoins at its last
+committed epoch. External death signals (a lapsed heartbeat via
+``ps.heartbeat.HeartBeatMonitor.attach_supervisor``) feed the same loop
+through ``Supervisor.notify_dead``.
+
 CLI: python -m paddle_tpu.distributed.launch --nproc_per_node=1 train.py
+     (add --max_restarts=N to supervise with relaunch instead of abort)
 """
 from __future__ import annotations
 
@@ -16,6 +25,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -31,14 +41,17 @@ def _worker_env(rank, nranks, endpoints):
     return env
 
 
-def start_local_trainers(nranks, script_args, base_port=6170):
+def _start_one_trainer(rank, nranks, script_args, base_port=6170):
+    """Spawn one rank's worker process (shared by the plain launcher and
+    the Supervisor so env wiring can never diverge between them)."""
     endpoints = [f"127.0.0.1:{base_port + i}" for i in range(nranks)]
-    procs = []
-    for rank in range(nranks):
-        cmd = [sys.executable] + script_args
-        procs.append(subprocess.Popen(
-            cmd, env=_worker_env(rank, nranks, endpoints)))
-    return procs
+    cmd = [sys.executable] + list(script_args)
+    return subprocess.Popen(cmd, env=_worker_env(rank, nranks, endpoints))
+
+
+def start_local_trainers(nranks, script_args, base_port=6170):
+    return [_start_one_trainer(rank, nranks, script_args, base_port)
+            for rank in range(nranks)]
 
 
 def watch_local_trainers(procs, poll_interval=1.0):
@@ -65,6 +78,186 @@ def watch_local_trainers(procs, poll_interval=1.0):
             if q.poll() is None:
                 q.send_signal(signal.SIGTERM)
         raise
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """supervise() spent its restart budget; the job stays down."""
+
+
+class Supervisor:
+    """Relaunch-on-death supervision with restart budget + backoff.
+
+    Each rank runs as one child process (``start_fn(rank)`` must return
+    a Popen-shaped object: ``poll()``, ``send_signal()``, ``pid``). A
+    rank exiting 0 is complete; any other death consumes one unit of the
+    shared restart budget and is re-exec'd after a backoff delay. When
+    the budget is spent, everything still alive is terminated and
+    RestartBudgetExceeded raised. ``start_fn``/``sleep`` injection keeps
+    the whole loop exercisable in-process — no real kills needed
+    (tests/test_fault_layer.py drives it with scripted fakes).
+
+    ``notify_dead(rank)`` (thread-safe) marks a live-but-hung rank dead —
+    the HeartBeatMonitor integration point: a trainer whose heartbeat
+    lapsed is SIGTERM'd and relaunched under the same budget.
+    """
+
+    def __init__(self, nranks, script_args=None, base_port=6170,
+                 max_restarts=3, backoff=None, poll_interval=1.0,
+                 start_fn=None, sleep=time.sleep):
+        from ..fault.retry import Backoff
+
+        self.nranks = int(nranks)
+        self.max_restarts = int(max_restarts)
+        self.poll_interval = float(poll_interval)
+        self._backoff = backoff or Backoff(base=1.0, cap=30.0)
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._external_dead = set()
+        self._relaunch_listeners = []
+        self.restarts = 0
+        if start_fn is not None:
+            self._start_fn = start_fn
+        else:
+            if script_args is None:
+                raise ValueError("need script_args or start_fn")
+            self._start_fn = lambda rank: _start_one_trainer(
+                rank, self.nranks, script_args, base_port)
+
+    # -- external liveness policy (heartbeat monitor) -----------------------
+    def notify_dead(self, rank: int) -> None:
+        with self._lock:
+            self._external_dead.add(int(rank))
+
+    def on_relaunch(self, fn) -> None:
+        """Register ``fn(rank)`` to run on every rank (re)start — the
+        heartbeat monitor uses it to refresh the rank's beat so a fresh
+        incarnation gets a full timeout of grace before being flagged
+        again."""
+        self._relaunch_listeners.append(fn)
+
+    def _start_rank(self, rank):
+        proc = self._start_fn(rank)
+        for fn in self._relaunch_listeners:
+            fn(rank)
+        # a notify_dead queued while this rank sat in relaunch backoff
+        # refers to the PREVIOUS incarnation: drop it, or the fresh
+        # process would be SIGTERM'd on the next loop iteration and the
+        # budget drained on a healthy job (the listeners above already
+        # refreshed the heartbeat, stopping future re-fires)
+        with self._lock:
+            self._external_dead.discard(rank)
+        return proc
+
+    def _take_external_dead(self):
+        with self._lock:
+            dead, self._external_dead = self._external_dead, set()
+            return dead
+
+    @staticmethod
+    def _await_death(p, timeout=10):
+        waiter = getattr(p, "wait", None)
+        if waiter is not None:
+            try:
+                waiter(timeout=timeout)
+            except Exception:
+                pass
+        return p.poll()
+
+    # -- the loop -----------------------------------------------------------
+    def _schedule_relaunch(self, rank, pending):
+        """Consume one budget unit and set the rank's relaunch deadline.
+        The backoff is a per-rank deadline, not an inline sleep — one
+        rank backing off 30s must not stall death-detection (or the
+        heartbeat SIGTERM path) for every other rank."""
+        from .. import profiler
+        from ..fault import injector as _fault
+
+        if self.restarts >= self.max_restarts:
+            # run()'s BaseException handler tears down the survivors
+            raise RestartBudgetExceeded(
+                f"trainer rank={rank} died and the restart budget "
+                f"({self.max_restarts}) is spent; job stays down")
+        delay = self._backoff.delay(self.restarts)
+        self.restarts += 1
+        profiler.bump_counter("trainer_relaunches")
+        _fault.point("launch.relaunch")
+        pending[rank] = time.monotonic() + delay
+
+    def run(self) -> int:
+        procs = {}
+        done = set()
+        pending = {}   # rank -> monotonic deadline of its relaunch
+        try:
+            for rank in range(self.nranks):
+                procs[rank] = self._start_rank(rank)
+            while len(done) < self.nranks:
+                now = time.monotonic()
+                for rank in [r for r, t in pending.items() if now >= t]:
+                    del pending[rank]
+                    procs[rank] = self._start_rank(rank)
+                ext = self._take_external_dead()
+                for rank in sorted(procs):
+                    if rank in done or rank in pending:
+                        continue
+                    p = procs[rank]
+                    ret = p.poll()
+                    if ret is None and rank in ext:
+                        # hung per the heartbeat: make it really dead,
+                        # then treat like any other death. Exit 0 here
+                        # is ambiguous (finished during the lapse vs. a
+                        # graceful sys.exit(0) SIGTERM handler killed
+                        # mid-training) — relaunch: with auto-checkpoint
+                        # resume a truly-finished trainer replays zero
+                        # epochs and re-exits 0, while counting a killed
+                        # one as done would silently lose its work
+                        p.send_signal(signal.SIGTERM)
+                        ret = self._await_death(p)
+                        if ret is None:
+                            # ignored SIGTERM: escalate — a relaunch
+                            # while the old incarnation lives would run
+                            # two processes with the same rank
+                            p.send_signal(
+                                getattr(signal, "SIGKILL", signal.SIGTERM))
+                            ret = self._await_death(p)
+                        if ret is None:
+                            # unkillable (D-state I/O): do NOT start a
+                            # duplicate; retry the kill next iteration
+                            self.notify_dead(rank)
+                            continue
+                        if ret == 0:
+                            ret = -signal.SIGTERM
+                    if ret is None:
+                        continue
+                    if ret == 0:
+                        done.add(rank)
+                        continue
+                    self._schedule_relaunch(rank, pending)
+                if len(done) < self.nranks:
+                    self._sleep(self.poll_interval)
+            return 0
+        except BaseException:
+            # no exit path may orphan a live trainer: a failed relaunch
+            # (ENOENT/ENOMEM from start_fn), Ctrl-C, or budget
+            # exhaustion all tear the job down before propagating
+            for q in procs.values():
+                try:
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+                except Exception:
+                    pass
+            raise
+
+
+def supervise(nranks, script_args=None, base_port=6170, max_restarts=3,
+              backoff=None, poll_interval=1.0, start_fn=None,
+              sleep=time.sleep) -> int:
+    """Run ``nranks`` trainers under relaunch supervision (see
+    Supervisor). Returns 0 once every rank has exited cleanly; raises
+    RestartBudgetExceeded when deaths outrun the budget."""
+    return Supervisor(nranks, script_args=script_args, base_port=base_port,
+                      max_restarts=max_restarts, backoff=backoff,
+                      poll_interval=poll_interval, start_fn=start_fn,
+                      sleep=sleep).run()
 
 
 def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
@@ -97,13 +290,19 @@ def main():
     parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     parser.add_argument("--nproc_per_node", type=int, default=1)
     parser.add_argument("--started_port", type=int, default=6170)
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="relaunch dead trainers up to N times "
+                             "(0 = reference abort-on-any-failure)")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args()
+    script = [args.training_script] + args.training_script_args
+    if args.max_restarts > 0:
+        sys.exit(supervise(args.nproc_per_node, script,
+                           base_port=args.started_port,
+                           max_restarts=args.max_restarts))
     procs = start_local_trainers(
-        args.nproc_per_node,
-        [args.training_script] + args.training_script_args,
-        base_port=args.started_port)
+        args.nproc_per_node, script, base_port=args.started_port)
     sys.exit(watch_local_trainers(procs))
 
 

@@ -91,24 +91,10 @@ def load_dygraph(model_path: str):
     """Returns (param_dict, opt_dict); a suffixed path
     ({prefix}.pdparams / .pdopt) is accepted like the reference.
     Raises when neither file exists (a typo'd path must not come back
-    as a silent (None, None))."""
-    import os
+    as a silent (None, None)). One implementation: io.serialization."""
+    from .io.serialization import load_dygraph as _load_dygraph
 
-    from .io.serialization import load
-
-    for suffix in (".pdparams", ".pdopt"):
-        if model_path.endswith(suffix):
-            model_path = model_path[:-len(suffix)]
-    params = opt = None
-    if os.path.exists(model_path + ".pdparams"):
-        params = load(model_path + ".pdparams")
-    if os.path.exists(model_path + ".pdopt"):
-        opt = load(model_path + ".pdopt")
-    if params is None and opt is None:
-        raise ValueError(
-            f"load_dygraph: neither {model_path}.pdparams nor "
-            f"{model_path}.pdopt exists")
-    return params, opt
+    return _load_dygraph(model_path)
 
 
 class BackwardStrategy:

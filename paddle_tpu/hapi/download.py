@@ -26,16 +26,48 @@ def _cache_path(url: str, root_dir: str) -> str:
 def get_path_from_url(url: str, root_dir: str = WEIGHTS_HOME,
                       md5sum=None, check_exist: bool = True) -> str:
     """Resolve ``url`` to its local cache path. Local paths pass
-    through; cached files resolve; anything else raises (no egress)."""
-    if os.path.exists(url):
-        return url
-    path = _cache_path(url, root_dir)
-    if os.path.exists(path):
-        return path
-    raise FileNotFoundError(
-        f"{url!r} is not cached at {path!r} and this build performs no "
-        "network downloads; pre-seed the file into "
-        f"{root_dir!r} (or set PADDLE_TPU_WEIGHTS_HOME)")
+    through; cached files resolve; anything else raises (no egress).
+
+    Resolution retries transient OSErrors (a flaky NFS/gcsfuse cache
+    mount mid-failover) with backoff via paddle_tpu.fault; a genuinely
+    absent file (FileNotFoundError) is terminal and raises immediately.
+    """
+    from ..fault import injector as _fault
+    from ..fault.retry import Retrier, env_backoff
+
+    def _probe(p: str) -> bool:
+        # os.path.exists swallows EIO/ESTALE as False — stat so a flaky
+        # mount surfaces as a retryable OSError, not a bogus cache miss.
+        # But a URL is probed as-is and may not even be a legal path
+        # (NUL bytes, >NAME_MAX components): path-shaped errors are a
+        # plain miss, only real I/O errors deserve the retry
+        import errno
+
+        try:
+            os.stat(p)
+            return True
+        except (FileNotFoundError, NotADirectoryError, ValueError):
+            return False
+        except OSError as e:
+            if e.errno in (errno.ENAMETOOLONG, errno.EINVAL):
+                return False
+            raise
+
+    def resolve() -> str:
+        _fault.point("download.resolve")
+        if _probe(url):
+            return url
+        path = _cache_path(url, root_dir)
+        if _probe(path):
+            return path
+        raise FileNotFoundError(
+            f"{url!r} is not cached at {path!r} and this build performs "
+            "no network downloads; pre-seed the file into "
+            f"{root_dir!r} (or set PADDLE_TPU_WEIGHTS_HOME)")
+
+    return Retrier(retry_on=(OSError,), giveup_on=(FileNotFoundError,),
+                   backoff=env_backoff(0.1, 2.0),
+                   name="hapi.download").call(resolve)
 
 
 def get_weights_path_from_url(url: str, md5sum=None) -> str:

@@ -75,10 +75,27 @@ def get_weights_path_from_url(url, md5sum=None):
     if os.path.exists(path) and (md5sum is None or _md5(path) == md5sum):
         return path
     # download to a temp name and rename so an interrupted transfer can
-    # never be mistaken for a cached file
+    # never be mistaken for a cached file; transient fetch failures
+    # (URLError and friends are OSErrors) retry with backoff through
+    # paddle_tpu.fault before the terminal RuntimeError
+    from ..fault import injector as _fault
+    from ..fault.retry import Retrier, env_backoff
+
     tmp = path + ".part"
-    try:
+
+    def _fetch():
+        _fault.point("download.fetch")
         urllib.request.urlretrieve(url, tmp)
+
+    import urllib.error
+
+    try:
+        # HTTPError subclasses OSError but a 404/403 is permanent — only
+        # connection-level flakes deserve the backoff
+        Retrier(retry_on=(OSError,),
+                giveup_on=(urllib.error.HTTPError,),
+                backoff=env_backoff(0.2, 5.0),
+                name="incubate.download").call(_fetch)
     except OSError as e:
         if os.path.exists(tmp):
             os.remove(tmp)

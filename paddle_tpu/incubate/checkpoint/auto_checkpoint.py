@@ -4,10 +4,16 @@ Parity with the reference auto-checkpoint subsystem
 (/root/reference/python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:
 TrainEpochRange :265, train_epoch_range :598 — periodic snapshot keyed by
 job id, resume skips completed epochs; checkpoint_saver.py). TPU-native
-simplifications: snapshots are state-dict pickles through io.serialization
-(orbax for sharded arrays is available via io.orbax_ckpt) on a local or
-mounted path; the job id comes from PADDLE_JOB_ID like the reference's
+simplifications: snapshots are state-dict pickles on a local or mounted
+path; the job id comes from PADDLE_JOB_ID like the reference's
 PaddleCloud wiring.
+
+Crash safety: snapshots go through io.snapshot.SnapshotStore — versioned
+``epoch_<k>/`` dirs where state and meta commit together under a single
+atomic sha256-manifest rename (the seed's separate state/meta
+``os.replace`` pair could diverge under a mid-save kill), with
+keep-last-N rotation (``PADDLE_CKPT_KEEP``, default 3) and load-time
+verification that falls back to the newest *valid* snapshot.
 
 Usage (mirrors the reference):
 
@@ -24,12 +30,25 @@ import pickle
 import time
 from typing import Optional
 
+from ...io.snapshot import SnapshotStore
+
 _CKPT_ROOT_ENV = "PADDLE_AUTO_CHECKPOINT_PATH"
 _JOB_ID_ENV = "PADDLE_JOB_ID"
+_KEEP_ENV = "PADDLE_CKPT_KEEP"
+
+_STATE_FILE = "state.pdparams"
+_META_FILE = "meta.pkl"
 
 
 def _default_root():
     return os.environ.get(_CKPT_ROOT_ENV, "./auto_checkpoint")
+
+
+def _default_keep():
+    try:
+        return int(os.environ.get(_KEEP_ENV, 3))
+    except ValueError:
+        return 3
 
 
 class TrainEpochRange:
@@ -38,11 +57,15 @@ class TrainEpochRange:
     def __init__(self, max_epoch_num: int, name: Optional[str] = None,
                  checkpoint_path: Optional[str] = None,
                  save_checkpoint_inter: Optional[int] = None,
-                 checkpoint_inter: Optional[int] = None):
+                 checkpoint_inter: Optional[int] = None,
+                 keep_last: Optional[int] = None):
         self._max = int(max_epoch_num)
         self.name = name or os.environ.get(_JOB_ID_ENV, "default_job")
         self._root = checkpoint_path or _default_root()
         self._dir = os.path.join(self._root, self.name)
+        self._store = SnapshotStore(
+            self._dir,
+            keep_last=keep_last if keep_last is not None else _default_keep())
         # seconds between saves; <=0 saves every epoch (tests use 0)
         self._inter = (save_checkpoint_inter
                        if save_checkpoint_inter is not None
@@ -53,6 +76,8 @@ class TrainEpochRange:
         self._model = None
         self._optimizer = None
         self._restored_epoch = -1
+        self._restored_state = None
+        self._restored_verified = False
         self._load_meta()
 
     # -- registration --------------------------------------------------------
@@ -63,25 +88,66 @@ class TrainEpochRange:
         return self
 
     # -- persistence ---------------------------------------------------------
-    def _meta_path(self):
-        return os.path.join(self._dir, "meta.pkl")
-
-    def _state_path(self):
-        return os.path.join(self._dir, "state.pdparams")
-
     def _load_meta(self):
+        """Pick the newest snapshot that verifies end-to-end; state and
+        meta come from the same commit, so they can never disagree about
+        which epoch completed. Verification streams (as_paths) — the
+        multi-GB state is never materialized just to check its sha."""
+        loaded = self._store.load_latest(as_paths=True)
+        if loaded is not None:
+            _tag, files = loaded
+            try:
+                with open(files[_META_FILE], "rb") as f:
+                    meta = pickle.load(f)
+                self._restored_epoch = int(meta.get("epoch", -1))
+                self._restored_state = files.get(_STATE_FILE)
+                self._restored_verified = True
+                return
+            except (KeyError, OSError, EOFError, pickle.UnpicklingError,
+                    ValueError):
+                pass
+        self._load_legacy_meta()
+
+    def _load_legacy_meta(self):
+        """Pre-manifest flat layout (meta.pkl + state.pdparams directly in
+        the job dir): still resumable so an upgrade doesn't orphan an
+        in-flight job's checkpoints."""
         try:
-            with open(self._meta_path(), "rb") as f:
+            with open(os.path.join(self._dir, _META_FILE), "rb") as f:
                 meta = pickle.load(f)
             self._restored_epoch = int(meta.get("epoch", -1))
         except (FileNotFoundError, EOFError, pickle.UnpicklingError):
             self._restored_epoch = -1
+            return
+        legacy_state = os.path.join(self._dir, _STATE_FILE)
+        self._restored_state = (legacy_state
+                                if os.path.exists(legacy_state) else None)
+        self._restored_verified = False   # flat layout has no manifest
 
     def _maybe_restore_state(self):
-        if self._restored_epoch < 0 or not os.path.exists(self._state_path()):
+        # _restored_state is a verified file path (never the blob), so
+        # nothing checkpoint-sized stays pinned, and a second register()
+        # — e.g. model first, optimizer later — re-reads and restores
+        # again like the seed did
+        if self._restored_epoch < 0 or self._restored_state is None:
             return
-        with open(self._state_path(), "rb") as f:
-            state = pickle.load(f)
+        try:
+            with open(self._restored_state, "rb") as f:
+                state = pickle.load(f)
+        except FileNotFoundError:
+            # rotated away between a first and a late second register():
+            # the state was already applied then; nothing to re-apply
+            self._restored_state = None
+            return
+        except (OSError, EOFError, pickle.UnpicklingError) as e:
+            detail = ("despite a valid manifest — was it written by an "
+                      "incompatible version?" if self._restored_verified
+                      else "(legacy flat layout: no manifest to verify "
+                      "against; the writer was likely interrupted)")
+            raise ValueError(
+                f"auto-checkpoint state for job {self.name!r} under "
+                f"{self._dir!r} failed to load ({type(e).__name__}) "
+                f"{detail}") from e
         if self._model is not None and state.get("model") is not None:
             self._model.set_state_dict(state["model"])
         if self._optimizer is not None and state.get("opt") is not None:
@@ -92,7 +158,6 @@ class TrainEpochRange:
     def save_checkpoint(self, epoch: int):
         from ...io.serialization import _to_numpy_state
 
-        os.makedirs(self._dir, exist_ok=True)
         state = {
             "model": (_to_numpy_state(self._model.state_dict())
                       if self._model is not None else None),
@@ -100,13 +165,14 @@ class TrainEpochRange:
                     if self._optimizer is not None
                     and hasattr(self._optimizer, "state_dict") else None),
         }
-        tmp = self._state_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f, protocol=4)
-        os.replace(tmp, self._state_path())
-        with open(self._meta_path() + ".tmp", "wb") as f:
-            pickle.dump({"epoch": epoch, "name": self.name}, f)
-        os.replace(self._meta_path() + ".tmp", self._meta_path())
+        meta = {"epoch": int(epoch), "name": self.name}
+        self._store.save(epoch, {
+            # streaming writers: the state pickle goes straight to disk
+            # (sha256'd in flight) instead of doubling peak memory as a
+            # bytes blob next to the live parameters
+            _STATE_FILE: lambda f: pickle.dump(state, f, protocol=4),
+            _META_FILE: lambda f: pickle.dump(meta, f, protocol=4),
+        })
         self._last_save = time.time()
 
     # -- iteration -----------------------------------------------------------

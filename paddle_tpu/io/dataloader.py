@@ -617,13 +617,44 @@ class _MultiprocessIter:
 
     def _check_workers(self):
         for w in self.workers:
-            if not w.is_alive() and w.exitcode not in (0, None):
+            if w.is_alive():
+                continue
+            if w.exitcode not in (0, None):
                 self._shutdown()
                 raise RuntimeError(
                     f"DataLoader worker (pid {w.pid}) exited unexpectedly "
                     f"with exitcode {w.exitcode}. This usually means the "
                     "worker was killed (OOM?) or called os._exit; rerun "
                     "with num_workers=0 to debug in-process.")
+            if not self.is_iterable and not getattr(self, "_closed", False):
+                # map-mode workers only exit at shutdown (stop event /
+                # None sentinel); a clean mid-run exit means sample code
+                # called sys.exit/os._exit(0) and took its in-flight
+                # batch with it — the reorder buffer would wait on that
+                # batch id forever. But a worker that RAISED also exits
+                # 0 after putting its ("error", traceback) message:
+                # surface that real traceback, not this diagnosis, if
+                # it's still in flight (we abort either way, so data
+                # payloads only need their shm segments reclaimed)
+                err = None
+                try:
+                    while err is None:
+                        msg = self.data_q.get(timeout=0.5)
+                        if msg[0] == "error":
+                            err = msg
+                        elif msg[0] == "data":
+                            _shm_unpack(msg[2])
+                except queue.Empty:
+                    pass
+                if err is not None:
+                    self._handle(err)  # shuts down + raises w/ traceback
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker (pid {w.pid}) exited cleanly "
+                    "mid-run (exitcode 0) with batches still pending — "
+                    "dataset/collate code must not call sys.exit or "
+                    "os._exit; rerun with num_workers=0 to debug "
+                    "in-process.")
 
     def _get(self):
         deadline = time.time() + self.timeout if self.timeout else None
@@ -632,6 +663,22 @@ class _MultiprocessIter:
                 return self.data_q.get(timeout=self._POLL_SEC)
             except queue.Empty:
                 self._check_workers()
+                if self.workers and all(
+                        not w.is_alive() for w in self.workers):
+                    # every worker is gone with exitcode 0 (iterable-mode
+                    # sample code os._exit(0) before its "done" marker):
+                    # one final drain for messages already in flight,
+                    # then surface instead of polling a queue nothing
+                    # will ever feed again
+                    try:
+                        return self.data_q.get(timeout=self._POLL_SEC)
+                    except queue.Empty:
+                        self._shutdown()
+                        raise RuntimeError(
+                            "All DataLoader workers exited before "
+                            "delivering the remaining batches (worker "
+                            "code called os._exit?); rerun with "
+                            "num_workers=0 to debug in-process.")
                 if deadline is not None and time.time() > deadline:
                     self._shutdown()
                     raise RuntimeError(

@@ -28,17 +28,71 @@ def _to_numpy_state(obj):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
+def _atomic_write(path, write_fn):
+    """Torn-write-proof file replacement: ``write_fn(f)`` streams into a
+    sibling temp file, which is fsync'd, then one atomic ``os.replace``
+    (fault point "io.replace") and a directory fsync so a kill at any
+    instant leaves either the old file or the complete new one — never a
+    truncated mix."""
+    from ..fault import injector as _fault
+    from .snapshot import _fsync_dir
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_numpy_state(obj), f, protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fault.point("io.replace")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d or ".")
+
+
+def _atomic_write_bytes(path, data):
+    _atomic_write(path, lambda f: f.write(data))
+
+
+def atomic_pickle_dump(obj, path, protocol=4):
+    """Pickle ``obj`` to ``path`` through the atomic-replace protocol.
+    Streams pickle.dump into the temp file — a multi-GB state dict must
+    not also be materialized as one bytes object at save time."""
+    _atomic_write(path, lambda f: pickle.dump(obj, f, protocol=protocol))
+
+
+def _load_pickle(path):
+    """pickle.load with actionable failure modes: a missing or truncated/
+    corrupt checkpoint file raises a ValueError naming the path instead
+    of leaking a bare EOFError/UnpicklingError from deep inside pickle."""
+    if not os.path.exists(path):
+        raise ValueError(
+            f"io.load: no checkpoint file at {path!r} (missing or "
+            "never saved)")
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except (EOFError, pickle.UnpicklingError) as e:
+        raise ValueError(
+            f"io.load: checkpoint file {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e}) — the writer was likely "
+            "interrupted; re-save it or fall back to an older snapshot"
+        ) from e
+
+
+def save(obj, path, protocol=4, **configs):
+    atomic_pickle_dump(_to_numpy_state(obj), path, protocol=protocol)
 
 
 def load(path, **configs):
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    return _load_pickle(path)
 
 
 def save_dygraph(state_dict, model_path):
@@ -49,12 +103,21 @@ def save_dygraph(state_dict, model_path):
 
 
 def load_dygraph(model_path, **configs):
+    # a suffixed path ({prefix}.pdparams / .pdopt) is accepted like the
+    # reference (and like paddle_tpu.dygraph.load_dygraph)
+    for suffix in (".pdparams", ".pdopt"):
+        if model_path.endswith(suffix):
+            model_path = model_path[:-len(suffix)]
     params = None
     opt = None
     if os.path.exists(model_path + ".pdparams"):
         params = load(model_path + ".pdparams")
     if os.path.exists(model_path + ".pdopt"):
         opt = load(model_path + ".pdopt")
+    if params is None and opt is None:
+        raise ValueError(
+            f"load_dygraph: neither {model_path}.pdparams nor "
+            f"{model_path}.pdopt exists")
     return params, opt
 
 

@@ -1,0 +1,309 @@
+"""Crash-safe snapshot directories with a sha256-manifest commit point.
+
+The seed's checkpoint path committed state and meta via two separate
+``os.replace`` calls with no fsync and no integrity check at load — a
+kill between the two renames left state and meta describing different
+epochs, and a torn write loaded as garbage. This module is the one
+snapshot engine both auto-checkpoint and manual tooling use:
+
+layout (one store root, versioned snapshot dirs)::
+
+    <root>/
+      epoch_7/
+        state.pdparams          payload files (opaque bytes)
+        meta.pkl
+        MANIFEST.json           <- exists IFF the snapshot is committed
+      epoch_8/                  newest committed snapshot wins at load
+      epoch_9.tmp/              torn write-in-progress leftover (ignored)
+      epoch_7.old/              same-tag rewrite crashed mid-write: the
+                                moved-aside committed copy, healed (restored
+                                or dropped) on the next save/load
+
+commit protocol (``SnapshotStore.save``):
+
+1. payloads are written into ``<dir>.tmp`` and fsync'd;
+2. the manifest (per-file sha256 + byte count) is written to
+   ``MANIFEST.json.tmp`` inside it and fsync'd;
+3. the dir is renamed to its final name — still uncommitted: readers
+   require ``MANIFEST.json``;
+4. ``MANIFEST.json.tmp`` → ``MANIFEST.json`` via one atomic
+   ``os.replace`` — the ONLY commit point — then the dir is fsync'd.
+
+A crash anywhere before step 4 leaves a torn snapshot that loading
+skips; a crash after it leaves a fully-verified snapshot. ``load_latest``
+walks snapshots newest-first, sha256-verifies every payload against the
+manifest, and falls back to the newest *valid* one, counting what it
+skipped (``ckpt_corrupt_skipped``) and whether it fell back
+(``ckpt_fallbacks``). Commits bump ``ckpt_commits`` and rotation keeps
+the last ``keep_last`` committed snapshots.
+
+Fault points (paddle_tpu.fault): ``ckpt.write``, ``ckpt.fsync``,
+``ckpt.manifest``, ``ckpt.rename`` — arming ``ckpt.rename`` simulates a
+crash at the commit instant with no real kill.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+from ..fault import injector as _fault
+from ..fault.injector import _bump  # shared lazy counter shim
+
+__all__ = ["SnapshotStore", "MANIFEST_NAME"]
+
+MANIFEST_NAME = "MANIFEST.json"
+_TMP_SUFFIX = ".tmp"
+_OLD_SUFFIX = ".old"
+
+
+class _HashingWriter:
+    """File-object shim that sha256's and counts everything written, so
+    streaming writers get manifest integrity without a second pass."""
+
+    def __init__(self, f):
+        self._f = f
+        self._h = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, b):
+        b = bytes(b) if isinstance(b, (bytearray, memoryview)) else b
+        self._h.update(b)
+        self.nbytes += len(b)
+        return self._f.write(b)
+
+    def flush(self):
+        self._f.flush()
+
+    def hexdigest(self) -> str:
+        return self._h.hexdigest()
+
+
+def _fsync_fileobj(f) -> None:
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a rename durable: fsync the containing directory (no-op on
+    platforms without O_DIRECTORY-style dir fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class SnapshotStore:
+    """Versioned ``<prefix><tag>/`` snapshot dirs under one root."""
+
+    def __init__(self, root: str, keep_last: int = 3,
+                 prefix: str = "epoch_"):
+        self.root = root
+        self.keep_last = max(1, int(keep_last))
+        self.prefix = prefix
+
+    # -- naming -------------------------------------------------------------
+    def _dir_for(self, tag: int) -> str:
+        return os.path.join(self.root, f"{self.prefix}{int(tag)}")
+
+    def _tag_of(self, dirname: str) -> Optional[int]:
+        if not dirname.startswith(self.prefix):
+            return None
+        rest = dirname[len(self.prefix):]
+        return int(rest) if rest.isdigit() or (
+            rest.startswith("-") and rest[1:].isdigit()) else None
+
+    # -- enumeration --------------------------------------------------------
+    def snapshots(self) -> List[Tuple[int, str, bool]]:
+        """All snapshot dirs as (tag, path, committed), tag-ascending.
+        ``committed`` means a MANIFEST.json exists (content unverified)."""
+        out = []
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return out
+        for name in entries:
+            if name.endswith(_TMP_SUFFIX):
+                continue
+            tag = self._tag_of(name)
+            path = os.path.join(self.root, name)
+            if tag is None or not os.path.isdir(path):
+                continue
+            committed = os.path.exists(os.path.join(path, MANIFEST_NAME))
+            out.append((tag, path, committed))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    # -- write path ---------------------------------------------------------
+    def save(self, tag: int, files: Dict[str, object]) -> str:
+        """Write one snapshot atomically; returns the committed dir.
+
+        ``files`` values are bytes or streaming writers
+        ``callable(fileobj) -> None`` (e.g. ``lambda f: pickle.dump(obj,
+        f)``) — the sha256 is computed while streaming, so a multi-GB
+        state dict is never materialized as one bytes object."""
+        if not files:
+            raise ValueError("snapshot must contain at least one file")
+        os.makedirs(self.root, exist_ok=True)
+        final = self._dir_for(tag)
+        tmp = final + _TMP_SUFFIX
+        old = final + _OLD_SUFFIX
+        self._recover_aside()
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        if os.path.exists(final):
+            # never delete a committed snapshot before its replacement
+            # commits — move it aside (readers ignore non-<prefix><int>
+            # names) and drop it only after the new commit succeeds
+            if os.path.exists(os.path.join(final, MANIFEST_NAME)):
+                os.rename(final, old)
+            else:
+                shutil.rmtree(final)        # torn leftover: no value
+        os.makedirs(tmp)
+        manifest = {"version": 1, "tag": int(tag), "files": {}}
+        for name, data in files.items():
+            if os.sep in name or name == MANIFEST_NAME:
+                raise ValueError(f"bad snapshot file name {name!r}")
+            _fault.point("ckpt.write")
+            with open(os.path.join(tmp, name), "wb") as f:
+                writer = _HashingWriter(f)
+                if callable(data):
+                    data(writer)
+                else:
+                    writer.write(data)
+                _fault.point("ckpt.fsync")
+                _fsync_fileobj(f)
+            manifest["files"][name] = {"sha256": writer.hexdigest(),
+                                       "bytes": writer.nbytes}
+        _fault.point("ckpt.manifest")
+        with open(os.path.join(tmp, MANIFEST_NAME + _TMP_SUFFIX),
+                  "w", encoding="utf-8") as f:
+            json.dump(manifest, f, sort_keys=True)
+            _fsync_fileobj(f)
+        # the dir becomes visible under its final name but is still torn:
+        # readers require MANIFEST.json, which does not exist yet
+        os.rename(tmp, final)
+        _fsync_dir(self.root)
+        _fault.point("ckpt.rename")
+        # THE commit point: one atomic rename inside the snapshot dir
+        os.replace(os.path.join(final, MANIFEST_NAME + _TMP_SUFFIX),
+                   os.path.join(final, MANIFEST_NAME))
+        _fsync_dir(final)
+        if os.path.exists(old):
+            shutil.rmtree(old, ignore_errors=True)
+        _bump("ckpt_commits")
+        self._rotate()
+        return final
+
+    def _rotate(self) -> None:
+        """Keep the newest ``keep_last`` committed snapshots; drop older
+        committed ones and any torn/.tmp dir older than the newest
+        commit (a crash before the tmp->final rename must not leak a
+        full-size .tmp dir forever)."""
+        snaps = self.snapshots()
+        committed = [s for s in snaps if s[2]]
+        if not committed:
+            return
+        newest_tag = committed[-1][0]
+        keep = {tag for tag, _, _ in committed[-self.keep_last:]}
+        for tag, path, is_committed in snaps:
+            stale_torn = not is_committed and tag < newest_tag
+            evicted = is_committed and tag not in keep
+            if stale_torn or evicted:
+                shutil.rmtree(path, ignore_errors=True)
+        for name in os.listdir(self.root):
+            if not name.endswith(_TMP_SUFFIX):
+                continue
+            tag = self._tag_of(name[:-len(_TMP_SUFFIX)])
+            if tag is not None and tag <= newest_tag:
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    def _recover_aside(self) -> None:
+        """Heal ``<dir>.old`` leftovers of a same-tag rewrite that
+        crashed: the aside copy is the committed snapshot unless the
+        rewrite reached its own commit, so restore or drop accordingly.
+        Runs before every save and load."""
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return
+        for name in entries:
+            if not name.endswith(_OLD_SUFFIX):
+                continue
+            aside = os.path.join(self.root, name)
+            if not os.path.isdir(aside):
+                continue
+            final = aside[:-len(_OLD_SUFFIX)]
+            if self.verify(final, as_paths=True) is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+            else:
+                if os.path.exists(final):
+                    shutil.rmtree(final)
+                os.rename(aside, final)
+
+    # -- read path ----------------------------------------------------------
+    def verify(self, path: str, as_paths: bool = False):
+        """Verify one snapshot dir (sha256 streamed per payload); None on
+        any torn/corrupt condition (missing manifest, bad json, size or
+        sha256 mismatch, unreadable payload). Returns name->bytes, or
+        name->filepath with ``as_paths`` — the streaming option for
+        multi-GB states that must not be materialized just to verify."""
+        try:
+            with open(os.path.join(path, MANIFEST_NAME),
+                      encoding="utf-8") as f:
+                manifest = json.load(f)
+            entries = manifest["files"]
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        out: Dict[str, object] = {}
+        for name, meta in entries.items():
+            fpath = os.path.join(path, name)
+            h = hashlib.sha256()
+            nbytes = 0
+            try:
+                with open(fpath, "rb") as f:
+                    if as_paths:
+                        for chunk in iter(lambda: f.read(1 << 20), b""):
+                            h.update(chunk)
+                            nbytes += len(chunk)
+                    else:
+                        data = f.read()
+                        h.update(data)
+                        nbytes = len(data)
+            except OSError:
+                return None
+            if nbytes != meta.get("bytes") or \
+                    h.hexdigest() != meta.get("sha256"):
+                return None
+            out[name] = fpath if as_paths else data
+        return out
+
+    def load_latest(self, as_paths: bool = False):
+        """Newest snapshot that verifies end-to-end as (tag, files), or
+        None. ``as_paths`` returns verified file paths instead of bytes
+        (callers stream-load them, e.g. pickle.load on the open file).
+
+        Torn/corrupt snapshots newer than the winner are skipped (each
+        bumps ``ckpt_corrupt_skipped``); returning anything after a skip
+        bumps ``ckpt_fallbacks`` once."""
+        self._recover_aside()
+        skipped = 0
+        for tag, path, committed in reversed(self.snapshots()):
+            if committed:
+                payload = self.verify(path, as_paths=as_paths)
+                if payload is not None:
+                    if skipped:
+                        _bump("ckpt_fallbacks")
+                    return tag, payload
+            _bump("ckpt_corrupt_skipped")
+            skipped += 1
+        return None

@@ -51,9 +51,27 @@ _state = {
 #   donation_fallback_copies  aliased/exposed state arrays copied so a
 #                      caller-held reference survives donation
 #   executor_steps     compiled steps dispatched
+#
+# Fault-tolerance counters (paddle_tpu.fault, io.snapshot,
+# distributed.launch) use the same table:
+#   retry_attempts     re-attempts after a retryable failure (Retrier)
+#   retry_giveups      retry budget/deadline exhausted, last error raised
+#   faults_injected    armed fault points fired (tests / PADDLE_FAULT_SPEC)
+#   ckpt_commits       snapshot manifest commits (the atomic rename ran)
+#   ckpt_corrupt_skipped  torn/sha-mismatched snapshots skipped at load
+#   ckpt_fallbacks     loads that fell back past a newer broken snapshot
+#   trainer_relaunches dead trainers re-exec'd by launch.supervise
+# These are process events, not per-executor ones, so Executor.counters
+# merges the FAULT_COUNTER_NAMES slice of this table into its view.
 # ---------------------------------------------------------------------------
 import threading as _threading
 from collections import Counter as _Counter
+
+FAULT_COUNTER_NAMES = (
+    "retry_attempts", "retry_giveups", "faults_injected",
+    "ckpt_commits", "ckpt_corrupt_skipped", "ckpt_fallbacks",
+    "trainer_relaunches",
+)
 
 _counters: _Counter = _Counter()
 # prefetch threads bump h2d_bytes concurrently with the training

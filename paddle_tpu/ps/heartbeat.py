@@ -30,6 +30,12 @@ class HeartBeatMonitor:
         self._beats: Dict[int, float] = {}
         self._status: Dict[int, int] = {}
         self._dead: set = set()
+        # supervisor integration (attach_supervisor): re-fire on_dead
+        # every timeout period while a rank stays silent, so a
+        # relaunched incarnation that hangs before its first beat is
+        # not lost. Plain on_dead users keep the one-shot contract.
+        self._refire = False
+        self._last_fired: Dict[int, float] = {}
         self._num_trainers = int(num_trainers)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -40,6 +46,28 @@ class HeartBeatMonitor:
             self._beats[trainer_id] = time.monotonic()
             self._status[trainer_id] = status
             self._dead.discard(trainer_id)
+            self._last_fired.pop(trainer_id, None)
+
+    def attach_supervisor(self, supervisor) -> "HeartBeatMonitor":
+        """Route dead-trainer events into a distributed.launch
+        Supervisor: a trainer whose beat lapses past the timeout is
+        terminated and relaunched under the supervisor's restart budget
+        (the reference heart_beat_monitor.cc only logs, and aborts the
+        whole job for the chief; here recovery is the default policy).
+
+        Two-way wiring: re-firing is enabled (a relaunched incarnation
+        that hangs before its first beat gets flagged again after a
+        fresh timeout), and every supervisor (re)launch refreshes the
+        rank's beat so the new process has a full timeout of grace —
+        without that, a re-fire racing a slow relaunch would SIGTERM the
+        fresh incarnation and drain the restart budget on a healthy
+        job."""
+        self._on_dead = supervisor.notify_dead
+        self._refire = True
+        register = getattr(supervisor, "on_relaunch", None)
+        if register is not None:
+            register(self.update)
+        return self
 
     # -- queries ------------------------------------------------------------
     def alive(self, trainer_id: int) -> bool:
@@ -78,10 +106,15 @@ class HeartBeatMonitor:
             newly_dead = []
             with self._lock:
                 for tid, t in self._beats.items():
-                    if (self._status.get(tid) != COMPLETED
-                            and tid not in self._dead
-                            and now - t > self._timeout):
+                    if self._status.get(tid) == COMPLETED:
+                        continue
+                    flagged = tid in self._dead
+                    if flagged and not self._refire:
+                        continue   # one-shot contract for plain users
+                    since = max(t, self._last_fired.get(tid, t))
+                    if now - since > self._timeout:
                         self._dead.add(tid)
+                        self._last_fired[tid] = now
                         newly_dead.append(tid)
             for tid in newly_dead:
                 if self._on_dead is not None:

@@ -204,8 +204,19 @@ class Executor:
     @property
     def counters(self) -> Dict[str, int]:
         """This executor's hot-path counters (cache hits/misses, h2d
-        bytes, donated bytes, steps) — cumulative since construction."""
-        return dict(self._counters)
+        bytes, donated bytes, steps) — cumulative since construction —
+        plus the process-global fault-tolerance counters (retry_*,
+        ckpt_*, faults_injected, trainer_relaunches): a retry or a
+        checkpoint fallback is a process event, not a per-executor one,
+        but operators read both off the same dashboard."""
+        from .. import profiler
+
+        out = dict(self._counters)
+        snap = profiler.counters_snapshot()
+        for name in profiler.FAULT_COUNTER_NAMES:
+            if name in snap:
+                out[name] = snap[name]
+        return out
 
     def close(self):
         self._cache.clear()

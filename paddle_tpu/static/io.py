@@ -8,17 +8,23 @@ Here persistables live in a host-side Scope of jax arrays, so saving is a
 straight pickle of name->numpy (the reference's single-file `save :1669`
 .pdparams format shape), and the program is serialized as versioned JSON
 (ir.py). No executor round-trip needed.
+
+Every write goes through io.serialization's atomic-replace protocol
+(temp file + fsync + one os.replace), so a kill mid-save can never leave
+a truncated .pdparams/.pdopt/__model__ behind; loads surface truncated
+or missing files as ValueErrors naming the path.
 """
 from __future__ import annotations
 
 import os
-import pickle
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .executor import Executor, Scope, global_scope
 from .ir import Program, Variable
+from ..io.serialization import _atomic_write_bytes, _load_pickle, \
+    atomic_pickle_dump
 
 _PARAMS_SUFFIX = ".pdparams"
 _MODEL_FILENAME = "__model__"
@@ -42,8 +48,7 @@ def save_persistables(executor: Executor, dirname: str,
     os.makedirs(dirname, exist_ok=True)
     state = _collect_persistables(program, global_scope())
     path = os.path.join(dirname, filename or "params" + _PARAMS_SUFFIX)
-    with open(path, "wb") as f:
-        pickle.dump(state, f, protocol=4)
+    atomic_pickle_dump(state, path)
     return path
 
 
@@ -52,8 +57,7 @@ def load_persistables(executor: Executor, dirname: str,
                       filename: Optional[str] = None):
     import jax.numpy as jnp
     path = os.path.join(dirname, filename or "params" + _PARAMS_SUFFIX)
-    with open(path, "rb") as f:
-        state = pickle.load(f)
+    state = _load_pickle(path)
     scope = global_scope()
     for k, v in state.items():
         scope.set(k, jnp.asarray(v))
@@ -76,11 +80,9 @@ def save(program: Program, model_path: str):
     param_names = {p.name for p in program.all_parameters()}
     params = {k: v for k, v in state.items() if k in param_names}
     opt = {k: v for k, v in state.items() if k not in param_names}
-    with open(model_path + ".pdparams", "wb") as f:
-        pickle.dump(params, f, protocol=4)
+    atomic_pickle_dump(params, model_path + ".pdparams")
     if opt:
-        with open(model_path + ".pdopt", "wb") as f:
-            pickle.dump(opt, f, protocol=4)
+        atomic_pickle_dump(opt, model_path + ".pdopt")
     save_program(program, model_path + ".pdmodel")
 
 
@@ -91,11 +93,9 @@ def load(program: Program, model_path: str, executor=None, var_list=None):
     import jax.numpy as jnp
 
     state = {}
-    with open(model_path + ".pdparams", "rb") as f:
-        state.update(pickle.load(f))
+    state.update(_load_pickle(model_path + ".pdparams"))
     if os.path.exists(model_path + ".pdopt"):
-        with open(model_path + ".pdopt", "rb") as f:
-            state.update(pickle.load(f))
+        state.update(_load_pickle(model_path + ".pdopt"))
     wanted = None
     if var_list is not None:
         wanted = {v.name if hasattr(v, "name") else v for v in var_list}
@@ -121,14 +121,12 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
     meta = {"feed_names": list(feeded_var_names),
             "fetch_names": fetch_names}
     blob = {"program": pruned.to_dict(), "meta": meta}
-    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME),
-              "wb") as f:
-        pickle.dump(blob, f, protocol=4)
+    atomic_pickle_dump(
+        blob, os.path.join(dirname, model_filename or _MODEL_FILENAME))
     state = _collect_persistables(pruned, global_scope())
-    with open(os.path.join(dirname,
-                           params_filename or "params" + _PARAMS_SUFFIX),
-              "wb") as f:
-        pickle.dump(state, f, protocol=4)
+    atomic_pickle_dump(
+        state, os.path.join(dirname,
+                            params_filename or "params" + _PARAMS_SUFFIX))
     return fetch_names
 
 
@@ -136,15 +134,12 @@ def load_inference_model(dirname: str, executor: Executor,
                          model_filename: Optional[str] = None,
                          params_filename: Optional[str] = None):
     import jax.numpy as jnp
-    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME),
-              "rb") as f:
-        blob = pickle.load(f)
+    blob = _load_pickle(
+        os.path.join(dirname, model_filename or _MODEL_FILENAME))
     program = Program.from_dict(blob["program"])
     meta = blob["meta"]
-    with open(os.path.join(dirname,
-                           params_filename or "params" + _PARAMS_SUFFIX),
-              "rb") as f:
-        state = pickle.load(f)
+    state = _load_pickle(
+        os.path.join(dirname, params_filename or "params" + _PARAMS_SUFFIX))
     scope = global_scope()
     for k, v in state.items():
         scope.set(k, jnp.asarray(v))
@@ -156,11 +151,7 @@ def save_program(program: Program, path: str):
     """Serialize one program to a file (the reference C++ train demo's
     main_program/startup_program files — train/demo/demo_trainer.cc:41
     Load reads exactly such a pair)."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(program.serialize_to_string())
+    _atomic_write_bytes(path, program.serialize_to_string())
 
 
 def load_program(path: str) -> Program:

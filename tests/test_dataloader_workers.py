@@ -115,6 +115,40 @@ def test_worker_death_raises_not_hangs():
             pass
 
 
+class CleanExitDataset(RangeDataset):
+    """os._exit(0) mid-run: a 'clean' death (sample code calling
+    sys.exit) used to block the reorder buffer forever — exitcode 0
+    passed the watchdog but the in-flight batch never arrived."""
+
+    def __getitem__(self, i):
+        if i == 17:
+            os._exit(0)
+        return super().__getitem__(i)
+
+
+class CleanExitStream(IterableDataset):
+    """Iterable twin: dies with exitcode 0 before its 'done' marker."""
+
+    def __iter__(self):
+        yield np.float32(0.0)
+        os._exit(0)
+
+
+def test_worker_clean_exit_raises_not_hangs():
+    ds = CleanExitDataset(64)
+    with pytest.raises(RuntimeError, match="exited cleanly mid-run"):
+        for _ in DataLoader(ds, batch_size=8, num_workers=2):
+            pass
+
+
+def test_iterable_worker_clean_exit_raises_not_hangs():
+    with pytest.raises(RuntimeError,
+                       match="workers exited before delivering"):
+        for _ in DataLoader(CleanExitStream(), batch_size=4,
+                            num_workers=2):
+            pass
+
+
 def test_worker_exception_propagates():
     ds = RaisingDataset(64)
     with pytest.raises(RuntimeError, match="bad sample 11"):

@@ -1,0 +1,700 @@
+"""Fault-tolerance layer (paddle_tpu.fault + io.snapshot +
+launch.supervise): fast, deterministic failure-path tests — no real
+process kills, no slow marker. The composed real-process story stays in
+tests/test_fault_resume.py (slow); everything here drives the same code
+paths through FaultInjector/fakes so the failure story is guarded in the
+unit tier too."""
+import json
+import os
+import pickle
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fault, profiler
+from paddle_tpu.fault import Backoff, InjectedFault, Retrier, retry
+from paddle_tpu.io.snapshot import MANIFEST_NAME, SnapshotStore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import corrupt_ckpt  # noqa: E402  (tools/ helper, importable for CI chaos)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.disarm_all()
+    yield
+    fault.disarm_all()
+
+
+def _counter(name):
+    return profiler.counters_snapshot().get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(f"transient {len(calls)}")
+        return "ok"
+
+    before = _counter("retry_attempts")
+    r = Retrier(max_attempts=5,
+                backoff=Backoff(base=0.1, factor=2.0, jitter=0),
+                sleep=sleeps.append)
+    assert r.call(flaky) == "ok"
+    assert len(calls) == 3
+    # deterministic exponential schedule with jitter off
+    assert sleeps == [0.1, 0.2]
+    assert _counter("retry_attempts") - before == 2
+
+
+def test_retry_exhaustion_raises_the_last_error():
+    errors = [OSError("first"), OSError("second"), OSError("third")]
+    seen = []
+
+    def fails():
+        e = errors[len(seen)]
+        seen.append(e)
+        raise e
+
+    before = _counter("retry_giveups")
+    with pytest.raises(OSError, match="third"):
+        Retrier(max_attempts=3, backoff=Backoff(base=0, jitter=0),
+                sleep=lambda d: None).call(fails)
+    assert len(seen) == 3
+    assert _counter("retry_giveups") - before == 1
+
+
+def test_retry_non_retryable_passes_through_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        Retrier(max_attempts=5, retry_on=(OSError,),
+                giveup_on=(FileNotFoundError,),
+                sleep=lambda d: None).call(bad)
+    assert len(calls) == 1
+
+    # predicate filter form
+    with pytest.raises(ValueError):
+        Retrier(max_attempts=5,
+                retry_on=lambda e: isinstance(e, OSError),
+                sleep=lambda d: None).call(
+                    lambda: (_ for _ in ()).throw(ValueError("no")))
+
+
+def test_retry_deadline_stops_before_budget():
+    calls = []
+
+    def fails():
+        calls.append(1)
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        Retrier(max_attempts=100, deadline=0.5,
+                backoff=Backoff(base=10.0, jitter=0),
+                sleep=lambda d: None).call(fails)
+    assert len(calls) == 1  # first backoff (10s) already busts 0.5s
+
+
+def test_retry_decorator_forms():
+    state = {"n": 0}
+
+    @retry(max_attempts=2, backoff=Backoff(base=0, jitter=0),
+           sleep=lambda d: None)
+    def once_flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise OSError("flake")
+        return state["n"]
+
+    assert once_flaky() == 2
+
+    @retry
+    def plain():
+        return "plain"
+
+    assert plain() == "plain"
+
+    # direct form: retry(fn, **options) wraps fn, never drops it
+    state["n"] = 0
+    wrapped = retry(once_flaky.__wrapped__, max_attempts=2,
+                    backoff=Backoff(base=0, jitter=0),
+                    sleep=lambda d: None)
+    assert wrapped() == 2
+    with pytest.raises(TypeError, match="callable"):
+        retry("not-a-function", max_attempts=2)
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+
+def test_fault_point_arms_fires_n_times_then_passes():
+    before = _counter("faults_injected")
+    fault.arm("unit.point", times=2)
+    with pytest.raises(InjectedFault):
+        fault.point("unit.point")
+    assert fault.armed("unit.point") == 1
+    with pytest.raises(InjectedFault):
+        fault.point("unit.point")
+    fault.point("unit.point")  # exhausted: passes
+    assert _counter("faults_injected") - before == 2
+
+
+def test_fault_point_custom_exception_and_pattern():
+    fault.arm("ckpt.*", times=1, exc=OSError, message="disk gone")
+    with pytest.raises(OSError, match="disk gone"):
+        fault.point("ckpt.rename")
+    fault.point("ckpt.rename")  # consumed
+
+
+def test_fault_env_spec_parsing():
+    inj = fault.FaultInjector("a.b:2:OSError:boom, c.d:1")
+    with pytest.raises(OSError, match="boom"):
+        inj.point("a.b")
+    with pytest.raises(OSError):
+        inj.point("a.b")
+    inj.point("a.b")
+    with pytest.raises(InjectedFault):
+        inj.point("c.d")
+    with pytest.raises(ValueError, match="bad PADDLE_FAULT_SPEC"):
+        fault.FaultInjector("justaname")
+    with pytest.raises(ValueError, match="exception"):
+        fault.FaultInjector("a.b:1:NotAnException")
+    with pytest.raises(ValueError, match="counts"):
+        fault.FaultInjector("a.b:one")
+
+    # times@after: skip the first 2 hits, fail the 3rd, then pass
+    inj3 = fault.FaultInjector("e.f:1@2:OSError")
+    inj3.point("e.f")
+    inj3.point("e.f")
+    with pytest.raises(OSError):
+        inj3.point("e.f")
+    inj3.point("e.f")
+
+
+# ---------------------------------------------------------------------------
+# crash-safe snapshots
+# ---------------------------------------------------------------------------
+
+def _mkstore(tmp_path, keep_last=3):
+    return SnapshotStore(str(tmp_path / "store"), keep_last=keep_last)
+
+
+def test_snapshot_commit_reload_newest(tmp_path):
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": b"s0", "meta": b"m0"})
+    st.save(1, {"state": b"s1", "meta": b"m1"})
+    tag, files = st.load_latest()
+    assert tag == 1 and files == {"state": b"s1", "meta": b"m1"}
+
+
+def test_torn_commit_falls_back_to_newest_valid(tmp_path):
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": b"s0"})
+    st.save(1, {"state": b"s1"})
+    before_fb = _counter("ckpt_fallbacks")
+    fault.arm("ckpt.rename", times=1, exc=OSError)
+    with pytest.raises(OSError):
+        st.save(2, {"state": b"s2"})
+    # the torn dir exists but has no manifest -> not committed
+    torn = [s for s in st.snapshots() if not s[2]]
+    assert [t[0] for t in torn] == [2]
+    tag, files = st.load_latest()
+    assert (tag, files["state"]) == (1, b"s1")
+    assert _counter("ckpt_fallbacks") - before_fb == 1
+    # recovery: the next commit of the same tag replaces the torn dir
+    st.save(2, {"state": b"s2"})
+    assert st.load_latest()[0] == 2
+
+
+def test_corrupt_payload_is_skipped_sha256(tmp_path):
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": b"good-old" * 64})
+    st.save(1, {"state": b"good-new" * 64})
+    info = corrupt_ckpt.corrupt(st.root, mode="flip")
+    assert info["snapshot"].endswith("epoch_1")
+    before = _counter("ckpt_corrupt_skipped")
+    tag, files = st.load_latest()
+    assert tag == 0 and files["state"] == b"good-old" * 64
+    assert _counter("ckpt_corrupt_skipped") - before == 1
+
+
+def test_truncated_payload_is_skipped(tmp_path):
+    st = _mkstore(tmp_path)
+    st.save(3, {"state": b"x" * 256})
+    st.save(4, {"state": b"y" * 256})
+    corrupt_ckpt.corrupt(st.root, mode="truncate")
+    assert st.load_latest()[0] == 3
+
+
+def test_unmanifest_mode_makes_snapshot_torn(tmp_path):
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": b"a"})
+    st.save(1, {"state": b"b"})
+    corrupt_ckpt.corrupt(st.root, mode="unmanifest")
+    assert st.load_latest()[0] == 0
+
+
+def test_corrupt_ckpt_cli(tmp_path, capsys):
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": b"z" * 64})
+    assert corrupt_ckpt.main([st.root, "--mode", "flip"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["mode"] == "flip" and out["target"].endswith("state")
+    assert st.load_latest() is None  # the only snapshot is now invalid
+
+
+def test_rotation_keeps_last_n(tmp_path):
+    st = _mkstore(tmp_path, keep_last=2)
+    for k in range(5):
+        st.save(k, {"state": str(k).encode()})
+    tags = [t for t, _, ok in st.snapshots() if ok]
+    assert tags == [3, 4]
+
+
+def test_same_tag_rewrite_preserves_committed_copy(tmp_path):
+    """Re-saving an existing tag must never destroy the committed copy
+    before its replacement commits: a crash mid-rewrite leaves the old
+    snapshot recoverable (healed on the next save/load)."""
+    st = _mkstore(tmp_path)
+    st.save(1, {"state": b"old-data"})
+    fault.arm("ckpt.write", times=1, exc=OSError)
+    with pytest.raises(OSError):
+        st.save(1, {"state": b"new-data"})
+    tag, files = st.load_latest()   # heals the moved-aside copy
+    assert (tag, files["state"]) == (1, b"old-data")
+    st.save(1, {"state": b"new-data"})
+    assert st.load_latest()[1]["state"] == b"new-data"
+    assert not any(p.endswith(".old") for p in
+                   os.listdir(st.root))
+
+
+def test_snapshot_streaming_writer(tmp_path):
+    """Dict values may be callables streaming into the file object —
+    sha256 is computed in flight, so big states never materialize as
+    one bytes blob."""
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": lambda f: pickle.dump({"w": [1, 2, 3]}, f),
+                "meta": b"m"})
+    tag, files = st.load_latest()
+    assert tag == 0
+    assert pickle.loads(files["state"]) == {"w": [1, 2, 3]}
+    assert files["meta"] == b"m"
+
+
+def test_rotation_reclaims_stale_tmp_dirs(tmp_path):
+    """A crash before the tmp->final rename leaks <dir>.tmp; the next
+    commit's rotation must reclaim it (interval saves may never reuse
+    that tag, so same-tag cleanup alone is not enough)."""
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": b"ok"})
+    fault.arm("ckpt.write", times=1, exc=OSError)
+    with pytest.raises(OSError):
+        st.save(1, {"state": b"crashed"})
+    assert os.path.isdir(os.path.join(st.root, "epoch_1.tmp"))
+    st.save(2, {"state": b"next"})
+    assert not os.path.exists(os.path.join(st.root, "epoch_1.tmp"))
+
+
+def test_relaunch_clears_stale_external_dead():
+    """A notify_dead queued while the rank sat in relaunch backoff
+    refers to the dead incarnation — starting the replacement must drop
+    it, or the fresh process gets SIGTERM'd and the budget drains."""
+    from paddle_tpu.distributed.launch import Supervisor
+
+    sup = Supervisor(1, start_fn=lambda r: FakeProc(0),
+                     backoff=Backoff(base=0, jitter=0),
+                     sleep=lambda d: None)
+    sup.notify_dead(0)
+    sup._start_rank(0)
+    assert 0 not in sup._external_dead
+
+
+def test_malformed_env_spec_does_not_brick_import(tmp_path):
+    """A typo'd job-wide PADDLE_FAULT_SPEC must degrade to a warning,
+    not make every `import paddle_tpu` in the environment raise."""
+    code = ("import warnings\n"
+            "with warnings.catch_warnings(record=True) as w:\n"
+            "    warnings.simplefilter('always')\n"
+            "    from paddle_tpu.framework.bringup import force_cpu\n"
+            "    force_cpu()\n"
+            "    from paddle_tpu import fault\n"
+            "assert any('malformed' in str(x.message) for x in w), w\n"
+            "fault.point('anything')\n"
+            "print('IMPORT_OK')\n")
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu",
+                "PADDLE_FAULT_SPEC": "ckpt.rename"})
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "IMPORT_OK" in out.stdout
+
+
+def test_all_snapshots_corrupt_returns_none(tmp_path):
+    st = _mkstore(tmp_path)
+    st.save(0, {"state": b"only" * 32})
+    corrupt_ckpt.corrupt(st.root, mode="flip")
+    assert st.load_latest() is None
+
+
+# ---------------------------------------------------------------------------
+# serialization load errors (satellite)
+# ---------------------------------------------------------------------------
+
+def test_io_load_missing_and_truncated_raise_valueerror(tmp_path):
+    from paddle_tpu.io import serialization
+
+    missing = str(tmp_path / "nope.pdparams")
+    with pytest.raises(ValueError, match="nope.pdparams"):
+        serialization.load(missing)
+
+    # a real pickle, truncated mid-stream
+    path = str(tmp_path / "trunc.pdparams")
+    serialization.save({"w": np.zeros((8, 8), np.float32)}, path)
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[:len(data) // 2])
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        serialization.load(path)
+
+    with pytest.raises(ValueError, match="neither"):
+        serialization.load_dygraph(str(tmp_path / "ghost"))
+
+    # a suffixed path is accepted (reference semantics) — it must not
+    # probe m.pdparams.pdparams and misfire the new ValueError
+    serialization.save({"w": 1}, str(tmp_path / "m.pdparams"))
+    params, _ = serialization.load_dygraph(str(tmp_path / "m.pdparams"))
+    assert params == {"w": 1}
+
+
+def test_atomic_write_survives_injected_replace_failure(tmp_path):
+    from paddle_tpu.io import serialization
+
+    path = str(tmp_path / "state.pdparams")
+    serialization.save({"v": 1}, path)
+    fault.arm("io.replace", times=1, exc=OSError)
+    with pytest.raises(OSError):
+        serialization.save({"v": 2}, path)
+    # the old file is intact (no torn overwrite), no temp litter
+    assert serialization.load(path) == {"v": 1}
+    assert os.listdir(str(tmp_path)) == ["state.pdparams"]
+    serialization.save({"v": 2}, path)
+    assert serialization.load(path) == {"v": 2}
+
+
+# ---------------------------------------------------------------------------
+# supervised relaunch (scripted fakes: no real processes, no kills)
+# ---------------------------------------------------------------------------
+
+class FakeProc:
+    """Popen-shaped object with a scripted exit code."""
+
+    def __init__(self, code):
+        self.returncode = code
+        self.pid = 4242
+        self.signals = []
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        self.returncode = -int(sig)
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+def test_supervise_relaunches_within_budget():
+    from paddle_tpu.distributed import launch
+
+    script = {0: [17, 17, 0], 1: [0]}  # rank0 dies twice, then completes
+    started = {0: 0, 1: 0}
+
+    def start_fn(rank):
+        code = script[rank][started[rank]]
+        started[rank] += 1
+        return FakeProc(code)
+
+    before = _counter("trainer_relaunches")
+    rc = launch.supervise(2, start_fn=start_fn, max_restarts=3,
+                          backoff=Backoff(base=0, jitter=0),
+                          sleep=lambda d: None)
+    assert rc == 0
+    assert started == {0: 3, 1: 1}
+    assert _counter("trainer_relaunches") - before == 2
+
+
+def test_supervise_budget_exhaustion_raises_and_terminates():
+    from paddle_tpu.distributed import launch
+
+    always_dead = []
+
+    def start_fn(rank):
+        p = FakeProc(17 if rank == 0 else None)  # rank1 stays "running"
+        always_dead.append(p)
+        return p
+
+    with pytest.raises(launch.RestartBudgetExceeded, match="budget"):
+        launch.supervise(2, start_fn=start_fn, max_restarts=2,
+                         backoff=Backoff(base=0, jitter=0),
+                         sleep=lambda d: None)
+    # initial rank0 + 2 relaunches + rank1 = 4 starts; the survivor got
+    # SIGTERM on the way out
+    assert len(always_dead) == 4
+    assert always_dead[1].signals  # rank1 (second start) terminated
+
+
+def test_heartbeat_on_dead_feeds_supervisor_relaunch():
+    import time as _time
+
+    from paddle_tpu.distributed.launch import Supervisor
+    from paddle_tpu.ps.heartbeat import HeartBeatMonitor
+
+    script = {0: [None, 0]}  # first incarnation hangs, relaunch completes
+    started = {0: 0}
+    procs = []
+
+    def start_fn(rank):
+        p = FakeProc(script[rank][started[rank]])
+        started[rank] += 1
+        procs.append(p)
+        return p
+
+    sup = Supervisor(1, start_fn=start_fn, max_restarts=2,
+                     backoff=Backoff(base=0, jitter=0),
+                     poll_interval=0.01, sleep=lambda d: None)
+    mon = HeartBeatMonitor(1, timeout_s=0.05, check_interval_s=0.01)
+    mon.attach_supervisor(sup)
+    mon.update(0)
+    mon.start()
+    try:
+        # wait (bounded) for the beat to lapse -> on_dead -> notify_dead;
+        # entering run() before that would spin on a "hung" rank forever
+        for _ in range(500):
+            if mon.dead_trainers():
+                break
+            _time.sleep(0.01)
+        assert mon.dead_trainers() == [0]
+        assert sup.run() == 0
+    finally:
+        mon.stop()
+    # incarnation 1 was SIGTERM'd for the lapsed heartbeat, then relaunched
+    assert started[0] == 2 and procs[0].signals
+
+
+# ---------------------------------------------------------------------------
+# http_kv client: retry + barrier timeout
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def kv_server():
+    import socket
+
+    from paddle_tpu.distributed.http_kv import KVServer
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = KVServer(port)
+    srv.start()
+    try:
+        yield port
+    finally:
+        srv.stop()
+
+
+def test_kv_client_roundtrip_retry_and_barrier(kv_server):
+    from paddle_tpu.distributed.http_kv import KVClient
+
+    cli = KVClient(f"127.0.0.1:{kv_server}", sleep=lambda d: None)
+    assert cli.get("scope/missing") is None
+    cli.put("scope/k", b"v1")
+    # a transient connection fault is retried away invisibly
+    fault.arm("http_kv.request", times=1, exc=ConnectionError)
+    assert cli.get("scope/k") == b"v1"
+    cli.delete("scope/k")
+    assert cli.get("scope/k") is None
+
+    with pytest.raises(TimeoutError, match="barrier timed out"):
+        cli.wait("scope/never", timeout=0.2, poll=0.01)
+
+    cli.put("b/0", b"1")
+    cli.put("b/1", b"1")
+    cli.barrier("b", rank=0, world_size=2, timeout=1.0)  # all present: ok
+    with pytest.raises(TimeoutError):
+        cli.barrier("c", rank=0, world_size=2, timeout=0.2, poll=0.01)
+
+
+# ---------------------------------------------------------------------------
+# download retry wiring
+# ---------------------------------------------------------------------------
+
+def test_download_resolve_retries_transient_oserror(tmp_path):
+    from paddle_tpu.hapi import download
+
+    p = tmp_path / "w.bin"
+    p.write_bytes(b"x")
+    before = _counter("retry_attempts")
+    fault.arm("download.resolve", times=1, exc=OSError)
+    assert download.get_path_from_url(str(p)) == str(p)
+    assert _counter("retry_attempts") - before == 1
+    # genuinely-missing stays terminal and immediate
+    with pytest.raises(FileNotFoundError):
+        download.get_path_from_url("http://example.com/nope.bin")
+
+
+def test_incubate_fetch_retries_then_gives_up(monkeypatch, tmp_path):
+    import paddle_tpu.incubate as incubate
+
+    monkeypatch.setenv("HOME", str(tmp_path))  # isolate the cache dir
+    before = _counter("retry_giveups")
+    fault.arm("download.fetch", times=10, exc=ConnectionError)
+    with pytest.raises(RuntimeError, match="could not download"):
+        incubate.get_weights_path_from_url("http://example.com/w.bin")
+    assert _counter("retry_giveups") - before == 1
+
+
+# ---------------------------------------------------------------------------
+# the deterministic chaos test (acceptance criterion): crash the
+# checkpoint commit mid-write via FaultInjector, verify sha256-checked
+# fallback + supervised relaunch + counters — zero real kills
+# ---------------------------------------------------------------------------
+
+class _NumpyModel:
+    def __init__(self):
+        self.w = np.zeros(4, np.float32)
+
+    def state_dict(self):
+        return {"w": self.w.copy()}
+
+    def set_state_dict(self, state):
+        self.w = np.asarray(state["w"], np.float32).copy()
+
+
+class _InlineProc:
+    """Runs the 'trainer' synchronously in-process at construction —
+    the supervisor sees a Popen-shaped corpse or survivor, but nothing
+    was ever forked or killed."""
+
+    def __init__(self, fn):
+        self.pid = os.getpid()
+        try:
+            fn()
+            self.returncode = 0
+        except Exception:
+            self.returncode = 17
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig):
+        pass
+
+
+def test_chaos_torn_commit_fallback_relaunch_counters(tmp_path):
+    from paddle_tpu.distributed import launch
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+        TrainEpochRange,
+    )
+    from paddle_tpu.static import Executor
+
+    ckpt_root = str(tmp_path / "ckpt")
+    epochs_trained = []
+
+    def trainer():
+        model = _NumpyModel()
+        tr = TrainEpochRange(5, name="chaos_job",
+                             checkpoint_path=ckpt_root)
+        tr.register(model=model)
+        for epoch in tr.get():
+            model.w = model.w + 1.0  # "training"
+            epochs_trained.append((epoch, float(model.w[0]),
+                                   tr.restored_epoch))
+
+    # arm: the THIRD commit (epoch 2) dies at the manifest rename — the
+    # commit instant. Epochs 0 and 1 commit fine first (after=2).
+    before = profiler.counters_snapshot()
+    fault.arm("ckpt.rename", times=1, exc=OSError, message="yanked",
+              after=2)
+
+    def wrapped_trainer():
+        try:
+            trainer()
+        except OSError:
+            # epoch-2 commit crashed: the trainer "dies" mid-epoch
+            raise RuntimeError("trainer crashed at checkpoint commit")
+
+    rc = launch.supervise(
+        1, start_fn=lambda rank: _InlineProc(wrapped_trainer),
+        max_restarts=2, backoff=Backoff(base=0, jitter=0),
+        sleep=lambda d: None)
+    assert rc == 0
+
+    # run 1 trained 0,1,2 (fresh start), crashed committing 2; the
+    # relaunch must resume from epoch 1 — the newest VALID snapshot
+    # (epoch_2 is torn on disk) — and train 2,3,4 with restored weights
+    assert [e for e, _, _ in epochs_trained] == [0, 1, 2, 2, 3, 4]
+    run2 = epochs_trained[3:]
+    assert run2[0][2] == 1        # restored_epoch from the fallback
+    assert run2[0][1] == 3.0      # w was 2.0 at epoch-1 commit, +1
+    # disk really holds a torn epoch_2 from run 1 next to run 2's commits
+    store = SnapshotStore(os.path.join(ckpt_root, "chaos_job"))
+    tag, files = store.load_latest()
+    assert tag == 4
+    state = pickle.loads(files["state.pdparams"])
+    assert float(state["model"]["w"][0]) == 5.0
+
+    delta = profiler.counters_delta(before)
+    assert delta.get("faults_injected", 0) >= 1
+    assert delta.get("ckpt_fallbacks", 0) >= 1
+    assert delta.get("ckpt_corrupt_skipped", 0) >= 1
+    assert delta.get("trainer_relaunches", 0) == 1
+    assert delta.get("ckpt_commits", 0) == 5  # epochs 0,1 + 2,3,4
+
+    # the fault/ckpt counters are on the executor dashboard too
+    exe = Executor()
+    counters = exe.counters
+    for key in ("ckpt_commits", "ckpt_fallbacks", "faults_injected",
+                "trainer_relaunches"):
+        assert counters.get(key, 0) >= 1, (key, counters)
+
+
+def test_fault_spec_env_arms_subprocess(tmp_path):
+    """PADDLE_FAULT_SPEC arms the default injector at import: prove it
+    end-to-end in a clean interpreter (the documented ops workflow)."""
+    code = (
+        "from paddle_tpu.framework.bringup import force_cpu; force_cpu()\n"
+        "from paddle_tpu import fault\n"
+        "try:\n"
+        "    fault.point('ckpt.rename')\n"
+        "    print('NOFIRE')\n"
+        "except OSError:\n"
+        "    print('FIRED')\n"
+        "fault.point('ckpt.rename')\n"
+        "print('PASSED')\n")
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu",
+                "PADDLE_FAULT_SPEC": "ckpt.rename:1:OSError"})
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "FIRED" in out.stdout and "PASSED" in out.stdout

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Chaos helper: damage a committed snapshot to exercise load-time
+verification (io.snapshot.SnapshotStore) in CI and by hand.
+
+Usage:
+    python tools/corrupt_ckpt.py PATH [--mode flip|truncate|unmanifest]
+                                 [--file NAME] [--offset N]
+
+PATH is either one snapshot dir (.../epoch_<k>) or a store root (or an
+auto-checkpoint job dir), in which case the NEWEST committed snapshot is
+picked. Modes:
+
+    flip        XOR one payload byte (default: middle of the file) —
+                the sha256 manifest check must reject the snapshot
+    truncate    cut the payload in half (or at --offset) — torn write
+    unmanifest  delete MANIFEST.json — uncommitted/torn snapshot
+
+Prints a JSON summary of what was damaged so CI logs show the exact
+chaos applied. After corruption, loading must fall back to the newest
+still-valid snapshot (see tests/test_fault_layer.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.io.snapshot import MANIFEST_NAME, SnapshotStore  # noqa: E402
+
+
+def pick_snapshot(path: str) -> str:
+    """Resolve PATH to one committed snapshot dir (newest wins)."""
+    if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+        return path
+    committed = [p for _tag, p, ok in SnapshotStore(path).snapshots() if ok]
+    if not committed:
+        raise SystemExit(f"no committed snapshot under {path!r}")
+    return committed[-1]
+
+
+def pick_payload(snap_dir: str, name=None) -> str:
+    with open(os.path.join(snap_dir, MANIFEST_NAME), encoding="utf-8") as f:
+        files = json.load(f)["files"]
+    if name is None:
+        name = sorted(files)[-1]  # deterministic default
+    if name not in files:
+        raise SystemExit(f"{name!r} not in manifest ({sorted(files)})")
+    return os.path.join(snap_dir, name)
+
+
+def corrupt(path: str, mode: str = "flip", file: str = None,
+            offset: int = None) -> dict:
+    """Damage one snapshot; returns a summary dict (importable for
+    tests)."""
+    snap = pick_snapshot(path)
+    if mode == "unmanifest":
+        target = os.path.join(snap, MANIFEST_NAME)
+        os.remove(target)
+        return {"snapshot": snap, "mode": mode, "target": target}
+    target = pick_payload(snap, file)
+    size = os.path.getsize(target)
+    if size == 0:
+        raise SystemExit(f"{target!r} is empty; nothing to corrupt")
+    at = offset if offset is not None else size // 2
+    at = max(0, min(size - 1, at))
+    if mode == "flip":
+        with open(target, "r+b") as f:
+            f.seek(at)
+            byte = f.read(1)
+            f.seek(at)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        detail = {"offset": at}
+    elif mode == "truncate":
+        with open(target, "r+b") as f:
+            f.truncate(at)
+        detail = {"truncated_to": at, "was": size}
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    return {"snapshot": snap, "mode": mode, "target": target, **detail}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "corrupt_ckpt", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("path", help="snapshot dir or store root")
+    parser.add_argument("--mode", default="flip",
+                        choices=("flip", "truncate", "unmanifest"))
+    parser.add_argument("--file", default=None,
+                        help="payload file name inside the snapshot")
+    parser.add_argument("--offset", type=int, default=None)
+    args = parser.parse_args(argv)
+    print(json.dumps(corrupt(args.path, mode=args.mode, file=args.file,
+                             offset=args.offset)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

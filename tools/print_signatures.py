@@ -20,6 +20,7 @@ MODULES = [
     "paddle_tpu.autograd",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
+    "paddle_tpu.fault",
     "paddle_tpu.hapi",
     "paddle_tpu.io",
     "paddle_tpu.jit",
