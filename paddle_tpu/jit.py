@@ -216,6 +216,10 @@ class TrainStep:
         self._zero_stage = zero_stage
         self._zero_axis = zero_axis
         self._placed = False
+        # PADDLE_COMPILE_CACHE[_DIR]: route this step's XLA compiles
+        # through the disk-persistent cache too (no-op when unset)
+        from .static.compile_cache import ensure_enabled
+        ensure_enabled()
 
     def _batch_row_axes(self) -> tuple:
         """Mesh axes the batch's leading (row) dims shard over, from

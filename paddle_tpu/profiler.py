@@ -54,6 +54,22 @@ _state = {
 #
 # Fault-tolerance counters (paddle_tpu.fault, io.snapshot,
 # distributed.launch) use the same table:
+# IR pass pipeline + compile cache counters (static/passes.py,
+# static/executor.py, static/compile_cache.py):
+#   ir_ops_before / ir_ops_after  block-0 op counts entering/leaving the
+#                      pass pipeline (cumulative over builds; the delta
+#                      over a bench config is what its row reports)
+#   ir_pass_ms         total pipeline wall-time (ms, float)
+#   ir_vars_dropped    unused VarDescs dropped by the cleanup pass
+#   pass_<name>_removed_ops / pass_<name>_ms  per-pass movement
+#   trace_ms           jit .lower() wall-time (Python trace -> StableHLO)
+#   compile_ms         .compile() wall-time (XLA; a disk-cache hit makes
+#                      this a file read)
+#   disk_cache_hits / disk_cache_misses  jax persistent-compilation-cache
+#                      traffic (PADDLE_COMPILE_CACHE[_DIR]); process
+#                      events, merged into exe.counters like the fault
+#                      counters below
+#
 #   retry_attempts     re-attempts after a retryable failure (Retrier)
 #   retry_giveups      retry budget/deadline exhausted, last error raised
 #   faults_injected    armed fault points fired (tests / PADDLE_FAULT_SPEC)
@@ -72,6 +88,10 @@ FAULT_COUNTER_NAMES = (
     "ckpt_commits", "ckpt_corrupt_skipped", "ckpt_fallbacks",
     "trainer_relaunches",
 )
+
+# process-level compile-cache counters merged into Executor.counters
+# (bumped by the jax monitoring listener in static/compile_cache.py)
+COMPILE_COUNTER_NAMES = ("disk_cache_hits", "disk_cache_misses")
 
 _counters: _Counter = _Counter()
 # prefetch threads bump h2d_bytes concurrently with the training

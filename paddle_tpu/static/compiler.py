@@ -23,9 +23,19 @@ from .ir import Program
 
 
 class BuildStrategy:
-    """Knob parity (reference details/build_strategy.h). Most knobs are
-    no-ops here — XLA does the fusing/scheduling — kept so user code and
-    the fleet facade keep working."""
+    """Knob parity (reference details/build_strategy.h), now WIRED: the
+    graph-rewrite knobs select which IR passes (static/passes.py) run
+    before the Executor traces the program —
+
+      fuse_elewise_add_act_ops  elementwise+activation fusion onto the
+                                fused_elemwise_activation kernel
+      memory_optimize           dead-op elimination + unused-VarDesc drop
+      enable_inplace            identity elision (assign / scale-by-1)
+      constant_folding          all-constant subgraph folding (new)
+      cse                       common-subexpression elimination (new)
+
+    Comm-layout knobs (reduce_strategy, fuse_all_reduce_ops) stay
+    descriptive: XLA's SPMD partitioner owns cross-chip scheduling."""
 
     def __init__(self):
         self.reduce_strategy = "AllReduce"
@@ -33,6 +43,8 @@ class BuildStrategy:
         self.fuse_elewise_add_act_ops = True
         self.memory_optimize = True
         self.enable_inplace = True
+        self.constant_folding = True
+        self.cse = True
         self.num_trainers = 1
         self.trainer_id = 0
 

@@ -13,11 +13,28 @@ backward + optimizer ops) becomes a single device program. The Scope is a
 host-side dict of jax arrays (functional state), not a mutable var tree.
 
 Startup programs run through the same lowering (initializer ops write
-persistables). Compiled executables are cached on (program version, feed
-signature, fetch list) like the reference's ExecutorPrepareContext cache.
+persistables). Before lowering, the block is rewritten by the IR pass
+pipeline (passes.py — dead-op elim, constant folding, CSE, identity
+elision, elementwise+act fusion, gated by BuildStrategy knobs).
+
+Compiled executables are cached CONTENT-ADDRESSED: the key is a sha256
+of (optimized program dict, feed signature, fetch list, state signature,
+sharding, donation), held in a process-global table — so
+Program.clone()/parse_from_string() copies, and a second Executor in the
+same process, all hit the same entry (the reference's
+ExecutorPrepareContext cache was per-executor and identity-keyed). A
+per-program weak-keyed fast path avoids re-hashing on every step. With
+PADDLE_COMPILE_CACHE[_DIR] set, compilation additionally goes through
+jax's disk-persistent cache (compile_cache.py), so a relaunched trainer
+skips the cold compile; the executor AOT-splits jit into lower()
+(trace_ms) and compile() (compile_ms) so both phases are measurable.
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -128,7 +145,10 @@ def run_block(block: Block, env: Dict[str, Any], ctx: ExecContext,
     for i, op in enumerate(block.ops):
         if stop_at is not None and i >= stop_at:
             break
-        ctx.op_index = i
+        # __rng_slot (stamped by passes.py) pins index-keyed random ops
+        # to their pre-rewrite RNG stream: op removal must not shift a
+        # surviving dropout/uniform/gaussian draw
+        ctx.op_index = op.attrs.get("__rng_slot", i)
         # control-flow kernels (cond/while) recurse into sub-blocks and
         # need the program + a snapshot of the enclosing env
         ctx.program = block.program
@@ -158,8 +178,75 @@ def run_block(block: Block, env: Dict[str, Any], ctx: ExecContext,
 
 
 def _feed_signature(feed: Dict[str, np.ndarray]):
-    return tuple(sorted((k, tuple(v.shape), str(v.dtype))
+    # weak_type matters: executables are AOT-compiled, and a weak-typed
+    # jax array has a different input aval than the same shape/dtype
+    # strong-typed one
+    return tuple(sorted((k, tuple(v.shape), str(v.dtype),
+                         bool(getattr(v, "weak_type", False)))
                         for k, v in feed.items()))
+
+
+def _state_signature(state) -> tuple:
+    # weak_type included for the same reason as in _feed_signature: the
+    # executable is AOT-compiled, and a weak-typed scope entry (e.g. a
+    # python-scalar-derived lr) has a different input aval
+    return tuple((tuple(a.shape) if hasattr(a, "shape") else None,
+                  str(getattr(a, "dtype", type(a).__name__)),
+                  bool(getattr(a, "weak_type", False)))
+                 for a in state)
+
+
+def _strategy_signature(strategy) -> tuple:
+    if strategy is None:
+        return ()
+    return tuple(sorted((k, bool(v)) for k, v in vars(strategy).items()
+                        if isinstance(v, bool)))
+
+
+class _ExecEntry:
+    """One content-cache slot: the AOT executable plus the optimized
+    program and pass report that produced it (dump/debug surface)."""
+
+    __slots__ = ("compiled", "optimized_program", "pass_report")
+
+    def __init__(self, compiled, optimized_program, pass_report):
+        self.compiled = compiled
+        self.optimized_program = optimized_program
+        self.pass_report = pass_report
+
+
+# process-global content-addressed executable cache: every Executor in
+# the process shares it, so identical programs (clones, deserialized
+# copies, or a second Executor) never recompile. Bounded LRU — evicted
+# entries release their executables.
+_EXEC_CACHE: "OrderedDict[str, _ExecEntry]" = OrderedDict()
+_EXEC_CACHE_MAX = 128
+
+
+def _exec_cache_get(key: str) -> Optional[_ExecEntry]:
+    entry = _EXEC_CACHE.get(key)
+    if entry is not None:
+        _EXEC_CACHE.move_to_end(key)
+    return entry
+
+
+def _exec_cache_put(key: str, entry: _ExecEntry) -> None:
+    _EXEC_CACHE[key] = entry
+    _EXEC_CACHE.move_to_end(key)
+    while len(_EXEC_CACHE) > _EXEC_CACHE_MAX:
+        _EXEC_CACHE.popitem(last=False)
+
+
+def _content_key(opt_program, feed_sig, fetch_names, persist_names,
+                 state_sig, sharding, donate) -> str:
+    shard_desc = None
+    if sharding:
+        shard_desc = sorted((k, str(v)) for k, v in sharding.items())
+    blob = json.dumps(
+        [opt_program.to_dict(), list(feed_sig), list(fetch_names),
+         list(persist_names), list(state_sig), shard_desc, bool(donate)],
+        sort_keys=True, default=str).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
 
 
 def _nbytes(arr) -> int:
@@ -185,10 +272,14 @@ class Executor:
     def __init__(self, place=None, donate_state: bool = True):
         import weakref
         self.place = place if place is not None else CPUPlace()
-        # per-program compiled cache: entries die with their Program (no
-        # id() aliasing, no pinning of dead programs)
+        # fast path: program object -> {step key -> content hash}. The
+        # executables themselves live in the process-global
+        # content-addressed _EXEC_CACHE; this weak map only avoids
+        # re-running passes + re-hashing on every step.
         self._cache = weakref.WeakKeyDictionary()
         self._step = 0
+        from .compile_cache import ensure_enabled
+        ensure_enabled()  # PADDLE_COMPILE_CACHE[_DIR] disk cache, once
         self._donate = bool(donate_state)
         # per-executor view of the hot-path counters; the module-global
         # aggregate lives in profiler._counters (bench reads that one)
@@ -213,7 +304,8 @@ class Executor:
 
         out = dict(self._counters)
         snap = profiler.counters_snapshot()
-        for name in profiler.FAULT_COUNTER_NAMES:
+        for name in (profiler.FAULT_COUNTER_NAMES
+                     + profiler.COMPILE_COUNTER_NAMES):
             if name in snap:
                 out[name] = snap[name]
         return out
@@ -232,8 +324,10 @@ class Executor:
         from .compiler import CompiledProgram
 
         sharding = None
+        strategy = None
         if isinstance(program, CompiledProgram):
             sharding = program._data_sharding()
+            strategy = program._build_strategy
             program = program._program
         if program is None:
             program = default_main_program()
@@ -261,23 +355,53 @@ class Executor:
         persist_names = sorted(
             n for n, v in block.vars.items()
             if v.persistable and peek(n) is not None)
-        # shape/dtype only — never materialize device arrays for the key
-        key = (program._version, _feed_signature(feed),
-               tuple(fetch_names), tuple(persist_names), bool(sharding))
-        per_prog = self._cache.setdefault(program, {})
-        if not use_program_cache or key not in per_prog:
-            per_prog[key] = self._build(program, block, feed, fetch_names,
-                                        persist_names, sharding)
-            self._bump("compile_cache_misses")
-        else:
-            self._bump("compile_cache_hits")
-        compiled = per_prog[key]
-
-        feed_vals = [feed[k] for k in sorted(feed.keys())]
+        feed_keys = sorted(feed.keys())
+        feed_vals = [feed[k] for k in feed_keys]
         state = self._gather_state(scope, persist_names, feed_vals,
                                    sharding)
         seed = program.random_seed or random_mod.default_generator().initial_seed()
         rng = jax.random.fold_in(random_mod.make_key(seed), self._step)
+        # shape/dtype only — never materialize device arrays for the key
+        feed_sig = _feed_signature(feed)
+        state_sig = _state_signature(state)
+        step_key = (program._version, feed_sig, tuple(fetch_names),
+                    tuple(persist_names), state_sig, bool(sharding),
+                    _strategy_signature(strategy))
+        per_prog = self._cache.setdefault(program, {})
+        entry = None
+        if use_program_cache:
+            ck = per_prog.get(step_key)
+            if ck is not None:
+                entry = _exec_cache_get(ck)
+                if entry is not None:
+                    self._bump("compile_cache_hits")
+        if entry is None:
+            # rewrite the block through the IR pass pipeline, then look
+            # up / build the executable by CONTENT — a cloned or
+            # deserialized copy of a compiled program lands on the same
+            # sha, as does any other Executor in this process
+            from .passes import apply_passes
+
+            opt_program, report = apply_passes(
+                program, feed_keys, fetch_names, strategy)
+            self._record_pass_report(report)
+            ck = _content_key(opt_program, feed_sig, fetch_names,
+                              persist_names, state_sig, sharding,
+                              self._donate)
+            per_prog[step_key] = ck
+            entry = _exec_cache_get(ck) if use_program_cache else None
+            if entry is not None:
+                self._bump("compile_cache_hits")
+            else:
+                compiled_fn = self._build(
+                    opt_program.global_block, feed_keys, fetch_names,
+                    persist_names, sharding, feed_vals, state, rng)
+                entry = _ExecEntry(compiled_fn, opt_program, report)
+                if use_program_cache:
+                    _exec_cache_put(ck, entry)
+                self._bump("compile_cache_misses")
+        compiled = entry.compiled
+
         self._step += 1
         self._bump("executor_steps")
         feed_h2d = sum(_nbytes(v) for v in feed_vals
@@ -340,9 +464,28 @@ class Executor:
             state.append(arr)
         return state
 
-    def _build(self, program, block, feed, fetch_names, persist_names,
-               sharding):
-        feed_keys = sorted(feed.keys())
+    def _record_pass_report(self, report) -> None:
+        """Land the pipeline's per-pass op deltas + wall time in the
+        profiler counters (and this executor's view): ir_ops_before/
+        ir_ops_after, ir_pass_ms, ir_vars_dropped, pass_<name>_*."""
+        self._bump("ir_ops_before", report.ops_before)
+        self._bump("ir_ops_after", report.ops_after)
+        self._bump("ir_pass_ms", round(report.ms, 3))
+        if report.vars_dropped:
+            self._bump("ir_vars_dropped", report.vars_dropped)
+        for s in report.stats:
+            if s.removed:
+                self._bump(f"pass_{s.name}_removed_ops", s.removed)
+            self._bump(f"pass_{s.name}_ms", round(s.ms, 3))
+
+    def _build(self, block, feed_keys, fetch_names, persist_names,
+               sharding, feed_vals, state, rng):
+        """AOT-compile one step: jit -> lower() (trace_ms) -> compile()
+        (compile_ms). The split makes trace vs XLA-compile time
+        measurable, and compile() goes through jax's persistent
+        compilation cache when PADDLE_COMPILE_CACHE[_DIR] is set — a
+        relaunched trainer's cold build becomes a disk read
+        (disk_cache_hits in exe.counters)."""
 
         def step(feed_vals, state, rng):
             env = dict(zip(feed_keys, feed_vals))
@@ -371,7 +514,15 @@ class Executor:
             jit_kwargs["out_shardings"] = (
                 [None] * len(fetch_names),
                 [param_shard] * len(persist_names))
-        return jax.jit(step, **jit_kwargs)
+        jitted = jax.jit(step, **jit_kwargs)
+        t0 = time.perf_counter()
+        lowered = jitted.lower(feed_vals, state, rng)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        self._bump("trace_ms", round((t1 - t0) * 1e3, 3))
+        self._bump("compile_ms", round((t2 - t1) * 1e3, 3))
+        return compiled
 
     # -- dataset-driven training (reference executor.py:1593) -------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

@@ -118,6 +118,16 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                    for v in target_vars]
     pruned = program.clone(for_test=True).prune(feeded_var_names,
                                                 fetch_names)
+    # shrink the blob through the IR pass pipeline (fusion off: saved
+    # artifacts keep canonical op types for tooling/inspection — the
+    # executor re-fuses at load time anyway)
+    from .compiler import BuildStrategy
+    from .passes import apply_passes
+
+    strategy = BuildStrategy()
+    strategy.fuse_elewise_add_act_ops = False
+    pruned, _ = apply_passes(pruned, feeded_var_names, fetch_names,
+                             strategy)
     meta = {"feed_names": list(feeded_var_names),
             "fetch_names": fetch_names}
     blob = {"program": pruned.to_dict(), "meta": meta}

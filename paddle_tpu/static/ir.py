@@ -419,7 +419,12 @@ class Program:
     # pruning (save_inference_model path)
     def prune(self, feed_names: Sequence[str], fetch_names: Sequence[str]):
         """Keep only ops needed to compute fetches from feeds + persistables
-        (reference Program._prune, inference/analysis ir_graph_build)."""
+        (reference Program._prune, inference/analysis ir_graph_build).
+
+        VarDescs no surviving op references are dropped, and sub-blocks
+        reachable only from pruned control-flow ops are emptied (their
+        indices stay stable so surviving sub_block attrs keep resolving)
+        — save_inference_model blobs carry no dead weight."""
         blk = self.global_block
         needed = set(fetch_names)
         kept = []
@@ -431,9 +436,26 @@ class Program:
         p = Program.from_dict(self.to_dict())
         nb = p.global_block
         nb.ops = [OpDesc.from_dict(o.to_dict()) for o in kept]
+        # drop sub-blocks only pruned ops referenced (dead While/cond
+        # branches used to ride along whole into the inference blob)
+        reachable = {0}
+        frontier = [nb]
+        while frontier:
+            b = frontier.pop()
+            for op in b.ops:
+                for key in ("sub_block", "sub_block_t", "sub_block_f"):
+                    idx = op.attrs.get(key)
+                    if isinstance(idx, int) and idx not in reachable:
+                        reachable.add(idx)
+                        frontier.append(p.blocks[idx])
+        for b in p.blocks:
+            if b.idx not in reachable:
+                b.ops = []
+                b.vars = {}
         used = set(feed_names) | set(fetch_names)
-        for op in nb.ops:
-            used |= set(op.input_names()) | set(op.output_names())
+        for b in p.blocks:
+            for op in b.ops:
+                used |= set(op.input_names()) | set(op.output_names())
         nb.vars = {k: v for k, v in nb.vars.items() if k in used}
         return p
 

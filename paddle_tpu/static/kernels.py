@@ -268,6 +268,23 @@ def _fake_quantize_dequantize_abs_max(ins, attrs, ctx):
     return {"Out": [out], "OutScale": [scale]}
 
 
+@kernel("fused_elemwise_activation")
+def _fused_elemwise_activation(ins, attrs, ctx):
+    """Fused binary-elementwise + activation (reference
+    operators/fused/fused_elemwise_activation_op.cc, emitted by
+    fuse_elewise_add_act_pass). The IR fusion pass (static/passes.py)
+    lowers matched elementwise->act chains onto this kernel; it
+    delegates to the registered component kernels so the math stays
+    bit-identical to the unfused pair."""
+    functors = attrs["functor_list"]
+    binary_t, act_t = functors[0], functors[1]
+    mid = KERNELS[binary_t]({"X": ins["X"], "Y": ins["Y"]},
+                            {"axis": attrs.get("axis", -1)}, ctx)["Out"]
+    out = KERNELS[act_t]({"X": mid}, dict(attrs.get("act_attrs") or {}),
+                         ctx)["Out"]
+    return {"Out": out}
+
+
 # ---------------------------------------------------------------------------
 # matmul / fc (reference operators/matmul_op.cc, mul_op.cc, math/fc.cc)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,567 @@
+"""Program-IR optimization pass pipeline.
+
+TPU-native counterpart of the reference's 89 hand-written IR passes
+(/root/reference/paddle/fluid/framework/ir/: graph_pattern_detector.cc,
+fuse_elewise_add_act_pass.cc, constant_folding, memory_optimize_pass,
+build_strategy.cc wiring). The reference rewrites an SSA ir::Graph before
+ParallelExecutor interprets it; here the rewrites happen on the thin
+Program IR before the Executor traces it into ONE jit function — XLA
+still does instruction-level fusion afterwards, so these passes exist to
+shrink what the *Python trace* and the resulting HLO have to chew on
+(trace time, HLO size, compile time) and to hit the hand-fused kernels
+in kernels.py directly.
+
+Passes (BuildStrategy knob in parentheses):
+  constant_folding       (strategy.constant_folding)   all-constant
+      subgraphs — fill_constant / shape-arithmetic chains — evaluated
+      once at build and re-materialized as single constant ops
+  elide_identities       (strategy.enable_inplace)     assign and
+      scale(scale=1, bias=0) ops dropped, consumers rewired
+  cse                    (strategy.cse)                duplicate OpDescs
+      (same type+inputs+attrs) merged, later consumers rewired
+  fuse_elemwise_act      (strategy.fuse_elewise_add_act_ops)
+      elementwise binary -> activation chains lowered onto the
+      fused_elemwise_activation kernel (kernels.py)
+  dead_code_elimination  (strategy.memory_optimize)    ops whose outputs
+      reach no fetch / persistable / sub-block read
+  drop_unused_vars       (strategy.memory_optimize)    VarDescs no
+      surviving op references (blob/content-hash shrink)
+
+Safety invariants (why rewrites stay bitwise-exact):
+- Random ops whose kernels fold ``op_index`` into their key (dropout,
+  *_random) are stamped with ``__rng_slot`` = their pre-pass index, and
+  run_block uses the stamp, so removals never shift a surviving op's RNG
+  stream. Random ops are excluded from folding/CSE (two dropouts must
+  draw independent masks).
+- Names read anywhere inside sub-blocks are protected: cond/while
+  kernels snapshot the whole enclosing env, so sub-block reads are
+  invisible to block-0 def-use chains.
+- A ``backward`` op re-traces the prefix of the (rewritten) block, so
+  its implicit dependencies are exactly the surviving ops — removing an
+  op that doesn't reach the loss/fetches/state is safe, reordering is
+  not (no pass reorders).
+- This IR permits name reassignment (e.g. legacy_flow's assign-into-
+  loop-var); every renaming pass walks forward and kills an alias the
+  moment the original name is redefined.
+
+All passes run on a CLONE — the user's Program is never mutated. Set
+``PADDLE_IR_PASSES=0`` to disable the whole pipeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from .ir import OpDesc, Program, _attrs_to_json
+
+# ops whose kernels fold ctx.op_index into their RNG key (kernels.py
+# ctx.key() users) — these get a stable __rng_slot stamp
+_INDEXED_RNG_OPS = {"gaussian_random", "uniform_random",
+                    "truncated_gaussian_random", "dropout"}
+
+_SIDE_EFFECT_OPS = {"feed", "fetch", "read", "py_func", "print", "assert",
+                    "backward"}
+_CONTROL_FLOW_OPS = {"cond", "while"}
+_ARRAY_OPS = {"create_array", "array_write", "array_read", "array_length",
+              "tensor_array_to_tensor"}
+
+# attrs that reference other blocks by index (cond/while)
+_SUB_BLOCK_ATTRS = ("sub_block", "sub_block_t", "sub_block_f")
+
+_FOLD_MAX_ELEMS = 1 << 16
+
+_FUSABLE_BINARY = {"elementwise_add", "elementwise_sub", "elementwise_mul",
+                   "elementwise_div", "elementwise_max", "elementwise_min"}
+_FUSABLE_ACTS = {"relu", "sigmoid", "tanh", "gelu", "leaky_relu",
+                 "softplus", "softsign", "swish", "square", "sqrt", "exp"}
+
+_FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}
+
+
+def _is_random(op_type: str) -> bool:
+    """Any kernel that draws from the RNG stream (explicit set plus a
+    defensive substring net for delegate-registered random ops like
+    uniform_random_s2 / sampling_id_s / sampled_*)."""
+    return (op_type in _INDEXED_RNG_OPS or "random" in op_type
+            or "dropout" in op_type or "sampl" in op_type)
+
+
+def _rewrite_unsafe(op_type: str) -> bool:
+    return (op_type in _SIDE_EFFECT_OPS or op_type in _CONTROL_FLOW_OPS
+            or _is_random(op_type))
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+@dataclass
+class PassStat:
+    name: str
+    ops_before: int
+    ops_after: int
+    ms: float
+    vars_dropped: int = 0
+
+    @property
+    def removed(self) -> int:
+        return self.ops_before - self.ops_after
+
+
+@dataclass
+class PassReport:
+    """What the pipeline did to one program: per-pass stats + totals."""
+    stats: List[PassStat] = field(default_factory=list)
+    ops_before: int = 0
+    ops_after: int = 0
+    ms: float = 0.0
+    vars_dropped: int = 0
+
+    @property
+    def removed(self) -> int:
+        return self.ops_before - self.ops_after
+
+    def table(self) -> str:
+        """Aligned text table (tools/dump_passes.py output)."""
+        lines = [f"{'Pass':<24}{'ops before':>12}{'ops after':>12}"
+                 f"{'removed':>10}{'ms':>10}"]
+        for s in self.stats:
+            lines.append(f"{s.name:<24}{s.ops_before:>12}{s.ops_after:>12}"
+                         f"{s.removed:>10}{s.ms:>10.2f}")
+        lines.append(f"{'TOTAL':<24}{self.ops_before:>12}"
+                     f"{self.ops_after:>12}{self.removed:>10}"
+                     f"{self.ms:>10.2f}")
+        if self.vars_dropped:
+            lines.append(f"(+ {self.vars_dropped} unused VarDescs dropped)")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# pass context
+# ---------------------------------------------------------------------------
+class _Ctx:
+    def __init__(self, program: Program, feeds: Set[str],
+                 fetches: Set[str]):
+        self.program = program
+        self.block = program.global_block
+        self.feeds = set(feeds)
+        self.fetches = set(fetches)
+        self.persistable = {n for n, v in self.block.vars.items()
+                            if v.persistable}
+        self.data = {n for n, v in self.block.vars.items() if v.is_data}
+        self.sub_reads = _sub_block_names(program)
+        # names no rewrite may alias away: the executor (fetch/state/feed)
+        # or a sub-block trace reads them by name
+        self.protected = (self.feeds | self.fetches | self.persistable
+                          | self.data | self.sub_reads)
+
+
+def _sub_block_names(program: Program) -> Set[str]:
+    """Every name referenced inside blocks[1:] or by control-flow attrs.
+    cond/while kernels snapshot the WHOLE outer env, so any of these may
+    be read by a sub-block trace regardless of block-0 def-use edges."""
+    names: Set[str] = set()
+    for blk in program.blocks[1:]:
+        for op in blk.ops:
+            names.update(op.input_names())
+            names.update(op.output_names())
+    for blk in program.blocks:
+        for op in blk.ops:
+            for key in ("loop_in", "body_out", "out_t", "out_f"):
+                v = op.attrs.get(key)
+                if isinstance(v, (list, tuple)):
+                    names.update(str(n) for n in v)
+            v = op.attrs.get("cond_out")
+            if isinstance(v, str):
+                names.add(v)
+    return names
+
+
+def _stamp_rng_slots(block) -> None:
+    """Pin index-keyed RNG ops to their pre-pass stream so later
+    removals can't shift a surviving op's random draw (bitwise parity
+    between passes-on and passes-off)."""
+    for i, op in enumerate(block.ops):
+        if op.type in _INDEXED_RNG_OPS and "__rng_slot" not in op.attrs:
+            op.attrs["__rng_slot"] = i
+
+
+# ---------------------------------------------------------------------------
+# constant folding
+# ---------------------------------------------------------------------------
+def _pass_constant_folding(ctx: _Ctx) -> None:
+    from .kernels import KERNELS, ExecContext
+
+    block = ctx.block
+    const_env: Dict[str, np.ndarray] = {}
+    fold_vals: Dict[int, Dict[str, np.ndarray]] = {}
+
+    def _invalidate(op):
+        for n in op.output_names():
+            const_env.pop(n, None)
+
+    for i, op in enumerate(block.ops):
+        if (_rewrite_unsafe(op.type) or op.type in _ARRAY_OPS
+                or any(n in ctx.protected for n in op.output_names())):
+            _invalidate(op)
+            continue
+        fn = KERNELS.get(op.type)
+        in_names = op.input_names()
+        is_source = op.type in ("fill_constant", "assign_value") \
+            and not in_names
+        if fn is None or not (
+                is_source or (in_names
+                              and all(n in const_env for n in in_names))):
+            _invalidate(op)
+            continue
+        try:
+            ins = {slot: [const_env[n] for n in names]
+                   for slot, names in op.inputs.items()}
+            outs = fn(ins, op.attrs, ExecContext(rng_key=None))
+            vals = {}
+            for slot, names in op.outputs.items():
+                produced = outs.get(slot)
+                if produced is None or len(produced) != len(names):
+                    raise ValueError("slot mismatch")
+                for n, v in zip(names, produced):
+                    arr = np.asarray(v)
+                    if arr.size > _FOLD_MAX_ELEMS:
+                        raise ValueError("too large to fold")
+                    vals[n] = arr
+        except Exception:
+            _invalidate(op)
+            continue
+        fold_vals[i] = vals
+        const_env.update(vals)
+
+    if not fold_vals:
+        return
+    # a const needs materialization if a surviving op or a fetch reads it
+    needed: Set[str] = set(ctx.fetches)
+    consumed: Set[str] = set()
+    for i, op in enumerate(block.ops):
+        if i not in fold_vals:
+            consumed.update(op.input_names())
+    needed = (needed | consumed) & {n for vs in fold_vals.values()
+                                    for n in vs}
+    new_ops = []
+    for i, op in enumerate(block.ops):
+        if i not in fold_vals:
+            new_ops.append(op)
+            continue
+        for slot, names in op.outputs.items():
+            for n in names:
+                if n in needed:
+                    new_ops.append(_materialize_const(n, fold_vals[i][n]))
+    block.ops = new_ops
+
+
+def _materialize_const(name: str, arr: np.ndarray) -> OpDesc:
+    dtype = dtype_mod.dtype_name(dtype_mod.convert_dtype(str(arr.dtype)))
+    if arr.size and (arr == arr.flat[0]).all():
+        val = arr.flat[0]
+        val = bool(val) if arr.dtype == np.bool_ else (
+            int(val) if np.issubdtype(arr.dtype, np.integer) else float(val))
+        return OpDesc("fill_constant", {}, {"Out": [name]},
+                      {"shape": [int(s) for s in arr.shape],
+                       "dtype": dtype, "value": val})
+    return OpDesc("assign_value", {}, {"Out": [name]},
+                  {"values": arr.ravel().tolist(),
+                   "shape": [int(s) for s in arr.shape], "dtype": dtype})
+
+
+# ---------------------------------------------------------------------------
+# identity elision
+# ---------------------------------------------------------------------------
+def _identity_source(op, block) -> Optional[str]:
+    """Name this op's Out is a bit-exact alias of, or None."""
+    if op.type == "assign":
+        return (op.inputs.get("X") or [None])[0]
+    if op.type == "scale" \
+            and op.attrs.get("scale", 1.0) == 1.0 \
+            and op.attrs.get("bias", 0.0) == 0.0:
+        # x*1.0+0.0 promotes int arrays to float — only elide when the
+        # input is declared floating
+        src = (op.inputs.get("X") or [None])[0]
+        desc = block.vars.get(src) if src else None
+        if desc is not None and desc.dtype in _FLOAT_DTYPES:
+            return src
+    return None
+
+
+def _def_counts(ctx: _Ctx) -> Dict[str, int]:
+    """Definitions per name: op writes plus one implicit def for names
+    the executor seeds into the env (feeds and scope-resident
+    persistables). A name with >1 defs is reassigned somewhere — no
+    rewrite may alias through it, because an alias captures the value
+    at ONE point in time while the name's value changes."""
+    counts: Dict[str, int] = defaultdict(int)
+    for n in ctx.feeds | ctx.persistable:
+        counts[n] += 1
+    for op in ctx.block.ops:
+        for n in op.output_names():
+            counts[n] += 1
+    return counts
+
+
+def _pass_elide_identities(ctx: _Ctx) -> None:
+    block = ctx.block
+    defs = _def_counts(ctx)
+    rename: Dict[str, str] = {}
+    rev: Dict[str, Set[str]] = defaultdict(set)  # source -> aliases of it
+
+    def res(n):
+        while n in rename:
+            n = rename[n]
+        return n
+
+    new_ops = []
+    for op in block.ops:
+        op.inputs = {s: [res(n) for n in names]
+                     for s, names in op.inputs.items()}
+        src = _identity_source(op, block)
+        out = (op.outputs.get("Out") or [None])[0]
+        if (src is not None and out is not None
+                and out not in ctx.protected
+                and defs.get(src, 0) <= 1):
+            # single-def source: the alias is valid for the rest of the
+            # block. A reassigned source would leave later readers of
+            # `out` pointing at the WRONG (new) value — keep the op.
+            if out != src:
+                rename[out] = src
+                rev[src].add(out)
+            continue
+        new_ops.append(op)
+        for n in op.output_names():
+            # redefinition kills aliases OF this name and (belt &
+            # braces — unreachable under the single-def guard) aliases
+            # pointing at it
+            rename.pop(n, None)
+            for alias in rev.pop(n, ()):
+                rename.pop(alias, None)
+    block.ops = new_ops
+
+
+# ---------------------------------------------------------------------------
+# common-subexpression elimination
+# ---------------------------------------------------------------------------
+def _pass_cse(ctx: _Ctx) -> None:
+    block = ctx.block
+    rename: Dict[str, str] = {}
+    seen: Dict[str, OpDesc] = {}
+    uses: Dict[str, Set[str]] = defaultdict(set)  # name -> keys touching it
+    # Merging a duplicate UPSTREAM of a backward op restructures vjp
+    # cotangent accumulation (two gradient paths collapse into one
+    # doubled path) — mathematically equal, bitwise different. XLA owns
+    # training-graph CSE; source-level CSE only merges past the last
+    # backward op (and everywhere on inference programs), keeping the
+    # passes-on/off bitwise-parity gate exact.
+    last_bwd = max((i for i, op in enumerate(block.ops)
+                    if op.type == "backward"), default=-1)
+    defs = _def_counts(ctx)
+
+    def res(n):
+        while n in rename:
+            n = rename[n]
+        return n
+
+    def _kill(name):
+        rename.pop(name, None)
+        for key in uses.pop(name, ()):
+            seen.pop(key, None)
+
+    new_ops = []
+    for i, op in enumerate(block.ops):
+        op.inputs = {s: [res(n) for n in names]
+                     for s, names in op.inputs.items()}
+        outs = op.output_names()
+        mergeable = (i > last_bwd and not _rewrite_unsafe(op.type)
+                     and outs
+                     and not any(n in ctx.protected for n in outs))
+        key = None
+        if mergeable:
+            key = json.dumps(
+                [op.type,
+                 sorted((s, ns) for s, ns in op.inputs.items()),
+                 sorted(_attrs_to_json(op.attrs).items())],
+                sort_keys=True, default=str)
+            prev = seen.get(key)
+            # merging aliases this op's outputs to prev's — only valid
+            # when prev's outputs are single-def (a later reassignment
+            # of a prev output would redirect the alias to the WRONG
+            # value; see _def_counts)
+            if prev is not None and all(
+                    s in prev.outputs
+                    and len(prev.outputs[s]) == len(ns)
+                    and all(defs.get(pn, 0) <= 1
+                            for pn in prev.outputs[s])
+                    for s, ns in op.outputs.items()):
+                for s, ns in op.outputs.items():
+                    for n, pn in zip(ns, prev.outputs[s]):
+                        if n != pn:
+                            rename[n] = pn
+                continue
+        new_ops.append(op)
+        # this op redefines its outputs: invalidate aliases and any
+        # cached exprs reading/producing those names FIRST, then record
+        # the op itself (its own entry must survive the kill)
+        for n in op.output_names():
+            _kill(n)
+        if key is not None:
+            seen[key] = op
+            for n in set(op.input_names()) | set(outs):
+                uses[n].add(key)
+    block.ops = new_ops
+
+
+# ---------------------------------------------------------------------------
+# elementwise + activation fusion
+# ---------------------------------------------------------------------------
+def _pass_fuse_elemwise_act(ctx: _Ctx) -> None:
+    block = ctx.block
+    ops = block.ops
+    readers: Dict[str, List[int]] = defaultdict(list)
+    writers: Dict[str, List[int]] = defaultdict(list)
+    for i, op in enumerate(ops):
+        for n in op.input_names():
+            readers[n].append(i)
+        for n in op.output_names():
+            writers[n].append(i)
+    drop: Set[int] = set()
+    for i, op in enumerate(ops):
+        if op.type not in _FUSABLE_BINARY or i in drop:
+            continue
+        out = (op.outputs.get("Out") or [None])[0]
+        if (out is None or out in ctx.protected
+                or len(writers.get(out, ())) != 1
+                or len(readers.get(out, ())) != 1):
+            continue
+        j = readers[out][0]
+        if j <= i or j in drop:
+            continue
+        act = ops[j]
+        if (act.type not in _FUSABLE_ACTS
+                or act.inputs.get("X") != [out]
+                or len(act.input_names()) != 1):
+            continue
+        act_out = (act.outputs.get("Out") or [None])[0]
+        if act_out is None or len(writers.get(act_out, ())) != 1:
+            continue
+        # fusing moves the act_out write from j up to i; if act_out is
+        # env-seeded (feed/persistable), a reader before j meant the
+        # seeded value — don't move the write past it
+        if act_out in (ctx.feeds | ctx.persistable) and any(
+                k < j for k in readers.get(act_out, ())):
+            continue
+        act_attrs = {k: v for k, v in act.attrs.items()
+                     if k != "__rng_slot"}
+        ops[i] = OpDesc(
+            "fused_elemwise_activation",
+            inputs={"X": op.inputs["X"], "Y": op.inputs["Y"]},
+            outputs={"Out": [act_out]},
+            attrs={"functor_list": [op.type, act.type],
+                   "axis": op.attrs.get("axis", -1),
+                   "act_attrs": act_attrs})
+        drop.add(j)
+    if drop:
+        block.ops = [op for k, op in enumerate(ops) if k not in drop]
+
+
+# ---------------------------------------------------------------------------
+# dead-code elimination + var-table cleanup
+# ---------------------------------------------------------------------------
+def _pass_dce(ctx: _Ctx) -> None:
+    block = ctx.block
+    live = set(ctx.fetches) | ctx.persistable | ctx.sub_reads
+    keep: List[OpDesc] = []
+    for op in reversed(block.ops):
+        if op.type in _SIDE_EFFECT_OPS or set(op.output_names()) & live:
+            keep.append(op)
+            live |= set(op.input_names())
+            # NOTE: defs are not killed — this IR allows name
+            # reassignment, so earlier writers stay conservatively live
+    keep.reverse()
+    block.ops = keep
+
+
+def _pass_drop_unused_vars(ctx: _Ctx) -> int:
+    referenced = set(ctx.feeds) | set(ctx.fetches) | ctx.sub_reads
+    for blk in ctx.program.blocks:
+        for op in blk.ops:
+            referenced.update(op.input_names())
+            referenced.update(op.output_names())
+    blk0 = ctx.block
+    before = len(blk0.vars)
+    blk0.vars = {n: v for n, v in blk0.vars.items()
+                 if n in referenced or v.persistable or v.is_data}
+    return before - len(blk0.vars)
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+# (name, BuildStrategy knob, fn) — run order matters: fold first so CSE
+# sees canonical constants, elide/cse before fusion so fusion matches the
+# slimmed chains, DCE last to sweep newly-orphaned producers
+_PIPELINE = (
+    ("constant_folding", "constant_folding", _pass_constant_folding),
+    ("elide_identities", "enable_inplace", _pass_elide_identities),
+    ("cse", "cse", _pass_cse),
+    ("fuse_elemwise_act", "fuse_elewise_add_act_ops",
+     _pass_fuse_elemwise_act),
+    ("dead_code_elimination", "memory_optimize", _pass_dce),
+)
+
+
+def pass_names() -> List[str]:
+    return [name for name, _, _ in _PIPELINE] + ["drop_unused_vars"]
+
+
+def apply_passes(program: Program, feed_names: Sequence[str],
+                 fetch_names: Sequence[str], strategy=None):
+    """Run the enabled passes over a CLONE of ``program`` and return
+    ``(optimized_program, PassReport)``.
+
+    ``strategy`` is a compiler.BuildStrategy (defaults to all knobs on);
+    ``PADDLE_IR_PASSES=0`` disables the pipeline entirely (the original
+    program is returned untouched).
+    """
+    from .compiler import BuildStrategy
+
+    strategy = strategy or BuildStrategy()
+    n0 = len(program.global_block.ops)
+    enabled = [(name, fn) for name, knob, fn in _PIPELINE
+               if getattr(strategy, knob, True)]
+    if os.environ.get("PADDLE_IR_PASSES") == "0" or not enabled:
+        return program, PassReport([], n0, n0, 0.0)
+
+    t_all = time.perf_counter()
+    opt = Program.from_dict(program.to_dict())
+    opt.random_seed = program.random_seed
+    ctx = _Ctx(opt, set(feed_names), set(fetch_names))
+    _stamp_rng_slots(opt.global_block)
+    stats: List[PassStat] = []
+    for name, fn in enabled:
+        before = len(opt.global_block.ops)
+        t0 = time.perf_counter()
+        fn(ctx)
+        ms = (time.perf_counter() - t0) * 1e3
+        stats.append(PassStat(name, before, len(opt.global_block.ops), ms))
+    vars_dropped = 0
+    if getattr(strategy, "memory_optimize", True):
+        n = len(opt.global_block.ops)
+        t0 = time.perf_counter()
+        vars_dropped = _pass_drop_unused_vars(ctx)
+        stats.append(PassStat("drop_unused_vars", n, n,
+                              (time.perf_counter() - t0) * 1e3,
+                              vars_dropped=vars_dropped))
+    total_ms = (time.perf_counter() - t_all) * 1e3
+    report = PassReport(stats, n0, len(opt.global_block.ops), total_ms,
+                        vars_dropped)
+    return opt, report

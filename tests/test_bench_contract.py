@@ -130,6 +130,17 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert rows[0].get("placeholder") is True
     # provenance: no driver-captured baseline exists yet, so no ratio
     assert last.get("baseline_provenance") in ("none", None)
+    # IR pass pipeline contract: the bert row carries the static-graph
+    # probe's op-count reduction (bitwise-parity-gated), the AOT
+    # trace/compile split, and the disk-cache counter
+    for key in ("ops_before", "ops_after", "trace_ms", "compile_ms",
+                "disk_cache_hits"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["ops_after"] < last["ops_before"], last
+    assert last.get("pass_parity_bitwise") is True, last
+    assert last.get("exec_cache_shared_hit") is True, last
+    # no PADDLE_COMPILE_CACHE_DIR in this run -> no disk traffic
+    assert last["disk_cache_hits"] == 0
 
 
 @pytest.mark.slow

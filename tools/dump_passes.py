@@ -1,0 +1,130 @@
+"""Per-pass op-count / timing table for a static Program.
+
+The CLI face of static/passes.py (reference: the --print_ir flavor of
+build_strategy + graph_viz_pass): run the IR pass pipeline over a
+program and print what each pass removed and how long it took, without
+executing anything.
+
+Usage:
+    # serialized program (static.save_program output, e.g. the
+    # main_program file save_train_program writes)
+    python tools/dump_passes.py path/to/main_program --fetch loss_name
+
+    # save_inference_model directory (feed/fetch read from the blob)
+    python tools/dump_passes.py path/to/inference_dir
+
+    # built-in demo program (no artifact needed)
+    python tools/dump_passes.py --demo
+
+    # graphviz dump of the optimized block, viz.py style
+    python tools/dump_passes.py --demo --dot /tmp/optimized.dot
+
+Knobs off by name: --disable fuse_elewise_add_act_ops,cse
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _demo_program():
+    """A small training program with food for every pass (the same
+    shape bench.py's _static_pass_probe measures)."""
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 16])
+        label = static.data("label", [-1, 1], dtype="int64")
+        h = static.nn.fc(x, 32, act="relu")
+        h = static.scale(h, scale=1.0)
+        a = static.reduce_mean(h, dim=[1], keep_dim=True)
+        b = static.reduce_mean(h, dim=[1], keep_dim=True)
+        h = static.elementwise_add(static.elementwise_sub(h, a),
+                                   static.elementwise_sub(h, b))
+        c = static.elementwise_mul(
+            static.fill_constant([1], "float32", 0.5),
+            static.fill_constant([1], "float32", 4.0))
+        h = static.elementwise_mul(h, c)
+        static.nn.fc(h, 8)  # dead branch
+        logits = static.nn.fc(h, 4)
+        loss = static.mean(
+            static.softmax_with_cross_entropy(logits, label))
+        static.SGD(0.01).minimize(loss)
+    return main, ["x", "label"], [loss.name]
+
+
+def _load_target(path):
+    """Resolve (program, feeds, fetches) from a serialized program file
+    or a save_inference_model directory."""
+    import paddle_tpu.static as static
+
+    if os.path.isdir(path):
+        from paddle_tpu.io.serialization import _load_pickle
+
+        blob = _load_pickle(os.path.join(path, "__model__"))
+        program = static.Program.from_dict(blob["program"])
+        meta = blob["meta"]
+        return program, meta["feed_names"], meta["fetch_names"]
+    program = static.load_program(path)
+    return program, [], []
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="print per-pass op-count/timing table for a program")
+    ap.add_argument("target", nargs="?",
+                    help="serialized program file or inference-model dir")
+    ap.add_argument("--demo", action="store_true",
+                    help="use a built-in demo program")
+    ap.add_argument("--feed", default=None,
+                    help="comma-separated feed names (override)")
+    ap.add_argument("--fetch", default=None,
+                    help="comma-separated fetch names (override)")
+    ap.add_argument("--disable", default=None,
+                    help="comma-separated BuildStrategy knobs to turn off")
+    ap.add_argument("--dot", default=None,
+                    help="write the optimized block as graphviz dot")
+    args = ap.parse_args()
+
+    import paddle_tpu.static as static
+
+    if args.demo or not args.target:
+        program, feeds, fetches = _demo_program()
+    else:
+        program, feeds, fetches = _load_target(args.target)
+    if args.feed:
+        feeds = [s for s in args.feed.split(",") if s]
+    if args.fetch:
+        fetches = [s for s in args.fetch.split(",") if s]
+    if not fetches:
+        # default: every leaf output (no consumer) of the global block
+        blk = program.global_block
+        consumed = {n for op in blk.ops for n in op.input_names()}
+        fetches = sorted({n for op in blk.ops for n in op.output_names()}
+                         - consumed)
+        print(f"(no --fetch given; using leaf outputs: {fetches})",
+              file=sys.stderr)
+
+    strategy = static.BuildStrategy()
+    for knob in (args.disable or "").split(","):
+        knob = knob.strip()
+        if knob:
+            if not hasattr(strategy, knob):
+                ap.error(f"unknown BuildStrategy knob {knob!r}")
+            setattr(strategy, knob, False)
+
+    optimized, report = static.apply_passes(program, feeds, fetches,
+                                            strategy)
+    print(report.table())
+    if args.dot:
+        static.save_dot(optimized, args.dot)
+        print(f"optimized block dot -> {args.dot}")
+
+
+if __name__ == "__main__":
+    main()
