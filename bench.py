@@ -211,6 +211,84 @@ def _static_pass_probe(steps=3):
     }
 
 
+def _amp_probe(steps=4):
+    """Static-graph bf16 mixed-precision probe (auto_mixed_precision
+    pass): run the same mini-encoder amp-OFF (f32) and amp-ON (bf16,
+    O1, master weights) from identical init, with a FLOAT feed so the
+    low-precision feed path shows up in h2d_bytes. Reports tokens/s for
+    both legs, the first-step loss delta (pure forward roundoff — the
+    post-update trajectories compound, so step 1 is the stable
+    comparison), the cast counters, and the h2d byte drop.
+
+    Fixed small shapes: like _static_pass_probe, this measures the
+    graph-level machinery, not throughput."""
+    import time as _time
+
+    import paddle_tpu.static as static
+
+    H, FF, S, B = 64, 128, 16, 8
+
+    def build():
+        main, startup = static.Program(), static.Program()
+        main.random_seed = startup.random_seed = 4321
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, S, H])
+            label = static.data("label", [-1, 1], dtype="int64")
+            h = static.nn.fc(x, FF, num_flatten_dims=2, act="relu")
+            h = static.nn.fc(h, H, num_flatten_dims=2)
+            pooled = static.reduce_mean(h, dim=[1])
+            logits = static.nn.fc(pooled, 4)
+            loss = static.mean(
+                static.softmax_with_cross_entropy(logits, label))
+            static.SGD(0.01).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(B, S, H).astype(np.float32),
+            "label": rng.randint(0, 4, (B, 1)).astype(np.int64)}
+    legs = {}
+    # env beats strategy: pin every override that could silently turn a
+    # leg into the other config (inherited PADDLE_AMP flips the off leg
+    # low; PADDLE_IR_PASSES=0 / PADDLE_AMP_LEVEL would defang the on leg)
+    _PIN = ("PADDLE_AMP", "PADDLE_IR_PASSES", "PADDLE_AMP_LEVEL")
+    saved_env = {k: os.environ.pop(k) for k in _PIN if k in os.environ}
+    try:
+        for mode in ("off", "on"):
+            bs = static.BuildStrategy()
+            bs.amp = mode == "on"
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                main, startup, loss = build()
+                exe = static.Executor()
+                exe.run(startup)
+                cp = static.CompiledProgram(main, build_strategy=bs)
+                first = float(np.ravel(
+                    exe.run(cp, feed=feed, fetch_list=[loss])[0])[0])
+                t0 = _time.perf_counter()
+                for _ in range(steps):
+                    exe.run(cp, feed=feed, fetch_list=[loss])
+                dt = _time.perf_counter() - t0
+                legs[mode] = {"first": first, "dt": dt,
+                              "counters": dict(exe.counters)}
+    finally:
+        os.environ.update(saved_env)
+    off, on = legs["off"], legs["on"]
+    tokens = B * S * steps
+    denom = max(abs(off["first"]), 1e-8)
+    oc = on["counters"]
+    return {
+        "amp_tokens_per_sec": round(tokens / on["dt"], 2),
+        "amp_f32_tokens_per_sec": round(tokens / off["dt"], 2),
+        "amp_loss_delta": round(abs(on["first"] - off["first"]) / denom, 6),
+        "amp_casts_inserted": int(oc.get("amp_casts_inserted", 0)),
+        "amp_casts_elided": int(oc.get("amp_casts_elided", 0)),
+        "amp_ops_lowprec": int(oc.get("amp_ops_lowprec", 0)),
+        "amp_master_params": int(oc.get("amp_master_params", 0)),
+        "amp_h2d_bytes": int(oc.get("h2d_bytes", 0)),
+        "amp_f32_h2d_bytes": int(off["counters"].get("h2d_bytes", 0)),
+    }
+
+
 def bench_bert(seq=128, smoke=False, trend=False):
     """BASELINE.md config 3: BERT-base pretraining, tokens/sec/chip.
 
@@ -317,8 +395,15 @@ def bench_bert(seq=128, smoke=False, trend=False):
         pass_probe = _static_pass_probe()
     except Exception as e:
         pass_probe = {"pass_probe_error": f"{type(e).__name__}: {e}"}
+    # bf16 mixed-precision probe: amp-off vs amp-on tokens/s + loss
+    # delta + cast counters + the low-precision-feed h2d drop
+    try:
+        amp_probe = _amp_probe()
+    except Exception as e:
+        amp_probe = {"amp_probe_error": f"{type(e).__name__}: {e}"}
     return {
         **pass_probe,
+        **amp_probe,
         "value": tokens / dt, "unit": "tokens/s",
         "flops_per_step": flops_per_step,
         "steps_per_sec": steps / dt, "dt": dt, "steps": steps,

@@ -174,11 +174,58 @@ AmpScaler = GradScaler
 
 def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
-    """paddle.amp.decorate parity: O2 casts layer params to bf16."""
-    if level == "O2" and models is not None:
-        targets = models if isinstance(models, (list, tuple)) else [models]
+    """paddle.amp.decorate parity.
+
+    O2 casts layer params to ``dtype`` (bf16/fp16 compute).
+    ``master_weight`` (default on under O2, pass False to opt out)
+    flips the optimizers to multi-precision: each low-precision param
+    keeps an f32 master copy in its optimizer slot, the update rule runs
+    in f32, and the compute param receives the cast-down of the master —
+    so repeated tiny updates don't vanish into bf16 rounding.
+    ``save_dtype`` pins ``model.state_dict()`` output to that dtype
+    regardless of the live compute precision (checkpoint portability).
+    """
+    targets = [] if models is None else (
+        list(models) if isinstance(models, (list, tuple)) else [models])
+    opts = [] if optimizers is None else (
+        list(optimizers) if isinstance(optimizers, (list, tuple))
+        else [optimizers])
+    if level == "O2":
+        want_masters = master_weight is None or master_weight
+        # snapshot the f32 params BEFORE the cast: the master must carry
+        # the full-precision bits, not a bf16 round trip
+        masters = {}
+        if want_masters:
+            for m in targets:
+                for p in m.parameters():
+                    if jnp.issubdtype(p.value.dtype, jnp.floating):
+                        masters[id(p)] = p.value.astype(jnp.float32)
         for m in targets:
             m.to(dtype=dtype)
+        if want_masters:
+            for o in opts:
+                if not hasattr(o, "_multi_precision"):
+                    continue
+                o._multi_precision = True
+                # a cached jitted update traced the master-less slot
+                # structure — retrace
+                o._jit_update = None
+                # upgrade slots that already exist (warmed-up optimizer
+                # or restored checkpoint) and pre-seed the rest, so the
+                # first post-decorate step takes the master path instead
+                # of silently promoting the param back to f32
+                for p in (o._parameter_list or []):
+                    master = masters.get(id(p))
+                    if master is None:
+                        continue
+                    slot = o._slots.get(id(p))
+                    if slot is None:
+                        slot = dict(o.init_slot(master))
+                        o._slots[id(p)] = slot
+                    slot.setdefault("__master__", master)
+    if save_dtype is not None:
+        for m in targets:
+            m._amp_save_dtype = str(save_dtype)
     if optimizers is None:
         return models
     return models, optimizers

@@ -243,7 +243,9 @@ class Layer:
         return self
 
     # -- state dict ---------------------------------------------------------
-    def state_dict(self, include_sublayers=True, structured_name_prefix=""):
+    def _state_targets(self, structured_name_prefix=""):
+        """The LIVE persistable tensors, un-cast: set_state_dict must
+        mutate these, never the save-dtype copies state_dict hands out."""
         out = OrderedDict()
         for n, p in self.named_parameters(prefix=structured_name_prefix.rstrip(".")):
             out[n] = p
@@ -252,8 +254,22 @@ class Layer:
                 out[n] = b
         return out
 
+    def state_dict(self, include_sublayers=True, structured_name_prefix=""):
+        out = self._state_targets(structured_name_prefix)
+        # amp.decorate(save_dtype=...): checkpoints keep the requested
+        # dtype even when the live params run low precision under O2
+        # (fresh Tensors — the live params are not touched)
+        save_dtype = getattr(self, "_amp_save_dtype", None)
+        if save_dtype is not None:
+            target = dtype_mod.convert_dtype(save_dtype)
+            for n, t in out.items():
+                if dtype_mod.is_inexact(t.dtype) and \
+                        dtype_mod.convert_dtype(t.dtype) != target:
+                    out[n] = Tensor(t.value.astype(target))
+        return out
+
     def set_state_dict(self, state_dict, use_structured_name=True):
-        own = self.state_dict()
+        own = self._state_targets()
         missing = []
         for name, tensor in own.items():
             if name in state_dict:

@@ -52,6 +52,10 @@ class Optimizer:
         self._step_count = 0
         self._slots: Dict[int, dict] = {}
         self._jit_update = None
+        # multi-precision (amp.decorate O2 master_weight=True): a low-
+        # precision param keeps an f32 master copy in its slot dict; the
+        # rule runs in f32 and the param gets the cast-down of the master
+        self._multi_precision = bool(multi_precision)
 
     # -- functional API ------------------------------------------------------
     def init_state(self, params, param_objs=None):
@@ -66,7 +70,7 @@ class Optimizer:
                             for n, p in param_objs.items()})
             slots = {}
             for n, p in params.items():
-                base = self.init_slot(p)
+                base = self._init_slot_mp(p)
                 restored = (self._slots.get(id(param_objs[n]))
                             if n in param_objs else None)
                 if restored:
@@ -76,9 +80,20 @@ class Optimizer:
                                 v, getattr(base[k], "dtype", None))
                 slots[n] = base
         else:
-            slots = _tmap(lambda p: self.init_slot(p), params)
+            slots = _tmap(lambda p: self._init_slot_mp(p), params)
         return {"slots": slots,
                 "step": jnp.asarray(self._step_count, jnp.int32)}
+
+    def _init_slot_mp(self, p):
+        """init_slot, plus the f32 master copy when multi-precision is on
+        and the param itself is low precision: moments are seeded from
+        (and shaped like) the master so the whole update runs f32."""
+        if self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16):
+            master = p.astype(jnp.float32)
+            slots = dict(self.init_slot(master))
+            slots["__master__"] = master
+            return slots
+        return self.init_slot(p)
 
     def apply_gradients_fn(self, grads, params, state, lr=None):
         """Pure update: returns (new_params, new_state). Used inside jit."""
@@ -96,6 +111,22 @@ class Optimizer:
             if g is None:
                 new_p.append(p)
                 new_s.append(s)
+                continue
+            master = s.get("__master__") if isinstance(s, dict) else None
+            if master is not None:
+                # multi-precision: update the f32 master, cast down for
+                # the compute param — the low-precision grad only ever
+                # touches f32 state
+                lr32 = jnp.asarray(lr, master.dtype)
+                sub = {k: v for k, v in s.items() if k != "__master__"}
+                m2, s2 = self.rule(g.astype(master.dtype), master, sub,
+                                   lr32, step)
+                if self._l2_coeff and self.DECOUPLED_WD:
+                    m2 = m2 - lr32 * self._l2_coeff * master
+                s2 = dict(s2)
+                s2["__master__"] = m2
+                new_p.append(m2.astype(p.dtype))
+                new_s.append(s2)
                 continue
             p2, s2 = self.rule(g, p, s, jnp.asarray(lr, p.dtype), step)
             if self._l2_coeff and self.DECOUPLED_WD:
@@ -163,7 +194,7 @@ class Optimizer:
         sdict = {}
         for n, (_, p) in zip(names, updatable):
             if id(p) not in self._slots:
-                self._slots[id(p)] = self.init_slot(p.value)
+                self._slots[id(p)] = self._init_slot_mp(p.value)
             sdict[n] = self._slots[id(p)]
         state = {"slots": sdict, "step": jnp.asarray(self._step_count, jnp.int32)}
         self._set_regs({n: getattr(p, "regularizer", None)
@@ -241,7 +272,8 @@ class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  name=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._momentum = momentum
         self._nesterov = use_nesterov
 
@@ -261,7 +293,8 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, name=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
 
     def init_slot(self, p):
@@ -286,14 +319,15 @@ class AdamW(Adam):
                  grad_clip=None, lr_ratio=None, apply_decay_param_fun=None,
                  name=None, **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip)
+                         weight_decay, grad_clip, **kw)
 
 
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
 
     def init_slot(self, p):
@@ -313,7 +347,8 @@ class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
                  name=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._eps = epsilon
         self._init_acc = initial_accumulator_value
 
@@ -329,7 +364,8 @@ class Adagrad(Optimizer):
 class DecayedAdagrad(Optimizer):
     def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
                  parameters=None, weight_decay=None, grad_clip=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._decay, self._eps = decay, epsilon
 
     def init_slot(self, p):
@@ -344,7 +380,8 @@ class DecayedAdagrad(Optimizer):
 class Adadelta(Optimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._eps, self._rho = epsilon, rho
 
     def init_slot(self, p):
@@ -364,7 +401,8 @@ class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._rho, self._eps = rho, epsilon
         self._momentum, self._centered = momentum, centered
 
@@ -387,7 +425,8 @@ class RMSProp(Optimizer):
 class Ftrl(Optimizer):
     def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
                  parameters=None, weight_decay=None, grad_clip=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._l1, self._l2, self._lr_power = l1, l2, lr_power
 
     def init_slot(self, p):
@@ -410,7 +449,8 @@ class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, name=None, **kw):
-        super().__init__(learning_rate, parameters, None, grad_clip)
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._lamb_wd = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
@@ -438,7 +478,8 @@ class LarsMomentum(Optimizer):
     def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,
                  epsilon=1e-9, **kw):
-        super().__init__(learning_rate, parameters, None, grad_clip)
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._momentum = momentum
         self._lars_coeff = lars_coeff
         self._lars_wd = lars_weight_decay
@@ -465,7 +506,8 @@ class Dpsgd(Optimizer):
 
     def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16,
                  sigma=1.0, parameters=None, seed=0, **kw):
-        super().__init__(learning_rate, parameters)
+        super().__init__(learning_rate, parameters,
+                         multi_precision=kw.get("multi_precision", False))
         self._clip, self._batch, self._sigma = clip, batch_size, sigma
         self._key = random_mod.make_key(seed or 0)
 

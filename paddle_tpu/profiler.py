@@ -65,6 +65,19 @@ _state = {
 #   trace_ms           jit .lower() wall-time (Python trace -> StableHLO)
 #   compile_ms         .compile() wall-time (XLA; a disk-cache hit makes
 #                      this a file read)
+#
+# Mixed-precision counters (the auto_mixed_precision pass in
+# static/passes.py, gated by BuildStrategy.amp / PADDLE_AMP):
+#   amp_casts_inserted amp cast ops added to the forward region
+#   amp_casts_elided   casts removed by the cleanup sub-pass (dup casts,
+#                      exact lowp->f32->lowp round trips)
+#   amp_ops_lowprec    ops rewritten to run in bf16/fp16
+#   amp_master_params  f32 parameters that got a low-precision compute
+#                      copy (master weights: optimizer updates stay f32)
+#   amp_lowprec_feeds  float32 data vars flipped to the low dtype (the
+#                      feed paths cast host-side; h2d bytes halve)
+#   amp_loss_scaled    fp16 static loss scaling wired through the
+#                      check_finite_and_unscale kernel (1 per build)
 #   disk_cache_hits / disk_cache_misses  jax persistent-compilation-cache
 #                      traffic (PADDLE_COMPILE_CACHE[_DIR]); process
 #                      events, merged into exe.counters like the fault

@@ -32,7 +32,8 @@ from .feeder import DataFeeder  # noqa: F401
 from .compiler import (BuildStrategy, CompiledProgram,  # noqa: F401
                        ExecutionStrategy)
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
-from .passes import PassReport, apply_passes, pass_names  # noqa: F401
+from .passes import (PassReport, apply_passes, pass_names,  # noqa: F401
+                     resolve_amp)
 from .compile_cache import (cache_dir as compile_cache_dir,  # noqa: F401
                             ensure_enabled as enable_compile_cache)
 from .io import load, save  # noqa: F401

@@ -34,6 +34,20 @@ class BuildStrategy:
       constant_folding          all-constant subgraph folding (new)
       cse                       common-subexpression elimination (new)
 
+    Mixed-precision knobs (the auto_mixed_precision pass,
+    static/passes.py; `PADDLE_AMP=bf16|fp16|0` env overrides them all):
+
+      amp                   run white/black-list bf16 (or fp16) rewrite
+                            of the forward region; params stay f32
+                            master weights, float32 feeds go low
+                            host-side (h2d bytes halve)
+      amp_dtype             "bfloat16" (TPU default; no loss scaling
+                            needed) or "float16"
+      amp_level             "O1" white-list only; "O2" lowers gray ops
+                            too (black list always stays f32)
+      amp_init_loss_scale   static loss scale threaded through
+                            check_finite_and_unscale under fp16
+
     Comm-layout knobs (reduce_strategy, fuse_all_reduce_ops) stay
     descriptive: XLA's SPMD partitioner owns cross-chip scheduling."""
 
@@ -45,6 +59,10 @@ class BuildStrategy:
         self.enable_inplace = True
         self.constant_folding = True
         self.cse = True
+        self.amp = False
+        self.amp_dtype = "bfloat16"
+        self.amp_level = "O1"
+        self.amp_init_loss_scale = 2.0 ** 15
         self.num_trainers = 1
         self.trainer_id = 0
 
@@ -65,6 +83,19 @@ class CompiledProgram:
         self._mesh: Optional[Mesh] = None
         self._loss_name = None
         self._sharding_cache = None
+        self._stash_amp_feed_dtypes()
+
+    def _stash_amp_feed_dtypes(self):
+        """Publish the AMP host-cast map on the program NOW, not at the
+        first run: py_reader prefetch threads started before Executor.run
+        would otherwise stage their first `depth` batches f32 and force
+        a second compile of the training step."""
+        from .passes import amp_feed_dtypes_cached, resolve_amp
+
+        prog = self._program
+        if hasattr(prog, "global_block"):
+            prog._amp_feed_dtypes = amp_feed_dtypes_cached(
+                prog, resolve_amp(self._build_strategy))
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
@@ -73,6 +104,7 @@ class CompiledProgram:
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
+            self._stash_amp_feed_dtypes()
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
         from ..parallel.mesh import create_mesh, get_mesh

@@ -199,8 +199,10 @@ def _state_signature(state) -> tuple:
 def _strategy_signature(strategy) -> tuple:
     if strategy is None:
         return ()
-    return tuple(sorted((k, bool(v)) for k, v in vars(strategy).items()
-                        if isinstance(v, bool)))
+    # scalar knobs only — bools select passes, strings/numbers carry the
+    # amp dtype/level/loss-scale (all shape which executable is built)
+    return tuple(sorted((k, str(v)) for k, v in vars(strategy).items()
+                        if isinstance(v, (bool, int, float, str))))
 
 
 class _ExecEntry:
@@ -351,6 +353,40 @@ class Executor:
                        for v in (fetch_list or [])]
 
         block = program.global_block
+        # mixed precision (BuildStrategy.amp / PADDLE_AMP): float32 feeds
+        # are cast HOST-side to the low dtype — half the h2d bytes — and
+        # the amp config joins the step key so flipping the env (or the
+        # strategy) can never hit a stale executable. Stash the feed
+        # dtype map on the program (like _feed_sharding) so py_reader
+        # prefetch threads stage batches already low.
+        from .passes import amp_feed_dtypes_cached, resolve_amp
+
+        amp = resolve_amp(strategy)
+        fdt = amp_feed_dtypes_cached(program, amp)
+        program._amp_feed_dtypes = fdt
+
+        def _amp_fix_feed(k, v):
+            if not isinstance(v, jax.Array):
+                if fdt and k in fdt and v.dtype == np.float32:
+                    return v.astype(fdt[k])
+                return v
+            # device-staged feeds must match the dtype this run traces
+            # with: the program-level stash is shared, so a prefetch
+            # thread serving a DIFFERENT amp config (amp-on train +
+            # amp-off eval over one Program) can stage the wrong dtype —
+            # a cheap on-device cast beats a silent wrong-graph feed or
+            # a recompile ping-pong
+            if fdt and k in fdt and v.dtype == jnp.float32:
+                return v.astype(jnp.dtype(fdt[k]))
+            if not fdt:
+                dv = block.vars.get(k)
+                if dv is not None and dv.is_data \
+                        and dv.dtype == "float32" \
+                        and v.dtype in (jnp.bfloat16, jnp.float16):
+                    return v.astype(jnp.float32)
+            return v
+
+        feed = {k: _amp_fix_feed(k, v) for k, v in feed.items()}
         peek = getattr(scope, "_peek", scope.find_var)
         persist_names = sorted(
             n for n, v in block.vars.items()
@@ -366,7 +402,7 @@ class Executor:
         state_sig = _state_signature(state)
         step_key = (program._version, feed_sig, tuple(fetch_names),
                     tuple(persist_names), state_sig, bool(sharding),
-                    _strategy_signature(strategy))
+                    _strategy_signature(strategy), amp)
         per_prog = self._cache.setdefault(program, {})
         entry = None
         if use_program_cache:
@@ -477,6 +513,8 @@ class Executor:
             if s.removed:
                 self._bump(f"pass_{s.name}_removed_ops", s.removed)
             self._bump(f"pass_{s.name}_ms", round(s.ms, 3))
+        for name, v in getattr(report, "amp", {}).items():
+            self._bump(name, v)
 
     def _build(self, block, feed_keys, fetch_names, persist_names,
                sharding, feed_vals, state, rng):
@@ -557,9 +595,11 @@ class Executor:
         # the prefetcher stages each batch DIRECTLY into the feed's
         # sharded layout — no per-step re-partition
         sharding = None
+        strategy = None
         program = run_target
         if isinstance(program, CompiledProgram):
             sharding = program._data_sharding()
+            strategy = program._build_strategy
             program = program._program
         scope = scope or global_scope()
         block = program.global_block
@@ -609,8 +649,14 @@ class Executor:
         # prefetch thread device_puts batch N+1 (the producers above
         # keep parsing/padding N+2...). Depth scales with ingestion
         # parallelism but stays bounded — each slot pins device memory.
+        # Under AMP, float32 feeds are cast low on the prefetch thread
+        # BEFORE the h2d copy (half the transfer, amp_feed_dtypes).
+        from .passes import amp_feed_dtypes, resolve_amp
+
+        feed_dtypes = amp_feed_dtypes(block, resolve_amp(strategy))
         prefetcher = FeedPrefetcher(host_feeds(), depth=max(2, int(thread)),
-                                    sharding=sharding)
+                                    sharding=sharding,
+                                    feed_dtypes=feed_dtypes)
         step = 0
         last_fetch = None
         try:

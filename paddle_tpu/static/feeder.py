@@ -46,6 +46,10 @@ class DataFeeder:
         for name, dtype, col in zip(self.feed_names, self.feed_dtypes,
                                     columns):
             out.update(self._present(name, dtype, col))
+        # Mixed precision needs no cast here: this dict flows into
+        # Executor.run, whose amp feed path (passes.amp_feed_dtypes)
+        # casts float32 slots host-side before the h2d copy — one owner
+        # for the cast keeps strategy- and env-driven AMP consistent.
         return out
 
     @staticmethod

@@ -692,12 +692,29 @@ def _layer_norm(ins, attrs, ctx):
 
 # ---------------------------------------------------------------------------
 # optimizer update ops (reference operators/optimizers/*.cc) — pure
-# functional: outputs are the updated params/accumulators
+# functional: outputs are the updated params/accumulators. An optional
+# FoundInfinite input (wired by the fp16 auto_mixed_precision pass)
+# gates the WHOLE update: on a non-finite step params, moments and
+# beta-pow accumulators all keep their previous values — the
+# GradScaler skip-step semantics, inside the compiled program.
 # ---------------------------------------------------------------------------
+def _gate_update(ins, outs):
+    found = ins.get("FoundInfinite")
+    if not found:
+        return outs
+    skip = found[0].reshape(())
+    olds = {"ParamOut": "Param", "VelocityOut": "Velocity",
+            "Moment1Out": "Moment1", "Moment2Out": "Moment2",
+            "Beta1PowOut": "Beta1Pow", "Beta2PowOut": "Beta2Pow"}
+    return {slot: [jnp.where(skip, ins[olds[slot]][0], new)
+                   for new in vals]
+            for slot, vals in outs.items()}
+
+
 @kernel("sgd")
 def _sgd(ins, attrs, ctx):
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
-    return {"ParamOut": [p - lr * g]}
+    return _gate_update(ins, {"ParamOut": [p - lr * g]})
 
 
 @kernel("momentum")
@@ -711,7 +728,8 @@ def _momentum(ins, attrs, ctx):
         p_new = p - (g + mu * v_new) * lr
     else:
         p_new = p - lr * v_new
-    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+    return _gate_update(ins, {"ParamOut": [p_new],
+                              "VelocityOut": [v_new]})
 
 
 @kernel("adam")
@@ -727,9 +745,10 @@ def _adam(ins, attrs, ctx):
     v_new = b2 * v + (1 - b2) * g * g
     lr_t = lr * jnp.sqrt(1 - b2p * b2) / (1 - b1p * b1)
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
-    return {"ParamOut": [p_new], "Moment1Out": [m_new],
-            "Moment2Out": [v_new], "Beta1PowOut": [b1p * b1],
-            "Beta2PowOut": [b2p * b2]}
+    return _gate_update(ins, {
+        "ParamOut": [p_new], "Moment1Out": [m_new],
+        "Moment2Out": [v_new], "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2]})
 
 
 @kernel("lamb")
@@ -750,9 +769,31 @@ def _lamb(ins, attrs, ctx):
     p_norm = jnp.linalg.norm(p)
     r_norm = jnp.linalg.norm(r)
     trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
-    return {"ParamOut": [p - lr * trust * r], "Moment1Out": [m_new],
-            "Moment2Out": [v_new], "Beta1PowOut": [b1p * b1],
-            "Beta2PowOut": [b2p * b2]}
+    return _gate_update(ins, {
+        "ParamOut": [p - lr * trust * r], "Moment1Out": [m_new],
+        "Moment2Out": [v_new], "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2]})
+
+
+@kernel("check_finite_and_unscale")
+def _check_finite_and_unscale(ins, attrs, ctx):
+    """Reference operators/amp/check_finite_and_scale_op.cc: divide every
+    grad by the loss scale and flag non-finite values. Inserted by the
+    auto_mixed_precision pass under fp16 (static loss scaling); the pass
+    also wires FoundInfinite into the downstream update ops, which skip
+    the whole step (params, moments, beta-pows) when it fires — the
+    static-graph equivalent of GradScaler skipping optimizer.step().
+    Grads are zeroed too, as a belt-and-braces for update ops outside
+    the gated set."""
+    xs = list(ins.get("X", []))
+    scale = ins["Scale"][0] if ins.get("Scale") else attrs.get("scale", 1.0)
+    inv = 1.0 / scale
+    found = jnp.zeros((), jnp.bool_)
+    for x in xs:
+        found = found | jnp.any(~jnp.isfinite(x))
+    outs = [jnp.where(found, jnp.zeros_like(x), (x * inv).astype(x.dtype))
+            for x in xs]
+    return {"Out": outs, "FoundInfinite": [found.reshape((1,))]}
 
 
 @kernel("increment")

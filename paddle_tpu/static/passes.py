@@ -12,6 +12,17 @@ shrink what the *Python trace* and the resulting HLO have to chew on
 in kernels.py directly.
 
 Passes (BuildStrategy knob in parentheses):
+  auto_mixed_precision   (strategy.amp / PADDLE_AMP)   bf16/fp16 compute
+      rewrite of the forward region: white-listed matmul-family ops get
+      cast ops on their f32 inputs and emit low-precision outputs,
+      black-listed (numerically sensitive) ops are pinned f32, gray ops
+      follow their inputs; parameters stay f32 MASTER WEIGHTS (the cast
+      materializes a low-precision copy inside the step, optimizer
+      updates apply in f32); float32 feed vars flip to the low dtype
+      (the executor/prefetcher cast host-side — h2d bytes halve); a
+      cleanup sub-pass dedups identical casts and elides exact
+      lowp->f32->lowp round trips. fp16 additionally threads static
+      loss scaling through a check_finite_and_unscale kernel.
   constant_folding       (strategy.constant_folding)   all-constant
       subgraphs — fill_constant / shape-arithmetic chains — evaluated
       once at build and re-materialized as single constant ops
@@ -59,7 +70,7 @@ from typing import Dict, List, Optional, Sequence, Set
 import numpy as np
 
 from ..framework import dtype as dtype_mod
-from .ir import OpDesc, Program, _attrs_to_json
+from .ir import OpDesc, Program, VarDesc, _attrs_to_json
 
 # ops whose kernels fold ctx.op_index into their RNG key (kernels.py
 # ctx.key() users) — these get a stable __rng_slot stamp
@@ -83,6 +94,120 @@ _FUSABLE_ACTS = {"relu", "sigmoid", "tanh", "gelu", "leaky_relu",
                  "softplus", "softsign", "swish", "square", "sqrt", "exp"}
 
 _FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}
+_LOW_PRECISION = {"float16", "bfloat16"}
+
+# update kernels that honor an optional FoundInfinite input (kernels.py):
+# under fp16 loss scaling, a non-finite step skips the whole update
+_AMP_GATED_UPDATE_OPS = {"sgd", "momentum", "adam", "lamb"}
+
+# PADDLE_AMP env spellings -> canonical low dtype
+_AMP_DTYPE_ALIASES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
+                      "1": "bfloat16", "true": "bfloat16", "on": "bfloat16",
+                      "fp16": "float16", "float16": "float16"}
+
+
+def resolve_amp(strategy=None):
+    """Resolve the mixed-precision config for one build.
+
+    Returns ``(low_dtype, level, init_loss_scale)`` or ``None`` (f32).
+    ``PADDLE_AMP`` (bf16|fp16|0) overrides the BuildStrategy knobs
+    (``amp``/``amp_dtype``/``amp_level``/``amp_init_loss_scale``);
+    ``PADDLE_AMP=0`` forces bitwise-f32 behavior whatever the strategy
+    says. The tuple is part of the executor's step cache key, so
+    flipping the env between runs can never hit a stale executable.
+
+    ``PADDLE_IR_PASSES=0`` resolves to None too: the graph rewrite and
+    the host-side feed casts must switch together — a bf16 feed into an
+    un-rewritten f32 graph would bypass the black-list pinning."""
+    if os.environ.get("PADDLE_IR_PASSES") == "0":
+        return None
+    level = str(os.environ.get("PADDLE_AMP_LEVEL")
+                or getattr(strategy, "amp_level", "O1") or "O1").upper()
+    try:
+        scale = float(getattr(strategy, "amp_init_loss_scale", 2.0 ** 15))
+    except (TypeError, ValueError):
+        scale = 2.0 ** 15
+    env = os.environ.get("PADDLE_AMP")
+    if env is not None:
+        e = env.strip().lower()
+        if e in ("", "0", "false", "off"):
+            return None
+        dt = _AMP_DTYPE_ALIASES.get(e)
+        if dt is None:
+            raise ValueError(
+                f"PADDLE_AMP={env!r}: expected bf16|bfloat16|fp16|"
+                f"float16|0")
+        return (dt, level, scale)
+    if strategy is not None and getattr(strategy, "amp", False):
+        raw = str(getattr(strategy, "amp_dtype", "bfloat16")).lower()
+        dt = _AMP_DTYPE_ALIASES.get(raw)
+        if dt is None:
+            raise ValueError(
+                f"BuildStrategy.amp_dtype={raw!r}: expected bfloat16 or "
+                f"float16")
+        return (dt, level, scale)
+    return None
+
+
+def _lowp_feed_names(block) -> Set[str]:
+    """float32 data vars that may flip to the low dtype: never consumed
+    by a black-listed (f32-pinned) op in the forward region and not read
+    inside a sub-block — quantizing a feed that flows straight into a
+    pinned op would defeat the pinning at the graph input. The decision
+    depends only on the block structure, so the executor's host-cast map
+    (amp_feed_dtypes) and the pass always agree without the pass having
+    run."""
+    data = {n for n, v in block.vars.items()
+            if v.is_data and v.dtype == "float32"}
+    if not data:
+        return data
+    _, black = _amp_lists()
+    data -= _sub_block_names(block.program)
+    first_bwd = next((i for i, op in enumerate(block.ops)
+                      if op.type == "backward"), len(block.ops))
+    for op in block.ops[:first_bwd]:
+        if op.type in black:
+            data -= set(op.input_names())
+        if not data:
+            break
+    return data
+
+
+def amp_feed_dtypes(block, amp):
+    """{float32 data-var name -> numpy dtype} for the low-precision feed
+    path under ``amp`` (a resolve_amp result), or None. The executor and
+    the prefetch paths (FeedPrefetcher/py_reader) cast these feeds
+    HOST-side, so the h2d transfer itself halves."""
+    if not amp:
+        return None
+    target = np.dtype(dtype_mod.convert_dtype(amp[0]))
+    out = {n: target for n in _lowp_feed_names(block)}
+    return out or None
+
+
+def amp_feed_dtypes_cached(program, amp):
+    """amp_feed_dtypes memoized on (program version, amp): the map only
+    depends on the block structure, and the executor consults it every
+    step — the O(ops) consumer scan must not ride the warm path."""
+    version = getattr(program, "_version", None)
+    cache = getattr(program, "_amp_feed_cache", None)
+    if cache is not None and cache[0] == version and cache[1] == amp:
+        return cache[2]
+    out = amp_feed_dtypes(program.global_block, amp)
+    program._amp_feed_cache = (version, amp, out)
+    return out
+
+
+def _amp_lists():
+    """Static op-type white/black lists, derived from the dygraph amp
+    module's lists plus the static-only spellings (fc lowers to `mul`;
+    the plain `mean`/`sum`/`cross_entropy` kernels are loss-adjacent)."""
+    from .. import amp as amp_mod
+
+    white = set(amp_mod.WHITE_LIST) | {"mul"}
+    black = set(amp_mod.BLACK_LIST) | {
+        "mean", "sum", "cross_entropy", "batch_norm", "accuracy"}
+    return white, black
 
 
 def _is_random(op_type: str) -> bool:
@@ -116,12 +241,16 @@ class PassStat:
 
 @dataclass
 class PassReport:
-    """What the pipeline did to one program: per-pass stats + totals."""
+    """What the pipeline did to one program: per-pass stats + totals.
+    ``amp`` carries the mixed-precision counters (amp_casts_inserted/
+    elided, amp_ops_lowprec, amp_master_params, ...) when the
+    auto_mixed_precision pass ran."""
     stats: List[PassStat] = field(default_factory=list)
     ops_before: int = 0
     ops_after: int = 0
     ms: float = 0.0
     vars_dropped: int = 0
+    amp: Dict[str, int] = field(default_factory=dict)
 
     @property
     def removed(self) -> int:
@@ -139,6 +268,9 @@ class PassReport:
                      f"{self.ms:>10.2f}")
         if self.vars_dropped:
             lines.append(f"(+ {self.vars_dropped} unused VarDescs dropped)")
+        if self.amp:
+            lines.append("amp: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(self.amp.items())))
         return "\n".join(lines)
 
 
@@ -504,6 +636,320 @@ def _pass_drop_unused_vars(ctx: _Ctx) -> int:
 
 
 # ---------------------------------------------------------------------------
+# auto mixed precision (bf16/fp16 compute, f32 master weights)
+# ---------------------------------------------------------------------------
+def _pass_auto_mixed_precision(ctx: _Ctx) -> None:
+    """Rewrite the forward region (ops before the first `backward` op —
+    the same boundary rule CSE respects) for low-precision compute:
+
+    - white-listed ops (matmul family — the MXU win) get `cast` ops on
+      their float32 inputs and emit low-precision outputs
+    - black-listed ops (softmax/norm/reductions/loss) are pinned f32:
+      low-precision inputs are cast back up
+    - gray ops follow their inputs: once any float input is low
+      precision the op runs low (remaining f32 float inputs cast down);
+      pure-f32 gray ops are untouched under O1. O2 lowers gray ops too.
+    - parameters stay f32 MASTER WEIGHTS: the inserted cast materializes
+      a low-precision copy inside the compiled step, the param buffer in
+      the executor's device-resident state is untouched and optimizer
+      ops keep updating it in f32
+    - float32 feed (data) vars flip to the low dtype — the executor and
+      prefetch paths cast host-side, halving h2d bytes
+    - protected names (fetches, persistables, sub-block reads, feeds)
+      keep their declared dtype: the producing op writes a low-precision
+      alias and a cast-up restores the original name
+    - under fp16, the loss is scaled before `backward` and the grads run
+      through a check_finite_and_unscale kernel (static loss scaling;
+      bf16 needs none — dygraph GradScaler stays the dynamic-scale path)
+
+    A cleanup sub-pass dedups identical casts (CSE-style, but valid in
+    the forward region because a cast is deterministic and random-free)
+    and elides exact lowp->f32->lowp round trips.
+    """
+    from .kernels import KERNELS
+
+    block = ctx.block
+    lowp = ctx.amp_dtype
+    level = ctx.amp_level
+    scale = ctx.amp_scale if lowp == "float16" else 0.0
+    tag = "bf16" if lowp == "bfloat16" else "fp16"
+    white, black = _amp_lists()
+    stats = ctx.amp_stats
+    first_bwd = next((i for i, op in enumerate(block.ops)
+                      if op.type == "backward"), len(block.ops))
+    masters: Set[str] = set()
+    cur: Dict[str, str] = {}
+
+    def declared(n):
+        v = block.vars.get(n)
+        return getattr(v, "dtype", None)
+
+    def dtype_of(n):
+        d = cur.get(n)
+        return d if d is not None else declared(n)
+
+    # low-precision feed path: the executor/prefetchers cast these
+    # host-side (amp_feed_dtypes — same consumer-aware rule), so the
+    # trace sees them low already; feeds reaching a black-listed op
+    # stay f32 (the pinning contract holds at graph inputs too)
+    for n in sorted(_lowp_feed_names(block)):
+        block.vars[n].dtype = lowp
+        cur[n] = lowp
+        stats["amp_lowprec_feeds"] += 1
+
+    new_ops: List[OpDesc] = []
+    cast_cache: Dict[tuple, str] = {}
+    cache_by_src: Dict[str, List[tuple]] = defaultdict(list)
+
+    def _kill_src(name):
+        # (re)definition of `name`: cached casts of it are stale
+        for key in cache_by_src.pop(name, ()):
+            cast_cache.pop(key, None)
+
+    def emit_cast(src, dt):
+        key = (src, dt)
+        alias = cast_cache.get(key)
+        if alias is not None:
+            return alias
+        alias = f"{src}@amp.{'f32' if dt == 'float32' else tag}"
+        sdesc = block.vars.get(src)
+        block.vars[alias] = VarDesc(alias, getattr(sdesc, "shape", None),
+                                    dt)
+        new_ops.append(OpDesc("cast", {"X": [src]}, {"Out": [alias]},
+                              {"out_dtype": dt}))
+        cast_cache[key] = alias
+        cache_by_src[src].append(key)
+        cur[alias] = dt
+        stats["amp_casts_inserted"] += 1
+        return alias
+
+    def cast_inputs(op, want, only_from):
+        for s, ns in list(op.inputs.items()):
+            row = []
+            for n in ns:
+                d = dtype_of(n)
+                if d in only_from and d != want:
+                    v = block.vars.get(n)
+                    if getattr(v, "persistable", False) \
+                            and want in _LOW_PRECISION:
+                        masters.add(n)  # f32 master, lowp copy in-step
+                    row.append(emit_cast(n, want))
+                else:
+                    row.append(n)
+            op.inputs[s] = row
+
+    def lower_outputs(op):
+        """Mark op outputs low-precision; protected names keep their
+        declared dtype through a cast-up under the original name."""
+        post = []
+        for s, ns in op.outputs.items():
+            for j, n in enumerate(ns):
+                d0 = declared(n)
+                if d0 not in _FLOAT_DTYPES:
+                    continue  # int/bool/undeclared outputs untouched
+                if n in ctx.protected:
+                    keep = d0   # guaranteed float by the guard above
+                    alias = f"{n}@amp.{tag}.out"
+                    block.vars[alias] = VarDesc(
+                        alias, getattr(block.vars.get(n), "shape", None),
+                        lowp)
+                    ns[j] = alias
+                    cur[alias] = lowp
+                    post.append(OpDesc("cast", {"X": [alias]},
+                                       {"Out": [n]}, {"out_dtype": keep}))
+                    stats["amp_casts_inserted"] += 1
+                    cur[n] = keep
+                else:
+                    cur[n] = lowp
+                    v = block.vars.get(n)
+                    if v is not None and v.dtype in _FLOAT_DTYPES:
+                        v.dtype = lowp
+        return post
+
+    found_inf_name = None
+    for i, op in enumerate(block.ops):
+        t = op.type
+        if i == first_bwd and t == "backward" and scale > 0:
+            # fp16 loss scaling: grads = S * dL/dp survive the fp16
+            # cotangent range; check_finite_and_unscale divides by S
+            # (exact for pow-2 S) and zeroes non-finite grads so the
+            # optimizer update degrades to a no-op for that step
+            loss_name = (op.inputs.get("Loss") or [None])[0]
+            grads = list(op.outputs.get("Grads", []))
+            if loss_name is not None and grads:
+                sname = f"{loss_name}@amp.scaled"
+                ldesc = block.vars.get(loss_name)
+                block.vars[sname] = VarDesc(
+                    sname, getattr(ldesc, "shape", None), "float32")
+                new_ops.append(OpDesc("scale", {"X": [loss_name]},
+                                      {"Out": [sname]},
+                                      {"scale": float(scale)}))
+                op.inputs = dict(op.inputs, Loss=[sname])
+                new_ops.append(op)
+                fi = "found_inf@amp"
+                block.vars[fi] = VarDesc(fi, (1,), "bool")
+                new_ops.append(OpDesc(
+                    "check_finite_and_unscale", {"X": grads},
+                    {"Out": list(grads), "FoundInfinite": [fi]},
+                    {"scale": float(scale)}))
+                found_inf_name = fi
+                stats["amp_loss_scaled"] += 1
+                continue
+        if i >= first_bwd or t in ("feed", "fetch"):
+            # found_inf gates the update ops: a non-finite step must not
+            # decay Adam/momentum accumulators or advance beta-pows —
+            # the GradScaler skip-step semantics, compiled
+            if found_inf_name is not None and t in _AMP_GATED_UPDATE_OPS:
+                op.inputs = dict(op.inputs,
+                                 FoundInfinite=[found_inf_name])
+            new_ops.append(op)
+            continue
+        if (t in _SIDE_EFFECT_OPS or t in _CONTROL_FLOW_OPS
+                or t in _ARRAY_OPS):
+            new_ops.append(op)
+            for n in op.output_names():
+                cur.pop(n, None)
+                _kill_src(n)
+            continue
+        if t == "cast":
+            new_ops.append(op)
+            od = op.attrs.get("out_dtype")
+            for n in op.output_names():
+                if od in _FLOAT_DTYPES:
+                    cur[n] = od
+                _kill_src(n)
+            continue
+        if _is_random(t) or t in ("fill_constant", "assign_value"):
+            # bookkeeping only: random ops must not gain cast inputs
+            # (their draw is keyed, not their operands) and constants
+            # keep their attr dtype — a white consumer casts them, and
+            # constant folding then folds the pair into a low constant
+            new_ops.append(op)
+            in_f = [d for d in (dtype_of(n) for n in op.input_names())
+                    if d in _FLOAT_DTYPES]
+            out_d = in_f[0] if in_f else op.attrs.get("dtype")
+            for n in op.output_names():
+                # float-declared outputs only: stamping an int output
+                # (dropout Mask, random int fills) would draw spurious
+                # casts onto its consumers
+                if out_d in _FLOAT_DTYPES and declared(n) in _FLOAT_DTYPES:
+                    cur[n] = out_d
+                _kill_src(n)
+            continue
+        in_f = [d for d in (dtype_of(n) for n in op.input_names())
+                if d in _FLOAT_DTYPES]
+        if t in black:
+            cast_inputs(op, "float32", _LOW_PRECISION)
+            new_ops.append(op)
+            for n in op.output_names():
+                if declared(n) in _FLOAT_DTYPES or \
+                        cur.get(n) in _FLOAT_DTYPES:
+                    cur[n] = "float32"
+                    v = block.vars.get(n)
+                    if v is not None and v.dtype in _LOW_PRECISION:
+                        v.dtype = "float32"
+                _kill_src(n)
+            continue
+        lower = bool(in_f) and t in KERNELS and (
+            t in white or level == "O2" or lowp in in_f)
+        if lower:
+            cast_inputs(op, lowp, {"float32"})
+            stats["amp_ops_lowprec"] += 1
+            post = lower_outputs(op)
+            new_ops.append(op)
+            new_ops.extend(post)
+            for n in op.output_names():
+                _kill_src(n)
+            for c in post:
+                _kill_src(c.outputs["Out"][0])
+        else:
+            new_ops.append(op)
+            for n in op.output_names():
+                # declared-float outputs only — an op with float inputs
+                # can still emit ints (arg_max/top_k indices, shape),
+                # and a float stamp there would cast indices downstream
+                if in_f and declared(n) in _FLOAT_DTYPES:
+                    cur[n] = ("float32"
+                              if "float32" in in_f or "float64" in in_f
+                              else in_f[0])
+                _kill_src(n)
+    block.ops = new_ops
+    stats["amp_master_params"] += len(masters)
+    _amp_cast_cleanup(ctx, cur)
+
+
+def _amp_cast_cleanup(ctx: _Ctx, cur: Dict[str, str]) -> None:
+    """Dedup identical casts and elide exact round trips.
+
+    Valid rewrites (all restricted to single-def names, see _def_counts,
+    and never touching protected names):
+    - no-op cast (out_dtype == source dtype): alias away
+    - duplicate (source, out_dtype) cast: alias to the first one
+    - lowp -> f32 -> lowp round trip: widening then narrowing back is
+      bit-exact, alias the final cast to the original low var
+    """
+    block = ctx.block
+    stats = ctx.amp_stats
+    defs = _def_counts(ctx)
+    rename: Dict[str, str] = {}
+    seen: Dict[tuple, str] = {}
+    origin: Dict[str, tuple] = {}  # cast out -> (src, src_declared_dtype)
+
+    def res(n):
+        while n in rename:
+            n = rename[n]
+        return n
+
+    def _declared(n):
+        # runtime dtype where tracked (random/gray outputs keep their
+        # declared VarDesc dtype but run in whatever flowed in — `cur`
+        # holds the truth); positional staleness is excluded by the
+        # single-def guards below
+        d = cur.get(n)
+        if d is not None:
+            return d
+        v = block.vars.get(n)
+        return getattr(v, "dtype", None)
+
+    new_ops = []
+    for op in block.ops:
+        op.inputs = {s: [res(n) for n in ns]
+                     for s, ns in op.inputs.items()}
+        if op.type == "cast":
+            out = (op.outputs.get("Out") or [None])[0]
+            src = (op.inputs.get("X") or [None])[0]
+            od = op.attrs.get("out_dtype")
+            tracked = (out is not None and src is not None
+                       and defs.get(out, 0) <= 1
+                       and defs.get(src, 0) <= 1)
+            # protected outputs are read by name (fetch/state/sub-block)
+            # and must keep their producing op; provenance is still
+            # recorded so a later re-narrowing can skip the round trip
+            if tracked and out not in ctx.protected:
+                if _declared(src) == od:
+                    rename[out] = src
+                    stats["amp_casts_elided"] += 1
+                    continue
+                prev = origin.get(src)
+                if (prev is not None and prev[1] == od
+                        and od in _LOW_PRECISION
+                        and defs.get(prev[0], 0) <= 1):
+                    rename[out] = prev[0]
+                    stats["amp_casts_elided"] += 1
+                    continue
+                dup = seen.get((src, od))
+                if dup is not None:
+                    rename[out] = dup
+                    stats["amp_casts_elided"] += 1
+                    continue
+            if tracked:
+                seen.setdefault((src, od), out)
+                origin[out] = (src, _declared(src))
+        new_ops.append(op)
+    block.ops = new_ops
+
+
+# ---------------------------------------------------------------------------
 # pipeline
 # ---------------------------------------------------------------------------
 # (name, BuildStrategy knob, fn) — run order matters: fold first so CSE
@@ -520,7 +966,8 @@ _PIPELINE = (
 
 
 def pass_names() -> List[str]:
-    return [name for name, _, _ in _PIPELINE] + ["drop_unused_vars"]
+    return (["auto_mixed_precision"]
+            + [name for name, _, _ in _PIPELINE] + ["drop_unused_vars"])
 
 
 def apply_passes(program: Program, feed_names: Sequence[str],
@@ -529,8 +976,10 @@ def apply_passes(program: Program, feed_names: Sequence[str],
     ``(optimized_program, PassReport)``.
 
     ``strategy`` is a compiler.BuildStrategy (defaults to all knobs on);
-    ``PADDLE_IR_PASSES=0`` disables the pipeline entirely (the original
-    program is returned untouched).
+    ``PADDLE_IR_PASSES=0`` disables the pipeline entirely — including
+    the auto_mixed_precision pass — and returns the original program
+    untouched. AMP runs FIRST so fusion/CSE/DCE see (and can clean up
+    after) the inserted casts.
     """
     from .compiler import BuildStrategy
 
@@ -538,7 +987,8 @@ def apply_passes(program: Program, feed_names: Sequence[str],
     n0 = len(program.global_block.ops)
     enabled = [(name, fn) for name, knob, fn in _PIPELINE
                if getattr(strategy, knob, True)]
-    if os.environ.get("PADDLE_IR_PASSES") == "0" or not enabled:
+    amp = resolve_amp(strategy)
+    if os.environ.get("PADDLE_IR_PASSES") == "0" or not (enabled or amp):
         return program, PassReport([], n0, n0, 0.0)
 
     t_all = time.perf_counter()
@@ -547,6 +997,17 @@ def apply_passes(program: Program, feed_names: Sequence[str],
     ctx = _Ctx(opt, set(feed_names), set(fetch_names))
     _stamp_rng_slots(opt.global_block)
     stats: List[PassStat] = []
+    amp_counts: Dict[str, int] = {}
+    if amp is not None:
+        ctx.amp_dtype, ctx.amp_level, ctx.amp_scale = amp
+        ctx.amp_stats = defaultdict(int)
+        before = len(opt.global_block.ops)
+        t0 = time.perf_counter()
+        _pass_auto_mixed_precision(ctx)
+        stats.append(PassStat("auto_mixed_precision", before,
+                              len(opt.global_block.ops),
+                              (time.perf_counter() - t0) * 1e3))
+        amp_counts = {k: int(v) for k, v in ctx.amp_stats.items() if v}
     for name, fn in enabled:
         before = len(opt.global_block.ops)
         t0 = time.perf_counter()
@@ -563,5 +1024,5 @@ def apply_passes(program: Program, feed_names: Sequence[str],
                               vars_dropped=vars_dropped))
     total_ms = (time.perf_counter() - t_all) * 1e3
     report = PassReport(stats, n0, len(opt.global_block.ops), total_ms,
-                        vars_dropped)
+                        vars_dropped, amp_counts)
     return opt, report

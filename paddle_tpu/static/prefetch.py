@@ -30,10 +30,14 @@ __all__ = ["FeedPrefetcher", "stage_feed"]
 
 
 def stage_feed(feed: Dict[str, Any],
-               sharding: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+               sharding: Optional[Dict[str, Any]] = None,
+               feed_dtypes: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
     """Device-put every host array of a feed dict (per-name sharding when
     given), counting the transferred bytes. Arrays already on device pass
-    through untouched."""
+    through untouched. ``feed_dtypes`` (name -> numpy dtype, from
+    passes.amp_feed_dtypes) casts float32 feeds HOST-side before the
+    copy — under bf16 mixed precision the h2d transfer itself halves."""
     from ..parallel.sharding import device_put_counted
 
     staged = {}
@@ -41,8 +45,12 @@ def stage_feed(feed: Dict[str, Any],
         if isinstance(val, jax.Array):
             staged[name] = val
             continue
+        arr = np.asarray(val)
+        if feed_dtypes is not None and name in feed_dtypes \
+                and arr.dtype == np.float32:
+            arr = arr.astype(feed_dtypes[name])
         staged[name] = device_put_counted(
-            np.asarray(val), sharding.get(name) if sharding else None)
+            arr, sharding.get(name) if sharding else None)
     return staged
 
 
@@ -60,12 +68,13 @@ class FeedPrefetcher:
 
     def __init__(self, source: Iterable, depth: int = 2,
                  stage: Optional[Callable] = None,
-                 sharding: Optional[Dict[str, Any]] = None):
+                 sharding: Optional[Dict[str, Any]] = None,
+                 feed_dtypes: Optional[Dict[str, Any]] = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._source = iter(source)
         self._stage = stage if stage is not None else (
-            lambda item: stage_feed(item, sharding))
+            lambda item: stage_feed(item, sharding, feed_dtypes))
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err: Optional[BaseException] = None

@@ -90,15 +90,30 @@ class PyReader:
             # depth beyond a couple of batches only holds extra device
             # memory; capacity still caps tiny-queue configs
             depth = max(1, min(int(self.capacity) or 1, 2))
+            # env-driven AMP fallback for raw-Program runs: without a
+            # stash (CompiledProgram/Executor.run set one) the first
+            # `depth` batches would stage f32 and cost a recompile
+            if not hasattr(self.program, "_amp_feed_dtypes"):
+                from .passes import amp_feed_dtypes_cached, resolve_amp
+
+                try:
+                    self.program._amp_feed_dtypes = amp_feed_dtypes_cached(
+                        self.program, resolve_amp(None))
+                except ValueError:
+                    self.program._amp_feed_dtypes = None
             # a CompiledProgram run stashes its feed sharding on the
             # program (Executor.run): batches stage straight into the
             # sharded layout instead of resharding every step. Resolved
             # per batch — the stash only appears at the first run, after
             # start() has already been called.
+            # _amp_feed_dtypes (stashed by Executor.run like the
+            # sharding) casts float32 feeds low on this thread, before
+            # the h2d copy
             self._it = FeedPrefetcher(
                 feeds(), depth=depth,
                 stage=lambda feed: stage_feed(
-                    feed, getattr(self.program, "_feed_sharding", None)))
+                    feed, getattr(self.program, "_feed_sharding", None),
+                    getattr(self.program, "_amp_feed_dtypes", None)))
         else:
             self._it = feeds()
         self._started = True

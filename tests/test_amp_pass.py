@@ -1,0 +1,636 @@
+"""Static-graph auto_mixed_precision pass: knob matrix, master weights,
+cast bookkeeping, feed path, fp16 loss scaling, amp.decorate satellites.
+
+Contract being pinned:
+- amp-on loss tracks the f32 loss within tolerance (roundoff, not drift)
+- PADDLE_AMP=0 restores bitwise-f32 behavior whatever the strategy says
+- parameters stay f32 master weights (bitwise untouched when amp only
+  wraps compute), optimizer updates run f32
+- the compile cache distinguishes amp-on/off (no stale executables)
+- __rng_slot keeps random draws stable while casts shift op indices
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import passes as passes_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALL_KNOBS = ("fuse_elewise_add_act_ops", "memory_optimize",
+             "enable_inplace", "constant_folding", "cse")
+
+
+def _strategy(amp=None, level="O1", others=False):
+    bs = static.BuildStrategy()
+    for k in ALL_KNOBS:
+        setattr(bs, k, bool(others))
+    if amp:
+        bs.amp = True
+        bs.amp_dtype = amp
+        bs.amp_level = level
+    else:
+        bs.amp = False
+    return bs
+
+
+def _train_program(seed=1234):
+    """Small MLP + bert-ish block: white mul ops, gray adds, a black
+    softmax-xent loss, SGD update ops past the backward boundary."""
+    main, startup = static.Program(), static.Program()
+    main.random_seed = startup.random_seed = seed
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 8])
+        label = static.data("label", [-1, 1], dtype="int64")
+        h = static.nn.fc(x, 16, act="relu")
+        h = static.nn.fc(h, 8)
+        logits = static.nn.fc(h, 4)
+        loss = static.mean(
+            static.softmax_with_cross_entropy(logits, label))
+        static.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(n=8):
+    rng = np.random.RandomState(0)
+    return {"x": rng.randn(n, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (n, 1)).astype(np.int64)}
+
+
+def _run_leg(strategy, steps=3):
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        main, startup, loss = _train_program()
+        exe = static.Executor()
+        exe.run(startup)
+        cp = static.CompiledProgram(main, build_strategy=strategy)
+        feed = _feed()
+        out = [exe.run(cp, feed=feed, fetch_list=[loss])[0]
+               for _ in range(steps)]
+        return [float(np.ravel(v)[0]) for v in out], dict(exe.counters)
+
+
+F32 = None
+
+
+def _f32_leg():
+    global F32
+    if F32 is None:
+        F32 = _run_leg(_strategy())
+    return F32
+
+
+# ---------------------------------------------------------------------------
+# rewrite structure
+# ---------------------------------------------------------------------------
+def test_amp_inserts_casts_and_lowers_white_ops():
+    main, _, loss = _train_program()
+    n_ops = len(main.global_block.ops)
+    opt, report = passes_mod.apply_passes(
+        main, ["x", "label"], [loss.name], _strategy(amp="bfloat16"))
+    ran = [s.name for s in report.stats]
+    assert ran[0] == "auto_mixed_precision"
+    assert report.amp["amp_casts_inserted"] > 0
+    assert report.amp["amp_ops_lowprec"] >= 3          # the three muls
+    assert report.amp["amp_master_params"] >= 3        # their f32 weights
+    assert report.amp["amp_lowprec_feeds"] == 1        # x, not label
+    types = [op.type for op in opt.global_block.ops]
+    assert "cast" in types
+    # user program untouched
+    assert len(main.global_block.ops) == n_ops
+    assert "cast" not in [op.type for op in main.global_block.ops]
+    # the float feed flipped low in the OPTIMIZED program only
+    assert opt.global_block.vars["x"].dtype == "bfloat16"
+    assert main.global_block.vars["x"].dtype == "float32"
+    # optimizer region untouched: every param stays an f32 master
+    pnames = [p.name for p in main.all_parameters()]
+    assert pnames
+    for n in pnames:
+        assert opt.global_block.vars[n].dtype == "float32", n
+
+
+def test_amp_black_ops_pinned_f32():
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 8])
+            h = static.nn.fc(x, 4)
+            out = static.softmax(h)
+        opt, _ = passes_mod.apply_passes(
+            main, ["x"], [out.name], _strategy(amp="bfloat16"))
+        blk = opt.global_block
+        (sm,) = [op for op in blk.ops if op.type == "softmax"]
+        # softmax input was cast back up; its (fetched) output stays f32
+        assert blk.vars[sm.inputs["X"][0]].dtype == "float32"
+        assert blk.vars[out.name].dtype == "float32"
+        exe = static.Executor()
+        exe.run(startup)
+        got = exe.run(static.CompiledProgram(
+            main, build_strategy=_strategy(amp="bfloat16")),
+            feed={"x": np.random.RandomState(0).randn(4, 8).astype(
+                np.float32)}, fetch_list=[out])[0]
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-3)
+
+
+def test_amp_cast_dedup_and_roundtrip_elision():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [-1, 8])
+        # h is fetched (protected): produced low, cast back up to f32
+        h = static.nn.fc(x, 8)
+        # both consumers of h cast it down again -> exact round trip,
+        # and the two identical casts dedup to one
+        a = static.nn.fc(h, 4)
+        b = static.nn.fc(h, 4)
+        out = static.elementwise_add(a, b)
+    opt, report = passes_mod.apply_passes(
+        main, ["x"], [h.name, out.name], _strategy(amp="bfloat16"))
+    assert report.amp["amp_casts_elided"] >= 1
+    # no cast op re-lowers h: its consumers read the low alias directly
+    down_casts = [op for op in opt.global_block.ops
+                  if op.type == "cast" and op.inputs["X"] == [h.name]]
+    assert not down_casts, [o.to_dict() for o in down_casts]
+
+
+# ---------------------------------------------------------------------------
+# loss parity matrix: O1/O2 x bf16/fp16 x other passes on/off
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("level", ["O1", "O2"])
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+@pytest.mark.parametrize("others", [False, True])
+def test_amp_matrix_loss_parity(level, dtype, others):
+    base, _ = _f32_leg()
+    losses, counters = _run_leg(_strategy(amp=dtype, level=level,
+                                          others=others))
+    assert counters["amp_casts_inserted"] > 0
+    assert counters["amp_ops_lowprec"] > 0
+    # first step is pure forward roundoff; later steps compound updates
+    assert abs(losses[0] - base[0]) / abs(base[0]) < 1e-2, (losses, base)
+    for got, want in zip(losses, base):
+        assert abs(got - want) / abs(want) < 5e-2, (losses, base)
+    if dtype == "float16":
+        assert counters.get("amp_loss_scaled", 0) >= 1
+
+
+def test_amp_env_zero_restores_bitwise_f32(monkeypatch):
+    base, _ = _f32_leg()
+    monkeypatch.setenv("PADDLE_AMP", "0")
+    losses, counters = _run_leg(_strategy(amp="bfloat16"))
+    assert losses == base
+    assert counters.get("amp_casts_inserted", 0) == 0
+
+
+def test_amp_env_force_enables(monkeypatch):
+    monkeypatch.setenv("PADDLE_AMP", "bf16")
+    main, _, loss = _train_program()
+    opt, report = passes_mod.apply_passes(
+        main, ["x", "label"], [loss.name], _strategy())  # amp=False
+    assert report.amp.get("amp_casts_inserted", 0) > 0
+    monkeypatch.setenv("PADDLE_AMP", "nonsense")
+    with pytest.raises(ValueError):
+        passes_mod.resolve_amp(None)
+
+
+# ---------------------------------------------------------------------------
+# executor integration
+# ---------------------------------------------------------------------------
+def test_amp_compile_cache_distinguishes_modes():
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        main, startup, loss = _train_program()
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed()
+        exe.run(static.CompiledProgram(main, _strategy()),
+                feed=feed, fetch_list=[loss])
+        assert exe.counters["compile_cache_misses"] == 1
+        exe.run(static.CompiledProgram(main, _strategy(amp="bfloat16")),
+                feed=feed, fetch_list=[loss])
+        assert exe.counters["compile_cache_misses"] == 2, \
+            "amp-on hit the f32 executable"
+        exe.run(static.CompiledProgram(main, _strategy(amp="bfloat16")),
+                feed=feed, fetch_list=[loss])
+        assert exe.counters["compile_cache_misses"] == 2
+        assert exe.counters["compile_cache_hits"] >= 1
+
+
+def test_amp_feed_cast_halves_h2d_bytes():
+    _, off = _run_leg(_strategy())
+    _, on = _run_leg(_strategy(amp="bfloat16"))
+    assert on["h2d_bytes"] < off["h2d_bytes"], (on, off)
+    # state upload identical (f32 masters both legs): the drop is feeds
+    assert on.get("state_h2d_bytes", 0) == off.get("state_h2d_bytes", 0)
+
+
+def test_amp_master_weights_bitwise_invariant():
+    """Inference-style run: amp wraps only compute, so the f32 params in
+    the scope must come back bitwise identical."""
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        main, startup = static.Program(), static.Program()
+        main.random_seed = startup.random_seed = 3
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 8])
+            out = static.reduce_mean(static.nn.fc(x, 4))
+        exe = static.Executor()
+        exe.run(startup)
+        pnames = [p.name for p in main.all_parameters()]
+        assert pnames
+        before = {n: np.asarray(scope.find_var(n)).tobytes()
+                  for n in pnames}
+        exe.run(static.CompiledProgram(main, _strategy(amp="bfloat16")),
+                feed={"x": np.ones((2, 8), np.float32)},
+                fetch_list=[out])
+        for n, b in before.items():
+            arr = np.asarray(scope.find_var(n))
+            assert arr.dtype == np.float32
+            assert arr.tobytes() == b, f"{n} mutated by amp compute"
+        assert exe.counters["amp_master_params"] >= 1
+
+
+def test_amp_rng_stable_under_dce():
+    """Casts shift op indices and DCE removes ops; __rng_slot must keep
+    the dropout mask identical between the two amp legs."""
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        main, startup = static.Program(), static.Program()
+        main.random_seed = startup.random_seed = 77
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 8])
+            static.scale(x, scale=2.0)  # dead op BEFORE the dropout
+            h = static.dropout(static.nn.fc(x, 8), dropout_prob=0.5)
+            out = static.reduce_mean(h)
+        static.Executor().run(startup)
+        feed = {"x": np.ones((4, 8), np.float32)}
+        legs = {}
+        for mode, others in (("plain", False), ("dce", True)):
+            # fresh executor per leg: the RNG folds in the step counter
+            exe = static.Executor()
+            legs[mode] = exe.run(static.CompiledProgram(
+                main, build_strategy=_strategy(amp="bfloat16",
+                                               others=others)),
+                feed=feed, fetch_list=[out])[0]
+        assert legs["plain"].tobytes() == legs["dce"].tobytes(), \
+            "amp + DCE shifted a dropout draw"
+
+
+def test_amp_never_casts_integer_outputs():
+    """Review regression: arg_max produces int64 from a float input; the
+    bookkeeping must not stamp it float, or a downstream gather gets
+    bfloat16 indices and the trace crashes."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [-1, 8])
+        z = static.data("z", [-1, 4])
+        y = static.nn.fc(x, 8)                  # white: bf16 producer
+        idx = static.argmax(z, axis=1)          # int64 from float input
+        out = static.reduce_mean(static.gather(y, idx))
+    opt, _ = passes_mod.apply_passes(
+        main, ["x", "z"], [out.name], _strategy(amp="bfloat16"))
+    for op in opt.global_block.ops:
+        if op.type == "cast":
+            src = op.inputs["X"][0]
+            assert idx.name not in src, "integer index var was cast"
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 8).astype(np.float32),
+            "z": rng.randn(4, 4).astype(np.float32)}
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        # no params needed beyond fc's: run the whole thing end to end
+        main2, startup2 = static.Program(), static.Program()
+        with static.program_guard(main2, startup2):
+            x = static.data("x", [-1, 8])
+            z = static.data("z", [-1, 4])
+            y = static.nn.fc(x, 8)
+            idx = static.argmax(z, axis=1)
+            out = static.reduce_mean(static.gather(y, idx))
+        exe = static.Executor()
+        exe.run(startup2)
+        got = exe.run(static.CompiledProgram(
+            main2, build_strategy=_strategy(amp="bfloat16")),
+            feed=feed, fetch_list=[out])[0]
+        assert np.isfinite(got).all()
+
+
+def test_amp_feed_into_black_op_stays_f32():
+    """Review regression: a feed consumed by a pinned op must not be
+    quantized host-side — the black-list contract holds at inputs."""
+    from paddle_tpu.static.passes import amp_feed_dtypes
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [-1, 8])        # white consumer only
+        w = static.data("w", [-1, 8])        # feeds softmax directly
+        h = static.nn.fc(x, 8)
+        out = static.reduce_mean(static.elementwise_mul(
+            static.softmax(w), h))
+    opt, report = passes_mod.apply_passes(
+        main, ["x", "w"], [out.name], _strategy(amp="bfloat16"))
+    assert opt.global_block.vars["x"].dtype == "bfloat16"
+    assert opt.global_block.vars["w"].dtype == "float32"
+    assert report.amp["amp_lowprec_feeds"] == 1
+    # the executor's host-cast map makes the same call
+    amp = passes_mod.resolve_amp(_strategy(amp="bfloat16"))
+    fdt = amp_feed_dtypes(main.global_block, amp)
+    assert "x" in fdt and "w" not in fdt
+
+
+def test_amp_py_reader_stages_low_from_first_batch():
+    """Review regression: batches prefetched before the first run used
+    to stage f32 (no stash yet) and force a second compile."""
+    from paddle_tpu.framework.errors import EOFException
+
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        main, startup = static.Program(), static.Program()
+        main.random_seed = startup.random_seed = 9
+        with static.program_guard(main, startup):
+            reader = static.py_reader(
+                capacity=4, shapes=[(-1, 8), (-1, 1)],
+                dtypes=["float32", "int64"], name="amp_pr")
+            x, y = static.read_file(reader)
+            loss = static.mean(static.softmax_with_cross_entropy(
+                static.nn.fc(x, 4), y))
+            static.SGD(0.1).minimize(loss)
+
+        def gen():
+            rng = np.random.RandomState(0)
+            for _ in range(4):
+                yield (rng.randn(8, 8).astype(np.float32),
+                       rng.randint(0, 4, (8, 1)).astype(np.int64))
+
+        reader.decorate_batch_generator(gen)
+        exe = static.Executor()
+        exe.run(startup)
+        cp = static.CompiledProgram(main,
+                                    build_strategy=_strategy(
+                                        amp="bfloat16"))
+        # the construction-time stash means the reader stages bf16
+        # before any run has happened
+        assert main._amp_feed_dtypes and \
+            str(main._amp_feed_dtypes["amp_pr.slot0"]) == "bfloat16"
+        for _epoch in range(2):
+            reader.start()
+            while True:
+                try:
+                    exe.run(cp, fetch_list=[loss])
+                except EOFException:
+                    reader.reset()
+                    break
+        assert exe.counters["compile_cache_misses"] == 1, \
+            "first prefetched batch staged f32 -> double compile"
+
+
+def test_amp_device_staged_feed_recast_to_run_dtype():
+    """Review regression: the program-level _amp_feed_dtypes stash is
+    shared, so a prefetch thread can stage a batch for the OTHER amp
+    config; the executor must re-cast device arrays to this run's
+    dtype instead of feeding the wrong graph or recompiling forever."""
+    import jax.numpy as jnp
+
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 8])
+            out = static.reduce_mean(static.nn.fc(x, 4))
+        exe = static.Executor()
+        exe.run(startup)
+        host = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        # stale bf16 staging into an amp-OFF run: cast up, f32 graph
+        r_off = exe.run(static.CompiledProgram(main, _strategy()),
+                        feed={"x": jnp.asarray(host, jnp.bfloat16)},
+                        fetch_list=[out])[0]
+        assert r_off.dtype == np.float32
+        # stale f32 staging into an amp-ON run: cast down — same
+        # executable as a host-cast bf16 feed (no second compile)
+        cp_on = static.CompiledProgram(main,
+                                       _strategy(amp="bfloat16"))
+        exe.run(cp_on, feed={"x": host}, fetch_list=[out])
+        misses = exe.counters["compile_cache_misses"]
+        exe.run(cp_on, feed={"x": jnp.asarray(host)}, fetch_list=[out])
+        assert exe.counters["compile_cache_misses"] == misses, \
+            "device f32 feed recompiled the amp executable"
+
+
+# ---------------------------------------------------------------------------
+# fp16 loss scaling
+# ---------------------------------------------------------------------------
+def test_fp16_threads_check_finite_and_unscale():
+    main, _, loss = _train_program()
+    opt, report = passes_mod.apply_passes(
+        main, ["x", "label"], [loss.name], _strategy(amp="float16"))
+    types = [op.type for op in opt.global_block.ops]
+    assert "check_finite_and_unscale" in types
+    assert report.amp.get("amp_loss_scaled") == 1
+    i_bwd = types.index("backward")
+    # scale feeds the backward, unscale follows it
+    assert types[i_bwd - 1] == "scale"
+    assert types[i_bwd + 1] == "check_finite_and_unscale"
+    (bwd,) = [op for op in opt.global_block.ops if op.type == "backward"]
+    assert bwd.inputs["Loss"][0].endswith("@amp.scaled")
+    # review regression: FoundInfinite must gate the update ops — a
+    # non-finite step skips params AND moments, not just zeroes grads
+    updates = [op for op in opt.global_block.ops if op.type == "sgd"]
+    assert updates
+    for op in updates:
+        assert op.inputs.get("FoundInfinite") == ["found_inf@amp"], \
+            op.to_dict()
+
+
+def test_update_kernels_skip_on_found_inf():
+    import jax.numpy as jnp
+
+    from paddle_tpu.static.kernels import KERNELS, ExecContext
+
+    p = jnp.asarray([1.0, 2.0], jnp.float32)
+    g = jnp.asarray([0.5, 0.5], jnp.float32)
+    m = jnp.asarray([0.1, 0.1], jnp.float32)
+    v = jnp.asarray([0.2, 0.2], jnp.float32)
+    one = jnp.asarray([1.0], jnp.float32)
+    lr = jnp.asarray([0.1], jnp.float32)
+    ins = {"Param": [p], "Grad": [g], "Moment1": [m], "Moment2": [v],
+           "Beta1Pow": [one * 0.9], "Beta2Pow": [one * 0.999],
+           "LearningRate": [lr]}
+    for flag, changed in ((False, True), (True, False)):
+        got = KERNELS["adam"](
+            dict(ins, FoundInfinite=[jnp.asarray([flag])]),
+            {}, ExecContext())
+        moved = not np.array_equal(np.asarray(got["ParamOut"][0]),
+                                   np.asarray(p))
+        assert moved == changed, (flag, got)
+        if not changed:   # skipped step: moments and beta-pows held too
+            np.testing.assert_array_equal(
+                np.asarray(got["Moment1Out"][0]), np.asarray(m))
+            np.testing.assert_array_equal(
+                np.asarray(got["Beta1PowOut"][0]), np.asarray(one * 0.9))
+    # without the input the kernel behaves exactly as before
+    got = KERNELS["sgd"]({"Param": [p], "Grad": [g],
+                          "LearningRate": [lr]}, {}, ExecContext())
+    np.testing.assert_allclose(np.asarray(got["ParamOut"][0]),
+                               np.asarray(p - 0.1 * g))
+
+
+def test_check_finite_and_unscale_kernel():
+    import jax.numpy as jnp
+
+    from paddle_tpu.static.kernels import KERNELS, ExecContext
+
+    fn = KERNELS["check_finite_and_unscale"]
+    g = jnp.asarray([2.0, 4.0], jnp.float32)
+    out = fn({"X": [g]}, {"scale": 2.0}, ExecContext())
+    np.testing.assert_array_equal(np.asarray(out["Out"][0]), [1.0, 2.0])
+    assert not bool(out["FoundInfinite"][0][0])
+    bad = jnp.asarray([1.0, np.inf], jnp.float32)
+    out = fn({"X": [g, bad]}, {"scale": 2.0}, ExecContext())
+    assert bool(out["FoundInfinite"][0][0])
+    # non-finite step: every grad zeroed -> optimizer no-op
+    for o in out["Out"]:
+        np.testing.assert_array_equal(np.asarray(o), [0.0, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# amp.decorate satellites (master_weight / save_dtype)
+# ---------------------------------------------------------------------------
+def test_decorate_master_weight_keeps_f32_masters():
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn, optimizer
+
+    paddle.seed(0)
+    m = nn.Linear(4, 3)
+    o = optimizer.Momentum(parameters=m.parameters(), learning_rate=0.1)
+    m, o = amp.decorate(m, o, level="O2", dtype="bfloat16",
+                        master_weight=True)
+    p = m.parameters()[0]
+    assert str(p.value.dtype) == "bfloat16"
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(
+        np.float32)).astype("bfloat16")
+    before = np.asarray(p.value.astype(jnp.float32)).copy()
+    loss = paddle.mean(m(x))
+    loss.backward()
+    o.step()
+    slot = o._slots[id(p)]
+    assert str(slot["__master__"].dtype) == "float32"
+    assert str(slot["velocity"].dtype) == "float32"
+    assert str(p.value.dtype) == "bfloat16"
+    assert not np.array_equal(
+        before, np.asarray(p.value.astype(jnp.float32)))
+    # compute param is exactly the cast-down of the master
+    np.testing.assert_array_equal(
+        np.asarray(p.value),
+        np.asarray(slot["__master__"].astype(jnp.bfloat16)))
+    # masters ride the optimizer checkpoint
+    assert any(k.endswith("@__master__") for k in o.state_dict())
+
+
+def test_decorate_after_warmup_upgrades_existing_slots():
+    """Review regression: step-then-decorate used to leave master-less
+    slots, and the next step silently promoted the param back to f32."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn, optimizer
+
+    paddle.seed(0)
+    m = nn.Linear(4, 3)
+    o = optimizer.Adam(parameters=m.parameters(), learning_rate=1e-2)
+    x32 = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(
+        np.float32))
+    paddle.mean(m(x32)).backward()
+    o.step()                      # slots exist, no masters yet
+    m, o = amp.decorate(m, o, level="O2", dtype="bfloat16",
+                        master_weight=True)
+    p = m.parameters()[0]
+    slot = o._slots[id(p)]
+    assert "__master__" in slot
+    assert str(slot["__master__"].dtype) == "float32"
+    paddle.mean(m(x32.astype("bfloat16"))).backward()
+    o.step()
+    assert str(p.value.dtype) == "bfloat16", \
+        "post-decorate step reverted the param to f32"
+    np.testing.assert_array_equal(
+        np.asarray(p.value),
+        np.asarray(o._slots[id(p)]["__master__"].astype(jnp.bfloat16)))
+
+
+def test_optimizer_multi_precision_kwarg_honored():
+    """Review regression: subclasses swallowed multi_precision in **kw."""
+    from paddle_tpu import optimizer
+
+    for cls in (optimizer.Adam, optimizer.AdamW, optimizer.Momentum,
+                optimizer.SGD, optimizer.Lamb, optimizer.RMSProp):
+        o = cls(learning_rate=1e-3, parameters=[],
+                multi_precision=True)
+        assert o._multi_precision is True, cls.__name__
+
+
+def test_ir_passes_escape_also_disables_amp_feed_cast(monkeypatch):
+    """Review regression: PADDLE_IR_PASSES=0 disabled the graph rewrite
+    but the executor still cast feeds bf16 — a bitwise-f32 escape that
+    wasn't. Both must switch together."""
+    base, _ = _f32_leg()
+    monkeypatch.setenv("PADDLE_IR_PASSES", "0")
+    monkeypatch.setenv("PADDLE_AMP", "bf16")
+    losses, counters = _run_leg(_strategy(amp="bfloat16"))
+    assert losses == base, "escape hatch changed numerics"
+    assert counters.get("amp_casts_inserted", 0) == 0
+
+
+def test_decorate_master_weight_false_opts_out():
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn, optimizer
+
+    paddle.seed(0)
+    m = nn.Linear(4, 3)
+    o = optimizer.SGD(parameters=m.parameters(), learning_rate=0.1)
+    amp.decorate(m, o, level="O2", dtype="bfloat16", master_weight=False)
+    assert o._multi_precision is False
+
+
+def test_decorate_save_dtype_pins_state_dict():
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn
+
+    paddle.seed(0)
+    m = nn.Linear(4, 3)
+    amp.decorate(m, level="O2", dtype="bfloat16", save_dtype="float32")
+    assert all(str(p.value.dtype) == "bfloat16" for p in m.parameters())
+    sd = m.state_dict()
+    assert all(str(v.dtype) == "float32" for v in sd.values())
+    # live params untouched by the save cast
+    assert all(str(p.value.dtype) == "bfloat16" for p in m.parameters())
+    # review regression: loading must hit the LIVE params, not the
+    # save-cast copies state_dict hands out
+    ones = {k: np.ones_like(np.asarray(v.value, np.float32))
+            for k, v in sd.items()}
+    m.set_state_dict(ones)
+    for p in m.parameters():
+        assert str(p.value.dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(p.value, np.float32), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# tools/dump_passes.py --amp
+# ---------------------------------------------------------------------------
+def test_dump_passes_amp_table():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dump_passes.py"),
+         "--demo", "--amp"], env=env, capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "auto_mixed_precision" in out.stdout
+    assert "lowprec" in out.stdout
+    assert "f32-pinned" in out.stdout
+    assert "amp_casts_inserted" in out.stdout

@@ -141,6 +141,19 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last.get("exec_cache_shared_hit") is True, last
     # no PADDLE_COMPILE_CACHE_DIR in this run -> no disk traffic
     assert last["disk_cache_hits"] == 0
+    # mixed-precision probe contract: amp-on runs end to end, the loss
+    # delta vs f32 stays within roundoff tolerance, casts were inserted
+    # and the bf16 feed path really shrank the h2d transfer
+    for key in ("amp_tokens_per_sec", "amp_loss_delta",
+                "amp_casts_inserted", "amp_casts_elided",
+                "amp_master_params", "amp_h2d_bytes",
+                "amp_f32_h2d_bytes"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["amp_tokens_per_sec"] > 0, last
+    assert last["amp_loss_delta"] <= 1e-2, last
+    assert last["amp_casts_inserted"] > 0, last
+    assert last["amp_master_params"] > 0, last
+    assert last["amp_h2d_bytes"] < last["amp_f32_h2d_bytes"], last
 
 
 @pytest.mark.slow

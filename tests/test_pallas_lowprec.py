@@ -1,0 +1,110 @@
+"""Low-precision parity for the Pallas-backed ops.
+
+bf16 flash_attention and fused_linear_cross_entropy must track the f32
+XLA reference within bf16 roundoff (the AMP pass routes exactly these
+ops low), and the autotune cache must keep per-dtype entries — a block
+choice timed for f32 must never be served for bf16 (the two dtypes
+prefer different kernels on the MXU).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import autotune as at
+from paddle_tpu.ops.pallas import fused_xent as fx
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_or_fallback
+
+
+def _qkv(b=2, l=64, h=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(rng.randn(b, l, h, d).astype(np.float32) * 0.5
+                 for _ in range(3))
+
+
+def test_flash_attention_bf16_matches_f32_reference():
+    q, k, v = _qkv()
+    ref = np.asarray(flash_attention_or_fallback(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    out = flash_attention_or_fallback(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(k, jnp.bfloat16),
+        jnp.asarray(v, jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_flash_attention_bf16_causal_matches_f32_reference():
+    q, k, v = _qkv(seed=1)
+    ref = np.asarray(flash_attention_or_fallback(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), is_causal=True))
+    out = flash_attention_or_fallback(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(k, jnp.bfloat16),
+        jnp.asarray(v, jnp.bfloat16), is_causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_flash_attention_bf16_grads_close():
+    q, k, v = _qkv(seed=2)
+
+    def loss(a, b, c):
+        return jnp.sum(flash_attention_or_fallback(a, b, c))
+
+    g32 = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g16 = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(k, jnp.bfloat16),
+        jnp.asarray(v, jnp.bfloat16))
+    for a, b in zip(g32, g16):
+        np.testing.assert_allclose(np.asarray(b, np.float32),
+                                   np.asarray(a), atol=5e-2, rtol=5e-2)
+
+
+def test_fused_xent_bf16_matches_f32_reference():
+    rng = np.random.RandomState(3)
+    n, hd, vocab = 16, 64, 128   # hd % 128 != 0: deterministic XLA path
+    h = rng.randn(n, hd).astype(np.float32) * 0.2
+    w = rng.randn(vocab, hd).astype(np.float32) * 0.2
+    b = rng.randn(vocab).astype(np.float32) * 0.1
+    lab = rng.randint(0, vocab, (n,)).astype(np.int32)
+    ref = float(fx.fused_linear_cross_entropy(
+        jnp.asarray(h), jnp.asarray(w), jnp.asarray(b),
+        jnp.asarray(lab)))
+    out = float(fx.fused_linear_cross_entropy(
+        jnp.asarray(h, jnp.bfloat16), jnp.asarray(w, jnp.bfloat16),
+        jnp.asarray(b, jnp.bfloat16), jnp.asarray(lab)))
+    # the kernel accumulates logits/lse in f32 whatever the input dtype,
+    # so bf16 inputs only cost input roundoff
+    assert abs(out - ref) / max(abs(ref), 1e-8) < 2e-2, (out, ref)
+
+
+def test_fused_xent_bf16_ignore_index_still_finite():
+    rng = np.random.RandomState(4)
+    h = rng.randn(8, 64).astype(np.float32)
+    w = rng.randn(128, 64).astype(np.float32)
+    b = np.zeros(128, np.float32)
+    lab = np.full((8,), -100, np.int32)
+    out = float(fx.fused_linear_cross_entropy(
+        jnp.asarray(h, jnp.bfloat16), jnp.asarray(w, jnp.bfloat16),
+        jnp.asarray(b, jnp.bfloat16), jnp.asarray(lab)))
+    assert out == 0.0
+
+
+def test_autotune_cache_key_separates_dtypes():
+    """Lock in autotune.py keying on str(dtype): one shape, two dtypes,
+    two independent cache rows (memory AND disk key)."""
+    at.reset()
+    try:
+        k32 = (1, 128, 1, 64, "float32", False, 0.0)
+        kbf = (1, 128, 1, 64, "bfloat16", False, 0.0)
+        assert k32 != kbf
+        assert at._disk_key(k32) != at._disk_key(kbf)
+        at._cache[k32] = "xla"
+        at._cache[kbf] = "short"
+        choices = at.cached_choices()
+        assert choices[k32] == "xla" and choices[kbf] == "short"
+        # the live key builder puts str(dtype) at the same slot
+        assert str(jnp.bfloat16.dtype if hasattr(jnp.bfloat16, "dtype")
+                   else np.dtype(jnp.bfloat16)) == "bfloat16"
+    finally:
+        at.reset()

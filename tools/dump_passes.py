@@ -20,6 +20,10 @@ Usage:
     python tools/dump_passes.py --demo --dot /tmp/optimized.dot
 
 Knobs off by name: --disable fuse_elewise_add_act_ops,cse
+
+Mixed precision: --amp [bf16|fp16] enables the auto_mixed_precision
+pass and prints a per-op dtype table (inserted/elided casts, f32-pinned
+ops, low-precision ops) after the usual per-pass report.
 """
 from __future__ import annotations
 
@@ -74,6 +78,36 @@ def _load_target(path):
     return program, [], []
 
 
+def _amp_table(program, report):
+    """Per-op dtype table of the optimized block: which ops run low
+    precision, which are f32-pinned, where casts were inserted."""
+    from paddle_tpu.static.passes import _LOW_PRECISION, _amp_lists
+
+    _, black = _amp_lists()
+    blk = program.global_block
+    lines = [f"{'#':>3} {'op':<26}{'out dtype':<12}{'amp':<12}outputs"]
+    for i, op in enumerate(blk.ops):
+        outs = op.output_names()
+        dts = {str(getattr(blk.vars.get(n), "dtype", "?")) for n in outs}
+        if op.type == "cast":
+            note = ("cast" if not any(
+                "@amp." in n for n in outs + op.input_names())
+                else "cast(amp)")
+        elif op.type in black:
+            note = "f32-pinned"
+        elif dts & _LOW_PRECISION:
+            note = "lowprec"
+        else:
+            note = "-"
+        lines.append(f"{i:>3} {op.type:<26}"
+                     f"{','.join(sorted(dts)) or '-':<12}{note:<12}"
+                     f"{','.join(outs)[:44]}")
+    if report.amp:
+        lines.append("amp counters: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(report.amp.items())))
+    return "\n".join(lines)
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="print per-pass op-count/timing table for a program")
@@ -87,6 +121,10 @@ def main():
                     help="comma-separated fetch names (override)")
     ap.add_argument("--disable", default=None,
                     help="comma-separated BuildStrategy knobs to turn off")
+    ap.add_argument("--amp", nargs="?", const="bf16", default=None,
+                    choices=("bf16", "bfloat16", "fp16", "float16"),
+                    help="run the auto_mixed_precision pass (default "
+                         "bf16) and print the per-op dtype table")
     ap.add_argument("--dot", default=None,
                     help="write the optimized block as graphviz dot")
     args = ap.parse_args()
@@ -117,10 +155,16 @@ def main():
             if not hasattr(strategy, knob):
                 ap.error(f"unknown BuildStrategy knob {knob!r}")
             setattr(strategy, knob, False)
+    if args.amp:
+        strategy.amp = True
+        strategy.amp_dtype = args.amp
 
     optimized, report = static.apply_passes(program, feeds, fetches,
                                             strategy)
     print(report.table())
+    if args.amp:
+        print()
+        print(_amp_table(optimized, report))
     if args.dot:
         static.save_dot(optimized, args.dot)
         print(f"optimized block dot -> {args.dot}")
