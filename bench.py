@@ -289,6 +289,114 @@ def _amp_probe(steps=4):
     }
 
 
+def _remat_probe(steps=3):
+    """Rematerialization + gradient-merge probe.
+
+    Remat leg: a wide-interior / narrow-boundary MLP (fc->FF, dropout,
+    fc->H — the shape where stashing hurts) trained remat-OFF and
+    remat-ON from identical init. The losses must be BITWISE equal (the
+    recomputed dropout replays its mask — the RNG invariant), and
+    compiled.memory_analysis() temp/peak bytes must be strictly lower
+    with remat on: the objective XLA-level gate, not a wall-clock guess.
+
+    Merge leg: the same net (dropout-free, so per-microbatch masks can't
+    shadow the comparison) with gradient_merge_k=4 — ONE dispatch per 4
+    microbatches — against the unmerged f32 run on the identical batch;
+    loss must agree within 1e-5 (mean-of-means vs whole-batch mean).
+
+    Fixed small shapes: graph-level machinery, not throughput."""
+    import time as _time
+
+    import paddle_tpu.static as static
+
+    H, FF, B, L = 32, 256, 64, 3
+
+    def build(dropout, seed=1234):
+        main, startup = static.Program(), static.Program()
+        main.random_seed = startup.random_seed = seed
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, H])
+            label = static.data("label", [-1, 1], dtype="int64")
+            h = x
+            for _ in range(L):
+                h = static.nn.fc(h, FF, act="relu")
+                if dropout:
+                    h = static.dropout(h, dropout_prob=0.1)
+                h = static.nn.fc(h, H)
+            logits = static.nn.fc(h, 4)
+            loss = static.mean(
+                static.softmax_with_cross_entropy(logits, label))
+            static.SGD(0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.randn(B, H).astype(np.float32),
+            "label": rng.randint(0, 4, (B, 1)).astype(np.int64)}
+    _PIN = ("PADDLE_AMP", "PADDLE_IR_PASSES", "PADDLE_AMP_LEVEL")
+    saved_env = {k: os.environ.pop(k) for k in _PIN if k in os.environ}
+    legs = {}
+    try:
+        for mode in ("off", "on"):
+            bs = static.BuildStrategy()
+            bs.recompute = mode == "on"
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                main, startup, loss = build(dropout=True)
+                exe = static.Executor()
+                exe.run(startup)
+                cp = static.CompiledProgram(main, build_strategy=bs)
+                losses = [
+                    np.ravel(exe.run(cp, feed=feed, fetch_list=[loss])[0])
+                    for _ in range(steps)]
+                legs[mode] = {
+                    "losses": np.concatenate(losses),
+                    "mem": exe.memory_stats(),
+                    "counters": dict(exe.counters)}
+        # gradient merge: k=4 scan vs the unmerged f32 step, same batch
+        gm = {}
+        for mode in ("unmerged", "merged"):
+            bs = static.BuildStrategy()
+            if mode == "merged":
+                bs.gradient_merge_k = 4
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                main, startup, loss = build(dropout=False)
+                exe = static.Executor()
+                exe.run(startup)
+                cp = static.CompiledProgram(main, build_strategy=bs)
+                first = float(np.ravel(
+                    exe.run(cp, feed=feed, fetch_list=[loss])[0])[0])
+                t0 = _time.perf_counter()
+                for _ in range(steps):
+                    exe.run(cp, feed=feed, fetch_list=[loss])
+                dt = _time.perf_counter() - t0
+                gm[mode] = {"first": first, "dt": dt,
+                            "counters": dict(exe.counters)}
+    finally:
+        os.environ.update(saved_env)
+    off, on = legs["off"], legs["on"]
+    mc = gm["merged"]["counters"]
+    tokens = B * steps
+    return {
+        # the acceptance gate: strictly lower temp/peak, bitwise loss
+        "remat_temp_bytes": int(on["mem"].get("temp_bytes", 0)),
+        "f32_temp_bytes": int(off["mem"].get("temp_bytes", 0)),
+        "remat_peak_bytes": int(on["mem"].get("peak_bytes", 0)),
+        "f32_peak_bytes": int(off["mem"].get("peak_bytes", 0)),
+        "remat_parity_bitwise":
+            off["losses"].tobytes() == on["losses"].tobytes(),
+        "remat_segments": int(on["counters"].get("remat_segments", 0)),
+        "memory_stats": {k: int(v) for k, v in on["mem"].items()},
+        "gm_tokens_per_sec": round(tokens / gm["merged"]["dt"], 2),
+        "gm_f32_tokens_per_sec": round(tokens / gm["unmerged"]["dt"], 2),
+        "gm_loss_delta": round(
+            abs(gm["merged"]["first"] - gm["unmerged"]["first"]), 8),
+        "gm_k": 4,
+        "gm_dispatches": int(mc.get("gm_dispatches", 0)),
+        "gm_microbatches": int(mc.get("gm_microbatches", 0)),
+    }
+
+
 def bench_bert(seq=128, smoke=False, trend=False):
     """BASELINE.md config 3: BERT-base pretraining, tokens/sec/chip.
 
@@ -401,9 +509,17 @@ def bench_bert(seq=128, smoke=False, trend=False):
         amp_probe = _amp_probe()
     except Exception as e:
         amp_probe = {"amp_probe_error": f"{type(e).__name__}: {e}"}
+    # rematerialization + gradient-merge probe: XLA temp/peak bytes must
+    # strictly drop with remat on at bitwise-identical loss; k=4 merge
+    # runs one dispatch per 4 microbatches within 1e-5 of unmerged f32
+    try:
+        remat_probe = _remat_probe()
+    except Exception as e:
+        remat_probe = {"remat_probe_error": f"{type(e).__name__}: {e}"}
     return {
         **pass_probe,
         **amp_probe,
+        **remat_probe,
         "value": tokens / dt, "unit": "tokens/s",
         "flops_per_step": flops_per_step,
         "steps_per_sec": steps / dt, "dt": dt, "steps": steps,

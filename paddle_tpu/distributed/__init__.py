@@ -64,7 +64,7 @@ from .fleet_compat import (  # noqa: F401,E402
 )
 from ..optimizer.meta import (  # noqa: F401,E402
     GradientMergeOptimizer, LocalSGDOptimizer, PipelineOptimizer,
-    RecomputeOptimizer,
+    RecomputeOptimizer, recompute,
 )
 from ..io.fs import (  # noqa: F401,E402
     ExecuteError, FS, FSFileExistsError, FSFileNotExistsError,

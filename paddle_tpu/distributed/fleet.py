@@ -108,6 +108,31 @@ class DistributedStrategy:
             if hasattr(cfg, k):
                 setattr(cfg, k, v)
 
+    def to_build_strategy(self):
+        """Map the distributed flags onto static-graph BuildStrategy
+        knobs: recompute -> the recompute_segmentation pass (checkpoint
+        names included), gradient_merge -> the executor's
+        scan-over-microbatches step, amp -> the auto_mixed_precision
+        pass. fleet.distributed_optimizer stamps the result on the
+        program when minimize() is handed a static loss, so a plain
+        Executor.run picks it up without a CompiledProgram."""
+        from ..static.compiler import BuildStrategy
+
+        bs = BuildStrategy()
+        if self.amp:
+            bs.amp = True
+            bs.amp_dtype = self.amp_configs.dtype
+            bs.amp_init_loss_scale = self.amp_configs.init_loss_scaling
+        if self.recompute:
+            bs.recompute = True
+            bs.recompute_checkpoints = tuple(
+                str(getattr(c, "name", c))
+                for c in self.recompute_configs.checkpoints)
+        if self.gradient_merge:
+            bs.gradient_merge_k = int(self.gradient_merge_configs.k_steps)
+            bs.gradient_merge_avg = bool(self.gradient_merge_configs.avg)
+        return bs
+
 
 class RoleMakerBase:
     def worker_num(self):
@@ -316,6 +341,10 @@ class _FleetOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from ..static.ir import Variable as StaticVariable
+
+        if isinstance(loss, StaticVariable):
+            return self._minimize_static(loss, parameter_list, no_grad_set)
         if self._scaler is not None:
             scaled = self._scaler.scale(loss)
             if scaled._node is not None:
@@ -323,6 +352,43 @@ class _FleetOptimizer:
             self.step()
             return None, None
         return self._inner.minimize(loss)
+
+    def _minimize_static(self, loss, parameter_list, no_grad_set):
+        """Static-graph route: the dygraph meta wrappers' host-side
+        schedules (grad accumulation loops, eager checkpoint wrapping)
+        are replaced by their COMPILED equivalents — the strategy maps
+        onto BuildStrategy knobs (recompute segmentation pass +
+        scan-over-microbatches gradient merge), stamped on the program
+        so Executor.run / CompiledProgram builds with them."""
+        s = self._strategy
+        base = self._inner
+        seen = set()
+        while id(base) not in seen:
+            seen.add(id(base))
+            nxt = base.__dict__.get("inner") or base.__dict__.get("_inner")
+            if nxt is None:
+                break
+            base = nxt
+        if not hasattr(base, "apply_gradients"):
+            raise TypeError(
+                "fleet.distributed_optimizer(...).minimize was handed a "
+                "static Variable loss, but the wrapped optimizer "
+                f"({type(base).__name__}) is not a static optimizer")
+        from ..static.backward import append_backward
+        from ..static.optimizer import resolve_grad_clip
+
+        cps = None
+        if s.recompute and s.recompute_configs.checkpoints:
+            cps = [str(getattr(c, "name", c))
+                   for c in s.recompute_configs.checkpoints]
+        params_grads = append_backward(loss, parameter_list, no_grad_set,
+                                       checkpoints=cps)
+        clip = resolve_grad_clip(base)
+        if clip is not None:
+            params_grads = clip(params_grads)
+        base.apply_gradients(params_grads)
+        loss.block.program._fleet_build_strategy = s.to_build_strategy()
+        return [], params_grads
 
     def clear_grad(self):
         self._inner.clear_grad()

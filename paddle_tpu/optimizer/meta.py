@@ -16,7 +16,15 @@ from .optimizer import Optimizer
 
 class GradientMergeOptimizer:
     """Accumulate grads for k_steps micro-batches, then apply once
-    (reference optimizer.py:4988)."""
+    (reference optimizer.py:4988).
+
+    avg semantics: with ``avg=True`` the MERGED gradient is divided by
+    ``k_steps`` once before the single inner step — single-large-batch
+    parity — never a per-microbatch lr rescale. After the merged update
+    the param grads are cleared here (not left to the caller): the
+    reference's minimize-only protocol issues no clear_grad between
+    cycles, and a stale merged grad would be double-counted into the
+    next cycle's first backward()."""
 
     def __init__(self, inner_optimizer, k_steps=1, avg=True):
         self.inner = inner_optimizer
@@ -46,6 +54,8 @@ class GradientMergeOptimizer:
                     g = g / self.k_steps
                 p.grad = Tensor(g)
         self.inner.step()
+        for p in params:
+            p.clear_grad()
         self._acc.clear()
         self._count = 0
         return True
@@ -63,24 +73,202 @@ class GradientMergeOptimizer:
         return getattr(self.inner, item)
 
 
+def _segment_params(fn):
+    """Trainable Tensors a recompute segment closes over: a Layer's (or
+    a bound Layer method's) parameters. Plain functions close over
+    nothing trainable — their tensor args carry the gradient path."""
+    owner = fn
+    if not hasattr(owner, "parameters") and hasattr(fn, "__self__"):
+        owner = fn.__self__
+    if hasattr(owner, "parameters"):
+        try:
+            return list(owner.parameters())
+        except TypeError:
+            return list(owner.parameters)
+    return []
+
+
+def recompute(function, *args, **kwargs):
+    """Eager activation rematerialization (reference
+    fleet.utils.recompute / RecomputeOptimizer checkpoints): run
+    ``function`` WITHOUT recording per-op vjp closures — the tape gets
+    ONE node for the whole segment whose backward re-runs the segment
+    under ``jax.vjp`` at cotangent time. Forward-pass memory for the
+    segment is its inputs + params, not its activations.
+
+    RNG correctness: the default generator's state is snapshotted before
+    the forward run and restored around the recompute, so a dropout
+    inside the segment replays the bitwise-identical mask.
+
+    Inside a jit trace (TrainStep) the same call lowers to
+    ``jax.checkpoint`` — XLA remat, same semantics, compiled."""
+    from ..framework import random as random_mod
+    from ..framework import tape as tape_mod
+    from ..framework.tensor import Tensor
+
+    # keyword Tensors get no tape edge (the vjp replay substitutes
+    # positional tensors only) — silently wrong gradients; refuse, like
+    # the reference fleet.utils.recompute
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor):
+            raise ValueError(
+                f"recompute: Tensor keyword argument {k!r} is not "
+                "supported — pass tensors positionally so gradients "
+                "flow through them")
+    params = _segment_params(function)
+    arg_ts = [a for a in args if isinstance(a, Tensor)]
+
+    def _call_with(arg_vals, param_vals, meta):
+        saved = [(p, p._value) for p in params]
+        try:
+            for p, v in zip(params, param_vals):
+                p._value = v
+            it = iter(arg_vals)
+            new_args = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                        for a in args]
+            with tape_mod.no_grad():
+                out = function(*new_args, **kwargs)
+        finally:
+            for p, v in saved:
+                p._value = v
+        single = not isinstance(out, (tuple, list))
+        meta["single"] = single
+        outs = [out] if single else list(out)
+        return [o.value if isinstance(o, Tensor) else jnp.asarray(o)
+                for o in outs]
+
+    traced = any(isinstance(getattr(t, "_value", None), jax.core.Tracer)
+                 for t in arg_ts + params)
+    meta: dict = {}
+    if traced:
+        # jit path: values are tracers, the tape is off — lower straight
+        # to jax.checkpoint over a pure function of (args, params)
+        vals = jax.checkpoint(
+            lambda av, pv: _call_with(av, pv, meta))(
+                [t.value for t in arg_ts], [p.value for p in params])
+        outs = [Tensor(v, stop_gradient=False) for v in vals]
+        return outs[0] if meta["single"] else tuple(outs)
+
+    gen = random_mod.default_generator()
+    rng_before = (gen._key, gen._seed)
+    out_vals = _call_with([t.value for t in arg_ts],
+                          [p.value for p in params], meta)
+    in_tensors = [t for t in arg_ts + params if not t.stop_gradient]
+    single = meta["single"]
+    if not (tape_mod.grad_enabled() and in_tensors):
+        outs = [Tensor(v) for v in out_vals]
+        return outs[0] if single else tuple(outs)
+
+    in_ids = {id(t) for t in in_tensors}
+
+    def pure(*vals):
+        # re-run the segment with the cotangent-path inputs substituted
+        # and the RNG rewound: identical draws, recomputed activations
+        sub = dict(zip((id(t) for t in in_tensors), vals))
+        av = [sub.get(id(t), t.value) for t in arg_ts]
+        pv = [sub.get(id(p), p.value) for p in params]
+        saved_rng = (gen._key, gen._seed)
+        gen._key, gen._seed = rng_before
+        try:
+            return tuple(_call_with(av, pv, {}))
+        finally:
+            gen._key, gen._seed = saved_rng
+
+    def vjp(cts):
+        cts = cts if isinstance(cts, tuple) else (cts,)
+        primals = tuple(t.value for t in in_tensors)
+        _, vjp_fn = jax.vjp(pure, *primals)
+        return vjp_fn(tuple(cts))
+
+    node = tape_mod.TapeNode(vjp, in_tensors, "recompute")
+    outs = []
+    for v in out_vals:
+        t = Tensor(v, stop_gradient=False)
+        t._node = node
+        node.add_output(t)
+        outs.append(t)
+    del in_ids
+    return outs[0] if single else tuple(outs)
+
+
 class RecomputeOptimizer:
-    """API parity with reference optimizer.py:4513. On TPU the actual
-    rematerialisation is jax.checkpoint applied to forward segments (see
-    paddle_tpu.distributed.fleet recompute strategy); eagerly this wrapper
-    is a pass-through."""
+    """Reference optimizer.py:4513, made real on both execution paths.
+
+    Static: ``minimize`` on a static ``Variable`` loss appends the
+    backward op WITH the registered checkpoint names — the
+    recompute_segmentation pass (static/passes.py) splits the forward
+    region at them and the executor lowers each segment through
+    ``jax.checkpoint`` (BuildStrategy.recompute is the knob-only
+    spelling of the same thing; fleet.distributed_optimizer routes a
+    recompute strategy onto those knobs).
+
+    Dygraph: ``_set_checkpoints`` accepts sub-Layers / callables; each
+    has its forward wrapped in :func:`recompute` IN PLACE, so the next
+    forward pass records one tape node per segment and ``minimize``'s
+    backward rematerializes activations instead of reading stashed
+    residuals (identical dropout masks — RNG state is rewound for the
+    replay)."""
 
     def __init__(self, optimizer):
         self.inner = optimizer
         self._checkpoints = None
+        self._wrapped = []
 
     def _set_checkpoints(self, checkpoints):
-        self._checkpoints = checkpoints
+        self._unwrap_layers()
+        self._checkpoints = list(checkpoints or [])
+        for c in self._checkpoints:
+            if callable(c) and not isinstance(c, str):
+                self._wrap_layer(c)
+
+    def _wrap_layer(self, layer):
+        import functools
+
+        orig = layer.forward
+
+        @functools.wraps(orig)
+        def wrapped(*a, **k):
+            return recompute(orig, *a, **k)
+
+        layer.forward = wrapped
+        self._wrapped.append((layer, orig))
+
+    def _unwrap_layers(self):
+        for layer, orig in self._wrapped:
+            layer.forward = orig
+        self._wrapped = []
+
+    def _static_checkpoint_names(self):
+        names = []
+        for c in self._checkpoints or []:
+            if isinstance(c, str):
+                names.append(c)
+            elif hasattr(c, "name") and not callable(c):
+                names.append(c.name)
+        return names
 
     def step(self):
         self.inner.step()
 
-    def minimize(self, loss, **kw):
-        return self.inner.minimize(loss, **kw)
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..static.ir import Variable as StaticVariable
+
+        if isinstance(loss, StaticVariable) and \
+                hasattr(self.inner, "apply_gradients"):
+            from ..static.backward import append_backward
+
+            from ..static.optimizer import resolve_grad_clip
+
+            params_grads = append_backward(
+                loss, parameter_list, no_grad_set,
+                checkpoints=self._static_checkpoint_names() or None)
+            clip = resolve_grad_clip(self.inner)
+            if clip is not None:
+                params_grads = clip(params_grads)
+            self.inner.apply_gradients(params_grads)
+            return [], params_grads
+        return self.inner.minimize(loss)
 
     def clear_grad(self):
         self.inner.clear_grad()

@@ -83,6 +83,21 @@ _state = {
 #                      events, merged into exe.counters like the fault
 #                      counters below
 #
+# Rematerialization + gradient-merge counters (recompute_segmentation
+# pass in static/passes.py; _gm_step_fn in static/executor.py):
+#   remat_segments     checkpoint segments the forward region was split
+#                      into (per build)
+#   remat_stash_vars / remat_recompute_vars  boundary vars saved for the
+#                      backward vs interior vars recomputed
+#   gm_dispatches / gm_microbatches  gradient-merge steps dispatched and
+#                      the microbatches they covered (microbatches /
+#                      dispatches = k)
+#   xla_temp_bytes / xla_peak_bytes / xla_argument_bytes /
+#   xla_output_bytes   GAUGES (set_counter, not accumulated): the last
+#                      built executable's compiled.memory_analysis() —
+#                      the objective remat gate (temp/peak must drop
+#                      with recompute on; exe.memory_stats() mirrors)
+#
 #   retry_attempts     re-attempts after a retryable failure (Retrier)
 #   retry_giveups      retry budget/deadline exhausted, last error raised
 #   faults_injected    armed fault points fired (tests / PADDLE_FAULT_SPEC)
@@ -116,6 +131,15 @@ def bump_counter(name: str, n: int = 1) -> None:
     """Add ``n`` to the global executor counter ``name`` (thread-safe)."""
     with _counters_lock:
         _counters[name] += n
+
+
+def set_counter(name: str, value: int) -> None:
+    """GAUGE semantics: overwrite counter ``name`` with ``value``
+    (thread-safe). Used for point-in-time quantities — the xla_*_bytes
+    memory-analysis numbers of the last-built executable — where
+    accumulation would be meaningless."""
+    with _counters_lock:
+        _counters[name] = value
 
 
 def counters_snapshot() -> dict:

@@ -65,8 +65,40 @@ def append_backward(loss: Variable,
     return [(block.var(p), block.var(g)) for p, g in zip(params, grad_names)]
 
 
+def _remat_plan(ops, idx):
+    """Group ops [0, idx) into maximal runs of equal ``__remat_seg``
+    stamp (the recompute_segmentation pass, static/passes.py). Returns
+    [(start, end, wrapped), ...] covering the range, or None when no op
+    is stamped (remat off)."""
+    segs = []
+    cur = None  # (seg_id_or_None, start)
+    found = False
+    for i in range(idx):
+        sid = ops[i].attrs.get("__remat_seg")
+        if sid is not None:
+            found = True
+        if cur is None:
+            cur = (sid, i)
+        elif sid != cur[0]:
+            segs.append((cur[1], i, cur[0] is not None))
+            cur = (sid, i)
+    if cur is not None:
+        segs.append((cur[1], idx, cur[0] is not None))
+    return segs if found else None
+
+
 def run_backward_op(block: Block, idx: int, op, env: Dict, ctx):
-    """Lower the `backward` op inside run_block's trace (see executor.py)."""
+    """Lower the `backward` op inside run_block's trace (see executor.py).
+
+    With ``__remat_seg`` stamps present (BuildStrategy.recompute), the
+    forward re-trace runs segment by segment, each wrapped in
+    ``jax.checkpoint``: only the env values LIVE at a segment boundary
+    are saved for the backward pass (the env is pruned to the names
+    later ops still read), interior activations are recomputed.
+    jax.checkpoint replays the segment with the same closed-over RNG key
+    and the kernels fold the same absolute ``__rng_slot``/op index, so a
+    recomputed dropout draws the bitwise-identical mask — the invariant
+    tests/test_recompute.py pins."""
     from .executor import run_block
     from .kernels import ExecContext
 
@@ -86,6 +118,8 @@ def run_backward_op(block: Block, idx: int, op, env: Dict, ctx):
             if n in pset:
                 last_producer[n] = j
 
+    segs = _remat_plan(block.ops, idx)
+
     def forward(pvals):
         pmap = dict(zip(params, pvals))
         env2 = dict(base_env)
@@ -96,17 +130,63 @@ def run_backward_op(block: Block, idx: int, op, env: Dict, ctx):
             post.setdefault(j, {})[p] = pmap[p]
         ctx2 = ExecContext(rng_key=ctx.rng_key, is_test=ctx.is_test)
         ctx2.initial_env = env2  # nested backward unsupported but harmless
-        env2 = run_block(block, env2, ctx2, stop_at=idx, post_writes=post)
+        if segs is None:
+            env2 = run_block(block, env2, ctx2, stop_at=idx,
+                             post_writes=post)
+            return env2[loss_name]
+        live_at = _segment_liveness(block, segs, idx, loss_name)
+        for start, end, wrapped in segs:
+            def run_range(env_in, _s=start, _e=end):
+                c = ExecContext(rng_key=ctx.rng_key, is_test=ctx.is_test)
+                c.initial_env = ctx2.initial_env
+                return run_block(block, dict(env_in), c, stop_at=_e,
+                                 post_writes=post, start=_s)
+            if wrapped:
+                live = live_at[start]
+                env_in = (env2 if live is None else
+                          {n: v for n, v in env2.items() if n in live})
+                env2 = jax.checkpoint(run_range)(env_in)
+            else:
+                env2 = run_range(env2)
         return env2[loss_name]
 
     fwd = forward
-    if op.attrs.get("use_checkpoint"):
+    if segs is None and op.attrs.get("use_checkpoint"):
+        # legacy whole-forward checkpoint (append_backward checkpoints
+        # without the segmentation pass, e.g. PADDLE_IR_PASSES=0)
         fwd = jax.checkpoint(forward)
 
     primal, vjp = jax.vjp(fwd, [env[p] for p in params])
     (grads,) = vjp(jnp.ones_like(primal))
+    if segs is not None or op.attrs.get("use_checkpoint"):
+        # hand the (bitwise-identical) checkpointed primal to the fetch
+        # path: the outer un-checkpointed forward chain feeding the loss
+        # becomes dead and XLA DCEs it instead of keeping its
+        # activations alive next to the remat segments
+        env[loss_name] = primal
     for gname, g in zip(op.outputs["Grads"], grads):
         env[gname] = g
+
+
+def _segment_liveness(block, segs, idx, loss_name):
+    """{segment start -> live name set (or None = keep all)}: the env
+    entries a checkpointed segment must receive — names any op in
+    [start, idx) still reads, plus the loss. Pruning the rest is what
+    actually frees memory: an unpruned dict would thread every dead
+    intermediate through every later checkpoint as a saved residual.
+    Control flow in the remaining range keeps everything (cond/while
+    kernels snapshot the whole env)."""
+    reads_after: set = {loss_name}
+    has_cf_after = False
+    live_at = {}
+    for start, end, _wrapped in reversed(segs):
+        for i in range(end - 1, start - 1, -1):
+            op = block.ops[i]
+            if op.type in ("cond", "while"):
+                has_cf_after = True
+            reads_after.update(op.input_names())
+        live_at[start] = None if has_cf_after else set(reads_after)
+    return live_at
 
 
 def calc_gradient(targets, inputs, target_gradients=None):

@@ -48,6 +48,31 @@ class BuildStrategy:
       amp_init_loss_scale   static loss scale threaded through
                             check_finite_and_unscale under fp16
 
+    Memory / microbatching knobs (ISSUE 5 — rematerialization + in-step
+    gradient merge; `PADDLE_IR_PASSES=0` disables both with the rest of
+    the pipeline):
+
+      recompute             run the recompute_segmentation pass: the
+                            forward region is split into checkpoint
+                            segments and the executor wraps each
+                            segment's backward re-trace in
+                            jax.checkpoint — activations are recomputed
+                            instead of stashed (XLA temp bytes drop;
+                            exe.memory_stats() shows the movement)
+      recompute_checkpoints var names marking segment boundaries (the
+                            reference RecomputeConfig.checkpoints); empty
+                            = automatic ~sqrt(#ops) split
+      recompute_segments    override the automatic segment count (0 =
+                            sqrt heuristic)
+      gradient_merge_k      k > 1 compiles the train step as a lax.scan
+                            over k microbatches (feed batch must be
+                            divisible by k): f32 gradient accumulators,
+                            ONE optimizer update and ONE dispatch per k
+                            microbatches; fp16 FoundInfinite from any
+                            microbatch gates the merged update
+      gradient_merge_avg    divide the MERGED gradient by k once
+                            (single-large-batch semantics); False sums
+
     Comm-layout knobs (reduce_strategy, fuse_all_reduce_ops) stay
     descriptive: XLA's SPMD partitioner owns cross-chip scheduling."""
 
@@ -63,6 +88,11 @@ class BuildStrategy:
         self.amp_dtype = "bfloat16"
         self.amp_level = "O1"
         self.amp_init_loss_scale = 2.0 ** 15
+        self.recompute = False
+        self.recompute_checkpoints = ()
+        self.recompute_segments = 0
+        self.gradient_merge_k = 1
+        self.gradient_merge_avg = True
         self.num_trainers = 1
         self.trainer_id = 0
 

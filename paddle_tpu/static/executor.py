@@ -129,22 +129,28 @@ def scope_guard(scope):
 # ---------------------------------------------------------------------------
 def run_block(block: Block, env: Dict[str, Any], ctx: ExecContext,
               stop_at: Optional[int] = None,
-              post_writes: Optional[Dict[int, Dict[str, Any]]] = None
-              ) -> Dict[str, Any]:
+              post_writes: Optional[Dict[int, Dict[str, Any]]] = None,
+              start: int = 0) -> Dict[str, Any]:
     """Interpret ops of a block over an env dict. Called under jit trace —
     this IS the compilation step, not the runtime (no per-op dispatch cost
     after compile).
 
     post_writes: {op_index: {var_name: value}} — after op i runs, override
     env entries (used by backward.py to treat an intermediate var as a free
-    input for gradient computation w.r.t. it)."""
+    input for gradient computation w.r.t. it).
+
+    start/stop_at bound the op range [start, stop_at): backward.py runs
+    checkpoint segments through here, and the gradient-merge step runs
+    the post-backward (optimizer) region separately — op indices stay
+    ABSOLUTE so ``__rng_slot`` fallbacks and post_writes keys are stable
+    whatever the entry point."""
     from .backward import run_backward_op  # local: avoids import cycle
 
     if not hasattr(ctx, "initial_env"):
         ctx.initial_env = dict(env)
-    for i, op in enumerate(block.ops):
-        if stop_at is not None and i >= stop_at:
-            break
+    stop = len(block.ops) if stop_at is None else stop_at
+    for i in range(start, stop):
+        op = block.ops[i]
         # __rng_slot (stamped by passes.py) pins index-keyed random ops
         # to their pre-rewrite RNG stream: op removal must not shift a
         # surviving dropout/uniform/gaussian draw
@@ -199,22 +205,36 @@ def _state_signature(state) -> tuple:
 def _strategy_signature(strategy) -> tuple:
     if strategy is None:
         return ()
-    # scalar knobs only — bools select passes, strings/numbers carry the
-    # amp dtype/level/loss-scale (all shape which executable is built)
-    return tuple(sorted((k, str(v)) for k, v in vars(strategy).items()
-                        if isinstance(v, (bool, int, float, str))))
+    # scalar knobs plus scalar tuples/lists — bools select passes,
+    # strings/numbers carry the amp dtype/level/loss-scale and the
+    # gradient_merge_k, tuples the recompute checkpoint names (all shape
+    # which executable is built)
+    out = []
+    for k, v in vars(strategy).items():
+        if isinstance(v, (bool, int, float, str)):
+            out.append((k, str(v)))
+        elif isinstance(v, (tuple, list)) and all(
+                isinstance(x, (bool, int, float, str)) for x in v):
+            out.append((k, str(tuple(v))))
+    return tuple(sorted(out))
 
 
 class _ExecEntry:
     """One content-cache slot: the AOT executable plus the optimized
-    program and pass report that produced it (dump/debug surface)."""
+    program and pass report that produced it (dump/debug surface).
+    ``is_gm`` records whether the step really compiled as a
+    scan-over-microbatches (a gradient_merge_k strategy on a
+    backward-less program falls back to the plain step — its dispatches
+    must not count as merged)."""
 
-    __slots__ = ("compiled", "optimized_program", "pass_report")
+    __slots__ = ("compiled", "optimized_program", "pass_report", "is_gm")
 
-    def __init__(self, compiled, optimized_program, pass_report):
+    def __init__(self, compiled, optimized_program, pass_report,
+                 is_gm=False):
         self.compiled = compiled
         self.optimized_program = optimized_program
         self.pass_report = pass_report
+        self.is_gm = is_gm
 
 
 # process-global content-addressed executable cache: every Executor in
@@ -240,13 +260,18 @@ def _exec_cache_put(key: str, entry: _ExecEntry) -> None:
 
 
 def _content_key(opt_program, feed_sig, fetch_names, persist_names,
-                 state_sig, sharding, donate) -> str:
+                 state_sig, sharding, donate, gm=None) -> str:
+    # gm (gradient merge) changes the compiled step's STRUCTURE (scan
+    # over microbatches) without touching the program content, so it
+    # must join the hash; remat changes the content itself (__remat_seg
+    # stamps) and needs no extra term
     shard_desc = None
     if sharding:
         shard_desc = sorted((k, str(v)) for k, v in sharding.items())
     blob = json.dumps(
         [opt_program.to_dict(), list(feed_sig), list(fetch_names),
-         list(persist_names), list(state_sig), shard_desc, bool(donate)],
+         list(persist_names), list(state_sig), shard_desc, bool(donate),
+         list(gm) if gm else None],
         sort_keys=True, default=str).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()
 
@@ -283,6 +308,9 @@ class Executor:
         from .compile_cache import ensure_enabled
         ensure_enabled()  # PADDLE_COMPILE_CACHE[_DIR] disk cache, once
         self._donate = bool(donate_state)
+        # last executable this executor dispatched — memory_stats() and
+        # the xla_*_bytes gauges read its compiled.memory_analysis()
+        self._last_entry: Optional[_ExecEntry] = None
         # per-executor view of the hot-path counters; the module-global
         # aggregate lives in profiler._counters (bench reads that one)
         import collections
@@ -312,6 +340,48 @@ class Executor:
                 out[name] = snap[name]
         return out
 
+    @staticmethod
+    def _memory_analysis_dict(entry) -> Dict[str, int]:
+        """compiled.memory_analysis() flattened to plain ints, {} when
+        the backend doesn't expose the analysis. peak_bytes is the
+        arguments + outputs + XLA temp working set (the quantity remat
+        shrinks); CPU/TPU PJRT report no finer peak."""
+        if entry is None:
+            return {}
+        try:
+            ma = entry.compiled.memory_analysis()
+            temp = int(getattr(ma, "temp_size_in_bytes", 0))
+            arg = int(getattr(ma, "argument_size_in_bytes", 0))
+            out = int(getattr(ma, "output_size_in_bytes", 0))
+            gen = int(getattr(ma, "generated_code_size_in_bytes", 0))
+            alias = int(getattr(ma, "alias_size_in_bytes", 0))
+        except Exception:
+            return {}
+        return {"temp_bytes": temp, "argument_bytes": arg,
+                "output_bytes": out, "generated_code_bytes": gen,
+                "alias_bytes": alias, "peak_bytes": temp + arg + out}
+
+    def memory_stats(self) -> Dict[str, int]:
+        """XLA memory analysis of the LAST executable this executor ran:
+        peak_bytes / temp_bytes / argument_bytes / output_bytes /
+        generated_code_bytes / alias_bytes. The objective gate for the
+        recompute pass — bench's remat probe asserts temp/peak strictly
+        drop with BuildStrategy.recompute on. {} before the first run."""
+        return self._memory_analysis_dict(self._last_entry)
+
+    def _update_memory_gauges(self, entry) -> None:
+        """Mirror the last executable's memory analysis into the
+        counters as GAUGES (assigned, not accumulated): xla_temp_bytes /
+        xla_peak_bytes / xla_argument_bytes / xla_output_bytes."""
+        from .. import profiler
+
+        stats = self._memory_analysis_dict(entry)
+        for key in ("temp_bytes", "peak_bytes", "argument_bytes",
+                    "output_bytes"):
+            if key in stats:
+                self._counters[f"xla_{key}"] = stats[key]
+                profiler.set_counter(f"xla_{key}", stats[key])
+
     def close(self):
         self._cache.clear()
 
@@ -333,6 +403,12 @@ class Executor:
             program = program._program
         if program is None:
             program = default_main_program()
+        if strategy is None:
+            # fleet.distributed_optimizer's static path stamps the
+            # program with the BuildStrategy its DistributedStrategy
+            # maps to (recompute/gradient_merge/amp knobs) — honored for
+            # raw-Program runs so fleet users need no CompiledProgram
+            strategy = getattr(program, "_fleet_build_strategy", None)
         # let the program's py_readers stage batches directly into the
         # feed layout on their prefetch thread; set unconditionally so a
         # later raw-Program run clears a stale data-parallel stash
@@ -359,9 +435,11 @@ class Executor:
         # strategy) can never hit a stale executable. Stash the feed
         # dtype map on the program (like _feed_sharding) so py_reader
         # prefetch threads stage batches already low.
-        from .passes import amp_feed_dtypes_cached, resolve_amp
+        from .passes import (amp_feed_dtypes_cached, resolve_amp,
+                             resolve_gradient_merge)
 
         amp = resolve_amp(strategy)
+        gm = resolve_gradient_merge(strategy)
         fdt = amp_feed_dtypes_cached(program, amp)
         program._amp_feed_dtypes = fdt
 
@@ -402,7 +480,7 @@ class Executor:
         state_sig = _state_signature(state)
         step_key = (program._version, feed_sig, tuple(fetch_names),
                     tuple(persist_names), state_sig, bool(sharding),
-                    _strategy_signature(strategy), amp)
+                    _strategy_signature(strategy), amp, gm)
         per_prog = self._cache.setdefault(program, {})
         entry = None
         if use_program_cache:
@@ -423,23 +501,35 @@ class Executor:
             self._record_pass_report(report)
             ck = _content_key(opt_program, feed_sig, fetch_names,
                               persist_names, state_sig, sharding,
-                              self._donate)
+                              self._donate, gm)
             per_prog[step_key] = ck
             entry = _exec_cache_get(ck) if use_program_cache else None
             if entry is not None:
                 self._bump("compile_cache_hits")
             else:
+                is_gm = gm is not None and any(
+                    op.type == "backward"
+                    for op in opt_program.global_block.ops)
                 compiled_fn = self._build(
                     opt_program.global_block, feed_keys, fetch_names,
-                    persist_names, sharding, feed_vals, state, rng)
-                entry = _ExecEntry(compiled_fn, opt_program, report)
+                    persist_names, sharding, feed_vals, state, rng, gm)
+                entry = _ExecEntry(compiled_fn, opt_program, report,
+                                   is_gm)
                 if use_program_cache:
                     _exec_cache_put(ck, entry)
                 self._bump("compile_cache_misses")
         compiled = entry.compiled
+        if entry is not getattr(self, "_last_entry", None):
+            self._last_entry = entry
+            self._update_memory_gauges(entry)
 
         self._step += 1
         self._bump("executor_steps")
+        if gm and entry.is_gm:
+            # one dispatch covers gm[0] microbatches (one optimizer
+            # update): the tokens-per-dispatch win gradient merge buys
+            self._bump("gm_dispatches")
+            self._bump("gm_microbatches", gm[0])
         feed_h2d = sum(_nbytes(v) for v in feed_vals
                        if not isinstance(v, jax.Array))
         if feed_h2d:
@@ -515,25 +605,39 @@ class Executor:
             self._bump(f"pass_{s.name}_ms", round(s.ms, 3))
         for name, v in getattr(report, "amp", {}).items():
             self._bump(name, v)
+        for name, v in getattr(report, "remat", {}).items():
+            self._bump(name, v)
 
     def _build(self, block, feed_keys, fetch_names, persist_names,
-               sharding, feed_vals, state, rng):
+               sharding, feed_vals, state, rng, gm=None):
         """AOT-compile one step: jit -> lower() (trace_ms) -> compile()
         (compile_ms). The split makes trace vs XLA-compile time
         measurable, and compile() goes through jax's persistent
         compilation cache when PADDLE_COMPILE_CACHE[_DIR] is set — a
         relaunched trainer's cold build becomes a disk read
-        (disk_cache_hits in exe.counters)."""
+        (disk_cache_hits in exe.counters).
 
-        def step(feed_vals, state, rng):
-            env = dict(zip(feed_keys, feed_vals))
-            env.update(zip(persist_names, state))
-            ctx = ExecContext(rng_key=rng)
-            env = run_block(block, env, ctx)
-            fetches = [env[n] for n in fetch_names]
-            new_state = [env.get(n, s)
-                         for n, s in zip(persist_names, state)]
-            return fetches, new_state
+        With ``gm`` (resolve_gradient_merge result) and a backward op in
+        the block, the step is compiled as a lax.scan over k microbatches
+        instead (_gm_step_fn)."""
+
+        gm_bwd = None
+        if gm is not None:
+            gm_bwd = next((i for i, op in enumerate(block.ops)
+                           if op.type == "backward"), None)
+        if gm_bwd is not None:
+            step = self._gm_step_fn(block, feed_keys, fetch_names,
+                                    persist_names, feed_vals, gm, gm_bwd)
+        else:
+            def step(feed_vals, state, rng):
+                env = dict(zip(feed_keys, feed_vals))
+                env.update(zip(persist_names, state))
+                ctx = ExecContext(rng_key=rng)
+                env = run_block(block, env, ctx)
+                fetches = [env[n] for n in fetch_names]
+                new_state = [env.get(n, s)
+                             for n, s in zip(persist_names, state)]
+                return fetches, new_state
 
         jit_kwargs = {}
         if self._donate:
@@ -561,6 +665,160 @@ class Executor:
         self._bump("trace_ms", round((t1 - t0) * 1e3, 3))
         self._bump("compile_ms", round((t2 - t1) * 1e3, 3))
         return compiled
+
+    def _gm_step_fn(self, block, feed_keys, fetch_names, persist_names,
+                    feed_vals, gm, bwd_idx):
+        """In-step gradient merge: compile the train step as ONE
+        lax.scan over k microbatches (GPipe-style accumulation, inside a
+        single dispatch).
+
+        The op list splits at the backward boundary: ops [0, scan_end)
+        (forward + backward + an adjacent fp16 check_finite_and_unscale)
+        run PER MICROBATCH inside the scan; ops [scan_end, ...) — the
+        optimizer update region — run ONCE on the merged gradient.
+        Mechanics:
+
+        - every feed is reshaped (B, ...) -> (k, B//k, ...) inside the
+          trace (host layout untouched; B must divide by k)
+        - gradients accumulate in f32 whatever the compute dtype (AMP
+          bf16/fp16 microbatch grads are upcast before the add), and
+          with avg=True the MERGED sum is divided by k once — never a
+          per-microbatch lr rescale
+        - the fp16 FoundInfinite flag is OR-reduced over microbatches:
+          one bad microbatch skips the whole merged update
+        - persistable state written inside the scanned region
+          (batch_norm running stats, step counters) threads through the
+          scan carry, so microbatch i sees microbatch i-1's updates
+        - each microbatch folds its index into the step RNG key —
+          dropout draws fresh masks per microbatch
+        - float fetches produced inside the scanned region (the loss)
+          are averaged over microbatches; non-float fetches report the
+          last microbatch
+        """
+        import numpy as _np
+
+        k, avg = gm
+        for key, v in zip(feed_keys, feed_vals):
+            shp = tuple(getattr(v, "shape", ()))
+            if not shp or shp[0] % k:
+                raise ValueError(
+                    f"gradient_merge_k={k}: feed {key!r} batch dim "
+                    f"{shp[0] if shp else None} is not divisible by k")
+        ops = block.ops
+        scan_end = bwd_idx + 1
+        if scan_end < len(ops) and \
+                ops[scan_end].type == "check_finite_and_unscale":
+            scan_end += 1
+        grad_names = list(ops[bwd_idx].outputs.get("Grads", []))
+        found_name = None
+        if ops[scan_end - 1].type == "check_finite_and_unscale":
+            fo = ops[scan_end - 1].outputs.get("FoundInfinite")
+            found_name = fo[0] if fo else None
+        produced: set = set()
+        for op in ops[:scan_end]:
+            produced.update(op.output_names())
+        post_reads: set = set()
+        post_outs: set = set()
+        for op in ops[scan_end:]:
+            post_reads.update(op.input_names())
+            post_outs.update(op.output_names())
+        special = set(grad_names) | {found_name} - {None}
+        persist_set = set(persist_names)
+        # state written per microbatch rides the carry; everything else
+        # the post region or a fetch reads rides the stacked ys
+        state_carry = sorted(produced & persist_set)
+        carry_out = sorted(((post_reads | set(fetch_names)) & produced)
+                           - special - persist_set)
+
+        def _micro(mb_feed, state_env, carried, key):
+            env = dict(zip(feed_keys, mb_feed))
+            env.update(state_env)
+            env.update(carried)
+            ctx = ExecContext(rng_key=key)
+            return run_block(block, env, ctx, stop_at=scan_end)
+
+        # grad avals (shape/dtype of ONE microbatch's grads): read from
+        # the grad VarDescs when fully static — append_backward declares
+        # them with the param's shape/dtype — falling back to an
+        # abstract eval_shape trace only for dynamic shapes
+        # (calc_gradient w.r.t. a batch-dim intermediate). The probe
+        # re-interprets the whole scanned region, so skipping it halves
+        # merged-build trace time in the common (param-grad) case.
+        grad_avals = []
+        for g in grad_names:
+            desc = block.vars.get(g)
+            shape = getattr(desc, "shape", None)
+            if not shape or any(int(d) < 0 for d in shape):
+                grad_avals = None
+                break
+            grad_avals.append(jax.ShapeDtypeStruct(
+                tuple(int(d) for d in shape),
+                jnp.dtype(dtype_mod.convert_dtype(desc.dtype))))
+
+        mb_avals = [jax.ShapeDtypeStruct(
+            (int(v.shape[0]) // k,) + tuple(int(d) for d in v.shape[1:]),
+            getattr(v, "dtype", _np.asarray(v).dtype))
+            for v in feed_vals]
+
+        def _probe(mb_feed, state, rng):
+            env = _micro(mb_feed, dict(zip(persist_names, state)), {},
+                         rng)
+            return [env[g] for g in grad_names]
+
+        def step(feed_vals, state, rng):
+            state_env0 = dict(zip(persist_names, state))
+            avals = grad_avals if grad_avals is not None else \
+                jax.eval_shape(_probe, mb_avals, state, rng)
+            mbs = [v.reshape((k, v.shape[0] // k) + tuple(v.shape[1:]))
+                   for v in feed_vals]
+
+            def body(carry, xs):
+                accum, carried, found = carry
+                mb, mi = xs
+                env = _micro(mb, state_env0, carried,
+                             jax.random.fold_in(rng, mi))
+                accum = [a + env[g].astype(jnp.float32)
+                         for a, g in zip(accum, grad_names)]
+                carried = {n: env[n] for n in state_carry}
+                if found_name is not None:
+                    found = found | jnp.reshape(
+                        env[found_name], ()).astype(bool)
+                ys = {n: env[n] for n in carry_out}
+                return (accum, carried, found), ys
+
+            init = ([jnp.zeros(a.shape, jnp.float32) for a in avals],
+                    {n: state_env0[n] for n in state_carry},
+                    jnp.zeros((), jnp.bool_))
+            (accum, carried, found), ys = jax.lax.scan(
+                body, init, (mbs, jnp.arange(k)))
+            env = dict(zip(feed_keys, feed_vals))  # full batch for post
+            env.update(state_env0)
+            env.update(carried)
+            env.update({n: ys[n][-1] for n in carry_out})
+            for g, a, aval in zip(grad_names, accum, avals):
+                merged = a / k if avg else a
+                env[g] = merged.astype(aval.dtype)
+            if found_name is not None:
+                env[found_name] = jnp.reshape(found, (1,))
+            ctx = ExecContext(rng_key=rng)
+            env = run_block(block, env, ctx, start=scan_end)
+            fetches = []
+            for n in fetch_names:
+                if n in ys and n not in post_outs:
+                    stacked = ys[n]
+                    if jnp.issubdtype(stacked.dtype, jnp.inexact):
+                        fetches.append(jnp.mean(
+                            stacked.astype(jnp.float32), axis=0
+                        ).astype(stacked.dtype))
+                    else:
+                        fetches.append(stacked[-1])
+                else:
+                    fetches.append(env[n])
+            new_state = [env.get(n, s)
+                         for n, s in zip(persist_names, state)]
+            return fetches, new_state
+
+        return step
 
     # -- dataset-driven training (reference executor.py:1593) -------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

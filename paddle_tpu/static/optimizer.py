@@ -30,6 +30,15 @@ def set_gradient_clip(clip, param_list=None, program=None):
     _global_grad_clip[0] = clip
 
 
+def resolve_grad_clip(optimizer):
+    """The clip a static minimize must apply for ``optimizer``: its own
+    grad_clip, else the program-level set_gradient_clip default. Every
+    path that re-implements the append_backward -> clip ->
+    apply_gradients body (RecomputeOptimizer, fleet's static minimize)
+    resolves through here so the global fallback is never dropped."""
+    return getattr(optimizer, "grad_clip", None) or _global_grad_clip[0]
+
+
 class Optimizer:
     _update_op = None
 
@@ -81,7 +90,7 @@ class Optimizer:
     def minimize(self, loss: Variable, startup_program=None,
                  parameter_list=None, no_grad_set=None):
         params_grads = append_backward(loss, parameter_list, no_grad_set)
-        clip = self.grad_clip or _global_grad_clip[0]
+        clip = resolve_grad_clip(self)
         if clip is not None:
             params_grads = clip(params_grads)
         self.apply_gradients(params_grads)

@@ -35,8 +35,19 @@ Passes (BuildStrategy knob in parentheses):
       fused_elemwise_activation kernel (kernels.py)
   dead_code_elimination  (strategy.memory_optimize)    ops whose outputs
       reach no fetch / persistable / sub-block read
+  recompute_segmentation (strategy.recompute)          partitions the
+      forward region into checkpoint segments (user checkpoint var
+      names, else an every-N-ops sqrt split) by stamping ``__remat_seg``
+      on each forward op; the executor's backward lowering wraps each
+      segment in jax.checkpoint so interior activations are recomputed
+      instead of stashed (Chen et al. sublinear memory)
   drop_unused_vars       (strategy.memory_optimize)    VarDescs no
       surviving op references (blob/content-hash shrink)
+
+Gradient merge is NOT a pass (no op rewrite): resolve_gradient_merge
+reads BuildStrategy.gradient_merge_k and the executor compiles the
+train step as a lax.scan over k microbatches with f32 gradient
+accumulators (executor.py _gm_step_fn).
 
 Safety invariants (why rewrites stay bitwise-exact):
 - Random ops whose kernels fold ``op_index`` into their key (dropout,
@@ -149,6 +160,57 @@ def resolve_amp(strategy=None):
     return None
 
 
+def resolve_recompute(strategy=None):
+    """Resolve the activation-rematerialization config for one build.
+
+    Returns ``(checkpoint_names, num_segments)`` or ``None`` (no remat).
+    ``checkpoint_names`` come from ``BuildStrategy.recompute_checkpoints``
+    (user-chosen segment boundaries, à la the reference
+    RecomputeConfig.checkpoints); ``num_segments`` is the
+    ``recompute_segments`` knob for the automatic every-N-ops heuristic
+    (0 = sqrt(#forward ops), the Chen et al. sublinear split).
+
+    ``PADDLE_IR_PASSES=0`` resolves to None: the escape hatch disables
+    every graph transform at once, so a passes-off run is the exact
+    baseline."""
+    if os.environ.get("PADDLE_IR_PASSES") == "0":
+        return None
+    if strategy is None or not getattr(strategy, "recompute", False):
+        return None
+    cps = tuple(str(getattr(c, "name", c))
+                for c in (getattr(strategy, "recompute_checkpoints", ())
+                          or ()))
+    try:
+        nseg = int(getattr(strategy, "recompute_segments", 0) or 0)
+    except (TypeError, ValueError):
+        nseg = 0
+    return (cps, nseg)
+
+
+def resolve_gradient_merge(strategy=None):
+    """Resolve the in-step gradient-merge config for one build.
+
+    Returns ``(k, avg)`` or ``None`` (no merge). With k > 1 the executor
+    compiles the train step as a ``lax.scan`` over k microbatches with
+    f32 gradient accumulators — one dispatch + one optimizer update per
+    k batches (executor.py ``_gm_step_fn``). ``avg`` divides the MERGED
+    gradient by k once (never a per-microbatch lr rescale).
+
+    ``PADDLE_IR_PASSES=0`` resolves to None, like resolve_amp /
+    resolve_recompute: one escape restores the whole baseline."""
+    if os.environ.get("PADDLE_IR_PASSES") == "0":
+        return None
+    if strategy is None:
+        return None
+    try:
+        k = int(getattr(strategy, "gradient_merge_k", 1) or 1)
+    except (TypeError, ValueError):
+        k = 1
+    if k <= 1:
+        return None
+    return (k, bool(getattr(strategy, "gradient_merge_avg", True)))
+
+
 def _lowp_feed_names(block) -> Set[str]:
     """float32 data vars that may flip to the low dtype: never consumed
     by a black-listed (f32-pinned) op in the forward region and not read
@@ -251,6 +313,11 @@ class PassReport:
     ms: float = 0.0
     vars_dropped: int = 0
     amp: Dict[str, int] = field(default_factory=dict)
+    # recompute segmentation counters (remat_segments, remat_stash_vars,
+    # remat_recompute_vars, ...) + the per-segment table dump_passes
+    # --remat prints
+    remat: Dict[str, int] = field(default_factory=dict)
+    remat_table: List[dict] = field(default_factory=list)
 
     @property
     def removed(self) -> int:
@@ -271,6 +338,27 @@ class PassReport:
         if self.amp:
             lines.append("amp: " + "  ".join(
                 f"{k}={v}" for k, v in sorted(self.amp.items())))
+        if self.remat:
+            lines.append("remat: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(self.remat.items())))
+        return "\n".join(lines)
+
+    def remat_segment_table(self) -> str:
+        """Aligned per-segment table (tools/dump_passes.py --remat):
+        ops per segment, stashed (boundary) vs recomputed (interior) var
+        counts and their estimated bytes (batch dim -1 counted as 1, so
+        the numbers are per-sample)."""
+        if not self.remat_table:
+            return "(no recompute segments)"
+        lines = [f"{'seg':>4}{'ops':>6}{'stash_vars':>12}"
+                 f"{'stash_bytes':>13}{'recomp_vars':>13}"
+                 f"{'recomp_bytes':>14}  boundary"]
+        for row in self.remat_table:
+            lines.append(
+                f"{row['seg']:>4}{row['ops']:>6}{row['stash_vars']:>12}"
+                f"{row['stash_bytes']:>13}{row['recompute_vars']:>13}"
+                f"{row['recompute_bytes']:>14}  "
+                f"{row['boundary'] or '-'}")
         return "\n".join(lines)
 
 
@@ -636,6 +724,115 @@ def _pass_drop_unused_vars(ctx: _Ctx) -> int:
 
 
 # ---------------------------------------------------------------------------
+# recompute segmentation (activation rematerialization)
+# ---------------------------------------------------------------------------
+def _var_nbytes(block, name) -> int:
+    """Estimated payload bytes of a var from its VarDesc (dynamic -1
+    dims counted as 1 — the estimate is per-sample, good enough for the
+    stash-vs-recompute segment table)."""
+    v = block.vars.get(name)
+    shape = getattr(v, "shape", None)
+    if not shape:
+        return 0
+    n = 1
+    for d in shape:
+        n *= max(1, int(d))
+    try:
+        item = np.dtype(dtype_mod.convert_dtype(v.dtype)).itemsize
+    except Exception:
+        item = 4
+    return n * item
+
+
+def _pass_recompute(ctx: _Ctx) -> None:
+    """Partition the forward region (ops before the first `backward` op)
+    into checkpoint segments and stamp each op with ``__remat_seg``.
+
+    The executor's backward lowering (backward.py run_backward_op) wraps
+    each stamped segment's re-trace in ``jax.checkpoint``: only segment
+    BOUNDARY values are stashed for the backward pass, interior
+    activations are recomputed — Chen et al. sublinear memory, compiled.
+
+    Boundaries come from user checkpoint var names (the reference
+    RecomputeConfig.checkpoints: a segment ends after the op producing a
+    checkpoint var) or, when none are given, from an every-N-ops split
+    into ~sqrt(#ops) segments (``recompute_segments`` overrides the
+    count). The stamp is pure bookkeeping — no op is added, removed or
+    reordered, so passes-on/off stays bitwise (RNG streams are pinned by
+    ``__rng_slot`` independently); jax.checkpoint replays random kernels
+    with identical fold_in keys, which is what makes recomputed dropout
+    draw the same mask (the tested invariant).
+
+    The stamps change the program's content hash, so remat-on and -off
+    can never share an executable."""
+    block = ctx.block
+    first_bwd = next((i for i, op in enumerate(block.ops)
+                      if op.type == "backward"), None)
+    if first_bwd is None:
+        return
+    bwd_op = block.ops[first_bwd]
+    cps = set(ctx.remat_checkpoints)
+    cps.update(str(c) for c in (bwd_op.attrs.get("checkpoints") or ()))
+    fwd = [i for i in range(first_bwd)
+           if block.ops[i].type not in ("feed", "fetch")]
+    if len(fwd) < 2:
+        return
+    seg_of: Dict[int, int] = {}
+    boundary_after: Dict[int, str] = {}
+    if cps:
+        seg = 0
+        for i in fwd:
+            seg_of[i] = seg
+            hit = set(block.ops[i].output_names()) & cps
+            if hit:
+                boundary_after[seg] = sorted(hit)[0]
+                seg += 1
+    else:
+        n = len(fwd)
+        nseg = ctx.remat_nseg or max(2, int(round(n ** 0.5)))
+        nseg = max(1, min(nseg, n))
+        per = -(-n // nseg)  # ceil
+        for j, i in enumerate(fwd):
+            seg_of[i] = j // per
+    for i, s in seg_of.items():
+        block.ops[i].attrs["__remat_seg"] = s
+    nseg = max(seg_of.values()) + 1
+
+    # stash vs recompute accounting: a segment's output consumed by a
+    # LATER segment (or live at the backward boundary) is a stashed
+    # residual; one consumed only inside its segment is recomputed
+    consumers: Dict[str, List[int]] = defaultdict(list)
+    for i in fwd:
+        for name in block.ops[i].input_names():
+            consumers[name].append(i)
+    loss_name = (bwd_op.inputs.get("Loss") or [None])[0]
+    stats = ctx.remat_stats
+    stats["remat_segments"] = nseg
+    table = []
+    for s in range(nseg):
+        seg_ops = [i for i in fwd if seg_of[i] == s]
+        stash, recomp = set(), set()
+        for i in seg_ops:
+            for name in block.ops[i].output_names():
+                later = any(seg_of.get(j, nseg) > s
+                            for j in consumers.get(name, ()))
+                crosses = later or name == loss_name or s < nseg - 1 and (
+                    name in ctx.protected)
+                (stash if crosses else recomp).add(name)
+        stats["remat_stash_vars"] += len(stash)
+        stats["remat_recompute_vars"] += len(recomp)
+        table.append({
+            "seg": s, "ops": len(seg_ops),
+            "stash_vars": len(stash),
+            "stash_bytes": sum(_var_nbytes(block, n) for n in stash),
+            "recompute_vars": len(recomp),
+            "recompute_bytes": sum(_var_nbytes(block, n) for n in recomp),
+            "boundary": boundary_after.get(s, ""),
+        })
+    ctx.remat_table = table
+
+
+# ---------------------------------------------------------------------------
 # auto mixed precision (bf16/fp16 compute, f32 master weights)
 # ---------------------------------------------------------------------------
 def _pass_auto_mixed_precision(ctx: _Ctx) -> None:
@@ -967,7 +1164,8 @@ _PIPELINE = (
 
 def pass_names() -> List[str]:
     return (["auto_mixed_precision"]
-            + [name for name, _, _ in _PIPELINE] + ["drop_unused_vars"])
+            + [name for name, _, _ in _PIPELINE]
+            + ["recompute_segmentation", "drop_unused_vars"])
 
 
 def apply_passes(program: Program, feed_names: Sequence[str],
@@ -988,7 +1186,9 @@ def apply_passes(program: Program, feed_names: Sequence[str],
     enabled = [(name, fn) for name, knob, fn in _PIPELINE
                if getattr(strategy, knob, True)]
     amp = resolve_amp(strategy)
-    if os.environ.get("PADDLE_IR_PASSES") == "0" or not (enabled or amp):
+    remat = resolve_recompute(strategy)
+    if os.environ.get("PADDLE_IR_PASSES") == "0" \
+            or not (enabled or amp or remat):
         return program, PassReport([], n0, n0, 0.0)
 
     t_all = time.perf_counter()
@@ -1014,6 +1214,21 @@ def apply_passes(program: Program, feed_names: Sequence[str],
         fn(ctx)
         ms = (time.perf_counter() - t0) * 1e3
         stats.append(PassStat(name, before, len(opt.global_block.ops), ms))
+    remat_counts: Dict[str, int] = {}
+    remat_table: List[dict] = []
+    if remat is not None:
+        # runs LAST among op-level passes: DCE has already settled the
+        # op list, so segment sizes reflect what will actually trace
+        ctx.remat_checkpoints, ctx.remat_nseg = remat
+        ctx.remat_stats = defaultdict(int)
+        ctx.remat_table = []
+        n = len(opt.global_block.ops)
+        t0 = time.perf_counter()
+        _pass_recompute(ctx)
+        stats.append(PassStat("recompute_segmentation", n, n,
+                              (time.perf_counter() - t0) * 1e3))
+        remat_counts = {k: int(v) for k, v in ctx.remat_stats.items() if v}
+        remat_table = ctx.remat_table
     vars_dropped = 0
     if getattr(strategy, "memory_optimize", True):
         n = len(opt.global_block.ops)
@@ -1024,5 +1239,6 @@ def apply_passes(program: Program, feed_names: Sequence[str],
                               vars_dropped=vars_dropped))
     total_ms = (time.perf_counter() - t_all) * 1e3
     report = PassReport(stats, n0, len(opt.global_block.ops), total_ms,
-                        vars_dropped, amp_counts)
+                        vars_dropped, amp_counts, remat_counts,
+                        remat_table)
     return opt, report

@@ -154,6 +154,23 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["amp_casts_inserted"] > 0, last
     assert last["amp_master_params"] > 0, last
     assert last["amp_h2d_bytes"] < last["amp_f32_h2d_bytes"], last
+    # rematerialization probe contract: XLA temp/peak bytes strictly
+    # drop with remat on, at BITWISE-identical loss (dropout replay
+    # inside recomputed segments); gradient_merge_k=4 covers 4
+    # microbatches per compiled dispatch within 1e-5 of unmerged f32
+    for key in ("remat_temp_bytes", "f32_temp_bytes", "remat_peak_bytes",
+                "f32_peak_bytes", "gm_tokens_per_sec", "memory_stats",
+                "gm_loss_delta"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["remat_temp_bytes"] < last["f32_temp_bytes"], last
+    assert last["remat_peak_bytes"] < last["f32_peak_bytes"], last
+    assert last.get("remat_parity_bitwise") is True, last
+    assert last["remat_segments"] > 1, last
+    assert last["gm_loss_delta"] <= 1e-5, last
+    assert last["gm_k"] == 4 and last["gm_microbatches"] == \
+        4 * last["gm_dispatches"], last
+    for key in ("temp_bytes", "peak_bytes", "argument_bytes"):
+        assert last["memory_stats"].get(key, 0) > 0, last["memory_stats"]
 
 
 @pytest.mark.slow

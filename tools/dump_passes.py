@@ -24,6 +24,12 @@ Knobs off by name: --disable fuse_elewise_add_act_ops,cse
 Mixed precision: --amp [bf16|fp16] enables the auto_mixed_precision
 pass and prints a per-op dtype table (inserted/elided casts, f32-pinned
 ops, low-precision ops) after the usual per-pass report.
+
+Rematerialization: --remat [N] enables the recompute_segmentation pass
+(N segments; omit N for the automatic sqrt split, or pass checkpoint
+var names via --checkpoints a,b) and prints the per-segment table: ops
+per segment, stashed (boundary) vs recomputed (interior) var counts and
+estimated bytes.
 """
 from __future__ import annotations
 
@@ -125,6 +131,14 @@ def main():
                     choices=("bf16", "bfloat16", "fp16", "float16"),
                     help="run the auto_mixed_precision pass (default "
                          "bf16) and print the per-op dtype table")
+    ap.add_argument("--remat", nargs="?", const=0, default=None, type=int,
+                    metavar="N",
+                    help="run the recompute_segmentation pass (N "
+                         "segments, 0/omitted = sqrt heuristic) and "
+                         "print the per-segment stash/recompute table")
+    ap.add_argument("--checkpoints", default=None,
+                    help="comma-separated checkpoint var names marking "
+                         "remat segment boundaries (implies --remat)")
     ap.add_argument("--dot", default=None,
                     help="write the optimized block as graphviz dot")
     args = ap.parse_args()
@@ -158,6 +172,12 @@ def main():
     if args.amp:
         strategy.amp = True
         strategy.amp_dtype = args.amp
+    if args.remat is not None or args.checkpoints:
+        strategy.recompute = True
+        strategy.recompute_segments = args.remat or 0
+        if args.checkpoints:
+            strategy.recompute_checkpoints = tuple(
+                s for s in args.checkpoints.split(",") if s)
 
     optimized, report = static.apply_passes(program, feeds, fetches,
                                             strategy)
@@ -165,6 +185,9 @@ def main():
     if args.amp:
         print()
         print(_amp_table(optimized, report))
+    if args.remat is not None or args.checkpoints:
+        print()
+        print(report.remat_segment_table())
     if args.dot:
         static.save_dot(optimized, args.dot)
         print(f"optimized block dot -> {args.dot}")
