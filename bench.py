@@ -397,6 +397,63 @@ def _remat_probe(steps=3):
     }
 
 
+def _serving_probe(requests=60, workers=4):
+    """Serving-engine probe: save a small static net as an inference
+    blob, load it through AnalysisPredictor (manifest-verified, bucket
+    ladder 1/2/4/8 compiled warm), and drive the continuous-batching
+    ServingEngine with the deterministic closed-loop load generator at
+    MIXED request sizes (1/2/3 rows cycling). Reports requests/s and
+    p50/p99 latency plus the robustness counters — with faults off and
+    nominal load, zero requests may be shed, expired, or degraded
+    (test_bench_contract pins that).
+
+    Fixed small shapes: like the other probes this measures the serving
+    machinery, not model throughput."""
+    import tempfile
+
+    import paddle_tpu.static as static
+    from paddle_tpu.inference.serving import (AnalysisPredictor,
+                                              ServingEngine)
+    from tools.load_gen import LoadGen
+
+    H = 16
+    with tempfile.TemporaryDirectory() as tmp:
+        main, startup = static.Program(), static.Program()
+        main.random_seed = startup.random_seed = 99
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, H])
+            h = static.nn.fc(x, 32, act="relu")
+            out = static.nn.fc(h, 4)
+        exe = static.Executor()
+        exe.run(startup)
+        d = os.path.join(tmp, "blob")
+        static.save_inference_model(d, ["x"], [out], exe, main)
+        predictor = AnalysisPredictor(d, batch_buckets=(1, 2, 4, 8))
+        predictor.warm()
+        engine = ServingEngine(predictor).start()
+        try:
+            summary = LoadGen(engine, total_requests=requests,
+                              workers=workers, sizes=(1, 2, 3)).run()
+        finally:
+            engine.drain(timeout=30)
+        ec = engine.counters
+        return {
+            "serve_requests_per_sec": summary["requests_per_sec"],
+            "serve_p50_ms": summary["p50_ms"],
+            "serve_p99_ms": summary["p99_ms"],
+            "serve_requests": int(ec.get("serve_requests", 0)),
+            "serve_batches": int(ec.get("serve_batches", 0)),
+            "serve_shed": int(ec.get("serve_shed", 0)),
+            "serve_deadline_expired":
+                int(ec.get("serve_deadline_expired", 0)),
+            "serve_degraded": int(ec.get("serve_degraded", 0)),
+            "serve_failed": int(ec.get("serve_failed", 0)),
+            "serve_batch_fill_pct":
+                float(ec.get("serve_batch_fill_pct", 0.0)),
+            "serve_ok": int(summary["ok"]),
+        }
+
+
 def bench_bert(seq=128, smoke=False, trend=False):
     """BASELINE.md config 3: BERT-base pretraining, tokens/sec/chip.
 
@@ -516,10 +573,19 @@ def bench_bert(seq=128, smoke=False, trend=False):
         remat_probe = _remat_probe()
     except Exception as e:
         remat_probe = {"remat_probe_error": f"{type(e).__name__}: {e}"}
+    # serving probe: continuous-batching engine over a bucket-compiled
+    # predictor under deterministic closed-loop load (requests/s +
+    # p50/p99 + shed/deadline/degraded counters + batch fill)
+    try:
+        serving_probe = _serving_probe()
+    except Exception as e:
+        serving_probe = {"serving_probe_error":
+                         f"{type(e).__name__}: {e}"}
     return {
         **pass_probe,
         **amp_probe,
         **remat_probe,
+        **serving_probe,
         "value": tokens / dt, "unit": "tokens/s",
         "flops_per_step": flops_per_step,
         "steps_per_sec": steps / dt, "dt": dt, "steps": steps,

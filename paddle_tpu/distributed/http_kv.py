@@ -19,10 +19,42 @@ from typing import Optional
 __all__ = ["KVHandler", "KVHTTPServer", "KVServer", "KVClient"]
 
 
+# shared lazy counter shim (fault/ is jax-free; profiler loads on bump)
+from ..fault.injector import _bump as _bump_counter  # noqa: E402
+
+
 class KVHandler(BaseHTTPRequestHandler):
     """GET returns the stored bytes (404 when absent), PUT stores the
     body, DELETE removes the key and counts toward the scope's
-    deleted-size barrier."""
+    deleted-size barrier.
+
+    Hardened against misbehaving clients — this server doubles as the
+    serving health endpoint, so a single bad peer must not wedge it:
+
+    - a PUT whose Content-Length exceeds the server's ``max_body_bytes``
+      is rejected 413 without reading the body (counter
+      ``kv_rejected_oversize``) and the connection is closed;
+    - a missing/unparseable Content-Length on PUT is a 411;
+    - every connection socket carries the server's ``request_timeout``,
+      so a client that stalls mid-request (half-sent headers, dribbled
+      body) gets its connection closed (counter ``kv_conn_timeouts``)
+      instead of pinning a handler thread forever."""
+
+    def setup(self):
+        # per-connection socket timeout BEFORE the stream wrappers are
+        # built: socketserver applies self.timeout in its setup()
+        self.timeout = getattr(self.server, "request_timeout", None)
+        super().setup()
+
+    def log_error(self, format, *args):  # noqa: A002 (reference name)
+        # handle_one_request swallows socket timeouts after routing them
+        # here — the one hook where a stalled connection is observable;
+        # everything else keeps the stock stderr diagnostics (only
+        # access logging via log_message is quieted)
+        if "timed out" in (format % args if args else format):
+            _bump_counter("kv_conn_timeouts")
+            return
+        BaseHTTPRequestHandler.log_error(self, format, *args)
 
     def do_GET(self):
         with self.server.kv_lock:
@@ -36,7 +68,44 @@ class KVHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_PUT(self):
-        n = int(self.headers.get("Content-Length", 0))
+        raw_len = self.headers.get("Content-Length")
+        try:
+            n = int(raw_len)
+        except (TypeError, ValueError):
+            # missing (None) or unparseable: refuse rather than guess —
+            # a silent empty-body store would destroy the stored value
+            self.send_status_code(411)
+            self.close_connection = True
+            return
+        if n < 0:
+            # a negative length slips past the oversize guard and makes
+            # rfile.read(n) read until EOF — unbounded buffering, the
+            # exact hole max_body_bytes closes
+            self.send_status_code(400)
+            self.close_connection = True
+            return
+        limit = getattr(self.server, "max_body_bytes", None)
+        if limit is not None and n > limit:
+            # reject WITHOUT buffering. Up to 4x the cap the body is
+            # drained in chunks (O(chunk) memory) so the client reads a
+            # clean 413 instead of hitting EPIPE mid-send — which its
+            # retry layer would treat as transient and re-send the
+            # whole oversized body for. Past that (absurd declared
+            # lengths) the body is left unread: the 413 is still sent,
+            # but a client mid-send will usually see the reset first
+            # and surface a connection error after its retries — the
+            # accepted tradeoff for not sinking unbounded bandwidth.
+            _bump_counter("kv_rejected_oversize")
+            if n <= 4 * limit:
+                left = n
+                while left > 0:
+                    chunk = self.rfile.read(min(left, 1 << 16))
+                    if not chunk:
+                        break
+                    left -= len(chunk)
+            self.send_status_code(413)
+            self.close_connection = True
+            return
         body = self.rfile.read(n) if n else b""
         with self.server.kv_lock:
             self.server.kv[self.path.strip("/")] = body
@@ -65,10 +134,19 @@ class KVHTTPServer(ThreadingHTTPServer):
 
     Binds loopback by default — the unauthenticated KV store must not be
     reachable from the network unless a real multi-node bring-up opts in
-    (host="" or the node's address)."""
+    (host="" or the node's address).
 
-    def __init__(self, port, handler, host="127.0.0.1"):
+    ``max_body_bytes`` bounds any single PUT body (413 past it; None
+    disables) and ``request_timeout`` is the per-connection socket
+    timeout in seconds (None disables) — together they keep one stalled
+    or oversized client from wedging the KV/health server."""
+
+    def __init__(self, port, handler, host="127.0.0.1",
+                 max_body_bytes: int = 64 << 20,
+                 request_timeout: Optional[float] = 30.0):
         super().__init__((host, int(port)), handler)
+        self.max_body_bytes = max_body_bytes
+        self.request_timeout = request_timeout
         self.delete_kv = {}
         self.kv_lock = threading.Lock()
         self.kv = {}
@@ -82,8 +160,12 @@ class KVServer:
     """Start/stop wrapper (reference KVServer): `size` maps scope ->
     expected delete count for wait_server_ready-style barriers."""
 
-    def __init__(self, port, size=None, host="127.0.0.1"):
-        self.http_server = KVHTTPServer(port, KVHandler, host=host)
+    def __init__(self, port, size=None, host="127.0.0.1",
+                 max_body_bytes: int = 64 << 20,
+                 request_timeout: Optional[float] = 30.0):
+        self.http_server = KVHTTPServer(port, KVHandler, host=host,
+                                        max_body_bytes=max_body_bytes,
+                                        request_timeout=request_timeout)
         self.listen_thread = None
         self.size = dict(size or {})
 

@@ -103,17 +103,21 @@ class Supervisor:
 
     def __init__(self, nranks, script_args=None, base_port=6170,
                  max_restarts=3, backoff=None, poll_interval=1.0,
-                 start_fn=None, sleep=time.sleep):
+                 start_fn=None, sleep=time.sleep, drain_window=30.0,
+                 clock=time.monotonic):
         from ..fault.retry import Backoff
 
         self.nranks = int(nranks)
         self.max_restarts = int(max_restarts)
         self.poll_interval = float(poll_interval)
+        self.drain_window = float(drain_window)
         self._backoff = backoff or Backoff(base=1.0, cap=30.0)
         self._sleep = sleep
+        self._clock = clock
         self._lock = threading.Lock()
         self._external_dead = set()
         self._relaunch_listeners = []
+        self._stop_requested = False
         self.restarts = 0
         if start_fn is not None:
             self._start_fn = start_fn
@@ -122,6 +126,59 @@ class Supervisor:
                 raise ValueError("need script_args or start_fn")
             self._start_fn = lambda rank: _start_one_trainer(
                 rank, self.nranks, script_args, base_port)
+
+    # -- graceful shutdown (SIGTERM forwarding + bounded drain) -------------
+    def request_stop(self) -> None:
+        """Ask the supervision loop to shut the job down gracefully:
+        children get SIGTERM forwarded (their drain/checkpoint-on-term
+        handlers run — the serving engine flushes in-flight batches,
+        TrainEpochRange commits its snapshot), then a bounded
+        ``drain_window`` passes before any straggler is SIGKILLed.
+        Safe from a signal handler or another thread."""
+        self._stop_requested = True
+
+    def install_signal_forwarding(self, signals=(signal.SIGTERM,)) -> None:
+        """Route the given signals (default SIGTERM) into request_stop so
+        `kill -TERM <launcher>` drains the whole job instead of orphaning
+        children mid-batch. Main-thread only (signal.signal constraint)."""
+        for sig in signals:
+            try:
+                signal.signal(sig, lambda signum, frame:
+                              self.request_stop())
+            except (ValueError, OSError):
+                pass   # non-main thread / unsupported platform
+
+    def _drain(self, procs, done) -> int:
+        """Forward SIGTERM to every live child and wait up to
+        drain_window for them to exit on their own; whatever is still
+        alive past the window is SIGKILLed (counter
+        ``supervisor_drain_kills``). Always returns 0 — the operator
+        asked for shutdown, and the children got their drain chance."""
+        from .. import profiler
+
+        profiler.bump_counter("supervisor_drains")
+        live = [p for rank, p in sorted(procs.items())
+                if rank not in done and p.poll() is None]
+        for p in live:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+        deadline = self._clock() + self.drain_window
+        while any(p.poll() is None for p in live) \
+                and self._clock() < deadline:
+            self._sleep(min(self.poll_interval,
+                            max(0.0, deadline - self._clock())))
+        kill = getattr(signal, "SIGKILL", signal.SIGTERM)
+        for p in live:
+            if p.poll() is None:
+                profiler.bump_counter("supervisor_drain_kills")
+                try:
+                    p.send_signal(kill)
+                except Exception:
+                    pass
+                self._await_death(p)
+        return 0
 
     # -- external liveness policy (heartbeat monitor) -----------------------
     def notify_dead(self, rank: int) -> None:
@@ -191,6 +248,8 @@ class Supervisor:
             for rank in range(self.nranks):
                 procs[rank] = self._start_rank(rank)
             while len(done) < self.nranks:
+                if self._stop_requested:
+                    return self._drain(procs, done)
                 now = time.monotonic()
                 for rank in [r for r, t in pending.items() if now >= t]:
                     del pending[rank]
@@ -250,14 +309,33 @@ class Supervisor:
 
 def supervise(nranks, script_args=None, base_port=6170, max_restarts=3,
               backoff=None, poll_interval=1.0, start_fn=None,
-              sleep=time.sleep) -> int:
+              sleep=time.sleep, drain_window=30.0,
+              forward_signals=False) -> int:
     """Run ``nranks`` trainers under relaunch supervision (see
     Supervisor). Returns 0 once every rank has exited cleanly; raises
-    RestartBudgetExceeded when deaths outrun the budget."""
-    return Supervisor(nranks, script_args=script_args, base_port=base_port,
-                      max_restarts=max_restarts, backoff=backoff,
-                      poll_interval=poll_interval, start_fn=start_fn,
-                      sleep=sleep).run()
+    RestartBudgetExceeded when deaths outrun the budget.
+    ``forward_signals=True`` installs the SIGTERM→graceful-drain
+    forwarding (children get SIGTERM + a ``drain_window`` to flush/
+    checkpoint before any kill)."""
+    sup = Supervisor(nranks, script_args=script_args, base_port=base_port,
+                     max_restarts=max_restarts, backoff=backoff,
+                     poll_interval=poll_interval, start_fn=start_fn,
+                     sleep=sleep, drain_window=drain_window)
+    if not forward_signals:
+        return sup.run()
+    # restore the previous handlers on the way out: leaving ours
+    # installed would route a later SIGTERM into a finished Supervisor
+    # — silently swallowed, making the process unkillable except -9
+    prev = {sig: signal.getsignal(sig) for sig in (signal.SIGTERM,)}
+    sup.install_signal_forwarding()
+    try:
+        return sup.run()
+    finally:
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
 
 
 def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
@@ -300,7 +378,8 @@ def main():
     if args.max_restarts > 0:
         sys.exit(supervise(args.nproc_per_node, script,
                            base_port=args.started_port,
-                           max_restarts=args.max_restarts))
+                           max_restarts=args.max_restarts,
+                           forward_signals=True))
     procs = start_local_trainers(
         args.nproc_per_node, script, base_port=args.started_port)
     sys.exit(watch_local_trainers(procs))

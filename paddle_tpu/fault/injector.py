@@ -21,6 +21,11 @@ Points in use (grep for ``point(`` to enumerate):
     http_kv.request   before each KV client HTTP round-trip
     download.resolve  before hapi download cache resolution
     download.fetch    before the incubate weights fetch
+    serve.admit       serving-engine admission (inference/serving.py)
+    serve.assemble    before a serving tick pops its batch
+    serve.dispatch    before each compiled serving dispatch (retried)
+    serve.respond     before each per-request result delivery
+    serve.fallback    before each degraded batch-1 eager fallback
 
 ``PADDLE_FAULT_SPEC`` grammar — comma-separated triggers::
 

@@ -158,3 +158,18 @@ def create_predictor(config: Config) -> Predictor:
 # NativePaddlePredictor-era aliases
 PaddlePredictor = Predictor
 AnalysisConfig = Config
+
+# TPU-native serving engine (continuous batching, admission control,
+# deadlines, chaos-tested degradation) — see serving.py
+from .serving import (AnalysisPredictor, DeadlineExceeded,  # noqa: E402
+                      EngineStopped, Overloaded, RequestFailed,
+                      ServingEngine, ServingError, ServingHealthServer,
+                      install_sigterm_drain)
+
+__all__ = [
+    "Config", "Predictor", "create_predictor", "PaddlePredictor",
+    "AnalysisConfig", "AnalysisPredictor", "ServingEngine",
+    "ServingHealthServer", "ServingError", "Overloaded",
+    "DeadlineExceeded", "EngineStopped", "RequestFailed",
+    "install_sigterm_drain",
+]

@@ -170,8 +170,19 @@ def save_inference_model(path_prefix, layer, input_spec=None, **configs):
              str(s.dtype)) for s in structs]
         if was_training and hasattr(layer, "train"):
             layer.train()
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        pickle.dump(meta, f)
+    _atomic_write(path_prefix + ".pdmodel",
+                  lambda f: pickle.dump(meta, f))
+    # per-file sha256 manifest: load_inference_model / Predictor verify
+    # it (when present) and refuse a truncated or bit-flipped blob with
+    # an error naming the path, instead of failing deep in pickle /
+    # StableHLO deserialization
+    from .snapshot import write_file_manifest
+
+    base = os.path.basename(path_prefix)
+    write_file_manifest(
+        path_prefix + ".manifest.json",
+        {base + suffix: path_prefix + suffix
+         for suffix in (".pdmodel", ".pdiparams")})
 
 
 class TranslatedLayer:
@@ -207,7 +218,15 @@ class TranslatedLayer:
 
 def load_inference_model(path_prefix, **configs):
     """Load an inference artifact. Returns a callable TranslatedLayer when
-    a StableHLO export is present, else the raw params state dict."""
+    a StableHLO export is present, else the raw params state dict.
+
+    When a ``<prefix>.manifest.json`` integrity manifest exists (written
+    by save_inference_model), every listed file is sha256-verified first;
+    a truncated/corrupt blob raises ValueError naming the path."""
+    from .snapshot import verify_file_manifest
+
+    verify_file_manifest(path_prefix + ".manifest.json",
+                         os.path.dirname(path_prefix) or ".")
     params = load(path_prefix + ".pdiparams")
     meta_path = path_prefix + ".pdmodel"
     if os.path.exists(meta_path):

@@ -52,11 +52,78 @@ from typing import Dict, List, Optional, Tuple
 from ..fault import injector as _fault
 from ..fault.injector import _bump  # shared lazy counter shim
 
-__all__ = ["SnapshotStore", "MANIFEST_NAME"]
+__all__ = ["SnapshotStore", "MANIFEST_NAME", "write_file_manifest",
+           "verify_file_manifest"]
 
 MANIFEST_NAME = "MANIFEST.json"
 _TMP_SUFFIX = ".tmp"
 _OLD_SUFFIX = ".old"
+
+
+def _sha256_file(path: str) -> Tuple[str, int]:
+    h = hashlib.sha256()
+    nbytes = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+            nbytes += len(chunk)
+    return h.hexdigest(), nbytes
+
+
+def write_file_manifest(manifest_path: str, files: Dict[str, str]) -> str:
+    """Write a standalone integrity manifest (same schema as a
+    SnapshotStore MANIFEST.json) over existing files: ``files`` maps the
+    manifest-relative name to the on-disk path. Used by
+    save_inference_model so a serving process can refuse a truncated or
+    bit-flipped blob at load time instead of failing deep inside
+    deserialization. The manifest itself commits via tmp+fsync+replace."""
+    manifest = {"version": 1, "files": {}}
+    for name, path in files.items():
+        sha, nbytes = _sha256_file(path)
+        manifest["files"][name] = {"sha256": sha, "bytes": nbytes}
+    tmp = manifest_path + _TMP_SUFFIX
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, sort_keys=True)
+        _fsync_fileobj(f)
+    os.replace(tmp, manifest_path)
+    _fsync_dir(os.path.dirname(manifest_path) or ".")
+    return manifest_path
+
+
+def verify_file_manifest(manifest_path: str, root: str) -> Optional[list]:
+    """Check every file listed in ``manifest_path`` against its recorded
+    sha256/size (names resolve under ``root``). Returns the list of
+    verified names, or None when no manifest exists (nothing to check —
+    older blobs stay loadable). Raises ValueError NAMING THE OFFENDING
+    PATH on a missing, truncated, or corrupt file, and on an unreadable
+    manifest."""
+    if not os.path.exists(manifest_path):
+        return None
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            entries = json.load(f)["files"]
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise ValueError(
+            f"integrity manifest {manifest_path!r} is unreadable "
+            f"({type(e).__name__}: {e}); re-save the model or delete the "
+            "manifest to skip verification") from e
+    verified = []
+    for name, meta in entries.items():
+        path = os.path.join(root, name)
+        if not os.path.exists(path):
+            raise ValueError(
+                f"model file {path!r} is missing but listed in "
+                f"{manifest_path!r}; the blob is incomplete — re-save it")
+        sha, nbytes = _sha256_file(path)
+        if nbytes != meta.get("bytes") or sha != meta.get("sha256"):
+            raise ValueError(
+                f"model file {path!r} is truncated or corrupt "
+                f"(got {nbytes} bytes / sha256 {sha[:12]}..., manifest "
+                f"says {meta.get('bytes')} bytes / "
+                f"{str(meta.get('sha256'))[:12]}...); the writer was "
+                "likely interrupted — re-save the model")
+        verified.append(name)
+    return verified
 
 
 class _HashingWriter:

@@ -98,6 +98,28 @@ _state = {
 #                      the objective remat gate (temp/peak must drop
 #                      with recompute on; exe.memory_stats() mirrors)
 #
+# Serving counters (inference/serving.py ServingEngine +
+# distributed/http_kv.py hardening; SERVE_COUNTER_NAMES below):
+#   serve_requests     requests admitted past admission control
+#   serve_shed         requests shed at admission (queue bound or token
+#                      bucket) with a typed Overloaded error
+#   serve_deadline_expired  requests dropped (admission, assembly, or
+#                      respond) because their deadline passed/was
+#                      unmakeable, with a typed DeadlineExceeded
+#   serve_degraded     requests that fell back to the batch-1 eager path
+#                      after the compiled dispatch exhausted its retries
+#   serve_failed       requests failed outright (fallback failed too):
+#                      typed RequestFailed to the caller
+#   serve_batches      compiled batches dispatched
+#   serve_queue_depth  GAUGE: admission-queue depth after the last
+#                      submit/assembly
+#   serve_batch_fill_pct  GAUGE: cumulative mean of rows/bucket-capacity
+#                      per dispatched batch, in percent
+#   kv_rejected_oversize  KV/health PUTs rejected 413 over the body cap
+#   kv_conn_timeouts   KV/health connections closed on socket timeout
+#   supervisor_drains  launch.Supervisor graceful shutdowns started
+#   supervisor_drain_kills  children SIGKILLed after the drain window
+#
 #   retry_attempts     re-attempts after a retryable failure (Retrier)
 #   retry_giveups      retry budget/deadline exhausted, last error raised
 #   faults_injected    armed fault points fired (tests / PADDLE_FAULT_SPEC)
@@ -120,6 +142,16 @@ FAULT_COUNTER_NAMES = (
 # process-level compile-cache counters merged into Executor.counters
 # (bumped by the jax monitoring listener in static/compile_cache.py)
 COMPILE_COUNTER_NAMES = ("disk_cache_hits", "disk_cache_misses")
+
+# serving-path counters (ServingEngine.counters merges these plus the
+# fault slice, mirroring Executor.counters)
+SERVE_COUNTER_NAMES = (
+    "serve_requests", "serve_shed", "serve_deadline_expired",
+    "serve_degraded", "serve_failed", "serve_batches",
+    "serve_queue_depth", "serve_batch_fill_pct",
+    "kv_rejected_oversize", "kv_conn_timeouts",
+    "supervisor_drains", "supervisor_drain_kills",
+)
 
 _counters: _Counter = _Counter()
 # prefetch threads bump h2d_bytes concurrently with the training

@@ -28,6 +28,7 @@ from ..io.serialization import _atomic_write_bytes, _load_pickle, \
 
 _PARAMS_SUFFIX = ".pdparams"
 _MODEL_FILENAME = "__model__"
+_BLOB_MANIFEST = "MANIFEST.json"
 
 
 def _collect_persistables(program: Program, scope: Scope):
@@ -131,12 +132,20 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
     meta = {"feed_names": list(feeded_var_names),
             "fetch_names": fetch_names}
     blob = {"program": pruned.to_dict(), "meta": meta}
-    atomic_pickle_dump(
-        blob, os.path.join(dirname, model_filename or _MODEL_FILENAME))
+    model_name = model_filename or _MODEL_FILENAME
+    params_name = params_filename or "params" + _PARAMS_SUFFIX
+    atomic_pickle_dump(blob, os.path.join(dirname, model_name))
     state = _collect_persistables(pruned, global_scope())
-    atomic_pickle_dump(
-        state, os.path.join(dirname,
-                            params_filename or "params" + _PARAMS_SUFFIX))
+    atomic_pickle_dump(state, os.path.join(dirname, params_name))
+    # integrity manifest (io.snapshot schema): loaders — including the
+    # serving AnalysisPredictor — sha256-verify the blob before
+    # deserializing, so a torn copy fails loudly naming the file
+    from ..io.snapshot import write_file_manifest
+
+    write_file_manifest(
+        os.path.join(dirname, _BLOB_MANIFEST),
+        {name: os.path.join(dirname, name)
+         for name in (model_name, params_name)})
     return fetch_names
 
 
@@ -144,6 +153,9 @@ def load_inference_model(dirname: str, executor: Executor,
                          model_filename: Optional[str] = None,
                          params_filename: Optional[str] = None):
     import jax.numpy as jnp
+    from ..io.snapshot import verify_file_manifest
+
+    verify_file_manifest(os.path.join(dirname, _BLOB_MANIFEST), dirname)
     blob = _load_pickle(
         os.path.join(dirname, model_filename or _MODEL_FILENAME))
     program = Program.from_dict(blob["program"])

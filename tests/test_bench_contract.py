@@ -171,6 +171,23 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
         4 * last["gm_dispatches"], last
     for key in ("temp_bytes", "peak_bytes", "argument_bytes"):
         assert last["memory_stats"].get(key, 0) > 0, last["memory_stats"]
+    # serving probe contract: the continuous-batching engine served the
+    # whole closed-loop run — with faults off at nominal load, ZERO
+    # requests shed, deadline-expired, degraded, or failed — and reports
+    # throughput, tail latency, and batch fill
+    for key in ("serve_requests_per_sec", "serve_p50_ms", "serve_p99_ms",
+                "serve_requests", "serve_batches", "serve_shed",
+                "serve_deadline_expired", "serve_degraded",
+                "serve_failed", "serve_batch_fill_pct", "serve_ok"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["serve_requests_per_sec"] > 0, last
+    assert last["serve_p99_ms"] >= last["serve_p50_ms"] > 0, last
+    assert last["serve_ok"] == last["serve_requests"] > 0, last
+    assert last["serve_shed"] == 0, last
+    assert last["serve_deadline_expired"] == 0, last
+    assert last["serve_degraded"] == 0 and last["serve_failed"] == 0, last
+    assert 0 < last["serve_batch_fill_pct"] <= 100.0, last
+    assert last["serve_batches"] <= last["serve_requests"], last
 
 
 @pytest.mark.slow

@@ -1,0 +1,738 @@
+"""Serving-engine robustness tests (ISSUE 6): bucket-compiled
+AnalysisPredictor, continuous batching, admission control, deadlines,
+chaos-tested degradation, graceful drain, health probes, KV hardening,
+and the supervisor's SIGTERM forwarding.
+
+Everything deterministic: the engine is driven synchronously
+(``run_once``) with an injectable clock (no sleeps), faults come from
+the PADDLE_FAULT_SPEC machinery (no real failures), the supervisor
+drain test uses scripted fakes (no real kills); the one subprocess test
+(SIGTERM → drain → exit 0) sends the signal to a self-terminating
+worker."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu import profiler
+from paddle_tpu.fault import injector as fault
+from paddle_tpu.inference import (AnalysisPredictor, DeadlineExceeded,
+                                  EngineStopped, Overloaded,
+                                  RequestFailed, ServingEngine,
+                                  ServingHealthServer)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DRAIN_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "_serving_drain_worker.py")
+
+
+def _counter(name):
+    return profiler.counters_snapshot().get(name, 0)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    fault.disarm_all()
+
+
+def _save_blob(tmp_path, seed=7, in_dim=6, out_dim=3):
+    main, startup = static.Program(), static.Program()
+    main.random_seed = startup.random_seed = seed
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, in_dim])
+        h = static.nn.fc(x, 16, act="relu")
+        out = static.nn.fc(h, out_dim)
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "blob")
+        static.save_inference_model(d, ["x"], [out], exe, main)
+    return d
+
+
+@pytest.fixture()
+def blob(tmp_path):
+    return _save_blob(tmp_path)
+
+
+@pytest.fixture()
+def predictor(blob):
+    p = AnalysisPredictor(blob, batch_buckets=(1, 2, 4))
+    p.warm()
+    return p
+
+
+def _feed(rows, in_dim=6, seed=0):
+    return {"x": np.random.RandomState(seed).randn(
+        rows, in_dim).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# AnalysisPredictor: buckets, padding parity, eager fallback parity
+# ---------------------------------------------------------------------------
+def test_predictor_bucket_ladder_and_padding_parity(predictor):
+    assert predictor.bucket_for(1) == 1
+    assert predictor.bucket_for(2) == 2
+    assert predictor.bucket_for(3) == 4
+    with pytest.raises(ValueError, match="largest bucket"):
+        predictor.bucket_for(5)
+    # padding to the bucket must not change the true rows' results
+    f3 = _feed(3)
+    out3 = predictor.run_batch(f3)[0]
+    assert out3.shape[0] == 3
+    f4 = _feed(4)
+    out4 = predictor.run_batch(f4)[0]
+    np.testing.assert_allclose(
+        out3, predictor.run_batch(f3)[0], rtol=0, atol=0)
+    # rows shared between different-size batches agree (the model is
+    # row-independent; padding must keep it so)
+    f4_sub = {"x": f4["x"][:3]}
+    np.testing.assert_allclose(predictor.run_batch(f4_sub)[0],
+                               out4[:3], atol=1e-6)
+
+
+def test_predictor_eager_fallback_matches_compiled(predictor):
+    f = _feed(2, seed=3)
+    np.testing.assert_allclose(predictor.run_eager(f)[0],
+                               predictor.run_batch(f)[0], atol=1e-5)
+
+
+def test_predictor_warm_compiles_every_bucket(blob):
+    p = AnalysisPredictor(blob, batch_buckets=(1, 2, 4))
+    assert p.warm() == 3
+    before = dict(p.counters)
+    # every ladder size now dispatches without a new compile
+    for rows in (1, 2, 3, 4):
+        p.run_batch(_feed(rows))
+    delta = {k: p.counters.get(k, 0) - before.get(k, 0)
+             for k in ("compile_cache_misses", "compile_cache_hits")}
+    assert delta["compile_cache_misses"] == 0
+    assert delta["compile_cache_hits"] == 4
+
+
+def test_predictor_verifies_manifest(tmp_path):
+    d = _save_blob(tmp_path)
+    with open(os.path.join(d, "params.pdparams"), "r+b") as f:
+        f.truncate(8)
+    with pytest.raises(ValueError, match="params.pdparams"):
+        AnalysisPredictor(d)
+
+
+def test_static_load_inference_model_verifies_manifest(tmp_path):
+    d = _save_blob(tmp_path)
+    exe = static.Executor()
+    static.load_inference_model(d, exe)   # intact: loads
+    with open(os.path.join(d, "__model__"), "ab") as f:
+        f.write(b"garbage")
+    with pytest.raises(ValueError, match="__model__"):
+        static.load_inference_model(d, exe)
+
+
+def test_dygraph_inference_manifest_verified(tmp_path):
+    from paddle_tpu import nn
+    from paddle_tpu.io import serialization
+    from paddle_tpu.static.input_spec import InputSpec
+
+    prefix = str(tmp_path / "lin")
+    serialization.save_inference_model(
+        prefix, nn.Linear(4, 2), input_spec=[InputSpec([2, 4], "float32")])
+    assert os.path.exists(prefix + ".manifest.json")
+    serialization.load_inference_model(prefix)   # intact: loads
+    with open(prefix + ".pdmodel", "r+b") as f:
+        f.truncate(4)
+    with pytest.raises(ValueError, match="pdmodel"):
+        serialization.load_inference_model(prefix)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching (sync drive: deterministic, no threads)
+# ---------------------------------------------------------------------------
+def test_engine_packs_compatible_requests_into_one_batch(predictor):
+    eng = ServingEngine(predictor)
+    before = dict(predictor.counters)
+    h1 = eng.submit(_feed(2, seed=1))
+    h2 = eng.submit(_feed(1, seed=2))
+    h3 = eng.submit(_feed(1, seed=3))
+    assert eng.run_once() == 3          # 2+1+1 rows = one bucket-4 batch
+    assert predictor.counters["executor_steps"] - \
+        before.get("executor_steps", 0) == 1
+    for h, seed, rows in ((h1, 1, 2), (h2, 2, 1), (h3, 3, 1)):
+        got = h.result(0)[0]
+        assert got.shape[0] == rows
+        np.testing.assert_allclose(
+            got, predictor.run_batch(_feed(rows, seed=seed))[0],
+            atol=1e-6)
+    assert eng.counters["serve_requests"] == 3
+    assert eng.counters["serve_batches"] == 1
+    assert eng.counters["serve_batch_fill_pct"] == 100.0
+    assert eng.counters["serve_queue_depth"] == 0
+
+
+def test_engine_overflow_rides_next_tick(predictor):
+    eng = ServingEngine(predictor)
+    handles = [eng.submit(_feed(2, seed=i)) for i in range(3)]
+    assert eng.run_once() == 2          # 2+2 fills bucket 4; third waits
+    assert not handles[2].done()
+    assert eng.run_once() == 1
+    assert handles[2].result(0)[0].shape[0] == 2
+    assert eng.counters["serve_batches"] == 2
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+def test_queue_bound_sheds_with_typed_overloaded(predictor):
+    eng = ServingEngine(predictor, max_queue=2)
+    eng.submit(_feed(1))
+    eng.submit(_feed(1))
+    before = eng.counters.get("serve_shed", 0)
+    with pytest.raises(Overloaded, match="queue full"):
+        eng.submit(_feed(1))
+    assert eng.counters["serve_shed"] == before + 1
+    # shedding didn't fail the admitted ones
+    eng.run_once()
+    assert eng.counters["serve_requests"] == 2
+
+
+def test_token_bucket_rate_limit_with_injectable_clock(predictor):
+    t = [0.0]
+    eng = ServingEngine(predictor, rate_limit=2.0, burst=2,
+                        clock=lambda: t[0])
+    eng.submit(_feed(1, seed=1))
+    eng.submit(_feed(1, seed=2))
+    with pytest.raises(Overloaded, match="rate limit"):
+        eng.submit(_feed(1, seed=3))
+    t[0] = 0.5                           # one token refilled (2/s)
+    eng.submit(_feed(1, seed=4))
+    with pytest.raises(Overloaded):
+        eng.submit(_feed(1, seed=5))
+    assert eng.counters["serve_shed"] == 2
+
+
+def test_oversized_request_rejected_at_submit(predictor):
+    eng = ServingEngine(predictor)
+    with pytest.raises(ValueError, match="largest batch"):
+        eng.submit(_feed(9))
+
+
+def test_zero_rate_limit_is_an_error_not_disabled(predictor):
+    # 0 is falsy: a truthiness check would silently DISABLE the limiter
+    # for an operator dialing admission to zero
+    with pytest.raises(ValueError, match="rate_limit"):
+        ServingEngine(predictor, rate_limit=0)
+    # a bucket that can never hold one whole token sheds everything —
+    # refuse at construction rather than silently serving nothing
+    with pytest.raises(ValueError, match="burst"):
+        ServingEngine(predictor, rate_limit=10, burst=0)
+
+
+def test_sub_one_rate_limit_still_serves(predictor):
+    # burst floors at one whole token; without it rate_limit < 1 req/s
+    # caps the bucket below 1.0 and sheds 100% of traffic forever
+    t = [0.0]
+    eng = ServingEngine(predictor, rate_limit=0.5, clock=lambda: t[0])
+    eng.submit(_feed(1, seed=1))
+    with pytest.raises(Overloaded, match="rate limit"):
+        eng.submit(_feed(1, seed=2))
+    t[0] = 2.0                           # one token refilled (0.5/s)
+    eng.submit(_feed(1, seed=3))
+    assert eng.run_once() == 2
+
+
+# ---------------------------------------------------------------------------
+# deadlines (injectable clock — zero sleeps)
+# ---------------------------------------------------------------------------
+def test_unmakeable_deadline_expires_at_admission(predictor):
+    eng = ServingEngine(predictor, min_service_s=0.010,
+                        clock=lambda: 0.0)
+    before = eng.counters.get("serve_deadline_expired", 0)
+    with pytest.raises(DeadlineExceeded, match="cannot be met"):
+        eng.submit(_feed(1), deadline_s=0.005)
+    assert eng.counters["serve_deadline_expired"] == before + 1
+
+
+def test_queued_request_dropped_the_moment_deadline_passes(predictor):
+    t = [0.0]
+    eng = ServingEngine(predictor, clock=lambda: t[0])
+    h_live = eng.submit(_feed(1, seed=1), deadline_s=100.0)
+    h_dead = eng.submit(_feed(1, seed=2), deadline_s=1.0)
+    t[0] = 2.0                           # past h_dead's deadline only
+    assert eng.run_once() == 1           # h_live served; h_dead dropped
+    with pytest.raises(DeadlineExceeded, match="deadline passed"):
+        h_dead.result(0)
+    assert h_live.result(0)[0].shape[0] == 1
+    assert eng.counters["serve_deadline_expired"] == 1
+
+
+def test_default_deadline_applies(predictor):
+    t = [0.0]
+    eng = ServingEngine(predictor, default_deadline_s=1.0,
+                        clock=lambda: t[0])
+    h = eng.submit(_feed(1))
+    t[0] = 5.0
+    eng.run_once()
+    with pytest.raises(DeadlineExceeded):
+        h.result(0)
+
+
+# ---------------------------------------------------------------------------
+# chaos: injected dispatch failure -> retry -> degraded fallback -> typed
+# failure on exhausted budget, with counters asserting each transition
+# ---------------------------------------------------------------------------
+def test_chaos_dispatch_fault_retry_then_degraded_fallback(
+        predictor, monkeypatch):
+    # the acceptance-path spec grammar: dispatch fails twice (the first
+    # attempt AND its one retry), the batch-1 eager fallback serves
+    monkeypatch.setenv("PADDLE_FAULT_SPEC", "serve.dispatch:2")
+    fault.load_env_spec()
+    eng = ServingEngine(predictor, retry_attempts=2,
+                        sleep=lambda d: None)
+    base = {k: _counter(k) for k in ("retry_attempts", "faults_injected")}
+    h = eng.submit(_feed(2, seed=5))
+    assert eng.run_once() == 1
+    # served, degraded, bitwise-comparable to the eager reference
+    got = h.result(0)[0]
+    np.testing.assert_allclose(
+        got, predictor.run_eager(_feed(2, seed=5))[0], atol=1e-6)
+    assert eng.counters["serve_degraded"] == 1
+    assert eng.counters.get("serve_failed", 0) == 0
+    assert _counter("retry_attempts") - base["retry_attempts"] == 1
+    assert _counter("faults_injected") - base["faults_injected"] == 2
+    # faults consumed: the next request rides the compiled path clean
+    h2 = eng.submit(_feed(2, seed=6))
+    eng.run_once()
+    assert h2.error() is None
+    assert eng.counters["serve_degraded"] == 1   # unchanged
+
+
+def test_degraded_fallback_handles_scalar_fetch(tmp_path, monkeypatch):
+    # a 0-d (batch-reduced) fetch rides the compiled path unsliced
+    # (run_once's as-is branch); the per-row eager fallback must not
+    # crash concatenating scalars — it delivers the scalar as-is too
+    main, startup = static.Program(), static.Program()
+    main.random_seed = startup.random_seed = 11
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 6])
+        out = static.nn.fc(x, 4)
+        m = static.mean(out)
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe = static.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "sblob")
+        static.save_inference_model(d, ["x"], [out, m], exe, main)
+    p = AnalysisPredictor(d, batch_buckets=(1, 2))
+    p.warm()
+    monkeypatch.setenv("PADDLE_FAULT_SPEC", "serve.dispatch:2")
+    fault.load_env_spec()
+    eng = ServingEngine(p, retry_attempts=2, sleep=lambda d: None)
+    h = eng.submit(_feed(2, seed=3))
+    assert eng.run_once() == 1
+    vals = h.result(0)
+    assert vals[0].shape == (2, 4)
+    assert np.asarray(vals[1]).ndim == 0         # delivered unsliced
+    assert eng.counters["serve_degraded"] == 1
+    assert eng.counters.get("serve_failed", 0) == 0
+
+
+def test_chaos_exhausted_budget_fails_typed(predictor, monkeypatch):
+    monkeypatch.setenv("PADDLE_FAULT_SPEC",
+                       "serve.dispatch:2,serve.fallback:1")
+    fault.load_env_spec()
+    eng = ServingEngine(predictor, retry_attempts=2,
+                        sleep=lambda d: None)
+    h = eng.submit(_feed(1, seed=9))
+    eng.run_once()
+    with pytest.raises(RequestFailed, match="fallback failed too"):
+        h.result(0)
+    assert eng.counters["serve_failed"] == 1
+
+
+def test_chaos_mixed_batch_partial_failure(predictor):
+    # fallback fails only for the FIRST request of the batch; the second
+    # must still be served degraded, not collateral-failed
+    fault.arm("serve.dispatch", times=2)
+    fault.arm("serve.fallback", times=1)
+    eng = ServingEngine(predictor, retry_attempts=2,
+                        sleep=lambda d: None)
+    h1 = eng.submit(_feed(1, seed=1))
+    h2 = eng.submit(_feed(1, seed=2))
+    eng.run_once()
+    assert isinstance(h1.error(), RequestFailed)
+    assert h2.error() is None and h2.result(0)[0].shape[0] == 1
+    assert eng.counters["serve_failed"] == 1
+    assert eng.counters["serve_degraded"] == 1
+
+
+def test_respond_fault_fails_only_that_request(predictor):
+    fault.arm("serve.respond", times=1)
+    eng = ServingEngine(predictor)
+    h1 = eng.submit(_feed(1, seed=1))
+    h2 = eng.submit(_feed(1, seed=2))
+    eng.run_once()
+    assert isinstance(h1.error(), fault.InjectedFault)
+    assert h2.error() is None
+
+
+def test_assemble_fault_is_transient_not_fatal(predictor):
+    fault.arm("serve.assemble", times=1)
+    eng = ServingEngine(predictor)
+    h = eng.submit(_feed(1))
+    assert eng.run_once() == 0           # faulted tick: queue intact
+    assert eng.queue_depth == 1
+    assert eng.run_once() == 1
+    assert h.error() is None
+
+
+# ---------------------------------------------------------------------------
+# drain / stop
+# ---------------------------------------------------------------------------
+def test_drain_flushes_queue_then_refuses_admission(predictor):
+    eng = ServingEngine(predictor)
+    handles = [eng.submit(_feed(1, seed=i)) for i in range(5)]
+    assert eng.drain() is True
+    assert all(h.done() and h.error() is None for h in handles)
+    with pytest.raises(EngineStopped):
+        eng.submit(_feed(1))
+
+
+def test_stop_keeps_queue_and_start_resumes(predictor):
+    """stop() is not a flush (queued requests stay queued) and a later
+    start() reopens admission and serves the backlog — with exactly one
+    scheduler thread."""
+    import threading
+
+    eng = ServingEngine(predictor)
+    handles = [eng.submit(_feed(1, seed=i)) for i in range(3)]
+    eng.start()
+    eng.stop()
+    with pytest.raises(EngineStopped):
+        eng.submit(_feed(1, seed=7))
+    eng.start()
+    for h in handles:
+        assert h.result(timeout=30)[0].shape[0] == 1
+    # restarted engine admits again, on a single scheduler thread
+    assert eng.submit(_feed(1, seed=8)).result(timeout=30)
+    assert sum(1 for t in threading.enumerate()
+               if t.name == "serving-scheduler") == 1
+    assert eng.drain(timeout=30) is True
+
+
+def test_sigterm_drains_and_exits_zero(tmp_path):
+    """SIGTERM → stop admitting → flush in-flight → exit 0, zero
+    admitted requests lost (subprocess: the worker signals itself)."""
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu",
+                "DRAIN_REQUESTS": "12"})
+    out = subprocess.run([sys.executable, _DRAIN_WORKER], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert "DRAINED done=12 ok=12 total=12" in out.stdout, out.stdout
+
+
+# ---------------------------------------------------------------------------
+# supervisor SIGTERM forwarding (scripted fakes — no real kills)
+# ---------------------------------------------------------------------------
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, d):
+        self.t += d
+
+
+class _DrainableProc:
+    """Popen-shaped fake: exits 0 ``exit_after`` fake-seconds after
+    receiving SIGTERM; ignores SIGTERM when exit_after is None."""
+
+    def __init__(self, clock, exit_after=0.0):
+        import signal as _signal
+
+        self._signal_mod = _signal
+        self._clock = clock
+        self._exit_after = exit_after
+        self._exit_at = None
+        self.returncode = None
+        self.signals = []
+        self.pid = 4242
+
+    def poll(self):
+        if self.returncode is None and self._exit_at is not None \
+                and self._clock() >= self._exit_at:
+            self.returncode = 0
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        if sig == self._signal_mod.SIGTERM:
+            if self._exit_after is not None:
+                self._exit_at = self._clock() + self._exit_after
+        else:                      # SIGKILL (or platform fallback)
+            self.returncode = -int(sig)
+
+    def wait(self, timeout=None):
+        return self.poll()
+
+
+def test_supervisor_forwards_sigterm_and_drains_clean():
+    import signal as signal_mod
+
+    from paddle_tpu.distributed.launch import Supervisor
+
+    clock = _FakeClock()
+    procs = []
+
+    def start_fn(rank):
+        p = _DrainableProc(clock, exit_after=0.5)
+        procs.append(p)
+        return p
+
+    sup = Supervisor(2, start_fn=start_fn, max_restarts=0,
+                     poll_interval=0.1, sleep=clock.sleep, clock=clock,
+                     drain_window=5.0)
+    before = _counter("supervisor_drains")
+    sup.request_stop()
+    assert sup.run() == 0
+    # both children got exactly SIGTERM (graceful), no SIGKILL
+    assert all(p.signals == [signal_mod.SIGTERM] for p in procs)
+    assert all(p.returncode == 0 for p in procs)
+    assert _counter("supervisor_drains") == before + 1
+
+
+def test_supervisor_kills_straggler_after_drain_window():
+    import signal as signal_mod
+
+    from paddle_tpu.distributed.launch import Supervisor
+
+    clock = _FakeClock()
+    procs = []
+
+    def start_fn(rank):
+        # rank 0 drains; rank 1 ignores SIGTERM
+        p = _DrainableProc(clock, exit_after=0.5 if rank == 0 else None)
+        procs.append(p)
+        return p
+
+    sup = Supervisor(2, start_fn=start_fn, max_restarts=0,
+                     poll_interval=0.1, sleep=clock.sleep, clock=clock,
+                     drain_window=2.0)
+    before = _counter("supervisor_drain_kills")
+    sup.request_stop()
+    assert sup.run() == 0
+    assert procs[0].signals == [signal_mod.SIGTERM]
+    kill = getattr(signal_mod, "SIGKILL", signal_mod.SIGTERM)
+    assert procs[1].signals == [signal_mod.SIGTERM, kill]
+    assert _counter("supervisor_drain_kills") == before + 1
+    # the drain window was honored before the kill
+    assert clock.t >= 2.0
+
+
+def test_supervise_restores_sigterm_handler():
+    """supervise(forward_signals=True) must not leave its handler bound
+    to the finished Supervisor — a later SIGTERM would be silently
+    swallowed, leaving the process unkillable except with -9."""
+    import signal as signal_mod
+
+    from paddle_tpu.distributed.launch import supervise
+
+    class _DoneProc:
+        returncode = 0
+        pid = 4243
+
+        def poll(self):
+            return 0
+
+        def wait(self, timeout=None):
+            return 0
+
+        def send_signal(self, sig):
+            pass
+
+    def marker(signum, frame):
+        pass
+
+    prev = signal_mod.signal(signal_mod.SIGTERM, marker)
+    try:
+        rc = supervise(2, start_fn=lambda rank: _DoneProc(),
+                       max_restarts=0, sleep=lambda d: None,
+                       forward_signals=True)
+        assert rc == 0
+        assert signal_mod.getsignal(signal_mod.SIGTERM) is marker
+    finally:
+        signal_mod.signal(signal_mod.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# KV/health server hardening
+# ---------------------------------------------------------------------------
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_kv_server_rejects_oversized_body():
+    import http.client
+
+    from paddle_tpu.distributed.http_kv import KVClient, KVServer
+
+    srv = KVServer(_free_port(), max_body_bytes=64)
+    srv.start()
+    try:
+        port = srv.http_server.server_address[1]
+        c = KVClient(f"127.0.0.1:{port}")
+        c.put("ok/key", b"x" * 32)
+        assert c.get("ok/key") == b"x" * 32
+        before = _counter("kv_rejected_oversize")
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("PUT", "/big", body=b"y" * 128)
+        assert conn.getresponse().status == 413
+        conn.close()
+        assert _counter("kv_rejected_oversize") == before + 1
+        # the server still serves after the rejection
+        assert c.get("ok/key") == b"x" * 32
+    finally:
+        srv.stop()
+
+
+def test_kv_server_rejects_negative_content_length():
+    import http.client
+
+    from paddle_tpu.distributed.http_kv import KVServer
+
+    srv = KVServer(_free_port(), max_body_bytes=64)
+    srv.start()
+    try:
+        port = srv.http_server.server_address[1]
+        # a negative length passes the oversize guard (n > limit is
+        # False) and turns rfile.read(n) into read-until-EOF: refused 400
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.putrequest("PUT", "/neg")
+        conn.putheader("Content-Length", "-1")
+        conn.endheaders()
+        assert conn.getresponse().status == 400
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_kv_server_times_out_stalled_connection():
+    import socket
+    import time as time_mod
+
+    from paddle_tpu.distributed.http_kv import KVServer
+
+    srv = KVServer(_free_port(), request_timeout=0.2)
+    srv.start()
+    try:
+        port = srv.http_server.server_address[1]
+        before = _counter("kv_conn_timeouts")
+        sk = socket.create_connection(("127.0.0.1", port), timeout=5)
+        # half a PUT: headers promise 10 body bytes, send 2, stall
+        sk.sendall(b"PUT /stall HTTP/1.1\r\nContent-Length: 10\r\n\r\nab")
+        deadline = time_mod.monotonic() + 5
+        sk.settimeout(0.5)
+        closed = False
+        while time_mod.monotonic() < deadline:
+            try:
+                if sk.recv(256) == b"":
+                    closed = True
+                    break
+            except socket.timeout:
+                continue
+        assert closed, "stalled connection was not closed"
+        assert _counter("kv_conn_timeouts") == before + 1
+        sk.close()
+    finally:
+        srv.stop()
+
+
+def test_health_and_readiness_probes(predictor):
+    import http.client
+
+    eng = ServingEngine(predictor).start()
+    hs = ServingHealthServer(eng).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", hs.port,
+                                          timeout=5)
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().read() == b"ok"
+        conn.request("GET", "/readyz")
+        assert conn.getresponse().status == 200
+        # KV paths still work on the same listener
+        conn.request("PUT", "/scope/k", body=b"v")
+        assert conn.getresponse().status == 200
+        conn.request("GET", "/scope/k")
+        assert conn.getresponse().read() == b"v"
+        eng.drain(timeout=10)
+        conn.request("GET", "/readyz")
+        assert conn.getresponse().status == 503    # draining: not ready
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 200    # ...but still alive
+        conn.close()
+    finally:
+        hs.stop()
+        eng.stop()
+
+
+def test_health_server_stop_without_start_does_not_hang(predictor):
+    # shutdown() blocks on an event only serve_forever() sets; stop()
+    # on a constructed-but-never-started server must just close the port
+    eng = ServingEngine(predictor)
+    ServingHealthServer(eng).stop()
+
+
+def test_readyz_not_ready_before_warm_or_start(blob):
+    p = AnalysisPredictor(blob, batch_buckets=(1, 2))
+    eng = ServingEngine(p)
+    assert eng.ready is False          # scheduler not running
+    eng.start()
+    try:
+        assert eng.ready is False      # running but still warming
+        p.warm()
+        assert eng.ready is True
+        eng.stop()
+        assert eng.ready is False      # stopped again
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# load generator (deterministic closed loop)
+# ---------------------------------------------------------------------------
+def test_load_gen_serves_everything_at_nominal_load(predictor):
+    from tools.load_gen import LoadGen
+
+    eng = ServingEngine(predictor).start()
+    try:
+        summary = LoadGen(eng, total_requests=20, workers=3,
+                          sizes=(1, 2)).run()
+    finally:
+        eng.drain(timeout=30)
+    assert summary["ok"] == 20
+    assert summary["shed"] == summary["deadline_expired"] == 0
+    assert summary["failed"] == 0
+    assert summary["requests_per_sec"] > 0
+    assert summary["p99_ms"] >= summary["p50_ms"] > 0
+    assert eng.counters["serve_requests"] == 20
+    assert eng.counters.get("serve_degraded", 0) == 0
+
+
+def test_load_gen_request_content_is_deterministic(predictor):
+    from tools.load_gen import default_feed_maker
+
+    make = default_feed_maker(predictor)
+    a = make(2, 7)
+    b = make(2, 7)
+    assert a["x"].shape == (2, 6)
+    np.testing.assert_array_equal(a["x"], b["x"])

@@ -1,0 +1,185 @@
+"""Deterministic closed-loop load generator for the serving engine.
+
+Closed loop: each worker submits a request, BLOCKS for its completion,
+then submits the next — so offered load self-regulates to the engine's
+service rate and the measurement is a throughput/latency probe, not a
+queue-explosion test (open-loop overload is what the admission-control
+tests cover). Request CONTENT is deterministic: request ``i`` always
+carries the same rows (seeded by ``i``) and the same size from the
+``sizes`` cycle, whatever thread runs it — so a bench row or a chaos
+drill replays identically.
+
+Library use (bench.py's serving probe)::
+
+    from tools.load_gen import LoadGen
+    summary = LoadGen(engine, total_requests=60, workers=4,
+                      sizes=(1, 2, 3)).run()
+
+CLI (against a saved inference blob)::
+
+    python tools/load_gen.py --model-dir /path/to/blob \
+        --requests 64 --workers 4 --sizes 1,2,3 [--deadline-s 5]
+
+prints one JSON summary: requests/s, p50/p99 latency, and the
+shed/deadline/degraded/failed outcome counts.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+
+def default_feed_maker(predictor) -> Callable[[int, int], Dict[str, np.ndarray]]:
+    """Feed factory from the predictor's declared feed specs: request
+    ``i`` of ``size`` rows gets RandomState(i)-seeded values — floats
+    standard-normal, ints in [0, 8)."""
+
+    specs = predictor._feed_specs
+
+    def make(size: int, i: int) -> Dict[str, np.ndarray]:
+        rng = np.random.RandomState(i)
+        feed = {}
+        for name, (tail, dtype) in specs.items():
+            shape = (size,) + tail
+            if np.issubdtype(dtype, np.floating):
+                feed[name] = rng.randn(*shape).astype(dtype)
+            else:
+                feed[name] = rng.randint(0, 8, shape).astype(dtype)
+        return feed
+
+    return make
+
+
+class LoadGen:
+    """Drive ``engine`` with ``total_requests`` requests from ``workers``
+    closed-loop threads; sizes cycle deterministically per request index.
+    ``run()`` returns the summary dict (and stores it as ``.summary``)."""
+
+    def __init__(self, engine, total_requests: int = 64, workers: int = 4,
+                 sizes: Sequence[int] = (1, 2, 3),
+                 deadline_s: Optional[float] = None,
+                 make_feed: Optional[Callable] = None,
+                 timeout_s: float = 120.0):
+        self.engine = engine
+        self.total_requests = int(total_requests)
+        self.workers = max(1, int(workers))
+        self.sizes = tuple(int(s) for s in sizes)
+        self.deadline_s = deadline_s
+        self.make_feed = make_feed or default_feed_maker(engine.predictor)
+        self.timeout_s = float(timeout_s)
+        self.summary: Optional[dict] = None
+
+    def run(self) -> dict:
+        from paddle_tpu.inference.serving import (DeadlineExceeded,
+                                                  EngineStopped,
+                                                  Overloaded,
+                                                  RequestFailed)
+
+        counter = itertools.count()
+        outcomes = {"ok": 0, "shed": 0, "deadline_expired": 0,
+                    "failed": 0, "stopped": 0, "other_error": 0}
+        lock = threading.Lock()
+
+        def record(kind: str):
+            with lock:
+                outcomes[kind] += 1
+
+        def worker():
+            while True:
+                i = next(counter)
+                if i >= self.total_requests:
+                    return
+                feed = self.make_feed(self.sizes[i % len(self.sizes)], i)
+                try:
+                    self.engine.infer(feed, deadline_s=self.deadline_s,
+                                      timeout=self.timeout_s)
+                    record("ok")
+                except Overloaded:
+                    record("shed")
+                except DeadlineExceeded:
+                    record("deadline_expired")
+                except RequestFailed:
+                    record("failed")
+                except EngineStopped:
+                    record("stopped")
+                    return
+                except Exception:
+                    record("other_error")
+
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"loadgen-{w}")
+                   for w in range(self.workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.timeout_s)
+        dt = time.perf_counter() - t0
+        completed = sum(outcomes.values())
+        lat = self.engine.latency_stats()
+        self.summary = {
+            "requests": self.total_requests,
+            "completed": completed,
+            "wall_s": round(dt, 4),
+            # throughput counts SERVED requests only: sheds/expiries are
+            # rejected at CPU speed in a closed loop, so counting them
+            # would report near the offered rate while the engine
+            # actually serves a fraction of it
+            "requests_per_sec":
+                round(outcomes.get("ok", 0) / dt, 2) if dt else 0.0,
+            "completed_per_sec":
+                round(completed / dt, 2) if dt else 0.0,
+            "workers": self.workers,
+            "sizes": list(self.sizes),
+            "p50_ms": lat["p50_ms"],
+            "p99_ms": lat["p99_ms"],
+            "mean_ms": lat["mean_ms"],
+            **outcomes,
+        }
+        return self.summary
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser("tools/load_gen.py")
+    ap.add_argument("--model-dir", required=True,
+                    help="static.save_inference_model directory")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--sizes", default="1,2,3",
+                    help="comma-separated request row counts (cycled)")
+    ap.add_argument("--buckets", default="1,2,4,8",
+                    help="comma-separated padded batch buckets")
+    ap.add_argument("--deadline-s", type=float, default=None)
+    args = ap.parse_args()
+
+    from paddle_tpu.inference.serving import (AnalysisPredictor,
+                                              ServingEngine)
+
+    predictor = AnalysisPredictor(
+        args.model_dir,
+        batch_buckets=[int(b) for b in args.buckets.split(",")])
+    predictor.warm()
+    engine = ServingEngine(predictor).start()
+    try:
+        gen = LoadGen(engine, total_requests=args.requests,
+                      workers=args.workers,
+                      sizes=[int(s) for s in args.sizes.split(",")],
+                      deadline_s=args.deadline_s)
+        summary = gen.run()
+        summary["engine_counters"] = {
+            k: v for k, v in sorted(engine.counters.items())
+            if k.startswith("serve_")}
+        print(json.dumps(summary))
+    finally:
+        engine.drain(timeout=10)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,7 @@ MODULES = [
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.fault",
     "paddle_tpu.hapi",
+    "paddle_tpu.inference",
     "paddle_tpu.io",
     "paddle_tpu.jit",
     "paddle_tpu.metric",
