@@ -73,3 +73,7 @@ from ..io.fs import (  # noqa: F401,E402
 from ..io.dataset import (  # noqa: F401,E402
     DatasetBase, DatasetFactory, InMemoryDataset, QueueDataset,
 )
+from .elastic import (  # noqa: F401,E402
+    ElasticAgent, ElasticError, NanGuard, NumericalDivergence,
+    RendezvousTimeout, StaleGeneration, WorkerLost,
+)

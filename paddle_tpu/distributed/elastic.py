@@ -1,0 +1,545 @@
+"""Elastic multi-worker membership: generation-numbered rendezvous,
+heartbeat leases, and bounded generation-aware collectives.
+
+The reference has no elastic story — a dead trainer wedges every peer's
+barrier until the global timeout and a relaunch replays the job from
+scratch. This module composes the repo's existing robustness pieces
+(``http_kv.KVClient`` coordination, ``fault.Retrier`` transient-failure
+policy, ``ps.heartbeat.HeartBeatMonitor`` liveness bookkeeping,
+``launch.Supervisor`` relaunch) into training that keeps going:
+
+**Generation-numbered membership.** Workers rendezvous through the KV
+server into a numbered *generation*: the KV key ``elastic/<job>/gen``
+holds the current generation number, and every member announces itself
+under ``elastic/<job>/g<N>/member/<rank>``. Joining means announcing and
+waiting (bounded) for ``world_size`` announcements. Each member holds a
+heartbeat *lease* — ``elastic/<job>/g<N>/lease/<rank>`` stores an expiry
+timestamp renewed by ``heartbeat()`` — so liveness is observable by
+every peer, not just a central monitor.
+
+**Failure = generation bump, never a hang.** A lease expiry or an
+explicit ``leave()`` bumps the generation number; survivors observe the
+bump (``StaleGeneration``) or the expiry itself (``WorkerLost``) on
+their next bounded operation and ``reform()`` into the next generation
+instead of spinning. Every blocking path raises typed errors on a
+deadline (``RendezvousTimeout``) — nothing in this module waits
+unboundedly, and every wait runs on injectable clock/sleep so the
+failure paths are CI-deterministic with no real kills.
+
+**Fault points** (``paddle_tpu.fault``): ``elastic.join``,
+``elastic.heartbeat``, ``elastic.barrier``, ``elastic.reform`` — each
+stage retries transient failures through one ``fault.Retrier`` (typed
+``ElasticError``\\ s are never retried: they are verdicts, not flakes).
+
+Counters (paddle_tpu.profiler ELASTIC_COUNTER_NAMES, merged into
+``exe.counters``): ``elastic_generations`` — generations this process
+joined; ``worker_lost`` — peers declared lost; ``lease_expirations`` —
+leases observed expired; ``barrier_timeouts`` — bounded barriers that
+timed out; ``nan_guard_trips`` — non-finite loss observations
+(NanGuard); ``kv_poll_backoffs`` — KV polls slowed by backoff.
+
+Typical worker loop::
+
+    agent = ElasticAgent(endpoint, rank, world_size, job="job0")
+    agent.join(timeout=60)            # generation N membership
+    agent.start_heartbeat()           # lease renewal thread
+    for epoch in tr.get():
+        train(...)
+        agent.synchronize(f"epoch_{epoch}")   # barrier + auto-reform
+    agent.stop_heartbeat()
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..fault import injector as _fault
+from ..fault.injector import _bump
+from ..fault.retry import Backoff, Retrier, env_backoff, env_max_attempts
+from ..ps.heartbeat import HeartBeatMonitor
+from .http_kv import KVClient
+
+__all__ = [
+    "ElasticAgent", "ElasticError", "WorkerLost", "RendezvousTimeout",
+    "StaleGeneration", "NumericalDivergence", "NanGuard",
+]
+
+
+# ---------------------------------------------------------------------------
+# typed failures — every elastic blocking path exits through one of these
+# ---------------------------------------------------------------------------
+class ElasticError(RuntimeError):
+    """Base of the elastic-membership failure taxonomy. Terminal for the
+    operation that raised it (never retried by the agent's Retrier);
+    callers decide whether to ``reform()`` and continue."""
+
+
+class WorkerLost(ElasticError):
+    """A peer's heartbeat lease expired (or its send thread died): the
+    member set shrank. ``lost_ranks`` names the peers; the detector has
+    already bumped the generation, so every survivor's next check sees
+    StaleGeneration and re-rendezvous."""
+
+    def __init__(self, message: str, lost_ranks=()):
+        super().__init__(message)
+        self.lost_ranks = tuple(lost_ranks)
+
+
+class RendezvousTimeout(ElasticError, TimeoutError):
+    """A bounded join/barrier exhausted its deadline with members still
+    missing. Subclasses TimeoutError so pre-elastic callers catching
+    the KVClient barrier timeout keep working."""
+
+    def __init__(self, message: str, missing_ranks=()):
+        super().__init__(message)
+        self.missing_ranks = tuple(missing_ranks)
+
+
+class StaleGeneration(ElasticError):
+    """The job moved to a newer generation while this worker was acting
+    in an old one — re-rendezvous (``reform``/``join``) to continue."""
+
+    def __init__(self, message: str, expected: int = -1,
+                 observed: int = -1):
+        super().__init__(message)
+        self.expected = int(expected)
+        self.observed = int(observed)
+
+
+class NumericalDivergence(ElasticError):
+    """NanGuard verdict: N consecutive non-finite losses — the run has
+    diverged and further steps only burn accelerator time.
+    ``rolled_back_to`` carries the (epoch, batch) the guard's optional
+    rollback restored, or None."""
+
+    def __init__(self, message: str, consecutive: int = 0,
+                 rolled_back_to=None):
+        super().__init__(message)
+        self.consecutive = int(consecutive)
+        self.rolled_back_to = rolled_back_to
+
+
+# ---------------------------------------------------------------------------
+# NaN / divergence guard
+# ---------------------------------------------------------------------------
+class NanGuard:
+    """Divergence tripwire over fetched losses.
+
+    ``check(*values)`` bumps ``nan_guard_trips`` for every non-finite
+    observation and raises :class:`NumericalDivergence` after
+    ``max_consecutive`` non-finite steps IN A ROW (a single loss spike
+    that recovers resets the streak — transient fp16 overflow is the
+    loss-scaler's business, a *sustained* NaN plateau is a dead run).
+
+    ``rollback`` is an optional zero-arg callable invoked once on trip —
+    wire ``TrainEpochRange.rollback`` here to restore the last valid
+    snapshot before surfacing the typed error; its return value rides
+    the exception as ``rolled_back_to``.
+    """
+
+    def __init__(self, max_consecutive: int = 3,
+                 rollback: Optional[Callable[[], object]] = None):
+        if int(max_consecutive) < 1:
+            raise ValueError("max_consecutive must be >= 1")
+        self.max_consecutive = int(max_consecutive)
+        self._rollback = rollback
+        self._streak = 0
+
+    @property
+    def consecutive(self) -> int:
+        return self._streak
+
+    @staticmethod
+    def _finite(value) -> bool:
+        import numpy as np
+
+        try:
+            return bool(np.all(np.isfinite(np.asarray(value))))
+        except TypeError:
+            return True   # non-numeric fetch: not this guard's business
+
+    def check(self, *values) -> bool:
+        """True when every value is finite. Raises NumericalDivergence
+        on the ``max_consecutive``-th non-finite step in a row."""
+        if all(self._finite(v) for v in values):
+            self._streak = 0
+            return True
+        self._streak += 1
+        _bump("nan_guard_trips")
+        if self._streak >= self.max_consecutive:
+            streak, self._streak = self._streak, 0
+            rolled = None
+            if self._rollback is not None:
+                rolled = self._rollback()
+            raise NumericalDivergence(
+                f"loss was non-finite for {streak} consecutive steps — "
+                "the run has diverged"
+                + (f"; rolled back to {rolled}" if rolled is not None
+                   else ""),
+                consecutive=streak, rolled_back_to=rolled)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# the agent
+# ---------------------------------------------------------------------------
+class ElasticAgent:
+    """One worker's handle on the elastic membership protocol.
+
+    Parameters
+    ----------
+    endpoint : "host:port" of the coordination KVServer (ignored when a
+        prebuilt ``kv`` client is injected).
+    rank / world_size : this worker's identity in the job.
+    job : namespace under which this job's keys live (parallel jobs on
+        one KV server never collide).
+    lease_ttl : seconds a heartbeat lease stays valid; a peer whose
+        lease is older than this is declared lost.
+    poll : base seconds between membership polls (grows with capped
+        exponential backoff + jitter so N workers in a barrier don't
+        hammer the KV server; each slowed poll bumps
+        ``kv_poll_backoffs``).
+    clock / sleep : injectable time sources — every deadline, lease
+        stamp, and wait in the agent runs on these, so tests drive lease
+        expiry and timeouts with fake clocks and zero real sleeps.
+        ``clock`` must be comparable ACROSS workers (wall clock by
+        default; monotonic clocks are per-process and would make leases
+        nonsense between hosts).
+    on_worker_lost : optional callback ``fn(rank)`` fired for each peer
+        this agent declares lost — the ``Supervisor.notify_dead``
+        integration point, so a lapsed lease feeds the same relaunch
+        loop a dead process does.
+    monitor : a ``ps.heartbeat.HeartBeatMonitor`` to mirror lease
+        observations into (one is built on the agent's clock when not
+        given) — ``agent.monitor.alive(r)`` / ``leases()`` expose the
+        liveness view without extra KV traffic.
+    """
+
+    def __init__(self, endpoint: Optional[str], rank: int, world_size: int,
+                 job: str = "default", lease_ttl: float = 15.0,
+                 poll: float = 0.1, clock: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep,
+                 kv: Optional[KVClient] = None,
+                 on_worker_lost: Optional[Callable[[int], None]] = None,
+                 monitor: Optional[HeartBeatMonitor] = None):
+        if world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        if not 0 <= int(rank) < int(world_size):
+            raise ValueError(f"rank {rank} outside world of {world_size}")
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.job = str(job)
+        self.generation = -1
+        self._ttl = float(lease_ttl)
+        self._poll = float(poll)
+        self._clock = clock
+        self._sleep = sleep
+        self._kv = kv or KVClient(endpoint, sleep=sleep)
+        self._on_worker_lost = on_worker_lost
+        self.monitor = monitor or HeartBeatMonitor(
+            self.world_size, timeout_s=self._ttl, clock=clock)
+        # transient-failure policy for every stage; ElasticError is a
+        # verdict (peer lost, generation moved, deadline spent) — a
+        # retry would mask the very condition the watchdog exists to
+        # surface, so the whole taxonomy is giveup_on
+        self._retry = Retrier(
+            max_attempts=env_max_attempts(3),
+            backoff=env_backoff(0.05, 1.0), sleep=sleep,
+            giveup_on=(ElasticError,), name="elastic")
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_error: Optional[BaseException] = None
+
+    # -- key layout ---------------------------------------------------------
+    def _k(self, *parts) -> str:
+        return "/".join(("elastic", self.job) + tuple(map(str, parts)))
+
+    def _member_key(self, gen: int, rank: int) -> str:
+        return self._k(f"g{int(gen)}", "member", rank)
+
+    def _lease_key(self, gen: int, rank: int) -> str:
+        return self._k(f"g{int(gen)}", "lease", rank)
+
+    def _barrier_key(self, gen: int, tag: str, rank: int) -> str:
+        return self._k(f"g{int(gen)}", "barrier", tag, rank)
+
+    def _read_gen(self) -> Optional[int]:
+        raw = self._kv.get(self._k("gen"))
+        return int(raw) if raw is not None else None
+
+    # -- polling pacing -----------------------------------------------------
+    def _poll_backoff(self) -> Backoff:
+        return Backoff(base=self._poll, factor=1.5,
+                       cap=max(self._poll, 1.0), jitter=0.25)
+
+    def _poll_sleep(self, backoff: Backoff, attempt: int,
+                    deadline: float) -> None:
+        if attempt > 0:
+            _bump("kv_poll_backoffs")
+        delay = min(backoff.delay(attempt),
+                    max(0.0, deadline - self._clock()))
+        self._sleep(delay)
+
+    # -- join / rendezvous --------------------------------------------------
+    def join(self, timeout: float = 60.0) -> int:
+        """Rendezvous into the current generation: announce membership,
+        place a first lease, and wait (bounded) for ``world_size``
+        members. A generation bump observed mid-join restarts the
+        announcement under the new number instead of failing. Returns
+        the generation joined; RendezvousTimeout past ``timeout``."""
+        return self._retry.call(self._join_once, float(timeout))
+
+    def _join_once(self, timeout: float) -> int:
+        _fault.point("elastic.join")
+        deadline = self._clock() + timeout
+        gen = self._await_generation(deadline)
+        backoff, attempt = self._poll_backoff(), 0
+        self._announce(gen)
+        while True:
+            missing = [r for r in range(self.world_size)
+                       if self._kv.get(self._member_key(gen, r)) is None]
+            if not missing:
+                break
+            cur = self._read_gen()
+            if cur is not None and cur != gen:
+                # the job moved on while we waited (a reform raced our
+                # join) — chase the new generation, don't fail
+                gen = cur
+                self._announce(gen)
+                backoff, attempt = self._poll_backoff(), 0
+                continue
+            if self._clock() >= deadline:
+                raise RendezvousTimeout(
+                    f"elastic join (job {self.job!r}, generation {gen}) "
+                    f"timed out after {timeout}s with ranks {missing} "
+                    "missing", missing_ranks=missing)
+            self._poll_sleep(backoff, attempt, deadline)
+            attempt += 1
+        if gen != self.generation:
+            _bump("elastic_generations")
+        self.generation = gen
+        for r in range(self.world_size):
+            self.monitor.update(r)
+        return gen
+
+    def _await_generation(self, deadline: float) -> int:
+        """Current generation number; rank 0 initializes it to 0 on a
+        fresh job, other ranks wait (bounded) for the initialization."""
+        gen = self._read_gen()
+        if gen is not None:
+            return gen
+        if self.rank == 0:
+            self._kv.put(self._k("gen"), b"0")
+            return 0
+        backoff, attempt = self._poll_backoff(), 0
+        while True:
+            gen = self._read_gen()
+            if gen is not None:
+                return gen
+            if self._clock() >= deadline:
+                raise RendezvousTimeout(
+                    f"elastic join (job {self.job!r}): rank 0 never "
+                    "initialized the generation", missing_ranks=(0,))
+            self._poll_sleep(backoff, attempt, deadline)
+            attempt += 1
+
+    def _announce(self, gen: int) -> None:
+        self._kv.put(self._member_key(gen, self.rank), b"1")
+        self._put_lease(gen)
+
+    # -- leases / heartbeat -------------------------------------------------
+    def _put_lease(self, gen: int) -> None:
+        self._kv.put(self._lease_key(gen, self.rank),
+                     repr(self._clock() + self._ttl))
+
+    def heartbeat(self) -> None:
+        """Renew this worker's lease in the current generation."""
+        self._retry.call(self._heartbeat_once)
+
+    def _heartbeat_once(self) -> None:
+        _fault.point("elastic.heartbeat")
+        if self.generation < 0:
+            raise ElasticError("heartbeat before join(): no generation "
+                               "to hold a lease in")
+        self._put_lease(self.generation)
+        self.monitor.update(self.rank)
+
+    def start_heartbeat(self, interval: Optional[float] = None) -> None:
+        """Daemon thread renewing the lease every ``interval`` seconds
+        (default ttl/3). A failing heartbeat stops the thread and parks
+        the error on ``heartbeat_error`` — the main loop surfaces it at
+        its next barrier rather than dying on a background thread."""
+        if self._hb_thread is not None:
+            if self._hb_thread.is_alive():
+                return
+            # the previous thread died on a parked error: a new start is
+            # the recovery path, not a no-op (it clears the parked error
+            # and resumes lease renewal)
+            self._hb_thread = None
+        interval = float(interval) if interval else self._ttl / 3.0
+        self._hb_stop.clear()
+        self._hb_error = None
+
+        def _loop():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self.heartbeat()
+                except BaseException as e:   # noqa: B036 (parked, not lost)
+                    self._hb_error = e
+                    return
+
+        self._hb_thread = threading.Thread(
+            target=_loop, daemon=True, name=f"elastic-hb-{self.rank}")
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+
+    stop = stop_heartbeat   # symmetric with HeartBeatMonitor.stop
+
+    @property
+    def heartbeat_error(self) -> Optional[BaseException]:
+        return self._hb_error
+
+    # -- liveness checks ----------------------------------------------------
+    def peer_leases(self) -> Dict[int, Optional[float]]:
+        """rank -> lease expiry (this generation), None when unleased."""
+        out: Dict[int, Optional[float]] = {}
+        for r in range(self.world_size):
+            raw = self._kv.get(self._lease_key(self.generation, r))
+            out[r] = float(raw) if raw is not None else None
+        return out
+
+    def check_peers(self) -> None:
+        """Raise WorkerLost if any peer's lease has expired; refresh the
+        local monitor view for every fresh lease. A peer with NO lease
+        yet is still joining, not lost — only an expired stamp is a
+        verdict."""
+        now = self._clock()
+        lost: List[int] = []
+        for r, expiry in self.peer_leases().items():
+            if r == self.rank or expiry is None:
+                continue
+            if expiry < now:
+                lost.append(r)
+            else:
+                self.monitor.update(r)
+        if lost:
+            self._declare_lost(lost)
+
+    def _declare_lost(self, lost: List[int]) -> None:
+        """Record the loss, bump the generation (so every survivor's
+        next check re-rendezvous instead of hanging on the shrunken
+        member set), notify the relaunch hook, and raise typed."""
+        _bump("lease_expirations", len(lost))
+        _bump("worker_lost", len(lost))
+        cur = self._read_gen()
+        if cur is not None and cur == self.generation:
+            self._kv.put(self._k("gen"), str(cur + 1))
+        for r in lost:
+            if self._on_worker_lost is not None:
+                self._on_worker_lost(r)
+        raise WorkerLost(
+            f"worker(s) {lost} lost their lease (job {self.job!r}, "
+            f"generation {self.generation}); generation bumped for "
+            "re-rendezvous", lost_ranks=lost)
+
+    def assert_current(self) -> None:
+        """StaleGeneration if the job has moved past our generation."""
+        cur = self._read_gen()
+        if cur is not None and cur != self.generation:
+            raise StaleGeneration(
+                f"job {self.job!r} is at generation {cur}, this worker "
+                f"is still in {self.generation} — reform() to rejoin",
+                expected=self.generation, observed=cur)
+
+    # -- bounded generation-aware barrier ------------------------------------
+    def barrier(self, tag: str, timeout: float = 60.0) -> None:
+        """All-present-members rendezvous on ``tag`` within the current
+        generation. Bounded and watched: every poll also checks the
+        generation number (StaleGeneration) and peer leases
+        (WorkerLost) — a dead peer surfaces as a typed error within one
+        lease TTL, never as a silent hang. RendezvousTimeout past
+        ``timeout`` (counter ``barrier_timeouts``)."""
+        self._retry.call(self._barrier_once, str(tag), float(timeout))
+
+    def _barrier_once(self, tag: str, timeout: float) -> None:
+        _fault.point("elastic.barrier")
+        if self.generation < 0:
+            raise ElasticError(f"barrier({tag!r}) before join()")
+        if self._hb_error is not None:
+            err, self._hb_error = self._hb_error, None
+            raise ElasticError(
+                f"heartbeat thread died: {err!r} — lease renewal "
+                "stopped; reform() or restart the agent") from err
+        gen = self.generation
+        deadline = self._clock() + timeout
+        self._kv.put(self._barrier_key(gen, tag, self.rank), b"1")
+        backoff, attempt = self._poll_backoff(), 0
+        while True:
+            missing = [r for r in range(self.world_size)
+                       if self._kv.get(
+                           self._barrier_key(gen, tag, r)) is None]
+            if not missing:
+                return
+            self.assert_current()
+            self.check_peers()
+            if self._clock() >= deadline:
+                _bump("barrier_timeouts")
+                raise RendezvousTimeout(
+                    f"elastic barrier {tag!r} (generation {gen}) timed "
+                    f"out after {timeout}s with ranks {missing} missing",
+                    missing_ranks=missing)
+            self._poll_sleep(backoff, attempt, deadline)
+            attempt += 1
+
+    # -- reform / leave -----------------------------------------------------
+    def reform(self, timeout: float = 60.0) -> int:
+        """Move to the next generation and rendezvous there. Idempotent
+        with respect to who bumps: the lease-expiry detector already
+        advanced the number, so reform only bumps when the KV still
+        shows our old generation (an explicit voluntary reform)."""
+        return self._retry.call(self._reform_once, float(timeout))
+
+    def _reform_once(self, timeout: float) -> int:
+        _fault.point("elastic.reform")
+        cur = self._read_gen()
+        if cur is None or cur == self.generation:
+            self._kv.put(self._k("gen"),
+                         str((cur if cur is not None
+                              else max(self.generation, 0)) + 1))
+        return self._join_once(timeout)
+
+    def synchronize(self, tag: str, timeout: float = 60.0,
+                    max_reforms: int = 2) -> None:
+        """``barrier`` that survives membership churn: on WorkerLost /
+        StaleGeneration it reforms into the next generation and retries
+        the same tag (barrier keys are per-generation, so stale
+        announcements can never satisfy the retry), up to
+        ``max_reforms`` times. The convenience loop every epoch
+        boundary wants."""
+        for _ in range(int(max_reforms)):
+            try:
+                self.barrier(tag, timeout=timeout)
+                return
+            except (WorkerLost, StaleGeneration):
+                self.reform(timeout=timeout)
+        self.barrier(tag, timeout=timeout)
+
+    def leave(self) -> None:
+        """Explicit departure: drop this worker's membership and lease,
+        bump the generation so peers re-rendezvous promptly instead of
+        waiting a full lease TTL, and stop the heartbeat thread."""
+        self.stop_heartbeat()
+        if self.generation < 0:
+            return
+        self._kv.delete(self._member_key(self.generation, self.rank))
+        self._kv.delete(self._lease_key(self.generation, self.rank))
+        cur = self._read_gen()
+        if cur is not None and cur == self.generation:
+            self._kv.put(self._k("gen"), str(cur + 1))
+        self.generation = -1

@@ -185,6 +185,7 @@ class Fleet:
         self._strategy: Optional[DistributedStrategy] = None
         self._is_collective = True
         self._inited = False
+        self._elastic = None
 
     def init(self, role_maker=None, is_collective=False, strategy=None):
         self._role_maker = role_maker or PaddleCloudRoleMaker(
@@ -199,7 +200,50 @@ class Fleet:
         if n > 1 and os.environ.get("PADDLE_COORDINATOR"):
             init_distributed(os.environ["PADDLE_COORDINATOR"], n,
                              self._role_maker.worker_index())
+        # PADDLE_ELASTIC_ENDPOINT turns every multi-worker fleet job
+        # elastic at init: workers rendezvous into a numbered generation
+        # and hold heartbeat leases, so a preempted peer surfaces as a
+        # typed WorkerLost + generation bump instead of a hung barrier
+        if os.environ.get("PADDLE_ELASTIC_ENDPOINT") and n > 1:
+            self.elastic_init()
         return self
+
+    # -- elastic membership (distributed.elastic) ---------------------------
+    def elastic_init(self, endpoint=None, job=None, lease_ttl=None,
+                     timeout=60.0, agent=None, **kwargs):
+        """Join the elastic membership layer: rendezvous through the KV
+        server at ``endpoint`` (default $PADDLE_ELASTIC_ENDPOINT) into
+        the job's current generation and start the heartbeat-lease
+        thread. Returns the :class:`distributed.elastic.ElasticAgent`;
+        it is also available as ``fleet.elastic``. Pass a prebuilt
+        ``agent`` to control clocks/KV injection (tests)."""
+        if self._elastic is not None:
+            return self._elastic
+        if agent is None:
+            from .elastic import ElasticAgent
+
+            endpoint = endpoint or os.environ.get(
+                "PADDLE_ELASTIC_ENDPOINT")
+            if not endpoint:
+                raise ValueError(
+                    "fleet.elastic_init needs an endpoint (argument or "
+                    "PADDLE_ELASTIC_ENDPOINT)")
+            if lease_ttl is None:
+                lease_ttl = float(os.environ.get(
+                    "PADDLE_ELASTIC_LEASE_TTL", 15.0))
+            agent = ElasticAgent(
+                endpoint, self.worker_index(), self.worker_num(),
+                job=job or os.environ.get("PADDLE_JOB_ID", "default"),
+                lease_ttl=lease_ttl, **kwargs)
+        agent.join(timeout=timeout)
+        agent.start_heartbeat()
+        self._elastic = agent
+        return agent
+
+    @property
+    def elastic(self):
+        """The ElasticAgent joined by elastic_init, or None."""
+        return self._elastic
 
     # -- role queries --------------------------------------------------------
     def worker_num(self):

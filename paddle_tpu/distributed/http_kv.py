@@ -260,15 +260,27 @@ class KVClient:
             raise RuntimeError(f"KV DELETE {key!r} failed: HTTP {status}")
 
     def wait(self, key: str, timeout: float = 60.0,
-             poll: float = 0.1) -> bytes:
+             poll: float = 0.1, max_poll: float = 1.0) -> bytes:
         """Block until ``key`` exists; TimeoutError past ``timeout`` —
         the barrier form of the reference's unbounded wait loops.
 
         Each poll is a SINGLE request attempt (the poll loop *is* the
         retry — an inner 4-attempt Retrier per poll would let a dead
         server overshoot the deadline by minutes); a connection error
-        counts as "not there yet"."""
+        counts as "not there yet".
+
+        Polls pace out with capped exponential backoff + jitter: the
+        first retry waits ``poll`` seconds, later ones grow 1.5x up to
+        ``max_poll`` — N workers parked in a barrier stop hammering the
+        KV server at a fixed aggregate rate, and the jitter de-phases
+        them. Every slowed poll (the second onward) bumps the
+        ``kv_poll_backoffs`` counter."""
+        from ..fault.retry import Backoff
+
         deadline = time.monotonic() + timeout
+        backoff = Backoff(base=poll, factor=1.5,
+                          cap=max(poll, max_poll), jitter=0.25)
+        attempt = 0
         while True:
             try:
                 status, data = self._request_once("GET", key)
@@ -280,7 +292,11 @@ class KVClient:
                 raise TimeoutError(
                     f"KV barrier timed out after {timeout}s waiting "
                     f"for {key!r} at {self.host}:{self.port}")
-            self._sleep(min(poll, max(0.0, deadline - time.monotonic())))
+            if attempt > 0:
+                _bump_counter("kv_poll_backoffs")
+            self._sleep(min(backoff.delay(attempt),
+                            max(0.0, deadline - time.monotonic())))
+            attempt += 1
 
     def barrier(self, scope: str, rank: int, world_size: int,
                 timeout: float = 60.0, poll: float = 0.1) -> None:

@@ -119,6 +119,10 @@ class Supervisor:
         self._relaunch_listeners = []
         self._stop_requested = False
         self.restarts = 0
+        # per-rank restart attribution (stats()): one flapping rank vs.
+        # evenly-spread churn are different operational stories even
+        # when the shared budget reads the same
+        self.restarts_by_rank: dict = {}
         if start_fn is not None:
             self._start_fn = start_fn
         else:
@@ -210,6 +214,13 @@ class Supervisor:
             dead, self._external_dead = self._external_dead, set()
             return dead
 
+    def stats(self) -> dict:
+        """Operational snapshot: total restarts consumed, the budget,
+        and the per-rank attribution (which rank is flapping)."""
+        return {"restarts": self.restarts,
+                "max_restarts": self.max_restarts,
+                "restarts_by_rank": dict(self.restarts_by_rank)}
+
     @staticmethod
     def _await_death(p, timeout=10):
         waiter = getattr(p, "wait", None)
@@ -236,9 +247,12 @@ class Supervisor:
                 f"({self.max_restarts}) is spent; job stays down")
         delay = self._backoff.delay(self.restarts)
         self.restarts += 1
+        self.restarts_by_rank[rank] = self.restarts_by_rank.get(rank, 0) + 1
         profiler.bump_counter("trainer_relaunches")
         _fault.point("launch.relaunch")
-        pending[rank] = time.monotonic() + delay
+        # the injected clock paces the backoff deadline like _drain's:
+        # tests on fake clocks must never real-sleep through a relaunch
+        pending[rank] = self._clock() + delay
 
     def run(self) -> int:
         procs = {}
@@ -250,7 +264,7 @@ class Supervisor:
             while len(done) < self.nranks:
                 if self._stop_requested:
                     return self._drain(procs, done)
-                now = time.monotonic()
+                now = self._clock()
                 for rank in [r for r, t in pending.items() if now >= t]:
                     del pending[rank]
                     procs[rank] = self._start_rank(rank)

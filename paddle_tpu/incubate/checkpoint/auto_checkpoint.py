@@ -15,12 +15,34 @@ atomic sha256-manifest rename (the seed's separate state/meta
 keep-last-N rotation (``PADDLE_CKPT_KEEP``, default 3) and load-time
 verification that falls back to the newest *valid* snapshot.
 
-Usage (mirrors the reference):
+**Mid-epoch resume (bitwise).** ``save_every_steps=N`` commits a
+``step_<g>/`` snapshot every N training batches carrying the *data
+position* alongside the weights: epoch, batch offset, the global step
+count, the static ``Executor._step`` (its RNG key is
+``fold_in(seed_key, _step)`` — restoring it replays the exact dropout
+masks and gradient-merge microbatch keys), and the dygraph default
+generator's split chain. A supervised relaunch then resumes at the
+exact next batch instead of replaying the epoch from batch 0:
+``get()`` re-enters the interrupted epoch and ``steps(epoch, reader)``
+consumes the reader through the already-completed batches without
+yielding them (the reader's own RNG/data order advances identically)
+before handing out batch ``b+1``. The final loss of an interrupted +
+resumed run is bitwise identical to an uninterrupted one — the elastic
+chaos drill (tools/chaos_drill.py) asserts exactly that.
 
-    tr = TrainEpochRange(max_epochs, name="job0")
-    tr.register(model=model, optimizer=opt)
-    for epoch in tr.get():        # resumes after the last saved epoch
-        train_one_epoch(...)
+``rollback()`` restores the newest valid snapshot in place and returns
+the (epoch, batch) position — the ``distributed.elastic.NanGuard``
+hook: after N consecutive non-finite losses the guard rolls the run
+back to the last good weights before raising the typed
+``NumericalDivergence``.
+
+Usage (mirrors the reference, plus the step loop):
+
+    tr = TrainEpochRange(max_epochs, name="job0", save_every_steps=50)
+    tr.register(executor=exe, program=main_prog)   # or model=/optimizer=
+    for epoch in tr.get():         # resumes after the last saved epoch
+        for i, batch in tr.steps(epoch, make_reader(epoch)):
+            exe.run(compiled, feed=batch, fetch_list=[loss])
         # tr saves automatically at each epoch end (save_checkpoint_inter)
 """
 from __future__ import annotations
@@ -29,6 +51,8 @@ import os
 import pickle
 import time
 from typing import Optional
+
+import numpy as np
 
 from ...io.snapshot import SnapshotStore
 
@@ -51,21 +75,93 @@ def _default_keep():
         return 3
 
 
+def _state_finite(obj) -> bool:
+    """True when no float array anywhere in a (nested) state dict holds
+    a non-finite value — the rollback() filter that keeps a snapshot
+    committed mid-divergence from being restored as "good" weights."""
+    if isinstance(obj, dict):
+        return all(_state_finite(v) for v in obj.values())
+    if obj is None or isinstance(obj, (str, bytes, bool, int)):
+        return True
+    try:
+        arr = np.asarray(obj)
+    except Exception:
+        return True   # non-array leaf: not this filter's business
+    if arr.dtype.kind == "f":
+        return bool(np.all(np.isfinite(arr)))
+    return True
+
+
+def _set_gauge(name: str, value: int) -> None:
+    from ... import profiler
+
+    profiler.set_counter(name, int(value))
+
+
+def _capture_generator():
+    """Dygraph default-generator position: (seed, split-chain key data
+    or None). Typed jax keys serialize via key_data — a tiny uint32
+    array, host-copied so the snapshot never pins a device buffer."""
+    import jax
+
+    from ...framework import random as random_mod
+
+    g = random_mod.default_generator()
+    key = getattr(g, "_key", None)
+    return {"seed": int(g.initial_seed()),
+            "impl": random_mod.prng_impl(),
+            "key": None if key is None else
+            np.asarray(jax.random.key_data(key)).tolist()}
+
+
+def _restore_generator(state) -> None:
+    if not state:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework import random as random_mod
+
+    g = random_mod.default_generator()
+    g.manual_seed(int(state.get("seed", 0)))
+    key = state.get("key")
+    if key is not None:
+        g._key = jax.random.wrap_key_data(
+            jnp.asarray(np.asarray(key, dtype=np.uint32)),
+            impl=state.get("impl") or random_mod.prng_impl())
+
+
 class TrainEpochRange:
-    """Epoch iterator with automatic snapshot/resume (reference :265)."""
+    """Epoch iterator with automatic snapshot/resume (reference :265).
+
+    Beyond the reference: ``save_every_steps`` + ``steps()`` add
+    mid-epoch snapshots with data-position state so a relaunch resumes
+    at the exact next batch, bitwise (see module docstring);
+    ``register(executor=..., program=...)`` checkpoints a static-graph
+    job's persistable scope state the same way ``model=``/``optimizer=``
+    checkpoint a dygraph one."""
 
     def __init__(self, max_epoch_num: int, name: Optional[str] = None,
                  checkpoint_path: Optional[str] = None,
                  save_checkpoint_inter: Optional[int] = None,
                  checkpoint_inter: Optional[int] = None,
-                 keep_last: Optional[int] = None):
+                 keep_last: Optional[int] = None,
+                 save_every_steps: Optional[int] = None):
         self._max = int(max_epoch_num)
         self.name = name or os.environ.get(_JOB_ID_ENV, "default_job")
         self._root = checkpoint_path or _default_root()
         self._dir = os.path.join(self._root, self.name)
-        self._store = SnapshotStore(
-            self._dir,
-            keep_last=keep_last if keep_last is not None else _default_keep())
+        keep = keep_last if keep_last is not None else _default_keep()
+        self._store = SnapshotStore(self._dir, keep_last=keep)
+        # mid-epoch snapshots live under the same job dir with their own
+        # prefix + tag sequence (the monotonic global step): epoch_<e>
+        # tags stay equal to the epoch number — existing stores, tools,
+        # and tests keep reading them — while step_<g> tags order the
+        # intra-epoch commits; load picks whichever holds the most
+        # training progress
+        self._step_store = SnapshotStore(self._dir, keep_last=keep,
+                                         prefix="step_")
+        self._save_every = int(save_every_steps or 0)
         # seconds between saves; <=0 saves every epoch (tests use 0)
         self._inter = (save_checkpoint_inter
                        if save_checkpoint_inter is not None
@@ -75,38 +171,108 @@ class TrainEpochRange:
         self._last_save = 0.0
         self._model = None
         self._optimizer = None
+        self._executor = None
+        self._exe_program = None
+        self._exe_scope = None
         self._restored_epoch = -1
         self._restored_state = None
+        self._restored_meta: dict = {}
         self._restored_verified = False
+        # mid-epoch resume position: epoch to re-enter and the last
+        # batch index already completed in it (-1/-1 = none)
+        self._resume_epoch = -1
+        self._resume_batch = -1
+        self._global_step = 0
         self._load_meta()
 
     # -- registration --------------------------------------------------------
-    def register(self, model=None, optimizer=None):
+    def register(self, model=None, optimizer=None, executor=None,
+                 program=None, scope=None):
+        """Attach the objects whose state rides every snapshot: dygraph
+        ``model``/``optimizer`` (state_dict protocol) and/or a static
+        ``executor`` + ``program`` (+ optional ``scope``, default the
+        global scope) whose persistable vars and ``_step`` RNG position
+        are captured/restored. Restores any previously-committed
+        snapshot into them immediately."""
         self._model = model
         self._optimizer = optimizer
+        if executor is not None and program is None:
+            raise ValueError("register(executor=...) needs program= too "
+                             "(its persistable vars name the state)")
+        self._executor = executor
+        self._exe_program = program
+        self._exe_scope = scope
         self._maybe_restore_state()
         return self
 
+    def _scope(self):
+        if self._exe_scope is not None:
+            return self._exe_scope
+        from ...static.executor import global_scope
+
+        return global_scope()
+
     # -- persistence ---------------------------------------------------------
+    @staticmethod
+    def _progress(meta: dict):
+        """Orderable training position of a snapshot: the NEXT (epoch,
+        batch) to run. An epoch-complete snapshot of epoch e resumes at
+        (e+1, 0); a mid-epoch one at batch b resumes at (e, b+1)."""
+        epoch = int(meta.get("epoch", -1))
+        batch = meta.get("batch")
+        if batch is None:
+            return (epoch + 1, 0)
+        return (epoch, int(batch) + 1)
+
     def _load_meta(self):
-        """Pick the newest snapshot that verifies end-to-end; state and
-        meta come from the same commit, so they can never disagree about
-        which epoch completed. Verification streams (as_paths) — the
-        multi-GB state is never materialized just to check its sha."""
-        loaded = self._store.load_latest(as_paths=True)
-        if loaded is not None:
+        """Pick the snapshot holding the most training progress across
+        the epoch-end and mid-epoch stores, newest-valid-first in each
+        (state and meta come from the same commit, so they can never
+        disagree about the position). Verification streams (as_paths) —
+        the multi-GB state is never materialized just to check its
+        sha."""
+        best = None
+        for store in (self._store, self._step_store):
+            loaded = store.load_latest(as_paths=True)
+            if loaded is None:
+                continue
             _tag, files = loaded
             try:
                 with open(files[_META_FILE], "rb") as f:
                     meta = pickle.load(f)
-                self._restored_epoch = int(meta.get("epoch", -1))
-                self._restored_state = files.get(_STATE_FILE)
-                self._restored_verified = True
-                return
+                state_path = files.get(_STATE_FILE)
             except (KeyError, OSError, EOFError, pickle.UnpicklingError,
                     ValueError):
-                pass
-        self._load_legacy_meta()
+                continue
+            if best is None or self._progress(meta) > \
+                    self._progress(best[0]):
+                best = (meta, state_path)
+        if best is None:
+            self._load_legacy_meta()
+            return
+        meta, state_path = best
+        self._restored_state = state_path
+        self._restored_verified = True
+        self._set_position(meta)
+
+    def _set_position(self, meta: dict) -> None:
+        """Adopt a snapshot's training position as the resume point."""
+        self._restored_meta = dict(meta)
+        epoch = int(meta.get("epoch", -1))
+        batch = meta.get("batch")
+        self._global_step = int(meta.get("global_step", 0))
+        self._resume_epoch = -1
+        self._resume_batch = -1
+        if batch is None:
+            self._restored_epoch = epoch
+        else:
+            # epoch is mid-flight: completed epochs end at epoch-1, and
+            # get()/steps() re-enter it at batch+1
+            self._restored_epoch = epoch - 1
+            self._resume_epoch = epoch
+            self._resume_batch = int(batch)
+        _set_gauge("resume_batch_offset",
+                   0 if batch is None else int(batch) + 1)
 
     def _load_legacy_meta(self):
         """Pre-manifest flat layout (meta.pkl + state.pdparams directly in
@@ -129,7 +295,9 @@ class TrainEpochRange:
         # nothing checkpoint-sized stays pinned, and a second register()
         # — e.g. model first, optimizer later — re-reads and restores
         # again like the seed did
-        if self._restored_epoch < 0 or self._restored_state is None:
+        if self._restored_state is None or (
+                self._restored_epoch < 0 and self._resume_epoch < 0):
+            self._apply_position(self._restored_meta)
             return
         try:
             with open(self._restored_state, "rb") as f:
@@ -148,14 +316,37 @@ class TrainEpochRange:
                 f"auto-checkpoint state for job {self.name!r} under "
                 f"{self._dir!r} failed to load ({type(e).__name__}) "
                 f"{detail}") from e
+        self._apply_state(state)
+        self._apply_position(self._restored_meta)
+
+    def _apply_state(self, state: dict) -> None:
+        """Write a loaded state dict into every registered object."""
         if self._model is not None and state.get("model") is not None:
             self._model.set_state_dict(state["model"])
         if self._optimizer is not None and state.get("opt") is not None:
             set_state = getattr(self._optimizer, "set_state_dict", None)
             if set_state:
                 set_state(state["opt"])
+        if self._executor is not None and state.get("exe") is not None:
+            scope = self._scope()
+            write_back = getattr(scope, "_write_back", scope.set)
+            for n, arr in state["exe"].items():
+                write_back(n, np.asarray(arr))
 
-    def save_checkpoint(self, epoch: int):
+    def _apply_position(self, meta: dict) -> None:
+        """Re-aim the RNG machinery at the snapshot's position: the
+        static executor's step counter (its per-step key is
+        fold_in(seed, _step)) and the dygraph generator chain — the two
+        pieces that make a resumed step bitwise-equal to the one the
+        uninterrupted run would have taken."""
+        if not meta:
+            return
+        if self._executor is not None and meta.get("exe_step") is not None:
+            self._executor._step = int(meta["exe_step"])
+        if meta.get("generator") is not None:
+            _restore_generator(meta["generator"])
+
+    def _capture_state(self) -> dict:
         from ...io.serialization import _to_numpy_state
 
         state = {
@@ -164,9 +355,37 @@ class TrainEpochRange:
             "opt": (_to_numpy_state(self._optimizer.state_dict())
                     if self._optimizer is not None
                     and hasattr(self._optimizer, "state_dict") else None),
+            "exe": None,
         }
-        meta = {"epoch": int(epoch), "name": self.name}
-        self._store.save(epoch, {
+        if self._executor is not None and self._exe_program is not None:
+            scope = self._scope()
+            peek = getattr(scope, "_peek", scope.find_var)
+            block = self._exe_program.global_block
+            # host copies (np.asarray pulls device-resident jax.Arrays
+            # down) via _peek: reading for a snapshot must not mark the
+            # buffer exposed or every later donating step pays a copy
+            state["exe"] = {
+                n: np.asarray(peek(n))
+                for n, v in block.vars.items()
+                if v.persistable and peek(n) is not None}
+        return state
+
+    def _meta(self, epoch: int, batch: Optional[int]) -> dict:
+        return {
+            "epoch": int(epoch),
+            "name": self.name,
+            "batch": None if batch is None else int(batch),
+            "global_step": int(self._global_step),
+            "exe_step": (int(self._executor._step)
+                         if self._executor is not None else None),
+            "generator": _capture_generator(),
+        }
+
+    def _save(self, store: SnapshotStore, tag: int, epoch: int,
+              batch: Optional[int]) -> None:
+        state = self._capture_state()
+        meta = self._meta(epoch, batch)
+        store.save(tag, {
             # streaming writers: the state pickle goes straight to disk
             # (sha256'd in flight) instead of doubling peak memory as a
             # bytes blob next to the live parameters
@@ -175,19 +394,117 @@ class TrainEpochRange:
         })
         self._last_save = time.time()
 
+    def save_checkpoint(self, epoch: int):
+        """Epoch-end snapshot: epoch ``epoch`` is complete."""
+        self._save(self._store, int(epoch), epoch, None)
+
+    def save_step_checkpoint(self, epoch: int, batch: int):
+        """Mid-epoch snapshot: batches 0..``batch`` of ``epoch`` are
+        complete; a relaunch resumes at ``batch``+1. Tagged by the
+        monotonic global step so newer commits always win."""
+        self._save(self._step_store, int(self._global_step), epoch,
+                   int(batch))
+
+    def rollback(self):
+        """Restore the newest valid AND FINITE snapshot into every
+        registered object and return the position it holds as
+        ``(epoch, batch)`` (``batch`` None = epoch boundary). The
+        NanGuard hook: a diverged run rolls back to the last good
+        weights before the typed NumericalDivergence surfaces.
+
+        "Good" means more than sha-verified: a step snapshot committed
+        after the divergence began carries NaN-infected weights (the
+        guard only trips after N consecutive bad steps, and a
+        ``save_every_steps`` commit can land inside that window) —
+        restoring it would re-diverge immediately. Rollback therefore
+        walks snapshots best-progress-first and skips any whose state
+        contains non-finite floats."""
+        candidates = []
+        for store in (self._store, self._step_store):
+            for _tag, path, committed in store.snapshots():
+                if not committed:
+                    continue
+                files = store.verify(path, as_paths=True)
+                if not files:
+                    continue
+                try:
+                    with open(files[_META_FILE], "rb") as f:
+                        meta = pickle.load(f)
+                except (KeyError, OSError, EOFError,
+                        pickle.UnpicklingError, ValueError):
+                    continue
+                candidates.append(
+                    (self._progress(meta), meta, files.get(_STATE_FILE)))
+        for _prog, meta, state_path in sorted(
+                candidates, key=lambda c: c[0], reverse=True):
+            if state_path is None:
+                continue
+            try:
+                with open(state_path, "rb") as f:
+                    state = pickle.load(f)
+            except (OSError, EOFError, pickle.UnpicklingError):
+                continue
+            if not _state_finite(state):
+                continue   # committed mid-divergence: not a good state
+            self._apply_state(state)
+            self._restored_state = state_path
+            self._restored_verified = True
+            self._set_position(meta)
+            self._apply_position(meta)
+            if self._resume_epoch >= 0:
+                return (self._resume_epoch, self._resume_batch)
+            return (self._restored_epoch, None)
+        return None
+
     # -- iteration -----------------------------------------------------------
     @property
     def restored_epoch(self):
         return self._restored_epoch
 
+    @property
+    def restored_batch(self):
+        """Last completed batch of the epoch being resumed mid-flight,
+        or -1 when resuming at an epoch boundary."""
+        return self._resume_batch
+
+    @property
+    def global_step(self):
+        return self._global_step
+
     def get(self):
-        """Yield remaining epoch indices; snapshot after each one."""
-        start = self._restored_epoch + 1
+        """Yield remaining epoch indices; snapshot after each one. A
+        mid-epoch snapshot re-enters its interrupted epoch (steps()
+        then skips the completed batches)."""
+        start = (self._resume_epoch if self._resume_epoch >= 0
+                 else self._restored_epoch + 1)
         for epoch in range(start, self._max):
             yield epoch
             now = time.time()
             if self._inter <= 0 or now - self._last_save >= self._inter:
                 self.save_checkpoint(epoch)
+
+    def steps(self, epoch: int, reader):
+        """Iterate ``(batch_idx, batch)`` over ``reader`` (an iterable,
+        or a zero-arg callable returning one — recreate it per epoch so
+        its data order is a pure function of the epoch). On the resumed
+        epoch the already-completed batches are consumed WITHOUT being
+        yielded — the reader's position (and any RNG it advances)
+        replays identically, training just doesn't repeat them. Commits
+        a mid-epoch snapshot every ``save_every_steps`` yielded batches."""
+        it = iter(reader() if callable(reader) else reader)
+        skip_through = (self._resume_batch
+                        if int(epoch) == self._resume_epoch else -1)
+        for i, batch in enumerate(it):
+            if i <= skip_through:
+                continue
+            yield i, batch
+            self._global_step += 1
+            if self._save_every > 0 and (i + 1) % self._save_every == 0:
+                self.save_step_checkpoint(epoch, i)
+        if int(epoch) == self._resume_epoch:
+            # the interrupted epoch is done: later epochs start at 0
+            self._resume_epoch = -1
+            self._resume_batch = -1
 
 
 def train_epoch_range(max_epoch_num: int, save_checkpoint_inter=None,

@@ -120,6 +120,22 @@ _state = {
 #   supervisor_drains  launch.Supervisor graceful shutdowns started
 #   supervisor_drain_kills  children SIGKILLed after the drain window
 #
+# Elastic-membership counters (distributed/elastic.py ElasticAgent +
+# auto_checkpoint mid-epoch resume; ELASTIC_COUNTER_NAMES below):
+#   elastic_generations  generations this process rendezvoused into
+#                      (initial join + every reform)
+#   worker_lost        peers declared lost (lease expiry / dead send
+#                      thread) — typed WorkerLost raised each time
+#   lease_expirations  heartbeat leases observed expired
+#   barrier_timeouts   bounded elastic barriers that hit their deadline
+#                      (typed RendezvousTimeout)
+#   kv_poll_backoffs   KV polls slowed by the capped-exponential
+#                      backoff (KVClient.wait + ElasticAgent polling)
+#   nan_guard_trips    non-finite loss observations (NanGuard; typed
+#                      NumericalDivergence after N consecutive)
+#   resume_batch_offset  GAUGE: the batch offset the last mid-epoch
+#                      resume restarted at (0 = epoch boundary)
+#
 #   retry_attempts     re-attempts after a retryable failure (Retrier)
 #   retry_giveups      retry budget/deadline exhausted, last error raised
 #   faults_injected    armed fault points fired (tests / PADDLE_FAULT_SPEC)
@@ -137,6 +153,15 @@ FAULT_COUNTER_NAMES = (
     "retry_attempts", "retry_giveups", "faults_injected",
     "ckpt_commits", "ckpt_corrupt_skipped", "ckpt_fallbacks",
     "trainer_relaunches",
+)
+
+# elastic-membership + mid-epoch-resume counters (distributed/elastic
+# ElasticAgent, http_kv poll backoff, auto_checkpoint resume), merged
+# into Executor.counters like the fault slice
+ELASTIC_COUNTER_NAMES = (
+    "elastic_generations", "worker_lost", "lease_expirations",
+    "barrier_timeouts", "kv_poll_backoffs", "nan_guard_trips",
+    "resume_batch_offset",
 )
 
 # process-level compile-cache counters merged into Executor.counters

@@ -29,10 +29,22 @@ def _merge_dups(ids, grads):
 class AsyncCommunicator:
     """Queue + background send thread (communicator.h:253). Trainer calls
     push_sparse_grad and keeps going; the send thread batches
-    send_queue_size entries, merges duplicates, and pushes."""
+    send_queue_size entries, merges duplicates, and pushes.
+
+    Bounded drain: ``flush`` used to be an unbounded ``Queue.join()`` —
+    a pserver death killed the send thread and wedged the trainer in
+    flush forever. Now the send thread parks its error instead of dying
+    silently, and ``flush(timeout)`` polls a pending counter on an
+    injectable clock, raising typed ``distributed.elastic.WorkerLost``
+    when the sender is dead (or its parked error re-raised as the
+    cause) and ``TimeoutError`` when it is merely too slow."""
 
     def __init__(self, client: PSClient, dim: int, table_id: int = 0,
-                 lr: float = 0.01, send_queue_size: int = 16):
+                 lr: float = 0.01, send_queue_size: int = 16,
+                 flush_timeout: float = 60.0,
+                 clock=None, sleep=None):
+        import time
+
         self._client = client
         self._dim = dim
         self._table = table_id
@@ -40,6 +52,12 @@ class AsyncCommunicator:
         self._q: queue.Queue = queue.Queue(maxsize=max(send_queue_size, 1))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._flush_timeout = float(flush_timeout)
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -47,9 +65,25 @@ class AsyncCommunicator:
         return self
 
     def push_sparse_grad(self, ids, grads, lr: Optional[float] = None):
-        self._q.put((np.asarray(ids, np.int64).ravel(),
-                     np.asarray(grads, np.float32),
-                     self._lr if lr is None else lr))
+        item = (np.asarray(ids, np.int64).ravel(),
+                np.asarray(grads, np.float32),
+                self._lr if lr is None else lr)
+        with self._pending_lock:
+            self._pending += 1
+        # the bounded queue must not become an unbounded wait: with the
+        # send thread dead nothing ever drains it, so a blocking put()
+        # would wedge the trainer in the push hot path before it even
+        # reaches flush()'s typed error
+        while True:
+            if self._sender_failed():
+                with self._pending_lock:
+                    self._pending -= 1
+                self._raise_worker_lost("push_sparse_grad")
+            try:
+                self._q.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
 
     def _loop(self):
         while not self._stop.is_set() or not self._q.empty():
@@ -57,12 +91,66 @@ class AsyncCommunicator:
                 ids, grads, lr = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
-            ids, grads = _merge_dups(ids, grads.reshape(ids.size, self._dim))
-            self._client.push(self._table, ids, grads, self._dim, lr)
+            try:
+                ids, grads = _merge_dups(
+                    ids, grads.reshape(ids.size, self._dim))
+                self._client.push(self._table, ids, grads, self._dim, lr)
+            except BaseException as e:   # noqa: B036 (parked for flush)
+                # the failed batch stays PENDING: flush must report the
+                # loss (WorkerLost), not count the batch as delivered
+                self._error = e
+                self._q.task_done()
+                return
+            with self._pending_lock:
+                self._pending -= 1
             self._q.task_done()
 
-    def flush(self):
-        self._q.join()
+    def _sender_dead(self) -> bool:
+        return (self._error is not None
+                or self._thread is None
+                or not self._thread.is_alive())
+
+    def _sender_failed(self) -> bool:
+        """Dead-after-start only: queueing before start() stays legal
+        (the reference lets trainers push before the communicator runs),
+        so a None thread is not a failure here — unlike flush(), where
+        waiting on a never-started sender would hang forever."""
+        return (self._error is not None
+                or (self._thread is not None
+                    and not self._thread.is_alive()))
+
+    def _raise_worker_lost(self, op: str):
+        from ..distributed.elastic import WorkerLost
+        from ..fault.injector import _bump
+
+        with self._pending_lock:
+            pending = self._pending
+        _bump("worker_lost")
+        raise WorkerLost(
+            f"communicator send thread is dead ({op}) with {pending} "
+            "unsent gradient batches"
+            + (f" (cause: {self._error!r})" if self._error
+               else "")) from self._error
+
+    def flush(self, timeout: Optional[float] = None):
+        """Block until every pushed gradient reached the pserver, the
+        sender died (WorkerLost), or ``timeout`` seconds passed
+        (TimeoutError). Never hangs on a dead peer."""
+        deadline = self._clock() + (self._flush_timeout
+                                    if timeout is None else float(timeout))
+        while True:
+            with self._pending_lock:
+                pending = self._pending
+            if pending <= 0:
+                return
+            if self._sender_dead():
+                self._raise_worker_lost("flush")
+            if self._clock() >= deadline:
+                raise TimeoutError(
+                    f"communicator flush timed out with {pending} "
+                    "gradient batches still unsent — pserver too slow "
+                    "or unreachable")
+            self._sleep(0.01)
 
     def stop(self):
         self._stop.set()

@@ -22,10 +22,16 @@ class HeartBeatMonitor:
 
     def __init__(self, num_trainers: int, timeout_s: float = 120.0,
                  check_interval_s: float = 1.0,
-                 on_dead: Optional[Callable[[int], None]] = None):
+                 on_dead: Optional[Callable[[int], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self._timeout = float(timeout_s)
         self._interval = float(check_interval_s)
         self._on_dead = on_dead
+        # injectable clock: every timestamp and expiry comparison runs
+        # on it, so tests (and distributed.elastic, which mirrors KV
+        # lease observations here) drive expiry with a fake clock and
+        # check_now() — no real sleeps, no monitor thread needed
+        self._clock = clock
         self._lock = threading.Lock()
         self._beats: Dict[int, float] = {}
         self._status: Dict[int, int] = {}
@@ -43,7 +49,7 @@ class HeartBeatMonitor:
     # -- updates ------------------------------------------------------------
     def update(self, trainer_id: int, status: int = RUNNING):
         with self._lock:
-            self._beats[trainer_id] = time.monotonic()
+            self._beats[trainer_id] = self._clock()
             self._status[trainer_id] = status
             self._dead.discard(trainer_id)
             self._last_fired.pop(trainer_id, None)
@@ -76,12 +82,21 @@ class HeartBeatMonitor:
                 return True
             t = self._beats.get(trainer_id)
             return t is not None and \
-                time.monotonic() - t <= self._timeout and \
+                self._clock() - t <= self._timeout and \
                 trainer_id not in self._dead
 
     def dead_trainers(self) -> List[int]:
         with self._lock:
             return sorted(self._dead)
+
+    def leases(self) -> Dict[int, float]:
+        """Liveness view as lease expiries: trainer -> the clock value
+        past which it counts as dead (last beat + timeout). The shape
+        distributed.elastic's KV leases use, so the agent's monitor and
+        a pserver-side monitor read identically."""
+        with self._lock:
+            return {tid: t + self._timeout
+                    for tid, t in self._beats.items()}
 
     def completed_trainers(self) -> List[int]:
         with self._lock:
@@ -97,28 +112,40 @@ class HeartBeatMonitor:
     def start(self):
         if self._thread is not None:
             return
+        # a restarted monitor must actually sweep: stop() left the event
+        # set, and _loop's first wait() would exit the thread immediately
+        self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _loop(self):
         while not self._stop.wait(self._interval):
-            now = time.monotonic()
-            newly_dead = []
-            with self._lock:
-                for tid, t in self._beats.items():
-                    if self._status.get(tid) == COMPLETED:
-                        continue
-                    flagged = tid in self._dead
-                    if flagged and not self._refire:
-                        continue   # one-shot contract for plain users
-                    since = max(t, self._last_fired.get(tid, t))
-                    if now - since > self._timeout:
-                        self._dead.add(tid)
-                        self._last_fired[tid] = now
-                        newly_dead.append(tid)
-            for tid in newly_dead:
-                if self._on_dead is not None:
-                    self._on_dead(tid)
+            self.check_now()
+
+    def check_now(self) -> List[int]:
+        """One expiry sweep (the _loop body, callable without the
+        thread): flag trainers whose last beat is older than the
+        timeout and fire on_dead for each. Returns the newly-flagged
+        ids. Tests and injectable-clock users drive this directly —
+        advance the clock, call check_now(), observe the policy."""
+        now = self._clock()
+        newly_dead = []
+        with self._lock:
+            for tid, t in self._beats.items():
+                if self._status.get(tid) == COMPLETED:
+                    continue
+                flagged = tid in self._dead
+                if flagged and not self._refire:
+                    continue   # one-shot contract for plain users
+                since = max(t, self._last_fired.get(tid, t))
+                if now - since > self._timeout:
+                    self._dead.add(tid)
+                    self._last_fired[tid] = now
+                    newly_dead.append(tid)
+        for tid in newly_dead:
+            if self._on_dead is not None:
+                self._on_dead(tid)
+        return newly_dead
 
     def stop(self):
         self._stop.set()

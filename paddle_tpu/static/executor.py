@@ -335,7 +335,8 @@ class Executor:
         out = dict(self._counters)
         snap = profiler.counters_snapshot()
         for name in (profiler.FAULT_COUNTER_NAMES
-                     + profiler.COMPILE_COUNTER_NAMES):
+                     + profiler.COMPILE_COUNTER_NAMES
+                     + profiler.ELASTIC_COUNTER_NAMES):
             if name in snap:
                 out[name] = snap[name]
         return out

@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""Deterministic elastic-training chaos drill (ISSUE 7 crown test).
+
+Promotes the PR 2 chaos recipe (arm a ``PADDLE_FAULT_SPEC``, supervise,
+resume) to a tool that drives the WHOLE elastic story end to end with
+real processes and real kills:
+
+1. a KVServer comes up in-process; ``nranks`` trainer workers launch
+   under ``launch.Supervisor`` relaunch supervision;
+2. every worker rendezvous through ``distributed.elastic.ElasticAgent``
+   into generation 0, holds a heartbeat lease, trains the same
+   deterministic toy job with ``TrainEpochRange`` mid-epoch
+   checkpointing, and barriers each epoch end;
+3. ``PADDLE_FAULT_SPEC=drill.step:1@K:SystemExit`` kills ``kill_rank``
+   mid-epoch at its (K+1)-th batch (the env spec re-arms per process;
+   ``@after`` is what lets the relaunched incarnation run past it);
+4. survivors observe the lease expiry as a typed ``WorkerLost``, bump
+   the generation, and reform; the supervisor relaunches the dead rank,
+   which resumes AT THE EXACT NEXT BATCH from its mid-epoch snapshot
+   and rejoins the bumped generation;
+5. the drill asserts the killed rank's final loss is **bitwise
+   identical** to the never-killed rank 0's (both run the same
+   deterministic schedule, so rank 0 *is* the uninterrupted run), that
+   a generation bump really happened, and that exactly the expected
+   relaunches were spent — then prints the counter table.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py [--workdir DIR]
+        [--epochs 3] [--batches 4] [--kill-after 6] [--lease-ttl 3]
+
+Exit code 0 = drill passed (bitwise parity + generation bump); the
+counter table goes to stdout either way. ``--no-kill`` runs the same
+job without the fault spec (a clean baseline of the harness itself).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# ---------------------------------------------------------------------------
+# worker (runs in the supervised subprocesses)
+# ---------------------------------------------------------------------------
+
+def worker_main() -> int:
+    import numpy as np
+
+    import paddle_tpu.static as static
+    from paddle_tpu import fault, profiler
+    from paddle_tpu.distributed.elastic import ElasticAgent
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+        TrainEpochRange,
+    )
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    endpoint = os.environ["PADDLE_ELASTIC_ENDPOINT"]
+    epochs = int(os.environ["DRILL_EPOCHS"])
+    batches = int(os.environ["DRILL_BATCHES"])
+    save_every = int(os.environ["DRILL_SAVE_EVERY"])
+    kill_rank = int(os.environ.get("DRILL_KILL_RANK", "-1"))
+    lease_ttl = float(os.environ.get("DRILL_LEASE_TTL", "3.0"))
+    log_path = os.environ["DRILL_LOG"]
+    h, b = 8, 8
+
+    def log(kind, **fields):
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"kind": kind, "rank": rank, **fields})
+                    + "\n")
+
+    main, startup = static.Program(), static.Program()
+    main.random_seed = startup.random_seed = 1234
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, h])
+        label = static.data("label", [-1, 1], dtype="int64")
+        hid = static.nn.fc(x, 16, act="relu")
+        hid = static.dropout(hid, dropout_prob=0.2)
+        logits = static.nn.fc(hid, 4)
+        loss = static.mean(static.softmax_with_cross_entropy(logits, label))
+        static.SGD(0.05).minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    cp = static.CompiledProgram(main)
+    tr = TrainEpochRange(epochs, name=f"drill_r{rank}",
+                         save_every_steps=save_every)
+    tr.register(executor=exe, program=main)
+    log("start", restored_epoch=tr.restored_epoch,
+        restored_batch=tr.restored_batch, exe_step=exe._step)
+
+    agent = ElasticAgent(endpoint, rank, world, job="drill",
+                         lease_ttl=lease_ttl)
+    agent.join(timeout=240.0)
+    agent.start_heartbeat()
+
+    def reader(epoch):
+        def gen():
+            for i in range(batches):
+                rng = np.random.RandomState(epoch * 100 + i)
+                yield {"x": rng.randn(b, h).astype(np.float32),
+                       "label": rng.randint(0, 4, (b, 1)).astype(np.int64)}
+        return gen
+
+    last = None
+    for epoch in tr.get():
+        for i, batch in tr.steps(epoch, reader(epoch)):
+            if rank == kill_rank:
+                # the armed PADDLE_FAULT_SPEC decides which visit dies
+                fault.point("drill.step")
+            out = exe.run(cp, feed=batch, fetch_list=[loss])
+            last = np.ravel(out[0]).astype(np.float32)
+            log("batch", epoch=epoch, batch=i, step=exe._step - 1,
+                loss=float(last[0]))
+        agent.synchronize(f"epoch{epoch}", timeout=240.0, max_reforms=3)
+    agent.stop_heartbeat()
+
+    counters = {k: v for k, v in profiler.counters_snapshot().items()
+                if k in profiler.ELASTIC_COUNTER_NAMES
+                or k in profiler.FAULT_COUNTER_NAMES}
+    log("final", loss=float(last[0]), loss_hex=last.tobytes().hex(),
+        generation=agent.generation, counters=counters)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the drill (parent process)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _read_log(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def run_drill(workdir: str, nranks: int = 2, epochs: int = 3,
+              batches: int = 4, save_every: int = 2, kill_rank: int = 1,
+              kill_after: int = 6, max_restarts: int = 2,
+              lease_ttl: float = 3.0, kill: bool = True) -> dict:
+    """Run the drill; returns a report dict (see keys in `main`).
+
+    ``kill_after=K`` kills ``kill_rank`` at its (K+1)-th training batch
+    — pick K so the death lands mid-epoch and the relaunched
+    incarnation has fewer than K batches left (the re-armed env spec
+    then never re-fires, per the ``@after`` skip count).
+    """
+    from paddle_tpu.distributed.http_kv import KVServer
+    from paddle_tpu.distributed.launch import Supervisor
+    from paddle_tpu.fault.retry import Backoff
+
+    os.makedirs(workdir, exist_ok=True)
+    port = _free_port()
+    srv = KVServer(port)
+    srv.start()
+
+    logs = {r: os.path.join(workdir, f"rank{r}.jsonl")
+            for r in range(nranks)}
+    for p in logs.values():
+        if os.path.exists(p):
+            os.remove(p)
+
+    def env_for(rank):
+        env = dict(os.environ)
+        env.update({
+            "PYTHONPATH": _REPO,
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nranks),
+            "PADDLE_ELASTIC_ENDPOINT": f"127.0.0.1:{port}",
+            "PADDLE_AUTO_CHECKPOINT_PATH": os.path.join(workdir, "ckpt"),
+            "DRILL_EPOCHS": str(epochs),
+            "DRILL_BATCHES": str(batches),
+            "DRILL_SAVE_EVERY": str(save_every),
+            "DRILL_KILL_RANK": str(kill_rank if kill else -1),
+            "DRILL_LEASE_TTL": repr(lease_ttl),
+            "DRILL_LOG": logs[rank],
+        })
+        if kill:
+            env["PADDLE_FAULT_SPEC"] = (
+                f"drill.step:1@{kill_after}:SystemExit")
+        else:
+            env.pop("PADDLE_FAULT_SPEC", None)
+        return env
+
+    def start_fn(rank):
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env_for(rank))
+
+    sup = Supervisor(nranks, start_fn=start_fn,
+                     max_restarts=max_restarts,
+                     backoff=Backoff(base=0.5, factor=2.0, jitter=0),
+                     poll_interval=0.2)
+    from paddle_tpu.distributed.launch import RestartBudgetExceeded
+
+    t0 = time.monotonic()
+    try:
+        rc = sup.run()
+    except RestartBudgetExceeded as e:
+        # deaths outran the budget: still report (the counter table is
+        # the point of a failed drill), just never as "ok"
+        print(f"chaos drill: {e}", file=sys.stderr)
+        rc = -1
+    finally:
+        srv.stop()
+    wall = time.monotonic() - t0
+
+    rows = {r: _read_log(p) for r, p in logs.items()}
+    finals = {r: [row for row in rs if row["kind"] == "final"]
+              for r, rs in rows.items()}
+    starts = {r: [row for row in rs if row["kind"] == "start"]
+              for r, rs in rows.items()}
+    report = {
+        "rc": rc,
+        "wall_s": round(wall, 1),
+        "supervisor": sup.stats(),
+        "loss_hex": {r: (f[-1]["loss_hex"] if f else None)
+                     for r, f in finals.items()},
+        "loss": {r: (f[-1]["loss"] if f else None)
+                 for r, f in finals.items()},
+        "generation": {r: (f[-1]["generation"] if f else None)
+                       for r, f in finals.items()},
+        "counters": {r: (f[-1]["counters"] if f else {})
+                     for r, f in finals.items()},
+        "resume": {r: [{k: s[k] for k in
+                        ("restored_epoch", "restored_batch", "exe_step")}
+                       for s in starts[r]] for r in rows},
+        "batches_trained": {r: sum(1 for row in rs
+                                   if row["kind"] == "batch")
+                            for r, rs in rows.items()},
+    }
+    hexes = [h for h in report["loss_hex"].values() if h]
+    report["parity_bitwise"] = (len(hexes) == nranks
+                                and len(set(hexes)) == 1)
+    report["generation_bumped"] = any(
+        (g or 0) > 0 for g in report["generation"].values())
+    survivor = next((r for r in range(nranks) if r != kill_rank), 0)
+    report["ok"] = bool(
+        rc == 0 and report["parity_bitwise"]
+        and (not kill or (report["generation_bumped"]
+                          and sup.stats()["restarts_by_rank"]
+                          .get(kill_rank, 0) >= 1
+                          and report["counters"][survivor]
+                          .get("worker_lost", 0) >= 1)))
+    return report
+
+
+def _print_table(report: dict) -> None:
+    print(f"\nchaos drill: rc={report['rc']} wall={report['wall_s']}s "
+          f"supervisor={report['supervisor']}")
+    print(f"{'rank':>4} {'final loss':>12} {'loss hex':>10} "
+          f"{'gen':>4} {'batches':>8}  resume")
+    for r in sorted(report["loss"]):
+        print(f"{r:>4} {report['loss'][r]!r:>12} "
+              f"{report['loss_hex'][r] or '-':>10} "
+              f"{report['generation'][r] if report['generation'][r] is not None else '-':>4} "
+              f"{report['batches_trained'][r]:>8}  {report['resume'][r]}")
+    names = sorted({k for c in report["counters"].values() for k in c})
+    if names:
+        print(f"\n{'counter':<24}" + "".join(
+            f"rank{r:>2} " for r in sorted(report["counters"])))
+        for n in names:
+            print(f"{n:<24}" + "".join(
+                f"{report['counters'][r].get(n, 0):>6} "
+                for r in sorted(report["counters"])))
+    print(f"\nparity_bitwise={report['parity_bitwise']} "
+          f"generation_bumped={report['generation_bumped']} "
+          f"ok={report['ok']}")
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "--worker":
+        return worker_main()
+    ap = argparse.ArgumentParser(
+        description="deterministic elastic kill/resume chaos drill")
+    ap.add_argument("--workdir", default="/tmp/paddle_tpu_chaos_drill")
+    ap.add_argument("--nranks", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--kill-rank", type=int, default=1)
+    ap.add_argument("--kill-after", type=int, default=6)
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--lease-ttl", type=float, default=3.0)
+    ap.add_argument("--no-kill", action="store_true",
+                    help="clean baseline: same job, no fault spec")
+    args = ap.parse_args(argv)
+    report = run_drill(args.workdir, nranks=args.nranks,
+                       epochs=args.epochs, batches=args.batches,
+                       save_every=args.save_every,
+                       kill_rank=args.kill_rank,
+                       kill_after=args.kill_after,
+                       max_restarts=args.max_restarts,
+                       lease_ttl=args.lease_ttl, kill=not args.no_kill)
+    _print_table(report)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ MODULES = [
     "paddle_tpu.amp",
     "paddle_tpu.autograd",
     "paddle_tpu.distributed",
+    "paddle_tpu.distributed.elastic",
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.fault",
     "paddle_tpu.hapi",
