@@ -260,9 +260,21 @@ class KVClient:
             raise RuntimeError(f"KV DELETE {key!r} failed: HTTP {status}")
 
     def wait(self, key: str, timeout: float = 60.0,
-             poll: float = 0.1, max_poll: float = 1.0) -> bytes:
+             poll: float = 0.1, max_poll: float = 1.0,
+             clock=time.monotonic) -> bytes:
         """Block until ``key`` exists; TimeoutError past ``timeout`` —
         the barrier form of the reference's unbounded wait loops.
+        ``wait_until`` with no predicate."""
+        return self.wait_until(key, timeout=timeout, poll=poll,
+                               max_poll=max_poll, clock=clock)
+
+    def wait_until(self, key: str, predicate=None, timeout: float = 60.0,
+                   poll: float = 0.1, max_poll: float = 1.0,
+                   clock=time.monotonic, sleep=None) -> bytes:
+        """Block until ``key`` exists AND ``predicate(value)`` is true
+        (predicate=None just waits for existence); TimeoutError past
+        ``timeout``. The shard-map/epoch watchers build on this: e.g.
+        ``wait_until("ps/job/epoch", lambda v: int(v) >= 2)``.
 
         Each poll is a SINGLE request attempt (the poll loop *is* the
         retry — an inner 4-attempt Retrier per poll would let a dead
@@ -274,28 +286,32 @@ class KVClient:
         ``max_poll`` — N workers parked in a barrier stop hammering the
         KV server at a fixed aggregate rate, and the jitter de-phases
         them. Every slowed poll (the second onward) bumps the
-        ``kv_poll_backoffs`` counter."""
+        ``kv_poll_backoffs`` counter. ``clock``/``sleep`` are injectable
+        so tests drive the deadline without real sleeps (``sleep``
+        defaults to the one passed at construction)."""
         from ..fault.retry import Backoff
 
-        deadline = time.monotonic() + timeout
+        sleep = sleep or self._sleep
+        deadline = clock() + timeout
         backoff = Backoff(base=poll, factor=1.5,
                           cap=max(poll, max_poll), jitter=0.25)
         attempt = 0
         while True:
             try:
                 status, data = self._request_once("GET", key)
-                if status == 200:
+                if status == 200 and (predicate is None
+                                      or predicate(data)):
                     return data
             except self._transient:
                 pass  # server not up yet / transient: poll again
-            if time.monotonic() >= deadline:
+            if clock() >= deadline:
                 raise TimeoutError(
                     f"KV barrier timed out after {timeout}s waiting "
                     f"for {key!r} at {self.host}:{self.port}")
             if attempt > 0:
                 _bump_counter("kv_poll_backoffs")
-            self._sleep(min(backoff.delay(attempt),
-                            max(0.0, deadline - time.monotonic())))
+            sleep(min(backoff.delay(attempt),
+                      max(0.0, deadline - clock())))
             attempt += 1
 
     def barrier(self, scope: str, rank: int, world_size: int,

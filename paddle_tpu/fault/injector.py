@@ -26,6 +26,14 @@ Points in use (grep for ``point(`` to enumerate):
     serve.dispatch    before each compiled serving dispatch (retried)
     serve.respond     before each per-request result delivery
     serve.fallback    before each degraded batch-1 eager fallback
+    ps.pull           before each PSClient pull/rows/keys RPC attempt
+    ps.push           before each PSClient push/merge/assign RPC attempt
+    ps.barrier        before each PSClient barrier RPC (single attempt)
+    ps.save           before each PSClient save/snapshot RPC attempt
+    ps.heartbeat      before each PSClient trainer heartbeat
+    ps.apply          server-side, before a pserver applies a write —
+                      the kill-a-primary chaos-drill point
+                      (PADDLE_FAULT_SPEC="ps.apply:1@K:SystemExit")
 
 ``PADDLE_FAULT_SPEC`` grammar — comma-separated triggers::
 

@@ -136,6 +136,24 @@ _state = {
 #   resume_batch_offset  GAUGE: the batch offset the last mid-epoch
 #                      resume restarted at (0 = epoch boundary)
 #
+# Parameter-server fault-tolerance counters (ps/replication.py +
+# ps/service.py; PS_COUNTER_NAMES below, merged into Executor.counters
+# like the fault/elastic/serve slices):
+#   ps_failovers       client failovers: primary unreachable past the
+#                      retry budget, shard map refreshed, request
+#                      REPLAYED against the promoted backup
+#   ps_promotions      backups promoted to primary by the
+#                      ReplicaCoordinator after a lease expiry (each one
+#                      is a shard-map epoch bump)
+#   ps_rpc_retries     PS RPC re-attempts after a transient socket
+#                      failure (subset of retry_attempts, PS-scoped)
+#   ps_snapshot_commits  crash-safe pserver table snapshots committed
+#                      through SnapshotStore (shard_<k>/seq_<n>/)
+#   ps_replication_lag GAUGE: frames accepted by the primary but not yet
+#                      replicated (async mode queue depth; 0 in sync)
+#   ps_conn_timeouts   pserver connections closed on the per-connection
+#                      idle timeout (mirrors kv_conn_timeouts)
+#
 #   retry_attempts     re-attempts after a retryable failure (Retrier)
 #   retry_giveups      retry budget/deadline exhausted, last error raised
 #   faults_injected    armed fault points fired (tests / PADDLE_FAULT_SPEC)
@@ -167,6 +185,14 @@ ELASTIC_COUNTER_NAMES = (
 # process-level compile-cache counters merged into Executor.counters
 # (bumped by the jax monitoring listener in static/compile_cache.py)
 COMPILE_COUNTER_NAMES = ("disk_cache_hits", "disk_cache_misses")
+
+# parameter-server fault-tolerance counters (ps/replication.py replica
+# groups + ps/service.py hardened RPC), merged into Executor.counters
+# and the chaos drill's counter table
+PS_COUNTER_NAMES = (
+    "ps_failovers", "ps_promotions", "ps_rpc_retries",
+    "ps_snapshot_commits", "ps_replication_lag", "ps_conn_timeouts",
+)
 
 # serving-path counters (ServingEngine.counters merges these plus the
 # fault slice, mirroring Executor.counters)

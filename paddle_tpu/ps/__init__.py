@@ -1,9 +1,17 @@
 """Parameter-server / large-scale sparse subsystem (SURVEY §2.6: the PS
 sync/async/geo family, large_scale_kv, FleetWrapper pull/push). See each
-module's docstring for the reference mapping."""
+module's docstring for the reference mapping; ps/replication.py for the
+fault-tolerance layer (replica groups, shard-map epochs, crash-safe
+shard recovery)."""
 from .communicator import AsyncCommunicator, GeoCommunicator  # noqa: F401
 from .heartbeat import HeartBeatMonitor  # noqa: F401
 from .embedding import SparseEmbedding  # noqa: F401
+from .replication import (  # noqa: F401
+    DeltaLog, PSError, PSRequestError, PSUnavailable, ReplicaCoordinator,
+    ReplicaDiverged, ReplicatedPSServer, Replicator, ShardMap,
+    ShardMapStale, fetch_shard_map, publish_shard_map, verify_replicas,
+    wait_shard_map,
+)
 from .server import run_server  # noqa: F401
 from .service import PSClient, PSServer  # noqa: F401
 from .table import SparseTable  # noqa: F401

@@ -37,7 +37,11 @@ class AsyncCommunicator:
     silently, and ``flush(timeout)`` polls a pending counter on an
     injectable clock, raising typed ``distributed.elastic.WorkerLost``
     when the sender is dead (or its parked error re-raised as the
-    cause) and ``TimeoutError`` when it is merely too slow."""
+    cause) and ``TimeoutError`` when it is merely too slow. When the
+    parked error is a :class:`~paddle_tpu.ps.replication.PSError` —
+    the PSERVER died, typed by the client's bounded retries, not the
+    send thread — flush re-raises it (``PSUnavailable`` etc.) instead
+    of mislabeling a server death as a lost worker."""
 
     def __init__(self, client: PSClient, dim: int, table_id: int = 0,
                  lr: float = 0.01, send_queue_size: int = 16,
@@ -45,9 +49,11 @@ class AsyncCommunicator:
                  clock=None, sleep=None):
         import time
 
-        self._client = client
-        self._dim = dim
-        self._table = table_id
+        # public identity: SparseEmbedding validates its pulls route to
+        # the same table/server this communicator pushes to
+        self.client = client
+        self.dim = int(dim)
+        self.table_id = int(table_id)
         self._lr = lr
         self._q: queue.Queue = queue.Queue(maxsize=max(send_queue_size, 1))
         self._stop = threading.Event()
@@ -93,8 +99,8 @@ class AsyncCommunicator:
                 continue
             try:
                 ids, grads = _merge_dups(
-                    ids, grads.reshape(ids.size, self._dim))
-                self._client.push(self._table, ids, grads, self._dim, lr)
+                    ids, grads.reshape(ids.size, self.dim))
+                self.client.push(self.table_id, ids, grads, self.dim, lr)
             except BaseException as e:   # noqa: B036 (parked for flush)
                 # the failed batch stays PENDING: flush must report the
                 # loss (WorkerLost), not count the batch as delivered
@@ -122,9 +128,16 @@ class AsyncCommunicator:
     def _raise_worker_lost(self, op: str):
         from ..distributed.elastic import WorkerLost
         from ..fault.injector import _bump
+        from .replication import PSError
 
         with self._pending_lock:
             pending = self._pending
+        if isinstance(self._error, PSError):
+            # the PSERVER died (typed PSUnavailable/ShardMapStale after
+            # the client's bounded retries), not the send thread itself:
+            # surface the server-side verdict — WorkerLost would point
+            # operators at the wrong process
+            raise self._error
         _bump("worker_lost")
         raise WorkerLost(
             f"communicator send thread is dead ({op}) with {pending} "

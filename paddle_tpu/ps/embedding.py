@@ -20,14 +20,41 @@ from .table import SparseTable
 
 
 class SparseEmbedding(Layer):
+    """``table=`` serves locally, ``client=`` pulls/pushes through a
+    PSClient (typed failures + failover ride the client — a pull during
+    a primary death fails over to the promoted backup transparently),
+    and ``communicator=`` routes ``push_gradients`` through an
+    AsyncCommunicator so the backward path never blocks on the pserver
+    round-trip (call ``communicator.flush()`` at the sync points)."""
+
     def __init__(self, embedding_dim: int, table: Optional[SparseTable] = None,
                  client=None, table_id: int = 0, optimizer: str = "sgd",
-                 init_range: float = 0.01, seed: int = 0, name=None):
+                 init_range: float = 0.01, seed: int = 0, name=None,
+                 communicator=None):
         super().__init__()
         self.embedding_dim = int(embedding_dim)
         self._table = table
         self._client = client          # PSClient for remote mode
+        self._comm = communicator      # AsyncCommunicator for async push
         self._table_id = table_id
+        if communicator is not None:
+            # the async push path and the pull path must agree on where
+            # the rows live — a mismatched table/dim would silently
+            # train a table the forward never reads (or crash the send
+            # thread and surface later as a misleading WorkerLost)
+            if communicator.dim != self.embedding_dim:
+                raise ValueError(
+                    f"communicator dim {communicator.dim} != "
+                    f"embedding_dim {self.embedding_dim}")
+            if communicator.table_id != table_id:
+                raise ValueError(
+                    f"communicator pushes table {communicator.table_id} "
+                    f"but this embedding reads table {table_id}")
+            if self._client is None:
+                # communicator-only construction: pulls must hit the
+                # SAME pserver the async pushes land on, not a fresh
+                # local table that would never see an update
+                self._client = communicator.client
         if self._table is None and self._client is None:
             self._table = SparseTable(embedding_dim, optimizer=optimizer,
                                       init_range=init_range, seed=seed)
@@ -40,7 +67,9 @@ class SparseEmbedding(Layer):
         return self._table.pull(ids)
 
     def _push(self, ids: np.ndarray, grads: np.ndarray, lr: float):
-        if self._client is not None:
+        if self._comm is not None:
+            self._comm.push_sparse_grad(ids, grads, lr)
+        elif self._client is not None:
             self._client.push(self._table_id, ids, grads,
                               self.embedding_dim, lr)
         else:

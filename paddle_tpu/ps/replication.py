@@ -1,0 +1,1459 @@
+"""Fault-tolerant parameter server: replica groups, shard-map epochs,
+crash-safe shard recovery.
+
+The reference PS data plane (listen_and_serv + parameter_send/recv, the
+Downpour pull/push cycle) loses a hash-shard of every SparseTable the
+moment one pserver dies, and a relaunched server comes back empty. This
+module gives the ``paddle_tpu.ps`` port the same discipline PRs 2/6/7
+gave checkpointing, serving, and elastic DP training:
+
+**Replica groups.** Shard ``k`` is served by a *group* — a primary plus
+``R`` backups. Writes land on the primary and forward primary→backup:
+synchronously in ``sync`` mode (the ack means every replica applied it —
+bitwise-deterministic for CI), or through a bounded queue with a lag
+watermark in ``async`` mode (gauge ``ps_replication_lag``).
+
+**Epoch-versioned shard map.** Group membership lives in the
+coordination KV store (``distributed.http_kv``) under
+``ps/<job>/map/<epoch>`` with a ``ps/<job>/epoch`` pointer — immutable
+per epoch, so readers never see a torn map. Every client request carries
+its map epoch; a demoted or stale server replies a typed error frame and
+the client refreshes instead of hanging.
+
+**Promotion.** Each server renews a heartbeat lease
+(``ps/<job>/lease/<endpoint>``). The :class:`ReplicaCoordinator`
+observes lease expiry, promotes the first live backup (epoch bump,
+counter ``ps_promotions``); clients discover the promotion via the map,
+fail over (counter ``ps_failovers``), and REPLAY the in-flight request —
+write frames carry (client, seq) so an update the dead primary already
+replicated is deduplicated, never double-applied: in sync mode the final
+table state is bitwise identical to a never-killed run.
+
+**Crash-safe shard recovery.** Servers commit their tables through the
+PR 2 :class:`~paddle_tpu.io.snapshot.SnapshotStore` (manifest-verified
+``shard_<k>/seq_<n>/`` dirs, atomic commit, keep-N; counter
+``ps_snapshot_commits``) and keep a sequence-numbered :class:`DeltaLog`
+of applied writes. A killed pserver relaunches, restores the newest
+valid snapshot (corrupt ones are skipped — the PR 2 fallback), and
+catches up by replaying the delta log of a group peer (full state
+transfer when the log rotated past its snapshot).
+
+Typed failures: :class:`PSUnavailable` (endpoint, shard),
+:class:`ShardMapStale` (expected_epoch, observed),
+:class:`ReplicaDiverged` (digest mismatch inside a group),
+:class:`PSRequestError` (server-side rejection, e.g. unknown table).
+Every blocking path is bounded and runs on injectable clocks.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import struct
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..fault.injector import _bump
+from ..fault import injector as _fault
+from .service import (
+    ERR_IO, ERR_LOG_TRUNCATED, ERR_NOT_PRIMARY, ERR_STALE_EPOCH,
+    ERR_UNSUPPORTED, OP_DELTA_SINCE, OP_DIGEST, OP_LOAD, OP_REPL_APPLY,
+    OP_SEQ, OP_SNAPSHOT, OP_STATE, PSReplyError, PSServer, WriteRejected,
+    _HDR, _read_reply, _recv_exact, _send_err, _send_ok, table_digest,
+)
+from .table import SparseTable
+
+__all__ = [
+    "PSError", "PSUnavailable", "ShardMapStale", "ReplicaDiverged",
+    "PSRequestError", "ShardMap", "publish_shard_map", "fetch_shard_map",
+    "wait_shard_map", "DeltaLog", "Replicator", "ReplicatedPSServer",
+    "ReplicaCoordinator", "verify_replicas",
+]
+
+
+# ---------------------------------------------------------------------------
+# typed failures — every PS blocking path exits through one of these
+# ---------------------------------------------------------------------------
+class PSError(RuntimeError):
+    """Base of the parameter-server failure taxonomy. A verdict for the
+    operation that raised it — the client's Retrier never blind-retries
+    these; callers decide whether to fail over, refresh, or surface."""
+
+
+class PSUnavailable(PSError):
+    """A pserver stayed unreachable past the retry budget (and, in
+    replicated mode, past the bounded failover window). ``endpoint``
+    names the dead server, ``shard`` the hash-shard it owned."""
+
+    def __init__(self, message: str, endpoint: str = "", shard: int = -1):
+        super().__init__(message)
+        self.endpoint = endpoint
+        self.shard = int(shard)
+
+
+class ShardMapStale(PSError):
+    """The shard map this client (or server) holds is behind the epoch
+    the cluster moved to, and the bounded refresh couldn't catch up."""
+
+    def __init__(self, message: str, expected_epoch: int = -1,
+                 observed: int = -1):
+        super().__init__(message)
+        self.expected_epoch = int(expected_epoch)
+        self.observed = int(observed)
+
+
+class ReplicaDiverged(PSError):
+    """Replicas of one shard disagree on table content (digest
+    mismatch): replication lost a write or applied out of order.
+    ``digests`` maps endpoint -> hex digest for the offending shard."""
+
+    def __init__(self, message: str, shard: int = -1,
+                 digests: Optional[Dict[str, str]] = None):
+        super().__init__(message)
+        self.shard = int(shard)
+        self.digests = dict(digests or {})
+
+
+class PSRequestError(PSError):
+    """The server rejected the request itself (unknown table id, dim
+    mismatch, save/load IO failure) — retrying the same frame cannot
+    succeed. ``code`` is the wire error code."""
+
+    def __init__(self, message: str, code: int = 0, endpoint: str = ""):
+        super().__init__(message)
+        self.code = int(code)
+        self.endpoint = endpoint
+
+
+# ---------------------------------------------------------------------------
+# the epoch-versioned shard map
+# ---------------------------------------------------------------------------
+class ShardMap:
+    """Immutable-per-epoch assignment of shards to replica groups.
+
+    ``groups[k]`` lists shard ``k``'s endpoints, primary FIRST. Epochs
+    start at 1 (0 on the wire means "not epoch-aware" — the legacy
+    static client) and only ever grow; every promotion bumps the epoch.
+    """
+
+    def __init__(self, groups: Sequence[Sequence[str]], epoch: int = 1,
+                 sync: bool = True, job: str = "ps"):
+        if not groups or any(not g for g in groups):
+            raise ValueError("shard map needs >=1 endpoint per group")
+        if int(epoch) < 1:
+            raise ValueError("shard-map epochs start at 1")
+        self.groups: List[List[str]] = [list(g) for g in groups]
+        self.epoch = int(epoch)
+        self.sync = bool(sync)
+        self.job = str(job)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.groups)
+
+    def primary(self, shard: int) -> str:
+        return self.groups[shard][0]
+
+    def backups(self, shard: int) -> List[str]:
+        return list(self.groups[shard][1:])
+
+    def endpoints(self) -> List[str]:
+        return [ep for g in self.groups for ep in g]
+
+    def role_of(self, endpoint: str) -> Tuple[Optional[str], int]:
+        """("primary"|"backup", shard) for an endpoint, (None, -1) when
+        it is not in the map."""
+        for k, group in enumerate(self.groups):
+            if endpoint in group:
+                return ("primary" if group[0] == endpoint else "backup", k)
+        return (None, -1)
+
+    def to_json(self) -> str:
+        return json.dumps({"epoch": self.epoch, "sync": self.sync,
+                           "job": self.job, "groups": self.groups},
+                          sort_keys=True)
+
+    @classmethod
+    def from_json(cls, raw) -> "ShardMap":
+        if isinstance(raw, (bytes, bytearray)):
+            raw = raw.decode("utf-8")
+        d = json.loads(raw)
+        return cls(d["groups"], epoch=d["epoch"], sync=d.get("sync", True),
+                   job=d.get("job", "ps"))
+
+
+def _map_key(job: str, epoch: int) -> str:
+    return f"ps/{job}/map/{int(epoch)}"
+
+
+def _epoch_key(job: str) -> str:
+    return f"ps/{job}/epoch"
+
+
+def _lease_key(job: str, endpoint: str) -> str:
+    return f"ps/{job}/lease/{endpoint}"
+
+
+def publish_shard_map(kv, m: ShardMap) -> None:
+    """Commit a map: the versioned body first (immutable per epoch),
+    then the epoch pointer — readers following the pointer can never
+    see a torn map."""
+    kv.put(_map_key(m.job, m.epoch), m.to_json())
+    kv.put(_epoch_key(m.job), str(m.epoch))
+
+
+def fetch_shard_map(kv, job: str) -> Optional[ShardMap]:
+    """Current map, or None while none is published."""
+    raw_epoch = kv.get(_epoch_key(job))
+    if raw_epoch is None:
+        return None
+    raw = kv.get(_map_key(job, int(raw_epoch)))
+    return ShardMap.from_json(raw) if raw is not None else None
+
+
+def wait_shard_map(kv, job: str, min_epoch: int = 1, timeout: float = 30.0,
+                   clock: Callable[[], float] = time.monotonic,
+                   sleep: Callable[[float], None] = time.sleep,
+                   poll: float = 0.05) -> ShardMap:
+    """Block (bounded, backoff-paced via ``KVClient.wait_until``) until
+    a map with epoch >= ``min_epoch`` is published; ShardMapStale past
+    the deadline."""
+    def _reached(raw) -> bool:
+        try:
+            return int(raw) >= int(min_epoch)
+        except (TypeError, ValueError):
+            return False
+
+    try:
+        kv.wait_until(_epoch_key(job), _reached, timeout=float(timeout),
+                      poll=poll, clock=clock, sleep=sleep)
+    except TimeoutError:
+        m = fetch_shard_map(kv, job)
+        observed = m.epoch if m is not None else -1
+        raise ShardMapStale(
+            f"shard map for job {job!r} never reached epoch "
+            f"{min_epoch} within {timeout}s (observed "
+            f"{'none' if observed < 0 else observed})",
+            expected_epoch=min_epoch, observed=observed) from None
+    m = fetch_shard_map(kv, job)
+    if m is None or m.epoch < int(min_epoch):
+        # the pointer advanced but the (immutable) map body is missing:
+        # a torn publish — treat as not-yet-available
+        raise ShardMapStale(
+            f"shard map body for job {job!r} missing at the published "
+            f"epoch", expected_epoch=min_epoch,
+            observed=m.epoch if m is not None else -1)
+    return m
+
+
+def publish_lease(kv, job: str, endpoint: str, ttl: float,
+                  clock: Callable[[], float] = time.time) -> float:
+    """Renew a server's liveness lease: stores the wall-clock expiry (the
+    coordinator compares against ITS wall clock — same convention as the
+    elastic agent's worker leases)."""
+    expiry = clock() + float(ttl)
+    kv.put(_lease_key(job, endpoint), repr(expiry))
+    return expiry
+
+
+def read_lease(kv, job: str, endpoint: str) -> Optional[float]:
+    raw = kv.get(_lease_key(job, endpoint))
+    try:
+        return float(raw) if raw is not None else None
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the delta log (catch-up replay source)
+# ---------------------------------------------------------------------------
+_DELTA_HDR = struct.Struct("<BIQIQfQQ")   # op table seq client cseq lr n vlen
+
+
+class DeltaEntry:
+    __slots__ = ("seq", "op", "table_id", "client", "client_seq", "lr",
+                 "ids", "vals")
+
+    def __init__(self, seq, op, table_id, client, client_seq, lr, ids,
+                 vals):
+        self.seq = int(seq)
+        self.op = int(op)
+        self.table_id = int(table_id)
+        self.client = int(client)
+        self.client_seq = int(client_seq)
+        self.lr = float(lr)
+        self.ids = bytes(ids)
+        self.vals = bytes(vals)
+
+    def encode(self) -> bytes:
+        n = len(self.ids) // 8
+        return (_DELTA_HDR.pack(self.op, self.table_id, self.seq,
+                                self.client, self.client_seq, self.lr,
+                                n, len(self.vals))
+                + self.ids + self.vals)
+
+
+def decode_deltas(raw: bytes) -> List[DeltaEntry]:
+    out, off = [], 0
+    while off < len(raw):
+        op, table_id, seq, client, cseq, lr, n, vlen = \
+            _DELTA_HDR.unpack_from(raw, off)
+        off += _DELTA_HDR.size
+        ids = raw[off:off + 8 * n]
+        off += 8 * n
+        vals = raw[off:off + vlen]
+        off += vlen
+        out.append(DeltaEntry(seq, op, table_id, client, cseq, lr, ids,
+                              vals))
+    return out
+
+
+class DeltaLog:
+    """Bounded in-memory log of applied writes, sequence-ordered. A
+    rejoining replica replays ``since(seq)``; ``None`` means the log
+    rotated past that point (ERR_LOG_TRUNCATED on the wire → the
+    rejoiner falls back to a full state transfer)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(1, int(capacity))
+        self._entries: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def append(self, entry: DeltaEntry) -> None:
+        with self._lock:
+            self._entries.append(entry)
+
+    def since(self, seq: int) -> Optional[List[DeltaEntry]]:
+        with self._lock:
+            if self._entries and self._entries[0].seq > seq + 1:
+                return None          # rotated past the requested point
+            return [e for e in self._entries if e.seq > seq]
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._entries[-1].seq if self._entries else 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# raw peer channel (replication/admin traffic; not the sharded client)
+# ---------------------------------------------------------------------------
+class _RawPeer:
+    """One socket to one endpoint speaking the service.py wire protocol
+    directly — what the primary's Replicator and a rejoiner's catch-up
+    use. Reconnects on any error (the desynced-stream rule)."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0,
+                 connect_timeout: Optional[float] = None):
+        self.endpoint = endpoint
+        self.timeout = float(timeout)
+        # connects are bounded tighter than data: a down-peer reprobe
+        # runs on the primary's write path (under its replication
+        # lock), and a black-holed host must not stall every shard
+        # write for the full data timeout
+        self.connect_timeout = (min(self.timeout, 2.0)
+                                if connect_timeout is None
+                                else float(connect_timeout))
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            host, port = self.endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.connect_timeout)
+            s.settimeout(self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, op: int, table_id: int = 0, n: int = 0, lr: float = 0.0,
+             epoch: int = 0, client: int = 0, seq: int = 0, dim: int = 0,
+             payload: bytes = b"", reader=None):
+        try:
+            s = self._connect()
+            s.sendall(_HDR.pack(op, table_id, n, lr, epoch, client, seq,
+                                dim) + payload)
+            _read_reply(s, endpoint=self.endpoint)
+            return reader(s) if reader is not None else None
+        except PSReplyError:
+            raise
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+
+    def call_frame(self, frame: bytes) -> None:
+        """Send a pre-built frame and consume its ack (the Replicator
+        forward hot path)."""
+        try:
+            s = self._connect()
+            s.sendall(frame)
+            _read_reply(s, endpoint=self.endpoint)
+        except PSReplyError:
+            raise
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+
+    # -- admin helpers ------------------------------------------------------
+    def seq_epoch(self) -> Tuple[int, int]:
+        raw = self.call(OP_SEQ, reader=lambda s: _recv_exact(s, 12))
+        return struct.unpack("<QI", raw)
+
+    def delta_since(self, seq: int) -> List[DeltaEntry]:
+        def read(s):
+            total = struct.unpack("<Q", _recv_exact(s, 8))[0]
+            return _recv_exact(s, total)
+
+        raw = self.call(OP_DELTA_SINCE, n=8,
+                        payload=struct.pack("<Q", int(seq)), reader=read)
+        return decode_deltas(raw)
+
+    def state(self) -> Tuple[int, Dict[int, int], Dict[int, bytes]]:
+        """(seq, applied_map, {table_id: blob}) — full state transfer."""
+        def read(s):
+            seq, jlen = struct.unpack("<QI", _recv_exact(s, 12))
+            applied = {int(k): int(v) for k, v in
+                       json.loads(_recv_exact(s, jlen).decode()).items()}
+            ntab = struct.unpack("<I", _recv_exact(s, 4))[0]
+            blobs = {}
+            for _ in range(ntab):
+                tid, blen = struct.unpack("<IQ", _recv_exact(s, 12))
+                blobs[tid] = _recv_exact(s, blen)
+            return seq, applied, blobs
+
+        return self.call(OP_STATE, reader=read)
+
+    def digest(self, table_id: int) -> bytes:
+        return self.call(OP_DIGEST, table_id=table_id,
+                         reader=lambda s: _recv_exact(s, 32))
+
+
+# ---------------------------------------------------------------------------
+# table state blobs (SnapshotStore payloads / full state transfer)
+# ---------------------------------------------------------------------------
+def _table_blob(table: SparseTable) -> bytes:
+    """Full table state (values + optimizer accumulators) as bytes, via
+    the table's own save format so native and python backends both
+    round-trip."""
+    fd, path = tempfile.mkstemp(suffix=".pstable")
+    os.close(fd)
+    try:
+        table.save(path)
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def _load_table_blob(table: SparseTable, blob: bytes,
+                     replace: bool = True) -> None:
+    if replace:
+        table.clear()
+    fd, path = tempfile.mkstemp(suffix=".pstable")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        table.load(path)
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# primary -> backup forwarding
+# ---------------------------------------------------------------------------
+class _StalePeerEpoch(Exception):
+    """A backup rejected a sync forward because the SENDER's epoch is
+    stale: this 'primary' has been demoted and doesn't know it yet. The
+    server turns this into a typed client rejection (fencing)."""
+
+    def __init__(self, endpoint: str, epoch: int):
+        super().__init__(f"{endpoint} reports epoch {epoch}")
+        self.endpoint = endpoint
+        self.epoch = int(epoch)
+
+
+class Replicator:
+    """Forwards applied writes to a group's backups.
+
+    ``sync=True``: ``forward`` blocks until every live backup acked —
+    the primary's ack to the client then means "replicated", and a
+    promoted backup serves a bitwise-identical table. ``sync=False``:
+    frames ride a BOUNDED queue drained by a forwarder thread; the queue
+    depth is the replication-lag watermark (gauge
+    ``ps_replication_lag``), and a full queue back-pressures the write
+    path (``max_lag`` frames) instead of growing without bound.
+
+    A backup that stops answering is marked down and skipped; it is
+    re-probed after ``peer_retry_s`` (its recovery path is the delta-log
+    catch-up, not this hot path). ``dropped`` counts frames each down
+    peer missed — the honest "how far behind is that replica" signal.
+    """
+
+    def __init__(self, peers: Sequence[str], sync: bool = True,
+                 max_lag: int = 1024, rpc_timeout: float = 10.0,
+                 peer_retry_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 on_stale: Optional[Callable[[int], None]] = None):
+        self.sync = bool(sync)
+        # async mode can't fence the already-acked write, but a typed
+        # STALE reject is definitive demotion evidence: surface it so
+        # the owning server refreshes its role immediately instead of
+        # acking more writes for the rest of the role_ttl window
+        self._on_stale = on_stale
+        self.max_lag = max(1, int(max_lag))
+        self._rpc_timeout = float(rpc_timeout)
+        self._peers: Dict[str, _RawPeer] = {
+            ep: _RawPeer(ep, timeout=rpc_timeout) for ep in peers}
+        self._down: Dict[str, float] = {}      # endpoint -> retry-at
+        self.dropped: Dict[str, int] = {ep: 0 for ep in peers}
+        self._peer_retry_s = float(peer_retry_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._killed = False
+        if not self.sync:
+            self._q = queue.Queue(maxsize=self.max_lag)
+            self._thread = threading.Thread(target=self._drain_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    @property
+    def peers(self) -> List[str]:
+        return list(self._peers)
+
+    def set_peers(self, peers: Sequence[str]) -> None:
+        """Adopt a new backup set (promotion / rejoin reshuffles)."""
+        for ep in list(self._peers):
+            if ep not in peers:
+                self._peers.pop(ep).close()
+                self._down.pop(ep, None)
+        for ep in peers:
+            if ep not in self._peers:
+                self._peers[ep] = _RawPeer(ep, timeout=self._rpc_timeout)
+                self.dropped.setdefault(ep, 0)
+
+    def lag(self) -> int:
+        """Frames accepted but not yet replicated (async queue depth)."""
+        return self._q.qsize() if self._q is not None else 0
+
+    def _set_lag_gauge(self) -> None:
+        from .. import profiler
+
+        profiler.set_counter("ps_replication_lag", self.lag())
+
+    def _send_one(self, ep: str, frame: bytes) -> bool:
+        peer = self._peers.get(ep)
+        if peer is None:
+            return False       # set_peers raced the drain thread
+        retry_at = self._down.get(ep)
+        if retry_at is not None and self._clock() < retry_at:
+            self.dropped[ep] = self.dropped.get(ep, 0) + 1
+            return False
+        try:
+            peer.call_frame(frame)
+            self._down.pop(ep, None)
+            return True
+        except PSReplyError as e:
+            if e.code == ERR_STALE_EPOCH:
+                if self.sync:
+                    # the peer is at a NEWER epoch than this sender: we
+                    # are a demoted primary that hasn't noticed — fence
+                    # the in-flight client write instead of silently
+                    # losing it
+                    raise _StalePeerEpoch(ep, e.epoch) from e
+                if self._on_stale is not None:
+                    self._on_stale(e.epoch)
+            self._down[ep] = self._clock() + self._peer_retry_s
+            self.dropped[ep] = self.dropped.get(ep, 0) + 1
+            return False
+        except (ConnectionError, OSError):
+            self._down[ep] = self._clock() + self._peer_retry_s
+            self.dropped[ep] = self.dropped.get(ep, 0) + 1
+            return False
+
+    def _send_all(self, frame: bytes) -> None:
+        for ep in list(self._peers):
+            self._send_one(ep, frame)
+
+    def forward(self, frame: bytes) -> None:
+        """Called by the primary under its replication lock, once per
+        applied write, with the fully-built OP_REPL_APPLY frame."""
+        if self.sync:
+            self._send_all(frame)
+            self._set_lag_gauge()
+            return
+        while True:
+            try:
+                self._q.put(frame, timeout=0.5)
+                break
+            except queue.Full:
+                # bounded lag: back-pressure the write path rather than
+                # let an unbounded backlog hide a dead forwarder — but
+                # never spin on a queue nobody will ever drain
+                if self._stop.is_set() or self._killed or (
+                        self._thread is not None
+                        and not self._thread.is_alive()):
+                    return
+        self._set_lag_gauge()
+
+    def _drain_loop(self) -> None:
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                frame = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                if not self._killed:
+                    self._send_all(frame)
+            except Exception:      # noqa: BLE001 (forwarder must live)
+                pass   # a down peer heals via gap-reject + catch-up
+            finally:
+                self._q.task_done()
+            self._set_lag_gauge()
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Async mode: block (bounded) until every accepted frame is
+        fully forwarded — polls the queue's unfinished-task count, not
+        qsize, so a frame the drain thread popped but is still sending
+        counts as pending (flush == replicated, not merely dequeued)."""
+        if self._q is None:
+            return
+        deadline = self._clock() + float(timeout)
+        while self._q.unfinished_tasks > 0:
+            if self._clock() >= deadline:
+                raise TimeoutError(
+                    f"replication queue still holds "
+                    f"{self._q.unfinished_tasks} frames after {timeout}s")
+            self._sleep(0.01)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if (self._thread is not None
+                and self._thread is not threading.current_thread()):
+            # on_stale can trigger a demotion that stops this replicator
+            # FROM the drain thread itself — joining yourself raises
+            self._thread.join(timeout=5)
+        for peer in self._peers.values():
+            peer.close()
+
+    def kill(self) -> None:
+        """Crash-fidelity stop: DROP queued frames instead of draining
+        them — a SIGKILL'd primary would never have sent them, and the
+        in-process chaos simulation must not replicate state a real
+        crash loses."""
+        self._killed = True
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# the replicated server
+# ---------------------------------------------------------------------------
+class ReplicatedPSServer(PSServer):
+    """A PSServer that participates in a replica group.
+
+    On top of the base server it: validates every client request against
+    its role/epoch (typed STALE/NOT_PRIMARY error frames — a client
+    talking to a demoted server refreshes instead of split-braining),
+    assigns a sequence number to every applied write, dedups replays by
+    (client, client_seq), appends to the :class:`DeltaLog`, forwards to
+    its backups through a :class:`Replicator`, renews a liveness lease
+    in the coordination KV, commits crash-safe SnapshotStore snapshots
+    (``snapshot_every`` writes, plus on demand via the client's
+    ``snapshot_shards``), and — after a crash — ``rejoin()``s its group:
+    restore newest valid snapshot, replay a peer's delta log (or full
+    state transfer), resume serving as whatever the current map says it
+    is.
+
+    The primary re-validates its role against the KV map at most every
+    ``role_ttl`` seconds (and immediately when a request carries a
+    newer epoch) — the bounded split-brain fencing window.
+    """
+
+    def __init__(self, tables: Dict[int, SparseTable], kv, job: str = "ps",
+                 host: str = "127.0.0.1", port: int = 0,
+                 advertise: Optional[str] = None,
+                 snapshot_dir: Optional[str] = None,
+                 num_trainers: int = 1, lease_ttl: float = 10.0,
+                 role_ttl: float = 5.0, snapshot_every: int = 0,
+                 keep_snapshots: int = 3, max_lag: int = 1024,
+                 sync: Optional[bool] = None,
+                 clock: Callable[[], float] = time.time,
+                 request_timeout: Optional[float] = None,
+                 heartbeat_timeout_s: float = 120.0):
+        from ..distributed.http_kv import KVClient
+
+        super().__init__(tables, host=host, port=port,
+                         num_trainers=num_trainers,
+                         heartbeat_timeout_s=heartbeat_timeout_s,
+                         request_timeout=request_timeout)
+        self._kv = KVClient(kv) if isinstance(kv, str) else kv
+        self.job = str(job)
+        self.advertise = advertise or self.endpoint
+        self._snapshot_dir = snapshot_dir
+        self._keep_snapshots = max(1, int(keep_snapshots))
+        self.snapshot_every = max(0, int(snapshot_every))
+        self._lease_ttl = float(lease_ttl)
+        self._role_ttl = float(role_ttl)
+        self._max_lag = int(max_lag)
+        self._sync_override = sync
+        self._sync_effective: Optional[bool] = None
+        self._clock = clock
+        self._repl_lock = threading.RLock()
+        self.seq = 0
+        self._applied: Dict[int, int] = {}     # client -> last client_seq
+        self._dlog = DeltaLog(capacity=max(64, self._max_lag * 4))
+        self._replicator: Optional[Replicator] = None
+        self._epoch = 0
+        self._role: Optional[str] = None
+        self._shard = 0
+        self._last_role_check = -1e18
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
+        self._catchup_running = threading.Event()
+        # set when this server was demoted primary→backup: it may hold
+        # locally-applied writes the group never replicated, so its
+        # state (and seq) cannot be trusted until a FULL resync from
+        # the current primary — replication traffic is rejected typed
+        # in the meantime (a seq collision would otherwise dup-ack the
+        # new primary's forwards without applying them: silent
+        # permanent divergence)
+        self._state_suspect = False
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def role(self) -> Optional[str]:
+        return self._role
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def shard(self) -> int:
+        return self._shard
+
+    @property
+    def sync_mode(self) -> bool:
+        """Effective replication mode: the constructor override, else
+        the adopted shard map's ``sync`` flag (True before any map is
+        seen). Callers gate bitwise-parity assumptions on this — it
+        must not claim sync while the map said async."""
+        if self._sync_override is not None:
+            return bool(self._sync_override)
+        if self._sync_effective is not None:
+            return bool(self._sync_effective)
+        return True
+
+    def _store(self):
+        from ..io.snapshot import SnapshotStore
+
+        if self._snapshot_dir is None:
+            return None
+        root = os.path.join(self._snapshot_dir, f"shard_{self._shard}")
+        return SnapshotStore(root, keep_last=self._keep_snapshots,
+                             prefix="seq_")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        super().start()
+        self.refresh_role(force=True)
+        self._publish_lease()
+        self._lease_thread = threading.Thread(target=self._lease_loop,
+                                              daemon=True)
+        self._lease_thread.start()
+        return self
+
+    def stop(self):
+        self._lease_stop.set()
+        if self._replicator is not None:
+            self._replicator.stop()
+        super().stop()
+
+    def crash(self):
+        # a crashed process renews nothing and forwards nothing: stop
+        # the lease thread so the coordinator sees the lease expire on
+        # schedule, and KILL the replicator (dropping queued frames — a
+        # real SIGKILL would never have sent them)
+        self._lease_stop.set()
+        if self._replicator is not None:
+            self._replicator.kill()
+        super().crash()
+
+    # -- leases -------------------------------------------------------------
+    def _publish_lease(self) -> None:
+        try:
+            publish_lease(self._kv, self.job, self.advertise,
+                          self._lease_ttl, clock=self._clock)
+        except (ConnectionError, OSError, RuntimeError):
+            pass   # KV briefly down: next renewal retries
+
+    def _lease_loop(self) -> None:
+        interval = max(0.05, self._lease_ttl / 3.0)
+        while not self._lease_stop.wait(interval):
+            if self._stop.is_set():
+                return
+            self._publish_lease()
+
+    # -- role management ----------------------------------------------------
+    def refresh_role(self, force: bool = False) -> None:
+        """Re-read the shard map and adopt role/epoch/peers. Paced by
+        ``role_ttl`` unless forced (a request carrying a newer epoch
+        forces — promotion must be adoptable the moment a client shows
+        up with the new map)."""
+        now = self._clock()
+        if not force and now - self._last_role_check < self._role_ttl:
+            return
+        self._last_role_check = now
+        try:
+            m = fetch_shard_map(self._kv, self.job)
+        except (ConnectionError, OSError, RuntimeError):
+            return
+        if m is None or m.epoch <= self._epoch:
+            return
+        self._adopt(m)
+
+    def _adopt(self, m: ShardMap) -> None:
+        demoted = False
+        with self._repl_lock:
+            was_primary = self._role == "primary"
+            role, shard = m.role_of(self.advertise)
+            self._epoch = m.epoch
+            self._role = role
+            if shard >= 0:
+                self._shard = shard
+            sync = (m.sync if self._sync_override is None
+                    else bool(self._sync_override))
+            self._sync_effective = m.sync
+            peers = ([ep for ep in m.groups[shard] if ep != self.advertise]
+                     if role == "primary" else [])
+            if role == "primary" and peers:
+                if self._replicator is None:
+                    self._replicator = Replicator(
+                        peers, sync=sync, max_lag=self._max_lag,
+                        clock=time.monotonic,
+                        on_stale=lambda _e: self.refresh_role(force=True))
+                else:
+                    self._replicator.set_peers(peers)
+            elif self._replicator is not None:
+                self._replicator.stop()
+                self._replicator = None
+            if was_primary and role != "primary" and self.seq > 0:
+                # demotion: any write applied here but not replicated
+                # is now orphaned state — quarantine until fully
+                # resynced from the authoritative primary
+                demoted = True
+                self._state_suspect = True
+        if demoted:
+            self._schedule_catch_up()
+
+    # -- request validation (PSServer hook) ---------------------------------
+    def _access_error(self, base_op: int, epoch: int):
+        self.refresh_role(force=epoch > self._epoch)
+        if self._epoch < 1:
+            return None          # no map published: plain-server mode
+        if base_op == OP_LOAD:
+            # a raw table load would mutate state with no seq, no delta
+            # entry, and no forward — backups would silently diverge;
+            # replicated recovery goes through snapshots + catch-up
+            return (ERR_UNSUPPORTED,
+                    f"{self.advertise} is replicated: OP_LOAD bypasses "
+                    "the replication stream — restore via snapshots "
+                    "and catch-up instead")
+        if epoch and epoch < self._epoch:
+            return (ERR_STALE_EPOCH,
+                    f"request epoch {epoch} is behind {self.advertise} "
+                    f"(epoch {self._epoch}) — refresh the shard map")
+        if self._role != "primary":
+            return (ERR_NOT_PRIMARY,
+                    f"{self.advertise} is "
+                    f"{self._role or 'unassigned'} for shard "
+                    f"{self._shard} at epoch {self._epoch} — only the "
+                    "primary serves clients")
+        return None
+
+    # -- the write path -----------------------------------------------------
+    def _apply_write(self, base_op: int, table: SparseTable, table_id: int,
+                     ids: np.ndarray, vals: np.ndarray, lr: float,
+                     client: int, cseq: int, forwarded: bool) -> None:
+        with self._repl_lock:
+            if client and cseq and self._applied.get(client, 0) >= cseq:
+                return           # failover replay of an applied write
+            _fault.point("ps.apply")
+            super()._apply_write(base_op, table, table_id, ids, vals, lr,
+                                 client, cseq, forwarded)
+            if client and cseq:
+                self._applied[client] = cseq
+            self.seq += 1
+            entry = DeltaEntry(self.seq, base_op, table_id, client, cseq,
+                               lr, ids.tobytes(), vals.tobytes())
+            self._dlog.append(entry)
+            if not forwarded and self._replicator is not None:
+                # forward the encoded delta entry: it carries THIS
+                # replication seq, so backups apply strictly in primary
+                # order (a gap is a typed reject + catch-up, never a
+                # silent out-of-order apply)
+                blob = entry.encode()
+                frame = _HDR.pack(OP_REPL_APPLY, 0, len(blob), 0.0,
+                                  self._epoch, 0, 0, 0) + blob
+                try:
+                    self._replicator.forward(frame)
+                except _StalePeerEpoch as e:
+                    # a peer at a NEWER epoch rejected our forward: we
+                    # were demoted mid-write. Fence: adopt the new map
+                    # and reject the client's write typed — our local
+                    # apply is on a stale replica whose state the rejoin
+                    # catch-up discards, and the client's replay against
+                    # the real primary applies it exactly once.
+                    self.refresh_role(force=True)
+                    raise WriteRejected(
+                        ERR_NOT_PRIMARY,
+                        f"{self.advertise} was demoted during the write "
+                        f"(peer {e.endpoint} is at epoch {e.epoch}) — "
+                        "refresh the shard map and replay") from e
+            if (self.snapshot_every and self._snapshot_dir
+                    and self.seq % self.snapshot_every == 0):
+                self._save_snapshot_locked()
+
+    # -- admin channel (PSServer hook) --------------------------------------
+    def _admin_reply(self, base_op: int, conn, table_id: int, n: int,
+                     payload: bytes, epoch: int = 0) -> None:
+        if base_op == OP_SEQ:
+            _send_ok(conn, struct.pack("<QI", self.seq, self._epoch))
+        elif base_op == OP_DELTA_SINCE:
+            if len(payload) < 8:
+                _send_err(conn, ERR_LOG_TRUNCATED, self._epoch,
+                          "malformed DELTA_SINCE request (no seq)")
+                return
+            if self._state_suspect:
+                _send_err(conn, ERR_LOG_TRUNCATED, self._epoch,
+                          f"{self.advertise} holds quarantined "
+                          "post-demotion state — not a catch-up source")
+                return
+            since = struct.unpack("<Q", payload)[0]
+            entries = self._dlog.since(since)
+            # the log must COVER since+1..self.seq — an empty log on a
+            # snapshot-restored server (seq ahead, nothing retained)
+            # would otherwise reply "0 entries" and leave the rejoiner
+            # believing it is caught up while silently diverged
+            if entries is None or since + len(entries) < self.seq:
+                _send_err(conn, ERR_LOG_TRUNCATED, self._epoch,
+                          f"delta log on {self.advertise} does not cover "
+                          f"seq {since + 1}..{self.seq} — full state "
+                          "transfer required")
+                return
+            blob = b"".join(e.encode() for e in entries)
+            _send_ok(conn, struct.pack("<Q", len(blob)) + blob)
+        elif base_op == OP_STATE:
+            if self._state_suspect:
+                _send_err(conn, ERR_LOG_TRUNCATED, self._epoch,
+                          f"{self.advertise} holds quarantined "
+                          "post-demotion state — not a sync source")
+                return
+            with self._repl_lock:
+                applied = json.dumps(
+                    {str(k): v for k, v in self._applied.items()}).encode()
+                blobs = {tid: _table_blob(t)
+                         for tid, t in self.tables.items()}
+                seq = self.seq
+            out = [struct.pack("<QI", seq, len(applied)), applied,
+                   struct.pack("<I", len(blobs))]
+            for tid, blob in sorted(blobs.items()):
+                out.append(struct.pack("<IQ", tid, len(blob)))
+                out.append(blob)
+            _send_ok(conn, b"".join(out))
+        elif base_op == OP_SNAPSHOT:
+            try:
+                seq = self.save_snapshot()
+            except (OSError, ValueError, RuntimeError) as e:
+                _send_err(conn, ERR_IO, self._epoch,
+                          f"snapshot on {self.advertise} failed: {e}")
+                return
+            _send_ok(conn, struct.pack("<Q", seq))
+        elif base_op == OP_REPL_APPLY:
+            if self._state_suspect:
+                # quarantined post-demotion state: a seq collision with
+                # the new primary's stream would dup-ack a DIFFERENT
+                # write — reject everything until the full resync lands
+                _send_err(conn, ERR_LOG_TRUNCATED, self._epoch,
+                          f"{self.advertise} is resyncing after "
+                          "demotion — retry after catch-up")
+                self._schedule_catch_up()
+                return
+            if epoch and self._epoch and epoch < self._epoch:
+                # a forward from a demoted primary that doesn't know it
+                # yet: rejecting typed (instead of a silent duplicate
+                # ack when its seq collides with ours) is what lets the
+                # stale sender fence ITS client's write
+                _send_err(conn, ERR_STALE_EPOCH, self._epoch,
+                          f"forward from epoch {epoch} but "
+                          f"{self.advertise} is at {self._epoch}")
+                return
+            if epoch and epoch > self._epoch and self.seq > 0:
+                # first forward from a NEW epoch's primary: our tail was
+                # fed by the old primary and may differ from the new
+                # one's by the writes that raced the promotion — a seq
+                # collision would dup-ack a different write. Quarantine
+                # and fully resync before accepting the new stream.
+                self.refresh_role(force=True)
+                with self._repl_lock:
+                    self._state_suspect = True
+                _send_err(conn, ERR_LOG_TRUNCATED, self._epoch,
+                          f"{self.advertise} crossed into epoch "
+                          f"{epoch} with a pre-promotion tail — "
+                          "resyncing")
+                self._schedule_catch_up()
+                return
+            try:
+                entries = decode_deltas(payload)
+            except (struct.error, IndexError):
+                entries = []
+            if not entries:
+                _send_err(conn, ERR_IO, self._epoch,
+                          "malformed replication frame")
+                return
+            entry = entries[0]
+            with self._repl_lock:
+                if entry.seq <= self.seq:
+                    _send_ok(conn)        # duplicate forward: acked
+                    return
+                if entry.seq != self.seq + 1:
+                    # a gap means this replica missed forwards while it
+                    # was down — applying out of order would silently
+                    # diverge; reject typed and self-heal: a background
+                    # catch-up replays the primary's delta log, after
+                    # which retried forwards line up again
+                    _send_err(conn, ERR_LOG_TRUNCATED, self._epoch,
+                              f"replica {self.advertise} is at seq "
+                              f"{self.seq}, got forward seq {entry.seq} "
+                              "— delta catch-up required")
+                    self._schedule_catch_up()
+                    return
+                table = self.tables.get(entry.table_id)
+                if table is None:
+                    _send_err(conn, ERR_IO, self._epoch,
+                              f"forwarded write names unknown table "
+                              f"{entry.table_id}")
+                    return
+                PSServer._apply_write(
+                    self, entry.op, table, entry.table_id,
+                    np.frombuffer(entry.ids, np.int64),
+                    np.frombuffer(entry.vals, np.float32), entry.lr,
+                    entry.client, entry.client_seq, True)
+                if entry.client and entry.client_seq:
+                    self._applied[entry.client] = max(
+                        self._applied.get(entry.client, 0),
+                        entry.client_seq)
+                self.seq = entry.seq
+                self._dlog.append(entry)
+                if (self.snapshot_every and self._snapshot_dir
+                        and self.seq % self.snapshot_every == 0):
+                    # backups snapshot on the same cadence as primaries:
+                    # a promoted backup must restore from ITS OWN disk,
+                    # not hope the dead primary's survives
+                    self._save_snapshot_locked()
+            _send_ok(conn)
+        else:
+            super()._admin_reply(base_op, conn, table_id, n, payload)
+
+    # -- crash-safe snapshots -----------------------------------------------
+    def save_snapshot(self) -> int:
+        """Commit all tables through SnapshotStore (atomic, manifest-
+        verified, keep-N). Returns the applied seq the snapshot covers.
+        Counter: ``ps_snapshot_commits``."""
+        with self._repl_lock:
+            return self._save_snapshot_locked()
+
+    def _save_snapshot_locked(self) -> int:
+        store = self._store()
+        if store is None:
+            raise ValueError(
+                f"{self.advertise} has no snapshot_dir configured")
+        meta = {"seq": self.seq, "epoch": self._epoch,
+                "applied": {str(k): v for k, v in self._applied.items()},
+                "tables": {str(t): {"dim": tab.dim}
+                           for t, tab in self.tables.items()}}
+        files: Dict[str, object] = {
+            "meta.json": json.dumps(meta, sort_keys=True).encode()}
+        for tid, tab in self.tables.items():
+            files[f"table_{tid}.bin"] = _table_blob(tab)
+        store.save(self.seq, files)
+        _bump("ps_snapshot_commits")
+        return self.seq
+
+    def restore(self) -> Optional[int]:
+        """Load the newest VALID snapshot (corrupt/torn ones are skipped
+        with the PR 2 fallback counters). Returns the restored seq, or
+        None when no usable snapshot exists (fresh start)."""
+        store = self._store()
+        if store is None:
+            return None
+        loaded = store.load_latest()
+        if loaded is None:
+            return None
+        _tag, files = loaded
+        meta = json.loads(files["meta.json"].decode())
+        with self._repl_lock:
+            for tid, tab in self.tables.items():
+                blob = files.get(f"table_{tid}.bin")
+                if blob is not None:
+                    _load_table_blob(tab, blob, replace=True)
+            self.seq = int(meta["seq"])
+            self._applied = {int(k): int(v)
+                             for k, v in meta.get("applied", {}).items()}
+        return self.seq
+
+    # -- catch-up / rejoin --------------------------------------------------
+    def _schedule_catch_up(self) -> None:
+        """One-shot background heal for a live backup that missed
+        forwards (gap-rejected an OP_REPL_APPLY): replay the current
+        primary's delta log, then retried forwards line up."""
+        if self._catchup_running.is_set():
+            return
+        self._catchup_running.set()
+
+        def run():
+            try:
+                m = fetch_shard_map(self._kv, self.job)
+                if m is None:
+                    return
+                if m.epoch > self._epoch:
+                    self._adopt(m)
+                _role, shard = m.role_of(self.advertise)
+                if shard < 0:
+                    return
+                primary = m.groups[shard][0]
+                if primary == self.advertise:
+                    return
+                try:
+                    if self._state_suspect:
+                        # quarantined: delta replay can't help (our seq
+                        # itself is untrustworthy) — full state only
+                        self._full_resync(primary)
+                    else:
+                        self.catch_up(primary)
+                except (ConnectionError, OSError, PSReplyError, PSError):
+                    pass   # next gap rejection schedules another round
+            finally:
+                self._catchup_running.clear()
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _replay(self, entries: List[DeltaEntry]) -> int:
+        applied = 0
+        with self._repl_lock:
+            for e in entries:
+                if e.seq <= self.seq:
+                    continue
+                table = self.tables.get(e.table_id)
+                if table is None:
+                    # a table this replica doesn't host (mismatched
+                    # PADDLE_PS_TABLES): consume the seq so catch-up
+                    # progresses instead of a KeyError killing the
+                    # heal thread in a crash loop
+                    self.seq = e.seq
+                    continue
+                ids = np.frombuffer(e.ids, np.int64)
+                vals = np.frombuffer(e.vals, np.float32)
+                PSServer._apply_write(self, e.op, table, e.table_id, ids,
+                                      vals, e.lr, e.client, e.client_seq,
+                                      True)
+                if e.client and e.client_seq:
+                    self._applied[e.client] = max(
+                        self._applied.get(e.client, 0), e.client_seq)
+                self.seq = e.seq
+                applied += 1
+        return applied
+
+    def _full_resync(self, peer_endpoint: str) -> int:
+        """Replace local state wholesale with the peer's (tables, seq,
+        dedup map) and reset the delta log; clears the post-demotion
+        quarantine. The recovery of last resort — and the only correct
+        one when our own seq can't be trusted."""
+        peer = _RawPeer(peer_endpoint)
+        try:
+            seq, applied, blobs = peer.state()
+        finally:
+            peer.close()
+        with self._repl_lock:
+            for tid, blob in blobs.items():
+                if tid in self.tables:
+                    _load_table_blob(self.tables[tid], blob,
+                                     replace=True)
+            self.seq = int(seq)
+            self._applied = dict(applied)
+            self._dlog = DeltaLog(self._dlog.capacity)
+            self._state_suspect = False
+        return len(blobs)
+
+    def catch_up(self, peer_endpoint: str) -> int:
+        """Replay the peer's delta log from our applied seq; on
+        ERR_LOG_TRUNCATED fall back to a full state transfer. Returns
+        the number of entries (or tables, for a full sync) applied."""
+        peer = _RawPeer(peer_endpoint)
+        try:
+            try:
+                entries = peer.delta_since(self.seq)
+                return self._replay(entries)
+            except PSReplyError as e:
+                if e.code != ERR_LOG_TRUNCATED:
+                    raise
+        finally:
+            peer.close()
+        return self._full_resync(peer_endpoint)
+
+    def rejoin(self, timeout: float = 30.0) -> Optional[str]:
+        """The supervised-relaunch recovery path: adopt the current map,
+        restore the newest valid snapshot, catch up from the most
+        advanced live group peer, and resume serving under whatever role
+        the map assigns. Returns the sync-source endpoint (None when
+        nothing to catch up from)."""
+        try:
+            m = wait_shard_map(self._kv, self.job, timeout=timeout,
+                               clock=time.monotonic)
+        except ShardMapStale:
+            return None
+        self._adopt(m)
+        self.restore()
+        _role, shard = m.role_of(self.advertise)
+        if shard < 0:
+            return None
+        # probe group peers for the most advanced seq. A transiently
+        # unreachable-but-lease-live peer is RETRIED (bounded): serving
+        # from a stale snapshot because one probe raced a busy peer
+        # would hand out old values (as primary) or set up a seq
+        # collision (as backup). Peers with expired leases are truly
+        # gone — no point waiting on them.
+        deadline = time.monotonic() + min(10.0, float(timeout))
+        while True:
+            best_ep, best_seq = None, self.seq
+            flaky = []
+            for ep in m.groups[shard]:
+                if ep == self.advertise:
+                    continue
+                probe = _RawPeer(ep)
+                try:
+                    seq, _ = probe.seq_epoch()
+                except (ConnectionError, OSError, PSReplyError):
+                    lease = read_lease(self._kv, self.job, ep)
+                    if lease is not None and lease > self._clock():
+                        flaky.append(ep)
+                    continue
+                finally:
+                    probe.close()
+                if seq > best_seq:
+                    best_ep, best_seq = ep, seq
+            if (best_ep is not None or not flaky
+                    or time.monotonic() >= deadline):
+                break
+            time.sleep(0.2)
+        if best_ep is not None:
+            self.catch_up(best_ep)
+        if _role == "backup":
+            # an async-mode crash can leave a restored snapshot holding
+            # writes the group never saw, with a seq that LOOKS caught
+            # up (or ahead) — digest-verify against the live primary
+            # and full-resync on any mismatch; seq comparison alone
+            # cannot see divergent content at equal seq
+            primary = m.groups[shard][0]
+            if primary != self.advertise:
+                probe = _RawPeer(primary)
+                try:
+                    for tid, tab in self.tables.items():
+                        if probe.digest(tid) != table_digest(tab):
+                            self._full_resync(primary)
+                            break
+                except (ConnectionError, OSError, PSReplyError):
+                    pass   # primary unreachable: forwards will gap-heal
+                finally:
+                    probe.close()
+        self._publish_lease()
+        return best_ep
+
+
+# ---------------------------------------------------------------------------
+# the coordinator (promotion on lease expiry)
+# ---------------------------------------------------------------------------
+class ReplicaCoordinator:
+    """Publishes the shard map and promotes backups when a primary's
+    lease expires.
+
+    ``check_now()`` is one sweep on the injected clock (tests drive
+    expiry with a fake clock, zero real sleeps); ``start()`` runs it on
+    a daemon thread every ``interval`` for real deployments/drills. A
+    promotion reorders the dead primary to the TAIL of its group (it
+    rejoins as a backup after relaunch) and bumps the epoch; counter
+    ``ps_promotions``. A shard whose every member is lease-dead is left
+    alone — there is nothing correct to promote, and clients keep
+    getting typed PSUnavailable until an operator intervenes.
+    """
+
+    def __init__(self, kv, job: str = "ps", lease_ttl: float = 10.0,
+                 interval: float = 1.0, boot_grace: Optional[float] = None,
+                 clock: Callable[[], float] = time.time,
+                 on_promote: Optional[Callable[[int, str], None]] = None):
+        from ..distributed.http_kv import KVClient
+
+        self._kv = KVClient(kv) if isinstance(kv, str) else kv
+        self.job = str(job)
+        self._ttl = float(lease_ttl)
+        self._interval = float(interval)
+        self._clock = clock
+        self._boot_grace = (2 * self._ttl if boot_grace is None
+                            else float(boot_grace))
+        self._boot_deadline = clock() + self._boot_grace
+        self._on_promote = on_promote
+        self._seen_lease: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.promotions = 0
+
+    # -- map management -----------------------------------------------------
+    def publish(self, groups: Sequence[Sequence[str]],
+                sync: bool = True, epoch: Optional[int] = None) -> ShardMap:
+        """Publish the initial (or a hand-edited) map. Epoch defaults to
+        one past the current map's."""
+        cur = fetch_shard_map(self._kv, self.job)
+        e = (epoch if epoch is not None
+             else (cur.epoch + 1 if cur is not None else 1))
+        m = ShardMap(groups, epoch=e, sync=sync, job=self.job)
+        publish_shard_map(self._kv, m)
+        # restart the grace window at the CONFIGURED width — resetting
+        # to a hardcoded 2*ttl here would silently defeat a generous
+        # boot_grace (slow server imports would read as dead primaries
+        # and promote before the cluster even came up)
+        self._boot_deadline = self._clock() + self._boot_grace
+        return m
+
+    def map(self) -> Optional[ShardMap]:
+        return fetch_shard_map(self._kv, self.job)
+
+    def leases(self) -> Dict[str, Optional[float]]:
+        m = self.map()
+        if m is None:
+            return {}
+        return {ep: read_lease(self._kv, self.job, ep)
+                for ep in m.endpoints()}
+
+    def _alive(self, ep: str, now: float) -> bool:
+        expiry = read_lease(self._kv, self.job, ep)
+        if expiry is None:
+            # no lease yet: grant boot grace, then treat as dead — a
+            # server that never came up is as gone as a crashed one
+            return ep not in self._seen_lease and now < self._boot_deadline
+        self._seen_lease.add(ep)
+        return expiry > now
+
+    # -- the sweep ----------------------------------------------------------
+    def check_now(self) -> List[int]:
+        """One promotion sweep; returns the shard indices promoted."""
+        m = self.map()
+        if m is None:
+            return []
+        now = self._clock()
+        promoted: List[int] = []
+        new_groups = [list(g) for g in m.groups]
+        for k, group in enumerate(m.groups):
+            if self._alive(group[0], now):
+                continue
+            live_backup = next((ep for ep in group[1:]
+                                if self._alive(ep, now)), None)
+            if live_backup is None:
+                continue   # whole group dark: nothing correct to promote
+            rest = [ep for ep in group if ep not in (group[0], live_backup)]
+            new_groups[k] = [live_backup] + rest + [group[0]]
+            promoted.append(k)
+        if promoted:
+            nm = ShardMap(new_groups, epoch=m.epoch + 1, sync=m.sync,
+                          job=self.job)
+            publish_shard_map(self._kv, nm)
+            for k in promoted:
+                self.promotions += 1
+                _bump("ps_promotions")
+                if self._on_promote is not None:
+                    self._on_promote(k, new_groups[k][0])
+        return promoted
+
+    # -- monitor thread -----------------------------------------------------
+    def start(self) -> "ReplicaCoordinator":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.check_now()
+            except (ConnectionError, OSError, RuntimeError):
+                continue   # KV hiccup: sweep again next interval
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# divergence check
+# ---------------------------------------------------------------------------
+def verify_replicas(m: ShardMap, table_ids: Sequence[int] = (0,),
+                    timeout: float = 10.0) -> Dict[int, Dict[str, str]]:
+    """Compare table digests across every group's live members; returns
+    {shard: {endpoint: hexdigest}} on agreement and raises
+    :class:`ReplicaDiverged` naming the first disagreeing shard.
+    Unreachable members are skipped (they are the failover/rejoin
+    story, not the divergence one)."""
+    out: Dict[int, Dict[str, str]] = {}
+    for k, group in enumerate(m.groups):
+        for tid in table_ids:
+            digests: Dict[str, str] = {}
+            for ep in group:
+                probe = _RawPeer(ep, timeout=timeout)
+                try:
+                    digests[ep] = probe.digest(tid).hex()
+                except (ConnectionError, OSError, PSReplyError):
+                    continue
+                finally:
+                    probe.close()
+            if len(set(digests.values())) > 1:
+                raise ReplicaDiverged(
+                    f"shard {k} table {tid} diverged across replicas: "
+                    + ", ".join(f"{ep}={d[:12]}..."
+                                for ep, d in sorted(digests.items())),
+                    shard=k, digests=digests)
+            out.setdefault(k, {}).update(digests)
+    return out
+
+
+def local_digest(table: SparseTable) -> str:
+    """Hex digest of one local table (pairs with verify_replicas)."""
+    return table_digest(table).hex()

@@ -5,11 +5,39 @@ Parity with the reference pserver startup
 listen_and_serv_op.cc): endpoints/roles come from the PADDLE_* env the
 launcher sets (launch_utils.py), tables are declared via
 PADDLE_PS_TABLES ("id:dim:optimizer,..." — the TrainerDesc/table-config
-analogue)."""
+analogue).
+
+Fault-tolerant mode (ps/replication.py) switches on when
+``PADDLE_PS_KV_ENDPOINT`` names the coordination KV server:
+
+    PADDLE_PS_KV_ENDPOINT   host:port of the http_kv KVServer
+    PADDLE_PS_JOB           shard-map namespace (default "ps")
+    PADDLE_PS_SYNC          1 = synchronous primary→backup replication
+                            (bitwise-deterministic acks; default),
+                            0 = async with a bounded lag watermark
+    PADDLE_PS_REPLICAS      backups per shard R — consumed by whoever
+                            publishes the shard map (publish_from_env /
+                            the chaos drill), not by the server itself
+    PADDLE_PS_SNAPSHOT_DIR  SnapshotStore root for crash-safe
+                            shard_<k>/seq_<n>/ table snapshots
+    PADDLE_PS_SNAPSHOT_EVERY  commit a snapshot every N applied writes
+    PADDLE_PS_LEASE_TTL     liveness-lease seconds (default 10)
+    PADDLE_PS_ADVERTISE     endpoint to register as (defaults to the
+                            bound host:port — set it when the bind host
+                            differs from the reachable one)
+
+A replicated server restores its newest valid snapshot and rejoins its
+group (delta-log catch-up from the most advanced live peer) before
+serving — the supervised-relaunch recovery path. SIGTERM drains
+gracefully (stop serving, exit 0) so launch.Supervisor's bounded drain
+window works on pservers exactly like on trainers.
+"""
 from __future__ import annotations
 
 import os
-from typing import Dict
+import signal
+import sys
+from typing import Dict, List, Sequence
 
 from .service import PSServer
 from .table import SparseTable
@@ -24,13 +52,79 @@ def _tables_from_env() -> Dict[int, SparseTable]:
     return tables
 
 
+def groups_from_env(endpoints: Sequence[str]) -> List[List[str]]:
+    """Slice a flat endpoint list into replica groups of 1 primary +
+    ``PADDLE_PS_REPLICAS`` backups each: with R=1, [a, b, c, d] becomes
+    [[a, b], [c, d]] — 2 shards, 2-replica groups."""
+    r = int(os.environ.get("PADDLE_PS_REPLICAS", "0"))
+    size = r + 1
+    eps = list(endpoints)
+    if len(eps) % size:
+        raise ValueError(
+            f"{len(eps)} endpoints do not divide into groups of "
+            f"{size} (PADDLE_PS_REPLICAS={r})")
+    return [eps[i:i + size] for i in range(0, len(eps), size)]
+
+
+def publish_from_env(kv, endpoints: Sequence[str], job=None):
+    """Publish the initial shard map from the launcher env (the
+    coordinator-less bring-up path: one process — usually rank 0 or the
+    launch driver — calls this once)."""
+    from .replication import ShardMap, publish_shard_map
+
+    m = ShardMap(groups_from_env(endpoints),
+                 sync=os.environ.get("PADDLE_PS_SYNC", "1") != "0",
+                 job=job or os.environ.get("PADDLE_PS_JOB", "ps"))
+    publish_shard_map(kv, m)
+    return m
+
+
 def run_server(block: bool = True):
-    """Start serving on PADDLE_PORT (reference listen_and_serv main loop)."""
+    """Start serving on PADDLE_PORT (reference listen_and_serv main
+    loop); replicated + crash-safe when PADDLE_PS_KV_ENDPOINT is set."""
     port = int(os.environ.get("PADDLE_PORT", "0"))
     num_trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    server = PSServer(_tables_from_env(), port=port,
-                      num_trainers=num_trainers).start()
-    print(f"paddle_tpu pserver listening on {server.endpoint}")
+    kv_ep = os.environ.get("PADDLE_PS_KV_ENDPOINT")
+    if kv_ep:
+        from .replication import ReplicatedPSServer
+
+        server = ReplicatedPSServer(
+            _tables_from_env(), kv_ep,
+            job=os.environ.get("PADDLE_PS_JOB", "ps"),
+            port=port,
+            advertise=os.environ.get("PADDLE_PS_ADVERTISE") or None,
+            snapshot_dir=os.environ.get("PADDLE_PS_SNAPSHOT_DIR") or None,
+            snapshot_every=int(
+                os.environ.get("PADDLE_PS_SNAPSHOT_EVERY", "0")),
+            lease_ttl=float(os.environ.get("PADDLE_PS_LEASE_TTL", "10")),
+            sync=(None if "PADDLE_PS_SYNC" not in os.environ
+                  else os.environ["PADDLE_PS_SYNC"] != "0"),
+            num_trainers=num_trainers)
+        # supervised-relaunch recovery BEFORE serving or leasing: a
+        # fast-relaunched primary must not answer pulls from its empty
+        # tables, and must not renew the lease that would suppress the
+        # promotion clients are waiting on. The listener is bound (the
+        # backlog queues early connections) but nothing is accepted and
+        # no lease is published until restore + catch-up finish.
+        source = server.rejoin(timeout=float(
+            os.environ.get("PADDLE_PS_REJOIN_TIMEOUT", "30")))
+        server.start()
+        print(f"paddle_tpu pserver listening on {server.endpoint} "
+              f"(job={server.job}, role={server.role}, "
+              f"epoch={server.epoch}, seq={server.seq}, "
+              f"caught_up_from={source})")
+    else:
+        server = PSServer(_tables_from_env(), port=port,
+                          num_trainers=num_trainers).start()
+        print(f"paddle_tpu pserver listening on {server.endpoint}")
     if block:
+        def _drain(signum, frame):
+            server.stop()
+            sys.exit(0)
+
+        try:
+            signal.signal(signal.SIGTERM, _drain)
+        except (ValueError, OSError):
+            pass   # non-main thread: caller owns signal policy
         server.join()
     return server

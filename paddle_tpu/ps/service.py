@@ -11,29 +11,106 @@ hash-sharded across server endpoints by the client exactly like the
 reference splits parameter blocks across pservers, and each connection
 gets a server thread (the listen_and_serv thread-per-handler model).
 
-Wire format: [op:u8][table:u32][n:u64][lr:f32] then op-dependent arrays.
-  PULL:  ids[n]i64            -> values[n*dim]f32
-  PUSH:  ids[n]i64 grads f32  -> ack u8
-  MERGE: ids[n]i64 deltas f32 -> ack u8   (geo delta add)
-  SAVE/LOAD: path bytes[n]    -> rc u8
-  ROWS:                       -> count u64
-  BARRIER/STOP:               -> ack u8
+Wire format v2 (fault-tolerant revision)::
+
+    request  = [op:u8][table:u32][n:u64][lr:f32]
+               [epoch:u32][client:u32][seq:u64][dim:u32]  + payload
+    reply    = [0x01] + payload                            (OK)
+             | [0x00][code:u8][srv_epoch:u32][len:u32][msg]  (typed error)
+
+``epoch`` is the client's shard-map epoch (0 = not epoch-aware — the
+legacy static-endpoint client), ``client``/``seq`` identify a write for
+replay dedup (a failover replays the *same* frame, so an update that was
+already applied-and-replicated is acked instead of double-applied), and
+``dim`` is the client's row width so the server can always drain a
+value-carrying payload before reporting an error (unknown table, dim
+mismatch) without desyncing the stream. Primary→backup replication
+traffic rides the seq-validated ``OP_REPL_APPLY`` admin op — there is
+deliberately NO wire-level "trusted" flag that would exempt a frame
+from role checks.
+
+The v1 protocol acked every reply with a bare ``\\x01`` and had no error
+channel at all: an unknown ``table_id`` raised KeyError past the
+``(ConnectionError, OSError)`` handler, killing the connection thread
+while the client blocked on a reply forever, and a timed-out barrier
+still acked success. Every reply now starts with a status byte and every
+failure is a typed error frame the client surfaces as a typed exception
+(see ps/replication.py for the taxonomy).
+
+Ops:
+  PULL:  ids[n]i64             -> values[n*dim]f32
+  PUSH:  ids[n]i64 grads f32   -> ack        (server-side optimizer step)
+  MERGE: ids[n]i64 deltas f32  -> ack        (geo delta add)
+  ASSIGN:ids[n]i64 values f32  -> ack        (raw overwrite, catch-up)
+  SAVE/LOAD: path bytes[n]     -> ack / ERR_IO
+  ROWS:                        -> count u64
+  SEQ:                         -> [applied_seq u64][epoch u32]
+  KEYS:                        -> [count u64][ids i64...]
+  DIGEST:                      -> sha256(sorted ids + values) 32 bytes
+  DELTA_SINCE / STATE / SNAPSHOT: replication admin (ReplicatedPSServer)
+  BARRIER/STOP/HEARTBEAT:      -> ack
 """
 from __future__ import annotations
 
+import hashlib
+import itertools
+import os
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional, Sequence
+import time
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..fault import injector as _fault
+from ..fault.injector import _bump  # shared lazy counter shim
+from ..fault.retry import Backoff, Retrier, env_backoff, env_max_attempts
 from .table import SparseTable
 
-OP_PULL, OP_PUSH, OP_MERGE, OP_SAVE, OP_LOAD, OP_ROWS, OP_BARRIER, \
-    OP_STOP, OP_HEARTBEAT = range(9)
+(OP_PULL, OP_PUSH, OP_MERGE, OP_SAVE, OP_LOAD, OP_ROWS, OP_BARRIER,
+ OP_STOP, OP_HEARTBEAT, OP_ASSIGN, OP_SEQ, OP_DELTA_SINCE, OP_DIGEST,
+ OP_KEYS, OP_SNAPSHOT, OP_STATE, OP_REPL_APPLY) = range(17)
 
-_HDR = struct.Struct("<BIQf")
+_MAX_OP = OP_REPL_APPLY
+
+_HDR = struct.Struct("<BIQfIIQI")   # op table n lr epoch client seq dim
+_ERR_HDR = struct.Struct("<BII")    # code srv_epoch msg_len
+
+# typed error-frame codes (client maps them to the ps.replication taxonomy)
+(ERR_UNKNOWN_TABLE, ERR_BARRIER_TIMEOUT, ERR_STALE_EPOCH, ERR_NOT_PRIMARY,
+ ERR_LOG_TRUNCATED, ERR_BAD_REQUEST, ERR_IO, ERR_UNSUPPORTED) = range(1, 9)
+
+#: a request larger than these bounds is a malformed/hostile header, not
+#: a real batch — reject before allocating buffers for it (the payload
+#: read is n*dim floats: both factors AND the product must be sane)
+_MAX_IDS = 1 << 28
+_MAX_DIM = 1 << 20
+_MAX_ELEMS = 1 << 28
+#: admin ops (DELTA_SINCE reply cursors, REPL_APPLY entry blobs) carry a
+#: BYTE length in ``n`` — bound it by the largest legal encoded write
+#: (ids + values at the element caps) rather than the ids-count caps, or
+#: a legal large write would forward as a "malformed" frame the backup
+#: rejects, silently breaking the sync-replication ack invariant
+_MAX_BLOB = 8 * _MAX_IDS + 4 * _MAX_ELEMS + 64
+
+
+class WriteRejected(Exception):
+    """Raised by an _apply_write hook to reject an already-drained write
+    with a typed error frame (e.g. a primary that discovered mid-write it
+    was demoted). Internal to the server loop."""
+
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = int(code)
+        self.msg = msg
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -48,12 +125,71 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _send_ok(conn: socket.socket, payload: bytes = b"") -> None:
+    conn.sendall(b"\x01" + payload)
+
+
+def _send_err(conn: socket.socket, code: int, epoch: int, msg: str) -> None:
+    data = msg.encode("utf-8", "replace")
+    conn.sendall(b"\x00" + _ERR_HDR.pack(code, max(0, int(epoch)),
+                                         len(data)) + data)
+
+
+class PSReplyError(Exception):
+    """Wire-level typed error frame from a pserver. Internal: PSClient
+    maps it onto the ps.replication exception taxonomy (or handles it —
+    a stale-epoch frame triggers a shard-map refresh, not a raise)."""
+
+    def __init__(self, code: int, epoch: int, message: str,
+                 endpoint: str = ""):
+        super().__init__(f"[err {code}] {message}")
+        self.code = int(code)
+        self.epoch = int(epoch)
+        self.message = message
+        self.endpoint = endpoint
+
+
+def _read_reply(sock: socket.socket, endpoint: str = "") -> None:
+    """Consume the status byte; raise PSReplyError on an error frame.
+    On OK the caller reads its op-specific payload next."""
+    status = _recv_exact(sock, 1)
+    if status == b"\x01":
+        return
+    code, epoch, mlen = _ERR_HDR.unpack(_recv_exact(sock, _ERR_HDR.size))
+    msg = _recv_exact(sock, mlen).decode("utf-8", "replace")
+    raise PSReplyError(code, epoch, msg, endpoint=endpoint)
+
+
+def table_digest(table: SparseTable) -> bytes:
+    """Deterministic sha256 over (sorted ids, their values): the
+    replica-divergence check. Values only (not optimizer accumulators) so
+    native and python table backends hash identically."""
+    ids = np.sort(table.keys())
+    h = hashlib.sha256()
+    h.update(ids.tobytes())
+    if ids.size:
+        h.update(np.ascontiguousarray(table.pull(ids)).tobytes())
+    return h.digest()
+
+
 class PSServer:
-    """One parameter-server process/thread (listen_and_serv_op parity)."""
+    """One parameter-server process/thread (listen_and_serv_op parity).
+
+    Hardened against misbehaving peers: every reply carries a status
+    byte, an unknown ``table_id`` or a dim mismatch is a typed error
+    frame (the connection thread survives — v1 died on the KeyError with
+    the client blocked forever), a broken barrier replies failure AND
+    resets so one timeout doesn't poison every later barrier, and each
+    connection carries an idle ``request_timeout`` (counter
+    ``ps_conn_timeouts``, mirroring the KVHTTPServer hardening) — safe
+    now that the client transparently reconnects on any socket error.
+    """
 
     def __init__(self, tables: Dict[int, SparseTable], host="127.0.0.1",
                  port: int = 0, num_trainers: int = 1,
-                 heartbeat_timeout_s: float = 120.0):
+                 heartbeat_timeout_s: float = 120.0,
+                 request_timeout: Optional[float] = None,
+                 barrier_timeout_s: float = 60.0):
         from .heartbeat import HeartBeatMonitor
 
         self.tables = tables
@@ -63,9 +199,26 @@ class PSServer:
         self._srv.bind((host, port))
         self._srv.listen(64)
         self.host, self.port = self._srv.getsockname()
+        self.request_timeout = (
+            request_timeout if request_timeout is not None
+            else _env_float("PADDLE_PS_CONN_TIMEOUT", 300.0)) or None
+        self.barrier_timeout_s = float(barrier_timeout_s)
         self._stop = threading.Event()
+        self.crashed = False
         self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
         self._barrier = threading.Barrier(max(num_trainers, 1))
+        self._barrier_lock = threading.Lock()
+        self._applied: Dict[int, int] = {}   # client -> last write seq
+        self._applied_lock = threading.Lock()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        # SystemExit from a fault point (PADDLE_FAULT_SPEC chaos kill)
+        # exits the whole process when this env flag is set — a server
+        # subprocess dies like a real crash; in-process test servers
+        # default to crash() (stop serving, drop connections) instead
+        self._exit_on_crash = os.environ.get(
+            "PADDLE_PS_EXIT_ON_CRASH", "0") not in ("0", "")
 
     @property
     def endpoint(self) -> str:
@@ -75,6 +228,7 @@ class PSServer:
         self.monitor.start()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
+        self._accept_thread = t
         self._threads.append(t)
         return self
 
@@ -87,58 +241,278 @@ class PSServer:
                 continue
             except OSError:
                 break
+            with self._conns_lock:
+                self._conns.add(conn)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
             self._threads.append(t)
 
+    # -- subclass hooks (ps/replication.py ReplicatedPSServer) --------------
+    def _access_error(self, base_op: int, epoch: int):
+        """Role/epoch validation for table data ops; (code, msg) to
+        reject, None to serve. The base server serves everyone.
+        Replication traffic never reaches this: it rides the
+        seq-validated OP_REPL_APPLY admin channel."""
+        return None
+
+    def _apply_write(self, base_op: int, table: SparseTable, table_id: int,
+                     ids: np.ndarray, vals: np.ndarray, lr: float,
+                     client: int, cseq: int, forwarded: bool) -> None:
+        """Apply one write, exactly once per (client, seq): the client's
+        retry loop replays a frame whose ack was lost (connection died
+        between apply and reply), and without dedup a plain server would
+        double-apply the gradient. The replicated subclass wraps this
+        with sequence numbering, the delta log, and primary→backup
+        forwarding (its own dedup runs under the replication lock)."""
+        if client and cseq:
+            with self._applied_lock:
+                if self._applied.get(client, 0) >= cseq:
+                    return           # replayed write: already applied
+        if base_op == OP_PUSH:
+            table.push(ids, vals, lr)
+        elif base_op == OP_MERGE:
+            table.merge_add(ids, vals)
+        else:
+            table.assign(ids, vals)
+        if client and cseq:
+            # watermark advances only AFTER a successful apply: set
+            # earlier, a failed apply would make the client's replay
+            # read as "already applied" and the write would be acked
+            # but never land
+            with self._applied_lock:
+                self._applied[client] = max(
+                    self._applied.get(client, 0), cseq)
+
+    def _admin_reply(self, base_op: int, conn: socket.socket,
+                     table_id: int, n: int, payload: bytes,
+                     epoch: int = 0) -> None:
+        """SEQ/DELTA_SINCE/STATE/SNAPSHOT — replication admin channel.
+        The base server only knows SEQ (always 0: nothing replicated)."""
+        if base_op == OP_SEQ:
+            _send_ok(conn, struct.pack("<QI", 0, 0))
+        else:
+            _send_err(conn, ERR_UNSUPPORTED, 0,
+                      f"op {base_op} needs a ReplicatedPSServer")
+
+    # -- the connection loop ------------------------------------------------
     def _serve(self, conn: socket.socket):
+        if self.request_timeout:
+            conn.settimeout(self.request_timeout)
         try:
             while not self._stop.is_set():
                 hdr = _recv_exact(conn, _HDR.size)
-                op, table_id, n, lr = _HDR.unpack(hdr)
-                if op == OP_STOP:
-                    conn.sendall(b"\x01")
+                op, table_id, n, lr, epoch, client, seq, dim = \
+                    _HDR.unpack(hdr)
+                # no wire-level "trusted" flag: replication traffic is
+                # the OP_REPL_APPLY admin op (seq-validated), so an op
+                # with any reserved bit set is simply malformed — a
+                # flag that exempted role checks would let any client
+                # desync a backup's replication stream
+                base = op
+                oversized = (
+                    n > _MAX_BLOB
+                    if base in (OP_DELTA_SINCE, OP_REPL_APPLY)
+                    else (n > _MAX_IDS or dim > _MAX_DIM
+                          or n * max(dim, 1) > _MAX_ELEMS))
+                if base > _MAX_OP or oversized:
+                    # unparseable header: the stream cannot be resynced —
+                    # reply typed, then drop the connection
+                    _send_err(conn, ERR_BAD_REQUEST, 0,
+                              f"malformed request (op={op}, n={n}, "
+                              f"dim={dim})")
+                    return
+                if base == OP_STOP:
+                    _send_ok(conn)
                     self._stop.set()
                     return
-                if op == OP_HEARTBEAT:
+                if base == OP_HEARTBEAT:
                     # trainer_id rides the table field, status the count
                     self.monitor.update(table_id, int(n))
-                    conn.sendall(b"\x01")
+                    _send_ok(conn)
                     continue
-                if op == OP_BARRIER:
+                if base == OP_BARRIER:
+                    self._serve_barrier(conn, epoch)
+                    continue
+                if base in (OP_SEQ, OP_DELTA_SINCE, OP_STATE, OP_SNAPSHOT,
+                            OP_REPL_APPLY):
+                    # DELTA_SINCE and REPL_APPLY carry n payload bytes
+                    body = (_recv_exact(conn, n)
+                            if base in (OP_DELTA_SINCE, OP_REPL_APPLY)
+                            else b"")
+                    self._admin_reply(base, conn, table_id, n, body,
+                                      epoch=epoch)
+                    continue
+                table = self.tables.get(table_id)
+                if base == OP_PULL:
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                    err = self._table_error(table, table_id, dim, epoch,
+                                            base)
+                    if err:
+                        _send_err(conn, err[0], err[1], err[2])
+                        continue
+                    _send_ok(conn, table.pull(ids).tobytes())
+                elif base in (OP_PUSH, OP_MERGE, OP_ASSIGN):
+                    # drain ids AND values by the client-declared dim
+                    # BEFORE any error reply, so a rejected write leaves
+                    # the stream in sync for the next request
+                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                    raw = _recv_exact(conn, 4 * n * dim)
+                    err = self._table_error(table, table_id, dim, epoch,
+                                            base)
+                    if err:
+                        _send_err(conn, err[0], err[1], err[2])
+                        continue
+                    vals = np.frombuffer(raw, np.float32)
                     try:
-                        self._barrier.wait(timeout=60)
-                    except threading.BrokenBarrierError:
-                        pass
-                    conn.sendall(b"\x01")
-                    continue
-                table = self.tables[table_id]
-                if op == OP_PULL:
-                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
-                    conn.sendall(table.pull(ids).tobytes())
-                elif op in (OP_PUSH, OP_MERGE):
-                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
-                    vals = np.frombuffer(
-                        _recv_exact(conn, 4 * n * table.dim), np.float32)
-                    if op == OP_PUSH:
-                        table.push(ids, vals, lr)
-                    else:
-                        table.merge_add(ids, vals)
-                    conn.sendall(b"\x01")
-                elif op in (OP_SAVE, OP_LOAD):
+                        self._apply_write(base, table, table_id, ids,
+                                          vals, lr, client, seq, False)
+                    except WriteRejected as e:
+                        _send_err(conn, e.code,
+                                  getattr(self, "_epoch", 0), e.msg)
+                        continue
+                    except (ValueError, KeyError, OSError,
+                            RuntimeError) as e:
+                        # a failed apply must reply typed (the client
+                        # replays; the dedup watermark only advances on
+                        # success) — dying here would leave the client
+                        # blocked and the retry silently swallowed
+                        _send_err(conn, ERR_IO,
+                                  getattr(self, "_epoch", 0),
+                                  f"write failed: {e}")
+                        continue
+                    _send_ok(conn)
+                elif base in (OP_SAVE, OP_LOAD):
                     path = _recv_exact(conn, n).decode()
+                    if table is None:
+                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                                  f"unknown table_id {table_id}")
+                        continue
+                    acc = self._access_error(base, epoch)
+                    if acc is not None:
+                        # SAVE/LOAD fence like data ops: a LOAD onto a
+                        # demoted server (or a backup) would mutate
+                        # state outside the replication stream
+                        _send_err(conn, acc[0],
+                                  getattr(self, "_epoch", 0), acc[1])
+                        continue
                     try:
-                        (table.save if op == OP_SAVE else table.load)(path)
-                        conn.sendall(b"\x01")
-                    except IOError:
-                        conn.sendall(b"\x00")
-                elif op == OP_ROWS:
-                    conn.sendall(struct.pack("<Q", table.rows()))
+                        (table.save if base == OP_SAVE else
+                         table.load)(path)
+                        _send_ok(conn)
+                    except (IOError, OSError, ValueError) as e:
+                        _send_err(conn, ERR_IO, 0,
+                                  f"{'save' if base == OP_SAVE else 'load'}"
+                                  f"({path}) failed: {e}")
+                elif base == OP_ROWS:
+                    if table is None:
+                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                                  f"unknown table_id {table_id}")
+                        continue
+                    _send_ok(conn, struct.pack("<Q", table.rows()))
+                elif base == OP_KEYS:
+                    if table is None:
+                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                                  f"unknown table_id {table_id}")
+                        continue
+                    keys = np.sort(table.keys())
+                    _send_ok(conn, struct.pack("<Q", keys.size)
+                             + keys.tobytes())
+                elif base == OP_DIGEST:
+                    if table is None:
+                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                                  f"unknown table_id {table_id}")
+                        continue
+                    _send_ok(conn, table_digest(table))
+                else:
+                    _send_err(conn, ERR_BAD_REQUEST, 0,
+                              f"unhandled op {base}")
+                    return
+        except socket.timeout:
+            # idle/stalled peer: close its connection, count it —
+            # the hardened client reconnects transparently on next use
+            _bump("ps_conn_timeouts")
         except (ConnectionError, OSError):
             pass
+        except SystemExit:
+            # a chaos fault point (PADDLE_FAULT_SPEC ... :SystemExit)
+            # fired inside a handler: die like a crashed pserver.
+            # _exit FIRST — crash() sets the stop event, and the main
+            # thread's join() would win the race and exit 0 (a "clean"
+            # death the supervisor would never relaunch)
+            if self._exit_on_crash:
+                os._exit(17)
+            self.crash()
         finally:
-            conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _table_error(self, table, table_id: int, dim: Optional[int],
+                     epoch: int, base_op: int):
+        if table is None:
+            return (ERR_UNKNOWN_TABLE, 0,
+                    f"unknown table_id {table_id} on {self.endpoint} "
+                    f"(serving {sorted(self.tables)})")
+        if dim is not None and dim != table.dim:
+            return (ERR_BAD_REQUEST, 0,
+                    f"dim mismatch for table {table_id}: client sent "
+                    f"{dim}, table is {table.dim}-wide")
+        acc = self._access_error(base_op, epoch)
+        if acc is not None:
+            code, msg = acc
+            return (code, getattr(self, "_epoch", 0), msg)
+        return None
+
+    def _serve_barrier(self, conn: socket.socket, epoch: int) -> None:
+        """Bounded barrier: a timeout/broken barrier replies a TYPED
+        failure (v1 acked success) and resets the barrier so the next
+        round starts clean instead of inheriting the broken state."""
+        try:
+            self._barrier.wait(timeout=self.barrier_timeout_s)
+        except threading.BrokenBarrierError:
+            with self._barrier_lock:
+                if self._barrier.broken:
+                    self._barrier.reset()
+            _send_err(conn, ERR_BARRIER_TIMEOUT, epoch,
+                      f"barrier on {self.endpoint} timed out after "
+                      f"{self.barrier_timeout_s}s (or was broken by a "
+                      "peer timeout) — barrier has been reset")
+            return
+        _send_ok(conn)
+
+    def crash(self):
+        """Simulate this pserver's process dying, in-process: stop
+        accepting, sever every live connection mid-whatever, stop
+        renewing liveness — clients see raw socket errors, exactly like
+        a SIGKILL'd server. The chaos-drill seam (no graceful replies)."""
+        self.crashed = True
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.monitor.stop()
+        self._join_acceptor()
+
+    def _join_acceptor(self):
+        """CPython defers the real close of the listening fd while the
+        accept thread is blocked in accept(); join it (bounded by its
+        0.2s accept timeout) so the port is actually free when
+        crash()/stop() return — a relaunch rebinds deterministically."""
+        t = self._accept_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=1.0)
 
     def stop(self):
         self._stop.set()
@@ -147,48 +521,320 @@ class PSServer:
             self._srv.close()
         except OSError:
             pass
+        self._join_acceptor()
 
     def join(self, timeout: Optional[float] = None):
         self._stop.wait(timeout)
 
 
+def _fresh_client_id() -> int:
+    """Random nonzero 32-bit write identity. It must be unique across
+    HOSTS and trainer RESTARTS: pids collide in containers (everything
+    is pid 1) and a relaunched trainer restarts its write seq at 1 — a
+    reused id would collide with the server's persisted high watermark
+    and every replayed-looking write would be silently dropped."""
+    while True:
+        cid = int.from_bytes(os.urandom(4), "little")
+        if cid:
+            return cid
+
+
 class PSClient:
     """Trainer-side client: shards ids across endpoints by hash
-    (parameter_send.cc splits param blocks the same way)."""
+    (parameter_send.cc splits param blocks the same way).
 
-    def __init__(self, endpoints: Sequence[str]):
-        self._eps = list(endpoints)
+    Fault-tolerant revision: every RPC runs with socket deadlines
+    (``PADDLE_PS_RPC_TIMEOUT``), passes a named fault point
+    (``ps.pull`` / ``ps.push`` / ``ps.barrier`` / ``ps.save``), retries
+    transient socket failures with the repo-wide backoff policy
+    (counters ``ps_rpc_retries`` + ``retry_attempts``), and exits TYPED:
+    :class:`~paddle_tpu.ps.replication.PSUnavailable` naming the
+    endpoint and shard when a server stays unreachable,
+    :class:`~paddle_tpu.ps.replication.ShardMapStale` when the shard map
+    can't catch up to the epoch a server demands, TimeoutError naming
+    the endpoint on a barrier timeout. A failed RPC always DROPS its
+    socket — v1 cached the half-written stream and the next call read
+    garbage from the desynced connection.
+
+    Replicated mode (``kv=`` + ``job=``): endpoints come from the
+    epoch-versioned shard map published in the coordination KV store;
+    on a primary failure the client refreshes the map (bounded), fails
+    over to the promoted backup, and REPLAYS the in-flight request —
+    write frames carry (client, seq) so a replay of an update the dead
+    primary already replicated is deduplicated server-side, never
+    double-applied. Counter: ``ps_failovers``.
+    """
+
+    def __init__(self, endpoints: Optional[Sequence[str]] = None, *,
+                 kv=None, job: str = "ps",
+                 rpc_timeout: Optional[float] = None,
+                 connect_timeout: float = 5.0,
+                 max_attempts: Optional[int] = None,
+                 failover_timeout: float = 30.0,
+                 client_id: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        from ..distributed.http_kv import KVClient
+        from .replication import fetch_shard_map
+
+        if endpoints is None and kv is None:
+            raise ValueError("PSClient needs endpoints= or kv=")
+        self._kv = (KVClient(kv, sleep=sleep) if isinstance(kv, str)
+                    else kv)
+        self._job = str(job)
+        self._clock = clock
+        self._sleep = sleep
+        self._connect_timeout = float(connect_timeout)
+        self._rpc_timeout = (rpc_timeout if rpc_timeout is not None
+                             else _env_float("PADDLE_PS_RPC_TIMEOUT", 30.0))
+        self._failover_timeout = float(failover_timeout)
+        self._max_attempts = (max_attempts if max_attempts is not None
+                              else env_max_attempts(3))
+        # the repo-wide retry policy object: transient socket failures
+        # only — typed error frames (PSReplyError) are verdicts, never
+        # blind-retried
+        self._retrier = Retrier(
+            max_attempts=self._max_attempts,
+            retry_on=(ConnectionError, OSError),
+            backoff=env_backoff(0.05, 1.0), sleep=sleep, name="ps")
+        self._map = None
+        if endpoints is not None:
+            self._eps = list(endpoints)
+            self._epoch = 0
+        else:
+            self._map = fetch_shard_map(self._kv, self._job)
+            if self._map is None:
+                from .replication import wait_shard_map
+                self._map = wait_shard_map(
+                    self._kv, self._job, timeout=self._failover_timeout,
+                    clock=clock, sleep=sleep)
+            self._eps = [g[0] for g in self._map.groups]
+            self._epoch = self._map.epoch
         self._socks: List[Optional[socket.socket]] = [None] * len(self._eps)
         self._locks = [threading.Lock() for _ in self._eps]
+        self._client_id = int(client_id if client_id is not None
+                              else _fresh_client_id())
+        self._wseq = itertools.count(1)
+        self._wseq_lock = threading.Lock()
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        self._hb_error: Optional[BaseException] = None
 
+    # -- topology -----------------------------------------------------------
+    @property
+    def endpoints(self) -> List[str]:
+        return list(self._eps)
+
+    @property
+    def epoch(self) -> int:
+        """Shard-map epoch this client is acting in (0 = static mode)."""
+        return self._epoch
+
+    @property
+    def replicated(self) -> bool:
+        return self._kv is not None
+
+    def _adopt_map(self, m) -> None:
+        if m.num_shards != len(self._eps):
+            raise ValueError(
+                f"shard map epoch {m.epoch} has {m.num_shards} shards, "
+                f"client was built for {len(self._eps)} — shard count is "
+                "fixed for a job's lifetime")
+        self._map, self._epoch = m, m.epoch
+        for k, group in enumerate(m.groups):
+            if group[0] != self._eps[k]:
+                self._eps[k] = group[0]
+                self._drop(k)
+
+    def refresh_shard_map(self, min_epoch: int = 0,
+                          timeout: Optional[float] = None) -> int:
+        """Re-read the shard map, blocking (bounded) until its epoch is
+        at least ``min_epoch``; returns the adopted epoch. Raises
+        ShardMapStale when the map can't catch up in time."""
+        from .replication import wait_shard_map
+
+        if self._kv is None:
+            from .replication import ShardMapStale
+            raise ShardMapStale(
+                "static-endpoint PSClient has no shard map to refresh",
+                expected_epoch=min_epoch, observed=self._epoch)
+        m = wait_shard_map(
+            self._kv, self._job, min_epoch=min_epoch,
+            timeout=self._failover_timeout if timeout is None else timeout,
+            clock=self._clock, sleep=self._sleep)
+        self._adopt_map(m)
+        return self._epoch
+
+    # -- sockets ------------------------------------------------------------
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
             host, port = self._eps[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=30)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self._connect_timeout)
+            s.settimeout(self._rpc_timeout or None)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
         return self._socks[i]
 
+    def _drop(self, i: int) -> None:
+        s = self._socks[i]
+        self._socks[i] = None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- core exchange ------------------------------------------------------
     def _shard(self, ids: np.ndarray):
         srv = (ids * np.int64(0x9E3779B1) % np.int64(2**31)) % len(self._eps)
         return [np.nonzero(srv == k)[0] for k in range(len(self._eps))]
 
+    def _next_wseq(self) -> int:
+        with self._wseq_lock:
+            return next(self._wseq)
+
+    def _frame(self, op: int, table_id: int, n: int, lr: float,
+               dim: int, seq: int, payload: bytes) -> bytes:
+        return _HDR.pack(op, table_id, n, lr, self._epoch,
+                         self._client_id, seq, dim) + payload
+
+    def _exchange_once(self, k: int, frame: bytes, reader, fp_name: str):
+        _fault.point(fp_name)
+        s = self._sock(k)
+        try:
+            s.sendall(frame)
+            _read_reply(s, endpoint=self._eps[k])
+            return reader(s) if reader is not None else None
+        except PSReplyError:
+            raise          # semantic error frame: stream is still in sync
+        except (ConnectionError, OSError):
+            # any transport failure poisons the stream: drop the socket
+            # so the retry/replay runs on a fresh connection
+            self._drop(k)
+            raise
+
+    def _exchange(self, k: int, frame: bytes, reader, fp_name: str,
+                  retry: bool = True):
+        """One RPC through the repo ``fault.Retrier`` (its counters
+        ``retry_attempts``/``retry_giveups`` plus the PS-scoped
+        ``ps_rpc_retries`` per re-attempt); transport exhaustion exits
+        typed as PSUnavailable naming the endpoint and shard."""
+        from .replication import PSUnavailable
+
+        first = True
+
+        def once():
+            nonlocal first
+            if not first:
+                _bump("ps_rpc_retries")
+            first = False
+            return self._exchange_once(k, frame, reader, fp_name)
+
+        try:
+            return self._retrier.call(once) if retry else once()
+        except PSReplyError:
+            raise
+        except (ConnectionError, OSError) as e:
+            attempts = self._retrier.max_attempts if retry else 1
+            raise PSUnavailable(
+                f"pserver {self._eps[k]} (shard {k}) unreachable after "
+                f"{attempts} attempt(s): {e!r}",
+                endpoint=self._eps[k], shard=k) from e
+
+    def _shard_call(self, k: int, build, reader, fp_name: str,
+                    retry: bool = True, failover: bool = True):
+        """One logical RPC against shard ``k``: ``build()`` re-packs the
+        frame with the CURRENT epoch (the write seq inside it is fixed,
+        so a replay after failover dedups server-side). Chases at most a
+        few promotions before giving up typed."""
+        from .replication import (PSRequestError, PSUnavailable,
+                                  ShardMapStale)
+
+        with self._locks[k]:
+            for _hop in range(4):
+                try:
+                    return self._exchange(k, build(), reader, fp_name,
+                                          retry=retry)
+                except PSReplyError as e:
+                    if e.code in (ERR_STALE_EPOCH, ERR_NOT_PRIMARY) \
+                            and self.replicated:
+                        # the server is ahead (promotion happened) or we
+                        # reached a demoted backup: adopt the newer map
+                        # and replay against the current primary
+                        self._drop(k)
+                        self.refresh_shard_map(
+                            min_epoch=max(e.epoch, self._epoch + 1))
+                        continue
+                    if e.code == ERR_STALE_EPOCH:
+                        raise ShardMapStale(
+                            f"pserver {self._eps[k]} is at epoch "
+                            f"{e.epoch}, this client at {self._epoch} "
+                            "with no shard map to refresh",
+                            expected_epoch=e.epoch,
+                            observed=self._epoch) from e
+                    if e.code == ERR_BARRIER_TIMEOUT:
+                        raise TimeoutError(
+                            f"ps barrier timed out at {self._eps[k]}: "
+                            f"{e.message}") from e
+                    raise PSRequestError(
+                        f"pserver {self._eps[k]} rejected the request: "
+                        f"{e.message}", code=e.code,
+                        endpoint=self._eps[k]) from e
+                except PSUnavailable as e:
+                    if self.replicated and failover:
+                        self._failover(k, e)
+                        continue
+                    raise
+            raise ShardMapStale(
+                f"shard {k} kept moving (epoch now {self._epoch}) — "
+                "gave up chasing promotions",
+                expected_epoch=self._epoch + 1, observed=self._epoch)
+
+    def _failover(self, k: int, cause: BaseException) -> None:
+        """Primary for shard ``k`` is gone: wait (bounded) for the
+        coordinator to publish a map that moves the shard off the dead
+        endpoint, adopt it, and let the caller replay."""
+        from .replication import PSUnavailable, fetch_shard_map
+
+        _bump("ps_failovers")
+        dead = self._eps[k]
+        deadline = self._clock() + self._failover_timeout
+        backoff = Backoff(base=0.05, factor=1.5, cap=1.0, jitter=0.25)
+        attempt = 0
+        while True:
+            m = fetch_shard_map(self._kv, self._job)
+            if m is not None and (m.epoch > self._epoch
+                                  or m.groups[k][0] != dead):
+                self._adopt_map(m)
+                return
+            if self._clock() >= deadline:
+                raise PSUnavailable(
+                    f"pserver {dead} (shard {k}) died and no promotion "
+                    f"was published within {self._failover_timeout}s",
+                    endpoint=dead, shard=k) from cause
+            self._sleep(min(backoff.delay(attempt),
+                            max(0.0, deadline - self._clock())))
+            attempt += 1
+
+    # -- data-plane API -----------------------------------------------------
     def pull(self, table_id: int, ids, dim: int) -> np.ndarray:
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         out = np.empty((ids.size, dim), np.float32)
         for k, sel in enumerate(self._shard(ids)):
             if sel.size == 0:
                 continue
-            with self._locks[k]:
-                s = self._sock(k)
-                s.sendall(_HDR.pack(OP_PULL, table_id, sel.size, 0.0))
-                s.sendall(ids[sel].tobytes())
-                vals = np.frombuffer(
-                    _recv_exact(s, 4 * sel.size * dim),
-                    np.float32).reshape(sel.size, dim)
-            out[sel] = vals
+            payload = ids[sel].tobytes()
+
+            def build(k=k, sel=sel, payload=payload):
+                return self._frame(OP_PULL, table_id, sel.size, 0.0,
+                                   dim, 0, payload)
+
+            raw = self._shard_call(
+                k, build,
+                lambda s, m=4 * sel.size * dim: _recv_exact(s, m),
+                "ps.pull")
+            out[sel] = np.frombuffer(raw, np.float32).reshape(sel.size, dim)
         return out
 
     def _send_vals(self, op: int, table_id: int, ids, vals, dim: int,
@@ -198,12 +844,21 @@ class PSClient:
         for k, sel in enumerate(self._shard(ids)):
             if sel.size == 0:
                 continue
-            with self._locks[k]:
-                s = self._sock(k)
-                s.sendall(_HDR.pack(op, table_id, sel.size, lr))
-                s.sendall(ids[sel].tobytes())
-                s.sendall(vals[sel].tobytes())
-                _recv_exact(s, 1)
+            payload = ids[sel].tobytes() + vals[sel].tobytes()
+            # seq is drawn on the FIRST build() call — inside the shard
+            # lock — so allocation order matches send order: drawing it
+            # out here would let a concurrent pusher send a higher seq
+            # first and the server's high-watermark dedup silently drop
+            # this write as a "replay". Fixed across failover replays.
+            state = {"seq": None}
+
+            def build(k=k, sel=sel, payload=payload, state=state):
+                if state["seq"] is None:
+                    state["seq"] = self._next_wseq()
+                return self._frame(op, table_id, sel.size, lr, dim,
+                                   state["seq"], payload)
+
+            self._shard_call(k, build, None, "ps.push")
 
     def push(self, table_id: int, ids, grads, dim: int, lr: float):
         self._send_vals(OP_PUSH, table_id, ids, grads, dim, lr)
@@ -211,59 +866,144 @@ class PSClient:
     def merge_add(self, table_id: int, ids, deltas, dim: int):
         self._send_vals(OP_MERGE, table_id, ids, deltas, dim, 0.0)
 
+    def assign(self, table_id: int, ids, values, dim: int):
+        self._send_vals(OP_ASSIGN, table_id, ids, values, dim, 0.0)
+
     def rows(self, table_id: int) -> int:
         total = 0
         for k in range(len(self._eps)):
-            with self._locks[k]:
-                s = self._sock(k)
-                s.sendall(_HDR.pack(OP_ROWS, table_id, 0, 0.0))
-                total += struct.unpack("<Q", _recv_exact(s, 8))[0]
+            def build(k=k):
+                return self._frame(OP_ROWS, table_id, 0, 0.0, 0, 0, b"")
+
+            raw = self._shard_call(k, build,
+                                   lambda s: _recv_exact(s, 8), "ps.pull")
+            total += struct.unpack("<Q", raw)[0]
         return total
+
+    def keys(self, table_id: int, shard: int) -> np.ndarray:
+        """All ids held by one shard (replication catch-up / tooling)."""
+        def build():
+            return self._frame(OP_KEYS, table_id, 0, 0.0, 0, 0, b"")
+
+        def read(s):
+            count = struct.unpack("<Q", _recv_exact(s, 8))[0]
+            return np.frombuffer(_recv_exact(s, 8 * count), np.int64)
+
+        return self._shard_call(shard, build, read, "ps.pull")
 
     def save(self, table_id: int, path_prefix: str):
         for k in range(len(self._eps)):
             p = f"{path_prefix}.shard{k}".encode()
-            with self._locks[k]:
-                s = self._sock(k)
-                s.sendall(_HDR.pack(OP_SAVE, table_id, len(p), 0.0))
-                s.sendall(p)
-                if _recv_exact(s, 1) != b"\x01":
-                    raise IOError(f"save failed on {self._eps[k]}")
+
+            def build(k=k, p=p):
+                return self._frame(OP_SAVE, table_id, len(p), 0.0, 0,
+                                   0, p)
+
+            self._shard_call(k, build, None, "ps.save")
+
+    def snapshot_shards(self, timeout: Optional[float] = None) -> List[int]:
+        """Ask every shard's primary to commit a crash-safe SnapshotStore
+        snapshot of its tables (ReplicatedPSServer only). Returns the
+        committed sequence number per shard."""
+        seqs = []
+        for k in range(len(self._eps)):
+            def build(k=k):
+                return self._frame(OP_SNAPSHOT, 0, 0, 0.0, 0, 0, b"")
+
+            raw = self._shard_call(k, build,
+                                   lambda s: _recv_exact(s, 8), "ps.save")
+            seqs.append(struct.unpack("<Q", raw)[0])
+        return seqs
+
+    def shard_seq(self, shard: int):
+        """(applied_seq, epoch) of one shard's server — replication lag /
+        catch-up introspection."""
+        def build():
+            return self._frame(OP_SEQ, 0, 0, 0.0, 0, 0, b"")
+
+        raw = self._shard_call(shard, build,
+                               lambda s: _recv_exact(s, 12), "ps.pull",
+                               failover=False)
+        return struct.unpack("<QI", raw)
 
     def barrier(self):
+        """All-trainer barrier on every pserver. Single attempt per
+        endpoint (a blind retry would double-count this trainer and
+        desync the barrier for everyone); a timed-out barrier raises
+        TimeoutError NAMING the endpoint — and the server has reset the
+        barrier, so the next round starts clean."""
+        errors: List[BaseException] = []
+
         def one(k):
-            with self._locks[k]:
-                s = self._sock(k)
-                s.sendall(_HDR.pack(OP_BARRIER, 0, 0, 0.0))
-                _recv_exact(s, 1)
+            try:
+                def build():
+                    return self._frame(OP_BARRIER, 0, 0, 0.0, 0, 0, b"")
+
+                self._shard_call(k, build, None, "ps.barrier",
+                                 retry=False, failover=False)
+            except BaseException as e:   # noqa: B036 (re-raised below)
+                errors.append(e)
+
         threads = [threading.Thread(target=one, args=(k,))
                    for k in range(len(self._eps))]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise errors[0]
 
+    # -- liveness -----------------------------------------------------------
     def heartbeat(self, trainer_id: int, status: int = 0):
         """Beat every pserver (reference HeartbeatRPC; status 0=running,
         1=completed — see ps/heartbeat.py)."""
         for k in range(len(self._eps)):
-            with self._locks[k]:
-                s = self._sock(k)
-                s.sendall(_HDR.pack(OP_HEARTBEAT, trainer_id, status, 0.0))
-                _recv_exact(s, 1)
+            def build(k=k):
+                return self._frame(OP_HEARTBEAT, trainer_id, status,
+                                   0.0, 0, 0, b"")
+
+            self._shard_call(k, build, None, "ps.heartbeat", retry=False,
+                             failover=False)
+
+    @property
+    def heartbeat_error(self) -> Optional[BaseException]:
+        """Last parked beat failure (None while beats land). A beat loop
+        never dies silently — it backs off and keeps trying."""
+        return self._hb_error
 
     def start_heartbeat(self, trainer_id: int, interval_s: float = 10.0):
-        """Background beat thread (the reference Communicator's send
-        thread beats as a side effect; here it is explicit)."""
-        if self._hb_thread is not None:
+        """Background beat thread. The loop retries with capped
+        exponential backoff on transient failures instead of silently
+        exiting on the first ConnectionError — a dead beat thread gets
+        the trainer flagged lost by the pserver monitor even though the
+        trainer is healthy (the PR 7 elastic lesson). Errors park on
+        ``heartbeat_error`` and clear on the next successful beat."""
+        if self._hb_thread is not None and self._hb_thread.is_alive():
             return
+        backoff = Backoff(base=min(1.0, interval_s), factor=1.5,
+                          cap=max(interval_s, 1.0), jitter=0.25)
 
         def loop():
-            while not self._hb_stop.wait(interval_s):
+            fails = 0
+            while True:
+                delay = (interval_s if fails == 0
+                         else backoff.delay(fails - 1))
+                if self._hb_stop.wait(delay):
+                    return
                 try:
                     self.heartbeat(trainer_id)
-                except (ConnectionError, OSError):
-                    return
+                    fails = 0
+                    self._hb_error = None
+                except (ConnectionError, OSError) as e:
+                    fails += 1
+                    self._hb_error = e
+                    _bump("ps_rpc_retries")
+                except BaseException as e:  # noqa: B036 (parked, typed)
+                    # typed verdicts (PSUnavailable after retries, ...)
+                    # park too: the beat loop survives a failover window
+                    # and resumes against the promoted primary
+                    fails += 1
+                    self._hb_error = e
 
         self.heartbeat(trainer_id)
         self._hb_thread = threading.Thread(target=loop, daemon=True)
@@ -279,24 +1019,21 @@ class PSClient:
         if trainer_id is not None and completed:
             try:
                 self.heartbeat(trainer_id, status=1)
-            except (ConnectionError, OSError):
+            except BaseException:  # noqa: B036 (best-effort farewell)
                 pass
 
+    # -- lifecycle ----------------------------------------------------------
     def stop_servers(self):
         for k in range(len(self._eps)):
             try:
-                with self._locks[k]:
-                    s = self._sock(k)
-                    s.sendall(_HDR.pack(OP_STOP, 0, 0, 0.0))
-                    _recv_exact(s, 1)
-            except (ConnectionError, OSError):
+                def build(k=k):
+                    return self._frame(OP_STOP, 0, 0, 0.0, 0, 0, b"")
+
+                self._shard_call(k, build, None, "ps.stop", retry=False,
+                                 failover=False)
+            except BaseException:  # noqa: B036 (best-effort shutdown)
                 pass
 
     def close(self):
-        for s in self._socks:
-            if s is not None:
-                try:
-                    s.close()
-                except OSError:
-                    pass
-        self._socks = [None] * len(self._eps)
+        for k in range(len(self._socks)):
+            self._drop(k)

@@ -96,10 +96,13 @@ class SparseTable:
 
     # -- API -----------------------------------------------------------------
     def pull(self, ids) -> np.ndarray:
+        # native branch locks too: clear() swaps the C handle, and a
+        # pull racing it would execute against freed memory
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         out = np.empty((ids.size, self.dim), np.float32)
         if self._lib is not None:
-            self._lib.kv_pull(self._h, ids, ids.size, out)
+            with self._lock:
+                self._lib.kv_pull(self._h, ids, ids.size, out)
             return out
         with self._lock:
             for k, i in enumerate(ids):
@@ -111,7 +114,9 @@ class SparseTable:
         grads = np.ascontiguousarray(grads, np.float32).reshape(
             ids.size, self.dim)
         if self._lib is not None:
-            self._lib.kv_push(self._h, ids, ids.size, grads, float(lr))
+            with self._lock:
+                self._lib.kv_push(self._h, ids, ids.size, grads,
+                                  float(lr))
             return
         with self._lock:
             for k, i in enumerate(ids):
@@ -129,7 +134,8 @@ class SparseTable:
         values = np.ascontiguousarray(values, np.float32).reshape(
             ids.size, self.dim)
         if self._lib is not None:
-            self._lib.kv_assign(self._h, ids, ids.size, values)
+            with self._lock:
+                self._lib.kv_assign(self._h, ids, ids.size, values)
             return
         with self._lock:
             for k, i in enumerate(ids):
@@ -141,7 +147,8 @@ class SparseTable:
         deltas = np.ascontiguousarray(deltas, np.float32).reshape(
             ids.size, self.dim)
         if self._lib is not None:
-            self._lib.kv_merge_add(self._h, ids, ids.size, deltas)
+            with self._lock:
+                self._lib.kv_merge_add(self._h, ids, ids.size, deltas)
             return
         with self._lock:
             for k, i in enumerate(ids):
@@ -149,9 +156,10 @@ class SparseTable:
 
     def keys(self) -> np.ndarray:
         if self._lib is not None:
-            n = self.rows()
-            out = np.empty(max(n, 1), np.int64)
-            got = self._lib.kv_keys(self._h, out, out.size)
+            with self._lock:   # one lock scope: rows() would re-lock
+                n = int(self._lib.kv_rows(self._h))
+                out = np.empty(max(n, 1), np.int64)
+                got = self._lib.kv_keys(self._h, out, out.size)
             return out[:got]
         with self._lock:
             return np.fromiter(self._rows.keys(), np.int64,
@@ -159,13 +167,29 @@ class SparseTable:
 
     def rows(self) -> int:
         if self._lib is not None:
-            return int(self._lib.kv_rows(self._h))
+            with self._lock:
+                return int(self._lib.kv_rows(self._h))
         return len(self._rows)
+
+    def clear(self):
+        """Drop every materialized row (replication full-state transfer
+        replaces the table rather than merging into it — a stale row the
+        source never held must not survive the sync). The native branch
+        swaps the C handle under the lock: a concurrent pull/digest on
+        the just-destroyed handle would be a use-after-free."""
+        with self._lock:
+            if self._lib is not None:
+                self._lib.kv_destroy(self._h)
+                self._h = self._lib.kv_create(self.dim, self.optimizer,
+                                              self.init_range, self.seed)
+                return
+            self._rows.clear()
 
     def save(self, path: str):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if self._lib is not None:
-            rc = self._lib.kv_save(self._h, path.encode())
+            with self._lock:
+                rc = self._lib.kv_save(self._h, path.encode())
             if rc != 0:
                 raise IOError(f"kv_save({path}) failed rc={rc}")
             return
@@ -179,7 +203,8 @@ class SparseTable:
 
     def load(self, path: str):
         if self._lib is not None:
-            rc = self._lib.kv_load(self._h, path.encode())
+            with self._lock:
+                rc = self._lib.kv_load(self._h, path.encode())
             if rc != 0:
                 raise IOError(f"kv_load({path}) failed rc={rc}")
             return

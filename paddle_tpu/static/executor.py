@@ -336,7 +336,8 @@ class Executor:
         snap = profiler.counters_snapshot()
         for name in (profiler.FAULT_COUNTER_NAMES
                      + profiler.COMPILE_COUNTER_NAMES
-                     + profiler.ELASTIC_COUNTER_NAMES):
+                     + profiler.ELASTIC_COUNTER_NAMES
+                     + profiler.PS_COUNTER_NAMES):
             if name in snap:
                 out[name] = snap[name]
         return out

@@ -1,0 +1,873 @@
+"""ISSUE 8: fault-tolerant parameter server — replica groups, shard-map
+epochs, typed client errors, crash-safe shard recovery, and the
+kill-a-primary chaos drill.
+
+Everything here is tier-1 fast: in-process servers on loopback sockets,
+fault injection via paddle_tpu.fault, fake clocks on every bounded wait
+that matters, and real sleeps only for sub-second lease expiries. The
+one subprocess test is the deterministic chaos drill
+(tools/chaos_drill.py --ps as a library), whose wall clock is dominated
+by two pserver imports."""
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.distributed.http_kv import KVClient, KVServer
+from paddle_tpu.fault import injector as fault
+from paddle_tpu.ps.replication import (
+    DeltaLog, PSRequestError, PSUnavailable, ReplicaCoordinator,
+    ReplicaDiverged, ReplicatedPSServer, ShardMap, ShardMapStale,
+    _RawPeer, fetch_shard_map, local_digest, publish_shard_map,
+    verify_replicas, wait_shard_map,
+)
+from paddle_tpu.ps.service import (
+    ERR_BAD_REQUEST, OP_PUSH, PSClient, PSServer, _ERR_HDR, _HDR,
+    _recv_exact,
+)
+from paddle_tpu.ps.table import SparseTable
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _counters():
+    return profiler.counters_snapshot()
+
+
+def _delta(before, name):
+    return _counters().get(name, 0) - before.get(name, 0)
+
+
+@pytest.fixture
+def kv():
+    srv = KVServer(_free_port())
+    srv.start()
+    client = KVClient(f"127.0.0.1:{srv.http_server.server_address[1]}")
+    yield client
+    srv.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.disarm_all()
+    yield
+    fault.disarm_all()
+
+
+def _table(dim=4):
+    return SparseTable(dim, init_range=0.0, seed=1)
+
+
+def _mk_pair(kv, job="j", sync=True, lease_a=10.0, lease_b=10.0,
+             snap_a=None, snap_b=None, snapshot_every=0):
+    """Replicated 2-replica group: A primary + B backup, map published."""
+    pa, pb = _free_port(), _free_port()
+    coord = ReplicaCoordinator(kv, job=job, lease_ttl=min(lease_a, lease_b),
+                               boot_grace=60.0)
+    coord.publish([[f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]], sync=sync)
+    a = ReplicatedPSServer({0: _table()}, kv, job=job, port=pa,
+                           lease_ttl=lease_a, snapshot_dir=snap_a,
+                           snapshot_every=snapshot_every).start()
+    b = ReplicatedPSServer({0: _table()}, kv, job=job, port=pb,
+                           lease_ttl=lease_b, snapshot_dir=snap_b,
+                           snapshot_every=snapshot_every).start()
+    return coord, a, b
+
+
+IDS = np.arange(20, dtype=np.int64)
+ONES = np.ones((20, 4), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# shard map
+# ---------------------------------------------------------------------------
+
+def test_shard_map_roundtrip_and_roles():
+    m = ShardMap([["a:1", "b:1"], ["c:1"]], epoch=3, sync=False, job="x")
+    m2 = ShardMap.from_json(m.to_json())
+    assert m2.groups == m.groups and m2.epoch == 3 and not m2.sync
+    assert m2.primary(0) == "a:1" and m2.backups(0) == ["b:1"]
+    assert m2.role_of("b:1") == ("backup", 0)
+    assert m2.role_of("c:1") == ("primary", 1)
+    assert m2.role_of("zz:9") == (None, -1)
+    with pytest.raises(ValueError):
+        ShardMap([["a:1"]], epoch=0)       # epochs start at 1
+    with pytest.raises(ValueError):
+        ShardMap([[]])
+
+
+def test_publish_fetch_epoch_ordering(kv):
+    assert fetch_shard_map(kv, "j") is None
+    publish_shard_map(kv, ShardMap([["a:1"]], epoch=1, job="j"))
+    publish_shard_map(kv, ShardMap([["b:1"]], epoch=2, job="j"))
+    m = fetch_shard_map(kv, "j")
+    assert m.epoch == 2 and m.primary(0) == "b:1"
+
+
+def test_wait_shard_map_timeout_typed(kv):
+    t = [0.0]
+    with pytest.raises(ShardMapStale) as ei:
+        wait_shard_map(kv, "j", min_epoch=5, timeout=2.0,
+                       clock=lambda: t[0],
+                       sleep=lambda d: t.__setitem__(0, t[0] + max(d, .1)))
+    assert ei.value.expected_epoch == 5 and ei.value.observed == -1
+    publish_shard_map(kv, ShardMap([["a:1"]], epoch=3, job="j"))
+    t[0] = 0.0
+    with pytest.raises(ShardMapStale) as ei:
+        wait_shard_map(kv, "j", min_epoch=5, timeout=2.0,
+                       clock=lambda: t[0],
+                       sleep=lambda d: t.__setitem__(0, t[0] + max(d, .1)))
+    assert ei.value.observed == 3
+
+
+# ---------------------------------------------------------------------------
+# hardened wire protocol (satellites: barrier, unknown table, timeouts)
+# ---------------------------------------------------------------------------
+
+def test_barrier_timeout_typed_and_reset():
+    srv = PSServer({0: _table()}, num_trainers=2,
+                   barrier_timeout_s=0.2).start()
+    c = PSClient([srv.endpoint])
+    try:
+        with pytest.raises(TimeoutError) as ei:
+            c.barrier()          # only 1 of 2 trainers: must time out
+        assert srv.endpoint in str(ei.value)
+        # the barrier was RESET: a full 2-party round now succeeds
+        # (v1 left it broken — every later barrier acked instantly
+        # while synchronizing nothing)
+        c2 = PSClient([srv.endpoint])
+        errs = []
+
+        def one(cl):
+            try:
+                cl.barrier()
+            except BaseException as e:  # noqa: B036
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, args=(cl,)) for cl in (c, c2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert errs == []
+        c2.close()
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_unknown_table_typed_connection_survives():
+    srv = PSServer({0: _table()}).start()
+    c = PSClient([srv.endpoint])
+    try:
+        with pytest.raises(PSRequestError) as ei:
+            c.pull(99, IDS, 4)
+        assert "unknown table_id 99" in str(ei.value)
+        with pytest.raises(PSRequestError):
+            c.push(99, IDS, ONES, 4, lr=0.1)   # value payload drained too
+        # same connection still serves — v1 killed the thread on the
+        # KeyError and the client hung forever on the next reply
+        c.push(0, IDS, ONES, 4, lr=0.25)
+        np.testing.assert_allclose(c.pull(0, IDS, 4), -0.25)
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_dim_mismatch_typed():
+    srv = PSServer({0: _table(4)}).start()
+    c = PSClient([srv.endpoint])
+    try:
+        with pytest.raises(PSRequestError, match="dim mismatch"):
+            c.push(0, IDS, np.ones((20, 8), np.float32), 8, lr=0.1)
+        # pulls validate too: a wrong dim used to silently return
+        # garbage (and desync the stream on the unread remainder)
+        with pytest.raises(PSRequestError, match="dim mismatch"):
+            c.pull(0, IDS, 8)
+        np.testing.assert_allclose(c.pull(0, IDS, 4), 0.0)
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_plain_server_dedups_retried_write():
+    """The hardened client replays a frame whose ack was lost — a plain
+    (non-replicated) server must apply it exactly once too."""
+    srv = PSServer({0: _table()}).start()
+    try:
+        ids = np.array([9], np.int64)
+        vals = np.ones((1, 4), np.float32)
+        frame = _HDR.pack(OP_PUSH, 0, 1, 0.5, 0, 77, 1, 4) \
+            + ids.tobytes() + vals.tobytes()
+        peer = _RawPeer(srv.endpoint)
+        peer.call_frame(frame)
+        peer.call_frame(frame)           # the retry replay
+        peer.close()
+        np.testing.assert_allclose(srv.tables[0].pull(ids), -0.5)
+    finally:
+        srv.stop()
+
+
+def test_concurrent_pushers_no_dedup_drop():
+    """Write seqs are drawn under the shard lock, so two threads sharing
+    one client can never have the earlier write swallowed by the
+    server's high-watermark replay dedup."""
+    srv = PSServer({0: _table()}).start()
+    c = PSClient([srv.endpoint])
+    try:
+        n_threads, per_thread = 4, 8
+
+        def worker(t):
+            for _ in range(per_thread):
+                c.push(0, np.array([t], np.int64),
+                       np.ones((1, 4), np.float32), 4, lr=0.125)
+
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        out = c.pull(0, np.arange(n_threads, dtype=np.int64), 4)
+        np.testing.assert_allclose(out, -0.125 * per_thread)
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_malformed_header_error_frame_then_close():
+    srv = PSServer({0: _table()}).start()
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    try:
+        s.sendall(_HDR.pack(250, 0, 0, 0.0, 0, 0, 0, 0))
+        assert _recv_exact(s, 1) == b"\x00"
+        code, _epoch, mlen = _ERR_HDR.unpack(_recv_exact(s, _ERR_HDR.size))
+        assert code == ERR_BAD_REQUEST
+        _recv_exact(s, mlen)
+        assert s.recv(1) == b""      # unresyncable stream: closed
+    finally:
+        s.close()
+        srv.stop()
+
+
+def test_conn_idle_timeout_counter_and_transparent_reconnect():
+    before = _counters()
+    srv = PSServer({0: _table()}, request_timeout=0.15).start()
+    c = PSClient([srv.endpoint])
+    try:
+        np.testing.assert_allclose(c.pull(0, IDS, 4), 0.0)
+        time.sleep(0.5)              # server reaps the idle connection
+        assert _delta(before, "ps_conn_timeouts") >= 1
+        # the client's next call hits the dead socket, drops it, and
+        # replays on a fresh connection — no error surfaces
+        c.push(0, IDS, ONES, 4, lr=0.25)
+        np.testing.assert_allclose(c.pull(0, IDS, 4), -0.25)
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# bounded client RPCs: fault points, retries, typed exhaustion
+# ---------------------------------------------------------------------------
+
+def test_rpc_retry_via_fault_point_then_success():
+    srv = PSServer({0: _table()}).start()
+    c = PSClient([srv.endpoint], sleep=lambda d: None)
+    before = _counters()
+    try:
+        fault.arm("ps.pull", times=2, exc=ConnectionError)
+        np.testing.assert_allclose(c.pull(0, IDS, 4), 0.0)
+        assert _delta(before, "ps_rpc_retries") == 2
+        assert _delta(before, "faults_injected") == 2
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_rpc_exhaustion_is_typed_psunavailable():
+    port = _free_port()                      # nobody listening
+    c = PSClient([f"127.0.0.1:{port}"], max_attempts=2,
+                 connect_timeout=0.2, sleep=lambda d: None)
+    before = _counters()
+    with pytest.raises(PSUnavailable) as ei:
+        c.pull(0, IDS, 4)
+    assert ei.value.endpoint == f"127.0.0.1:{port}"
+    assert ei.value.shard == 0
+    assert _delta(before, "retry_giveups") == 1
+    c.close()
+
+
+def test_failed_rpc_drops_desynced_socket():
+    srv = PSServer({0: _table()}).start()
+    c = PSClient([srv.endpoint], sleep=lambda d: None)
+    try:
+        np.testing.assert_allclose(c.pull(0, IDS, 4), 0.0)
+        real = c._socks[0]
+
+        class _FlakySock:
+            """Delegating proxy that half-writes one header then dies —
+            the mid-send failure that used to leave a desynced stream
+            cached for the next call."""
+
+            fired = False
+
+            def sendall(self, data):
+                if not self.fired:
+                    self.fired = True
+                    real.sendall(data[:3])   # half a header on the wire
+                    raise OSError("injected mid-send failure")
+                return real.sendall(data)
+
+            def __getattr__(self, name):
+                return getattr(real, name)
+
+        proxy = _FlakySock()
+        c._socks[0] = proxy
+        # v1 kept the desynced socket cached and the next call read
+        # garbage; now the failed attempt drops it and the retry replays
+        # the WHOLE request on a fresh connection
+        c.push(0, IDS, ONES, 4, lr=0.25)
+        assert proxy.fired
+        assert c._socks[0] is not proxy
+        assert real.fileno() == -1           # old socket really closed
+        np.testing.assert_allclose(c.pull(0, IDS, 4), -0.25)
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_heartbeat_loop_survives_outage_with_backoff():
+    port = _free_port()
+    srv = PSServer({0: _table()}, port=port,
+                   heartbeat_timeout_s=30.0).start()
+    c = PSClient([srv.endpoint], max_attempts=1, connect_timeout=0.2,
+                 sleep=lambda d: None)
+    try:
+        c.start_heartbeat(trainer_id=0, interval_s=0.05)
+        time.sleep(0.15)
+        assert srv.monitor.alive(0)
+        srv.crash()                      # pserver dies mid-job
+        deadline = time.time() + 3
+        while c.heartbeat_error is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert c.heartbeat_error is not None
+        assert c._hb_thread.is_alive()   # v1's loop silently returned
+        # server comes back on the same endpoint: beats resume and the
+        # parked error clears
+        srv2 = PSServer({0: _table()}, port=port,
+                        heartbeat_timeout_s=30.0).start()
+        deadline = time.time() + 5
+        while c.heartbeat_error is not None and time.time() < deadline:
+            time.sleep(0.02)
+        assert c.heartbeat_error is None
+        assert srv2.monitor.alive(0)
+        c.stop_heartbeat()
+        srv2.stop()
+    finally:
+        c.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# replication: sync parity, dedup, divergence, async lag
+# ---------------------------------------------------------------------------
+
+def test_sync_replication_bitwise_parity(kv):
+    coord, a, b = _mk_pair(kv)
+    c = PSClient(kv=kv, job="j")
+    try:
+        for _ in range(3):
+            c.push(0, IDS, ONES, 4, lr=0.1)
+        assert a.seq == b.seq == 3
+        assert local_digest(a.tables[0]) == local_digest(b.tables[0])
+        verify_replicas(fetch_shard_map(kv, "j"))
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_replica_diverged_typed(kv):
+    coord, a, b = _mk_pair(kv)
+    c = PSClient(kv=kv, job="j")
+    try:
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        b.tables[0].assign(np.array([3], np.int64),
+                           np.full((1, 4), 7.0, np.float32))
+        with pytest.raises(ReplicaDiverged) as ei:
+            verify_replicas(fetch_shard_map(kv, "j"))
+        assert ei.value.shard == 0 and len(ei.value.digests) == 2
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_write_replay_dedups_exactly_once(kv):
+    _coord, a, b = _mk_pair(kv)
+    try:
+        ids = np.array([7], np.int64)
+        vals = np.ones((1, 4), np.float32)
+        frame = _HDR.pack(OP_PUSH, 0, 1, 0.5, a.epoch, 42, 1, 4) \
+            + ids.tobytes() + vals.tobytes()
+        peer = _RawPeer(a.endpoint)
+        peer.call_frame(frame)
+        peer.call_frame(frame)       # the failover replay: same (42, 1)
+        peer.close()
+        out = a.tables[0].pull(ids)
+        np.testing.assert_allclose(out, -0.5)   # ONE sgd step, not two
+        assert a.seq == 1
+        np.testing.assert_allclose(b.tables[0].pull(ids), -0.5)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_async_replication_bounded_lag_converges(kv):
+    coord, a, b = _mk_pair(kv, sync=False)
+    c = PSClient(kv=kv, job="j")
+    try:
+        for _ in range(5):
+            c.push(0, IDS, ONES, 4, lr=0.1)
+        a._replicator.flush(timeout=10.0)
+        deadline = time.time() + 5
+        while b.seq < a.seq and time.time() < deadline:
+            time.sleep(0.02)
+        assert b.seq == a.seq == 5
+        assert local_digest(a.tables[0]) == local_digest(b.tables[0])
+        assert "ps_replication_lag" in _counters()
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_gap_rejected_backup_self_heals(kv):
+    """A live backup that missed forwards (marked down during a blip)
+    must NOT apply out of order: the gap is rejected and a background
+    delta catch-up reconverges it."""
+    coord, a, b = _mk_pair(kv)
+    c = PSClient(kv=kv, job="j")
+    try:
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        b.crash()                      # blip: B misses two writes
+        time.sleep(0.05)
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        # B comes back on the same endpoint (fresh server object),
+        # rejoins, catches up from A's delta log
+        b2 = ReplicatedPSServer({0: _table()}, kv, job="j",
+                                port=int(b.endpoint.rsplit(":", 1)[1]),
+                                lease_ttl=10.0).start()
+        assert b2.rejoin(timeout=5.0) == a.endpoint
+        assert b2.seq == a.seq == 3
+        assert local_digest(a.tables[0]) == local_digest(b2.tables[0])
+        b2.stop()
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# failover: promotion, typed errors, replay
+# ---------------------------------------------------------------------------
+
+def test_promotion_failover_and_replay(kv):
+    before = _counters()
+    coord, a, b = _mk_pair(kv, lease_a=0.3, lease_b=10.0)
+    c = PSClient(kv=kv, job="j", failover_timeout=10.0)
+    try:
+        c.push(0, IDS, ONES, 4, lr=0.5)
+        a.crash()
+        time.sleep(0.5)                  # A's 0.3s lease lapses; B's holds
+        assert coord.check_now() == [0]
+        m = fetch_shard_map(kv, "j")
+        assert m.epoch == 2
+        assert m.primary(0) == b.endpoint
+        assert m.backups(0) == [a.endpoint]   # demoted to tail
+        # the client's next write fails over and REPLAYS: nothing lost,
+        # nothing doubled (2 pushes of lr .5 on grad 1 => -1.0)
+        c.push(0, IDS, ONES, 4, lr=0.5)
+        np.testing.assert_allclose(c.pull(0, IDS, 4), -1.0)
+        assert c.epoch == 2
+        assert b.role == "primary"
+        assert _delta(before, "ps_failovers") >= 1
+        assert _delta(before, "ps_promotions") == 1
+        assert coord.promotions == 1
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_whole_group_dark_stays_typed_no_promotion(kv):
+    before = _counters()
+    coord, a, b = _mk_pair(kv, lease_a=0.2, lease_b=0.2)
+    c = PSClient(kv=kv, job="j", failover_timeout=0.5,
+                 max_attempts=1, connect_timeout=0.2,
+                 sleep=lambda d: None)
+    try:
+        a.crash()
+        b.crash()
+        time.sleep(0.4)
+        assert coord.check_now() == []       # nothing correct to promote
+        with pytest.raises(PSUnavailable) as ei:
+            c.push(0, IDS, ONES, 4, lr=0.1)
+        assert ei.value.shard == 0
+        assert _delta(before, "ps_promotions") == 0
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_demoted_primary_fences_inflight_write(kv):
+    """A demoted primary that doesn't know it yet (inside its role_ttl
+    window) must not silently lose an acked write: its sync forward is
+    STALE-rejected by the newer-epoch peer, and the client's write is
+    rejected typed for replay — never acked against stale state."""
+    coord, a, b = _mk_pair(kv)
+    c = PSClient(kv=kv, job="j")
+    try:
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        # operator republish moves the job to epoch 2 with B primary;
+        # B learns, A (old primary) does NOT (role_ttl pacing)
+        coord.publish([[b.endpoint, a.endpoint]])
+        b.refresh_role(force=True)
+        assert b.role == "primary" and b.epoch == 2
+        assert a.role == "primary" and a.epoch == 1   # stale, unaware
+        # an epoch-1 client writing to A: A applies locally, forwards,
+        # B STALE-rejects, A fences -> the client refreshes to the new
+        # map and replays against B; dedup is per-server so nothing is
+        # lost and nothing double-applied on the authoritative replica
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        assert c.epoch == 2
+        np.testing.assert_allclose(c.pull(0, IDS, 4), -0.2)
+        assert a.role == "backup"        # the fence forced A's refresh
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_oversized_header_rejected_before_allocation():
+    srv = PSServer({0: _table()}).start()
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    try:
+        # n passes the id cap but n*dim would be a ~1 EiB allocation
+        s.sendall(_HDR.pack(OP_PUSH, 0, 1 << 27, 0.0, 0, 0, 0,
+                            0xFFFFF))
+        assert _recv_exact(s, 1) == b"\x00"
+        code, _epoch, mlen = _ERR_HDR.unpack(_recv_exact(s, _ERR_HDR.size))
+        assert code == ERR_BAD_REQUEST
+    finally:
+        s.close()
+        srv.stop()
+
+
+def test_embedding_communicator_mismatch_rejected():
+    from paddle_tpu.ps import AsyncCommunicator, SparseEmbedding
+
+    srv = PSServer({0: _table()}).start()
+    c = PSClient([srv.endpoint])
+    try:
+        comm = AsyncCommunicator(c, dim=4, table_id=0)
+        with pytest.raises(ValueError, match="dim"):
+            SparseEmbedding(8, client=c, communicator=comm)
+        with pytest.raises(ValueError, match="table"):
+            SparseEmbedding(4, client=c, table_id=1, communicator=comm)
+        # communicator-only: pulls route through the communicator's
+        # client, not a silently-fresh local table
+        emb = SparseEmbedding(4, communicator=comm)
+        assert emb._client is c
+    finally:
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_stale_epoch_client_auto_refreshes(kv):
+    coord, a, b = _mk_pair(kv)
+    c = PSClient(kv=kv, job="j")
+    try:
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        assert c.epoch == 1
+        # the coordinator republishes (an operator edit): same group,
+        # epoch 2 — the server learns first, the client's next request
+        # carries epoch 1, gets a typed STALE frame, refreshes, replays
+        coord.publish([[a.endpoint, b.endpoint]])
+        a.refresh_role(force=True)
+        assert a.epoch == 2
+        c.push(0, IDS, ONES, 4, lr=0.1)
+        assert c.epoch == 2
+        np.testing.assert_allclose(c.pull(0, IDS, 4), -0.2)
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_refresh_shard_map_bounded_typed(kv):
+    _coord, a, b = _mk_pair(kv)
+    c = PSClient(kv=kv, job="j", sleep=lambda d: None)
+    try:
+        with pytest.raises(ShardMapStale) as ei:
+            c.refresh_shard_map(min_epoch=99, timeout=0.2)
+        assert ei.value.expected_epoch == 99 and ei.value.observed == 1
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe shard snapshots + recovery (SnapshotStore + corrupt_ckpt)
+# ---------------------------------------------------------------------------
+
+def test_snapshot_restore_catchup(kv, tmp_path):
+    before = _counters()
+    coord, a, b = _mk_pair(kv, lease_a=0.3, lease_b=10.0,
+                           snap_a=str(tmp_path / "A"), snapshot_every=2)
+    c = PSClient(kv=kv, job="j", failover_timeout=10.0)
+    try:
+        for _ in range(5):               # snapshots at seq 2 and 4
+            c.push(0, IDS, ONES, 4, lr=0.1)
+        assert _delta(before, "ps_snapshot_commits") == 2
+        assert sorted(os.listdir(tmp_path / "A" / "shard_0")) == \
+            ["seq_2", "seq_4"]
+        a.crash()
+        time.sleep(0.5)
+        assert coord.check_now() == [0]
+        c.push(0, IDS, ONES, 4, lr=0.1)  # write 6 lands on promoted B
+        # relaunch A on its endpoint: restore seq_4, replay 5..6 from B
+        a2 = ReplicatedPSServer({0: _table()}, kv, job="j",
+                                port=int(a.endpoint.rsplit(":", 1)[1]),
+                                lease_ttl=10.0,
+                                snapshot_dir=str(tmp_path / "A"))
+        a2.start()
+        assert a2.rejoin(timeout=5.0) == b.endpoint
+        assert a2.seq == b.seq == 6
+        assert a2.role == "backup"
+        assert local_digest(a2.tables[0]) == local_digest(b.tables[0])
+        a2.stop()
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_corrupt_snapshot_falls_back_then_heals(kv, tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import corrupt_ckpt
+
+    before = _counters()
+    coord, a, b = _mk_pair(kv, snap_a=str(tmp_path / "A"),
+                           snapshot_every=2)
+    c = PSClient(kv=kv, job="j")
+    try:
+        for _ in range(4):               # snapshots at seq 2 and 4
+            c.push(0, IDS, ONES, 4, lr=0.1)
+        a.crash()
+        # damage the NEWEST shard snapshot through the chaos tool (it
+        # must find the shard_<k>/seq_<n> layout on its own)
+        report = corrupt_ckpt.corrupt(str(tmp_path / "A"), mode="flip")
+        assert report["snapshot"].endswith("seq_4")
+        a2 = ReplicatedPSServer({0: _table()}, kv, job="j",
+                                port=int(a.endpoint.rsplit(":", 1)[1]),
+                                lease_ttl=10.0,
+                                snapshot_dir=str(tmp_path / "A"))
+        a2.start()
+        # restore skips the corrupt seq_4 (sha mismatch), falls back to
+        # seq_2, and the delta catch-up heals the rest
+        a2.rejoin(timeout=5.0)
+        assert _delta(before, "ckpt_corrupt_skipped") >= 1
+        assert _delta(before, "ckpt_fallbacks") >= 1
+        assert a2.seq == b.seq == 4
+        assert local_digest(a2.tables[0]) == local_digest(b.tables[0])
+        a2.stop()
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_delta_log_truncation_forces_full_state_transfer(kv):
+    _coord, a, b = _mk_pair(kv)
+    c = PSClient(kv=kv, job="j")
+    try:
+        a._dlog = DeltaLog(capacity=2)   # tiny log: rotates fast
+        for _ in range(5):
+            c.push(0, IDS, ONES, 4, lr=0.1)
+        fresh = ReplicatedPSServer({0: _table()}, kv, job="j",
+                                   port=_free_port(), lease_ttl=10.0)
+        # no start needed: catch_up is a pure client of A
+        assert fresh._dlog.since(0) == []
+        n = fresh.catch_up(a.endpoint)
+        assert n == 1                    # one table, full transfer
+        assert fresh.seq == a.seq == 5
+        assert local_digest(fresh.tables[0]) == local_digest(a.tables[0])
+        # dedup state rides the transfer: a replay of write 5 is a no-op
+        assert fresh._applied == a._applied
+        fresh.stop()
+        # an EMPTY log on a server that is ahead (snapshot-restored, no
+        # deltas retained) must also force the full transfer — "0
+        # entries" would leave the rejoiner silently diverged at seq 0
+        a._dlog = DeltaLog(capacity=8)
+        fresh2 = ReplicatedPSServer({0: _table()}, kv, job="j",
+                                    port=_free_port(), lease_ttl=10.0)
+        assert fresh2.catch_up(a.endpoint) == 1   # full transfer again
+        assert fresh2.seq == a.seq == 5
+        assert local_digest(fresh2.tables[0]) == local_digest(a.tables[0])
+        fresh2.stop()
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# embedding + communicator on the typed error path
+# ---------------------------------------------------------------------------
+
+def test_sparse_embedding_remote_roundtrip_and_failover(kv):
+    import paddle_tpu as paddle
+    from paddle_tpu.ps import SparseEmbedding
+
+    coord, a, b = _mk_pair(kv, lease_a=0.3, lease_b=10.0)
+    c = PSClient(kv=kv, job="j", failover_timeout=10.0)
+    try:
+        emb = SparseEmbedding(4, client=c)
+        ids = paddle.to_tensor(np.array([1, 2, 3], np.int64))
+        out = emb(ids)
+        assert tuple(out.shape) == (3, 4)
+        loss = (out * out).sum()
+        loss.backward()
+        emb.push_gradients(lr=0.5)
+        ref_after_one = c.pull(0, np.array([1, 2, 3], np.int64), 4)
+        # primary dies; the next pull/push cycle rides the failover
+        a.crash()
+        time.sleep(0.5)
+        assert coord.check_now() == [0]
+        out = emb(ids)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref_after_one)
+        loss = (out * out).sum()
+        loss.backward()
+        emb.push_gradients(lr=0.5)       # lands on the promoted backup
+        assert c.epoch == 2
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+
+
+def test_sparse_embedding_through_communicator():
+    import paddle_tpu as paddle
+    from paddle_tpu.ps import AsyncCommunicator, SparseEmbedding
+
+    srv = PSServer({0: _table()}).start()
+    c = PSClient([srv.endpoint])
+    comm = AsyncCommunicator(c, dim=4, lr=0.5).start()
+    try:
+        emb = SparseEmbedding(4, client=c, communicator=comm)
+        out = emb(paddle.to_tensor(np.array([5, 6], np.int64)))
+        (out.sum()).backward()
+        emb.push_gradients(lr=0.5)
+        comm.flush()
+        got = c.pull(0, np.array([5, 6], np.int64), 4)
+        np.testing.assert_allclose(got, -0.5)   # grad of sum() is ones
+    finally:
+        comm.stop()
+        c.stop_servers()
+        c.close()
+        srv.stop()
+
+
+def test_communicator_flush_surfaces_psunavailable():
+    from paddle_tpu.ps import AsyncCommunicator
+
+    port = _free_port()                  # dead pserver
+    c = PSClient([f"127.0.0.1:{port}"], max_attempts=1,
+                 connect_timeout=0.2, sleep=lambda d: None)
+    comm = AsyncCommunicator(c, dim=4)
+    comm.start()
+    comm.push_sparse_grad(IDS, ONES)
+    # the send thread's push exits typed (PSUnavailable after the
+    # bounded retries) and parks; flush must surface THAT — the pserver
+    # died, not the sender — instead of mislabeling it WorkerLost
+    with pytest.raises(PSUnavailable) as ei:
+        comm.flush(timeout=10.0)
+    assert ei.value.endpoint == f"127.0.0.1:{port}"
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# counters surface
+# ---------------------------------------------------------------------------
+
+def test_ps_counters_merge_into_exe_counters():
+    import paddle_tpu.static as static
+
+    assert set(profiler.PS_COUNTER_NAMES) == {
+        "ps_failovers", "ps_promotions", "ps_rpc_retries",
+        "ps_snapshot_commits", "ps_replication_lag", "ps_conn_timeouts"}
+    profiler.bump_counter("ps_failovers", 0)
+    profiler.bump_counter("ps_promotions", 0)
+    exe = static.Executor()
+    counters = exe.counters
+    assert "ps_failovers" in counters
+    assert "ps_promotions" in counters
+
+
+# ---------------------------------------------------------------------------
+# the crown: deterministic kill-a-primary chaos drill (subprocess)
+# ---------------------------------------------------------------------------
+
+def test_ps_chaos_drill_kill_primary(tmp_path, monkeypatch):
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import chaos_drill
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PYTHONPATH", _REPO)
+    monkeypatch.delenv("PADDLE_FAULT_SPEC", raising=False)
+    # lease_ttl 3.0 = the elastic drill's proven CI value: shorter TTLs
+    # can expire spuriously on the loaded 2-core box (GIL-starved KV
+    # renewal), promoting the backup before the kill lands and routing
+    # the drill down the fence path instead of the crash-failover path
+    report = chaos_drill.run_ps_drill(str(tmp_path), pushes=12,
+                                      kill_after=5, snapshot_every=3,
+                                      lease_ttl=3.0)
+    assert report.get("error") is None, report
+    # zero lost updates, zero doubles: the final pull is BITWISE equal
+    # to the never-killed reference stream
+    assert report["parity_bitwise"], report
+    # the backup was promoted via a shard-map epoch bump and the client
+    # failed over with typed errors only (a hang would time the drill out)
+    assert report["epoch"] == 2, report
+    assert report["counters"]["ps_promotions"] == 1, report
+    assert report["counters"]["ps_failovers"] >= 1, report
+    assert report["counters"]["ps_snapshot_commits"] >= 1, report
+    # the killed primary was relaunched once, restored its snapshot,
+    # caught up from the promoted backup's delta log, and reconverged
+    assert report["supervisor"]["restarts_by_rank"] == {0: 1}, report
+    assert report["replicas_converged"] and report["digest_parity"], report
+    assert report["ok"], report

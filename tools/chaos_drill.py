@@ -1,5 +1,28 @@
 #!/usr/bin/env python
-"""Deterministic elastic-training chaos drill (ISSUE 7 crown test).
+"""Deterministic chaos drills: elastic kill/resume (ISSUE 7) and
+parameter-server kill-a-primary (ISSUE 8, ``--ps``).
+
+PS drill (``--ps``): a KVServer comes up in-process; one 2-replica
+group serves shard 0 — primary A as a SUPERVISED SUBPROCESS
+(``launch.Supervisor``, the real relaunch path), backup B in-process.
+The parent is the trainer: it pushes a deterministic gradient stream
+through a replicated ``PSClient``. ``PADDLE_FAULT_SPEC=
+ps.apply:1@K:SystemExit`` (armed only in A's env) kills A at its
+(K+1)-th applied write — mid-stream, with snapshots already committed.
+The ReplicaCoordinator observes A's lease expiry, promotes B (shard-map
+epoch bump); the client fails over with typed errors only and REPLAYS
+the in-flight push (write dedup makes the replay exactly-once); the
+supervisor relaunches A, which restores its newest valid SnapshotStore
+snapshot and catches up from B's delta log, rejoining as a backup. The
+drill asserts: the final pull is BITWISE identical to the never-killed
+reference (a local same-backend oracle table fed the same stream — in
+sync replication mode zero updates may be lost or doubled), a promotion
+and a failover really happened, the relaunched replica reconverged
+(digest parity across the group), and the ``ps_*`` counter table.
+"""
+from __future__ import annotations
+
+_ELASTIC_DOC = """Deterministic elastic-training chaos drill (ISSUE 7 crown test).
 
 Promotes the PR 2 chaos recipe (arm a ``PADDLE_FAULT_SPEC``, supervise,
 resume) to a tool that drives the WHOLE elastic story end to end with
@@ -33,7 +56,6 @@ Exit code 0 = drill passed (bitwise parity + generation bump); the
 counter table goes to stdout either way. ``--no-kill`` runs the same
 job without the fault spec (a clean baseline of the harness itself).
 """
-from __future__ import annotations
 
 import argparse
 import json
@@ -284,11 +306,266 @@ def _print_table(report: dict) -> None:
           f"ok={report['ok']}")
 
 
+# ---------------------------------------------------------------------------
+# the PS drill (ISSUE 8): kill-a-primary, promote, fail over, rejoin
+# ---------------------------------------------------------------------------
+
+def ps_server_main() -> int:
+    """Supervised pserver subprocess: env-driven replicated bootstrap
+    (restore + rejoin happen inside run_server)."""
+    from paddle_tpu.ps.server import run_server
+
+    run_server(block=True)
+    return 0
+
+
+def _push_stream(dim: int, pushes: int, rows: int):
+    """The deterministic gradient stream both the drill and its oracle
+    consume: (ids, grads, lr) per push."""
+    import numpy as np
+
+    for i in range(pushes):
+        rng = np.random.RandomState(1000 + i)
+        ids = rng.randint(0, 200, (rows,)).astype(np.int64)
+        grads = rng.randn(rows, dim).astype(np.float32)
+        yield ids, grads, 0.05
+
+
+def run_ps_drill(workdir: str, dim: int = 8, pushes: int = 12,
+                 rows: int = 16, kill_after: int = 5,
+                 snapshot_every: int = 3, lease_ttl: float = 3.0,
+                 max_restarts: int = 1, sync: bool = True,
+                 kill: bool = True, rejoin_wait: float = 60.0) -> dict:
+    """Run the kill-a-primary drill; returns a report dict.
+
+    ``kill_after=K`` kills the primary at its (K+1)-th applied write.
+    Pick K inside [snapshot_every, pushes) so the death lands mid-stream
+    with at least one snapshot committed. The re-armed env spec in the
+    relaunched process never re-fires: the relaunch rejoins as a BACKUP,
+    and backups apply forwards through the replication channel, which
+    bypasses the ``ps.apply`` client-write fault point.
+    """
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed.http_kv import KVClient, KVServer
+    from paddle_tpu.distributed.launch import Supervisor
+    from paddle_tpu.fault.retry import Backoff
+    from paddle_tpu.ps.replication import (
+        ReplicaCoordinator, ReplicatedPSServer, _RawPeer, fetch_shard_map,
+        local_digest, verify_replicas,
+    )
+    from paddle_tpu.ps.service import PSClient, table_digest
+    from paddle_tpu.ps.table import SparseTable
+
+    os.makedirs(workdir, exist_ok=True)
+    job = "psdrill"
+    counters0 = profiler.counters_snapshot()
+    kv_port = _free_port()
+    kvs = KVServer(kv_port)
+    kvs.start()
+    kv_ep = f"127.0.0.1:{kv_port}"
+    kv = KVClient(kv_ep)
+
+    port_a, port_b = _free_port(), _free_port()
+    ep_a, ep_b = f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"
+
+    coord = ReplicaCoordinator(kv, job=job, lease_ttl=lease_ttl,
+                               interval=0.2, boot_grace=60.0)
+    coord.publish([[ep_a, ep_b]], sync=sync)
+
+    mk_table = lambda: {0: SparseTable(dim, optimizer="sgd")}  # noqa: E731
+    srv_b = ReplicatedPSServer(
+        mk_table(), kv, job=job, port=port_b, lease_ttl=lease_ttl,
+        snapshot_dir=os.path.join(workdir, "B"),
+        snapshot_every=snapshot_every).start()
+
+    def env_for(rank):
+        env = dict(os.environ)
+        env.update({
+            "PYTHONPATH": _REPO,
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+            "PADDLE_PORT": str(port_a),
+            "PADDLE_PS_KV_ENDPOINT": kv_ep,
+            "PADDLE_PS_JOB": job,
+            "PADDLE_PS_TABLES": f"0:{dim}:sgd",
+            "PADDLE_PS_SNAPSHOT_DIR": os.path.join(workdir, "A"),
+            "PADDLE_PS_SNAPSHOT_EVERY": str(snapshot_every),
+            "PADDLE_PS_LEASE_TTL": repr(lease_ttl),
+            "PADDLE_PS_SYNC": "1" if sync else "0",
+            "PADDLE_PS_EXIT_ON_CRASH": "1",
+        })
+        if kill:
+            env["PADDLE_FAULT_SPEC"] = (
+                f"ps.apply:1@{kill_after}:SystemExit")
+        else:
+            env.pop("PADDLE_FAULT_SPEC", None)
+        return env
+
+    def start_fn(rank):
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--ps-server"],
+            env=env_for(rank))
+
+    sup = Supervisor(1, start_fn=start_fn, max_restarts=max_restarts,
+                     backoff=Backoff(base=0.5, factor=2.0, jitter=0),
+                     poll_interval=0.2)
+    sup_rc = {}
+
+    def sup_run():
+        try:
+            sup_rc["rc"] = sup.run()
+        except BaseException as e:  # noqa: B036 (reported, not masked)
+            sup_rc["error"] = repr(e)
+
+    sup_thread = threading.Thread(target=sup_run, daemon=True)
+    sup_thread.start()
+    coord.start()
+
+    t0 = time.monotonic()
+    report = {"ok": False, "kill": kill}
+    try:
+        # wait for A's first lease (its heavy jax import dominates)
+        kv.wait(f"ps/{job}/lease/{ep_a}", timeout=120.0)
+
+        client = PSClient(kv=kv, job=job, failover_timeout=60.0)
+        oracle = SparseTable(dim, optimizer="sgd")   # never-killed ref
+        touched = set()
+        for ids, grads, lr in _push_stream(dim, pushes, rows):
+            client.push(0, ids, grads, dim, lr)
+            oracle.push(ids, grads, lr)
+            touched.update(int(i) for i in ids)
+
+        all_ids = np.array(sorted(touched), np.int64)
+        final = client.pull(0, all_ids, dim)
+        report["final_digest"] = final.tobytes().hex()[:32]
+        report["expected_digest"] = (
+            oracle.pull(all_ids).tobytes().hex()[:32])
+        report["parity_bitwise"] = (
+            report["final_digest"] == report["expected_digest"])
+        m = fetch_shard_map(kv, job)
+        report["epoch"] = m.epoch
+        report["groups"] = m.groups
+        report["client_epoch"] = client.epoch
+
+        # the relaunched replica must reconverge: same seq, same digest
+        deadline = time.monotonic() + (rejoin_wait if kill else 1.0)
+        converged = False
+        while time.monotonic() < deadline:
+            probe = _RawPeer(ep_a)
+            try:
+                seq_a, _ = probe.seq_epoch()
+            except (ConnectionError, OSError):
+                time.sleep(0.3)
+                continue
+            finally:
+                probe.close()
+            if seq_a == srv_b.seq:
+                converged = True
+                break
+            time.sleep(0.3)
+        report["replicas_converged"] = converged
+        report["seq"] = {"A": (seq_a if converged else None),
+                         "B": srv_b.seq}
+        if converged:
+            verify_replicas(m)   # raises ReplicaDiverged on mismatch
+            try:
+                dig_a = _RawPeer(ep_a).digest(0).hex()
+            except (ConnectionError, OSError):
+                dig_a = None
+            report["digest_parity"] = (
+                dig_a == table_digest(srv_b.tables[0]).hex())
+        client.stop_heartbeat()
+        client.close()
+    except BaseException as e:  # noqa: B036 (the report IS the output)
+        report["error"] = repr(e)
+    finally:
+        coord.stop()
+        sup.request_stop()
+        sup_thread.join(timeout=45)
+        srv_b.stop()
+        kvs.stop()
+    report["wall_s"] = round(time.monotonic() - t0, 1)
+    report["supervisor"] = sup.stats()
+    report["supervisor_rc"] = sup_rc
+    delta = {k: v - counters0.get(k, 0)
+             for k, v in profiler.counters_snapshot().items()}
+    from paddle_tpu.profiler import PS_COUNTER_NAMES
+
+    report["counters"] = {n: delta.get(n, 0) for n in PS_COUNTER_NAMES}
+    report["promotions"] = coord.promotions
+    report["ok"] = bool(
+        "error" not in report
+        and report.get("parity_bitwise")
+        and report.get("replicas_converged")
+        and (not kill or (
+            report["counters"]["ps_failovers"] >= 1
+            and report["counters"]["ps_promotions"] >= 1
+            and report.get("epoch", 1) >= 2
+            and report.get("digest_parity")
+            and sup.stats()["restarts_by_rank"].get(0, 0) >= 1)))
+    return report
+
+
+def _print_ps_table(report: dict) -> None:
+    print(f"\nps chaos drill: kill={report['kill']} "
+          f"wall={report['wall_s']}s supervisor={report['supervisor']}")
+    if "error" in report:
+        print(f"ERROR: {report['error']}")
+    print(f"epoch={report.get('epoch')} groups={report.get('groups')}")
+    print(f"final    {report.get('final_digest')}")
+    print(f"expected {report.get('expected_digest')}  "
+          f"parity_bitwise={report.get('parity_bitwise')}")
+    print(f"seq={report.get('seq')} "
+          f"replicas_converged={report.get('replicas_converged')} "
+          f"digest_parity={report.get('digest_parity')}")
+    print(f"\n{'counter':<24}{'value':>8}")
+    for name, value in sorted(report.get("counters", {}).items()):
+        print(f"{name:<24}{value:>8}")
+    print(f"\nok={report['ok']}")
+
+
+def ps_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic PS kill-a-primary chaos drill")
+    ap.add_argument("--workdir", default="/tmp/paddle_tpu_ps_drill")
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--pushes", type=int, default=12)
+    ap.add_argument("--rows", type=int, default=16)
+    ap.add_argument("--kill-after", type=int, default=5)
+    ap.add_argument("--snapshot-every", type=int, default=3)
+    # 3.0s matches the elastic drill's proven-stable TTL on the noisy
+    # 2-core CI box: a shorter lease can expire SPURIOUSLY when the
+    # GIL-starved parent delays serving a renewal, promoting the backup
+    # before the kill even lands (the drill then exercises the fence
+    # path instead of the crash-failover path it asserts)
+    ap.add_argument("--lease-ttl", type=float, default=3.0)
+    ap.add_argument("--max-restarts", type=int, default=1)
+    ap.add_argument("--async-repl", action="store_true",
+                    help="async replication (bounded lag) instead of sync")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="clean baseline: same harness, no fault spec")
+    args = ap.parse_args(argv)
+    report = run_ps_drill(
+        args.workdir, dim=args.dim, pushes=args.pushes, rows=args.rows,
+        kill_after=args.kill_after, snapshot_every=args.snapshot_every,
+        lease_ttl=args.lease_ttl, max_restarts=args.max_restarts,
+        sync=not args.async_repl, kill=not args.no_kill)
+    _print_ps_table(report)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "--worker":
         return worker_main()
+    if argv and argv[0] == "--ps-server":
+        return ps_server_main()
+    if argv and argv[0] == "--ps":
+        return ps_main(argv[1:])
     ap = argparse.ArgumentParser(
         description="deterministic elastic kill/resume chaos drill")
     ap.add_argument("--workdir", default="/tmp/paddle_tpu_chaos_drill")

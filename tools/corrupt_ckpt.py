@@ -6,9 +6,11 @@ Usage:
     python tools/corrupt_ckpt.py PATH [--mode flip|truncate|unmanifest]
                                  [--file NAME] [--offset N]
 
-PATH is either one snapshot dir (.../epoch_<k>) or a store root (or an
-auto-checkpoint job dir), in which case the NEWEST committed snapshot is
-picked. Modes:
+PATH is either one snapshot dir (.../epoch_<k>, .../step_<k>,
+.../seq_<k>), a store root, an auto-checkpoint job dir, or a pserver
+snapshot root (shard_<k>/seq_<n>/ layout — search descends one level),
+in which case the NEWEST committed snapshot (highest tag) is picked.
+Modes:
 
     flip        XOR one payload byte (default: middle of the file) —
                 the sha256 manifest check must reject the snapshot
@@ -24,21 +26,51 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from paddle_tpu.io.snapshot import MANIFEST_NAME, SnapshotStore  # noqa: E402
+from paddle_tpu.io.snapshot import MANIFEST_NAME  # noqa: E402
+
+# any SnapshotStore naming scheme: epoch_<k>, step_<k>, seq_<k>, ...
+_SNAP_DIR = re.compile(r"^[A-Za-z_]*?(-?\d+)$")
+
+
+def _committed_under(root: str):
+    """(tag, path) for every committed snapshot dir directly under
+    ``root``, prefix-agnostic."""
+    out = []
+    try:
+        names = sorted(os.listdir(root))
+    except (FileNotFoundError, NotADirectoryError, OSError):
+        return out
+    for name in names:
+        m = _SNAP_DIR.match(name)
+        path = os.path.join(root, name)
+        if (m and os.path.isdir(path)
+                and os.path.exists(os.path.join(path, MANIFEST_NAME))):
+            out.append((int(m.group(1)), path))
+    return out
 
 
 def pick_snapshot(path: str) -> str:
-    """Resolve PATH to one committed snapshot dir (newest wins)."""
+    """Resolve PATH to one committed snapshot dir (newest tag wins).
+    Handles a snapshot dir itself, a store root, and a root of stores
+    (pserver shard_<k>/ dirs, auto-checkpoint job dirs) one level down."""
     if os.path.exists(os.path.join(path, MANIFEST_NAME)):
         return path
-    committed = [p for _tag, p, ok in SnapshotStore(path).snapshots() if ok]
+    committed = _committed_under(path)
+    if not committed:
+        try:
+            names = sorted(os.listdir(path))
+        except OSError as e:
+            raise SystemExit(f"cannot read {path!r}: {e}")
+        for name in names:
+            committed += _committed_under(os.path.join(path, name))
     if not committed:
         raise SystemExit(f"no committed snapshot under {path!r}")
-    return committed[-1]
+    return max(committed)[1]
 
 
 def pick_payload(snap_dir: str, name=None) -> str:

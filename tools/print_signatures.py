@@ -34,6 +34,8 @@ MODULES = [
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",
     "paddle_tpu.profiler",
+    "paddle_tpu.ps",
+    "paddle_tpu.ps.replication",
     "paddle_tpu.quantization",
     "paddle_tpu.regularizer",
     "paddle_tpu.static",
