@@ -441,6 +441,16 @@ def _serving_probe(requests=60, workers=4):
             "serve_requests_per_sec": summary["requests_per_sec"],
             "serve_p50_ms": summary["p50_ms"],
             "serve_p99_ms": summary["p99_ms"],
+            # engine-side latency truth: percentiles derived from the
+            # serve_e2e_ms / serve_queue_wait_ms histogram BUCKETS the
+            # engine records per request (what /metrics exposes), next
+            # to the client-observed wall-clock view
+            "serve_engine_p50_ms": summary["engine_p50_ms"],
+            "serve_engine_p99_ms": summary["engine_p99_ms"],
+            "serve_queue_wait_p50_ms": summary["queue_wait_p50_ms"],
+            "serve_queue_wait_p99_ms": summary["queue_wait_p99_ms"],
+            "serve_client_p50_ms": summary["client_p50_ms"],
+            "serve_client_p99_ms": summary["client_p99_ms"],
             "serve_requests": int(ec.get("serve_requests", 0)),
             "serve_batches": int(ec.get("serve_batches", 0)),
             "serve_shed": int(ec.get("serve_shed", 0)),

@@ -41,6 +41,7 @@ from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .framework.tape import no_grad as no_grad  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401  (metrics registry, step trace, flight recorder)
 from . import fault  # noqa: F401  (retry/backoff + fault injection)
 from . import inference  # noqa: F401
 from . import incubate  # noqa: F401

@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..fault import injector as _fault
 from ..fault.injector import _bump
+from ..observability.flight_recorder import note_typed_error
 from ..fault.retry import Backoff, Retrier, env_backoff, env_max_attempts
 from ..ps.heartbeat import HeartBeatMonitor
 from .http_kv import KVClient
@@ -172,12 +173,14 @@ class NanGuard:
             rolled = None
             if self._rollback is not None:
                 rolled = self._rollback()
-            raise NumericalDivergence(
+            err = NumericalDivergence(
                 f"loss was non-finite for {streak} consecutive steps — "
                 "the run has diverged"
                 + (f"; rolled back to {rolled}" if rolled is not None
                    else ""),
                 consecutive=streak, rolled_back_to=rolled)
+            note_typed_error(err, where="elastic.nan_guard")
+            raise err
         return False
 
 
@@ -310,10 +313,12 @@ class ElasticAgent:
                 backoff, attempt = self._poll_backoff(), 0
                 continue
             if self._clock() >= deadline:
-                raise RendezvousTimeout(
+                err = RendezvousTimeout(
                     f"elastic join (job {self.job!r}, generation {gen}) "
                     f"timed out after {timeout}s with ranks {missing} "
                     "missing", missing_ranks=missing)
+                note_typed_error(err, where="elastic.join")
+                raise err
             self._poll_sleep(backoff, attempt, deadline)
             attempt += 1
         if gen != self.generation:
@@ -443,10 +448,12 @@ class ElasticAgent:
         for r in lost:
             if self._on_worker_lost is not None:
                 self._on_worker_lost(r)
-        raise WorkerLost(
+        err = WorkerLost(
             f"worker(s) {lost} lost their lease (job {self.job!r}, "
             f"generation {self.generation}); generation bumped for "
             "re-rendezvous", lost_ranks=lost)
+        note_typed_error(err, where="elastic.check_peers")
+        raise err
 
     def assert_current(self) -> None:
         """StaleGeneration if the job has moved past our generation."""
@@ -490,10 +497,12 @@ class ElasticAgent:
             self.check_peers()
             if self._clock() >= deadline:
                 _bump("barrier_timeouts")
-                raise RendezvousTimeout(
+                err = RendezvousTimeout(
                     f"elastic barrier {tag!r} (generation {gen}) timed "
                     f"out after {timeout}s with ranks {missing} missing",
                     missing_ranks=missing)
+                note_typed_error(err, where="elastic.barrier")
+                raise err
             self._poll_sleep(backoff, attempt, deadline)
             attempt += 1
 

@@ -21,6 +21,21 @@ __all__ = ["KVHandler", "KVHTTPServer", "KVServer", "KVClient"]
 
 # shared lazy counter shim (fault/ is jax-free; profiler loads on bump)
 from ..fault.injector import _bump as _bump_counter  # noqa: E402
+# stdlib-only registry: /metrics exposition + the kv round-trip
+# histogram ride it without pulling jax into this module
+from ..observability import metrics as _obs_metrics  # noqa: E402
+
+_KV_HIST = None
+
+
+def _kv_hist():
+    """Cached kv_request_ms histogram handle (per-request hot path —
+    includes every elastic-barrier wait poll)."""
+    global _KV_HIST
+    if _KV_HIST is None:
+        _KV_HIST = _obs_metrics.default_registry().histogram(
+            "kv_request_ms")
+    return _KV_HIST
 
 
 class KVHandler(BaseHTTPRequestHandler):
@@ -38,7 +53,12 @@ class KVHandler(BaseHTTPRequestHandler):
     - every connection socket carries the server's ``request_timeout``,
       so a client that stalls mid-request (half-sent headers, dribbled
       body) gets its connection closed (counter ``kv_conn_timeouts``)
-      instead of pinning a handler thread forever."""
+      instead of pinning a handler thread forever.
+
+    GET ``/metrics`` is a RESERVED route (Prometheus exposition of the
+    process-global registry) — a KV key literally named ``metrics`` is
+    shadowed on GET; real keys use "scope/key" paths, which never
+    collide."""
 
     def setup(self):
         # per-connection socket timeout BEFORE the stream wrappers are
@@ -57,6 +77,19 @@ class KVHandler(BaseHTTPRequestHandler):
         BaseHTTPRequestHandler.log_error(self, format, *args)
 
     def do_GET(self):
+        if self.path == "/metrics":
+            # Prometheus text exposition of the process-global registry:
+            # every KV listener in the fleet (elastic/PS coordination
+            # server, serving health server, PADDLE_METRICS_PORT
+            # standalone) is a scrape target for free
+            body = _obs_metrics.default_registry() \
+                .render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", _obs_metrics.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         with self.server.kv_lock:
             value = self.server.kv.get(self.path.strip("/"))
         if value is None:
@@ -225,12 +258,14 @@ class KVClient:
         _fault.point("http_kv.request")
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
+        t0 = time.perf_counter()
         try:
             conn.request(method, "/" + key.strip("/"), body=body)
             resp = conn.getresponse()
             return resp.status, resp.read()
         finally:
             conn.close()
+            _kv_hist().observe((time.perf_counter() - t0) * 1e3)
 
     def _request(self, method: str, key: str, body: Optional[bytes] = None):
         return self._retry.call(self._request_once, method, key, body)

@@ -181,6 +181,18 @@ class FaultInjector:
             t.fired += 1
             exc = t.exc_type(t.message)
         _bump("faults_injected")
+        # flight recorder, BEFORE the raise propagates: a chaos
+        # SystemExit often dies via os._exit (no atexit, no teardown),
+        # so the postmortem must hit disk here or never
+        try:
+            from ..observability.flight_recorder import flight_recorder
+
+            fr = flight_recorder()
+            fr.record("fault_injected", point=name,
+                      error=type(exc).__name__, message=str(exc))
+            fr.dump(reason=f"fault_injected:{name}")
+        except Exception:
+            pass   # the chaos knob must not mask its own fault
         raise exc
 
 

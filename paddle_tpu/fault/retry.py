@@ -152,6 +152,18 @@ class Retrier:
                     and time.monotonic() - t0 + delay > self.deadline)
                 if out_of_budget or past_deadline:
                     profiler.bump_counter("retry_giveups")
+                    try:
+                        from ..observability.flight_recorder import \
+                            flight_recorder
+
+                        fr = flight_recorder()
+                        fr.record("retry_giveup", name=self.name,
+                                  attempts=attempt,
+                                  error=type(e).__name__,
+                                  message=str(e)[:500])
+                        fr.dump(reason=f"retry_giveup:{self.name}")
+                    except Exception:
+                        pass   # postmortem writer must not mask the error
                     raise
                 profiler.bump_counter("retry_attempts")
                 self._sleep(delay)

@@ -90,6 +90,34 @@ class RequestFailed(ServingError):
 
 
 from ..fault.injector import _bump  # noqa: E402 (shared lazy counter shim)
+from ..observability.flight_recorder import note_typed_error  # noqa: E402
+from ..observability.metrics import MetricsRegistry  # noqa: E402
+from ..observability.metrics import default_registry as _registry  # noqa: E402
+
+
+class _DualHist:
+    """One serving latency histogram recorded twice: into the engine's
+    PRIVATE registry (so ``engine_latency_stats`` reports THIS engine's
+    requests — a second engine in the process, or a registry reset,
+    cannot skew it) and into the process-global registry the /metrics
+    scrape renders. Reads (percentile/snapshot) come from the private
+    series."""
+
+    __slots__ = ("_local", "_global")
+
+    def __init__(self, name: str, local_registry: MetricsRegistry):
+        self._local = local_registry.histogram(name)
+        self._global = _registry().histogram(name)
+
+    def observe(self, value) -> None:
+        self._local.observe(value)
+        self._global.observe(value)
+
+    def percentile(self, q: float) -> float:
+        return self._local.percentile(q)
+
+    def snapshot(self) -> dict:
+        return self._local.snapshot()
 
 
 # ---------------------------------------------------------------------------
@@ -388,6 +416,15 @@ class ServingEngine:
         self._lat_ms: deque = deque(maxlen=8192)
         self._fill_rows = 0
         self._fill_capacity = 0
+        # engine-side latency histograms: the serving latency record no
+        # longer depends on any client's view (dual-recorded: private
+        # per-engine series + the process-global /metrics series)
+        self._hist_reg = MetricsRegistry()
+        self._h_queue_wait = _DualHist("serve_queue_wait_ms",
+                                       self._hist_reg)
+        self._h_assembly = _DualHist("serve_assembly_ms", self._hist_reg)
+        self._h_dispatch = _DualHist("serve_dispatch_ms", self._hist_reg)
+        self._h_e2e = _DualHist("serve_e2e_ms", self._hist_reg)
 
     # -- counters ---------------------------------------------------------
     def _count(self, name: str, n: int = 1) -> None:
@@ -428,6 +465,20 @@ class ServingEngine:
                 "p50_ms": round(float(np.percentile(lat, 50)), 3),
                 "p99_ms": round(float(np.percentile(lat, 99)), 3),
                 "mean_ms": round(float(lat.mean()), 3)}
+
+    def engine_latency_stats(self) -> Dict[str, float]:
+        """Engine-reported percentiles DERIVED FROM THE HISTOGRAM
+        BUCKETS (serve_e2e_ms / serve_queue_wait_ms) — the latency
+        record that exists server-side whatever any client measured,
+        and exactly what a /metrics scraper can recompute."""
+        e2e, qw = self._h_e2e, self._h_queue_wait
+        return {
+            "n": int(e2e.snapshot()["count"]),
+            "e2e_p50_ms": round(e2e.percentile(50), 3),
+            "e2e_p99_ms": round(e2e.percentile(99), 3),
+            "queue_wait_p50_ms": round(qw.percentile(50), 3),
+            "queue_wait_p99_ms": round(qw.percentile(99), 3),
+        }
 
     @property
     def ready(self) -> bool:
@@ -540,6 +591,7 @@ class ServingEngine:
     def _assemble(self) -> List[_Request]:
         """Pop one batch: drop expired requests, then pack the oldest
         request's signature greedily up to the largest bucket."""
+        t0 = time.perf_counter()
         now = self._clock()
         with self._cond:
             expired = [r for r in self._queue
@@ -564,6 +616,12 @@ class ServingEngine:
             self._gauge("serve_queue_depth", len(self._queue))
         if expired:
             self._expire(expired, now)
+        if batch:
+            self._h_assembly.observe((time.perf_counter() - t0) * 1e3)
+            for r in batch:
+                # queue wait ends when the request makes it into a batch
+                self._h_queue_wait.observe(max(0.0, now - r.t_submit)
+                                           * 1e3)
         return batch
 
     def run_once(self) -> int:
@@ -610,19 +668,29 @@ class ServingEngine:
                     continue
                 if r.degraded:
                     self._count("serve_degraded")
+                e2e_ms = (now - r.t_submit) * 1e3
                 with self._stats_lock:
-                    self._lat_ms.append((now - r.t_submit) * 1e3)
+                    self._lat_ms.append(e2e_ms)
+                self._h_e2e.observe(e2e_ms)
                 r.handle._resolve(value=sl)
         except BaseException as e:
             # no unexpected error may leave a handle unresolved (the
             # caller would block forever) or kill the scheduler thread:
             # fail the batch's remaining requests typed and keep serving
+            noted = False
             for r in batch:
                 if not r.handle.done():
                     self._count("serve_failed")
-                    r.handle._resolve(error=RequestFailed(
+                    err = RequestFailed(
                         f"internal serving error: "
-                        f"{type(e).__name__}: {e}"))
+                        f"{type(e).__name__}: {e}")
+                    if not noted:
+                        # once per failed BATCH: a 32-request batch
+                        # must not write 32 identical postmortems on
+                        # the scheduler thread mid-incident
+                        note_typed_error(err, where="serve.run_once")
+                        noted = True
+                    r.handle._resolve(error=err)
             resolved = len(batch)
         finally:
             with self._cond:
@@ -651,8 +719,10 @@ class ServingEngine:
             _fault.point("serve.dispatch")
             return self.predictor.run_batch(feed)
 
+        t0 = time.perf_counter()
         try:
             out = self._retrier.call(_compiled)
+            self._h_dispatch.observe((time.perf_counter() - t0) * 1e3)
             self._count("serve_batches")
             return out
         except ServingError:
@@ -661,6 +731,7 @@ class ServingEngine:
             # degrade: batch-1 eager per request; a request whose
             # fallback also fails is failed typed, the others survive
             per_req: List[Optional[List[np.ndarray]]] = []
+            fb_noted = False
             for r in batch:
                 try:
                     _fault.point("serve.fallback")
@@ -668,12 +739,17 @@ class ServingEngine:
                     r.degraded = True
                 except BaseException as fb_err:
                     self._count("serve_failed")
-                    r.handle._resolve(error=RequestFailed(
+                    err = RequestFailed(
                         f"dispatch failed after "
                         f"{self._retrier.max_attempts} attempts "
                         f"({type(dispatch_err).__name__}: {dispatch_err})"
                         f" and the degraded fallback failed too "
-                        f"({type(fb_err).__name__}: {fb_err})"))
+                        f"({type(fb_err).__name__}: {fb_err})")
+                    if not fb_noted:
+                        # once per batch (see run_once's failure path)
+                        note_typed_error(err, where="serve.fallback")
+                        fb_noted = True
+                    r.handle._resolve(error=err)
                     per_req.append(None)
             # stitch survivors back into batch-row layout; failed
             # requests contribute zero-filled rows (their handles are
@@ -810,7 +886,15 @@ def install_sigterm_drain(engine: ServingEngine,
     import signal as _signal
 
     def _drain_and_exit():
-        engine.drain(timeout=drain_timeout)
+        drained = engine.drain(timeout=drain_timeout)
+        try:
+            from ..observability.flight_recorder import flight_recorder
+
+            fr = flight_recorder()
+            fr.record("sigterm_drain", drained=bool(drained))
+            fr.dump(reason="sigterm_drain")
+        except Exception:
+            pass   # the postmortem writer must not block the drain exit
         if on_drained is not None:
             on_drained()
         if exit_code is not None:

@@ -1,0 +1,49 @@
+"""Unified observability plane: typed metrics registry with Prometheus
+text exposition, structured step tracing, and a crash flight recorder.
+
+- :mod:`.metrics` — ``MetricsRegistry`` (Counter/Gauge/Histogram with
+  labels, help text, a label-cardinality cap, and bucket-derived
+  p50/p99); ``profiler.bump_counter``/``set_counter`` are compat shims
+  over the default registry's scalar tier.
+- :mod:`.catalog` — every counter family declared with help text.
+- :mod:`.step_trace` — per-step JSONL records correlated with the
+  XPlane device timeline via ``paddle_step_<id>`` annotations
+  (``PADDLE_STEP_TRACE``).
+- :mod:`.flight_recorder` — bounded postmortem ring dumped atomically
+  on typed failures and SIGTERM drain (``PADDLE_FLIGHTREC_DIR``).
+- :mod:`.server` — standalone ``/metrics`` endpoint for hosts without
+  an HTTP surface (``PADDLE_METRICS_PORT``); every http_kv listener
+  (KVServer, ServingHealthServer) serves ``/metrics`` natively.
+"""
+from . import metrics  # noqa: F401  (stdlib-only, safe under profiler)
+from .metrics import (CONTENT_TYPE, Counter, Gauge,  # noqa: F401
+                      Histogram, MetricsRegistry, default_registry,
+                      parse_prometheus_text, render_prometheus)
+from .flight_recorder import (FlightRecorder,  # noqa: F401
+                              flight_recorder, note_typed_error,
+                              reset_flight_recorder)
+from .step_trace import (StepTrace, active_step_trace,  # noqa: F401
+                         disable_step_trace, enable_step_trace,
+                         reset_step_trace)
+
+__all__ = [
+    "CONTENT_TYPE", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry", "render_prometheus", "parse_prometheus_text",
+    "FlightRecorder", "flight_recorder", "note_typed_error",
+    "reset_flight_recorder",
+    "StepTrace", "active_step_trace", "enable_step_trace",
+    "disable_step_trace", "reset_step_trace",
+    "MetricsServer", "start_metrics_server",
+    "maybe_start_metrics_server", "stop_metrics_server",
+]
+
+
+def __getattr__(name):
+    # server pulls in distributed.http_kv; keep it lazy so importing
+    # the package (e.g. from the profiler) stays dependency-light
+    if name in ("MetricsServer", "start_metrics_server",
+                "maybe_start_metrics_server", "stop_metrics_server"):
+        from . import server
+
+        return getattr(server, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,125 @@
+"""Declared metric catalog: every counter family the subsystems bump —
+formerly documented only in profiler.py's comment block — as typed
+registry declarations with help text, plus the latency histograms the
+observability plane adds. ``declare_standard_metrics`` is idempotent
+and runs once at profiler import, so ``/metrics`` scrapes always see
+the full declared surface (untouched counters render 0, never gap).
+
+Names must stay in sync with the ``*_COUNTER_NAMES`` tuples in
+profiler.py (tests pin both surfaces); per-pass dynamic names
+(``pass_<name>_removed_ops``) stay auto-registered.
+"""
+from __future__ import annotations
+
+from .metrics import DEFAULT_LATENCY_BUCKETS_MS, MetricsRegistry
+
+# name -> (kind, help). kind: "counter" | "gauge"
+SCALARS = {
+    # executor hot path (static/executor.py, jit.TrainStep)
+    "compile_cache_hits": ("counter", "per-step executable cache hits"),
+    "compile_cache_misses": ("counter", "executable cache misses (a build ran)"),
+    "h2d_bytes": ("counter", "host->device payload bytes (feeds + uploads)"),
+    "state_h2d_bytes": ("counter", "persistable-state slice of h2d_bytes (zero once state is device-resident)"),
+    "donated_bytes": ("counter", "bytes of buffers offered to XLA for in-place reuse"),
+    "donation_fallback_copies": ("counter", "exposed/aliased state arrays copied before donation"),
+    "executor_steps": ("counter", "compiled steps dispatched"),
+    # IR pass pipeline + compile caches
+    "ir_ops_before": ("counter", "block-0 op count entering the pass pipeline (cumulative over builds)"),
+    "ir_ops_after": ("counter", "block-0 op count leaving the pass pipeline"),
+    "ir_pass_ms": ("counter", "total pass-pipeline wall time, ms"),
+    "ir_vars_dropped": ("counter", "unused VarDescs dropped by cleanup"),
+    "trace_ms": ("counter", "jit lower() wall time, ms"),
+    "compile_ms": ("counter", "XLA compile() wall time, ms (disk-cache hits make this a file read)"),
+    "disk_cache_hits": ("counter", "jax persistent-compilation-cache hits"),
+    "disk_cache_misses": ("counter", "jax persistent-compilation-cache misses"),
+    # mixed precision
+    "amp_casts_inserted": ("counter", "amp cast ops added to the forward region"),
+    "amp_casts_elided": ("counter", "casts removed by the amp cleanup sub-pass"),
+    "amp_ops_lowprec": ("counter", "ops rewritten to run in bf16/fp16"),
+    "amp_master_params": ("counter", "f32 params given a low-precision compute copy"),
+    "amp_lowprec_feeds": ("counter", "float32 data vars flipped to the low dtype"),
+    "amp_loss_scaled": ("counter", "fp16 static loss-scaling wirings (1 per build)"),
+    # remat + gradient merge
+    "remat_segments": ("counter", "checkpoint segments per build"),
+    "remat_stash_vars": ("counter", "boundary vars saved for the backward"),
+    "remat_recompute_vars": ("counter", "interior vars recomputed in the backward"),
+    "gm_dispatches": ("counter", "gradient-merge steps dispatched"),
+    "gm_microbatches": ("counter", "microbatches covered by gm dispatches"),
+    "xla_temp_bytes": ("gauge", "last built executable: XLA temp working set"),
+    "xla_peak_bytes": ("gauge", "last built executable: arguments+outputs+temp bytes"),
+    "xla_argument_bytes": ("gauge", "last built executable: argument bytes"),
+    "xla_output_bytes": ("gauge", "last built executable: output bytes"),
+    # fault layer
+    "retry_attempts": ("counter", "re-attempts after a retryable failure"),
+    "retry_giveups": ("counter", "retry budget/deadline exhaustions (last error raised)"),
+    "faults_injected": ("counter", "armed fault points fired"),
+    "ckpt_commits": ("counter", "snapshot manifest commits (atomic rename ran)"),
+    "ckpt_corrupt_skipped": ("counter", "torn/sha-mismatched snapshots skipped at load"),
+    "ckpt_fallbacks": ("counter", "loads that fell back past a newer broken snapshot"),
+    "trainer_relaunches": ("counter", "dead trainers re-exec'd by launch.supervise"),
+    # serving
+    "serve_requests": ("counter", "requests admitted past admission control"),
+    "serve_shed": ("counter", "requests shed at admission (queue bound or token bucket)"),
+    "serve_deadline_expired": ("counter", "requests dropped because their deadline passed/was unmakeable"),
+    "serve_degraded": ("counter", "requests served by the batch-1 eager fallback"),
+    "serve_failed": ("counter", "requests failed outright (fallback failed too)"),
+    "serve_batches": ("counter", "compiled serving batches dispatched"),
+    "serve_queue_depth": ("gauge", "admission-queue depth after the last submit/assembly"),
+    "serve_batch_fill_pct": ("gauge", "cumulative mean rows/bucket-capacity per dispatched batch, percent"),
+    "kv_rejected_oversize": ("counter", "KV/health PUTs rejected 413 over the body cap"),
+    "kv_conn_timeouts": ("counter", "KV/health connections closed on socket timeout"),
+    "supervisor_drains": ("counter", "launch.Supervisor graceful shutdowns started"),
+    "supervisor_drain_kills": ("counter", "children SIGKILLed after the drain window"),
+    # elastic membership + resume
+    "elastic_generations": ("counter", "generations this process rendezvoused into"),
+    "worker_lost": ("counter", "peers declared lost (typed WorkerLost raised)"),
+    "lease_expirations": ("counter", "heartbeat leases observed expired"),
+    "barrier_timeouts": ("counter", "bounded elastic barriers that hit their deadline"),
+    "kv_poll_backoffs": ("counter", "KV polls slowed by capped-exponential backoff"),
+    "nan_guard_trips": ("counter", "non-finite loss observations (NanGuard)"),
+    "resume_batch_offset": ("gauge", "batch offset the last mid-epoch resume restarted at"),
+    # parameter server
+    "ps_failovers": ("counter", "client failovers to a promoted backup (request replayed)"),
+    "ps_promotions": ("counter", "backups promoted to primary on lease expiry"),
+    "ps_rpc_retries": ("counter", "PS RPC re-attempts after transient socket failures"),
+    "ps_snapshot_commits": ("counter", "crash-safe pserver table snapshots committed"),
+    "ps_replication_lag": ("gauge", "frames accepted by the primary not yet replicated (async queue depth)"),
+    "ps_conn_timeouts": ("counter", "pserver connections closed on the idle timeout"),
+    # observability plane itself
+    "metrics_label_overflow": ("counter", "label sets folded into the overflow series by the cardinality cap"),
+    "flightrec_dumps": ("counter", "flight-recorder postmortem dumps written"),
+    "step_trace_records": ("counter", "structured step-trace JSONL records emitted"),
+}
+
+# name -> (help, labels). All use the default ms latency ladder.
+HISTOGRAMS = {
+    "executor_step_phase_ms": (
+        "executor step wall time split by phase: feed (host prep + h2d, "
+        "includes rare builds), dispatch (compiled XLA step), fetch "
+        "(write-back + host conversion)", ("phase",)),
+    "serve_queue_wait_ms": (
+        "serving request wait from admission to batch assembly", ()),
+    "serve_assembly_ms": (
+        "serving batch-assembly time per scheduler tick", ()),
+    "serve_dispatch_ms": (
+        "serving compiled-dispatch time per batch (incl. retries)", ()),
+    "serve_e2e_ms": (
+        "serving request end-to-end latency, admission to respond — "
+        "engine-side truth; p50/p99 derive from the buckets", ()),
+    "ps_rpc_ms": (
+        "parameter-server RPC round-trip per attempt", ("op",)),
+    "kv_request_ms": (
+        "http_kv request round-trip per attempt (incl. wait polls)", ()),
+}
+
+
+def declare_standard_metrics(registry: MetricsRegistry) -> None:
+    """Declare the full catalog on ``registry`` (idempotent)."""
+    for name, (kind, help_) in SCALARS.items():
+        if kind == "gauge":
+            registry.gauge(name, help=help_)
+        else:
+            registry.counter(name, help=help_)
+    for name, (help_, labels) in HISTOGRAMS.items():
+        registry.histogram(name, help=help_, labels=labels,
+                           buckets=DEFAULT_LATENCY_BUCKETS_MS)

@@ -1,0 +1,206 @@
+"""Structured step tracing: a run-scoped ``StepTrace`` stamps a
+monotonically increasing step id into ``profiler.RecordEvent`` (and so
+``jax.profiler.TraceAnnotation``) scopes around the executor hot path,
+and emits one JSONL record per step — step id, phase durations
+(feed/dispatch/fetch), counter deltas, cache hit/miss, h2d bytes — so
+host spans correlate 1:1 with the XPlane device timeline from
+``profiler.start_profiler(trace_dir=...)``.
+
+Enable programmatically (``enable_step_trace(path)``) or with
+``PADDLE_STEP_TRACE=<file-or-dir>``; the executor checks
+``active_step_trace()`` per step (None = zero-overhead fast path).
+Every record also feeds the crash flight recorder's ring, so a
+postmortem dump carries the last N steps before the failure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["StepTrace", "enable_step_trace", "disable_step_trace",
+           "active_step_trace", "reset_step_trace"]
+
+_ENV = "PADDLE_STEP_TRACE"
+
+
+class _StepScope:
+    """One traced step: RAII scope with named phases.
+
+    ``phase(name)`` sub-scopes time the hot-path sections; ``set(k, v)``
+    attaches extra fields (cache_hit, h2d_bytes, ...) to the record."""
+
+    def __init__(self, trace: "StepTrace", step_id: int, kind: str):
+        self.step_id = step_id
+        self.kind = kind
+        self._trace = trace
+        self._phases: Dict[str, float] = {}
+        self._fields: Dict[str, object] = {}
+        self._t0 = None
+        self._counters0 = None
+        self._ev = None
+
+    def __enter__(self) -> "_StepScope":
+        from .. import profiler
+
+        self._counters0 = profiler.counters_snapshot()
+        # the step id IS the scope name: the XPlane/chrome-trace span
+        # for step 17 is literally "paddle_step_17", so a device-side
+        # slow step names the host-side JSONL record that explains it
+        self._ev = profiler.RecordEvent(
+            f"paddle_step_{self.step_id}").begin()
+        self._t0 = time.perf_counter()
+        return self
+
+    def phase(self, name: str):
+        return _PhaseScope(self, name)
+
+    def set(self, key: str, value) -> None:
+        self._fields[key] = value
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        from .. import profiler
+
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._ev is not None:
+            self._ev.end()
+        rec = {
+            "step": self.step_id,
+            "kind": self.kind,
+            "t": round(time.time(), 6),
+            "dur_ms": round(dur_ms, 3),
+            "phases": {k: round(v, 3) for k, v in self._phases.items()},
+            "counters": profiler.counters_delta(self._counters0),
+        }
+        rec.update(self._fields)
+        if exc is not None:
+            rec["error"] = type(exc).__name__
+        self._trace._write(rec)
+        return False
+
+
+class _PhaseScope:
+    __slots__ = ("_step", "_name", "_t0", "_ev")
+
+    def __init__(self, step: _StepScope, name: str):
+        self._step = step
+        self._name = name
+
+    def __enter__(self):
+        from .. import profiler
+
+        # stable phase names (step/feed, step/dispatch, step/fetch)
+        # aggregate in the profiler summary table; the enclosing
+        # paddle_step_<id> annotation carries the correlation id
+        self._ev = profiler.RecordEvent(f"step/{self._name}").begin()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = (time.perf_counter() - self._t0) * 1e3
+        self._ev.end()
+        phases = self._step._phases
+        phases[self._name] = phases.get(self._name, 0.0) + dt
+        return False
+
+
+class StepTrace:
+    """JSONL step-record writer. ``path`` may be a file or a directory
+    (per-process ``steptrace_<pid>.jsonl`` inside it)."""
+
+    def __init__(self, path: Optional[str] = None, flight: bool = True):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._flight = flight
+        self._fh = None
+        self.path = None
+        if path:
+            if path.endswith(os.sep) or os.path.isdir(path):
+                os.makedirs(path, exist_ok=True)
+                path = os.path.join(
+                    path, f"steptrace_{os.getpid()}.jsonl")
+            else:
+                d = os.path.dirname(path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+            self.path = path
+            # line-buffered: a crashed process keeps every whole record
+            self._fh = open(path, "a", buffering=1)
+
+    def step(self, kind: str = "step") -> _StepScope:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        return _StepScope(self, sid, kind)
+
+    def _write(self, rec: dict) -> None:
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+        if self._flight:
+            from .flight_recorder import flight_recorder
+
+            flight_recorder().record_step(
+                {k: rec[k] for k in ("step", "dur_ms", "phases")
+                 if k in rec})
+        from .metrics import default_registry
+
+        default_registry().inc_scalar("step_trace_records")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_active: Optional[StepTrace] = None
+_env_checked = False
+_lock = threading.Lock()
+
+
+def enable_step_trace(path: Optional[str] = None) -> StepTrace:
+    """Install the run-scoped global trace (returned for closing)."""
+    global _active, _env_checked
+    with _lock:
+        if _active is not None:
+            _active.close()
+        _active = StepTrace(path)
+        _env_checked = True
+    return _active
+
+
+def disable_step_trace() -> None:
+    global _active
+    with _lock:
+        if _active is not None:
+            _active.close()
+        _active = None
+
+
+def reset_step_trace() -> None:
+    """Forget trace AND the env check (tests flip PADDLE_STEP_TRACE)."""
+    global _env_checked
+    disable_step_trace()
+    with _lock:
+        _env_checked = False
+
+
+def active_step_trace() -> Optional[StepTrace]:
+    """The global trace, auto-created from ``PADDLE_STEP_TRACE`` on
+    first call; None (the executor's zero-cost path) when tracing is
+    off."""
+    global _active, _env_checked
+    if _active is None:
+        if _env_checked:
+            return None
+        with _lock:
+            if _active is None and not _env_checked:
+                _env_checked = True
+                p = os.environ.get(_ENV)
+                if p:
+                    _active = StepTrace(p)
+    return _active

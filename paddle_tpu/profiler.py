@@ -164,9 +164,6 @@ _state = {
 # These are process events, not per-executor ones, so Executor.counters
 # merges the FAULT_COUNTER_NAMES slice of this table into its view.
 # ---------------------------------------------------------------------------
-import threading as _threading
-from collections import Counter as _Counter
-
 FAULT_COUNTER_NAMES = (
     "retry_attempts", "retry_giveups", "faults_injected",
     "ckpt_commits", "ckpt_corrupt_skipped", "ckpt_fallbacks",
@@ -204,16 +201,41 @@ SERVE_COUNTER_NAMES = (
     "supervisor_drains", "supervisor_drain_kills",
 )
 
-_counters: _Counter = _Counter()
-# prefetch threads bump h2d_bytes concurrently with the training
-# thread's bumps; Counter's += is a read-modify-write
-_counters_lock = _threading.Lock()
+# The counter table is now the SCALAR TIER of the typed metrics
+# registry (paddle_tpu.observability.metrics): every name above is a
+# declared Counter/Gauge with help text (observability.catalog), the
+# registry adds labeled metrics + fixed-bucket latency histograms, and
+# every http_kv listener (KVServer, ServingHealthServer, the standalone
+# PADDLE_METRICS_PORT server) exposes the whole table as Prometheus
+# text at GET /metrics. The functions below are thin compat shims —
+# byte-identical snapshots, zero call-site churn.
+from .observability import metrics as _obs_metrics
+from .observability.catalog import declare_standard_metrics as _declare
+
+_REGISTRY = _obs_metrics.default_registry()
+_declare(_REGISTRY)
+# the registry lock doubles as the host-span state lock (RecordEvent
+# mutation vs summary()/export_chrome_tracing iteration)
+_state_lock = _REGISTRY.lock
+
+
+def metrics_registry() -> "_obs_metrics.MetricsRegistry":
+    """The process-global typed metrics registry behind the counter
+    shims — declare histograms/labeled metrics here; render with
+    ``render_prometheus()`` or scrape any KV/health listener's
+    ``/metrics``."""
+    return _REGISTRY
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition of the whole registry (the scrape-free
+    path; the HTTP form rides http_kv's GET /metrics)."""
+    return _REGISTRY.render_prometheus()
 
 
 def bump_counter(name: str, n: int = 1) -> None:
     """Add ``n`` to the global executor counter ``name`` (thread-safe)."""
-    with _counters_lock:
-        _counters[name] += n
+    _REGISTRY.inc_scalar(name, n)
 
 
 def set_counter(name: str, value: int) -> None:
@@ -221,26 +243,21 @@ def set_counter(name: str, value: int) -> None:
     (thread-safe). Used for point-in-time quantities — the xla_*_bytes
     memory-analysis numbers of the last-built executable — where
     accumulation would be meaningless."""
-    with _counters_lock:
-        _counters[name] = value
+    _REGISTRY.set_scalar(name, value)
 
 
 def counters_snapshot() -> dict:
     """Copy of the global executor counters (pair with counters_delta)."""
-    with _counters_lock:
-        return dict(_counters)
+    return _REGISTRY.flat_snapshot()
 
 
 def counters_delta(before: dict) -> dict:
     """Non-zero counter movement since ``before`` (a counters_snapshot)."""
-    with _counters_lock:
-        return {k: v - before.get(k, 0) for k, v in _counters.items()
-                if v - before.get(k, 0)}
+    return _REGISTRY.flat_delta(before)
 
 
 def reset_counters() -> None:
-    with _counters_lock:
-        _counters.clear()
+    _REGISTRY.reset_values()
 
 
 class RecordEvent:
@@ -270,17 +287,20 @@ class RecordEvent:
         if self._t0 is not None:
             t1 = time.perf_counter()
             dt = t1 - self._t0
-            rec = _state["events"][self.name]
-            rec[0] += 1
-            rec[1] += dt
-            rec[2] = min(rec[2], dt)
-            rec[3] = max(rec[3], dt)
             import threading
 
             ident = threading.get_ident()
-            tid = _state["tids"].setdefault(ident, len(_state["tids"]))
-            _state["spans"].append(
-                (self.name, self._t0 * 1e6, dt * 1e6, tid))
+            # registry lock: prefetch/serving threads end() concurrently
+            # with summary()/export_chrome_tracing iterating these
+            with _state_lock:
+                rec = _state["events"][self.name]
+                rec[0] += 1
+                rec[1] += dt
+                rec[2] = min(rec[2], dt)
+                rec[3] = max(rec[3], dt)
+                tid = _state["tids"].setdefault(ident, len(_state["tids"]))
+                _state["spans"].append(
+                    (self.name, self._t0 * 1e6, dt * 1e6, tid))
             self._t0 = None
 
     __enter__ = begin
@@ -298,10 +318,11 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
                    trace_dir: Optional[str] = None):
     """Enable host aggregation; with trace_dir, also start a device trace
     (reference profiler.py:131; state kept for API parity)."""
-    _state["enabled"] = True
-    _state["events"].clear()
-    _state["spans"].clear()
-    _state["tids"].clear()
+    with _state_lock:
+        _state["enabled"] = True
+        _state["events"].clear()
+        _state["spans"].clear()
+        _state["tids"].clear()
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
         jax.profiler.start_trace(trace_dir)
@@ -309,9 +330,12 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
 
 
 def stop_profiler(sorted_key: Optional[str] = "total",
-                  profile_path: Optional[str] = None):
-    """Disable profiling, write/print the aggregated event table
-    (reference profiler.py:198)."""
+                  profile_path: Optional[str] = None,
+                  print_table: bool = True):
+    """Disable profiling, write the aggregated event table to
+    ``profile_path`` or print it (reference profiler.py:198).
+    ``print_table=False`` silences the no-path default — library
+    callers and tests read the returned table instead of stdout."""
     _state["enabled"] = False
     if _state["trace_dir"]:
         jax.profiler.stop_trace()
@@ -323,14 +347,16 @@ def stop_profiler(sorted_key: Optional[str] = "total",
             os.makedirs(d, exist_ok=True)
         with open(profile_path, "w") as f:
             f.write(table)
-    else:
+    elif print_table:
         print(table)
     return table
 
 
 def summary(sorted_key: Optional[str] = "total") -> str:
     rows = []
-    for name, (calls, total, mn, mx) in _state["events"].items():
+    with _state_lock:   # recording threads mutate events concurrently
+        events = {k: list(v) for k, v in _state["events"].items()}
+    for name, (calls, total, mn, mx) in events.items():
         rows.append((name, calls, total, total / max(calls, 1), mn, mx))
     key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
         sorted_key or "total", 2)
@@ -352,13 +378,15 @@ def summary(sorted_key: Optional[str] = "total") -> str:
 @contextlib.contextmanager
 def profiler(state: str = "All", sorted_key: str = "total",
              profile_path: Optional[str] = None,
-             trace_dir: Optional[str] = None):
-    """`with profiler.profiler():` parity (reference profiler.py:255)."""
+             trace_dir: Optional[str] = None,
+             print_table: bool = True):
+    """`with profiler.profiler():` parity (reference profiler.py:255).
+    ``print_table`` forwards to :func:`stop_profiler`."""
     start_profiler(state, trace_dir=trace_dir)
     try:
         yield
     finally:
-        stop_profiler(sorted_key, profile_path)
+        stop_profiler(sorted_key, profile_path, print_table=print_table)
 
 
 def export_chrome_tracing(path: str, process_name: str = "paddle_tpu"):
@@ -370,7 +398,9 @@ def export_chrome_tracing(path: str, process_name: str = "paddle_tpu"):
 
     events = [{"name": "process_name", "ph": "M", "pid": 0,
                "args": {"name": process_name}}]
-    for name, start_us, dur_us, tid in _state["spans"]:
+    with _state_lock:   # recording threads append spans concurrently
+        spans = list(_state["spans"])
+    for name, start_us, dur_us, tid in spans:
         events.append({"name": name, "ph": "X", "pid": 0, "tid": tid,
                        "ts": start_us, "dur": dur_us, "cat": "host"})
     d = os.path.dirname(path)

@@ -85,6 +85,14 @@ def run_server(block: bool = True):
     port = int(os.environ.get("PADDLE_PORT", "0"))
     num_trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     kv_ep = os.environ.get("PADDLE_PS_KV_ENDPOINT")
+    # pserver scrape surface: the PS wire protocol is raw sockets, so
+    # /metrics rides a sidecar HTTP listener on PADDLE_METRICS_PORT
+    from ..observability.server import maybe_start_metrics_server
+
+    metrics_server = maybe_start_metrics_server()
+    if metrics_server is not None:
+        print(f"paddle_tpu pserver /metrics on 127.0.0.1:"
+              f"{metrics_server.port}")
     if kv_ep:
         from .replication import ReplicatedPSServer
 
@@ -117,9 +125,19 @@ def run_server(block: bool = True):
         server = PSServer(_tables_from_env(), port=port,
                           num_trainers=num_trainers).start()
         print(f"paddle_tpu pserver listening on {server.endpoint}")
+    server.metrics_server = metrics_server
     if block:
         def _drain(signum, frame):
             server.stop()
+            try:
+                from ..observability.flight_recorder import \
+                    flight_recorder
+
+                fr = flight_recorder()
+                fr.record("sigterm_drain", role="pserver")
+                fr.dump(reason="sigterm_drain")
+            except Exception:
+                pass
             sys.exit(0)
 
         try:

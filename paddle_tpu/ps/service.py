@@ -66,6 +66,20 @@ import numpy as np
 from ..fault import injector as _fault
 from ..fault.injector import _bump  # shared lazy counter shim
 from ..fault.retry import Backoff, Retrier, env_backoff, env_max_attempts
+from ..observability.flight_recorder import note_typed_error
+from ..observability.metrics import default_registry as _obs_registry
+
+_RPC_HIST = None
+
+
+def _rpc_hist():
+    """Cached ps_rpc_ms histogram handle — the per-RPC hot path must
+    not re-take the registry declaration lock on every round trip."""
+    global _RPC_HIST
+    if _RPC_HIST is None:
+        _RPC_HIST = _obs_registry().histogram("ps_rpc_ms",
+                                              labels=("op",))
+    return _RPC_HIST
 from .table import SparseTable
 
 (OP_PULL, OP_PUSH, OP_MERGE, OP_SAVE, OP_LOAD, OP_ROWS, OP_BARRIER,
@@ -702,10 +716,17 @@ class PSClient:
     def _exchange_once(self, k: int, frame: bytes, reader, fp_name: str):
         _fault.point(fp_name)
         s = self._sock(k)
+        t0 = time.perf_counter()
         try:
             s.sendall(frame)
             _read_reply(s, endpoint=self._eps[k])
-            return reader(s) if reader is not None else None
+            out = reader(s) if reader is not None else None
+            # RPC round-trip histogram, per successful attempt, labeled
+            # by fault-point name (ps.pull/ps.push/...): the PS latency
+            # truth the /metrics scrape derives p50/p99 from
+            _rpc_hist().observe((time.perf_counter() - t0) * 1e3,
+                                op=fp_name)
+            return out
         except PSReplyError:
             raise          # semantic error frame: stream is still in sync
         except (ConnectionError, OSError):
@@ -737,10 +758,12 @@ class PSClient:
             raise
         except (ConnectionError, OSError) as e:
             attempts = self._retrier.max_attempts if retry else 1
-            raise PSUnavailable(
+            err = PSUnavailable(
                 f"pserver {self._eps[k]} (shard {k}) unreachable after "
                 f"{attempts} attempt(s): {e!r}",
-                endpoint=self._eps[k], shard=k) from e
+                endpoint=self._eps[k], shard=k)
+            note_typed_error(err, where=fp_name)
+            raise err from e
 
     def _shard_call(self, k: int, build, reader, fp_name: str,
                     retry: bool = True, failover: bool = True):
@@ -809,10 +832,12 @@ class PSClient:
                 self._adopt_map(m)
                 return
             if self._clock() >= deadline:
-                raise PSUnavailable(
+                err = PSUnavailable(
                     f"pserver {dead} (shard {k}) died and no promotion "
                     f"was published within {self._failover_timeout}s",
-                    endpoint=dead, shard=k) from cause
+                    endpoint=dead, shard=k)
+                note_typed_error(err, where="ps.failover")
+                raise err from cause
             self._sleep(min(backoff.delay(attempt),
                             max(0.0, deadline - self._clock())))
             attempt += 1

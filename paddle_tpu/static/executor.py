@@ -44,8 +44,25 @@ import numpy as np
 from ..framework import dtype as dtype_mod
 from ..framework import random as random_mod
 from ..framework.place import CPUPlace
+from ..observability.flight_recorder import flight_recorder
+from ..observability.step_trace import active_step_trace
 from .ir import Block, Program, Variable, grad_var_name
 from .kernels import KERNELS, ExecContext
+
+_PHASE_HIST = None
+
+
+def _phase_hist():
+    """The executor_step_phase_ms histogram (feed/dispatch/fetch labels)
+    — engine-side latency truth for the training hot path, scraped at
+    /metrics and percentile-derivable from its buckets."""
+    global _PHASE_HIST
+    if _PHASE_HIST is None:
+        from ..observability.metrics import default_registry
+
+        _PHASE_HIST = default_registry().histogram(
+            "executor_step_phase_ms", labels=("phase",))
+    return _PHASE_HIST
 
 
 class Scope:
@@ -312,9 +329,14 @@ class Executor:
         # the xla_*_bytes gauges read its compiled.memory_analysis()
         self._last_entry: Optional[_ExecEntry] = None
         # per-executor view of the hot-path counters; the module-global
-        # aggregate lives in profiler._counters (bench reads that one)
+        # aggregate lives in the profiler's metrics registry (bench and
+        # the /metrics endpoint read that one)
         import collections
         self._counters = collections.Counter()
+        # trainer scrape surface: PADDLE_METRICS_PORT starts the
+        # process-wide /metrics server once (no-op when unset)
+        from ..observability.server import maybe_start_metrics_server
+        maybe_start_metrics_server()
 
     def _bump(self, name: str, n: int = 1):
         from .. import profiler
@@ -394,6 +416,55 @@ class Executor:
             scope: Optional[Scope] = None,
             return_numpy: bool = True,
             use_program_cache: bool = True):
+        """One step. The hot path is phase-instrumented: feed (host prep
+        + h2d, includes rare builds), dispatch (compiled XLA step), and
+        fetch (write-back + host conversion) land in the
+        ``executor_step_phase_ms`` histogram; with a StepTrace active
+        (``PADDLE_STEP_TRACE``) each step also emits a JSONL record
+        stamped ``paddle_step_<id>`` for XPlane correlation, and every
+        step rides the crash flight recorder's bounded ring."""
+        trace = active_step_trace()
+        tr_scope = trace.step("executor") if trace is not None else None
+        obs: Dict[str, Any] = {"t0": time.perf_counter()}
+        if tr_scope is not None:
+            tr_scope.__enter__()
+        try:
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache, obs)
+        finally:
+            self._finish_step_obs(obs, tr_scope)
+
+    def _finish_step_obs(self, obs, tr_scope) -> None:
+        """Close one step's observability: histogram observes, flight
+        ring append, step-trace record (exception-safe — runs in run()'s
+        finally with the in-flight exception, if any, via exc_info)."""
+        import sys as _sys
+
+        t_end = time.perf_counter()
+        t_feed, t_disp = obs.get("t_feed"), obs.get("t_dispatch")
+        phases: Dict[str, float] = {}
+        if t_disp is not None:
+            phases["feed"] = (t_feed - obs["t0"]) * 1e3
+            phases["dispatch"] = (t_disp - t_feed) * 1e3
+            phases["fetch"] = (t_end - t_disp) * 1e3
+            h = _phase_hist()
+            for name, ms in phases.items():
+                h.observe(ms, phase=name)
+            flight_recorder().record_step({
+                "exe_step": self._step,
+                "cache_hit": obs.get("cache_hit", False),
+                "h2d_bytes": obs.get("h2d_bytes", 0),
+                "phases": {k: round(v, 3) for k, v in phases.items()}})
+        if tr_scope is not None:
+            tr_scope._phases.update(phases)
+            if t_disp is not None:
+                tr_scope.set("exe_step", self._step)
+                tr_scope.set("cache_hit", obs.get("cache_hit", False))
+                tr_scope.set("h2d_bytes", obs.get("h2d_bytes", 0))
+            tr_scope.__exit__(*_sys.exc_info())
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache, obs):
         from .ir import default_main_program
         from .compiler import CompiledProgram
 
@@ -491,6 +562,7 @@ class Executor:
                 entry = _exec_cache_get(ck)
                 if entry is not None:
                     self._bump("compile_cache_hits")
+                    obs["cache_hit"] = True
         if entry is None:
             # rewrite the block through the IR pass pipeline, then look
             # up / build the executable by CONTENT — a cloned or
@@ -508,6 +580,7 @@ class Executor:
             entry = _exec_cache_get(ck) if use_program_cache else None
             if entry is not None:
                 self._bump("compile_cache_hits")
+                obs["cache_hit"] = True
             else:
                 is_gm = gm is not None and any(
                     op.type == "backward"
@@ -539,7 +612,10 @@ class Executor:
         if self._donate:
             self._bump("donated_bytes",
                        sum(_nbytes(a) for a in state) + _nbytes(rng))
+        obs["h2d_bytes"] = feed_h2d
+        obs["t_feed"] = time.perf_counter()
         fetches, new_state = compiled(feed_vals, state, rng)
+        obs["t_dispatch"] = time.perf_counter()
         write_back = getattr(scope, "_write_back", scope.set)
         for n, v in zip(persist_names, new_state):
             write_back(n, v)

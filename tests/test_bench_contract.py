@@ -182,6 +182,19 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
         assert key in last, f"bench row missing {key!r}"
     assert last["serve_requests_per_sec"] > 0, last
     assert last["serve_p99_ms"] >= last["serve_p50_ms"] > 0, last
+    # engine-side latency truth: the bucket-derived percentiles the
+    # engine's serve_e2e_ms / serve_queue_wait_ms histograms report —
+    # load_gen's client view is no longer the only latency record
+    for key in ("serve_engine_p50_ms", "serve_engine_p99_ms",
+                "serve_queue_wait_p50_ms", "serve_queue_wait_p99_ms",
+                "serve_client_p50_ms", "serve_client_p99_ms"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["serve_engine_p99_ms"] >= last["serve_engine_p50_ms"] > 0, \
+        last
+    assert last["serve_queue_wait_p99_ms"] >= \
+        last["serve_queue_wait_p50_ms"] >= 0, last
+    assert last["serve_client_p99_ms"] >= last["serve_client_p50_ms"] > 0, \
+        last
     assert last["serve_ok"] == last["serve_requests"] > 0, last
     assert last["serve_shed"] == 0, last
     assert last["serve_deadline_expired"] == 0, last

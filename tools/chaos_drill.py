@@ -171,6 +171,40 @@ def _read_log(path):
         return [json.loads(ln) for ln in f if ln.strip()]
 
 
+def _flightrec_dir(workdir: str) -> str:
+    return os.path.join(workdir, "flightrec")
+
+
+def _clean_flightrec(workdir: str) -> None:
+    d = _flightrec_dir(workdir)
+    if os.path.isdir(d):
+        for fn in os.listdir(d):
+            if fn.startswith("flightrec_"):
+                os.remove(os.path.join(d, fn))
+
+
+def _flightrec_report(workdir: str, error_name: str = "SystemExit") -> dict:
+    """Scan the drill's flight-recorder dumps: the postmortem contract
+    is that a killed process left a dump whose LAST recorded events
+    name the typed error that killed it."""
+    d = _flightrec_dir(workdir)
+    dumps = []
+    if os.path.isdir(d):
+        for fn in sorted(os.listdir(d)):
+            if fn.startswith("flightrec_") and fn.endswith(".json"):
+                try:
+                    with open(os.path.join(d, fn)) as f:
+                        dumps.append(json.load(f))
+                except (OSError, ValueError):
+                    pass
+    names_killer = any(
+        ev.get("error") == error_name
+        for dump in dumps for ev in dump.get("events", [])[-3:])
+    return {"dumps": len(dumps),
+            "reasons": [dump.get("reason") for dump in dumps],
+            "names_killer": names_killer}
+
+
 def run_drill(workdir: str, nranks: int = 2, epochs: int = 3,
               batches: int = 4, save_every: int = 2, kill_rank: int = 1,
               kill_after: int = 6, max_restarts: int = 2,
@@ -196,6 +230,7 @@ def run_drill(workdir: str, nranks: int = 2, epochs: int = 3,
     for p in logs.values():
         if os.path.exists(p):
             os.remove(p)
+    _clean_flightrec(workdir)
 
     def env_for(rank):
         env = dict(os.environ)
@@ -212,6 +247,9 @@ def run_drill(workdir: str, nranks: int = 2, epochs: int = 3,
             "DRILL_KILL_RANK": str(kill_rank if kill else -1),
             "DRILL_LEASE_TTL": repr(lease_ttl),
             "DRILL_LOG": logs[rank],
+            # every worker dumps a crash postmortem here; the report
+            # asserts the killed rank's dump names the SystemExit
+            "PADDLE_FLIGHTREC_DIR": _flightrec_dir(workdir),
         })
         if kill:
             env["PADDLE_FAULT_SPEC"] = (
@@ -272,6 +310,7 @@ def run_drill(workdir: str, nranks: int = 2, epochs: int = 3,
                                 and len(set(hexes)) == 1)
     report["generation_bumped"] = any(
         (g or 0) > 0 for g in report["generation"].values())
+    report["flightrec"] = _flightrec_report(workdir)
     survivor = next((r for r in range(nranks) if r != kill_rank), 0)
     report["ok"] = bool(
         rc == 0 and report["parity_bitwise"]
@@ -279,7 +318,11 @@ def run_drill(workdir: str, nranks: int = 2, epochs: int = 3,
                           and sup.stats()["restarts_by_rank"]
                           .get(kill_rank, 0) >= 1
                           and report["counters"][survivor]
-                          .get("worker_lost", 0) >= 1)))
+                          .get("worker_lost", 0) >= 1
+                          # postmortem contract: the killed rank left a
+                          # flight-recorder dump naming its killer
+                          and report["flightrec"]["dumps"] >= 1
+                          and report["flightrec"]["names_killer"])))
     return report
 
 
@@ -301,6 +344,7 @@ def _print_table(report: dict) -> None:
             print(f"{n:<24}" + "".join(
                 f"{report['counters'][r].get(n, 0):>6} "
                 for r in sorted(report["counters"])))
+    print(f"flightrec={report.get('flightrec')}")
     print(f"\nparity_bitwise={report['parity_bitwise']} "
           f"generation_bumped={report['generation_bumped']} "
           f"ok={report['ok']}")
@@ -361,6 +405,7 @@ def run_ps_drill(workdir: str, dim: int = 8, pushes: int = 12,
     from paddle_tpu.ps.table import SparseTable
 
     os.makedirs(workdir, exist_ok=True)
+    _clean_flightrec(workdir)
     job = "psdrill"
     counters0 = profiler.counters_snapshot()
     kv_port = _free_port()
@@ -396,6 +441,7 @@ def run_ps_drill(workdir: str, dim: int = 8, pushes: int = 12,
             "PADDLE_PS_LEASE_TTL": repr(lease_ttl),
             "PADDLE_PS_SYNC": "1" if sync else "0",
             "PADDLE_PS_EXIT_ON_CRASH": "1",
+            "PADDLE_FLIGHTREC_DIR": _flightrec_dir(workdir),
         })
         if kill:
             env["PADDLE_FAULT_SPEC"] = (
@@ -496,6 +542,7 @@ def run_ps_drill(workdir: str, dim: int = 8, pushes: int = 12,
 
     report["counters"] = {n: delta.get(n, 0) for n in PS_COUNTER_NAMES}
     report["promotions"] = coord.promotions
+    report["flightrec"] = _flightrec_report(workdir)
     report["ok"] = bool(
         "error" not in report
         and report.get("parity_bitwise")
@@ -505,7 +552,11 @@ def run_ps_drill(workdir: str, dim: int = 8, pushes: int = 12,
             and report["counters"]["ps_promotions"] >= 1
             and report.get("epoch", 1) >= 2
             and report.get("digest_parity")
-            and sup.stats()["restarts_by_rank"].get(0, 0) >= 1)))
+            and sup.stats()["restarts_by_rank"].get(0, 0) >= 1
+            # postmortem contract: the killed primary left a dump
+            # whose last events name the injected SystemExit
+            and report["flightrec"]["dumps"] >= 1
+            and report["flightrec"]["names_killer"])))
     return report
 
 
@@ -521,9 +572,11 @@ def _print_ps_table(report: dict) -> None:
     print(f"seq={report.get('seq')} "
           f"replicas_converged={report.get('replicas_converged')} "
           f"digest_parity={report.get('digest_parity')}")
-    print(f"\n{'counter':<24}{'value':>8}")
-    for name, value in sorted(report.get("counters", {}).items()):
-        print(f"{name:<24}{value:>8}")
+    from tools.metrics_watch import format_counter_table
+
+    print("\n" + format_counter_table(report.get("counters", {}),
+                                      name_width=24))
+    print(f"flightrec={report.get('flightrec')}")
     print(f"\nok={report['ok']}")
 
 

@@ -89,15 +89,21 @@ class LoadGen:
             with lock:
                 outcomes[kind] += 1
 
+        client_lat_ms = []
+
         def worker():
             while True:
                 i = next(counter)
                 if i >= self.total_requests:
                     return
                 feed = self.make_feed(self.sizes[i % len(self.sizes)], i)
+                t0 = time.perf_counter()
                 try:
                     self.engine.infer(feed, deadline_s=self.deadline_s,
                                       timeout=self.timeout_s)
+                    dt_ms = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        client_lat_ms.append(dt_ms)
                     record("ok")
                 except Overloaded:
                     record("shed")
@@ -122,6 +128,11 @@ class LoadGen:
         dt = time.perf_counter() - t0
         completed = sum(outcomes.values())
         lat = self.engine.latency_stats()
+        # engine-side truth: percentiles DERIVED FROM THE HISTOGRAM
+        # BUCKETS the engine records per request — the latency record a
+        # /metrics scraper sees, independent of this client's clocks
+        eng = self.engine.engine_latency_stats()
+        clat = np.asarray(client_lat_ms, np.float64)
         self.summary = {
             "requests": self.total_requests,
             "completed": completed,
@@ -139,6 +150,17 @@ class LoadGen:
             "p50_ms": lat["p50_ms"],
             "p99_ms": lat["p99_ms"],
             "mean_ms": lat["mean_ms"],
+            # client-observed: wall time around infer() in THIS process
+            # (submit -> result delivery, including handle wakeup)
+            "client_p50_ms": (round(float(np.percentile(clat, 50)), 3)
+                              if clat.size else 0.0),
+            "client_p99_ms": (round(float(np.percentile(clat, 99)), 3)
+                              if clat.size else 0.0),
+            # engine-reported: bucket-derived, scrape-reproducible
+            "engine_p50_ms": eng["e2e_p50_ms"],
+            "engine_p99_ms": eng["e2e_p99_ms"],
+            "queue_wait_p50_ms": eng["queue_wait_p50_ms"],
+            "queue_wait_p99_ms": eng["queue_wait_p99_ms"],
             **outcomes,
         }
         return self.summary

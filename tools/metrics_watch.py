@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Poll a paddle_tpu ``/metrics`` endpoint and print counter deltas as
+a table — the live-fleet companion to the chaos drills' post-run
+counter tables (same formatter).
+
+Any http_kv listener is a valid target: the elastic/PS coordination
+KVServer, a ServingHealthServer, or the standalone sidecar a trainer or
+pserver starts when ``PADDLE_METRICS_PORT`` is set.
+
+Usage::
+
+    python tools/metrics_watch.py --endpoint 127.0.0.1:8321 \
+        [--interval 2] [--count 0] [--filter serve_] [--all]
+
+Each poll prints the samples that MOVED since the previous poll (the
+first poll prints non-zero values); ``--all`` prints every sample every
+poll; ``--count N`` stops after N polls (0 = forever). Exit code 1 when
+the endpoint never answered.
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.observability.metrics import (  # noqa: E402
+    parse_prometheus_text,
+)
+
+
+def format_counter_table(counters: Dict[str, float],
+                         title: Optional[str] = None,
+                         name_width: int = 44) -> str:
+    """The chaos-drill counter-table format: one ``name  value`` row per
+    sorted counter (shared by tools/chaos_drill.py's PS report)."""
+    lines = []
+    if title:
+        lines.append(title)
+    lines.append(f"{'counter':<{name_width}}{'value':>12}")
+    for name, value in sorted(counters.items()):
+        v = int(value) if float(value) == int(value) else round(value, 3)
+        lines.append(f"{name:<{name_width}}{v:>12}")
+    return "\n".join(lines)
+
+
+def scrape(endpoint: str, timeout: float = 5.0) -> Dict[str, float]:
+    """One GET /metrics -> {sample_key: value} (histogram buckets keep
+    their ``name_bucket{le="..."}`` keys)."""
+    host, _, port = endpoint.replace("http://", "").rpartition(":")
+    conn = http.client.HTTPConnection(host or "127.0.0.1", int(port),
+                                      timeout=timeout)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+        if resp.status != 200:
+            raise RuntimeError(f"GET /metrics -> HTTP {resp.status}")
+        return parse_prometheus_text(body)
+    finally:
+        conn.close()
+
+
+def watch(endpoint: str, interval: float = 2.0, count: int = 0,
+          name_filter: str = "", show_all: bool = False,
+          out=sys.stdout) -> int:
+    """Poll loop; returns the number of successful scrapes."""
+    prev: Optional[Dict[str, float]] = None
+    polls = ok = 0
+    while count <= 0 or polls < count:
+        polls += 1
+        try:
+            cur = scrape(endpoint)
+        except (OSError, RuntimeError) as e:
+            print(f"[{time.strftime('%H:%M:%S')}] scrape failed: {e}",
+                  file=out)
+            if count <= 0 or polls < count:
+                time.sleep(interval)
+            continue
+        ok += 1
+        cur = {k: v for k, v in cur.items()
+               if not name_filter or name_filter in k}
+        if show_all:
+            shown = cur
+        elif prev is None:
+            shown = {k: v for k, v in cur.items() if v}
+        else:
+            shown = {k: v - prev.get(k, 0.0) for k, v in cur.items()
+                     if v != prev.get(k, 0.0)}
+        stamp = time.strftime("%H:%M:%S")
+        if shown:
+            title = (f"[{stamp}] {endpoint} "
+                     f"({'values' if prev is None or show_all else 'deltas'})")
+            print(format_counter_table(shown, title=title) + "\n",
+                  file=out)
+        else:
+            print(f"[{stamp}] {endpoint}: no movement", file=out)
+        prev = cur
+        if count <= 0 or polls < count:
+            time.sleep(interval)
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="poll a /metrics endpoint, print counter deltas")
+    ap.add_argument("--endpoint", required=True, help="host:port")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--count", type=int, default=0,
+                    help="polls before exiting (0 = forever)")
+    ap.add_argument("--filter", default="", dest="name_filter",
+                    help="substring filter on sample names")
+    ap.add_argument("--all", action="store_true", dest="show_all",
+                    help="print every sample each poll, not deltas")
+    args = ap.parse_args(argv)
+    ok = watch(args.endpoint, interval=args.interval, count=args.count,
+               name_filter=args.name_filter, show_all=args.show_all)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

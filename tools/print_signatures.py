@@ -30,6 +30,8 @@ MODULES = [
     "paddle_tpu.nn",
     "paddle_tpu.nn.functional",
     "paddle_tpu.nn.initializer",
+    "paddle_tpu.observability",
+    "paddle_tpu.observability.metrics",
     "paddle_tpu.ops",
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",
