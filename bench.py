@@ -464,6 +464,123 @@ def _serving_probe(requests=60, workers=4):
         }
 
 
+def _shard_probe_main(n_devices=8, steps=3):
+    """Child body of the MULTICHIP probe (run in a subprocess with
+    XLA_FLAGS=--xla_force_host_platform_device_count=N — the parent
+    process's jax is already initialized single-device). Exercises the
+    GSPMD static-executor path: a DP×TP compiled step from
+    BuildStrategy.mesh_shape + sharding_hints must match the single-chip
+    run within the established gm tolerance, and the
+    gradient-merge×pipeline composition reports its stage count and
+    analytic bubble. Prints ONE JSON dict on stdout."""
+    import time as _time
+
+    import paddle_tpu.static as static
+    from paddle_tpu.parallel.pipeline import gpipe_bubble_fraction
+    from paddle_tpu.utils import unique_name
+
+    H, B, K, S = 16, 8, 4, 2
+
+    def build(seed=77):
+        main, startup = static.Program(), static.Program()
+        main.random_seed = startup.random_seed = seed
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, H])
+            label = static.data("label", [-1, 1], dtype="int64")
+            h = static.nn.fc(x, 32, act="relu")
+            h = static.nn.fc(h, H, act="relu")
+            logits = static.nn.fc(h, 4)
+            loss = static.mean(
+                static.softmax_with_cross_entropy(logits, label))
+            static.SGD(0.05).minimize(loss)
+        return main, startup, loss, [p.name for p in
+                                     main.all_parameters()]
+
+    rng = np.random.RandomState(5)
+    feed = {"x": rng.randn(B, H).astype(np.float32),
+            "label": rng.randint(0, 4, (B, 1)).astype(np.int64)}
+
+    def run(strategy=None):
+        with unique_name.guard():
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                main, startup, loss, params = build()
+                exe = static.Executor()
+                exe.run(startup)
+                target = static.CompiledProgram(
+                    main, build_strategy=strategy) if strategy else main
+                losses = [float(np.ravel(exe.run(
+                    target, feed=feed, fetch_list=[loss])[0])[0])
+                    for _ in range(steps)]
+                t0 = _time.perf_counter()
+                for _ in range(steps):
+                    exe.run(target, feed=feed, fetch_list=[loss])
+                dt = _time.perf_counter() - t0
+                return losses, dt, dict(exe.counters), params
+
+    single, dt_single, _, params = run()
+    # column-parallel first fc, row-parallel second (the psum leg)
+    bs = static.BuildStrategy()
+    bs.mesh_shape = {"dp": 2, "tp": 2}
+    bs.sharding_hints = {params[0]: (None, "tp"),
+                         params[2]: ("tp", None)}
+    sharded, dt_shard, sc, _ = run(bs)
+    # GPipe schedule composed with the gradient-merge microbatch loop
+    bs_pp = static.BuildStrategy()
+    bs_pp.mesh_shape = {"dp": 2, "tp": 2}
+    bs_pp.sharding_hints = dict(bs.sharding_hints)
+    bs_pp.gradient_merge_k = K
+    bs_pp.pipeline_stages = S
+    _pp_losses, _dt_pp, pc, _ = run(bs_pp)
+    tokens = B * steps
+    print(json.dumps({
+        "shard_tokens_per_sec": round(tokens / dt_shard, 2),
+        "shard_single_tokens_per_sec": round(tokens / dt_single, 2),
+        "shard_parity_delta": max(
+            abs(a - b) for a, b in zip(single, sharded)),
+        "shard_psums_inserted": int(sc.get("shard_psums_inserted", 0)),
+        "shard_vars_annotated": int(sc.get("shard_vars_annotated", 0)),
+        "pp_stages": int(pc.get("pp_stages", 0)),
+        "pp_bubble_frac": round(gpipe_bubble_fraction(S, K), 4),
+        "shard_devices": n_devices,
+    }), flush=True)
+
+
+def _multichip_probe(n_devices=8, timeout=300):
+    """MULTICHIP probe: the DP×TP(×PP) static-executor legs, in a
+    SUBPROCESS so the forced multi-device CPU topology
+    (xla_force_host_platform_device_count) can apply — the parent's jax
+    is already initialized on the real backend. CPU rows stay
+    `comparable: false` like everything else; the parity/psum/bubble
+    fields are the contract (test_bench_contract pins them), the
+    tokens/s are movement-only."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count="
+                        f"{n_devices}").strip()
+    # pin the escape hatches like the in-process probes do: an inherited
+    # override would silently defang the pass under test
+    for k in ("PADDLE_IR_PASSES", "PADDLE_AMP", "PADDLE_AMP_LEVEL"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import bench; bench._shard_probe_main()"],
+        cwd=repo, env=env, capture_output=True, text=True,
+        timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"shard probe subprocess rc={out.returncode}: "
+            f"{out.stderr[-1000:]}")
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
 def bench_bert(seq=128, smoke=False, trend=False):
     """BASELINE.md config 3: BERT-base pretraining, tokens/sec/chip.
 
@@ -591,11 +708,20 @@ def bench_bert(seq=128, smoke=False, trend=False):
     except Exception as e:
         serving_probe = {"serving_probe_error":
                          f"{type(e).__name__}: {e}"}
+    # MULTICHIP probe (subprocess, 8 forced CPU devices): DP×TP parity
+    # vs single chip within the gm tolerance, psum accounting, and the
+    # gradient-merge×pipeline GPipe composition's stage count + bubble
+    try:
+        multichip_probe = _multichip_probe()
+    except Exception as e:
+        multichip_probe = {"multichip_probe_error":
+                           f"{type(e).__name__}: {e}"}
     return {
         **pass_probe,
         **amp_probe,
         **remat_probe,
         **serving_probe,
+        **multichip_probe,
         "value": tokens / dt, "unit": "tokens/s",
         "flops_per_step": flops_per_step,
         "steps_per_sec": steps / dt, "dt": dt, "steps": steps,

@@ -45,6 +45,12 @@ SCALARS = {
     "remat_recompute_vars": ("counter", "interior vars recomputed in the backward"),
     "gm_dispatches": ("counter", "gradient-merge steps dispatched"),
     "gm_microbatches": ("counter", "microbatches covered by gm dispatches"),
+    # GSPMD sharding propagation + pipeline schedule
+    "shard_vars_annotated": ("counter", "VarDescs stamped with a propagated PartitionSpec"),
+    "shard_conflicts_replicated": ("counter", "spec conflicts resolved by replication"),
+    "shard_psums_inserted": ("counter", "contracted/reduced sharded dims needing a psum (XLA SPMD materializes them)"),
+    "pp_stages": ("gauge", "pipeline stages of the last pipelined build (GPipe schedule)"),
+    "autotune_disk_hits": ("counter", "flash-attention autotune verdicts served from the persistent disk cache"),
     "xla_temp_bytes": ("gauge", "last built executable: XLA temp working set"),
     "xla_peak_bytes": ("gauge", "last built executable: arguments+outputs+temp bytes"),
     "xla_argument_bytes": ("gauge", "last built executable: argument bytes"),

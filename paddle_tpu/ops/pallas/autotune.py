@@ -37,8 +37,11 @@ _ITERS = 8
 
 # Verdicts persist across processes (the reference's cudnn algo cache is
 # process-local, but here every re-probe burns scarce tunnel minutes —
-# VERDICT r4 weak #5). One JSON file per device kind beside the backend
-# probe cache; write-through on every new verdict.
+# VERDICT r4 weak #5). One JSON file per device kind; dir resolution:
+# PADDLE_TPU_AUTOTUNE_CACHE_DIR > PADDLE_COMPILE_CACHE_DIR/autotune
+# (tuned configs relaunch alongside the persistent compiled steps;
+# disk hits bump the autotune_disk_hits profiler counter) > the backend
+# probe cache dir. Write-through on every new verdict.
 _disk: Dict[str, str] | None = None
 _stats = {"mem_hits": 0, "disk_hits": 0, "timed": 0}
 
@@ -47,6 +50,12 @@ def _cache_dir() -> str:
     p = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE_DIR")
     if p:
         return p
+    # co-locate tuned configs with the persistent compile cache: a
+    # relaunched trainer that skips its cold XLA compiles
+    # (PADDLE_COMPILE_CACHE_DIR) skips its timing rounds too
+    p = os.environ.get("PADDLE_COMPILE_CACHE_DIR")
+    if p:
+        return os.path.join(p, "autotune")
     from ...framework.bringup import cache_dir
 
     return cache_dir()
@@ -136,6 +145,12 @@ def best_short_window_impl(b, l, h, d, dtype, causal,
     hit = disk.get(_disk_key(key))
     if hit in ("short", "stream", "xla"):
         _stats["disk_hits"] += 1
+        try:
+            from ... import profiler
+
+            profiler.bump_counter("autotune_disk_hits")
+        except Exception:
+            pass  # counter is best-effort; the verdict still serves
         _cache[key] = hit
         return hit
 

@@ -2,13 +2,14 @@
 + transpiler + fleet meta-optimizer machinery — SURVEY.md §2.6)."""
 from .mesh import (  # noqa: F401
     create_mesh, get_mesh, set_mesh, replicated, data_sharding, axis_size,
-    AXES,
+    mesh_for_shape, AXES, DATA_AXIS_NAMES,
 )
 from .sharding import (  # noqa: F401
     shard_params, place_params, spec_for, TRANSFORMER_TP_RULES,
 )
 from .pipeline import (  # noqa: F401
     pipeline_apply, pipeline_1f1b_value_and_grad, stack_stage_params,
+    gpipe_schedule, gpipe_bubble_fraction,
 )
 from .ring import (  # noqa: F401
     ring_attention, ulysses_attention, ring_attention_local,

@@ -23,6 +23,12 @@ _global_mesh: list = [None]
 
 AXES = ("dp", "pp", "tp", "sp", "ep")
 
+# axes a feed's batch dim rides by default (data_sharding / the static
+# executor's feed shardings): plain data parallel ('dp') or the classic
+# CompiledProgram 'data' axis. Explicit batch axes (e.g. ('dp', 'sp'))
+# go through data_sharding(..., axes=...).
+DATA_AXIS_NAMES = ("dp", "data")
+
 
 def create_mesh(mesh_shape: Optional[Dict[str, int]] = None,
                 devices: Optional[Sequence] = None) -> Mesh:
@@ -97,11 +103,56 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
-def data_sharding(mesh: Mesh, batch_ndim: int = 1) -> NamedSharding:
-    """Shard leading (batch) dim over every data-like axis present."""
-    axes = [a for a in ("dp",) if a in mesh.axis_names]
+def data_sharding(mesh: Mesh, batch_ndim: int = 1,
+                  axes: Optional[Sequence[str]] = None) -> NamedSharding:
+    """Shard the leading (batch) dim over the mesh's data-like axes.
+
+    ``axes`` names the batch axes explicitly (e.g. ``('dp', 'sp')`` for
+    batch rows split over data AND sequence-parallel ranks); names absent
+    from the mesh are dropped. With ``axes=None`` the default derives
+    from the active mesh's axis names (every :data:`DATA_AXIS_NAMES`
+    axis present), so the executor's feed sharding works on any mesh
+    shape — 'dp', the classic CompiledProgram 'data' axis, or both."""
+    if axes is None:
+        axes = [a for a in DATA_AXIS_NAMES if a in mesh.axis_names]
+    else:
+        axes = [a for a in axes if a in mesh.axis_names]
     spec = [tuple(axes) if axes else None] + [None] * (batch_ndim - 1)
     return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+# (axis sizes tuple, device ids tuple) -> Mesh: the static executor
+# resolves BuildStrategy.mesh_shape through here on every step, so the
+# Mesh object must be stable (jax mesh/sharding caches key on identity)
+_mesh_cache: Dict[tuple, Mesh] = {}
+
+
+def mesh_for_shape(mesh_shape: Dict[str, int],
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """A Mesh of exactly ``mesh_shape`` (no dp-folding of leftover
+    devices, unlike :func:`create_mesh`) over the first
+    prod(sizes) local (or given) devices, cached — repeated calls with
+    the same shape return the SAME Mesh object and never touch the
+    ambient global mesh."""
+    devices = list(devices if devices is not None else _safe_devices())
+    sized = {str(k): int(v) for k, v in (mesh_shape or {}).items()
+             if int(v) > 1}
+    if not sized:
+        raise ValueError(f"mesh_for_shape: no axis with size > 1 in "
+                         f"{mesh_shape!r}")
+    total = int(np.prod(list(sized.values())))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh_shape {mesh_shape!r} needs {total} devices, have "
+            f"{len(devices)} (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=N for CPU tests)")
+    key = (tuple(sized.items()), tuple(id(d) for d in devices[:total]))
+    mesh = _mesh_cache.get(key)
+    if mesh is None:
+        arr = np.asarray(devices[:total]).reshape(tuple(sized.values()))
+        mesh = Mesh(arr, tuple(sized.keys()))
+        _mesh_cache[key] = mesh
+    return mesh
 
 
 def axis_size(mesh: Mesh, name: str) -> int:

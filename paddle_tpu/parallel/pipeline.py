@@ -133,6 +133,38 @@ def stack_stage_params(per_stage_params):
         lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
+def gpipe_schedule(num_stages: int, num_microbatches: int):
+    """The GPipe fill-drain tick grid as data: yields
+    ``(tick, [(stage, microbatch), ...])`` for every schedule tick.
+
+    With S stages and M microbatches there are S+M-1 ticks; at tick t,
+    stage s runs microbatch t-s when 0 <= t-s < M — the same grid
+    :func:`pipeline_apply` compiles as a masked scan. Within one tick
+    every (stage, microbatch) pair is data-independent (stage s consumes
+    what stage s-1 produced at tick t-1), which is what lets a consumer
+    run the pairs concurrently — the static executor's pipelined train
+    step (executor._pp_step_fn) drives its per-stage op ranges off this
+    grid. Stages are yielded in DESCENDING order so an in-place consumer
+    never overwrites an activation the same tick still reads.
+    """
+    s_count, m_count = int(num_stages), int(num_microbatches)
+    if s_count < 1 or m_count < 1:
+        raise ValueError(f"gpipe_schedule: need num_stages >= 1 and "
+                         f"num_microbatches >= 1, got ({num_stages}, "
+                         f"{num_microbatches})")
+    for t in range(s_count + m_count - 1):
+        yield t, [(s, t - s) for s in range(s_count - 1, -1, -1)
+                  if 0 <= t - s < m_count]
+
+
+def gpipe_bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """Analytic GPipe bubble: the idle fraction (S-1)/(S+M-1) of the
+    fill-drain schedule — the quantity the MULTICHIP bench probe reports
+    as ``pp_bubble_frac`` and that growing M amortises."""
+    s_count, m_count = int(num_stages), int(num_microbatches)
+    return (s_count - 1) / max(s_count + m_count - 1, 1)
+
+
 # ---------------------------------------------------------------------------
 # 1F1B schedule (PipeDream-flush) with activation recomputation
 # ---------------------------------------------------------------------------

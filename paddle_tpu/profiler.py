@@ -92,6 +92,22 @@ _state = {
 #   gm_dispatches / gm_microbatches  gradient-merge steps dispatched and
 #                      the microbatches they covered (microbatches /
 #                      dispatches = k)
+#
+# GSPMD sharding counters (shard_propagation pass in static/passes.py;
+# _pp_step_fn in static/executor.py):
+#   shard_vars_annotated  VarDescs stamped with a propagated
+#                      PartitionSpec (__sharding_spec attr) per build
+#   shard_conflicts_replicated  spec conflicts (disagreeing inputs,
+#                      reduced sharded dims on unknown ops) resolved by
+#                      replication
+#   shard_psums_inserted  contracted/reduced dims found sharded — each
+#                      is a psum XLA's SPMD partitioner materializes
+#                      (row-parallel matmul, dp loss reduction)
+#   pp_stages          GAUGE: pipeline stage count of the last
+#                      pipelined (GPipe-scheduled) build
+#   autotune_disk_hits flash autotune verdicts served from the
+#                      persistent disk cache (PADDLE_COMPILE_CACHE_DIR
+#                      co-location; ops/pallas/autotune.py)
 #   xla_temp_bytes / xla_peak_bytes / xla_argument_bytes /
 #   xla_output_bytes   GAUGES (set_counter, not accumulated): the last
 #                      built executable's compiled.memory_analysis() —
@@ -180,8 +196,11 @@ ELASTIC_COUNTER_NAMES = (
 )
 
 # process-level compile-cache counters merged into Executor.counters
-# (bumped by the jax monitoring listener in static/compile_cache.py)
-COMPILE_COUNTER_NAMES = ("disk_cache_hits", "disk_cache_misses")
+# (bumped by the jax monitoring listener in static/compile_cache.py;
+# autotune_disk_hits by ops/pallas/autotune.py — tuned kernel configs
+# persist alongside compiled steps under PADDLE_COMPILE_CACHE_DIR)
+COMPILE_COUNTER_NAMES = ("disk_cache_hits", "disk_cache_misses",
+                         "autotune_disk_hits")
 
 # parameter-server fault-tolerance counters (ps/replication.py replica
 # groups + ps/service.py hardened RPC), merged into Executor.counters

@@ -73,6 +73,29 @@ class BuildStrategy:
       gradient_merge_avg    divide the MERGED gradient by k once
                             (single-large-batch semantics); False sums
 
+    GSPMD sharding knobs (the shard_propagation pass, static/passes.py;
+    `PADDLE_IR_PASSES=0` disables them with the rest of the pipeline):
+
+      mesh_shape            {'dp': 2, 'tp': 2}-style axis sizes; non-empty
+                            turns on the shard_propagation pass and the
+                            executor compiles the step over a real
+                            jax.sharding.Mesh of that shape (the pjit
+                            in/out_shardings pattern). Axes named 'dp' /
+                            'data' carry the feed batch dim.
+      sharding_hints        {var_name: PartitionSpec-like tuple} seed
+                            specs, e.g. {'fc_w_0': (None, 'tp')} for a
+                            column-parallel weight or ('tp', None) for a
+                            row-parallel one (the pass counts the psum on
+                            the contracted dim). Specs propagate across
+                            every VarDesc through op-level rules; feeds
+                            default to batch-over-'dp'.
+      pipeline_stages       S > 1 splits the forward region into S
+                            contiguous stages and composes the
+                            gradient-merge microbatch loop into a
+                            GPipe-style fill-drain schedule (requires
+                            gradient_merge_k > 1 — the k microbatches
+                            are the pipeline's microbatches)
+
     Comm-layout knobs (reduce_strategy, fuse_all_reduce_ops) stay
     descriptive: XLA's SPMD partitioner owns cross-chip scheduling."""
 
@@ -93,6 +116,9 @@ class BuildStrategy:
         self.recompute_segments = 0
         self.gradient_merge_k = 1
         self.gradient_merge_avg = True
+        self.mesh_shape = {}
+        self.sharding_hints = {}
+        self.pipeline_stages = 1
         self.num_trainers = 1
         self.trainer_id = 0
 
@@ -137,16 +163,19 @@ class CompiledProgram:
             self._stash_amp_feed_dtypes()
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
-        from ..parallel.mesh import create_mesh, get_mesh
+        from ..parallel.mesh import DATA_AXIS_NAMES, create_mesh, get_mesh
         self._mesh = get_mesh()
-        if self._mesh is None or "data" not in self._mesh.axis_names:
+        if self._mesh is None or not any(
+                a in self._mesh.axis_names for a in DATA_AXIS_NAMES):
             n = len(places) if places else len(_safe_devices())
             self._mesh = create_mesh({"data": n})
         return self
 
     def _data_sharding(self):
         """Sharding map consumed by Executor._build: feed names -> sharding
-        (batch split over "data"), "__param__" -> replicated. Built once
+        (batch split over the mesh's data-like axes — mesh.data_sharding
+        derives them from the axis names, so a 'dp' mesh works as well as
+        the classic 'data' one), "__param__" -> replicated. Built once
         and cached — the executor applies it when state is first uploaded
         (and via in/out_shardings on the compiled step), so chained steps
         never re-partition resident state."""
@@ -157,7 +186,8 @@ class CompiledProgram:
         version = getattr(self._program, "_version", 0)
         if self._sharding_cache is None or \
                 self._sharding_cache[0] != version:
-            shard = NamedSharding(self._mesh, PartitionSpec("data"))
+            from ..parallel.mesh import data_sharding
+            shard = data_sharding(self._mesh)
             rep = NamedSharding(self._mesh, PartitionSpec())
             feeds = {v.name: shard for v in self._program.list_vars()
                      if v.desc.is_data}

@@ -222,10 +222,11 @@ def _state_signature(state) -> tuple:
 def _strategy_signature(strategy) -> tuple:
     if strategy is None:
         return ()
-    # scalar knobs plus scalar tuples/lists — bools select passes,
-    # strings/numbers carry the amp dtype/level/loss-scale and the
-    # gradient_merge_k, tuples the recompute checkpoint names (all shape
-    # which executable is built)
+    # scalar knobs plus scalar tuples/lists and shallow dicts — bools
+    # select passes, strings/numbers carry the amp dtype/level/loss-scale
+    # and the gradient_merge_k, tuples the recompute checkpoint names,
+    # dicts the mesh_shape/sharding_hints (all shape which executable is
+    # built)
     out = []
     for k, v in vars(strategy).items():
         if isinstance(v, (bool, int, float, str)):
@@ -233,6 +234,9 @@ def _strategy_signature(strategy) -> tuple:
         elif isinstance(v, (tuple, list)) and all(
                 isinstance(x, (bool, int, float, str)) for x in v):
             out.append((k, str(tuple(v))))
+        elif isinstance(v, dict):
+            out.append((k, repr(sorted(
+                (str(kk), repr(vv)) for kk, vv in v.items()))))
     return tuple(sorted(out))
 
 
@@ -277,18 +281,21 @@ def _exec_cache_put(key: str, entry: _ExecEntry) -> None:
 
 
 def _content_key(opt_program, feed_sig, fetch_names, persist_names,
-                 state_sig, sharding, donate, gm=None) -> str:
-    # gm (gradient merge) changes the compiled step's STRUCTURE (scan
-    # over microbatches) without touching the program content, so it
-    # must join the hash; remat changes the content itself (__remat_seg
-    # stamps) and needs no extra term
+                 state_sig, sharding, donate, gm=None, pp=None) -> str:
+    # gm (gradient merge) and pp (pipeline stage count) change the
+    # compiled step's STRUCTURE (scan / GPipe schedule over
+    # microbatches) without touching the program content, so they must
+    # join the hash; remat and sharding change the content itself
+    # (__remat_seg / __sharding_spec / __pp_stage stamps) and the
+    # sharding map additionally lands here via shard_desc (mesh shape +
+    # per-name NamedShardings)
     shard_desc = None
     if sharding:
         shard_desc = sorted((k, str(v)) for k, v in sharding.items())
     blob = json.dumps(
         [opt_program.to_dict(), list(feed_sig), list(fetch_names),
          list(persist_names), list(state_sig), shard_desc, bool(donate),
-         list(gm) if gm else None],
+         list(gm) if gm else None, pp],
         sort_keys=True, default=str).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()
 
@@ -509,10 +516,17 @@ class Executor:
         # dtype map on the program (like _feed_sharding) so py_reader
         # prefetch threads stage batches already low.
         from .passes import (amp_feed_dtypes_cached, resolve_amp,
-                             resolve_gradient_merge)
+                             resolve_gradient_merge, resolve_pipeline,
+                             resolve_sharding)
 
         amp = resolve_amp(strategy)
         gm = resolve_gradient_merge(strategy)
+        shard_cfg = resolve_sharding(strategy)
+        pp = resolve_pipeline(strategy)
+        if gm is None:
+            # mirrors apply_passes: pipeline_stages without
+            # gradient_merge_k > 1 has no microbatches to schedule
+            pp = None
         fdt = amp_feed_dtypes_cached(program, amp)
         program._amp_feed_dtypes = fdt
 
@@ -542,6 +556,32 @@ class Executor:
         persist_names = sorted(
             n for n, v in block.vars.items()
             if v.persistable and peek(n) is not None)
+        if shard_cfg is not None:
+            # GSPMD static sharding (BuildStrategy.mesh_shape +
+            # sharding_hints): build the real mesh and the jit-boundary
+            # sharding map — it REPLACES any CompiledProgram
+            # data-parallel map (mesh_shape is the more general spelling
+            # of the same thing) and rides program._feed_sharding so
+            # prefetch threads stage batches already partitioned.
+            # Memoized on the shapes that decide it (spec fitting checks
+            # divisibility against live shapes) — the warm path pays one
+            # key comparison, not a NamedSharding rebuild per step.
+            shard_key = (
+                program._version, shard_cfg, tuple(persist_names),
+                tuple(sorted((k, tuple(getattr(v, "shape", ())))
+                             for k, v in feed.items())))
+            cached = getattr(self, "_shard_map_cache", None)
+            if cached is not None and cached[0] == shard_key:
+                sharding = cached[1]
+            else:
+                from ..parallel.mesh import mesh_for_shape
+                from .passes import shard_boundary_shardings
+
+                mesh = mesh_for_shape(dict(shard_cfg[0]))
+                sharding = shard_boundary_shardings(
+                    mesh, block, feed, persist_names, shard_cfg, peek)
+                self._shard_map_cache = (shard_key, sharding)
+            program._feed_sharding = sharding
         feed_keys = sorted(feed.keys())
         feed_vals = [feed[k] for k in feed_keys]
         state = self._gather_state(scope, persist_names, feed_vals,
@@ -553,7 +593,8 @@ class Executor:
         state_sig = _state_signature(state)
         step_key = (program._version, feed_sig, tuple(fetch_names),
                     tuple(persist_names), state_sig, bool(sharding),
-                    _strategy_signature(strategy), amp, gm)
+                    _strategy_signature(strategy), amp, gm, shard_cfg,
+                    pp)
         per_prog = self._cache.setdefault(program, {})
         entry = None
         if use_program_cache:
@@ -575,7 +616,7 @@ class Executor:
             self._record_pass_report(report)
             ck = _content_key(opt_program, feed_sig, fetch_names,
                               persist_names, state_sig, sharding,
-                              self._donate, gm)
+                              self._donate, gm, pp)
             per_prog[step_key] = ck
             entry = _exec_cache_get(ck) if use_program_cache else None
             if entry is not None:
@@ -587,7 +628,8 @@ class Executor:
                     for op in opt_program.global_block.ops)
                 compiled_fn = self._build(
                     opt_program.global_block, feed_keys, fetch_names,
-                    persist_names, sharding, feed_vals, state, rng, gm)
+                    persist_names, sharding, feed_vals, state, rng, gm,
+                    pp)
                 entry = _ExecEntry(compiled_fn, opt_program, report,
                                    is_gm)
                 if use_program_cache:
@@ -654,11 +696,26 @@ class Executor:
                 host = np.asarray(arr)
                 # device_put_counted bumps the global h2d_bytes; the
                 # state-specific slice (and this executor's view) are
-                # tracked here
-                arr = device_put_counted(host, param_shard)
+                # tracked here. A per-name entry (shard_propagation's
+                # hinted params) beats the blanket __param__ fallback —
+                # the upload lands already tp/dp-partitioned.
+                arr = device_put_counted(
+                    host, sharding.get(n, param_shard)
+                    if sharding else None)
                 self._counters["h2d_bytes"] += host.nbytes
                 self._bump("state_h2d_bytes", host.nbytes)
                 write_back(n, arr)
+            elif sharding is not None:
+                # a resident array laid out for a DIFFERENT config (the
+                # user flipped sharding_hints/mesh_shape between runs on
+                # one scope) must be re-placed or the AOT step rejects
+                # the arg; a matching layout costs one equality check,
+                # and a reshard is device-to-device (no h2d)
+                target = sharding.get(n, param_shard)
+                if target is not None and \
+                        getattr(arr, "sharding", None) != target:
+                    arr = jax.device_put(arr, target)
+                    write_back(n, arr)
             if self._donate:
                 aliased = id(arr) in seen
                 seen.add(id(arr))
@@ -685,9 +742,17 @@ class Executor:
             self._bump(name, v)
         for name, v in getattr(report, "remat", {}).items():
             self._bump(name, v)
+        for name, v in getattr(report, "shard", {}).items():
+            if name == "pp_stages":   # point-in-time, not cumulative
+                from .. import profiler
+
+                self._counters[name] = v
+                profiler.set_counter(name, v)
+            else:
+                self._bump(name, v)
 
     def _build(self, block, feed_keys, fetch_names, persist_names,
-               sharding, feed_vals, state, rng, gm=None):
+               sharding, feed_vals, state, rng, gm=None, pp=None):
         """AOT-compile one step: jit -> lower() (trace_ms) -> compile()
         (compile_ms). The split makes trace vs XLA-compile time
         measurable, and compile() goes through jax's persistent
@@ -697,13 +762,19 @@ class Executor:
 
         With ``gm`` (resolve_gradient_merge result) and a backward op in
         the block, the step is compiled as a lax.scan over k microbatches
-        instead (_gm_step_fn)."""
+        instead (_gm_step_fn); with ``pp`` (resolve_pipeline stage count)
+        on top, the microbatch loop runs on the GPipe fill-drain schedule
+        over the ``__pp_stage``-stamped forward stages (_pp_step_fn)."""
 
         gm_bwd = None
         if gm is not None:
             gm_bwd = next((i for i, op in enumerate(block.ops)
                            if op.type == "backward"), None)
-        if gm_bwd is not None:
+        if gm_bwd is not None and pp is not None and pp > 1 and any(
+                "__pp_stage" in op.attrs for op in block.ops):
+            step = self._pp_step_fn(block, feed_keys, fetch_names,
+                                    persist_names, feed_vals, gm, gm_bwd)
+        elif gm_bwd is not None:
             step = self._gm_step_fn(block, feed_keys, fetch_names,
                                     persist_names, feed_vals, gm, gm_bwd)
         else:
@@ -724,16 +795,22 @@ class Executor:
             jit_kwargs["donate_argnums"] = (1, 2)
         if sharding is not None:
             param_shard = sharding.get("__param__")
+            # per-name entries (the shard_propagation boundary map:
+            # hinted tp/dp params) beat the blanket __param__ fallback;
+            # the classic data-parallel map has no per-name entries so
+            # this degenerates to the old [param_shard] * N
+            state_shards = [sharding.get(n, param_shard)
+                            for n in persist_names]
             in_shardings = (
                 [sharding.get(k) for k in feed_keys],
-                [param_shard] * len(persist_names),
-                None)
+                state_shards,
+                sharding.get("__rng__"))
             jit_kwargs["in_shardings"] = in_shardings
             # pin state OUTPUTS to the same layout: chained steps feed
             # new_state straight back in without re-partitioning
             jit_kwargs["out_shardings"] = (
                 [None] * len(fetch_names),
-                [param_shard] * len(persist_names))
+                state_shards)
         jitted = jax.jit(step, **jit_kwargs)
         t0 = time.perf_counter()
         lowered = jitted.lower(feed_vals, state, rng)
@@ -743,6 +820,53 @@ class Executor:
         self._bump("trace_ms", round((t1 - t0) * 1e3, 3))
         self._bump("compile_ms", round((t2 - t1) * 1e3, 3))
         return compiled
+
+    @staticmethod
+    def _merge_region(block, feed_keys, feed_vals, persist_names,
+                      fetch_names, k, bwd_idx):
+        """Split one training block at the backward boundary for a
+        k-microbatch merged step — shared by the gm scan and the GPipe
+        schedule (their parity depends on agreeing on this split).
+        Returns ``(scan_end, grad_names, found_name, state_carry,
+        carry_out, post_outs)``: ops [0, scan_end) run per microbatch
+        (forward + backward + an adjacent fp16 check_finite_and_unscale),
+        ops [scan_end, ...) are the optimizer region run once on the
+        merged gradient; state_carry is the per-microbatch persistable
+        writes, carry_out everything else the post region or a fetch
+        reads."""
+        for key, v in zip(feed_keys, feed_vals):
+            shp = tuple(getattr(v, "shape", ()))
+            if not shp or shp[0] % k:
+                raise ValueError(
+                    f"gradient_merge_k={k}: feed {key!r} batch dim "
+                    f"{shp[0] if shp else None} is not divisible by k")
+        ops = block.ops
+        scan_end = bwd_idx + 1
+        if scan_end < len(ops) and \
+                ops[scan_end].type == "check_finite_and_unscale":
+            scan_end += 1
+        grad_names = list(ops[bwd_idx].outputs.get("Grads", []))
+        found_name = None
+        if ops[scan_end - 1].type == "check_finite_and_unscale":
+            fo = ops[scan_end - 1].outputs.get("FoundInfinite")
+            found_name = fo[0] if fo else None
+        produced: set = set()
+        for op in ops[:scan_end]:
+            produced.update(op.output_names())
+        post_reads: set = set()
+        post_outs: set = set()
+        for op in ops[scan_end:]:
+            post_reads.update(op.input_names())
+            post_outs.update(op.output_names())
+        special = set(grad_names) | {found_name} - {None}
+        persist_set = set(persist_names)
+        # state written per microbatch rides the carry; everything else
+        # the post region or a fetch reads rides the stacked ys
+        state_carry = sorted(produced & persist_set)
+        carry_out = sorted(((post_reads | set(fetch_names)) & produced)
+                           - special - persist_set)
+        return (scan_end, grad_names, found_name, state_carry,
+                carry_out, post_outs)
 
     def _gm_step_fn(self, block, feed_keys, fetch_names, persist_names,
                     feed_vals, gm, bwd_idx):
@@ -776,37 +900,10 @@ class Executor:
         import numpy as _np
 
         k, avg = gm
-        for key, v in zip(feed_keys, feed_vals):
-            shp = tuple(getattr(v, "shape", ()))
-            if not shp or shp[0] % k:
-                raise ValueError(
-                    f"gradient_merge_k={k}: feed {key!r} batch dim "
-                    f"{shp[0] if shp else None} is not divisible by k")
-        ops = block.ops
-        scan_end = bwd_idx + 1
-        if scan_end < len(ops) and \
-                ops[scan_end].type == "check_finite_and_unscale":
-            scan_end += 1
-        grad_names = list(ops[bwd_idx].outputs.get("Grads", []))
-        found_name = None
-        if ops[scan_end - 1].type == "check_finite_and_unscale":
-            fo = ops[scan_end - 1].outputs.get("FoundInfinite")
-            found_name = fo[0] if fo else None
-        produced: set = set()
-        for op in ops[:scan_end]:
-            produced.update(op.output_names())
-        post_reads: set = set()
-        post_outs: set = set()
-        for op in ops[scan_end:]:
-            post_reads.update(op.input_names())
-            post_outs.update(op.output_names())
-        special = set(grad_names) | {found_name} - {None}
-        persist_set = set(persist_names)
-        # state written per microbatch rides the carry; everything else
-        # the post region or a fetch reads rides the stacked ys
-        state_carry = sorted(produced & persist_set)
-        carry_out = sorted(((post_reads | set(fetch_names)) & produced)
-                           - special - persist_set)
+        (scan_end, grad_names, found_name, state_carry, carry_out,
+         post_outs) = self._merge_region(block, feed_keys, feed_vals,
+                                         persist_names, fetch_names, k,
+                                         bwd_idx)
 
         def _micro(mb_feed, state_env, carried, key):
             env = dict(zip(feed_keys, mb_feed))
@@ -894,6 +991,137 @@ class Executor:
                     fetches.append(env[n])
             new_state = [env.get(n, s)
                          for n, s in zip(persist_names, state)]
+            return fetches, new_state
+
+        return step
+
+    def _pp_step_fn(self, block, feed_keys, fetch_names, persist_names,
+                    feed_vals, gm, bwd_idx):
+        """GPipe-composed gradient merge: the k microbatches of
+        BuildStrategy.gradient_merge_k flow through the
+        ``__pp_stage``-stamped forward stages on the GPipe fill-drain
+        schedule (parallel.pipeline.gpipe_schedule), still as ONE
+        compiled, donated, device-resident dispatch.
+
+        Differences from the plain gm scan (_gm_step_fn):
+
+        - the microbatch loop is schedule-ordered instead of sequential:
+          at tick t, stage s advances microbatch t-s — within a tick
+          every (stage, microbatch) pair is data-independent, which is
+          the property that lets XLA overlap the stages across a 'pp'
+          mesh axis (and on one chip compiles to the same math)
+        - a microbatch's backward (+ fp16 finite check) runs when it
+          retires from the last stage; f32 gradient accumulation happens
+          in retirement order == microbatch order, so the merged
+          gradient matches the scan's within reassociation roundoff
+        - persistable state written INSIDE the forward region does not
+          thread microbatch-to-microbatch (GPipe stages overlap, so
+          there is no earlier-microbatch value to read); every
+          microbatch sees the step-entry state and the LAST retired
+          microbatch's writes carry out — bn running stats behave like
+          classic GPipe, parameter updates are untouched (they live in
+          the post region)
+
+        Everything else (feed reshape, merged-gradient averaging,
+        FoundInfinite OR-reduce, loss-fetch averaging, single optimizer
+        region on the merged gradient) mirrors _gm_step_fn."""
+        from .. import profiler
+        from ..parallel.pipeline import gpipe_schedule
+
+        k, avg = gm
+        (scan_end, grad_names, found_name, state_carry, carry_out,
+         post_outs) = self._merge_region(block, feed_keys, feed_vals,
+                                         persist_names, fetch_names, k,
+                                         bwd_idx)
+        ops = block.ops
+
+        # stage op ranges from the __pp_stage stamps: stage s covers the
+        # absolute index range (start_s, end_s]; un-stamped prefix ops
+        # (feeds) ride stage 0, un-stamped trailing forward ops ride the
+        # last stage
+        stage_last: Dict[int, int] = {}
+        for i in range(bwd_idx):
+            sid = ops[i].attrs.get("__pp_stage")
+            if sid is not None:
+                stage_last[int(sid)] = i
+        n_stages = max(stage_last) + 1
+        ranges = []
+        start = 0
+        for s in range(n_stages):
+            end = bwd_idx if s == n_stages - 1 else stage_last[s] + 1
+            ranges.append((start, end))
+            start = end
+        self._counters["pp_stages"] = n_stages
+        profiler.set_counter("pp_stages", n_stages)
+
+        def step(feed_vals, state, rng):
+            state_env0 = dict(zip(persist_names, state))
+            mbs = [v.reshape((k, v.shape[0] // k) + tuple(v.shape[1:]))
+                   for v in feed_vals]
+            accum = None
+            grad_dtypes = None
+            found = jnp.zeros((), jnp.bool_)
+            carried: Dict[str, Any] = {}
+            ys = {n: [None] * k for n in carry_out}
+            live: Dict[int, tuple] = {}
+            for _t, pairs in gpipe_schedule(n_stages, k):
+                for s, m in pairs:
+                    if s == 0:
+                        env = dict(zip(feed_keys,
+                                       [mb[m] for mb in mbs]))
+                        env.update(state_env0)
+                        # same per-microbatch key derivation as the gm
+                        # scan: dropout masks match the scan leg bitwise
+                        live[m] = (env, ExecContext(
+                            rng_key=jax.random.fold_in(rng, m)))
+                    env, ctx = live[m]
+                    run_block(block, env, ctx,
+                              start=ranges[s][0], stop_at=ranges[s][1])
+                    if s == n_stages - 1:
+                        # microbatch m retires: backward + fp16 finite
+                        # check, then f32 accumulation
+                        run_block(block, env, ctx,
+                                  start=ranges[s][1], stop_at=scan_end)
+                        if grad_dtypes is None:
+                            grad_dtypes = [env[g].dtype
+                                           for g in grad_names]
+                        g = [env[gn].astype(jnp.float32)
+                             for gn in grad_names]
+                        accum = g if accum is None else \
+                            [a + b for a, b in zip(accum, g)]
+                        if found_name is not None:
+                            found = found | jnp.reshape(
+                                env[found_name], ()).astype(bool)
+                        carried = {n: env[n] for n in state_carry}
+                        for n in carry_out:
+                            ys[n][m] = env[n]
+                        del live[m]
+            env = dict(zip(feed_keys, feed_vals))  # full batch for post
+            env.update(state_env0)
+            env.update(carried)
+            env.update({n: ys[n][-1] for n in carry_out})
+            for gname, a, dt in zip(grad_names, accum or (),
+                                    grad_dtypes or ()):
+                merged = a / k if avg else a
+                env[gname] = merged.astype(dt)
+            if found_name is not None:
+                env[found_name] = jnp.reshape(found, (1,))
+            ctx = ExecContext(rng_key=rng)
+            env = run_block(block, env, ctx, start=scan_end)
+            fetches = []
+            for n in fetch_names:
+                if n in ys and n not in post_outs:
+                    stacked = jnp.stack(ys[n])
+                    if jnp.issubdtype(stacked.dtype, jnp.inexact):
+                        fetches.append(jnp.mean(
+                            stacked.astype(jnp.float32), axis=0
+                        ).astype(stacked.dtype))
+                    else:
+                        fetches.append(stacked[-1])
+                else:
+                    fetches.append(env[n])
+            new_state = [env.get(n, s_)
+                         for n, s_ in zip(persist_names, state)]
             return fetches, new_state
 
         return step
@@ -987,12 +1215,37 @@ class Executor:
         # parallelism but stays bounded — each slot pins device memory.
         # Under AMP, float32 feeds are cast low on the prefetch thread
         # BEFORE the h2d copy (half the transfer, amp_feed_dtypes).
-        from .passes import amp_feed_dtypes, resolve_amp
+        from .passes import (amp_feed_dtypes, resolve_amp,
+                             resolve_sharding, shard_boundary_shardings)
 
         feed_dtypes = amp_feed_dtypes(block, resolve_amp(strategy))
-        prefetcher = FeedPrefetcher(host_feeds(), depth=max(2, int(thread)),
-                                    sharding=sharding,
-                                    feed_dtypes=feed_dtypes)
+        shard_cfg = resolve_sharding(strategy)
+        if shard_cfg is not None:
+            # BuildStrategy.mesh_shape (GSPMD) beats the classic
+            # CompiledProgram data-parallel map, exactly as in _run_impl:
+            # batches must stage into the SAME layout the AOT step's
+            # in_shardings expect, or the dispatch rejects the committed
+            # arrays. Derived per batch (stage_feed runs on the prefetch
+            # thread) because divisibility is checked against the live
+            # batch shapes.
+            from ..parallel.mesh import mesh_for_shape
+            from .prefetch import stage_feed
+
+            shard_mesh = mesh_for_shape(dict(shard_cfg[0]))
+
+            def _stage(item):
+                m = shard_boundary_shardings(shard_mesh, block, item, (),
+                                             shard_cfg)
+                return stage_feed(item, m, feed_dtypes)
+
+            prefetcher = FeedPrefetcher(host_feeds(),
+                                        depth=max(2, int(thread)),
+                                        stage=_stage)
+        else:
+            prefetcher = FeedPrefetcher(host_feeds(),
+                                        depth=max(2, int(thread)),
+                                        sharding=sharding,
+                                        feed_dtypes=feed_dtypes)
         step = 0
         last_fetch = None
         try:

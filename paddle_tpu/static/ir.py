@@ -53,9 +53,13 @@ class VarDesc:
         self.stop_gradient = stop_gradient
         self.is_data = is_data
         self.lod_level = lod_level
+        # pass-stamped annotations (e.g. __sharding_spec from the
+        # shard_propagation pass) — serialized only when present, so
+        # un-stamped programs keep their exact dict/content-hash shape
+        self.attrs: Dict[str, Any] = {}
 
     def to_dict(self):
-        return {
+        out = {
             "name": self.name,
             "shape": list(self.shape) if self.shape is not None else None,
             "dtype": self.dtype,
@@ -64,12 +68,17 @@ class VarDesc:
             "is_data": self.is_data,
             "lod_level": self.lod_level,
         }
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        return out
 
     @staticmethod
     def from_dict(d):
-        return VarDesc(
+        v = VarDesc(
             d["name"], d["shape"], d["dtype"], d["persistable"],
             d["stop_gradient"], d["is_data"], d.get("lod_level", 0))
+        v.attrs = dict(d.get("attrs") or {})
+        return v
 
     def __repr__(self):
         return (f"VarDesc(name={self.name!r}, shape={self.shape}, "
@@ -220,6 +229,7 @@ class Block:
                 desc = ParamDesc(vd["name"], vd["shape"], vd["dtype"],
                                  trainable=vd.get("trainable", True))
                 desc.initializer_desc = vd.get("initializer")
+                desc.attrs = dict(vd.get("attrs") or {})
             else:
                 desc = VarDesc.from_dict(vd)
             self.vars[desc.name] = desc

@@ -41,6 +41,19 @@ Passes (BuildStrategy knob in parentheses):
       on each forward op; the executor's backward lowering wraps each
       segment in jax.checkpoint so interior activations are recomputed
       instead of stashed (Chen et al. sublinear memory)
+  shard_propagation      (strategy.mesh_shape/sharding_hints)  GSPMD
+      sharding annotation: user PartitionSpec hints (plus the
+      batch-over-'dp' feed default) propagate across every VarDesc
+      through op-level rules (matmul column/row parallel with psums
+      counted on contracted dims, elementwise pass-through, reductions
+      and losses resolve conflicts by replication) and are stamped as
+      ``__sharding_spec`` attrs; the executor turns the boundary stamps
+      into real NamedSharding in/out/state shardings on the compiled
+      step (shard_boundary_shardings)
+  pipeline_stages        (strategy.pipeline_stages)    forward region
+      split into S contiguous stages (``__pp_stage`` stamps); the
+      executor composes the gradient-merge microbatch loop with
+      parallel.pipeline.gpipe_schedule into a GPipe fill-drain schedule
   drop_unused_vars       (strategy.memory_optimize)    VarDescs no
       surviving op references (blob/content-hash shrink)
 
@@ -187,6 +200,80 @@ def resolve_recompute(strategy=None):
     return (cps, nseg)
 
 
+def resolve_sharding(strategy=None):
+    """Resolve the GSPMD sharding config for one build.
+
+    Returns ``(mesh_axes, hints)`` or ``None`` (single chip):
+    ``mesh_axes`` is a tuple of ``(axis_name, size)`` pairs in the
+    strategy's ``mesh_shape`` order (axes of size <= 1 dropped — they
+    select nothing) and ``hints`` a sorted tuple of
+    ``(var_name, spec_tuple)`` seed PartitionSpecs from
+    ``BuildStrategy.sharding_hints``. Spec entries are normalized to
+    ``None`` / axis-name / tuple-of-axis-names; axis names absent from
+    the mesh are dropped (the spec_for precedent), never an error.
+
+    ``PADDLE_IR_PASSES=0`` resolves to None like resolve_amp /
+    resolve_recompute: one escape restores the whole single-chip
+    baseline, bitwise."""
+    if os.environ.get("PADDLE_IR_PASSES") == "0":
+        return None
+    if strategy is None:
+        return None
+    shape = getattr(strategy, "mesh_shape", None) or {}
+    try:
+        axes = tuple((str(k), int(v)) for k, v in shape.items()
+                     if int(v) > 1)
+    except (TypeError, ValueError, AttributeError):
+        # AttributeError covers the likeliest misuse — a string or a
+        # pair list instead of a dict (no .items())
+        raise ValueError(
+            f"BuildStrategy.mesh_shape={shape!r}: expected "
+            f"{{axis_name: int_size}}")
+    if not axes:
+        return None
+    names = {k for k, _ in axes}
+
+    def _entry(e):
+        if e is None or e == "" or e == "None" or e == "-":
+            return None
+        if isinstance(e, (list, tuple)):
+            kept = tuple(str(a) for a in e if str(a) in names)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return str(e) if str(e) in names else None
+
+    hints = []
+    for name, spec in sorted(
+            (getattr(strategy, "sharding_hints", None) or {}).items()):
+        if spec is None:
+            spec = ()
+        if isinstance(spec, str):
+            spec = (spec,)
+        hints.append((str(name), tuple(_entry(e) for e in spec)))
+    return (axes, tuple(hints))
+
+
+def resolve_pipeline(strategy=None):
+    """Resolve the pipeline-schedule config for one build.
+
+    Returns the stage count ``S`` (> 1) or ``None``. With S > 1 and
+    ``gradient_merge_k > 1`` the executor composes the gradient-merge
+    microbatch loop with ``parallel.pipeline.gpipe_schedule`` into a
+    GPipe fill-drain schedule over S contiguous forward stages
+    (``__pp_stage`` stamps from the pipeline_stages pass).
+
+    ``PADDLE_IR_PASSES=0`` resolves to None with the rest of the
+    pipeline."""
+    if os.environ.get("PADDLE_IR_PASSES") == "0":
+        return None
+    if strategy is None:
+        return None
+    try:
+        s = int(getattr(strategy, "pipeline_stages", 1) or 1)
+    except (TypeError, ValueError):
+        s = 1
+    return s if s > 1 else None
+
+
 def resolve_gradient_merge(strategy=None):
     """Resolve the in-step gradient-merge config for one build.
 
@@ -318,6 +405,11 @@ class PassReport:
     # --remat prints
     remat: Dict[str, int] = field(default_factory=dict)
     remat_table: List[dict] = field(default_factory=list)
+    # sharding-propagation counters (shard_vars_annotated,
+    # shard_conflicts_replicated, shard_psums_inserted, pp_stages) + the
+    # per-var spec table dump_passes --sharding prints
+    shard: Dict[str, int] = field(default_factory=dict)
+    shard_table: List[dict] = field(default_factory=list)
 
     @property
     def removed(self) -> int:
@@ -341,6 +433,34 @@ class PassReport:
         if self.remat:
             lines.append("remat: " + "  ".join(
                 f"{k}={v}" for k, v in sorted(self.remat.items())))
+        if self.shard:
+            lines.append("shard: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(self.shard.items())))
+        return "\n".join(lines)
+
+    def shard_spec_table(self) -> str:
+        """Aligned per-var PartitionSpec table (tools/dump_passes.py
+        --sharding): the user hint, the propagated spec, and how it was
+        resolved (hint / data batch default / propagated /
+        conflict-replicated)."""
+        if not self.shard_table:
+            return "(no sharded vars)"
+
+        def fmt(spec):
+            if spec is None:
+                return "-"
+            return "(" + ", ".join(
+                "+".join(e) if isinstance(e, (list, tuple)) else
+                (str(e) if e is not None else "None")
+                for e in spec) + ")"
+
+        lines = [f"{'var':<38}{'hint':<16}{'spec':<22}resolution"]
+        for row in self.shard_table:
+            lines.append(f"{row['var']:<38}{fmt(row['hint']):<16}"
+                         f"{fmt(row['spec']):<22}{row['src']}")
+        if self.shard:
+            lines.append("shard counters: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(self.shard.items())))
         return "\n".join(lines)
 
     def remat_segment_table(self) -> str:
@@ -833,6 +953,367 @@ def _pass_recompute(ctx: _Ctx) -> None:
 
 
 # ---------------------------------------------------------------------------
+# GSPMD sharding propagation (PartitionSpec annotation over VarDescs)
+# ---------------------------------------------------------------------------
+# op families with dedicated propagation rules; anything else stops
+# propagation (outputs replicated) without counting a conflict
+_MATMUL_OPS = {"mul", "matmul", "matmul_v2"}
+_SHARD_UNARY = (_FUSABLE_ACTS
+                | {"cast", "scale", "assign", "dropout", "abs", "log",
+                   "log_softmax_none", "clip", "pow"})
+_SHARD_BINARY = _FUSABLE_BINARY | {"elementwise_pow",
+                                   "fused_elemwise_activation"}
+_SHARD_REDUCE = {"reduce_mean", "reduce_sum", "reduce_max", "reduce_min",
+                 "reduce_prod"}
+_SHARD_FULL_REDUCE = {"mean"}
+_SHARD_LOSSES = {"softmax_with_cross_entropy", "cross_entropy",
+                 "sigmoid_cross_entropy_with_logits"}
+
+
+def _spec_to_json(spec):
+    """Spec tuple -> JSON-safe list (axis tuples become lists)."""
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def _spec_from_json(spec):
+    """Inverse of _spec_to_json; None stays None."""
+    if spec is None:
+        return None
+    return tuple(tuple(e) if isinstance(e, list) else e for e in spec)
+
+
+def _spec_axes(entry):
+    """Axis names of one spec entry as a tuple (None -> ())."""
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def _fit_spec(spec, shape, axis_sizes):
+    """Clip/pad ``spec`` to ``shape``'s rank and drop entries whose axis
+    product does not divide the dim (the shard_params rule — an uneven
+    split would change numerics, replication never does). Dynamic dims
+    (-1/None shape) keep their entry: the executor re-checks against the
+    live array."""
+    nd = len(shape) if shape is not None else len(spec)
+    spec = tuple(spec[:nd]) + (None,) * (nd - len(spec))
+    fixed = []
+    for i, entry in enumerate(spec):
+        axes = tuple(a for a in _spec_axes(entry) if a in axis_sizes)
+        if not axes:
+            fixed.append(None)
+            continue
+        size = 1
+        for a in axes:
+            size *= axis_sizes[a]
+        dim = shape[i] if shape is not None and i < len(shape) else None
+        if dim is not None and int(dim) >= 0 and int(dim) % size != 0:
+            fixed.append(None)
+        else:
+            fixed.append(axes if len(axes) > 1 else axes[0])
+    return tuple(fixed)
+
+
+def _batch_entry(axis_sizes):
+    """The default batch-dim spec entry: every data-like mesh axis."""
+    from ..parallel.mesh import DATA_AXIS_NAMES
+
+    axes = tuple(a for a in DATA_AXIS_NAMES if a in axis_sizes)
+    return axes if len(axes) > 1 else (axes[0] if axes else None)
+
+
+def _pass_shard_propagation(ctx: _Ctx) -> None:
+    """Propagate PartitionSpecs from the user's sharding hints (plus the
+    batch-over-'dp' feed default) across every VarDesc and stamp the
+    result as ``__sharding_spec`` attrs — the cross-chip sibling of the
+    AMP/remat stamps (pure bookkeeping, no op added or reordered, but
+    the stamps join the program's content hash so hint flips can never
+    hit a stale executable).
+
+    Op-level rules (the naive-sharding-tree / pjit in_shardings pattern):
+
+    - matmul/fc (`mul`): a column-parallel weight hint ``(None, 'tp')``
+      shards the output's feature dim; a row-parallel hint
+      ``('tp', None)`` shards the CONTRACTED dim — the output needs a
+      psum over 'tp', counted in ``shard_psums_inserted`` and stamped as
+      ``__psum_axes`` on the op (XLA's SPMD partitioner materializes it)
+    - elementwise / activation / cast pass specs through; binary ops
+      merge per-dim, disagreeing non-replicated dims resolve to
+      replication (``shard_conflicts_replicated``)
+    - reductions and losses drop the reduced dims' sharding (a sharded
+      reduced dim is itself a psum) and keep surviving batch dims
+    - the batch dim of every data var rides the mesh's data axes
+      ('dp'/'data'); `backward` hands each param's spec to its grad, and
+      optimizer update ops keep the param's spec on the updated output
+
+    The interior specs are annotations (XLA propagates from the jit
+    boundary); the executor turns the BOUNDARY stamps — feeds and hinted
+    persistables — into real NamedSharding in/out/state shardings via
+    :func:`shard_boundary_shardings`, which derives the same specs by
+    construction."""
+    block = ctx.block
+    axis_sizes = dict(ctx.shard_axes)
+    hints = dict(ctx.shard_hints)
+    stats = ctx.shard_stats
+    specs: Dict[str, tuple] = {}
+    source: Dict[str, str] = {}
+
+    def shape_of(n):
+        v = block.vars.get(n)
+        return getattr(v, "shape", None)
+
+    def set_spec(n, spec, src):
+        spec = _fit_spec(spec, shape_of(n), axis_sizes)
+        if any(e is not None for e in spec):
+            specs[n] = spec
+            source.setdefault(n, src)
+        else:
+            specs.pop(n, None)
+
+    def merge(a, b):
+        """Per-dim join of two specs; disagreement replicates that dim.
+        Broadcasting aligns trailing dims (numpy rule), so the shorter
+        spec is right-aligned."""
+        if not a:
+            return b, 0
+        if not b:
+            return a, 0
+        la, lb = len(a), len(b)
+        n = max(la, lb)
+        a = (None,) * (n - la) + tuple(a)
+        b = (None,) * (n - lb) + tuple(b)
+        out, conflicts = [], 0
+        for ea, eb in zip(a, b):
+            if ea == eb or eb is None:
+                out.append(ea)
+            elif ea is None:
+                out.append(eb)
+            else:
+                out.append(None)
+                conflicts += 1
+        return tuple(out), conflicts
+
+    # seeds: user hints, then the batch default on data (feed) vars
+    for name, spec in hints.items():
+        if name in block.vars:
+            set_spec(name, spec, "hint")
+    batch = _batch_entry(axis_sizes)
+    if batch is not None:
+        for name, v in block.vars.items():
+            if v.is_data and name not in hints and v.shape:
+                set_spec(name, (batch,) + (None,) * (len(v.shape) - 1),
+                         "data")
+
+    for op in block.ops:
+        t = op.type
+        if t in ("feed", "fetch"):
+            continue
+        if t == "backward":
+            for p, g in zip(op.inputs.get("Params", ()),
+                            op.outputs.get("Grads", ())):
+                sp = specs.get(p)
+                if sp:
+                    set_spec(g, sp, "propagated")
+            continue
+        if t in _AMP_GATED_UPDATE_OPS or t == "adamw":
+            # the updated param (and any same-shaped slot outputs) keep
+            # the param's spec — state residency must not flip layouts
+            psp = specs.get((op.inputs.get("Param") or [None])[0])
+            for n in op.output_names():
+                if psp and shape_of(n) == shape_of(
+                        (op.inputs.get("Param") or [None])[0]):
+                    set_spec(n, psp, "propagated")
+            continue
+        if t in _MATMUL_OPS:
+            x = (op.inputs.get("X") or [None])[0]
+            y = (op.inputs.get("Y") or [None])[0]
+            sx, sy = specs.get(x), specs.get(y)
+            if t == "mul":
+                ncol = int(op.attrs.get("x_num_col_dims", 1))
+                contracted = list(_spec_axes(e) for e in (sx or ())[ncol:])
+                lead = tuple((sx or ())[:ncol]) + (None,) * (
+                    ncol - len((sx or ())[:ncol]))
+                tail = (sy[-1],) if sy else (None,)
+                if sy and len(sy) > 1:
+                    contracted.extend(_spec_axes(e) for e in sy[:-1])
+            else:
+                if op.attrs.get("transpose_X") or \
+                        op.attrs.get("transpose_Y") or \
+                        op.attrs.get("trans_x") or op.attrs.get("trans_y"):
+                    for n in op.output_names():
+                        specs.pop(n, None)
+                    continue
+                lead = tuple((sx or ())[:-1]) if sx else ()
+                tail = (sy[-1],) if sy else (None,)
+                contracted = [_spec_axes((sx or (None,))[-1])]
+                if sy and len(sy) > 1:
+                    contracted.append(_spec_axes(sy[-2]))
+            psum_axes = sorted({a for axes in contracted for a in axes})
+            for n in op.output_names():
+                # LEFT-pad to the output's rank: the tail entry belongs
+                # to the LAST (feature) dim — _fit_spec right-pads, and
+                # with an untracked X (lead shorter than rank-1) that
+                # would drift the feature axis onto a batch dim
+                spec = lead + tail
+                nd = len(shape_of(n) or ())
+                if nd and len(spec) < nd:
+                    spec = (None,) * (nd - len(spec)) + spec
+                set_spec(n, spec, "propagated")
+            if psum_axes:
+                op.attrs["__psum_axes"] = psum_axes
+                stats["shard_psums_inserted"] += 1
+            continue
+        if t in _SHARD_BINARY:
+            x = (op.inputs.get("X") or [None])[0]
+            y = (op.inputs.get("Y") or [None])[0]
+            out_spec, conflicts = merge(specs.get(x), specs.get(y))
+            if conflicts:
+                stats["shard_conflicts_replicated"] += conflicts
+                for n in op.output_names():
+                    source.setdefault(n, "conflict")
+            for n in op.output_names():
+                set_spec(n, out_spec or (), "propagated")
+            continue
+        if t in _SHARD_UNARY:
+            x = (op.inputs.get("X") or [None])[0]
+            sp = specs.get(x)
+            for n in op.output_names():
+                if sp and shape_of(n) is not None and \
+                        len(shape_of(n)) != len(sp):
+                    specs.pop(n, None)   # rank change (e.g. dropout Mask)
+                else:
+                    set_spec(n, sp or (), "propagated")
+            continue
+        if t in _SHARD_REDUCE or t in _SHARD_FULL_REDUCE:
+            x = (op.inputs.get("X") or [None])[0]
+            sp = specs.get(x)
+            if not sp:
+                for n in op.output_names():
+                    specs.pop(n, None)
+                continue
+            if t in _SHARD_FULL_REDUCE:
+                reduced = range(len(sp))
+                kept: list = []
+            else:
+                dims = op.attrs.get("dim")
+                if dims is None:
+                    reduced = range(len(sp))
+                else:
+                    dims = [dims] if isinstance(dims, int) else list(dims)
+                    reduced = {d % len(sp) for d in dims}
+                keep_dim = bool(op.attrs.get("keep_dim"))
+                kept = [None if i in reduced else e
+                        for i, e in enumerate(sp)] if keep_dim else \
+                    [e for i, e in enumerate(sp) if i not in reduced]
+            if any(_spec_axes(sp[i]) for i in reduced):
+                # reducing a sharded dim IS a cross-device psum
+                stats["shard_psums_inserted"] += 1
+                op.attrs["__psum_axes"] = sorted(
+                    {a for i in reduced for a in _spec_axes(sp[i])})
+            for n in op.output_names():
+                set_spec(n, tuple(kept), "propagated")
+            continue
+        if t in _SHARD_LOSSES:
+            lg = (op.inputs.get("Logits") or op.inputs.get("X")
+                  or [None])[0]
+            sp = specs.get(lg)
+            if sp and _spec_axes(sp[-1]):
+                stats["shard_psums_inserted"] += 1
+                op.attrs["__psum_axes"] = sorted(_spec_axes(sp[-1]))
+            out_spec = (tuple(sp[:-1]) + (None,)) if sp else ()
+            for n in op.output_names():
+                set_spec(n, out_spec, "propagated")
+            continue
+        # unknown op: propagation stops, outputs replicated
+        for n in op.output_names():
+            specs.pop(n, None)
+
+    table = []
+    for name in sorted(set(specs) | set(hints)):
+        spec = specs.get(name)
+        if spec is not None and name in block.vars:
+            block.vars[name].attrs["__sharding_spec"] = _spec_to_json(spec)
+        table.append({
+            "var": name,
+            "hint": _spec_to_json(tuple(hints[name]))
+            if name in hints else None,
+            "spec": _spec_to_json(spec) if spec else None,
+            "src": source.get(name,
+                              "replicated" if spec is None else
+                              "propagated"),
+        })
+    stats["shard_vars_annotated"] += sum(
+        1 for name in specs if name in block.vars)
+    ctx.shard_table = table
+
+
+def _pass_pipeline_stages(ctx: _Ctx) -> None:
+    """Split the forward region into ``pipeline_stages`` contiguous
+    stages and stamp each forward op with ``__pp_stage`` — the remat
+    pass's even-split mechanics, reused as GPipe stage boundaries. The
+    executor's ``_pp_step_fn`` drives gpipe_schedule over the stamped op
+    ranges; the stamps join the content hash so stage-count flips
+    recompile."""
+    block = ctx.block
+    n_stages = ctx.pp_stages
+    first_bwd = next((i for i, op in enumerate(block.ops)
+                      if op.type == "backward"), None)
+    if first_bwd is None:
+        return
+    fwd = [i for i in range(first_bwd)
+           if block.ops[i].type not in ("feed", "fetch")]
+    if len(fwd) < n_stages:
+        return
+    per = -(-len(fwd) // n_stages)  # ceil
+    for j, i in enumerate(fwd):
+        block.ops[i].attrs["__pp_stage"] = j // per
+    ctx.shard_stats["pp_stages"] = max(
+        block.ops[i].attrs["__pp_stage"] for i in fwd) + 1
+
+
+def shard_boundary_shardings(mesh, block, feed, persist_names,
+                             shard_cfg, peek=None):
+    """The jit-boundary sharding map for one sharded build: ``{feed name
+    -> NamedSharding, persistable name -> NamedSharding, '__param__' ->
+    replicated fallback}`` — what Executor._build installs as
+    in/out/state shardings and _gather_state uses for the one-time state
+    upload.
+
+    Specs derive from the SAME seeds the shard_propagation pass stamps
+    (hints for persistables, hints-else-batch-axes for feeds), checked
+    against the live array shapes for divisibility — so the map agrees
+    with the stamped program by construction, and a cache-hit step (no
+    pass run) still shards identically."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axes, hints_t = shard_cfg
+    axis_sizes = dict(axes)
+    hints = dict(hints_t)
+
+    def named(spec):
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    out = {"__param__": named(()), "__rng__": named(())}
+    batch = _batch_entry(axis_sizes)
+    for k, v in feed.items():
+        shape = tuple(getattr(v, "shape", ()) or ())
+        spec = hints.get(k)
+        if spec is None:
+            spec = ((batch,) + (None,) * (len(shape) - 1)
+                    if batch is not None and shape else ())
+        out[k] = named(_fit_spec(spec, shape, axis_sizes))
+    for n in persist_names:
+        spec = hints.get(n)
+        if not spec:
+            continue
+        arr = peek(n) if peek is not None else None
+        shape = tuple(getattr(arr, "shape", None)
+                      or getattr(block.vars.get(n), "shape", None) or ())
+        out[n] = named(_fit_spec(spec, shape, axis_sizes))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # auto mixed precision (bf16/fp16 compute, f32 master weights)
 # ---------------------------------------------------------------------------
 def _pass_auto_mixed_precision(ctx: _Ctx) -> None:
@@ -1165,7 +1646,8 @@ _PIPELINE = (
 def pass_names() -> List[str]:
     return (["auto_mixed_precision"]
             + [name for name, _, _ in _PIPELINE]
-            + ["recompute_segmentation", "drop_unused_vars"])
+            + ["recompute_segmentation", "shard_propagation",
+               "pipeline_stages", "drop_unused_vars"])
 
 
 def apply_passes(program: Program, feed_names: Sequence[str],
@@ -1187,8 +1669,16 @@ def apply_passes(program: Program, feed_names: Sequence[str],
                if getattr(strategy, knob, True)]
     amp = resolve_amp(strategy)
     remat = resolve_recompute(strategy)
+    shard = resolve_sharding(strategy)
+    pp = resolve_pipeline(strategy)
+    if pp is not None and resolve_gradient_merge(strategy) is None:
+        # the GPipe schedule's microbatches ARE the gradient-merge
+        # microbatches — without gradient_merge_k > 1 there is nothing
+        # to pipeline, so don't stamp __pp_stage (a content-hash flip)
+        # or publish a pp_stages gauge for a schedule that never runs
+        pp = None
     if os.environ.get("PADDLE_IR_PASSES") == "0" \
-            or not (enabled or amp or remat):
+            or not (enabled or amp or remat or shard or pp):
         return program, PassReport([], n0, n0, 0.0)
 
     t_all = time.perf_counter()
@@ -1229,6 +1719,30 @@ def apply_passes(program: Program, feed_names: Sequence[str],
                               (time.perf_counter() - t0) * 1e3))
         remat_counts = {k: int(v) for k, v in ctx.remat_stats.items() if v}
         remat_table = ctx.remat_table
+    shard_counts: Dict[str, int] = {}
+    shard_table: List[dict] = []
+    if shard is not None or pp is not None:
+        # runs after remat (stamps only, like remat — DCE has settled
+        # the op list so the annotated vars are the ones that trace)
+        ctx.shard_stats = defaultdict(int)
+        ctx.shard_table = []
+        if shard is not None:
+            ctx.shard_axes, ctx.shard_hints = shard
+            n = len(opt.global_block.ops)
+            t0 = time.perf_counter()
+            _pass_shard_propagation(ctx)
+            stats.append(PassStat("shard_propagation", n, n,
+                                  (time.perf_counter() - t0) * 1e3))
+        if pp is not None:
+            ctx.pp_stages = pp
+            n = len(opt.global_block.ops)
+            t0 = time.perf_counter()
+            _pass_pipeline_stages(ctx)
+            stats.append(PassStat("pipeline_stages", n, n,
+                                  (time.perf_counter() - t0) * 1e3))
+        shard_counts = {k: int(v) for k, v in ctx.shard_stats.items()
+                        if v}
+        shard_table = ctx.shard_table
     vars_dropped = 0
     if getattr(strategy, "memory_optimize", True):
         n = len(opt.global_block.ops)
@@ -1240,5 +1754,5 @@ def apply_passes(program: Program, feed_names: Sequence[str],
     total_ms = (time.perf_counter() - t_all) * 1e3
     report = PassReport(stats, n0, len(opt.global_block.ops), total_ms,
                         vars_dropped, amp_counts, remat_counts,
-                        remat_table)
+                        remat_table, shard_counts, shard_table)
     return opt, report

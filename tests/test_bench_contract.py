@@ -105,9 +105,11 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
                            BENCH_SEQ="16", BENCH_STEPS="1",
                            BENCH_NO_PERSIST="0",
                            BENCH_CAPTURES_PATH=str(captures))
+    # total deadline covers the in-process probes PLUS the multichip
+    # subprocess probe (fresh interpreter + 8-virtual-device compiles)
     rc, lines, _ = _run_streaming(
         [sys.executable, BENCH], env,
-        first_row_deadline=60, total_deadline=180)
+        first_row_deadline=60, total_deadline=240)
     assert rc == 0
     rows = [json.loads(ln) for ln in lines if ln.startswith("{")]
     assert rows, lines
@@ -201,6 +203,23 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["serve_degraded"] == 0 and last["serve_failed"] == 0, last
     assert 0 < last["serve_batch_fill_pct"] <= 100.0, last
     assert last["serve_batches"] <= last["serve_requests"], last
+    # MULTICHIP probe contract: the DP×TP static-executor step (forced
+    # 8-device CPU topology in a subprocess) matches the single-chip
+    # loss within the established gm tolerance, the row-parallel hint
+    # really produced psum accounting, and the gradient-merge×pipeline
+    # composition reports its GPipe stage count + analytic bubble (CPU
+    # rows stay comparable: false — the fields are the contract, the
+    # tokens/s are movement-only)
+    for key in ("shard_tokens_per_sec", "shard_parity_delta",
+                "shard_psums_inserted", "pp_bubble_frac", "pp_stages",
+                "shard_vars_annotated"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["shard_tokens_per_sec"] > 0, last
+    assert last["shard_parity_delta"] <= 1.2e-7, last
+    assert last["shard_psums_inserted"] >= 1, last
+    assert last["shard_vars_annotated"] > 0, last
+    assert last["pp_stages"] == 2, last
+    assert 0.0 < last["pp_bubble_frac"] < 1.0, last
 
 
 @pytest.mark.slow

@@ -195,3 +195,42 @@ def test_all_failed_leaves_cache_empty(monkeypatch, interpret_pallas):
     assert autotune.short_window_choice(q, q, False, 0.0) is None
     assert autotune.cached_choices() == {}, (
         "a transient failure must not pin a process-wide verdict")
+
+
+def test_compile_cache_dir_colocates_and_counts(monkeypatch,
+                                               interpret_pallas,
+                                               tmp_path):
+    """With no explicit autotune dir, verdicts persist under
+    PADDLE_COMPILE_CACHE_DIR/autotune — tuned configs relaunch alongside
+    the compiled steps — and a disk hit bumps the process-global
+    autotune_disk_hits counter (COMPILE_COUNTER_NAMES slice)."""
+    import os
+
+    import paddle_tpu.utils.timing as timing
+    from paddle_tpu import profiler
+
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    monkeypatch.setattr(bringup, "TPU_PLATFORMS", ("cpu", "tpu"))
+    monkeypatch.delenv("PADDLE_TPU_AUTOTUNE_CACHE_DIR", raising=False)
+    monkeypatch.setenv("PADDLE_COMPILE_CACHE_DIR",
+                       str(tmp_path / "xla_cache"))
+    autotune.reset()
+    assert autotune._cache_dir() == str(tmp_path / "xla_cache" /
+                                        "autotune")
+    times = iter([3.0, 1.0])
+    monkeypatch.setattr(timing, "timeit", lambda fn, *a, **k: next(times))
+    q = _q(l=128)
+    assert autotune.short_window_choice(q, q, False, 0.0) == "xla"
+    assert os.path.exists(autotune._disk_path())
+    # fresh "process": the verdict reloads from the co-located cache and
+    # the counter records the saved timing round
+    before = profiler.counters_snapshot().get("autotune_disk_hits", 0)
+    autotune.reset()
+    monkeypatch.setattr(
+        timing, "timeit",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("warm shape must not re-time")))
+    assert autotune.short_window_choice(q, q, False, 0.0) == "xla"
+    assert autotune.stats()["disk_hits"] == 1
+    assert profiler.counters_snapshot()["autotune_disk_hits"] == \
+        before + 1

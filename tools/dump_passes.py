@@ -30,6 +30,14 @@ Rematerialization: --remat [N] enables the recompute_segmentation pass
 var names via --checkpoints a,b) and prints the per-segment table: ops
 per segment, stashed (boundary) vs recomputed (interior) var counts and
 estimated bytes.
+
+Sharding: --sharding [dp=2,tp=2] enables the shard_propagation pass
+over that mesh shape and prints the per-var PartitionSpec table (hint
+vs propagated vs conflict-replicated). Seed specs ride --shard-hints
+"w0=-,tp;w1=tp,-" (dims comma-separated, '-' = replicated,
+'dp+sp' = multi-axis dim); without hints the demo auto-hints the first
+divisible 2-D parameters column-/row-parallel so the psum accounting
+shows up. No devices are touched — the pass is pure annotation.
 """
 from __future__ import annotations
 
@@ -114,6 +122,47 @@ def _amp_table(program, report):
     return "\n".join(lines)
 
 
+def _parse_shard_hints(spec, program, mesh_shape):
+    """'w0=-,tp;w1=tp,-' -> {name: spec tuple}. With no spec given,
+    auto-hint: the first 2-D trainable params whose dims divide the
+    'tp' axis get column-/row-parallel seeds, so the demo's propagation
+    (and the psum on the row-parallel contraction) is visible without
+    memorizing parameter names."""
+    if spec:
+        hints = {}
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, _, dims = entry.partition("=")
+            parsed = []
+            for d in dims.split(","):
+                d = d.strip()
+                if d in ("", "-", "None"):
+                    parsed.append(None)
+                elif "+" in d:
+                    parsed.append(tuple(a for a in d.split("+") if a))
+                else:
+                    parsed.append(d)
+            hints[name.strip()] = tuple(parsed)
+        return hints
+    tp = mesh_shape.get("tp", 0)
+    if tp <= 1:
+        return {}
+    hints, want = {}, [(1, (None, "tp")), (0, ("tp", None))]
+    for p in program.all_parameters():
+        if not want:
+            break
+        shape = p.shape or ()
+        if len(shape) != 2:
+            continue
+        dim, spec_t = want[0]
+        if shape[dim] and shape[dim] % tp == 0:
+            hints[p.name] = spec_t
+            want.pop(0)
+    return hints
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="print per-pass op-count/timing table for a program")
@@ -139,6 +188,16 @@ def main():
     ap.add_argument("--checkpoints", default=None,
                     help="comma-separated checkpoint var names marking "
                          "remat segment boundaries (implies --remat)")
+    ap.add_argument("--sharding", nargs="?", const="dp=2,tp=2",
+                    default=None, metavar="MESH",
+                    help="run the shard_propagation pass over this mesh "
+                         "shape (axis=size pairs, default dp=2,tp=2) and "
+                         "print the per-var PartitionSpec table")
+    ap.add_argument("--shard-hints", default=None, metavar="HINTS",
+                    help="seed PartitionSpecs: 'w0=-,tp;w1=tp,-' "
+                         "(';'-separated vars, ','-separated dims, '-' = "
+                         "replicated, '+' joins multi-axis dims); "
+                         "implies --sharding")
     ap.add_argument("--dot", default=None,
                     help="write the optimized block as graphviz dot")
     args = ap.parse_args()
@@ -178,6 +237,17 @@ def main():
         if args.checkpoints:
             strategy.recompute_checkpoints = tuple(
                 s for s in args.checkpoints.split(",") if s)
+    if args.sharding or args.shard_hints:
+        mesh_shape = {}
+        for part in (args.sharding or "dp=2,tp=2").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            axis, _, size = part.partition("=")
+            mesh_shape[axis.strip()] = int(size or 2)
+        strategy.mesh_shape = mesh_shape
+        strategy.sharding_hints = _parse_shard_hints(
+            args.shard_hints, program, mesh_shape)
 
     optimized, report = static.apply_passes(program, feeds, fetches,
                                             strategy)
@@ -188,6 +258,9 @@ def main():
     if args.remat is not None or args.checkpoints:
         print()
         print(report.remat_segment_table())
+    if args.sharding or args.shard_hints:
+        print()
+        print(report.shard_spec_table())
     if args.dot:
         static.save_dot(optimized, args.dot)
         print(f"optimized block dot -> {args.dot}")

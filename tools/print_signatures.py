@@ -35,6 +35,7 @@ MODULES = [
     "paddle_tpu.ops",
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",
+    "paddle_tpu.parallel",
     "paddle_tpu.profiler",
     "paddle_tpu.ps",
     "paddle_tpu.ps.replication",
