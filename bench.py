@@ -77,13 +77,21 @@ CPU_TREND = {"layers": 2, "batch": 4, "seq": 128, "steps": 10}
 # software-regression class, not to be a perf claim
 CPU_TREND_BASELINE = {"bert": 198.5}
 
-# bf16 peak FLOP/s per chip by device_kind substring (lowercased match,
-# first hit wins — "v5 lite" must precede the bare "v5")
-PEAK_FLOPS = (
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
-    ("v6", 918e12), ("trillium", 918e12), ("v4", 275e12),
-    ("v3", 123e12), ("v2", 45e12),
-)
+# bf16 peak FLOP/s per chip now live in
+# paddle_tpu/observability/device_peaks.py (with an HBM-bandwidth
+# column for the roofline plane) — the ONE home of every MFU
+# denominator: this file, the executor's live mfu gauge, and
+# tools/perf_report.py all resolve through it. ``bench.PEAK_FLOPS``
+# stays importable (lazy module attr, so importing bench still touches
+# neither jax nor paddle_tpu before the signal net is armed).
+
+
+def __getattr__(name):
+    if name == "PEAK_FLOPS":
+        from paddle_tpu.observability.device_peaks import PEAK_FLOPS
+
+        return PEAK_FLOPS
+    raise AttributeError(f"module 'bench' has no attribute {name!r}")
 
 
 def _device_kind():
@@ -96,11 +104,9 @@ def _device_kind():
 
 
 def _peak_flops(kind: str):
-    k = kind.lower()
-    for sub, peak in PEAK_FLOPS:
-        if sub in k:
-            return peak
-    return None
+    from paddle_tpu.observability.device_peaks import peak_flops
+
+    return peak_flops(kind)
 
 
 def attach_mfu(row: dict) -> dict:
@@ -115,6 +121,73 @@ def attach_mfu(row: dict) -> dict:
         mfu = round(fps * row["steps"] / row["dt"] / peak, 4)
     row.update(device_kind=kind, mfu=mfu)
     return row
+
+
+def _transformer_ir_flops(layers, batch, seq, hidden, ffn, vocab,
+                          dec_layers=0, head_transform=True):
+    """IR-derived train-step model FLOPs for a transformer-shaped
+    static probe built at the row's EXACT shapes: per encoder layer
+    qkv+out projections, scores/values matmuls and the ffn pair (+ a
+    cross-attention block per decoder layer), plus the vocab head —
+    walked by static/cost_model.py, the same per-op rules behind the
+    executor's live mfu gauge. The bench rows report this next to the
+    hand-coded closed form and gate the relative delta <= 2%
+    (ir_flops_delta), so the two accountings can never silently drift.
+
+    Graph construction only — no Scope, no execution, no device."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static.cost_model import program_cost
+    from paddle_tpu.utils import unique_name
+
+    H = hidden
+
+    def attention(h, kv):
+        # 3 H->H projections + out proj (the closed form's 8H^2/token),
+        # scores q@k^T and probs@v (its 4*S*H/token)
+        q = static.nn.fc(h, H, num_flatten_dims=2)
+        k = static.nn.fc(kv, H, num_flatten_dims=2)
+        v = static.nn.fc(kv, H, num_flatten_dims=2)
+        probs = static.softmax(static.matmul(q, k, transpose_y=True))
+        return static.nn.fc(static.matmul(probs, v), H,
+                            num_flatten_dims=2)
+
+    def ffn_block(h):
+        h = static.nn.fc(h, ffn, num_flatten_dims=2, act="relu")
+        return static.nn.fc(h, H, num_flatten_dims=2)
+
+    with unique_name.guard():
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, seq, H])
+            h = x
+            for _ in range(layers):
+                h = ffn_block(attention(h, h))
+            if dec_layers:
+                y = static.data("y", [-1, seq, H])
+                enc = h
+                h = y
+                for _ in range(dec_layers):
+                    h = attention(h, h)          # decoder self-attention
+                    h = ffn_block(attention(h, enc))  # cross-attention
+            if head_transform:
+                h = static.nn.fc(h, H, num_flatten_dims=2)
+            logits = static.nn.fc(h, vocab, num_flatten_dims=2)
+            loss = static.mean(logits)
+            static.SGD(0.01).minimize(loss)
+        report = program_cost(
+            main, feed_shapes={"x": (batch, seq, H)})
+    return int(report.model_flops)
+
+
+def _ir_flops_fields(ir_flops, closed_form):
+    """The row fields the cross-check satellite pins: the cost-model
+    count, and its relative delta vs the closed form (<= 0.02 gated by
+    test_bench_contract)."""
+    return {
+        "ir_flops_per_step": int(ir_flops),
+        "ir_flops_delta": round(
+            abs(ir_flops - closed_form) / max(closed_form, 1), 6),
+    }
 
 
 def _time_steps(step, args, steps):
@@ -669,6 +742,15 @@ def bench_bert(seq=128, smoke=False, trend=False):
     fwd_per_token = L * (8 * H * H + 4 * H * I + 4 * seq * H) \
         + 2 * H * H + 2 * H * V
     flops_per_step = 3 * fwd_per_token * batch * seq
+    # IR cross-check: the cost model walks a static probe at these
+    # exact shapes; its count must stay within 2% of the closed form
+    try:
+        ir_probe = _ir_flops_fields(
+            _transformer_ir_flops(layers=L, batch=batch, seq=seq,
+                                  hidden=H, ffn=I, vocab=V),
+            flops_per_step)
+    except Exception as e:
+        ir_probe = {"ir_flops_error": f"{type(e).__name__}: {e}"}
     # dispatch truth (VERDICT r3 weak #8): pallas_fallback reflects the
     # real kernel-dispatch counters, not just compile exceptions — on an
     # eligible backend, zero Pallas engagements = fallback, whatever the
@@ -722,6 +804,7 @@ def bench_bert(seq=128, smoke=False, trend=False):
         **remat_probe,
         **serving_probe,
         **multichip_probe,
+        **ir_probe,
         "value": tokens / dt, "unit": "tokens/s",
         "flops_per_step": flops_per_step,
         "steps_per_sec": steps / dt, "dt": dt, "steps": steps,
@@ -828,8 +911,19 @@ def bench_nmt(smoke=False):
     enc = LE * (8 * H * H + 4 * H * I + 4 * seq * H)
     dec = LE * (16 * H * H + 4 * H * I + 8 * seq * H) + 2 * H * V
     flops_per_step = 3 * (enc + dec) * batch * seq
+    # IR cross-check, like the bert row: cost-model count on an
+    # encoder+decoder probe at these shapes, delta <= 2% vs closed form
+    try:
+        ir_probe = _ir_flops_fields(
+            _transformer_ir_flops(layers=LE, batch=batch, seq=seq,
+                                  hidden=H, ffn=I, vocab=V,
+                                  dec_layers=LE, head_transform=False),
+            flops_per_step)
+    except Exception as e:
+        ir_probe = {"ir_flops_error": f"{type(e).__name__}: {e}"}
     # tokens/sec counts source + target tokens processed per step
-    return {"value": 2 * batch * seq * steps / dt, "unit": "tokens/s",
+    return {**ir_probe,
+            "value": 2 * batch * seq * steps / dt, "unit": "tokens/s",
             "dt": dt, "steps": steps, "batch": batch, "seq": seq,
             "flops_per_step": flops_per_step}
 

@@ -18,21 +18,24 @@ text exposition, structured step tracing, and a crash flight recorder.
 from . import metrics  # noqa: F401  (stdlib-only, safe under profiler)
 from .metrics import (CONTENT_TYPE, Counter, Gauge,  # noqa: F401
                       Histogram, MetricsRegistry, default_registry,
-                      parse_prometheus_text, render_prometheus)
+                      parse_prometheus_text, percentile_from_buckets,
+                      render_prometheus)
 from .flight_recorder import (FlightRecorder,  # noqa: F401
                               flight_recorder, note_typed_error,
                               reset_flight_recorder)
-from .step_trace import (StepTrace, active_step_trace,  # noqa: F401
-                         disable_step_trace, enable_step_trace,
-                         reset_step_trace)
+from .step_trace import (SCHEMA_VERSION, StepTrace,  # noqa: F401
+                         active_step_trace, disable_step_trace,
+                         enable_step_trace, reset_step_trace)
+from . import device_peaks  # noqa: F401  (stdlib-only peak registry)
 
 __all__ = [
     "CONTENT_TYPE", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry", "render_prometheus", "parse_prometheus_text",
+    "percentile_from_buckets",
     "FlightRecorder", "flight_recorder", "note_typed_error",
     "reset_flight_recorder",
-    "StepTrace", "active_step_trace", "enable_step_trace",
-    "disable_step_trace", "reset_step_trace",
+    "SCHEMA_VERSION", "StepTrace", "active_step_trace",
+    "enable_step_trace", "disable_step_trace", "reset_step_trace",
     "MetricsServer", "start_metrics_server",
     "maybe_start_metrics_server", "stop_metrics_server",
 ]

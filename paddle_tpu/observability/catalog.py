@@ -95,6 +95,13 @@ SCALARS = {
     "metrics_label_overflow": ("counter", "label sets folded into the overflow series by the cardinality cap"),
     "flightrec_dumps": ("counter", "flight-recorder postmortem dumps written"),
     "step_trace_records": ("counter", "structured step-trace JSONL records emitted"),
+    # graph-derived cost model (static/cost_model.py over the optimized
+    # Program IR, folded with the compiled step structure)
+    "step_model_flops": ("gauge", "cost-model model FLOPs of the last dispatched step (matmul-class, train multipliers + gm/remat/shard folded in)"),
+    "step_hbm_bytes": ("gauge", "cost-model HBM payload bytes of the last dispatched step (dtype-aware reads+writes)"),
+    "step_comm_bytes": ("gauge", "cost-model cross-chip bytes of the last dispatched step (psum ring all-reduce accounting)"),
+    "mfu": ("gauge", "model FLOPs utilization of the last step: step_model_flops / measured dispatch+fetch seconds / device peak FLOP/s"),
+    "arith_intensity": ("gauge", "step arithmetic intensity, FLOPs per HBM byte — compare against the device machine balance for roofline position"),
 }
 
 # name -> (help, labels). All use the default ms latency ladder.

@@ -25,6 +25,7 @@ __all__ = [
     "CONTENT_TYPE", "DEFAULT_LATENCY_BUCKETS_MS", "Counter", "Gauge",
     "Histogram", "MetricsRegistry", "default_registry",
     "render_prometheus", "parse_prometheus_text",
+    "percentile_from_buckets",
 ]
 
 # the Prometheus text exposition format version this module renders
@@ -234,21 +235,36 @@ class Histogram(_Metric):
     def percentile(self, q: float, **labels) -> float:
         """q in [0, 100]. 0.0 when empty; the last finite bound when the
         quantile lands in the +Inf bucket."""
-        snap = self.snapshot(**labels)
-        total = snap["count"]
-        if total == 0:
-            return 0.0
-        rank = (float(q) / 100.0) * total
-        prev_bound, prev_cum = 0.0, 0
-        for bound, cum in snap["buckets"]:
-            if cum >= rank and cum > prev_cum:
-                if math.isinf(bound):
-                    return prev_bound if prev_bound else 0.0
-                frac = (rank - prev_cum) / (cum - prev_cum)
-                return prev_bound + (bound - prev_bound) * max(0.0, frac)
-            prev_bound, prev_cum = (0.0 if math.isinf(bound) else bound,
-                                    cum)
-        return prev_bound
+        return percentile_from_buckets(self.snapshot(**labels)["buckets"],
+                                       q)
+
+
+def percentile_from_buckets(buckets, q: float) -> float:
+    """Quantile from CUMULATIVE histogram buckets by linear
+    interpolation inside the winning bucket — the one interpolation
+    rule every bucket-derived percentile in the repo uses
+    (``Histogram.percentile``, tools/metrics_watch.py's between-poll
+    deltas, tools/perf_report.py's scrape view).
+
+    ``buckets``: ``[(upper_bound, cumulative_count), ...]`` sorted by
+    bound with the +Inf bucket last (``Histogram.snapshot`` layout).
+    Returns 0.0 when empty; the last finite bound when the quantile
+    lands in the +Inf bucket."""
+    buckets = list(buckets)
+    total = buckets[-1][1] if buckets else 0
+    if total == 0:
+        return 0.0
+    rank = (float(q) / 100.0) * total
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in buckets:
+        if cum >= rank and cum > prev_cum:
+            if math.isinf(bound):
+                return prev_bound if prev_bound else 0.0
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * max(0.0, frac)
+        prev_bound, prev_cum = (0.0 if math.isinf(bound) else bound,
+                                cum)
+    return prev_bound
 
 
 class MetricsRegistry:

@@ -20,10 +20,21 @@ import threading
 import time
 from typing import Dict, Optional
 
-__all__ = ["StepTrace", "enable_step_trace", "disable_step_trace",
-           "active_step_trace", "reset_step_trace"]
+__all__ = ["SCHEMA_VERSION", "StepTrace", "enable_step_trace",
+           "disable_step_trace", "active_step_trace",
+           "reset_step_trace"]
 
 _ENV = "PADDLE_STEP_TRACE"
+
+# Step-trace JSONL schema version, stamped into every record as
+# ``"schema"``. Bump when record fields change shape incompatibly;
+# readers (tools/perf_report.py) refuse unknown versions with a clear
+# error instead of misparsing. History (documented in MIGRATION.md):
+#   1 — PR 9 records (no "schema" field: readers treat absence as 1)
+#   2 — adds "schema", the cost-model fields on executor step records
+#       (model_flops / hbm_bytes / comm_bytes / mfu / arith_intensity)
+#       and the per-executable ``kind="cost"`` breakdown record
+SCHEMA_VERSION = 2
 
 
 class _StepScope:
@@ -67,6 +78,7 @@ class _StepScope:
         if self._ev is not None:
             self._ev.end()
         rec = {
+            "schema": SCHEMA_VERSION,
             "step": self.step_id,
             "kind": self.kind,
             "t": round(time.time(), 6),
@@ -134,6 +146,18 @@ class StepTrace:
             sid = self._next_id
             self._next_id += 1
         return _StepScope(self, sid, kind)
+
+    def record(self, kind: str, fields: Dict[str, object]) -> None:
+        """Emit one non-step record (e.g. the executor's per-executable
+        ``kind="cost"`` breakdown). Takes the next step id so the file
+        stays a single monotonically-ordered sequence."""
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        rec = {"schema": SCHEMA_VERSION, "step": sid, "kind": kind,
+               "t": round(time.time(), 6)}
+        rec.update(fields)
+        self._write(rec)
 
     def _write(self, rec: dict) -> None:
         line = json.dumps(rec, default=str)

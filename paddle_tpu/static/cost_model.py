@@ -1,0 +1,351 @@
+"""Analytic cost model over the *optimized* Program IR: per-op and
+per-step ``{model_flops, hbm_bytes, comm_bytes}`` derived from the
+OpDescs the pass pipeline actually compiles — not from hand-coded
+per-model closed forms.
+
+Accounting conventions (PaLM-style MFU numerator):
+
+- ``model_flops`` counts matmul-class ops only (matmul/mul/conv) at
+  2 FLOPs per MAC; elementwise/reduction/normalization ops contribute
+  HBM bytes, not FLOPs — they are bandwidth-bound and excluded from the
+  MFU numerator exactly like bench.py's closed forms exclude them.
+- ``hbm_bytes`` is the dtype-aware payload traffic of every op: input
+  reads + output writes from VarDesc shapes and dtypes. The AMP pass
+  stamps rewritten vars bf16/fp16, so mixed-precision bytes halve with
+  no extra bookkeeping here. Gather-class ops (lookup_table, gather)
+  read the gathered rows, never the whole table.
+- ``comm_bytes`` is cross-chip traffic from the shard_propagation
+  stamps: an op carrying ``__psum_axes`` costs a ring all-reduce of its
+  per-shard output over those axes (``2*(g-1)/g`` bytes per payload
+  byte).
+
+The executor's real step structure folds in on top of the per-op walk:
+
+- a ``backward`` op multiplies every forward op by 3 (one forward + two
+  backward passes, the PaLM train-step convention); ops stamped
+  ``__remat_seg`` add one more forward (the recompute pass re-runs the
+  segment in the backward)
+- ``gradient_merge_k``: ops in the scanned region (forward + backward +
+  an adjacent ``check_finite_and_unscale``) run per microbatch at
+  ``B/k`` and are counted k times; the optimizer region runs once — the
+  compiled ``lax.scan`` structure, mirrored
+- sharding (``__sharding_spec`` stamps + the build's mesh axis sizes):
+  an op's work divides by the product of the distinct mesh axes its
+  operands are partitioned over — per-CHIP cost, matching per-chip MFU
+- ``pipeline_stages`` is recorded (GPipe moves work in time, not in
+  amount)
+
+Everything is static VarDesc arithmetic — no tracing, no device touch —
+so a cost report for a BERT-sized program costs microseconds and can
+run per compiled executable in the executor hot path (cached on the
+executable's cache entry).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["OpCost", "CostReport", "program_cost"]
+
+# matmul-class ops: the MFU numerator (2 FLOPs per MAC)
+_MATMUL_OPS = {"mul", "matmul", "matmul_v2"}
+# conv ops as the IR actually emits them (layers.py conv2d,
+# layers_ext/layers_compat "_s"-suffixed 3D + transpose forms). Weight
+# layouts differ: forward convs carry (Co, Ci/g, k...) and cost per
+# OUTPUT element; transpose convs carry (Ci, Co/g, k...) and cost per
+# INPUT element — both are 2 * elements * prod(W.shape[1:]) FLOPs.
+_CONV_OPS = {"conv2d", "conv3d_s"}
+_CONV_TRANSPOSE_OPS = {"conv2d_transpose_s", "conv3d_transpose_s"}
+# gather-class: read the gathered rows + indices, not the whole table
+_GATHER_OPS = {"lookup_table", "lookup_table_v2", "gather", "gather_nd",
+               "embedding"}
+# layout-only ops XLA compiles away: no HBM traffic charged
+_FREE_OPS = {"feed", "fetch", "backward", "reshape2", "assign",
+             "share_data", "shape", "increment"}
+# write-only producers: charge the output, there is no tensor input
+_PRODUCER_OPS = {"fill_constant", "assign_value", "gaussian_random",
+                 "uniform_random", "truncated_gaussian_random",
+                 "uniform_random_batch_size_like", "randint", "range",
+                 "eye", "one_hot", "one_hot_v2"}
+
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def _itemsize(dtype) -> int:
+    return _ITEMSIZE.get(str(dtype), 4)
+
+
+def _prod(seq) -> int:
+    out = 1
+    for v in seq:
+        out *= int(v)
+    return out
+
+
+def _spec_axes(entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, (list, tuple)) else (entry,)
+
+
+class OpCost:
+    """One op's per-step cost after structure multipliers: ``flops``
+    (model FLOPs), ``hbm_bytes``, ``comm_bytes``; ``mult`` is the step
+    multiplier applied (fwd/bwd/remat × gradient-merge k),
+    ``shard_factor`` the per-chip division."""
+
+    __slots__ = ("index", "type", "out", "flops", "hbm_bytes",
+                 "comm_bytes", "mult", "shard_factor")
+
+    def __init__(self, index, type, out, flops, hbm_bytes, comm_bytes,
+                 mult, shard_factor):
+        self.index = index
+        self.type = type
+        self.out = out
+        self.flops = flops
+        self.hbm_bytes = hbm_bytes
+        self.comm_bytes = comm_bytes
+        self.mult = mult
+        self.shard_factor = shard_factor
+
+    @property
+    def arith_intensity(self) -> float:
+        return self.flops / self.hbm_bytes if self.hbm_bytes else 0.0
+
+    def to_dict(self) -> dict:
+        return {"index": self.index, "type": self.type, "out": self.out,
+                "flops": self.flops, "hbm_bytes": self.hbm_bytes,
+                "comm_bytes": self.comm_bytes, "mult": self.mult,
+                "shard_factor": self.shard_factor,
+                "arith_intensity": round(self.arith_intensity, 3)}
+
+
+class CostReport:
+    """Per-op costs plus step totals for one optimized program."""
+
+    def __init__(self, ops: List[OpCost], gm_k: int = 1,
+                 pp_stages: int = 1, n_shards: int = 1,
+                 batch: int = 1):
+        self.ops = ops
+        self.gm_k = gm_k
+        self.pp_stages = pp_stages
+        self.n_shards = n_shards
+        self.batch = batch
+        self.model_flops = sum(o.flops for o in ops)
+        self.hbm_bytes = sum(o.hbm_bytes for o in ops)
+        self.comm_bytes = sum(o.comm_bytes for o in ops)
+
+    @property
+    def arith_intensity(self) -> float:
+        return (self.model_flops / self.hbm_bytes
+                if self.hbm_bytes else 0.0)
+
+    def by_type(self, field: str = "flops") -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for o in self.ops:
+            v = getattr(o, field)
+            if v:
+                out[o.type] = out.get(o.type, 0) + v
+        return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+    def top_ops(self, k: int = 10, by: str = "flops") -> List[OpCost]:
+        return sorted((o for o in self.ops if getattr(o, by)),
+                      key=lambda o: -getattr(o, by))[:k]
+
+    def to_dict(self, top: int = 20) -> dict:
+        """JSON-able summary — what the executor stamps into the
+        step-trace ``cost`` record and ``exe.cost_stats()`` returns."""
+        return {
+            "model_flops": self.model_flops,
+            "hbm_bytes": self.hbm_bytes,
+            "comm_bytes": self.comm_bytes,
+            "arith_intensity": round(self.arith_intensity, 3),
+            "n_ops": len(self.ops),
+            "batch": self.batch,
+            "gm_k": self.gm_k,
+            "pp_stages": self.pp_stages,
+            "n_shards": self.n_shards,
+            "flops_by_type": self.by_type("flops"),
+            "bytes_by_type": self.by_type("hbm_bytes"),
+            "top_flops": [o.to_dict() for o in self.top_ops(top, "flops")],
+            "top_bytes": [o.to_dict()
+                          for o in self.top_ops(top, "hbm_bytes")],
+        }
+
+
+def _resolve_batch(block, feed_shapes: Optional[Dict[str, Sequence[int]]],
+                   batch_size: Optional[int]) -> int:
+    """The dynamic-dim substitution value: derived from the live feed
+    shapes against the data VarDescs' ``-k`` sentinel dims (``-k`` means
+    "dynamic batch times static k"), else ``batch_size``, else 1."""
+    if feed_shapes:
+        for name, shape in feed_shapes.items():
+            v = block.vars.get(name)
+            dshape = getattr(v, "shape", None)
+            if not dshape or not shape:
+                continue
+            d0 = -1 if dshape[0] is None else int(dshape[0])
+            if d0 < 0 and int(shape[0]) > 0:
+                return max(1, int(shape[0]) // -d0)
+    return max(1, int(batch_size or 1))
+
+
+def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
+                 shard_cfg=None, pp=None) -> CostReport:
+    """Walk ``program``'s optimized global block into a CostReport.
+
+    ``feed_shapes``: {data var name -> live array shape} — resolves the
+    dynamic batch dim. ``gm``/``shard_cfg``/``pp`` are the executor's
+    resolve_gradient_merge/resolve_sharding/resolve_pipeline results for
+    the build (None each when off)."""
+    block = program.global_block
+    batch = _resolve_batch(block, feed_shapes, batch_size)
+    axis_sizes: Dict[str, int] = dict(shard_cfg[0]) if shard_cfg else {}
+    n_shards = _prod(axis_sizes.values()) if axis_sizes else 1
+
+    ops = block.ops
+    first_bwd = next((i for i, op in enumerate(ops)
+                      if op.type == "backward"), None)
+    gm_k = int(gm[0]) if (gm and first_bwd is not None) else 1
+    scan_end = len(ops)
+    if first_bwd is not None:
+        scan_end = first_bwd + 1
+        if scan_end < len(ops) and \
+                ops[scan_end].type == "check_finite_and_unscale":
+            scan_end += 1
+
+    def shape_of(name: str, b: int) -> Optional[Tuple[int, ...]]:
+        v = block.vars.get(name)
+        shape = getattr(v, "shape", None)
+        if shape is None:
+            return None
+        # dynamic dims come as -k ("dynamic batch times k") or a bare
+        # None (the Paddle 2.x [None, ...] spelling) — both resolve
+        # through the batch substitution
+        return tuple(int(-(d if d is not None else -1)) * b
+                     if d is None or int(d) < 0 else int(d)
+                     for d in shape)
+
+    def nbytes_of(name: str, b: int) -> int:
+        shape = shape_of(name, b)
+        if shape is None:
+            return 0
+        v = block.vars.get(name)
+        return _prod(shape) * _itemsize(getattr(v, "dtype", "float32"))
+
+    def spec_of(name: str):
+        v = block.vars.get(name)
+        return (getattr(v, "attrs", None) or {}).get("__sharding_spec")
+
+    def shard_axes_of(op) -> Tuple[str, ...]:
+        """Distinct mesh axes partitioning any operand of ``op`` (or its
+        psum stamp): the op's work divides by their size product —
+        row-parallel matmuls shard the contracted (input) dim, column-
+        parallel the output dim, dp the batch dim; the union covers all
+        three."""
+        axes = set(op.attrs.get("__psum_axes") or ())
+        for name in list(op.input_names()) + list(op.output_names()):
+            for entry in (spec_of(name) or ()):
+                axes.update(a for a in _spec_axes(entry)
+                            if a in axis_sizes)
+        return tuple(a for a in axes if a in axis_sizes)
+
+    out: List[OpCost] = []
+    for i, op in enumerate(ops):
+        t = op.type
+        if t in ("feed", "fetch", "backward"):
+            continue
+        # region structure: forward ops run 1 fwd + 2 bwd passes when a
+        # backward op exists (+1 recompute under remat); the scanned
+        # region repeats per microbatch at B/k; the optimizer region
+        # runs once on the merged gradient at full batch
+        in_scan = first_bwd is not None and i < scan_end
+        b = max(1, batch // gm_k) if (in_scan and gm_k > 1) else batch
+        if first_bwd is not None and i < first_bwd:
+            mult = 3 + (1 if "__remat_seg" in op.attrs else 0)
+        else:
+            mult = 1
+        if in_scan and gm_k > 1:
+            mult *= gm_k
+
+        ins = [n for n in op.input_names()]
+        outs = [n for n in op.output_names()]
+        flops = 0
+        if t == "mul":
+            o = outs[0] if outs else None
+            oshape = shape_of(o, b) if o else None
+            xshape = shape_of((op.inputs.get("X") or [None])[0], b)
+            ncol = int(op.attrs.get("x_num_col_dims", 1))
+            if oshape and xshape:
+                k_dim = _prod(xshape[ncol:])
+                flops = 2 * _prod(oshape) * k_dim
+        elif t in _MATMUL_OPS:
+            o = outs[0] if outs else None
+            oshape = shape_of(o, b) if o else None
+            xshape = shape_of((op.inputs.get("X") or [None])[0], b)
+            if oshape and xshape:
+                # both attr spellings: "transpose_X" (matmul) and
+                # "trans_x" (matmul_v2 from deserialized 2.x programs —
+                # the shard pass defends against the same pair)
+                trans_x = (op.attrs.get("transpose_X")
+                           or op.attrs.get("trans_x"))
+                k_dim = int(xshape[-2] if trans_x else xshape[-1])
+                flops = 2 * _prod(oshape) * k_dim
+        elif t in _CONV_OPS or t in _CONV_TRANSPOSE_OPS:
+            if t in _CONV_TRANSPOSE_OPS:
+                base_name = (op.inputs.get("Input") or [None])[0]
+            else:
+                base_name = outs[0] if outs else None
+            bshape = shape_of(base_name, b) if base_name else None
+            wshape = shape_of((op.inputs.get("Filter")
+                               or op.inputs.get("W") or [None])[0], b)
+            if bshape and wshape:
+                flops = 2 * _prod(bshape) * _prod(wshape[1:])
+
+        if t in _FREE_OPS:
+            hbm = 0
+        elif t in _PRODUCER_OPS:
+            hbm = sum(nbytes_of(n, b) for n in outs)
+        elif t in _GATHER_OPS:
+            # reads gathered rows (== out bytes) + indices, writes out
+            ids = (op.inputs.get("Ids") or op.inputs.get("Index")
+                   or [None])[0]
+            out_b = sum(nbytes_of(n, b) for n in outs)
+            hbm = 2 * out_b + (nbytes_of(ids, b) if ids else 0)
+        else:
+            hbm = (sum(nbytes_of(n, b) for n in ins)
+                   + sum(nbytes_of(n, b) for n in outs))
+
+        shard_axes = shard_axes_of(op)
+        factor = _prod(axis_sizes[a] for a in shard_axes) \
+            if shard_axes else 1
+        comm = 0
+        psum_axes = [a for a in (op.attrs.get("__psum_axes") or ())
+                     if a in axis_sizes]
+        if psum_axes and outs:
+            g = _prod(axis_sizes[a] for a in psum_axes)
+            if g > 1:
+                # ring all-reduce of the per-shard output block: the
+                # output spec's axes give its partitioning BEFORE the
+                # psum replicates it over the contracted axes
+                out_axes = {a for n in outs
+                            for entry in (spec_of(n) or ())
+                            for a in _spec_axes(entry)
+                            if a in axis_sizes}
+                out_factor = _prod(axis_sizes[a] for a in out_axes) \
+                    if out_axes else 1
+                payload = sum(nbytes_of(n, b) for n in outs) // out_factor
+                comm = int(2 * (g - 1) * payload // g) * mult
+
+        out.append(OpCost(
+            index=i, type=t, out=(outs[0] if outs else ""),
+            flops=flops * mult // factor,
+            hbm_bytes=hbm * mult // factor,
+            comm_bytes=comm, mult=mult, shard_factor=factor))
+
+    return CostReport(out, gm_k=gm_k, pp_stages=int(pp or 1),
+                      n_shards=n_shards, batch=batch)

@@ -65,6 +65,21 @@ def _phase_hist():
     return _PHASE_HIST
 
 
+_DEVICE_KIND: Optional[str] = None
+
+
+def _device_kind() -> str:
+    """The local chip's PJRT device_kind, resolved once — keys the
+    device_peaks lookup behind the live mfu/arith_intensity gauges."""
+    global _DEVICE_KIND
+    if _DEVICE_KIND is None:
+        try:
+            _DEVICE_KIND = jax.devices()[0].device_kind
+        except Exception:
+            _DEVICE_KIND = "unknown"
+    return _DEVICE_KIND
+
+
 class Scope:
     """name -> jax.Array store (reference framework/scope.cc, but flat &
     functional: executors read a snapshot and write back results).
@@ -246,9 +261,13 @@ class _ExecEntry:
     ``is_gm`` records whether the step really compiled as a
     scan-over-microbatches (a gradient_merge_k strategy on a
     backward-less program falls back to the plain step — its dispatches
-    must not count as merged)."""
+    must not count as merged). ``cost`` caches the analytic
+    cost_model.CostReport for the executable (one walk per entry, the
+    warm path pays an attribute read; ``False`` = computation failed,
+    don't retry)."""
 
-    __slots__ = ("compiled", "optimized_program", "pass_report", "is_gm")
+    __slots__ = ("compiled", "optimized_program", "pass_report", "is_gm",
+                 "cost")
 
     def __init__(self, compiled, optimized_program, pass_report,
                  is_gm=False):
@@ -256,6 +275,7 @@ class _ExecEntry:
         self.optimized_program = optimized_program
         self.pass_report = pass_report
         self.is_gm = is_gm
+        self.cost = None
 
 
 # process-global content-addressed executable cache: every Executor in
@@ -400,6 +420,83 @@ class Executor:
         drop with BuildStrategy.recompute on. {} before the first run."""
         return self._memory_analysis_dict(self._last_entry)
 
+    def cost_stats(self, top: int = 10) -> Dict[str, Any]:
+        """Analytic cost breakdown of the LAST executable this executor
+        dispatched (static/cost_model.py over the optimized Program IR,
+        with the gm/remat/shard step structure folded in): per-op and
+        per-step model_flops / hbm_bytes / comm_bytes, flops/bytes by op
+        type, top ops, plus the device peaks and the live derived
+        gauges (mfu, arith_intensity) from the last measured step.
+        {} before the first run or when the model could not cost the
+        program."""
+        entry = self._last_entry
+        cost = getattr(entry, "cost", None) if entry is not None else None
+        if not cost:
+            return {}
+        from ..observability.device_peaks import machine_balance, peaks_for
+
+        out = cost.to_dict(top=top)
+        kind = _device_kind()
+        out["device_kind"] = kind
+        peaks = peaks_for(kind)
+        if peaks is not None:
+            out["peak_flops"] = peaks.flops
+            out["peak_hbm_bytes_per_s"] = peaks.hbm_bytes_per_s
+            mb = machine_balance(kind)
+            if mb:
+                out["machine_balance"] = round(mb, 3)
+        for g in ("step_model_flops", "step_hbm_bytes",
+                  "step_comm_bytes", "mfu", "arith_intensity"):
+            if g in self._counters:
+                out[g] = self._counters[g]
+        return out
+
+    def _publish_cost_gauges(self, cost, phases) -> Dict[str, Any]:
+        """Land one step's cost-model totals + derived utilization in
+        the gauges: step_model_flops / step_hbm_bytes / step_comm_bytes
+        from the report, mfu from the MEASURED dispatch+fetch seconds
+        against the device peak (fetch is included because jax dispatch
+        is async — the host-side conversion is where the device step is
+        actually awaited), arith_intensity = flops per HBM byte."""
+        from .. import profiler
+        from ..observability.device_peaks import peaks_for
+
+        vals: Dict[str, Any] = {
+            "step_model_flops": cost.model_flops,
+            "step_hbm_bytes": cost.hbm_bytes,
+            "step_comm_bytes": cost.comm_bytes,
+            "arith_intensity": round(cost.arith_intensity, 3),
+        }
+        step_s = (phases.get("dispatch", 0.0)
+                  + phases.get("fetch", 0.0)) / 1e3
+        peaks = peaks_for(_device_kind())
+        if peaks is not None and peaks.flops > 0 and step_s > 0 \
+                and cost.model_flops:
+            # 6 decimals: a tiny probe's true MFU can sit at 1e-5 — a
+            # 4-decimal gauge would floor it to an indistinguishable 0
+            vals["mfu"] = round(
+                cost.model_flops / step_s / peaks.flops, 6)
+        else:
+            # not computable for THIS step (matmul-free program, or no
+            # known peak): overwrite, never leave a previous program's
+            # mfu standing next to step_model_flops=0
+            vals["mfu"] = 0
+        for name, v in vals.items():
+            self._counters[name] = v
+            profiler.set_counter(name, v)
+        return vals
+
+    def _clear_cost_gauges(self) -> None:
+        """Zero the cost gauges unconditionally (another executor may
+        have set the process-global ones): 5 dict writes per uncosted
+        step, negligible next to the dispatch."""
+        from .. import profiler
+
+        for name in ("step_model_flops", "step_hbm_bytes",
+                     "step_comm_bytes", "mfu", "arith_intensity"):
+            self._counters[name] = 0
+            profiler.set_counter(name, 0)
+
     def _update_memory_gauges(self, entry) -> None:
         """Mirror the last executable's memory analysis into the
         counters as GAUGES (assigned, not accumulated): xla_temp_bytes /
@@ -450,6 +547,7 @@ class Executor:
         t_end = time.perf_counter()
         t_feed, t_disp = obs.get("t_feed"), obs.get("t_dispatch")
         phases: Dict[str, float] = {}
+        cost_vals: Dict[str, Any] = {}
         if t_disp is not None:
             phases["feed"] = (t_feed - obs["t0"]) * 1e3
             phases["dispatch"] = (t_disp - t_feed) * 1e3
@@ -457,6 +555,14 @@ class Executor:
             h = _phase_hist()
             for name, ms in phases.items():
                 h.observe(ms, phase=name)
+            cost = obs.get("cost")
+            if cost is not None:
+                cost_vals = self._publish_cost_gauges(cost, phases)
+            else:
+                # an uncostable program must not leave the previous
+                # program's flops/mfu on the dashboard: the gauges
+                # describe the LAST DISPATCHED step, so zero them
+                self._clear_cost_gauges()
             flight_recorder().record_step({
                 "exe_step": self._step,
                 "cache_hit": obs.get("cache_hit", False),
@@ -468,7 +574,46 @@ class Executor:
                 tr_scope.set("exe_step", self._step)
                 tr_scope.set("cache_hit", obs.get("cache_hit", False))
                 tr_scope.set("h2d_bytes", obs.get("h2d_bytes", 0))
+                for name, v in cost_vals.items():
+                    tr_scope.set(name, v)
             tr_scope.__exit__(*_sys.exc_info())
+            if obs.get("cost") is not None:
+                # per-executable breakdown record (kind="cost"): totals,
+                # per-op top tables, device peaks — the top-K/roofline
+                # source tools/perf_report.py reads next to the per-step
+                # rows (emitted AFTER the step record so file order
+                # stays a single monotone step-id sequence; de-duped per
+                # trace so warm steps don't repeat it)
+                self._emit_cost_record(tr_scope._trace, obs["cost"])
+
+    def _emit_cost_record(self, trace, cost) -> None:
+        from ..observability.device_peaks import peaks_for
+
+        # per-trace dedup: one record per REPORT OBJECT, not per step —
+        # keyed by identity with the object held strongly (an id() alone
+        # could be reused after a cache-evicted report is GC'd, silently
+        # skipping a new executable), LRU-bounded so alternating
+        # programs (train+eval) emit once each, not once per step
+        seen = getattr(trace, "_cost_seen", None)
+        if seen is None:
+            seen = trace._cost_seen = OrderedDict()
+        if id(cost) in seen:
+            seen.move_to_end(id(cost))
+            return
+        seen[id(cost)] = cost
+        while len(seen) > 64:
+            seen.popitem(last=False)
+        try:
+            rec = cost.to_dict(top=20)
+            kind = _device_kind()
+            rec["device_kind"] = kind
+            peaks = peaks_for(kind)
+            if peaks is not None:
+                rec["peak_flops"] = peaks.flops
+                rec["peak_hbm_bytes_per_s"] = peaks.hbm_bytes_per_s
+            trace.record("cost", rec)
+        except Exception:
+            pass  # tracing must never take down the step
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, obs):
@@ -639,6 +784,23 @@ class Executor:
         if entry is not getattr(self, "_last_entry", None):
             self._last_entry = entry
             self._update_memory_gauges(entry)
+        if entry.cost is None:
+            # one analytic walk per executable (VarDesc arithmetic, no
+            # tracing); False = model couldn't cost this program, never
+            # retried on the hot path
+            try:
+                from .cost_model import program_cost
+
+                entry.cost = program_cost(
+                    entry.optimized_program,
+                    feed_shapes={k: tuple(getattr(v, "shape", ()) or ())
+                                 for k, v in feed.items()},
+                    gm=gm if entry.is_gm else None,
+                    shard_cfg=shard_cfg, pp=pp)
+            except Exception:
+                entry.cost = False
+        if entry.cost:
+            obs["cost"] = entry.cost
 
         self._step += 1
         self._bump("executor_steps")

@@ -143,6 +143,13 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last.get("exec_cache_shared_hit") is True, last
     # no PADDLE_COMPILE_CACHE_DIR in this run -> no disk traffic
     assert last["disk_cache_hits"] == 0
+    # graph-derived cost model cross-check: the IR-walked flop count of
+    # the bert-shaped probe agrees with the closed-form flops_per_step
+    # within 2% (the two accountings can never silently drift)
+    for key in ("ir_flops_per_step", "ir_flops_delta"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["ir_flops_per_step"] > 0, last
+    assert last["ir_flops_delta"] <= 0.02, last
     # mixed-precision probe contract: amp-on runs end to end, the loss
     # delta vs f32 stays within roundoff tolerance, casts were inserted
     # and the bf16 feed path really shrank the h2d transfer

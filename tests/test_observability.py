@@ -319,8 +319,10 @@ def test_step_trace_jsonl_schema(tmp_path):
     finally:
         disable_step_trace()
     recs = [json.loads(ln) for ln in open(path) if ln.strip()]
-    # startup + 3 steps, ids strictly increasing from 0
+    # startup + 3 steps (+ the per-executable cost record), ids
+    # strictly increasing from 0; every record is schema-versioned
     assert [r["step"] for r in recs] == list(range(len(recs)))
+    assert all(r.get("schema") == 2 for r in recs)
     steps = [r for r in recs if r.get("phases", {}).get("dispatch")
              is not None]
     assert len(steps) == 3

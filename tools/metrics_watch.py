@@ -16,22 +16,28 @@ Each poll prints the samples that MOVED since the previous poll (the
 first poll prints non-zero values); ``--all`` prints every sample every
 poll; ``--count N`` stops after N polls (0 = forever). Exit code 1 when
 the endpoint never answered.
+
+Histogram samples additionally render as a derived p50/p99 table per
+poll — the percentiles of the INTERVAL distribution (cumulative-bucket
+deltas between polls, interpolated exactly like
+``metrics.Histogram.percentile``), not raw bucket counters.
 """
 from __future__ import annotations
 
 import argparse
 import http.client
 import os
+import re
 import sys
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from paddle_tpu.observability.metrics import (  # noqa: E402
-    parse_prometheus_text,
+    parse_prometheus_text, percentile_from_buckets,
 )
 
 
@@ -47,6 +53,93 @@ def format_counter_table(counters: Dict[str, float],
     for name, value in sorted(counters.items()):
         v = int(value) if float(value) == int(value) else round(value, 3)
         lines.append(f"{name:<{name_width}}{v:>12}")
+    return "\n".join(lines)
+
+
+_BUCKET_RE = re.compile(r"^(?P<name>[a-zA-Z_:][\w:]*)_bucket"
+                        r"\{(?P<labels>.*)\}$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def histogram_series(samples: Dict[str, float]
+                     ) -> Dict[Tuple[str, tuple],
+                               List[Tuple[float, float]]]:
+    """Group parsed scrape samples into cumulative histogram bucket
+    series: ``{(metric, non-le labels): [(le, cumulative), ...]}`` with
+    the +Inf bucket last — the ``Histogram.snapshot`` layout, rebuilt
+    from exposition text."""
+    out: Dict[Tuple[str, tuple], List[Tuple[float, float]]] = {}
+    for key, value in samples.items():
+        m = _BUCKET_RE.match(key)
+        if not m:
+            continue
+        le, rest = None, []
+        for k, v in _LABEL_RE.findall(m.group("labels")):
+            if k == "le":
+                le = v
+            else:
+                rest.append((k, v))
+        if le is None:
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        out.setdefault((m.group("name"), tuple(rest)), []).append(
+            (bound, value))
+    for buckets in out.values():
+        buckets.sort(key=lambda bv: bv[0])
+    return out
+
+
+def histogram_percentile_deltas(cur: Dict[str, float],
+                                prev: Optional[Dict[str, float]] = None,
+                                qs=(50, 99)) -> Dict[str, dict]:
+    """Between-poll histogram movement: for every histogram series whose
+    cumulative buckets advanced since ``prev``, the new-sample count and
+    the interpolated percentiles of the INTERVAL distribution (bucket
+    deltas) — the same cumulative-bucket interpolation
+    ``metrics.Histogram.percentile`` uses, so a poll loop shows live
+    p50/p99 instead of raw bucket samples. ``prev=None`` reports the
+    cumulative distribution."""
+    cur_h = histogram_series(cur)
+    prev_h = histogram_series(prev) if prev else {}
+    out: Dict[str, dict] = {}
+    for (name, labels), buckets in sorted(cur_h.items()):
+        pb = dict(prev_h.get((name, labels), ()))
+        delta = [(b, c - pb.get(b, 0.0)) for b, c in buckets]
+        if any(c < 0 for _b, c in delta):
+            # counter reset (scraped server restarted between polls):
+            # the cumulative counts went backwards, so the delta is
+            # garbage — fall back to the fresh process's cumulative
+            # distribution instead of interpolating a non-monotone
+            # series or silently dropping the row
+            delta = buckets
+        total = delta[-1][1] if delta else 0.0
+        if total <= 0:
+            continue
+        disp = name + ("{" + ",".join(f'{k}="{v}"' for k, v in labels)
+                       + "}" if labels else "")
+        row = {"count": int(total)}
+        for q in qs:
+            row[f"p{q}"] = round(percentile_from_buckets(delta, q), 3)
+        out[disp] = row
+    return out
+
+
+def format_percentile_table(rows: Dict[str, dict],
+                            title: Optional[str] = None,
+                            name_width: int = 52) -> str:
+    """``histogram  count  p50  p99`` table for the poll loop."""
+    qs = sorted({k for r in rows.values() for k in r if k != "count"},
+                key=lambda s: float(s[1:]))
+    lines = []
+    if title:
+        lines.append(title)
+    header = f"{'histogram':<{name_width}}{'count':>8}"
+    header += "".join(f"{q + '_ms':>10}" for q in qs)
+    lines.append(header)
+    for name, row in rows.items():
+        line = f"{name:<{name_width}}{row['count']:>8}"
+        line += "".join(f"{row.get(q, 0.0):>10}" for q in qs)
+        lines.append(line)
     return "\n".join(lines)
 
 
@@ -101,6 +194,14 @@ def watch(endpoint: str, interval: float = 2.0, count: int = 0,
                   file=out)
         else:
             print(f"[{stamp}] {endpoint}: no movement", file=out)
+        # derived histogram view: p50/p99 of the samples that landed
+        # since the previous poll (cumulative on the first poll)
+        pct = histogram_percentile_deltas(cur, prev)
+        if pct:
+            span = "cumulative" if prev is None else "interval"
+            print(format_percentile_table(
+                pct, title=f"[{stamp}] histogram p50/p99 ({span})")
+                + "\n", file=out)
         prev = cur
         if count <= 0 or polls < count:
             time.sleep(interval)

@@ -31,6 +31,7 @@ MODULES = [
     "paddle_tpu.nn.functional",
     "paddle_tpu.nn.initializer",
     "paddle_tpu.observability",
+    "paddle_tpu.observability.device_peaks",
     "paddle_tpu.observability.metrics",
     "paddle_tpu.ops",
     "paddle_tpu.optimizer",
@@ -42,6 +43,7 @@ MODULES = [
     "paddle_tpu.quantization",
     "paddle_tpu.regularizer",
     "paddle_tpu.static",
+    "paddle_tpu.static.cost_model",
     "paddle_tpu.text",
     "paddle_tpu.utils",
     "paddle_tpu.vision",
