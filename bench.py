@@ -537,6 +537,133 @@ def _serving_probe(requests=60, workers=4):
         }
 
 
+def _decode_probe(requests=12, workers=4):
+    """LLM decode-engine probe: the paged continuous-batching engine vs
+    the padded-bucket data path ON THE SAME MODEL at mixed sequence
+    lengths.
+
+    Engine leg: DecodeLoadGen drives deterministic mixed prompt/output
+    lengths through the paged engine (one compiled ragged decode step,
+    KV pages donated). Baseline leg: the SAME greedy workload through
+    the PR 6-shaped padded path — every emitted token recomputes the
+    full forward over the max-context padded buffer, batch fixed until
+    the bucket drains (no KV cache, no continuous refill). Both legs
+    emit identical tokens (asserted: decode_padded_parity), so
+    decode_tokens_per_sec vs decode_padded_tokens_per_sec is a pure
+    data-path comparison. Engine-side p50/p99 come from the PR 9
+    decode histograms' buckets.
+
+    Fixed small shapes: like the other probes this measures the
+    serving machinery, not model quality."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.decode import DecodeEngine, DecodeModelConfig
+    from paddle_tpu.inference.decode.model import dense_forward
+    from tools.load_gen import DecodeLoadGen
+
+    page_size, max_pages = 16, 8
+    lmax = page_size * max_pages                      # 128 ctx budget
+    max_batch = 4
+    cfg = DecodeModelConfig(vocab_size=64, n_layers=2, n_heads=4,
+                            head_dim=16, ffn_dim=128, max_context=lmax)
+    prompt_lens = (8, 24, 48, 16)
+    output_lens = (8, 16, 12)
+    engine = DecodeEngine(cfg, seed=11, max_batch=max_batch, n_pages=64,
+                          page_size=page_size,
+                          max_pages_per_seq=max_pages)
+    engine.warm()
+    engine.start()
+    try:
+        gen = DecodeLoadGen(engine, total_requests=requests,
+                            workers=workers, prompt_lens=prompt_lens,
+                            output_lens=output_lens, keep_outputs=True)
+        summary = gen.run()
+    finally:
+        engine.drain(timeout=60)
+    ec = engine.counters
+
+    # padded-bucket baseline: identical workload, identical greedy
+    # outputs, but every token recomputes the full lmax-padded forward
+    # and the bucket only refills when it drains
+    params = engine.params
+
+    @jax.jit
+    def padded_step(params, toks, lens):
+        logits = dense_forward(cfg, params, toks)
+        idx = jnp.clip(lens - 1, 0, lmax - 1)
+        last = jnp.take_along_axis(
+            logits, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    workload = [(gen._make_prompt(i), output_lens[i % len(output_lens)])
+                for i in range(requests)]
+    # warm the baseline executable before timing
+    _ = np.asarray(padded_step(params, np.zeros((max_batch, lmax),
+                                                np.int32),
+                               np.ones((max_batch,), np.int32)))
+    padded_outputs = {}
+    t0 = _time.perf_counter()
+    padded_tokens = 0
+    for g0 in range(0, requests, max_batch):
+        group = workload[g0:g0 + max_batch]
+        toks = np.zeros((max_batch, lmax), np.int32)
+        lens = np.ones((max_batch,), np.int32)
+        remaining = np.zeros((max_batch,), np.int64)
+        outs = [[] for _ in group]
+        for r, (prompt, out_n) in enumerate(group):
+            toks[r, :len(prompt)] = prompt
+            lens[r] = len(prompt)
+            remaining[r] = out_n
+        while (remaining > 0).any():
+            nxt = np.asarray(padded_step(params, toks, lens))
+            for r in range(len(group)):
+                if remaining[r] <= 0:
+                    continue
+                outs[r].append(int(nxt[r]))
+                toks[r, lens[r]] = nxt[r]
+                lens[r] += 1
+                remaining[r] -= 1
+                padded_tokens += 1
+        for r in range(len(group)):
+            padded_outputs[g0 + r] = outs[r]
+    dt_padded = _time.perf_counter() - t0
+    parity = all(padded_outputs.get(i) == gen.outputs.get(i)
+                 for i in range(requests))
+    return {
+        "decode_tokens_per_sec": summary["decode_tokens_per_sec"],
+        "decode_padded_tokens_per_sec":
+            round(padded_tokens / dt_padded, 2) if dt_padded else 0.0,
+        "decode_padded_parity": bool(parity),
+        # engine-side latency truth: bucket-derived percentiles from
+        # the decode_e2e_ms / decode_step_ms histograms (PR 9 plane)
+        "decode_engine_p50_ms": summary["engine_p50_ms"],
+        "decode_engine_p99_ms": summary["engine_p99_ms"],
+        "decode_step_p50_ms": summary["step_p50_ms"],
+        "decode_step_p99_ms": summary["step_p99_ms"],
+        "decode_ttft_p50_ms": summary["ttft_p50_ms"],
+        "decode_itl_p50_ms": summary["itl_p50_ms"],
+        "decode_requests": int(ec.get("decode_requests", 0)),
+        "decode_tokens": int(ec.get("decode_tokens", 0)),
+        "decode_prefills": int(ec.get("decode_prefills", 0)),
+        "decode_steps": int(ec.get("decode_steps", 0)),
+        "decode_shed": int(ec.get("decode_shed", 0)),
+        "decode_deadline_expired":
+            int(ec.get("decode_deadline_expired", 0)),
+        "decode_failed": int(ec.get("decode_failed", 0)),
+        "decode_preempted": int(ec.get("decode_preempted", 0)),
+        "decode_batch_fill_pct":
+            float(ec.get("decode_batch_fill_pct", 0.0)),
+        "decode_page_util_peak_pct": round(
+            100.0 * engine.pool.peak_pages_in_use
+            / max(1, engine.pool.capacity), 2),
+        "kv_page_evictions": int(engine.pool.evicted_pages),
+        "decode_ok": int(summary["ok"]),
+    }
+
+
 def _shard_probe_main(n_devices=8, steps=3):
     """Child body of the MULTICHIP probe (run in a subprocess with
     XLA_FLAGS=--xla_force_host_platform_device_count=N — the parent
@@ -790,6 +917,15 @@ def bench_bert(seq=128, smoke=False, trend=False):
     except Exception as e:
         serving_probe = {"serving_probe_error":
                          f"{type(e).__name__}: {e}"}
+    # LLM decode probe: paged continuous-batching engine vs the
+    # padded-bucket baseline on the same model at mixed lengths
+    # (identical greedy outputs asserted), engine-side p50/p99 from
+    # the decode histograms, page-pool utilization
+    try:
+        decode_probe = _decode_probe()
+    except Exception as e:
+        decode_probe = {"decode_probe_error":
+                        f"{type(e).__name__}: {e}"}
     # MULTICHIP probe (subprocess, 8 forced CPU devices): DP×TP parity
     # vs single chip within the gm tolerance, psum accounting, and the
     # gradient-merge×pipeline GPipe composition's stage count + bubble
@@ -803,6 +939,7 @@ def bench_bert(seq=128, smoke=False, trend=False):
         **amp_probe,
         **remat_probe,
         **serving_probe,
+        **decode_probe,
         **multichip_probe,
         **ir_probe,
         "value": tokens / dt, "unit": "tokens/s",

@@ -165,11 +165,16 @@ from .serving import (AnalysisPredictor, DeadlineExceeded,  # noqa: E402
                       EngineStopped, Overloaded, RequestFailed,
                       ServingEngine, ServingError, ServingHealthServer,
                       install_sigterm_drain)
+# LLM decode serving (paged KV cache + ragged paged attention +
+# continuous prefill/decode scheduling) — see decode/
+from . import decode  # noqa: E402
+from .decode import DecodeEngine, DecodeModelConfig  # noqa: E402
 
 __all__ = [
     "Config", "Predictor", "create_predictor", "PaddlePredictor",
     "AnalysisConfig", "AnalysisPredictor", "ServingEngine",
     "ServingHealthServer", "ServingError", "Overloaded",
     "DeadlineExceeded", "EngineStopped", "RequestFailed",
-    "install_sigterm_drain",
+    "install_sigterm_drain", "decode", "DecodeEngine",
+    "DecodeModelConfig",
 ]

@@ -1,0 +1,41 @@
+"""LLM decode serving: paged KV cache + ragged paged attention +
+continuous prefill/decode scheduling.
+
+The autoregressive data path the padded-bucket ServingEngine (PR 6)
+could not express: a device-resident pool of fixed-size KV pages
+(donated executor state — XLA updates pages in place), a ragged paged
+attention kernel that gathers only each sequence's live pages through
+its page table (ops/pallas/paged_attention.py), and ONE compiled
+decode step at a fixed max-batch that continuously batches whatever
+mix of sequence lengths is live — no length padding anywhere.
+
+Quickstart::
+
+    from paddle_tpu.inference.decode import (DecodeEngine,
+                                             DecodeModelConfig)
+
+    cfg = DecodeModelConfig(vocab_size=256, n_layers=4, n_heads=8,
+                            head_dim=64, ffn_dim=1024, max_context=2048)
+    eng = DecodeEngine(cfg, n_pages=256, page_size=128,
+                       max_pages_per_seq=16, max_batch=8)
+    eng.warm()                      # compile prefill buckets + the step
+    eng.start()                     # continuous-batching scheduler
+    tokens = eng.generate([1, 5, 9], max_new_tokens=32)
+
+Admission sheds typed (``Overloaded`` / ``DeadlineExceeded`` /
+``EngineStopped`` — the PR 6 taxonomy), ``serving.install_sigterm_drain``
+drains it on SIGTERM, and the ``kv_pages_in_use`` /
+``kv_page_evictions`` / ``decode_*`` metric family scrapes through
+every /metrics listener.
+"""
+from .engine import DecodeEngine
+from .kv_cache import PageTableManager, alloc_kv_pool
+from .model import (DecodeModelConfig, init_decode_params,
+                    reference_generate)
+from .scheduler import DecodeRequest, DecodeScheduler
+
+__all__ = [
+    "DecodeEngine", "DecodeModelConfig", "DecodeRequest",
+    "DecodeScheduler", "PageTableManager", "alloc_kv_pool",
+    "init_decode_params", "reference_generate",
+]

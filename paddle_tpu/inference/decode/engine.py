@@ -1,0 +1,587 @@
+"""LLM decode engine: continuous batching over a paged KV pool with
+ONE compiled ragged decode step.
+
+Data path (vs the PR 6 padded-bucket ServingEngine): a request's
+prompt is PREFILLED once (dense forward at a pow2 page-count bucket,
+K/V scattered into its allocated pages), then joins a fixed ladder of
+decode SLOTS; every engine tick dispatches one compiled decode step at
+``max_batch`` that advances EVERY live sequence by one token, ragged
+via the page table — a batch mixing short and long contexts pays for
+the live tokens it attends, not for padding.
+
+Compiled-step substrate: both executables (prefill per bucket, the one
+decode step) build through ``static.substrate.aot_compile`` — the same
+jit/lower/compile path (donation, shardings, trace_ms/compile_ms
+accounting, persistent disk compile cache) the training Executor and
+the serving predictor use. The KV pool arrays are DONATED through both,
+so XLA updates pages in place: per-step host→device traffic is a few
+int32 control vectors.
+
+Tensor parallelism (PR 10 composition): pass ``mesh_shape={"tp": k}``
+and the engine commits params with megatron-style NamedShardings and
+the pool sharded over heads; GSPMD partitions the compiled steps —
+outputs are parity-gated against the unsharded engine in tests.
+
+Observability: ``decode_prefill_ms`` / ``decode_step_ms`` /
+``decode_e2e_ms`` histograms (dual-recorded: per-engine + the global
+/metrics registry), ``decode_batch_fill_pct`` / ``kv_pages_in_use`` /
+``kv_page_evictions`` gauges, and per-step cost gauges
+(``step_model_flops`` / ``mfu`` / ``arith_intensity``) from
+``cost_model.paged_decode_cost`` — gathered LIVE pages, not the pool.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter as _Counter
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..serving import DeadlineExceeded, RequestFailed, _DualHist
+from .kv_cache import PageTableManager, alloc_kv_pool
+from .model import (DecodeModelConfig, decode_forward, init_decode_params,
+                    kv_pool_spec, param_shardings, prefill_forward)
+from .scheduler import DecodeRequest, DecodeScheduler, RunningSeq
+
+__all__ = ["DecodeEngine"]
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+class DecodeEngine:
+    """Paged continuous-batching decode engine. Construction knobs:
+
+    config / params      DecodeModelConfig (+ optional ready params —
+                         omitted: deterministic init from ``seed``)
+    max_batch            decode slots (the ONE compiled step's batch)
+    n_pages / page_size  KV pool geometry (page 0 reserved)
+    max_pages_per_seq    page-table width per sequence
+    mesh_shape           e.g. {"tp": 2} — TP-shard params + pool
+    max_queue, rate_limit/burst, default_deadline_s, min_service_s
+                         PR 6 admission semantics (typed sheds)
+    eos_id               optional stop token
+    clock / sleep        injectable time sources (deterministic tests)
+    """
+
+    def __init__(self, config: DecodeModelConfig,
+                 params: Optional[Dict[str, object]] = None,
+                 seed: int = 0, max_batch: int = 4,
+                 n_pages: int = 64, page_size: int = 16,
+                 max_pages_per_seq: int = 8,
+                 mesh_shape: Optional[Dict[str, int]] = None,
+                 max_queue: int = 64,
+                 rate_limit: Optional[float] = None,
+                 burst: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 min_service_s: float = 0.0,
+                 eos_id: Optional[int] = None,
+                 dtype: str = "float32",
+                 clock=time.monotonic, sleep=time.sleep,
+                 tick_interval: float = 0.002):
+        import jax
+
+        from ...observability.metrics import MetricsRegistry
+
+        self.config = config
+        if config.max_context < max_pages_per_seq * page_size:
+            raise ValueError(
+                f"config.max_context={config.max_context} is smaller "
+                f"than the page budget {max_pages_per_seq}x{page_size}; "
+                f"positions past it would alias positional embeddings")
+        if n_pages - 1 < max_pages_per_seq:
+            raise ValueError(
+                f"pool of {n_pages} pages (1 reserved) cannot hold even "
+                f"one full sequence of {max_pages_per_seq} pages")
+        self.max_batch = int(max_batch)
+        self.eos_id = eos_id
+        self._clock = clock
+        self._sleep = sleep
+        self._tick_interval = float(tick_interval)
+        self._dtype = dtype
+
+        self.pool = PageTableManager(n_pages, page_size, max_pages_per_seq)
+        self.sched = DecodeScheduler(
+            self.pool, max_batch, max_queue=max_queue,
+            rate_limit=rate_limit, burst=burst,
+            default_deadline_s=default_deadline_s,
+            min_service_s=min_service_s, clock=clock)
+        self.sched._count = self._count
+
+        # -- params + pool, optionally TP-sharded -------------------------
+        self.mesh = None
+        kv_sharding = None
+        if mesh_shape:
+            from ...parallel.mesh import mesh_for_shape
+
+            self.mesh = mesh_for_shape(dict(mesh_shape))
+            shard_map, rep = param_shardings(config, self.mesh)
+            raw = params if params is not None \
+                else init_decode_params(config, seed)
+            self.params = {k: jax.device_put(v, shard_map.get(k, rep))
+                           for k, v in raw.items()}
+            kv_sharding = kv_pool_spec(self.mesh)
+        else:
+            self.params = params if params is not None \
+                else init_decode_params(config, seed)
+        self._k_pages, self._v_pages = alloc_kv_pool(
+            config.n_layers, n_pages, page_size, config.n_heads,
+            config.head_dim, dtype=dtype, sharding=kv_sharding)
+
+        # -- compiled steps (substrate) -----------------------------------
+        self._decode_step = None
+        self._prefill_steps: Dict[int, object] = {}   # n_pages -> step
+        self._warmed = False
+
+        # -- observability -------------------------------------------------
+        self._counters: _Counter = _Counter()
+        self._stats_lock = threading.Lock()
+        self._fill_rows = 0
+        self._fill_capacity = 0
+        self._hist_reg = MetricsRegistry()
+        self._h_prefill = _DualHist("decode_prefill_ms", self._hist_reg)
+        self._h_step = _DualHist("decode_step_ms", self._hist_reg)
+        self._h_e2e = _DualHist("decode_e2e_ms", self._hist_reg)
+
+        # -- scheduler thread ----------------------------------------------
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        from ...observability.server import maybe_start_metrics_server
+
+        maybe_start_metrics_server()
+
+    # -- counters ---------------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        from ... import profiler
+
+        with self._stats_lock:
+            self._counters[name] += n
+        profiler.bump_counter(name, n)
+
+    def _gauge(self, name: str, value) -> None:
+        from ... import profiler
+
+        with self._stats_lock:
+            self._counters[name] = value
+        profiler.set_counter(name, value)
+
+    def _bump(self, name: str, n=1) -> None:
+        # substrate build-timing sink (trace_ms / compile_ms)
+        self._count(name, n)
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        """This engine's decode counters plus the pool gauges and the
+        process-global fault slice — one dashboard, like
+        ``exe.counters`` / ``ServingEngine.counters``."""
+        from ... import profiler
+
+        with self._stats_lock:
+            out = dict(self._counters)
+        out["kv_pages_in_use"] = self.pool.pages_in_use
+        out["kv_page_evictions"] = self.pool.evicted_pages
+        snap = profiler.counters_snapshot()
+        for name in profiler.FAULT_COUNTER_NAMES:
+            if name in snap:
+                out[name] = snap[name]
+        return out
+
+    def engine_latency_stats(self) -> Dict[str, float]:
+        """Bucket-derived engine-side percentiles — what a /metrics
+        scraper can recompute from decode_e2e_ms / decode_step_ms /
+        decode_prefill_ms."""
+        return {
+            "n": int(self._h_e2e.snapshot()["count"]),
+            "e2e_p50_ms": round(self._h_e2e.percentile(50), 3),
+            "e2e_p99_ms": round(self._h_e2e.percentile(99), 3),
+            "step_p50_ms": round(self._h_step.percentile(50), 3),
+            "step_p99_ms": round(self._h_step.percentile(99), 3),
+            "prefill_p50_ms": round(self._h_prefill.percentile(50), 3),
+            "prefill_p99_ms": round(self._h_prefill.percentile(99), 3),
+        }
+
+    # -- compiled-step builds ---------------------------------------------
+    def _build_decode_step(self):
+        from ...static.substrate import aot_compile
+
+        cfg = self.config
+        B, T = self.max_batch, self.pool.max_pages_per_seq
+
+        def step(params, k_pages, v_pages, tokens, positions, table,
+                 lens, active):
+            return decode_forward(cfg, params, tokens, positions,
+                                  k_pages, v_pages, table, lens, active)
+
+        zi = np.zeros((B,), np.int32)
+        args = (self.params, self._k_pages, self._v_pages, zi, zi,
+                np.full((B, T), -1, np.int32), zi,
+                np.zeros((B,), np.bool_))
+        cs = aot_compile(step, args, donate_argnums=(1, 2),
+                         bump=self._bump)
+        return cs.compiled
+
+    def _build_prefill_step(self, n_pages: int):
+        from ...ops.pallas.paged_attention import paged_prefill_write
+        from ...static.substrate import aot_compile
+
+        cfg = self.config
+        Lb = n_pages * self.pool.page_size
+
+        def step(params, k_pages, v_pages, tokens, length, page_ids):
+            nxt, ks, vs = prefill_forward(cfg, params, tokens, length)
+            for i in range(cfg.n_layers):
+                ki, vi = paged_prefill_write(k_pages[i], v_pages[i],
+                                             page_ids, ks[i][0], vs[i][0])
+                k_pages = k_pages.at[i].set(ki)
+                v_pages = v_pages.at[i].set(vi)
+            return nxt, k_pages, v_pages
+
+        args = (self.params, self._k_pages, self._v_pages,
+                np.zeros((1, Lb), np.int32), np.ones((1,), np.int32),
+                np.arange(1, n_pages + 1, dtype=np.int32))
+        cs = aot_compile(step, args, donate_argnums=(1, 2),
+                         bump=self._bump)
+        return cs.compiled
+
+    def _prefill_buckets(self) -> List[int]:
+        out, n = [], 1
+        while n < self.pool.max_pages_per_seq:
+            out.append(n)
+            n *= 2
+        out.append(self.pool.max_pages_per_seq)
+        return out
+
+    def warm(self) -> int:
+        """Compile (or disk-cache-load) the decode step and every
+        prefill bucket; run before serving so no request pays a
+        compile. Returns the number of executables warmed."""
+        n = 0
+        if self._decode_step is None:
+            self._decode_step = self._build_decode_step()
+            n += 1
+        for b in self._prefill_buckets():
+            if b not in self._prefill_steps:
+                self._prefill_steps[b] = self._build_prefill_step(b)
+                n += 1
+        self._warmed = True
+        return n
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               deadline_s: Optional[float] = None):
+        """Admit one generation request; returns the pending handle
+        (``result()`` → generated token ids, ``stats()`` → TTFT and
+        per-token times). Typed admission errors raise synchronously."""
+        return self.sched.submit(prompt, max_new_tokens,
+                                 deadline_s=deadline_s)
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 deadline_s: Optional[float] = None,
+                 timeout: Optional[float] = None) -> List[int]:
+        """Blocking convenience: submit + wait for the token list."""
+        return self.submit(prompt, max_new_tokens,
+                           deadline_s=deadline_s).result(timeout)
+
+    @property
+    def ready(self) -> bool:
+        return self.sched.accepting and self._running and self._warmed
+
+    @property
+    def queue_depth(self) -> int:
+        return self.sched.queue_depth
+
+    # -- the tick -----------------------------------------------------------
+    def run_once(self) -> int:
+        """One synchronous scheduler tick: expire, admit+prefill, one
+        ragged decode step, harvest. Returns a work count (prefills +
+        tokens emitted + expiries) — 0 means nothing advanced."""
+        now = self._clock()
+        work = len(self.sched.expire_queued(now))
+        while True:
+            req = self.sched.pop_for_prefill()
+            if req is None:
+                break
+            work += self._prefill_one(req)
+        active = self.sched.active()
+        if active:
+            work += self._decode_once(active)
+        return work
+
+    def _finish(self, slot_id: Optional[int], rs_or_req, error=None):
+        req = rs_or_req.req if isinstance(rs_or_req, RunningSeq) \
+            else rs_or_req
+        if slot_id is not None:
+            self.sched.release(slot_id)
+        h = req.handle
+        now = self._clock()
+        h.meta["preempted"] = req.preempted
+        if req.token_times:
+            h.meta["ttft_ms"] = round(
+                (req.token_times[0] - req.t_submit) * 1e3, 3)
+            h.meta["token_times"] = list(req.token_times)
+        if error is not None:
+            h._resolve(error=error)
+            return
+        self._h_e2e.observe((now - req.t_submit) * 1e3)
+        h._resolve(value=list(req.generated))
+
+    def _emit(self, req: DecodeRequest, token: int) -> None:
+        req.generated.append(int(token))
+        req.token_times.append(self._clock())
+        self._count("decode_tokens")
+
+    def _req_done(self, req: DecodeRequest) -> bool:
+        if len(req.generated) >= req.max_new_tokens:
+            return True
+        return self.eos_id is not None and req.generated \
+            and req.generated[-1] == self.eos_id
+
+    def _prefill_one(self, req: DecodeRequest) -> int:
+        now = self._clock()
+        if req.deadline is not None and now >= req.deadline:
+            self._count("decode_deadline_expired")
+            self._finish(None, req, error=DeadlineExceeded(
+                f"deadline passed before prefill "
+                f"({now - req.t_submit:.3f}s since submit)"))
+            return 1
+        ctx_tokens = req.prompt + req.generated
+        ctx = len(ctx_tokens)
+        npages = min(_next_pow2(self.pool.pages_for_tokens(ctx)),
+                     self.pool.max_pages_per_seq)
+        seq_id = self.sched.new_seq_id()
+        pages = self.pool.alloc_seq(seq_id, npages * self.pool.page_size)
+        if pages is None:
+            # pow2 rounding outgrew the exact-fit check: fall back to
+            # the exact page count (compiles one extra bucket, rarely)
+            npages = self.pool.pages_for_tokens(ctx)
+            pages = self.pool.alloc_seq(seq_id, ctx)
+        if pages is None:
+            # raced out of pages (shouldn't happen single-threaded);
+            # requeue at the front and try next tick
+            with self.sched.lock:
+                self.sched.queue.appendleft(req)
+            return 0
+        step = self._prefill_steps.get(npages)
+        if step is None:
+            step = self._prefill_steps[npages] = \
+                self._build_prefill_step(npages)
+        Lb = npages * self.pool.page_size
+        toks = np.zeros((1, Lb), np.int32)
+        toks[0, :ctx] = np.asarray(ctx_tokens, np.int32)
+        t0 = time.perf_counter()
+        try:
+            nxt, self._k_pages, self._v_pages = step(
+                self.params, self._k_pages, self._v_pages, toks,
+                np.asarray([ctx], np.int32),
+                np.asarray(pages, np.int32))
+            token = int(np.asarray(nxt)[0])
+        except Exception as e:
+            self.pool.free_seq(seq_id)
+            self._count("decode_failed")
+            self._finish(None, req, error=RequestFailed(
+                f"prefill dispatch failed: {type(e).__name__}: {e}"))
+            # the prefill step donates the pool too: a runtime failure
+            # may have invalidated it — rebuild before anything else
+            # dispatches (running sequences preempt-requeue)
+            self._reset_pool()
+            return 1
+        self._h_prefill.observe((time.perf_counter() - t0) * 1e3)
+        self._count("decode_prefills")
+        self._emit(req, token)
+        if self._req_done(req):
+            self.pool.free_seq(seq_id)
+            self._finish(None, req)
+            return 1
+        # KV written so far = the prefilled context (the emitted token's
+        # own KV lands at position ctx on its decode step)
+        self.sched.place(req, seq_id, ctx, token)
+        return 1
+
+    def _reset_pool(self) -> None:
+        """Recover from a failed DONATED dispatch: JAX invalidates
+        donated inputs when execution starts, not on success, so after
+        a runtime failure self._k_pages/_v_pages may point at deleted
+        buffers — every later step would raise 'Array has been
+        deleted'. Preempt every running sequence onto the queue (their
+        emitted tokens ride the re-prefill, so greedy outputs are
+        preserved) and re-allocate a zeroed pool."""
+        while self.sched.preempt_youngest() is not None:
+            pass
+        kv_sharding = kv_pool_spec(self.mesh) \
+            if self.mesh is not None else None
+        self._k_pages, self._v_pages = alloc_kv_pool(
+            self.config.n_layers, self.pool.n_pages,
+            self.pool.page_size, self.config.n_heads,
+            self.config.head_dim, dtype=self._dtype,
+            sharding=kv_sharding)
+
+    def _decode_once(self, active: Dict[int, RunningSeq]) -> int:
+        # grow page tables for this step's writes; pool pressure
+        # preempts the youngest slot (requeued, outputs preserved)
+        for slot_id in sorted(active):
+            rs = active[slot_id]
+            if slot_id not in self.sched.slots:
+                continue   # preempted below while we iterated
+            while self.pool.append_token(rs.seq_id, rs.length + 1) == -1:
+                victim = self.sched.preempt_youngest()
+                if victim is None or victim is rs.req:
+                    break
+        active = self.sched.active()
+        if not active:
+            return 0
+        if self._decode_step is None:
+            self._decode_step = self._build_decode_step()
+        B, T = self.max_batch, self.pool.max_pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        lens = np.zeros((B,), np.int32)
+        table = np.full((B, T), -1, np.int32)
+        mask = np.zeros((B,), np.bool_)
+        for slot_id, rs in active.items():
+            tokens[slot_id] = rs.next_token
+            positions[slot_id] = rs.length
+            lens[slot_id] = rs.length
+            table[slot_id] = self.pool.table_row(rs.seq_id)
+            mask[slot_id] = True
+        t0 = time.perf_counter()
+        try:
+            nxt, self._k_pages, self._v_pages = self._decode_step(
+                self.params, self._k_pages, self._v_pages, tokens,
+                positions, table, lens, mask)
+            nxt = np.asarray(nxt)   # device sync: the step really ran
+        except Exception as e:
+            # no silent hang: every live request fails TYPED (the
+            # serving engine's retry→fail posture; _loop's backstop
+            # swallow must never be the only handler), and the
+            # possibly-invalidated donated pool is rebuilt so queued
+            # requests keep serving
+            for slot_id, rs in active.items():
+                self._count("decode_failed")
+                self._finish(slot_id, rs, error=RequestFailed(
+                    f"decode step dispatch failed: "
+                    f"{type(e).__name__}: {e}"))
+            self._reset_pool()
+            return len(active)
+        step_s = time.perf_counter() - t0
+        self._h_step.observe(step_s * 1e3)
+        self._count("decode_steps")
+        with self._stats_lock:
+            self._fill_rows += len(active)
+            self._fill_capacity += B
+            fill = round(100.0 * self._fill_rows
+                         / max(1, self._fill_capacity), 2)
+        self._gauge("decode_batch_fill_pct", fill)
+        self._publish_cost(
+            [rs.length + 1 for rs in active.values()], step_s)
+        now = self._clock()
+        emitted = 0
+        for slot_id, rs in active.items():
+            rs.length += 1
+            tok = int(nxt[slot_id])
+            rs.next_token = tok
+            self._emit(rs.req, tok)
+            emitted += 1
+            if rs.req.deadline is not None and now >= rs.req.deadline:
+                self._count("decode_deadline_expired")
+                self._finish(slot_id, rs, error=DeadlineExceeded(
+                    "deadline passed mid-generation; sequence dropped"))
+            elif self._req_done(rs.req):
+                self._finish(slot_id, rs)
+        return emitted
+
+    def _publish_cost(self, live_lens: List[int], step_s: float) -> None:
+        """Per-step cost gauges from the paged accounting (gathered
+        LIVE pages count toward hbm_bytes, never the whole pool)."""
+        try:
+            from ... import profiler
+            from ...observability.device_peaks import peaks_for
+            from ...static.cost_model import paged_decode_cost
+            from ...static.executor import _device_kind
+
+            c = paged_decode_cost(
+                self.config, live_lens, self.pool.page_size,
+                itemsize=np.dtype(self._dtype).itemsize)
+            vals = {"step_model_flops": c["model_flops"],
+                    "step_hbm_bytes": c["hbm_bytes"],
+                    "step_comm_bytes": 0,
+                    "arith_intensity": round(c["arith_intensity"], 3)}
+            peaks = peaks_for(_device_kind())
+            if peaks is not None and peaks.flops > 0 and step_s > 0:
+                vals["mfu"] = round(
+                    c["model_flops"] / step_s / peaks.flops, 6)
+            else:
+                vals["mfu"] = 0
+            for name, v in vals.items():
+                with self._stats_lock:
+                    self._counters[name] = v
+                profiler.set_counter(name, v)
+        except Exception:
+            pass   # cost accounting must never take down the step
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "DecodeEngine":
+        """Run the scheduler on a background thread; idempotent."""
+        with self.sched.lock:
+            if self._running:
+                return self
+            stale = self._thread
+        if stale is not None:
+            stale.join()
+        with self.sched.lock:
+            if self._running:
+                return self
+            self._running = True
+            self.sched.accepting = True
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="decode-scheduler")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            with self.sched.lock:
+                while self._running and not self.sched.queue \
+                        and not self.sched.slots:
+                    self.sched.lock.wait(timeout=0.05)
+                if not self._running:
+                    return
+            try:
+                work = self.run_once()
+            except BaseException:
+                work = 0   # the scheduler thread must survive
+            if work == 0 and self.sched.pending():
+                self._sleep(self._tick_interval)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, flush every queued and in-flight request,
+        stop the scheduler. True when the flush completed."""
+        with self.sched.lock:
+            self.sched.accepting = False
+            threaded = self._running
+            self.sched.lock.notify_all()
+        if not threaded:
+            while self.sched.pending():
+                if self.run_once() == 0 and self.sched.pending():
+                    return False  # wedged: nothing can advance
+            return True
+        deadline = None if timeout is None else self._clock() + timeout
+        while self.sched.pending():
+            if deadline is not None and self._clock() >= deadline:
+                return False
+            self._sleep(0.01)
+        self.stop()
+        return True
+
+    def stop(self) -> None:
+        with self.sched.lock:
+            self._running = False
+            self.sched.accepting = False
+            self.sched.lock.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            if not t.is_alive():
+                self._thread = None

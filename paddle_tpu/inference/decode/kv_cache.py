@@ -1,0 +1,169 @@
+"""Paged KV cache: a device-resident pool of fixed-size KV pages plus
+the host-side page-table manager that owns allocation, free, and
+eviction.
+
+The DEVICE side is two arrays per engine — ``k_pages`` / ``v_pages`` of
+shape ``(n_layers, n_pages, page_size, heads, head_dim)`` — created
+once by :func:`alloc_kv_pool` and thereafter threaded through the
+compiled decode step as DONATED arguments (PR 1 machinery: XLA updates
+the pages in place, zero per-step host→device state traffic).
+
+The HOST side is :class:`PageTableManager`: a free-list allocator over
+page ids with per-sequence page lists. Page 0 is RESERVED as the trash
+page (never allocated): the compiled step routes inactive batch slots'
+writes there, so no live sequence can be clobbered by a masked lane.
+
+Accounting lands in the declared gauges the moment it changes:
+``kv_pages_in_use`` (live pages now) and ``kv_page_evictions``
+(cumulative pages reclaimed by preemption) — scraped through every
+/metrics listener like the rest of the observability plane.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PageTableManager", "alloc_kv_pool"]
+
+
+def alloc_kv_pool(n_layers: int, n_pages: int, page_size: int,
+                  heads: int, head_dim: int, dtype="float32",
+                  sharding=None) -> Tuple[object, object]:
+    """Allocate the device-resident pool: zeroed ``(k_pages, v_pages)``
+    of shape (n_layers, n_pages, page_size, heads, head_dim). With
+    ``sharding`` (a NamedSharding — TP shards the heads axis) the pool
+    is created already partitioned."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = (int(n_layers), int(n_pages), int(page_size), int(heads),
+             int(head_dim))
+    if sharding is not None:
+        zeros = jax.jit(lambda: jnp.zeros(shape, jnp.dtype(dtype)),
+                        out_shardings=sharding)
+        return zeros(), zeros()
+    return (jnp.zeros(shape, jnp.dtype(dtype)),
+            jnp.zeros(shape, jnp.dtype(dtype)))
+
+
+class PageTableManager:
+    """Free-list page allocator + per-sequence page tables.
+
+    ``n_pages`` counts the whole pool; page 0 is reserved (trash page),
+    so ``capacity`` — the allocatable budget — is ``n_pages - 1``.
+    ``max_pages_per_seq`` bounds any one sequence's table row (the
+    compiled step's static table width)."""
+
+    def __init__(self, n_pages: int, page_size: int,
+                 max_pages_per_seq: int):
+        if n_pages < 2:
+            raise ValueError(f"pool needs >= 2 pages (page 0 is the "
+                             f"reserved trash page), got {n_pages}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self._free: List[int] = list(range(self.n_pages - 1, 0, -1))
+        self._seqs: Dict[int, List[int]] = {}
+        self._evicted_pages = 0
+        self._peak_in_use = 0
+        self._publish()
+
+    # -- accounting -------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.n_pages - 1
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def evicted_pages(self) -> int:
+        return self._evicted_pages
+
+    @property
+    def peak_pages_in_use(self) -> int:
+        return self._peak_in_use
+
+    def _publish(self) -> None:
+        from ... import profiler
+
+        self._peak_in_use = max(self._peak_in_use, self.pages_in_use)
+        profiler.set_counter("kv_pages_in_use", self.pages_in_use)
+        profiler.set_counter("kv_page_evictions", self._evicted_pages)
+
+    # -- allocation -------------------------------------------------------
+    def pages_for_tokens(self, n_tokens: int) -> int:
+        return max(1, -(-int(n_tokens) // self.page_size))
+
+    def can_fit(self, n_tokens: int) -> bool:
+        n = self.pages_for_tokens(n_tokens)
+        return n <= self.max_pages_per_seq and n <= len(self._free)
+
+    def alloc_seq(self, seq_id: int, n_tokens: int) -> Optional[List[int]]:
+        """Allocate the pages for a ``n_tokens``-long context; None when
+        the pool (or the table width) can't hold it — the caller decides
+        between shedding and evicting."""
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id} already has pages")
+        n = self.pages_for_tokens(n_tokens)
+        if n > self.max_pages_per_seq or n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._seqs[seq_id] = pages
+        self._publish()
+        return list(pages)
+
+    def append_token(self, seq_id: int, new_len: int) -> Optional[int]:
+        """Ensure the page holding position ``new_len - 1`` exists.
+        Returns the newly allocated page id, None when the existing
+        tail page covers it; raises KeyError for an unknown sequence
+        and returns ``-1`` when the pool or table row is exhausted
+        (caller evicts or preempts)."""
+        pages = self._seqs[seq_id]
+        need = self.pages_for_tokens(new_len)
+        if need <= len(pages):
+            return None
+        if need > self.max_pages_per_seq or not self._free:
+            return -1
+        page = self._free.pop()
+        pages.append(page)
+        self._publish()
+        return page
+
+    def free_seq(self, seq_id: int) -> int:
+        """Release a finished sequence's pages; returns the count."""
+        pages = self._seqs.pop(seq_id, [])
+        self._free.extend(reversed(pages))
+        self._publish()
+        return len(pages)
+
+    def evict_seq(self, seq_id: int) -> int:
+        """Preempt a LIVE sequence: release its pages and count them as
+        evictions (the scheduler re-queues the sequence for a fresh
+        prefill)."""
+        pages = self._seqs.pop(seq_id, [])
+        self._free.extend(reversed(pages))
+        self._evicted_pages += len(pages)
+        self._publish()
+        return len(pages)
+
+    # -- views ------------------------------------------------------------
+    def seq_pages(self, seq_id: int) -> List[int]:
+        return list(self._seqs.get(seq_id, ()))
+
+    def table_row(self, seq_id: int) -> np.ndarray:
+        """This sequence's page-table row, -1-padded to the static
+        width."""
+        row = np.full((self.max_pages_per_seq,), -1, np.int32)
+        pages = self._seqs.get(seq_id, ())
+        row[:len(pages)] = pages
+        return row
+
+    def utilization_pct(self) -> float:
+        return round(100.0 * self.pages_in_use / max(1, self.capacity), 2)

@@ -1,0 +1,259 @@
+"""Decoder-only transformer for the decode engine: functional params,
+a dense prefill forward, and a paged single-token decode forward.
+
+The model is deliberately minimal (pre-RMSNorm blocks, learned
+positional embeddings, relu FFN, greedy head) — the engine's subject is
+the DATA PATH (paged KV, ragged attention, continuous batching), not
+model quality. Three forwards share the same math:
+
+- :func:`dense_forward` — full causal attention over a token matrix;
+  the oracle every paged path is parity-gated against
+  (:func:`reference_generate` drives it token by token).
+- :func:`prefill_forward` — dense_forward plus the per-layer K/V it
+  produced, for scattering into the page pool.
+- :func:`decode_forward` — ONE token per sequence: writes its K/V into
+  the page pool (``paged_write``) and attends through the ragged paged
+  attention kernel over the page table. No length padding anywhere.
+
+Tensor-parallel serving (PR 10 composition): :func:`param_shardings`
+returns the megatron-style NamedSharding map (qkv column-parallel, out
+row-parallel, ffn col/row) and :func:`kv_pool_spec` shards the pool
+over the heads axis; under jit, GSPMD inserts the collectives — the
+engine just commits params/pool with these shardings.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["DecodeModelConfig", "init_decode_params", "dense_forward",
+           "prefill_forward", "decode_forward", "reference_generate",
+           "param_shardings", "kv_pool_spec"]
+
+
+class DecodeModelConfig:
+    """Shapes of the decode model. ``hidden = n_heads * head_dim``."""
+
+    def __init__(self, vocab_size: int = 64, n_layers: int = 2,
+                 n_heads: int = 4, head_dim: int = 8, ffn_dim: int = 64,
+                 max_context: int = 128):
+        self.vocab_size = int(vocab_size)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.ffn_dim = int(ffn_dim)
+        self.max_context = int(max_context)
+
+    @property
+    def hidden(self) -> int:
+        return self.n_heads * self.head_dim
+
+    def to_dict(self) -> dict:
+        return {"vocab_size": self.vocab_size, "n_layers": self.n_layers,
+                "n_heads": self.n_heads, "head_dim": self.head_dim,
+                "ffn_dim": self.ffn_dim, "max_context": self.max_context}
+
+
+def init_decode_params(cfg: DecodeModelConfig,
+                       seed: int = 0) -> Dict[str, object]:
+    """Deterministic f32 params (numpy RandomState — the same seed
+    yields bitwise-identical params in every process)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    E, F, V = cfg.hidden, cfg.ffn_dim, cfg.vocab_size
+
+    def w(*shape, scale=None):
+        s = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * s)
+
+    p: Dict[str, object] = {
+        "tok_emb": w(V, E, scale=0.5),
+        "pos_emb": w(cfg.max_context, E, scale=0.1),
+        "lnf": jnp.ones((E,), jnp.float32),
+        "head": w(E, V),
+    }
+    for i in range(cfg.n_layers):
+        p[f"l{i}.ln1"] = jnp.ones((E,), jnp.float32)
+        p[f"l{i}.wq"] = w(E, E)
+        p[f"l{i}.wk"] = w(E, E)
+        p[f"l{i}.wv"] = w(E, E)
+        p[f"l{i}.wo"] = w(E, E)
+        p[f"l{i}.ln2"] = jnp.ones((E,), jnp.float32)
+        p[f"l{i}.w1"] = w(E, F)
+        p[f"l{i}.w2"] = w(F, E)
+    return p
+
+
+def _rms(x, scale):
+    import jax.numpy as jnp
+
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * scale / jnp.sqrt(var + 1e-6)
+
+
+def _split_heads(x, n_heads, head_dim):
+    return x.reshape(x.shape[:-1] + (n_heads, head_dim))
+
+
+def _forward_layers(cfg: DecodeModelConfig, params, h, attn_fn,
+                    write_fn=None):
+    """Shared block loop: ``attn_fn(i, q, k, v) -> attn out`` supplies
+    the attention data path (dense vs paged); ``write_fn(i, k, v)``
+    (paged decode) persists the new K/V before attention runs."""
+    import jax.numpy as jnp
+
+    H, D = cfg.n_heads, cfg.head_dim
+    for i in range(cfg.n_layers):
+        x = _rms(h, params[f"l{i}.ln1"])
+        q = _split_heads(x @ params[f"l{i}.wq"], H, D)
+        k = _split_heads(x @ params[f"l{i}.wk"], H, D)
+        v = _split_heads(x @ params[f"l{i}.wv"], H, D)
+        if write_fn is not None:
+            write_fn(i, k, v)
+        attn = attn_fn(i, q, k, v)
+        h = h + attn.reshape(attn.shape[:-2] + (cfg.hidden,)) \
+            @ params[f"l{i}.wo"]
+        x = _rms(h, params[f"l{i}.ln2"])
+        h = h + jnp.maximum(x @ params[f"l{i}.w1"], 0.0) \
+            @ params[f"l{i}.w2"]
+    return _rms(h, params["lnf"]) @ params["head"]
+
+
+def dense_forward(cfg: DecodeModelConfig, params, tokens,
+                  collect_kv: bool = False):
+    """Full causal forward over ``tokens`` (B, L) → logits (B, L, V);
+    with ``collect_kv`` also the per-layer K/V stacks
+    (n_layers, B, L, H, D) for prefill page writes."""
+    import jax
+    import jax.numpy as jnp
+
+    B, L = tokens.shape
+    D = cfg.head_dim
+    h = params["tok_emb"][tokens] + params["pos_emb"][:L][None, :, :]
+    ks: List = []
+    vs: List = []
+
+    def attn(i, q, k, v):
+        if collect_kv:
+            ks.append(k)
+            vs.append(v)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(D)
+        causal = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(causal[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+                          ).astype(h.dtype)
+
+    logits = _forward_layers(cfg, params, h, attn)
+    if collect_kv:
+        return logits, jnp.stack(ks), jnp.stack(vs)
+    return logits
+
+
+def prefill_forward(cfg: DecodeModelConfig, params, tokens, lens):
+    """Prefill one padded prompt batch (B, Lp): next greedy token per
+    row (logits at position ``lens-1``) plus the per-layer K/V stacks
+    to scatter into pages. Pad positions are causal-masked dead weight —
+    they never influence positions < lens and their K/V is masked by
+    seq_lens at decode time."""
+    import jax.numpy as jnp
+
+    logits, ks, vs = dense_forward(cfg, params, tokens, collect_kv=True)
+    idx = jnp.clip(lens - 1, 0, tokens.shape[1] - 1)
+    last = jnp.take_along_axis(
+        logits, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return jnp.argmax(last, axis=-1).astype(jnp.int32), ks, vs
+
+
+def decode_forward(cfg: DecodeModelConfig, params, tokens, positions,
+                   k_pages, v_pages, page_table, seq_lens, active):
+    """One ragged decode step at fixed max-batch: write each sequence's
+    new K/V into its page slot, attend over its live pages (+ the token
+    just written), return the next greedy token and the updated pools.
+
+    ``tokens``/``positions``/``seq_lens``/``active`` are (B,);
+    ``k_pages``/``v_pages`` are the stacked (n_layers, P, S, H, D)
+    pools (donated through the compiled step)."""
+    import jax.numpy as jnp
+
+    from ...ops.pallas.paged_attention import paged_attention, paged_write
+
+    maxp = cfg.max_context - 1
+    h = params["tok_emb"][tokens] \
+        + params["pos_emb"][jnp.clip(positions, 0, maxp)]
+    pools = {"k": k_pages, "v": v_pages}
+
+    def write(i, k, v):
+        ki, vi = paged_write(pools["k"][i], pools["v"][i], page_table,
+                             positions, k, v, active)
+        pools["k"] = pools["k"].at[i].set(ki)
+        pools["v"] = pools["v"].at[i].set(vi)
+
+    def attn(i, q, k, v):
+        return paged_attention(q, pools["k"][i], pools["v"][i],
+                               page_table, seq_lens + 1)
+
+    logits = _forward_layers(cfg, params, h, attn, write_fn=write)
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            pools["k"], pools["v"])
+
+
+def reference_generate(cfg: DecodeModelConfig, params, prompt,
+                       max_new_tokens: int,
+                       eos_id: Optional[int] = None) -> List[int]:
+    """Greedy oracle: full dense recompute per emitted token (no KV
+    cache, no paging, no batching) — the output every engine/paged
+    configuration is parity-gated against."""
+    import jax.numpy as jnp
+
+    tokens = [int(t) for t in prompt]
+    for _ in range(int(max_new_tokens)):
+        logits = dense_forward(
+            cfg, params, jnp.asarray([tokens], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        tokens.append(nxt)
+        if eos_id is not None and nxt == eos_id:
+            break
+    return tokens[len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel shardings (PR 10 composition): megatron-style
+# column/row splits; GSPMD inserts the psums under jit
+# ---------------------------------------------------------------------------
+def param_shardings(cfg: DecodeModelConfig, mesh, axis: str = "tp"):
+    """name -> NamedSharding: qkv column-parallel (heads split across
+    ``axis``), out-projection row-parallel, ffn col/row; embeddings,
+    norms and head replicated. Requires n_heads and ffn_dim divisible
+    by the axis size."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    size = mesh.shape[axis]
+    if cfg.n_heads % size or cfg.ffn_dim % size:
+        raise ValueError(
+            f"tp={size} must divide n_heads={cfg.n_heads} and "
+            f"ffn_dim={cfg.ffn_dim}")
+    col = NamedSharding(mesh, P(None, axis))
+    row = NamedSharding(mesh, P(axis, None))
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for i in range(cfg.n_layers):
+        out[f"l{i}.wq"] = col
+        out[f"l{i}.wk"] = col
+        out[f"l{i}.wv"] = col
+        out[f"l{i}.wo"] = row
+        out[f"l{i}.w1"] = col
+        out[f"l{i}.w2"] = row
+    return out, rep
+
+
+def kv_pool_spec(mesh, axis: str = "tp"):
+    """The pool's NamedSharding: (n_layers, P, S, heads, head_dim)
+    partitioned over the heads axis — each chip holds its own heads'
+    pages, matching the column-parallel qkv projections."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(None, None, None, axis, None))

@@ -91,6 +91,19 @@ SCALARS = {
     "ps_snapshot_commits": ("counter", "crash-safe pserver table snapshots committed"),
     "ps_replication_lag": ("gauge", "frames accepted by the primary not yet replicated (async queue depth)"),
     "ps_conn_timeouts": ("counter", "pserver connections closed on the idle timeout"),
+    # LLM decode engine (inference/decode: paged KV pool + ragged
+    # paged attention + continuous prefill/decode scheduling)
+    "decode_requests": ("counter", "decode requests admitted past admission control"),
+    "decode_tokens": ("counter", "tokens generated by the decode engine (prefill first tokens included)"),
+    "decode_steps": ("counter", "compiled ragged decode steps dispatched"),
+    "decode_prefills": ("counter", "prompt prefills dispatched (incl. re-prefills after preemption)"),
+    "decode_shed": ("counter", "decode requests shed at admission (queue bound or token bucket)"),
+    "decode_deadline_expired": ("counter", "decode requests dropped because their deadline passed/was unmakeable"),
+    "decode_preempted": ("counter", "running sequences preempted under page-pool pressure (requeued, outputs preserved)"),
+    "decode_failed": ("counter", "decode requests failed outright (prefill/step dispatch error)"),
+    "decode_batch_fill_pct": ("gauge", "cumulative mean live slots / max_batch per decode step, percent"),
+    "kv_pages_in_use": ("gauge", "KV pool pages currently allocated to live sequences"),
+    "kv_page_evictions": ("gauge", "cumulative KV pages reclaimed by preemption/eviction"),
     # observability plane itself
     "metrics_label_overflow": ("counter", "label sets folded into the overflow series by the cardinality cap"),
     "flightrec_dumps": ("counter", "flight-recorder postmortem dumps written"),
@@ -123,6 +136,15 @@ HISTOGRAMS = {
         "parameter-server RPC round-trip per attempt", ("op",)),
     "kv_request_ms": (
         "http_kv request round-trip per attempt (incl. wait polls)", ()),
+    "decode_prefill_ms": (
+        "decode-engine prompt prefill wall time per dispatch (pow2 "
+        "page-count bucket, KV scattered into pages)", ()),
+    "decode_step_ms": (
+        "one compiled ragged decode step: every live slot advances one "
+        "token over its page table", ()),
+    "decode_e2e_ms": (
+        "decode request end-to-end latency, admission to final token — "
+        "engine-side truth; p50/p99 derive from the buckets", ()),
 }
 
 

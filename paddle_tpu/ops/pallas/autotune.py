@@ -32,6 +32,13 @@ define_flag("flash_autotune", True,
             "(cudnn_exhaustive_search parity). TPU only; "
             "FLAGS_flash_short_seq=True overrides to always-short")
 
+define_flag("paged_autotune", True,
+            "Time the ragged paged-attention Pallas kernel against the "
+            "XLA gather path once per (batch, pages, page_size, heads, "
+            "head_dim, dtype) decode shape and dispatch the winner "
+            "(persisted in the same disk cache as the flash verdicts). "
+            "TPU only")
+
 _cache: Dict[tuple, str] = {}
 _ITERS = 8
 
@@ -219,6 +226,120 @@ def best_short_window_impl(b, l, h, d, dtype, causal,
     disk[_disk_key(key)] = winner
     _save_disk()
     return winner
+
+
+def paged_cache_key(b, pages, page_size, h, d, dtype) -> tuple:
+    """The paged-attention verdict key: namespaced alongside the flash
+    keys in the ONE memo/disk cache ('paged' leading component — a
+    flash (b, l, ...) tuple can never collide with it)."""
+    return ("paged", int(b), int(pages), int(page_size), int(h), int(d),
+            str(dtype))
+
+
+def best_paged_impl(b, pages, page_size, h, d, dtype,
+                    pool_pages=None) -> str | None:
+    """'pallas' | 'xla' for this decode shape, timed on the device over
+    a representative random pool (memoized + disk-persisted like the
+    flash verdicts), or None when no candidate could be timed. Must
+    only be called with _paged_ok shapes on a TPU backend.
+
+    ``pool_pages`` bounds the synthetic pool at the REAL pool's size:
+    the tuner runs while the engine's donated pool and params are
+    already resident, so allocating b*pages disjoint pages could
+    transiently double HBM on a production config — table entries
+    alias pages instead, exactly as live tables do. Not part of the
+    verdict key (it only shapes the probe allocation)."""
+    key = paged_cache_key(b, pages, page_size, h, d, dtype)
+    if key in _cache:
+        _stats["mem_hits"] += 1
+        return _cache[key]
+
+    import jax
+    import jax.numpy as jnp
+
+    disk = _load_disk()
+    hit = disk.get(_disk_key(key))
+    if hit in ("pallas", "xla"):
+        _stats["disk_hits"] += 1
+        try:
+            from ... import profiler
+
+            profiler.bump_counter("autotune_disk_hits")
+        except Exception:
+            pass  # counter is best-effort; the verdict still serves
+        _cache[key] = hit
+        return hit
+
+    from ...utils.timing import timeit
+    from . import paged_attention as pa
+
+    rng = jax.random.key(1)
+    pool = max(b * pages + 1, 2)
+    if pool_pages:
+        pool = max(2, min(pool, int(pool_pages)))
+    k_pages = jax.random.normal(rng, (pool, page_size, h, d),
+                                jnp.float32).astype(dtype)
+    v_pages = k_pages + 1.0
+    q = jax.random.normal(jax.random.key(2), (b, h, d),
+                          jnp.float32).astype(dtype)
+    # every sequence at the worst-case live length for the table width
+    # (the shape being tuned, not a particular traffic mix); entries
+    # alias the bounded pool like live page tables alias the real one
+    table = (jnp.arange(b * pages, dtype=jnp.int32) % (pool - 1)
+             + 1).reshape(b, pages)
+    lens = jnp.full((b,), pages * page_size, jnp.int32)
+
+    candidates = {
+        "pallas": jax.jit(lambda qq: pa._paged_attention_pallas(
+            qq, k_pages, v_pages, table, lens)),
+        "xla": jax.jit(lambda qq: pa._xla_paged_attention(
+            qq, k_pages, v_pages, table, lens)),
+    }
+    times = {}
+    for name, fn in candidates.items():
+        try:
+            times[name] = timeit(fn, q, iters=_ITERS)
+        except Exception as e:  # candidate fails to compile/run: skip it
+            sys.stderr.write(f"paged autotune: {name} failed "
+                             f"({type(e).__name__}: {e})\n")
+    if not times:
+        sys.stderr.write("paged autotune: all candidates failed; "
+                         "keeping static dispatch\n")
+        return None
+    winner = min(times, key=times.get)
+    sys.stderr.write(
+        f"paged autotune (b={b} pages={pages} S={page_size} h={h} "
+        f"d={d}): "
+        + " ".join(f"{n}={t:.3f}ms" for n, t in sorted(times.items()))
+        + f" -> {winner}\n")
+    _stats["timed"] += 1
+    _cache[key] = winner
+    disk[_disk_key(key)] = winner
+    _save_disk()
+    return winner
+
+
+def paged_attention_choice(q, k_pages, page_table) -> str | None:
+    """The paged dispatch entry: the tuned impl name, or None when
+    autotuning does not apply (not TPU / flag off) — None keeps the
+    static dispatch (kernel-first with XLA fallback)."""
+    from ...framework.bringup import TPU_PLATFORMS
+
+    if not get_flag("paged_autotune"):
+        return None
+    import jax
+
+    if jax.default_backend() not in TPU_PLATFORMS:
+        return None
+    b, h, d = q.shape
+    try:
+        return best_paged_impl(b, page_table.shape[1], k_pages.shape[1],
+                               h, d, q.dtype,
+                               pool_pages=k_pages.shape[0])
+    except Exception as e:
+        sys.stderr.write(f"paged autotune failed, static dispatch keeps "
+                         f"({type(e).__name__}: {e})\n")
+        return None
 
 
 def short_window_choice(q, k, causal, dropout_p) -> str | None:

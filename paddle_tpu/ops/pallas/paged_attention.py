@@ -1,0 +1,256 @@
+"""Ragged paged attention for TPU decode steps (Pallas kernel + XLA
+gather fallback).
+
+The LLM decode data path (PAPERS.md "Ragged Paged Attention: A
+High-Performance and Flexible LLM Inference Kernel for TPU"): each
+sequence's KV history lives in fixed-size PAGES of a device-resident
+pool, and a decode step attends one query token per sequence against
+only that sequence's LIVE pages, addressed through a per-sequence page
+table — no length padding, so a batch mixing a 40-token and a
+4000-token context does 40+4000 tokens of work, not 2×4000.
+
+Layout:
+
+- ``q``          (B, H, D)        one query token per sequence
+- ``k_pages``    (P, S, H, D)     the pool: P pages of S tokens each
+- ``v_pages``    (P, S, H, D)
+- ``page_table`` (B, T) int32     page ids per sequence, -1 = unused
+- ``seq_lens``   (B,) int32       live tokens per sequence (ragged)
+
+Kernel shape: grid (B, T) with the page table SCALAR-PREFETCHED
+(``PrefetchScalarGridSpec``) so each grid step's KV block is DMA'd
+straight from the page the table names — the gather never materializes
+a contiguous copy of the context. Online-softmax carries (m, l, acc)
+persist in VMEM scratch across a sequence's page steps; pages past
+``ceil(seq_len/S)`` are skipped (``pl.when``), which is where the
+ragged win comes from.
+
+Dispatch follows the established kernel pattern (flash_attention.py):
+an eligibility gate (``_paged_ok``), per-decision counters
+(``paged_attention.pallas`` / ``.xla`` with a reason), an autotuned
+choice persisted in the PR 10 disk cache (autotune.py), and
+``PADDLE_PAGED_ATTENTION=0`` as the bitwise escape leg that pins the
+XLA gather path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+_F32 = jnp.float32
+
+__all__ = ["paged_attention", "paged_write", "paged_prefill_write"]
+
+
+# ---------------------------------------------------------------------------
+# XLA gather fallback — the reference data path the kernel is parity-
+# gated against (and the only path off-TPU / for ineligible shapes)
+# ---------------------------------------------------------------------------
+def _xla_paged_attention(q, k_pages, v_pages, page_table, seq_lens):
+    """Gather each sequence's pages, mask the ragged tail, attend."""
+    B, H, D = q.shape
+    S = k_pages.shape[1]
+    T = page_table.shape[1]
+    safe = jnp.maximum(page_table, 0)                      # (B, T)
+    k = k_pages[safe].reshape(B, T * S, H, D)
+    v = v_pages[safe].reshape(B, T * S, H, D)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(_F32), k.astype(_F32),
+                   preferred_element_type=_F32) / math.sqrt(D)
+    pos = jnp.arange(T * S, dtype=jnp.int32)
+    s = jnp.where(pos[None, None, :] < seq_lens[:, None, None],
+                  s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v.astype(_F32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (B, T), page table scalar-prefetched, online
+# softmax carried in VMEM scratch across a sequence's page steps
+# ---------------------------------------------------------------------------
+def _paged_attn_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_sc, l_sc, acc_sc, *, page_size, sm_scale):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    num_pages = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc[...], _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc[...])
+        acc_sc[...] = jnp.zeros_like(acc_sc[...])
+
+    length = lens_ref[b]
+
+    @pl.when(j * page_size < length)
+    def _page():
+        q = q_ref[...].astype(_F32) * sm_scale          # (H, D)
+        k = jnp.swapaxes(k_ref[...].astype(_F32), 0, 1)  # (H, S, D)
+        v = jnp.swapaxes(v_ref[...].astype(_F32), 0, 1)  # (H, S, D)
+        H, S = q.shape[0], k.shape[1]
+        # per-head batched q·K^T: (H, D) x (H, S, D) -> (H, S)
+        s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (0,))),
+                                preferred_element_type=_F32)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, S), 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_prev = m_sc[:, 0]
+        l_prev = l_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, 0] = alpha * l_prev + jnp.sum(p, axis=1)
+        m_sc[:, 0] = m_new
+        # (H, S) x (H, S, D) -> (H, D)
+        pv = jax.lax.dot_general(p, v, (((1,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=_F32)
+        acc_sc[...] = acc_sc[...] * alpha[:, None] + pv
+
+    @pl.when(j == num_pages - 1)
+    def _flush():
+        norm = jnp.maximum(l_sc[:, 0], 1e-30)[:, None]
+        o_ref[...] = (acc_sc[...] / norm).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _paged_attention_pallas(q, k_pages, v_pages, page_table, seq_lens):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    S = k_pages.shape[1]
+    T = page_table.shape[1]
+    sm_scale = 1.0 / math.sqrt(D)
+    # dead/unused table entries route the DMA at a real page (0); the
+    # pl.when page gate skips their compute and the ragged mask keeps
+    # their positions out of the softmax either way
+    safe_table = jnp.maximum(page_table, 0).astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,       # page_table, seq_lens
+        grid=(B, T),
+        in_specs=[
+            pl.BlockSpec((None, H, D), lambda b, j, pt, lens: (b, 0, 0)),
+            pl.BlockSpec((None, S, H, D),
+                         lambda b, j, pt, lens: (pt[b, j], 0, 0, 0)),
+            pl.BlockSpec((None, S, H, D),
+                         lambda b, j, pt, lens: (pt[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, H, D),
+                               lambda b, j, pt, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), _F32),       # running max m
+            pltpu.VMEM((H, 1), _F32),       # running normalizer l
+            pltpu.VMEM((H, D), _F32),       # value accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_attn_kernel, page_size=S,
+                          sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+    )(safe_table, seq_lens.astype(jnp.int32), q, k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+def _paged_ok(q, k_pages) -> bool:
+    from ...framework.bringup import pallas_enabled
+
+    if not pallas_enabled():
+        return False
+    B, H, D = q.shape
+    S = k_pages.shape[1]
+    # S % 128: the score tile's lane dim is the page; D % 64 / <= 256
+    # mirrors the flash kernel's head-dim contract; the H*S + H*D
+    # scratch stays far inside VMEM at these ceilings
+    return (S % 128 == 0 and D % 64 == 0 and D <= 256 and
+            H <= 128 and S <= 1024)
+
+
+def _escape_pinned() -> bool:
+    """PADDLE_PAGED_ATTENTION=0 pins the XLA gather path — the bitwise
+    escape leg (same shape as PADDLE_IR_PASSES=0 for the pass
+    pipeline)."""
+    return os.environ.get("PADDLE_PAGED_ATTENTION", "").strip() == "0"
+
+
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens):
+    """Decode-step attention over the paged KV pool: best path for the
+    backend (Pallas when eligible — autotune-arbitrated in the window
+    where it competes with XLA — else the XLA gather fallback). One
+    counter bump per dispatch decision (trace time under jit)."""
+    from .counters import bump
+
+    if _escape_pinned():
+        bump("paged_attention", "xla", "PADDLE_PAGED_ATTENTION=0 pin")
+        return _xla_paged_attention(q, k_pages, v_pages, page_table,
+                                    seq_lens)
+    if _paged_ok(q, k_pages):
+        from .autotune import paged_attention_choice
+
+        choice = paged_attention_choice(q, k_pages, page_table)
+        if choice == "xla":
+            bump("paged_attention", "xla", "autotuned: xla wins this shape")
+            return _xla_paged_attention(q, k_pages, v_pages, page_table,
+                                        seq_lens)
+        try:
+            out = _paged_attention_pallas(q, k_pages, v_pages,
+                                          page_table, seq_lens)
+            bump("paged_attention", "pallas")
+            return out
+        except Exception as e:
+            bump("paged_attention", "xla",
+                 f"kernel error {type(e).__name__}: {e}")
+    else:
+        bump("paged_attention", "xla",
+             f"dispatch ineligible (q {tuple(q.shape)}, page "
+             f"{k_pages.shape[1]}; gate in _paged_ok)")
+    return _xla_paged_attention(q, k_pages, v_pages, page_table, seq_lens)
+
+
+# ---------------------------------------------------------------------------
+# page writes: decode-step single-token scatter + prefill bulk scatter
+# ---------------------------------------------------------------------------
+def paged_write(k_pages, v_pages, page_table, positions, new_k, new_v,
+                active=None):
+    """Scatter ONE new token's K/V per sequence into its page slot.
+
+    ``positions`` (B,) is the absolute write position; the owning page
+    is ``page_table[b, positions[b] // S]``. Inactive batch slots (and
+    unused -1 table entries) are routed at the reserved trash page 0,
+    which the pool manager never allocates — their writes land
+    harmlessly where no live page table points."""
+    S = k_pages.shape[1]
+    pidx = jnp.take_along_axis(page_table,
+                               (positions // S)[:, None], axis=1)[:, 0]
+    pidx = jnp.maximum(pidx, 0)
+    if active is not None:
+        pidx = jnp.where(active, pidx, 0)
+    off = positions % S
+    k_pages = k_pages.at[pidx, off].set(new_k.astype(k_pages.dtype))
+    v_pages = v_pages.at[pidx, off].set(new_v.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+def paged_prefill_write(k_pages, v_pages, page_ids, new_k, new_v):
+    """Scatter one prefilled prompt's K/V into its allocated pages.
+
+    ``page_ids`` (n,) names the pages; ``new_k``/``new_v`` are
+    (n * S, H, D) — the prompt padded up to a whole number of pages
+    (pad positions are dead: seq_lens masks them at attention time)."""
+    S = k_pages.shape[1]
+    n = page_ids.shape[0]
+    H, D = new_k.shape[-2], new_k.shape[-1]
+    k_pages = k_pages.at[page_ids].set(
+        new_k.reshape(n, S, H, D).astype(k_pages.dtype))
+    v_pages = v_pages.at[page_ids].set(
+        new_v.reshape(n, S, H, D).astype(v_pages.dtype))
+    return k_pages, v_pages

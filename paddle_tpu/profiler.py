@@ -210,6 +210,16 @@ PS_COUNTER_NAMES = (
     "ps_snapshot_commits", "ps_replication_lag", "ps_conn_timeouts",
 )
 
+# LLM decode-engine counters (inference/decode: paged KV pool + ragged
+# paged attention + continuous prefill/decode scheduling;
+# DecodeEngine.counters merges these plus the fault slice)
+DECODE_COUNTER_NAMES = (
+    "decode_requests", "decode_tokens", "decode_steps",
+    "decode_prefills", "decode_shed", "decode_deadline_expired",
+    "decode_preempted", "decode_failed", "decode_batch_fill_pct",
+    "kv_pages_in_use", "kv_page_evictions",
+)
+
 # serving-path counters (ServingEngine.counters merges these plus the
 # fault slice, mirroring Executor.counters)
 SERVE_COUNTER_NAMES = (

@@ -44,7 +44,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["OpCost", "CostReport", "program_cost"]
+__all__ = ["OpCost", "CostReport", "program_cost", "paged_decode_cost"]
 
 # matmul-class ops: the MFU numerator (2 FLOPs per MAC)
 _MATMUL_OPS = {"mul", "matmul", "matmul_v2"}
@@ -306,7 +306,33 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
             if bshape and wshape:
                 flops = 2 * _prod(bshape) * _prod(wshape[1:])
 
-        if t in _FREE_OPS:
+        if t == "paged_attention":
+            # ragged paged decode attention: only the GATHERED live
+            # pages (page-table entries x page bytes, K and V) count
+            # toward hbm_bytes — never the whole pool the KPages/VPages
+            # operands declare. FLOPs are the two attention matmuls
+            # (scores + values) over the table-bounded context, the
+            # same accounting the bench closed forms use.
+            q_name = (op.inputs.get("Q") or [None])[0]
+            kp_name = (op.inputs.get("KPages") or [None])[0]
+            pt_name = (op.inputs.get("PageTable") or [None])[0]
+            qshape = shape_of(q_name, b) if q_name else None
+            kshape = shape_of(kp_name, b) if kp_name else None
+            tshape = shape_of(pt_name, b) if pt_name else None
+            if qshape and kshape and tshape:
+                h, d = qshape[-2], qshape[-1]
+                page_size = kshape[-3]
+                live_tokens = _prod(tshape) * page_size
+                item = _itemsize(getattr(block.vars.get(kp_name),
+                                         "dtype", "float32"))
+                flops = 4 * h * d * live_tokens   # 2 matmuls x 2 F/MAC
+                hbm = (2 * live_tokens * h * d * item   # live K+V pages
+                       + sum(nbytes_of(n, b) for n in (q_name,) if n)
+                       + sum(nbytes_of(n, b) for n in outs)
+                       + (nbytes_of(pt_name, b) if pt_name else 0))
+            else:
+                hbm = 0
+        elif t in _FREE_OPS:
             hbm = 0
         elif t in _PRODUCER_OPS:
             hbm = sum(nbytes_of(n, b) for n in outs)
@@ -349,3 +375,46 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
 
     return CostReport(out, gm_k=gm_k, pp_stages=int(pp or 1),
                       n_shards=n_shards, batch=batch)
+
+
+def paged_decode_cost(config, live_lens: Sequence[int], page_size: int,
+                      itemsize: int = 4) -> Dict[str, float]:
+    """Analytic cost of ONE ragged paged decode step — the decode
+    engine's source for the ``step_model_flops`` / ``step_hbm_bytes``
+    / ``mfu`` / ``arith_intensity`` gauges (PR 12 plane), kept truthful
+    on decode: attention HBM counts the GATHERED LIVE PAGES of each
+    sequence (``ceil(len/page_size) * page_size`` positions), never the
+    whole pool.
+
+    ``config`` carries the model dims (``DecodeModelConfig`` or
+    anything with n_layers/n_heads/head_dim/ffn_dim/vocab_size);
+    ``live_lens`` is the attended context length per LIVE slot this
+    step.
+
+    FLOPs (matmul-class only, the MFU numerator): per live token the
+    qkv+out projections (8E²) + ffn pair (4EF) + vocab head (2EV), plus
+    per layer the two attention matmuls over the live context (4·E·ctx).
+    HBM: the weights stream once per step (decode is bandwidth-bound
+    precisely because of this) + the live K/V pages read and the new
+    token's K/V written."""
+    L = int(config.n_layers)
+    H = int(config.n_heads)
+    D = int(config.head_dim)
+    E = H * D
+    F = int(config.ffn_dim)
+    V = int(config.vocab_size)
+    n = len(live_lens)
+    flops = 0
+    page_tokens = 0
+    for ln in live_lens:
+        flops += L * (8 * E * E + 4 * E * F + 4 * E * int(ln)) \
+            + 2 * E * V
+        page_tokens += -(-int(ln) // int(page_size)) * int(page_size)
+    param_bytes = (L * (4 * E * E + 2 * E * F) + 2 * V * E) * itemsize
+    hbm = (param_bytes
+           + 2 * L * page_tokens * E * itemsize      # live K+V pages read
+           + 2 * L * n * E * itemsize                # new K+V written
+           + n * V * itemsize)                       # logits out
+    return {"model_flops": int(flops), "hbm_bytes": int(hbm),
+            "arith_intensity": flops / hbm if hbm else 0.0,
+            "live_slots": n, "live_page_tokens": int(page_tokens)}

@@ -926,7 +926,12 @@ class Executor:
         the block, the step is compiled as a lax.scan over k microbatches
         instead (_gm_step_fn); with ``pp`` (resolve_pipeline stage count)
         on top, the microbatch loop runs on the GPipe fill-drain schedule
-        over the ``__pp_stage``-stamped forward stages (_pp_step_fn)."""
+        over the ``__pp_stage``-stamped forward stages (_pp_step_fn).
+
+        The jit/lower/compile mechanics live in substrate.aot_compile —
+        the ONE compiled-step build path this executor shares with the
+        decode engine (inference/decode) and, through Executor.run, the
+        serving predictor."""
 
         gm_bwd = None
         if gm is not None:
@@ -950,11 +955,7 @@ class Executor:
                              for n, s in zip(persist_names, state)]
                 return fetches, new_state
 
-        jit_kwargs = {}
-        if self._donate:
-            # state + rng buffers are reused in place by XLA; feeds are
-            # fresh per step and stay un-donated
-            jit_kwargs["donate_argnums"] = (1, 2)
+        in_shardings = out_shardings = None
         if sharding is not None:
             param_shard = sharding.get("__param__")
             # per-name entries (the shard_propagation boundary map:
@@ -967,21 +968,21 @@ class Executor:
                 [sharding.get(k) for k in feed_keys],
                 state_shards,
                 sharding.get("__rng__"))
-            jit_kwargs["in_shardings"] = in_shardings
             # pin state OUTPUTS to the same layout: chained steps feed
             # new_state straight back in without re-partitioning
-            jit_kwargs["out_shardings"] = (
+            out_shardings = (
                 [None] * len(fetch_names),
                 state_shards)
-        jitted = jax.jit(step, **jit_kwargs)
-        t0 = time.perf_counter()
-        lowered = jitted.lower(feed_vals, state, rng)
-        t1 = time.perf_counter()
-        compiled = lowered.compile()
-        t2 = time.perf_counter()
-        self._bump("trace_ms", round((t1 - t0) * 1e3, 3))
-        self._bump("compile_ms", round((t2 - t1) * 1e3, 3))
-        return compiled
+        from .substrate import aot_compile
+
+        cs = aot_compile(
+            step, (feed_vals, state, rng),
+            # state + rng buffers are reused in place by XLA; feeds are
+            # fresh per step and stay un-donated
+            donate_argnums=(1, 2) if self._donate else None,
+            in_shardings=in_shardings, out_shardings=out_shardings,
+            bump=self._bump)
+        return cs.compiled
 
     @staticmethod
     def _merge_region(block, feed_keys, feed_vals, persist_names,

@@ -210,6 +210,37 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["serve_degraded"] == 0 and last["serve_failed"] == 0, last
     assert 0 < last["serve_batch_fill_pct"] <= 100.0, last
     assert last["serve_batches"] <= last["serve_requests"], last
+    # LLM decode probe contract: the paged continuous-batching engine
+    # beats the padded-bucket data path ON THE SAME MODEL at mixed
+    # lengths with IDENTICAL greedy outputs, engine-side p50/p99 come
+    # from the decode histograms' buckets, and with faults off at
+    # nominal load nothing sheds/expires/fails
+    for key in ("decode_tokens_per_sec", "decode_padded_tokens_per_sec",
+                "decode_padded_parity", "decode_engine_p50_ms",
+                "decode_engine_p99_ms", "decode_step_p50_ms",
+                "decode_step_p99_ms", "decode_ttft_p50_ms",
+                "decode_requests", "decode_tokens", "decode_prefills",
+                "decode_steps", "decode_shed", "decode_deadline_expired",
+                "decode_failed", "decode_batch_fill_pct",
+                "decode_page_util_peak_pct", "kv_page_evictions",
+                "decode_ok"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["decode_tokens_per_sec"] > 0, last
+    # the acceptance gate: ragged paged decode beats padded recompute
+    assert last["decode_tokens_per_sec"] > \
+        last["decode_padded_tokens_per_sec"] > 0, last
+    assert last["decode_padded_parity"] is True, last
+    assert last["decode_engine_p99_ms"] >= last["decode_engine_p50_ms"] \
+        > 0, last
+    assert last["decode_step_p99_ms"] >= last["decode_step_p50_ms"] > 0, \
+        last
+    assert last["decode_ok"] == last["decode_requests"] > 0, last
+    assert last["decode_tokens"] > 0 and last["decode_steps"] > 0, last
+    assert last["decode_shed"] == 0, last
+    assert last["decode_deadline_expired"] == 0, last
+    assert last["decode_failed"] == 0, last
+    assert 0 < last["decode_batch_fill_pct"] <= 100.0, last
+    assert 0 < last["decode_page_util_peak_pct"] <= 100.0, last
     # MULTICHIP probe contract: the DP×TP static-executor step (forced
     # 8-device CPU topology in a subprocess) matches the single-chip
     # loss within the established gm tolerance, the row-parallel hint

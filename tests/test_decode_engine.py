@@ -1,0 +1,462 @@
+"""LLM decode engine (inference/decode): paged KV pool accounting,
+output parity against the dense greedy oracle (mixed lengths,
+continuous arrival, preemption under pool pressure, TP sharding,
+escape legs), PR 6 admission semantics, drain, and the decode metric
+family."""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.decode import (DecodeEngine, DecodeModelConfig,
+                                         DecodeScheduler, PageTableManager,
+                                         init_decode_params,
+                                         reference_generate)
+from paddle_tpu.inference.serving import (DeadlineExceeded, EngineStopped,
+                                          Overloaded)
+
+CFG = DecodeModelConfig(vocab_size=32, n_layers=2, n_heads=2, head_dim=8,
+                        ffn_dim=32, max_context=64)
+
+
+def _drive(eng, max_ticks=500):
+    for _ in range(max_ticks):
+        if not eng.sched.pending():
+            return
+        eng.run_once()
+    raise AssertionError("engine did not drain the workload")
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32, page_size=8,
+                       max_pages_per_seq=8)
+    eng.warm()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def ref_params():
+    return init_decode_params(CFG, 3)
+
+
+# ---------------------------------------------------------------------------
+# paged KV pool manager
+# ---------------------------------------------------------------------------
+def test_pool_alloc_free_accounting():
+    pool = PageTableManager(n_pages=8, page_size=4, max_pages_per_seq=4)
+    assert pool.capacity == 7 and pool.pages_in_use == 0
+    pages = pool.alloc_seq(1, 9)            # ceil(9/4) = 3 pages
+    assert len(pages) == 3 and 0 not in pages
+    assert pool.pages_in_use == 3
+    # grow within the tail page: no new allocation
+    assert pool.append_token(1, 10) is None
+    assert pool.append_token(1, 13) not in (None, -1)  # 4th page
+    assert pool.pages_in_use == 4
+    # table row: pages then -1 padding
+    row = pool.table_row(1)
+    assert list(row[:4]) == pool.seq_pages(1) and row[-1] == -1 \
+        if len(row) > 4 else True
+    # per-seq budget exhausted
+    assert pool.append_token(1, 17) == -1
+    assert pool.free_seq(1) == 4 and pool.pages_in_use == 0
+    assert pool.peak_pages_in_use == 4
+
+
+def test_pool_eviction_counts():
+    pool = PageTableManager(n_pages=6, page_size=4, max_pages_per_seq=4)
+    pool.alloc_seq(1, 8)
+    pool.alloc_seq(2, 8)
+    assert pool.alloc_seq(3, 8) is None     # 5 allocatable, 4 used
+    assert pool.evict_seq(2) == 2
+    assert pool.evicted_pages == 2
+    assert pool.alloc_seq(3, 8) is not None
+    assert pool.pages_in_use == 4
+
+
+def test_pool_reserves_trash_page():
+    pool = PageTableManager(n_pages=4, page_size=2, max_pages_per_seq=3)
+    pages = pool.alloc_seq(1, 6)
+    assert pages is not None and 0 not in pages
+    with pytest.raises(ValueError):
+        PageTableManager(n_pages=1, page_size=2, max_pages_per_seq=1)
+
+
+# ---------------------------------------------------------------------------
+# output parity: the core correctness gate
+# ---------------------------------------------------------------------------
+def test_mixed_length_batch_matches_dense_oracle(engine, ref_params):
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8, 9, 10], [11, 12]]
+    handles = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    _drive(engine)
+    outs = [h.result(timeout=5) for h in handles]
+    refs = [reference_generate(CFG, ref_params, p, 6) for p in prompts]
+    assert outs == refs
+
+
+def test_continuous_arrival_joins_running_batch(engine, ref_params):
+    """A request submitted mid-generation joins the live decode batch
+    (continuous batching) and both streams stay correct."""
+    h1 = engine.submit([7, 3, 1, 2], max_new_tokens=10)
+    for _ in range(4):
+        engine.run_once()
+    assert not h1.done()
+    h2 = engine.submit([9, 8], max_new_tokens=5)
+    _drive(engine)
+    assert h1.result(timeout=5) == reference_generate(
+        CFG, ref_params, [7, 3, 1, 2], 10)
+    assert h2.result(timeout=5) == reference_generate(
+        CFG, ref_params, [9, 8], 5)
+
+
+def test_preemption_under_pool_pressure_preserves_outputs():
+    """A pool too small for both sequences forces eviction; the
+    preempted request re-prefills and still emits the oracle tokens."""
+    cfg = DecodeModelConfig(vocab_size=32, n_layers=1, n_heads=2,
+                            head_dim=8, ffn_dim=16, max_context=24)
+    eng = DecodeEngine(cfg, seed=7, max_batch=2, n_pages=8, page_size=4,
+                       max_pages_per_seq=6)
+    eng.warm()
+    prompts = [[1, 2, 3, 4, 5], [6, 7, 8, 9, 10, 11]]
+    hs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    _drive(eng)
+    params = init_decode_params(cfg, 7)
+    assert [h.result(timeout=5) for h in hs] == \
+        [reference_generate(cfg, params, p, 10) for p in prompts]
+    c = eng.counters
+    assert c["decode_preempted"] >= 1
+    assert c["kv_page_evictions"] >= 1
+    assert eng.pool.pages_in_use == 0       # everything released
+    preempted = [h for h in hs if h.stats().get("preempted")]
+    assert preempted, "no handle recorded its preemption"
+
+
+def test_eos_stops_generation(engine, ref_params):
+    ref = reference_generate(CFG, ref_params, [1, 2, 3], 6)
+    eos = ref[2]
+    ref_eos = reference_generate(CFG, ref_params, [1, 2, 3], 6,
+                                 eos_id=eos)
+    eng = DecodeEngine(CFG, seed=3, max_batch=2, n_pages=32, page_size=8,
+                       max_pages_per_seq=8, eos_id=eos)
+    eng.warm()
+    h = eng.submit([1, 2, 3], max_new_tokens=6)
+    _drive(eng)
+    out = h.result(timeout=5)
+    assert out == ref_eos and out[-1] == eos
+    assert len(out) < 6          # the stop token really cut it short
+
+
+def test_escape_leg_pinned_xla_is_bitwise(engine, ref_params,
+                                          monkeypatch):
+    """PADDLE_PAGED_ATTENTION=0 (forced XLA gather) produces the same
+    token stream — the escape leg stays bitwise on the ints that
+    matter."""
+    hb = engine.submit([3, 1, 4, 1, 5], max_new_tokens=8)
+    _drive(engine)
+    base = hb.result(timeout=5)
+    monkeypatch.setenv("PADDLE_PAGED_ATTENTION", "0")
+    eng = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32, page_size=8,
+                       max_pages_per_seq=8)
+    eng.warm()
+    h = eng.submit([3, 1, 4, 1, 5], max_new_tokens=8)
+    _drive(eng)
+    assert h.result(timeout=5) == base == reference_generate(
+        CFG, ref_params, [3, 1, 4, 1, 5], 8)
+
+
+def test_tp_sharded_engine_matches_unsharded():
+    """PR 10 composition: a TP=2 engine (megatron shardings over the
+    conftest's virtual CPU mesh) serves the same tokens as the
+    unsharded engine."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU topology")
+    cfg = DecodeModelConfig(vocab_size=32, n_layers=2, n_heads=4,
+                            head_dim=8, ffn_dim=32, max_context=64)
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8]]
+
+    def run(mesh_shape):
+        eng = DecodeEngine(cfg, seed=5, max_batch=2, n_pages=32,
+                           page_size=8, max_pages_per_seq=8,
+                           mesh_shape=mesh_shape)
+        eng.warm()
+        hs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        _drive(eng)
+        return [h.result(timeout=5) for h in hs]
+
+    single = run(None)
+    assert run({"tp": 2}) == single
+    params = init_decode_params(cfg, 5)
+    assert single == [reference_generate(cfg, params, p, 8)
+                      for p in prompts]
+
+
+# ---------------------------------------------------------------------------
+# admission semantics (PR 6 machinery, typed)
+# ---------------------------------------------------------------------------
+def _sched(clock=None, **kw):
+    pool = PageTableManager(n_pages=16, page_size=4, max_pages_per_seq=8)
+    kw.setdefault("max_batch", 2)
+    return DecodeScheduler(pool, clock=clock or (lambda: 0.0), **kw)
+
+
+def test_admission_queue_bound_sheds_typed():
+    s = _sched(max_queue=2)
+    s.submit([1], 4)
+    s.submit([1], 4)
+    with pytest.raises(Overloaded):
+        s.submit([1], 4)
+    assert s.queue_depth == 2
+
+
+def test_admission_rate_limit_sheds_typed():
+    t = [0.0]
+    s = _sched(clock=lambda: t[0], rate_limit=1.0, burst=1)
+    s.submit([1], 4)
+    with pytest.raises(Overloaded):
+        s.submit([1], 4)
+    t[0] += 2.0                  # bucket refills
+    s.submit([1], 4)
+    with pytest.raises(ValueError):
+        _sched(rate_limit=0.0)
+    with pytest.raises(ValueError):
+        _sched(rate_limit=1.0, burst=0)
+
+
+def test_admission_unmakeable_deadline_typed():
+    s = _sched(min_service_s=0.5)
+    with pytest.raises(DeadlineExceeded):
+        s.submit([1], 4, deadline_s=0.1)
+
+
+def test_admission_oversized_request_refused():
+    s = _sched()
+    with pytest.raises(ValueError):
+        s.submit([1] * 30, 10)   # 40 > 8 pages x 4 tokens
+    with pytest.raises(ValueError):
+        s.submit([], 4)
+
+
+def test_queued_deadline_expires_typed():
+    t = [0.0]
+    s = _sched(clock=lambda: t[0])
+    h = s.submit([1], 4, deadline_s=1.0)
+    t[0] = 2.0
+    expired = s.expire_queued(t[0])
+    assert len(expired) == 1 and isinstance(h.error(), DeadlineExceeded)
+    with pytest.raises(DeadlineExceeded):
+        h.result(timeout=0)
+
+
+def test_stopped_engine_refuses_typed():
+    s = _sched()
+    s.accepting = False
+    with pytest.raises(EngineStopped):
+        s.submit([1], 4)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: threaded scheduler + drain
+# ---------------------------------------------------------------------------
+def test_threaded_start_generate_drain(ref_params):
+    eng = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32, page_size=8,
+                       max_pages_per_seq=8)
+    eng.warm()
+    assert not eng.ready
+    eng.start()
+    assert eng.ready
+    out = eng.generate([2, 4, 6], max_new_tokens=5, timeout=30)
+    assert out == reference_generate(CFG, ref_params, [2, 4, 6], 5)
+    h = eng.submit([5, 5], max_new_tokens=4)
+    assert eng.drain(timeout=30)
+    assert h.result(timeout=5) == reference_generate(
+        CFG, ref_params, [5, 5], 4)
+    with pytest.raises(EngineStopped):
+        eng.submit([1], 2)
+    assert not eng.ready
+
+
+def test_decode_step_failure_fails_typed_and_recovers(ref_params):
+    """A runtime decode-step failure must fail every live request
+    TYPED (never a silent hang in the scheduler loop) and rebuild the
+    donated pool so later requests keep serving correctly."""
+    from paddle_tpu.inference.serving import RequestFailed
+
+    eng = DecodeEngine(CFG, seed=3, max_batch=2, n_pages=32, page_size=8,
+                       max_pages_per_seq=8)
+    eng.warm()
+    h1 = eng.submit([1, 2, 3], max_new_tokens=6)
+    eng.run_once()               # prefill lands h1 in a slot
+
+    def boom(*a, **k):
+        raise RuntimeError("device fell over")
+
+    real_step = eng._decode_step
+    eng._decode_step = boom
+    assert eng.run_once() >= 1   # the failure resolved work, not a hang
+    with pytest.raises(RequestFailed):
+        h1.result(timeout=0)
+    assert eng.counters["decode_failed"] >= 1
+    assert eng.pool.pages_in_use == 0
+    # pool was rebuilt: a fresh request serves the oracle tokens
+    eng._decode_step = real_step
+    h2 = eng.submit([4, 5, 6], max_new_tokens=5)
+    _drive(eng)
+    assert h2.result(timeout=5) == reference_generate(
+        CFG, ref_params, [4, 5, 6], 5)
+
+
+def test_prefill_failure_fails_typed_and_recovers(ref_params):
+    from paddle_tpu.inference.serving import RequestFailed
+
+    eng = DecodeEngine(CFG, seed=3, max_batch=2, n_pages=32, page_size=8,
+                       max_pages_per_seq=8)
+    eng.warm()
+
+    def boom(*a, **k):
+        raise RuntimeError("prefill fell over")
+
+    real = dict(eng._prefill_steps)
+    eng._prefill_steps = {n: boom for n in real}
+    h1 = eng.submit([1, 2, 3], max_new_tokens=4)
+    eng.run_once()
+    with pytest.raises(RequestFailed):
+        h1.result(timeout=0)
+    assert eng.pool.pages_in_use == 0    # failed seq's pages released
+    eng._prefill_steps = real
+    h2 = eng.submit([1, 2, 3], max_new_tokens=4)
+    _drive(eng)
+    assert h2.result(timeout=5) == reference_generate(
+        CFG, ref_params, [1, 2, 3], 4)
+
+
+def test_sigterm_drain_duck_types():
+    """serving.install_sigterm_drain drives any engine with a
+    drain(timeout) — the decode engine reuses it verbatim."""
+    import signal
+
+    from paddle_tpu.inference.serving import install_sigterm_drain
+
+    eng = DecodeEngine(CFG, seed=3, max_batch=2, n_pages=16, page_size=8,
+                       max_pages_per_seq=4)
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        install_sigterm_drain(eng, exit_code=None)
+        assert signal.getsignal(signal.SIGTERM) is not prev
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# observability: counters, histograms, cost gauges, /metrics
+# ---------------------------------------------------------------------------
+def test_counters_and_latency_stats(engine, ref_params):
+    h = engine.submit([8, 6, 4], max_new_tokens=5)
+    _drive(engine)
+    h.result(timeout=5)
+    c = engine.counters
+    for key in ("decode_requests", "decode_tokens", "decode_steps",
+                "decode_prefills", "kv_pages_in_use",
+                "kv_page_evictions", "decode_batch_fill_pct"):
+        assert key in c, c
+    # substrate build accounting rode the engine's counter sink
+    assert c["trace_ms"] > 0 and c["compile_ms"] > 0
+    # cost gauges stay truthful on decode: live pages, not the pool
+    assert c["step_model_flops"] > 0
+    assert c["step_hbm_bytes"] > 0
+    ls = engine.engine_latency_stats()
+    assert ls["n"] > 0
+    assert ls["e2e_p99_ms"] >= ls["e2e_p50_ms"] > 0
+    assert ls["step_p99_ms"] >= ls["step_p50_ms"] > 0
+    st = h.stats()
+    assert st["ttft_ms"] > 0 and len(st["token_times"]) == 5
+
+
+def test_decode_metric_family_scrapes():
+    from paddle_tpu import profiler
+
+    assert set(profiler.DECODE_COUNTER_NAMES) >= {
+        "decode_requests", "decode_tokens", "kv_pages_in_use",
+        "kv_page_evictions", "decode_batch_fill_pct"}
+    text = profiler.render_prometheus()
+    for name in ("kv_pages_in_use", "kv_page_evictions",
+                 "decode_batch_fill_pct", "decode_e2e_ms",
+                 "decode_step_ms", "decode_prefill_ms"):
+        assert name in text, f"/metrics missing {name}"
+
+
+def test_paged_decode_cost_counts_live_pages_not_pool():
+    from paddle_tpu.static.cost_model import paged_decode_cost
+
+    c = paged_decode_cost(CFG, [9, 17], page_size=8, itemsize=4)
+    E = CFG.hidden
+    # live page tokens: ceil(9/8)*8 + ceil(17/8)*8 = 16 + 24
+    assert c["live_page_tokens"] == 40
+    kv_bytes = 2 * CFG.n_layers * 40 * E * 4
+    assert c["hbm_bytes"] >= kv_bytes
+    assert c["model_flops"] > 0 and c["arith_intensity"] > 0
+    # longer context -> more flops AND more page bytes
+    c2 = paged_decode_cost(CFG, [57, 57], page_size=8, itemsize=4)
+    assert c2["model_flops"] > c["model_flops"]
+    assert c2["live_page_tokens"] == 128
+
+
+def test_program_cost_paged_attention_op_rule():
+    """The IR rule: a paged_attention op's hbm_bytes charge the
+    GATHERED live pages (table entries x page bytes), never the whole
+    pool operand."""
+    from paddle_tpu.static.cost_model import program_cost
+    from paddle_tpu.static.ir import Program
+
+    prog = Program()
+    b = prog.global_block
+    b.create_var("q", shape=[4, 8, 64], dtype="float32")
+    b.create_var("kp", shape=[1000, 128, 8, 64], dtype="float32")
+    b.create_var("vp", shape=[1000, 128, 8, 64], dtype="float32")
+    b.create_var("pt", shape=[4, 4], dtype="int32")
+    b.create_var("lens", shape=[4], dtype="int32")
+    b.create_var("out", shape=[4, 8, 64], dtype="float32")
+    b.append_op("paged_attention",
+                inputs={"Q": ["q"], "KPages": ["kp"], "VPages": ["vp"],
+                        "PageTable": ["pt"], "SeqLens": ["lens"]},
+                outputs={"Out": ["out"]})
+    report = program_cost(prog)
+    (op,) = report.ops
+    live_tokens = 4 * 4 * 128
+    live_kv_bytes = 2 * live_tokens * 8 * 64 * 4
+    pool_bytes = 2 * 1000 * 128 * 8 * 64 * 4
+    assert op.hbm_bytes >= live_kv_bytes
+    assert op.hbm_bytes < pool_bytes // 10, \
+        "pool bytes leaked into the paged-attention charge"
+    assert op.flops == 4 * 8 * 64 * live_tokens
+
+
+# ---------------------------------------------------------------------------
+# decode load generator (tools/load_gen.py satellite)
+# ---------------------------------------------------------------------------
+def test_decode_load_gen_deterministic_summary():
+    from tools.load_gen import DecodeLoadGen
+
+    def run():
+        eng = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32,
+                           page_size=8, max_pages_per_seq=8)
+        eng.warm()
+        eng.start()
+        try:
+            gen = DecodeLoadGen(eng, total_requests=6, workers=2,
+                                prompt_lens=(3, 7, 12),
+                                output_lens=(4, 6), keep_outputs=True)
+            return gen.run(), dict(gen.outputs)
+        finally:
+            eng.drain(timeout=30)
+
+    s1, o1 = run()
+    s2, o2 = run()
+    assert o1 == o2, "decode workload content is not deterministic"
+    assert s1["ok"] == 6 and s1["shed"] == 0 and s1["failed"] == 0
+    assert s1["decode_tokens"] == s2["decode_tokens"] == 6 * 5  # (4+6)/2
+    assert s1["decode_tokens_per_sec"] > 0
+    for key in ("ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms", "itl_p99_ms",
+                "engine_p50_ms", "engine_p99_ms", "step_p50_ms"):
+        assert key in s1, s1
+    assert s1["ttft_p99_ms"] >= s1["ttft_p50_ms"] > 0
+    assert s1["itl_p50_ms"] >= 0

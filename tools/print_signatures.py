@@ -24,6 +24,7 @@ MODULES = [
     "paddle_tpu.fault",
     "paddle_tpu.hapi",
     "paddle_tpu.inference",
+    "paddle_tpu.inference.decode",
     "paddle_tpu.io",
     "paddle_tpu.jit",
     "paddle_tpu.metric",
@@ -44,6 +45,7 @@ MODULES = [
     "paddle_tpu.regularizer",
     "paddle_tpu.static",
     "paddle_tpu.static.cost_model",
+    "paddle_tpu.static.substrate",
     "paddle_tpu.text",
     "paddle_tpu.utils",
     "paddle_tpu.vision",
