@@ -555,6 +555,7 @@ def _decode_probe(requests=12, workers=4):
 
     Fixed small shapes: like the other probes this measures the
     serving machinery, not model quality."""
+    import tempfile as _tempfile
     import time as _time
 
     import jax
@@ -562,6 +563,8 @@ def _decode_probe(requests=12, workers=4):
 
     from paddle_tpu.inference.decode import DecodeEngine, DecodeModelConfig
     from paddle_tpu.inference.decode.model import dense_forward
+    from paddle_tpu.observability.step_trace import (enable_step_trace,
+                                                     reset_step_trace)
     from tools.load_gen import DecodeLoadGen
 
     page_size, max_pages = 16, 8
@@ -576,6 +579,12 @@ def _decode_probe(requests=12, workers=4):
                           max_pages_per_seq=max_pages)
     engine.warm()
     engine.start()
+    # the probe runs TRACED: request span trees land in a private JSONL
+    # so the row can report spans-per-request and the slowest trace id
+    # (the `trace_view --trace <id>` handle) next to the percentiles
+    trace_path = os.path.join(
+        _tempfile.mkdtemp(prefix="decode_probe_trace_"), "trace.jsonl")
+    enable_step_trace(trace_path)
     try:
         gen = DecodeLoadGen(engine, total_requests=requests,
                             workers=workers, prompt_lens=prompt_lens,
@@ -583,7 +592,27 @@ def _decode_probe(requests=12, workers=4):
         summary = gen.run()
     finally:
         engine.drain(timeout=60)
+        # drop the probe's sink and re-arm PADDLE_STEP_TRACE detection
+        reset_step_trace()
     ec = engine.counters
+    request_span_names = {"loadgen.decode", "decode.request",
+                          "decode.queue", "decode.prefill"}
+    request_spans = 0
+    with open(trace_path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "span" and \
+                    rec.get("name") in request_span_names:
+                request_spans += 1
+    import shutil as _shutil
+
+    # the probe's private trace dir is consumed above — don't leak one
+    # temp dir per bench/CI invocation
+    _shutil.rmtree(os.path.dirname(trace_path), ignore_errors=True)
+    slowest = summary.get("slowest_traces") or []
 
     # padded-bucket baseline: identical workload, identical greedy
     # outputs, but every token recomputes the full lmax-padded forward
@@ -661,6 +690,16 @@ def _decode_probe(requests=12, workers=4):
             / max(1, engine.pool.capacity), 2),
         "kv_page_evictions": int(engine.pool.evicted_pages),
         "decode_ok": int(summary["ok"]),
+        # distributed-tracing contract: every request leaves a span
+        # tree (client root + decode.request + queue + prefill >= 4
+        # per request when nothing sheds), and the worst tail request
+        # is one `trace_view --trace <id>` away
+        "trace_spans_per_request": round(
+            request_spans / max(1, requests), 2),
+        "decode_slowest_trace":
+            str(slowest[0]["trace_id"]) if slowest else "",
+        "decode_slowest_trace_ms":
+            float(slowest[0]["ms"]) if slowest else 0.0,
     }
 
 

@@ -24,6 +24,9 @@ from ..fault.injector import _bump as _bump_counter  # noqa: E402
 # stdlib-only registry: /metrics exposition + the kv round-trip
 # histogram ride it without pulling jax into this module
 from ..observability import metrics as _obs_metrics  # noqa: E402
+# stdlib-only tracing: requests carry X-Paddle-Trace/X-Paddle-Span so
+# a rendezvous/shard-map poll inside a traced region links server-side
+from ..observability import tracing as _tracing  # noqa: E402
 
 _KV_HIST = None
 
@@ -66,6 +69,23 @@ class KVHandler(BaseHTTPRequestHandler):
         self.timeout = getattr(self.server, "request_timeout", None)
         super().setup()
 
+    def _traced(self, name: str, inner):
+        """Run ``inner()`` inside a server-side span parented to the
+        caller's header context (straight call when untraced) — the
+        http_kv leg of distributed tracing."""
+        ctx = _tracing.SpanContext.from_headers(self.headers)
+        if ctx is None:
+            return inner()
+        sp = _tracing.Span(name, parent=ctx, path=self.path)
+        try:
+            with sp.activate():
+                return inner()
+        except BaseException as e:
+            sp.fail(e)
+            raise
+        finally:
+            sp.end()
+
     def log_error(self, format, *args):  # noqa: A002 (reference name)
         # handle_one_request swallows socket timeouts after routing them
         # here — the one hook where a stalled connection is observable;
@@ -77,6 +97,9 @@ class KVHandler(BaseHTTPRequestHandler):
         BaseHTTPRequestHandler.log_error(self, format, *args)
 
     def do_GET(self):
+        return self._traced("http_kv.GET", self._get_inner)
+
+    def _get_inner(self):
         if self.path == "/metrics":
             # Prometheus text exposition of the process-global registry:
             # every KV listener in the fleet (elastic/PS coordination
@@ -101,6 +124,9 @@ class KVHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_PUT(self):
+        return self._traced("http_kv.PUT", self._put_inner)
+
+    def _put_inner(self):
         raw_len = self.headers.get("Content-Length")
         try:
             n = int(raw_len)
@@ -145,6 +171,9 @@ class KVHandler(BaseHTTPRequestHandler):
         self.send_status_code(200)
 
     def do_DELETE(self):
+        return self._traced("http_kv.DELETE", self._delete_inner)
+
+    def _delete_inner(self):
         key = self.path.strip("/")
         with self.server.kv_lock:
             self.server.kv.pop(key, None)
@@ -258,9 +287,14 @@ class KVClient:
         _fault.point("http_kv.request")
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
+        # stamp the ambient trace context onto the request so the
+        # server's handler links its span into the caller's tree
+        ctx = _tracing.current_context()
+        headers = ctx.to_headers() if ctx is not None else {}
         t0 = time.perf_counter()
         try:
-            conn.request(method, "/" + key.strip("/"), body=body)
+            conn.request(method, "/" + key.strip("/"), body=body,
+                         headers=headers)
             resp = conn.getresponse()
             return resp.status, resp.read()
         finally:

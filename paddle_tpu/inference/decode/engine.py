@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...observability import tracing
 from ..serving import DeadlineExceeded, RequestFailed, _DualHist
 from .kv_cache import PageTableManager, alloc_kv_pool
 from .model import (DecodeModelConfig, decode_forward, init_decode_params,
@@ -322,6 +323,15 @@ class DecodeEngine:
             h.meta["ttft_ms"] = round(
                 (req.token_times[0] - req.t_submit) * 1e3, 3)
             h.meta["token_times"] = list(req.token_times)
+        if req.span is not None:
+            h.meta["trace_id"] = req.trace_hex()
+            req.span.set("tokens", len(req.generated))
+            if req.preempted:
+                req.span.set("preempted", req.preempted)
+            if error is not None:
+                req.span.fail(error)
+            else:
+                req.span.end()
         if error is not None:
             h._resolve(error=error)
             return
@@ -341,6 +351,12 @@ class DecodeEngine:
 
     def _prefill_one(self, req: DecodeRequest) -> int:
         now = self._clock()
+        if req.qspan is not None:
+            # the queue wait ends the moment the request is popped for
+            # prefill (deadline expiry right below types it instead)
+            req.qspan.end("DeadlineExceeded"
+                          if req.deadline is not None
+                          and now >= req.deadline else "ok")
         if req.deadline is not None and now >= req.deadline:
             self._count("decode_deadline_expired")
             self._finish(None, req, error=DeadlineExceeded(
@@ -361,6 +377,10 @@ class DecodeEngine:
         if pages is None:
             # raced out of pages (shouldn't happen single-threaded);
             # requeue at the front and try next tick
+            if req.span is not None:
+                req.qspan = tracing.Span("decode.queue",
+                                         parent=req.span,
+                                         clock=self._clock)
             with self.sched.lock:
                 self.sched.queue.appendleft(req)
             return 0
@@ -371,23 +391,30 @@ class DecodeEngine:
         Lb = npages * self.pool.page_size
         toks = np.zeros((1, Lb), np.int32)
         toks[0, :ctx] = np.asarray(ctx_tokens, np.int32)
+        pspan = tracing.Span("decode.prefill", parent=req.span,
+                             clock=self._clock, ctx_tokens=ctx,
+                             n_pages=npages)
         t0 = time.perf_counter()
         try:
-            nxt, self._k_pages, self._v_pages = step(
-                self.params, self._k_pages, self._v_pages, toks,
-                np.asarray([ctx], np.int32),
-                np.asarray(pages, np.int32))
+            with pspan.activate():
+                nxt, self._k_pages, self._v_pages = step(
+                    self.params, self._k_pages, self._v_pages, toks,
+                    np.asarray([ctx], np.int32),
+                    np.asarray(pages, np.int32))
             token = int(np.asarray(nxt)[0])
         except Exception as e:
             self.pool.free_seq(seq_id)
             self._count("decode_failed")
-            self._finish(None, req, error=RequestFailed(
-                f"prefill dispatch failed: {type(e).__name__}: {e}"))
+            err = RequestFailed(
+                f"prefill dispatch failed: {type(e).__name__}: {e}")
+            pspan.fail(err)
+            self._finish(None, req, error=err)
             # the prefill step donates the pool too: a runtime failure
             # may have invalidated it — rebuild before anything else
             # dispatches (running sequences preempt-requeue)
             self._reset_pool()
             return 1
+        pspan.end()
         self._h_prefill.observe((time.perf_counter() - t0) * 1e3)
         self._count("decode_prefills")
         self._emit(req, token)
@@ -446,13 +473,23 @@ class DecodeEngine:
             lens[slot_id] = rs.length
             table[slot_id] = self.pool.table_row(rs.seq_id)
             mask[slot_id] = True
+        # per-tick decode spans batch as ONE span per tick: a 4-slot
+        # step is one dispatch, so it is one span carrying the slot's
+        # request trace ids (the per-request tree reaches it by id)
+        tspan = tracing.Span(
+            "decode.tick", parent=False, clock=self._clock,
+            slots=sorted(active),
+            requests=[rs.req.trace_hex() for _, rs in sorted(
+                active.items()) if rs.req.span is not None])
         t0 = time.perf_counter()
         try:
-            nxt, self._k_pages, self._v_pages = self._decode_step(
-                self.params, self._k_pages, self._v_pages, tokens,
-                positions, table, lens, mask)
-            nxt = np.asarray(nxt)   # device sync: the step really ran
+            with tspan.activate():
+                nxt, self._k_pages, self._v_pages = self._decode_step(
+                    self.params, self._k_pages, self._v_pages, tokens,
+                    positions, table, lens, mask)
+                nxt = np.asarray(nxt)  # device sync: the step really ran
         except Exception as e:
+            tspan.fail(e)
             # no silent hang: every live request fails TYPED (the
             # serving engine's retry→fail posture; _loop's backstop
             # swallow must never be the only handler), and the
@@ -466,6 +503,7 @@ class DecodeEngine:
             self._reset_pool()
             return len(active)
         step_s = time.perf_counter() - t0
+        tspan.end()
         self._h_step.observe(step_s * 1e3)
         self._count("decode_steps")
         with self._stats_lock:

@@ -30,6 +30,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ...observability import tracing
 from ..serving import (DeadlineExceeded, EngineStopped,  # noqa: F401
                        Overloaded, RequestFailed, ServingError)
 from .kv_cache import PageTableManager
@@ -77,7 +78,8 @@ class _DecodeHandle:
 
 class DecodeRequest:
     __slots__ = ("prompt", "max_new_tokens", "deadline", "t_submit",
-                 "handle", "generated", "token_times", "preempted")
+                 "handle", "generated", "token_times", "preempted",
+                 "span", "qspan")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  deadline: Optional[float], t_submit: float):
@@ -89,6 +91,15 @@ class DecodeRequest:
         self.generated: List[int] = []    # survives preemption
         self.token_times: List[float] = []
         self.preempted = 0
+        # request-lifecycle trace: root span (admit -> respond; in the
+        # flight recorder's in-flight table) + the open child for the
+        # current queue wait (re-opened on preemption requeue)
+        self.span: Optional[tracing.Span] = None
+        self.qspan: Optional[tracing.Span] = None
+
+    def trace_hex(self) -> Optional[str]:
+        return format(self.span.trace_id, "016x") \
+            if self.span is not None else None
 
 
 class RunningSeq:
@@ -182,31 +193,48 @@ class DecodeScheduler:
                 f"page_size); shorten the request or grow the table")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        with self.lock:
-            now = self._clock()
-            if not self.accepting:
-                raise EngineStopped(
-                    "decode engine is draining/stopped; not admitting")
-            if deadline_s is not None and deadline_s <= self.min_service_s:
-                self._count("decode_deadline_expired")
-                raise DeadlineExceeded(
-                    f"deadline {deadline_s}s cannot be met (min service "
-                    f"estimate {self.min_service_s}s)")
-            if len(self.queue) >= self.max_queue:
-                self._count("decode_shed")
-                raise Overloaded(
-                    f"admission queue full ({self.max_queue})")
-            if not self._take_token(now):
-                self._count("decode_shed")
-                raise Overloaded(
-                    f"rate limit {self._rate} req/s exceeded "
-                    f"(burst {int(self._burst)})")
-            req = DecodeRequest(
-                prompt, max_new_tokens,
-                None if deadline_s is None else now + deadline_s, now)
-            self.queue.append(req)
-            self._count("decode_requests")
-            self.lock.notify_all()
+        # created on the caller's thread: an ambient client context
+        # (load_gen, an upstream service) parents the request tree
+        root = tracing.Span("decode.request", clock=self._clock,
+                            root=True, prompt_tokens=len(prompt),
+                            max_new_tokens=int(max_new_tokens))
+        try:
+            with self.lock:
+                now = self._clock()
+                if not self.accepting:
+                    raise EngineStopped(
+                        "decode engine is draining/stopped; "
+                        "not admitting")
+                if deadline_s is not None \
+                        and deadline_s <= self.min_service_s:
+                    self._count("decode_deadline_expired")
+                    raise DeadlineExceeded(
+                        f"deadline {deadline_s}s cannot be met "
+                        f"(min service estimate {self.min_service_s}s)")
+                if len(self.queue) >= self.max_queue:
+                    self._count("decode_shed")
+                    raise Overloaded(
+                        f"admission queue full ({self.max_queue})")
+                if not self._take_token(now):
+                    self._count("decode_shed")
+                    raise Overloaded(
+                        f"rate limit {self._rate} req/s exceeded "
+                        f"(burst {int(self._burst)})")
+                req = DecodeRequest(
+                    prompt, max_new_tokens,
+                    None if deadline_s is None else now + deadline_s,
+                    now)
+                req.span = root
+                req.qspan = tracing.Span("decode.queue", parent=root,
+                                         clock=self._clock)
+                self.queue.append(req)
+                self._count("decode_requests")
+                self.lock.notify_all()
+        except BaseException as e:
+            # typed sheds must not leak the root span into the
+            # in-flight table
+            root.fail(e)
+            raise
         return req.handle
 
     # -- queue maintenance ------------------------------------------------
@@ -221,9 +249,14 @@ class DecodeScheduler:
                                    if r not in expired)
         for r in expired:
             self._count("decode_deadline_expired")
-            r.handle._resolve(error=DeadlineExceeded(
+            err = DeadlineExceeded(
                 f"deadline passed while queued "
-                f"({now - r.t_submit:.3f}s since submit)"))
+                f"({now - r.t_submit:.3f}s since submit)")
+            if r.qspan is not None:
+                r.qspan.end(type(err).__name__)
+            if r.span is not None:
+                r.span.fail(err)
+            r.handle._resolve(error=err)
         return expired
 
     # -- slot management --------------------------------------------------
@@ -286,6 +319,15 @@ class DecodeScheduler:
             rs = self.slots.pop(slot)
             self.pool.evict_seq(rs.seq_id)
             rs.req.preempted += 1
+            if rs.req.span is not None:
+                # preemption is an EVENT on the request's root span
+                # (the request survives, its pages do not), and the
+                # re-queue wait gets a fresh queue child span
+                rs.req.span.event("preempted", slot=slot,
+                                  generated=len(rs.req.generated))
+                rs.req.qspan = tracing.Span(
+                    "decode.queue", parent=rs.req.span,
+                    clock=self._clock, requeued_after_preemption=True)
             self.queue.appendleft(rs.req)
             self._count("decode_preempted")
             return rs.req

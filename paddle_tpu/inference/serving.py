@@ -90,6 +90,7 @@ class RequestFailed(ServingError):
 
 
 from ..fault.injector import _bump  # noqa: E402 (shared lazy counter shim)
+from ..observability import tracing  # noqa: E402 (stdlib-only)
 from ..observability.flight_recorder import note_typed_error  # noqa: E402
 from ..observability.metrics import MetricsRegistry  # noqa: E402
 from ..observability.metrics import default_registry as _registry  # noqa: E402
@@ -326,7 +327,7 @@ class _PendingResult:
 
 class _Request:
     __slots__ = ("feed", "rows", "sig", "deadline", "t_submit", "handle",
-                 "degraded")
+                 "degraded", "span", "qspan")
 
     def __init__(self, feed, rows, sig, deadline, t_submit):
         self.feed = feed
@@ -336,6 +337,11 @@ class _Request:
         self.t_submit = t_submit
         self.handle = _PendingResult()
         self.degraded = False
+        # request-lifecycle trace: root span (admit -> respond, in the
+        # flight recorder's in-flight table) + its open child for the
+        # current wait (queue). The engine ends them typed.
+        self.span: Optional[tracing.Span] = None
+        self.qspan: Optional[tracing.Span] = None
 
 
 # ---------------------------------------------------------------------------
@@ -539,39 +545,57 @@ class ServingEngine:
                 f"bucket {self.predictor.max_batch}; split the request")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        with self._cond:
-            # clock read under the lock: concurrent submitters reading
-            # timestamps outside it can apply them out of order in
-            # _take_token, shrinking the bucket and rewinding _t_refill
-            now = self._clock()
-            if not self._accepting:
-                raise EngineStopped(
-                    "serving engine is draining/stopped; not admitting")
-            _fault.point("serve.admit")
-            if deadline_s is not None and \
-                    deadline_s <= self.min_service_s:
-                self._count("serve_deadline_expired")
-                raise DeadlineExceeded(
-                    f"deadline {deadline_s}s cannot be met (min service "
-                    f"estimate {self.min_service_s}s)")
-            # queue-depth first: it is side-effect-free, so a queue-full
-            # shed never burns a rate token (double-punishing bursts)
-            if len(self._queue) >= self.max_queue:
-                self._count("serve_shed")
-                raise Overloaded(
-                    f"admission queue full ({self.max_queue})")
-            if not self._take_token(now):
-                self._count("serve_shed")
-                raise Overloaded(
-                    f"rate limit {self._rate} req/s exceeded "
-                    f"(burst {int(self._burst)})")
-            req = _Request(
-                feed, rows, self._feed_sig(feed),
-                None if deadline_s is None else now + deadline_s, now)
-            self._queue.append(req)
-            self._count("serve_requests")
-            self._gauge("serve_queue_depth", len(self._queue))
-            self._cond.notify_all()
+        # request-root span: created on the CALLER's thread so an
+        # ambient client context (load_gen, an upstream service) parents
+        # it; a typed admission failure ends it with that error's name
+        root = tracing.Span("serve.request", clock=self._clock,
+                            root=True, rows=rows)
+        try:
+            with self._cond:
+                # clock read under the lock: concurrent submitters
+                # reading timestamps outside it can apply them out of
+                # order in _take_token, shrinking the bucket and
+                # rewinding _t_refill
+                now = self._clock()
+                if not self._accepting:
+                    raise EngineStopped(
+                        "serving engine is draining/stopped; "
+                        "not admitting")
+                _fault.point("serve.admit")
+                if deadline_s is not None and \
+                        deadline_s <= self.min_service_s:
+                    self._count("serve_deadline_expired")
+                    raise DeadlineExceeded(
+                        f"deadline {deadline_s}s cannot be met "
+                        f"(min service estimate {self.min_service_s}s)")
+                # queue-depth first: it is side-effect-free, so a
+                # queue-full shed never burns a rate token
+                # (double-punishing bursts)
+                if len(self._queue) >= self.max_queue:
+                    self._count("serve_shed")
+                    raise Overloaded(
+                        f"admission queue full ({self.max_queue})")
+                if not self._take_token(now):
+                    self._count("serve_shed")
+                    raise Overloaded(
+                        f"rate limit {self._rate} req/s exceeded "
+                        f"(burst {int(self._burst)})")
+                req = _Request(
+                    feed, rows, self._feed_sig(feed),
+                    None if deadline_s is None else now + deadline_s,
+                    now)
+                req.span = root
+                req.qspan = tracing.Span("serve.queue", parent=root,
+                                         clock=self._clock)
+                self._queue.append(req)
+                self._count("serve_requests")
+                self._gauge("serve_queue_depth", len(self._queue))
+                self._cond.notify_all()
+        except BaseException as e:
+            # typed sheds AND armed admission faults: the root span must
+            # not leak into the in-flight table
+            root.fail(e)
+            raise
         return req.handle
 
     def infer(self, feed: Dict[str, Any],
@@ -584,9 +608,27 @@ class ServingEngine:
     def _expire(self, reqs: List[_Request], now: float) -> None:
         for r in reqs:
             self._count("serve_deadline_expired")
-            r.handle._resolve(error=DeadlineExceeded(
+            err = DeadlineExceeded(
                 f"deadline passed before completion "
-                f"({now - r.t_submit:.3f}s since submit)"))
+                f"({now - r.t_submit:.3f}s since submit)")
+            self._end_trace(r, err)
+            r.handle._resolve(error=err)
+
+    @staticmethod
+    def _end_trace(r: _Request,
+                   error: Optional[BaseException] = None) -> None:
+        """Close a request's open spans with the typed status (first
+        end wins, like the handle resolve)."""
+        if r.qspan is not None:
+            r.qspan.end("ok" if error is None
+                        else type(error).__name__)
+        if r.span is not None:
+            if r.degraded:
+                r.span.set("degraded", True)
+            if error is None:
+                r.span.end()
+            else:
+                r.span.fail(error)
 
     def _assemble(self) -> List[_Request]:
         """Pop one batch: drop expired requests, then pack the oldest
@@ -622,6 +664,8 @@ class ServingEngine:
                 # queue wait ends when the request makes it into a batch
                 self._h_queue_wait.observe(max(0.0, now - r.t_submit)
                                            * 1e3)
+                if r.qspan is not None:
+                    r.qspan.end()
         return batch
 
     def run_once(self) -> int:
@@ -658,12 +702,15 @@ class ServingEngine:
                     continue   # failed in _dispatch (fallback exhausted)
                 if r.deadline is not None and now >= r.deadline:
                     self._count("serve_deadline_expired")
-                    r.handle._resolve(error=DeadlineExceeded(
-                        "completed after its deadline; result dropped"))
+                    err = DeadlineExceeded(
+                        "completed after its deadline; result dropped")
+                    self._end_trace(r, err)
+                    r.handle._resolve(error=err)
                     continue
                 try:
                     _fault.point("serve.respond")
                 except BaseException as e:
+                    self._end_trace(r, e)
                     r.handle._resolve(error=e)
                     continue
                 if r.degraded:
@@ -672,6 +719,7 @@ class ServingEngine:
                 with self._stats_lock:
                     self._lat_ms.append(e2e_ms)
                 self._h_e2e.observe(e2e_ms)
+                self._end_trace(r)
                 r.handle._resolve(value=sl)
         except BaseException as e:
             # no unexpected error may leave a handle unresolved (the
@@ -684,6 +732,7 @@ class ServingEngine:
                     err = RequestFailed(
                         f"internal serving error: "
                         f"{type(e).__name__}: {e}")
+                    self._end_trace(r, err)
                     if not noted:
                         # once per failed BATCH: a 32-request batch
                         # must not write 32 identical postmortems on
@@ -715,19 +764,32 @@ class ServingEngine:
                     round(100.0 * self._fill_rows
                           / max(1, self._fill_capacity), 2))
 
+        # one batch-level span: no single parent (requests fan in), so
+        # the member request traces ride as an attribute; activated so
+        # any RPC inside the predictor links under it
+        dspan = tracing.Span(
+            "serve.dispatch", parent=False, clock=self._clock,
+            rows=rows, bucket=bucket, n_requests=len(batch),
+            requests=[format(r.span.trace_id, "016x")
+                      for r in batch if r.span is not None])
+
         def _compiled():
             _fault.point("serve.dispatch")
-            return self.predictor.run_batch(feed)
+            with dspan.activate():
+                return self.predictor.run_batch(feed)
 
         t0 = time.perf_counter()
         try:
             out = self._retrier.call(_compiled)
             self._h_dispatch.observe((time.perf_counter() - t0) * 1e3)
             self._count("serve_batches")
+            dspan.end()
             return out
-        except ServingError:
+        except ServingError as e:
+            dspan.fail(e)
             raise
         except BaseException as dispatch_err:
+            dspan.fail(dispatch_err)
             # degrade: batch-1 eager per request; a request whose
             # fallback also fails is failed typed, the others survive
             per_req: List[Optional[List[np.ndarray]]] = []
@@ -749,6 +811,7 @@ class ServingEngine:
                         # once per batch (see run_once's failure path)
                         note_typed_error(err, where="serve.fallback")
                         fb_noted = True
+                    self._end_trace(r, err)
                     r.handle._resolve(error=err)
                     per_req.append(None)
             # stitch survivors back into batch-row layout; failed

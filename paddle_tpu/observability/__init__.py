@@ -14,6 +14,15 @@ text exposition, structured step tracing, and a crash flight recorder.
 - :mod:`.server` — standalone ``/metrics`` endpoint for hosts without
   an HTTP surface (``PADDLE_METRICS_PORT``); every http_kv listener
   (KVServer, ServingHealthServer) serves ``/metrics`` natively.
+- :mod:`.tracing` — distributed request tracing: trace/span ids with
+  parent linkage and typed status, ``kind="span"`` JSONL records
+  (schema v3), trace context propagated over the PS v2 wire header and
+  http_kv requests (reader: ``tools/trace_view.py``).
+- :mod:`.slo` — objectives over cumulative histograms/counters with
+  multi-window burn-rate evaluation (CLI: ``tools/slo_check.py``).
+- :mod:`.federation` — scrape N member ``/metrics`` endpoints, merge
+  families under an ``instance`` label, re-serve the union; dead
+  members degrade to staleness gauges, never scrape failures.
 """
 from . import metrics  # noqa: F401  (stdlib-only, safe under profiler)
 from .metrics import (CONTENT_TYPE, Counter, Gauge,  # noqa: F401
@@ -27,6 +36,11 @@ from .step_trace import (SCHEMA_VERSION, StepTrace,  # noqa: F401
                          active_step_trace, disable_step_trace,
                          enable_step_trace, reset_step_trace)
 from . import device_peaks  # noqa: F401  (stdlib-only peak registry)
+from . import tracing  # noqa: F401  (stdlib-only distributed tracing)
+from .tracing import (Span, SpanContext, current_context,  # noqa: F401
+                      inflight_snapshot, span, use_context)
+from . import slo  # noqa: F401  (stdlib-only SLO burn-rate plane)
+from .slo import Objective, SLOEvaluator  # noqa: F401
 
 __all__ = [
     "CONTENT_TYPE", "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -38,15 +52,24 @@ __all__ = [
     "enable_step_trace", "disable_step_trace", "reset_step_trace",
     "MetricsServer", "start_metrics_server",
     "maybe_start_metrics_server", "stop_metrics_server",
+    "Span", "SpanContext", "current_context", "inflight_snapshot",
+    "span", "use_context",
+    "Objective", "SLOEvaluator",
+    "FederatedMetrics", "FederationServer",
 ]
 
 
 def __getattr__(name):
-    # server pulls in distributed.http_kv; keep it lazy so importing
-    # the package (e.g. from the profiler) stays dependency-light
+    # server/federation pull in distributed.http_kv; keep them lazy so
+    # importing the package (e.g. from the profiler) stays
+    # dependency-light
     if name in ("MetricsServer", "start_metrics_server",
                 "maybe_start_metrics_server", "stop_metrics_server"):
         from . import server
 
         return getattr(server, name)
+    if name in ("FederatedMetrics", "FederationServer"):
+        from . import federation
+
+        return getattr(federation, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

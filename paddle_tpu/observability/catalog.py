@@ -108,6 +108,11 @@ SCALARS = {
     "metrics_label_overflow": ("counter", "label sets folded into the overflow series by the cardinality cap"),
     "flightrec_dumps": ("counter", "flight-recorder postmortem dumps written"),
     "step_trace_records": ("counter", "structured step-trace JSONL records emitted"),
+    # distributed tracing + federation + SLO plane
+    "trace_spans": ("counter", "distributed-tracing spans emitted to the step-trace JSONL sink"),
+    "federation_scrapes": ("counter", "successful member /metrics scrapes by the federator"),
+    "federation_scrape_failures": ("counter", "member scrapes that failed (target kept stale, staleness gauges set)"),
+    "slo_breaches": ("counter", "SLO evaluations where an objective burned on every configured window"),
     # graph-derived cost model (static/cost_model.py over the optimized
     # Program IR, folded with the compiled step structure)
     "step_model_flops": ("gauge", "cost-model model FLOPs of the last dispatched step (matmul-class, train multipliers + gm/remat/shard folded in)"),
@@ -115,6 +120,24 @@ SCALARS = {
     "step_comm_bytes": ("gauge", "cost-model cross-chip bytes of the last dispatched step (psum ring all-reduce accounting)"),
     "mfu": ("gauge", "model FLOPs utilization of the last step: step_model_flops / measured dispatch+fetch seconds / device peak FLOP/s"),
     "arith_intensity": ("gauge", "step arithmetic intensity, FLOPs per HBM byte — compare against the device machine balance for roofline position"),
+}
+
+# name -> (help, labels): labeled gauges (federation/SLO planes). The
+# series only exist once the subsystem runs, but declaring here keeps
+# kind/labels consistent across every call site.
+LABELED_GAUGES = {
+    "federation_target_up": (
+        "1 while the member endpoint answers scrapes, 0 once it goes "
+        "dark", ("instance",)),
+    "federation_scrape_age_s": (
+        "seconds since the member's last successful scrape "
+        "(staleness)", ("instance",)),
+    "slo_burn_rate": (
+        "burn rate per objective and window (1.0 = budget consumed at "
+        "exactly the sustainable pace)", ("objective", "window")),
+    "slo_burning": (
+        "1 while the objective burns on every configured window",
+        ("objective",)),
 }
 
 # name -> (help, labels). All use the default ms latency ladder.
@@ -155,6 +178,8 @@ def declare_standard_metrics(registry: MetricsRegistry) -> None:
             registry.gauge(name, help=help_)
         else:
             registry.counter(name, help=help_)
+    for name, (help_, labels) in LABELED_GAUGES.items():
+        registry.gauge(name, help=help_, labels=labels)
     for name, (help_, labels) in HISTOGRAMS.items():
         registry.histogram(name, help=help_, labels=labels,
                            buckets=DEFAULT_LATENCY_BUCKETS_MS)

@@ -106,6 +106,11 @@ class FlightRecorder:
             "time": self._clock(),
             "events": self.events(),
             "counters": _counters_if_loaded(),
+            # requests stranded mid-flight at dump time: their
+            # trace/span ids, so a chaos kill NAMES the requests it
+            # killed and `trace_view --trace <id>` shows how far each
+            # one got
+            "inflight_requests": _inflight_if_loaded(),
         }
         with self._dump_lock:
             # unique tmp per call (module-wide counter): even a dump
@@ -134,6 +139,17 @@ def _counters_if_loaded() -> dict:
         return prof.counters_snapshot()
     except Exception:
         return {}
+
+
+def _inflight_if_loaded() -> list:
+    """Open request-root spans (tracing module) — a failed import must
+    never break the postmortem writer mid-death."""
+    try:
+        from . import tracing
+
+        return tracing.inflight_snapshot()
+    except Exception:
+        return []
 
 
 def _bump_if_loaded(name: str) -> None:

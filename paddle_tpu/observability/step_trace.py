@@ -20,8 +20,9 @@ import threading
 import time
 from typing import Dict, Optional
 
-__all__ = ["SCHEMA_VERSION", "StepTrace", "enable_step_trace",
-           "disable_step_trace", "active_step_trace",
+__all__ = ["SCHEMA_VERSION", "StepTrace", "UnknownTraceSchema",
+           "enable_step_trace", "disable_step_trace",
+           "active_step_trace", "read_trace_records",
            "reset_step_trace"]
 
 _ENV = "PADDLE_STEP_TRACE"
@@ -34,7 +35,47 @@ _ENV = "PADDLE_STEP_TRACE"
 #   2 — adds "schema", the cost-model fields on executor step records
 #       (model_flops / hbm_bytes / comm_bytes / mfu / arith_intensity)
 #       and the per-executable ``kind="cost"`` breakdown record
-SCHEMA_VERSION = 2
+#   3 — adds ``kind="span"`` distributed-tracing records (trace/span/
+#       parent hex ids, typed status, events — observability/tracing.py;
+#       readers: tools/trace_view.py)
+SCHEMA_VERSION = 3
+
+#: every version this repo's readers accept (absence of the field = 1)
+SUPPORTED_SCHEMAS = frozenset(range(1, SCHEMA_VERSION + 1))
+
+
+class UnknownTraceSchema(ValueError):
+    """A step-trace record's ``schema`` is newer than this build —
+    readers refuse instead of misparsing (tools exit 2 on this)."""
+
+
+def read_trace_records(path: str, reader: str = "this tool"):
+    """Parse one step-trace JSONL file into a record list — the ONE
+    loader every reader (tools/perf_report.py, tools/trace_view.py)
+    shares, so the torn-line policy and the schema gate cannot drift
+    between tools. Torn tail lines from a crashed writer are skipped;
+    an unknown ``schema`` raises :class:`UnknownTraceSchema` naming
+    ``reader``; an unreadable file raises OSError."""
+    records = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a crashed writer
+            schema = rec.get("schema", 1)
+            if schema not in SUPPORTED_SCHEMAS:
+                raise UnknownTraceSchema(
+                    f"{path}:{lineno}: unknown step-trace schema "
+                    f"{schema!r} (this tool supports "
+                    f"{sorted(SUPPORTED_SCHEMAS)}); regenerate the "
+                    f"trace with this repo or upgrade {reader} — "
+                    "schema history is documented in MIGRATION.md")
+            records.append(rec)
+    return records
 
 
 class _StepScope:

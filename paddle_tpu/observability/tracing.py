@@ -1,0 +1,286 @@
+"""Distributed request tracing: trace/span ids with parent linkage,
+monotonic timings, and typed status, emitted to the step-trace JSONL
+sink as ``kind="span"`` records (schema v3).
+
+A *trace* is one request's journey — through the ServingEngine's
+admit→queue→assemble→dispatch→respond ladder, the DecodeEngine's
+admit→queue→prefill→per-tick-decode→respond loop, and across process
+boundaries: the PS v2 wire header and http_kv requests carry a compact
+trace context (trace id + parent span id, two u64s / two hex headers),
+so a PS pull or an elastic rendezvous issued inside a traced region
+shows up as a server-side span linked to the caller's tree.
+
+Design rules:
+
+- **Spans are always live, emission is gated.** Creating a span is a
+  few attribute writes (no locks, no I/O); the JSONL record is written
+  only when a step-trace sink is active (``PADDLE_STEP_TRACE`` /
+  ``enable_step_trace``). Context therefore propagates across the wire
+  even in processes that never opted into the sink — the server on the
+  other side may have.
+- **Typed status.** A span ends ``ok`` or with the *error taxonomy
+  name* that killed it (``DeadlineExceeded``, ``Overloaded``,
+  ``RequestFailed``, ``PSUnavailable``, ...) — the same types callers
+  branch on.
+- **Deterministic under fake clocks.** Every span takes an injectable
+  ``clock`` (the engines pass theirs), so durations and orderings are
+  reproducible in CI with no real waiting.
+- **Crash-visible.** Request-root spans register in an in-flight table
+  that the crash flight recorder snapshots into its postmortem — a
+  chaos kill names the trace ids of the requests it stranded.
+
+Stdlib-only on purpose: ``ps``/``http_kv``/``fault`` are jax-free and
+instrument through this module.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Span", "SpanContext", "current_context", "use_context", "span",
+    "new_trace_id", "inflight_snapshot", "trace_enabled",
+]
+
+# 63-bit ids: fit a u64 wire field with the sign bit clear, render as
+# 16-hex in JSONL. Fully random per id (the PSClient client-id lesson:
+# pids collide in containers, and any fixed per-process base caps the
+# varying bits — a 32-bit-varying scheme measurably collided within
+# ~100k ids); a live counter is folded in so even an exhausted or
+# broken entropy source cannot repeat within a process.
+_ID_SEQ = itertools.count(1)
+
+
+def new_trace_id() -> int:
+    return ((int.from_bytes(os.urandom(8), "little") + next(_ID_SEQ))
+            & 0x7FFFFFFFFFFFFFFF) or 1
+
+
+_new_span_id = new_trace_id
+
+
+def _hex(i: Optional[int]) -> Optional[str]:
+    return format(i, "016x") if i else None
+
+
+class SpanContext:
+    """Compact propagatable identity: (trace_id, span_id), both 63-bit
+    ints. ``to_wire()``/``from_wire()`` are the two-u64 form the PS v2
+    header carries; ``to_headers()``/``from_headers()`` the http_kv
+    form. A zero trace id means "untraced" everywhere."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = int(trace_id)
+        self.span_id = int(span_id)
+
+    def to_wire(self) -> Tuple[int, int]:
+        return (self.trace_id, self.span_id)
+
+    @staticmethod
+    def from_wire(trace_id: int, span_id: int) -> Optional["SpanContext"]:
+        if not trace_id:
+            return None
+        return SpanContext(trace_id, span_id)
+
+    # http_kv propagation: two hex headers, absent = untraced
+    TRACE_HEADER = "X-Paddle-Trace"
+    SPAN_HEADER = "X-Paddle-Span"
+
+    def to_headers(self) -> Dict[str, str]:
+        return {self.TRACE_HEADER: format(self.trace_id, "x"),
+                self.SPAN_HEADER: format(self.span_id, "x")}
+
+    @staticmethod
+    def from_headers(headers) -> Optional["SpanContext"]:
+        raw_t = headers.get(SpanContext.TRACE_HEADER)
+        if not raw_t:
+            return None
+        try:
+            trace = int(raw_t, 16)
+            sid = int(headers.get(SpanContext.SPAN_HEADER) or "0", 16)
+        except ValueError:
+            return None
+        return SpanContext.from_wire(trace, sid)
+
+    def __repr__(self):
+        return f"SpanContext({_hex(self.trace_id)}, {_hex(self.span_id)})"
+
+
+_CURRENT: contextvars.ContextVar[Optional[SpanContext]] = \
+    contextvars.ContextVar("paddle_trace_context", default=None)
+
+
+def current_context() -> Optional[SpanContext]:
+    """The ambient trace context of this thread/task (None = untraced).
+    RPC clients (PSClient, KVClient) stamp it onto the wire."""
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[SpanContext]):
+    """Make ``ctx`` the ambient context inside the with-block (None
+    clears it — e.g. around internal traffic that must not inherit a
+    request's identity)."""
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CURRENT.reset(token)
+
+
+# -- in-flight request table (flight-recorder postmortems) ----------------
+_INFLIGHT: Dict[int, dict] = {}
+_INFLIGHT_LOCK = threading.Lock()
+
+
+def inflight_snapshot() -> List[dict]:
+    """Open request-root spans right now — what a crash postmortem
+    names as the requests it stranded (trace/span ids + name + age)."""
+    with _INFLIGHT_LOCK:
+        return [dict(v) for v in _INFLIGHT.values()]
+
+
+def trace_enabled() -> bool:
+    """True when finished spans will actually land in a JSONL sink."""
+    from .step_trace import active_step_trace
+
+    return active_step_trace() is not None
+
+
+class Span:
+    """One timed, linkable operation.
+
+    ``parent`` may be a Span, a SpanContext, or None (None adopts the
+    ambient ``current_context()``; pass ``parent=False`` to force a
+    root). ``root=True`` registers the span in the in-flight table the
+    flight recorder dumps. End with ``end(status)`` or use as a context
+    manager (an exception types the status automatically)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "events", "status", "_clock", "_t0", "_t_epoch",
+                 "_root", "_done")
+
+    def __init__(self, name: str, parent=None, clock=None,
+                 root: bool = False, **attrs):
+        if parent is None:
+            parent = current_context()
+        elif parent is False:
+            parent = None
+        if isinstance(parent, Span):
+            parent = parent.context()
+        self.name = name
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
+        else:
+            self.trace_id = new_trace_id()
+            self.parent_id = 0
+        self.span_id = _new_span_id()
+        self.attrs: Dict[str, object] = dict(attrs)
+        self.events: List[dict] = []
+        self.status: Optional[str] = None
+        self._clock = clock or time.monotonic
+        self._t0 = self._clock()
+        self._t_epoch = time.time()
+        self._root = bool(root)
+        self._done = False
+        if self._root:
+            with _INFLIGHT_LOCK:
+                _INFLIGHT[self.span_id] = {
+                    "trace": _hex(self.trace_id),
+                    "span": _hex(self.span_id),
+                    "name": name, "t0": round(self._t0, 6)}
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set(self, key: str, value) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def event(self, name: str, **fields) -> "Span":
+        """Attach a point-in-time event (e.g. ``preempted``) — rendered
+        inside the span's JSONL record."""
+        ev = {"name": name, "t_ms": round(
+            (self._clock() - self._t0) * 1e3, 3)}
+        ev.update(fields)
+        self.events.append(ev)
+        return self
+
+    def activate(self):
+        """``with sp.activate():`` — make this span the ambient context
+        so nested spans and outbound RPCs link under it."""
+        return use_context(self.context())
+
+    def end(self, status: str = "ok") -> None:
+        """Finish the span: fix its duration, set the typed status, and
+        (when a step-trace sink is active) emit the ``kind="span"``
+        JSONL record. Idempotent — the first end wins, mirroring the
+        request handles' first-resolve-wins rule."""
+        if self._done:
+            return
+        self._done = True
+        self.status = status
+        dur_ms = (self._clock() - self._t0) * 1e3
+        if self._root:
+            with _INFLIGHT_LOCK:
+                _INFLIGHT.pop(self.span_id, None)
+        from .step_trace import active_step_trace
+
+        sink = active_step_trace()
+        if sink is None:
+            return
+        rec = {
+            "name": self.name,
+            "trace": _hex(self.trace_id),
+            "span": _hex(self.span_id),
+            "parent": _hex(self.parent_id),
+            "t0": round(self._t0, 6),
+            "t": round(self._t_epoch, 6),
+            "dur_ms": round(dur_ms, 3),
+            "status": status,
+        }
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        if self.events:
+            rec["events"] = self.events
+        sink.record("span", rec)
+        from .metrics import default_registry
+
+        default_registry().inc_scalar("trace_spans")
+
+    def fail(self, exc: BaseException) -> None:
+        """End with the error taxonomy name of ``exc`` as the status."""
+        self.end(status=type(exc).__name__)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.end(status="ok" if exc is None else exc_type.__name__)
+        return False
+
+
+@contextlib.contextmanager
+def span(name: str, parent=None, clock=None, **attrs):
+    """Scoped span that is ALSO the ambient context inside the block:
+    nested ``span()`` calls and outbound PS/KV RPCs parent to it. For
+    long-lived request spans that cross threads/ticks, construct
+    ``Span`` directly and pass it around instead."""
+    sp = Span(name, parent=parent, clock=clock, **attrs)
+    token = _CURRENT.set(sp.context())
+    try:
+        yield sp
+    except BaseException as e:
+        sp.fail(e)
+        raise
+    finally:
+        _CURRENT.reset(token)
+        sp.end()   # no-op when fail() already ended it

@@ -71,7 +71,9 @@ def _sds(shape, dtype, ref):
     """ShapeDtypeStruct for pallas_call out_shape that inherits `ref`'s
     varying-manual-axes type: under shard_map (the flash-ring path)
     check_vma requires outputs to declare how they vary over the mesh."""
-    vma = getattr(jax.typeof(ref), "vma", None)
+    typeof = getattr(jax, "typeof", None)
+    # jax < 0.7 has no typeof/vma typing at all — nothing to inherit
+    vma = getattr(typeof(ref), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -292,10 +292,17 @@ def _build_1f1b_step(stage_fn, first_fn, last_fn, mesh, axis, mb, ba):
             shard_map's varying-axes type, and stage-local values
             genuinely differ per rank. Already-varying axes pass through
             (pcast rejects re-casting them)."""
+            typeof = getattr(jax, "typeof", None)
+            pcast = getattr(jax.lax, "pcast", None)
+            if typeof is None or pcast is None:
+                # jax < 0.7: no varying-manual-axes typing, so there is
+                # nothing to re-cast — values are already usable
+                return t
+
             def one(a):
-                have = set(getattr(jax.typeof(a), "vma", ()))
+                have = set(getattr(typeof(a), "vma", ()))
                 need = tuple(ax for ax in want_axes if ax not in have)
-                return jax.lax.pcast(a, need, to="varying") if need else a
+                return pcast(a, need, to="varying") if need else a
             return jax.tree_util.tree_map(one, t)
 
         zero_h = vary(jnp.zeros(h_struct.shape, h_struct.dtype))

@@ -61,6 +61,7 @@ import numpy as np
 
 from ..fault.injector import _bump
 from ..fault import injector as _fault
+from ..observability import tracing
 from .service import (
     ERR_IO, ERR_LOG_TRUNCATED, ERR_NOT_PRIMARY, ERR_STALE_EPOCH,
     ERR_UNSUPPORTED, OP_DELTA_SINCE, OP_DIGEST, OP_LOAD, OP_REPL_APPLY,
@@ -388,8 +389,11 @@ class _RawPeer:
              payload: bytes = b"", reader=None):
         try:
             s = self._connect()
+            ctx = tracing.current_context()
+            w_trace, w_span = ctx.to_wire() if ctx is not None \
+                else (0, 0)
             s.sendall(_HDR.pack(op, table_id, n, lr, epoch, client, seq,
-                                dim) + payload)
+                                dim, w_trace, w_span) + payload)
             _read_reply(s, endpoint=self.endpoint)
             return reader(s) if reader is not None else None
         except PSReplyError:
@@ -919,8 +923,13 @@ class ReplicatedPSServer(PSServer):
                 # order (a gap is a typed reject + catch-up, never a
                 # silent out-of-order apply)
                 blob = entry.encode()
+                _ctx = tracing.current_context()
+                _wt, _ws = _ctx.to_wire() if _ctx is not None else (0, 0)
+                # the primary's server-side ps_rpc span is ambient here,
+                # so the replication forward links the backup's apply
+                # into the same trace
                 frame = _HDR.pack(OP_REPL_APPLY, 0, len(blob), 0.0,
-                                  self._epoch, 0, 0, 0) + blob
+                                  self._epoch, 0, 0, 0, _wt, _ws) + blob
                 try:
                     self._replicator.forward(frame)
                 except _StalePeerEpoch as e:

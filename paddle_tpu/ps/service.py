@@ -11,12 +11,19 @@ hash-sharded across server endpoints by the client exactly like the
 reference splits parameter blocks across pservers, and each connection
 gets a server thread (the listen_and_serv thread-per-handler model).
 
-Wire format v2 (fault-tolerant revision)::
+Wire format v2 (fault-tolerant revision; trace-context extension)::
 
     request  = [op:u8][table:u32][n:u64][lr:f32]
-               [epoch:u32][client:u32][seq:u64][dim:u32]  + payload
+               [epoch:u32][client:u32][seq:u64][dim:u32]
+               [trace:u64][span:u64]                       + payload
     reply    = [0x01] + payload                            (OK)
              | [0x00][code:u8][srv_epoch:u32][len:u32][msg]  (typed error)
+
+``trace``/``span`` are the caller's compact trace context
+(observability/tracing.py — zero = untraced): when set, the server
+wraps the request in a server-side ``ps_rpc`` span parented to the
+caller's span, so a PS pull issued inside a traced region appears in
+the caller's tree even across the process boundary.
 
 ``epoch`` is the client's shard-map epoch (0 = not epoch-aware — the
 legacy static-endpoint client), ``client``/``seq`` identify a write for
@@ -66,6 +73,7 @@ import numpy as np
 from ..fault import injector as _fault
 from ..fault.injector import _bump  # shared lazy counter shim
 from ..fault.retry import Backoff, Retrier, env_backoff, env_max_attempts
+from ..observability import tracing
 from ..observability.flight_recorder import note_typed_error
 from ..observability.metrics import default_registry as _obs_registry
 
@@ -88,8 +96,19 @@ from .table import SparseTable
 
 _MAX_OP = OP_REPL_APPLY
 
-_HDR = struct.Struct("<BIQfIIQI")   # op table n lr epoch client seq dim
+# op table n lr epoch client seq dim trace span — trace/span are the
+# caller's compact trace context (0 = untraced; tracing.SpanContext)
+_HDR = struct.Struct("<BIQfIIQIQQ")
 _ERR_HDR = struct.Struct("<BII")    # code srv_epoch msg_len
+
+_OP_NAMES = {
+    OP_PULL: "pull", OP_PUSH: "push", OP_MERGE: "merge",
+    OP_SAVE: "save", OP_LOAD: "load", OP_ROWS: "rows",
+    OP_BARRIER: "barrier", OP_STOP: "stop", OP_HEARTBEAT: "heartbeat",
+    OP_ASSIGN: "assign", OP_SEQ: "seq", OP_DELTA_SINCE: "delta_since",
+    OP_DIGEST: "digest", OP_KEYS: "keys", OP_SNAPSHOT: "snapshot",
+    OP_STATE: "state", OP_REPL_APPLY: "repl_apply",
+}
 
 # typed error-frame codes (client maps them to the ps.replication taxonomy)
 (ERR_UNKNOWN_TABLE, ERR_BARRIER_TIMEOUT, ERR_STALE_EPOCH, ERR_NOT_PRIMARY,
@@ -316,131 +335,33 @@ class PSServer:
         try:
             while not self._stop.is_set():
                 hdr = _recv_exact(conn, _HDR.size)
-                op, table_id, n, lr, epoch, client, seq, dim = \
-                    _HDR.unpack(hdr)
-                # no wire-level "trusted" flag: replication traffic is
-                # the OP_REPL_APPLY admin op (seq-validated), so an op
-                # with any reserved bit set is simply malformed — a
-                # flag that exempted role checks would let any client
-                # desync a backup's replication stream
-                base = op
-                oversized = (
-                    n > _MAX_BLOB
-                    if base in (OP_DELTA_SINCE, OP_REPL_APPLY)
-                    else (n > _MAX_IDS or dim > _MAX_DIM
-                          or n * max(dim, 1) > _MAX_ELEMS))
-                if base > _MAX_OP or oversized:
-                    # unparseable header: the stream cannot be resynced —
-                    # reply typed, then drop the connection
-                    _send_err(conn, ERR_BAD_REQUEST, 0,
-                              f"malformed request (op={op}, n={n}, "
-                              f"dim={dim})")
-                    return
-                if base == OP_STOP:
-                    _send_ok(conn)
-                    self._stop.set()
-                    return
-                if base == OP_HEARTBEAT:
-                    # trainer_id rides the table field, status the count
-                    self.monitor.update(table_id, int(n))
-                    _send_ok(conn)
+                (op, table_id, n, lr, epoch, client, seq, dim,
+                 w_trace, w_span) = _HDR.unpack(hdr)
+                ctx = tracing.SpanContext.from_wire(w_trace, w_span)
+                if ctx is None:
+                    if not self._serve_one(conn, op, table_id, n, lr,
+                                           epoch, client, seq, dim):
+                        return
                     continue
-                if base == OP_BARRIER:
-                    self._serve_barrier(conn, epoch)
-                    continue
-                if base in (OP_SEQ, OP_DELTA_SINCE, OP_STATE, OP_SNAPSHOT,
-                            OP_REPL_APPLY):
-                    # DELTA_SINCE and REPL_APPLY carry n payload bytes
-                    body = (_recv_exact(conn, n)
-                            if base in (OP_DELTA_SINCE, OP_REPL_APPLY)
-                            else b"")
-                    self._admin_reply(base, conn, table_id, n, body,
-                                      epoch=epoch)
-                    continue
-                table = self.tables.get(table_id)
-                if base == OP_PULL:
-                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
-                    err = self._table_error(table, table_id, dim, epoch,
-                                            base)
-                    if err:
-                        _send_err(conn, err[0], err[1], err[2])
-                        continue
-                    _send_ok(conn, table.pull(ids).tobytes())
-                elif base in (OP_PUSH, OP_MERGE, OP_ASSIGN):
-                    # drain ids AND values by the client-declared dim
-                    # BEFORE any error reply, so a rejected write leaves
-                    # the stream in sync for the next request
-                    ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
-                    raw = _recv_exact(conn, 4 * n * dim)
-                    err = self._table_error(table, table_id, dim, epoch,
-                                            base)
-                    if err:
-                        _send_err(conn, err[0], err[1], err[2])
-                        continue
-                    vals = np.frombuffer(raw, np.float32)
-                    try:
-                        self._apply_write(base, table, table_id, ids,
-                                          vals, lr, client, seq, False)
-                    except WriteRejected as e:
-                        _send_err(conn, e.code,
-                                  getattr(self, "_epoch", 0), e.msg)
-                        continue
-                    except (ValueError, KeyError, OSError,
-                            RuntimeError) as e:
-                        # a failed apply must reply typed (the client
-                        # replays; the dedup watermark only advances on
-                        # success) — dying here would leave the client
-                        # blocked and the retry silently swallowed
-                        _send_err(conn, ERR_IO,
-                                  getattr(self, "_epoch", 0),
-                                  f"write failed: {e}")
-                        continue
-                    _send_ok(conn)
-                elif base in (OP_SAVE, OP_LOAD):
-                    path = _recv_exact(conn, n).decode()
-                    if table is None:
-                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
-                                  f"unknown table_id {table_id}")
-                        continue
-                    acc = self._access_error(base, epoch)
-                    if acc is not None:
-                        # SAVE/LOAD fence like data ops: a LOAD onto a
-                        # demoted server (or a backup) would mutate
-                        # state outside the replication stream
-                        _send_err(conn, acc[0],
-                                  getattr(self, "_epoch", 0), acc[1])
-                        continue
-                    try:
-                        (table.save if base == OP_SAVE else
-                         table.load)(path)
-                        _send_ok(conn)
-                    except (IOError, OSError, ValueError) as e:
-                        _send_err(conn, ERR_IO, 0,
-                                  f"{'save' if base == OP_SAVE else 'load'}"
-                                  f"({path}) failed: {e}")
-                elif base == OP_ROWS:
-                    if table is None:
-                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
-                                  f"unknown table_id {table_id}")
-                        continue
-                    _send_ok(conn, struct.pack("<Q", table.rows()))
-                elif base == OP_KEYS:
-                    if table is None:
-                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
-                                  f"unknown table_id {table_id}")
-                        continue
-                    keys = np.sort(table.keys())
-                    _send_ok(conn, struct.pack("<Q", keys.size)
-                             + keys.tobytes())
-                elif base == OP_DIGEST:
-                    if table is None:
-                        _send_err(conn, ERR_UNKNOWN_TABLE, 0,
-                                  f"unknown table_id {table_id}")
-                        continue
-                    _send_ok(conn, table_digest(table))
-                else:
-                    _send_err(conn, ERR_BAD_REQUEST, 0,
-                              f"unhandled op {base}")
+                # server-side ps_rpc span parented to the CALLER's
+                # span over the wire: a PS pull inside a traced region
+                # lands in the caller's tree across the process
+                # boundary. Activated, so replication forwards carry
+                # it one hop further (primary -> backup).
+                sp = tracing.Span("ps_rpc", parent=ctx,
+                                  op=_OP_NAMES.get(op, str(op)),
+                                  table=table_id,
+                                  endpoint=self.endpoint)
+                try:
+                    with sp.activate():
+                        keep = self._serve_one(conn, op, table_id, n,
+                                               lr, epoch, client, seq,
+                                               dim)
+                except BaseException as e:
+                    sp.fail(e)
+                    raise
+                sp.end()
+                if not keep:
                     return
         except socket.timeout:
             # idle/stalled peer: close its connection, count it —
@@ -464,6 +385,137 @@ class PSServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_one(self, conn: socket.socket, op: int, table_id: int,
+                   n: int, lr: float, epoch: int, client: int,
+                   seq: int, dim: int) -> bool:
+        """Handle ONE framed request (header already consumed). Returns
+        True to keep the connection loop serving, False to close it."""
+        # no wire-level "trusted" flag: replication traffic is the
+        # OP_REPL_APPLY admin op (seq-validated), so an op with any
+        # reserved bit set is simply malformed — a flag that exempted
+        # role checks would let any client desync a backup's
+        # replication stream
+        base = op
+        oversized = (
+            n > _MAX_BLOB
+            if base in (OP_DELTA_SINCE, OP_REPL_APPLY)
+            else (n > _MAX_IDS or dim > _MAX_DIM
+                  or n * max(dim, 1) > _MAX_ELEMS))
+        if base > _MAX_OP or oversized:
+            # unparseable header: the stream cannot be resynced —
+            # reply typed, then drop the connection
+            _send_err(conn, ERR_BAD_REQUEST, 0,
+                      f"malformed request (op={op}, n={n}, "
+                      f"dim={dim})")
+            return False
+        if base == OP_STOP:
+            _send_ok(conn)
+            self._stop.set()
+            return False
+        if base == OP_HEARTBEAT:
+            # trainer_id rides the table field, status the count
+            self.monitor.update(table_id, int(n))
+            _send_ok(conn)
+            return True
+        if base == OP_BARRIER:
+            self._serve_barrier(conn, epoch)
+            return True
+        if base in (OP_SEQ, OP_DELTA_SINCE, OP_STATE, OP_SNAPSHOT,
+                    OP_REPL_APPLY):
+            # DELTA_SINCE and REPL_APPLY carry n payload bytes
+            body = (_recv_exact(conn, n)
+                    if base in (OP_DELTA_SINCE, OP_REPL_APPLY)
+                    else b"")
+            self._admin_reply(base, conn, table_id, n, body,
+                              epoch=epoch)
+            return True
+        table = self.tables.get(table_id)
+        if base == OP_PULL:
+            ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+            err = self._table_error(table, table_id, dim, epoch,
+                                    base)
+            if err:
+                _send_err(conn, err[0], err[1], err[2])
+                return True
+            _send_ok(conn, table.pull(ids).tobytes())
+        elif base in (OP_PUSH, OP_MERGE, OP_ASSIGN):
+            # drain ids AND values by the client-declared dim
+            # BEFORE any error reply, so a rejected write leaves
+            # the stream in sync for the next request
+            ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+            raw = _recv_exact(conn, 4 * n * dim)
+            err = self._table_error(table, table_id, dim, epoch,
+                                    base)
+            if err:
+                _send_err(conn, err[0], err[1], err[2])
+                return True
+            vals = np.frombuffer(raw, np.float32)
+            try:
+                self._apply_write(base, table, table_id, ids,
+                                  vals, lr, client, seq, False)
+            except WriteRejected as e:
+                _send_err(conn, e.code,
+                          getattr(self, "_epoch", 0), e.msg)
+                return True
+            except (ValueError, KeyError, OSError,
+                    RuntimeError) as e:
+                # a failed apply must reply typed (the client
+                # replays; the dedup watermark only advances on
+                # success) — dying here would leave the client
+                # blocked and the retry silently swallowed
+                _send_err(conn, ERR_IO,
+                          getattr(self, "_epoch", 0),
+                          f"write failed: {e}")
+                return True
+            _send_ok(conn)
+        elif base in (OP_SAVE, OP_LOAD):
+            path = _recv_exact(conn, n).decode()
+            if table is None:
+                _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                          f"unknown table_id {table_id}")
+                return True
+            acc = self._access_error(base, epoch)
+            if acc is not None:
+                # SAVE/LOAD fence like data ops: a LOAD onto a
+                # demoted server (or a backup) would mutate
+                # state outside the replication stream
+                _send_err(conn, acc[0],
+                          getattr(self, "_epoch", 0), acc[1])
+                return True
+            try:
+                (table.save if base == OP_SAVE else
+                 table.load)(path)
+                _send_ok(conn)
+            except (IOError, OSError, ValueError) as e:
+                _send_err(conn, ERR_IO, 0,
+                          f"{'save' if base == OP_SAVE else 'load'}"
+                          f"({path}) failed: {e}")
+        elif base == OP_ROWS:
+            if table is None:
+                _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                          f"unknown table_id {table_id}")
+                return True
+            _send_ok(conn, struct.pack("<Q", table.rows()))
+        elif base == OP_KEYS:
+            if table is None:
+                _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                          f"unknown table_id {table_id}")
+                return True
+            keys = np.sort(table.keys())
+            _send_ok(conn, struct.pack("<Q", keys.size)
+                     + keys.tobytes())
+        elif base == OP_DIGEST:
+            if table is None:
+                _send_err(conn, ERR_UNKNOWN_TABLE, 0,
+                          f"unknown table_id {table_id}")
+                return True
+            _send_ok(conn, table_digest(table))
+        else:
+            _send_err(conn, ERR_BAD_REQUEST, 0,
+                      f"unhandled op {base}")
+            return False
+        return True
 
     def _table_error(self, table, table_id: int, dim: Optional[int],
                      epoch: int, base_op: int):
@@ -710,8 +762,14 @@ class PSClient:
 
     def _frame(self, op: int, table_id: int, n: int, lr: float,
                dim: int, seq: int, payload: bytes) -> bytes:
+        # the ambient trace context rides every frame (0s = untraced):
+        # read at build time, so a failover replay re-stamps the SAME
+        # caller identity onto the fresh primary's frame
+        ctx = tracing.current_context()
+        w_trace, w_span = ctx.to_wire() if ctx is not None else (0, 0)
         return _HDR.pack(op, table_id, n, lr, self._epoch,
-                         self._client_id, seq, dim) + payload
+                         self._client_id, seq, dim, w_trace,
+                         w_span) + payload
 
     def _exchange_once(self, k: int, frame: bytes, reader, fp_name: str):
         _fault.point(fp_name)

@@ -223,7 +223,8 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
                 "decode_steps", "decode_shed", "decode_deadline_expired",
                 "decode_failed", "decode_batch_fill_pct",
                 "decode_page_util_peak_pct", "kv_page_evictions",
-                "decode_ok"):
+                "decode_ok", "trace_spans_per_request",
+                "decode_slowest_trace", "decode_slowest_trace_ms"):
         assert key in last, f"bench row missing {key!r}"
     assert last["decode_tokens_per_sec"] > 0, last
     # the acceptance gate: ragged paged decode beats padded recompute
@@ -241,6 +242,13 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["decode_failed"] == 0, last
     assert 0 < last["decode_batch_fill_pct"] <= 100.0, last
     assert 0 < last["decode_page_util_peak_pct"] <= 100.0, last
+    # tracing contract: the probe runs traced — every served request
+    # leaves at least its client root + decode.request + queue +
+    # prefill spans, and the slowest request is named by trace id
+    assert last["trace_spans_per_request"] >= 3.0, last
+    assert isinstance(last["decode_slowest_trace"], str) \
+        and len(last["decode_slowest_trace"]) == 16, last
+    assert last["decode_slowest_trace_ms"] > 0, last
     # MULTICHIP probe contract: the DP×TP static-executor step (forced
     # 8-device CPU topology in a subprocess) matches the single-chip
     # loss within the established gm tolerance, the row-parallel hint

@@ -223,7 +223,8 @@ def test_executor_gm_step_same_cost():
 
 
 def test_step_trace_rows_carry_cost_fields(tmp_path, monkeypatch):
-    from paddle_tpu.observability.step_trace import (disable_step_trace,
+    from paddle_tpu.observability.step_trace import (SCHEMA_VERSION,
+                                                     disable_step_trace,
                                                      enable_step_trace)
 
     monkeypatch.setenv("PADDLE_PEAK_FLOPS", "1e12")
@@ -234,7 +235,8 @@ def test_step_trace_rows_carry_cost_fields(tmp_path, monkeypatch):
     finally:
         disable_step_trace()
     recs = [json.loads(ln) for ln in open(path) if ln.strip()]
-    assert recs and all(r.get("schema") == 2 for r in recs)
+    assert recs and all(r.get("schema") == SCHEMA_VERSION
+                        for r in recs)
     steps = [r for r in recs if r["kind"] == "executor"
              and r.get("phases", {}).get("dispatch") is not None]
     assert len(steps) == 3

@@ -24,7 +24,8 @@ from paddle_tpu.observability.flight_recorder import (FlightRecorder,
 from paddle_tpu.observability.metrics import (CONTENT_TYPE,
                                               MetricsRegistry,
                                               parse_prometheus_text)
-from paddle_tpu.observability.step_trace import (disable_step_trace,
+from paddle_tpu.observability.step_trace import (SCHEMA_VERSION,
+                                                 disable_step_trace,
                                                  enable_step_trace)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -322,7 +323,7 @@ def test_step_trace_jsonl_schema(tmp_path):
     # startup + 3 steps (+ the per-executable cost record), ids
     # strictly increasing from 0; every record is schema-versioned
     assert [r["step"] for r in recs] == list(range(len(recs)))
-    assert all(r.get("schema") == 2 for r in recs)
+    assert all(r.get("schema") == SCHEMA_VERSION for r in recs)
     steps = [r for r in recs if r.get("phases", {}).get("dispatch")
              is not None]
     assert len(steps) == 3

@@ -1,0 +1,660 @@
+"""Distributed request tracing + metrics federation + SLO burn-rate
+plane (PR 14):
+
+- span primitives: linkage, typed status, events, ambient context,
+  in-flight table, deterministic timings under a fake clock;
+- a decode request under continuous-batching load leaves a COMPLETE
+  span tree in the JSONL (admission through per-tick decode to
+  respond, preemption visible as a span event);
+- serving engine request lifecycle spans with typed deadline status;
+- cross-process propagation: a PS pull inside a traced region yields
+  a server-side ps_rpc span linked to the caller's trace over the v2
+  wire header — including across a chaos-drill failover to the
+  promoted backup — and http_kv requests link via headers;
+- federation: merge with instance labels, a killed endpoint mid-scrape
+  degrades to staleness gauges (merged output still renders);
+- SLO: burn rates from cumulative-bucket deltas over multi-window
+  snapshots; tools/slo_check.py exits non-zero on a synthetic burn and
+  zero on a healthy scrape;
+- tools/trace_view.py renders trees/critical paths and refuses unknown
+  schemas; the flight recorder postmortem names in-flight requests.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.step_trace import (disable_step_trace,
+                                                 enable_step_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spans(path):
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("kind") == "span":
+                out.append(rec)
+    return out
+
+
+@pytest.fixture
+def sink(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    enable_step_trace(path)
+    yield path
+    disable_step_trace()
+
+
+# ---------------------------------------------------------------------------
+# span primitives
+# ---------------------------------------------------------------------------
+def test_span_linkage_status_events_fake_clock(sink):
+    clk = [0.0]
+
+    def clock():
+        clk[0] += 0.125
+        return clk[0]
+
+    root = tracing.Span("req", parent=False, clock=clock, root=True)
+    root_hex = format(root.span_id, "016x")
+    assert any(e["span"] == root_hex
+               for e in tracing.inflight_snapshot())
+    with root.activate():
+        assert tracing.current_context().trace_id == root.trace_id
+        with tracing.span("child", clock=clock) as c:
+            c.event("preempted", slot=1)
+    assert tracing.current_context() is None
+    root.fail(ValueError("boom"))
+    root.end()     # idempotent: first end wins
+    # membership, not emptiness: earlier suite tests legitimately
+    # strand requests (engine stop() leaves queued handles unresolved)
+    assert all(e["span"] != root_hex
+               for e in tracing.inflight_snapshot())
+    recs = _spans(sink)
+    child, parent = recs[0], recs[1]
+    assert child["name"] == "child"
+    assert child["trace"] == parent["trace"]
+    assert child["parent"] == parent["span"]
+    assert child["events"][0]["name"] == "preempted"
+    # fake clock: exact durations (0.125 s per tick, ms in the record;
+    # the child consumes two ticks: one for the event stamp, one at end)
+    assert child["dur_ms"] == pytest.approx(250.0)
+    assert child["events"][0]["t_ms"] == pytest.approx(125.0)
+    assert parent["status"] == "ValueError"
+    assert parent["dur_ms"] == pytest.approx(500.0)
+
+
+def test_span_context_wire_and_headers_roundtrip():
+    ctx = tracing.SpanContext(0x1234, 0x5678)
+    assert tracing.SpanContext.from_wire(*ctx.to_wire()).span_id == 0x5678
+    assert tracing.SpanContext.from_wire(0, 7) is None
+    h = ctx.to_headers()
+    back = tracing.SpanContext.from_headers(h)
+    assert (back.trace_id, back.span_id) == (0x1234, 0x5678)
+    assert tracing.SpanContext.from_headers({}) is None
+
+
+# ---------------------------------------------------------------------------
+# decode engine: the complete request tree
+# ---------------------------------------------------------------------------
+def _drive(eng, max_ticks=500):
+    for _ in range(max_ticks):
+        if not eng.sched.pending():
+            return
+        eng.run_once()
+    raise AssertionError("engine did not drain the workload")
+
+
+def test_decode_request_leaves_complete_span_tree(sink):
+    from paddle_tpu.inference.decode import (DecodeEngine,
+                                             DecodeModelConfig)
+
+    cfg = DecodeModelConfig(vocab_size=32, n_layers=1, n_heads=2,
+                            head_dim=8, ffn_dim=16, max_context=32)
+    eng = DecodeEngine(cfg, seed=3, max_batch=2, n_pages=16, page_size=4,
+                       max_pages_per_seq=8)
+    eng.warm()
+    hs = [eng.submit([1 + i, 2, 3], max_new_tokens=4) for i in range(3)]
+    _drive(eng)
+    for h in hs:
+        h.result(timeout=5)
+        assert len(h.stats()["trace_id"]) == 16
+    recs = _spans(sink)
+    by_trace = {}
+    for r in recs:
+        by_trace.setdefault(r["trace"], []).append(r)
+    for h in hs:
+        tid = h.stats()["trace_id"]
+        tree = by_trace[tid]
+        names = [r["name"] for r in tree]
+        # admission -> queue wait -> prefill -> respond, all linked
+        assert names.count("decode.request") == 1
+        assert "decode.queue" in names and "decode.prefill" in names
+        root = next(r for r in tree if r["name"] == "decode.request")
+        assert root["status"] == "ok"
+        assert root["parent"] is None
+        assert root["attrs"]["tokens"] == 4
+        for r in tree:
+            if r is not root:
+                assert r["parent"] == root["span"], r
+        # per-tick decode spans reference this request by trace id
+        ticks = [r for r in recs if r["name"] == "decode.tick"
+                 and tid in (r.get("attrs", {}).get("requests") or ())]
+        assert ticks, f"no tick span names trace {tid}"
+    # one span per tick, not per slot: tick spans <= decode steps + 1
+    tick_spans = [r for r in recs if r["name"] == "decode.tick"]
+    assert len(tick_spans) == eng.counters["decode_steps"]
+
+
+def test_decode_preemption_is_a_span_event(sink):
+    from paddle_tpu.inference.decode import (DecodeEngine,
+                                             DecodeModelConfig)
+
+    cfg = DecodeModelConfig(vocab_size=32, n_layers=1, n_heads=2,
+                            head_dim=8, ffn_dim=16, max_context=24)
+    eng = DecodeEngine(cfg, seed=7, max_batch=2, n_pages=8, page_size=4,
+                       max_pages_per_seq=6)
+    eng.warm()
+    hs = [eng.submit(p, max_new_tokens=10)
+          for p in ([1, 2, 3, 4, 5], [6, 7, 8, 9, 10, 11])]
+    _drive(eng)
+    for h in hs:
+        h.result(timeout=5)
+    assert eng.counters["decode_preempted"] >= 1
+    roots = [r for r in _spans(sink) if r["name"] == "decode.request"]
+    preempted = [r for r in roots
+                 if any(e["name"] == "preempted"
+                        for e in r.get("events", ()))]
+    assert preempted, "no root span carries the preemption event"
+    # the preempted request re-queued: a second decode.queue span
+    # exists under its root, flagged as a preemption requeue
+    pr = preempted[0]
+    queues = [r for r in _spans(sink)
+              if r["name"] == "decode.queue"
+              and r["parent"] == pr["span"]]
+    assert len(queues) >= 2
+    assert any(r.get("attrs", {}).get("requeued_after_preemption")
+               for r in queues)
+    assert pr["attrs"]["preempted"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# serving engine lifecycle spans
+# ---------------------------------------------------------------------------
+def _serving_engine(tmp_path):
+    import paddle_tpu.static as static
+    from paddle_tpu.inference.serving import (AnalysisPredictor,
+                                              ServingEngine)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4])
+        y = static.nn.fc(x, 3)
+    exe = static.Executor()
+    exe.run(startup)
+    model_dir = str(tmp_path / "blob")
+    static.save_inference_model(model_dir, ["x"], [y], exe,
+                                main_program=main)
+    pred = AnalysisPredictor(model_dir, batch_buckets=(1, 2, 4))
+    pred.warm()
+    return ServingEngine(pred)
+
+
+def test_serving_request_spans_and_typed_deadline(sink, tmp_path):
+    from paddle_tpu.inference.serving import DeadlineExceeded
+
+    eng = _serving_engine(tmp_path)
+    h = eng.submit({"x": np.ones((2, 4), np.float32)})
+    eng.run_once()
+    h.result(timeout=5)
+    # unmakeable deadline: typed status on the root span
+    eng.min_service_s = 10.0
+    with pytest.raises(DeadlineExceeded):
+        eng.submit({"x": np.ones((1, 4), np.float32)}, deadline_s=0.5)
+    recs = _spans(sink)
+    root = next(r for r in recs if r["name"] == "serve.request"
+                and r["status"] == "ok")
+    children = [r for r in recs if r.get("parent") == root["span"]]
+    assert {"serve.queue"} <= {r["name"] for r in children}
+    dispatch = next(r for r in recs if r["name"] == "serve.dispatch")
+    assert root["trace"] in dispatch["attrs"]["requests"]
+    assert dispatch["attrs"]["n_requests"] == 1
+    shed = next(r for r in recs if r["name"] == "serve.request"
+                and r["status"] == "DeadlineExceeded")
+    assert shed["span"] != root["span"]
+
+
+# ---------------------------------------------------------------------------
+# PS wire propagation (cross-process header) + failover
+# ---------------------------------------------------------------------------
+def test_ps_rpc_span_links_to_caller_trace(sink):
+    from paddle_tpu.ps.service import PSClient, PSServer
+    from paddle_tpu.ps.table import SparseTable
+
+    srv = PSServer({0: SparseTable(4, init_range=0.0, seed=1)}).start()
+    c = PSClient(endpoints=[srv.endpoint])
+    ids = np.arange(8, dtype=np.int64)
+    try:
+        with tracing.span("train.step", parent=False) as sp:
+            caller = sp.context()
+            c.push(0, ids, np.ones((8, 4), np.float32), 4, lr=0.5)
+            c.pull(0, ids, 4)
+        # untraced RPC: no span context on the wire, no server span
+        c.pull(0, ids, 4)
+    finally:
+        c.close()
+        srv.stop()
+    recs = _spans(sink)
+    server_side = [r for r in recs if r["name"] == "ps_rpc"]
+    assert {r["attrs"]["op"] for r in server_side} == {"push", "pull"}
+    for r in server_side:
+        # the server's span landed in the CALLER's tree across the wire
+        assert r["trace"] == format(caller.trace_id, "016x")
+        assert r["parent"] == format(caller.span_id, "016x")
+        assert r["status"] == "ok"
+    # exactly one traced pull: the untraced one produced no span
+    assert sum(1 for r in server_side
+               if r["attrs"]["op"] == "pull") == 1
+
+
+@pytest.mark.slow
+def test_ps_rpc_spans_parent_across_failover(sink, tmp_path):
+    """Chaos-drill shape: primary dies mid-job; the client's next
+    traced write fails over to the promoted backup and the NEW
+    server-side span still lands in the caller's trace."""
+    from paddle_tpu.distributed.http_kv import KVClient, KVServer
+    from paddle_tpu.ps.replication import (ReplicaCoordinator,
+                                           ReplicatedPSServer)
+    from paddle_tpu.ps.service import PSClient
+    from paddle_tpu.ps.table import SparseTable
+
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    kv_srv = KVServer(free_port())
+    kv_srv.start()
+    kv = KVClient(
+        f"127.0.0.1:{kv_srv.http_server.server_address[1]}")
+    pa, pb = free_port(), free_port()
+    coord = ReplicaCoordinator(kv, job="j", lease_ttl=0.3,
+                               boot_grace=60.0)
+    coord.publish([[f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]], sync=True)
+    a = ReplicatedPSServer({0: SparseTable(4, init_range=0.0, seed=1)},
+                           kv, job="j", port=pa, lease_ttl=0.3).start()
+    b = ReplicatedPSServer({0: SparseTable(4, init_range=0.0, seed=1)},
+                           kv, job="j", port=pb, lease_ttl=10.0).start()
+    c = PSClient(kv=kv, job="j", failover_timeout=10.0)
+    ids = np.arange(6, dtype=np.int64)
+    ones = np.ones((6, 4), np.float32)
+    try:
+        with tracing.span("train.step", parent=False) as sp:
+            caller_trace = format(sp.trace_id, "016x")
+            c.push(0, ids, ones, 4, lr=0.5)
+            a.crash()
+            time.sleep(0.5)          # A's lease lapses; B's holds
+            assert coord.check_now() == [0]
+            # failover + replay inside the SAME traced region
+            c.push(0, ids, ones, 4, lr=0.5)
+            np.testing.assert_allclose(c.pull(0, ids, 4), -1.0)
+    finally:
+        c.close()
+        a.stop()
+        b.stop()
+        kv_srv.stop()
+    server_side = [r for r in _spans(sink) if r["name"] == "ps_rpc"]
+    eps = {r["attrs"]["endpoint"] for r in server_side
+           if r["attrs"]["op"] == "push"}
+    # both generations served a traced push: the dead primary AND the
+    # promoted backup link into the one caller trace
+    assert eps == {a.endpoint, b.endpoint}
+    assert all(r["trace"] == caller_trace for r in server_side)
+
+
+# ---------------------------------------------------------------------------
+# http_kv propagation
+# ---------------------------------------------------------------------------
+def test_http_kv_spans_link_via_headers(sink):
+    from paddle_tpu.distributed.http_kv import KVClient, KVServer
+
+    srv = KVServer(0)
+    srv.start()
+    port = srv.http_server.server_address[1]
+    c = KVClient(f"127.0.0.1:{port}")
+    try:
+        with tracing.span("rendezvous", parent=False) as sp:
+            c.put("scope/k", b"v")
+            assert c.get("scope/k") == b"v"
+        c.get("scope/k")       # untraced: no server span
+    finally:
+        srv.stop()
+    recs = [r for r in _spans(sink)
+            if r["name"].startswith("http_kv.")]
+    assert {r["name"] for r in recs} == {"http_kv.PUT", "http_kv.GET"}
+    for r in recs:
+        assert r["trace"] == format(sp.trace_id, "016x")
+        assert r["parent"] == format(sp.span_id, "016x")
+    assert sum(1 for r in recs if r["name"] == "http_kv.GET") == 1
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+def test_federation_merges_with_instance_labels():
+    from paddle_tpu.observability.federation import FederatedMetrics
+    from paddle_tpu.observability.metrics import parse_prometheus_text
+
+    texts = {
+        "a:1": "# TYPE serve_requests counter\nserve_requests 5\n",
+        "b:2": "# TYPE serve_requests counter\nserve_requests 7\n",
+    }
+
+    def fetch(ep, timeout=None):
+        return texts[ep]
+
+    fed = FederatedMetrics(["a:1", "b:2"], clock=lambda: 100.0,
+                           fetch=fetch)
+    assert fed.scrape_once() == {"a:1": True, "b:2": True}
+    merged = parse_prometheus_text(fed.render())
+    assert merged['serve_requests{instance="a:1"}'] == 5
+    assert merged['serve_requests{instance="b:2"}'] == 7
+    assert merged['federation_target_up{instance="a:1"}'] == 1
+    # TYPE header survives the merge exactly once
+    assert fed.render().count("# TYPE serve_requests counter") == 1
+
+
+def test_federation_survives_killed_endpoint_mid_scrape():
+    """Satellite acceptance: a member dies between scrapes — the
+    staleness gauge is set, the merged output still renders (stale
+    samples kept), and the scrape NEVER raises."""
+    from paddle_tpu.observability.federation import FederatedMetrics
+    from paddle_tpu.observability.metrics import (default_registry,
+                                                  parse_prometheus_text)
+
+    clk = [100.0]
+    alive = {"a:1": True, "b:2": True}
+    texts = {"a:1": "decode_requests 3\n", "b:2": "decode_requests 9\n"}
+
+    def fetch(ep, timeout=None):
+        if not alive[ep]:
+            raise ConnectionRefusedError(f"{ep} is dead")
+        return texts[ep]
+
+    fed = FederatedMetrics(["a:1", "b:2"], clock=lambda: clk[0],
+                           fetch=fetch)
+    fed.scrape_once()
+    alive["b:2"] = False       # killed mid-scrape-cycle
+    clk[0] = 160.0
+    assert fed.scrape_once() == {"a:1": True, "b:2": False}
+    merged = parse_prometheus_text(fed.render())
+    # the dead member's last good samples still serve, staleness visible
+    assert merged['decode_requests{instance="b:2"}'] == 9
+    assert merged['federation_target_up{instance="b:2"}'] == 0
+    assert merged['federation_scrape_age_s{instance="b:2"}'] == 60.0
+    assert merged['federation_target_up{instance="a:1"}'] == 1
+    assert fed.staleness()["b:2"] == 60.0
+    reg = default_registry()
+    assert reg.get("federation_target_up") \
+        .value(instance="b:2") == 0
+    assert reg.flat_snapshot().get("federation_scrape_failures", 0) >= 1
+
+
+def test_federation_server_real_listeners():
+    """End to end over real sockets: two /metrics listeners federated
+    onto one; killing one flips its up gauge on the next cycle."""
+    from paddle_tpu.observability.federation import (FederationServer,
+                                                     scrape_text)
+    from paddle_tpu.observability.metrics import parse_prometheus_text
+    from paddle_tpu.observability.server import MetricsServer
+
+    m1, m2 = MetricsServer(0).start(), MetricsServer(0).start()
+    eps = [f"127.0.0.1:{m1.port}", f"127.0.0.1:{m2.port}"]
+    fed = FederationServer(eps, interval_s=3600)   # manual cycles
+    fed.start()
+    try:
+        text = scrape_text(f"127.0.0.1:{fed.port}")
+        merged = parse_prometheus_text(text)
+        for ep in eps:
+            assert merged[f'federation_target_up{{instance="{ep}"}}'] \
+                == 1
+        m2.stop()
+        fed.federation.scrape_once()
+        merged = parse_prometheus_text(
+            scrape_text(f"127.0.0.1:{fed.port}"))
+        assert merged[
+            f'federation_target_up{{instance="{eps[1]}"}}'] == 0
+        assert merged[
+            f'federation_target_up{{instance="{eps[0]}"}}'] == 1
+    finally:
+        fed.stop()
+        m1.stop()
+        from paddle_tpu.observability.server import stop_metrics_server
+        stop_metrics_server()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+def _hist_samples(name, cums, bounds=(1.0, 10.0, 100.0)):
+    out = {}
+    for b, c in zip(list(bounds) + ["+Inf"], cums):
+        out[f'{name}_bucket{{le="{b}"}}'] = c
+    return out
+
+
+def test_objective_burn_from_bucket_deltas():
+    from paddle_tpu.observability.slo import Objective
+
+    o = Objective("p99", hist="serve_e2e_ms", percentile=99,
+                  threshold_ms=100.0)
+    old = _hist_samples("serve_e2e_ms", (90, 95, 100, 100))
+    # delta: 100 new events, 5 past 100ms -> bad 5%, burn 5
+    new = _hist_samples("serve_e2e_ms", (180, 190, 195, 200))
+    assert o.bad_fraction(new, old) == pytest.approx(0.05)
+    assert o.burn_rate(new, old) == pytest.approx(5.0)
+    # counter reset: negative delta falls back to the new totals
+    shrunk = _hist_samples("serve_e2e_ms", (10, 10, 10, 10))
+    assert o.bad_fraction(shrunk, old) == pytest.approx(0.0)
+    # empty window: no signal, never a burn
+    assert o.burn_rate(new, new) is None
+
+
+def test_multi_window_evaluator_fake_clock():
+    from paddle_tpu.observability.slo import Objective, SLOEvaluator
+
+    o = Objective("err", numerator="serve_failed",
+                  denominator="serve_requests", max_ratio=0.01)
+    ev = SLOEvaluator([o], windows=((60.0, 10.0), (600.0, 2.0)),
+                      clock=lambda: 0.0, publish=False)
+    # long healthy history, then a short error spike: the fast window
+    # burns, the slow window absorbs it -> NOT burning (de-noised)
+    ev.add_snapshot({"serve_requests": 0, "serve_failed": 0}, t=0.0)
+    ev.add_snapshot({"serve_requests": 10000, "serve_failed": 0},
+                    t=540.0)
+    ev.add_snapshot({"serve_requests": 10100, "serve_failed": 30},
+                    t=610.0)
+    v = ev.evaluate()[0]
+    fast, slow = v.windows
+    assert fast["burn_rate"] > 10.0
+    assert slow["burn_rate"] < 2.0
+    assert not v.burning
+    # sustained burn: BOTH windows exceed -> burning
+    ev2 = SLOEvaluator([o], windows=((60.0, 10.0), (600.0, 2.0)),
+                       clock=lambda: 0.0, publish=False)
+    ev2.add_snapshot({"serve_requests": 0, "serve_failed": 0}, t=0.0)
+    ev2.add_snapshot({"serve_requests": 9000, "serve_failed": 4000},
+                     t=540.0)
+    ev2.add_snapshot({"serve_requests": 10000, "serve_failed": 4500},
+                     t=610.0)
+    assert ev2.evaluate()[0].burning
+
+
+def test_evaluator_publishes_verdict_gauges():
+    from paddle_tpu.observability.metrics import default_registry
+    from paddle_tpu.observability.slo import Objective, SLOEvaluator
+
+    o = Objective("pub_err", numerator="decode_failed",
+                  denominator="decode_requests", max_ratio=0.01)
+    ev = SLOEvaluator([o], windows=((60.0, 1.0),), clock=lambda: 0.0)
+    ev.add_snapshot({"decode_requests": 100, "decode_failed": 50},
+                    t=0.0)
+    reg = default_registry()
+    before = reg.flat_snapshot().get("slo_breaches", 0)
+    verdicts = ev.evaluate()
+    assert [v.objective for v in verdicts if v.burning] == ["pub_err"]
+    # burning() is a read: it must not re-publish/re-count the breach
+    assert ev.burning() == ["pub_err"]
+    assert reg.flat_snapshot().get("slo_breaches", 0) - before == 1
+    assert reg.get("slo_burning").value(objective="pub_err") == 1
+    assert reg.get("slo_burn_rate") \
+        .value(objective="pub_err", window="60s") == pytest.approx(50.0)
+
+
+# ---------------------------------------------------------------------------
+# CLIs: slo_check + trace_view
+# ---------------------------------------------------------------------------
+def _run_cli(args):
+    return subprocess.run([sys.executable] + args, cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_slo_check_cli_exit_codes(tmp_path):
+    healthy = tmp_path / "healthy.txt"
+    healthy.write_text(
+        "\n".join(f'decode_e2e_ms_bucket{{le="{b}"}} {c}'
+                  for b, c in (("100", 99), ("2500", 100),
+                               ("+Inf", 100)))
+        + "\ndecode_requests 100\ndecode_failed 0\n"
+        + "serve_requests 10\nserve_failed 0\n")
+    burned = tmp_path / "burned.txt"
+    burned.write_text(
+        "\n".join(f'decode_e2e_ms_bucket{{le="{b}"}} {c}'
+                  for b, c in (("100", 1), ("2500", 5), ("+Inf", 100)))
+        + "\ndecode_requests 100\ndecode_failed 0\n")
+    r = _run_cli(["tools/slo_check.py", "--metrics", str(healthy)])
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "BURNING" not in r.stdout
+    r = _run_cli(["tools/slo_check.py", "--metrics", str(burned)])
+    assert r.returncode == 1, r.stderr + r.stdout
+    assert "decode_e2e_p99" in r.stdout and "BURNING" in r.stdout
+    r = _run_cli(["tools/slo_check.py", "--metrics", str(burned),
+                  "--json"])
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert "decode_e2e_p99" in doc["burning"]
+    r = _run_cli(["tools/slo_check.py", "--metrics",
+                  str(tmp_path / "missing.txt")])
+    assert r.returncode == 2
+
+
+def test_trace_view_cli_tree_and_refusal(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    enable_step_trace(path)
+    clk = [0.0]
+
+    def clock():
+        clk[0] += 0.1
+        return clk[0]
+
+    try:
+        slow = tracing.Span("decode.request", parent=False, clock=clock)
+        q = tracing.Span("decode.queue", parent=slow, clock=clock)
+        q.end()
+        p = tracing.Span("decode.prefill", parent=slow, clock=clock)
+        p.event("preempted", slot=0)
+        p.end()
+        tick = tracing.Span(
+            "decode.tick", parent=False, clock=clock,
+            requests=[format(slow.trace_id, "016x")])
+        tick.end()
+        slow.end()
+        fast = tracing.Span("decode.request", parent=False)
+        fast.end()
+    finally:
+        disable_step_trace()
+    tid = format(slow.trace_id, "016x")
+    r = _run_cli(["tools/trace_view.py", path, "--slowest", "1"])
+    assert r.returncode == 0, r.stderr
+    assert tid in r.stdout      # the slowest root is the slow trace
+    r = _run_cli(["tools/trace_view.py", path, "--trace", tid])
+    assert r.returncode == 0, r.stderr
+    assert "decode.prefill" in r.stdout
+    assert "preempted" in r.stdout
+    assert "critical path" in r.stdout
+    assert "decode.tick" in r.stdout    # referenced batch tick folded in
+    # unknown schema: refuse with exit 2, like perf_report
+    bad = tmp_path / "future.jsonl"
+    bad.write_text(json.dumps({"schema": 99, "kind": "span",
+                               "trace": "x", "span": "y"}) + "\n")
+    r = _run_cli(["tools/trace_view.py", str(bad)])
+    assert r.returncode == 2
+    assert "unknown step-trace schema" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# flight recorder names stranded requests
+# ---------------------------------------------------------------------------
+def test_flight_dump_names_inflight_requests(tmp_path):
+    from paddle_tpu.observability.flight_recorder import FlightRecorder
+
+    fr = FlightRecorder(capacity=8, dir=str(tmp_path))
+    sp = tracing.Span("decode.request", parent=False, root=True)
+    tid = format(sp.trace_id, "016x")
+    try:
+        path = fr.note_error(RuntimeError("chaos kill"),
+                             where="decode.step")
+        dump = json.load(open(path))
+        stranded = {s["trace"]: s for s in dump["inflight_requests"]}
+        assert tid in stranded
+        assert stranded[tid]["name"] == "decode.request"
+        assert stranded[tid]["span"] == format(sp.span_id, "016x")
+    finally:
+        sp.end()
+    # after the request resolves, a new dump no longer strands it
+    # (other suite tests' genuinely-stranded requests may remain)
+    dump = json.load(open(fr.dump(reason="after")))
+    assert tid not in {s["trace"] for s in dump["inflight_requests"]}
+
+
+# ---------------------------------------------------------------------------
+# load_gen stamps trace ids
+# ---------------------------------------------------------------------------
+def test_decode_load_gen_reports_slowest_traces(sink):
+    from paddle_tpu.inference.decode import (DecodeEngine,
+                                             DecodeModelConfig)
+    from tools.load_gen import DecodeLoadGen
+
+    cfg = DecodeModelConfig(vocab_size=32, n_layers=1, n_heads=2,
+                            head_dim=8, ffn_dim=16, max_context=32)
+    eng = DecodeEngine(cfg, seed=3, max_batch=2, n_pages=16, page_size=4,
+                       max_pages_per_seq=8)
+    eng.warm()
+    eng.start()
+    try:
+        summary = DecodeLoadGen(eng, total_requests=4, workers=2,
+                                prompt_lens=(2, 3), output_lens=(2,),
+                                timeout_s=60).run()
+    finally:
+        eng.drain(timeout=30)
+    assert summary["ok"] == 4
+    slowest = summary["slowest_traces"]
+    assert slowest and len(slowest[0]["trace_id"]) == 16
+    assert slowest == sorted(slowest, key=lambda r: -r["ms"])
+    # the reported ids resolve to real span trees in the JSONL
+    traces = {r["trace"] for r in _spans(sink)}
+    for row in slowest:
+        assert row["trace_id"] in traces

@@ -38,9 +38,20 @@ import itertools
 import json
 import threading
 import time
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from paddle_tpu.observability import tracing
+
+
+def _slowest_traces(rows: List[Tuple[float, Optional[str]]],
+                    n: int = 5) -> List[dict]:
+    """Top-N slowest requests as ``{"trace_id", "ms"}`` rows — the
+    bridge from a bad client p99 to ``trace_view --trace <id>``."""
+    ranked = sorted((r for r in rows if r[1]), key=lambda r: -r[0])
+    return [{"trace_id": t, "ms": round(ms, 3)}
+            for ms, t in ranked[:n]]
 
 
 def default_feed_maker(predictor) -> Callable[[int, int], Dict[str, np.ndarray]]:
@@ -99,6 +110,7 @@ class LoadGen:
                 outcomes[kind] += 1
 
         client_lat_ms = []
+        traced: List[Tuple[float, Optional[str]]] = []
 
         def worker():
             while True:
@@ -108,11 +120,19 @@ class LoadGen:
                 feed = self.make_feed(self.sizes[i % len(self.sizes)], i)
                 t0 = time.perf_counter()
                 try:
-                    self.engine.infer(feed, deadline_s=self.deadline_s,
-                                      timeout=self.timeout_s)
+                    # client-side root span: the engine's serve.request
+                    # span parents under it, so the trace id reported
+                    # next to a bad client p99 names the WHOLE tree
+                    with tracing.span("loadgen.request", parent=False,
+                                      request_index=i) as sp:
+                        self.engine.infer(feed,
+                                          deadline_s=self.deadline_s,
+                                          timeout=self.timeout_s)
                     dt_ms = (time.perf_counter() - t0) * 1e3
                     with lock:
                         client_lat_ms.append(dt_ms)
+                        traced.append((dt_ms,
+                                       format(sp.trace_id, "016x")))
                     record("ok")
                 except Overloaded:
                     record("shed")
@@ -170,6 +190,9 @@ class LoadGen:
             "engine_p99_ms": eng["e2e_p99_ms"],
             "queue_wait_p50_ms": eng["queue_wait_p50_ms"],
             "queue_wait_p99_ms": eng["queue_wait_p99_ms"],
+            # the tail, NAMED: a bad client_p99 is one
+            # `trace_view --trace <id>` away from its span tree
+            "slowest_traces": _slowest_traces(traced),
             **outcomes,
         }
         return self.summary
@@ -224,6 +247,7 @@ class DecodeLoadGen:
         ttft_ms: list = []
         itl_ms: list = []
         tokens_out = [0]
+        traced: List[Tuple[float, Optional[str]]] = []
 
         def record(kind: str):
             with lock:
@@ -236,11 +260,19 @@ class DecodeLoadGen:
                     return
                 prompt = self._make_prompt(i)
                 out_n = self.output_lens[i % len(self.output_lens)]
+                t0 = time.perf_counter()
                 try:
-                    h = self.engine.submit(prompt, max_new_tokens=out_n,
-                                           deadline_s=self.deadline_s)
-                    toks = h.result(self.timeout_s)
+                    # client root span: the engine's decode.request
+                    # parents under it — the trace id reported in
+                    # slowest_traces names the full tree
+                    with tracing.span("loadgen.decode", parent=False,
+                                      request_index=i) as sp:
+                        h = self.engine.submit(
+                            prompt, max_new_tokens=out_n,
+                            deadline_s=self.deadline_s)
+                        toks = h.result(self.timeout_s)
                     st = h.stats()
+                    dt_ms = (time.perf_counter() - t0) * 1e3
                     with lock:
                         if self.keep_outputs:
                             self.outputs[i] = list(toks)
@@ -251,6 +283,8 @@ class DecodeLoadGen:
                         itl_ms.extend(
                             (b - a) * 1e3
                             for a, b in zip(times, times[1:]))
+                        traced.append((dt_ms,
+                                       format(sp.trace_id, "016x")))
                     record("ok")
                 except Overloaded:
                     record("shed")
@@ -303,6 +337,9 @@ class DecodeLoadGen:
             "engine_p99_ms": eng["e2e_p99_ms"],
             "step_p50_ms": eng["step_p50_ms"],
             "step_p99_ms": eng["step_p99_ms"],
+            # the tail, NAMED: the worst requests' trace ids next to
+            # the client p99 (`trace_view --trace <id>`)
+            "slowest_traces": _slowest_traces(traced),
             **outcomes,
         }
         return self.summary

@@ -25,7 +25,6 @@ deltas between polls, interpolated exactly like
 from __future__ import annotations
 
 import argparse
-import http.client
 import os
 import re
 import sys
@@ -145,19 +144,14 @@ def format_percentile_table(rows: Dict[str, dict],
 
 def scrape(endpoint: str, timeout: float = 5.0) -> Dict[str, float]:
     """One GET /metrics -> {sample_key: value} (histogram buckets keep
-    their ``name_bucket{le="..."}`` keys)."""
-    host, _, port = endpoint.replace("http://", "").rpartition(":")
-    conn = http.client.HTTPConnection(host or "127.0.0.1", int(port),
-                                      timeout=timeout)
-    try:
-        conn.request("GET", "/metrics")
-        resp = conn.getresponse()
-        body = resp.read().decode("utf-8", "replace")
-        if resp.status != 200:
-            raise RuntimeError(f"GET /metrics -> HTTP {resp.status}")
-        return parse_prometheus_text(body)
-    finally:
-        conn.close()
+    their ``name_bucket{le="..."}`` keys). Delegates the HTTP leg to
+    the ONE scraper the federation layer owns — endpoint parsing and
+    status handling must not fork between the tools and the library.
+    A non-200/dead endpoint raises ConnectionError (an OSError, which
+    every existing caller already catches)."""
+    from paddle_tpu.observability.federation import scrape_text
+
+    return parse_prometheus_text(scrape_text(endpoint, timeout=timeout))
 
 
 def watch(endpoint: str, interval: float = 2.0, count: int = 0,

@@ -35,11 +35,8 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from paddle_tpu.observability.step_trace import (  # noqa: E402
-    SCHEMA_VERSION,
+    UnknownTraceSchema, read_trace_records,
 )
-
-# schema 1 = PR 9 records (no "schema" field); see step_trace.py
-SUPPORTED_SCHEMAS = frozenset(range(1, SCHEMA_VERSION + 1))
 
 
 class PerfReportError(Exception):
@@ -51,32 +48,19 @@ class PerfReportError(Exception):
 # ---------------------------------------------------------------------------
 def load_trace(path: str) -> Tuple[List[dict], List[dict]]:
     """Parse one step-trace JSONL file into (step records, cost
-    records). Raises PerfReportError on an unknown ``schema`` version —
-    a reader silently misparsing a future format is how perf
-    regressions hide."""
-    steps: List[dict] = []
-    costs: List[dict] = []
+    records) through the shared schema-gated loader
+    (``step_trace.read_trace_records``). Raises PerfReportError on an
+    unknown ``schema`` version — a reader silently misparsing a future
+    format is how perf regressions hide."""
     try:
-        with open(path) as fh:
-            lines = fh.readlines()
+        records = read_trace_records(path, reader="tools/perf_report.py")
+    except UnknownTraceSchema as e:
+        raise PerfReportError(str(e))
     except OSError as e:
         raise PerfReportError(f"cannot read trace {path!r}: {e}")
-    for lineno, line in enumerate(lines, 1):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue  # torn tail line from a crashed writer
-        schema = rec.get("schema", 1)
-        if schema not in SUPPORTED_SCHEMAS:
-            raise PerfReportError(
-                f"{path}:{lineno}: unknown step-trace schema {schema!r} "
-                f"(this tool supports {sorted(SUPPORTED_SCHEMAS)}); "
-                "regenerate the trace with this repo or upgrade "
-                "tools/perf_report.py — schema history is documented in "
-                "MIGRATION.md")
+    steps: List[dict] = []
+    costs: List[dict] = []
+    for rec in records:
         if rec.get("kind") == "cost":
             costs.append(rec)
         elif rec.get("phases", {}).get("dispatch") is not None:

@@ -33,7 +33,10 @@ MODULES = [
     "paddle_tpu.nn.initializer",
     "paddle_tpu.observability",
     "paddle_tpu.observability.device_peaks",
+    "paddle_tpu.observability.federation",
     "paddle_tpu.observability.metrics",
+    "paddle_tpu.observability.slo",
+    "paddle_tpu.observability.tracing",
     "paddle_tpu.ops",
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",

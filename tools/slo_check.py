@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""SLO burn-rate check over a ``/metrics`` scrape — CI-able: exits
+non-zero when an objective burns.
+
+Usage::
+
+    python tools/slo_check.py --metrics 127.0.0.1:8321
+    python tools/slo_check.py --metrics scrape.txt          # saved scrape
+    python tools/slo_check.py --metrics new.txt --baseline old.txt \
+        --window-s 300
+    python tools/slo_check.py --metrics ... --objectives slo.json
+
+With one scrape, objectives evaluate over the CUMULATIVE totals (the
+window is "since process start"). With ``--baseline`` (an earlier
+scrape of the same process), they evaluate over the DELTA — the real
+burn-rate window; ``--window-s`` only labels it. Objectives default to
+:func:`paddle_tpu.observability.slo.default_objectives`; pass a JSON
+list (see ``objectives_from_json``) to declare your own. Works against
+a federated scrape too — pass ``--instance host:port`` to narrow to
+one member.
+
+Exit codes: 0 healthy, 1 burning (the CI signal), 2 input/usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.observability.metrics import (  # noqa: E402
+    parse_prometheus_text,
+)
+from paddle_tpu.observability.slo import (  # noqa: E402
+    SLOEvaluator, default_objectives, objectives_from_json,
+)
+
+
+def _load_samples(target: str):
+    if os.path.exists(target):
+        with open(target) as fh:
+            return parse_prometheus_text(fh.read())
+    from tools.metrics_watch import scrape
+
+    return scrape(target)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="evaluate SLO burn rates against a /metrics "
+                    "scrape; exit 1 on burn")
+    ap.add_argument("--metrics", required=True,
+                    help="host:port to scrape, or a saved scrape file")
+    ap.add_argument("--baseline", default=None,
+                    help="earlier scrape (host:port or file) — "
+                         "evaluate the delta instead of cumulative "
+                         "totals")
+    ap.add_argument("--objectives", default=None,
+                    help="JSON file declaring objectives (default: "
+                         "the stock fleet objectives)")
+    ap.add_argument("--window-s", type=float, default=3600.0,
+                    help="window label for the delta/cumulative "
+                         "evaluation (seconds)")
+    ap.add_argument("--burn-factor", type=float, default=1.0,
+                    help="burn-rate factor above which an objective "
+                         "burns (1.0 = budget-neutral pace)")
+    ap.add_argument("--instance", default=None,
+                    help="narrow a federated scrape to one member "
+                         "endpoint")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdicts as one JSON document")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.objectives:
+            with open(args.objectives) as fh:
+                objectives = objectives_from_json(fh.read())
+        else:
+            objectives = default_objectives()
+        if args.instance:
+            for o in objectives:
+                o.instance = args.instance
+        samples = _load_samples(args.metrics)
+        base = (_load_samples(args.baseline)
+                if args.baseline else None)
+    # TypeError: an --objectives row with a wrong/unknown field
+    # (Objective(**row)) — a usage error, which must NOT exit 1 and
+    # read as a burning SLO to CI
+    except (OSError, RuntimeError, TypeError, ValueError) as e:
+        print(f"slo_check: {e}", file=sys.stderr)
+        return 2
+    if not samples:
+        print(f"slo_check: no samples in {args.metrics!r}",
+              file=sys.stderr)
+        return 2
+
+    ev = SLOEvaluator(objectives,
+                      windows=((args.window_s, args.burn_factor),),
+                      clock=lambda: float(args.window_s) * 2)
+    if base is not None:
+        ev.add_snapshot(base, t=0.0)
+    # the newest snapshot lands just inside the window; with a baseline
+    # it predates the window edge, so the delta is baseline->now
+    ev.add_snapshot(samples, t=float(args.window_s) * 1.5)
+    verdicts = ev.evaluate()
+
+    burning = [v for v in verdicts if v.burning]
+    if args.json:
+        print(json.dumps({"burning": [v.objective for v in burning],
+                          "verdicts": [v.to_dict() for v in verdicts]},
+                         indent=2))
+    else:
+        for v in verdicts:
+            rates = ", ".join(
+                f"{int(w['window_s'])}s: "
+                + (f"{w['burn_rate']:.3f}" if w["burn_rate"] is not None
+                   else "no-signal")
+                for w in v.windows)
+            flag = "BURNING" if v.burning else "ok"
+            print(f"{v.objective:<24}{flag:<9}{rates}")
+    if burning:
+        print(f"slo_check: {len(burning)} objective(s) burning: "
+              + ", ".join(v.objective for v in burning),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

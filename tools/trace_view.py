@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Span-tree viewer over step-trace JSONL: render the distributed
+traces the observability plane emits as ``kind="span"`` records
+(schema v3 — observability/tracing.py).
+
+Usage::
+
+    python tools/trace_view.py trace.jsonl                 # trace index
+    python tools/trace_view.py trace.jsonl --slowest 5     # slowest roots
+    python tools/trace_view.py trace.jsonl --trace <hexid> # one tree
+
+The tree view shows every span of the trace with parent indentation,
+monotonic offsets, durations, typed status, events (e.g. a decode
+preemption), and the **critical path** — the chain of child spans that
+ends latest at every level, i.e. where the time actually went.
+Per-tick decode spans reference their member requests by trace id
+(``attrs.requests``); the tree view folds ticks that reference the
+requested trace in.
+
+Refuses unknown ``schema`` versions like tools/perf_report.py (history
+in MIGRATION.md). Exit codes: 0 ok, 1 empty/unreadable/not-found,
+2 unknown schema.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.observability.step_trace import (  # noqa: E402
+    UnknownTraceSchema, read_trace_records,
+)
+
+
+class TraceViewError(Exception):
+    """Typed failure: unreadable trace or unknown schema version."""
+
+
+def load_spans(path: str) -> List[dict]:
+    """``kind="span"`` records from one step-trace JSONL file, through
+    the shared schema-gated loader (``step_trace.read_trace_records``).
+    Raises TraceViewError on an unknown schema version — misparsing a
+    future format would silently draw wrong trees."""
+    try:
+        records = read_trace_records(path, reader="tools/trace_view.py")
+    except UnknownTraceSchema as e:
+        raise TraceViewError(str(e))
+    except OSError as e:
+        raise TraceViewError(f"cannot read trace {path!r}: {e}")
+    return [rec for rec in records if rec.get("kind") == "span"]
+
+
+def group_traces(spans: List[dict]) -> Dict[str, List[dict]]:
+    """{trace_id: spans} — ticks/batch spans that only REFERENCE a
+    trace (attrs.requests) are folded into every trace they name."""
+    out: Dict[str, List[dict]] = {}
+    for s in spans:
+        tid = s.get("trace")
+        if tid:
+            out.setdefault(tid, []).append(s)
+        for ref in (s.get("attrs", {}) or {}).get("requests", ()) or ():
+            if ref and ref != tid:
+                out.setdefault(ref, []).append(s)
+    return out
+
+
+def _roots(spans: List[dict], trace_id: str) -> List[dict]:
+    ids = {s["span"] for s in spans if s.get("trace") == trace_id}
+    return [s for s in spans
+            if s.get("trace") == trace_id
+            and (not s.get("parent") or s["parent"] not in ids)]
+
+
+def _children_index(spans: List[dict]) -> Dict[str, List[dict]]:
+    idx: Dict[str, List[dict]] = {}
+    for s in spans:
+        if s.get("parent"):
+            idx.setdefault(s["parent"], []).append(s)
+    for kids in idx.values():
+        kids.sort(key=lambda s: s.get("t0", 0.0))
+    return idx
+
+
+def critical_path(root: dict,
+                  children: Dict[str, List[dict]]) -> List[dict]:
+    """Chain from the root through, at each level, the child that ENDS
+    latest — the spans that actually bound the root's duration."""
+    path = [root]
+    node = root
+    seen = {root["span"]}
+    while True:
+        kids = [k for k in children.get(node["span"], ())
+                if k["span"] not in seen]
+        if not kids:
+            return path
+        node = max(kids, key=lambda s: s.get("t0", 0.0)
+                   + s.get("dur_ms", 0.0) / 1e3)
+        seen.add(node["span"])
+        path.append(node)
+
+
+def _fmt_span(s: dict, t_base: float, depth: int,
+              referenced: bool = False) -> str:
+    off_ms = (s.get("t0", t_base) - t_base) * 1e3
+    status = s.get("status", "?")
+    mark = "~" if referenced else ("!" if status != "ok" else " ")
+    line = (f"{mark} {'  ' * depth}{s.get('name', '?'):<{28 - 2 * min(depth, 8)}}"
+            f"+{off_ms:>9.3f}ms  {s.get('dur_ms', 0.0):>9.3f}ms"
+            f"  {status}")
+    evs = s.get("events") or []
+    for ev in evs:
+        line += (f"\n  {'  ' * depth}  * {ev.get('name', '?')} "
+                 f"@+{ev.get('t_ms', 0.0):.3f}ms "
+                 + " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                            if k not in ("name", "t_ms")))
+    return line
+
+
+def render_trace(trace_id: str, spans: List[dict]) -> str:
+    own = [s for s in spans if s.get("trace") == trace_id]
+    refs = [s for s in spans if s.get("trace") != trace_id]
+    if not own and not refs:
+        raise TraceViewError(f"trace {trace_id!r} not found")
+    lines = [f"== trace {trace_id} =="]
+    t_base = min(s.get("t0", 0.0) for s in own + refs)
+    children = _children_index(own)
+    printed = set()
+
+    def walk(s: dict, depth: int):
+        lines.append(_fmt_span(s, t_base, depth))
+        printed.add(s["span"])
+        for kid in children.get(s["span"], ()):
+            walk(kid, depth + 1)
+
+    roots = _roots(own, trace_id)
+    for root in sorted(roots, key=lambda s: s.get("t0", 0.0)):
+        walk(root, 0)
+    # spans of this trace whose parent never landed in the file (e.g.
+    # a remote caller's span on the other side of the wire)
+    for s in sorted(own, key=lambda x: x.get("t0", 0.0)):
+        if s["span"] not in printed:
+            lines.append(_fmt_span(s, t_base, 1))
+    if refs:
+        lines.append("-- referencing spans (batched ticks naming this "
+                     "trace) --")
+        for s in sorted(refs, key=lambda x: x.get("t0", 0.0)):
+            lines.append(_fmt_span(s, t_base, 1, referenced=True))
+    if roots:
+        main = max(roots, key=lambda s: s.get("dur_ms", 0.0))
+        path = critical_path(main, children)
+        lines.append("-- critical path --")
+        total = main.get("dur_ms", 0.0) or 1.0
+        for s in path:
+            pct = 100.0 * s.get("dur_ms", 0.0) / total
+            lines.append(f"  {s.get('name', '?'):<28}"
+                         f"{s.get('dur_ms', 0.0):>9.3f}ms  {pct:>5.1f}%"
+                         f"  {s.get('status', '?')}")
+    return "\n".join(lines) + "\n"
+
+
+def _is_batch_span(s: dict) -> bool:
+    """Batch-level spans (decode ticks, serve dispatches) carry the
+    member request trace ids as ``attrs.requests`` — each one is its
+    own fresh trace by construction."""
+    return isinstance((s.get("attrs") or {}).get("requests"), list)
+
+
+def _trace_rows(traces: Dict[str, List[dict]]
+                ) -> Tuple[List[Tuple[str, dict, int]], int]:
+    """(rows, batch_only_count): one (trace_id, root span, span count)
+    row per REQUEST trace. Traces whose every span is a batch-level
+    tick/dispatch are counted, not listed — under load there is one
+    tick per compiled step and they would drown the request index
+    (they still render inside the traces they reference)."""
+    rows = []
+    batch_only = 0
+    for tid, spans in traces.items():
+        own = [s for s in spans if s.get("trace") == tid]
+        if not own:
+            continue
+        if all(_is_batch_span(s) for s in own):
+            batch_only += 1
+            continue
+        roots = _roots(own, tid)
+        root = max(roots or own, key=lambda s: s.get("dur_ms", 0.0))
+        rows.append((tid, root, len(own)))
+    return rows, batch_only
+
+
+def render_index(traces: Dict[str, List[dict]],
+                 slowest: Optional[int] = None) -> str:
+    rows, batch_only = _trace_rows(traces)
+    rows.sort(key=lambda r: r[1].get("dur_ms", 0.0), reverse=True)
+    title = (f"== slowest {slowest} traces ==" if slowest
+             else f"== {len(rows)} traces ==")
+    if slowest:
+        rows = rows[:slowest]
+    lines = [title,
+             f"{'trace':<18}{'root':<22}{'dur_ms':>10}{'spans':>7}"
+             f"  status"]
+    for tid, root, n in rows:
+        lines.append(f"{tid:<18}{root.get('name', '?'):<22}"
+                     f"{root.get('dur_ms', 0.0):>10.3f}{n:>7}"
+                     f"  {root.get('status', '?')}")
+    if batch_only:
+        lines.append(f"({batch_only} batch-level tick/dispatch spans "
+                     "not listed; they render inside the traces they "
+                     "reference)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="span-tree viewer over step-trace JSONL "
+                    "(kind=span records)")
+    ap.add_argument("trace_file", help="step-trace JSONL file")
+    ap.add_argument("--trace", default=None,
+                    help="render one trace id's span tree")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="list the N slowest traces by root duration")
+    args = ap.parse_args(argv)
+    try:
+        spans = load_spans(args.trace_file)
+        if not spans:
+            print(f"no span records in {args.trace_file} (enable "
+                  "PADDLE_STEP_TRACE and run traced work)",
+                  file=sys.stderr)
+            return 1
+        traces = group_traces(spans)
+        if args.trace:
+            sys.stdout.write(render_trace(args.trace,
+                                          traces.get(args.trace, [])))
+        else:
+            sys.stdout.write(render_index(traces,
+                                          slowest=args.slowest))
+    except TraceViewError as e:
+        print(f"trace_view: {e}", file=sys.stderr)
+        return 2 if "unknown step-trace schema" in str(e) else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
