@@ -771,6 +771,20 @@ def _shard_probe_main(n_devices=8, steps=3):
     bs_pp.gradient_merge_k = K
     bs_pp.pipeline_stages = S
     _pp_losses, _dt_pp, pc, _ = run(bs_pp)
+    # quantized-collective DP leg (ISSUE 15): pure-dp mesh, int8
+    # bucketed ring all-reduce vs the same mesh's XLA f32 leg — the
+    # loss delta is the accuracy gate, the byte counters the bandwidth
+    # win, the overlap fraction the schedule-structure contract
+    bs_dp = static.BuildStrategy()
+    bs_dp.mesh_shape = {"dp": n_devices}
+    dp_f32, _dt_dpf, _, _ = run(bs_dp)
+    bs_q = static.BuildStrategy()
+    bs_q.mesh_shape = {"dp": n_devices}
+    bs_q.comm_quant = "int8"
+    bs_q.comm_bucket_bytes = 1024
+    quant, dt_q, qc, _ = run(bs_q)
+    q_sent = int(qc.get("comm_quant_bytes_sent", 0))
+    q_saved = int(qc.get("comm_quant_bytes_saved", 0))
     tokens = B * steps
     print(json.dumps({
         "shard_tokens_per_sec": round(tokens / dt_shard, 2),
@@ -782,6 +796,15 @@ def _shard_probe_main(n_devices=8, steps=3):
         "pp_stages": int(pc.get("pp_stages", 0)),
         "pp_bubble_frac": round(gpipe_bubble_fraction(S, K), 4),
         "shard_devices": n_devices,
+        "quant_allreduce_tokens_per_sec": round(tokens / dt_q, 2),
+        "quant_loss_delta": max(
+            abs(a - b) for a, b in zip(dp_f32, quant)),
+        "comm_bytes_saved_pct": round(
+            100.0 * q_saved / (q_sent + q_saved), 2)
+        if (q_sent + q_saved) else 0.0,
+        "comm_buckets": int(qc.get("comm_buckets", 0)),
+        "allreduce_overlap_frac": float(
+            qc.get("allreduce_overlap_frac", 0.0)),
     }), flush=True)
 
 

@@ -50,6 +50,12 @@ SCALARS = {
     "shard_conflicts_replicated": ("counter", "spec conflicts resolved by replication"),
     "shard_psums_inserted": ("counter", "contracted/reduced sharded dims needing a psum (XLA SPMD materializes them)"),
     "pp_stages": ("gauge", "pipeline stages of the last pipelined build (GPipe schedule)"),
+    # quantized collectives (parallel/collectives.py + the executor's
+    # bucketed DP all-reduce step; PS wire codecs bump the same bytes)
+    "comm_quant_bytes_sent": ("counter", "encoded collective/PS wire bytes actually moved (per-device ring bytes for the DP all-reduce, payload bytes for PS push/pull)"),
+    "comm_quant_bytes_saved": ("counter", "f32 bytes the quantized codec avoided moving (f32 cost minus encoded cost)"),
+    "comm_buckets": ("gauge", "gradient buckets of the last quantized-collective build (completion-ordered)"),
+    "allreduce_overlap_frac": ("gauge", "analytic fraction of buckets whose all-reduce overlaps later work ((nb-1)/nb; 0 = single barrier-shaped reduce)"),
     "autotune_disk_hits": ("counter", "flash-attention autotune verdicts served from the persistent disk cache"),
     "xla_temp_bytes": ("gauge", "last built executable: XLA temp working set"),
     "xla_peak_bytes": ("gauge", "last built executable: arguments+outputs+temp bytes"),

@@ -15,3 +15,8 @@ from .ring import (  # noqa: F401
     ring_attention, ulysses_attention, ring_attention_local,
     ulysses_attention_local, sequence_parallel, active_sequence_parallel,
 )
+from .collectives import (  # noqa: F401
+    QUANT_BLOCK, allreduce_done, allreduce_start, bucketed_allreduce,
+    encoded_nbytes, np_decode, np_encode, quant_decode, quant_encode,
+    quantized_allreduce, ring_allreduce_local, ring_nbytes,
+)

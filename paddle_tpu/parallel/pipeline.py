@@ -121,8 +121,10 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
             jnp.where(s == n_stages - 1, outs, 0.0 * outs), axis)
         return outs
 
-    outs = jax.shard_map(local, mesh=mesh, in_specs=(p_spec, x_spec),
-                         out_specs=x_spec)(stage_params, xm)
+    from .collectives import shard_map_fn
+
+    outs = shard_map_fn()(local, mesh=mesh, in_specs=(p_spec, x_spec),
+                          out_specs=x_spec)(stage_params, xm)
     return outs.reshape(batch, *x.shape[1:])
 
 
@@ -409,7 +411,9 @@ def _build_1f1b_step(stage_fn, first_fn, last_fn, mesh, axis, mb, ba):
                 lambda a: jax.lax.pmean(a, ba), (gf, gb, gl))
         return loss, gf, gb, gl
 
-    sharded = jax.shard_map(
+    from .collectives import shard_map_fn
+
+    sharded = shard_map_fn()(
         local, mesh=mesh,
         in_specs=(repl_spec, blocks_spec, repl_spec, data_spec, data_spec),
         out_specs=(repl_spec, repl_spec, blocks_spec, repl_spec))

@@ -64,9 +64,17 @@ _SHARD_MAP_CHECK_VMA = [True]
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
-    kw = {} if _SHARD_MAP_CHECK_VMA[0] else {"check_vma": False}
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, **kw)
+    from .collectives import shard_map_fn
+
+    sm = shard_map_fn()  # jax.shard_map, or the pre-0.6 experimental home
+    if _SHARD_MAP_CHECK_VMA[0]:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:   # pre-vma jax spells it check_rep
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
 
 
 # ---------------------------------------------------------------------------

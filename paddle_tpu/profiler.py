@@ -202,6 +202,17 @@ ELASTIC_COUNTER_NAMES = (
 COMPILE_COUNTER_NAMES = ("disk_cache_hits", "disk_cache_misses",
                          "autotune_disk_hits")
 
+# quantized-collective counters (parallel/collectives.py encodings:
+# the executor's bucketed DP all-reduce step bumps per dispatch, the
+# PS client/replicator per quantized wire payload; merged into
+# Executor.counters like the fault slice). comm_buckets and
+# allreduce_overlap_frac are point-in-time gauges of the last
+# quantized-collective build.
+COMM_COUNTER_NAMES = (
+    "comm_quant_bytes_sent", "comm_quant_bytes_saved",
+    "comm_buckets", "allreduce_overlap_frac",
+)
+
 # parameter-server fault-tolerance counters (ps/replication.py replica
 # groups + ps/service.py hardened RPC), merged into Executor.counters
 # and the chaos drill's counter table

@@ -254,35 +254,57 @@ def wait_shard_map(kv, job: str, min_epoch: int = 1, timeout: float = 30.0,
 
 
 def publish_lease(kv, job: str, endpoint: str, ttl: float,
-                  clock: Callable[[], float] = time.time) -> float:
+                  clock: Callable[[], float] = time.time,
+                  token: Optional[str] = None) -> float:
     """Renew a server's liveness lease: stores the wall-clock expiry (the
     coordinator compares against ITS wall clock — same convention as the
-    elastic agent's worker leases)."""
+    elastic agent's worker leases). ``token`` is the server's PROCESS
+    INCARNATION (random per construction): a crashed primary whose
+    supervised relaunch republishes a fresh lease BEFORE the TTL sweep
+    notices the expiry gap would otherwise look continuously alive —
+    the coordinator sees the token change and promotes anyway, closing
+    the relaunch-vs-promotion race on the injectable clock instead of
+    widening wall sleeps."""
     expiry = clock() + float(ttl)
-    kv.put(_lease_key(job, endpoint), repr(expiry))
+    val = repr(expiry) if token is None else f"{expiry!r}:{token}"
+    kv.put(_lease_key(job, endpoint), val)
     return expiry
 
 
 def read_lease(kv, job: str, endpoint: str) -> Optional[float]:
+    return read_lease_token(kv, job, endpoint)[0]
+
+
+def read_lease_token(kv, job: str, endpoint: str):
+    """(expiry, incarnation_token) — token None for tokenless leases
+    (pre-incarnation writers keep working)."""
     raw = kv.get(_lease_key(job, endpoint))
+    if raw is None:
+        return None, None
+    s = raw.decode() if isinstance(raw, bytes) else str(raw)
+    expiry_s, _, token = s.partition(":")
     try:
-        return float(raw) if raw is not None else None
+        return float(expiry_s), (token or None)
     except ValueError:
-        return None
+        return None, None
 
 
 # ---------------------------------------------------------------------------
 # the delta log (catch-up replay source)
 # ---------------------------------------------------------------------------
-_DELTA_HDR = struct.Struct("<BIQIQfQQ")   # op table seq client cseq lr n vlen
+# op codec table seq client cseq lr n vlen — codec is the VALUE payload
+# encoding (ps/codec.py ids): a quantized client push forwards its RAW
+# ENCODED bytes, so every backup decodes the identical payload the
+# primary applied (bitwise replica parity under quantization)
+_DELTA_HDR = struct.Struct("<BBIQIQfQQ")
 
 
 class DeltaEntry:
     __slots__ = ("seq", "op", "table_id", "client", "client_seq", "lr",
-                 "ids", "vals")
+                 "ids", "vals", "codec")
 
     def __init__(self, seq, op, table_id, client, client_seq, lr, ids,
-                 vals):
+                 vals, codec: int = 0):
         self.seq = int(seq)
         self.op = int(op)
         self.table_id = int(table_id)
@@ -291,19 +313,48 @@ class DeltaEntry:
         self.lr = float(lr)
         self.ids = bytes(ids)
         self.vals = bytes(vals)
+        self.codec = int(codec)
+
+    def values(self, dim: Optional[int] = None) -> np.ndarray:
+        """The f32 values this entry applies (decoding ``vals`` per the
+        entry codec) — every replica applies THIS, never the raw bytes.
+        Pass the table ``dim`` when known (apply sites do); without it
+        the element count is inverted from the byte length (exact — the
+        elems→bytes map is strictly increasing)."""
+        from .codec import codec_name, np_decode
+
+        if not self.codec:
+            return np.frombuffer(self.vals, np.float32)
+        elems = ((len(self.ids) // 8) * int(dim) if dim
+                 else self._elems())
+        return np_decode(self.vals, elems, codec_name(self.codec))
+
+    def _elems(self) -> int:
+        from .codec import QUANT_BLOCK
+
+        if self.codec == 1:       # bf16: 2 bytes/elem
+            return len(self.vals) // 2
+        # int8: vlen = elems + 4 * nblocks, nblocks = ceil(elems/BLOCK)
+        # → invert exactly: try the candidate implied by vlen
+        vlen = len(self.vals)
+        est = vlen * QUANT_BLOCK // (QUANT_BLOCK + 4)
+        for cand in range(max(0, est - QUANT_BLOCK), est + QUANT_BLOCK + 1):
+            if cand + 4 * (-(-cand // QUANT_BLOCK)) == vlen:
+                return cand
+        raise ValueError(f"undecodable int8 delta payload ({vlen} bytes)")
 
     def encode(self) -> bytes:
         n = len(self.ids) // 8
-        return (_DELTA_HDR.pack(self.op, self.table_id, self.seq,
-                                self.client, self.client_seq, self.lr,
-                                n, len(self.vals))
+        return (_DELTA_HDR.pack(self.op, self.codec, self.table_id,
+                                self.seq, self.client, self.client_seq,
+                                self.lr, n, len(self.vals))
                 + self.ids + self.vals)
 
 
 def decode_deltas(raw: bytes) -> List[DeltaEntry]:
     out, off = [], 0
     while off < len(raw):
-        op, table_id, seq, client, cseq, lr, n, vlen = \
+        op, codec, table_id, seq, client, cseq, lr, n, vlen = \
             _DELTA_HDR.unpack_from(raw, off)
         off += _DELTA_HDR.size
         ids = raw[off:off + 8 * n]
@@ -311,7 +362,7 @@ def decode_deltas(raw: bytes) -> List[DeltaEntry]:
         vals = raw[off:off + vlen]
         off += vlen
         out.append(DeltaEntry(seq, op, table_id, client, cseq, lr, ids,
-                              vals))
+                              vals, codec))
     return out
 
 
@@ -393,7 +444,7 @@ class _RawPeer:
             w_trace, w_span = ctx.to_wire() if ctx is not None \
                 else (0, 0)
             s.sendall(_HDR.pack(op, table_id, n, lr, epoch, client, seq,
-                                dim, w_trace, w_span) + payload)
+                                dim, w_trace, w_span, 0) + payload)
             _read_reply(s, endpoint=self.endpoint)
             return reader(s) if reader is not None else None
         except PSReplyError:
@@ -748,6 +799,11 @@ class ReplicatedPSServer(PSServer):
         # new primary's forwards without applying them: silent
         # permanent divergence)
         self._state_suspect = False
+        # process incarnation, stamped into every lease renewal: a
+        # relaunch of this endpoint carries a fresh token, which is how
+        # the coordinator distinguishes "still alive" from "died and
+        # came back fast" (the promotion-race fix)
+        self._incarnation = os.urandom(8).hex()
 
     # -- properties ---------------------------------------------------------
     @property
@@ -813,7 +869,8 @@ class ReplicatedPSServer(PSServer):
     def _publish_lease(self) -> None:
         try:
             publish_lease(self._kv, self.job, self.advertise,
-                          self._lease_ttl, clock=self._clock)
+                          self._lease_ttl, clock=self._clock,
+                          token=self._incarnation)
         except (ConnectionError, OSError, RuntimeError):
             pass   # KV briefly down: next renewal retries
 
@@ -823,6 +880,59 @@ class ReplicatedPSServer(PSServer):
             if self._stop.is_set():
                 return
             self._publish_lease()
+            # role refresh rides the renewal beat (role_ttl-paced
+            # inside): without it a demoted primary that receives NO
+            # traffic — e.g. a crash-relaunch that resumed serving a
+            # heartbeat before the coordinator's promotion landed —
+            # would zombie at the old epoch forever, since every other
+            # refresh path is request-driven
+            try:
+                self.refresh_role()
+            except Exception:  # noqa: BLE001 (KV blip: next beat retries)
+                pass
+            try:
+                self._anti_entropy_check()
+            except Exception:  # noqa: BLE001 (next beat retries)
+                pass
+
+    def _anti_entropy_check(self) -> None:
+        """Backup-side idle-divergence repair, role_ttl-paced on the
+        lease beat: compare our applied seq with the primary's and
+        schedule a catch-up when behind. The forward path alone cannot
+        close this — a backup that was down-listed by the primary's
+        replicator during its own resync misses the tail forwards, and
+        with no further traffic there is no gap-reject left to trigger
+        the heal (the "last writes before idle" divergence window)."""
+        if self._role != "backup" or self._catchup_running.is_set():
+            return
+        now = self._clock()
+        last = getattr(self, "_last_entropy_check", -1e18)
+        if now - last < self._role_ttl:
+            return
+        self._last_entropy_check = now
+        m = fetch_shard_map(self._kv, self.job)
+        if m is None:
+            return
+        _role, shard = m.role_of(self.advertise)
+        if shard < 0:
+            return
+        primary = m.groups[shard][0]
+        if primary == self.advertise:
+            return
+        # this probe runs ON the lease-renewal thread: bound it well
+        # under the TTL, or a hung (not crashed) primary — SIGSTOP,
+        # black-holed network — would stall our OWN renewals past
+        # expiry and cascade a false promotion over a healthy backup
+        t = max(0.2, self._lease_ttl / 6.0)
+        peer = _RawPeer(primary, timeout=t, connect_timeout=t)
+        try:
+            pseq, _ = peer.seq_epoch()
+        except (ConnectionError, OSError, PSReplyError):
+            return
+        finally:
+            peer.close()
+        if pseq > self.seq or self._state_suspect:
+            self._schedule_catch_up()
 
     # -- role management ----------------------------------------------------
     def refresh_role(self, force: bool = False) -> None:
@@ -904,7 +1014,8 @@ class ReplicatedPSServer(PSServer):
     # -- the write path -----------------------------------------------------
     def _apply_write(self, base_op: int, table: SparseTable, table_id: int,
                      ids: np.ndarray, vals: np.ndarray, lr: float,
-                     client: int, cseq: int, forwarded: bool) -> None:
+                     client: int, cseq: int, forwarded: bool,
+                     codec: int = 0, raw=None) -> None:
         with self._repl_lock:
             if client and cseq and self._applied.get(client, 0) >= cseq:
                 return           # failover replay of an applied write
@@ -914,8 +1025,15 @@ class ReplicatedPSServer(PSServer):
             if client and cseq:
                 self._applied[client] = cseq
             self.seq += 1
-            entry = DeltaEntry(self.seq, base_op, table_id, client, cseq,
-                               lr, ids.tobytes(), vals.tobytes())
+            # a quantized push logs/forwards its RAW ENCODED payload:
+            # backups decode the identical bytes the primary applied,
+            # so replica digests stay bitwise equal under quantization
+            # (and the delta log holds the true wire-sized entry)
+            entry = DeltaEntry(
+                self.seq, base_op, table_id, client, cseq, lr,
+                ids.tobytes(),
+                raw if (codec and raw is not None) else vals.tobytes(),
+                codec if raw is not None else 0)
             self._dlog.append(entry)
             if not forwarded and self._replicator is not None:
                 # forward the encoded delta entry: it carries THIS
@@ -929,7 +1047,8 @@ class ReplicatedPSServer(PSServer):
                 # so the replication forward links the backup's apply
                 # into the same trace
                 frame = _HDR.pack(OP_REPL_APPLY, 0, len(blob), 0.0,
-                                  self._epoch, 0, 0, 0, _wt, _ws) + blob
+                                  self._epoch, 0, 0, 0, _wt, _ws,
+                                  0) + blob
                 try:
                     self._replicator.forward(frame)
                 except _StalePeerEpoch as e:
@@ -1072,7 +1191,7 @@ class ReplicatedPSServer(PSServer):
                 PSServer._apply_write(
                     self, entry.op, table, entry.table_id,
                     np.frombuffer(entry.ids, np.int64),
-                    np.frombuffer(entry.vals, np.float32), entry.lr,
+                    entry.values(table.dim), entry.lr,
                     entry.client, entry.client_seq, True)
                 if entry.client and entry.client_seq:
                     self._applied[entry.client] = max(
@@ -1188,7 +1307,7 @@ class ReplicatedPSServer(PSServer):
                     self.seq = e.seq
                     continue
                 ids = np.frombuffer(e.ids, np.int64)
-                vals = np.frombuffer(e.vals, np.float32)
+                vals = e.values(table.dim)
                 PSServer._apply_write(self, e.op, table, e.table_id, ids,
                                       vals, e.lr, e.client, e.client_seq,
                                       True)
@@ -1338,6 +1457,10 @@ class ReplicaCoordinator:
         self._boot_deadline = clock() + self._boot_grace
         self._on_promote = on_promote
         self._seen_lease: set = set()
+        # endpoint -> last seen lease incarnation token: a PRIMARY
+        # whose token changes died and relaunched between sweeps — it
+        # must be promoted over even though its (fresh) lease is live
+        self._tokens: Dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.promotions = 0
@@ -1369,13 +1492,26 @@ class ReplicaCoordinator:
         return {ep: read_lease(self._kv, self.job, ep)
                 for ep in m.endpoints()}
 
-    def _alive(self, ep: str, now: float) -> bool:
-        expiry = read_lease(self._kv, self.job, ep)
+    def _alive(self, ep: str, now: float,
+               track_incarnation: bool = False) -> bool:
+        expiry, token = read_lease_token(self._kv, self.job, ep)
         if expiry is None:
             # no lease yet: grant boot grace, then treat as dead — a
             # server that never came up is as gone as a crashed one
             return ep not in self._seen_lease and now < self._boot_deadline
         self._seen_lease.add(ep)
+        relaunched = False
+        if token is not None:
+            prev = self._tokens.get(ep)
+            relaunched = prev is not None and prev != token
+            self._tokens[ep] = token
+        if track_incarnation and relaunched:
+            # the endpoint died and came back between sweeps: its fresh
+            # lease must NOT read as continuity — for a primary this is
+            # exactly the relaunch-beats-the-TTL-sweep race, and the
+            # correct answer is a promotion (the relaunch rejoins as a
+            # backup, per the group contract)
+            return False
         return expiry > now
 
     # -- the sweep ----------------------------------------------------------
@@ -1388,7 +1524,7 @@ class ReplicaCoordinator:
         promoted: List[int] = []
         new_groups = [list(g) for g in m.groups]
         for k, group in enumerate(m.groups):
-            if self._alive(group[0], now):
+            if self._alive(group[0], now, track_incarnation=True):
                 continue
             live_backup = next((ep for ep in group[1:]
                                 if self._alive(ep, now)), None)

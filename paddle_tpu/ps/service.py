@@ -11,13 +11,25 @@ hash-sharded across server endpoints by the client exactly like the
 reference splits parameter blocks across pservers, and each connection
 gets a server thread (the listen_and_serv thread-per-handler model).
 
-Wire format v2 (fault-tolerant revision; trace-context extension)::
+Wire format v2 (fault-tolerant revision; trace-context + codec
+extensions)::
 
     request  = [op:u8][table:u32][n:u64][lr:f32]
                [epoch:u32][client:u32][seq:u64][dim:u32]
-               [trace:u64][span:u64]                       + payload
+               [trace:u64][span:u64][codec:u8]             + payload
     reply    = [0x01] + payload                            (OK)
              | [0x00][code:u8][srv_epoch:u32][len:u32][msg]  (typed error)
+
+``codec`` selects the VALUE payload encoding for PULL/PUSH (ps/codec.py:
+0 = f32, 1 = bf16, 2 = blocked-scaled int8 — the same encodings the
+quantized all-reduce uses): a quantized push carries
+``encoded_nbytes(n*dim, codec)`` value bytes which the primary decodes
+to f32 before applying, and a pull request asks the server to encode
+its reply the same way. The RAW ENCODED bytes ride the replication
+stream (DeltaEntry carries the codec), so primary and every backup
+decode identical bytes — replica digests stay bitwise equal under
+quantization. MERGE/ASSIGN/admin traffic is always codec 0 (an ASSIGN
+is a raw overwrite — quantizing it would corrupt catch-up state).
 
 ``trace``/``span`` are the caller's compact trace context
 (observability/tracing.py — zero = untraced): when set, the server
@@ -76,6 +88,8 @@ from ..fault.retry import Backoff, Retrier, env_backoff, env_max_attempts
 from ..observability import tracing
 from ..observability.flight_recorder import note_typed_error
 from ..observability.metrics import default_registry as _obs_registry
+from .codec import CODEC_IDS, codec_name, encoded_nbytes, np_decode, \
+    np_encode
 
 _RPC_HIST = None
 
@@ -96,9 +110,10 @@ from .table import SparseTable
 
 _MAX_OP = OP_REPL_APPLY
 
-# op table n lr epoch client seq dim trace span — trace/span are the
-# caller's compact trace context (0 = untraced; tracing.SpanContext)
-_HDR = struct.Struct("<BIQfIIQIQQ")
+# op table n lr epoch client seq dim trace span codec — trace/span are
+# the caller's compact trace context (0 = untraced; tracing.SpanContext),
+# codec the value-payload encoding (ps/codec.py ids; 0 = plain f32)
+_HDR = struct.Struct("<BIQfIIQIQQB")
 _ERR_HDR = struct.Struct("<BII")    # code srv_epoch msg_len
 
 _OP_NAMES = {
@@ -291,13 +306,17 @@ class PSServer:
 
     def _apply_write(self, base_op: int, table: SparseTable, table_id: int,
                      ids: np.ndarray, vals: np.ndarray, lr: float,
-                     client: int, cseq: int, forwarded: bool) -> None:
+                     client: int, cseq: int, forwarded: bool,
+                     codec: int = 0, raw: Optional[bytes] = None) -> None:
         """Apply one write, exactly once per (client, seq): the client's
         retry loop replays a frame whose ack was lost (connection died
         between apply and reply), and without dedup a plain server would
         double-apply the gradient. The replicated subclass wraps this
         with sequence numbering, the delta log, and primary→backup
-        forwarding (its own dedup runs under the replication lock)."""
+        forwarding (its own dedup runs under the replication lock).
+        ``codec``/``raw`` carry a quantized push's wire encoding so the
+        replicated subclass can forward the ENCODED bytes — backups
+        decode the same payload the primary did, bitwise."""
         if client and cseq:
             with self._applied_lock:
                 if self._applied.get(client, 0) >= cseq:
@@ -336,11 +355,12 @@ class PSServer:
             while not self._stop.is_set():
                 hdr = _recv_exact(conn, _HDR.size)
                 (op, table_id, n, lr, epoch, client, seq, dim,
-                 w_trace, w_span) = _HDR.unpack(hdr)
+                 w_trace, w_span, codec) = _HDR.unpack(hdr)
                 ctx = tracing.SpanContext.from_wire(w_trace, w_span)
                 if ctx is None:
                     if not self._serve_one(conn, op, table_id, n, lr,
-                                           epoch, client, seq, dim):
+                                           epoch, client, seq, dim,
+                                           codec):
                         return
                     continue
                 # server-side ps_rpc span parented to the CALLER's
@@ -356,7 +376,7 @@ class PSServer:
                     with sp.activate():
                         keep = self._serve_one(conn, op, table_id, n,
                                                lr, epoch, client, seq,
-                                               dim)
+                                               dim, codec)
                 except BaseException as e:
                     sp.fail(e)
                     raise
@@ -388,7 +408,7 @@ class PSServer:
 
     def _serve_one(self, conn: socket.socket, op: int, table_id: int,
                    n: int, lr: float, epoch: int, client: int,
-                   seq: int, dim: int) -> bool:
+                   seq: int, dim: int, codec: int = 0) -> bool:
         """Handle ONE framed request (header already consumed). Returns
         True to keep the connection loop serving, False to close it."""
         # no wire-level "trusted" flag: replication traffic is the
@@ -402,12 +422,15 @@ class PSServer:
             if base in (OP_DELTA_SINCE, OP_REPL_APPLY)
             else (n > _MAX_IDS or dim > _MAX_DIM
                   or n * max(dim, 1) > _MAX_ELEMS))
-        if base > _MAX_OP or oversized:
-            # unparseable header: the stream cannot be resynced —
+        if base > _MAX_OP or oversized or codec > 2 or (
+                codec and base not in (OP_PULL, OP_PUSH)):
+            # unparseable header (an unknown codec makes the payload
+            # length uncomputable — the stream cannot be resynced, and
+            # a quantized MERGE/ASSIGN would corrupt catch-up state):
             # reply typed, then drop the connection
             _send_err(conn, ERR_BAD_REQUEST, 0,
                       f"malformed request (op={op}, n={n}, "
-                      f"dim={dim})")
+                      f"dim={dim}, codec={codec})")
             return False
         if base == OP_STOP:
             _send_ok(conn)
@@ -438,22 +461,31 @@ class PSServer:
             if err:
                 _send_err(conn, err[0], err[1], err[2])
                 return True
-            _send_ok(conn, table.pull(ids).tobytes())
+            vals = table.pull(ids)
+            if codec:
+                _send_ok(conn, np_encode(vals, codec_name(codec)))
+            else:
+                _send_ok(conn, vals.tobytes())
         elif base in (OP_PUSH, OP_MERGE, OP_ASSIGN):
-            # drain ids AND values by the client-declared dim
+            # drain ids AND values by the client-declared dim + codec
             # BEFORE any error reply, so a rejected write leaves
             # the stream in sync for the next request
             ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
-            raw = _recv_exact(conn, 4 * n * dim)
+            raw = _recv_exact(
+                conn, encoded_nbytes(n * dim, codec_name(codec)))
             err = self._table_error(table, table_id, dim, epoch,
                                     base)
             if err:
                 _send_err(conn, err[0], err[1], err[2])
                 return True
-            vals = np.frombuffer(raw, np.float32)
+            if codec:
+                vals = np_decode(raw, n * dim, codec_name(codec))
+            else:
+                vals = np.frombuffer(raw, np.float32)
             try:
                 self._apply_write(base, table, table_id, ids,
-                                  vals, lr, client, seq, False)
+                                  vals, lr, client, seq, False,
+                                  codec=codec, raw=raw)
             except WriteRejected as e:
                 _send_err(conn, e.code,
                           getattr(self, "_epoch", 0), e.msg)
@@ -638,6 +670,7 @@ class PSClient:
                  max_attempts: Optional[int] = None,
                  failover_timeout: float = 30.0,
                  client_id: Optional[int] = None,
+                 codec: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         from ..distributed.http_kv import KVClient
@@ -645,6 +678,23 @@ class PSClient:
 
         if endpoints is None and kv is None:
             raise ValueError("PSClient needs endpoints= or kv=")
+        # quantized wire codec for PUSH/PULL value payloads ("int8" |
+        # "bf16" | "f32"): ctor arg, else PADDLE_PS_QUANT, else f32 —
+        # PADDLE_QUANT_ALLREDUCE=0 pins the escape leg here too (ONE
+        # switch restores the whole f32 baseline, DP step + PS wire)
+        if codec is None:
+            codec = os.environ.get("PADDLE_PS_QUANT", "f32").strip() \
+                .lower() or "f32"
+            if codec in ("0", "off", "false"):
+                codec = "f32"
+        if os.environ.get("PADDLE_QUANT_ALLREDUCE", "").strip() in (
+                "0", "off", "false"):
+            codec = "f32"
+        if codec not in CODEC_IDS:
+            raise ValueError(f"PSClient codec {codec!r}: expected "
+                             "f32|bf16|int8")
+        self._codec = codec
+        self._codec_id = CODEC_IDS[codec]
         self._kv = (KVClient(kv, sleep=sleep) if isinstance(kv, str)
                     else kv)
         self._job = str(job)
@@ -760,8 +810,14 @@ class PSClient:
         with self._wseq_lock:
             return next(self._wseq)
 
+    @property
+    def codec(self) -> str:
+        """Wire codec for PUSH/PULL value payloads."""
+        return self._codec
+
     def _frame(self, op: int, table_id: int, n: int, lr: float,
-               dim: int, seq: int, payload: bytes) -> bytes:
+               dim: int, seq: int, payload: bytes,
+               codec: int = 0) -> bytes:
         # the ambient trace context rides every frame (0s = untraced):
         # read at build time, so a failover replay re-stamps the SAME
         # caller identity onto the fresh primary's frame
@@ -769,7 +825,7 @@ class PSClient:
         w_trace, w_span = ctx.to_wire() if ctx is not None else (0, 0)
         return _HDR.pack(op, table_id, n, lr, self._epoch,
                          self._client_id, seq, dim, w_trace,
-                         w_span) + payload
+                         w_span, codec) + payload
 
     def _exchange_once(self, k: int, frame: bytes, reader, fp_name: str):
         _fault.point(fp_name)
@@ -904,6 +960,7 @@ class PSClient:
     def pull(self, table_id: int, ids, dim: int) -> np.ndarray:
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         out = np.empty((ids.size, dim), np.float32)
+        cid, cname = self._codec_id, self._codec
         for k, sel in enumerate(self._shard(ids)):
             if sel.size == 0:
                 continue
@@ -911,23 +968,42 @@ class PSClient:
 
             def build(k=k, sel=sel, payload=payload):
                 return self._frame(OP_PULL, table_id, sel.size, 0.0,
-                                   dim, 0, payload)
+                                   dim, 0, payload, codec=cid)
 
+            nb = encoded_nbytes(sel.size * dim, cname)
             raw = self._shard_call(
-                k, build,
-                lambda s, m=4 * sel.size * dim: _recv_exact(s, m),
-                "ps.pull")
-            out[sel] = np.frombuffer(raw, np.float32).reshape(sel.size, dim)
+                k, build, lambda s, m=nb: _recv_exact(s, m), "ps.pull")
+            if cid:
+                self._count_quant(sel.size * dim, nb)
+                out[sel] = np_decode(raw, sel.size * dim,
+                                     cname).reshape(sel.size, dim)
+            else:
+                out[sel] = np.frombuffer(raw, np.float32).reshape(
+                    sel.size, dim)
         return out
+
+    @staticmethod
+    def _count_quant(n_elems: int, encoded: int) -> None:
+        _bump("comm_quant_bytes_sent", encoded)
+        _bump("comm_quant_bytes_saved", 4 * n_elems - encoded)
 
     def _send_vals(self, op: int, table_id: int, ids, vals, dim: int,
                    lr: float):
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         vals = np.ascontiguousarray(vals, np.float32).reshape(ids.size, dim)
+        # only PUSH payloads quantize: a MERGE/ASSIGN is state transfer
+        # (geo deltas / catch-up overwrites), not a gradient
+        cid = self._codec_id if op == OP_PUSH else 0
+        cname = self._codec if cid else "f32"
         for k, sel in enumerate(self._shard(ids)):
             if sel.size == 0:
                 continue
-            payload = ids[sel].tobytes() + vals[sel].tobytes()
+            if cid:
+                enc = np_encode(vals[sel], cname)
+                self._count_quant(sel.size * dim, len(enc))
+                payload = ids[sel].tobytes() + enc
+            else:
+                payload = ids[sel].tobytes() + vals[sel].tobytes()
             # seq is drawn on the FIRST build() call — inside the shard
             # lock — so allocation order matches send order: drawing it
             # out here would let a concurrent pusher send a higher seq
@@ -939,7 +1015,7 @@ class PSClient:
                 if state["seq"] is None:
                     state["seq"] = self._next_wseq()
                 return self._frame(op, table_id, sel.size, lr, dim,
-                                   state["seq"], payload)
+                                   state["seq"], payload, codec=cid)
 
             self._shard_call(k, build, None, "ps.push")
 

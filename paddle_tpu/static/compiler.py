@@ -96,6 +96,27 @@ class BuildStrategy:
                             gradient_merge_k > 1 — the k microbatches
                             are the pipeline's microbatches)
 
+    Communication-efficiency knobs (the comm_bucketing concern in
+    static/passes.py + parallel/collectives.py; pure data-parallel
+    meshes only — `PADDLE_QUANT_ALLREDUCE=0` is the bitwise escape):
+
+      comm_quant            "int8" | "bf16" | "off": quantize the DP
+                            gradient all-reduce (EQuARX-style blocked
+                            encodings, f32 accumulation at every reduce
+                            hop). The executor compiles an explicit
+                            bucketed ring all-reduce into the step;
+                            ineligible configs fall back to the XLA f32
+                            path with a dispatch-counter reason.
+      comm_bucket_bytes     target f32 payload bytes per gradient
+                            bucket; buckets are ordered by backward
+                            completion so bucket k's all-reduce is
+                            issued while bucket k+1's is still forming
+                            (reduce/compute overlap).
+      comm_error_feedback   carry each device's local quantization
+                            residual in DONATED executor state and fold
+                            it into the next step's contribution
+                            (compressed-gradient error feedback).
+
     Comm-layout knobs (reduce_strategy, fuse_all_reduce_ops) stay
     descriptive: XLA's SPMD partitioner owns cross-chip scheduling."""
 
@@ -119,6 +140,9 @@ class BuildStrategy:
         self.mesh_shape = {}
         self.sharding_hints = {}
         self.pipeline_stages = 1
+        self.comm_quant = "off"
+        self.comm_bucket_bytes = 4 << 20
+        self.comm_error_feedback = False
         self.num_trainers = 1
         self.trainer_id = 0
 

@@ -195,14 +195,23 @@ def _resolve_batch(block, feed_shapes: Optional[Dict[str, Sequence[int]]],
 
 
 def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
-                 shard_cfg=None, pp=None) -> CostReport:
+                 shard_cfg=None, pp=None, comm=None) -> CostReport:
     """Walk ``program``'s optimized global block into a CostReport.
 
     ``feed_shapes``: {data var name -> live array shape} — resolves the
     dynamic batch dim. ``gm``/``shard_cfg``/``pp`` are the executor's
     resolve_gradient_merge/resolve_sharding/resolve_pipeline results for
-    the build (None each when off)."""
+    the build (None each when off). ``comm`` is the resolve_comm result
+    when the build compiled the EXPLICIT quantized DP gradient
+    all-reduce (parallel/collectives.py): the gradient buckets then
+    charge their ENCODED ring bytes (payload + per-block scales, the
+    encoded_nbytes closed form) into comm_bytes as a ``comm_allreduce``
+    pseudo-op — never the f32 bytes the escape leg would move, so
+    step_comm_bytes and the perf_report roofline stay truthful under
+    quantization. (With comm=None the DP grad reduce is XLA's implicit
+    f32 psum, uncharged — the pre-quantization accounting, unchanged.)"""
     block = program.global_block
+    comm_cfg = comm   # the per-op loop below reuses `comm` as a local
     batch = _resolve_batch(block, feed_shapes, batch_size)
     axis_sizes: Dict[str, int] = dict(shard_cfg[0]) if shard_cfg else {}
     n_shards = _prod(axis_sizes.values()) if axis_sizes else 1
@@ -372,6 +381,22 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
             flops=flops * mult // factor,
             hbm_bytes=hbm * mult // factor,
             comm_bytes=comm, mult=mult, shard_factor=factor))
+
+    if comm_cfg is not None and first_bwd is not None:
+        from .passes import comm_bucket_plan, comm_data_axis
+
+        axis = comm_data_axis(shard_cfg)
+        plan = (comm_bucket_plan(block, comm_cfg, axis[1])
+                if axis is not None else None)
+        if plan:
+            # the bucketed quantized all-reduce runs ONCE per step on
+            # the merged gradient (no gm multiplier — the PR 5
+            # quantize-once-per-step discipline)
+            out.append(OpCost(
+                index=first_bwd, type="comm_allreduce", out="",
+                flops=0, hbm_bytes=0,
+                comm_bytes=sum(b["ring_encoded"] for b in plan),
+                mult=1, shard_factor=1))
 
     return CostReport(out, gm_k=gm_k, pp_stages=int(pp or 1),
                       n_shards=n_shards, batch=batch)

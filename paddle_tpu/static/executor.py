@@ -267,7 +267,7 @@ class _ExecEntry:
     don't retry)."""
 
     __slots__ = ("compiled", "optimized_program", "pass_report", "is_gm",
-                 "cost")
+                 "cost", "comm_stats")
 
     def __init__(self, compiled, optimized_program, pass_report,
                  is_gm=False):
@@ -276,6 +276,11 @@ class _ExecEntry:
         self.pass_report = pass_report
         self.is_gm = is_gm
         self.cost = None
+        # per-step quantized-collective accounting when the executable
+        # compiled with the explicit bucketed all-reduce (see
+        # _comm_entry_stats): wire bytes sent/saved per dispatch plus
+        # the comm_buckets / allreduce_overlap_frac gauges
+        self.comm_stats = None
 
 
 # process-global content-addressed executable cache: every Executor in
@@ -301,7 +306,8 @@ def _exec_cache_put(key: str, entry: _ExecEntry) -> None:
 
 
 def _content_key(opt_program, feed_sig, fetch_names, persist_names,
-                 state_sig, sharding, donate, gm=None, pp=None) -> str:
+                 state_sig, sharding, donate, gm=None, pp=None,
+                 comm=None) -> str:
     # gm (gradient merge) and pp (pipeline stage count) change the
     # compiled step's STRUCTURE (scan / GPipe schedule over
     # microbatches) without touching the program content, so they must
@@ -315,7 +321,8 @@ def _content_key(opt_program, feed_sig, fetch_names, persist_names,
     blob = json.dumps(
         [opt_program.to_dict(), list(feed_sig), list(fetch_names),
          list(persist_names), list(state_sig), shard_desc, bool(donate),
-         list(gm) if gm else None, pp],
+         list(gm) if gm else None, pp,
+         list(comm) if comm else None],
         sort_keys=True, default=str).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()
 
@@ -327,6 +334,26 @@ def _nbytes(arr) -> int:
         return int(arr.nbytes)
     except Exception:
         return 0
+
+
+def _comm_entry_stats(comm_plan) -> Dict[str, Any]:
+    """Per-dispatch quantized-collective accounting for one compiled
+    executable: encoded ring bytes actually moved per device per step
+    (``bytes_sent``), the f32 bytes the codec saved (``bytes_saved``),
+    the bucket count, and the analytic overlap fraction — with nb
+    buckets emitted in completion order, nb-1 of them have a later
+    bucket's work in flight behind them (the last one drains alone),
+    the same analytic convention as pp_bubble_frac."""
+    _axis, _g, plan = comm_plan
+    sent = sum(b["ring_encoded"] for b in plan)
+    f32 = sum(b["ring_f32"] for b in plan)
+    nb = len(plan)
+    return {
+        "bytes_sent": int(sent),
+        "bytes_saved": int(max(0, f32 - sent)),
+        "comm_buckets": nb,
+        "allreduce_overlap_frac": round((nb - 1) / nb, 4) if nb else 0.0,
+    }
 
 
 class Executor:
@@ -386,7 +413,8 @@ class Executor:
         for name in (profiler.FAULT_COUNTER_NAMES
                      + profiler.COMPILE_COUNTER_NAMES
                      + profiler.ELASTIC_COUNTER_NAMES
-                     + profiler.PS_COUNTER_NAMES):
+                     + profiler.PS_COUNTER_NAMES
+                     + profiler.COMM_COUNTER_NAMES):
             if name in snap:
                 out[name] = snap[name]
         return out
@@ -661,13 +689,14 @@ class Executor:
         # dtype map on the program (like _feed_sharding) so py_reader
         # prefetch threads stage batches already low.
         from .passes import (amp_feed_dtypes_cached, resolve_amp,
-                             resolve_gradient_merge, resolve_pipeline,
-                             resolve_sharding)
+                             resolve_comm, resolve_gradient_merge,
+                             resolve_pipeline, resolve_sharding)
 
         amp = resolve_amp(strategy)
         gm = resolve_gradient_merge(strategy)
         shard_cfg = resolve_sharding(strategy)
         pp = resolve_pipeline(strategy)
+        comm = resolve_comm(strategy)
         if gm is None:
             # mirrors apply_passes: pipeline_stages without
             # gradient_merge_k > 1 has no microbatches to schedule
@@ -727,6 +756,23 @@ class Executor:
                     mesh, block, feed, persist_names, shard_cfg, peek)
                 self._shard_map_cache = (shard_key, sharding)
             program._feed_sharding = sharding
+        # quantized DP collectives (BuildStrategy.comm_quant /
+        # PADDLE_QUANT_ALLREDUCE): resolve eligibility + the gradient
+        # bucket plan up front — the error-feedback residuals ride the
+        # DONATED state, so they must join persist_names before the
+        # state gather, and the comm tuple joins the step/content keys
+        # so a codec/bucket flip can never hit a stale executable
+        comm_plan = None
+        if comm is not None:
+            comm_plan = self._comm_eligibility(
+                program, block, comm, shard_cfg, gm, feed, sharding,
+                pp=pp)
+            if comm_plan is not None and comm[2]:
+                sharding = dict(sharding) if sharding else {}
+                persist_names = list(persist_names)
+                persist_names += self._ensure_ef_state(
+                    scope, comm_plan, shard_cfg, sharding)
+                program._feed_sharding = sharding
         feed_keys = sorted(feed.keys())
         feed_vals = [feed[k] for k in feed_keys]
         state = self._gather_state(scope, persist_names, feed_vals,
@@ -739,7 +785,7 @@ class Executor:
         step_key = (program._version, feed_sig, tuple(fetch_names),
                     tuple(persist_names), state_sig, bool(sharding),
                     _strategy_signature(strategy), amp, gm, shard_cfg,
-                    pp)
+                    pp, comm, comm_plan is not None)
         per_prog = self._cache.setdefault(program, {})
         entry = None
         if use_program_cache:
@@ -761,7 +807,7 @@ class Executor:
             self._record_pass_report(report)
             ck = _content_key(opt_program, feed_sig, fetch_names,
                               persist_names, state_sig, sharding,
-                              self._donate, gm, pp)
+                              self._donate, gm, pp, comm)
             per_prog[step_key] = ck
             entry = _exec_cache_get(ck) if use_program_cache else None
             if entry is not None:
@@ -774,9 +820,13 @@ class Executor:
                 compiled_fn = self._build(
                     opt_program.global_block, feed_keys, fetch_names,
                     persist_names, sharding, feed_vals, state, rng, gm,
-                    pp)
+                    pp, comm=comm, comm_plan=comm_plan)
                 entry = _ExecEntry(compiled_fn, opt_program, report,
                                    is_gm)
+                if comm_plan is not None and any(
+                        op.type == "backward"
+                        for op in opt_program.global_block.ops):
+                    entry.comm_stats = _comm_entry_stats(comm_plan)
                 if use_program_cache:
                     _exec_cache_put(ck, entry)
                 self._bump("compile_cache_misses")
@@ -796,7 +846,9 @@ class Executor:
                     feed_shapes={k: tuple(getattr(v, "shape", ()) or ())
                                  for k, v in feed.items()},
                     gm=gm if entry.is_gm else None,
-                    shard_cfg=shard_cfg, pp=pp)
+                    shard_cfg=shard_cfg, pp=pp,
+                    comm=comm if getattr(entry, "comm_stats", None)
+                    else None)
             except Exception:
                 entry.cost = False
         if entry.cost:
@@ -809,6 +861,19 @@ class Executor:
             # update): the tokens-per-dispatch win gradient merge buys
             self._bump("gm_dispatches")
             self._bump("gm_microbatches", gm[0])
+        if getattr(entry, "comm_stats", None):
+            # quantized-collective accounting, per dispatch: the wire
+            # bytes this step's bucketed all-reduce moved (and saved vs
+            # f32) are cumulative counters; the bucket count and the
+            # analytic overlap fraction are point-in-time gauges
+            from .. import profiler
+
+            cs = entry.comm_stats
+            self._bump("comm_quant_bytes_sent", cs["bytes_sent"])
+            self._bump("comm_quant_bytes_saved", cs["bytes_saved"])
+            for name in ("comm_buckets", "allreduce_overlap_frac"):
+                self._counters[name] = cs[name]
+                profiler.set_counter(name, cs[name])
         feed_h2d = sum(_nbytes(v) for v in feed_vals
                        if not isinstance(v, jax.Array))
         if feed_h2d:
@@ -914,7 +979,8 @@ class Executor:
                 self._bump(name, v)
 
     def _build(self, block, feed_keys, fetch_names, persist_names,
-               sharding, feed_vals, state, rng, gm=None, pp=None):
+               sharding, feed_vals, state, rng, gm=None, pp=None,
+               comm=None, comm_plan=None):
         """AOT-compile one step: jit -> lower() (trace_ms) -> compile()
         (compile_ms). The split makes trace vs XLA-compile time
         measurable, and compile() goes through jax's persistent
@@ -937,7 +1003,18 @@ class Executor:
         if gm is not None:
             gm_bwd = next((i for i, op in enumerate(block.ops)
                            if op.type == "backward"), None)
-        if gm_bwd is not None and pp is not None and pp > 1 and any(
+        comm_bwd = None
+        if comm_plan is not None:
+            comm_bwd = next((i for i, op in enumerate(block.ops)
+                             if op.type == "backward"), None)
+        if comm_bwd is not None:
+            # explicit quantized-collective DP step (shard_map over the
+            # pure-dp mesh; composes the gm microbatch scan internally)
+            step = self._comm_step_fn(block, feed_keys, fetch_names,
+                                      persist_names, feed_vals, gm,
+                                      comm_bwd, comm, comm_plan,
+                                      sharding)
+        elif gm_bwd is not None and pp is not None and pp > 1 and any(
                 "__pp_stage" in op.attrs for op in block.ops):
             step = self._pp_step_fn(block, feed_keys, fetch_names,
                                     persist_names, feed_vals, gm, gm_bwd)
@@ -1155,6 +1232,337 @@ class Executor:
             new_state = [env.get(n, s)
                          for n, s in zip(persist_names, state)]
             return fetches, new_state
+
+        return step
+
+    # -- quantized DP collectives (ISSUE 15: EQuARX-style comm layer) ------
+    def _comm_eligibility(self, program, block, comm, shard_cfg, gm,
+                          feed, sharding, pp=None):
+        """Gate + plan for the explicit quantized-collective DP step.
+
+        Returns ``(axis_name, group, plan)`` when the build is eligible,
+        else None after bumping the ``quant_allreduce.xla`` dispatch
+        counter with the reason (the established kernel pattern — the
+        XLA f32 GSPMD path is the fallback, bitwise-identical to the
+        pre-quantization baseline). Memoized per (program, config, feed
+        shapes): the warm step pays one key comparison.
+
+        Eligible means: a PURE data-parallel mesh (exactly one
+        'dp'/'data' axis, no sharding hints — tensor/pipeline layouts
+        keep XLA's partitioner-owned collectives), one static
+        ``backward`` gradient plan, no persistable writes inside the
+        scanned region (per-device batch-norm style stats would diverge
+        silently under a replicated-out shard_map), every dynamic-batch
+        feed actually sharded over the axis, and local batches
+        divisible by gradient_merge_k."""
+        from ..ops.pallas.counters import bump
+        from .passes import comm_bucket_plan, comm_data_axis
+
+        key = (program._version, comm, shard_cfg, gm, pp,
+               tuple(sorted((k, tuple(getattr(v, "shape", ())))
+                            for k, v in feed.items())))
+        cached = getattr(self, "_comm_elig_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+
+        def verdict(result, reason=None):
+            if result is None:
+                bump("quant_allreduce", "xla", reason)
+            else:
+                bump("quant_allreduce", "quant")
+            self._comm_elig_cache = (key, result)
+            return result
+
+        if shard_cfg is None:
+            return verdict(None, "comm_quant set but no mesh_shape — "
+                                 "quantized collectives need a dp mesh")
+        if pp is not None:
+            return verdict(None, "pipeline_stages > 1 — the GPipe "
+                                 "schedule keeps XLA collectives")
+        axis = comm_data_axis(shard_cfg)
+        if axis is None:
+            return verdict(None, "mesh is not pure data-parallel "
+                                 f"(axes {shard_cfg[0]})")
+        if shard_cfg[1]:
+            return verdict(None, "sharding_hints present — tensor-"
+                                 "parallel layouts keep XLA collectives")
+        name, g = axis
+        plan = comm_bucket_plan(block, comm, g)
+        if plan is None:
+            return verdict(None, "no static gradient plan (no backward "
+                                 "op, or dynamic grad shapes)")
+        ops = block.ops
+        bwd_idx = next(i for i, op in enumerate(ops)
+                       if op.type == "backward")
+        persist = {n for n, v in block.vars.items() if v.persistable}
+        written = {n for op in ops[:bwd_idx] for n in op.output_names()
+                   if n in persist}
+        if written:
+            return verdict(None, f"persistable writes in the forward "
+                                 f"region ({sorted(written)[:3]}) would "
+                                 "diverge per-device")
+        for k_, v in feed.items():
+            dv = block.vars.get(k_)
+            shape = getattr(dv, "shape", None)
+            if not shape or shape[0] is None or int(shape[0]) >= 0:
+                continue
+            sh = sharding.get(k_) if sharding else None
+            spec = getattr(sh, "spec", None)
+            if not spec or not spec[0]:
+                return verdict(None, f"feed {k_!r} batch dim not "
+                                     f"sharded over {name!r} (size not "
+                                     f"divisible by {g}?)")
+            local_b = int(getattr(v, "shape", (0,))[0]) // g
+            if gm is not None and local_b % gm[0]:
+                return verdict(None, f"local batch {local_b} not "
+                                     f"divisible by gradient_merge_k="
+                                     f"{gm[0]}")
+        return verdict((name, g, plan))
+
+    def _ensure_ef_state(self, scope, comm_plan, shard_cfg, sharding):
+        """Materialize the error-feedback residual buffers as DONATED
+        executor state: one ``(g, padded)`` f32 array per bucket,
+        sharded over the data axis so each device owns its row. Returns
+        the names (appended to persist_names; XLA updates them in place
+        step over step through the normal donation path)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.collectives import padded_len
+        from ..parallel.mesh import mesh_for_shape
+
+        axis, g, plan = comm_plan
+        mesh = mesh_for_shape(dict(shard_cfg[0]))
+        shard = NamedSharding(mesh, PartitionSpec(axis, None))
+        peek = getattr(scope, "_peek", scope.find_var)
+        write_back = getattr(scope, "_write_back", scope.set)
+        names = []
+        for i, b in enumerate(plan):
+            n = f"__comm_ef_{i}"
+            padded = padded_len(b["elems"], g)
+            arr = peek(n)
+            if not isinstance(arr, jax.Array) or \
+                    tuple(arr.shape) != (g, padded):
+                arr = jax.device_put(np.zeros((g, padded), np.float32),
+                                     shard)
+                write_back(n, arr)
+            sharding[n] = shard
+            names.append(n)
+        return names
+
+    def _comm_step_fn(self, block, feed_keys, fetch_names, persist_names,
+                      feed_vals, gm, bwd_idx, comm, comm_plan, sharding):
+        """Compile the DP train step with an EXPLICIT bucketed,
+        quantized gradient all-reduce instead of XLA's implicit f32
+        psum: the whole step runs inside shard_map over the pure-dp
+        mesh — each device traces the forward+backward on its LOCAL
+        batch shard, the per-bucket gradients reduce through
+        parallel.collectives' quantized ring (encode per hop, f32
+        accumulation, deterministic decode → bitwise-replicated reduced
+        values), and the optimizer region then runs replicated on
+        every device (same grads + same params ⇒ same updates, so
+        state out-specs are replicated by construction).
+
+        Overlap: every bucket's reduce-scatter is ISSUED (in backward-
+        completion order, the comm_bucketing plan) before any bucket's
+        all-gather completes — XLA's latency-hiding scheduler is free
+        to run them concurrently instead of one barrier-shaped reduce.
+
+        Composition: with ``gradient_merge_k`` the local microbatch
+        scan accumulates f32 grads exactly like ``_gm_step_fn`` and the
+        MERGED gradient is reduced once per step (quantize once per
+        step, the PR 5 accumulator discipline). ``avg=True`` on the
+        collective turns sum-of-local-mean-grads into the global-mean
+        gradient, matching the GSPMD leg's mean-loss semantics.
+
+        Fetch assembly: dynamic-batch fetches gather over the axis
+        (out-spec carries the batch dim), other float fetches are
+        pmean'd (exact for replicated values, the global mean for
+        per-shard losses), the rest report the local value.
+
+        Error feedback (``comm_error_feedback``): each device adds its
+        residual to its contribution, quantizes ONCE locally, carries
+        the new residual out through the donated ``__comm_ef_<i>``
+        state row, and feeds the dequantized contribution into the
+        ring."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.collectives import (
+            allreduce_done, allreduce_start, padded_len, quant_decode,
+            quant_encode, shard_map_nocheck)
+        from ..parallel.mesh import mesh_for_shape
+
+        axis, g, plan = comm_plan
+        codec, _bucket_bytes, ef = comm
+        k, avg_gm = gm if gm is not None else (1, True)
+        (scan_end, grad_names, found_name, state_carry, carry_out,
+         post_outs) = self._merge_region(block, feed_keys, feed_vals,
+                                         persist_names, fetch_names, 1,
+                                         bwd_idx)
+        mesh = mesh_for_shape({axis: g})
+        ef_names = [f"__comm_ef_{i}" for i in range(len(plan))] \
+            if ef else []
+        ef_set = set(ef_names)
+        reg_names = [n for n in persist_names if n not in ef_set]
+
+        grad_elems = {}
+        grad_shapes = {}
+        for gn in grad_names:
+            desc = block.vars.get(gn)
+            shape = tuple(int(d) for d in (desc.shape or ()))
+            grad_shapes[gn] = shape
+            e = 1
+            for d in shape:
+                e *= d
+            grad_elems[gn] = e
+
+        def spec_of(n):
+            sh = sharding.get(n) if sharding else None
+            spec = getattr(sh, "spec", None)
+            return P(*spec) if spec is not None else P()
+
+        # fetch modes: dynamic-batch fetches re-assemble over the axis;
+        # float fetches pmean (global mean for shard-varying losses, a
+        # no-op for replicated values); the rest report local
+        fetch_modes = []
+        for n in fetch_names:
+            v = block.vars.get(n)
+            shape = getattr(v, "shape", None)
+            dt = str(getattr(v, "dtype", "float32"))
+            if shape and (shape[0] is None or int(shape[0]) < 0):
+                fetch_modes.append("gather")
+            elif dt.startswith("float") or dt == "bfloat16":
+                fetch_modes.append("pmean")
+            else:
+                fetch_modes.append("local")
+
+        in_specs = ([spec_of(kk) for kk in feed_keys],
+                    [P(axis, None) if n in ef_set else P()
+                     for n in persist_names],
+                    P())
+        out_specs = ([P(axis) if m == "gather" else P()
+                      for m in fetch_modes],
+                     [P(axis, None) if n in ef_set else P()
+                      for n in persist_names])
+
+        def reduce_buckets(env, ef_rows):
+            """Bucketed quantized all-reduce of env's grads, overlap-
+            emitted; returns (env with reduced grads, new ef rows)."""
+            xs, new_ef = [], []
+            for i, b in enumerate(plan):
+                flats = [env[gn].astype(jnp.float32).reshape(-1)
+                         for gn in b["grads"]]
+                flat = flats[0] if len(flats) == 1 else \
+                    jnp.concatenate(flats)
+                padded = padded_len(b["elems"], g)
+                if padded != flat.shape[0]:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((padded - flat.shape[0],),
+                                         jnp.float32)])
+                if ef:
+                    flat = flat + ef_rows[i]
+                    q, sc = quant_encode(flat, codec)
+                    dec = quant_decode(q, sc, codec)
+                    new_ef.append(flat - dec)
+                    flat = dec
+                xs.append(flat)
+            starts = [allreduce_start(x, axis, codec=codec, axis_size=g)
+                      for x in xs]
+            reduced = [allreduce_done(c, avg=True) for c in starts]
+            for b, r in zip(plan, reduced):
+                off = 0
+                for gn in b["grads"]:
+                    e = grad_elems[gn]
+                    env[gn] = r[off:off + e].reshape(
+                        grad_shapes[gn]).astype(env[gn].dtype)
+                    off += e
+            return env, new_ef
+
+        def local_step(feed_local, state, rng):
+            state_env = dict(zip(persist_names, state))
+            ef_rows = [state_env[n][0] for n in ef_names]
+            state_env0 = {n: state_env[n] for n in reg_names}
+            found = jnp.zeros((), jnp.bool_)
+            if k > 1:
+                mbs = [v.reshape((k, v.shape[0] // k)
+                                 + tuple(v.shape[1:]))
+                       for v in feed_local]
+
+                def body(carry, xs):
+                    accum, found = carry
+                    mb, mi = xs
+                    env = dict(zip(feed_keys, mb))
+                    env.update(state_env0)
+                    ctx = ExecContext(
+                        rng_key=jax.random.fold_in(rng, mi))
+                    env = run_block(block, env, ctx, stop_at=scan_end)
+                    accum = [a + env[gn].astype(jnp.float32)
+                             for a, gn in zip(accum, grad_names)]
+                    if found_name is not None:
+                        found = found | jnp.reshape(
+                            env[found_name], ()).astype(bool)
+                    ys = {n: env[n] for n in carry_out}
+                    return (accum, found), ys
+
+                init = ([jnp.zeros((grad_elems[gn],), jnp.float32
+                                   ).reshape(grad_shapes[gn])
+                         for gn in grad_names],
+                        jnp.zeros((), jnp.bool_))
+                (accum, found), ys = jax.lax.scan(
+                    body, init, (mbs, jnp.arange(k)))
+                env = dict(zip(feed_keys, feed_local))
+                env.update(state_env0)
+                env.update({n: ys[n][-1] for n in carry_out})
+                for gn, a in zip(grad_names, accum):
+                    env[gn] = (a / k if avg_gm else a)
+                scanned_ys = ys
+            else:
+                env = dict(zip(feed_keys, feed_local))
+                env.update(state_env0)
+                ctx = ExecContext(rng_key=rng)
+                env = run_block(block, env, ctx, stop_at=scan_end)
+                if found_name is not None:
+                    found = jnp.reshape(env[found_name], ()).astype(bool)
+                scanned_ys = None
+            env, new_ef = reduce_buckets(env, ef_rows)
+            if found_name is not None:
+                # one non-finite microbatch on ANY device skips the
+                # whole replicated update (pmax = cross-device OR)
+                found = jax.lax.pmax(found.astype(jnp.int32), axis) > 0
+                env[found_name] = jnp.reshape(found, (1,))
+            ctx = ExecContext(rng_key=rng)
+            env = run_block(block, env, ctx, start=scan_end)
+            fetches = []
+            for n, mode in zip(fetch_names, fetch_modes):
+                if scanned_ys is not None and n in scanned_ys \
+                        and n not in post_outs:
+                    stacked = scanned_ys[n]
+                    if jnp.issubdtype(stacked.dtype, jnp.inexact):
+                        val = jnp.mean(stacked.astype(jnp.float32),
+                                       axis=0).astype(stacked.dtype)
+                    else:
+                        val = stacked[-1]
+                else:
+                    val = env[n]
+                if mode == "pmean" and jnp.issubdtype(
+                        jnp.asarray(val).dtype, jnp.inexact):
+                    val = jax.lax.pmean(
+                        val.astype(jnp.float32), axis).astype(val.dtype)
+                fetches.append(val)
+            new_state = []
+            ef_iter = iter(new_ef)
+            for n, s in zip(persist_names, state):
+                if n in ef_set:
+                    new_state.append(next(ef_iter)[None, :]
+                                     if ef else s)
+                else:
+                    new_state.append(env.get(n, s))
+            return fetches, new_state
+
+        sharded = shard_map_nocheck(local_step, mesh, in_specs,
+                                    out_specs)
+
+        def step(feed_vals, state, rng):
+            return sharded(feed_vals, state, rng)
 
         return step
 

@@ -298,6 +298,155 @@ def resolve_gradient_merge(strategy=None):
     return (k, bool(getattr(strategy, "gradient_merge_avg", True)))
 
 
+def resolve_comm(strategy=None):
+    """Resolve the quantized-collective config for one build.
+
+    Returns ``(codec, bucket_bytes, error_feedback)`` or ``None``
+    (plain XLA f32 collectives). ``codec`` comes from
+    ``BuildStrategy.comm_quant`` ("int8" | "bf16"); the env override
+    ``PADDLE_QUANT_ALLREDUCE`` follows the PADDLE_AMP pattern —
+    ``int8``/``bf16`` forces the codec on, ``0``/``off`` is the bitwise
+    escape leg whatever the strategy says. ``PADDLE_IR_PASSES=0``
+    resolves to None with the rest of the pipeline (the comm step is a
+    graph-structure change like gm/sharding)."""
+    if os.environ.get("PADDLE_IR_PASSES") == "0":
+        return None
+    try:
+        bucket = int(getattr(strategy, "comm_bucket_bytes", 4 << 20)
+                     or (4 << 20))
+    except (TypeError, ValueError):
+        bucket = 4 << 20
+    ef = bool(getattr(strategy, "comm_error_feedback", False))
+    env = os.environ.get("PADDLE_QUANT_ALLREDUCE")
+    if env is not None:
+        e = env.strip().lower()
+        if e in ("", "0", "false", "off"):
+            return None
+        if e in ("int8", "bf16"):
+            return (e, bucket, ef)
+        raise ValueError(
+            f"PADDLE_QUANT_ALLREDUCE={env!r}: expected int8|bf16|0")
+    raw = str(getattr(strategy, "comm_quant", "off") or "off").lower()
+    if raw in ("off", "none", "false", "0", ""):
+        return None
+    if raw not in ("int8", "bf16"):
+        raise ValueError(
+            f"BuildStrategy.comm_quant={raw!r}: expected int8|bf16|off")
+    return (raw, bucket, ef)
+
+
+def comm_data_axis(shard_cfg):
+    """The single pure-DP mesh axis a quantized-collective step runs
+    over: ``(axis_name, size)`` when the resolved mesh has EXACTLY one
+    axis and it is data-like ('dp'/'data'), else ``None`` — tensor/
+    pipeline axes mean XLA's SPMD partitioner owns the collectives and
+    the quantized step is ineligible (dispatch-counter reason)."""
+    from ..parallel.mesh import DATA_AXIS_NAMES
+
+    if shard_cfg is None:
+        return None
+    axes = shard_cfg[0]
+    if len(axes) != 1 or axes[0][0] not in DATA_AXIS_NAMES:
+        return None
+    name, size = axes[0]
+    return (name, int(size)) if size > 1 else None
+
+
+def comm_bucket_plan(block, comm, group: int):
+    """Size-targeted gradient buckets ordered by BACKWARD COMPLETION.
+
+    Walks the first ``backward`` op's (Params, Grads) pairs; a param's
+    gradient completes when the backward reaches its LAST forward use,
+    so grads sort by descending forward-consumer index (the deepest
+    layer's grads are ready first) and pack greedily into buckets of
+    ``comm_bucket_bytes`` f32 payload. Returns a list of dicts
+    ``{"grads", "elems", "f32_bytes", "encoded_bytes", "ring_f32",
+    "ring_encoded"}`` — or ``None`` when no backward op exists or any
+    grad shape is dynamic (the plan must be static). Shared by the
+    comm_bucketing pass (stamps), the executor (step structure + EF
+    state sizes), and the cost model (comm_bytes rule) so all three
+    agree by construction."""
+    from ..parallel.collectives import encoded_nbytes, ring_nbytes
+
+    codec, bucket_bytes, _ef = comm
+    bwd = next((op for op in block.ops if op.type == "backward"), None)
+    if bwd is None:
+        return None
+    params = list(bwd.inputs.get("Params", ()))
+    grads = list(bwd.outputs.get("Grads", ()))
+    if not grads or len(params) != len(grads):
+        return None
+    bwd_idx = block.ops.index(bwd)
+    last_use = {}
+    for i, op in enumerate(block.ops[:bwd_idx]):
+        for n in op.input_names():
+            last_use[n] = i
+    pairs = []
+    for j, (p, g) in enumerate(zip(params, grads)):
+        v = block.vars.get(g)
+        shape = getattr(v, "shape", None)
+        if not shape or any(d is None or int(d) < 0 for d in shape):
+            return None
+        elems = 1
+        for d in shape:
+            elems *= int(d)
+        pairs.append((-(last_use.get(p, -1)), j, g, elems))
+    pairs.sort()   # descending last forward use == completion order
+    buckets = []
+    cur, cur_elems = [], 0
+    for _, _, g, elems in pairs:
+        if cur and (cur_elems + elems) * 4 > bucket_bytes:
+            buckets.append((cur, cur_elems))
+            cur, cur_elems = [], 0
+        cur.append(g)
+        cur_elems += elems
+    if cur:
+        buckets.append((cur, cur_elems))
+    out = []
+    for names, elems in buckets:
+        out.append({
+            "grads": names,
+            "elems": elems,
+            "f32_bytes": 4 * elems,
+            "encoded_bytes": encoded_nbytes(elems, codec),
+            "ring_f32": ring_nbytes(elems, group, "f32"),
+            "ring_encoded": ring_nbytes(elems, group, codec),
+        })
+    return out
+
+
+def _pass_comm_bucketing(ctx: _Ctx) -> None:
+    """Stamp the gradient bucket plan onto the program: the backward op
+    gets ``__comm_buckets`` (list of grad-name lists, completion order)
+    and ``__comm_codec``, each grad VarDesc gets ``__comm_bucket`` —
+    pure bookkeeping like the shard stamps, but it joins the content
+    hash so a comm_quant/bucket-size flip can never reuse a stale
+    executable. The executor and the cost model re-derive the same plan
+    through :func:`comm_bucket_plan`."""
+    block = ctx.block
+    plan = comm_bucket_plan(block, ctx.comm, ctx.comm_group)
+    if plan is None:
+        return
+    codec = ctx.comm[0]
+    bwd = next(op for op in block.ops if op.type == "backward")
+    bwd.attrs["__comm_buckets"] = [list(b["grads"]) for b in plan]
+    bwd.attrs["__comm_codec"] = codec
+    table = []
+    for i, b in enumerate(plan):
+        for g in b["grads"]:
+            v = block.vars.get(g)
+            if v is not None:
+                v.attrs["__comm_bucket"] = i
+        table.append({
+            "bucket": i, "codec": codec, "grads": list(b["grads"]),
+            "elems": b["elems"], "f32_bytes": b["f32_bytes"],
+            "encoded_bytes": b["encoded_bytes"],
+            "ring_f32": b["ring_f32"], "ring_encoded": b["ring_encoded"],
+        })
+    ctx.comm_stats["comm_buckets"] = len(plan)
+    ctx.comm_table = table
+
+
 def _lowp_feed_names(block) -> Set[str]:
     """float32 data vars that may flip to the low dtype: never consumed
     by a black-listed (f32-pinned) op in the forward region and not read
@@ -410,6 +559,10 @@ class PassReport:
     # per-var spec table dump_passes --sharding prints
     shard: Dict[str, int] = field(default_factory=dict)
     shard_table: List[dict] = field(default_factory=list)
+    # comm_bucketing counters (comm_buckets) + the per-bucket
+    # size/order/codec table dump_passes --comm prints
+    comm: Dict[str, int] = field(default_factory=dict)
+    comm_table: List[dict] = field(default_factory=list)
 
     @property
     def removed(self) -> int:
@@ -436,6 +589,29 @@ class PassReport:
         if self.shard:
             lines.append("shard: " + "  ".join(
                 f"{k}={v}" for k, v in sorted(self.shard.items())))
+        if self.comm:
+            lines.append("comm: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(self.comm.items())))
+        return "\n".join(lines)
+
+    def comm_bucket_table(self) -> str:
+        """Aligned per-bucket table (tools/dump_passes.py --comm): the
+        reduce order, member grads, element count, f32 vs encoded ring
+        bytes per device."""
+        if not self.comm_table:
+            return "(no comm buckets)"
+        lines = [f"{'bucket':>6}  {'codec':<6}{'elems':>10}"
+                 f"{'ring f32':>12}{'ring enc':>12}{'saved':>8}  grads"]
+        for row in self.comm_table:
+            saved = (1 - row["ring_encoded"] / row["ring_f32"]
+                     if row["ring_f32"] else 0.0)
+            names = ", ".join(row["grads"][:4])
+            if len(row["grads"]) > 4:
+                names += f", … +{len(row['grads']) - 4}"
+            lines.append(
+                f"{row['bucket']:>6}  {row['codec']:<6}"
+                f"{row['elems']:>10}{row['ring_f32']:>12}"
+                f"{row['ring_encoded']:>12}{saved:>7.1%}  {names}")
         return "\n".join(lines)
 
     def shard_spec_table(self) -> str:
@@ -1647,7 +1823,8 @@ def pass_names() -> List[str]:
     return (["auto_mixed_precision"]
             + [name for name, _, _ in _PIPELINE]
             + ["recompute_segmentation", "shard_propagation",
-               "pipeline_stages", "drop_unused_vars"])
+               "pipeline_stages", "comm_bucketing",
+               "drop_unused_vars"])
 
 
 def apply_passes(program: Program, feed_names: Sequence[str],
@@ -1671,6 +1848,12 @@ def apply_passes(program: Program, feed_names: Sequence[str],
     remat = resolve_recompute(strategy)
     shard = resolve_sharding(strategy)
     pp = resolve_pipeline(strategy)
+    comm = resolve_comm(strategy)
+    if comm is not None and comm_data_axis(shard) is None:
+        # quantized collectives ride a pure data-parallel mesh; other
+        # topologies keep XLA's partitioner-owned collectives (the
+        # executor bumps the dispatch counter with the reason)
+        comm = None
     if pp is not None and resolve_gradient_merge(strategy) is None:
         # the GPipe schedule's microbatches ARE the gradient-merge
         # microbatches — without gradient_merge_k > 1 there is nothing
@@ -1743,6 +1926,23 @@ def apply_passes(program: Program, feed_names: Sequence[str],
         shard_counts = {k: int(v) for k, v in ctx.shard_stats.items()
                         if v}
         shard_table = ctx.shard_table
+    comm_counts: Dict[str, int] = {}
+    comm_table: List[dict] = []
+    if comm is not None and pp is None:
+        # after shard_propagation (grads inherit their params' specs)
+        # and never composed with the GPipe schedule
+        ctx.comm = comm
+        ctx.comm_group = comm_data_axis(shard)[1]
+        ctx.comm_stats = defaultdict(int)
+        ctx.comm_table = []
+        n = len(opt.global_block.ops)
+        t0 = time.perf_counter()
+        _pass_comm_bucketing(ctx)
+        stats.append(PassStat("comm_bucketing", n, n,
+                              (time.perf_counter() - t0) * 1e3))
+        comm_counts = {k: int(v) for k, v in ctx.comm_stats.items()
+                       if v}
+        comm_table = ctx.comm_table
     vars_dropped = 0
     if getattr(strategy, "memory_optimize", True):
         n = len(opt.global_block.ops)
@@ -1754,5 +1954,6 @@ def apply_passes(program: Program, feed_names: Sequence[str],
     total_ms = (time.perf_counter() - t_all) * 1e3
     report = PassReport(stats, n0, len(opt.global_block.ops), total_ms,
                         vars_dropped, amp_counts, remat_counts,
-                        remat_table, shard_counts, shard_table)
+                        remat_table, shard_counts, shard_table,
+                        comm_counts, comm_table)
     return opt, report

@@ -266,6 +266,19 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["shard_vars_annotated"] > 0, last
     assert last["pp_stages"] == 2, last
     assert 0.0 < last["pp_bubble_frac"] < 1.0, last
+    # quantized-collective contract (ISSUE 15): the int8 bucketed DP
+    # all-reduce must save >= 60% of the f32 ring bytes while holding
+    # the loss inside the established amp-style gate, with the buckets
+    # emitted in completion order (overlap fraction (nb-1)/nb)
+    for key in ("quant_allreduce_tokens_per_sec", "quant_loss_delta",
+                "comm_bytes_saved_pct", "allreduce_overlap_frac",
+                "comm_buckets"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["quant_allreduce_tokens_per_sec"] > 0, last
+    assert last["quant_loss_delta"] <= 1e-2, last
+    assert last["comm_bytes_saved_pct"] >= 60.0, last
+    assert last["comm_buckets"] >= 2, last
+    assert 0.0 < last["allreduce_overlap_frac"] < 1.0, last
 
 
 @pytest.mark.slow

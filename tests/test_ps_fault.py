@@ -211,7 +211,7 @@ def test_plain_server_dedups_retried_write():
     try:
         ids = np.array([9], np.int64)
         vals = np.ones((1, 4), np.float32)
-        frame = _HDR.pack(OP_PUSH, 0, 1, 0.5, 0, 77, 1, 4, 0, 0) \
+        frame = _HDR.pack(OP_PUSH, 0, 1, 0.5, 0, 77, 1, 4, 0, 0, 0) \
             + ids.tobytes() + vals.tobytes()
         peer = _RawPeer(srv.endpoint)
         peer.call_frame(frame)
@@ -254,7 +254,7 @@ def test_malformed_header_error_frame_then_close():
     srv = PSServer({0: _table()}).start()
     s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
     try:
-        s.sendall(_HDR.pack(250, 0, 0, 0.0, 0, 0, 0, 0, 0, 0))
+        s.sendall(_HDR.pack(250, 0, 0, 0.0, 0, 0, 0, 0, 0, 0, 0))
         assert _recv_exact(s, 1) == b"\x00"
         code, _epoch, mlen = _ERR_HDR.unpack(_recv_exact(s, _ERR_HDR.size))
         assert code == ERR_BAD_REQUEST
@@ -427,7 +427,7 @@ def test_write_replay_dedups_exactly_once(kv):
     try:
         ids = np.array([7], np.int64)
         vals = np.ones((1, 4), np.float32)
-        frame = _HDR.pack(OP_PUSH, 0, 1, 0.5, a.epoch, 42, 1, 4, 0, 0) \
+        frame = _HDR.pack(OP_PUSH, 0, 1, 0.5, a.epoch, 42, 1, 4, 0, 0, 0) \
             + ids.tobytes() + vals.tobytes()
         peer = _RawPeer(a.endpoint)
         peer.call_frame(frame)
@@ -576,7 +576,7 @@ def test_oversized_header_rejected_before_allocation():
     try:
         # n passes the id cap but n*dim would be a ~1 EiB allocation
         s.sendall(_HDR.pack(OP_PUSH, 0, 1 << 27, 0.0, 0, 0, 0,
-                            0xFFFFF, 0, 0))
+                            0xFFFFF, 0, 0, 0))
         assert _recv_exact(s, 1) == b"\x00"
         code, _epoch, mlen = _ERR_HDR.unpack(_recv_exact(s, _ERR_HDR.size))
         assert code == ERR_BAD_REQUEST

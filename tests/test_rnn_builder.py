@@ -107,10 +107,15 @@ def test_static_rnn_trains():
         static.SGD(learning_rate=0.1).minimize(loss)
     exe = static.Executor()
     exe.run(startup)
+    # 24 steps: the 0.7x margin at 12 steps sat one init-drift away
+    # from flaky (observed 0.77x after a jax RNG-stream change) — the
+    # assertion gates GRADIENT FLOW, so give SGD room to make the
+    # margin decisive while keeping every step monotone-checked
     losses = [float(np.asarray(exe.run(main, feed={"x": x, "y": y},
                                        fetch_list=[loss])[0]))
-              for _ in range(12)]
+              for _ in range(24)]
     assert losses[-1] < losses[0] * 0.7, losses
+    assert all(b < a for a, b in zip(losses, losses[1:])), losses
 
 
 def test_dynamic_rnn_length_masking():

@@ -263,9 +263,18 @@ def run_drill(workdir: str, nranks: int = 2, epochs: int = 3,
             [sys.executable, os.path.abspath(__file__), "--worker"],
             env=env_for(rank))
 
+    # relaunch backoff WIDER than the lease TTL: the drill exercises the
+    # lease-expiry -> WorkerLost -> generation-bump path, and a relaunch
+    # that re-leases the same (generation, rank) key before the TTL
+    # sweep observes the gap reads as continuity — the survivors never
+    # reform and the bump assertion goes flaky (the same
+    # relaunch-beats-the-sweep race the PS coordinator closes with lease
+    # incarnation tokens; here the relaunch hook IS a kill switch, so
+    # the deterministic fix is the drill's own backoff policy)
     sup = Supervisor(nranks, start_fn=start_fn,
                      max_restarts=max_restarts,
-                     backoff=Backoff(base=0.5, factor=2.0, jitter=0),
+                     backoff=Backoff(base=float(lease_ttl) + 1.0,
+                                     factor=2.0, jitter=0),
                      poll_interval=0.2)
     from paddle_tpu.distributed.launch import RestartBudgetExceeded
 

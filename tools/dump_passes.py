@@ -38,6 +38,12 @@ vs propagated vs conflict-replicated). Seed specs ride --shard-hints
 'dp+sp' = multi-axis dim); without hints the demo auto-hints the first
 divisible 2-D parameters column-/row-parallel so the psum accounting
 shows up. No devices are touched — the pass is pure annotation.
+
+Quantized collectives: --comm [int8|bf16] enables the comm_bucketing
+pass over a pure-dp mesh (--sharding dp=N, default dp=8) and prints
+the per-bucket size/order/codec table: the gradient buckets in
+backward-completion order with their f32 vs encoded ring bytes.
+Bucket size rides --comm-bucket-bytes (default 1 MiB).
 """
 from __future__ import annotations
 
@@ -198,6 +204,14 @@ def main():
                          "(';'-separated vars, ','-separated dims, '-' = "
                          "replicated, '+' joins multi-axis dims); "
                          "implies --sharding")
+    ap.add_argument("--comm", nargs="?", const="int8", default=None,
+                    choices=("int8", "bf16"),
+                    help="run the comm_bucketing pass (quantized DP "
+                         "all-reduce planning, default int8) and print "
+                         "the per-bucket size/order/codec table; uses "
+                         "--sharding's mesh (default dp=8)")
+    ap.add_argument("--comm-bucket-bytes", type=int, default=1 << 20,
+                    help="target f32 payload bytes per gradient bucket")
     ap.add_argument("--dot", default=None,
                     help="write the optimized block as graphviz dot")
     args = ap.parse_args()
@@ -248,6 +262,11 @@ def main():
         strategy.mesh_shape = mesh_shape
         strategy.sharding_hints = _parse_shard_hints(
             args.shard_hints, program, mesh_shape)
+    if args.comm:
+        if not strategy.mesh_shape:
+            strategy.mesh_shape = {"dp": 8}   # pure-dp planning mesh
+        strategy.comm_quant = args.comm
+        strategy.comm_bucket_bytes = args.comm_bucket_bytes
 
     optimized, report = static.apply_passes(program, feeds, fetches,
                                             strategy)
@@ -261,6 +280,9 @@ def main():
     if args.sharding or args.shard_hints:
         print()
         print(report.shard_spec_table())
+    if args.comm:
+        print()
+        print(report.comm_bucket_table())
     if args.dot:
         static.save_dot(optimized, args.dot)
         print(f"optimized block dot -> {args.dot}")

@@ -41,8 +41,10 @@ MODULES = [
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",
     "paddle_tpu.parallel",
+    "paddle_tpu.parallel.collectives",
     "paddle_tpu.profiler",
     "paddle_tpu.ps",
+    "paddle_tpu.ps.codec",
     "paddle_tpu.ps.replication",
     "paddle_tpu.quantization",
     "paddle_tpu.regularizer",
