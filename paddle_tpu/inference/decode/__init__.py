@@ -29,13 +29,15 @@ drains it on SIGTERM, and the ``kv_pages_in_use`` /
 every /metrics listener.
 """
 from .engine import DecodeEngine
-from .kv_cache import PageTableManager, alloc_kv_pool
+from .kv_cache import PageTableManager, alloc_kv_pool, alloc_kv_scales
 from .model import (DecodeModelConfig, init_decode_params,
                     reference_generate)
 from .scheduler import DecodeRequest, DecodeScheduler
+from .spec import NgramProposer
 
 __all__ = [
     "DecodeEngine", "DecodeModelConfig", "DecodeRequest",
-    "DecodeScheduler", "PageTableManager", "alloc_kv_pool",
-    "init_decode_params", "reference_generate",
+    "DecodeScheduler", "NgramProposer", "PageTableManager",
+    "alloc_kv_pool", "alloc_kv_scales", "init_decode_params",
+    "reference_generate",
 ]

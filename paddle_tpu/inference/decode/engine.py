@@ -31,6 +31,7 @@ Observability: ``decode_prefill_ms`` / ``decode_step_ms`` /
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import Counter as _Counter
@@ -40,10 +41,12 @@ import numpy as np
 
 from ...observability import tracing
 from ..serving import DeadlineExceeded, RequestFailed, _DualHist
-from .kv_cache import PageTableManager, alloc_kv_pool
+from .kv_cache import PageTableManager, alloc_kv_pool, alloc_kv_scales
 from .model import (DecodeModelConfig, decode_forward, init_decode_params,
-                    kv_pool_spec, param_shardings, prefill_forward)
+                    kv_pool_spec, param_shardings, prefill_forward,
+                    spec_decode_forward)
 from .scheduler import DecodeRequest, DecodeScheduler, RunningSeq
+from .spec import NgramProposer
 
 __all__ = ["DecodeEngine"]
 
@@ -64,6 +67,19 @@ class DecodeEngine:
     max_queue, rate_limit/burst, default_deadline_s, min_service_s
                          PR 6 admission semantics (typed sheds)
     eos_id               optional stop token
+    kv_codec             "off" (pool in ``dtype``) or "int8" — pages
+                         stored int8 with per-token-row f32 scales
+                         (ps/codec layout), dequant inside attention;
+                         ~4x sequences per pool byte
+    spec_k               speculative drafts per slot per tick (0 = off;
+                         ``PADDLE_SPEC_DECODE=0`` pins it off) — drafts
+                         from ``proposer`` (default: n-gram prompt
+                         lookup) verified in ONE ragged step, accepted
+                         prefix bitwise-identical to greedy decode
+    temperature/top_k/top_p/sample_seed
+                         sampling controls (temperature 0 = greedy);
+                         Gumbel noise comes from a seeded host RNG so
+                         runs replay token for token
     clock / sleep        injectable time sources (deterministic tests)
     """
 
@@ -80,6 +96,10 @@ class DecodeEngine:
                  min_service_s: float = 0.0,
                  eos_id: Optional[int] = None,
                  dtype: str = "float32",
+                 kv_codec: str = "off",
+                 spec_k: int = 0, proposer=None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, sample_seed: int = 0,
                  clock=time.monotonic, sleep=time.sleep,
                  tick_interval: float = 0.002):
         import jax
@@ -102,6 +122,29 @@ class DecodeEngine:
         self._sleep = sleep
         self._tick_interval = float(tick_interval)
         self._dtype = dtype
+        if kv_codec not in ("off", "int8"):
+            raise ValueError(f"kv_codec must be 'off' or 'int8', got "
+                             f"{kv_codec!r}")
+        self._kv_codec = kv_codec
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        # PADDLE_SPEC_DECODE=0 is the bitwise escape leg: same engine,
+        # plain one-token steps — outputs are identical either way (the
+        # verify step only ever accepts what greedy would emit)
+        pinned_off = os.environ.get("PADDLE_SPEC_DECODE",
+                                    "").strip() == "0"
+        self._spec_k = 0 if pinned_off else int(spec_k)
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._top_p = float(top_p)
+        if self._spec_k and self._temperature > 0:
+            raise ValueError(
+                "speculative decoding verifies against greedy argmax; "
+                "it requires temperature=0 (got "
+                f"temperature={temperature})")
+        self.proposer = proposer if proposer is not None \
+            else NgramProposer()
+        self._sample_rng = np.random.RandomState(int(sample_seed))
 
         self.pool = PageTableManager(n_pages, page_size, max_pages_per_seq)
         self.sched = DecodeScheduler(
@@ -127,12 +170,18 @@ class DecodeEngine:
         else:
             self.params = params if params is not None \
                 else init_decode_params(config, seed)
+        pool_dtype = "int8" if self._kv_codec == "int8" else dtype
         self._k_pages, self._v_pages = alloc_kv_pool(
             config.n_layers, n_pages, page_size, config.n_heads,
-            config.head_dim, dtype=dtype, sharding=kv_sharding)
+            config.head_dim, dtype=pool_dtype, sharding=kv_sharding)
+        self._k_scales = self._v_scales = None
+        if self._kv_codec == "int8":
+            self._k_scales, self._v_scales = alloc_kv_scales(
+                config.n_layers, n_pages, page_size)
 
         # -- compiled steps (substrate) -----------------------------------
         self._decode_step = None
+        self._spec_step = None
         self._prefill_steps: Dict[int, object] = {}   # n_pages -> step
         self._warmed = False
 
@@ -173,6 +222,26 @@ class DecodeEngine:
         # substrate build-timing sink (trace_ms / compile_ms)
         self._count(name, n)
 
+    # -- pool plumbing ------------------------------------------------------
+    def _pool_args(self) -> tuple:
+        """The device pool arrays in compiled-step order: (k, v) plus
+        the scale planes when the pool is int8 — every step donates and
+        returns exactly this tuple."""
+        if self._k_scales is not None:
+            return (self._k_pages, self._v_pages, self._k_scales,
+                    self._v_scales)
+        return (self._k_pages, self._v_pages)
+
+    def _store_pools(self, pools) -> None:
+        if self._k_scales is not None:
+            (self._k_pages, self._v_pages, self._k_scales,
+             self._v_scales) = pools
+        else:
+            self._k_pages, self._v_pages = pools
+
+    def _pool_donate(self) -> tuple:
+        return (1, 2, 3, 4) if self._k_scales is not None else (1, 2)
+
     @property
     def counters(self) -> Dict[str, int]:
         """This engine's decode counters plus the pool gauges and the
@@ -184,11 +253,29 @@ class DecodeEngine:
             out = dict(self._counters)
         out["kv_pages_in_use"] = self.pool.pages_in_use
         out["kv_page_evictions"] = self.pool.evicted_pages
+        out["kv_pages_shared"] = self.pool.pages_shared
+        out["kv_pages_cached"] = self.pool.pages_cached
+        out["kv_prefix_hits"] = self.pool.prefix_hits
         snap = profiler.counters_snapshot()
         for name in profiler.FAULT_COUNTER_NAMES:
             if name in snap:
                 out[name] = snap[name]
         return out
+
+    def kv_debug_snapshot(self) -> dict:
+        """JSON-ready page-pool state for tools/dump_kv.py: the
+        manager's snapshot (tables, refcounts, shared/cached/indexed
+        pages) plus this engine's codec/spec configuration and decode
+        counters."""
+        snap = self.pool.snapshot()
+        snap["kv_codec"] = self._kv_codec
+        snap["spec_k"] = self._spec_k
+        snap["max_batch"] = self.max_batch
+        with self._stats_lock:
+            snap["counters"] = {
+                k: v for k, v in sorted(self._counters.items())
+                if k.startswith(("spec_", "kv_", "decode_"))}
+        return snap
 
     def engine_latency_stats(self) -> Dict[str, float]:
         """Bucket-derived engine-side percentiles — what a /metrics
@@ -206,44 +293,111 @@ class DecodeEngine:
 
     # -- compiled-step builds ---------------------------------------------
     def _build_decode_step(self):
+        from ...ops.pallas.sampling import fused_sample
         from ...static.substrate import aot_compile
 
         cfg = self.config
         B, T = self.max_batch, self.pool.max_pages_per_seq
+        quant = self._kv_codec == "int8"
+        temp, tk, tp = self._temperature, self._top_k, self._top_p
+        sampling = temp > 0
 
-        def step(params, k_pages, v_pages, tokens, positions, table,
-                 lens, active):
-            return decode_forward(cfg, params, tokens, positions,
-                                  k_pages, v_pages, table, lens, active)
+        def step(params, k_pages, v_pages, *rest):
+            if quant:
+                k_scales, v_scales = rest[0], rest[1]
+                rest = rest[2:]
+            else:
+                k_scales = v_scales = None
+            tokens, positions, table, lens, active = rest[:5]
+            out = decode_forward(cfg, params, tokens, positions,
+                                 k_pages, v_pages, table, lens, active,
+                                 k_scales=k_scales, v_scales=v_scales,
+                                 return_logits=sampling)
+            head = out[0]
+            if sampling:   # rest[5] is the host-generated Gumbel noise
+                head = fused_sample(head, rest[5], temp, tk, tp)
+            return (head,) + tuple(out[1:])
 
         zi = np.zeros((B,), np.int32)
-        args = (self.params, self._k_pages, self._v_pages, zi, zi,
-                np.full((B, T), -1, np.int32), zi,
-                np.zeros((B,), np.bool_))
-        cs = aot_compile(step, args, donate_argnums=(1, 2),
+        args = (self.params,) + self._pool_args() + (
+            zi, zi, np.full((B, T), -1, np.int32), zi,
+            np.zeros((B,), np.bool_))
+        if sampling:
+            args = args + (np.zeros((B, cfg.vocab_size), np.float32),)
+        cs = aot_compile(step, args, donate_argnums=self._pool_donate(),
+                         bump=self._bump)
+        return cs.compiled
+
+    def _build_spec_step(self):
+        from ...static.substrate import aot_compile
+
+        cfg = self.config
+        B, T = self.max_batch, self.pool.max_pages_per_seq
+        K1 = self._spec_k + 1
+        quant = self._kv_codec == "int8"
+
+        def step(params, k_pages, v_pages, *rest):
+            if quant:
+                k_scales, v_scales = rest[0], rest[1]
+                rest = rest[2:]
+            else:
+                k_scales = v_scales = None
+            tokens, positions, table, lens, active = rest
+            return spec_decode_forward(cfg, params, tokens, positions,
+                                       k_pages, v_pages, table, lens,
+                                       active, k_scales=k_scales,
+                                       v_scales=v_scales)
+
+        zi = np.zeros((B,), np.int32)
+        args = (self.params,) + self._pool_args() + (
+            np.zeros((B, K1), np.int32), zi,
+            np.full((B, T), -1, np.int32), zi,
+            np.zeros((B, K1), np.bool_))
+        cs = aot_compile(step, args, donate_argnums=self._pool_donate(),
                          bump=self._bump)
         return cs.compiled
 
     def _build_prefill_step(self, n_pages: int):
-        from ...ops.pallas.paged_attention import paged_prefill_write
+        from ...ops.pallas.paged_attention import (
+            paged_prefill_write, paged_prefill_write_quant)
         from ...static.substrate import aot_compile
 
         cfg = self.config
         Lb = n_pages * self.pool.page_size
+        quant = self._kv_codec == "int8"
+        # with sampling the step returns the last-position LOGITS and
+        # the engine draws the first token host-side (same seeded noise
+        # stream as decode ticks); greedy keeps the in-step argmax
+        sampling = self._temperature > 0
 
-        def step(params, k_pages, v_pages, tokens, length, page_ids):
-            nxt, ks, vs = prefill_forward(cfg, params, tokens, length)
+        def step(params, k_pages, v_pages, *rest):
+            if quant:
+                k_scales, v_scales = rest[0], rest[1]
+                rest = rest[2:]
+            tokens, length, page_ids = rest
+            nxt, ks, vs = prefill_forward(cfg, params, tokens, length,
+                                          return_logits=sampling)
             for i in range(cfg.n_layers):
-                ki, vi = paged_prefill_write(k_pages[i], v_pages[i],
-                                             page_ids, ks[i][0], vs[i][0])
+                if quant:
+                    ki, vi, ksi, vsi = paged_prefill_write_quant(
+                        k_pages[i], v_pages[i], k_scales[i],
+                        v_scales[i], page_ids, ks[i][0], vs[i][0])
+                    k_scales = k_scales.at[i].set(ksi)
+                    v_scales = v_scales.at[i].set(vsi)
+                else:
+                    ki, vi = paged_prefill_write(
+                        k_pages[i], v_pages[i], page_ids, ks[i][0],
+                        vs[i][0])
                 k_pages = k_pages.at[i].set(ki)
                 v_pages = v_pages.at[i].set(vi)
+            if quant:
+                return nxt, k_pages, v_pages, k_scales, v_scales
             return nxt, k_pages, v_pages
 
-        args = (self.params, self._k_pages, self._v_pages,
-                np.zeros((1, Lb), np.int32), np.ones((1,), np.int32),
-                np.arange(1, n_pages + 1, dtype=np.int32))
-        cs = aot_compile(step, args, donate_argnums=(1, 2),
+        args = (self.params,) + self._pool_args() + (
+            np.zeros((1, Lb), np.int32), np.ones((1,), np.int32),
+            np.arange(1, n_pages + 1, dtype=np.int32))
+        cs = aot_compile(step, args, donate_argnums=self._pool_donate(),
                          bump=self._bump)
         return cs.compiled
 
@@ -260,7 +414,11 @@ class DecodeEngine:
         prefill bucket; run before serving so no request pays a
         compile. Returns the number of executables warmed."""
         n = 0
-        if self._decode_step is None:
+        if self._spec_k > 0:
+            if self._spec_step is None:
+                self._spec_step = self._build_spec_step()
+                n += 1
+        elif self._decode_step is None:
             self._decode_step = self._build_decode_step()
             n += 1
         for b in self._prefill_buckets():
@@ -365,15 +523,20 @@ class DecodeEngine:
             return 1
         ctx_tokens = req.prompt + req.generated
         ctx = len(ctx_tokens)
+        S = self.pool.page_size
+        # prefix cache: the longest indexed full-page chain of this
+        # context is SHARED (refcounted, zero new pages), capped so at
+        # least one suffix token remains to produce the next logits
+        shared = self.pool.match_prefix(ctx_tokens, limit=(ctx - 1) // S)
         npages = min(_next_pow2(self.pool.pages_for_tokens(ctx)),
                      self.pool.max_pages_per_seq)
         seq_id = self.sched.new_seq_id()
-        pages = self.pool.alloc_seq(seq_id, npages * self.pool.page_size)
+        pages = self.pool.alloc_seq_shared(seq_id, shared, npages * S)
         if pages is None:
             # pow2 rounding outgrew the exact-fit check: fall back to
             # the exact page count (compiles one extra bucket, rarely)
             npages = self.pool.pages_for_tokens(ctx)
-            pages = self.pool.alloc_seq(seq_id, ctx)
+            pages = self.pool.alloc_seq_shared(seq_id, shared, ctx)
         if pages is None:
             # raced out of pages (shouldn't happen single-threaded);
             # requeue at the front and try next tick
@@ -388,20 +551,33 @@ class DecodeEngine:
         if step is None:
             step = self._prefill_steps[npages] = \
                 self._build_prefill_step(npages)
-        Lb = npages * self.pool.page_size
+        Lb = npages * S
         toks = np.zeros((1, Lb), np.int32)
         toks[0, :ctx] = np.asarray(ctx_tokens, np.int32)
+        # shared prefix pages already hold this exact KV (content-hash
+        # guarantee + deterministic forward) and other sequences may be
+        # reading them: route their scatter slots at the trash page
+        write_ids = np.asarray(pages, np.int32).copy()
+        write_ids[:len(shared)] = 0
         pspan = tracing.Span("decode.prefill", parent=req.span,
                              clock=self._clock, ctx_tokens=ctx,
-                             n_pages=npages)
+                             n_pages=npages, shared_pages=len(shared))
         t0 = time.perf_counter()
         try:
             with pspan.activate():
-                nxt, self._k_pages, self._v_pages = step(
-                    self.params, self._k_pages, self._v_pages, toks,
-                    np.asarray([ctx], np.int32),
-                    np.asarray(pages, np.int32))
-            token = int(np.asarray(nxt)[0])
+                out = step(self.params, *self._pool_args(), toks,
+                           np.asarray([ctx], np.int32), write_ids)
+                self._store_pools(out[1:])
+            if self._temperature > 0:
+                from ...ops.pallas.sampling import fused_sample
+
+                noise = self._sample_rng.gumbel(
+                    size=(1, self.config.vocab_size)).astype(np.float32)
+                token = int(np.asarray(fused_sample(
+                    out[0], noise, self._temperature, self._top_k,
+                    self._top_p))[0])
+            else:
+                token = int(np.asarray(out[0])[0])
         except Exception as e:
             self.pool.free_seq(seq_id)
             self._count("decode_failed")
@@ -415,6 +591,10 @@ class DecodeEngine:
             self._reset_pool()
             return 1
         pspan.end()
+        # index every full page of this context (shared ones keep their
+        # entry): the next request with this prefix shares instead of
+        # allocating, and a finished holder parks them in the LRU
+        self.pool.register_prefix(seq_id, ctx_tokens)
         self._h_prefill.observe((time.perf_counter() - t0) * 1e3)
         self._count("decode_prefills")
         self._emit(req, token)
@@ -439,11 +619,41 @@ class DecodeEngine:
             pass
         kv_sharding = kv_pool_spec(self.mesh) \
             if self.mesh is not None else None
+        pool_dtype = "int8" if self._kv_codec == "int8" else self._dtype
         self._k_pages, self._v_pages = alloc_kv_pool(
             self.config.n_layers, self.pool.n_pages,
             self.pool.page_size, self.config.n_heads,
-            self.config.head_dim, dtype=self._dtype,
+            self.config.head_dim, dtype=pool_dtype,
             sharding=kv_sharding)
+        if self._kv_codec == "int8":
+            self._k_scales, self._v_scales = alloc_kv_scales(
+                self.config.n_layers, self.pool.n_pages,
+                self.pool.page_size)
+
+    def _maybe_cow(self, rs: RunningSeq) -> None:
+        """Copy-on-write guard before this slot's writes: prefix
+        sharing only ever shares FULL prompt pages and writes land past
+        the context, so an organic hit is impossible by construction —
+        but a proposer/table bug must corrupt a private copy, not a
+        page other sequences are reading."""
+        span = self._spec_k if self._spec_k > 0 else 0
+        for pos in {rs.length, rs.length + span}:
+            if not self.pool.needs_cow(rs.seq_id, pos):
+                continue
+            res = self.pool.cow_page(rs.seq_id, pos)
+            if res is None or res == -1:
+                continue   # already private / pool dry (preempt soon)
+            src, dst = res
+            self._count("kv_cow_copies")
+            self._k_pages = self._k_pages.at[:, dst].set(
+                self._k_pages[:, src])
+            self._v_pages = self._v_pages.at[:, dst].set(
+                self._v_pages[:, src])
+            if self._k_scales is not None:
+                self._k_scales = self._k_scales.at[:, dst].set(
+                    self._k_scales[:, src])
+                self._v_scales = self._v_scales.at[:, dst].set(
+                    self._v_scales[:, src])
 
     def _decode_once(self, active: Dict[int, RunningSeq]) -> int:
         # grow page tables for this step's writes; pool pressure
@@ -452,6 +662,7 @@ class DecodeEngine:
             rs = active[slot_id]
             if slot_id not in self.sched.slots:
                 continue   # preempted below while we iterated
+            self._maybe_cow(rs)
             while self.pool.append_token(rs.seq_id, rs.length + 1) == -1:
                 victim = self.sched.preempt_youngest()
                 if victim is None or victim is rs.req:
@@ -459,6 +670,8 @@ class DecodeEngine:
         active = self.sched.active()
         if not active:
             return 0
+        if self._spec_k > 0:
+            return self._spec_once(active)
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
         B, T = self.max_batch, self.pool.max_pages_per_seq
@@ -473,6 +686,11 @@ class DecodeEngine:
             lens[slot_id] = rs.length
             table[slot_id] = self.pool.table_row(rs.seq_id)
             mask[slot_id] = True
+        step_args = [self.params, *self._pool_args(), tokens,
+                     positions, table, lens, mask]
+        if self._temperature > 0:
+            step_args.append(self._sample_rng.gumbel(
+                size=(B, self.config.vocab_size)).astype(np.float32))
         # per-tick decode spans batch as ONE span per tick: a 4-slot
         # step is one dispatch, so it is one span carrying the slot's
         # request trace ids (the per-request tree reaches it by id)
@@ -484,10 +702,9 @@ class DecodeEngine:
         t0 = time.perf_counter()
         try:
             with tspan.activate():
-                nxt, self._k_pages, self._v_pages = self._decode_step(
-                    self.params, self._k_pages, self._v_pages, tokens,
-                    positions, table, lens, mask)
-                nxt = np.asarray(nxt)  # device sync: the step really ran
+                out = self._decode_step(*step_args)
+                nxt = np.asarray(out[0])  # device sync: step really ran
+                self._store_pools(out[1:])
         except Exception as e:
             tspan.fail(e)
             # no silent hang: every live request fails TYPED (the
@@ -530,6 +747,120 @@ class DecodeEngine:
                 self._finish(slot_id, rs)
         return emitted
 
+    def _spec_once(self, active: Dict[int, RunningSeq]) -> int:
+        """One speculative tick: propose up to ``spec_k`` drafts per
+        slot (host, model-free), verify all columns in ONE compiled
+        ragged step, accept the longest prefix matching greedy argmax —
+        every accepted token is bitwise what one-token-per-tick decode
+        would have emitted, there are just fewer dispatches."""
+        if self._spec_step is None:
+            self._spec_step = self._build_spec_step()
+        B, T = self.max_batch, self.pool.max_pages_per_seq
+        K = self._spec_k
+        K1 = K + 1
+        tokens = np.zeros((B, K1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        lens = np.zeros((B,), np.int32)
+        table = np.full((B, T), -1, np.int32)
+        colmask = np.zeros((B, K1), np.bool_)
+        drafts: Dict[int, List[int]] = {}
+        for slot_id, rs in active.items():
+            tokens[slot_id, 0] = rs.next_token
+            positions[slot_id] = rs.length
+            lens[slot_id] = rs.length
+            colmask[slot_id, 0] = True
+            # draft capacity grows the table opportunistically but
+            # NEVER preempts — speculation must not evict real work;
+            # drafts shrink to what the table already holds
+            k_cap = K
+            while k_cap > 0:
+                got = self.pool.append_token(rs.seq_id,
+                                             rs.length + 1 + k_cap)
+                if got is None:
+                    break
+                if got == -1:
+                    k_cap -= 1
+            d: List[int] = []
+            if k_cap > 0:
+                d = [int(t) for t in self.proposer.propose(
+                    rs.req.prompt + rs.req.generated, k_cap)][:k_cap]
+            for j, t in enumerate(d, start=1):
+                tokens[slot_id, j] = t
+                colmask[slot_id, j] = True
+            drafts[slot_id] = d
+            if d:
+                self._count("spec_proposed", len(d))
+            table[slot_id] = self.pool.table_row(rs.seq_id)
+        tspan = tracing.Span(
+            "decode.tick", parent=False, clock=self._clock,
+            slots=sorted(active), spec_k=K,
+            requests=[rs.req.trace_hex() for _, rs in sorted(
+                active.items()) if rs.req.span is not None])
+        t0 = time.perf_counter()
+        try:
+            with tspan.activate():
+                out = self._spec_step(self.params, *self._pool_args(),
+                                      tokens, positions, table, lens,
+                                      colmask)
+                greedy = np.asarray(out[0])   # (B, K+1) device sync
+                self._store_pools(out[1:])
+        except Exception as e:
+            tspan.fail(e)
+            for slot_id, rs in active.items():
+                self._count("decode_failed")
+                self._finish(slot_id, rs, error=RequestFailed(
+                    f"decode step dispatch failed: "
+                    f"{type(e).__name__}: {e}"))
+            self._reset_pool()
+            return len(active)
+        step_s = time.perf_counter() - t0
+        tspan.end()
+        self._h_step.observe(step_s * 1e3)
+        self._count("decode_steps")
+        with self._stats_lock:
+            self._fill_rows += len(active)
+            self._fill_capacity += B
+            fill = round(100.0 * self._fill_rows
+                         / max(1, self._fill_capacity), 2)
+        self._gauge("decode_batch_fill_pct", fill)
+        self._publish_cost(
+            [rs.length + 1 for rs in active.values()], step_s)
+        now = self._clock()
+        emitted = 0
+        for slot_id, rs in active.items():
+            d = drafts.get(slot_id, [])
+            g = greedy[slot_id]
+            # g_0 is the committed next token; draft d_j holds while it
+            # equals g_{j-1} (what greedy would have fed next), and then
+            # g_j — scored in the same dispatch — comes for free
+            accept = [int(g[0])]
+            for j in range(1, len(d) + 1):
+                if d[j - 1] != int(g[j - 1]):
+                    break
+                accept.append(int(g[j]))
+            if len(accept) > 1:
+                self._count("spec_accepted", len(accept) - 1)
+            rs.length += len(accept)
+            rs.next_token = accept[-1]
+            done = False
+            for tok in accept:
+                self._emit(rs.req, tok)
+                emitted += 1
+                if self._req_done(rs.req):
+                    done = True
+                    break
+            if rs.req.deadline is not None and now >= rs.req.deadline:
+                self._count("decode_deadline_expired")
+                self._finish(slot_id, rs, error=DeadlineExceeded(
+                    "deadline passed mid-generation; sequence dropped"))
+            elif done:
+                self._finish(slot_id, rs)
+        with self._stats_lock:
+            p = self._counters.get("spec_proposed", 0)
+            a = self._counters.get("spec_accepted", 0)
+        self._gauge("spec_accept_rate", round(a / max(1, p), 4))
+        return emitted
+
     def _publish_cost(self, live_lens: List[int], step_s: float) -> None:
         """Per-step cost gauges from the paged accounting (gathered
         LIVE pages count toward hbm_bytes, never the whole pool)."""
@@ -541,7 +872,8 @@ class DecodeEngine:
 
             c = paged_decode_cost(
                 self.config, live_lens, self.pool.page_size,
-                itemsize=np.dtype(self._dtype).itemsize)
+                itemsize=np.dtype(self._dtype).itemsize,
+                kv_codec=self._kv_codec)
             vals = {"step_model_flops": c["model_flops"],
                     "step_hbm_bytes": c["hbm_bytes"],
                     "step_comm_bytes": 0,

@@ -28,8 +28,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["DecodeModelConfig", "init_decode_params", "dense_forward",
-           "prefill_forward", "decode_forward", "reference_generate",
-           "param_shardings", "kv_pool_spec"]
+           "prefill_forward", "decode_forward", "spec_decode_forward",
+           "reference_generate", "param_shardings", "kv_pool_spec"]
 
 
 class DecodeModelConfig:
@@ -153,52 +153,149 @@ def dense_forward(cfg: DecodeModelConfig, params, tokens,
     return logits
 
 
-def prefill_forward(cfg: DecodeModelConfig, params, tokens, lens):
+def prefill_forward(cfg: DecodeModelConfig, params, tokens, lens,
+                    return_logits=False):
     """Prefill one padded prompt batch (B, Lp): next greedy token per
-    row (logits at position ``lens-1``) plus the per-layer K/V stacks
-    to scatter into pages. Pad positions are causal-masked dead weight —
-    they never influence positions < lens and their K/V is masked by
-    seq_lens at decode time."""
+    row (logits at position ``lens-1`` — or the raw last-position
+    logits with ``return_logits``, for host-side sampling) plus the
+    per-layer K/V stacks to scatter into pages. Pad positions are
+    causal-masked dead weight — they never influence positions < lens
+    and their K/V is masked by seq_lens at decode time."""
     import jax.numpy as jnp
 
     logits, ks, vs = dense_forward(cfg, params, tokens, collect_kv=True)
     idx = jnp.clip(lens - 1, 0, tokens.shape[1] - 1)
     last = jnp.take_along_axis(
         logits, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    if return_logits:
+        return last, ks, vs
     return jnp.argmax(last, axis=-1).astype(jnp.int32), ks, vs
 
 
 def decode_forward(cfg: DecodeModelConfig, params, tokens, positions,
-                   k_pages, v_pages, page_table, seq_lens, active):
+                   k_pages, v_pages, page_table, seq_lens, active,
+                   k_scales=None, v_scales=None, return_logits=False):
     """One ragged decode step at fixed max-batch: write each sequence's
     new K/V into its page slot, attend over its live pages (+ the token
-    just written), return the next greedy token and the updated pools.
+    just written), return the next greedy token (or, with
+    ``return_logits``, the raw logits for in-step sampling) and the
+    updated pools.
 
     ``tokens``/``positions``/``seq_lens``/``active`` are (B,);
     ``k_pages``/``v_pages`` are the stacked (n_layers, P, S, H, D)
-    pools (donated through the compiled step)."""
+    pools (donated through the compiled step). With
+    ``k_scales``/``v_scales`` (n_layers, P, S) the pools are int8
+    (``kv_codec="int8"``): writes row-encode through the ps/codec
+    layout and attention dequants inside the page gather — the updated
+    scale planes ride along in the return."""
     import jax.numpy as jnp
 
-    from ...ops.pallas.paged_attention import paged_attention, paged_write
+    from ...ops.pallas.paged_attention import (paged_attention,
+                                               paged_write,
+                                               paged_write_quant)
 
+    quant = k_scales is not None
     maxp = cfg.max_context - 1
     h = params["tok_emb"][tokens] \
         + params["pos_emb"][jnp.clip(positions, 0, maxp)]
-    pools = {"k": k_pages, "v": v_pages}
+    pools = {"k": k_pages, "v": v_pages,
+             "ks": k_scales, "vs": v_scales}
 
     def write(i, k, v):
-        ki, vi = paged_write(pools["k"][i], pools["v"][i], page_table,
-                             positions, k, v, active)
+        if quant:
+            ki, vi, ksi, vsi = paged_write_quant(
+                pools["k"][i], pools["v"][i], pools["ks"][i],
+                pools["vs"][i], page_table, positions, k, v, active)
+            pools["ks"] = pools["ks"].at[i].set(ksi)
+            pools["vs"] = pools["vs"].at[i].set(vsi)
+        else:
+            ki, vi = paged_write(pools["k"][i], pools["v"][i],
+                                 page_table, positions, k, v, active)
         pools["k"] = pools["k"].at[i].set(ki)
         pools["v"] = pools["v"].at[i].set(vi)
 
     def attn(i, q, k, v):
-        return paged_attention(q, pools["k"][i], pools["v"][i],
-                               page_table, seq_lens + 1)
+        return paged_attention(
+            q, pools["k"][i], pools["v"][i], page_table, seq_lens + 1,
+            k_scales=pools["ks"][i] if quant else None,
+            v_scales=pools["vs"][i] if quant else None)
 
     logits = _forward_layers(cfg, params, h, attn, write_fn=write)
-    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-            pools["k"], pools["v"])
+    out = logits if return_logits \
+        else jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if quant:
+        return out, pools["k"], pools["v"], pools["ks"], pools["vs"]
+    return out, pools["k"], pools["v"]
+
+
+def spec_decode_forward(cfg: DecodeModelConfig, params, tokens,
+                        positions, k_pages, v_pages, page_table,
+                        seq_lens, active, k_scales=None, v_scales=None):
+    """Speculative verify step: score K+1 token columns per slot in ONE
+    ragged dispatch. ``tokens`` (B, K+1) is [next_token, d_1..d_K] —
+    the committed next token plus the proposer's drafts; column j's
+    K/V is written at position ``positions + j`` and its query attends
+    with seq_len ``positions + j + 1`` (write-then-attend, so each
+    draft sees exactly the tokens before it — causality by the ragged
+    mask, not a dense triangle). Returns the greedy argmax per column
+    (B, K+1): g_0 is the dense-equivalent next token; g_j verifies
+    draft d_j (accept while d_j == g_{j-1}), so the ACCEPTED prefix is
+    bitwise what token-by-token greedy decode would have produced.
+
+    ``active`` is (B, K+1): column 0 live per slot, draft columns live
+    only where a draft was proposed and table capacity exists (dead
+    columns write to the trash page and their outputs are ignored).
+    Stale K/V past the accepted length is invisible — seq_lens never
+    reaches it before the slot overwrites it."""
+    import jax.numpy as jnp
+
+    from ...ops.pallas.paged_attention import (paged_attention,
+                                               paged_write,
+                                               paged_write_quant)
+
+    quant = k_scales is not None
+    B, K1 = tokens.shape
+    cols = jnp.arange(K1, dtype=jnp.int32)
+    pos = positions[:, None] + cols[None, :]               # (B, K+1)
+    maxp = cfg.max_context - 1
+    h = params["tok_emb"][tokens] \
+        + params["pos_emb"][jnp.clip(pos, 0, maxp)]
+    h = h.reshape(B * K1, cfg.hidden)
+    # flatten the (slot, column) grid to B*(K+1) ragged rows: every row
+    # shares its slot's page table but carries its OWN write position
+    # and seq_len — the same kernels, just a wider batch
+    flat_pos = pos.reshape(-1)
+    flat_lens = (pos + 1).reshape(-1)
+    flat_active = active.reshape(-1)
+    flat_table = jnp.repeat(page_table, K1, axis=0)        # (B*K1, T)
+    pools = {"k": k_pages, "v": v_pages,
+             "ks": k_scales, "vs": v_scales}
+
+    def write(i, k, v):
+        if quant:
+            ki, vi, ksi, vsi = paged_write_quant(
+                pools["k"][i], pools["v"][i], pools["ks"][i],
+                pools["vs"][i], flat_table, flat_pos, k, v, flat_active)
+            pools["ks"] = pools["ks"].at[i].set(ksi)
+            pools["vs"] = pools["vs"].at[i].set(vsi)
+        else:
+            ki, vi = paged_write(pools["k"][i], pools["v"][i],
+                                 flat_table, flat_pos, k, v, flat_active)
+        pools["k"] = pools["k"].at[i].set(ki)
+        pools["v"] = pools["v"].at[i].set(vi)
+
+    def attn(i, q, k, v):
+        return paged_attention(
+            q, pools["k"][i], pools["v"][i], flat_table, flat_lens,
+            k_scales=pools["ks"][i] if quant else None,
+            v_scales=pools["vs"][i] if quant else None)
+
+    logits = _forward_layers(cfg, params, h, attn, write_fn=write)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy = greedy.reshape(B, K1)
+    if quant:
+        return greedy, pools["k"], pools["v"], pools["ks"], pools["vs"]
+    return greedy, pools["k"], pools["v"]
 
 
 def reference_generate(cfg: DecodeModelConfig, params, prompt,

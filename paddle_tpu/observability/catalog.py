@@ -110,6 +110,14 @@ SCALARS = {
     "decode_batch_fill_pct": ("gauge", "cumulative mean live slots / max_batch per decode step, percent"),
     "kv_pages_in_use": ("gauge", "KV pool pages currently allocated to live sequences"),
     "kv_page_evictions": ("gauge", "cumulative KV pages reclaimed by preemption/eviction"),
+    # decode token economics (speculative decoding + prefix cache + COW)
+    "spec_proposed": ("counter", "draft tokens proposed to the speculative verify step"),
+    "spec_accepted": ("counter", "draft tokens accepted (bitwise equal to what greedy decode would emit)"),
+    "spec_accept_rate": ("gauge", "cumulative spec_accepted / spec_proposed"),
+    "kv_prefix_hits": ("counter", "KV pages served from the shared-prefix index instead of fresh allocation"),
+    "kv_pages_shared": ("gauge", "KV pages currently backing more than one live sequence (refcount > 1)"),
+    "kv_pages_cached": ("gauge", "zero-ref prefix pages parked in the reclaimable LRU"),
+    "kv_cow_copies": ("counter", "copy-on-write page copies (a write targeted a shared/indexed page)"),
     # observability plane itself
     "metrics_label_overflow": ("counter", "label sets folded into the overflow series by the cardinality cap"),
     "flightrec_dumps": ("counter", "flight-recorder postmortem dumps written"),

@@ -32,6 +32,12 @@ define_flag("flash_autotune", True,
             "(cudnn_exhaustive_search parity). TPU only; "
             "FLAGS_flash_short_seq=True overrides to always-short")
 
+define_flag("sample_autotune", True,
+            "Time the fused sampling Pallas kernel against the XLA "
+            "path once per (batch, vocab, dtype, top_k) shape and "
+            "dispatch the winner (persisted in the same disk cache as "
+            "the flash/paged verdicts). TPU only")
+
 define_flag("paged_autotune", True,
             "Time the ragged paged-attention Pallas kernel against the "
             "XLA gather path once per (batch, pages, page_size, heads, "
@@ -317,6 +323,95 @@ def best_paged_impl(b, pages, page_size, h, d, dtype,
     disk[_disk_key(key)] = winner
     _save_disk()
     return winner
+
+
+def sample_cache_key(b, v, dtype, top_k) -> tuple:
+    """The fused-sampling verdict key, namespaced like the paged keys
+    in the ONE memo/disk cache."""
+    return ("sample", int(b), int(v), str(dtype), int(top_k))
+
+
+def best_sample_impl(b, v, dtype, top_k) -> str | None:
+    """'pallas' | 'xla' for this sampling shape, timed on the device
+    (memoized + disk-persisted like the flash/paged verdicts), or None
+    when no candidate could be timed. Must only be called with
+    _sample_ok shapes on a TPU backend."""
+    key = sample_cache_key(b, v, dtype, top_k)
+    if key in _cache:
+        _stats["mem_hits"] += 1
+        return _cache[key]
+
+    import jax
+    import jax.numpy as jnp
+
+    disk = _load_disk()
+    hit = disk.get(_disk_key(key))
+    if hit in ("pallas", "xla"):
+        _stats["disk_hits"] += 1
+        try:
+            from ... import profiler
+
+            profiler.bump_counter("autotune_disk_hits")
+        except Exception:
+            pass  # counter is best-effort; the verdict still serves
+        _cache[key] = hit
+        return hit
+
+    from ...utils.timing import timeit
+    from . import sampling as sp
+
+    logits = jax.random.normal(jax.random.key(5), (b, v),
+                               jnp.float32).astype(dtype)
+    noise = -jnp.log(-jnp.log(jax.random.uniform(
+        jax.random.key(6), (b, v), jnp.float32, 1e-6, 1.0 - 1e-6)))
+    candidates = {
+        "pallas": jax.jit(lambda ll: sp._fused_sample_pallas(
+            ll, noise, 1.0, top_k)),
+        "xla": jax.jit(lambda ll: sp._xla_sample(
+            ll, noise, 1.0, top_k, 1.0)),
+    }
+    times = {}
+    for name, fn in candidates.items():
+        try:
+            times[name] = timeit(fn, logits, iters=_ITERS)
+        except Exception as e:  # candidate fails to compile/run: skip it
+            sys.stderr.write(f"sample autotune: {name} failed "
+                             f"({type(e).__name__}: {e})\n")
+    if not times:
+        sys.stderr.write("sample autotune: all candidates failed; "
+                         "keeping static dispatch\n")
+        return None
+    winner = min(times, key=times.get)
+    sys.stderr.write(
+        f"sample autotune (b={b} v={v} top_k={top_k}): "
+        + " ".join(f"{n}={t:.3f}ms" for n, t in sorted(times.items()))
+        + f" -> {winner}\n")
+    _stats["timed"] += 1
+    _cache[key] = winner
+    disk[_disk_key(key)] = winner
+    _save_disk()
+    return winner
+
+
+def fused_sample_choice(logits, top_k) -> str | None:
+    """The sampling dispatch entry: the tuned impl name, or None when
+    autotuning does not apply (not TPU / flag off) — None keeps the
+    static dispatch (kernel-first with XLA fallback)."""
+    from ...framework.bringup import TPU_PLATFORMS
+
+    if not get_flag("sample_autotune"):
+        return None
+    import jax
+
+    if jax.default_backend() not in TPU_PLATFORMS:
+        return None
+    b, v = logits.shape
+    try:
+        return best_sample_impl(b, v, logits.dtype, top_k)
+    except Exception as e:
+        sys.stderr.write(f"sample autotune failed, static dispatch "
+                         f"keeps ({type(e).__name__}: {e})\n")
+        return None
 
 
 def paged_attention_choice(q, k_pages, page_table) -> str | None:

@@ -44,7 +44,8 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 _F32 = jnp.float32
 
-__all__ = ["paged_attention", "paged_write", "paged_prefill_write"]
+__all__ = ["paged_attention", "paged_write", "paged_prefill_write",
+           "paged_write_quant", "paged_prefill_write_quant"]
 
 
 # ---------------------------------------------------------------------------
@@ -66,6 +67,32 @@ def _xla_paged_attention(q, k_pages, v_pages, page_table, seq_lens):
                   s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhk,bkhd->bhd", p, v.astype(_F32))
+    return out.astype(q.dtype)
+
+
+def _xla_paged_attention_quant(q, k_pages, v_pages, k_scales, v_scales,
+                               page_table, seq_lens):
+    """Quantized-pool twin of :func:`_xla_paged_attention`: the pool
+    holds int8 rows with one f32 scale per token row (codec.py's
+    ``jnp_encode_kv_rows`` layout, block = H*D); dequant happens inside
+    the gather, so nothing f32-sized ever persists in HBM."""
+    B, H, D = q.shape
+    S = k_pages.shape[1]
+    T = page_table.shape[1]
+    safe = jnp.maximum(page_table, 0)                      # (B, T)
+    ks = k_scales[safe].reshape(B, T * S)                  # (B, K)
+    vs = v_scales[safe].reshape(B, T * S)
+    k = k_pages[safe].reshape(B, T * S, H, D).astype(_F32)
+    v = v_pages[safe].reshape(B, T * S, H, D).astype(_F32)
+    k = k * ks[..., None, None]
+    v = v * vs[..., None, None]
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(_F32), k,
+                   preferred_element_type=_F32) / math.sqrt(D)
+    pos = jnp.arange(T * S, dtype=jnp.int32)
+    s = jnp.where(pos[None, None, :] < seq_lens[:, None, None],
+                  s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v)
     return out.astype(q.dtype)
 
 
@@ -158,6 +185,98 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_table, seq_lens):
     )(safe_table, seq_lens.astype(jnp.int32), q, k_pages, v_pages)
 
 
+def _paged_attn_kernel_quant(pt_ref, lens_ref, q_ref, k_ref, v_ref,
+                             ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc,
+                             *, page_size, sm_scale):
+    """Quantized twin of :func:`_paged_attn_kernel`: the page DMA
+    brings int8 rows + their per-row f32 scales into VMEM and the
+    dequant (one multiply per row) happens right there — the f32 view
+    of a page exists only transiently in registers/VMEM, which is the
+    whole ~4x pool-headroom win."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    num_pages = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc[...], _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc[...])
+        acc_sc[...] = jnp.zeros_like(acc_sc[...])
+
+    length = lens_ref[b]
+
+    @pl.when(j * page_size < length)
+    def _page():
+        q = q_ref[...].astype(_F32) * sm_scale          # (H, D)
+        kq = k_ref[...].astype(_F32) * ks_ref[...][:, None, None]
+        vq = v_ref[...].astype(_F32) * vs_ref[...][:, None, None]
+        k = jnp.swapaxes(kq, 0, 1)                      # (H, S, D)
+        v = jnp.swapaxes(vq, 0, 1)                      # (H, S, D)
+        H, S = q.shape[0], k.shape[1]
+        s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (0,))),
+                                preferred_element_type=_F32)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, S), 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_prev = m_sc[:, 0]
+        l_prev = l_sc[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, 0] = alpha * l_prev + jnp.sum(p, axis=1)
+        m_sc[:, 0] = m_new
+        pv = jax.lax.dot_general(p, v, (((1,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=_F32)
+        acc_sc[...] = acc_sc[...] * alpha[:, None] + pv
+
+    @pl.when(j == num_pages - 1)
+    def _flush():
+        norm = jnp.maximum(l_sc[:, 0], 1e-30)[:, None]
+        o_ref[...] = (acc_sc[...] / norm).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _paged_attention_pallas_quant(q, k_pages, v_pages, k_scales,
+                                  v_scales, page_table, seq_lens):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    S = k_pages.shape[1]
+    T = page_table.shape[1]
+    sm_scale = 1.0 / math.sqrt(D)
+    safe_table = jnp.maximum(page_table, 0).astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,       # page_table, seq_lens
+        grid=(B, T),
+        in_specs=[
+            pl.BlockSpec((None, H, D), lambda b, j, pt, lens: (b, 0, 0)),
+            pl.BlockSpec((None, S, H, D),
+                         lambda b, j, pt, lens: (pt[b, j], 0, 0, 0)),
+            pl.BlockSpec((None, S, H, D),
+                         lambda b, j, pt, lens: (pt[b, j], 0, 0, 0)),
+            pl.BlockSpec((None, S), lambda b, j, pt, lens: (pt[b, j], 0)),
+            pl.BlockSpec((None, S), lambda b, j, pt, lens: (pt[b, j], 0)),
+        ],
+        out_specs=pl.BlockSpec((None, H, D),
+                               lambda b, j, pt, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), _F32),
+            pltpu.VMEM((H, 1), _F32),
+            pltpu.VMEM((H, D), _F32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_attn_kernel_quant, page_size=S,
+                          sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+    )(safe_table, seq_lens.astype(jnp.int32), q, k_pages, v_pages,
+      k_scales, v_scales)
+
+
 # ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------
@@ -182,17 +301,46 @@ def _escape_pinned() -> bool:
     return os.environ.get("PADDLE_PAGED_ATTENTION", "").strip() == "0"
 
 
-def paged_attention(q, k_pages, v_pages, page_table, seq_lens):
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens,
+                    k_scales=None, v_scales=None):
     """Decode-step attention over the paged KV pool: best path for the
     backend (Pallas when eligible — autotune-arbitrated in the window
     where it competes with XLA — else the XLA gather fallback). One
-    counter bump per dispatch decision (trace time under jit)."""
+    counter bump per dispatch decision (trace time under jit).
+
+    When ``k_scales``/``v_scales`` (P, S) are given the pool is int8
+    (``kv_codec="int8"``): both paths dequant per token row inside the
+    gather/page-DMA; the quant leg keeps the same escape env and
+    counters but skips the f32 autotune verdict (different memory
+    traffic, not comparable)."""
     from .counters import bump
 
+    quant = k_scales is not None
     if _escape_pinned():
         bump("paged_attention", "xla", "PADDLE_PAGED_ATTENTION=0 pin")
+        if quant:
+            return _xla_paged_attention_quant(q, k_pages, v_pages,
+                                              k_scales, v_scales,
+                                              page_table, seq_lens)
         return _xla_paged_attention(q, k_pages, v_pages, page_table,
                                     seq_lens)
+    if quant:
+        if _paged_ok(q, k_pages):
+            try:
+                out = _paged_attention_pallas_quant(
+                    q, k_pages, v_pages, k_scales, v_scales,
+                    page_table, seq_lens)
+                bump("paged_attention", "pallas")
+                return out
+            except Exception as e:
+                bump("paged_attention", "xla",
+                     f"kernel error {type(e).__name__}: {e}")
+        else:
+            bump("paged_attention", "xla",
+                 f"dispatch ineligible (q {tuple(q.shape)}, page "
+                 f"{k_pages.shape[1]}; gate in _paged_ok)")
+        return _xla_paged_attention_quant(q, k_pages, v_pages, k_scales,
+                                          v_scales, page_table, seq_lens)
     if _paged_ok(q, k_pages):
         from .autotune import paged_attention_choice
 
@@ -254,3 +402,47 @@ def paged_prefill_write(k_pages, v_pages, page_ids, new_k, new_v):
     v_pages = v_pages.at[page_ids].set(
         new_v.reshape(n, S, H, D).astype(v_pages.dtype))
     return k_pages, v_pages
+
+
+def paged_write_quant(k_pages, v_pages, k_scales, v_scales, page_table,
+                      positions, new_k, new_v, active=None):
+    """int8-pool twin of :func:`paged_write`: each token row is
+    encoded (codec.py ``jnp_encode_kv_rows``, one scale per row) and
+    both the int8 payload and the f32 scale land in the slot the page
+    table names. Trash-page-0 routing for inactive lanes is identical
+    — their scales land there too, harmlessly."""
+    from ...ps.codec import jnp_encode_kv_rows
+
+    S = k_pages.shape[1]
+    pidx = jnp.take_along_axis(page_table,
+                               (positions // S)[:, None], axis=1)[:, 0]
+    pidx = jnp.maximum(pidx, 0)
+    if active is not None:
+        pidx = jnp.where(active, pidx, 0)
+    off = positions % S
+    qk, sk = jnp_encode_kv_rows(new_k)                  # (B,H,D) / (B,)
+    qv, sv = jnp_encode_kv_rows(new_v)
+    k_pages = k_pages.at[pidx, off].set(qk)
+    v_pages = v_pages.at[pidx, off].set(qv)
+    k_scales = k_scales.at[pidx, off].set(sk)
+    v_scales = v_scales.at[pidx, off].set(sv)
+    return k_pages, v_pages, k_scales, v_scales
+
+
+def paged_prefill_write_quant(k_pages, v_pages, k_scales, v_scales,
+                              page_ids, new_k, new_v):
+    """int8-pool twin of :func:`paged_prefill_write`: the (n * S, H, D)
+    prompt K/V is row-encoded and scattered as whole pages, scales
+    reshaped alongside as (n, S)."""
+    from ...ps.codec import jnp_encode_kv_rows
+
+    S = k_pages.shape[1]
+    n = page_ids.shape[0]
+    H, D = new_k.shape[-2], new_k.shape[-1]
+    qk, sk = jnp_encode_kv_rows(new_k)              # (n*S,H,D) / (n*S,)
+    qv, sv = jnp_encode_kv_rows(new_v)
+    k_pages = k_pages.at[page_ids].set(qk.reshape(n, S, H, D))
+    v_pages = v_pages.at[page_ids].set(qv.reshape(n, S, H, D))
+    k_scales = k_scales.at[page_ids].set(sk.reshape(n, S))
+    v_scales = v_scales.at[page_ids].set(sv.reshape(n, S))
+    return k_pages, v_pages, k_scales, v_scales

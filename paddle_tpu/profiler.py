@@ -229,6 +229,9 @@ DECODE_COUNTER_NAMES = (
     "decode_prefills", "decode_shed", "decode_deadline_expired",
     "decode_preempted", "decode_failed", "decode_batch_fill_pct",
     "kv_pages_in_use", "kv_page_evictions",
+    "spec_proposed", "spec_accepted", "spec_accept_rate",
+    "kv_prefix_hits", "kv_pages_shared", "kv_pages_cached",
+    "kv_cow_copies",
 )
 
 # serving-path counters (ServingEngine.counters merges these plus the

@@ -22,6 +22,7 @@ import numpy as np
 __all__ = [
     "QUANT_BLOCK", "CODEC_IDS", "CODEC_NAMES", "codec_name",
     "encoded_nbytes", "ring_nbytes", "np_encode", "np_decode",
+    "jnp_encode_kv_rows", "jnp_decode_kv_rows",
 ]
 
 #: elements covered by one f32 scale in the blocked int8 encoding —
@@ -95,6 +96,36 @@ def np_encode(values: np.ndarray, codec: str,
     safe = np.where(scale > 0, scale, 1.0)
     q = np.clip(np.rint(xb / safe[:, None]), -127, 127).astype(np.int8)
     return scale.tobytes() + q.reshape(-1)[:n].tobytes()
+
+
+def jnp_encode_kv_rows(x):
+    """Trace-time int8 encode for KV page writes: one symmetric scale
+    per TOKEN ROW — the blocked int8 layout with ``block`` = one row's
+    ``H * D`` elements, so ``encoded_nbytes(n, "int8", block=H*D)`` is
+    the page's exact byte cost. ``x`` is (..., H, D); returns the int8
+    payload (same shape) and the f32 scales (...,). jnp.rint matches
+    np_encode's half-even rounding bit for bit.
+
+    Lazy jax import: the module itself stays importable on jax-free PS
+    boxes (the PR 9 contract)."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = (amax / 127.0).astype(jnp.float32)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.rint(xf / safe[..., None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def jnp_decode_kv_rows(q, scale):
+    """Trace-time dequant twin of :func:`jnp_encode_kv_rows`: int8
+    payload (..., H, D) × per-row scales (...,) → f32."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None,
+                                                             None]
 
 
 def np_decode(raw: bytes, n_elems: int, codec: str,

@@ -332,10 +332,23 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
                 h, d = qshape[-2], qshape[-1]
                 page_size = kshape[-3]
                 live_tokens = _prod(tshape) * page_size
-                item = _itemsize(getattr(block.vars.get(kp_name),
-                                         "dtype", "float32"))
+                kp_dtype = str(getattr(block.vars.get(kp_name),
+                                       "dtype", "float32"))
+                if kp_dtype in ("int8", "uint8"):
+                    # quantized pool (kv_codec="int8"): the DMA moves
+                    # the ENCODED page — int8 payload + one f32 scale
+                    # per token row (ps/codec blocked layout with
+                    # block = H*D), the same closed form the wire
+                    # codec and the engine gauges share
+                    from ..ps.codec import encoded_nbytes
+
+                    kv_bytes = 2 * live_tokens * encoded_nbytes(
+                        h * d, "int8", block=h * d)
+                else:
+                    kv_bytes = (2 * live_tokens * h * d
+                                * _itemsize(kp_dtype))
                 flops = 4 * h * d * live_tokens   # 2 matmuls x 2 F/MAC
-                hbm = (2 * live_tokens * h * d * item   # live K+V pages
+                hbm = (kv_bytes                         # live K+V pages
                        + sum(nbytes_of(n, b) for n in (q_name,) if n)
                        + sum(nbytes_of(n, b) for n in outs)
                        + (nbytes_of(pt_name, b) if pt_name else 0))
@@ -403,7 +416,8 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
 
 
 def paged_decode_cost(config, live_lens: Sequence[int], page_size: int,
-                      itemsize: int = 4) -> Dict[str, float]:
+                      itemsize: int = 4,
+                      kv_codec: str = "off") -> Dict[str, float]:
     """Analytic cost of ONE ragged paged decode step — the decode
     engine's source for the ``step_model_flops`` / ``step_hbm_bytes``
     / ``mfu`` / ``arith_intensity`` gauges (PR 12 plane), kept truthful
@@ -421,7 +435,12 @@ def paged_decode_cost(config, live_lens: Sequence[int], page_size: int,
     per layer the two attention matmuls over the live context (4·E·ctx).
     HBM: the weights stream once per step (decode is bandwidth-bound
     precisely because of this) + the live K/V pages read and the new
-    token's K/V written."""
+    token's K/V written.
+
+    With ``kv_codec="int8"`` the K/V page traffic is charged at the
+    ENCODED byte cost — ``ps.codec.encoded_nbytes(E, "int8", block=E)``
+    per token row (int8 payload + one f32 scale), the exact layout the
+    pool stores — while params/logits stay at ``itemsize``."""
     L = int(config.n_layers)
     H = int(config.n_heads)
     D = int(config.head_dim)
@@ -429,6 +448,12 @@ def paged_decode_cost(config, live_lens: Sequence[int], page_size: int,
     F = int(config.ffn_dim)
     V = int(config.vocab_size)
     n = len(live_lens)
+    if kv_codec == "int8":
+        from ..ps.codec import encoded_nbytes
+
+        kv_row_bytes = encoded_nbytes(E, "int8", block=E)
+    else:
+        kv_row_bytes = E * itemsize
     flops = 0
     page_tokens = 0
     for ln in live_lens:
@@ -437,9 +462,11 @@ def paged_decode_cost(config, live_lens: Sequence[int], page_size: int,
         page_tokens += -(-int(ln) // int(page_size)) * int(page_size)
     param_bytes = (L * (4 * E * E + 2 * E * F) + 2 * V * E) * itemsize
     hbm = (param_bytes
-           + 2 * L * page_tokens * E * itemsize      # live K+V pages read
-           + 2 * L * n * E * itemsize                # new K+V written
-           + n * V * itemsize)                       # logits out
+           + 2 * L * page_tokens * kv_row_bytes     # live K+V pages read
+           + 2 * L * n * kv_row_bytes               # new K+V written
+           + n * V * itemsize)                      # logits out
     return {"model_flops": int(flops), "hbm_bytes": int(hbm),
             "arith_intensity": flops / hbm if hbm else 0.0,
-            "live_slots": n, "live_page_tokens": int(page_tokens)}
+            "live_slots": n, "live_page_tokens": int(page_tokens),
+            "kv_codec": kv_codec,
+            "kv_row_bytes": int(kv_row_bytes)}

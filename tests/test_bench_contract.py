@@ -249,6 +249,29 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert isinstance(last["decode_slowest_trace"], str) \
         and len(last["decode_slowest_trace"]) == 16, last
     assert last["decode_slowest_trace_ms"] > 0, last
+    # decode token-economics contract: speculative decoding is EXACT
+    # under greedy (spec_parity) and pays for itself (every accepted
+    # draft token is a ragged step never run → strictly fewer steps
+    # and more tokens/sec than the spec-off leg); int8 KV pages stay
+    # inside the quant-loss gate at ~2x+ pool headroom; the repeated
+    # prompt hits the shared-prefix index
+    for key in ("spec_tokens_per_sec", "spec_accept_rate", "spec_steps",
+                "spec_proposed", "spec_accepted", "spec_parity",
+                "spec_beats_dense", "kv_quant_loss_delta",
+                "kv_pool_headroom_x", "kv_prefix_hits",
+                "kv_prefix_parity"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["spec_parity"] is True, last
+    assert last["spec_proposed"] >= last["spec_accepted"] > 0, last
+    assert last["spec_accept_rate"] > 0, last
+    assert last["spec_steps"] < last["decode_steps"], last
+    assert last["spec_beats_dense"] is True, last
+    assert last["spec_tokens_per_sec"] > \
+        last["decode_tokens_per_sec"], last
+    assert 0 <= last["kv_quant_loss_delta"] <= 5e-2, last
+    assert last["kv_pool_headroom_x"] >= 2.0, last
+    assert last["kv_prefix_hits"] > 0, last
+    assert last["kv_prefix_parity"] is True, last
     # MULTICHIP probe contract: the DP×TP static-executor step (forced
     # 8-device CPU topology in a subprocess) matches the single-chip
     # loss within the established gm tolerance, the row-parallel hint

@@ -718,3 +718,78 @@ def test_bench_ir_flops_matches_nmt_closed_form():
                                      dec_layers=le,
                                      head_transform=False)
     assert abs(ir - closed) / closed <= 0.02
+
+
+# ---------------------------------------------------------------------------
+# int8 KV pages in the cost model: the ONE closed form (ps.codec.
+# encoded_nbytes) prices the wire codec, the decode cost, and the IR
+# rule — they can never drift apart
+# ---------------------------------------------------------------------------
+def test_paged_decode_cost_int8_charges_encoded_bytes():
+    from paddle_tpu.inference.decode import DecodeModelConfig
+    from paddle_tpu.ps.codec import encoded_nbytes
+    from paddle_tpu.static.cost_model import paged_decode_cost
+
+    cfg = DecodeModelConfig(vocab_size=32, n_layers=2, n_heads=2,
+                            head_dim=8, ffn_dim=32, max_context=64)
+    E = cfg.hidden
+    off = paged_decode_cost(cfg, [9, 17], page_size=8, itemsize=4)
+    on = paged_decode_cost(cfg, [9, 17], page_size=8, itemsize=4,
+                           kv_codec="int8")
+    assert off["kv_codec"] == "off" and on["kv_codec"] == "int8"
+    # the closed form, verbatim: one f32 scale per token row
+    assert off["kv_row_bytes"] == E * 4
+    assert on["kv_row_bytes"] == encoded_nbytes(E, "int8", block=E) \
+        == E + 4
+    # page traffic shrinks by exactly the row-byte ratio; flops don't
+    page_tokens = off["live_page_tokens"]
+    saved = 2 * cfg.n_layers * (page_tokens + 2) * (E * 4 - (E + 4))
+    assert off["hbm_bytes"] - on["hbm_bytes"] == saved
+    assert on["model_flops"] == off["model_flops"]
+    assert on["arith_intensity"] > off["arith_intensity"]
+
+
+def test_program_cost_paged_attention_int8_rule():
+    """An int8 KPages operand flips the IR rule to ENCODED page bytes
+    (payload + scale rows), closed-form-checked against
+    encoded_nbytes."""
+    from paddle_tpu.ps.codec import encoded_nbytes
+    from paddle_tpu.static.cost_model import program_cost
+    from paddle_tpu.static.ir import Program
+
+    def build(kv_dtype):
+        prog = Program()
+        b = prog.global_block
+        b.create_var("q", shape=[4, 8, 64], dtype="float32")
+        b.create_var("kp", shape=[1000, 128, 8, 64], dtype=kv_dtype)
+        b.create_var("vp", shape=[1000, 128, 8, 64], dtype=kv_dtype)
+        b.create_var("pt", shape=[4, 4], dtype="int32")
+        b.create_var("lens", shape=[4], dtype="int32")
+        b.create_var("out", shape=[4, 8, 64], dtype="float32")
+        b.append_op("paged_attention",
+                    inputs={"Q": ["q"], "KPages": ["kp"],
+                            "VPages": ["vp"], "PageTable": ["pt"],
+                            "SeqLens": ["lens"]},
+                    outputs={"Out": ["out"]})
+        (op,) = program_cost(prog).ops
+        return op
+
+    f32 = build("float32")
+    i8 = build("int8")
+    live_tokens = 4 * 4 * 128
+    row = 8 * 64
+    delta = 2 * live_tokens * (row * 4 - encoded_nbytes(row, "int8",
+                                                       block=row))
+    assert f32.hbm_bytes - i8.hbm_bytes == delta
+    assert i8.flops == f32.flops
+
+
+def test_perf_report_metrics_decode_section():
+    from tools.perf_report import render_metrics
+
+    out = render_metrics({"decode_tokens": 128.0, "spec_accept_rate":
+                          0.42, "kv_prefix_hits": 3.0, "mfu": 0.1})
+    assert "decode token economics" in out
+    assert "spec_accept_rate" in out and "0.42" in out
+    # absent decode samples -> no empty section
+    assert "decode token economics" not in render_metrics({"mfu": 0.1})

@@ -460,3 +460,216 @@ def test_decode_load_gen_deterministic_summary():
         assert key in s1, s1
     assert s1["ttft_p99_ms"] >= s1["ttft_p50_ms"] > 0
     assert s1["itl_p50_ms"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# decode token economics: spec decode, int8 KV pages, prefix cache,
+# sampling (this PR's plane)
+# ---------------------------------------------------------------------------
+from paddle_tpu.inference.decode import NgramProposer  # noqa: E402
+
+LOOP_PROMPT = [5, 9, 2, 5, 9, 2, 5, 9, 2, 5, 9, 2]     # period-3 motif
+
+
+def test_ngram_proposer_prompt_lookup():
+    p = NgramProposer(max_n=3)
+    # periodic context: the tail 3-gram recurs, continuation is the
+    # cycle itself
+    assert p.propose([1, 2, 3, 1, 2, 3, 1, 2], 3) == [3, 1, 2]
+    # most RECENT prior occurrence wins
+    assert p.propose([7, 1, 7, 5, 7], 2) == [5, 7]
+    # falls back to shorter n-grams before giving up
+    assert p.propose([4, 8, 9, 8], 2) == [9, 8]
+    assert p.propose([1, 2, 3], 2) == []           # no recurrence
+    assert p.propose([1], 3) == []                 # too short
+    assert p.propose([1, 1], 0) == []              # k=0
+    with pytest.raises(ValueError):
+        NgramProposer(max_n=0)
+
+
+def _spec_engine(spec_k=3, **kw):
+    eng = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32, page_size=8,
+                       max_pages_per_seq=8, spec_k=spec_k,
+                       proposer=NgramProposer(), **kw)
+    eng.warm()
+    return eng
+
+
+def test_spec_decode_matches_dense_oracle_mixed_lengths(ref_params):
+    """The tentpole gate: speculative decoding is EXACT under greedy —
+    bitwise the oracle's tokens over mixed lengths — while the
+    telemetry shows real drafting happened."""
+    eng = _spec_engine()
+    prompts = [LOOP_PROMPT, [4, 5, 6, 7, 8, 9, 10], [11, 12]]
+    handles = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    _drive(eng)
+    outs = [h.result(timeout=5) for h in handles]
+    assert outs == [reference_generate(CFG, ref_params, p, 10)
+                    for p in prompts]
+    c = eng.counters
+    assert c["spec_proposed"] > 0
+    assert 0 <= c["spec_accepted"] <= c["spec_proposed"]
+    assert c["spec_accept_rate"] == pytest.approx(
+        c["spec_accepted"] / max(1, c["spec_proposed"]), abs=1e-3)
+    # accepted drafts are steps never run: the loop-prone prompt must
+    # have bought at least one multi-token step
+    assert c["spec_accepted"] > 0
+    assert c["decode_steps"] < sum(10 for _ in prompts)
+
+
+def test_spec_continuous_arrival_joins_running_batch(ref_params):
+    eng = _spec_engine()
+    h1 = eng.submit(LOOP_PROMPT, max_new_tokens=10)
+    for _ in range(3):
+        eng.run_once()
+    assert not h1.done()
+    h2 = eng.submit([9, 8], max_new_tokens=5)
+    _drive(eng)
+    assert h1.result(timeout=5) == reference_generate(
+        CFG, ref_params, LOOP_PROMPT, 10)
+    assert h2.result(timeout=5) == reference_generate(
+        CFG, ref_params, [9, 8], 5)
+
+
+def test_spec_preemption_under_pool_pressure_preserves_outputs():
+    """Draft growth never preempts a peer: under pool pressure the
+    engine shrinks k instead, and a preempted request still re-prefills
+    to the oracle's tokens."""
+    cfg = DecodeModelConfig(vocab_size=32, n_layers=1, n_heads=2,
+                            head_dim=8, ffn_dim=16, max_context=24)
+    eng = DecodeEngine(cfg, seed=7, max_batch=2, n_pages=8, page_size=4,
+                       max_pages_per_seq=6, spec_k=2,
+                       proposer=NgramProposer())
+    eng.warm()
+    prompts = [[1, 2, 3, 4, 5], [6, 7, 8, 9, 10, 11]]
+    hs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    _drive(eng)
+    params = init_decode_params(cfg, 7)
+    assert [h.result(timeout=5) for h in hs] == \
+        [reference_generate(cfg, params, p, 10) for p in prompts]
+    assert eng.pool.pages_in_use == 0
+
+
+def test_spec_escape_env_pins_dense_step(ref_params, monkeypatch):
+    """PADDLE_SPEC_DECODE=0 forces the plain one-token step even when
+    spec_k is configured — bitwise the oracle, zero drafts."""
+    monkeypatch.setenv("PADDLE_SPEC_DECODE", "0")
+    eng = _spec_engine()
+    h = eng.submit(LOOP_PROMPT, max_new_tokens=8)
+    _drive(eng)
+    assert h.result(timeout=5) == reference_generate(
+        CFG, ref_params, LOOP_PROMPT, 8)
+    c = eng.counters
+    assert c.get("spec_proposed", 0) == 0
+    # prefill emits the first token; each remaining token is exactly
+    # one plain decode step — no multi-token acceptances anywhere
+    assert c["decode_steps"] == 7
+
+
+def test_spec_requires_greedy_temperature():
+    with pytest.raises(ValueError, match="temperature"):
+        DecodeEngine(CFG, seed=3, max_batch=2, n_pages=32, page_size=8,
+                     max_pages_per_seq=8, spec_k=2,
+                     proposer=NgramProposer(), temperature=0.7)
+    with pytest.raises(ValueError):
+        DecodeEngine(CFG, seed=3, max_batch=2, n_pages=32, page_size=8,
+                     max_pages_per_seq=8, kv_codec="int4")
+
+
+def test_int8_kv_engine_matches_oracle(ref_params):
+    """kv_codec=int8: pools allocate as int8 (+ per-row scale planes)
+    and greedy outputs still match the f32 dense oracle — the quant
+    error stays under the logit margins at these scales."""
+    import jax.numpy as jnp
+
+    eng = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32, page_size=8,
+                       max_pages_per_seq=8, kv_codec="int8")
+    eng.warm()
+    assert eng._k_pages.dtype == jnp.int8
+    assert eng._k_scales is not None and \
+        eng._k_scales.shape == eng._k_pages.shape[:3]
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8, 9, 10], [11, 12]]
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    _drive(eng)
+    assert [h.result(timeout=5) for h in handles] == \
+        [reference_generate(CFG, ref_params, p, 6) for p in prompts]
+    snap = eng.kv_debug_snapshot()
+    assert snap["kv_codec"] == "int8"
+
+
+def test_spec_over_int8_pool_matches_oracle(ref_params):
+    """The two legs compose: speculative verify over quantized pages
+    still emits the oracle's tokens."""
+    eng = _spec_engine(kv_codec="int8")
+    h = eng.submit(LOOP_PROMPT, max_new_tokens=10)
+    _drive(eng)
+    assert h.result(timeout=5) == reference_generate(
+        CFG, ref_params, LOOP_PROMPT, 10)
+    assert eng.counters["spec_proposed"] > 0
+
+
+def test_prefix_cache_repeat_prompt_hits_and_matches(ref_params):
+    """The same prompt twice: the second prefill consumes the shared-
+    prefix index (kv_prefix_hits = full prompt pages) and the outputs
+    stay identical — shared pages are read-only for the consumer."""
+    eng = DecodeEngine(CFG, seed=3, max_batch=2, n_pages=32, page_size=8,
+                       max_pages_per_seq=8)
+    eng.warm()
+    prompt = list(range(1, 18))                    # 17 toks: 2 full pages
+    h1 = eng.submit(prompt, max_new_tokens=6)
+    _drive(eng)
+    out1 = h1.result(timeout=5)
+    assert eng.counters["kv_prefix_hits"] == 0
+    reclaimed_before = eng.pool.snapshot()["cached_reclaimed"]
+    h2 = eng.submit(prompt, max_new_tokens=6)
+    _drive(eng)
+    out2 = h2.result(timeout=5)
+    assert out1 == out2 == reference_generate(CFG, ref_params, prompt, 6)
+    assert eng.counters["kv_prefix_hits"] == 2     # (17-1)//8 pages
+    # the hit revived cached pages — it did not allocate-and-recompute
+    assert eng.pool.snapshot()["cached_reclaimed"] == reclaimed_before
+
+
+def test_engine_cow_hook_copies_device_page():
+    """_maybe_cow is the defensive engine hook: when a slot's write
+    position lands on a shared page, the page is copied on device and
+    the slot's table repoints — other holders keep reading the
+    original bytes."""
+    from types import SimpleNamespace
+
+    import jax.numpy as jnp
+
+    eng = DecodeEngine(CFG, seed=3, max_batch=2, n_pages=16, page_size=8,
+                       max_pages_per_seq=4)
+    eng.warm()
+    pool = eng.pool
+    toks = list(range(8))
+    p1 = pool.alloc_seq(101, 8)
+    pool.register_prefix(101, toks)
+    shared = pool.match_prefix(toks + [9])
+    pool.alloc_seq_shared(102, shared, 9)
+    eng._k_pages = eng._k_pages.at[:, p1[0]].set(7.0)
+    eng._maybe_cow(SimpleNamespace(seq_id=102, length=2))
+    assert eng.counters.get("kv_cow_copies", 0) == 1
+    dst = pool.seq_pages(102)[0]
+    assert dst != p1[0] and pool.seq_pages(101)[0] == p1[0]
+    np.testing.assert_allclose(np.asarray(eng._k_pages[:, dst]), 7.0)
+
+
+def test_sampling_engine_deterministic_per_seed():
+    """temperature > 0: same sample_seed -> the same token stream
+    (host-seeded Gumbel noise through the fused kernel); tokens stay
+    in-vocab."""
+    def run(seed):
+        eng = DecodeEngine(CFG, seed=3, max_batch=2, n_pages=32,
+                           page_size=8, max_pages_per_seq=8,
+                           temperature=0.8, top_k=5, sample_seed=seed)
+        eng.warm()
+        hs = [eng.submit([1, 2, 3], max_new_tokens=8),
+              eng.submit([4, 5, 6, 7], max_new_tokens=8)]
+        _drive(eng)
+        return [h.result(timeout=5) for h in hs]
+
+    a = run(42)
+    assert a == run(42)
+    assert all(0 <= t < CFG.vocab_size for out in a for t in out)

@@ -1,0 +1,238 @@
+"""PageTableManager prefix sharing + refcounts (inference/decode/
+kv_cache.py): the chained-hash prefix index, shared-page refcounts,
+the cached-page LRU, copy-on-write, and eviction under sharing — the
+invariants the engine leans on: a shared page is NEVER reclaimed from
+under another holder, a refcount never goes negative, and a repeated
+prefix allocates zero new pages."""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.decode.kv_cache import (PageTableManager,
+                                                  _chain_keys)
+
+
+def _pool(n_pages=16, page_size=4, max_pages_per_seq=6):
+    return PageTableManager(n_pages=n_pages, page_size=page_size,
+                            max_pages_per_seq=max_pages_per_seq)
+
+
+TOKS = list(range(1, 13))                      # 12 tokens = 3 full pages
+
+
+def _share_scene():
+    """seq 1 owns a 3-page registered prefix; seq 2 shares all 3 pages
+    plus one fresh suffix page."""
+    pool = _pool()
+    p1 = pool.alloc_seq(1, len(TOKS))
+    pool.register_prefix(1, TOKS)
+    shared = pool.match_prefix(TOKS + [99, 100], limit=3)
+    p2 = pool.alloc_seq_shared(2, shared, len(TOKS) + 2)
+    return pool, p1, p2
+
+
+# ---------------------------------------------------------------------------
+# prefix index
+# ---------------------------------------------------------------------------
+def test_chain_keys_fold_the_whole_prefix():
+    """key_i must cover tokens [0, (i+1)*S): identical page CONTENT
+    after a different prefix hashes differently."""
+    a = _chain_keys([1, 2, 3, 4, 5, 6, 7, 8], 2, 4)
+    b = _chain_keys([9, 9, 9, 9, 5, 6, 7, 8], 2, 4)
+    assert a[0] != b[0] and a[1] != b[1]
+    assert a == _chain_keys([1, 2, 3, 4, 5, 6, 7, 8, 99], 2, 4)
+
+
+def test_match_prefix_chain_and_limit():
+    pool = _pool()
+    pages = pool.alloc_seq(1, len(TOKS))
+    pool.register_prefix(1, TOKS)
+    assert pool.match_prefix(TOKS + [77]) == pages
+    assert pool.match_prefix(TOKS[:8] + [77, 78]) == pages[:2]
+    # chain breaks at the first divergent page — later matches can't
+    # resurrect it
+    divergent = [42] * 4 + TOKS[4:]
+    assert pool.match_prefix(divergent) == []
+    # the prefill caller's cap: at least one suffix token must remain
+    assert pool.match_prefix(TOKS + [77], limit=2) == pages[:2]
+    assert pool.match_prefix(TOKS[:3]) == []       # no full page
+
+
+def test_register_prefix_idempotent_and_partial():
+    pool = _pool()
+    pool.alloc_seq(1, 10)                          # 3 pages, 2 full
+    assert pool.register_prefix(1, TOKS[:10]) == 2
+    assert pool.register_prefix(1, TOKS[:10]) == 0  # already indexed
+    # a second sequence with the same prefix doesn't double-index
+    pool.alloc_seq(2, 10)
+    assert pool.register_prefix(2, TOKS[:10]) == 0
+
+
+# ---------------------------------------------------------------------------
+# shared refcounts
+# ---------------------------------------------------------------------------
+def test_shared_alloc_refcounts_and_hit_accounting():
+    pool, p1, p2 = _share_scene()
+    assert p2[:3] == p1 and len(p2) == 4
+    for p in p1:
+        assert pool.page_ref(p) == 2
+    assert pool.pages_shared == 3
+    assert pool.prefix_hits == 3
+    # shared pages count ONCE toward occupancy
+    assert pool.pages_in_use == 4
+
+
+def test_repeated_prefix_allocates_zero_new_pages():
+    """The acceptance gate: a full-prefix hit consumes no fresh pages
+    for the shared span — only the suffix allocates."""
+    pool, p1, p2 = _share_scene()
+    free_before = pool.pages_free
+    shared = pool.match_prefix(TOKS + [7], limit=3)
+    p3 = pool.alloc_seq_shared(3, shared, len(TOKS) + 1)
+    assert p3[:3] == p1
+    # exactly ONE fresh page (the suffix), zero for the prefix
+    assert pool.pages_free == free_before - 1
+    assert all(pool.page_ref(p) == 3 for p in p1)
+
+
+def test_free_of_shared_page_decrements_not_frees():
+    pool, p1, p2 = _share_scene()
+    free_before = pool.pages_free
+    assert pool.free_seq(1) == 3
+    # seq 2 still holds every shared page: nothing returned to the pool
+    assert pool.pages_free == free_before
+    assert all(pool.page_ref(p) == 1 for p in p1)
+    assert pool.pages_shared == 0
+    # last holder drops: indexed pages park in the cached LRU (KV still
+    # valid for future hits), the unindexed suffix page goes free
+    pool.free_seq(2)
+    assert pool.pages_cached == 3
+    assert pool.pages_in_use == 0
+    assert pool.match_prefix(TOKS + [5]) == p1     # still matchable
+
+
+def test_evict_while_shared_never_reclaims_from_holder():
+    pool, p1, p2 = _share_scene()
+    assert pool.evict_seq(1) == 3
+    assert pool.evicted_pages == 3
+    # the survivor's table is intact and its pages never re-enter the
+    # allocator while it holds them
+    assert pool.seq_pages(2) == p2
+    assert all(pool.page_ref(p) == 1 for p in p2)
+    grabbed = []
+    while True:
+        got = pool.alloc_seq(100 + len(grabbed), 4 * 6)
+        if got is None:
+            break
+        grabbed.extend(got)
+    assert not (set(grabbed) & set(p2)), \
+        "allocator handed out a page a live sequence still holds"
+
+
+def test_refcount_never_goes_negative():
+    pool = _pool()
+    (page,) = pool.alloc_seq(1, 4)
+    pool.free_seq(1)
+    with pytest.raises(ValueError, match="below refcount 0"):
+        pool._release_page(page)
+    # double-free via the public API is a no-op (table row is gone)
+    assert pool.free_seq(1) == 0
+
+
+def test_peak_tracking_survives_frees():
+    pool, p1, p2 = _share_scene()
+    assert pool.peak_pages_in_use == 4
+    assert pool.peak_pages_shared == 3
+    pool.free_seq(1)
+    pool.free_seq(2)
+    assert pool.pages_in_use == 0
+    assert pool.peak_pages_in_use == 4
+    assert pool.peak_pages_shared == 3
+
+
+# ---------------------------------------------------------------------------
+# cached LRU: revival and reclaim
+# ---------------------------------------------------------------------------
+def test_cached_pages_revive_without_allocation():
+    pool = _pool()
+    p1 = pool.alloc_seq(1, len(TOKS))
+    pool.register_prefix(1, TOKS)
+    pool.free_seq(1)
+    assert pool.pages_cached == 3 and pool.pages_in_use == 0
+    shared = pool.match_prefix(TOKS + [7], limit=3)
+    assert shared == p1
+    p2 = pool.alloc_seq_shared(2, shared, len(TOKS) + 1)
+    assert p2[:3] == p1
+    assert pool.pages_cached == 0                  # revived, not copied
+    assert pool.prefix_hits == 3
+
+
+def test_cached_lru_reclaim_drops_index_entry():
+    pool = _pool(n_pages=6, page_size=4, max_pages_per_seq=5)
+    toks = TOKS[:8]
+    p1 = pool.alloc_seq(1, 8)
+    pool.register_prefix(1, toks)
+    pool.free_seq(1)
+    assert pool.pages_cached == 2
+    # demand exceeds the free list: the LRU-oldest cached page is
+    # reclaimed and its index entry dies with it
+    p2 = pool.alloc_seq(2, 4 * 5)
+    assert p2 is not None and len(p2) == 5
+    snap = pool.snapshot()
+    assert snap["cached_reclaimed"] == 2
+    assert pool.match_prefix(toks + [1]) == []
+    assert set(p1) <= set(p2)                      # pages were reused
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write
+# ---------------------------------------------------------------------------
+def test_cow_exclusive_indexed_page_unindexes_in_place():
+    pool = _pool()
+    p1 = pool.alloc_seq(1, len(TOKS))
+    pool.register_prefix(1, TOKS)
+    assert pool.needs_cow(1, 2)                    # indexed, though ref 1
+    assert pool.cow_page(1, 2) is None             # sole owner: mutate
+    assert not pool.needs_cow(1, 2)
+    assert pool.match_prefix(TOKS + [7]) == []     # index entry dropped
+    assert pool.seq_pages(1) == p1                 # no copy happened
+
+
+def test_cow_shared_page_allocates_private_copy():
+    pool, p1, p2 = _share_scene()
+    assert pool.needs_cow(2, 1)                    # page 0 of the prefix
+    res = pool.cow_page(2, 1)
+    src, dst = res
+    assert src == p1[0] and dst not in p1
+    assert pool.seq_pages(2)[0] == dst
+    assert pool.seq_pages(1) == p1                 # donor untouched
+    assert pool.page_ref(src) == 1 and pool.page_ref(dst) == 1
+    # a position past the table is never a COW hit
+    assert not pool.needs_cow(2, 4 * 10)
+
+
+def test_cow_pool_dry_returns_sentinel():
+    pool = _pool(n_pages=4, page_size=4, max_pages_per_seq=3)
+    pool.alloc_seq(1, 4)
+    pool.register_prefix(1, [1, 2, 3, 4])
+    shared = pool.match_prefix([1, 2, 3, 4, 5])
+    pool.alloc_seq_shared(2, shared, 5)
+    pool.alloc_seq(3, 4)                           # drains the pool
+    assert pool.pages_free == 0
+    assert pool.cow_page(2, 0) == -1               # caller preempts
+
+
+# ---------------------------------------------------------------------------
+# snapshot: the dump_kv contract
+# ---------------------------------------------------------------------------
+def test_snapshot_is_json_ready_and_renders():
+    import json
+
+    from tools.dump_kv import render_snapshot
+
+    pool, p1, p2 = _share_scene()
+    snap = json.loads(json.dumps(pool.snapshot()))
+    assert snap["pages_shared"] == 3
+    assert snap["seqs"]["2"][:3] == p1
+    assert all(snap["refs"][str(p)] == 2 for p in p1)
+    text = render_snapshot(snap)
+    assert "shared (ref > 1)" in text and "seq 2" in text

@@ -295,3 +295,114 @@ def test_paged_autotuned_xla_choice_drives_dispatch(monkeypatch,
                                rtol=2e-5, atol=2e-5)
     assert counters.snapshot().get("paged_attention.xla", 0) == 1
     assert counters.snapshot().get("paged_attention.pallas", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# int8 KV pages (kv_codec="int8"): quant parity, writes, dispatch
+# ---------------------------------------------------------------------------
+from paddle_tpu.ps.codec import jnp_encode_kv_rows  # noqa: E402
+
+
+def _quant_pool(**kw):
+    q, kp, vp = _pool(**kw)
+    kq, ks = jnp_encode_kv_rows(kp)
+    vq, vs = jnp_encode_kv_rows(vp)
+    return q, kq, vq, ks, vs, kp, vp
+
+
+def test_quant_xla_tracks_f32_reference():
+    """Dequantized attention stays within int8-roundoff of the f32
+    pool — the kv_quant_loss gate at unit scale."""
+    q, kq, vq, ks, vs, kp, vp = _quant_pool()
+    table = jnp.asarray([[1, 2, 3], [4, 5, -1], [6, -1, -1]], jnp.int32)
+    lens = jnp.asarray([20, 11, 5], jnp.int32)
+    ref = pa._xla_paged_attention(q, kp, vp, table, lens)
+    out = pa._xla_paged_attention_quant(q, kq, vq, ks, vs, table, lens)
+    assert float(jnp.max(jnp.abs(out - ref))) <= 5e-2
+
+
+def test_quant_kernel_matches_quant_xla():
+    """The quant kernel and the quant gather fallback are the same
+    function of the encoded pool."""
+    q, kq, vq, ks, vs, _, _ = _quant_pool(seed=9)
+    table = jnp.asarray([[1, 2, 3], [4, 5, -1], [6, -1, -1]], jnp.int32)
+    lens = jnp.asarray([20, 11, 5], jnp.int32)
+    ref = pa._xla_paged_attention_quant(q, kq, vq, ks, vs, table, lens)
+    out = pa._paged_attention_pallas_quant(q, kq, vq, ks, vs, table,
+                                           lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_write_quant_roundtrip_and_trash_page():
+    """paged_write_quant encodes the row in place: payload at
+    [page, off], its scale on the (P, S) plane, and inactive lanes
+    land on the reserved page 0."""
+    _, kp, vp = _pool(b=2, pages=6, t=2)
+    kq, ks = jnp_encode_kv_rows(kp)
+    vq, vs = jnp_encode_kv_rows(vp)
+    table = jnp.asarray([[3, 4], [5, -1]], jnp.int32)
+    positions = jnp.asarray([9, 2], jnp.int32)
+    new_k = jnp.full((2, 2, 16), 7.0, jnp.float32)
+    new_v = jnp.full((2, 2, 16), -7.0, jnp.float32)
+    k2, v2, ks2, vs2 = pa.paged_write_quant(
+        kq, vq, ks, vs, table, positions, new_k, new_v,
+        jnp.asarray([True, True]))
+    # dequant lands back on the written constant
+    np.testing.assert_allclose(
+        np.asarray(k2[4, 1].astype(jnp.float32) * ks2[4, 1]),
+        7.0, rtol=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(v2[5, 2].astype(jnp.float32) * vs2[5, 2]),
+        -7.0, rtol=1e-2)
+    # untouched elsewhere (payload AND scale planes)
+    np.testing.assert_array_equal(np.asarray(k2[3]), np.asarray(kq[3]))
+    np.testing.assert_array_equal(np.asarray(ks2[3]), np.asarray(ks[3]))
+    # inactive lanes route to the trash page
+    k3, _, ks3, _ = pa.paged_write_quant(
+        kq, vq, ks, vs, table, positions, new_k, new_v,
+        jnp.asarray([False, False]))
+    np.testing.assert_array_equal(np.asarray(k3[1:]), np.asarray(kq[1:]))
+    np.testing.assert_array_equal(np.asarray(ks3[1:]),
+                                  np.asarray(ks[1:]))
+
+
+def test_paged_prefill_write_quant_roundtrip():
+    _, kp, vp = _pool(pages=8)
+    kq, ks = jnp_encode_kv_rows(kp)
+    vq, vs = jnp_encode_kv_rows(vp)
+    page_ids = jnp.asarray([2, 5], jnp.int32)
+    new_k = jnp.asarray(np.random.RandomState(4).randn(16, 2, 16),
+                        jnp.float32)
+    k2, _, ks2, _ = pa.paged_prefill_write_quant(kq, vq, ks, vs,
+                                                 page_ids, new_k, new_k)
+    deq = np.asarray(k2[2].astype(jnp.float32)) * \
+        np.asarray(ks2[2])[:, None, None]
+    np.testing.assert_allclose(deq, np.asarray(new_k[:8]), atol=0.05)
+    deq5 = np.asarray(k2[5].astype(jnp.float32)) * \
+        np.asarray(ks2[5])[:, None, None]
+    np.testing.assert_allclose(deq5, np.asarray(new_k[8:]), atol=0.05)
+
+
+def test_quant_dispatch_counters_and_escape(monkeypatch):
+    monkeypatch.setattr(bringup, "pallas_enabled", lambda: True)
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(2, 2, 64), jnp.float32)
+    kp = jnp.asarray(rng.randn(5, 128, 2, 64), jnp.float32)
+    vp = jnp.asarray(rng.randn(5, 128, 2, 64), jnp.float32)
+    kq, ks = jnp_encode_kv_rows(kp)
+    vq, vs = jnp_encode_kv_rows(vp)
+    table = jnp.asarray([[1, 2], [3, -1]], jnp.int32)
+    lens = jnp.asarray([200, 70], jnp.int32)
+    out = pa.paged_attention(q, kq, vq, table, lens, k_scales=ks,
+                             v_scales=vs)
+    ref = pa._xla_paged_attention_quant(q, kq, vq, ks, vs, table, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert counters.snapshot().get("paged_attention.pallas", 0) == 1
+    # the escape env pins the quant gather path bitwise
+    monkeypatch.setenv("PADDLE_PAGED_ATTENTION", "0")
+    out2 = pa.paged_attention(q, kq, vq, table, lens, k_scales=ks,
+                              v_scales=vs)
+    assert np.asarray(out2).tobytes() == np.asarray(ref).tobytes()
+    assert counters.snapshot().get("paged_attention.xla", 0) == 1

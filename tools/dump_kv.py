@@ -1,0 +1,135 @@
+"""Page-pool inspector: render a decode engine's KV page-table state.
+
+Reads a JSON snapshot produced by ``DecodeEngine.kv_debug_snapshot()``
+(or a bare ``PageTableManager.snapshot()``) and prints the human view:
+pool geometry and codec, occupancy (in use / free / cached / shared),
+the per-sequence page tables with refcounts inlined, the shared-page
+list, and the decode/spec counters when the snapshot carries them.
+
+    python tools/dump_kv.py snapshot.json
+    python tools/dump_kv.py --demo            # no file needed
+    python tools/dump_kv.py --demo --json     # raw snapshot JSON
+
+``--demo`` exercises a small in-process ``PageTableManager`` (pure
+Python — no jax, no model): one sequence registers its prefix, a
+second allocates against it via ``match_prefix``, so the rendered view
+shows live prefix sharing and refcounts > 1. The snapshot format is
+the stable contract; this tool only formats it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def render_snapshot(snap: dict) -> str:
+    """Format one snapshot dict as the human page-pool view."""
+    lines: List[str] = ["== kv page pool =="]
+    lines.append(f"{'pages':<22}{snap.get('n_pages', 0)} x "
+                 f"{snap.get('page_size', 0)} tokens "
+                 f"(max {snap.get('max_pages_per_seq', 0)}/seq)")
+    if "kv_codec" in snap:
+        lines.append(f"{'kv_codec':<22}{snap['kv_codec']}")
+    if "spec_k" in snap:
+        lines.append(f"{'spec_k':<22}{snap['spec_k']}")
+    if "max_batch" in snap:
+        lines.append(f"{'max_batch':<22}{snap['max_batch']}")
+    lines.append(f"{'in use / free':<22}{snap.get('pages_in_use', 0)}"
+                 f" / {snap.get('pages_free', 0)}")
+    lines.append(f"{'cached (reclaimable)':<22}"
+                 f"{snap.get('pages_cached', 0)}")
+    lines.append(f"{'shared (ref > 1)':<22}{snap.get('pages_shared', 0)}")
+    lines.append(f"{'utilization':<22}{snap.get('utilization_pct', 0.0)}%"
+                 f"  (peak {snap.get('peak_pages_in_use', 0)}, "
+                 f"peak shared {snap.get('peak_pages_shared', 0)})")
+    lines.append(f"{'prefix hits':<22}{snap.get('prefix_hits', 0)}"
+                 f"   evictions {snap.get('evicted_pages', 0)}"
+                 f"   cache reclaims {snap.get('cached_reclaimed', 0)}")
+    refs = {int(p): int(r) for p, r in (snap.get("refs") or {}).items()}
+    seqs = snap.get("seqs") or {}
+    if seqs:
+        lines.append("")
+        lines.append("-- sequences --")
+        for sid in sorted(seqs, key=int):
+            pages = [int(p) for p in seqs[sid]]
+            rr = [refs.get(p, 0) for p in pages]
+            lines.append(f"seq {sid:<6}{len(pages)} pages  "
+                         f"{pages}  refs {rr}")
+    shared = sorted(p for p, r in refs.items() if r > 1)
+    if shared:
+        lines.append("")
+        lines.append("-- shared pages (ref > 1) --")
+        for p in shared:
+            lines.append(f"page {p:<6}refs {refs[p]}")
+    cached = snap.get("cached") or []
+    if cached:
+        lines.append("")
+        lines.append(f"-- cached (LRU, reclaimable) --  {list(cached)}")
+    counters = snap.get("counters") or {}
+    if counters:
+        lines.append("")
+        lines.append("-- counters --")
+        for name in sorted(counters):
+            lines.append(f"{name:<28}{counters[name]}")
+    return "\n".join(lines) + "\n"
+
+
+def _demo_snapshot() -> dict:
+    """A live prefix-sharing scene from a bare PageTableManager: seq 1
+    owns a registered 12-token prefix; seq 2 allocates against it so
+    its first pages are shared (ref 2)."""
+    from paddle_tpu.inference.decode.kv_cache import PageTableManager
+
+    pool = PageTableManager(n_pages=16, page_size=4, max_pages_per_seq=4)
+    toks = list(range(1, 13))
+    pool.alloc_seq(1, len(toks))
+    pool.register_prefix(1, toks)
+    shared = pool.match_prefix(toks + [99], limit=2)
+    pool.alloc_seq_shared(2, shared, len(toks) + 1)
+    return pool.snapshot()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "tools/dump_kv.py",
+        description="render a DecodeEngine.kv_debug_snapshot() / "
+                    "PageTableManager.snapshot() JSON file")
+    ap.add_argument("snapshot", nargs="?",
+                    help="snapshot JSON file (omit with --demo)")
+    ap.add_argument("--demo", action="store_true",
+                    help="render a small in-process demo pool with "
+                         "live prefix sharing (no file, no jax)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw snapshot JSON instead of the "
+                         "rendered view")
+    args = ap.parse_args(argv)
+    if args.demo:
+        snap = _demo_snapshot()
+    elif args.snapshot:
+        try:
+            with open(args.snapshot) as fh:
+                snap = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"dump_kv: cannot read {args.snapshot!r}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        ap.print_usage(sys.stderr)
+        return 1
+    if args.json:
+        json.dump(snap, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_snapshot(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

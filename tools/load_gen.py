@@ -30,17 +30,27 @@ the configured ``prompt_lens``/``output_lens`` tuples and draws its
 token content from ``RandomState(i)`` — and reports the
 autoregressive latency decomposition next to the closed-loop fields:
 per-token client latency, TTFT (submit → first token) vs inter-token
-percentiles, and ``decode_tokens_per_sec``.
+percentiles, ``decode_tokens_per_sec``, the prefill-vs-decode token
+split (``prefill_tokens[_per_sec]``), and the speculative-decoding
+economics (``spec_proposed`` / ``spec_accepted`` /
+``spec_accept_rate`` — zeros when ``--spec-k`` is 0). ``--kv-codec
+int8`` drives the same workload over int8 KV pages.
 """
 from __future__ import annotations
 
 import itertools
 import json
+import os
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 from paddle_tpu.observability import tracing
 
@@ -247,6 +257,7 @@ class DecodeLoadGen:
         ttft_ms: list = []
         itl_ms: list = []
         tokens_out = [0]
+        tokens_in = [0]
         traced: List[Tuple[float, Optional[str]]] = []
 
         def record(kind: str):
@@ -277,6 +288,7 @@ class DecodeLoadGen:
                         if self.keep_outputs:
                             self.outputs[i] = list(toks)
                         tokens_out[0] += len(toks)
+                        tokens_in[0] += len(prompt)
                         if "ttft_ms" in st:
                             ttft_ms.append(st["ttft_ms"])
                         times = st.get("token_times") or []
@@ -313,6 +325,10 @@ class DecodeLoadGen:
             return round(float(np.percentile(a, q)), 3) if a.size else 0.0
 
         eng = self.engine.engine_latency_stats()
+        try:
+            ectr = self.engine.counters
+        except Exception:
+            ectr = {}
         self.summary = {
             "requests": self.total_requests,
             "completed": sum(outcomes.values()),
@@ -323,6 +339,21 @@ class DecodeLoadGen:
             # baseline is compared against
             "decode_tokens_per_sec":
                 round(tokens_out[0] / dt, 2) if dt else 0.0,
+            # prefill vs decode split: prompt tokens ingested (batched
+            # prefill) vs tokens generated (one ragged step each) —
+            # the two phases have opposite economics, so a workload
+            # row that only reports decode throughput hides half the
+            # token bill
+            "prefill_tokens": tokens_in[0],
+            "prefill_tokens_per_sec":
+                round(tokens_in[0] / dt, 2) if dt else 0.0,
+            # speculative-decoding economics (0s when spec is off):
+            # drafted vs accepted counts and the engine's accept-rate
+            # gauge — accepted/proposed, the fraction of draft work
+            # that became real tokens
+            "spec_proposed": int(ectr.get("spec_proposed", 0)),
+            "spec_accepted": int(ectr.get("spec_accepted", 0)),
+            "spec_accept_rate": float(ectr.get("spec_accept_rate", 0.0)),
             "workers": self.workers,
             "prompt_lens": list(self.prompt_lens),
             "output_lens": list(self.output_lens),
@@ -355,9 +386,14 @@ def _decode_main(args):
                             ffn_dim=args.ffn,
                             max_context=args.pages_per_seq
                             * args.page_size)
+    proposer = None
+    if args.spec_k:
+        from paddle_tpu.inference.decode import NgramProposer
+        proposer = NgramProposer()
     engine = DecodeEngine(
         cfg, seed=0, max_batch=args.max_batch, n_pages=args.pages,
-        page_size=args.page_size, max_pages_per_seq=args.pages_per_seq)
+        page_size=args.page_size, max_pages_per_seq=args.pages_per_seq,
+        kv_codec=args.kv_codec, spec_k=args.spec_k, proposer=proposer)
     engine.warm()
     engine.start()
     try:
@@ -369,7 +405,7 @@ def _decode_main(args):
         summary = gen.run()
         summary["engine_counters"] = {
             k: v for k, v in sorted(engine.counters.items())
-            if k.startswith(("decode_", "kv_"))}
+            if k.startswith(("decode_", "kv_", "spec_"))}
         print(json.dumps(summary))
     finally:
         engine.drain(timeout=30)
@@ -392,6 +428,13 @@ def main():
     ap.add_argument("--output-lens", default="4,8,16",
                     help="decode mode: comma-separated max_new_tokens "
                          "(cycled per request)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="decode mode: speculative draft length per "
+                         "slot (0 = off; uses the n-gram prompt-lookup "
+                         "proposer)")
+    ap.add_argument("--kv-codec", default="off", choices=("off", "int8"),
+                    help="decode mode: KV page codec (int8 halves pool "
+                         "bytes; per-token-row scales)")
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=16)

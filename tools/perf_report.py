@@ -249,7 +249,10 @@ def render_compare(before: Tuple[List[dict], List[dict]],
 # ---------------------------------------------------------------------------
 def render_metrics(samples: Dict[str, float]) -> str:
     """Utilization view of one parsed ``/metrics`` scrape: the cost
-    gauges plus bucket-derived phase percentiles."""
+    gauges plus bucket-derived phase percentiles, and — when the
+    scrape carries the decode-serving plane — the token-economics
+    section (speculation accept rate, KV page occupancy/sharing,
+    prefix-cache hits)."""
     from tools.metrics_watch import (format_percentile_table,
                                      histogram_percentile_deltas)
 
@@ -260,6 +263,17 @@ def render_metrics(samples: Dict[str, float]) -> str:
             v = samples[g]
             fmt = _fmt_count(v) if g.startswith("step_") else f"{v:g}"
             lines.append(f"{g:<20}{fmt:>14}")
+    decode = [(g, samples[g]) for g in (
+        "decode_requests", "decode_tokens", "decode_prefills",
+        "decode_steps", "decode_batch_fill_pct", "spec_proposed",
+        "spec_accepted", "spec_accept_rate", "kv_pages_in_use",
+        "kv_pages_shared", "kv_pages_cached", "kv_prefix_hits",
+        "kv_page_evictions", "kv_cow_copies") if g in samples]
+    if decode:
+        lines.append("")
+        lines.append("-- decode token economics --")
+        for g, v in decode:
+            lines.append(f"{g:<22}{v:>12g}")
     pct = histogram_percentile_deltas(samples, None)
     phase = {k: v for k, v in pct.items()
              if k.startswith("executor_step_phase_ms")}
