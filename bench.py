@@ -849,6 +849,104 @@ def _decode_probe(requests=12, workers=4):
     }
 
 
+def _fleet_probe(requests=8, workers=3):
+    """Fleet serving probe: two decode engines behind an in-process
+    ``FleetRouter`` (serving/router.py), on the SAME geometry as
+    `_decode_probe` so the compiled ragged step is already cached.
+
+    Three legs: (1) the zipf-session ``FleetLoadGen`` workload for
+    fleet throughput + p99 TTFT through the router, (2) a deterministic
+    failover — the probe session's pinned engine is stopped after its
+    first chunk lands, and the survivor's greedy replay must match the
+    dense oracle bitwise (``fleet_failover_parity``), (3) KV page
+    migration into the survivor: the int8 wire frame's byte saving vs
+    f32 (``kv_migration_bytes_saved_pct``) plus the degrade leg (a dead
+    transport burns the retry budget and falls back, counted — never
+    user-visible)."""
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.decode import (DecodeEngine,
+                                             DecodeModelConfig,
+                                             reference_generate)
+    from paddle_tpu.serving import (FleetRouter, MigrationClient,
+                                    PrefillWorker)
+    from tools.load_gen import FleetLoadGen
+
+    page_size, max_pages = 16, 8
+    cfg = DecodeModelConfig(vocab_size=64, n_layers=2, n_heads=4,
+                            head_dim=16, ffn_dim=128,
+                            max_context=page_size * max_pages)
+    engines = []
+    for _ in range(2):
+        e = DecodeEngine(cfg, seed=11, max_batch=4, n_pages=64,
+                         page_size=page_size,
+                         max_pages_per_seq=max_pages)
+        e.warm()
+        e.start()
+        engines.append(e)
+    router = FleetRouter(engines, chunk_tokens=4, config=cfg)
+    try:
+        gen = FleetLoadGen(router, total_requests=requests,
+                           workers=workers, prompt_lens=(8, 24, 16),
+                           output_lens=(8, 12))
+        summary = gen.run()
+
+        prompt = [int(t) for t in np.random.RandomState(99).randint(
+            0, cfg.vocab_size, 12)]
+        stopped = []
+
+        def killer(emitted):
+            if not stopped:
+                idx = int(router.session_replica("bench-probe")[-1])
+                engines[idx].stop()
+                stopped.append(idx)
+
+        out = router.generate(prompt, max_new_tokens=12,
+                              session="bench-probe", on_chunk=killer,
+                              timeout=120)
+        failover_parity = out == reference_generate(
+            cfg, engines[0].params, prompt, 12)
+
+        survivor = engines[1 - stopped[0]]
+        worker = PrefillWorker(cfg, params=survivor.params,
+                               page_size=page_size)
+        shipment = worker.prefill(
+            [int(t) for t in np.random.RandomState(123).randint(
+                0, cfg.vocab_size, 2 * page_size)])
+        mig = MigrationClient(survivor.adopt_pages).migrate(shipment)
+
+        def dead_send(frame):
+            raise ConnectionError("no decode engine at that endpoint")
+
+        fb_before = int(profiler.counters_snapshot().get(
+            "kv_migration_fallbacks", 0))
+        MigrationClient(dead_send, max_attempts=2,
+                        sleep=lambda s: None).migrate(shipment)
+        fallbacks = int(profiler.counters_snapshot().get(
+            "kv_migration_fallbacks", 0)) - fb_before
+    finally:
+        router.drain(timeout=30)
+        router.stop()
+    rctr = router.counters
+    return {
+        "fleet_tokens_per_sec": summary["fleet_tokens_per_sec"],
+        "fleet_p99_ttft_ms": summary["fleet_p99_ttft_ms"],
+        "fleet_requests_ok": int(summary["ok"]),
+        "fleet_token_share_top": max(
+            list(summary["per_engine_token_share"].values()) or [0.0]),
+        "router_failovers": int(rctr.get("router_failovers", 0)),
+        "router_replays": int(rctr.get("router_replays", 0)),
+        "router_affinity_hits":
+            int(rctr.get("router_affinity_hits", 0)),
+        "fleet_failover_parity": bool(failover_parity),
+        "kv_migration_ok": bool(mig.get("ok")),
+        "kv_migration_adopted": int(mig.get("adopted", 0)),
+        "kv_migration_bytes_saved_pct": round(
+            100.0 * (1.0 - shipment.encoded_bytes
+                     / max(1, shipment.f32_bytes)), 2),
+        "kv_migration_fallbacks": fallbacks,
+    }
+
+
 def _shard_probe_main(n_devices=8, steps=3):
     """Child body of the MULTICHIP probe (run in a subprocess with
     XLA_FLAGS=--xla_force_host_platform_device_count=N — the parent
@@ -1134,6 +1232,15 @@ def bench_bert(seq=128, smoke=False, trend=False):
     except Exception as e:
         decode_probe = {"decode_probe_error":
                         f"{type(e).__name__}: {e}"}
+    # FLEET probe: two engines behind the serving router — fleet
+    # throughput/p99 TTFT under the zipf-session workload, a
+    # deterministic mid-generation failover with bitwise replay
+    # parity, and the KV page-migration wire saving + degrade leg
+    try:
+        fleet_probe = _fleet_probe()
+    except Exception as e:
+        fleet_probe = {"fleet_probe_error":
+                       f"{type(e).__name__}: {e}"}
     # MULTICHIP probe (subprocess, 8 forced CPU devices): DP×TP parity
     # vs single chip within the gm tolerance, psum accounting, and the
     # gradient-merge×pipeline GPipe composition's stage count + bubble
@@ -1148,6 +1255,7 @@ def bench_bert(seq=128, smoke=False, trend=False):
         **remat_probe,
         **serving_probe,
         **decode_probe,
+        **fleet_probe,
         **multichip_probe,
         **ir_probe,
         "value": tokens / dt, "unit": "tokens/s",

@@ -34,7 +34,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from collections import Counter as _Counter
+from collections import Counter as _Counter, deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -198,6 +198,11 @@ class DecodeEngine:
         # -- scheduler thread ----------------------------------------------
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # KV page adoption (serving/disagg.py): frames posted from any
+        # thread, applied on the scheduler thread between steps — the
+        # pool arrays are donated through compiled dispatches, so a
+        # concurrent host-side write would race a step's in-place update
+        self._adoptions: deque = deque()
 
         from ...observability.server import maybe_start_metrics_server
 
@@ -444,6 +449,98 @@ class DecodeEngine:
         return self.submit(prompt, max_new_tokens,
                            deadline_s=deadline_s).result(timeout)
 
+    def adopt_pages(self, frame: bytes) -> dict:
+        """Adopt a shipped prefill PAGE FRAME (serving/disagg.py wire
+        format) into this engine's pool: decode the frame, allocate and
+        index its full pages under their chained content hashes, write
+        the KV rows on device. The adopted pages park in the cached
+        prefix LRU, so the next ``submit`` with that prompt shares them
+        (``match_prefix``) and prefills only its suffix — migration is
+        remote prefix-cache population, never a correctness dependency.
+
+        Thread-safe: while the scheduler thread runs, the frame is
+        queued and applied between steps (the pool arrays are donated
+        through compiled dispatches). Returns the adoption report dict
+        (``ok``/``adopted``/``shared``/``pages``); raises
+        ``MalformedPageFrame`` on a bad frame and ValueError on a
+        geometry the pool can't represent."""
+        with self.sched.lock:
+            running = self._running
+            if running:
+                box: dict = {}
+                done = threading.Event()
+                entry = (frame, box, done)
+                self._adoptions.append(entry)
+                self.sched.lock.notify_all()
+        if not running:
+            return self._adopt_now(frame)
+        while not done.wait(timeout=0.05):
+            with self.sched.lock:
+                if self._running or done.is_set():
+                    continue
+                # scheduler stopped before picking the frame up: apply
+                # inline once its thread is provably out of dispatch
+                try:
+                    self._adoptions.remove(entry)
+                except ValueError:
+                    continue   # picked up after all; keep waiting
+            t = self._thread
+            if t is not None:
+                t.join(timeout=10)
+            return self._adopt_now(frame)
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _adopt_now(self, frame: bytes) -> dict:
+        from ...serving.disagg import MalformedPageFrame, decode_frame
+
+        pf = decode_frame(frame)
+        want = (self.config.n_layers, self.pool.page_size,
+                self.config.n_heads, self.config.head_dim)
+        got = (pf.n_layers, pf.page_size, pf.heads, pf.head_dim)
+        if got != want:
+            raise MalformedPageFrame(
+                f"frame geometry {got} does not match engine "
+                f"(n_layers, page_size, heads, head_dim)={want}")
+        seq_id = self.sched.new_seq_id()
+        res = self.pool.adopt_pages(seq_id, pf.tokens)
+        if res is None:
+            return {"ok": False, "reason": "pool_full",
+                    "adopted": 0, "shared": 0, "pages": 0}
+        pages, fresh = res
+        if fresh:
+            if self._kv_codec == "int8":
+                # int8 pool: store the quantized rows + scale planes
+                # directly — the wire codec and the local prefill path
+                # share one per-row rounding rule, so an adopted page is
+                # bitwise identical to a locally prefilled one
+                kq, ks = pf.int8_rows("k")
+                vq, vs = pf.int8_rows("v")
+                for i, page in fresh:
+                    self._k_pages = self._k_pages.at[:, page].set(kq[:, i])
+                    self._v_pages = self._v_pages.at[:, page].set(vq[:, i])
+                    self._k_scales = self._k_scales.at[:, page].set(
+                        ks[:, i])
+                    self._v_scales = self._v_scales.at[:, page].set(
+                        vs[:, i])
+            else:
+                kf = pf.f32_rows("k")
+                vf = pf.f32_rows("v")
+                dt = self._k_pages.dtype
+                for i, page in fresh:
+                    self._k_pages = self._k_pages.at[:, page].set(
+                        kf[:, i].astype(dt))
+                    self._v_pages = self._v_pages.at[:, page].set(
+                        vf[:, i].astype(dt))
+            self._count("kv_migration_pages", len(fresh))
+        # drop the holder reference: the pages park INDEXED in the
+        # cached LRU, reclaimable under pressure — adoption never pins
+        # pool budget (worst case the next prefill recomputes locally)
+        self.pool.free_seq(seq_id)
+        return {"ok": True, "adopted": len(fresh),
+                "shared": len(pages) - len(fresh), "pages": len(pages)}
+
     @property
     def ready(self) -> bool:
         return self.sched.accepting and self._running and self._warmed
@@ -458,7 +555,17 @@ class DecodeEngine:
         ragged decode step, harvest. Returns a work count (prefills +
         tokens emitted + expiries) — 0 means nothing advanced."""
         now = self._clock()
-        work = len(self.sched.expire_queued(now))
+        work = 0
+        while self._adoptions:
+            frame, box, done = self._adoptions.popleft()
+            try:
+                box["result"] = self._adopt_now(frame)
+            except BaseException as e:
+                box["error"] = e
+            finally:
+                done.set()
+            work += 1
+        work += len(self.sched.expire_queued(now))
         while True:
             req = self.sched.pop_for_prefill()
             if req is None:
@@ -914,7 +1021,8 @@ class DecodeEngine:
         while True:
             with self.sched.lock:
                 while self._running and not self.sched.queue \
-                        and not self.sched.slots:
+                        and not self.sched.slots \
+                        and not self._adoptions:
                     self.sched.lock.wait(timeout=0.05)
                 if not self._running:
                     return

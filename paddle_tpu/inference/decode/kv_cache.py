@@ -283,6 +283,74 @@ class PageTableManager:
         self._publish()
         return list(pages)
 
+    def adopt_pages(self, seq_id: int, tokens: Sequence[int]
+                    ) -> Optional[Tuple[List[int], List[Tuple[int, int]]]]:
+        """Adopt SHIPPED prefill pages (serving/disagg.py migration):
+        ``tokens`` is the full-page context a remote prefill worker
+        computed KV for — a whole number of pages, chained-hash keyed
+        exactly like :meth:`register_prefix` so shipped pages dedupe
+        against locally prefilled ones.
+
+        Per full page: an already-indexed page is SHARED (reference
+        bumped, revived from the cached LRU, counted as a prefix hit —
+        never duplicated); an unindexed one allocates a slot (free list
+        first, then LRU reclaim) and is indexed immediately. Returns
+        ``(pages, fresh)`` where ``fresh`` lists ``(block_index, page)``
+        pairs whose KV the engine still has to write — shared pages
+        already hold it. Returns None when the pool can't hold the
+        fresh pages (caller falls back to local prefill); raises
+        ValueError when ``seq_id`` already holds pages (double-adopt)
+        or ``tokens`` is not a non-empty whole number of pages."""
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id} already has pages")
+        toks = [int(t) for t in tokens]
+        n_full, rem = divmod(len(toks), self.page_size)
+        if n_full <= 0 or rem:
+            raise ValueError(
+                f"adoption ships whole pages: got {len(toks)} tokens "
+                f"for page_size {self.page_size}")
+        if n_full > self.max_pages_per_seq:
+            return None
+        pages: List[int] = []
+        fresh: List[Tuple[int, int]] = []
+        fresh_set: set = set()
+        shared_n = 0
+        for i, key in enumerate(_chain_keys(toks, n_full,
+                                            self.page_size)):
+            page = self._index.get(key)
+            if page is not None:         # must share, not duplicate
+                if page in self._cached:
+                    del self._cached[page]
+                self._refs[page] = self._refs.get(page, 0) + 1
+                shared_n += 1
+                pages.append(page)
+                continue
+            page = self._take_page()
+            if page is None:             # pool dry: undo everything
+                for q in reversed(pages):
+                    if q in fresh_set:
+                        self._drop_index(q)
+                        del self._refs[q]
+                        self._free.append(q)
+                    else:
+                        self._release_page(q)
+                self._publish()
+                return None
+            self._refs[page] = 1
+            self._index[key] = page
+            self._page_key[page] = key
+            fresh.append((i, page))
+            fresh_set.add(page)
+            pages.append(page)
+        self._seqs[seq_id] = pages
+        if shared_n:
+            self._prefix_hits += shared_n
+            from ... import profiler
+
+            profiler.bump_counter("kv_prefix_hits", shared_n)
+        self._publish()
+        return list(pages), fresh
+
     def append_token(self, seq_id: int, new_len: int) -> Optional[int]:
         """Ensure the page holding position ``new_len - 1`` exists.
         Returns the newly allocated page id, None when the existing

@@ -933,13 +933,18 @@ class ServingEngine:
 # ---------------------------------------------------------------------------
 # SIGTERM → graceful drain
 # ---------------------------------------------------------------------------
-def install_sigterm_drain(engine: ServingEngine,
+def install_sigterm_drain(engine,
                           on_drained: Optional[Callable[[], None]] = None,
                           exit_code: Optional[int] = 0,
                           drain_timeout: Optional[float] = 30.0) -> None:
     """Make SIGTERM drain ``engine`` (stop admitting, flush in-flight
     batches) and exit ``exit_code`` — the contract a supervised server
-    needs under ``launch.Supervisor``'s SIGTERM forwarding. Pass
+    needs under ``launch.Supervisor``'s SIGTERM forwarding.
+
+    ``engine`` is duck-typed on ``drain(timeout=...) -> bool``: a
+    ``ServingEngine``, a ``DecodeEngine``, or a
+    ``serving.FleetRouter`` (which drains its own admission first,
+    then every replica) all satisfy it. Pass
     ``exit_code=None`` to keep the process alive after the drain (the
     caller owns the exit); ``on_drained`` runs after the flush, before
     any exit. The flush is bounded by ``drain_timeout`` (seconds,

@@ -118,6 +118,19 @@ SCALARS = {
     "kv_pages_shared": ("gauge", "KV pages currently backing more than one live sequence (refcount > 1)"),
     "kv_pages_cached": ("gauge", "zero-ref prefix pages parked in the reclaimable LRU"),
     "kv_cow_copies": ("counter", "copy-on-write page copies (a write targeted a shared/indexed page)"),
+    # fleet decode serving (serving/router.py + serving/disagg.py):
+    # routing across engine replicas and prefill->decode KV migration
+    "router_requests": ("counter", "requests admitted by the fleet router"),
+    "router_dispatches": ("counter", "generation chunks dispatched to an engine replica"),
+    "router_failovers": ("counter", "chunks re-routed to a different replica after an engine death or typed failure"),
+    "router_replays": ("counter", "in-flight sessions replayed on a healthy replica with emitted tokens folded into the prompt"),
+    "router_affinity_hits": ("counter", "chunk dispatches that stuck to their session's previous replica"),
+    "router_sheds": ("counter", "requests shed at router admission (in-flight bound or fleet-wide SLO burn)"),
+    "router_engines_routable": ("gauge", "replicas currently passing health/readiness gating (readyz green, not cooling down)"),
+    "kv_migration_bytes": ("counter", "encoded KV page-frame bytes shipped prefill->decode"),
+    "kv_migration_bytes_saved": ("counter", "f32 bytes the page codec avoided shipping (f32 cost minus encoded cost)"),
+    "kv_migration_pages": ("counter", "KV pages adopted into a decode pool from shipped prefill state"),
+    "kv_migration_fallbacks": ("counter", "migrations degraded to local re-prefill (budget exhausted or pool full) - never a user-visible error"),
     # observability plane itself
     "metrics_label_overflow": ("counter", "label sets folded into the overflow series by the cardinality cap"),
     "flightrec_dumps": ("counter", "flight-recorder postmortem dumps written"),
@@ -182,6 +195,9 @@ HISTOGRAMS = {
     "decode_e2e_ms": (
         "decode request end-to-end latency, admission to final token — "
         "engine-side truth; p50/p99 derive from the buckets", ()),
+    "router_e2e_ms": (
+        "fleet-router request end-to-end latency, admission to final "
+        "chunk — includes every failover/replay leg", ()),
 }
 
 

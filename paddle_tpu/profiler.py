@@ -234,6 +234,17 @@ DECODE_COUNTER_NAMES = (
     "kv_cow_copies",
 )
 
+# fleet-router + KV-migration counters (serving/router.py dispatch,
+# failover, replay, SLO shed; serving/disagg.py page shipping;
+# FleetRouter.counters merges these plus the fault slice)
+ROUTER_COUNTER_NAMES = (
+    "router_requests", "router_dispatches", "router_failovers",
+    "router_replays", "router_affinity_hits", "router_sheds",
+    "router_engines_routable",
+    "kv_migration_bytes", "kv_migration_bytes_saved",
+    "kv_migration_pages", "kv_migration_fallbacks",
+)
+
 # serving-path counters (ServingEngine.counters merges these plus the
 # fault slice, mirroring Executor.counters)
 SERVE_COUNTER_NAMES = (

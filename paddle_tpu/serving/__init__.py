@@ -1,0 +1,58 @@
+"""paddle_tpu.serving — the fleet plane over ``DecodeEngine``:
+COMPOSE engines, don't grow inside one.
+
+A single decode engine (inference/decode/) is a process: one pool, one
+scheduler thread, one /metrics listener. This package is everything
+that only exists BETWEEN engines:
+
+- :mod:`router` — ``FleetRouter``: health-gated, session-affine,
+  least-loaded dispatch over N replicas with chunked
+  retry-with-failover — an engine SIGKILLed mid-generation is replayed
+  on a healthy replica with its emitted tokens folded into the prompt,
+  byte-identical to an unkilled run. ``DecodeEngineServer`` is the
+  per-engine HTTP surface (healthz/readyz/stats/metrics/generate/
+  adopt); ``FleetSLOSignal`` federates per-engine burn rates into the
+  router's shed/scale signal.
+- :mod:`disagg` — prefill/decode disaggregation: ``PrefillWorker``
+  computes prompt KV pool-free, ships FULL pages as int8 page frames
+  (the PS v2 codec, per-token-row scales), and a decode engine adopts
+  them through ``PageTableManager.adopt_pages`` with prefix-cache
+  hashes preserved — shipped pages dedupe exactly like local ones.
+  ``MigrationClient`` wraps the ship in deadlines + bounded retries
+  with a local-recompute degrade leg (``kv_migration_fallbacks``).
+
+Quickstart (three engines, one router)::
+
+    from paddle_tpu.inference.decode import DecodeEngine, DecodeModelConfig
+    from paddle_tpu.serving import DecodeEngineServer, FleetRouter
+
+    cfg = DecodeModelConfig()
+    engines = [DecodeEngine(cfg, seed=11).start() for _ in range(3)]
+    for e in engines:
+        e.warm()
+    router = FleetRouter(engines)           # in-process replicas
+    tokens = router.generate([1, 2, 3], max_new_tokens=32)
+
+    # or remote: DecodeEngineServer(engine, port=8101).start() per
+    # process, then FleetRouter([HTTPReplica("127.0.0.1:8101"), ...])
+
+``tools/chaos_drill.py --fleet`` is the proof: 3 engine processes
+under live load, one SIGKILLed mid-generation, outputs asserted
+bitwise against a never-killed oracle.
+"""
+from .disagg import (  # noqa: F401
+    MalformedPageFrame, MigrationClient, PageFrame, PrefillShipment,
+    PrefillWorker, decode_frame, encode_frame, migration_cost,
+)
+from .router import (  # noqa: F401
+    DecodeEngineServer, FleetRouter, FleetSLOSignal, HTTPReplica,
+    LocalReplica, ReplicaUnroutable,
+)
+
+__all__ = [
+    "DecodeEngineServer", "FleetRouter", "FleetSLOSignal",
+    "HTTPReplica", "LocalReplica", "ReplicaUnroutable",
+    "MalformedPageFrame", "MigrationClient", "PageFrame",
+    "PrefillShipment", "PrefillWorker", "decode_frame", "encode_frame",
+    "migration_cost",
+]

@@ -272,6 +272,29 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["kv_pool_headroom_x"] >= 2.0, last
     assert last["kv_prefix_hits"] > 0, last
     assert last["kv_prefix_parity"] is True, last
+    # FLEET probe contract: two engines behind the serving router —
+    # the zipf-session workload reports throughput + p99 TTFT, the
+    # deterministic mid-generation engine stop fails over with the
+    # survivor's greedy replay BITWISE equal to the dense oracle, and
+    # KV page migration both saves wire bytes (int8 frame vs f32) and
+    # degrades cleanly when the transport is dead (fallback counted)
+    for key in ("fleet_tokens_per_sec", "fleet_p99_ttft_ms",
+                "fleet_requests_ok", "router_failovers",
+                "router_replays", "fleet_failover_parity",
+                "kv_migration_ok", "kv_migration_adopted",
+                "kv_migration_bytes_saved_pct",
+                "kv_migration_fallbacks"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["fleet_tokens_per_sec"] > 0, last
+    assert last["fleet_p99_ttft_ms"] > 0, last
+    assert last["fleet_requests_ok"] > 0, last
+    assert last["router_failovers"] >= 1, last
+    assert last["router_replays"] >= 1, last
+    assert last["fleet_failover_parity"] is True, last
+    assert last["kv_migration_ok"] is True, last
+    assert last["kv_migration_adopted"] >= 1, last
+    assert last["kv_migration_bytes_saved_pct"] > 50.0, last
+    assert last["kv_migration_fallbacks"] >= 1, last
     # MULTICHIP probe contract: the DP×TP static-executor step (forced
     # 8-device CPU topology in a subprocess) matches the single-chip
     # loss within the established gm tolerance, the row-parallel hint

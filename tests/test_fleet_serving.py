@@ -1,0 +1,542 @@
+"""Fleet serving plane (paddle_tpu/serving): router dispatch policy
+(least-loaded, affinity, health gating, typed admission, SLO shed),
+chunked retry-with-failover with bitwise replay parity, prefill/decode
+disaggregation (page frames, adoption edge cases, migration fallback),
+the per-engine HTTP surface, and the router-shaped SIGTERM drain."""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.inference.decode import (DecodeEngine, DecodeModelConfig,
+                                         PageTableManager,
+                                         init_decode_params,
+                                         reference_generate)
+from paddle_tpu.inference.serving import EngineStopped, Overloaded
+from paddle_tpu.serving import (DecodeEngineServer, FleetRouter,
+                                FleetSLOSignal, HTTPReplica,
+                                MalformedPageFrame, MigrationClient,
+                                PrefillWorker, decode_frame,
+                                encode_frame, migration_cost)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = DecodeModelConfig(vocab_size=32, n_layers=2, n_heads=2, head_dim=8,
+                        ffn_dim=32, max_context=64)
+
+
+def _counter(name):
+    return profiler.counters_snapshot().get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# fake replicas: dispatch policy without spinning jax engines
+# ---------------------------------------------------------------------------
+class _FakeHandle:
+    def __init__(self, toks):
+        self._toks = toks
+        self.meta = {}
+
+    def done(self):
+        return True
+
+    def result(self, timeout=None):
+        return self._toks
+
+    def stats(self):
+        return dict(self.meta)
+
+
+class _FakeEngine:
+    """Deterministic next-token function sensitive to the WHOLE folded
+    context — a replayed prefix that lost or doubled a token diverges
+    immediately, so chunk-parity assertions are meaningful."""
+
+    def __init__(self, pages=0, depth=0):
+        self._ready = True
+        self._dead = False
+        self.queue_depth = depth
+        self.served = 0
+
+        class _P:
+            pages_in_use = pages
+        self.pool = _P()
+
+    @property
+    def ready(self):
+        return self._ready
+
+    @staticmethod
+    def oracle(prompt, n):
+        out, ctx = [], list(prompt)
+        for _ in range(n):
+            t = (sum(ctx) * 7 + len(ctx)) % 97
+            out.append(t)
+            ctx.append(t)
+        return out
+
+    def submit(self, prompt, max_new_tokens=16, deadline_s=None):
+        if self._dead:
+            raise EngineStopped("engine killed mid-generation")
+        self.served += 1
+        return _FakeHandle(self.oracle(prompt, max_new_tokens))
+
+    @property
+    def counters(self):
+        return {}
+
+    def drain(self, timeout=None):
+        return True
+
+    def stop(self):
+        # a SIGKILL the health prober hasn't noticed yet: the probe
+        # still answers green, the next dispatch dies typed
+        self._dead = True
+
+
+def test_router_failover_replays_bitwise():
+    """Kill the probe session's pinned replica after its first chunk:
+    the router replays the emitted tokens on the survivor and the
+    output is byte-identical to an unkilled run — zero lost, zero
+    doubled. The failover/replay counters tick and the flight recorder
+    names the dead replica."""
+    from paddle_tpu.observability.flight_recorder import flight_recorder
+
+    e0, e1 = _FakeEngine(), _FakeEngine()
+    r = FleetRouter([e0, e1], chunk_tokens=4)
+    killed = []
+
+    def on_chunk(emitted):
+        if not killed:
+            name = r.session_replica("probe")
+            (e0 if name == "local:0" else e1).stop()
+            killed.append(name)
+
+    h = r.submit([3, 5, 2], max_new_tokens=12, session="probe",
+                 on_chunk=on_chunk)
+    assert h.result(timeout=30) == _FakeEngine.oracle([3, 5, 2], 12)
+    c = r.counters
+    assert c["router_failovers"] >= 1
+    assert c["router_replays"] >= 1
+    assert c["router_dispatches"] == 3          # 12 tokens / chunk 4
+    assert any(ev.get("kind") == "replica_dead"
+               and ev.get("replica") == killed[0]
+               for ev in flight_recorder().events())
+    # the handle carries the serving-standard stats
+    st = h.stats()
+    assert "ttft_ms" in st and len(st["token_times"]) == 12
+
+
+def test_router_least_loaded_dispatch():
+    light = _FakeEngine(pages=1, depth=0)
+    heavy = _FakeEngine(pages=30, depth=5)
+    r = FleetRouter([light, heavy], chunk_tokens=8, affinity=False)
+    for i in range(4):
+        r.generate([1 + i], max_new_tokens=4, timeout=30)
+    assert light.served == 4 and heavy.served == 0
+
+
+def test_router_session_affinity_beats_load():
+    """An affine session sticks to its replica even when a lighter one
+    exists; distinct sessions still spread by load."""
+    a = _FakeEngine(pages=0)
+    b = _FakeEngine(pages=10)
+    r = FleetRouter([a, b], chunk_tokens=8)
+    r.generate([1], max_new_tokens=4, session="s", timeout=30)
+    assert r.session_replica("s") == "local:0"
+    a.pool.pages_in_use = 50        # now the WORSE choice by load
+    r.generate([2], max_new_tokens=4, session="s", timeout=30)
+    assert r.session_replica("s") == "local:0"
+    assert r.counters["router_affinity_hits"] >= 1
+    r.generate([3], max_new_tokens=4, session="other", timeout=30)
+    assert r.session_replica("other") == "local:1"
+
+
+def test_router_health_gate_and_typed_admission():
+    e0, e1 = _FakeEngine(), _FakeEngine()
+    r = FleetRouter([e0, e1], chunk_tokens=8, max_attempts=2,
+                    cooldown_s=0.0)
+    e0._ready = False               # readiness gate skips it
+    r.generate([5], max_new_tokens=4, timeout=30)
+    assert e1.served == 1 and e0.served == 0
+    with pytest.raises(ValueError):
+        r.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        r.submit([1], max_new_tokens=0)
+    e1._ready = False               # nobody routable -> typed shed
+    h = r.submit([6], max_new_tokens=4)
+    with pytest.raises(Overloaded):
+        h.result(timeout=30)
+    assert not r.ready
+    assert r.drain(timeout=5.0)
+    with pytest.raises(EngineStopped):
+        r.submit([7], max_new_tokens=4)
+
+
+def test_router_max_inflight_sheds():
+    gate = threading.Event()
+
+    class _SlowEngine(_FakeEngine):
+        def submit(self, prompt, max_new_tokens=16, deadline_s=None):
+            gate.wait(timeout=30)
+            return super().submit(prompt, max_new_tokens, deadline_s)
+
+    r = FleetRouter([_SlowEngine()], chunk_tokens=8, max_inflight=1)
+    h = r.submit([1], max_new_tokens=4)
+    try:
+        with pytest.raises(Overloaded):
+            r.submit([2], max_new_tokens=4)
+        assert r.counters["router_sheds"] == 1
+    finally:
+        gate.set()
+    assert h.result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn signal -> shed/scale
+# ---------------------------------------------------------------------------
+def _slo_fetch(failed_by_target):
+    def fetch(target, timeout=None):
+        failed = failed_by_target.get(target, 0)
+        return (f"decode_requests {failed_by_target['_requests']}\n"
+                f"decode_failed {failed}\n")
+    return fetch
+
+
+def test_fleet_slo_signal_names_burning_engine():
+    clock = [0.0]
+    samples = {"_requests": 100, "a": 0, "b": 0}
+    sig = FleetSLOSignal(["a", "b"], windows=((10.0, 1.0),),
+                         clock=lambda: clock[0],
+                         fetch=_slo_fetch(samples))
+    assert sig.refresh() == set()
+    clock[0] = 15.0
+    samples.update(_requests=200, b=90)   # b burns, a stays clean
+    assert sig.refresh() == {"b"}
+    assert sig.burning() == {"b"}
+    hint = sig.scale_hint()
+    assert hint["burning"] == ["b"] and hint["action"] == "scale_up"
+
+
+def test_router_deprioritizes_burning_and_sheds_when_all_burn():
+    clock = [0.0]
+    samples = {"_requests": 100, "local:0": 0, "local:1": 0}
+    sig = FleetSLOSignal(["local:0", "local:1"],
+                         windows=((10.0, 1.0),),
+                         clock=lambda: clock[0],
+                         fetch=_slo_fetch(samples))
+    sig.refresh()
+    e0, e1 = _FakeEngine(pages=0), _FakeEngine(pages=50)
+    r = FleetRouter([e0, e1], chunk_tokens=8, slo_signal=sig,
+                    shed_on_burn=True)
+    clock[0] = 15.0
+    samples.update(_requests=200, **{"local:0": 90})  # best-by-load burns
+    sig.refresh()
+    r.generate([1], max_new_tokens=4, timeout=30)
+    assert e1.served == 1 and e0.served == 0  # steered off the burner
+    samples.update(**{"local:1": 90})          # now EVERYONE burns
+    clock[0] = 16.0
+    sig.refresh()
+    with pytest.raises(Overloaded):
+        r.submit([2], max_new_tokens=4)
+    assert r.counters["router_sheds"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# page adoption edge cases (PageTableManager.adopt_pages)
+# ---------------------------------------------------------------------------
+def test_adopt_whole_pages_only_and_double_adopt():
+    pool = PageTableManager(n_pages=8, page_size=4, max_pages_per_seq=4)
+    with pytest.raises(ValueError):
+        pool.adopt_pages(1, [])
+    with pytest.raises(ValueError):
+        pool.adopt_pages(1, [1, 2, 3])          # partial page
+    pages, fresh = pool.adopt_pages(1, [1, 2, 3, 4, 5, 6, 7, 8])
+    assert len(pages) == 2 and [i for i, _ in fresh] == [0, 1]
+    with pytest.raises(ValueError):
+        pool.adopt_pages(1, [9, 10, 11, 12])    # seq already holds pages
+    assert pool.pages_in_use == 2
+
+
+def test_adopt_existing_prefix_shares_not_duplicates():
+    pool = PageTableManager(n_pages=8, page_size=4, max_pages_per_seq=4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    pages1, _ = pool.adopt_pages(1, toks)
+    hits0 = pool.prefix_hits
+    pages2, fresh2 = pool.adopt_pages(2, toks)
+    assert pages2 == pages1 and fresh2 == []    # same slots, no copies
+    assert pool.prefix_hits - hits0 == 2
+    assert pool.pages_in_use == 2               # shared, not doubled
+    # freeing one owner keeps the pages for the other
+    pool.free_seq(1)
+    assert pool.pages_in_use == 2
+    pool.free_seq(2)                            # now parked in the LRU
+    pages3, fresh3 = pool.adopt_pages(3, toks)
+    assert pages3 == pages1 and fresh3 == []    # revived from cache
+
+
+def test_adopt_near_full_pool_reclaims_cached_lru():
+    pool = PageTableManager(n_pages=5, page_size=4, max_pages_per_seq=4)
+    old = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16]
+    pool.adopt_pages(1, old)              # 4 pages = whole capacity
+    pool.free_seq(1)                      # parked indexed in the LRU
+    assert pool.pages_cached == 4 and len(pool._free) == 0
+    new = [21, 22, 23, 24, 25, 26, 27, 28, 29, 30, 31, 32]
+    pages, fresh = pool.adopt_pages(2, new)
+    assert len(pages) == 3 and len(fresh) == 3  # LRU reclaim fed these
+    assert pool.pages_cached == 1         # one old page survived
+    # reclaimed pages lost their identity: re-adopting the old tokens
+    # shares only the surviving page and rewrites the rest
+    pool.free_seq(2)
+    pages_old, fresh_old = pool.adopt_pages(3, old)
+    assert len(pages_old) == 4 and len(fresh_old) == 3
+
+
+def test_adopt_pool_dry_rolls_back_cleanly():
+    pool = PageTableManager(n_pages=5, page_size=4, max_pages_per_seq=4)
+    held = pool.alloc_seq(1, 16)          # 4 ACTIVE pages: nothing to
+    assert held is not None               # reclaim, nothing free
+    before = pool.pages_in_use
+    assert pool.adopt_pages(2, [1, 2, 3, 4, 5, 6, 7, 8]) is None
+    assert pool.pages_in_use == before    # full rollback
+    assert pool.free_seq(1) == 4
+    assert pool.adopt_pages(2, [1, 2, 3, 4, 5, 6, 7, 8]) is not None
+
+
+def test_adopt_partial_share_rolls_back_shared_refs():
+    """Pool goes dry AFTER some pages shared: the shared refs must be
+    released back to their original owner, never leaked."""
+    pool = PageTableManager(n_pages=6, page_size=4, max_pages_per_seq=5)
+    prefix = [1, 2, 3, 4, 5, 6, 7, 8]
+    pool.adopt_pages(1, prefix)           # 2 indexed pages, refs=1
+    pool.alloc_seq(9, 12)                 # 3 more: pool now dry
+    ext = prefix + [31, 32, 33, 34, 35, 36, 37, 38]   # 2 share + 2 fresh
+    assert pool.adopt_pages(2, ext) is None
+    assert all(pool._refs[p] == 1 for p in pool.seq_pages(1))
+    assert pool.pages_in_use == 5
+
+
+def test_adopt_over_seq_budget_returns_none():
+    pool = PageTableManager(n_pages=16, page_size=4, max_pages_per_seq=2)
+    assert pool.adopt_pages(1, list(range(12))) is None   # 3 > budget
+    assert pool.pages_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# page frames: codec, typed rejects, ship-vs-recompute
+# ---------------------------------------------------------------------------
+def _frame_for(cfg, tokens, seed=3, codec="int8"):
+    from paddle_tpu.inference.decode.model import dense_forward
+
+    params = init_decode_params(cfg, seed)
+    arr = np.asarray(tokens, np.int32)[None, :]
+    _, ks, vs = dense_forward(cfg, params, arr, collect_kv=True)
+    return encode_frame(tokens, np.asarray(ks)[:, 0],
+                        np.asarray(vs)[:, 0], page_size=8, codec=codec)
+
+
+def test_frame_roundtrip_and_typed_rejects():
+    tokens = list(range(1, 17))           # 2 full pages of 8
+    frame = _frame_for(CFG, tokens)
+    pf = decode_frame(frame)
+    assert pf.tokens == tokens and pf.n_pages == 2
+    assert pf.codec == "int8" and pf.heads == CFG.n_heads
+    k = pf.f32_rows("k")
+    assert k.shape == (CFG.n_layers, 2, 8, CFG.n_heads, CFG.head_dim)
+    for bad in (frame[:10],                      # truncated header
+                b"XXXX" + frame[4:],             # bad magic
+                frame + b"\x00",                 # trailing junk
+                frame[:-2]):                     # truncated payload
+        with pytest.raises(MalformedPageFrame):
+            decode_frame(bad)
+
+
+def test_migration_cost_flips_with_scale():
+    toy = migration_cost(CFG, 16)
+    assert not toy["cheaper_to_ship"]     # tiny model: just recompute
+    serving = DecodeModelConfig(vocab_size=256_000, n_layers=48,
+                                n_heads=32, head_dim=128,
+                                ffn_dim=32_768, max_context=8192)
+    big = migration_cost(serving, 2048)
+    assert big["cheaper_to_ship"]
+    assert big["bytes_saved_pct"] > 70.0  # int8 + scales vs f32
+
+
+def test_migration_client_degrade_leg():
+    cfg = CFG
+    worker = PrefillWorker(cfg, seed=3, page_size=8)
+    shipment = worker.prefill(list(range(1, 17)))
+    before = _counter("kv_migration_fallbacks")
+
+    def dead_send(frame):
+        raise ConnectionError("nothing listens there")
+
+    rep = MigrationClient(dead_send, max_attempts=2,
+                          sleep=lambda s: None).migrate(shipment)
+    assert rep["ok"] is False
+    assert _counter("kv_migration_fallbacks") == before + 1
+    # a sub-page prompt has nothing to ship: fallback, not an error
+    rep2 = MigrationClient(dead_send).migrate(worker.prefill([1, 2, 3]))
+    assert rep2["ok"] is False and rep2["reason"] == "no_full_pages"
+    assert _counter("kv_migration_fallbacks") == before + 2
+
+
+# ---------------------------------------------------------------------------
+# real engines: adoption end-to-end, failover parity, HTTP surface
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ref_params():
+    return init_decode_params(CFG, 3)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32, page_size=8,
+                       max_pages_per_seq=8)
+    eng.warm()
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_engine_adoption_end_to_end(engine, ref_params):
+    """Ship a 2-page prefill into a live engine: the adopted pages land
+    in the prefix cache, the next submit of that prompt HITS them, and
+    the output still matches the dense oracle bitwise."""
+    prompt = [int(t) for t in
+              np.random.RandomState(42).randint(0, 32, size=16)]
+    worker = PrefillWorker(CFG, params=ref_params, page_size=8)
+    shipment = worker.prefill(prompt)
+    rep = MigrationClient(engine.adopt_pages).migrate(shipment)
+    assert rep["ok"] and rep["adopted"] == 2 and rep["shared"] == 0
+    hits0 = engine.pool.prefix_hits
+    out = engine.submit(prompt, max_new_tokens=6).result(timeout=30)
+    assert out == reference_generate(CFG, ref_params, prompt, 6)
+    assert engine.pool.prefix_hits > hits0
+    # re-shipping the same prefix dedupes instead of duplicating
+    rep2 = MigrationClient(engine.adopt_pages).migrate(shipment)
+    assert rep2["ok"] and rep2["adopted"] == 0 and rep2["shared"] == 2
+
+
+def test_engine_adopt_rejects_geometry_mismatch(engine):
+    other = DecodeModelConfig(vocab_size=32, n_layers=1, n_heads=2,
+                              head_dim=8, ffn_dim=32, max_context=64)
+    frame = _frame_for(other, list(range(1, 17)))
+    with pytest.raises(MalformedPageFrame):
+        engine.adopt_pages(frame)
+    with pytest.raises(MalformedPageFrame):
+        engine.adopt_pages(b"not a frame at all")
+
+
+def test_router_over_real_engines_failover_parity(ref_params):
+    """The drill's in-process core: two live engines, the probe's
+    pinned one stopped mid-generation, output bitwise equal to the
+    dense oracle."""
+    engines = []
+    for _ in range(2):
+        e = DecodeEngine(CFG, seed=3, max_batch=3, n_pages=32,
+                         page_size=8, max_pages_per_seq=8)
+        e.warm()
+        e.start()
+        engines.append(e)
+    router = FleetRouter(engines, chunk_tokens=4, config=CFG)
+    try:
+        prompt = [7, 3, 1, 2]
+        stopped = []
+
+        def on_chunk(emitted):
+            if not stopped:
+                idx = int(router.session_replica("probe")[-1])
+                engines[idx].stop()
+                stopped.append(idx)
+
+        out = router.generate(prompt, max_new_tokens=12,
+                              session="probe", on_chunk=on_chunk,
+                              timeout=60)
+        assert out == reference_generate(CFG, ref_params, prompt, 12)
+        assert router.counters["router_failovers"] >= 1
+        assert router.counters["router_replays"] >= 1
+    finally:
+        router.stop()
+
+
+@pytest.fixture(scope="module")
+def http_server(engine):
+    srv = DecodeEngineServer(engine, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_http_surface_serves_and_rejects_typed(http_server, engine,
+                                               ref_params):
+    import http.client
+
+    replica = HTTPReplica(http_server.endpoint)
+    assert replica.ready()
+    pages, depth = replica.load()
+    assert pages >= 0 and depth >= 0
+    out = replica.generate_chunk([1, 2, 3], 5, None)
+    assert out == reference_generate(CFG, ref_params, [1, 2, 3], 5)
+    # malformed adopt: typed 400 with the error class in the header
+    conn = http.client.HTTPConnection(replica.host, replica.port,
+                                      timeout=10)
+    conn.request("PUT", "/adopt", body=b"garbage")
+    resp = conn.getresponse()
+    body = resp.read()
+    assert resp.status == 400
+    assert resp.getheader("X-Paddle-Error") == "MalformedPageFrame"
+    conn.close()
+    with pytest.raises(MalformedPageFrame):
+        replica.adopt(b"garbage")
+    # /metrics rides along for the SLO scrape
+    conn = http.client.HTTPConnection(replica.host, replica.port,
+                                      timeout=10)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    assert resp.status == 200 and b"decode_requests" in resp.read()
+    conn.close()
+    # bad generate body: a typed 400, not a hung socket
+    conn = http.client.HTTPConnection(replica.host, replica.port,
+                                      timeout=10)
+    conn.request("PUT", "/generate", body=b"{not json")
+    resp = conn.getresponse()
+    assert resp.status == 400
+    resp.read()
+    conn.close()
+
+
+def test_http_replica_unroutable_when_dead():
+    from paddle_tpu.serving import ReplicaUnroutable
+
+    replica = HTTPReplica("127.0.0.1:1")       # nothing listens there
+    assert replica.ready() is False
+    with pytest.raises(ReplicaUnroutable):
+        replica.generate_chunk([1], 2, None)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drains the ROUTER duck-typed (satellite of ISSUE 17)
+# ---------------------------------------------------------------------------
+def test_sigterm_drains_router_zero_lost(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": _REPO,
+        "JAX_PLATFORMS": "cpu",
+        "DRAIN_REQUESTS": "8",
+        "PADDLE_FLIGHTREC_DIR": str(tmp_path),
+    })
+    worker = os.path.join(_REPO, "tests", "_fleet_drain_worker.py")
+    proc = subprocess.run([sys.executable, worker], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert b"DRAINED done=8 ok=8 total=8" in proc.stdout
+    dumps = [json.load(open(tmp_path / f)) for f in os.listdir(tmp_path)
+             if f.startswith("flightrec_")]
+    assert any(d["reason"] == "sigterm_drain" for d in dumps), \
+        "sigterm drain must leave a postmortem dump"

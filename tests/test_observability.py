@@ -136,6 +136,7 @@ def test_counter_names_families_are_declared():
                    profiler.ELASTIC_COUNTER_NAMES,
                    profiler.COMPILE_COUNTER_NAMES,
                    profiler.PS_COUNTER_NAMES,
+                   profiler.ROUTER_COUNTER_NAMES,
                    profiler.SERVE_COUNTER_NAMES):
         for name in family:
             m = reg.get(name)

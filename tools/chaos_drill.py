@@ -1,6 +1,24 @@
 #!/usr/bin/env python
-"""Deterministic chaos drills: elastic kill/resume (ISSUE 7) and
-parameter-server kill-a-primary (ISSUE 8, ``--ps``).
+"""Deterministic chaos drills: elastic kill/resume (ISSUE 7),
+parameter-server kill-a-primary (ISSUE 8, ``--ps``), and fleet decode
+serving kill-an-engine (ISSUE 17, ``--fleet``).
+
+Fleet drill (``--fleet``): N decode engines come up as subprocesses,
+each behind its ``DecodeEngineServer`` HTTP surface; a ``FleetRouter``
+sprays deterministic traffic over them, then SIGKILLs the engine a
+probe session is pinned to — mid-generation, under live load. The
+router's health gate flips the victim out, its chunked
+retry-with-failover replays every stranded session on a survivor
+(emitted tokens folded into the prompt), and the drill asserts: zero
+lost, zero doubled, every output BITWISE equal to the never-killed
+dense oracle; ``/readyz`` flipped; the parent's flight-recorder dump
+names the killed endpoint. The KV-migration legs then run against a
+survivor: a ``PrefillWorker`` ships int8 page frames (adopt +
+prefix-hit + dedupe on re-ship + typed malformed reject), the
+dead-endpoint ship exercises the ``kv_migration_fallbacks`` degrade
+leg with the request still serving, ship-vs-recompute is gated at a
+serving-scale config, and a multi-endpoint ``slo_check`` over every
+surviving ``/metrics`` must come back healthy.
 
 PS drill (``--ps``): a KVServer comes up in-process; one 2-replica
 group serves shard 0 — primary A as a SUPERVISED SUBPROCESS
@@ -619,6 +637,447 @@ def ps_main(argv) -> int:
     return 0 if report["ok"] else 1
 
 
+# ---------------------------------------------------------------------------
+# the fleet drill (ISSUE 17): SIGKILL a decode engine under live traffic
+# ---------------------------------------------------------------------------
+
+def fleet_engine_main() -> int:
+    """One fleet member: a decode engine + its HTTP surface, env-driven.
+    Lives until SIGTERM (drained by ``install_sigterm_drain`` — the
+    zero-lost shutdown) or SIGKILL (the chaos)."""
+    from paddle_tpu.inference.decode import DecodeEngine, DecodeModelConfig
+    from paddle_tpu.inference.serving import install_sigterm_drain
+    from paddle_tpu.serving import DecodeEngineServer
+
+    env = os.environ
+    cfg = DecodeModelConfig(
+        vocab_size=int(env["FLEET_VOCAB"]),
+        n_layers=int(env["FLEET_LAYERS"]),
+        n_heads=int(env["FLEET_HEADS"]),
+        head_dim=int(env["FLEET_HEAD_DIM"]),
+        ffn_dim=int(env["FLEET_FFN"]),
+        max_context=int(env["FLEET_PAGES_PER_SEQ"])
+        * int(env["FLEET_PAGE_SIZE"]))
+    engine = DecodeEngine(
+        cfg, seed=int(env["FLEET_SEED"]),
+        n_pages=int(env["FLEET_PAGES"]),
+        page_size=int(env["FLEET_PAGE_SIZE"]),
+        max_pages_per_seq=int(env["FLEET_PAGES_PER_SEQ"]),
+        kv_codec=env.get("FLEET_KV_CODEC", "int8"))
+    engine.warm()
+    engine.start()
+    srv = DecodeEngineServer(engine, port=int(env["FLEET_PORT"]))
+    srv.start()
+    install_sigterm_drain(engine, exit_code=0)
+    with open(env["FLEET_LOG"], "a") as f:
+        f.write(json.dumps({"kind": "ready", "pid": os.getpid(),
+                            "port": srv.port}) + "\n")
+    while True:   # the parent owns this process's death
+        time.sleep(3600)
+
+
+def _http_get(endpoint: str, path: str, timeout: float = 2.0):
+    """(status, body) — raises OSError family when the port is dead."""
+    import http.client
+
+    host, _, port = endpoint.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _wait_ready(endpoint: str, timeout: float = 180.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            status, _ = _http_get(endpoint, "/readyz")
+            if status == 200:
+                return True
+        except OSError:
+            pass
+        time.sleep(0.2)
+    return False
+
+
+def _port_dead(endpoint: str, timeout: float = 10.0) -> bool:
+    """True once /readyz stops answering 200 — refused OR non-ready."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            status, _ = _http_get(endpoint, "/readyz")
+            if status != 200:
+                return True
+        except OSError:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def run_fleet_drill(workdir: str, n_engines: int = 3, requests: int = 9,
+                    chunk_tokens: int = 4, kill: bool = True,
+                    kv_codec: str = "int8", seed: int = 11) -> dict:
+    """SIGKILL one of ``n_engines`` decode engines mid-generation under
+    live router traffic; assert the fleet absorbed it with zero lost,
+    zero doubled, and every output bitwise equal to the never-killed
+    dense oracle. Then run the KV-migration legs against a survivor
+    (ship + dedupe + malformed reject + dead-endpoint fallback) and the
+    fleet-wide SLO burn gate."""
+    import threading
+
+    import numpy as np
+
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.decode import (DecodeModelConfig,
+                                             init_decode_params,
+                                             reference_generate)
+    from paddle_tpu.observability.flight_recorder import flight_recorder
+    from paddle_tpu.serving import (FleetRouter, HTTPReplica,
+                                    MalformedPageFrame, MigrationClient,
+                                    PrefillWorker, migration_cost)
+
+    geom = {"FLEET_VOCAB": "64", "FLEET_LAYERS": "2",
+            "FLEET_HEADS": "4", "FLEET_HEAD_DIM": "16",
+            "FLEET_FFN": "128", "FLEET_PAGES": "64",
+            "FLEET_PAGE_SIZE": "8", "FLEET_PAGES_PER_SEQ": "8"}
+    cfg = DecodeModelConfig(
+        vocab_size=64, n_layers=2, n_heads=4, head_dim=16, ffn_dim=128,
+        max_context=64)
+    params = init_decode_params(cfg, seed)   # the oracle's weights
+
+    os.makedirs(workdir, exist_ok=True)
+    _clean_flightrec(workdir)
+    counters0 = profiler.counters_snapshot()
+    log_path = os.path.join(workdir, "fleet.jsonl")
+    if os.path.exists(log_path):
+        os.remove(log_path)
+
+    ports = [_free_port() for _ in range(n_engines)]
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+
+    def env_for(port):
+        env = dict(os.environ)
+        env.update(geom)
+        env.update({
+            "PYTHONPATH": _REPO,
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+            "FLEET_PORT": str(port),
+            "FLEET_SEED": str(seed),
+            "FLEET_KV_CODEC": kv_codec,
+            "FLEET_LOG": log_path,
+            "PADDLE_FLIGHTREC_DIR": _flightrec_dir(workdir),
+        })
+        env.pop("PADDLE_FAULT_SPEC", None)
+        return env
+
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--fleet-engine"],
+        env=env_for(p)) for p in ports]
+
+    t0 = time.monotonic()
+    report: dict = {"ok": False, "kill": kill, "engines": n_engines,
+                    "endpoints": endpoints}
+    router = None
+    try:
+        for ep in endpoints:
+            if not _wait_ready(ep):
+                raise RuntimeError(f"engine {ep} never became ready")
+        report["readyz_before"] = True
+
+        router = FleetRouter([HTTPReplica(ep) for ep in endpoints],
+                             chunk_tokens=chunk_tokens, config=cfg)
+
+        # --- live traffic: deterministic prompts, zipf-free spread ---
+        out_lens = (8, 12, 16)
+        prompts = {}
+        for i in range(requests):
+            rng = np.random.RandomState(i)
+            n = (6, 14, 10)[i % 3]
+            prompts[i] = [int(t) for t in
+                          rng.randint(0, cfg.vocab_size, size=n)]
+        results: dict = {}
+        errors: dict = {}
+
+        def traffic(i):
+            try:
+                h = router.submit(prompts[i],
+                                  max_new_tokens=out_lens[i % 3],
+                                  session=f"s{i:02d}")
+                results[i] = h.result(120.0)
+            except BaseException as e:  # noqa: B036 (reported below)
+                errors[i] = repr(e)
+
+        threads = [threading.Thread(target=traffic, args=(i,),
+                                    daemon=True)
+                   for i in range(requests)]
+        for t in threads:
+            t.start()
+
+        # --- the kill: SIGKILL the probe session's pinned engine the
+        # moment its first chunk lands (mid-generation by construction)
+        probe_rng = np.random.RandomState(999)
+        probe_prompt = [int(t) for t in
+                        probe_rng.randint(0, cfg.vocab_size, size=12)]
+        victim_box: dict = {}
+        killed = threading.Event()
+
+        def killer(emitted):
+            if kill and not killed.is_set():
+                name = router.session_replica("probe")
+                victim_box["endpoint"] = name
+                procs[endpoints.index(name)].kill()   # SIGKILL, no grace
+                killed.set()
+
+        h_probe = router.submit(probe_prompt, max_new_tokens=24,
+                                session="probe", on_chunk=killer)
+        probe_tokens = h_probe.result(120.0)
+        for t in threads:
+            t.join(timeout=120.0)
+
+        victim = victim_box.get("endpoint")
+        report["victim"] = victim
+        if kill:
+            report["readyz_flipped"] = _port_dead(victim)
+
+        # --- zero lost, zero doubled, bitwise oracle parity ---
+        report["traffic_errors"] = errors
+        report["lost"] = sorted(set(range(requests)) - set(results))
+        report["probe_len"] = len(probe_tokens)
+        probe_oracle = reference_generate(cfg, params, probe_prompt, 24)
+        traffic_parity = all(
+            results.get(i) == reference_generate(
+                cfg, params, prompts[i], out_lens[i % 3])
+            for i in range(requests))
+        report["parity_bitwise"] = (probe_tokens == probe_oracle
+                                    and traffic_parity)
+
+        # --- KV migration legs against a survivor ---
+        survivor = next(ep for ep in endpoints if ep != victim)
+        report["survivor"] = survivor
+        worker = PrefillWorker(cfg, params=params, page_size=8,
+                               codec=kv_codec)
+        mig_rng = np.random.RandomState(555)
+        mig_prompt = [int(t) for t in
+                      mig_rng.randint(0, cfg.vocab_size, size=24)]
+        shipment = worker.prefill(mig_prompt)
+        s_replica = HTTPReplica(survivor)
+
+        def hits(ep):
+            _, body = _http_get(ep, "/metrics", timeout=5.0)
+            from paddle_tpu.observability.metrics import (
+                parse_prometheus_text,
+            )
+            samples = parse_prometheus_text(body.decode())
+            return sum(v for k, v in samples.items()
+                       if k.split("{")[0] == "kv_prefix_hits")
+
+        hits0 = hits(survivor)
+        mig1 = MigrationClient(s_replica.adopt).migrate(shipment)
+        report["migrate"] = {k: mig1.get(k) for k in
+                            ("ok", "adopted", "shared", "pages",
+                             "frame_bytes", "encoded_bytes",
+                             "f32_bytes")}
+        mig_tokens = s_replica.generate_chunk(mig_prompt, 8, None)
+        report["migrate_parity"] = (
+            mig_tokens == reference_generate(cfg, params,
+                                             mig_prompt, 8))
+        report["migrate_prefix_hits"] = hits(survivor) - hits0
+        # shipping the same prefix again must DEDUPE, not duplicate
+        mig2 = MigrationClient(s_replica.adopt).migrate(shipment)
+        report["migrate_dedupe"] = {
+            "adopted": mig2.get("adopted"), "shared": mig2.get("shared")}
+
+        # malformed frame: typed reject at the wire, not a 500
+        try:
+            s_replica.adopt(shipment.frame[:-3])
+            report["malformed_reject"] = False
+        except MalformedPageFrame:
+            report["malformed_reject"] = True
+
+        # degrade leg: ship at the DEAD endpoint — retries burn, the
+        # fallback counter ticks, and the request itself still serves
+        # (local recompute; the user never sees the failed migration)
+        fb_target = victim if kill else "127.0.0.1:1"
+        fb = MigrationClient(HTTPReplica(fb_target).adopt,
+                             max_attempts=2).migrate(shipment)
+        report["fallback"] = {"ok": fb.get("ok"),
+                              "reason": fb.get("reason")}
+        fb_rng = np.random.RandomState(556)
+        fb_prompt = [int(t) for t in
+                     fb_rng.randint(0, cfg.vocab_size, size=16)]
+        report["fallback_parity"] = (
+            router.generate(fb_prompt, max_new_tokens=8)
+            == reference_generate(cfg, params, fb_prompt, 8))
+
+        # --- ship-vs-recompute: the toy model is honest (too small to
+        # be worth shipping); the gate runs at a serving-scale shape
+        report["cost_toy"] = migration_cost(cfg, len(mig_prompt),
+                                            codec=kv_codec)
+        serving_cfg = DecodeModelConfig(
+            vocab_size=256_000, n_layers=48, n_heads=32, head_dim=128,
+            ffn_dim=32_768, max_context=8192)
+        report["cost_serving"] = migration_cost(serving_cfg, 2048,
+                                                codec=kv_codec)
+
+        # --- fleet-wide SLO burn gate over every surviving /metrics ---
+        from tools import slo_check
+
+        scrapes = []
+        for ep in endpoints:
+            if ep == victim:
+                continue
+            _, body = _http_get(ep, "/metrics", timeout=5.0)
+            path = os.path.join(
+                workdir, f"scrape_{ep.replace(':', '_')}.txt")
+            with open(path, "w") as f:
+                f.write(body.decode())
+            scrapes.append(path)
+        slo_argv = []
+        for p in scrapes:
+            slo_argv += ["--metrics", p]
+        report["slo_rc"] = slo_check.main(slo_argv)
+
+        # --- postmortem: the router named the kill; dump the ring ---
+        os.makedirs(_flightrec_dir(workdir), exist_ok=True)
+        flight_recorder().dump(
+            reason="fleet_failover",
+            path=os.path.join(_flightrec_dir(workdir),
+                              f"flightrec_{os.getpid()}.json"))
+    except BaseException as e:  # noqa: B036 (the report IS the output)
+        report["error"] = repr(e)
+    finally:
+        if router is not None:
+            try:
+                router.drain(timeout=10.0)
+            except Exception:
+                pass
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    report["wall_s"] = round(time.monotonic() - t0, 1)
+
+    delta = {k: v - counters0.get(k, 0)
+             for k, v in profiler.counters_snapshot().items()}
+    report["counters"] = {
+        n: delta.get(n, 0)
+        for n in (*profiler.ROUTER_COUNTER_NAMES, "retry_attempts",
+                  "retry_giveups", "kv_migration_fallbacks")}
+    if router is not None:
+        report["counters"].update(
+            {k: v for k, v in router.counters.items()
+             if k.startswith("router_")})
+
+    dumps = _flightrec_report(workdir)
+    victim = report.get("victim")
+    names_kill = False
+    d = _flightrec_dir(workdir)
+    if os.path.isdir(d) and victim:
+        for fn in os.listdir(d):
+            if not fn.startswith("flightrec_"):
+                continue
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    dump = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if dump.get("reason") == "fleet_failover" and any(
+                    ev.get("kind") == "replica_dead"
+                    and ev.get("replica") == victim
+                    for ev in dump.get("events", [])):
+                names_kill = True
+    report["flightrec"] = {"dumps": dumps["dumps"],
+                           "reasons": dumps["reasons"],
+                           "names_kill": names_kill}
+
+    ctr = report["counters"]
+    report["ok"] = bool(
+        "error" not in report
+        and not report.get("lost")
+        and not report.get("traffic_errors")
+        and report.get("parity_bitwise")
+        and report.get("migrate", {}).get("ok")
+        and report.get("migrate_parity")
+        and report.get("migrate_prefix_hits", 0) >= 1
+        and report.get("migrate_dedupe", {}).get("adopted") == 0
+        and report.get("migrate_dedupe", {}).get("shared", 0) >= 1
+        and report.get("malformed_reject")
+        and report.get("fallback", {}).get("ok") is False
+        and report.get("fallback_parity")
+        and ctr.get("kv_migration_fallbacks", 0) >= 1
+        and report.get("cost_serving", {}).get("cheaper_to_ship")
+        and report.get("slo_rc") == 0
+        and (not kill or (report.get("readyz_flipped")
+                          and ctr.get("router_failovers", 0) >= 1
+                          and ctr.get("router_replays", 0) >= 1
+                          and report["flightrec"]["names_kill"])))
+    return report
+
+
+def _print_fleet_table(report: dict) -> None:
+    print(f"\nfleet chaos drill: kill={report['kill']} "
+          f"engines={report.get('engines')} wall={report['wall_s']}s")
+    if "error" in report:
+        print(f"ERROR: {report['error']}")
+    print(f"victim={report.get('victim')} "
+          f"readyz_flipped={report.get('readyz_flipped')} "
+          f"survivor={report.get('survivor')}")
+    print(f"lost={report.get('lost')} "
+          f"traffic_errors={report.get('traffic_errors')} "
+          f"parity_bitwise={report.get('parity_bitwise')}")
+    print(f"migrate={report.get('migrate')} "
+          f"parity={report.get('migrate_parity')} "
+          f"prefix_hits={report.get('migrate_prefix_hits')} "
+          f"dedupe={report.get('migrate_dedupe')}")
+    print(f"malformed_reject={report.get('malformed_reject')} "
+          f"fallback={report.get('fallback')} "
+          f"fallback_parity={report.get('fallback_parity')}")
+    cost_t, cost_s = report.get("cost_toy", {}), \
+        report.get("cost_serving", {})
+    print(f"cost: toy cheaper_to_ship={cost_t.get('cheaper_to_ship')} "
+          f"({cost_t.get('encoded_bytes')}B vs "
+          f"{cost_t.get('flops_equiv_bytes')}B-equiv) | serving-scale "
+          f"cheaper_to_ship={cost_s.get('cheaper_to_ship')} "
+          f"({cost_s.get('encoded_bytes')}B vs "
+          f"{cost_s.get('flops_equiv_bytes')}B-equiv, "
+          f"saved {cost_s.get('bytes_saved_pct')}%)")
+    print(f"slo_rc={report.get('slo_rc')} "
+          f"flightrec={report.get('flightrec')}")
+    from tools.metrics_watch import format_counter_table
+
+    print("\n" + format_counter_table(report.get("counters", {}),
+                                      name_width=28))
+    print(f"\nok={report['ok']}")
+
+
+def fleet_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description="fleet decode drill: SIGKILL an engine under live "
+                    "router traffic; assert failover, bitwise replay "
+                    "parity, and the KV-migration legs")
+    ap.add_argument("--workdir", default="/tmp/paddle_tpu_fleet_drill")
+    ap.add_argument("--engines", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=9)
+    ap.add_argument("--chunk-tokens", type=int, default=4)
+    ap.add_argument("--kv-codec", default="int8",
+                    choices=("off", "int8"))
+    ap.add_argument("--no-kill", action="store_true",
+                    help="clean baseline: same traffic, no SIGKILL")
+    args = ap.parse_args(argv)
+    report = run_fleet_drill(
+        args.workdir, n_engines=args.engines, requests=args.requests,
+        chunk_tokens=args.chunk_tokens, kv_codec=args.kv_codec,
+        kill=not args.no_kill)
+    _print_fleet_table(report)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -626,8 +1085,12 @@ def main(argv=None) -> int:
         return worker_main()
     if argv and argv[0] == "--ps-server":
         return ps_server_main()
+    if argv and argv[0] == "--fleet-engine":
+        return fleet_engine_main()
     if argv and argv[0] == "--ps":
         return ps_main(argv[1:])
+    if argv and argv[0] == "--fleet":
+        return fleet_main(argv[1:])
     ap = argparse.ArgumentParser(
         description="deterministic elastic kill/resume chaos drill")
     ap.add_argument("--workdir", default="/tmp/paddle_tpu_chaos_drill")

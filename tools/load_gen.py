@@ -35,6 +35,14 @@ split (``prefill_tokens[_per_sec]``), and the speculative-decoding
 economics (``spec_proposed`` / ``spec_accepted`` /
 ``spec_accept_rate`` — zeros when ``--spec-k`` is 0). ``--kv-codec
 int8`` drives the same workload over int8 KV pages.
+
+Fleet mode (``FleetLoadGen`` / ``--fleet N``): N decode engines behind
+one in-process ``FleetRouter``, sprayed with a zipf-distributed
+session workload (a few hot sessions dominate — the shape that makes
+session affinity and prefix caching earn their keep). Reports
+``fleet_tokens_per_sec``, ``fleet_p99_ttft_ms``, the PER-ENGINE token
+share (each engine's ``decode_tokens`` delta), the session spread, and
+the router's dispatch/failover/affinity/shed counters.
 """
 from __future__ import annotations
 
@@ -44,6 +52,7 @@ import os
 import sys
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -376,6 +385,218 @@ class DecodeLoadGen:
         return self.summary
 
 
+class FleetLoadGen:
+    """Closed-loop fleet workload: spray a :class:`FleetRouter` from
+    ``workers`` threads with requests whose SESSION ids follow a zipf
+    distribution — a few hot sessions dominate, the realistic shape for
+    session-affine routing (uniform sessions would make affinity free
+    and prefix caching useless). Deterministic like the other gens:
+    request ``i`` draws its session from ``RandomState(77000 + i)``,
+    its prompt is the session's shared prefix (so affinity converts to
+    prefix-cache hits) plus an ``i``-seeded tail, and lengths cycle
+    through ``prompt_lens``/``output_lens``.
+
+    ``run()`` reports the fleet view next to the closed-loop fields:
+    ``fleet_tokens_per_sec``, ``fleet_p99_ttft_ms``, PER-ENGINE token
+    share (from each engine's ``decode_tokens`` delta — the balance
+    evidence), the session spread, and the router's own counters
+    (dispatches/failovers/affinity hits/sheds)."""
+
+    def __init__(self, router, total_requests: int = 24, workers: int = 4,
+                 prompt_lens: Sequence[int] = (4, 12, 24, 8),
+                 output_lens: Sequence[int] = (4, 8, 16),
+                 n_sessions: Optional[int] = None, zipf_a: float = 1.5,
+                 deadline_s: Optional[float] = None,
+                 timeout_s: float = 300.0, keep_outputs: bool = False):
+        self.router = router
+        self.total_requests = int(total_requests)
+        self.workers = max(1, int(workers))
+        self.prompt_lens = tuple(int(p) for p in prompt_lens)
+        self.output_lens = tuple(int(o) for o in output_lens)
+        self.n_sessions = int(n_sessions or max(4, total_requests // 3))
+        self.zipf_a = float(zipf_a)
+        self.deadline_s = deadline_s
+        self.timeout_s = float(timeout_s)
+        self.keep_outputs = bool(keep_outputs)
+        self.outputs: dict = {}   # request index -> generated tokens
+        self.summary: Optional[dict] = None
+
+    def _session_for(self, i: int) -> str:
+        rng = np.random.RandomState(77_000 + i)
+        rank = int(rng.zipf(self.zipf_a))
+        return f"sess-{(rank - 1) % self.n_sessions:03d}"
+
+    def _make_prompt(self, i: int, session: str) -> list:
+        cfg = getattr(self.router, "config", None)
+        vocab = cfg.vocab_size if cfg is not None else 128
+        n = self.prompt_lens[i % len(self.prompt_lens)]
+        # shared per-session prefix: affinity keeps the session on one
+        # replica, whose prefix cache then serves these tokens for free
+        # (crc32, NOT hash(): str hash is salted per process and this
+        # workload must replay identically)
+        srng = np.random.RandomState(
+            zlib.crc32(session.encode()) & 0x7FFFFFFF)
+        prefix = [int(t) for t in srng.randint(0, vocab, size=4)]
+        rng = np.random.RandomState(i)
+        tail = [int(t) for t in rng.randint(0, vocab, size=max(1, n - 4))]
+        return prefix + tail
+
+    def run(self) -> dict:
+        from paddle_tpu.inference.serving import (DeadlineExceeded,
+                                                  EngineStopped,
+                                                  Overloaded,
+                                                  RequestFailed)
+
+        counter = itertools.count()
+        outcomes = {"ok": 0, "shed": 0, "deadline_expired": 0,
+                    "failed": 0, "stopped": 0, "other_error": 0}
+        lock = threading.Lock()
+        ttft_ms: list = []
+        tokens_out = [0]
+        session_hits: Dict[str, int] = {}
+
+        def engine_tokens() -> Dict[str, int]:
+            out = {}
+            for r in getattr(self.router, "replicas", []):
+                eng = getattr(r, "engine", None)
+                if eng is None:
+                    continue
+                try:
+                    out[r.name] = int(eng.counters.get("decode_tokens", 0))
+                except Exception:
+                    out[r.name] = 0
+            return out
+
+        base_tokens = engine_tokens()
+
+        def record(kind: str):
+            with lock:
+                outcomes[kind] += 1
+
+        def worker():
+            while True:
+                i = next(counter)
+                if i >= self.total_requests:
+                    return
+                session = self._session_for(i)
+                prompt = self._make_prompt(i, session)
+                out_n = self.output_lens[i % len(self.output_lens)]
+                try:
+                    h = self.router.submit(
+                        prompt, max_new_tokens=out_n,
+                        deadline_s=self.deadline_s, session=session)
+                    toks = h.result(self.timeout_s)
+                    st = h.stats()
+                    with lock:
+                        if self.keep_outputs:
+                            self.outputs[i] = list(toks)
+                        tokens_out[0] += len(toks)
+                        session_hits[session] = \
+                            session_hits.get(session, 0) + 1
+                        if "ttft_ms" in st:
+                            ttft_ms.append(st["ttft_ms"])
+                    record("ok")
+                except Overloaded:
+                    record("shed")
+                except DeadlineExceeded:
+                    record("deadline_expired")
+                except RequestFailed:
+                    record("failed")
+                except EngineStopped:
+                    record("stopped")
+                    return
+                except Exception:
+                    record("other_error")
+
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"fleet-loadgen-{w}")
+                   for w in range(self.workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.timeout_s)
+        dt = time.perf_counter() - t0
+
+        def pct(arr, q):
+            a = np.asarray(arr, np.float64)
+            return round(float(np.percentile(a, q)), 3) if a.size else 0.0
+
+        per_engine = {
+            name: tok - base_tokens.get(name, 0)
+            for name, tok in engine_tokens().items()}
+        total_eng = sum(per_engine.values())
+        rctr = self.router.counters
+        self.summary = {
+            "requests": self.total_requests,
+            "completed": sum(outcomes.values()),
+            "wall_s": round(dt, 4),
+            "fleet_tokens": tokens_out[0],
+            "fleet_tokens_per_sec":
+                round(tokens_out[0] / dt, 2) if dt else 0.0,
+            "fleet_ttft_p50_ms": pct(ttft_ms, 50),
+            "fleet_p99_ttft_ms": pct(ttft_ms, 99),
+            # balance evidence: each engine's decode_tokens delta over
+            # the run, and its share of the fleet total
+            "per_engine_tokens": per_engine,
+            "per_engine_token_share": {
+                name: (round(tok / total_eng, 4) if total_eng else 0.0)
+                for name, tok in per_engine.items()},
+            "sessions": self.n_sessions,
+            "session_spread": dict(sorted(
+                session_hits.items(), key=lambda kv: -kv[1])[:8]),
+            "zipf_a": self.zipf_a,
+            "workers": self.workers,
+            "prompt_lens": list(self.prompt_lens),
+            "output_lens": list(self.output_lens),
+            "router_requests": int(rctr.get("router_requests", 0)),
+            "router_dispatches": int(rctr.get("router_dispatches", 0)),
+            "router_failovers": int(rctr.get("router_failovers", 0)),
+            "router_affinity_hits":
+                int(rctr.get("router_affinity_hits", 0)),
+            "router_sheds": int(rctr.get("router_sheds", 0)),
+            **outcomes,
+        }
+        return self.summary
+
+
+def _fleet_main(args):
+    """--fleet N CLI leg: N self-contained decode engines behind one
+    in-process ``FleetRouter``, sprayed with the zipf-session
+    workload."""
+    from paddle_tpu.inference.decode import DecodeEngine, DecodeModelConfig
+    from paddle_tpu.serving import FleetRouter
+
+    cfg = DecodeModelConfig(vocab_size=args.vocab, n_layers=args.layers,
+                            n_heads=args.heads, head_dim=args.head_dim,
+                            ffn_dim=args.ffn,
+                            max_context=args.pages_per_seq
+                            * args.page_size)
+    engines = []
+    for _ in range(max(1, args.fleet)):
+        e = DecodeEngine(
+            cfg, seed=0, max_batch=args.max_batch, n_pages=args.pages,
+            page_size=args.page_size,
+            max_pages_per_seq=args.pages_per_seq,
+            kv_codec=args.kv_codec)
+        e.warm()
+        e.start()
+        engines.append(e)
+    router = FleetRouter(engines, config=cfg,
+                         chunk_tokens=args.chunk_tokens)
+    try:
+        gen = FleetLoadGen(
+            router, total_requests=args.requests, workers=args.workers,
+            prompt_lens=[int(p) for p in args.prompt_lens.split(",")],
+            output_lens=[int(o) for o in args.output_lens.split(",")],
+            n_sessions=args.sessions or None, zipf_a=args.zipf_a,
+            deadline_s=args.deadline_s)
+        summary = gen.run()
+        print(json.dumps(summary))
+    finally:
+        router.drain(timeout=30)
+
+
 def _decode_main(args):
     """--decode CLI leg: a self-contained tiny decode engine (no blob
     needed — the mode demos/benches the decode data path itself)."""
@@ -422,6 +643,20 @@ def main():
                     help="decode workload mode: drive a self-contained "
                          "LLM decode engine with deterministic mixed "
                          "prompt/output lengths")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet mode: N decode engines behind one "
+                         "FleetRouter, sprayed with a zipf-session "
+                         "workload; reports per-engine token share and "
+                         "fleet p99 TTFT")
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="fleet mode: session pool size (0 = derive "
+                         "from --requests)")
+    ap.add_argument("--zipf-a", type=float, default=1.5,
+                    help="fleet mode: zipf exponent for the session "
+                         "distribution (higher = hotter head)")
+    ap.add_argument("--chunk-tokens", type=int, default=8,
+                    help="fleet mode: router dispatch chunk size "
+                         "(failover granularity)")
     ap.add_argument("--prompt-lens", default="4,12,24,8",
                     help="decode mode: comma-separated prompt lengths "
                          "(cycled per request)")
@@ -453,6 +688,9 @@ def main():
     ap.add_argument("--deadline-s", type=float, default=None)
     args = ap.parse_args()
 
+    if args.fleet:
+        _fleet_main(args)
+        return
     if args.decode:
         _decode_main(args)
         return

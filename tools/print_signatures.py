@@ -48,6 +48,7 @@ MODULES = [
     "paddle_tpu.ps.replication",
     "paddle_tpu.quantization",
     "paddle_tpu.regularizer",
+    "paddle_tpu.serving",
     "paddle_tpu.static",
     "paddle_tpu.static.cost_model",
     "paddle_tpu.static.substrate",

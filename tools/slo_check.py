@@ -9,6 +9,8 @@ Usage::
     python tools/slo_check.py --metrics new.txt --baseline old.txt \
         --window-s 300
     python tools/slo_check.py --metrics ... --objectives slo.json
+    python tools/slo_check.py --metrics 127.0.0.1:8101 \
+        --metrics 127.0.0.1:8102 --metrics 127.0.0.1:8103   # a fleet
 
 With one scrape, objectives evaluate over the CUMULATIVE totals (the
 window is "since process start"). With ``--baseline`` (an earlier
@@ -18,6 +20,13 @@ burn-rate window; ``--window-s`` only labels it. Objectives default to
 list (see ``objectives_from_json``) to declare your own. Works against
 a federated scrape too — pass ``--instance host:port`` to narrow to
 one member.
+
+``--metrics`` repeats: each endpoint/file is scraped and its samples
+are merged under an ``instance`` label (exactly the federation plane's
+convention), so objectives evaluate the FLEET aggregate by default and
+``--instance`` still narrows to one member. Repeat ``--baseline`` the
+same number of times, in the same order, for a fleet-wide delta. One
+unreachable endpoint is an input error (exit 2), never a silent gap.
 
 Exit codes: 0 healthy, 1 burning (the CI signal), 2 input/usage error.
 """
@@ -49,16 +58,35 @@ def _load_samples(target: str):
     return scrape(target)
 
 
+def _load_fleet(targets):
+    """One target -> its samples verbatim (single-scrape back-compat).
+    Several -> the union with each sample ``instance``-labeled by the
+    target it came from, so per-member objectives keep working and
+    unlabeled ones sum fleet-wide."""
+    if len(targets) == 1:
+        return _load_samples(targets[0])
+    from paddle_tpu.observability.federation import _inject_instance
+
+    merged = {}
+    for target in targets:
+        for key, v in _load_samples(target).items():
+            merged[_inject_instance(key, target)] = v
+    return merged
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="evaluate SLO burn rates against a /metrics "
                     "scrape; exit 1 on burn")
-    ap.add_argument("--metrics", required=True,
-                    help="host:port to scrape, or a saved scrape file")
-    ap.add_argument("--baseline", default=None,
+    ap.add_argument("--metrics", required=True, action="append",
+                    help="host:port to scrape, or a saved scrape file; "
+                         "repeat for a fleet (samples merge under an "
+                         "instance label)")
+    ap.add_argument("--baseline", default=None, action="append",
                     help="earlier scrape (host:port or file) — "
                          "evaluate the delta instead of cumulative "
-                         "totals")
+                         "totals; repeat to mirror a multi --metrics "
+                         "fleet")
     ap.add_argument("--objectives", default=None,
                     help="JSON file declaring objectives (default: "
                          "the stock fleet objectives)")
@@ -84,8 +112,13 @@ def main(argv=None) -> int:
         if args.instance:
             for o in objectives:
                 o.instance = args.instance
-        samples = _load_samples(args.metrics)
-        base = (_load_samples(args.baseline)
+        if args.baseline and len(args.baseline) != len(args.metrics):
+            raise ValueError(
+                f"{len(args.baseline)} --baseline scrape(s) for "
+                f"{len(args.metrics)} --metrics endpoint(s); repeat "
+                "--baseline once per endpoint, in the same order")
+        samples = _load_fleet(args.metrics)
+        base = (_load_fleet(args.baseline)
                 if args.baseline else None)
     # TypeError: an --objectives row with a wrong/unknown field
     # (Objective(**row)) — a usage error, which must NOT exit 1 and
