@@ -959,23 +959,28 @@ def _shard_probe_main(n_devices=8, steps=3):
     import time as _time
 
     import paddle_tpu.static as static
-    from paddle_tpu.parallel.pipeline import gpipe_bubble_fraction
+    from paddle_tpu.parallel.pipeline import (gpipe_bubble_fraction,
+                                              schedule_bubble_fraction)
     from paddle_tpu.utils import unique_name
 
     H, B, K, S = 16, 8, 4, 2
 
-    def build(seed=77):
+    def build(seed=77, hidden=(32, H), opt="sgd"):
         main, startup = static.Program(), static.Program()
         main.random_seed = startup.random_seed = seed
         with static.program_guard(main, startup):
             x = static.data("x", [-1, H])
             label = static.data("label", [-1, 1], dtype="int64")
-            h = static.nn.fc(x, 32, act="relu")
-            h = static.nn.fc(h, H, act="relu")
+            h = x
+            for w in hidden:
+                h = static.nn.fc(h, w, act="relu")
             logits = static.nn.fc(h, 4)
             loss = static.mean(
                 static.softmax_with_cross_entropy(logits, label))
-            static.SGD(0.05).minimize(loss)
+            if opt == "momentum":
+                static.Momentum(0.05, momentum=0.9).minimize(loss)
+            else:
+                static.SGD(0.05).minimize(loss)
         return main, startup, loss, [p.name for p in
                                      main.all_parameters()]
 
@@ -983,11 +988,11 @@ def _shard_probe_main(n_devices=8, steps=3):
     feed = {"x": rng.randn(B, H).astype(np.float32),
             "label": rng.randint(0, 4, (B, 1)).astype(np.int64)}
 
-    def run(strategy=None):
+    def run(strategy=None, **bkw):
         with unique_name.guard():
             scope = static.Scope()
             with static.scope_guard(scope):
-                main, startup, loss, params = build()
+                main, startup, loss, params = build(**bkw)
                 exe = static.Executor()
                 exe.run(startup)
                 target = static.CompiledProgram(
@@ -1015,6 +1020,16 @@ def _shard_probe_main(n_devices=8, steps=3):
     bs_pp.gradient_merge_k = K
     bs_pp.pipeline_stages = S
     _pp_losses, _dt_pp, pc, _ = run(bs_pp)
+    # 1F1B on the same gm×pp composition (ISSUE 18): the schedule is
+    # bitwise with gpipe (the test suite's gate); the probe reports the
+    # modeled bubble win + the measured rate
+    bs_1f = static.BuildStrategy()
+    bs_1f.mesh_shape = {"dp": 2, "tp": 2}
+    bs_1f.sharding_hints = dict(bs.sharding_hints)
+    bs_1f.gradient_merge_k = K
+    bs_1f.pipeline_stages = S
+    bs_1f.pipeline_schedule = "1f1b"
+    _1f_losses, dt_1f, _, _ = run(bs_1f)
     # quantized-collective DP leg (ISSUE 15): pure-dp mesh, int8
     # bucketed ring all-reduce vs the same mesh's XLA f32 leg — the
     # loss delta is the accuracy gate, the byte counters the bandwidth
@@ -1029,6 +1044,24 @@ def _shard_probe_main(n_devices=8, steps=3):
     quant, dt_q, qc, _ = run(bs_q)
     q_sent = int(qc.get("comm_quant_bytes_sent", 0))
     q_saved = int(qc.get("comm_quant_bytes_saved", 0))
+    # ZeRO-2 sharded optimizer states riding the int8 ring (ISSUE 18):
+    # a momentum net big enough that the (g, chunk) rows dwarf the ring
+    # padding — per-device state bytes collapse toward 1/g while the
+    # loss stays inside the quant gate vs the replicated comm leg
+    from paddle_tpu.ops.pallas import counters as _pk
+
+    zkw = dict(hidden=(128, 64), opt="momentum")
+    bs_zc = static.BuildStrategy()
+    bs_zc.mesh_shape = {"dp": n_devices}
+    bs_zc.comm_quant = "int8"
+    z_base, _dt_zc, _, _ = run(bs_zc, **zkw)
+    z_snap0 = _pk.snapshot().get("zero.zero", 0)
+    bs_z = static.BuildStrategy()
+    bs_z.mesh_shape = {"dp": n_devices}
+    bs_z.comm_quant = "int8"
+    bs_z.zero_stage = 2
+    z_losses, _dt_z, zc, _ = run(bs_z, **zkw)
+    z_dispatches = _pk.snapshot().get("zero.zero", 0) - z_snap0
     tokens = B * steps
     print(json.dumps({
         "shard_tokens_per_sec": round(tokens / dt_shard, 2),
@@ -1039,6 +1072,15 @@ def _shard_probe_main(n_devices=8, steps=3):
         "shard_vars_annotated": int(sc.get("shard_vars_annotated", 0)),
         "pp_stages": int(pc.get("pp_stages", 0)),
         "pp_bubble_frac": round(gpipe_bubble_fraction(S, K), 4),
+        "pp_1f1b_tokens_per_sec": round(tokens / dt_1f, 2),
+        "pp_1f1b_bubble_frac": round(
+            schedule_bubble_fraction("1f1b", S, K), 4),
+        "zero_stage": int(zc.get("zero_stage_active", 0)),
+        "zero_state_bytes_saved_pct": round(float(
+            zc.get("zero_state_bytes_saved_pct", 0.0)), 2),
+        "zero_loss_delta": max(
+            abs(a - b) for a, b in zip(z_base, z_losses)),
+        "zero_dispatches": int(z_dispatches),
         "shard_devices": n_devices,
         "quant_allreduce_tokens_per_sec": round(tokens / dt_q, 2),
         "quant_loss_delta": max(

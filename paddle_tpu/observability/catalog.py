@@ -49,7 +49,18 @@ SCALARS = {
     "shard_vars_annotated": ("counter", "VarDescs stamped with a propagated PartitionSpec"),
     "shard_conflicts_replicated": ("counter", "spec conflicts resolved by replication"),
     "shard_psums_inserted": ("counter", "contracted/reduced sharded dims needing a psum (XLA SPMD materializes them)"),
-    "pp_stages": ("gauge", "pipeline stages of the last pipelined build (GPipe schedule)"),
+    "pp_stages": ("gauge", "pipeline stages of the last pipelined build"),
+    "pp_bubble_frac": ("gauge", "modeled bubble fraction of the last pipelined build's schedule (gpipe/1f1b/interleaved closed forms)"),
+    "pp_stash_depth": ("gauge", "modeled max live microbatch activations of the last non-gpipe schedule (1f1b bounds this at S)"),
+    "pp_schedule_fallback": ("gauge", "1 when the requested interleaved schedule degraded to 1f1b (stage count not divisible by the interleave)"),
+    # ZeRO sharded optimizer states (static/stepplan.py zero kind)
+    "zero_stage_active": ("gauge", "ZeRO stage of the last engaged zero build (2 = grads+optimizer state sharded, 3 = +params)"),
+    "zero_buckets": ("gauge", "gradient buckets of the last zero build (rides the comm bucket plan)"),
+    "zero_state_bytes_replicated": ("gauge", "per-device optimizer-state bytes the replicated step would hold"),
+    "zero_state_bytes_sharded": ("gauge", "per-device optimizer-state bytes the sharded rows actually hold (~1/g + padding)"),
+    "zero_state_bytes_saved_pct": ("gauge", "percent of per-device optimizer-state bytes the sharding saved"),
+    "zero_wire_bytes_sent": ("counter", "ZeRO step wire bytes per device (encoded half-ring reduce-scatter + raw-f32 all-gather; kept out of comm_quant_bytes_sent)"),
+    "zero_wire_bytes_saved": ("counter", "f32 all-reduce ring bytes the ZeRO rs+ag profile avoided moving"),
     # quantized collectives (parallel/collectives.py + the executor's
     # bucketed DP all-reduce step; PS wire codecs bump the same bytes)
     "comm_quant_bytes_sent": ("counter", "encoded collective/PS wire bytes actually moved (per-device ring bytes for the DP all-reduce, payload bytes for PS push/pull)"),

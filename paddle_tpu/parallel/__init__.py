@@ -9,14 +9,17 @@ from .sharding import (  # noqa: F401
 )
 from .pipeline import (  # noqa: F401
     pipeline_apply, pipeline_1f1b_value_and_grad, stack_stage_params,
-    gpipe_schedule, gpipe_bubble_fraction,
+    gpipe_schedule, gpipe_bubble_fraction, one_f_one_b_schedule,
+    interleaved_schedule, pipeline_timeline, schedule_bubble_fraction,
 )
 from .ring import (  # noqa: F401
     ring_attention, ulysses_attention, ring_attention_local,
     ulysses_attention_local, sequence_parallel, active_sequence_parallel,
 )
 from .collectives import (  # noqa: F401
-    QUANT_BLOCK, allreduce_done, allreduce_start, bucketed_allreduce,
-    encoded_nbytes, np_decode, np_encode, quant_decode, quant_encode,
-    quantized_allreduce, ring_allreduce_local, ring_nbytes,
+    QUANT_BLOCK, all_gather, all_gather_nbytes, allreduce_done,
+    allreduce_start, bucketed_allreduce, encoded_nbytes, np_decode,
+    np_encode, quant_decode, quant_encode, quantized_allreduce,
+    reduce_scatter, reduce_scatter_nbytes, ring_allreduce_local,
+    ring_nbytes,
 )

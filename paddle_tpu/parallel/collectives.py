@@ -52,12 +52,39 @@ from ..ps.codec import (  # noqa: F401
 __all__ = [
     "CODEC_IDS", "CODEC_NAMES", "QUANT_BLOCK",
     "encoded_nbytes", "ring_nbytes",
+    "reduce_scatter_nbytes", "all_gather_nbytes",
     "quant_encode", "quant_decode",
     "ring_allreduce_local", "allreduce_start", "allreduce_done",
+    "reduce_scatter", "all_gather",
     "quantized_allreduce", "bucketed_allreduce", "padded_len",
     "np_encode", "np_decode",
     "quant_allreduce_escaped", "shard_map_nocheck",
 ]
+
+
+def reduce_scatter_nbytes(n_elems: int, group: int, codec: str,
+                          block: int = QUANT_BLOCK) -> int:
+    """Per-device wire bytes of the reduce-scatter half of the ring:
+    ``(g-1)/g`` of the encoded payload (one encoded chunk per hop,
+    g-1 hops) — half of :func:`ring_nbytes`."""
+    g = max(1, int(group))
+    if g <= 1:
+        return 0
+    return ring_nbytes(n_elems, group, codec, block) // 2
+
+
+def all_gather_nbytes(n_elems: int, group: int, codec: str,
+                      block: int = QUANT_BLOCK) -> int:
+    """Per-device wire bytes of the all-gather half of the ring — the
+    same ``(g-1)/g`` of the encoded payload as the reduce-scatter half
+    (the carried chunk circulates g-1 hops); the two halves sum to
+    :func:`ring_nbytes` exactly (this side carries the floor
+    remainder)."""
+    g = max(1, int(group))
+    if g <= 1:
+        return 0
+    full = ring_nbytes(n_elems, group, codec, block)
+    return full - full // 2
 
 
 def quant_allreduce_escaped() -> bool:
@@ -257,6 +284,56 @@ def allreduce_done(carry, avg: bool = False):
         out = out / g
     n = int(np.prod(shape)) if shape else 1
     return out[:n].reshape(shape).astype(dtype)
+
+
+def reduce_scatter(x, axis_name: str, *, codec: str = "int8",
+                   axis_size: Optional[int] = None, avg: bool = False,
+                   block: int = QUANT_BLOCK):
+    """Public reduce-scatter half of the quantized ring; call inside
+    shard_map. ``x`` is this device's local contribution (any shape);
+    the result is the flat f32 REDUCED chunk this device owns —
+    length ``padded_len(x.size, g, block) // g``, f32-accumulated at
+    every hop with the wire payloads encoded per ``codec`` (the
+    ``np_encode`` block layout).
+
+    Chunk ownership follows the ring convention: device ``idx`` ends
+    holding chunk ``(idx + 1) % g`` of the padded flat buffer —
+    :func:`all_gather` undoes exactly that placement, so
+    ``all_gather(reduce_scatter(x))`` (avg off, same codec) is
+    BITWISE ``quantized_allreduce`` of the same contributions. This is
+    the ZeRO decomposition: the optimizer consumes the unquantized f32
+    chunk, only the wire moves encoded bytes. ``avg=True`` divides the
+    reduced chunk by g (mean-gradient semantics, BEFORE any further
+    encode)."""
+    carry = allreduce_start(x, axis_name, codec=codec,
+                            axis_size=axis_size, block=block)
+    mine, g = carry[1], carry[7]
+    if avg:
+        mine = mine / g
+    return mine
+
+
+def all_gather(chunk, axis_name: str, *, codec: str = "f32",
+               axis_size: Optional[int] = None,
+               block: int = QUANT_BLOCK):
+    """Public all-gather half of the ring; call inside shard_map.
+    ``chunk`` is this device's flat owned chunk under the ring
+    placement (device ``idx`` owns chunk ``(idx + 1) % g`` — what
+    :func:`reduce_scatter` returns); the result is the full flat
+    ``(g * chunk.size,)`` f32 buffer in ORIGINAL chunk order, bitwise
+    identical on every device (the payload is encoded once and every
+    device decodes the same bytes). The default ``codec='f32'`` moves
+    raw bytes — the ZeRO parameter all-gather leg (sharded-update
+    results must come back exact); pass the grad codec to reproduce
+    ``quantized_allreduce``'s gather phase."""
+    import jax.numpy as jnp
+
+    g = axis_size if axis_size is not None else _axis_size(axis_name)
+    flat = chunk.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0] * g
+    tag = "done1" if g == 1 else "rs"
+    return allreduce_done(
+        (tag, flat, (n,), jnp.float32, codec, block, axis_name, g))
 
 
 def ring_allreduce_local(x, axis_name: str, *, codec: str = "int8",

@@ -145,9 +145,10 @@ def gpipe_schedule(num_stages: int, num_microbatches: int):
     every (stage, microbatch) pair is data-independent (stage s consumes
     what stage s-1 produced at tick t-1), which is what lets a consumer
     run the pairs concurrently — the static executor's pipelined train
-    step (executor._pp_step_fn) drives its per-stage op ranges off this
-    grid. Stages are yielded in DESCENDING order so an in-place consumer
-    never overwrites an activation the same tick still reads.
+    step (the ``pipeline`` plan kind in static/stepplan.py) drives its
+    per-stage op ranges off this grid. Stages are yielded in DESCENDING
+    order so an in-place consumer never overwrites an activation the
+    same tick still reads.
     """
     s_count, m_count = int(num_stages), int(num_microbatches)
     if s_count < 1 or m_count < 1:
@@ -165,6 +166,155 @@ def gpipe_bubble_fraction(num_stages: int, num_microbatches: int) -> float:
     as ``pp_bubble_frac`` and that growing M amortises."""
     s_count, m_count = int(num_stages), int(num_microbatches)
     return (s_count - 1) / max(s_count + m_count - 1, 1)
+
+
+def one_f_one_b_schedule(num_stages: int, num_microbatches: int):
+    """The 1F1B (PipeDream-flush) tick grid as data: yields
+    ``(tick, [("F"|"B", stage, microbatch), ...])`` for every tick.
+
+    Each stage warms up with forwards until it holds its target
+    in-flight depth (S - s microbatches), then strictly alternates
+    backward/forward until the drain — so a microbatch's backward
+    starts as soon as its cotangent can arrive, and the activation
+    stash per stage stays bounded by the warmup depth instead of M
+    (GPipe keeps all M in flight through the fill phase).
+
+    The grid is generated by simulating the per-stage state machines
+    under the dataflow dependencies (F(s,m) needs F(s-1,m); B(s,m)
+    needs F(s,m) and B(s+1,m)), one slot per stage per tick, so any
+    consumer that replays the slots in order preserves them by
+    construction. Within a tick, stages are yielded in DESCENDING
+    order (same contract as :func:`gpipe_schedule`). Microbatches
+    retire (run their last-stage forward + backward) in ascending
+    order on every schedule — the invariant that keeps merged-gradient
+    accumulation order, and therefore the loss, identical across
+    gpipe/1f1b/interleaved.
+    """
+    s_count, m_count = int(num_stages), int(num_microbatches)
+    if s_count < 1 or m_count < 1:
+        raise ValueError(f"one_f_one_b_schedule: need num_stages >= 1 "
+                         f"and num_microbatches >= 1, got "
+                         f"({num_stages}, {num_microbatches})")
+    f_done = [0] * s_count   # forwards completed per stage
+    b_done = [0] * s_count   # backwards completed per stage
+    t = 0
+    while any(b < m_count for b in b_done):
+        prev_f, prev_b = list(f_done), list(b_done)
+        slots = []
+        for s in range(s_count - 1, -1, -1):
+            m_f, m_b = prev_f[s], prev_b[s]
+            can_f = m_f < m_count and (s == 0 or prev_f[s - 1] > m_f)
+            can_b = m_b < m_f and \
+                (s == s_count - 1 or prev_b[s + 1] > m_b)
+            # 1F1B discipline: once the stage holds its warmup depth
+            # (S - s in-flight microbatches) — or has no forwards left
+            # — it drains a backward before admitting another forward
+            prefer_b = (m_f - m_b) >= (s_count - s) or m_f == m_count
+            if can_b and (prefer_b or not can_f):
+                slots.append(("B", s, m_b))
+                b_done[s] += 1
+            elif can_f:
+                slots.append(("F", s, m_f))
+                f_done[s] += 1
+        yield t, slots
+        t += 1
+
+
+def interleaved_schedule(num_stages: int, num_microbatches: int,
+                         interleave: int = 2):
+    """Interleaved 1F1B: the ``num_stages`` stamped stages are treated
+    as v (= ``interleave``) virtual chunks round-robined over
+    S/v physical workers (Megatron-style assignment: worker p owns
+    virtual stages p, p + S/v, ...), shrinking the warmup bubble by v
+    at the cost of v× the stage-boundary traffic.
+
+    Generated by list-scheduling the plain 1F1B slot stream under the
+    same dataflow dependencies plus one-slot-per-worker-per-tick
+    occupancy: each slot lands at the earliest tick where its inputs
+    are done and its worker is free, preserving both the dependency
+    order and the ascending microbatch retirement order. Requires
+    ``num_stages % interleave == 0``. Yields the same
+    ``(tick, [("F"|"B", stage, m), ...])`` grid as
+    :func:`one_f_one_b_schedule`.
+    """
+    s_count, m_count = int(num_stages), int(num_microbatches)
+    v = int(interleave)
+    if v < 1 or s_count % v:
+        raise ValueError(
+            f"interleaved_schedule: num_stages {num_stages} not "
+            f"divisible by interleave {interleave}")
+    workers = s_count // v
+    f_end: dict = {}
+    b_end: dict = {}
+    busy: dict = {p: set() for p in range(workers)}
+    grid: dict = {}
+    for _t, tick in one_f_one_b_schedule(s_count, m_count):
+        for kind, vs, m in tick:
+            if kind == "F":
+                ready = f_end.get((vs - 1, m), 0) if vs else 0
+            else:
+                ready = max(f_end[(vs, m)],
+                            b_end.get((vs + 1, m), 0)
+                            if vs < s_count - 1 else 0)
+            p = vs % workers
+            t = ready
+            while t in busy[p]:
+                t += 1
+            busy[p].add(t)
+            (f_end if kind == "F" else b_end)[(vs, m)] = t + 1
+            grid.setdefault(t, []).append((kind, vs, m))
+    for t in sorted(grid):
+        yield t, sorted(grid[t], key=lambda slot: (-slot[1], slot[0]))
+
+
+def pipeline_timeline(schedule: str, num_stages: int,
+                      num_microbatches: int, interleave: int = 2):
+    """One entry point over the schedule generators: the
+    ``(tick, slots)`` stream for ``schedule`` in
+    gpipe | 1f1b | interleaved. GPipe's forward-only grid is lifted to
+    the slot format with the backward folded into the last-stage
+    forward (that is where the compiled GPipe step runs it)."""
+    if schedule == "gpipe":
+        return ((t, [("F", s, m) for s, m in pairs])
+                for t, pairs in gpipe_schedule(num_stages,
+                                               num_microbatches))
+    if schedule == "1f1b":
+        return one_f_one_b_schedule(num_stages, num_microbatches)
+    if schedule == "interleaved":
+        return interleaved_schedule(num_stages, num_microbatches,
+                                    interleave)
+    raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                     "(expected gpipe|1f1b|interleaved)")
+
+
+def schedule_bubble_fraction(schedule: str, num_stages: int,
+                             num_microbatches: int,
+                             interleave: int = 2) -> float:
+    """Schedule-aware analytic bubble fraction, one convention across
+    the cost model, the gauges and the bench probes.
+
+    The per-microbatch work unit weighs backward at 2× forward
+    (B = 2F, the standard roofline for matmul-dominated stages), so a
+    full microbatch costs 3 units:
+
+    - ``gpipe``:        (S-1)/(S+M-1) — the classic fill-drain form,
+      unchanged from :func:`gpipe_bubble_fraction` (forward grid; the
+      monolithic backward rides the last-stage slot)
+    - ``1f1b``:         (S-1)/(3M + S-1) — the warmup/drain bubble is
+      amortised over the full forward+backward steady state
+    - ``interleaved``:  (S-1)/(v·3M + S-1) — v virtual chunks per
+      worker shrink the warmup bubble by v
+    """
+    s_count, m_count = int(num_stages), int(num_microbatches)
+    if schedule == "gpipe":
+        return gpipe_bubble_fraction(s_count, m_count)
+    if schedule == "1f1b":
+        return (s_count - 1) / max(3 * m_count + s_count - 1, 1)
+    if schedule == "interleaved":
+        v = int(interleave)
+        return (s_count - 1) / max(3 * v * m_count + s_count - 1, 1)
+    raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                     "(expected gpipe|1f1b|interleaved)")
 
 
 # ---------------------------------------------------------------------------

@@ -213,6 +213,24 @@ COMM_COUNTER_NAMES = (
     "comm_buckets", "allreduce_overlap_frac",
 )
 
+# pipeline-schedule + ZeRO plan gauges (static/stepplan.py notifies at
+# step-plan build; the executor replays them on warm cache hits).
+# Declaration-only for dashboards/catalog: the values ride each
+# executor's OWN counters via its plan-gauge hook — merging the
+# process-global snapshot here would leak one executor's plan gauges
+# into a fresh executor's view
+ZERO_COUNTER_NAMES = (
+    "pp_bubble_frac", "pp_stash_depth", "pp_schedule_fallback",
+    "zero_stage_active", "zero_buckets",
+    "zero_state_bytes_replicated", "zero_state_bytes_sharded",
+    "zero_state_bytes_saved_pct",
+    # cumulative wire counters of ZeRO dispatches (encoded half-ring
+    # reduce-scatter + raw-f32 all-gather) — deliberately separate from
+    # comm_quant_bytes_* so the quantized-ring saved>sent invariant
+    # stays a codec property
+    "zero_wire_bytes_sent", "zero_wire_bytes_saved",
+)
+
 # parameter-server fault-tolerance counters (ps/replication.py replica
 # groups + ps/service.py hardened RPC), merged into Executor.counters
 # and the chaos drill's counter table

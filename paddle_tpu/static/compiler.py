@@ -92,9 +92,28 @@ class BuildStrategy:
       pipeline_stages       S > 1 splits the forward region into S
                             contiguous stages and composes the
                             gradient-merge microbatch loop into a
-                            GPipe-style fill-drain schedule (requires
+                            pipeline schedule (requires
                             gradient_merge_k > 1 — the k microbatches
                             are the pipeline's microbatches)
+      pipeline_schedule     "gpipe" (fill-drain, the default and the
+                            escape leg) | "1f1b" (one-forward-one-
+                            backward: bounded activation stash, the
+                            warmup bubble amortised over the full
+                            forward+backward steady state) |
+                            "interleaved" (1F1B over
+                            pipeline_interleave virtual chunks per
+                            worker). `PADDLE_PP_SCHEDULE` overrides.
+      pipeline_interleave   virtual stages per worker for
+                            pipeline_schedule="interleaved"
+                            (pipeline_stages must divide by it)
+      zero_stage            0 | 2 | 3: ZeRO sharded optimizer states
+                            over the dp axis, riding the quantized
+                            comm layer (requires comm_quant engaged —
+                            the grad reduce decomposes into the same
+                            ring's reduce-scatter + all-gather).
+                            Stage 2 shards optimizer states; stage 3
+                            also shards the params between steps.
+                            `PADDLE_ZERO=0` is the escape leg.
 
     Communication-efficiency knobs (the comm_bucketing concern in
     static/passes.py + parallel/collectives.py; pure data-parallel
@@ -140,6 +159,9 @@ class BuildStrategy:
         self.mesh_shape = {}
         self.sharding_hints = {}
         self.pipeline_stages = 1
+        self.pipeline_schedule = "gpipe"
+        self.pipeline_interleave = 2
+        self.zero_stage = 0
         self.comm_quant = "off"
         self.comm_bucket_bytes = 4 << 20
         self.comm_error_feedback = False

@@ -32,8 +32,15 @@ The executor's real step structure folds in on top of the per-op walk:
 - sharding (``__sharding_spec`` stamps + the build's mesh axis sizes):
   an op's work divides by the product of the distinct mesh axes its
   operands are partitioned over — per-CHIP cost, matching per-chip MFU
-- ``pipeline_stages`` is recorded (GPipe moves work in time, not in
-  amount)
+- ``pipeline_stages`` is recorded (pipelining moves work in time, not
+  in amount); with a schedule the report also carries the analytic
+  bubble fraction (``parallel.pipeline.schedule_bubble_fraction``), so
+  the roofline can discount idle slots per schedule
+- ``zero`` (the engaged ZeRO stage): the gradient traffic decomposes
+  into a ``comm_reduce_scatter`` of the ENCODED bucket (half the ring)
+  plus a ``comm_all_gather`` of the updated params in RAW f32 — the
+  exact wire structure static/stepplan.py's zero kind compiles —
+  instead of the single ``comm_allreduce`` pseudo-op
 
 Everything is static VarDesc arithmetic — no tracing, no device touch —
 so a cost report for a BERT-sized program costs microseconds and can
@@ -129,12 +136,16 @@ class CostReport:
 
     def __init__(self, ops: List[OpCost], gm_k: int = 1,
                  pp_stages: int = 1, n_shards: int = 1,
-                 batch: int = 1):
+                 batch: int = 1, schedule: str = "gpipe",
+                 interleave: int = 2, zero_stage: int = 0):
         self.ops = ops
         self.gm_k = gm_k
         self.pp_stages = pp_stages
         self.n_shards = n_shards
         self.batch = batch
+        self.schedule = schedule or "gpipe"
+        self.interleave = int(interleave or 2)
+        self.zero_stage = int(zero_stage or 0)
         self.model_flops = sum(o.flops for o in ops)
         self.hbm_bytes = sum(o.hbm_bytes for o in ops)
         self.comm_bytes = sum(o.comm_bytes for o in ops)
@@ -143,6 +154,18 @@ class CostReport:
     def arith_intensity(self) -> float:
         return (self.model_flops / self.hbm_bytes
                 if self.hbm_bytes else 0.0)
+
+    @property
+    def pp_bubble_frac(self) -> float:
+        """Analytic idle fraction of the pipelined step under the
+        compiled schedule — 0.0 when not pipelined (S <= 1 or a single
+        microbatch leaves nothing to overlap)."""
+        if self.pp_stages <= 1 or self.gm_k <= 1:
+            return 0.0
+        from ..parallel.pipeline import schedule_bubble_fraction
+
+        return schedule_bubble_fraction(
+            self.schedule, self.pp_stages, self.gm_k, self.interleave)
 
     def by_type(self, field: str = "flops") -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -168,6 +191,9 @@ class CostReport:
             "batch": self.batch,
             "gm_k": self.gm_k,
             "pp_stages": self.pp_stages,
+            "pp_schedule": self.schedule,
+            "pp_bubble_frac": round(self.pp_bubble_frac, 4),
+            "zero_stage": self.zero_stage,
             "n_shards": self.n_shards,
             "flops_by_type": self.by_type("flops"),
             "bytes_by_type": self.by_type("hbm_bytes"),
@@ -195,7 +221,8 @@ def _resolve_batch(block, feed_shapes: Optional[Dict[str, Sequence[int]]],
 
 
 def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
-                 shard_cfg=None, pp=None, comm=None) -> CostReport:
+                 shard_cfg=None, pp=None, comm=None, schedule=None,
+                 interleave=None, zero=None) -> CostReport:
     """Walk ``program``'s optimized global block into a CostReport.
 
     ``feed_shapes``: {data var name -> live array shape} — resolves the
@@ -209,7 +236,19 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
     pseudo-op — never the f32 bytes the escape leg would move, so
     step_comm_bytes and the perf_report roofline stay truthful under
     quantization. (With comm=None the DP grad reduce is XLA's implicit
-    f32 psum, uncharged — the pre-quantization accounting, unchanged.)"""
+    f32 psum, uncharged — the pre-quantization accounting, unchanged.)
+
+    ``schedule``/``interleave`` are the resolve_pipeline_schedule
+    result for a pipelined build (None otherwise): they pick the
+    closed-form ``pp_bubble_frac`` the report exposes. ``zero`` is the
+    ZeRO stage (2|3) WHEN THE STEP-PLAN ENGAGED it (None otherwise —
+    the caller gates on the live zero plan, mirroring how ``comm``
+    gates on comm_stats): the gradient ring then splits into a
+    ``comm_reduce_scatter`` pseudo-op at the encoded half-ring bytes
+    plus a ``comm_all_gather`` at the RAW f32 updated-param bytes (the
+    optimizer consumes the unquantized reduced chunk and re-broadcasts
+    params unencoded — stepplan.py's wire structure, both stages move
+    one param gather per step)."""
     block = program.global_block
     comm_cfg = comm   # the per-op loop below reuses `comm` as a local
     batch = _resolve_batch(block, feed_shapes, batch_size)
@@ -401,7 +440,32 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
         axis = comm_data_axis(shard_cfg)
         plan = (comm_bucket_plan(block, comm_cfg, axis[1])
                 if axis is not None else None)
-        if plan:
+        if plan and zero:
+            # ZeRO decomposition: the grad moves as the encoded
+            # reduce-scatter HALF of the ring; the optimizer updates
+            # its local chunk and the params come back as a raw-f32
+            # all-gather (stage 2 post-update, stage 3 pre-forward —
+            # one per step either way). Both once per step, like the
+            # all-reduce they replace.
+            from ..parallel.collectives import (all_gather_nbytes,
+                                                reduce_scatter_nbytes)
+
+            g = axis[1]
+            out.append(OpCost(
+                index=first_bwd, type="comm_reduce_scatter", out="",
+                flops=0, hbm_bytes=0,
+                comm_bytes=sum(
+                    reduce_scatter_nbytes(b["elems"], g, comm_cfg[0])
+                    for b in plan),
+                mult=1, shard_factor=1))
+            out.append(OpCost(
+                index=first_bwd, type="comm_all_gather", out="",
+                flops=0, hbm_bytes=0,
+                comm_bytes=sum(
+                    all_gather_nbytes(b["elems"], g, "f32")
+                    for b in plan),
+                mult=1, shard_factor=1))
+        elif plan:
             # the bucketed quantized all-reduce runs ONCE per step on
             # the merged gradient (no gm multiplier — the PR 5
             # quantize-once-per-step discipline)
@@ -412,7 +476,10 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
                 mult=1, shard_factor=1))
 
     return CostReport(out, gm_k=gm_k, pp_stages=int(pp or 1),
-                      n_shards=n_shards, batch=batch)
+                      n_shards=n_shards, batch=batch,
+                      schedule=schedule or "gpipe",
+                      interleave=interleave or 2,
+                      zero_stage=int(zero or 0))
 
 
 def paged_decode_cost(config, live_lens: Sequence[int], page_size: int,

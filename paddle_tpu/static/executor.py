@@ -267,7 +267,7 @@ class _ExecEntry:
     don't retry)."""
 
     __slots__ = ("compiled", "optimized_program", "pass_report", "is_gm",
-                 "cost", "comm_stats")
+                 "cost", "comm_stats", "plan_gauges")
 
     def __init__(self, compiled, optimized_program, pass_report,
                  is_gm=False):
@@ -281,6 +281,11 @@ class _ExecEntry:
         # _comm_entry_stats): wire bytes sent/saved per dispatch plus
         # the comm_buckets / allreduce_overlap_frac gauges
         self.comm_stats = None
+        # plan-layer gauges (pp_stages, pp_bubble_frac, zero_*) recorded
+        # at build time and REPLAYED on every cache hit — a warm
+        # executor reports the executable's schedule, not the last
+        # built one's
+        self.plan_gauges = {}
 
 
 # process-global content-addressed executable cache: every Executor in
@@ -307,9 +312,11 @@ def _exec_cache_put(key: str, entry: _ExecEntry) -> None:
 
 def _content_key(opt_program, feed_sig, fetch_names, persist_names,
                  state_sig, sharding, donate, gm=None, pp=None,
-                 comm=None) -> str:
-    # gm (gradient merge) and pp (pipeline stage count) change the
-    # compiled step's STRUCTURE (scan / GPipe schedule over
+                 comm=None, schedule=None, zero=None,
+                 interleave=None) -> str:
+    # gm (gradient merge), pp (pipeline stage count), the pipeline
+    # schedule and the zero stage change the compiled step's STRUCTURE
+    # (scan / pipeline slot order / sharded-optimizer regions over
     # microbatches) without touching the program content, so they must
     # join the hash; remat and sharding change the content itself
     # (__remat_seg / __sharding_spec / __pp_stage stamps) and the
@@ -322,7 +329,7 @@ def _content_key(opt_program, feed_sig, fetch_names, persist_names,
         [opt_program.to_dict(), list(feed_sig), list(fetch_names),
          list(persist_names), list(state_sig), shard_desc, bool(donate),
          list(gm) if gm else None, pp,
-         list(comm) if comm else None],
+         list(comm) if comm else None, schedule, zero, interleave],
         sort_keys=True, default=str).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()
 
@@ -336,24 +343,11 @@ def _nbytes(arr) -> int:
         return 0
 
 
-def _comm_entry_stats(comm_plan) -> Dict[str, Any]:
-    """Per-dispatch quantized-collective accounting for one compiled
-    executable: encoded ring bytes actually moved per device per step
-    (``bytes_sent``), the f32 bytes the codec saved (``bytes_saved``),
-    the bucket count, and the analytic overlap fraction — with nb
-    buckets emitted in completion order, nb-1 of them have a later
-    bucket's work in flight behind them (the last one drains alone),
-    the same analytic convention as pp_bubble_frac."""
-    _axis, _g, plan = comm_plan
-    sent = sum(b["ring_encoded"] for b in plan)
-    f32 = sum(b["ring_f32"] for b in plan)
-    nb = len(plan)
-    return {
-        "bytes_sent": int(sent),
-        "bytes_saved": int(max(0, f32 - sent)),
-        "comm_buckets": nb,
-        "allreduce_overlap_frac": round((nb - 1) / nb, 4) if nb else 0.0,
-    }
+# per-dispatch quantized-collective accounting — lives with the plan
+# layer now (stepplan.comm_entry_stats); re-exported for callers that
+# imported it from here
+from .stepplan import comm_entry_stats as _comm_entry_stats  # noqa: E402
+from .stepplan import zero_entry_stats as _zero_entry_stats  # noqa: E402
 
 
 class Executor:
@@ -690,17 +684,24 @@ class Executor:
         # prefetch threads stage batches already low.
         from .passes import (amp_feed_dtypes_cached, resolve_amp,
                              resolve_comm, resolve_gradient_merge,
-                             resolve_pipeline, resolve_sharding)
+                             resolve_pipeline, resolve_pipeline_schedule,
+                             resolve_sharding, resolve_zero)
 
         amp = resolve_amp(strategy)
         gm = resolve_gradient_merge(strategy)
         shard_cfg = resolve_sharding(strategy)
         pp = resolve_pipeline(strategy)
         comm = resolve_comm(strategy)
+        zero = resolve_zero(strategy)
         if gm is None:
             # mirrors apply_passes: pipeline_stages without
             # gradient_merge_k > 1 has no microbatches to schedule
             pp = None
+        schedule = interleave = None
+        if pp is not None:
+            # the schedule only shapes a pipelined step; resolving it
+            # to None otherwise keeps non-pp step keys unchanged
+            schedule, interleave = resolve_pipeline_schedule(strategy)
         fdt = amp_feed_dtypes_cached(program, amp)
         program._amp_feed_dtypes = fdt
 
@@ -773,6 +774,34 @@ class Executor:
                 persist_names += self._ensure_ef_state(
                     scope, comm_plan, shard_cfg, sharding)
                 program._feed_sharding = sharding
+        # ZeRO sharded optimizer states (BuildStrategy.zero_stage /
+        # PADDLE_ZERO): rides the SAME engaged comm plan — the grad
+        # all-reduce decomposes into reduce-scatter + all-gather and the
+        # optimizer runs on local (g, c) state rows, which join the
+        # donated state exactly like the error-feedback residuals
+        zero_plan = None
+        if zero is not None:
+            zero_plan = self._zero_eligibility(
+                program, block, zero, comm, comm_plan, shard_cfg, gm,
+                pp, fetch_names)
+            if zero_plan is not None:
+                sharding = dict(sharding) if sharding else {}
+                added, dropped = self._ensure_zero_state(
+                    scope, zero_plan, shard_cfg, sharding)
+                persist_names = [n for n in persist_names
+                                 if n not in dropped] + added
+                program._feed_sharding = sharding
+        if zero_plan is None and peek("__zero_layout__") is not None:
+            # ZeRO turned off (or went ineligible) between steps while
+            # the scope still holds sharded rows: flip the per-var
+            # state back before the replicated step gathers it
+            from .stepplan import zero_flip_back
+
+            restored = zero_flip_back(scope)
+            have = set(persist_names)
+            persist_names = list(persist_names) + sorted(
+                n for n in set(restored) - have
+                if n in block.vars and block.vars[n].persistable)
         feed_keys = sorted(feed.keys())
         feed_vals = [feed[k] for k in feed_keys]
         state = self._gather_state(scope, persist_names, feed_vals,
@@ -785,7 +814,9 @@ class Executor:
         step_key = (program._version, feed_sig, tuple(fetch_names),
                     tuple(persist_names), state_sig, bool(sharding),
                     _strategy_signature(strategy), amp, gm, shard_cfg,
-                    pp, comm, comm_plan is not None)
+                    pp, comm, comm_plan is not None, schedule,
+                    interleave if schedule == "interleaved" else None,
+                    zero, zero_plan is not None)
         per_prog = self._cache.setdefault(program, {})
         entry = None
         if use_program_cache:
@@ -807,7 +838,10 @@ class Executor:
             self._record_pass_report(report)
             ck = _content_key(opt_program, feed_sig, fetch_names,
                               persist_names, state_sig, sharding,
-                              self._donate, gm, pp, comm)
+                              self._donate, gm, pp, comm,
+                              schedule=schedule, zero=zero,
+                              interleave=interleave
+                              if schedule == "interleaved" else None)
             per_prog[step_key] = ck
             entry = _exec_cache_get(ck) if use_program_cache else None
             if entry is not None:
@@ -820,13 +854,20 @@ class Executor:
                 compiled_fn = self._build(
                     opt_program.global_block, feed_keys, fetch_names,
                     persist_names, sharding, feed_vals, state, rng, gm,
-                    pp, comm=comm, comm_plan=comm_plan)
+                    pp, comm=comm, comm_plan=comm_plan,
+                    schedule=schedule, zero=zero, zero_plan=zero_plan,
+                    interleave=interleave)
                 entry = _ExecEntry(compiled_fn, opt_program, report,
                                    is_gm)
+                entry.plan_gauges = dict(
+                    getattr(self, "_last_plan_gauges", {}) or {})
                 if comm_plan is not None and any(
                         op.type == "backward"
                         for op in opt_program.global_block.ops):
-                    entry.comm_stats = _comm_entry_stats(comm_plan)
+                    entry.comm_stats = (
+                        _zero_entry_stats(comm_plan)
+                        if zero_plan is not None
+                        else _comm_entry_stats(comm_plan))
                 if use_program_cache:
                     _exec_cache_put(ck, entry)
                 self._bump("compile_cache_misses")
@@ -834,6 +875,8 @@ class Executor:
         if entry is not getattr(self, "_last_entry", None):
             self._last_entry = entry
             self._update_memory_gauges(entry)
+            for name, v in entry.plan_gauges.items():
+                self._set_plan_gauge(name, v)
         if entry.cost is None:
             # one analytic walk per executable (VarDesc arithmetic, no
             # tracing); False = model couldn't cost this program, never
@@ -848,7 +891,9 @@ class Executor:
                     gm=gm if entry.is_gm else None,
                     shard_cfg=shard_cfg, pp=pp,
                     comm=comm if getattr(entry, "comm_stats", None)
-                    else None)
+                    else None,
+                    schedule=schedule, interleave=interleave,
+                    zero=zero if zero_plan is not None else None)
             except Exception:
                 entry.cost = False
         if entry.cost:
@@ -862,15 +907,22 @@ class Executor:
             self._bump("gm_dispatches")
             self._bump("gm_microbatches", gm[0])
         if getattr(entry, "comm_stats", None):
-            # quantized-collective accounting, per dispatch: the wire
-            # bytes this step's bucketed all-reduce moved (and saved vs
-            # f32) are cumulative counters; the bucket count and the
-            # analytic overlap fraction are point-in-time gauges
+            # collective wire accounting, per dispatch: cumulative byte
+            # counters plus point-in-time bucket/overlap gauges. ZeRO
+            # dispatches ride their own counter pair — their wire is an
+            # encoded half-ring reduce-scatter + raw-f32 all-gather, a
+            # different profile than the quantized all-reduce ring the
+            # comm_quant_* counters (and their saved>sent codec
+            # invariant) account for
             from .. import profiler
 
             cs = entry.comm_stats
-            self._bump("comm_quant_bytes_sent", cs["bytes_sent"])
-            self._bump("comm_quant_bytes_saved", cs["bytes_saved"])
+            if cs.get("zero"):
+                self._bump("zero_wire_bytes_sent", cs["bytes_sent"])
+                self._bump("zero_wire_bytes_saved", cs["bytes_saved"])
+            else:
+                self._bump("comm_quant_bytes_sent", cs["bytes_sent"])
+                self._bump("comm_quant_bytes_saved", cs["bytes_saved"])
             for name in ("comm_buckets", "allreduce_overlap_frac"):
                 self._counters[name] = cs[name]
                 profiler.set_counter(name, cs[name])
@@ -980,7 +1032,8 @@ class Executor:
 
     def _build(self, block, feed_keys, fetch_names, persist_names,
                sharding, feed_vals, state, rng, gm=None, pp=None,
-               comm=None, comm_plan=None):
+               comm=None, comm_plan=None, schedule=None, zero=None,
+               zero_plan=None, interleave=None):
         """AOT-compile one step: jit -> lower() (trace_ms) -> compile()
         (compile_ms). The split makes trace vs XLA-compile time
         measurable, and compile() goes through jax's persistent
@@ -988,714 +1041,87 @@ class Executor:
         relaunched trainer's cold build becomes a disk read
         (disk_cache_hits in exe.counters).
 
-        With ``gm`` (resolve_gradient_merge result) and a backward op in
-        the block, the step is compiled as a lax.scan over k microbatches
-        instead (_gm_step_fn); with ``pp`` (resolve_pipeline stage count)
-        on top, the microbatch loop runs on the GPipe fill-drain schedule
-        over the ``__pp_stage``-stamped forward stages (_pp_step_fn).
+        The step's SHAPE — plain forward, gm scan, pipeline schedule
+        (gpipe/1f1b/interleaved), explicit quantized comm, or ZeRO
+        sharded-optimizer — is the step-plan layer's job
+        (static/stepplan.py): ``build_plan`` selects the registered
+        plan kind and ``build_step_fn`` produces the traced callable.
+        This method only wires the plan's boundary shardings + donation
+        into substrate.aot_compile — the ONE compiled-step build path
+        this executor shares with the decode engine (inference/decode)
+        and, through Executor.run, the serving predictor."""
+        from . import stepplan
 
-        The jit/lower/compile mechanics live in substrate.aot_compile —
-        the ONE compiled-step build path this executor shares with the
-        decode engine (inference/decode) and, through Executor.run, the
-        serving predictor."""
+        plan = stepplan.build_plan(
+            block, gm=gm, pp=pp, comm=comm, comm_plan=comm_plan,
+            schedule=schedule, zero=zero, zero_plan=zero_plan,
+            sharding=sharding, donate=self._donate)
+        if interleave is not None:
+            plan.meta["interleave"] = interleave
+        gauges = self._last_plan_gauges = {}
 
-        gm_bwd = None
-        if gm is not None:
-            gm_bwd = next((i for i, op in enumerate(block.ops)
-                           if op.type == "backward"), None)
-        comm_bwd = None
-        if comm_plan is not None:
-            comm_bwd = next((i for i, op in enumerate(block.ops)
-                             if op.type == "backward"), None)
-        if comm_bwd is not None:
-            # explicit quantized-collective DP step (shard_map over the
-            # pure-dp mesh; composes the gm microbatch scan internally)
-            step = self._comm_step_fn(block, feed_keys, fetch_names,
-                                      persist_names, feed_vals, gm,
-                                      comm_bwd, comm, comm_plan,
-                                      sharding)
-        elif gm_bwd is not None and pp is not None and pp > 1 and any(
-                "__pp_stage" in op.attrs for op in block.ops):
-            step = self._pp_step_fn(block, feed_keys, fetch_names,
-                                    persist_names, feed_vals, gm, gm_bwd)
-        elif gm_bwd is not None:
-            step = self._gm_step_fn(block, feed_keys, fetch_names,
-                                    persist_names, feed_vals, gm, gm_bwd)
-        else:
-            def step(feed_vals, state, rng):
-                env = dict(zip(feed_keys, feed_vals))
-                env.update(zip(persist_names, state))
-                ctx = ExecContext(rng_key=rng)
-                env = run_block(block, env, ctx)
-                fetches = [env[n] for n in fetch_names]
-                new_state = [env.get(n, s)
-                             for n, s in zip(persist_names, state)]
-                return fetches, new_state
+        def notify(name, value):
+            gauges[name] = value   # replayed on cache hits (_ExecEntry)
+            self._set_plan_gauge(name, value)
 
-        in_shardings = out_shardings = None
-        if sharding is not None:
-            param_shard = sharding.get("__param__")
-            # per-name entries (the shard_propagation boundary map:
-            # hinted tp/dp params) beat the blanket __param__ fallback;
-            # the classic data-parallel map has no per-name entries so
-            # this degenerates to the old [param_shard] * N
-            state_shards = [sharding.get(n, param_shard)
-                            for n in persist_names]
-            in_shardings = (
-                [sharding.get(k) for k in feed_keys],
-                state_shards,
-                sharding.get("__rng__"))
-            # pin state OUTPUTS to the same layout: chained steps feed
-            # new_state straight back in without re-partitioning
-            out_shardings = (
-                [None] * len(fetch_names),
-                state_shards)
+        step = stepplan.build_step_fn(
+            plan, block, feed_keys, fetch_names, persist_names,
+            feed_vals, notify=notify)
+        in_shardings, out_shardings = plan.boundary_shardings(
+            feed_keys, persist_names, fetch_names)
         from .substrate import aot_compile
 
         cs = aot_compile(
             step, (feed_vals, state, rng),
-            # state + rng buffers are reused in place by XLA; feeds are
-            # fresh per step and stay un-donated
-            donate_argnums=(1, 2) if self._donate else None,
+            donate_argnums=plan.donate_argnums,
             in_shardings=in_shardings, out_shardings=out_shardings,
             bump=self._bump)
         return cs.compiled
 
-    @staticmethod
-    def _merge_region(block, feed_keys, feed_vals, persist_names,
-                      fetch_names, k, bwd_idx):
-        """Split one training block at the backward boundary for a
-        k-microbatch merged step — shared by the gm scan and the GPipe
-        schedule (their parity depends on agreeing on this split).
-        Returns ``(scan_end, grad_names, found_name, state_carry,
-        carry_out, post_outs)``: ops [0, scan_end) run per microbatch
-        (forward + backward + an adjacent fp16 check_finite_and_unscale),
-        ops [scan_end, ...) are the optimizer region run once on the
-        merged gradient; state_carry is the per-microbatch persistable
-        writes, carry_out everything else the post region or a fetch
-        reads."""
-        for key, v in zip(feed_keys, feed_vals):
-            shp = tuple(getattr(v, "shape", ()))
-            if not shp or shp[0] % k:
-                raise ValueError(
-                    f"gradient_merge_k={k}: feed {key!r} batch dim "
-                    f"{shp[0] if shp else None} is not divisible by k")
-        ops = block.ops
-        scan_end = bwd_idx + 1
-        if scan_end < len(ops) and \
-                ops[scan_end].type == "check_finite_and_unscale":
-            scan_end += 1
-        grad_names = list(ops[bwd_idx].outputs.get("Grads", []))
-        found_name = None
-        if ops[scan_end - 1].type == "check_finite_and_unscale":
-            fo = ops[scan_end - 1].outputs.get("FoundInfinite")
-            found_name = fo[0] if fo else None
-        produced: set = set()
-        for op in ops[:scan_end]:
-            produced.update(op.output_names())
-        post_reads: set = set()
-        post_outs: set = set()
-        for op in ops[scan_end:]:
-            post_reads.update(op.input_names())
-            post_outs.update(op.output_names())
-        special = set(grad_names) | {found_name} - {None}
-        persist_set = set(persist_names)
-        # state written per microbatch rides the carry; everything else
-        # the post region or a fetch reads rides the stacked ys
-        state_carry = sorted(produced & persist_set)
-        carry_out = sorted(((post_reads | set(fetch_names)) & produced)
-                           - special - persist_set)
-        return (scan_end, grad_names, found_name, state_carry,
-                carry_out, post_outs)
+    def _set_plan_gauge(self, name, value):
+        """Plan-layer gauge sink (pp_stages, pp_bubble_frac,
+        pp_stash_depth, zero_*): point-in-time values set at step-plan
+        build time — assigned, not accumulated."""
+        from .. import profiler
 
-    def _gm_step_fn(self, block, feed_keys, fetch_names, persist_names,
-                    feed_vals, gm, bwd_idx):
-        """In-step gradient merge: compile the train step as ONE
-        lax.scan over k microbatches (GPipe-style accumulation, inside a
-        single dispatch).
-
-        The op list splits at the backward boundary: ops [0, scan_end)
-        (forward + backward + an adjacent fp16 check_finite_and_unscale)
-        run PER MICROBATCH inside the scan; ops [scan_end, ...) — the
-        optimizer update region — run ONCE on the merged gradient.
-        Mechanics:
-
-        - every feed is reshaped (B, ...) -> (k, B//k, ...) inside the
-          trace (host layout untouched; B must divide by k)
-        - gradients accumulate in f32 whatever the compute dtype (AMP
-          bf16/fp16 microbatch grads are upcast before the add), and
-          with avg=True the MERGED sum is divided by k once — never a
-          per-microbatch lr rescale
-        - the fp16 FoundInfinite flag is OR-reduced over microbatches:
-          one bad microbatch skips the whole merged update
-        - persistable state written inside the scanned region
-          (batch_norm running stats, step counters) threads through the
-          scan carry, so microbatch i sees microbatch i-1's updates
-        - each microbatch folds its index into the step RNG key —
-          dropout draws fresh masks per microbatch
-        - float fetches produced inside the scanned region (the loss)
-          are averaged over microbatches; non-float fetches report the
-          last microbatch
-        """
-        import numpy as _np
-
-        k, avg = gm
-        (scan_end, grad_names, found_name, state_carry, carry_out,
-         post_outs) = self._merge_region(block, feed_keys, feed_vals,
-                                         persist_names, fetch_names, k,
-                                         bwd_idx)
-
-        def _micro(mb_feed, state_env, carried, key):
-            env = dict(zip(feed_keys, mb_feed))
-            env.update(state_env)
-            env.update(carried)
-            ctx = ExecContext(rng_key=key)
-            return run_block(block, env, ctx, stop_at=scan_end)
-
-        # grad avals (shape/dtype of ONE microbatch's grads): read from
-        # the grad VarDescs when fully static — append_backward declares
-        # them with the param's shape/dtype — falling back to an
-        # abstract eval_shape trace only for dynamic shapes
-        # (calc_gradient w.r.t. a batch-dim intermediate). The probe
-        # re-interprets the whole scanned region, so skipping it halves
-        # merged-build trace time in the common (param-grad) case.
-        grad_avals = []
-        for g in grad_names:
-            desc = block.vars.get(g)
-            shape = getattr(desc, "shape", None)
-            if not shape or any(int(d) < 0 for d in shape):
-                grad_avals = None
-                break
-            grad_avals.append(jax.ShapeDtypeStruct(
-                tuple(int(d) for d in shape),
-                jnp.dtype(dtype_mod.convert_dtype(desc.dtype))))
-
-        mb_avals = [jax.ShapeDtypeStruct(
-            (int(v.shape[0]) // k,) + tuple(int(d) for d in v.shape[1:]),
-            getattr(v, "dtype", _np.asarray(v).dtype))
-            for v in feed_vals]
-
-        def _probe(mb_feed, state, rng):
-            env = _micro(mb_feed, dict(zip(persist_names, state)), {},
-                         rng)
-            return [env[g] for g in grad_names]
-
-        def step(feed_vals, state, rng):
-            state_env0 = dict(zip(persist_names, state))
-            avals = grad_avals if grad_avals is not None else \
-                jax.eval_shape(_probe, mb_avals, state, rng)
-            mbs = [v.reshape((k, v.shape[0] // k) + tuple(v.shape[1:]))
-                   for v in feed_vals]
-
-            def body(carry, xs):
-                accum, carried, found = carry
-                mb, mi = xs
-                env = _micro(mb, state_env0, carried,
-                             jax.random.fold_in(rng, mi))
-                accum = [a + env[g].astype(jnp.float32)
-                         for a, g in zip(accum, grad_names)]
-                carried = {n: env[n] for n in state_carry}
-                if found_name is not None:
-                    found = found | jnp.reshape(
-                        env[found_name], ()).astype(bool)
-                ys = {n: env[n] for n in carry_out}
-                return (accum, carried, found), ys
-
-            init = ([jnp.zeros(a.shape, jnp.float32) for a in avals],
-                    {n: state_env0[n] for n in state_carry},
-                    jnp.zeros((), jnp.bool_))
-            (accum, carried, found), ys = jax.lax.scan(
-                body, init, (mbs, jnp.arange(k)))
-            env = dict(zip(feed_keys, feed_vals))  # full batch for post
-            env.update(state_env0)
-            env.update(carried)
-            env.update({n: ys[n][-1] for n in carry_out})
-            for g, a, aval in zip(grad_names, accum, avals):
-                merged = a / k if avg else a
-                env[g] = merged.astype(aval.dtype)
-            if found_name is not None:
-                env[found_name] = jnp.reshape(found, (1,))
-            ctx = ExecContext(rng_key=rng)
-            env = run_block(block, env, ctx, start=scan_end)
-            fetches = []
-            for n in fetch_names:
-                if n in ys and n not in post_outs:
-                    stacked = ys[n]
-                    if jnp.issubdtype(stacked.dtype, jnp.inexact):
-                        fetches.append(jnp.mean(
-                            stacked.astype(jnp.float32), axis=0
-                        ).astype(stacked.dtype))
-                    else:
-                        fetches.append(stacked[-1])
-                else:
-                    fetches.append(env[n])
-            new_state = [env.get(n, s)
-                         for n, s in zip(persist_names, state)]
-            return fetches, new_state
-
-        return step
+        self._counters[name] = value
+        profiler.set_counter(name, value)
 
     # -- quantized DP collectives (ISSUE 15: EQuARX-style comm layer) ------
     def _comm_eligibility(self, program, block, comm, shard_cfg, gm,
                           feed, sharding, pp=None):
-        """Gate + plan for the explicit quantized-collective DP step.
+        """Gate + plan for the explicit quantized-collective DP step —
+        the logic lives in stepplan.comm_eligibility; this wrapper only
+        keeps the per-executor memo (the warm step pays one key
+        comparison, and counters bump once per verdict, not per step)."""
+        from .stepplan import comm_eligibility
 
-        Returns ``(axis_name, group, plan)`` when the build is eligible,
-        else None after bumping the ``quant_allreduce.xla`` dispatch
-        counter with the reason (the established kernel pattern — the
-        XLA f32 GSPMD path is the fallback, bitwise-identical to the
-        pre-quantization baseline). Memoized per (program, config, feed
-        shapes): the warm step pays one key comparison.
-
-        Eligible means: a PURE data-parallel mesh (exactly one
-        'dp'/'data' axis, no sharding hints — tensor/pipeline layouts
-        keep XLA's partitioner-owned collectives), one static
-        ``backward`` gradient plan, no persistable writes inside the
-        scanned region (per-device batch-norm style stats would diverge
-        silently under a replicated-out shard_map), every dynamic-batch
-        feed actually sharded over the axis, and local batches
-        divisible by gradient_merge_k."""
-        from ..ops.pallas.counters import bump
-        from .passes import comm_bucket_plan, comm_data_axis
-
-        key = (program._version, comm, shard_cfg, gm, pp,
-               tuple(sorted((k, tuple(getattr(v, "shape", ())))
-                            for k, v in feed.items())))
-        cached = getattr(self, "_comm_elig_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
-
-        def verdict(result, reason=None):
-            if result is None:
-                bump("quant_allreduce", "xla", reason)
-            else:
-                bump("quant_allreduce", "quant")
-            self._comm_elig_cache = (key, result)
-            return result
-
-        if shard_cfg is None:
-            return verdict(None, "comm_quant set but no mesh_shape — "
-                                 "quantized collectives need a dp mesh")
-        if pp is not None:
-            return verdict(None, "pipeline_stages > 1 — the GPipe "
-                                 "schedule keeps XLA collectives")
-        axis = comm_data_axis(shard_cfg)
-        if axis is None:
-            return verdict(None, "mesh is not pure data-parallel "
-                                 f"(axes {shard_cfg[0]})")
-        if shard_cfg[1]:
-            return verdict(None, "sharding_hints present — tensor-"
-                                 "parallel layouts keep XLA collectives")
-        name, g = axis
-        plan = comm_bucket_plan(block, comm, g)
-        if plan is None:
-            return verdict(None, "no static gradient plan (no backward "
-                                 "op, or dynamic grad shapes)")
-        ops = block.ops
-        bwd_idx = next(i for i, op in enumerate(ops)
-                       if op.type == "backward")
-        persist = {n for n, v in block.vars.items() if v.persistable}
-        written = {n for op in ops[:bwd_idx] for n in op.output_names()
-                   if n in persist}
-        if written:
-            return verdict(None, f"persistable writes in the forward "
-                                 f"region ({sorted(written)[:3]}) would "
-                                 "diverge per-device")
-        for k_, v in feed.items():
-            dv = block.vars.get(k_)
-            shape = getattr(dv, "shape", None)
-            if not shape or shape[0] is None or int(shape[0]) >= 0:
-                continue
-            sh = sharding.get(k_) if sharding else None
-            spec = getattr(sh, "spec", None)
-            if not spec or not spec[0]:
-                return verdict(None, f"feed {k_!r} batch dim not "
-                                     f"sharded over {name!r} (size not "
-                                     f"divisible by {g}?)")
-            local_b = int(getattr(v, "shape", (0,))[0]) // g
-            if gm is not None and local_b % gm[0]:
-                return verdict(None, f"local batch {local_b} not "
-                                     f"divisible by gradient_merge_k="
-                                     f"{gm[0]}")
-        return verdict((name, g, plan))
+        self._comm_elig_cache = comm_eligibility(
+            program, block, comm, shard_cfg, gm, feed, sharding, pp=pp,
+            memo=getattr(self, "_comm_elig_cache", None))
+        return self._comm_elig_cache[1]
 
     def _ensure_ef_state(self, scope, comm_plan, shard_cfg, sharding):
-        """Materialize the error-feedback residual buffers as DONATED
-        executor state: one ``(g, padded)`` f32 array per bucket,
-        sharded over the data axis so each device owns its row. Returns
-        the names (appended to persist_names; XLA updates them in place
-        step over step through the normal donation path)."""
-        from jax.sharding import NamedSharding, PartitionSpec
+        from .stepplan import ensure_ef_state
 
-        from ..parallel.collectives import padded_len
-        from ..parallel.mesh import mesh_for_shape
+        return ensure_ef_state(scope, comm_plan, shard_cfg, sharding)
 
-        axis, g, plan = comm_plan
-        mesh = mesh_for_shape(dict(shard_cfg[0]))
-        shard = NamedSharding(mesh, PartitionSpec(axis, None))
-        peek = getattr(scope, "_peek", scope.find_var)
-        write_back = getattr(scope, "_write_back", scope.set)
-        names = []
-        for i, b in enumerate(plan):
-            n = f"__comm_ef_{i}"
-            padded = padded_len(b["elems"], g)
-            arr = peek(n)
-            if not isinstance(arr, jax.Array) or \
-                    tuple(arr.shape) != (g, padded):
-                arr = jax.device_put(np.zeros((g, padded), np.float32),
-                                     shard)
-                write_back(n, arr)
-            sharding[n] = shard
-            names.append(n)
-        return names
+    def _zero_eligibility(self, program, block, zero, comm, comm_plan,
+                          shard_cfg, gm, pp, fetch_names):
+        """Gate + layout plan for ZeRO sharded optimizer states — the
+        logic lives in stepplan.zero_eligibility; the wrapper keeps the
+        per-executor memo so counters bump once per verdict."""
+        from .stepplan import zero_eligibility
 
-    def _comm_step_fn(self, block, feed_keys, fetch_names, persist_names,
-                      feed_vals, gm, bwd_idx, comm, comm_plan, sharding):
-        """Compile the DP train step with an EXPLICIT bucketed,
-        quantized gradient all-reduce instead of XLA's implicit f32
-        psum: the whole step runs inside shard_map over the pure-dp
-        mesh — each device traces the forward+backward on its LOCAL
-        batch shard, the per-bucket gradients reduce through
-        parallel.collectives' quantized ring (encode per hop, f32
-        accumulation, deterministic decode → bitwise-replicated reduced
-        values), and the optimizer region then runs replicated on
-        every device (same grads + same params ⇒ same updates, so
-        state out-specs are replicated by construction).
+        self._zero_elig_cache = zero_eligibility(
+            program, block, zero, comm, comm_plan, shard_cfg, gm, pp,
+            fetch_names, memo=getattr(self, "_zero_elig_cache", None))
+        return self._zero_elig_cache[1]
 
-        Overlap: every bucket's reduce-scatter is ISSUED (in backward-
-        completion order, the comm_bucketing plan) before any bucket's
-        all-gather completes — XLA's latency-hiding scheduler is free
-        to run them concurrently instead of one barrier-shaped reduce.
+    def _ensure_zero_state(self, scope, zero_plan, shard_cfg, sharding):
+        from .stepplan import ensure_zero_state
 
-        Composition: with ``gradient_merge_k`` the local microbatch
-        scan accumulates f32 grads exactly like ``_gm_step_fn`` and the
-        MERGED gradient is reduced once per step (quantize once per
-        step, the PR 5 accumulator discipline). ``avg=True`` on the
-        collective turns sum-of-local-mean-grads into the global-mean
-        gradient, matching the GSPMD leg's mean-loss semantics.
-
-        Fetch assembly: dynamic-batch fetches gather over the axis
-        (out-spec carries the batch dim), other float fetches are
-        pmean'd (exact for replicated values, the global mean for
-        per-shard losses), the rest report the local value.
-
-        Error feedback (``comm_error_feedback``): each device adds its
-        residual to its contribution, quantizes ONCE locally, carries
-        the new residual out through the donated ``__comm_ef_<i>``
-        state row, and feeds the dequantized contribution into the
-        ring."""
-        from jax.sharding import PartitionSpec as P
-
-        from ..parallel.collectives import (
-            allreduce_done, allreduce_start, padded_len, quant_decode,
-            quant_encode, shard_map_nocheck)
-        from ..parallel.mesh import mesh_for_shape
-
-        axis, g, plan = comm_plan
-        codec, _bucket_bytes, ef = comm
-        k, avg_gm = gm if gm is not None else (1, True)
-        (scan_end, grad_names, found_name, state_carry, carry_out,
-         post_outs) = self._merge_region(block, feed_keys, feed_vals,
-                                         persist_names, fetch_names, 1,
-                                         bwd_idx)
-        mesh = mesh_for_shape({axis: g})
-        ef_names = [f"__comm_ef_{i}" for i in range(len(plan))] \
-            if ef else []
-        ef_set = set(ef_names)
-        reg_names = [n for n in persist_names if n not in ef_set]
-
-        grad_elems = {}
-        grad_shapes = {}
-        for gn in grad_names:
-            desc = block.vars.get(gn)
-            shape = tuple(int(d) for d in (desc.shape or ()))
-            grad_shapes[gn] = shape
-            e = 1
-            for d in shape:
-                e *= d
-            grad_elems[gn] = e
-
-        def spec_of(n):
-            sh = sharding.get(n) if sharding else None
-            spec = getattr(sh, "spec", None)
-            return P(*spec) if spec is not None else P()
-
-        # fetch modes: dynamic-batch fetches re-assemble over the axis;
-        # float fetches pmean (global mean for shard-varying losses, a
-        # no-op for replicated values); the rest report local
-        fetch_modes = []
-        for n in fetch_names:
-            v = block.vars.get(n)
-            shape = getattr(v, "shape", None)
-            dt = str(getattr(v, "dtype", "float32"))
-            if shape and (shape[0] is None or int(shape[0]) < 0):
-                fetch_modes.append("gather")
-            elif dt.startswith("float") or dt == "bfloat16":
-                fetch_modes.append("pmean")
-            else:
-                fetch_modes.append("local")
-
-        in_specs = ([spec_of(kk) for kk in feed_keys],
-                    [P(axis, None) if n in ef_set else P()
-                     for n in persist_names],
-                    P())
-        out_specs = ([P(axis) if m == "gather" else P()
-                      for m in fetch_modes],
-                     [P(axis, None) if n in ef_set else P()
-                      for n in persist_names])
-
-        def reduce_buckets(env, ef_rows):
-            """Bucketed quantized all-reduce of env's grads, overlap-
-            emitted; returns (env with reduced grads, new ef rows)."""
-            xs, new_ef = [], []
-            for i, b in enumerate(plan):
-                flats = [env[gn].astype(jnp.float32).reshape(-1)
-                         for gn in b["grads"]]
-                flat = flats[0] if len(flats) == 1 else \
-                    jnp.concatenate(flats)
-                padded = padded_len(b["elems"], g)
-                if padded != flat.shape[0]:
-                    flat = jnp.concatenate(
-                        [flat, jnp.zeros((padded - flat.shape[0],),
-                                         jnp.float32)])
-                if ef:
-                    flat = flat + ef_rows[i]
-                    q, sc = quant_encode(flat, codec)
-                    dec = quant_decode(q, sc, codec)
-                    new_ef.append(flat - dec)
-                    flat = dec
-                xs.append(flat)
-            starts = [allreduce_start(x, axis, codec=codec, axis_size=g)
-                      for x in xs]
-            reduced = [allreduce_done(c, avg=True) for c in starts]
-            for b, r in zip(plan, reduced):
-                off = 0
-                for gn in b["grads"]:
-                    e = grad_elems[gn]
-                    env[gn] = r[off:off + e].reshape(
-                        grad_shapes[gn]).astype(env[gn].dtype)
-                    off += e
-            return env, new_ef
-
-        def local_step(feed_local, state, rng):
-            state_env = dict(zip(persist_names, state))
-            ef_rows = [state_env[n][0] for n in ef_names]
-            state_env0 = {n: state_env[n] for n in reg_names}
-            found = jnp.zeros((), jnp.bool_)
-            if k > 1:
-                mbs = [v.reshape((k, v.shape[0] // k)
-                                 + tuple(v.shape[1:]))
-                       for v in feed_local]
-
-                def body(carry, xs):
-                    accum, found = carry
-                    mb, mi = xs
-                    env = dict(zip(feed_keys, mb))
-                    env.update(state_env0)
-                    ctx = ExecContext(
-                        rng_key=jax.random.fold_in(rng, mi))
-                    env = run_block(block, env, ctx, stop_at=scan_end)
-                    accum = [a + env[gn].astype(jnp.float32)
-                             for a, gn in zip(accum, grad_names)]
-                    if found_name is not None:
-                        found = found | jnp.reshape(
-                            env[found_name], ()).astype(bool)
-                    ys = {n: env[n] for n in carry_out}
-                    return (accum, found), ys
-
-                init = ([jnp.zeros((grad_elems[gn],), jnp.float32
-                                   ).reshape(grad_shapes[gn])
-                         for gn in grad_names],
-                        jnp.zeros((), jnp.bool_))
-                (accum, found), ys = jax.lax.scan(
-                    body, init, (mbs, jnp.arange(k)))
-                env = dict(zip(feed_keys, feed_local))
-                env.update(state_env0)
-                env.update({n: ys[n][-1] for n in carry_out})
-                for gn, a in zip(grad_names, accum):
-                    env[gn] = (a / k if avg_gm else a)
-                scanned_ys = ys
-            else:
-                env = dict(zip(feed_keys, feed_local))
-                env.update(state_env0)
-                ctx = ExecContext(rng_key=rng)
-                env = run_block(block, env, ctx, stop_at=scan_end)
-                if found_name is not None:
-                    found = jnp.reshape(env[found_name], ()).astype(bool)
-                scanned_ys = None
-            env, new_ef = reduce_buckets(env, ef_rows)
-            if found_name is not None:
-                # one non-finite microbatch on ANY device skips the
-                # whole replicated update (pmax = cross-device OR)
-                found = jax.lax.pmax(found.astype(jnp.int32), axis) > 0
-                env[found_name] = jnp.reshape(found, (1,))
-            ctx = ExecContext(rng_key=rng)
-            env = run_block(block, env, ctx, start=scan_end)
-            fetches = []
-            for n, mode in zip(fetch_names, fetch_modes):
-                if scanned_ys is not None and n in scanned_ys \
-                        and n not in post_outs:
-                    stacked = scanned_ys[n]
-                    if jnp.issubdtype(stacked.dtype, jnp.inexact):
-                        val = jnp.mean(stacked.astype(jnp.float32),
-                                       axis=0).astype(stacked.dtype)
-                    else:
-                        val = stacked[-1]
-                else:
-                    val = env[n]
-                if mode == "pmean" and jnp.issubdtype(
-                        jnp.asarray(val).dtype, jnp.inexact):
-                    val = jax.lax.pmean(
-                        val.astype(jnp.float32), axis).astype(val.dtype)
-                fetches.append(val)
-            new_state = []
-            ef_iter = iter(new_ef)
-            for n, s in zip(persist_names, state):
-                if n in ef_set:
-                    new_state.append(next(ef_iter)[None, :]
-                                     if ef else s)
-                else:
-                    new_state.append(env.get(n, s))
-            return fetches, new_state
-
-        sharded = shard_map_nocheck(local_step, mesh, in_specs,
-                                    out_specs)
-
-        def step(feed_vals, state, rng):
-            return sharded(feed_vals, state, rng)
-
-        return step
-
-    def _pp_step_fn(self, block, feed_keys, fetch_names, persist_names,
-                    feed_vals, gm, bwd_idx):
-        """GPipe-composed gradient merge: the k microbatches of
-        BuildStrategy.gradient_merge_k flow through the
-        ``__pp_stage``-stamped forward stages on the GPipe fill-drain
-        schedule (parallel.pipeline.gpipe_schedule), still as ONE
-        compiled, donated, device-resident dispatch.
-
-        Differences from the plain gm scan (_gm_step_fn):
-
-        - the microbatch loop is schedule-ordered instead of sequential:
-          at tick t, stage s advances microbatch t-s — within a tick
-          every (stage, microbatch) pair is data-independent, which is
-          the property that lets XLA overlap the stages across a 'pp'
-          mesh axis (and on one chip compiles to the same math)
-        - a microbatch's backward (+ fp16 finite check) runs when it
-          retires from the last stage; f32 gradient accumulation happens
-          in retirement order == microbatch order, so the merged
-          gradient matches the scan's within reassociation roundoff
-        - persistable state written INSIDE the forward region does not
-          thread microbatch-to-microbatch (GPipe stages overlap, so
-          there is no earlier-microbatch value to read); every
-          microbatch sees the step-entry state and the LAST retired
-          microbatch's writes carry out — bn running stats behave like
-          classic GPipe, parameter updates are untouched (they live in
-          the post region)
-
-        Everything else (feed reshape, merged-gradient averaging,
-        FoundInfinite OR-reduce, loss-fetch averaging, single optimizer
-        region on the merged gradient) mirrors _gm_step_fn."""
-        from .. import profiler
-        from ..parallel.pipeline import gpipe_schedule
-
-        k, avg = gm
-        (scan_end, grad_names, found_name, state_carry, carry_out,
-         post_outs) = self._merge_region(block, feed_keys, feed_vals,
-                                         persist_names, fetch_names, k,
-                                         bwd_idx)
-        ops = block.ops
-
-        # stage op ranges from the __pp_stage stamps: stage s covers the
-        # absolute index range (start_s, end_s]; un-stamped prefix ops
-        # (feeds) ride stage 0, un-stamped trailing forward ops ride the
-        # last stage
-        stage_last: Dict[int, int] = {}
-        for i in range(bwd_idx):
-            sid = ops[i].attrs.get("__pp_stage")
-            if sid is not None:
-                stage_last[int(sid)] = i
-        n_stages = max(stage_last) + 1
-        ranges = []
-        start = 0
-        for s in range(n_stages):
-            end = bwd_idx if s == n_stages - 1 else stage_last[s] + 1
-            ranges.append((start, end))
-            start = end
-        self._counters["pp_stages"] = n_stages
-        profiler.set_counter("pp_stages", n_stages)
-
-        def step(feed_vals, state, rng):
-            state_env0 = dict(zip(persist_names, state))
-            mbs = [v.reshape((k, v.shape[0] // k) + tuple(v.shape[1:]))
-                   for v in feed_vals]
-            accum = None
-            grad_dtypes = None
-            found = jnp.zeros((), jnp.bool_)
-            carried: Dict[str, Any] = {}
-            ys = {n: [None] * k for n in carry_out}
-            live: Dict[int, tuple] = {}
-            for _t, pairs in gpipe_schedule(n_stages, k):
-                for s, m in pairs:
-                    if s == 0:
-                        env = dict(zip(feed_keys,
-                                       [mb[m] for mb in mbs]))
-                        env.update(state_env0)
-                        # same per-microbatch key derivation as the gm
-                        # scan: dropout masks match the scan leg bitwise
-                        live[m] = (env, ExecContext(
-                            rng_key=jax.random.fold_in(rng, m)))
-                    env, ctx = live[m]
-                    run_block(block, env, ctx,
-                              start=ranges[s][0], stop_at=ranges[s][1])
-                    if s == n_stages - 1:
-                        # microbatch m retires: backward + fp16 finite
-                        # check, then f32 accumulation
-                        run_block(block, env, ctx,
-                                  start=ranges[s][1], stop_at=scan_end)
-                        if grad_dtypes is None:
-                            grad_dtypes = [env[g].dtype
-                                           for g in grad_names]
-                        g = [env[gn].astype(jnp.float32)
-                             for gn in grad_names]
-                        accum = g if accum is None else \
-                            [a + b for a, b in zip(accum, g)]
-                        if found_name is not None:
-                            found = found | jnp.reshape(
-                                env[found_name], ()).astype(bool)
-                        carried = {n: env[n] for n in state_carry}
-                        for n in carry_out:
-                            ys[n][m] = env[n]
-                        del live[m]
-            env = dict(zip(feed_keys, feed_vals))  # full batch for post
-            env.update(state_env0)
-            env.update(carried)
-            env.update({n: ys[n][-1] for n in carry_out})
-            for gname, a, dt in zip(grad_names, accum or (),
-                                    grad_dtypes or ()):
-                merged = a / k if avg else a
-                env[gname] = merged.astype(dt)
-            if found_name is not None:
-                env[found_name] = jnp.reshape(found, (1,))
-            ctx = ExecContext(rng_key=rng)
-            env = run_block(block, env, ctx, start=scan_end)
-            fetches = []
-            for n in fetch_names:
-                if n in ys and n not in post_outs:
-                    stacked = jnp.stack(ys[n])
-                    if jnp.issubdtype(stacked.dtype, jnp.inexact):
-                        fetches.append(jnp.mean(
-                            stacked.astype(jnp.float32), axis=0
-                        ).astype(stacked.dtype))
-                    else:
-                        fetches.append(stacked[-1])
-                else:
-                    fetches.append(env[n])
-            new_state = [env.get(n, s_)
-                         for n, s_ in zip(persist_names, state)]
-            return fetches, new_state
-
-        return step
+        return ensure_zero_state(scope, zero_plan, shard_cfg, sharding)
 
     # -- dataset-driven training (reference executor.py:1593) -------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

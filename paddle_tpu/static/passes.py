@@ -274,6 +274,83 @@ def resolve_pipeline(strategy=None):
     return s if s > 1 else None
 
 
+PIPELINE_SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+def resolve_pipeline_schedule(strategy=None):
+    """Resolve which pipeline schedule a pipelined step compiles with.
+
+    Returns ``(schedule, interleave)`` with schedule in
+    ``gpipe | 1f1b | interleaved`` — gpipe is the default and the
+    escape leg (``pipeline_schedule="gpipe"`` restores the exact
+    pre-1F1B trace). The env override ``PADDLE_PP_SCHEDULE`` follows
+    the PADDLE_AMP pattern: a schedule name forces it on,
+    ``0``/``off`` forces gpipe whatever the strategy says.
+    ``interleave`` (BuildStrategy.pipeline_interleave) is the virtual
+    stages per worker, only meaningful for "interleaved"."""
+    try:
+        interleave = int(getattr(strategy, "pipeline_interleave", 2)
+                         or 2)
+    except (TypeError, ValueError):
+        interleave = 2
+    env = os.environ.get("PADDLE_PP_SCHEDULE")
+    if env is not None:
+        e = env.strip().lower()
+        if e in ("", "0", "false", "off", "gpipe"):
+            return ("gpipe", interleave)
+        if e in PIPELINE_SCHEDULES:
+            return (e, interleave)
+        raise ValueError(f"PADDLE_PP_SCHEDULE={env!r}: expected "
+                         "gpipe|1f1b|interleaved|0")
+    raw = str(getattr(strategy, "pipeline_schedule", "gpipe")
+              or "gpipe").lower()
+    if raw not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"BuildStrategy.pipeline_schedule={raw!r}: expected "
+            "gpipe|1f1b|interleaved")
+    return (raw, interleave)
+
+
+def resolve_zero(strategy=None):
+    """Resolve the ZeRO sharded-optimizer stage for one build.
+
+    Returns ``2`` or ``3`` (BuildStrategy.zero_stage), or ``None``
+    (stage 0 — replicated optimizer states). The env override
+    ``PADDLE_ZERO`` follows the PADDLE_AMP pattern: ``2``/``3``
+    forces the stage on, ``0``/``off`` is the escape leg whatever the
+    strategy says. ``PADDLE_IR_PASSES=0`` resolves to None with the
+    rest of the pipeline.
+
+    A resolved stage is a REQUEST, not a guarantee: the executor's
+    zero_eligibility gate (static/stepplan.py) additionally needs an
+    engaged quantized-comm plan (the reduce-scatter/all-gather
+    decomposition rides that ring) and an allowlisted optimizer —
+    ineligible builds fall back to the replicated path with a counted
+    ``zero.xla`` dispatch reason."""
+    if os.environ.get("PADDLE_IR_PASSES") == "0":
+        return None
+    env = os.environ.get("PADDLE_ZERO")
+    if env is not None:
+        e = env.strip().lower()
+        if e in ("", "0", "false", "off"):
+            return None
+        if e in ("2", "3"):
+            return int(e)
+        raise ValueError(f"PADDLE_ZERO={env!r}: expected 2|3|0")
+    if strategy is None:
+        return None
+    try:
+        stage = int(getattr(strategy, "zero_stage", 0) or 0)
+    except (TypeError, ValueError):
+        stage = 0
+    if stage == 0:
+        return None
+    if stage not in (2, 3):
+        raise ValueError(
+            f"BuildStrategy.zero_stage={stage!r}: expected 0|2|3")
+    return stage
+
+
 def resolve_gradient_merge(strategy=None):
     """Resolve the in-step gradient-merge config for one build.
 
@@ -303,12 +380,15 @@ def resolve_comm(strategy=None):
 
     Returns ``(codec, bucket_bytes, error_feedback)`` or ``None``
     (plain XLA f32 collectives). ``codec`` comes from
-    ``BuildStrategy.comm_quant`` ("int8" | "bf16"); the env override
+    ``BuildStrategy.comm_quant`` ("int8" | "bf16" | "f32" — f32 runs
+    the same explicit bucketed ring with NO rounding, the exact leg
+    the ZeRO bitwise-parity gate compares against); the env override
     ``PADDLE_QUANT_ALLREDUCE`` follows the PADDLE_AMP pattern —
-    ``int8``/``bf16`` forces the codec on, ``0``/``off`` is the bitwise
-    escape leg whatever the strategy says. ``PADDLE_IR_PASSES=0``
-    resolves to None with the rest of the pipeline (the comm step is a
-    graph-structure change like gm/sharding)."""
+    ``int8``/``bf16``/``f32`` forces the codec on, ``0``/``off`` is
+    the bitwise escape leg whatever the strategy says.
+    ``PADDLE_IR_PASSES=0`` resolves to None with the rest of the
+    pipeline (the comm step is a graph-structure change like
+    gm/sharding)."""
     if os.environ.get("PADDLE_IR_PASSES") == "0":
         return None
     try:
@@ -322,16 +402,17 @@ def resolve_comm(strategy=None):
         e = env.strip().lower()
         if e in ("", "0", "false", "off"):
             return None
-        if e in ("int8", "bf16"):
+        if e in ("int8", "bf16", "f32"):
             return (e, bucket, ef)
         raise ValueError(
-            f"PADDLE_QUANT_ALLREDUCE={env!r}: expected int8|bf16|0")
+            f"PADDLE_QUANT_ALLREDUCE={env!r}: expected int8|bf16|f32|0")
     raw = str(getattr(strategy, "comm_quant", "off") or "off").lower()
     if raw in ("off", "none", "false", "0", ""):
         return None
-    if raw not in ("int8", "bf16"):
+    if raw not in ("int8", "bf16", "f32"):
         raise ValueError(
-            f"BuildStrategy.comm_quant={raw!r}: expected int8|bf16|off")
+            f"BuildStrategy.comm_quant={raw!r}: "
+            "expected int8|bf16|f32|off")
     return (raw, bucket, ef)
 
 

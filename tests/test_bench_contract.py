@@ -325,6 +325,22 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["comm_bytes_saved_pct"] >= 60.0, last
     assert last["comm_buckets"] >= 2, last
     assert 0.0 < last["allreduce_overlap_frac"] < 1.0, last
+    # pipeline-schedule + ZeRO contract (ISSUE 18): 1F1B's modeled
+    # bubble beats gpipe's at the same (S, M); ZeRO-2 over dp=8 engages
+    # (counted zero dispatch), collapses >= 40% of the per-device
+    # optimizer-state bytes, and holds the loss inside the quant gate
+    # vs the replicated comm leg
+    for key in ("pp_1f1b_tokens_per_sec", "pp_1f1b_bubble_frac",
+                "zero_stage", "zero_state_bytes_saved_pct",
+                "zero_loss_delta", "zero_dispatches"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["pp_1f1b_tokens_per_sec"] > 0, last
+    assert 0.0 < last["pp_1f1b_bubble_frac"] < last["pp_bubble_frac"], \
+        last
+    assert last["zero_stage"] == 2, last
+    assert last["zero_state_bytes_saved_pct"] >= 40.0, last
+    assert last["zero_loss_delta"] <= 1e-2, last
+    assert last["zero_dispatches"] >= 1, last
 
 
 @pytest.mark.slow

@@ -151,6 +151,113 @@ def test_bucketed_overlap_matches_sequential(mesh8):
 
 
 # ---------------------------------------------------------------------------
+# public reduce_scatter / all_gather (the ZeRO decomposition surface)
+# ---------------------------------------------------------------------------
+
+def test_reduce_scatter_all_gather_roundtrip_is_allreduce(mesh8):
+    """all_gather(reduce_scatter(x)) under one codec is BITWISE the
+    one-shot quantized_allreduce of the same contributions — the
+    property the ZeRO grad path rides."""
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.RandomState(7)
+    x = (rng.randn(8, 1000) * 3).astype(np.float32)
+    total = C.padded_len(1000, 8)
+    for codec in ("f32", "bf16", "int8"):
+        def local(xs):
+            mine = C.reduce_scatter(xs[0], "dp", codec=codec,
+                                    axis_size=8)
+            return C.all_gather(mine, "dp", codec=codec, axis_size=8)
+
+        full = np.asarray(C.shard_map_nocheck(
+            local, mesh8, (P("dp", None),), P())(jnp.asarray(x)))
+        ar = np.asarray(C.quantized_allreduce(
+            jnp.asarray(x), mesh8, "dp", codec=codec))
+        assert full.shape == (total,)
+        assert np.array_equal(full[:1000], ar), codec
+
+
+def test_reduce_scatter_chunk_ownership_and_f32_exactness(mesh8):
+    """Device idx ends owning ring chunk (idx+1) % g; the f32 codec
+    accumulates with no rounding, so each owned chunk equals the exact
+    f32 ring sum of that chunk."""
+    from jax.sharding import PartitionSpec as P
+
+    g = 8
+    n = C.padded_len(4096, g)   # whole ring chunks, no padding
+    rng = np.random.RandomState(8)
+    x = rng.randn(g, n).astype(np.float32)
+
+    def local(xs):
+        return C.reduce_scatter(xs[0], "dp", codec="f32",
+                                axis_size=g)[None, :]
+
+    mine = np.asarray(C.shard_map_nocheck(
+        local, mesh8, (P("dp", None),), P("dp", None))(jnp.asarray(x)))
+    assert mine.shape == (g, n // g)
+    chunks = x.reshape(g, g, -1)   # [device, chunk, elems]
+    for idx in range(g):
+        own = (idx + 1) % g
+        # the f32 ring adds contributions in a fixed order: the sum
+        # walks devices idx+1, idx+2, ... around the ring and the
+        # local contribution lands last
+        acc = np.zeros_like(chunks[0, 0])
+        for t in range(1, g):
+            acc = acc + chunks[(idx + t) % g, own]
+        acc = acc + chunks[idx, own]
+        assert np.array_equal(mine[idx], acc), idx
+
+
+def test_reduce_scatter_avg_divides_by_group(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.RandomState(9)
+    x = rng.randn(8, 512).astype(np.float32)
+
+    def run(avg):
+        def local(xs):
+            return C.reduce_scatter(xs[0], "dp", codec="f32",
+                                    axis_size=8, avg=avg)[None, :]
+        return np.asarray(C.shard_map_nocheck(
+            local, mesh8, (P("dp", None),), P("dp", None))(
+                jnp.asarray(x)))
+
+    assert np.array_equal(run(True), run(False) / 8)
+
+
+def test_all_gather_raw_f32_is_exact(mesh8):
+    """codec='f32' all-gather (the ZeRO param leg) returns every
+    device's chunk bit-exact, in original chunk order."""
+    from jax.sharding import PartitionSpec as P
+
+    g = 8
+    rng = np.random.RandomState(10)
+    chunks = rng.randn(g, 64).astype(np.float32)
+
+    def local(cs):
+        return C.all_gather(cs[0], "dp", axis_size=g)
+
+    full = np.asarray(C.shard_map_nocheck(
+        local, mesh8, (P("dp", None),), P())(jnp.asarray(chunks)))
+    # device idx contributed chunks[idx] as ring chunk (idx+1) % g
+    want = np.concatenate(
+        [chunks[(pos - 1) % g] for pos in range(g)])
+    assert np.array_equal(full, want)
+
+
+def test_phase_nbytes_closed_forms():
+    for n in (1000, 8192, 333):
+        for g in (2, 8):
+            for codec in ("int8", "bf16", "f32"):
+                rs = C.reduce_scatter_nbytes(n, g, codec)
+                ag = C.all_gather_nbytes(n, g, codec)
+                assert abs(rs - ag) <= 1   # floor remainder only
+                assert rs + ag == C.ring_nbytes(n, g, codec)
+    assert C.reduce_scatter_nbytes(1000, 1, "int8") == 0
+    assert C.all_gather_nbytes(1000, 1, "int8") == 0
+
+
+# ---------------------------------------------------------------------------
 # bucket planning (static/passes.py comm_bucketing)
 # ---------------------------------------------------------------------------
 
@@ -263,20 +370,32 @@ def test_quant_dp_accuracy_gates():
     """The core accuracy contract: int8-quantized DP grads track the
     f32 GSPMD leg inside the established amp-style loss gate (<=1e-2),
     the bf16 leg tighter."""
+    from paddle_tpu import profiler
+
     f32, _ = _run_steps(mesh={"dp": 8})
+    s0 = profiler.counters_snapshot()
     int8, c8 = _run_steps(quant="int8", mesh={"dp": 8})
+    s1 = profiler.counters_snapshot()
     bf16, cb = _run_steps(quant="bf16", mesh={"dp": 8})
+    s2 = profiler.counters_snapshot()
     d8 = max(abs(a - b) for a, b in zip(f32, int8))
     db = max(abs(a - b) for a, b in zip(f32, bf16))
     assert d8 <= 1e-2, (d8, f32, int8)
     assert db <= 1e-3 and db <= d8, (db, d8)
-    # counters: wire bytes + gauges flow into exe.counters
-    assert c8["comm_quant_bytes_sent"] > 0
-    assert c8["comm_quant_bytes_saved"] > c8["comm_quant_bytes_sent"]
+    # counters: wire bytes + gauges flow into exe.counters; the byte
+    # counters are process-cumulative (merged like the fault slice) so
+    # each leg's own contribution is a snapshot diff
+    def leg(a, b, name="comm_quant_bytes_sent"):
+        return b.get(name, 0) - a.get(name, 0)
+    sent8 = leg(s0, s1)
+    saved8 = leg(s0, s1, "comm_quant_bytes_saved")
+    sentb = leg(s1, s2)
+    assert sent8 > 0
+    assert saved8 > sent8, (saved8, sent8)
     assert c8["comm_buckets"] >= 2
     assert 0.0 < c8["allreduce_overlap_frac"] < 1.0
     # int8 moves fewer wire bytes than bf16 for the same step count
-    assert c8["comm_quant_bytes_sent"] < cb["comm_quant_bytes_sent"]
+    assert sent8 < sentb, (sent8, sentb)
 
 
 def test_escape_leg_bitwise(monkeypatch):

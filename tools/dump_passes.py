@@ -44,6 +44,18 @@ pass over a pure-dp mesh (--sharding dp=N, default dp=8) and prints
 the per-bucket size/order/codec table: the gradient buckets in
 backward-completion order with their f32 vs encoded ring bytes.
 Bucket size rides --comm-bucket-bytes (default 1 MiB).
+
+Pipelining: --pipeline [S] stamps pipeline_stages=S (with
+--microbatches M as gradient_merge_k) and prints the tick-by-tick
+schedule timeline grid for --schedule [gpipe|1f1b|interleaved] plus
+the modeled bubble fractions of all three schedules at (S, M) — the
+same parallel.pipeline generators the compiled step replays.
+
+ZeRO: --zero [2|3] plans the sharded-optimizer decomposition over the
+comm buckets (implies --comm int8 over dp=8) and prints the per-bucket
+state-bytes table: replicated vs per-device (g, chunk) row bytes and
+the saved fraction — or the counted refusal reason when the build
+falls back to the replicated step.
 """
 from __future__ import annotations
 
@@ -169,6 +181,88 @@ def _parse_shard_hints(spec, program, mesh_shape):
     return hints
 
 
+def _timeline_table(schedule, s_count, m_count, interleave):
+    """Tick-by-tick grid of the compiled schedule (rows = stages,
+    columns = ticks, F<m>/B<m> slots) + the modeled bubble comparison
+    across all three schedules at the same (S, M)."""
+    from paddle_tpu.parallel.pipeline import (pipeline_timeline,
+                                              schedule_bubble_fraction)
+
+    grid, ticks = {}, 0
+    for t, slots in pipeline_timeline(schedule, s_count, m_count,
+                                      interleave):
+        ticks = max(ticks, t + 1)
+        for kind, s, m in slots:
+            grid[(s, t)] = f"{kind}{m}"
+    w = max(2, len(str(m_count - 1)) + 1)
+    head = f"{schedule} timeline: S={s_count} M={m_count}"
+    if schedule == "interleaved":
+        head += f" v={interleave}"
+    lines = [head,
+             "stage " + " ".join(f"{t:>{w}}" for t in range(ticks))]
+    for s in range(s_count):
+        lines.append(f"{s:>5} " + " ".join(
+            f"{grid.get((s, t), '.'):>{w}}" for t in range(ticks)))
+    lines.append("modeled bubble fraction: " + "  ".join(
+        f"{name}={schedule_bubble_fraction(name, s_count, m_count, interleave):.4f}"
+        for name in ("gpipe", "1f1b", "interleaved")))
+    return "\n".join(lines)
+
+
+def _zero_state_table(program, strategy, stage):
+    """Per-bucket replicated vs per-device optimizer-state bytes under
+    the ZeRO plan — or the counted refusal reason on fallback."""
+    from paddle_tpu.static import passes as passes_mod
+    from paddle_tpu.static.stepplan import (zero_eligibility,
+                                            zero_state_layout)
+
+    comm = passes_mod.resolve_comm(strategy)
+    shard_cfg = passes_mod.resolve_sharding(strategy)
+    axis = passes_mod.comm_data_axis(shard_cfg)
+    block = program.global_block
+    comm_plan = None
+    if comm is not None and axis is not None:
+        cplan = passes_mod.comm_bucket_plan(block, comm, axis[1])
+        if cplan:
+            comm_plan = (axis[0], axis[1], cplan)
+    reasons = []
+
+    def bump(cat, kind, reason=None):
+        if reason:
+            reasons.append(reason)
+
+    _, plan = zero_eligibility(
+        program, block, stage, comm, comm_plan, shard_cfg,
+        passes_mod.resolve_gradient_merge(strategy),
+        passes_mod.resolve_pipeline(strategy), (), bump=bump)
+    if plan is None:
+        return ("zero refused (replicated fallback): "
+                + (reasons[0] if reasons else "(no reason recorded)"))
+    g = plan["group"]
+    lines = [f"zero stage {plan['stage']} over axis {plan['axis']!r} "
+             f"(g={g}): one (g, chunk) f32 row per (bucket, role)",
+             f"{'bucket':>6}  {'opt':<10}{'params':>7}{'elems':>10}"
+             f"{'chunk':>9}{'rows':>5}{'repl B':>12}{'/dev B':>12}"
+             f"{'saved':>8}"]
+    for i, b in enumerate(plan["buckets"]):
+        nrows = len(b["roles"]) + (1 if plan["stage"] >= 3 else 0)
+        rep = b["elems"] * 4 * nrows
+        sh = b["chunk"] * 4 * nrows
+        saved = 1 - sh / rep if rep else 0.0
+        lines.append(f"{i:>6}  {b['op_type']:<10}{len(b['params']):>7}"
+                     f"{b['elems']:>10}{b['chunk']:>9}{nrows:>5}"
+                     f"{rep:>12}{sh:>12}{saved:>7.1%}")
+    rows = zero_state_layout(plan)
+    if rows:
+        lines.append("state rows: " + ", ".join(
+            f"{n}{list(shape)}" for n, _role, _bi, shape in rows))
+    tot_r, tot_s = plan["bytes_replicated"], plan["bytes_sharded"]
+    pct = 100.0 * (1 - tot_s / tot_r) if tot_r else 0.0
+    lines.append(f"total optimizer-state bytes: replicated {tot_r} -> "
+                 f"per-device {tot_s} ({pct:.1f}% saved)")
+    return "\n".join(lines)
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="print per-pass op-count/timing table for a program")
@@ -212,6 +306,27 @@ def main():
                          "--sharding's mesh (default dp=8)")
     ap.add_argument("--comm-bucket-bytes", type=int, default=1 << 20,
                     help="target f32 payload bytes per gradient bucket")
+    ap.add_argument("--pipeline", nargs="?", const=4, default=None,
+                    type=int, metavar="S",
+                    help="stamp pipeline_stages=S (default 4) and print "
+                         "the schedule timeline grid + modeled bubble "
+                         "fractions")
+    ap.add_argument("--schedule", default="gpipe",
+                    choices=("gpipe", "1f1b", "interleaved"),
+                    help="which schedule the --pipeline grid prints "
+                         "(bubbles always compare all three)")
+    ap.add_argument("--microbatches", type=int, default=8, metavar="M",
+                    help="gradient_merge_k microbatch count for "
+                         "--pipeline (default 8)")
+    ap.add_argument("--interleave", type=int, default=2,
+                    help="virtual chunks per worker for "
+                         "--schedule interleaved (default 2)")
+    ap.add_argument("--zero", nargs="?", const=2, default=None,
+                    type=int, choices=(2, 3), metavar="STAGE",
+                    help="plan ZeRO sharded optimizer states (implies "
+                         "--comm int8 over dp=8) and print the "
+                         "per-bucket state-bytes table or the counted "
+                         "refusal reason")
     ap.add_argument("--dot", default=None,
                     help="write the optimized block as graphviz dot")
     args = ap.parse_args()
@@ -262,11 +377,20 @@ def main():
         strategy.mesh_shape = mesh_shape
         strategy.sharding_hints = _parse_shard_hints(
             args.shard_hints, program, mesh_shape)
+    if args.zero and not args.comm:
+        args.comm = "int8"   # ZeRO rides the engaged comm plan
     if args.comm:
         if not strategy.mesh_shape:
             strategy.mesh_shape = {"dp": 8}   # pure-dp planning mesh
         strategy.comm_quant = args.comm
         strategy.comm_bucket_bytes = args.comm_bucket_bytes
+    if args.pipeline:
+        strategy.pipeline_stages = args.pipeline
+        strategy.gradient_merge_k = max(int(args.microbatches), 2)
+        strategy.pipeline_schedule = args.schedule
+        strategy.pipeline_interleave = args.interleave
+    if args.zero:
+        strategy.zero_stage = args.zero
 
     optimized, report = static.apply_passes(program, feeds, fetches,
                                             strategy)
@@ -283,6 +407,14 @@ def main():
     if args.comm:
         print()
         print(report.comm_bucket_table())
+    if args.pipeline:
+        print()
+        print(_timeline_table(args.schedule, args.pipeline,
+                              strategy.gradient_merge_k,
+                              args.interleave))
+    if args.zero:
+        print()
+        print(_zero_state_table(optimized, strategy, args.zero))
     if args.dot:
         static.save_dot(optimized, args.dot)
         print(f"optimized block dot -> {args.dot}")

@@ -51,6 +51,7 @@ MODULES = [
     "paddle_tpu.serving",
     "paddle_tpu.static",
     "paddle_tpu.static.cost_model",
+    "paddle_tpu.static.stepplan",
     "paddle_tpu.static.substrate",
     "paddle_tpu.text",
     "paddle_tpu.utils",
