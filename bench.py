@@ -1062,6 +1062,89 @@ def _shard_probe_main(n_devices=8, steps=3):
     bs_z.zero_stage = 2
     z_losses, _dt_z, zc, _ = run(bs_z, **zkw)
     z_dispatches = _pk.snapshot().get("zero.zero", 0) - z_snap0
+    # fused-optimizer dual leg (ISSUE 19): the same ZeRO-2 int8 step
+    # with the fused Pallas chunk update pinned OFF (PADDLE_FUSED_OPT=0,
+    # the bitwise XLA reference) vs ON via interpret mode (CPU has no
+    # Pallas backend; interpret-mode timing is a smoke signal, the real
+    # win needs a TPU — fused_opt_note says so)
+    def _zero_leg(envs):
+        bs = static.BuildStrategy()
+        bs.mesh_shape = {"dp": n_devices}
+        bs.comm_quant = "int8"
+        bs.zero_stage = 2
+        for k, v in envs.items():
+            os.environ[k] = v
+        try:
+            return run(bs, **zkw)
+        finally:
+            for k in envs:
+                os.environ.pop(k, None)
+
+    fx_losses, dt_fx, _, _ = _zero_leg({"PADDLE_FUSED_OPT": "0"})
+    f_snap0 = _pk.snapshot().get("fused_opt.pallas", 0)
+    ff_losses, dt_ff, _, _ = _zero_leg({"PADDLE_FUSED_OPT_INTERPRET": "1"})
+    fused_hits = _pk.snapshot().get("fused_opt.pallas", 0) - f_snap0
+    # expert-parallel MoE leg (ISSUE 19): dense oracle vs the explicit
+    # all_to_all exchange on an ep x dp mesh (same loss — global gating
+    # makes the explicit path numerically the dense path), plus the
+    # int8 dispatch-payload leg (accuracy-gated like the int8 ring)
+    from paddle_tpu.nn.moe import moe_a2a_nbytes, moe_route_stats
+
+    T, E, DH, EP = 32, 4, 32, 4
+    cap = max(1, int(1.25 * T / E))
+    mfeed = {"mx": rng.randn(T, H).astype(np.float32),
+             "mlabel": rng.randint(0, 4, (T, 1)).astype(np.int64)}
+
+    def run_moe(strategy=None, codec=None):
+        with unique_name.guard():
+            scope = static.Scope()
+            with static.scope_guard(scope):
+                main, startup = static.Program(), static.Program()
+                main.random_seed = startup.random_seed = 99
+                with static.program_guard(main, startup):
+                    x = static.data("mx", [T, H])
+                    label = static.data("mlabel", [T, 1], dtype="int64")
+                    h = static.nn.fc(x, H, act="relu")
+                    m, aux = static.nn.moe(
+                        h, num_experts=E, d_hidden=DH,
+                        capacity_factor=1.25, dispatch_codec=codec)
+                    logits = static.nn.fc(m, 4)
+                    loss = static.mean(static.softmax_with_cross_entropy(
+                        logits, label)) + static.mean(aux) * 0.01
+                    static.SGD(0.05).minimize(loss)
+                exe = static.Executor()
+                exe.run(startup)
+                target = static.CompiledProgram(
+                    main, build_strategy=strategy) if strategy else main
+                losses = [float(np.ravel(exe.run(
+                    target, feed=mfeed, fetch_list=[loss])[0])[0])
+                    for _ in range(steps)]
+                t0 = _time.perf_counter()
+                for _ in range(steps):
+                    exe.run(target, feed=mfeed, fetch_list=[loss])
+                dt = _time.perf_counter() - t0
+                # untrained-gate routing diagnostics from the live
+                # params (capacity drops are a property of the plan)
+                peek = getattr(scope, "_peek", scope.find_var)
+                ps = [p.name for p in main.all_parameters()]
+                w0, b0, gw = (np.asarray(peek(n)) for n in ps[:3])
+                hx = np.maximum(mfeed["mx"] @ w0 + b0, 0.0)
+                route = moe_route_stats(hx @ gw, cap)
+                return losses, dt, exe, route
+
+    moe_dense, _dt_md, _, _ = run_moe()
+    bs_moe = static.BuildStrategy()
+    bs_moe.mesh_shape = {"ep": EP, "dp": n_devices // EP}
+    a2a_snap0 = _pk.snapshot().get("moe_a2a.a2a", 0)
+    moe_ep, dt_me, exe_me, route = run_moe(bs_moe)
+    a2a_hits = _pk.snapshot().get("moe_a2a.a2a", 0) - a2a_snap0
+    moe_cost = (exe_me.cost_stats() or {}) \
+        if hasattr(exe_me, "cost_stats") else {}
+    bs_mq = static.BuildStrategy()
+    bs_mq.mesh_shape = {"ep": EP, "dp": n_devices // EP}
+    moe_int8, _dt_mq, _, _ = run_moe(bs_mq, codec="int8")
+    a2a_f32 = moe_a2a_nbytes(E, cap, H, EP, None)
+    a2a_int8 = moe_a2a_nbytes(E, cap, H, EP, "int8")
     tokens = B * steps
     print(json.dumps({
         "shard_tokens_per_sec": round(tokens / dt_shard, 2),
@@ -1091,6 +1174,25 @@ def _shard_probe_main(n_devices=8, steps=3):
         "comm_buckets": int(qc.get("comm_buckets", 0)),
         "allreduce_overlap_frac": float(
             qc.get("allreduce_overlap_frac", 0.0)),
+        "fused_opt_step_ms": round(1000.0 * dt_ff / steps, 3),
+        "fused_opt_xla_step_ms": round(1000.0 * dt_fx / steps, 3),
+        "fused_opt_dispatches": int(fused_hits),
+        "fused_opt_loss_delta": max(
+            abs(a - b) for a, b in zip(fx_losses, ff_losses)),
+        "fused_opt_note": (
+            "fused leg runs the Pallas kernel in interpret mode (CPU "
+            "host has no Pallas backend); step-time is a smoke signal "
+            "only — the HBM-bandwidth win needs a real TPU"),
+        "moe_tokens_per_sec": round(T * steps / dt_me, 2),
+        "moe_parity_delta": max(
+            abs(a - b) for a, b in zip(moe_dense, moe_ep)),
+        "moe_int8_loss_delta": max(
+            abs(a - b) for a, b in zip(moe_dense, moe_int8)),
+        "moe_capacity_drop_pct": float(route["drop_pct"]),
+        "moe_a2a_dispatches": int(a2a_hits),
+        "moe_a2a_bytes": int(moe_cost.get("moe_a2a_bytes", 0)),
+        "moe_a2a_bytes_saved_pct": round(
+            100.0 * (1.0 - a2a_int8 / a2a_f32), 2) if a2a_f32 else 0.0,
     }), flush=True)
 
 

@@ -38,6 +38,13 @@ define_flag("sample_autotune", True,
             "dispatch the winner (persisted in the same disk cache as "
             "the flash/paged verdicts). TPU only")
 
+define_flag("fused_opt_autotune", True,
+            "Time the fused Pallas optimizer update kernel (sgd / "
+            "momentum / adam / lamb) against the unfused XLA update "
+            "once per (op, n, dtype) flat size and dispatch the winner "
+            "(persisted in the same disk cache as the flash/paged "
+            "verdicts). TPU only")
+
 define_flag("paged_autotune", True,
             "Time the ragged paged-attention Pallas kernel against the "
             "XLA gather path once per (batch, pages, page_size, heads, "
@@ -391,6 +398,104 @@ def best_sample_impl(b, v, dtype, top_k) -> str | None:
     disk[_disk_key(key)] = winner
     _save_disk()
     return winner
+
+
+def fused_opt_cache_key(op_type, n, dtype) -> tuple:
+    """The fused-optimizer verdict key, namespaced like the paged and
+    sample keys in the ONE memo/disk cache."""
+    return ("fused_opt", str(op_type), int(n), str(dtype))
+
+
+def best_fused_opt_impl(op_type, n, dtype) -> str | None:
+    """'pallas' | 'xla' for this (op, flat size), timed on the device
+    (memoized + disk-persisted like the flash/paged verdicts), or None
+    when no candidate could be timed. Must only be called with
+    fused-eligible sizes on a TPU backend."""
+    key = fused_opt_cache_key(op_type, n, dtype)
+    if key in _cache:
+        _stats["mem_hits"] += 1
+        return _cache[key]
+
+    import jax
+    import jax.numpy as jnp
+
+    disk = _load_disk()
+    hit = disk.get(_disk_key(key))
+    if hit in ("pallas", "xla"):
+        _stats["disk_hits"] += 1
+        try:
+            from ... import profiler
+
+            profiler.bump_counter("autotune_disk_hits")
+        except Exception:
+            pass  # counter is best-effort; the verdict still serves
+        _cache[key] = hit
+        return hit
+
+    from ...utils.timing import timeit
+    from . import fused_optimizer as fo
+
+    g = jax.random.normal(jax.random.key(7), (n,), jnp.float32)
+
+    def _ins(gg):
+        ins = {"Param": [gg * 0.5], "Grad": [gg],
+               "LearningRate": [jnp.asarray(1e-3, jnp.float32)]}
+        if op_type == "momentum":
+            ins["Velocity"] = [gg * 0.1]
+        elif op_type in ("adam", "lamb"):
+            ins["Moment1"] = [gg * 0.1]
+            ins["Moment2"] = [gg * gg * 0.1]
+            ins["Beta1Pow"] = [jnp.asarray([0.9], jnp.float32)]
+            ins["Beta2Pow"] = [jnp.asarray([0.999], jnp.float32)]
+        return ins
+
+    candidates = {
+        "pallas": jax.jit(lambda gg: fo._pallas_update(
+            op_type, _ins(gg), {}, False)["ParamOut"][0]),
+        "xla": jax.jit(lambda gg: fo._XLA[op_type](
+            _ins(gg), {})["ParamOut"][0]),
+    }
+    times = {}
+    for name, fn in candidates.items():
+        try:
+            times[name] = timeit(fn, g, iters=_ITERS)
+        except Exception as e:  # candidate fails to compile/run: skip it
+            sys.stderr.write(f"fused_opt autotune: {name} failed "
+                             f"({type(e).__name__}: {e})\n")
+    if not times:
+        sys.stderr.write("fused_opt autotune: all candidates failed; "
+                         "keeping static dispatch\n")
+        return None
+    winner = min(times, key=times.get)
+    sys.stderr.write(
+        f"fused_opt autotune (op={op_type} n={n}): "
+        + " ".join(f"{nm}={t:.3f}ms" for nm, t in sorted(times.items()))
+        + f" -> {winner}\n")
+    _stats["timed"] += 1
+    _cache[key] = winner
+    disk[_disk_key(key)] = winner
+    _save_disk()
+    return winner
+
+
+def fused_opt_choice(op_type, n, dtype) -> str | None:
+    """The fused-optimizer dispatch entry: the tuned impl name, or None
+    when autotuning does not apply (not TPU / flag off) — None keeps
+    the static dispatch (kernel-first with XLA fallback)."""
+    from ...framework.bringup import TPU_PLATFORMS
+
+    if not get_flag("fused_opt_autotune"):
+        return None
+    import jax
+
+    if jax.default_backend() not in TPU_PLATFORMS:
+        return None
+    try:
+        return best_fused_opt_impl(op_type, n, dtype)
+    except Exception as e:
+        sys.stderr.write(f"fused_opt autotune failed, static dispatch "
+                         f"keeps ({type(e).__name__}: {e})\n")
+        return None
 
 
 def fused_sample_choice(logits, top_k) -> str | None:

@@ -156,6 +156,12 @@ class CostReport:
                 if self.hbm_bytes else 0.0)
 
     @property
+    def moe_a2a_bytes(self) -> int:
+        """Wire bytes of the explicit MoE dispatch/combine all_to_alls
+        (moe ops stamped ``__moe_ep`` by shard propagation)."""
+        return sum(o.comm_bytes for o in self.ops if o.type == "moe")
+
+    @property
     def pp_bubble_frac(self) -> float:
         """Analytic idle fraction of the pipelined step under the
         compiled schedule — 0.0 when not pipelined (S <= 1 or a single
@@ -186,6 +192,7 @@ class CostReport:
             "model_flops": self.model_flops,
             "hbm_bytes": self.hbm_bytes,
             "comm_bytes": self.comm_bytes,
+            "moe_a2a_bytes": self.moe_a2a_bytes,
             "arith_intensity": round(self.arith_intensity, 3),
             "n_ops": len(self.ops),
             "batch": self.batch,
@@ -323,6 +330,7 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
         ins = [n for n in op.input_names()]
         outs = [n for n in op.output_names()]
         flops = 0
+        moe_comm = 0
         if t == "mul":
             o = outs[0] if outs else None
             oshape = shape_of(o, b) if o else None
@@ -353,6 +361,28 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
                                or op.inputs.get("W") or [None])[0], b)
             if bshape and wshape:
                 flops = 2 * _prod(bshape) * _prod(wshape[1:])
+        elif t == "moe":
+            # gate matmul + dispatch/combine einsums over the (e, c, d)
+            # capacity grid + the expert FFNs on their capacity blocks
+            xshape = shape_of((op.inputs.get("X") or [None])[0], b)
+            w1shape = shape_of((op.inputs.get("W1") or [None])[0], b)
+            if xshape and w1shape:
+                tkn, d = _prod(xshape[:-1]), int(xshape[-1])
+                e, h = int(w1shape[0]), int(w1shape[-1])
+                cf = float(op.attrs.get("capacity_factor", 2.0))
+                cap = max(1, int(cf * tkn / e))
+                flops = (2 * tkn * d * e          # gate logits
+                         + 4 * tkn * e * cap * d  # dispatch + combine
+                         + 4 * e * cap * d * h)   # two FFN matmuls
+                ep = op.attrs.get("__moe_ep")
+                if ep:
+                    # explicit exchange plan: charge the two hand-
+                    # placed all_to_alls (dispatch may ride int8)
+                    from ..nn.moe import moe_a2a_nbytes
+
+                    moe_comm = moe_a2a_nbytes(
+                        e, cap, d, int(ep[1]),
+                        op.attrs.get("dispatch_codec") or None)
 
         if t == "paged_attention":
             # ragged paged decode attention: only the GATHERED live
@@ -427,6 +457,8 @@ def program_cost(program, feed_shapes=None, batch_size=None, gm=None,
                     if out_axes else 1
                 payload = sum(nbytes_of(n, b) for n in outs) // out_factor
                 comm = int(2 * (g - 1) * payload // g) * mult
+        if moe_comm:
+            comm += int(moe_comm) * mult
 
         out.append(OpCost(
             index=i, type=t, out=(outs[0] if outs else ""),

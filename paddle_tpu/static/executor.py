@@ -310,6 +310,20 @@ def _exec_cache_put(key: str, entry: _ExecEntry) -> None:
         _EXEC_CACHE.popitem(last=False)
 
 
+def _escape_env_signature() -> tuple:
+    """Kernel escape hatches read the environment at TRACE time (fused
+    optimizer / explicit MoE exchange), so two content-identical
+    programs traced under different toggles are different executables —
+    the toggles must join both cache keys or a cached leg silently
+    defangs the env pin (the bench's dual fused-vs-xla legs hit exactly
+    this)."""
+    import os
+
+    return tuple((k, os.environ.get(k, "")) for k in
+                 ("PADDLE_FUSED_OPT", "PADDLE_FUSED_OPT_INTERPRET",
+                  "PADDLE_MOE_A2A"))
+
+
 def _content_key(opt_program, feed_sig, fetch_names, persist_names,
                  state_sig, sharding, donate, gm=None, pp=None,
                  comm=None, schedule=None, zero=None,
@@ -325,11 +339,13 @@ def _content_key(opt_program, feed_sig, fetch_names, persist_names,
     shard_desc = None
     if sharding:
         shard_desc = sorted((k, str(v)) for k, v in sharding.items())
+    env_desc = list(_escape_env_signature())
     blob = json.dumps(
         [opt_program.to_dict(), list(feed_sig), list(fetch_names),
          list(persist_names), list(state_sig), shard_desc, bool(donate),
          list(gm) if gm else None, pp,
-         list(comm) if comm else None, schedule, zero, interleave],
+         list(comm) if comm else None, schedule, zero, interleave,
+         env_desc],
         sort_keys=True, default=str).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()
 
@@ -816,7 +832,8 @@ class Executor:
                     _strategy_signature(strategy), amp, gm, shard_cfg,
                     pp, comm, comm_plan is not None, schedule,
                     interleave if schedule == "interleaved" else None,
-                    zero, zero_plan is not None)
+                    zero, zero_plan is not None,
+                    _escape_env_signature())
         per_prog = self._cache.setdefault(program, {})
         entry = None
         if use_program_cache:

@@ -1243,6 +1243,70 @@ def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
 
 
 # ---------------------------------------------------------------------------
+# mixture-of-experts (ISSUE 19): one static op wrapping nn.moe's
+# expert-parallel apply. The shard_propagation pass stamps __moe_ep =
+# [axis, n] when the mesh has an "ep" axis dividing the expert count;
+# with the stamp the kernel compiles the explicit all_to_all
+# dispatch/combine inside shard_map, otherwise it runs the dense
+# single-device oracle (numerically identical — see nn/moe.py).
+# ---------------------------------------------------------------------------
+@kernel("moe")
+def _moe_kernel(ins, attrs, ctx):
+    from ..nn.moe import moe_apply_ep
+    from ..parallel.mesh import mesh_for_shape
+
+    params = {"gate_w": ins["GateW"][0],
+              "experts_w1": ins["W1"][0], "experts_b1": ins["B1"][0],
+              "experts_w2": ins["W2"][0], "experts_b2": ins["B2"][0]}
+    mesh, axis = None, "ep"
+    stamp = attrs.get("__moe_ep")
+    if stamp:
+        axis, n = str(stamp[0]), int(stamp[1])
+        shape = ({str(a): int(s) for a, s in stamp[2]}
+                 if len(stamp) > 2 else {axis: n})
+        mesh = mesh_for_shape(shape)
+    out, aux = moe_apply_ep(
+        params, ins["X"][0], mesh=mesh, axis=axis,
+        capacity_factor=float(attrs.get("capacity_factor", 2.0)),
+        dispatch_codec=attrs.get("dispatch_codec") or None)
+    return {"Out": [out], "AuxLoss": [aux.reshape((1,))]}
+
+
+def moe(x, num_experts, d_hidden, capacity_factor=2.0, dispatch_codec=None,
+        param_attr=None, name=None):
+    """Static-graph MoE FFN: top-2 gate + num_experts expert FFNs with
+    GShard static capacity (capacity_factor * tokens / experts). x must
+    be 2-D (tokens, d_model) with a static token count — capacity is a
+    compile-time shape. Returns (out, aux_loss): out keeps x's shape,
+    aux_loss is the (1,) load-balancing loss to add to the objective.
+
+    Under a mesh with an "ep" axis the shard_propagation pass stamps
+    the op and the kernel runs the explicit expert-parallel all_to_all
+    exchange; ``dispatch_codec="int8"`` additionally quantizes the
+    dispatch payload on the wire (accuracy-gated by the caller)."""
+    from .initializer import Xavier
+
+    helper = LayerHelper("moe", name=name)
+    d = int(x.shape[-1])
+    e, h = int(num_experts), int(d_hidden)
+    xav = Xavier(uniform=True)
+    gate_w = helper.create_parameter([d, e], attr=param_attr,
+                                     initializer=xav)
+    w1 = helper.create_parameter([e, d, h], initializer=xav)
+    b1 = helper.create_parameter([e, h], initializer=_const(0.0))
+    w2 = helper.create_parameter([e, h, d], initializer=xav)
+    b2 = helper.create_parameter([e, d], initializer=_const(0.0))
+    attrs = {"capacity_factor": float(capacity_factor)}
+    if dispatch_codec:
+        attrs["dispatch_codec"] = str(dispatch_codec)
+    return _append_simple(
+        "moe",
+        {"X": [x.name], "GateW": [gate_w.name], "W1": [w1.name],
+         "B1": [b1.name], "W2": [w2.name], "B2": [b2.name]},
+        attrs, out_slots=("Out", "AuxLoss"), helper=helper)
+
+
+# ---------------------------------------------------------------------------
 # export: public functions defined here join fluid.layers / static.nn
 # ---------------------------------------------------------------------------
 __all__ = [n for n, v in list(globals().items())

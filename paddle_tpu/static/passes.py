@@ -1481,6 +1481,33 @@ def _pass_shard_propagation(ctx: _Ctx) -> None:
             for n in op.output_names():
                 set_spec(n, out_spec, "propagated")
             continue
+        if t == "moe":
+            # expert parallelism (ISSUE 19): when the mesh carries an
+            # "ep" axis that divides the expert count, stamp the
+            # exchange plan — the moe kernel compiles the explicit
+            # all_to_all dispatch/combine from it and the cost model
+            # charges both exchanges into comm_bytes. Out keeps the
+            # token spec of X; AuxLoss is a replicated scalar.
+            w1 = (op.inputs.get("W1") or [None])[0]
+            e = int((shape_of(w1) or (0,))[0] or 0)
+            n_ep = int(axis_sizes.get("ep", 0) or 0)
+            if n_ep > 1 and e and e % n_ep == 0:
+                # carry the FULL mesh shape: the kernel's shard_map
+                # must run on the executor's own mesh (mesh_for_shape
+                # caches, so the same shape returns the same Mesh)
+                op.attrs["__moe_ep"] = [
+                    "ep", n_ep,
+                    [[str(a), int(s)] for a, s in axis_sizes.items()]]
+                stats["moe_ep_stamped"] += 1
+            x = (op.inputs.get("X") or [None])[0]
+            sp = specs.get(x)
+            out_n = (op.outputs.get("Out") or [None])[0]
+            if out_n is not None:
+                set_spec(out_n, sp or (), "propagated")
+            aux_n = (op.outputs.get("AuxLoss") or [None])[0]
+            if aux_n is not None:
+                specs.pop(aux_n, None)
+            continue
         # unknown op: propagation stops, outputs replicated
         for n in op.output_names():
             specs.pop(n, None)

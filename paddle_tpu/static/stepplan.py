@@ -994,18 +994,25 @@ def _comm_step_fn(plan, block, feed_keys, fetch_names, persist_names,
 # engaged comm plan) + its eligibility gate, state layout and flip-back
 # ---------------------------------------------------------------------------
 
-# optimizer ops whose update rule is ELEMENTWISE, so it commutes with
-# the concat/pad/chunk reshuffle and runs unchanged on a (chunk,) shard.
-# lamb is deliberately absent: its trust ratio is a global param norm.
-ZERO_OPT_OPS = ("sgd", "momentum", "adam")
+# optimizer ops that run on a (chunk,) shard. sgd/momentum/adam are
+# ELEMENTWISE, so they commute with the concat/pad/chunk reshuffle
+# unchanged. lamb (ISSUE 19) rides the fused kernel's TWO-PHASE trust
+# plan: per-chunk partial per-param sq-norms -> one tiny psum over the
+# dp axis -> the elementwise finish consumes the global norms — so its
+# global-param-norm trust ratio no longer blocks sharding (it is
+# tolerance-parity vs the unsharded op: the norm sum reassociates
+# across devices).
+ZERO_OPT_OPS = ("sgd", "momentum", "adam", "lamb")
 
 # per-op state slots that shard into (g, chunk) rows, and the scalar
 # accumulators that stay replicated per-var (the fused kernel call
 # updates them through its own gated Beta*PowOut rule)
 _ZERO_ROLES = {"sgd": (), "momentum": ("Velocity",),
-               "adam": ("Moment1", "Moment2")}
+               "adam": ("Moment1", "Moment2"),
+               "lamb": ("Moment1", "Moment2")}
 _ZERO_SCALARS = {"sgd": (), "momentum": (),
-                 "adam": ("Beta1Pow", "Beta2Pow")}
+                 "adam": ("Beta1Pow", "Beta2Pow"),
+                 "lamb": ("Beta1Pow", "Beta2Pow")}
 
 
 def _zero_row_sources(stage, bucket):
@@ -1032,7 +1039,8 @@ def zero_eligibility(program, block, zero, comm, comm_plan, shard_cfg,
     region collapses to one fused elementwise kernel call per bucket on
     this device's (chunk,) shard. Eligible means: the comm plan is
     engaged, every bucket's params are updated by allowlisted
-    elementwise optimizer ops (:data:`ZERO_OPT_OPS`) with ONE uniform
+    chunk-shardable optimizer ops (:data:`ZERO_OPT_OPS`; lamb via the
+    fused kernel's two-phase trust-ratio plan) with ONE uniform
     type/attrs/lr/gate per bucket (the fused call synthesizes a single
     op), params and grads are f32 (a chunked f32 update of a bf16
     param would drift from the reference kernel's native-dtype math),
@@ -1102,9 +1110,8 @@ def zero_eligibility(program, block, zero, comm, comm_plan, shard_cfg,
             i, op = opt_at[pn]
             if op.type not in ZERO_OPT_OPS:
                 return verdict(None, f"optimizer {op.type!r} is not "
-                                     "chunk-shardable (lamb's trust "
-                                     "ratio is a global param norm); "
-                                     f"allowlist: {ZERO_OPT_OPS}")
+                                     "chunk-shardable; allowlist: "
+                                     f"{ZERO_OPT_OPS}")
             if not _f32(pn) or not _f32(gn):
                 return verdict(None, f"param/grad for {pn!r} is not "
                                      "f32 — the chunked f32 update "
@@ -1299,7 +1306,9 @@ def _zero_step_fn(plan, block, feed_keys, fetch_names, persist_names,
       chunk — the full merged gradient is never materialized (the
       ZeRO-2 gradient shard), and the optimizer consumes the chunk
       UN-quantized (one fewer encode than the all-reduce path; with
-      codec='f32' the step is bitwise the replicated comm step)
+      codec='f32' the step is bitwise the replicated comm step for
+      the elementwise rules — lamb is tolerance-parity: its segment
+      norms psum across devices, which reassociates the sum)
     - ONE fused elementwise kernel call per bucket updates the param
       chunk (stage 2: sliced from the replicated param concat at the
       ring-owned position; stage 3: this device's param row) against
@@ -1318,6 +1327,7 @@ def _zero_step_fn(plan, block, feed_keys, fetch_names, persist_names,
     """
     from jax.sharding import PartitionSpec as P
 
+    from ..ops.pallas.fused_optimizer import fused_chunk_update
     from ..parallel.collectives import (
         all_gather, quant_decode, quant_encode, reduce_scatter,
         shard_map_nocheck)
@@ -1523,7 +1533,18 @@ def _zero_step_fn(plan, block, feed_keys, fetch_names, persist_names,
                 ins[srole] = [env[names[0]]]
             if b["found"] is not None:
                 ins["FoundInfinite"] = [env[b["found"]]]
-            outs = KERNELS[b["op_type"]](ins, b["attrs"], ctx)
+            # ONE fused kernel call per bucket (ISSUE 19): the Pallas
+            # grid pass reads the chunk's grad/param/moments once; the
+            # ineligible path is the verbatim static-op math. lamb
+            # threads the per-param element layout + this device's
+            # ring position so its two-phase trust plan can psum the
+            # segment norms over the dp axis.
+            outs = fused_chunk_update(
+                b["op_type"], ins, b["attrs"], axis=axis,
+                param_elems=tuple(
+                    int(np.prod(shp or (1,)))
+                    for shp in b["param_shapes"]),
+                position=jnp.mod(idx + 1, g) * c)
             for role in b["roles"]:
                 new_rows[f"__zero_{role.lower()}_{bi}"] = \
                     outs[role + "Out"][0]

@@ -341,6 +341,27 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["zero_state_bytes_saved_pct"] >= 40.0, last
     assert last["zero_loss_delta"] <= 1e-2, last
     assert last["zero_dispatches"] >= 1, last
+    # kernel MFU push contract (ISSUE 19): the fused Pallas optimizer
+    # engages on the ZeRO int8 leg (interpret-forced on CPU) and stays
+    # inside the quant gate vs its PADDLE_FUSED_OPT=0 XLA twin; the
+    # MoE probe's explicit all_to_all path is parity-gated vs the dense
+    # oracle with its wire bytes charged in the cost model
+    for key in ("fused_opt_step_ms", "fused_opt_xla_step_ms",
+                "fused_opt_dispatches", "fused_opt_loss_delta",
+                "fused_opt_note", "moe_tokens_per_sec",
+                "moe_parity_delta", "moe_int8_loss_delta",
+                "moe_capacity_drop_pct", "moe_a2a_dispatches",
+                "moe_a2a_bytes", "moe_a2a_bytes_saved_pct"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["fused_opt_step_ms"] > 0, last
+    assert last["fused_opt_dispatches"] >= 1, last
+    assert last["fused_opt_loss_delta"] <= 1e-2, last
+    assert last["moe_tokens_per_sec"] > 0, last
+    assert last["moe_parity_delta"] <= 1e-5, last
+    assert last["moe_int8_loss_delta"] <= 1e-2, last
+    assert last["moe_a2a_dispatches"] >= 1, last
+    assert last["moe_a2a_bytes"] > 0, last
+    assert last["moe_a2a_bytes_saved_pct"] > 0.0, last
 
 
 @pytest.mark.slow

@@ -367,9 +367,8 @@ def test_zero3_shards_params_too():
 
 def test_zero_fallbacks_are_counted_with_reasons():
     """Every refusal is a counted zero.xla verdict, never a silent
-    ignore or a crash: no engaged comm plan, a non-elementwise
-    optimizer (lamb), and a fetch of absorbed state all fall back to
-    the replicated step."""
+    ignore or a crash: no engaged comm plan and a fetch of absorbed
+    state both fall back to the replicated step."""
     from paddle_tpu.ops.pallas import counters as pk
 
     # zero_stage without comm_quant: comm plan not engaged, the step
@@ -384,11 +383,6 @@ def test_zero_fallbacks_are_counted_with_reasons():
     z, _, _, _ = _run_legs([bs], opt="momentum")
     assert base.tobytes() == z.tobytes()
     assert pk.snapshot().get("zero.xla", 0) >= 1
-    # lamb's trust ratio is a global norm: not chunk-shardable
-    pk.reset()
-    _run_legs([_zero_bs("f32")], opt="lamb", steps_each=1)
-    assert pk.snapshot().get("zero.xla", 0) >= 1
-    assert pk.snapshot().get("zero.zero", 0) == 0
     # fetching a sharded moment cannot be served from rows
     pk.reset()
     with unique_name.guard():
@@ -408,6 +402,26 @@ def test_zero_fallbacks_are_counted_with_reasons():
                 main, build_strategy=_zero_bs("f32")),
                 feed=feed, fetch_list=[loss, vel])
     assert pk.snapshot().get("zero.xla", 0) >= 1
+
+
+def test_zero_lamb_two_phase_trust_engages_and_tracks():
+    """lamb is chunk-shardable now (ISSUE 19): the fused kernel's
+    two-phase trust plan — per-chunk partial per-param sq-norms, one
+    tiny psum over dp, elementwise finish against the global norms —
+    replaces PR 18's counted refusal. The sharded run ENGAGES
+    (zero.zero) and tracks the replicated comm leg within the norm
+    reassociation tolerance; moments shard into rows like adam's."""
+    from paddle_tpu.ops.pallas import counters as pk
+
+    base, _, _, _ = _run_legs([_comm_bs("f32")] * 2, opt="lamb")
+    pk.reset()
+    z, exe, scope, _ = _run_legs([_zero_bs("f32")] * 2, opt="lamb")
+    assert pk.snapshot().get("zero.zero", 0) >= 1
+    assert pk.snapshot().get("zero.xla", 0) == 0
+    np.testing.assert_allclose(z, base, rtol=1e-5, atol=1e-6)
+    assert dict(exe.counters)["zero_stage_active"] == 2
+    assert _peek(scope)("__zero_moment1_0") is not None
+    assert _peek(scope)("__zero_moment2_0") is not None
 
 
 def test_zero_env_escape_leg(monkeypatch):

@@ -263,6 +263,143 @@ def _zero_state_table(program, strategy, stage):
     return "\n".join(lines)
 
 
+def _moe_demo_program(ep):
+    """Demo program with an expert-parallel MoE block (2*ep experts so
+    the ep axis divides them, capacity_factor 1.25 so overflow drops
+    show up in the route table)."""
+    import paddle_tpu.static as static
+
+    e = 2 * max(2, ep)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [64, 16])
+        label = static.data("label", [64, 1], dtype="int64")
+        h = static.nn.fc(x, 16, act="relu")
+        m, aux = static.nn.moe(h, num_experts=e, d_hidden=32,
+                               capacity_factor=1.25)
+        logits = static.nn.fc(m, 4)
+        loss = static.mean(
+            static.softmax_with_cross_entropy(logits, label)) \
+            + static.mean(aux) * 0.01
+        static.SGD(0.01).minimize(loss)
+    return main, ["x", "label"], [loss.name]
+
+
+def _moe_table(optimized, ep):
+    """Per-moe-op routing/exchange table: the __moe_ep stamp (or why it
+    is absent), the per-expert capacity-kept/dropped counts from one
+    synthetic untrained-gate evaluation, and the explicit all_to_all
+    wire bytes the cost model charges."""
+    import numpy as np
+
+    from paddle_tpu.nn.moe import moe_a2a_nbytes, moe_route_stats
+
+    blk = optimized.global_block
+    moes = [(i, op) for i, op in enumerate(blk.ops) if op.type == "moe"]
+    if not moes:
+        return "(no moe ops in the optimized block)"
+    lines = []
+    for i, op in moes:
+        w1 = blk.vars[op.inputs["W1"][0]]
+        x = blk.vars[op.inputs["X"][0]]
+        e = int(w1.shape[0])
+        t = abs(int(x.shape[0] or 1))
+        d = int(x.shape[-1])
+        cf = float(op.attrs.get("capacity_factor", 2.0))
+        cap = max(1, int(cf * t / e))
+        stamp = op.attrs.get("__moe_ep")
+        head = (f"moe op #{i}: tokens={t} d={d} experts={e} "
+                f"capacity={cap} (factor {cf})")
+        if stamp:
+            axis, n = str(stamp[0]), int(stamp[1])
+            head += (f"  [stamped __moe_ep: {axis}={n}, explicit "
+                     f"all_to_all x2, "
+                     f"{moe_a2a_nbytes(e, cap, d, n)} B/device f32 / "
+                     f"{moe_a2a_nbytes(e, cap, d, n, 'int8')} B int8]")
+        else:
+            head += (f"  [not stamped: needs an 'ep' mesh axis >1 "
+                     f"dividing experts={e} (asked ep={ep}) -> dense]")
+        lines.append(head)
+        rng = np.random.RandomState(0)
+        stats = moe_route_stats(
+            rng.randn(t, e).astype("float32"), cap)
+        lines.append(f"{'expert':>6}{'kept':>7}{'dropped':>9}  "
+                     "(one synthetic untrained-gate eval)")
+        for j, (k, dr) in enumerate(zip(stats["kept_per_expert"],
+                                        stats["dropped_per_expert"])):
+            lines.append(f"{j:>6}{k:>7}{dr:>9}")
+        lines.append(f"capacity drop: {stats['drop_pct']}% of 2t "
+                     f"token-choices, aux_loss="
+                     f"{stats['aux_loss']:.4f}")
+    return "\n".join(lines)
+
+
+def _fused_opt_table(optimized, strategy, zero_stage):
+    """Per-update-op (and per-ZeRO-bucket) kernel-vs-xla dispatch table
+    — the same ``_dispatch`` gate the compiled step funnels through, so
+    the table shows exactly which params ride the fused Pallas kernel
+    on this backend/env and the refusal reason for the rest."""
+    from paddle_tpu.ops.pallas.fused_optimizer import _dispatch
+
+    blk = optimized.global_block
+    update_ops = ("sgd", "momentum", "adam", "adamw", "lamb",
+                  "rmsprop", "adagrad")
+    rows = [(i, op) for i, op in enumerate(blk.ops)
+            if op.type in update_ops]
+    if not rows:
+        return "(no optimizer update ops in the optimized block)"
+    lines = [f"{'#':>3} {'op':<10}{'param':<22}{'elems':>9} "
+             f"{'dtype':<9}{'path':<8}reason"]
+    import numpy as np
+
+    for i, op in rows:
+        pname = (op.inputs.get("Param") or ["?"])[0]
+        v = blk.vars.get(pname)
+        shape = tuple(getattr(v, "shape", ()) or ())
+        elems = int(np.prod([abs(s or 1) for s in shape])) if shape else 0
+        dtype = str(getattr(v, "dtype", "float32"))
+        path, reason, interp = _dispatch(op.type, elems, dtype)
+        if path == "pallas" and interp:
+            path = "pallas*"
+        lines.append(f"{i:>3} {op.type:<10}{pname[:21]:<22}{elems:>9} "
+                     f"{dtype:<9}{path:<8}{reason}")
+    lines.append("(pallas* = interpret-forced via "
+                 "PADDLE_FUSED_OPT_INTERPRET)")
+    if zero_stage:
+        from paddle_tpu.static import passes as passes_mod
+        from paddle_tpu.static.stepplan import zero_eligibility
+
+        comm = passes_mod.resolve_comm(strategy)
+        shard_cfg = passes_mod.resolve_sharding(strategy)
+        axis = passes_mod.comm_data_axis(shard_cfg)
+        comm_plan = None
+        if comm is not None and axis is not None:
+            cplan = passes_mod.comm_bucket_plan(blk, comm, axis[1])
+            if cplan:
+                comm_plan = (axis[0], axis[1], cplan)
+        _, plan = zero_eligibility(
+            optimized, blk, zero_stage, comm, comm_plan, shard_cfg,
+            passes_mod.resolve_gradient_merge(strategy),
+            passes_mod.resolve_pipeline(strategy), (),
+            bump=lambda *a, **k: None)
+        if plan is None:
+            lines.append("zero refused: per-bucket table unavailable "
+                         "(see --zero output)")
+        else:
+            lines.append(f"zero buckets (g={plan['group']}): the fused "
+                         "kernel runs on the PER-DEVICE chunk")
+            lines.append(f"{'bucket':>6}  {'opt':<10}{'chunk':>9} "
+                         f"{'path':<8}reason")
+            for j, b in enumerate(plan["buckets"]):
+                path, reason, interp = _dispatch(
+                    b["op_type"], int(b["chunk"]), "float32")
+                if path == "pallas" and interp:
+                    path = "pallas*"
+                lines.append(f"{j:>6}  {b['op_type']:<10}"
+                             f"{int(b['chunk']):>9} {path:<8}{reason}")
+    return "\n".join(lines)
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="print per-pass op-count/timing table for a program")
@@ -327,6 +464,16 @@ def main():
                          "--comm int8 over dp=8) and print the "
                          "per-bucket state-bytes table or the counted "
                          "refusal reason")
+    ap.add_argument("--moe", nargs="?", const=4, default=None,
+                    type=int, metavar="EP",
+                    help="run over an expert-parallel mesh (ep=EP, "
+                         "default 4; demo swaps in an MoE program) and "
+                         "print the per-expert capacity/route table + "
+                         "the explicit all_to_all wire bytes")
+    ap.add_argument("--fused-opt", action="store_true",
+                    help="print the per-update-op (and, with --zero, "
+                         "per-bucket) fused-kernel-vs-xla dispatch "
+                         "table with refusal reasons")
     ap.add_argument("--dot", default=None,
                     help="write the optimized block as graphviz dot")
     args = ap.parse_args()
@@ -334,7 +481,8 @@ def main():
     import paddle_tpu.static as static
 
     if args.demo or not args.target:
-        program, feeds, fetches = _demo_program()
+        program, feeds, fetches = (_moe_demo_program(args.moe)
+                                   if args.moe else _demo_program())
     else:
         program, feeds, fetches = _load_target(args.target)
     if args.feed:
@@ -391,6 +539,10 @@ def main():
         strategy.pipeline_interleave = args.interleave
     if args.zero:
         strategy.zero_stage = args.zero
+    if args.moe:
+        mesh = dict(strategy.mesh_shape or {})
+        mesh.setdefault("ep", args.moe)
+        strategy.mesh_shape = mesh
 
     optimized, report = static.apply_passes(program, feeds, fetches,
                                             strategy)
@@ -415,6 +567,12 @@ def main():
     if args.zero:
         print()
         print(_zero_state_table(optimized, strategy, args.zero))
+    if args.moe:
+        print()
+        print(_moe_table(optimized, args.moe))
+    if args.fused_opt:
+        print()
+        print(_fused_opt_table(optimized, strategy, args.zero))
     if args.dot:
         static.save_dot(optimized, args.dot)
         print(f"optimized block dot -> {args.dot}")

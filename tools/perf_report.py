@@ -166,6 +166,24 @@ def render_report(steps: List[dict], costs: List[dict],
             float(cost.get("arith_intensity", 0.0)), balance)
         lines.append(f"machine balance {balance:.1f} flops/byte -> "
                      f"step is {step_bound}-bound")
+    # kernel MFU push (ISSUE 19): the two places step time hides from
+    # the matmul roofline — optimizer-region HBM traffic (now one fused
+    # Pallas pass per ZeRO chunk instead of 5-8 elementwise ops) and
+    # the MoE expert exchange (explicit all_to_all, charged into
+    # comm_bytes by the cost model)
+    moe_b = int(cost.get("moe_a2a_bytes", 0) or 0)
+    if moe_b:
+        comm_b = int(cost.get("comm_bytes", 0) or 1)
+        lines.append("")
+        lines.append("-- kernel MFU push --")
+        lines.append(
+            f"moe_a2a_bytes {_fmt_count(moe_b)} "
+            f"({100.0 * moe_b / comm_b:.1f}% of comm_bytes) — the "
+            f"explicit expert-parallel dispatch/combine exchange")
+        lines.append(
+            "fused optimizer: dispatch counters ride /metrics "
+            "(fused_opt.pallas / fused_opt.xla) and "
+            "`tools/dump_passes.py --fused-opt`")
     for field, title in (("top_flops", "top ops by model flops"),
                          ("top_bytes", "top ops by hbm bytes")):
         rows = cost.get(field) or []
