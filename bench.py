@@ -748,6 +748,144 @@ def _decode_probe(requests=12, workers=4):
     dense_tps = round(dense_toks / dense_best, 2)
     spec_tps = round(spec_toks / spec_best, 2)
 
+    # async-vs-sync tick race: dedicated twins — same model, same
+    # seed, same compiled executable (donation is mode-independent) —
+    # at a BATCHED operating point (8 concurrent streams). The async
+    # engine's steady-state tick feeds device-resident control vectors
+    # (token/position chains + cached page tables) straight back into
+    # the next dispatch, so its per-tick host work is O(1) in batch
+    # size; the sync tick rebuilds and re-uploads O(B) control vectors
+    # and blocks on the fetch every tick. Racing at batch 8 measures
+    # that structural gap instead of scheduler noise. Seven paired
+    # rounds, median verdict; greedy async is exact by construction,
+    # so outputs must match token for token (async_parity) and the
+    # tokens/sec delta is pure dispatch economics: the host consuming
+    # tick t while tick t+1 is already on device.
+    arace_workload = []
+    for i in range(8):
+        rrng = np.random.RandomState(2000 + i)
+        motif = [int(t) for t in rrng.randint(0, cfg.vocab_size, 4)]
+        n = race_plens[i % len(race_plens)]
+        arace_workload.append(((motif * ((n + 3) // 4))[:n], 104))
+    _prev_async = os.environ.get("PADDLE_ASYNC_DECODE")
+    try:
+        os.environ["PADDLE_ASYNC_DECODE"] = "1"
+        async_engine = DecodeEngine(cfg, seed=11, max_batch=8,
+                                    n_pages=128, page_size=page_size,
+                                    max_pages_per_seq=max_pages)
+        os.environ["PADDLE_ASYNC_DECODE"] = "0"
+        sync_engine = DecodeEngine(cfg, seed=11, max_batch=8,
+                                   n_pages=128, page_size=page_size,
+                                   max_pages_per_seq=max_pages)
+    finally:
+        if _prev_async is None:
+            os.environ.pop("PADDLE_ASYNC_DECODE", None)
+        else:
+            os.environ["PADDLE_ASYNC_DECODE"] = _prev_async
+    async_engine.warm()
+    sync_engine.warm()
+
+    def _race_outs(eng):
+        t0 = _time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=n)
+                   for p, n in arace_workload]
+        outs = [list(h.result(120)) for h in handles]
+        return outs, _time.perf_counter() - t0
+
+    async_engine.start()
+    sync_engine.start()
+    try:
+        async_outs, _ = _race_outs(async_engine)  # warmup + parity
+        sync_outs, _ = _race_outs(sync_engine)
+        async_toks = sum(len(o) for o in async_outs)
+        # PAIRED rounds, min verdict: rounds run in adjacent pairs
+        # (order alternates so periodic ambient load can't phase-lock
+        # onto one mode) and each leg is scored by its FASTEST round.
+        # Ambient load on a shared box only ever ADDS time, so the min
+        # over nine rounds is the closest estimate of each mode's
+        # structural cost — a median still eats the bias when a churned
+        # box (post-suite page-cache/reclaim pressure) keeps half the
+        # rounds noisy, which is exactly the environment the tier-1
+        # contract run creates.
+        async_times, sync_times = [], []
+        for pair in range(9):
+            if pair % 2:
+                _, dt = _race_outs(sync_engine)
+                sync_times.append(dt)
+                _, dt = _race_outs(async_engine)
+                async_times.append(dt)
+            else:
+                _, dt = _race_outs(async_engine)
+                async_times.append(dt)
+                _, dt = _race_outs(sync_engine)
+                sync_times.append(dt)
+    finally:
+        async_engine.drain(timeout=60)
+        sync_engine.drain(timeout=60)
+    async_best = min(async_times)
+    sync_best = min(sync_times)
+    async_tps = round(async_toks / async_best, 2)
+    sync_tps = round(async_toks / sync_best, 2)
+    async_wins = sum(1 for a, s in zip(async_times, sync_times)
+                     if a < s)
+    async_parity = bool(async_outs == sync_outs)
+    overlap_frac = float(
+        async_engine.counters.get("decode_overlap_frac", 0.0))
+
+    # host KV offload leg: an engine whose HBM pool is SMALLER than the
+    # concurrent sessions' page demand, with a host-RAM tier to absorb
+    # it — under growth pressure the coldest session parks (pages spill
+    # d2h as int8 rows) instead of preempt-requeuing, and resumes with
+    # its KV restored. A big-pool twin provides the greedy oracle:
+    # park/resume must be invisible in the tokens.
+    off_plens, off_new = (17, 19, 17, 21, 17, 19), 27
+    off_prompts = []
+    for i in range(6):
+        orng = np.random.RandomState(3000 + i)
+        off_prompts.append([int(t) for t in orng.randint(
+            0, cfg.vocab_size, off_plens[i])])
+    ref_engine = DecodeEngine(cfg, seed=11, max_batch=4, n_pages=64,
+                              page_size=page_size, max_pages_per_seq=3)
+    ref_engine.warm()
+    ref_engine.start()
+    try:
+        ref_outs = [list(ref_engine.submit(
+            p, max_new_tokens=off_new).result(120))
+            for p in off_prompts]
+    finally:
+        ref_engine.drain(timeout=60)
+    off_engine = DecodeEngine(cfg, seed=11, max_batch=4, n_pages=11,
+                              page_size=page_size, max_pages_per_seq=3,
+                              host_kv_bytes=1 << 22)
+    off_engine.warm()
+    off_handles = [off_engine.submit(p, max_new_tokens=off_new)
+                   for p in off_prompts]
+    peak_host_pages = 0
+    deadline = _time.perf_counter() + 120
+    while any(not h.done() for h in off_handles):
+        off_engine.run_once()
+        peak_host_pages = max(peak_host_pages,
+                              off_engine._offload.pages_host)
+        if _time.perf_counter() > deadline:
+            break
+    off_outs = [list(h.result(10)) for h in off_handles]
+    off_ec = off_engine.counters
+    off_engine.stop()
+    kv_offload_parity = bool(off_outs == ref_outs)
+    # concurrent session page demand the pool served vs its HBM
+    # capacity: > 1.0 means the host tier held sessions HBM never could
+    kv_sessions_per_pool_x = round(
+        (off_engine.pool.peak_pages_in_use + peak_host_pages)
+        / max(1, off_engine.pool.capacity), 2)
+    # host-tier encoding economics: int8 rows + f32 scales vs the raw
+    # f32 page bytes the device pool holds (cost-model closed form)
+    from paddle_tpu.static.cost_model import kv_offload_page_bytes
+    raw_page = 2 * cfg.n_layers * page_size * cfg.n_heads \
+        * cfg.head_dim * 4
+    kv_offload_bytes_saved_pct = round(
+        100.0 * (1.0 - kv_offload_page_bytes(cfg, page_size)
+                 / raw_page), 2)
+
     # int8 KV quant-loss probe: the SAME paged attention read over an
     # f32 pool vs its int8-encoded twin (per-token-row scales, dequant
     # inside the gather). The max-abs attention-output delta is the
@@ -812,6 +950,24 @@ def _decode_probe(requests=12, workers=4):
         "kv_pool_headroom_x": kv_pool_headroom_x,
         "kv_prefix_hits": kv_prefix_hits,
         "kv_prefix_parity": bool(px_a == px_b),
+        # overlapped decode data plane: async double-buffered ticks
+        # vs the per-tick host fetch, byte-identical greedy outputs
+        "async_tokens_per_sec": async_tps,
+        "sync_tokens_per_sec": sync_tps,
+        "async_parity": async_parity,
+        "async_beats_sync": bool(async_best < sync_best),
+        "async_round_wins": f"{async_wins}/9",
+        "decode_overlap_frac": round(overlap_frac, 4),
+        # host-RAM KV offload tier: sessions the pool could never hold
+        # concurrently, parked and restored with bitwise outputs
+        "kv_sessions_per_pool_x": kv_sessions_per_pool_x,
+        "kv_offload_parity": kv_offload_parity,
+        "kv_offload_bytes_saved_pct": kv_offload_bytes_saved_pct,
+        "kv_offload_bytes": int(off_ec.get("kv_offload_bytes", 0)),
+        "kv_sessions_parked": int(off_ec.get("kv_sessions_parked", 0)),
+        "kv_sessions_resumed":
+            int(off_ec.get("kv_sessions_resumed", 0)),
+        "kv_page_restores": int(off_ec.get("kv_page_restores", 0)),
         # engine-side latency truth: bucket-derived percentiles from
         # the decode_e2e_ms / decode_step_ms histograms (PR 9 plane)
         "decode_engine_p50_ms": summary["engine_p50_ms"],

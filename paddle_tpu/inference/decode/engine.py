@@ -22,10 +22,36 @@ and the engine commits params with megatron-style NamedShardings and
 the pool sharded over heads; GSPMD partitions the compiled steps —
 outputs are parity-gated against the unsharded engine in tests.
 
+ASYNC TICK PIPELINING (default for greedy non-spec engines;
+``PADDLE_ASYNC_DECODE=0`` is the bitwise sync escape): the sampled
+token array stays DEVICE-RESIDENT and feeds the next compiled step
+directly — a ``jnp.where`` splices host-injected tokens (fresh
+prefills, resumes) over the previous tick's output chain, and the
+spliced buffer is DONATED alongside the KV pool. Tick ``t+1`` is
+dispatched before tick ``t``'s tokens are fetched, so the host phase
+(EOS checks, admission, page growth, detokenization) overlaps device
+compute; the host consumes tokens at depth-1 lag. At EOS exactly one
+speculative extra token is discarded (its page headroom was
+pre-allocated); before any preemption/park/reset the in-flight tick is
+drained, so greedy outputs stay bitwise identical to the sync engine.
+``decode_tick_phase_ms{phase=dispatch|host|fetch}`` histograms split
+the tick wall and ``decode_overlap_frac`` gauges the hidden fraction.
+
+HOST KV OFFLOAD TIER (``host_kv_bytes > 0``): a
+:class:`~.kv_cache.HostKVPool` extends the pool below HBM — under
+pool pressure the scheduler PARKS the coldest slot (pages encoded
+int8 per token row, the ps/codec layout disagg ships on the wire)
+instead of preempt-requeuing, LRU-reclaimed prefix pages spill
+through ``spill_sink``, and parked sessions resume via a background
+h2d prefetcher (typed ``KVRestoreError`` falls back to a synchronous
+restore). int8 pools offload VERBATIM, so park → resume is bitwise.
+
 Observability: ``decode_prefill_ms`` / ``decode_step_ms`` /
-``decode_e2e_ms`` histograms (dual-recorded: per-engine + the global
-/metrics registry), ``decode_batch_fill_pct`` / ``kv_pages_in_use`` /
-``kv_page_evictions`` gauges, and per-step cost gauges
+``decode_e2e_ms`` / ``kv_restore_wait_ms`` histograms (dual-recorded:
+per-engine + the global /metrics registry), ``decode_batch_fill_pct``
+/ ``kv_pages_in_use`` / ``kv_page_evictions`` / ``kv_pages_host`` /
+``decode_overlap_frac`` gauges, ``kv_offload_bytes`` /
+``kv_page_restores`` counters, and per-step cost gauges
 (``step_model_flops`` / ``mfu`` / ``arith_intensity``) from
 ``cost_model.paged_decode_cost`` — gathered LIVE pages, not the pool.
 """
@@ -40,8 +66,10 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ...observability import tracing
-from ..serving import DeadlineExceeded, RequestFailed, _DualHist
-from .kv_cache import PageTableManager, alloc_kv_pool, alloc_kv_scales
+from ..serving import (DeadlineExceeded, KVRestoreError, RequestFailed,
+                       _DualHist)
+from .kv_cache import (HostKVPool, PageTableManager, _chain_keys,
+                       alloc_kv_pool, alloc_kv_scales)
 from .model import (DecodeModelConfig, decode_forward, init_decode_params,
                     kv_pool_spec, param_shardings, prefill_forward,
                     spec_decode_forward)
@@ -49,6 +77,90 @@ from .scheduler import DecodeRequest, DecodeScheduler, RunningSeq
 from .spec import NgramProposer
 
 __all__ = ["DecodeEngine"]
+
+
+class _RestorePrefetcher:
+    """Background h2d restore staging: parked sessions' encoded pages
+    are decoded (int8 → pool rows) off the scheduler thread the moment
+    they park, so a resume usually finds its arrays READY and pays only
+    the device writes. ``take`` raises the typed
+    :class:`KVRestoreError` when the worker died or staging failed —
+    the engine counts ``kv_restore_fallbacks`` and decodes inline
+    (correctness never depends on the prefetcher)."""
+
+    def __init__(self, decode_fn):
+        self._decode = decode_fn
+        self._lock = threading.Lock()
+        self._staged: Dict[int, dict] = {}
+        self._queue: deque = deque()
+        self._wake = threading.Event()
+        self._alive = True
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="kv-restore-prefetch")
+        self._thread.start()
+
+    def request(self, key: int, records) -> None:
+        """Idempotently stage a parked session's decode."""
+        with self._lock:
+            if key in self._staged:
+                return
+            self._staged[key] = {"ready": threading.Event(),
+                                 "arrays": None, "error": None}
+            self._queue.append((key, list(records)))
+        self._wake.set()
+
+    def _run(self) -> None:
+        while self._alive:
+            if not self._wake.wait(timeout=0.1):
+                continue
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        break
+                    key, records = self._queue.popleft()
+                    ent = self._staged.get(key)
+                if ent is None:
+                    continue   # discarded while queued
+                try:
+                    ent["arrays"] = [self._decode(r) for r in records]
+                except BaseException as e:
+                    ent["error"] = e
+                ent["ready"].set()
+
+    def take(self, key: int, timeout: float = 2.0):
+        """The staged arrays for ``key`` (waits for an in-progress
+        decode); raises :class:`KVRestoreError` when nothing was
+        staged, the worker died, staging failed, or the wait timed
+        out."""
+        with self._lock:
+            ent = self._staged.get(key)
+        if ent is None:
+            raise KVRestoreError(
+                f"no staged restore for parked session {key}")
+        if not ent["ready"].is_set() and not self._thread.is_alive():
+            raise KVRestoreError(
+                "restore prefetcher thread died; falling back to "
+                "synchronous h2d")
+        if not ent["ready"].wait(timeout):
+            raise KVRestoreError(
+                f"restore staging for session {key} timed out "
+                f"after {timeout}s")
+        with self._lock:
+            self._staged.pop(key, None)
+        if ent["error"] is not None:
+            raise KVRestoreError(
+                f"restore staging failed: "
+                f"{type(ent['error']).__name__}: {ent['error']}")
+        return ent["arrays"]
+
+    def discard(self, key: int) -> None:
+        with self._lock:
+            self._staged.pop(key, None)
+
+    def stop(self) -> None:
+        self._alive = False
+        self._wake.set()
 
 
 def _next_pow2(n: int) -> int:
@@ -71,6 +183,11 @@ class DecodeEngine:
                          stored int8 with per-token-row f32 scales
                          (ps/codec layout), dequant inside attention;
                          ~4x sequences per pool byte
+    host_kv_bytes        host-RAM KV offload tier budget in bytes
+                         (0 = off): under pool pressure the coldest
+                         slot PARKS its pages to host RAM (int8 rows)
+                         instead of preempt-requeuing, and reclaimed
+                         prefix-cache pages spill there too
     spec_k               speculative drafts per slot per tick (0 = off;
                          ``PADDLE_SPEC_DECODE=0`` pins it off) — drafts
                          from ``proposer`` (default: n-gram prompt
@@ -97,6 +214,7 @@ class DecodeEngine:
                  eos_id: Optional[int] = None,
                  dtype: str = "float32",
                  kv_codec: str = "off",
+                 host_kv_bytes: int = 0,
                  spec_k: int = 0, proposer=None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, sample_seed: int = 0,
@@ -179,6 +297,36 @@ class DecodeEngine:
             self._k_scales, self._v_scales = alloc_kv_scales(
                 config.n_layers, n_pages, page_size)
 
+        # -- async tick pipelining ----------------------------------------
+        # on by default for greedy non-spec single-mesh engines; the
+        # escape env pins the synchronous tick (bitwise-identical
+        # outputs either way — the same compiled executable runs, only
+        # the host-side fetch timing moves). Sampling stays sync (an
+        # extra speculative tick at EOS would consume Gumbel noise and
+        # shift every later slot's stream); TP stays sync (the chained
+        # token buffer would need the executable's output sharding).
+        env_async = os.environ.get("PADDLE_ASYNC_DECODE", "").strip()
+        self._async_decode = (env_async != "0"
+                              and self._temperature == 0
+                              and self.mesh is None)
+        self._inflight: Optional[dict] = None   # the depth-1 lagged tick
+        self._chain = None   # device (B,) tokens from the last dispatch
+        self._ctl = None     # last rebuild tick's control vectors
+        self._pos_chain = None   # device (B,) next positions (step out)
+        self._steady_sig = None  # (slot set, pool mutation epoch)
+        self._tab_dev = None     # device table/mask for steady ticks
+        self._mask_dev = None
+
+        # -- host KV offload tier -----------------------------------------
+        self._offload: Optional[HostKVPool] = None
+        self._prefetch: Optional[_RestorePrefetcher] = None
+        if int(host_kv_bytes) > 0:
+            self._offload = HostKVPool(
+                config.n_layers, page_size, config.n_heads,
+                config.head_dim, int(host_kv_bytes))
+            self.pool.spill_sink = self._spill_prefix_page
+            self._prefetch = _RestorePrefetcher(self._decode_record)
+
         # -- compiled steps (substrate) -----------------------------------
         self._decode_step = None
         self._spec_step = None
@@ -194,6 +342,12 @@ class DecodeEngine:
         self._h_prefill = _DualHist("decode_prefill_ms", self._hist_reg)
         self._h_step = _DualHist("decode_step_ms", self._hist_reg)
         self._h_e2e = _DualHist("decode_e2e_ms", self._hist_reg)
+        self._h_restore = _DualHist("kv_restore_wait_ms", self._hist_reg)
+        # tick phase split (dispatch / host / fetch) feeding the
+        # decode_overlap_frac gauge: overlap = 1 - fetch/total — the
+        # share of the tick wall NOT spent blocked on the device
+        self._phase_h = None
+        self._phase_ms = {"dispatch": 0.0, "host": 0.0, "fetch": 0.0}
 
         # -- scheduler thread ----------------------------------------------
         self._running = False
@@ -245,7 +399,89 @@ class DecodeEngine:
             self._k_pages, self._v_pages = pools
 
     def _pool_donate(self) -> tuple:
+        # pool planes only — the tokens input is NOT donated, so the
+        # async pipeline can pass the previous tick's device-resident
+        # out[0] straight back in while the lagged harvest still holds
+        # a fetchable reference to it
         return (1, 2, 3, 4) if self._k_scales is not None else (1, 2)
+
+    # -- tick phase accounting --------------------------------------------
+    def _phase_hist(self):
+        if self._phase_h is None:
+            from ...observability.metrics import default_registry
+
+            self._phase_h = default_registry().histogram(
+                "decode_tick_phase_ms", labels=("phase",))
+        return self._phase_h
+
+    def _note_phases(self, dispatch_ms: float, host_ms: float,
+                     fetch_ms: float) -> None:
+        hist = self._phase_hist()
+        hist.observe(dispatch_ms, phase="dispatch")
+        hist.observe(host_ms, phase="host")
+        hist.observe(fetch_ms, phase="fetch")
+        with self._stats_lock:
+            self._phase_ms["dispatch"] += dispatch_ms
+            self._phase_ms["host"] += host_ms
+            self._phase_ms["fetch"] += fetch_ms
+            tot = sum(self._phase_ms.values())
+            frac = 0.0 if tot <= 0 else round(
+                (tot - self._phase_ms["fetch"]) / tot, 4)
+        self._gauge("decode_overlap_frac", frac)
+
+    # -- host-tier page plumbing ------------------------------------------
+    def _fetch_page_record(self, page: int) -> tuple:
+        """d2h snapshot of one pool page as the host-tier record
+        ``(kq, ks, vq, vs)`` — int8 pools copy VERBATIM (their planes
+        already carry the per-row codec layout, so park → resume is
+        bitwise); f32 pools pay one deterministic per-row quantization
+        (the same rounding rule disagg ships on the wire)."""
+        if self._kv_codec == "int8":
+            return (np.asarray(self._k_pages[:, page]),
+                    np.asarray(self._k_scales[:, page]),
+                    np.asarray(self._v_pages[:, page]),
+                    np.asarray(self._v_scales[:, page]))
+        from ...serving.disagg import quantize_rows
+
+        kq, ks = quantize_rows(
+            np.asarray(self._k_pages[:, page], np.float32))
+        vq, vs = quantize_rows(
+            np.asarray(self._v_pages[:, page], np.float32))
+        return (kq, ks, vq, vs)
+
+    def _decode_record(self, rec: tuple) -> tuple:
+        """Host-side decode of one record into write-ready arrays —
+        the prefetcher runs this off-thread so a resume pays only the
+        device writes."""
+        if self._kv_codec == "int8":
+            return rec   # the pool IS the encoded layout
+        kq, ks, vq, vs = rec
+        return ((kq.astype(np.float32) * ks[:, :, None, None]),
+                (vq.astype(np.float32) * vs[:, :, None, None]))
+
+    def _write_page_arrays(self, page: int, arrays: tuple) -> None:
+        if self._kv_codec == "int8":
+            kq, ks, vq, vs = arrays
+            self._k_pages = self._k_pages.at[:, page].set(kq)
+            self._v_pages = self._v_pages.at[:, page].set(vq)
+            self._k_scales = self._k_scales.at[:, page].set(ks)
+            self._v_scales = self._v_scales.at[:, page].set(vs)
+        else:
+            kf, vf = arrays
+            dt = self._k_pages.dtype
+            self._k_pages = self._k_pages.at[:, page].set(kf.astype(dt))
+            self._v_pages = self._v_pages.at[:, page].set(vf.astype(dt))
+
+    def _spill_prefix_page(self, page: int, key: bytes) -> None:
+        """``spill_sink``: the allocator is reclaiming an indexed
+        cached page — snapshot its rows into the host prefix LRU so a
+        later prefill can revive it instead of recomputing."""
+        if self._offload is None:
+            return
+        rec = self._fetch_page_record(page)
+        if self._offload.put_prefix(key, rec):
+            self._count("kv_offload_bytes", self._offload.page_nbytes)
+            self._gauge("kv_pages_host", self._offload.pages_host)
 
     @property
     def counters(self) -> Dict[str, int]:
@@ -261,6 +497,9 @@ class DecodeEngine:
         out["kv_pages_shared"] = self.pool.pages_shared
         out["kv_pages_cached"] = self.pool.pages_cached
         out["kv_prefix_hits"] = self.pool.prefix_hits
+        if self._offload is not None:
+            out["kv_pages_host"] = self._offload.pages_host
+            out["kv_pages_parked"] = self.pool.parked_pages
         snap = profiler.counters_snapshot()
         for name in profiler.FAULT_COUNTER_NAMES:
             if name in snap:
@@ -276,6 +515,10 @@ class DecodeEngine:
         snap["kv_codec"] = self._kv_codec
         snap["spec_k"] = self._spec_k
         snap["max_batch"] = self.max_batch
+        snap["async_decode"] = self._async_decode
+        if self._offload is not None:
+            snap["host_tier"] = self._offload.snapshot()
+            snap["host_tier"]["parked_sessions"] = len(self.sched.parked)
         with self._stats_lock:
             snap["counters"] = {
                 k: v for k, v in sorted(self._counters.items())
@@ -286,7 +529,7 @@ class DecodeEngine:
         """Bucket-derived engine-side percentiles — what a /metrics
         scraper can recompute from decode_e2e_ms / decode_step_ms /
         decode_prefill_ms."""
-        return {
+        out = {
             "n": int(self._h_e2e.snapshot()["count"]),
             "e2e_p50_ms": round(self._h_e2e.percentile(50), 3),
             "e2e_p99_ms": round(self._h_e2e.percentile(99), 3),
@@ -295,6 +538,10 @@ class DecodeEngine:
             "prefill_p50_ms": round(self._h_prefill.percentile(50), 3),
             "prefill_p99_ms": round(self._h_prefill.percentile(99), 3),
         }
+        if self._offload is not None:
+            out["restore_wait_p99_ms"] = round(
+                self._h_restore.percentile(99), 3)
+        return out
 
     # -- compiled-step builds ---------------------------------------------
     def _build_decode_step(self):
@@ -321,7 +568,11 @@ class DecodeEngine:
             head = out[0]
             if sampling:   # rest[5] is the host-generated Gumbel noise
                 head = fused_sample(head, rest[5], temp, tk, tp)
-            return (head,) + tuple(out[1:])
+            # trailing output: next-tick positions, computed on device
+            # so a steady-state async tick can chain positions/lens
+            # (and the token chain) without uploading a single host
+            # array — the engine feeds this straight back in
+            return (head,) + tuple(out[1:]) + (positions + 1,)
 
         zi = np.zeros((B,), np.int32)
         args = (self.params,) + self._pool_args() + (
@@ -566,6 +817,28 @@ class DecodeEngine:
                 done.set()
             work += 1
         work += len(self.sched.expire_queued(now))
+        if self._offload is not None:
+            for pk in self.sched.expire_parked(now):
+                self._offload.drop_seq(pk.host_key)
+                if self._prefetch is not None:
+                    self._prefetch.discard(pk.host_key)
+                self._gauge("kv_pages_host", self._offload.pages_host)
+                work += 1
+            work += self._resume_parked()
+            # admission-driven parking: the queue head can't fit but a
+            # slot is free — park the coldest running session to make
+            # page room (skipped while resumes are themselves waiting,
+            # so park-to-admit never thrashes against park-to-resume)
+            if not self.sched.parked:
+                with self.sched.lock:
+                    head = self.sched.queue[0] if self.sched.queue \
+                        else None
+                    slot_free = len(self.sched.slots) < self.max_batch
+                if head is not None and slot_free and not \
+                        self.pool.can_fit(len(head.prompt)
+                                          + len(head.generated)):
+                    if self._try_park():
+                        work += 1
         while True:
             req = self.sched.pop_for_prefill()
             if req is None:
@@ -574,6 +847,11 @@ class DecodeEngine:
         active = self.sched.active()
         if active:
             work += self._decode_once(active)
+        elif self._inflight is not None:
+            # every in-flight slot already finished (EOS harvest): the
+            # lagged tick carries only discards, but it must still be
+            # consumed so phase accounting and the chain stay coherent
+            work += 1 + self._drain_inflight()
         return work
 
     def _finish(self, slot_id: Optional[int], rs_or_req, error=None):
@@ -633,7 +911,11 @@ class DecodeEngine:
         S = self.pool.page_size
         # prefix cache: the longest indexed full-page chain of this
         # context is SHARED (refcounted, zero new pages), capped so at
-        # least one suffix token remains to produce the next logits
+        # least one suffix token remains to produce the next logits —
+        # with a host tier, spilled pages revive h2d first so the
+        # match sees them
+        if self._offload is not None:
+            self._revive_host_prefix(ctx_tokens, (ctx - 1) // S)
         shared = self.pool.match_prefix(ctx_tokens, limit=(ctx - 1) // S)
         npages = min(_next_pow2(self.pool.pages_for_tokens(ctx)),
                      self.pool.max_pages_per_seq)
@@ -722,6 +1004,14 @@ class DecodeEngine:
         deleted'. Preempt every running sequence onto the queue (their
         emitted tokens ride the re-prefill, so greedy outputs are
         preserved) and re-allocate a zeroed pool."""
+        fl, self._inflight = self._inflight, None
+        self._chain = None
+        self._pos_chain = None
+        self._steady_sig = None
+        if fl is not None:
+            # the chain the in-flight tick wrote is being thrown away;
+            # its slots requeue below and re-prefill their full context
+            self._abort_inflight(fl)
         while self.sched.preempt_youngest() is not None:
             pass
         kv_sharding = kv_pool_spec(self.mesh) \
@@ -763,14 +1053,19 @@ class DecodeEngine:
                     self._v_scales[:, src])
 
     def _decode_once(self, active: Dict[int, RunningSeq]) -> int:
-        # grow page tables for this step's writes; pool pressure
-        # preempts the youngest slot (requeued, outputs preserved)
+        if self._async_decode and self._spec_k == 0:
+            return self._decode_once_async(active)
+        # grow page tables for this step's writes; pool pressure parks
+        # the coldest slot into the host tier when one is attached,
+        # else preempts the youngest (requeued, outputs preserved)
         for slot_id in sorted(active):
             rs = active[slot_id]
             if slot_id not in self.sched.slots:
                 continue   # preempted below while we iterated
             self._maybe_cow(rs)
             while self.pool.append_token(rs.seq_id, rs.length + 1) == -1:
+                if self._try_park(exclude=rs.req):
+                    continue
                 victim = self.sched.preempt_youngest()
                 if victim is None or victim is rs.req:
                     break
@@ -782,6 +1077,7 @@ class DecodeEngine:
         if self._decode_step is None:
             self._decode_step = self._build_decode_step()
         B, T = self.max_batch, self.pool.max_pages_per_seq
+        t_build0 = time.perf_counter()
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
         lens = np.zeros((B,), np.int32)
@@ -811,7 +1107,8 @@ class DecodeEngine:
             with tspan.activate():
                 out = self._decode_step(*step_args)
                 nxt = np.asarray(out[0])  # device sync: step really ran
-                self._store_pools(out[1:])
+                self._store_pools(out[1:-1])  # [-1] is the position
+                # chain, only consumed by the async tick
         except Exception as e:
             tspan.fail(e)
             # no silent hang: every live request fails TYPED (the
@@ -840,6 +1137,7 @@ class DecodeEngine:
             [rs.length + 1 for rs in active.values()], step_s)
         now = self._clock()
         emitted = 0
+        t_h0 = time.perf_counter()
         for slot_id, rs in active.items():
             rs.length += 1
             tok = int(nxt[slot_id])
@@ -852,7 +1150,421 @@ class DecodeEngine:
                     "deadline passed mid-generation; sequence dropped"))
             elif self._req_done(rs.req):
                 self._finish(slot_id, rs)
+        # sync tick: the whole step wall is a blocked device fetch
+        self._note_phases((t0 - t_build0) * 1e3,
+                          (time.perf_counter() - t_h0) * 1e3,
+                          step_s * 1e3)
         return emitted
+
+    # -- the async tick -----------------------------------------------------
+    def _budget_done(self, rs: RunningSeq) -> bool:
+        """True when harvested + in-flight tokens already cover the
+        request's budget — dispatching more would overrun
+        ``max_new_tokens`` (EOS, unknowable ahead of the lagged fetch,
+        is instead handled by discarding one in-flight token)."""
+        return len(rs.req.generated) + rs.pending \
+            >= rs.req.max_new_tokens
+
+    def _decode_once_async(self, active: Dict[int, RunningSeq]) -> int:
+        """One pipelined tick: dispatch tick ``t+1`` against the
+        device-resident token chain BEFORE fetching tick ``t``'s
+        tokens, then harvest ``t`` at depth-1 lag. Page growth happens
+        at dispatch (headroom pre-allocated, so a page-boundary write
+        never waits on the lagged token); any state surgery — park,
+        preempt, pool reset — drains the in-flight tick first, which
+        is what keeps greedy outputs bitwise equal to the sync
+        engine's."""
+        work = 0
+        for slot_id in sorted(active):
+            rs = active[slot_id]
+            if slot_id not in self.sched.slots \
+                    or rs.req.handle.done() or self._budget_done(rs):
+                continue
+            self._maybe_cow(rs)
+            while slot_id in self.sched.slots and \
+                    self.pool.append_token(rs.seq_id, rs.length + 1) == -1:
+                if self._inflight is not None:
+                    # harvesting may finish slots and free their pages
+                    work += self._drain_inflight()
+                    if rs.req.handle.done() \
+                            or slot_id not in self.sched.slots:
+                        break
+                    continue
+                if self._try_park(exclude=rs.req):
+                    continue
+                victim = self.sched.preempt_youngest()
+                if victim is None or victim is rs.req:
+                    break
+        # re-derive eligibility by filtering the tick's own view: the
+        # growth loop above may have finished slots (drained harvest),
+        # parked or preempted — all of which REMOVE slots, never add —
+        # so a slots.get identity check is complete and skips a second
+        # lock-and-rebuild of the active dict on the hot path
+        elig = {sid: rs for sid, rs in sorted(active.items())
+                if self.sched.slots.get(sid) is rs
+                and not rs.req.handle.done()
+                and not self._budget_done(rs)}
+        prev, self._inflight = self._inflight, None
+        if elig:
+            if self._decode_step is None:
+                self._decode_step = self._build_decode_step()
+            import jax.numpy as jnp
+
+            B, T = self.max_batch, self.pool.max_pages_per_seq
+            t_build0 = time.perf_counter()
+            # steady-state signature: same slot set as the previous
+            # dispatch AND no page-table mutation since. When it holds,
+            # every control vector is derivable on device — tokens from
+            # the chain, positions/lens from the step's own positions+1
+            # output, table/mask byte-identical to last tick — so the
+            # tick uploads NOTHING and rebuilds nothing.
+            sig = (tuple(elig), self.pool.mutations)
+            steady = (self._chain is not None
+                      and self._pos_chain is not None
+                      and sig == self._steady_sig)
+            if steady:
+                if self._tab_dev is None:
+                    # first steady tick after a table change: commit the
+                    # (already-correct) host table/mask once; later
+                    # steady ticks reuse the device copies outright
+                    self._tab_dev = jnp.asarray(self._ctl[4])
+                    self._mask_dev = jnp.asarray(self._ctl[5])
+                tokens = self._chain
+                positions = lens = self._pos_chain
+                table, mask = self._tab_dev, self._mask_dev
+            else:
+                # FRESH control buffers every rebuild tick — never a
+                # memset-refill of shared ones. The dispatch only
+                # ENQUEUES the host->device copy of numpy args (PJRT's
+                # immutable-until-transfer-completes contract): the
+                # caller must not touch the memory until the transfer
+                # lands, and with a depth-1 in-flight tick the next
+                # rebuild would scribble these exact bytes while a
+                # cold device queue is still draining the copy.
+                # Rebuild ticks are the minority (any table mutation or
+                # slot-set change); six small allocations are noise
+                # next to the dispatch itself.
+                inject = np.zeros((B,), np.int32)
+                inj_mask = np.zeros((B,), np.bool_)
+                positions = np.zeros((B,), np.int32)
+                lens = np.zeros((B,), np.int32)
+                table = np.full((B, T), -1, np.int32)
+                mask = np.zeros((B,), np.bool_)
+                self._ctl = (inject, inj_mask, positions, lens,
+                             table, mask)
+                self._tab_dev = self._mask_dev = None
+                n_inj = 0
+                for slot_id, rs in elig.items():
+                    if not rs.fed:
+                        # host injection: fresh prefill / resumed
+                        # session — the chain doesn't hold this slot's
+                        # next input
+                        inject[slot_id] = rs.next_token
+                        inj_mask[slot_id] = True
+                        n_inj += 1
+                    positions[slot_id] = rs.length
+                    lens[slot_id] = rs.length
+                    table[slot_id] = self.pool.table_row(rs.seq_id)
+                    mask[slot_id] = True
+                if self._chain is None or n_inj == len(elig):
+                    tokens = inject
+                elif n_inj == 0:
+                    # the previous tick's sampled tokens feed the step
+                    # as a plain device-resident input — the tokens arg
+                    # is never donated, so the lagged harvest can still
+                    # fetch it
+                    tokens = self._chain
+                else:
+                    # mixed tick: a prefill/resume joined while other
+                    # slots chain — merge on device, leaving the chain
+                    # input itself untouched for the pending harvest
+                    tokens = jnp.where(jnp.asarray(inj_mask),
+                                       jnp.asarray(inject), self._chain)
+            # per-tick spans only when a step-trace sink is recording:
+            # the async tick is latency-critical host code, and span
+            # construction (ids, attr dicts, sorted slot lists) is
+            # measurable against a sub-millisecond dispatch
+            tspan = None
+            if tracing.trace_enabled():
+                tspan = tracing.Span(
+                    "decode.tick", parent=False, clock=self._clock,
+                    slots=sorted(elig), async_depth=1, steady=steady,
+                    requests=[rs.req.trace_hex() for _, rs in sorted(
+                        elig.items()) if rs.req.span is not None])
+            t0 = time.perf_counter()
+            try:
+                # the call ENQUEUES the tick and returns — jax's
+                # dispatch is async even with donation, so the device
+                # computes while this thread emits the lagged harvest
+                # below and the scheduler admits/builds the next tick.
+                # The blocking device->host fetch is deferred to the
+                # NEXT tick's harvest; that depth-1 lag is the whole
+                # pipeline.
+                if tspan is None:
+                    out = self._decode_step(
+                        self.params, *self._pool_args(), tokens,
+                        positions, table, lens, mask)
+                else:
+                    with tspan.activate():
+                        out = self._decode_step(
+                            self.params, *self._pool_args(), tokens,
+                            positions, table, lens, mask)
+            except Exception as e:
+                # dispatch-time failure (bad shapes, deleted buffers):
+                # surfaces here rather than at the fetch
+                if tspan is not None:
+                    tspan.fail(e)
+                self._chain = None
+                self._pos_chain = None
+                self._steady_sig = None
+                for slot_id, rs in elig.items():
+                    self._count("decode_failed")
+                    self._finish(
+                        slot_id if self.sched.slots.get(slot_id) is rs
+                        else None, rs,
+                        error=RequestFailed(
+                            f"decode step dispatch failed: "
+                            f"{type(e).__name__}: {e}"))
+                if prev is not None:
+                    self._abort_inflight(prev)
+                self._reset_pool()
+                return work + len(elig)
+            # the superseded device handles retire at HARVEST, not
+            # here: the old pools were just donated into the in-flight
+            # step and the old chain feeds it, and dropping the LAST
+            # Python reference to such a buffer blocks until the
+            # consuming computation completes (the destructor waits
+            # out the buffer's pending events) — an invisible
+            # synchronization that would serialize the pipeline every
+            # tick. Parking them on the inflight record keeps the
+            # destructors where the fetch has already paid the wait.
+            retire = (self._chain, self._pos_chain) + self._pool_args()
+            self._chain = out[0]
+            if self._k_scales is not None:
+                self._k_pages, self._v_pages = out[1], out[2]
+                self._k_scales, self._v_scales = out[3], out[4]
+            else:
+                self._k_pages, self._v_pages = out[1], out[2]
+            self._pos_chain = out[-1]
+            self._steady_sig = sig
+            dispatch_ms = (time.perf_counter() - t_build0) * 1e3
+            self._inflight = {
+                "tokens": out[0], "plan": list(elig.items()),
+                "span": tspan, "t0": t0, "dispatch_ms": dispatch_ms,
+                "retire": retire,
+                "lens": [rs.length + 1 for rs in elig.values()]}
+            for _, rs in elig.items():
+                rs.length += 1    # optimistic: the write is in flight
+                rs.pending += 1
+                rs.fed = True
+            self._count("decode_steps")
+            with self._stats_lock:
+                self._fill_rows += len(elig)
+                self._fill_capacity += B
+                fill = round(100.0 * self._fill_rows
+                             / max(1, self._fill_capacity), 2)
+            self._gauge("decode_batch_fill_pct", fill)
+            work += len(elig)
+        if prev is not None:
+            work += self._harvest(prev)
+        return work
+
+    def _harvest(self, fl: dict) -> int:
+        """Consume one lagged tick: fetch its device tokens (the only
+        blocking point of the pipeline), emit them, finish EOS/budget/
+        deadline slots. A slot finished by an EARLIER harvest discards
+        its token — the one speculative extra the EOS lag costs."""
+        t_f0 = time.perf_counter()
+        try:
+            # the actual wait-for-device + readback; deferred XLA
+            # runtime errors surface here too
+            nxt = np.asarray(fl["tokens"])
+        except Exception as e:
+            fl["retire"] = None
+            # async dispatch surfaces runtime failures at the fetch:
+            # same typed-fail + pool-rebuild posture as the sync path
+            if fl["span"] is not None:
+                fl["span"].fail(e)
+            self._chain = None
+            self._pos_chain = None
+            self._steady_sig = None
+            n = 0
+            for slot_id, rs in fl["plan"]:
+                rs.pending -= 1
+                if rs.req.handle.done():
+                    continue
+                self._count("decode_failed")
+                self._finish(
+                    slot_id if self.sched.slots.get(slot_id) is rs
+                    else None, rs,
+                    error=RequestFailed(
+                        f"decode step dispatch failed: "
+                        f"{type(e).__name__}: {e}"))
+                n += 1
+            self._reset_pool()
+            return n
+        # the tick is complete: the retired handles' events are
+        # resolved, so their destructors are free now
+        fl["retire"] = None
+        fetch_ms = (time.perf_counter() - t_f0) * 1e3
+        step_ms = (time.perf_counter() - fl["t0"]) * 1e3
+        if fl["span"] is not None:
+            fl["span"].end()
+        self._h_step.observe(step_ms)
+        self._publish_cost(fl["lens"], step_ms / 1e3)
+        now = self._clock()
+        emitted = 0
+        t_h0 = time.perf_counter()
+        for slot_id, rs in fl["plan"]:
+            rs.pending -= 1
+            if rs.req.handle.done():
+                continue   # EOS already out: discard the extra token
+            tok = int(nxt[slot_id])
+            rs.next_token = tok
+            self._emit(rs.req, tok)
+            emitted += 1
+            if rs.req.deadline is not None and now >= rs.req.deadline:
+                self._count("decode_deadline_expired")
+                self._finish(slot_id, rs, error=DeadlineExceeded(
+                    "deadline passed mid-generation; sequence dropped"))
+            elif self._req_done(rs.req):
+                self._finish(slot_id, rs)
+        self._note_phases(fl["dispatch_ms"],
+                          (time.perf_counter() - t_h0) * 1e3, fetch_ms)
+        return emitted
+
+    def _drain_inflight(self) -> int:
+        """Harvest the lagged tick NOW — the barrier before any state
+        surgery (park, preempt, prefill-failure reset, shutdown)."""
+        fl, self._inflight = self._inflight, None
+        return self._harvest(fl) if fl is not None else 0
+
+    def _abort_inflight(self, fl: dict) -> None:
+        """Discard an in-flight tick whose results can no longer be
+        trusted (a later dispatch on the same pool chain failed): wait
+        the device out (no tick may still be writing pool pages during
+        the caller's pool surgery), then roll back the optimistic
+        advances; the slots are being failed or preempt-requeued by
+        the caller, so no token is lost from any surviving output."""
+        try:
+            fl["tokens"].block_until_ready()
+        except Exception:
+            pass
+        fl["retire"] = None
+        for _, rs in fl["plan"]:
+            rs.pending -= 1
+            rs.length = max(0, rs.length - 1)
+            rs.fed = False
+        if fl["span"] is not None:
+            fl["span"].end("aborted")
+
+    # -- host-tier park / resume --------------------------------------------
+    def _try_park(self, exclude: Optional[DecodeRequest] = None) -> bool:
+        """Park the coldest slot's session into the host tier: drain
+        the in-flight tick, d2h-snapshot its pages (encoded), release
+        them from HBM, move the request to the parked list. False when
+        no tier is attached, no parkable slot exists, or the tier is
+        full (callers fall back to preemption)."""
+        if self._offload is None:
+            return False
+        if self._inflight is not None:
+            self._drain_inflight()
+        slot_id = self.sched.coldest_slot(exclude_req=exclude)
+        if slot_id is None:
+            return False
+        rs = self.sched.slots.get(slot_id)
+        if rs is None or rs.req.handle.done():
+            return False
+        pages = self.pool.seq_pages(rs.seq_id)
+        if not pages or not self._offload.room_for(len(pages)):
+            return False
+        records = [self._fetch_page_record(p) for p in pages]
+        if not self._offload.put_seq(rs.seq_id, records):
+            return False
+        self.sched.park(slot_id)
+        if self._prefetch is not None:
+            # stage the h2d decode immediately: by the time pages free
+            # up for the resume, the arrays are usually ready
+            self._prefetch.request(rs.seq_id, records)
+        self._count("kv_offload_bytes",
+                    len(records) * self._offload.page_nbytes)
+        self._gauge("kv_pages_host", self._offload.pages_host)
+        return True
+
+    def _resume_parked(self) -> int:
+        """Resume parked sessions (FIFO) while slots and pages allow:
+        allocate fresh pages, write the staged (or sync-decoded) rows
+        back h2d, re-place the request with its exact pre-park state —
+        the continuation is bitwise for int8 pools (verbatim records)
+        and deterministic for f32 pools (one quantization)."""
+        work = 0
+        while True:
+            pk = self.sched.peek_parked()
+            if pk is None:
+                break
+            if pk.req.handle.done():   # failed/cancelled while parked
+                self.sched.pop_parked()
+                self._offload.drop_seq(pk.host_key)
+                if self._prefetch is not None:
+                    self._prefetch.discard(pk.host_key)
+                self._gauge("kv_pages_host", self._offload.pages_host)
+                continue
+            if pk.n_pages > self.pool.pages_free:
+                break   # pages not there yet; staging already runs
+            t0 = time.perf_counter()
+            seq_id = self.sched.new_seq_id()
+            pages = self.pool.alloc_seq(
+                seq_id, pk.n_pages * self.pool.page_size)
+            if pages is None:
+                break
+            arrays = None
+            if self._prefetch is not None:
+                try:
+                    arrays = self._prefetch.take(pk.host_key)
+                except KVRestoreError:
+                    self._count("kv_restore_fallbacks")
+            records = self._offload.pop_seq(pk.host_key)
+            if arrays is None:   # typed fallback: sync h2d decode
+                arrays = [self._decode_record(r) for r in records]
+            for page, arr in zip(pages, arrays):
+                self._write_page_arrays(page, arr)
+            self.sched.pop_parked()
+            self.sched.place(pk.req, seq_id, pk.length, pk.next_token)
+            if pk.req.span is not None:
+                pk.req.span.event("resumed", pages=pk.n_pages,
+                                  length=pk.length)
+            self._count("kv_page_restores", len(pages))
+            self._count("kv_sessions_resumed")
+            self._h_restore.observe((time.perf_counter() - t0) * 1e3)
+            self._gauge("kv_pages_host", self._offload.pages_host)
+            work += 1
+        return work
+
+    def _revive_host_prefix(self, tokens: List[int], limit: int) -> int:
+        """Walk the context's chain keys and pull spilled prefix pages
+        back from the host tier into the cached LRU (h2d write + index
+        install) so the prefill right after shares them via
+        ``match_prefix`` instead of recomputing."""
+        n_full = min(len(tokens) // self.pool.page_size, int(limit))
+        if n_full <= 0:
+            return 0
+        revived = 0
+        for key in _chain_keys(tokens, n_full, self.pool.page_size):
+            if self.pool.is_indexed(key):
+                continue   # already HBM-resident
+            rec = self._offload.take_prefix(key)
+            if rec is None:
+                break      # chain ends: nothing further can match
+            page = self.pool.install_cached(key)
+            if page is None:
+                self._offload.put_prefix(key, rec)   # pool dry: keep it
+                break
+            self._write_page_arrays(page, self._decode_record(rec))
+            self._count("kv_page_restores")
+            revived += 1
+        if revived:
+            self._gauge("kv_pages_host", self._offload.pages_host)
+        return revived
 
     def _spec_once(self, active: Dict[int, RunningSeq]) -> int:
         """One speculative tick: propose up to ``spec_k`` drafts per
@@ -1022,6 +1734,8 @@ class DecodeEngine:
             with self.sched.lock:
                 while self._running and not self.sched.queue \
                         and not self.sched.slots \
+                        and not self.sched.parked \
+                        and self._inflight is None \
                         and not self._adoptions:
                     self.sched.lock.wait(timeout=0.05)
                 if not self._running:
@@ -1063,3 +1777,5 @@ class DecodeEngine:
             t.join(timeout=10)
             if not t.is_alive():
                 self._thread = None
+        if self._prefetch is not None:
+            self._prefetch.stop()

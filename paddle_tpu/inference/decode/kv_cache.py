@@ -37,16 +37,31 @@ Accounting lands in the declared gauges the moment it changes:
 ``kv_pages_cached`` gauges and the ``kv_prefix_hits`` counter —
 scraped through every /metrics listener like the rest of the
 observability plane.
+
+THE HOST TIER (:class:`HostKVPool`) extends the pool below HBM:
+parked sessions' pages and LRU-reclaimed prefix pages spill to host
+RAM as int8 rows in the ps/codec blocked layout (block = one token
+row — byte-identical to the int8 pool planes, so int8 pools offload
+VERBATIM and f32 pools pay one deterministic quantization). The page
+table gains :meth:`PageTableManager.park_seq` (release without
+counting evictions — the KV survives on the host, nothing needs
+recomputing), a ``spill_sink`` hook fired when the allocator reclaims
+an indexed cached page (the engine snapshots the rows host-side before
+the slot is reused), and :meth:`PageTableManager.install_cached` (the
+reverse: a restored host page re-enters the cached LRU under its
+chain key). ``kv_pages_host`` / ``kv_offload_bytes`` /
+``kv_page_restores`` land in the same metrics plane.
 """
 from __future__ import annotations
 
 import hashlib
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["PageTableManager", "alloc_kv_pool", "alloc_kv_scales"]
+__all__ = ["HostKVPool", "PageTableManager", "alloc_kv_pool",
+           "alloc_kv_scales"]
 
 
 def alloc_kv_pool(n_layers: int, n_pages: int, page_size: int,
@@ -97,6 +112,158 @@ def _chain_keys(tokens: Sequence[int], n_blocks: int,
     return keys
 
 
+class HostKVPool:
+    """Host-RAM offload tier for KV pages: int8-encoded page records
+    keyed two ways — PARKED SESSIONS (every page of an idle sequence,
+    restored wholesale on resume) and a PREFIX LRU (individual indexed
+    pages the HBM allocator reclaimed, revivable by chain key at
+    prefill time).
+
+    A page record is ``(kq, ks, vq, vs)`` numpy arrays: int8 rows
+    ``(n_layers, page_size, heads, head_dim)`` plus the per-token-row
+    f32 scales ``(n_layers, page_size)`` — exactly the int8 pool's
+    plane layout, so :attr:`page_nbytes` is the ps/codec closed form
+    ``2 * L * encoded_nbytes(S*H*D, "int8", block=H*D)``.
+
+    ``capacity_bytes`` bounds the tier. Parked sessions are load-
+    bearing (a parked request WILL resume) so they evict prefix pages
+    to make room but are never evicted themselves; prefix pages age
+    out LRU-oldest first. Everything here is plain numpy — no device,
+    no locks beyond the caller's (the engine serializes access on its
+    scheduler lock)."""
+
+    def __init__(self, n_layers: int, page_size: int, heads: int,
+                 head_dim: int, capacity_bytes: int):
+        from ...ps.codec import encoded_nbytes
+
+        self.n_layers = int(n_layers)
+        self.page_size = int(page_size)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.capacity_bytes = int(capacity_bytes)
+        row = self.heads * self.head_dim
+        #: encoded bytes one page costs on the host: K and V planes,
+        #: one f32 scale per token row per layer
+        self.page_nbytes = 2 * self.n_layers * encoded_nbytes(
+            self.page_size * row, "int8", block=row)
+        self._seqs: Dict[int, List[tuple]] = {}
+        self._prefix: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._spilled_pages = 0      # cumulative d2h page count
+        self._restored_pages = 0     # cumulative h2d page count
+        self._dropped_pages = 0      # refused/aged-out prefix pages
+
+    # -- accounting -------------------------------------------------------
+    @property
+    def pages_host(self) -> int:
+        """Pages resident in the host tier right now."""
+        return (sum(len(p) for p in self._seqs.values())
+                + len(self._prefix))
+
+    @property
+    def bytes_in_use(self) -> int:
+        return self.pages_host * self.page_nbytes
+
+    @property
+    def spilled_pages(self) -> int:
+        return self._spilled_pages
+
+    @property
+    def restored_pages(self) -> int:
+        return self._restored_pages
+
+    def room_for(self, n_pages: int) -> bool:
+        """True when ``n_pages`` fit after aging out every prefix
+        page — parked sessions are the only immovable tenants."""
+        fixed = sum(len(p) for p in self._seqs.values())
+        return (fixed + int(n_pages)) * self.page_nbytes \
+            <= self.capacity_bytes
+
+    def _make_room(self, n_pages: int) -> bool:
+        """Age out LRU-oldest prefix pages until ``n_pages`` fit;
+        False when parked sessions alone exceed the budget."""
+        need = int(n_pages) * self.page_nbytes
+        while self.bytes_in_use + need > self.capacity_bytes:
+            if not self._prefix:
+                return False
+            self._prefix.popitem(last=False)
+            self._dropped_pages += 1
+        return True
+
+    # -- parked sessions --------------------------------------------------
+    def put_seq(self, key: int, records: Sequence[tuple]) -> bool:
+        """Park a session's encoded pages; False when the tier can't
+        hold them even after aging the prefix LRU out (caller falls
+        back to preemption)."""
+        if key in self._seqs:
+            raise ValueError(f"session {key} already parked")
+        records = list(records)
+        if not self._make_room(len(records)):
+            return False
+        self._seqs[key] = records
+        self._spilled_pages += len(records)
+        return True
+
+    def pop_seq(self, key: int) -> List[tuple]:
+        """Take a parked session's pages back for restore; raises
+        KeyError for an unknown session."""
+        records = self._seqs.pop(key)
+        self._restored_pages += len(records)
+        return records
+
+    def drop_seq(self, key: int) -> int:
+        """Discard a parked session (deadline expiry, shutdown);
+        returns the page count freed."""
+        records = self._seqs.pop(key, [])
+        self._dropped_pages += len(records)
+        return len(records)
+
+    def has_seq(self, key: int) -> bool:
+        return key in self._seqs
+
+    # -- prefix LRU -------------------------------------------------------
+    def put_prefix(self, key: bytes, record: tuple) -> bool:
+        """Spill one reclaimed prefix page under its chain key; the
+        newest entry is the warmest. False when there is no room even
+        after aging older prefixes out."""
+        if key in self._prefix:
+            self._prefix.move_to_end(key)
+            return True
+        if not self._make_room(1):
+            self._dropped_pages += 1
+            return False
+        self._prefix[key] = record
+        self._spilled_pages += 1
+        return True
+
+    def take_prefix(self, key: bytes) -> Optional[tuple]:
+        """Pop a spilled prefix page for revival; None on miss."""
+        record = self._prefix.pop(key, None)
+        if record is not None:
+            self._restored_pages += 1
+        return record
+
+    def has_prefix(self, key: bytes) -> bool:
+        return key in self._prefix
+
+    # -- views ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-ready host-tier state for tools/dump_kv.py: residency
+        per parked session, the prefix LRU in temperature order
+        (oldest/coldest first), and the byte accounting."""
+        return {
+            "page_nbytes": self.page_nbytes,
+            "capacity_bytes": self.capacity_bytes,
+            "bytes_in_use": self.bytes_in_use,
+            "pages_host": self.pages_host,
+            "spilled_pages": self._spilled_pages,
+            "restored_pages": self._restored_pages,
+            "dropped_pages": self._dropped_pages,
+            "sessions": {str(k): len(v)
+                         for k, v in sorted(self._seqs.items())},
+            "prefix_lru": [k.hex()[:12] for k in self._prefix],
+        }
+
+
 class PageTableManager:
     """Free-list page allocator + per-sequence page tables + refcounted
     prefix sharing.
@@ -121,10 +288,23 @@ class PageTableManager:
         self._page_key: Dict[int, bytes] = {}    # page -> its index key
         self._cached: "OrderedDict[int, None]" = OrderedDict()
         self._evicted_pages = 0
+        self._parked_pages = 0
         self._prefix_hits = 0
         self._cached_reclaimed = 0
         self._peak_in_use = 0
         self._peak_shared = 0
+        #: monotonic table-mutation epoch: bumped by every operation
+        #: that can change a sequence's page list (alloc, append-page,
+        #: COW, free/evict/park, adoption). The async decode engine
+        #: compares epochs to prove a tick's page tables are unchanged
+        #: and reuse device-resident control vectors instead of
+        #: rebuilding + re-uploading them.
+        self.mutations = 0
+        #: optional ``(page, chain_key)`` hook fired just before an
+        #: indexed cached page is reclaimed — the engine's host-tier
+        #: spill (d2h snapshot of the rows). Purely an optimization:
+        #: a raising sink never blocks the allocation.
+        self.spill_sink: Optional[Callable[[int, bytes], None]] = None
         self._publish()
 
     # -- accounting -------------------------------------------------------
@@ -158,6 +338,13 @@ class PageTableManager:
         return self._evicted_pages
 
     @property
+    def parked_pages(self) -> int:
+        """Cumulative pages released by :meth:`park_seq` — kept apart
+        from ``evicted_pages`` because parked KV survives on the host
+        and needs no recompute."""
+        return self._parked_pages
+
+    @property
     def prefix_hits(self) -> int:
         """Cumulative pages served from the prefix index instead of a
         fresh allocation + recompute."""
@@ -177,6 +364,7 @@ class PageTableManager:
     def _publish(self) -> None:
         from ... import profiler
 
+        self.mutations += 1
         self._peak_in_use = max(self._peak_in_use, self.pages_in_use)
         self._peak_shared = max(self._peak_shared, self.pages_shared)
         profiler.set_counter("kv_pages_in_use", self.pages_in_use)
@@ -197,6 +385,13 @@ class PageTableManager:
             return self._free.pop()
         if self._cached:
             page, _ = self._cached.popitem(last=False)
+            if self.spill_sink is not None:
+                key = self._page_key.get(page)
+                if key is not None:
+                    try:
+                        self.spill_sink(page, key)
+                    except Exception:
+                        pass   # spill is best-effort, never gates alloc
             self._drop_index(page)
             self._cached_reclaimed += 1
             return page
@@ -391,6 +586,12 @@ class PageTableManager:
             out.append(page)
         return out
 
+    def is_indexed(self, key: bytes) -> bool:
+        """True when a chain key already resolves to an HBM-resident
+        page (shared or cached) — the host-tier revival path skips
+        these."""
+        return key in self._index
+
     def register_prefix(self, seq_id: int,
                         tokens: Sequence[int]) -> int:
         """Index every FULL page of ``tokens`` (the just-prefilled
@@ -472,6 +673,38 @@ class PageTableManager:
         self._publish()
         return len(pages)
 
+    def park_seq(self, seq_id: int) -> int:
+        """Park a LIVE sequence into the host tier: release its
+        references like :meth:`evict_seq` but WITHOUT counting
+        evictions — the caller already snapshotted the KV to a
+        :class:`HostKVPool`, so nothing needs recomputing and
+        ``kv_page_evictions`` keeps meaning 'prefill again'."""
+        pages = self._seqs.pop(seq_id, [])
+        for page in reversed(pages):
+            self._release_page(page)
+        self._parked_pages += len(pages)
+        self._publish()
+        return len(pages)
+
+    def install_cached(self, key: bytes) -> Optional[int]:
+        """Re-enter a restored host-tier prefix page as a CACHED
+        indexed page: allocate a slot, index it under ``key``, park it
+        warmest in the reclaimable LRU with zero refs. The caller
+        writes the page's KV rows on device before anything can match
+        it. None when the key is already indexed (nothing to do) or
+        the pool is dry."""
+        if key in self._index:
+            return None
+        page = self._take_page()
+        if page is None:
+            return None
+        self._index[key] = page
+        self._page_key[page] = key
+        self._cached[page] = None
+        self._cached.move_to_end(page)
+        self._publish()
+        return page
+
     # -- views ------------------------------------------------------------
     def seq_pages(self, seq_id: int) -> List[int]:
         return list(self._seqs.get(seq_id, ()))
@@ -500,6 +733,7 @@ class PageTableManager:
             "pages_shared": self.pages_shared,
             "utilization_pct": self.utilization_pct(),
             "evicted_pages": self._evicted_pages,
+            "parked_pages": self._parked_pages,
             "prefix_hits": self._prefix_hits,
             "cached_reclaimed": self._cached_reclaimed,
             "peak_pages_in_use": self._peak_in_use,

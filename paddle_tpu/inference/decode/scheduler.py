@@ -21,7 +21,14 @@ Past admission the policy is vLLM-shaped continuous batching:
   (``kv_page_evictions``) and the request re-queues at the front with
   its already-emitted tokens folded into the prompt — greedy decoding
   makes the re-prefilled continuation identical, so preemption is
-  invisible in the output.
+  invisible in the output;
+- with a host KV tier attached (engine ``host_kv_bytes``), the dry-pool
+  policy PARKS the COLDEST slot instead (``placed_at`` minimum — the
+  most KV accumulated, hence the most expensive to recompute but the
+  cheapest to ship): its pages move to host RAM intact, the request
+  waits in a PARKED list (not the queue — ``queue_depth`` stays an
+  admission signal), and resumes into a free slot with its pages
+  restored h2d, no recompute, bitwise-identical continuation.
 """
 from __future__ import annotations
 
@@ -35,7 +42,8 @@ from ..serving import (DeadlineExceeded, EngineStopped,  # noqa: F401
                        Overloaded, RequestFailed, ServingError)
 from .kv_cache import PageTableManager
 
-__all__ = ["DecodeRequest", "DecodeScheduler", "RunningSeq"]
+__all__ = ["DecodeRequest", "DecodeScheduler", "ParkedSeq",
+           "RunningSeq"]
 
 
 class _DecodeHandle:
@@ -110,15 +118,41 @@ class RunningSeq:
     key (a re-placed preemptee is YOUNG again, whatever its original
     submit time)."""
 
-    __slots__ = ("req", "seq_id", "length", "next_token", "placed_at")
+    __slots__ = ("req", "seq_id", "length", "next_token", "placed_at",
+                 "pending", "fed")
 
     def __init__(self, req: DecodeRequest, seq_id: int, length: int,
                  next_token: int, placed_at: int = 0):
         self.req = req
         self.seq_id = seq_id
-        self.length = length        # KV positions written
+        self.length = length        # KV positions written (incl. in-flight)
         self.next_token = next_token  # pending input of the next step
         self.placed_at = placed_at
+        # async-tick state: in-flight dispatched-not-yet-harvested tick
+        # count for this slot (depth <= 1), and whether the device-side
+        # token chain holds this slot's next input (so the dispatch can
+        # feed it device->device instead of injecting from the host)
+        self.pending = 0
+        self.fed = False
+
+
+class ParkedSeq:
+    """A session parked in the host KV tier: everything needed to
+    resume it bitwise — the request, the host-pool key (its sequence
+    id at park time), the KV positions covered, and the pending next
+    input token. Parked sessions live OUTSIDE the admission queue:
+    they already hold state (host pages), so they resume ahead of new
+    prefills and never count in ``queue_depth``."""
+
+    __slots__ = ("req", "host_key", "length", "next_token", "n_pages")
+
+    def __init__(self, req: DecodeRequest, host_key: int, length: int,
+                 next_token: int, n_pages: int):
+        self.req = req
+        self.host_key = host_key
+        self.length = length
+        self.next_token = next_token
+        self.n_pages = n_pages
 
 
 class DecodeScheduler:
@@ -154,6 +188,7 @@ class DecodeScheduler:
         self._t_refill = clock()
         self.lock = threading.Condition()
         self.queue: deque = deque()
+        self.parked: deque = deque()   # ParkedSeq, FIFO resume order
         self.slots: Dict[int, RunningSeq] = {}
         self.accepting = True
         self._next_seq_id = 0
@@ -332,6 +367,74 @@ class DecodeScheduler:
             self._count("decode_preempted")
             return rs.req
 
+    # -- host-tier parking ------------------------------------------------
+    def coldest_slot(self, exclude_req: Optional[DecodeRequest] = None
+                     ) -> Optional[int]:
+        """The slot placed LONGEST ago (min ``placed_at``) — the park
+        victim: it carries the most KV, which parking preserves intact
+        while preemption would throw it away. ``exclude_req`` keeps
+        the sequence whose growth triggered the pressure from parking
+        itself."""
+        with self.lock:
+            cands = [s for s, rs in self.slots.items()
+                     if rs.req is not exclude_req]
+            if not cands:
+                return None
+            return min(cands, key=lambda s: self.slots[s].placed_at)
+
+    def park(self, slot_id: int) -> Optional[ParkedSeq]:
+        """Move a slot to the parked list: release its pages via
+        :meth:`PageTableManager.park_seq` (the caller already
+        snapshotted the KV to the host tier under ``seq_id``) and
+        record what resume needs. Returns the record, or None for a
+        vacated slot."""
+        with self.lock:
+            rs = self.slots.pop(slot_id, None)
+            if rs is None:
+                return None
+            n_pages = self.pool.park_seq(rs.seq_id)
+            pk = ParkedSeq(rs.req, rs.seq_id, rs.length,
+                           rs.next_token, n_pages)
+            self.parked.append(pk)
+        if rs.req.span is not None:
+            rs.req.span.event("parked", slot=slot_id, length=rs.length,
+                              pages=n_pages)
+        self._count("kv_sessions_parked")
+        return pk
+
+    def peek_parked(self) -> Optional[ParkedSeq]:
+        """Head of the parked list when a slot is free to resume into;
+        the caller pops with :meth:`pop_parked` only once the restore
+        actually succeeded (pages allocated, KV written back)."""
+        with self.lock:
+            if not self.parked or len(self.slots) >= self.max_batch:
+                return None
+            return self.parked[0]
+
+    def pop_parked(self) -> Optional[ParkedSeq]:
+        with self.lock:
+            return self.parked.popleft() if self.parked else None
+
+    def expire_parked(self, now: float) -> List[ParkedSeq]:
+        """Drop parked sessions whose deadline already passed; the
+        engine resolves handles and frees the host-tier pages."""
+        with self.lock:
+            expired = [p for p in self.parked
+                       if p.req.deadline is not None
+                       and now >= p.req.deadline]
+            if expired:
+                self.parked = deque(p for p in self.parked
+                                    if p not in expired)
+        for p in expired:
+            self._count("decode_deadline_expired")
+            err = DeadlineExceeded(
+                f"deadline passed while parked "
+                f"({now - p.req.t_submit:.3f}s since submit)")
+            if p.req.span is not None:
+                p.req.span.fail(err)
+            p.req.handle._resolve(error=err)
+        return expired
+
     def active(self) -> Dict[int, RunningSeq]:
         with self.lock:
             return dict(self.slots)
@@ -343,4 +446,4 @@ class DecodeScheduler:
 
     def pending(self) -> bool:
         with self.lock:
-            return bool(self.queue or self.slots)
+            return bool(self.queue or self.slots or self.parked)

@@ -61,8 +61,8 @@ import numpy as np
 
 __all__ = [
     "ServingError", "Overloaded", "DeadlineExceeded", "EngineStopped",
-    "RequestFailed", "AnalysisPredictor", "ServingEngine",
-    "ServingHealthServer", "install_sigterm_drain",
+    "RequestFailed", "KVRestoreError", "AnalysisPredictor",
+    "ServingEngine", "ServingHealthServer", "install_sigterm_drain",
 ]
 
 
@@ -87,6 +87,13 @@ class EngineStopped(ServingError):
 
 class RequestFailed(ServingError):
     """Dispatch retries AND the degraded fallback were exhausted."""
+
+
+class KVRestoreError(ServingError):
+    """A parked session's staged h2d restore was unavailable (prefetch
+    worker dead, staging failure, or timeout). Never surfaces to a
+    caller: the decode engine catches it, counts
+    ``kv_restore_fallbacks``, and restores synchronously."""
 
 
 from ..fault.injector import _bump  # noqa: E402 (shared lazy counter shim)

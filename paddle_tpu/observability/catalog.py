@@ -129,6 +129,16 @@ SCALARS = {
     "kv_pages_shared": ("gauge", "KV pages currently backing more than one live sequence (refcount > 1)"),
     "kv_pages_cached": ("gauge", "zero-ref prefix pages parked in the reclaimable LRU"),
     "kv_cow_copies": ("counter", "copy-on-write page copies (a write targeted a shared/indexed page)"),
+    # overlapped decode data plane (async double-buffered ticks +
+    # host-RAM KV offload tier)
+    "decode_overlap_frac": ("gauge", "fraction of cumulative decode tick wall NOT spent blocked on the device fetch ((dispatch+host)/total from decode_tick_phase_ms)"),
+    "kv_pages_host": ("gauge", "KV pages resident in the host-RAM offload tier (parked sessions + spilled prefix pages)"),
+    "kv_pages_parked": ("gauge", "cumulative HBM pages released by parking sessions to the host tier (KV survives, nothing recomputes)"),
+    "kv_offload_bytes": ("counter", "encoded KV bytes spilled d2h into the host tier (int8 rows, ps/codec layout)"),
+    "kv_page_restores": ("counter", "KV pages restored h2d from the host tier (session resumes + prefix revivals)"),
+    "kv_sessions_parked": ("counter", "sessions parked to the host tier instead of preempt-requeued under pool pressure"),
+    "kv_sessions_resumed": ("counter", "parked sessions resumed into a decode slot with their pages restored"),
+    "kv_restore_fallbacks": ("counter", "resumes that fell back to a synchronous h2d restore (prefetch staging unavailable, typed KVRestoreError)"),
     # fleet decode serving (serving/router.py + serving/disagg.py):
     # routing across engine replicas and prefill->decode KV migration
     "router_requests": ("counter", "requests admitted by the fleet router"),
@@ -209,6 +219,13 @@ HISTOGRAMS = {
     "router_e2e_ms": (
         "fleet-router request end-to-end latency, admission to final "
         "chunk — includes every failover/replay leg", ()),
+    "decode_tick_phase_ms": (
+        "decode tick wall split by phase: dispatch (control-vector build "
+        "+ step enqueue), host (harvest + scheduler bookkeeping), fetch "
+        "(blocked waiting for device tokens)", ("phase",)),
+    "kv_restore_wait_ms": (
+        "parked-session resume wall: wait for staged host-tier pages "
+        "(or sync fallback decode) + h2d page writes", ()),
 }
 
 

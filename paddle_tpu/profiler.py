@@ -250,6 +250,9 @@ DECODE_COUNTER_NAMES = (
     "spec_proposed", "spec_accepted", "spec_accept_rate",
     "kv_prefix_hits", "kv_pages_shared", "kv_pages_cached",
     "kv_cow_copies",
+    "decode_overlap_frac",
+    "kv_pages_host", "kv_offload_bytes", "kv_page_restores",
+    "kv_sessions_parked", "kv_sessions_resumed", "kv_restore_fallbacks",
 )
 
 # fleet-router + KV-migration counters (serving/router.py dispatch,

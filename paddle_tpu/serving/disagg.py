@@ -42,7 +42,7 @@ from ..ps.codec import CODEC_IDS, codec_name, encoded_nbytes, np_encode
 __all__ = [
     "FRAME_MAGIC", "FRAME_VERSION", "MalformedPageFrame", "PageFrame",
     "PrefillShipment", "PrefillWorker", "MigrationClient",
-    "decode_frame", "encode_frame", "migration_cost",
+    "decode_frame", "encode_frame", "migration_cost", "quantize_rows",
 ]
 
 FRAME_MAGIC = b"KVPG"
@@ -66,11 +66,17 @@ class MalformedPageFrame(RuntimeError):
     version or codec byte, or a body shorter than its header promises."""
 
 
-def _row_quant(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+def quantize_rows(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Per-token-row symmetric int8 quantization of ``(..., H, D)``
     float32 rows — identical rounding to ``np_encode``/
     ``jnp_encode_kv_rows`` (amax/127 scale, half-even rint, clip), so
-    every producer of an int8 page row agrees bit for bit."""
+    every producer of an int8 page row agrees bit for bit. Public
+    because it is THE row codec of every KV tier: the wire frames
+    below, the int8 pool's prefill path, and the decode engine's
+    host-RAM offload records (kv_cache.HostKVPool) all quantize
+    through this one rule — which is what makes a page parked to host
+    RAM re-encode IDEMPOTENTLY (the amax element quantizes to ±127
+    exactly, so decode → re-encode reproduces the same bytes)."""
     xf = np.asarray(rows, np.float32)
     amax = np.max(np.abs(xf), axis=(-2, -1))
     scale = (amax / 127.0).astype(np.float32)
@@ -161,7 +167,7 @@ class PageFrame:
                               offset=4 * self.n_rows)
             return (q.reshape(shape).copy(),
                     scales.reshape(shape[:3]).copy())
-        q, scales = _row_quant(self.f32_rows(which))
+        q, scales = quantize_rows(self.f32_rows(which))
         return q, scales
 
 

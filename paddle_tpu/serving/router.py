@@ -190,6 +190,12 @@ class DecodeEngineServer:
 
         def _stats(handler):
             pool = engine.pool
+            ctr = engine.counters
+            # kv_pages_in_use is HBM-RESIDENT pages only: parked
+            # sessions release their device pages into the free list,
+            # so an engine with a deep host tier legitimately looks
+            # light to the router's load signal — that is the point
+            # of the offload tier.
             _send_json(handler, 200, {
                 "ready": bool(engine.ready),
                 "kv_pages_in_use": pool.pages_in_use,
@@ -197,6 +203,12 @@ class DecodeEngineServer:
                 "page_size": pool.page_size,
                 "max_pages_per_seq": pool.max_pages_per_seq,
                 "vocab_size": engine.config.vocab_size,
+                "kv_pages_host": int(ctr.get("kv_pages_host", 0)),
+                "kv_offload_bytes": int(ctr.get("kv_offload_bytes", 0)),
+                "kv_page_restores": int(ctr.get("kv_page_restores", 0)),
+                "kv_restore_wait_p99_ms": float(
+                    engine.engine_latency_stats().get(
+                        "restore_wait_p99_ms", 0.0)),
             })
 
         class _Handler(KVHandler):
@@ -496,15 +508,31 @@ class FleetSLOSignal:
 
     def scale_hint(self) -> dict:
         """The autoscaler-facing summary: which engines burn, how many
-        are clean, and the resulting action."""
+        are clean, and the resulting action — plus the KV tier view.
+        ``kv_pages_in_use`` is HBM-RESIDENT by construction (parked
+        sessions live in each engine's host tier), so ``kv_pages_host``
+        is the pressure the fleet absorbed WITHOUT scaling: a high
+        host-page count with a clean burn set means the offload tier is
+        doing its job; a high count WITH burn means the fleet is out of
+        headroom and paging cost is leaking into latency — scale up."""
         burning = self.burning()
         clean = [t for t in self.targets if t not in burning]
         action = "steady"
         if burning:
             action = "scale_up" if len(clean) <= len(burning) \
                 else "shift_load"
+        samples = self._fed.merged_samples()
+        pages_host = 0.0
+        restores = 0.0
+        for key, v in samples.items():
+            if key.startswith("kv_pages_host"):
+                pages_host += v
+            elif key.startswith("kv_page_restores"):
+                restores += v
         return {"burning": sorted(burning), "clean": len(clean),
-                "targets": len(self.targets), "action": action}
+                "targets": len(self.targets), "action": action,
+                "kv_pages_host": int(pages_host),
+                "kv_page_restores": int(restores)}
 
 
 # ---------------------------------------------------------------------------

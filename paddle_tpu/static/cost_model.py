@@ -51,7 +51,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["OpCost", "CostReport", "program_cost", "paged_decode_cost"]
+__all__ = ["OpCost", "CostReport", "program_cost", "paged_decode_cost",
+           "kv_offload_page_bytes"]
 
 # matmul-class ops: the MFU numerator (2 FLOPs per MAC)
 _MATMUL_OPS = {"mul", "matmul", "matmul_v2"}
@@ -569,3 +570,20 @@ def paged_decode_cost(config, live_lens: Sequence[int], page_size: int,
             "live_slots": n, "live_page_tokens": int(page_tokens),
             "kv_codec": kv_codec,
             "kv_row_bytes": int(kv_row_bytes)}
+
+
+def kv_offload_page_bytes(config, page_size: int) -> int:
+    """Encoded bytes ONE KV page costs in the host offload tier — the
+    closed form behind ``HostKVPool.page_nbytes`` and the d2h/h2d
+    traffic the ``kv_offload_bytes`` counter charges per spilled page.
+
+    Host records are always int8 rows regardless of the device pool
+    dtype (f32 pools pay one deterministic row quantize on the way
+    out), so the cost is the ps/codec blocked layout with block = one
+    token row: K and V planes, ``n_layers`` each, ``page_size`` rows of
+    ``n_heads * head_dim`` int8 payload plus one f32 scale per row."""
+    from ..ps.codec import encoded_nbytes
+
+    row = int(config.n_heads) * int(config.head_dim)
+    return 2 * int(config.n_layers) * encoded_nbytes(
+        int(page_size) * row, "int8", block=row)

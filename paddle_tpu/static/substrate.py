@@ -30,12 +30,22 @@ class CompiledStep:
 
     ``compiled`` is the raw jax ``Compiled`` object (kept accessible:
     the executor's memory/cost planes read ``compiled.memory_analysis()``
-    off it); calling the ``CompiledStep`` dispatches it."""
+    off it); calling the ``CompiledStep`` dispatches it.
 
-    __slots__ = ("compiled", "trace_ms", "compile_ms")
+    ``jitted`` is the ``jax.jit`` wrapper the executable was lowered
+    from. For per-call-latency-critical loops (the decode engine's
+    tick) it is the better dispatch handle: the jit wrapper's C++
+    fast path skips the Python argument processing every
+    ``Compiled.__call__`` pays, and its own first call recompiles
+    through the XLA compilation cache the AOT build just populated —
+    same executable, cheaper dispatch."""
 
-    def __init__(self, compiled, trace_ms: float, compile_ms: float):
+    __slots__ = ("compiled", "jitted", "trace_ms", "compile_ms")
+
+    def __init__(self, compiled, trace_ms: float, compile_ms: float,
+                 jitted=None):
         self.compiled = compiled
+        self.jitted = jitted
         self.trace_ms = trace_ms
         self.compile_ms = compile_ms
 
@@ -57,11 +67,16 @@ def aot_compile(step_fn: Callable, example_args: Tuple[Any, ...], *,
     """AOT-compile ``step_fn`` against ``example_args``.
 
     ``donate_argnums``: argument indices whose buffers XLA may reuse in
-    place (device-resident state — params, KV pages, rng). ``in_/
-    out_shardings``: jit boundary shardings (omit to let jax infer from
-    the committed arguments). ``bump(name, value)``: counter sink for
-    the ``trace_ms`` / ``compile_ms`` build timings (the executor
-    passes its ``_bump``; pass None to skip accounting)."""
+    place (device-resident state — params, KV pages, rng). Donation is
+    a liveness contract, not just an optimization: a donated input is
+    dead the moment the step dispatches, so any buffer a caller must
+    read back later — e.g. the decode engine's token chain, where the
+    previous tick's output feeds the next tick's input while a lagged
+    harvest still wants to fetch it — must stay OUT of the donate set.
+    ``in_/out_shardings``: jit boundary shardings (omit to let jax
+    infer from the committed arguments). ``bump(name, value)``: counter
+    sink for the ``trace_ms`` / ``compile_ms`` build timings (the
+    executor passes its ``_bump``; pass None to skip accounting)."""
     import jax
 
     from .compile_cache import ensure_enabled
@@ -85,4 +100,4 @@ def aot_compile(step_fn: Callable, example_args: Tuple[Any, ...], *,
     if bump is not None:
         bump("trace_ms", trace_ms)
         bump("compile_ms", compile_ms)
-    return CompiledStep(compiled, trace_ms, compile_ms)
+    return CompiledStep(compiled, trace_ms, compile_ms, jitted=jitted)

@@ -272,6 +272,32 @@ def test_bench_emits_row_fast_with_dead_tunnel(tmp_path):
     assert last["kv_pool_headroom_x"] >= 2.0, last
     assert last["kv_prefix_hits"] > 0, last
     assert last["kv_prefix_parity"] is True, last
+    # overlapped decode data plane contract (ISSUE 20): the async
+    # double-buffered tick loop is EXACT under greedy (async_parity,
+    # byte-identical outputs vs the PADDLE_ASYNC_DECODE=0 twin) and
+    # wins the majority of paired rounds against it; the host-RAM KV
+    # tier holds more concurrent sessions than the HBM pool alone
+    # could (kv_sessions_per_pool_x > 1), park/resume is invisible in
+    # the tokens, and the int8 host rows save most of the f32 bytes
+    for key in ("async_tokens_per_sec", "sync_tokens_per_sec",
+                "async_parity", "async_beats_sync", "async_round_wins",
+                "decode_overlap_frac", "kv_sessions_per_pool_x",
+                "kv_offload_parity", "kv_offload_bytes_saved_pct",
+                "kv_offload_bytes", "kv_sessions_parked",
+                "kv_sessions_resumed", "kv_page_restores"):
+        assert key in last, f"bench row missing {key!r}"
+    assert last["async_parity"] is True, last
+    assert last["async_beats_sync"] is True, last
+    assert last["async_tokens_per_sec"] > 0, last
+    assert last["sync_tokens_per_sec"] > 0, last
+    assert 0.0 < last["decode_overlap_frac"] <= 1.0, last
+    assert last["kv_sessions_per_pool_x"] > 1.0, last
+    assert last["kv_offload_parity"] is True, last
+    assert last["kv_offload_bytes_saved_pct"] > 50.0, last
+    assert last["kv_offload_bytes"] > 0, last
+    assert last["kv_sessions_parked"] >= 1, last
+    assert last["kv_sessions_resumed"] >= 1, last
+    assert last["kv_page_restores"] >= 1, last
     # FLEET probe contract: two engines behind the serving router —
     # the zipf-session workload reports throughput + p99 TTFT, the
     # deterministic mid-generation engine stop fails over with the

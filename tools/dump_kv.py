@@ -72,6 +72,38 @@ def render_snapshot(snap: dict) -> str:
     if cached:
         lines.append("")
         lines.append(f"-- cached (LRU, reclaimable) --  {list(cached)}")
+    host = snap.get("host_tier")
+    if host:
+        lines.append("")
+        lines.append("-- host offload tier --")
+        cap = int(host.get("capacity_bytes", 0))
+        used = int(host.get("bytes_in_use", 0))
+        pct = round(100.0 * used / cap, 1) if cap else 0.0
+        lines.append(f"{'pages host':<22}{host.get('pages_host', 0)}"
+                     f"  ({used} / {cap} bytes, {pct}%, "
+                     f"{host.get('page_nbytes', 0)} B/page encoded)")
+        lines.append(f"{'spilled / restored':<22}"
+                     f"{host.get('spilled_pages', 0)} / "
+                     f"{host.get('restored_pages', 0)}"
+                     f"   lru drops {host.get('dropped_pages', 0)}")
+        if "parked_sessions" in host:
+            lines.append(f"{'parked sessions':<22}"
+                         f"{host['parked_sessions']}")
+        sessions = host.get("sessions") or {}
+        if sessions:
+            lines.append("")
+            lines.append("-- parked sessions (host-resident KV) --")
+            for sid in sorted(sessions, key=int):
+                lines.append(f"seq {sid:<6}{sessions[sid]} pages on host")
+        lru = host.get("prefix_lru") or []
+        if lru:
+            # oldest first == next to be aged out: the temperature order
+            lines.append("")
+            lines.append(f"-- host prefix LRU (coldest first) --  "
+                         f"{list(lru)}")
+    if "async_decode" in snap:
+        lines.append("")
+        lines.append(f"{'async_decode':<22}{snap['async_decode']}")
     counters = snap.get("counters") or {}
     if counters:
         lines.append("")
@@ -84,8 +116,13 @@ def render_snapshot(snap: dict) -> str:
 def _demo_snapshot() -> dict:
     """A live prefix-sharing scene from a bare PageTableManager: seq 1
     owns a registered 12-token prefix; seq 2 allocates against it so
-    its first pages are shared (ref 2)."""
-    from paddle_tpu.inference.decode.kv_cache import PageTableManager
+    its first pages are shared (ref 2). A small HostKVPool rides along
+    with one parked session and one spilled prefix page, so the host
+    offload tier renders too."""
+    import numpy as np
+
+    from paddle_tpu.inference.decode.kv_cache import (HostKVPool,
+                                                      PageTableManager)
 
     pool = PageTableManager(n_pages=16, page_size=4, max_pages_per_seq=4)
     toks = list(range(1, 13))
@@ -93,7 +130,22 @@ def _demo_snapshot() -> dict:
     pool.register_prefix(1, toks)
     shared = pool.match_prefix(toks + [99], limit=2)
     pool.alloc_seq_shared(2, shared, len(toks) + 1)
-    return pool.snapshot()
+
+    host = HostKVPool(n_layers=2, page_size=4, heads=2, head_dim=8,
+                      capacity_bytes=1 << 16)
+
+    def rec(seed):
+        rng = np.random.RandomState(seed)
+        kq = rng.randint(-128, 127, (2, 4, 2, 8)).astype(np.int8)
+        ks = rng.rand(2, 4).astype(np.float32)
+        return kq, ks, kq.copy(), ks.copy()
+
+    host.put_seq(7, [rec(0), rec(1)])          # a parked session
+    host.put_prefix(b"demo-prefix-key", rec(2))  # a spilled prefix page
+    snap = pool.snapshot()
+    snap["host_tier"] = host.snapshot()
+    snap["host_tier"]["parked_sessions"] = 1
+    return snap
 
 
 def main(argv=None) -> int:

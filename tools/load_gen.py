@@ -229,13 +229,23 @@ class DecodeLoadGen:
     ``decode_tokens_per_sec`` (generated tokens / wall), client-side
     TTFT and inter-token-latency percentiles (from the engine's
     per-token clock stamps), engine-side bucket-derived e2e/step
-    percentiles, and the typed outcome counts."""
+    percentiles, and the typed outcome counts.
+
+    ``arrival_rate`` (requests/second) switches the gen OPEN-LOOP:
+    request ``i`` is submitted no earlier than ``i / arrival_rate``
+    seconds after the run starts — a deterministic arrival schedule,
+    so queueing (and with a host KV tier, session parking) is driven
+    by the OFFERED rate instead of adapting to service time the way
+    closed-loop workers do. ``workers`` then caps in-flight requests:
+    if all workers are blocked the schedule slips, which is exactly
+    the saturation evidence an open-loop run exists to surface."""
 
     def __init__(self, engine, total_requests: int = 16, workers: int = 4,
                  prompt_lens: Sequence[int] = (4, 12, 24, 8),
                  output_lens: Sequence[int] = (4, 8, 16),
                  deadline_s: Optional[float] = None,
-                 timeout_s: float = 300.0, keep_outputs: bool = False):
+                 timeout_s: float = 300.0, keep_outputs: bool = False,
+                 arrival_rate: Optional[float] = None):
         self.engine = engine
         self.total_requests = int(total_requests)
         self.workers = max(1, int(workers))
@@ -244,6 +254,7 @@ class DecodeLoadGen:
         self.deadline_s = deadline_s
         self.timeout_s = float(timeout_s)
         self.keep_outputs = bool(keep_outputs)
+        self.arrival_rate = float(arrival_rate) if arrival_rate else None
         self.outputs: dict = {}   # request index -> generated tokens
         self.summary: Optional[dict] = None
 
@@ -273,11 +284,21 @@ class DecodeLoadGen:
             with lock:
                 outcomes[kind] += 1
 
+        t_start = [0.0]
+
         def worker():
             while True:
                 i = next(counter)
                 if i >= self.total_requests:
                     return
+                if self.arrival_rate:
+                    # open loop: hold request i until its scheduled
+                    # arrival — the schedule is a pure function of the
+                    # index, so two runs offer identical load
+                    delay = (t_start[0] + i / self.arrival_rate
+                             - time.perf_counter())
+                    if delay > 0:
+                        time.sleep(delay)
                 prompt = self._make_prompt(i)
                 out_n = self.output_lens[i % len(self.output_lens)]
                 t0 = time.perf_counter()
@@ -323,6 +344,7 @@ class DecodeLoadGen:
                                     name=f"decode-loadgen-{w}")
                    for w in range(self.workers)]
         t0 = time.perf_counter()
+        t_start[0] = t0
         for t in threads:
             t.start()
         for t in threads:
@@ -364,6 +386,11 @@ class DecodeLoadGen:
             "spec_accepted": int(ectr.get("spec_accepted", 0)),
             "spec_accept_rate": float(ectr.get("spec_accept_rate", 0.0)),
             "workers": self.workers,
+            # open- vs closed-loop provenance: at a fixed offered rate
+            # the latency percentiles mean something different than
+            # under back-pressure-adapted submission
+            "mode": "open" if self.arrival_rate else "closed",
+            "arrival_rate": self.arrival_rate or 0.0,
             "prompt_lens": list(self.prompt_lens),
             "output_lens": list(self.output_lens),
             # TTFT vs inter-token: the autoregressive latency split
@@ -614,7 +641,8 @@ def _decode_main(args):
     engine = DecodeEngine(
         cfg, seed=0, max_batch=args.max_batch, n_pages=args.pages,
         page_size=args.page_size, max_pages_per_seq=args.pages_per_seq,
-        kv_codec=args.kv_codec, spec_k=args.spec_k, proposer=proposer)
+        kv_codec=args.kv_codec, spec_k=args.spec_k, proposer=proposer,
+        host_kv_bytes=args.host_kv_bytes)
     engine.warm()
     engine.start()
     try:
@@ -622,7 +650,7 @@ def _decode_main(args):
             engine, total_requests=args.requests, workers=args.workers,
             prompt_lens=[int(p) for p in args.prompt_lens.split(",")],
             output_lens=[int(o) for o in args.output_lens.split(",")],
-            deadline_s=args.deadline_s)
+            deadline_s=args.deadline_s, arrival_rate=args.arrival_rate)
         summary = gen.run()
         summary["engine_counters"] = {
             k: v for k, v in sorted(engine.counters.items())
@@ -670,6 +698,16 @@ def main():
     ap.add_argument("--kv-codec", default="off", choices=("off", "int8"),
                     help="decode mode: KV page codec (int8 halves pool "
                          "bytes; per-token-row scales)")
+    ap.add_argument("--arrival-rate", type=float, default=None,
+                    help="decode mode: OPEN-LOOP arrivals at this "
+                         "requests/second (request i submits at "
+                         "i/rate — deterministic schedule; default is "
+                         "closed-loop workers)")
+    ap.add_argument("--host-kv-bytes", type=int, default=0,
+                    help="decode mode: host-RAM KV offload tier budget "
+                         "in bytes (0 = off; under pool pressure the "
+                         "engine parks the coldest session to host RAM "
+                         "instead of preempt-requeuing)")
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--pages", type=int, default=64)
     ap.add_argument("--page-size", type=int, default=16)

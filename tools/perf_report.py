@@ -263,6 +263,82 @@ def render_compare(before: Tuple[List[dict], List[dict]],
 
 
 # ---------------------------------------------------------------------------
+# decode overlap view (async tick pipelining + host KV tier)
+# ---------------------------------------------------------------------------
+def _phase_sum(samples: Dict[str, float], phase: str) -> float:
+    """Cumulative ms for one decode tick phase, summed across any
+    instance labels a federated scrape injected."""
+    total = 0.0
+    for key, v in samples.items():
+        if key.startswith("decode_tick_phase_ms_sum") \
+                and f'phase="{phase}"' in key:
+            total += v
+    return total
+
+
+def decode_overlap_metrics(samples: Dict[str, float]
+                           ) -> Dict[str, float]:
+    """The decode-overlap scorecard from one parsed scrape: the tick
+    wall split by phase (dispatch / host / fetch — fetch is the time
+    the host sat blocked on device tokens, the thing async pipelining
+    exists to hide), the engine's cumulative ``decode_overlap_frac``
+    gauge, and the host-tier counters."""
+    out: Dict[str, float] = {}
+    for ph in ("dispatch", "host", "fetch"):
+        out[f"tick_{ph}_ms"] = round(_phase_sum(samples, ph), 3)
+    total = sum(out.values())
+    out["tick_total_ms"] = round(total, 3)
+    if total:
+        out["overlap_frac"] = round(
+            (total - out["tick_fetch_ms"]) / total, 4)
+    for g in ("decode_overlap_frac", "kv_pages_host",
+              "kv_offload_bytes", "kv_page_restores",
+              "kv_sessions_parked", "kv_sessions_resumed",
+              "kv_restore_fallbacks"):
+        if g in samples:
+            out[g] = samples[g]
+    return out
+
+
+def render_decode_overlap(samples: Dict[str, float]) -> str:
+    m = decode_overlap_metrics(samples)
+    if not m.get("tick_total_ms") and "decode_overlap_frac" not in m:
+        return ""   # scrape has no decode tick phase data
+    lines = ["-- decode overlap --"]
+    for key in ("tick_dispatch_ms", "tick_host_ms", "tick_fetch_ms",
+                "tick_total_ms", "overlap_frac",
+                "decode_overlap_frac", "kv_pages_host",
+                "kv_offload_bytes", "kv_page_restores",
+                "kv_sessions_parked", "kv_sessions_resumed",
+                "kv_restore_fallbacks"):
+        if key in m:
+            lines.append(f"{key:<22}{m[key]:>12g}")
+    return "\n".join(lines) + "\n"
+
+
+def render_metrics_compare(before: Dict[str, float],
+                           after: Dict[str, float]) -> str:
+    """``--compare`` over two SAVED SCRAPES instead of step traces:
+    the decode-overlap deltas (sync baseline vs async run is the
+    intended pairing — fetch wall should collapse and overlap_frac
+    rise while token counts match)."""
+    b, a = decode_overlap_metrics(before), decode_overlap_metrics(after)
+    lines = ["== decode overlap delta (before -> after) ==",
+             f"{'metric':<22}{'before':>14}{'after':>14}{'delta':>10}"]
+    keys = [k for k in (
+        "tick_dispatch_ms", "tick_host_ms", "tick_fetch_ms",
+        "tick_total_ms", "overlap_frac", "decode_overlap_frac",
+        "kv_pages_host", "kv_offload_bytes", "kv_page_restores",
+        "kv_sessions_parked", "kv_sessions_resumed",
+        "kv_restore_fallbacks") if k in b or k in a]
+    for key in keys:
+        bv, av = b.get(key, 0.0), a.get(key, 0.0)
+        delta = (f"{100.0 * (av - bv) / bv:+.1f}%" if bv else "n/a")
+        lines.append(f"{key:<22}{bv:>14g}{av:>14g}{delta:>10}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # /metrics scrape view
 # ---------------------------------------------------------------------------
 def render_metrics(samples: Dict[str, float]) -> str:
@@ -292,6 +368,10 @@ def render_metrics(samples: Dict[str, float]) -> str:
         lines.append("-- decode token economics --")
         for g, v in decode:
             lines.append(f"{g:<22}{v:>12g}")
+    overlap = render_decode_overlap(samples)
+    if overlap:
+        lines.append("")
+        lines.append(overlap.rstrip("\n"))
     pct = histogram_percentile_deltas(samples, None)
     phase = {k: v for k, v in pct.items()
              if k.startswith("executor_step_phase_ms")}
@@ -300,6 +380,27 @@ def render_metrics(samples: Dict[str, float]) -> str:
         lines.append(format_percentile_table(
             phase, title="executor phase percentiles (cumulative)"))
     return "\n".join(lines) + "\n"
+
+
+def _is_metrics_file(path: str) -> bool:
+    """True when ``path`` reads as Prometheus text exposition rather
+    than step-trace JSONL (whose every line is a JSON object)."""
+    if not os.path.exists(path):
+        return False
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    json.loads(line)
+                    return False
+                except ValueError:
+                    return True
+    except OSError:
+        return False
+    return False
 
 
 def _load_metrics(target: str) -> Dict[str, float]:
@@ -328,8 +429,14 @@ def main(argv=None) -> int:
     try:
         wrote = False
         if args.compare:
-            before, after = (load_trace(p) for p in args.compare)
-            sys.stdout.write(render_compare(before, after))
+            if all(_is_metrics_file(p) for p in args.compare):
+                # two saved /metrics scrapes: decode-overlap deltas
+                # (the async-vs-sync pairing)
+                b, a = (_load_metrics(p) for p in args.compare)
+                sys.stdout.write(render_metrics_compare(b, a))
+            else:
+                before, after = (load_trace(p) for p in args.compare)
+                sys.stdout.write(render_compare(before, after))
             wrote = True
         elif args.trace:
             steps, costs = load_trace(args.trace)
